@@ -1,0 +1,61 @@
+"""Headline benchmark: PSO on Rastrigin-30D at 1M particles, one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference has no published numbers (BASELINE.md); its
+measured aggregate throughput is ~40,000 agent-steps/sec at 64 agents on a
+2.70 GHz Xeon core (SURVEY.md §6) — that is the denominator for
+``vs_baseline``.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from distributed_swarm_algorithm_tpu.ops.objectives import rastrigin
+from distributed_swarm_algorithm_tpu.ops.pso import pso_init, pso_run
+
+N = 1_048_576           # 1M particles (BASELINE.json north star)
+DIM = 30                # Rastrigin-30D
+HALF_WIDTH = 5.12
+WARMUP_STEPS = 20
+BENCH_STEPS = 200
+REFERENCE_AGENT_STEPS_PER_SEC = 40_000.0  # SURVEY.md §6, measured
+
+
+def main():
+    state = pso_init(rastrigin, n=N, dim=DIM, half_width=HALF_WIDTH, seed=0)
+    jax.block_until_ready(state.pos)
+
+    # Warmup: trigger compilation of the scan'd kernel.
+    state = pso_run(state, rastrigin, WARMUP_STEPS, half_width=HALF_WIDTH)
+    jax.block_until_ready(state.gbest_fit)
+
+    start = time.perf_counter()
+    state = pso_run(state, rastrigin, BENCH_STEPS, half_width=HALF_WIDTH)
+    jax.block_until_ready(state.gbest_fit)
+    elapsed = time.perf_counter() - start
+
+    steps_per_sec = BENCH_STEPS / elapsed
+    agent_steps_per_sec = steps_per_sec * N
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "agent-steps/sec, PSO Rastrigin-30D, 1,048,576 "
+                    "particles, 1 chip"
+                ),
+                "value": round(agent_steps_per_sec, 1),
+                "unit": "agent-steps/sec",
+                "vs_baseline": round(
+                    agent_steps_per_sec / REFERENCE_AGENT_STEPS_PER_SEC, 2
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

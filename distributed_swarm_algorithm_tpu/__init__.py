@@ -1,0 +1,69 @@
+"""distributed_swarm_algorithm_tpu — a TPU-native swarm framework.
+
+A ground-up re-design of the capabilities of the reference
+``distributed-swarm-algorithm`` (decentralized leader election, heartbeat
+failure detection, distributed task allocation, formation control, APF
+motion planning) as synchronous vectorized JAX dataflow: the swarm is one
+struct-of-arrays pytree, the per-agent 10 Hz loop body is one jitted
+whole-swarm kernel, and every message-based protocol is a masked reduction
+that shards over a TPU mesh via ``shard_map`` (see ``parallel/``).
+
+Layers (mirrors SURVEY.md §1, rebuilt TPU-first):
+  models/    VectorSwarm (capability parity), PSO (perf flagship),
+             SwarmAgent (per-agent CPU-compatible API + real transport)
+  ops/       pure kernels: physics, coordination, allocation, PSO,
+             objectives, neighbor search
+  parallel/  mesh/sharding/island-model multi-chip layer
+  utils/     config, checkpoint, metrics, profiling
+"""
+
+from .state import (
+    ELECTION_WAIT,
+    FOLLOWER,
+    LEADER,
+    NO_CAP,
+    NO_LEADER,
+    NO_WINNER,
+    TASK_ASSIGNED,
+    TASK_LOCKED,
+    TASK_OPEN,
+    TASK_TENTATIVE,
+    SwarmState,
+    make_swarm,
+    with_tasks,
+)
+from .utils.config import DEFAULT_CONFIG, SwarmConfig
+from .models.swarm import VectorSwarm, swarm_rollout, swarm_tick
+from .models.pso import PSO
+from .ops import objectives
+from .ops.allocation import (
+    allocation_step,
+    arbitrate,
+    task_status_view,
+    utility_matrix,
+)
+from .ops.coordination import (
+    coordination_step,
+    current_leader,
+    instant_election,
+    kill,
+    revive,
+)
+from .ops.physics import apf_forces, formation_targets, physics_step
+from .ops.pso import PSOState, pso_init, pso_run, pso_step
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "SwarmConfig", "DEFAULT_CONFIG", "SwarmState", "make_swarm", "with_tasks",
+    "VectorSwarm", "swarm_tick", "swarm_rollout", "PSO",
+    "PSOState", "pso_init", "pso_step", "pso_run",
+    "objectives",
+    "coordination_step", "instant_election", "current_leader", "kill",
+    "revive",
+    "allocation_step", "arbitrate", "utility_matrix", "task_status_view",
+    "physics_step", "apf_forces", "formation_targets",
+    "FOLLOWER", "ELECTION_WAIT", "LEADER",
+    "TASK_OPEN", "TASK_TENTATIVE", "TASK_ASSIGNED", "TASK_LOCKED",
+    "NO_LEADER", "NO_CAP", "NO_WINNER",
+]

@@ -1,0 +1,213 @@
+"""Command-line interface.
+
+A superset of the reference CLI (/root/reference/agent.py:349-360, flags
+``--id --count --caps``) plus subcommands for the deployments the
+reference could not actually run:
+
+  agent   one per-agent process (reference-compatible; UDP transport works)
+  sim     N agents on an in-process bus, stepped in lockstep
+  swarm   the vectorized TPU swarm (VectorSwarm)
+  pso     particle-swarm optimization on a benchmark objective
+  bench   the headline benchmark (same as bench.py)
+
+``python -m distributed_swarm_algorithm_tpu --id 1 --count 3 --caps lift``
+is accepted as-is (bare reference flags imply ``agent``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _add_agent_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--id", type=int, required=True, help="Agent ID")
+    p.add_argument("--count", type=int, default=1, help="Total Agents")
+    p.add_argument("--caps", type=str, nargs="+", default=[],
+                   help="Agent Capabilities")
+    p.add_argument("--bind", type=str, default=None,
+                   help="UDP bind addr host:port (enables UDP transport)")
+    p.add_argument("--peers", type=str, nargs="*", default=[],
+                   help="UDP peer addrs host:port")
+    p.add_argument("--steps", type=int, default=0,
+                   help="run N ticks then exit (0 = forever)")
+
+
+def _parse_addr(addr: str):
+    host, _, port = addr.rpartition(":")
+    if not host or not port.isdigit():
+        raise SystemExit(
+            f"error: expected host:port, got {addr!r} (e.g. 127.0.0.1:9001)"
+        )
+    return host, int(port)
+
+
+def _cmd_agent(args) -> int:
+    import logging
+
+    from .models.agent import SwarmAgent, UdpTransport
+
+    # The reference logs agent lifecycle at INFO (agent.py:9-10); match it
+    # so elections/claims are visible from the terminal.
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s - %(name)s - %(levelname)s - %(message)s",
+    )
+    agent = SwarmAgent(args.id, args.count, capabilities=args.caps)
+    if args.bind:
+        peers = [_parse_addr(p) for p in args.peers]
+        transport = UdpTransport(_parse_addr(args.bind), peers)
+        transport.attach(agent)
+    try:
+        if args.steps:
+            period = 1.0 / agent.config.tick_rate_hz
+            for _ in range(args.steps):
+                start = time.time()
+                agent.step()
+                # Sleep the leftover, like update_loop (agent.py:78-81), so
+                # wall-clock timing stays at tick_rate_hz.
+                time.sleep(max(0.0, period - (time.time() - start)))
+            print(json.dumps({
+                "id": agent.agent_id,
+                "state": agent.state.name,
+                "leader_id": agent.leader_id,
+                "position": [round(p, 3) for p in agent.position],
+                "tick": agent.tick,
+            }))
+        else:
+            agent.update_loop()
+    except KeyboardInterrupt:
+        print("Shutting down.")
+    finally:
+        if args.bind:
+            transport.close()
+    return 0
+
+
+def _cmd_sim(args) -> int:
+    from .models.agent import AgentState, run_local_swarm
+
+    agents, _ = run_local_swarm(args.n, args.steps, seed=args.seed)
+    leaders = [a.agent_id for a in agents if a.state == AgentState.LEADER]
+    print(json.dumps({
+        "agents": args.n,
+        "ticks": args.steps,
+        "leaders": leaders,
+        "consensus": len({a.leader_id for a in agents}) == 1,
+    }))
+    return 0
+
+
+def _cmd_swarm(args) -> int:
+    from .models.swarm import VectorSwarm
+
+    sw = VectorSwarm(args.n, dim=args.dim, seed=args.seed,
+                     spread=args.spread)
+    if args.target:
+        sw.set_target([float(x) for x in args.target])
+    start = time.perf_counter()
+    sw.step(args.steps)
+    elapsed = time.perf_counter() - start
+    lid, exists = sw.leader()
+    print(json.dumps({
+        "agents": args.n,
+        "ticks": args.steps,
+        "leader": lid if exists else None,
+        "ticks_per_sec": round(args.steps / elapsed, 1),
+        "agent_steps_per_sec": round(args.steps * args.n / elapsed, 1),
+    }))
+    return 0
+
+
+def _cmd_pso(args) -> int:
+    from .models.pso import PSO
+
+    opt = PSO(args.objective, n=args.n, dim=args.dim, seed=args.seed)
+    start = time.perf_counter()
+    opt.run(args.steps)
+    elapsed = time.perf_counter() - start
+    print(json.dumps({
+        "objective": args.objective,
+        "particles": args.n,
+        "dim": args.dim,
+        "iters": args.steps,
+        "best": opt.best,
+        "steps_per_sec": round(args.steps / elapsed, 1),
+    }))
+    return 0
+
+
+def _cmd_bench(args) -> int:
+    # bench.py lives at the repo root (a driver contract), outside the
+    # package — resolve it relative to this file so the subcommand works
+    # from any CWD.
+    import runpy
+    from pathlib import Path
+
+    bench_path = Path(__file__).resolve().parent.parent / "bench.py"
+    if not bench_path.exists():
+        print(f"error: bench script not found at {bench_path}",
+              file=sys.stderr)
+        return 2
+    runpy.run_path(str(bench_path), run_name="__main__")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="distributed_swarm_algorithm_tpu")
+    sub = parser.add_subparsers(dest="cmd")
+
+    p_agent = sub.add_parser("agent", help="run one per-agent process")
+    _add_agent_args(p_agent)
+    p_agent.set_defaults(fn=_cmd_agent)
+
+    p_sim = sub.add_parser("sim", help="N agents on an in-process bus")
+    p_sim.add_argument("--n", type=int, default=5)
+    p_sim.add_argument("--steps", type=int, default=100)
+    p_sim.add_argument("--seed", type=int, default=0)
+    p_sim.set_defaults(fn=_cmd_sim)
+
+    p_swarm = sub.add_parser("swarm", help="vectorized TPU swarm")
+    p_swarm.add_argument("--n", type=int, default=1024)
+    p_swarm.add_argument("--dim", type=int, default=2)
+    p_swarm.add_argument("--steps", type=int, default=1000)
+    p_swarm.add_argument("--seed", type=int, default=0)
+    p_swarm.add_argument("--spread", type=float, default=10.0)
+    p_swarm.add_argument("--target", nargs="+", default=None)
+    p_swarm.set_defaults(fn=_cmd_swarm)
+
+    p_pso = sub.add_parser("pso", help="particle swarm optimization")
+    p_pso.add_argument("--objective", default="rastrigin")
+    p_pso.add_argument("--n", type=int, default=8192)
+    p_pso.add_argument("--dim", type=int, default=30)
+    p_pso.add_argument("--steps", type=int, default=500)
+    p_pso.add_argument("--seed", type=int, default=0)
+    p_pso.set_defaults(fn=_cmd_pso)
+
+    p_bench = sub.add_parser("bench", help="headline benchmark")
+    p_bench.set_defaults(fn=_cmd_bench)
+
+    return parser
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # Bare reference flags (`--id 1 --count 3`) imply the agent command.
+    if argv and argv[0].startswith("--"):
+        argv = ["agent"] + argv
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if not getattr(args, "fn", None):
+        parser.print_help()
+        return 2
+    try:
+        return args.fn(args)
+    except (KeyError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

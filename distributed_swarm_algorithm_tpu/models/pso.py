@@ -1,0 +1,66 @@
+"""User-facing PSO optimizer model."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+
+from ..ops import pso as _k
+from ..ops.objectives import get_objective
+
+
+class PSO:
+    """Global-best particle swarm optimizer.
+
+    >>> opt = PSO("rastrigin", n=4096, dim=30, seed=0)
+    >>> opt.run(500)
+    >>> float(opt.state.gbest_fit)  # doctest: +SKIP
+    """
+
+    def __init__(
+        self,
+        objective: Union[str, Callable],
+        n: int,
+        dim: int,
+        half_width: Optional[float] = None,
+        w: float = _k.W,
+        c1: float = _k.C1,
+        c2: float = _k.C2,
+        vmax_frac: float = 0.5,
+        seed: int = 0,
+        dtype=None,
+    ):
+        if isinstance(objective, str):
+            fn, default_hw = get_objective(objective)
+        else:
+            fn, default_hw = objective, 5.12
+        self.objective = fn
+        self.half_width = float(
+            half_width if half_width is not None else default_hw
+        )
+        self.w, self.c1, self.c2 = float(w), float(c1), float(c2)
+        self.vmax_frac = float(vmax_frac)
+        kwargs = {} if dtype is None else {"dtype": dtype}
+        self.state = _k.pso_init(
+            fn, n, dim, self.half_width, seed=seed, **kwargs
+        )
+
+    def step(self) -> _k.PSOState:
+        self.state = _k.pso_step(
+            self.state, self.objective, self.w, self.c1, self.c2,
+            self.half_width, self.vmax_frac,
+        )
+        return self.state
+
+    def run(self, n_steps: int) -> _k.PSOState:
+        self.state = _k.pso_run(
+            self.state, self.objective, n_steps, self.w, self.c1, self.c2,
+            self.half_width, self.vmax_frac,
+        )
+        jax.block_until_ready(self.state.gbest_fit)
+        return self.state
+
+    @property
+    def best(self) -> float:
+        return float(self.state.gbest_fit)

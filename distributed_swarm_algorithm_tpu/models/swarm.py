@@ -1,0 +1,152 @@
+"""The vectorized swarm model — full reference capability parity.
+
+``swarm_tick`` is the whole-swarm equivalent of one pass through the
+reference's 10 Hz loop body (/root/reference/agent.py:67-92): coordination
+(election + heartbeat + failure detection), task allocation, then physics.
+It is a pure ``SwarmState -> SwarmState`` function; ``VectorSwarm`` wraps
+it with jit, ``lax.scan`` batched rollouts, and an optional wall-clock
+realtime mode matching the reference's pacing (agent.py:78-81).
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.allocation import allocation_step, task_status_view
+from ..ops.coordination import coordination_step, current_leader, kill, revive
+from ..ops.physics import physics_step
+from ..state import SwarmState, make_swarm, with_tasks
+from ..utils.config import DEFAULT_CONFIG, SwarmConfig
+
+_NO_OBSTACLES = None
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def swarm_tick(
+    state: SwarmState,
+    obstacles: Optional[jax.Array],
+    cfg: SwarmConfig,
+) -> SwarmState:
+    """One synchronous swarm tick (= one 10 Hz loop body for every agent)."""
+    state = state.replace(tick=state.tick + 1)
+    state = coordination_step(state, cfg)          # agent.py:83-89
+    state = allocation_step(state, cfg)            # agent.py:91-92
+    state = physics_step(state, obstacles, cfg)    # agent.py:94-181
+    return state
+
+
+@partial(jax.jit, static_argnames=("cfg", "n_steps"))
+def swarm_rollout(
+    state: SwarmState,
+    obstacles: Optional[jax.Array],
+    cfg: SwarmConfig,
+    n_steps: int,
+) -> SwarmState:
+    """``n_steps`` ticks under one ``lax.scan`` — the as-fast-as-possible
+    mode; XLA fuses each tick into a handful of kernels."""
+
+    def body(s, _):
+        return swarm_tick(s, obstacles, cfg), None
+
+    state, _ = jax.lax.scan(body, state, None, length=n_steps)
+    return state
+
+
+class VectorSwarm:
+    """User-facing handle: owns a SwarmState + SwarmConfig.
+
+    Replaces the reference's one-process-per-agent CLI deployment
+    (agent.py:349-360) with one object for the entire swarm.  The per-agent
+    API surface (set_target / update_sensors / tasks) maps to whole-swarm
+    array setters.
+    """
+
+    def __init__(
+        self,
+        n_agents: int,
+        dim: int = 2,
+        n_tasks: int = 0,
+        n_caps: int = 1,
+        config: Optional[SwarmConfig] = None,
+        seed: int = 0,
+        spread: float = 0.0,
+    ):
+        self.config = config or DEFAULT_CONFIG
+        self.state = make_swarm(
+            n_agents, dim=dim, n_tasks=n_tasks, n_caps=n_caps, seed=seed,
+            spread=spread,
+        )
+        self.obstacles: Optional[jax.Array] = _NO_OBSTACLES
+
+    # --- world injection (reference: set_target / update_sensors) --------
+    def set_target(self, target, agents=None) -> None:
+        """Set a nav target for all agents (or a subset) — agent.py:56-57."""
+        t = jnp.broadcast_to(
+            jnp.asarray(target, self.state.pos.dtype), self.state.pos.shape
+        )
+        if agents is None:
+            self.state = self.state.replace(
+                target=t, has_target=jnp.ones_like(self.state.has_target)
+            )
+        else:
+            sel = jnp.zeros_like(self.state.has_target).at[agents].set(True)
+            self.state = self.state.replace(
+                target=jnp.where(sel[:, None], t, self.state.target),
+                has_target=self.state.has_target | sel,
+            )
+
+    def set_obstacles(self, obstacles) -> None:
+        """obstacles: [O, D+1] rows of (center..., radius) — agent.py:59-64."""
+        self.obstacles = (
+            None
+            if obstacles is None
+            else jnp.asarray(obstacles, self.state.pos.dtype)
+        )
+
+    def add_tasks(self, task_pos, task_cap=None) -> None:
+        self.state = with_tasks(self.state, task_pos, task_cap)
+
+    def set_capabilities(self, caps) -> None:
+        """caps: [N, C] bool one-hot (replaces string lists, agent.py:52)."""
+        self.state = self.state.replace(caps=jnp.asarray(caps, bool))
+
+    # --- stepping --------------------------------------------------------
+    def step(self, n: int = 1) -> SwarmState:
+        if n == 1:
+            self.state = swarm_tick(self.state, self.obstacles, self.config)
+        else:
+            self.state = swarm_rollout(
+                self.state, self.obstacles, self.config, n
+            )
+        return self.state
+
+    def run_realtime(self, n_steps: int) -> SwarmState:
+        """Wall-clock-paced loop at ``tick_rate_hz`` (agent.py:67-81)."""
+        period = 1.0 / self.config.tick_rate_hz
+        for _ in range(n_steps):
+            start = time.time()
+            self.state = swarm_tick(self.state, self.obstacles, self.config)
+            jax.block_until_ready(self.state.pos)
+            leftover = period - (time.time() - start)
+            if leftover > 0:
+                time.sleep(leftover)
+        return self.state
+
+    # --- introspection / fault injection ---------------------------------
+    def leader(self):
+        lid, exists = current_leader(self.state)
+        return (int(lid), bool(exists))
+
+    def task_statuses(self):
+        return task_status_view(self.state)
+
+    def kill(self, ids) -> None:
+        self.state = kill(self.state, ids)
+
+    def revive(self, ids) -> None:
+        self.state = revive(self.state, ids)

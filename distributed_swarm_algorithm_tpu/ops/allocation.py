@@ -1,0 +1,140 @@
+"""Distributed task allocation as a bid matrix.
+
+The reference's allocation (components #14-#16, /root/reference/agent.py:
+291-347) is: agents greedily broadcast claims for OPEN tasks whose utility
+U = 100/(1+dist)·cap_match exceeds 20; the current leader arbitrates —
+first claim wins, a challenger must beat the incumbent by +5 hysteresis —
+and broadcasts the award; the winner marks ASSIGNED, everyone else LOCKED.
+
+Vectorized: all claims for a tick land simultaneously in a utility matrix
+``U[N, T]``; arbitration is a per-task masked argmax with the hysteresis
+applied against the incumbent column (exact semantics of agent.py:308-325).
+The global ``task_winner``/``task_util`` arrays ARE the leader's
+``task_claims`` ledger; ``task_claimed[N, T]`` is each agent's local
+"I claimed / saw it resolved" view that drives TENTATIVE/LOCKED statuses
+and stops re-claims, like the reference's per-agent ``tasks`` dict.
+
+Tie-breaking: the reference awards whichever claim *arrives* first — a
+nondeterministic race.  Here simultaneous claims are resolved to the
+highest utility, ties to the lowest agent id — deterministic by
+construction (SURVEY.md §5 "race detection": protocol races vanish in the
+synchronous model).
+
+Deliberate fix (SURVEY.md §5a bug 4): the reference lets an agent go
+TENTATIVE on its own broadcast even when no leader exists to arbitrate,
+wedging the task forever.  Here claims are simply not made while the swarm
+is leaderless; the task stays OPEN and is claimed once a leader emerges.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..state import (
+    LEADER,
+    NO_WINNER,
+    TASK_ASSIGNED,
+    TASK_LOCKED,
+    TASK_OPEN,
+    TASK_TENTATIVE,
+    SwarmState,
+)
+from ..utils.config import SwarmConfig
+
+
+def utility_matrix(state: SwarmState, cfg: SwarmConfig) -> jax.Array:
+    """U[N, T] = scale / (1 + dist) · cap_match  (agent.py:338-347)."""
+    delta = state.pos[:, None, :] - state.task_pos[None, :, :]
+    dist = jnp.linalg.norm(delta, axis=-1)                      # [N, T]
+    no_cap_needed = state.task_cap < 0                          # [T]
+    cap_ok = state.caps[:, jnp.maximum(state.task_cap, 0)]      # [N, T]
+    match = jnp.where(no_cap_needed[None, :], True, cap_ok)
+    return jnp.where(match, cfg.utility_scale / (1.0 + dist), 0.0)
+
+
+def arbitrate(
+    claims_util: jax.Array,
+    claimant_id: jax.Array,
+    incumbent_winner: jax.Array,
+    incumbent_util: jax.Array,
+    hysteresis: float,
+):
+    """The leader's conflict-resolution rule as a pure reduction.
+
+    claims_util: [N, T] utility of each live claim (-inf/0 where no claim).
+    Returns (winner[T], util[T]).  First claim wins; a challenger must beat
+    the incumbent's recorded utility by ``hysteresis`` (agent.py:308-322).
+    """
+    has_claim = jnp.any(claims_util > 0.0, axis=0)              # [T]
+    # Highest utility wins; ties break to the lowest agent id (argmax picks
+    # the first maximal row; rows are id-ordered).
+    best_row = jnp.argmax(claims_util, axis=0)                  # [T]
+    best_util = jnp.max(claims_util, axis=0)                    # [T]
+    best_id = claimant_id[best_row]
+    vacant = incumbent_winner == NO_WINNER
+    beats = best_util > incumbent_util + hysteresis             # agent.py:316
+    award = has_claim & (vacant | beats)
+    winner = jnp.where(award, best_id, incumbent_winner)
+    util = jnp.where(award, best_util, incumbent_util)
+    return winner, util
+
+
+def allocation_step(state: SwarmState, cfg: SwarmConfig) -> SwarmState:
+    """One allocation tick: greedy claims, leader arbitration, award."""
+    if state.n_tasks == 0:
+        return state
+    u = utility_matrix(state, cfg)
+    leader_exists = jnp.any(state.alive & (state.fsm == LEADER))
+
+    # Greedy claim (agent.py:292-302): alive agents claim tasks that are
+    # OPEN *in their own view* and clear the threshold — gated on a leader
+    # existing to arbitrate (see module docstring).
+    open_for_me = ~state.task_claimed
+    if not cfg.allocation_lock_on_award:
+        # Live-reallocation mode: an awarded task stays contestable by
+        # everyone except its current owner; the hysteresis in arbitrate()
+        # then damps thrash between moving agents.
+        not_mine = state.task_winner[None, :] != state.agent_id[:, None]
+        open_for_me = open_for_me | not_mine
+    claims = (
+        state.alive[:, None]
+        & open_for_me
+        & (u > cfg.utility_threshold)
+        & leader_exists
+    )
+    claims_util = jnp.where(claims, u, 0.0)
+
+    winner, util = arbitrate(
+        claims_util,
+        state.agent_id,
+        state.task_winner,
+        state.task_util,
+        cfg.claim_hysteresis,
+    )
+
+    # Claimants go TENTATIVE locally (agent.py:300); the award broadcast
+    # resolves the task for every agent (agent.py:327-336).
+    awarded = winner != NO_WINNER
+    task_claimed = state.task_claimed | claims | awarded[None, :]
+
+    return state.replace(
+        task_winner=winner, task_util=util, task_claimed=task_claimed
+    )
+
+
+def task_status_view(state: SwarmState) -> jax.Array:
+    """[N, T] per-agent task status, the reference's string statuses as ints:
+    OPEN=0, TENTATIVE=1 (I claimed, unresolved), ASSIGNED=2 (awarded to me),
+    LOCKED=3 (awarded to someone else) — agent.py:41, 300, 330-336."""
+    awarded = state.task_winner != NO_WINNER                    # [T]
+    mine = state.task_winner[None, :] == state.agent_id[:, None]
+    return jnp.where(
+        awarded[None, :] & mine,
+        TASK_ASSIGNED,
+        jnp.where(
+            awarded[None, :],
+            TASK_LOCKED,
+            jnp.where(state.task_claimed, TASK_TENTATIVE, TASK_OPEN),
+        ),
+    )

@@ -1,0 +1,180 @@
+"""Leader election, heartbeat liveness, and failure detection — as dataflow.
+
+The reference implements a "quiet bully" protocol with asynchronous messages
+(/root/reference/agent.py:216-289): followers detect 3 s of heartbeat
+silence, wait a random jitter, self-acclaim leadership, and higher agent
+ids bully lower ones.  Here the same protocol runs *synchronously* over the
+whole swarm as masked array updates — per-agent views (``fsm``,
+``leader_id``, ``last_hb_tick``) are kept so the decentralized semantics
+(divergent views mid-election, jittered acclaim races) are preserved, but
+each "broadcast" resolves in one tick via a max-id reduction instead of a
+packet exchange.  Under ``shard_map`` the reductions become
+``lax.pmax``/``lax.psum`` over ICI (see parallel/sharding.py).
+
+Tick order inside ``coordination_step`` (mirrors _process_logic, which runs
+timeout/acclaim logic before leader duties, agent.py:83-92):
+  1. failure detection: silent leader -> ELECTION_WAIT + jitter
+     (agent.py:217-231),
+  2. acclaim resolution: expired waiters self-acclaim; the highest-id
+     contender (acclaimers + sitting leaders) wins and everyone adopts it —
+     this collapses the reference's ACCLAIM/COORDINATOR/bully-back exchange
+     (agent.py:234-241, 263-281) into one reduction with the same steady
+     state,
+  3. heartbeat: leaders emit every ``heartbeat_period_ticks``
+     (agent.py:283-289); receivers refresh liveness and adopt the highest
+     emitter; lower-id leaders yield, higher-id leaders suppress
+     (agent.py:243-261).
+
+Deliberate fix (SURVEY.md §5a bug 3): the reference's "bully back" reply is
+tick-gated and usually sends nothing; here suppression is part of the same-
+tick reduction, so it always takes effect.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..state import ELECTION_WAIT, FOLLOWER, LEADER, NO_LEADER, SwarmState
+from ..utils.config import SwarmConfig
+
+
+def coordination_step(state: SwarmState, cfg: SwarmConfig) -> SwarmState:
+    """One coordination tick.  Assumes ``state.tick`` was already advanced."""
+    tick = state.tick
+    key, sub = jax.random.split(state.key)
+    agent_id = state.agent_id
+    alive = state.alive
+    fsm = state.fsm
+    leader_id = state.leader_id
+    last_hb = state.last_hb_tick
+    wait_until = state.wait_until
+    lpos = state.leader_pos
+    has_lpos = state.has_leader_pos
+
+    # --- 1. failure detection (agent.py:221-231) -------------------------
+    silent = (tick - last_hb) > cfg.election_timeout_ticks
+    to_wait = alive & (fsm == FOLLOWER) & silent
+    jitter = jax.random.randint(
+        sub, (state.n_agents,), 0, cfg.election_jitter_ticks + 1
+    )
+    wait_until = jnp.where(to_wait, tick + jitter, wait_until)
+    fsm = jnp.where(to_wait, ELECTION_WAIT, fsm)
+    leader_id = jnp.where(to_wait, NO_LEADER, leader_id)
+    has_lpos = has_lpos & ~to_wait
+
+    # --- 2. acclaim + bully resolution (agent.py:234-241, 263-281) -------
+    # "elapsed > delay" is strict in the reference (agent.py:235), so an
+    # agent entering ELECTION_WAIT this tick never acclaims this tick.
+    acclaim = alive & (fsm == ELECTION_WAIT) & (tick > wait_until)
+    any_acclaim = jnp.any(acclaim)
+    # A still-waiting agent that hears an acclaim from a LOWER id stops
+    # waiting and fights (agent.py:269-275) — without this, a lucky low-id
+    # jitter could steal leadership from a higher waiter for good.
+    min_acclaim = jnp.min(
+        jnp.where(acclaim, agent_id, jnp.iinfo(jnp.int32).max)
+    )
+    bully = (
+        alive
+        & (fsm == ELECTION_WAIT)
+        & any_acclaim
+        & (agent_id > min_acclaim)
+    )
+    contender = acclaim | bully | (alive & (fsm == LEADER))
+    winner = jnp.max(jnp.where(contender, agent_id, NO_LEADER))
+    is_winner = contender & (agent_id == winner)
+    resolve = any_acclaim & alive
+    fsm = jnp.where(resolve, jnp.where(is_winner, LEADER, FOLLOWER), fsm)
+    leader_id = jnp.where(resolve, winner, leader_id)
+    # Losers treat the acclaim as liveness proof (agent.py:268).
+    last_hb = jnp.where(resolve & ~is_winner, tick, last_hb)
+
+    # --- 3. heartbeat (agent.py:243-261, 283-289) ------------------------
+    leaders = alive & (fsm == LEADER)
+    emit = leaders & (tick % cfg.heartbeat_period_ticks == 0)
+    any_emit = jnp.any(emit)
+    emit_ids = jnp.where(emit, agent_id, NO_LEADER)
+    hb_id = jnp.max(emit_ids)
+    hb_pos = state.pos[jnp.argmax(emit_ids)]
+    recv = any_emit & alive & (agent_id != hb_id)
+    # Higher-id leaders suppress the emitter (agent.py:244-247); lower-id
+    # leaders yield (agent.py:249-251); waiters cancel (agent.py:260-261).
+    suppress = recv & (fsm == LEADER) & (agent_id > hb_id)
+    adopt = recv & ~suppress
+    fsm = jnp.where(adopt, FOLLOWER, fsm)
+    leader_id = jnp.where(adopt, hb_id, leader_id)
+    last_hb = jnp.where(adopt, tick, last_hb)
+    lpos = jnp.where(adopt[:, None], hb_pos[None, :], lpos)
+    has_lpos = has_lpos | adopt
+
+    # A leader's own view of the leadership (agent.py:239).
+    leader_id = jnp.where(alive & (fsm == LEADER), agent_id, leader_id)
+
+    return state.replace(
+        key=key,
+        fsm=fsm,
+        leader_id=leader_id,
+        last_hb_tick=last_hb,
+        wait_until=wait_until,
+        leader_pos=lpos,
+        has_leader_pos=has_lpos,
+    )
+
+
+def instant_election(state: SwarmState) -> SwarmState:
+    """Steady-state election collapsed to a single reduction.
+
+    The bully protocol's fixed point is "highest alive id leads"
+    (agent.py:244-251, 263-275).  This skips the transient entirely — the
+    optimizer-path equivalent of SURVEY.md §7 step 3.  Recovery from leader
+    failure is free: clear the alive bit and call this again.
+    """
+    winner = jnp.max(jnp.where(state.alive, state.agent_id, NO_LEADER))
+    n = state.n_agents
+    is_winner = state.alive & (state.agent_id == winner)
+    winner_pos = state.pos[jnp.argmax(jnp.where(is_winner, 1, 0))]
+    any_alive = winner >= 0
+    return state.replace(
+        fsm=jnp.where(is_winner, LEADER, FOLLOWER),
+        leader_id=jnp.where(state.alive, winner, state.leader_id),
+        leader_pos=jnp.where(
+            (state.alive & ~is_winner & any_alive)[:, None],
+            winner_pos[None, :],
+            state.leader_pos,
+        ),
+        has_leader_pos=jnp.where(
+            state.alive, ~is_winner & any_alive, state.has_leader_pos
+        ),
+        last_hb_tick=jnp.where(state.alive, state.tick, state.last_hb_tick),
+    )
+
+
+def current_leader(state: SwarmState) -> Tuple[jax.Array, jax.Array]:
+    """(leader_id, exists) — the swarm-wide ground truth: the highest-id
+    alive agent that believes itself leader."""
+    mask = state.alive & (state.fsm == LEADER)
+    lid = jnp.max(jnp.where(mask, state.agent_id, NO_LEADER))
+    return lid, lid >= NO_LEADER + 1
+
+
+def kill(state: SwarmState, ids) -> SwarmState:
+    """Fault injection: mark agents dead.  The reference's only fault hook is
+    back-dating a timestamp in tests (test_election.py:25); here failure is a
+    first-class mask and detection/recovery follow from the protocol."""
+    ids = jnp.asarray(ids, jnp.int32).reshape(-1)
+    dead = jnp.any(state.agent_id[:, None] == ids[None, :], axis=1)
+    return state.replace(alive=state.alive & ~dead)
+
+
+def revive(state: SwarmState, ids) -> SwarmState:
+    """Elastic recovery: bring agents back (they rejoin as followers)."""
+    ids = jnp.asarray(ids, jnp.int32).reshape(-1)
+    back = jnp.any(state.agent_id[:, None] == ids[None, :], axis=1)
+    return state.replace(
+        alive=state.alive | back,
+        fsm=jnp.where(back, FOLLOWER, state.fsm),
+        leader_id=jnp.where(back, NO_LEADER, state.leader_id),
+        last_hb_tick=jnp.where(back, state.tick, state.last_hb_tick),
+    )

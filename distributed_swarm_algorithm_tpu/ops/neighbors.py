@@ -1,0 +1,120 @@
+"""Neighbor-separation kernels.
+
+The reference's separation force iterates a Python list of sensor-provided
+neighbors (/root/reference/agent.py:148-160).  Vectorized, "neighbors" means
+*every other alive agent* — exact, because any agent beyond the 2 m
+personal-space radius contributes zero force anyway.
+
+Two kernels:
+  - ``separation_dense``: all-pairs [N,N] broadcast.  Exact; O(N^2) memory —
+    the right choice up to a few thousand agents on one chip.
+  - ``separation_grid``: spatial-hash grid (sort by cell key + windowed
+    gather over the 9 neighboring cells).  O(N * 9 * K); the SURVEY.md §7
+    "hard parts" answer for million-agent swarms where O(N^2) is impossible.
+    2-D only (the reference's world is 2-D); other dims fall back to dense.
+
+Both clamp every distance/norm at ``eps`` (fixes SURVEY.md §5a bug 1 — the
+reference crashes with ZeroDivisionError when two agents are co-located,
+which is its *default spawn*).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Cell-key packing base for the grid hash; supports coords in ±(2^15) cells.
+_GRID_BASE = 1 << 16
+
+
+def separation_dense(
+    pos: jax.Array,
+    alive: jax.Array,
+    k_sep: float,
+    personal_space: float,
+    eps: float,
+) -> jax.Array:
+    """All-pairs separation force, [N, D]."""
+    n = pos.shape[0]
+    diff = pos[:, None, :] - pos[None, :, :]          # [N, N, D], i minus j
+    dist = jnp.linalg.norm(diff, axis=-1)             # [N, N]
+    dist_c = jnp.maximum(dist, eps)
+    near = (
+        alive[:, None]
+        & alive[None, :]
+        & ~jnp.eye(n, dtype=bool)
+        & (dist < personal_space)
+    )
+    mag = k_sep / (dist_c * dist_c)                   # agent.py:155
+    unit = diff / dist_c[..., None]
+    force = jnp.where(near[..., None], mag[..., None] * unit, 0.0)
+    return jnp.sum(force, axis=1)
+
+
+def separation_grid(
+    pos: jax.Array,
+    alive: jax.Array,
+    k_sep: float,
+    personal_space: float,
+    eps: float,
+    cell: float,
+    max_per_cell: int,
+) -> jax.Array:
+    """Spatial-hash separation force, [N, D].  2-D only; else dense fallback.
+
+    Agents are sorted by packed cell key; each agent then gathers a
+    ``max_per_cell``-wide window from each of its 9 surrounding cells via
+    ``searchsorted``.  Cells holding more than ``max_per_cell`` agents are
+    truncated (nearest-in-sort-order kept) — an explicit, documented cap,
+    unlike silent O(N^2) blowup.
+    """
+    n, d = pos.shape
+    if d != 2:
+        return separation_dense(pos, alive, k_sep, personal_space, eps)
+    if cell < personal_space:
+        # The 3×3 stencil only reaches one cell out: a smaller cell would
+        # silently drop in-range neighbors and agents would collide.
+        raise ValueError(
+            f"grid cell ({cell}) must be >= personal_space "
+            f"({personal_space}) for the 3x3 stencil to cover the "
+            "separation radius"
+        )
+
+    half = _GRID_BASE // 2
+    cx = jnp.floor(pos[:, 0] / cell).astype(jnp.int32) + half
+    cy = jnp.floor(pos[:, 1] / cell).astype(jnp.int32) + half
+    keys = cx * _GRID_BASE + cy
+
+    order = jnp.argsort(keys)
+    skeys = keys[order]
+    spos = pos[order]
+    salive = alive[order]
+    sorig = order  # sorted-slot -> original index, for self-exclusion
+
+    window = jnp.arange(max_per_cell)
+    me = jnp.arange(n)
+    force = jnp.zeros_like(pos)
+    for dx in (-1, 0, 1):
+        for dy in (-1, 0, 1):
+            nkey = (cx + dx) * _GRID_BASE + (cy + dy)
+            start = jnp.searchsorted(skeys, nkey)
+            idx = start[:, None] + window[None, :]          # [N, K]
+            idx_c = jnp.minimum(idx, n - 1)
+            in_cell = (idx < n) & (skeys[idx_c] == nkey[:, None])
+            npos = spos[idx_c]                              # [N, K, 2]
+            diff = pos[:, None, :] - npos
+            dist = jnp.linalg.norm(diff, axis=-1)
+            dist_c = jnp.maximum(dist, eps)
+            near = (
+                in_cell
+                & salive[idx_c]
+                & alive[:, None]
+                & (dist < personal_space)
+                & (sorig[idx_c] != me[:, None])
+            )
+            mag = k_sep / (dist_c * dist_c)
+            unit = diff / dist_c[..., None]
+            force = force + jnp.sum(
+                jnp.where(near[..., None], mag[..., None] * unit, 0.0), axis=1
+            )
+    return force

@@ -1,0 +1,81 @@
+"""Benchmark objective functions for swarm optimization.
+
+The reference has no objective library (its only 'fitness' is the task
+utility, agent.py:338-347); BASELINE.json's north-star configs name Sphere,
+Rastrigin-30D and Ackley-100D, so they are first-class here.  Every
+objective is a pure ``[..., D] -> [...]`` function, batched over leading
+axes, jit/vmap/shard_map-friendly (no Python branching on data).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_TWO_PI = 2.0 * jnp.pi
+
+
+def sphere(x):
+    """f(x) = sum x_i^2; global min 0 at origin."""
+    return jnp.sum(x * x, axis=-1)
+
+
+def rastrigin(x):
+    """f(x) = 10 D + sum(x^2 - 10 cos(2 pi x)); global min 0 at origin."""
+    d = x.shape[-1]
+    return 10.0 * d + jnp.sum(x * x - 10.0 * jnp.cos(_TWO_PI * x), axis=-1)
+
+
+def ackley(x):
+    """Ackley; global min 0 at origin."""
+    d = x.shape[-1]
+    s1 = jnp.sum(x * x, axis=-1) / d
+    s2 = jnp.sum(jnp.cos(_TWO_PI * x), axis=-1) / d
+    return (
+        -20.0 * jnp.exp(-0.2 * jnp.sqrt(s1))
+        - jnp.exp(s2)
+        + 20.0
+        + jnp.e
+    )
+
+
+def rosenbrock(x):
+    """Rosenbrock valley; global min 0 at (1,...,1)."""
+    a = x[..., 1:] - x[..., :-1] ** 2
+    b = 1.0 - x[..., :-1]
+    return jnp.sum(100.0 * a * a + b * b, axis=-1)
+
+
+def griewank(x):
+    d = x.shape[-1]
+    i = jnp.arange(1, d + 1, dtype=x.dtype)
+    return (
+        jnp.sum(x * x, axis=-1) / 4000.0
+        - jnp.prod(jnp.cos(x / jnp.sqrt(i)), axis=-1)
+        + 1.0
+    )
+
+
+def schwefel(x):
+    d = x.shape[-1]
+    return 418.9829 * d - jnp.sum(x * jnp.sin(jnp.sqrt(jnp.abs(x))), axis=-1)
+
+
+# Registry: name -> (fn, canonical search-domain half-width)
+OBJECTIVES = {
+    "sphere": (sphere, 5.12),
+    "rastrigin": (rastrigin, 5.12),
+    "ackley": (ackley, 32.768),
+    "rosenbrock": (rosenbrock, 2.048),
+    "griewank": (griewank, 600.0),
+    "schwefel": (schwefel, 500.0),
+}
+
+
+def get_objective(name: str):
+    """Return (fn, domain_half_width) for a registered objective."""
+    try:
+        return OBJECTIVES[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown objective {name!r}; available: {sorted(OBJECTIVES)}"
+        ) from None

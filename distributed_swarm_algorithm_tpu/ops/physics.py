@@ -1,0 +1,170 @@
+"""Vectorized artificial-potential-field motion planning.
+
+Re-expresses the reference's per-agent physics (components #6-#8,
+/root/reference/agent.py:94-181) as one pure array kernel over the whole
+swarm.  Exact force semantics are preserved:
+
+  - formation retarget for followers from the leader pose (agent.py:96-111),
+  - attraction  F = k_att * (target - pos), zero inside the 0.5 m arrival
+    tolerance (agent.py:116-125),
+  - obstacle repulsion mag = k_rep * (1/d - 1/rho0) / d^2 along the unit
+    vector away from the obstacle, active inside rho0, with d measured to
+    the obstacle *surface* (dist - radius) (agent.py:127-146),
+  - neighbor separation mag = k_sep / d^2 inside the 2.0 m personal space
+    (agent.py:148-160),
+  - force == velocity command ("holonomic-ish", agent.py:166), clamped to
+    max_speed, explicit-Euler position update (agent.py:165-178),
+  - agents with no target do not move at all (agent.py:113-114).
+
+Deliberate fixes over the reference (SURVEY.md §5a):
+  - every norm is epsilon-clamped, so co-located agents (the reference's
+    default spawn!) no longer divide by zero (bug 1),
+  - formation rank defaults to the ordinal among alive agents instead of the
+    raw id, so id gaps don't leave holes in the V and agent 0 doesn't sit on
+    the leader (bug 7); ``formation_rank_mode='id'`` restores reference
+    behavior.
+
+Neighbor semantics: the reference receives an externally-chosen neighbor
+list via update_sensors (agent.py:59-65).  The vectorized model defaults to
+all-pairs separation (``separation_mode='dense'``, exact for the personal-
+space radius since every agent beyond 2 m contributes zero force) and
+offers a spatial-hash grid mode for large N (see ops/neighbors.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..state import FOLLOWER, LEADER, SwarmState
+from ..utils.config import SwarmConfig
+from . import neighbors as _neighbors
+
+
+def formation_targets(state: SwarmState, cfg: SwarmConfig) -> SwarmState:
+    """Followers derive their nav target from their view of the leader pose.
+
+    V-shape (agent.py:105-111): x_off = -spacing*rank; y_off = ±spacing*rank
+    with even ranks going one side, odd the other.  "line" keeps y_off = 0
+    (the commented-out variant at agent.py:101-103).
+    """
+    if cfg.formation_rank_mode == "id":
+        rank = state.agent_id.astype(jnp.float32)
+    else:
+        # Ordinal among alive agents by id, skipping each agent's own view
+        # of the leader: rank 1 = lowest-id alive non-leader agent.  O(N):
+        # agent_id is the arange index (make_swarm), so "# alive below me"
+        # is an exclusive cumsum, minus one if my leader sits below me.
+        alive_i = state.alive.astype(jnp.int32)
+        alive_below = jnp.cumsum(alive_i) - alive_i
+        lid = state.leader_id
+        lid_valid = (lid >= 0) & (lid < state.n_agents)
+        leader_alive = state.alive[jnp.clip(lid, 0, state.n_agents - 1)]
+        leader_below = (
+            lid_valid & leader_alive & (lid < state.agent_id)
+        ).astype(jnp.int32)
+        rank = (alive_below - leader_below + 1).astype(jnp.float32)
+
+    spacing = jnp.asarray(cfg.formation_spacing, state.pos.dtype)
+    x_off = -spacing * rank
+    if cfg.formation_shape == "line":
+        y_off = jnp.zeros_like(x_off)
+    else:
+        side = jnp.where((rank.astype(jnp.int32) % 2) == 0, 1.0, -1.0)
+        y_off = spacing * rank * side
+
+    offset = jnp.zeros_like(state.pos)
+    offset = offset.at[:, 0].set(x_off)
+    if state.dim >= 2:
+        offset = offset.at[:, 1].set(y_off)
+
+    is_follower = (state.fsm == FOLLOWER) & state.has_leader_pos & state.alive
+    new_target = state.leader_pos + offset
+    target = jnp.where(is_follower[:, None], new_target, state.target)
+    has_target = state.has_target | is_follower
+    return state.replace(target=target, has_target=has_target)
+
+
+def apf_forces(
+    state: SwarmState,
+    obstacles: Optional[jax.Array],
+    cfg: SwarmConfig,
+) -> jax.Array:
+    """Total APF force per agent, [N, D]."""
+    pos = state.pos
+    eps = jnp.asarray(cfg.dist_eps, pos.dtype)
+
+    # 1. Attraction to target (agent.py:116-125): full displacement vector,
+    #    gated outside the arrival tolerance.
+    delta = state.target - pos
+    dist = jnp.linalg.norm(delta, axis=-1)
+    pulling = state.has_target & (dist > cfg.arrival_tolerance)
+    f_att = jnp.where(pulling[:, None], cfg.k_att * delta, 0.0)
+
+    # 2. Obstacle repulsion (agent.py:127-146).  obstacles: [O, D+1] rows of
+    #    (center..., radius), matching update_sensors' (x, y, r) tuples.
+    if obstacles is not None and obstacles.shape[0] > 0:
+        centers = obstacles[:, : state.dim]          # [O, D]
+        radii = obstacles[:, state.dim]              # [O]
+        away = pos[:, None, :] - centers[None, :, :]  # [N, O, D]
+        center_dist = jnp.linalg.norm(away, axis=-1)  # [N, O]
+        surf = jnp.maximum(center_dist - radii[None, :], eps)
+        mag = cfg.k_rep * (1.0 / surf - 1.0 / cfg.rho0) / (surf * surf)
+        mag = jnp.where(surf < cfg.rho0, mag, 0.0)
+        unit = away / jnp.maximum(center_dist, eps)[..., None]
+        f_rep = jnp.sum(mag[..., None] * unit, axis=1)
+    else:
+        f_rep = jnp.zeros_like(pos)
+
+    # 3. Neighbor separation (agent.py:148-160): every *other alive agent*
+    #    inside the personal-space radius repels with k_sep / d^2.
+    if cfg.separation_mode == "dense":
+        f_sep = _neighbors.separation_dense(
+            pos, state.alive, cfg.k_sep, cfg.personal_space, eps
+        )
+    elif cfg.separation_mode == "grid":
+        f_sep = _neighbors.separation_grid(
+            pos, state.alive, cfg.k_sep, cfg.personal_space, eps,
+            cell=cfg.grid_cell, max_per_cell=cfg.grid_max_per_cell,
+        )
+    else:
+        f_sep = jnp.zeros_like(pos)
+
+    return f_att + f_rep + f_sep
+
+
+def integrate(
+    pos: jax.Array,
+    force: jax.Array,
+    moving: jax.Array,
+    cfg: SwarmConfig,
+    dt: float,
+) -> Tuple[jax.Array, jax.Array]:
+    """Force -> clamped velocity command -> Euler step (agent.py:165-178)."""
+    speed = jnp.linalg.norm(force, axis=-1, keepdims=True)
+    scale = jnp.where(
+        speed > cfg.max_speed, cfg.max_speed / jnp.maximum(speed, cfg.dist_eps), 1.0
+    )
+    vel = force * scale
+    vel = jnp.where(moving[:, None], vel, 0.0)
+    return pos + vel * dt, vel
+
+
+def physics_step(
+    state: SwarmState,
+    obstacles: Optional[jax.Array],
+    cfg: SwarmConfig,
+    dt: Optional[float] = None,
+) -> SwarmState:
+    """One full motion tick: formation retarget -> forces -> integrate."""
+    dt = cfg.dt if dt is None else dt
+    state = formation_targets(state, cfg)
+    force = apf_forces(state, obstacles, cfg)
+    # Reference semantics: no target => early return, nothing moves
+    # (agent.py:113-114).  Dead agents are frozen too (masked update).
+    moving = state.has_target & state.alive
+    pos, vel = integrate(state.pos, force, moving, cfg, dt)
+    pos = jnp.where(moving[:, None], pos, state.pos)
+    return state.replace(pos=pos, vel=vel)

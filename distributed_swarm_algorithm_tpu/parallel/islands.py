@@ -1,0 +1,170 @@
+"""Island-model multi-swarm PSO with ring migration.
+
+BASELINE.json config 5: "64 islands × 16k particles, periodic migration
+all-to-all over ICI".  Each island is an independent PSO swarm (its own
+gbest, its own RNG stream); every ``migrate_every`` iterations each island
+ships its ``k`` best particles to the next island on a ring, replacing that
+island's ``k`` worst.
+
+TPU mapping: all island state is stacked on a leading island axis
+``[I, n, ...]`` and sharded over the mesh's island axis.  The per-island
+update is ``jax.vmap`` of the single-swarm kernel (ops/pso.py), and the
+migration is ``jnp.roll`` along the island axis — under GSPMD, XLA lowers
+that roll to an ICI collective-permute between devices, which *is* the
+migration network.  No hand-written transport, per the design stance in
+SURVEY.md §2a.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..ops import pso as _pso
+from .mesh import ISLAND_AXIS  # noqa: F401  (canonical axis name)
+
+
+@struct.dataclass
+class IslandPSOState:
+    """Stacked per-island PSO state: I islands × n particles × D dims."""
+
+    pso: _pso.PSOState     # every leaf carries a leading island axis [I, ...]
+    iteration: jax.Array   # i32 scalar (shared; islands step in lockstep)
+
+    @property
+    def n_islands(self) -> int:
+        return self.pso.pos.shape[0]
+
+
+def island_init(
+    objective: Callable,
+    n_islands: int,
+    n_per_island: int,
+    dim: int,
+    half_width: float,
+    seed: int = 0,
+    dtype=jnp.float32,
+) -> IslandPSOState:
+    seeds = jnp.arange(n_islands) + seed * 1_000_003
+
+    # vmap over per-island seeds so each island draws an independent stream.
+    def init_with_seed(island_seed):
+        key = jax.random.PRNGKey(0)
+        key = jax.random.fold_in(key, island_seed)
+        kp, kv, kc = jax.random.split(key, 3)
+        pos = jax.random.uniform(
+            kp, (n_per_island, dim), dtype, minval=-half_width,
+            maxval=half_width,
+        )
+        vel = (
+            jax.random.uniform(
+                kv, (n_per_island, dim), dtype, minval=-half_width,
+                maxval=half_width,
+            )
+            * 0.1
+        )
+        fit = objective(pos)
+        best = jnp.argmin(fit)
+        return _pso.PSOState(
+            pos=pos, vel=vel, pbest_pos=pos, pbest_fit=fit,
+            gbest_pos=pos[best], gbest_fit=fit[best], key=kc,
+            iteration=jnp.asarray(0, jnp.int32),
+        )
+
+    pso = jax.vmap(init_with_seed)(seeds)
+    return IslandPSOState(pso=pso, iteration=jnp.asarray(0, jnp.int32))
+
+
+def migrate(state: IslandPSOState, k: int) -> IslandPSOState:
+    """Ring migration: island i's k best pbest particles replace island
+    (i+1)'s k worst.  ``jnp.roll`` on the island axis = ICI collective."""
+    pso = state.pso
+    fit = pso.pbest_fit                                   # [I, n]
+
+    _, best_idx = jax.lax.top_k(-fit, k)                  # k smallest
+    em_pos = jnp.take_along_axis(pso.pbest_pos, best_idx[..., None], axis=1)
+    em_fit = jnp.take_along_axis(fit, best_idx, axis=1)
+
+    in_pos = jnp.roll(em_pos, 1, axis=0)                  # ring: i -> i+1
+    in_fit = jnp.roll(em_fit, 1, axis=0)
+
+    _, worst_idx = jax.lax.top_k(fit, k)                  # k largest
+
+    def scatter_rows(arr, idx, val):
+        return jax.vmap(lambda a, i, v: a.at[i].set(v))(arr, idx, val)
+
+    pos = scatter_rows(pso.pos, worst_idx, in_pos)
+    pbest_pos = scatter_rows(pso.pbest_pos, worst_idx, in_pos)
+    pbest_fit = scatter_rows(pso.pbest_fit, worst_idx, in_fit)
+    vel = scatter_rows(
+        pso.vel, worst_idx, jnp.zeros_like(in_pos)
+    )
+
+    # Refresh island gbests with the immigrants.
+    best = jnp.argmin(pbest_fit, axis=1)                  # [I]
+    cand_fit = jnp.take_along_axis(pbest_fit, best[:, None], axis=1)[:, 0]
+    cand_pos = jnp.take_along_axis(
+        pbest_pos, best[:, None, None], axis=1
+    )[:, 0]
+    better = cand_fit < pso.gbest_fit
+    gbest_fit = jnp.where(better, cand_fit, pso.gbest_fit)
+    gbest_pos = jnp.where(better[:, None], cand_pos, pso.gbest_pos)
+
+    return state.replace(
+        pso=pso.replace(
+            pos=pos, vel=vel, pbest_pos=pbest_pos, pbest_fit=pbest_fit,
+            gbest_fit=gbest_fit, gbest_pos=gbest_pos,
+        )
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective", "n_steps", "migrate_every", "migrate_k", "w", "c1",
+        "c2", "half_width", "vmax_frac",
+    ),
+)
+def island_run(
+    state: IslandPSOState,
+    objective: Callable,
+    n_steps: int,
+    migrate_every: int = 25,
+    migrate_k: int = 4,
+    w: float = _pso.W,
+    c1: float = _pso.C1,
+    c2: float = _pso.C2,
+    half_width: float = 5.12,
+    vmax_frac: float = 0.5,
+) -> IslandPSOState:
+    """Run all islands in lockstep under one scan, migrating periodically."""
+
+    step_one = partial(
+        _pso.pso_step, objective=objective, w=w, c1=c1, c2=c2,
+        half_width=half_width, vmax_frac=vmax_frac,
+    )
+    vstep = jax.vmap(lambda s: step_one(s))
+
+    def body(st: IslandPSOState, _):
+        st = st.replace(pso=vstep(st.pso), iteration=st.iteration + 1)
+        st = jax.lax.cond(
+            st.iteration % migrate_every == 0,
+            lambda s: migrate(s, migrate_k),
+            lambda s: s,
+            st,
+        )
+        return st, None
+
+    state, _ = jax.lax.scan(body, state, None, length=n_steps)
+    return state
+
+
+def global_best(state: IslandPSOState):
+    """(fit, pos) of the best particle across all islands — one reduction
+    (lax.pmin over ICI when the island axis is sharded)."""
+    i = jnp.argmin(state.pso.gbest_fit)
+    return state.pso.gbest_fit[i], state.pso.gbest_pos[i]

@@ -1,0 +1,46 @@
+"""Device-mesh construction helpers.
+
+The reference's only notion of scale-out is "run more OS processes"
+(/root/reference/agent.py:349-360) over a transport that was never written
+(agent.py:188-195).  Here the communication backend is XLA collectives over
+a ``jax.sharding.Mesh``: the agent/particle axis shards across devices
+(data parallel over ICI), an optional island axis gives the multi-swarm
+island model, and election/allocation/gbest reductions ride ICI as
+``pmax``/``pmin``/``psum``/``ppermute``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AGENT_AXIS = "agents"
+ISLAND_AXIS = "islands"
+
+
+def make_mesh(
+    axis_names: Sequence[str] = (AGENT_AXIS,),
+    shape: Optional[Tuple[int, ...]] = None,
+    devices=None,
+) -> Mesh:
+    """Build a mesh over available devices.
+
+    Default: every device on one axis.  ``shape`` splits devices over
+    multiple axes, e.g. ``make_mesh(("islands", "agents"), (2, 4))``.
+    """
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (devices.size,) + (1,) * (len(axis_names) - 1)
+    return Mesh(devices.reshape(shape), axis_names)
+
+
+def agent_sharding(mesh: Mesh, axis: str = AGENT_AXIS) -> NamedSharding:
+    """Shard dim 0 (the agent/particle axis) over ``axis``."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,177 @@
+"""Swarm state as a struct-of-arrays pytree.
+
+The reference scatters all mutable state across instance attributes of one
+``SwarmAgent`` object per OS process (/root/reference/agent.py:25-54).  The
+TPU-native model holds the *entire swarm* in one immutable pytree of arrays,
+so the per-tick update is a pure function ``SwarmState -> SwarmState`` that
+jits into a handful of fused XLA kernels and shards over a device mesh along
+the agent axis.
+
+Mapping from reference attributes to fields here:
+  - state / leader_id / leader_pos      (agent.py:31-33)  -> fsm, leader_id,
+    leader_pos, has_leader_pos — kept PER AGENT ([N]-shaped) so the
+    decentralized protocol semantics (divergent views during elections)
+    are preserved, not collapsed into one global scalar.
+  - last_heartbeat_time (agent.py:34)   -> last_hb_tick [N] (tick-based; the
+    synchronous model has no wall clock inside jit).
+  - tick (agent.py:35)                  -> tick (scalar, shared: synchronous).
+  - election_wait_start/delay (38-39)   -> wait_until [N] (absolute tick).
+  - tasks / task_claims dicts (41-44)   -> task_pos/task_cap/task_winner/
+    task_util arrays + task_claimed [N,T] bitmap.  String statuses
+    'OPEN'|'TENTATIVE'|'ASSIGNED'|'LOCKED' become derived views
+    (see ops/allocation.py:task_status_view).
+  - position/velocity/target (47-51)    -> pos, vel, target, has_target.
+  - capabilities: list[str] (52)        -> caps [N,C] one-hot bool (string
+    sets don't vectorize; SURVEY.md §7 "scale limits to remove").
+  - sensors (50)                        -> obstacles are an *input* to the
+    step (like update_sensors, agent.py:59-65); neighbors are implicit
+    (every alive agent, or a spatial-hash subset at large N).
+
+Agent ids are int32, removing the reference's u8 wire-format ceiling of 255
+agents (agent.py:186; SURVEY.md §5a bug 2).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+# FSM states — same values as the reference enum (agent.py:19-22).
+FOLLOWER = 1
+ELECTION_WAIT = 2
+LEADER = 3
+
+# Task status codes for derived views (reference string statuses, agent.py:41).
+TASK_OPEN = 0
+TASK_TENTATIVE = 1
+TASK_ASSIGNED = 2
+TASK_LOCKED = 3
+
+# Sentinel for "no leader known" (reference uses None, agent.py:32).
+NO_LEADER = -1
+# Sentinel for "no capability required" on a task (agent.py:344).
+NO_CAP = -1
+# Sentinel for "task unclaimed" (reference: absent key in task_claims dict).
+NO_WINNER = -1
+
+
+@struct.dataclass
+class SwarmState:
+    """Struct-of-arrays swarm state. N agents, D spatial dims, T tasks, C caps."""
+
+    # --- global ---
+    tick: jax.Array            # i32 scalar
+    key: jax.Array             # PRNG key (election jitter, agent.py:229)
+
+    # --- agents ---
+    agent_id: jax.Array        # [N] i32
+    alive: jax.Array           # [N] bool — failure injection = clearing bits
+    pos: jax.Array             # [N,D] f32
+    vel: jax.Array             # [N,D] f32
+    caps: jax.Array            # [N,C] bool one-hot capabilities
+    target: jax.Array          # [N,D] f32 nav target (agent.py:56-57)
+    has_target: jax.Array      # [N] bool (reference: target is None, agent.py:51)
+
+    # --- per-agent coordination view (decentralized semantics) ---
+    fsm: jax.Array             # [N] i32 FOLLOWER/ELECTION_WAIT/LEADER
+    leader_id: jax.Array       # [N] i32, NO_LEADER when unknown
+    leader_pos: jax.Array      # [N,D] f32 last heard leader position
+    has_leader_pos: jax.Array  # [N] bool
+    last_hb_tick: jax.Array    # [N] i32 tick of last heard heartbeat
+    wait_until: jax.Array      # [N] i32 acclaim-after tick (ELECTION_WAIT)
+
+    # --- tasks (global table = the leader's arbitration ledger) ---
+    task_pos: jax.Array        # [T,D] f32
+    task_cap: jax.Array        # [T] i32 required capability, NO_CAP if none
+    task_winner: jax.Array     # [T] i32 awarded agent id, NO_WINNER if open
+    task_util: jax.Array       # [T] f32 winning utility (hysteresis incumbent)
+    task_claimed: jax.Array    # [N,T] bool — per-agent "I have claimed /
+    #                            have seen this task resolved" view; drives
+    #                            TENTATIVE/LOCKED statuses and claim gating.
+
+    @property
+    def n_agents(self) -> int:
+        return self.agent_id.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.pos.shape[-1]
+
+    @property
+    def n_tasks(self) -> int:
+        return self.task_pos.shape[0]
+
+
+def make_swarm(
+    n_agents: int,
+    dim: int = 2,
+    n_tasks: int = 0,
+    n_caps: int = 1,
+    seed: int = 0,
+    pos: Optional[jax.Array] = None,
+    spread: float = 0.0,
+    dtype=jnp.float32,
+) -> SwarmState:
+    """Build an initial SwarmState.
+
+    The reference spawns every agent at the origin (agent.py:47), which its
+    physics cannot survive (ZeroDivisionError, SURVEY.md §5a bug 1).  We
+    default to the same origin spawn — safe here because every norm is
+    epsilon-clamped — but ``spread`` scatters agents uniformly in
+    [-spread, spread]^D, and ``pos`` overrides entirely.
+    """
+    key = jax.random.PRNGKey(seed)
+    if pos is None:
+        if spread > 0.0:
+            key, sub = jax.random.split(key)
+            pos = jax.random.uniform(
+                sub, (n_agents, dim), dtype, minval=-spread, maxval=spread
+            )
+        else:
+            pos = jnp.zeros((n_agents, dim), dtype)
+    else:
+        pos = jnp.asarray(pos, dtype)
+
+    return SwarmState(
+        tick=jnp.asarray(0, jnp.int32),
+        key=key,
+        agent_id=jnp.arange(n_agents, dtype=jnp.int32),
+        alive=jnp.ones((n_agents,), bool),
+        pos=pos,
+        vel=jnp.zeros((n_agents, dim), dtype),
+        caps=jnp.zeros((n_agents, max(n_caps, 1)), bool),
+        target=jnp.zeros((n_agents, dim), dtype),
+        has_target=jnp.zeros((n_agents,), bool),
+        fsm=jnp.full((n_agents,), FOLLOWER, jnp.int32),
+        leader_id=jnp.full((n_agents,), NO_LEADER, jnp.int32),
+        leader_pos=jnp.zeros((n_agents, dim), dtype),
+        has_leader_pos=jnp.zeros((n_agents,), bool),
+        last_hb_tick=jnp.zeros((n_agents,), jnp.int32),
+        wait_until=jnp.zeros((n_agents,), jnp.int32),
+        task_pos=jnp.zeros((n_tasks, dim), dtype),
+        task_cap=jnp.full((n_tasks,), NO_CAP, jnp.int32),
+        task_winner=jnp.full((n_tasks,), NO_WINNER, jnp.int32),
+        task_util=jnp.zeros((n_tasks,), dtype),
+        task_claimed=jnp.zeros((n_agents, n_tasks), bool),
+    )
+
+
+def with_tasks(state: SwarmState, task_pos, task_cap=None) -> SwarmState:
+    """Install a task table (the reference's de-facto input API is writing
+    the ``tasks`` dict directly, agent.py:41-42 / test_allocation.py)."""
+    task_pos = jnp.asarray(task_pos, state.task_pos.dtype)
+    t = task_pos.shape[0]
+    if task_cap is None:
+        task_cap = jnp.full((t,), NO_CAP, jnp.int32)
+    else:
+        task_cap = jnp.asarray(task_cap, jnp.int32)
+    return state.replace(
+        task_pos=task_pos,
+        task_cap=task_cap,
+        task_winner=jnp.full((t,), NO_WINNER, jnp.int32),
+        task_util=jnp.zeros((t,), state.task_util.dtype),
+        task_claimed=jnp.zeros((state.n_agents, t), bool),
+    )

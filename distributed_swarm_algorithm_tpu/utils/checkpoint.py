@@ -1,0 +1,54 @@
+"""Checkpoint / resume for swarm state pytrees.
+
+The reference has no persistence of any kind (SURVEY.md §5 "Checkpoint /
+resume: absent").  Because all framework state is a pytree of arrays
+(SwarmState, PSOState, IslandPSOState), checkpointing is generic: orbax
+when available (async-friendly, sharding-aware), with a numpy ``.npz``
+fallback that has zero extra dependencies.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, TypeVar
+
+import jax
+import numpy as np
+
+T = TypeVar("T")
+
+try:  # pragma: no cover - exercised indirectly
+    import orbax.checkpoint as ocp
+
+    _HAVE_ORBAX = True
+except Exception:  # pragma: no cover
+    _HAVE_ORBAX = False
+
+
+def save(path: str, state: Any) -> None:
+    """Save a state pytree to ``path`` (directory for orbax, .npz file
+    otherwise)."""
+    if _HAVE_ORBAX and not path.endswith(".npz"):
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(os.path.abspath(path), state, force=True)
+        return
+    leaves, _ = jax.tree_util.tree_flatten(state)
+    np.savez(
+        path,
+        **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)},
+    )
+
+
+def restore(path: str, target: T) -> T:
+    """Restore a pytree saved by :func:`save`.  ``target`` supplies the
+    structure (and shardings, for orbax) to restore into."""
+    if _HAVE_ORBAX and not path.endswith(".npz"):
+        ckptr = ocp.PyTreeCheckpointer()
+        restored = ckptr.restore(os.path.abspath(path), item=target)
+        return restored
+    data = np.load(path if path.endswith(".npz") else path + ".npz")
+    leaves, treedef = jax.tree_util.tree_flatten(target)
+    new_leaves = [
+        jax.numpy.asarray(data[f"leaf_{i}"]) for i in range(len(leaves))
+    ]
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)

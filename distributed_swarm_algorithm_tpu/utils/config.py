@@ -1,0 +1,94 @@
+"""Configuration for the TPU-native swarm framework.
+
+The reference hard-codes every tunable as a literal inside ``agent.py``
+(see SURVEY.md §5 "Config / flag system").  This module lifts each of them
+into a single frozen dataclass so the whole framework is configured in one
+place and the config can be passed as a *static* argument to ``jax.jit``
+(it is hashable because it is frozen and contains only leaf values).
+
+Reference provenance for each default (file:line in /root/reference):
+  - loop rate 10 Hz                       agent.py:68
+  - heartbeat every 10th tick (1 Hz)      agent.py:288
+  - election timeout 3.0 s (= 30 ticks)   agent.py:222
+  - election jitter U(0, 0.2) s           agent.py:229
+  - max_speed 5.0 m/s                     agent.py:49
+  - k_att 1.0, arrival tolerance 0.5 m    agent.py:118,123
+  - k_rep 50.0, rho_0 5.0 m               agent.py:128-129
+  - distance clamp 0.001                  agent.py:135,154
+  - k_sep 20.0, personal space 2.0 m      agent.py:149,153
+  - formation spacing 2.0 m (V-shape)     agent.py:106-107
+  - utility threshold 20.0                agent.py:297
+  - utility scale 100.0                   agent.py:347
+  - claim hysteresis +5.0                 agent.py:316
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class SwarmConfig:
+    """All swarm tunables.  Frozen → hashable → usable as a jit-static arg.
+
+    Timing is expressed in *ticks*, not wall-clock seconds: the reference's
+    event loop runs at 10 Hz wall-clock (agent.py:67-81), so 1 tick = 0.1 s.
+    The synchronous TPU model steps ticks as fast as the chip allows; an
+    optional realtime mode re-introduces the wall-clock pacing.
+    """
+
+    # --- timing -----------------------------------------------------------
+    tick_rate_hz: float = 10.0          # reference loop rate (agent.py:68)
+    dt: float = 0.1                     # integration step = 1/tick_rate
+    heartbeat_period_ticks: int = 10    # 1 Hz heartbeat (agent.py:288)
+    election_timeout_ticks: int = 30    # 3.0 s at 10 Hz (agent.py:222)
+    election_jitter_ticks: int = 2      # U(0, 0.2) s at 10 Hz (agent.py:229)
+
+    # --- physics / motion (APF) ------------------------------------------
+    max_speed: float = 5.0              # velocity clamp (agent.py:49)
+    k_att: float = 1.0                  # target attraction gain (agent.py:118)
+    arrival_tolerance: float = 0.5      # no attraction inside (agent.py:123)
+    k_rep: float = 50.0                 # obstacle repulsion gain (agent.py:128)
+    rho0: float = 5.0                   # obstacle influence radius (agent.py:129)
+    k_sep: float = 20.0                 # neighbor separation gain (agent.py:149)
+    personal_space: float = 2.0         # separation radius (agent.py:153)
+    dist_eps: float = 1e-3              # distance clamp (agent.py:135,154);
+    #   unlike the reference, the clamp is applied to *every* norm, fixing the
+    #   ZeroDivisionError for co-located agents (SURVEY.md §5a bug 1).
+
+    # --- formation --------------------------------------------------------
+    formation_spacing: float = 2.0      # V spacing (agent.py:106-107)
+    formation_shape: str = "vee"        # "vee" (agent.py:105-107) | "line"
+    #   (line-formation variant left commented in the reference, agent.py:101-103)
+    formation_rank_mode: str = "ordinal"
+    #   "ordinal": rank = position among alive non-leader agents (fixes the
+    #     gaps-in-the-V quirk, SURVEY.md §5a bug 7).
+    #   "id": rank = raw agent id, byte-faithful to agent.py:99.
+
+    # --- task allocation --------------------------------------------------
+    utility_threshold: float = 20.0     # claim threshold (agent.py:297)
+    utility_scale: float = 100.0        # U = scale/(1+d)·cap (agent.py:347)
+    claim_hysteresis: float = 5.0       # challenger margin (agent.py:316)
+    allocation_lock_on_award: bool = True
+    #   True (reference semantics, agent.py:330-336): the award broadcast
+    #   LOCKs the task for everyone, so assignments are final and the
+    #   hysteresis only arbitrates same-tick claim races.  False: losers may
+    #   keep challenging as the swarm moves, and an incumbent is replaced
+    #   only when beaten by claim_hysteresis — live reallocation.
+
+    # --- scale / numerics -------------------------------------------------
+    separation_mode: str = "dense"      # "dense" O(N²) | "grid" | "off"
+    grid_cell: float = 2.0              # spatial-hash cell for "grid" mode
+    grid_max_per_cell: int = 8          # bucket capacity for "grid" mode
+    dtype: str = "float32"
+
+    def replace(self, **kw) -> "SwarmConfig":
+        return dataclasses.replace(self, **kw)
+
+    @property
+    def timeout_seconds(self) -> float:
+        return self.election_timeout_ticks / self.tick_rate_hz
+
+
+DEFAULT_CONFIG = SwarmConfig()

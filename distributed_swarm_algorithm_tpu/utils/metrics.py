@@ -1,0 +1,68 @@
+"""Throughput metrics.
+
+The reference's loop measures its own elapsed time but only to compute
+sleep, never to report (SURVEY.md §5 "Tracing / profiling: absent").
+Here steps/sec is a first-class counter.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class StepTimer:
+    """Rolling throughput counter.
+
+    >>> t = StepTimer()
+    >>> with t.measure(steps=100, agents=1024): ...   # doctest: +SKIP
+    >>> t.agent_steps_per_sec                         # doctest: +SKIP
+    """
+
+    total_steps: int = 0
+    total_agent_steps: int = 0
+    total_seconds: float = 0.0
+    _t0: Optional[float] = field(default=None, repr=False)
+    _pending: tuple = field(default=(0, 0), repr=False)
+
+    def start(self, steps: int, agents: int = 1) -> None:
+        self._pending = (steps, agents)
+        self._t0 = time.perf_counter()
+
+    def stop(self) -> float:
+        assert self._t0 is not None, "start() not called"
+        elapsed = time.perf_counter() - self._t0
+        steps, agents = self._pending
+        self.total_steps += steps
+        self.total_agent_steps += steps * agents
+        self.total_seconds += elapsed
+        self._t0 = None
+        return elapsed
+
+    def measure(self, steps: int, agents: int = 1):
+        timer = self
+
+        class _Ctx:
+            def __enter__(self):
+                timer.start(steps, agents)
+                return timer
+
+            def __exit__(self, *exc):
+                timer.stop()
+                return False
+
+        return _Ctx()
+
+    @property
+    def steps_per_sec(self) -> float:
+        return self.total_steps / self.total_seconds if self.total_seconds else 0.0
+
+    @property
+    def agent_steps_per_sec(self) -> float:
+        return (
+            self.total_agent_steps / self.total_seconds
+            if self.total_seconds
+            else 0.0
+        )

@@ -1,0 +1,31 @@
+"""Profiler hooks — jax.profiler made one-liner-friendly.
+
+Absent in the reference (SURVEY.md §5).  Usage:
+
+    with trace("/tmp/swarm-trace"):
+        swarm.step(1000)
+
+then load the trace directory in TensorBoard/XProf; or use
+``annotate("phase")`` inside host loops to label regions.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a device trace (TensorBoard-compatible) for the block."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region inside a host-side loop (shows up in trace viewers)."""
+    return jax.profiler.TraceAnnotation(name)

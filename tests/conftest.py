@@ -1,0 +1,29 @@
+"""Test harness config.
+
+Tests run on CPU with 8 virtual XLA devices so multi-chip sharding
+(parallel/) is exercised without TPU hardware — the env vars must be set
+before jax is imported anywhere.
+"""
+
+import os
+
+# Force CPU even when the session presets JAX_PLATFORMS (e.g. "axon" for
+# the real TPU tunnel) — tests must not occupy the chip and need 8 devices.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# A TPU-tunnel sitecustomize hook (e.g. "axon") may have imported jax
+# *before* this conftest, freezing jax_platforms from the old env var — in
+# which case the first backends() call inside the test run would dial the
+# remote chip and can block for minutes (or hold a chip lease).  Pin the
+# live config to CPU as well.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass

@@ -1,0 +1,213 @@
+"""Leader election + failure detection.
+
+Re-expresses the reference's election suite (/root/reference/
+test_election.py) against the vectorized protocol, then covers what the
+reference never tested (SURVEY.md §4 "Untested"): heartbeat ingress,
+multi-agent convergence, leader failure + elastic recovery.
+"""
+
+import jax.numpy as jnp
+import pytest
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu import (
+    ELECTION_WAIT,
+    FOLLOWER,
+    LEADER,
+    NO_LEADER,
+    coordination_step,
+    current_leader,
+    instant_election,
+    make_swarm,
+    swarm_tick,
+)
+
+CFG = dsa.SwarmConfig()
+
+
+def tick_n(state, n, cfg=CFG):
+    for _ in range(n):
+        state = swarm_tick(state, None, cfg)
+    return state
+
+
+def test_initial_state():
+    # Reference test_initial_state (test_election.py:18-20): agents start
+    # as followers with no leader.
+    s = make_swarm(4)
+    assert (s.fsm == FOLLOWER).all()
+    assert (s.leader_id == NO_LEADER).all()
+    _, exists = current_leader(s)
+    assert not bool(exists)
+
+
+def test_election_timeout_trigger():
+    # Reference test_election_timeout_trigger (test_election.py:22-30):
+    # heartbeat silence beyond the timeout moves a follower to
+    # ELECTION_WAIT.  Time-warp = back-dating last_hb_tick, the tick-space
+    # equivalent of `last_heartbeat_time = time.time() - 5.0`.
+    s = make_swarm(3)
+    s = s.replace(
+        tick=jnp.asarray(100, jnp.int32),
+        last_hb_tick=jnp.full((3,), 100 - 50, jnp.int32),
+    )
+    s = coordination_step(s.replace(tick=s.tick + 1), CFG)
+    assert (s.fsm == ELECTION_WAIT).all()
+    assert (s.leader_id == NO_LEADER).all()
+
+
+def test_election_victory_after_wait():
+    # Reference test_election_victory_after_wait (test_election.py:32-46):
+    # a waiter whose jitter expired acclaims leadership; the reference
+    # asserts on the broadcast ACCLAIM/COORDINATOR packets — here the
+    # "broadcast" is the same-tick resolution, so we assert every other
+    # agent adopted the winner.
+    # (The rivals must not be mid-election themselves: a waiting higher id
+    # would bully back, agent.py:269-275 — see
+    # test_waiting_higher_id_bullies_acclaimer.)
+    s = make_swarm(3)
+    s = s.replace(
+        tick=jnp.asarray(200, jnp.int32),
+        fsm=jnp.asarray([ELECTION_WAIT, FOLLOWER, FOLLOWER], jnp.int32),
+        wait_until=jnp.asarray([190, 0, 0], jnp.int32),
+        last_hb_tick=jnp.asarray([0, 200, 200], jnp.int32),
+    )
+    s = coordination_step(s.replace(tick=s.tick + 1), CFG)
+    assert int(s.fsm[0]) == LEADER
+    assert (s.leader_id == 0).all()
+    assert int(s.fsm[1]) == FOLLOWER and int(s.fsm[2]) == FOLLOWER
+
+
+def test_submission_to_higher_id():
+    # Reference test_submission_to_higher_id (test_election.py:48-57): an
+    # acclaim from a higher id makes a lower waiter back down and adopt.
+    s = make_swarm(3)
+    s = s.replace(
+        tick=jnp.asarray(200, jnp.int32),
+        fsm=jnp.asarray([FOLLOWER, ELECTION_WAIT, ELECTION_WAIT], jnp.int32),
+        wait_until=jnp.asarray([0, 190, 190], jnp.int32),
+        last_hb_tick=jnp.full((3,), 200, jnp.int32),
+    )
+    s = coordination_step(s.replace(tick=s.tick + 1), CFG)
+    assert int(s.fsm[2]) == LEADER
+    assert int(s.fsm[1]) == FOLLOWER
+    assert (s.leader_id == 2).all()
+    # Acclaim counts as liveness proof for the loser (agent.py:268).
+    assert int(s.last_hb_tick[1]) == 201
+
+
+def test_bullying_lower_id():
+    # Reference test_bullying_lower_id (test_election.py:59-71): a sitting
+    # higher-id leader bullies back a lower-id acclaimer.
+    s = make_swarm(3)
+    s = s.replace(
+        tick=jnp.asarray(200, jnp.int32),
+        fsm=jnp.asarray([ELECTION_WAIT, FOLLOWER, LEADER], jnp.int32),
+        wait_until=jnp.asarray([190, 0, 0], jnp.int32),
+        leader_id=jnp.asarray([NO_LEADER, 2, 2], jnp.int32),
+        last_hb_tick=jnp.full((3,), 200, jnp.int32),
+    )
+    s = coordination_step(s.replace(tick=s.tick + 1), CFG)
+    assert int(s.fsm[2]) == LEADER
+    assert int(s.fsm[0]) == FOLLOWER
+    assert (s.leader_id == 2).all()
+
+
+def test_waiting_higher_id_bullies_acclaimer():
+    # agent.py:269-275: a still-waiting higher id that hears a lower id's
+    # acclaim stops waiting and fights — and wins.
+    s = make_swarm(5)
+    s = s.replace(
+        tick=jnp.asarray(200, jnp.int32),
+        fsm=jnp.full((5,), ELECTION_WAIT, jnp.int32),
+        # Only agent 1's jitter has expired.
+        wait_until=jnp.asarray([205, 190, 205, 205, 205], jnp.int32),
+    )
+    s = coordination_step(s.replace(tick=s.tick + 1), CFG)
+    assert int(s.fsm[4]) == LEADER
+    assert (s.leader_id == 4).all()
+
+
+def test_cold_start_converges_to_highest_id():
+    # Untested in the reference: full multi-agent convergence from cold
+    # start.  After timeout + jitter the highest alive id must lead.
+    s = make_swarm(8, seed=3)
+    s = tick_n(s, CFG.election_timeout_ticks + CFG.election_jitter_ticks + 3)
+    lid, exists = current_leader(s)
+    assert bool(exists)
+    assert int(lid) == 7
+    assert (s.leader_id == 7).all()
+    assert int(s.fsm[7]) == LEADER
+
+
+def test_leader_failure_triggers_reelection():
+    # The heart of the reference (SURVEY.md §5): failure detection +
+    # elastic recovery.  Kill the leader; after the timeout the next-highest
+    # id takes over.
+    s = make_swarm(5, seed=1)
+    s = tick_n(s, CFG.election_timeout_ticks + CFG.election_jitter_ticks + 3)
+    assert current_leader(s)[0] == 4
+    s = dsa.kill(s, [4])
+    s = tick_n(s, CFG.election_timeout_ticks + CFG.election_jitter_ticks + 3)
+    lid, exists = current_leader(s)
+    assert bool(exists) and int(lid) == 3
+    alive_mask = s.alive
+    assert (s.leader_id[alive_mask] == 3).all()
+
+
+def test_revive_rejoins_as_follower():
+    s = make_swarm(4, seed=2)
+    s = tick_n(s, CFG.election_timeout_ticks + 5)
+    s = dsa.kill(s, [3])
+    s = tick_n(s, CFG.election_timeout_ticks + 5)
+    assert current_leader(s)[0] == 2
+    s = dsa.revive(s, [3])
+    assert int(s.fsm[3]) == FOLLOWER
+    # The revived higher id eventually bullies its way back on heartbeat
+    # silence… but with leader 2 heartbeating, 3 simply adopts 2 first.
+    s = tick_n(s, CFG.heartbeat_period_ticks + 1)
+    assert int(s.leader_id[3]) == 2
+
+
+def test_instant_election_matches_protocol_fixed_point():
+    s = make_swarm(16, seed=5)
+    s = dsa.kill(s, [15, 14])
+    inst = instant_election(s)
+    proto = tick_n(
+        s, CFG.election_timeout_ticks + CFG.election_jitter_ticks + 3
+    )
+    assert current_leader(inst)[0] == current_leader(proto)[0] == 13
+
+
+def test_heartbeat_refreshes_followers():
+    # Untested in the reference: _handle_heartbeat ingress.  With a live
+    # leader heartbeating at 1 Hz, no follower ever times out.
+    s = make_swarm(4, seed=0)
+    s = tick_n(s, CFG.election_timeout_ticks + CFG.election_jitter_ticks + 3)
+    before = s.last_hb_tick
+    s = tick_n(s, 4 * CFG.heartbeat_period_ticks)
+    assert current_leader(s)[0] == 3
+    assert (s.fsm != ELECTION_WAIT).all()
+    followers = s.agent_id != 3
+    assert (s.last_hb_tick[followers] > before[followers]).all()
+    # Followers know the leader pose from the heartbeat payload
+    # (agent.py:256-258).
+    assert bool(s.has_leader_pos[followers].all())
+
+
+def test_dead_agents_never_lead():
+    s = make_swarm(6, seed=7)
+    s = dsa.kill(s, [5])
+    s = tick_n(s, CFG.election_timeout_ticks + CFG.election_jitter_ticks + 3)
+    assert current_leader(s)[0] == 4
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_determinism(seed):
+    # Protocol races are deterministic by construction in the synchronous
+    # model (SURVEY.md §5 "race detection").
+    a = tick_n(make_swarm(8, seed=seed), 40)
+    b = tick_n(make_swarm(8, seed=seed), 40)
+    assert (a.fsm == b.fsm).all()
+    assert (a.leader_id == b.leader_id).all()
+    assert jnp.allclose(a.pos, b.pos)

@@ -1,0 +1,51 @@
+"""Objective library sanity: minima, batching, registry."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_swarm_algorithm_tpu.ops import objectives as obj
+
+
+@pytest.mark.parametrize("name", sorted(obj.OBJECTIVES))
+def test_batched_shape(name):
+    fn, hw = obj.get_objective(name)
+    x = jax.random.uniform(jax.random.PRNGKey(0), (7, 5), minval=-hw,
+                           maxval=hw)
+    y = fn(x)
+    assert y.shape == (7,)
+    assert bool(jnp.isfinite(y).all())
+
+
+@pytest.mark.parametrize(
+    "name,argmin",
+    [
+        ("sphere", 0.0),
+        ("rastrigin", 0.0),
+        ("ackley", 0.0),
+        ("griewank", 0.0),
+        ("rosenbrock", 1.0),
+    ],
+)
+def test_global_minimum(name, argmin):
+    fn, _ = obj.get_objective(name)
+    x = jnp.full((1, 10), argmin)
+    assert abs(float(fn(x)[0])) < 1e-3
+
+
+def test_schwefel_minimum():
+    fn, _ = obj.get_objective("schwefel")
+    x = jnp.full((1, 4), 420.9687)
+    assert abs(float(fn(x)[0])) < 1e-2
+
+
+def test_unknown_objective_raises():
+    with pytest.raises(KeyError):
+        obj.get_objective("nope")
+
+
+def test_jit_and_grad():
+    fn, _ = obj.get_objective("rastrigin")
+    g = jax.grad(lambda x: fn(x[None, :])[0])(jnp.ones((6,)))
+    assert g.shape == (6,)
+    assert bool(jnp.isfinite(g).all())

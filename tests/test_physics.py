@@ -1,0 +1,218 @@
+"""APF physics: forces, clamps, formation, integration.
+
+The reference never tested its physics at all (SURVEY.md §4 "Untested");
+these tests pin the exact force semantics of agent.py:94-181 plus the
+deliberate bug fixes (epsilon clamps, ordinal formation ranks).
+"""
+
+import jax.numpy as jnp
+import pytest
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu import (
+    FOLLOWER,
+    LEADER,
+    apf_forces,
+    formation_targets,
+    make_swarm,
+    physics_step,
+)
+from distributed_swarm_algorithm_tpu.ops.neighbors import (
+    separation_dense,
+    separation_grid,
+)
+
+CFG = dsa.SwarmConfig()
+
+
+def lone_agent(pos, target=None):
+    s = make_swarm(1)
+    s = s.replace(pos=jnp.asarray([pos], jnp.float32))
+    if target is not None:
+        s = s.replace(
+            target=jnp.asarray([target], jnp.float32),
+            has_target=jnp.ones((1,), bool),
+        )
+    return s
+
+
+def test_attraction_toward_target():
+    # F_att = k_att * (target - pos) outside tolerance (agent.py:116-125).
+    s = lone_agent([0.0, 0.0], target=[3.0, 4.0])
+    f = apf_forces(s, None, CFG)
+    assert jnp.allclose(f[0], jnp.asarray([3.0, 4.0]), atol=1e-6)
+
+
+def test_attraction_zero_inside_tolerance():
+    s = lone_agent([0.0, 0.0], target=[0.3, 0.0])  # dist 0.3 < 0.5
+    f = apf_forces(s, None, CFG)
+    assert jnp.allclose(f[0], 0.0)
+
+
+def test_obstacle_repulsion_pushes_away():
+    # Obstacle at (2,0) r=0.5; agent inside rho0 gets pushed in -x
+    # (agent.py:127-146).
+    s = lone_agent([0.0, 0.0], target=[0.1, 0.0])  # target inside tol
+    obs = jnp.asarray([[2.0, 0.0, 0.5]])
+    f = apf_forces(s, obs, CFG)
+    assert float(f[0, 0]) < 0.0
+    assert abs(float(f[0, 1])) < 1e-6
+    # Magnitude matches k_rep·(1/d − 1/rho0)/d² at surface dist 1.5.
+    d = 1.5
+    expected = CFG.k_rep * (1.0 / d - 1.0 / CFG.rho0) / d**2
+    assert abs(-float(f[0, 0]) - expected) < 1e-4
+
+
+def test_obstacle_outside_influence_radius_ignored():
+    s = lone_agent([0.0, 0.0], target=[0.1, 0.0])
+    obs = jnp.asarray([[10.0, 0.0, 1.0]])  # surface dist 9 > rho0 5
+    f = apf_forces(s, obs, CFG)
+    assert jnp.allclose(f[0], 0.0)
+
+
+def test_separation_inside_personal_space():
+    pos = jnp.asarray([[0.0, 0.0], [1.0, 0.0]])
+    alive = jnp.ones((2,), bool)
+    f = separation_dense(pos, alive, CFG.k_sep, CFG.personal_space,
+                         CFG.dist_eps)
+    # mag = k_sep/d² = 20 at d=1, opposite directions (agent.py:148-160).
+    assert jnp.allclose(f[0], jnp.asarray([-20.0, 0.0]), atol=1e-4)
+    assert jnp.allclose(f[1], jnp.asarray([20.0, 0.0]), atol=1e-4)
+
+
+def test_separation_outside_personal_space_zero():
+    pos = jnp.asarray([[0.0, 0.0], [3.0, 0.0]])
+    alive = jnp.ones((2,), bool)
+    f = separation_dense(pos, alive, CFG.k_sep, CFG.personal_space,
+                         CFG.dist_eps)
+    assert jnp.allclose(f, 0.0)
+
+
+def test_colocated_agents_finite():
+    # SURVEY.md §5a bug 1: the reference crashes (ZeroDivisionError) when
+    # agents share a position — its own default spawn.  Must be finite here.
+    s = make_swarm(4)  # all at origin
+    s = s.replace(
+        has_target=jnp.ones((4,), bool),
+        target=jnp.ones((4, 2)) * 5.0,
+    )
+    out = physics_step(s, None, CFG)
+    assert bool(jnp.isfinite(out.pos).all())
+    assert bool(jnp.isfinite(out.vel).all())
+
+
+def test_speed_clamp():
+    s = lone_agent([0.0, 0.0], target=[100.0, 0.0])
+    out = physics_step(s, None, CFG)
+    speed = float(jnp.linalg.norm(out.vel[0]))
+    assert speed <= CFG.max_speed + 1e-5
+
+
+def test_euler_integration():
+    # v = F (below clamp), x += v·dt (agent.py:165-178).
+    s = lone_agent([0.0, 0.0], target=[2.0, 0.0])
+    out = physics_step(s, None, CFG)
+    assert abs(float(out.vel[0, 0]) - 2.0) < 1e-5
+    assert abs(float(out.pos[0, 0]) - 0.2) < 1e-6
+
+
+def test_no_target_no_motion():
+    # agent.py:113-114: no target → early return, nothing moves.
+    s = lone_agent([1.0, 2.0])
+    out = physics_step(s, None, CFG)
+    assert jnp.allclose(out.pos, s.pos)
+    assert jnp.allclose(out.vel, 0.0)
+
+
+def test_dead_agents_frozen():
+    s = make_swarm(2)
+    s = s.replace(
+        pos=jnp.asarray([[0.0, 0.0], [5.0, 5.0]]),
+        target=jnp.asarray([[9.0, 9.0], [9.0, 9.0]]),
+        has_target=jnp.ones((2,), bool),
+    )
+    s = dsa.kill(s, [1])
+    out = physics_step(s, None, CFG)
+    assert jnp.allclose(out.pos[1], s.pos[1])
+    assert not jnp.allclose(out.pos[0], s.pos[0])
+
+
+def test_formation_vee_offsets():
+    # V-shape (agent.py:105-111): rank r sits at (-2r, ±2r) from the leader.
+    s = make_swarm(4)
+    s = s.replace(
+        fsm=jnp.asarray([FOLLOWER, FOLLOWER, FOLLOWER, LEADER], jnp.int32),
+        leader_id=jnp.full((4,), 3, jnp.int32),
+        leader_pos=jnp.broadcast_to(jnp.asarray([10.0, 10.0]), (4, 2)),
+        has_leader_pos=jnp.asarray([True, True, True, False]),
+    )
+    out = formation_targets(s, CFG)
+    # Ordinal ranks: agents 0,1,2 → ranks 1,2,3.
+    assert jnp.allclose(out.target[0], jnp.asarray([8.0, 8.0]))    # odd → -y
+    assert jnp.allclose(out.target[1], jnp.asarray([6.0, 14.0]))   # even → +y
+    assert jnp.allclose(out.target[2], jnp.asarray([4.0, 4.0]))
+    assert bool(out.has_target[:3].all())
+    # The leader's own target is untouched.
+    assert not bool(out.has_target[3])
+
+
+def test_formation_id_mode_matches_reference_quirk():
+    cfg = CFG.replace(formation_rank_mode="id")
+    s = make_swarm(3)
+    s = s.replace(
+        fsm=jnp.asarray([FOLLOWER, FOLLOWER, LEADER], jnp.int32),
+        leader_id=jnp.full((3,), 2, jnp.int32),
+        leader_pos=jnp.zeros((3, 2)),
+        has_leader_pos=jnp.asarray([True, True, False]),
+    )
+    out = formation_targets(s, cfg)
+    # agent.py:99,106-107 with rank = raw id: id 0 sits ON the leader.
+    assert jnp.allclose(out.target[0], jnp.asarray([0.0, 0.0]))
+    assert jnp.allclose(out.target[1], jnp.asarray([-2.0, -2.0]))
+
+
+def test_line_formation():
+    cfg = CFG.replace(formation_shape="line")
+    s = make_swarm(2)
+    s = s.replace(
+        fsm=jnp.asarray([FOLLOWER, LEADER], jnp.int32),
+        leader_id=jnp.full((2,), 1, jnp.int32),
+        leader_pos=jnp.zeros((2, 2)),
+        has_leader_pos=jnp.asarray([True, False]),
+    )
+    out = formation_targets(s, cfg)
+    assert jnp.allclose(out.target[0], jnp.asarray([-2.0, 0.0]))
+
+
+@pytest.mark.parametrize("n", [17, 64])
+def test_grid_separation_matches_dense(n):
+    import jax
+
+    pos = jax.random.uniform(
+        jax.random.PRNGKey(0), (n, 2), minval=-10.0, maxval=10.0
+    )
+    alive = jnp.ones((n,), bool).at[0].set(False)
+    dense = separation_dense(pos, alive, CFG.k_sep, CFG.personal_space,
+                             CFG.dist_eps)
+    grid = separation_grid(pos, alive, CFG.k_sep, CFG.personal_space,
+                           CFG.dist_eps, cell=CFG.personal_space,
+                           max_per_cell=n)
+    assert jnp.allclose(dense, grid, atol=1e-4)
+
+
+def test_grid_cell_smaller_than_personal_space_rejected():
+    pos = jnp.zeros((4, 2))
+    alive = jnp.ones((4,), bool)
+    with pytest.raises(ValueError, match="grid cell"):
+        separation_grid(pos, alive, CFG.k_sep, CFG.personal_space,
+                        CFG.dist_eps, cell=0.5, max_per_cell=4)
+
+
+def test_swarm_moves_to_target_and_settles():
+    # End-to-end motion sanity: a 4-agent swarm sent to a far target gets
+    # close (within tolerance + formation spread) and slows down.
+    sw = dsa.VectorSwarm(4, spread=1.0, seed=1)
+    sw.set_target([20.0, 0.0])
+    sw.step(400)
+    d = jnp.linalg.norm(sw.state.pos - jnp.asarray([20.0, 0.0]), axis=-1)
+    assert float(d.min()) < 2.0

@@ -1,0 +1,64 @@
+"""PSO optimizer: convergence, determinism, scan/step equivalence."""
+
+import jax.numpy as jnp
+import pytest
+
+from distributed_swarm_algorithm_tpu import PSO, pso_run, pso_step
+from distributed_swarm_algorithm_tpu.ops.objectives import get_objective
+
+
+def test_sphere_converges():
+    opt = PSO("sphere", n=256, dim=5, seed=0)
+    opt.run(300)
+    assert opt.best < 1e-3
+
+
+def test_rastrigin_improves_substantially():
+    opt = PSO("rastrigin", n=512, dim=10, seed=1)
+    start = float(opt.state.gbest_fit)
+    opt.run(400)
+    assert opt.best < start * 0.1
+
+
+def test_gbest_monotone():
+    opt = PSO("ackley", n=128, dim=8, seed=2)
+    prev = float(opt.state.gbest_fit)
+    for _ in range(50):
+        opt.step()
+        cur = float(opt.state.gbest_fit)
+        assert cur <= prev + 1e-6
+        prev = cur
+
+
+def test_scan_matches_python_loop():
+    fn, hw = get_objective("sphere")
+    a = PSO("sphere", n=64, dim=4, seed=3)
+    b = PSO("sphere", n=64, dim=4, seed=3)
+    sa = pso_run(a.state, fn, 25, half_width=a.half_width)
+    sb = b.state
+    for _ in range(25):
+        sb = pso_step(sb, fn, half_width=b.half_width)
+    assert jnp.allclose(sa.gbest_fit, sb.gbest_fit, atol=1e-5)
+    assert jnp.allclose(sa.pos, sb.pos, atol=1e-5)
+
+
+def test_determinism_same_seed():
+    a = PSO("rastrigin", n=64, dim=6, seed=7)
+    b = PSO("rastrigin", n=64, dim=6, seed=7)
+    a.run(50)
+    b.run(50)
+    assert a.best == b.best
+
+
+def test_positions_stay_in_domain():
+    opt = PSO("rastrigin", n=128, dim=6, seed=4)
+    opt.run(100)
+    hw = opt.half_width
+    assert bool((jnp.abs(opt.state.pos) <= hw + 1e-5).all())
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_dtypes(dtype):
+    opt = PSO("sphere", n=64, dim=4, seed=0, dtype=jnp.dtype(dtype))
+    opt.run(20)
+    assert bool(jnp.isfinite(opt.state.gbest_fit))

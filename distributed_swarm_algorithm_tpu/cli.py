@@ -101,19 +101,34 @@ def _cmd_sim(args) -> int:
 
 
 def _cmd_swarm(args) -> int:
-    from .models.swarm import VectorSwarm
+    if args.backend == "jax":
+        from .models.swarm import VectorSwarm
 
-    sw = VectorSwarm(args.n, dim=args.dim, seed=args.seed,
-                     spread=args.spread)
+        sw = VectorSwarm(args.n, dim=args.dim, seed=args.seed,
+                         spread=args.spread)
+    else:
+        from .models.cpu_swarm import CpuSwarm
+
+        if args.dim != 2:
+            raise SystemExit("error: CPU backends are 2-D (like the "
+                             "reference world); use --backend jax")
+        sw = CpuSwarm(args.n, seed=args.seed, spread=args.spread,
+                      backend=args.backend)
     if args.target:
         sw.set_target([float(x) for x in args.target])
     start = time.perf_counter()
     sw.step(args.steps)
+    if args.backend == "jax":
+        # JAX dispatch is async — wait for the device before timing.
+        import jax
+
+        jax.block_until_ready(sw.state.pos)
     elapsed = time.perf_counter() - start
     lid, exists = sw.leader()
     print(json.dumps({
         "agents": args.n,
         "ticks": args.steps,
+        "backend": getattr(sw, "backend", "jax"),
         "leader": lid if exists else None,
         "ticks_per_sec": round(args.steps / elapsed, 1),
         "agent_steps_per_sec": round(args.steps * args.n / elapsed, 1),
@@ -176,6 +191,12 @@ def build_parser() -> argparse.ArgumentParser:
     p_swarm.add_argument("--seed", type=int, default=0)
     p_swarm.add_argument("--spread", type=float, default=10.0)
     p_swarm.add_argument("--target", nargs="+", default=None)
+    p_swarm.add_argument(
+        "--backend", default="jax",
+        choices=["jax", "auto", "native", "numpy"],
+        help="jax = vectorized XLA path; native = C++ CPU kernels; "
+             "numpy = pure-NumPy oracle; auto = native if available",
+    )
     p_swarm.set_defaults(fn=_cmd_swarm)
 
     p_pso = sub.add_parser("pso", help="particle swarm optimization")
@@ -204,7 +225,7 @@ def main(argv=None) -> int:
         return 2
     try:
         return args.fn(args)
-    except (KeyError, ValueError) as e:
+    except (KeyError, ValueError, RuntimeError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
 

@@ -1,0 +1,363 @@
+"""Vectorized CPU backend: NumPy oracle + optional native C++ kernels.
+
+Role (SURVEY.md §7 design stance): the CPU path is the default debugging /
+small-swarm backend and the baseline that the TPU path's speedups are
+measured against (BASELINE.md).  This module re-implements the vectorized
+swarm tick — coordination, allocation, physics, identical semantics to the
+JAX kernels in ops/ — in plain NumPy, and transparently dispatches the two
+compute hot spots (APF physics, utility/arbitration) to the C++ tier in
+``native/`` when a compiler is available.
+
+The NumPy implementations double as the *oracle* for testing the C++
+kernels (tests/test_native.py) and for cross-checking the JAX path
+(tests/test_cpu_swarm.py): three independent implementations, one
+semantics.
+
+World is 2-D like the reference's (agent.py:47).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..utils.config import DEFAULT_CONFIG, SwarmConfig
+from .. import native as _native
+
+# FSM codes — keep in sync with state.py (reference agent.py:19-22).
+FOLLOWER = 1
+ELECTION_WAIT = 2
+LEADER = 3
+NO_LEADER = -1
+NO_WINNER = -1
+NO_CAP = -1
+
+
+class CpuSwarm:
+    """Whole-swarm lockstep simulator on NumPy arrays.
+
+    Mirrors models/swarm.py:VectorSwarm field-for-field (see state.py for
+    the reference-attribute mapping).  ``backend="native"`` uses the C++
+    kernels for physics and allocation; ``backend="numpy"`` forces the
+    pure-NumPy oracle; ``backend="auto"`` (default) picks native when the
+    shared library builds/loads.
+    """
+
+    def __init__(
+        self,
+        n_agents: int,
+        n_caps: int = 1,
+        config: Optional[SwarmConfig] = None,
+        seed: int = 0,
+        spread: float = 0.0,
+        backend: str = "auto",
+    ):
+        self.config = config or DEFAULT_CONFIG
+        self.n = n_agents
+        rng = np.random.default_rng(seed)
+        self.rng = rng
+
+        if backend == "auto":
+            backend = "native" if _native.available() else "numpy"
+        elif backend == "native":
+            if not _native.available():
+                raise RuntimeError(
+                    "native backend requested but unavailable"
+                )
+        elif backend != "numpy":
+            raise ValueError(f"unknown backend {backend!r}")
+        self.backend = backend
+
+        self.tick = 0
+        self.agent_id = np.arange(n_agents, dtype=np.int32)
+        self.alive = np.ones(n_agents, bool)
+        self.pos = (
+            rng.uniform(-spread, spread, (n_agents, 2))
+            if spread > 0.0
+            else np.zeros((n_agents, 2))
+        )
+        self.vel = np.zeros((n_agents, 2))
+        self.target = np.zeros((n_agents, 2))
+        self.has_target = np.zeros(n_agents, bool)
+        self.caps = np.zeros((n_agents, max(n_caps, 1)), bool)
+
+        self.fsm = np.full(n_agents, FOLLOWER, np.int32)
+        self.leader_id = np.full(n_agents, NO_LEADER, np.int32)
+        self.leader_pos = np.zeros((n_agents, 2))
+        self.has_leader_pos = np.zeros(n_agents, bool)
+        self.last_hb_tick = np.zeros(n_agents, np.int32)
+        self.wait_until = np.zeros(n_agents, np.int32)
+
+        self.task_pos = np.zeros((0, 2))
+        self.task_cap = np.zeros(0, np.int32)
+        self.task_winner = np.zeros(0, np.int32)
+        self.task_util = np.zeros(0)
+        self.task_claimed = np.zeros((n_agents, 0), bool)
+
+        self.obstacles: Optional[np.ndarray] = None
+
+    # --- world injection --------------------------------------------------
+    def set_target(self, target, agents=None) -> None:
+        t = np.broadcast_to(np.asarray(target, float), (self.n, 2))
+        if agents is None:
+            self.target[:] = t
+            self.has_target[:] = True
+        else:
+            self.target[agents] = t[agents]
+            self.has_target[agents] = True
+
+    def set_obstacles(self, obstacles) -> None:
+        self.obstacles = (
+            None if obstacles is None else np.asarray(obstacles, float)
+        )
+
+    def add_tasks(self, task_pos, task_cap=None) -> None:
+        self.task_pos = np.asarray(task_pos, float)
+        t = self.task_pos.shape[0]
+        self.task_cap = (
+            np.full(t, NO_CAP, np.int32)
+            if task_cap is None
+            else np.asarray(task_cap, np.int32)
+        )
+        self.task_winner = np.full(t, NO_WINNER, np.int32)
+        self.task_util = np.zeros(t)
+        self.task_claimed = np.zeros((self.n, t), bool)
+
+    def kill(self, ids) -> None:
+        self.alive[np.asarray(ids)] = False
+
+    def revive(self, ids) -> None:
+        ids = np.asarray(ids)
+        self.alive[ids] = True
+        self.fsm[ids] = FOLLOWER
+        self.leader_id[ids] = NO_LEADER
+        self.last_hb_tick[ids] = self.tick
+
+    # --- stepping ---------------------------------------------------------
+    def step(self, n_steps: int = 1) -> None:
+        for _ in range(n_steps):
+            self.tick += 1
+            self._coordination_step()
+            self._allocation_step()
+            self._physics_step()
+
+    def leader(self) -> Tuple[int, bool]:
+        mask = self.alive & (self.fsm == LEADER)
+        if not mask.any():
+            return NO_LEADER, False
+        return int(self.agent_id[mask].max()), True
+
+    # --- coordination (NumPy port of ops/coordination.py) ----------------
+    def _coordination_step(self) -> None:
+        cfg = self.config
+        tick = self.tick
+
+        silent = (tick - self.last_hb_tick) > cfg.election_timeout_ticks
+        to_wait = self.alive & (self.fsm == FOLLOWER) & silent
+        jitter = self.rng.integers(
+            0, cfg.election_jitter_ticks + 1, self.n
+        ).astype(np.int32)
+        self.wait_until = np.where(
+            to_wait, tick + jitter, self.wait_until
+        )
+        self.fsm = np.where(to_wait, ELECTION_WAIT, self.fsm)
+        self.leader_id = np.where(to_wait, NO_LEADER, self.leader_id)
+        self.has_leader_pos &= ~to_wait
+
+        acclaim = (
+            self.alive
+            & (self.fsm == ELECTION_WAIT)
+            & (tick > self.wait_until)
+        )
+        any_acclaim = acclaim.any()
+        if any_acclaim:
+            min_acclaim = self.agent_id[acclaim].min()
+            bully = (
+                self.alive
+                & (self.fsm == ELECTION_WAIT)
+                & (self.agent_id > min_acclaim)
+            )
+            contender = acclaim | bully | (self.alive & (self.fsm == LEADER))
+            winner = self.agent_id[contender].max()
+            is_winner = contender & (self.agent_id == winner)
+            resolve = self.alive
+            self.fsm = np.where(
+                resolve, np.where(is_winner, LEADER, FOLLOWER), self.fsm
+            )
+            self.leader_id = np.where(resolve, winner, self.leader_id)
+            self.last_hb_tick = np.where(
+                resolve & ~is_winner, tick, self.last_hb_tick
+            )
+
+        leaders = self.alive & (self.fsm == LEADER)
+        emit = leaders & (tick % cfg.heartbeat_period_ticks == 0)
+        if emit.any():
+            emit_ids = np.where(emit, self.agent_id, NO_LEADER)
+            hb_id = emit_ids.max()
+            hb_pos = self.pos[emit_ids.argmax()]
+            recv = self.alive & (self.agent_id != hb_id)
+            suppress = recv & (self.fsm == LEADER) & (self.agent_id > hb_id)
+            adopt = recv & ~suppress
+            self.fsm = np.where(adopt, FOLLOWER, self.fsm)
+            self.leader_id = np.where(adopt, hb_id, self.leader_id)
+            self.last_hb_tick = np.where(adopt, tick, self.last_hb_tick)
+            self.leader_pos = np.where(
+                adopt[:, None], hb_pos[None, :], self.leader_pos
+            )
+            self.has_leader_pos |= adopt
+
+        mine = self.alive & (self.fsm == LEADER)
+        self.leader_id = np.where(mine, self.agent_id, self.leader_id)
+
+    # --- allocation (NumPy / native port of ops/allocation.py) -----------
+    def _allocation_step(self) -> None:
+        cfg = self.config
+        t = self.task_pos.shape[0]
+        if t == 0:
+            return
+        if self.backend == "native":
+            u = _native.utility_matrix(
+                self.pos, self.task_pos, self.caps, self.task_cap,
+                cfg.utility_scale,
+            )
+        else:
+            delta = self.pos[:, None, :] - self.task_pos[None, :, :]
+            dist = np.linalg.norm(delta, axis=-1)
+            no_cap = self.task_cap < 0
+            cap_ok = self.caps[:, np.maximum(self.task_cap, 0)]
+            match = np.where(no_cap[None, :], True, cap_ok)
+            u = np.where(match, cfg.utility_scale / (1.0 + dist), 0.0)
+
+        leader_exists = (self.alive & (self.fsm == LEADER)).any()
+        open_for_me = ~self.task_claimed
+        if not cfg.allocation_lock_on_award:
+            not_mine = self.task_winner[None, :] != self.agent_id[:, None]
+            open_for_me = open_for_me | not_mine
+        claims = (
+            self.alive[:, None]
+            & open_for_me
+            & (u > cfg.utility_threshold)
+            & leader_exists
+        )
+        claims_util = np.where(claims, u, 0.0)
+
+        if self.backend == "native":
+            _native.arbitrate(
+                claims_util, self.task_winner, self.task_util,
+                cfg.claim_hysteresis,
+            )
+        else:
+            has_claim = (claims_util > 0.0).any(axis=0)
+            best_row = claims_util.argmax(axis=0)
+            best_util = claims_util.max(axis=0)
+            best_id = self.agent_id[best_row]
+            vacant = self.task_winner == NO_WINNER
+            beats = best_util > self.task_util + cfg.claim_hysteresis
+            award = has_claim & (vacant | beats)
+            self.task_winner = np.where(
+                award, best_id, self.task_winner
+            ).astype(np.int32)
+            self.task_util = np.where(award, best_util, self.task_util)
+
+        awarded = self.task_winner != NO_WINNER
+        self.task_claimed |= claims | awarded[None, :]
+
+    # --- physics (NumPy / native port of ops/physics.py) ------------------
+    def _formation_targets(self) -> None:
+        cfg = self.config
+        if cfg.formation_rank_mode == "id":
+            rank = self.agent_id.astype(float)
+        else:
+            alive_i = self.alive.astype(np.int64)
+            alive_below = np.cumsum(alive_i) - alive_i
+            lid = self.leader_id
+            lid_valid = (lid >= 0) & (lid < self.n)
+            leader_alive = self.alive[np.clip(lid, 0, self.n - 1)]
+            leader_below = (
+                lid_valid & leader_alive & (lid < self.agent_id)
+            ).astype(np.int64)
+            rank = (alive_below - leader_below + 1).astype(float)
+
+        sp = cfg.formation_spacing
+        x_off = -sp * rank
+        if cfg.formation_shape == "line":
+            y_off = np.zeros_like(x_off)
+        else:
+            side = np.where(rank.astype(np.int64) % 2 == 0, 1.0, -1.0)
+            y_off = sp * rank * side
+
+        is_follower = (
+            (self.fsm == FOLLOWER) & self.has_leader_pos & self.alive
+        )
+        new_target = self.leader_pos + np.stack([x_off, y_off], axis=1)
+        self.target = np.where(
+            is_follower[:, None], new_target, self.target
+        )
+        self.has_target |= is_follower
+
+    def _physics_step(self) -> None:
+        cfg = self.config
+        self._formation_targets()
+        # separation_mode: "dense" and "grid" both mean exact all-pairs
+        # here (grid is a TPU-scale optimization, ops/neighbors.py; CPU
+        # swarms are small enough for O(N^2)); "off" disables the force —
+        # mirrored by zeroing k_sep on the native path.
+        sep_off = cfg.separation_mode == "off"
+        if self.backend == "native":
+            _native.physics_step(
+                self.pos, self.vel, self.target, self.has_target,
+                self.alive, self.obstacles,
+                cfg.replace(k_sep=0.0) if sep_off else cfg,
+            )
+            return
+
+        eps = cfg.dist_eps
+        pos = self.pos
+        delta = self.target - pos
+        dist = np.linalg.norm(delta, axis=-1)
+        pulling = self.has_target & (dist > cfg.arrival_tolerance)
+        force = np.where(pulling[:, None], cfg.k_att * delta, 0.0)
+
+        if self.obstacles is not None and len(self.obstacles):
+            centers = self.obstacles[:, :2]
+            radii = self.obstacles[:, 2]
+            away = pos[:, None, :] - centers[None, :, :]
+            center_dist = np.linalg.norm(away, axis=-1)
+            surf = np.maximum(
+                np.maximum(center_dist, eps) - radii[None, :], eps
+            )
+            mag = cfg.k_rep * (1.0 / surf - 1.0 / cfg.rho0) / (surf * surf)
+            mag = np.where(surf < cfg.rho0, mag, 0.0)
+            unit = away / np.maximum(center_dist, eps)[..., None]
+            force = force + (mag[..., None] * unit).sum(axis=1)
+
+        if not sep_off:
+            diff = pos[:, None, :] - pos[None, :, :]
+            d = np.linalg.norm(diff, axis=-1)
+            d_c = np.maximum(d, eps)
+            near = (
+                self.alive[:, None]
+                & self.alive[None, :]
+                & ~np.eye(self.n, dtype=bool)
+                & (d < cfg.personal_space)
+            )
+            mag = cfg.k_sep / (d_c * d_c)
+            unit = diff / d_c[..., None]
+            force = force + np.where(
+                near[..., None], mag[..., None] * unit, 0.0
+            ).sum(axis=1)
+
+        speed = np.linalg.norm(force, axis=-1, keepdims=True)
+        scale = np.where(
+            speed > cfg.max_speed,
+            cfg.max_speed / np.maximum(speed, eps),
+            1.0,
+        )
+        vel = force * scale
+        moving = self.has_target & self.alive
+        vel = np.where(moving[:, None], vel, 0.0)
+        self.pos = np.where(
+            moving[:, None], pos + vel * cfg.dt, pos
+        )
+        self.vel = vel

@@ -79,7 +79,7 @@ class VectorSwarm:
         self.config = config or DEFAULT_CONFIG
         self.state = make_swarm(
             n_agents, dim=dim, n_tasks=n_tasks, n_caps=n_caps, seed=seed,
-            spread=spread,
+            spread=spread, dtype=jnp.dtype(self.config.dtype),
         )
         self.obstacles: Optional[jax.Array] = _NO_OBSTACLES
 

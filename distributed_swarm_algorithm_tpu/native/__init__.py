@@ -1,0 +1,201 @@
+"""Native (C++) CPU runtime tier — ctypes loader and NumPy-facing wrappers.
+
+The reference framework is pure Python (SURVEY.md §2: zero native
+components); its physics loop is the measured hot spot (~171k single-agent
+steps/sec, SURVEY.md §6).  This package supplies the native tier the
+framework's CPU path deserves: ``csrc/swarm_core.cpp`` implements the
+whole-swarm APF physics tick and the allocation kernels in C++, built on
+demand with the system ``g++`` into a shared library and loaded here with
+``ctypes`` (no pybind11 required — see Environment notes).
+
+Graceful degradation: if no compiler is available the loader returns
+``None`` and callers fall back to NumPy (models/cpu_swarm.py keeps a pure
+NumPy oracle of identical semantics — also used to test the C++ against).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sysconfig
+import threading
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "csrc", "swarm_core.cpp")
+_ABI_VERSION = 1
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _lib_path() -> str:
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    return os.path.join(_HERE, f"_swarm_core{suffix}")
+
+
+def _build(src: str, out: str) -> bool:
+    # Portable codegen by default: the cached .so may be loaded on a
+    # different CPU than it was built on (shared volume, container image),
+    # where -march=native output would SIGILL.  Opt in to host tuning with
+    # DSA_NATIVE_MARCH=native.
+    march = os.environ.get("DSA_NATIVE_MARCH", "")
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-O3", "-shared", "-fPIC", "-std=c++17",
+        *([f"-march={march}"] if march else []),
+        src, "-o", out,
+    ]
+    try:
+        res = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=120
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    return res.returncode == 0 and os.path.exists(out)
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable.
+
+    Rebuilds when the source is newer than the cached .so (dev loop).
+    Thread-safe; the result is cached for the process lifetime.
+    """
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        out = _lib_path()
+        try:
+            stale = (not os.path.exists(out)) or (
+                os.path.getmtime(out) < os.path.getmtime(_SRC)
+            )
+        except OSError:
+            stale = True
+        if stale and not _build(_SRC, out) and not os.path.exists(out):
+            # No compiler AND no previously-built library: degrade to
+            # NumPy.  A stale-but-loadable .so is still used (the ABI
+            # check below guards real incompatibility).
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(out)
+        except OSError:
+            _load_failed = True
+            return None
+        if lib.dsa_abi_version() != _ABI_VERSION:
+            _load_failed = True
+            return None
+        _declare(lib)
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+_i64 = ctypes.c_int64
+_f64 = ctypes.c_double
+_pd = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+_pu8 = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+_pi32 = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    lib.dsa_physics_step.restype = None
+    lib.dsa_physics_step.argtypes = [
+        _i64, _pd, _pd, _pd, _pu8, _pu8, _pd, _i64,
+        _f64, _f64, _f64, _f64, _f64, _f64, _f64, _f64, _f64,
+    ]
+    lib.dsa_utility_matrix.restype = None
+    lib.dsa_utility_matrix.argtypes = [
+        _i64, _i64, _pd, _pd, _pu8, _i64, _pi32, _f64, _pd,
+    ]
+    lib.dsa_arbitrate.restype = None
+    lib.dsa_arbitrate.argtypes = [_i64, _i64, _pd, _pi32, _pd, _f64]
+    lib.dsa_abi_version.restype = ctypes.c_int32
+    lib.dsa_abi_version.argtypes = []
+
+
+# ---------------------------------------------------------------------------
+# NumPy-facing wrappers (in-place where the C does in-place)
+# ---------------------------------------------------------------------------
+
+
+def physics_step(
+    pos: np.ndarray,
+    vel: np.ndarray,
+    target: np.ndarray,
+    has_target: np.ndarray,
+    alive: np.ndarray,
+    obstacles: Optional[np.ndarray],
+    cfg,
+    dt: Optional[float] = None,
+) -> None:
+    """In-place whole-swarm APF tick (see csrc/swarm_core.cpp).
+
+    ``pos``/``vel`` are float64 [N,2] C-contiguous and updated in place.
+    ``cfg`` is a utils.config.SwarmConfig.
+    """
+    lib = load()
+    assert lib is not None, "native library unavailable"
+    n = pos.shape[0]
+    obs = (
+        np.zeros((0, 3), np.float64)
+        if obstacles is None
+        else np.ascontiguousarray(obstacles, np.float64)
+    )
+    lib.dsa_physics_step(
+        n, pos, vel,
+        np.ascontiguousarray(target, np.float64),
+        np.ascontiguousarray(has_target, np.uint8),
+        np.ascontiguousarray(alive, np.uint8),
+        obs, obs.shape[0],
+        cfg.k_att, cfg.arrival_tolerance, cfg.k_rep, cfg.rho0,
+        cfg.k_sep, cfg.personal_space, cfg.dist_eps, cfg.max_speed,
+        cfg.dt if dt is None else dt,
+    )
+
+
+def utility_matrix(
+    pos: np.ndarray,
+    task_pos: np.ndarray,
+    caps: np.ndarray,
+    task_cap: np.ndarray,
+    scale: float,
+) -> np.ndarray:
+    lib = load()
+    assert lib is not None, "native library unavailable"
+    n, t = pos.shape[0], task_pos.shape[0]
+    out = np.zeros((n, t), np.float64)
+    caps_u8 = np.ascontiguousarray(caps, np.uint8)
+    lib.dsa_utility_matrix(
+        n, t,
+        np.ascontiguousarray(pos, np.float64),
+        np.ascontiguousarray(task_pos, np.float64),
+        caps_u8, caps_u8.shape[1] if caps_u8.ndim == 2 else 0,
+        np.ascontiguousarray(task_cap, np.int32),
+        scale, out,
+    )
+    return out
+
+
+def arbitrate(
+    claims: np.ndarray,
+    winner: np.ndarray,
+    util: np.ndarray,
+    hysteresis: float,
+) -> None:
+    """In-place arbitration: updates winner[t] (int32) and util[t]."""
+    lib = load()
+    assert lib is not None, "native library unavailable"
+    n, t = claims.shape
+    lib.dsa_arbitrate(
+        n, t, np.ascontiguousarray(claims, np.float64), winner, util,
+        hysteresis,
+    )

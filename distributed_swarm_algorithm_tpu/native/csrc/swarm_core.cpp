@@ -1,0 +1,190 @@
+// Native CPU runtime kernels for the per-agent path.
+//
+// The reference is pure Python (SURVEY.md §2: zero native components) and
+// its per-tick physics is the compute hot spot (~171k single-agent
+// steps/sec in CPython, SURVEY.md §6 / reference agent.py:94-181).  The
+// TPU path vectorizes this under XLA (ops/physics.py); this file is the
+// equivalent *native* tier for the CPU per-agent runtime: the whole-swarm
+// APF physics tick and the bid-matrix utility/arbitration kernels, batched
+// over agents in C++ so the lockstep simulator (models/agent.py
+// run_local_swarm and models/cpu_swarm.py) is not bottlenecked by the
+// interpreter.
+//
+// Exposed as a plain C ABI, loaded from Python with ctypes
+// (native/__init__.py) — no pybind11 dependency.  Semantics mirror
+// ops/physics.py / ops/allocation.py exactly (same epsilon clamps, same
+// force laws from reference agent.py:116-178, same hysteresis rule from
+// reference agent.py:308-325); tests/test_native.py checks bit-level
+// agreement with the NumPy oracle.
+//
+// World is 2-D, like the reference's (agent.py:47).
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// One APF physics tick for the whole swarm (reference agent.py:94-181).
+//
+//   pos, vel:        [n][2] in/out (Euler-updated in place)
+//   target:          [n][2]; has_target: [n] (0/1, agent.py:113-114)
+//   alive:           [n] (0/1) — dead agents are frozen
+//   obstacles:       [n_obs][3] rows of (x, y, radius)
+//   neighbor mode:   all-pairs over alive agents (the vectorized-model
+//                    semantics; any agent beyond personal_space contributes
+//                    zero force, so this is exact)
+//
+// Config scalars are the reference constants (see utils/config.py for
+// file:line provenance).  All norms clamp at eps — the reference's
+// co-located-agent ZeroDivisionError (SURVEY.md §5a bug 1) cannot occur.
+void dsa_physics_step(
+    int64_t n,
+    double* pos,
+    double* vel,
+    const double* target,
+    const uint8_t* has_target,
+    const uint8_t* alive,
+    const double* obstacles,
+    int64_t n_obs,
+    double k_att,
+    double arrival_tolerance,
+    double k_rep,
+    double rho0,
+    double k_sep,
+    double personal_space,
+    double eps,
+    double max_speed,
+    double dt) {
+  const double ps2 = personal_space * personal_space;
+  for (int64_t i = 0; i < n; ++i) {
+    if (!alive[i] || !has_target[i]) {
+      vel[2 * i] = 0.0;
+      vel[2 * i + 1] = 0.0;
+      continue;
+    }
+    const double px = pos[2 * i];
+    const double py = pos[2 * i + 1];
+    double fx = 0.0, fy = 0.0;
+
+    // Attraction (agent.py:116-125): full displacement, gated outside the
+    // arrival tolerance.
+    const double tx = target[2 * i] - px;
+    const double ty = target[2 * i + 1] - py;
+    if (std::sqrt(tx * tx + ty * ty) > arrival_tolerance) {
+      fx += k_att * tx;
+      fy += k_att * ty;
+    }
+
+    // Obstacle repulsion (agent.py:127-146): distance to the obstacle
+    // *surface*, active inside rho0.
+    for (int64_t o = 0; o < n_obs; ++o) {
+      const double dx = px - obstacles[3 * o];
+      const double dy = py - obstacles[3 * o + 1];
+      double center = std::sqrt(dx * dx + dy * dy);
+      if (center < eps) center = eps;
+      double surf = center - obstacles[3 * o + 2];
+      if (surf < eps) surf = eps;
+      if (surf < rho0) {
+        const double mag = k_rep * (1.0 / surf - 1.0 / rho0) / (surf * surf);
+        fx += (dx / center) * mag;
+        fy += (dy / center) * mag;
+      }
+    }
+
+    // Neighbor separation (agent.py:148-160) over all alive others.
+    for (int64_t j = 0; j < n; ++j) {
+      if (j == i || !alive[j]) continue;
+      const double dx = px - pos[2 * j];
+      const double dy = py - pos[2 * j + 1];
+      const double d2 = dx * dx + dy * dy;
+      if (d2 >= ps2) continue;
+      double dist = std::sqrt(d2);
+      if (dist < eps) dist = eps;
+      const double mag = k_sep / (dist * dist);
+      fx += (dx / dist) * mag;
+      fy += (dy / dist) * mag;
+    }
+
+    // Clamp + Euler (agent.py:165-178); force == velocity command.
+    const double speed = std::sqrt(fx * fx + fy * fy);
+    if (speed > max_speed) {
+      const double s = max_speed / (speed < eps ? eps : speed);
+      fx *= s;
+      fy *= s;
+    }
+    vel[2 * i] = fx;
+    vel[2 * i + 1] = fy;
+  }
+  // Second pass for positions so every separation force reads *pre-tick*
+  // positions (synchronous semantics, matching the vectorized model).
+  for (int64_t i = 0; i < n; ++i) {
+    if (!alive[i] || !has_target[i]) continue;
+    pos[2 * i] += vel[2 * i] * dt;
+    pos[2 * i + 1] += vel[2 * i + 1] * dt;
+  }
+}
+
+// Utility bid matrix U[n][t] = scale / (1 + dist) * cap_match
+// (reference agent.py:338-347; ops/allocation.py:utility_matrix).
+//   caps:     [n][n_caps] 0/1 one-hot agent capabilities
+//   task_cap: [t] required capability index, -1 = none required
+void dsa_utility_matrix(
+    int64_t n,
+    int64_t t,
+    const double* pos,
+    const double* task_pos,
+    const uint8_t* caps,
+    int64_t n_caps,
+    const int32_t* task_cap,
+    double scale,
+    double* out /* [n][t] */) {
+  for (int64_t i = 0; i < n; ++i) {
+    const double px = pos[2 * i];
+    const double py = pos[2 * i + 1];
+    for (int64_t k = 0; k < t; ++k) {
+      const double dx = px - task_pos[2 * k];
+      const double dy = py - task_pos[2 * k + 1];
+      const double dist = std::sqrt(dx * dx + dy * dy);
+      const int32_t req = task_cap[k];
+      const bool match = req < 0 || (req < n_caps && caps[i * n_caps + req]);
+      out[i * t + k] = match ? scale / (1.0 + dist) : 0.0;
+    }
+  }
+}
+
+// Leader arbitration with hysteresis (reference agent.py:304-325;
+// ops/allocation.py:arbitrate).  claims[n][t] holds each agent's live
+// claim utility (0 = no claim).  winner/util[t] are the incumbent ledger,
+// updated in place.  Highest utility wins; ties break to the lowest agent
+// id; a challenger must beat the incumbent by `hysteresis`.
+void dsa_arbitrate(
+    int64_t n,
+    int64_t t,
+    const double* claims,
+    int32_t* winner,
+    double* util,
+    double hysteresis) {
+  for (int64_t k = 0; k < t; ++k) {
+    double best = 0.0;
+    int64_t best_i = -1;
+    for (int64_t i = 0; i < n; ++i) {
+      const double u = claims[i * t + k];
+      if (u > best) {  // strict: ties keep the lower id
+        best = u;
+        best_i = i;
+      }
+    }
+    if (best_i < 0) continue;  // no claim this tick
+    const bool vacant = winner[k] < 0;
+    if (vacant || best > util[k] + hysteresis) {
+      winner[k] = static_cast<int32_t>(best_i);
+      util[k] = best;
+    }
+  }
+}
+
+// Version tag so the Python loader can verify the ABI.
+int32_t dsa_abi_version() { return 1; }
+
+}  // extern "C"

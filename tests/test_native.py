@@ -1,0 +1,137 @@
+"""Native C++ kernels vs the NumPy oracle (models/cpu_swarm.py) vs JAX.
+
+Three independent implementations of one semantics (reference
+agent.py:94-181 physics, agent.py:291-347 allocation); these tests pin
+them together.  Skipped wholesale when no C++ toolchain is available.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_swarm_algorithm_tpu import native
+from distributed_swarm_algorithm_tpu.models.cpu_swarm import CpuSwarm
+from distributed_swarm_algorithm_tpu.utils.config import SwarmConfig
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no C++ toolchain / native lib"
+)
+
+CFG = SwarmConfig()
+
+
+def _twin_swarms(n=24, seed=3, tasks=True):
+    """Two CpuSwarms with identical state, one per backend."""
+    swarms = []
+    for backend in ("numpy", "native"):
+        s = CpuSwarm(n, n_caps=2, seed=seed, spread=8.0, backend=backend)
+        s.set_target([20.0, -5.0])
+        s.set_obstacles([[4.0, 4.0, 1.0], [-3.0, 2.0, 0.5]])
+        if tasks:
+            rng = np.random.default_rng(seed + 1)
+            s.add_tasks(
+                rng.uniform(-8, 8, (6, 2)),
+                task_cap=np.array([-1, -1, 0, 0, 1, 1], np.int32),
+            )
+            s.caps[: n // 2, 0] = True
+            s.caps[n // 2 :, 1] = True
+        swarms.append(s)
+    return swarms
+
+
+def test_physics_native_matches_numpy_oracle():
+    a, b = _twin_swarms(tasks=False)
+    for _ in range(50):
+        a.step()
+        b.step()
+    # -march=native FMA contraction vs NumPy changes last-ulp rounding;
+    # 1e-9 over 50 chaotic steps still pins the semantics.
+    np.testing.assert_allclose(a.pos, b.pos, rtol=1e-9, atol=1e-9)
+    np.testing.assert_allclose(a.vel, b.vel, rtol=1e-9, atol=1e-9)
+
+
+def test_allocation_native_matches_numpy_oracle():
+    a, b = _twin_swarms()
+    for _ in range(40):
+        a.step()
+        b.step()
+    np.testing.assert_array_equal(a.task_winner, b.task_winner)
+    np.testing.assert_allclose(a.task_util, b.task_util, rtol=1e-12)
+    np.testing.assert_array_equal(a.task_claimed, b.task_claimed)
+
+
+def test_utility_matrix_values():
+    # U = 100/(1+d)·cap_match at d=1 → 50 (reference test_allocation.py:16-23)
+    pos = np.array([[0.0, 0.0]])
+    task_pos = np.array([[1.0, 0.0]])
+    caps = np.array([[True]])
+    u = native.utility_matrix(
+        pos, task_pos, caps, np.array([0], np.int32), 100.0
+    )
+    np.testing.assert_allclose(u, [[50.0]])
+    # Missing capability zeroes the utility (test_allocation.py:25-32).
+    u0 = native.utility_matrix(
+        pos, task_pos, np.array([[False]]), np.array([0], np.int32), 100.0
+    )
+    np.testing.assert_allclose(u0, [[0.0]])
+
+
+def test_arbitrate_hysteresis():
+    # Incumbent at 50; +2 challenger rejected, +10 accepted
+    # (reference test_allocation.py:70-96).
+    winner = np.array([0], np.int32)
+    util = np.array([50.0])
+    claims = np.array([[0.0], [52.0]])
+    native.arbitrate(claims, winner, util, 5.0)
+    assert winner[0] == 0 and util[0] == 50.0
+    claims = np.array([[0.0], [60.0]])
+    native.arbitrate(claims, winner, util, 5.0)
+    assert winner[0] == 1 and util[0] == 60.0
+
+
+def test_arbitrate_tie_breaks_low_id():
+    winner = np.array([-1], np.int32)
+    util = np.array([0.0])
+    claims = np.array([[42.0], [42.0], [42.0]])
+    native.arbitrate(claims, winner, util, 5.0)
+    assert winner[0] == 0
+
+
+def test_physics_co_located_agents_finite():
+    # The reference's default spawn (all agents at the origin) crashes it
+    # with ZeroDivisionError (SURVEY.md §5a bug 1); the native kernel must
+    # stay finite.
+    s = CpuSwarm(8, backend="native")
+    s.set_target([5.0, 5.0])
+    s.step(20)
+    assert np.isfinite(s.pos).all()
+    assert np.isfinite(s.vel).all()
+
+
+def test_native_matches_jax_physics():
+    """C++ vs the JAX ops/physics.py kernel on one deterministic tick."""
+    import jax.numpy as jnp
+
+    from distributed_swarm_algorithm_tpu import make_swarm
+    from distributed_swarm_algorithm_tpu.ops.physics import physics_step
+
+    n = 12
+    rng = np.random.default_rng(7)
+    pos = rng.uniform(-6, 6, (n, 2))
+    target = np.tile([10.0, 3.0], (n, 1))
+    obstacles = np.array([[2.0, 2.0, 1.0]])
+
+    st = make_swarm(n, pos=jnp.asarray(pos))
+    st = st.replace(
+        target=jnp.asarray(target),
+        has_target=jnp.ones(n, bool),
+    )
+    out = physics_step(st, jnp.asarray(obstacles), CFG)
+
+    cpos = pos.copy()
+    cvel = np.zeros((n, 2))
+    native.physics_step(
+        cpos, cvel, target, np.ones(n, np.uint8), np.ones(n, np.uint8),
+        obstacles, CFG,
+    )
+    np.testing.assert_allclose(cpos, np.asarray(out.pos), atol=1e-5)
+    np.testing.assert_allclose(cvel, np.asarray(out.vel), atol=1e-5)

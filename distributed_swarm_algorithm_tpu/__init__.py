@@ -49,6 +49,7 @@ from .ops.coordination import (
     kill,
     revive,
 )
+from .ops.pallas import fused_pso_run
 from .ops.physics import apf_forces, formation_targets, physics_step
 from .ops.pso import PSOState, pso_init, pso_run, pso_step
 
@@ -57,7 +58,7 @@ __version__ = "0.1.0"
 __all__ = [
     "SwarmConfig", "DEFAULT_CONFIG", "SwarmState", "make_swarm", "with_tasks",
     "VectorSwarm", "swarm_tick", "swarm_rollout", "PSO",
-    "PSOState", "pso_init", "pso_step", "pso_run",
+    "PSOState", "pso_init", "pso_step", "pso_run", "fused_pso_run",
     "objectives",
     "coordination_step", "instant_election", "current_leader", "kill",
     "revive",

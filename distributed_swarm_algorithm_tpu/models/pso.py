@@ -8,10 +8,23 @@ import jax
 
 from ..ops import pso as _k
 from ..ops.objectives import get_objective
+from ..ops.pallas import pso_fused as _pf
+
+
+def _on_tpu() -> bool:
+    d = jax.devices()[0]
+    return "tpu" in d.device_kind.lower() or d.platform in ("tpu", "axon")
 
 
 class PSO:
     """Global-best particle swarm optimizer.
+
+    Two compute paths with the same PSOState contract:
+      - portable jit'd JAX (any backend),
+      - the fused Pallas TPU kernel (ops/pallas/pso_fused.py) — picked
+        automatically on TPU for named objectives in float32, or forced
+        with ``use_pallas=True`` (on CPU that runs the same kernel body in
+        interpret mode with host RNG — slow, for testing).
 
     >>> opt = PSO("rastrigin", n=4096, dim=30, seed=0)
     >>> opt.run(500)
@@ -30,21 +43,39 @@ class PSO:
         vmax_frac: float = 0.5,
         seed: int = 0,
         dtype=None,
+        use_pallas: Optional[bool] = None,
+        steps_per_kernel: int = 8,
     ):
         if isinstance(objective, str):
             fn, default_hw = get_objective(objective)
+            self.objective_name: Optional[str] = objective
         else:
             fn, default_hw = objective, 5.12
+            self.objective_name = None
         self.objective = fn
         self.half_width = float(
             half_width if half_width is not None else default_hw
         )
         self.w, self.c1, self.c2 = float(w), float(c1), float(c2)
         self.vmax_frac = float(vmax_frac)
+        self.steps_per_kernel = int(steps_per_kernel)
         kwargs = {} if dtype is None else {"dtype": dtype}
         self.state = _k.pso_init(
             fn, n, dim, self.half_width, seed=seed, **kwargs
         )
+
+        supported = self.objective_name is not None and _pf.pallas_supported(
+            self.objective_name or "", self.state.pos.dtype
+        )
+        if use_pallas is None:
+            self.use_pallas = supported and _on_tpu()
+        elif use_pallas and not supported:
+            raise ValueError(
+                "use_pallas=True needs a named objective from "
+                "ops.objectives and float32 state"
+            )
+        else:
+            self.use_pallas = bool(use_pallas)
 
     def step(self) -> _k.PSOState:
         self.state = _k.pso_step(
@@ -54,10 +85,20 @@ class PSO:
         return self.state
 
     def run(self, n_steps: int) -> _k.PSOState:
-        self.state = _k.pso_run(
-            self.state, self.objective, n_steps, self.w, self.c1, self.c2,
-            self.half_width, self.vmax_frac,
-        )
+        if self.use_pallas:
+            on_tpu = _on_tpu()
+            self.state = _pf.fused_pso_run(
+                self.state, self.objective_name, n_steps,
+                self.w, self.c1, self.c2, self.half_width, self.vmax_frac,
+                rng="tpu" if on_tpu else "host",
+                interpret=not on_tpu,
+                steps_per_kernel=self.steps_per_kernel,
+            )
+        else:
+            self.state = _k.pso_run(
+                self.state, self.objective, n_steps, self.w, self.c1,
+                self.c2, self.half_width, self.vmax_frac,
+            )
         jax.block_until_ready(self.state.gbest_fit)
         return self.state
 

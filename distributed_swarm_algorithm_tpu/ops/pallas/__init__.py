@@ -1,0 +1,21 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+The compute path of the framework is plain jit'd JAX (XLA fuses the
+elementwise chains well); these kernels exist for the ops where manual
+control of VMEM tiling, on-chip RNG, and single-pass fusion beats what XLA
+does on its own:
+
+  - ``pso_fused``: blocks of whole PSO iterations (RNG + velocity/position
+    update + fitness + pbest + cross-tile best reduction) as ONE pass over
+    HBM, in a lane-aligned ``[D, N]`` layout with the TPU hardware PRNG.
+
+Every kernel has a host/interpret mode so the test suite exercises the
+exact kernel bodies on CPU (tests/conftest.py pins JAX to CPU).
+"""
+
+from .pso_fused import (  # noqa: F401
+    OBJECTIVES_T,
+    fused_pso_run,
+    fused_pso_step_t,
+    pallas_supported,
+)

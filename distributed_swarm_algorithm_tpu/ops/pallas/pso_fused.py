@@ -1,0 +1,442 @@
+"""Fused PSO iteration as a single Pallas TPU kernel.
+
+The portable PSO step (ops/pso.py) is a chain XLA already fuses decently;
+what it cannot do is (a) use the TPU's hardware PRNG instead of ~hundreds
+of ALU ops of threefry per random word, (b) pick the memory layout.  This
+kernel does both:
+
+  - **Layout**: particles live on the *lane* axis — arrays are ``[D, N]``
+    (transposed from the portable ``[N, D]``).  With D=30 the portable
+    layout wastes 98/128 lanes of every VPU op; transposed, tiles are
+    ``[D, TILE_N]`` with the lane dimension fully aligned (TILE_N a
+    multiple of 128) and D padded only on sublanes (30 -> 32).
+  - **RNG**: `pltpu.prng_random_bits` inside the kernel — no HBM traffic
+    and no threefry tower for the 2·N·D uniforms per step.
+  - **Fusion**: velocity update, clamp, position update, domain clip,
+    objective evaluation, pbest compare-and-select, and a per-tile
+    best-candidate reduction all happen in one pass: each of pos/vel/
+    pbest_pos is read once and written once per step.
+
+The per-tile candidates (``[1, n_tiles]`` fits + ``[D, n_tiles]``
+positions) are reduced to the global best by a trivial jnp argmin outside
+the kernel — the same two-stage reduction that, under ``shard_map``,
+becomes per-shard kernel + cross-device ``pmin`` (parallel/sharding.py).
+
+Testing: the kernel body is identical under ``rng="host"``, where r1/r2
+arrive as operands instead of being drawn on-chip; that variant runs under
+``pallas_call(interpret=True)`` on CPU, so tests/test_pallas_pso.py checks
+the exact kernel math against the portable step (tests/conftest.py pins
+CPU).  The TPU-PRNG variant differs only in where the uniforms come from.
+
+Capability lineage: this is the perf flagship for the BASELINE.md north
+star (1M-particle Rastrigin-30D); the reference has no optimizer at all —
+its swarm "fitness" is the task utility at /root/reference/agent.py:338-347.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..pso import C1, C2, W, PSOState
+
+# Default lane tile on the particle axis; fused_pso_run shrinks it for
+# high-D problems via _auto_tile so all live [D, TILE_N] buffers (double-
+# buffered in/out blocks + loop temporaries) fit the ~16 MB VMEM budget.
+DEFAULT_TILE_N = 4096
+MAX_TILE_N = 8192
+
+
+def _auto_tile(d_pad: int) -> int:
+    """Largest lane tile whose VMEM working set fits the scoped budget.
+
+    Calibrated on v5e: D=30 (pad 32) supports 4096 lanes with the k-step
+    kernel; scale inversely with padded depth and keep lane alignment.
+    """
+    tile = (131072 // d_pad) // 128 * 128
+    return max(128, min(MAX_TILE_N, tile))
+
+
+# --------------------------------------------------------------------------
+# Objectives in transposed [D, n] layout: f(x[D, n]) -> fit[1, n].
+# Mirrors ops/objectives.py exactly, with the reduction on axis 0
+# (sublanes) so results land lane-aligned.
+# --------------------------------------------------------------------------
+
+_TWO_PI = 2.0 * jnp.pi
+
+
+def _sphere_t(x):
+    return jnp.sum(x * x, axis=0, keepdims=True)
+
+
+def _rastrigin_t(x):
+    d = x.shape[0]
+    return 10.0 * d + jnp.sum(
+        x * x - 10.0 * jnp.cos(_TWO_PI * x), axis=0, keepdims=True
+    )
+
+
+def _ackley_t(x):
+    d = x.shape[0]
+    s1 = jnp.sum(x * x, axis=0, keepdims=True) / d
+    s2 = jnp.sum(jnp.cos(_TWO_PI * x), axis=0, keepdims=True) / d
+    return -20.0 * jnp.exp(-0.2 * jnp.sqrt(s1)) - jnp.exp(s2) + 20.0 + jnp.e
+
+
+def _rosenbrock_t(x):
+    a = x[1:, :] - x[:-1, :] ** 2
+    b = 1.0 - x[:-1, :]
+    return jnp.sum(100.0 * a * a + b * b, axis=0, keepdims=True)
+
+
+def _griewank_t(x):
+    d = x.shape[0]
+    # 2D iota (1D iota is unsupported on TPU).
+    i = jax.lax.broadcasted_iota(x.dtype, (d, 1), 0) + 1.0
+    return (
+        jnp.sum(x * x, axis=0, keepdims=True) / 4000.0
+        - jnp.prod(jnp.cos(x / jnp.sqrt(i)), axis=0, keepdims=True)
+        + 1.0
+    )
+
+
+def _schwefel_t(x):
+    d = x.shape[0]
+    return 418.9829 * d - jnp.sum(
+        x * jnp.sin(jnp.sqrt(jnp.abs(x))), axis=0, keepdims=True
+    )
+
+
+OBJECTIVES_T: Dict[str, Callable] = {
+    "sphere": _sphere_t,
+    "rastrigin": _rastrigin_t,
+    "ackley": _ackley_t,
+    "rosenbrock": _rosenbrock_t,
+    "griewank": _griewank_t,
+    "schwefel": _schwefel_t,
+}
+
+
+def pallas_supported(objective_name: str, dtype) -> bool:
+    """True if the fused kernel covers this config (else use ops/pso.py)."""
+    return objective_name in OBJECTIVES_T and jnp.dtype(dtype) == jnp.float32
+
+
+# --------------------------------------------------------------------------
+# Kernel body
+# --------------------------------------------------------------------------
+
+
+def _uniform_bits(shape):
+    """U[0,1) from the on-chip PRNG: exponent-trick bit twiddling."""
+    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    f = pltpu.bitcast((bits >> 9) | jnp.uint32(0x3F800000), jnp.float32)
+    return f - 1.0
+
+
+def _make_kernel(
+    objective_t: Callable,
+    w: float,
+    c1: float,
+    c2: float,
+    vmax: float,
+    half_width: float,
+    host_rng: bool,
+    k_steps: int = 1,
+):
+    def body(seed_ref, gbest_ref, pos_ref, vel_ref, bpos_ref, bfit_ref,
+             r1, r2, pos_o, vel_o, bpos_o, bfit_o, tfit_o, tpos_o):
+        pos, vel = pos_ref[:], vel_ref[:]
+        bpos, bfit = bpos_ref[:], bfit_ref[:]
+        g = gbest_ref[:]                        # [D,1] broadcasts over lanes
+
+        # k_steps iterations entirely in VMEM: HBM sees one read + one
+        # write of pos/vel/pbest per KERNEL, not per STEP.  gbest is held
+        # fixed within the block (delayed-gbest PSO — the same staleness a
+        # sharded swarm has between cross-device reductions).
+        for step in range(k_steps):
+            if host_rng:
+                rr1, rr2 = r1, r2
+            else:
+                rr1 = _uniform_bits(pos.shape)
+                rr2 = _uniform_bits(pos.shape)
+            vel = (
+                w * vel
+                + c1 * rr1 * (bpos - pos)
+                + c2 * rr2 * (g - pos)
+            )
+            vel = jnp.clip(vel, -vmax, vmax)
+            pos = jnp.clip(pos + vel, -half_width, half_width)
+
+            fit = objective_t(pos)              # [1, TILE_N]
+            improved = fit < bfit
+            bfit = jnp.where(improved, fit, bfit)
+            bpos = jnp.where(improved, pos, bpos)   # mask bcasts sublanes
+
+        pos_o[:] = pos
+        vel_o[:] = vel
+        bpos_o[:] = bpos
+        bfit_o[:] = bfit
+
+        # Running-best accumulator: the TPU grid executes sequentially on
+        # one core, so revisited output blocks (fixed index map) persist
+        # across programs — tfit_o/tpos_o hold the best over tiles 0..i.
+        tile_fit = jnp.min(bfit)
+        k = jnp.argmin(bfit[0, :])
+        col = jax.lax.broadcasted_iota(jnp.int32, bfit.shape, 1)
+        cand = jnp.sum(jnp.where(col == k, bpos, 0.0), axis=1, keepdims=True)
+
+        first = pl.program_id(0) == 0
+
+        @pl.when(first)
+        def _():
+            tfit_o[0, 0] = tile_fit
+            tpos_o[:] = cand
+
+        # At program 0 the ref read below sees uninitialized memory, but
+        # `first` being True already forces the predicate False there.
+        @pl.when(jnp.logical_not(first) & (tile_fit < tfit_o[0, 0]))
+        def _():
+            tfit_o[0, 0] = tile_fit
+            tpos_o[:] = cand
+
+    if host_rng:
+        def kernel(seed_ref, gbest_ref, pos_ref, vel_ref, bpos_ref,
+                   bfit_ref, r1_ref, r2_ref, *outs):
+            body(seed_ref, gbest_ref, pos_ref, vel_ref, bpos_ref, bfit_ref,
+                 r1_ref[:], r2_ref[:], *outs)
+    else:
+        def kernel(seed_ref, gbest_ref, pos_ref, vel_ref, bpos_ref,
+                   bfit_ref, *outs):
+            # Distinct stream per (kernel call, tile): caller advances the
+            # base seed by n_tiles per call; the on-chip stream advances
+            # across the k_steps draws within the call.
+            pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
+            body(seed_ref, gbest_ref, pos_ref, vel_ref, bpos_ref, bfit_ref,
+                 None, None, *outs)
+
+    return kernel
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "w", "c1", "c2", "half_width", "vmax_frac",
+        "tile_n", "rng", "interpret", "k_steps",
+    ),
+)
+def fused_pso_step_t(
+    seed: jax.Array,          # i32 scalar — base PRNG seed for this call
+    gbest_pos: jax.Array,     # [D, 1]
+    pos: jax.Array,           # [D, N]   (N a multiple of tile_n)
+    vel: jax.Array,           # [D, N]
+    bpos: jax.Array,          # [D, N]
+    bfit: jax.Array,          # [1, N]
+    r1: jax.Array | None = None,   # [D, N] uniforms when rng="host"
+    r2: jax.Array | None = None,
+    *,
+    objective_name: str,
+    w: float = W,
+    c1: float = C1,
+    c2: float = C2,
+    half_width: float = 5.12,
+    vmax_frac: float = 0.5,
+    tile_n: int = DEFAULT_TILE_N,
+    rng: str = "tpu",
+    interpret: bool = False,
+    k_steps: int = 1,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """``k_steps`` fused PSO iterations in transposed layout, one HBM pass.
+
+    Returns ``(pos, vel, bpos, bfit, best_fit[1, 1], best_pos[D, 1])``
+    where best_* is the swarm-wide best candidate after the block (reduced
+    across tiles inside the kernel); the caller merges it into gbest.
+    gbest is constant within the block (delayed-gbest PSO).
+    """
+    d, n = pos.shape
+    if n % tile_n:
+        raise ValueError(f"N ({n}) must be a multiple of tile_n ({tile_n})")
+    n_tiles = n // tile_n
+    host_rng = rng == "host"
+    if host_rng and (r1 is None or r2 is None):
+        raise ValueError('rng="host" requires r1 and r2')
+    if host_rng and k_steps != 1:
+        raise ValueError('rng="host" supports k_steps=1 only')
+
+    kernel = _make_kernel(
+        OBJECTIVES_T[objective_name], w, c1, c2,
+        half_width * vmax_frac, half_width, host_rng, k_steps,
+    )
+
+    col_block = lambda i, s: (0, i)          # noqa: E731
+    fixed = lambda i, s: (0, 0)              # noqa: E731
+    dn_spec = pl.BlockSpec((d, tile_n), col_block, memory_space=pltpu.VMEM)
+    fit_spec = pl.BlockSpec((1, tile_n), col_block, memory_space=pltpu.VMEM)
+
+    in_specs = [
+        pl.BlockSpec((d, 1), fixed, memory_space=pltpu.VMEM),   # gbest
+        dn_spec, dn_spec, dn_spec, fit_spec,                    # pos/vel/bpos/bfit
+    ]
+    operands = [gbest_pos, pos, vel, bpos, bfit]
+    if host_rng:
+        in_specs += [dn_spec, dn_spec]
+        operands += [r1, r2]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=in_specs,
+        out_specs=[
+            dn_spec, dn_spec, dn_spec, fit_spec,
+            pl.BlockSpec((1, 1), fixed, memory_space=pltpu.SMEM),
+            pl.BlockSpec((d, 1), fixed, memory_space=pltpu.VMEM),
+        ],
+    )
+    f32 = jnp.float32
+    out_shape = [
+        jax.ShapeDtypeStruct((d, n), f32),
+        jax.ShapeDtypeStruct((d, n), f32),
+        jax.ShapeDtypeStruct((d, n), f32),
+        jax.ShapeDtypeStruct((1, n), f32),
+        jax.ShapeDtypeStruct((1, 1), f32),
+        jax.ShapeDtypeStruct((d, 1), f32),
+    ]
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(jnp.reshape(seed.astype(jnp.int32), (1,)), *operands)
+
+
+# --------------------------------------------------------------------------
+# Driver: PSOState in, PSOState out — drop-in fast path for ops/pso.pso_run
+# --------------------------------------------------------------------------
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "n_steps", "w", "c1", "c2", "half_width",
+        "vmax_frac", "tile_n", "rng", "interpret", "steps_per_kernel",
+    ),
+)
+def fused_pso_run(
+    state: PSOState,
+    objective_name: str,
+    n_steps: int,
+    w: float = W,
+    c1: float = C1,
+    c2: float = C2,
+    half_width: float = 5.12,
+    vmax_frac: float = 0.5,
+    tile_n: int | None = None,
+    rng: str = "tpu",
+    interpret: bool = False,
+    steps_per_kernel: int = 8,
+) -> PSOState:
+    """``n_steps`` fused iterations under one ``lax.scan``.
+
+    Transposes to the kernel's ``[D, N]`` layout once, scans blocks of
+    ``steps_per_kernel`` in-VMEM iterations (HBM traffic drops by that
+    factor; gbest refreshes between blocks), transposes back — same
+    PSOState contract as ``ops.pso.pso_run`` (trajectories differ only in
+    RNG stream and gbest refresh cadence).  If N is not a multiple of the
+    lane tile, the swarm is padded by *duplicating leading particles*:
+    duplicates are legal particles, so the swarm optimum is preserved (min
+    over a multiset superset built from existing members cannot be worse,
+    and the padded state is sliced off on return).
+    """
+    n, d = state.pos.shape
+    if rng == "host":
+        steps_per_kernel = 1       # host mode feeds one r1/r2 pair per call
+    if tile_n is None:
+        tile_n = _auto_tile(_ceil_to(max(d, 8), 8))
+    tile_n = min(tile_n, _ceil_to(n, 128))
+    n_pad = _ceil_to(n, tile_n)
+    pad = n_pad - n
+
+    # Cyclic padding handles pad >= n too (tiny swarms on a 128-lane tile).
+    reps = -(-n_pad // n)
+
+    def prep(x_nd):
+        x = x_nd.astype(jnp.float32)
+        if pad:
+            x = jnp.tile(x, (reps, 1))[:n_pad]
+        return x.T
+
+    pos_t = prep(state.pos)
+    vel_t = prep(state.vel)
+    bpos_t = prep(state.pbest_pos)
+    bfit = state.pbest_fit.astype(jnp.float32)
+    if pad:
+        bfit = jnp.tile(bfit, reps)[:n_pad]
+    bfit_t = bfit[None, :]
+
+    n_tiles = n_pad // tile_n
+    seed0 = jax.random.randint(
+        state.key, (), 0, jnp.iinfo(jnp.int32).max, dtype=jnp.int32
+    )
+
+    if rng == "host":
+        run_key = jax.random.fold_in(state.key, 0x5EED)
+
+    def block(carry, call_i, k):
+        pos_t, vel_t, bpos_t, bfit_t, gpos, gfit = carry
+        seed = seed0 + call_i * n_tiles
+        if rng == "host":
+            kk = jax.random.fold_in(run_key, call_i)
+            k1, k2 = jax.random.split(kk)
+            r1 = jax.random.uniform(k1, pos_t.shape, jnp.float32)
+            r2 = jax.random.uniform(k2, pos_t.shape, jnp.float32)
+        else:
+            r1 = r2 = None
+        pos_t, vel_t, bpos_t, bfit_t, bf, bp = fused_pso_step_t(
+            seed, gpos[:, None], pos_t, vel_t, bpos_t, bfit_t, r1, r2,
+            objective_name=objective_name, w=w, c1=c1, c2=c2,
+            half_width=half_width, vmax_frac=vmax_frac, tile_n=tile_n,
+            rng=rng, interpret=interpret, k_steps=k,
+        )
+        cand_fit, cand_pos = bf[0, 0], bp[:, 0]
+        better = cand_fit < gfit
+        gfit = jnp.where(better, cand_fit, gfit)
+        gpos = jnp.where(better, cand_pos, gpos)
+        return (pos_t, vel_t, bpos_t, bfit_t, gpos, gfit), None
+
+    carry = (
+        pos_t, vel_t, bpos_t, bfit_t,
+        state.gbest_pos.astype(jnp.float32),
+        state.gbest_fit.astype(jnp.float32),
+    )
+    n_blocks, rem = divmod(n_steps, steps_per_kernel)
+    if n_blocks:
+        carry, _ = jax.lax.scan(
+            lambda c, i: block(c, i, steps_per_kernel),
+            carry,
+            jnp.arange(n_blocks, dtype=jnp.int32),
+        )
+    if rem:
+        carry, _ = block(carry, jnp.asarray(n_blocks, jnp.int32), rem)
+    pos_t, vel_t, bpos_t, bfit_t, gpos, gfit = carry
+
+    back = lambda x_t: x_t.T[:n].astype(state.pos.dtype)  # noqa: E731
+    return PSOState(
+        pos=back(pos_t),
+        vel=back(vel_t),
+        pbest_pos=back(bpos_t),
+        pbest_fit=bfit_t[0, :n].astype(state.pbest_fit.dtype),
+        gbest_pos=gpos.astype(state.gbest_pos.dtype),
+        gbest_fit=gfit.astype(state.gbest_fit.dtype),
+        key=jax.random.fold_in(state.key, n_steps),
+        iteration=state.iteration + n_steps,
+    )
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m

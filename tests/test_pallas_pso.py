@@ -1,0 +1,141 @@
+"""Fused Pallas PSO kernel: exact kernel math vs a NumPy oracle, the
+padding/convergence contract of the driver, and the model-level backend
+switch.  Runs the REAL kernel body on CPU via ``interpret=True`` with
+host-supplied RNG (rng="host") — the TPU variant differs only in drawing
+its uniforms from the on-chip PRNG."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_swarm_algorithm_tpu.models.pso import PSO
+from distributed_swarm_algorithm_tpu.ops.objectives import sphere, rastrigin
+from distributed_swarm_algorithm_tpu.ops.pallas.pso_fused import (
+    OBJECTIVES_T,
+    fused_pso_run,
+    fused_pso_step_t,
+    pallas_supported,
+)
+from distributed_swarm_algorithm_tpu.ops.pso import C1, C2, W, pso_init
+
+HW = 5.12
+VMAX_FRAC = 0.5
+
+
+def _numpy_oracle(pos, vel, bpos, bfit, gbest, r1, r2, objective):
+    """The exact update rule, [N, D] layout, plain NumPy."""
+    vmax = HW * VMAX_FRAC
+    vel = W * vel + C1 * r1 * (bpos - pos) + C2 * r2 * (gbest[None] - pos)
+    vel = np.clip(vel, -vmax, vmax)
+    pos = np.clip(pos + vel, -HW, HW)
+    fit = np.asarray(objective(jnp.asarray(pos)))
+    imp = fit < bfit
+    bfit = np.where(imp, fit, bfit)
+    bpos = np.where(imp[:, None], pos, bpos)
+    return pos, vel, bpos, bfit
+
+
+def test_fused_step_matches_numpy_oracle():
+    n, d = 256, 8
+    rng = np.random.default_rng(0)
+    pos = rng.uniform(-HW, HW, (n, d)).astype(np.float32)
+    vel = rng.uniform(-1, 1, (n, d)).astype(np.float32)
+    bpos = rng.uniform(-HW, HW, (n, d)).astype(np.float32)
+    bfit = np.asarray(sphere(jnp.asarray(bpos)))
+    gbest = bpos[np.argmin(bfit)]
+    r1 = rng.uniform(size=(n, d)).astype(np.float32)
+    r2 = rng.uniform(size=(n, d)).astype(np.float32)
+
+    out = fused_pso_step_t(
+        jnp.asarray(0), jnp.asarray(gbest)[:, None],
+        jnp.asarray(pos.T), jnp.asarray(vel.T), jnp.asarray(bpos.T),
+        jnp.asarray(bfit)[None, :],
+        jnp.asarray(r1.T), jnp.asarray(r2.T),
+        objective_name="sphere", half_width=HW, vmax_frac=VMAX_FRAC,
+        tile_n=128, rng="host", interpret=True,
+    )
+    pos_o, vel_o, bpos_o, bfit_o, best_fit, best_pos = out
+
+    e_pos, e_vel, e_bpos, e_bfit = _numpy_oracle(
+        pos, vel, bpos, bfit, gbest, r1, r2, sphere
+    )
+    np.testing.assert_allclose(np.asarray(pos_o).T, e_pos, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(vel_o).T, e_vel, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(bpos_o).T, e_bpos, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(bfit_o)[0], e_bfit, atol=1e-5)
+
+    # The in-kernel cross-tile reduction found the true swarm best.
+    np.testing.assert_allclose(
+        float(best_fit[0, 0]), float(e_bfit.min()), atol=1e-5
+    )
+    k = int(np.argmin(e_bfit))
+    np.testing.assert_allclose(
+        np.asarray(best_pos)[:, 0], e_bpos[k], atol=1e-5
+    )
+
+
+def test_transposed_objectives_match_portable():
+    from distributed_swarm_algorithm_tpu.ops.objectives import OBJECTIVES
+
+    rng = np.random.default_rng(1)
+    x = rng.uniform(-2, 2, (64, 12)).astype(np.float32)
+    for name, obj_t in OBJECTIVES_T.items():
+        fn, _ = OBJECTIVES[name]
+        want = np.asarray(fn(jnp.asarray(x)))
+        got = np.asarray(obj_t(jnp.asarray(x.T)))[0]
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_fused_run_converges_and_pads():
+    # n=300 is not lane-aligned: exercises the duplicate-particle padding.
+    st = pso_init(sphere, n=300, dim=5, half_width=HW, seed=0)
+    out = fused_pso_run(
+        st, "sphere", 103, half_width=HW, rng="host", interpret=True
+    )
+    assert out.pos.shape == (300, 5)
+    assert int(out.iteration) == 103
+    assert float(out.gbest_fit) < 1e-4
+    assert bool((jnp.abs(out.pos) <= HW + 1e-5).all())
+    # gbest is the min over a superset of the real particles' pbest.
+    assert float(out.gbest_fit) <= float(out.pbest_fit.min()) + 1e-6
+
+
+def test_fused_run_tiny_swarm_pad_exceeds_n():
+    # n=50 < the 128-lane minimum tile: cyclic padding must cover pad > n.
+    st = pso_init(sphere, n=50, dim=5, half_width=HW, seed=1)
+    out = fused_pso_run(
+        st, "sphere", 30, half_width=HW, rng="host", interpret=True
+    )
+    assert out.pos.shape == (50, 5)
+    assert float(out.gbest_fit) <= float(st.gbest_fit) + 1e-6
+
+
+def test_fused_run_gbest_monotone():
+    st = pso_init(rastrigin, n=256, dim=6, half_width=HW, seed=3)
+    prev = float(st.gbest_fit)
+    s = st
+    for _ in range(4):
+        s = fused_pso_run(
+            s, "rastrigin", 10, half_width=HW, rng="host", interpret=True
+        )
+        cur = float(s.gbest_fit)
+        assert cur <= prev + 1e-6
+        prev = cur
+
+
+def test_pallas_supported_matrix():
+    assert pallas_supported("rastrigin", jnp.float32)
+    assert not pallas_supported("rastrigin", jnp.bfloat16)
+    assert not pallas_supported("not_an_objective", jnp.float32)
+
+
+def test_pso_model_pallas_backend_on_cpu():
+    opt = PSO("sphere", n=256, dim=4, seed=0, use_pallas=True)
+    opt.run(60)
+    assert opt.best < 1e-3
+
+
+def test_pso_model_rejects_pallas_for_callable_objective():
+    with pytest.raises(ValueError):
+        PSO(sphere, n=64, dim=4, seed=0, use_pallas=True)

@@ -9,11 +9,7 @@ import jax
 from ..ops import pso as _k
 from ..ops.objectives import get_objective
 from ..ops.pallas import pso_fused as _pf
-
-
-def _on_tpu() -> bool:
-    d = jax.devices()[0]
-    return "tpu" in d.device_kind.lower() or d.platform in ("tpu", "axon")
+from ..utils.platform import on_tpu as _on_tpu
 
 
 class PSO:

@@ -8,6 +8,9 @@ does on its own:
   - ``pso_fused``: blocks of whole PSO iterations (RNG + velocity/position
     update + fitness + pbest + cross-tile best reduction) as ONE pass over
     HBM, in a lane-aligned ``[D, N]`` layout with the TPU hardware PRNG.
+  - ``separation``: tiled all-pairs neighbor-separation forces that never
+    materialize the O(N^2) pairwise tensor in HBM
+    (``cfg.separation_mode="pallas"`` in ops/physics.py).
 
 Every kernel has a host/interpret mode so the test suite exercises the
 exact kernel bodies on CPU (tests/conftest.py pins JAX to CPU).
@@ -19,3 +22,4 @@ from .pso_fused import (  # noqa: F401
     fused_pso_step_t,
     pallas_supported,
 )
+from .separation import separation_pallas  # noqa: F401

@@ -1,0 +1,8 @@
+"""Shared helpers for the Pallas kernel modules."""
+
+from __future__ import annotations
+
+
+def ceil_to(x: int, m: int) -> int:
+    """Round ``x`` up to the next multiple of ``m``."""
+    return ((x + m - 1) // m) * m

@@ -44,6 +44,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..pso import C1, C2, W, PSOState
+from .common import ceil_to as _ceil_to
 
 # Default lane tile on the particle axis; fused_pso_run shrinks it for
 # high-D problems via _auto_tile so all live [D, TILE_N] buffers (double-
@@ -436,7 +437,3 @@ def fused_pso_run(
         key=jax.random.fold_in(state.key, n_steps),
         iteration=state.iteration + n_steps,
     )
-
-
-def _ceil_to(x: int, m: int) -> int:
-    return ((x + m - 1) // m) * m

@@ -1,0 +1,133 @@
+"""Tiled all-pairs neighbor-separation forces as a Pallas TPU kernel.
+
+``ops/neighbors.py:separation_dense`` materializes the [N, N, D] pairwise
+difference tensor, so XLA spills it to HBM beyond a few thousand agents
+(at N=65536, D=2 that intermediate alone is 34 GB).  This kernel computes
+the same force exactly — mag = k_sep / d_c^2 along diff / d_c with every
+norm clamped at eps (the reference crashes on co-located agents,
+/root/reference/agent.py:148-160, SURVEY.md §5a bug 1) — but streams
+[TILE_I, TILE_J] blocks of the interaction matrix through VMEM and
+accumulates force partials into the [TILE_I, D] output block, which is
+revisited across the sequential j-sweep of the TPU grid.  HBM traffic is
+O(N * n_tiles) input reads, O(N * D) output writes, and zero pairwise
+intermediates.
+
+Exact semantics (mirrors separation_dense):
+    near(i,j) = alive_i & alive_j & (i != j) & (dist(i,j) < personal_space)
+    force_i   = sum_j near * k_sep * (pos_i - pos_j) / max(dist, eps)^3
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .common import ceil_to as _ceil_to
+
+DEFAULT_TILE_I = 256
+DEFAULT_TILE_J = 1024
+
+
+def _make_kernel(dim, tile_i, tile_j, k_sep, r2_cut, eps2):
+    def kernel(pos_ref, post_ref, alive_ref, alivet_ref, out_ref):
+        i, j = pl.program_id(0), pl.program_id(1)
+        pi = pos_ref[:]          # [TILE_I, D]
+        pjt = post_ref[:]        # [D, TILE_J]
+
+        # Squared distances, one [TILE_I, TILE_J] plane per axis; the
+        # per-axis differences are recomputed in the force loop below to
+        # keep only two planes live at a time in VMEM.
+        d2 = jnp.zeros((tile_i, tile_j), jnp.float32)
+        for d in range(dim):
+            dx = pi[:, d : d + 1] - pjt[d : d + 1, :]
+            d2 = d2 + dx * dx
+
+        row = jax.lax.broadcasted_iota(jnp.int32, (tile_i, tile_j), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (tile_i, tile_j), 1)
+        not_self = (row + i * tile_i) != (col + j * tile_j)
+        near = (
+            not_self
+            & (d2 < r2_cut)
+            & (alive_ref[:] > 0.0)       # [TILE_I, 1] broadcasts
+            & (alivet_ref[:] > 0.0)      # [1, TILE_J] broadcasts
+        )
+        inv = jax.lax.rsqrt(jnp.maximum(d2, eps2))
+        mag = jnp.where(near, k_sep * inv * inv * inv, 0.0)
+
+        parts = []
+        for d in range(dim):
+            dx = pi[:, d : d + 1] - pjt[d : d + 1, :]
+            parts.append(jnp.sum(mag * dx, axis=1, keepdims=True))
+        acc = jnp.concatenate(parts, axis=1)     # [TILE_I, D]
+
+        @pl.when(j == 0)
+        def _():
+            out_ref[:] = acc
+
+        @pl.when(j > 0)
+        def _():
+            out_ref[:] = out_ref[:] + acc
+
+    return kernel
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "k_sep", "personal_space", "eps", "tile_i", "tile_j", "interpret",
+    ),
+)
+def separation_pallas(
+    pos: jax.Array,            # [N, D]
+    alive: jax.Array,          # [N] bool
+    k_sep: float,
+    personal_space: float,
+    eps: float,
+    tile_i: int = DEFAULT_TILE_I,
+    tile_j: int = DEFAULT_TILE_J,
+    interpret: bool = False,
+) -> jax.Array:
+    """All-pairs separation force [N, D] without O(N^2) HBM intermediates.
+
+    Drop-in replacement for ``neighbors.separation_dense``; pads N up to
+    the tile grid with dead agents (zero force contribution).
+    """
+    n, dim = pos.shape
+    tile_j = min(tile_j, _ceil_to(n, 128))
+    tile_i = min(tile_i, tile_j)
+    while tile_j % tile_i:       # tile_i must divide tile_j (shared n_pad)
+        tile_i //= 2
+    n_pad = _ceil_to(n, tile_j)
+    f32 = jnp.float32
+
+    pos_p = jnp.zeros((n_pad, dim), f32).at[:n].set(pos.astype(f32))
+    alive_f = jnp.zeros((n_pad,), f32).at[:n].set(alive.astype(f32))
+
+    grid = (n_pad // tile_i, n_pad // tile_j)
+    kernel = _make_kernel(
+        dim, tile_i, tile_j, float(k_sep),
+        float(personal_space) ** 2, float(eps) ** 2,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_i, dim), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((dim, tile_j), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_i, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile_j), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile_i, dim), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_pad, dim), f32),
+        interpret=interpret,
+    )(pos_p, pos_p.T, alive_f[:, None], alive_f[None, :])
+    return out[:n].astype(pos.dtype)

@@ -129,8 +129,23 @@ def apf_forces(
             pos, state.alive, cfg.k_sep, cfg.personal_space, eps,
             cell=cfg.grid_cell, max_per_cell=cfg.grid_max_per_cell,
         )
-    else:
+    elif cfg.separation_mode == "pallas":
+        from .pallas.separation import separation_pallas
+        from ..utils.platform import on_tpu
+
+        # The kernel takes eps as a static Python float (baked into the
+        # Mosaic program); semantics match the `eps` array used above.
+        f_sep = separation_pallas(
+            pos, state.alive, float(cfg.k_sep), float(cfg.personal_space),
+            float(cfg.dist_eps), interpret=not on_tpu(),
+        )
+    elif cfg.separation_mode == "off":
         f_sep = jnp.zeros_like(pos)
+    else:
+        raise ValueError(
+            f"unknown separation_mode {cfg.separation_mode!r}; "
+            "expected 'dense', 'pallas', 'grid', or 'off'"
+        )
 
     return f_att + f_rep + f_sep
 

@@ -78,7 +78,12 @@ class SwarmConfig:
     #   only when beaten by claim_hysteresis — live reallocation.
 
     # --- scale / numerics -------------------------------------------------
-    separation_mode: str = "dense"      # "dense" O(N²) | "grid" | "off"
+    separation_mode: str = "dense"
+    #   "dense": exact all-pairs via [N,N,D] broadcast — small swarms.
+    #   "pallas": exact all-pairs, tiled Pallas TPU kernel, no O(N²) HBM
+    #     intermediates — large swarms on chip (ops/pallas/separation.py).
+    #   "grid": spatial-hash approximation for very large N.
+    #   "off": no separation force.
     grid_cell: float = 2.0              # spatial-hash cell for "grid" mode
     grid_max_per_cell: int = 8          # bucket capacity for "grid" mode
     dtype: str = "float32"

@@ -1,0 +1,13 @@
+"""Backend detection shared by the Pallas dispatch points."""
+
+from __future__ import annotations
+
+import jax
+
+
+def on_tpu() -> bool:
+    """True when the default JAX backend is a real TPU (incl. the axon
+    tunnel used in this environment), i.e. compiled Pallas TPU kernels can
+    run; False on CPU/GPU where callers fall back to interpret mode."""
+    d = jax.devices()[0]
+    return "tpu" in d.device_kind.lower() or d.platform in ("tpu", "axon")

@@ -1,0 +1,89 @@
+"""Tiled Pallas separation kernel vs the dense all-pairs oracle.
+
+Runs the real kernel body on CPU via ``interpret=True`` (conftest pins
+CPU); the TPU build is the same Mosaic program compiled instead of
+interpreted."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_swarm_algorithm_tpu import (
+    DEFAULT_CONFIG,
+    make_swarm,
+    physics_step,
+)
+from distributed_swarm_algorithm_tpu.ops.neighbors import separation_dense
+from distributed_swarm_algorithm_tpu.ops.pallas.separation import (
+    separation_pallas,
+)
+
+K_SEP, R, EPS = 20.0, 2.0, 1e-3
+
+
+def _random_swarm(n, d, seed, co_locate=False):
+    rng = np.random.default_rng(seed)
+    pos = rng.uniform(-5, 5, (n, d)).astype(np.float32)
+    if co_locate:  # reference's default spawn: identical positions (§5a bug 1)
+        pos[1] = pos[0]
+        pos[2] = pos[0]
+    alive = rng.random(n) > 0.2
+    alive[0] = True
+    return jnp.asarray(pos), jnp.asarray(alive)
+
+
+def _check(n, d, seed, co_locate=False, tile_i=64, tile_j=128):
+    pos, alive = _random_swarm(n, d, seed, co_locate)
+    want = separation_dense(pos, alive, K_SEP, R, EPS)
+    got = separation_pallas(
+        pos, alive, K_SEP, R, EPS,
+        tile_i=tile_i, tile_j=tile_j, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_matches_dense_2d():
+    _check(n=256, d=2, seed=0)
+
+
+def test_matches_dense_3d():
+    _check(n=192, d=3, seed=1)
+
+
+def test_matches_dense_unaligned_n():
+    # n=300 not a multiple of any tile: exercises dead-agent padding.
+    _check(n=300, d=2, seed=2)
+
+
+def test_matches_dense_tiny_n():
+    _check(n=20, d=2, seed=5)
+
+
+def test_colocated_agents_no_nan():
+    # The reference's ZeroDivisionError regime: identical positions.
+    _check(n=128, d=2, seed=3, co_locate=True)
+    pos, alive = _random_swarm(128, 2, 3, co_locate=True)
+    out = separation_pallas(pos, alive, K_SEP, R, EPS, interpret=True)
+    assert bool(jnp.isfinite(out).all())
+
+
+def test_dead_agents_feel_and_exert_nothing():
+    pos, alive = _random_swarm(64, 2, 4)
+    out = separation_pallas(pos, alive, K_SEP, R, EPS, interpret=True)
+    dead = ~np.asarray(alive)
+    np.testing.assert_allclose(np.asarray(out)[dead], 0.0)
+
+
+def test_physics_step_pallas_mode_matches_dense():
+    s = make_swarm(96, seed=0, spread=4.0)
+    s = s.replace(
+        target=s.pos + 1.0, has_target=jnp.ones(96, bool),
+    )
+    cfg_d = DEFAULT_CONFIG.replace(separation_mode="dense")
+    cfg_p = DEFAULT_CONFIG.replace(separation_mode="pallas")
+    out_d = physics_step(s, None, cfg_d)
+    out_p = physics_step(s, None, cfg_p)
+    np.testing.assert_allclose(
+        np.asarray(out_p.pos), np.asarray(out_d.pos), rtol=1e-4, atol=1e-5
+    )

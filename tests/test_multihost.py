@@ -1,0 +1,55 @@
+"""Multi-host mesh helpers on the 8-virtual-device CPU harness."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_swarm_algorithm_tpu.parallel.multihost import (
+    coord_print,
+    hybrid_mesh,
+    is_coordinator,
+)
+
+
+def test_hybrid_mesh_shape_single_process():
+    # Single process, 8 devices: islands axis = n_proc * islands_per_host.
+    mesh = hybrid_mesh(islands_per_host=2)
+    assert mesh.axis_names == ("islands", "agents")
+    assert mesh.devices.shape == (2, 4)
+    # Device order keeps each island's group contiguous (ICI-local).
+    flat = [d.id for d in mesh.devices.reshape(-1)]
+    assert flat == sorted(flat)
+
+
+def test_hybrid_mesh_rejects_bad_split():
+    with pytest.raises(ValueError):
+        hybrid_mesh(islands_per_host=3)   # 3 does not divide 8
+
+
+def test_hybrid_mesh_collectives_ride_axes():
+    mesh = hybrid_mesh(islands_per_host=4)           # (4, 2)
+    x = jnp.arange(8.0).reshape(4, 2)
+    xs = jax.device_put(x, NamedSharding(mesh, P("islands", "agents")))
+
+    from jax import shard_map
+
+    @jax.jit
+    def global_min(v):
+        f = shard_map(
+            lambda a: jax.lax.pmin(jax.lax.pmin(a, "agents"), "islands"),
+            mesh=mesh,
+            in_specs=P("islands", "agents"),
+            out_specs=P("islands", "agents"),
+        )
+        return f(v)
+
+    out = global_min(xs)
+    np.testing.assert_allclose(np.asarray(out), 0.0)
+
+
+def test_coordinator_guards(capsys):
+    assert is_coordinator()               # single-process: process 0
+    coord_print("hello-from-coordinator")
+    assert "hello-from-coordinator" in capsys.readouterr().out

@@ -317,6 +317,88 @@ def fused_pso_step_t(
 
 
 # --------------------------------------------------------------------------
+# Shared driver plumbing — used by fused_pso_run here and by the sharded
+# fused_pso_run_shmap (parallel/sharding.py).  Kept in ONE place because
+# the invariants are subtle: cyclic padding preserves the swarm optimum,
+# and seed spacing must keep (call, device, tile) PRNG streams disjoint.
+# --------------------------------------------------------------------------
+
+
+def prep_padded_t(state: PSOState, n_pad: int):
+    """State → transposed f32 arrays ``(pos_t, vel_t, bpos_t, bfit_t)`` of
+    lane width ``n_pad``.  Padding duplicates leading particles cyclically:
+    duplicates are legal particles, so the swarm optimum is preserved (the
+    min over a multiset superset of the real particles cannot be worse)."""
+    n = state.pos.shape[0]
+    reps = -(-n_pad // n)
+
+    def pad2(x):
+        x = x.astype(jnp.float32)
+        return jnp.tile(x, (reps, 1))[:n_pad] if n_pad != n else x
+
+    bfit = state.pbest_fit.astype(jnp.float32)
+    if n_pad != n:
+        bfit = jnp.tile(bfit, reps)[:n_pad]
+    return (
+        pad2(state.pos).T, pad2(state.vel).T, pad2(state.pbest_pos).T,
+        bfit[None, :],
+    )
+
+
+def seed_base(key: jax.Array) -> jax.Array:
+    """i32 base seed for the on-chip PRNG, derived from the state key."""
+    return jax.random.randint(
+        key, (), 0, jnp.iinfo(jnp.int32).max, dtype=jnp.int32
+    )
+
+
+def host_uniforms(host_key, call_i, shape, fold=None):
+    """(r1, r2) for rng="host" mode, unique per (call, optional device)."""
+    kk = jax.random.fold_in(host_key, call_i)
+    if fold is not None:
+        kk = jax.random.fold_in(kk, fold)
+    k1, k2 = jax.random.split(kk)
+    return (
+        jax.random.uniform(k1, shape, jnp.float32),
+        jax.random.uniform(k2, shape, jnp.float32),
+    )
+
+
+def run_blocks(block, carry, n_steps: int, steps_per_kernel: int):
+    """Scan ``block(carry, call_i, k) -> carry`` over full k-step blocks,
+    then once more for the remainder (a separate kernel specialization)."""
+    n_blocks, rem = divmod(n_steps, steps_per_kernel)
+    if n_blocks:
+        carry, _ = jax.lax.scan(
+            lambda c, i: (block(c, i, steps_per_kernel), None),
+            carry,
+            jnp.arange(n_blocks, dtype=jnp.int32),
+        )
+    if rem:
+        carry = block(carry, jnp.asarray(n_blocks, jnp.int32), rem)
+    return carry
+
+
+def rebuild_state(
+    state: PSOState, pos_t, vel_t, bpos_t, bfit_t, gpos, gfit, n_steps: int
+) -> PSOState:
+    """Transposed padded arrays → PSOState with the original n and dtypes."""
+    n = state.pos.shape[0]
+    dt = state.pos.dtype
+    back = lambda x_t: x_t.T[:n].astype(dt)  # noqa: E731
+    return PSOState(
+        pos=back(pos_t),
+        vel=back(vel_t),
+        pbest_pos=back(bpos_t),
+        pbest_fit=bfit_t[0, :n].astype(state.pbest_fit.dtype),
+        gbest_pos=gpos.astype(state.gbest_pos.dtype),
+        gbest_fit=gfit.astype(state.gbest_fit.dtype),
+        key=jax.random.fold_in(state.key, n_steps),
+        iteration=state.iteration + n_steps,
+    )
+
+
+# --------------------------------------------------------------------------
 # Driver: PSOState in, PSOState out — drop-in fast path for ops/pso.pso_run
 # --------------------------------------------------------------------------
 
@@ -361,43 +443,18 @@ def fused_pso_run(
         tile_n = _auto_tile(_ceil_to(max(d, 8), 8))
     tile_n = min(tile_n, _ceil_to(n, 128))
     n_pad = _ceil_to(n, tile_n)
-    pad = n_pad - n
-
-    # Cyclic padding handles pad >= n too (tiny swarms on a 128-lane tile).
-    reps = -(-n_pad // n)
-
-    def prep(x_nd):
-        x = x_nd.astype(jnp.float32)
-        if pad:
-            x = jnp.tile(x, (reps, 1))[:n_pad]
-        return x.T
-
-    pos_t = prep(state.pos)
-    vel_t = prep(state.vel)
-    bpos_t = prep(state.pbest_pos)
-    bfit = state.pbest_fit.astype(jnp.float32)
-    if pad:
-        bfit = jnp.tile(bfit, reps)[:n_pad]
-    bfit_t = bfit[None, :]
-
     n_tiles = n_pad // tile_n
-    seed0 = jax.random.randint(
-        state.key, (), 0, jnp.iinfo(jnp.int32).max, dtype=jnp.int32
-    )
 
-    if rng == "host":
-        run_key = jax.random.fold_in(state.key, 0x5EED)
+    pos_t, vel_t, bpos_t, bfit_t = prep_padded_t(state, n_pad)
+    seed0 = seed_base(state.key)
+    host_key = jax.random.fold_in(state.key, 0x5EED)
 
     def block(carry, call_i, k):
         pos_t, vel_t, bpos_t, bfit_t, gpos, gfit = carry
         seed = seed0 + call_i * n_tiles
+        r1 = r2 = None
         if rng == "host":
-            kk = jax.random.fold_in(run_key, call_i)
-            k1, k2 = jax.random.split(kk)
-            r1 = jax.random.uniform(k1, pos_t.shape, jnp.float32)
-            r2 = jax.random.uniform(k2, pos_t.shape, jnp.float32)
-        else:
-            r1 = r2 = None
+            r1, r2 = host_uniforms(host_key, call_i, pos_t.shape)
         pos_t, vel_t, bpos_t, bfit_t, bf, bp = fused_pso_step_t(
             seed, gpos[:, None], pos_t, vel_t, bpos_t, bfit_t, r1, r2,
             objective_name=objective_name, w=w, c1=c1, c2=c2,
@@ -408,32 +465,15 @@ def fused_pso_run(
         better = cand_fit < gfit
         gfit = jnp.where(better, cand_fit, gfit)
         gpos = jnp.where(better, cand_pos, gpos)
-        return (pos_t, vel_t, bpos_t, bfit_t, gpos, gfit), None
+        return (pos_t, vel_t, bpos_t, bfit_t, gpos, gfit)
 
-    carry = (
-        pos_t, vel_t, bpos_t, bfit_t,
-        state.gbest_pos.astype(jnp.float32),
-        state.gbest_fit.astype(jnp.float32),
+    carry = run_blocks(
+        block,
+        (
+            pos_t, vel_t, bpos_t, bfit_t,
+            state.gbest_pos.astype(jnp.float32),
+            state.gbest_fit.astype(jnp.float32),
+        ),
+        n_steps, steps_per_kernel,
     )
-    n_blocks, rem = divmod(n_steps, steps_per_kernel)
-    if n_blocks:
-        carry, _ = jax.lax.scan(
-            lambda c, i: block(c, i, steps_per_kernel),
-            carry,
-            jnp.arange(n_blocks, dtype=jnp.int32),
-        )
-    if rem:
-        carry, _ = block(carry, jnp.asarray(n_blocks, jnp.int32), rem)
-    pos_t, vel_t, bpos_t, bfit_t, gpos, gfit = carry
-
-    back = lambda x_t: x_t.T[:n].astype(state.pos.dtype)  # noqa: E731
-    return PSOState(
-        pos=back(pos_t),
-        vel=back(vel_t),
-        pbest_pos=back(bpos_t),
-        pbest_fit=bfit_t[0, :n].astype(state.pbest_fit.dtype),
-        gbest_pos=gpos.astype(state.gbest_pos.dtype),
-        gbest_fit=gfit.astype(state.gbest_fit.dtype),
-        key=jax.random.fold_in(state.key, n_steps),
-        iteration=state.iteration + n_steps,
-    )
+    return rebuild_state(state, *carry, n_steps)

@@ -92,7 +92,10 @@ def hybrid_mesh(
     # put the per-tick 'agents' collectives on the DCN): sort explicitly
     # by owning process, stably, so each host's devices form one row group.
     devices = sorted(devices, key=lambda d: (d.process_index, d.id))
-    n_proc = max(jax.process_count(), 1)
+    # Derive the host split from the devices actually given (a subset may
+    # span fewer processes than the whole job — jax.process_count() would
+    # then cut the islands axis inside a host).
+    n_proc = max(len({d.process_index for d in devices}), 1)
     local = len(devices) // n_proc
     if local * n_proc != len(devices):
         raise ValueError(

@@ -188,6 +188,121 @@ def pso_run_shmap(
     return state
 
 
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "mesh", "n_steps", "axis", "w", "c1", "c2",
+        "half_width", "vmax_frac", "steps_per_kernel", "tile_n", "rng",
+        "interpret",
+    ),
+)
+def fused_pso_run_shmap(
+    state: _pso.PSOState,
+    objective_name: str,
+    mesh: Mesh,
+    n_steps: int,
+    axis: str = AGENT_AXIS,
+    w: float = _pso.W,
+    c1: float = _pso.C1,
+    c2: float = _pso.C2,
+    half_width: float = 5.12,
+    vmax_frac: float = 0.5,
+    steps_per_kernel: int = 8,
+    tile_n: int | None = None,
+    rng: str = "tpu",
+    interpret: bool = False,
+) -> _pso.PSOState:
+    """Multi-chip fused-Pallas PSO: each device runs ``steps_per_kernel``
+    in-VMEM iterations of the fused kernel (ops/pallas/pso_fused.py) on its
+    particle shard, then the shards exchange the global best over ICI
+    (``pmin`` value + ``psum`` position broadcast) — the per-block gbest
+    staleness of the single-chip kernel and the cross-device reduction
+    cadence coincide, so multi-chip costs no extra semantic delay.
+
+    N is padded (cyclic particle duplication, optimum-preserving) to
+    devices × lane-tile.  On CPU meshes pass ``rng="host",
+    interpret=True`` (tests do).  All padding/seed/loop/reassembly
+    invariants are shared with the single-chip driver via the helpers in
+    ops/pallas/pso_fused.py; only the gbest merge differs (collectives
+    here, local compare there).
+    """
+    from ..ops.pallas.common import ceil_to
+    from ..ops.pallas.pso_fused import (
+        _auto_tile,
+        fused_pso_step_t,
+        host_uniforms,
+        prep_padded_t,
+        rebuild_state,
+        run_blocks,
+        seed_base,
+    )
+
+    n, d = state.pos.shape
+    n_dev = mesh.shape[axis]
+    if rng == "host":
+        steps_per_kernel = 1
+    if tile_n is None:
+        tile_n = _auto_tile(ceil_to(max(d, 8), 8))
+    tile_n = min(tile_n, ceil_to(-(-n // n_dev), 128))
+    n_pad = ceil_to(n, n_dev * tile_n)
+    n_tiles_local = (n_pad // n_dev) // tile_n
+
+    pos_t, vel_t, bpos_t, bfit_t = prep_padded_t(state, n_pad)
+    seed0 = seed_base(state.key)
+    host_key = jax.random.fold_in(state.key, 0x5EED)
+
+    col = P(None, axis)   # transposed layout: particles on the last axis
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(col, col, col, col, P(), P()),
+        out_specs=(col, col, col, col, P(), P()),
+        check_vma=False,
+    )
+    def run(pos_t, vel_t, bpos_t, bfit_t, gpos, gfit):
+        dev = lax.axis_index(axis)
+
+        def block(carry, call_i, k):
+            pos_t, vel_t, bpos_t, bfit_t, gpos, gfit = carry
+            seed = seed0 + (call_i * n_dev + dev) * n_tiles_local
+            r1 = r2 = None
+            if rng == "host":
+                r1, r2 = host_uniforms(
+                    host_key, call_i, pos_t.shape, fold=dev
+                )
+            pos_t, vel_t, bpos_t, bfit_t, bf, bp = fused_pso_step_t(
+                seed, gpos[:, None], pos_t, vel_t, bpos_t, bfit_t, r1, r2,
+                objective_name=objective_name, w=w, c1=c1, c2=c2,
+                half_width=half_width, vmax_frac=vmax_frac, tile_n=tile_n,
+                rng=rng, interpret=interpret, k_steps=k,
+            )
+            # Cross-device gbest: pmin the value, min-device tie-break,
+            # psum-broadcast the winner's position.
+            loc_fit, loc_pos = bf[0, 0], bp[:, 0]
+            gmin = lax.pmin(loc_fit, axis)
+            mine = loc_fit == gmin
+            win = lax.pmin(jnp.where(mine, dev, _BIG_I32), axis)
+            gcand = lax.psum(jnp.where(dev == win, loc_pos, 0.0), axis)
+            better = gmin < gfit
+            gfit = jnp.where(better, gmin, gfit)
+            gpos = jnp.where(better, gcand, gpos)
+            return (pos_t, vel_t, bpos_t, bfit_t, gpos, gfit)
+
+        return run_blocks(
+            block,
+            (pos_t, vel_t, bpos_t, bfit_t, gpos, gfit),
+            n_steps, steps_per_kernel,
+        )
+
+    carry = run(
+        pos_t, vel_t, bpos_t, bfit_t,
+        state.gbest_pos.astype(jnp.float32),
+        state.gbest_fit.astype(jnp.float32),
+    )
+    return rebuild_state(state, *carry, n_steps)
+
+
 def elect_shmap(
     alive: jax.Array,
     agent_id: jax.Array,

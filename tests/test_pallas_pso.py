@@ -130,6 +130,26 @@ def test_pallas_supported_matrix():
     assert not pallas_supported("not_an_objective", jnp.float32)
 
 
+def test_fused_shmap_multichip():
+    # 8-virtual-device mesh (conftest): fused kernel per shard + ICI-style
+    # gbest exchange; n=1000 pads to 8 x 128 lanes.
+    from distributed_swarm_algorithm_tpu.parallel.mesh import make_mesh
+    from distributed_swarm_algorithm_tpu.parallel.sharding import (
+        fused_pso_run_shmap,
+    )
+
+    mesh = make_mesh()
+    st = pso_init(sphere, n=1000, dim=5, half_width=HW, seed=0)
+    out = fused_pso_run_shmap(
+        st, "sphere", mesh, 60, rng="host", interpret=True
+    )
+    assert out.pos.shape == (1000, 5)
+    assert int(out.iteration) == 60
+    assert float(out.gbest_fit) < 1e-4
+    # Replicated gbest agrees with the sharded pbest min.
+    assert float(out.gbest_fit) <= float(out.pbest_fit.min()) + 1e-6
+
+
 def test_pso_model_pallas_backend_on_cpu():
     opt = PSO("sphere", n=256, dim=4, seed=0, use_pallas=True)
     opt.run(60)

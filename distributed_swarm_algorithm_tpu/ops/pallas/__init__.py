@@ -11,6 +11,9 @@ does on its own:
   - ``separation``: tiled all-pairs neighbor-separation forces that never
     materialize the O(N^2) pairwise tensor in HBM
     (``cfg.separation_mode="pallas"`` in ops/physics.py).
+  - ``islands_fused``: the island model on the same fused kernel — all
+    islands in one launch, per-island gbest via BlockSpec index mapping,
+    ring migration between k-step blocks.
 
 Every kernel has a host/interpret mode so the test suite exercises the
 exact kernel bodies on CPU (tests/conftest.py pins JAX to CPU).
@@ -23,3 +26,4 @@ from .pso_fused import (  # noqa: F401
     pallas_supported,
 )
 from .separation import separation_pallas  # noqa: F401
+from .islands_fused import fused_island_run  # noqa: F401

@@ -1,0 +1,267 @@
+"""Island-model PSO on the fused Pallas kernel.
+
+The portable island path (parallel/islands.py) vmaps the jnp PSO step over
+a leading island axis.  Here all islands share ONE fused kernel launch:
+particles flatten onto the lane axis ``[D, I * n_pad]`` and each lane tile
+belongs to exactly one island, so the only island-aware piece is the
+gbest operand — a ``[D, I]`` matrix whose BlockSpec index map hands tile
+``i`` its island's column (``i // tiles_per_island``).  The kernel body is
+byte-identical to the single-swarm one (_make_kernel, track_best=False);
+per-island bests and ring migration run between k-step blocks as cheap
+jnp reductions over the ``[I, n]`` fitness view.
+
+Migration semantics mirror parallel/islands.py:migrate exactly (k best
+pbest particles replace the next island's k worst, ring order, velocities
+zeroed, island gbests refreshed) — re-expressed in the transposed layout
+so the particle arrays never leave ``[D, I*n]`` form between blocks.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ...parallel.islands import IslandPSOState
+from ..pso import C1, C2, W
+from .common import ceil_to
+from .pso_fused import (
+    OBJECTIVES_T,
+    _auto_tile,
+    _make_kernel,
+    host_uniforms,
+    run_blocks,
+    seed_base,
+)
+
+
+def _islands_step_t(
+    seed, gbest_ti, pos_t, vel_t, bpos_t, bfit_t, r1, r2,
+    *, objective_name, w, c1, c2, half_width, vmax_frac,
+    tile_n, tiles_per_island, rng, interpret, k_steps,
+):
+    """One fused k-step block over all islands.  ``gbest_ti`` is [D, I]."""
+    d, n_flat = pos_t.shape
+    n_tiles = n_flat // tile_n
+    host_rng = rng == "host"
+    kernel = _make_kernel(
+        OBJECTIVES_T[objective_name], w, c1, c2,
+        half_width * vmax_frac, half_width, host_rng, k_steps,
+        track_best=False,
+    )
+    col = lambda i, s: (0, i)                        # noqa: E731
+    isl = lambda i, s: (0, i // tiles_per_island)    # noqa: E731
+    dn = pl.BlockSpec((d, tile_n), col, memory_space=pltpu.VMEM)
+    ft = pl.BlockSpec((1, tile_n), col, memory_space=pltpu.VMEM)
+    # Island gbest, lane-padded to the 128-lane block minimum: column
+    # j*128 holds island j's gbest (the kernel reads column 0 of its
+    # block); Mosaic rejects 1-lane blocks on multi-column arrays.
+    n_i = gbest_ti.shape[1]
+    g128 = jnp.broadcast_to(
+        gbest_ti[:, :, None], (d, n_i, 128)
+    ).reshape(d, n_i * 128)
+    in_specs = [
+        pl.BlockSpec((d, 128), isl, memory_space=pltpu.VMEM),
+        dn, dn, dn, ft,
+    ]
+    operands = [g128, pos_t, vel_t, bpos_t, bfit_t]
+    if host_rng:
+        in_specs += [dn, dn]
+        operands += [r1, r2]
+    f32 = jnp.float32
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n_tiles,),
+            in_specs=in_specs,
+            out_specs=[dn, dn, dn, ft],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((d, n_flat), f32),
+            jax.ShapeDtypeStruct((d, n_flat), f32),
+            jax.ShapeDtypeStruct((d, n_flat), f32),
+            jax.ShapeDtypeStruct((1, n_flat), f32),
+        ],
+        interpret=interpret,
+    )(jnp.reshape(seed.astype(jnp.int32), (1,)), *operands)
+
+
+def _island_gbest_update(bfit_t, bpos_t, gpos_ti, gfit_i, n_i, n_l):
+    """Refresh per-island gbests from the flat pbest arrays."""
+    bfit_r = bfit_t.reshape(n_i, n_l)                      # [I, n]
+    best = jnp.argmin(bfit_r, axis=1)                      # [I]
+    cand_fit = jnp.take_along_axis(bfit_r, best[:, None], axis=1)[:, 0]
+    flat = jnp.arange(n_i) * n_l + best
+    cand_pos = bpos_t[:, flat]                             # [D, I]
+    better = cand_fit < gfit_i
+    gfit_i = jnp.where(better, cand_fit, gfit_i)
+    gpos_ti = jnp.where(better[None, :], cand_pos, gpos_ti)
+    return gpos_ti, gfit_i
+
+
+def _migrate_t(pos_t, vel_t, bpos_t, bfit_t, k, n_i, n_l, n_real=None):
+    """Ring migration in transposed layout (parallel/islands.py:migrate).
+
+    Padded lanes (index >= ``n_real`` within an island) are excluded from
+    both emigrant and replacement selection, so migration touches exactly
+    the particles the portable path would — immigrants are never written
+    into lanes the final unpad slice discards.
+    """
+    n_real = n_l if n_real is None else n_real
+    bfit_r = bfit_t.reshape(n_i, n_l)
+    offs = (jnp.arange(n_i) * n_l)[:, None]                # [I, 1]
+    valid = (jnp.arange(n_l) < n_real)[None, :]            # [1, n_l]
+
+    inf = jnp.asarray(jnp.inf, bfit_r.dtype)
+    _, best_idx = jax.lax.top_k(                            # k smallest real
+        -jnp.where(valid, bfit_r, inf), k
+    )
+    flat_b = (offs + best_idx).reshape(-1)                 # [I*k]
+    em_pos = bpos_t[:, flat_b].reshape(-1, n_i, k)         # [D, I, k]
+    em_fit = jnp.take_along_axis(bfit_r, best_idx, axis=1)  # [I, k]
+
+    in_pos = jnp.roll(em_pos, 1, axis=1).reshape(-1, n_i * k)
+    in_fit = jnp.roll(em_fit, 1, axis=0).reshape(-1)
+
+    _, worst_idx = jax.lax.top_k(                           # k largest real
+        jnp.where(valid, bfit_r, -inf), k
+    )
+    flat_w = (offs + worst_idx).reshape(-1)
+
+    pos_t = pos_t.at[:, flat_w].set(in_pos)
+    bpos_t = bpos_t.at[:, flat_w].set(in_pos)
+    vel_t = vel_t.at[:, flat_w].set(0.0)
+    bfit_t = bfit_t.at[0, flat_w].set(in_fit)
+    return pos_t, vel_t, bpos_t, bfit_t
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "n_steps", "migrate_every", "migrate_k", "w",
+        "c1", "c2", "half_width", "vmax_frac", "tile_n", "rng",
+        "interpret", "steps_per_kernel",
+    ),
+)
+def fused_island_run(
+    state: IslandPSOState,
+    objective_name: str,
+    n_steps: int,
+    migrate_every: int = 25,
+    migrate_k: int = 4,
+    w: float = W,
+    c1: float = C1,
+    c2: float = C2,
+    half_width: float = 5.12,
+    vmax_frac: float = 0.5,
+    tile_n: int | None = None,
+    rng: str = "tpu",
+    interpret: bool = False,
+    steps_per_kernel: int = 8,
+) -> IslandPSOState:
+    """All islands, one fused kernel per k-step block, single chip.
+
+    Migration fires between blocks on the first block boundary at or past
+    each ``migrate_every`` multiple (exact when ``steps_per_kernel``
+    divides ``migrate_every``; the portable path migrates mid-cadence
+    otherwise).  Per-island padding duplicates that island's own leading
+    particles (optimum-preserving per island).
+    """
+    pso = state.pso
+    n_i, n, d = pso.pos.shape
+    if rng == "host":
+        steps_per_kernel = 1
+    if tile_n is None:
+        tile_n = _auto_tile(ceil_to(max(d, 8), 8))
+    tile_n = min(tile_n, ceil_to(n, 128))
+    n_l = ceil_to(n, tile_n)                 # per-island padded width
+    tpi = n_l // tile_n
+    reps = -(-n_l // n)
+
+    def prep(x_ind):                          # [I, n, D] -> [D, I*n_l]
+        x = x_ind.astype(jnp.float32)
+        if n_l != n:
+            x = jnp.tile(x, (1, reps, 1))[:, :n_l]
+        return x.reshape(n_i * n_l, d).T
+
+    pos_t = prep(pso.pos)
+    vel_t = prep(pso.vel)
+    bpos_t = prep(pso.pbest_pos)
+    bfit = pso.pbest_fit.astype(jnp.float32)
+    if n_l != n:
+        bfit = jnp.tile(bfit, (1, reps))[:, :n_l]
+    bfit_t = bfit.reshape(1, n_i * n_l)
+
+    gpos_ti = pso.gbest_pos.astype(jnp.float32).T          # [D, I]
+    gfit_i = pso.gbest_fit.astype(jnp.float32)             # [I]
+
+    # island_init stacks one raw uint32 [2] key per island -> [I, 2].
+    stacked_keys = pso.key.ndim == 2
+    base_key = pso.key[0] if stacked_keys else pso.key
+    seed0 = seed_base(base_key)
+    host_key = jax.random.fold_in(base_key, 0x15AD)
+    n_tiles = n_i * tpi
+    blocks_per_migration = max(1, migrate_every // steps_per_kernel)
+
+    def block(carry, call_i, k):
+        pos_t, vel_t, bpos_t, bfit_t, gpos_ti, gfit_i = carry
+        seed = seed0 + call_i * n_tiles
+        r1 = r2 = None
+        if rng == "host":
+            r1, r2 = host_uniforms(host_key, call_i, pos_t.shape)
+        pos_t, vel_t, bpos_t, bfit_t = _islands_step_t(
+            seed, gpos_ti, pos_t, vel_t, bpos_t, bfit_t, r1, r2,
+            objective_name=objective_name, w=w, c1=c1, c2=c2,
+            half_width=half_width, vmax_frac=vmax_frac, tile_n=tile_n,
+            tiles_per_island=tpi, rng=rng, interpret=interpret, k_steps=k,
+        )
+
+        due = (call_i + 1) % blocks_per_migration == 0
+
+        def do_migrate(args):
+            return _migrate_t(*args, migrate_k, n_i, n_l, n_real=n)
+
+        pos_t, vel_t, bpos_t, bfit_t = jax.lax.cond(
+            due, do_migrate, lambda a: a, (pos_t, vel_t, bpos_t, bfit_t)
+        )
+        gpos_ti, gfit_i = _island_gbest_update(
+            bfit_t, bpos_t, gpos_ti, gfit_i, n_i, n_l
+        )
+        return (pos_t, vel_t, bpos_t, bfit_t, gpos_ti, gfit_i)
+
+    carry = run_blocks(
+        block,
+        (pos_t, vel_t, bpos_t, bfit_t, gpos_ti, gfit_i),
+        n_steps, steps_per_kernel,
+    )
+    pos_t, vel_t, bpos_t, bfit_t, gpos_ti, gfit_i = carry
+
+    dt = pso.pos.dtype
+
+    def back(x_t):                            # [D, I*n_l] -> [I, n, D]
+        return x_t.T.reshape(n_i, n_l, d)[:, :n].astype(dt)
+
+    new_keys = (
+        jax.vmap(lambda kk: jax.random.fold_in(kk, n_steps))(pso.key)
+        if stacked_keys
+        else jax.random.fold_in(pso.key, n_steps)
+    )
+    return state.replace(
+        pso=pso.replace(
+            pos=back(pos_t),
+            vel=back(vel_t),
+            pbest_pos=back(bpos_t),
+            pbest_fit=bfit_t.reshape(n_i, n_l)[:, :n].astype(
+                pso.pbest_fit.dtype
+            ),
+            gbest_pos=gpos_ti.T.astype(pso.gbest_pos.dtype),
+            gbest_fit=gfit_i.astype(pso.gbest_fit.dtype),
+            key=new_keys,
+            iteration=pso.iteration + n_steps,
+        ),
+        iteration=state.iteration + n_steps,
+    )

@@ -150,12 +150,20 @@ def _make_kernel(
     half_width: float,
     host_rng: bool,
     k_steps: int = 1,
+    track_best: bool = True,
 ):
+    """Kernel factory.  ``track_best=False`` drops the cross-tile running-
+    best outputs — used by the island variant (ops/pallas/islands_fused.py)
+    where each tile group has its own gbest and the per-island best is a
+    cheap host-side reduction over ``bfit`` instead."""
+
     def body(seed_ref, gbest_ref, pos_ref, vel_ref, bpos_ref, bfit_ref,
-             r1, r2, pos_o, vel_o, bpos_o, bfit_o, tfit_o, tpos_o):
+             r1, r2, pos_o, vel_o, bpos_o, bfit_o, *best_outs):
         pos, vel = pos_ref[:], vel_ref[:]
         bpos, bfit = bpos_ref[:], bfit_ref[:]
-        g = gbest_ref[:]                        # [D,1] broadcasts over lanes
+        # [D,1] broadcasts over lanes; island mode hands a lane-padded
+        # [D,128] block (Mosaic block constraints), same first column.
+        g = gbest_ref[:][:, 0:1]
 
         # k_steps iterations entirely in VMEM: HBM sees one read + one
         # write of pos/vel/pbest per KERNEL, not per STEP.  gbest is held
@@ -184,6 +192,10 @@ def _make_kernel(
         vel_o[:] = vel
         bpos_o[:] = bpos
         bfit_o[:] = bfit
+
+        if not track_best:
+            return
+        tfit_o, tpos_o = best_outs
 
         # Running-best accumulator: the TPU grid executes sequentially on
         # one core, so revisited output blocks (fixed index map) persist

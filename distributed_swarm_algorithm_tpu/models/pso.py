@@ -98,6 +98,18 @@ class PSO:
         jax.block_until_ready(self.state.gbest_fit)
         return self.state
 
+    def save(self, path: str) -> None:
+        """Checkpoint the optimizer state (orbax dir or .npz file)."""
+        from ..utils import checkpoint as _ckpt
+
+        _ckpt.save(path, self.state)
+
+    def load(self, path: str) -> None:
+        """Restore state saved by :meth:`save` (shapes must match)."""
+        from ..utils import checkpoint as _ckpt
+
+        self.state = _ckpt.restore(path, self.state)
+
     @property
     def best(self) -> float:
         return float(self.state.gbest_fit)

@@ -137,6 +137,19 @@ class VectorSwarm:
                 time.sleep(leftover)
         return self.state
 
+    # --- checkpoint / resume (absent in the reference, SURVEY.md §5) -----
+    def save(self, path: str) -> None:
+        """Checkpoint the full swarm state (orbax dir or .npz file)."""
+        from ..utils import checkpoint as _ckpt
+
+        _ckpt.save(path, self.state)
+
+    def load(self, path: str) -> None:
+        """Restore state saved by :meth:`save` (shapes must match)."""
+        from ..utils import checkpoint as _ckpt
+
+        self.state = _ckpt.restore(path, self.state)
+
     # --- introspection / fault injection ---------------------------------
     def leader(self):
         lid, exists = current_leader(self.state)

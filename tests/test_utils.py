@@ -81,6 +81,48 @@ def test_cli_pso(capsys):
     assert out["best"] < 10.0
 
 
+def test_cli_pso_islands(capsys):
+    assert cli_main(
+        ["pso", "--objective", "sphere", "--n", "256", "--dim", "4",
+         "--steps", "60", "--islands", "4", "--migrate-every", "20",
+         "--migrate-k", "2"]
+    ) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["islands"] == 4
+    assert out["particles_per_island"] == 64
+    assert out["best"] < 1.0
+
+
+def test_cli_swarm_separation_flag(capsys):
+    # > election_timeout_ticks (30) so a leader has emerged.
+    assert cli_main(
+        ["swarm", "--n", "32", "--steps", "60", "--target", "5", "0",
+         "--separation", "grid"]
+    ) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["leader"] == 31
+
+
+def test_model_checkpoint_roundtrip(tmp_path):
+    sw = dsa.VectorSwarm(16, seed=0, spread=3.0)
+    sw.set_target([5.0, 5.0])
+    sw.step(10)
+    p = str(tmp_path / "swarm.npz")
+    sw.save(p)
+    sw2 = dsa.VectorSwarm(16, seed=1, spread=3.0)
+    sw2.load(p)
+    assert jnp.allclose(sw2.state.pos, sw.state.pos)
+    assert int(sw2.state.tick) == int(sw.state.tick)
+
+    opt = dsa.PSO("sphere", n=64, dim=4, seed=0)
+    opt.run(20)
+    p2 = str(tmp_path / "pso.npz")
+    opt.save(p2)
+    opt2 = dsa.PSO("sphere", n=64, dim=4, seed=5)
+    opt2.load(p2)
+    assert opt2.best == opt.best
+
+
 def test_cli_reference_compat_flags(capsys):
     # `--id ... --count ... --caps ... ` without a subcommand = reference
     # CLI (agent.py:349-360), bounded by --steps for testability.

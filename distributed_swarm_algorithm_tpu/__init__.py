@@ -10,9 +10,10 @@ that shards over a TPU mesh via ``shard_map`` (see ``parallel/``).
 
 Layers (mirrors SURVEY.md §1, rebuilt TPU-first):
   models/    VectorSwarm (capability parity), PSO (perf flagship),
+             DE / CMAES (optimizer families), Boids (flocking),
              SwarmAgent (per-agent CPU-compatible API + real transport)
-  ops/       pure kernels: physics, coordination, allocation, PSO,
-             objectives, neighbor search
+  ops/       pure kernels: physics, coordination, allocation, PSO/DE/
+             CMA-ES/boids, objectives, neighbor search
   parallel/  mesh/sharding/island-model multi-chip layer
   utils/     config, checkpoint, metrics, profiling
 """
@@ -35,7 +36,13 @@ from .state import (
 from .utils.config import DEFAULT_CONFIG, SwarmConfig
 from .models.swarm import VectorSwarm, swarm_rollout, swarm_tick
 from .models.pso import PSO
+from .models.de import DE
+from .models.cmaes import CMAES
+from .models.boids import Boids
 from .ops import objectives
+from .ops.boids import BoidsParams, BoidsState, boids_init, boids_run, boids_step
+from .ops.cmaes import CMAESState, cmaes_init, cmaes_params, cmaes_run, cmaes_step
+from .ops.de import DEState, de_init, de_run, de_step
 from .ops.allocation import (
     allocation_step,
     arbitrate,
@@ -59,6 +66,11 @@ __all__ = [
     "SwarmConfig", "DEFAULT_CONFIG", "SwarmState", "make_swarm", "with_tasks",
     "VectorSwarm", "swarm_tick", "swarm_rollout", "PSO",
     "PSOState", "pso_init", "pso_step", "pso_run", "fused_pso_run",
+    "DE", "DEState", "de_init", "de_step", "de_run",
+    "CMAES", "CMAESState", "cmaes_params", "cmaes_init", "cmaes_step",
+    "cmaes_run",
+    "Boids", "BoidsParams", "BoidsState", "boids_init", "boids_step",
+    "boids_run",
     "objectives",
     "coordination_step", "instant_election", "current_leader", "kill",
     "revive",

@@ -8,6 +8,9 @@ reference could not actually run:
   sim     N agents on an in-process bus, stepped in lockstep
   swarm   the vectorized TPU swarm (VectorSwarm)
   pso     particle-swarm optimization on a benchmark objective
+  de      differential evolution on a benchmark objective
+  cmaes   CMA-ES on a benchmark objective
+  boids   Reynolds flocking simulation (order-parameter report)
   bench   the headline benchmark (same as bench.py)
 
 ``python -m distributed_swarm_algorithm_tpu --id 1 --count 3 --caps lift``
@@ -169,7 +172,17 @@ def _cmd_pso_islands(args) -> int:
     from .utils.platform import on_tpu
 
     fn, hw = get_objective(args.objective)
-    n_per = args.n // args.islands
+    n_per, rem = divmod(args.n, args.islands)
+    if n_per < 1:
+        raise SystemExit(
+            f"error: --n ({args.n}) must be >= --islands ({args.islands})"
+        )
+    if rem:
+        print(
+            f"note: --n {args.n} not divisible by --islands "
+            f"{args.islands}; running {n_per * args.islands} particles",
+            file=sys.stderr,
+        )
     st = island_init(fn, n_islands=args.islands, n_per_island=n_per,
                      dim=args.dim, half_width=hw, seed=args.seed)
     use_fused = on_tpu() and pallas_supported(args.objective, st.pso.pos.dtype)
@@ -199,6 +212,66 @@ def _cmd_pso_islands(args) -> int:
         "path": "pallas-fused" if use_fused else "vmap",
         "best": best,
         "steps_per_sec": round(args.steps / elapsed, 1),
+    }))
+    return 0
+
+
+def _cmd_de(args) -> int:
+    from .models.de import DE
+
+    opt = DE(args.objective, n=args.n, dim=args.dim, f=args.f, cr=args.cr,
+             variant=args.variant, seed=args.seed)
+    start = time.perf_counter()
+    opt.run(args.steps)
+    elapsed = time.perf_counter() - start
+    print(json.dumps({
+        "objective": args.objective,
+        "population": args.n,
+        "dim": args.dim,
+        "iters": args.steps,
+        "variant": args.variant,
+        "best": opt.best,
+        "steps_per_sec": round(args.steps / elapsed, 1),
+    }))
+    return 0
+
+
+def _cmd_cmaes(args) -> int:
+    from .models.cmaes import CMAES
+
+    opt = CMAES(args.objective, dim=args.dim, n=args.n, seed=args.seed)
+    start = time.perf_counter()
+    opt.run(args.steps)
+    elapsed = time.perf_counter() - start
+    print(json.dumps({
+        "objective": args.objective,
+        "popsize": opt.params.popsize,
+        "dim": args.dim,
+        "iters": args.steps,
+        "best": opt.best,
+        "sigma": float(opt.state.sigma),
+        "steps_per_sec": round(args.steps / elapsed, 1),
+    }))
+    return 0
+
+
+def _cmd_boids(args) -> int:
+    from .models.boids import Boids
+
+    flock = Boids(n=args.n, dim=args.dim, seed=args.seed,
+                  half_width=args.half_width)
+    p0 = flock.polarization
+    start = time.perf_counter()
+    flock.run(args.steps)
+    elapsed = time.perf_counter() - start
+    print(json.dumps({
+        "boids": args.n,
+        "dim": args.dim,
+        "ticks": args.steps,
+        "polarization_start": round(p0, 3),
+        "polarization_end": round(flock.polarization, 3),
+        "nearest_neighbor_dist": round(flock.nearest_neighbor_dist, 3),
+        "ticks_per_sec": round(args.steps / elapsed, 1),
     }))
     return 0
 
@@ -267,6 +340,37 @@ def build_parser() -> argparse.ArgumentParser:
     p_pso.add_argument("--migrate-every", type=int, default=25)
     p_pso.add_argument("--migrate-k", type=int, default=4)
     p_pso.set_defaults(fn=_cmd_pso)
+
+    p_de = sub.add_parser("de", help="differential evolution")
+    p_de.add_argument("--objective", default="rastrigin")
+    p_de.add_argument("--n", type=int, default=256)
+    p_de.add_argument("--dim", type=int, default=30)
+    p_de.add_argument("--steps", type=int, default=500)
+    p_de.add_argument("--seed", type=int, default=0)
+    p_de.add_argument("--f", type=float, default=0.5,
+                      help="differential weight F")
+    p_de.add_argument("--cr", type=float, default=0.9,
+                      help="crossover rate CR")
+    p_de.add_argument("--variant", default="rand1bin",
+                      choices=["rand1bin", "best1bin"])
+    p_de.set_defaults(fn=_cmd_de)
+
+    p_cma = sub.add_parser("cmaes", help="CMA-ES evolution strategy")
+    p_cma.add_argument("--objective", default="rosenbrock")
+    p_cma.add_argument("--n", type=int, default=None,
+                       help="popsize lambda (default 4 + 3 ln D)")
+    p_cma.add_argument("--dim", type=int, default=30)
+    p_cma.add_argument("--steps", type=int, default=500)
+    p_cma.add_argument("--seed", type=int, default=0)
+    p_cma.set_defaults(fn=_cmd_cmaes)
+
+    p_boids = sub.add_parser("boids", help="Reynolds flocking simulation")
+    p_boids.add_argument("--n", type=int, default=512)
+    p_boids.add_argument("--dim", type=int, default=2)
+    p_boids.add_argument("--steps", type=int, default=500)
+    p_boids.add_argument("--seed", type=int, default=0)
+    p_boids.add_argument("--half-width", type=float, default=50.0)
+    p_boids.set_defaults(fn=_cmd_boids)
 
     p_bench = sub.add_parser("bench", help="headline benchmark")
     p_bench.set_defaults(fn=_cmd_bench)

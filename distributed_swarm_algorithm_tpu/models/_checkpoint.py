@@ -1,0 +1,24 @@
+"""Shared checkpoint/restore behavior for stateful model classes.
+
+Every model in ``models/`` keeps its whole device state in a single
+pytree attribute ``self.state``; this mixin gives them all the same
+save/load contract over ``utils/checkpoint.py`` (orbax dir or .npz).
+"""
+
+from __future__ import annotations
+
+
+class CheckpointMixin:
+    """save()/load() over the model's ``state`` pytree."""
+
+    def save(self, path: str) -> None:
+        """Checkpoint the model state (orbax dir or .npz file)."""
+        from ..utils import checkpoint as _ckpt
+
+        _ckpt.save(path, self.state)
+
+    def load(self, path: str) -> None:
+        """Restore state saved by :meth:`save` (shapes must match)."""
+        from ..utils import checkpoint as _ckpt
+
+        self.state = _ckpt.restore(path, self.state)

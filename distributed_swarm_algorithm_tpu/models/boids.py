@@ -1,0 +1,67 @@
+"""User-facing Boids flocking model.
+
+Thin stateful wrapper over ``ops/boids.py``, same shape as the other
+model classes (PSO/DE/CMAES/VectorSwarm).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import boids as _k
+from ._checkpoint import CheckpointMixin
+
+
+class Boids(CheckpointMixin):
+    """Reynolds flocking simulation on a toroidal world.
+
+    >>> flock = Boids(n=256, seed=0)
+    >>> flock.run(500)
+    >>> float(flock.polarization)   # -> ~1.0 once aligned  # doctest: +SKIP
+    """
+
+    def __init__(
+        self,
+        n: int,
+        dim: int = 2,
+        params: Optional[_k.BoidsParams] = None,
+        obstacles: Optional[jax.Array] = None,
+        seed: int = 0,
+        **overrides,
+    ):
+        base = params if params is not None else _k.BoidsParams()
+        if overrides:
+            base = base._replace(**overrides)
+        self.params = base
+        self.obstacles = (
+            jnp.asarray(obstacles, jnp.float32)
+            if obstacles is not None
+            else None
+        )
+        self.state = _k.boids_init(n, dim, self.params, seed=seed)
+
+    def step(self) -> _k.BoidsState:
+        self.state = _k.boids_step(self.state, self.params, self.obstacles)
+        return self.state
+
+    def run(self, n_steps: int, record: bool = False):
+        """Advance ``n_steps`` ticks; with ``record=True`` returns the
+        ``[n_steps, N, D]`` position trajectory."""
+        self.state, traj = _k.boids_run(
+            self.state, self.params, n_steps, self.obstacles, record
+        )
+        jax.block_until_ready(self.state.pos)
+        return traj if record else self.state
+
+    @property
+    def polarization(self) -> float:
+        return float(_k.polarization(self.state))
+
+    @property
+    def nearest_neighbor_dist(self) -> float:
+        return float(
+            _k.nearest_neighbor_dist(self.state, self.params.half_width)
+        )

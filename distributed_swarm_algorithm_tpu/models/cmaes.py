@@ -1,0 +1,86 @@
+"""User-facing CMA-ES optimizer model.
+
+Same shape as :class:`~distributed_swarm_algorithm_tpu.models.pso.PSO`:
+a thin stateful wrapper over the pure kernels in ``ops/cmaes.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import cmaes as _k
+from ..ops.objectives import get_objective
+from ._checkpoint import CheckpointMixin
+
+
+class CMAES(CheckpointMixin):
+    """Covariance-matrix-adaptation evolution strategy.
+
+    Unlike PSO/DE, ``n`` here is the per-generation sample count
+    (lambda); Hansen's ``4 + 3 ln D`` default applies when omitted.
+    ``half_width`` (resolved from the objective registry for named
+    objectives) box-projects samples before evaluation.
+
+    >>> opt = CMAES("rosenbrock", dim=10, seed=0)
+    >>> opt.run(400)
+    >>> float(opt.state.best_fit)  # doctest: +SKIP
+    """
+
+    def __init__(
+        self,
+        objective: Union[str, Callable],
+        dim: int,
+        n: Optional[int] = None,
+        half_width: Optional[float] = None,
+        sigma: Optional[float] = None,
+        mean: Optional[jax.Array] = None,
+        seed: int = 0,
+    ):
+        if isinstance(objective, str):
+            fn, default_hw = get_objective(objective)
+        else:
+            fn, default_hw = objective, None
+        self.objective = fn
+        self.half_width = (
+            float(half_width)
+            if half_width is not None
+            else (float(default_hw) if default_hw is not None else None)
+        )
+        self.params = _k.cmaes_params(dim, popsize=n)
+        if sigma is None:
+            # Hansen's rule of thumb: ~0.3x the search-domain width.
+            sigma = (
+                0.3 * 2.0 * self.half_width
+                if self.half_width is not None
+                else 0.3
+            )
+        if mean is None and self.half_width is not None:
+            key = jax.random.PRNGKey(seed ^ 0xC3A)
+            mean = jax.random.uniform(
+                key, (dim,), jnp.float32,
+                minval=-0.5 * self.half_width,
+                maxval=0.5 * self.half_width,
+            )
+        self.state = _k.cmaes_init(dim, sigma=float(sigma), mean=mean,
+                                   seed=seed)
+
+    def step(self) -> _k.CMAESState:
+        self.state = _k.cmaes_step(
+            self.state, self.objective, self.params, self.half_width
+        )
+        return self.state
+
+    def run(self, n_steps: int) -> _k.CMAESState:
+        self.state = _k.cmaes_run(
+            self.state, self.objective, self.params, n_steps,
+            self.half_width,
+        )
+        jax.block_until_ready(self.state.best_fit)
+        return self.state
+
+    @property
+    def best(self) -> float:
+        return float(self.state.best_fit)

@@ -1,0 +1,71 @@
+"""User-facing differential-evolution optimizer model.
+
+Same shape as :class:`~distributed_swarm_algorithm_tpu.models.pso.PSO`:
+a thin stateful wrapper over the pure kernels in ``ops/de.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import de as _k
+from ..ops.objectives import get_objective
+from ._checkpoint import CheckpointMixin
+
+
+class DE(CheckpointMixin):
+    """Differential evolution (rand/1/bin by default).
+
+    >>> opt = DE("rastrigin", n=256, dim=10, seed=0)
+    >>> opt.run(300)
+    >>> float(opt.state.best_fit)  # doctest: +SKIP
+    """
+
+    def __init__(
+        self,
+        objective: Union[str, Callable],
+        n: int,
+        dim: int,
+        half_width: Optional[float] = None,
+        f: float = _k.F,
+        cr: float = _k.CR,
+        variant: str = "rand1bin",
+        seed: int = 0,
+        dtype=None,
+    ):
+        if isinstance(objective, str):
+            fn, default_hw = get_objective(objective)
+        else:
+            fn, default_hw = objective, 5.12
+        self.objective = fn
+        self.half_width = float(
+            half_width if half_width is not None else default_hw
+        )
+        self.f, self.cr = float(f), float(cr)
+        self.variant = variant
+        kwargs = {} if dtype is None else {"dtype": dtype}
+        self.state = _k.de_init(
+            fn, n, dim, self.half_width, seed=seed, **kwargs
+        )
+
+    def step(self) -> _k.DEState:
+        self.state = _k.de_step(
+            self.state, self.objective, self.f, self.cr, self.half_width,
+            self.variant,
+        )
+        return self.state
+
+    def run(self, n_steps: int) -> _k.DEState:
+        self.state = _k.de_run(
+            self.state, self.objective, n_steps, self.f, self.cr,
+            self.half_width, self.variant,
+        )
+        jax.block_until_ready(self.state.best_fit)
+        return self.state
+
+    @property
+    def best(self) -> float:
+        return float(self.state.best_fit)

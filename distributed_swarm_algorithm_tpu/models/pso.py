@@ -10,9 +10,10 @@ from ..ops import pso as _k
 from ..ops.objectives import get_objective
 from ..ops.pallas import pso_fused as _pf
 from ..utils.platform import on_tpu as _on_tpu
+from ._checkpoint import CheckpointMixin
 
 
-class PSO:
+class PSO(CheckpointMixin):
     """Global-best particle swarm optimizer.
 
     Two compute paths with the same PSOState contract:
@@ -97,18 +98,6 @@ class PSO:
             )
         jax.block_until_ready(self.state.gbest_fit)
         return self.state
-
-    def save(self, path: str) -> None:
-        """Checkpoint the optimizer state (orbax dir or .npz file)."""
-        from ..utils import checkpoint as _ckpt
-
-        _ckpt.save(path, self.state)
-
-    def load(self, path: str) -> None:
-        """Restore state saved by :meth:`save` (shapes must match)."""
-        from ..utils import checkpoint as _ckpt
-
-        self.state = _ckpt.restore(path, self.state)
 
     @property
     def best(self) -> float:

@@ -22,6 +22,7 @@ from ..ops.coordination import coordination_step, current_leader, kill, revive
 from ..ops.physics import physics_step
 from ..state import SwarmState, make_swarm, with_tasks
 from ..utils.config import DEFAULT_CONFIG, SwarmConfig
+from ._checkpoint import CheckpointMixin
 
 _NO_OBSTACLES = None
 
@@ -57,7 +58,7 @@ def swarm_rollout(
     return state
 
 
-class VectorSwarm:
+class VectorSwarm(CheckpointMixin):
     """User-facing handle: owns a SwarmState + SwarmConfig.
 
     Replaces the reference's one-process-per-agent CLI deployment
@@ -137,18 +138,8 @@ class VectorSwarm:
                 time.sleep(leftover)
         return self.state
 
-    # --- checkpoint / resume (absent in the reference, SURVEY.md §5) -----
-    def save(self, path: str) -> None:
-        """Checkpoint the full swarm state (orbax dir or .npz file)."""
-        from ..utils import checkpoint as _ckpt
-
-        _ckpt.save(path, self.state)
-
-    def load(self, path: str) -> None:
-        """Restore state saved by :meth:`save` (shapes must match)."""
-        from ..utils import checkpoint as _ckpt
-
-        self.state = _ckpt.restore(path, self.state)
+    # checkpoint/resume (absent in the reference, SURVEY.md §5) comes
+    # from CheckpointMixin.
 
     # --- introspection / fault injection ---------------------------------
     def leader(self):

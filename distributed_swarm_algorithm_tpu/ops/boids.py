@@ -1,0 +1,224 @@
+"""Boids flocking kernels (Reynolds 1987: separation/alignment/cohesion).
+
+The robotics-side sibling of the APF motion planner (ops/physics.py).
+The reference's physics is leader-follower formation control plus
+separation (/root/reference/agent.py:94-181) — i.e. two of the three
+Reynolds rules in disguise (cohesion-to-slot + separation).  This module
+completes the family with the classic decentralized flocking model:
+no leader, no slots — alignment and cohesion emerge from local
+neighborhoods.
+
+Vectorized the same way as the rest of ``ops/``: the flock is
+struct-of-arrays, one step is a dense masked all-pairs pass (the same
+[N, 1, D] - [1, N, D] broadcast as ops/neighbors.py:separation_dense;
+for N beyond a few thousand the tiled Pallas separation kernel shows the
+scale-out shape), every norm epsilon-clamped (the reference's
+co-located-agents crash, SURVEY.md §5a bug 1, cannot happen here).
+
+World model: toroidal box ``[-half_width, half_width)^D`` — neighbor
+displacements use minimum-image wrapping so flocks cross the seam
+cleanly.  Speeds are clamped to ``[min_speed, max_speed]`` (a stationary
+boid has no heading, so min_speed > 0 keeps the order parameter defined).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class BoidsState:
+    """Struct-of-arrays flock state. N boids, D dims."""
+
+    pos: jax.Array        # [N, D], in [-half_width, half_width)
+    vel: jax.Array        # [N, D]
+    key: jax.Array
+    iteration: jax.Array  # i32 scalar
+
+
+class BoidsParams(NamedTuple):
+    """Flocking constants — plain scalars, hashable, static under jit."""
+
+    half_width: float = 50.0      # world is [-hw, hw)^D, toroidal
+    r_sep: float = 2.0            # separation radius (personal space)
+    r_align: float = 8.0          # alignment perception radius
+    r_coh: float = 8.0            # cohesion perception radius
+    w_sep: float = 1.5
+    w_align: float = 1.0
+    w_coh: float = 1.0
+    max_speed: float = 5.0        # same cap as the reference (agent.py:49)
+    min_speed: float = 0.5
+    max_force: float = 10.0       # steering-acceleration clamp
+    dt: float = 0.1               # reference tick period (agent.py:68)
+    eps: float = 1e-3             # norm floor (SURVEY.md §5a bug 1 fix)
+
+
+def boids_init(
+    n: int,
+    dim: int = 2,
+    params: BoidsParams = BoidsParams(),
+    seed: int = 0,
+    dtype=jnp.float32,
+) -> BoidsState:
+    key = jax.random.PRNGKey(seed)
+    key, kp, kv = jax.random.split(key, 3)
+    hw = params.half_width
+    pos = jax.random.uniform(kp, (n, dim), dtype, minval=-hw, maxval=hw)
+    vel = jax.random.uniform(kv, (n, dim), dtype, minval=-1.0, maxval=1.0)
+    vel = _clamp_speed(vel, params.min_speed, params.max_speed, params.eps)
+    return BoidsState(
+        pos=pos, vel=vel, key=key, iteration=jnp.asarray(0, jnp.int32)
+    )
+
+
+def _wrap(x: jax.Array, hw: float) -> jax.Array:
+    """Map into the toroidal box [-hw, hw)."""
+    return jnp.mod(x + hw, 2.0 * hw) - hw
+
+
+def _clamp_speed(
+    vel: jax.Array, lo: float, hi: float, eps: float
+) -> jax.Array:
+    speed = jnp.linalg.norm(vel, axis=-1, keepdims=True)
+    speed_c = jnp.maximum(speed, eps)
+    return vel / speed_c * jnp.clip(speed_c, lo, hi)
+
+
+def boids_forces(
+    state: BoidsState,
+    params: BoidsParams,
+    obstacles: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Steering acceleration [N, D] from the three Reynolds rules (plus
+    optional obstacle repulsion, same ``(center..., radius)`` convention
+    and force law as ops/physics.py / agent.py:127-146)."""
+    p = params
+    pos, vel = state.pos, state.vel
+    n = pos.shape[0]
+
+    diff = pos[:, None, :] - pos[None, :, :]          # i minus j, [N, N, D]
+    diff = _wrap(diff, p.half_width)                  # minimum image
+    dist = jnp.linalg.norm(diff, axis=-1)
+    dist_c = jnp.maximum(dist, p.eps)
+    not_self = ~jnp.eye(n, dtype=bool)
+
+    # Separation: push away from each too-close neighbor, 1/d weighting.
+    near = not_self & (dist < p.r_sep)
+    sep = jnp.sum(
+        jnp.where(near[..., None], diff / (dist_c * dist_c)[..., None], 0.0),
+        axis=1,
+    )
+
+    # Alignment: steer toward mean neighbor velocity.
+    mask_a = not_self & (dist < p.r_align)
+    cnt_a = jnp.maximum(jnp.sum(mask_a, axis=1, keepdims=True), 1)
+    mean_vel = jnp.sum(
+        jnp.where(mask_a[..., None], vel[None, :, :], 0.0), axis=1
+    ) / cnt_a
+    align = jnp.where(
+        jnp.sum(mask_a, axis=1, keepdims=True) > 0, mean_vel - vel, 0.0
+    )
+
+    # Cohesion: steer toward the neighborhood centroid (computed in
+    # relative coordinates so the toroidal seam does not tear flocks).
+    mask_c = not_self & (dist < p.r_coh)
+    cnt_c = jnp.maximum(jnp.sum(mask_c, axis=1, keepdims=True), 1)
+    rel_centroid = -jnp.sum(
+        jnp.where(mask_c[..., None], diff, 0.0), axis=1
+    ) / cnt_c
+    coh = jnp.where(jnp.sum(mask_c, axis=1, keepdims=True) > 0,
+                    rel_centroid, 0.0)
+
+    acc = p.w_sep * sep + p.w_align * align + p.w_coh * coh
+
+    if obstacles is not None and obstacles.shape[0] > 0:
+        centers, radius = obstacles[:, :-1], obstacles[:, -1]
+        od = _wrap(pos[:, None, :] - centers[None, :, :], p.half_width)
+        odist = jnp.maximum(jnp.linalg.norm(od, axis=-1), p.eps)
+        rho = radius[None, :] + p.r_sep
+        inside = odist < rho
+        mag = (1.0 / odist - 1.0 / rho) / (odist * odist)
+        acc = acc + jnp.sum(
+            jnp.where(
+                inside[..., None],
+                (p.w_sep * p.max_force) * mag[..., None]
+                * od / odist[..., None],
+                0.0,
+            ),
+            axis=1,
+        )
+
+    # Clamp steering magnitude (keeps the integrator stable at any dt).
+    amag = jnp.linalg.norm(acc, axis=-1, keepdims=True)
+    amag_c = jnp.maximum(amag, p.eps)
+    return acc / amag_c * jnp.minimum(amag_c, p.max_force)
+
+
+def boids_step(
+    state: BoidsState,
+    params: BoidsParams,
+    obstacles: Optional[jax.Array] = None,
+) -> BoidsState:
+    """One flocking tick: Reynolds forces -> speed-clamped Euler -> wrap."""
+    acc = boids_forces(state, params, obstacles)
+    vel = _clamp_speed(
+        state.vel + params.dt * acc,
+        params.min_speed, params.max_speed, params.eps,
+    )
+    pos = _wrap(state.pos + params.dt * vel, params.half_width)
+    return BoidsState(
+        pos=pos, vel=vel, key=state.key, iteration=state.iteration + 1
+    )
+
+
+@partial(jax.jit, static_argnames=("params", "n_steps", "record"))
+def boids_run(
+    state: BoidsState,
+    params: BoidsParams,
+    n_steps: int,
+    obstacles: Optional[jax.Array] = None,
+    record: bool = False,
+) -> Tuple[BoidsState, Optional[jax.Array]]:
+    """``n_steps`` ticks under one ``lax.scan``.
+
+    With ``record=True`` also returns the position trajectory
+    ``[n_steps, N, D]`` (stacked by the scan — the framework's
+    trajectory-capture hook; the reference could only log poses to
+    stdout, agent.py:180-181).
+    """
+
+    def body(s, _):
+        s = boids_step(s, params, obstacles)
+        return s, (s.pos if record else None)
+
+    state, traj = jax.lax.scan(body, state, None, length=n_steps)
+    return state, (traj if record else None)
+
+
+# ---------------------------------------------------------------------------
+# Order parameters — the standard emergent-behavior metrics.
+# ---------------------------------------------------------------------------
+
+
+def polarization(state: BoidsState, eps: float = 1e-6) -> jax.Array:
+    """Velocity order parameter in [0, 1]: 1 = perfectly aligned flock."""
+    speed = jnp.maximum(
+        jnp.linalg.norm(state.vel, axis=-1, keepdims=True), eps
+    )
+    return jnp.linalg.norm(jnp.mean(state.vel / speed, axis=0))
+
+
+def nearest_neighbor_dist(state: BoidsState, half_width: float) -> jax.Array:
+    """Mean distance to the nearest neighbor (collision-risk proxy)."""
+    n = state.pos.shape[0]
+    diff = _wrap(
+        state.pos[:, None, :] - state.pos[None, :, :], half_width
+    )
+    dist = jnp.linalg.norm(diff, axis=-1)
+    dist = jnp.where(jnp.eye(n, dtype=bool), jnp.inf, dist)
+    return jnp.mean(jnp.min(dist, axis=1))

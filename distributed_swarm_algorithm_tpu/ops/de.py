@@ -1,0 +1,172 @@
+"""Differential-evolution kernels (Storn & Price 1997).
+
+A second population-based optimizer family alongside PSO (ops/pso.py),
+sharing the objective library (ops/objectives.py) and the same
+struct-of-arrays / pure-step / ``lax.scan`` design so it jits, vmaps and
+shards identically.  The reference has no optimizer at all — its only
+"fitness" is the task utility at /root/reference/agent.py:338-347; DE is
+part of widening the framework to a full swarm-intelligence toolkit.
+
+TPU notes: every draw is batched (one ``randint``/``uniform`` per step,
+never per individual), donor selection is pure gathers, and the selection
+rule is a masked ``where`` — no data-dependent control flow, so XLA fuses
+the whole generation into a few kernels.
+
+Update rule (``rand/1/bin``; ``best/1/bin`` swaps the base vector):
+    mutant  = x_a + F * (x_b - x_c)           a, b, c distinct, != i
+    trial_j = mutant_j  if r_j < CR or j == j_rand  else  x_ij
+    x_i'    = trial     if f(trial) <= f(x_i) else  x_i
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+# Classic defaults (Storn & Price).
+F = 0.5
+CR = 0.9
+
+
+@struct.dataclass
+class DEState:
+    """Struct-of-arrays DE population. N individuals, D dims."""
+
+    pos: jax.Array        # [N, D]
+    fit: jax.Array        # [N]
+    best_pos: jax.Array   # [D]
+    best_fit: jax.Array   # scalar
+    key: jax.Array
+    iteration: jax.Array  # i32 scalar
+
+
+def _distinct3(key: jax.Array, n: int) -> Tuple[jax.Array, ...]:
+    """Three index vectors ``a, b, c`` with ``{a_i, b_i, c_i, i}`` all
+    distinct for every row i — exact uniform sampling without rejection.
+
+    Incremental-shift trick: draw from a shrunken range, then bump the
+    draw past each (sorted) already-excluded index.  Pure gathers and
+    compares; no rejection loop, so the shape is static under jit.
+    """
+    i = jnp.arange(n)
+    ka, kb, kc = jax.random.split(key, 3)
+
+    a = jax.random.randint(ka, (n,), 0, n - 1)
+    a = a + (a >= i)                                   # skip {i}
+
+    lo = jnp.minimum(i, a)
+    hi = jnp.maximum(i, a)
+    b = jax.random.randint(kb, (n,), 0, n - 2)
+    b = b + (b >= lo)
+    b = b + (b >= hi)                                  # skip {i, a}
+
+    e = jnp.sort(jnp.stack([i, a, b]), axis=0)         # [3, N] ascending
+    c = jax.random.randint(kc, (n,), 0, n - 3)
+    c = c + (c >= e[0])
+    c = c + (c >= e[1])
+    c = c + (c >= e[2])                                # skip {i, a, b}
+    return a, b, c
+
+
+def de_init(
+    objective: Callable,
+    n: int,
+    dim: int,
+    half_width: float,
+    seed: int = 0,
+    dtype=jnp.float32,
+) -> DEState:
+    if n < 4:
+        raise ValueError("DE needs a population of at least 4")
+    key = jax.random.PRNGKey(seed)
+    key, kp = jax.random.split(key)
+    pos = jax.random.uniform(
+        kp, (n, dim), dtype, minval=-half_width, maxval=half_width
+    )
+    fit = objective(pos)
+    best = jnp.argmin(fit)
+    return DEState(
+        pos=pos,
+        fit=fit,
+        best_pos=pos[best],
+        best_fit=fit[best],
+        key=key,
+        iteration=jnp.asarray(0, jnp.int32),
+    )
+
+
+def de_step(
+    state: DEState,
+    objective: Callable,
+    f: float = F,
+    cr: float = CR,
+    half_width: float = 5.12,
+    variant: str = "rand1bin",
+) -> DEState:
+    """One DE generation.  Pure; jit/scan/shard_map-friendly."""
+    n, d = state.pos.shape
+    key, k_idx, k_cr, k_jr = jax.random.split(state.key, 4)
+
+    a, b, c = _distinct3(k_idx, n)
+    if variant == "rand1bin":
+        base = state.pos[a]
+    elif variant == "best1bin":
+        base = jnp.broadcast_to(state.best_pos, state.pos.shape)
+    else:
+        raise ValueError(f"unknown DE variant {variant!r}")
+    mutant = base + f * (state.pos[b] - state.pos[c])
+    mutant = jnp.clip(mutant, -half_width, half_width)
+
+    # Binomial crossover; j_rand guarantees >= 1 mutant gene per row.
+    r = jax.random.uniform(k_cr, (n, d), state.pos.dtype)
+    j_rand = jax.random.randint(k_jr, (n,), 0, d)
+    cross = (r < cr) | (jnp.arange(d)[None, :] == j_rand[:, None])
+    trial = jnp.where(cross, mutant, state.pos)
+
+    trial_fit = objective(trial)
+    better = trial_fit <= state.fit
+    pos = jnp.where(better[:, None], trial, state.pos)
+    fit = jnp.where(better, trial_fit, state.fit)
+
+    # Same two-stage best reduction as PSO: per-shard argmin + pmin under
+    # shard_map (parallel/sharding.py applies to any State with this
+    # best_pos/best_fit contract).
+    idx = jnp.argmin(fit)
+    cand_fit = fit[idx]
+    cand_pos = pos[idx]
+    improved = cand_fit < state.best_fit
+    return DEState(
+        pos=pos,
+        fit=fit,
+        best_pos=jnp.where(improved, cand_pos, state.best_pos),
+        best_fit=jnp.where(improved, cand_fit, state.best_fit),
+        key=key,
+        iteration=state.iteration + 1,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("objective", "n_steps", "f", "cr", "half_width",
+                     "variant"),
+)
+def de_run(
+    state: DEState,
+    objective: Callable,
+    n_steps: int,
+    f: float = F,
+    cr: float = CR,
+    half_width: float = 5.12,
+    variant: str = "rand1bin",
+) -> DEState:
+    """``n_steps`` generations under one ``lax.scan``."""
+
+    def body(s, _):
+        return de_step(s, objective, f, cr, half_width, variant), None
+
+    state, _ = jax.lax.scan(body, state, None, length=n_steps)
+    return state

@@ -1,0 +1,110 @@
+"""Boids: emergent alignment, collision avoidance, toroidal wrapping,
+obstacle repulsion, trajectory recording, determinism."""
+
+import jax.numpy as jnp
+
+from distributed_swarm_algorithm_tpu.models.boids import Boids
+from distributed_swarm_algorithm_tpu.ops.boids import (
+    BoidsParams,
+    BoidsState,
+    _wrap,
+    boids_init,
+    boids_run,
+    boids_step,
+    nearest_neighbor_dist,
+    polarization,
+)
+
+
+def test_wrap_minimum_image():
+    hw = 10.0
+    x = jnp.asarray([9.0, -9.0, 11.0, -11.0, 0.0])
+    w = _wrap(x, hw)
+    assert bool((w >= -hw).all()) and bool((w < hw).all())
+    # 11 wraps to -9; the displacement between 9 and -9 is 2, not 18.
+    assert float(_wrap(jnp.asarray(9.0 - (-9.0)), hw)) == -2.0
+
+
+def test_alignment_emerges():
+    # A random flock should self-organize: polarization rises markedly.
+    flock = Boids(n=128, seed=0, half_width=20.0)
+    p0 = flock.polarization
+    flock.run(600)
+    p1 = flock.polarization
+    assert p1 > 0.8
+    assert p1 > p0 + 0.2
+
+
+def test_separation_prevents_collisions():
+    # Start everyone in a tight clump (the reference's default spawn is
+    # literally co-located, agent.py:47 — its physics crashes on it).
+    params = BoidsParams(half_width=20.0)
+    st = boids_init(64, 2, params, seed=1)
+    st = st.replace(pos=st.pos * 0.01)      # collapse into the origin
+    st, _ = boids_run(st, params, 300)
+    assert bool(jnp.isfinite(st.pos).all())
+    assert float(nearest_neighbor_dist(st, params.half_width)) > 0.3
+
+
+def test_positions_stay_in_box():
+    flock = Boids(n=64, seed=2)
+    flock.run(200)
+    hw = flock.params.half_width
+    assert bool((flock.state.pos >= -hw).all())
+    assert bool((flock.state.pos < hw).all())
+
+
+def test_speed_clamped():
+    flock = Boids(n=64, seed=3)
+    flock.run(100)
+    speed = jnp.linalg.norm(flock.state.vel, axis=-1)
+    p = flock.params
+    assert bool((speed <= p.max_speed + 1e-4).all())
+    assert bool((speed >= p.min_speed - 1e-4).all())
+
+
+def test_obstacle_keeps_boids_out():
+    obstacles = jnp.asarray([[0.0, 0.0, 4.0]])     # (x, y, r)
+    flock = Boids(n=96, seed=4, obstacles=obstacles, half_width=20.0)
+    flock.run(400)
+    d = jnp.linalg.norm(flock.state.pos, axis=-1)
+    # The interior of the obstacle stays essentially empty.
+    assert int(jnp.sum(d < 3.0)) <= 2
+
+
+def test_record_trajectory():
+    flock = Boids(n=16, seed=5)
+    traj = flock.run(25, record=True)
+    assert traj.shape == (25, 16, 2)
+    assert bool(jnp.allclose(traj[-1], flock.state.pos))
+
+
+def test_determinism_same_seed():
+    a = Boids(n=32, seed=7)
+    b = Boids(n=32, seed=7)
+    a.run(100)
+    b.run(100)
+    assert bool(jnp.array_equal(a.state.pos, b.state.pos))
+
+
+def test_step_matches_run():
+    params = BoidsParams()
+    sa = boids_init(24, 2, params, seed=8)
+    sb = sa
+    sa, _ = boids_run(sa, params, 10)
+    for _ in range(10):
+        sb = boids_step(sb, params)
+    assert bool(jnp.allclose(sa.pos, sb.pos, atol=1e-5))
+
+
+def test_3d_flock():
+    flock = Boids(n=48, dim=3, seed=9, half_width=15.0)
+    flock.run(150)
+    assert flock.state.pos.shape == (48, 3)
+    assert bool(jnp.isfinite(flock.state.pos).all())
+
+
+def test_param_overrides():
+    flock = Boids(n=8, seed=0, max_speed=2.5, r_align=4.0)
+    assert flock.params.max_speed == 2.5
+    assert flock.params.r_align == 4.0

@@ -1,0 +1,117 @@
+"""Differential evolution: distinct-index sampling, convergence,
+determinism, scan/step equivalence, domain containment."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_swarm_algorithm_tpu.models.de import DE
+from distributed_swarm_algorithm_tpu.ops.de import (
+    _distinct3,
+    de_init,
+    de_run,
+    de_step,
+)
+from distributed_swarm_algorithm_tpu.ops.objectives import get_objective
+
+
+@pytest.mark.parametrize("n", [4, 5, 64, 257])
+def test_distinct3_all_distinct(n):
+    for seed in range(3):
+        a, b, c = _distinct3(jax.random.PRNGKey(seed), n)
+        i = jnp.arange(n)
+        for x in (a, b, c):
+            assert bool((x >= 0).all()) and bool((x < n).all())
+            assert bool((x != i).all())
+        assert bool((a != b).all())
+        assert bool((a != c).all())
+        assert bool((b != c).all())
+
+
+def test_distinct3_uniform_marginals():
+    # Each donor index should be ~uniform over [0, n) \ {i}.
+    n, reps = 16, 4000
+    keys = jax.random.split(jax.random.PRNGKey(0), reps)
+    a = jax.vmap(lambda k: _distinct3(k, n)[0])(keys)       # [reps, n]
+    counts = jnp.zeros((n, n)).at[jnp.arange(n)[None, :], a].add(1.0)
+    off_diag = counts[~jnp.eye(n, dtype=bool)]
+    expected = reps / (n - 1)       # ~267; sd ~16 -> +-25% is >4 sigma
+    assert bool((off_diag > expected * 0.75).all())
+    assert bool((off_diag < expected * 1.25).all())
+
+
+def test_sphere_converges():
+    opt = DE("sphere", n=128, dim=5, seed=0)
+    opt.run(300)
+    assert opt.best < 1e-4
+
+
+def test_rastrigin_improves_substantially():
+    # Low CR suits separable objectives (per-dim moves stay independent).
+    opt = DE("rastrigin", n=256, dim=10, seed=1, cr=0.2)
+    start = float(opt.state.best_fit)
+    opt.run(500)
+    assert opt.best < start * 0.1
+
+
+def test_best1bin_variant_converges():
+    opt = DE("sphere", n=128, dim=5, seed=2, variant="best1bin")
+    opt.run(200)
+    assert opt.best < 1e-4
+
+
+def test_unknown_variant_raises():
+    opt = DE("sphere", n=16, dim=2, seed=0, variant="rand2exp")
+    with pytest.raises(ValueError, match="variant"):
+        opt.step()
+
+
+def test_min_population_enforced():
+    fn, hw = get_objective("sphere")
+    with pytest.raises(ValueError, match="at least 4"):
+        de_init(fn, n=3, dim=2, half_width=hw)
+
+
+def test_best_monotone():
+    opt = DE("ackley", n=64, dim=8, seed=2)
+    prev = float(opt.state.best_fit)
+    for _ in range(50):
+        opt.step()
+        cur = float(opt.state.best_fit)
+        assert cur <= prev + 1e-6
+        prev = cur
+
+
+def test_scan_matches_python_loop():
+    fn, hw = get_objective("sphere")
+    sa = de_init(fn, n=32, dim=4, half_width=hw, seed=3)
+    sb = sa
+    sa = de_run(sa, fn, 25, half_width=hw)
+    for _ in range(25):
+        sb = de_step(sb, fn, half_width=hw)
+    assert jnp.allclose(sa.best_fit, sb.best_fit, atol=1e-6)
+    assert jnp.allclose(sa.pos, sb.pos, atol=1e-6)
+
+
+def test_determinism_same_seed():
+    a = DE("rastrigin", n=64, dim=6, seed=7)
+    b = DE("rastrigin", n=64, dim=6, seed=7)
+    a.run(50)
+    b.run(50)
+    assert a.best == b.best
+
+
+def test_positions_stay_in_domain():
+    opt = DE("rastrigin", n=64, dim=6, seed=4)
+    opt.run(100)
+    hw = opt.half_width
+    assert bool((jnp.abs(opt.state.pos) <= hw + 1e-5).all())
+
+
+def test_fit_matches_pos():
+    # Selection must keep fit[i] == objective(pos[i]) in lockstep.
+    fn, hw = get_objective("rastrigin")
+    s = de_init(fn, n=48, dim=5, half_width=hw, seed=5)
+    s = de_run(s, fn, 30, half_width=hw)
+    assert jnp.allclose(s.fit, fn(s.pos), atol=1e-4)
+    assert jnp.allclose(s.best_fit, s.fit.min(), atol=1e-6)

@@ -142,6 +142,8 @@ def _cmd_swarm(args) -> int:
 
 
 def _cmd_pso(args) -> int:
+    if args.islands < 1:
+        raise SystemExit(f"error: --islands ({args.islands}) must be >= 1")
     if args.islands > 1:
         return _cmd_pso_islands(args)
 

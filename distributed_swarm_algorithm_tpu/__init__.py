@@ -36,9 +36,13 @@ from .state import (
 from .utils.config import DEFAULT_CONFIG, SwarmConfig
 from .models.swarm import VectorSwarm, swarm_rollout, swarm_tick
 from .models.pso import PSO
+from .models.memetic import MemeticPSO
 from .models.de import DE
 from .models.cmaes import CMAES
 from .models.boids import Boids
+from .models.aco import ACO
+from .models.abc_bees import ABC
+from .models.gwo import GWO
 from .ops import objectives
 from .ops.boids import BoidsParams, BoidsState, boids_init, boids_run, boids_step
 from .ops.cmaes import CMAESState, cmaes_init, cmaes_params, cmaes_run, cmaes_step
@@ -56,9 +60,21 @@ from .ops.coordination import (
     kill,
     revive,
 )
+from .ops.abc import ABCState, abc_init, abc_run, abc_step
+from .ops.aco import (
+    ACOState,
+    aco_init,
+    aco_run,
+    aco_step,
+    coords_to_dist,
+    tour_lengths,
+)
+from .ops.gwo import GWOState, gwo_init, gwo_run, gwo_step
+from .ops.memetic import gd_refine, memetic_run, refine_pbest
 from .ops.pallas import fused_pso_run
 from .ops.physics import apf_forces, formation_targets, physics_step
 from .ops.pso import PSOState, pso_init, pso_run, pso_step
+from .ops.topology import neighbor_best, ring_best, von_neumann_best
 
 __version__ = "0.1.0"
 
@@ -66,11 +82,17 @@ __all__ = [
     "SwarmConfig", "DEFAULT_CONFIG", "SwarmState", "make_swarm", "with_tasks",
     "VectorSwarm", "swarm_tick", "swarm_rollout", "PSO",
     "PSOState", "pso_init", "pso_step", "pso_run", "fused_pso_run",
+    "MemeticPSO", "memetic_run", "refine_pbest", "gd_refine",
+    "neighbor_best", "ring_best", "von_neumann_best",
     "DE", "DEState", "de_init", "de_step", "de_run",
     "CMAES", "CMAESState", "cmaes_params", "cmaes_init", "cmaes_step",
     "cmaes_run",
     "Boids", "BoidsParams", "BoidsState", "boids_init", "boids_step",
     "boids_run",
+    "ACO", "ACOState", "aco_init", "aco_step", "aco_run",
+    "coords_to_dist", "tour_lengths",
+    "ABC", "ABCState", "abc_init", "abc_step", "abc_run",
+    "GWO", "GWOState", "gwo_init", "gwo_step", "gwo_run",
     "objectives",
     "coordination_step", "instant_election", "current_leader", "kill",
     "revive",

@@ -7,10 +7,14 @@ reference could not actually run:
   agent   one per-agent process (reference-compatible; UDP transport works)
   sim     N agents on an in-process bus, stepped in lockstep
   swarm   the vectorized TPU swarm (VectorSwarm)
-  pso     particle-swarm optimization on a benchmark objective
+  pso     particle-swarm optimization (gbest/lbest topologies, memetic
+          jax.grad refinement, island model)
   de      differential evolution on a benchmark objective
   cmaes   CMA-ES on a benchmark objective
   boids   Reynolds flocking simulation (order-parameter report)
+  aco     ant-colony TSP solver
+  abc     artificial bee colony on a benchmark objective
+  gwo     grey wolf optimizer on a benchmark objective
   bench   the headline benchmark (same as bench.py)
 
 ``python -m distributed_swarm_algorithm_tpu --id 1 --count 3 --caps lift``
@@ -145,11 +149,30 @@ def _cmd_pso(args) -> int:
     if args.islands < 1:
         raise SystemExit(f"error: --islands ({args.islands}) must be >= 1")
     if args.islands > 1:
+        # The island path has its own migration-based social structure;
+        # reject flags it would otherwise silently drop.
+        if args.topology != "gbest" or args.refine_every > 0:
+            raise SystemExit(
+                "error: --topology/--refine-every are not supported with "
+                "--islands > 1 (each island is a gbest swarm; diversity "
+                "comes from migration)"
+            )
         return _cmd_pso_islands(args)
 
-    from .models.pso import PSO
+    kwargs = dict(topology=args.topology, ring_radius=args.ring_radius)
+    if args.refine_every > 0:
+        from .models.memetic import MemeticPSO
 
-    opt = PSO(args.objective, n=args.n, dim=args.dim, seed=args.seed)
+        opt = MemeticPSO(
+            args.objective, n=args.n, dim=args.dim, seed=args.seed,
+            refine_every=args.refine_every, refine_steps=args.refine_steps,
+            lr=args.lr, **kwargs,
+        )
+    else:
+        from .models.pso import PSO
+
+        opt = PSO(args.objective, n=args.n, dim=args.dim, seed=args.seed,
+                  **kwargs)
     start = time.perf_counter()
     opt.run(args.steps)
     elapsed = time.perf_counter() - start
@@ -158,6 +181,8 @@ def _cmd_pso(args) -> int:
         "particles": args.n,
         "dim": args.dim,
         "iters": args.steps,
+        "topology": args.topology,
+        "memetic": args.refine_every > 0,
         "best": opt.best,
         "steps_per_sec": round(args.steps / elapsed, 1),
     }))
@@ -278,6 +303,71 @@ def _cmd_boids(args) -> int:
     return 0
 
 
+def _cmd_aco(args) -> int:
+    import numpy as np
+
+    from .models.aco import ACO
+
+    rng = np.random.default_rng(args.seed)
+    if args.cities_file:
+        coords = np.loadtxt(args.cities_file, delimiter=",")
+    else:
+        coords = rng.uniform(0.0, 100.0, size=(args.cities, 2))
+    colony = ACO(coords=coords, n_ants=args.ants, alpha=args.alpha,
+                 beta=args.beta, rho=args.rho, q0=args.q0,
+                 elite=args.elite, seed=args.seed)
+    start = time.perf_counter()
+    colony.run(args.steps)
+    elapsed = time.perf_counter() - start
+    print(json.dumps({
+        "cities": int(coords.shape[0]),
+        "ants": args.ants,
+        "iters": args.steps,
+        "best_length": round(colony.best_length, 4),
+        "steps_per_sec": round(args.steps / elapsed, 1),
+    }))
+    return 0
+
+
+def _cmd_abc(args) -> int:
+    from .models.abc_bees import ABC
+
+    opt = ABC(args.objective, n=args.n, dim=args.dim, limit=args.limit,
+              seed=args.seed)
+    start = time.perf_counter()
+    opt.run(args.steps)
+    elapsed = time.perf_counter() - start
+    print(json.dumps({
+        "objective": args.objective,
+        "sources": args.n,
+        "dim": args.dim,
+        "iters": args.steps,
+        "best": opt.best,
+        "steps_per_sec": round(args.steps / elapsed, 1),
+    }))
+    return 0
+
+
+def _cmd_gwo(args) -> int:
+    from .models.gwo import GWO
+
+    opt = GWO(args.objective, n=args.n, dim=args.dim,
+              t_max=args.t_max if args.t_max else args.steps,
+              seed=args.seed)
+    start = time.perf_counter()
+    opt.run(args.steps)
+    elapsed = time.perf_counter() - start
+    print(json.dumps({
+        "objective": args.objective,
+        "wolves": args.n,
+        "dim": args.dim,
+        "iters": args.steps,
+        "best": opt.best,
+        "steps_per_sec": round(args.steps / elapsed, 1),
+    }))
+    return 0
+
+
 def _cmd_bench(args) -> int:
     # bench.py lives at the repo root (a driver contract), outside the
     # package — resolve it relative to this file so the subcommand works
@@ -341,6 +431,16 @@ def build_parser() -> argparse.ArgumentParser:
                             "with periodic ring migration")
     p_pso.add_argument("--migrate-every", type=int, default=25)
     p_pso.add_argument("--migrate-k", type=int, default=4)
+    p_pso.add_argument("--topology", default="gbest",
+                       choices=["gbest", "ring", "vonneumann"],
+                       help="social topology (lbest ring / torus grid)")
+    p_pso.add_argument("--ring-radius", type=int, default=1)
+    p_pso.add_argument("--refine-every", type=int, default=0,
+                       help="memetic mode: jax.grad refinement every K "
+                            "iterations (0 = off)")
+    p_pso.add_argument("--refine-steps", type=int, default=5)
+    p_pso.add_argument("--lr", type=float, default=0.01,
+                       help="memetic gradient-descent learning rate")
     p_pso.set_defaults(fn=_cmd_pso)
 
     p_de = sub.add_parser("de", help="differential evolution")
@@ -373,6 +473,44 @@ def build_parser() -> argparse.ArgumentParser:
     p_boids.add_argument("--seed", type=int, default=0)
     p_boids.add_argument("--half-width", type=float, default=50.0)
     p_boids.set_defaults(fn=_cmd_boids)
+
+    p_aco = sub.add_parser("aco", help="ant-colony TSP solver")
+    p_aco.add_argument("--cities", type=int, default=32,
+                       help="random-uniform instance size")
+    p_aco.add_argument("--cities-file", default=None,
+                       help="CSV of x,y coordinates (overrides --cities)")
+    p_aco.add_argument("--ants", type=int, default=64)
+    p_aco.add_argument("--steps", type=int, default=200)
+    p_aco.add_argument("--alpha", type=float, default=1.0)
+    p_aco.add_argument("--beta", type=float, default=2.0)
+    p_aco.add_argument("--rho", type=float, default=0.1)
+    p_aco.add_argument("--q0", type=float, default=0.0,
+                       help="ACS exploitation probability")
+    p_aco.add_argument("--elite", type=float, default=0.0,
+                       help="elitist deposit weight on best-so-far tour")
+    p_aco.add_argument("--seed", type=int, default=0)
+    p_aco.set_defaults(fn=_cmd_aco)
+
+    p_abc = sub.add_parser("abc", help="artificial bee colony")
+    p_abc.add_argument("--objective", default="rastrigin")
+    p_abc.add_argument("--n", type=int, default=128,
+                       help="food sources (= employed bees = onlookers)")
+    p_abc.add_argument("--dim", type=int, default=30)
+    p_abc.add_argument("--steps", type=int, default=500)
+    p_abc.add_argument("--limit", type=int, default=None,
+                       help="scout abandonment limit (default n*dim)")
+    p_abc.add_argument("--seed", type=int, default=0)
+    p_abc.set_defaults(fn=_cmd_abc)
+
+    p_gwo = sub.add_parser("gwo", help="grey wolf optimizer")
+    p_gwo.add_argument("--objective", default="rastrigin")
+    p_gwo.add_argument("--n", type=int, default=128)
+    p_gwo.add_argument("--dim", type=int, default=30)
+    p_gwo.add_argument("--steps", type=int, default=500)
+    p_gwo.add_argument("--t-max", type=int, default=0,
+                       help="exploration schedule length (default --steps)")
+    p_gwo.add_argument("--seed", type=int, default=0)
+    p_gwo.set_defaults(fn=_cmd_gwo)
 
     p_bench = sub.add_parser("bench", help="headline benchmark")
     p_bench.set_defaults(fn=_cmd_bench)

@@ -1,0 +1,63 @@
+"""User-facing artificial-bee-colony optimizer model."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+
+from ..ops import abc as _k
+from ..ops.objectives import get_objective
+from ._checkpoint import CheckpointMixin
+
+
+class ABC(CheckpointMixin):
+    """Artificial bee colony (employed / onlooker / scout phases).
+
+    >>> opt = ABC("rastrigin", n=256, dim=10, seed=0)
+    >>> opt.run(300)
+    >>> opt.best  # doctest: +SKIP
+    """
+
+    def __init__(
+        self,
+        objective: Union[str, Callable],
+        n: int,
+        dim: int,
+        half_width: Optional[float] = None,
+        limit: Optional[int] = None,
+        seed: int = 0,
+        dtype=None,
+    ):
+        if isinstance(objective, str):
+            fn, default_hw = get_objective(objective)
+        else:
+            fn, default_hw = objective, 5.12
+        self.objective = fn
+        self.half_width = float(
+            half_width if half_width is not None else default_hw
+        )
+        # Karaboga's rule of thumb: limit = sources * dim
+        self.limit = int(limit if limit is not None else n * dim)
+        kwargs = {} if dtype is None else {"dtype": dtype}
+        self.state = _k.abc_init(
+            fn, n, dim, self.half_width, seed=seed, **kwargs
+        )
+
+    def step(self) -> _k.ABCState:
+        self.state = _k.abc_step(
+            self.state, self.objective, self.half_width, self.limit
+        )
+        return self.state
+
+    def run(self, n_steps: int) -> _k.ABCState:
+        self.state = _k.abc_run(
+            self.state, self.objective, n_steps, self.half_width,
+            self.limit,
+        )
+        jax.block_until_ready(self.state.best_fit)
+        return self.state
+
+    @property
+    def best(self) -> float:
+        return float(self.state.best_fit)

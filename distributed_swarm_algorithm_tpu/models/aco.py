@@ -1,0 +1,76 @@
+"""User-facing ACO (ant colony) TSP solver."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import aco as _k
+from ._checkpoint import CheckpointMixin
+
+
+class ACO(CheckpointMixin):
+    """Ant-colony TSP solver over a coordinate set or distance matrix.
+
+    The whole colony steps as one jitted kernel (ops/aco.py): per
+    construction step every ant samples its next city simultaneously via
+    masked Gumbel-argmax over pheromone × heuristic scores.
+
+    >>> import numpy as np
+    >>> pts = np.random.default_rng(0).uniform(size=(24, 2))
+    >>> colony = ACO(coords=pts, n_ants=64, seed=0)
+    >>> colony.run(50)
+    >>> colony.best_length  # doctest: +SKIP
+    """
+
+    def __init__(
+        self,
+        coords=None,
+        dist=None,
+        n_ants: int = 64,
+        alpha: float = 1.0,
+        beta: float = 2.0,
+        rho: float = 0.1,
+        q0: float = 0.0,
+        elite: float = 0.0,
+        seed: int = 0,
+        tau0: Optional[float] = None,
+    ):
+        if (coords is None) == (dist is None):
+            raise ValueError("pass exactly one of coords= or dist=")
+        if dist is None:
+            dist = _k.coords_to_dist(jnp.asarray(coords, jnp.float32))
+        else:
+            dist = jnp.asarray(dist, jnp.float32)
+            if dist.ndim != 2 or dist.shape[0] != dist.shape[1]:
+                raise ValueError(f"dist must be square, got {dist.shape}")
+        self.n_ants = int(n_ants)
+        self.alpha, self.beta = float(alpha), float(beta)
+        self.rho, self.q0, self.elite = float(rho), float(q0), float(elite)
+        self.state = _k.aco_init(dist, seed=seed, tau0=tau0)
+
+    def step(self) -> _k.ACOState:
+        self.state = _k.aco_step(
+            self.state, self.n_ants, self.alpha, self.beta, self.rho,
+            self.q0, self.elite,
+        )
+        return self.state
+
+    def run(self, n_steps: int) -> _k.ACOState:
+        self.state = _k.aco_run(
+            self.state, n_steps, self.n_ants, self.alpha, self.beta,
+            self.rho, self.q0, self.elite,
+        )
+        jax.block_until_ready(self.state.best_len)
+        return self.state
+
+    @property
+    def best_length(self) -> float:
+        return float(self.state.best_len)
+
+    @property
+    def best_tour(self) -> np.ndarray:
+        return np.asarray(self.state.best_tour)

@@ -1,0 +1,67 @@
+"""User-facing grey-wolf optimizer model."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+
+from ..ops import gwo as _k
+from ..ops.objectives import get_objective
+from ._checkpoint import CheckpointMixin
+
+
+class GWO(CheckpointMixin):
+    """Grey wolf optimizer (alpha/beta/delta-led pack).
+
+    ``t_max`` sets the exploration schedule length (a: 2 → 0); the pack
+    exploits fully once ``t_max`` iterations have elapsed.
+
+    >>> opt = GWO("rastrigin", n=256, dim=10, t_max=300, seed=0)
+    >>> opt.run(300)
+    >>> opt.best  # doctest: +SKIP
+    """
+
+    def __init__(
+        self,
+        objective: Union[str, Callable],
+        n: int,
+        dim: int,
+        half_width: Optional[float] = None,
+        t_max: int = 500,
+        seed: int = 0,
+        dtype=None,
+    ):
+        if isinstance(objective, str):
+            fn, default_hw = get_objective(objective)
+        else:
+            fn, default_hw = objective, 5.12
+        self.objective = fn
+        self.half_width = float(
+            half_width if half_width is not None else default_hw
+        )
+        if t_max < 1:
+            raise ValueError(f"t_max must be >= 1, got {t_max}")
+        self.t_max = int(t_max)
+        kwargs = {} if dtype is None else {"dtype": dtype}
+        self.state = _k.gwo_init(
+            fn, n, dim, self.half_width, seed=seed, **kwargs
+        )
+
+    def step(self) -> _k.GWOState:
+        self.state = _k.gwo_step(
+            self.state, self.objective, self.half_width, self.t_max
+        )
+        return self.state
+
+    def run(self, n_steps: int) -> _k.GWOState:
+        self.state = _k.gwo_run(
+            self.state, self.objective, n_steps, self.half_width,
+            self.t_max,
+        )
+        jax.block_until_ready(self.state.leader_fit)
+        return self.state
+
+    @property
+    def best(self) -> float:
+        return float(self.state.leader_fit[0])

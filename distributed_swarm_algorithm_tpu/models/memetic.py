@@ -1,0 +1,55 @@
+"""User-facing memetic (gradient-hybrid) PSO model."""
+
+from __future__ import annotations
+
+import jax
+
+from ..ops import memetic as _m
+from ..ops import pso as _k
+from .pso import PSO
+
+
+class MemeticPSO(PSO):
+    """PSO + periodic ``jax.grad`` local refinement of personal bests.
+
+    Same constructor as :class:`PSO` plus the refinement schedule; the
+    fused Pallas path is disabled (refinement needs autodiff, which runs
+    on the portable XLA path).
+
+    >>> opt = MemeticPSO("rosenbrock", n=512, dim=10, refine_every=5)
+    >>> opt.run(100)
+    >>> opt.best  # doctest: +SKIP
+    """
+
+    def __init__(
+        self,
+        objective,
+        n: int,
+        dim: int,
+        refine_every: int = 10,
+        refine_steps: int = 5,
+        lr: float = 0.01,
+        **kwargs,
+    ):
+        kwargs.setdefault("use_pallas", False)
+        if kwargs["use_pallas"]:
+            raise ValueError("MemeticPSO runs on the portable XLA path")
+        super().__init__(objective, n, dim, **kwargs)
+        if refine_every < 1:
+            raise ValueError(
+                f"refine_every must be >= 1, got {refine_every} "
+                "(use PSO for no refinement)"
+            )
+        self.refine_every = int(refine_every)
+        self.refine_steps = int(refine_steps)
+        self.lr = float(lr)
+
+    def run(self, n_steps: int) -> _k.PSOState:
+        self.state = _m.memetic_run(
+            self.state, self.objective, n_steps,
+            self.refine_every, self.refine_steps, self.lr,
+            self.w, self.c1, self.c2, self.half_width, self.vmax_frac,
+            self.topology, self.ring_radius, self.grid_cols,
+        )
+        jax.block_until_ready(self.state.gbest_fit)
+        return self.state

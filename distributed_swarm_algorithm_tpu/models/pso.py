@@ -7,6 +7,7 @@ from typing import Callable, Optional, Union
 import jax
 
 from ..ops import pso as _k
+from ..ops import topology as _topo
 from ..ops.objectives import get_objective
 from ..ops.pallas import pso_fused as _pf
 from ..utils.platform import on_tpu as _on_tpu
@@ -42,6 +43,9 @@ class PSO(CheckpointMixin):
         dtype=None,
         use_pallas: Optional[bool] = None,
         steps_per_kernel: int = 8,
+        topology: str = "gbest",
+        ring_radius: int = 1,
+        grid_cols: int = 0,
     ):
         if isinstance(objective, str):
             fn, default_hw = get_objective(objective)
@@ -56,20 +60,33 @@ class PSO(CheckpointMixin):
         self.w, self.c1, self.c2 = float(w), float(c1), float(c2)
         self.vmax_frac = float(vmax_frac)
         self.steps_per_kernel = int(steps_per_kernel)
+        if topology not in _topo.TOPOLOGIES:
+            raise ValueError(
+                f"unknown topology {topology!r}; "
+                f"available: {_topo.TOPOLOGIES}"
+            )
+        self.topology = topology
+        self.ring_radius = int(ring_radius)
+        self.grid_cols = int(grid_cols)
         kwargs = {} if dtype is None else {"dtype": dtype}
         self.state = _k.pso_init(
             fn, n, dim, self.half_width, seed=seed, **kwargs
         )
 
-        supported = self.objective_name is not None and _pf.pallas_supported(
-            self.objective_name or "", self.state.pos.dtype
+        # The fused Pallas kernel implements the gbest attractor only.
+        supported = (
+            topology == "gbest"
+            and self.objective_name is not None
+            and _pf.pallas_supported(
+                self.objective_name or "", self.state.pos.dtype
+            )
         )
         if use_pallas is None:
             self.use_pallas = supported and _on_tpu()
         elif use_pallas and not supported:
             raise ValueError(
                 "use_pallas=True needs a named objective from "
-                "ops.objectives and float32 state"
+                "ops.objectives, float32 state, and topology='gbest'"
             )
         else:
             self.use_pallas = bool(use_pallas)
@@ -78,6 +95,7 @@ class PSO(CheckpointMixin):
         self.state = _k.pso_step(
             self.state, self.objective, self.w, self.c1, self.c2,
             self.half_width, self.vmax_frac,
+            self.topology, self.ring_radius, self.grid_cols,
         )
         return self.state
 
@@ -95,6 +113,7 @@ class PSO(CheckpointMixin):
             self.state = _k.pso_run(
                 self.state, self.objective, n_steps, self.w, self.c1,
                 self.c2, self.half_width, self.vmax_frac,
+                self.topology, self.ring_radius, self.grid_cols,
             )
         jax.block_until_ready(self.state.gbest_fit)
         return self.state

@@ -1,0 +1,181 @@
+"""Artificial-bee-colony kernels (Karaboga's ABC), TPU-vectorized.
+
+With PSO and ACO this completes the classic swarm-intelligence trio.
+The reference offers no optimizer at all (its only fitness logic is the
+task-utility rule, /root/reference/agent.py:338-347); ABC's
+employed/onlooker/scout division of labor is the population analog of the
+reference's forager/leader role split.
+
+TPU-first formulation:
+  - every phase updates ALL food sources at once — the classic per-bee
+    loop becomes masked array ops;
+  - the "mutate one random dimension against one random partner" rule is
+    a one-hot dimension mask + a gathered partner row;
+  - onlooker fitness-proportional recruitment is a single categorical
+    sample (Gumbel top-1 per onlooker) — no roulette-wheel loop;
+  - scouts re-randomize exhausted sources with a vectorized where.
+
+Greedy acceptance keeps source fitness monotone per phase.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class ABCState:
+    """S food sources in D dims; one employed bee per source."""
+
+    pos: jax.Array       # [S, D]
+    fit: jax.Array       # [S] raw objective values (lower is better)
+    trials: jax.Array    # [S] i32 stagnation counters
+    best_pos: jax.Array  # [D]
+    best_fit: jax.Array  # scalar
+    key: jax.Array
+    iteration: jax.Array
+
+
+def abc_init(
+    objective: Callable,
+    n_sources: int,
+    dim: int,
+    half_width: float,
+    seed: int = 0,
+    dtype=jnp.float32,
+) -> ABCState:
+    key = jax.random.PRNGKey(seed)
+    key, kp = jax.random.split(key)
+    pos = jax.random.uniform(
+        kp, (n_sources, dim), dtype, minval=-half_width, maxval=half_width
+    )
+    fit = objective(pos)
+    best = jnp.argmin(fit)
+    return ABCState(
+        pos=pos,
+        fit=fit,
+        trials=jnp.zeros((n_sources,), jnp.int32),
+        best_pos=pos[best],
+        best_fit=fit[best],
+        key=key,
+        iteration=jnp.asarray(0, jnp.int32),
+    )
+
+
+def _mutate(
+    pos: jax.Array,
+    base_idx: jax.Array,
+    key: jax.Array,
+    half_width: float,
+) -> jax.Array:
+    """v = x_b ± phi·(x_b − x_k) on ONE random dim per row (ABC rule)."""
+    s, d = pos.shape
+    kk, kj, kphi = jax.random.split(key, 3)
+    base = pos[base_idx]                                    # [S, D]
+    # partner k != base row: shift a uniform draw past the base index
+    draw = jax.random.randint(kk, (s,), 0, s - 1)
+    partner = jnp.where(draw >= base_idx, draw + 1, draw)
+    j = jax.random.randint(kj, (s,), 0, d)
+    phi = jax.random.uniform(kphi, (s,), pos.dtype, -1.0, 1.0)
+    onehot = jax.nn.one_hot(j, d, dtype=pos.dtype)          # [S, D]
+    cand = base + onehot * (phi[:, None] * (base - pos[partner]))
+    return jnp.clip(cand, -half_width, half_width)
+
+
+def _greedy(
+    pos, fit, trials, cand, cand_fit
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    better = cand_fit < fit
+    return (
+        jnp.where(better[:, None], cand, pos),
+        jnp.where(better, cand_fit, fit),
+        jnp.where(better, 0, trials + 1),
+    )
+
+
+@partial(jax.jit, static_argnames=("objective", "half_width", "limit"))
+def abc_step(
+    state: ABCState,
+    objective: Callable,
+    half_width: float = 5.12,
+    limit: int = 20,
+) -> ABCState:
+    """One ABC cycle: employed phase, onlooker phase, scout phase."""
+    s = state.pos.shape[0]
+    key, ke, ko, ksel, ks = jax.random.split(state.key, 5)
+
+    # --- employed bees: one candidate per source ------------------------
+    ident = jnp.arange(s)
+    cand = _mutate(state.pos, ident, ke, half_width)
+    pos, fit, trials = _greedy(
+        state.pos, state.fit, state.trials, cand, objective(cand)
+    )
+
+    # --- onlooker bees: recruit sources by quality, mutate them ---------
+    # quality: monotone decreasing in raw fitness, safe for any sign
+    quality = 1.0 / (1.0 + jnp.where(fit >= 0, fit, 0.0)) + jnp.where(
+        fit < 0, -fit, 0.0
+    )
+    logits = jnp.log(quality + 1e-12)
+    chosen = jax.random.categorical(ksel, logits, shape=(s,))
+    cand = _mutate(pos, chosen, ko, half_width)
+    cand_fit = objective(cand)
+    # Several onlookers may pick the same source; the best candidate per
+    # source wins (segment-min), ties broken by lowest onlooker row so
+    # exactly one candidate row is gathered per source.
+    seg_best = jnp.full((s,), jnp.inf, fit.dtype).at[chosen].min(cand_fit)
+    is_winner = cand_fit == seg_best[chosen]
+    rows = jnp.arange(s)
+    winner_row = (
+        jnp.full((s,), s, jnp.int32)
+        .at[chosen]
+        .min(jnp.where(is_winner, rows, s).astype(jnp.int32))
+    )
+    accept_src = seg_best < fit                     # inf where unchosen
+    src_cand = cand[jnp.clip(winner_row, 0, s - 1)]
+    pos = jnp.where(accept_src[:, None], src_cand, pos)
+    trials = jnp.where(accept_src, 0, trials + 1)
+    fit = jnp.where(accept_src, seg_best, fit)
+
+    # --- scout bees: abandon exhausted sources --------------------------
+    exhausted = trials > limit
+    fresh = jax.random.uniform(
+        ks, pos.shape, pos.dtype, -half_width, half_width
+    )
+    pos = jnp.where(exhausted[:, None], fresh, pos)
+    fit = jnp.where(exhausted, objective(fresh), fit)
+    trials = jnp.where(exhausted, 0, trials)
+
+    best = jnp.argmin(fit)
+    improved = fit[best] < state.best_fit
+    return ABCState(
+        pos=pos,
+        fit=fit,
+        trials=trials,
+        best_pos=jnp.where(improved, pos[best], state.best_pos),
+        best_fit=jnp.where(improved, fit[best], state.best_fit),
+        key=key,
+        iteration=state.iteration + 1,
+    )
+
+
+@partial(
+    jax.jit, static_argnames=("objective", "n_steps", "half_width", "limit")
+)
+def abc_run(
+    state: ABCState,
+    objective: Callable,
+    n_steps: int,
+    half_width: float = 5.12,
+    limit: int = 20,
+) -> ABCState:
+    def body(st, _):
+        return abc_step(st, objective, half_width, limit), None
+
+    state, _ = jax.lax.scan(body, state, None, length=n_steps)
+    return state

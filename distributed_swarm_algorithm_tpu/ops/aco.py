@@ -1,0 +1,205 @@
+"""Ant-colony-optimization kernels (TSP), TPU-vectorized.
+
+Extends the framework into combinatorial territory the reference's greedy
+task-utility rule (/root/reference/agent.py:338-347) gestures at: many
+agents concurrently claiming discrete resources.  ACO is the canonical
+swarm algorithm for that problem class.
+
+TPU-first formulation (Ant System / Ant Colony System, Dorigo et al.):
+  - the colony is vectorized — ALL ants take their construction step at
+    once: the carry is ``(current_city [A], visited [A, C])`` and one
+    scan step does a row-gather of pheromone/heuristic, a masked
+    Gumbel-argmax sample (categorical sampling without normalization),
+    and a mask update — no per-ant Python, no rejection loops;
+  - tour construction is a single ``lax.scan`` of C-1 such steps;
+  - evaporation + deposit is one scatter-add epoch over the [C, C]
+    pheromone matrix (symmetric: both edge directions);
+  - an optional ACS-style ``q0`` exploitation knob mixes greedy argmax
+    with sampling per ant per step.
+
+Static shapes throughout: C cities, A ants, [A, C] tours.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+_EPS = 1e-10
+_NEG = -1e30
+
+
+@struct.dataclass
+class ACOState:
+    """Colony state for one TSP instance."""
+
+    tau: jax.Array        # [C, C] pheromone
+    dist: jax.Array       # [C, C] edge lengths (0 diagonal)
+    best_tour: jax.Array  # [C] city indices of best-so-far tour
+    best_len: jax.Array   # scalar
+    key: jax.Array
+    iteration: jax.Array  # i32 scalar
+
+
+def tour_lengths(dist: jax.Array, tours: jax.Array) -> jax.Array:
+    """[A] closed-tour lengths for [A, C] city-index tours."""
+    nxt = jnp.roll(tours, -1, axis=1)
+    return jnp.sum(dist[tours, nxt], axis=1)
+
+
+def coords_to_dist(coords: jax.Array) -> jax.Array:
+    """Euclidean [C, C] distance matrix from [C, D] coordinates."""
+    diff = coords[:, None, :] - coords[None, :, :]
+    return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 0.0)
+
+
+def aco_init(
+    dist: jax.Array,
+    seed: int = 0,
+    tau0: Optional[float] = None,
+) -> ACOState:
+    """Initialize pheromone to ``tau0`` (default 1 / (C * mean edge))."""
+    c = dist.shape[0]
+    if tau0 is None:
+        mean_edge = jnp.sum(dist) / (c * (c - 1))
+        tau0 = 1.0 / (c * mean_edge)
+    tau = jnp.full((c, c), tau0, dist.dtype)
+    return ACOState(
+        tau=tau,
+        dist=dist,
+        best_tour=jnp.arange(c, dtype=jnp.int32),
+        best_len=jnp.asarray(jnp.inf, dist.dtype),
+        key=jax.random.PRNGKey(seed),
+        iteration=jnp.asarray(0, jnp.int32),
+    )
+
+
+def construct_tours(
+    tau: jax.Array,
+    dist: jax.Array,
+    key: jax.Array,
+    n_ants: int,
+    alpha: float = 1.0,
+    beta: float = 2.0,
+    q0: float = 0.0,
+) -> jax.Array:
+    """All ants build closed tours simultaneously → [A, C] int32.
+
+    Each step samples the next city from p ∝ tau^alpha * eta^beta over
+    unvisited cities via Gumbel-argmax; with probability ``q0`` an ant
+    exploits (pure argmax, ACS rule) instead.
+    """
+    c = dist.shape[0]
+    eta = 1.0 / (dist + jnp.eye(c, dtype=dist.dtype) + _EPS)
+    # log-space scores; eta's fake diagonal is masked out by `visited`.
+    logits = alpha * jnp.log(tau + _EPS) + beta * jnp.log(eta)
+
+    key, k0 = jax.random.split(key)
+    start = jax.random.randint(k0, (n_ants,), 0, c)
+    visited = jax.nn.one_hot(start, c, dtype=jnp.bool_)
+
+    def step(carry, k):
+        cur, visited = carry
+        kg, kq = jax.random.split(k)
+        row = logits[cur]                                  # [A, C]
+        row = jnp.where(visited, _NEG, row)
+        g = jax.random.gumbel(kg, row.shape, row.dtype)
+        sampled = jnp.argmax(row + g, axis=1)
+        greedy = jnp.argmax(row, axis=1)
+        exploit = jax.random.uniform(kq, (n_ants,)) < q0
+        nxt = jnp.where(exploit, greedy, sampled).astype(jnp.int32)
+        visited = visited | jax.nn.one_hot(nxt, c, dtype=jnp.bool_)
+        return (nxt, visited), nxt
+
+    keys = jax.random.split(key, c - 1)
+    _, rest = jax.lax.scan(step, (start.astype(jnp.int32), visited), keys)
+    return jnp.concatenate(
+        [start.astype(jnp.int32)[None, :], rest], axis=0
+    ).T                                                    # [A, C]
+
+
+def deposit(
+    tau: jax.Array,
+    tours: jax.Array,
+    lengths: jax.Array,
+    rho: float,
+    q: float = 1.0,
+) -> jax.Array:
+    """Evaporate then scatter-add Q/L onto each ant's edges (symmetric)."""
+    u = tours
+    v = jnp.roll(tours, -1, axis=1)
+    amount = jnp.broadcast_to((q / lengths)[:, None], u.shape)
+    tau = (1.0 - rho) * tau
+    tau = tau.at[u, v].add(amount)
+    tau = tau.at[v, u].add(amount)
+    return tau
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_ants", "alpha", "beta", "rho", "q0", "elite"),
+)
+def aco_step(
+    state: ACOState,
+    n_ants: int,
+    alpha: float = 1.0,
+    beta: float = 2.0,
+    rho: float = 0.1,
+    q0: float = 0.0,
+    elite: float = 0.0,
+) -> ACOState:
+    """One colony iteration: construct, evaluate, evaporate, deposit.
+
+    ``elite`` > 0 adds an elitist deposit of ``elite * Q/L_best`` on the
+    best-so-far tour each iteration.
+    """
+    key, kc = jax.random.split(state.key)
+    tours = construct_tours(
+        state.tau, state.dist, kc, n_ants, alpha, beta, q0
+    )
+    lengths = tour_lengths(state.dist, tours)
+
+    best = jnp.argmin(lengths)
+    improved = lengths[best] < state.best_len
+    best_len = jnp.where(improved, lengths[best], state.best_len)
+    best_tour = jnp.where(improved, tours[best], state.best_tour)
+
+    tau = deposit(state.tau, tours, lengths, rho)
+    if elite > 0.0:
+        tau = deposit(tau, best_tour[None, :], best_len[None] / elite,
+                      rho=0.0)
+    return state.replace(
+        tau=tau,
+        best_tour=best_tour,
+        best_len=best_len,
+        key=key,
+        iteration=state.iteration + 1,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_steps", "n_ants", "alpha", "beta", "rho", "q0",
+                     "elite"),
+)
+def aco_run(
+    state: ACOState,
+    n_steps: int,
+    n_ants: int,
+    alpha: float = 1.0,
+    beta: float = 2.0,
+    rho: float = 0.1,
+    q0: float = 0.0,
+    elite: float = 0.0,
+) -> ACOState:
+    """``n_steps`` colony iterations under one ``lax.scan``."""
+
+    def body(s, _):
+        return aco_step(s, n_ants, alpha, beta, rho, q0, elite), None
+
+    state, _ = jax.lax.scan(body, state, None, length=n_steps)
+    return state

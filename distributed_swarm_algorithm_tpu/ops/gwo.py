@@ -1,0 +1,119 @@
+"""Grey-wolf-optimizer kernels (Mirjalili et al. 2014), TPU-vectorized.
+
+GWO is the population optimizer whose social model most closely mirrors
+the reference's leadership hierarchy: a strict alpha/beta/delta ranking
+steers the pack, exactly as the reference's elected leader steers its
+followers (election at /root/reference/agent.py:216-289, formation
+slots at 96-111).  Here the "election" of the three leaders is a top-3
+reduction over pack fitness each step — the same argmin-reduction design
+as the framework's swarm-coordination layer (ops/coordination.py).
+
+TPU shape: one fused update for the whole pack — three broadcasted
+leader-attraction terms, no per-wolf control flow; the exploration
+schedule ``a: 2 → 0`` is a function of the iteration carried in state.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class GWOState:
+    pos: jax.Array        # [N, D]
+    fit: jax.Array        # [N]
+    leaders: jax.Array    # [3, D] alpha/beta/delta positions
+    leader_fit: jax.Array # [3]
+    key: jax.Array
+    iteration: jax.Array  # i32 scalar
+
+
+def gwo_init(
+    objective: Callable,
+    n: int,
+    dim: int,
+    half_width: float,
+    seed: int = 0,
+    dtype=jnp.float32,
+) -> GWOState:
+    key = jax.random.PRNGKey(seed)
+    key, kp = jax.random.split(key)
+    pos = jax.random.uniform(
+        kp, (n, dim), dtype, minval=-half_width, maxval=half_width
+    )
+    fit = objective(pos)
+    _, top3 = jax.lax.top_k(-fit, 3)
+    return GWOState(
+        pos=pos,
+        fit=fit,
+        leaders=pos[top3],
+        leader_fit=fit[top3],
+        key=key,
+        iteration=jnp.asarray(0, jnp.int32),
+    )
+
+
+@partial(
+    jax.jit, static_argnames=("objective", "half_width", "t_max")
+)
+def gwo_step(
+    state: GWOState,
+    objective: Callable,
+    half_width: float = 5.12,
+    t_max: int = 500,
+) -> GWOState:
+    """One pack update.  ``t_max`` sets the a: 2→0 exploration schedule;
+    past ``t_max`` the pack stays in full-exploitation mode (a=0)."""
+    if t_max < 1:
+        raise ValueError(f"t_max must be >= 1, got {t_max}")
+    n, d = state.pos.shape
+    key, kr = jax.random.split(state.key)
+    frac = jnp.minimum(
+        state.iteration.astype(state.pos.dtype) / t_max, 1.0
+    )
+    a = 2.0 * (1.0 - frac)
+
+    r = jax.random.uniform(kr, (2, 3, n, d), state.pos.dtype)
+    big_a = 2.0 * a * r[0] - a                       # [3, N, D]
+    big_c = 2.0 * r[1]                               # [3, N, D]
+    lead = state.leaders[:, None, :]                 # [3, 1, D]
+    dist = jnp.abs(big_c * lead - state.pos[None])   # [3, N, D]
+    x = lead - big_a * dist                          # [3, N, D]
+    pos = jnp.clip(jnp.mean(x, axis=0), -half_width, half_width)
+
+    fit = objective(pos)
+    # merge new pack with incumbent leaders, re-rank top-3
+    all_fit = jnp.concatenate([state.leader_fit, fit])
+    all_pos = jnp.concatenate([state.leaders, pos])
+    _, top3 = jax.lax.top_k(-all_fit, 3)
+    return GWOState(
+        pos=pos,
+        fit=fit,
+        leaders=all_pos[top3],
+        leader_fit=all_fit[top3],
+        key=key,
+        iteration=state.iteration + 1,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("objective", "n_steps", "half_width", "t_max"),
+)
+def gwo_run(
+    state: GWOState,
+    objective: Callable,
+    n_steps: int,
+    half_width: float = 5.12,
+    t_max: int = 500,
+) -> GWOState:
+    def body(s, _):
+        return gwo_step(s, objective, half_width, t_max), None
+
+    state, _ = jax.lax.scan(body, state, None, length=n_steps)
+    return state

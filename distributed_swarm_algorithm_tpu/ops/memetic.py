@@ -1,0 +1,127 @@
+"""Memetic (gradient-hybrid) refinement for population optimizers.
+
+The reference is gradient-free by construction (pure-Python agents, no
+autodiff anywhere — /root/reference/agent.py).  On TPU the objective is
+a JAX function, so its gradient is free: ``jax.grad`` differentiates the
+same batched objective the swarm already evaluates, and a handful of
+vectorized gradient-descent steps sharpen every particle's personal best
+simultaneously.  This is the classic memetic-algorithm pattern (global
+stochastic search + local refinement) expressed as two fused kernels —
+something the reference's architecture could never offer.
+
+Improvements are accepted greedily: refined points replace ``pbest`` only
+where strictly better, so the swarm's bests stay monotone.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from .pso import C1, C2, PSOState, W, pso_step
+
+
+def gd_refine(
+    pos: jax.Array,
+    objective: Callable,
+    n_steps: int,
+    lr: float,
+    half_width: float,
+) -> jax.Array:
+    """``n_steps`` of plain gradient descent on every row of ``pos``.
+
+    The objective is batched ``[N, D] -> [N]`` with independent rows, so
+    ``grad(sum(f))`` yields exact per-row gradients in one backward pass.
+    Positions stay clipped to the search domain.
+    """
+    grad_fn = jax.grad(lambda p: jnp.sum(objective(p)))
+
+    def body(p, _):
+        g = grad_fn(p)
+        # Guard against non-finite gradients at domain edges.
+        g = jnp.where(jnp.isfinite(g), g, 0.0)
+        return jnp.clip(p - lr * g, -half_width, half_width), None
+
+    pos, _ = jax.lax.scan(body, pos, None, length=n_steps)
+    return pos
+
+
+def refine_pbest(
+    state: PSOState,
+    objective: Callable,
+    n_steps: int = 5,
+    lr: float = 0.01,
+    half_width: float = 5.12,
+) -> PSOState:
+    """Refine every particle's personal best with GD; accept improvements.
+
+    Monotone: ``pbest_fit``/``gbest_fit`` never worsen.
+    """
+    cand = gd_refine(state.pbest_pos, objective, n_steps, lr, half_width)
+    cand_fit = objective(cand)
+    better = cand_fit < state.pbest_fit
+    pbest_fit = jnp.where(better, cand_fit, state.pbest_fit)
+    pbest_pos = jnp.where(better[:, None], cand, state.pbest_pos)
+
+    best = jnp.argmin(pbest_fit)
+    improved = pbest_fit[best] < state.gbest_fit
+    return state.replace(
+        pbest_pos=pbest_pos,
+        pbest_fit=pbest_fit,
+        gbest_pos=jnp.where(improved, pbest_pos[best], state.gbest_pos),
+        gbest_fit=jnp.where(improved, pbest_fit[best], state.gbest_fit),
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective", "n_steps", "refine_every", "refine_steps", "w", "c1",
+        "c2", "half_width", "vmax_frac", "topology", "ring_radius",
+        "grid_cols",
+    ),
+)
+def memetic_run(
+    state: PSOState,
+    objective: Callable,
+    n_steps: int,
+    refine_every: int = 10,
+    refine_steps: int = 5,
+    lr: float = 0.01,
+    w: float = W,
+    c1: float = C1,
+    c2: float = C2,
+    half_width: float = 5.12,
+    vmax_frac: float = 0.5,
+    topology: str = "gbest",
+    ring_radius: int = 1,
+    grid_cols: int = 0,
+) -> PSOState:
+    """PSO with a GD refinement pass every ``refine_every`` iterations.
+
+    One ``lax.scan``; the refinement is a ``lax.cond`` branch so
+    non-refining iterations pay nothing for it.
+    """
+    if refine_every < 1:
+        raise ValueError(
+            f"refine_every must be >= 1, got {refine_every} "
+            "(use plain pso_run for no refinement)"
+        )
+
+    def body(s, _):
+        s = pso_step(s, objective, w, c1, c2, half_width, vmax_frac,
+                     topology, ring_radius, grid_cols)
+        s = jax.lax.cond(
+            s.iteration % refine_every == 0,
+            lambda t: refine_pbest(t, objective, refine_steps, lr,
+                                   half_width),
+            lambda t: t,
+            s,
+        )
+        return s, None
+
+    state, _ = jax.lax.scan(body, state, None, length=n_steps)
+    return state

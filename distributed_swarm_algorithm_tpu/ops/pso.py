@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
+from . import topology as _topo
+
 # Clerc-Kennedy constriction defaults.
 W = 0.7298
 C1 = 1.49618
@@ -80,18 +82,35 @@ def pso_step(
     c2: float = C2,
     half_width: float = 5.12,
     vmax_frac: float = 0.5,
+    topology: str = "gbest",
+    ring_radius: int = 1,
+    grid_cols: int = 0,
 ) -> PSOState:
-    """One PSO iteration.  Pure; jit/scan/shard_map-friendly."""
+    """One PSO iteration.  Pure; jit/scan/shard_map-friendly.
+
+    ``topology`` selects the social attractor: ``"gbest"`` (the default —
+    the reference's broadcast-to-all semantics) uses the running global
+    best; ``"ring"``/``"vonneumann"`` use a per-particle neighborhood
+    best over pbest (ops/topology.py), trading convergence speed for
+    swarm diversity.
+    """
     key, k1, k2 = jax.random.split(state.key, 3)
     shape = state.pos.shape
     dtype = state.pos.dtype
     r1 = jax.random.uniform(k1, shape, dtype)
     r2 = jax.random.uniform(k2, shape, dtype)
 
+    if topology == "gbest":
+        social = state.gbest_pos[None, :]
+    else:
+        social, _ = _topo.neighbor_best(
+            state.pbest_fit, state.pbest_pos, topology,
+            radius=ring_radius, cols=grid_cols,
+        )
     vel = (
         w * state.vel
         + c1 * r1 * (state.pbest_pos - state.pos)
-        + c2 * r2 * (state.gbest_pos[None, :] - state.pos)
+        + c2 * r2 * (social - state.pos)
     )
     vmax = half_width * vmax_frac
     vel = jnp.clip(vel, -vmax, vmax)
@@ -127,7 +146,7 @@ def pso_step(
 @partial(
     jax.jit,
     static_argnames=("objective", "n_steps", "w", "c1", "c2", "half_width",
-                     "vmax_frac"),
+                     "vmax_frac", "topology", "ring_radius", "grid_cols"),
 )
 def pso_run(
     state: PSOState,
@@ -138,12 +157,16 @@ def pso_run(
     c2: float = C2,
     half_width: float = 5.12,
     vmax_frac: float = 0.5,
+    topology: str = "gbest",
+    ring_radius: int = 1,
+    grid_cols: int = 0,
 ) -> PSOState:
     """``n_steps`` iterations under one ``lax.scan``."""
 
     def body(s, _):
         return (
-            pso_step(s, objective, w, c1, c2, half_width, vmax_frac),
+            pso_step(s, objective, w, c1, c2, half_width, vmax_frac,
+                     topology, ring_radius, grid_cols),
             None,
         )
 

@@ -1,0 +1,117 @@
+"""Swarm neighborhood topologies (social networks) for lbest PSO.
+
+The reference's only "communication topology" is broadcast-to-everyone
+(/root/reference/agent.py:188-195 — every message goes to the whole
+swarm), which corresponds to the *star/gbest* topology.  Real swarm
+frameworks also ship local-best topologies — ring and von-Neumann grids —
+which trade convergence speed for diversity (Kennedy & Mendes 2002).
+
+TPU-first design: a neighborhood best over a static topology is a
+*min-dilation* — the min of a few ``jnp.roll`` shifts of the fitness
+vector.  Rolls compile to cheap XLA slice-concats (no gathers, no
+dynamic indexing), fuse with the surrounding PSO update, and under
+``shard_map`` the wrap-around halo becomes a collective-permute between
+neighbor devices — i.e. the topology literally maps onto the ICI ring.
+
+Each function returns ``(nbest_pos [N, D], nbest_fit [N])`` — per-particle
+best over its neighborhood *including itself* (so lbest is monotone).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+TOPOLOGIES = ("gbest", "ring", "vonneumann")
+
+
+def _select_min(
+    fits: jax.Array, poss: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Reduce a stacked [K, N] fitness / [K, N, D] position set over K."""
+    idx = jnp.argmin(fits, axis=0)                      # [N]
+    n = fits.shape[1]
+    ar = jnp.arange(n)
+    return poss[idx, ar], fits[idx, ar]
+
+
+def ring_best(
+    pbest_fit: jax.Array,
+    pbest_pos: jax.Array,
+    radius: int = 1,
+) -> Tuple[jax.Array, jax.Array]:
+    """lbest over a ring: particle i sees i-radius … i+radius (mod N).
+
+    ``2*radius + 1`` rolls; radius=1 is the classic lbest ring.
+    """
+    if radius < 1:
+        raise ValueError(f"radius must be >= 1, got {radius}")
+    shifts = range(-radius, radius + 1)
+    fits = jnp.stack([jnp.roll(pbest_fit, s, axis=0) for s in shifts])
+    poss = jnp.stack([jnp.roll(pbest_pos, s, axis=0) for s in shifts])
+    return _select_min(fits, poss)
+
+
+def von_neumann_best(
+    pbest_fit: jax.Array,
+    pbest_pos: jax.Array,
+    cols: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """lbest over a torus grid: self + N/S/E/W neighbors.
+
+    Particles are arranged row-major on a ``(N // cols, cols)`` torus;
+    N must divide evenly.
+    """
+    n = pbest_fit.shape[0]
+    if cols < 1 or n % cols:
+        raise ValueError(f"cols={cols} must divide swarm size {n}")
+    rows = n // cols
+    fit2 = pbest_fit.reshape(rows, cols)
+    pos2 = pbest_pos.reshape(rows, cols, -1)
+    stacks_f, stacks_p = [fit2], [pos2]
+    for axis in (0, 1):
+        for s in (-1, 1):
+            stacks_f.append(jnp.roll(fit2, s, axis=axis))
+            stacks_p.append(jnp.roll(pos2, s, axis=axis))
+    fits = jnp.stack([f.reshape(n) for f in stacks_f])
+    poss = jnp.stack([p.reshape(n, -1) for p in stacks_p])
+    return _select_min(fits, poss)
+
+
+def neighbor_best(
+    pbest_fit: jax.Array,
+    pbest_pos: jax.Array,
+    topology: str,
+    radius: int = 1,
+    cols: int = 0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-particle social attractor for the given topology.
+
+    ``gbest`` broadcasts the single global argmin (the reference's
+    broadcast-to-all semantics); ``ring``/``vonneumann`` are local.
+    """
+    if topology == "gbest":
+        best = jnp.argmin(pbest_fit)
+        n = pbest_fit.shape[0]
+        return (
+            jnp.broadcast_to(pbest_pos[best], pbest_pos.shape),
+            jnp.broadcast_to(pbest_fit[best], (n,)),
+        )
+    if topology == "ring":
+        return ring_best(pbest_fit, pbest_pos, radius)
+    if topology == "vonneumann":
+        c = cols if cols else _default_cols(pbest_fit.shape[0])
+        return von_neumann_best(pbest_fit, pbest_pos, c)
+    raise ValueError(
+        f"unknown topology {topology!r}; available: {TOPOLOGIES}"
+    )
+
+
+def _default_cols(n: int) -> int:
+    """Most-square factorization of n (largest divisor <= sqrt(n))."""
+    c = int(n ** 0.5)
+    while c > 1 and n % c:
+        c -= 1
+    return max(c, 1)

@@ -1,0 +1,105 @@
+"""Artificial bee colony (ops/abc.py) and grey wolf (ops/gwo.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_swarm_algorithm_tpu.models.abc_bees import ABC
+from distributed_swarm_algorithm_tpu.models.gwo import GWO
+from distributed_swarm_algorithm_tpu.ops.abc import abc_init, abc_run, abc_step
+from distributed_swarm_algorithm_tpu.ops.gwo import gwo_init, gwo_run, gwo_step
+from distributed_swarm_algorithm_tpu.ops.objectives import rastrigin, sphere
+
+
+# --------------------------------------------------------------------- ABC
+
+def test_abc_converges_on_sphere():
+    opt = ABC("sphere", n=64, dim=4, seed=0)
+    opt.run(300)
+    assert opt.best < 1e-3
+
+
+def test_abc_best_is_monotone():
+    st = abc_init(sphere, 32, 5, 5.12, seed=1)
+    prev = float(st.best_fit)
+    for _ in range(20):
+        st = abc_step(st, sphere, 5.12, limit=10)
+        cur = float(st.best_fit)
+        assert cur <= prev + 1e-7
+        prev = cur
+
+
+def test_abc_positions_stay_in_domain():
+    st = abc_run(abc_init(sphere, 48, 6, 2.0, seed=2), sphere, 50,
+                 half_width=2.0, limit=5)
+    assert float(jnp.max(jnp.abs(st.pos))) <= 2.0 + 1e-6
+    # state consistency: fit matches objective(pos)
+    assert np.allclose(np.asarray(sphere(st.pos)), np.asarray(st.fit),
+                       atol=1e-5)
+
+
+def test_abc_scout_resets_trials():
+    st = abc_init(sphere, 16, 3, 5.12, seed=3)
+    st = abc_run(st, sphere, 40, half_width=5.12, limit=3)
+    # with such a tight limit, scouting must have fired; counters bounded
+    assert int(jnp.max(st.trials)) <= 3 + 2  # at most limit + both phases
+
+
+def test_abc_seeded_deterministic():
+    a = ABC("rastrigin", n=32, dim=4, seed=7)
+    b = ABC("rastrigin", n=32, dim=4, seed=7)
+    a.run(30)
+    b.run(30)
+    assert a.best == b.best
+
+
+# --------------------------------------------------------------------- GWO
+
+def test_gwo_converges_on_sphere():
+    opt = GWO("sphere", n=64, dim=4, t_max=200, seed=0)
+    opt.run(200)
+    assert opt.best < 1e-3
+
+
+def test_gwo_leaders_sorted_and_monotone():
+    st = gwo_init(rastrigin, 64, 6, 5.12, seed=1)
+    prev = float(st.leader_fit[0])
+    for _ in range(15):
+        st = gwo_step(st, rastrigin, 5.12, t_max=100)
+        lf = np.asarray(st.leader_fit)
+        assert lf[0] <= lf[1] <= lf[2]
+        assert lf[0] <= prev + 1e-7
+        prev = float(lf[0])
+
+
+def test_gwo_exploitation_after_t_max():
+    """Past t_max the schedule pins a=0: pack contracts onto leaders."""
+    st = gwo_init(sphere, 32, 3, 5.12, seed=2)
+    st = gwo_run(st, sphere, 150, half_width=5.12, t_max=50)
+    spread = float(jnp.mean(jnp.std(st.pos, axis=0)))
+    assert spread < 0.5
+
+
+def test_gwo_run_matches_stepped():
+    a = GWO("sphere", n=24, dim=3, seed=5, t_max=40)
+    b = GWO("sphere", n=24, dim=3, seed=5, t_max=40)
+    for _ in range(10):
+        a.step()
+    b.run(10)
+    assert np.isclose(a.best, b.best)
+    assert int(a.state.iteration) == int(b.state.iteration) == 10
+
+
+def test_gwo_positions_stay_in_domain():
+    st = gwo_run(gwo_init(sphere, 40, 5, 1.5, seed=6), sphere, 60,
+                 half_width=1.5, t_max=60)
+    assert float(jnp.max(jnp.abs(st.pos))) <= 1.5 + 1e-6
+
+
+def test_gwo_rejects_bad_t_max():
+    import pytest
+
+    with pytest.raises(ValueError):
+        GWO("sphere", n=8, dim=2, t_max=0)
+    st = gwo_init(sphere, 8, 2, 5.12, seed=0)
+    with pytest.raises(ValueError):
+        gwo_step(st, sphere, 5.12, t_max=0)

@@ -1,0 +1,126 @@
+"""Ant colony optimization (ops/aco.py, models/aco.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_swarm_algorithm_tpu.models.aco import ACO
+from distributed_swarm_algorithm_tpu.ops.aco import (
+    aco_init,
+    aco_run,
+    aco_step,
+    construct_tours,
+    coords_to_dist,
+    deposit,
+    tour_lengths,
+)
+
+
+def _circle(c, r=10.0):
+    th = np.linspace(0.0, 2 * np.pi, c, endpoint=False)
+    return np.stack([r * np.cos(th), r * np.sin(th)], axis=1)
+
+
+def test_coords_to_dist():
+    pts = jnp.asarray([[0.0, 0.0], [3.0, 4.0], [0.0, 1.0]])
+    d = coords_to_dist(pts)
+    assert d.shape == (3, 3)
+    assert np.allclose(np.diag(np.asarray(d)), 0.0)
+    assert np.isclose(float(d[0, 1]), 5.0)
+    assert np.allclose(np.asarray(d), np.asarray(d).T)
+
+
+def test_tour_lengths_closed():
+    pts = jnp.asarray([[0.0, 0.0], [1.0, 0.0], [1.0, 1.0], [0.0, 1.0]])
+    d = coords_to_dist(pts)
+    tours = jnp.asarray([[0, 1, 2, 3], [0, 2, 1, 3]], jnp.int32)
+    lens = tour_lengths(d, tours)
+    assert np.isclose(float(lens[0]), 4.0)                 # unit square
+    assert np.isclose(float(lens[1]), 2.0 + 2.0 * np.sqrt(2.0))
+
+
+def test_construct_tours_are_permutations():
+    d = coords_to_dist(jnp.asarray(_circle(9), jnp.float32))
+    st = aco_init(d, seed=0)
+    tours = construct_tours(st.tau, d, jax.random.PRNGKey(1), n_ants=16)
+    assert tours.shape == (16, 9)
+    srt = np.sort(np.asarray(tours), axis=1)
+    assert np.all(srt == np.arange(9))
+
+
+def test_deposit_evaporates_and_adds():
+    d = jnp.ones((4, 4)) - jnp.eye(4)
+    tau = jnp.full((4, 4), 2.0)
+    tours = jnp.asarray([[0, 1, 2, 3]], jnp.int32)
+    lens = tour_lengths(d, tours)                          # 4.0
+    out = deposit(tau, tours, lens, rho=0.5)
+    out = np.asarray(out)
+    # evaporation: every cell halved; tour edges get +1/4 each direction
+    assert np.isclose(out[0, 1], 1.0 + 0.25)
+    assert np.isclose(out[1, 0], 1.0 + 0.25)
+    assert np.isclose(out[0, 2], 1.0)                      # not on tour
+
+
+def test_aco_solves_circle_tsp():
+    """On cities arranged on a circle the optimal tour is the perimeter
+    walk; AS with elitism should find it (or come within 5%)."""
+    c = 12
+    pts = _circle(c)
+    colony = ACO(coords=pts, n_ants=64, seed=0, rho=0.2, elite=4.0)
+    colony.run(120)
+    d = coords_to_dist(jnp.asarray(pts, jnp.float32))
+    opt_len = float(
+        tour_lengths(d, jnp.arange(c, dtype=jnp.int32)[None, :])[0]
+    )
+    assert colony.best_length < opt_len * 1.05
+    assert np.sort(colony.best_tour).tolist() == list(range(c))
+
+
+def test_aco_improves_over_iterations():
+    pts = np.random.default_rng(5).uniform(size=(20, 2)) * 10
+    colony = ACO(coords=pts, n_ants=32, seed=2)
+    colony.run(5)
+    early = colony.best_length
+    colony.run(60)
+    assert colony.best_length <= early
+
+
+def test_acs_q0_exploitation_path():
+    pts = _circle(10)
+    colony = ACO(coords=pts, n_ants=32, seed=0, q0=0.9, elite=2.0)
+    colony.run(60)
+    assert np.isfinite(colony.best_length)
+    assert np.sort(colony.best_tour).tolist() == list(range(10))
+
+
+def test_best_len_monotone_and_seeded():
+    pts = np.random.default_rng(7).uniform(size=(15, 2))
+    a = ACO(coords=pts, n_ants=24, seed=9)
+    b = ACO(coords=pts, n_ants=24, seed=9)
+    a.run(30)
+    b.run(30)
+    assert a.best_length == b.best_length                  # deterministic
+    st = aco_run(aco_init(a.state.dist, seed=1), 10, 24)
+    st2 = aco_run(st, 10, 24)
+    assert float(st2.best_len) <= float(st.best_len)
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError):
+        ACO()
+    with pytest.raises(ValueError):
+        ACO(coords=np.zeros((4, 2)), dist=np.zeros((4, 4)))
+    with pytest.raises(ValueError):
+        ACO(dist=np.zeros((3, 4)))
+
+
+def test_step_matches_run():
+    pts = _circle(8)
+    a = ACO(coords=pts, n_ants=16, seed=3)
+    b = ACO(coords=pts, n_ants=16, seed=3)
+    for _ in range(12):
+        a.step()
+    b.run(12)
+    assert np.isclose(a.best_length, b.best_length)
+    assert int(a.state.iteration) == int(b.state.iteration) == 12

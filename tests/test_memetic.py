@@ -1,0 +1,101 @@
+"""Gradient-hybrid (memetic) refinement (ops/memetic.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_swarm_algorithm_tpu.models.memetic import MemeticPSO
+from distributed_swarm_algorithm_tpu.models.pso import PSO
+from distributed_swarm_algorithm_tpu.ops.memetic import (
+    gd_refine,
+    memetic_run,
+    refine_pbest,
+)
+from distributed_swarm_algorithm_tpu.ops.objectives import (
+    rosenbrock,
+    sphere,
+)
+from distributed_swarm_algorithm_tpu.ops.pso import pso_init
+
+
+def test_gd_refine_descends_sphere():
+    pos = jnp.asarray([[3.0, -4.0], [1.0, 1.0]])
+    out = gd_refine(pos, sphere, n_steps=50, lr=0.1, half_width=5.12)
+    assert float(jnp.max(jnp.abs(out))) < 1e-3
+
+
+def test_gd_refine_respects_domain():
+    pos = jnp.asarray([[5.0, 5.0]])
+    # Negative lr = gradient ASCENT; must stay clipped to the domain.
+    out = gd_refine(pos, sphere, n_steps=20, lr=-0.5, half_width=5.12)
+    assert float(jnp.max(jnp.abs(out))) <= 5.12 + 1e-6
+
+
+def test_refine_pbest_is_monotone():
+    state = pso_init(sphere, n=64, dim=6, half_width=5.12, seed=0)
+    refined = refine_pbest(state, sphere, n_steps=10, lr=0.05,
+                           half_width=5.12)
+    assert np.all(
+        np.asarray(refined.pbest_fit) <= np.asarray(state.pbest_fit) + 1e-7
+    )
+    assert float(refined.gbest_fit) <= float(state.gbest_fit) + 1e-7
+    # pbest_pos/fit stay consistent.
+    assert np.allclose(
+        np.asarray(sphere(refined.pbest_pos)),
+        np.asarray(refined.pbest_fit),
+        atol=1e-5,
+    )
+
+
+def test_memetic_beats_plain_pso_on_rosenbrock():
+    """Same budget of PSO iterations; refinement should win on a valley
+    objective where gradients carry real information."""
+    plain = PSO("rosenbrock", n=128, dim=8, seed=0, use_pallas=False)
+    mem = MemeticPSO("rosenbrock", n=128, dim=8, seed=0,
+                     refine_every=5, refine_steps=10, lr=1e-3)
+    plain.run(100)
+    mem.run(100)
+    assert mem.best <= plain.best
+    assert mem.best < 10.0
+
+
+def test_memetic_run_jits_and_counts_iterations():
+    state = pso_init(sphere, n=32, dim=3, half_width=5.12, seed=2)
+    out = memetic_run(state, sphere, 25, refine_every=7, refine_steps=3,
+                      lr=0.05)
+    assert int(out.iteration) == 25
+    assert float(out.gbest_fit) <= float(state.gbest_fit)
+
+
+def test_memetic_rejects_pallas():
+    with pytest.raises(ValueError):
+        MemeticPSO("sphere", n=16, dim=2, use_pallas=True)
+
+
+def test_memetic_with_lbest_topology():
+    opt = MemeticPSO("sphere", n=36, dim=4, topology="vonneumann",
+                     refine_every=5, refine_steps=5, lr=0.1)
+    opt.run(60)
+    assert opt.best < 1e-3
+
+
+def test_memetic_run_threads_topology_params():
+    """run() and step() must use the same topology parameters."""
+    a = MemeticPSO("sphere", n=32, dim=3, seed=4, topology="ring",
+                   ring_radius=3, refine_every=4, refine_steps=2, lr=0.05)
+    b = MemeticPSO("sphere", n=32, dim=3, seed=4, topology="ring",
+                   ring_radius=3, refine_every=4, refine_steps=2, lr=0.05)
+    a.run(8)
+    for _ in range(8):
+        b.step()
+        if int(b.state.iteration) % 4 == 0:
+            b.state = refine_pbest(b.state, sphere, 2, 0.05, b.half_width)
+    assert np.isclose(float(a.state.gbest_fit), float(b.state.gbest_fit))
+
+
+def test_memetic_rejects_refine_every_zero():
+    with pytest.raises(ValueError):
+        MemeticPSO("sphere", n=16, dim=2, refine_every=0)
+    state = pso_init(sphere, n=8, dim=2, half_width=5.12, seed=0)
+    with pytest.raises(ValueError):
+        memetic_run(state, sphere, 5, refine_every=0)

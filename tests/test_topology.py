@@ -1,0 +1,126 @@
+"""Neighborhood topologies (ops/topology.py) and lbest PSO."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_swarm_algorithm_tpu.models.pso import PSO
+from distributed_swarm_algorithm_tpu.ops.objectives import rastrigin, sphere
+from distributed_swarm_algorithm_tpu.ops.pso import pso_init, pso_run
+from distributed_swarm_algorithm_tpu.ops.topology import (
+    _default_cols,
+    neighbor_best,
+    ring_best,
+    von_neumann_best,
+)
+
+
+def _toy(n, d=2, seed=0):
+    rng = np.random.default_rng(seed)
+    fit = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    pos = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    return fit, pos
+
+
+def test_ring_best_matches_bruteforce():
+    n, radius = 12, 2
+    fit, pos = _toy(n)
+    nb_pos, nb_fit = ring_best(fit, pos, radius=radius)
+    fit_np = np.asarray(fit)
+    for i in range(n):
+        idxs = [(i + s) % n for s in range(-radius, radius + 1)]
+        j = idxs[int(np.argmin(fit_np[idxs]))]
+        assert nb_fit[i] == fit[j]
+        assert np.allclose(nb_pos[i], pos[j])
+
+
+def test_von_neumann_best_matches_bruteforce():
+    rows, cols = 4, 5
+    n = rows * cols
+    fit, pos = _toy(n)
+    nb_pos, nb_fit = von_neumann_best(fit, pos, cols=cols)
+    fit_np = np.asarray(fit)
+    for i in range(n):
+        r, c = divmod(i, cols)
+        idxs = [
+            i,
+            ((r - 1) % rows) * cols + c,
+            ((r + 1) % rows) * cols + c,
+            r * cols + (c - 1) % cols,
+            r * cols + (c + 1) % cols,
+        ]
+        j = idxs[int(np.argmin(fit_np[idxs]))]
+        assert nb_fit[i] == fit[j]
+        assert np.allclose(nb_pos[i], pos[j])
+
+
+def test_gbest_topology_broadcasts_argmin():
+    fit, pos = _toy(9)
+    nb_pos, nb_fit = neighbor_best(fit, pos, "gbest")
+    j = int(jnp.argmin(fit))
+    assert np.all(np.asarray(nb_fit) == float(fit[j]))
+    assert np.allclose(nb_pos, np.broadcast_to(np.asarray(pos[j]), pos.shape))
+
+
+def test_neighborhood_includes_self():
+    # A particle that is its own neighborhood minimum keeps itself.
+    fit = jnp.asarray([5.0, -1.0, 5.0, 5.0])
+    pos = jnp.arange(8.0).reshape(4, 2)
+    _, nb_fit = ring_best(fit, pos, radius=1)
+    assert float(nb_fit[1]) == -1.0
+
+
+def test_validation_errors():
+    fit, pos = _toy(10)
+    with pytest.raises(ValueError):
+        ring_best(fit, pos, radius=0)
+    with pytest.raises(ValueError):
+        von_neumann_best(fit, pos, cols=3)   # 3 does not divide 10
+    with pytest.raises(ValueError):
+        neighbor_best(fit, pos, "petersen-graph")
+    with pytest.raises(ValueError):
+        PSO(sphere, n=16, dim=2, topology="petersen-graph")
+
+
+def test_default_cols_most_square():
+    assert _default_cols(12) == 3
+    assert _default_cols(16) == 4
+    assert _default_cols(7) == 1
+
+
+@pytest.mark.parametrize("topology", ["ring", "vonneumann"])
+def test_lbest_pso_converges_on_sphere(topology):
+    opt = PSO("sphere", n=64, dim=4, seed=0, topology=topology)
+    opt.run(150)
+    assert opt.best < 1e-2
+
+
+def test_lbest_run_matches_stepped(monkeypatch):
+    state = pso_init(sphere, n=32, dim=3, half_width=5.12, seed=1)
+    run = pso_run(state, sphere, 20, topology="ring", ring_radius=2)
+    opt = PSO(sphere, n=32, dim=3, seed=1, topology="ring", ring_radius=2)
+    for _ in range(20):
+        opt.step()
+    assert np.allclose(
+        np.asarray(run.gbest_fit), np.asarray(opt.state.gbest_fit)
+    )
+
+
+def test_lbest_preserves_diversity_vs_gbest():
+    """Ring lbest should keep more positional spread than gbest early on
+    (the defining property of local topologies)."""
+    g = PSO("rastrigin", n=256, dim=8, seed=3, topology="gbest",
+            use_pallas=False)
+    l = PSO("rastrigin", n=256, dim=8, seed=3, topology="ring")
+    g.run(60)
+    l.run(60)
+    spread_g = float(jnp.mean(jnp.std(g.state.pos, axis=0)))
+    spread_l = float(jnp.mean(jnp.std(l.state.pos, axis=0)))
+    assert spread_l > spread_g
+    assert np.isfinite(l.best) and np.isfinite(g.best)
+
+
+def test_rastrigin_lbest_quality():
+    opt = PSO("rastrigin", n=128, dim=5, seed=0, topology="vonneumann")
+    opt.run(300)
+    assert opt.best < 30.0

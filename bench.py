@@ -13,7 +13,11 @@ attached, else the portable jit path.  Methodology notes:
     compilation is excluded;
   - sync is a scalar device_get (``float(...)``) — under the axon TPU
     tunnel, ``block_until_ready`` can return before remote execution
-    completes, which silently times dispatch instead of compute.
+    completes, which silently times dispatch instead of compute;
+  - 2560 steps per timed call with 64 in-VMEM steps per kernel block:
+    sustained-throughput regime (real optimization runs are thousands of
+    steps); the one-time [N,D]→[D,N] transposes amortize out and HBM
+    sees pos/vel/pbest once per 64 steps, leaving the VPU as the limit.
 """
 
 import json
@@ -23,13 +27,13 @@ from distributed_swarm_algorithm_tpu.models.pso import PSO
 
 N = 1_048_576           # 1M particles (BASELINE.json north star)
 DIM = 30                # Rastrigin-30D
-BENCH_STEPS = 200
+BENCH_STEPS = 2560
 REPS = 3
 REFERENCE_AGENT_STEPS_PER_SEC = 40_000.0  # SURVEY.md §6, measured
 
 
 def main():
-    opt = PSO("rastrigin", n=N, dim=DIM, seed=0)
+    opt = PSO("rastrigin", n=N, dim=DIM, seed=0, steps_per_kernel=64)
     float(opt.state.gbest_fit)
 
     # Warmup: compile + first execution of the exact timed program.

@@ -44,6 +44,18 @@ class MemeticPSO(PSO):
         self.refine_steps = int(refine_steps)
         self.lr = float(lr)
 
+    def step(self) -> _k.PSOState:
+        """One PSO step + refinement on the same schedule as :meth:`run`
+        (a refinement pass fires when the post-step iteration counter hits
+        a ``refine_every`` multiple)."""
+        state = super().step()
+        if int(state.iteration) % self.refine_every == 0:
+            self.state = _m.refine_pbest(
+                state, self.objective, self.refine_steps, self.lr,
+                self.half_width,
+            )
+        return self.state
+
     def run(self, n_steps: int) -> _k.PSOState:
         self.state = _m.memetic_run(
             self.state, self.objective, n_steps,

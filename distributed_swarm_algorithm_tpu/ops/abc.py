@@ -138,8 +138,14 @@ def abc_step(
     )
     accept_src = seg_best < fit                     # inf where unchosen
     src_cand = cand[jnp.clip(winner_row, 0, s - 1)]
+    # Only sources an onlooker actually probed accrue a failed trial;
+    # unrecruited sources keep their counter (Karaboga ABC — otherwise
+    # low-recruitment sources hit the abandonment limit twice as fast).
+    probed = jnp.zeros((s,), bool).at[chosen].set(True)
     pos = jnp.where(accept_src[:, None], src_cand, pos)
-    trials = jnp.where(accept_src, 0, trials + 1)
+    trials = jnp.where(
+        accept_src, 0, jnp.where(probed, trials + 1, trials)
+    )
     fit = jnp.where(accept_src, seg_best, fit)
 
     # --- scout bees: abandon exhausted sources --------------------------

@@ -229,6 +229,7 @@ def fused_pso_run_shmap(
     from ..ops.pallas.common import ceil_to
     from ..ops.pallas.pso_fused import (
         _auto_tile,
+        best_of_block,
         fused_pso_step_t,
         host_uniforms,
         prep_padded_t,
@@ -271,15 +272,15 @@ def fused_pso_run_shmap(
                 r1, r2 = host_uniforms(
                     host_key, call_i, pos_t.shape, fold=dev
                 )
-            pos_t, vel_t, bpos_t, bfit_t, bf, bp = fused_pso_step_t(
+            pos_t, vel_t, bpos_t, bfit_t = fused_pso_step_t(
                 seed, gpos[:, None], pos_t, vel_t, bpos_t, bfit_t, r1, r2,
                 objective_name=objective_name, w=w, c1=c1, c2=c2,
                 half_width=half_width, vmax_frac=vmax_frac, tile_n=tile_n,
-                rng=rng, interpret=interpret, k_steps=k,
+                rng=rng, interpret=interpret, k_steps=k, track_best=False,
             )
-            # Cross-device gbest: pmin the value, min-device tie-break,
-            # psum-broadcast the winner's position.
-            loc_fit, loc_pos = bf[0, 0], bp[:, 0]
+            # Per-shard best, then cross-device gbest: pmin the value,
+            # min-device tie-break, psum-broadcast the winner's position.
+            loc_fit, loc_pos = best_of_block(bfit_t, bpos_t)
             gmin = lax.pmin(loc_fit, axis)
             mine = loc_fit == gmin
             win = lax.pmin(jnp.where(mine, dev, _BIG_I32), axis)

@@ -80,7 +80,8 @@ def test_memetic_with_lbest_topology():
 
 
 def test_memetic_run_threads_topology_params():
-    """run() and step() must use the same topology parameters."""
+    """run() and step() apply the same topology params AND the same
+    refinement schedule — stepping one-at-a-time reproduces run()."""
     a = MemeticPSO("sphere", n=32, dim=3, seed=4, topology="ring",
                    ring_radius=3, refine_every=4, refine_steps=2, lr=0.05)
     b = MemeticPSO("sphere", n=32, dim=3, seed=4, topology="ring",
@@ -88,8 +89,6 @@ def test_memetic_run_threads_topology_params():
     a.run(8)
     for _ in range(8):
         b.step()
-        if int(b.state.iteration) % 4 == 0:
-            b.state = refine_pbest(b.state, sphere, 2, 0.05, b.half_width)
     assert np.isclose(float(a.state.gbest_fit), float(b.state.gbest_fit))
 
 

@@ -95,7 +95,7 @@ def test_lbest_pso_converges_on_sphere(topology):
     assert opt.best < 1e-2
 
 
-def test_lbest_run_matches_stepped(monkeypatch):
+def test_lbest_run_matches_stepped():
     state = pso_init(sphere, n=32, dim=3, half_width=5.12, seed=1)
     run = pso_run(state, sphere, 20, topology="ring", ring_radius=2)
     opt = PSO(sphere, n=32, dim=3, seed=1, topology="ring", ring_radius=2)

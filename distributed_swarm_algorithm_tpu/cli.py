@@ -15,6 +15,9 @@ reference could not actually run:
   aco     ant-colony TSP solver
   abc     artificial bee colony on a benchmark objective
   gwo     grey wolf optimizer on a benchmark objective
+  firefly firefly algorithm on a benchmark objective
+  cuckoo  cuckoo search on a benchmark objective
+  woa     whale optimization on a benchmark objective
   bench   the headline benchmark (same as bench.py)
 
 ``python -m distributed_swarm_algorithm_tpu --id 1 --count 3 --caps lift``
@@ -243,43 +246,44 @@ def _cmd_pso_islands(args) -> int:
     return 0
 
 
+def _run_report(opt, args, count_key: str, count=None, extra=None) -> int:
+    """Shared optimizer-subcommand tail: timed run + one JSON line.
+
+    Every benchmark-objective optimizer subcommand reports the same
+    schema — objective, population size (under a family-specific key),
+    dim, iters, best, steps/sec — plus optional family extras (callable
+    values are evaluated after the run, for final-state fields)."""
+    start = time.perf_counter()
+    opt.run(args.steps)
+    elapsed = time.perf_counter() - start
+    out = {
+        "objective": args.objective,
+        count_key: args.n if count is None else count,
+        "dim": args.dim,
+        "iters": args.steps,
+        **{k: v() if callable(v) else v for k, v in (extra or {}).items()},
+        "best": opt.best,
+        "steps_per_sec": round(args.steps / elapsed, 1),
+    }
+    print(json.dumps(out))
+    return 0
+
+
 def _cmd_de(args) -> int:
     from .models.de import DE
 
     opt = DE(args.objective, n=args.n, dim=args.dim, f=args.f, cr=args.cr,
              variant=args.variant, seed=args.seed)
-    start = time.perf_counter()
-    opt.run(args.steps)
-    elapsed = time.perf_counter() - start
-    print(json.dumps({
-        "objective": args.objective,
-        "population": args.n,
-        "dim": args.dim,
-        "iters": args.steps,
-        "variant": args.variant,
-        "best": opt.best,
-        "steps_per_sec": round(args.steps / elapsed, 1),
-    }))
-    return 0
+    return _run_report(opt, args, "population",
+                       extra={"variant": args.variant})
 
 
 def _cmd_cmaes(args) -> int:
     from .models.cmaes import CMAES
 
     opt = CMAES(args.objective, dim=args.dim, n=args.n, seed=args.seed)
-    start = time.perf_counter()
-    opt.run(args.steps)
-    elapsed = time.perf_counter() - start
-    print(json.dumps({
-        "objective": args.objective,
-        "popsize": opt.params.popsize,
-        "dim": args.dim,
-        "iters": args.steps,
-        "best": opt.best,
-        "sigma": float(opt.state.sigma),
-        "steps_per_sec": round(args.steps / elapsed, 1),
-    }))
-    return 0
+    return _run_report(opt, args, "popsize", count=opt.params.popsize,
+                       extra={"sigma": lambda: float(opt.state.sigma)})
 
 
 def _cmd_boids(args) -> int:
@@ -334,18 +338,7 @@ def _cmd_abc(args) -> int:
 
     opt = ABC(args.objective, n=args.n, dim=args.dim, limit=args.limit,
               seed=args.seed)
-    start = time.perf_counter()
-    opt.run(args.steps)
-    elapsed = time.perf_counter() - start
-    print(json.dumps({
-        "objective": args.objective,
-        "sources": args.n,
-        "dim": args.dim,
-        "iters": args.steps,
-        "best": opt.best,
-        "steps_per_sec": round(args.steps / elapsed, 1),
-    }))
-    return 0
+    return _run_report(opt, args, "sources")
 
 
 def _cmd_gwo(args) -> int:
@@ -354,18 +347,32 @@ def _cmd_gwo(args) -> int:
     opt = GWO(args.objective, n=args.n, dim=args.dim,
               t_max=args.t_max if args.t_max else args.steps,
               seed=args.seed)
-    start = time.perf_counter()
-    opt.run(args.steps)
-    elapsed = time.perf_counter() - start
-    print(json.dumps({
-        "objective": args.objective,
-        "wolves": args.n,
-        "dim": args.dim,
-        "iters": args.steps,
-        "best": opt.best,
-        "steps_per_sec": round(args.steps / elapsed, 1),
-    }))
-    return 0
+    return _run_report(opt, args, "wolves")
+
+
+def _cmd_firefly(args) -> int:
+    from .models.firefly import Firefly
+
+    opt = Firefly(args.objective, n=args.n, dim=args.dim,
+                  gamma=args.gamma, alpha0=args.alpha0, seed=args.seed)
+    return _run_report(opt, args, "fireflies")
+
+
+def _cmd_cuckoo(args) -> int:
+    from .models.cuckoo import Cuckoo
+
+    opt = Cuckoo(args.objective, n=args.n, dim=args.dim, pa=args.pa,
+                 seed=args.seed)
+    return _run_report(opt, args, "nests")
+
+
+def _cmd_woa(args) -> int:
+    from .models.woa import WOA
+
+    opt = WOA(args.objective, n=args.n, dim=args.dim,
+              t_max=args.t_max if args.t_max else args.steps,
+              seed=args.seed)
+    return _run_report(opt, args, "whales")
 
 
 def _cmd_bench(args) -> int:
@@ -511,6 +518,38 @@ def build_parser() -> argparse.ArgumentParser:
                        help="exploration schedule length (default --steps)")
     p_gwo.add_argument("--seed", type=int, default=0)
     p_gwo.set_defaults(fn=_cmd_gwo)
+
+    p_ff = sub.add_parser("firefly", help="firefly algorithm")
+    p_ff.add_argument("--objective", default="rastrigin")
+    p_ff.add_argument("--n", type=int, default=128)
+    p_ff.add_argument("--dim", type=int, default=30)
+    p_ff.add_argument("--steps", type=int, default=500)
+    p_ff.add_argument("--gamma", type=float, default=1.0,
+                      help="light absorption coefficient")
+    p_ff.add_argument("--alpha0", type=float, default=0.25,
+                      help="initial random-walk scale")
+    p_ff.add_argument("--seed", type=int, default=0)
+    p_ff.set_defaults(fn=_cmd_firefly)
+
+    p_cs = sub.add_parser("cuckoo", help="cuckoo search")
+    p_cs.add_argument("--objective", default="rastrigin")
+    p_cs.add_argument("--n", type=int, default=128, help="nests")
+    p_cs.add_argument("--dim", type=int, default=30)
+    p_cs.add_argument("--steps", type=int, default=500)
+    p_cs.add_argument("--pa", type=float, default=0.25,
+                      help="nest abandonment probability")
+    p_cs.add_argument("--seed", type=int, default=0)
+    p_cs.set_defaults(fn=_cmd_cuckoo)
+
+    p_woa = sub.add_parser("woa", help="whale optimization")
+    p_woa.add_argument("--objective", default="rastrigin")
+    p_woa.add_argument("--n", type=int, default=128)
+    p_woa.add_argument("--dim", type=int, default=30)
+    p_woa.add_argument("--steps", type=int, default=500)
+    p_woa.add_argument("--t-max", type=int, default=0,
+                       help="exploration schedule length (default --steps)")
+    p_woa.add_argument("--seed", type=int, default=0)
+    p_woa.set_defaults(fn=_cmd_woa)
 
     p_bench = sub.add_parser("bench", help="headline benchmark")
     p_bench.set_defaults(fn=_cmd_bench)

@@ -1,0 +1,69 @@
+"""User-facing cuckoo-search model."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+
+from ..ops import cuckoo as _k
+from ..ops.objectives import get_objective
+from ._checkpoint import CheckpointMixin
+
+
+class Cuckoo(CheckpointMixin):
+    """Cuckoo search (Lévy flights + nest abandonment, Yang & Deb 2009).
+
+    >>> opt = Cuckoo("rastrigin", n=64, dim=8, seed=0)
+    >>> opt.run(400)
+    >>> opt.best  # doctest: +SKIP
+    """
+
+    def __init__(
+        self,
+        objective: Union[str, Callable],
+        n: int,
+        dim: int,
+        half_width: Optional[float] = None,
+        pa: float = _k.PA,
+        step_scale: float = _k.STEP_SCALE,
+        levy_beta: float = _k.LEVY_BETA,
+        seed: int = 0,
+        dtype=None,
+    ):
+        if isinstance(objective, str):
+            fn, default_hw = get_objective(objective)
+        else:
+            fn, default_hw = objective, 5.12
+        self.objective = fn
+        self.half_width = float(
+            half_width if half_width is not None else default_hw
+        )
+        if not 0.0 <= pa <= 1.0:
+            raise ValueError(f"pa must be in [0, 1], got {pa}")
+        self.pa = float(pa)
+        self.step_scale = float(step_scale)
+        self.levy_beta = float(levy_beta)
+        kwargs = {} if dtype is None else {"dtype": dtype}
+        self.state = _k.cuckoo_init(
+            fn, n, dim, self.half_width, seed=seed, **kwargs
+        )
+
+    def step(self) -> _k.CuckooState:
+        self.state = _k.cuckoo_step(
+            self.state, self.objective, self.half_width, self.pa,
+            self.step_scale, self.levy_beta,
+        )
+        return self.state
+
+    def run(self, n_steps: int) -> _k.CuckooState:
+        self.state = _k.cuckoo_run(
+            self.state, self.objective, n_steps, self.half_width,
+            self.pa, self.step_scale, self.levy_beta,
+        )
+        jax.block_until_ready(self.state.best_fit)
+        return self.state
+
+    @property
+    def best(self) -> float:
+        return float(self.state.best_fit)

@@ -1,0 +1,72 @@
+"""User-facing firefly-algorithm model."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+
+from ..ops import firefly as _k
+from ..ops.objectives import get_objective
+from ._checkpoint import CheckpointMixin
+
+
+class Firefly(CheckpointMixin):
+    """Firefly algorithm (all-pairs brightness attraction, Yang 2008).
+
+    Synchronous generation-at-once variant (ops/firefly.py); the random
+    walk scale ``alpha0`` decays by ``alpha_decay`` per iteration.
+
+    >>> opt = Firefly("sphere", n=64, dim=4, seed=0)
+    >>> opt.run(150)
+    >>> opt.best  # doctest: +SKIP
+    """
+
+    def __init__(
+        self,
+        objective: Union[str, Callable],
+        n: int,
+        dim: int,
+        half_width: Optional[float] = None,
+        beta0: float = _k.BETA0,
+        gamma: float = _k.GAMMA,
+        alpha0: float = _k.ALPHA0,
+        alpha_decay: float = _k.ALPHA_DECAY,
+        seed: int = 0,
+        dtype=None,
+    ):
+        if isinstance(objective, str):
+            fn, default_hw = get_objective(objective)
+        else:
+            fn, default_hw = objective, 5.12
+        self.objective = fn
+        self.half_width = float(
+            half_width if half_width is not None else default_hw
+        )
+        self.beta0 = float(beta0)
+        self.gamma = float(gamma)
+        self.alpha0 = float(alpha0)
+        self.alpha_decay = float(alpha_decay)
+        kwargs = {} if dtype is None else {"dtype": dtype}
+        self.state = _k.firefly_init(
+            fn, n, dim, self.half_width, seed=seed, **kwargs
+        )
+
+    def step(self) -> _k.FireflyState:
+        self.state = _k.firefly_step(
+            self.state, self.objective, self.half_width, self.beta0,
+            self.gamma, self.alpha0, self.alpha_decay,
+        )
+        return self.state
+
+    def run(self, n_steps: int) -> _k.FireflyState:
+        self.state = _k.firefly_run(
+            self.state, self.objective, n_steps, self.half_width,
+            self.beta0, self.gamma, self.alpha0, self.alpha_decay,
+        )
+        jax.block_until_ready(self.state.best_fit)
+        return self.state
+
+    @property
+    def best(self) -> float:
+        return float(self.state.best_fit)

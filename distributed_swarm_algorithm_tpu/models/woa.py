@@ -1,0 +1,70 @@
+"""User-facing whale-optimization model."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+
+from ..ops import woa as _k
+from ..ops.objectives import get_objective
+from ._checkpoint import CheckpointMixin
+
+
+class WOA(CheckpointMixin):
+    """Whale optimization algorithm (Mirjalili & Lewis 2016).
+
+    ``t_max`` sets the exploration schedule length (a: 2 → 0); the pod
+    exploits fully once ``t_max`` iterations have elapsed.
+
+    >>> opt = WOA("sphere", n=64, dim=6, t_max=200, seed=0)
+    >>> opt.run(200)
+    >>> opt.best  # doctest: +SKIP
+    """
+
+    def __init__(
+        self,
+        objective: Union[str, Callable],
+        n: int,
+        dim: int,
+        half_width: Optional[float] = None,
+        t_max: int = 500,
+        spiral_b: float = _k.SPIRAL_B,
+        seed: int = 0,
+        dtype=None,
+    ):
+        if isinstance(objective, str):
+            fn, default_hw = get_objective(objective)
+        else:
+            fn, default_hw = objective, 5.12
+        self.objective = fn
+        self.half_width = float(
+            half_width if half_width is not None else default_hw
+        )
+        if t_max < 1:
+            raise ValueError(f"t_max must be >= 1, got {t_max}")
+        self.t_max = int(t_max)
+        self.spiral_b = float(spiral_b)
+        kwargs = {} if dtype is None else {"dtype": dtype}
+        self.state = _k.woa_init(
+            fn, n, dim, self.half_width, seed=seed, **kwargs
+        )
+
+    def step(self) -> _k.WOAState:
+        self.state = _k.woa_step(
+            self.state, self.objective, self.half_width, self.t_max,
+            self.spiral_b,
+        )
+        return self.state
+
+    def run(self, n_steps: int) -> _k.WOAState:
+        self.state = _k.woa_run(
+            self.state, self.objective, n_steps, self.half_width,
+            self.t_max, self.spiral_b,
+        )
+        jax.block_until_ready(self.state.best_fit)
+        return self.state
+
+    @property
+    def best(self) -> float:
+        return float(self.state.best_fit)

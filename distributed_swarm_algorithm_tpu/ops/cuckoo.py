@@ -1,0 +1,182 @@
+"""Cuckoo-search kernels (Yang & Deb 2009), TPU-vectorized.
+
+Part of the swarm-intelligence toolkit alongside PSO/DE/CMA-ES/ABC/GWO
+(the reference has no optimizer — its only "fitness" is the task
+utility at /root/reference/agent.py:338-347).  CS contributes the
+heavy-tailed exploration family: Lévy flights let a few nests make rare
+long jumps while most step locally.
+
+TPU shape: Lévy steps come from Mantegna's algorithm — two batched
+normal draws and a power, no rejection sampling or data-dependent
+control flow; the replace/abandon decisions are masked ``where``s, so
+the whole generation fuses under jit and scales with ``vmap``/sharding
+like every other family here.
+
+One generation:
+  1. Lévy flight per nest:  x' = x + step_scale * levy * (x - best);
+     greedy compare against a RANDOM other nest j (a cuckoo drops its
+     egg in a random nest): if f(x'_i) < f(x_j), nest j := x'_i.
+  2. Abandonment: each nest is abandoned with prob ``pa`` and rebuilt by
+     a biased random walk  x + u * (x_p1 - x_p2)  (permuted peers).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+# Canonical defaults (Yang & Deb 2009).
+PA = 0.25           # abandonment probability
+STEP_SCALE = 0.01   # Lévy step scale (fraction of domain dynamics)
+LEVY_BETA = 1.5     # Lévy exponent
+
+
+@struct.dataclass
+class CuckooState:
+    """Struct-of-arrays nest population. N nests, D dims."""
+
+    pos: jax.Array        # [N, D]
+    fit: jax.Array        # [N]
+    best_pos: jax.Array   # [D]
+    best_fit: jax.Array   # scalar
+    key: jax.Array
+    iteration: jax.Array  # i32 scalar
+
+
+def _mantegna_sigma(beta: float) -> float:
+    """sigma_u of Mantegna's Lévy generator (closed form)."""
+    num = math.gamma(1.0 + beta) * math.sin(math.pi * beta / 2.0)
+    den = (
+        math.gamma((1.0 + beta) / 2.0)
+        * beta
+        * 2.0 ** ((beta - 1.0) / 2.0)
+    )
+    return (num / den) ** (1.0 / beta)
+
+
+def levy_steps(key, shape, beta: float, dtype) -> jax.Array:
+    """Batched Lévy(beta) steps: u / |v|^(1/beta), Mantegna's algorithm."""
+    ku, kv = jax.random.split(key)
+    sigma = _mantegna_sigma(beta)
+    u = sigma * jax.random.normal(ku, shape, dtype)
+    v = jax.random.normal(kv, shape, dtype)
+    return u / jnp.power(jnp.abs(v) + 1e-12, 1.0 / beta)
+
+
+def cuckoo_init(
+    objective: Callable,
+    n: int,
+    dim: int,
+    half_width: float,
+    seed: int = 0,
+    dtype=jnp.float32,
+) -> CuckooState:
+    key = jax.random.PRNGKey(seed)
+    key, kp = jax.random.split(key)
+    pos = jax.random.uniform(
+        kp, (n, dim), dtype, minval=-half_width, maxval=half_width
+    )
+    fit = objective(pos)
+    b = jnp.argmin(fit)
+    return CuckooState(
+        pos=pos,
+        fit=fit,
+        best_pos=pos[b],
+        best_fit=fit[b],
+        key=key,
+        iteration=jnp.asarray(0, jnp.int32),
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective", "half_width", "pa", "step_scale", "levy_beta"
+    ),
+)
+def cuckoo_step(
+    state: CuckooState,
+    objective: Callable,
+    half_width: float = 5.12,
+    pa: float = PA,
+    step_scale: float = STEP_SCALE,
+    levy_beta: float = LEVY_BETA,
+) -> CuckooState:
+    """One generation: Lévy flights into random nests, then abandonment."""
+    n, d = state.pos.shape
+    dt = state.pos.dtype
+    key, kl, kt, ka, kp1, kp2, ku = jax.random.split(state.key, 7)
+
+    # --- 1. Lévy flights; egg i lands in random nest t(i) ---------------
+    levy = levy_steps(kl, (n, d), levy_beta, dt)
+    cand = state.pos + step_scale * levy * (state.pos - state.best_pos)
+    cand = jnp.clip(cand, -half_width, half_width)
+    cand_fit = objective(cand)
+
+    target = jax.random.randint(kt, (n,), 0, n)
+    # Several cuckoos may pick the same target nest; the best egg per
+    # nest wins (segment-min), ties broken by lowest cuckoo row so
+    # exactly one egg row is gathered per nest.
+    seg_best = jnp.full((n,), jnp.inf, dt).at[target].min(cand_fit)
+    rows = jnp.arange(n)
+    is_winner = cand_fit == seg_best[target]
+    winner_row = (
+        jnp.full((n,), n, jnp.int32)
+        .at[target]
+        .min(jnp.where(is_winner, rows, n).astype(jnp.int32))
+    )
+    accept = seg_best < state.fit               # inf where untargeted
+    egg = cand[jnp.clip(winner_row, 0, n - 1)]
+    pos = jnp.where(accept[:, None], egg, state.pos)
+    fit = jnp.where(accept, seg_best, state.fit)
+
+    # --- 2. Abandon a fraction pa, rebuild by biased random walk --------
+    abandon = jax.random.uniform(ka, (n,), dt) < pa
+    p1 = jax.random.permutation(kp1, n)
+    p2 = jax.random.permutation(kp2, n)
+    walk = jax.random.uniform(ku, (n, d), dt) * (pos[p1] - pos[p2])
+    fresh = jnp.clip(pos + walk, -half_width, half_width)
+    fresh_fit = objective(fresh)
+    pos = jnp.where(abandon[:, None], fresh, pos)
+    fit = jnp.where(abandon, fresh_fit, fit)
+
+    b = jnp.argmin(fit)
+    improved = fit[b] < state.best_fit
+    return CuckooState(
+        pos=pos,
+        fit=fit,
+        best_pos=jnp.where(improved, pos[b], state.best_pos),
+        best_fit=jnp.where(improved, fit[b], state.best_fit),
+        key=key,
+        iteration=state.iteration + 1,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective", "n_steps", "half_width", "pa", "step_scale",
+        "levy_beta",
+    ),
+)
+def cuckoo_run(
+    state: CuckooState,
+    objective: Callable,
+    n_steps: int,
+    half_width: float = 5.12,
+    pa: float = PA,
+    step_scale: float = STEP_SCALE,
+    levy_beta: float = LEVY_BETA,
+) -> CuckooState:
+    def body(s, _):
+        return cuckoo_step(
+            s, objective, half_width, pa, step_scale, levy_beta
+        ), None
+
+    state, _ = jax.lax.scan(body, state, None, length=n_steps)
+    return state

@@ -1,0 +1,156 @@
+"""Firefly-algorithm kernels (Yang 2008), TPU-vectorized.
+
+Part of widening the framework into a full swarm-intelligence toolkit
+(the reference has no optimizer — its only "fitness" is the task utility
+at /root/reference/agent.py:338-347).  FA is the all-pairs family: every
+firefly is attracted to every brighter one, so the update is an [N, N]
+interaction — the same shape as the framework's neighbor-separation
+physics (ops/neighbors.py) and amenable to the same tiling treatment if
+N grows beyond one chip's liking.
+
+This is the *synchronous* (generation-at-once) FA standard for
+vectorized hardware: all moves are computed from the generation's
+starting positions and applied together, instead of Yang's sequential
+pair loop whose later moves see earlier ones.  The whole interaction is
+two matmuls on the MXU — pairwise distances via the Gram-matrix
+identity, then  move = W @ X − rowsum(W)·X  with the [N, N] weight
+matrix W = brighter ⊙ attraction — so memory stays O(N² + N·D) with no
+[N, N, D] temporary, and there is no per-pair control flow.
+
+Update (firefly i, all brighter j):
+    x_i += sum_j  beta0 * exp(-gamma * r_ij^2) * (x_j - x_i)
+           + alpha_t * (u - 0.5) * 2 * half_width,   u ~ U(0,1)^D
+with alpha_t = alpha0 * decay^t carried via the iteration counter.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+# Yang's canonical defaults.
+BETA0 = 1.0
+GAMMA = 1.0
+ALPHA0 = 0.25
+ALPHA_DECAY = 0.97
+
+
+@struct.dataclass
+class FireflyState:
+    """Struct-of-arrays firefly swarm. N fireflies, D dims."""
+
+    pos: jax.Array        # [N, D]
+    fit: jax.Array        # [N]  (lower is better; brightness = -fit)
+    best_pos: jax.Array   # [D]
+    best_fit: jax.Array   # scalar
+    key: jax.Array
+    iteration: jax.Array  # i32 scalar
+
+
+def firefly_init(
+    objective: Callable,
+    n: int,
+    dim: int,
+    half_width: float,
+    seed: int = 0,
+    dtype=jnp.float32,
+) -> FireflyState:
+    key = jax.random.PRNGKey(seed)
+    key, kp = jax.random.split(key)
+    pos = jax.random.uniform(
+        kp, (n, dim), dtype, minval=-half_width, maxval=half_width
+    )
+    fit = objective(pos)
+    b = jnp.argmin(fit)
+    return FireflyState(
+        pos=pos,
+        fit=fit,
+        best_pos=pos[b],
+        best_fit=fit[b],
+        key=key,
+        iteration=jnp.asarray(0, jnp.int32),
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective", "half_width", "beta0", "gamma", "alpha0", "alpha_decay"
+    ),
+)
+def firefly_step(
+    state: FireflyState,
+    objective: Callable,
+    half_width: float = 5.12,
+    beta0: float = BETA0,
+    gamma: float = GAMMA,
+    alpha0: float = ALPHA0,
+    alpha_decay: float = ALPHA_DECAY,
+) -> FireflyState:
+    """One synchronous generation: all-pairs attraction + random walk."""
+    n, d = state.pos.shape
+    key, kr = jax.random.split(state.key)
+    dt = state.pos.dtype
+
+    # Pairwise attraction as a matmul so the O(N²·D) interaction runs on
+    # the MXU with O(N² + N·D) memory:  move_i = Σ_j W_ij (x_j - x_i)
+    # = (W @ X)_i - rowsum(W)_i · x_i,  W_ij = brighter_ij · attract_ij.
+    sq = jnp.sum(state.pos * state.pos, axis=1)            # [N]
+    r2 = sq[:, None] + sq[None, :] - 2.0 * (state.pos @ state.pos.T)
+    attract = beta0 * jnp.exp(-gamma * jnp.maximum(r2, 0.0))
+    brighter = state.fit[None, :] < state.fit[:, None]     # j brighter than i
+    w = jnp.where(brighter, attract, 0.0)                  # [N, N]
+    move = w @ state.pos - jnp.sum(w, axis=1, keepdims=True) * state.pos
+
+    alpha_t = alpha0 * jnp.power(
+        jnp.asarray(alpha_decay, dt), state.iteration.astype(dt)
+    )
+    noise = alpha_t * (jax.random.uniform(kr, (n, d), dt) - 0.5) * (
+        2.0 * half_width
+    )
+    # The global brightest has no j to chase; it still random-walks
+    # (canonical FA — keeps the incumbent exploring), and best_pos below
+    # archives the optimum so the walk never loses it.
+    pos = jnp.clip(state.pos + move + noise, -half_width, half_width)
+    fit = objective(pos)
+
+    b = jnp.argmin(fit)
+    improved = fit[b] < state.best_fit
+    return FireflyState(
+        pos=pos,
+        fit=fit,
+        best_pos=jnp.where(improved, pos[b], state.best_pos),
+        best_fit=jnp.where(improved, fit[b], state.best_fit),
+        key=key,
+        iteration=state.iteration + 1,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective", "n_steps", "half_width", "beta0", "gamma", "alpha0",
+        "alpha_decay",
+    ),
+)
+def firefly_run(
+    state: FireflyState,
+    objective: Callable,
+    n_steps: int,
+    half_width: float = 5.12,
+    beta0: float = BETA0,
+    gamma: float = GAMMA,
+    alpha0: float = ALPHA0,
+    alpha_decay: float = ALPHA_DECAY,
+) -> FireflyState:
+    def body(s, _):
+        return firefly_step(
+            s, objective, half_width, beta0, gamma, alpha0, alpha_decay
+        ), None
+
+    state, _ = jax.lax.scan(body, state, None, length=n_steps)
+    return state

@@ -1,0 +1,148 @@
+"""Whale-optimization kernels (Mirjalili & Lewis 2016), TPU-vectorized.
+
+Part of the swarm-intelligence toolkit (the reference has no optimizer —
+its only "fitness" is the task utility at
+/root/reference/agent.py:338-347).  WOA is the leader-pursuit family
+closest in spirit to GWO (ops/gwo.py) but with a stochastic three-way
+behavior split per whale per step: encircle the incumbent leader, search
+toward a random peer, or spiral in.  Under ``vmap``-style vectorization
+that split is two masked ``where``s over batched draws — no per-whale
+control flow, so the whole pod updates in a handful of fused kernels.
+
+Per whale, with a: 2→0 over ``t_max`` and p, l, r1, r2 batched draws:
+  p < 0.5, |A| <  1:  X' = X*   - A · |C·X*   - X|      (encircle)
+  p < 0.5, |A| >= 1:  X' = Xr   - A · |C·Xr   - X|      (explore)
+  p >= 0.5:           X' = |X* - X| · e^{b·l} · cos(2πl) + X*   (spiral)
+where A = 2a·r1 - a, C = 2·r2, Xr a random whale, b the spiral constant.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+SPIRAL_B = 1.0   # logarithmic-spiral shape constant (canonical b = 1)
+
+
+@struct.dataclass
+class WOAState:
+    """Struct-of-arrays whale pod. N whales, D dims."""
+
+    pos: jax.Array        # [N, D]
+    fit: jax.Array        # [N]
+    best_pos: jax.Array   # [D]
+    best_fit: jax.Array   # scalar
+    key: jax.Array
+    iteration: jax.Array  # i32 scalar
+
+
+def woa_init(
+    objective: Callable,
+    n: int,
+    dim: int,
+    half_width: float,
+    seed: int = 0,
+    dtype=jnp.float32,
+) -> WOAState:
+    key = jax.random.PRNGKey(seed)
+    key, kp = jax.random.split(key)
+    pos = jax.random.uniform(
+        kp, (n, dim), dtype, minval=-half_width, maxval=half_width
+    )
+    fit = objective(pos)
+    b = jnp.argmin(fit)
+    return WOAState(
+        pos=pos,
+        fit=fit,
+        best_pos=pos[b],
+        best_fit=fit[b],
+        key=key,
+        iteration=jnp.asarray(0, jnp.int32),
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("objective", "half_width", "t_max", "spiral_b"),
+)
+def woa_step(
+    state: WOAState,
+    objective: Callable,
+    half_width: float = 5.12,
+    t_max: int = 500,
+    spiral_b: float = SPIRAL_B,
+) -> WOAState:
+    """One pod update.  ``t_max`` sets the a: 2→0 schedule; past it the
+    pod stays in full-exploitation mode (a=0), as in GWO (ops/gwo.py)."""
+    if t_max < 1:
+        raise ValueError(f"t_max must be >= 1, got {t_max}")
+    n, d = state.pos.shape
+    dt = state.pos.dtype
+    key, kr, kp, kl, kq = jax.random.split(state.key, 5)
+
+    frac = jnp.minimum(state.iteration.astype(dt) / t_max, 1.0)
+    a = 2.0 * (1.0 - frac)
+
+    r = jax.random.uniform(kr, (2, n, d), dt)
+    big_a = 2.0 * a * r[0] - a                       # [N, D]
+    big_c = 2.0 * r[1]                               # [N, D]
+    p = jax.random.uniform(kp, (n, 1), dt)
+    l = jax.random.uniform(kl, (n, 1), dt, minval=-1.0, maxval=1.0)
+
+    best = state.best_pos[None, :]                   # [1, D]
+    rand_idx = jax.random.randint(kq, (n,), 0, n)
+    x_rand = state.pos[rand_idx]                     # [N, D]
+
+    # encircle vs. explore share one contraction form; |A| >= 1 swaps the
+    # prey for a random peer (per-dimension, as the batched draws make
+    # |A| elementwise — the vectorized reading of the scalar-A paper).
+    explore = jnp.abs(big_a) >= 1.0
+    prey = jnp.where(explore, x_rand, best)
+    contract = prey - big_a * jnp.abs(big_c * prey - state.pos)
+
+    dist_best = jnp.abs(best - state.pos)
+    spiral = (
+        dist_best * jnp.exp(spiral_b * l) * jnp.cos(2.0 * jnp.pi * l)
+        + best
+    )
+
+    pos = jnp.clip(
+        jnp.where(p < 0.5, contract, spiral), -half_width, half_width
+    )
+    fit = objective(pos)
+
+    b = jnp.argmin(fit)
+    improved = fit[b] < state.best_fit
+    return WOAState(
+        pos=pos,
+        fit=fit,
+        best_pos=jnp.where(improved, pos[b], state.best_pos),
+        best_fit=jnp.where(improved, fit[b], state.best_fit),
+        key=key,
+        iteration=state.iteration + 1,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective", "n_steps", "half_width", "t_max", "spiral_b"
+    ),
+)
+def woa_run(
+    state: WOAState,
+    objective: Callable,
+    n_steps: int,
+    half_width: float = 5.12,
+    t_max: int = 500,
+    spiral_b: float = SPIRAL_B,
+) -> WOAState:
+    def body(s, _):
+        return woa_step(s, objective, half_width, t_max, spiral_b), None
+
+    state, _ = jax.lax.scan(body, state, None, length=n_steps)
+    return state

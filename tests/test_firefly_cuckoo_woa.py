@@ -1,0 +1,197 @@
+"""Firefly (ops/firefly.py), cuckoo search (ops/cuckoo.py), and whale
+optimization (ops/woa.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_swarm_algorithm_tpu.models.cuckoo import Cuckoo
+from distributed_swarm_algorithm_tpu.models.firefly import Firefly
+from distributed_swarm_algorithm_tpu.models.woa import WOA
+from distributed_swarm_algorithm_tpu.ops.cuckoo import (
+    cuckoo_init,
+    cuckoo_run,
+    cuckoo_step,
+    levy_steps,
+)
+from distributed_swarm_algorithm_tpu.ops.firefly import (
+    firefly_init,
+    firefly_run,
+    firefly_step,
+)
+from distributed_swarm_algorithm_tpu.ops.objectives import sphere
+from distributed_swarm_algorithm_tpu.ops.woa import woa_init, woa_run, woa_step
+
+
+# ----------------------------------------------------------------- firefly
+
+def test_firefly_converges_on_sphere():
+    opt = Firefly("sphere", n=64, dim=4, seed=0)
+    opt.run(150)
+    assert opt.best < 1e-2
+
+
+def test_firefly_best_is_monotone():
+    st = firefly_init(sphere, 32, 5, 5.12, seed=1)
+    prev = float(st.best_fit)
+    for _ in range(20):
+        st = firefly_step(st, sphere, 5.12)
+        cur = float(st.best_fit)
+        assert cur <= prev + 1e-7
+        prev = cur
+
+
+def test_firefly_attraction_pulls_dimmer_toward_brighter():
+    # Two fireflies, no noise (alpha0=0): the dimmer one must move
+    # strictly toward the brighter one; the brighter one must not move.
+    pos = jnp.asarray([[0.0, 0.0], [4.0, 0.0]])
+    st = firefly_init(sphere, 2, 2, 5.12, seed=0)
+    st = st.replace(pos=pos, fit=sphere(pos))
+    nxt = firefly_step(st, sphere, 5.12, alpha0=0.0)
+    assert float(nxt.pos[1, 0]) < 4.0          # dimmer pulled toward origin
+    np.testing.assert_allclose(np.asarray(nxt.pos[0]), [0.0, 0.0])
+
+
+def test_firefly_positions_stay_in_domain():
+    st = firefly_run(firefly_init(sphere, 48, 3, 2.0, seed=2), sphere, 40,
+                     half_width=2.0)
+    assert float(jnp.max(jnp.abs(st.pos))) <= 2.0 + 1e-6
+    assert np.allclose(np.asarray(sphere(st.pos)), np.asarray(st.fit),
+                       atol=1e-5)
+
+
+def test_firefly_seeded_deterministic():
+    a = Firefly("rastrigin", n=32, dim=4, seed=7)
+    b = Firefly("rastrigin", n=32, dim=4, seed=7)
+    a.run(30)
+    b.run(30)
+    assert a.best == b.best
+
+
+def test_firefly_run_matches_stepped():
+    st = firefly_init(sphere, 16, 3, 5.12, seed=3)
+    ran = firefly_run(st, sphere, 10, half_width=5.12)
+    stepped = st
+    for _ in range(10):
+        stepped = firefly_step(stepped, sphere, 5.12)
+    np.testing.assert_allclose(
+        np.asarray(ran.pos), np.asarray(stepped.pos), atol=1e-6
+    )
+    assert float(ran.best_fit) == float(stepped.best_fit)
+
+
+# ------------------------------------------------------------------ cuckoo
+
+def test_cuckoo_converges_on_sphere():
+    opt = Cuckoo("sphere", n=64, dim=4, seed=0)
+    opt.run(400)
+    assert opt.best < 1e-2
+
+
+def test_cuckoo_best_is_monotone():
+    st = cuckoo_init(sphere, 32, 5, 5.12, seed=1)
+    prev = float(st.best_fit)
+    for _ in range(20):
+        st = cuckoo_step(st, sphere, 5.12)
+        cur = float(st.best_fit)
+        assert cur <= prev + 1e-7
+        prev = cur
+
+
+def test_cuckoo_nest_replacement_is_greedy():
+    # A nest is only ever overwritten by a better egg (or abandonment,
+    # disabled here via pa=0): population fitness is non-increasing
+    # elementwise.
+    st = cuckoo_init(sphere, 32, 4, 5.12, seed=2)
+    for _ in range(10):
+        nxt = cuckoo_step(st, sphere, 5.12, pa=0.0)
+        assert np.all(np.asarray(nxt.fit) <= np.asarray(st.fit) + 1e-7)
+        st = nxt
+
+
+def test_cuckoo_positions_stay_in_domain():
+    st = cuckoo_run(cuckoo_init(sphere, 48, 3, 2.0, seed=3), sphere, 40,
+                    half_width=2.0)
+    assert float(jnp.max(jnp.abs(st.pos))) <= 2.0 + 1e-6
+    assert np.allclose(np.asarray(sphere(st.pos)), np.asarray(st.fit),
+                       atol=1e-5)
+
+
+def test_levy_steps_are_heavy_tailed():
+    steps = np.asarray(levy_steps(
+        jax.random.PRNGKey(0), (20000,), 1.5, jnp.float32
+    ))
+    # Lévy(1.5) has far heavier tails than any Gaussian with the same
+    # interquartile scale: normalize by IQR, then check extreme outliers.
+    iqr = np.subtract(*np.percentile(steps, [75, 25]))
+    assert np.max(np.abs(steps)) / iqr > 50.0
+
+
+def test_cuckoo_seeded_deterministic():
+    a = Cuckoo("rastrigin", n=32, dim=4, seed=7)
+    b = Cuckoo("rastrigin", n=32, dim=4, seed=7)
+    a.run(30)
+    b.run(30)
+    assert a.best == b.best
+
+
+# --------------------------------------------------------------------- woa
+
+def test_woa_converges_on_sphere():
+    opt = WOA("sphere", n=64, dim=4, t_max=200, seed=0)
+    opt.run(200)
+    assert opt.best < 1e-3
+
+
+def test_woa_best_is_monotone():
+    st = woa_init(sphere, 32, 5, 5.12, seed=1)
+    prev = float(st.best_fit)
+    for _ in range(20):
+        st = woa_step(st, sphere, 5.12, t_max=100)
+        cur = float(st.best_fit)
+        assert cur <= prev + 1e-7
+        prev = cur
+
+
+def test_woa_positions_stay_in_domain():
+    st = woa_run(woa_init(sphere, 48, 3, 2.0, seed=2), sphere, 40,
+                 half_width=2.0, t_max=40)
+    assert float(jnp.max(jnp.abs(st.pos))) <= 2.0 + 1e-6
+    assert np.allclose(np.asarray(sphere(st.pos)), np.asarray(st.fit),
+                       atol=1e-5)
+
+
+def test_woa_late_phase_contracts_to_best():
+    # Past t_max, a = 0 so the encircle branch becomes X' = X* (the
+    # spiral branch still wanders); the pod must tighten around best.
+    st = woa_init(sphere, 64, 4, 5.12, seed=3)
+    st = st.replace(iteration=jnp.asarray(10_000, jnp.int32))
+    spread0 = float(jnp.mean(jnp.linalg.norm(st.pos - st.best_pos, axis=1)))
+    for _ in range(30):
+        st = woa_step(st, sphere, 5.12, t_max=100)
+    spread = float(jnp.mean(jnp.linalg.norm(st.pos - st.best_pos, axis=1)))
+    assert spread < spread0 * 0.5
+
+
+def test_woa_seeded_deterministic():
+    a = WOA("rastrigin", n=32, dim=4, seed=7)
+    b = WOA("rastrigin", n=32, dim=4, seed=7)
+    a.run(30)
+    b.run(30)
+    assert a.best == b.best
+
+
+# ------------------------------------------------------------- checkpoints
+
+def test_new_families_checkpoint_roundtrip(tmp_path):
+    for cls, name in ((Firefly, "ff"), (Cuckoo, "cs"), (WOA, "woa")):
+        opt = cls("sphere", n=16, dim=3, seed=5)
+        opt.run(10)
+        p = str(tmp_path / f"{name}.npz")
+        opt.save(p)
+        fresh = cls("sphere", n=16, dim=3, seed=99)
+        fresh.load(p)
+        assert fresh.best == opt.best
+        np.testing.assert_allclose(
+            np.asarray(fresh.state.pos), np.asarray(opt.state.pos)
+        )

@@ -176,20 +176,10 @@ def _cmd_pso(args) -> int:
 
         opt = PSO(args.objective, n=args.n, dim=args.dim, seed=args.seed,
                   **kwargs)
-    start = time.perf_counter()
-    opt.run(args.steps)
-    elapsed = time.perf_counter() - start
-    print(json.dumps({
-        "objective": args.objective,
-        "particles": args.n,
-        "dim": args.dim,
-        "iters": args.steps,
-        "topology": args.topology,
-        "memetic": args.refine_every > 0,
-        "best": opt.best,
-        "steps_per_sec": round(args.steps / elapsed, 1),
-    }))
-    return 0
+    return _run_report(
+        opt, args, "particles",
+        extra={"topology": args.topology, "memetic": args.refine_every > 0},
+    )
 
 
 def _cmd_pso_islands(args) -> int:

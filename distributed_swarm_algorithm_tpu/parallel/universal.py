@@ -1,0 +1,189 @@
+"""Family-agnostic multi-device execution: every optimizer family scales.
+
+The PSO-specific paths (parallel/sharding.py, parallel/islands.py) spell
+out collectives for the perf flagship.  This module gives the SAME two
+scaling strategies to *every* population family (DE, ABC, GWO, WOA,
+cuckoo, firefly, …) without touching family internals, exploiting the
+framework-wide state convention: each family's state is a
+struct-of-arrays pytree whose population leaves have dim 0 == N
+(``pos [N, D]``, ``fit [N]``, …) plus replicated leaves (incumbent best,
+PRNG key, iteration counter).
+
+1. **GSPMD population sharding** — ``shard_population`` places any such
+   state with the population axis sharded over the mesh; the family's
+   ordinary jitted step/run then executes SPMD, XLA inserting ICI
+   collectives for the global reductions (best argmin; firefly's
+   all-pairs matmul becomes a sharded matmul with an all-gather).
+
+2. **Generic island model** — ``stack_islands`` builds I independent
+   populations (one PRNG stream each), ``run_islands`` steps them in
+   lockstep under ``vmap`` (shardable over an island mesh axis, where
+   the ring migration's ``jnp.roll`` lowers to a collective-permute),
+   and ``migrate_ring`` exchanges k elites ring-wise using only the
+   shared ``pos``/``fit`` fields (families with extra per-individual
+   state — e.g. ABC ``trials`` — get immigrant slots reset to zero).
+
+Capability lineage: the island model generalizes the reference's only
+scale story ("more processes", /root/reference/agent.py:349-360) into
+per-device subswarms with a working exchange protocol; migration plays
+the role its stubbed transport (agent.py:188-195) never could.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import ISLAND_AXIS
+from .sharding import _tree_shard_dim0
+
+
+def shard_population(state, mesh: Mesh, axis: str):
+    """Place any family state with the population axis (dim 0 of every
+    leaf sized like ``state.pos``) sharded over ``axis``; other leaves
+    replicate.  Requires N % mesh.shape[axis] == 0."""
+    n = state.pos.shape[0]
+    if n % mesh.shape[axis]:
+        raise ValueError(
+            f"population {n} not divisible by mesh axis "
+            f"'{axis}' size {mesh.shape[axis]}"
+        )
+    return _tree_shard_dim0(state, mesh, axis, n)
+
+
+# ---------------------------------------------------------------------------
+# Generic island model
+# ---------------------------------------------------------------------------
+
+
+def stack_islands(
+    init_fn: Callable,
+    n_islands: int,
+    seed: int = 0,
+):
+    """Stack ``n_islands`` independent populations into one pytree with a
+    leading island axis on every leaf.
+
+    ``init_fn(seed) -> state`` builds one island from an integer seed;
+    islands get the seeds ``seed*1_000_003 + i`` (matching the PSO
+    island model, parallel/islands.py) so their PRNG streams are
+    disjoint.  Stacking runs per-island inits eagerly and stacks leaves
+    — init cost is per-island Python, but init is once.
+    """
+    if n_islands < 1:
+        raise ValueError(f"n_islands must be >= 1, got {n_islands}")
+    states = [init_fn(seed * 1_000_003 + i) for i in range(n_islands)]
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
+
+
+def shard_islands(stacked, mesh: Mesh, axis: str = ISLAND_AXIS):
+    """Place a stacked island state with the island axis sharded."""
+    n_i = stacked.pos.shape[0]
+    if n_i % mesh.shape[axis]:
+        raise ValueError(
+            f"{n_i} islands not divisible by mesh axis "
+            f"'{axis}' size {mesh.shape[axis]}"
+        )
+    sharded = NamedSharding(mesh, P(axis))
+
+    # Every leaf carries the island axis at dim 0 (stack_islands built it
+    # that way), so shard dim 0 unconditionally — including scalars-per-
+    # island like iteration [I] and keys [I, 2].
+    return jax.tree_util.tree_map(
+        lambda leaf: jax.device_put(leaf, sharded), stacked
+    )
+
+
+def migrate_ring(stacked, k: int):
+    """Ring elite migration over the island axis, family-agnostic.
+
+    Island i's k best individuals (by ``fit``) replace island (i+1)%I's
+    k worst, copying the consistent ``(pos, fit)`` pairs so every
+    family's ``fit == objective(pos)`` invariant survives.  If the state
+    has an integer per-individual ``trials`` field (ABC), immigrant
+    slots reset to 0 (a fresh source).  The ``jnp.roll`` over the island
+    axis lowers to a collective-permute when that axis is sharded.
+    """
+    pos, fit = stacked.pos, stacked.fit
+    n_i, n = fit.shape
+    if not 0 < k <= n:
+        raise ValueError(f"migrate_k must be in [1, {n}], got {k}")
+
+    _, best_idx = lax.top_k(-fit, k)                       # [I, k]
+    em_pos = jnp.take_along_axis(pos, best_idx[..., None], axis=1)
+    em_fit = jnp.take_along_axis(fit, best_idx, axis=1)
+    in_pos = jnp.roll(em_pos, 1, axis=0)                   # ring i -> i+1
+    in_fit = jnp.roll(em_fit, 1, axis=0)
+
+    _, worst_idx = lax.top_k(fit, k)                       # [I, k]
+    rows = jnp.arange(n_i)[:, None]
+    updates = {
+        "pos": pos.at[rows, worst_idx].set(in_pos),
+        "fit": fit.at[rows, worst_idx].set(in_fit),
+    }
+    if hasattr(stacked, "trials"):
+        updates["trials"] = stacked.trials.at[rows, worst_idx].set(0)
+    if hasattr(stacked, "leader_fit"):
+        # GWO reads only its leader archive (not ``fit``) when moving the
+        # pack, so immigrants must enter the archive or migration is
+        # lossy: merge them with the incumbent leaders and re-rank.
+        n_lead = stacked.leader_fit.shape[1]
+        all_fit = jnp.concatenate([stacked.leader_fit, in_fit], axis=1)
+        all_pos = jnp.concatenate([stacked.leaders, in_pos], axis=1)
+        _, top = lax.top_k(-all_fit, n_lead)               # [I, n_lead]
+        updates["leader_fit"] = jnp.take_along_axis(all_fit, top, axis=1)
+        updates["leaders"] = jnp.take_along_axis(
+            all_pos, top[..., None], axis=1
+        )
+    return stacked.replace(**updates)
+
+
+def run_islands(
+    run_fn: Callable,
+    stacked,
+    n_steps: int,
+    migrate_every: int = 0,
+    migrate_k: int = 4,
+):
+    """Run all islands in lockstep; optionally migrate periodically.
+
+    ``run_fn(state, n_steps) -> state`` is the family's jitted run
+    closed over its objective/hyperparameters (e.g.
+    ``lambda s, n: de_run(s, rastrigin, n)``).  With
+    ``migrate_every <= 0`` this is one vmapped call; otherwise blocks of
+    ``migrate_every`` steps alternate with ``migrate_ring`` (remainder
+    steps run unmigrated at the end, matching parallel/islands.py).
+    """
+    if migrate_every <= 0:
+        return jax.vmap(lambda s: run_fn(s, n_steps))(stacked)
+    n_blocks, rem = divmod(n_steps, migrate_every)
+    vrun = jax.vmap(lambda s: run_fn(s, migrate_every))
+    for _ in range(n_blocks):
+        stacked = migrate_ring(vrun(stacked), migrate_k)
+    if rem:
+        stacked = jax.vmap(lambda s: run_fn(s, rem))(stacked)
+    return stacked
+
+
+def islands_global_best(stacked) -> Tuple[jax.Array, jax.Array]:
+    """(fit, pos) of the best archived optimum across all islands.
+
+    Uses the framework-wide ``best_fit``/``best_pos`` archive fields;
+    GWO, which archives the alpha wolf in ``leader_fit[0]``/
+    ``leaders[0]`` instead, is handled transparently.
+    """
+    if hasattr(stacked, "best_fit"):
+        fits, poss = stacked.best_fit, stacked.best_pos
+    elif hasattr(stacked, "leader_fit"):
+        fits, poss = stacked.leader_fit[:, 0], stacked.leaders[:, 0]
+    else:
+        raise TypeError(
+            f"{type(stacked).__name__} has neither best_fit nor "
+            "leader_fit archive fields"
+        )
+    i = jnp.argmin(fits)
+    return fits[i], poss[i]

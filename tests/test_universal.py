@@ -1,0 +1,212 @@
+"""Family-agnostic scaling (parallel/universal.py): GSPMD population
+sharding and the generic island model, across optimizer families."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_swarm_algorithm_tpu.ops import abc as abc_k
+from distributed_swarm_algorithm_tpu.ops import cuckoo as cs_k
+from distributed_swarm_algorithm_tpu.ops import de as de_k
+from distributed_swarm_algorithm_tpu.ops import firefly as ff_k
+from distributed_swarm_algorithm_tpu.ops import gwo as gwo_k
+from distributed_swarm_algorithm_tpu.ops import woa as woa_k
+from distributed_swarm_algorithm_tpu.ops.objectives import rastrigin, sphere
+from distributed_swarm_algorithm_tpu.parallel.mesh import (
+    ISLAND_AXIS,
+    make_mesh,
+)
+from distributed_swarm_algorithm_tpu.parallel.universal import (
+    islands_global_best,
+    migrate_ring,
+    run_islands,
+    shard_islands,
+    shard_population,
+    stack_islands,
+)
+
+HW = 5.12
+
+# (init_fn(seed) -> state, run_fn(state, n) -> state) per family, all on
+# sphere-4D at N=32 so one parametrized test covers the whole toolkit.
+FAMILIES = {
+    "de": (
+        lambda seed: de_k.de_init(sphere, 32, 4, HW, seed=seed),
+        lambda s, n: de_k.de_run(s, sphere, n, half_width=HW),
+    ),
+    "abc": (
+        lambda seed: abc_k.abc_init(sphere, 32, 4, HW, seed=seed),
+        lambda s, n: abc_k.abc_run(s, sphere, n, half_width=HW, limit=10),
+    ),
+    "gwo": (
+        lambda seed: gwo_k.gwo_init(sphere, 32, 4, HW, seed=seed),
+        lambda s, n: gwo_k.gwo_run(s, sphere, n, half_width=HW, t_max=100),
+    ),
+    "woa": (
+        lambda seed: woa_k.woa_init(sphere, 32, 4, HW, seed=seed),
+        lambda s, n: woa_k.woa_run(s, sphere, n, half_width=HW, t_max=100),
+    ),
+    "cuckoo": (
+        lambda seed: cs_k.cuckoo_init(sphere, 32, 4, HW, seed=seed),
+        lambda s, n: cs_k.cuckoo_run(s, sphere, n, half_width=HW),
+    ),
+    "firefly": (
+        lambda seed: ff_k.firefly_init(sphere, 32, 4, HW, seed=seed),
+        lambda s, n: ff_k.firefly_run(s, sphere, n, half_width=HW),
+    ),
+}
+
+
+@pytest.mark.parametrize("family", sorted(FAMILIES))
+def test_islands_run_and_improve(family):
+    init_fn, run_fn = FAMILIES[family]
+    stacked = stack_islands(init_fn, n_islands=4, seed=0)
+    fit0, _ = islands_global_best(stacked)
+    out = run_islands(run_fn, stacked, 40, migrate_every=10, migrate_k=2)
+    fit, pos = islands_global_best(out)
+    assert float(fit) < float(fit0)
+    assert np.isfinite(float(fit))
+    assert pos.shape == (4,)
+    # island axis preserved on every leaf
+    assert out.pos.shape == (4, 32, 4)
+
+
+@pytest.mark.parametrize("family", sorted(FAMILIES))
+def test_islands_match_independent_runs_without_migration(family):
+    """migrate_every=0 must equal running each island separately."""
+    init_fn, run_fn = FAMILIES[family]
+    stacked = stack_islands(init_fn, n_islands=3, seed=1)
+    out = run_islands(run_fn, stacked, 15)
+    for i in range(3):
+        solo = run_fn(init_fn(1 * 1_000_003 + i), 15)
+        np.testing.assert_allclose(
+            np.asarray(out.pos[i]), np.asarray(solo.pos), atol=1e-6
+        )
+
+
+def test_migrate_ring_moves_elites():
+    init_fn, _ = FAMILIES["de"]
+    stacked = stack_islands(init_fn, n_islands=4, seed=2)
+    k = 3
+    fit = np.asarray(stacked.fit)
+    migrated = migrate_ring(stacked, k)
+    new_fit = np.asarray(migrated.fit)
+    for i in range(4):
+        donors = np.sort(fit[(i - 1) % 4])[:k]
+        # island i now contains its predecessor's k best
+        for d in donors:
+            assert np.any(np.isclose(new_fit[i], d))
+        # and its own k worst are gone (replaced)
+        assert new_fit[i].max() <= fit[i].max()
+        # non-migrated individuals untouched
+        assert np.sum(~np.isin(new_fit[i], fit[i])) <= k
+
+
+def test_migrate_ring_resets_abc_trials():
+    init_fn, run_fn = FAMILIES["abc"]
+    stacked = stack_islands(init_fn, n_islands=2, seed=3)
+    stacked = run_islands(run_fn, stacked, 10)  # accumulate some trials
+    stacked = stacked.replace(
+        trials=jnp.ones_like(stacked.trials) * 7
+    )
+    migrated = migrate_ring(stacked, 4)
+    trials = np.asarray(migrated.trials)
+    assert (trials == 0).sum() == 2 * 4          # immigrant slots fresh
+    assert (trials == 7).sum() == 2 * (32 - 4)
+
+
+def test_migrate_ring_merges_gwo_leader_archive():
+    """GWO reads only its leader archive when moving the pack, so
+    immigrant elites must enter it — the donated best becomes (at
+    worst ties) the recipient's new alpha when it beats the incumbent."""
+    init_fn, _ = FAMILIES["gwo"]
+    stacked = stack_islands(init_fn, n_islands=4, seed=6)
+    fit = np.asarray(stacked.fit)
+    alpha_before = np.asarray(stacked.leader_fit[:, 0])
+    migrated = migrate_ring(stacked, 2)
+    alpha_after = np.asarray(migrated.leader_fit[:, 0])
+    for i in range(4):
+        donated_best = np.sort(fit[(i - 1) % 4])[0]
+        expected = min(alpha_before[i], donated_best)
+        assert np.isclose(alpha_after[i], expected)
+    # archive stays sorted best-first
+    lf = np.asarray(migrated.leader_fit)
+    assert np.all(lf[:, 0] <= lf[:, 1]) and np.all(lf[:, 1] <= lf[:, 2])
+
+
+def test_migrate_ring_rejects_bad_k():
+    init_fn, _ = FAMILIES["de"]
+    stacked = stack_islands(init_fn, n_islands=2, seed=0)
+    with pytest.raises(ValueError):
+        migrate_ring(stacked, 0)
+    with pytest.raises(ValueError):
+        migrate_ring(stacked, 33)
+
+
+def test_shard_islands_placement_and_equivalence():
+    init_fn, run_fn = FAMILIES["woa"]
+    mesh = make_mesh((ISLAND_AXIS,))
+    n_dev = mesh.shape[ISLAND_AXIS]
+    stacked = stack_islands(init_fn, n_islands=n_dev, seed=4)
+    ref = run_islands(run_fn, stacked, 20, migrate_every=5, migrate_k=2)
+
+    placed = shard_islands(stacked, mesh)
+    assert placed.pos.sharding.spec == jax.sharding.PartitionSpec(
+        ISLAND_AXIS
+    )
+    out = run_islands(run_fn, placed, 20, migrate_every=5, migrate_k=2)
+    np.testing.assert_allclose(
+        np.asarray(out.pos), np.asarray(ref.pos), atol=1e-5
+    )
+
+
+def test_shard_islands_rejects_indivisible():
+    init_fn, _ = FAMILIES["de"]
+    mesh = make_mesh((ISLAND_AXIS,))
+    if mesh.shape[ISLAND_AXIS] == 1:
+        pytest.skip("needs >1 device")
+    stacked = stack_islands(init_fn, n_islands=mesh.shape[ISLAND_AXIS] + 1,
+                            seed=0)
+    with pytest.raises(ValueError):
+        shard_islands(stacked, mesh)
+
+
+@pytest.mark.parametrize("family", ["de", "firefly"])
+def test_shard_population_gspmd_matches_single_device(family):
+    """The family's ordinary jitted run, executed SPMD over the sharded
+    population axis, matches the single-device result (firefly covers
+    the all-pairs-matmul case, where sharding inserts an all-gather)."""
+    init_fn, run_fn = FAMILIES[family]
+    mesh = make_mesh(("pop",))
+    state = init_fn(5)
+    ref = run_fn(state, 10)
+    placed = shard_population(state, mesh, "pop")
+    out = run_fn(placed, 10)
+    np.testing.assert_allclose(
+        np.asarray(out.pos), np.asarray(ref.pos), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(out.best_fit), float(ref.best_fit), atol=1e-6
+    )
+
+
+def test_shard_population_rejects_indivisible():
+    init_fn, _ = FAMILIES["de"]
+    mesh = make_mesh(("pop",))
+    if mesh.shape["pop"] == 1:
+        pytest.skip("needs >1 device")
+    state = init_fn(0)
+    odd = state.replace(
+        pos=jnp.concatenate([state.pos, state.pos[:1]]),
+        fit=jnp.concatenate([state.fit, state.fit[:1]]),
+    )
+    with pytest.raises(ValueError):
+        shard_population(odd, mesh, "pop")
+
+
+def test_islands_global_best_requires_archive():
+    with pytest.raises(TypeError):
+        islands_global_best(object())

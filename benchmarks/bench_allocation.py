@@ -1,0 +1,61 @@
+"""BASELINE config 4: task allocation at 4096 agents x 4096 tasks.
+
+One full arbitration step = utility matrix (the exact formula from
+/root/reference/agent.py:338-347, batched to [N, T]) + threshold mask +
+argmax-with-hysteresis against incumbents + status update.  The
+reference arbitrates one claim per message per tick through its leader
+(agent.py:304-325) and crashes beyond 255 agents; this is 16.7M bids
+per step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from common import report, timeit_best
+
+from distributed_swarm_algorithm_tpu.ops.allocation import allocation_step
+from distributed_swarm_algorithm_tpu.state import make_swarm, with_tasks
+from distributed_swarm_algorithm_tpu.utils.config import SwarmConfig
+
+N = 4096
+T = 4096
+STEPS = 100
+
+
+def main() -> None:
+    key = jax.random.PRNGKey(0)
+    state = make_swarm(N, n_tasks=0, seed=0, spread=50.0)
+    task_pos = jax.random.uniform(key, (T, 2), minval=-50.0, maxval=50.0)
+    state = with_tasks(state, task_pos)
+    cfg = SwarmConfig()
+
+    @jax.jit
+    def run(s):
+        def body(st, _):
+            return allocation_step(st, cfg), None
+
+        s, _ = jax.lax.scan(body, s, None, length=STEPS)
+        return s
+    out = run(state)
+    jax.block_until_ready(out.task_winner)          # compile + warm
+
+    holder = {}
+
+    def once():
+        holder["out"] = run(state)
+
+    best = timeit_best(
+        once, lambda: int(holder["out"].task_winner[0]), reps=3
+    )
+    report(
+        f"bids/sec, allocation arbitration, {N} agents x {T} tasks",
+        N * T * STEPS / best,
+        "bids/sec",
+        0.0,
+    )
+
+
+if __name__ == "__main__":
+    main()

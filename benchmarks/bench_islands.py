@@ -1,0 +1,72 @@
+"""BASELINE config 5: island-model PSO, 64 islands x 16k particles.
+
+The fused-Pallas island path on one chip (multi-chip shards the island
+axis; see parallel/islands.py and __graft_entry__.dryrun_multichip).
+"""
+
+from __future__ import annotations
+
+from common import REFERENCE_AGENT_STEPS_PER_SEC, report, timeit_best
+
+from distributed_swarm_algorithm_tpu.ops.objectives import get_objective
+from distributed_swarm_algorithm_tpu.parallel.islands import (
+    global_best,
+    island_init,
+)
+from distributed_swarm_algorithm_tpu.utils.platform import on_tpu
+
+N_ISLANDS = 64
+N_PER = 16_384
+DIM = 30
+STEPS = 1280
+MIGRATE_EVERY = 64
+
+
+def main() -> None:
+    fn, hw = get_objective("rastrigin")
+    state = island_init(fn, N_ISLANDS, N_PER, DIM, hw, seed=0)
+    tpu = on_tpu()
+
+    if tpu:
+        from distributed_swarm_algorithm_tpu.ops.pallas.islands_fused import (
+            fused_island_run,
+        )
+
+        def run_once(s):
+            return fused_island_run(
+                s, "rastrigin", STEPS, migrate_every=MIGRATE_EVERY,
+                migrate_k=4, steps_per_kernel=64,
+            )
+        path = "pallas-fused"
+    else:
+        from distributed_swarm_algorithm_tpu.parallel.islands import (
+            island_run,
+        )
+
+        def run_once(s):
+            return island_run(
+                s, fn, STEPS, migrate_every=MIGRATE_EVERY, migrate_k=4,
+                half_width=hw,
+            )
+        path = "xla-jit"
+
+    holder = {"out": run_once(state)}
+    float(global_best(holder["out"])[0])            # compile + warm
+
+    def once():
+        holder["out"] = run_once(state)
+
+    best = timeit_best(
+        once, lambda: float(global_best(holder["out"])[0]), reps=3
+    )
+    report(
+        f"agent-steps/sec, island PSO Rastrigin-30D, {N_ISLANDS} islands "
+        f"x {N_PER}, 1 chip ({path})",
+        N_ISLANDS * N_PER * STEPS / best,
+        "agent-steps/sec",
+        REFERENCE_AGENT_STEPS_PER_SEC,
+    )
+
+
+if __name__ == "__main__":
+    main()

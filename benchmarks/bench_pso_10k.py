@@ -1,0 +1,33 @@
+"""BASELINE config 2: 10k-particle PSO, Rastrigin-30D, one chip."""
+
+from __future__ import annotations
+
+from common import REFERENCE_AGENT_STEPS_PER_SEC, report, timeit_best
+
+from distributed_swarm_algorithm_tpu.models.pso import PSO
+
+N = 10_240          # lane-friendly 10k
+DIM = 30
+STEPS = 2000
+
+
+def main() -> None:
+    opt = PSO("rastrigin", n=N, dim=DIM, seed=0, steps_per_kernel=64)
+    float(opt.state.gbest_fit)
+    opt.run(STEPS)
+    float(opt.state.gbest_fit)                      # warm the timed program
+    best = timeit_best(
+        lambda: opt.run(STEPS), lambda: float(opt.state.gbest_fit)
+    )
+    path = "pallas-fused" if opt.use_pallas else "xla-jit"
+    report(
+        f"agent-steps/sec, PSO Rastrigin-30D, {N} particles, 1 chip "
+        f"({path})",
+        N * STEPS / best,
+        "agent-steps/sec",
+        REFERENCE_AGENT_STEPS_PER_SEC,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""BASELINE config 3: 1M-particle PSO on Ackley-100D, one chip.
+
+The high-dimension sibling of the headline bench (bench.py runs
+Rastrigin-30D); D=100 stresses the sublane axis and the transcendental
+budget (exp + sqrt + cos per element).
+"""
+
+from __future__ import annotations
+
+from common import REFERENCE_AGENT_STEPS_PER_SEC, report, timeit_best
+
+from distributed_swarm_algorithm_tpu.models.pso import PSO
+
+N = 1_048_576
+DIM = 100
+STEPS = 512
+
+
+def main() -> None:
+    opt = PSO("ackley", n=N, dim=DIM, seed=0, steps_per_kernel=64)
+    float(opt.state.gbest_fit)
+    opt.run(STEPS)
+    float(opt.state.gbest_fit)
+    best = timeit_best(
+        lambda: opt.run(STEPS), lambda: float(opt.state.gbest_fit),
+        reps=2,
+    )
+    path = "pallas-fused" if opt.use_pallas else "xla-jit"
+    report(
+        f"agent-steps/sec, PSO Ackley-100D, {N} particles, 1 chip ({path})",
+        N * STEPS / best,
+        "agent-steps/sec",
+        REFERENCE_AGENT_STEPS_PER_SEC,
+    )
+
+
+if __name__ == "__main__":
+    main()

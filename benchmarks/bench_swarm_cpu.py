@@ -1,0 +1,42 @@
+"""BASELINE config 1: 64-agent swarm, 2D Sphere world, CPU backends.
+
+The reference-scale deployment (64 agents is the test_election-era
+default scale; the reference itself measured ~40k agent-steps/sec here,
+SURVEY.md §6).  Runs the NumPy oracle and, when a compiler is available,
+the native C++ tier — no JAX involved.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from common import REFERENCE_AGENT_STEPS_PER_SEC, report, timeit_best
+
+from distributed_swarm_algorithm_tpu import native
+from distributed_swarm_algorithm_tpu.models.cpu_swarm import CpuSwarm
+
+N = 64
+STEPS = 2000
+
+
+def bench_backend(backend: str) -> None:
+    swarm = CpuSwarm(N, seed=0, backend=backend)
+    swarm.set_target(np.asarray([30.0, 0.0]))
+    swarm.step(50)                                  # warm caches
+    best = timeit_best(lambda: swarm.step(STEPS), lambda: None)
+    report(
+        f"agent-steps/sec, 64-agent swarm tick, CPU ({backend})",
+        N * STEPS / best,
+        "agent-steps/sec",
+        REFERENCE_AGENT_STEPS_PER_SEC,
+    )
+
+
+def main() -> None:
+    bench_backend("numpy")
+    if native.available():
+        bench_backend("native")
+
+
+if __name__ == "__main__":
+    main()

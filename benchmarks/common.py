@@ -1,0 +1,56 @@
+"""Shared benchmark harness.
+
+Methodology (hard-won; see docs/PERFORMANCE.md "Benchmark methodology"):
+warm up the EXACT program being timed (jit specializes on static
+n_steps), sync with a scalar device_get (under the axon TPU tunnel,
+``block_until_ready`` can return before remote execution finishes), and
+report the best of ``reps`` (tunnel jitter is one-sided noise).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Callable
+
+# Make the repo root importable no matter where the bench is launched
+# from (the package is used in-tree, not installed).
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+
+def timeit_best(run: Callable[[], None], sync: Callable[[], float],
+                reps: int = 3) -> float:
+    """Best wall-clock seconds over ``reps`` of run()+sync().
+
+    ``run`` must be warmed (compiled) by the caller; ``sync`` must force
+    a scalar off the device.
+    """
+    best = float("inf")
+    for _ in range(reps):
+        start = time.perf_counter()
+        run()
+        sync()
+        best = min(best, time.perf_counter() - start)
+    return best
+
+
+def report(metric: str, value: float, unit: str, baseline: float) -> dict:
+    """Print the one-JSON-line contract (same schema as bench.py)."""
+    out = {
+        "metric": metric,
+        "value": round(value, 1),
+        "unit": unit,
+        "vs_baseline": round(value / baseline, 2) if baseline else None,
+    }
+    print(json.dumps(out))
+    return out
+
+
+# The reference's measured aggregate throughput: ~40k agent-steps/sec at
+# 64 agents on a 2.70 GHz Xeon core (SURVEY.md §6) — the shared
+# denominator for vs_baseline across the suite.
+REFERENCE_AGENT_STEPS_PER_SEC = 40_000.0

@@ -1,0 +1,56 @@
+"""Run the whole benchmark suite; one JSON line per metric.
+
+Each bench is a subprocess so a failure (e.g. no TPU attached for the
+1M-particle configs) skips that line instead of killing the suite.
+Usage:  python benchmarks/run_all.py  [--quick]
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+BENCHES = [
+    "bench_swarm_cpu.py",
+    "bench_allocation.py",
+    "bench_pso_10k.py",
+    "bench_pso_1m_ackley.py",
+    "bench_islands.py",
+]
+
+QUICK_SKIP = {"bench_pso_1m_ackley.py", "bench_islands.py"}
+
+
+def main() -> int:
+    quick = "--quick" in sys.argv[1:]
+    failures = 0
+    for name in BENCHES:
+        if quick and name in QUICK_SKIP:
+            continue
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(HERE, name)],
+                capture_output=True, text=True, timeout=1800,
+            )
+        except subprocess.TimeoutExpired:
+            failures += 1
+            print(f"# {name} timed out after 1800s", file=sys.stderr)
+            continue
+        for line in proc.stdout.splitlines():
+            if line.startswith("{"):
+                print(line, flush=True)
+        if proc.returncode != 0:
+            failures += 1
+            print(
+                f"# {name} failed (rc={proc.returncode}): "
+                f"{proc.stderr.strip().splitlines()[-1] if proc.stderr.strip() else 'no stderr'}",
+                file=sys.stderr,
+            )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -31,6 +31,7 @@ the role its stubbed transport (agent.py:188-195) never could.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Callable, Tuple
 
 import jax
@@ -108,10 +109,16 @@ def migrate_ring(stacked, k: int):
     slots reset to 0 (a fresh source).  The ``jnp.roll`` over the island
     axis lowers to a collective-permute when that axis is sharded.
     """
-    pos, fit = stacked.pos, stacked.fit
-    n_i, n = fit.shape
+    n = stacked.fit.shape[1]
     if not 0 < k <= n:
         raise ValueError(f"migrate_k must be in [1, {n}], got {k}")
+    return _migrate_ring_jit(stacked, k)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _migrate_ring_jit(stacked, k: int):
+    pos, fit = stacked.pos, stacked.fit
+    n_i, n = fit.shape
 
     _, best_idx = lax.top_k(-fit, k)                       # [I, k]
     em_pos = jnp.take_along_axis(pos, best_idx[..., None], axis=1)
@@ -157,13 +164,25 @@ def run_islands(
     ``migrate_every <= 0`` this is one vmapped call; otherwise blocks of
     ``migrate_every`` steps alternate with ``migrate_ring`` (remainder
     steps run unmigrated at the end, matching parallel/islands.py).
+    Each (block + migration) pair is one jit-composed executable,
+    compiled once per ``run_islands`` call and reused across blocks —
+    the per-block cost is a single dispatch, not a dozen eager ops.
     """
     if migrate_every <= 0:
         return jax.vmap(lambda s: run_fn(s, n_steps))(stacked)
     n_blocks, rem = divmod(n_steps, migrate_every)
-    vrun = jax.vmap(lambda s: run_fn(s, migrate_every))
+    block = jax.jit(
+        lambda s: _migrate_ring_jit(
+            jax.vmap(lambda t: run_fn(t, migrate_every))(s), migrate_k
+        )
+    )
+    if n_blocks and not 0 < migrate_k <= stacked.fit.shape[1]:
+        raise ValueError(
+            f"migrate_k must be in [1, {stacked.fit.shape[1]}], "
+            f"got {migrate_k}"
+        )
     for _ in range(n_blocks):
-        stacked = migrate_ring(vrun(stacked), migrate_k)
+        stacked = block(stacked)
     if rem:
         stacked = jax.vmap(lambda s: run_fn(s, rem))(stacked)
     return stacked

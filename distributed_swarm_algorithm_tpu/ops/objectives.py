@@ -60,6 +60,49 @@ def schwefel(x):
     return 418.9829 * d - jnp.sum(x * jnp.sin(jnp.sqrt(jnp.abs(x))), axis=-1)
 
 
+def levy(x):
+    """Levy function; global min 0 at (1,...,1)."""
+    w = 1.0 + (x - 1.0) / 4.0
+    head = jnp.sin(jnp.pi * w[..., 0]) ** 2
+    wi = w[..., :-1]
+    mid = jnp.sum(
+        (wi - 1.0) ** 2
+        * (1.0 + 10.0 * jnp.sin(jnp.pi * wi + 1.0) ** 2),
+        axis=-1,
+    )
+    wd = w[..., -1]
+    tail = (wd - 1.0) ** 2 * (1.0 + jnp.sin(_TWO_PI * wd) ** 2)
+    return head + mid + tail
+
+
+def zakharov(x):
+    """Zakharov; global min 0 at origin (unimodal, ill-conditioned)."""
+    d = x.shape[-1]
+    i = jnp.arange(1, d + 1, dtype=x.dtype)
+    s1 = jnp.sum(x * x, axis=-1)
+    s2 = jnp.sum(0.5 * i * x, axis=-1)
+    return s1 + s2**2 + s2**4
+
+
+def styblinski_tang(x):
+    """Styblinski-Tang, shifted so the global min is 0 (at x_i ≈ -2.9035;
+    the canonical form has min -39.166 D)."""
+    d = x.shape[-1]
+    return (
+        0.5 * jnp.sum(x**4 - 16.0 * x * x + 5.0 * x, axis=-1)
+        + 39.16616570377142 * d
+    )
+
+
+def michalewicz(x):
+    """Michalewicz (m=10): steep ridges, D! local minima; min < 0."""
+    d = x.shape[-1]
+    i = jnp.arange(1, d + 1, dtype=x.dtype)
+    return -jnp.sum(
+        jnp.sin(x) * jnp.sin(i * x * x / jnp.pi) ** 20, axis=-1
+    )
+
+
 # Registry: name -> (fn, canonical search-domain half-width)
 OBJECTIVES = {
     "sphere": (sphere, 5.12),
@@ -68,6 +111,12 @@ OBJECTIVES = {
     "rosenbrock": (rosenbrock, 2.048),
     "griewank": (griewank, 600.0),
     "schwefel": (schwefel, 500.0),
+    "levy": (levy, 10.0),
+    "zakharov": (zakharov, 10.0),
+    "styblinski_tang": (styblinski_tang, 5.0),
+    # Michalewicz's canonical domain is [0, pi]; the framework's domains
+    # are symmetric half-widths, so center at pi/2: x_search = x + pi/2.
+    "michalewicz": (lambda x: michalewicz(x + jnp.pi / 2.0), jnp.pi / 2.0),
 }
 
 

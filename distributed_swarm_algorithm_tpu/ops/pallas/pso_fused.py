@@ -96,21 +96,72 @@ def _rosenbrock_t(x):
     return jnp.sum(100.0 * a * a + b * b, axis=0, keepdims=True)
 
 
+def _iota_1based(d: int, dtype):
+    """[d, 1] column 1..d.  2D because 1D iota is unsupported on TPU, and
+    integer-typed because Mosaic rejects float tpu.iota results."""
+    return jax.lax.broadcasted_iota(jnp.int32, (d, 1), 0).astype(dtype) + 1.0
+
+
 def _griewank_t(x):
     d = x.shape[0]
-    # 2D iota (1D iota is unsupported on TPU).
-    i = jax.lax.broadcasted_iota(x.dtype, (d, 1), 0) + 1.0
-    return (
-        jnp.sum(x * x, axis=0, keepdims=True) / 4000.0
-        - jnp.prod(jnp.cos(x / jnp.sqrt(i)), axis=0, keepdims=True)
-        + 1.0
-    )
+    i = _iota_1based(d, x.dtype)
+    c = jnp.cos(x / jnp.sqrt(i))
+    # reduce_prod is unimplemented in Mosaic; unroll the product over the
+    # static (and sublane-sized) depth axis.
+    p = c[0:1, :]
+    for j in range(1, d):
+        p = p * c[j:j + 1, :]
+    return jnp.sum(x * x, axis=0, keepdims=True) / 4000.0 - p + 1.0
 
 
 def _schwefel_t(x):
     d = x.shape[0]
     return 418.9829 * d - jnp.sum(
         x * jnp.sin(jnp.sqrt(jnp.abs(x))), axis=0, keepdims=True
+    )
+
+
+def _levy_t(x):
+    w = 1.0 + (x - 1.0) / 4.0
+    head = jnp.sin(jnp.pi * w[0:1, :]) ** 2
+    wi = w[:-1, :]
+    mid = jnp.sum(
+        (wi - 1.0) ** 2
+        * (1.0 + 10.0 * jnp.sin(jnp.pi * wi + 1.0) ** 2),
+        axis=0,
+        keepdims=True,
+    )
+    wd = w[-1:, :]
+    tail = (wd - 1.0) ** 2 * (1.0 + jnp.sin(_TWO_PI * wd) ** 2)
+    return head + mid + tail
+
+
+def _zakharov_t(x):
+    d = x.shape[0]
+    i = _iota_1based(d, x.dtype)
+    s1 = jnp.sum(x * x, axis=0, keepdims=True)
+    s2 = jnp.sum(0.5 * i * x, axis=0, keepdims=True)
+    return s1 + s2**2 + s2**4
+
+
+def _styblinski_tang_t(x):
+    d = x.shape[0]
+    return (
+        0.5 * jnp.sum(x**4 - 16.0 * x * x + 5.0 * x, axis=0, keepdims=True)
+        + 39.16616570377142 * d
+    )
+
+
+def _michalewicz_t(x):
+    # Matches the registry's shifted form (ops/objectives.py): the
+    # symmetric search domain [-pi/2, pi/2] maps onto canonical [0, pi].
+    x = x + jnp.pi / 2.0
+    d = x.shape[0]
+    i = _iota_1based(d, x.dtype)
+    return -jnp.sum(
+        jnp.sin(x) * jnp.sin(i * x * x / jnp.pi) ** 20,
+        axis=0,
+        keepdims=True,
     )
 
 
@@ -121,6 +172,10 @@ OBJECTIVES_T: Dict[str, Callable] = {
     "rosenbrock": _rosenbrock_t,
     "griewank": _griewank_t,
     "schwefel": _schwefel_t,
+    "levy": _levy_t,
+    "zakharov": _zakharov_t,
+    "styblinski_tang": _styblinski_tang_t,
+    "michalewicz": _michalewicz_t,
 }
 
 

@@ -99,6 +99,11 @@ def shard_islands(stacked, mesh: Mesh, axis: str = ISLAND_AXIS):
     )
 
 
+def _check_migrate_k(n: int, k: int) -> None:
+    if not 0 < k <= n:
+        raise ValueError(f"migrate_k must be in [1, {n}], got {k}")
+
+
 def migrate_ring(stacked, k: int):
     """Ring elite migration over the island axis, family-agnostic.
 
@@ -109,9 +114,7 @@ def migrate_ring(stacked, k: int):
     slots reset to 0 (a fresh source).  The ``jnp.roll`` over the island
     axis lowers to a collective-permute when that axis is sharded.
     """
-    n = stacked.fit.shape[1]
-    if not 0 < k <= n:
-        raise ValueError(f"migrate_k must be in [1, {n}], got {k}")
+    _check_migrate_k(stacked.fit.shape[1], k)
     return _migrate_ring_jit(stacked, k)
 
 
@@ -164,28 +167,28 @@ def run_islands(
     ``migrate_every <= 0`` this is one vmapped call; otherwise blocks of
     ``migrate_every`` steps alternate with ``migrate_ring`` (remainder
     steps run unmigrated at the end, matching parallel/islands.py).
-    Each (block + migration) pair is one jit-composed executable,
-    compiled once per ``run_islands`` call and reused across blocks —
-    the per-block cost is a single dispatch, not a dozen eager ops.
+    Each (block + migration) pair is one jit-composed executable — the
+    per-block cost is a single dispatch, not a dozen eager ops — cached
+    globally by (run_fn identity, migrate_every, migrate_k, shapes), so
+    repeated ``run_islands`` calls that reuse the same ``run_fn``
+    closure compile once.
     """
     if migrate_every <= 0:
         return jax.vmap(lambda s: run_fn(s, n_steps))(stacked)
+    _check_migrate_k(stacked.fit.shape[1], migrate_k)
     n_blocks, rem = divmod(n_steps, migrate_every)
-    block = jax.jit(
-        lambda s: _migrate_ring_jit(
-            jax.vmap(lambda t: run_fn(t, migrate_every))(s), migrate_k
-        )
-    )
-    if n_blocks and not 0 < migrate_k <= stacked.fit.shape[1]:
-        raise ValueError(
-            f"migrate_k must be in [1, {stacked.fit.shape[1]}], "
-            f"got {migrate_k}"
-        )
     for _ in range(n_blocks):
-        stacked = block(stacked)
+        stacked = _island_block(stacked, run_fn, migrate_every, migrate_k)
     if rem:
         stacked = jax.vmap(lambda s: run_fn(s, rem))(stacked)
     return stacked
+
+
+@partial(jax.jit, static_argnames=("run_fn", "migrate_every", "migrate_k"))
+def _island_block(stacked, run_fn, migrate_every: int, migrate_k: int):
+    return _migrate_ring_jit(
+        jax.vmap(lambda t: run_fn(t, migrate_every))(stacked), migrate_k
+    )
 
 
 def islands_global_best(stacked) -> Tuple[jax.Array, jax.Array]:

@@ -39,6 +39,25 @@ def test_schwefel_minimum():
     assert abs(float(fn(x)[0])) < 1e-2
 
 
+@pytest.mark.parametrize(
+    "name,argmin",
+    [("levy", 1.0), ("zakharov", 0.0), ("styblinski_tang", -2.903534)],
+)
+def test_new_objective_minima(name, argmin):
+    fn, _ = obj.get_objective(name)
+    x = jnp.full((1, 10), argmin)
+    assert abs(float(fn(x)[0])) < 1e-3
+
+
+def test_michalewicz_known_2d_minimum():
+    # Canonical 2D minimum f(2.20, 1.57) ≈ -1.8013; the registry's form
+    # is shifted onto the symmetric domain: x_search = x_canonical - π/2.
+    fn, hw = obj.get_objective("michalewicz")
+    x = jnp.asarray([[2.20290552, 1.57079633]]) - jnp.pi / 2.0
+    assert abs(float(fn(x)[0]) + 1.8013) < 1e-3
+    assert float(jnp.max(jnp.abs(x))) <= hw
+
+
 def test_unknown_objective_raises():
     with pytest.raises(KeyError):
         obj.get_objective("nope")

@@ -1,0 +1,64 @@
+"""Protocol-tick throughput on chip: the full swarm semantics at scale.
+
+One ``swarm_tick`` = APF physics + election/heartbeat/failure detection
++ bid-matrix task allocation, fused by XLA into a handful of kernels.
+The reference runs the same semantics one process per agent at 10 Hz
+with a 255-agent hard cap (SURVEY.md §6); here a MILLION-agent swarm
+ticks faster than one reference agent does.
+
+Separation mode picks the right kernel per scale: exact dense to 4k,
+exact tiled-Pallas to 65k, Morton-window (TPU-native approximate,
+ops/neighbors.py:separation_window) at 1M.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from common import REFERENCE_AGENT_STEPS_PER_SEC, report, timeit_best
+
+import distributed_swarm_algorithm_tpu as dsa
+
+CONFIGS = [
+    (4_096, "dense", 200),
+    (65_536, "pallas", 50),
+    (65_536, "window", 200),
+    (1_048_576, "window", 100),
+]
+
+
+def bench(n: int, mode: str, steps: int) -> None:
+    cfg = dsa.SwarmConfig().replace(separation_mode=mode)
+    s = dsa.make_swarm(n, seed=0, spread=1000.0)
+    s = dsa.with_tasks(
+        s, jnp.asarray([[1.0, 1.0], [-2.0, 3.0], [5.0, -8.0], [0.0, 9.0]])
+    )
+    s = s.replace(
+        target=jnp.broadcast_to(jnp.asarray([50.0, 0.0]), s.pos.shape),
+        has_target=jnp.ones_like(s.has_target),
+    )
+    run = jax.jit(lambda st: dsa.swarm_rollout(st, None, cfg, steps))
+    holder = {"out": run(s)}
+    jax.block_until_ready(holder["out"].pos)        # compile + warm
+
+    def once():
+        holder["out"] = run(s)
+
+    best = timeit_best(once, lambda: float(holder["out"].pos[0, 0]))
+    report(
+        f"agent-steps/sec, full protocol tick, {n} agents "
+        f"(separation={mode})",
+        n * steps / best,
+        "agent-steps/sec",
+        REFERENCE_AGENT_STEPS_PER_SEC,
+    )
+
+
+def main() -> None:
+    for n, mode, steps in CONFIGS:
+        bench(n, mode, steps)
+
+
+if __name__ == "__main__":
+    main()

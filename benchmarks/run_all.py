@@ -19,9 +19,14 @@ BENCHES = [
     "bench_pso_10k.py",
     "bench_pso_1m_ackley.py",
     "bench_islands.py",
+    "bench_swarm_tpu.py",
 ]
 
-QUICK_SKIP = {"bench_pso_1m_ackley.py", "bench_islands.py"}
+QUICK_SKIP = {
+    "bench_pso_1m_ackley.py",
+    "bench_islands.py",
+    "bench_swarm_tpu.py",
+}
 
 
 def main() -> int:

@@ -410,9 +410,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p_swarm.add_argument(
         "--separation", default="dense",
-        choices=["dense", "pallas", "grid", "off"],
+        choices=["dense", "pallas", "grid", "window", "off"],
         help="neighbor-separation kernel (jax backend): dense all-pairs, "
-             "tiled Pallas (large N on TPU), spatial-hash grid, or off",
+             "tiled Pallas (exact, large N on TPU), spatial-hash grid "
+             "(CPU), Morton-window (approximate, very large N on TPU), "
+             "or off",
     )
     p_swarm.set_defaults(fn=_cmd_swarm)
 

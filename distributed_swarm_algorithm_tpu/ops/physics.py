@@ -139,12 +139,17 @@ def apf_forces(
             pos, state.alive, float(cfg.k_sep), float(cfg.personal_space),
             float(cfg.dist_eps), interpret=not on_tpu(),
         )
+    elif cfg.separation_mode == "window":
+        f_sep = _neighbors.separation_window(
+            pos, state.alive, cfg.k_sep, cfg.personal_space, eps,
+            cell=cfg.grid_cell, window=cfg.window_size,
+        )
     elif cfg.separation_mode == "off":
         f_sep = jnp.zeros_like(pos)
     else:
         raise ValueError(
             f"unknown separation_mode {cfg.separation_mode!r}; "
-            "expected 'dense', 'pallas', 'grid', or 'off'"
+            "expected 'dense', 'pallas', 'grid', 'window', or 'off'"
         )
 
     return f_att + f_rep + f_sep

@@ -168,27 +168,26 @@ def run_islands(
     ``migrate_every`` steps alternate with ``migrate_ring`` (remainder
     steps run unmigrated at the end, matching parallel/islands.py).
     Each (block + migration) pair is one jit-composed executable — the
-    per-block cost is a single dispatch, not a dozen eager ops — cached
-    globally by (run_fn identity, migrate_every, migrate_k, shapes), so
-    repeated ``run_islands`` calls that reuse the same ``run_fn``
-    closure compile once.
+    per-block cost is a single dispatch, not a dozen eager ops.  The
+    executable is local to this call (compiled once, reused across all
+    its blocks, garbage-collected after): keying a global jit cache on
+    ``run_fn`` identity would silently recompile for every fresh lambda
+    AND pin each one's closure and executable forever.
     """
     if migrate_every <= 0:
         return jax.vmap(lambda s: run_fn(s, n_steps))(stacked)
     _check_migrate_k(stacked.fit.shape[1], migrate_k)
     n_blocks, rem = divmod(n_steps, migrate_every)
+    block = jax.jit(
+        lambda s: _migrate_ring_jit(
+            jax.vmap(lambda t: run_fn(t, migrate_every))(s), migrate_k
+        )
+    )
     for _ in range(n_blocks):
-        stacked = _island_block(stacked, run_fn, migrate_every, migrate_k)
+        stacked = block(stacked)
     if rem:
         stacked = jax.vmap(lambda s: run_fn(s, rem))(stacked)
     return stacked
-
-
-@partial(jax.jit, static_argnames=("run_fn", "migrate_every", "migrate_k"))
-def _island_block(stacked, run_fn, migrate_every: int, migrate_k: int):
-    return _migrate_ring_jit(
-        jax.vmap(lambda t: run_fn(t, migrate_every))(stacked), migrate_k
-    )
 
 
 def islands_global_best(stacked) -> Tuple[jax.Array, jax.Array]:

@@ -82,10 +82,13 @@ class SwarmConfig:
     #   "dense": exact all-pairs via [N,N,D] broadcast — small swarms.
     #   "pallas": exact all-pairs, tiled Pallas TPU kernel, no O(N²) HBM
     #     intermediates — large swarms on chip (ops/pallas/separation.py).
-    #   "grid": spatial-hash approximation for very large N.
+    #   "grid": spatial-hash approximation (gather-heavy; CPU-oriented).
+    #   "window": Morton-sorted sliding window — the TPU-native
+    #     approximate mode for very large N (roll-based, no gathers).
     #   "off": no separation force.
-    grid_cell: float = 2.0              # spatial-hash cell for "grid" mode
+    grid_cell: float = 2.0              # cell for "grid"/"window" modes
     grid_max_per_cell: int = 8          # bucket capacity for "grid" mode
+    window_size: int = 16               # ± sorted-order span for "window"
     dtype: str = "float32"
 
     def replace(self, **kw) -> "SwarmConfig":

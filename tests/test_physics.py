@@ -6,6 +6,7 @@ deliberate bug fixes (epsilon clamps, ordinal formation ranks).
 """
 
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 import distributed_swarm_algorithm_tpu as dsa
@@ -216,3 +217,72 @@ def test_swarm_moves_to_target_and_settles():
     sw.step(400)
     d = jnp.linalg.norm(sw.state.pos - jnp.asarray([20.0, 0.0]), axis=-1)
     assert float(d.min()) < 2.0
+
+
+# ------------------------------------------------------- window separation
+
+def test_window_separation_exact_when_window_covers_swarm():
+    from distributed_swarm_algorithm_tpu.ops.neighbors import (
+        separation_dense,
+        separation_window,
+    )
+
+    rng = np.random.default_rng(0)
+    pos = jnp.asarray(rng.uniform(-5, 5, (48, 2)).astype(np.float32))
+    alive = jnp.ones(48, bool).at[7].set(False)
+    want = separation_dense(pos, alive, 20.0, 2.0, 1e-3)
+    got = separation_window(pos, alive, 20.0, 2.0, 1e-3, cell=2.0,
+                            window=47)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_window_separation_line_world_small_window():
+    from distributed_swarm_algorithm_tpu.ops.neighbors import (
+        separation_dense,
+        separation_window,
+    )
+
+    # Agents on a line, spacing 1.5 < personal_space 2.0 < 2*spacing:
+    # only adjacent agents interact, and Z-order follows the line, so a
+    # +/-1 window is already exact.
+    n = 32
+    pos = jnp.stack(
+        [jnp.arange(n, dtype=jnp.float32) * 1.5, jnp.zeros(n)], axis=1
+    )
+    alive = jnp.ones(n, bool)
+    want = separation_dense(pos, alive, 20.0, 2.0, 1e-3)
+    got = separation_window(pos, alive, 20.0, 2.0, 1e-3, cell=2.0,
+                            window=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_window_separation_validates_and_falls_back():
+    from distributed_swarm_algorithm_tpu.ops.neighbors import (
+        separation_dense,
+        separation_window,
+    )
+
+    pos3 = jnp.zeros((8, 3))
+    alive = jnp.ones(8, bool)
+    # 3-D falls back to dense (same values)
+    np.testing.assert_allclose(
+        np.asarray(separation_window(pos3, alive, 20.0, 2.0, 1e-3, 2.0, 4)),
+        np.asarray(separation_dense(pos3, alive, 20.0, 2.0, 1e-3)),
+    )
+    with pytest.raises(ValueError):
+        separation_window(jnp.zeros((8, 2)), alive, 20.0, 2.0, 1e-3, 2.0, 0)
+
+
+def test_swarm_tick_window_mode_runs():
+    import distributed_swarm_algorithm_tpu as dsa
+
+    cfg = dsa.SwarmConfig().replace(separation_mode="window")
+    s = dsa.make_swarm(128, seed=0, spread=20.0)
+    s = s.replace(
+        target=jnp.broadcast_to(jnp.asarray([5.0, 0.0]), s.pos.shape),
+        has_target=jnp.ones_like(s.has_target),
+    )
+    out = dsa.swarm_rollout(s, None, cfg, 20)
+    assert bool(jnp.isfinite(out.pos).all())

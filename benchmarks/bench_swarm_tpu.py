@@ -21,15 +21,17 @@ from common import REFERENCE_AGENT_STEPS_PER_SEC, report, timeit_best
 import distributed_swarm_algorithm_tpu as dsa
 
 CONFIGS = [
-    (4_096, "dense", 200),
-    (65_536, "pallas", 50),
-    (65_536, "window", 200),
-    (1_048_576, "window", 100),
+    (4_096, "dense", 200, 1),
+    (65_536, "pallas", 50, 1),
+    (65_536, "window", 200, 8),
+    (1_048_576, "window", 100, 25),
 ]
 
 
-def bench(n: int, mode: str, steps: int) -> None:
-    cfg = dsa.SwarmConfig().replace(separation_mode=mode)
+def bench(n: int, mode: str, steps: int, sort_every: int) -> None:
+    cfg = dsa.SwarmConfig().replace(
+        separation_mode=mode, sort_every=sort_every
+    )
     s = dsa.make_swarm(n, seed=0, spread=1000.0)
     s = dsa.with_tasks(
         s, jnp.asarray([[1.0, 1.0], [-2.0, 3.0], [5.0, -8.0], [0.0, 9.0]])
@@ -46,9 +48,11 @@ def bench(n: int, mode: str, steps: int) -> None:
         holder["out"] = run(s)
 
     best = timeit_best(once, lambda: float(holder["out"].pos[0, 0]))
+    tag = f"separation={mode}" + (
+        f", sort_every={sort_every}" if sort_every > 1 else ""
+    )
     report(
-        f"agent-steps/sec, full protocol tick, {n} agents "
-        f"(separation={mode})",
+        f"agent-steps/sec, full protocol tick, {n} agents ({tag})",
         n * steps / best,
         "agent-steps/sec",
         REFERENCE_AGENT_STEPS_PER_SEC,
@@ -56,8 +60,8 @@ def bench(n: int, mode: str, steps: int) -> None:
 
 
 def main() -> None:
-    for n, mode, steps in CONFIGS:
-        bench(n, mode, steps)
+    for n, mode, steps, sort_every in CONFIGS:
+        bench(n, mode, steps, sort_every)
 
 
 if __name__ == "__main__":

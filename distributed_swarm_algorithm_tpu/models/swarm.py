@@ -19,8 +19,9 @@ import jax.numpy as jnp
 
 from ..ops.allocation import allocation_step, task_status_view
 from ..ops.coordination import coordination_step, current_leader, kill, revive
+from ..ops.neighbors import morton_keys as _morton_keys
 from ..ops.physics import physics_step
-from ..state import SwarmState, make_swarm, with_tasks
+from ..state import SwarmState, make_swarm, permute_agents, with_tasks
 from ..utils.config import DEFAULT_CONFIG, SwarmConfig
 from ._checkpoint import CheckpointMixin
 
@@ -35,6 +36,21 @@ def swarm_tick(
 ) -> SwarmState:
     """One synchronous swarm tick (= one 10 Hz loop body for every agent)."""
     state = state.replace(tick=state.tick + 1)
+    if cfg.separation_mode == "window" and cfg.sort_every > 1:
+        # Keep the agent axis approximately Morton-sorted so the window
+        # separation pass (ops/neighbors.py) runs roll-only.  The full
+        # permutation is semantically transparent (permute_agents) and
+        # amortizes over sort_every ticks; between re-sorts, drift costs
+        # separation recall only.  tick % sort_every == 1 fires on the
+        # first tick of a fresh swarm, then every sort_every.
+        state = jax.lax.cond(
+            state.tick % cfg.sort_every == 1,
+            lambda s: permute_agents(
+                s, jnp.argsort(_morton_keys(s.pos, cfg.grid_cell))
+            ),
+            lambda s: s,
+            state,
+        )
     state = coordination_step(state, cfg)          # agent.py:83-89
     state = allocation_step(state, cfg)            # agent.py:91-92
     state = physics_step(state, obstacles, cfg)    # agent.py:94-181

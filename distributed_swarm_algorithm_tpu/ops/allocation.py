@@ -67,11 +67,16 @@ def arbitrate(
     the incumbent's recorded utility by ``hysteresis`` (agent.py:308-322).
     """
     has_claim = jnp.any(claims_util > 0.0, axis=0)              # [T]
-    # Highest utility wins; ties break to the lowest agent id (argmax picks
-    # the first maximal row; rows are id-ordered).
-    best_row = jnp.argmax(claims_util, axis=0)                  # [T]
+    # Highest utility wins; ties break to the lowest agent ID *by value* —
+    # not by array row, which would make the outcome depend on slot order
+    # (the Morton re-sort under separation_mode="window"/sort_every>1
+    # permutes rows freely).
     best_util = jnp.max(claims_util, axis=0)                    # [T]
-    best_id = claimant_id[best_row]
+    at_best = claims_util == best_util[None, :]                 # [N, T]
+    big = jnp.iinfo(claimant_id.dtype).max
+    best_id = jnp.min(
+        jnp.where(at_best, claimant_id[:, None], big), axis=0
+    )                                                           # [T]
     vacant = incumbent_winner == NO_WINNER
     beats = best_util > incumbent_util + hysteresis             # agent.py:316
     award = has_claim & (vacant | beats)

@@ -68,12 +68,15 @@ def morton_keys(pos: jax.Array, cell: float) -> jax.Array:
     locality, which is what :func:`separation_window` relies on.
     """
     half = 1 << 15
-    cx = (jnp.floor(pos[:, 0] / cell).astype(jnp.int32) + half).astype(
-        jnp.uint32
-    )
-    cy = (jnp.floor(pos[:, 1] / cell).astype(jnp.int32) + half).astype(
-        jnp.uint32
-    )
+    # Clip instead of letting the 16-bit interleave mask wrap: beyond
+    # ±32768 cells the world saturates at the boundary (neighbors there
+    # degrade gracefully) rather than teleporting keys across the map.
+    cx = jnp.clip(
+        jnp.floor(pos[:, 0] / cell).astype(jnp.int32) + half, 0, 0xFFFF
+    ).astype(jnp.uint32)
+    cy = jnp.clip(
+        jnp.floor(pos[:, 1] / cell).astype(jnp.int32) + half, 0, 0xFFFF
+    ).astype(jnp.uint32)
     return _part1by1(cx) | (_part1by1(cy) << 1)
 
 
@@ -85,6 +88,7 @@ def separation_window(
     eps: float,
     cell: float,
     window: int,
+    presorted: bool = False,
 ) -> jax.Array:
     """Morton-sorted sliding-window separation force, [N, D].  2-D only
     (dense fallback otherwise) — the TPU-native mode for very large N.
@@ -99,6 +103,12 @@ def separation_window(
     when more than ~``window`` agents crowd one personal-space
     neighborhood — exactly the regime where separation forces saturate
     anyway.  O(N · window) compute, O(N) memory.
+
+    ``presorted=True`` promises the caller keeps the agent axis itself
+    (approximately) Morton-sorted — see ``state.permute_agents`` and
+    ``cfg.sort_every`` — so the pass runs with NO sort, gather, or
+    scatter at all, just the rolls.  Staleness of that ordering costs
+    recall only: the distance test still rejects every false pair.
     """
     n, d = pos.shape
     if d != 2:
@@ -106,9 +116,12 @@ def separation_window(
     if window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
 
-    order = jnp.argsort(morton_keys(pos, cell))
-    spos = pos[order]
-    salive = alive[order]
+    if presorted:
+        spos, salive = pos, alive
+    else:
+        order = jnp.argsort(morton_keys(pos, cell))
+        spos = pos[order]
+        salive = alive[order]
 
     idx = jnp.arange(n)
     force_s = jnp.zeros_like(pos)
@@ -132,6 +145,8 @@ def separation_window(
             force_s = force_s + jnp.where(
                 near[:, None], mag[:, None] * diff / dist_c[:, None], 0.0
             )
+    if presorted:
+        return force_s
     return jnp.zeros_like(pos).at[order].set(force_s)
 
 

@@ -140,9 +140,14 @@ def apf_forces(
             float(cfg.dist_eps), interpret=not on_tpu(),
         )
     elif cfg.separation_mode == "window":
+        # With sort_every > 1 the swarm itself is kept approximately
+        # Morton-sorted (swarm_tick reorders on cadence via
+        # state.permute_agents), so the pass runs roll-only with no
+        # per-tick sort, gather, or scatter.
         f_sep = _neighbors.separation_window(
             pos, state.alive, cfg.k_sep, cfg.personal_space, eps,
             cell=cfg.grid_cell, window=cfg.window_size,
+            presorted=cfg.sort_every > 1,
         )
     elif cfg.separation_mode == "off":
         f_sep = jnp.zeros_like(pos)

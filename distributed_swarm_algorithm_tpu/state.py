@@ -159,6 +159,32 @@ def make_swarm(
     )
 
 
+# Agent-axis fields (dim 0 == N) — the fields a swarm-wide permutation
+# must move together.  Listed explicitly rather than inferred from shapes:
+# with n_tasks == n_agents a shape test would silently permute the task
+# table too.
+AGENT_AXIS_FIELDS = (
+    "agent_id", "alive", "pos", "vel", "caps", "target", "has_target",
+    "fsm", "leader_id", "leader_pos", "has_leader_pos", "last_hb_tick",
+    "wait_until", "task_claimed",
+)
+
+
+def permute_agents(state: SwarmState, order: jax.Array) -> SwarmState:
+    """Reorder the swarm's agent axis by ``order`` ([N] indices).
+
+    Semantically transparent: every protocol op is a reduction or an
+    elementwise update over the agent axis, and identity lives in
+    ``agent_id`` (which moves with its agent) — only the *array slot* of
+    each agent changes.  Used by ``separation_mode="window"`` with
+    ``sort_every > 1`` to keep the swarm approximately Morton-sorted so
+    the separation pass needs no per-tick gather/scatter.
+    """
+    return state.replace(
+        **{f: getattr(state, f)[order] for f in AGENT_AXIS_FIELDS}
+    )
+
+
 def with_tasks(state: SwarmState, task_pos, task_cap=None) -> SwarmState:
     """Install a task table (the reference's de-facto input API is writing
     the ``tasks`` dict directly, agent.py:41-42 / test_allocation.py)."""

@@ -89,6 +89,17 @@ class SwarmConfig:
     grid_cell: float = 2.0              # cell for "grid"/"window" modes
     grid_max_per_cell: int = 8          # bucket capacity for "grid" mode
     window_size: int = 16               # ± sorted-order span for "window"
+    sort_every: int = 1                 # "window" re-sort cadence in ticks.
+    #   1 (default): sort+gather+scatter inside the separation pass every
+    #     tick; agent array slots are stable.
+    #   >1: the WHOLE swarm state is re-ordered by Morton key every
+    #     sort_every ticks (state.permute_agents) and the separation pass
+    #     runs roll-only with no sort/gather/scatter — 3.7x faster ticks
+    #     at 1M agents.  Semantically transparent to the protocol
+    #     (identity lives in agent_id; kill/revive match by value), but
+    #     ARRAY SLOTS become internal — address agents by id, not index.
+    #     Agents move <= max_speed*dt (0.5 m) per tick vs a 2 m cell, so
+    #     staleness between re-sorts costs separation recall marginally.
     dtype: str = "float32"
 
     def replace(self, **kw) -> "SwarmConfig":

@@ -286,3 +286,114 @@ def test_swarm_tick_window_mode_runs():
     )
     out = dsa.swarm_rollout(s, None, cfg, 20)
     assert bool(jnp.isfinite(out.pos).all())
+
+
+def test_permute_agents_moves_identity_with_agent():
+    from distributed_swarm_algorithm_tpu.state import permute_agents
+
+    s = dsa.make_swarm(16, seed=3, spread=10.0)
+    order = jnp.asarray(np.random.default_rng(0).permutation(16))
+    p = permute_agents(s, order)
+    np.testing.assert_array_equal(
+        np.asarray(p.agent_id), np.asarray(s.agent_id[order])
+    )
+    np.testing.assert_allclose(
+        np.asarray(p.pos), np.asarray(s.pos[order])
+    )
+    # task table untouched (permutation is agent-axis only)
+    np.testing.assert_array_equal(
+        np.asarray(p.task_winner), np.asarray(s.task_winner)
+    )
+
+
+def test_window_sorted_swarm_protocol_semantics_survive_permutation():
+    """sort_every > 1 reorders array slots; election, failure recovery,
+    and id-addressed fault injection must be unaffected (identity lives
+    in agent_id, and kill/revive match by value)."""
+    from distributed_swarm_algorithm_tpu.ops.coordination import (
+        current_leader,
+        kill,
+    )
+
+    cfg = dsa.SwarmConfig().replace(separation_mode="window", sort_every=5)
+    s = dsa.make_swarm(64, seed=1, spread=30.0)
+    s = s.replace(
+        target=jnp.broadcast_to(jnp.asarray([10.0, 0.0]), s.pos.shape),
+        has_target=jnp.ones_like(s.has_target),
+    )
+    s = dsa.swarm_rollout(s, None, cfg, 40)
+    lid, exists = current_leader(s)
+    assert bool(exists) and int(lid) == 63
+    s = kill(s, [63])
+    s = dsa.swarm_rollout(s, None, cfg, 40)
+    lid, exists = current_leader(s)
+    assert bool(exists) and int(lid) == 62
+    assert bool(jnp.isfinite(s.pos).all())
+
+
+def test_window_sorted_swarm_still_separates():
+    """A clustered swarm must spread out under the presorted window mode
+    (the roll-only pass still produces real repulsion forces)."""
+    cfg = dsa.SwarmConfig().replace(separation_mode="window", sort_every=4)
+    s = dsa.make_swarm(256, seed=2, spread=0.5)        # crowded start
+    s = s.replace(
+        target=jnp.broadcast_to(jnp.asarray([0.0, 0.0]), s.pos.shape),
+        has_target=jnp.ones_like(s.has_target),
+    )
+    def mean_nn(st):
+        d = jnp.linalg.norm(
+            st.pos[:, None, :] - st.pos[None, :, :], axis=-1
+        ) + jnp.eye(256) * 1e9
+        return float(jnp.mean(jnp.min(d, axis=1)))
+    before = mean_nn(s)
+    out = dsa.swarm_rollout(s, None, cfg, 60)
+    assert mean_nn(out) > before * 1.5
+
+
+def test_agent_axis_fields_cover_swarm_state():
+    """Guard: every SwarmState field whose leading dim is the agent axis
+    must be listed in AGENT_AXIS_FIELDS, or permute_agents silently
+    cross-wires agents' state.  Uses n_tasks != n_agents so agent-axis
+    and task-axis fields are distinguishable by shape."""
+    import dataclasses
+
+    from distributed_swarm_algorithm_tpu.state import AGENT_AXIS_FIELDS
+
+    n, t = 11, 7
+    s = dsa.make_swarm(n, n_tasks=t, seed=0)
+    known_non_agent = {"tick", "key", "task_pos", "task_cap",
+                       "task_winner", "task_util"}
+    for f in dataclasses.fields(s):
+        leaf = getattr(s, f.name)
+        if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == n:
+            assert f.name in AGENT_AXIS_FIELDS, (
+                f"SwarmState.{f.name} has an agent-axis leading dim but "
+                "is missing from AGENT_AXIS_FIELDS — permute_agents "
+                "would not move it"
+            )
+        else:
+            assert f.name in known_non_agent or f.name in AGENT_AXIS_FIELDS
+
+
+def test_allocation_tie_breaks_by_id_value_not_row_order():
+    """Two agents equidistant from a task with equal utility: the LOWER
+    agent id must win regardless of array slot order (the Morton re-sort
+    permutes slots freely)."""
+    from distributed_swarm_algorithm_tpu.ops.allocation import (
+        allocation_step,
+    )
+    from distributed_swarm_algorithm_tpu.state import permute_agents
+
+    cfg = dsa.SwarmConfig()
+    s = dsa.make_swarm(
+        2, n_tasks=1, seed=0, pos=jnp.asarray([[-1.0, 0.0], [1.0, 0.0]])
+    )
+    s = s.replace(
+        task_pos=jnp.asarray([[0.0, 0.0]]),
+        fsm=s.fsm.at[1].set(dsa.LEADER),
+        leader_id=jnp.full_like(s.leader_id, 1),
+    )
+    out_a = allocation_step(s, cfg)
+    out_b = allocation_step(permute_agents(s, jnp.asarray([1, 0])), cfg)
+    assert int(out_a.task_winner[0]) == 0
+    assert int(out_b.task_winner[0]) == 0

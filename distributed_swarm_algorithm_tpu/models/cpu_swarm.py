@@ -216,6 +216,23 @@ class CpuSwarm:
         t = self.task_pos.shape[0]
         if t == 0:
             return
+
+        # Dead-winner eviction (mirrors ops/allocation.py:allocation_step):
+        # a task awarded to a dead agent reopens and everyone's view of it
+        # resets, so the swarm re-bids — deliberate elastic recovery the
+        # reference lacks (SURVEY.md §5a bug 6).
+        awarded = self.task_winner != NO_WINNER
+        winner_alive = (
+            (self.agent_id[:, None] == self.task_winner[None, :])
+            & self.alive[:, None]
+        ).any(axis=0)
+        evict = awarded & ~winner_alive
+        self.task_winner = np.where(
+            evict, NO_WINNER, self.task_winner
+        ).astype(np.int32)
+        self.task_util = np.where(evict, 0.0, self.task_util)
+        self.task_claimed &= ~evict[None, :]
+
         if self.backend == "native":
             u = _native.utility_matrix(
                 self.pos, self.task_pos, self.caps, self.task_cap,
@@ -264,7 +281,7 @@ class CpuSwarm:
         self.task_claimed |= claims | awarded[None, :]
 
     # --- physics (NumPy / native port of ops/physics.py) ------------------
-    def _formation_targets(self) -> None:
+    def _formation_targets(self):
         cfg = self.config
         if cfg.formation_rank_mode == "id":
             rank = self.agent_id.astype(float)
@@ -291,14 +308,16 @@ class CpuSwarm:
             (self.fsm == FOLLOWER) & self.has_leader_pos & self.alive
         )
         new_target = self.leader_pos + np.stack([x_off, y_off], axis=1)
-        self.target = np.where(
-            is_follower[:, None], new_target, self.target
+        # Ephemeral (mirrors ops/physics.py:physics_step): the derived
+        # target steers this tick only; self.target keeps the nav goal.
+        return (
+            np.where(is_follower[:, None], new_target, self.target),
+            self.has_target | is_follower,
         )
-        self.has_target |= is_follower
 
     def _physics_step(self) -> None:
         cfg = self.config
-        self._formation_targets()
+        target, has_target = self._formation_targets()
         # separation_mode: "dense" and "grid" both mean exact all-pairs
         # here (grid is a TPU-scale optimization, ops/neighbors.py; CPU
         # swarms are small enough for O(N^2)); "off" disables the force —
@@ -306,7 +325,7 @@ class CpuSwarm:
         sep_off = cfg.separation_mode == "off"
         if self.backend == "native":
             _native.physics_step(
-                self.pos, self.vel, self.target, self.has_target,
+                self.pos, self.vel, target, has_target,
                 self.alive, self.obstacles,
                 cfg.replace(k_sep=0.0) if sep_off else cfg,
             )
@@ -314,9 +333,9 @@ class CpuSwarm:
 
         eps = cfg.dist_eps
         pos = self.pos
-        delta = self.target - pos
+        delta = target - pos
         dist = np.linalg.norm(delta, axis=-1)
-        pulling = self.has_target & (dist > cfg.arrival_tolerance)
+        pulling = has_target & (dist > cfg.arrival_tolerance)
         force = np.where(pulling[:, None], cfg.k_att * delta, 0.0)
 
         if self.obstacles is not None and len(self.obstacles):
@@ -355,7 +374,7 @@ class CpuSwarm:
             1.0,
         )
         vel = force * scale
-        moving = self.has_target & self.alive
+        moving = has_target & self.alive
         vel = np.where(moving[:, None], vel, 0.0)
         self.pos = np.where(
             moving[:, None], pos + vel * cfg.dt, pos

@@ -66,6 +66,14 @@ def swarm_rollout(
 ) -> SwarmState:
     """``n_steps`` ticks under one ``lax.scan`` — the as-fast-as-possible
     mode; XLA fuses each tick into a handful of kernels."""
+    if cfg.separation_mode == "window" and cfg.sort_every > 1:
+        # Re-sort unconditionally on rollout entry: the in-tick cadence
+        # (tick % sort_every == 1) assumes ticks are aligned to it, which
+        # a state produced under a different config (or hand-built) may
+        # not be — entering sorted bounds staleness to < sort_every ticks.
+        state = permute_agents(
+            state, jnp.argsort(_morton_keys(state.pos, cfg.grid_cell))
+        )
 
     def body(s, _):
         return swarm_tick(s, obstacles, cfg), None
@@ -102,7 +110,13 @@ class VectorSwarm(CheckpointMixin):
 
     # --- world injection (reference: set_target / update_sensors) --------
     def set_target(self, target, agents=None) -> None:
-        """Set a nav target for all agents (or a subset) — agent.py:56-57."""
+        """Set a nav target for all agents (or a subset) — agent.py:56-57.
+
+        ``agents`` are agent IDS, matched by value (like kill/revive) —
+        array slots are internal once the Morton re-sort is active
+        (separation_mode="window", sort_every > 1).  With the default
+        ordering ids and slots coincide, so this is backward-compatible.
+        """
         t = jnp.broadcast_to(
             jnp.asarray(target, self.state.pos.dtype), self.state.pos.shape
         )
@@ -111,7 +125,10 @@ class VectorSwarm(CheckpointMixin):
                 target=t, has_target=jnp.ones_like(self.state.has_target)
             )
         else:
-            sel = jnp.zeros_like(self.state.has_target).at[agents].set(True)
+            ids = jnp.asarray(agents, jnp.int32).reshape(-1)
+            sel = jnp.any(
+                self.state.agent_id[:, None] == ids[None, :], axis=1
+            )
             self.state = self.state.replace(
                 target=jnp.where(sel[:, None], t, self.state.target),
                 has_target=self.state.has_target | sel,

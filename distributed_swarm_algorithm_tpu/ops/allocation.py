@@ -86,9 +86,29 @@ def arbitrate(
 
 
 def allocation_step(state: SwarmState, cfg: SwarmConfig) -> SwarmState:
-    """One allocation tick: greedy claims, leader arbitration, award."""
+    """One allocation tick: dead-winner eviction, greedy claims, leader
+    arbitration, award."""
     if state.n_tasks == 0:
         return state
+
+    # Failure recovery: a task whose awarded winner has died reopens (and
+    # everyone's claimed/LOCKED view of it resets) so the swarm re-bids.
+    # The reference never garbage-collects claims — a dead winner keeps
+    # its tasks forever (SURVEY.md §5a bug 6); elastic recovery here is
+    # deliberate, in both lock-on-award and live-reallocation modes.
+    awarded = state.task_winner != NO_WINNER                     # [T]
+    winner_alive = jnp.any(
+        (state.agent_id[:, None] == state.task_winner[None, :])
+        & state.alive[:, None],
+        axis=0,
+    )                                                            # [T]
+    evict = awarded & ~winner_alive
+    state = state.replace(
+        task_winner=jnp.where(evict, NO_WINNER, state.task_winner),
+        task_util=jnp.where(evict, 0.0, state.task_util),
+        task_claimed=state.task_claimed & ~evict[None, :],
+    )
+
     u = utility_matrix(state, cfg)
     leader_exists = jnp.any(state.alive & (state.fsm == LEADER))
 

@@ -53,17 +53,26 @@ def formation_targets(state: SwarmState, cfg: SwarmConfig) -> SwarmState:
     if cfg.formation_rank_mode == "id":
         rank = state.agent_id.astype(jnp.float32)
     else:
-        # Ordinal among alive agents by id, skipping each agent's own view
-        # of the leader: rank 1 = lowest-id alive non-leader agent.  O(N):
-        # agent_id is the arange index (make_swarm), so "# alive below me"
-        # is an exclusive cumsum, minus one if my leader sits below me.
-        alive_i = state.alive.astype(jnp.int32)
-        alive_below = jnp.cumsum(alive_i) - alive_i
+        # Ordinal among alive agents by ID VALUE, skipping each agent's
+        # own view of the leader: rank 1 = lowest-id alive non-leader.
+        # Computed in id space (scatter by agent_id, cumsum, gather back)
+        # so the result is invariant to array-slot order — the Morton
+        # re-sort under sort_every > 1 permutes slots freely.  O(N).
+        n = state.n_agents
+        aid = state.agent_id
+        alive_by_id = (
+            jnp.zeros((n,), jnp.int32)
+            .at[aid]
+            .set(state.alive.astype(jnp.int32))
+        )
+        cum = jnp.cumsum(alive_by_id) - alive_by_id    # alive ids < id k
+        alive_below = cum[aid]
         lid = state.leader_id
-        lid_valid = (lid >= 0) & (lid < state.n_agents)
-        leader_alive = state.alive[jnp.clip(lid, 0, state.n_agents - 1)]
+        lid_c = jnp.clip(lid, 0, n - 1)
+        lid_valid = (lid >= 0) & (lid < n)
+        leader_alive = alive_by_id[lid_c].astype(bool)  # id-indexed
         leader_below = (
-            lid_valid & leader_alive & (lid < state.agent_id)
+            lid_valid & leader_alive & (lid < aid)
         ).astype(jnp.int32)
         rank = (alive_below - leader_below + 1).astype(jnp.float32)
 
@@ -183,13 +192,20 @@ def physics_step(
     cfg: SwarmConfig,
     dt: Optional[float] = None,
 ) -> SwarmState:
-    """One full motion tick: formation retarget -> forces -> integrate."""
+    """One full motion tick: formation retarget -> forces -> integrate.
+
+    The formation-derived target is EPHEMERAL: it steers this tick's
+    forces but is not written back, so ``state.target`` keeps the
+    user-set nav goal.  A follower promoted to leader therefore resumes
+    the mission instead of parking on its stale formation slot (which is
+    what persisting the derived target caused).
+    """
     dt = cfg.dt if dt is None else dt
-    state = formation_targets(state, cfg)
-    force = apf_forces(state, obstacles, cfg)
+    derived = formation_targets(state, cfg)
+    force = apf_forces(derived, obstacles, cfg)
     # Reference semantics: no target => early return, nothing moves
     # (agent.py:113-114).  Dead agents are frozen too (masked update).
-    moving = state.has_target & state.alive
+    moving = derived.has_target & state.alive
     pos, vel = integrate(state.pos, force, moving, cfg, dt)
     pos = jnp.where(moving[:, None], pos, state.pos)
     return state.replace(pos=pos, vel=vel)

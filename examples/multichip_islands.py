@@ -1,0 +1,53 @@
+"""Island-model optimization over a device mesh — any family.
+
+On a multi-chip TPU slice the island axis shards over ICI and the ring
+migration lowers to a collective-permute; on a single host this runs on
+virtual devices.  Run:
+
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/multichip_islands.py
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import jax
+
+
+def main():
+    from distributed_swarm_algorithm_tpu.ops.de import de_init, de_run
+    from distributed_swarm_algorithm_tpu.ops.objectives import get_objective
+    from distributed_swarm_algorithm_tpu.parallel.mesh import (
+        ISLAND_AXIS,
+        make_mesh,
+    )
+    from distributed_swarm_algorithm_tpu.parallel.universal import (
+        islands_global_best,
+        run_islands,
+        shard_islands,
+        stack_islands,
+    )
+
+    fn, hw = get_objective("rastrigin")
+    n_dev = len(jax.devices())
+    print(f"devices: {n_dev} ({jax.devices()[0].platform})")
+
+    stacked = stack_islands(
+        lambda seed: de_init(fn, 512, 16, hw, seed=seed),
+        n_islands=n_dev,
+    )
+    stacked = shard_islands(stacked, make_mesh((ISLAND_AXIS,)))
+    stacked = run_islands(
+        lambda s, k: de_run(s, fn, k, half_width=hw),
+        stacked, 300, migrate_every=50, migrate_k=8,
+    )
+    fit, pos = islands_global_best(stacked)
+    print(f"global best after 300 gens x {n_dev} islands: {float(fit):.4g}")
+    assert float(fit) < 150.0      # random init is ~400 on rastrigin-16D
+    print("OK: islands ran sharded with ring elite migration.")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""Every optimizer family on one problem — one table.
+
+Run:  python examples/optimizer_zoo.py   (~1 min on CPU, faster on TPU)
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import time
+
+
+def main():
+    from distributed_swarm_algorithm_tpu.models.abc_bees import ABC
+    from distributed_swarm_algorithm_tpu.models.cmaes import CMAES
+    from distributed_swarm_algorithm_tpu.models.cuckoo import Cuckoo
+    from distributed_swarm_algorithm_tpu.models.de import DE
+    from distributed_swarm_algorithm_tpu.models.firefly import Firefly
+    from distributed_swarm_algorithm_tpu.models.gwo import GWO
+    from distributed_swarm_algorithm_tpu.models.memetic import MemeticPSO
+    from distributed_swarm_algorithm_tpu.models.pso import PSO
+    from distributed_swarm_algorithm_tpu.models.woa import WOA
+
+    problem, n, dim, steps = "rastrigin", 256, 10, 400
+    families = [
+        ("PSO", lambda: PSO(problem, n=n, dim=dim, seed=0)),
+        ("PSO ring", lambda: PSO(problem, n=n, dim=dim, seed=0,
+                                 topology="ring", use_pallas=False)),
+        ("MemeticPSO", lambda: MemeticPSO(problem, n=n, dim=dim, seed=0,
+                                          refine_every=20)),
+        ("DE", lambda: DE(problem, n=n, dim=dim, seed=0)),
+        ("CMA-ES", lambda: CMAES(problem, dim=dim, n=64, seed=0)),
+        ("ABC", lambda: ABC(problem, n=n, dim=dim, seed=0)),
+        ("GWO", lambda: GWO(problem, n=n, dim=dim, t_max=steps, seed=0)),
+        ("WOA", lambda: WOA(problem, n=n, dim=dim, t_max=steps, seed=0)),
+        ("Cuckoo", lambda: Cuckoo(problem, n=n, dim=dim, seed=0)),
+        ("Firefly", lambda: Firefly(problem, n=n, dim=dim, seed=0)),
+    ]
+
+    print(f"{problem}-{dim}D, {steps} iterations\n")
+    print(f"{'family':<12} {'best':>12} {'seconds':>8}")
+    for name, build in families:
+        opt = build()
+        t0 = time.perf_counter()
+        opt.run(steps)
+        dt = time.perf_counter() - t0
+        print(f"{name:<12} {opt.best:>12.4g} {dt:>8.2f}")
+
+
+if __name__ == "__main__":
+    main()

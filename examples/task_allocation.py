@@ -1,0 +1,64 @@
+"""Capability-gated task allocation with live reallocation on failure.
+
+The reference's greedy-claim + leader-arbitration protocol
+(/root/reference/agent.py:291-347) as one bid-matrix reduction.
+Run:  python examples/task_allocation.py
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import jax.numpy as jnp
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu.ops.allocation import task_status_view
+from distributed_swarm_algorithm_tpu.ops.coordination import kill
+from distributed_swarm_algorithm_tpu.state import TASK_ASSIGNED
+
+STATUS = {0: "OPEN", 1: "TENTATIVE", 2: "ASSIGNED", 3: "LOCKED"}
+
+
+def show(sw, label):
+    # task_status_view is the per-agent [N, T] view (decentralized
+    # semantics); agent 0's row serves as the global picture here.
+    status = [STATUS[int(c)] for c in task_status_view(sw.state)[0]]
+    winners = [int(w) for w in sw.state.task_winner]
+    print(f"{label}: winners={winners} status={status}")
+
+
+def main():
+    # Live-reallocation mode: tasks stay contestable, so a dead winner's
+    # task is re-awarded (the reference LOCKs forever, §5a quirk 4).
+    # utility_threshold 2.0 widens claim range to ~49 m (the reference's
+    # 20.0 means "within 4 m", agent.py:297) so a 10 m-spread swarm bids.
+    cfg = dsa.SwarmConfig().replace(
+        allocation_lock_on_award=False, utility_threshold=2.0
+    )
+    sw = dsa.VectorSwarm(6, n_tasks=0, n_caps=2, config=cfg, seed=3,
+                         spread=10.0)
+    # Agents 0-2 can 'lift', 3-5 can 'scan' (one-hot columns 0/1).
+    caps = jnp.zeros((6, 2), bool).at[:3, 0].set(True).at[3:, 1].set(True)
+    sw.set_capabilities(caps)
+    # Two tasks: one needs cap 0, one needs cap 1.
+    sw.add_tasks([[5.0, 5.0], [-5.0, -5.0]], task_cap=[0, 1])
+
+    sw.step(40)                                  # elect + claim + arbitrate
+    show(sw, "after arbitration")
+    w0, w1 = (int(w) for w in sw.state.task_winner)
+    assert w0 in (0, 1, 2) and w1 in (3, 4, 5), "capability gating violated"
+
+    sw.state = kill(sw.state, [w0])
+    print(f"winner {w0} of task 0 KILLED")
+    sw.step(60)
+    show(sw, "after recovery")
+    w0b = int(sw.state.task_winner[0])
+    assert w0b != w0 and w0b in (0, 1, 2), "task 0 should be re-awarded"
+    w0_row = int(jnp.argmax(sw.state.agent_id == w0b))
+    assert int(task_status_view(sw.state)[w0_row, 0]) == TASK_ASSIGNED
+    print("OK: tasks awarded by capability, reallocated after failure.")
+
+
+if __name__ == "__main__":
+    main()

@@ -226,3 +226,39 @@ def test_bid_matrix_scales():
     u = utility_matrix(s, CFG)
     reachable = (u > CFG.utility_threshold).any(axis=0)
     assert bool((s.task_winner[reachable] != NO_WINNER).all())
+
+
+def test_dead_winner_evicted_and_task_reawarded():
+    """A task awarded to an agent that then dies must reopen and be
+    re-awarded to a surviving claimant — elastic recovery the reference
+    lacks (SURVEY.md §5a bug 6: claims are never garbage-collected)."""
+    from distributed_swarm_algorithm_tpu.ops.coordination import kill
+
+    cfg = dsa.SwarmConfig().replace(utility_threshold=2.0)
+    sw = dsa.VectorSwarm(4, seed=0, spread=5.0, config=cfg)
+    sw.add_tasks([[0.0, 0.0]])
+    sw.step(40)                       # elect, claim, award
+    w = int(sw.state.task_winner[0])
+    assert w != -1
+    sw.state = kill(sw.state, [w])
+    sw.step(60)
+    w2 = int(sw.state.task_winner[0])
+    assert w2 != -1 and w2 != w
+
+
+def test_dead_winner_evicted_cpu_backends():
+    from distributed_swarm_algorithm_tpu import native
+    from distributed_swarm_algorithm_tpu.models.cpu_swarm import CpuSwarm
+
+    cfg = dsa.SwarmConfig().replace(utility_threshold=2.0)
+    backends = ["numpy"] + (["native"] if native.available() else [])
+    for backend in backends:
+        sw = CpuSwarm(4, seed=0, spread=5.0, config=cfg, backend=backend)
+        sw.add_tasks([[0.0, 0.0]])
+        sw.step(40)
+        w = int(sw.task_winner[0])
+        assert w != -1
+        sw.kill([w])
+        sw.step(60)
+        w2 = int(sw.task_winner[0])
+        assert w2 != -1 and w2 != w

@@ -397,3 +397,26 @@ def test_allocation_tie_breaks_by_id_value_not_row_order():
     out_b = allocation_step(permute_agents(s, jnp.asarray([1, 0])), cfg)
     assert int(out_a.task_winner[0]) == 0
     assert int(out_b.task_winner[0]) == 0
+
+
+def test_formation_targets_equivariant_under_permutation():
+    """formation_targets must commute with agent permutation: ranks are
+    computed in id space, so Morton re-sorts cannot reshuffle slots."""
+    from distributed_swarm_algorithm_tpu.state import permute_agents
+
+    s = dsa.make_swarm(8, seed=5, spread=10.0)
+    s = s.replace(
+        fsm=s.fsm.at[6].set(dsa.LEADER),
+        leader_id=jnp.full_like(s.leader_id, 6),
+        leader_pos=jnp.broadcast_to(jnp.asarray([3.0, 1.0]), s.pos.shape),
+        has_leader_pos=jnp.ones_like(s.has_leader_pos),
+        alive=s.alive.at[2].set(False),
+    )
+    order = jnp.asarray([5, 0, 7, 3, 6, 1, 4, 2])
+    a = permute_agents(formation_targets(s, CFG), order)
+    b = formation_targets(permute_agents(s, order), CFG)
+    np.testing.assert_allclose(np.asarray(a.target), np.asarray(b.target),
+                               atol=1e-6)
+    np.testing.assert_array_equal(
+        np.asarray(a.has_target), np.asarray(b.has_target)
+    )

@@ -1,0 +1,40 @@
+"""Boids flocking at scale: dense vs Morton-window neighbor modes.
+
+Density held constant (~0.32 boids/m²: half_width scales with sqrt N)
+so perception-disc populations — and therefore window recall — stay
+comparable across sizes.  A million-boid flock is impossible for the
+dense pass (the [N, N] interaction would need ~4 TB); the window pass
+runs it in real time.
+"""
+
+from __future__ import annotations
+
+from common import report, timeit_best
+
+from distributed_swarm_algorithm_tpu.models.boids import Boids
+
+CONFIGS = [
+    (16_384, 113.0, "dense", 100),
+    (16_384, 113.0, "window", 200),
+    (1_048_576, 905.0, "window", 50),
+]
+
+
+def main() -> None:
+    for n, hw, mode, steps in CONFIGS:
+        flock = Boids(n=n, seed=0, half_width=hw, neighbor_mode=mode)
+        flock.run(steps)                          # compile + warm
+        best = timeit_best(
+            lambda: flock.run(steps),
+            lambda: float(flock.state.pos[0, 0]),
+        )
+        report(
+            f"boid-steps/sec, Reynolds flocking, {n} boids ({mode})",
+            n * steps / best,
+            "boid-steps/sec",
+            0.0,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -20,12 +20,14 @@ BENCHES = [
     "bench_pso_1m_ackley.py",
     "bench_islands.py",
     "bench_swarm_tpu.py",
+    "bench_boids.py",
 ]
 
 QUICK_SKIP = {
     "bench_pso_1m_ackley.py",
     "bench_islands.py",
     "bench_swarm_tpu.py",
+    "bench_boids.py",
 }
 
 

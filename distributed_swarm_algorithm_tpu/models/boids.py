@@ -30,12 +30,25 @@ class Boids(CheckpointMixin):
         params: Optional[_k.BoidsParams] = None,
         obstacles: Optional[jax.Array] = None,
         seed: int = 0,
+        neighbor_mode: str = "dense",
         **overrides,
     ):
         base = params if params is not None else _k.BoidsParams()
         if overrides:
             base = base._replace(**overrides)
         self.params = base
+        if neighbor_mode not in ("dense", "window"):
+            raise ValueError(
+                f"unknown neighbor_mode {neighbor_mode!r}; "
+                "expected 'dense' or 'window'"
+            )
+        if neighbor_mode == "window" and dim != 2:
+            raise ValueError(
+                "neighbor_mode='window' is 2-D only (a silent dense "
+                f"fallback would OOM at window-mode flock sizes); got "
+                f"dim={dim}"
+            )
+        self.neighbor_mode = neighbor_mode
         self.obstacles = (
             jnp.asarray(obstacles, jnp.float32)
             if obstacles is not None
@@ -44,14 +57,20 @@ class Boids(CheckpointMixin):
         self.state = _k.boids_init(n, dim, self.params, seed=seed)
 
     def step(self) -> _k.BoidsState:
-        self.state = _k.boids_step(self.state, self.params, self.obstacles)
+        step_fn = (
+            _k.boids_step_window
+            if self.neighbor_mode == "window"
+            else _k.boids_step
+        )
+        self.state = step_fn(self.state, self.params, self.obstacles)
         return self.state
 
     def run(self, n_steps: int, record: bool = False):
         """Advance ``n_steps`` ticks; with ``record=True`` returns the
         ``[n_steps, N, D]`` position trajectory."""
         self.state, traj = _k.boids_run(
-            self.state, self.params, n_steps, self.obstacles, record
+            self.state, self.params, n_steps, self.obstacles, record,
+            neighbor_mode=self.neighbor_mode,
         )
         jax.block_until_ready(self.state.pos)
         return traj if record else self.state

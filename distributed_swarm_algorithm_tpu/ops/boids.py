@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
+from . import neighbors as _neighbors
+
 
 @struct.dataclass
 class BoidsState:
@@ -56,6 +58,16 @@ class BoidsParams(NamedTuple):
     max_force: float = 10.0       # steering-acceleration clamp
     dt: float = 0.1               # reference tick period (agent.py:68)
     eps: float = 1e-3             # norm floor (SURVEY.md §5a bug 1 fix)
+    # --- "window" neighbor mode (million-boid scale; 2-D only) ----------
+    # The window samples the alignment/cohesion neighborhood: recall is
+    # ~min(1, window / boids-per-perception-disc), and since those rules
+    # are neighborhood AVERAGES a ~50% sample still orders the flock
+    # (measured: polarization plateaus ~0.85 vs 0.99 dense at 512 boids,
+    # 40x40 world).  Separation (small radius, few neighbors) stays
+    # near-exact.  Size ``window`` to your density accordingly.
+    window: int = 48              # ± sorted-order span per boid
+    sort_cell: float = 2.0        # Morton cell (finer = better locality)
+    sort_every: int = 2           # re-sort cadence in steps
 
 
 def boids_init(
@@ -135,28 +147,96 @@ def boids_forces(
                     rel_centroid, 0.0)
 
     acc = p.w_sep * sep + p.w_align * align + p.w_coh * coh
+    acc = acc + _obstacle_acc(pos, obstacles, p)
+    return _clamp_force(acc, p)
 
-    if obstacles is not None and obstacles.shape[0] > 0:
-        centers, radius = obstacles[:, :-1], obstacles[:, -1]
-        od = _wrap(pos[:, None, :] - centers[None, :, :], p.half_width)
-        odist = jnp.maximum(jnp.linalg.norm(od, axis=-1), p.eps)
-        rho = radius[None, :] + p.r_sep
-        inside = odist < rho
-        mag = (1.0 / odist - 1.0 / rho) / (odist * odist)
-        acc = acc + jnp.sum(
-            jnp.where(
-                inside[..., None],
-                (p.w_sep * p.max_force) * mag[..., None]
-                * od / odist[..., None],
-                0.0,
-            ),
-            axis=1,
-        )
 
-    # Clamp steering magnitude (keeps the integrator stable at any dt).
+def _obstacle_acc(pos, obstacles, p: BoidsParams) -> jax.Array:
+    """Obstacle repulsion (same force law as ops/physics.py)."""
+    if obstacles is None or obstacles.shape[0] == 0:
+        return jnp.zeros_like(pos)
+    centers, radius = obstacles[:, :-1], obstacles[:, -1]
+    od = _wrap(pos[:, None, :] - centers[None, :, :], p.half_width)
+    odist = jnp.maximum(jnp.linalg.norm(od, axis=-1), p.eps)
+    rho = radius[None, :] + p.r_sep
+    inside = odist < rho
+    mag = (1.0 / odist - 1.0 / rho) / (odist * odist)
+    return jnp.sum(
+        jnp.where(
+            inside[..., None],
+            (p.w_sep * p.max_force) * mag[..., None] * od
+            / odist[..., None],
+            0.0,
+        ),
+        axis=1,
+    )
+
+
+def _clamp_force(acc, p: BoidsParams) -> jax.Array:
+    """Clamp steering magnitude (keeps the integrator stable at any dt)."""
     amag = jnp.linalg.norm(acc, axis=-1, keepdims=True)
     amag_c = jnp.maximum(amag, p.eps)
     return acc / amag_c * jnp.minimum(amag_c, p.max_force)
+
+
+def boids_forces_window(
+    state: BoidsState,
+    params: BoidsParams,
+    obstacles: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Reynolds forces via the Morton sliding window — million-boid scale.
+
+    Same design as ops/neighbors.py:separation_window, extended to all
+    three rules: each boid accumulates separation pushes, neighbor
+    velocity sums (alignment), and relative-centroid sums (cohesion)
+    from its ±``params.window`` neighbors in sorted order, via
+    ``jnp.roll`` — no [N, N] matrices, no gathers.  Assumes the CALLER
+    keeps the flock approximately Morton-sorted (``boids_step_window``
+    re-sorts every ``params.sort_every`` steps; BoidsState carries no
+    per-boid identity, so the permutation is fully transparent).
+    Distance tests keep precision exact; recall is approximate — worst
+    at the toroidal seam, where Z-order locality breaks.  2-D only
+    (raises otherwise: a silent dense fallback would OOM at exactly the
+    flock sizes this mode exists for).
+    """
+    p = params
+    pos, vel = state.pos, state.vel
+    n, d = pos.shape
+    if d != 2:
+        raise ValueError(
+            f"window neighbor mode is 2-D only (got dim={d}); use "
+            "neighbor_mode='dense' for small 3-D flocks"
+        )
+
+    sep = jnp.zeros_like(pos)
+    vsum = jnp.zeros_like(pos)
+    dsum = jnp.zeros_like(pos)
+    cnt_a = jnp.zeros((n, 1), pos.dtype)
+    cnt_c = jnp.zeros((n, 1), pos.dtype)
+
+    for s, valid in _neighbors.window_shifts(n, p.window):
+        npos = jnp.roll(pos, s, axis=0)
+        nvel = jnp.roll(vel, s, axis=0)
+        diff = _wrap(pos - npos, p.half_width)     # minimum image (torus)
+        dist = jnp.linalg.norm(diff, axis=-1)
+        dist_c = jnp.maximum(dist, p.eps)
+
+        near = valid & (dist < p.r_sep)
+        sep = sep + jnp.where(
+            near[:, None], diff / (dist_c * dist_c)[:, None], 0.0
+        )
+        ma = (valid & (dist < p.r_align))[:, None]
+        vsum = vsum + jnp.where(ma, nvel, 0.0)
+        cnt_a = cnt_a + ma
+        mc = (valid & (dist < p.r_coh))[:, None]
+        dsum = dsum + jnp.where(mc, diff, 0.0)
+        cnt_c = cnt_c + mc
+
+    align = jnp.where(cnt_a > 0, vsum / jnp.maximum(cnt_a, 1) - vel, 0.0)
+    coh = jnp.where(cnt_c > 0, -dsum / jnp.maximum(cnt_c, 1), 0.0)
+    acc = p.w_sep * sep + p.w_align * align + p.w_coh * coh
+    acc = acc + _obstacle_acc(pos, obstacles, p)
+    return _clamp_force(acc, p)
 
 
 def boids_step(
@@ -176,24 +256,71 @@ def boids_step(
     )
 
 
-@partial(jax.jit, static_argnames=("params", "n_steps", "record"))
+def _morton_sort_boids(state: BoidsState, p: BoidsParams) -> BoidsState:
+    """Permute the flock into Morton order (identity-free, so free)."""
+    order = jnp.argsort(_neighbors.morton_keys(state.pos, p.sort_cell))
+    return state.replace(pos=state.pos[order], vel=state.vel[order])
+
+
+def boids_step_window(
+    state: BoidsState,
+    params: BoidsParams,
+    obstacles: Optional[jax.Array] = None,
+) -> BoidsState:
+    """One flocking tick in window mode: re-sort on cadence, roll-only
+    Reynolds forces, speed-clamped Euler, toroidal wrap."""
+    p = params
+    state = jax.lax.cond(
+        state.iteration % p.sort_every == 0,
+        lambda s: _morton_sort_boids(s, p),
+        lambda s: s,
+        state,
+    )
+    acc = boids_forces_window(state, params, obstacles)
+    vel = _clamp_speed(
+        state.vel + p.dt * acc, p.min_speed, p.max_speed, p.eps
+    )
+    pos = _wrap(state.pos + p.dt * vel, p.half_width)
+    return BoidsState(
+        pos=pos, vel=vel, key=state.key, iteration=state.iteration + 1
+    )
+
+
+@partial(
+    jax.jit, static_argnames=("params", "n_steps", "record", "neighbor_mode")
+)
 def boids_run(
     state: BoidsState,
     params: BoidsParams,
     n_steps: int,
     obstacles: Optional[jax.Array] = None,
     record: bool = False,
+    neighbor_mode: str = "dense",
 ) -> Tuple[BoidsState, Optional[jax.Array]]:
     """``n_steps`` ticks under one ``lax.scan``.
 
-    With ``record=True`` also returns the position trajectory
+    ``neighbor_mode="dense"`` is the exact all-pairs pass;
+    ``"window"`` is the Morton sliding-window pass for very large
+    flocks.  With ``record=True`` also returns the position trajectory
     ``[n_steps, N, D]`` (stacked by the scan — the framework's
     trajectory-capture hook; the reference could only log poses to
     stdout, agent.py:180-181).
     """
+    if neighbor_mode not in ("dense", "window"):
+        raise ValueError(
+            f"unknown neighbor_mode {neighbor_mode!r}; "
+            "expected 'dense' or 'window'"
+        )
+    if neighbor_mode == "window" and record:
+        raise ValueError(
+            "record=True is incompatible with neighbor_mode='window': the "
+            "in-scan Morton re-sorts permute boid array slots, so "
+            "traj[t, i] would not track one boid over time"
+        )
+    step = boids_step_window if neighbor_mode == "window" else boids_step
 
     def body(s, _):
-        s = boids_step(s, params, obstacles)
+        s = step(s, params, obstacles)
         return s, (s.pos if record else None)
 
     state, traj = jax.lax.scan(body, state, None, length=n_steps)

@@ -80,6 +80,23 @@ def morton_keys(pos: jax.Array, cell: float) -> jax.Array:
     return _part1by1(cx) | (_part1by1(cy) << 1)
 
 
+def window_shifts(n: int, window: int):
+    """Yield ``(s, valid)`` per sliding-window shift: ``s`` is the signed
+    roll amount and ``valid`` masks rows whose rolled partner is real
+    (not wrapped around the array end).  Shared traversal for every
+    Morton-window kernel (separation here, the Reynolds rules in
+    ops/boids.py) so the validity logic cannot drift between them —
+    distance/wrap semantics stay per-caller (the swarm world is an
+    infinite plane; the boids world is toroidal).
+    """
+    idx = jnp.arange(n)
+    for shift in range(1, window + 1):
+        for sgn in (1, -1):
+            s = sgn * shift
+            src = idx - s
+            yield s, (src >= 0) & (src < n)
+
+
 def separation_window(
     pos: jax.Array,
     alive: jax.Array,
@@ -123,28 +140,23 @@ def separation_window(
         spos = pos[order]
         salive = alive[order]
 
-    idx = jnp.arange(n)
     force_s = jnp.zeros_like(pos)
-    for shift in range(1, window + 1):
-        for sgn in (1, -1):
-            s = sgn * shift
-            npos = jnp.roll(spos, s, axis=0)
-            nalive = jnp.roll(salive, s)
-            src = idx - s
-            not_wrapped = (src >= 0) & (src < n)
-            diff = spos - npos
-            dist = jnp.linalg.norm(diff, axis=-1)
-            dist_c = jnp.maximum(dist, eps)
-            near = (
-                not_wrapped
-                & salive
-                & nalive
-                & (dist < personal_space)
-            )
-            mag = k_sep / (dist_c * dist_c)                # agent.py:155
-            force_s = force_s + jnp.where(
-                near[:, None], mag[:, None] * diff / dist_c[:, None], 0.0
-            )
+    for s, not_wrapped in window_shifts(n, window):
+        npos = jnp.roll(spos, s, axis=0)
+        nalive = jnp.roll(salive, s)
+        diff = spos - npos
+        dist = jnp.linalg.norm(diff, axis=-1)
+        dist_c = jnp.maximum(dist, eps)
+        near = (
+            not_wrapped
+            & salive
+            & nalive
+            & (dist < personal_space)
+        )
+        mag = k_sep / (dist_c * dist_c)                    # agent.py:155
+        force_s = force_s + jnp.where(
+            near[:, None], mag[:, None] * diff / dist_c[:, None], 0.0
+        )
     if presorted:
         return force_s
     return jnp.zeros_like(pos).at[order].set(force_s)

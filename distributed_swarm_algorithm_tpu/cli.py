@@ -128,13 +128,23 @@ def _cmd_swarm(args) -> int:
                       backend=args.backend)
     if args.target:
         sw.set_target([float(x) for x in args.target])
-    start = time.perf_counter()
-    sw.step(args.steps)
-    if args.backend == "jax":
-        # JAX dispatch is async — wait for the device before timing.
-        import jax
+    import contextlib
 
-        jax.block_until_ready(sw.state.pos)
+    if args.trace:
+        from .utils.profiling import trace as _trace
+
+        tracer = _trace(args.trace)
+    else:
+        tracer = contextlib.nullcontext()
+    start = time.perf_counter()
+    with tracer:
+        sw.step(args.steps)
+        if args.backend == "jax":
+            # JAX dispatch is async — sync INSIDE the traced block so the
+            # profiler captures the device work, and before timing.
+            import jax
+
+            jax.block_until_ready(sw.state.pos)
     elapsed = time.perf_counter() - start
     lid, exists = sw.leader()
     print(json.dumps({
@@ -280,20 +290,26 @@ def _cmd_boids(args) -> int:
     from .models.boids import Boids
 
     flock = Boids(n=args.n, dim=args.dim, seed=args.seed,
-                  half_width=args.half_width)
+                  half_width=args.half_width,
+                  neighbor_mode=args.neighbor_mode)
     p0 = flock.polarization
     start = time.perf_counter()
     flock.run(args.steps)
     elapsed = time.perf_counter() - start
-    print(json.dumps({
+    out = {
         "boids": args.n,
         "dim": args.dim,
         "ticks": args.steps,
         "polarization_start": round(p0, 3),
         "polarization_end": round(flock.polarization, 3),
-        "nearest_neighbor_dist": round(flock.nearest_neighbor_dist, 3),
+        "neighbor_mode": args.neighbor_mode,
         "ticks_per_sec": round(args.steps / elapsed, 1),
-    }))
+    }
+    if args.n <= 32768:
+        # The NN-distance metric is an O(N^2) diagnostic — skip it at the
+        # flock sizes window mode exists for (it would OOM post-run).
+        out["nearest_neighbor_dist"] = round(flock.nearest_neighbor_dist, 3)
+    print(json.dumps(out))
     return 0
 
 
@@ -409,6 +425,10 @@ def build_parser() -> argparse.ArgumentParser:
              "numpy = pure-NumPy oracle; auto = native if available",
     )
     p_swarm.add_argument(
+        "--trace", default=None, metavar="DIR",
+        help="capture a jax.profiler device trace into DIR "
+             "(open with TensorBoard/XProf)")
+    p_swarm.add_argument(
         "--separation", default="dense",
         choices=["dense", "pallas", "grid", "window", "off"],
         help="neighbor-separation kernel (jax backend): dense all-pairs, "
@@ -471,6 +491,11 @@ def build_parser() -> argparse.ArgumentParser:
     p_boids.add_argument("--steps", type=int, default=500)
     p_boids.add_argument("--seed", type=int, default=0)
     p_boids.add_argument("--half-width", type=float, default=50.0)
+    p_boids.add_argument("--neighbor-mode", default="dense",
+                         choices=["dense", "window"],
+                         help="dense = exact all-pairs; window = "
+                              "Morton sliding window (million-boid "
+                              "scale, 2-D only)")
     p_boids.set_defaults(fn=_cmd_boids)
 
     p_aco = sub.add_parser("aco", help="ant-colony TSP solver")

@@ -207,6 +207,8 @@ def boids_forces_window(
             f"window neighbor mode is 2-D only (got dim={d}); use "
             "neighbor_mode='dense' for small 3-D flocks"
         )
+    if p.window < 1:
+        raise ValueError(f"window must be >= 1, got {p.window}")
 
     sep = jnp.zeros_like(pos)
     vsum = jnp.zeros_like(pos)

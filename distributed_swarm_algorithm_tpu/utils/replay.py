@@ -1,0 +1,81 @@
+"""Determinism checking — the synchronous model's answer to race detection.
+
+The reference resolves its protocol races *algorithmically* (election
+jitter, id-ordering, claim hysteresis — SURVEY.md §5 "Race detection:
+absent") and offers no way to check that two runs agree.  Here the whole
+swarm step is a pure function of (state, config), so the strongest
+possible property is available: bit-identical replays.  This module
+fingerprints state pytrees and verifies that re-executing a rollout from
+the same initial state reproduces the same trajectory — the test that
+catches nondeterminism from unordered collectives, host callbacks,
+donated-buffer aliasing, or accidental wall-clock/IO dependence.
+
+    fp = fingerprint(state)                       # one state
+    trace = record_trace(step_fn, state, 100)     # every k-th tick
+    verify_replay(step_fn, state, trace)          # raises on divergence
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Callable, List, Tuple
+
+import jax
+import numpy as np
+
+
+def fingerprint(tree) -> str:
+    """Order-stable SHA-256 over every leaf's bytes (exact, not approx)."""
+    h = hashlib.sha256()
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    h.update(repr(treedef).encode())
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        h.update(arr.dtype.str.encode())
+        h.update(np.int64(arr.shape).tobytes())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def record_trace(
+    step_fn: Callable,
+    state,
+    n_steps: int,
+    every: int = 1,
+) -> List[Tuple[int, str]]:
+    """Run ``n_steps`` of ``step_fn(state) -> state``, fingerprinting the
+    state after every ``every``-th step.  Returns [(step, hash), ...]
+    (device->host sync per fingerprint — a debugging tool, not a hot
+    path)."""
+    if every < 1:
+        raise ValueError(f"every must be >= 1, got {every}")
+    trace = []
+    for i in range(1, n_steps + 1):
+        state = step_fn(state)
+        if i % every == 0:
+            trace.append((i, fingerprint(state)))
+    return trace
+
+
+class ReplayDivergence(AssertionError):
+    """Replay produced a different state than the recorded trace."""
+
+
+def verify_replay(
+    step_fn: Callable,
+    state,
+    trace: List[Tuple[int, str]],
+) -> None:
+    """Re-execute from ``state`` and compare against ``trace``; raises
+    :class:`ReplayDivergence` at the first mismatching checkpoint."""
+    if not trace:
+        return
+    want = dict(trace)
+    last = max(want)
+    for i in range(1, last + 1):
+        state = step_fn(state)
+        if i in want and (got := fingerprint(state)) != want[i]:
+            raise ReplayDivergence(
+                f"replay diverged at step {i}: recorded "
+                f"{want[i][:12]}…, got {got[:12]}…"
+            )

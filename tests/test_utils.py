@@ -129,3 +129,45 @@ def test_cli_reference_compat_flags(capsys):
     rc = cli_main(["--id", "1", "--count", "2", "--caps", "lift",
                    "--steps", "2"])
     assert rc == 0
+
+
+# ------------------------------------------------------------ replay/determinism
+
+def test_swarm_rollout_is_bit_deterministic():
+    from distributed_swarm_algorithm_tpu.utils.replay import (
+        fingerprint,
+        record_trace,
+        verify_replay,
+    )
+
+    cfg = dsa.SwarmConfig()
+    s = dsa.make_swarm(32, seed=0, spread=10.0)
+    s = s.replace(
+        target=jnp.broadcast_to(jnp.asarray([5.0, 0.0]), s.pos.shape),
+        has_target=jnp.ones_like(s.has_target),
+    )
+    step = lambda st: dsa.swarm_tick(st, None, cfg)  # noqa: E731
+    trace = record_trace(step, s, 30, every=10)
+    assert len(trace) == 3
+    verify_replay(step, s, trace)                    # must not raise
+    # identical states fingerprint identically; a flipped bit does not
+    assert fingerprint(s) == fingerprint(s)
+    assert fingerprint(s) != fingerprint(s.replace(tick=s.tick + 1))
+
+
+def test_verify_replay_detects_divergence():
+    import pytest
+
+    from distributed_swarm_algorithm_tpu.utils.replay import (
+        ReplayDivergence,
+        record_trace,
+        verify_replay,
+    )
+
+    cfg = dsa.SwarmConfig()
+    s = dsa.make_swarm(16, seed=1, spread=5.0)
+    step = lambda st: dsa.swarm_tick(st, None, cfg)  # noqa: E731
+    trace = record_trace(step, s, 10, every=5)
+    tampered = s.replace(pos=s.pos.at[0, 0].add(1e-3))
+    with pytest.raises(ReplayDivergence):
+        verify_replay(step, tampered, trace)

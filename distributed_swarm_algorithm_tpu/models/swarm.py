@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import time
 from functools import partial
-from typing import Optional
+from typing import Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -57,15 +57,23 @@ def swarm_tick(
     return state
 
 
-@partial(jax.jit, static_argnames=("cfg", "n_steps"))
+@partial(jax.jit, static_argnames=("cfg", "n_steps", "record"))
 def swarm_rollout(
     state: SwarmState,
     obstacles: Optional[jax.Array],
     cfg: SwarmConfig,
     n_steps: int,
-) -> SwarmState:
+    record: bool = False,
+) -> Union[SwarmState, Tuple[SwarmState, jax.Array]]:
     """``n_steps`` ticks under one ``lax.scan`` — the as-fast-as-possible
-    mode; XLA fuses each tick into a handful of kernels."""
+    mode; XLA fuses each tick into a handful of kernels.
+
+    ``record=True`` additionally returns the ``[n_steps, N, D]`` position
+    trajectory IN AGENT-ID ORDER (the whole-history upgrade of the
+    reference's per-tick pose log, agent.py:180-181).  Recording under
+    the Morton re-sort is safe: each frame is unscrambled by scattering
+    rows to their ``agent_id`` slots before stacking.
+    """
     if cfg.separation_mode == "window" and cfg.sort_every > 1:
         # Re-sort unconditionally on rollout entry: the in-tick cadence
         # (tick % sort_every == 1) assumes ticks are aligned to it, which
@@ -75,11 +83,23 @@ def swarm_rollout(
             state, jnp.argsort(_morton_keys(state.pos, cfg.grid_cell))
         )
 
-    def body(s, _):
-        return swarm_tick(s, obstacles, cfg), None
+    permuting = cfg.separation_mode == "window" and cfg.sort_every > 1
 
-    state, _ = jax.lax.scan(body, state, None, length=n_steps)
-    return state
+    def body(s, _):
+        s = swarm_tick(s, obstacles, cfg)
+        frame = None
+        if record:
+            # Unscramble to id order only when slots can actually move;
+            # otherwise agent_id == arange and the scatter is waste.
+            frame = (
+                jnp.zeros_like(s.pos).at[s.agent_id].set(s.pos)
+                if permuting
+                else s.pos
+            )
+        return s, frame
+
+    state, traj = jax.lax.scan(body, state, None, length=n_steps)
+    return (state, traj) if record else state
 
 
 class VectorSwarm(CheckpointMixin):
@@ -150,7 +170,15 @@ class VectorSwarm(CheckpointMixin):
         self.state = self.state.replace(caps=jnp.asarray(caps, bool))
 
     # --- stepping --------------------------------------------------------
-    def step(self, n: int = 1) -> SwarmState:
+    def step(self, n: int = 1, record: bool = False):
+        """Advance ``n`` ticks.  Returns the new state — or, with
+        ``record=True`` (any n, including 1), the ``[n, N, D]`` position
+        trajectory in agent-id order instead (state is on ``.state``)."""
+        if record:
+            self.state, traj = swarm_rollout(
+                self.state, self.obstacles, self.config, n, record=True
+            )
+            return traj
         if n == 1:
             self.state = swarm_tick(self.state, self.obstacles, self.config)
         else:

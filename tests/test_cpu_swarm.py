@@ -101,3 +101,4 @@ def test_matches_jax_vector_swarm_on_deterministic_run():
 def test_backend_flag_validation():
     with pytest.raises(ValueError):
         CpuSwarm(4, backend="bogus")
+

@@ -18,6 +18,7 @@ reference could not actually run:
   firefly firefly algorithm on a benchmark objective
   cuckoo  cuckoo search on a benchmark objective
   woa     whale optimization on a benchmark objective
+  bat     bat algorithm on a benchmark objective
   bench   the headline benchmark (same as bench.py)
 
 ``python -m distributed_swarm_algorithm_tpu --id 1 --count 3 --caps lift``
@@ -381,6 +382,13 @@ def _cmd_woa(args) -> int:
     return _run_report(opt, args, "whales")
 
 
+def _cmd_bat(args) -> int:
+    from .models.bat import Bat
+
+    opt = Bat(args.objective, n=args.n, dim=args.dim, seed=args.seed)
+    return _run_report(opt, args, "bats")
+
+
 def _cmd_bench(args) -> int:
     # bench.py lives at the repo root (a driver contract), outside the
     # package — resolve it relative to this file so the subcommand works
@@ -567,6 +575,14 @@ def build_parser() -> argparse.ArgumentParser:
                        help="exploration schedule length (default --steps)")
     p_woa.add_argument("--seed", type=int, default=0)
     p_woa.set_defaults(fn=_cmd_woa)
+
+    p_bat = sub.add_parser("bat", help="bat algorithm")
+    p_bat.add_argument("--objective", default="rastrigin")
+    p_bat.add_argument("--n", type=int, default=128)
+    p_bat.add_argument("--dim", type=int, default=30)
+    p_bat.add_argument("--steps", type=int, default=500)
+    p_bat.add_argument("--seed", type=int, default=0)
+    p_bat.set_defaults(fn=_cmd_bat)
 
     p_bench = sub.add_parser("bench", help="headline benchmark")
     p_bench.set_defaults(fn=_cmd_bench)

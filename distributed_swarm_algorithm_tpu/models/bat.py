@@ -1,0 +1,76 @@
+"""User-facing bat-algorithm model."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+
+from ..ops import bat as _k
+from ..ops.objectives import get_objective
+from ._checkpoint import CheckpointMixin
+
+
+class Bat(CheckpointMixin):
+    """Bat algorithm (echolocation search, Yang 2010).
+
+    Per-bat loudness/pulse adaptation schedules each individual's own
+    exploration→exploitation transition.
+
+    >>> opt = Bat("sphere", n=64, dim=6, seed=0)
+    >>> opt.run(300)
+    >>> opt.best  # doctest: +SKIP
+    """
+
+    def __init__(
+        self,
+        objective: Union[str, Callable],
+        n: int,
+        dim: int,
+        half_width: Optional[float] = None,
+        f_min: float = _k.F_MIN,
+        f_max: float = _k.F_MAX,
+        alpha: float = _k.ALPHA,
+        gamma: float = _k.GAMMA,
+        r0: float = _k.R0,
+        sigma_local: float = _k.SIGMA_LOCAL,
+        seed: int = 0,
+        dtype=None,
+    ):
+        if isinstance(objective, str):
+            fn, default_hw = get_objective(objective)
+        else:
+            fn, default_hw = objective, 5.12
+        self.objective = fn
+        self.half_width = float(
+            half_width if half_width is not None else default_hw
+        )
+        if f_max < f_min:
+            raise ValueError(f"f_max ({f_max}) must be >= f_min ({f_min})")
+        self.f_min, self.f_max = float(f_min), float(f_max)
+        self.alpha, self.gamma = float(alpha), float(gamma)
+        self.r0, self.sigma_local = float(r0), float(sigma_local)
+        kwargs = {} if dtype is None else {"dtype": dtype}
+        self.state = _k.bat_init(
+            fn, n, dim, self.half_width, seed=seed, **kwargs
+        )
+
+    def step(self) -> _k.BatState:
+        self.state = _k.bat_step(
+            self.state, self.objective, self.half_width, self.f_min,
+            self.f_max, self.alpha, self.gamma, self.r0, self.sigma_local,
+        )
+        return self.state
+
+    def run(self, n_steps: int) -> _k.BatState:
+        self.state = _k.bat_run(
+            self.state, self.objective, n_steps, self.half_width,
+            self.f_min, self.f_max, self.alpha, self.gamma, self.r0,
+            self.sigma_local,
+        )
+        jax.block_until_ready(self.state.best_fit)
+        return self.state
+
+    @property
+    def best(self) -> float:
+        return float(self.state.best_fit)

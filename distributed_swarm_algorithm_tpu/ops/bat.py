@@ -1,0 +1,182 @@
+"""Bat-algorithm kernels (Yang 2010), TPU-vectorized.
+
+Part of the swarm-intelligence toolkit (the reference has no optimizer —
+its only "fitness" is the task utility at
+/root/reference/agent.py:338-347).  BA contributes echolocation-style
+adaptive search: every bat carries its own loudness ``A`` (acceptance
+willingness, decays on success) and pulse rate ``r`` (grows on success;
+the local walk fires when a draw EXCEEDS it, so successful bats walk
+less and fly their frequency paths more), so the population
+self-schedules its own exploration→exploitation transition per
+individual.
+
+TPU shape: frequencies/pulse draws are batched; the local-search branch
+and the greedy accept are masked ``where``s — no per-bat control flow,
+so the generation fuses under jit and scales like every family here.
+
+Per bat i per generation (f in [f_min, f_max], beta, eps, u batched):
+    f_i = f_min + (f_max - f_min) * beta
+    v_i = v_i + (x_i - x*) * f_i;  cand = x_i + v_i
+    if u1 > r_i:  cand = x* + sigma_local * mean(A) * eps      (local walk)
+    accept iff f(cand) <= f(x_i) and u2 < A_i                  (greedy+loud)
+    on accept: A_i *= alpha;  r_i = r0 * (1 - exp(-gamma * t))
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+# Yang's canonical defaults.
+F_MIN = 0.0
+F_MAX = 2.0
+ALPHA = 0.9         # loudness decay on success
+GAMMA = 0.9         # pulse-rate growth constant
+A0 = 1.0            # initial loudness
+R0 = 0.5            # asymptotic pulse rate
+SIGMA_LOCAL = 0.1   # local-walk scale (fraction of domain half-width)
+
+
+@struct.dataclass
+class BatState:
+    """Struct-of-arrays bat colony. N bats, D dims."""
+
+    pos: jax.Array        # [N, D]
+    vel: jax.Array        # [N, D]
+    fit: jax.Array        # [N]
+    loudness: jax.Array   # [N]
+    pulse: jax.Array      # [N]
+    best_pos: jax.Array   # [D]
+    best_fit: jax.Array   # scalar
+    key: jax.Array
+    iteration: jax.Array  # i32 scalar
+
+
+def bat_init(
+    objective: Callable,
+    n: int,
+    dim: int,
+    half_width: float,
+    seed: int = 0,
+    dtype=jnp.float32,
+) -> BatState:
+    key = jax.random.PRNGKey(seed)
+    key, kp = jax.random.split(key)
+    pos = jax.random.uniform(
+        kp, (n, dim), dtype, minval=-half_width, maxval=half_width
+    )
+    fit = objective(pos)
+    b = jnp.argmin(fit)
+    return BatState(
+        pos=pos,
+        vel=jnp.zeros((n, dim), dtype),
+        fit=fit,
+        loudness=jnp.full((n,), A0, dtype),
+        pulse=jnp.zeros((n,), dtype),      # r grows toward R0 with t
+        best_pos=pos[b],
+        best_fit=fit[b],
+        key=key,
+        iteration=jnp.asarray(0, jnp.int32),
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective", "half_width", "f_min", "f_max", "alpha", "gamma",
+        "r0", "sigma_local",
+    ),
+)
+def bat_step(
+    state: BatState,
+    objective: Callable,
+    half_width: float = 5.12,
+    f_min: float = F_MIN,
+    f_max: float = F_MAX,
+    alpha: float = ALPHA,
+    gamma: float = GAMMA,
+    r0: float = R0,
+    sigma_local: float = SIGMA_LOCAL,
+) -> BatState:
+    """One generation: frequency flight, pulse-gated local walk, loud
+    greedy acceptance, per-bat loudness/pulse adaptation."""
+    n, d = state.pos.shape
+    dt = state.pos.dtype
+    key, kb, k1, ke, k2 = jax.random.split(state.key, 5)
+
+    beta = jax.random.uniform(kb, (n, 1), dt)
+    freq = f_min + (f_max - f_min) * beta
+    vel = state.vel + (state.pos - state.best_pos) * freq
+    cand = state.pos + vel
+
+    # Pulse-gated local walk around the incumbent best (Yang:
+    # ``if rand > r_i``): LOW-pulse bats — those without recent success —
+    # probe near the best; once a bat succeeds its pulse rises and it
+    # flies its frequency path instead.
+    walk = jax.random.uniform(k1, (n,), dt) > state.pulse
+    eps = jax.random.uniform(ke, (n, d), dt, minval=-1.0, maxval=1.0)
+    mean_a = jnp.mean(state.loudness)
+    local = state.best_pos + sigma_local * half_width * mean_a * eps
+    cand = jnp.where(walk[:, None], local, cand)
+    cand = jnp.clip(cand, -half_width, half_width)
+
+    cand_fit = objective(cand)
+    accept = (cand_fit <= state.fit) & (
+        jax.random.uniform(k2, (n,), dt) < state.loudness
+    )
+
+    pos = jnp.where(accept[:, None], cand, state.pos)
+    fit = jnp.where(accept, cand_fit, state.fit)
+    vel = jnp.where(accept[:, None], vel, state.vel)
+    t = (state.iteration + 1).astype(dt)
+    loudness = jnp.where(accept, state.loudness * alpha, state.loudness)
+    pulse = jnp.where(
+        accept, r0 * (1.0 - jnp.exp(-gamma * t)), state.pulse
+    )
+
+    b = jnp.argmin(fit)
+    improved = fit[b] < state.best_fit
+    return BatState(
+        pos=pos,
+        vel=vel,
+        fit=fit,
+        loudness=loudness,
+        pulse=pulse,
+        best_pos=jnp.where(improved, pos[b], state.best_pos),
+        best_fit=jnp.where(improved, fit[b], state.best_fit),
+        key=key,
+        iteration=state.iteration + 1,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective", "n_steps", "half_width", "f_min", "f_max", "alpha",
+        "gamma", "r0", "sigma_local",
+    ),
+)
+def bat_run(
+    state: BatState,
+    objective: Callable,
+    n_steps: int,
+    half_width: float = 5.12,
+    f_min: float = F_MIN,
+    f_max: float = F_MAX,
+    alpha: float = ALPHA,
+    gamma: float = GAMMA,
+    r0: float = R0,
+    sigma_local: float = SIGMA_LOCAL,
+) -> BatState:
+    def body(s, _):
+        return bat_step(
+            s, objective, half_width, f_min, f_max, alpha, gamma, r0,
+            sigma_local,
+        ), None
+
+    state, _ = jax.lax.scan(body, state, None, length=n_steps)
+    return state

@@ -13,6 +13,7 @@ import time
 
 def main():
     from distributed_swarm_algorithm_tpu.models.abc_bees import ABC
+    from distributed_swarm_algorithm_tpu.models.bat import Bat
     from distributed_swarm_algorithm_tpu.models.cmaes import CMAES
     from distributed_swarm_algorithm_tpu.models.cuckoo import Cuckoo
     from distributed_swarm_algorithm_tpu.models.de import DE
@@ -35,6 +36,7 @@ def main():
         ("GWO", lambda: GWO(problem, n=n, dim=dim, t_max=steps, seed=0)),
         ("WOA", lambda: WOA(problem, n=n, dim=dim, t_max=steps, seed=0)),
         ("Cuckoo", lambda: Cuckoo(problem, n=n, dim=dim, seed=0)),
+        ("Bat", lambda: Bat(problem, n=n, dim=dim, seed=0)),
         ("Firefly", lambda: Firefly(problem, n=n, dim=dim, seed=0)),
     ]
 

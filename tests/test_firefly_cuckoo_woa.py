@@ -1,5 +1,5 @@
-"""Firefly (ops/firefly.py), cuckoo search (ops/cuckoo.py), and whale
-optimization (ops/woa.py)."""
+"""Firefly (ops/firefly.py), cuckoo search (ops/cuckoo.py), whale
+optimization (ops/woa.py), and bat algorithm (ops/bat.py)."""
 
 import jax
 import jax.numpy as jnp
@@ -195,3 +195,64 @@ def test_new_families_checkpoint_roundtrip(tmp_path):
         np.testing.assert_allclose(
             np.asarray(fresh.state.pos), np.asarray(opt.state.pos)
         )
+
+
+# --------------------------------------------------------------------- bat
+
+def test_bat_converges_on_sphere():
+    from distributed_swarm_algorithm_tpu.models.bat import Bat
+
+    opt = Bat("sphere", n=64, dim=4, seed=0)
+    opt.run(300)
+    assert opt.best < 1e-2
+
+
+def test_bat_best_is_monotone_and_adapts():
+    from distributed_swarm_algorithm_tpu.ops.bat import bat_init, bat_step
+    from distributed_swarm_algorithm_tpu.ops.objectives import sphere
+
+    st = bat_init(sphere, 32, 5, 5.12, seed=1)
+    prev = float(st.best_fit)
+    for _ in range(30):
+        st = bat_step(st, sphere, 5.12)
+        cur = float(st.best_fit)
+        assert cur <= prev + 1e-7
+        prev = cur
+    # successful bats quieted down and pulse rates grew
+    assert float(jnp.min(st.loudness)) < 1.0
+    assert float(jnp.max(st.pulse)) > 0.0
+
+
+def test_bat_positions_stay_in_domain():
+    from distributed_swarm_algorithm_tpu.ops.bat import bat_init, bat_run
+    from distributed_swarm_algorithm_tpu.ops.objectives import sphere
+
+    st = bat_run(bat_init(sphere, 48, 3, 2.0, seed=2), sphere, 50,
+                 half_width=2.0)
+    assert float(jnp.max(jnp.abs(st.pos))) <= 2.0 + 1e-6
+    assert np.allclose(np.asarray(sphere(st.pos)), np.asarray(st.fit),
+                       atol=1e-5)
+
+
+def test_bat_seeded_deterministic_and_checkpoints(tmp_path):
+    from distributed_swarm_algorithm_tpu.models.bat import Bat
+
+    a = Bat("rastrigin", n=32, dim=4, seed=7)
+    b = Bat("rastrigin", n=32, dim=4, seed=7)
+    a.run(30)
+    b.run(30)
+    assert a.best == b.best
+    p = str(tmp_path / "bat.npz")
+    a.save(p)
+    fresh = Bat("rastrigin", n=32, dim=4, seed=99)
+    fresh.load(p)
+    assert fresh.best == a.best
+
+
+def test_bat_rejects_bad_frequency_band():
+    import pytest
+
+    from distributed_swarm_algorithm_tpu.models.bat import Bat
+
+    with pytest.raises(ValueError):
+        Bat("sphere", n=16, dim=2, f_min=2.0, f_max=1.0)

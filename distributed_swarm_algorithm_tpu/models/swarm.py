@@ -17,7 +17,11 @@ from typing import Optional, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from ..ops.allocation import allocation_step, task_status_view
+from ..ops.allocation import (
+    allocation_step,
+    auction_allocation_step,
+    task_status_view,
+)
 from ..ops.coordination import coordination_step, current_leader, kill, revive
 from ..ops.neighbors import morton_keys as _morton_keys
 from ..ops.physics import physics_step
@@ -52,7 +56,10 @@ def swarm_tick(
             state,
         )
     state = coordination_step(state, cfg)          # agent.py:83-89
-    state = allocation_step(state, cfg)            # agent.py:91-92
+    if cfg.allocation_mode == "auction":
+        state = auction_allocation_step(state, cfg)
+    else:
+        state = allocation_step(state, cfg)        # agent.py:91-92
     state = physics_step(state, obstacles, cfg)    # agent.py:94-181
     return state
 

@@ -85,24 +85,30 @@ def arbitrate(
     return winner, util
 
 
-def allocation_step(state: SwarmState, cfg: SwarmConfig) -> SwarmState:
-    """One allocation tick: dead-winner eviction, greedy claims, leader
-    arbitration, award."""
-    if state.n_tasks == 0:
-        return state
+def dead_winner_tasks(state: SwarmState) -> jax.Array:
+    """[T] bool — tasks whose awarded winner is no longer alive.
 
-    # Failure recovery: a task whose awarded winner has died reopens (and
-    # everyone's claimed/LOCKED view of it resets) so the swarm re-bids.
-    # The reference never garbage-collects claims — a dead winner keeps
-    # its tasks forever (SURVEY.md §5a bug 6); elastic recovery here is
-    # deliberate, in both lock-on-award and live-reallocation modes.
+    Failure recovery: such tasks reopen so the swarm re-bids.  The
+    reference never garbage-collects claims — a dead winner keeps its
+    tasks forever (SURVEY.md §5a bug 6); elastic recovery here is
+    deliberate, shared by the greedy and auction allocation modes.
+    """
     awarded = state.task_winner != NO_WINNER                     # [T]
     winner_alive = jnp.any(
         (state.agent_id[:, None] == state.task_winner[None, :])
         & state.alive[:, None],
         axis=0,
     )                                                            # [T]
-    evict = awarded & ~winner_alive
+    return awarded & ~winner_alive
+
+
+def allocation_step(state: SwarmState, cfg: SwarmConfig) -> SwarmState:
+    """One allocation tick: dead-winner eviction, greedy claims, leader
+    arbitration, award."""
+    if state.n_tasks == 0:
+        return state
+
+    evict = dead_winner_tasks(state)
     state = state.replace(
         task_winner=jnp.where(evict, NO_WINNER, state.task_winner),
         task_util=jnp.where(evict, 0.0, state.task_util),
@@ -146,6 +152,67 @@ def allocation_step(state: SwarmState, cfg: SwarmConfig) -> SwarmState:
     return state.replace(
         task_winner=winner, task_util=util, task_claimed=task_claimed
     )
+
+
+def auction_allocation_step(state: SwarmState, cfg: SwarmConfig) -> SwarmState:
+    """Allocation tick in ``allocation_mode="auction"``: the leader solves
+    an eps-optimal one-task-per-agent assignment (Bertsekas auction,
+    ops/auction.py) instead of greedy argmax arbitration.
+
+    Beyond-parity semantics, deliberately different from the reference:
+      - one task per agent (the greedy path lets one agent hoard many);
+      - globally (eps-)optimal total utility, not first-come-first-served;
+      - the whole assignment refreshes every ``cfg.auction_every`` ticks
+        and immediately when an awarded winner dies — live reallocation
+        with no hysteresis needed (the auction is deterministic, so there
+        is no claim race to damp).
+    Feasibility keeps the reference's rules: alive agents only, utility
+    must clear ``utility_threshold`` (agent.py:297), and nothing happens
+    while the swarm is leaderless (same stance as the greedy path).
+    """
+    from .auction import auction_assign_scaled
+
+    if state.n_tasks == 0:
+        return state
+
+    t = state.n_tasks
+    # Dead winners are evicted immediately (leader or not), exactly like
+    # the greedy path; the freed tasks stay OPEN until the next re-solve.
+    evict = dead_winner_tasks(state)
+    state = state.replace(
+        task_winner=jnp.where(evict, NO_WINNER, state.task_winner),
+        task_util=jnp.where(evict, 0.0, state.task_util),
+        task_claimed=state.task_claimed & ~evict[None, :],
+    )
+    # The re-solve is gated on a leader existing to arbitrate (same
+    # stance as the greedy path): while leaderless, surviving incumbents
+    # keep their tasks — a re-solve here would see an all-infeasible
+    # matrix and strip alive, healthy winners.
+    leader_exists = jnp.any(state.alive & (state.fsm == LEADER))
+    resolve = leader_exists & (
+        (state.tick % cfg.auction_every == 0) | jnp.any(evict)
+    )
+
+    def solve(st):
+        # Utility/feasibility are only needed on re-solve ticks; traced
+        # inside the cond branch so the O(N*T*D) work is skipped on the
+        # other auction_every - 1 ticks.
+        u = utility_matrix(st, cfg)
+        feasible = st.alive[:, None] & (u > cfg.utility_threshold)
+        res = auction_assign_scaled(u, feasible, eps=cfg.auction_eps)
+        got = res.task_agent >= 0                                  # [T]
+        row = jnp.maximum(res.task_agent, 0)
+        winner = jnp.where(got, st.agent_id[row], NO_WINNER)
+        util = jnp.where(got, u[row, jnp.arange(t)], 0.0)
+        # The award broadcast resolves every task for every agent
+        # (agent.py:327-336); unassigned tasks read as OPEN again.
+        return st.replace(
+            task_winner=winner,
+            task_util=util,
+            task_claimed=jnp.broadcast_to(got[None, :], st.task_claimed.shape),
+        )
+
+    return jax.lax.cond(resolve, solve, lambda st: st, state)
 
 
 def task_status_view(state: SwarmState) -> jax.Array:

@@ -76,6 +76,16 @@ class SwarmConfig:
     #   hysteresis only arbitrates same-tick claim races.  False: losers may
     #   keep challenging as the swarm moves, and an incumbent is replaced
     #   only when beaten by claim_hysteresis — live reallocation.
+    allocation_mode: str = "greedy"
+    #   "greedy": reference semantics — threshold claims + leader argmax
+    #     arbitration with hysteresis (agent.py:291-347).
+    #   "auction": eps-optimal one-task-per-agent assignment via the
+    #     Bertsekas auction (ops/auction.py) — a beyond-parity upgrade;
+    #     solves on the auction_every cadence and whenever an awarded
+    #     winner dies.
+    auction_every: int = 10             # auction re-solve cadence, ticks
+    auction_eps: float = 0.25           # bid increment (optimality gap
+    #   <= max(N, T) * auction_eps in total utility)
 
     # --- scale / numerics -------------------------------------------------
     separation_mode: str = "dense"

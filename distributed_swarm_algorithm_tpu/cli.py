@@ -19,6 +19,9 @@ reference could not actually run:
   cuckoo  cuckoo search on a benchmark objective
   woa     whale optimization on a benchmark objective
   bat     bat algorithm on a benchmark objective
+  salp    salp swarm algorithm on a benchmark objective
+  mfo     moth-flame optimization on a benchmark objective
+  hho     Harris hawks optimization on a benchmark objective
   bench   the headline benchmark (same as bench.py)
 
 ``python -m distributed_swarm_algorithm_tpu --id 1 --count 3 --caps lift``
@@ -389,6 +392,32 @@ def _cmd_bat(args) -> int:
     return _run_report(opt, args, "bats")
 
 
+def _make_scheduled_family_cmd(module: str, cls: str, noun: str):
+    """Handler factory for families whose only extra knob is the
+    schedule horizon t_max (defaulting to --steps)."""
+
+    def cmd(args) -> int:
+        import importlib
+
+        model = getattr(
+            importlib.import_module(f".models.{module}", __package__), cls
+        )
+        opt = model(args.objective, n=args.n, dim=args.dim,
+                    t_max=args.t_max if args.t_max else args.steps,
+                    seed=args.seed)
+        return _run_report(opt, args, noun)
+
+    return cmd
+
+
+_SCHEDULED_FAMILIES = (
+    # (subcommand, module, class, report noun, help text)
+    ("salp", "salp", "Salp", "salps", "salp swarm algorithm"),
+    ("mfo", "mfo", "MFO", "moths", "moth-flame optimization"),
+    ("hho", "hho", "HarrisHawks", "hawks", "Harris hawks optimization"),
+)
+
+
 def _cmd_bench(args) -> int:
     # bench.py lives at the repo root (a driver contract), outside the
     # package — resolve it relative to this file so the subcommand works
@@ -583,6 +612,17 @@ def build_parser() -> argparse.ArgumentParser:
     p_bat.add_argument("--steps", type=int, default=500)
     p_bat.add_argument("--seed", type=int, default=0)
     p_bat.set_defaults(fn=_cmd_bat)
+
+    for name, module, cls, noun, helptext in _SCHEDULED_FAMILIES:
+        p_fam = sub.add_parser(name, help=helptext)
+        p_fam.add_argument("--objective", default="rastrigin")
+        p_fam.add_argument("--n", type=int, default=128)
+        p_fam.add_argument("--dim", type=int, default=30)
+        p_fam.add_argument("--steps", type=int, default=500)
+        p_fam.add_argument("--t-max", type=int, default=0,
+                           help="schedule horizon (default --steps)")
+        p_fam.add_argument("--seed", type=int, default=0)
+        p_fam.set_defaults(fn=_make_scheduled_family_cmd(module, cls, noun))
 
     p_bench = sub.add_parser("bench", help="headline benchmark")
     p_bench.set_defaults(fn=_cmd_bench)

@@ -54,6 +54,14 @@ class CpuSwarm:
         backend: str = "auto",
     ):
         self.config = config or DEFAULT_CONFIG
+        if self.config.allocation_mode != "greedy":
+            # The CPU path is the semantics oracle for the greedy
+            # arbiter only; silently running greedy under an auction
+            # config would make cross-checks diverge without warning.
+            raise NotImplementedError(
+                "CpuSwarm supports allocation_mode='greedy' only; the "
+                "auction mode is a vectorized-path feature (ops/auction.py)"
+            )
         self.n = n_agents
         rng = np.random.default_rng(seed)
         self.rng = rng

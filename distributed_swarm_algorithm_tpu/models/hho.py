@@ -1,0 +1,71 @@
+"""User-facing Harris-hawks model."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+
+from ..ops import hho as _k
+from ..ops.objectives import get_objective
+from ._checkpoint import CheckpointMixin
+
+
+class HarrisHawks(CheckpointMixin):
+    """Harris hawks optimization (cooperative pursuit, Heidari 2019).
+
+    The prey's decaying escape energy gates each hawk between
+    exploration perches and four besiege strategies (soft/hard, with or
+    without Lévy rapid dives).
+
+    >>> opt = HarrisHawks("sphere", n=64, dim=6, seed=0)
+    >>> opt.run(300)
+    >>> opt.best  # doctest: +SKIP
+    """
+
+    def __init__(
+        self,
+        objective: Union[str, Callable],
+        n: int,
+        dim: int,
+        half_width: Optional[float] = None,
+        t_max: int = _k.T_MAX,
+        levy_beta: float = _k.LEVY_BETA,
+        seed: int = 0,
+        dtype=None,
+    ):
+        if isinstance(objective, str):
+            fn, default_hw = get_objective(objective)
+        else:
+            fn, default_hw = objective, 5.12
+        self.objective = fn
+        self.half_width = float(
+            half_width if half_width is not None else default_hw
+        )
+        if t_max <= 0:
+            raise ValueError(f"t_max ({t_max}) must be positive")
+        self.t_max = int(t_max)
+        self.levy_beta = float(levy_beta)
+        kwargs = {} if dtype is None else {"dtype": dtype}
+        self.state = _k.hho_init(
+            fn, n, dim, self.half_width, seed=seed, **kwargs
+        )
+
+    def step(self) -> _k.HHOState:
+        self.state = _k.hho_step(
+            self.state, self.objective, self.half_width, self.t_max,
+            self.levy_beta,
+        )
+        return self.state
+
+    def run(self, n_steps: int) -> _k.HHOState:
+        self.state = _k.hho_run(
+            self.state, self.objective, n_steps, self.half_width,
+            self.t_max, self.levy_beta,
+        )
+        jax.block_until_ready(self.state.best_fit)
+        return self.state
+
+    @property
+    def best(self) -> float:
+        return float(self.state.best_fit)

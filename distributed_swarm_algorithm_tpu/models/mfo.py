@@ -1,0 +1,69 @@
+"""User-facing moth-flame model."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+
+from ..ops import mfo as _k
+from ..ops.objectives import get_objective
+from ._checkpoint import CheckpointMixin
+
+
+class MFO(CheckpointMixin):
+    """Moth-flame optimization (elitist spiral search, Mirjalili 2015).
+
+    Flames are the best N positions ever seen; each moth spirals around
+    its own flame, and the flame count anneals N -> 1 over ``t_max``.
+
+    >>> opt = MFO("sphere", n=64, dim=6, seed=0)
+    >>> opt.run(300)
+    >>> opt.best  # doctest: +SKIP
+    """
+
+    def __init__(
+        self,
+        objective: Union[str, Callable],
+        n: int,
+        dim: int,
+        half_width: Optional[float] = None,
+        t_max: int = _k.T_MAX,
+        b: float = _k.SPIRAL_B,
+        seed: int = 0,
+        dtype=None,
+    ):
+        if isinstance(objective, str):
+            fn, default_hw = get_objective(objective)
+        else:
+            fn, default_hw = objective, 5.12
+        self.objective = fn
+        self.half_width = float(
+            half_width if half_width is not None else default_hw
+        )
+        if t_max <= 0:
+            raise ValueError(f"t_max ({t_max}) must be positive")
+        self.t_max = int(t_max)
+        self.b = float(b)
+        kwargs = {} if dtype is None else {"dtype": dtype}
+        self.state = _k.mfo_init(
+            fn, n, dim, self.half_width, seed=seed, **kwargs
+        )
+
+    def step(self) -> _k.MFOState:
+        self.state = _k.mfo_step(
+            self.state, self.objective, self.half_width, self.t_max, self.b
+        )
+        return self.state
+
+    def run(self, n_steps: int) -> _k.MFOState:
+        self.state = _k.mfo_run(
+            self.state, self.objective, n_steps, self.half_width,
+            self.t_max, self.b,
+        )
+        jax.block_until_ready(self.state.flame_fit)
+        return self.state
+
+    @property
+    def best(self) -> float:
+        return float(self.state.flame_fit[0])

@@ -187,10 +187,16 @@ def auction_allocation_step(state: SwarmState, cfg: SwarmConfig) -> SwarmState:
     # The re-solve is gated on a leader existing to arbitrate (same
     # stance as the greedy path): while leaderless, surviving incumbents
     # keep their tasks — a re-solve here would see an all-infeasible
-    # matrix and strip alive, healthy winners.
+    # matrix and strip alive, healthy winners.  Besides the cadence, it
+    # fires whenever any task is unawarded (which subsumes winner-death
+    # evictions, including ones whose tick coincided with a leaderless
+    # window and would otherwise lose their one-tick pulse) — the same
+    # keep-retrying stance as the greedy path's per-tick claims.
     leader_exists = jnp.any(state.alive & (state.fsm == LEADER))
     resolve = leader_exists & (
-        (state.tick % cfg.auction_every == 0) | jnp.any(evict)
+        (state.tick % cfg.auction_every == 0)
+        | jnp.any(evict)
+        | jnp.any(state.task_winner == NO_WINNER)
     )
 
     def solve(st):

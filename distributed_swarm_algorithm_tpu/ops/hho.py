@@ -1,0 +1,183 @@
+"""Harris-hawks-optimization kernels (Heidari et al. 2019), TPU-vectorized.
+
+Part of the swarm-intelligence toolkit (the reference has no optimizer —
+its only "fitness" is the task utility at
+/root/reference/agent.py:338-347).  HHO contributes *cooperative
+pursuit*: the population's behavior switches between four besiege
+strategies (soft/hard, with or without Lévy-flight rapid dives) driven
+by the prey's decaying escape energy E — a richer per-individual policy
+than any single-rule family here, exercising the masked-branch design
+at its hardest.
+
+TPU shape: all six behavior branches (2 exploration + 4 besiege) are
+computed batched and combined with nested ``jnp.where`` masks — no
+per-hawk control flow; the dive branches' trial points Y and Z are
+evaluated for the whole population at once (3 objective evaluations per
+generation, documented), and the Lévy steps reuse the Mantegna sampler
+from ``ops/cuckoo.py``.
+
+Per hawk, generation t (T = horizon, rabbit = best-so-far):
+    E = 2*E0*(1 - t/T),  E0 ~ U(-1,1);  J = 2*(1 - U(0,1))
+    |E| >= 1: explore   (random-hawk perch or mean-referenced perch)
+    |E| <  1: besiege   soft / hard, +- Lévy rapid dives (greedy accept)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from .cuckoo import levy_steps
+
+T_MAX = 1000      # default schedule horizon for the escape-energy decay
+LEVY_BETA = 1.5   # Lévy exponent for the rapid dives
+
+
+@struct.dataclass
+class HHOState:
+    """Struct-of-arrays hawk population. N hawks, D dims."""
+
+    pos: jax.Array        # [N, D]
+    fit: jax.Array        # [N]
+    best_pos: jax.Array   # [D] — the rabbit
+    best_fit: jax.Array   # scalar
+    key: jax.Array
+    iteration: jax.Array  # i32 scalar
+
+
+def hho_init(
+    objective: Callable,
+    n: int,
+    dim: int,
+    half_width: float,
+    seed: int = 0,
+    dtype=jnp.float32,
+) -> HHOState:
+    key = jax.random.PRNGKey(seed)
+    key, kp = jax.random.split(key)
+    pos = jax.random.uniform(
+        kp, (n, dim), dtype, minval=-half_width, maxval=half_width
+    )
+    fit = objective(pos)
+    b = jnp.argmin(fit)
+    return HHOState(
+        pos=pos,
+        fit=fit,
+        best_pos=pos[b],
+        best_fit=fit[b],
+        key=key,
+        iteration=jnp.asarray(0, jnp.int32),
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("objective", "half_width", "t_max", "levy_beta"),
+)
+def hho_step(
+    state: HHOState,
+    objective: Callable,
+    half_width: float = 5.12,
+    t_max: int = T_MAX,
+    levy_beta: float = LEVY_BETA,
+) -> HHOState:
+    """One generation: energy-gated switch over the six HHO behaviors,
+    with greedy acceptance on the Lévy-dive branches."""
+    n, d = state.pos.shape
+    dt = state.pos.dtype
+    key, ke, kj, kq, kr, kperm, k1, k2, k3, k4, ks, klev = jax.random.split(
+        state.key, 12
+    )
+    lb, ub = -half_width, half_width
+    rabbit = state.best_pos
+
+    t = (state.iteration + 1).astype(dt)
+    e0 = jax.random.uniform(ke, (n,), dt, minval=-1.0, maxval=1.0)
+    # Clamped at the horizon: past t_max the energy stays 0 (pure
+    # exploitation) instead of growing again and re-randomizing a
+    # converged population.
+    frac = jnp.clip(t / t_max, 0.0, 1.0)
+    energy = 2.0 * e0 * (1.0 - frac)                    # [N]
+    abs_e = jnp.abs(energy)[:, None]
+    e = energy[:, None]
+    jump = 2.0 * (1.0 - jax.random.uniform(kj, (n, 1), dt))
+    q = jax.random.uniform(kq, (n, 1), dt)
+    r = jax.random.uniform(kr, (n, 1), dt)
+
+    # --- exploration (|E| >= 1): perch on a random hawk or below the
+    # family mean (Heidari eq. 1) --------------------------------------
+    rand_idx = jax.random.randint(kperm, (n,), 0, n)
+    x_rand = state.pos[rand_idx]                        # [N, D]
+    r1 = jax.random.uniform(k1, (n, d), dt)
+    r2 = jax.random.uniform(k2, (n, d), dt)
+    r3 = jax.random.uniform(k3, (n, d), dt)
+    r4 = jax.random.uniform(k4, (n, d), dt)
+    mean = jnp.mean(state.pos, axis=0)                  # [D]
+    explore_a = x_rand - r1 * jnp.abs(x_rand - 2.0 * r2 * state.pos)
+    explore_b = (rabbit - mean) - r3 * (lb + r4 * (ub - lb))
+    explore = jnp.where(q >= 0.5, explore_a, explore_b)
+
+    # --- besiege without dives (r >= 0.5, eqs. 4 & 6) ------------------
+    delta = rabbit - state.pos
+    soft = delta - e * jnp.abs(jump * rabbit - state.pos)
+    hard = rabbit - e * jnp.abs(delta)
+    besiege = jnp.where(abs_e >= 0.5, soft, hard)
+
+    # --- besiege with Lévy rapid dives (r < 0.5, eqs. 10-13):
+    # trial Y (direct strike), trial Z = Y + Lévy dive; both evaluated
+    # batched, accepted greedily against the hawk's current fitness ----
+    y_soft = rabbit - e * jnp.abs(jump * rabbit - state.pos)
+    y_hard = rabbit - e * jnp.abs(jump * rabbit - mean)
+    y = jnp.where(abs_e >= 0.5, y_soft, y_hard)
+    s = jax.random.uniform(ks, (n, d), dt)
+    z = y + s * levy_steps(klev, (n, d), levy_beta, dt)
+    y = jnp.clip(y, lb, ub)
+    z = jnp.clip(z, lb, ub)
+    fy = objective(y)
+    fz = objective(z)
+    dive = jnp.where(
+        (fy < state.fit)[:, None],
+        y,
+        jnp.where((fz < state.fit)[:, None], z, state.pos),
+    )
+
+    exploit = jnp.where(r >= 0.5, besiege, dive)
+    pos = jnp.where(abs_e >= 1.0, explore, exploit)
+    pos = jnp.clip(pos, lb, ub)
+    fit = objective(pos)
+
+    b = jnp.argmin(fit)
+    improved = fit[b] < state.best_fit
+    return HHOState(
+        pos=pos,
+        fit=fit,
+        best_pos=jnp.where(improved, pos[b], state.best_pos),
+        best_fit=jnp.where(improved, fit[b], state.best_fit),
+        key=key,
+        iteration=state.iteration + 1,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective", "n_steps", "half_width", "t_max", "levy_beta",
+    ),
+)
+def hho_run(
+    state: HHOState,
+    objective: Callable,
+    n_steps: int,
+    half_width: float = 5.12,
+    t_max: int = T_MAX,
+    levy_beta: float = LEVY_BETA,
+) -> HHOState:
+    def body(s, _):
+        return hho_step(s, objective, half_width, t_max, levy_beta), None
+
+    state, _ = jax.lax.scan(body, state, None, length=n_steps)
+    return state

@@ -1,0 +1,136 @@
+"""Moth-flame-optimization kernels (Mirjalili 2015), TPU-vectorized.
+
+Part of the swarm-intelligence toolkit (the reference has no optimizer —
+its only "fitness" is the task utility at
+/root/reference/agent.py:338-347).  MFO contributes an *elitist memory*
+population: the flames are the best N positions ever seen (moths and
+old flames merged and sorted each generation), and each moth spirals
+around its own flame — so good regions persist even after every moth
+has flown away, unlike PSO's single gbest or DE's in-place population.
+
+TPU shape: the flame update is one length-2N sort (XLA sort, no host
+round-trips); the spiral flight is batched elementwise math; the
+shrinking flame count is a clipped traced index, not a dynamic shape.
+
+Per moth i, generation t (T = horizon, b = spiral constant):
+    n_flames = round(N - t * (N - 1) / T)
+    j        = min(i, n_flames - 1)                  (assigned flame)
+    l        ~ U(r, 1),  r = -1 - t/T                (goes -1 -> -2)
+    M_i      = |F_j - M_i| * exp(b*l) * cos(2*pi*l) + F_j
+    flames   = best N of (old flames ++ new moths)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+T_MAX = 1000    # default schedule horizon (flame count + l range decay)
+SPIRAL_B = 1.0  # logarithmic-spiral shape constant
+
+
+@struct.dataclass
+class MFOState:
+    """Struct-of-arrays moth/flame population. N moths, D dims.
+    Flames are kept sorted by fitness, ascending — flame 0 is the best
+    position ever seen."""
+
+    pos: jax.Array        # [N, D] moths
+    fit: jax.Array        # [N]
+    flame_pos: jax.Array  # [N, D] sorted elite memory
+    flame_fit: jax.Array  # [N]
+    key: jax.Array
+    iteration: jax.Array  # i32 scalar
+
+
+def mfo_init(
+    objective: Callable,
+    n: int,
+    dim: int,
+    half_width: float,
+    seed: int = 0,
+    dtype=jnp.float32,
+) -> MFOState:
+    key = jax.random.PRNGKey(seed)
+    key, kp = jax.random.split(key)
+    pos = jax.random.uniform(
+        kp, (n, dim), dtype, minval=-half_width, maxval=half_width
+    )
+    fit = objective(pos)
+    order = jnp.argsort(fit)
+    return MFOState(
+        pos=pos,
+        fit=fit,
+        flame_pos=pos[order],
+        flame_fit=fit[order],
+        key=key,
+        iteration=jnp.asarray(0, jnp.int32),
+    )
+
+
+@partial(
+    jax.jit, static_argnames=("objective", "half_width", "t_max", "b")
+)
+def mfo_step(
+    state: MFOState,
+    objective: Callable,
+    half_width: float = 5.12,
+    t_max: int = T_MAX,
+    b: float = SPIRAL_B,
+) -> MFOState:
+    """One generation: spiral flights around per-moth flames, then the
+    elitist merge-sort flame update."""
+    n, d = state.pos.shape
+    dt = state.pos.dtype
+    key, kl = jax.random.split(state.key)
+
+    t = (state.iteration + 1).astype(dt)
+    frac = jnp.clip(t / t_max, 0.0, 1.0)
+    # Flame count shrinks N -> 1; moths beyond it share the last flame.
+    n_flames = jnp.round(n - frac * (n - 1)).astype(jnp.int32)
+    j = jnp.minimum(jnp.arange(n), n_flames - 1)        # [N] flame index
+    flame = state.flame_pos[j]                          # [N, D]
+
+    # l ~ U(r, 1) with r: -1 -> -2; more negative l = tighter spiral.
+    r = -1.0 - frac
+    l = jax.random.uniform(kl, (n, d), dt, minval=r, maxval=1.0)
+    dist = jnp.abs(flame - state.pos)
+    pos = dist * jnp.exp(b * l) * jnp.cos(2.0 * jnp.pi * l) + flame
+    pos = jnp.clip(pos, -half_width, half_width)
+    fit = objective(pos)
+
+    # Elitist memory: best N of (old flames ++ new moths), one XLA sort.
+    all_fit = jnp.concatenate([state.flame_fit, fit])
+    all_pos = jnp.concatenate([state.flame_pos, pos], axis=0)
+    order = jnp.argsort(all_fit)[:n]
+    return MFOState(
+        pos=pos,
+        fit=fit,
+        flame_pos=all_pos[order],
+        flame_fit=all_fit[order],
+        key=key,
+        iteration=state.iteration + 1,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("objective", "n_steps", "half_width", "t_max", "b"),
+)
+def mfo_run(
+    state: MFOState,
+    objective: Callable,
+    n_steps: int,
+    half_width: float = 5.12,
+    t_max: int = T_MAX,
+    b: float = SPIRAL_B,
+) -> MFOState:
+    def body(s, _):
+        return mfo_step(s, objective, half_width, t_max, b), None
+
+    state, _ = jax.lax.scan(body, state, None, length=n_steps)
+    return state

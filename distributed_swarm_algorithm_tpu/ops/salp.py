@@ -1,0 +1,126 @@
+"""Salp-swarm-algorithm kernels (Mirjalili et al. 2017), TPU-vectorized.
+
+Part of the swarm-intelligence toolkit (the reference has no optimizer —
+its only "fitness" is the task utility at
+/root/reference/agent.py:338-347).  SSA contributes *chain* topology:
+the leading salp explores around the food source (best-so-far) under a
+shrinking exploration envelope c1, and every follower simply averages
+with its predecessor, so information ripples down the chain with a
+built-in delay — a qualitatively different information-flow pattern
+from gbest broadcast (PSO) or all-pairs attraction (firefly).
+
+TPU shape: the follower rule x_i <- (x_i + x_{i-1})/2 is one shifted
+add over the population axis (no gathers, no per-salp control flow),
+and the leader rule is a masked first-row write — the whole generation
+fuses under jit.
+
+Per generation t (T = schedule horizon, lb/ub = ±half_width):
+    c1 = 2 * exp(-(4t/T)^2)
+    x_0 = F + sign(c3 - 0.5) * c1 * ((ub - lb) * c2 + lb)   (leader)
+    x_i = (x_i + x_{i-1}) / 2                    for i >= 1 (followers)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+T_MAX = 1000  # default schedule horizon for the c1 decay
+
+
+@struct.dataclass
+class SalpState:
+    """Struct-of-arrays salp chain. N salps, D dims."""
+
+    pos: jax.Array        # [N, D]
+    fit: jax.Array        # [N]
+    best_pos: jax.Array   # [D] — the food source F
+    best_fit: jax.Array   # scalar
+    key: jax.Array
+    iteration: jax.Array  # i32 scalar
+
+
+def salp_init(
+    objective: Callable,
+    n: int,
+    dim: int,
+    half_width: float,
+    seed: int = 0,
+    dtype=jnp.float32,
+) -> SalpState:
+    key = jax.random.PRNGKey(seed)
+    key, kp = jax.random.split(key)
+    pos = jax.random.uniform(
+        kp, (n, dim), dtype, minval=-half_width, maxval=half_width
+    )
+    fit = objective(pos)
+    b = jnp.argmin(fit)
+    return SalpState(
+        pos=pos,
+        fit=fit,
+        best_pos=pos[b],
+        best_fit=fit[b],
+        key=key,
+        iteration=jnp.asarray(0, jnp.int32),
+    )
+
+
+@partial(jax.jit, static_argnames=("objective", "half_width", "t_max"))
+def salp_step(
+    state: SalpState,
+    objective: Callable,
+    half_width: float = 5.12,
+    t_max: int = T_MAX,
+) -> SalpState:
+    """One generation: leader explores around the food source under the
+    decaying c1 envelope, followers chain-average, food updates greedily."""
+    n, d = state.pos.shape
+    dt = state.pos.dtype
+    key, k2, k3 = jax.random.split(state.key, 3)
+
+    t = (state.iteration + 1).astype(dt)
+    c1 = 2.0 * jnp.exp(-((4.0 * t / t_max) ** 2))
+    c2 = jax.random.uniform(k2, (d,), dt)
+    c3 = jax.random.uniform(k3, (d,), dt)
+    lb, ub = -half_width, half_width
+    sign = jnp.where(c3 >= 0.5, 1.0, -1.0)
+    leader = state.best_pos + sign * c1 * ((ub - lb) * c2 + lb)
+
+    # Followers: one shifted add down the chain (Newtonian-motion
+    # simplification from the paper, eq. 3.4).
+    followers = 0.5 * (state.pos[1:] + state.pos[:-1])
+    pos = jnp.concatenate([leader[None, :], followers], axis=0)
+    pos = jnp.clip(pos, -half_width, half_width)
+
+    fit = objective(pos)
+    b = jnp.argmin(fit)
+    improved = fit[b] < state.best_fit
+    return SalpState(
+        pos=pos,
+        fit=fit,
+        best_pos=jnp.where(improved, pos[b], state.best_pos),
+        best_fit=jnp.where(improved, fit[b], state.best_fit),
+        key=key,
+        iteration=state.iteration + 1,
+    )
+
+
+@partial(
+    jax.jit, static_argnames=("objective", "n_steps", "half_width", "t_max")
+)
+def salp_run(
+    state: SalpState,
+    objective: Callable,
+    n_steps: int,
+    half_width: float = 5.12,
+    t_max: int = T_MAX,
+) -> SalpState:
+    def body(s, _):
+        return salp_step(s, objective, half_width, t_max), None
+
+    state, _ = jax.lax.scan(body, state, None, length=n_steps)
+    return state

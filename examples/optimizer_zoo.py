@@ -19,8 +19,11 @@ def main():
     from distributed_swarm_algorithm_tpu.models.de import DE
     from distributed_swarm_algorithm_tpu.models.firefly import Firefly
     from distributed_swarm_algorithm_tpu.models.gwo import GWO
+    from distributed_swarm_algorithm_tpu.models.hho import HarrisHawks
     from distributed_swarm_algorithm_tpu.models.memetic import MemeticPSO
+    from distributed_swarm_algorithm_tpu.models.mfo import MFO
     from distributed_swarm_algorithm_tpu.models.pso import PSO
+    from distributed_swarm_algorithm_tpu.models.salp import Salp
     from distributed_swarm_algorithm_tpu.models.woa import WOA
 
     problem, n, dim, steps = "rastrigin", 256, 10, 400
@@ -37,6 +40,10 @@ def main():
         ("WOA", lambda: WOA(problem, n=n, dim=dim, t_max=steps, seed=0)),
         ("Cuckoo", lambda: Cuckoo(problem, n=n, dim=dim, seed=0)),
         ("Bat", lambda: Bat(problem, n=n, dim=dim, seed=0)),
+        ("Salp", lambda: Salp(problem, n=n, dim=dim, t_max=steps, seed=0)),
+        ("MFO", lambda: MFO(problem, n=n, dim=dim, t_max=steps, seed=0)),
+        ("HHO", lambda: HarrisHawks(problem, n=n, dim=dim, t_max=steps,
+                                    seed=0)),
         ("Firefly", lambda: Firefly(problem, n=n, dim=dim, seed=0)),
     ]
 

@@ -120,6 +120,16 @@ def test_scaled_auction_same_quality_as_flat():
     assert abs(a - b) <= 2 * 24 * 0.05 + 1e-3
 
 
+def test_cpu_swarm_rejects_auction_mode():
+    # The CPU oracle implements greedy only; it must refuse an auction
+    # config rather than silently diverge from the vectorized path.
+    import distributed_swarm_algorithm_tpu as dsa
+    from distributed_swarm_algorithm_tpu.models.cpu_swarm import CpuSwarm
+
+    with pytest.raises(NotImplementedError):
+        CpuSwarm(4, config=dsa.SwarmConfig(allocation_mode="auction"))
+
+
 def test_swarm_auction_mode_assigns_and_recovers():
     import distributed_swarm_algorithm_tpu as dsa
     from distributed_swarm_algorithm_tpu.ops.coordination import kill

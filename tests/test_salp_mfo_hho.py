@@ -1,0 +1,220 @@
+"""Salp swarm (ops/salp.py), moth-flame (ops/mfo.py), and Harris hawks
+(ops/hho.py) model families."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+# --------------------------------------------------------------------- salp
+
+
+def test_salp_converges_on_sphere():
+    from distributed_swarm_algorithm_tpu.models.salp import Salp
+
+    opt = Salp("sphere", n=64, dim=4, seed=0, t_max=300)
+    opt.run(300)
+    assert opt.best < 1e-2
+
+
+def test_salp_chain_structure_and_monotone_best():
+    from distributed_swarm_algorithm_tpu.ops.objectives import sphere
+    from distributed_swarm_algorithm_tpu.ops.salp import salp_init, salp_step
+
+    st = salp_init(sphere, 32, 5, 5.12, seed=1)
+    prev_pos = st.pos
+    st2 = salp_step(st, sphere, 5.12)
+    # Follower rule: row i (i>=1) is the average of old rows i and i-1,
+    # clipped to the domain.
+    want = jnp.clip(0.5 * (prev_pos[1:] + prev_pos[:-1]), -5.12, 5.12)
+    np.testing.assert_allclose(
+        np.asarray(st2.pos[1:]), np.asarray(want), atol=1e-6
+    )
+    prev = float(st.best_fit)
+    for _ in range(30):
+        st = salp_step(st, sphere, 5.12)
+        cur = float(st.best_fit)
+        assert cur <= prev + 1e-7
+        prev = cur
+
+
+def test_salp_positions_stay_in_domain():
+    from distributed_swarm_algorithm_tpu.ops.objectives import sphere
+    from distributed_swarm_algorithm_tpu.ops.salp import salp_init, salp_run
+
+    st = salp_run(salp_init(sphere, 48, 3, 2.0, seed=2), sphere, 50,
+                  half_width=2.0)
+    assert float(jnp.max(jnp.abs(st.pos))) <= 2.0 + 1e-6
+    assert np.allclose(np.asarray(sphere(st.pos)), np.asarray(st.fit),
+                       atol=1e-5)
+
+
+def test_salp_seeded_deterministic_and_checkpoints(tmp_path):
+    from distributed_swarm_algorithm_tpu.models.salp import Salp
+
+    a = Salp("rastrigin", n=32, dim=4, seed=7)
+    b = Salp("rastrigin", n=32, dim=4, seed=7)
+    a.run(30)
+    b.run(30)
+    assert a.best == b.best
+    p = str(tmp_path / "salp.npz")
+    a.save(p)
+    fresh = Salp("rastrigin", n=32, dim=4, seed=99)
+    fresh.load(p)
+    assert fresh.best == a.best
+
+
+def test_salp_rejects_bad_horizon():
+    from distributed_swarm_algorithm_tpu.models.salp import Salp
+
+    with pytest.raises(ValueError):
+        Salp("sphere", n=16, dim=2, t_max=0)
+
+
+# ---------------------------------------------------------------------- mfo
+
+
+def test_mfo_converges_on_sphere():
+    from distributed_swarm_algorithm_tpu.models.mfo import MFO
+
+    opt = MFO("sphere", n=64, dim=4, seed=0, t_max=300)
+    opt.run(300)
+    assert opt.best < 1e-2
+
+
+def test_mfo_flames_are_sorted_elitist_memory():
+    from distributed_swarm_algorithm_tpu.ops.mfo import mfo_init, mfo_step
+    from distributed_swarm_algorithm_tpu.ops.objectives import rastrigin
+
+    st = mfo_init(rastrigin, 32, 5, 5.12, seed=1)
+    prev_best = float(st.flame_fit[0])
+    for _ in range(20):
+        st = mfo_step(st, rastrigin, 5.12)
+        ff = np.asarray(st.flame_fit)
+        assert (np.diff(ff) >= -1e-6).all()          # sorted ascending
+        assert ff[0] <= prev_best + 1e-7             # elitist: never worse
+        prev_best = float(ff[0])
+        # every flame's fitness matches its position
+        np.testing.assert_allclose(
+            np.asarray(rastrigin(st.flame_pos)), ff, atol=1e-4
+        )
+
+
+def test_mfo_positions_stay_in_domain():
+    from distributed_swarm_algorithm_tpu.ops.mfo import mfo_init, mfo_run
+    from distributed_swarm_algorithm_tpu.ops.objectives import sphere
+
+    st = mfo_run(mfo_init(sphere, 48, 3, 2.0, seed=2), sphere, 50,
+                 half_width=2.0)
+    assert float(jnp.max(jnp.abs(st.pos))) <= 2.0 + 1e-6
+    assert float(jnp.max(jnp.abs(st.flame_pos))) <= 2.0 + 1e-6
+
+
+def test_mfo_seeded_deterministic_and_checkpoints(tmp_path):
+    from distributed_swarm_algorithm_tpu.models.mfo import MFO
+
+    a = MFO("rastrigin", n=32, dim=4, seed=7)
+    b = MFO("rastrigin", n=32, dim=4, seed=7)
+    a.run(30)
+    b.run(30)
+    assert a.best == b.best
+    p = str(tmp_path / "mfo.npz")
+    a.save(p)
+    fresh = MFO("rastrigin", n=32, dim=4, seed=99)
+    fresh.load(p)
+    assert fresh.best == a.best
+
+
+# ---------------------------------------------------------------------- hho
+
+
+def test_hho_converges_on_sphere():
+    from distributed_swarm_algorithm_tpu.models.hho import HarrisHawks
+
+    opt = HarrisHawks("sphere", n=64, dim=4, seed=0, t_max=300)
+    opt.run(300)
+    assert opt.best < 1e-2
+
+
+def test_hho_best_is_monotone():
+    from distributed_swarm_algorithm_tpu.ops.hho import hho_init, hho_step
+    from distributed_swarm_algorithm_tpu.ops.objectives import rastrigin
+
+    st = hho_init(rastrigin, 32, 5, 5.12, seed=1)
+    prev = float(st.best_fit)
+    for _ in range(30):
+        st = hho_step(st, rastrigin, 5.12)
+        cur = float(st.best_fit)
+        assert cur <= prev + 1e-7
+        prev = cur
+
+
+def test_hho_positions_stay_in_domain_late_phase():
+    # Run past t_max so the low-energy besiege branches (incl. Lévy
+    # dives) are exercised, then check containment + fitness coherence.
+    from distributed_swarm_algorithm_tpu.ops.hho import hho_init, hho_run
+    from distributed_swarm_algorithm_tpu.ops.objectives import sphere
+
+    st = hho_run(hho_init(sphere, 48, 3, 2.0, seed=2), sphere, 120,
+                 half_width=2.0, t_max=100)
+    assert float(jnp.max(jnp.abs(st.pos))) <= 2.0 + 1e-6
+    assert np.allclose(np.asarray(sphere(st.pos)), np.asarray(st.fit),
+                       atol=1e-5)
+    assert np.isfinite(np.asarray(st.pos)).all()
+
+
+def test_hho_energy_clamped_past_horizon():
+    # Regression: past t_max the escape energy must stay 0 (pure
+    # exploitation), not grow again and re-randomize a converged
+    # population — so the best keeps improving after the horizon.
+    from distributed_swarm_algorithm_tpu.ops.hho import hho_init, hho_run
+    from distributed_swarm_algorithm_tpu.ops.objectives import sphere
+
+    st = hho_run(hho_init(sphere, 48, 4, 5.12, seed=3), sphere, 100,
+                 half_width=5.12, t_max=100)
+    at_horizon = float(st.best_fit)
+    st = hho_run(st, sphere, 100, half_width=5.12, t_max=100)
+    assert float(st.best_fit) <= at_horizon
+    assert float(st.best_fit) < 1e-3
+
+
+def test_hho_seeded_deterministic_and_checkpoints(tmp_path):
+    from distributed_swarm_algorithm_tpu.models.hho import HarrisHawks
+
+    a = HarrisHawks("rastrigin", n=32, dim=4, seed=7)
+    b = HarrisHawks("rastrigin", n=32, dim=4, seed=7)
+    a.run(30)
+    b.run(30)
+    assert a.best == b.best
+    p = str(tmp_path / "hho.npz")
+    a.save(p)
+    fresh = HarrisHawks("rastrigin", n=32, dim=4, seed=99)
+    fresh.load(p)
+    assert fresh.best == a.best
+
+
+# ------------------------------------------------------- island-model reuse
+
+
+def test_new_families_work_with_generic_islands():
+    # All three families follow the shared pos/fit state convention, so
+    # the family-agnostic island model (parallel/universal.py) applies
+    # unchanged.
+    from distributed_swarm_algorithm_tpu.ops.objectives import rastrigin
+    from distributed_swarm_algorithm_tpu.ops.salp import salp_init, salp_run
+    from distributed_swarm_algorithm_tpu.parallel.universal import (
+        islands_global_best,
+        run_islands,
+        stack_islands,
+    )
+
+    stacked = stack_islands(
+        lambda seed: salp_init(rastrigin, 16, 4, 5.12, seed=seed),
+        n_islands=4,
+    )
+    stacked = run_islands(
+        lambda s, n: salp_run(s, rastrigin, n, half_width=5.12),
+        stacked, 6, migrate_every=3, migrate_k=2,
+    )
+    gfit, gpos = islands_global_best(stacked)
+    assert np.isfinite(float(gfit))
+    assert gpos.shape == (4,)

@@ -22,6 +22,7 @@ reference could not actually run:
   salp    salp swarm algorithm on a benchmark objective
   mfo     moth-flame optimization on a benchmark objective
   hho     Harris hawks optimization on a benchmark objective
+  nsga2   NSGA-II multi-objective search on a ZDT problem
   bench   the headline benchmark (same as bench.py)
 
 ``python -m distributed_swarm_algorithm_tpu --id 1 --count 3 --caps lift``
@@ -418,6 +419,30 @@ _SCHEDULED_FAMILIES = (
 )
 
 
+def _cmd_nsga2(args) -> int:
+    import time as _time
+
+    import json
+
+    from .models.nsga2 import NSGA2
+
+    opt = NSGA2(args.problem, n=args.n, dim=args.dim, seed=args.seed)
+    t0 = _time.perf_counter()
+    opt.run(args.steps)
+    dt = _time.perf_counter() - t0
+    front = opt.pareto_front()
+    print(json.dumps({
+        "problem": args.problem,
+        "pop": args.n,
+        "dim": args.dim,
+        "iters": args.steps,
+        "front_size": int(front.shape[0]),
+        "hypervolume@(1.1,1.1)": round(opt.hypervolume([1.1, 1.1]), 4),
+        "steps_per_sec": round(args.steps / dt, 1),
+    }))
+    return 0
+
+
 def _cmd_bench(args) -> int:
     # bench.py lives at the repo root (a driver contract), outside the
     # package — resolve it relative to this file so the subcommand works
@@ -623,6 +648,15 @@ def build_parser() -> argparse.ArgumentParser:
                            help="schedule horizon (default --steps)")
         p_fam.add_argument("--seed", type=int, default=0)
         p_fam.set_defaults(fn=_make_scheduled_family_cmd(module, cls, noun))
+
+    p_nsga2 = sub.add_parser("nsga2", help="NSGA-II multi-objective")
+    p_nsga2.add_argument("--problem", default="zdt1",
+                         choices=["zdt1", "zdt2", "zdt3"])
+    p_nsga2.add_argument("--n", type=int, default=128)
+    p_nsga2.add_argument("--dim", type=int, default=12)
+    p_nsga2.add_argument("--steps", type=int, default=200)
+    p_nsga2.add_argument("--seed", type=int, default=0)
+    p_nsga2.set_defaults(fn=_cmd_nsga2)
 
     p_bench = sub.add_parser("bench", help="headline benchmark")
     p_bench.set_defaults(fn=_cmd_bench)

@@ -25,7 +25,7 @@ from ..ops.allocation import (
 from ..ops.coordination import coordination_step, current_leader, kill, revive
 from ..ops.neighbors import morton_keys as _morton_keys
 from ..ops.physics import physics_step
-from ..state import SwarmState, make_swarm, permute_agents, with_tasks
+from ..state import LEADER, SwarmState, make_swarm, permute_agents, with_tasks
 from ..utils.config import DEFAULT_CONFIG, SwarmConfig
 from ._checkpoint import CheckpointMixin
 
@@ -55,10 +55,15 @@ def swarm_tick(
             lambda s: s,
             state,
         )
-    state = coordination_step(state, cfg)          # agent.py:83-89
     if cfg.allocation_mode == "auction":
-        state = auction_allocation_step(state, cfg)
+        had_leader = jnp.any(state.alive & (state.fsm == LEADER))
+        state = coordination_step(state, cfg)      # agent.py:83-89
+        has_leader = jnp.any(state.alive & (state.fsm == LEADER))
+        state = auction_allocation_step(
+            state, cfg, leader_emerged=~had_leader & has_leader
+        )
     else:
+        state = coordination_step(state, cfg)      # agent.py:83-89
         state = allocation_step(state, cfg)        # agent.py:91-92
     state = physics_step(state, obstacles, cfg)    # agent.py:94-181
     return state

@@ -154,7 +154,11 @@ def allocation_step(state: SwarmState, cfg: SwarmConfig) -> SwarmState:
     )
 
 
-def auction_allocation_step(state: SwarmState, cfg: SwarmConfig) -> SwarmState:
+def auction_allocation_step(
+    state: SwarmState,
+    cfg: SwarmConfig,
+    leader_emerged: jax.Array | bool = False,
+) -> SwarmState:
     """Allocation tick in ``allocation_mode="auction"``: the leader solves
     an eps-optimal one-task-per-agent assignment (Bertsekas auction,
     ops/auction.py) instead of greedy argmax arbitration.
@@ -187,16 +191,20 @@ def auction_allocation_step(state: SwarmState, cfg: SwarmConfig) -> SwarmState:
     # The re-solve is gated on a leader existing to arbitrate (same
     # stance as the greedy path): while leaderless, surviving incumbents
     # keep their tasks — a re-solve here would see an all-infeasible
-    # matrix and strip alive, healthy winners.  Besides the cadence, it
-    # fires whenever any task is unawarded (which subsumes winner-death
-    # evictions, including ones whose tick coincided with a leaderless
-    # window and would otherwise lose their one-tick pulse) — the same
-    # keep-retrying stance as the greedy path's per-tick claims.
+    # matrix and strip alive, healthy winners.  Besides the cadence it
+    # fires on a winner-death eviction, and on ``leader_emerged`` (the
+    # swarm_tick-supplied pulse marking a leaderless->led transition) so
+    # evictions whose tick fell inside a leaderless window — when the
+    # evict pulse itself is consumed with resolve=False — are re-solved
+    # as soon as arbitration is possible again, not an auction_every
+    # later.  Permanently unawardable tasks (infeasible capability, more
+    # tasks than agents) deliberately do NOT trigger per-tick re-solves;
+    # they are retried on the cadence only.
     leader_exists = jnp.any(state.alive & (state.fsm == LEADER))
     resolve = leader_exists & (
         (state.tick % cfg.auction_every == 0)
         | jnp.any(evict)
-        | jnp.any(state.task_winner == NO_WINNER)
+        | jnp.asarray(leader_emerged)
     )
 
     def solve(st):

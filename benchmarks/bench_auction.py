@@ -1,0 +1,69 @@
+"""Auction assignment throughput: eps-optimal 1024 agents x 1024 tasks.
+
+The Bertsekas forward auction (ops/auction.py) solves the one-to-one
+assignment the reference's greedy arbiter (/root/reference/agent.py:
+304-325) merely approximates — and the reference arbitrates one claim
+per message through a leader that crashes beyond 255 agents.  Here a
+full eps-scaled solve over a [1024, 1024] utility matrix runs as a
+lax.while_loop of Jacobi bidding rounds on device.
+
+Metric: assignments/sec = N * solves / wall-clock (one "assignment" =
+one agent seated eps-optimally).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from common import report, timeit_best
+
+from distributed_swarm_algorithm_tpu.ops.auction import (
+    assignment_utility,
+    auction_assign_scaled,
+)
+
+N = 1024
+SOLVES = 10
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    # Dense random utilities in (0, 100] — every pair feasible, the
+    # hardest case for bidding churn.
+    utils = [
+        jax.numpy.asarray(
+            rng.uniform(1.0, 100.0, size=(N, N)).astype(np.float32)
+        )
+        for _ in range(SOLVES)
+    ]
+
+    def solve(u):
+        return auction_assign_scaled(u, eps=0.25, phases=4, theta=5.0)
+
+    res = solve(utils[0])
+    jax.block_until_ready(res.agent_task)           # compile + warm
+
+    holder = {}
+
+    def once():
+        holder["res"] = [solve(u) for u in utils]
+
+    best = timeit_best(
+        once, lambda: int(holder["res"][-1].agent_task[0]), reps=3
+    )
+    r0 = holder["res"][0]
+    seated = int((np.asarray(r0.agent_task) >= 0).sum())
+    total = float(assignment_utility(utils[0], r0))
+    report(
+        f"assignments/sec, eps-optimal auction, {N} x {N} "
+        f"(seated {seated}/{N}, utility {total:.0f}, "
+        f"{int(r0.rounds)} rounds)",
+        N * SOLVES / best,
+        "assignments/sec",
+        0.0,
+    )
+
+
+if __name__ == "__main__":
+    main()

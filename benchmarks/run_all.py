@@ -16,6 +16,7 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 BENCHES = [
     "bench_swarm_cpu.py",
     "bench_allocation.py",
+    "bench_auction.py",
     "bench_pso_10k.py",
     "bench_pso_1m_ackley.py",
     "bench_islands.py",

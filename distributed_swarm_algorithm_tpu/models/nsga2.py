@@ -82,6 +82,11 @@ class NSGA2(CheckpointMixin):
         """2-D hypervolume of the current population w.r.t. ``ref``."""
         import jax.numpy as jnp
 
+        m = self.state.objs.shape[1]
+        if m != 2:
+            raise ValueError(
+                f"hypervolume() supports 2 objectives, problem has {m}"
+            )
         return float(
             _k.hypervolume_2d(self.state.objs, jnp.asarray(ref))
         )

@@ -190,9 +190,10 @@ def nsga2_init(
     )
 
 
-def _tournament(key, rank, crowd, n):
-    """Binary tournament on (rank asc, crowding desc): [N] winner rows."""
-    idx = jax.random.randint(key, (2, n), 0, n)
+def _tournament(key, rank, crowd, n, k):
+    """Binary tournament on (rank asc, crowding desc): [k] winner rows
+    drawn from a pool of n."""
+    idx = jax.random.randint(key, (2, k), 0, n)
     a, b = idx[0], idx[1]
     a_wins = (rank[a] < rank[b]) | (
         (rank[a] == rank[b]) & (crowd[a] > crowd[b])
@@ -223,13 +224,14 @@ def nsga2_step(
         p_mut = 1.0 / d
     key, kt1, kt2, kx, km = jax.random.split(state.key, 5)
 
-    pa = state.pos[_tournament(kt1, state.rank, state.crowd, n)]
-    pb = state.pos[_tournament(kt2, state.rank, state.crowd, n)]
+    # ceil(N/2) parent pairs, both children of each pair kept — N
+    # offspring from N tournament picks and N/2 crossovers (odd N drops
+    # the last surplus child).
+    half = (n + 1) // 2
+    pa = state.pos[_tournament(kt1, state.rank, state.crowd, n, half)]
+    pb = state.pos[_tournament(kt2, state.rank, state.crowd, n, half)]
     c1, c2 = sbx_crossover(kx, pa, pb, lb, ub, eta_c, p_cross)
-    # Interleave the two child sets into one [N, D] offspring batch
-    # (keeps the population size constant for odd/even N alike).
-    half = n // 2
-    children = jnp.concatenate([c1[:half], c2[: n - half]], axis=0)
+    children = jnp.concatenate([c1, c2], axis=0)[:n]
     children = polynomial_mutation(km, children, lb, ub, eta_m, p_mut)
     child_objs = objective(children)
 

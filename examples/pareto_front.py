@@ -1,0 +1,49 @@
+"""Multi-objective search: NSGA-II on the ZDT suite, with an ASCII
+rendering of the Pareto front.
+
+Run:  python examples/pareto_front.py  [zdt1|zdt2|zdt3]
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+
+def render(front, width=60, height=18):
+    """ASCII scatter of the front in objective space."""
+    import numpy as np
+
+    f1, f2 = front[:, 0], front[:, 1]
+    lo1, hi1 = float(f1.min()), float(f1.max())
+    lo2, hi2 = float(f2.min()), float(f2.max())
+    span1 = max(hi1 - lo1, 1e-9)
+    span2 = max(hi2 - lo2, 1e-9)
+    grid = [[" "] * width for _ in range(height)]
+    for a, b in front:
+        x = int((a - lo1) / span1 * (width - 1))
+        y = int((b - lo2) / span2 * (height - 1))
+        grid[height - 1 - y][x] = "o"
+    print(f"f2: {hi2:.2f}")
+    for row in grid:
+        print("".join(row))
+    print(f"{'f1: %.2f' % lo1:<{width // 2}}{'%.2f' % hi1:>{width // 2}}")
+    del np
+
+
+def main():
+    from distributed_swarm_algorithm_tpu.models.nsga2 import NSGA2
+
+    problem = sys.argv[1] if len(sys.argv) > 1 else "zdt1"
+    opt = NSGA2(problem, n=128, dim=12, seed=0)
+    opt.run(250)
+    front = opt.pareto_front()
+    order = front[:, 0].argsort()
+    front = front[order]
+    print(f"{problem}: front size {len(front)}, "
+          f"hypervolume@(1.1,1.1) = {opt.hypervolume([1.1, 1.1]):.4f}\n")
+    render(front)
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,8 @@ reference could not actually run:
   mfo     moth-flame optimization on a benchmark objective
   hho     Harris hawks optimization on a benchmark objective
   nsga2   NSGA-II multi-objective search on a ZDT problem
+  ga      real-coded genetic algorithm on a benchmark objective
+  pt      parallel tempering (replica exchange) on a benchmark objective
   bench   the headline benchmark (same as bench.py)
 
 ``python -m distributed_swarm_algorithm_tpu --id 1 --count 3 --caps lift``
@@ -419,6 +421,23 @@ _SCHEDULED_FAMILIES = (
 )
 
 
+def _cmd_ga(args) -> int:
+    from .models.ga import GA
+
+    opt = GA(args.objective, n=args.n, dim=args.dim, seed=args.seed)
+    return _run_report(opt, args, "individuals")
+
+
+def _cmd_pt(args) -> int:
+    from .models.tempering import ParallelTempering
+
+    opt = ParallelTempering(
+        args.objective, n=args.n, dim=args.dim,
+        swap_every=args.swap_every, seed=args.seed,
+    )
+    return _run_report(opt, args, "chains")
+
+
 def _cmd_nsga2(args) -> int:
     import time as _time
 
@@ -648,6 +667,23 @@ def build_parser() -> argparse.ArgumentParser:
                            help="schedule horizon (default --steps)")
         p_fam.add_argument("--seed", type=int, default=0)
         p_fam.set_defaults(fn=_make_scheduled_family_cmd(module, cls, noun))
+
+    p_ga = sub.add_parser("ga", help="real-coded genetic algorithm")
+    p_ga.add_argument("--objective", default="rastrigin")
+    p_ga.add_argument("--n", type=int, default=128)
+    p_ga.add_argument("--dim", type=int, default=30)
+    p_ga.add_argument("--steps", type=int, default=500)
+    p_ga.add_argument("--seed", type=int, default=0)
+    p_ga.set_defaults(fn=_cmd_ga)
+
+    p_pt = sub.add_parser("pt", help="parallel tempering")
+    p_pt.add_argument("--objective", default="rastrigin")
+    p_pt.add_argument("--n", type=int, default=32)
+    p_pt.add_argument("--dim", type=int, default=30)
+    p_pt.add_argument("--steps", type=int, default=2000)
+    p_pt.add_argument("--swap-every", type=int, default=5)
+    p_pt.add_argument("--seed", type=int, default=0)
+    p_pt.set_defaults(fn=_cmd_pt)
 
     p_nsga2 = sub.add_parser("nsga2", help="NSGA-II multi-objective")
     p_nsga2.add_argument("--problem", default="zdt1",

@@ -1,0 +1,76 @@
+"""User-facing parallel-tempering model."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+
+from ..ops import tempering as _k
+from ..ops.objectives import get_objective
+from ._checkpoint import CheckpointMixin
+
+
+class ParallelTempering(CheckpointMixin):
+    """Parallel tempering (replica exchange): ``n`` Metropolis chains on
+    a geometric temperature ladder, exchanging replicas with the
+    detailed-balance probability every ``swap_every`` steps.
+
+    >>> opt = ParallelTempering("rastrigin", n=32, dim=6, seed=0)
+    >>> opt.run(2000)
+    >>> opt.best  # doctest: +SKIP
+    """
+
+    def __init__(
+        self,
+        objective: Union[str, Callable],
+        n: int,
+        dim: int,
+        half_width: Optional[float] = None,
+        t_min: float = _k.T_MIN,
+        t_max: float = _k.T_MAX,
+        sigma0: float = _k.SIGMA0,
+        swap_every: int = _k.SWAP_EVERY,
+        seed: int = 0,
+        dtype=None,
+    ):
+        if isinstance(objective, str):
+            fn, default_hw = get_objective(objective)
+        else:
+            fn, default_hw = objective, 5.12
+        self.objective = fn
+        self.half_width = float(
+            half_width if half_width is not None else default_hw
+        )
+        if not 0 < t_min < t_max:
+            raise ValueError(
+                f"need 0 < t_min ({t_min}) < t_max ({t_max})"
+            )
+        if swap_every <= 0:
+            raise ValueError(f"swap_every ({swap_every}) must be positive")
+        self.sigma0 = float(sigma0)
+        self.swap_every = int(swap_every)
+        kwargs = {} if dtype is None else {"dtype": dtype}
+        self.state = _k.pt_init(
+            fn, n, dim, self.half_width, t_min=float(t_min),
+            t_max=float(t_max), seed=seed, **kwargs
+        )
+
+    def step(self) -> _k.PTState:
+        self.state = _k.pt_step(
+            self.state, self.objective, self.half_width, self.sigma0,
+            self.swap_every,
+        )
+        return self.state
+
+    def run(self, n_steps: int) -> _k.PTState:
+        self.state = _k.pt_run(
+            self.state, self.objective, n_steps, self.half_width,
+            self.sigma0, self.swap_every,
+        )
+        jax.block_until_ready(self.state.best_fit)
+        return self.state
+
+    @property
+    def best(self) -> float:
+        return float(self.state.best_fit)

@@ -1,0 +1,148 @@
+"""Real-coded genetic-algorithm kernels, TPU-vectorized.
+
+Part of the swarm-intelligence toolkit (the reference has no optimizer —
+its only "fitness" is the task utility at
+/root/reference/agent.py:338-347).  The GA is the classic generational
+baseline the rest of the zoo is measured against: binary-tournament
+selection, SBX crossover, polynomial mutation (both reused from
+``ops/nsga2.py`` — the single-objective case is NSGA-II with a scalar
+rank), and k-elitist replacement.
+
+TPU shape: selection is a batched random-pair compare, variation is
+batched elementwise math, and elitism is one top-k — the generation is
+a handful of fused kernels with no per-individual control flow.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from .nsga2 import ETA_C, ETA_M, P_CROSS, polynomial_mutation, sbx_crossover
+
+N_ELITE = 2  # unconditionally surviving best individuals
+
+
+@struct.dataclass
+class GAState:
+    """Struct-of-arrays population. N individuals, D dims."""
+
+    pos: jax.Array        # [N, D]
+    fit: jax.Array        # [N]
+    best_pos: jax.Array   # [D]
+    best_fit: jax.Array   # scalar
+    key: jax.Array
+    iteration: jax.Array  # i32 scalar
+
+
+def ga_init(
+    objective: Callable,
+    n: int,
+    dim: int,
+    half_width: float,
+    seed: int = 0,
+    dtype=jnp.float32,
+) -> GAState:
+    key = jax.random.PRNGKey(seed)
+    key, kp = jax.random.split(key)
+    pos = jax.random.uniform(
+        kp, (n, dim), dtype, minval=-half_width, maxval=half_width
+    )
+    fit = objective(pos)
+    b = jnp.argmin(fit)
+    return GAState(
+        pos=pos,
+        fit=fit,
+        best_pos=pos[b],
+        best_fit=fit[b],
+        key=key,
+        iteration=jnp.asarray(0, jnp.int32),
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective", "half_width", "eta_c", "eta_m", "p_cross", "p_mut",
+        "n_elite",
+    ),
+)
+def ga_step(
+    state: GAState,
+    objective: Callable,
+    half_width: float = 5.12,
+    eta_c: float = ETA_C,
+    eta_m: float = ETA_M,
+    p_cross: float = P_CROSS,
+    p_mut: float | None = None,
+    n_elite: int = N_ELITE,
+) -> GAState:
+    """One generation: tournament mating, SBX + polynomial mutation,
+    generational replacement with k-elitism."""
+    n, d = state.pos.shape
+    if p_mut is None:
+        p_mut = 1.0 / d
+    lb, ub = -half_width, half_width
+    key, kt1, kt2, kx, km = jax.random.split(state.key, 5)
+
+    def tournament(k, count):
+        idx = jax.random.randint(k, (2, count), 0, n)
+        a, b = idx[0], idx[1]
+        return jnp.where(state.fit[a] <= state.fit[b], a, b)
+
+    half = (n + 1) // 2
+    pa = state.pos[tournament(kt1, half)]
+    pb = state.pos[tournament(kt2, half)]
+    c1, c2 = sbx_crossover(kx, pa, pb, lb, ub, eta_c, p_cross)
+    children = jnp.concatenate([c1, c2], axis=0)[:n]
+    children = polynomial_mutation(km, children, lb, ub, eta_m, p_mut)
+    child_fit = objective(children)
+
+    # k-elitism: the best n_elite parents replace the worst children
+    # (top-k, not full sorts — this runs inside the scan hot loop).
+    _, elite = jax.lax.top_k(-state.fit, n_elite)        # parent rows
+    _, worst = jax.lax.top_k(child_fit, n_elite)         # child rows
+    pos = children.at[worst].set(state.pos[elite])
+    fit = child_fit.at[worst].set(state.fit[elite])
+
+    b = jnp.argmin(fit)
+    improved = fit[b] < state.best_fit
+    return GAState(
+        pos=pos,
+        fit=fit,
+        best_pos=jnp.where(improved, pos[b], state.best_pos),
+        best_fit=jnp.where(improved, fit[b], state.best_fit),
+        key=key,
+        iteration=state.iteration + 1,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective", "n_steps", "half_width", "eta_c", "eta_m", "p_cross",
+        "p_mut", "n_elite",
+    ),
+)
+def ga_run(
+    state: GAState,
+    objective: Callable,
+    n_steps: int,
+    half_width: float = 5.12,
+    eta_c: float = ETA_C,
+    eta_m: float = ETA_M,
+    p_cross: float = P_CROSS,
+    p_mut: float | None = None,
+    n_elite: int = N_ELITE,
+) -> GAState:
+    def body(s, _):
+        return ga_step(
+            s, objective, half_width, eta_c, eta_m, p_cross, p_mut, n_elite
+        ), None
+
+    state, _ = jax.lax.scan(body, state, None, length=n_steps)
+    return state

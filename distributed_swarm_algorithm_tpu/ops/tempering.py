@@ -1,0 +1,176 @@
+"""Parallel-tempering (replica-exchange) kernels, TPU-vectorized.
+
+Part of the swarm-intelligence toolkit (the reference has no optimizer —
+its only "fitness" is the task utility at
+/root/reference/agent.py:338-347).  Parallel tempering is the
+physics-flavored member of the zoo: N Metropolis chains run the same
+landscape at a geometric temperature ladder — hot chains tunnel across
+barriers, cold chains refine — and adjacent chains periodically
+*exchange* replicas with the detailed-balance probability
+exp((1/T_i - 1/T_j)(f_i - f_j)), so a good basin found hot anneals its
+way down the ladder.
+
+TPU shape: every chain proposes/accepts in one batched Metropolis pass
+(temperature-scaled Gaussian steps, masked accept).  The exchange round
+pairs adjacent chains by XOR-parity (round r pairs (i, i^1) at even r,
+the offset pairing at odd r), so a swap is one gather + masked where —
+no per-pair control flow, and under ``shard_map`` the pairing is a
+neighbor exchange on the device ring.
+
+Chain 0 is the coldest; temperatures follow a geometric ladder
+T_c = t_min * (t_max/t_min)^(c/(C-1)).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+T_MIN = 0.01        # coldest temperature
+T_MAX = 10.0        # hottest temperature
+SIGMA0 = 0.1        # proposal scale at T=1, in half_width units
+SWAP_EVERY = 5      # exchange-round cadence, steps
+
+
+@struct.dataclass
+class PTState:
+    """Struct-of-arrays replica ladder. C chains, D dims."""
+
+    pos: jax.Array        # [C, D]
+    fit: jax.Array        # [C]
+    temps: jax.Array      # [C] geometric ladder, index 0 coldest
+    best_pos: jax.Array   # [D]
+    best_fit: jax.Array   # scalar
+    key: jax.Array
+    iteration: jax.Array  # i32 scalar
+
+
+def pt_init(
+    objective: Callable,
+    n: int,
+    dim: int,
+    half_width: float,
+    t_min: float = T_MIN,
+    t_max: float = T_MAX,
+    seed: int = 0,
+    dtype=jnp.float32,
+) -> PTState:
+    key = jax.random.PRNGKey(seed)
+    key, kp = jax.random.split(key)
+    pos = jax.random.uniform(
+        kp, (n, dim), dtype, minval=-half_width, maxval=half_width
+    )
+    fit = objective(pos)
+    expo = jnp.arange(n, dtype=dtype) / jnp.maximum(n - 1, 1)
+    temps = t_min * (t_max / t_min) ** expo
+    b = jnp.argmin(fit)
+    return PTState(
+        pos=pos,
+        fit=fit,
+        temps=temps,
+        best_pos=pos[b],
+        best_fit=fit[b],
+        key=key,
+        iteration=jnp.asarray(0, jnp.int32),
+    )
+
+
+def _exchange(key, pos, fit, temps, parity):
+    """One replica-exchange round: chains pair with their XOR-parity
+    neighbor; each pair swaps configurations with the detailed-balance
+    probability."""
+    c = fit.shape[0]
+    idx = jnp.arange(c)
+    # parity 0 pairs (0,1)(2,3)...; parity 1 pairs (1,2)(3,4)... —
+    # achieved by shifting the ladder index before the XOR.
+    partner = ((idx - parity) ^ 1) + parity
+    valid = (partner >= 0) & (partner < c)
+    partner = jnp.clip(partner, 0, c - 1)
+
+    # Swap probability from the pair's (beta, energy) gap; computed on
+    # the lower index and shared so both members decide identically.
+    beta = 1.0 / temps
+    delta = (beta - beta[partner]) * (fit - fit[partner])
+    u = jax.random.uniform(key, (c,), fit.dtype)
+    lower = jnp.minimum(idx, partner)
+    do_swap = valid & (u[lower] < jnp.exp(jnp.minimum(delta, 0.0)))
+
+    new_pos = jnp.where(do_swap[:, None], pos[partner], pos)
+    new_fit = jnp.where(do_swap, fit[partner], fit)
+    return new_pos, new_fit
+
+
+@partial(
+    jax.jit,
+    static_argnames=("objective", "half_width", "sigma0", "swap_every"),
+)
+def pt_step(
+    state: PTState,
+    objective: Callable,
+    half_width: float = 5.12,
+    sigma0: float = SIGMA0,
+    swap_every: int = SWAP_EVERY,
+) -> PTState:
+    """One step: batched Metropolis move per chain, plus a replica-
+    exchange round every ``swap_every`` steps (alternating pairing
+    parity between rounds)."""
+    c, d = state.pos.shape
+    dt = state.pos.dtype
+    key, kp, ka, ks = jax.random.split(state.key, 4)
+
+    # Temperature-scaled Gaussian proposal: hot chains stride further.
+    sigma = sigma0 * half_width * jnp.sqrt(state.temps)[:, None]
+    cand = state.pos + sigma * jax.random.normal(kp, (c, d), dt)
+    cand = jnp.clip(cand, -half_width, half_width)
+    cand_fit = objective(cand)
+    accept = jax.random.uniform(ka, (c,), dt) < jnp.exp(
+        jnp.minimum((state.fit - cand_fit) / state.temps, 0.0)
+    )
+    pos = jnp.where(accept[:, None], cand, state.pos)
+    fit = jnp.where(accept, cand_fit, state.fit)
+
+    it = state.iteration + 1
+    parity = (it // swap_every) % 2
+    pos, fit = jax.lax.cond(
+        it % swap_every == 0,
+        lambda p, f: _exchange(ks, p, f, state.temps, parity),
+        lambda p, f: (p, f),
+        pos, fit,
+    )
+
+    b = jnp.argmin(fit)
+    improved = fit[b] < state.best_fit
+    return PTState(
+        pos=pos,
+        fit=fit,
+        temps=state.temps,
+        best_pos=jnp.where(improved, pos[b], state.best_pos),
+        best_fit=jnp.where(improved, fit[b], state.best_fit),
+        key=key,
+        iteration=it,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective", "n_steps", "half_width", "sigma0", "swap_every",
+    ),
+)
+def pt_run(
+    state: PTState,
+    objective: Callable,
+    n_steps: int,
+    half_width: float = 5.12,
+    sigma0: float = SIGMA0,
+    swap_every: int = SWAP_EVERY,
+) -> PTState:
+    def body(s, _):
+        return pt_step(s, objective, half_width, sigma0, swap_every), None
+
+    state, _ = jax.lax.scan(body, state, None, length=n_steps)
+    return state

@@ -18,12 +18,16 @@ def main():
     from distributed_swarm_algorithm_tpu.models.cuckoo import Cuckoo
     from distributed_swarm_algorithm_tpu.models.de import DE
     from distributed_swarm_algorithm_tpu.models.firefly import Firefly
+    from distributed_swarm_algorithm_tpu.models.ga import GA
     from distributed_swarm_algorithm_tpu.models.gwo import GWO
     from distributed_swarm_algorithm_tpu.models.hho import HarrisHawks
     from distributed_swarm_algorithm_tpu.models.memetic import MemeticPSO
     from distributed_swarm_algorithm_tpu.models.mfo import MFO
     from distributed_swarm_algorithm_tpu.models.pso import PSO
     from distributed_swarm_algorithm_tpu.models.salp import Salp
+    from distributed_swarm_algorithm_tpu.models.tempering import (
+        ParallelTempering,
+    )
     from distributed_swarm_algorithm_tpu.models.woa import WOA
 
     problem, n, dim, steps = "rastrigin", 256, 10, 400
@@ -44,6 +48,9 @@ def main():
         ("MFO", lambda: MFO(problem, n=n, dim=dim, t_max=steps, seed=0)),
         ("HHO", lambda: HarrisHawks(problem, n=n, dim=dim, t_max=steps,
                                     seed=0)),
+        ("GA", lambda: GA(problem, n=n, dim=dim, seed=0)),
+        ("Tempering", lambda: ParallelTempering(problem, n=64, dim=dim,
+                                                seed=0)),
         ("Firefly", lambda: Firefly(problem, n=n, dim=dim, seed=0)),
     ]
 

@@ -177,6 +177,11 @@ def _cmd_pso(args) -> int:
                 "--islands > 1 (each island is a gbest swarm; diversity "
                 "comes from migration)"
             )
+        if getattr(args, "history", None):
+            raise SystemExit(
+                "error: --history is not supported with --islands > 1 "
+                "(the island path runs one fused program end to end)"
+            )
         return _cmd_pso_islands(args)
 
     kwargs = dict(topology=args.topology, ring_radius=args.ring_radius)
@@ -259,9 +264,33 @@ def _run_report(opt, args, count_key: str, count=None, extra=None) -> int:
     Every benchmark-objective optimizer subcommand reports the same
     schema — objective, population size (under a family-specific key),
     dim, iters, best, steps/sec — plus optional family extras (callable
-    values are evaluated after the run, for final-state fields)."""
+    values are evaluated after the run, for final-state fields).
+
+    ``--history FILE`` (available on every single-objective optimizer
+    subcommand) writes the best-so-far convergence curve as JSON to
+    FILE, sampled every ``--history-every`` steps (chunked runs, still
+    jitted).  NSGA-II records curves via the library API
+    (``utils.history.best_curve`` with a custom metric)."""
+    history_path = getattr(args, "history", None)
     start = time.perf_counter()
-    opt.run(args.steps)
+    if history_path:
+        from .utils.history import best_curve
+
+        every = getattr(args, "history_every", 16)
+        if every <= 0:
+            raise SystemExit(
+                f"error: --history-every ({every}) must be >= 1"
+            )
+        if args.steps <= 0:
+            raise SystemExit(
+                f"error: --steps ({args.steps}) must be >= 1 with "
+                "--history"
+            )
+        curve = best_curve(opt, args.steps, chunk=every)
+        with open(history_path, "w") as fh:
+            json.dump(curve, fh)
+    else:
+        opt.run(args.steps)
     elapsed = time.perf_counter() - start
     out = {
         "objective": args.objective,
@@ -696,6 +725,18 @@ def build_parser() -> argparse.ArgumentParser:
 
     p_bench = sub.add_parser("bench", help="headline benchmark")
     p_bench.set_defaults(fn=_cmd_bench)
+
+    # Convergence-history flags for every single-objective optimizer
+    # subcommand (utils/history.py; see _run_report).
+    for name in (
+        "pso", "de", "cmaes", "abc", "gwo", "firefly", "cuckoo", "woa",
+        "bat", "salp", "mfo", "hho", "ga", "pt",
+    ):
+        sp = sub.choices[name]
+        sp.add_argument("--history", metavar="FILE", default=None,
+                        help="write best-so-far curve as JSON to FILE")
+        sp.add_argument("--history-every", type=int, default=16,
+                        help="curve sampling stride in steps")
 
     return parser
 

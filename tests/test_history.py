@@ -1,0 +1,69 @@
+"""Convergence-history recording (utils/history.py + CLI --history)."""
+
+import json
+
+import pytest
+
+from distributed_swarm_algorithm_tpu.utils.history import best_curve
+
+
+def test_best_curve_shape_and_monotonicity():
+    from distributed_swarm_algorithm_tpu.models.de import DE
+
+    opt = DE("sphere", n=64, dim=4, seed=0)
+    curve = best_curve(opt, 100, chunk=20)
+    steps = [p["step"] for p in curve]
+    assert steps == [0, 20, 40, 60, 80, 100]
+    bests = [p["best"] for p in curve]
+    assert all(b2 <= b1 + 1e-7 for b1, b2 in zip(bests, bests[1:]))
+    assert bests[-1] < bests[0]
+
+
+def test_best_curve_ragged_tail_and_custom_metric():
+    from distributed_swarm_algorithm_tpu.models.nsga2 import NSGA2
+
+    opt = NSGA2("zdt1", n=64, dim=6, seed=0)
+    curve = best_curve(
+        opt, 25, chunk=10, metric=lambda m: m.hypervolume([1.1, 1.1])
+    )
+    assert [p["step"] for p in curve] == [0, 10, 20, 25]
+    # Hypervolume grows as the front advances.
+    assert curve[-1]["best"] > curve[0]["best"]
+
+
+def test_best_curve_validates_inputs():
+    from distributed_swarm_algorithm_tpu.models.de import DE
+
+    opt = DE("sphere", n=16, dim=2, seed=0)
+    with pytest.raises(ValueError):
+        best_curve(opt, 0)
+    with pytest.raises(ValueError):
+        best_curve(opt, 10, chunk=0)
+
+
+def test_cli_history_rejections(tmp_path):
+    from distributed_swarm_algorithm_tpu.cli import main
+
+    out = str(tmp_path / "c.json")
+    with pytest.raises(SystemExit):
+        main(["pso", "--islands", "2", "--n", "64", "--dim", "2",
+              "--steps", "10", "--history", out])
+    with pytest.raises(SystemExit):
+        main(["de", "--n", "16", "--dim", "2", "--steps", "10",
+              "--history", out, "--history-every", "0"])
+
+
+def test_cli_history_flag_writes_curve(tmp_path, capsys):
+    from distributed_swarm_algorithm_tpu.cli import main
+
+    out = tmp_path / "curve.json"
+    rc = main([
+        "ga", "--objective", "sphere", "--n", "32", "--dim", "3",
+        "--steps", "40", "--history", str(out), "--history-every", "10",
+    ])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["best"] < 1.0
+    curve = json.loads(out.read_text())
+    assert [p["step"] for p in curve] == [0, 10, 20, 30, 40]
+    assert curve[-1]["best"] == pytest.approx(report["best"], rel=1e-6)

@@ -54,13 +54,10 @@ class CpuSwarm:
         backend: str = "auto",
     ):
         self.config = config or DEFAULT_CONFIG
-        if self.config.allocation_mode != "greedy":
-            # The CPU path is the semantics oracle for the greedy
-            # arbiter only; silently running greedy under an auction
-            # config would make cross-checks diverge without warning.
-            raise NotImplementedError(
-                "CpuSwarm supports allocation_mode='greedy' only; the "
-                "auction mode is a vectorized-path feature (ops/auction.py)"
+        if self.config.allocation_mode not in ("greedy", "auction"):
+            raise ValueError(
+                f"unknown allocation_mode "
+                f"{self.config.allocation_mode!r}"
             )
         self.n = n_agents
         rng = np.random.default_rng(seed)
@@ -144,10 +141,23 @@ class CpuSwarm:
 
     # --- stepping ---------------------------------------------------------
     def step(self, n_steps: int = 1) -> None:
+        auction = self.config.allocation_mode == "auction"
         for _ in range(n_steps):
             self.tick += 1
-            self._coordination_step()
-            self._allocation_step()
+            if auction:
+                had_leader = bool(
+                    (self.alive & (self.fsm == LEADER)).any()
+                )
+                self._coordination_step()
+                has_leader = bool(
+                    (self.alive & (self.fsm == LEADER)).any()
+                )
+                self._auction_allocation_step(
+                    leader_emerged=not had_leader and has_leader
+                )
+            else:
+                self._coordination_step()
+                self._allocation_step()
             self._physics_step()
 
     def leader(self) -> Tuple[int, bool]:
@@ -219,16 +229,13 @@ class CpuSwarm:
         self.leader_id = np.where(mine, self.agent_id, self.leader_id)
 
     # --- allocation (NumPy / native port of ops/allocation.py) -----------
-    def _allocation_step(self) -> None:
-        cfg = self.config
-        t = self.task_pos.shape[0]
-        if t == 0:
-            return
-
-        # Dead-winner eviction (mirrors ops/allocation.py:allocation_step):
-        # a task awarded to a dead agent reopens and everyone's view of it
-        # resets, so the swarm re-bids — deliberate elastic recovery the
-        # reference lacks (SURVEY.md §5a bug 6).
+    def _evict_dead_winners(self):
+        """Dead-winner eviction (mirrors ops/allocation.py
+        ``dead_winner_tasks``): a task awarded to a dead agent reopens
+        and everyone's view of it resets, so the swarm re-bids —
+        deliberate elastic recovery the reference lacks (SURVEY.md §5a
+        bug 6).  Shared by both allocation modes; returns the [T] evict
+        mask."""
         awarded = self.task_winner != NO_WINNER
         winner_alive = (
             (self.agent_id[:, None] == self.task_winner[None, :])
@@ -240,6 +247,33 @@ class CpuSwarm:
         ).astype(np.int32)
         self.task_util = np.where(evict, 0.0, self.task_util)
         self.task_claimed &= ~evict[None, :]
+        return evict
+
+    def _utility_matrix(self, dtype=np.float64):
+        """[N, T] utility (ops/allocation.py:utility_matrix).  The
+        auction path passes float32 so the whole chain matches the JAX
+        kernel's arithmetic bit for bit; the greedy path keeps the
+        historical float64."""
+        cfg = self.config
+        pos = self.pos.astype(dtype)
+        tpos = self.task_pos.astype(dtype)
+        delta = pos[:, None, :] - tpos[None, :, :]
+        dist = np.linalg.norm(delta, axis=-1)
+        no_cap = self.task_cap < 0
+        cap_ok = self.caps[:, np.maximum(self.task_cap, 0)]
+        match = np.where(no_cap[None, :], True, cap_ok)
+        return np.where(
+            match, dtype(cfg.utility_scale) / (dtype(1.0) + dist),
+            dtype(0.0),
+        )
+
+    def _allocation_step(self) -> None:
+        cfg = self.config
+        t = self.task_pos.shape[0]
+        if t == 0:
+            return
+
+        self._evict_dead_winners()
 
         if self.backend == "native":
             u = _native.utility_matrix(
@@ -247,12 +281,7 @@ class CpuSwarm:
                 cfg.utility_scale,
             )
         else:
-            delta = self.pos[:, None, :] - self.task_pos[None, :, :]
-            dist = np.linalg.norm(delta, axis=-1)
-            no_cap = self.task_cap < 0
-            cap_ok = self.caps[:, np.maximum(self.task_cap, 0)]
-            match = np.where(no_cap[None, :], True, cap_ok)
-            u = np.where(match, cfg.utility_scale / (1.0 + dist), 0.0)
+            u = self._utility_matrix()
 
         leader_exists = (self.alive & (self.fsm == LEADER)).any()
         open_for_me = ~self.task_claimed
@@ -287,6 +316,45 @@ class CpuSwarm:
 
         awarded = self.task_winner != NO_WINNER
         self.task_claimed |= claims | awarded[None, :]
+
+    def _auction_allocation_step(self, leader_emerged: bool) -> None:
+        """NumPy mirror of ops/allocation.py:auction_allocation_step —
+        immediate dead-winner eviction; eps-optimal re-solve (Bertsekas
+        auction, ops/auction.py:auction_assign_np) on the auction_every
+        cadence, on eviction, and on the leaderless->led pulse."""
+        cfg = self.config
+        t = self.task_pos.shape[0]
+        if t == 0:
+            return
+
+        evict = self._evict_dead_winners()
+
+        leader_exists = bool((self.alive & (self.fsm == LEADER)).any())
+        resolve = leader_exists and (
+            self.tick % cfg.auction_every == 0
+            or bool(evict.any())
+            or leader_emerged
+        )
+        if not resolve:
+            return
+
+        from ..ops.auction import auction_assign_np
+
+        u = self._utility_matrix(dtype=np.float32)
+        feasible = self.alive[:, None] & (
+            u > np.float32(cfg.utility_threshold)
+        )
+
+        res = auction_assign_np(u, feasible, eps=cfg.auction_eps)
+        got = res.task_agent >= 0
+        row = np.maximum(res.task_agent, 0)
+        self.task_winner = np.where(
+            got, self.agent_id[row], NO_WINNER
+        ).astype(np.int32)
+        self.task_util = np.where(got, u[row, np.arange(t)], 0.0)
+        self.task_claimed = np.broadcast_to(
+            got[None, :], self.task_claimed.shape
+        ).copy()
 
     # --- physics (NumPy / native port of ops/physics.py) ------------------
     def _formation_targets(self):

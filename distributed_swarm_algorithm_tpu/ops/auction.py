@@ -223,3 +223,70 @@ def assignment_utility(util: jax.Array, result: AuctionResult) -> jax.Array:
     j = jnp.where(result.agent_task >= 0, result.agent_task, 0)
     vals = util[i, j]
     return jnp.sum(jnp.where(result.agent_task >= 0, vals, 0.0))
+
+
+def auction_assign_np(util, feasible=None, eps: float = 0.25,
+                      phases: int = 4, theta: float = 5.0,
+                      max_rounds: int = 100_000) -> AuctionResult:
+    """NumPy mirror of ``auction_assign_scaled`` for the CPU oracle path
+    (models/cpu_swarm.py).  Same squared problem, same Jacobi rounds,
+    same lowest-id tie-break, same float32 arithmetic — so outcomes are
+    bit-identical to the JAX kernel and the CPU path can cross-check it.
+    """
+    import numpy as np
+
+    util = np.asarray(util, np.float32)
+    n, t = util.shape
+    if feasible is None:
+        feasible = util > 0.0
+    feasible = np.asarray(feasible, bool)
+    s = max(n, t)
+    values = np.zeros((s, s), np.float32)
+    values[:n, :t] = np.where(feasible & (util > 0.0), util, 0.0)
+
+    prices = np.zeros(s, np.float32)
+    total_rounds = 0
+    agent_task = task_agent = None
+    for k in range(phases - 1, -1, -1):
+        cur_eps = np.float32(eps * float(theta) ** k)
+        agent_task = np.full(s, -1, np.int32)
+        task_agent = np.full(s, -1, np.int32)
+        rounds = 0
+        while (agent_task < 0).any() and rounds < max_rounds:
+            v = values - prices[None, :]
+            w1 = v.max(axis=1)
+            j1 = v.argmax(axis=1)
+            v2 = v.copy()
+            v2[np.arange(s), j1] = _NEG
+            w2 = v2.max(axis=1)
+            bidding = agent_task < 0
+            bid = prices[j1] + (w1 - w2) + cur_eps
+            bid_v = np.where(bidding, bid, np.float32(_NEG))
+            best_bid = np.full(s, np.float32(_NEG))
+            np.maximum.at(best_bid, j1, bid_v)
+            has_bid = best_bid > _NEG / 2.0
+            at_best = bidding & (bid_v >= best_bid[j1])
+            winner = np.full(s, _BIG_ID, np.int64)
+            np.minimum.at(
+                winner, j1[at_best], np.arange(s, dtype=np.int64)[at_best]
+            )
+            winner = winner.astype(np.int32)
+            prev = np.where(has_bid, task_agent, -1)
+            agent_task[prev[prev >= 0]] = -1
+            contested = np.flatnonzero(has_bid)
+            agent_task[winner[contested]] = contested
+            task_agent[contested] = winner[contested]
+            prices[contested] = best_bid[contested]
+            rounds += 1
+        total_rounds += rounds
+
+    i = np.arange(n)
+    j = np.clip(agent_task[:n], 0, t - 1)
+    really = (
+        (agent_task[:n] >= 0) & (agent_task[:n] < t)
+        & feasible[i, j] & (util[i, j] > 0.0)
+    )
+    at = np.where(really, agent_task[:n], -1).astype(np.int32)
+    ta = np.full(t, -1, np.int32)
+    ta[at[really]] = i[really]
+    return AuctionResult(at, ta, prices[:t].copy(), total_rounds)

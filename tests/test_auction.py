@@ -120,14 +120,55 @@ def test_scaled_auction_same_quality_as_flat():
     assert abs(a - b) <= 2 * 24 * 0.05 + 1e-3
 
 
-def test_cpu_swarm_rejects_auction_mode():
-    # The CPU oracle implements greedy only; it must refuse an auction
-    # config rather than silently diverge from the vectorized path.
-    import distributed_swarm_algorithm_tpu as dsa
-    from distributed_swarm_algorithm_tpu.models.cpu_swarm import CpuSwarm
+@pytest.mark.parametrize("shape", [(8, 5), (16, 16), (5, 9)])
+@pytest.mark.parametrize("seed", [0, 3])
+def test_numpy_oracle_matches_jax_auction_exactly(shape, seed):
+    # auction_assign_np mirrors the squared Jacobi algorithm with the
+    # same float32 arithmetic and tie-breaks, so outcomes (not just
+    # totals) must be identical.
+    from distributed_swarm_algorithm_tpu.ops.auction import (
+        auction_assign_np,
+        auction_assign_scaled,
+    )
 
-    with pytest.raises(NotImplementedError):
-        CpuSwarm(4, config=dsa.SwarmConfig(allocation_mode="auction"))
+    rng = np.random.default_rng(seed)
+    n, t = shape
+    util = rng.uniform(0.0, 100.0, size=(n, t)).astype(np.float32)
+    feasible = rng.random((n, t)) < 0.8
+    jx = auction_assign_scaled(jnp.asarray(util), jnp.asarray(feasible))
+    npy = auction_assign_np(util, feasible)
+    np.testing.assert_array_equal(np.asarray(jx.agent_task), npy.agent_task)
+    np.testing.assert_array_equal(np.asarray(jx.task_agent), npy.task_agent)
+    np.testing.assert_array_equal(np.asarray(jx.prices), npy.prices)
+    assert int(jx.rounds) == int(npy.rounds)
+
+
+def test_cpu_swarm_auction_mode_assigns_and_recovers():
+    # The CPU oracle runs the same auction semantics as the vectorized
+    # path: one task per agent, immediate eviction, re-solve coverage.
+    import distributed_swarm_algorithm_tpu as dsa
+    from distributed_swarm_algorithm_tpu.models.cpu_swarm import (
+        NO_WINNER as CPU_NO_WINNER,
+        CpuSwarm,
+    )
+
+    cfg = dsa.SwarmConfig(
+        allocation_mode="auction", auction_every=1, utility_threshold=5.0
+    )
+    sw = CpuSwarm(8, config=cfg, seed=0, spread=3.0, backend="numpy")
+    sw.add_tasks(np.asarray([[1.0, 1.0], [-1.0, 2.0], [2.0, -1.0]]))
+    sw.step(40)
+    winners = sw.task_winner.copy()
+    assert (winners != CPU_NO_WINNER).all()
+    assert len(set(winners.tolist())) == len(winners)
+
+    victim = int(winners[0])
+    sw.kill([victim])
+    sw.step(1)
+    assert victim not in sw.task_winner.tolist()
+    sw.step(40)
+    assert victim not in sw.task_winner.tolist()
+    assert (sw.task_winner != CPU_NO_WINNER).all()
 
 
 def test_swarm_auction_mode_assigns_and_recovers():

@@ -143,9 +143,24 @@ def _cmd_swarm(args) -> int:
         tracer = _trace(args.trace)
     else:
         tracer = contextlib.nullcontext()
+    render = getattr(args, "render", None)
+    if render and args.backend != "jax":
+        raise SystemExit(
+            "error: --render needs trajectory recording "
+            "(--backend jax)"
+        )
+    if render and args.dim != 2:
+        raise SystemExit("error: --render is 2-D only")
+    if render and args.steps < 1:
+        raise SystemExit(
+            f"error: --steps ({args.steps}) must be >= 1 with --render"
+        )
     start = time.perf_counter()
     with tracer:
-        sw.step(args.steps)
+        if render:
+            traj = sw.step(args.steps, record=True)
+        else:
+            sw.step(args.steps)
         if args.backend == "jax":
             # JAX dispatch is async — sync INSIDE the traced block so the
             # profiler captures the device work, and before timing.
@@ -153,6 +168,17 @@ def _cmd_swarm(args) -> int:
 
             jax.block_until_ready(sw.state.pos)
     elapsed = time.perf_counter() - start
+    if render:
+        import numpy as _np
+
+        from .utils.render import trajectory_svg
+
+        trajectory_svg(
+            _np.asarray(traj), render,
+            targets=[[float(x) for x in args.target]]
+            if args.target else None,
+            trails=args.n <= 64,
+        )
     lid, exists = sw.leader()
     print(json.dumps({
         "agents": args.n,
@@ -546,6 +572,10 @@ def build_parser() -> argparse.ArgumentParser:
              "(CPU), Morton-window (approximate, very large N on TPU), "
              "or off",
     )
+    p_swarm.add_argument(
+        "--render", default=None, metavar="FILE.svg",
+        help="record the rollout and write an animated SVG "
+             "(jax backend, 2-D)")
     p_swarm.set_defaults(fn=_cmd_swarm)
 
     p_pso = sub.add_parser("pso", help="particle swarm optimization")

@@ -143,6 +143,59 @@ def test_numpy_oracle_matches_jax_auction_exactly(shape, seed):
     assert int(jx.rounds) == int(npy.rounds)
 
 
+def test_cpu_swarm_matches_vector_swarm_auction_decisions():
+    # End-to-end oracle parity: identical (motionless) swarms stepped
+    # through both implementations must make identical allocation
+    # decisions every tick — same winners, same recorded utilities.
+    # max_speed=0 pins every agent in place (once a leader heartbeats,
+    # followers would otherwise chase formation slots and the f32/f64
+    # physics paths drift apart), so the float32 utility chains see
+    # bit-identical inputs for the whole run.
+    import distributed_swarm_algorithm_tpu as dsa
+    from distributed_swarm_algorithm_tpu.models.cpu_swarm import CpuSwarm
+
+    cfg = dsa.SwarmConfig(
+        allocation_mode="auction", auction_every=4, utility_threshold=5.0,
+        max_speed=0.0,
+    )
+    rng = np.random.default_rng(5)
+    pos = rng.uniform(-4.0, 4.0, size=(10, 2)).astype(np.float32)
+    tasks = rng.uniform(-3.0, 3.0, size=(4, 2)).astype(np.float32)
+
+    s = dsa.make_swarm(10, seed=0)
+    s = s.replace(pos=jnp.asarray(pos))
+    s = dsa.with_tasks(s, jnp.asarray(tasks))
+
+    sw = CpuSwarm(10, config=cfg, seed=0, backend="numpy")
+    sw.pos[:] = pos
+    sw.add_tasks(tasks)
+
+    killed = False
+    for tick in range(60):
+        s = dsa.swarm_tick(s, None, cfg)
+        sw.step(1)
+        np.testing.assert_array_equal(
+            np.asarray(s.task_winner), sw.task_winner,
+            err_msg=f"winner divergence at tick {tick}",
+        )
+        np.testing.assert_allclose(
+            np.asarray(s.task_util), sw.task_util, atol=1e-6,
+            err_msg=f"utility divergence at tick {tick}",
+        )
+        if tick == 45 and not killed:
+            # Kill the same awarded winner in both paths mid-run.
+            winners = np.asarray(s.task_winner)
+            victims = winners[winners >= 0]
+            if len(victims):
+                from distributed_swarm_algorithm_tpu.ops.coordination import (
+                    kill,
+                )
+
+                s = kill(s, int(victims[0]))
+                sw.kill([int(victims[0])])
+                killed = True
+
+
 def test_cpu_swarm_auction_mode_assigns_and_recovers():
     # The CPU oracle runs the same auction semantics as the vectorized
     # path: one task per agent, immediate eviction, re-solve coverage.

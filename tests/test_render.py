@@ -1,0 +1,72 @@
+"""SVG trajectory rendering (utils/render.py + swarm --render)."""
+
+import xml.etree.ElementTree as ET
+
+import numpy as np
+import pytest
+
+from distributed_swarm_algorithm_tpu.utils.render import trajectory_svg
+
+SVG = "{http://www.w3.org/2000/svg}"
+
+
+def _load(path):
+    return ET.parse(path).getroot()
+
+
+def test_svg_structure_and_animation(tmp_path):
+    rng = np.random.default_rng(0)
+    traj = rng.normal(size=(12, 5, 2)).cumsum(axis=0)
+    out = str(tmp_path / "t.svg")
+    assert trajectory_svg(
+        traj, out, obstacles=[[0.0, 0.0, 1.0]], targets=[[2.0, 2.0]]
+    ) == out
+    root = _load(out)                         # valid XML
+    circles = root.findall(f"{SVG}circle")
+    assert len(circles) == 5 + 1              # agents + obstacle
+    animates = root.findall(f".//{SVG}animate")
+    assert len(animates) == 5 * 2             # cx + cy per agent
+    # every keyframe list has one value per frame
+    for a in animates:
+        assert len(a.attrib["values"].split(";")) == 12
+
+
+def test_svg_strides_large_inputs(tmp_path):
+    traj = np.zeros((1000, 700, 2))
+    traj[:, :, 0] = np.arange(1000)[:, None]
+    out = str(tmp_path / "big.svg")
+    trajectory_svg(traj, out, max_frames=50, max_agents=100)
+    root = _load(out)
+    assert len(root.findall(f"{SVG}circle")) == 100
+    anim = root.find(f".//{SVG}animate")
+    assert len(anim.attrib["values"].split(";")) == 50
+
+
+def test_svg_trails_and_validation(tmp_path):
+    traj = np.zeros((3, 2, 2))
+    out = str(tmp_path / "trails.svg")
+    trajectory_svg(traj, out, trails=True)
+    root = _load(out)
+    assert len(root.findall(f"{SVG}polyline")) == 2
+    with pytest.raises(ValueError):
+        trajectory_svg(np.zeros((3, 2)), out)
+    with pytest.raises(ValueError):
+        trajectory_svg(np.zeros((0, 2, 2)), out)
+
+
+def test_cli_swarm_render(tmp_path, capsys):
+    from distributed_swarm_algorithm_tpu.cli import main
+
+    out = tmp_path / "swarm.svg"
+    rc = main([
+        "swarm", "--n", "16", "--steps", "30", "--target", "5", "0",
+        "--render", str(out),
+    ])
+    assert rc == 0
+    root = _load(str(out))
+    # 16 agents + the target cross (a path, not a circle)
+    assert len(root.findall(f"{SVG}circle")) == 16
+    assert len(root.findall(f"{SVG}path")) == 1
+    with pytest.raises(SystemExit):
+        main(["swarm", "--n", "4", "--steps", "5", "--backend", "numpy",
+              "--render", str(tmp_path / "x.svg")])

@@ -118,6 +118,20 @@ def _cmd_sim(args) -> int:
 
 
 def _cmd_swarm(args) -> int:
+    # Preflight --render before any backend construction (the native
+    # backend may trigger an on-demand C++ build).
+    render = getattr(args, "render", None)
+    if render and args.backend != "jax":
+        raise SystemExit(
+            "error: --render needs trajectory recording "
+            "(--backend jax)"
+        )
+    if render and args.dim != 2:
+        raise SystemExit("error: --render is 2-D only")
+    if render and args.steps < 1:
+        raise SystemExit(
+            f"error: --steps ({args.steps}) must be >= 1 with --render"
+        )
     if args.backend == "jax":
         from .models.swarm import VectorSwarm
         from .utils.config import DEFAULT_CONFIG
@@ -143,18 +157,6 @@ def _cmd_swarm(args) -> int:
         tracer = _trace(args.trace)
     else:
         tracer = contextlib.nullcontext()
-    render = getattr(args, "render", None)
-    if render and args.backend != "jax":
-        raise SystemExit(
-            "error: --render needs trajectory recording "
-            "(--backend jax)"
-        )
-    if render and args.dim != 2:
-        raise SystemExit("error: --render is 2-D only")
-    if render and args.steps < 1:
-        raise SystemExit(
-            f"error: --steps ({args.steps}) must be >= 1 with --render"
-        )
     start = time.perf_counter()
     with tracer:
         if render:

@@ -338,14 +338,17 @@ class CpuSwarm:
         if not resolve:
             return
 
-        from ..ops.auction import auction_assign_np
-
         u = self._utility_matrix(dtype=np.float32)
         feasible = self.alive[:, None] & (
             u > np.float32(cfg.utility_threshold)
         )
 
-        res = auction_assign_np(u, feasible, eps=cfg.auction_eps)
+        if self.backend == "native":
+            res = _native.auction_assign(u, feasible, eps=cfg.auction_eps)
+        else:
+            from ..ops.auction import auction_assign_np
+
+            res = auction_assign_np(u, feasible, eps=cfg.auction_eps)
         got = res.task_agent >= 0
         row = np.maximum(res.task_agent, 0)
         self.task_winner = np.where(

@@ -26,7 +26,7 @@ import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "csrc", "swarm_core.cpp")
-_ABI_VERSION = 1
+_ABI_VERSION = 2
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -102,8 +102,10 @@ def available() -> bool:
 _i64 = ctypes.c_int64
 _f64 = ctypes.c_double
 _pd = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+_pf32 = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
 _pu8 = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
 _pi32 = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_pi64 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
 
 
 def _declare(lib: ctypes.CDLL) -> None:
@@ -118,6 +120,11 @@ def _declare(lib: ctypes.CDLL) -> None:
     ]
     lib.dsa_arbitrate.restype = None
     lib.dsa_arbitrate.argtypes = [_i64, _i64, _pd, _pi32, _pd, _f64]
+    lib.dsa_auction_assign.restype = None
+    lib.dsa_auction_assign.argtypes = [
+        _i64, _i64, _pf32, _pu8, _f64, ctypes.c_int32, _f64, _i64,
+        _pi32, _pi32, _pf32, _pi64,
+    ]
     lib.dsa_abi_version.restype = ctypes.c_int32
     lib.dsa_abi_version.argtypes = []
 
@@ -199,3 +206,33 @@ def arbitrate(
         n, t, np.ascontiguousarray(claims, np.float64), winner, util,
         hysteresis,
     )
+
+
+def auction_assign(
+    util: np.ndarray,
+    feasible: np.ndarray,
+    eps: float = 0.25,
+    phases: int = 4,
+    theta: float = 5.0,
+    max_rounds: int = 100_000,
+):
+    """C++ eps-scaled auction (see csrc); bit-identical to
+    ops/auction.py:auction_assign_np / the JAX kernel.  Returns an
+    ``ops.auction.AuctionResult`` of NumPy arrays."""
+    from ..ops.auction import AuctionResult
+
+    lib = load()
+    assert lib is not None, "native library unavailable"
+    n, t = util.shape
+    agent_task = np.empty(n, np.int32)
+    task_agent = np.empty(t, np.int32)
+    prices = np.empty(t, np.float32)
+    rounds = np.zeros(1, np.int64)
+    lib.dsa_auction_assign(
+        n, t,
+        np.ascontiguousarray(util, np.float32),
+        np.ascontiguousarray(feasible, np.uint8),
+        eps, phases, theta, max_rounds,
+        agent_task, task_agent, prices, rounds,
+    )
+    return AuctionResult(agent_task, task_agent, prices, int(rounds[0]))

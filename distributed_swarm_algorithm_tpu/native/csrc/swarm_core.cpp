@@ -22,6 +22,7 @@
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <vector>
 
 extern "C" {
 
@@ -184,7 +185,122 @@ void dsa_arbitrate(
   }
 }
 
+// eps-scaled Bertsekas forward auction (ops/auction.py), float32.
+//
+// Mirrors the JAX kernel / NumPy oracle EXACTLY — same squared problem
+// (S = max(n, t), zero-value slots for infeasible/virtual pairs), same
+// Jacobi rounds, same first-index argmax and lowest-id tie-breaks, same
+// float32 arithmetic order — so all three tiers produce bit-identical
+// assignments, prices, and round counts (tests/test_native.py).
+//
+//   util:     [n][t] float32 utilities
+//   feasible: [n][t] 0/1
+//   agent_task[n], task_agent[t]: outputs, -1 = unassigned
+//   prices_out[t]: final prices; rounds_out: total Jacobi rounds
+void dsa_auction_assign(
+    int64_t n,
+    int64_t t,
+    const float* util,
+    const uint8_t* feasible,
+    double eps,
+    int32_t phases,
+    double theta,
+    int64_t max_rounds,
+    int32_t* agent_task_out,
+    int32_t* task_agent_out,
+    float* prices_out,
+    int64_t* rounds_out) {
+  const int64_t s = n > t ? n : t;
+  const float kNeg = -1.0e6f;
+  std::vector<float> values(static_cast<size_t>(s) * s, 0.0f);
+  for (int64_t i = 0; i < n; ++i)
+    for (int64_t j = 0; j < t; ++j) {
+      const float u = util[i * t + j];
+      if (feasible[i * t + j] && u > 0.0f) values[i * s + j] = u;
+    }
+
+  std::vector<float> prices(s, 0.0f);
+  std::vector<int32_t> agent_task(s), task_agent(s);
+  std::vector<float> bid_v(s), best_bid(s);
+  std::vector<int32_t> j1(s), winner(s);
+  int64_t total_rounds = 0;
+
+  for (int32_t k = phases - 1; k >= 0; --k) {
+    const float cur_eps = static_cast<float>(eps * std::pow(theta, k));
+    std::fill(agent_task.begin(), agent_task.end(), -1);
+    std::fill(task_agent.begin(), task_agent.end(), -1);
+    int64_t rounds = 0;
+    while (rounds < max_rounds) {
+      bool any_unassigned = false;
+      for (int64_t i = 0; i < s; ++i)
+        if (agent_task[i] < 0) { any_unassigned = true; break; }
+      if (!any_unassigned) break;
+
+      // Per-agent best / second-best net value (first-index argmax,
+      // matching np/jnp.argmax).
+      for (int64_t i = 0; i < s; ++i) {
+        const float* vi = values.data() + i * s;
+        float w1 = vi[0] - prices[0];  // first-index argmax, no floor
+        int64_t best_j = 0;
+        for (int64_t j = 1; j < s; ++j) {
+          const float v = vi[j] - prices[j];
+          if (v > w1) { w1 = v; best_j = j; }
+        }
+        float w2 = kNeg;  // the NumPy mirror masks j1 with _NEG
+        for (int64_t j = 0; j < s; ++j) {
+          if (j == best_j) continue;
+          const float v = vi[j] - prices[j];
+          if (v > w2) w2 = v;
+        }
+        j1[i] = static_cast<int32_t>(best_j);
+        bid_v[i] = (agent_task[i] < 0)
+                       ? (prices[best_j] + (w1 - w2)) + cur_eps
+                       : kNeg;
+      }
+
+      // Per-task best bid and lowest-id winner.
+      std::fill(best_bid.begin(), best_bid.end(), kNeg);
+      for (int64_t i = 0; i < s; ++i)
+        if (bid_v[i] > best_bid[j1[i]]) best_bid[j1[i]] = bid_v[i];
+      std::fill(winner.begin(), winner.end(), -1);
+      for (int64_t i = 0; i < s; ++i) {
+        if (agent_task[i] >= 0) continue;        // not bidding
+        const int32_t j = j1[i];
+        if (bid_v[i] >= best_bid[j] && best_bid[j] > kNeg / 2.0f &&
+            winner[j] < 0)
+          winner[j] = static_cast<int32_t>(i);   // ascending i = min id
+      }
+
+      // Evict previous owners of contested tasks, then seat winners.
+      for (int64_t j = 0; j < s; ++j) {
+        if (winner[j] < 0) continue;
+        if (task_agent[j] >= 0) agent_task[task_agent[j]] = -1;
+      }
+      for (int64_t j = 0; j < s; ++j) {
+        if (winner[j] < 0) continue;
+        agent_task[winner[j]] = static_cast<int32_t>(j);
+        task_agent[j] = winner[j];
+        prices[j] = best_bid[j];
+      }
+      ++rounds;
+    }
+    total_rounds += rounds;
+  }
+
+  // Unpad: a real pair counts only if feasible with positive utility.
+  for (int64_t j = 0; j < t; ++j) task_agent_out[j] = -1;
+  for (int64_t i = 0; i < n; ++i) {
+    const int32_t j = agent_task[i];
+    const bool really = j >= 0 && j < t && feasible[i * t + j] &&
+                        util[i * t + j] > 0.0f;
+    agent_task_out[i] = really ? j : -1;
+    if (really) task_agent_out[j] = static_cast<int32_t>(i);
+  }
+  for (int64_t j = 0; j < t; ++j) prices_out[j] = prices[j];
+  *rounds_out = total_rounds;
+}
+
 // Version tag so the Python loader can verify the ABI.
-int32_t dsa_abi_version() { return 1; }
+int32_t dsa_abi_version() { return 2; }
 
 }  // extern "C"

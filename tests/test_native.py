@@ -135,3 +135,51 @@ def test_native_matches_jax_physics():
     )
     np.testing.assert_allclose(cpos, np.asarray(out.pos), atol=1e-5)
     np.testing.assert_allclose(cvel, np.asarray(out.vel), atol=1e-5)
+
+
+def test_auction_native_matches_numpy_and_jax_exactly():
+    # Three tiers, one algorithm: the C++ auction must produce
+    # bit-identical assignments, prices, and round counts to both the
+    # NumPy oracle and the JAX kernel.
+    import jax.numpy as jnp
+
+    from distributed_swarm_algorithm_tpu.ops.auction import (
+        auction_assign_np,
+        auction_assign_scaled,
+    )
+
+    rng = np.random.default_rng(11)
+    for n, t in ((8, 5), (16, 16), (6, 12)):
+        util = rng.uniform(0.0, 100.0, size=(n, t)).astype(np.float32)
+        feasible = rng.random((n, t)) < 0.8
+        cc = native.auction_assign(util, feasible)
+        npy = auction_assign_np(util, feasible)
+        jx = auction_assign_scaled(jnp.asarray(util), jnp.asarray(feasible))
+        np.testing.assert_array_equal(cc.agent_task, npy.agent_task)
+        np.testing.assert_array_equal(cc.task_agent, npy.task_agent)
+        np.testing.assert_array_equal(cc.prices, npy.prices)
+        assert int(cc.rounds) == int(npy.rounds)
+        np.testing.assert_array_equal(cc.agent_task,
+                                      np.asarray(jx.agent_task))
+        np.testing.assert_array_equal(cc.prices, np.asarray(jx.prices))
+
+
+def test_cpu_swarm_native_auction_backend():
+    import distributed_swarm_algorithm_tpu as dsa
+    from distributed_swarm_algorithm_tpu.models.cpu_swarm import (
+        NO_WINNER,
+        CpuSwarm,
+    )
+
+    cfg = dsa.SwarmConfig(
+        allocation_mode="auction", auction_every=1, utility_threshold=5.0
+    )
+    a = CpuSwarm(8, config=cfg, seed=0, spread=3.0, backend="native")
+    b = CpuSwarm(8, config=cfg, seed=0, spread=3.0, backend="numpy")
+    tasks = np.asarray([[1.0, 1.0], [-1.0, 2.0], [2.0, -1.0]])
+    a.add_tasks(tasks)
+    b.add_tasks(tasks)
+    a.step(40)
+    b.step(40)
+    assert (a.task_winner != NO_WINNER).all()
+    np.testing.assert_array_equal(a.task_winner, b.task_winner)

@@ -1,0 +1,39 @@
+"""Fused-Pallas bat algorithm at 1M bats, Rastrigin-30D, one chip.
+
+The second fused family (ops/pallas/bat_fused.py): same lane-major
+layout, hardware PRNG, and k-step VMEM blocking as the PSO flagship —
+demonstrating the kernel tier generalizes beyond one optimizer.
+"""
+
+from __future__ import annotations
+
+from common import REFERENCE_AGENT_STEPS_PER_SEC, report, timeit_best
+
+from distributed_swarm_algorithm_tpu.models.bat import Bat
+
+N = 1_048_576
+DIM = 30
+STEPS = 1280
+
+
+def main() -> None:
+    opt = Bat("rastrigin", n=N, dim=DIM, seed=0, steps_per_kernel=8)
+    float(opt.state.best_fit)
+    opt.run(STEPS)
+    float(opt.state.best_fit)              # warm the exact timed program
+
+    def once():
+        opt.run(STEPS)
+
+    best = timeit_best(once, lambda: float(opt.state.best_fit), reps=3)
+    path = "pallas-fused" if opt.use_pallas else "xla-jit"
+    report(
+        f"agent-steps/sec, Bat Rastrigin-30D, {N} bats, 1 chip ({path})",
+        N * STEPS / best,
+        "agent-steps/sec",
+        REFERENCE_AGENT_STEPS_PER_SEC,
+    )
+
+
+if __name__ == "__main__":
+    main()

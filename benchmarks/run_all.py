@@ -20,6 +20,7 @@ BENCHES = [
     "bench_pso_10k.py",
     "bench_pso_1m_ackley.py",
     "bench_islands.py",
+    "bench_bat_1m.py",
     "bench_swarm_tpu.py",
     "bench_boids.py",
 ]
@@ -27,6 +28,7 @@ BENCHES = [
 QUICK_SKIP = {
     "bench_pso_1m_ackley.py",
     "bench_islands.py",
+    "bench_bat_1m.py",
     "bench_swarm_tpu.py",
     "bench_boids.py",
 }

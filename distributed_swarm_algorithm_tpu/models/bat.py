@@ -8,6 +8,8 @@ import jax
 
 from ..ops import bat as _k
 from ..ops.objectives import get_objective
+from ..ops.pallas import bat_fused as _bf
+from ..utils.platform import on_tpu as _on_tpu
 from ._checkpoint import CheckpointMixin
 
 
@@ -16,6 +18,12 @@ class Bat(CheckpointMixin):
 
     Per-bat loudness/pulse adaptation schedules each individual's own
     exploration→exploitation transition.
+
+    ``run`` uses the fused Pallas TPU kernel
+    (ops/pallas/bat_fused.py) when on TPU with a named objective —
+    force with ``use_pallas=True`` (CPU runs the same kernel body in
+    interpret mode) or disable with ``use_pallas=False``.  ``step``
+    always uses the portable path.
 
     >>> opt = Bat("sphere", n=64, dim=6, seed=0)
     >>> opt.run(300)
@@ -36,11 +44,15 @@ class Bat(CheckpointMixin):
         sigma_local: float = _k.SIGMA_LOCAL,
         seed: int = 0,
         dtype=None,
+        use_pallas: Optional[bool] = None,
+        steps_per_kernel: int = 8,
     ):
         if isinstance(objective, str):
             fn, default_hw = get_objective(objective)
+            self.objective_name: Optional[str] = objective
         else:
             fn, default_hw = objective, 5.12
+            self.objective_name = None
         self.objective = fn
         self.half_width = float(
             half_width if half_width is not None else default_hw
@@ -50,10 +62,25 @@ class Bat(CheckpointMixin):
         self.f_min, self.f_max = float(f_min), float(f_max)
         self.alpha, self.gamma = float(alpha), float(gamma)
         self.r0, self.sigma_local = float(r0), float(sigma_local)
+        self.steps_per_kernel = int(steps_per_kernel)
         kwargs = {} if dtype is None else {"dtype": dtype}
         self.state = _k.bat_init(
             fn, n, dim, self.half_width, seed=seed, **kwargs
         )
+        supported = self.objective_name is not None and (
+            _bf.bat_pallas_supported(
+                self.objective_name, self.state.pos.dtype
+            )
+        )
+        if use_pallas is None:
+            self.use_pallas = supported and _on_tpu()
+        elif use_pallas and not supported:
+            raise ValueError(
+                "use_pallas=True needs a named objective from "
+                f"{sorted(_bf.OBJECTIVES_T)} and float32 state"
+            )
+        else:
+            self.use_pallas = bool(use_pallas)
 
     def step(self) -> _k.BatState:
         self.state = _k.bat_step(
@@ -63,11 +90,22 @@ class Bat(CheckpointMixin):
         return self.state
 
     def run(self, n_steps: int) -> _k.BatState:
-        self.state = _k.bat_run(
-            self.state, self.objective, n_steps, self.half_width,
-            self.f_min, self.f_max, self.alpha, self.gamma, self.r0,
-            self.sigma_local,
-        )
+        if self.use_pallas:
+            self.state = _bf.fused_bat_run(
+                self.state, self.objective_name, n_steps,
+                half_width=self.half_width, f_min=self.f_min,
+                f_max=self.f_max, alpha=self.alpha, gamma=self.gamma,
+                r0=self.r0, sigma_local=self.sigma_local,
+                rng="tpu" if _on_tpu() else "host",
+                interpret=not _on_tpu(),
+                steps_per_kernel=self.steps_per_kernel,
+            )
+        else:
+            self.state = _k.bat_run(
+                self.state, self.objective, n_steps, self.half_width,
+                self.f_min, self.f_max, self.alpha, self.gamma, self.r0,
+                self.sigma_local,
+            )
         jax.block_until_ready(self.state.best_fit)
         return self.state
 

@@ -1,0 +1,324 @@
+"""Fused bat-algorithm iteration as a single Pallas TPU kernel.
+
+The second fused family after PSO (ops/pallas/pso_fused.py) — the bat
+algorithm (ops/bat.py) has the same kernel-friendly shape: every
+per-bat update references only the bat's own state plus two global,
+slowly-moving quantities (the incumbent best and the mean loudness),
+so k steps run entirely in VMEM with the globals held fixed per block
+(the same delayed-global trade PSO makes for its gbest).
+
+Same design points as the PSO kernel: lane-major ``[D, N]`` layout,
+on-chip hardware PRNG (four uniform draws per step: frequency beta,
+walk gate, walk direction, loudness gate), one HBM read+write of the
+five state arrays per k-step kernel, and an interpret-mode host-RNG
+variant whose body is byte-identical for CPU testing
+(tests/test_pallas_bat.py).
+
+Deliberate deltas from the portable step, both documented and bounded:
+the incumbent best and mean loudness refresh between kernel blocks,
+not between steps (staleness <= steps_per_kernel iterations — the same
+semantics a sharded bat colony would have between cross-device
+reductions).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..bat import ALPHA, BatState, F_MAX, F_MIN, GAMMA, R0, SIGMA_LOCAL
+from .common import ceil_to as _ceil_to, cyclic_pad_rows as _cyclic_pad_rows
+from .pso_fused import (
+    OBJECTIVES_T,
+    _auto_tile,
+    _uniform_bits,
+    best_of_block,
+    run_blocks,
+    seed_base,
+)
+
+
+def bat_pallas_supported(objective_name, dtype) -> bool:
+    return objective_name in OBJECTIVES_T and jnp.dtype(dtype) == jnp.float32
+
+
+def _make_kernel(
+    objective_t,
+    half_width: float,
+    f_min: float,
+    f_max: float,
+    alpha: float,
+    gamma: float,
+    r0: float,
+    sigma_local: float,
+    host_rng: bool,
+    k_steps: int,
+):
+    def body(scalar_ref, best_ref, mean_a_ref, pos_ref, vel_ref, fit_ref,
+             loud_ref, pulse_ref, rb, rw, re, ra,
+             pos_o, vel_o, fit_o, loud_o, pulse_o):
+        pos, vel = pos_ref[:], vel_ref[:]
+        fit, loud, pulse = fit_ref[:], loud_ref[:], pulse_ref[:]
+        best = best_ref[:][:, 0:1]              # [D, 1]
+        mean_a = mean_a_ref[:][0:1, 0:1]        # [1, 1]
+        t0 = scalar_ref[1].astype(jnp.float32)
+
+        for step in range(k_steps):
+            if host_rng:
+                u_beta, u_walk, u_eps, u_acc = rb, rw, re, ra
+            else:
+                u_beta = _uniform_bits(fit.shape)       # [1, T]
+                u_walk = _uniform_bits(fit.shape)
+                u_eps = _uniform_bits(pos.shape)        # [D, T]
+                u_acc = _uniform_bits(fit.shape)
+
+            freq = f_min + (f_max - f_min) * u_beta     # [1, T] per bat
+            vel_new = vel + (pos - best) * freq
+            cand = pos + vel_new
+
+            # Pulse-gated local walk around the incumbent best
+            # (ops/bat.py: fires when the draw EXCEEDS the pulse rate).
+            walk = u_walk > pulse                       # [1, T]
+            eps = 2.0 * u_eps - 1.0                     # U(-1, 1)
+            local = best + sigma_local * half_width * mean_a * eps
+            cand = jnp.where(walk, local, cand)
+            cand = jnp.clip(cand, -half_width, half_width)
+
+            cfit = objective_t(cand)                    # [1, T]
+            accept = (cfit <= fit) & (u_acc < loud)     # [1, T]
+
+            pos = jnp.where(accept, cand, pos)
+            fit = jnp.where(accept, cfit, fit)
+            vel = jnp.where(accept, vel_new, vel)
+            tf = t0 + (step + 1)
+            loud = jnp.where(accept, loud * alpha, loud)
+            pulse = jnp.where(
+                accept, r0 * (1.0 - jnp.exp(-gamma * tf)), pulse
+            )
+
+        pos_o[:] = pos
+        vel_o[:] = vel
+        fit_o[:] = fit
+        loud_o[:] = loud
+        pulse_o[:] = pulse
+
+    if host_rng:
+        def kernel(scalar_ref, best_ref, mean_a_ref, pos_ref, vel_ref,
+                   fit_ref, loud_ref, pulse_ref, rb_ref, rw_ref, re_ref,
+                   ra_ref, *outs):
+            body(scalar_ref, best_ref, mean_a_ref, pos_ref, vel_ref,
+                 fit_ref, loud_ref, pulse_ref,
+                 rb_ref[:], rw_ref[:], re_ref[:], ra_ref[:], *outs)
+    else:
+        def kernel(scalar_ref, best_ref, mean_a_ref, pos_ref, vel_ref,
+                   fit_ref, loud_ref, pulse_ref, *outs):
+            pltpu.prng_seed(scalar_ref[0] + pl.program_id(0))
+            body(scalar_ref, best_ref, mean_a_ref, pos_ref, vel_ref,
+                 fit_ref, loud_ref, pulse_ref, None, None, None, None,
+                 *outs)
+
+    return kernel
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "half_width", "f_min", "f_max", "alpha",
+        "gamma", "r0", "sigma_local", "tile_n", "rng", "interpret",
+        "k_steps",
+    ),
+)
+def fused_bat_step_t(
+    scalars: jax.Array,       # [2] i32: (base seed, block-start iteration)
+    best_pos: jax.Array,      # [D, 1]
+    mean_a: jax.Array,        # f32 scalar — block-start mean loudness
+    pos: jax.Array,           # [D, N]
+    vel: jax.Array,           # [D, N]
+    fit: jax.Array,           # [1, N]
+    loud: jax.Array,          # [1, N]
+    pulse: jax.Array,         # [1, N]
+    r_beta: jax.Array | None = None,   # [1, N] host-RNG operands
+    r_walk: jax.Array | None = None,   # [1, N]
+    r_eps: jax.Array | None = None,    # [D, N] (mapped to U(-1,1))
+    r_acc: jax.Array | None = None,    # [1, N]
+    *,
+    objective_name: str,
+    half_width: float = 5.12,
+    f_min: float = F_MIN,
+    f_max: float = F_MAX,
+    alpha: float = ALPHA,
+    gamma: float = GAMMA,
+    r0: float = R0,
+    sigma_local: float = SIGMA_LOCAL,
+    tile_n: int = 4096,
+    rng: str = "tpu",
+    interpret: bool = False,
+    k_steps: int = 1,
+) -> Tuple[jax.Array, ...]:
+    """``k_steps`` fused bat generations, one HBM pass over the colony.
+
+    Returns ``(pos, vel, fit, loud, pulse)``; the caller reduces the
+    block's best from ``fit`` (per-bat fitness is monotone under the
+    greedy accept) and recomputes the mean loudness between blocks.
+    """
+    d, n = pos.shape
+    if n % tile_n:
+        raise ValueError(f"N ({n}) must be a multiple of tile_n ({tile_n})")
+    n_tiles = n // tile_n
+    host_rng = rng == "host"
+    if host_rng and any(x is None for x in (r_beta, r_walk, r_eps, r_acc)):
+        raise ValueError('rng="host" requires all four uniform operands')
+    if host_rng and k_steps != 1:
+        raise ValueError('rng="host" supports k_steps=1 only')
+
+    kernel = _make_kernel(
+        OBJECTIVES_T[objective_name], half_width, f_min, f_max, alpha,
+        gamma, r0, sigma_local, host_rng, k_steps,
+    )
+
+    col_block = lambda i, s: (0, i)          # noqa: E731
+    fixed = lambda i, s: (0, 0)              # noqa: E731
+    dn_spec = pl.BlockSpec((d, tile_n), col_block, memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((1, tile_n), col_block, memory_space=pltpu.VMEM)
+
+    # Globals ride lane-broadcast to full 128-lane blocks (Mosaic lowers
+    # 1-lane VMEM blocks with a costly per-program relayout — see the
+    # measurement note in pso_fused.py).
+    best128 = jnp.broadcast_to(best_pos, (d, 128))
+    mean128 = jnp.broadcast_to(
+        jnp.reshape(mean_a.astype(jnp.float32), (1, 1)), (1, 128)
+    )
+    in_specs = [
+        pl.BlockSpec((d, 128), fixed, memory_space=pltpu.VMEM),   # best
+        pl.BlockSpec((1, 128), fixed, memory_space=pltpu.VMEM),   # mean_a
+        dn_spec, dn_spec, row_spec, row_spec, row_spec,
+    ]
+    operands = [best128, mean128, pos, vel, fit, loud, pulse]
+    if host_rng:
+        in_specs += [row_spec, row_spec, dn_spec, row_spec]
+        operands += [r_beta, r_walk, r_eps, r_acc]
+
+    f32 = jnp.float32
+    out_specs = [dn_spec, dn_spec, row_spec, row_spec, row_spec]
+    out_shape = [
+        jax.ShapeDtypeStruct((d, n), f32),
+        jax.ShapeDtypeStruct((d, n), f32),
+        jax.ShapeDtypeStruct((1, n), f32),
+        jax.ShapeDtypeStruct((1, n), f32),
+        jax.ShapeDtypeStruct((1, n), f32),
+    ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(scalars.astype(jnp.int32), *operands)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "n_steps", "half_width", "f_min", "f_max",
+        "alpha", "gamma", "r0", "sigma_local", "tile_n", "rng",
+        "interpret", "steps_per_kernel",
+    ),
+)
+def fused_bat_run(
+    state: BatState,
+    objective_name: str,
+    n_steps: int,
+    half_width: float = 5.12,
+    f_min: float = F_MIN,
+    f_max: float = F_MAX,
+    alpha: float = ALPHA,
+    gamma: float = GAMMA,
+    r0: float = R0,
+    sigma_local: float = SIGMA_LOCAL,
+    tile_n: int | None = None,
+    rng: str = "tpu",
+    interpret: bool = False,
+    steps_per_kernel: int = 8,
+) -> BatState:
+    """``n_steps`` fused bat generations — BatState in, BatState out,
+    drop-in fast path for ``ops.bat.bat_run`` (trajectories differ only
+    in RNG stream and the per-block best/mean-loudness refresh cadence).
+    Padding duplicates leading bats cyclically, which preserves the
+    colony optimum (same argument as pso_fused.fused_pso_run)."""
+    n, d = state.pos.shape
+    if rng == "host":
+        steps_per_kernel = 1
+    if tile_n is None:
+        tile_n = _auto_tile(_ceil_to(max(d, 8), 8))
+    tile_n = min(tile_n, _ceil_to(n, 128))
+    n_pad = _ceil_to(n, tile_n)
+    n_tiles = n_pad // tile_n
+
+    pos_t = _cyclic_pad_rows(state.pos, n_pad).T
+    vel_t = _cyclic_pad_rows(state.vel, n_pad).T
+    fit_t = _cyclic_pad_rows(state.fit, n_pad)[None, :]
+    loud_t = _cyclic_pad_rows(state.loudness, n_pad)[None, :]
+    pulse_t = _cyclic_pad_rows(state.pulse, n_pad)[None, :]
+    seed0 = seed_base(state.key)
+    host_key = jax.random.fold_in(state.key, 0xBA7)
+
+    def block(carry, call_i, k):
+        pos_t, vel_t, fit_t, loud_t, pulse_t, bpos, bfit, it = carry
+        scalars = jnp.stack([seed0 + call_i * n_tiles, it])
+        rb = rw = re = ra = None
+        if rng == "host":
+            kk = jax.random.fold_in(host_key, call_i)
+            kb, kw, ke, ka = jax.random.split(kk, 4)
+            rb = jax.random.uniform(kb, fit_t.shape, jnp.float32)
+            rw = jax.random.uniform(kw, fit_t.shape, jnp.float32)
+            re = jax.random.uniform(ke, pos_t.shape, jnp.float32)
+            ra = jax.random.uniform(ka, fit_t.shape, jnp.float32)
+        mean_a = jnp.mean(loud_t[0, :n])        # real bats only
+        pos_t, vel_t, fit_t, loud_t, pulse_t = fused_bat_step_t(
+            scalars, bpos[:, None], mean_a,
+            pos_t, vel_t, fit_t, loud_t, pulse_t, rb, rw, re, ra,
+            objective_name=objective_name, half_width=half_width,
+            f_min=f_min, f_max=f_max, alpha=alpha, gamma=gamma, r0=r0,
+            sigma_local=sigma_local, tile_n=tile_n, rng=rng,
+            interpret=interpret, k_steps=k,
+        )
+        cand_fit, cand_pos = best_of_block(fit_t, pos_t)
+        better = cand_fit < bfit
+        bfit = jnp.where(better, cand_fit, bfit)
+        bpos = jnp.where(better, cand_pos, bpos)
+        return (pos_t, vel_t, fit_t, loud_t, pulse_t, bpos, bfit, it + k)
+
+    carry = run_blocks(
+        block,
+        (
+            pos_t, vel_t, fit_t, loud_t, pulse_t,
+            state.best_pos.astype(jnp.float32),
+            state.best_fit.astype(jnp.float32),
+            state.iteration,
+        ),
+        n_steps, steps_per_kernel,
+    )
+    pos_t, vel_t, fit_t, loud_t, pulse_t, bpos, bfit, _ = carry
+    dt = state.pos.dtype
+    back = lambda x_t: x_t.T[:n].astype(dt)  # noqa: E731
+    return BatState(
+        pos=back(pos_t),
+        vel=back(vel_t),
+        fit=fit_t[0, :n].astype(state.fit.dtype),
+        loudness=loud_t[0, :n].astype(state.loudness.dtype),
+        pulse=pulse_t[0, :n].astype(state.pulse.dtype),
+        best_pos=bpos.astype(state.best_pos.dtype),
+        best_fit=bfit.astype(state.best_fit.dtype),
+        key=jax.random.fold_in(state.key, n_steps),
+        iteration=state.iteration + n_steps,
+    )

@@ -6,3 +6,23 @@ from __future__ import annotations
 def ceil_to(x: int, m: int) -> int:
     """Round ``x`` up to the next multiple of ``m``."""
     return ((x + m - 1) // m) * m
+
+
+def cyclic_pad_rows(x, n_pad: int):
+    """Pad a [N, ...] float array to ``n_pad`` rows by duplicating the
+    leading rows cyclically (as float32).
+
+    The invariant every fused driver relies on: duplicates are legal
+    population members, so the population optimum is preserved — the min
+    over a multiset superset of the real members cannot be worse, and
+    the padding is sliced off on return.
+    """
+    import jax.numpy as jnp
+
+    x = x.astype(jnp.float32)
+    n = x.shape[0]
+    if n_pad == n:
+        return x
+    reps = -(-n_pad // n)
+    tiling = (reps,) + (1,) * (x.ndim - 1)
+    return jnp.tile(x, tiling)[:n_pad]

@@ -44,7 +44,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..pso import C1, C2, W, PSOState
-from .common import ceil_to as _ceil_to
+from .common import ceil_to as _ceil_to, cyclic_pad_rows as _cyclic_pad_rows
 
 # Default lane tile on the particle axis; fused_pso_run shrinks it for
 # high-D problems via _auto_tile so all live [D, TILE_N] buffers (double-
@@ -411,22 +411,13 @@ def fused_pso_step_t(
 
 def prep_padded_t(state: PSOState, n_pad: int):
     """State → transposed f32 arrays ``(pos_t, vel_t, bpos_t, bfit_t)`` of
-    lane width ``n_pad``.  Padding duplicates leading particles cyclically:
-    duplicates are legal particles, so the swarm optimum is preserved (the
-    min over a multiset superset of the real particles cannot be worse)."""
-    n = state.pos.shape[0]
-    reps = -(-n_pad // n)
-
-    def pad2(x):
-        x = x.astype(jnp.float32)
-        return jnp.tile(x, (reps, 1))[:n_pad] if n_pad != n else x
-
-    bfit = state.pbest_fit.astype(jnp.float32)
-    if n_pad != n:
-        bfit = jnp.tile(bfit, reps)[:n_pad]
+    lane width ``n_pad``.  Padding duplicates leading particles cyclically
+    (common.cyclic_pad_rows), which preserves the swarm optimum."""
     return (
-        pad2(state.pos).T, pad2(state.vel).T, pad2(state.pbest_pos).T,
-        bfit[None, :],
+        _cyclic_pad_rows(state.pos, n_pad).T,
+        _cyclic_pad_rows(state.vel, n_pad).T,
+        _cyclic_pad_rows(state.pbest_pos, n_pad).T,
+        _cyclic_pad_rows(state.pbest_fit, n_pad)[None, :],
     )
 
 

@@ -226,6 +226,45 @@ def fused_bat_step_t(
     )(scalars.astype(jnp.int32), *operands)
 
 
+def bat_host_uniforms(host_key, call_i, fit_shape, pos_shape, fold=None):
+    """The four per-call uniform streams for rng="host" mode (frequency
+    beta, walk gate, walk direction, loudness gate), unique per
+    (call, optional device).  Shared by the single-chip and sharded
+    drivers so their stream construction cannot drift."""
+    kk = jax.random.fold_in(host_key, call_i)
+    if fold is not None:
+        kk = jax.random.fold_in(kk, fold)
+    kb, kw, ke, ka = jax.random.split(kk, 4)
+    return (
+        jax.random.uniform(kb, fit_shape, jnp.float32),
+        jax.random.uniform(kw, fit_shape, jnp.float32),
+        jax.random.uniform(ke, pos_shape, jnp.float32),
+        jax.random.uniform(ka, fit_shape, jnp.float32),
+    )
+
+
+def rebuild_bat_state(
+    state: BatState, pos_t, vel_t, fit_t, loud_t, pulse_t, bpos, bfit,
+    n_steps: int,
+) -> BatState:
+    """Transposed padded arrays → BatState with the original n and
+    dtypes.  Shared by the single-chip and sharded drivers."""
+    n = state.pos.shape[0]
+    dt = state.pos.dtype
+    back = lambda x_t: x_t.T[:n].astype(dt)  # noqa: E731
+    return BatState(
+        pos=back(pos_t),
+        vel=back(vel_t),
+        fit=fit_t[0, :n].astype(state.fit.dtype),
+        loudness=loud_t[0, :n].astype(state.loudness.dtype),
+        pulse=pulse_t[0, :n].astype(state.pulse.dtype),
+        best_pos=bpos.astype(state.best_pos.dtype),
+        best_fit=bfit.astype(state.best_fit.dtype),
+        key=jax.random.fold_in(state.key, n_steps),
+        iteration=state.iteration + n_steps,
+    )
+
+
 @partial(
     jax.jit,
     static_argnames=(
@@ -277,12 +316,9 @@ def fused_bat_run(
         scalars = jnp.stack([seed0 + call_i * n_tiles, it])
         rb = rw = re = ra = None
         if rng == "host":
-            kk = jax.random.fold_in(host_key, call_i)
-            kb, kw, ke, ka = jax.random.split(kk, 4)
-            rb = jax.random.uniform(kb, fit_t.shape, jnp.float32)
-            rw = jax.random.uniform(kw, fit_t.shape, jnp.float32)
-            re = jax.random.uniform(ke, pos_t.shape, jnp.float32)
-            ra = jax.random.uniform(ka, fit_t.shape, jnp.float32)
+            rb, rw, re, ra = bat_host_uniforms(
+                host_key, call_i, fit_t.shape, pos_t.shape
+            )
         mean_a = jnp.mean(loud_t[0, :n])        # real bats only
         pos_t, vel_t, fit_t, loud_t, pulse_t = fused_bat_step_t(
             scalars, bpos[:, None], mean_a,
@@ -308,17 +344,4 @@ def fused_bat_run(
         ),
         n_steps, steps_per_kernel,
     )
-    pos_t, vel_t, fit_t, loud_t, pulse_t, bpos, bfit, _ = carry
-    dt = state.pos.dtype
-    back = lambda x_t: x_t.T[:n].astype(dt)  # noqa: E731
-    return BatState(
-        pos=back(pos_t),
-        vel=back(vel_t),
-        fit=fit_t[0, :n].astype(state.fit.dtype),
-        loudness=loud_t[0, :n].astype(state.loudness.dtype),
-        pulse=pulse_t[0, :n].astype(state.pulse.dtype),
-        best_pos=bpos.astype(state.best_pos.dtype),
-        best_fit=bfit.astype(state.best_fit.dtype),
-        key=jax.random.fold_in(state.key, n_steps),
-        iteration=state.iteration + n_steps,
-    )
+    return rebuild_bat_state(state, *carry[:7], n_steps)

@@ -304,6 +304,143 @@ def fused_pso_run_shmap(
     return rebuild_state(state, *carry, n_steps)
 
 
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "mesh", "n_steps", "axis", "half_width",
+        "f_min", "f_max", "alpha", "gamma", "r0", "sigma_local",
+        "steps_per_kernel", "tile_n", "rng", "interpret",
+    ),
+)
+def fused_bat_run_shmap(
+    state,
+    objective_name: str,
+    mesh: Mesh,
+    n_steps: int,
+    axis: str = AGENT_AXIS,
+    half_width: float = 5.12,
+    f_min: float | None = None,
+    f_max: float | None = None,
+    alpha: float | None = None,
+    gamma: float | None = None,
+    r0: float | None = None,
+    sigma_local: float | None = None,
+    steps_per_kernel: int = 8,
+    tile_n: int | None = None,
+    rng: str = "tpu",
+    interpret: bool = False,
+):
+    """Multi-chip fused-Pallas bat colony (ops/pallas/bat_fused.py):
+    each device runs ``steps_per_kernel`` in-VMEM generations on its bat
+    shard, then the shards exchange the two global quantities over ICI —
+    the incumbent best (``pmin`` value + ``psum`` position broadcast,
+    exactly like the PSO driver) and the mean loudness (``pmean`` of the
+    per-shard means; shards are equal-sized so that IS the colony mean).
+    The per-block staleness of the single-chip kernel and the
+    cross-device cadence coincide, so multi-chip costs no extra
+    semantic delay.  On CPU meshes pass ``rng="host", interpret=True``.
+    """
+    from ..ops.bat import ALPHA, F_MAX, F_MIN, GAMMA, R0, SIGMA_LOCAL
+    from ..ops.pallas.bat_fused import (
+        bat_host_uniforms,
+        fused_bat_step_t,
+        rebuild_bat_state,
+    )
+    from ..ops.pallas.common import ceil_to, cyclic_pad_rows
+    from ..ops.pallas.pso_fused import (
+        _auto_tile,
+        best_of_block,
+        run_blocks,
+        seed_base,
+    )
+
+    f_min = F_MIN if f_min is None else f_min
+    f_max = F_MAX if f_max is None else f_max
+    alpha = ALPHA if alpha is None else alpha
+    gamma = GAMMA if gamma is None else gamma
+    r0 = R0 if r0 is None else r0
+    sigma_local = SIGMA_LOCAL if sigma_local is None else sigma_local
+
+    n, d = state.pos.shape
+    n_dev = mesh.shape[axis]
+    if rng == "host":
+        steps_per_kernel = 1
+    if tile_n is None:
+        tile_n = _auto_tile(ceil_to(max(d, 8), 8))
+    tile_n = min(tile_n, ceil_to(-(-n // n_dev), 128))
+    n_pad = ceil_to(n, n_dev * tile_n)
+    n_tiles_local = (n_pad // n_dev) // tile_n
+
+    pos_t = cyclic_pad_rows(state.pos, n_pad).T
+    vel_t = cyclic_pad_rows(state.vel, n_pad).T
+    fit_t = cyclic_pad_rows(state.fit, n_pad)[None, :]
+    loud_t = cyclic_pad_rows(state.loudness, n_pad)[None, :]
+    pulse_t = cyclic_pad_rows(state.pulse, n_pad)[None, :]
+    seed0 = seed_base(state.key)
+    host_key = jax.random.fold_in(state.key, 0xBA7)
+
+    col = P(None, axis)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(col, col, col, col, col, P(), P()),
+        out_specs=(col, col, col, col, col, P(), P()),
+        check_vma=False,
+    )
+    def run(pos_t, vel_t, fit_t, loud_t, pulse_t, bpos, bfit):
+        dev = lax.axis_index(axis)
+
+        def block(carry, call_i, k):
+            pos_t, vel_t, fit_t, loud_t, pulse_t, bpos, bfit, it = carry
+            scalars = jnp.stack(
+                [seed0 + (call_i * n_dev + dev) * n_tiles_local, it]
+            )
+            rb = rw = re = ra = None
+            if rng == "host":
+                rb, rw, re, ra = bat_host_uniforms(
+                    host_key, call_i, fit_t.shape, pos_t.shape, fold=dev
+                )
+            # Colony mean loudness: pmean of per-shard means (equal
+            # shard sizes).  Padding duplicates are legal bats, so the
+            # padded mean deviates only by duplicate weighting.
+            mean_a = lax.pmean(jnp.mean(loud_t), axis)
+            pos_t, vel_t, fit_t, loud_t, pulse_t = fused_bat_step_t(
+                scalars, bpos[:, None], mean_a,
+                pos_t, vel_t, fit_t, loud_t, pulse_t, rb, rw, re, ra,
+                objective_name=objective_name, half_width=half_width,
+                f_min=f_min, f_max=f_max, alpha=alpha, gamma=gamma,
+                r0=r0, sigma_local=sigma_local, tile_n=tile_n, rng=rng,
+                interpret=interpret, k_steps=k,
+            )
+            loc_fit, loc_pos = best_of_block(fit_t, pos_t)
+            gmin = lax.pmin(loc_fit, axis)
+            mine = loc_fit == gmin
+            win = lax.pmin(jnp.where(mine, dev, _BIG_I32), axis)
+            gcand = lax.psum(jnp.where(dev == win, loc_pos, 0.0), axis)
+            better = gmin < bfit
+            bfit = jnp.where(better, gmin, bfit)
+            bpos = jnp.where(better, gcand, bpos)
+            return (
+                pos_t, vel_t, fit_t, loud_t, pulse_t, bpos, bfit, it + k
+            )
+
+        carry = run_blocks(
+            block,
+            (pos_t, vel_t, fit_t, loud_t, pulse_t, bpos, bfit,
+             state.iteration),
+            n_steps, steps_per_kernel,
+        )
+        return carry[:7]
+
+    carry = run(
+        pos_t, vel_t, fit_t, loud_t, pulse_t,
+        state.best_pos.astype(jnp.float32),
+        state.best_fit.astype(jnp.float32),
+    )
+    return rebuild_bat_state(state, *carry, n_steps)
+
+
 def elect_shmap(
     alive: jax.Array,
     agent_id: jax.Array,

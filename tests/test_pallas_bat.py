@@ -121,3 +121,26 @@ def test_bat_model_backend_switch():
     with pytest.raises(ValueError):
         Bat(lambda x: jnp.sum(x * x, axis=-1), n=16, dim=2,
             use_pallas=True)
+
+
+def test_fused_bat_run_shmap_on_mesh():
+    # Multi-chip fused bat: 8-device CPU mesh, best exchange over the
+    # mesh axis; converges and keeps the colony invariants.
+    from distributed_swarm_algorithm_tpu.parallel.mesh import make_mesh
+    from distributed_swarm_algorithm_tpu.parallel.sharding import (
+        fused_bat_run_shmap,
+    )
+
+    mesh = make_mesh(("agents",))
+    st = bat_init(sphere, 1024, 4, HW, seed=0)
+    init_best = float(st.best_fit)
+    out = fused_bat_run_shmap(
+        st, "sphere", mesh, 40, half_width=HW, rng="host", interpret=True
+    )
+    assert out.pos.shape == (1024, 4)
+    assert float(out.best_fit) <= init_best
+    assert float(out.best_fit) < 1.0
+    assert int(out.iteration) == 40
+    np.testing.assert_allclose(
+        np.asarray(sphere(out.pos)), np.asarray(out.fit), atol=1e-5
+    )

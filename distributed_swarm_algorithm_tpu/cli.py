@@ -286,6 +286,35 @@ def _cmd_pso_islands(args) -> int:
     return 0
 
 
+def _write_history(opt, args, metric=None) -> bool:
+    """Handle ``--history`` for an optimizer subcommand: validate the
+    flags, record the convergence curve (which runs the optimizer), and
+    write JSON-safe output (non-finite samples — e.g. an unevaluated
+    initial best — become null).  Returns True if a curve was recorded,
+    False if the caller should run the optimizer itself."""
+    import math
+
+    history_path = getattr(args, "history", None)
+    if not history_path:
+        return False
+    from .utils.history import best_curve
+
+    every = getattr(args, "history_every", 16)
+    if every <= 0:
+        raise SystemExit(f"error: --history-every ({every}) must be >= 1")
+    if args.steps <= 0:
+        raise SystemExit(
+            f"error: --steps ({args.steps}) must be >= 1 with --history"
+        )
+    curve = best_curve(opt, args.steps, chunk=every, metric=metric)
+    for p in curve:
+        if not math.isfinite(p["best"]):
+            p["best"] = None
+    with open(history_path, "w") as fh:
+        json.dump(curve, fh)
+    return True
+
+
 def _run_report(opt, args, count_key: str, count=None, extra=None) -> int:
     """Shared optimizer-subcommand tail: timed run + one JSON line.
 
@@ -299,25 +328,8 @@ def _run_report(opt, args, count_key: str, count=None, extra=None) -> int:
     FILE, sampled every ``--history-every`` steps (chunked runs, still
     jitted).  NSGA-II records curves via the library API
     (``utils.history.best_curve`` with a custom metric)."""
-    history_path = getattr(args, "history", None)
     start = time.perf_counter()
-    if history_path:
-        from .utils.history import best_curve
-
-        every = getattr(args, "history_every", 16)
-        if every <= 0:
-            raise SystemExit(
-                f"error: --history-every ({every}) must be >= 1"
-            )
-        if args.steps <= 0:
-            raise SystemExit(
-                f"error: --steps ({args.steps}) must be >= 1 with "
-                "--history"
-            )
-        curve = best_curve(opt, args.steps, chunk=every)
-        with open(history_path, "w") as fh:
-            json.dump(curve, fh)
-    else:
+    if not _write_history(opt, args):
         opt.run(args.steps)
     elapsed = time.perf_counter() - start
     out = {
@@ -391,7 +403,8 @@ def _cmd_aco(args) -> int:
                  beta=args.beta, rho=args.rho, q0=args.q0,
                  elite=args.elite, seed=args.seed)
     start = time.perf_counter()
-    colony.run(args.steps)
+    if not _write_history(colony, args, metric=lambda c: c.best_length):
+        colony.run(args.steps)
     elapsed = time.perf_counter() - start
     print(json.dumps({
         "cities": int(coords.shape[0]),
@@ -762,7 +775,7 @@ def build_parser() -> argparse.ArgumentParser:
     # subcommand (utils/history.py; see _run_report).
     for name in (
         "pso", "de", "cmaes", "abc", "gwo", "firefly", "cuckoo", "woa",
-        "bat", "salp", "mfo", "hho", "ga", "pt",
+        "bat", "salp", "mfo", "hho", "ga", "pt", "aco",
     ):
         sp = sub.choices[name]
         sp.add_argument("--history", metavar="FILE", default=None,

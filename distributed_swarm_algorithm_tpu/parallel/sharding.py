@@ -35,6 +35,22 @@ from .mesh import AGENT_AXIS
 _BIG_I32 = jnp.iinfo(jnp.int32).max
 
 
+def _exchange_best(loc_fit, loc_pos, best_fit, best_pos, dev, axis):
+    """Cross-device global-best exchange used by every shmap driver:
+    ``pmin`` the per-shard best value, break ties to the lowest device
+    index, ``psum``-broadcast the winner's position, and merge into the
+    carried incumbent.  Returns ``(best_fit, best_pos)``."""
+    gmin = lax.pmin(loc_fit, axis)
+    mine = loc_fit == gmin
+    win = lax.pmin(jnp.where(mine, dev, _BIG_I32), axis)
+    gcand = lax.psum(jnp.where(dev == win, loc_pos, 0.0), axis)
+    better = gmin < best_fit
+    return (
+        jnp.where(better, gmin, best_fit),
+        jnp.where(better, gcand, best_pos),
+    )
+
+
 def _tree_shard_dim0(tree, mesh: Mesh, axis: str, n: int):
     """Shard every leaf whose dim 0 == n over ``axis``; replicate the rest."""
     sharded = NamedSharding(mesh, P(axis))
@@ -130,15 +146,9 @@ def pso_step_shmap(
         loc_fit = pbest_fit[loc]
         loc_pos = pbest_pos[loc]
         # … global best via ICI collectives.
-        gmin = lax.pmin(loc_fit, axis)
-        mine = loc_fit == gmin
-        winner_dev = lax.pmin(jnp.where(mine, dev, _BIG_I32), axis)
-        gpos = lax.psum(
-            jnp.where(dev == winner_dev, loc_pos, 0.0), axis
+        gbest_fit, gbest_pos = _exchange_best(
+            loc_fit, loc_pos, s.gbest_fit, s.gbest_pos, dev, axis
         )
-        better = gmin < s.gbest_fit
-        gbest_fit = jnp.where(better, gmin, s.gbest_fit)
-        gbest_pos = jnp.where(better, gpos, s.gbest_pos)
 
         # Keep the carried key replicated (every shard advances the same
         # base key; shards re-diversify via fold_in above).
@@ -278,16 +288,11 @@ def fused_pso_run_shmap(
                 half_width=half_width, vmax_frac=vmax_frac, tile_n=tile_n,
                 rng=rng, interpret=interpret, k_steps=k, track_best=False,
             )
-            # Per-shard best, then cross-device gbest: pmin the value,
-            # min-device tie-break, psum-broadcast the winner's position.
+            # Per-shard best, then cross-device gbest exchange.
             loc_fit, loc_pos = best_of_block(bfit_t, bpos_t)
-            gmin = lax.pmin(loc_fit, axis)
-            mine = loc_fit == gmin
-            win = lax.pmin(jnp.where(mine, dev, _BIG_I32), axis)
-            gcand = lax.psum(jnp.where(dev == win, loc_pos, 0.0), axis)
-            better = gmin < gfit
-            gfit = jnp.where(better, gmin, gfit)
-            gpos = jnp.where(better, gcand, gpos)
+            gfit, gpos = _exchange_best(
+                loc_fit, loc_pos, gfit, gpos, dev, axis
+            )
             return (pos_t, vel_t, bpos_t, bfit_t, gpos, gfit)
 
         return run_blocks(
@@ -414,13 +419,9 @@ def fused_bat_run_shmap(
                 interpret=interpret, k_steps=k,
             )
             loc_fit, loc_pos = best_of_block(fit_t, pos_t)
-            gmin = lax.pmin(loc_fit, axis)
-            mine = loc_fit == gmin
-            win = lax.pmin(jnp.where(mine, dev, _BIG_I32), axis)
-            gcand = lax.psum(jnp.where(dev == win, loc_pos, 0.0), axis)
-            better = gmin < bfit
-            bfit = jnp.where(better, gmin, bfit)
-            bpos = jnp.where(better, gcand, bpos)
+            bfit, bpos = _exchange_best(
+                loc_fit, loc_pos, bfit, bpos, dev, axis
+            )
             return (
                 pos_t, vel_t, fit_t, loud_t, pulse_t, bpos, bfit, it + k
             )

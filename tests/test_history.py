@@ -67,3 +67,24 @@ def test_cli_history_flag_writes_curve(tmp_path, capsys):
     curve = json.loads(out.read_text())
     assert [p["step"] for p in curve] == [0, 10, 20, 30, 40]
     assert curve[-1]["best"] == pytest.approx(report["best"], rel=1e-6)
+
+
+def test_cli_aco_history(tmp_path, capsys):
+    # ACO tracks tour length, not `best` — the handler wires the custom
+    # metric through best_curve.
+    from distributed_swarm_algorithm_tpu.cli import main
+
+    out = tmp_path / "aco.json"
+    rc = main([
+        "aco", "--cities", "12", "--ants", "16", "--steps", "20",
+        "--history", str(out), "--history-every", "5",
+    ])
+    assert rc == 0
+    curve = json.loads(out.read_text())
+    assert [p["step"] for p in curve] == [0, 5, 10, 15, 20]
+    # Step 0 samples the unevaluated init (best_len = inf), which must
+    # serialize as JSON null, not the invalid token Infinity.
+    assert curve[0]["best"] is None
+    bests = [p["best"] for p in curve if p["best"] is not None]
+    assert len(bests) == 4
+    assert all(b2 <= b1 + 1e-6 for b1, b2 in zip(bests, bests[1:]))

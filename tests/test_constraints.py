@@ -1,0 +1,72 @@
+"""Constraint handling (ops/constraints.py): penalty composition with
+the optimizer families."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_swarm_algorithm_tpu.ops.constraints import (
+    feasible_mask,
+    penalized,
+    violation,
+)
+from distributed_swarm_algorithm_tpu.ops.objectives import sphere
+
+
+def test_violation_and_feasible_mask():
+    x = jnp.asarray([[2.0, 0.0], [0.5, 0.0], [1.0, 3.0]])
+    ineq = [lambda x: 1.0 - x[:, 0]]          # x0 >= 1
+    eq = [lambda x: x[:, 1]]                  # x1 == 0
+    v = np.asarray(violation(x, ineq, eq))
+    np.testing.assert_allclose(v, [0.0, 0.5, 3.0], atol=1e-6)
+    m = np.asarray(feasible_mask(x, ineq, eq))
+    assert m.tolist() == [True, False, False]
+
+
+def test_penalized_values():
+    x = jnp.asarray([[2.0, 0.0], [0.0, 0.0]])
+    obj = penalized(sphere, inequalities=[lambda x: 1.0 - x[:, 0]],
+                    rho=10.0)
+    got = np.asarray(obj(x))
+    # feasible point: plain sphere; infeasible origin: 0 + 10 * 1^2
+    np.testing.assert_allclose(got, [4.0, 10.0], atol=1e-6)
+
+
+def test_de_solves_constrained_sphere():
+    # min ||x||^2 s.t. x0 >= 1 — optimum at (1, 0, ..., 0), value 1.
+    from distributed_swarm_algorithm_tpu.models.de import DE
+
+    obj = penalized(sphere, inequalities=[lambda x: 1.0 - x[:, 0]],
+                    rho=1e3)
+    opt = DE(obj, n=128, dim=4, half_width=5.12, seed=0)
+    opt.run(400)
+    assert abs(opt.best - 1.0) < 0.05
+    best_x = np.asarray(opt.state.best_pos)
+    assert best_x[0] > 0.9
+    assert np.abs(best_x[1:]).max() < 0.2
+
+
+def test_memetic_gradient_flows_through_penalty():
+    # The penalty is differentiable, so the memetic jax.grad refinement
+    # works on the wrapped objective.
+    from distributed_swarm_algorithm_tpu.models.memetic import MemeticPSO
+
+    obj = penalized(sphere, inequalities=[lambda x: 1.0 - x[:, 0]],
+                    rho=100.0)
+    opt = MemeticPSO(obj, n=64, dim=3, half_width=5.12, seed=0,
+                     refine_every=10)
+    opt.run(200)
+    assert abs(opt.best - 1.0) < 0.1
+
+
+def test_equality_constraint_with_ga():
+    # min ||x||^2 s.t. x0 + x1 == 2 — optimum at (1, 1), value 2.
+    from distributed_swarm_algorithm_tpu.models.ga import GA
+
+    obj = penalized(
+        sphere, equalities=[lambda x: x[:, 0] + x[:, 1] - 2.0], rho=1e3
+    )
+    opt = GA(obj, n=256, dim=2, half_width=5.12, seed=0)
+    opt.run(400)
+    best_x = np.asarray(opt.state.best_pos)
+    assert abs(best_x[0] + best_x[1] - 2.0) < 0.05
+    assert abs(opt.best - 2.0) < 0.1

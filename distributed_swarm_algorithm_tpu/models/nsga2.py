@@ -17,6 +17,11 @@ class NSGA2(CheckpointMixin):
     ``objective`` maps [K, D] -> [K, M] batched (minimization), or pass
     a named ZDT problem ("zdt1" | "zdt2" | "zdt3", domain [0,1]).
 
+    ``inequalities``/``equalities`` (batched [K, D] -> [K] functions;
+    feasible when g <= 0 / h == 0) switch ranking to Deb's constrained
+    domination: feasible beats infeasible, lower total violation beats
+    higher, Pareto dominance decides among the feasible.
+
     >>> opt = NSGA2("zdt1", n=128, dim=12, seed=0)
     >>> opt.run(150)
     >>> front = opt.pareto_front()  # doctest: +SKIP
@@ -33,6 +38,8 @@ class NSGA2(CheckpointMixin):
         eta_m: float = _k.ETA_M,
         p_cross: float = _k.P_CROSS,
         p_mut: float | None = None,
+        inequalities=(),
+        equalities=(),
         seed: int = 0,
         dtype=None,
     ):
@@ -53,15 +60,27 @@ class NSGA2(CheckpointMixin):
         self.eta_c, self.eta_m = float(eta_c), float(eta_m)
         self.p_cross = float(p_cross)
         self.p_mut = None if p_mut is None else float(p_mut)
+        if inequalities or equalities:
+            from ..ops.constraints import violation as _violation
+
+            ineqs, eqs = tuple(inequalities), tuple(equalities)
+
+            def violation_fn(x):
+                return _violation(x, ineqs, eqs)
+
+            self.violation_fn = violation_fn
+        else:
+            self.violation_fn = None
         kwargs = {} if dtype is None else {"dtype": dtype}
         self.state = _k.nsga2_init(
-            fn, n, dim, self.lb, self.ub, seed=seed, **kwargs
+            fn, n, dim, self.lb, self.ub, seed=seed,
+            violation_fn=self.violation_fn, **kwargs
         )
 
     def step(self) -> _k.NSGA2State:
         self.state = _k.nsga2_step(
             self.state, self.objective, self.lb, self.ub, self.eta_c,
-            self.eta_m, self.p_cross, self.p_mut,
+            self.eta_m, self.p_cross, self.p_mut, self.violation_fn,
         )
         return self.state
 
@@ -69,6 +88,7 @@ class NSGA2(CheckpointMixin):
         self.state = _k.nsga2_run(
             self.state, self.objective, n_steps, self.lb, self.ub,
             self.eta_c, self.eta_m, self.p_cross, self.p_mut,
+            self.violation_fn,
         )
         jax.block_until_ready(self.state.objs)
         return self.state
@@ -79,7 +99,8 @@ class NSGA2(CheckpointMixin):
         return np.asarray(self.state.objs)[mask]
 
     def hypervolume(self, ref) -> float:
-        """2-D hypervolume of the current population w.r.t. ``ref``."""
+        """2-D hypervolume of the current population w.r.t. ``ref``
+        (constraint-aware: infeasible individuals contribute no area)."""
         import jax.numpy as jnp
 
         m = self.state.objs.shape[1]
@@ -88,5 +109,7 @@ class NSGA2(CheckpointMixin):
                 f"hypervolume() supports 2 objectives, problem has {m}"
             )
         return float(
-            _k.hypervolume_2d(self.state.objs, jnp.asarray(ref))
+            _k.hypervolume_2d(
+                self.state.objs, jnp.asarray(ref), self.state.viol
+            )
         )

@@ -40,25 +40,56 @@ from flax import struct
 ETA_C = 15.0   # SBX crossover distribution index
 ETA_M = 20.0   # polynomial-mutation distribution index
 P_CROSS = 0.9  # per-pair crossover probability
+FEAS_TOL = 1e-4  # constrained-domination feasibility tolerance: |h| or
+#   max(g, 0) below this counts as feasible.  Deb's standard practice
+#   for equality constraints (which are never exactly 0 in float32);
+#   looser than the 1e-6 diagnostic tol in ops/constraints.feasible_mask
+#   because ranking needs a reachable feasibility band, not a report.
 _INF = jnp.inf
 
 
 # --------------------------------------------------------------- sorting ops
 
 
-def domination_matrix(objs: jax.Array) -> jax.Array:
-    """[P, P] bool: dom[i, j] = i dominates j (all objectives <=, at
-    least one <; minimization)."""
+def domination_matrix(
+    objs: jax.Array,
+    viol: jax.Array | None = None,
+    feas_tol: float = FEAS_TOL,
+) -> jax.Array:
+    """[P, P] bool: dom[i, j] = i dominates j (minimization).
+
+    Unconstrained: all objectives <=, at least one <.  With ``viol``
+    ([P] total constraint violations), Deb's constrained domination
+    applies: a feasible point (violation <= ``feas_tol``) dominates
+    every infeasible one; between infeasible points the smaller
+    violation dominates; between feasible points plain Pareto
+    domination decides.
+    """
     a = objs[:, None, :]                       # [P, 1, M]
     b = objs[None, :, :]                       # [1, P, M]
-    return jnp.all(a <= b, axis=-1) & jnp.any(a < b, axis=-1)
+    pareto = jnp.all(a <= b, axis=-1) & jnp.any(a < b, axis=-1)
+    if viol is None:
+        return pareto
+    feas = viol <= feas_tol                    # [P]
+    fi, fj = feas[:, None], feas[None, :]
+    less_viol = viol[:, None] < viol[None, :]
+    return (
+        (fi & ~fj)
+        | (~fi & ~fj & less_viol)
+        | (fi & fj & pareto)
+    )
 
 
-def nondominated_ranks(objs: jax.Array) -> jax.Array:
+def nondominated_ranks(
+    objs: jax.Array,
+    viol: jax.Array | None = None,
+    feas_tol: float = FEAS_TOL,
+) -> jax.Array:
     """[P] i32 front index per individual (0 = Pareto front), by
-    iterative front peeling under ``lax.while_loop``."""
+    iterative front peeling under ``lax.while_loop``.  With ``viol``,
+    fronts follow constrained domination (see domination_matrix)."""
     p = objs.shape[0]
-    dom = domination_matrix(objs)              # [P, P]
+    dom = domination_matrix(objs, viol, feas_tol)  # [P, P]
 
     def cond(carry):
         rank, _ = carry
@@ -155,10 +186,13 @@ def polynomial_mutation(key, pos, lb, ub, eta_m, p_mut):
 
 @struct.dataclass
 class NSGA2State:
-    """Struct-of-arrays population. N individuals, D dims, M objectives."""
+    """Struct-of-arrays population. N individuals, D dims, M objectives.
+    ``viol`` is all-zero for unconstrained problems (then constrained
+    domination reduces exactly to Pareto domination)."""
 
     pos: jax.Array        # [N, D]
     objs: jax.Array       # [N, M]
+    viol: jax.Array       # [N] total constraint violation (0 = feasible)
     rank: jax.Array       # [N] front index
     crowd: jax.Array      # [N] crowding distance
     key: jax.Array
@@ -173,16 +207,26 @@ def nsga2_init(
     ub: float = 1.0,
     seed: int = 0,
     dtype=jnp.float32,
+    violation_fn: Callable | None = None,
 ) -> NSGA2State:
-    """``objective`` maps [K, D] -> [K, M] (vectorized, minimization)."""
+    """``objective`` maps [K, D] -> [K, M] (vectorized, minimization).
+    ``violation_fn`` ([K, D] -> [K] total constraint violation, 0 =
+    feasible — e.g. ``ops.constraints.violation``) switches ranking to
+    Deb's constrained domination."""
     key = jax.random.PRNGKey(seed)
     key, kp = jax.random.split(key)
     pos = jax.random.uniform(kp, (n, dim), dtype, minval=lb, maxval=ub)
     objs = objective(pos)
-    rank = nondominated_ranks(objs)
+    viol = (
+        jnp.zeros((n,), dtype)
+        if violation_fn is None
+        else violation_fn(pos)
+    )
+    rank = nondominated_ranks(objs, viol)
     return NSGA2State(
         pos=pos,
         objs=objs,
+        viol=viol,
         rank=rank,
         crowd=crowding_distance(objs, rank),
         key=key,
@@ -205,6 +249,7 @@ def _tournament(key, rank, crowd, n, k):
     jax.jit,
     static_argnames=(
         "objective", "lb", "ub", "eta_c", "eta_m", "p_cross", "p_mut",
+        "violation_fn",
     ),
 )
 def nsga2_step(
@@ -216,6 +261,7 @@ def nsga2_step(
     eta_m: float = ETA_M,
     p_cross: float = P_CROSS,
     p_mut: float | None = None,
+    violation_fn: Callable | None = None,
 ) -> NSGA2State:
     """One generation: tournament mating, SBX + polynomial mutation,
     elitist (mu+lambda) survival by (rank, crowding)."""
@@ -236,9 +282,16 @@ def nsga2_step(
     child_objs = objective(children)
 
     # Elitist (mu+lambda) environmental selection over parents+children.
+    # Parent violations ride in the state; only children are evaluated.
     all_pos = jnp.concatenate([state.pos, children], axis=0)     # [2N, D]
     all_objs = jnp.concatenate([state.objs, child_objs], axis=0)
-    all_rank = nondominated_ranks(all_objs)
+    child_viol = (
+        jnp.zeros_like(child_objs[:, 0])
+        if violation_fn is None
+        else violation_fn(children)
+    )
+    all_viol = jnp.concatenate([state.viol, child_viol])
+    all_rank = nondominated_ranks(all_objs, all_viol)
     all_crowd = crowding_distance(all_objs, all_rank)
     # Survivor order: rank ascending, crowding descending — as a
     # two-pass stable sort.  A single float composite key (rank*BIG -
@@ -248,15 +301,12 @@ def nsga2_step(
     order = order_c[jnp.argsort(all_rank[order_c], stable=True)]
     survivors = order[:n]
 
-    pos = all_pos[survivors]
-    objs = all_objs[survivors]
-    rank = all_rank[survivors]
-    crowd = all_crowd[survivors]
     return NSGA2State(
-        pos=pos,
-        objs=objs,
-        rank=rank,
-        crowd=crowd,
+        pos=all_pos[survivors],
+        objs=all_objs[survivors],
+        viol=all_viol[survivors],
+        rank=all_rank[survivors],
+        crowd=all_crowd[survivors],
         key=key,
         iteration=state.iteration + 1,
     )
@@ -266,7 +316,7 @@ def nsga2_step(
     jax.jit,
     static_argnames=(
         "objective", "n_steps", "lb", "ub", "eta_c", "eta_m", "p_cross",
-        "p_mut",
+        "p_mut", "violation_fn",
     ),
 )
 def nsga2_run(
@@ -279,10 +329,12 @@ def nsga2_run(
     eta_m: float = ETA_M,
     p_cross: float = P_CROSS,
     p_mut: float | None = None,
+    violation_fn: Callable | None = None,
 ) -> NSGA2State:
     def body(s, _):
         return nsga2_step(
-            s, objective, lb, ub, eta_c, eta_m, p_cross, p_mut
+            s, objective, lb, ub, eta_c, eta_m, p_cross, p_mut,
+            violation_fn,
         ), None
 
     state, _ = jax.lax.scan(body, state, None, length=n_steps)
@@ -319,12 +371,26 @@ def zdt3(pos: jax.Array) -> jax.Array:
 MOO_PROBLEMS = {"zdt1": zdt1, "zdt2": zdt2, "zdt3": zdt3}
 
 
-def hypervolume_2d(objs: jax.Array, ref: jax.Array) -> jax.Array:
+def hypervolume_2d(
+    objs: jax.Array, ref: jax.Array, viol: jax.Array | None = None
+) -> jax.Array:
     """Hypervolume of the non-dominated subset of 2-D points w.r.t. a
     reference point (minimization; larger = better).  One sort + one
-    scan-free prefix max — O(K log K)."""
+    scan-free prefix max — O(K log K).
+
+    With ``viol``, infeasible points contribute NO area (they are
+    excluded before ranking) — otherwise an infeasible survivor that
+    Pareto-dominates the feasible front would inflate the metric with
+    unattainable area."""
+    if viol is not None:
+        feasible = viol <= FEAS_TOL
+        objs = jnp.where(
+            feasible[:, None], objs, jnp.broadcast_to(ref, objs.shape)
+        )
     rank = nondominated_ranks(objs)
     on_front = rank == 0
+    if viol is not None:
+        on_front = on_front & feasible
     # Sort by f1; mask dominated/absent points to the reference corner
     # so they contribute zero area.
     f1 = jnp.where(on_front, objs[:, 0], ref[0])

@@ -152,3 +152,72 @@ def test_nsga2_custom_objective():
     # Endpoints approached: some point near each optimum.
     assert front[:, 0].min() < 0.05
     assert front[:, 1].min() < 0.05
+
+
+def test_constrained_domination_rules():
+    from distributed_swarm_algorithm_tpu.ops.nsga2 import domination_matrix
+
+    objs = jnp.asarray([[0.0, 0.0], [1.0, 1.0], [0.5, 0.5], [2.0, 2.0]])
+    viol = jnp.asarray([0.0, 0.0, 0.2, 0.5])
+    dom = np.asarray(domination_matrix(objs, viol))
+    assert dom[0, 1]            # both feasible: Pareto decides
+    assert not dom[1, 0]
+    assert dom[1, 2]            # feasible dominates infeasible, even if
+    assert not dom[2, 1]        # the infeasible point Pareto-dominates
+    assert dom[2, 3]            # both infeasible: lower violation wins
+    assert not dom[3, 2]
+    # without violations, plain Pareto: point 2 dominates point 1
+    dom_u = np.asarray(domination_matrix(objs))
+    assert dom_u[2, 1]
+
+
+def test_nsga2_constrained_zdt1_front_respects_constraint():
+    from distributed_swarm_algorithm_tpu.models.nsga2 import NSGA2
+
+    # ZDT1 with x0 >= 0.3: the attainable front is f1 in [0.3, 1].
+    opt = NSGA2(
+        "zdt1", n=100, dim=8, seed=0,
+        inequalities=[lambda x: 0.3 - x[:, 0]],
+    )
+    opt.run(150)
+    front = opt.pareto_front()
+    assert len(front) > 10
+    assert front[:, 0].min() >= 0.3 - 1e-3     # constraint respected
+    assert front[:, 0].min() < 0.35            # boundary approached
+    assert front[:, 0].max() > 0.8             # spread preserved
+    # every rank-0 individual is feasible
+    mask = np.asarray(opt.state.rank) == 0
+    xs = np.asarray(opt.state.pos)[mask]
+    assert (xs[:, 0] >= 0.3 - 1e-3).all()
+
+
+def test_nsga2_equality_constraint_with_tolerance():
+    from distributed_swarm_algorithm_tpu.models.nsga2 import NSGA2
+
+    # ZDT1 with x0 == 0.5: the front collapses toward the single
+    # attainable point (0.5, ~1 - sqrt(0.5)).  The feasibility band
+    # (FEAS_TOL) keeps ranking from degenerating to violation-only
+    # ordering even though |h| is never exactly zero in float32.
+    opt = NSGA2(
+        "zdt1", n=100, dim=6, seed=0,
+        equalities=[lambda x: x[:, 0] - 0.5],
+    )
+    opt.run(200)
+    pos = np.asarray(opt.state.pos)
+    assert abs(float(np.median(pos[:, 0])) - 0.5) < 0.02
+    front = opt.pareto_front()
+    assert abs(front[:, 0].min() - 0.5) < 0.02
+    # Some individuals actually inside the feasibility band.
+    assert (np.asarray(opt.state.viol) <= 1e-4).any()
+
+
+def test_hypervolume_excludes_infeasible_points():
+    from distributed_swarm_algorithm_tpu.ops.nsga2 import hypervolume_2d
+
+    objs = jnp.asarray([[0.1, 0.1], [0.5, 0.5]])
+    viol = jnp.asarray([1.0, 0.0])     # the dominating point is infeasible
+    ref = jnp.asarray([1.0, 1.0])
+    hv_all = float(hypervolume_2d(objs, ref))
+    hv_feas = float(hypervolume_2d(objs, ref, viol))
+    assert hv_all == pytest.approx(0.81, abs=1e-6)
+    assert hv_feas == pytest.approx(0.25, abs=1e-6)

@@ -25,6 +25,8 @@ reference could not actually run:
   nsga2   NSGA-II multi-objective search on a ZDT problem
   ga      real-coded genetic algorithm on a benchmark objective
   pt      parallel tempering (replica exchange) on a benchmark objective
+  es      OpenAI-style evolution strategy on a benchmark objective
+  mapelites  MAP-Elites quality-diversity archive on a benchmark objective
   bench   the headline benchmark (same as bench.py)
 
 ``python -m distributed_swarm_algorithm_tpu --id 1 --count 3 --caps lift``
@@ -508,6 +510,25 @@ def _cmd_pt(args) -> int:
     return _run_report(opt, args, "chains")
 
 
+def _cmd_es(args) -> int:
+    from .models.es import ES
+
+    opt = ES(args.objective, n=args.n, dim=args.dim, seed=args.seed)
+    return _run_report(opt, args, "samples")
+
+
+def _cmd_mapelites(args) -> int:
+    from .models.map_elites import MAPElites
+
+    opt = MAPElites(args.objective, dim=args.dim, bins=args.bins,
+                    batch=args.n, seed=args.seed)
+    return _run_report(
+        opt, args, "batch",
+        extra={"bins": args.bins,
+               "coverage": lambda: round(opt.coverage, 4)},
+    )
+
+
 def _cmd_nsga2(args) -> int:
     import time as _time
 
@@ -759,6 +780,24 @@ def build_parser() -> argparse.ArgumentParser:
     p_pt.add_argument("--seed", type=int, default=0)
     p_pt.set_defaults(fn=_cmd_pt)
 
+    p_es = sub.add_parser("es", help="OpenAI-style evolution strategy")
+    p_es.add_argument("--objective", default="rastrigin")
+    p_es.add_argument("--n", type=int, default=256)
+    p_es.add_argument("--dim", type=int, default=30)
+    p_es.add_argument("--steps", type=int, default=500)
+    p_es.add_argument("--seed", type=int, default=0)
+    p_es.set_defaults(fn=_cmd_es)
+
+    p_me = sub.add_parser("mapelites", help="MAP-Elites quality-diversity")
+    p_me.add_argument("--objective", default="rastrigin")
+    p_me.add_argument("--n", type=int, default=256,
+                      help="mutation batch per generation")
+    p_me.add_argument("--dim", type=int, default=6)
+    p_me.add_argument("--bins", type=int, default=16)
+    p_me.add_argument("--steps", type=int, default=300)
+    p_me.add_argument("--seed", type=int, default=0)
+    p_me.set_defaults(fn=_cmd_mapelites)
+
     p_nsga2 = sub.add_parser("nsga2", help="NSGA-II multi-objective")
     p_nsga2.add_argument("--problem", default="zdt1",
                          choices=["zdt1", "zdt2", "zdt3"])
@@ -775,7 +814,8 @@ def build_parser() -> argparse.ArgumentParser:
     # subcommand (utils/history.py; see _run_report).
     for name in (
         "pso", "de", "cmaes", "abc", "gwo", "firefly", "cuckoo", "woa",
-        "bat", "salp", "mfo", "hho", "ga", "pt", "aco",
+        "bat", "salp", "mfo", "hho", "ga", "pt", "aco", "es",
+        "mapelites",
     ):
         sp = sub.choices[name]
         sp.add_argument("--history", metavar="FILE", default=None,

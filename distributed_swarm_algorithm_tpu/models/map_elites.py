@@ -1,0 +1,110 @@
+"""User-facing MAP-Elites model."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+import numpy as np
+
+from ..ops import map_elites as _k
+from ..ops.objectives import get_objective
+from ._checkpoint import CheckpointMixin
+
+
+class MAPElites(CheckpointMixin):
+    """MAP-Elites quality-diversity search (Mouret & Clune 2015).
+
+    ``descriptor`` maps solutions [K, D] -> behaviors [K, B] expected in
+    [lo, hi]; the archive is a ``bins**B`` grid keeping the best
+    solution per behavior cell.  The default descriptor is the first
+    two solution coordinates normalized to [0, 1].
+
+    >>> opt = MAPElites("rastrigin", dim=6, bins=16, seed=0)
+    >>> opt.run(200)
+    >>> opt.coverage, opt.best  # doctest: +SKIP
+    """
+
+    def __init__(
+        self,
+        objective: Union[str, Callable],
+        dim: int,
+        bins: int = 16,
+        descriptor: Optional[Callable] = None,
+        behavior_dims: int = 2,
+        half_width: Optional[float] = None,
+        lo: float = 0.0,
+        hi: float = 1.0,
+        batch: int = 256,
+        sigma_mut: float = _k.SIGMA_MUT,
+        n_init: int = 256,
+        seed: int = 0,
+        dtype=None,
+    ):
+        if isinstance(objective, str):
+            fn, default_hw = get_objective(objective)
+        else:
+            fn, default_hw = objective, 5.12
+        self.objective = fn
+        self.half_width = float(
+            half_width if half_width is not None else default_hw
+        )
+        if bins < 1:
+            raise ValueError(f"bins ({bins}) must be >= 1")
+        if descriptor is None:
+            if dim < behavior_dims:
+                raise ValueError(
+                    f"default descriptor needs dim >= {behavior_dims}"
+                )
+            hw = self.half_width
+            nb = behavior_dims
+
+            def descriptor(x):
+                return (x[:, :nb] + hw) / (2.0 * hw)
+
+        self.descriptor = descriptor
+        self.bins = int(bins)
+        self.behavior_dims = int(behavior_dims)
+        self.lo, self.hi = float(lo), float(hi)
+        self.batch = int(batch)
+        self.sigma_mut = float(sigma_mut)
+        kwargs = {} if dtype is None else {"dtype": dtype}
+        self.state = _k.me_init(
+            fn, self.descriptor, dim, self.bins, self.behavior_dims,
+            self.half_width, self.lo, self.hi, n_init=n_init, seed=seed,
+            **kwargs,
+        )
+
+    def step(self) -> _k.MapElitesState:
+        self.state = _k.me_step(
+            self.state, self.objective, self.descriptor, self.bins,
+            self.half_width, self.lo, self.hi, self.batch,
+            self.sigma_mut,
+        )
+        return self.state
+
+    def run(self, n_steps: int) -> _k.MapElitesState:
+        self.state = _k.me_run(
+            self.state, self.objective, self.descriptor, n_steps,
+            self.bins, self.half_width, self.lo, self.hi, self.batch,
+            self.sigma_mut,
+        )
+        jax.block_until_ready(self.state.archive_fit)
+        return self.state
+
+    @property
+    def best(self) -> float:
+        return float(jax.numpy.min(self.state.archive_fit))
+
+    @property
+    def coverage(self) -> float:
+        return float(_k.coverage(self.state))
+
+    def qd_score(self, offset: float = 0.0) -> float:
+        return float(_k.qd_score(self.state, offset))
+
+    def elites(self) -> tuple:
+        """(positions [K, D], fitnesses [K]) of the filled cells."""
+        fit = np.asarray(self.state.archive_fit)
+        mask = np.isfinite(fit)
+        return np.asarray(self.state.archive_pos)[mask], fit[mask]

@@ -77,6 +77,29 @@ class NSGA2(CheckpointMixin):
             violation_fn=self.violation_fn, **kwargs
         )
 
+    def load(self, path: str) -> None:
+        """Restore a checkpoint; pre-``viol`` checkpoints (saved before
+        constrained-domination support, 6 leaves) are migrated by
+        positional mapping with a zero-filled violation vector."""
+        from ..utils import checkpoint as _ckpt
+
+        try:
+            self.state = _ckpt.restore(path, self.state)
+            return
+        except KeyError:
+            pass  # legacy .npz layout without viol — migrate below
+        import jax.numpy as jnp
+        import numpy as np
+
+        data = np.load(path if path.endswith(".npz") else path + ".npz")
+        legacy = [jnp.asarray(data[f"leaf_{i}"]) for i in range(6)]
+        pos, objs, rank, crowd, key, iteration = legacy
+        self.state = self.state.replace(
+            pos=pos, objs=objs, rank=rank, crowd=crowd, key=key,
+            iteration=iteration,
+            viol=jnp.zeros(objs.shape[:1], objs.dtype),
+        )
+
     def step(self) -> _k.NSGA2State:
         self.state = _k.nsga2_step(
             self.state, self.objective, self.lb, self.ub, self.eta_c,

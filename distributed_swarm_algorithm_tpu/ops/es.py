@@ -1,0 +1,162 @@
+"""OpenAI-style evolution-strategy kernels (Salimans et al. 2017),
+TPU-vectorized.
+
+Part of the swarm-intelligence toolkit (the reference has no optimizer —
+its only "fitness" is the task utility at
+/root/reference/agent.py:338-347).  ES is the estimation-of-gradient
+member of the zoo: instead of carrying a population, it carries a single
+search *distribution* (mean + isotropic sigma) and each generation
+estimates the fitness gradient from antithetic Gaussian perturbations —
+the approach evosax and the population-based-RL literature build on.
+
+TPU shape: one generation is a single [n/2, D] normal draw expanded to
+antithetic pairs, one batched objective evaluation of the [n, D]
+population, a rank-shaping sort, and one matvec-like reduction
+``g = shaped^T @ eps / (n*sigma)`` — MXU/VPU-friendly with no
+per-sample control flow.
+
+Details kept from the reference implementation lineage:
+  - antithetic (mirrored) sampling halves the draw count and removes
+    the gradient-estimate bias from any odd moment;
+  - centered-rank fitness shaping in [-0.5, 0.5] makes the update
+    invariant to monotone fitness transforms (and outlier-robust);
+  - SGD with momentum on the mean; sigma stays fixed (isotropic) — the
+    covariance-adaptive sibling is ops/cmaes.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+SIGMA = 0.1          # perturbation scale, in half_width units
+LR = 0.05            # mean learning rate, in half_width units
+MOMENTUM = 0.9
+
+
+@struct.dataclass
+class ESState:
+    """Search-distribution state. D dims (population is per-generation)."""
+
+    mean: jax.Array       # [D]
+    mom: jax.Array        # [D] momentum buffer
+    best_pos: jax.Array   # [D]
+    best_fit: jax.Array   # scalar
+    key: jax.Array
+    iteration: jax.Array  # i32 scalar
+
+
+def es_init(
+    objective: Callable,
+    dim: int,
+    half_width: float,
+    seed: int = 0,
+    dtype=jnp.float32,
+) -> ESState:
+    key = jax.random.PRNGKey(seed)
+    key, km = jax.random.split(key)
+    mean = jax.random.uniform(
+        km, (dim,), dtype, minval=-half_width, maxval=half_width
+    )
+    fit = objective(mean[None, :])[0]
+    return ESState(
+        mean=mean,
+        mom=jnp.zeros((dim,), dtype),
+        best_pos=mean,
+        best_fit=fit,
+        key=key,
+        iteration=jnp.asarray(0, jnp.int32),
+    )
+
+
+def centered_ranks(fit: jax.Array) -> jax.Array:
+    """[n] centered-rank shaping in [-0.5, 0.5]; smaller fitness
+    (better, minimization) gets the most negative value."""
+    n = fit.shape[0]
+    order = jnp.argsort(fit)
+    ranks = jnp.zeros((n,), fit.dtype).at[order].set(
+        jnp.arange(n, dtype=fit.dtype)
+    )
+    return ranks / (n - 1) - 0.5
+
+
+@partial(
+    jax.jit,
+    static_argnames=("objective", "n", "half_width", "sigma", "lr",
+                     "momentum"),
+)
+def es_step(
+    state: ESState,
+    objective: Callable,
+    n: int = 256,
+    half_width: float = 5.12,
+    sigma: float = SIGMA,
+    lr: float = LR,
+    momentum: float = MOMENTUM,
+) -> ESState:
+    """One generation: antithetic sampling, centered-rank shaping,
+    momentum-SGD step on the mean (``n`` must be even)."""
+    d = state.mean.shape[0]
+    dt = state.mean.dtype
+    key, kd = jax.random.split(state.key)
+    half = n // 2
+    s = sigma * half_width
+
+    eps_half = jax.random.normal(kd, (half, d), dt)
+    eps = jnp.concatenate([eps_half, -eps_half], axis=0)    # [n, D]
+    pop = jnp.clip(state.mean + s * eps, -half_width, half_width)
+    fit = objective(pop)                                    # [n]
+
+    # Gradient estimate of E[f]: descend it (minimization), so the most
+    # negative shaped weights (the best samples) pull the mean toward
+    # their perturbations.
+    shaped = centered_ranks(fit)                            # [n]
+    grad = (shaped @ eps) / (n * s)                         # [D]
+    mom = momentum * state.mom - lr * half_width * grad
+    mean = jnp.clip(state.mean + mom, -half_width, half_width)
+
+    b = jnp.argmin(fit)
+    cand_fit, cand_pos = fit[b], pop[b]
+    mean_fit = objective(mean[None, :])[0]
+    better_mean = mean_fit < cand_fit
+    cand_fit = jnp.where(better_mean, mean_fit, cand_fit)
+    cand_pos = jnp.where(better_mean, mean, cand_pos)
+    improved = cand_fit < state.best_fit
+    return ESState(
+        mean=mean,
+        mom=mom,
+        best_pos=jnp.where(improved, cand_pos, state.best_pos),
+        best_fit=jnp.where(improved, cand_fit, state.best_fit),
+        key=key,
+        iteration=state.iteration + 1,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective", "n_steps", "n", "half_width", "sigma", "lr",
+        "momentum",
+    ),
+)
+def es_run(
+    state: ESState,
+    objective: Callable,
+    n_steps: int,
+    n: int = 256,
+    half_width: float = 5.12,
+    sigma: float = SIGMA,
+    lr: float = LR,
+    momentum: float = MOMENTUM,
+) -> ESState:
+    def body(s, _):
+        return es_step(
+            s, objective, n, half_width, sigma, lr, momentum
+        ), None
+
+    state, _ = jax.lax.scan(body, state, None, length=n_steps)
+    return state

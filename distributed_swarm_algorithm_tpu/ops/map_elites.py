@@ -1,0 +1,212 @@
+"""MAP-Elites quality-diversity kernels (Mouret & Clune 2015),
+TPU-vectorized.
+
+Part of the swarm-intelligence toolkit (the reference has no optimizer —
+its only "fitness" is the task utility at
+/root/reference/agent.py:338-347).  MAP-Elites is the
+*quality-diversity* member of the zoo: instead of one best solution it
+illuminates a whole behavior space — a grid of cells over a
+user-supplied behavior descriptor, each holding the best ("elite")
+solution ever seen with that behavior.  The output is an archive of
+diverse, locally-optimal solutions, the standard tool for
+swarm-robotics repertoire learning.
+
+TPU shape: the archive is a dense ``[cells, D]`` array (empty cells
+masked by +inf fitness); one generation is a batched parent gather
+(uniform over filled cells via Gumbel-argmax over the filled mask),
+batched Gaussian mutation, one objective + descriptor evaluation, and a
+``segment_min`` scatter insert — same deterministic lowest-row
+tie-break idiom as the auction and ABC kernels.  No dynamic shapes:
+coverage lives in the mask, not the array size.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+SIGMA_MUT = 0.1   # Gaussian mutation scale, in half_width units
+_BIG = jnp.inf
+
+
+@struct.dataclass
+class MapElitesState:
+    """Dense elite archive. C = bins**B cells, D solution dims."""
+
+    archive_pos: jax.Array   # [C, D]
+    archive_fit: jax.Array   # [C]; +inf = empty cell
+    key: jax.Array
+    iteration: jax.Array     # i32 scalar
+
+
+def cell_index(
+    desc: jax.Array, bins: int, lo: float, hi: float
+) -> jax.Array:
+    """[K] flat cell index from [K, B] behavior descriptors expected in
+    [lo, hi] (out-of-range descriptors clamp to the boundary cells)."""
+    k, b = desc.shape
+    frac = (desc - lo) / (hi - lo)
+    idx = jnp.clip(
+        jnp.floor(frac * bins).astype(jnp.int32), 0, bins - 1
+    )                                          # [K, B]
+    flat = jnp.zeros((k,), jnp.int32)
+    for j in range(b):
+        flat = flat * bins + idx[:, j]
+    return flat
+
+
+def insert(
+    archive_pos: jax.Array,
+    archive_fit: jax.Array,
+    pos: jax.Array,
+    fit: jax.Array,
+    cells: jax.Array,
+):
+    """Batched elitist insert: per cell, keep the best of (incumbent,
+    candidates); candidate ties break to the lowest batch row.  Returns
+    the updated (archive_pos, archive_fit)."""
+    c = archive_fit.shape[0]
+    k = fit.shape[0]
+    best = jax.ops.segment_min(fit, cells, num_segments=c)      # [C]
+    at_best = fit <= best[cells]
+    row = jax.ops.segment_min(
+        jnp.where(at_best, jnp.arange(k), k), cells, num_segments=c
+    )                                                           # [C]
+    has_cand = row < k
+    row_safe = jnp.minimum(row, k - 1)
+    better = has_cand & (best < archive_fit)
+    new_fit = jnp.where(better, best, archive_fit)
+    new_pos = jnp.where(better[:, None], pos[row_safe], archive_pos)
+    return new_pos, new_fit
+
+
+def me_init(
+    objective: Callable,
+    descriptor: Callable,
+    dim: int,
+    bins: int,
+    behavior_dims: int,
+    half_width: float,
+    lo: float = 0.0,
+    hi: float = 1.0,
+    n_init: int = 256,
+    seed: int = 0,
+    dtype=jnp.float32,
+) -> MapElitesState:
+    """Seed the archive with ``n_init`` uniform random solutions."""
+    c = bins**behavior_dims
+    key = jax.random.PRNGKey(seed)
+    key, kp = jax.random.split(key)
+    pos = jax.random.uniform(
+        kp, (n_init, dim), dtype, minval=-half_width, maxval=half_width
+    )
+    fit = objective(pos)
+    cells = cell_index(descriptor(pos), bins, lo, hi)
+    a_pos, a_fit = insert(
+        jnp.zeros((c, dim), dtype), jnp.full((c,), _BIG, dtype),
+        pos, fit, cells,
+    )
+    return MapElitesState(
+        archive_pos=a_pos,
+        archive_fit=a_fit,
+        key=key,
+        iteration=jnp.asarray(0, jnp.int32),
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective", "descriptor", "bins", "half_width", "lo", "hi",
+        "batch", "sigma_mut",
+    ),
+)
+def me_step(
+    state: MapElitesState,
+    objective: Callable,
+    descriptor: Callable,
+    bins: int,
+    half_width: float = 5.12,
+    lo: float = 0.0,
+    hi: float = 1.0,
+    batch: int = 256,
+    sigma_mut: float = SIGMA_MUT,
+) -> MapElitesState:
+    """One generation: sample parents uniformly from the filled cells,
+    Gaussian-mutate, evaluate, elitist-insert."""
+    c, d = state.archive_pos.shape
+    dt = state.archive_pos.dtype
+    key, kg, km = jax.random.split(state.key, 3)
+
+    # Uniform choice among filled cells, batched: Gumbel-argmax over
+    # log(filled) is an exact uniform categorical per batch row.
+    filled = jnp.isfinite(state.archive_fit)                # [C]
+    logits = jnp.where(filled, 0.0, -jnp.inf)
+    gumbel = jax.random.gumbel(kg, (batch, c), dt)
+    parents = jnp.argmax(logits[None, :] + gumbel, axis=1)  # [batch]
+    parent_pos = state.archive_pos[parents]
+
+    children = parent_pos + sigma_mut * half_width * jax.random.normal(
+        km, (batch, d), dt
+    )
+    children = jnp.clip(children, -half_width, half_width)
+    fit = objective(children)
+    cells = cell_index(descriptor(children), bins, lo, hi)
+    a_pos, a_fit = insert(
+        state.archive_pos, state.archive_fit, children, fit, cells
+    )
+    return MapElitesState(
+        archive_pos=a_pos,
+        archive_fit=a_fit,
+        key=key,
+        iteration=state.iteration + 1,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective", "descriptor", "n_steps", "bins", "half_width",
+        "lo", "hi", "batch", "sigma_mut",
+    ),
+)
+def me_run(
+    state: MapElitesState,
+    objective: Callable,
+    descriptor: Callable,
+    n_steps: int,
+    bins: int,
+    half_width: float = 5.12,
+    lo: float = 0.0,
+    hi: float = 1.0,
+    batch: int = 256,
+    sigma_mut: float = SIGMA_MUT,
+) -> MapElitesState:
+    def body(s, _):
+        return me_step(
+            s, objective, descriptor, bins, half_width, lo, hi, batch,
+            sigma_mut,
+        ), None
+
+    state, _ = jax.lax.scan(body, state, None, length=n_steps)
+    return state
+
+
+def coverage(state: MapElitesState) -> jax.Array:
+    """Fraction of cells holding an elite (scalar in [0, 1])."""
+    return jnp.mean(jnp.isfinite(state.archive_fit).astype(jnp.float32))
+
+
+def qd_score(state: MapElitesState, offset: float = 0.0) -> jax.Array:
+    """Sum of (offset - fitness) over filled cells — the standard
+    quality-diversity score for minimization problems (choose ``offset``
+    >= the worst plausible fitness so every elite contributes
+    positively)."""
+    filled = jnp.isfinite(state.archive_fit)
+    return jnp.sum(
+        jnp.where(filled, offset - state.archive_fit, 0.0)
+    )

@@ -17,6 +17,7 @@ def main():
     from distributed_swarm_algorithm_tpu.models.cmaes import CMAES
     from distributed_swarm_algorithm_tpu.models.cuckoo import Cuckoo
     from distributed_swarm_algorithm_tpu.models.de import DE
+    from distributed_swarm_algorithm_tpu.models.es import ES
     from distributed_swarm_algorithm_tpu.models.firefly import Firefly
     from distributed_swarm_algorithm_tpu.models.ga import GA
     from distributed_swarm_algorithm_tpu.models.gwo import GWO
@@ -39,6 +40,7 @@ def main():
                                           refine_every=20)),
         ("DE", lambda: DE(problem, n=n, dim=dim, seed=0)),
         ("CMA-ES", lambda: CMAES(problem, dim=dim, n=64, seed=0)),
+        ("ES", lambda: ES(problem, n=n, dim=dim, seed=0)),
         ("ABC", lambda: ABC(problem, n=n, dim=dim, seed=0)),
         ("GWO", lambda: GWO(problem, n=n, dim=dim, t_max=steps, seed=0)),
         ("WOA", lambda: WOA(problem, n=n, dim=dim, t_max=steps, seed=0)),

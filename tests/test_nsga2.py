@@ -221,3 +221,30 @@ def test_hypervolume_excludes_infeasible_points():
     hv_feas = float(hypervolume_2d(objs, ref, viol))
     assert hv_all == pytest.approx(0.81, abs=1e-6)
     assert hv_feas == pytest.approx(0.25, abs=1e-6)
+
+
+def test_nsga2_loads_pre_viol_checkpoints(tmp_path):
+    # Migration: checkpoints saved before the viol field existed (6
+    # positional leaves) restore with a zero-filled violation vector.
+    import jax
+    from distributed_swarm_algorithm_tpu.models.nsga2 import NSGA2
+
+    a = NSGA2("zdt1", n=32, dim=4, seed=3)
+    a.run(10)
+    legacy = {}
+    leaves = [
+        a.state.pos, a.state.objs, a.state.rank, a.state.crowd,
+        a.state.key, a.state.iteration,
+    ]
+    for i, leaf in enumerate(leaves):
+        legacy[f"leaf_{i}"] = np.asarray(leaf)
+    p = str(tmp_path / "legacy.npz")
+    np.savez(p, **legacy)
+
+    fresh = NSGA2("zdt1", n=32, dim=4, seed=99)
+    fresh.load(p)
+    np.testing.assert_array_equal(
+        np.asarray(fresh.state.objs), np.asarray(a.state.objs)
+    )
+    np.testing.assert_allclose(np.asarray(fresh.state.viol), 0.0)
+    del jax

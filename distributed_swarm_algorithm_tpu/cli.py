@@ -26,6 +26,7 @@ reference could not actually run:
   ga      real-coded genetic algorithm on a benchmark objective
   pt      parallel tempering (replica exchange) on a benchmark objective
   es      OpenAI-style evolution strategy on a benchmark objective
+  shade   success-history adaptive DE on a benchmark objective
   mapelites  MAP-Elites quality-diversity archive on a benchmark objective
   bench   the headline benchmark (same as bench.py)
 
@@ -517,6 +518,13 @@ def _cmd_es(args) -> int:
     return _run_report(opt, args, "samples")
 
 
+def _cmd_shade(args) -> int:
+    from .models.shade import SHADE
+
+    opt = SHADE(args.objective, n=args.n, dim=args.dim, seed=args.seed)
+    return _run_report(opt, args, "individuals")
+
+
 def _cmd_mapelites(args) -> int:
     from .models.map_elites import MAPElites
 
@@ -788,6 +796,14 @@ def build_parser() -> argparse.ArgumentParser:
     p_es.add_argument("--seed", type=int, default=0)
     p_es.set_defaults(fn=_cmd_es)
 
+    p_shade = sub.add_parser("shade", help="success-history adaptive DE")
+    p_shade.add_argument("--objective", default="rastrigin")
+    p_shade.add_argument("--n", type=int, default=256)
+    p_shade.add_argument("--dim", type=int, default=30)
+    p_shade.add_argument("--steps", type=int, default=500)
+    p_shade.add_argument("--seed", type=int, default=0)
+    p_shade.set_defaults(fn=_cmd_shade)
+
     p_me = sub.add_parser("mapelites", help="MAP-Elites quality-diversity")
     p_me.add_argument("--objective", default="rastrigin")
     p_me.add_argument("--n", type=int, default=256,
@@ -815,7 +831,7 @@ def build_parser() -> argparse.ArgumentParser:
     for name in (
         "pso", "de", "cmaes", "abc", "gwo", "firefly", "cuckoo", "woa",
         "bat", "salp", "mfo", "hho", "ga", "pt", "aco", "es",
-        "mapelites",
+        "mapelites", "shade",
     ):
         sp = sub.choices[name]
         sp.add_argument("--history", metavar="FILE", default=None,

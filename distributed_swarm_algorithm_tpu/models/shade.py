@@ -1,0 +1,67 @@
+"""User-facing SHADE model."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+
+from ..ops import shade as _k
+from ..ops.objectives import get_objective
+from ._checkpoint import CheckpointMixin
+
+
+class SHADE(CheckpointMixin):
+    """Success-history adaptive DE (Tanabe & Fukunaga 2013): F/CR are
+    sampled around a circular memory of recently-successful settings,
+    mutation is current-to-pbest/1 with an external archive of defeated
+    parents — the self-tuning member of the DE lineage.
+
+    >>> opt = SHADE("rastrigin", n=256, dim=10, seed=0)
+    >>> opt.run(300)
+    >>> opt.best  # doctest: +SKIP
+    """
+
+    def __init__(
+        self,
+        objective: Union[str, Callable],
+        n: int,
+        dim: int,
+        half_width: Optional[float] = None,
+        p_best: float = _k.P_BEST,
+        seed: int = 0,
+        dtype=None,
+    ):
+        if isinstance(objective, str):
+            fn, default_hw = get_objective(objective)
+        else:
+            fn, default_hw = objective, 5.12
+        self.objective = fn
+        self.half_width = float(
+            half_width if half_width is not None else default_hw
+        )
+        if not 0.0 < p_best <= 1.0:
+            raise ValueError(f"p_best ({p_best}) must be in (0, 1]")
+        self.p_best = float(p_best)
+        kwargs = {} if dtype is None else {"dtype": dtype}
+        self.state = _k.shade_init(
+            fn, n, dim, self.half_width, seed=seed, **kwargs
+        )
+
+    def step(self) -> _k.SHADEState:
+        self.state = _k.shade_step(
+            self.state, self.objective, self.half_width, self.p_best
+        )
+        return self.state
+
+    def run(self, n_steps: int) -> _k.SHADEState:
+        self.state = _k.shade_run(
+            self.state, self.objective, n_steps, self.half_width,
+            self.p_best,
+        )
+        jax.block_until_ready(self.state.best_fit)
+        return self.state
+
+    @property
+    def best(self) -> float:
+        return float(self.state.best_fit)

@@ -1,0 +1,221 @@
+"""SHADE kernels (success-history adaptive DE, Tanabe & Fukunaga 2013),
+TPU-vectorized.
+
+Part of the swarm-intelligence toolkit (the reference has no optimizer —
+its only "fitness" is the task utility at
+/root/reference/agent.py:338-347).  SHADE is the self-tuning member of
+the DE lineage (ops/de.py): instead of fixed F/CR it keeps a circular
+*success memory* of parameter settings that recently produced improving
+trials, samples each individual's F (Cauchy) and CR (Normal) around a
+random memory slot, mutates with current-to-pbest/1 against an external
+archive of defeated parents, and updates the memory with
+improvement-weighted Lehmer means.
+
+TPU shape: everything is batched — per-individual parameter draws,
+the top-p pbest gather, the archive-aware donor sampling, and the
+scatter insert of defeated parents into the fixed-size archive (first
+fill in order, then random replacement; overflow collisions last-write-
+win, which IS random replacement).  No per-individual control flow.
+
+Documented deltas from the paper, all bounded:
+  - F is one truncated-Cauchy draw (clip to (0, 1] with a floor at
+    0.01) instead of resample-until-positive — same support, slightly
+    different density near 0;
+  - donor distinctness (r1 != r2 != i) uses two mod-shift fixups
+    instead of rejection loops — a residual collision is possible with
+    probability O(1/(N+|A|)^2) and merely weakens one donor vector.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+H = 10          # success-memory size
+P_BEST = 0.11   # pbest fraction
+F_SCALE = 0.1   # Cauchy scale for F
+CR_SCALE = 0.1  # Normal scale for CR
+
+
+@struct.dataclass
+class SHADEState:
+    """Struct-of-arrays SHADE population. N individuals, D dims."""
+
+    pos: jax.Array          # [N, D]
+    fit: jax.Array          # [N]
+    best_pos: jax.Array     # [D]
+    best_fit: jax.Array     # scalar
+    m_f: jax.Array          # [H] success memory for F
+    m_cr: jax.Array         # [H] success memory for CR
+    mem_k: jax.Array        # i32 scalar — next memory slot to update
+    archive: jax.Array      # [N, D] defeated parents
+    archive_n: jax.Array    # i32 scalar — valid archive rows
+    key: jax.Array
+    iteration: jax.Array    # i32 scalar
+
+
+def shade_init(
+    objective: Callable,
+    n: int,
+    dim: int,
+    half_width: float,
+    seed: int = 0,
+    dtype=jnp.float32,
+) -> SHADEState:
+    if n < 5:
+        raise ValueError("SHADE needs a population of at least 5")
+    key = jax.random.PRNGKey(seed)
+    key, kp = jax.random.split(key)
+    pos = jax.random.uniform(
+        kp, (n, dim), dtype, minval=-half_width, maxval=half_width
+    )
+    fit = objective(pos)
+    b = jnp.argmin(fit)
+    return SHADEState(
+        pos=pos,
+        fit=fit,
+        best_pos=pos[b],
+        best_fit=fit[b],
+        m_f=jnp.full((H,), 0.5, dtype),
+        m_cr=jnp.full((H,), 0.5, dtype),
+        mem_k=jnp.asarray(0, jnp.int32),
+        archive=jnp.zeros((n, dim), dtype),
+        archive_n=jnp.asarray(0, jnp.int32),
+        key=key,
+        iteration=jnp.asarray(0, jnp.int32),
+    )
+
+
+def _mod_distinct(r, forbidden, size):
+    """Shift ``r`` by one (mod size) where it collides with ``forbidden``."""
+    return jnp.where(r == forbidden, (r + 1) % size, r)
+
+
+@partial(jax.jit, static_argnames=("objective", "half_width", "p_best"))
+def shade_step(
+    state: SHADEState,
+    objective: Callable,
+    half_width: float = 5.12,
+    p_best: float = P_BEST,
+) -> SHADEState:
+    """One SHADE generation: memory-sampled F/CR, current-to-pbest/1
+    with archive, greedy selection, archive + memory updates."""
+    n, d = state.pos.shape
+    dt = state.pos.dtype
+    (key, k_mem, k_f, k_cr, k_pb, k_r1, k_r2, k_cross, k_jr,
+     k_slot) = jax.random.split(state.key, 10)
+
+    # --- per-individual parameters from the success memory ------------
+    slot = jax.random.randint(k_mem, (n,), 0, H)
+    mf = state.m_f[slot]
+    mcr = state.m_cr[slot]
+    f = mf + F_SCALE * jax.random.cauchy(k_f, (n,), dt)
+    f = jnp.clip(f, 0.01, 1.0)[:, None]                 # truncated draw
+    cr = jnp.clip(
+        mcr + CR_SCALE * jax.random.normal(k_cr, (n,), dt), 0.0, 1.0
+    )
+
+    # --- current-to-pbest/1 with external archive ---------------------
+    n_top = max(2, int(round(p_best * n)))
+    _, top_idx = jax.lax.top_k(-state.fit, n_top)       # best rows
+    pb = top_idx[jax.random.randint(k_pb, (n,), 0, n_top)]
+    rows = jnp.arange(n)
+    r1 = jax.random.randint(k_r1, (n,), 0, n)
+    r1 = _mod_distinct(r1, rows, n)
+    pool = n + state.archive_n                          # pop ++ archive
+    r2 = jax.random.randint(k_r2, (n,), 0, pool)
+    r2 = _mod_distinct(_mod_distinct(r2, rows, pool), r1, pool)
+    from_archive = r2 >= n
+    x_r2 = jnp.where(
+        from_archive[:, None],
+        state.archive[jnp.clip(r2 - n, 0, n - 1)],
+        state.pos[jnp.clip(r2, 0, n - 1)],
+    )
+    x_pb = state.pos[pb]
+    x_r1 = state.pos[r1]
+    mutant = (
+        state.pos
+        + f * (x_pb - state.pos)
+        + f * (x_r1 - x_r2)
+    )
+    mutant = jnp.clip(mutant, -half_width, half_width)
+
+    r = jax.random.uniform(k_cross, (n, d), dt)
+    j_rand = jax.random.randint(k_jr, (n,), 0, d)
+    cross = (r < cr[:, None]) | (jnp.arange(d)[None, :] == j_rand[:, None])
+    trial = jnp.where(cross, mutant, state.pos)
+    trial_fit = objective(trial)
+
+    better = trial_fit < state.fit                      # strict: success
+    accept = trial_fit <= state.fit
+    pos = jnp.where(accept[:, None], trial, state.pos)
+    fit = jnp.where(accept, trial_fit, state.fit)
+
+    # --- archive: defeated parents in, fill-then-random-replace -------
+    cum = jnp.cumsum(better) - 1                        # [N] success ordinal
+    seq_slot = state.archive_n + cum
+    rand_slot = jax.random.randint(k_slot, (n,), 0, n)
+    a_slot = jnp.where(seq_slot < n, seq_slot, rand_slot)
+    a_slot = jnp.where(better, a_slot, n)               # drop non-success
+    archive = state.archive.at[a_slot].set(state.pos, mode="drop")
+    archive_n = jnp.minimum(state.archive_n + jnp.sum(better), n).astype(
+        jnp.int32
+    )
+
+    # --- success-memory update (improvement-weighted Lehmer means) ----
+    w = jnp.where(better, state.fit - trial_fit, 0.0)
+    w_sum = jnp.sum(w)
+    any_success = w_sum > 0.0
+    safe = jnp.where(any_success, w_sum, 1.0)
+    fs = f[:, 0]
+    new_mf = jnp.sum(w * fs * fs) / jnp.maximum(
+        jnp.sum(w * fs), 1e-12
+    )                                                   # Lehmer mean
+    new_mcr = jnp.sum(w * cr) / safe                    # arithmetic mean
+    m_f = jnp.where(
+        any_success, state.m_f.at[state.mem_k].set(new_mf), state.m_f
+    )
+    m_cr = jnp.where(
+        any_success, state.m_cr.at[state.mem_k].set(new_mcr), state.m_cr
+    )
+    mem_k = jnp.where(
+        any_success, (state.mem_k + 1) % H, state.mem_k
+    ).astype(jnp.int32)
+
+    b = jnp.argmin(fit)
+    improved = fit[b] < state.best_fit
+    return SHADEState(
+        pos=pos,
+        fit=fit,
+        best_pos=jnp.where(improved, pos[b], state.best_pos),
+        best_fit=jnp.where(improved, fit[b], state.best_fit),
+        m_f=m_f,
+        m_cr=m_cr,
+        mem_k=mem_k,
+        archive=archive,
+        archive_n=archive_n,
+        key=key,
+        iteration=state.iteration + 1,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("objective", "n_steps", "half_width", "p_best"),
+)
+def shade_run(
+    state: SHADEState,
+    objective: Callable,
+    n_steps: int,
+    half_width: float = 5.12,
+    p_best: float = P_BEST,
+) -> SHADEState:
+    def body(s, _):
+        return shade_step(s, objective, half_width, p_best), None
+
+    state, _ = jax.lax.scan(body, state, None, length=n_steps)
+    return state

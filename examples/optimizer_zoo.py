@@ -26,6 +26,7 @@ def main():
     from distributed_swarm_algorithm_tpu.models.mfo import MFO
     from distributed_swarm_algorithm_tpu.models.pso import PSO
     from distributed_swarm_algorithm_tpu.models.salp import Salp
+    from distributed_swarm_algorithm_tpu.models.shade import SHADE
     from distributed_swarm_algorithm_tpu.models.tempering import (
         ParallelTempering,
     )
@@ -39,6 +40,7 @@ def main():
         ("MemeticPSO", lambda: MemeticPSO(problem, n=n, dim=dim, seed=0,
                                           refine_every=20)),
         ("DE", lambda: DE(problem, n=n, dim=dim, seed=0)),
+        ("SHADE", lambda: SHADE(problem, n=n, dim=dim, seed=0)),
         ("CMA-ES", lambda: CMAES(problem, dim=dim, n=64, seed=0)),
         ("ES", lambda: ES(problem, n=n, dim=dim, seed=0)),
         ("ABC", lambda: ABC(problem, n=n, dim=dim, seed=0)),

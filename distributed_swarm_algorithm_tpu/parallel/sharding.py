@@ -442,6 +442,115 @@ def fused_bat_run_shmap(
     return rebuild_bat_state(state, *carry, n_steps)
 
 
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective", "mesh", "n_steps", "n", "axis", "half_width",
+        "sigma", "lr", "momentum",
+    ),
+)
+def es_run_shmap(
+    state,
+    objective,
+    mesh: Mesh,
+    n_steps: int,
+    n: int = 256,
+    axis: str = AGENT_AXIS,
+    half_width: float = 5.12,
+    sigma: float | None = None,
+    lr: float | None = None,
+    momentum: float | None = None,
+):
+    """Multi-chip OpenAI-ES — the canonical distributed-ES design
+    (Salimans et al. 2017) on ICI: every device draws its own antithetic
+    perturbation shard from a device-folded key and evaluates it
+    locally; the only cross-device traffic per generation is the
+    ``psum`` of the partial gradient estimate ``shaped^T @ eps`` plus
+    the best-sample exchange — O(D) bytes, independent of population
+    size.  Rank shaping needs the global fitness vector, so fitnesses
+    are ``all_gather``ed ([n] scalars — also tiny).
+
+    ``n`` is the GLOBAL population (must divide by mesh size, halves
+    antithetic per device).  Results match the single-chip ``es_run``
+    semantics (different RNG stream).
+    """
+    from ..ops.es import ESState, LR, MOMENTUM, SIGMA, centered_ranks
+
+    sigma = SIGMA if sigma is None else sigma
+    lr = LR if lr is None else lr
+    momentum = MOMENTUM if momentum is None else momentum
+    n_dev = mesh.shape[axis]
+    if n % (2 * n_dev):
+        raise ValueError(
+            f"global population n ({n}) must be a multiple of "
+            f"2 * devices ({2 * n_dev})"
+        )
+    n_loc = n // n_dev
+    d = state.mean.shape[0]
+    s = sigma * half_width
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P()),
+        out_specs=(P(), P(), P(), P(), P()),
+        check_vma=False,
+    )
+    def run(mean, mom, best_pos, best_fit, key):
+        dev = lax.axis_index(axis)
+
+        def step(carry, _):
+            mean, mom, best_pos, best_fit, key = carry
+            key, kd = jax.random.split(key)
+            kd = jax.random.fold_in(kd, dev)
+            eps_half = jax.random.normal(
+                kd, (n_loc // 2, d), mean.dtype
+            )
+            eps = jnp.concatenate([eps_half, -eps_half], axis=0)
+            pop = jnp.clip(mean + s * eps, -half_width, half_width)
+            fit = objective(pop)                        # [n_loc]
+
+            # Global centered ranks need every fitness; the gathered
+            # vector is n scalars — negligible next to the [n, D] work
+            # that stayed device-local.
+            all_fit = lax.all_gather(fit, axis)         # [n_dev, n_loc]
+            shaped_all = centered_ranks(all_fit.reshape(-1))
+            shaped = lax.dynamic_slice(
+                shaped_all, (dev * n_loc,), (n_loc,)
+            )
+            grad = lax.psum((shaped @ eps) / (n * s), axis)
+            mom = momentum * mom - lr * half_width * grad
+            mean = jnp.clip(mean + mom, -half_width, half_width)
+
+            b = jnp.argmin(fit)
+            best_fit, best_pos = _exchange_best(
+                fit[b], pop[b], best_fit, best_pos, dev, axis
+            )
+            mean_fit = objective(mean[None, :])[0]
+            better_mean = mean_fit < best_fit
+            best_fit = jnp.where(better_mean, mean_fit, best_fit)
+            best_pos = jnp.where(better_mean, mean, best_pos)
+            return (mean, mom, best_pos, best_fit, key), None
+
+        carry, _ = jax.lax.scan(
+            step, (mean, mom, best_pos, best_fit, key), None,
+            length=n_steps,
+        )
+        return carry
+
+    mean, mom, best_pos, best_fit, key = run(
+        state.mean, state.mom, state.best_pos, state.best_fit, state.key
+    )
+    return ESState(
+        mean=mean,
+        mom=mom,
+        best_pos=best_pos,
+        best_fit=best_fit,
+        key=key,
+        iteration=state.iteration + n_steps,
+    )
+
+
 def elect_shmap(
     alive: jax.Array,
     agent_id: jax.Array,

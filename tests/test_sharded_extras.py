@@ -95,3 +95,31 @@ def test_ga_and_tempering_ride_generic_islands():
         gfit, gpos = islands_global_best(stacked)
         assert np.isfinite(float(gfit))
         assert gpos.shape == (4,)
+
+
+def test_es_run_shmap_on_mesh():
+    # Distributed OpenAI-ES: perturbations and evaluations stay
+    # device-local; only the psum'd gradient estimate and the gathered
+    # fitness scalars cross the mesh.
+    import pytest
+
+    from distributed_swarm_algorithm_tpu.ops.es import es_init
+    from distributed_swarm_algorithm_tpu.ops.objectives import sphere
+    from distributed_swarm_algorithm_tpu.parallel.sharding import (
+        es_run_shmap,
+    )
+
+    mesh = make_mesh(("agents",))
+    st = es_init(sphere, 6, 5.12, seed=0)
+    init_best = float(st.best_fit)
+    out = es_run_shmap(st, sphere, mesh, 200, n=256)
+    assert float(out.best_fit) <= init_best
+    assert float(out.best_fit) < 1e-2
+    assert int(out.iteration) == 200
+    assert float(jnp.max(jnp.abs(out.mean))) <= 5.12 + 1e-6
+    # deterministic across calls
+    out2 = es_run_shmap(st, sphere, mesh, 200, n=256)
+    assert float(out2.best_fit) == float(out.best_fit)
+    with pytest.raises(ValueError):
+        # odd n can never be a multiple of 2*devices, on any mesh size
+        es_run_shmap(st, sphere, mesh, 10, n=101)

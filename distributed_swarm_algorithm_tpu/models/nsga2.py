@@ -51,8 +51,10 @@ class NSGA2(CheckpointMixin):
                     f"unknown multi-objective problem {objective!r}; "
                     f"have {sorted(_k.MOO_PROBLEMS)}"
                 ) from None
+            self.problem_name: str | None = objective
         else:
             fn = objective
+            self.problem_name = None
         if ub <= lb:
             raise ValueError(f"ub ({ub}) must be > lb ({lb})")
         self.objective = fn
@@ -115,6 +117,26 @@ class NSGA2(CheckpointMixin):
         )
         jax.block_until_ready(self.state.objs)
         return self.state
+
+    def igd(self, reference=None, k: int = 256) -> float:
+        """Inverted generational distance (lower = better convergence +
+        coverage).  ``reference`` is an explicit [R, M] reference front;
+        omitted, the analytic front of the named problem is used
+        (available for zdt1/zdt2)."""
+        import jax.numpy as jnp
+
+        if reference is None:
+            try:
+                reference = _k.MOO_FRONTS[self.problem_name](k)
+            except KeyError:
+                raise ValueError(
+                    "no analytic front for this problem; pass an "
+                    "explicit reference ([R, M] array)"
+                ) from None
+        return float(
+            _k.igd(self.state.objs, jnp.asarray(reference),
+                   self.state.viol)
+        )
 
     def pareto_front(self) -> np.ndarray:
         """[K, M] objective vectors of the current rank-0 individuals."""

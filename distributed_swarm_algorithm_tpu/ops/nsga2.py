@@ -371,6 +371,41 @@ def zdt3(pos: jax.Array) -> jax.Array:
 MOO_PROBLEMS = {"zdt1": zdt1, "zdt2": zdt2, "zdt3": zdt3}
 
 
+def zdt1_front(k: int = 256) -> jax.Array:
+    """[k, 2] points on the analytic ZDT1 Pareto front f2 = 1 - sqrt(f1)."""
+    f1 = jnp.linspace(0.0, 1.0, k)
+    return jnp.stack([f1, 1.0 - jnp.sqrt(f1)], axis=1)
+
+
+def zdt2_front(k: int = 256) -> jax.Array:
+    """[k, 2] points on the analytic ZDT2 Pareto front f2 = 1 - f1^2."""
+    f1 = jnp.linspace(0.0, 1.0, k)
+    return jnp.stack([f1, 1.0 - f1**2], axis=1)
+
+
+MOO_FRONTS = {"zdt1": zdt1_front, "zdt2": zdt2_front}
+
+
+def igd(
+    objs: jax.Array,
+    ref_front: jax.Array,
+    viol: jax.Array | None = None,
+) -> jax.Array:
+    """Inverted generational distance: mean over reference-front points
+    of the distance to the nearest attained (rank-0, feasible) point —
+    lower is better; measures convergence AND coverage together.  One
+    [R, K] pairwise-distance broadcast."""
+    rank = nondominated_ranks(objs, viol)
+    on_front = rank == 0
+    if viol is not None:
+        on_front = on_front & (viol <= FEAS_TOL)
+    # Masked points sit at +inf so they can never be nearest.
+    pts = jnp.where(on_front[:, None], objs, jnp.inf)
+    delta = ref_front[:, None, :] - pts[None, :, :]      # [R, K, M]
+    dist = jnp.sqrt(jnp.sum(delta * delta, axis=-1))
+    return jnp.mean(jnp.min(dist, axis=1))
+
+
 def hypervolume_2d(
     objs: jax.Array, ref: jax.Array, viol: jax.Array | None = None
 ) -> jax.Array:

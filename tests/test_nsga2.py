@@ -248,3 +248,36 @@ def test_nsga2_loads_pre_viol_checkpoints(tmp_path):
     )
     np.testing.assert_allclose(np.asarray(fresh.state.viol), 0.0)
     del jax
+
+
+def test_igd_exact_values_and_masking():
+    from distributed_swarm_algorithm_tpu.ops.nsga2 import igd
+
+    ref = jnp.asarray([[0.0, 1.0], [1.0, 0.0]])
+    # Attained front exactly on the reference: IGD = 0.
+    objs = jnp.asarray([[0.0, 1.0], [1.0, 0.0], [2.0, 2.0]])
+    assert float(igd(objs, ref)) == pytest.approx(0.0, abs=1e-6)
+    # Front uniformly offset by 0.1 in f2: IGD = 0.1.
+    objs2 = jnp.asarray([[0.0, 1.1], [1.0, 0.1]])
+    assert float(igd(objs2, ref)) == pytest.approx(0.1, abs=1e-6)
+    # An infeasible point sitting on the reference must not count.
+    viol = jnp.asarray([1.0, 0.0])
+    got = float(igd(objs2, ref, viol))
+    # only (1.0, 0.1) remains: ref (0,1) is hypot(1, 0.9) away, ref
+    # (1,0) is 0.1 away
+    want = (np.hypot(1.0, 0.9) + 0.1) / 2
+    assert got == pytest.approx(want, abs=1e-4)
+
+
+def test_nsga2_igd_on_zdt1():
+    from distributed_swarm_algorithm_tpu.models.nsga2 import NSGA2
+
+    opt = NSGA2("zdt1", n=100, dim=8, seed=0)
+    opt.run(150)
+    assert opt.igd() < 0.02             # converged AND spread
+    with pytest.raises(ValueError):
+        NSGA2("zdt3", n=16, dim=4).igd()    # no analytic zdt3 front
+    # explicit reference works for any problem
+    from distributed_swarm_algorithm_tpu.ops.nsga2 import zdt1_front
+
+    assert opt.igd(reference=zdt1_front(128)) < 0.02

@@ -121,8 +121,16 @@ def _cmd_sim(args) -> int:
 
 
 def _cmd_swarm(args) -> int:
-    # Preflight --render before any backend construction (the native
-    # backend may trigger an on-demand C++ build).
+    # Preflight flag combinations before any backend construction (the
+    # native backend may trigger an on-demand C++ build) and before any
+    # simulation work.
+    if (
+        getattr(args, "load_state", None)
+        or getattr(args, "save_state", None)
+    ) and args.backend != "jax":
+        raise SystemExit(
+            "error: --load-state/--save-state need --backend jax"
+        )
     render = getattr(args, "render", None)
     if render and args.backend != "jax":
         raise SystemExit(
@@ -150,6 +158,14 @@ def _cmd_swarm(args) -> int:
                              "reference world); use --backend jax")
         sw = CpuSwarm(args.n, seed=args.seed, spread=args.spread,
                       backend=args.backend)
+    if getattr(args, "load_state", None):
+        sw.load(args.load_state)
+        got = tuple(sw.state.pos.shape)
+        if got != (args.n, args.dim):
+            raise SystemExit(
+                f"error: checkpoint holds a {got[0]}-agent {got[1]}-D "
+                f"swarm; rerun with --n {got[0]} --dim {got[1]}"
+            )
     if args.target:
         sw.set_target([float(x) for x in args.target])
     import contextlib
@@ -173,6 +189,8 @@ def _cmd_swarm(args) -> int:
 
             jax.block_until_ready(sw.state.pos)
     elapsed = time.perf_counter() - start
+    if getattr(args, "save_state", None):
+        sw.save(args.save_state)
     if render:
         import numpy as _np
 
@@ -616,6 +634,12 @@ def build_parser() -> argparse.ArgumentParser:
              "(CPU), Morton-window (approximate, very large N on TPU), "
              "or off",
     )
+    p_swarm.add_argument(
+        "--save-state", default=None, metavar="PATH",
+        help="checkpoint the final swarm state (orbax dir or .npz)")
+    p_swarm.add_argument(
+        "--load-state", default=None, metavar="PATH",
+        help="resume from a state saved with --save-state")
     p_swarm.add_argument(
         "--render", default=None, metavar="FILE.svg",
         help="record the rollout and write an animated SVG "

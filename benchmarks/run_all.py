@@ -17,6 +17,7 @@ BENCHES = [
     "bench_swarm_cpu.py",
     "bench_allocation.py",
     "bench_auction.py",
+    "bench_nsga2.py",
     "bench_pso_10k.py",
     "bench_pso_1m_ackley.py",
     "bench_islands.py",

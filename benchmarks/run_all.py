@@ -22,6 +22,7 @@ BENCHES = [
     "bench_pso_1m_ackley.py",
     "bench_islands.py",
     "bench_bat_1m.py",
+    "bench_gwo_1m.py",
     "bench_swarm_tpu.py",
     "bench_boids.py",
 ]
@@ -30,6 +31,7 @@ QUICK_SKIP = {
     "bench_pso_1m_ackley.py",
     "bench_islands.py",
     "bench_bat_1m.py",
+    "bench_gwo_1m.py",
     "bench_swarm_tpu.py",
     "bench_boids.py",
 }

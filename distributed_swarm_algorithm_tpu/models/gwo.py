@@ -8,6 +8,8 @@ import jax
 
 from ..ops import gwo as _k
 from ..ops.objectives import get_objective
+from ..ops.pallas import gwo_fused as _gf
+from ..utils.platform import on_tpu as _on_tpu
 from ._checkpoint import CheckpointMixin
 
 
@@ -16,6 +18,12 @@ class GWO(CheckpointMixin):
 
     ``t_max`` sets the exploration schedule length (a: 2 → 0); the pack
     exploits fully once ``t_max`` iterations have elapsed.
+
+    ``run`` uses the fused Pallas TPU kernel
+    (ops/pallas/gwo_fused.py) when on TPU with a named objective —
+    force with ``use_pallas=True`` (CPU runs the same body in interpret
+    mode) or disable with ``use_pallas=False``; ``step`` always uses
+    the portable path.
 
     >>> opt = GWO("rastrigin", n=256, dim=10, t_max=300, seed=0)
     >>> opt.run(300)
@@ -31,11 +39,15 @@ class GWO(CheckpointMixin):
         t_max: int = 500,
         seed: int = 0,
         dtype=None,
+        use_pallas: Optional[bool] = None,
+        steps_per_kernel: int = 8,
     ):
         if isinstance(objective, str):
             fn, default_hw = get_objective(objective)
+            self.objective_name: Optional[str] = objective
         else:
             fn, default_hw = objective, 5.12
+            self.objective_name = None
         self.objective = fn
         self.half_width = float(
             half_width if half_width is not None else default_hw
@@ -43,10 +55,25 @@ class GWO(CheckpointMixin):
         if t_max < 1:
             raise ValueError(f"t_max must be >= 1, got {t_max}")
         self.t_max = int(t_max)
+        self.steps_per_kernel = int(steps_per_kernel)
         kwargs = {} if dtype is None else {"dtype": dtype}
         self.state = _k.gwo_init(
             fn, n, dim, self.half_width, seed=seed, **kwargs
         )
+        supported = self.objective_name is not None and (
+            _gf.gwo_pallas_supported(
+                self.objective_name, self.state.pos.dtype
+            )
+        )
+        if use_pallas is None:
+            self.use_pallas = supported and _on_tpu()
+        elif use_pallas and not supported:
+            raise ValueError(
+                "use_pallas=True needs a named objective from "
+                f"{sorted(_gf.OBJECTIVES_T)} and float32 state"
+            )
+        else:
+            self.use_pallas = bool(use_pallas)
 
     def step(self) -> _k.GWOState:
         self.state = _k.gwo_step(
@@ -55,10 +82,19 @@ class GWO(CheckpointMixin):
         return self.state
 
     def run(self, n_steps: int) -> _k.GWOState:
-        self.state = _k.gwo_run(
-            self.state, self.objective, n_steps, self.half_width,
-            self.t_max,
-        )
+        if self.use_pallas:
+            self.state = _gf.fused_gwo_run(
+                self.state, self.objective_name, n_steps,
+                half_width=self.half_width, t_max=self.t_max,
+                rng="tpu" if _on_tpu() else "host",
+                interpret=not _on_tpu(),
+                steps_per_kernel=self.steps_per_kernel,
+            )
+        else:
+            self.state = _k.gwo_run(
+                self.state, self.objective, n_steps, self.half_width,
+                self.t_max,
+            )
         jax.block_until_ready(self.state.leader_fit)
         return self.state
 

@@ -1,0 +1,250 @@
+"""Fused grey-wolf-optimizer iteration as a single Pallas TPU kernel.
+
+The third fused family after PSO and bat: GWO's update references only
+the three leader positions — per-block globals exactly like PSO's gbest
+— so k generations run entirely in VMEM with one HBM read+write of
+pos/fit per kernel.  Same design points as the siblings: lane-major
+``[D, N]`` layout, on-chip hardware PRNG (six uniform draws per step:
+A and C coefficients per leader), and a host-RNG interpret variant with
+a byte-identical body for CPU testing (tests/test_pallas_gwo.py).
+
+Deliberate delta, documented and bounded: the alpha/beta/delta leaders
+refresh between kernel blocks, not between steps (staleness <=
+steps_per_kernel generations — the same delayed-global trade the PSO
+and bat kernels make); the driver re-ranks leaders against the
+incumbents after every block exactly like the portable step does every
+generation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..gwo import GWOState
+from .common import ceil_to as _ceil_to, cyclic_pad_rows as _cyclic_pad_rows
+from .pso_fused import (
+    OBJECTIVES_T,
+    _auto_tile,
+    _uniform_bits,
+    host_uniforms,
+    run_blocks,
+    seed_base,
+)
+
+
+def gwo_pallas_supported(objective_name, dtype) -> bool:
+    return objective_name in OBJECTIVES_T and jnp.dtype(dtype) == jnp.float32
+
+
+def _make_kernel(objective_t, half_width, t_max, host_rng, k_steps):
+    def body(scalar_ref, lead_ref, pos_ref, ra, rc, pos_o, fit_o):
+        pos = pos_ref[:]
+        d = pos.shape[0]
+        leads = lead_ref[:]                       # [D, 128]; cols 0..2
+        t0 = scalar_ref[1].astype(jnp.float32)
+
+        for step in range(k_steps):
+            # a: 2 -> 0 over t_max, clamped (matches ops/gwo.py).
+            frac = jnp.minimum((t0 + step) / t_max, 1.0)
+            a = 2.0 * (1.0 - frac)
+
+            if host_rng:
+                u_a, u_c = ra, rc                 # [3D, T] each
+            else:
+                u_a = _uniform_bits((3 * d,) + pos.shape[1:])
+                u_c = _uniform_bits((3 * d,) + pos.shape[1:])
+
+            acc = jnp.zeros_like(pos)
+            for ell in range(3):
+                lead = leads[:, ell:ell + 1]      # [D, 1]
+                r1 = u_a[ell * d:(ell + 1) * d, :]
+                r2 = u_c[ell * d:(ell + 1) * d, :]
+                big_a = 2.0 * a * r1 - a
+                big_c = 2.0 * r2
+                dist = jnp.abs(big_c * lead - pos)
+                acc = acc + (lead - big_a * dist)
+            pos = jnp.clip(acc / 3.0, -half_width, half_width)
+
+        pos_o[:] = pos
+        fit_o[:] = objective_t(pos)
+
+    if host_rng:
+        def kernel(scalar_ref, lead_ref, pos_ref, ra_ref, rc_ref, *outs):
+            body(scalar_ref, lead_ref, pos_ref, ra_ref[:], rc_ref[:],
+                 *outs)
+    else:
+        def kernel(scalar_ref, lead_ref, pos_ref, *outs):
+            pltpu.prng_seed(scalar_ref[0] + pl.program_id(0))
+            body(scalar_ref, lead_ref, pos_ref, None, None, *outs)
+
+    return kernel
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "half_width", "t_max", "tile_n", "rng",
+        "interpret", "k_steps",
+    ),
+)
+def fused_gwo_step_t(
+    scalars: jax.Array,       # [2] i32: (base seed, block-start iteration)
+    leaders: jax.Array,       # [3, D] alpha/beta/delta
+    pos: jax.Array,           # [D, N]
+    r_a: jax.Array | None = None,     # [3D, N] host-RNG A draws
+    r_c: jax.Array | None = None,     # [3D, N] host-RNG C draws
+    *,
+    objective_name: str,
+    half_width: float = 5.12,
+    t_max: int = 500,
+    tile_n: int = 4096,
+    rng: str = "tpu",
+    interpret: bool = False,
+    k_steps: int = 1,
+) -> Tuple[jax.Array, jax.Array]:
+    """``k_steps`` fused GWO generations, one HBM pass over the pack.
+    Returns ``(pos, fit)``; the caller re-ranks leaders between blocks.
+    Fitness is an output only — GWO's update never reads it, so (unlike
+    PSO/bat) there is no fitness input operand to DMA.
+    """
+    d, n = pos.shape
+    if n % tile_n:
+        raise ValueError(f"N ({n}) must be a multiple of tile_n ({tile_n})")
+    n_tiles = n // tile_n
+    host_rng = rng == "host"
+    if host_rng and (r_a is None or r_c is None):
+        raise ValueError('rng="host" requires r_a and r_c')
+    if host_rng and k_steps != 1:
+        raise ValueError('rng="host" supports k_steps=1 only')
+
+    kernel = _make_kernel(
+        OBJECTIVES_T[objective_name], half_width, t_max, host_rng,
+        k_steps,
+    )
+
+    col_block = lambda i, s: (0, i)          # noqa: E731
+    fixed = lambda i, s: (0, 0)              # noqa: E731
+    dn_spec = pl.BlockSpec((d, tile_n), col_block, memory_space=pltpu.VMEM)
+    d3_spec = pl.BlockSpec(
+        (3 * d, tile_n), col_block, memory_space=pltpu.VMEM
+    )
+    row_spec = pl.BlockSpec((1, tile_n), col_block, memory_space=pltpu.VMEM)
+
+    # Leaders ride lane-broadcast as [D, 128] (cols 0..2 meaningful) for
+    # the same Mosaic relayout reason as the PSO gbest operand.
+    lead128 = jnp.zeros((d, 128), jnp.float32).at[:, :3].set(leaders.T)
+    in_specs = [
+        pl.BlockSpec((d, 128), fixed, memory_space=pltpu.VMEM),
+        dn_spec,
+    ]
+    operands = [lead128, pos]
+    if host_rng:
+        in_specs += [d3_spec, d3_spec]
+        operands += [r_a, r_c]
+
+    f32 = jnp.float32
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=in_specs,
+        out_specs=[dn_spec, row_spec],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((d, n), f32),
+            jax.ShapeDtypeStruct((1, n), f32),
+        ],
+        interpret=interpret,
+    )(scalars.astype(jnp.int32), *operands)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "n_steps", "half_width", "t_max", "tile_n",
+        "rng", "interpret", "steps_per_kernel",
+    ),
+)
+def fused_gwo_run(
+    state: GWOState,
+    objective_name: str,
+    n_steps: int,
+    half_width: float = 5.12,
+    t_max: int = 500,
+    tile_n: int | None = None,
+    rng: str = "tpu",
+    interpret: bool = False,
+    steps_per_kernel: int = 8,
+) -> GWOState:
+    """``n_steps`` fused GWO generations — GWOState in, GWOState out,
+    drop-in fast path for ``ops.gwo.gwo_run`` (trajectories differ only
+    in RNG stream and the per-block leader refresh cadence).  Cyclic
+    padding preserves the pack optimum (pallas/common.cyclic_pad_rows).
+    """
+    n, d = state.pos.shape
+    if rng == "host":
+        steps_per_kernel = 1
+    if tile_n is None:
+        # Six extra [3D, T] uniform buffers live alongside pos in VMEM;
+        # size the lane tile for the padded 8*D working depth.
+        tile_n = _auto_tile(_ceil_to(max(8 * d, 8), 8))
+    tile_n = min(tile_n, _ceil_to(n, 128))
+    n_pad = _ceil_to(n, tile_n)
+    n_tiles = n_pad // tile_n
+
+    pos_t = _cyclic_pad_rows(state.pos, n_pad).T
+    fit_t = _cyclic_pad_rows(state.fit, n_pad)[None, :]
+    seed0 = seed_base(state.key)
+    host_key = jax.random.fold_in(state.key, 0x6E0)
+
+    def block(carry, call_i, k):
+        pos_t, fit_t, leaders, leader_fit, it = carry
+        scalars = jnp.stack([seed0 + call_i * n_tiles, it])
+        ra = rc = None
+        if rng == "host":
+            ra, rc = host_uniforms(
+                host_key, call_i, (3 * d,) + pos_t.shape[1:]
+            )
+        pos_t, fit_t = fused_gwo_step_t(
+            scalars, leaders, pos_t, ra, rc,
+            objective_name=objective_name, half_width=half_width,
+            t_max=t_max, tile_n=tile_n, rng=rng, interpret=interpret,
+            k_steps=k,
+        )
+        # Re-rank leaders against incumbents (portable semantics, at
+        # block cadence): top-3 of (incumbent leaders ++ current pack).
+        all_fit = jnp.concatenate([leader_fit, fit_t[0]])
+        _, top3 = jax.lax.top_k(-all_fit, 3)
+        all_pos = jnp.concatenate([leaders, pos_t.T], axis=0)
+        return (
+            pos_t, fit_t, all_pos[top3], all_fit[top3], it + k
+        )
+
+    carry = run_blocks(
+        block,
+        (
+            pos_t, fit_t,
+            state.leaders.astype(jnp.float32),
+            state.leader_fit.astype(jnp.float32),
+            state.iteration,
+        ),
+        n_steps, steps_per_kernel,
+    )
+    pos_t, fit_t, leaders, leader_fit, _ = carry
+    dt = state.pos.dtype
+    return GWOState(
+        pos=pos_t.T[:n].astype(dt),
+        fit=fit_t[0, :n].astype(state.fit.dtype),
+        leaders=leaders.astype(state.leaders.dtype),
+        leader_fit=leader_fit.astype(state.leader_fit.dtype),
+        key=jax.random.fold_in(state.key, n_steps),
+        iteration=state.iteration + n_steps,
+    )

@@ -1,0 +1,99 @@
+"""Fused Pallas GWO kernel (ops/pallas/gwo_fused.py): exact kernel math
+vs a NumPy oracle, the driver contract, and the model backend switch —
+same testing shape as the PSO and bat kernels (real body on CPU via
+interpret=True with host RNG)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_swarm_algorithm_tpu.models.gwo import GWO
+from distributed_swarm_algorithm_tpu.ops.gwo import gwo_init
+from distributed_swarm_algorithm_tpu.ops.objectives import sphere
+from distributed_swarm_algorithm_tpu.ops.pallas.gwo_fused import (
+    fused_gwo_run,
+    fused_gwo_step_t,
+    gwo_pallas_supported,
+)
+
+HW = 5.12
+T_MAX = 500
+
+
+def _numpy_oracle(pos, leaders, t0, ra, rc):
+    """Exact kernel update, [D, N] layout, plain NumPy."""
+    d = pos.shape[0]
+    a = 2.0 * (1.0 - min(t0 / T_MAX, 1.0))
+    acc = np.zeros_like(pos)
+    for ell in range(3):
+        lead = leaders[ell][:, None]              # [D, 1]
+        r1 = ra[ell * d:(ell + 1) * d]
+        r2 = rc[ell * d:(ell + 1) * d]
+        big_a = 2.0 * a * r1 - a
+        big_c = 2.0 * r2
+        dist = np.abs(big_c * lead - pos)
+        acc += lead - big_a * dist
+    new_pos = np.clip(acc / 3.0, -HW, HW)
+    fit = np.asarray(sphere(jnp.asarray(new_pos.T)))[None, :]
+    return new_pos, fit
+
+
+def test_fused_gwo_step_matches_numpy_oracle():
+    n, d = 256, 5
+    rng = np.random.default_rng(0)
+    pos = rng.uniform(-HW, HW, (d, n)).astype(np.float32)
+    fit = np.asarray(sphere(jnp.asarray(pos.T)))[None, :]
+    leaders = pos.T[np.argsort(fit[0])[:3]].astype(np.float32)  # [3, D]
+    ra = rng.uniform(size=(3 * d, n)).astype(np.float32)
+    rc = rng.uniform(size=(3 * d, n)).astype(np.float32)
+
+    pos_o, fit_o = fused_gwo_step_t(
+        jnp.asarray([0, 42]), jnp.asarray(leaders),
+        jnp.asarray(pos),
+        jnp.asarray(ra), jnp.asarray(rc),
+        objective_name="sphere", half_width=HW, t_max=T_MAX,
+        tile_n=128, rng="host", interpret=True,
+    )
+    e_pos, e_fit = _numpy_oracle(pos, leaders, 42.0, ra, rc)
+    np.testing.assert_allclose(np.asarray(pos_o), e_pos, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(fit_o), e_fit, atol=1e-4)
+
+
+def test_fused_gwo_run_converges_and_leaders_monotone():
+    st = gwo_init(sphere, 256, 4, HW, seed=0)
+    init_best = float(st.leader_fit[0])
+    out = fused_gwo_run(
+        st, "sphere", 100, half_width=HW, t_max=100, rng="host",
+        interpret=True,
+    )
+    assert float(out.leader_fit[0]) <= init_best
+    assert float(out.leader_fit[0]) < 1e-2
+    assert int(out.iteration) == 100
+    # leaders stay sorted best-first
+    lf = np.asarray(out.leader_fit)
+    assert lf[0] <= lf[1] <= lf[2]
+    np.testing.assert_allclose(
+        np.asarray(sphere(out.leaders)), lf, atol=1e-4
+    )
+
+
+def test_fused_gwo_run_pads_non_tile_multiples():
+    st = gwo_init(sphere, 200, 3, HW, seed=1)
+    out = fused_gwo_run(
+        st, "sphere", 10, half_width=HW, rng="host", interpret=True
+    )
+    assert out.pos.shape == (200, 3)
+    assert float(out.leader_fit[0]) <= float(st.leader_fit[0])
+    np.testing.assert_allclose(
+        np.asarray(sphere(out.pos)), np.asarray(out.fit), atol=1e-4
+    )
+
+
+def test_gwo_model_backend_switch():
+    assert gwo_pallas_supported("sphere", jnp.float32)
+    opt = GWO("sphere", n=256, dim=4, seed=0, t_max=100, use_pallas=True)
+    opt.run(100)
+    assert opt.best < 1e-2
+    with pytest.raises(ValueError):
+        GWO(lambda x: jnp.sum(x * x, axis=-1), n=16, dim=2,
+            use_pallas=True)

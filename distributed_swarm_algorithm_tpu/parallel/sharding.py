@@ -551,6 +551,129 @@ def es_run_shmap(
     )
 
 
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "mesh", "n_steps", "axis", "half_width",
+        "t_max", "steps_per_kernel", "tile_n", "rng", "interpret",
+    ),
+)
+def fused_gwo_run_shmap(
+    state,
+    objective_name: str,
+    mesh: Mesh,
+    n_steps: int,
+    axis: str = AGENT_AXIS,
+    half_width: float = 5.12,
+    t_max: int = 500,
+    steps_per_kernel: int = 8,
+    tile_n: int | None = None,
+    rng: str = "tpu",
+    interpret: bool = False,
+):
+    """Multi-chip fused-Pallas GWO: each device runs ``steps_per_kernel``
+    in-VMEM generations on its wolf shard; between blocks the three
+    leaders are re-elected globally — each shard contributes its local
+    top-3 (vs the incumbents) via ``all_gather`` ([n_dev, 3] candidates,
+    O(D) bytes) and every shard deterministically re-ranks the same
+    pool.  Leader staleness equals the single-chip kernel's per-block
+    delay, so multi-chip costs no extra semantic lag."""
+    from ..ops.gwo import GWOState
+    from ..ops.pallas.common import ceil_to, cyclic_pad_rows
+    from ..ops.pallas.gwo_fused import fused_gwo_step_t
+    from ..ops.pallas.pso_fused import (
+        _auto_tile,
+        host_uniforms,
+        run_blocks,
+        seed_base,
+    )
+
+    n, d = state.pos.shape
+    n_dev = mesh.shape[axis]
+    if rng == "host":
+        steps_per_kernel = 1
+    if tile_n is None:
+        tile_n = _auto_tile(ceil_to(max(8 * d, 8), 8))
+    tile_n = min(tile_n, ceil_to(-(-n // n_dev), 128))
+    n_pad = ceil_to(n, n_dev * tile_n)
+    n_tiles_local = (n_pad // n_dev) // tile_n
+
+    pos_t = cyclic_pad_rows(state.pos, n_pad).T
+    fit_t = cyclic_pad_rows(state.fit, n_pad)[None, :]
+    seed0 = seed_base(state.key)
+    host_key = jax.random.fold_in(state.key, 0x6E0)
+
+    col = P(None, axis)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(col, col, P(), P()),
+        out_specs=(col, col, P(), P()),
+        check_vma=False,
+    )
+    def run(pos_t, fit_t, leaders, leader_fit):
+        dev = lax.axis_index(axis)
+
+        def block(carry, call_i, k):
+            pos_t, fit_t, leaders, leader_fit, it = carry
+            scalars = jnp.stack(
+                [seed0 + (call_i * n_dev + dev) * n_tiles_local, it]
+            )
+            ra = rc = None
+            if rng == "host":
+                ra, rc = host_uniforms(
+                    host_key, call_i, (3 * d,) + pos_t.shape[1:],
+                    fold=dev,
+                )
+            pos_t, fit_t = fused_gwo_step_t(
+                scalars, leaders, pos_t, ra, rc,
+                objective_name=objective_name, half_width=half_width,
+                t_max=t_max, tile_n=tile_n, rng=rng,
+                interpret=interpret, k_steps=k,
+            )
+            # Each shard contributes its PACK-local top-3 only; the
+            # replicated incumbents join the pool exactly once in the
+            # global re-rank (gathering incumbents from every shard
+            # would flood the pool with n_dev duplicates and collapse
+            # alpha/beta/delta into copies of one wolf).
+            _, loc3 = jax.lax.top_k(-fit_t[0], 3)
+            cand_fit = jnp.concatenate([
+                leader_fit,
+                lax.all_gather(fit_t[0, loc3], axis).reshape(-1),
+            ])                                    # [3 + n_dev * 3]
+            cand_pos = jnp.concatenate([
+                leaders,
+                lax.all_gather(pos_t.T[loc3], axis).reshape(-1, d),
+            ], axis=0)
+            _, top3 = jax.lax.top_k(-cand_fit, 3)
+            return (
+                pos_t, fit_t, cand_pos[top3], cand_fit[top3], it + k
+            )
+
+        carry = run_blocks(
+            block,
+            (pos_t, fit_t, leaders, leader_fit, state.iteration),
+            n_steps, steps_per_kernel,
+        )
+        return carry[:4]
+
+    pos_t, fit_t, leaders, leader_fit = run(
+        pos_t, fit_t,
+        state.leaders.astype(jnp.float32),
+        state.leader_fit.astype(jnp.float32),
+    )
+    dt = state.pos.dtype
+    return GWOState(
+        pos=pos_t.T[:n].astype(dt),
+        fit=fit_t[0, :n].astype(state.fit.dtype),
+        leaders=leaders.astype(state.leaders.dtype),
+        leader_fit=leader_fit.astype(state.leader_fit.dtype),
+        key=jax.random.fold_in(state.key, n_steps),
+        iteration=state.iteration + n_steps,
+    )
+
+
 def elect_shmap(
     alive: jax.Array,
     agent_id: jax.Array,

@@ -123,3 +123,37 @@ def test_es_run_shmap_on_mesh():
     with pytest.raises(ValueError):
         # odd n can never be a multiple of 2*devices, on any mesh size
         es_run_shmap(st, sphere, mesh, 10, n=101)
+
+
+def test_map_elites_partitions_bit_identically():
+    # The archive (cells axis) shards under GSPMD: the segment-min
+    # insert and Gumbel-argmax parent choice partition transparently
+    # and match the unsharded run bit for bit.
+    from distributed_swarm_algorithm_tpu.ops.map_elites import (
+        me_init,
+        me_run,
+    )
+    from distributed_swarm_algorithm_tpu.ops.objectives import rastrigin
+
+    def desc(x):
+        return (x[:, :2] + 5.12) / 10.24
+
+    st = me_init(rastrigin, desc, 4, 16, 2, 5.12, seed=0)
+    ref = me_run(st, rastrigin, desc, 20, 16, half_width=5.12)
+
+    mesh = make_mesh(("agents",))
+
+    def sh(spec):
+        return NamedSharding(mesh, spec)
+
+    st2 = st.replace(
+        archive_pos=jax.device_put(st.archive_pos, sh(P("agents", None))),
+        archive_fit=jax.device_put(st.archive_fit, sh(P("agents"))),
+    )
+    out = me_run(st2, rastrigin, desc, 20, 16, half_width=5.12)
+    np.testing.assert_array_equal(
+        np.asarray(out.archive_fit), np.asarray(ref.archive_fit)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out.archive_pos), np.asarray(ref.archive_pos)
+    )

@@ -22,6 +22,7 @@
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <limits>
 #include <vector>
 
 extern "C" {
@@ -211,7 +212,10 @@ void dsa_auction_assign(
     float* prices_out,
     int64_t* rounds_out) {
   const int64_t s = n > t ? n : t;
-  const float kNeg = -1.0e6f;
+  // -inf masking identity, valid at any utility/price magnitude (a
+  // finite sentinel breaks once prices approach it — ADVICE r1); the
+  // JAX/NumPy tiers use the same identity + isfinite tests.
+  const float kNeg = -std::numeric_limits<float>::infinity();
   std::vector<float> values(static_cast<size_t>(s) * s, 0.0f);
   for (int64_t i = 0; i < n; ++i)
     for (int64_t j = 0; j < t; ++j) {
@@ -252,6 +256,7 @@ void dsa_auction_assign(
           const float v = vi[j] - prices[j];
           if (v > w2) w2 = v;
         }
+        if (!std::isfinite(w2)) w2 = w1;  // S == 1: zero margin
         j1[i] = static_cast<int32_t>(best_j);
         bid_v[i] = (agent_task[i] < 0)
                        ? (prices[best_j] + (w1 - w2)) + cur_eps
@@ -266,7 +271,7 @@ void dsa_auction_assign(
       for (int64_t i = 0; i < s; ++i) {
         if (agent_task[i] >= 0) continue;        // not bidding
         const int32_t j = j1[i];
-        if (bid_v[i] >= best_bid[j] && best_bid[j] > kNeg / 2.0f &&
+        if (bid_v[i] >= best_bid[j] && std::isfinite(best_bid[j]) &&
             winner[j] < 0)
           winner[j] = static_cast<int32_t>(i);   // ascending i = min id
       }

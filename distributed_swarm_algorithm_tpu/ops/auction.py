@@ -49,7 +49,15 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-_NEG = -1.0e6          # identity filler for segment/row maxima
+# Masking identity for segment/row maxima.  -inf (not a large finite
+# sentinel): a finite filler like -1e6 silently breaks the second-best
+# masking once utilities or accumulated prices approach its magnitude —
+# Bertsekas' worst-case prices grow like O(S * (max|util| + eps)), so no
+# finite sentinel is safe for every instance (ADVICE r1).  With -inf the
+# mask can never be confused with a real net value; the one place it
+# could surface — w2 when the row has no second column (S == 1) — is
+# explicitly mapped to a zero bidding margin in each tier.
+_NEG = -jnp.inf
 _BIG_ID = jnp.iinfo(jnp.int32).max
 
 
@@ -91,6 +99,7 @@ def _auction_round(values, eps, carry):
     j1 = jnp.argmax(v, axis=1).astype(jnp.int32)       # best task
     v2 = jnp.where(jax.nn.one_hot(j1, s, dtype=bool), _NEG, v)
     w2 = jnp.max(v2, axis=1)                           # second-best value
+    w2 = jnp.where(jnp.isfinite(w2), w2, w1)           # S == 1: zero margin
 
     bidding = agent_task < 0
     # Bertsekas bid: pay away the margin over the second choice, plus eps.
@@ -99,7 +108,7 @@ def _auction_round(values, eps, carry):
     best_bid = jax.ops.segment_max(
         bid_v, j1, num_segments=s, indices_are_sorted=False
     )                                                  # [S]
-    has_bid = best_bid > _NEG / 2.0
+    has_bid = jnp.isfinite(best_bid)
 
     at_best = bidding & (bid_v >= best_bid[j1])
     winner = jax.ops.segment_min(
@@ -176,6 +185,15 @@ def auction_assign(
     The returned assignment is one-to-one on the assigned pairs; agents
     and tasks may stay unassigned (id -1) when infeasible, non-positive,
     or outcompeted.
+
+    Numerical range: the -inf masking identity is valid at any utility
+    or price magnitude; the remaining practical bound is float32
+    resolution — eps must stay representable against the *worst-case
+    price* scale, which grows like O(S * (max|util| + eps)) on
+    adversarial chained-preference instances (typical instances stay
+    near max|util|).  Size eps >> S * max|util| * 2**-23, or contested
+    prices can stop rising and the round cap, not
+    eps-complementary-slackness, ends the auction.
     """
     if feasible is None:
         feasible = util > 0.0
@@ -259,12 +277,13 @@ def auction_assign_np(util, feasible=None, eps: float = 0.25,
             v2 = v.copy()
             v2[np.arange(s), j1] = _NEG
             w2 = v2.max(axis=1)
+            w2 = np.where(np.isfinite(w2), w2, w1)  # S == 1: zero margin
             bidding = agent_task < 0
             bid = prices[j1] + (w1 - w2) + cur_eps
             bid_v = np.where(bidding, bid, np.float32(_NEG))
             best_bid = np.full(s, np.float32(_NEG))
             np.maximum.at(best_bid, j1, bid_v)
-            has_bid = best_bid > _NEG / 2.0
+            has_bid = np.isfinite(best_bid)
             at_best = bidding & (bid_v >= best_bid[j1])
             winner = np.full(s, _BIG_ID, np.int64)
             np.minimum.at(

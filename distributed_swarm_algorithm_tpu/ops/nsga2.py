@@ -21,7 +21,13 @@ TPU shape:
     the paper: objectives are normalized by the *population* min/max,
     not per-front min/max (keeps the pass sort-only; crowding is only
     ever compared within a front, where this is a uniform rescale per
-    objective).
+    objective).  Known skew: the rescale is uniform *per objective* but
+    the summed distance mixes objectives, so an objective whose front
+    spans only a narrow slice of the population range contributes less
+    to the total than under Deb's per-front normalization — boundary
+    points still get +inf, but interior diversity along that objective
+    is under-weighted.  Accepted trade-off for the sort-only pass; use
+    per-front spans if that skew ever matters.
   - SBX crossover and polynomial mutation are batched elementwise math.
 
 Selection: binary tournament on (rank, -crowding); survivors are the

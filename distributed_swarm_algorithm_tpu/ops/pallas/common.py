@@ -21,6 +21,11 @@ def cyclic_pad_rows(x, n_pad: int):
 
     x = x.astype(jnp.float32)
     n = x.shape[0]
+    if n_pad < n:
+        raise ValueError(
+            f"cyclic_pad_rows: n_pad={n_pad} < n={n} would silently drop "
+            "population members; callers must pass n_pad >= x.shape[0]"
+        )
     if n_pad == n:
         return x
     reps = -(-n_pad // n)

@@ -262,3 +262,45 @@ def test_swarm_auction_mode_assigns_and_recovers():
     winners2 = np.asarray(s.task_winner)
     assert victim not in winners2.tolist()
     assert (winners2 != NO_WINNER).all()  # 7 alive agents re-cover 3 tasks
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_sentinel_robust_at_large_magnitudes(seed):
+    """ADVICE r1: a finite -1e6 masking sentinel silently corrupted the
+    second-best computation once utilities/prices approached it.  With
+    the -inf identity the auction stays eps-optimal at magnitudes that
+    used to overflow the old sentinel (utilities ~3e6, prices beyond
+    1e6).  eps is scaled with the utilities so float32 resolution and
+    the optimality gap both scale uniformly."""
+    rng = np.random.default_rng(seed)
+    scale = 1.0e5
+    util = (rng.integers(1, 40, size=(5, 5)) * scale).astype(np.float32)
+    feasible = np.ones((5, 5), bool)
+
+    res = auction_assign(
+        jnp.asarray(util), jnp.asarray(feasible), eps=0.1 * scale
+    )
+    check_valid(util, feasible, res)
+    got = float(assignment_utility(jnp.asarray(util), res))
+    want = brute_force_best(util.tolist(), feasible.tolist())
+    # integer-multiples-of-scale utilities + S*eps < scale => exact
+    assert got == pytest.approx(want, rel=1e-6)
+
+    from distributed_swarm_algorithm_tpu.ops.auction import auction_assign_np
+
+    npy = auction_assign_np(util, feasible, eps=0.1 * scale)
+    np.testing.assert_array_equal(
+        np.asarray(res.agent_task), npy.agent_task
+    )
+
+
+def test_single_pair_instance():
+    """S == 1 exercises the no-second-column path: the masked w2 row is
+    all -inf and must map to a zero bidding margin, not a NaN/inf bid."""
+    res = auction_assign(jnp.asarray([[7.0]]), eps=0.25)
+    assert int(res.agent_task[0]) == 0
+    assert int(res.task_agent[0]) == 0
+    assert np.isfinite(float(res.prices[0]))
+    scaled = auction_assign_scaled(jnp.asarray([[7.0]]), eps=0.25)
+    assert int(scaled.agent_task[0]) == 0
+    assert np.isfinite(float(scaled.prices[0]))

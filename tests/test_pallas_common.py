@@ -1,0 +1,42 @@
+"""Shared Pallas helpers (ops/pallas/common.py).
+
+The fused drivers all pad populations to tile multiples with
+``cyclic_pad_rows``; its invariant (duplicates are legal members, so the
+population optimum is preserved) only holds when it actually *pads* —
+ADVICE r1 flagged that a caller passing n_pad < n would silently drop
+members.  These tests pin the guard and the padding semantics.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_swarm_algorithm_tpu.ops.pallas.common import (
+    ceil_to,
+    cyclic_pad_rows,
+)
+
+
+def test_ceil_to():
+    assert ceil_to(1, 8) == 8
+    assert ceil_to(8, 8) == 8
+    assert ceil_to(9, 8) == 16
+    assert ceil_to(1_000_000, 128) == 1_000_064
+
+
+def test_cyclic_pad_rows_pads_cyclically():
+    x = jnp.arange(6, dtype=jnp.float32).reshape(3, 2)
+    out = cyclic_pad_rows(x, 8)
+    assert out.shape == (8, 2)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.tile(np.asarray(x), (3, 1))[:8]
+    )
+    # identity when already sized
+    same = cyclic_pad_rows(x, 3)
+    np.testing.assert_array_equal(np.asarray(same), np.asarray(x))
+
+
+def test_cyclic_pad_rows_refuses_truncation():
+    x = jnp.zeros((4, 2), jnp.float32)
+    with pytest.raises(ValueError, match="n_pad"):
+        cyclic_pad_rows(x, 3)

@@ -1,0 +1,69 @@
+"""Dimension-sharded PSO at D=4096 (SURVEY §2a TP row, VERDICT r1 #7).
+
+Two rows on one chip: the portable jit path at [N, 4096] and
+``pso_run_dimshard`` on a 1-device mesh — demonstrating the TP-style
+path costs nothing when it isn't needed.  The actual *scaling* claim
+(objective partial-sums reduced by one O(N)-byte psum per step,
+independent of D) is validated functionally on the 8-virtual-device
+mesh in tests/test_dimshard.py and by ``__graft_entry__.dryrun_multichip``;
+with a single real chip there is no second device to time ICI against.
+"""
+
+from __future__ import annotations
+
+from common import REFERENCE_AGENT_STEPS_PER_SEC, report, timeit_best
+
+import jax
+
+from distributed_swarm_algorithm_tpu.ops.objectives import rastrigin
+from distributed_swarm_algorithm_tpu.ops.pso import pso_init, pso_run
+from distributed_swarm_algorithm_tpu.parallel.dimshard import (
+    DIM_AXIS,
+    pso_run_dimshard,
+    shard_pso_dim,
+)
+from distributed_swarm_algorithm_tpu.parallel.mesh import make_mesh
+
+N = 2048
+DIM = 4096
+STEPS = 128
+
+
+def main() -> None:
+    st = pso_init(rastrigin, n=N, dim=DIM, half_width=5.12, seed=0)
+
+    out = pso_run(st, rastrigin, STEPS)
+    float(out.gbest_fit)
+    best = timeit_best(
+        lambda: float(pso_run(st, rastrigin, STEPS).gbest_fit),
+        lambda: 0.0,
+    )
+    report(
+        f"agent-steps/sec, PSO Rastrigin-{DIM}D, {N} particles, "
+        "portable jit",
+        N * STEPS / best,
+        "agent-steps/sec",
+        REFERENCE_AGENT_STEPS_PER_SEC,
+    )
+
+    mesh = make_mesh((DIM_AXIS,), devices=jax.devices()[:1])
+    sh = shard_pso_dim(st, mesh)
+    out = pso_run_dimshard(sh, "rastrigin", mesh, STEPS)
+    float(out.gbest_fit)
+    best = timeit_best(
+        lambda: float(
+            pso_run_dimshard(sh, "rastrigin", mesh, STEPS).gbest_fit
+        ),
+        lambda: 0.0,
+    )
+    report(
+        f"agent-steps/sec, PSO Rastrigin-{DIM}D, {N} particles, "
+        "dim-sharded shard_map (1-device mesh)",
+        N * STEPS / best,
+        "agent-steps/sec",
+        REFERENCE_AGENT_STEPS_PER_SEC,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,168 @@
+"""Window-separation error quantification at scale (VERDICT r1 #4).
+
+Measures, on the attached device, the Morton-window separation mode
+against the exact tiled Pallas kernel across densities, window sizes,
+and sort staleness (``sort_every``):
+
+* **pair recall** — fraction of true in-radius pairs within the sorted
+  window (sampled: exact per sampled agent against all agents);
+* **force error** — relative L2 error of the window force field vs the
+  exact kernel;
+* **staleness** — the same metrics after the swarm has moved K ticks
+  since the last re-sort (the ``presorted``/``sort_every`` regime),
+  using the live swarm_tick dynamics at 65k.
+
+Prints one JSON line per configuration (schema: config + metrics);
+the round's numbers are tabulated in docs/PERFORMANCE.md.
+"""
+
+from __future__ import annotations
+
+import json
+
+from common import REFERENCE_AGENT_STEPS_PER_SEC  # noqa: F401  (sys.path)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_swarm_algorithm_tpu.ops.neighbors import (
+    morton_keys,
+    separation_window,
+    suggest_window,
+)
+from distributed_swarm_algorithm_tpu.ops.pallas.separation import (
+    separation_pallas,
+)
+from distributed_swarm_algorithm_tpu.utils.platform import on_tpu
+
+PS = 2.0
+K_SEP = 20.0
+EPS = 1e-3
+SAMPLE = 4096
+
+
+def uniform_swarm(n, mean_neighbors, seed=0):
+    rho = mean_neighbors / (np.pi * PS * PS)
+    side = float(np.sqrt(n / rho))
+    key = jax.random.PRNGKey(seed)
+    return jax.random.uniform(key, (n, 2), minval=0.0, maxval=side)
+
+
+def sampled_recall(pos, window, cell, seed=0, chunk=512, rank=None):
+    """Pair recall over SAMPLE probe agents, exact against all agents.
+
+    ``rank`` is the position of each agent in the traversal order the
+    window actually walks; None means a fresh Morton sort of the given
+    positions (the sort_every=1 regime).  For staleness measurements
+    pass the identity — in ``presorted`` mode the array order IS the
+    (stale) traversal order."""
+    n = pos.shape[0]
+    s = min(SAMPLE, n)
+    key = jax.random.PRNGKey(seed)
+    idx = jax.random.choice(key, n, (s,), replace=False)
+
+    if rank is None:
+        order = jnp.argsort(morton_keys(pos, cell))
+        rank = jnp.zeros((n,), jnp.int32).at[order].set(
+            jnp.arange(n, dtype=jnp.int32)
+        )
+
+    total = 0
+    captured = 0
+    me = np.asarray(idx)
+    for start in range(0, s, chunk):
+        block = me[start:start + chunk]
+        d = jnp.linalg.norm(
+            pos[block][:, None, :] - pos[None, :, :], axis=-1
+        )                                                   # [C, N]
+        near = np.asarray((d < PS))
+        near[np.arange(len(block)), block] = False          # drop self
+        dr = np.abs(
+            np.asarray(rank)[block][:, None] - np.asarray(rank)[None, :]
+        )
+        total += int(near.sum())
+        captured += int((near & (dr <= window)).sum())
+    return captured / max(total, 1), total
+
+
+def force_rel_err(pos, window, cell, presorted=False):
+    n = pos.shape[0]
+    alive = jnp.ones((n,), bool)
+    exact = separation_pallas(pos, alive, K_SEP, PS, EPS)
+    approx = separation_window(
+        pos, alive, K_SEP, PS, EPS, cell=cell, window=window,
+        presorted=presorted,
+    )
+    num = float(jnp.linalg.norm(approx - exact))
+    den = float(jnp.linalg.norm(exact))
+    return num / max(den, 1e-12)
+
+
+def static_sweep():
+    for n in (65_536, 1_048_576):
+        for mean_nb in (2.0, 6.0, 12.0):
+            pos = uniform_swarm(n, mean_nb, seed=0)
+            suggested = suggest_window(pos, PS)
+            for window in sorted({8, 16, 32, suggested}):
+                recall, pairs = sampled_recall(pos, window, PS)
+                err = force_rel_err(pos, window, PS)
+                print(json.dumps({
+                    "kind": "static",
+                    "n": n,
+                    "mean_neighbors": mean_nb,
+                    "window": window,
+                    "suggested_window": suggested,
+                    "pair_recall": round(recall, 4),
+                    "sampled_pairs": pairs,
+                    "force_rel_err": round(err, 4),
+                }))
+
+
+def staleness_sweep():
+    """Error growth between re-sorts: run the real swarm at 65k with the
+    window mode, and measure recall/force error K ticks after a sort
+    (K = sort_every - 1 is the worst tick of the cadence)."""
+    import distributed_swarm_algorithm_tpu as dsa
+
+    n = 65_536
+    for sort_every in (1, 8, 25, 50):
+        cfg = dsa.SwarmConfig(
+            separation_mode="window",
+            sort_every=sort_every,
+        )
+        s = dsa.make_swarm(n, seed=0, spread=float(np.sqrt(n)))
+        s = dsa.with_tasks(s, jnp.asarray([[1.0, 1.0]]))
+        # Advance past a sort boundary then to the stalest tick of the
+        # cadence; the swarm state's array order is then the traversal
+        # order the presorted window pass actually uses.
+        for _ in range(sort_every + max(sort_every - 1, 0)):
+            s = dsa.swarm_tick(s, None, cfg)
+        pos = s.pos
+        window = cfg.window_size
+        stale_rank = jnp.arange(n, dtype=jnp.int32)
+        recall, pairs = sampled_recall(
+            pos, window, cfg.grid_cell, seed=1, rank=stale_rank
+        )
+        err = force_rel_err(pos, window, cfg.grid_cell, presorted=True)
+        print(json.dumps({
+            "kind": "stale",
+            "n": n,
+            "sort_every": sort_every,
+            "window": window,
+            "pair_recall_at_stalest_tick": round(recall, 4),
+            "sampled_pairs": pairs,
+            "force_rel_err": round(err, 4),
+        }))
+
+
+def main():
+    if not on_tpu():
+        print(json.dumps({"skipped": "no TPU attached"}))
+        return
+    static_sweep()
+    staleness_sweep()
+
+
+if __name__ == "__main__":
+    main()

@@ -2,7 +2,12 @@
 
 Each bench is a subprocess so a failure (e.g. no TPU attached for the
 1M-particle configs) skips that line instead of killing the suite.
-Usage:  python benchmarks/run_all.py  [--quick]
+Usage:  python benchmarks/run_all.py  [--quick] [--tests]
+
+``--tests`` first runs the FULL pytest suite (including the tests the
+default `pytest` run deselects via the `slow` marker: heavyweight
+convergence sweeps, multi-process socket scenarios, examples smoke) —
+the CI-style everything gate.
 """
 
 from __future__ import annotations
@@ -25,6 +30,8 @@ BENCHES = [
     "bench_gwo_1m.py",
     "bench_swarm_tpu.py",
     "bench_boids.py",
+    "bench_dim_sharded.py",
+    "measure_window_recall.py",
 ]
 
 QUICK_SKIP = {
@@ -34,12 +41,21 @@ QUICK_SKIP = {
     "bench_gwo_1m.py",
     "bench_swarm_tpu.py",
     "bench_boids.py",
+    "bench_dim_sharded.py",
+    "measure_window_recall.py",
 }
 
 
 def main() -> int:
     quick = "--quick" in sys.argv[1:]
     failures = 0
+    if "--tests" in sys.argv[1:]:
+        rc = subprocess.call(
+            [sys.executable, "-m", "pytest", "tests/", "-q", "-m", ""],
+            cwd=os.path.dirname(HERE),
+        )
+        if rc != 0:
+            return rc
     for name in BENCHES:
         if quick and name in QUICK_SKIP:
             continue

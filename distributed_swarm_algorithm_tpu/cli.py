@@ -48,11 +48,23 @@ def _add_agent_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--caps", type=str, nargs="+", default=[],
                    help="Agent Capabilities")
     p.add_argument("--bind", type=str, default=None,
-                   help="UDP bind addr host:port (enables UDP transport)")
+                   help="bind addr host:port (enables the socket transport)")
     p.add_argument("--peers", type=str, nargs="*", default=[],
-                   help="UDP peer addrs host:port")
+                   help="peer addrs host:port")
+    p.add_argument("--transport", choices=("udp", "tcp"), default="udp",
+                   help="socket transport when --bind is given (the two "
+                        "backends the reference names at agent.py:191-193)")
     p.add_argument("--steps", type=int, default=0,
                    help="run N ticks then exit (0 = forever)")
+    p.add_argument("--tick-rate", type=float, default=None,
+                   help="override loop rate in Hz (timeouts are "
+                        "tick-derived, so protocol semantics scale with "
+                        "it — handy for fast integration tests)")
+    p.add_argument("--task", action="append", default=[],
+                   metavar="ID,X,Y[,CAP]",
+                   help="seed a task (repeatable); statuses are reported "
+                        "in the exit JSON — gives the multi-process "
+                        "deployment an end-to-end allocation path")
 
 
 def _parse_addr(addr: str):
@@ -67,7 +79,7 @@ def _parse_addr(addr: str):
 def _cmd_agent(args) -> int:
     import logging
 
-    from .models.agent import SwarmAgent, UdpTransport
+    from .models.agent import SwarmAgent, TcpTransport, UdpTransport
 
     # The reference logs agent lifecycle at INFO (agent.py:9-10); match it
     # so elections/claims are visible from the terminal.
@@ -75,11 +87,41 @@ def _cmd_agent(args) -> int:
         level=logging.INFO,
         format="%(asctime)s - %(name)s - %(levelname)s - %(message)s",
     )
-    agent = SwarmAgent(args.id, args.count, capabilities=args.caps)
+    config = None
+    if args.tick_rate:
+        from .utils.config import SwarmConfig
+
+        config = SwarmConfig(tick_rate_hz=args.tick_rate)
+    agent = SwarmAgent(
+        args.id, args.count, capabilities=args.caps, config=config
+    )
+    for spec in args.task:
+        parts = spec.split(",")
+        if len(parts) not in (3, 4):
+            raise SystemExit(
+                f"error: expected ID,X,Y[,CAP], got {spec!r}"
+            )
+        try:
+            tid, x, y = int(parts[0]), float(parts[1]), float(parts[2])
+        except ValueError:
+            raise SystemExit(
+                f"error: expected numeric ID,X,Y in {spec!r}"
+            )
+        agent.tasks[tid] = {
+            "status": "OPEN",
+            "pos": (x, y),
+            "required_cap": parts[3] if len(parts) == 4 else None,
+        }
     if args.bind:
         peers = [_parse_addr(p) for p in args.peers]
-        transport = UdpTransport(_parse_addr(args.bind), peers)
+        cls = TcpTransport if args.transport == "tcp" else UdpTransport
+        transport = cls(_parse_addr(args.bind), peers)
         transport.attach(agent)
+        # Readiness beacon for process orchestration (integration tests
+        # wait for this line before staging peers/faults).
+        agent.log.info(
+            "online: %s transport bound to %s", args.transport, args.bind
+        )
     try:
         if args.steps:
             period = 1.0 / agent.config.tick_rate_hz
@@ -89,13 +131,19 @@ def _cmd_agent(args) -> int:
                 # Sleep the leftover, like update_loop (agent.py:78-81), so
                 # wall-clock timing stays at tick_rate_hz.
                 time.sleep(max(0.0, period - (time.time() - start)))
-            print(json.dumps({
+            out = {
                 "id": agent.agent_id,
                 "state": agent.state.name,
                 "leader_id": agent.leader_id,
                 "position": [round(p, 3) for p in agent.position],
                 "tick": agent.tick,
-            }))
+            }
+            if agent.tasks:
+                out["tasks"] = {
+                    str(tid): t["status"]
+                    for tid, t in sorted(agent.tasks.items())
+                }
+            print(json.dumps(out))
         else:
             agent.update_loop()
     except KeyboardInterrupt:

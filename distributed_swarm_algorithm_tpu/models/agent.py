@@ -184,6 +184,180 @@ class UdpTransport(Transport):
             self._thread.join(timeout=1.0)
 
 
+class TcpTransport(Transport):
+    """Stream transport between OS processes — the *other* backend the
+    reference's stub comment names (agent.py:191-193, "this goes to
+    UDP/TCP socket").  TCP adds per-link ordering and reliability on top
+    of what UdpTransport gives; since TCP is a byte stream, packets are
+    framed with a u16 length prefix.
+
+    Topology matches UdpTransport: every agent listens on ``bind`` and
+    unicasts each broadcast to its static ``peers`` list.  Outbound
+    links dial lazily on first send and re-dial after failure (at most
+    once per ``redial_seconds`` per peer, so a dead peer does not stall
+    the 10 Hz loop); inbound connections each get a daemon reader
+    thread feeding the agent ingress.
+    """
+
+    FRAME_FMT = "!H"
+    FRAME_LEN = struct.calcsize(FRAME_FMT)
+
+    def __init__(
+        self,
+        bind: Tuple[str, int],
+        peers: Sequence[Tuple[str, int]],
+        redial_seconds: float = 1.0,
+        connect_timeout: float = 0.25,
+    ):
+        self.peers = list(peers)
+        self.redial_seconds = redial_seconds
+        self.connect_timeout = connect_timeout
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(bind)
+        self._listener.listen(16)
+        self._listener.settimeout(0.2)
+        self._agent: Optional["SwarmAgent"] = None
+        self._running = False
+        self._accept_thread: Optional[threading.Thread] = None
+        self._readers: List[threading.Thread] = []
+        self._inbound: List[socket.socket] = []
+        self._out: Dict[Tuple[str, int], Optional[socket.socket]] = {}
+        self._next_dial: Dict[Tuple[str, int], float] = {}
+        self._out_lock = threading.Lock()
+
+    def attach(self, agent: "SwarmAgent") -> None:
+        self._agent = agent
+        agent.transport = self
+        self._running = True
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True
+        )
+        self._accept_thread.start()
+
+    # --- inbound ---------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            conn.settimeout(0.2)
+            self._inbound.append(conn)
+            t = threading.Thread(
+                target=self._read_loop, args=(conn,), daemon=True
+            )
+            t.start()
+            self._readers.append(t)
+
+    def _read_loop(self, conn: socket.socket) -> None:
+        buf = b""
+        while self._running:
+            try:
+                chunk = conn.recv(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            if not chunk:      # peer closed
+                break
+            buf += chunk
+            while len(buf) >= self.FRAME_LEN:
+                (length,) = struct.unpack(
+                    self.FRAME_FMT, buf[: self.FRAME_LEN]
+                )
+                if len(buf) < self.FRAME_LEN + length:
+                    break
+                packet = buf[self.FRAME_LEN: self.FRAME_LEN + length]
+                buf = buf[self.FRAME_LEN + length:]
+                if self._agent is not None:
+                    self._agent.on_message_received(packet)
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    # --- outbound --------------------------------------------------------
+    def _dial(self, peer: Tuple[str, int]) -> Optional[socket.socket]:
+        now = time.monotonic()
+        if now < self._next_dial.get(peer, 0.0):
+            return None
+        self._next_dial[peer] = now + self.redial_seconds
+        try:
+            s = socket.create_connection(peer, timeout=self.connect_timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            return s
+        except OSError:
+            return None
+
+    def send(self, sender_id: int, packet: bytes) -> None:
+        frame = struct.pack(self.FRAME_FMT, len(packet)) + packet
+        # Dial dead peers OUTSIDE the lock: a blocking connect to an
+        # unreachable host (up to connect_timeout) must not stall other
+        # sender threads, or k dead peers would delay every tick by
+        # k * connect_timeout and push heartbeats toward the election
+        # timeout exactly when the swarm is already degraded.
+        with self._out_lock:
+            links = [(peer, self._out.get(peer)) for peer in self.peers]
+        dialed = {}
+        for peer, s in links:
+            if s is None:
+                dialed[peer] = self._dial(peer)
+        if dialed:
+            with self._out_lock:
+                for peer, s in dialed.items():
+                    if self._out.get(peer) is None:
+                        self._out[peer] = s
+                    elif s is not None:
+                        # another sender won the race; drop ours
+                        try:
+                            s.close()
+                        except OSError:
+                            pass
+                links = [
+                    (peer, self._out.get(peer)) for peer in self.peers
+                ]
+        for peer, s in links:
+            if s is None:
+                continue
+            try:
+                s.sendall(frame)
+            except OSError:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+                with self._out_lock:
+                    if self._out.get(peer) is s:
+                        self._out[peer] = None
+
+    def close(self) -> None:
+        self._running = False
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._out_lock:
+            for s in self._out.values():
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+            self._out.clear()
+        for c in self._inbound:
+            try:
+                c.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=1.0)
+        for t in self._readers:
+            t.join(timeout=1.0)
+
+
 # ---------------------------------------------------------------------------
 # The agent
 # ---------------------------------------------------------------------------

@@ -1,0 +1,367 @@
+"""Dimension-axis (tensor-parallel-style) sharding for very-high-D
+objectives.
+
+SURVEY.md §2a's optional TP row: the reference has no tensors at all
+(its state is two Python floats, /root/reference/agent.py:47), so the
+only meaning "tensor parallel" can take here is sharding the *search
+dimension* D over the mesh — worthwhile once D is large enough that a
+particle no longer fits a lane tile comfortably (Ackley-100D and up,
+e.g. neuroevolution parameter vectors at D = 10^4..10^6).
+
+Design (the scaling-book recipe, applied to the D axis):
+
+* every per-dimension array shards its LAST axis over ``"dim"`` —
+  ``pos/vel/pbest_pos [N, D]`` as ``P(None, "dim")``, ``gbest_pos [D]``
+  / ``mean [D]`` as ``P("dim")``; per-particle scalars ([N] fitness)
+  and the RNG key replicate;
+* the PSO/ES update rules are **dimension-wise independent** — the
+  velocity/position/momentum updates never mix dimensions, so they run
+  entirely device-local with zero communication;
+* the only cross-dimension coupling is the *objective*: separable
+  benchmark objectives reduce over D, so each device computes partial
+  sums over its D-shard and one ``lax.psum`` of ``[P, N]`` scalars
+  (P = 1-2 partials) produces the global fitness — O(N) bytes per step
+  over ICI, independent of D.  Fitness-derived bookkeeping (pbest
+  masks, argmin, centered ranks) is replicated arithmetic on identical
+  inputs, so no further collectives are needed.
+
+The objective goes through ``PARTIAL_OBJECTIVES`` — a registry of
+``(local, combine)`` pairs, where ``local(x_local, offset, d_global) ->
+[P, N]`` partial sums and ``combine(psummed [P, N], d_global) -> [N]``
+applies the non-separable tail (Ackley's exponentials, Zakharov's
+powers).  Objectives with true cross-dimension chains (Rosenbrock's
+x_{i+1} terms, Levy) would need halo exchange and are not registered —
+callers get a clear KeyError, and the agent/particle-axis sharding in
+parallel/sharding.py remains the right tool for them.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..ops.es import ESState, LR, MOMENTUM, SIGMA, centered_ranks
+from ..ops.pso import C1, C2, PSOState, W
+
+DIM_AXIS = "dim"
+
+_TWO_PI = 2.0 * jnp.pi
+
+
+# ------------------------------------------------------------ objectives
+
+def _sphere_local(x, offset, d):
+    return jnp.sum(x * x, axis=1)[None, :]
+
+
+def _sphere_combine(s, d):
+    return s[0]
+
+
+def _rastrigin_local(x, offset, d):
+    return jnp.sum(
+        x * x - 10.0 * jnp.cos(_TWO_PI * x), axis=1
+    )[None, :]
+
+
+def _rastrigin_combine(s, d):
+    return 10.0 * d + s[0]
+
+
+def _ackley_local(x, offset, d):
+    return jnp.stack(
+        [
+            jnp.sum(x * x, axis=1),
+            jnp.sum(jnp.cos(_TWO_PI * x), axis=1),
+        ]
+    )
+
+
+def _ackley_combine(s, d):
+    s1 = s[0] / d
+    s2 = s[1] / d
+    return (
+        -20.0 * jnp.exp(-0.2 * jnp.sqrt(s1)) - jnp.exp(s2) + 20.0 + jnp.e
+    )
+
+
+def _zakharov_local(x, offset, d):
+    i = offset + 1.0 + jnp.arange(x.shape[1], dtype=x.dtype)
+    return jnp.stack(
+        [
+            jnp.sum(x * x, axis=1),
+            jnp.sum(0.5 * i[None, :] * x, axis=1),
+        ]
+    )
+
+
+def _zakharov_combine(s, d):
+    return s[0] + s[1] ** 2 + s[1] ** 4
+
+
+def _styblinski_local(x, offset, d):
+    return jnp.sum(x**4 - 16.0 * x * x + 5.0 * x, axis=1)[None, :]
+
+
+def _styblinski_combine(s, d):
+    return 0.5 * s[0] + 39.16616570377142 * d
+
+
+# name -> (local partial-sum fn, combine fn).  ``local`` sees only the
+# device's D-shard (plus its global offset); ``combine`` sees the
+# psum'ed partials.  Semantics match ops/objectives.py exactly.
+PARTIAL_OBJECTIVES: Dict[str, Tuple[Callable, Callable]] = {
+    "sphere": (_sphere_local, _sphere_combine),
+    "rastrigin": (_rastrigin_local, _rastrigin_combine),
+    "ackley": (_ackley_local, _ackley_combine),
+    "zakharov": (_zakharov_local, _zakharov_combine),
+    "styblinski_tang": (_styblinski_local, _styblinski_combine),
+}
+
+
+def dimshard_supported(objective_name: str) -> bool:
+    return objective_name in PARTIAL_OBJECTIVES
+
+
+# ------------------------------------------------------------- placement
+
+def shard_pso_dim(
+    state: PSOState, mesh: Mesh, axis: str = DIM_AXIS
+) -> PSOState:
+    """Place a PSOState with the dimension axis sharded over ``axis``."""
+    nd2 = NamedSharding(mesh, P(None, axis))
+    nd1 = NamedSharding(mesh, P(axis))
+    repl = NamedSharding(mesh, P())
+    return PSOState(
+        pos=jax.device_put(state.pos, nd2),
+        vel=jax.device_put(state.vel, nd2),
+        pbest_pos=jax.device_put(state.pbest_pos, nd2),
+        pbest_fit=jax.device_put(state.pbest_fit, repl),
+        gbest_pos=jax.device_put(state.gbest_pos, nd1),
+        gbest_fit=jax.device_put(state.gbest_fit, repl),
+        key=jax.device_put(state.key, repl),
+        iteration=jax.device_put(state.iteration, repl),
+    )
+
+
+def shard_es_dim(
+    state: ESState, mesh: Mesh, axis: str = DIM_AXIS
+) -> ESState:
+    """Place an ESState with the dimension axis sharded over ``axis``."""
+    nd1 = NamedSharding(mesh, P(axis))
+    repl = NamedSharding(mesh, P())
+    return ESState(
+        mean=jax.device_put(state.mean, nd1),
+        mom=jax.device_put(state.mom, nd1),
+        best_pos=jax.device_put(state.best_pos, nd1),
+        best_fit=jax.device_put(state.best_fit, repl),
+        key=jax.device_put(state.key, repl),
+        iteration=jax.device_put(state.iteration, repl),
+    )
+
+
+# ---------------------------------------------------------------- drivers
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "mesh", "n_steps", "axis", "w", "c1", "c2",
+        "half_width", "vmax_frac",
+    ),
+)
+def pso_run_dimshard(
+    state: PSOState,
+    objective_name: str,
+    mesh: Mesh,
+    n_steps: int,
+    axis: str = DIM_AXIS,
+    w: float = W,
+    c1: float = C1,
+    c2: float = C2,
+    half_width: float = 5.12,
+    vmax_frac: float = 0.5,
+) -> PSOState:
+    """``n_steps`` of gbest PSO with the DIMENSION axis sharded.
+
+    Same update rule as ``ops.pso.pso_step`` (trajectories differ only
+    in RNG stream: each device draws its own [N, D_loc] uniforms from a
+    device-folded key).  Communication per step: one ``psum`` of
+    ``[P, N]`` objective partials — O(N) bytes regardless of D.
+    """
+    local, combine = PARTIAL_OBJECTIVES[objective_name]
+    n, d = state.pos.shape
+    n_dev = mesh.shape[axis]
+    if d % n_dev:
+        raise ValueError(
+            f"dim D ({d}) must be a multiple of mesh axis "
+            f"{axis!r} size ({n_dev})"
+        )
+    d_loc = d // n_dev
+    vmax = half_width * vmax_frac
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(None, axis), P(None, axis), P(None, axis), P(),
+            P(axis), P(), P(),
+        ),
+        out_specs=(
+            P(None, axis), P(None, axis), P(None, axis), P(),
+            P(axis), P(), P(),
+        ),
+        check_vma=False,
+    )
+    def run(pos, vel, bpos, bfit, gpos, gfit, key):
+        dev = lax.axis_index(axis)
+
+        def step(carry, _):
+            pos, vel, bpos, bfit, gpos, gfit, key = carry
+            key, k1, k2 = jax.random.split(key, 3)
+            r1 = jax.random.uniform(
+                jax.random.fold_in(k1, dev), (n, d_loc), pos.dtype
+            )
+            r2 = jax.random.uniform(
+                jax.random.fold_in(k2, dev), (n, d_loc), pos.dtype
+            )
+            vel = (
+                w * vel
+                + c1 * r1 * (bpos - pos)
+                + c2 * r2 * (gpos[None, :] - pos)
+            )
+            vel = jnp.clip(vel, -vmax, vmax)
+            pos = jnp.clip(pos + vel, -half_width, half_width)
+
+            # The one collective: global fitness from local partials.
+            fit = combine(lax.psum(local(pos, dev * d_loc, d), axis), d)
+
+            # Replicated-arithmetic bookkeeping: every device holds the
+            # same [N] fitness, so masks and argmins agree everywhere.
+            improved = fit < bfit
+            bfit = jnp.where(improved, fit, bfit)
+            bpos = jnp.where(improved[:, None], pos, bpos)
+            b = jnp.argmin(bfit)
+            better = bfit[b] < gfit
+            gfit = jnp.where(better, bfit[b], gfit)
+            gpos = jnp.where(better, bpos[b], gpos)
+            return (pos, vel, bpos, bfit, gpos, gfit, key), None
+
+        carry, _ = lax.scan(
+            step, (pos, vel, bpos, bfit, gpos, gfit, key), None,
+            length=n_steps,
+        )
+        return carry
+
+    pos, vel, bpos, bfit, gpos, gfit, key = run(
+        state.pos, state.vel, state.pbest_pos, state.pbest_fit,
+        state.gbest_pos, state.gbest_fit, state.key,
+    )
+    return PSOState(
+        pos=pos, vel=vel, pbest_pos=bpos, pbest_fit=bfit,
+        gbest_pos=gpos, gbest_fit=gfit, key=key,
+        iteration=state.iteration + n_steps,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "mesh", "n_steps", "n", "axis", "half_width",
+        "sigma", "lr", "momentum",
+    ),
+)
+def es_run_dimshard(
+    state: ESState,
+    objective_name: str,
+    mesh: Mesh,
+    n_steps: int,
+    n: int = 256,
+    axis: str = DIM_AXIS,
+    half_width: float = 5.12,
+    sigma: float = SIGMA,
+    lr: float = LR,
+    momentum: float = MOMENTUM,
+) -> ESState:
+    """OpenAI-ES with the PARAMETER axis sharded — proper tensor
+    parallelism for neuroevolution-scale D.
+
+    Everything except the fitness reduction is dimension-local: the
+    antithetic draws, the rank-weighted gradient ``shaped @ eps``, and
+    the momentum update all act per-dimension, so the gradient needs NO
+    collective at all.  Per generation the devices exchange exactly one
+    ``psum`` of ``[P, n]`` objective partials (the population's shaped
+    ranks are then replicated arithmetic).  Complements
+    ``parallel.sharding.es_run_shmap``, which shards the *population*
+    axis instead — compose them on a 2-D mesh for both scales at once.
+    """
+    local, combine = PARTIAL_OBJECTIVES[objective_name]
+    d = state.mean.shape[0]
+    n_dev = mesh.shape[axis]
+    if d % n_dev:
+        raise ValueError(
+            f"dim D ({d}) must be a multiple of mesh axis "
+            f"{axis!r} size ({n_dev})"
+        )
+    if n % 2:
+        raise ValueError(f"population n ({n}) must be even")
+    d_loc = d // n_dev
+    s = sigma * half_width
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(), P()),
+        out_specs=(P(axis), P(axis), P(axis), P(), P()),
+        check_vma=False,
+    )
+    def run(mean, mom, best_pos, best_fit, key):
+        dev = lax.axis_index(axis)
+
+        def step(carry, _):
+            mean, mom, best_pos, best_fit, key = carry
+            key, kd = jax.random.split(key)
+            eps_half = jax.random.normal(
+                jax.random.fold_in(kd, dev), (n // 2, d_loc), mean.dtype
+            )
+            eps = jnp.concatenate([eps_half, -eps_half], axis=0)
+            pop = jnp.clip(mean + s * eps, -half_width, half_width)
+
+            fit = combine(lax.psum(local(pop, dev * d_loc, d), axis), d)
+            shaped = centered_ranks(fit)          # replicated arithmetic
+
+            grad = (shaped @ eps) / (n * s)       # [d_loc] — local!
+            mom = momentum * mom - lr * half_width * grad
+            mean = jnp.clip(mean + mom, -half_width, half_width)
+
+            b = jnp.argmin(fit)                   # same index everywhere
+            better = fit[b] < best_fit
+            best_fit = jnp.where(better, fit[b], best_fit)
+            best_pos = jnp.where(better, pop[b], best_pos)
+
+            mean_fit = combine(
+                lax.psum(local(mean[None, :], dev * d_loc, d), axis), d
+            )[0]
+            better_mean = mean_fit < best_fit
+            best_fit = jnp.where(better_mean, mean_fit, best_fit)
+            best_pos = jnp.where(better_mean, mean, best_pos)
+            return (mean, mom, best_pos, best_fit, key), None
+
+        carry, _ = lax.scan(
+            step, (mean, mom, best_pos, best_fit, key), None,
+            length=n_steps,
+        )
+        return carry
+
+    mean, mom, best_pos, best_fit, key = run(
+        state.mean, state.mom, state.best_pos, state.best_fit, state.key
+    )
+    return ESState(
+        mean=mean, mom=mom, best_pos=best_pos, best_fit=best_fit,
+        key=key, iteration=state.iteration + n_steps,
+    )

@@ -132,6 +132,7 @@ def test_window_forces_match_dense_when_window_covers_flock():
     )
 
 
+@pytest.mark.slow
 def test_window_mode_flock_aligns():
     """Polarization must still emerge from the windowed neighborhoods.
     The window samples ~50% of each alignment disc at this density, so

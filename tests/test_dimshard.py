@@ -1,0 +1,146 @@
+"""Dimension-axis (TP-style) sharding (parallel/dimshard.py).
+
+Closes SURVEY.md §2a's optional TP row: for very-high-D objectives the
+search dimension shards over the mesh and the objective reduces via one
+[P, N] psum per step.  Runs on the 8-virtual-CPU-device mesh from
+conftest, like the rest of tests/test_parallel.py's machinery.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_swarm_algorithm_tpu.ops.es import es_init
+from distributed_swarm_algorithm_tpu.ops.objectives import OBJECTIVES
+from distributed_swarm_algorithm_tpu.ops.pso import pso_init
+from distributed_swarm_algorithm_tpu.parallel.dimshard import (
+    DIM_AXIS,
+    PARTIAL_OBJECTIVES,
+    dimshard_supported,
+    es_run_dimshard,
+    pso_run_dimshard,
+    shard_es_dim,
+    shard_pso_dim,
+)
+from distributed_swarm_algorithm_tpu.parallel.mesh import make_mesh
+
+HW = 5.12
+
+
+def _mesh():
+    return make_mesh((DIM_AXIS,))
+
+
+def test_partial_objectives_match_registry():
+    """local+combine with a single full-width shard must equal the
+    portable objective exactly (offset 0, no psum needed)."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.uniform(-2, 2, (64, 24)).astype(np.float32))
+    for name, (local, combine) in PARTIAL_OBJECTIVES.items():
+        fn, _ = OBJECTIVES[name]
+        want = np.asarray(fn(x))
+        got = np.asarray(combine(local(x, 0, 24), 24))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_partial_objectives_split_matches_full():
+    """Summing partials from two half-shards (the psum, done by hand)
+    must equal the single-shard result — including the offset-dependent
+    Zakharov weights."""
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.uniform(-3, 3, (16, 32)).astype(np.float32))
+    for name, (local, combine) in PARTIAL_OBJECTIVES.items():
+        full = np.asarray(combine(local(x, 0, 32), 32))
+        halves = local(x[:, :16], 0, 32) + local(x[:, 16:], 16, 32)
+        split = np.asarray(combine(halves, 32))
+        np.testing.assert_allclose(split, full, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("objective", ["sphere", "rastrigin", "ackley"])
+def test_pso_dimshard_converges(objective):
+    mesh = _mesh()
+    st = pso_init(
+        OBJECTIVES[objective][0], n=256, dim=64, half_width=HW, seed=0
+    )
+    st = shard_pso_dim(st, mesh)
+    out = pso_run_dimshard(st, objective, mesh, 120, half_width=HW)
+    assert out.pos.shape == (256, 64)
+    assert int(out.iteration) == 120
+    assert float(out.gbest_fit) < float(st.gbest_fit)
+    assert bool((jnp.abs(out.pos) <= HW + 1e-5).all())
+    if objective == "sphere":
+        # D=64 gbest PSO in 120 steps: well off the init but not tiny.
+        assert float(out.gbest_fit) < 5.0
+    # gbest tracks the pbest minimum (replicated bookkeeping stayed
+    # consistent across the dim shards).
+    assert float(out.gbest_fit) <= float(out.pbest_fit.min()) + 1e-6
+
+
+def test_pso_dimshard_deterministic():
+    mesh = _mesh()
+    st = pso_init(
+        OBJECTIVES["rastrigin"][0], n=128, dim=32, half_width=HW, seed=3
+    )
+    st = shard_pso_dim(st, mesh)
+    a = pso_run_dimshard(st, "rastrigin", mesh, 40, half_width=HW)
+    b = pso_run_dimshard(st, "rastrigin", mesh, 40, half_width=HW)
+    np.testing.assert_array_equal(np.asarray(a.pos), np.asarray(b.pos))
+    assert float(a.gbest_fit) == float(b.gbest_fit)
+
+
+def test_pso_dimshard_gbest_monotone_across_calls():
+    mesh = _mesh()
+    st = pso_init(
+        OBJECTIVES["ackley"][0], n=128, dim=32, half_width=HW, seed=5
+    )
+    st = shard_pso_dim(st, mesh)
+    prev = float(st.gbest_fit)
+    s = st
+    for _ in range(3):
+        s = pso_run_dimshard(s, "ackley", mesh, 15, half_width=HW)
+        cur = float(s.gbest_fit)
+        assert cur <= prev + 1e-6
+        prev = cur
+
+
+def test_es_dimshard_converges_sphere():
+    mesh = _mesh()
+    st = es_init(OBJECTIVES["sphere"][0], dim=64, half_width=HW, seed=0)
+    st = shard_es_dim(st, mesh)
+    out = es_run_dimshard(st, "sphere", mesh, 150, n=128, half_width=HW)
+    assert out.mean.shape == (64,)
+    assert int(out.iteration) == 150
+    assert float(out.best_fit) < float(st.best_fit)
+    assert float(out.best_fit) < 20.0
+
+
+def test_es_dimshard_deterministic():
+    mesh = _mesh()
+    st = es_init(OBJECTIVES["rastrigin"][0], dim=32, half_width=HW, seed=2)
+    st = shard_es_dim(st, mesh)
+    a = es_run_dimshard(st, "rastrigin", mesh, 30, n=64, half_width=HW)
+    b = es_run_dimshard(st, "rastrigin", mesh, 30, n=64, half_width=HW)
+    np.testing.assert_array_equal(np.asarray(a.mean), np.asarray(b.mean))
+    assert float(a.best_fit) == float(b.best_fit)
+
+
+def test_dimshard_validation():
+    mesh = _mesh()
+    n_dev = mesh.shape[DIM_AXIS]
+    if n_dev > 1:
+        st = pso_init(
+            OBJECTIVES["sphere"][0], n=32, dim=n_dev + 1, half_width=HW,
+            seed=0,
+        )
+        with pytest.raises(ValueError, match="multiple"):
+            pso_run_dimshard(st, "sphere", mesh, 2, half_width=HW)
+    assert not dimshard_supported("rosenbrock")   # cross-dim chain
+    with pytest.raises(KeyError):
+        pso_run_dimshard(
+            pso_init(
+                OBJECTIVES["sphere"][0], n=32, dim=8 * n_dev,
+                half_width=HW, seed=0,
+            ),
+            "rosenbrock", mesh, 2, half_width=HW,
+        )

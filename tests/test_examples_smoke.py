@@ -1,0 +1,42 @@
+"""Every example script runs to completion (VERDICT r1 #6 tail item:
+"examples/ are never smoke-tested").
+
+Each example is executed as a real subprocess — exactly how a user runs
+it — on the CPU backend.  Marked slow: each pays a fresh interpreter +
+jax import (~10-30 s on a busy 1-core host).
+"""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+EXAMPLES = sorted(
+    (pathlib.Path(__file__).parents[1] / "examples").glob("*.py")
+)
+
+_ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "PALLAS_AXON_POOL_IPS": "",
+    # examples/multichip_islands.py wants several devices.
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+}
+
+
+@pytest.mark.parametrize(
+    "script", EXAMPLES, ids=[p.stem for p in EXAMPLES]
+)
+def test_example_runs(script):
+    res = subprocess.run(
+        [sys.executable, str(script)],
+        env=_ENV, text=True, capture_output=True, timeout=600,
+    )
+    assert res.returncode == 0, (
+        f"{script.name} failed:\n{res.stderr[-3000:]}"
+    )
+    assert res.stdout.strip(), f"{script.name} printed nothing"

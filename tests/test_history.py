@@ -19,6 +19,7 @@ def test_best_curve_shape_and_monotonicity():
     assert bests[-1] < bests[0]
 
 
+@pytest.mark.slow
 def test_best_curve_ragged_tail_and_custom_metric():
     from distributed_swarm_algorithm_tpu.models.nsga2 import NSGA2
 

@@ -66,6 +66,7 @@ def test_hypervolume_2d_clips_to_reference_box():
     assert hv == pytest.approx(0.54, abs=1e-6)
 
 
+@pytest.mark.slow
 def test_nsga2_converges_on_zdt1():
     from distributed_swarm_algorithm_tpu.models.nsga2 import NSGA2
 

@@ -221,6 +221,7 @@ def test_swarm_moves_to_target_and_settles():
 
 # ------------------------------------------------------- window separation
 
+@pytest.mark.slow
 def test_window_separation_exact_when_window_covers_swarm():
     from distributed_swarm_algorithm_tpu.ops.neighbors import (
         separation_dense,

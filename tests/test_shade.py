@@ -20,6 +20,7 @@ def test_shade_converges_on_sphere():
     assert opt.best < 1e-3
 
 
+@pytest.mark.slow
 def test_shade_beats_plain_de_on_rastrigin():
     # The point of parameter adaptation: at a matched budget SHADE
     # should do at least as well as fixed-parameter DE on a multimodal

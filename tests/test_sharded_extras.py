@@ -7,11 +7,13 @@ additionally ride the family-agnostic island model unchanged."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distributed_swarm_algorithm_tpu.parallel.mesh import make_mesh
 
 
+@pytest.mark.slow
 def test_auction_partitions_bit_identically():
     from distributed_swarm_algorithm_tpu.ops.auction import (
         auction_assign_scaled,
@@ -37,6 +39,7 @@ def test_auction_partitions_bit_identically():
     assert int(res.rounds) == int(ref.rounds)
 
 
+@pytest.mark.slow
 def test_nsga2_partitions_bit_identically():
     from distributed_swarm_algorithm_tpu.ops.nsga2 import (
         nsga2_init,
@@ -67,6 +70,7 @@ def test_nsga2_partitions_bit_identically():
     )
 
 
+@pytest.mark.slow
 def test_ga_and_tempering_ride_generic_islands():
     from distributed_swarm_algorithm_tpu.ops.ga import ga_init, ga_run
     from distributed_swarm_algorithm_tpu.ops.objectives import rastrigin

@@ -21,6 +21,7 @@ attached, else the portable jit path.  Methodology notes:
 """
 
 import json
+import sys
 import time
 
 from distributed_swarm_algorithm_tpu.models.pso import PSO
@@ -32,7 +33,40 @@ REPS = 3
 REFERENCE_AGENT_STEPS_PER_SEC = 40_000.0  # SURVEY.md §6, measured
 
 
+def _parity_gate():
+    """On-TPU numerical parity for the headline kernel (VERDICT r1 #1):
+    the fused Pallas program is validated against interpret-mode math on
+    the host plus an on-chip PRNG statistics check BEFORE any headline
+    is printed.  Returns None when no TPU is attached (nothing to
+    certify — the portable path's math is the tests' oracle)."""
+    import os
+
+    sys.path.insert(
+        0,
+        os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmarks"
+        ),
+    )
+    from verify_on_device import run_gates
+
+    report = run_gates(quick=True)
+    if report["parity_ok"] is False:
+        print(
+            json.dumps({
+                "metric": "PARITY FAILURE — headline withheld",
+                "value": 0.0,
+                "unit": "agent-steps/sec",
+                "vs_baseline": 0.0,
+                "parity_ok": False,
+                "gates": report["gates"],
+            })
+        )
+        raise SystemExit(2)
+    return report["parity_ok"]
+
+
 def main():
+    parity_ok = _parity_gate()
     opt = PSO("rastrigin", n=N, dim=DIM, seed=0, steps_per_kernel=64)
     float(opt.state.gbest_fit)
 
@@ -62,6 +96,9 @@ def main():
                 "vs_baseline": round(
                     agent_steps_per_sec / REFERENCE_AGENT_STEPS_PER_SEC, 2
                 ),
+                # True = fused kernel numerically certified on this chip
+                # this run; None = no TPU attached (portable path).
+                "parity_ok": parity_ok,
             }
         )
     )

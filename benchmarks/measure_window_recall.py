@@ -49,7 +49,7 @@ def uniform_swarm(n, mean_neighbors, seed=0):
     return jax.random.uniform(key, (n, 2), minval=0.0, maxval=side)
 
 
-def sampled_recall(pos, window, cell, seed=0, chunk=512, rank=None):
+def sampled_recall(pos, window, cell, seed=0, chunk=256, rank=None):
     """Pair recall over SAMPLE probe agents, exact against all agents.
 
     ``rank`` is the position of each agent in the traversal order the
@@ -68,28 +68,32 @@ def sampled_recall(pos, window, cell, seed=0, chunk=512, rank=None):
             jnp.arange(n, dtype=jnp.int32)
         )
 
+    all_idx = jnp.arange(n, dtype=jnp.int32)
     total = 0
     captured = 0
     me = np.asarray(idx)
     for start in range(0, s, chunk):
-        block = me[start:start + chunk]
+        block = jnp.asarray(me[start:start + chunk])
+        # Everything stays on-device; only two scalars come back per
+        # chunk (a [C, N] bool round-trip through the chip tunnel would
+        # dominate the whole sweep).
         d = jnp.linalg.norm(
             pos[block][:, None, :] - pos[None, :, :], axis=-1
         )                                                   # [C, N]
-        near = np.asarray((d < PS))
-        near[np.arange(len(block)), block] = False          # drop self
-        dr = np.abs(
-            np.asarray(rank)[block][:, None] - np.asarray(rank)[None, :]
-        )
-        total += int(near.sum())
-        captured += int((near & (dr <= window)).sum())
+        near = (d < PS) & (block[:, None] != all_idx[None, :])
+        dr = jnp.abs(rank[block][:, None] - rank[None, :]) <= window
+        total += int(jnp.sum(near))
+        captured += int(jnp.sum(near & dr))
     return captured / max(total, 1), total
 
 
-def force_rel_err(pos, window, cell, presorted=False):
+def force_rel_err(pos, window, cell, presorted=False, exact=None):
+    """``exact`` lets callers amortize the O(N^2) exact kernel across a
+    window sweep — it depends only on the positions."""
     n = pos.shape[0]
     alive = jnp.ones((n,), bool)
-    exact = separation_pallas(pos, alive, K_SEP, PS, EPS)
+    if exact is None:
+        exact = separation_pallas(pos, alive, K_SEP, PS, EPS)
     approx = separation_window(
         pos, alive, K_SEP, PS, EPS, cell=cell, window=window,
         presorted=presorted,
@@ -104,9 +108,11 @@ def static_sweep():
         for mean_nb in (2.0, 6.0, 12.0):
             pos = uniform_swarm(n, mean_nb, seed=0)
             suggested = suggest_window(pos, PS)
+            alive = jnp.ones((n,), bool)
+            exact = separation_pallas(pos, alive, K_SEP, PS, EPS)
             for window in sorted({8, 16, 32, suggested}):
                 recall, pairs = sampled_recall(pos, window, PS)
-                err = force_rel_err(pos, window, PS)
+                err = force_rel_err(pos, window, PS, exact=exact)
                 print(json.dumps({
                     "kind": "static",
                     "n": n,
@@ -140,11 +146,23 @@ def staleness_sweep():
             s = dsa.swarm_tick(s, None, cfg)
         pos = s.pos
         window = cfg.window_size
-        stale_rank = jnp.arange(n, dtype=jnp.int32)
+        if sort_every == 1:
+            # Production regime: swarm_tick re-sorts inside the pass
+            # every tick (no state permutation, presorted=False) — the
+            # traversal order is a FRESH Morton sort of current pos.
+            stale_rank = None
+            presorted = False
+        else:
+            # Production regime: the state array order IS the traversal
+            # order, last refreshed up to sort_every-1 ticks ago.
+            stale_rank = jnp.arange(n, dtype=jnp.int32)
+            presorted = True
         recall, pairs = sampled_recall(
             pos, window, cfg.grid_cell, seed=1, rank=stale_rank
         )
-        err = force_rel_err(pos, window, cfg.grid_cell, presorted=True)
+        err = force_rel_err(
+            pos, window, cfg.grid_cell, presorted=presorted
+        )
         print(json.dumps({
             "kind": "stale",
             "n": n,

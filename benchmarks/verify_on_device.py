@@ -41,7 +41,15 @@ to print a headline when parity fails.
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
+
+# Repo root on sys.path whether invoked as `python benchmarks/...` or
+# imported from bench.py (same contract as benchmarks/common.py).
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
 import jax
 import jax.numpy as jnp

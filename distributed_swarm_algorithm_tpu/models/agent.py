@@ -225,6 +225,13 @@ class TcpTransport(Transport):
         self._out: Dict[Tuple[str, int], Optional[socket.socket]] = {}
         self._next_dial: Dict[Tuple[str, int], float] = {}
         self._out_lock = threading.Lock()
+        # Per-peer WRITE locks: sendall can split across syscalls under
+        # backpressure, and both the tick thread and the inbound reader
+        # threads send — interleaved partial frames would permanently
+        # desynchronize the length-prefixed stream.
+        self._wlocks: Dict[Tuple[str, int], threading.Lock] = {
+            peer: threading.Lock() for peer in self.peers
+        }
 
     def attach(self, agent: "SwarmAgent") -> None:
         self._agent = agent
@@ -323,7 +330,8 @@ class TcpTransport(Transport):
             if s is None:
                 continue
             try:
-                s.sendall(frame)
+                with self._wlocks[peer]:
+                    s.sendall(frame)
             except OSError:
                 try:
                     s.close()

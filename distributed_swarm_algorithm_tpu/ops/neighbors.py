@@ -174,7 +174,7 @@ def neighbor_counts_sampled(
     radius: float,
     sample: int = 4096,
     seed: int = 0,
-    chunk: int = 1024,
+    chunk: int = 256,
 ) -> jax.Array:
     """[S] in-radius neighbor counts for ``sample`` randomly chosen
     agents (exact per sampled agent: distances against ALL agents,

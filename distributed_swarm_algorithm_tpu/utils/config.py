@@ -108,8 +108,13 @@ class SwarmConfig:
     #     at 1M agents.  Semantically transparent to the protocol
     #     (identity lives in agent_id; kill/revive match by value), but
     #     ARRAY SLOTS become internal — address agents by id, not index.
-    #     Agents move <= max_speed*dt (0.5 m) per tick vs a 2 m cell, so
-    #     staleness between re-sorts costs separation recall marginally.
+    #     KEEP sort_every <= ~personal_space / (2*max_speed*dt) (= 2 at
+    #     the defaults; 8 is still fine in practice): an agent crosses a
+    #     personal space in personal_space/(max_speed*dt) = 4 ticks, and
+    #     the measured force error under converging motion jumps from
+    #     ~0.7% at sort_every=8 to ~99% at 25 — the stale ordering
+    #     misses exactly the newly colliding (strongest-force) pairs.
+    #     See docs/PERFORMANCE.md "Window-separation error".
     dtype: str = "float32"
 
     def replace(self, **kw) -> "SwarmConfig":

@@ -67,9 +67,22 @@ def _spawn(agent_id, ports, *, transport, steps, tasks=(), caps=()):
 
 def _wait_for_stderr(proc, needle: str, timeout: float) -> str:
     """Block until ``needle`` appears on the process's stderr (consumed
-    line by line); returns the matching line."""
+    line by line); returns the matching line.  Uses select so the
+    deadline is enforced even when the agent goes silent — a bare
+    readline() would block past any timeout."""
+    import select
+
     deadline = time.monotonic() + timeout
+    fd = proc.stderr.fileno()
     while time.monotonic() < deadline:
+        ready, _, _ = select.select([fd], [], [], 0.2)
+        if not ready:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"agent exited (rc={proc.returncode}) before "
+                    f"{needle!r} appeared"
+                )
+            continue
         line = proc.stderr.readline()
         if not line:
             if proc.poll() is not None:

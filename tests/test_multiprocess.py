@@ -66,15 +66,25 @@ def _spawn(agent_id, ports, *, transport, steps, tasks=(), caps=()):
 
 
 def _wait_for_stderr(proc, needle: str, timeout: float) -> str:
-    """Block until ``needle`` appears on the process's stderr (consumed
-    line by line); returns the matching line.  Uses select so the
-    deadline is enforced even when the agent goes silent — a bare
-    readline() would block past any timeout."""
+    """Block until ``needle`` appears on the process's stderr; returns
+    the matching line.  Reads the raw fd with os.read + select (never
+    the buffered TextIOWrapper: mixing select on the fd with buffered
+    readline() makes lines sitting in the stdio buffer invisible to
+    select, so the wait could falsely time out), enforcing the deadline
+    even when the agent goes silent."""
     import select
 
     deadline = time.monotonic() + timeout
     fd = proc.stderr.fileno()
+    buf = b""
     while time.monotonic() < deadline:
+        nl = buf.find(b"\n")
+        if nl >= 0:
+            line, buf = buf[:nl + 1], buf[nl + 1:]
+            text = line.decode(errors="replace")
+            if needle in text:
+                return text
+            continue
         ready, _, _ = select.select([fd], [], [], 0.2)
         if not ready:
             if proc.poll() is not None:
@@ -83,8 +93,8 @@ def _wait_for_stderr(proc, needle: str, timeout: float) -> str:
                     f"{needle!r} appeared"
                 )
             continue
-        line = proc.stderr.readline()
-        if not line:
+        chunk = os.read(fd, 65536)
+        if not chunk:
             if proc.poll() is not None:
                 raise AssertionError(
                     f"agent exited (rc={proc.returncode}) before "
@@ -92,8 +102,7 @@ def _wait_for_stderr(proc, needle: str, timeout: float) -> str:
                 )
             time.sleep(0.05)
             continue
-        if needle in line:
-            return line
+        buf += chunk
     raise AssertionError(f"timed out waiting for {needle!r} on stderr")
 
 

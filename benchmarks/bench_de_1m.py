@@ -1,0 +1,41 @@
+"""Fused DE at 1M individuals (VERDICT r1 #3 — the fourth fused family).
+
+The portable DE step is gather-bound on TPU: three uniform-random donor
+row gathers over [1M, 30] measure ~9M individual-steps/s regardless of
+objective.  The fused kernel (ops/pallas/de_fused.py) replaces the
+gathers with rotational donor selection (scalar-prefetched tile shifts
++ dynamic lane rolls) — pure block DMA + VPU work.
+"""
+
+from __future__ import annotations
+
+from common import REFERENCE_AGENT_STEPS_PER_SEC, report, timeit_best
+
+from distributed_swarm_algorithm_tpu.models.de import DE
+
+N = 1_048_576
+DIM = 30
+STEPS = 1024
+
+
+def main() -> None:
+    opt = DE("rastrigin", n=N, dim=DIM, seed=0, steps_per_kernel=32)
+    float(opt.state.best_fit)
+    opt.run(STEPS)
+    float(opt.state.best_fit)
+    best = timeit_best(
+        lambda: opt.run(STEPS), lambda: float(opt.state.best_fit),
+        reps=3,
+    )
+    path = "pallas-fused" if opt.use_pallas else "xla-jit"
+    report(
+        f"agent-steps/sec, DE rand/1/bin Rastrigin-30D, {N} individuals, "
+        f"1 chip ({path})",
+        N * STEPS / best,
+        "agent-steps/sec",
+        REFERENCE_AGENT_STEPS_PER_SEC,
+    )
+
+
+if __name__ == "__main__":
+    main()

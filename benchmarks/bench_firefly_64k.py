@@ -1,0 +1,37 @@
+"""Tiled firefly at 16k and 65k (VERDICT r1 #3 — sixth fused family).
+
+Firefly is the O(N^2) family: the portable XLA step materializes the
+[N, N] weight matrix (1 GB at 16k, 17 GB at 65k — OOM), so the tiled
+Pallas kernel (ops/pallas/firefly_fused.py) is both a modest speedup at
+16k (measured 7.8 -> 6.2 ms/gen) and the ONLY path at 65k+.
+"""
+
+from __future__ import annotations
+
+from common import REFERENCE_AGENT_STEPS_PER_SEC, report, timeit_best
+
+from distributed_swarm_algorithm_tpu.models.firefly import Firefly
+
+
+def main() -> None:
+    for n, steps in ((16_384, 32), (65_536, 8)):
+        opt = Firefly("rastrigin", n=n, dim=30, seed=0)
+        float(opt.state.best_fit)
+        opt.run(steps)
+        float(opt.state.best_fit)
+        best = timeit_best(
+            lambda: opt.run(steps), lambda: float(opt.state.best_fit),
+            reps=2,
+        )
+        path = "pallas-tiled" if opt.use_pallas else "xla-jit"
+        report(
+            f"agent-steps/sec, firefly Rastrigin-30D, {n} fireflies, "
+            f"1 chip ({path})",
+            n * steps / best,
+            "agent-steps/sec",
+            REFERENCE_AGENT_STEPS_PER_SEC,
+        )
+
+
+if __name__ == "__main__":
+    main()

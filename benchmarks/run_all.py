@@ -28,6 +28,9 @@ BENCHES = [
     "bench_islands.py",
     "bench_bat_1m.py",
     "bench_gwo_1m.py",
+    "bench_de_1m.py",
+    "bench_shade_1m.py",
+    "bench_firefly_64k.py",
     "bench_swarm_tpu.py",
     "bench_boids.py",
     "bench_dim_sharded.py",
@@ -39,6 +42,9 @@ QUICK_SKIP = {
     "bench_islands.py",
     "bench_bat_1m.py",
     "bench_gwo_1m.py",
+    "bench_de_1m.py",
+    "bench_shade_1m.py",
+    "bench_firefly_64k.py",
     "bench_swarm_tpu.py",
     "bench_boids.py",
     "bench_dim_sharded.py",

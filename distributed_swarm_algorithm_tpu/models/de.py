@@ -13,11 +13,22 @@ import jax.numpy as jnp
 
 from ..ops import de as _k
 from ..ops.objectives import get_objective
+from ..ops.pallas import de_fused as _df
+from ..utils.platform import on_tpu as _on_tpu
 from ._checkpoint import CheckpointMixin
 
 
 class DE(CheckpointMixin):
     """Differential evolution (rand/1/bin by default).
+
+    Two compute paths with the same DEState contract:
+      - portable jit'd JAX (any backend; exact rand/1/bin donors via
+        row gathers — gather-bound on TPU at large N),
+      - the fused Pallas TPU kernel (ops/pallas/de_fused.py) with
+        rotational donor selection — picked automatically on TPU for
+        named objectives in float32 with the default rand1bin variant
+        and a population of >= 512, or forced with ``use_pallas=True``
+        (interpret mode on CPU, for testing).
 
     >>> opt = DE("rastrigin", n=256, dim=10, seed=0)
     >>> opt.run(300)
@@ -35,21 +46,45 @@ class DE(CheckpointMixin):
         variant: str = "rand1bin",
         seed: int = 0,
         dtype=None,
+        use_pallas: Optional[bool] = None,
+        steps_per_kernel: int = 8,
     ):
         if isinstance(objective, str):
             fn, default_hw = get_objective(objective)
+            self.objective_name: Optional[str] = objective
         else:
             fn, default_hw = objective, 5.12
+            self.objective_name = None
         self.objective = fn
         self.half_width = float(
             half_width if half_width is not None else default_hw
         )
         self.f, self.cr = float(f), float(cr)
         self.variant = variant
+        self.steps_per_kernel = int(steps_per_kernel)
         kwargs = {} if dtype is None else {"dtype": dtype}
         self.state = _k.de_init(
             fn, n, dim, self.half_width, seed=seed, **kwargs
         )
+
+        supported = (
+            variant == "rand1bin"
+            and n >= 512          # rotational donors need >= 4 lane tiles
+            and self.objective_name is not None
+            and _df.de_pallas_supported(
+                self.objective_name or "", self.state.pos.dtype
+            )
+        )
+        if use_pallas is None:
+            self.use_pallas = supported and _on_tpu()
+        elif use_pallas and not supported:
+            raise ValueError(
+                "use_pallas=True needs a named objective from "
+                "ops.objectives, float32 state, variant='rand1bin', "
+                "and n >= 512"
+            )
+        else:
+            self.use_pallas = bool(use_pallas)
 
     def step(self) -> _k.DEState:
         self.state = _k.de_step(
@@ -59,10 +94,20 @@ class DE(CheckpointMixin):
         return self.state
 
     def run(self, n_steps: int) -> _k.DEState:
-        self.state = _k.de_run(
-            self.state, self.objective, n_steps, self.f, self.cr,
-            self.half_width, self.variant,
-        )
+        if self.use_pallas:
+            on_tpu = _on_tpu()
+            self.state = _df.fused_de_run(
+                self.state, self.objective_name, n_steps,
+                self.f, self.cr, self.half_width,
+                rng="tpu" if on_tpu else "host",
+                interpret=not on_tpu,
+                steps_per_kernel=self.steps_per_kernel,
+            )
+        else:
+            self.state = _k.de_run(
+                self.state, self.objective, n_steps, self.f, self.cr,
+                self.half_width, self.variant,
+            )
         jax.block_until_ready(self.state.best_fit)
         return self.state
 

@@ -8,6 +8,8 @@ import jax
 
 from ..ops import firefly as _k
 from ..ops.objectives import get_objective
+from ..ops.pallas import firefly_fused as _ff
+from ..utils.platform import on_tpu as _on_tpu
 from ._checkpoint import CheckpointMixin
 
 
@@ -16,6 +18,13 @@ class Firefly(CheckpointMixin):
 
     Synchronous generation-at-once variant (ops/firefly.py); the random
     walk scale ``alpha0`` decays by ``alpha_decay`` per iteration.
+
+    Two compute paths with the same FireflyState contract and update
+    rule: the portable XLA step materializes the [N, N] weight matrix
+    (fast to ~16k, OOM beyond ~32k); the tiled Pallas path
+    (ops/pallas/firefly_fused.py) streams interaction blocks through
+    VMEM — modestly faster at 16k and the only option at 65k+.
+    Auto-selected on TPU for n >= 8192; force with ``use_pallas``.
 
     >>> opt = Firefly("sphere", n=64, dim=4, seed=0)
     >>> opt.run(150)
@@ -34,6 +43,7 @@ class Firefly(CheckpointMixin):
         alpha_decay: float = _k.ALPHA_DECAY,
         seed: int = 0,
         dtype=None,
+        use_pallas: Optional[bool] = None,
     ):
         if isinstance(objective, str):
             fn, default_hw = get_objective(objective)
@@ -51,6 +61,17 @@ class Firefly(CheckpointMixin):
         self.state = _k.firefly_init(
             fn, n, dim, self.half_width, seed=seed, **kwargs
         )
+        # The tiled path works for any objective callable (the tail is
+        # portable XLA); f32 only (the kernel accumulates in f32).
+        import jax.numpy as jnp
+
+        supported = self.state.pos.dtype == jnp.float32
+        if use_pallas is None:
+            self.use_pallas = supported and n >= 8192 and _on_tpu()
+        elif use_pallas and not supported:
+            raise ValueError("use_pallas=True needs float32 state")
+        else:
+            self.use_pallas = bool(use_pallas)
 
     def step(self) -> _k.FireflyState:
         self.state = _k.firefly_step(
@@ -60,10 +81,17 @@ class Firefly(CheckpointMixin):
         return self.state
 
     def run(self, n_steps: int) -> _k.FireflyState:
-        self.state = _k.firefly_run(
-            self.state, self.objective, n_steps, self.half_width,
-            self.beta0, self.gamma, self.alpha0, self.alpha_decay,
-        )
+        if self.use_pallas:
+            self.state = _ff.fused_firefly_run(
+                self.state, self.objective, n_steps, self.half_width,
+                self.beta0, self.gamma, self.alpha0, self.alpha_decay,
+                interpret=not _on_tpu(),
+            )
+        else:
+            self.state = _k.firefly_run(
+                self.state, self.objective, n_steps, self.half_width,
+                self.beta0, self.gamma, self.alpha0, self.alpha_decay,
+            )
         jax.block_until_ready(self.state.best_fit)
         return self.state
 

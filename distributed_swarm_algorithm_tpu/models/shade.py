@@ -8,6 +8,8 @@ import jax
 
 from ..ops import shade as _k
 from ..ops.objectives import get_objective
+from ..ops.pallas import shade_fused as _sf
+from ..utils.platform import on_tpu as _on_tpu
 from ._checkpoint import CheckpointMixin
 
 
@@ -16,6 +18,15 @@ class SHADE(CheckpointMixin):
     sampled around a circular memory of recently-successful settings,
     mutation is current-to-pbest/1 with an external archive of defeated
     parents — the self-tuning member of the DE lineage.
+
+    Two compute paths with the same SHADEState contract:
+      - portable jit'd JAX (exact paper semantics; donor-gather-bound
+        on TPU at large N),
+      - the fused SHADE-R Pallas kernel (ops/pallas/shade_fused.py,
+        rotational donors; memory adaptation stays exact per
+        generation) — picked automatically on TPU for named objectives
+        in float32 with default p_best and n >= 512, or forced with
+        ``use_pallas=True`` (interpret mode on CPU, for testing).
 
     >>> opt = SHADE("rastrigin", n=256, dim=10, seed=0)
     >>> opt.run(300)
@@ -31,11 +42,14 @@ class SHADE(CheckpointMixin):
         p_best: float = _k.P_BEST,
         seed: int = 0,
         dtype=None,
+        use_pallas: Optional[bool] = None,
     ):
         if isinstance(objective, str):
             fn, default_hw = get_objective(objective)
+            self.objective_name: Optional[str] = objective
         else:
             fn, default_hw = objective, 5.12
+            self.objective_name = None
         self.objective = fn
         self.half_width = float(
             half_width if half_width is not None else default_hw
@@ -48,6 +62,25 @@ class SHADE(CheckpointMixin):
             fn, n, dim, self.half_width, seed=seed, **kwargs
         )
 
+        supported = (
+            p_best == _k.P_BEST     # SHADE-R uses its own elite pool
+            and n >= 512            # rotational donors need >= 4 tiles
+            and self.objective_name is not None
+            and _sf.shade_pallas_supported(
+                self.objective_name or "", self.state.pos.dtype
+            )
+        )
+        if use_pallas is None:
+            self.use_pallas = supported and _on_tpu()
+        elif use_pallas and not supported:
+            raise ValueError(
+                "use_pallas=True needs a named objective from "
+                "ops.objectives, float32 state, default p_best, and "
+                "n >= 512"
+            )
+        else:
+            self.use_pallas = bool(use_pallas)
+
     def step(self) -> _k.SHADEState:
         self.state = _k.shade_step(
             self.state, self.objective, self.half_width, self.p_best
@@ -55,10 +88,19 @@ class SHADE(CheckpointMixin):
         return self.state
 
     def run(self, n_steps: int) -> _k.SHADEState:
-        self.state = _k.shade_run(
-            self.state, self.objective, n_steps, self.half_width,
-            self.p_best,
-        )
+        if self.use_pallas:
+            on_tpu = _on_tpu()
+            self.state = _sf.fused_shade_run(
+                self.state, self.objective_name, n_steps,
+                self.half_width,
+                rng="tpu" if on_tpu else "host",
+                interpret=not on_tpu,
+            )
+        else:
+            self.state = _k.shade_run(
+                self.state, self.objective, n_steps, self.half_width,
+                self.p_best,
+            )
         jax.block_until_ready(self.state.best_fit)
         return self.state
 

@@ -1,0 +1,100 @@
+"""Fused Pallas DE kernel (ops/pallas/de_fused.py): rotational-donor
+semantics, padding/convergence contract, and the model-level backend
+switch.  Runs the real kernel body on CPU via ``interpret=True`` with
+host RNG, like the PSO/bat/GWO siblings."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_swarm_algorithm_tpu.models.de import DE
+from distributed_swarm_algorithm_tpu.ops.de import de_init, de_run
+from distributed_swarm_algorithm_tpu.ops.objectives import (
+    rastrigin,
+    sphere,
+)
+from distributed_swarm_algorithm_tpu.ops.pallas.de_fused import (
+    _distinct_tile_shifts,
+    de_pallas_supported,
+    fused_de_run,
+)
+
+HW = 5.12
+
+
+def test_fused_run_converges_sphere():
+    st = de_init(sphere, 1000, 6, HW, seed=0)
+    out = fused_de_run(st, "sphere", 150, half_width=HW, rng="host",
+                       interpret=True)
+    assert out.pos.shape == (1000, 6)
+    assert int(out.iteration) == 150
+    assert float(out.best_fit) < 1e-4
+    assert bool((jnp.abs(out.pos) <= HW + 1e-5).all())
+    # best tracks the population minimum over a superset of members
+    assert float(out.best_fit) <= float(out.fit.min()) + 1e-6
+
+
+def test_fused_matches_portable_regime_on_rastrigin():
+    """Rotational donors + snapshot staleness must stay in the portable
+    path's optimization regime (not bit-equal — different donor law)."""
+    st = de_init(rastrigin, 2048, 8, HW, seed=1)
+    fused = fused_de_run(st, "rastrigin", 200, half_width=HW,
+                         rng="host", interpret=True)
+    portable = de_run(st, rastrigin, 200, half_width=HW)
+    f, p = float(fused.best_fit), float(portable.best_fit)
+    assert f < p * 3.0 + 5.0, (f, p)
+
+
+def test_fused_best_monotone_and_deterministic():
+    st = de_init(rastrigin, 512, 6, HW, seed=3)
+    prev = float(st.best_fit)
+    s = st
+    for _ in range(3):
+        s = fused_de_run(s, "rastrigin", 10, half_width=HW,
+                         rng="host", interpret=True)
+        cur = float(s.best_fit)
+        assert cur <= prev + 1e-6
+        prev = cur
+    a = fused_de_run(st, "rastrigin", 25, half_width=HW, rng="host",
+                     interpret=True)
+    b = fused_de_run(st, "rastrigin", 25, half_width=HW, rng="host",
+                     interpret=True)
+    np.testing.assert_array_equal(np.asarray(a.pos), np.asarray(b.pos))
+
+
+def test_fused_pads_non_aligned_population():
+    st = de_init(sphere, 700, 5, HW, seed=2)   # 700 not lane-aligned
+    out = fused_de_run(st, "sphere", 40, half_width=HW, rng="host",
+                       interpret=True)
+    assert out.pos.shape == (700, 5)
+    assert float(out.best_fit) <= float(st.best_fit) + 1e-6
+
+
+def test_tiny_population_rejected():
+    st = de_init(sphere, 64, 5, HW, seed=2)    # < 4 tiles of 128
+    with pytest.raises(ValueError, match="rotational"):
+        fused_de_run(st, "sphere", 5, half_width=HW, rng="host",
+                     interpret=True)
+
+
+def test_distinct_tile_shifts():
+    import jax
+
+    for seed in range(20):
+        a, b, c = _distinct_tile_shifts(jax.random.PRNGKey(seed), 8)
+        vals = {int(a), int(b), int(c)}
+        assert len(vals) == 3
+        assert 0 not in vals
+        assert all(1 <= v <= 7 for v in vals)
+
+
+def test_de_model_backend_switch():
+    assert de_pallas_supported("rastrigin", jnp.float32)
+    assert not de_pallas_supported("rastrigin", jnp.bfloat16)
+    opt = DE("sphere", n=1024, dim=4, seed=0, use_pallas=True)
+    opt.run(60)
+    assert opt.best < 1e-3
+    with pytest.raises(ValueError):
+        DE("sphere", n=64, dim=4, seed=0, use_pallas=True)   # tiny pop
+    with pytest.raises(ValueError):
+        DE(sphere, n=1024, dim=4, seed=0, use_pallas=True)   # callable

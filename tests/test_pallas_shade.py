@@ -1,0 +1,83 @@
+"""Fused SHADE-R Pallas kernel (ops/pallas/shade_fused.py): the
+rotational-donor SHADE variant with exact per-generation success-memory
+adaptation.  Interpret-mode on CPU with host RNG, like the siblings."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_swarm_algorithm_tpu.models.shade import SHADE
+from distributed_swarm_algorithm_tpu.ops.objectives import (
+    rastrigin,
+    sphere,
+)
+from distributed_swarm_algorithm_tpu.ops.pallas.shade_fused import (
+    fused_shade_run,
+    shade_pallas_supported,
+)
+from distributed_swarm_algorithm_tpu.ops.shade import shade_init, shade_run
+
+HW = 5.12
+
+
+def test_fused_run_converges_sphere():
+    st = shade_init(sphere, 1024, 6, HW, seed=0)
+    out = fused_shade_run(st, "sphere", 150, half_width=HW, rng="host",
+                          interpret=True)
+    assert out.pos.shape == (1024, 6)
+    assert int(out.iteration) == 150
+    assert float(out.best_fit) < 1e-4
+    assert bool((jnp.abs(out.pos) <= HW + 1e-5).all())
+    assert float(out.best_fit) <= float(out.fit.min()) + 1e-6
+
+
+def test_fused_matches_portable_regime():
+    st = shade_init(rastrigin, 2048, 8, HW, seed=1)
+    fused = fused_shade_run(st, "rastrigin", 200, half_width=HW,
+                            rng="host", interpret=True)
+    portable = shade_run(st, rastrigin, 200, half_width=HW)
+    f, p = float(fused.best_fit), float(portable.best_fit)
+    assert f < p * 3.0 + 5.0, (f, p)
+
+
+def test_memory_adapts_and_archive_fills():
+    st = shade_init(rastrigin, 1024, 6, HW, seed=2)
+    out = fused_shade_run(st, "rastrigin", 60, half_width=HW,
+                          rng="host", interpret=True)
+    # Success memory moved off its 0.5 init somewhere.
+    moved = (
+        float(jnp.max(jnp.abs(out.m_f - 0.5))) > 1e-6
+        or float(jnp.max(jnp.abs(out.m_cr - 0.5))) > 1e-6
+    )
+    assert moved
+    assert int(out.archive_n) == 1024      # pre-filled archive
+    assert bool(jnp.isfinite(out.archive).all())
+
+
+def test_fused_deterministic():
+    st = shade_init(rastrigin, 512, 6, HW, seed=3)
+    a = fused_shade_run(st, "rastrigin", 25, half_width=HW, rng="host",
+                        interpret=True)
+    b = fused_shade_run(st, "rastrigin", 25, half_width=HW, rng="host",
+                        interpret=True)
+    np.testing.assert_array_equal(np.asarray(a.pos), np.asarray(b.pos))
+    assert float(a.best_fit) == float(b.best_fit)
+
+
+def test_tiny_population_rejected():
+    st = shade_init(sphere, 64, 5, HW, seed=2)
+    with pytest.raises(ValueError, match="rotational"):
+        fused_shade_run(st, "sphere", 5, half_width=HW, rng="host",
+                        interpret=True)
+
+
+def test_shade_model_backend_switch():
+    assert shade_pallas_supported("rastrigin", jnp.float32)
+    assert not shade_pallas_supported("rastrigin", jnp.bfloat16)
+    opt = SHADE("sphere", n=1024, dim=4, seed=0, use_pallas=True)
+    opt.run(60)
+    assert opt.best < 1e-3
+    with pytest.raises(ValueError):
+        SHADE("sphere", n=64, dim=4, seed=0, use_pallas=True)
+    with pytest.raises(ValueError):
+        SHADE(sphere, n=1024, dim=4, seed=0, use_pallas=True)

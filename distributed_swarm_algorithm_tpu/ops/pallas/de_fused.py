@@ -189,6 +189,33 @@ def fused_de_step_t(
     )(scalars.astype(jnp.int32), *operands)
 
 
+def shrink_tile_for_donors(
+    n: int, tile_n: int, per_shard: int = 1
+) -> Tuple[int, int, int]:
+    """Shrink the lane tile (in 128-lane multiples — Mosaic alignment;
+    a halved non-multiple like 160 would break pltpu.roll) until each
+    shard of ``n`` split ``per_shard`` ways has >= 4 tiles, so the
+    three donor tile shifts can be distinct and nonzero.  Returns
+    ``(tile_n, n_pad, n_tiles_per_shard)``; raises when even 128-lane
+    tiles cannot provide 4 per shard.  Shared by the single-chip driver
+    (fused_de_run, shade_fused) and the shmap driver
+    (parallel/sharding.py) so their acceptance/tiling cannot drift."""
+    n_pad = _ceil_to(n, per_shard * tile_n)
+    n_tiles = (n_pad // per_shard) // tile_n
+    while n_tiles < 4 and tile_n > 128:
+        tile_n = max(128, (tile_n // 2) // 128 * 128)
+        n_pad = _ceil_to(n, per_shard * tile_n)
+        n_tiles = (n_pad // per_shard) // tile_n
+    if n_tiles < 4:
+        raise ValueError(
+            f"population n={n} too small for rotational donors"
+            + (f" on {per_shard} devices" if per_shard > 1 else "")
+            + " (need >= 4 lane tiles of 128 per shard); use the"
+            " portable path"
+        )
+    return tile_n, n_pad, n_tiles
+
+
 def _distinct_tile_shifts(key, n_tiles: int):
     """Three distinct nonzero shifts mod n_tiles (incremental-shift
     trick, same as ops/de._distinct3 but over {1..n_tiles-1})."""
@@ -242,21 +269,7 @@ def fused_de_run(
     if tile_n is None:
         tile_n = _auto_tile(_ceil_to(max(d, 8), 8))
     tile_n = min(tile_n, _ceil_to(n, 128))
-    n_pad = _ceil_to(n, tile_n)
-    n_tiles = n_pad // tile_n
-    if n_tiles < 4:
-        # Shrink the lane tile until the donor shifts have room,
-        # keeping it a multiple of 128 (Mosaic lane alignment; a
-        # halved non-multiple like 160 would break pltpu.roll).
-        while n_tiles < 4 and tile_n > 128:
-            tile_n = max(128, (tile_n // 2) // 128 * 128)
-            n_pad = _ceil_to(n, tile_n)
-            n_tiles = n_pad // tile_n
-        if n_tiles < 4:
-            raise ValueError(
-                f"population n={n} too small for rotational donors "
-                "(need >= 4 lane tiles of 128); use ops.de.de_run"
-            )
+    tile_n, n_pad, n_tiles = shrink_tile_for_donors(n, tile_n)
 
     pos_t = _cyclic_pad_rows(state.pos, n_pad).T
     fit_t = _cyclic_pad_rows(state.fit, n_pad)[None, :]

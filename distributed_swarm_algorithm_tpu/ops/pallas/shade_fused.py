@@ -229,19 +229,9 @@ def fused_shade_run(
     if tile_n is None:
         tile_n = _auto_tile(_ceil_to(max(d, 8), 8))
     tile_n = min(tile_n, _ceil_to(n, 128))
-    n_pad = _ceil_to(n, tile_n)
-    n_tiles = n_pad // tile_n
-    if n_tiles < 4:
-        # Multiples of 128 only (Mosaic lane alignment — see de_fused).
-        while n_tiles < 4 and tile_n > 128:
-            tile_n = max(128, (tile_n // 2) // 128 * 128)
-            n_pad = _ceil_to(n, tile_n)
-            n_tiles = n_pad // tile_n
-        if n_tiles < 4:
-            raise ValueError(
-                f"population n={n} too small for rotational donors "
-                "(need >= 4 lane tiles of 128); use ops.shade.shade_run"
-            )
+    from .de_fused import shrink_tile_for_donors
+
+    tile_n, n_pad, n_tiles = shrink_tile_for_donors(n, tile_n)
     win = max(tile_n, n_pad // archive_window_frac)
     win = min(_ceil_to(win, 128), n_pad)
 

@@ -674,6 +674,134 @@ def fused_gwo_run_shmap(
     )
 
 
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "mesh", "n_steps", "axis", "f", "cr",
+        "half_width", "steps_per_kernel", "tile_n", "rng", "interpret",
+    ),
+)
+def fused_de_run_shmap(
+    state,
+    objective_name: str,
+    mesh: Mesh,
+    n_steps: int,
+    axis: str = AGENT_AXIS,
+    f: float | None = None,
+    cr: float | None = None,
+    half_width: float = 5.12,
+    steps_per_kernel: int = 8,
+    tile_n: int | None = None,
+    rng: str = "tpu",
+    interpret: bool = False,
+):
+    """Multi-chip fused-Pallas DE: each device runs rotational-donor DE
+    blocks (ops/pallas/de_fused.py) on its population shard; the global
+    best is exchanged over ICI per block (``pmin`` + ``psum``
+    broadcast).  Donor pools are SHARD-LOCAL between exchanges — the
+    mesh behaves like an island model whose islands share their best
+    every ``steps_per_kernel`` generations, the same semantic lag class
+    as every other fused shmap driver here.  Each shard needs >= 4 lane
+    tiles for distinct donor shifts (n >= devices * 512)."""
+    from ..ops.de import DEState, CR as _CR, F as _F
+    from ..ops.pallas.common import ceil_to, cyclic_pad_rows
+    from ..ops.pallas.de_fused import (
+        _auto_tile,
+        _distinct_tile_shifts,
+        best_of_block,
+        fused_de_step_t,
+        host_uniforms,
+        run_blocks,
+        seed_base,
+        shrink_tile_for_donors,
+    )
+
+    f = _F if f is None else f
+    cr = _CR if cr is None else cr
+    n, d = state.pos.shape
+    n_dev = mesh.shape[axis]
+    if rng == "host":
+        steps_per_kernel = 1
+    steps_per_kernel = min(steps_per_kernel, 32)   # VMEM (see de_fused)
+    if tile_n is None:
+        tile_n = _auto_tile(ceil_to(max(d, 8), 8))
+    tile_n = min(tile_n, ceil_to(-(-n // n_dev), 128))
+    tile_n, n_pad, n_tiles_local = shrink_tile_for_donors(
+        n, tile_n, per_shard=n_dev
+    )
+
+    pos_t = cyclic_pad_rows(state.pos, n_pad).T
+    fit_t = cyclic_pad_rows(state.fit, n_pad)[None, :]
+    seed0 = seed_base(state.key)
+    host_key = jax.random.fold_in(state.key, 0xDE)
+    shift_key = jax.random.fold_in(state.key, 0x5F1F7)
+
+    col = P(None, axis)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(col, col, P(), P()),
+        out_specs=(col, col, P(), P()),
+        check_vma=False,
+    )
+    def run(pos_t, fit_t, best_pos, best_fit):
+        dev = lax.axis_index(axis)
+
+        def block(carry, call_i, k):
+            pos_t, fit_t, best_pos, best_fit = carry
+            kk = jax.random.fold_in(
+                jax.random.fold_in(shift_key, call_i), dev
+            )
+            sa, sb, sc = _distinct_tile_shifts(kk, n_tiles_local)
+            lanes = jax.random.randint(
+                jax.random.fold_in(kk, 1), (3,), 0, tile_n
+            )
+            scalars = jnp.concatenate([
+                jnp.stack([
+                    seed0 + (call_i * n_dev + dev) * n_tiles_local,
+                    sa, sb, sc,
+                ]),
+                lanes,
+            ]).astype(jnp.int32)
+            r = None
+            if rng == "host":
+                (r, _) = host_uniforms(
+                    host_key, call_i, pos_t.shape, fold=dev
+                )
+            pos_t, fit_t = fused_de_step_t(
+                scalars, pos_t, fit_t, r,
+                objective_name=objective_name, f=f, cr=cr,
+                half_width=half_width, tile_n=tile_n, rng=rng,
+                interpret=interpret, k_steps=k,
+            )
+            loc_fit, loc_pos = best_of_block(fit_t, pos_t)
+            best_fit, best_pos = _exchange_best(
+                loc_fit, loc_pos, best_fit, best_pos, dev, axis
+            )
+            return (pos_t, fit_t, best_pos, best_fit)
+
+        return run_blocks(
+            block, (pos_t, fit_t, best_pos, best_fit),
+            n_steps, steps_per_kernel,
+        )
+
+    pos_t, fit_t, best_pos, best_fit = run(
+        pos_t, fit_t,
+        state.best_pos.astype(jnp.float32),
+        state.best_fit.astype(jnp.float32),
+    )
+    dt = state.pos.dtype
+    return DEState(
+        pos=pos_t.T[:n].astype(dt),
+        fit=fit_t[0, :n].astype(state.fit.dtype),
+        best_pos=best_pos.astype(state.best_pos.dtype),
+        best_fit=best_fit.astype(state.best_fit.dtype),
+        key=jax.random.fold_in(state.key, n_steps),
+        iteration=state.iteration + n_steps,
+    )
+
+
 def elect_shmap(
     alive: jax.Array,
     agent_id: jax.Array,

@@ -98,3 +98,27 @@ def test_de_model_backend_switch():
         DE("sphere", n=64, dim=4, seed=0, use_pallas=True)   # tiny pop
     with pytest.raises(ValueError):
         DE(sphere, n=1024, dim=4, seed=0, use_pallas=True)   # callable
+
+
+def test_fused_de_shmap_multichip():
+    """8-virtual-device mesh: per-shard rotational DE + cross-device
+    best exchange.  n=8192 gives each shard 4+ lane tiles of 128."""
+    from distributed_swarm_algorithm_tpu.parallel.mesh import make_mesh
+    from distributed_swarm_algorithm_tpu.parallel.sharding import (
+        fused_de_run_shmap,
+    )
+
+    mesh = make_mesh()
+    st = de_init(sphere, 8192, 5, HW, seed=0)
+    out = fused_de_run_shmap(
+        st, "sphere", mesh, 60, rng="host", interpret=True
+    )
+    assert out.pos.shape == (8192, 5)
+    assert int(out.iteration) == 60
+    assert float(out.best_fit) < 1e-2
+    assert float(out.best_fit) <= float(out.fit.min()) + 1e-6
+    # deterministic
+    out2 = fused_de_run_shmap(
+        st, "sphere", mesh, 60, rng="host", interpret=True
+    )
+    assert float(out2.best_fit) == float(out.best_fit)

@@ -56,12 +56,24 @@ def main() -> int:
     quick = "--quick" in sys.argv[1:]
     failures = 0
     if "--tests" in sys.argv[1:]:
-        rc = subprocess.call(
-            [sys.executable, "-m", "pytest", "tests/", "-q", "-m", ""],
-            cwd=os.path.dirname(HERE),
-        )
-        if rc != 0:
-            return rc
+        # Full gate = TWO pytest processes (default set, then the slow
+        # set).  Running all ~470 tests in ONE process segfaults XLA's
+        # CPU backend_compile_and_load deterministically late in the
+        # run (reproduced with the persistent compile cache both on and
+        # off; the crashing test passes solo and in either half) — an
+        # accumulated-in-process-state issue in XLA CPU, not in this
+        # code.  Each half has been stable across many runs, so process
+        # isolation is the correctness-preserving mitigation.
+        for marker in ("not slow", "slow"):
+            rc = subprocess.call(
+                [
+                    sys.executable, "-m", "pytest", "tests/", "-q",
+                    "-m", marker, "-p", "no:randomly",
+                ],
+                cwd=os.path.dirname(HERE),
+            )
+            if rc != 0:
+                return rc
     for name in BENCHES:
         if quick and name in QUICK_SKIP:
             continue

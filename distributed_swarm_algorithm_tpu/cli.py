@@ -65,6 +65,12 @@ def _add_agent_args(p: argparse.ArgumentParser) -> None:
                    help="seed a task (repeatable); statuses are reported "
                         "in the exit JSON — gives the multi-process "
                         "deployment an end-to-end allocation path")
+    p.add_argument("--hold", action="store_true",
+                   help="after binding the transport (and printing the "
+                        "online beacon), wait for one line on stdin "
+                        "before starting the tick loop — lets an "
+                        "orchestrator start N agents simultaneously "
+                        "regardless of per-process startup skew")
 
 
 def _parse_addr(addr: str):
@@ -80,6 +86,12 @@ def _cmd_agent(args) -> int:
     import logging
 
     from .models.agent import SwarmAgent, TcpTransport, UdpTransport
+
+    if args.hold and not args.bind:
+        raise SystemExit(
+            "error: --hold requires --bind (the release contract is "
+            "the 'online' beacon, which only a bound transport prints)"
+        )
 
     # The reference logs agent lifecycle at INFO (agent.py:9-10); match it
     # so elections/claims are visible from the terminal.
@@ -123,6 +135,11 @@ def _cmd_agent(args) -> int:
             "online: %s transport bound to %s", args.transport, args.bind
         )
     try:
+        if args.hold:
+            sys.stdin.readline()
+            # The heartbeat clock started at construction; re-arm it so
+            # the election timeout counts from the synchronized start.
+            agent.last_heartbeat_time = agent.time_fn()
         if args.steps:
             period = 1.0 / agent.config.tick_rate_hz
             for _ in range(args.steps):

@@ -44,7 +44,8 @@ def _free_ports(n: int, kind=socket.SOCK_DGRAM) -> list[int]:
     return ports
 
 
-def _spawn(agent_id, ports, *, transport, steps, tasks=(), caps=()):
+def _spawn(agent_id, ports, *, transport, steps, tasks=(), caps=(),
+           hold=False):
     """Start one CLI agent process; peers = every other port."""
     me = ports[agent_id]
     peers = [f"127.0.0.1:{p}" for p in ports if p != me]
@@ -59,10 +60,28 @@ def _spawn(agent_id, ports, *, transport, steps, tasks=(), caps=()):
         cmd += ["--task", t]
     if caps:
         cmd += ["--caps", *caps]
+    if hold:
+        cmd += ["--hold"]
     return subprocess.Popen(
         cmd, env=_ENV, text=True,
+        stdin=subprocess.PIPE if hold else None,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
     )
+
+
+def _release(procs):
+    """Drop the --hold barrier on every agent at once (they have all
+    printed their online beacon, so transports are bound).  A dead
+    agent's broken pipe must not block releasing the others — the
+    caller's assertions will surface its failure."""
+    for p in procs:
+        try:
+            p.stdin.write("\n")
+            p.stdin.flush()
+            # stdin stays open: communicate() closes it and raises if
+            # we already did.
+        except (BrokenPipeError, OSError):
+            pass
 
 
 def _wait_for_stderr(proc, needle: str, timeout: float) -> str:
@@ -122,15 +141,29 @@ def test_election_and_allocation_end_to_end(transport):
     TASK_CLAIM/TASK_CONFLICT arbitration over actual sockets."""
     kind = socket.SOCK_STREAM if transport == "tcp" else socket.SOCK_DGRAM
     ports = _free_ports(3, kind)
-    # 350 ticks at 50 Hz = 7 s: election (~35 ticks incl. jitter), the
-    # pre-leader TENTATIVE claims re-opening (+30 ticks), re-claim and
-    # verdict broadcast, plus margin for busy-host scheduling stalls.
+    # --hold barrier: jax import skew between the three processes on a
+    # busy 1-core host can exceed the whole scenario length, so agents
+    # wait at the barrier until everyone's transport is bound, then
+    # start their tick loops together.  350 ticks at 50 Hz = 7 s:
+    # election (~35 ticks incl. jitter), the pre-leader TENTATIVE
+    # claims re-opening (+30 ticks), re-claim and verdict broadcast,
+    # plus margin for scheduling stalls.
     procs = [
         _spawn(i, ports, transport=transport, steps=350,
-               tasks=["7,1.0,1.0"])
+               tasks=["7,1.0,1.0"], hold=True)
         for i in range(3)
     ]
-    outs = _collect_json(procs, timeout=_STARTUP_TIMEOUT + 30)
+    try:
+        for p in procs:
+            _wait_for_stderr(p, "online", _STARTUP_TIMEOUT)
+        _release(procs)
+        outs = _collect_json(procs, timeout=_STARTUP_TIMEOUT + 30)
+    finally:
+        # A failure before/at release must not orphan held agents (they
+        # would sit in readline() with bound ports for the whole run).
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
 
     leaders = [o["id"] for o in outs if o["state"] == "LEADER"]
     assert len(leaders) == 1, f"want exactly one leader: {outs}"

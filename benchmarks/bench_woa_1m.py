@@ -1,0 +1,39 @@
+"""Fused WOA at 1M whales (seventh fused family).
+
+Portable WOA measures ~24M whale-steps/s at 1M — the random-peer row
+gather (`pos[rand_idx]`) bounds it like portable DE's donors.  The
+fused kernel (ops/pallas/woa_fused.py: rotational peer + poly-trig
+spiral) removes the gather.
+"""
+
+from __future__ import annotations
+
+from common import REFERENCE_AGENT_STEPS_PER_SEC, report, timeit_best
+
+from distributed_swarm_algorithm_tpu.models.woa import WOA
+
+N = 1_048_576
+DIM = 30
+STEPS = 512
+
+
+def main() -> None:
+    opt = WOA("rastrigin", n=N, dim=DIM, t_max=STEPS, seed=0)
+    float(opt.state.best_fit)
+    opt.run(STEPS)
+    float(opt.state.best_fit)
+    best = timeit_best(
+        lambda: opt.run(STEPS), lambda: float(opt.state.best_fit),
+        reps=3,
+    )
+    path = "pallas-fused" if opt.use_pallas else "xla-jit"
+    report(
+        f"agent-steps/sec, WOA Rastrigin-30D, {N} whales, 1 chip ({path})",
+        N * STEPS / best,
+        "agent-steps/sec",
+        REFERENCE_AGENT_STEPS_PER_SEC,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -30,6 +30,7 @@ BENCHES = [
     "bench_gwo_1m.py",
     "bench_de_1m.py",
     "bench_shade_1m.py",
+    "bench_woa_1m.py",
     "bench_firefly_64k.py",
     "bench_swarm_tpu.py",
     "bench_boids.py",
@@ -44,6 +45,7 @@ QUICK_SKIP = {
     "bench_gwo_1m.py",
     "bench_de_1m.py",
     "bench_shade_1m.py",
+    "bench_woa_1m.py",
     "bench_firefly_64k.py",
     "bench_swarm_tpu.py",
     "bench_boids.py",

@@ -8,6 +8,8 @@ import jax
 
 from ..ops import woa as _k
 from ..ops.objectives import get_objective
+from ..ops.pallas import woa_fused as _wf
+from ..utils.platform import on_tpu as _on_tpu
 from ._checkpoint import CheckpointMixin
 
 
@@ -16,6 +18,12 @@ class WOA(CheckpointMixin):
 
     ``t_max`` sets the exploration schedule length (a: 2 → 0); the pod
     exploits fully once ``t_max`` iterations have elapsed.
+
+    Two compute paths with the same WOAState contract: portable jit'd
+    JAX (exact iid random-peer draws — row-gather-bound on TPU at large
+    N) and the fused Pallas kernel (ops/pallas/woa_fused.py, rotational
+    random peer + per-block best snapshot) — auto-selected on TPU for
+    named objectives in float32, or forced with ``use_pallas=True``.
 
     >>> opt = WOA("sphere", n=64, dim=6, t_max=200, seed=0)
     >>> opt.run(200)
@@ -32,11 +40,15 @@ class WOA(CheckpointMixin):
         spiral_b: float = _k.SPIRAL_B,
         seed: int = 0,
         dtype=None,
+        use_pallas: Optional[bool] = None,
+        steps_per_kernel: int = 8,
     ):
         if isinstance(objective, str):
             fn, default_hw = get_objective(objective)
+            self.objective_name: Optional[str] = objective
         else:
             fn, default_hw = objective, 5.12
+            self.objective_name = None
         self.objective = fn
         self.half_width = float(
             half_width if half_width is not None else default_hw
@@ -45,10 +57,27 @@ class WOA(CheckpointMixin):
             raise ValueError(f"t_max must be >= 1, got {t_max}")
         self.t_max = int(t_max)
         self.spiral_b = float(spiral_b)
+        self.steps_per_kernel = int(steps_per_kernel)
         kwargs = {} if dtype is None else {"dtype": dtype}
         self.state = _k.woa_init(
             fn, n, dim, self.half_width, seed=seed, **kwargs
         )
+
+        supported = (
+            self.objective_name is not None
+            and _wf.woa_pallas_supported(
+                self.objective_name or "", self.state.pos.dtype
+            )
+        )
+        if use_pallas is None:
+            self.use_pallas = supported and _on_tpu()
+        elif use_pallas and not supported:
+            raise ValueError(
+                "use_pallas=True needs a named objective from "
+                "ops.objectives and float32 state"
+            )
+        else:
+            self.use_pallas = bool(use_pallas)
 
     def step(self) -> _k.WOAState:
         self.state = _k.woa_step(
@@ -58,10 +87,20 @@ class WOA(CheckpointMixin):
         return self.state
 
     def run(self, n_steps: int) -> _k.WOAState:
-        self.state = _k.woa_run(
-            self.state, self.objective, n_steps, self.half_width,
-            self.t_max, self.spiral_b,
-        )
+        if self.use_pallas:
+            on_tpu = _on_tpu()
+            self.state = _wf.fused_woa_run(
+                self.state, self.objective_name, n_steps,
+                self.half_width, self.t_max, self.spiral_b,
+                rng="tpu" if on_tpu else "host",
+                interpret=not on_tpu,
+                steps_per_kernel=self.steps_per_kernel,
+            )
+        else:
+            self.state = _k.woa_run(
+                self.state, self.objective, n_steps, self.half_width,
+                self.t_max, self.spiral_b,
+            )
         jax.block_until_ready(self.state.best_fit)
         return self.state
 

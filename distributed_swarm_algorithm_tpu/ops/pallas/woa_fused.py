@@ -1,0 +1,269 @@
+"""Fused whale-optimization iteration as a single Pallas TPU kernel.
+
+Seventh fused family.  WOA is PSO-shaped — per-whale elementwise math
+referencing one global (the incumbent best) — plus one random-peer
+lookup on the exploration branch, which the portable step implements as
+a row gather (ops/woa.py ``pos[rand_idx]``).  Here the peer comes from
+the same rotational-donor machinery as ops/pallas/de_fused.py (random
+tile shift via a scalar-prefetched index map + dynamic lane roll — two
+block DMAs, zero gathers); unlike DE, self-donation is benign (the
+contraction form stays well-defined when the peer IS the whale), so any
+shift is legal and there is no minimum tile count.
+
+Same chassis as the siblings: lane-major [D, N], on-chip PRNG (two
+[D, T] draws for A/C and two [1, T] row draws for p/l per step),
+k steps per HBM round-trip with the incumbent best and the donor
+snapshot held fixed within a block (same staleness class as the
+delayed-gbest PSO kernel), the spiral's cos(2*pi*l) through the
+polynomial trig (pso_fused._cos2pi), and a host-RNG interpret variant
+with a byte-identical body for CPU testing (tests/test_pallas_woa.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..woa import SPIRAL_B, WOAState
+from .common import ceil_to as _ceil_to, cyclic_pad_rows as _cyclic_pad_rows
+from .de_fused import _LANE_SHIFTS
+from .pso_fused import (
+    OBJECTIVES_T,
+    _auto_tile,
+    _cos2pi,
+    _uniform_bits,
+    best_of_block,
+    host_uniforms,
+    run_blocks,
+    seed_base,
+)
+
+
+def woa_pallas_supported(objective_name, dtype) -> bool:
+    return objective_name in OBJECTIVES_T and jnp.dtype(dtype) == jnp.float32
+
+
+def _make_kernel(objective_t, half_width, t_max, spiral_b, host_rng,
+                 k_steps):
+    def body(scalar_ref, best_ref, pos_ref, peer_ref, r_a, r_c, r_p, r_l,
+             pos_o, fit_o):
+        pos = pos_ref[:]
+        peer0 = peer_ref[:]
+        best = best_ref[:][:, 0:1]                 # [D, 1]
+        t0 = scalar_ref[2].astype(jnp.float32)
+        dlane = scalar_ref[3]
+
+        for step in range(k_steps):
+            frac = jnp.minimum((t0 + step) / t_max, 1.0)
+            a = 2.0 * (1.0 - frac)
+            if host_rng:
+                u_a, u_c, u_p, u_l = r_a, r_c, r_p, r_l
+            else:
+                u_a = _uniform_bits(pos.shape)
+                u_c = _uniform_bits(pos.shape)
+                u_p = _uniform_bits((1,) + pos.shape[1:])
+                u_l = _uniform_bits((1,) + pos.shape[1:])
+
+            big_a = 2.0 * a * u_a - a
+            big_c = 2.0 * u_c
+            peer = pltpu.roll(
+                peer0, dlane + _LANE_SHIFTS[step % len(_LANE_SHIFTS)][0],
+                1,
+            )
+            explore = jnp.abs(big_a) >= 1.0
+            prey = jnp.where(explore, peer, best)
+            contract = prey - big_a * jnp.abs(big_c * prey - pos)
+
+            l = 2.0 * u_l - 1.0                    # [1, T] in [-1, 1)
+            dist_best = jnp.abs(best - pos)
+            spiral = (
+                dist_best * jnp.exp(spiral_b * l) * _cos2pi(l) + best
+            )
+            pos = jnp.clip(
+                jnp.where(u_p < 0.5, contract, spiral),
+                -half_width, half_width,
+            )
+
+        pos_o[:] = pos
+        fit_o[:] = objective_t(pos)
+
+    if host_rng:
+        def kernel(scalar_ref, best_ref, pos_ref, peer_ref, ra_ref,
+                   rc_ref, rp_ref, rl_ref, *outs):
+            body(scalar_ref, best_ref, pos_ref, peer_ref, ra_ref[:],
+                 rc_ref[:], rp_ref[:], rl_ref[:], *outs)
+    else:
+        def kernel(scalar_ref, best_ref, pos_ref, peer_ref, *outs):
+            pltpu.prng_seed(scalar_ref[0] + pl.program_id(0))
+            body(scalar_ref, best_ref, pos_ref, peer_ref, None, None,
+                 None, None, *outs)
+
+    return kernel
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "half_width", "t_max", "spiral_b", "tile_n",
+        "rng", "interpret", "k_steps",
+    ),
+)
+def fused_woa_step_t(
+    scalars: jax.Array,       # [4] i32: seed, peer tile shift, block t0, lane shift
+    best_pos: jax.Array,      # [D, 1]
+    pos: jax.Array,           # [D, N]
+    r_a: jax.Array | None = None,   # [D, N] host-RNG draws
+    r_c: jax.Array | None = None,
+    r_p: jax.Array | None = None,   # [1, N]
+    r_l: jax.Array | None = None,   # [1, N]
+    *,
+    objective_name: str,
+    half_width: float = 5.12,
+    t_max: int = 500,
+    spiral_b: float = SPIRAL_B,
+    tile_n: int = 4096,
+    rng: str = "tpu",
+    interpret: bool = False,
+    k_steps: int = 1,
+) -> Tuple[jax.Array, jax.Array]:
+    """``k_steps`` fused WOA updates; returns ``(pos, fit)``."""
+    d, n = pos.shape
+    if n % tile_n:
+        raise ValueError(f"N ({n}) must be a multiple of tile_n ({tile_n})")
+    n_tiles = n // tile_n
+    host_rng = rng == "host"
+    if host_rng and any(x is None for x in (r_a, r_c, r_p, r_l)):
+        raise ValueError('rng="host" requires r_a, r_c, r_p, r_l')
+    if host_rng and k_steps != 1:
+        raise ValueError('rng="host" supports k_steps=1 only')
+
+    kernel = _make_kernel(
+        OBJECTIVES_T[objective_name], half_width, t_max, spiral_b,
+        host_rng, k_steps,
+    )
+
+    col = lambda i, s: (0, i)                                # noqa: E731
+    fixed = lambda i, s: (0, 0)                              # noqa: E731
+    rot = lambda i, s: (0, jax.lax.rem(i + s[1], n_tiles))   # noqa: E731
+    dn = pl.BlockSpec((d, tile_n), col, memory_space=pltpu.VMEM)
+    ft = pl.BlockSpec((1, tile_n), col, memory_space=pltpu.VMEM)
+
+    b128 = jnp.broadcast_to(best_pos, (d, 128))
+    in_specs = [
+        pl.BlockSpec((d, 128), fixed, memory_space=pltpu.VMEM),
+        dn,
+        pl.BlockSpec((d, tile_n), rot, memory_space=pltpu.VMEM),
+    ]
+    operands = [b128, pos, pos]
+    if host_rng:
+        in_specs += [dn, dn, ft, ft]
+        operands += [r_a, r_c, r_p, r_l]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=in_specs,
+        out_specs=[dn, ft],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((d, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+        ],
+        interpret=interpret,
+    )(scalars.astype(jnp.int32), *operands)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "n_steps", "half_width", "t_max", "spiral_b",
+        "tile_n", "rng", "interpret", "steps_per_kernel",
+    ),
+)
+def fused_woa_run(
+    state: WOAState,
+    objective_name: str,
+    n_steps: int,
+    half_width: float = 5.12,
+    t_max: int = 500,
+    spiral_b: float = SPIRAL_B,
+    tile_n: int | None = None,
+    rng: str = "tpu",
+    interpret: bool = False,
+    steps_per_kernel: int = 8,
+) -> WOAState:
+    """``n_steps`` fused WOA updates — WOAState in, WOAState out,
+    drop-in fast path for ``ops.woa.woa_run`` (deltas: rotational
+    random peer, per-block best/donor snapshots — the module docstring
+    class of staleness)."""
+    n, d = state.pos.shape
+    if rng == "host":
+        steps_per_kernel = 1
+    if tile_n is None:
+        tile_n = _auto_tile(_ceil_to(max(d, 8), 8))
+    tile_n = min(tile_n, _ceil_to(n, 128))
+    n_pad = _ceil_to(n, tile_n)
+    n_tiles = n_pad // tile_n
+
+    pos_t = _cyclic_pad_rows(state.pos, n_pad).T
+    fit_t = _cyclic_pad_rows(state.fit, n_pad)[None, :]
+    seed0 = seed_base(state.key)
+    host_key = jax.random.fold_in(state.key, 0x30A)
+    shift_key = jax.random.fold_in(state.key, 0x0A1)
+
+    def block(carry, call_i, k):
+        pos_t, fit_t, best_pos, best_fit, it = carry
+        kk = jax.random.fold_in(shift_key, call_i)
+        tshift = jax.random.randint(kk, (), 0, n_tiles)
+        lshift = jax.random.randint(
+            jax.random.fold_in(kk, 1), (), 0, tile_n
+        )
+        scalars = jnp.stack(
+            [seed0 + call_i * n_tiles, tshift, it, lshift]
+        ).astype(jnp.int32)
+        r_a = r_c = r_p = r_l = None
+        if rng == "host":
+            r_a, r_c = host_uniforms(host_key, call_i, pos_t.shape)
+            r_p, r_l = host_uniforms(
+                host_key, call_i, fit_t.shape, fold=1
+            )
+        pos_t, fit_t = fused_woa_step_t(
+            scalars, best_pos[:, None], pos_t, r_a, r_c, r_p, r_l,
+            objective_name=objective_name, half_width=half_width,
+            t_max=t_max, spiral_b=spiral_b, tile_n=tile_n, rng=rng,
+            interpret=interpret, k_steps=k,
+        )
+        cand_fit, cand_pos = best_of_block(fit_t, pos_t)
+        improved = cand_fit < best_fit
+        best_fit = jnp.where(improved, cand_fit, best_fit)
+        best_pos = jnp.where(improved, cand_pos, best_pos)
+        return (pos_t, fit_t, best_pos, best_fit, it + k)
+
+    carry = run_blocks(
+        block,
+        (
+            pos_t, fit_t,
+            state.best_pos.astype(jnp.float32),
+            state.best_fit.astype(jnp.float32),
+            state.iteration,
+        ),
+        n_steps, steps_per_kernel,
+    )
+    pos_t, fit_t, best_pos, best_fit, _ = carry
+    dt = state.pos.dtype
+    return WOAState(
+        pos=pos_t.T[:n].astype(dt),
+        fit=fit_t[0, :n].astype(state.fit.dtype),
+        best_pos=best_pos.astype(state.best_pos.dtype),
+        best_fit=best_fit.astype(state.best_fit.dtype),
+        key=jax.random.fold_in(state.key, n_steps),
+        iteration=state.iteration + n_steps,
+    )

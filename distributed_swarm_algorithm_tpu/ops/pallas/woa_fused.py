@@ -207,6 +207,11 @@ def fused_woa_run(
     n, d = state.pos.shape
     if rng == "host":
         steps_per_kernel = 1
+    # Each unrolled step emits a pltpu.roll whose temporaries consume
+    # scoped VMEM (same budget class the DE kernel measured OOMing at
+    # deep unrolls — see de_fused); cap like the sibling rather than
+    # fail at Mosaic compile.
+    steps_per_kernel = min(steps_per_kernel, 32)
     if tile_n is None:
         tile_n = _auto_tile(_ceil_to(max(d, 8), 8))
     tile_n = min(tile_n, _ceil_to(n, 128))

@@ -802,6 +802,131 @@ def fused_de_run_shmap(
     )
 
 
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "mesh", "n_steps", "axis", "half_width",
+        "t_max", "spiral_b", "steps_per_kernel", "tile_n", "rng",
+        "interpret",
+    ),
+)
+def fused_woa_run_shmap(
+    state,
+    objective_name: str,
+    mesh: Mesh,
+    n_steps: int,
+    axis: str = AGENT_AXIS,
+    half_width: float = 5.12,
+    t_max: int = 500,
+    spiral_b: float | None = None,
+    steps_per_kernel: int = 8,
+    tile_n: int | None = None,
+    rng: str = "tpu",
+    interpret: bool = False,
+):
+    """Multi-chip fused-Pallas WOA: each device runs rotational-peer
+    blocks (ops/pallas/woa_fused.py) on its pod shard; the incumbent
+    best is exchanged over ICI per block (``pmin`` + ``psum``
+    broadcast) — per-block best staleness and the cross-device cadence
+    coincide, like every fused shmap driver here.  Random peers are
+    shard-local between exchanges."""
+    from ..ops.pallas.common import ceil_to, cyclic_pad_rows
+    from ..ops.pallas.woa_fused import (
+        _auto_tile,
+        best_of_block,
+        fused_woa_step_t,
+        host_uniforms,
+        run_blocks,
+        seed_base,
+    )
+    from ..ops.woa import SPIRAL_B, WOAState
+
+    spiral_b = float(SPIRAL_B if spiral_b is None else spiral_b)
+    n, d = state.pos.shape
+    n_dev = mesh.shape[axis]
+    if rng == "host":
+        steps_per_kernel = 1
+    steps_per_kernel = min(steps_per_kernel, 32)   # VMEM (see woa_fused)
+    if tile_n is None:
+        tile_n = _auto_tile(ceil_to(max(d, 8), 8))
+    tile_n = min(tile_n, ceil_to(-(-n // n_dev), 128))
+    n_pad = ceil_to(n, n_dev * tile_n)
+    n_tiles_local = (n_pad // n_dev) // tile_n
+
+    pos_t = cyclic_pad_rows(state.pos, n_pad).T
+    fit_t = cyclic_pad_rows(state.fit, n_pad)[None, :]
+    seed0 = seed_base(state.key)
+    host_key = jax.random.fold_in(state.key, 0x30A)
+    shift_key = jax.random.fold_in(state.key, 0x0A1)
+
+    col = P(None, axis)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(col, col, P(), P()),
+        out_specs=(col, col, P(), P()),
+        check_vma=False,
+    )
+    def run(pos_t, fit_t, best_pos, best_fit):
+        dev = lax.axis_index(axis)
+
+        def block(carry, call_i, k):
+            pos_t, fit_t, best_pos, best_fit, it = carry
+            kk = jax.random.fold_in(
+                jax.random.fold_in(shift_key, call_i), dev
+            )
+            tshift = jax.random.randint(kk, (), 0, n_tiles_local)
+            lshift = jax.random.randint(
+                jax.random.fold_in(kk, 1), (), 0, tile_n
+            )
+            scalars = jnp.stack([
+                seed0 + (call_i * n_dev + dev) * n_tiles_local,
+                tshift, it, lshift,
+            ]).astype(jnp.int32)
+            r_a = r_c = r_p = r_l = None
+            if rng == "host":
+                r_a, r_c = host_uniforms(
+                    host_key, call_i, pos_t.shape, fold=dev
+                )
+                r_p, r_l = host_uniforms(
+                    host_key, call_i, fit_t.shape, fold=1000 + dev
+                )
+            pos_t, fit_t = fused_woa_step_t(
+                scalars, best_pos[:, None], pos_t, r_a, r_c, r_p, r_l,
+                objective_name=objective_name, half_width=half_width,
+                t_max=t_max, spiral_b=spiral_b, tile_n=tile_n, rng=rng,
+                interpret=interpret, k_steps=k,
+            )
+            loc_fit, loc_pos = best_of_block(fit_t, pos_t)
+            best_fit, best_pos = _exchange_best(
+                loc_fit, loc_pos, best_fit, best_pos, dev, axis
+            )
+            return (pos_t, fit_t, best_pos, best_fit, it + k)
+
+        carry = run_blocks(
+            block,
+            (pos_t, fit_t, best_pos, best_fit, state.iteration),
+            n_steps, steps_per_kernel,
+        )
+        return carry[:4]
+
+    pos_t, fit_t, best_pos, best_fit = run(
+        pos_t, fit_t,
+        state.best_pos.astype(jnp.float32),
+        state.best_fit.astype(jnp.float32),
+    )
+    dt = state.pos.dtype
+    return WOAState(
+        pos=pos_t.T[:n].astype(dt),
+        fit=fit_t[0, :n].astype(state.fit.dtype),
+        best_pos=best_pos.astype(state.best_pos.dtype),
+        best_fit=best_fit.astype(state.best_fit.dtype),
+        key=jax.random.fold_in(state.key, n_steps),
+        iteration=state.iteration + n_steps,
+    )
+
+
 def elect_shmap(
     alive: jax.Array,
     agent_id: jax.Array,

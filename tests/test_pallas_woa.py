@@ -73,3 +73,26 @@ def test_woa_model_backend_switch():
     assert opt.best < 1e-2
     with pytest.raises(ValueError):
         WOA(sphere, n=512, dim=4, seed=0, use_pallas=True)   # callable
+
+
+def test_fused_woa_shmap_multichip():
+    """8-virtual-device mesh: per-shard rotational WOA + cross-device
+    best exchange."""
+    from distributed_swarm_algorithm_tpu.parallel.mesh import make_mesh
+    from distributed_swarm_algorithm_tpu.parallel.sharding import (
+        fused_woa_run_shmap,
+    )
+
+    mesh = make_mesh()
+    st = woa_init(sphere, 2048, 5, HW, seed=0)
+    out = fused_woa_run_shmap(
+        st, "sphere", mesh, 60, t_max=60, rng="host", interpret=True
+    )
+    assert out.pos.shape == (2048, 5)
+    assert int(out.iteration) == 60
+    assert float(out.best_fit) < 1e-2
+    assert float(out.best_fit) <= float(out.fit.min()) + 1e-6
+    out2 = fused_woa_run_shmap(
+        st, "sphere", mesh, 60, t_max=60, rng="host", interpret=True
+    )
+    assert float(out2.best_fit) == float(out.best_fit)

@@ -1,0 +1,41 @@
+"""Fused cuckoo search at 1M nests (eighth fused family).
+
+Portable cuckoo is the worst gather profile in the zoo (~6.5M
+nest-steps/s at 1M): random-target egg scatter + gather-back, plus two
+permuted peers.  The fused kernel (ops/pallas/cuckoo_fused.py) replaces
+all of it with rotations and draws its Levy flights on-chip via
+fast-math Box-Muller.
+"""
+
+from __future__ import annotations
+
+from common import REFERENCE_AGENT_STEPS_PER_SEC, report, timeit_best
+
+from distributed_swarm_algorithm_tpu.models.cuckoo import Cuckoo
+
+N = 1_048_576
+DIM = 30
+STEPS = 256
+
+
+def main() -> None:
+    opt = Cuckoo("rastrigin", n=N, dim=DIM, seed=0)
+    float(opt.state.best_fit)
+    opt.run(STEPS)
+    float(opt.state.best_fit)
+    best = timeit_best(
+        lambda: opt.run(STEPS), lambda: float(opt.state.best_fit),
+        reps=3,
+    )
+    path = "pallas-fused" if opt.use_pallas else "xla-jit"
+    report(
+        f"agent-steps/sec, cuckoo Rastrigin-30D, {N} nests, 1 chip "
+        f"({path})",
+        N * STEPS / best,
+        "agent-steps/sec",
+        REFERENCE_AGENT_STEPS_PER_SEC,
+    )
+
+
+if __name__ == "__main__":
+    main()

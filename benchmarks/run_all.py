@@ -31,6 +31,7 @@ BENCHES = [
     "bench_de_1m.py",
     "bench_shade_1m.py",
     "bench_woa_1m.py",
+    "bench_cuckoo_1m.py",
     "bench_firefly_64k.py",
     "bench_swarm_tpu.py",
     "bench_boids.py",
@@ -46,6 +47,7 @@ QUICK_SKIP = {
     "bench_de_1m.py",
     "bench_shade_1m.py",
     "bench_woa_1m.py",
+    "bench_cuckoo_1m.py",
     "bench_firefly_64k.py",
     "bench_swarm_tpu.py",
     "bench_boids.py",
@@ -59,13 +61,12 @@ def main() -> int:
     failures = 0
     if "--tests" in sys.argv[1:]:
         # Full gate = TWO pytest processes (default set, then the slow
-        # set).  Running all ~470 tests in ONE process segfaults XLA's
-        # CPU backend_compile_and_load deterministically late in the
-        # run (reproduced with the persistent compile cache both on and
-        # off; the crashing test passes solo and in either half) — an
-        # accumulated-in-process-state issue in XLA CPU, not in this
-        # code.  Each half has been stable across many runs, so process
-        # isolation is the correctness-preserving mitigation.
+        # set).  XLA's CPU backend_compile_and_load used to segfault
+        # after several hundred executables accumulated in one process;
+        # conftest's periodic jax.clear_caches() fixture fixed the root
+        # cause (the full single-process run now passes), and the
+        # process split stays as defense in depth for the CI-style
+        # gate.
         for marker in ("not slow", "slow"):
             rc = subprocess.call(
                 [

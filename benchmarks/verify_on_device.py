@@ -315,6 +315,49 @@ def gate_woa_tpu_prng() -> dict:
     }
 
 
+def gate_cuckoo_host_exact() -> dict:
+    from distributed_swarm_algorithm_tpu.ops.cuckoo import cuckoo_init
+    from distributed_swarm_algorithm_tpu.ops.objectives import rastrigin
+    from distributed_swarm_algorithm_tpu.ops.pallas.cuckoo_fused import (
+        fused_cuckoo_run,
+    )
+
+    st = cuckoo_init(rastrigin, n=4096, dim=16, half_width=5.12, seed=7)
+    dev = fused_cuckoo_run(st, "rastrigin", 5, rng="host",
+                           interpret=False)
+    jax.block_until_ready(dev.pos)
+    with jax.default_device(_cpu_device()):
+        ref = fused_cuckoo_run(
+            _to_cpu(st), "rastrigin", 5, rng="host", interpret=True
+        )
+    res = _state_parity(dev, ref, ("pos", "fit"))
+    dg = abs(float(dev.best_fit) - float(ref.best_fit))
+    res["gbest_abs_diff"] = round(dg, 8)
+    res["ok"] = res["worst"] >= FRAC_CLOSE_MIN and dg <= 1e-2
+    return res
+
+
+def gate_cuckoo_tpu_prng() -> dict:
+    from distributed_swarm_algorithm_tpu.ops.cuckoo import (
+        cuckoo_init,
+        cuckoo_run,
+    )
+    from distributed_swarm_algorithm_tpu.ops.objectives import rastrigin
+    from distributed_swarm_algorithm_tpu.ops.pallas.cuckoo_fused import (
+        fused_cuckoo_run,
+    )
+
+    st = cuckoo_init(rastrigin, n=16384, dim=30, half_width=5.12,
+                     seed=11)
+    fused = fused_cuckoo_run(st, "rastrigin", 256, rng="tpu")
+    portable = cuckoo_run(st, rastrigin, 256)
+    f, p = float(fused.best_fit), float(portable.best_fit)
+    return {
+        "fused_best": round(f, 4), "portable_best": round(p, 4),
+        "ok": _convergence_band(f, p),
+    }
+
+
 def gate_separation_exact() -> dict:
     """Tiled all-pairs Pallas kernel vs the dense jnp broadcast, on-chip
     Mosaic vs on-CPU XLA.  Deterministic (no RNG, no selection), so the
@@ -485,6 +528,7 @@ ALL_GATES = {
     "de_host_exact": gate_de_host_exact,
     "shade_host_exact": gate_shade_host_exact,
     "woa_host_exact": gate_woa_host_exact,
+    "cuckoo_host_exact": gate_cuckoo_host_exact,
     "islands_host_exact": gate_islands_host_exact,
     "separation_exact": gate_separation_exact,
     "pso_tpu_prng": gate_pso_tpu_prng,
@@ -493,6 +537,7 @@ ALL_GATES = {
     "de_tpu_prng": gate_de_tpu_prng,
     "shade_tpu_prng": gate_shade_tpu_prng,
     "woa_tpu_prng": gate_woa_tpu_prng,
+    "cuckoo_tpu_prng": gate_cuckoo_tpu_prng,
 }
 
 

@@ -8,11 +8,21 @@ import jax
 
 from ..ops import cuckoo as _k
 from ..ops.objectives import get_objective
+from ..ops.pallas import cuckoo_fused as _cf
+from ..utils.platform import on_tpu as _on_tpu
 from ._checkpoint import CheckpointMixin
 
 
 class Cuckoo(CheckpointMixin):
     """Cuckoo search (Lévy flights + nest abandonment, Yang & Deb 2009).
+
+    Two compute paths with the same CuckooState contract: portable
+    jit'd JAX (exact random egg targets + permuted peers — scatter/
+    gather-bound on TPU at large N) and the fused Pallas kernel
+    (ops/pallas/cuckoo_fused.py: rotational egg drop + peers, in-kernel
+    Box-Muller Levy flights) — auto-selected on TPU for named
+    objectives in float32 with n >= 512, or forced with
+    ``use_pallas=True``.
 
     >>> opt = Cuckoo("rastrigin", n=64, dim=8, seed=0)
     >>> opt.run(400)
@@ -30,11 +40,14 @@ class Cuckoo(CheckpointMixin):
         levy_beta: float = _k.LEVY_BETA,
         seed: int = 0,
         dtype=None,
+        use_pallas: Optional[bool] = None,
     ):
         if isinstance(objective, str):
             fn, default_hw = get_objective(objective)
+            self.objective_name: Optional[str] = objective
         else:
             fn, default_hw = objective, 5.12
+            self.objective_name = None
         self.objective = fn
         self.half_width = float(
             half_width if half_width is not None else default_hw
@@ -49,6 +62,23 @@ class Cuckoo(CheckpointMixin):
             fn, n, dim, self.half_width, seed=seed, **kwargs
         )
 
+        supported = (
+            n >= 512            # rotational peers need >= 4 lane tiles
+            and self.objective_name is not None
+            and _cf.cuckoo_pallas_supported(
+                self.objective_name or "", self.state.pos.dtype
+            )
+        )
+        if use_pallas is None:
+            self.use_pallas = supported and _on_tpu()
+        elif use_pallas and not supported:
+            raise ValueError(
+                "use_pallas=True needs a named objective from "
+                "ops.objectives, float32 state, and n >= 512"
+            )
+        else:
+            self.use_pallas = bool(use_pallas)
+
     def step(self) -> _k.CuckooState:
         self.state = _k.cuckoo_step(
             self.state, self.objective, self.half_width, self.pa,
@@ -57,10 +87,20 @@ class Cuckoo(CheckpointMixin):
         return self.state
 
     def run(self, n_steps: int) -> _k.CuckooState:
-        self.state = _k.cuckoo_run(
-            self.state, self.objective, n_steps, self.half_width,
-            self.pa, self.step_scale, self.levy_beta,
-        )
+        if self.use_pallas:
+            on_tpu = _on_tpu()
+            self.state = _cf.fused_cuckoo_run(
+                self.state, self.objective_name, n_steps,
+                self.half_width, self.pa, self.step_scale,
+                self.levy_beta,
+                rng="tpu" if on_tpu else "host",
+                interpret=not on_tpu,
+            )
+        else:
+            self.state = _k.cuckoo_run(
+                self.state, self.objective, n_steps, self.half_width,
+                self.pa, self.step_scale, self.levy_beta,
+            )
         jax.block_until_ready(self.state.best_fit)
         return self.state
 

@@ -1,0 +1,352 @@
+"""Fused cuckoo-search generation as a Pallas TPU kernel.
+
+Eighth fused family.  Portable cuckoo search measures ~6.5M
+nest-steps/s at 1M on the chip — the worst gather profile in the zoo:
+the egg-drop phase scatters candidate fitnesses into random target
+nests (segment-min + gather-back) and the abandonment phase gathers
+two permuted peers.  This kernel removes all of it:
+
+  - **Rotational egg drop**: egg i lands in nest ``(i + shift) mod
+    TILE_N`` of its own lane tile — a bijective assignment (every nest
+    receives exactly one egg, so the portable path's same-target
+    conflict resolution disappears) realized as one dynamic lane roll
+    of the candidate block.  Targets are tile-local; cross-tile mixing
+    still happens through the abandonment peers and the shared best.
+  - **Rotational abandonment peers**: the biased random walk's two
+    permuted peers become rotated block-start snapshots of the
+    population (the DE donor machinery — scalar-prefetched tile shifts
+    + dynamic lane rolls).
+  - **In-kernel Lévy flights**: Mantegna steps ``sigma*n1/|n2|^(1/b)``
+    from the on-chip PRNG via Box-Muller —
+    ``n = sqrt(-2 ln u1) * cos(2*pi*u2)`` — built entirely from
+    fast-math primitives: the shared cos polynomial
+    (pso_fused._cos2pi), a bit-field ``log2`` (exponent extraction +
+    degree-6 mantissa polynomial, max abs err 6e-6), and the firefly
+    kernel's ``2^f`` polynomial with exponent-field bit construction
+    for the power.  Mosaic's library transcendentals at ~19 G/s would
+    otherwise dominate the kernel.
+
+Same chassis as the siblings (lane-major [D, N], k steps per HBM
+round-trip with best/donor block-start snapshots, host-RNG interpret
+variant with a byte-identical body for CPU testing).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..cuckoo import (
+    LEVY_BETA,
+    PA,
+    STEP_SCALE,
+    CuckooState,
+    _mantegna_sigma,
+)
+from .common import ceil_to as _ceil_to, cyclic_pad_rows as _cyclic_pad_rows
+from .de_fused import _LANE_SHIFTS, shrink_tile_for_donors
+from .firefly_fused import _exp2_poly
+from .pso_fused import (
+    OBJECTIVES_T,
+    _auto_tile,
+    _cos2pi,
+    _sin2pi,
+    _uniform_bits,
+    best_of_block,
+    run_blocks,
+    seed_base,
+)
+
+_LN2 = 0.6931471805599453
+# log2(m) on m in [1, 2): degree-6 polynomial (descending), max abs err
+# 6.0e-6 through f32 Horner (np.polyfit over 4e5 points).
+_LOG2_C = (
+    -0.024825585616, 0.266858603621, -1.234262243474, 3.218830782097,
+    -5.264107973620, 6.065828547204, -3.028317064600,
+)
+
+
+def _log2_fast(x):
+    """log2(x) for x > 0: exponent bit-field + mantissa polynomial."""
+    bits = pltpu.bitcast(x, jnp.uint32)
+    e = ((bits >> 23) & jnp.uint32(0xFF)).astype(jnp.int32) - 127
+    mant = pltpu.bitcast(
+        (bits & jnp.uint32(0x7FFFFF)) | jnp.uint32(0x3F800000),
+        jnp.float32,
+    )
+    p = jnp.float32(_LOG2_C[0])
+    for a in _LOG2_C[1:]:
+        p = p * mant + jnp.float32(a)
+    return e.astype(jnp.float32) + p
+
+
+def _exp2_fast(t):
+    """2^t: round to n + f, exponent-field bit construction * 2^f poly
+    (shared with the firefly kernel).  Clamped to the f32 normal range."""
+    n = jnp.round(t)
+    f = t - n
+    ni = jnp.clip(n, -126.0, 126.0).astype(jnp.int32)
+    two_n = pltpu.bitcast((ni + 127) << 23, jnp.float32)
+    val = two_n * _exp2_poly(f)
+    return jnp.where(t < -126.0, 0.0, val)
+
+
+def _normal_pair(shape):
+    """Two independent standard normals via Box-Muller on on-chip
+    uniforms (u1 mapped to (0, 1] so the log never sees 0)."""
+    u1 = 1.0 - _uniform_bits(shape)
+    u2 = _uniform_bits(shape)
+    r = jnp.sqrt(-2.0 * _LN2 * _log2_fast(u1))
+    return r * _cos2pi(u2), r * _sin2pi(u2)
+
+
+def cuckoo_pallas_supported(objective_name, dtype) -> bool:
+    return objective_name in OBJECTIVES_T and jnp.dtype(dtype) == jnp.float32
+
+
+def _make_kernel(objective_t, half_width, pa, step_scale, beta, sigma,
+                 host_rng, k_steps):
+    inv_beta = 1.0 / beta
+
+    def body(scalar_ref, best_ref, pos_ref, fit_ref, p1_ref, p2_ref,
+             r_levy1, r_levy2, r_ab, r_walk, pos_o, fit_o):
+        pos, fit = pos_ref[:], fit_ref[:]
+        p1s, p2s = p1_ref[:], p2_ref[:]
+        best = best_ref[:][:, 0:1]
+        l_egg = scalar_ref[3]
+        l_p1, l_p2 = scalar_ref[4], scalar_ref[5]
+
+        for step in range(k_steps):
+            sa, sb, sc = _LANE_SHIFTS[step % len(_LANE_SHIFTS)]
+            # --- 1. Levy flight + rotational egg drop ----------------
+            if host_rng:
+                n1, n2, u_ab, u_walk = r_levy1, r_levy2, r_ab, r_walk
+            else:
+                n1, n2 = _normal_pair(pos.shape)
+                u_ab = _uniform_bits(fit.shape)
+                u_walk = _uniform_bits(pos.shape)
+            levy = sigma * n1 * _exp2_fast(
+                -inv_beta * _log2_fast(jnp.abs(n2) + 1e-12)
+            )
+            cand = pos + step_scale * levy * (pos - best)
+            cand = jnp.clip(cand, -half_width, half_width)
+            cand_fit = objective_t(cand)
+            # Egg from lane j-shift lands in nest j (bijective).
+            egg = pltpu.roll(cand, l_egg + sa, 1)
+            egg_fit = pltpu.roll(cand_fit, l_egg + sa, 1)
+            accept = egg_fit < fit
+            pos = jnp.where(accept, egg, pos)
+            fit = jnp.where(accept, egg_fit, fit)
+
+            # --- 2. Abandonment: biased walk over rotated peers ------
+            x1 = pltpu.roll(p1s, l_p1 + sb, 1)
+            x2 = pltpu.roll(p2s, l_p2 + sc, 1)
+            fresh = jnp.clip(
+                pos + u_walk * (x1 - x2), -half_width, half_width
+            )
+            fresh_fit = objective_t(fresh)
+            abandon = u_ab < pa
+            pos = jnp.where(abandon, fresh, pos)
+            fit = jnp.where(abandon, fresh_fit, fit)
+
+        pos_o[:] = pos
+        fit_o[:] = fit
+
+    if host_rng:
+        def kernel(scalar_ref, best_ref, pos_ref, fit_ref, p1_ref,
+                   p2_ref, rl1, rl2, rab, rwk, *outs):
+            body(scalar_ref, best_ref, pos_ref, fit_ref, p1_ref, p2_ref,
+                 rl1[:], rl2[:], rab[:], rwk[:], *outs)
+    else:
+        def kernel(scalar_ref, best_ref, pos_ref, fit_ref, p1_ref,
+                   p2_ref, *outs):
+            pltpu.prng_seed(scalar_ref[0] + pl.program_id(0))
+            body(scalar_ref, best_ref, pos_ref, fit_ref, p1_ref, p2_ref,
+                 None, None, None, None, *outs)
+
+    return kernel
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "half_width", "pa", "step_scale", "levy_beta",
+        "tile_n", "rng", "interpret", "k_steps",
+    ),
+)
+def fused_cuckoo_step_t(
+    scalars: jax.Array,       # [6] i32: seed, tshift_p1, tshift_p2, lane_egg/p1/p2
+    best_pos: jax.Array,      # [D, 1]
+    pos: jax.Array,           # [D, N]
+    fit: jax.Array,           # [1, N]
+    r_levy1: jax.Array | None = None,   # [D, N] host-RNG normals
+    r_levy2: jax.Array | None = None,
+    r_ab: jax.Array | None = None,      # [1, N] uniforms
+    r_walk: jax.Array | None = None,    # [D, N] uniforms
+    *,
+    objective_name: str,
+    half_width: float = 5.12,
+    pa: float = PA,
+    step_scale: float = STEP_SCALE,
+    levy_beta: float = LEVY_BETA,
+    tile_n: int = 4096,
+    rng: str = "tpu",
+    interpret: bool = False,
+    k_steps: int = 1,
+) -> Tuple[jax.Array, jax.Array]:
+    """``k_steps`` fused cuckoo generations; returns ``(pos, fit)``."""
+    d, n = pos.shape
+    if n % tile_n:
+        raise ValueError(f"N ({n}) must be a multiple of tile_n ({tile_n})")
+    n_tiles = n // tile_n
+    host_rng = rng == "host"
+    if host_rng and any(
+        x is None for x in (r_levy1, r_levy2, r_ab, r_walk)
+    ):
+        raise ValueError(
+            'rng="host" requires r_levy1, r_levy2, r_ab, r_walk'
+        )
+    if host_rng and k_steps != 1:
+        raise ValueError('rng="host" supports k_steps=1 only')
+
+    kernel = _make_kernel(
+        OBJECTIVES_T[objective_name], half_width, pa, step_scale,
+        levy_beta, _mantegna_sigma(levy_beta), host_rng, k_steps,
+    )
+
+    col = lambda i, s: (0, i)                                # noqa: E731
+    fixed = lambda i, s: (0, 0)                              # noqa: E731
+    rot = lambda j: (                                        # noqa: E731
+        lambda i, s: (0, jax.lax.rem(i + s[j], n_tiles))
+    )
+    dn = pl.BlockSpec((d, tile_n), col, memory_space=pltpu.VMEM)
+    ft = pl.BlockSpec((1, tile_n), col, memory_space=pltpu.VMEM)
+
+    b128 = jnp.broadcast_to(best_pos, (d, 128))
+    in_specs = [
+        pl.BlockSpec((d, 128), fixed, memory_space=pltpu.VMEM),
+        dn, ft,
+        pl.BlockSpec((d, tile_n), rot(1), memory_space=pltpu.VMEM),
+        pl.BlockSpec((d, tile_n), rot(2), memory_space=pltpu.VMEM),
+    ]
+    operands = [b128, pos, fit, pos, pos]
+    if host_rng:
+        in_specs += [dn, dn, ft, dn]
+        operands += [r_levy1, r_levy2, r_ab, r_walk]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=in_specs,
+        out_specs=[dn, ft],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((d, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+        ],
+        interpret=interpret,
+    )(scalars.astype(jnp.int32), *operands)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "n_steps", "half_width", "pa", "step_scale",
+        "levy_beta", "tile_n", "rng", "interpret", "steps_per_kernel",
+    ),
+)
+def fused_cuckoo_run(
+    state: CuckooState,
+    objective_name: str,
+    n_steps: int,
+    half_width: float = 5.12,
+    pa: float = PA,
+    step_scale: float = STEP_SCALE,
+    levy_beta: float = LEVY_BETA,
+    tile_n: int | None = None,
+    rng: str = "tpu",
+    interpret: bool = False,
+    steps_per_kernel: int = 8,
+) -> CuckooState:
+    """``n_steps`` fused cuckoo generations — CuckooState in/out,
+    drop-in fast path for ``ops.cuckoo.cuckoo_run`` with the module
+    docstring's rotational/fast-math deltas."""
+    n, d = state.pos.shape
+    if rng == "host":
+        steps_per_kernel = 1
+    # Cuckoo's per-step temporaries are the heaviest in the zoo (two
+    # Box-Muller normals, the Levy power chain, TWO objective
+    # evaluations, three rolls): spk=32 at tile 4096 measured 61 MB of
+    # scoped VMEM vs the 16 MB limit; spk=8 compiles and runs at 483M
+    # nest-steps/s.
+    steps_per_kernel = min(steps_per_kernel, 8)
+    if tile_n is None:
+        tile_n = _auto_tile(_ceil_to(max(d, 8), 8))
+    tile_n = min(tile_n, _ceil_to(n, 128))
+    tile_n, n_pad, n_tiles = shrink_tile_for_donors(n, tile_n)
+
+    pos_t = _cyclic_pad_rows(state.pos, n_pad).T
+    fit_t = _cyclic_pad_rows(state.fit, n_pad)[None, :]
+    seed0 = seed_base(state.key)
+    host_key = jax.random.fold_in(state.key, 0xC0C)
+    shift_key = jax.random.fold_in(state.key, 0xC1C)
+
+    def block(carry, call_i, k):
+        pos_t, fit_t, best_pos, best_fit = carry
+        kk = jax.random.fold_in(shift_key, call_i)
+        tshifts = jax.random.randint(kk, (2,), 1, max(n_tiles, 2))
+        lanes = jax.random.randint(
+            jax.random.fold_in(kk, 1), (3,), 0, tile_n
+        )
+        scalars = jnp.concatenate([
+            jnp.stack([seed0 + call_i * n_tiles]), tshifts, lanes,
+        ]).astype(jnp.int32)
+        r1 = r2 = rab = rwk = None
+        if rng == "host":
+            import jax.random as jr
+
+            kk2 = jr.fold_in(host_key, call_i)
+            k1, k2, k3, k4 = jr.split(kk2, 4)
+            r1 = jr.normal(k1, pos_t.shape, jnp.float32)
+            r2 = jr.normal(k2, pos_t.shape, jnp.float32)
+            rab = jr.uniform(k3, fit_t.shape, jnp.float32)
+            rwk = jr.uniform(k4, pos_t.shape, jnp.float32)
+        pos_t, fit_t = fused_cuckoo_step_t(
+            scalars, best_pos[:, None], pos_t, fit_t, r1, r2, rab, rwk,
+            objective_name=objective_name, half_width=half_width,
+            pa=pa, step_scale=step_scale, levy_beta=levy_beta,
+            tile_n=tile_n, rng=rng, interpret=interpret, k_steps=k,
+        )
+        cand_fit, cand_pos = best_of_block(fit_t, pos_t)
+        improved = cand_fit < best_fit
+        best_fit = jnp.where(improved, cand_fit, best_fit)
+        best_pos = jnp.where(improved, cand_pos, best_pos)
+        return (pos_t, fit_t, best_pos, best_fit)
+
+    carry = run_blocks(
+        block,
+        (
+            pos_t, fit_t,
+            state.best_pos.astype(jnp.float32),
+            state.best_fit.astype(jnp.float32),
+        ),
+        n_steps, steps_per_kernel,
+    )
+    pos_t, fit_t, best_pos, best_fit = carry
+    dt = state.pos.dtype
+    return CuckooState(
+        pos=pos_t.T[:n].astype(dt),
+        fit=fit_t[0, :n].astype(state.fit.dtype),
+        best_pos=best_pos.astype(state.best_pos.dtype),
+        best_fit=best_fit.astype(state.best_fit.dtype),
+        key=jax.random.fold_in(state.key, n_steps),
+        iteration=state.iteration + n_steps,
+    )

@@ -32,12 +32,9 @@ except Exception:
 # small host is compiling the same jitted programs run after run.  The
 # cache is keyed on HLO + compile options, so correctness is unaffected;
 # a warm cache cuts the wall-clock severalfold (measured 14 min -> 2.5).
-# Opt out with DSA_NO_COMPILE_CACHE=1.  Note: running ALL ~470 tests
-# (default + slow) in one process segfaults XLA's CPU
-# backend_compile_and_load late in the run regardless of this cache
-# (accumulated in-process state; the crashing test passes solo and in
-# either half) — benchmarks/run_all.py --tests therefore runs the
-# default and slow sets as separate processes.
+# Opt out with DSA_NO_COMPILE_CACHE=1.  (The periodic-clear fixture
+# below keeps in-process executable accumulation bounded — see its
+# comment; the full ~480-test single-process run passes with it.)
 if not os.environ.get("DSA_NO_COMPILE_CACHE"):
     try:
         _cache_dir = os.environ.get(
@@ -48,3 +45,27 @@ if not os.environ.get("DSA_NO_COMPILE_CACHE"):
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     except Exception:
         pass
+
+# XLA's CPU backend segfaults in backend_compile_and_load after several
+# hundred executables accumulate in one process (reproduced with the
+# persistent cache on AND off; the crashing test passes solo).  Bound
+# the live-executable count by dropping jax's in-memory caches every
+# ~100 tests — with the warm persistent disk cache the re-JITs this
+# forces are cheap, and the suite stays one process.
+import pytest  # noqa: E402
+
+_TESTS_SINCE_CLEAR = {"n": 0}
+
+
+@pytest.fixture(autouse=True)
+def _periodic_jax_cache_clear():
+    yield
+    _TESTS_SINCE_CLEAR["n"] += 1
+    if _TESTS_SINCE_CLEAR["n"] >= 100:
+        _TESTS_SINCE_CLEAR["n"] = 0
+        try:
+            import jax as _jax
+
+            _jax.clear_caches()
+        except Exception:
+            pass

@@ -1,0 +1,122 @@
+"""Fused Pallas cuckoo kernel (ops/pallas/cuckoo_fused.py): rotational
+egg-drop/peer semantics, in-kernel fast-math Levy primitives, and the
+model backend switch.  Interpret mode on CPU with host RNG."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_swarm_algorithm_tpu.models.cuckoo import Cuckoo
+from distributed_swarm_algorithm_tpu.ops.cuckoo import (
+    cuckoo_init,
+    cuckoo_run,
+)
+from distributed_swarm_algorithm_tpu.ops.objectives import (
+    rastrigin,
+    sphere,
+)
+from distributed_swarm_algorithm_tpu.ops.pallas.cuckoo_fused import (
+    cuckoo_pallas_supported,
+    fused_cuckoo_run,
+)
+
+HW = 5.12
+
+
+def test_fast_math_primitives():
+    """log2/exp2 bit-tricks match the library functions.  They must run
+    through a (interpret-mode) pallas_call: pltpu.bitcast has no
+    evaluation rule outside a kernel trace."""
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from distributed_swarm_algorithm_tpu.ops.pallas.cuckoo_fused import (
+        _exp2_fast,
+        _log2_fast,
+    )
+
+    def run_in_kernel(fn, x):
+        def kernel(x_ref, o_ref):
+            o_ref[:] = fn(x_ref[:])
+
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, jnp.float32),
+            interpret=True,
+        )(x)
+
+    x = jnp.asarray(
+        np.random.default_rng(0).uniform(1e-6, 100.0, (8, 256)),
+        jnp.float32,
+    )
+    np.testing.assert_allclose(
+        np.asarray(run_in_kernel(_log2_fast, x)),
+        np.log2(np.asarray(x, np.float64)),
+        atol=1e-5,
+    )
+    t = jnp.asarray(
+        np.random.default_rng(1).uniform(-30.0, 30.0, (8, 256)),
+        jnp.float32,
+    )
+    np.testing.assert_allclose(
+        np.asarray(run_in_kernel(_exp2_fast, t)),
+        2.0 ** np.asarray(t, np.float64),
+        rtol=2e-6,
+    )
+
+
+def test_fused_run_converges_sphere():
+    st = cuckoo_init(sphere, 1024, 6, HW, seed=0)
+    out = fused_cuckoo_run(st, "sphere", 150, half_width=HW,
+                           rng="host", interpret=True)
+    assert out.pos.shape == (1024, 6)
+    assert int(out.iteration) == 150
+    assert float(out.best_fit) < 1e-3
+    assert bool((jnp.abs(out.pos) <= HW + 1e-5).all())
+    assert float(out.best_fit) <= float(out.fit.min()) + 1e-6
+
+
+def test_fused_matches_portable_regime():
+    st = cuckoo_init(rastrigin, 2048, 8, HW, seed=1)
+    fused = fused_cuckoo_run(st, "rastrigin", 200, half_width=HW,
+                             rng="host", interpret=True)
+    portable = cuckoo_run(st, rastrigin, 200, half_width=HW)
+    f, p = float(fused.best_fit), float(portable.best_fit)
+    assert f < p * 3.0 + 5.0, (f, p)
+
+
+def test_fused_deterministic_and_monotone():
+    st = cuckoo_init(rastrigin, 512, 6, HW, seed=3)
+    prev = float(st.best_fit)
+    s = st
+    for _ in range(3):
+        s = fused_cuckoo_run(s, "rastrigin", 10, half_width=HW,
+                             rng="host", interpret=True)
+        cur = float(s.best_fit)
+        assert cur <= prev + 1e-6
+        prev = cur
+    a = fused_cuckoo_run(st, "rastrigin", 25, half_width=HW,
+                         rng="host", interpret=True)
+    b = fused_cuckoo_run(st, "rastrigin", 25, half_width=HW,
+                         rng="host", interpret=True)
+    np.testing.assert_array_equal(np.asarray(a.pos), np.asarray(b.pos))
+
+
+def test_tiny_population_rejected():
+    st = cuckoo_init(sphere, 64, 5, HW, seed=2)
+    with pytest.raises(ValueError, match="rotational"):
+        fused_cuckoo_run(st, "sphere", 5, half_width=HW, rng="host",
+                         interpret=True)
+
+
+def test_cuckoo_model_backend_switch():
+    assert cuckoo_pallas_supported("rastrigin", jnp.float32)
+    assert not cuckoo_pallas_supported("rastrigin", jnp.bfloat16)
+    opt = Cuckoo("sphere", n=1024, dim=4, seed=0, use_pallas=True)
+    opt.run(80)
+    assert opt.best < 1e-2
+    with pytest.raises(ValueError):
+        Cuckoo("sphere", n=64, dim=4, seed=0, use_pallas=True)
+    with pytest.raises(ValueError):
+        Cuckoo(sphere, n=1024, dim=4, seed=0, use_pallas=True)

@@ -1,0 +1,39 @@
+"""Fused Harris-hawks at 1M hawks (ninth fused family).
+
+Portable HHO measures ~20M hawk-steps/s at 1M (random-hawk gather +
+three HBM-round-trip objective evaluations per generation); the fused
+kernel (ops/pallas/hho_fused.py) keeps all three evaluations in VMEM
+and replaces the gather with a rotational peer.
+"""
+
+from __future__ import annotations
+
+from common import REFERENCE_AGENT_STEPS_PER_SEC, report, timeit_best
+
+from distributed_swarm_algorithm_tpu.models.hho import HarrisHawks
+
+N = 1_048_576
+DIM = 30
+STEPS = 256
+
+
+def main() -> None:
+    opt = HarrisHawks("rastrigin", n=N, dim=DIM, t_max=STEPS, seed=0)
+    float(opt.state.best_fit)
+    opt.run(STEPS)
+    float(opt.state.best_fit)
+    best = timeit_best(
+        lambda: opt.run(STEPS), lambda: float(opt.state.best_fit),
+        reps=3,
+    )
+    path = "pallas-fused" if opt.use_pallas else "xla-jit"
+    report(
+        f"agent-steps/sec, HHO Rastrigin-30D, {N} hawks, 1 chip ({path})",
+        N * STEPS / best,
+        "agent-steps/sec",
+        REFERENCE_AGENT_STEPS_PER_SEC,
+    )
+
+
+if __name__ == "__main__":
+    main()

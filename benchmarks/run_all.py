@@ -32,6 +32,7 @@ BENCHES = [
     "bench_shade_1m.py",
     "bench_woa_1m.py",
     "bench_cuckoo_1m.py",
+    "bench_hho_1m.py",
     "bench_firefly_64k.py",
     "bench_swarm_tpu.py",
     "bench_boids.py",
@@ -48,6 +49,7 @@ QUICK_SKIP = {
     "bench_shade_1m.py",
     "bench_woa_1m.py",
     "bench_cuckoo_1m.py",
+    "bench_hho_1m.py",
     "bench_firefly_64k.py",
     "bench_swarm_tpu.py",
     "bench_boids.py",

@@ -8,6 +8,8 @@ import jax
 
 from ..ops import hho as _k
 from ..ops.objectives import get_objective
+from ..ops.pallas import hho_fused as _hf
+from ..utils.platform import on_tpu as _on_tpu
 from ._checkpoint import CheckpointMixin
 
 
@@ -33,11 +35,14 @@ class HarrisHawks(CheckpointMixin):
         levy_beta: float = _k.LEVY_BETA,
         seed: int = 0,
         dtype=None,
+        use_pallas: Optional[bool] = None,
     ):
         if isinstance(objective, str):
             fn, default_hw = get_objective(objective)
+            self.objective_name: Optional[str] = objective
         else:
             fn, default_hw = objective, 5.12
+            self.objective_name = None
         self.objective = fn
         self.half_width = float(
             half_width if half_width is not None else default_hw
@@ -51,6 +56,23 @@ class HarrisHawks(CheckpointMixin):
             fn, n, dim, self.half_width, seed=seed, **kwargs
         )
 
+        supported = (
+            n >= 512            # rotational peers need >= 4 lane tiles
+            and self.objective_name is not None
+            and _hf.hho_pallas_supported(
+                self.objective_name or "", self.state.pos.dtype
+            )
+        )
+        if use_pallas is None:
+            self.use_pallas = supported and _on_tpu()
+        elif use_pallas and not supported:
+            raise ValueError(
+                "use_pallas=True needs a named objective from "
+                "ops.objectives, float32 state, and n >= 512"
+            )
+        else:
+            self.use_pallas = bool(use_pallas)
+
     def step(self) -> _k.HHOState:
         self.state = _k.hho_step(
             self.state, self.objective, self.half_width, self.t_max,
@@ -59,10 +81,19 @@ class HarrisHawks(CheckpointMixin):
         return self.state
 
     def run(self, n_steps: int) -> _k.HHOState:
-        self.state = _k.hho_run(
-            self.state, self.objective, n_steps, self.half_width,
-            self.t_max, self.levy_beta,
-        )
+        if self.use_pallas:
+            on_tpu = _on_tpu()
+            self.state = _hf.fused_hho_run(
+                self.state, self.objective_name, n_steps,
+                self.half_width, self.t_max, self.levy_beta,
+                rng="tpu" if on_tpu else "host",
+                interpret=not on_tpu,
+            )
+        else:
+            self.state = _k.hho_run(
+                self.state, self.objective, n_steps, self.half_width,
+                self.t_max, self.levy_beta,
+            )
         jax.block_until_ready(self.state.best_fit)
         return self.state
 

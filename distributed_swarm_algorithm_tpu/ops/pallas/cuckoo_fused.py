@@ -50,7 +50,7 @@ from ..cuckoo import (
 )
 from .common import ceil_to as _ceil_to, cyclic_pad_rows as _cyclic_pad_rows
 from .de_fused import _LANE_SHIFTS, shrink_tile_for_donors
-from .firefly_fused import _exp2_poly
+from .firefly_fused import exp2_fast as _exp2_fast
 from .pso_fused import (
     OBJECTIVES_T,
     _auto_tile,
@@ -83,17 +83,6 @@ def _log2_fast(x):
     for a in _LOG2_C[1:]:
         p = p * mant + jnp.float32(a)
     return e.astype(jnp.float32) + p
-
-
-def _exp2_fast(t):
-    """2^t: round to n + f, exponent-field bit construction * 2^f poly
-    (shared with the firefly kernel).  Clamped to the f32 normal range."""
-    n = jnp.round(t)
-    f = t - n
-    ni = jnp.clip(n, -126.0, 126.0).astype(jnp.int32)
-    two_n = pltpu.bitcast((ni + 127) << 23, jnp.float32)
-    val = two_n * _exp2_poly(f)
-    return jnp.where(t < -126.0, 0.0, val)
 
 
 def _normal_pair(shape):

@@ -66,16 +66,22 @@ def _exp2_poly(f):
     return c0 + f * (c1 + f * (c2 + f * (c3 + f * (c4 + f * c5))))
 
 
-def _exp_fast(x):
-    """exp(x) for x <= 0 via 2^(x*log2e); exact 0 below the f32
-    denormal range."""
-    t = x * _LOG2E
+def exp2_fast(t):
+    """2^t: round to n + f, exponent-field bit construction times the
+    2^f polynomial; exact 0 below the f32 normal range.  The shared
+    core for every fast exponential in the fused kernels (firefly's
+    attraction here, the cuckoo/HHO Levy power chains)."""
     n = jnp.round(t)
     f = t - n
     ni = jnp.clip(n, -126.0, 126.0).astype(jnp.int32)
     two_n = pltpu.bitcast((ni + 127) << 23, jnp.float32)
     val = two_n * _exp2_poly(f)
     return jnp.where(t < -126.0, 0.0, val)
+
+
+def _exp_fast(x):
+    """exp(x) via 2^(x*log2e)."""
+    return exp2_fast(x * _LOG2E)
 
 
 def _make_kernel(dim, tile_i, tile_j, beta0, gamma):

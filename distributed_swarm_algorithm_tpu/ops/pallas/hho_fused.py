@@ -1,0 +1,320 @@
+"""Fused Harris-hawks iteration as a Pallas TPU kernel.
+
+Ninth fused family.  Portable HHO measures ~20M hawk-steps/s at 1M —
+bound on the random-hawk row gather plus three full objective
+evaluations per generation through HBM.  The kernel keeps all three
+evaluations (exact HHO semantics: trial Y, trial Z, and the final
+position) in VMEM, draws every random on-chip, and replaces the one
+gather with the rotational-peer machinery shared by the DE/WOA/cuckoo
+siblings.  The Lévy dives reuse the cuckoo kernel's fast-math
+Box-Muller + bit-field log2/exp2 power chain.
+
+Per-block (steps_per_kernel) snapshots, documented staleness like every
+sibling: the rabbit (global best), the population mean (eq. 2's
+``x_m``), and the random-peer view refresh between blocks, not between
+steps.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..cuckoo import _mantegna_sigma
+from ..hho import LEVY_BETA, T_MAX, HHOState
+from .common import ceil_to as _ceil_to, cyclic_pad_rows as _cyclic_pad_rows
+from .cuckoo_fused import _exp2_fast, _log2_fast, _normal_pair
+from .de_fused import _LANE_SHIFTS, shrink_tile_for_donors
+from .pso_fused import (
+    OBJECTIVES_T,
+    _auto_tile,
+    _uniform_bits,
+    best_of_block,
+    run_blocks,
+    seed_base,
+)
+
+
+def hho_pallas_supported(objective_name, dtype) -> bool:
+    return objective_name in OBJECTIVES_T and jnp.dtype(dtype) == jnp.float32
+
+
+def _make_kernel(objective_t, half_width, t_max, beta, sigma, host_rng,
+                 k_steps):
+    inv_beta = 1.0 / beta
+    lb, ub = -half_width, half_width
+
+    def body(scalar_ref, best_ref, mean_ref, pos_ref, fit_ref, peer_ref,
+             host_r, pos_o, fit_o):
+        pos, fit = pos_ref[:], fit_ref[:]
+        peer0 = peer_ref[:]
+        rabbit = best_ref[:][:, 0:1]               # [D, 1]
+        mean = mean_ref[:][:, 0:1]                 # [D, 1]
+        t0 = scalar_ref[2].astype(jnp.float32)
+        l_peer = scalar_ref[3]
+
+        for step in range(k_steps):
+            t = t0 + step + 1.0
+            frac = jnp.clip(t / t_max, 0.0, 1.0)
+            if host_rng:
+                (u_e0, u_j, u_q, u_r, r1, r2, r3, r4, s, n1, n2) = host_r
+            else:
+                u_e0 = _uniform_bits(fit.shape)
+                u_j = _uniform_bits(fit.shape)
+                u_q = _uniform_bits(fit.shape)
+                u_r = _uniform_bits(fit.shape)
+                r1 = _uniform_bits(pos.shape)
+                r2 = _uniform_bits(pos.shape)
+                r3 = _uniform_bits(pos.shape)
+                r4 = _uniform_bits(pos.shape)
+                s = _uniform_bits(pos.shape)
+                n1, n2 = _normal_pair(pos.shape)
+
+            e0 = 2.0 * u_e0 - 1.0
+            energy = 2.0 * e0 * (1.0 - frac)       # [1, T]
+            abs_e = jnp.abs(energy)
+            jump = 2.0 * (1.0 - u_j)
+
+            x_rand = pltpu.roll(
+                peer0,
+                l_peer + _LANE_SHIFTS[step % len(_LANE_SHIFTS)][0],
+                1,
+            )
+            explore_a = x_rand - r1 * jnp.abs(x_rand - 2.0 * r2 * pos)
+            explore_b = (rabbit - mean) - r3 * (lb + r4 * (ub - lb))
+            explore = jnp.where(u_q >= 0.5, explore_a, explore_b)
+
+            delta = rabbit - pos
+            soft = delta - energy * jnp.abs(jump * rabbit - pos)
+            hard = rabbit - energy * jnp.abs(delta)
+            besiege = jnp.where(abs_e >= 0.5, soft, hard)
+
+            y_soft = rabbit - energy * jnp.abs(jump * rabbit - pos)
+            y_hard = rabbit - energy * jnp.abs(jump * rabbit - mean)
+            y = jnp.where(abs_e >= 0.5, y_soft, y_hard)
+            levy = sigma * n1 * _exp2_fast(
+                -inv_beta * _log2_fast(jnp.abs(n2) + 1e-12)
+            )
+            z = y + s * levy
+            y = jnp.clip(y, lb, ub)
+            z = jnp.clip(z, lb, ub)
+            fy = objective_t(y)
+            fz = objective_t(z)
+            dive = jnp.where(
+                fy < fit, y, jnp.where(fz < fit, z, pos)
+            )
+
+            exploit = jnp.where(u_r >= 0.5, besiege, dive)
+            pos = jnp.clip(
+                jnp.where(abs_e >= 1.0, explore, exploit), lb, ub
+            )
+            fit = objective_t(pos)
+
+        pos_o[:] = pos
+        fit_o[:] = fit
+
+    if host_rng:
+        def kernel(scalar_ref, best_ref, mean_ref, pos_ref, fit_ref,
+                   peer_ref, ue, uj, uq, ur, r1, r2, r3, r4, s, n1, n2,
+                   *outs):
+            body(
+                scalar_ref, best_ref, mean_ref, pos_ref, fit_ref,
+                peer_ref,
+                (ue[:], uj[:], uq[:], ur[:], r1[:], r2[:], r3[:],
+                 r4[:], s[:], n1[:], n2[:]),
+                *outs,
+            )
+    else:
+        def kernel(scalar_ref, best_ref, mean_ref, pos_ref, fit_ref,
+                   peer_ref, *outs):
+            pltpu.prng_seed(scalar_ref[0] + pl.program_id(0))
+            body(scalar_ref, best_ref, mean_ref, pos_ref, fit_ref,
+                 peer_ref, None, *outs)
+
+    return kernel
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "half_width", "t_max", "levy_beta", "tile_n",
+        "rng", "interpret", "k_steps",
+    ),
+)
+def fused_hho_step_t(
+    scalars: jax.Array,       # [4] i32: seed, peer tile shift, t0, lane
+    best_pos: jax.Array,      # [D, 1]
+    mean_pos: jax.Array,      # [D, 1]
+    pos: jax.Array,           # [D, N]
+    fit: jax.Array,           # [1, N]
+    host_draws: tuple | None = None,
+    *,
+    objective_name: str,
+    half_width: float = 5.12,
+    t_max: int = T_MAX,
+    levy_beta: float = LEVY_BETA,
+    tile_n: int = 4096,
+    rng: str = "tpu",
+    interpret: bool = False,
+    k_steps: int = 1,
+) -> Tuple[jax.Array, jax.Array]:
+    """``k_steps`` fused HHO generations; returns ``(pos, fit)``."""
+    d, n = pos.shape
+    if n % tile_n:
+        raise ValueError(f"N ({n}) must be a multiple of tile_n ({tile_n})")
+    n_tiles = n // tile_n
+    host_rng = rng == "host"
+    if host_rng and host_draws is None:
+        raise ValueError('rng="host" requires host_draws')
+    if host_rng and k_steps != 1:
+        raise ValueError('rng="host" supports k_steps=1 only')
+
+    kernel = _make_kernel(
+        OBJECTIVES_T[objective_name], half_width, t_max, levy_beta,
+        _mantegna_sigma(levy_beta), host_rng, k_steps,
+    )
+
+    col = lambda i, s: (0, i)                                # noqa: E731
+    fixed = lambda i, s: (0, 0)                              # noqa: E731
+    rot = lambda i, s: (0, jax.lax.rem(i + s[1], n_tiles))   # noqa: E731
+    dn = pl.BlockSpec((d, tile_n), col, memory_space=pltpu.VMEM)
+    ft = pl.BlockSpec((1, tile_n), col, memory_space=pltpu.VMEM)
+    b128 = pl.BlockSpec((d, 128), fixed, memory_space=pltpu.VMEM)
+
+    in_specs = [
+        b128, b128, dn, ft,
+        pl.BlockSpec((d, tile_n), rot, memory_space=pltpu.VMEM),
+    ]
+    operands = [
+        jnp.broadcast_to(best_pos, (d, 128)),
+        jnp.broadcast_to(mean_pos, (d, 128)),
+        pos, fit, pos,
+    ]
+    if host_rng:
+        in_specs += [ft, ft, ft, ft, dn, dn, dn, dn, dn, dn, dn]
+        operands += list(host_draws)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=in_specs,
+        out_specs=[dn, ft],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((d, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+        ],
+        interpret=interpret,
+    )(scalars.astype(jnp.int32), *operands)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "n_steps", "half_width", "t_max", "levy_beta",
+        "tile_n", "rng", "interpret", "steps_per_kernel",
+    ),
+)
+def fused_hho_run(
+    state: HHOState,
+    objective_name: str,
+    n_steps: int,
+    half_width: float = 5.12,
+    t_max: int = T_MAX,
+    levy_beta: float = LEVY_BETA,
+    tile_n: int | None = None,
+    rng: str = "tpu",
+    interpret: bool = False,
+    steps_per_kernel: int = 8,
+) -> HHOState:
+    """``n_steps`` fused HHO generations — HHOState in/out, drop-in
+    fast path for ``ops.hho.hho_run`` with the module docstring's
+    rotational/snapshot deltas."""
+    n, d = state.pos.shape
+    if rng == "host":
+        steps_per_kernel = 1
+    # Three objective evaluations + eleven random planes per step: the
+    # same scoped-VMEM budget class as the cuckoo kernel — cap at 8.
+    steps_per_kernel = min(steps_per_kernel, 8)
+    if tile_n is None:
+        tile_n = _auto_tile(_ceil_to(max(d, 8), 8))
+    tile_n = min(tile_n, _ceil_to(n, 128))
+    tile_n, n_pad, n_tiles = shrink_tile_for_donors(n, tile_n)
+
+    pos_t = _cyclic_pad_rows(state.pos, n_pad).T
+    fit_t = _cyclic_pad_rows(state.fit, n_pad)[None, :]
+    seed0 = seed_base(state.key)
+    host_key = jax.random.fold_in(state.key, 0x440)
+    shift_key = jax.random.fold_in(state.key, 0x441)
+
+    def block(carry, call_i, k):
+        pos_t, fit_t, best_pos, best_fit, it = carry
+        kk = jax.random.fold_in(shift_key, call_i)
+        tshift = jax.random.randint(kk, (), 1, max(n_tiles, 2))
+        lshift = jax.random.randint(
+            jax.random.fold_in(kk, 1), (), 0, tile_n
+        )
+        scalars = jnp.stack(
+            [seed0 + call_i * n_tiles, tshift, it, lshift]
+        ).astype(jnp.int32)
+        # Mean over the REAL population lanes (pad lanes are duplicates
+        # of leading members — excluding them keeps x_m exact).
+        mean = jnp.mean(pos_t[:, :n], axis=1, keepdims=True)
+        host_draws = None
+        if rng == "host":
+            import jax.random as jr
+
+            ks = jr.split(jr.fold_in(host_key, call_i), 11)
+            rows = [
+                jr.uniform(ks[i], fit_t.shape, jnp.float32)
+                for i in range(4)
+            ]
+            planes = [
+                jr.uniform(ks[4 + i], pos_t.shape, jnp.float32)
+                for i in range(5)
+            ]
+            normals = [
+                jr.normal(ks[9 + i], pos_t.shape, jnp.float32)
+                for i in range(2)
+            ]
+            host_draws = tuple(rows + planes + normals)
+        pos_t, fit_t = fused_hho_step_t(
+            scalars, best_pos[:, None], mean, pos_t, fit_t, host_draws,
+            objective_name=objective_name, half_width=half_width,
+            t_max=t_max, levy_beta=levy_beta, tile_n=tile_n, rng=rng,
+            interpret=interpret, k_steps=k,
+        )
+        cand_fit, cand_pos = best_of_block(fit_t, pos_t)
+        improved = cand_fit < best_fit
+        best_fit = jnp.where(improved, cand_fit, best_fit)
+        best_pos = jnp.where(improved, cand_pos, best_pos)
+        return (pos_t, fit_t, best_pos, best_fit, it + k)
+
+    carry = run_blocks(
+        block,
+        (
+            pos_t, fit_t,
+            state.best_pos.astype(jnp.float32),
+            state.best_fit.astype(jnp.float32),
+            state.iteration,
+        ),
+        n_steps, steps_per_kernel,
+    )
+    pos_t, fit_t, best_pos, best_fit, _ = carry
+    dt = state.pos.dtype
+    return HHOState(
+        pos=pos_t.T[:n].astype(dt),
+        fit=fit_t[0, :n].astype(state.fit.dtype),
+        best_pos=best_pos.astype(state.best_pos.dtype),
+        best_fit=best_fit.astype(state.best_fit.dtype),
+        key=jax.random.fold_in(state.key, n_steps),
+        iteration=state.iteration + n_steps,
+    )

@@ -1,0 +1,77 @@
+"""Fused Pallas HHO kernel (ops/pallas/hho_fused.py): rotational peer,
+in-kernel triple evaluation + Levy dives, model backend switch.
+Interpret mode on CPU with host RNG, like the siblings."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_swarm_algorithm_tpu.models.hho import HarrisHawks
+from distributed_swarm_algorithm_tpu.ops.hho import hho_init, hho_run
+from distributed_swarm_algorithm_tpu.ops.objectives import (
+    rastrigin,
+    sphere,
+)
+from distributed_swarm_algorithm_tpu.ops.pallas.hho_fused import (
+    fused_hho_run,
+    hho_pallas_supported,
+)
+
+HW = 5.12
+
+
+def test_fused_run_converges_sphere():
+    st = hho_init(sphere, 1024, 6, HW, seed=0)
+    out = fused_hho_run(st, "sphere", 150, half_width=HW, t_max=150,
+                        rng="host", interpret=True)
+    assert out.pos.shape == (1024, 6)
+    assert int(out.iteration) == 150
+    assert float(out.best_fit) < 1e-3
+    assert bool((jnp.abs(out.pos) <= HW + 1e-5).all())
+    assert float(out.best_fit) <= float(out.fit.min()) + 1e-6
+
+
+def test_fused_matches_portable_regime():
+    st = hho_init(rastrigin, 2048, 8, HW, seed=1)
+    fused = fused_hho_run(st, "rastrigin", 200, half_width=HW,
+                          t_max=200, rng="host", interpret=True)
+    portable = hho_run(st, rastrigin, 200, half_width=HW, t_max=200)
+    f, p = float(fused.best_fit), float(portable.best_fit)
+    assert f < p * 3.0 + 5.0, (f, p)
+
+
+def test_fused_deterministic_and_monotone():
+    st = hho_init(rastrigin, 512, 6, HW, seed=3)
+    prev = float(st.best_fit)
+    s = st
+    for _ in range(3):
+        s = fused_hho_run(s, "rastrigin", 10, half_width=HW, t_max=30,
+                          rng="host", interpret=True)
+        cur = float(s.best_fit)
+        assert cur <= prev + 1e-6
+        prev = cur
+    a = fused_hho_run(st, "rastrigin", 25, half_width=HW, t_max=25,
+                      rng="host", interpret=True)
+    b = fused_hho_run(st, "rastrigin", 25, half_width=HW, t_max=25,
+                      rng="host", interpret=True)
+    np.testing.assert_array_equal(np.asarray(a.pos), np.asarray(b.pos))
+
+
+def test_tiny_population_rejected():
+    st = hho_init(sphere, 64, 5, HW, seed=2)
+    with pytest.raises(ValueError, match="rotational"):
+        fused_hho_run(st, "sphere", 5, half_width=HW, rng="host",
+                      interpret=True)
+
+
+def test_hho_model_backend_switch():
+    assert hho_pallas_supported("rastrigin", jnp.float32)
+    assert not hho_pallas_supported("rastrigin", jnp.bfloat16)
+    opt = HarrisHawks("sphere", n=1024, dim=4, t_max=80, seed=0,
+                      use_pallas=True)
+    opt.run(80)
+    assert opt.best < 1e-2
+    with pytest.raises(ValueError):
+        HarrisHawks("sphere", n=64, dim=4, seed=0, use_pallas=True)
+    with pytest.raises(ValueError):
+        HarrisHawks(sphere, n=1024, dim=4, seed=0, use_pallas=True)

@@ -1,0 +1,41 @@
+"""Fused MFO at 1M moths (tenth fused family).
+
+Portable MFO measures ~8.3M moth-steps/s at 1M — bound on the
+per-generation elitist flame update (length-2N sort + two [N, D] row
+gathers).  The fused kernel (ops/pallas/mfo_fused.py) exploits the
+positional flame pairing (zero in-kernel gathers) and refreshes the
+flame memory at block cadence, amortizing the sort by
+steps_per_kernel.
+"""
+
+from __future__ import annotations
+
+from common import REFERENCE_AGENT_STEPS_PER_SEC, report, timeit_best
+
+from distributed_swarm_algorithm_tpu.models.mfo import MFO
+
+N = 1_048_576
+DIM = 30
+STEPS = 256
+
+
+def main() -> None:
+    opt = MFO("rastrigin", n=N, dim=DIM, t_max=1000, seed=0)
+    float(opt.state.flame_fit[0])
+    opt.run(STEPS)
+    float(opt.state.flame_fit[0])
+    best = timeit_best(
+        lambda: opt.run(STEPS), lambda: float(opt.state.flame_fit[0]),
+        reps=3,
+    )
+    path = "pallas-fused" if opt.use_pallas else "xla-jit"
+    report(
+        f"agent-steps/sec, MFO Rastrigin-30D, {N} moths, 1 chip ({path})",
+        N * STEPS / best,
+        "agent-steps/sec",
+        REFERENCE_AGENT_STEPS_PER_SEC,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -33,6 +33,7 @@ BENCHES = [
     "bench_woa_1m.py",
     "bench_cuckoo_1m.py",
     "bench_hho_1m.py",
+    "bench_mfo_1m.py",
     "bench_firefly_64k.py",
     "bench_swarm_tpu.py",
     "bench_boids.py",
@@ -50,6 +51,7 @@ QUICK_SKIP = {
     "bench_woa_1m.py",
     "bench_cuckoo_1m.py",
     "bench_hho_1m.py",
+    "bench_mfo_1m.py",
     "bench_firefly_64k.py",
     "bench_swarm_tpu.py",
     "bench_boids.py",

@@ -404,6 +404,54 @@ def gate_hho_tpu_prng() -> dict:
     }
 
 
+def gate_mfo_host_exact() -> dict:
+    from distributed_swarm_algorithm_tpu.ops.mfo import mfo_init
+    from distributed_swarm_algorithm_tpu.ops.objectives import rastrigin
+    from distributed_swarm_algorithm_tpu.ops.pallas.mfo_fused import (
+        fused_mfo_run,
+    )
+
+    # Two steps, looser frac threshold than the siblings: MFO's elitist
+    # refresh SORTS the whole flame array, so a single near-tie fitness
+    # comparison flipped by cross-backend f32 reassociation (~1e-6)
+    # permutes entire rows and then redirects every moth that pairs
+    # with them — divergence amplifies chaotically per refresh (step 1
+    # measures frac_close 1.0, step 2 ~0.995, step 5 ~0.1).  A real
+    # lowering bug still breaks step 1 outright, and the convergence
+    # gate covers the long-run behavior.
+    st = mfo_init(rastrigin, n=4096, dim=16, half_width=5.12, seed=7)
+    dev = fused_mfo_run(st, "rastrigin", 2, t_max=100, rng="host",
+                        interpret=False)
+    jax.block_until_ready(dev.pos)
+    with jax.default_device(_cpu_device()):
+        ref = fused_mfo_run(
+            _to_cpu(st), "rastrigin", 2, t_max=100, rng="host",
+            interpret=True,
+        )
+    res = _state_parity(dev, ref, ("pos", "fit", "flame_fit"))
+    dg = abs(float(dev.flame_fit[0]) - float(ref.flame_fit[0]))
+    res["gbest_abs_diff"] = round(dg, 8)
+    res["ok"] = res["worst"] >= 0.98 and dg <= 1e-2
+    return res
+
+
+def gate_mfo_tpu_prng() -> dict:
+    from distributed_swarm_algorithm_tpu.ops.mfo import mfo_init, mfo_run
+    from distributed_swarm_algorithm_tpu.ops.objectives import rastrigin
+    from distributed_swarm_algorithm_tpu.ops.pallas.mfo_fused import (
+        fused_mfo_run,
+    )
+
+    st = mfo_init(rastrigin, n=16384, dim=30, half_width=5.12, seed=11)
+    fused = fused_mfo_run(st, "rastrigin", 256, t_max=1000, rng="tpu")
+    portable = mfo_run(st, rastrigin, 256, t_max=1000)
+    f, p = float(fused.flame_fit[0]), float(portable.flame_fit[0])
+    return {
+        "fused_best": round(f, 4), "portable_best": round(p, 4),
+        "ok": _convergence_band(f, p),
+    }
+
+
 def gate_separation_exact() -> dict:
     """Tiled all-pairs Pallas kernel vs the dense jnp broadcast, on-chip
     Mosaic vs on-CPU XLA.  Deterministic (no RNG, no selection), so the
@@ -576,6 +624,7 @@ ALL_GATES = {
     "woa_host_exact": gate_woa_host_exact,
     "cuckoo_host_exact": gate_cuckoo_host_exact,
     "hho_host_exact": gate_hho_host_exact,
+    "mfo_host_exact": gate_mfo_host_exact,
     "islands_host_exact": gate_islands_host_exact,
     "separation_exact": gate_separation_exact,
     "pso_tpu_prng": gate_pso_tpu_prng,
@@ -586,6 +635,7 @@ ALL_GATES = {
     "woa_tpu_prng": gate_woa_tpu_prng,
     "cuckoo_tpu_prng": gate_cuckoo_tpu_prng,
     "hho_tpu_prng": gate_hho_tpu_prng,
+    "mfo_tpu_prng": gate_mfo_tpu_prng,
 }
 
 

@@ -8,6 +8,8 @@ import jax
 
 from ..ops import mfo as _k
 from ..ops.objectives import get_objective
+from ..ops.pallas import mfo_fused as _mf
+from ..utils.platform import on_tpu as _on_tpu
 from ._checkpoint import CheckpointMixin
 
 
@@ -32,11 +34,14 @@ class MFO(CheckpointMixin):
         b: float = _k.SPIRAL_B,
         seed: int = 0,
         dtype=None,
+        use_pallas: Optional[bool] = None,
     ):
         if isinstance(objective, str):
             fn, default_hw = get_objective(objective)
+            self.objective_name: Optional[str] = objective
         else:
             fn, default_hw = objective, 5.12
+            self.objective_name = None
         self.objective = fn
         self.half_width = float(
             half_width if half_width is not None else default_hw
@@ -50,6 +55,22 @@ class MFO(CheckpointMixin):
             fn, n, dim, self.half_width, seed=seed, **kwargs
         )
 
+        supported = (
+            self.objective_name is not None
+            and _mf.mfo_pallas_supported(
+                self.objective_name or "", self.state.pos.dtype
+            )
+        )
+        if use_pallas is None:
+            self.use_pallas = supported and _on_tpu()
+        elif use_pallas and not supported:
+            raise ValueError(
+                "use_pallas=True needs a named objective from "
+                "ops.objectives and float32 state"
+            )
+        else:
+            self.use_pallas = bool(use_pallas)
+
     def step(self) -> _k.MFOState:
         self.state = _k.mfo_step(
             self.state, self.objective, self.half_width, self.t_max, self.b
@@ -57,10 +78,19 @@ class MFO(CheckpointMixin):
         return self.state
 
     def run(self, n_steps: int) -> _k.MFOState:
-        self.state = _k.mfo_run(
-            self.state, self.objective, n_steps, self.half_width,
-            self.t_max, self.b,
-        )
+        if self.use_pallas:
+            on_tpu = _on_tpu()
+            self.state = _mf.fused_mfo_run(
+                self.state, self.objective_name, n_steps,
+                self.half_width, self.t_max, self.b,
+                rng="tpu" if on_tpu else "host",
+                interpret=not on_tpu,
+            )
+        else:
+            self.state = _k.mfo_run(
+                self.state, self.objective, n_steps, self.half_width,
+                self.t_max, self.b,
+            )
         jax.block_until_ready(self.state.flame_fit)
         return self.state
 

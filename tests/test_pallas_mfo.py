@@ -1,0 +1,78 @@
+"""Fused Pallas MFO kernel (ops/pallas/mfo_fused.py): positional flame
+pairing, block-cadence elitist refresh, model backend switch.
+Interpret mode on CPU with host RNG, like the siblings."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_swarm_algorithm_tpu.models.mfo import MFO
+from distributed_swarm_algorithm_tpu.ops.mfo import mfo_init, mfo_run
+from distributed_swarm_algorithm_tpu.ops.objectives import (
+    rastrigin,
+    sphere,
+)
+from distributed_swarm_algorithm_tpu.ops.pallas.mfo_fused import (
+    fused_mfo_run,
+    mfo_pallas_supported,
+)
+
+HW = 5.12
+
+
+def test_fused_run_converges_sphere():
+    st = mfo_init(sphere, 1024, 6, HW, seed=0)
+    out = fused_mfo_run(st, "sphere", 150, half_width=HW, t_max=150,
+                        rng="host", interpret=True)
+    assert out.pos.shape == (1024, 6)
+    assert int(out.iteration) == 150
+    assert float(out.flame_fit[0]) < 1e-3
+    assert bool((jnp.abs(out.pos) <= HW + 1e-5).all())
+    # flame memory is sorted ascending
+    ff = np.asarray(out.flame_fit)
+    assert (np.diff(ff) >= -1e-6).all()
+
+
+def test_fused_matches_portable_regime():
+    st = mfo_init(rastrigin, 2048, 8, HW, seed=1)
+    fused = fused_mfo_run(st, "rastrigin", 200, half_width=HW,
+                          t_max=200, rng="host", interpret=True)
+    portable = mfo_run(st, rastrigin, 200, half_width=HW, t_max=200)
+    f, p = float(fused.flame_fit[0]), float(portable.flame_fit[0])
+    assert f < p * 3.0 + 5.0, (f, p)
+
+
+def test_flame_memory_monotone_and_deterministic():
+    st = mfo_init(rastrigin, 512, 6, HW, seed=3)
+    prev = float(st.flame_fit[0])
+    s = st
+    for _ in range(3):
+        s = fused_mfo_run(s, "rastrigin", 10, half_width=HW, t_max=30,
+                          rng="host", interpret=True)
+        cur = float(s.flame_fit[0])
+        assert cur <= prev + 1e-6
+        prev = cur
+    a = fused_mfo_run(st, "rastrigin", 25, half_width=HW, t_max=25,
+                      rng="host", interpret=True)
+    b = fused_mfo_run(st, "rastrigin", 25, half_width=HW, t_max=25,
+                      rng="host", interpret=True)
+    np.testing.assert_array_equal(np.asarray(a.pos), np.asarray(b.pos))
+
+
+def test_fused_pads_non_aligned():
+    st = mfo_init(sphere, 700, 5, HW, seed=2)
+    out = fused_mfo_run(st, "sphere", 40, half_width=HW, t_max=40,
+                        rng="host", interpret=True)
+    assert out.pos.shape == (700, 5)
+    assert out.flame_pos.shape == (700, 5)
+    assert float(out.flame_fit[0]) <= float(st.flame_fit[0]) + 1e-6
+
+
+def test_mfo_model_backend_switch():
+    assert mfo_pallas_supported("rastrigin", jnp.float32)
+    assert not mfo_pallas_supported("rastrigin", jnp.bfloat16)
+    opt = MFO("sphere", n=512, dim=4, t_max=80, seed=0, use_pallas=True)
+    opt.run(80)
+    assert opt.best < 1e-2
+    with pytest.raises(ValueError):
+        MFO(sphere, n=512, dim=4, seed=0, use_pallas=True)

@@ -21,7 +21,6 @@ attached, else the portable jit path.  Methodology notes:
 """
 
 import json
-import sys
 import time
 
 from distributed_swarm_algorithm_tpu.models.pso import PSO
@@ -39,15 +38,23 @@ def _parity_gate():
     the host plus an on-chip PRNG statistics check BEFORE any headline
     is printed.  Returns None when no TPU is attached (nothing to
     certify — the portable path's math is the tests' oracle)."""
+    import importlib.util
     import os
 
-    sys.path.insert(
-        0,
-        os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), "benchmarks"
-        ),
+    # Load by file path rather than sys.path.insert(0, benchmarks/): a
+    # permanent path prepend would let any module-name collision in
+    # that dir shadow stdlib/site-packages for the rest of the process.
+    vod_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "benchmarks",
+        "verify_on_device.py",
     )
-    from verify_on_device import run_gates
+    spec = importlib.util.spec_from_file_location(
+        "verify_on_device", vod_path
+    )
+    vod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(vod)
+    run_gates = vod.run_gates
 
     report = run_gates(quick=True)
     if report["parity_ok"] is False:

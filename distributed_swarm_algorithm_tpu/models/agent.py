@@ -300,6 +300,16 @@ class TcpTransport(Transport):
             return None
 
     def send(self, sender_id: int, packet: bytes) -> None:
+        if len(packet) > 0xFFFF:
+            # The u16 length prefix cannot frame it; treat like any
+            # other link failure (drop + log) instead of letting
+            # struct.error escape and kill the caller's tick/reader
+            # thread.  No protocol packet comes near 64 KiB.
+            logger.warning(
+                "TcpTransport: dropping oversized packet (%d bytes)",
+                len(packet),
+            )
+            return
         frame = struct.pack(self.FRAME_FMT, len(packet)) + packet
         # Dial dead peers OUTSIDE the lock: a blocking connect to an
         # unreachable host (up to connect_timeout) must not stall other

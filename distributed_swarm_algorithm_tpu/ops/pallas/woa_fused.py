@@ -17,6 +17,14 @@ snapshot held fixed within a block (same staleness class as the
 delayed-gbest PSO kernel), the spiral's cos(2*pi*l) through the
 polynomial trig (pso_fused._cos2pi), and a host-RNG interpret variant
 with a byte-identical body for CPU testing (tests/test_pallas_woa.py).
+
+One more documented delta beyond the delayed-best staleness: fitness
+is evaluated once per k-step block (on the block's END state), so the
+best-of-block candidate ranks end-of-block whales only — a better
+position visited mid-block and then left is not captured, unlike the
+portable path's per-step best tracking.  WOA's incumbent best ("prey")
+therefore refreshes with per-block granularity; convergence gates in
+tests/test_pallas_woa.py and the on-device verifier bound the effect.
 """
 
 from __future__ import annotations

@@ -48,10 +48,12 @@ if not os.environ.get("DSA_NO_COMPILE_CACHE"):
 
 # XLA's CPU backend segfaults in backend_compile_and_load after several
 # hundred executables accumulate in one process (reproduced with the
-# persistent cache on AND off; the crashing test passes solo).  Bound
-# the live-executable count by dropping jax's in-memory caches every
-# ~100 tests — with the warm persistent disk cache the re-JITs this
-# forces are cheap, and the suite stays one process.
+# persistent cache on AND off; the crashing test passes solo).  This
+# fixture is a WORKAROUND, not a fix: the underlying XLA bug is
+# contained, not removed (commit 4268b64's "at the root" overstated
+# it).  Bound the live-executable count by dropping jax's in-memory
+# caches every ~100 tests — with the warm persistent disk cache the
+# re-JITs this forces are cheap, and the suite stays one process.
 import pytest  # noqa: E402
 
 _TESTS_SINCE_CLEAR = {"n": 0}

@@ -1,0 +1,174 @@
+"""Cross-round benchmark comparator — the perf-regression gate.
+
+``BENCH_HISTORY.json`` (repo root) holds one entry per round label
+(``r02``, ``r03``, ...), each mapping metric strings to recorded
+values.  ``run_all.py --record rNN`` appends a round; this script
+compares two rounds and flags any family whose throughput dropped by
+more than ``--threshold`` (default 20% — the VERDICT r2 §6 bar:
+"a >=20% family-level regression would currently go unnoticed").
+
+Usage:
+    python benchmarks/compare.py r02 r03 [--threshold 0.2]
+
+Matching: metric strings are pinned configs (fixed N/DIM/steps per
+bench script), so they are compared verbatim after normalizing
+embedded measurement floats (NSGA-II's "HV 0.875" etc.) to '#'.
+Metrics present in only one round are listed informationally and do
+not gate.  Exit code 1 iff at least one regression exceeds the
+threshold (higher-is-better metrics only; every recorded metric is a
+throughput).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HISTORY_PATH = os.path.join(ROOT, "BENCH_HISTORY.json")
+
+
+def norm_key(metric: str) -> str:
+    """Stable cross-round key: measurement floats (quality stats that
+    ride inside some metric strings) become '#'; config integers stay
+    (they ARE the pin)."""
+    return re.sub(r"\d+\.\d+", "#", metric)
+
+
+def load_history(path: str = HISTORY_PATH) -> dict:
+    if not os.path.exists(path):
+        return {"rounds": {}}
+    with open(path) as f:
+        return json.load(f)
+
+
+def save_history(hist: dict, path: str = HISTORY_PATH) -> None:
+    with open(path, "w") as f:
+        json.dump(hist, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def record(label: str, parsed_lines: list[dict],
+           path: str = HISTORY_PATH) -> None:
+    """Merge a list of bench JSON dicts into round ``label``."""
+    hist = load_history(path)
+    rnd = hist["rounds"].setdefault(label, {})
+    for obj in parsed_lines:
+        if "metric" not in obj or "value" not in obj:
+            continue
+        rnd[obj["metric"]] = {
+            "value": obj["value"],
+            "unit": obj.get("unit", ""),
+        }
+    save_history(hist, path)
+
+
+def round_sort_key(label: str) -> int:
+    """Numeric ordering for round labels: r02 < r09 < r10 < r100
+    (lexicographic sort breaks past two digits)."""
+    digits = re.sub(r"\D", "", label)
+    return int(digits) if digits else 0
+
+
+def baseline_union(rounds: dict, until_label: str) -> dict:
+    """Merged baseline from every round ordered before ``until_label``:
+    each metric's value comes from the latest earlier round that
+    recorded it.  A partial round (e.g. recorded under ``--quick``)
+    therefore narrows nothing — families it skipped stay gated against
+    their last full measurement."""
+    cut = round_sort_key(until_label)
+    merged: dict = {}
+    for lab in sorted(
+        (r for r in rounds if round_sort_key(r) < cut),
+        key=round_sort_key,
+    ):
+        merged.update(rounds[lab])
+    return merged
+
+
+def compare(prev_label: str, cur_label: str, threshold: float = 0.2,
+            path: str = HISTORY_PATH, min_coverage: float = 0.0) -> int:
+    """Print a comparison table; return count of gating failures.
+
+    ``prev_label`` may be a round label or the special string
+    ``"union"`` (the merged baseline of every round before
+    ``cur_label`` — what ``run_all.py --record`` gates against).
+    ``min_coverage`` guards against a vacuously green gate: if fewer
+    than that fraction of baseline metrics are matched by the current
+    round, the gate fails (a partial or wrong-path run proves
+    nothing)."""
+    hist = load_history(path)
+    rounds = hist.get("rounds", {})
+    if cur_label not in rounds:
+        print(f"# no round '{cur_label}' in {path} "
+              f"(have: {sorted(rounds, key=round_sort_key)})",
+              file=sys.stderr)
+        return 1
+    if prev_label == "union":
+        prev_metrics = baseline_union(rounds, cur_label)
+    elif prev_label in rounds:
+        prev_metrics = rounds[prev_label]
+    else:
+        print(f"# no round '{prev_label}' in {path} "
+              f"(have: {sorted(rounds, key=round_sort_key)})",
+              file=sys.stderr)
+        return 0
+    prev = {norm_key(k): (k, v) for k, v in prev_metrics.items()}
+    cur = {norm_key(k): (k, v) for k, v in rounds[cur_label].items()}
+
+    regressions = []
+    for key in sorted(set(prev) & set(cur)):
+        pv = float(prev[key][1]["value"])
+        cv = float(cur[key][1]["value"])
+        if pv <= 0:
+            continue
+        ratio = cv / pv
+        status = "ok"
+        if ratio < 1.0 - threshold:
+            status = "REGRESSION"
+            regressions.append((key, pv, cv, ratio))
+        elif ratio > 1.0 + threshold:
+            status = "improved"
+        print(f"{status:>10}  {ratio:6.2f}x  {cur[key][0]}"
+              f"  ({pv:.3g} -> {cv:.3g})")
+    for key in sorted(set(cur) - set(prev)):
+        print(f"{'new':>10}      -    {cur[key][0]}"
+              f"  ({float(cur[key][1]['value']):.3g})")
+    for key in sorted(set(prev) - set(cur)):
+        print(f"{'dropped':>10}      -    {prev[key][0]}"
+              f"  (was {float(prev[key][1]['value']):.3g})")
+    if regressions:
+        print(f"\n# {len(regressions)} regression(s) beyond "
+              f"{threshold:.0%} vs {prev_label}:", file=sys.stderr)
+        for key, pv, cv, ratio in regressions:
+            print(f"#   {ratio:.2f}x  {key}", file=sys.stderr)
+    failures = len(regressions)
+    if prev:
+        coverage = len(set(prev) & set(cur)) / len(prev)
+        if coverage < min_coverage:
+            print(
+                f"# COVERAGE GATE: only {coverage:.0%} of baseline "
+                f"metrics matched (< {min_coverage:.0%}) — a partial "
+                "run proves nothing; use --no-gate to record anyway",
+                file=sys.stderr,
+            )
+            failures += 1
+    return failures
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prev", help="baseline round label, or 'union'")
+    ap.add_argument("cur")
+    ap.add_argument("--threshold", type=float, default=0.2)
+    ap.add_argument("--min-coverage", type=float, default=0.0)
+    args = ap.parse_args()
+    return 1 if compare(args.prev, args.cur, args.threshold,
+                        min_coverage=args.min_coverage) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

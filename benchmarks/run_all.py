@@ -3,15 +3,23 @@
 Each bench is a subprocess so a failure (e.g. no TPU attached for the
 1M-particle configs) skips that line instead of killing the suite.
 Usage:  python benchmarks/run_all.py  [--quick] [--tests]
+                                      [--record rNN] [--no-gate]
 
 ``--tests`` first runs the FULL pytest suite (including the tests the
 default `pytest` run deselects via the `slow` marker: heavyweight
 convergence sweeps, multi-process socket scenarios, examples smoke) —
 the CI-style everything gate.
+
+``--record rNN`` merges every printed metric into BENCH_HISTORY.json
+under round label rNN, then runs ``compare.py`` against the latest
+earlier round: any family-level throughput drop >20% fails the run
+(the perf-regression gate, VERDICT r2 §6).  ``--no-gate`` records and
+prints the comparison without failing.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 import sys
@@ -60,49 +68,92 @@ QUICK_SKIP = {
 }
 
 
+def _run_one(cmd, cwd, recorded, record: bool) -> bool:
+    """Run one bench subprocess; print/record its JSON lines.  Returns
+    False on failure/timeout."""
+    name = os.path.basename(cmd[-1])
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=1800, cwd=cwd,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"# {name} timed out after 1800s", file=sys.stderr)
+        return False
+    for line in proc.stdout.splitlines():
+        if line.startswith("{"):
+            print(line, flush=True)
+            if record:
+                try:
+                    recorded.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+    if proc.returncode != 0:
+        tail = (proc.stderr.strip().splitlines()[-1]
+                if proc.stderr.strip() else "no stderr")
+        print(f"# {name} failed (rc={proc.returncode}): {tail}",
+              file=sys.stderr)
+        return False
+    return True
+
+
 def main() -> int:
-    quick = "--quick" in sys.argv[1:]
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--tests", action="store_true")
+    ap.add_argument("--record", metavar="rNN", default=None)
+    ap.add_argument("--no-gate", action="store_true")
+    args = ap.parse_args()
+
+    root = os.path.dirname(HERE)
     failures = 0
-    if "--tests" in sys.argv[1:]:
+    recorded: list = []
+    if args.tests:
         # Full gate = TWO pytest processes (default set, then the slow
-        # set).  XLA's CPU backend_compile_and_load used to segfault
-        # after several hundred executables accumulated in one process;
-        # conftest's periodic jax.clear_caches() fixture fixed the root
-        # cause (the full single-process run now passes), and the
-        # process split stays as defense in depth for the CI-style
-        # gate.
+        # set).  XLA's CPU backend_compile_and_load segfaults after
+        # several hundred executables accumulate in one process;
+        # conftest's periodic jax.clear_caches() fixture CONTAINS the
+        # bug (a workaround — the full single-process run passes with
+        # it), and the process split stays as defense in depth for the
+        # CI-style gate.
         for marker in ("not slow", "slow"):
             rc = subprocess.call(
                 [
                     sys.executable, "-m", "pytest", "tests/", "-q",
                     "-m", marker, "-p", "no:randomly",
                 ],
-                cwd=os.path.dirname(HERE),
+                cwd=root,
             )
             if rc != 0:
                 return rc
     for name in BENCHES:
-        if quick and name in QUICK_SKIP:
+        if args.quick and name in QUICK_SKIP:
             continue
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.join(HERE, name)],
-                capture_output=True, text=True, timeout=1800,
-            )
-        except subprocess.TimeoutExpired:
-            failures += 1
-            print(f"# {name} timed out after 1800s", file=sys.stderr)
-            continue
-        for line in proc.stdout.splitlines():
-            if line.startswith("{"):
-                print(line, flush=True)
-        if proc.returncode != 0:
-            failures += 1
-            print(
-                f"# {name} failed (rc={proc.returncode}): "
-                f"{proc.stderr.strip().splitlines()[-1] if proc.stderr.strip() else 'no stderr'}",
-                file=sys.stderr,
-            )
+        ok = _run_one(
+            [sys.executable, os.path.join(HERE, name)], root,
+            recorded, bool(args.record),
+        )
+        failures += 0 if ok else 1
+    if not args.quick:
+        # The flagship headline (repo-root bench.py, driver contract)
+        # is a gated family too — without it a headline regression
+        # would land in the non-gating 'dropped' bucket.
+        ok = _run_one(
+            [sys.executable, os.path.join(root, "bench.py")], root,
+            recorded, bool(args.record),
+        )
+        failures += 0 if ok else 1
+    if args.record:
+        import compare
+
+        compare.record(args.record, recorded)
+        print(f"# perf-regression gate: union -> {args.record}")
+        n_bad = compare.compare(
+            "union", args.record, min_coverage=0.5,
+        )
+        if n_bad and not args.no_gate:
+            return 1
     return 1 if failures else 0
 
 

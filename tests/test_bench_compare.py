@@ -1,0 +1,111 @@
+"""Perf-regression comparator (benchmarks/compare.py): record/compare
+round trips, the 20% gate, float-stat key normalization, and the seeded
+r02 baseline's integrity."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+spec = importlib.util.spec_from_file_location(
+    "bench_compare", os.path.join(ROOT, "benchmarks", "compare.py")
+)
+compare = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(compare)
+
+
+@pytest.fixture()
+def hist(tmp_path):
+    return str(tmp_path / "BENCH_HISTORY.json")
+
+
+def test_record_and_compare_ok(hist):
+    compare.record("r01", [
+        {"metric": "agent-steps/sec, fam A", "value": 100.0, "unit": "x"},
+        {"metric": "agent-steps/sec, fam B", "value": 50.0, "unit": "x"},
+    ], path=hist)
+    compare.record("r02", [
+        {"metric": "agent-steps/sec, fam A", "value": 95.0, "unit": "x"},
+        {"metric": "agent-steps/sec, fam B", "value": 200.0, "unit": "x"},
+    ], path=hist)
+    assert compare.compare("r01", "r02", path=hist) == 0
+
+
+def test_regression_gates(hist):
+    compare.record("r01", [
+        {"metric": "m", "value": 100.0, "unit": "x"},
+    ], path=hist)
+    compare.record("r02", [
+        {"metric": "m", "value": 79.0, "unit": "x"},   # -21% > 20% bar
+    ], path=hist)
+    assert compare.compare("r01", "r02", path=hist) == 1
+    # threshold is adjustable
+    assert compare.compare("r01", "r02", threshold=0.25, path=hist) == 0
+
+
+def test_new_and_dropped_metrics_do_not_gate(hist):
+    compare.record("r01", [{"metric": "old", "value": 10.0}], path=hist)
+    compare.record("r02", [{"metric": "new", "value": 10.0}], path=hist)
+    assert compare.compare("r01", "r02", path=hist) == 0
+
+
+def test_float_stats_normalized_ints_pinned():
+    # Quality floats riding in the metric string must not break matching
+    a = "generations/sec, NSGA-II ZDT1-30D, pop 512 (HV 0.875, IGD 0.0009)"
+    b = "generations/sec, NSGA-II ZDT1-30D, pop 512 (HV 0.871, IGD 0.0011)"
+    assert compare.norm_key(a) == compare.norm_key(b)
+    # ...but config integers ARE the pin
+    c = "generations/sec, NSGA-II ZDT1-30D, pop 1024 (HV 0.875, IGD 0.0009)"
+    assert compare.norm_key(a) != compare.norm_key(c)
+
+
+def test_record_merges_rounds(hist):
+    compare.record("r01", [{"metric": "a", "value": 1.0}], path=hist)
+    compare.record("r01", [{"metric": "b", "value": 2.0}], path=hist)
+    data = json.load(open(hist))
+    assert set(data["rounds"]["r01"]) == {"a", "b"}
+
+
+def test_round_sort_key_numeric():
+    labs = ["r100", "r02", "r9", "r10"]
+    assert sorted(labs, key=compare.round_sort_key) == [
+        "r02", "r9", "r10", "r100"
+    ]
+
+
+def test_union_baseline_survives_partial_round(hist):
+    # r01 full, r02 partial (quick run): r03 still gates vs r01's keys
+    compare.record("r01", [
+        {"metric": "famA", "value": 100.0},
+        {"metric": "famB", "value": 100.0},
+    ], path=hist)
+    compare.record("r02", [{"metric": "famA", "value": 100.0}],
+                   path=hist)
+    compare.record("r03", [
+        {"metric": "famA", "value": 100.0},
+        {"metric": "famB", "value": 70.0},     # regressed vs r01
+    ], path=hist)
+    assert compare.compare("union", "r03", path=hist) == 1
+
+
+def test_coverage_gate_fails_vacuous_run(hist):
+    compare.record("r01", [
+        {"metric": f"fam{i}", "value": 100.0} for i in range(10)
+    ], path=hist)
+    compare.record("r02", [{"metric": "fam0", "value": 100.0}],
+                   path=hist)
+    # only 10% of baseline matched -> coverage gate trips at 50%
+    assert compare.compare("r01", "r02", path=hist,
+                           min_coverage=0.5) == 1
+    assert compare.compare("r01", "r02", path=hist) == 0
+
+
+def test_seeded_history_loads_and_has_r02():
+    data = compare.load_history()   # the real repo-root file
+    assert "r02" in data["rounds"]
+    r02 = data["rounds"]["r02"]
+    assert len(r02) >= 13
+    assert all(v["value"] > 0 for v in r02.values())

@@ -1,0 +1,41 @@
+"""Fused GA at 1M individuals (eleventh fused family).
+
+Portable GA measures 16.1M individual-steps/s at 1M on the chip — the
+four tournament row gathers per generation bound it like portable DE's
+donors did.  The fused kernel (ops/pallas/ga_fused.py: rotational
+tournaments + in-kernel SBX/mutation via fast log2/exp2 + per-tile
+elitism) removes every gather.
+"""
+
+from __future__ import annotations
+
+from common import REFERENCE_AGENT_STEPS_PER_SEC, report, timeit_best
+
+from distributed_swarm_algorithm_tpu.models.ga import GA
+
+N = 1_048_576
+DIM = 30
+STEPS = 256
+
+
+def main() -> None:
+    opt = GA("rastrigin", n=N, dim=DIM, seed=0)
+    float(opt.state.best_fit)
+    opt.run(STEPS)
+    float(opt.state.best_fit)
+    best = timeit_best(
+        lambda: opt.run(STEPS), lambda: float(opt.state.best_fit),
+        reps=3,
+    )
+    path = "pallas-fused" if opt.use_pallas else "xla-jit"
+    report(
+        f"agent-steps/sec, GA Rastrigin-30D, {N} individuals, "
+        f"1 chip ({path})",
+        N * STEPS / best,
+        "agent-steps/sec",
+        REFERENCE_AGENT_STEPS_PER_SEC,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -37,6 +37,7 @@ BENCHES = [
     "bench_bat_1m.py",
     "bench_gwo_1m.py",
     "bench_de_1m.py",
+    "bench_ga_1m.py",
     "bench_shade_1m.py",
     "bench_woa_1m.py",
     "bench_cuckoo_1m.py",
@@ -55,6 +56,7 @@ QUICK_SKIP = {
     "bench_bat_1m.py",
     "bench_gwo_1m.py",
     "bench_de_1m.py",
+    "bench_ga_1m.py",
     "bench_shade_1m.py",
     "bench_woa_1m.py",
     "bench_cuckoo_1m.py",

@@ -1,0 +1,102 @@
+"""Fused Pallas GA kernel (ops/pallas/ga_fused.py): rotational-
+tournament semantics, per-tile elitism, padding/convergence contract,
+and the model-level backend switch.  Runs the real kernel body on CPU
+via ``interpret=True`` with host RNG, like the DE/cuckoo siblings."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_swarm_algorithm_tpu.models.ga import GA
+from distributed_swarm_algorithm_tpu.ops.ga import ga_init, ga_run
+from distributed_swarm_algorithm_tpu.ops.objectives import (
+    rastrigin,
+    sphere,
+)
+from distributed_swarm_algorithm_tpu.ops.pallas.ga_fused import (
+    fused_ga_run,
+    ga_pallas_supported,
+)
+
+HW = 5.12
+
+
+def test_fused_run_converges_sphere():
+    st = ga_init(sphere, 1000, 6, HW, seed=0)
+    out = fused_ga_run(st, "sphere", 150, half_width=HW, rng="host",
+                       interpret=True)
+    assert out.pos.shape == (1000, 6)
+    assert int(out.iteration) == 150
+    assert float(out.best_fit) < 1e-3
+    assert bool((jnp.abs(out.pos) <= HW + 1e-5).all())
+    assert float(out.best_fit) <= float(out.fit.min()) + 1e-6
+
+
+def test_fused_matches_portable_regime_on_rastrigin():
+    """Rotational tournaments + per-tile elitism must stay in the
+    portable path's optimization regime (not bit-equal — different
+    selection law)."""
+    st = ga_init(rastrigin, 2048, 8, HW, seed=1)
+    fused = fused_ga_run(st, "rastrigin", 200, half_width=HW,
+                         rng="host", interpret=True)
+    portable = ga_run(st, rastrigin, 200, half_width=HW)
+    f, p = float(fused.best_fit), float(portable.best_fit)
+    assert f < p * 3.0 + 5.0, (f, p)
+
+
+def test_fused_best_monotone_and_deterministic():
+    st = ga_init(rastrigin, 512, 6, HW, seed=3)
+    prev = float(st.best_fit)
+    s = st
+    for _ in range(3):
+        s = fused_ga_run(s, "rastrigin", 10, half_width=HW,
+                         rng="host", interpret=True)
+        cur = float(s.best_fit)
+        assert cur <= prev + 1e-6
+        prev = cur
+    a = fused_ga_run(st, "rastrigin", 25, half_width=HW, rng="host",
+                     interpret=True)
+    b = fused_ga_run(st, "rastrigin", 25, half_width=HW, rng="host",
+                     interpret=True)
+    np.testing.assert_array_equal(np.asarray(a.pos), np.asarray(b.pos))
+
+
+def test_fused_pads_non_aligned_population():
+    st = ga_init(sphere, 700, 5, HW, seed=2)   # 700 not lane-aligned
+    out = fused_ga_run(st, "sphere", 40, half_width=HW, rng="host",
+                       interpret=True)
+    assert out.pos.shape == (700, 5)
+    assert float(out.best_fit) <= float(st.best_fit) + 1e-6
+
+
+def test_tiny_population_rejected():
+    st = ga_init(sphere, 64, 5, HW, seed=2)    # < 4 tiles of 128
+    with pytest.raises(ValueError, match="rotational"):
+        fused_ga_run(st, "sphere", 5, half_width=HW, rng="host",
+                     interpret=True)
+
+
+def test_elitism_keeps_tile_best_in_population():
+    """Per-tile 1-elitism: the population min must never worsen across
+    a single fused generation (the elite is re-injected)."""
+    st = ga_init(rastrigin, 512, 6, HW, seed=5)
+    prev_min = float(st.fit.min())
+    s = st
+    for _ in range(5):
+        s = fused_ga_run(s, "rastrigin", 1, half_width=HW,
+                         rng="host", interpret=True)
+        cur_min = float(s.fit.min())
+        assert cur_min <= prev_min + 1e-5, (cur_min, prev_min)
+        prev_min = cur_min
+
+
+def test_ga_model_backend_switch():
+    assert ga_pallas_supported("rastrigin", jnp.float32)
+    assert not ga_pallas_supported("rastrigin", jnp.bfloat16)
+    opt = GA("sphere", n=1024, dim=4, seed=0, use_pallas=True)
+    opt.run(60)
+    assert opt.best < 1e-2
+    with pytest.raises(ValueError):
+        GA("sphere", n=64, dim=4, seed=0, use_pallas=True)   # tiny pop
+    with pytest.raises(ValueError):
+        GA(sphere, n=1024, dim=4, seed=0, use_pallas=True)   # callable

@@ -1,0 +1,41 @@
+"""Fused ABC at 1M food sources (twelfth fused family).
+
+Portable ABC is the worst TPU profile in the zoo — 0.2M source-steps/s
+at 262k (categorical gather + segment-min scatter + gather-back per
+onlooker phase) and a device fault at 1M.  The fused kernel
+(ops/pallas/abc_fused.py: Bernoulli recruitment + rotational partners,
+scatter/gather-free) is the only way ABC runs at this scale at all.
+"""
+
+from __future__ import annotations
+
+from common import REFERENCE_AGENT_STEPS_PER_SEC, report, timeit_best
+
+from distributed_swarm_algorithm_tpu.models.abc_bees import ABC
+
+N = 1_048_576
+DIM = 30
+STEPS = 256
+
+
+def main() -> None:
+    opt = ABC("rastrigin", n=N, dim=DIM, seed=0)
+    float(opt.state.best_fit)
+    opt.run(STEPS)
+    float(opt.state.best_fit)
+    best = timeit_best(
+        lambda: opt.run(STEPS), lambda: float(opt.state.best_fit),
+        reps=3,
+    )
+    path = "pallas-fused" if opt.use_pallas else "xla-jit"
+    report(
+        f"agent-steps/sec, ABC Rastrigin-30D, {N} sources, "
+        f"1 chip ({path})",
+        N * STEPS / best,
+        "agent-steps/sec",
+        REFERENCE_AGENT_STEPS_PER_SEC,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -38,6 +38,7 @@ BENCHES = [
     "bench_gwo_1m.py",
     "bench_de_1m.py",
     "bench_ga_1m.py",
+    "bench_abc_1m.py",
     "bench_shade_1m.py",
     "bench_woa_1m.py",
     "bench_cuckoo_1m.py",
@@ -57,6 +58,7 @@ QUICK_SKIP = {
     "bench_gwo_1m.py",
     "bench_de_1m.py",
     "bench_ga_1m.py",
+    "bench_abc_1m.py",
     "bench_shade_1m.py",
     "bench_woa_1m.py",
     "bench_cuckoo_1m.py",

@@ -233,6 +233,44 @@ def gate_de_tpu_prng() -> dict:
     }
 
 
+def gate_abc_host_exact() -> dict:
+    from distributed_swarm_algorithm_tpu.ops.abc import abc_init
+    from distributed_swarm_algorithm_tpu.ops.objectives import rastrigin
+    from distributed_swarm_algorithm_tpu.ops.pallas.abc_fused import (
+        fused_abc_run,
+    )
+
+    st = abc_init(rastrigin, 4096, dim=16, half_width=5.12, seed=7)
+    dev = fused_abc_run(st, "rastrigin", 5, rng="host", interpret=False)
+    jax.block_until_ready(dev.pos)
+    with jax.default_device(_cpu_device()):
+        ref = fused_abc_run(
+            _to_cpu(st), "rastrigin", 5, rng="host", interpret=True
+        )
+    res = _state_parity(dev, ref, ("pos", "fit", "trials"))
+    dg = abs(float(dev.best_fit) - float(ref.best_fit))
+    res["gbest_abs_diff"] = round(dg, 8)
+    res["ok"] = res["worst"] >= FRAC_CLOSE_MIN and dg <= 1e-2
+    return res
+
+
+def gate_abc_tpu_prng() -> dict:
+    from distributed_swarm_algorithm_tpu.ops.abc import abc_init, abc_run
+    from distributed_swarm_algorithm_tpu.ops.objectives import rastrigin
+    from distributed_swarm_algorithm_tpu.ops.pallas.abc_fused import (
+        fused_abc_run,
+    )
+
+    st = abc_init(rastrigin, 16384, dim=30, half_width=5.12, seed=11)
+    fused = fused_abc_run(st, "rastrigin", 256, rng="tpu")
+    portable = abc_run(st, rastrigin, 256)
+    f, p = float(fused.best_fit), float(portable.best_fit)
+    return {
+        "fused_best": round(f, 4), "portable_best": round(p, 4),
+        "ok": _convergence_band(f, p),
+    }
+
+
 def gate_ga_host_exact() -> dict:
     from distributed_swarm_algorithm_tpu.ops.ga import ga_init
     from distributed_swarm_algorithm_tpu.ops.objectives import rastrigin
@@ -658,6 +696,7 @@ ALL_GATES = {
     "bat_host_exact": gate_bat_host_exact,
     "gwo_host_exact": gate_gwo_host_exact,
     "de_host_exact": gate_de_host_exact,
+    "abc_host_exact": gate_abc_host_exact,
     "ga_host_exact": gate_ga_host_exact,
     "shade_host_exact": gate_shade_host_exact,
     "woa_host_exact": gate_woa_host_exact,
@@ -670,6 +709,7 @@ ALL_GATES = {
     "bat_tpu_prng": gate_bat_tpu_prng,
     "gwo_tpu_prng": gate_gwo_tpu_prng,
     "de_tpu_prng": gate_de_tpu_prng,
+    "abc_tpu_prng": gate_abc_tpu_prng,
     "ga_tpu_prng": gate_ga_tpu_prng,
     "shade_tpu_prng": gate_shade_tpu_prng,
     "woa_tpu_prng": gate_woa_tpu_prng,

@@ -8,11 +8,22 @@ import jax
 
 from ..ops import abc as _k
 from ..ops.objectives import get_objective
+from ..ops.pallas import abc_fused as _af
+from ..utils.platform import on_tpu as _on_tpu
 from ._checkpoint import CheckpointMixin
 
 
 class ABC(CheckpointMixin):
     """Artificial bee colony (employed / onlooker / scout phases).
+
+    Two compute paths with the same ABCState contract: portable jit'd
+    JAX (exact multinomial onlooker recruitment — its categorical
+    sample + segment-min scatter + gather-back is the worst TPU
+    profile in the zoo: 0.2M source-steps/s at 262k, device fault at
+    1M) and the fused Pallas kernel (ops/pallas/abc_fused.py:
+    Bernoulli recruitment + rotational partners, scatter/gather-free)
+    — auto-selected on TPU for named objectives in float32 with
+    n >= 512, or forced with ``use_pallas=True``.
 
     >>> opt = ABC("rastrigin", n=256, dim=10, seed=0)
     >>> opt.run(300)
@@ -28,11 +39,14 @@ class ABC(CheckpointMixin):
         limit: Optional[int] = None,
         seed: int = 0,
         dtype=None,
+        use_pallas: Optional[bool] = None,
     ):
         if isinstance(objective, str):
             fn, default_hw = get_objective(objective)
+            self.objective_name: Optional[str] = objective
         else:
             fn, default_hw = objective, 5.12
+            self.objective_name = None
         self.objective = fn
         self.half_width = float(
             half_width if half_width is not None else default_hw
@@ -44,6 +58,23 @@ class ABC(CheckpointMixin):
             fn, n, dim, self.half_width, seed=seed, **kwargs
         )
 
+        supported = (
+            n >= 512            # rotational partners need >= 4 lane tiles
+            and self.objective_name is not None
+            and _af.abc_pallas_supported(
+                self.objective_name or "", self.state.pos.dtype
+            )
+        )
+        if use_pallas is None:
+            self.use_pallas = supported and _on_tpu()
+        elif use_pallas and not supported:
+            raise ValueError(
+                "use_pallas=True needs a named objective from "
+                "ops.objectives, float32 state, and n >= 512"
+            )
+        else:
+            self.use_pallas = bool(use_pallas)
+
     def step(self) -> _k.ABCState:
         self.state = _k.abc_step(
             self.state, self.objective, self.half_width, self.limit
@@ -51,10 +82,19 @@ class ABC(CheckpointMixin):
         return self.state
 
     def run(self, n_steps: int) -> _k.ABCState:
-        self.state = _k.abc_run(
-            self.state, self.objective, n_steps, self.half_width,
-            self.limit,
-        )
+        if self.use_pallas:
+            on_tpu = _on_tpu()
+            self.state = _af.fused_abc_run(
+                self.state, self.objective_name, n_steps,
+                self.half_width, self.limit,
+                rng="tpu" if on_tpu else "host",
+                interpret=not on_tpu,
+            )
+        else:
+            self.state = _k.abc_run(
+                self.state, self.objective, n_steps, self.half_width,
+                self.limit,
+            )
         jax.block_until_ready(self.state.best_fit)
         return self.state
 

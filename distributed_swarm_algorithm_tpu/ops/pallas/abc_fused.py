@@ -1,0 +1,327 @@
+"""Fused artificial-bee-colony cycle as a Pallas TPU kernel.
+
+Twelfth fused family — and the one the portable path needed most:
+portable ABC (ops/abc.py) measures **0.2M source-steps/s at 262k** on
+v5e and *faults the device at 1M* — the worst profile in the zoo.  The
+onlooker phase is a categorical sample (gather), a segment-min scatter
+for conflict resolution, a winner-row gather-back, and a scatter of
+trial counters; the employed phase adds a partner row gather.  None of
+it survives contact with the TPU at scale.
+
+This kernel is scatter/gather-free:
+
+  - **Employed phase**: partner ``x_k`` is a dynamic lane roll of the
+    CURRENT tile (fresh within a k-step block); the "one random
+    dimension" rule is an in-kernel one-hot mask built from an i32
+    compare of a per-lane random dim index against a sublane iota —
+    the exact v = x_b + phi*(x_b - x_k) single-dim update, purely
+    elementwise.
+  - **Onlooker phase, Bernoulli recruitment**: the portable
+    fitness-proportional multinomial (sample S onlookers over S
+    sources → scatter/segment-min/gather) becomes an independent
+    per-source Bernoulli gate with probability q_i / max_tile(q)
+    (same quality law ``q = 1/(1+max(f,0)) + max(-f,0)``,
+    ops/abc.py:121).  Better sources still get probed more in
+    expectation; the number of onlookers per cycle becomes random
+    (mean = S * mean(q)/max(q)) instead of exactly S, and conflict
+    resolution disappears because each source receives at most one
+    probe — a bijective-recruitment trade in the same family as
+    cuckoo_fused's rotational egg drop.  The onlooker's partner is a
+    rotated block-start snapshot tile (cross-tile gene flow, DE donor
+    machinery).
+  - **Scout phase**: exhausted sources (trials > limit) re-randomize
+    from the on-chip PRNG — elementwise where, third in-VMEM
+    objective evaluation (the HHO kernel set the 3-eval precedent).
+  - Trial counters ride as an i32 [1, N] row through the kernel;
+    the portable semantics are kept exactly: accept → 0, probed-and-
+    rejected → +1, unprobed onlooker sources keep their counter
+    (ops/abc.py:142-148).
+
+Same chassis as the siblings: lane-major [D, N], k cycles per HBM
+round-trip with block-start snapshot donors, host-RNG interpret
+variant with a byte-identical body for CPU testing
+(tests/test_pallas_abc.py).
+
+Capability lineage: the reference has no optimizer; ABC's
+employed/onlooker/scout division mirrors its forager/leader role
+split (SURVEY.md; /root/reference/agent.py:338-347 is the only
+fitness logic).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..abc import ABCState
+from .common import ceil_to as _ceil_to, cyclic_pad_rows as _cyclic_pad_rows
+from .de_fused import _LANE_SHIFTS, shrink_tile_for_donors
+from .pso_fused import (
+    OBJECTIVES_T,
+    _auto_tile,
+    _uniform_bits,
+    best_of_block,
+    run_blocks,
+    seed_base,
+)
+
+
+def abc_pallas_supported(objective_name, dtype) -> bool:
+    return objective_name in OBJECTIVES_T and jnp.dtype(dtype) == jnp.float32
+
+
+def _quality(fit):
+    """Monotone-decreasing source quality, any sign (ops/abc.py:121)."""
+    return 1.0 / (1.0 + jnp.maximum(fit, 0.0)) + jnp.maximum(-fit, 0.0)
+
+
+def _make_kernel(objective_t, half_width, limit, host_rng, k_steps):
+    def body(scalar_ref, pos_ref, fit_ref, tri_ref, p2_ref,
+             r_e, r_o, r_s, pos_o, fit_o, tri_o):
+        pos, fit, trials = pos_ref[:], fit_ref[:], tri_ref[:]
+        p2s = p2_ref[:]
+        d = pos.shape[0]
+        dl1, dl2 = scalar_ref[2], scalar_ref[3]
+        row = jax.lax.broadcasted_iota(jnp.int32, pos.shape, 0)
+
+        def mutate(base, partner, u_dim, u_phi):
+            """v = base + onehot(j) * phi * (base - partner)."""
+            j = jnp.floor(u_dim * d).astype(jnp.int32)      # [1, T]
+            mask = (row == j).astype(base.dtype)            # [D, T]
+            phi = 2.0 * u_phi - 1.0                         # [1, T]
+            cand = base + mask * (phi * (base - partner))
+            return jnp.clip(cand, -half_width, half_width)
+
+        for step in range(k_steps):
+            la, lb, _ = _LANE_SHIFTS[step % len(_LANE_SHIFTS)]
+            if host_rng:
+                ud1, up1 = r_e
+                ug, ud2, up2 = r_o
+                fresh_u = r_s
+            else:
+                ud1 = _uniform_bits(fit.shape)
+                up1 = _uniform_bits(fit.shape)
+                ug = _uniform_bits(fit.shape)
+                ud2 = _uniform_bits(fit.shape)
+                up2 = _uniform_bits(fit.shape)
+                fresh_u = _uniform_bits(pos.shape)
+
+            # --- employed: partner = rolled CURRENT tile -------------
+            partner = pltpu.roll(pos, dl1 + la, 1)
+            cand = mutate(pos, partner, ud1, up1)
+            cfit = objective_t(cand)
+            acc = cfit < fit
+            pos = jnp.where(acc, cand, pos)
+            fit = jnp.where(acc, cfit, fit)
+            trials = jnp.where(acc, 0, trials + 1)
+
+            # --- onlooker: Bernoulli recruitment, snapshot partner ---
+            q = _quality(fit)
+            p_recruit = q / jnp.maximum(jnp.max(q), 1e-12)
+            probed = ug < p_recruit
+            partner2 = pltpu.roll(p2s, dl2 + lb, 1)
+            cand2 = mutate(pos, partner2, ud2, up2)
+            c2fit = objective_t(cand2)
+            acc2 = probed & (c2fit < fit)
+            pos = jnp.where(acc2, cand2, pos)
+            fit = jnp.where(acc2, c2fit, fit)
+            trials = jnp.where(
+                acc2, 0, jnp.where(probed, trials + 1, trials)
+            )
+
+            # --- scout: re-randomize exhausted sources ---------------
+            exhausted = trials > limit
+            fresh = (2.0 * fresh_u - 1.0) * half_width
+            ffit = objective_t(fresh)
+            pos = jnp.where(exhausted, fresh, pos)
+            fit = jnp.where(exhausted, ffit, fit)
+            trials = jnp.where(exhausted, 0, trials)
+
+        pos_o[:] = pos
+        fit_o[:] = fit
+        tri_o[:] = trials
+
+    if host_rng:
+        def kernel(scalar_ref, pos_ref, fit_ref, tri_ref, p2_ref,
+                   rd1, rp1, rg, rd2, rp2, rf, *outs):
+            body(scalar_ref, pos_ref, fit_ref, tri_ref, p2_ref,
+                 (rd1[:], rp1[:]), (rg[:], rd2[:], rp2[:]), rf[:],
+                 *outs)
+    else:
+        def kernel(scalar_ref, pos_ref, fit_ref, tri_ref, p2_ref,
+                   *outs):
+            pltpu.prng_seed(scalar_ref[0] + pl.program_id(0))
+            body(scalar_ref, pos_ref, fit_ref, tri_ref, p2_ref,
+                 None, None, None, *outs)
+
+    return kernel
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "half_width", "limit", "tile_n", "rng",
+        "interpret", "k_steps",
+    ),
+)
+def fused_abc_step_t(
+    scalars: jax.Array,       # [4] i32: seed, tshift, lane_1, lane_2
+    pos: jax.Array,           # [D, N]
+    fit: jax.Array,           # [1, N]
+    trials: jax.Array,        # [1, N] i32
+    r_host: tuple | None = None,   # 6 host-RNG operands (see driver)
+    *,
+    objective_name: str,
+    half_width: float = 5.12,
+    limit: int = 20,
+    tile_n: int = 4096,
+    rng: str = "tpu",
+    interpret: bool = False,
+    k_steps: int = 1,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """``k_steps`` fused ABC cycles; returns ``(pos, fit, trials)``."""
+    d, n = pos.shape
+    if n % tile_n:
+        raise ValueError(f"N ({n}) must be a multiple of tile_n ({tile_n})")
+    n_tiles = n // tile_n
+    host_rng = rng == "host"
+    if host_rng and r_host is None:
+        raise ValueError('rng="host" requires the uniform operands')
+    if host_rng and k_steps != 1:
+        raise ValueError('rng="host" supports k_steps=1 only')
+
+    kernel = _make_kernel(
+        OBJECTIVES_T[objective_name], half_width, limit, host_rng,
+        k_steps,
+    )
+
+    col = lambda i, s: (0, i)                                # noqa: E731
+    rot = lambda i, s: (0, jax.lax.rem(i + s[1], n_tiles))   # noqa: E731
+    dn = pl.BlockSpec((d, tile_n), col, memory_space=pltpu.VMEM)
+    ft = pl.BlockSpec((1, tile_n), col, memory_space=pltpu.VMEM)
+    dn_r = pl.BlockSpec((d, tile_n), rot, memory_space=pltpu.VMEM)
+
+    in_specs = [dn, ft, ft, dn_r]
+    operands = [pos, fit, trials, pos]
+    if host_rng:
+        in_specs += [ft, ft, ft, ft, ft, dn]
+        operands += list(r_host)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=in_specs,
+        out_specs=[dn, ft, ft],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((d, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.int32),
+        ],
+        interpret=interpret,
+    )(scalars.astype(jnp.int32), *operands)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "n_steps", "half_width", "limit", "tile_n",
+        "rng", "interpret", "steps_per_kernel",
+    ),
+)
+def fused_abc_run(
+    state: ABCState,
+    objective_name: str,
+    n_steps: int,
+    half_width: float = 5.12,
+    limit: int = 20,
+    tile_n: int | None = None,
+    rng: str = "tpu",
+    interpret: bool = False,
+    steps_per_kernel: int = 8,
+) -> ABCState:
+    """``n_steps`` fused ABC cycles — ABCState in/out, drop-in fast
+    path for ``ops.abc.abc_run`` with the module docstring's
+    Bernoulli-recruitment / rotational-partner deltas."""
+    n, d = state.pos.shape
+    if rng == "host":
+        steps_per_kernel = 1
+    # Three in-VMEM objective evaluations per cycle (employed,
+    # onlooker, scout) — HHO's weight class; spk capped at 8.
+    steps_per_kernel = min(steps_per_kernel, 8)
+    if tile_n is None:
+        tile_n = _auto_tile(_ceil_to(max(d, 8), 8))
+    tile_n = min(tile_n, _ceil_to(n, 128))
+    tile_n, n_pad, n_tiles = shrink_tile_for_donors(n, tile_n)
+
+    pos_t = _cyclic_pad_rows(state.pos, n_pad).T
+    fit_t = _cyclic_pad_rows(state.fit, n_pad)[None, :]
+    # cyclic_pad_rows normalizes to f32 (its float-row contract);
+    # trial counters are integral-valued, so the round-trip is exact.
+    tri_t = _cyclic_pad_rows(state.trials, n_pad)[None, :].astype(
+        jnp.int32
+    )
+    seed0 = seed_base(state.key)
+    host_key = jax.random.fold_in(state.key, 0xABC)
+    shift_key = jax.random.fold_in(state.key, 0xAB5)
+
+    def block(carry, call_i, k):
+        pos_t, fit_t, tri_t, best_pos, best_fit = carry
+        kk = jax.random.fold_in(shift_key, call_i)
+        tshift = jax.random.randint(kk, (1,), 1, max(n_tiles, 2))
+        lanes = jax.random.randint(
+            jax.random.fold_in(kk, 1), (2,), 0, tile_n
+        )
+        scalars = jnp.concatenate([
+            jnp.stack([seed0 + call_i * n_tiles]), tshift, lanes,
+        ]).astype(jnp.int32)
+        r_host = None
+        if rng == "host":
+            import jax.random as jr
+
+            kk2 = jr.fold_in(host_key, call_i)
+            ks = jr.split(kk2, 6)
+            r_host = tuple(
+                jr.uniform(ks[i], fit_t.shape, jnp.float32)
+                for i in range(5)
+            ) + (jr.uniform(ks[5], pos_t.shape, jnp.float32),)
+        pos_t, fit_t, tri_t = fused_abc_step_t(
+            scalars, pos_t, fit_t, tri_t, r_host,
+            objective_name=objective_name, half_width=half_width,
+            limit=limit, tile_n=tile_n, rng=rng, interpret=interpret,
+            k_steps=k,
+        )
+        cand_fit, cand_pos = best_of_block(fit_t, pos_t)
+        improved = cand_fit < best_fit
+        best_fit = jnp.where(improved, cand_fit, best_fit)
+        best_pos = jnp.where(improved, cand_pos, best_pos)
+        return (pos_t, fit_t, tri_t, best_pos, best_fit)
+
+    carry = run_blocks(
+        block,
+        (
+            pos_t, fit_t, tri_t,
+            state.best_pos.astype(jnp.float32),
+            state.best_fit.astype(jnp.float32),
+        ),
+        n_steps, steps_per_kernel,
+    )
+    pos_t, fit_t, tri_t, best_pos, best_fit = carry
+    dt = state.pos.dtype
+    return ABCState(
+        pos=pos_t.T[:n].astype(dt),
+        fit=fit_t[0, :n].astype(state.fit.dtype),
+        trials=tri_t[0, :n].astype(state.trials.dtype),
+        best_pos=best_pos.astype(state.best_pos.dtype),
+        best_fit=best_fit.astype(state.best_fit.dtype),
+        key=jax.random.fold_in(state.key, n_steps),
+        iteration=state.iteration + n_steps,
+    )

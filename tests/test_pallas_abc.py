@@ -1,0 +1,102 @@
+"""Fused Pallas ABC kernel (ops/pallas/abc_fused.py): Bernoulli-
+recruitment semantics, trial-counter contract, padding/convergence,
+and the model-level backend switch.  Runs the real kernel body on CPU
+via ``interpret=True`` with host RNG, like the DE/GA siblings."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_swarm_algorithm_tpu.models.abc_bees import ABC
+from distributed_swarm_algorithm_tpu.ops.abc import abc_init, abc_run
+from distributed_swarm_algorithm_tpu.ops.objectives import (
+    rastrigin,
+    sphere,
+)
+from distributed_swarm_algorithm_tpu.ops.pallas.abc_fused import (
+    abc_pallas_supported,
+    fused_abc_run,
+)
+
+HW = 5.12
+
+
+def test_fused_run_converges_sphere():
+    st = abc_init(sphere, 1000, 6, HW, seed=0)
+    out = fused_abc_run(st, "sphere", 200, half_width=HW, rng="host",
+                        interpret=True)
+    assert out.pos.shape == (1000, 6)
+    assert int(out.iteration) == 200
+    assert float(out.best_fit) < 1e-6
+    assert bool((jnp.abs(out.pos) <= HW + 1e-5).all())
+    assert float(out.best_fit) <= float(out.fit.min()) + 1e-6
+
+
+def test_fused_matches_portable_regime_on_rastrigin():
+    """Bernoulli recruitment + rotational partners must stay in the
+    portable path's optimization regime (not bit-equal — different
+    recruitment law)."""
+    st = abc_init(rastrigin, 2048, 8, HW, seed=1)
+    fused = fused_abc_run(st, "rastrigin", 200, half_width=HW,
+                          rng="host", interpret=True)
+    portable = abc_run(st, rastrigin, 200, half_width=HW)
+    f, p = float(fused.best_fit), float(portable.best_fit)
+    assert f < p * 3.0 + 5.0, (f, p)
+
+
+def test_trial_counters_reset_and_bound():
+    """Trials reset on acceptance and never exceed limit + cycles
+    between scout sweeps; scouts zero them."""
+    st = abc_init(rastrigin, 512, 6, HW, seed=3)
+    out = fused_abc_run(st, "rastrigin", 50, half_width=HW, limit=10,
+                        rng="host", interpret=True)
+    assert out.trials.dtype == jnp.int32
+    assert int(out.trials.min()) >= 0
+    # a source can exceed the limit only within one cycle before the
+    # scout phase catches it (employed +1 then onlooker +1)
+    assert int(out.trials.max()) <= 10 + 2
+
+
+def test_fused_best_monotone_and_deterministic():
+    st = abc_init(rastrigin, 512, 6, HW, seed=3)
+    prev = float(st.best_fit)
+    s = st
+    for _ in range(3):
+        s = fused_abc_run(s, "rastrigin", 10, half_width=HW,
+                          rng="host", interpret=True)
+        cur = float(s.best_fit)
+        assert cur <= prev + 1e-6
+        prev = cur
+    a = fused_abc_run(st, "rastrigin", 25, half_width=HW, rng="host",
+                      interpret=True)
+    b = fused_abc_run(st, "rastrigin", 25, half_width=HW, rng="host",
+                      interpret=True)
+    np.testing.assert_array_equal(np.asarray(a.pos), np.asarray(b.pos))
+
+
+def test_fused_pads_non_aligned_population():
+    st = abc_init(sphere, 700, 5, HW, seed=2)   # 700 not lane-aligned
+    out = fused_abc_run(st, "sphere", 40, half_width=HW, rng="host",
+                        interpret=True)
+    assert out.pos.shape == (700, 5)
+    assert out.trials.shape == (700,)
+    assert float(out.best_fit) <= float(st.best_fit) + 1e-6
+
+
+def test_tiny_population_rejected():
+    st = abc_init(sphere, 64, 5, HW, seed=2)    # < 4 tiles of 128
+    with pytest.raises(ValueError, match="rotational"):
+        fused_abc_run(st, "sphere", 5, half_width=HW, rng="host",
+                      interpret=True)
+
+
+def test_abc_model_backend_switch():
+    assert abc_pallas_supported("rastrigin", jnp.float32)
+    assert not abc_pallas_supported("rastrigin", jnp.bfloat16)
+    opt = ABC("sphere", n=1024, dim=4, seed=0, use_pallas=True)
+    opt.run(60)
+    assert opt.best < 1e-3
+    with pytest.raises(ValueError):
+        ABC("sphere", n=64, dim=4, seed=0, use_pallas=True)   # tiny pop
+    with pytest.raises(ValueError):
+        ABC(sphere, n=1024, dim=4, seed=0, use_pallas=True)   # callable

@@ -39,6 +39,7 @@ BENCHES = [
     "bench_de_1m.py",
     "bench_ga_1m.py",
     "bench_abc_1m.py",
+    "bench_pt_1m.py",
     "bench_shade_1m.py",
     "bench_woa_1m.py",
     "bench_cuckoo_1m.py",
@@ -59,6 +60,7 @@ QUICK_SKIP = {
     "bench_de_1m.py",
     "bench_ga_1m.py",
     "bench_abc_1m.py",
+    "bench_pt_1m.py",
     "bench_shade_1m.py",
     "bench_woa_1m.py",
     "bench_cuckoo_1m.py",

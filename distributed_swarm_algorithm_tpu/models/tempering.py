@@ -8,6 +8,8 @@ import jax
 
 from ..ops import tempering as _k
 from ..ops.objectives import get_objective
+from ..ops.pallas import tempering_fused as _tf
+from ..utils.platform import on_tpu as _on_tpu
 from ._checkpoint import CheckpointMixin
 
 
@@ -15,6 +17,13 @@ class ParallelTempering(CheckpointMixin):
     """Parallel tempering (replica exchange): ``n`` Metropolis chains on
     a geometric temperature ladder, exchanging replicas with the
     detailed-balance probability every ``swap_every`` steps.
+
+    Two compute paths with the same PTState contract: portable jit'd
+    JAX (global XOR-parity exchange — 40.9M chain-steps/s at 1M on
+    v5e) and the fused Pallas kernel (ops/pallas/tempering_fused.py:
+    on-chip Box-Muller proposals, adjacent-lane exchange) —
+    auto-selected on TPU for named objectives in float32 with
+    n >= 128, or forced with ``use_pallas=True``.
 
     >>> opt = ParallelTempering("rastrigin", n=32, dim=6, seed=0)
     >>> opt.run(2000)
@@ -33,11 +42,14 @@ class ParallelTempering(CheckpointMixin):
         swap_every: int = _k.SWAP_EVERY,
         seed: int = 0,
         dtype=None,
+        use_pallas: Optional[bool] = None,
     ):
         if isinstance(objective, str):
             fn, default_hw = get_objective(objective)
+            self.objective_name: Optional[str] = objective
         else:
             fn, default_hw = objective, 5.12
+            self.objective_name = None
         self.objective = fn
         self.half_width = float(
             half_width if half_width is not None else default_hw
@@ -56,6 +68,23 @@ class ParallelTempering(CheckpointMixin):
             t_max=float(t_max), seed=seed, **kwargs
         )
 
+        supported = (
+            n >= 128            # one full lane tile
+            and self.objective_name is not None
+            and _tf.pt_pallas_supported(
+                self.objective_name or "", self.state.pos.dtype
+            )
+        )
+        if use_pallas is None:
+            self.use_pallas = supported and _on_tpu()
+        elif use_pallas and not supported:
+            raise ValueError(
+                "use_pallas=True needs a named objective from "
+                "ops.objectives, float32 state, and n >= 128"
+            )
+        else:
+            self.use_pallas = bool(use_pallas)
+
     def step(self) -> _k.PTState:
         self.state = _k.pt_step(
             self.state, self.objective, self.half_width, self.sigma0,
@@ -64,10 +93,19 @@ class ParallelTempering(CheckpointMixin):
         return self.state
 
     def run(self, n_steps: int) -> _k.PTState:
-        self.state = _k.pt_run(
-            self.state, self.objective, n_steps, self.half_width,
-            self.sigma0, self.swap_every,
-        )
+        if self.use_pallas:
+            on_tpu = _on_tpu()
+            self.state = _tf.fused_pt_run(
+                self.state, self.objective_name, n_steps,
+                self.half_width, self.sigma0, self.swap_every,
+                rng="tpu" if on_tpu else "host",
+                interpret=not on_tpu,
+            )
+        else:
+            self.state = _k.pt_run(
+                self.state, self.objective, n_steps, self.half_width,
+                self.sigma0, self.swap_every,
+            )
         jax.block_until_ready(self.state.best_fit)
         return self.state
 

@@ -1,0 +1,356 @@
+"""Fused parallel-tempering (replica-exchange) as a Pallas TPU kernel.
+
+Thirteenth fused family.  Portable PT (ops/tempering.py) measures
+40.9M chain-steps/s at 1M on v5e — the Metropolis pass is elementwise
+(XLA handles it) but every step round-trips HBM and burns threefry for
+~N*D normals, and the exchange round's partner gather adds a
+[C, D] shuffle.  The fused kernel:
+
+  - draws proposal normals from the on-chip PRNG via the shared
+    Box-Muller chain (cuckoo_fused._normal_pair — fast-math log2/cos);
+  - evaluates accept probabilities with the fast ``2^x`` polynomial
+    (``exp(d) = 2^(d*log2 e)``);
+  - runs k Metropolis+exchange rounds per HBM round-trip;
+  - realizes the XOR-parity replica exchange as *adjacent-lane rolls*:
+    pairs are (i, i^1) shifted by the round parity, so the partner's
+    state/energy/inverse-temperature arrive via one static lane roll
+    in each direction, the pair-shared uniform comes from the lower
+    lane, and the swap is a masked where — no gather, no conflict.
+
+Documented delta from ops/tempering.py: pairing is TILE-local — at
+odd parity the first and last lanes of each 4096-lane tile sit out
+(the portable path only benches chains 0 and C-1).  The ladder is laid
+out contiguously along lanes, so tile-local pairing preserves
+temperature adjacency everywhere except those boundaries; with the
+geometric ladder spanning the tile this costs two idle chains per
+tile per odd round.  Exchange *semantics* (detailed-balance
+probability, lower-lane shared uniform, parity alternation per
+``swap_every`` cadence) match the portable path exactly.
+
+Same chassis as the siblings: lane-major [D, N], k steps per HBM
+round-trip, host-RNG interpret variant with a byte-identical body for
+CPU testing (tests/test_pallas_tempering.py).
+
+Capability lineage: the reference has no optimizer; its only fitness
+logic is the task utility at /root/reference/agent.py:338-347.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..tempering import SIGMA0, SWAP_EVERY, PTState
+from .common import ceil_to as _ceil_to, cyclic_pad_rows as _cyclic_pad_rows
+from .cuckoo_fused import _normal_pair
+from .firefly_fused import _exp_fast
+from .pso_fused import (
+    OBJECTIVES_T,
+    _auto_tile,
+    _uniform_bits,
+    run_blocks,
+    seed_base,
+)
+
+# Unlike the elitist siblings, best-so-far here is recorded PER STEP
+# inside the kernel (running per-lane best + cross-tile accumulator
+# outputs) — Metropolis chains are non-elitist, so a block-end sample
+# would silently miss optima visited and then hopped away from.
+
+
+def pt_pallas_supported(objective_name, dtype) -> bool:
+    return objective_name in OBJECTIVES_T and jnp.dtype(dtype) == jnp.float32
+
+
+def _make_kernel(objective_t, half_width, swap_every, host_rng,
+                 k_steps, tile_n, n_real):
+    def body(scalar_ref, pos_ref, fit_ref, sig_ref, beta_ref,
+             r_n, r_acc, r_swap, pos_o, fit_o, tfit_o, tpos_o):
+        pos, fit = pos_ref[:], fit_ref[:]
+        sigma = sig_ref[:]                       # [1, T] proposal scales
+        beta = beta_ref[:]                       # [1, T] 1/temperature
+        it0 = scalar_ref[1]
+        col = jax.lax.broadcasted_iota(jnp.int32, fit.shape, 1)
+        # Global chain index: masks padded phantom chains out of the
+        # exchange (a cyclic duplicate carries the COLD end's
+        # temperature next to the real hot end — swapping with it
+        # would graft a ladder topology the portable path never has).
+        gcol = pl.program_id(0) * tile_n + col
+        # PT is non-elitist (Metropolis chains hop away from optima),
+        # so unlike the elitist siblings the per-block END state is
+        # not a sufficient best record: track the running per-lane
+        # best across the k steps in VMEM.
+        rb_fit, rb_pos = fit, pos
+
+        for step in range(k_steps):
+            # --- Metropolis move --------------------------------------
+            if host_rng:
+                noise, u_acc, u_swap = r_n, r_acc, r_swap
+            else:
+                noise, _ = _normal_pair(pos.shape)
+                u_acc = _uniform_bits(fit.shape)
+                u_swap = _uniform_bits(fit.shape)
+            cand = jnp.clip(
+                pos + sigma * noise, -half_width, half_width
+            )
+            cand_fit = objective_t(cand)
+            # accept prob exp(-(df)*beta), clamped at 1
+            d = (fit - cand_fit) * beta
+            acc = u_acc < _exp_fast(jnp.minimum(d, 0.0))
+            pos = jnp.where(acc, cand, pos)
+            fit = jnp.where(acc, cand_fit, fit)
+            visited_better = fit < rb_fit
+            rb_fit = jnp.where(visited_better, fit, rb_fit)
+            rb_pos = jnp.where(visited_better, pos, rb_pos)
+
+            # --- replica exchange (every swap_every steps) ------------
+            it = it0 + (step + 1)
+            do_round = (it % swap_every) == 0
+            parity = (it // swap_every) % 2
+            is_lower = ((col - parity) % 2) == 0
+            partner_g = jnp.where(is_lower, gcol + 1, gcol - 1)
+            valid = (
+                jnp.logical_or(
+                    parity == 0,
+                    (col >= 1) & (col <= tile_n - 2),
+                )
+                & (gcol < n_real) & (partner_g < n_real)
+                & (partner_g >= 0)
+            )
+            # partner values via static adjacent-lane rolls
+            right_pos = pltpu.roll(pos, tile_n - 1, 1)   # lane i <- i+1
+            left_pos = pltpu.roll(pos, 1, 1)             # lane i <- i-1
+            right_fit = pltpu.roll(fit, tile_n - 1, 1)
+            left_fit = pltpu.roll(fit, 1, 1)
+            right_beta = pltpu.roll(beta, tile_n - 1, 1)
+            left_beta = pltpu.roll(beta, 1, 1)
+            left_u = pltpu.roll(u_swap, 1, 1)
+            p_fit = jnp.where(is_lower, right_fit, left_fit)
+            p_beta = jnp.where(is_lower, right_beta, left_beta)
+            u_pair = jnp.where(is_lower, u_swap, left_u)
+            delta = (beta - p_beta) * (fit - p_fit)
+            do_swap = (
+                do_round & valid
+                & (u_pair < _exp_fast(jnp.minimum(delta, 0.0)))
+            )
+            pos = jnp.where(
+                do_swap, jnp.where(is_lower, right_pos, left_pos), pos
+            )
+            fit = jnp.where(do_swap, p_fit, fit)
+
+        pos_o[:] = pos
+        fit_o[:] = fit
+
+        # Cross-tile running-best accumulator over the VISITED states
+        # (pso_fused track_best pattern: revisited fixed output blocks
+        # persist across the sequential grid).
+        tile_fit = jnp.min(rb_fit)
+        kbest = jnp.argmin(rb_fit[0, :])
+        cand_col = jnp.sum(
+            jnp.where(col == kbest, rb_pos, 0.0), axis=1, keepdims=True
+        )
+        first = pl.program_id(0) == 0
+
+        @pl.when(first)
+        def _():
+            tfit_o[0, 0] = tile_fit
+            tpos_o[:] = cand_col
+
+        @pl.when(jnp.logical_not(first) & (tile_fit < tfit_o[0, 0]))
+        def _():
+            tfit_o[0, 0] = tile_fit
+            tpos_o[:] = cand_col
+
+    if host_rng:
+        def kernel(scalar_ref, pos_ref, fit_ref, sig_ref, beta_ref,
+                   rn, ra, rs, *outs):
+            body(scalar_ref, pos_ref, fit_ref, sig_ref, beta_ref,
+                 rn[:], ra[:], rs[:], *outs)
+    else:
+        def kernel(scalar_ref, pos_ref, fit_ref, sig_ref, beta_ref,
+                   *outs):
+            pltpu.prng_seed(scalar_ref[0] + pl.program_id(0))
+            body(scalar_ref, pos_ref, fit_ref, sig_ref, beta_ref,
+                 None, None, None, *outs)
+
+    return kernel
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "half_width", "swap_every",
+        "tile_n", "n_real", "rng", "interpret", "k_steps",
+    ),
+)
+def fused_pt_step_t(
+    scalars: jax.Array,       # [2] i32: seed, iteration-before-block
+    pos: jax.Array,           # [D, N]
+    fit: jax.Array,           # [1, N]
+    sigma: jax.Array,         # [1, N] per-chain proposal scales
+    beta: jax.Array,          # [1, N] per-chain 1/temperature
+    r_n: jax.Array | None = None,     # [D, N] proposal normals (host)
+    r_acc: jax.Array | None = None,   # [1, N] accept uniforms
+    r_swap: jax.Array | None = None,  # [1, N] swap uniforms
+    *,
+    objective_name: str,
+    half_width: float = 5.12,
+    swap_every: int = SWAP_EVERY,
+    tile_n: int = 4096,
+    n_real: int | None = None,
+    rng: str = "tpu",
+    interpret: bool = False,
+    k_steps: int = 1,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """``k_steps`` fused PT rounds; returns ``(pos, fit, best_fit[1,1],
+    best_pos[D,1])`` where best_* is the best state *visited* anywhere
+    during the block (per-step record — PT chains are non-elitist, so
+    block-end state alone would under-report).  ``n_real`` is the
+    unpadded ladder length; padded phantom chains never exchange."""
+    d, n = pos.shape
+    if n % tile_n:
+        raise ValueError(f"N ({n}) must be a multiple of tile_n ({tile_n})")
+    if n_real is None:
+        n_real = n
+    n_tiles = n // tile_n
+    host_rng = rng == "host"
+    if host_rng and any(x is None for x in (r_n, r_acc, r_swap)):
+        raise ValueError('rng="host" requires r_n, r_acc, r_swap')
+    if host_rng and k_steps != 1:
+        raise ValueError('rng="host" supports k_steps=1 only')
+
+    kernel = _make_kernel(
+        OBJECTIVES_T[objective_name], half_width, swap_every,
+        host_rng, k_steps, tile_n, n_real,
+    )
+
+    col = lambda i, s: (0, i)                                # noqa: E731
+    fixed = lambda i, s: (0, 0)                              # noqa: E731
+    dn = pl.BlockSpec((d, tile_n), col, memory_space=pltpu.VMEM)
+    ft = pl.BlockSpec((1, tile_n), col, memory_space=pltpu.VMEM)
+
+    in_specs = [dn, ft, ft, ft]
+    operands = [pos, fit, sigma, beta]
+    if host_rng:
+        in_specs += [dn, ft, ft]
+        operands += [r_n, r_acc, r_swap]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=in_specs,
+        out_specs=[
+            dn, ft,
+            pl.BlockSpec((1, 1), fixed, memory_space=pltpu.SMEM),
+            pl.BlockSpec((d, 1), fixed, memory_space=pltpu.VMEM),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((d, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((d, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(scalars.astype(jnp.int32), *operands)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "n_steps", "half_width", "sigma0",
+        "swap_every", "tile_n", "rng", "interpret", "steps_per_kernel",
+    ),
+)
+def fused_pt_run(
+    state: PTState,
+    objective_name: str,
+    n_steps: int,
+    half_width: float = 5.12,
+    sigma0: float = SIGMA0,
+    swap_every: int = SWAP_EVERY,
+    tile_n: int | None = None,
+    rng: str = "tpu",
+    interpret: bool = False,
+    steps_per_kernel: int = 16,
+) -> PTState:
+    """``n_steps`` fused PT rounds — PTState in/out, drop-in fast path
+    for ``ops.tempering.pt_run`` with the module docstring's tile-local
+    exchange delta.  The temperature ladder (``state.temps``) is laid
+    out along lanes exactly as the portable path orders it."""
+    n, d = state.pos.shape
+    if rng == "host":
+        steps_per_kernel = 1
+    # One objective eval + light temporaries per step: VMEM class of
+    # the PSO kernel; spk 16 measured safe at tile 4096.
+    steps_per_kernel = min(steps_per_kernel, 16)
+    if tile_n is None:
+        tile_n = _auto_tile(_ceil_to(max(d, 8), 8))
+    tile_n = min(tile_n, _ceil_to(n, 128))
+    n_pad = _ceil_to(n, tile_n)
+    n_tiles = n_pad // tile_n
+
+    pos_t = _cyclic_pad_rows(state.pos, n_pad).T
+    fit_t = _cyclic_pad_rows(state.fit, n_pad)[None, :]
+    temps_t = _cyclic_pad_rows(state.temps, n_pad)[None, :]
+    sigma_t = sigma0 * half_width * jnp.sqrt(temps_t)
+    beta_t = 1.0 / temps_t
+    seed0 = seed_base(state.key)
+    host_key = jax.random.fold_in(state.key, 0x9E)
+    it0 = state.iteration.astype(jnp.int32)
+
+    def block(carry, call_i, k):
+        pos_t, fit_t, best_pos, best_fit, it = carry
+        scalars = jnp.stack(
+            [seed0 + call_i * n_tiles, it]
+        ).astype(jnp.int32)
+        rn = ra = rs = None
+        if rng == "host":
+            import jax.random as jr
+
+            kk = jr.fold_in(host_key, call_i)
+            k1, k2, k3 = jr.split(kk, 3)
+            rn = jr.normal(k1, pos_t.shape, jnp.float32)
+            ra = jr.uniform(k2, fit_t.shape, jnp.float32)
+            rs = jr.uniform(k3, fit_t.shape, jnp.float32)
+        pos_t, fit_t, blk_fit, blk_pos = fused_pt_step_t(
+            scalars, pos_t, fit_t, sigma_t, beta_t, rn, ra, rs,
+            objective_name=objective_name, half_width=half_width,
+            swap_every=swap_every, tile_n=tile_n, n_real=n,
+            rng=rng, interpret=interpret, k_steps=k,
+        )
+        cand_fit, cand_pos = blk_fit[0, 0], blk_pos[:, 0]
+        improved = cand_fit < best_fit
+        best_fit = jnp.where(improved, cand_fit, best_fit)
+        best_pos = jnp.where(improved, cand_pos, best_pos)
+        return (pos_t, fit_t, best_pos, best_fit, it + k)
+
+    carry = run_blocks(
+        block,
+        (
+            pos_t, fit_t,
+            state.best_pos.astype(jnp.float32),
+            state.best_fit.astype(jnp.float32),
+            it0,
+        ),
+        n_steps, steps_per_kernel,
+    )
+    pos_t, fit_t, best_pos, best_fit, _ = carry
+    dt = state.pos.dtype
+    return PTState(
+        pos=pos_t.T[:n].astype(dt),
+        fit=fit_t[0, :n].astype(state.fit.dtype),
+        temps=state.temps,
+        best_pos=best_pos.astype(state.best_pos.dtype),
+        best_fit=best_fit.astype(state.best_fit.dtype),
+        key=jax.random.fold_in(state.key, n_steps),
+        iteration=state.iteration + n_steps,
+    )

@@ -1,0 +1,101 @@
+"""Fused Pallas parallel-tempering kernel
+(ops/pallas/tempering_fused.py): Metropolis semantics, tile-local
+exchange contract, padding/convergence, and the model-level backend
+switch.  Runs the real kernel body on CPU via ``interpret=True`` with
+host RNG, like the siblings."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_swarm_algorithm_tpu.models.tempering import (
+    ParallelTempering,
+)
+from distributed_swarm_algorithm_tpu.ops.objectives import (
+    rastrigin,
+    sphere,
+)
+from distributed_swarm_algorithm_tpu.ops.pallas.tempering_fused import (
+    fused_pt_run,
+    pt_pallas_supported,
+)
+from distributed_swarm_algorithm_tpu.ops.tempering import pt_init, pt_run
+
+HW = 5.12
+
+
+def test_fused_run_converges_sphere():
+    st = pt_init(sphere, 1000, 6, HW, seed=0)
+    out = fused_pt_run(st, "sphere", 300, half_width=HW, rng="host",
+                       interpret=True)
+    assert out.pos.shape == (1000, 6)
+    assert int(out.iteration) == 300
+    assert float(out.best_fit) < 0.05
+    assert bool((jnp.abs(out.pos) <= HW + 1e-5).all())
+    assert float(out.best_fit) <= float(out.fit.min()) + 1e-6
+    # ladder untouched
+    np.testing.assert_array_equal(
+        np.asarray(out.temps), np.asarray(st.temps)
+    )
+
+
+def test_fused_matches_portable_regime_on_rastrigin():
+    """Tile-local exchange + on-chip RNG must stay in the portable
+    path's optimization regime (not bit-equal — different RNG and
+    boundary pairing)."""
+    st = pt_init(rastrigin, 2048, 8, HW, seed=1)
+    fused = fused_pt_run(st, "rastrigin", 300, half_width=HW,
+                         rng="host", interpret=True)
+    portable = pt_run(st, rastrigin, 300, half_width=HW)
+    f, p = float(fused.best_fit), float(portable.best_fit)
+    assert f < p * 3.0 + 5.0, (f, p)
+
+
+def test_exchange_cadence_respected():
+    """With swap_every > n_steps no exchange fires: cold chains only
+    do Metropolis, and the iteration counter threads through blocks."""
+    st = pt_init(sphere, 512, 4, HW, seed=2)
+    out = fused_pt_run(st, "sphere", 7, half_width=HW, swap_every=100,
+                       rng="host", interpret=True)
+    assert int(out.iteration) == 7
+
+
+def test_fused_best_monotone_and_deterministic():
+    st = pt_init(rastrigin, 512, 6, HW, seed=3)
+    prev = float(st.best_fit)
+    s = st
+    for _ in range(3):
+        s = fused_pt_run(s, "rastrigin", 10, half_width=HW,
+                         rng="host", interpret=True)
+        cur = float(s.best_fit)
+        assert cur <= prev + 1e-6
+        prev = cur
+    a = fused_pt_run(st, "rastrigin", 25, half_width=HW, rng="host",
+                     interpret=True)
+    b = fused_pt_run(st, "rastrigin", 25, half_width=HW, rng="host",
+                     interpret=True)
+    np.testing.assert_array_equal(np.asarray(a.pos), np.asarray(b.pos))
+
+
+def test_fused_pads_non_aligned_population():
+    st = pt_init(sphere, 700, 5, HW, seed=2)   # 700 not lane-aligned
+    out = fused_pt_run(st, "sphere", 40, half_width=HW, rng="host",
+                       interpret=True)
+    assert out.pos.shape == (700, 5)
+    assert float(out.best_fit) <= float(st.best_fit) + 1e-6
+
+
+def test_pt_model_backend_switch():
+    assert pt_pallas_supported("rastrigin", jnp.float32)
+    assert not pt_pallas_supported("rastrigin", jnp.bfloat16)
+    opt = ParallelTempering(
+        "sphere", n=1024, dim=4, seed=0, use_pallas=True
+    )
+    opt.run(200)
+    assert opt.best < 0.1
+    with pytest.raises(ValueError):
+        ParallelTempering("sphere", n=64, dim=4, seed=0,
+                          use_pallas=True)          # tiny ladder
+    with pytest.raises(ValueError):
+        ParallelTempering(sphere, n=1024, dim=4, seed=0,
+                          use_pallas=True)          # callable

@@ -1,0 +1,41 @@
+"""Fused salp at 1M salps (fourteenth fused family).
+
+Portable salp is the healthiest portable profile in the zoo (the chain
+is one shifted add, no gathers) and still only measures 218M
+salp-steps/s at 1M — per-generation HBM round-trips.  The fused kernel
+(ops/pallas/salp_fused.py) holds the chain in VMEM for k generations
+per HBM pass.
+"""
+
+from __future__ import annotations
+
+from common import REFERENCE_AGENT_STEPS_PER_SEC, report, timeit_best
+
+from distributed_swarm_algorithm_tpu.models.salp import Salp
+
+N = 1_048_576
+DIM = 30
+STEPS = 512
+
+
+def main() -> None:
+    opt = Salp("rastrigin", n=N, dim=DIM, t_max=STEPS, seed=0)
+    float(opt.state.best_fit)
+    opt.run(STEPS)
+    float(opt.state.best_fit)
+    best = timeit_best(
+        lambda: opt.run(STEPS), lambda: float(opt.state.best_fit),
+        reps=3,
+    )
+    path = "pallas-fused" if opt.use_pallas else "xla-jit"
+    report(
+        f"agent-steps/sec, salp Rastrigin-30D, {N} salps, "
+        f"1 chip ({path})",
+        N * STEPS / best,
+        "agent-steps/sec",
+        REFERENCE_AGENT_STEPS_PER_SEC,
+    )
+
+
+if __name__ == "__main__":
+    main()

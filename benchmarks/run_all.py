@@ -40,6 +40,7 @@ BENCHES = [
     "bench_ga_1m.py",
     "bench_abc_1m.py",
     "bench_pt_1m.py",
+    "bench_salp_1m.py",
     "bench_shade_1m.py",
     "bench_woa_1m.py",
     "bench_cuckoo_1m.py",
@@ -61,6 +62,7 @@ QUICK_SKIP = {
     "bench_ga_1m.py",
     "bench_abc_1m.py",
     "bench_pt_1m.py",
+    "bench_salp_1m.py",
     "bench_shade_1m.py",
     "bench_woa_1m.py",
     "bench_cuckoo_1m.py",

@@ -233,6 +233,47 @@ def gate_de_tpu_prng() -> dict:
     }
 
 
+def gate_salp_host_exact() -> dict:
+    from distributed_swarm_algorithm_tpu.ops.objectives import rastrigin
+    from distributed_swarm_algorithm_tpu.ops.pallas.salp_fused import (
+        fused_salp_run,
+    )
+    from distributed_swarm_algorithm_tpu.ops.salp import salp_init
+
+    st = salp_init(rastrigin, 4096, 16, half_width=5.12, seed=7)
+    dev = fused_salp_run(st, "rastrigin", 5, rng="host", interpret=False)
+    jax.block_until_ready(dev.pos)
+    with jax.default_device(_cpu_device()):
+        ref = fused_salp_run(
+            _to_cpu(st), "rastrigin", 5, rng="host", interpret=True
+        )
+    res = _state_parity(dev, ref, ("pos", "fit"))
+    dg = abs(float(dev.best_fit) - float(ref.best_fit))
+    res["gbest_abs_diff"] = round(dg, 8)
+    res["ok"] = res["worst"] >= FRAC_CLOSE_MIN and dg <= 1e-2
+    return res
+
+
+def gate_salp_tpu_prng() -> dict:
+    from distributed_swarm_algorithm_tpu.ops.objectives import rastrigin
+    from distributed_swarm_algorithm_tpu.ops.pallas.salp_fused import (
+        fused_salp_run,
+    )
+    from distributed_swarm_algorithm_tpu.ops.salp import (
+        salp_init,
+        salp_run,
+    )
+
+    st = salp_init(rastrigin, 16384, 30, half_width=5.12, seed=11)
+    fused = fused_salp_run(st, "rastrigin", 256, rng="tpu")
+    portable = salp_run(st, rastrigin, 256)
+    f, p = float(fused.best_fit), float(portable.best_fit)
+    return {
+        "fused_best": round(f, 4), "portable_best": round(p, 4),
+        "ok": _convergence_band(f, p),
+    }
+
+
 def gate_pt_host_exact() -> dict:
     from distributed_swarm_algorithm_tpu.ops.objectives import rastrigin
     from distributed_swarm_algorithm_tpu.ops.pallas.tempering_fused import (
@@ -740,6 +781,7 @@ ALL_GATES = {
     "abc_host_exact": gate_abc_host_exact,
     "ga_host_exact": gate_ga_host_exact,
     "pt_host_exact": gate_pt_host_exact,
+    "salp_host_exact": gate_salp_host_exact,
     "shade_host_exact": gate_shade_host_exact,
     "woa_host_exact": gate_woa_host_exact,
     "cuckoo_host_exact": gate_cuckoo_host_exact,
@@ -754,6 +796,7 @@ ALL_GATES = {
     "abc_tpu_prng": gate_abc_tpu_prng,
     "ga_tpu_prng": gate_ga_tpu_prng,
     "pt_tpu_prng": gate_pt_tpu_prng,
+    "salp_tpu_prng": gate_salp_tpu_prng,
     "shade_tpu_prng": gate_shade_tpu_prng,
     "woa_tpu_prng": gate_woa_tpu_prng,
     "cuckoo_tpu_prng": gate_cuckoo_tpu_prng,

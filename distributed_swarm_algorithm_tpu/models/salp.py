@@ -8,6 +8,8 @@ import jax
 
 from ..ops import salp as _k
 from ..ops.objectives import get_objective
+from ..ops.pallas import salp_fused as _sf
+from ..utils.platform import on_tpu as _on_tpu
 from ._checkpoint import CheckpointMixin
 
 
@@ -17,6 +19,14 @@ class Salp(CheckpointMixin):
     The leader explores around the food source under a decaying
     envelope; followers average down the chain, rippling information
     with a built-in delay.
+
+    Two compute paths with the same SalpState contract: portable
+    jit'd JAX (exact per-step chain + food refresh — 218M
+    salp-steps/s at 1M on v5e) and the fused Pallas kernel
+    (ops/pallas/salp_fused.py: in-VMEM chain, block-cadence
+    cross-tile links/food, per-step best recording) — auto-selected
+    on TPU for named objectives in float32 with n >= 128, or forced
+    with ``use_pallas=True``.
 
     >>> opt = Salp("sphere", n=64, dim=6, seed=0)
     >>> opt.run(300)
@@ -32,11 +42,14 @@ class Salp(CheckpointMixin):
         t_max: int = _k.T_MAX,
         seed: int = 0,
         dtype=None,
+        use_pallas: Optional[bool] = None,
     ):
         if isinstance(objective, str):
             fn, default_hw = get_objective(objective)
+            self.objective_name: Optional[str] = objective
         else:
             fn, default_hw = objective, 5.12
+            self.objective_name = None
         self.objective = fn
         self.half_width = float(
             half_width if half_width is not None else default_hw
@@ -49,6 +62,23 @@ class Salp(CheckpointMixin):
             fn, n, dim, self.half_width, seed=seed, **kwargs
         )
 
+        supported = (
+            n >= 128            # one full lane tile
+            and self.objective_name is not None
+            and _sf.salp_pallas_supported(
+                self.objective_name or "", self.state.pos.dtype
+            )
+        )
+        if use_pallas is None:
+            self.use_pallas = supported and _on_tpu()
+        elif use_pallas and not supported:
+            raise ValueError(
+                "use_pallas=True needs a named objective from "
+                "ops.objectives, float32 state, and n >= 128"
+            )
+        else:
+            self.use_pallas = bool(use_pallas)
+
     def step(self) -> _k.SalpState:
         self.state = _k.salp_step(
             self.state, self.objective, self.half_width, self.t_max
@@ -56,9 +86,19 @@ class Salp(CheckpointMixin):
         return self.state
 
     def run(self, n_steps: int) -> _k.SalpState:
-        self.state = _k.salp_run(
-            self.state, self.objective, n_steps, self.half_width, self.t_max
-        )
+        if self.use_pallas:
+            on_tpu = _on_tpu()
+            self.state = _sf.fused_salp_run(
+                self.state, self.objective_name, n_steps,
+                self.half_width, self.t_max,
+                rng="tpu" if on_tpu else "host",
+                interpret=not on_tpu,
+            )
+        else:
+            self.state = _k.salp_run(
+                self.state, self.objective, n_steps, self.half_width,
+                self.t_max,
+            )
         jax.block_until_ready(self.state.best_fit)
         return self.state
 

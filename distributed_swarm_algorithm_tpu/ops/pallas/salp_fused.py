@@ -1,0 +1,307 @@
+"""Fused salp-swarm generation as a Pallas TPU kernel.
+
+Fourteenth fused family.  Portable salp (ops/salp.py) is the
+*healthiest* portable profile in the zoo — the chain rule
+``x_i <- (x_i + x_{i-1})/2`` is one shifted add, no gathers — and
+still measures only 218M salp-steps/s at 1M: every generation
+round-trips pos/fit through HBM and re-enters the XLA executable.
+The fused kernel holds the chain in VMEM for k generations per HBM
+pass:
+
+  - the follower shift is an adjacent-lane roll; the cross-tile chain
+    link (lane 0 of tile i follows the last salp of tile i-1) comes
+    from a statically-rotated snapshot block, held fixed within a
+    k-step block — the same staleness class as the delayed-gbest PSO
+    (the link refreshes every block);
+  - the leader rule runs only on the global first lane
+    (``pl.when``-free: a masked where on program 0), with the food
+    source F delayed per block like every fused sibling's best;
+  - the c1 envelope ``2*exp(-(4t/T)^2)`` uses the shared fast ``2^x``
+    polynomial and the true global iteration threaded per block;
+  - like the fused PT (the other non-elitist family), the best state
+    is recorded PER STEP in-kernel (running per-lane best + the
+    cross-tile accumulator outputs) — salps move every generation, so
+    a block-end sample would miss optima visited mid-block.
+
+Documented deltas from ops/salp.py: cross-tile chain links and the
+food source refresh at block cadence (exact within a tile); c2/c3
+leader draws come from the on-chip PRNG per tile (only tile 0's lane
+0 consumes them).
+
+Capability lineage: the reference has no optimizer; its only fitness
+logic is the task utility at /root/reference/agent.py:338-347.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..salp import T_MAX, SalpState
+from .common import ceil_to as _ceil_to, cyclic_pad_rows as _cyclic_pad_rows
+from .firefly_fused import _exp_fast
+from .pso_fused import (
+    OBJECTIVES_T,
+    _auto_tile,
+    _uniform_bits,
+    host_uniforms,
+    run_blocks,
+    seed_base,
+)
+
+
+def salp_pallas_supported(objective_name, dtype) -> bool:
+    return objective_name in OBJECTIVES_T and jnp.dtype(dtype) == jnp.float32
+
+
+def _make_kernel(objective_t, half_width, t_max, host_rng, k_steps,
+                 tile_n):
+    lb, ub = -half_width, half_width
+
+    def body(scalar_ref, food_ref, pos_ref, fit_ref, prev_ref,
+             r2, r3, pos_o, fit_o, tfit_o, tpos_o):
+        pos, fit = pos_ref[:], fit_ref[:]
+        food = food_ref[:][:, 0:1]               # [D, 1]
+        # Last salp of the PREVIOUS tile (block-start snapshot): the
+        # cross-tile chain link, fixed within the block.
+        prev_last = prev_ref[:][:, tile_n - 1:tile_n]    # [D, 1]
+        it0 = scalar_ref[1]
+        col = jax.lax.broadcasted_iota(jnp.int32, fit.shape, 1)
+        first_tile = pl.program_id(0) == 0
+        rb_fit, rb_pos = fit, pos
+
+        for step in range(k_steps):
+            t = (it0 + step + 1).astype(jnp.float32)
+            # [1, 1]-shaped: the fast-exp bit twiddling needs >= 2D
+            c1 = 2.0 * _exp_fast(
+                jnp.full((1, 1), -1.0, jnp.float32)
+                * ((4.0 * t / t_max) ** 2)
+            )
+            if host_rng:
+                u2, u3 = r2, r3
+            else:
+                # Only column 0 of tile 0 is consumed (leader draws):
+                # a 128-lane draw is 1/32 the PRNG work of a full
+                # tile.  Measured effect is inside the tunnel jitter
+                # (narrow 1.44-1.49B vs full-tile 1.36-1.66B
+                # salp-steps/s over 5 runs), so prefer the smaller op.
+                u2 = _uniform_bits((pos.shape[0], 128))
+                u3 = _uniform_bits((pos.shape[0], 128))
+            c2 = u2[:, 0:1]                      # [D, 1] leader draws
+            c3 = u3[:, 0:1]
+            sign = jnp.where(c3 >= 0.5, 1.0, -1.0)
+            leader = food + sign * c1 * ((ub - lb) * c2 + lb)
+
+            prev = pltpu.roll(pos, 1, 1)         # lane i <- i-1
+            # lane 0's predecessor: the cross-tile snapshot link
+            prev = jnp.where(col == 0, prev_last, prev)
+            followers = 0.5 * (pos + prev)
+            # global salp 0 IS the leader (replace, not average)
+            is_leader = first_tile & (col == 0)
+            pos = jnp.where(is_leader, leader, followers)
+            pos = jnp.clip(pos, lb, ub)
+            fit = objective_t(pos)
+            visited_better = fit < rb_fit
+            rb_fit = jnp.where(visited_better, fit, rb_fit)
+            rb_pos = jnp.where(visited_better, pos, rb_pos)
+
+        pos_o[:] = pos
+        fit_o[:] = fit
+
+        tile_fit = jnp.min(rb_fit)
+        kbest = jnp.argmin(rb_fit[0, :])
+        cand_col = jnp.sum(
+            jnp.where(col == kbest, rb_pos, 0.0), axis=1, keepdims=True
+        )
+
+        @pl.when(first_tile)
+        def _():
+            tfit_o[0, 0] = tile_fit
+            tpos_o[:] = cand_col
+
+        @pl.when(jnp.logical_not(first_tile) & (tile_fit < tfit_o[0, 0]))
+        def _():
+            tfit_o[0, 0] = tile_fit
+            tpos_o[:] = cand_col
+
+    if host_rng:
+        def kernel(scalar_ref, food_ref, pos_ref, fit_ref, prev_ref,
+                   r2_ref, r3_ref, *outs):
+            body(scalar_ref, food_ref, pos_ref, fit_ref, prev_ref,
+                 r2_ref[:], r3_ref[:], *outs)
+    else:
+        def kernel(scalar_ref, food_ref, pos_ref, fit_ref, prev_ref,
+                   *outs):
+            pltpu.prng_seed(scalar_ref[0] + pl.program_id(0))
+            body(scalar_ref, food_ref, pos_ref, fit_ref, prev_ref,
+                 None, None, *outs)
+
+    return kernel
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "half_width", "t_max", "tile_n", "rng",
+        "interpret", "k_steps",
+    ),
+)
+def fused_salp_step_t(
+    scalars: jax.Array,       # [2] i32: seed, iteration-before-block
+    food_pos: jax.Array,      # [D, 1]
+    pos: jax.Array,           # [D, N]
+    fit: jax.Array,           # [1, N]
+    r2: jax.Array | None = None,   # [D, N] leader uniforms (host rng)
+    r3: jax.Array | None = None,
+    *,
+    objective_name: str,
+    half_width: float = 5.12,
+    t_max: int = T_MAX,
+    tile_n: int = 4096,
+    rng: str = "tpu",
+    interpret: bool = False,
+    k_steps: int = 1,
+) -> Tuple[jax.Array, ...]:
+    """``k_steps`` fused salp generations; returns ``(pos, fit,
+    best_fit[1,1], best_pos[D,1])`` with per-step best recording."""
+    d, n = pos.shape
+    if n % tile_n:
+        raise ValueError(f"N ({n}) must be a multiple of tile_n ({tile_n})")
+    n_tiles = n // tile_n
+    host_rng = rng == "host"
+    if host_rng and (r2 is None or r3 is None):
+        raise ValueError('rng="host" requires r2 and r3')
+    if host_rng and k_steps != 1:
+        raise ValueError('rng="host" supports k_steps=1 only')
+
+    kernel = _make_kernel(
+        OBJECTIVES_T[objective_name], half_width, float(t_max),
+        host_rng, k_steps, tile_n,
+    )
+
+    col = lambda i, s: (0, i)                                # noqa: E731
+    fixed = lambda i, s: (0, 0)                              # noqa: E731
+    prev_map = lambda i, s: (                                # noqa: E731
+        0, jax.lax.rem(i + n_tiles - 1, n_tiles)
+    )
+    dn = pl.BlockSpec((d, tile_n), col, memory_space=pltpu.VMEM)
+    ft = pl.BlockSpec((1, tile_n), col, memory_space=pltpu.VMEM)
+    dn_prev = pl.BlockSpec((d, tile_n), prev_map, memory_space=pltpu.VMEM)
+
+    f128 = jnp.broadcast_to(food_pos, (d, 128))
+    in_specs = [
+        pl.BlockSpec((d, 128), fixed, memory_space=pltpu.VMEM),
+        dn, ft, dn_prev,
+    ]
+    operands = [f128, pos, fit, pos]
+    if host_rng:
+        in_specs += [dn, dn]
+        operands += [r2, r3]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=in_specs,
+        out_specs=[
+            dn, ft,
+            pl.BlockSpec((1, 1), fixed, memory_space=pltpu.SMEM),
+            pl.BlockSpec((d, 1), fixed, memory_space=pltpu.VMEM),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((d, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((d, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(scalars.astype(jnp.int32), *operands)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "n_steps", "half_width", "t_max", "tile_n",
+        "rng", "interpret", "steps_per_kernel",
+    ),
+)
+def fused_salp_run(
+    state: SalpState,
+    objective_name: str,
+    n_steps: int,
+    half_width: float = 5.12,
+    t_max: int = T_MAX,
+    tile_n: int | None = None,
+    rng: str = "tpu",
+    interpret: bool = False,
+    steps_per_kernel: int = 16,
+) -> SalpState:
+    """``n_steps`` fused salp generations — SalpState in/out, drop-in
+    fast path for ``ops.salp.salp_run`` with the module docstring's
+    block-cadence chain-link/food deltas."""
+    n, d = state.pos.shape
+    if rng == "host":
+        steps_per_kernel = 1
+    # One objective eval + a roll per step: the lightest kernel in the
+    # zoo; spk 16 measured safe at tile 4096.
+    steps_per_kernel = min(steps_per_kernel, 16)
+    if tile_n is None:
+        tile_n = _auto_tile(_ceil_to(max(d, 8), 8))
+    tile_n = min(tile_n, _ceil_to(n, 128))
+    n_pad = _ceil_to(n, tile_n)
+    n_tiles = n_pad // tile_n
+
+    pos_t = _cyclic_pad_rows(state.pos, n_pad).T
+    fit_t = _cyclic_pad_rows(state.fit, n_pad)[None, :]
+    seed0 = seed_base(state.key)
+    host_key = jax.random.fold_in(state.key, 0x5A1)
+    it0 = state.iteration.astype(jnp.int32)
+
+    def block(carry, call_i, k):
+        pos_t, fit_t, best_pos, best_fit, it = carry
+        scalars = jnp.stack(
+            [seed0 + call_i * n_tiles, it]
+        ).astype(jnp.int32)
+        r2 = r3 = None
+        if rng == "host":
+            r2, r3 = host_uniforms(host_key, call_i, pos_t.shape)
+        pos_t, fit_t, blk_fit, blk_pos = fused_salp_step_t(
+            scalars, best_pos[:, None], pos_t, fit_t, r2, r3,
+            objective_name=objective_name, half_width=half_width,
+            t_max=t_max, tile_n=tile_n, rng=rng, interpret=interpret,
+            k_steps=k,
+        )
+        cand_fit, cand_pos = blk_fit[0, 0], blk_pos[:, 0]
+        improved = cand_fit < best_fit
+        best_fit = jnp.where(improved, cand_fit, best_fit)
+        best_pos = jnp.where(improved, cand_pos, best_pos)
+        return (pos_t, fit_t, best_pos, best_fit, it + k)
+
+    carry = run_blocks(
+        block,
+        (
+            pos_t, fit_t,
+            state.best_pos.astype(jnp.float32),
+            state.best_fit.astype(jnp.float32),
+            it0,
+        ),
+        n_steps, steps_per_kernel,
+    )
+    pos_t, fit_t, best_pos, best_fit, _ = carry
+    dt = state.pos.dtype
+    return SalpState(
+        pos=pos_t.T[:n].astype(dt),
+        fit=fit_t[0, :n].astype(state.fit.dtype),
+        best_pos=best_pos.astype(state.best_pos.dtype),
+        best_fit=best_fit.astype(state.best_fit.dtype),
+        key=jax.random.fold_in(state.key, n_steps),
+        iteration=state.iteration + n_steps,
+    )

@@ -1,0 +1,90 @@
+"""Fused Pallas salp kernel (ops/pallas/salp_fused.py): chain-link
+semantics, leader rule, per-step best recording, and the model-level
+backend switch.  Runs the real kernel body on CPU via
+``interpret=True`` with host RNG, like the siblings."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_swarm_algorithm_tpu.models.salp import Salp
+from distributed_swarm_algorithm_tpu.ops.objectives import (
+    rastrigin,
+    sphere,
+)
+from distributed_swarm_algorithm_tpu.ops.pallas.salp_fused import (
+    fused_salp_run,
+    salp_pallas_supported,
+)
+from distributed_swarm_algorithm_tpu.ops.salp import salp_init, salp_run
+
+HW = 5.12
+
+
+def test_fused_run_converges_sphere():
+    st = salp_init(sphere, 1000, 6, HW, seed=0)
+    out = fused_salp_run(st, "sphere", 400, half_width=HW, rng="host",
+                         interpret=True)
+    assert out.pos.shape == (1000, 6)
+    assert int(out.iteration) == 400
+    assert float(out.best_fit) < 1.0
+    assert bool((jnp.abs(out.pos) <= HW + 1e-5).all())
+    assert float(out.best_fit) <= float(out.fit.min()) + 1e-6
+
+
+def test_fused_matches_portable_regime_on_rastrigin():
+    """Block-cadence chain links + delayed food must stay in the
+    portable path's optimization regime."""
+    st = salp_init(rastrigin, 2048, 8, HW, seed=1)
+    fused = fused_salp_run(st, "rastrigin", 300, half_width=HW,
+                           rng="host", interpret=True)
+    portable = salp_run(st, rastrigin, 300, half_width=HW)
+    f, p = float(fused.best_fit), float(portable.best_fit)
+    assert f < p * 3.0 + 5.0, (f, p)
+
+
+def test_chain_contracts_toward_leader():
+    """Follower averaging is contractive: after a run the chain spread
+    must shrink from the uniform init."""
+    st = salp_init(sphere, 512, 4, HW, seed=2)
+    spread0 = float(jnp.std(st.pos))
+    out = fused_salp_run(st, "sphere", 100, half_width=HW, rng="host",
+                         interpret=True)
+    assert float(jnp.std(out.pos)) < spread0
+
+
+def test_fused_best_monotone_and_deterministic():
+    st = salp_init(rastrigin, 512, 6, HW, seed=3)
+    prev = float(st.best_fit)
+    s = st
+    for _ in range(3):
+        s = fused_salp_run(s, "rastrigin", 10, half_width=HW,
+                           rng="host", interpret=True)
+        cur = float(s.best_fit)
+        assert cur <= prev + 1e-6
+        prev = cur
+    a = fused_salp_run(st, "rastrigin", 25, half_width=HW, rng="host",
+                       interpret=True)
+    b = fused_salp_run(st, "rastrigin", 25, half_width=HW, rng="host",
+                       interpret=True)
+    np.testing.assert_array_equal(np.asarray(a.pos), np.asarray(b.pos))
+
+
+def test_fused_pads_non_aligned_population():
+    st = salp_init(sphere, 700, 5, HW, seed=2)   # 700 not lane-aligned
+    out = fused_salp_run(st, "sphere", 40, half_width=HW, rng="host",
+                         interpret=True)
+    assert out.pos.shape == (700, 5)
+    assert float(out.best_fit) <= float(st.best_fit) + 1e-6
+
+
+def test_salp_model_backend_switch():
+    assert salp_pallas_supported("rastrigin", jnp.float32)
+    assert not salp_pallas_supported("rastrigin", jnp.bfloat16)
+    opt = Salp("sphere", n=1024, dim=4, seed=0, use_pallas=True)
+    opt.run(300)
+    assert opt.best < 1.0
+    with pytest.raises(ValueError):
+        Salp("sphere", n=64, dim=4, seed=0, use_pallas=True)   # tiny
+    with pytest.raises(ValueError):
+        Salp(sphere, n=1024, dim=4, seed=0, use_pallas=True)   # callable

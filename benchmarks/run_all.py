@@ -41,6 +41,7 @@ BENCHES = [
     "bench_abc_1m.py",
     "bench_pt_1m.py",
     "bench_salp_1m.py",
+    "bench_memetic_1m.py",
     "bench_shade_1m.py",
     "bench_woa_1m.py",
     "bench_cuckoo_1m.py",
@@ -63,6 +64,7 @@ QUICK_SKIP = {
     "bench_abc_1m.py",
     "bench_pt_1m.py",
     "bench_salp_1m.py",
+    "bench_memetic_1m.py",
     "bench_shade_1m.py",
     "bench_woa_1m.py",
     "bench_cuckoo_1m.py",

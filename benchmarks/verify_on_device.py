@@ -233,6 +233,28 @@ def gate_de_tpu_prng() -> dict:
     }
 
 
+def gate_memetic_tpu() -> dict:
+    """Fused-composition memetic (fused PSO blocks + transposed-layout
+    grad refinement) vs the portable path — convergence band only (the
+    PSO kernel inside is covered by pso_host_exact; the refinement is
+    plain XLA autodiff)."""
+    from distributed_swarm_algorithm_tpu.ops.memetic import (
+        fused_memetic_run,
+        memetic_run,
+    )
+    from distributed_swarm_algorithm_tpu.ops.objectives import rastrigin
+    from distributed_swarm_algorithm_tpu.ops.pso import pso_init
+
+    st = pso_init(rastrigin, 16384, 30, half_width=5.12, seed=11)
+    fused = fused_memetic_run(st, "rastrigin", rastrigin, 256)
+    portable = memetic_run(st, rastrigin, 256)
+    f, p = float(fused.gbest_fit), float(portable.gbest_fit)
+    return {
+        "fused_best": round(f, 4), "portable_best": round(p, 4),
+        "ok": _convergence_band(f, p),
+    }
+
+
 def gate_salp_host_exact() -> dict:
     from distributed_swarm_algorithm_tpu.ops.objectives import rastrigin
     from distributed_swarm_algorithm_tpu.ops.pallas.salp_fused import (
@@ -797,6 +819,7 @@ ALL_GATES = {
     "ga_tpu_prng": gate_ga_tpu_prng,
     "pt_tpu_prng": gate_pt_tpu_prng,
     "salp_tpu_prng": gate_salp_tpu_prng,
+    "memetic_tpu": gate_memetic_tpu,
     "shade_tpu_prng": gate_shade_tpu_prng,
     "woa_tpu_prng": gate_woa_tpu_prng,
     "cuckoo_tpu_prng": gate_cuckoo_tpu_prng,

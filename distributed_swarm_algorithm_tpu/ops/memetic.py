@@ -79,6 +79,154 @@ def refine_pbest(
 @partial(
     jax.jit,
     static_argnames=(
+        "objective_name", "objective", "n_steps", "refine_every",
+        "refine_steps", "w", "c1", "c2", "half_width", "vmax_frac",
+        "steps_per_kernel",
+    ),
+)
+def fused_memetic_run(
+    state: PSOState,
+    objective_name: str,
+    objective: Callable,
+    n_steps: int,
+    refine_every: int = 10,
+    refine_steps: int = 5,
+    lr: float = 0.01,
+    w: float = W,
+    c1: float = C1,
+    c2: float = C2,
+    half_width: float = 5.12,
+    vmax_frac: float = 0.5,
+    steps_per_kernel: int = 8,
+) -> PSOState:
+    """Memetic fast path: fused-Pallas PSO blocks + the gradient
+    refinement, composed entirely in the kernel's transposed layout.
+
+    No new kernel — this is COMPOSITION: the global phase runs
+    ``refine_every`` iterations through the fused PSO kernel
+    (ops/pallas/pso_fused.py — gbest topology only), then the
+    ``jax.grad`` refinement sharpens every pbest *in the same
+    lane-major [D, N] layout* (autodiff through the transposed
+    objective registry), so pos/vel/pbest transpose exactly once per
+    run — a first draft that round-tripped layouts per chunk measured
+    only 1.7x portable; this one measures 693M agent-steps/s at 1M
+    Rastrigin-30D vs ~222M portable (**3.1x**; see
+    benchmarks/bench_memetic_1m.py and the docs/PERFORMANCE.md row).
+    ``objective`` (the [N, D] callable) is unused on this path but
+    kept in the signature so callers can pass both interchangeably.
+
+    Refinement cadence matches the portable path exactly: one pass
+    per completed ``refine_every`` iterations (a trailing remainder
+    runs PSO blocks only).  Full chunks run under one ``lax.scan`` so
+    compile time stays O(1) in ``n_steps``.  The refinement's
+    acceptance stays greedy/monotone, so the composition inherits the
+    portable path's pbest/gbest invariants.
+    """
+    from .pallas.pso_fused import (
+        OBJECTIVES_T,
+        _auto_tile,
+        _ceil_to,
+        best_of_block,
+        fused_pso_step_t,
+        prep_padded_t,
+        rebuild_state,
+        run_blocks,
+        seed_base,
+    )
+
+    if refine_every < 1:
+        raise ValueError(
+            f"refine_every must be >= 1, got {refine_every} "
+            "(use fused_pso_run for no refinement)"
+        )
+    del objective  # the transposed registry drives both phases
+
+    n, d = state.pos.shape
+    objective_t = OBJECTIVES_T[objective_name]
+    tile_n = min(_auto_tile(_ceil_to(max(d, 8), 8)), _ceil_to(n, 128))
+    n_pad = _ceil_to(n, tile_n)
+    n_tiles = n_pad // tile_n
+
+    pos_t, vel_t, bpos_t, bfit_t = prep_padded_t(state, n_pad)
+    seed0 = seed_base(state.key)
+
+    def refine_t(bpos_t, bfit_t):
+        # gd_refine is layout-agnostic (grad-of-sum + clip are
+        # shape-blind), so the transposed path reuses it verbatim
+        # with the transposed objective.
+        cand = gd_refine(
+            bpos_t, objective_t, refine_steps, lr, half_width
+        )
+        cand_fit = objective_t(cand)               # [1, N]
+        better = cand_fit < bfit_t
+        return (
+            jnp.where(better, cand, bpos_t),
+            jnp.where(better, cand_fit, bfit_t),
+        )
+
+    def pso_block(carry, call_i, k):
+        pos_t, vel_t, bpos_t, bfit_t, gpos, gfit = carry
+        pos_t, vel_t, bpos_t, bfit_t = fused_pso_step_t(
+            seed0 + call_i * n_tiles, gpos[:, None], pos_t, vel_t,
+            bpos_t, bfit_t,
+            objective_name=objective_name, w=w, c1=c1, c2=c2,
+            half_width=half_width, vmax_frac=vmax_frac, tile_n=tile_n,
+            k_steps=k, track_best=False,
+        )
+        cand_fit, cand_pos = best_of_block(bfit_t, bpos_t)
+        better = cand_fit < gfit
+        gfit = jnp.where(better, cand_fit, gfit)
+        gpos = jnp.where(better, cand_pos, gpos)
+        return (pos_t, vel_t, bpos_t, bfit_t, gpos, gfit)
+
+    carry = (
+        pos_t, vel_t, bpos_t, bfit_t,
+        state.gbest_pos.astype(jnp.float32),
+        state.gbest_fit.astype(jnp.float32),
+    )
+
+    def pso_steps(carry, call0, k):
+        """k PSO iterations in fused blocks; call0 is the traced block
+        counter base (keeps PRNG streams disjoint across chunks)."""
+        return run_blocks(
+            lambda c, i, kk: pso_block(c, call0 + i, kk),
+            carry, k, min(steps_per_kernel, k),
+        )
+
+    def chunk(carry, call0):
+        carry = pso_steps(carry, call0, refine_every)
+        pos_t, vel_t, bpos_t, bfit_t, gpos, gfit = carry
+        bpos_t, bfit_t = refine_t(bpos_t, bfit_t)
+        cand_fit, cand_pos = best_of_block(bfit_t, bpos_t)
+        better = cand_fit < gfit
+        gfit = jnp.where(better, cand_fit, gfit)
+        gpos = jnp.where(better, cand_pos, gpos)
+        return (pos_t, vel_t, bpos_t, bfit_t, gpos, gfit)
+
+    n_chunks, rem = divmod(n_steps, refine_every)
+    blocks_per_chunk = -(-refine_every // max(
+        min(steps_per_kernel, refine_every), 1
+    ))
+    if n_chunks:
+        # One scanned chunk body: compile stays O(1) in n_steps.
+        carry, _ = jax.lax.scan(
+            lambda c, ci: (chunk(c, ci * blocks_per_chunk), None),
+            carry,
+            jnp.arange(n_chunks, dtype=jnp.int32),
+        )
+    if rem:
+        # Trailing partial chunk: PSO only — the portable schedule
+        # refines on refine_every multiples, never after a remainder.
+        carry = pso_steps(
+            carry, jnp.asarray(n_chunks * blocks_per_chunk, jnp.int32),
+            rem,
+        )
+    return rebuild_state(state, *carry, n_steps)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
         "objective", "n_steps", "refine_every", "refine_steps", "w", "c1",
         "c2", "half_width", "vmax_frac", "topology", "ring_radius",
         "grid_cols",

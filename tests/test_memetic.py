@@ -67,9 +67,21 @@ def test_memetic_run_jits_and_counts_iterations():
     assert float(out.gbest_fit) <= float(state.gbest_fit)
 
 
-def test_memetic_rejects_pallas():
+def test_memetic_pallas_gate():
+    """The fused-composition path follows PSO's gate: named f32 gbest
+    objectives qualify; callables and non-gbest topologies do not.
+    (Until r3 MemeticPSO rejected use_pallas entirely — the fused
+    composition in ops/memetic.fused_memetic_run lifted that.)"""
+    opt = MemeticPSO("sphere", n=512, dim=4, use_pallas=True)
+    assert opt.use_pallas
+    # on CPU run() falls back to the portable path and still works
+    opt.run(20)
     with pytest.raises(ValueError):
-        MemeticPSO("sphere", n=16, dim=2, use_pallas=True)
+        MemeticPSO(lambda x: (x * x).sum(-1), n=512, dim=2,
+                   use_pallas=True)
+    with pytest.raises(ValueError):
+        MemeticPSO("sphere", n=512, dim=2, topology="ring",
+                   use_pallas=True)
 
 
 def test_memetic_with_lbest_topology():

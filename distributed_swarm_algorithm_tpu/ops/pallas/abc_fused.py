@@ -71,6 +71,21 @@ from .pso_fused import (
 )
 
 
+def host_draws(host_key, call_i, pos_shape, fit_shape, fold=None):
+    """The kernel's host-RNG operand contract — 5 fitness-row uniforms
+    (employed dim/phi, onlooker gate/dim/phi) then the scout position
+    plane — in ONE place shared by the single-chip and shmap drivers
+    so their draw order can never drift."""
+    kk = jax.random.fold_in(host_key, call_i)
+    if fold is not None:
+        kk = jax.random.fold_in(kk, fold)
+    ks = jax.random.split(kk, 6)
+    return tuple(
+        jax.random.uniform(ks[i], fit_shape, jnp.float32)
+        for i in range(5)
+    ) + (jax.random.uniform(ks[5], pos_shape, jnp.float32),)
+
+
 def abc_pallas_supported(objective_name, dtype) -> bool:
     return objective_name in OBJECTIVES_T and jnp.dtype(dtype) == jnp.float32
 
@@ -285,14 +300,9 @@ def fused_abc_run(
         ]).astype(jnp.int32)
         r_host = None
         if rng == "host":
-            import jax.random as jr
-
-            kk2 = jr.fold_in(host_key, call_i)
-            ks = jr.split(kk2, 6)
-            r_host = tuple(
-                jr.uniform(ks[i], fit_t.shape, jnp.float32)
-                for i in range(5)
-            ) + (jr.uniform(ks[5], pos_t.shape, jnp.float32),)
+            r_host = host_draws(
+                host_key, call_i, pos_t.shape, fit_t.shape
+            )
         pos_t, fit_t, tri_t = fused_abc_step_t(
             scalars, pos_t, fit_t, tri_t, r_host,
             objective_name=objective_name, half_width=half_width,

@@ -98,6 +98,22 @@ def cuckoo_pallas_supported(objective_name, dtype) -> bool:
     return objective_name in OBJECTIVES_T and jnp.dtype(dtype) == jnp.float32
 
 
+def host_draws(host_key, call_i, pos_shape, fit_shape, fold=None):
+    """The kernel's host-RNG operand contract — (r_levy1, r_levy2,
+    r_ab, r_walk) — in ONE place shared by the single-chip and shmap
+    drivers so their draw order can never drift."""
+    kk = jax.random.fold_in(host_key, call_i)
+    if fold is not None:
+        kk = jax.random.fold_in(kk, fold)
+    k1, k2, k3, k4 = jax.random.split(kk, 4)
+    return (
+        jax.random.normal(k1, pos_shape, jnp.float32),
+        jax.random.normal(k2, pos_shape, jnp.float32),
+        jax.random.uniform(k3, fit_shape, jnp.float32),
+        jax.random.uniform(k4, pos_shape, jnp.float32),
+    )
+
+
 def _make_kernel(objective_t, half_width, pa, step_scale, beta, sigma,
                  host_rng, k_steps):
     inv_beta = 1.0 / beta
@@ -300,14 +316,9 @@ def fused_cuckoo_run(
         ]).astype(jnp.int32)
         r1 = r2 = rab = rwk = None
         if rng == "host":
-            import jax.random as jr
-
-            kk2 = jr.fold_in(host_key, call_i)
-            k1, k2, k3, k4 = jr.split(kk2, 4)
-            r1 = jr.normal(k1, pos_t.shape, jnp.float32)
-            r2 = jr.normal(k2, pos_t.shape, jnp.float32)
-            rab = jr.uniform(k3, fit_t.shape, jnp.float32)
-            rwk = jr.uniform(k4, pos_t.shape, jnp.float32)
+            r1, r2, rab, rwk = host_draws(
+                host_key, call_i, pos_t.shape, fit_t.shape
+            )
         pos_t, fit_t = fused_cuckoo_step_t(
             scalars, best_pos[:, None], pos_t, fit_t, r1, r2, rab, rwk,
             objective_name=objective_name, half_width=half_width,

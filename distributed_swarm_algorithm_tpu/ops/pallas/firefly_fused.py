@@ -124,30 +124,53 @@ def _make_kernel(dim, tile_i, tile_j, beta0, gamma):
     static_argnames=("beta0", "gamma", "tile_i", "tile_j", "interpret"),
 )
 def firefly_attraction_pallas(
-    pos: jax.Array,            # [N, D]
-    fit: jax.Array,            # [N]
+    pos: jax.Array,            # [N_i, D]
+    fit: jax.Array,            # [N_i]
     beta0: float = BETA0,
     gamma: float = GAMMA,
     tile_i: int = DEFAULT_TILE_I,
     tile_j: int = DEFAULT_TILE_J,
     interpret: bool = False,
+    pos_j: jax.Array | None = None,   # [N_j, D] source swarm
+    fit_j: jax.Array | None = None,   # [N_j]
 ) -> jax.Array:
-    """All-pairs attraction move [N, D] without O(N^2) HBM
-    intermediates:  move_i = sum_j W_ij (x_j - x_i)."""
+    """Attraction move [N_i, D] without O(N^2) HBM intermediates:
+    ``move_i = sum_j W_ij (x_j - x_i)``.  By default j ranges over the
+    same swarm (the all-pairs square case); passing ``pos_j``/``fit_j``
+    computes the RECTANGULAR case — rows i attracted by an arbitrary
+    source swarm — which is how the shmap driver shards the quadratic:
+    each device's rows against the all-gathered full swarm."""
     n, dim = pos.shape
-    tile_j = min(tile_j, _ceil_to(n, 128))
-    tile_i = min(tile_i, tile_j)
-    while tile_j % tile_i:
-        tile_i //= 2
-    n_pad = _ceil_to(n, tile_j)
+    if pos_j is None:
+        pos_j, fit_j = pos, fit
+    nj = pos_j.shape[0]
+    tile_j = min(tile_j, _ceil_to(nj, 128))
+    tile_i = min(tile_i, _ceil_to(n, 128), tile_j)
+    # Largest 128-multiple divisor of tile_j not exceeding tile_i: a
+    # plain halving loop can collapse to 1 when tile_j has an odd
+    # 128-multiple factor (e.g. rectangular n_j=1280 vs tile_i=384 ->
+    # 3), breaking Mosaic's lane-block constraints.
+    tile_i = max(
+        t for t in range(128, tile_i + 1, 128) if tile_j % t == 0
+    )
+    n_pad = _ceil_to(n, tile_i)
+    nj_pad = _ceil_to(nj, tile_j)
     f32 = jnp.float32
 
     pos_p = jnp.zeros((n_pad, dim), f32).at[:n].set(pos.astype(f32))
-    # Padded rows get +inf fitness: never brighter than anyone, so they
-    # contribute zero weight to real rows.
-    fit_p = jnp.full((n_pad,), jnp.inf, f32).at[:n].set(fit.astype(f32))
+    fit_i_p = jnp.full((n_pad,), jnp.inf, f32).at[:n].set(
+        fit.astype(f32)
+    )
+    pos_jp = jnp.zeros((nj_pad, dim), f32).at[:nj].set(
+        pos_j.astype(f32)
+    )
+    # Padded source rows get +inf fitness: never brighter than anyone,
+    # so they contribute zero weight to real rows.
+    fit_jp = jnp.full((nj_pad,), jnp.inf, f32).at[:nj].set(
+        fit_j.astype(f32)
+    )
 
-    grid = (n_pad // tile_i, n_pad // tile_j)
+    grid = (n_pad // tile_i, nj_pad // tile_j)
     kernel = _make_kernel(dim, tile_i, tile_j, float(beta0), float(gamma))
     move, wsum = pl.pallas_call(
         kernel,
@@ -175,7 +198,7 @@ def firefly_attraction_pallas(
             jax.ShapeDtypeStruct((n_pad, 1), f32),
         ],
         interpret=interpret,
-    )(pos_p, pos_p.T, pos_p, fit_p[:, None], fit_p[None, :])
+    )(pos_p, pos_jp.T, pos_jp, fit_i_p[:, None], fit_jp[None, :])
     return (move[:n] - wsum[:n] * pos_p[:n]).astype(pos.dtype)
 
 

@@ -73,6 +73,22 @@ from .pso_fused import (
 )
 
 
+def host_draws(host_key, call_i, pos_shape, fit_shape, fold=None):
+    """The kernel's host-RNG operand contract — (r_sbx, r_gate, r_mut,
+    r_do) — in ONE place shared by the single-chip and shmap drivers
+    so their draw order can never drift."""
+    kk = jax.random.fold_in(host_key, call_i)
+    if fold is not None:
+        kk = jax.random.fold_in(kk, fold)
+    k1, k2, k3, k4 = jax.random.split(kk, 4)
+    return (
+        jax.random.uniform(k1, pos_shape, jnp.float32),
+        jax.random.uniform(k2, fit_shape, jnp.float32),
+        jax.random.uniform(k3, pos_shape, jnp.float32),
+        jax.random.uniform(k4, pos_shape, jnp.float32),
+    )
+
+
 def ga_pallas_supported(objective_name, dtype) -> bool:
     return objective_name in OBJECTIVES_T and jnp.dtype(dtype) == jnp.float32
 
@@ -312,14 +328,9 @@ def fused_ga_run(
         ]).astype(jnp.int32)
         rs = rg = rm = rd = None
         if rng == "host":
-            import jax.random as jr
-
-            kk2 = jr.fold_in(host_key, call_i)
-            k1, k2, k3, k4 = jr.split(kk2, 4)
-            rs = jr.uniform(k1, pos_t.shape, jnp.float32)
-            rg = jr.uniform(k2, fit_t.shape, jnp.float32)
-            rm = jr.uniform(k3, pos_t.shape, jnp.float32)
-            rd = jr.uniform(k4, pos_t.shape, jnp.float32)
+            rs, rg, rm, rd = host_draws(
+                host_key, call_i, pos_t.shape, fit_t.shape
+            )
         pos_t, fit_t = fused_ga_step_t(
             scalars, pos_t, fit_t, rs, rg, rm, rd,
             objective_name=objective_name, half_width=half_width,

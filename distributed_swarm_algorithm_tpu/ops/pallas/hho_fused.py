@@ -40,6 +40,30 @@ from .pso_fused import (
 )
 
 
+def host_draws(host_key, call_i, pos_shape, fit_shape, fold=None):
+    """The kernel's host-RNG operand contract — 4 fitness-row uniforms,
+    5 position-plane uniforms, 2 position-plane normals, in that order
+    — in ONE place shared by the single-chip and shmap drivers so
+    their draw order can never drift."""
+    kk = jax.random.fold_in(host_key, call_i)
+    if fold is not None:
+        kk = jax.random.fold_in(kk, fold)
+    ks = jax.random.split(kk, 11)
+    rows = [
+        jax.random.uniform(ks[i], fit_shape, jnp.float32)
+        for i in range(4)
+    ]
+    planes = [
+        jax.random.uniform(ks[4 + i], pos_shape, jnp.float32)
+        for i in range(5)
+    ]
+    normals = [
+        jax.random.normal(ks[9 + i], pos_shape, jnp.float32)
+        for i in range(2)
+    ]
+    return tuple(rows + planes + normals)
+
+
 def hho_pallas_supported(objective_name, dtype) -> bool:
     return objective_name in OBJECTIVES_T and jnp.dtype(dtype) == jnp.float32
 
@@ -268,26 +292,13 @@ def fused_hho_run(
         # Mean over the REAL population lanes (pad lanes are duplicates
         # of leading members — excluding them keeps x_m exact).
         mean = jnp.mean(pos_t[:, :n], axis=1, keepdims=True)
-        host_draws = None
+        draws = None
         if rng == "host":
-            import jax.random as jr
-
-            ks = jr.split(jr.fold_in(host_key, call_i), 11)
-            rows = [
-                jr.uniform(ks[i], fit_t.shape, jnp.float32)
-                for i in range(4)
-            ]
-            planes = [
-                jr.uniform(ks[4 + i], pos_t.shape, jnp.float32)
-                for i in range(5)
-            ]
-            normals = [
-                jr.normal(ks[9 + i], pos_t.shape, jnp.float32)
-                for i in range(2)
-            ]
-            host_draws = tuple(rows + planes + normals)
+            draws = host_draws(
+                host_key, call_i, pos_t.shape, fit_t.shape
+            )
         pos_t, fit_t = fused_hho_step_t(
-            scalars, best_pos[:, None], mean, pos_t, fit_t, host_draws,
+            scalars, best_pos[:, None], mean, pos_t, fit_t, draws,
             objective_name=objective_name, half_width=half_width,
             t_max=t_max, levy_beta=levy_beta, tile_n=tile_n, rng=rng,
             interpret=interpret, k_steps=k,

@@ -103,13 +103,20 @@ def _island_gbest_update(bfit_t, bpos_t, gpos_ti, gfit_i, n_i, n_l):
     return gpos_ti, gfit_i
 
 
-def _migrate_t(pos_t, vel_t, bpos_t, bfit_t, k, n_i, n_l, n_real=None):
+def _migrate_t(pos_t, vel_t, bpos_t, bfit_t, k, n_i, n_l, n_real=None,
+               shift_fn=None):
     """Ring migration in transposed layout (parallel/islands.py:migrate).
 
     Padded lanes (index >= ``n_real`` within an island) are excluded from
     both emigrant and replacement selection, so migration touches exactly
     the particles the portable path would — immigrants are never written
     into lanes the final unpad slice discards.
+
+    ``shift_fn(em_pos [D, I, k], em_fit [I, k]) -> (in_pos, in_fit)``
+    overrides the default single-chip ``jnp.roll`` ring shift — the
+    sharded driver (parallel/sharding.py:fused_island_run_shmap) passes
+    a within-shard roll + ``ppermute`` of the boundary pack, which
+    realizes the exact same GLOBAL ring across devices.
     """
     n_real = n_l if n_real is None else n_real
     bfit_r = bfit_t.reshape(n_i, n_l)
@@ -124,8 +131,13 @@ def _migrate_t(pos_t, vel_t, bpos_t, bfit_t, k, n_i, n_l, n_real=None):
     em_pos = bpos_t[:, flat_b].reshape(-1, n_i, k)         # [D, I, k]
     em_fit = jnp.take_along_axis(bfit_r, best_idx, axis=1)  # [I, k]
 
-    in_pos = jnp.roll(em_pos, 1, axis=1).reshape(-1, n_i * k)
-    in_fit = jnp.roll(em_fit, 1, axis=0).reshape(-1)
+    if shift_fn is None:
+        in_pos = jnp.roll(em_pos, 1, axis=1).reshape(-1, n_i * k)
+        in_fit = jnp.roll(em_fit, 1, axis=0).reshape(-1)
+    else:
+        in_pos, in_fit = shift_fn(em_pos, em_fit)
+        in_pos = in_pos.reshape(-1, n_i * k)
+        in_fit = in_fit.reshape(-1)
 
     _, worst_idx = jax.lax.top_k(                           # k largest real
         jnp.where(valid, bfit_r, -inf), k

@@ -63,18 +63,35 @@ from .pso_fused import (
 # would silently miss optima visited and then hopped away from.
 
 
+def host_draws(host_key, call_i, pos_shape, fit_shape, fold=None):
+    """The kernel's host-RNG operand contract — (proposal normals,
+    accept uniforms, swap uniforms) — in ONE place shared by the
+    single-chip and shmap drivers so their draw order can never
+    drift."""
+    kk = jax.random.fold_in(host_key, call_i)
+    if fold is not None:
+        kk = jax.random.fold_in(kk, fold)
+    k1, k2, k3 = jax.random.split(kk, 3)
+    return (
+        jax.random.normal(k1, pos_shape, jnp.float32),
+        jax.random.uniform(k2, fit_shape, jnp.float32),
+        jax.random.uniform(k3, fit_shape, jnp.float32),
+    )
+
+
 def pt_pallas_supported(objective_name, dtype) -> bool:
     return objective_name in OBJECTIVES_T and jnp.dtype(dtype) == jnp.float32
 
 
 def _make_kernel(objective_t, half_width, swap_every, host_rng,
-                 k_steps, tile_n, n_real):
+                 k_steps, tile_n):
     def body(scalar_ref, pos_ref, fit_ref, sig_ref, beta_ref,
              r_n, r_acc, r_swap, pos_o, fit_o, tfit_o, tpos_o):
         pos, fit = pos_ref[:], fit_ref[:]
         sigma = sig_ref[:]                       # [1, T] proposal scales
         beta = beta_ref[:]                       # [1, T] 1/temperature
         it0 = scalar_ref[1]
+        n_real = scalar_ref[2]                   # unpadded ladder length
         col = jax.lax.broadcasted_iota(jnp.int32, fit.shape, 1)
         # Global chain index: masks padded phantom chains out of the
         # exchange (a cyclic duplicate carries the COLD end's
@@ -185,11 +202,11 @@ def _make_kernel(objective_t, half_width, swap_every, host_rng,
     jax.jit,
     static_argnames=(
         "objective_name", "half_width", "swap_every",
-        "tile_n", "n_real", "rng", "interpret", "k_steps",
+        "tile_n", "rng", "interpret", "k_steps",
     ),
 )
 def fused_pt_step_t(
-    scalars: jax.Array,       # [2] i32: seed, iteration-before-block
+    scalars: jax.Array,       # [3] i32: seed, iteration-before-block, n_real
     pos: jax.Array,           # [D, N]
     fit: jax.Array,           # [1, N]
     sigma: jax.Array,         # [1, N] per-chain proposal scales
@@ -202,7 +219,6 @@ def fused_pt_step_t(
     half_width: float = 5.12,
     swap_every: int = SWAP_EVERY,
     tile_n: int = 4096,
-    n_real: int | None = None,
     rng: str = "tpu",
     interpret: bool = False,
     k_steps: int = 1,
@@ -210,13 +226,12 @@ def fused_pt_step_t(
     """``k_steps`` fused PT rounds; returns ``(pos, fit, best_fit[1,1],
     best_pos[D,1])`` where best_* is the best state *visited* anywhere
     during the block (per-step record — PT chains are non-elitist, so
-    block-end state alone would under-report).  ``n_real`` is the
-    unpadded ladder length; padded phantom chains never exchange."""
+    block-end state alone would under-report).  ``scalars[2]`` is the
+    unpadded ladder length (traced so shmap shards can pass their
+    own); padded phantom chains never exchange."""
     d, n = pos.shape
     if n % tile_n:
         raise ValueError(f"N ({n}) must be a multiple of tile_n ({tile_n})")
-    if n_real is None:
-        n_real = n
     n_tiles = n // tile_n
     host_rng = rng == "host"
     if host_rng and any(x is None for x in (r_n, r_acc, r_swap)):
@@ -226,7 +241,7 @@ def fused_pt_step_t(
 
     kernel = _make_kernel(
         OBJECTIVES_T[objective_name], half_width, swap_every,
-        host_rng, k_steps, tile_n, n_real,
+        host_rng, k_steps, tile_n,
     )
 
     col = lambda i, s: (0, i)                                # noqa: E731
@@ -310,21 +325,17 @@ def fused_pt_run(
     def block(carry, call_i, k):
         pos_t, fit_t, best_pos, best_fit, it = carry
         scalars = jnp.stack(
-            [seed0 + call_i * n_tiles, it]
+            [seed0 + call_i * n_tiles, it, jnp.asarray(n, jnp.int32)]
         ).astype(jnp.int32)
         rn = ra = rs = None
         if rng == "host":
-            import jax.random as jr
-
-            kk = jr.fold_in(host_key, call_i)
-            k1, k2, k3 = jr.split(kk, 3)
-            rn = jr.normal(k1, pos_t.shape, jnp.float32)
-            ra = jr.uniform(k2, fit_t.shape, jnp.float32)
-            rs = jr.uniform(k3, fit_t.shape, jnp.float32)
+            rn, ra, rs = host_draws(
+                host_key, call_i, pos_t.shape, fit_t.shape
+            )
         pos_t, fit_t, blk_fit, blk_pos = fused_pt_step_t(
             scalars, pos_t, fit_t, sigma_t, beta_t, rn, ra, rs,
             objective_name=objective_name, half_width=half_width,
-            swap_every=swap_every, tile_n=tile_n, n_real=n,
+            swap_every=swap_every, tile_n=tile_n,
             rng=rng, interpret=interpret, k_steps=k,
         )
         cand_fit, cand_pos = blk_fit[0, 0], blk_pos[:, 0]

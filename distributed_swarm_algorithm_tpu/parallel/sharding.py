@@ -946,3 +946,1443 @@ def elect_shmap(
         return lax.pmax(local, axis)[None]
 
     return elect(alive, agent_id)[0]
+
+
+# --------------------------------------------------------------------------
+# r3 shmap drivers: the rest of the fused zoo (VERDICT r2 §weak-2).
+# All follow fused_de_run_shmap's shape: per-shard fused kernel blocks,
+# cross-device best exchange per block over ICI (_exchange_best), donor/
+# peer pools SHARD-LOCAL between exchanges (island-model lag class).
+# --------------------------------------------------------------------------
+
+
+def _shard_real_count(n, n_dev, shard_w, dev):
+    """Real (unpadded) lane count of shard ``dev`` after global cyclic
+    padding to ``n_dev * shard_w``: clip(n - dev*w, 0, w)."""
+    return jnp.clip(n - dev * shard_w, 0, shard_w)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "mesh", "n_steps", "axis", "half_width", "pa",
+        "step_scale", "levy_beta", "steps_per_kernel", "tile_n", "rng",
+        "interpret",
+    ),
+)
+def fused_cuckoo_run_shmap(
+    state,
+    objective_name: str,
+    mesh: Mesh,
+    n_steps: int,
+    axis: str = AGENT_AXIS,
+    half_width: float = 5.12,
+    pa: float | None = None,
+    step_scale: float | None = None,
+    levy_beta: float | None = None,
+    steps_per_kernel: int = 8,
+    tile_n: int | None = None,
+    rng: str = "tpu",
+    interpret: bool = False,
+):
+    """Multi-chip fused cuckoo: rotational egg-drop/peer blocks per
+    shard (ops/pallas/cuckoo_fused.py); the shared best is exchanged
+    per block over ICI."""
+    from ..ops.cuckoo import (
+        LEVY_BETA as _LB,
+        PA as _PA,
+        STEP_SCALE as _SS,
+        CuckooState,
+    )
+    from ..ops.pallas.common import ceil_to, cyclic_pad_rows
+    from ..ops.pallas.cuckoo_fused import (
+        fused_cuckoo_step_t,
+        host_draws as _cuckoo_host_draws,
+    )
+    from ..ops.pallas.de_fused import shrink_tile_for_donors
+    from ..ops.pallas.pso_fused import (
+        _auto_tile,
+        best_of_block,
+        run_blocks,
+        seed_base,
+    )
+
+    pa = _PA if pa is None else pa
+    step_scale = _SS if step_scale is None else step_scale
+    levy_beta = _LB if levy_beta is None else levy_beta
+    n, d = state.pos.shape
+    n_dev = mesh.shape[axis]
+    if rng == "host":
+        steps_per_kernel = 1
+    steps_per_kernel = min(steps_per_kernel, 8)    # VMEM (cuckoo_fused)
+    if tile_n is None:
+        tile_n = _auto_tile(ceil_to(max(d, 8), 8))
+    tile_n = min(tile_n, ceil_to(-(-n // n_dev), 128))
+    tile_n, n_pad, n_tiles_local = shrink_tile_for_donors(
+        n, tile_n, per_shard=n_dev
+    )
+
+    pos_t = cyclic_pad_rows(state.pos, n_pad).T
+    fit_t = cyclic_pad_rows(state.fit, n_pad)[None, :]
+    seed0 = seed_base(state.key)
+    host_key = jax.random.fold_in(state.key, 0xC0C)
+    shift_key = jax.random.fold_in(state.key, 0xC1C)
+    col = P(None, axis)
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(col, col, P(), P()),
+        out_specs=(col, col, P(), P()),
+        check_vma=False,
+    )
+    def run(pos_t, fit_t, best_pos, best_fit):
+        dev = lax.axis_index(axis)
+
+        def block(carry, call_i, k):
+            pos_t, fit_t, best_pos, best_fit = carry
+            kk = jax.random.fold_in(
+                jax.random.fold_in(shift_key, call_i), dev
+            )
+            tshifts = jax.random.randint(
+                kk, (2,), 1, max(n_tiles_local, 2)
+            )
+            lanes = jax.random.randint(
+                jax.random.fold_in(kk, 1), (3,), 0, tile_n
+            )
+            scalars = jnp.concatenate([
+                jnp.stack(
+                    [seed0 + (call_i * n_dev + dev) * n_tiles_local]
+                ),
+                tshifts, lanes,
+            ]).astype(jnp.int32)
+            r1 = r2 = rab = rwk = None
+            if rng == "host":
+                r1, r2, rab, rwk = _cuckoo_host_draws(
+                    host_key, call_i, pos_t.shape, fit_t.shape,
+                    fold=dev,
+                )
+            pos_t, fit_t = fused_cuckoo_step_t(
+                scalars, best_pos[:, None], pos_t, fit_t, r1, r2, rab,
+                rwk,
+                objective_name=objective_name, half_width=half_width,
+                pa=pa, step_scale=step_scale, levy_beta=levy_beta,
+                tile_n=tile_n, rng=rng, interpret=interpret, k_steps=k,
+            )
+            loc_fit, loc_pos = best_of_block(fit_t, pos_t)
+            best_fit, best_pos = _exchange_best(
+                loc_fit, loc_pos, best_fit, best_pos, dev, axis
+            )
+            return (pos_t, fit_t, best_pos, best_fit)
+
+        return run_blocks(
+            block, (pos_t, fit_t, best_pos, best_fit),
+            n_steps, steps_per_kernel,
+        )
+
+    pos_t, fit_t, best_pos, best_fit = run(
+        pos_t, fit_t,
+        state.best_pos.astype(jnp.float32),
+        state.best_fit.astype(jnp.float32),
+    )
+    dt = state.pos.dtype
+    return CuckooState(
+        pos=pos_t.T[:n].astype(dt),
+        fit=fit_t[0, :n].astype(state.fit.dtype),
+        best_pos=best_pos.astype(state.best_pos.dtype),
+        best_fit=best_fit.astype(state.best_fit.dtype),
+        key=jax.random.fold_in(state.key, n_steps),
+        iteration=state.iteration + n_steps,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "mesh", "n_steps", "axis", "half_width",
+        "t_max", "levy_beta", "steps_per_kernel", "tile_n", "rng",
+        "interpret",
+    ),
+)
+def fused_hho_run_shmap(
+    state,
+    objective_name: str,
+    mesh: Mesh,
+    n_steps: int,
+    axis: str = AGENT_AXIS,
+    half_width: float = 5.12,
+    t_max: int | None = None,
+    levy_beta: float | None = None,
+    steps_per_kernel: int = 8,
+    tile_n: int | None = None,
+    rng: str = "tpu",
+    interpret: bool = False,
+):
+    """Multi-chip fused HHO: rotational-peer blocks per shard
+    (ops/pallas/hho_fused.py); the rabbit (best) AND the global swarm
+    mean are exchanged per block over ICI (``psum`` of per-shard
+    real-lane sums — exact, pad lanes excluded)."""
+    from ..ops.hho import LEVY_BETA as _LB, T_MAX as _TM, HHOState
+    from ..ops.pallas.common import ceil_to, cyclic_pad_rows
+    from ..ops.pallas.de_fused import shrink_tile_for_donors
+    from ..ops.pallas.hho_fused import (
+        fused_hho_step_t,
+        host_draws as _hho_host_draws,
+    )
+    from ..ops.pallas.pso_fused import (
+        _auto_tile,
+        best_of_block,
+        run_blocks,
+        seed_base,
+    )
+
+    t_max = _TM if t_max is None else t_max
+    levy_beta = _LB if levy_beta is None else levy_beta
+    n, d = state.pos.shape
+    n_dev = mesh.shape[axis]
+    if rng == "host":
+        steps_per_kernel = 1
+    steps_per_kernel = min(steps_per_kernel, 8)    # VMEM (hho_fused)
+    if tile_n is None:
+        tile_n = _auto_tile(ceil_to(max(d, 8), 8))
+    tile_n = min(tile_n, ceil_to(-(-n // n_dev), 128))
+    tile_n, n_pad, n_tiles_local = shrink_tile_for_donors(
+        n, tile_n, per_shard=n_dev
+    )
+    shard_w = n_pad // n_dev
+
+    pos_t = cyclic_pad_rows(state.pos, n_pad).T
+    fit_t = cyclic_pad_rows(state.fit, n_pad)[None, :]
+    seed0 = seed_base(state.key)
+    host_key = jax.random.fold_in(state.key, 0x440)
+    shift_key = jax.random.fold_in(state.key, 0x441)
+    col = P(None, axis)
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(col, col, P(), P()),
+        out_specs=(col, col, P(), P()),
+        check_vma=False,
+    )
+    def run(pos_t, fit_t, best_pos, best_fit):
+        dev = lax.axis_index(axis)
+        n_real_local = _shard_real_count(n, n_dev, shard_w, dev)
+
+        def block(carry, call_i, k):
+            pos_t, fit_t, best_pos, best_fit, it = carry
+            kk = jax.random.fold_in(
+                jax.random.fold_in(shift_key, call_i), dev
+            )
+            tshift = jax.random.randint(
+                kk, (), 1, max(n_tiles_local, 2)
+            )
+            lshift = jax.random.randint(
+                jax.random.fold_in(kk, 1), (), 0, tile_n
+            )
+            scalars = jnp.stack([
+                seed0 + (call_i * n_dev + dev) * n_tiles_local,
+                tshift, it, lshift,
+            ]).astype(jnp.int32)
+            # Global mean over REAL lanes: per-shard masked sum + psum.
+            lane = jnp.arange(shard_w)
+            real = (lane < n_real_local)[None, :]
+            loc_sum = jnp.sum(
+                jnp.where(real, pos_t, 0.0), axis=1, keepdims=True
+            )
+            mean = lax.psum(loc_sum, axis) / n
+            draws = None
+            if rng == "host":
+                draws = _hho_host_draws(
+                    host_key, call_i, pos_t.shape, fit_t.shape,
+                    fold=dev,
+                )
+            pos_t, fit_t = fused_hho_step_t(
+                scalars, best_pos[:, None], mean, pos_t, fit_t,
+                draws,
+                objective_name=objective_name, half_width=half_width,
+                t_max=t_max, levy_beta=levy_beta, tile_n=tile_n,
+                rng=rng, interpret=interpret, k_steps=k,
+            )
+            loc_fit, loc_pos = best_of_block(fit_t, pos_t)
+            best_fit, best_pos = _exchange_best(
+                loc_fit, loc_pos, best_fit, best_pos, dev, axis
+            )
+            return (pos_t, fit_t, best_pos, best_fit, it + k)
+
+        carry = run_blocks(
+            block, (pos_t, fit_t, best_pos, best_fit, state.iteration),
+            n_steps, steps_per_kernel,
+        )
+        return carry[:4]
+
+    pos_t, fit_t, best_pos, best_fit = run(
+        pos_t, fit_t,
+        state.best_pos.astype(jnp.float32),
+        state.best_fit.astype(jnp.float32),
+    )
+    dt = state.pos.dtype
+    return HHOState(
+        pos=pos_t.T[:n].astype(dt),
+        fit=fit_t[0, :n].astype(state.fit.dtype),
+        best_pos=best_pos.astype(state.best_pos.dtype),
+        best_fit=best_fit.astype(state.best_fit.dtype),
+        key=jax.random.fold_in(state.key, n_steps),
+        iteration=state.iteration + n_steps,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "mesh", "n_steps", "axis", "half_width",
+        "t_max", "b", "steps_per_kernel", "tile_n", "rng", "interpret",
+    ),
+)
+def fused_mfo_run_shmap(
+    state,
+    objective_name: str,
+    mesh: Mesh,
+    n_steps: int,
+    axis: str = AGENT_AXIS,
+    half_width: float = 5.12,
+    t_max: int | None = None,
+    b: float | None = None,
+    steps_per_kernel: int = 8,
+    tile_n: int | None = None,
+    rng: str = "tpu",
+    interpret: bool = False,
+):
+    """Multi-chip fused MFO: positional-flame blocks per shard
+    (ops/pallas/mfo_fused.py) with a SHARD-LOCAL flame memory — each
+    shard sorts (flames ++ moths) over its own lanes at block cadence,
+    the island-model trade (global elitism would need a cross-device
+    sort; the shards still couple through nothing else, exactly like
+    the portable island model over MFO).  The flame-count schedule
+    runs on the shard width."""
+    from ..ops.mfo import SPIRAL_B as _SB, T_MAX as _TM, MFOState
+    from ..ops.pallas.common import ceil_to, cyclic_pad_rows
+    from ..ops.pallas.mfo_fused import fused_mfo_step_t
+    from ..ops.pallas.pso_fused import (
+        _auto_tile,
+        run_blocks,
+        seed_base,
+    )
+
+    t_max = _TM if t_max is None else t_max
+    b = _SB if b is None else b
+    n, d = state.pos.shape
+    n_dev = mesh.shape[axis]
+    if rng == "host":
+        steps_per_kernel = 1
+    steps_per_kernel = min(steps_per_kernel, 32)
+    if tile_n is None:
+        tile_n = _auto_tile(ceil_to(max(d, 8), 8))
+    tile_n = min(tile_n, ceil_to(-(-n // n_dev), 128))
+    n_pad = ceil_to(n, n_dev * tile_n)
+    shard_w = n_pad // n_dev
+    n_tiles_local = shard_w // tile_n
+
+    pos_t = cyclic_pad_rows(state.pos, n_pad).T
+    fit_t = cyclic_pad_rows(state.fit, n_pad)[None, :]
+    flame_pos_t = jnp.concatenate(
+        [
+            state.flame_pos.T.astype(jnp.float32),
+            jnp.broadcast_to(
+                state.flame_pos[-1][:, None].astype(jnp.float32),
+                (d, n_pad - n),
+            ),
+        ],
+        axis=1,
+    )
+    flame_fit = jnp.concatenate([
+        state.flame_fit.astype(jnp.float32),
+        jnp.full((n_pad - n,), jnp.inf, jnp.float32),
+    ])[None, :]
+    seed0 = seed_base(state.key)
+    host_key = jax.random.fold_in(state.key, 0x3F0)
+    col = P(None, axis)
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(col, col, col, col),
+        out_specs=(col, col, col, col),
+        check_vma=False,
+    )
+    def run(pos_t, fit_t, flame_pos_t, flame_fit_row):
+        dev = lax.axis_index(axis)
+
+        def block(carry, call_i, k):
+            pos_t, fit_t, flame_pos_t, flame_fit, it = carry
+            t = (it + 1).astype(jnp.float32)
+            frac = jnp.clip(t / t_max, 0.0, 1.0)
+            n_flames = jnp.round(
+                shard_w - frac * (shard_w - 1)
+            ).astype(jnp.int32)
+            r_lo = -1.0 - frac
+            last = jax.lax.dynamic_slice(
+                flame_pos_t, (0, jnp.maximum(n_flames - 1, 0)), (d, 1)
+            )
+            scalars = jnp.stack([
+                seed0 + (call_i * n_dev + dev) * n_tiles_local,
+                n_flames,
+                jnp.round(r_lo * 65536.0).astype(jnp.int32),
+            ]).astype(jnp.int32)
+            r_l = None
+            if rng == "host":
+                r_l = jax.random.uniform(
+                    jax.random.fold_in(
+                        jax.random.fold_in(host_key, call_i), dev
+                    ),
+                    pos_t.shape, jnp.float32,
+                )
+            pos_t, fit_t = fused_mfo_step_t(
+                scalars, last, pos_t, flame_pos_t, r_l,
+                objective_name=objective_name,
+                half_width=half_width, b=b, tile_n=tile_n, rng=rng,
+                interpret=interpret, k_steps=k,
+            )
+            all_fit = jnp.concatenate([flame_fit, fit_t[0]])
+            all_pos = jnp.concatenate([flame_pos_t, pos_t], axis=1)
+            order = jnp.argsort(all_fit)[:shard_w]
+            flame_fit = all_fit[order]
+            flame_pos_t = all_pos[:, order]
+            return (pos_t, fit_t, flame_pos_t, flame_fit, it + k)
+
+        carry = run_blocks(
+            block,
+            (pos_t, fit_t, flame_pos_t, flame_fit_row[0],
+             state.iteration),
+            n_steps, steps_per_kernel,
+        )
+        pos_t, fit_t, flame_pos_t, flame_fit, _ = carry
+        return pos_t, fit_t, flame_pos_t, flame_fit[None, :]
+
+    pos_t, fit_t, flame_pos_t, flame_fit = run(
+        pos_t, fit_t, flame_pos_t, flame_fit
+    )
+    dt = state.pos.dtype
+    return MFOState(
+        pos=pos_t.T[:n].astype(dt),
+        fit=fit_t[0, :n].astype(state.fit.dtype),
+        flame_pos=flame_pos_t.T[:n].astype(state.flame_pos.dtype),
+        flame_fit=flame_fit[0, :n].astype(state.flame_fit.dtype),
+        key=jax.random.fold_in(state.key, n_steps),
+        iteration=state.iteration + n_steps,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "mesh", "n_steps", "axis", "half_width",
+        "t_max", "steps_per_kernel", "tile_n", "rng", "interpret",
+    ),
+)
+def fused_salp_run_shmap(
+    state,
+    objective_name: str,
+    mesh: Mesh,
+    n_steps: int,
+    axis: str = AGENT_AXIS,
+    half_width: float = 5.12,
+    t_max: int | None = None,
+    steps_per_kernel: int = 16,
+    tile_n: int | None = None,
+    rng: str = "tpu",
+    interpret: bool = False,
+):
+    """Multi-chip fused salp: each shard runs its own sub-chain with
+    its own leader (the kernel's tile-0 leader rule fires per shard),
+    all leaders following the GLOBAL food source exchanged per block
+    over ICI — the multi-leader salp-chain variant; per-step in-kernel
+    best recording feeds the exchange."""
+    from ..ops.pallas.common import ceil_to, cyclic_pad_rows
+    from ..ops.pallas.pso_fused import (
+        _auto_tile,
+        host_uniforms,
+        run_blocks,
+        seed_base,
+    )
+    from ..ops.pallas.salp_fused import fused_salp_step_t
+    from ..ops.salp import T_MAX as _TM, SalpState
+
+    t_max = _TM if t_max is None else t_max
+    n, d = state.pos.shape
+    n_dev = mesh.shape[axis]
+    if rng == "host":
+        steps_per_kernel = 1
+    steps_per_kernel = min(steps_per_kernel, 16)
+    if tile_n is None:
+        tile_n = _auto_tile(ceil_to(max(d, 8), 8))
+    tile_n = min(tile_n, ceil_to(-(-n // n_dev), 128))
+    n_pad = ceil_to(n, n_dev * tile_n)
+    shard_w = n_pad // n_dev
+    n_tiles_local = shard_w // tile_n
+
+    pos_t = cyclic_pad_rows(state.pos, n_pad).T
+    fit_t = cyclic_pad_rows(state.fit, n_pad)[None, :]
+    seed0 = seed_base(state.key)
+    host_key = jax.random.fold_in(state.key, 0x5A1)
+    col = P(None, axis)
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(col, col, P(), P()),
+        out_specs=(col, col, P(), P()),
+        check_vma=False,
+    )
+    def run(pos_t, fit_t, best_pos, best_fit):
+        dev = lax.axis_index(axis)
+
+        def block(carry, call_i, k):
+            pos_t, fit_t, best_pos, best_fit, it = carry
+            scalars = jnp.stack([
+                seed0 + (call_i * n_dev + dev) * n_tiles_local, it,
+            ]).astype(jnp.int32)
+            r2 = r3 = None
+            if rng == "host":
+                r2, r3 = host_uniforms(
+                    host_key, call_i, pos_t.shape, fold=dev
+                )
+            pos_t, fit_t, blk_fit, blk_pos = fused_salp_step_t(
+                scalars, best_pos[:, None], pos_t, fit_t, r2, r3,
+                objective_name=objective_name, half_width=half_width,
+                t_max=t_max, tile_n=tile_n, rng=rng,
+                interpret=interpret, k_steps=k,
+            )
+            best_fit, best_pos = _exchange_best(
+                blk_fit[0, 0], blk_pos[:, 0], best_fit, best_pos,
+                dev, axis,
+            )
+            return (pos_t, fit_t, best_pos, best_fit, it + k)
+
+        carry = run_blocks(
+            block, (pos_t, fit_t, best_pos, best_fit, state.iteration),
+            n_steps, steps_per_kernel,
+        )
+        return carry[:4]
+
+    pos_t, fit_t, best_pos, best_fit = run(
+        pos_t, fit_t,
+        state.best_pos.astype(jnp.float32),
+        state.best_fit.astype(jnp.float32),
+    )
+    dt = state.pos.dtype
+    return SalpState(
+        pos=pos_t.T[:n].astype(dt),
+        fit=fit_t[0, :n].astype(state.fit.dtype),
+        best_pos=best_pos.astype(state.best_pos.dtype),
+        best_fit=best_fit.astype(state.best_fit.dtype),
+        key=jax.random.fold_in(state.key, n_steps),
+        iteration=state.iteration + n_steps,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "mesh", "n_steps", "axis", "half_width",
+        "eta_c", "eta_m", "p_cross", "p_mut", "steps_per_kernel",
+        "tile_n", "rng", "interpret",
+    ),
+)
+def fused_ga_run_shmap(
+    state,
+    objective_name: str,
+    mesh: Mesh,
+    n_steps: int,
+    axis: str = AGENT_AXIS,
+    half_width: float = 5.12,
+    eta_c: float | None = None,
+    eta_m: float | None = None,
+    p_cross: float | None = None,
+    p_mut: float | None = None,
+    steps_per_kernel: int = 8,
+    tile_n: int | None = None,
+    rng: str = "tpu",
+    interpret: bool = False,
+):
+    """Multi-chip fused GA: rotational-tournament blocks per shard
+    (ops/pallas/ga_fused.py); tournament snapshot pools are SHARD-LOCAL
+    between exchanges and the best is exchanged per block over ICI."""
+    from ..ops.ga import GAState
+    from ..ops.nsga2 import ETA_C as _EC, ETA_M as _EM, P_CROSS as _PC
+    from ..ops.pallas.common import ceil_to, cyclic_pad_rows
+    from ..ops.pallas.de_fused import shrink_tile_for_donors
+    from ..ops.pallas.ga_fused import (
+        fused_ga_step_t,
+        host_draws as _ga_host_draws,
+    )
+    from ..ops.pallas.pso_fused import (
+        _auto_tile,
+        best_of_block,
+        run_blocks,
+        seed_base,
+    )
+
+    eta_c = _EC if eta_c is None else eta_c
+    eta_m = _EM if eta_m is None else eta_m
+    p_cross = _PC if p_cross is None else p_cross
+    n, d = state.pos.shape
+    if p_mut is None:
+        p_mut = 1.0 / d
+    n_dev = mesh.shape[axis]
+    if rng == "host":
+        steps_per_kernel = 1
+    steps_per_kernel = min(steps_per_kernel, 8)    # VMEM (ga_fused)
+    if tile_n is None:
+        tile_n = _auto_tile(ceil_to(max(d, 8), 8))
+    tile_n = min(tile_n, ceil_to(-(-n // n_dev), 128))
+    tile_n, n_pad, n_tiles_local = shrink_tile_for_donors(
+        n, tile_n, per_shard=n_dev
+    )
+
+    pos_t = cyclic_pad_rows(state.pos, n_pad).T
+    fit_t = cyclic_pad_rows(state.fit, n_pad)[None, :]
+    seed0 = seed_base(state.key)
+    host_key = jax.random.fold_in(state.key, 0x6A)
+    shift_key = jax.random.fold_in(state.key, 0x6A5F)
+    col = P(None, axis)
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(col, col, P(), P()),
+        out_specs=(col, col, P(), P()),
+        check_vma=False,
+    )
+    def run(pos_t, fit_t, best_pos, best_fit):
+        dev = lax.axis_index(axis)
+
+        def block(carry, call_i, k):
+            pos_t, fit_t, best_pos, best_fit = carry
+            kk = jax.random.fold_in(
+                jax.random.fold_in(shift_key, call_i), dev
+            )
+            tshifts = jax.random.randint(
+                kk, (2,), 1, max(n_tiles_local, 2)
+            )
+            lanes = jax.random.randint(
+                jax.random.fold_in(kk, 1), (3,), 0, tile_n
+            )
+            scalars = jnp.concatenate([
+                jnp.stack(
+                    [seed0 + (call_i * n_dev + dev) * n_tiles_local]
+                ),
+                tshifts, lanes,
+            ]).astype(jnp.int32)
+            rs = rg = rm = rd = None
+            if rng == "host":
+                rs, rg, rm, rd = _ga_host_draws(
+                    host_key, call_i, pos_t.shape, fit_t.shape,
+                    fold=dev,
+                )
+            pos_t, fit_t = fused_ga_step_t(
+                scalars, pos_t, fit_t, rs, rg, rm, rd,
+                objective_name=objective_name, half_width=half_width,
+                eta_c=eta_c, eta_m=eta_m, p_cross=p_cross, p_mut=p_mut,
+                tile_n=tile_n, rng=rng, interpret=interpret, k_steps=k,
+            )
+            loc_fit, loc_pos = best_of_block(fit_t, pos_t)
+            best_fit, best_pos = _exchange_best(
+                loc_fit, loc_pos, best_fit, best_pos, dev, axis
+            )
+            return (pos_t, fit_t, best_pos, best_fit)
+
+        return run_blocks(
+            block, (pos_t, fit_t, best_pos, best_fit),
+            n_steps, steps_per_kernel,
+        )
+
+    pos_t, fit_t, best_pos, best_fit = run(
+        pos_t, fit_t,
+        state.best_pos.astype(jnp.float32),
+        state.best_fit.astype(jnp.float32),
+    )
+    dt = state.pos.dtype
+    return GAState(
+        pos=pos_t.T[:n].astype(dt),
+        fit=fit_t[0, :n].astype(state.fit.dtype),
+        best_pos=best_pos.astype(state.best_pos.dtype),
+        best_fit=best_fit.astype(state.best_fit.dtype),
+        key=jax.random.fold_in(state.key, n_steps),
+        iteration=state.iteration + n_steps,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "mesh", "n_steps", "axis", "half_width",
+        "limit", "steps_per_kernel", "tile_n", "rng", "interpret",
+    ),
+)
+def fused_abc_run_shmap(
+    state,
+    objective_name: str,
+    mesh: Mesh,
+    n_steps: int,
+    axis: str = AGENT_AXIS,
+    half_width: float = 5.12,
+    limit: int = 20,
+    steps_per_kernel: int = 8,
+    tile_n: int | None = None,
+    rng: str = "tpu",
+    interpret: bool = False,
+):
+    """Multi-chip fused ABC: Bernoulli-recruitment blocks per shard
+    (ops/pallas/abc_fused.py); the onlooker's cross-tile snapshot
+    partner pool is SHARD-LOCAL between exchanges; trial counters ride
+    sharded; the best is exchanged per block over ICI."""
+    from ..ops.abc import ABCState
+    from ..ops.pallas.abc_fused import (
+        fused_abc_step_t,
+        host_draws as _abc_host_draws,
+    )
+    from ..ops.pallas.common import ceil_to, cyclic_pad_rows
+    from ..ops.pallas.de_fused import shrink_tile_for_donors
+    from ..ops.pallas.pso_fused import (
+        _auto_tile,
+        best_of_block,
+        run_blocks,
+        seed_base,
+    )
+
+    n, d = state.pos.shape
+    n_dev = mesh.shape[axis]
+    if rng == "host":
+        steps_per_kernel = 1
+    steps_per_kernel = min(steps_per_kernel, 8)    # VMEM (abc_fused)
+    if tile_n is None:
+        tile_n = _auto_tile(ceil_to(max(d, 8), 8))
+    tile_n = min(tile_n, ceil_to(-(-n // n_dev), 128))
+    tile_n, n_pad, n_tiles_local = shrink_tile_for_donors(
+        n, tile_n, per_shard=n_dev
+    )
+
+    pos_t = cyclic_pad_rows(state.pos, n_pad).T
+    fit_t = cyclic_pad_rows(state.fit, n_pad)[None, :]
+    tri_t = cyclic_pad_rows(state.trials, n_pad)[None, :].astype(
+        jnp.int32
+    )
+    seed0 = seed_base(state.key)
+    host_key = jax.random.fold_in(state.key, 0xABC)
+    shift_key = jax.random.fold_in(state.key, 0xAB5)
+    col = P(None, axis)
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(col, col, col, P(), P()),
+        out_specs=(col, col, col, P(), P()),
+        check_vma=False,
+    )
+    def run(pos_t, fit_t, tri_t, best_pos, best_fit):
+        dev = lax.axis_index(axis)
+
+        def block(carry, call_i, k):
+            pos_t, fit_t, tri_t, best_pos, best_fit = carry
+            kk = jax.random.fold_in(
+                jax.random.fold_in(shift_key, call_i), dev
+            )
+            tshift = jax.random.randint(
+                kk, (1,), 1, max(n_tiles_local, 2)
+            )
+            lanes = jax.random.randint(
+                jax.random.fold_in(kk, 1), (2,), 0, tile_n
+            )
+            scalars = jnp.concatenate([
+                jnp.stack(
+                    [seed0 + (call_i * n_dev + dev) * n_tiles_local]
+                ),
+                tshift, lanes,
+            ]).astype(jnp.int32)
+            r_host = None
+            if rng == "host":
+                r_host = _abc_host_draws(
+                    host_key, call_i, pos_t.shape, fit_t.shape,
+                    fold=dev,
+                )
+            pos_t, fit_t, tri_t = fused_abc_step_t(
+                scalars, pos_t, fit_t, tri_t, r_host,
+                objective_name=objective_name, half_width=half_width,
+                limit=limit, tile_n=tile_n, rng=rng,
+                interpret=interpret, k_steps=k,
+            )
+            loc_fit, loc_pos = best_of_block(fit_t, pos_t)
+            best_fit, best_pos = _exchange_best(
+                loc_fit, loc_pos, best_fit, best_pos, dev, axis
+            )
+            return (pos_t, fit_t, tri_t, best_pos, best_fit)
+
+        return run_blocks(
+            block, (pos_t, fit_t, tri_t, best_pos, best_fit),
+            n_steps, steps_per_kernel,
+        )
+
+    pos_t, fit_t, tri_t, best_pos, best_fit = run(
+        pos_t, fit_t, tri_t,
+        state.best_pos.astype(jnp.float32),
+        state.best_fit.astype(jnp.float32),
+    )
+    dt = state.pos.dtype
+    return ABCState(
+        pos=pos_t.T[:n].astype(dt),
+        fit=fit_t[0, :n].astype(state.fit.dtype),
+        trials=tri_t[0, :n].astype(state.trials.dtype),
+        best_pos=best_pos.astype(state.best_pos.dtype),
+        best_fit=best_fit.astype(state.best_fit.dtype),
+        key=jax.random.fold_in(state.key, n_steps),
+        iteration=state.iteration + n_steps,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "mesh", "n_steps", "axis", "half_width",
+        "sigma0", "swap_every", "steps_per_kernel", "tile_n", "rng",
+        "interpret",
+    ),
+)
+def fused_pt_run_shmap(
+    state,
+    objective_name: str,
+    mesh: Mesh,
+    n_steps: int,
+    axis: str = AGENT_AXIS,
+    half_width: float = 5.12,
+    sigma0: float | None = None,
+    swap_every: int | None = None,
+    steps_per_kernel: int = 16,
+    tile_n: int | None = None,
+    rng: str = "tpu",
+    interpret: bool = False,
+):
+    """Multi-chip fused parallel tempering: the geometric ladder is
+    laid out contiguously along lanes and SHARDED over the mesh — each
+    shard holds a contiguous temperature sub-range, exchange stays
+    adjacent-lane within shards (the kernel's tile-local pairing;
+    shard boundaries idle exactly like tile boundaries at odd parity),
+    and the best visited state is exchanged per block over ICI.
+    Phantom pad chains (last shard only) are masked from exchange via
+    the kernel's traced real-lane bound."""
+    from ..ops.pallas.common import ceil_to, cyclic_pad_rows
+    from ..ops.pallas.pso_fused import (
+        _auto_tile,
+        run_blocks,
+        seed_base,
+    )
+    from ..ops.pallas.tempering_fused import (
+        fused_pt_step_t,
+        host_draws as _pt_host_draws,
+    )
+    from ..ops.tempering import (
+        SIGMA0 as _S0,
+        SWAP_EVERY as _SE,
+        PTState,
+    )
+
+    sigma0 = _S0 if sigma0 is None else sigma0
+    swap_every = _SE if swap_every is None else swap_every
+    n, d = state.pos.shape
+    n_dev = mesh.shape[axis]
+    if rng == "host":
+        steps_per_kernel = 1
+    steps_per_kernel = min(steps_per_kernel, 16)
+    if tile_n is None:
+        tile_n = _auto_tile(ceil_to(max(d, 8), 8))
+    tile_n = min(tile_n, ceil_to(-(-n // n_dev), 128))
+    n_pad = ceil_to(n, n_dev * tile_n)
+    shard_w = n_pad // n_dev
+    n_tiles_local = shard_w // tile_n
+
+    pos_t = cyclic_pad_rows(state.pos, n_pad).T
+    fit_t = cyclic_pad_rows(state.fit, n_pad)[None, :]
+    temps_t = cyclic_pad_rows(state.temps, n_pad)[None, :]
+    sigma_t = sigma0 * half_width * jnp.sqrt(temps_t)
+    beta_t = 1.0 / temps_t
+    seed0 = seed_base(state.key)
+    host_key = jax.random.fold_in(state.key, 0x9E)
+    col = P(None, axis)
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(col, col, col, col, P(), P()),
+        out_specs=(col, col, P(), P()),
+        check_vma=False,
+    )
+    def run(pos_t, fit_t, sigma_t, beta_t, best_pos, best_fit):
+        dev = lax.axis_index(axis)
+        n_real_local = _shard_real_count(n, n_dev, shard_w, dev)
+
+        def block(carry, call_i, k):
+            pos_t, fit_t, best_pos, best_fit, it = carry
+            scalars = jnp.stack([
+                seed0 + (call_i * n_dev + dev) * n_tiles_local,
+                it,
+                n_real_local,
+            ]).astype(jnp.int32)
+            rn = ra = rs = None
+            if rng == "host":
+                rn, ra, rs = _pt_host_draws(
+                    host_key, call_i, pos_t.shape, fit_t.shape,
+                    fold=dev,
+                )
+            pos_t, fit_t, blk_fit, blk_pos = fused_pt_step_t(
+                scalars, pos_t, fit_t, sigma_t, beta_t, rn, ra, rs,
+                objective_name=objective_name, half_width=half_width,
+                swap_every=swap_every, tile_n=tile_n, rng=rng,
+                interpret=interpret, k_steps=k,
+            )
+            best_fit, best_pos = _exchange_best(
+                blk_fit[0, 0], blk_pos[:, 0], best_fit, best_pos,
+                dev, axis,
+            )
+            return (pos_t, fit_t, best_pos, best_fit, it + k)
+
+        carry = run_blocks(
+            block, (pos_t, fit_t, best_pos, best_fit, state.iteration),
+            n_steps, steps_per_kernel,
+        )
+        return carry[:4]
+
+    pos_t, fit_t, best_pos, best_fit = run(
+        pos_t, fit_t, sigma_t, beta_t,
+        state.best_pos.astype(jnp.float32),
+        state.best_fit.astype(jnp.float32),
+    )
+    dt = state.pos.dtype
+    return PTState(
+        pos=pos_t.T[:n].astype(dt),
+        fit=fit_t[0, :n].astype(state.fit.dtype),
+        temps=state.temps,
+        best_pos=best_pos.astype(state.best_pos.dtype),
+        best_fit=best_fit.astype(state.best_fit.dtype),
+        key=jax.random.fold_in(state.key, n_steps),
+        iteration=state.iteration + n_steps,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "mesh", "n_steps", "axis", "half_width",
+        "tile_n", "rng", "interpret", "archive_window_frac",
+    ),
+)
+def fused_shade_run_shmap(
+    state,
+    objective_name: str,
+    mesh: Mesh,
+    n_steps: int,
+    axis: str = AGENT_AXIS,
+    half_width: float = 5.12,
+    tile_n: int | None = None,
+    rng: str = "tpu",
+    interpret: bool = False,
+    archive_window_frac: int = 8,
+):
+    """Multi-chip fused SHADE-R: per-shard rotational-donor kernels with
+    the success-history adaptation kept GLOBAL and EXACT — the per-
+    generation weighted success sums are ``psum``'d across shards, so
+    every device updates the same replicated F/CR memory the portable
+    path would.  Donor pools, the tile-champion elite pool, and the
+    archive window stay SHARD-LOCAL between the per-generation best
+    exchanges (island-model lag class, like every fused shmap driver)."""
+    from ..ops.pallas.common import ceil_to, cyclic_pad_rows
+    from ..ops.pallas.de_fused import shrink_tile_for_donors
+    from ..ops.pallas.pso_fused import _auto_tile, seed_base
+    from ..ops.pallas.shade_fused import (
+        _ELITE,
+        _FRAC_FX,
+        _tile_champion_elite,
+        fused_shade_step_t,
+    )
+    from ..ops.shade import CR_SCALE, F_SCALE, H, SHADEState
+
+    n, d = state.pos.shape
+    dt = state.pos.dtype
+    n_dev = mesh.shape[axis]
+    if tile_n is None:
+        tile_n = _auto_tile(ceil_to(max(d, 8), 8))
+    tile_n = min(tile_n, ceil_to(-(-n // n_dev), 128))
+    tile_n, n_pad, n_tiles_local = shrink_tile_for_donors(
+        n, tile_n, per_shard=n_dev
+    )
+    shard_w = n_pad // n_dev
+    win = max(tile_n, shard_w // archive_window_frac)
+    win = min(ceil_to(win, 128), shard_w)
+
+    pos_t = cyclic_pad_rows(state.pos, n_pad).T
+    fit_t = cyclic_pad_rows(state.fit, n_pad)[None, :]
+    row = jnp.arange(n)[:, None]
+    arch_src = jnp.where(row < state.archive_n, state.archive, state.pos)
+    arch_t = cyclic_pad_rows(arch_src, n_pad).T
+    seed0 = seed_base(state.key)
+    base_key = jax.random.fold_in(state.key, 0x5AADE)
+    col = P(None, axis)
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(col, col, col, P(), P(), P(), P(), P()),
+        out_specs=(col, col, col, P(), P(), P(), P(), P()),
+        check_vma=False,
+    )
+    def run(pos_t, fit_t, arch_t, m_f, m_cr, mem_k, best_pos, best_fit):
+        dev = lax.axis_index(axis)
+        n_real_local = _shard_real_count(n, n_dev, shard_w, dev)
+
+        def gen(carry, step_i):
+            (pos_t, fit_t, arch_t, m_f, m_cr, mem_k, best_pos,
+             best_fit) = carry
+            kk = jax.random.fold_in(
+                jax.random.fold_in(base_key, step_i), dev
+            )
+            (k_slot, k_f, k_cr, k_sh, k_ln, k_win, k_hc, k_hs) = (
+                jax.random.split(kk, 8)
+            )
+
+            slot = jax.random.randint(k_slot, (shard_w,), 0, H)
+            mf = m_f[slot]
+            mcr = m_cr[slot]
+            f_i = jnp.clip(
+                mf + F_SCALE * jax.random.cauchy(
+                    k_f, (shard_w,), jnp.float32
+                ),
+                0.01, 1.0,
+            )
+            cr_i = jnp.clip(
+                mcr + CR_SCALE * jax.random.normal(
+                    k_cr, (shard_w,), jnp.float32
+                ),
+                0.0, 1.0,
+            )
+
+            sh = jax.random.randint(
+                k_sh, (3,), 1, max(n_tiles_local, 2)
+            )
+            lanes = jax.random.randint(k_ln, (4,), 0, tile_n)
+            lanes = lanes.at[3].set(
+                jax.random.randint(k_hs, (), 0, _ELITE)
+            )
+            frac = jnp.asarray(0.5 * _FRAC_FX, jnp.int32)
+            scalars = jnp.concatenate([
+                jnp.stack([
+                    seed0 + (step_i * n_dev + dev) * n_tiles_local,
+                    sh[0], sh[1], sh[2],
+                ]),
+                lanes, frac[None],
+            ]).astype(jnp.int32)
+
+            elite = _tile_champion_elite(
+                pos_t, fit_t[0], n_tiles_local, tile_n
+            )
+
+            r_cross = r_src = None
+            if rng == "host":
+                kc1, kc2 = jax.random.split(k_hc)
+                r_cross = jax.random.uniform(
+                    kc1, pos_t.shape, jnp.float32
+                )
+                r_src = jax.random.uniform(
+                    kc2, fit_t.shape, jnp.float32
+                )
+
+            new_pos_t, new_fit_t = fused_shade_step_t(
+                scalars, pos_t, fit_t, f_i[None, :], cr_i[None, :],
+                arch_t, elite, r_cross, r_src,
+                objective_name=objective_name, half_width=half_width,
+                tile_n=tile_n, rng=rng, interpret=interpret,
+            )
+
+            # --- success memory: psum'd, globally exact ---------------
+            valid = jnp.arange(shard_w) < n_real_local
+            better = (new_fit_t[0] < fit_t[0]) & valid
+            w = jnp.where(better, fit_t[0] - new_fit_t[0], 0.0)
+            w_sum = lax.psum(jnp.sum(w), axis)
+            wf2 = lax.psum(jnp.sum(w * f_i * f_i), axis)
+            wf = lax.psum(jnp.sum(w * f_i), axis)
+            wcr = lax.psum(jnp.sum(w * cr_i), axis)
+            any_success = w_sum > 0.0
+            safe = jnp.where(any_success, w_sum, 1.0)
+            new_mf = wf2 / jnp.maximum(wf, 1e-12)
+            new_mcr = wcr / safe
+            m_f = jnp.where(
+                any_success, m_f.at[mem_k].set(new_mf), m_f
+            )
+            m_cr = jnp.where(
+                any_success, m_cr.at[mem_k].set(new_mcr), m_cr
+            )
+            mem_k = jnp.where(
+                any_success, (mem_k + 1) % H, mem_k
+            ).astype(jnp.int32)
+
+            # --- archive: defeated parents, shard-local window --------
+            off = jax.random.randint(k_win, (), 0, shard_w // 128) * 128
+            off = jnp.minimum(off, shard_w - win)
+            par = jax.lax.dynamic_slice(pos_t, (0, off), (d, win))
+            old = jax.lax.dynamic_slice(arch_t, (0, off), (d, win))
+            bet = jax.lax.dynamic_slice(
+                better[None, :], (0, off), (1, win)
+            )
+            arch_t = jax.lax.dynamic_update_slice(
+                arch_t, jnp.where(bet, par, old), (0, off)
+            )
+
+            # --- best exchange ----------------------------------------
+            b = jnp.argmin(new_fit_t[0])
+            best_fit, best_pos = _exchange_best(
+                new_fit_t[0, b], new_pos_t[:, b], best_fit, best_pos,
+                dev, axis,
+            )
+
+            return (
+                new_pos_t, new_fit_t, arch_t, m_f, m_cr, mem_k,
+                best_pos, best_fit,
+            ), None
+
+        carry, _ = jax.lax.scan(
+            gen,
+            (pos_t, fit_t, arch_t, m_f, m_cr, mem_k, best_pos,
+             best_fit),
+            jnp.arange(n_steps, dtype=jnp.int32),
+        )
+        return carry
+
+    (pos_t, fit_t, arch_t, m_f, m_cr, mem_k, best_pos, best_fit) = run(
+        pos_t, fit_t, arch_t,
+        state.m_f.astype(jnp.float32),
+        state.m_cr.astype(jnp.float32),
+        state.mem_k,
+        state.best_pos.astype(jnp.float32),
+        state.best_fit.astype(jnp.float32),
+    )
+    return SHADEState(
+        pos=pos_t.T[:n].astype(dt),
+        fit=fit_t[0, :n].astype(state.fit.dtype),
+        best_pos=best_pos.astype(state.best_pos.dtype),
+        best_fit=best_fit.astype(state.best_fit.dtype),
+        m_f=m_f.astype(state.m_f.dtype),
+        m_cr=m_cr.astype(state.m_cr.dtype),
+        mem_k=mem_k,
+        archive=arch_t.T[:n].astype(state.archive.dtype),
+        archive_n=jnp.asarray(n, jnp.int32),
+        key=jax.random.fold_in(state.key, n_steps),
+        iteration=state.iteration + n_steps,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective", "mesh", "n_steps", "axis", "half_width", "beta0",
+        "gamma", "alpha0", "alpha_decay", "tile_i", "tile_j",
+        "interpret",
+    ),
+)
+def fused_firefly_run_shmap(
+    state,
+    objective: Callable,
+    mesh: Mesh,
+    n_steps: int,
+    axis: str = AGENT_AXIS,
+    half_width: float = 5.12,
+    beta0: float | None = None,
+    gamma: float | None = None,
+    alpha0: float | None = None,
+    alpha_decay: float | None = None,
+    tile_i: int | None = None,
+    tile_j: int | None = None,
+    interpret: bool = False,
+):
+    """Multi-chip tiled firefly: the O(N^2) attraction shards over the
+    row axis — each device runs the RECTANGULAR Pallas kernel (its rows
+    against the per-generation ``all_gather``'d full swarm), so the
+    quadratic FLOPs split n_dev ways while the semantics stay exactly
+    the square kernel's.  Cross-device traffic is one [N, D] gather +
+    one [N] fitness gather per generation plus the best exchange."""
+    from ..ops.firefly import (
+        ALPHA0 as _A0,
+        ALPHA_DECAY as _AD,
+        BETA0 as _B0,
+        GAMMA as _G,
+        FireflyState,
+    )
+    from ..ops.pallas.firefly_fused import (
+        DEFAULT_TILE_I,
+        DEFAULT_TILE_J,
+        firefly_attraction_pallas,
+    )
+
+    beta0 = _B0 if beta0 is None else beta0
+    gamma = _G if gamma is None else gamma
+    alpha0 = _A0 if alpha0 is None else alpha0
+    alpha_decay = _AD if alpha_decay is None else alpha_decay
+    tile_i = DEFAULT_TILE_I if tile_i is None else tile_i
+    tile_j = DEFAULT_TILE_J if tile_j is None else tile_j
+    n, d = state.pos.shape
+    dt = state.pos.dtype
+    n_dev = mesh.shape[axis]
+    n_pad = pad_to_devices(n, n_dev)
+    shard_w = n_pad // n_dev
+
+    # Row padding with +inf fitness: never brighter, zero weight.
+    pos_p = jnp.zeros((n_pad, d), jnp.float32).at[:n].set(
+        state.pos.astype(jnp.float32)
+    )
+    fit_p = jnp.full((n_pad,), jnp.inf, jnp.float32).at[:n].set(
+        state.fit.astype(jnp.float32)
+    )
+    rows = P(axis)
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(rows, rows, P(), P(), P(), P()),
+        out_specs=(rows, rows, P(), P()),
+        check_vma=False,
+    )
+    def run(pos_l, fit_l, best_pos, best_fit, key, it0):
+        dev = lax.axis_index(axis)
+
+        def gen(carry, step_i):
+            pos_l, fit_l, best_pos, best_fit = carry
+            kr = jax.random.fold_in(
+                jax.random.fold_in(key, step_i), dev
+            )
+            full_pos = lax.all_gather(pos_l, axis).reshape(-1, d)
+            full_fit = lax.all_gather(fit_l, axis).reshape(-1)
+            move = firefly_attraction_pallas(
+                pos_l, fit_l, beta0, gamma, tile_i, tile_j, interpret,
+                pos_j=full_pos, fit_j=full_fit,
+            )
+            alpha_t = alpha0 * jnp.power(
+                jnp.asarray(alpha_decay, jnp.float32),
+                (it0 + step_i).astype(jnp.float32),
+            )
+            noise = alpha_t * (
+                jax.random.uniform(kr, pos_l.shape, jnp.float32) - 0.5
+            ) * (2.0 * half_width)
+            pos_l = jnp.clip(
+                pos_l + move + noise, -half_width, half_width
+            )
+            fit_l = objective(pos_l).astype(jnp.float32)
+            # keep pad rows dark so they never attract anyone
+            gcol = dev * shard_w + jnp.arange(shard_w)
+            fit_l = jnp.where(gcol < n, fit_l, jnp.inf)
+            b = jnp.argmin(fit_l)
+            best_fit, best_pos = _exchange_best(
+                fit_l[b], pos_l[b], best_fit, best_pos, dev, axis
+            )
+            return (pos_l, fit_l, best_pos, best_fit), None
+
+        carry, _ = jax.lax.scan(
+            gen, (pos_l, fit_l, best_pos, best_fit),
+            jnp.arange(n_steps, dtype=jnp.int32),
+        )
+        return carry
+
+    pos_p, fit_p, best_pos, best_fit = run(
+        pos_p, fit_p,
+        state.best_pos.astype(jnp.float32),
+        state.best_fit.astype(jnp.float32),
+        state.key, state.iteration,
+    )
+    return FireflyState(
+        pos=pos_p[:n].astype(dt),
+        fit=fit_p[:n].astype(state.fit.dtype),
+        best_pos=best_pos.astype(state.best_pos.dtype),
+        best_fit=best_fit.astype(state.best_fit.dtype),
+        key=jax.random.fold_in(state.key, n_steps),
+        iteration=state.iteration + n_steps,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "objective_name", "mesh", "n_steps", "axis", "migrate_every",
+        "migrate_k", "w", "c1", "c2", "half_width", "vmax_frac",
+        "tile_n", "rng", "interpret", "steps_per_kernel",
+    ),
+)
+def fused_island_run_shmap(
+    state,
+    objective_name: str,
+    mesh: Mesh,
+    n_steps: int,
+    axis: str = AGENT_AXIS,
+    migrate_every: int = 25,
+    migrate_k: int = 4,
+    w: float | None = None,
+    c1: float | None = None,
+    c2: float | None = None,
+    half_width: float = 5.12,
+    vmax_frac: float = 0.5,
+    tile_n: int | None = None,
+    rng: str = "tpu",
+    interpret: bool = False,
+    steps_per_kernel: int = 8,
+):
+    """Multi-chip fused island PSO: the ISLAND axis shards over the
+    mesh (requires islands % devices == 0) — each device runs the
+    single-chip fused island block (ops/pallas/islands_fused.py) on
+    its islands, and ring migration stays GLOBALLY EXACT: the
+    within-shard ``jnp.roll`` of emigrant packs composes with one
+    ``ppermute`` of the boundary pack to the next device, the same
+    ring the portable islands path uses."""
+    from ..ops.pallas.common import ceil_to
+    from ..ops.pallas.islands_fused import (
+        _island_gbest_update,
+        _islands_step_t,
+        _migrate_t,
+    )
+    from ..ops.pallas.pso_fused import (
+        _auto_tile,
+        host_uniforms,
+        run_blocks,
+        seed_base,
+    )
+    from ..ops.pso import C1 as _C1, C2 as _C2, W as _W
+
+    w = _W if w is None else w
+    c1 = _C1 if c1 is None else c1
+    c2 = _C2 if c2 is None else c2
+    pso = state.pso
+    n_i, n, d = pso.pos.shape
+    n_dev = mesh.shape[axis]
+    if n_i % n_dev:
+        raise ValueError(
+            f"islands ({n_i}) must divide over devices ({n_dev})"
+        )
+    i_local = n_i // n_dev
+    if rng == "host":
+        steps_per_kernel = 1
+    if tile_n is None:
+        tile_n = _auto_tile(ceil_to(max(d, 8), 8))
+    tile_n = min(tile_n, ceil_to(n, 128))
+    n_l = ceil_to(n, tile_n)
+    tpi = n_l // tile_n
+    reps = -(-n_l // n)
+
+    def prep(x_ind):                          # [I, n, D] -> [D, I*n_l]
+        x = x_ind.astype(jnp.float32)
+        if n_l != n:
+            x = jnp.tile(x, (1, reps, 1))[:, :n_l]
+        return x.reshape(n_i * n_l, d).T
+
+    pos_t = prep(pso.pos)
+    vel_t = prep(pso.vel)
+    bpos_t = prep(pso.pbest_pos)
+    bfit = pso.pbest_fit.astype(jnp.float32)
+    if n_l != n:
+        bfit = jnp.tile(bfit, (1, reps))[:, :n_l]
+    bfit_t = bfit.reshape(1, n_i * n_l)
+
+    gpos_ti = pso.gbest_pos.astype(jnp.float32).T          # [D, I]
+    gfit_i = pso.gbest_fit.astype(jnp.float32)             # [I]
+
+    stacked_keys = pso.key.ndim == 2
+    base_key = pso.key[0] if stacked_keys else pso.key
+    seed0 = seed_base(base_key)
+    host_key = jax.random.fold_in(base_key, 0x15AD)
+    n_tiles_local = i_local * tpi
+    blocks_per_migration = max(1, migrate_every // steps_per_kernel)
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+    col = P(None, axis)
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(col, col, col, col, col, P(axis)),
+        out_specs=(col, col, col, col, col, P(axis)),
+        check_vma=False,
+    )
+    def run(pos_t, vel_t, bpos_t, bfit_t, gpos_ti, gfit_i):
+        dev = lax.axis_index(axis)
+
+        def ring_shift(em_pos, em_fit):
+            # within-shard roll puts local island j-1's pack at j; the
+            # pack now sitting at local island 0 (the shard's LAST
+            # island's emigrants) is what the NEXT device's island 0
+            # must receive — swap it over the device ring.
+            rolled_pos = jnp.roll(em_pos, 1, axis=1)
+            rolled_fit = jnp.roll(em_fit, 1, axis=0)
+            recv_pos = lax.ppermute(rolled_pos[:, 0:1], axis, perm)
+            recv_fit = lax.ppermute(rolled_fit[0:1], axis, perm)
+            return (
+                rolled_pos.at[:, 0:1].set(recv_pos),
+                rolled_fit.at[0:1].set(recv_fit),
+            )
+
+        def block(carry, call_i, k):
+            pos_t, vel_t, bpos_t, bfit_t, gpos_ti, gfit_i = carry
+            seed = seed0 + (call_i * n_dev + dev) * n_tiles_local
+            r1 = r2 = None
+            if rng == "host":
+                r1, r2 = host_uniforms(
+                    host_key, call_i, pos_t.shape, fold=dev
+                )
+            pos_t, vel_t, bpos_t, bfit_t = _islands_step_t(
+                seed, gpos_ti, pos_t, vel_t, bpos_t, bfit_t, r1, r2,
+                objective_name=objective_name, w=w, c1=c1, c2=c2,
+                half_width=half_width, vmax_frac=vmax_frac,
+                tile_n=tile_n, tiles_per_island=tpi, rng=rng,
+                interpret=interpret, k_steps=k,
+            )
+
+            due = (call_i + 1) % blocks_per_migration == 0
+
+            def do_migrate(args):
+                return _migrate_t(
+                    *args, migrate_k, i_local, n_l, n_real=n,
+                    shift_fn=ring_shift,
+                )
+
+            def no_migrate(args):
+                # collectives must run on every branch-free path: the
+                # ppermute inside do_migrate is manifest only when due,
+                # and lax.cond with collectives requires both branches
+                # shard-uniform — `due` is trace-level uniform (same
+                # call_i on every shard), so this is safe.
+                return args
+
+            pos_t, vel_t, bpos_t, bfit_t = jax.lax.cond(
+                due, do_migrate, no_migrate,
+                (pos_t, vel_t, bpos_t, bfit_t),
+            )
+            gpos_ti, gfit_i = _island_gbest_update(
+                bfit_t, bpos_t, gpos_ti, gfit_i, i_local, n_l
+            )
+            return (pos_t, vel_t, bpos_t, bfit_t, gpos_ti, gfit_i)
+
+        return run_blocks(
+            block,
+            (pos_t, vel_t, bpos_t, bfit_t, gpos_ti, gfit_i),
+            n_steps, steps_per_kernel,
+        )
+
+    pos_t, vel_t, bpos_t, bfit_t, gpos_ti, gfit_i = run(
+        pos_t, vel_t, bpos_t, bfit_t, gpos_ti, gfit_i
+    )
+    dt = pso.pos.dtype
+
+    def back(x_t):                            # [D, I*n_l] -> [I, n, D]
+        return x_t.T.reshape(n_i, n_l, d)[:, :n].astype(dt)
+
+    new_keys = (
+        jax.vmap(lambda kk: jax.random.fold_in(kk, n_steps))(pso.key)
+        if stacked_keys
+        else jax.random.fold_in(pso.key, n_steps)
+    )
+    return state.replace(
+        pso=pso.replace(
+            pos=back(pos_t),
+            vel=back(vel_t),
+            pbest_pos=back(bpos_t),
+            pbest_fit=bfit_t.reshape(n_i, n_l)[:, :n].astype(
+                pso.pbest_fit.dtype
+            ),
+            gbest_pos=gpos_ti.T.astype(pso.gbest_pos.dtype),
+            gbest_fit=gfit_i.astype(pso.gbest_fit.dtype),
+            key=new_keys,
+            iteration=pso.iteration + n_steps,
+        ),
+        iteration=state.iteration + n_steps,
+    )

@@ -1,0 +1,191 @@
+"""r3 multi-chip fused drivers (parallel/sharding.py): cuckoo, HHO,
+MFO, salp, GA, ABC, PT run per-shard fused kernels on the 8-virtual-
+device CPU mesh (interpret + host RNG) with per-block ICI best
+exchange.  Each case checks shape/iteration contracts, convergence,
+and determinism; mirrors test_pallas_de.py::test_fused_de_shmap_multichip."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_swarm_algorithm_tpu.ops.abc import abc_init
+from distributed_swarm_algorithm_tpu.ops.cuckoo import cuckoo_init
+from distributed_swarm_algorithm_tpu.ops.ga import ga_init
+from distributed_swarm_algorithm_tpu.ops.hho import hho_init
+from distributed_swarm_algorithm_tpu.ops.mfo import mfo_init
+from distributed_swarm_algorithm_tpu.ops.objectives import sphere
+from distributed_swarm_algorithm_tpu.ops.salp import salp_init
+from distributed_swarm_algorithm_tpu.ops.tempering import pt_init
+from distributed_swarm_algorithm_tpu.parallel.mesh import make_mesh
+from distributed_swarm_algorithm_tpu.parallel import sharding as sh
+
+N = 8192          # 8 devices x 4+ lane tiles of 128
+D = 5
+STEPS = 40
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh()
+
+
+def _check(out, st, n=N, tol=1.0):
+    assert out.pos.shape == (n, D)
+    assert int(out.iteration) == int(st.iteration) + STEPS
+    assert np.isfinite(float(out.best_fit))
+    assert float(out.best_fit) <= float(st.best_fit) + 1e-6
+    assert float(out.best_fit) < tol
+
+
+def test_fused_cuckoo_shmap(mesh):
+    st = cuckoo_init(sphere, N, D, 5.12, seed=0)
+    out = sh.fused_cuckoo_run_shmap(
+        st, "sphere", mesh, STEPS, rng="host", interpret=True
+    )
+    _check(out, st)
+    out2 = sh.fused_cuckoo_run_shmap(
+        st, "sphere", mesh, STEPS, rng="host", interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(out.pos),
+                                  np.asarray(out2.pos))
+
+
+def test_fused_hho_shmap(mesh):
+    st = hho_init(sphere, N, D, 5.12, seed=0)
+    out = sh.fused_hho_run_shmap(
+        st, "sphere", mesh, STEPS, rng="host", interpret=True
+    )
+    _check(out, st)
+
+
+def test_fused_mfo_shmap(mesh):
+    st = mfo_init(sphere, N, D, 5.12, seed=0)
+    out = sh.fused_mfo_run_shmap(
+        st, "sphere", mesh, STEPS, rng="host", interpret=True
+    )
+    assert out.pos.shape == (N, D)
+    assert int(out.iteration) == STEPS
+    # flame memory is elitist per shard: global best flame <= any moth
+    assert float(out.flame_fit.min()) <= float(out.fit.min()) + 1e-6
+    assert float(out.flame_fit.min()) < 1.0
+
+
+def test_fused_salp_shmap(mesh):
+    st = salp_init(sphere, N, D, 5.12, seed=0)
+    out = sh.fused_salp_run_shmap(
+        st, "sphere", mesh, STEPS, rng="host", interpret=True
+    )
+    _check(out, st, tol=5.0)   # salp converges slower at few steps
+
+
+def test_fused_ga_shmap(mesh):
+    st = ga_init(sphere, N, D, 5.12, seed=0)
+    out = sh.fused_ga_run_shmap(
+        st, "sphere", mesh, STEPS, rng="host", interpret=True
+    )
+    _check(out, st)
+
+
+def test_fused_abc_shmap(mesh):
+    st = abc_init(sphere, N, D, 5.12, seed=0)
+    out = sh.fused_abc_run_shmap(
+        st, "sphere", mesh, STEPS, rng="host", interpret=True
+    )
+    _check(out, st)
+    assert out.trials.shape == (N,)
+    assert int(out.trials.min()) >= 0
+
+
+def test_fused_pt_shmap(mesh):
+    st = pt_init(sphere, N, D, 5.12, seed=0)
+    out = sh.fused_pt_run_shmap(
+        st, "sphere", mesh, STEPS, rng="host", interpret=True
+    )
+    _check(out, st, tol=5.0)   # Metropolis at 40 steps is coarse
+    np.testing.assert_array_equal(np.asarray(out.temps),
+                                  np.asarray(st.temps))
+
+
+def test_non_aligned_population_pads(mesh):
+    st = cuckoo_init(sphere, 8200, D, 5.12, seed=1)   # not 8-divisible
+    out = sh.fused_cuckoo_run_shmap(
+        st, "sphere", mesh, 10, rng="host", interpret=True
+    )
+    assert out.pos.shape == (8200, D)
+
+
+def test_fused_shade_shmap(mesh):
+    from distributed_swarm_algorithm_tpu.ops.shade import shade_init
+
+    st = shade_init(sphere, N, D, 5.12, seed=0)
+    out = sh.fused_shade_run_shmap(
+        st, "sphere", mesh, STEPS, rng="host", interpret=True
+    )
+    _check(out, st)
+    # replicated success memory stays finite and in range
+    assert bool(jnp.isfinite(out.m_f).all())
+    assert bool((out.m_cr >= 0).all()) and bool((out.m_cr <= 1).all())
+
+
+def test_fused_firefly_shmap(mesh):
+    from distributed_swarm_algorithm_tpu.ops.firefly import firefly_init
+
+    n = 1024                       # O(N^2) family: keep the test light
+    st = firefly_init(sphere, n, D, 5.12, seed=0)
+    out = sh.fused_firefly_run_shmap(
+        st, sphere, mesh, 20, interpret=True
+    )
+    assert out.pos.shape == (n, D)
+    assert int(out.iteration) == 20
+    assert float(out.best_fit) <= float(st.best_fit) + 1e-6
+
+
+def test_fused_firefly_shmap_matches_single_chip(mesh):
+    """The sharded rectangular attraction must reproduce the square
+    single-chip kernel: same rule, same RNG stream shape — compare
+    one generation's move against the single-chip fused path on the
+    same state (noise differs only through the dev fold, so compare
+    the deterministic attraction component via alpha0=0)."""
+    from distributed_swarm_algorithm_tpu.ops.firefly import firefly_init
+    from distributed_swarm_algorithm_tpu.ops.pallas.firefly_fused import (
+        fused_firefly_run,
+    )
+
+    n = 512
+    st = firefly_init(sphere, n, D, 5.12, seed=3)
+    a = sh.fused_firefly_run_shmap(
+        st, sphere, mesh, 5, alpha0=0.0, interpret=True
+    )
+    b = fused_firefly_run(st, sphere, 5, alpha0=0.0, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(a.pos), np.asarray(b.pos), atol=2e-4
+    )
+
+
+def test_fused_islands_shmap(mesh):
+    from distributed_swarm_algorithm_tpu.parallel.islands import (
+        island_init,
+    )
+
+    st = island_init(sphere, n_islands=8, n_per_island=512, dim=D,
+                     half_width=5.12, seed=0)
+    out = sh.fused_island_run_shmap(
+        st, "sphere", mesh, 50, migrate_every=16, rng="host",
+        interpret=True,
+    )
+    assert out.pso.pos.shape == (8, 512, D)
+    assert int(out.iteration) == 50
+    assert float(out.pso.gbest_fit.min()) < 1.0
+
+
+def test_fused_islands_shmap_rejects_bad_split(mesh):
+    from distributed_swarm_algorithm_tpu.parallel.islands import (
+        island_init,
+    )
+
+    st = island_init(sphere, n_islands=6, n_per_island=256, dim=D,
+                     half_width=5.12, seed=0)
+    with pytest.raises(ValueError, match="devices"):
+        sh.fused_island_run_shmap(
+            st, "sphere", mesh, 10, rng="host", interpret=True
+        )

@@ -11,12 +11,24 @@ observations make it fusable:
      the shrinking flame count) shares the single last flame, which the
      driver extracts once per block and passes lane-broadcast like a
      gbest operand.
-  2. **The elitist memory tolerates cadence** — flames are the best-N
-     multiset ever seen; refreshing the merge-sort once per
-     ``steps_per_kernel`` block (with the schedule scalars n_flames and
-     the l-range frozen at block start) amortizes the sort+gathers by
-     k while keeping the memory exact at block granularity — the same
-     delayed-global trade as the GWO leader refresh.
+  2. **The elitist memory splits into a fast positional part and a
+     slow ordering part** (r3 — this broke the r2 sort ceiling).  r2
+     re-sorted (flames ++ moths) on the host every block: one
+     length-2N argsort (~109 ms at 1M) plus a [D, 2N] column gather
+     (~114 ms) per 8 steps — ~90% of the runtime, pinning MFO at
+     114-121M moth-steps/s.  The r3 kernel keeps the flame arrays in
+     VMEM and updates them PER STEP, positionally:
+     ``flame_i = better_of(flame_i, moth_i)`` — elementwise, no sort,
+     and *stronger* elitism granularity than r2's block cadence
+     (every step, not every 8).  What this loses is the global RANK
+     ordering (best moth no longer migrates to flame slot 0); the
+     driver restores it with a full fitness re-sort of the N flames
+     every ``sort_blocks`` blocks (default 8 blocks = 64 steps at
+     spk 8), so the pairing order decays only between re-sorts.  The
+     clamp flame (shared by moths past the shrinking n_flames count)
+     and the l-range schedule stay frozen per block as in r2.
+     Measured: 114-121M → see docs/PERFORMANCE.md (≥3x, VERDICT r2
+     item 3).  Convergence stays gated by mfo_tpu_prng.
 
 The spiral ``exp(b l) cos(2 pi l)`` runs through the shared fast-math
 primitives (firefly's 2^t construction + the cos polynomial).  Host-RNG
@@ -52,10 +64,11 @@ def mfo_pallas_supported(objective_name, dtype) -> bool:
 
 
 def _make_kernel(objective_t, half_width, b, host_rng, k_steps, tile_n):
-    def body(scalar_ref, last_ref, pos_ref, flame_ref, r_l, pos_o,
-             fit_o):
+    def body(scalar_ref, last_ref, pos_ref, flame_ref, ffit_ref, r_l,
+             pos_o, fit_o, fpos_o, ffit_o):
         pos = pos_ref[:]
         flames = flame_ref[:]                      # [D, T] positional
+        ffit = ffit_ref[:]                         # [1, T]
         last = last_ref[:][:, 0:1]                 # [D, 1] clamp flame
         n_flames = scalar_ref[1]
         r_lo = scalar_ref[2].astype(jnp.float32) / 65536.0  # fixed-point
@@ -64,30 +77,40 @@ def _make_kernel(objective_t, half_width, b, host_rng, k_steps, tile_n):
             jnp.int32, (1, pos.shape[1]), 1
         ) + pl.program_id(0) * tile_n
         own = col < n_flames                       # [1, T] mask
-        flame = jnp.where(own, flames, last)
 
+        mfit = objective_t(pos)                    # defined for k=0
         for step in range(k_steps):
             if host_rng:
                 u = r_l
             else:
                 u = _uniform_bits(pos.shape)
             l = u * (1.0 - r_lo) + r_lo            # U(r, 1)
+            flame = jnp.where(own, flames, last)
             dist = jnp.abs(flame - pos)
             pos = dist * exp2_fast(b * l * _LOG2E) * _cos2pi(l) + flame
             pos = jnp.clip(pos, -half_width, half_width)
+            mfit = objective_t(pos)
+            # per-step positional elitism: slot i keeps its best visitor
+            better = mfit < ffit
+            flames = jnp.where(better, pos, flames)
+            ffit = jnp.where(better, mfit, ffit)
 
         pos_o[:] = pos
-        fit_o[:] = objective_t(pos)
+        fit_o[:] = mfit
+        fpos_o[:] = flames
+        ffit_o[:] = ffit
 
     if host_rng:
-        def kernel(scalar_ref, last_ref, pos_ref, flame_ref, rl_ref,
-                   *outs):
-            body(scalar_ref, last_ref, pos_ref, flame_ref, rl_ref[:],
-                 *outs)
+        def kernel(scalar_ref, last_ref, pos_ref, flame_ref, ffit_ref,
+                   rl_ref, *outs):
+            body(scalar_ref, last_ref, pos_ref, flame_ref, ffit_ref,
+                 rl_ref[:], *outs)
     else:
-        def kernel(scalar_ref, last_ref, pos_ref, flame_ref, *outs):
+        def kernel(scalar_ref, last_ref, pos_ref, flame_ref, ffit_ref,
+                   *outs):
             pltpu.prng_seed(scalar_ref[0] + pl.program_id(0))
-            body(scalar_ref, last_ref, pos_ref, flame_ref, None, *outs)
+            body(scalar_ref, last_ref, pos_ref, flame_ref, ffit_ref,
+                 None, *outs)
 
     return kernel
 
@@ -103,7 +126,8 @@ def fused_mfo_step_t(
     scalars: jax.Array,       # [3] i32: seed, n_flames, r_lo (fx 16.16)
     last_flame: jax.Array,    # [D, 1]
     pos: jax.Array,           # [D, N]
-    flames: jax.Array,        # [D, N] sorted, positional pairing
+    flames: jax.Array,        # [D, N] positional pairing
+    flame_fit: jax.Array,     # [1, N]
     r_l: jax.Array | None = None,   # [D, N] uniforms (host rng)
     *,
     objective_name: str,
@@ -113,8 +137,9 @@ def fused_mfo_step_t(
     rng: str = "tpu",
     interpret: bool = False,
     k_steps: int = 1,
-) -> Tuple[jax.Array, jax.Array]:
-    """``k_steps`` fused MFO spiral flights; returns ``(pos, fit)``."""
+) -> Tuple[jax.Array, ...]:
+    """``k_steps`` fused MFO spiral flights with per-step positional
+    flame elitism; returns ``(pos, fit, flames, flame_fit)``."""
     d, n = pos.shape
     if n % tile_n:
         raise ValueError(f"N ({n}) must be a multiple of tile_n ({tile_n})")
@@ -137,9 +162,11 @@ def fused_mfo_step_t(
 
     in_specs = [
         pl.BlockSpec((d, 128), fixed, memory_space=pltpu.VMEM),
-        dn, dn,
+        dn, dn, ft,
     ]
-    operands = [jnp.broadcast_to(last_flame, (d, 128)), pos, flames]
+    operands = [
+        jnp.broadcast_to(last_flame, (d, 128)), pos, flames, flame_fit,
+    ]
     if host_rng:
         in_specs.append(dn)
         operands.append(r_l)
@@ -148,12 +175,14 @@ def fused_mfo_step_t(
         num_scalar_prefetch=1,
         grid=(n_tiles,),
         in_specs=in_specs,
-        out_specs=[dn, ft],
+        out_specs=[dn, ft, dn, ft],
     )
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=[
+            jax.ShapeDtypeStruct((d, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
             jax.ShapeDtypeStruct((d, n), jnp.float32),
             jax.ShapeDtypeStruct((1, n), jnp.float32),
         ],
@@ -166,6 +195,7 @@ def fused_mfo_step_t(
     static_argnames=(
         "objective_name", "n_steps", "half_width", "t_max", "b",
         "tile_n", "rng", "interpret", "steps_per_kernel",
+        "sort_blocks",
     ),
 )
 def fused_mfo_run(
@@ -179,10 +209,13 @@ def fused_mfo_run(
     rng: str = "tpu",
     interpret: bool = False,
     steps_per_kernel: int = 8,
+    sort_blocks: int = 8,
 ) -> MFOState:
     """``n_steps`` fused MFO generations — MFOState in/out, drop-in
-    fast path for ``ops.mfo.mfo_run`` (block-cadence flame refresh and
-    block-frozen schedule scalars; see the module docstring)."""
+    fast path for ``ops.mfo.mfo_run``.  Flame elitism is per-step and
+    positional inside the kernel; the global rank re-sort runs every
+    ``sort_blocks`` blocks (see the module docstring for the r3
+    split)."""
     n, d = state.pos.shape
     if rng == "host":
         steps_per_kernel = 1
@@ -214,6 +247,11 @@ def fused_mfo_run(
     host_key = jax.random.fold_in(state.key, 0x3F0)
     n_tiles = n_pad // tile_n
 
+    def resort(flame_pos_t, flame_fit):
+        """Restore global rank order (best flame first)."""
+        order = jnp.argsort(flame_fit)
+        return flame_pos_t[:, order], flame_fit[order]
+
     def block(carry, call_i, k):
         pos_t, fit_t, flame_pos_t, flame_fit, it = carry
         t = (it + 1).astype(jnp.float32)
@@ -234,20 +272,20 @@ def fused_mfo_run(
                 jax.random.fold_in(host_key, call_i), pos_t.shape,
                 jnp.float32,
             )
-        pos_t, fit_t = fused_mfo_step_t(
-            scalars, last, pos_t, flame_pos_t, r_l,
+        pos_t, fit_t, flame_pos_t, flame_fit_row = fused_mfo_step_t(
+            scalars, last, pos_t, flame_pos_t, flame_fit[None, :], r_l,
             objective_name=objective_name, half_width=half_width, b=b,
             tile_n=tile_n, rng=rng, interpret=interpret, k_steps=k,
         )
-        # Elitist flame refresh at block cadence: best n_pad of
-        # (flames ++ moths), sorted ascending (pad flames carry +inf
-        # fitness contributions only from the pad moths' duplicated
-        # rows — legal members, so the multiset invariant holds).
-        all_fit = jnp.concatenate([flame_fit, fit_t[0]])
-        all_pos = jnp.concatenate([flame_pos_t, pos_t], axis=1)
-        order = jnp.argsort(all_fit)[:n_pad]
-        flame_fit = all_fit[order]
-        flame_pos_t = all_pos[:, order]
+        flame_fit = flame_fit_row[0]
+        # Rank re-sort at sort_blocks cadence (the multiset is already
+        # elitist from the in-kernel positional updates).
+        flame_pos_t, flame_fit = jax.lax.cond(
+            (call_i + 1) % sort_blocks == 0,
+            lambda a: resort(*a),
+            lambda a: a,
+            (flame_pos_t, flame_fit),
+        )
         return (pos_t, fit_t, flame_pos_t, flame_fit, it + k)
 
     carry = run_blocks(
@@ -256,6 +294,8 @@ def fused_mfo_run(
         n_steps, steps_per_kernel,
     )
     pos_t, fit_t, flame_pos_t, flame_fit, _ = carry
+    # Hand back rank-ordered flames (the portable contract).
+    flame_pos_t, flame_fit = resort(flame_pos_t, flame_fit)
     dt = state.pos.dtype
     return MFOState(
         pos=pos_t.T[:n].astype(dt),

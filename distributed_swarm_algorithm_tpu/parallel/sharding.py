@@ -1334,17 +1334,26 @@ def fused_mfo_run_shmap(
                     ),
                     pos_t.shape, jnp.float32,
                 )
-            pos_t, fit_t = fused_mfo_step_t(
-                scalars, last, pos_t, flame_pos_t, r_l,
+            pos_t, fit_t, flame_pos_t, ffit_row = fused_mfo_step_t(
+                scalars, last, pos_t, flame_pos_t, flame_fit[None, :],
+                r_l,
                 objective_name=objective_name,
                 half_width=half_width, b=b, tile_n=tile_n, rng=rng,
                 interpret=interpret, k_steps=k,
             )
-            all_fit = jnp.concatenate([flame_fit, fit_t[0]])
-            all_pos = jnp.concatenate([flame_pos_t, pos_t], axis=1)
-            order = jnp.argsort(all_fit)[:shard_w]
-            flame_fit = all_fit[order]
-            flame_pos_t = all_pos[:, order]
+            flame_fit = ffit_row[0]
+            # shard-local rank re-sort at the same cadence as the
+            # single-chip driver (per-step positional elitism happens
+            # in-kernel; see mfo_fused's r3 docstring)
+            def _resort(a):
+                fp, ff = a
+                order = jnp.argsort(ff)
+                return fp[:, order], ff[order]
+
+            flame_pos_t, flame_fit = jax.lax.cond(
+                (call_i + 1) % 8 == 0, _resort, lambda a: a,
+                (flame_pos_t, flame_fit),
+            )
             return (pos_t, fit_t, flame_pos_t, flame_fit, it + k)
 
         carry = run_blocks(
@@ -1354,7 +1363,11 @@ def fused_mfo_run_shmap(
             n_steps, steps_per_kernel,
         )
         pos_t, fit_t, flame_pos_t, flame_fit, _ = carry
-        return pos_t, fit_t, flame_pos_t, flame_fit[None, :]
+        order = jnp.argsort(flame_fit)
+        return (
+            pos_t, fit_t, flame_pos_t[:, order],
+            flame_fit[order][None, :],
+        )
 
     pos_t, fit_t, flame_pos_t, flame_fit = run(
         pos_t, fit_t, flame_pos_t, flame_fit

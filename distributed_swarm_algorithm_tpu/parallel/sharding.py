@@ -1235,6 +1235,7 @@ def fused_hho_run_shmap(
     static_argnames=(
         "objective_name", "mesh", "n_steps", "axis", "half_width",
         "t_max", "b", "steps_per_kernel", "tile_n", "rng", "interpret",
+        "sort_blocks",
     ),
 )
 def fused_mfo_run_shmap(
@@ -1250,17 +1251,22 @@ def fused_mfo_run_shmap(
     tile_n: int | None = None,
     rng: str = "tpu",
     interpret: bool = False,
+    sort_blocks: int = 8,
 ):
     """Multi-chip fused MFO: positional-flame blocks per shard
-    (ops/pallas/mfo_fused.py) with a SHARD-LOCAL flame memory — each
-    shard sorts (flames ++ moths) over its own lanes at block cadence,
-    the island-model trade (global elitism would need a cross-device
-    sort; the shards still couple through nothing else, exactly like
-    the portable island model over MFO).  The flame-count schedule
-    runs on the shard width."""
+    (ops/pallas/mfo_fused.py) with a SHARD-LOCAL flame memory — flame
+    slots update per step in-kernel (positional elitism, r3 split)
+    and each shard re-sorts its own N-local flames by fitness every
+    ``sort_blocks`` blocks, the island-model trade (global rank order
+    would need a cross-device sort; the shards couple through nothing
+    else, exactly like the portable island model over MFO).  The
+    flame-count schedule runs on the shard width."""
     from ..ops.mfo import SPIRAL_B as _SB, T_MAX as _TM, MFOState
     from ..ops.pallas.common import ceil_to, cyclic_pad_rows
-    from ..ops.pallas.mfo_fused import fused_mfo_step_t
+    from ..ops.pallas.mfo_fused import (
+        fused_mfo_step_t,
+        resort_flames as _mfo_resort,
+    )
     from ..ops.pallas.pso_fused import (
         _auto_tile,
         run_blocks,
@@ -1342,16 +1348,12 @@ def fused_mfo_run_shmap(
                 interpret=interpret, k_steps=k,
             )
             flame_fit = ffit_row[0]
-            # shard-local rank re-sort at the same cadence as the
-            # single-chip driver (per-step positional elitism happens
-            # in-kernel; see mfo_fused's r3 docstring)
-            def _resort(a):
-                fp, ff = a
-                order = jnp.argsort(ff)
-                return fp[:, order], ff[order]
-
+            # shard-local rank re-sort on the shared sort_blocks
+            # cadence (per-step positional elitism happens in-kernel;
+            # see mfo_fused's r3 docstring)
             flame_pos_t, flame_fit = jax.lax.cond(
-                (call_i + 1) % 8 == 0, _resort, lambda a: a,
+                (call_i + 1) % sort_blocks == 0,
+                lambda a: _mfo_resort(*a), lambda a: a,
                 (flame_pos_t, flame_fit),
             )
             return (pos_t, fit_t, flame_pos_t, flame_fit, it + k)
@@ -1363,11 +1365,8 @@ def fused_mfo_run_shmap(
             n_steps, steps_per_kernel,
         )
         pos_t, fit_t, flame_pos_t, flame_fit, _ = carry
-        order = jnp.argsort(flame_fit)
-        return (
-            pos_t, fit_t, flame_pos_t[:, order],
-            flame_fit[order][None, :],
-        )
+        flame_pos_t, flame_fit = _mfo_resort(flame_pos_t, flame_fit)
+        return pos_t, fit_t, flame_pos_t, flame_fit[None, :]
 
     pos_t, fit_t, flame_pos_t, flame_fit = run(
         pos_t, fit_t, flame_pos_t, flame_fit

@@ -1,5 +1,5 @@
 """Fused Pallas MFO kernel (ops/pallas/mfo_fused.py): positional flame
-pairing, block-cadence elitist refresh, model backend switch.
+pairing, per-step positional flame elitism + cadenced rank re-sort, model backend switch.
 Interpret mode on CPU with host RNG, like the siblings."""
 
 import jax.numpy as jnp

@@ -87,16 +87,18 @@ def sampled_recall(pos, window, cell, seed=0, chunk=256, rank=None):
     return captured / max(total, 1), total
 
 
-def force_rel_err(pos, window, cell, presorted=False, exact=None):
+def force_rel_err(pos, window, cell, presorted=False, exact=None,
+                  passes=1):
     """``exact`` lets callers amortize the O(N^2) exact kernel across a
-    window sweep — it depends only on the positions."""
+    window sweep — it depends only on the positions.  ``passes=2``
+    measures the r3 union-of-two-orderings path."""
     n = pos.shape[0]
     alive = jnp.ones((n,), bool)
     if exact is None:
         exact = separation_pallas(pos, alive, K_SEP, PS, EPS)
     approx = separation_window(
         pos, alive, K_SEP, PS, EPS, cell=cell, window=window,
-        presorted=presorted,
+        presorted=presorted, passes=passes,
     )
     num = float(jnp.linalg.norm(approx - exact))
     den = float(jnp.linalg.norm(exact))
@@ -113,6 +115,9 @@ def static_sweep():
             for window in sorted({8, 16, 32, suggested}):
                 recall, pairs = sampled_recall(pos, window, PS)
                 err = force_rel_err(pos, window, PS, exact=exact)
+                err2 = force_rel_err(
+                    pos, window, PS, exact=exact, passes=2
+                )
                 print(json.dumps({
                     "kind": "static",
                     "n": n,

@@ -41,6 +41,12 @@ class BoidsState:
     vel: jax.Array        # [N, D]
     key: jax.Array
     iteration: jax.Array  # i32 scalar
+    # Alternative Morton ordering of the CURRENT array (half-cell-
+    # shifted grid), refreshed on the same sort_every cadence as the
+    # array's own re-sort — consumed by window mode's passes=2 sweep
+    # (a stale order2 costs recall only; the rank-based
+    # de-duplication stays exact for ANY permutation).
+    order2: jax.Array     # [N] i32
 
 
 class BoidsParams(NamedTuple):
@@ -68,6 +74,14 @@ class BoidsParams(NamedTuple):
     window: int = 48              # ± sorted-order span per boid
     sort_cell: float = 2.0        # Morton cell (finer = better locality)
     sort_every: int = 2           # re-sort cadence in steps
+    # passes=2 runs a second sweep under a half-cell-shifted Morton
+    # ordering, adding only the pairs pass 1 missed (exact rank-based
+    # de-duplication — see ops/neighbors.py:separation_window).  Two
+    # passes at window W/2 beat one pass at W on recall at equal roll
+    # count and NARROW the polarization gap vs dense (0.68 -> 0.82 at
+    # matched density; the rest is disc-sampling bias, measured in
+    # docs/PERFORMANCE.md — not closable by recall alone).
+    passes: int = 1
 
 
 def boids_init(
@@ -84,7 +98,12 @@ def boids_init(
     vel = jax.random.uniform(kv, (n, dim), dtype, minval=-1.0, maxval=1.0)
     vel = _clamp_speed(vel, params.min_speed, params.max_speed, params.eps)
     return BoidsState(
-        pos=pos, vel=vel, key=key, iteration=jnp.asarray(0, jnp.int32)
+        pos=pos, vel=vel, key=key, iteration=jnp.asarray(0, jnp.int32),
+        order2=jnp.argsort(
+            _neighbors.morton_keys(
+                pos + 0.5 * params.sort_cell, params.sort_cell
+            )
+        ).astype(jnp.int32),
     )
 
 
@@ -209,30 +228,66 @@ def boids_forces_window(
         )
     if p.window < 1:
         raise ValueError(f"window must be >= 1, got {p.window}")
+    if p.passes not in (1, 2):
+        raise ValueError(f"passes must be 1 or 2, got {p.passes}")
 
-    sep = jnp.zeros_like(pos)
-    vsum = jnp.zeros_like(pos)
-    dsum = jnp.zeros_like(pos)
-    cnt_a = jnp.zeros((n, 1), pos.dtype)
-    cnt_c = jnp.zeros((n, 1), pos.dtype)
+    def sweep(spos, svel, exclude_rank=None, srank=None):
+        """One ±window roll sweep over (spos, svel); returns the five
+        rule accumulators in that array order.  ``exclude_rank``/
+        ``srank`` implement pass-2's exact de-duplication: pairs whose
+        pass-1 ranks are within ``exclude_rank`` were already counted
+        and are masked out."""
+        sep = jnp.zeros_like(spos)
+        vsum = jnp.zeros_like(spos)
+        dsum = jnp.zeros_like(spos)
+        cnt_a = jnp.zeros((n, 1), spos.dtype)
+        cnt_c = jnp.zeros((n, 1), spos.dtype)
+        for s, valid in _neighbors.window_shifts(n, p.window):
+            npos = jnp.roll(spos, s, axis=0)
+            nvel = jnp.roll(svel, s, axis=0)
+            diff = _wrap(spos - npos, p.half_width)   # min image (torus)
+            dist = jnp.linalg.norm(diff, axis=-1)
+            dist_c = jnp.maximum(dist, p.eps)
+            if exclude_rank is not None:
+                valid = valid & (
+                    jnp.abs(srank - jnp.roll(srank, s)) > exclude_rank
+                )
 
-    for s, valid in _neighbors.window_shifts(n, p.window):
-        npos = jnp.roll(pos, s, axis=0)
-        nvel = jnp.roll(vel, s, axis=0)
-        diff = _wrap(pos - npos, p.half_width)     # minimum image (torus)
-        dist = jnp.linalg.norm(diff, axis=-1)
-        dist_c = jnp.maximum(dist, p.eps)
+            near = valid & (dist < p.r_sep)
+            sep = sep + jnp.where(
+                near[:, None], diff / (dist_c * dist_c)[:, None], 0.0
+            )
+            ma = (valid & (dist < p.r_align))[:, None]
+            vsum = vsum + jnp.where(ma, nvel, 0.0)
+            cnt_a = cnt_a + ma
+            mc = (valid & (dist < p.r_coh))[:, None]
+            dsum = dsum + jnp.where(mc, diff, 0.0)
+            cnt_c = cnt_c + mc
+        return sep, vsum, dsum, cnt_a, cnt_c
 
-        near = valid & (dist < p.r_sep)
-        sep = sep + jnp.where(
-            near[:, None], diff / (dist_c * dist_c)[:, None], 0.0
+    sep, vsum, dsum, cnt_a, cnt_c = sweep(pos, vel)
+
+    if p.passes == 2:
+        # Second ordering: the state-carried half-cell-shifted Morton
+        # permutation, refreshed on the sort_every cadence (NOT per
+        # step — staleness costs recall only, exactly like pass 1's
+        # ordering; the rank exclusion below is exact for any
+        # permutation).  The array order IS ordering 1, so rank1 =
+        # arange and the pass-2 rank of a boid is just order2.
+        # Accumulators merge BEFORE the rule normalization, so
+        # averages see the union neighborhood.
+        order2 = state.order2
+        s2, v2, d2, ca2, cc2 = sweep(
+            pos[order2], vel[order2],
+            exclude_rank=p.window,
+            srank=order2.astype(jnp.int32),
         )
-        ma = (valid & (dist < p.r_align))[:, None]
-        vsum = vsum + jnp.where(ma, nvel, 0.0)
-        cnt_a = cnt_a + ma
-        mc = (valid & (dist < p.r_coh))[:, None]
-        dsum = dsum + jnp.where(mc, diff, 0.0)
-        cnt_c = cnt_c + mc
+        back = lambda x: jnp.zeros_like(x).at[order2].set(x)  # noqa: E731
+        sep = sep + back(s2)
+        vsum = vsum + back(v2)
+        dsum = dsum + back(d2)
+        cnt_a = cnt_a + back(ca2)
+        cnt_c = cnt_c + back(cc2)
 
     align = jnp.where(cnt_a > 0, vsum / jnp.maximum(cnt_a, 1) - vel, 0.0)
     coh = jnp.where(cnt_c > 0, -dsum / jnp.maximum(cnt_c, 1), 0.0)
@@ -253,15 +308,21 @@ def boids_step(
         params.min_speed, params.max_speed, params.eps,
     )
     pos = _wrap(state.pos + params.dt * vel, params.half_width)
-    return BoidsState(
-        pos=pos, vel=vel, key=state.key, iteration=state.iteration + 1
+    return state.replace(
+        pos=pos, vel=vel, iteration=state.iteration + 1
     )
 
 
 def _morton_sort_boids(state: BoidsState, p: BoidsParams) -> BoidsState:
-    """Permute the flock into Morton order (identity-free, so free)."""
+    """Permute the flock into Morton order (identity-free, so free),
+    and refresh the alternative half-cell-shifted ordering for
+    passes=2 at the same (amortized) cadence."""
     order = jnp.argsort(_neighbors.morton_keys(state.pos, p.sort_cell))
-    return state.replace(pos=state.pos[order], vel=state.vel[order])
+    pos = state.pos[order]
+    order2 = jnp.argsort(
+        _neighbors.morton_keys(pos + 0.5 * p.sort_cell, p.sort_cell)
+    ).astype(jnp.int32)
+    return state.replace(pos=pos, vel=state.vel[order], order2=order2)
 
 
 def boids_step_window(
@@ -283,8 +344,8 @@ def boids_step_window(
         state.vel + p.dt * acc, p.min_speed, p.max_speed, p.eps
     )
     pos = _wrap(state.pos + p.dt * vel, p.half_width)
-    return BoidsState(
-        pos=pos, vel=vel, key=state.key, iteration=state.iteration + 1
+    return state.replace(
+        pos=pos, vel=vel, iteration=state.iteration + 1
     )
 
 

@@ -122,3 +122,71 @@ def test_neighbor_counts_sampled_matches_dense():
     np.testing.assert_array_equal(
         np.sort(counts), np.sort(true_counts)
     )
+
+
+def test_two_pass_union_beats_one_pass():
+    """r3 union-of-two-orderings: at EQUAL roll count (2 passes at W/2
+    vs 1 pass at W), the union's force error must be well below the
+    single-ordering plateau (quadrant-boundary misses decorrelate
+    between half-cell-shifted grids)."""
+    import numpy as np
+
+    from distributed_swarm_algorithm_tpu.ops.neighbors import (
+        separation_dense,
+        separation_window,
+    )
+
+    key = jax.random.PRNGKey(3)
+    n, ps = 4000, 2.0
+    side = float(np.sqrt(n * np.pi * ps**2 / 8))   # ~8 mean neighbors
+    pos = jax.random.uniform(key, (n, 2), jnp.float32, 0, side)
+    alive = jnp.ones((n,), bool)
+    dense = np.asarray(separation_dense(pos, alive, 20.0, ps, 1e-3))
+
+    def err(w, p):
+        f = separation_window(
+            pos, alive, 20.0, ps, 1e-3, ps, w, passes=p
+        )
+        return float(
+            np.linalg.norm(np.asarray(f) - dense)
+            / (np.linalg.norm(dense) + 1e-12)
+        )
+
+    one = err(16, 1)
+    two = err(8, 2)
+    assert two < one * 0.5, (one, two)
+    assert two < 0.01
+
+
+def test_two_pass_no_double_count():
+    """Rank exclusion must make pass 2 add ONLY unseen pairs: in a
+    configuration where pass 1 already finds every pair (tiny cluster,
+    window >= n), the two-pass force equals the one-pass force."""
+    import numpy as np
+
+    from distributed_swarm_algorithm_tpu.ops.neighbors import (
+        separation_window,
+    )
+
+    key = jax.random.PRNGKey(5)
+    n = 64
+    pos = jax.random.uniform(key, (n, 2), jnp.float32, 0, 4.0)
+    alive = jnp.ones((n,), bool)
+    f1 = separation_window(pos, alive, 20.0, 2.0, 1e-3, 2.0, n, passes=1)
+    f2 = separation_window(pos, alive, 20.0, 2.0, 1e-3, 2.0, n, passes=2)
+    np.testing.assert_allclose(
+        np.asarray(f1), np.asarray(f2), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_two_pass_rejects_bad_passes():
+    import pytest as _pytest
+
+    from distributed_swarm_algorithm_tpu.ops.neighbors import (
+        separation_window,
+    )
+
+    pos = jnp.zeros((8, 2))
+    alive = jnp.ones((8,), bool)
+    with _pytest.raises(ValueError, match="passes"):
+        separation_window(pos, alive, 1.0, 1.0, 1e-3, 1.0, 2, passes=3)

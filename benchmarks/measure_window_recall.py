@@ -127,6 +127,7 @@ def static_sweep():
                     "pair_recall": round(recall, 4),
                     "sampled_pairs": pairs,
                     "force_rel_err": round(err, 4),
+                    "force_rel_err_2pass": round(err2, 4),
                 }))
 
 

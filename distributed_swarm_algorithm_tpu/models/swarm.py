@@ -25,22 +25,42 @@ from ..ops.allocation import (
 from ..ops.coordination import coordination_step, current_leader, kill, revive
 from ..ops.neighbors import morton_keys as _morton_keys
 from ..ops.physics import physics_step
-from ..state import LEADER, SwarmState, make_swarm, permute_agents, with_tasks
+from ..state import (
+    LEADER,
+    SwarmState,
+    make_swarm,
+    permute_agents,  # noqa: F401  (public re-export)
+    sort_agents_by_key,
+    with_tasks,
+)
 from ..utils.config import DEFAULT_CONFIG, SwarmConfig
 from ._checkpoint import CheckpointMixin
 
 _NO_OBSTACLES = None
 
 
-@partial(jax.jit, static_argnames=("cfg",))
+@partial(jax.jit, static_argnames=("cfg", "sort_in_tick"))
 def swarm_tick(
     state: SwarmState,
     obstacles: Optional[jax.Array],
     cfg: SwarmConfig,
+    sort_in_tick: bool = True,
 ) -> SwarmState:
-    """One synchronous swarm tick (= one 10 Hz loop body for every agent)."""
+    """One synchronous swarm tick (= one 10 Hz loop body for every agent).
+
+    ``sort_in_tick=False`` drops the cadenced Morton re-sort ``lax.cond``
+    from the graph — callers that handle the cadence themselves
+    (``swarm_rollout``'s chunked scan) MUST use it: a conditional
+    carrying the full swarm state costs ~26 ms/tick at 1M on v5e even
+    when the branch never fires (measured r3 — XLA TPU conditionals
+    materialize their whole carried tuple).
+    """
     state = state.replace(tick=state.tick + 1)
-    if cfg.separation_mode == "window" and cfg.sort_every > 1:
+    if (
+        sort_in_tick
+        and cfg.separation_mode == "window"
+        and cfg.sort_every > 1
+    ):
         # Keep the agent axis approximately Morton-sorted so the window
         # separation pass (ops/neighbors.py) runs roll-only.  The full
         # permutation is semantically transparent (permute_agents) and
@@ -49,8 +69,8 @@ def swarm_tick(
         # first tick of a fresh swarm, then every sort_every.
         state = jax.lax.cond(
             state.tick % cfg.sort_every == 1,
-            lambda s: permute_agents(
-                s, jnp.argsort(_morton_keys(s.pos, cfg.grid_cell))
+            lambda s: sort_agents_by_key(
+                s, _morton_keys(s.pos, cfg.grid_cell)
             ),
             lambda s: s,
             state,
@@ -86,19 +106,13 @@ def swarm_rollout(
     the Morton re-sort is safe: each frame is unscrambled by scattering
     rows to their ``agent_id`` slots before stacking.
     """
-    if cfg.separation_mode == "window" and cfg.sort_every > 1:
-        # Re-sort unconditionally on rollout entry: the in-tick cadence
-        # (tick % sort_every == 1) assumes ticks are aligned to it, which
-        # a state produced under a different config (or hand-built) may
-        # not be — entering sorted bounds staleness to < sort_every ticks.
-        state = permute_agents(
-            state, jnp.argsort(_morton_keys(state.pos, cfg.grid_cell))
-        )
-
     permuting = cfg.separation_mode == "window" and cfg.sort_every > 1
 
     def body(s, _):
-        s = swarm_tick(s, obstacles, cfg)
+        # The chunked path below owns the re-sort cadence, so the tick
+        # runs cond-free (the conditional alone measured ~26 ms/tick
+        # at 1M — see swarm_tick's docstring).
+        s = swarm_tick(s, obstacles, cfg, sort_in_tick=not permuting)
         frame = None
         if record:
             # Unscramble to id order only when slots can actually move;
@@ -110,8 +124,47 @@ def swarm_rollout(
             )
         return s, frame
 
-    state, traj = jax.lax.scan(body, state, None, length=n_steps)
-    return (state, traj) if record else state
+    if not permuting:
+        state, traj = jax.lax.scan(body, state, None, length=n_steps)
+        return (state, traj) if record else state
+
+    # Window mode with a sort cadence: scan CHUNKS of sort_every ticks,
+    # each chunk opening with one UNCONDITIONAL full-state variadic
+    # sort (state.sort_agents_by_key — a comparison network, no
+    # gathers).  Same staleness bound as the old in-tick cadence
+    # (ordering is <= sort_every ticks stale), with zero conditionals
+    # in the hot graph.  The entry sort also covers states produced
+    # under a different config (or hand-built mid-cadence).
+    chunk = cfg.sort_every
+
+    def sorted_chunk(s, length):
+        s = sort_agents_by_key(
+            s, _morton_keys(s.pos, cfg.grid_cell)
+        )
+        return jax.lax.scan(body, s, None, length=length)
+
+    n_chunks, rem = divmod(n_steps, chunk)
+    frames = []
+    if n_chunks:
+        def chunk_body(s, _):
+            s, fr = sorted_chunk(s, chunk)
+            return s, fr
+
+        state, fr = jax.lax.scan(
+            chunk_body, state, None, length=n_chunks
+        )
+        if record:
+            frames.append(fr.reshape((n_chunks * chunk,) + fr.shape[2:]))
+    if rem:
+        state, fr = sorted_chunk(state, rem)
+        if record:
+            frames.append(fr)
+    if record:
+        if not frames:                       # n_steps == 0
+            return state, jnp.zeros((0,) + state.pos.shape,
+                                    state.pos.dtype)
+        return state, jnp.concatenate(frames, axis=0)
+    return state
 
 
 class VectorSwarm(CheckpointMixin):

@@ -152,12 +152,39 @@ def apf_forces(
         # With sort_every > 1 the swarm itself is kept approximately
         # Morton-sorted (swarm_tick reorders on cadence via
         # state.permute_agents), so the pass runs roll-only with no
-        # per-tick sort, gather, or scatter.
-        f_sep = _neighbors.separation_window(
-            pos, state.alive, cfg.k_sep, cfg.personal_space, eps,
-            cell=cfg.grid_cell, window=cfg.window_size,
-            presorted=cfg.sort_every > 1,
-        )
+        # per-tick sort, gather, or scatter.  On TPU with f32 2-D
+        # state the roll chain fuses further into one Pallas VMEM
+        # pass (ops/pallas/window_separation.py — identical math; HBM
+        # traffic independent of window size).
+        from ..utils.platform import on_tpu
+
+        # the kernel's halo spans only adjacent tiles, so window must
+        # be < the lane-tile bound; wider windows (legal portably —
+        # window_shifts masks out-of-range partners) stay on the
+        # portable path
+        tile_bound = min(4096, -(-pos.shape[0] // 128) * 128)
+        if (
+            pos.shape[1] == 2
+            and pos.dtype == jnp.float32
+            and cfg.window_size < tile_bound
+            and on_tpu()
+        ):
+            from .pallas.window_separation import (
+                separation_window_pallas,
+            )
+
+            f_sep = separation_window_pallas(
+                pos, state.alive, float(cfg.k_sep),
+                float(cfg.personal_space), float(cfg.dist_eps),
+                cell=float(cfg.grid_cell), window=cfg.window_size,
+                presorted=cfg.sort_every > 1,
+            )
+        else:
+            f_sep = _neighbors.separation_window(
+                pos, state.alive, cfg.k_sep, cfg.personal_space, eps,
+                cell=cfg.grid_cell, window=cfg.window_size,
+                presorted=cfg.sort_every > 1,
+            )
     elif cfg.separation_mode == "off":
         f_sep = jnp.zeros_like(pos)
     else:

@@ -1,0 +1,97 @@
+"""Fused Morton-window separation kernel
+(ops/pallas/window_separation.py): exact parity with the portable
+roll-chain path (same math — allclose, not a convergence band), halo
+and bound handling, and the physics-dispatch contract.  Runs the real
+kernel via ``interpret=True`` on CPU."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_swarm_algorithm_tpu.ops.neighbors import (
+    separation_window,
+)
+from distributed_swarm_algorithm_tpu.ops.pallas.window_separation import (
+    separation_window_pallas,
+)
+
+
+def _swarm(n, seed=0, side=60.0):
+    key = jax.random.PRNGKey(seed)
+    pos = jax.random.uniform(key, (n, 2), jnp.float32, -side, side)
+    alive = jnp.arange(n) % 97 != 0
+    return pos, alive
+
+
+def _assert_match(f_port, f_fused):
+    np.testing.assert_allclose(
+        np.asarray(f_port), np.asarray(f_fused), rtol=1e-4, atol=1e-3
+    )
+
+
+@pytest.mark.parametrize("window", [1, 8, 16])
+def test_matches_portable(window):
+    pos, alive = _swarm(9000)
+    f_port = separation_window(
+        pos, alive, 20.0, 2.0, 1e-3, 2.0, window
+    )
+    f_fused = separation_window_pallas(
+        pos, alive, 20.0, 2.0, 1e-3, 2.0, window, interpret=True
+    )
+    _assert_match(f_port, f_fused)
+
+
+def test_matches_portable_presorted():
+    """The presorted fast path (the protocol's hot configuration)."""
+    from distributed_swarm_algorithm_tpu.ops.neighbors import morton_keys
+
+    pos, alive = _swarm(8192, seed=3)
+    order = jnp.argsort(morton_keys(pos, 2.0))
+    spos, salive = pos[order], alive[order]
+    f_port = separation_window(
+        spos, salive, 20.0, 2.0, 1e-3, 2.0, 12, presorted=True
+    )
+    f_fused = separation_window_pallas(
+        spos, salive, 20.0, 2.0, 1e-3, 2.0, 12, presorted=True,
+        interpret=True,
+    )
+    _assert_match(f_port, f_fused)
+
+
+def test_non_aligned_and_tile_boundaries():
+    """n not a multiple of the lane tile: pad lanes must contribute no
+    force and boundary tiles must see their true halo."""
+    pos, alive = _swarm(5000, seed=5)     # crosses a 4096-lane tile
+    f_port = separation_window(pos, alive, 20.0, 2.0, 1e-3, 2.0, 16)
+    f_fused = separation_window_pallas(
+        pos, alive, 20.0, 2.0, 1e-3, 2.0, 16, interpret=True
+    )
+    _assert_match(f_port, f_fused)
+
+
+def test_dead_agents_inert():
+    pos, _ = _swarm(2048, seed=7)
+    alive = jnp.zeros((2048,), bool)
+    f = separation_window_pallas(
+        pos, alive, 20.0, 2.0, 1e-3, 2.0, 8, interpret=True
+    )
+    assert float(jnp.abs(f).max()) == 0.0
+
+
+def test_validation():
+    pos, alive = _swarm(1024)
+    with pytest.raises(ValueError, match="2-D"):
+        separation_window_pallas(
+            jnp.zeros((64, 3)), alive[:64], 1.0, 1.0, 1e-3, 1.0, 4,
+            interpret=True,
+        )
+    with pytest.raises(ValueError, match="window"):
+        separation_window_pallas(
+            pos, alive, 1.0, 1.0, 1e-3, 1.0, 0, interpret=True
+        )
+    with pytest.raises(ValueError, match="halo"):
+        separation_window_pallas(
+            pos, alive, 1.0, 1.0, 1e-3, 1.0, 2000, tile_n=1024,
+            interpret=True,
+        )

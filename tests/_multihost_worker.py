@@ -1,0 +1,104 @@
+"""Worker process for the two-real-process multi-host test.
+
+Launched (twice) by tests/test_multihost.py::test_two_process_island_run
+with ``python _multihost_worker.py <coordinator> <n_proc> <proc_id>
+<out.npz>``.  Each process owns 4 virtual CPU devices; together they
+form the 8-device world the single-process harness uses, so the island
+run's result must match the single-process reference bit-for-bit class
+(same XLA program over the same global device count — multi-process
+changes placement, not math).
+"""
+
+import os
+import sys
+
+# Must precede any jax import: 4 local devices per process, CPU backend,
+# and keep the axon TPU-tunnel plugin from dialing out.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4"
+)
+
+# The package is used in-tree (not installed); workers launch with
+# tests/ as their script dir, so add the repo root.
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+
+def main() -> None:
+    coordinator, n_proc, proc_id, out_path = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+    )
+    # The sitecustomize hook may have frozen jax_platforms already;
+    # re-pin to the CPU backend explicitly before device queries.
+    jax.config.update("jax_platforms", "cpu")
+
+    from distributed_swarm_algorithm_tpu.parallel.multihost import (
+        hybrid_mesh,
+        init_distributed,
+        is_coordinator,
+    )
+
+    init_distributed(
+        coordinator_address=coordinator,
+        num_processes=n_proc,
+        process_id=proc_id,
+    )
+    assert jax.process_count() == n_proc
+    assert len(jax.local_devices()) == 4
+    assert len(jax.devices()) == 4 * n_proc
+
+    from distributed_swarm_algorithm_tpu.ops.objectives import sphere
+    from distributed_swarm_algorithm_tpu.parallel.islands import (
+        global_best,
+        island_init,
+        island_run,
+    )
+
+    mesh = hybrid_mesh(islands_per_host=1)     # (n_proc, 4) world mesh
+    assert mesh.devices.shape == (n_proc, 4)
+
+    state = island_init(
+        sphere, n_islands=n_proc, n_per_island=64, dim=4,
+        half_width=5.12, seed=0,
+    )
+    # Island axis across HOSTS (the DCN row of the hybrid mesh):
+    # migration's roll lowers to a cross-process collective permute.
+    island_sharding = NamedSharding(mesh, P("islands"))
+
+    def place(leaf):
+        if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == n_proc:
+            return jax.device_put(leaf, island_sharding)
+        return jax.device_put(leaf, NamedSharding(mesh, P()))
+
+    state = jax.tree_util.tree_map(place, state)
+    out = island_run(state, sphere, 60, migrate_every=20, migrate_k=2)
+    best_fit, best_pos = global_best(out)
+
+    # gbest_fit stays island-sharded ACROSS PROCESSES — a plain
+    # device_get cannot read non-addressable shards; allgather it.
+    from jax.experimental import multihost_utils
+
+    gbest_all = multihost_utils.process_allgather(
+        out.pso.gbest_fit, tiled=True
+    )
+    if is_coordinator():
+        np.savez(
+            out_path,
+            best_fit=np.asarray(best_fit),
+            best_pos=np.asarray(best_pos),
+            gbest_fit=np.asarray(gbest_all),
+        )
+    # Every process must reach the end (collectives are collective).
+    jax.effects_barrier()
+
+
+if __name__ == "__main__":
+    main()

@@ -53,3 +53,71 @@ def test_coordinator_guards(capsys):
     assert is_coordinator()               # single-process: process 0
     coord_print("hello-from-coordinator")
     assert "hello-from-coordinator" in capsys.readouterr().out
+
+
+@pytest.mark.slow
+def test_two_process_island_run(tmp_path):
+    """VERDICT r2 item 5: TWO REAL OS PROCESSES under
+    ``jax.distributed.initialize`` (CPU backend, 4 virtual devices
+    each), hybrid_mesh spanning both, island PSO with cross-process
+    migration — and the result must match the single-process
+    8-virtual-device run of the same program (multi-process changes
+    placement, not math)."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    import numpy as np
+
+    # Free port for the distributed coordinator.
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+    worker = os.path.join(os.path.dirname(__file__),
+                          "_multihost_worker.py")
+    out_npz = str(tmp_path / "two_proc.npz")
+
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, coord, "2", str(i), out_npz],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=420)[0] for p in procs]
+    for p, o in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{o[-3000:]}"
+    assert os.path.exists(out_npz)
+    got = np.load(out_npz)
+
+    # Single-process 8-device reference (this test process IS that
+    # harness — conftest pinned 8 virtual CPU devices).
+    from distributed_swarm_algorithm_tpu.ops.objectives import sphere
+    from distributed_swarm_algorithm_tpu.parallel.islands import (
+        global_best,
+        island_init,
+        island_run,
+    )
+
+    st = island_init(sphere, n_islands=2, n_per_island=64, dim=4,
+                     half_width=5.12, seed=0)
+    ref = island_run(st, sphere, 60, migrate_every=20, migrate_k=2)
+    ref_fit, ref_pos = global_best(ref)
+
+    np.testing.assert_allclose(
+        got["best_fit"], np.asarray(ref_fit), rtol=1e-6, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        got["best_pos"], np.asarray(ref_pos), rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        got["gbest_fit"], np.asarray(ref.pso.gbest_fit),
+        rtol=1e-6, atol=1e-6,
+    )

@@ -1,0 +1,146 @@
+"""Decompose the 1M protocol tick: where does the protocol floor go?
+
+docs/PERFORMANCE.md (r3) measured the 1M window tick at 19.8 ms =
+14.6 ms protocol floor (separation off) + 4.5 ms window kernel + 1.7 ms
+amortized re-sort, and named the floor as the next lever.  This probe
+times each stage of ``swarm_tick`` in isolation — each stage scanned
+``STEPS`` times under one jit so per-dispatch overhead amortizes like it
+does in ``swarm_rollout`` — plus sub-stages of the suspects:
+
+  - ``coordination_step``'s threefry jitter draw (a [N] randint tower),
+  - ``formation_targets``'s ordinal-rank scatter/cumsum/gather round-trip,
+  - ``allocation_step``'s caps gather and [N, T] bid machinery.
+
+Usage: python benchmarks/decompose_tick.py [N]
+"""
+
+from __future__ import annotations
+
+import sys
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from common import timeit_best
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu.ops.allocation import (
+    allocation_step,
+    utility_matrix,
+)
+from distributed_swarm_algorithm_tpu.ops.coordination import coordination_step
+from distributed_swarm_algorithm_tpu.ops.physics import (
+    apf_forces,
+    formation_targets,
+    integrate,
+    physics_step,
+)
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_048_576
+STEPS = 50
+
+
+def make_state():
+    s = dsa.make_swarm(N, seed=0, spread=1000.0)
+    s = dsa.with_tasks(
+        s, jnp.asarray([[1.0, 1.0], [-2.0, 3.0], [5.0, -8.0], [0.0, 9.0]])
+    )
+    return s.replace(
+        target=jnp.broadcast_to(jnp.asarray([50.0, 0.0]), s.pos.shape),
+        has_target=jnp.ones_like(s.has_target),
+    )
+
+
+def scan_stage(fn, state, label):
+    """Time STEPS applications of ``fn(state) -> state`` under one scan."""
+
+    @jax.jit
+    def run(s):
+        return jax.lax.scan(lambda st, _: (fn(st), None), s, None,
+                            length=STEPS)[0]
+
+    out = {"s": run(state)}
+    jax.block_until_ready(out["s"].pos)
+
+    def once():
+        out["s"] = run(state)
+
+    best = timeit_best(once, lambda: float(out["s"].pos[0, 0]))
+    print(f"{label:<46s} {best / STEPS * 1e3:8.3f} ms/tick")
+    return best / STEPS
+
+
+def main():
+    cfg_off = dsa.SwarmConfig().replace(separation_mode="off")
+    s = make_state()
+
+    # Whole-tick reference points.
+    scan_stage(lambda st: dsa.swarm_tick(st, None, cfg_off,
+                                         sort_in_tick=False),
+               s, "full tick, separation=off")
+
+    # Stage 1: coordination.
+    def tick_and_coord(st):
+        return coordination_step(st.replace(tick=st.tick + 1), cfg_off)
+
+    scan_stage(tick_and_coord, s, "coordination_step")
+
+    # ... without the jitter draw (replaces randint with a constant).
+    def coord_no_rng(st):
+        st = st.replace(tick=st.tick + 1)
+        # inline: same masked updates but zero jitter, no threefry
+        tick = st.tick
+        silent = (tick - st.last_hb_tick) > cfg_off.election_timeout_ticks
+        to_wait = st.alive & (st.fsm == 0) & silent
+        wait_until = jnp.where(to_wait, tick, st.wait_until)
+        return st.replace(wait_until=wait_until)
+
+    scan_stage(coord_no_rng, s, "  coordination w/o threefry (partial sem)")
+
+    def just_randint(st):
+        key, sub = jax.random.split(st.key)
+        j = jax.random.randint(sub, (N,), 0, 3)
+        return st.replace(key=key,
+                          wait_until=st.wait_until + j * 0)
+
+    scan_stage(just_randint, s, "  threefry randint [N] alone")
+
+    # Stage 2: allocation.
+    scan_stage(lambda st: allocation_step(st, cfg_off), s, "allocation_step")
+
+    def just_utility(st):
+        u = utility_matrix(st, cfg_off)
+        return st.replace(task_util=st.task_util + 0 * jnp.max(u, axis=0))
+
+    scan_stage(just_utility, s, "  utility_matrix [N,4] alone")
+
+    def caps_gather(st):
+        cap_ok = st.caps[:, jnp.maximum(st.task_cap, 0)]
+        return st.replace(
+            task_util=st.task_util + 0 * jnp.sum(cap_ok, axis=0)
+        )
+
+    scan_stage(caps_gather, s, "    caps[:, task_cap] gather alone")
+
+    # Stage 3: physics (separation off).
+    scan_stage(lambda st: physics_step(st, None, cfg_off), s,
+               "physics_step, separation=off")
+
+    scan_stage(lambda st: formation_targets(st, cfg_off), s,
+               "  formation_targets (ordinal rank)")
+
+    cfg_id = cfg_off.replace(formation_rank_mode="id")
+    scan_stage(lambda st: formation_targets(st, cfg_id), s,
+               "  formation_targets (id rank — no scatter)")
+
+    def forces_only(st):
+        f = apf_forces(st, None, cfg_off)
+        pos, vel = integrate(st.pos, f, st.alive, cfg_off, cfg_off.dt)
+        return st.replace(pos=pos, vel=vel)
+
+    scan_stage(forces_only, s, "  apf_forces + integrate (no formation)")
+
+
+if __name__ == "__main__":
+    main()

@@ -37,7 +37,14 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from ..state import ELECTION_WAIT, FOLLOWER, LEADER, NO_LEADER, SwarmState
+from ..state import (
+    ELECTION_WAIT,
+    FOLLOWER,
+    LEADER,
+    NO_LEADER,
+    SwarmState,
+    recount_alive_below,
+)
 from ..utils.config import SwarmConfig
 
 
@@ -53,6 +60,7 @@ def coordination_step(state: SwarmState, cfg: SwarmConfig) -> SwarmState:
     wait_until = state.wait_until
     lpos = state.leader_pos
     has_lpos = state.has_leader_pos
+    leader_live = state.leader_live
 
     # --- 1. failure detection (agent.py:221-231) -------------------------
     silent = (tick - last_hb) > cfg.election_timeout_ticks
@@ -64,6 +72,7 @@ def coordination_step(state: SwarmState, cfg: SwarmConfig) -> SwarmState:
     fsm = jnp.where(to_wait, ELECTION_WAIT, fsm)
     leader_id = jnp.where(to_wait, NO_LEADER, leader_id)
     has_lpos = has_lpos & ~to_wait
+    leader_live = leader_live & ~to_wait
 
     # --- 2. acclaim + bully resolution (agent.py:234-241, 263-281) -------
     # "elapsed > delay" is strict in the reference (agent.py:235), so an
@@ -90,6 +99,7 @@ def coordination_step(state: SwarmState, cfg: SwarmConfig) -> SwarmState:
     leader_id = jnp.where(resolve, winner, leader_id)
     # Losers treat the acclaim as liveness proof (agent.py:268).
     last_hb = jnp.where(resolve & ~is_winner, tick, last_hb)
+    leader_live = leader_live | resolve      # the winner acclaimed: alive
 
     # --- 3. heartbeat (agent.py:243-261, 283-289) ------------------------
     leaders = alive & (fsm == LEADER)
@@ -97,7 +107,17 @@ def coordination_step(state: SwarmState, cfg: SwarmConfig) -> SwarmState:
     any_emit = jnp.any(emit)
     emit_ids = jnp.where(emit, agent_id, NO_LEADER)
     hb_id = jnp.max(emit_ids)
-    hb_pos = state.pos[jnp.argmax(emit_ids)]
+    # The emitter's pose as a masked REDUCTION, not pos[argmax].  A dynamic
+    # row-slice of a loop-carried [N, D] array broadcast back into another
+    # carried [N, D] array degrades every fusion in the surrounding scan
+    # body ~35x on TPU (XLA layout/alias pessimization, measured r3:
+    # 6.6 -> 0.18 ms/tick at 1M agents); the exactly-one-hot mask makes the
+    # sum the emitter's row.  No emitter => hb_pos = 0, unused (adopt all
+    # false).
+    hb_pos = jnp.sum(
+        jnp.where((emit & (agent_id == hb_id))[:, None], state.pos, 0.0),
+        axis=0,
+    )
     recv = any_emit & alive & (agent_id != hb_id)
     # Higher-id leaders suppress the emitter (agent.py:244-247); lower-id
     # leaders yield (agent.py:249-251); waiters cancel (agent.py:260-261).
@@ -108,9 +128,12 @@ def coordination_step(state: SwarmState, cfg: SwarmConfig) -> SwarmState:
     last_hb = jnp.where(adopt, tick, last_hb)
     lpos = jnp.where(adopt[:, None], hb_pos[None, :], lpos)
     has_lpos = has_lpos | adopt
+    leader_live = leader_live | adopt        # the emitter heartbeat: alive
 
     # A leader's own view of the leadership (agent.py:239).
-    leader_id = jnp.where(alive & (fsm == LEADER), agent_id, leader_id)
+    is_leader = alive & (fsm == LEADER)
+    leader_id = jnp.where(is_leader, agent_id, leader_id)
+    leader_live = leader_live | is_leader
 
     return state.replace(
         key=key,
@@ -120,6 +143,7 @@ def coordination_step(state: SwarmState, cfg: SwarmConfig) -> SwarmState:
         wait_until=wait_until,
         leader_pos=lpos,
         has_leader_pos=has_lpos,
+        leader_live=leader_live,
     )
 
 
@@ -129,12 +153,19 @@ def instant_election(state: SwarmState) -> SwarmState:
     The bully protocol's fixed point is "highest alive id leads"
     (agent.py:244-251, 263-275).  This skips the transient entirely — the
     optimizer-path equivalent of SURVEY.md §7 step 3.  Recovery from leader
-    failure is free: clear the alive bit and call this again.
+    failure is free: clear the alive bit (through ``kill``, or directly —
+    this function recounts the ``alive_below`` cache, so a raw
+    ``replace(alive=...)`` is safe here) and call this again.
     """
+    state = recount_alive_below(state)
     winner = jnp.max(jnp.where(state.alive, state.agent_id, NO_LEADER))
     n = state.n_agents
     is_winner = state.alive & (state.agent_id == winner)
-    winner_pos = state.pos[jnp.argmax(jnp.where(is_winner, 1, 0))]
+    # Masked reduction, not pos[argmax] — see coordination_step's note on
+    # the scan-body pessimization.  No winner => zeros, gated by any_alive.
+    winner_pos = jnp.sum(
+        jnp.where(is_winner[:, None], state.pos, 0.0), axis=0
+    )
     any_alive = winner >= 0
     return state.replace(
         fsm=jnp.where(is_winner, LEADER, FOLLOWER),
@@ -148,6 +179,7 @@ def instant_election(state: SwarmState) -> SwarmState:
             state.alive, ~is_winner & any_alive, state.has_leader_pos
         ),
         last_hb_tick=jnp.where(state.alive, state.tick, state.last_hb_tick),
+        leader_live=state.leader_live | state.alive,   # winner is alive
     )
 
 
@@ -165,16 +197,35 @@ def kill(state: SwarmState, ids) -> SwarmState:
     first-class mask and detection/recovery follow from the protocol."""
     ids = jnp.asarray(ids, jnp.int32).reshape(-1)
     dead = jnp.any(state.agent_id[:, None] == ids[None, :], axis=1)
-    return state.replace(alive=state.alive & ~dead)
+    # Believers in a killed leader see the liveness flip immediately —
+    # the same instantaneous-global semantics as the alive-array lookup
+    # this cache replaces (formation ranks close over the dead leader's
+    # slot at once; *detection* still waits for the heartbeat timeout).
+    believed_killed = jnp.any(
+        state.leader_id[:, None] == ids[None, :], axis=1
+    )
+    return recount_alive_below(
+        state.replace(
+            alive=state.alive & ~dead,
+            leader_live=state.leader_live & ~believed_killed,
+        )
+    )
 
 
 def revive(state: SwarmState, ids) -> SwarmState:
     """Elastic recovery: bring agents back (they rejoin as followers)."""
     ids = jnp.asarray(ids, jnp.int32).reshape(-1)
     back = jnp.any(state.agent_id[:, None] == ids[None, :], axis=1)
-    return state.replace(
-        alive=state.alive | back,
-        fsm=jnp.where(back, FOLLOWER, state.fsm),
-        leader_id=jnp.where(back, NO_LEADER, state.leader_id),
-        last_hb_tick=jnp.where(back, state.tick, state.last_hb_tick),
+    # An agent still pointing at a revived leader sees it alive again.
+    believed_back = jnp.any(
+        state.leader_id[:, None] == ids[None, :], axis=1
+    )
+    return recount_alive_below(
+        state.replace(
+            alive=state.alive | back,
+            fsm=jnp.where(back, FOLLOWER, state.fsm),
+            leader_id=jnp.where(back, NO_LEADER, state.leader_id),
+            last_hb_tick=jnp.where(back, state.tick, state.last_hb_tick),
+            leader_live=(state.leader_live | believed_back) & ~back,
+        )
     )

@@ -91,7 +91,15 @@ def test_two_process_island_run(tmp_path):
         )
         for i in range(2)
     ]
-    outs = [p.communicate(timeout=420)[0] for p in procs]
+    try:
+        outs = [p.communicate(timeout=420)[0] for p in procs]
+    finally:
+        # A hung distributed barrier (e.g. the free-port race) must not
+        # leak workers holding the port past the test.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
     for p, o in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{o[-3000:]}"
     assert os.path.exists(out_npz)

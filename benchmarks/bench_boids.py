@@ -1,10 +1,17 @@
-"""Boids flocking at scale: dense vs Morton-window neighbor modes.
+"""Boids flocking at scale: dense vs Morton-window vs gridmean modes.
 
 Density held constant (~0.32 boids/m²: half_width scales with sqrt N)
 so perception-disc populations — and therefore window recall — stay
 comparable across sizes.  A million-boid flock is impossible for the
 dense pass (the [N, N] interaction would need ~4 TB); the window pass
 runs it in real time.
+
+"gridmean" is the r3 flocking-QUALITY mode: particle-in-cell
+alignment/cohesion + exact torus-hash separation, polarization
+0.993–0.997 vs dense 0.995 where window mode plateaus at 0.82 — at a
+measured gather-bound cost (docs/PERFORMANCE.md has the full story and
+the trade-off table; its row here is capped at 65k, and single calls
+are kept short — long scans at 1M crash the TPU worker).
 """
 
 from __future__ import annotations
@@ -17,6 +24,7 @@ CONFIGS = [
     (16_384, 113.0, "dense", 100),
     (16_384, 113.0, "window", 200),
     (1_048_576, 905.0, "window", 50),
+    (65_536, 226.0, "gridmean", 20),
 ]
 
 

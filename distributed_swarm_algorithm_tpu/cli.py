@@ -765,10 +765,13 @@ def build_parser() -> argparse.ArgumentParser:
     p_boids.add_argument("--seed", type=int, default=0)
     p_boids.add_argument("--half-width", type=float, default=50.0)
     p_boids.add_argument("--neighbor-mode", default="dense",
-                         choices=["dense", "window"],
+                         choices=["dense", "window", "gridmean"],
                          help="dense = exact all-pairs; window = "
                               "Morton sliding window (million-boid "
-                              "scale, 2-D only)")
+                              "scale, 2-D only); gridmean = "
+                              "particle-in-cell align/cohesion + "
+                              "exact hash separation (dense-grade "
+                              "flocking quality, 2-D only)")
     p_boids.set_defaults(fn=_cmd_boids)
 
     p_aco = sub.add_parser("aco", help="ant-colony TSP solver")

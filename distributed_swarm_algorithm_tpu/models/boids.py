@@ -37,15 +37,15 @@ class Boids(CheckpointMixin):
         if overrides:
             base = base._replace(**overrides)
         self.params = base
-        if neighbor_mode not in ("dense", "window"):
+        if neighbor_mode not in ("dense", "window", "gridmean"):
             raise ValueError(
                 f"unknown neighbor_mode {neighbor_mode!r}; "
-                "expected 'dense' or 'window'"
+                "expected 'dense', 'window', or 'gridmean'"
             )
-        if neighbor_mode == "window" and dim != 2:
+        if neighbor_mode != "dense" and dim != 2:
             raise ValueError(
-                "neighbor_mode='window' is 2-D only (a silent dense "
-                f"fallback would OOM at window-mode flock sizes); got "
+                f"neighbor_mode={neighbor_mode!r} is 2-D only (a silent "
+                "dense fallback would OOM at large-flock sizes); got "
                 f"dim={dim}"
             )
         self.neighbor_mode = neighbor_mode
@@ -57,11 +57,11 @@ class Boids(CheckpointMixin):
         self.state = _k.boids_init(n, dim, self.params, seed=seed)
 
     def step(self) -> _k.BoidsState:
-        step_fn = (
-            _k.boids_step_window
-            if self.neighbor_mode == "window"
-            else _k.boids_step
-        )
+        step_fn = {
+            "dense": _k.boids_step,
+            "window": _k.boids_step_window,
+            "gridmean": _k.boids_step_gridmean,
+        }[self.neighbor_mode]
         self.state = step_fn(self.state, self.params, self.obstacles)
         return self.state
 

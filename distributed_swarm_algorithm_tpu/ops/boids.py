@@ -82,6 +82,25 @@ class BoidsParams(NamedTuple):
     # matched density; the rest is disc-sampling bias, measured in
     # docs/PERFORMANCE.md — not closable by recall alone).
     passes: int = 1
+    # --- "gridmean" neighbor mode (neighbor_mode="gridmean") ------------
+    # Alignment/cohesion from a tent-smoothed grid velocity/centroid
+    # field (particle-in-cell: deposit per ~r_align cell, 3x3 periodic
+    # tent pool, sample at own cell); separation stays windowed.  The
+    # pooled supports OVERLAP, which is what the window sweep (a
+    # Z-order-biased disc sample) and plain per-block means both lack:
+    # measured at 512 boids / 40x40 world, dense polarization 0.995,
+    # window 0.82 (plateau), non-overlapping Z-block means 0.09-0.31
+    # (domain walls persist — overlap, not unbiasedness, is the
+    # ordering ingredient), gridmean 0.992-0.993 (3 seeds).  The grid
+    # tiles the torus exactly (effective cell = 2*half_width / G).
+    # Separation in this mode uses the torus-aware spatial-hash kernel
+    # (ops/neighbors.py:separation_grid): windowed Z-order pairing's
+    # detection set FLICKERS as ranks drift, and that flicker acts as
+    # heading noise that disorders the flock (measured: gridmean
+    # align/cohesion + windowed separation 0.03-0.38 vs + hash
+    # separation ~dense).  grid_max_per_cell caps hash-cell occupancy.
+    align_cell: float = 8.0
+    grid_max_per_cell: int = 16
 
 
 def boids_init(
@@ -296,20 +315,128 @@ def boids_forces_window(
     return _clamp_force(acc, p)
 
 
+def boids_forces_gridmean(
+    state: BoidsState,
+    params: BoidsParams,
+    obstacles: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Reynolds forces with particle-in-cell alignment/cohesion.
+
+    Separation (short-range, 1/d² — the collision-avoidance contract)
+    uses the torus-aware spatial-hash kernel
+    (``ops/neighbors.py:separation_grid``): exact up to the occupancy
+    cap and STABLE in time.  Windowed Z-order pairing measured 26%
+    missed r_sep pairs at this density with the misses *flickering* as
+    ranks drift — impulsive 1/d² on/off kicks that act as heading
+    noise and disorder the flock no matter how good alignment is
+    (0.03–0.38 polarization over window 8–48, vs ~dense with exact
+    separation; a grid density-gradient "pressure" separation was also
+    tried and measured negative — boids pile up at NN ≈ 0.01, the
+    cell-scale field cannot resolve sub-cell collisions).
+    Alignment and cohesion — neighborhood AVERAGES over an ~r_align
+    disc — come from a grid field: deposit each boid's (velocity,
+    cell-relative position, 1) into its ``align_cell``-sized grid
+    cell, pool the grid with a 3×3 periodic tent kernel, sample at
+    the boid's own cell.  One scatter-add and one gather per tick at
+    GRID-deposit granularity — no [N, N] work, no window-width scaling.
+
+    Why a smoothed grid and not exact per-block means: the pooled
+    supports OVERLAP (each boid's average spans its 3×3 cell
+    neighborhood, weighted toward the center), giving spatially
+    continuous coupling like the dense disc.  Measured at 512 boids /
+    40×40 world / 1000 steps / 3 seeds: dense 0.995, window sweep 0.82
+    (the docs/PERFORMANCE.md plateau), EXACT non-overlapping Z-block
+    means 0.09–0.31 (domain walls between blocks never anneal —
+    overlap, not sample bias, is the ordering ingredient; the
+    machinery for that negative result lives on as
+    ``ops/neighbors.py:seg_sums_sorted``/``block_mean_field``),
+    gridmean **0.992–0.993**.
+
+    Deltas vs the dense rule (documented contract): the support is the
+    tent-pooled 3×3 cell patch, not a centered disc; self is included
+    in the field (a 1/count bias, negligible at flocking densities —
+    a boid alone in its pooled patch gets zero align/cohesion force,
+    matching dense's no-neighbor case); the grid tiles the torus
+    exactly, so pooling wraps the seam cleanly (which the window
+    sweep's Z-order cannot).
+    """
+    p = params
+    pos, vel = state.pos, state.vel
+    n, d = pos.shape
+    if d != 2:
+        raise ValueError(
+            f"gridmean neighbor mode is 2-D only (got dim={d})"
+        )
+
+    # --- separation: torus-aware spatial hash (stable detection) --------
+    sep = _neighbors.separation_grid(
+        pos, jnp.ones((n,), bool), 1.0, p.r_sep, p.eps,
+        cell=p.r_sep, max_per_cell=p.grid_max_per_cell,
+        torus_hw=p.half_width,
+    )
+
+    # --- alignment + cohesion: tent-pooled grid field -------------------
+    hw = p.half_width
+    g = max(1, int(round(2.0 * hw / p.align_cell)))
+    cell = 2.0 * hw / g                       # tiles the torus exactly
+    ci = jnp.clip(
+        jnp.floor((pos + hw) / cell).astype(jnp.int32), 0, g - 1
+    )                                                       # [N, 2]
+    center = (ci.astype(pos.dtype) + 0.5) * cell - hw
+    rel = _wrap(pos - center, hw)             # cell-local, seam-safe
+    dep = jnp.concatenate(
+        [vel, rel, jnp.ones((n, 1), pos.dtype)], axis=1
+    )                                                       # [N, 5]
+    grid = jnp.zeros((g, g, 5), pos.dtype).at[ci[:, 0], ci[:, 1]].add(dep)
+
+    pooled = jnp.zeros_like(grid)
+    for dx in (-1, 0, 1):
+        for dy in (-1, 0, 1):
+            w = (2 - abs(dx)) * (2 - abs(dy)) / 16.0
+            gshift = jnp.roll(grid, (dx, dy), axis=(0, 1))  # periodic
+            # Neighbor cells' position sums are relative to THEIR
+            # centers; re-express relative to the receiving cell.
+            off = jnp.asarray([dx * cell, dy * cell], pos.dtype)
+            gshift = gshift.at[..., 2:4].add(-gshift[..., 4:5] * off)
+            pooled = pooled + w * gshift
+
+    samp = pooled[ci[:, 0], ci[:, 1]]                       # [N, 5]
+    cnt = jnp.maximum(samp[:, 4:5], 1e-6)
+    # Self deposits exactly 0.25 into the pooled count (tent center
+    # weight 4/16); anything above that means some OTHER boid is in the
+    # pooled patch — matching dense's no-neighbor gate for a lone boid.
+    has = samp[:, 4:5] > 0.26
+    mean_vel = samp[:, :d] / cnt
+    centroid_rel = samp[:, d:2 * d] / cnt + _wrap(center - pos, hw)
+    align = jnp.where(has, mean_vel - vel, 0.0)
+    coh = jnp.where(has, centroid_rel, 0.0)
+
+    acc = p.w_sep * sep + p.w_align * align + p.w_coh * coh
+    acc = acc + _obstacle_acc(pos, obstacles, p)
+    return _clamp_force(acc, p)
+
+
+def _integrate_tick(
+    state: BoidsState, acc: jax.Array, p: BoidsParams
+) -> BoidsState:
+    """Shared tail of every step mode: speed-clamped Euler + torus wrap."""
+    vel = _clamp_speed(
+        state.vel + p.dt * acc, p.min_speed, p.max_speed, p.eps
+    )
+    pos = _wrap(state.pos + p.dt * vel, p.half_width)
+    return state.replace(
+        pos=pos, vel=vel, iteration=state.iteration + 1
+    )
+
+
 def boids_step(
     state: BoidsState,
     params: BoidsParams,
     obstacles: Optional[jax.Array] = None,
 ) -> BoidsState:
     """One flocking tick: Reynolds forces -> speed-clamped Euler -> wrap."""
-    acc = boids_forces(state, params, obstacles)
-    vel = _clamp_speed(
-        state.vel + params.dt * acc,
-        params.min_speed, params.max_speed, params.eps,
-    )
-    pos = _wrap(state.pos + params.dt * vel, params.half_width)
-    return state.replace(
-        pos=pos, vel=vel, iteration=state.iteration + 1
+    return _integrate_tick(
+        state, boids_forces(state, params, obstacles), params
     )
 
 
@@ -339,13 +466,26 @@ def boids_step_window(
         lambda s: s,
         state,
     )
-    acc = boids_forces_window(state, params, obstacles)
-    vel = _clamp_speed(
-        state.vel + p.dt * acc, p.min_speed, p.max_speed, p.eps
+    return _integrate_tick(
+        state, boids_forces_window(state, params, obstacles), params
     )
-    pos = _wrap(state.pos + p.dt * vel, p.half_width)
-    return state.replace(
-        pos=pos, vel=vel, iteration=state.iteration + 1
+
+
+def boids_step_gridmean(
+    state: BoidsState,
+    params: BoidsParams,
+    obstacles: Optional[jax.Array] = None,
+) -> BoidsState:
+    """One flocking tick with particle-in-cell alignment/cohesion.
+
+    No Morton re-sort of the array: every gridmean rule is computed in
+    grid space (the hash kernel sorts internally), so array order is
+    irrelevant and the sort cadence machinery would be pure overhead.
+    This also means ``record=True`` trajectories are slot-stable here,
+    unlike window mode.
+    """
+    return _integrate_tick(
+        state, boids_forces_gridmean(state, params, obstacles), params
     )
 
 
@@ -369,18 +509,24 @@ def boids_run(
     trajectory-capture hook; the reference could only log poses to
     stdout, agent.py:180-181).
     """
-    if neighbor_mode not in ("dense", "window"):
+    if neighbor_mode not in ("dense", "window", "gridmean"):
         raise ValueError(
             f"unknown neighbor_mode {neighbor_mode!r}; "
-            "expected 'dense' or 'window'"
+            "expected 'dense', 'window', or 'gridmean'"
         )
     if neighbor_mode == "window" and record:
+        # gridmean never re-sorts the array (boids_step_gridmean), so
+        # recording is slot-stable there; only window mode scrambles.
         raise ValueError(
             "record=True is incompatible with neighbor_mode='window': the "
             "in-scan Morton re-sorts permute boid array slots, so "
             "traj[t, i] would not track one boid over time"
         )
-    step = boids_step_window if neighbor_mode == "window" else boids_step
+    step = {
+        "dense": boids_step,
+        "window": boids_step_window,
+        "gridmean": boids_step_gridmean,
+    }[neighbor_mode]
 
     def body(s, _):
         s = step(s, params, obstacles)

@@ -1,0 +1,55 @@
+"""ACO TSP throughput: portable scan vs the fused whole-tour kernel.
+
+The ledger's r3 portable measurement (73k tours/s best-case dispatch-
+pipelined; 13-14k with per-call sync at 30-iteration granularity) was
+a measured negative with the whole-tour VMEM kernel named as the
+future path — ops/pallas/aco_fused.py is that kernel.  Device-profiled
+iteration time at C=256, A=1024: portable ~74 ms (255 sequential
+small-op steps), fused 4.6 ms (1.06 ms construction kernel + the
+[A, C] deposit scatters, which now dominate and are the next lever).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from common import report, timeit_best
+
+from distributed_swarm_algorithm_tpu.ops.aco import (
+    aco_init,
+    aco_run,
+    coords_to_dist,
+)
+from distributed_swarm_algorithm_tpu.ops.pallas.aco_fused import (
+    fused_aco_run,
+)
+
+C, A, STEPS = 256, 1024, 100
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    coords = jnp.asarray(rng.uniform(0, 100, (C, 2)).astype(np.float32))
+    st = aco_init(coords_to_dist(coords), seed=0)
+
+    for name, fn in [
+        ("portable", lambda s: aco_run(s, STEPS, A)),
+        ("pallas-fused", lambda s: fused_aco_run(s, STEPS, A)),
+    ]:
+        holder = {"out": fn(st)}
+        _ = float(holder["out"].best_len)          # compile + warm
+        best = timeit_best(
+            lambda: holder.update(out=fn(st)),
+            lambda: float(holder["out"].best_len),
+        )
+        report(
+            f"tours/sec, ACO TSP C={C} A={A} ({name})",
+            A * STEPS / best,
+            "tours/sec",
+            0.0,
+        )
+
+
+if __name__ == "__main__":
+    main()

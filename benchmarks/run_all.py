@@ -48,6 +48,7 @@ BENCHES = [
     "bench_hho_1m.py",
     "bench_mfo_1m.py",
     "bench_firefly_64k.py",
+    "bench_aco.py",
     "bench_swarm_tpu.py",
     "bench_boids.py",
     "bench_dim_sharded.py",
@@ -71,6 +72,7 @@ QUICK_SKIP = {
     "bench_hho_1m.py",
     "bench_mfo_1m.py",
     "bench_firefly_64k.py",
+    "bench_aco.py",
     "bench_swarm_tpu.py",
     "bench_boids.py",
     "bench_dim_sharded.py",

@@ -2398,3 +2398,90 @@ def fused_island_run_shmap(
         ),
         iteration=state.iteration + n_steps,
     )
+
+
+def fused_aco_run_shmap(
+    state,
+    mesh: Mesh,
+    n_steps: int,
+    n_ants: int,
+    axis: str = AGENT_AXIS,
+    alpha: float = 1.0,
+    beta: float = 2.0,
+    rho: float = 0.1,
+    q0: float = 0.0,
+    tile_a: int = 1024,
+    rng: str = "tpu",
+    interpret: bool = False,
+):
+    """Multi-chip fused ACO: the ANT axis is sharded, pheromone is
+    replicated state.
+
+    Each device constructs ``n_ants / n_dev`` whole tours with the
+    fused kernel (ops/pallas/aco_fused.py) under a device-folded RNG
+    stream, computes its local deposit matrix, and ``psum``s it over
+    ICI; the tau update ``(1-rho)·tau + D + D^T`` is then replicated
+    deterministic math, so every device carries an identical pheromone
+    matrix with no further synchronization.  Unlike the optimizer-
+    family drivers there is NO semantic lag here: the deposit is a sum
+    over ants, so the sharded colony is exactly a single colony of the
+    union ant set (only the RNG stream assignment differs from the
+    1-device run).  Best tour/length ride the shared pmin/psum
+    exchange (city indices are exact in f32 up to 2^24).
+    """
+    from ..ops.pallas.aco_fused import (
+        fused_construct_tours,
+        fused_deposit_matrix,
+    )
+
+    n_dev = mesh.shape[axis]
+    ants_local = -(-n_ants // n_dev)
+    f32 = jnp.float32
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P()),
+        out_specs=(P(), P(), P(), P()),
+        check_vma=False,
+    )
+    def run(tau, dist, best_tour_f, best_len, key):
+        dev = lax.axis_index(axis)
+
+        def body(carry, _):
+            tau, best_tour_f, best_len, key = carry
+            key, kc = jax.random.split(key)
+            kd = jax.random.fold_in(kc, dev)
+            tours, lengths = fused_construct_tours(
+                tau, dist, kd, ants_local, alpha, beta, q0,
+                tile_a=tile_a, rng=rng, interpret=interpret,
+            )
+            d = fused_deposit_matrix(
+                tours, lengths, tile_a=tile_a, interpret=interpret
+            )
+            d = lax.psum(d, axis)
+            loc = jnp.argmin(lengths)
+            best_len, best_tour_f = _exchange_best(
+                lengths[loc], tours[loc].astype(f32),
+                best_len, best_tour_f, dev, axis,
+            )
+            tau = (1.0 - rho) * tau + d + d.T
+            return (tau, best_tour_f, best_len, key), None
+
+        (tau, best_tour_f, best_len, key), _ = lax.scan(
+            body, (tau, best_tour_f, best_len, key), None,
+            length=n_steps,
+        )
+        return tau, best_tour_f, best_len, key
+
+    tau, bt_f, bl, key = run(
+        state.tau, state.dist, state.best_tour.astype(f32),
+        state.best_len, state.key,
+    )
+    return state.replace(
+        tau=tau,
+        best_tour=bt_f.astype(jnp.int32),
+        best_len=bl,
+        key=key,
+        iteration=state.iteration + n_steps,
+    )

@@ -189,3 +189,33 @@ def test_fused_islands_shmap_rejects_bad_split(mesh):
         sh.fused_island_run_shmap(
             st, "sphere", mesh, 10, rng="host", interpret=True
         )
+
+
+def test_fused_aco_shmap(mesh):
+    from distributed_swarm_algorithm_tpu.ops.aco import (
+        aco_init,
+        coords_to_dist,
+        tour_lengths,
+    )
+
+    rng = np.random.default_rng(7)
+    coords = jnp.asarray(rng.uniform(0, 10, (16, 2)).astype(np.float32))
+    dist = coords_to_dist(coords)
+    st = aco_init(dist, seed=0)
+    out = sh.fused_aco_run_shmap(
+        st, mesh, 15, n_ants=256, tile_a=128, rng="host", interpret=True
+    )
+    assert int(out.iteration) == 15
+    assert np.isfinite(float(out.best_len))
+    # best tour is a coherent permutation whose recorded length matches
+    bt = np.asarray(out.best_tour)
+    assert sorted(bt) == list(range(16))
+    got = float(tour_lengths(dist, out.best_tour[None, :])[0])
+    np.testing.assert_allclose(got, float(out.best_len), rtol=1e-4)
+    # deterministic
+    out2 = sh.fused_aco_run_shmap(
+        st, mesh, 15, n_ants=256, tile_a=128, rng="host", interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(out.best_tour),
+                                  np.asarray(out2.best_tour))
+    np.testing.assert_allclose(np.asarray(out.tau), np.asarray(out2.tau))

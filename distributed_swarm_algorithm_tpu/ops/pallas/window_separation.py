@@ -7,13 +7,23 @@ arrays from HBM per shift and dominated the 1M full-protocol tick
 (23-31 ticks/s with window separation vs 103 with separation off —
 the roll chain was ~70% of the tick, VERDICT r2 item 7).
 
-This kernel loads each 4096-lane tile of the sorted layout into VMEM
-ONCE (plus a ±window halo from the two adjacent tiles, fetched as
-whole neighbor blocks through rotated BlockSpec index maps) and runs
-every shifted interaction as a STATIC slice of the in-VMEM extended
-buffer — zero rolls, zero HBM re-streaming: HBM sees one read of
-(x, y, alive) and one write of the force per tile, independent of
-window size.
+This kernel loads each 4096-agent tile of the sorted layout into VMEM
+ONCE (plus halos from the two adjacent tiles through rotated BlockSpec
+index maps) and runs every shifted interaction in-VMEM — zero HBM
+re-streaming: HBM sees one read of (x, y, alive) and one write of the
+force per tile, independent of window size.
+
+Layout (r3b rewrite): the sorted 1-D agent axis is packed ROW-MAJOR
+into [8, 512] sublane×lane tiles — agent ``i`` lives at
+``(i // 512 % 8, i % 512)``.  The first kernel kept attributes as
+[1, 4096] single-sublane rows, so every VPU op ran at 1/8 lane-tile
+utilization; full-height tiles cut the per-shift vreg work ~8×
+(measured: 4.5 → 1.0 ms/pass at 1M, W=16).  A shifted neighbor is a
+lane roll within rows plus a one-sublane roll for the lanes that cross
+a row boundary (edge lanes patched from the adjacent tile's block —
+the same wrap-and-patch trick as the lane-only version, one dimension
+up).  An even earlier draft used static UNALIGNED slices of a halo
+buffer: Mosaic's relayouts made it as slow as the portable rolls.
 
 Math is byte-identical to the portable presorted path (same eps
 clamp, same validity mask via the global sorted index), so the parity
@@ -38,63 +48,93 @@ from jax.experimental.pallas import tpu as pltpu
 from ..neighbors import morton_keys
 from .common import ceil_to as _ceil_to
 
-# Packed attribute rows in the [8, N] operand (8 = f32 sublane tile).
-_ROW_X, _ROW_Y, _ROW_ALIVE = 0, 1, 2
+_LANES = 512           # lanes per packed row (multiple of 128)
+_ROWS = 8              # sublane tile height; tile = _ROWS * _LANES agents
 
 
-def _make_kernel(k_sep, personal_space, eps, window, tile_n, n_real):
-    def kernel(prev_ref, own_ref, next_ref, out_ref):
-        w = window
-        own = own_ref[:]
-        prev = prev_ref[:]
-        nxt = next_ref[:]
-        ox, oy = own[_ROW_X:_ROW_X + 1], own[_ROW_Y:_ROW_Y + 1]
-        oalive = own[_ROW_ALIVE:_ROW_ALIVE + 1] > 0.5
+def _make_kernel(k_sep, personal_space, eps, window, n_real):
+    tile = _ROWS * _LANES
 
-        col = jax.lax.broadcasted_iota(jnp.int32, (1, tile_n), 1)
-        gcol = col + pl.program_id(0) * tile_n
+    def kernel(xp_ref, xo_ref, xn_ref, yp_ref, yo_ref, yn_ref,
+               ap_ref, ao_ref, an_ref, fx_ref, fy_ref):
+        xo, yo, ao = xo_ref[:], yo_ref[:], ao_ref[:]
+        xprev, yprev, aprev = xp_ref[:], yp_ref[:], ap_ref[:]
+        xnext, ynext, anext = xn_ref[:], yn_ref[:], an_ref[:]
+        oalive = ao > 0.5
 
-        fx = jnp.zeros((1, tile_n), jnp.float32)
-        fy = jnp.zeros((1, tile_n), jnp.float32)
-        # Shifted neighbors come from pltpu.roll (the lane-rotation
-        # fast path every fused family uses) with the wrapped edge
-        # lanes patched from the adjacent tile's roll — an earlier
-        # draft used static UNALIGNED slices of a [8, W+T+W] halo
-        # buffer instead, and Mosaic's relayouts made it as slow as
-        # the portable jnp.roll chain (measured 6.3 vs 7.4 ms/pass at
-        # 1M; this form measures the HBM-bound ideal).
-        for s in range(-w, w + 1):
+        lane = jax.lax.broadcasted_iota(jnp.int32, (_ROWS, _LANES), 1)
+        row = jax.lax.broadcasted_iota(jnp.int32, (_ROWS, _LANES), 0)
+        gidx = pl.program_id(0) * tile + row * _LANES + lane
+
+        # Row-shifted bases: up[r] = buf[r-1] (row 0 from prev tile's
+        # last row); down[r] = buf[r+1] (row 7 from next tile's first).
+        def up(own, prev):
+            shifted = pltpu.roll(own, 1, 0)
+            pshift = pltpu.roll(prev, 1, 0)
+            return jnp.where(row == 0, pshift, shifted)
+
+        def down(own, nxt):
+            shifted = pltpu.roll(own, _ROWS - 1, 0)
+            nshift = pltpu.roll(nxt, _ROWS - 1, 0)
+            return jnp.where(row == _ROWS - 1, nshift, shifted)
+
+        xup, yup, aup = up(xo, xprev), up(yo, yprev), up(ao, aprev)
+        xdn, ydn, adn = (
+            down(xo, xnext), down(yo, ynext), down(ao, anext)
+        )
+
+        fx = jnp.zeros((_ROWS, _LANES), jnp.float32)
+        fy = jnp.zeros((_ROWS, _LANES), jnp.float32)
+        for s in range(-window, window + 1):
             if s == 0:
                 continue
             if s > 0:
-                # neighbor = sorted index gcol - s
-                rolled = pltpu.roll(own, s, 1)
-                edge = pltpu.roll(prev, s, 1)
-                nb = jnp.where(col < s, edge, rolled)
+                # neighbor = sorted index gidx - s: lane roll right;
+                # the first s lanes of each row cross into the row
+                # above.
+                cross = lane < s
+                nx = jnp.where(
+                    cross,
+                    pltpu.roll(xup, s, 1), pltpu.roll(xo, s, 1),
+                )
+                ny = jnp.where(
+                    cross,
+                    pltpu.roll(yup, s, 1), pltpu.roll(yo, s, 1),
+                )
+                na = jnp.where(
+                    cross,
+                    pltpu.roll(aup, s, 1), pltpu.roll(ao, s, 1),
+                )
             else:
-                rolled = pltpu.roll(own, tile_n + s, 1)
-                edge = pltpu.roll(nxt, tile_n + s, 1)
-                nb = jnp.where(col >= tile_n + s, edge, rolled)
-            nx, ny = nb[_ROW_X:_ROW_X + 1], nb[_ROW_Y:_ROW_Y + 1]
-            nalive = nb[_ROW_ALIVE:_ROW_ALIVE + 1] > 0.5
-            src = gcol - s
-            valid = (src >= 0) & (src < n_real) & (gcol < n_real)
-            dx = ox - nx
-            dy = oy - ny
+                cross = lane >= _LANES + s
+                r = _LANES + s
+                nx = jnp.where(
+                    cross,
+                    pltpu.roll(xdn, r, 1), pltpu.roll(xo, r, 1),
+                )
+                ny = jnp.where(
+                    cross,
+                    pltpu.roll(ydn, r, 1), pltpu.roll(yo, r, 1),
+                )
+                na = jnp.where(
+                    cross,
+                    pltpu.roll(adn, r, 1), pltpu.roll(ao, r, 1),
+                )
+            src = gidx - s
+            valid = (src >= 0) & (src < n_real) & (gidx < n_real)
+            dx = xo - nx
+            dy = yo - ny
             d2 = dx * dx + dy * dy
             dist = jnp.sqrt(d2)
             dist_c = jnp.maximum(dist, eps)
-            near = valid & oalive & nalive & (dist < personal_space)
+            near = valid & oalive & (na > 0.5) & (dist < personal_space)
             # k_sep / d_c^2 * diff / d_c  (agent.py:155 form)
             scale = k_sep / (dist_c * dist_c * dist_c)
             fx = fx + jnp.where(near, scale * dx, 0.0)
             fy = fy + jnp.where(near, scale * dy, 0.0)
 
-        # Row-concatenate instead of .at[].set: scatter has no Mosaic
-        # lowering; sublane concat does.
-        out_ref[:] = jnp.concatenate(
-            [fx, fy, jnp.zeros((6, tile_n), jnp.float32)], axis=0
-        )
+        fx_ref[:] = fx
+        fy_ref[:] = fy
 
     return kernel
 
@@ -115,26 +155,28 @@ def separation_window_pallas(
     cell: float,
     window: int,
     presorted: bool = False,
-    tile_n: int = 4096,
+    tile_n: int = _ROWS * _LANES,
     interpret: bool = False,
 ) -> jax.Array:
     """Drop-in fused fast path for the portable
     ``separation_window(..., passes=1)`` — identical math, one VMEM
     pass.  2-D float32 only (callers fall back to the portable path
-    otherwise)."""
+    otherwise).  ``tile_n`` is fixed at 4096 by the packed layout and
+    kept only as an API-compatibility knob (values are clamped)."""
+    del tile_n
     n, d = pos.shape
     if d != 2:
         raise ValueError("window separation kernel is 2-D only")
     if window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
-    tile_n = min(tile_n, _ceil_to(n, 128))
-    if window >= tile_n:
+    if window >= _LANES:
         raise ValueError(
-            f"window ({window}) must be < tile_n ({tile_n}) — the halo"
-            " spans only the adjacent tiles"
+            f"window ({window}) must be < {_LANES} — a shifted lane "
+            "crosses at most one packed-row boundary"
         )
-    n_pad = _ceil_to(n, tile_n)
-    n_tiles = n_pad // tile_n
+    tile = _ROWS * _LANES
+    n_pad = _ceil_to(n, tile)
+    n_tiles = n_pad // tile
 
     if presorted:
         spos, salive = pos, alive
@@ -144,34 +186,44 @@ def separation_window_pallas(
         spos = pos[order]
         salive = alive[order]
 
-    packed = jnp.zeros((8, n_pad), jnp.float32)
-    packed = packed.at[_ROW_X, :n].set(spos[:, 0].astype(jnp.float32))
-    packed = packed.at[_ROW_Y, :n].set(spos[:, 1].astype(jnp.float32))
-    packed = packed.at[_ROW_ALIVE, :n].set(
-        salive.astype(jnp.float32)
-    )
+    def pack(v):
+        return (
+            jnp.zeros((n_pad,), jnp.float32)
+            .at[:n].set(v.astype(jnp.float32))
+            .reshape(n_pad // _LANES, _LANES)
+        )
+
+    xr = pack(spos[:, 0])
+    yr = pack(spos[:, 1])
+    ar = pack(salive)
 
     kernel = _make_kernel(
-        float(k_sep), float(personal_space), float(eps), int(window),
-        tile_n, n,
+        float(k_sep), float(personal_space), float(eps), int(window), n
     )
-    col = lambda i: (0, i)                                   # noqa: E731
-    prev_map = lambda i: (0, jax.lax.rem(i + n_tiles - 1, n_tiles))  # noqa: E731
-    next_map = lambda i: (0, jax.lax.rem(i + 1, n_tiles))    # noqa: E731
+    col = lambda i: (i, 0)                                   # noqa: E731
+    prev_map = lambda i: (jax.lax.rem(i + n_tiles - 1, n_tiles), 0)  # noqa: E731
+    next_map = lambda i: (jax.lax.rem(i + 1, n_tiles), 0)    # noqa: E731
     blk = lambda m: pl.BlockSpec(                            # noqa: E731
-        (8, tile_n), m, memory_space=pltpu.VMEM
+        (_ROWS, _LANES), m, memory_space=pltpu.VMEM
     )
-    force8 = pl.pallas_call(
+    fx, fy = pl.pallas_call(
         kernel,
         grid=(n_tiles,),
-        in_specs=[blk(prev_map), blk(col), blk(next_map)],
-        out_specs=blk(col),
-        out_shape=jax.ShapeDtypeStruct((8, n_pad), jnp.float32),
+        in_specs=[
+            blk(prev_map), blk(col), blk(next_map),
+            blk(prev_map), blk(col), blk(next_map),
+            blk(prev_map), blk(col), blk(next_map),
+        ],
+        out_specs=[blk(col), blk(col)],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_pad // _LANES, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad // _LANES, _LANES), jnp.float32),
+        ],
         interpret=interpret,
-    )(packed, packed, packed)
+    )(xr, xr, xr, yr, yr, yr, ar, ar, ar)
 
     force_s = jnp.stack(
-        [force8[_ROW_X, :n], force8[_ROW_Y, :n]], axis=1
+        [fx.reshape(-1)[:n], fy.reshape(-1)[:n]], axis=1
     ).astype(pos.dtype)
     if presorted:
         return force_s

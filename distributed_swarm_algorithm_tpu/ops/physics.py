@@ -154,11 +154,11 @@ def apf_forces(
         # traffic independent of window size).
         from ..utils.platform import on_tpu
 
-        # the kernel's halo spans only adjacent tiles, so window must
-        # be < the lane-tile bound; wider windows (legal portably —
-        # window_shifts masks out-of-range partners) stay on the
-        # portable path
-        tile_bound = min(4096, -(-pos.shape[0] // 128) * 128)
+        # the kernel's packed-row layout shifts lanes across at most
+        # one row boundary, so window must be < the 512-lane row;
+        # wider windows (legal portably — window_shifts masks
+        # out-of-range partners) stay on the portable path
+        tile_bound = min(512, -(-pos.shape[0] // 128) * 128)
         if (
             pos.shape[1] == 2
             and pos.dtype == jnp.float32

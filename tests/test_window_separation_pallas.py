@@ -90,8 +90,8 @@ def test_validation():
         separation_window_pallas(
             pos, alive, 1.0, 1.0, 1e-3, 1.0, 0, interpret=True
         )
-    with pytest.raises(ValueError, match="halo"):
+    with pytest.raises(ValueError, match="row boundary"):
+        # r3b packed-row layout: window is bounded by the 512-lane row.
         separation_window_pallas(
-            pos, alive, 1.0, 1.0, 1e-3, 1.0, 2000, tile_n=1024,
-            interpret=True,
+            pos, alive, 1.0, 1.0, 1e-3, 1.0, 2000, interpret=True
         )

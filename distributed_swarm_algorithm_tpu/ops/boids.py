@@ -101,6 +101,17 @@ class BoidsParams(NamedTuple):
     # separation ~dense).  grid_max_per_cell caps hash-cell occupancy.
     align_cell: float = 8.0
     grid_max_per_cell: int = 16
+    # Separation backend for gridmean mode.  "auto" = the fused
+    # Pallas hash-grid kernel (ops/pallas/grid_separation.py) on TPU
+    # when the configuration qualifies (2-D f32, >=16 grid rows after
+    # rounding down to a multiple of 16, cap a multiple of 8 in
+    # [8, 64]), else the portable separation_grid;
+    # "pallas" forces the kernel (interpret off-TPU — test hook, same
+    # convention as physics.py separation_mode="pallas"); "portable"
+    # forces separation_grid.  The kernel's documented delta: agents
+    # past the per-cell cap drop from the interaction entirely rather
+    # than only from neighbor gathers.
+    grid_sep_backend: str = "auto"
 
 
 def boids_init(
@@ -369,11 +380,51 @@ def boids_forces_gridmean(
         )
 
     # --- separation: torus-aware spatial hash (stable detection) --------
-    sep = _neighbors.separation_grid(
-        pos, jnp.ones((n,), bool), 1.0, p.r_sep, p.eps,
-        cell=p.r_sep, max_per_cell=p.grid_max_per_cell,
-        torus_hw=p.half_width,
-    )
+    # The fused Pallas cell-slot kernel runs the same grid semantics
+    # as one VMEM pass (ops/pallas/grid_separation.py) — the r4 fix
+    # for gridmean's gather-bound cost (measured ~60x window at 65k)
+    # and its 1M long-scan worker crash, both in separation_grid.
+    if p.grid_sep_backend not in ("auto", "pallas", "portable"):
+        raise ValueError(
+            f"unknown grid_sep_backend {p.grid_sep_backend!r}; "
+            "expected 'auto', 'pallas', or 'portable'"
+        )
+    use_kernel = False
+    if p.grid_sep_backend != "portable":
+        from .pallas.grid_separation import hashgrid_supported
+
+        supported = hashgrid_supported(
+            d, pos.dtype, p.half_width, p.r_sep, p.grid_max_per_cell
+        )
+        if p.grid_sep_backend == "pallas" and not supported:
+            raise ValueError(
+                "grid_sep_backend='pallas' but this configuration is "
+                "outside the kernel's envelope (needs 2-D f32, "
+                "2*half_width/r_sep >= 16 grid cells, grid_max_per_cell "
+                "a multiple of 8 in [8, 64], and the grid row within "
+                "the VMEM budget)"
+            )
+        from ..utils.platform import on_tpu
+
+        use_kernel = supported and (
+            p.grid_sep_backend == "pallas" or on_tpu()
+        )
+    if use_kernel:
+        from ..utils.platform import on_tpu
+        from .pallas.grid_separation import separation_hashgrid_pallas
+
+        sep = separation_hashgrid_pallas(
+            pos, jnp.ones((n,), bool), 1.0, float(p.r_sep),
+            float(p.eps), cell=float(p.r_sep),
+            max_per_cell=p.grid_max_per_cell,
+            torus_hw=float(p.half_width), interpret=not on_tpu(),
+        )
+    else:
+        sep = _neighbors.separation_grid(
+            pos, jnp.ones((n,), bool), 1.0, p.r_sep, p.eps,
+            cell=p.r_sep, max_per_cell=p.grid_max_per_cell,
+            torus_hw=p.half_width,
+        )
 
     # --- alignment + cohesion: tent-pooled grid field -------------------
     hw = p.half_width

@@ -405,6 +405,29 @@ def suggest_window(
     return int(np.clip(int(np.ceil(safety * max(p95, 1.0))), lo, hi))
 
 
+def torus_cell_tables(pos: jax.Array, torus_hw: float, g: int):
+    """(cx, cy, key, counts, starts) for the ``g x g`` cell grid
+    tiling the torus ``[-hw, hw)^2``: per-agent cell coordinates and
+    row-major key, plus the CSR occupancy tables over the ``g*g`` key
+    space.  Shared by :func:`separation_grid`'s torus mode and the
+    Pallas hash-grid kernel (ops/pallas/grid_separation.py) so the
+    cell assignment the kernel's parity contract depends on cannot
+    drift between backends."""
+    cell_eff = 2.0 * torus_hw / g
+    cx = jnp.clip(
+        jnp.floor((pos[:, 0] + torus_hw) / cell_eff).astype(jnp.int32),
+        0, g - 1,
+    )
+    cy = jnp.clip(
+        jnp.floor((pos[:, 1] + torus_hw) / cell_eff).astype(jnp.int32),
+        0, g - 1,
+    )
+    key = cx * g + cy
+    counts = jnp.zeros((g * g,), jnp.int32).at[key].add(1)
+    starts = jnp.cumsum(counts) - counts
+    return cx, cy, key, counts, starts
+
+
 def separation_grid(
     pos: jax.Array,
     alive: jax.Array,
@@ -454,14 +477,8 @@ def separation_grid(
                 f"gives a {g}-cell grid; the wrapping 3x3 stencil needs "
                 "g >= 3 (use dense separation for such tiny worlds)"
             )
-        cell_eff = 2.0 * torus_hw / g
-        cx = jnp.clip(
-            jnp.floor((pos[:, 0] + torus_hw) / cell_eff).astype(jnp.int32),
-            0, g - 1,
-        )
-        cy = jnp.clip(
-            jnp.floor((pos[:, 1] + torus_hw) / cell_eff).astype(jnp.int32),
-            0, g - 1,
+        cx, cy, keys, cell_counts, cell_starts = torus_cell_tables(
+            pos, torus_hw, g
         )
 
         def neighbor_key(dx, dy):
@@ -471,8 +488,6 @@ def separation_grid(
             return (
                 jnp.mod(diff + torus_hw, 2.0 * torus_hw) - torus_hw
             )
-
-        keys = cx * g + cy
     else:
         half = _GRID_BASE // 2
         cx = jnp.floor(pos[:, 0] / cell).astype(jnp.int32) + half
@@ -492,13 +507,12 @@ def separation_grid(
     sorig = order  # sorted-slot -> original index, for self-exclusion
 
     if torus_hw is not None:
-        # CSR cell-start table: one scatter + exclusive cumsum over the
-        # bounded g*g key space replaces NINE searchsorted binary
-        # searches (measured 97 ms of a 324 ms force pass at 65k — the
-        # single largest cost center; each stencil start is then one
-        # cheap [N] table gather).
-        cell_counts = jnp.zeros((g * g,), jnp.int32).at[keys].add(1)
-        cell_starts = jnp.cumsum(cell_counts) - cell_counts
+        # CSR cell-start table (from torus_cell_tables above): one
+        # scatter + exclusive cumsum over the bounded g*g key space
+        # replaces NINE searchsorted binary searches (measured 97 ms
+        # of a 324 ms force pass at 65k — the single largest cost
+        # center; each stencil start is then one cheap [N] table
+        # gather).
 
         def stencil_start(nkey):
             return cell_starts[nkey]

@@ -1,0 +1,422 @@
+"""Hash-grid (cell-slot) separation as a single Pallas TPU kernel.
+
+The portable torus-hash kernel (ops/neighbors.py:separation_grid) is
+exact-and-STABLE in detection — the property that closes the boids
+flocking-quality gap (ops/boids.py:boids_forces_gridmean) — but its 9
+stencil gathers of [N, K] windows are gather-bound on TPU: measured
+~60x the window-kernel cost at 65k boids, and its long scans crash the
+TPU worker at 1M (docs/PERFORMANCE.md, boids section).  This kernel
+keeps separation_grid's detection semantics and runs them as pure
+in-VMEM vector work: zero gathers in the hot loop.
+
+Layout — the particle-in-cell dual of window_separation.py's packed
+rows: the torus ``[-hw, hw)^2`` is tiled by a ``g x g`` cell grid
+(``g`` a multiple of 16, so ``g*K`` is lane-aligned for any ``K``
+multiple of 8) and every cell owns ``K`` agent slots.  Agent
+attributes live in ``[g, g*K]`` planes: sublane = grid row ``cx``,
+lane = ``cy*K + rank`` (rank = the agent's arrival order within its
+cell, from one stable sort).  Two facts make the 3x3 stencil free in
+this layout:
+
+  - cy-adjacency is LANE-adjacency: a neighbor in cell ``cy' in
+    {cy-1, cy, cy+1}`` sits within ``+-(2K-1)`` lanes, so the whole
+    in-row stencil is a sweep of static cyclic lane rolls
+    (``pltpu.roll``) — and because the roll is cyclic over the
+    ``g*K``-lane row, the cy seam of the torus wraps for free.
+  - cx-adjacency is SUBLANE-adjacency: rows ``cx+-1`` come from a
+    one-sublane roll patched from the adjacent 8-row tile block
+    (same prev/own/next rotated-BlockSpec trick as
+    window_separation.py), and the rem-wrapped index maps wrap the
+    cx seam for free.
+
+Rolls reaching past ``+-1`` cell in cy (possible for ``|s| > K``) are
+rejected by the distance test alone: cells two apart are separated by
+``cell_eff >= personal_space``, so no extra validity mask is needed.
+
+Two measured kernel-shape decisions (r4, 65k boids on v5e):
+
+  - No alive plane: empty and dead slots hold a 1e18 position
+    SENTINEL — any pair involving a sentinel fails
+    ``dist < personal_space`` by construction (sentinel-sentinel
+    pairs alias to dist 0, but their contribution is
+    ``scale * diff = scale * 0``), so the alive plane, its rolls,
+    and its compares all vanish: 2 rolls per shift instead of 3.
+    (Stacking all six remaining planes into one [48, L] array rolled
+    once per shift was also tried and measured NEGATIVE: 2x slower
+    and a scoped-VMEM OOM at K=32 — Mosaic kept ~4x more rows
+    resident.  Per-plane [8, L] rolls it is.)
+  - Build by scatter, not gather: each agent writes its (x, y) into
+    its slot of a sentinel-FILLED [g*g*K] buffer.  The seemingly
+    TPU-friendlier CSR inverse-map gather
+    (``plane[cell, k] = sorted_agent[starts[cell] + k]``) measured
+    4x SLOWER (16.9 vs 4.2 ms at 65k/K=16): the gather touches all
+    g*g*K slots where the scatter writes only N values over a fast
+    fill.
+
+Minimum-image wrapping uses the select form
+``where(v >= hw, v - 2hw, where(v < -hw, v + 2hw, v))`` — exact for
+true displacements (|v| < 2hw), a no-op on sentinel-sized values
+(1e18 - 2hw rounds back to 1e18 in f32), and cheaper than the mod
+form.
+
+Detection contract (documented delta vs separation_grid): the per-cell
+occupancy cap drops agents past rank ``K`` from the grid — they exert
+no force on in-grid agents — whereas separation_grid truncates only
+each neighbor GATHER (a truncated agent there still receives force
+from its own stencil pass).  With ``K`` at or above the max cell
+occupancy both are exact and byte-identical to a dense torus pass;
+size ``K`` to your density with :func:`hashgrid_overflow` (returns
+the dropped-agent count).
+
+The overflow RESCUE pass (``overflow_budget``): capped-out agents
+must still RECEIVE separation force, or the cap becomes a runaway —
+measured at 4096 boids (flock equilibrium ~10/cell, cap 16): with
+dropped agents force-free, they free-fall into the clump (NN
+0.599 -> 0.128), push occupancy further past the cap, and 77% of the
+flock ends up dropped, even though the TRUE dynamics (dense oracle)
+never exceed the cap at all (overflow 0 at equilibrium).  So up to
+``overflow_budget`` overflow agents get their force from an exact
+masked dense pass against all agents (O(budget * N), fused by XLA,
+~0 cost when overflow is empty).  They still do not push in-grid
+agents until they re-enter the grid — a transient asymmetry that
+vanishes at equilibrium, where overflow is empty and the kernel is
+exact.  Overflow beyond the budget gets zero force (size the budget
+to your transient worst case; the count is observable via
+:func:`hashgrid_overflow`).
+
+Capability lineage: the separation rule is /root/reference/
+agent.py:148-160; the grid machinery is this repo's own scale answer
+(the reference's sensor lists cap at its 255-agent world).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_ROWS = 8               # sublane tile height (grid rows per block)
+_SENTINEL = 1.0e18      # empty/dead slot position (see module doc)
+# Peak resident VMEM ~ (6 double-buffered input blocks + 2 outputs +
+# 4 row-base planes + roll/diff temporaries), each [8, L] f32 ~ 24
+# blocks; budgeted against the 16 MB/core scoped-vmem limit.
+_VMEM_ROWS = 24 * _ROWS
+_VMEM_BUDGET = 13 * 1024 * 1024
+
+
+def _geometry(torus_hw: float, cell: float, max_per_cell: int):
+    """(g, cell_eff) for the cell grid.  ``g`` is ``floor(2hw/cell)``
+    rounded DOWN to a multiple of 16 (so ``cell_eff >= cell`` and the
+    stencil radius can only grow past ``personal_space``; 16 keeps
+    ``g*K`` lane-aligned for every ``K`` multiple of 8)."""
+    if max_per_cell % 8 != 0 or not 8 <= max_per_cell <= 64:
+        raise ValueError(
+            f"max_per_cell must be a multiple of 8 in [8, 64] "
+            f"(lane-tile alignment), got {max_per_cell}"
+        )
+    g = (int(2.0 * torus_hw / cell) // 16) * 16
+    if g < 16:
+        raise ValueError(
+            f"torus [-{torus_hw}, {torus_hw}) tiled by cell {cell} gives "
+            f"fewer than 16 aligned grid rows; use the portable "
+            "separation_grid (or dense) for such small worlds"
+        )
+    return g, 2.0 * torus_hw / g
+
+
+def _make_kernel(k_sep, personal_space, eps, hw, K, L):
+    two_hw = 2.0 * hw
+
+    def wrap(v):
+        # Select-form minimum image: exact for |v| < 2hw, inert on
+        # sentinel-sized values (1e18 +- 2hw == 1e18 in f32).
+        return jnp.where(
+            v >= hw, v - two_hw, jnp.where(v < -hw, v + two_hw, v)
+        )
+
+    def kernel(xp_ref, xo_ref, xn_ref, yp_ref, yo_ref, yn_ref,
+               fx_ref, fy_ref):
+        xo, yo = xo_ref[:], yo_ref[:]
+        row = jax.lax.broadcasted_iota(jnp.int32, (_ROWS, L), 0)
+
+        # Row-shifted bases: up[r] = grid row r-1 (row 0 patched from
+        # the previous tile's last row); down[r] = row r+1 (row 7
+        # from the next tile's first).  rem-wrapped index maps make
+        # the prev of tile 0 the LAST tile, closing the cx seam.
+        def up(own, prev):
+            return jnp.where(
+                row == 0, pltpu.roll(prev, 1, 0), pltpu.roll(own, 1, 0)
+            )
+
+        def down(own, nxt):
+            return jnp.where(
+                row == _ROWS - 1,
+                pltpu.roll(nxt, _ROWS - 1, 0),
+                pltpu.roll(own, _ROWS - 1, 0),
+            )
+
+        # Measured negative (r4, 65k/K=32): stacking all six planes
+        # into one [48, L] array rolled once per shift was 2x SLOWER
+        # than these per-plane [8, L] rolls and OOM'd scoped VMEM
+        # (Mosaic kept ~4x more rows resident) — per-plane it is.
+        bases = (
+            (up(xo, xp_ref[:]), up(yo, yp_ref[:]), False),
+            (xo, yo, True),
+            (down(xo, xn_ref[:]), down(yo, yn_ref[:]), False),
+        )
+
+        fx = jnp.zeros((_ROWS, L), jnp.float32)
+        fy = jnp.zeros((_ROWS, L), jnp.float32)
+        for bx, by, is_own in bases:
+            for s in range(-(2 * K - 1), 2 * K):
+                if is_own and s == 0:
+                    continue          # a slot is its own only self-pair
+                dx = wrap(xo - pltpu.roll(bx, s % L, 1))
+                dy = wrap(yo - pltpu.roll(by, s % L, 1))
+                dist = jnp.sqrt(dx * dx + dy * dy)
+                dist_c = jnp.maximum(dist, eps)
+                # Sentinel slots (empty/dead) fail this by construction.
+                near = dist < personal_space
+                # k_sep / d_c^2 * diff / d_c  (agent.py:155 form)
+                scale = k_sep / (dist_c * dist_c * dist_c)
+                fx = fx + jnp.where(near, scale * dx, 0.0)
+                fy = fy + jnp.where(near, scale * dy, 0.0)
+        fx_ref[:] = fx
+        fy_ref[:] = fy
+
+    return kernel
+
+
+def _cell_tables(pos, torus_hw, g):
+    """(key, order, starts, counts): per-agent cell key, the stable
+    cell-sort order, and the CSR start/count tables — the cell
+    assignment itself comes from the SHARED
+    ops/neighbors.py:torus_cell_tables (the parity contract with
+    separation_grid depends on both backends binning identically)."""
+    from ..neighbors import torus_cell_tables
+
+    _, _, key, counts, starts = torus_cell_tables(pos, torus_hw, g)
+    order = jnp.argsort(key)          # stable: rank = arrival order
+    return key, order, starts, counts
+
+
+def _agent_slots(key, order, starts, K):
+    """(slot, ok) per SORTED agent: flat slot ``key*K + rank`` and the
+    under-cap mask."""
+    n = key.shape[0]
+    skey = key[order]
+    rank = jnp.arange(n, dtype=jnp.int32) - starts[skey]
+    return skey * K + rank, rank < K
+
+
+def _overflow_rescue(
+    pos, alive, order, ok, k_sep, personal_space, eps, hw, budget
+):
+    """[N, 2] force correction for up to ``budget`` capped-out agents:
+    an exact masked dense pass (difference form — XLA fuses the
+    [V, N, 2] broadcast into the reductions, nothing is materialized).
+
+    SYMMETRIC (r4 fix, the load-bearing part): each rescued pair
+    (v, j) contributes both the force ON v and the reaction ON j.
+    Receive-only rescue measured catastrophic at 4096 boids: each
+    capped-out agent is INVISIBLE to its ~14 in-grid neighbors, so 18
+    overflow agents poisoned 248 agents' forces (rel err 1-8,
+    flickering as cells crossed the cap) — exactly the detection-
+    flicker heading noise of docs/PERFORMANCE.md r3b — and the flock
+    decayed to pol ~0.03 where the exact-separation control reaches
+    0.993.  The reaction term excludes j's that are themselves
+    capped-out (their own rescue row already counts the pair)."""
+    n = pos.shape[0]
+    two_hw = 2.0 * hw
+    # First `budget` LIVE overflow agents by sorted order -> their
+    # ORIGINAL indices, padded with n (invalid).  Dead capped-out
+    # agents are skipped so they cannot burn budget slots on rows
+    # that would contribute zero force anyway.
+    sorted_alive = alive[order]
+    live_ovf = ~ok & sorted_alive
+    ovf_rank = jnp.cumsum(live_ovf) - 1
+    v_slot = jnp.where(live_ovf & (ovf_rank < budget), ovf_rank, budget)
+    vidx = (
+        jnp.full((budget + 1,), n, jnp.int32)
+        .at[v_slot].set(order.astype(jnp.int32))[:budget]
+    )
+    vvalid = vidx < n
+    vi = jnp.minimum(vidx, n - 1)
+    in_grid = jnp.zeros((n,), bool).at[order].set(ok)      # [N]
+    vpos = pos[vi]                                         # [V, 2]
+    diff = vpos[:, None, :] - pos[None, :, :]              # fused away
+    diff = jnp.where(
+        diff >= hw, diff - two_hw,
+        jnp.where(diff < -hw, diff + two_hw, diff),
+    )                                                      # min image
+    dist = jnp.sqrt(jnp.sum(diff * diff, axis=-1))         # [V, N]
+    dist_c = jnp.maximum(dist, eps)
+    near = (
+        vvalid[:, None]
+        & (alive[vi])[:, None]
+        & alive[None, :]
+        & (dist < personal_space)
+        & (vi[:, None] != jnp.arange(n)[None, :])          # not self
+    )
+    mag = k_sep / (dist_c * dist_c)
+    contrib = jnp.where(
+        near[..., None], mag[..., None] * diff / dist_c[..., None],
+        0.0,
+    )                                                      # [V, N, 2]
+    f_v = jnp.sum(contrib, axis=1)                         # [V, 2]
+    # Reaction on in-grid partners: -force(v<-j) = force(j<-v).
+    f_react = -jnp.sum(
+        jnp.where(in_grid[None, :, None], contrib, 0.0), axis=0
+    )                                                      # [N, 2]
+    return f_react + (
+        jnp.zeros((n, 2), f_v.dtype)
+        .at[vi].add(jnp.where(vvalid[:, None], f_v, 0.0))
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "k_sep", "personal_space", "eps", "cell", "max_per_cell",
+        "torus_hw", "overflow_budget", "interpret",
+    ),
+)
+def separation_hashgrid_pallas(
+    pos: jax.Array,
+    alive: jax.Array,
+    k_sep: float,
+    personal_space: float,
+    eps: float,
+    cell: float,
+    max_per_cell: int,
+    torus_hw: float,
+    overflow_budget: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Drop-in fused fast path for the torus-mode
+    ``separation_grid`` — same grid semantics (up to the documented
+    occupancy-cap delta above), one VMEM pass.  2-D float32 only;
+    torus worlds only (the cyclic rolls ARE the seam wrap)."""
+    n, d = pos.shape
+    if d != 2:
+        raise ValueError("hash-grid separation kernel is 2-D only")
+    if cell < personal_space:
+        # Mirrors separation_grid: the 3x3 stencil only reaches one
+        # cell out, so a smaller cell would silently drop neighbors.
+        raise ValueError(
+            f"grid cell ({cell}) must be >= personal_space "
+            f"({personal_space}) for the 3x3 stencil to cover the "
+            "separation radius"
+        )
+    K = max_per_cell
+    g, cell_eff = _geometry(torus_hw, cell, K)
+    L = g * K
+    if _VMEM_ROWS * L * 4 > _VMEM_BUDGET:
+        raise ValueError(
+            f"grid row of {L} lanes needs ~{(_VMEM_ROWS * L * 4) >> 20} "
+            f"MiB resident VMEM (budget {_VMEM_BUDGET >> 20} MiB); "
+            "lower max_per_cell or use a coarser cell"
+        )
+
+    key, order, starts, counts = _cell_tables(pos, torus_hw, g)
+    slot, ok = _agent_slots(key, order, starts, K)
+
+    # Scatter-build over a sentinel fill (see module doc for the
+    # measured gather-build negative).  Dead agents write the
+    # sentinel so they exert and receive nothing.
+    slot_s = jnp.where(ok, slot, g * g * K)   # overflow -> scratch
+    sorted_alive = alive[order]
+
+    def plane(v):
+        sv = jnp.where(sorted_alive, v[order], _SENTINEL)
+        return (
+            jnp.full((g * g * K + 1,), _SENTINEL, jnp.float32)
+            .at[slot_s].set(sv.astype(jnp.float32))[:g * g * K]
+            .reshape(g, L)
+        )
+
+    xr = plane(pos[:, 0])
+    yr = plane(pos[:, 1])
+
+    kernel = _make_kernel(
+        float(k_sep), float(personal_space), float(eps),
+        float(torus_hw), K, L,
+    )
+    n_tiles = g // _ROWS
+    col = lambda i: (i, 0)                                   # noqa: E731
+    prev_map = lambda i: (jax.lax.rem(i + n_tiles - 1, n_tiles), 0)  # noqa: E731
+    next_map = lambda i: (jax.lax.rem(i + 1, n_tiles), 0)    # noqa: E731
+    blk = lambda m: pl.BlockSpec(                            # noqa: E731
+        (_ROWS, L), m, memory_space=pltpu.VMEM
+    )
+    fx, fy = pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            blk(prev_map), blk(col), blk(next_map),
+            blk(prev_map), blk(col), blk(next_map),
+        ],
+        out_specs=[blk(col), blk(col)],
+        out_shape=[
+            jax.ShapeDtypeStruct((g, L), jnp.float32),
+            jax.ShapeDtypeStruct((g, L), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xr, xr, xr, yr, yr, yr)
+
+    # Dead agents' slots hold the sentinel, so their computed force
+    # is exactly zero — no receive-side masking needed.
+    slot_c = jnp.minimum(slot, g * g * K - 1)
+    fsx = jnp.where(ok, fx.reshape(-1)[slot_c], 0.0)
+    fsy = jnp.where(ok, fy.reshape(-1)[slot_c], 0.0)
+    force_s = jnp.stack([fsx, fsy], axis=1).astype(pos.dtype)
+    force = jnp.zeros_like(pos).at[order].set(force_s)
+    if overflow_budget > 0:
+        # lax.cond so the O(budget * N) pass costs ~nothing in the
+        # common no-overflow case (uniform swarms, equilibrium
+        # flocks) and only runs during crowding transients.
+        force = force + jax.lax.cond(
+            jnp.any(~ok),
+            lambda: _overflow_rescue(
+                pos, alive, order, ok, float(k_sep),
+                float(personal_space), float(eps), float(torus_hw),
+                int(overflow_budget),
+            ).astype(pos.dtype),
+            lambda: jnp.zeros_like(pos),
+        )
+    return force
+
+
+def hashgrid_supported(
+    dim: int, dtype, torus_hw: float, cell: float, max_per_cell: int
+) -> bool:
+    """True when this configuration is inside the kernel's
+    geometry/dtype/VMEM envelope (the auto-dispatch gate in
+    ops/boids.py).  The caller still owes the kernel's semantic
+    precondition ``cell >= personal_space`` — not checked here
+    because this gate does not see the force parameters (boids
+    always passes ``cell == r_sep == personal_space``)."""
+    if dim != 2 or dtype != jnp.float32:
+        return False
+    if max_per_cell % 8 != 0 or not 8 <= max_per_cell <= 64:
+        return False
+    g = (int(2.0 * torus_hw / cell) // 16) * 16
+    if g < 16:
+        return False
+    return _VMEM_ROWS * g * max_per_cell * 4 <= _VMEM_BUDGET
+
+
+def hashgrid_overflow(
+    pos: jax.Array, cell: float, max_per_cell: int, torus_hw: float
+) -> jax.Array:
+    """Number of agents past the per-cell slot cap — the agents the
+    kernel drops from the grid (they receive force only via the
+    rescue pass, and exert none until they re-enter).  Diagnostic for
+    sizing ``max_per_cell``; 0 means the kernel is exact."""
+    g, cell_eff = _geometry(torus_hw, cell, max_per_cell)
+    key, order, starts, _ = _cell_tables(pos, torus_hw, g)
+    _, ok = _agent_slots(key, order, starts, max_per_cell)
+    return jnp.sum(~ok)

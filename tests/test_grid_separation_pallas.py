@@ -1,0 +1,223 @@
+"""Fused hash-grid separation kernel
+(ops/pallas/grid_separation.py): parity with the portable torus-mode
+``separation_grid`` (allclose when no cell overflows its cap — both
+paths are then exact), cap semantics, seam wrapping, and the geometry
+guards.  Runs the real kernel via ``interpret=True`` on CPU."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_swarm_algorithm_tpu.ops.neighbors import (
+    separation_dense,
+    separation_grid,
+)
+from distributed_swarm_algorithm_tpu.ops.pallas.grid_separation import (
+    hashgrid_overflow,
+    hashgrid_supported,
+    separation_hashgrid_pallas,
+)
+
+# hw/cell chosen so int(2*hw/cell) is already a multiple of 16: the
+# kernel's alignment rounding is then a no-op and both paths tile the
+# torus with the SAME grid, making parity exact rather than a band.
+HW, CELL, PS = 32.0, 2.0, 2.0
+
+
+def _swarm(n, seed=0, hw=HW):
+    key = jax.random.PRNGKey(seed)
+    pos = jax.random.uniform(key, (n, 2), jnp.float32, -hw, hw)
+    alive = jnp.arange(n) % 97 != 0
+    return pos, alive
+
+
+def _assert_match(f_a, f_b):
+    np.testing.assert_allclose(
+        np.asarray(f_a), np.asarray(f_b), rtol=1e-4, atol=1e-3
+    )
+
+
+@pytest.mark.parametrize("k", [8, 16])
+def test_matches_portable_grid(k):
+    pos, alive = _swarm(2048)
+    assert int(hashgrid_overflow(pos, CELL, k, HW)) == 0
+    f_grid = separation_grid(
+        pos, alive, 20.0, PS, 1e-3, cell=CELL, max_per_cell=k,
+        torus_hw=HW,
+    )
+    f_fused = separation_hashgrid_pallas(
+        pos, alive, 20.0, PS, 1e-3, cell=CELL, max_per_cell=k,
+        torus_hw=HW, interpret=True,
+    )
+    _assert_match(f_grid, f_fused)
+
+
+def test_matches_dense_away_from_seam():
+    """Agents kept > personal_space from the torus seam: the plane
+    dense pass is then an independent exact oracle (no wrapping
+    involved), so agreement checks the kernel against a path sharing
+    NO grid machinery with it."""
+    key = jax.random.PRNGKey(1)
+    pos = jax.random.uniform(key, (1024, 2), jnp.float32, -28.0, 28.0)
+    alive = jnp.ones((1024,), bool)
+    assert int(hashgrid_overflow(pos, CELL, 16, HW)) == 0
+    f_dense = separation_dense(pos, alive, 20.0, PS, 1e-3)
+    f_fused = separation_hashgrid_pallas(
+        pos, alive, 20.0, PS, 1e-3, cell=CELL, max_per_cell=16,
+        torus_hw=HW, interpret=True,
+    )
+    # Wider band than the grid-parity tests: the kernel's min-image
+    # mod rounds every displacement once where dense subtracts
+    # directly (identical pair sets — arithmetic-form noise only), and
+    # near-co-located pairs amplify that noise to ~1e-5 of their
+    # ~4.5e3 contributions, which does NOT cancel in agents whose NET
+    # force is small.  So atol scales with the largest contribution.
+    atol = 1e-5 * float(jnp.abs(f_dense).max())
+    np.testing.assert_allclose(
+        np.asarray(f_dense), np.asarray(f_fused), rtol=5e-4, atol=atol
+    )
+
+
+def test_seam_wrap():
+    """A pair straddling the torus seam must repel through it."""
+    pos = jnp.asarray(
+        [[-HW + 0.3, 0.0], [HW - 0.3, 0.0], [0.0, -HW + 0.3],
+         [0.0, HW - 0.3]],
+        jnp.float32,
+    )
+    pos = jnp.concatenate(
+        [pos, _swarm(508, seed=9)[0]]
+    )  # bulk so the grid is populated
+    alive = jnp.ones((512,), bool)
+    f_grid = separation_grid(
+        pos, alive, 20.0, PS, 1e-3, cell=CELL, max_per_cell=16,
+        torus_hw=HW,
+    )
+    f_fused = separation_hashgrid_pallas(
+        pos, alive, 20.0, PS, 1e-3, cell=CELL, max_per_cell=16,
+        torus_hw=HW, interpret=True,
+    )
+    _assert_match(f_grid, f_fused)
+    # The seam pair (0.6 apart through the seam) actually repels.
+    assert float(jnp.abs(f_fused[0]).max()) > 1.0
+
+
+def test_cap_overflow_rescue():
+    """Co-located crowd past the cap: capped-out agents still RECEIVE
+    separation force via the rescue pass (the anti-runaway contract);
+    with the rescue disabled they get exactly zero."""
+    crowd = jnp.tile(jnp.asarray([[1.05, 1.05]], jnp.float32), (12, 1))
+    crowd = crowd + 0.01 * jnp.arange(12, dtype=jnp.float32)[:, None]
+    pos = jnp.concatenate([crowd, _swarm(500, seed=3)[0]])
+    alive = jnp.ones((512,), bool)
+    dropped = int(hashgrid_overflow(pos, CELL, 8, HW))
+    assert dropped >= 4            # 12 co-located, cap 8
+    f = separation_hashgrid_pallas(
+        pos, alive, 20.0, PS, 1e-3, cell=CELL, max_per_cell=8,
+        torus_hw=HW, interpret=True,
+    )
+    assert bool(jnp.all(jnp.isfinite(f)))
+    # every crowd member — in-grid or capped-out — feels repulsion
+    assert float(jnp.min(jnp.max(jnp.abs(f[:12]), axis=1))) > 0.0
+    f0 = separation_hashgrid_pallas(
+        pos, alive, 20.0, PS, 1e-3, cell=CELL, max_per_cell=8,
+        torus_hw=HW, overflow_budget=0, interpret=True,
+    )
+    # Stable sort keeps the crowd (indices 0-11) at within-cell ranks
+    # 0-11, so exactly 4 of them are past cap 8.
+    n_zero = int(jnp.sum(jnp.max(jnp.abs(f0[:12]), axis=1) == 0.0))
+    assert n_zero == 4
+
+
+def test_rescue_matches_dense_for_overflow():
+    """Rescued agents' force equals the dense oracle's (identical
+    pair math: the rescue pass IS a masked dense row)."""
+    crowd = jnp.tile(jnp.asarray([[5.0, 5.0]], jnp.float32), (20, 1))
+    crowd = crowd + 0.02 * jnp.arange(20, dtype=jnp.float32)[:, None]
+    pos = jnp.concatenate([crowd, _swarm(236, seed=13)[0]])
+    alive = jnp.ones((256,), bool)
+    f_dense = separation_dense(pos, alive, 20.0, PS, 1e-3)
+    f = separation_hashgrid_pallas(
+        pos, alive, 20.0, PS, 1e-3, cell=CELL, max_per_cell=8,
+        torus_hw=HW, interpret=True,
+    )
+    # The 12 capped-out crowd members take the rescue path; their
+    # rows must match dense.  Band: the rescue's min-image mod costs
+    # ~ulp(hw + x) ~ 4e-6 per displacement component, which on the
+    # crowd's 0.028-spacing pairs is ~2e-4 relative, amplified ~3x
+    # through the 1/d^3 force chain.
+    atol = 1e-5 * float(jnp.abs(f_dense).max())
+    np.testing.assert_allclose(
+        np.asarray(f[8:20]), np.asarray(f_dense[8:20]),
+        rtol=2e-3, atol=atol,
+    )
+
+
+def test_dead_agents_inert():
+    pos, _ = _swarm(512, seed=7)
+    alive = jnp.zeros((512,), bool)
+    f = separation_hashgrid_pallas(
+        pos, alive, 20.0, PS, 1e-3, cell=CELL, max_per_cell=16,
+        torus_hw=HW, interpret=True,
+    )
+    assert float(jnp.abs(f).max()) == 0.0
+
+
+def test_gridmean_backend_equivalence():
+    """boids gridmean forces: fused backend == portable backend.
+    Geometry may differ (the kernel rounds g down for alignment) but
+    with zero cell overflow both detect exactly the same pairs."""
+    from distributed_swarm_algorithm_tpu.ops import boids as bk
+
+    state = bk.boids_init(512, 2, seed=11)
+    f_port = bk.boids_forces_gridmean(
+        state, bk.BoidsParams(grid_sep_backend="portable")
+    )
+    f_fused = bk.boids_forces_gridmean(
+        state, bk.BoidsParams(grid_sep_backend="pallas")
+    )
+    _assert_match(f_port, f_fused)
+
+
+def test_gridmean_pallas_scan_runs():
+    """The fused backend under boids_run's lax.scan (the production
+    shape): a short flock run stays finite and ordered."""
+    from distributed_swarm_algorithm_tpu.ops import boids as bk
+
+    state = bk.boids_init(256, 2, seed=2)
+    params = bk.BoidsParams(grid_sep_backend="pallas")
+    state, _ = bk.boids_run(
+        state, params, 30, neighbor_mode="gridmean"
+    )
+    assert bool(jnp.all(jnp.isfinite(state.pos)))
+    assert bool(jnp.all(jnp.isfinite(state.vel)))
+
+
+def test_validation_and_support_gate():
+    pos, alive = _swarm(256)
+    with pytest.raises(ValueError, match="2-D"):
+        separation_hashgrid_pallas(
+            jnp.zeros((64, 3)), alive[:64], 1.0, 1.0, 1e-3,
+            cell=2.0, max_per_cell=16, torus_hw=HW, interpret=True,
+        )
+    with pytest.raises(ValueError, match="personal_space"):
+        separation_hashgrid_pallas(
+            pos, alive, 1.0, 4.0, 1e-3, cell=2.0, max_per_cell=16,
+            torus_hw=HW, interpret=True,
+        )
+    with pytest.raises(ValueError, match="max_per_cell"):
+        separation_hashgrid_pallas(
+            pos, alive, 1.0, 1.0, 1e-3, cell=2.0, max_per_cell=12,
+            torus_hw=HW, interpret=True,
+        )
+    with pytest.raises(ValueError, match="grid rows"):
+        # 2hw/cell = 6 cells < 8 aligned rows.
+        separation_hashgrid_pallas(
+            pos, alive, 1.0, 1.0, 1e-3, cell=2.0, max_per_cell=16,
+            torus_hw=6.0, interpret=True,
+        )
+    assert hashgrid_supported(2, jnp.float32, HW, CELL, 16)
+    assert not hashgrid_supported(3, jnp.float32, HW, CELL, 16)
+    assert not hashgrid_supported(2, jnp.float32, 6.0, CELL, 16)
+    assert not hashgrid_supported(2, jnp.float32, HW, CELL, 12)

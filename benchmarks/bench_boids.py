@@ -6,12 +6,13 @@ comparable across sizes.  A million-boid flock is impossible for the
 dense pass (the [N, N] interaction would need ~4 TB); the window pass
 runs it in real time.
 
-"gridmean" is the r3 flocking-QUALITY mode: particle-in-cell
-alignment/cohesion + exact torus-hash separation, polarization
-0.993–0.997 vs dense 0.995 where window mode plateaus at 0.82 — at a
-measured gather-bound cost (docs/PERFORMANCE.md has the full story and
-the trade-off table; its row here is capped at 65k, and single calls
-are kept short — long scans at 1M crash the TPU worker).
+"gridmean" is the flocking-QUALITY mode: CIC-field alignment/cohesion
++ exact stable hash-grid separation.  r4 rebuilt both halves — the
+Pallas cell-slot kernel (ops/pallas/grid_separation.py) replaces the
+gather-bound portable path (258 -> ~16 ms/step at 65k, and the 1M
+long-scan worker crash is gone), and bilinear CIC deposit replaces
+nearest-cell (polarization 0.991 at 65k where the r3 field broke past
+4096 boids).  docs/PERFORMANCE.md has the full story.
 """
 
 from __future__ import annotations
@@ -21,16 +22,23 @@ from common import report, timeit_best
 from distributed_swarm_algorithm_tpu.models.boids import Boids
 
 CONFIGS = [
-    (16_384, 113.0, "dense", 100),
-    (16_384, 113.0, "window", 200),
-    (1_048_576, 905.0, "window", 50),
-    (65_536, 226.0, "gridmean", 20),
+    (16_384, 113.0, "dense", 100, {}),
+    (16_384, 113.0, "window", 200, {}),
+    (1_048_576, 905.0, "window", 50, {}),
+    # K=24: zero overflow at flock equilibrium (measured 65k/14k
+    # steps), kernel cost between K=16 and the conservative K=32.
+    (65_536, 226.0, "gridmean", 50, {"grid_max_per_cell": 24}),
+    # 1M gridmean: the r3 portable path crashed the TPU worker here;
+    # the VMEM budget caps the cell cap at K=16 at this world size
+    # (short-horizon exact; long-horizon compaction needs the
+    # documented lane-tiled extension).
+    (1_048_576, 905.0, "gridmean", 20, {}),
 ]
 
 
 def main() -> None:
-    for n, hw, mode, steps in CONFIGS:
-        flock = Boids(n=n, seed=0, half_width=hw, neighbor_mode=mode)
+    for n, hw, mode, steps, kw in CONFIGS:
+        flock = Boids(n=n, seed=0, half_width=hw, neighbor_mode=mode, **kw)
         flock.run(steps)                          # compile + warm
         best = timeit_best(
             lambda: flock.run(steps),

@@ -101,6 +101,24 @@ class BoidsParams(NamedTuple):
     # separation ~dense).  grid_max_per_cell caps hash-cell occupancy.
     align_cell: float = 8.0
     grid_max_per_cell: int = 16
+    # Field deposit/sample scheme for gridmean align/cohesion.
+    # "bilinear" (CIC, r4 default): each boid deposits into its 2x2
+    # nearest cell corners with bilinear weights and samples the
+    # field bilinearly — spatially CONTINUOUS coupling.  "nearest"
+    # (r3): deposit whole into one cell, 3x3 tent pool, sample at own
+    # cell — the field a boid sees JUMPS as it crosses cell
+    # boundaries, and at >=4096 boids those jumps break global
+    # ordering: measured 6000-step polarization at 4096 (3 seeds)
+    # 0.995-0.996 bilinear vs 0.44-0.99 nearest (basin-dependent),
+    # with healthier spacing (NN 0.55 vs 0.36); at 512 both match
+    # dense (the r3 result that did not generalize).
+    align_deposit: str = "bilinear"
+    # Rescue budget for the fused separation kernel: max capped-out
+    # agents per step that still get exact (symmetric) separation via
+    # the dense rescue pass.  Size to the transient worst case —
+    # overflow beyond it silently gets zero separation (the kernel
+    # module doc has the measured runaway this prevents); 0 disables.
+    grid_overflow_budget: int = 512
     # Separation backend for gridmean mode.  "auto" = the fused
     # Pallas hash-grid kernel (ops/pallas/grid_separation.py) on TPU
     # when the configuration qualifies (2-D f32, >=16 grid rows after
@@ -333,6 +351,17 @@ def boids_forces_gridmean(
 ) -> jax.Array:
     """Reynolds forces with particle-in-cell alignment/cohesion.
 
+    r4 updates to the r3 design described below: (1) separation
+    dispatches to the fused Pallas hash-grid kernel on TPU
+    (``grid_sep_backend``, ops/pallas/grid_separation.py — same
+    detection semantics, ~20x cheaper, no 1M worker crash); (2) the
+    field deposit defaults to bilinear CIC (``align_deposit`` —
+    nearest-cell deposit granularity measured scale-breaking at
+    >=4096 boids, see BoidsParams).  With both: 65k boids reach
+    polarization 0.991 (t=14k, zero cell overflow) at ~16 ms/step vs
+    the r3 path's 258 ms/step — quality and scale are no longer an
+    either/or.
+
     Separation (short-range, 1/d² — the collision-avoidance contract)
     uses the torus-aware spatial-hash kernel
     (``ops/neighbors.py:separation_grid``): exact up to the occupancy
@@ -417,7 +446,9 @@ def boids_forces_gridmean(
             pos, jnp.ones((n,), bool), 1.0, float(p.r_sep),
             float(p.eps), cell=float(p.r_sep),
             max_per_cell=p.grid_max_per_cell,
-            torus_hw=float(p.half_width), interpret=not on_tpu(),
+            torus_hw=float(p.half_width),
+            overflow_budget=p.grid_overflow_budget,
+            interpret=not on_tpu(),
         )
     else:
         sep = _neighbors.separation_grid(
@@ -426,41 +457,114 @@ def boids_forces_gridmean(
             torus_hw=p.half_width,
         )
 
-    # --- alignment + cohesion: tent-pooled grid field -------------------
+    # --- alignment + cohesion: grid velocity/centroid field -------------
     hw = p.half_width
     g = max(1, int(round(2.0 * hw / p.align_cell)))
     cell = 2.0 * hw / g                       # tiles the torus exactly
-    ci = jnp.clip(
-        jnp.floor((pos + hw) / cell).astype(jnp.int32), 0, g - 1
-    )                                                       # [N, 2]
-    center = (ci.astype(pos.dtype) + 0.5) * cell - hw
-    rel = _wrap(pos - center, hw)             # cell-local, seam-safe
-    dep = jnp.concatenate(
-        [vel, rel, jnp.ones((n, 1), pos.dtype)], axis=1
-    )                                                       # [N, 5]
-    grid = jnp.zeros((g, g, 5), pos.dtype).at[ci[:, 0], ci[:, 1]].add(dep)
+    if p.align_deposit == "bilinear":
+        # CIC: deposit into the 2x2 nearest cell corners with
+        # bilinear weights, sample bilinearly — the field a boid sees
+        # varies continuously with position (see BoidsParams for the
+        # measured nearest-vs-bilinear ordering result).  Position
+        # sums are stored relative to each receiving cell's CENTER so
+        # the toroidal seam never tears the centroid.
+        u = (pos + hw) / cell - 0.5
+        i0 = jnp.floor(u).astype(jnp.int32)
+        frac = u - i0.astype(pos.dtype)
 
-    pooled = jnp.zeros_like(grid)
-    for dx in (-1, 0, 1):
-        for dy in (-1, 0, 1):
-            w = (2 - abs(dx)) * (2 - abs(dy)) / 16.0
-            gshift = jnp.roll(grid, (dx, dy), axis=(0, 1))  # periodic
-            # Neighbor cells' position sums are relative to THEIR
-            # centers; re-express relative to the receiving cell.
-            off = jnp.asarray([dx * cell, dy * cell], pos.dtype)
-            gshift = gshift.at[..., 2:4].add(-gshift[..., 4:5] * off)
-            pooled = pooled + w * gshift
+        # Four separate corner scatters/gathers.  Measured negative
+        # (r4): batching them as [4n] concatenated index arrays (one
+        # scatter, one gather) was 25% SLOWER at 65k — the tiles and
+        # concats materialize [4n, 5] intermediates that cost more
+        # than the three saved scatter launches.
+        def corners():
+            for dx in (0, 1):
+                for dy in (0, 1):
+                    w = (
+                        jnp.where(dx == 0, 1 - frac[:, 0], frac[:, 0])
+                        * jnp.where(dy == 0, 1 - frac[:, 1], frac[:, 1])
+                    )
+                    ci = jnp.mod(i0[:, 0] + dx, g)
+                    cj = jnp.mod(i0[:, 1] + dy, g)
+                    center = jnp.stack(
+                        [
+                            (ci.astype(pos.dtype) + 0.5) * cell - hw,
+                            (cj.astype(pos.dtype) + 0.5) * cell - hw,
+                        ],
+                        axis=1,
+                    )
+                    yield w, ci, cj, center
 
-    samp = pooled[ci[:, 0], ci[:, 1]]                       # [N, 5]
-    cnt = jnp.maximum(samp[:, 4:5], 1e-6)
-    # Self deposits exactly 0.25 into the pooled count (tent center
-    # weight 4/16); anything above that means some OTHER boid is in the
-    # pooled patch — matching dense's no-neighbor gate for a lone boid.
-    has = samp[:, 4:5] > 0.26
-    mean_vel = samp[:, :d] / cnt
-    centroid_rel = samp[:, d:2 * d] / cnt + _wrap(center - pos, hw)
-    align = jnp.where(has, mean_vel - vel, 0.0)
-    coh = jnp.where(has, centroid_rel, 0.0)
+        grid = jnp.zeros((g, g, 2 * d + 1), pos.dtype)
+        for w, ci, cj, center in corners():
+            rel = _wrap(pos - center, hw)
+            depc = jnp.concatenate(
+                [vel, rel, jnp.ones((n, 1), pos.dtype)], axis=1
+            )
+            grid = grid.at[ci, cj].add(w[:, None] * depc)
+
+        samp = jnp.zeros((n, 2 * d + 1), pos.dtype)
+        for w, ci, cj, center in corners():
+            gv = grid[ci, cj]
+            # Corner cells' position sums are relative to THEIR
+            # centers; re-express relative to this boid.
+            adj = gv.at[:, d:2 * d].add(
+                gv[:, 2 * d:] * _wrap(center - pos, hw)
+            )
+            samp = samp + w[:, None] * adj
+        # No presence gate needed: self-sampling is exactly
+        # force-free (per corner, the self deposit w*(pos - center)
+        # plus the sample-side re-centering w*(center - pos) cancel
+        # identically, and the self mean-velocity is the boid's own),
+        # and the count can never hit 0 — a lone boid always
+        # self-samples sum(w^2) >= 0.25, so a lone boid feels zero
+        # force, matching dense's no-neighbor case.
+        cnt = jnp.maximum(samp[:, 2 * d:], 1e-6)
+        align = samp[:, :d] / cnt - vel
+        coh = samp[:, d:2 * d] / cnt
+    elif p.align_deposit == "nearest":
+        ci = jnp.clip(
+            jnp.floor((pos + hw) / cell).astype(jnp.int32), 0, g - 1
+        )                                                   # [N, 2]
+        center = (ci.astype(pos.dtype) + 0.5) * cell - hw
+        rel = _wrap(pos - center, hw)         # cell-local, seam-safe
+        dep = jnp.concatenate(
+            [vel, rel, jnp.ones((n, 1), pos.dtype)], axis=1
+        )                                                   # [N, 5]
+        grid = (
+            jnp.zeros((g, g, 5), pos.dtype)
+            .at[ci[:, 0], ci[:, 1]].add(dep)
+        )
+
+        pooled = jnp.zeros_like(grid)
+        for dx in (-1, 0, 1):
+            for dy in (-1, 0, 1):
+                w = (2 - abs(dx)) * (2 - abs(dy)) / 16.0
+                gshift = jnp.roll(grid, (dx, dy), axis=(0, 1))  # periodic
+                # Neighbor cells' position sums are relative to THEIR
+                # centers; re-express relative to the receiving cell.
+                off = jnp.asarray([dx * cell, dy * cell], pos.dtype)
+                gshift = gshift.at[..., 2:4].add(
+                    -gshift[..., 4:5] * off
+                )
+                pooled = pooled + w * gshift
+
+        samp = pooled[ci[:, 0], ci[:, 1]]                   # [N, 5]
+        cnt = jnp.maximum(samp[:, 4:5], 1e-6)
+        # Self deposits exactly 0.25 into the pooled count (tent
+        # center weight 4/16); anything above that means some OTHER
+        # boid is in the pooled patch — matching dense's no-neighbor
+        # gate for a lone boid.
+        has = samp[:, 4:5] > 0.26
+        mean_vel = samp[:, :d] / cnt
+        centroid_rel = samp[:, d:2 * d] / cnt + _wrap(center - pos, hw)
+        align = jnp.where(has, mean_vel - vel, 0.0)
+        coh = jnp.where(has, centroid_rel, 0.0)
+    else:
+        raise ValueError(
+            f"unknown align_deposit {p.align_deposit!r}; "
+            "expected 'bilinear' or 'nearest'"
+        )
 
     acc = p.w_sep * sep + p.w_align * align + p.w_coh * coh
     acc = acc + _obstacle_acc(pos, obstacles, p)

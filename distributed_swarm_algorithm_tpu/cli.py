@@ -341,7 +341,9 @@ def _cmd_pso_islands(args) -> int:
         )
     st = island_init(fn, n_islands=args.islands, n_per_island=n_per,
                      dim=args.dim, half_width=hw, seed=args.seed)
-    use_fused = on_tpu() and pallas_supported(args.objective, st.pso.pos.dtype)
+    use_fused = on_tpu() and pallas_supported(
+        args.objective, st.pso.pos.dtype, st.pso.pos.shape[-1]
+    )
     start = time.perf_counter()
     if use_fused:
         from .ops.pallas.islands_fused import fused_island_run

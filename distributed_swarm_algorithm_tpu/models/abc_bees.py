@@ -62,7 +62,8 @@ class ABC(CheckpointMixin):
             n >= 512            # rotational partners need >= 4 lane tiles
             and self.objective_name is not None
             and _af.abc_pallas_supported(
-                self.objective_name or "", self.state.pos.dtype
+                self.objective_name or "", self.state.pos.dtype,
+                self.state.pos.shape[-1],
             )
         )
         if use_pallas is None:

@@ -69,7 +69,8 @@ class Bat(CheckpointMixin):
         )
         supported = self.objective_name is not None and (
             _bf.bat_pallas_supported(
-                self.objective_name, self.state.pos.dtype
+                self.objective_name, self.state.pos.dtype,
+                self.state.pos.shape[-1],
             )
         )
         if use_pallas is None:

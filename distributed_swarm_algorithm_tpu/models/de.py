@@ -72,7 +72,8 @@ class DE(CheckpointMixin):
             and n >= 512          # rotational donors need >= 4 lane tiles
             and self.objective_name is not None
             and _df.de_pallas_supported(
-                self.objective_name or "", self.state.pos.dtype
+                self.objective_name or "", self.state.pos.dtype,
+                self.state.pos.shape[-1],
             )
         )
         if use_pallas is None:

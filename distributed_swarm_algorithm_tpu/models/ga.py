@@ -75,7 +75,8 @@ class GA(CheckpointMixin):
             # on the portable path, like DE's variant gate
             and n_elite == _k.N_ELITE
             and _gf.ga_pallas_supported(
-                self.objective_name or "", self.state.pos.dtype
+                self.objective_name or "", self.state.pos.dtype,
+                self.state.pos.shape[-1],
             )
         )
         if use_pallas is None:

@@ -62,7 +62,8 @@ class GWO(CheckpointMixin):
         )
         supported = self.objective_name is not None and (
             _gf.gwo_pallas_supported(
-                self.objective_name, self.state.pos.dtype
+                self.objective_name, self.state.pos.dtype,
+                self.state.pos.shape[-1],
             )
         )
         if use_pallas is None:

@@ -60,7 +60,8 @@ class HarrisHawks(CheckpointMixin):
             n >= 512            # rotational peers need >= 4 lane tiles
             and self.objective_name is not None
             and _hf.hho_pallas_supported(
-                self.objective_name or "", self.state.pos.dtype
+                self.objective_name or "", self.state.pos.dtype,
+                self.state.pos.shape[-1],
             )
         )
         if use_pallas is None:

@@ -58,7 +58,8 @@ class MFO(CheckpointMixin):
         supported = (
             self.objective_name is not None
             and _mf.mfo_pallas_supported(
-                self.objective_name or "", self.state.pos.dtype
+                self.objective_name or "", self.state.pos.dtype,
+                self.state.pos.shape[-1],
             )
         )
         if use_pallas is None:

@@ -85,15 +85,24 @@ class NSGA2(CheckpointMixin):
         positional mapping with a zero-filled violation vector."""
         from ..utils import checkpoint as _ckpt
 
-        try:
-            self.state = _ckpt.restore(path, self.state)
-            return
-        except KeyError:
-            pass  # legacy .npz layout without viol — migrate below
         import jax.numpy as jnp
         import numpy as np
 
-        data = np.load(path if path.endswith(".npz") else path + ".npz")
+        if not path.endswith(".npz"):
+            self.state = _ckpt.restore(path, self.state)
+            return
+        data = np.load(path)
+        if (
+            "__schema_version__" in data.files
+            or len([k for k in data.files if k.startswith("leaf_")])
+            == len(jax.tree_util.tree_leaves(self.state))
+        ):
+            # Current schema, or positional with matching leaf count:
+            # the generic restore handles it (and its named errors
+            # must propagate, not be swallowed into the migration).
+            self.state = _ckpt.restore(path, self.state)
+            return
+        # Legacy pre-viol layout: 6 positional leaves.
         legacy = [jnp.asarray(data[f"leaf_{i}"]) for i in range(6)]
         pos, objs, rank, crowd, key, iteration = legacy
         self.state = self.state.replace(

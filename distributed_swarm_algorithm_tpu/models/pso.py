@@ -78,7 +78,8 @@ class PSO(CheckpointMixin):
             topology == "gbest"
             and self.objective_name is not None
             and _pf.pallas_supported(
-                self.objective_name or "", self.state.pos.dtype
+                self.objective_name or "", self.state.pos.dtype,
+                self.state.pos.shape[-1],
             )
         )
         if use_pallas is None:

@@ -66,7 +66,8 @@ class Salp(CheckpointMixin):
             n >= 128            # one full lane tile
             and self.objective_name is not None
             and _sf.salp_pallas_supported(
-                self.objective_name or "", self.state.pos.dtype
+                self.objective_name or "", self.state.pos.dtype,
+                self.state.pos.shape[-1],
             )
         )
         if use_pallas is None:

@@ -67,7 +67,8 @@ class SHADE(CheckpointMixin):
             and n >= 512            # rotational donors need >= 4 tiles
             and self.objective_name is not None
             and _sf.shade_pallas_supported(
-                self.objective_name or "", self.state.pos.dtype
+                self.objective_name or "", self.state.pos.dtype,
+                self.state.pos.shape[-1],
             )
         )
         if use_pallas is None:

@@ -72,7 +72,8 @@ class ParallelTempering(CheckpointMixin):
             n >= 128            # one full lane tile
             and self.objective_name is not None
             and _tf.pt_pallas_supported(
-                self.objective_name or "", self.state.pos.dtype
+                self.objective_name or "", self.state.pos.dtype,
+                self.state.pos.shape[-1],
             )
         )
         if use_pallas is None:

@@ -66,7 +66,8 @@ class WOA(CheckpointMixin):
         supported = (
             self.objective_name is not None
             and _wf.woa_pallas_supported(
-                self.objective_name or "", self.state.pos.dtype
+                self.objective_name or "", self.state.pos.dtype,
+                self.state.pos.shape[-1],
             )
         )
         if use_pallas is None:

@@ -461,6 +461,20 @@ def boids_forces_gridmean(
     hw = p.half_width
     g = max(1, int(round(2.0 * hw / p.align_cell)))
     cell = 2.0 * hw / g                       # tiles the torus exactly
+    # Tiny-grid guards (advisor r3): with g < 3 the nearest branch's
+    # 3x3 tent pool would roll(+-1) onto the same cell twice,
+    # double-counting deposits with inconsistent center offsets; with
+    # g < 2 the bilinear corners collapse onto one cell.  Mirror
+    # separation_grid's torus guard instead of corrupting silently.
+    g_min = 2 if p.align_deposit == "bilinear" else 3
+    if g < g_min:
+        raise ValueError(
+            f"align grid of {g} cells (align_cell={p.align_cell}, "
+            f"world [-{hw}, {hw})) is below the {g_min}-cell minimum "
+            f"for align_deposit={p.align_deposit!r}; use "
+            "neighbor_mode='dense' for such tiny worlds or shrink "
+            "align_cell"
+        )
     if p.align_deposit == "bilinear":
         # CIC: deposit into the 2x2 nearest cell corners with
         # bilinear weights, sample bilinearly — the field a boid sees

@@ -61,7 +61,8 @@ from jax.experimental.pallas import tpu as pltpu
 from ..abc import ABCState
 from .common import ceil_to as _ceil_to, cyclic_pad_rows as _cyclic_pad_rows
 from .de_fused import _LANE_SHIFTS, shrink_tile_for_donors
-from .pso_fused import (
+from .pso_fused import (  # noqa: F401
+    pallas_supported,
     OBJECTIVES_T,
     _auto_tile,
     _uniform_bits,
@@ -86,8 +87,9 @@ def host_draws(host_key, call_i, pos_shape, fit_shape, fold=None):
     ) + (jax.random.uniform(ks[5], pos_shape, jnp.float32),)
 
 
-def abc_pallas_supported(objective_name, dtype) -> bool:
-    return objective_name in OBJECTIVES_T and jnp.dtype(dtype) == jnp.float32
+# The support gate (incl. the michalewicz poly-trig D bound)
+# is the central one — every family shares OBJECTIVES_T.
+abc_pallas_supported = pallas_supported
 
 
 def _quality(fit):

@@ -33,7 +33,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..bat import ALPHA, BatState, F_MAX, F_MIN, GAMMA, R0, SIGMA_LOCAL
 from .common import ceil_to as _ceil_to, cyclic_pad_rows as _cyclic_pad_rows
-from .pso_fused import (
+from .pso_fused import (  # noqa: F401
+    pallas_supported,
     OBJECTIVES_T,
     _auto_tile,
     _uniform_bits,
@@ -43,8 +44,9 @@ from .pso_fused import (
 )
 
 
-def bat_pallas_supported(objective_name, dtype) -> bool:
-    return objective_name in OBJECTIVES_T and jnp.dtype(dtype) == jnp.float32
+# The support gate (incl. the michalewicz poly-trig D bound)
+# is the central one — every family shares OBJECTIVES_T.
+bat_pallas_supported = pallas_supported
 
 
 def _make_kernel(

@@ -51,7 +51,8 @@ from ..cuckoo import (
 from .common import ceil_to as _ceil_to, cyclic_pad_rows as _cyclic_pad_rows
 from .de_fused import _LANE_SHIFTS, shrink_tile_for_donors
 from .firefly_fused import exp2_fast as _exp2_fast
-from .pso_fused import (
+from .pso_fused import (  # noqa: F401
+    pallas_supported,
     OBJECTIVES_T,
     _auto_tile,
     _cos2pi,
@@ -94,8 +95,9 @@ def _normal_pair(shape):
     return r * _cos2pi(u2), r * _sin2pi(u2)
 
 
-def cuckoo_pallas_supported(objective_name, dtype) -> bool:
-    return objective_name in OBJECTIVES_T and jnp.dtype(dtype) == jnp.float32
+# The support gate (incl. the michalewicz poly-trig D bound)
+# is the central one — every family shares OBJECTIVES_T.
+cuckoo_pallas_supported = pallas_supported
 
 
 def host_draws(host_key, call_i, pos_shape, fit_shape, fold=None):

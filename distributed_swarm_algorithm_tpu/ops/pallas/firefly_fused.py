@@ -43,7 +43,7 @@ from ..firefly import (
     GAMMA,
 )
 from .common import ceil_to as _ceil_to
-from .pso_fused import OBJECTIVES_T
+from .pso_fused import pallas_supported, OBJECTIVES_T
 
 # Measured (16k fireflies, D=30, v5e): 512x2048 gives 6.2 ms/gen vs
 # 8.8 at 256x512 and 7.8 for the portable XLA [N, N] step; larger
@@ -202,8 +202,9 @@ def firefly_attraction_pallas(
     return (move[:n] - wsum[:n] * pos_p[:n]).astype(pos.dtype)
 
 
-def firefly_pallas_supported(objective_name, dtype) -> bool:
-    return objective_name in OBJECTIVES_T and jnp.dtype(dtype) == jnp.float32
+# The support gate (incl. the michalewicz poly-trig D bound)
+# is the central one — every family shares OBJECTIVES_T.
+firefly_pallas_supported = pallas_supported
 
 
 @partial(

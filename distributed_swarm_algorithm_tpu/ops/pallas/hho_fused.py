@@ -30,7 +30,8 @@ from ..hho import LEVY_BETA, T_MAX, HHOState
 from .common import ceil_to as _ceil_to, cyclic_pad_rows as _cyclic_pad_rows
 from .cuckoo_fused import _exp2_fast, _log2_fast, _normal_pair
 from .de_fused import _LANE_SHIFTS, shrink_tile_for_donors
-from .pso_fused import (
+from .pso_fused import (  # noqa: F401
+    pallas_supported,
     OBJECTIVES_T,
     _auto_tile,
     _uniform_bits,
@@ -64,8 +65,9 @@ def host_draws(host_key, call_i, pos_shape, fit_shape, fold=None):
     return tuple(rows + planes + normals)
 
 
-def hho_pallas_supported(objective_name, dtype) -> bool:
-    return objective_name in OBJECTIVES_T and jnp.dtype(dtype) == jnp.float32
+# The support gate (incl. the michalewicz poly-trig D bound)
+# is the central one — every family shares OBJECTIVES_T.
+hho_pallas_supported = pallas_supported
 
 
 def _make_kernel(objective_t, half_width, t_max, beta, sigma, host_rng,

@@ -54,7 +54,8 @@ from jax.experimental.pallas import tpu as pltpu
 from ..mfo import SPIRAL_B, T_MAX, MFOState
 from .common import ceil_to as _ceil_to, cyclic_pad_rows as _cyclic_pad_rows
 from .firefly_fused import _LOG2E, exp2_fast
-from .pso_fused import (
+from .pso_fused import (  # noqa: F401
+    pallas_supported,
     OBJECTIVES_T,
     _auto_tile,
     _cos2pi,
@@ -71,8 +72,9 @@ def resort_flames(flame_pos_t, flame_fit):
     return flame_pos_t[:, order], flame_fit[order]
 
 
-def mfo_pallas_supported(objective_name, dtype) -> bool:
-    return objective_name in OBJECTIVES_T and jnp.dtype(dtype) == jnp.float32
+# The support gate (incl. the michalewicz poly-trig D bound)
+# is the central one — every family shares OBJECTIVES_T.
+mfo_pallas_supported = pallas_supported
 
 
 def _make_kernel(objective_t, half_width, b, host_rng, k_steps, tile_n):

@@ -53,14 +53,15 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..shade import CR_SCALE, F_SCALE, H, SHADEState
 from .common import ceil_to as _ceil_to, cyclic_pad_rows as _cyclic_pad_rows
-from .pso_fused import OBJECTIVES_T, _auto_tile, _uniform_bits, seed_base
+from .pso_fused import pallas_supported, OBJECTIVES_T, _auto_tile, _uniform_bits, seed_base
 
 _ELITE = 128          # pbest pool width (one lane block)
 _FRAC_FX = 1 << 16    # fixed-point denominator for the archive fraction
 
 
-def shade_pallas_supported(objective_name, dtype) -> bool:
-    return objective_name in OBJECTIVES_T and jnp.dtype(dtype) == jnp.float32
+# The support gate (incl. the michalewicz poly-trig D bound)
+# is the central one — every family shares OBJECTIVES_T.
+shade_pallas_supported = pallas_supported
 
 
 def _make_kernel(objective_t, half_width, host_rng):

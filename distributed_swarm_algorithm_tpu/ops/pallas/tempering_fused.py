@@ -49,7 +49,8 @@ from ..tempering import SIGMA0, SWAP_EVERY, PTState
 from .common import ceil_to as _ceil_to, cyclic_pad_rows as _cyclic_pad_rows
 from .cuckoo_fused import _normal_pair
 from .firefly_fused import _exp_fast
-from .pso_fused import (
+from .pso_fused import (  # noqa: F401
+    pallas_supported,
     OBJECTIVES_T,
     _auto_tile,
     _uniform_bits,
@@ -79,8 +80,9 @@ def host_draws(host_key, call_i, pos_shape, fit_shape, fold=None):
     )
 
 
-def pt_pallas_supported(objective_name, dtype) -> bool:
-    return objective_name in OBJECTIVES_T and jnp.dtype(dtype) == jnp.float32
+# The support gate (incl. the michalewicz poly-trig D bound)
+# is the central one — every family shares OBJECTIVES_T.
+pt_pallas_supported = pallas_supported
 
 
 def _make_kernel(objective_t, half_width, swap_every, host_rng,

@@ -40,7 +40,8 @@ from jax.experimental.pallas import tpu as pltpu
 from ..woa import SPIRAL_B, WOAState
 from .common import ceil_to as _ceil_to, cyclic_pad_rows as _cyclic_pad_rows
 from .de_fused import _LANE_SHIFTS
-from .pso_fused import (
+from .pso_fused import (  # noqa: F401
+    pallas_supported,
     OBJECTIVES_T,
     _auto_tile,
     _cos2pi,
@@ -52,8 +53,9 @@ from .pso_fused import (
 )
 
 
-def woa_pallas_supported(objective_name, dtype) -> bool:
-    return objective_name in OBJECTIVES_T and jnp.dtype(dtype) == jnp.float32
+# The support gate (incl. the michalewicz poly-trig D bound)
+# is the central one — every family shares OBJECTIVES_T.
+woa_pallas_supported = pallas_supported
 
 
 def _make_kernel(objective_t, half_width, t_max, spiral_b, host_rng,

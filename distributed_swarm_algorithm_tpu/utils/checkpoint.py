@@ -5,6 +5,14 @@ resume: absent").  Because all framework state is a pytree of arrays
 (SwarmState, PSOState, IslandPSOState), checkpointing is generic: orbax
 when available (async-friendly, sharding-aware), with a numpy ``.npz``
 fallback that has zero extra dependencies.
+
+.npz schema (v2, r4 — advisor finding): leaves are keyed by their
+PYTREE PATH (``f:.pos``, ``f:.vel``, ...) plus a ``__schema_version__``
+marker, not by flatten position.  Positional ``leaf_i`` keys silently
+misalign when a struct gains a field mid-series (SwarmState grew
+``alive_below``/``leader_live`` in r3).  v1 (positional) files still
+restore when the leaf count matches, and every mismatch dies with a
+named, actionable error instead of a KeyError.
 """
 
 from __future__ import annotations
@@ -17,12 +25,20 @@ import numpy as np
 
 T = TypeVar("T")
 
+_VERSION = 2
+
 try:  # pragma: no cover - exercised indirectly
     import orbax.checkpoint as ocp
 
     _HAVE_ORBAX = True
 except Exception:  # pragma: no cover
     _HAVE_ORBAX = False
+
+
+def _path_leaves(tree: Any):
+    """[(path_str, leaf)] with stable, human-readable path keys."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
 
 
 def save(path: str, state: Any) -> None:
@@ -32,23 +48,73 @@ def save(path: str, state: Any) -> None:
         ckptr = ocp.PyTreeCheckpointer()
         ckptr.save(os.path.abspath(path), state, force=True)
         return
-    leaves, _ = jax.tree_util.tree_flatten(state)
     np.savez(
         path,
-        **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)},
+        __schema_version__=np.asarray(_VERSION),
+        **{f"f:{name}": np.asarray(x) for name, x in _path_leaves(state)},
     )
 
 
-def restore(path: str, target: T) -> T:
+def restore(path: str, target: T, strict: bool = True) -> T:
     """Restore a pytree saved by :func:`save`.  ``target`` supplies the
-    structure (and shardings, for orbax) to restore into."""
+    structure (and shardings, for orbax) to restore into.
+
+    ``strict=False`` lets a v2 checkpoint restore into a target that
+    has GAINED fields since the save: missing leaves keep the
+    target's current values.  Only do this when the new fields are
+    recomputable caches — e.g. a pre-r3 SwarmState checkpoint needs
+    ``state.recount_alive_below`` (and a conservative leader check)
+    after restoring, because ``alive_below``/``leader_live`` are
+    event-maintained.
+    """
     if _HAVE_ORBAX and not path.endswith(".npz"):
         ckptr = ocp.PyTreeCheckpointer()
         restored = ckptr.restore(os.path.abspath(path), item=target)
         return restored
     data = np.load(path if path.endswith(".npz") else path + ".npz")
     leaves, treedef = jax.tree_util.tree_flatten(target)
-    new_leaves = [
-        jax.numpy.asarray(data[f"leaf_{i}"]) for i in range(len(leaves))
-    ]
+    if "__schema_version__" in data.files:
+        ver = int(data["__schema_version__"])
+        if ver > _VERSION:
+            raise ValueError(
+                f"checkpoint {path!r} uses schema v{ver} but this "
+                f"code understands up to v{_VERSION}; upgrade the "
+                "framework to restore it"
+            )
+        named = _path_leaves(target)
+        missing = [n for n, _ in named if f"f:{n}" not in data.files]
+        extra = [
+            k[2:] for k in data.files
+            if k.startswith("f:") and k[2:] not in {n for n, _ in named}
+        ]
+        if extra:
+            raise ValueError(
+                f"checkpoint {path!r} holds leaves the target lacks: "
+                f"{extra} — restoring into an older/different struct; "
+                "rebuild the target at the checkpoint's version"
+            )
+        if missing and strict:
+            raise ValueError(
+                f"checkpoint {path!r} predates target fields {missing}; "
+                "pass strict=False to keep the target's values for "
+                "them, then recompute any event-maintained caches "
+                "(e.g. SwarmState.recount_alive_below)"
+            )
+        new_leaves = [
+            jax.numpy.asarray(data[f"f:{n}"])
+            if f"f:{n}" in data.files else leaf
+            for n, leaf in named
+        ]
+    else:
+        n_saved = len([k for k in data.files if k.startswith("leaf_")])
+        if n_saved != len(leaves):
+            raise ValueError(
+                f"positional (schema-v1) checkpoint {path!r} has "
+                f"{n_saved} leaves but the target has {len(leaves)} — "
+                "the struct changed since the save and positional keys "
+                "cannot be realigned; re-save with the current version"
+            )
+        new_leaves = [
+            jax.numpy.asarray(data[f"leaf_{i}"]) for i in range(len(leaves))
+        ]
     return jax.tree_util.tree_unflatten(treedef, new_leaves)

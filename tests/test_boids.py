@@ -296,3 +296,20 @@ def test_block_mean_field_matches_naive():
     wc = np.asarray([np.sum(blk == b) for b in blk], np.float32)
     np.testing.assert_allclose(np.asarray(t), wt, atol=1e-5)
     np.testing.assert_allclose(np.asarray(c)[:, 0], wc)
+
+
+def test_gridmean_tiny_align_grid_guard():
+    """Advisor r3: g < 3 tent pooling double-counts; both deposit
+    modes must refuse tiny align grids instead of corrupting."""
+    from distributed_swarm_algorithm_tpu.ops.boids import (
+        boids_forces_gridmean,
+    )
+
+    state = boids_init(64, 2, seed=0)
+    for deposit, hw in (("nearest", 10.0), ("bilinear", 4.0)):
+        params = BoidsParams(
+            half_width=hw, align_cell=8.0, align_deposit=deposit,
+            grid_sep_backend="portable",
+        )
+        with pytest.raises(ValueError, match="align grid"):
+            boids_forces_gridmean(state, params)

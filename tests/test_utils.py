@@ -171,3 +171,59 @@ def test_verify_replay_detects_divergence():
     tampered = s.replace(pos=s.pos.at[0, 0].add(1e-3))
     with pytest.raises(ReplayDivergence):
         verify_replay(step, tampered, trace)
+
+
+def test_checkpoint_schema_v2_path_keys(tmp_path):
+    """r4 (advisor): .npz leaves are path-keyed with a version marker;
+    a struct that gains a field restores with strict=False (target
+    value kept) and raises a NAMED error under strict."""
+    import numpy as _np
+    from flax import struct as _struct
+
+    import jax as _jax
+
+    @_struct.dataclass
+    class Old:
+        a: _jax.Array
+        b: _jax.Array
+
+    @_struct.dataclass
+    class New:
+        a: _jax.Array
+        b: _jax.Array
+        c: _jax.Array          # gained after the save
+
+    old = Old(a=jnp.arange(4.0), b=jnp.ones((2, 2)))
+    p = str(tmp_path / "st.npz")
+    ckpt.save(p, old)
+    raw = _np.load(p)
+    assert int(raw["__schema_version__"]) == 2
+    assert any(k.startswith("f:") for k in raw.files)
+
+    new_t = New(a=jnp.zeros(4), b=jnp.zeros((2, 2)), c=jnp.full((3,), 7.0))
+    with pytest.raises(ValueError, match=r"\.c"):
+        ckpt.restore(p, new_t)
+    got = ckpt.restore(p, new_t, strict=False)
+    _np.testing.assert_array_equal(_np.asarray(got.a), _np.arange(4.0))
+    _np.testing.assert_array_equal(_np.asarray(got.c), _np.full((3,), 7.0))
+
+    # Shrunken target (checkpoint has extra leaves): named error.
+    new_full = New(a=jnp.zeros(4), b=jnp.zeros((2, 2)), c=jnp.zeros(3))
+    p2 = str(tmp_path / "st2.npz")
+    ckpt.save(p2, new_full)
+    with pytest.raises(ValueError, match="lacks"):
+        ckpt.restore(p2, old)
+
+
+def test_checkpoint_legacy_positional_mismatch_is_named(tmp_path):
+    """Pre-v2 positional files: count match restores, mismatch dies
+    with an actionable message, not a KeyError."""
+    import numpy as _np
+
+    leaves = [_np.arange(3.0), _np.ones((2,))]
+    p = str(tmp_path / "legacy.npz")
+    _np.savez(p, **{f"leaf_{i}": x for i, x in enumerate(leaves)})
+    got = ckpt.restore(p, (jnp.zeros(3), jnp.zeros(2)))
+    _np.testing.assert_array_equal(_np.asarray(got[0]), leaves[0])
+    with pytest.raises(ValueError, match="schema-v1"):
+        ckpt.restore(p, (jnp.zeros(3), jnp.zeros(2), jnp.zeros(1)))

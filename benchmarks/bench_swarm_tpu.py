@@ -24,6 +24,11 @@ CONFIGS = [
     (4_096, "dense", 200, 1),
     (65_536, "pallas", 50, 1),
     (65_536, "window", 200, 8),
+    # The r3 flagship: the full 1M-agent protocol tick (window
+    # separation, Morton sort amortized) — the 337-ticks/s config of
+    # docs/PERFORMANCE.md's decomposition table, recorded per-round
+    # so the regression gate covers it.
+    (1_048_576, "window", 100, 8),
     # sort_every=8, not 25: at max_speed*dt = 0.5 m/tick an agent
     # crosses the 2 m personal space in 4 ticks, and the measured force
     # error at sort_every=25 under converging motion is ~99% (stale

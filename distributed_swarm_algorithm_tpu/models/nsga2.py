@@ -88,21 +88,15 @@ class NSGA2(CheckpointMixin):
         import jax.numpy as jnp
         import numpy as np
 
-        if not path.endswith(".npz"):
-            self.state = _ckpt.restore(path, self.state)
-            return
-        data = np.load(path)
-        if (
-            "__schema_version__" in data.files
-            or len([k for k in data.files if k.startswith("leaf_")])
-            == len(jax.tree_util.tree_leaves(self.state))
-        ):
-            # Current schema, or positional with matching leaf count:
-            # the generic restore handles it (and its named errors
-            # must propagate, not be swallowed into the migration).
+        if _ckpt.npz_layout(path) != ("v1", 6):
+            # Anything but the legacy pre-viol layout (orbax dirs,
+            # schema-v2 files, positional files of the current size):
+            # the generic restore handles it — and its named errors
+            # must propagate, not be swallowed into the migration.
             self.state = _ckpt.restore(path, self.state)
             return
         # Legacy pre-viol layout: 6 positional leaves.
+        data = np.load(path if path.endswith(".npz") else path + ".npz")
         legacy = [jnp.asarray(data[f"leaf_{i}"]) for i in range(6)]
         pos, objs, rank, crowd, key, iteration = legacy
         self.state = self.state.replace(

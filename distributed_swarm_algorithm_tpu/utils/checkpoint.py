@@ -55,6 +55,22 @@ def save(path: str, state: Any) -> None:
     )
 
 
+def npz_layout(path: str):
+    """Schema sniff for .npz checkpoints: ``("v2", n_leaves)`` for
+    path-keyed files, ``("v1", n_leaves)`` for positional ones, or
+    ``None`` when ``path`` does not resolve to an .npz file (an orbax
+    directory).  Exists so migration shims (e.g. NSGA2's pre-``viol``
+    loader) can dispatch on the actual layout without re-implementing
+    this module's format knowledge."""
+    p = path if path.endswith(".npz") else path + ".npz"
+    if not os.path.exists(p):
+        return None
+    data = np.load(p)
+    if "__schema_version__" in data.files:
+        return ("v2", len([k for k in data.files if k.startswith("f:")]))
+    return ("v1", len([k for k in data.files if k.startswith("leaf_")]))
+
+
 def restore(path: str, target: T, strict: bool = True) -> T:
     """Restore a pytree saved by :func:`save`.  ``target`` supplies the
     structure (and shardings, for orbax) to restore into.

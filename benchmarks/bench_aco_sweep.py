@@ -1,0 +1,88 @@
+"""ACO fused-kernel scale sweep: city-count ceiling + throughput.
+
+VERDICT r3 item 4: the fused whole-tour kernel was benchmarked at one
+shape (C=256, A=1024).  This sweep measures C = 256 / 512 / 1024 (the
+VMEM-residency envelope: two [Cp, Cp] operands + the [Cp, tile_a]
+working set live in VMEM for all C-1 steps) against the portable path
+at each size, plus a known-optimum quality row (cities on a circle —
+optimal tour = the circle order) at the largest size.  Standalone
+artifact: not part of run_all.py's round record (the pinned-shape
+bench_aco.py row is what the regression gate tracks).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from common import report, timeit_best
+
+from distributed_swarm_algorithm_tpu.ops.aco import (
+    aco_init,
+    aco_run,
+    coords_to_dist,
+    tour_lengths,
+)
+from distributed_swarm_algorithm_tpu.ops.pallas.aco_fused import (
+    fused_aco_run,
+)
+
+A, STEPS = 1024, 50
+
+
+def main() -> None:
+    for c in (256, 512, 1024):
+        rng = np.random.default_rng(0)
+        coords = jnp.asarray(
+            rng.uniform(0, 100, (c, 2)).astype(np.float32)
+        )
+        st = aco_init(coords_to_dist(coords), seed=0)
+        for name, fn in [
+            ("portable", lambda s: aco_run(s, STEPS, A)),
+            ("pallas-fused", lambda s: fused_aco_run(s, STEPS, A)),
+        ]:
+            if name == "portable" and c > 512:
+                # ~74 ms/iter at C=256 and O(C) sequential steps: the
+                # C=1024 portable row alone would be ~5 min of bench
+                # time for a known-slower path; the C<=512 rows pin
+                # the ratio.
+                continue
+            holder = {"out": fn(st)}
+            _ = float(holder["out"].best_len)      # compile + warm
+            best = timeit_best(
+                lambda: holder.update(out=fn(st)),
+                lambda: float(holder["out"].best_len),
+            )
+            report(
+                f"tours/sec, ACO TSP sweep C={c} A={A} ({name})",
+                A * STEPS / best,
+                "tours/sec",
+                0.0,
+            )
+
+    # Known-optimum quality at the ceiling size: circle instance.
+    c = 1024
+    th = 2 * math.pi * np.arange(c) / c
+    coords = jnp.asarray(
+        np.stack([100 * np.cos(th), 100 * np.sin(th)], 1).astype(
+            np.float32
+        )
+    )
+    dist = coords_to_dist(coords)
+    opt = float(tour_lengths(dist, jnp.arange(c)[None, :])[0])
+    st = aco_init(dist, seed=0)
+    out = fused_aco_run(st, 100, A, q0=0.1, elite=4.0)
+    gap = float(out.best_len) / opt - 1.0
+    report(
+        f"opt-gap-pct, ACO circle-{c} known-optimum, 100 iters "
+        f"(gap {gap * 100:.2f}%)",
+        gap * 100,
+        "percent",
+        0.0,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -34,7 +34,6 @@ CONFIGS = [
     # error at sort_every=25 under converging motion is ~99% (stale
     # ordering misses exactly the new collisions) vs ~0.7% at 8 — see
     # docs/PERFORMANCE.md window-error table.
-    (1_048_576, "window", 100, 8),
 ]
 
 

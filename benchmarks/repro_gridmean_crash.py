@@ -1,0 +1,73 @@
+"""Repro harness for the portable-gridmean TPU worker crash.
+
+r3 documented "at 1M long scans crash the TPU worker".  r4 bisection
+(VERDICT r3 item 6) narrowed WHERE but found it INTERMITTENT:
+
+  - Observed twice: r3 at 1M in long scans; r4 at 4096 x 2000-step
+    scan — both in the PORTABLE separation_grid path (9-stencil
+    searchsorted/gather chain), both in processes that had ALREADY
+    compiled and run several other large programs (the r4 hit came
+    mid quality-sweep after window/dense/gridmean runs; subsequent
+    JAX calls in that process then failed with JaxRuntimeError).
+  - NOT reproducible in isolation: a fresh process running the exact
+    4096 x 2000 scan survives, as does 4096 x 4000 — so the trigger
+    is scan length x accumulated worker state (HBM pressure /
+    program-cache interaction), not scan length alone.
+
+Containment shipped anyway (defense in depth): ``models/boids.py``
+chunks the host loop at ``_PORTABLE_GRIDMEAN_CHUNK`` (500) steps per
+XLA program when the portable gridmean path runs on TPU — bounding
+any single program far below every observed failure — and the r4
+default backend is the fused Pallas kernel, which has never exhibited
+the crash (measured: 65k x 14,000 steps, 1M x 300 steps clean).
+
+Run on a throwaway process — a reproduced crash kills this process's
+TPU runtime:
+
+    python benchmarks/repro_gridmean_crash.py            # containment path
+    python benchmarks/repro_gridmean_crash.py --crash    # raw 2000-step scan
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+
+from common import report  # noqa: F401  (repo root on sys.path)
+
+from distributed_swarm_algorithm_tpu.ops import boids as bk
+
+
+def main() -> None:
+    crash = "--crash" in sys.argv
+    n, hw, steps = 4096, 56.5, 2000
+    params = bk.BoidsParams(
+        half_width=hw, grid_sep_backend="portable"
+    )
+    state = bk.boids_init(n, 2, seed=0, params=params)
+    if crash:
+        # ONE scan of 2000 steps: the raw trigger.
+        state, _ = bk.boids_run(
+            state, params, steps, neighbor_mode="gridmean"
+        )
+        jax.block_until_ready(state.pos)
+        print("raw 2000-step scan survived (crash not reproduced)")
+    else:
+        # The shipped containment: 500-step programs, host loop.
+        from distributed_swarm_algorithm_tpu.models.boids import Boids
+
+        flock = Boids(
+            n=n, seed=0, half_width=hw, neighbor_mode="gridmean",
+            grid_sep_backend="portable",
+        )
+        flock.run(steps)
+        print(
+            f"containment path ok: {steps} steps in "
+            f"{-(-steps // Boids._PORTABLE_GRIDMEAN_CHUNK)} chunked "
+            f"programs, pol={flock.polarization:.3f}"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -65,15 +65,72 @@ class Boids(CheckpointMixin):
         self.state = step_fn(self.state, self.params, self.obstacles)
         return self.state
 
+    # Longest single scan allowed on the PORTABLE gridmean path on
+    # TPU.  Long scans over separation_grid's 9-stencil gather chain
+    # have INTERMITTENTLY crashed the TPU worker process (observed r3
+    # at 1M and r4 at 4096x2000 — both in processes that had already
+    # run other large programs; not reproducible in a fresh process:
+    # benchmarks/repro_gridmean_crash.py has the full
+    # characterization).  Chunking the host-side loop bounds any
+    # single XLA program far below every observed failure, at ~one
+    # extra dispatch per chunk (~100 us) — semantics identical
+    # (pinned by test).  The fused Pallas backend (the TPU default)
+    # has never exhibited the crash.
+    _PORTABLE_GRIDMEAN_CHUNK = 500
+
+    def _portable_gridmean_on_tpu(self) -> bool:
+        from ..utils.platform import on_tpu
+
+        if self.neighbor_mode != "gridmean" or not on_tpu():
+            return False
+        p = self.params
+        if p.grid_sep_backend == "portable":
+            return True
+        if p.grid_sep_backend == "pallas":
+            return False
+        from ..ops.pallas.grid_separation import hashgrid_supported
+
+        return not hashgrid_supported(
+            self.state.pos.shape[-1], self.state.pos.dtype,
+            p.half_width, p.r_sep, p.grid_max_per_cell,
+        )
+
     def run(self, n_steps: int, record: bool = False):
         """Advance ``n_steps`` ticks; with ``record=True`` returns the
         ``[n_steps, N, D]`` position trajectory."""
-        self.state, traj = _k.boids_run(
-            self.state, self.params, n_steps, self.obstacles, record,
-            neighbor_mode=self.neighbor_mode,
+        chunk = (
+            self._PORTABLE_GRIDMEAN_CHUNK
+            if n_steps > self._PORTABLE_GRIDMEAN_CHUNK
+            and self._portable_gridmean_on_tpu()
+            else n_steps
         )
+        if n_steps <= 0:
+            # Preserve the single-call contract (a 0-length scan
+            # returns an empty [0, N, D] trajectory).
+            self.state, traj = _k.boids_run(
+                self.state, self.params, n_steps, self.obstacles,
+                record, neighbor_mode=self.neighbor_mode,
+            )
+            jax.block_until_ready(self.state.pos)
+            return traj if record else self.state
+        frames = []
+        done = 0
+        while done < n_steps:
+            step = min(chunk, n_steps - done)
+            self.state, traj = _k.boids_run(
+                self.state, self.params, step, self.obstacles, record,
+                neighbor_mode=self.neighbor_mode,
+            )
+            if record:
+                frames.append(traj)
+            done += step
         jax.block_until_ready(self.state.pos)
-        return traj if record else self.state
+        if record:
+            return (
+                frames[0] if len(frames) == 1
+                else jax.numpy.concatenate(frames, axis=0)
+            )
+        return self.state
 
     @property
     def polarization(self) -> float:

@@ -179,6 +179,21 @@ def fused_construct_tours(
     c = dist.shape[0]
     cp = _ceil_to(c, 128)      # MXU/lane tile; fake cities masked off
     f32 = jnp.float32
+    # Scale envelope (r4, VERDICT r3 item 4): both [Cp, Cp] operands
+    # (logits, dist) plus the [Cp, tile_a] tour/one-hot working set
+    # are VMEM-resident for all C-1 steps — that residency IS the
+    # kernel's speed, and it caps C.  Empirical rule (v5e, 16 MiB
+    # scoped vmem): the grid-invariant operands stay single-buffered,
+    # the per-program ant blocks double-buffer once the grid has >1
+    # program.  Measured boundary at C=1024: tile_a=256 single-program
+    # runs, tile_a=256 multi-program dies at 16.23 MiB, tile_a=128
+    # multi-program runs — so _fits() below models exactly that and
+    # tile selection shrinks tile_a until it fits.  C ceiling ~1024
+    # (the operands alone are 8 MiB; C=1408 cannot fit at any tile).
+    # Past the cap, construction would need block-DMA'd logits panels
+    # per step — re-introducing the per-step HBM traffic the kernel
+    # exists to avoid; use the portable path there (sweep numbers:
+    # docs/PERFORMANCE.md ACO section; benchmarks/bench_aco_sweep.py).
 
     eta = 1.0 / (dist + jnp.eye(c, dtype=dist.dtype) + _EPS)
     logits = alpha * jnp.log(tau + _EPS) + beta * jnp.log(eta)
@@ -190,14 +205,46 @@ def fused_construct_tours(
     dist_p = jnp.zeros((cp, cp), f32).at[:c, :c].set(dist.astype(f32))
 
     a_pad = _ceil_to(n_ants, 128)
-    # Largest 128-multiple divisor of a_pad not exceeding the request:
-    # small colonies must not be silently padded to the default tile
-    # (n_ants=64 would otherwise construct 1024 tours to use 64).
-    tile_a = max(
+
+    def _fits(t):
+        grid_mult = 1 if a_pad == t else 2
+        est = (
+            2 * cp * cp * 4            # logits + dist, single-buffered
+            + grid_mult * 3 * cp * t * 4   # start/tours/len blocks
+            + cp * t * 4                   # in-kernel scratch
+        )
+        if rng == "host":
+            # The uniforms ride in as one whole-rows block per
+            # program: [(C-1)*Cp, t] f32 (advisor r3 — previously an
+            # opaque Mosaic OOM).
+            est += grid_mult * (c - 1) * cp * t * 4
+        return est <= 14 * 1024 * 1024
+
+    # Largest 128-multiple divisor of a_pad not exceeding the request
+    # THAT FITS IN VMEM: small colonies must not be silently padded to
+    # the default tile, and large instances shrink the ant tile
+    # instead of dying in Mosaic allocation (see envelope note above).
+    candidates = [
         t
         for t in range(128, max(128, min(tile_a, a_pad)) + 1, 128)
-        if a_pad % t == 0
-    )
+        if a_pad % t == 0 and (interpret or _fits(t))
+    ]
+    if not candidates and rng == "host":
+        raise ValueError(
+            f"rng='host' at C={c} needs a [(C-1)*Cp, tile_a] uniform "
+            "block resident in VMEM and no ant tile fits.  Use "
+            "rng='tpu' (the production path: on-chip PRNG, no "
+            "operand) or a smaller instance."
+        )
+    if not candidates:
+        raise ValueError(
+            f"C={c} cannot fit the fused construction kernel in VMEM "
+            f"at any ant tile (the two [Cp, Cp] operands alone are "
+            f"{(2 * cp * cp * 4) >> 20} MiB of the ~14 MiB envelope; "
+            "ceiling C~1024 on v5e).  Use the portable ops/aco.py "
+            "path for larger instances."
+        )
+    tile_a = max(candidates)
     key, k0, ku, kq = jax.random.split(key, 4)
     start = jax.random.randint(k0, (a_pad,), 0, c)
     start_oh = jax.nn.one_hot(start, cp, dtype=f32).T    # [cp, a_pad]

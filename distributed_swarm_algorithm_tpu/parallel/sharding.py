@@ -2410,6 +2410,7 @@ def fused_aco_run_shmap(
     beta: float = 2.0,
     rho: float = 0.1,
     q0: float = 0.0,
+    elite: float = 0.0,
     tile_a: int = 1024,
     rng: str = "tpu",
     interpret: bool = False,
@@ -2429,13 +2430,23 @@ def fused_aco_run_shmap(
     1-device run).  Best tour/length ride the shared pmin/psum
     exchange (city indices are exact in f32 up to 2^24).
     """
+    from ..ops.aco import deposit as _deposit
     from ..ops.pallas.aco_fused import (
         fused_construct_tours,
         fused_deposit_matrix,
     )
 
     n_dev = mesh.shape[axis]
-    ants_local = -(-n_ants // n_dev)
+    if n_ants % n_dev != 0:
+        # A silent ceil round-up would run MORE ants than asked and
+        # break the docstring's "exactly a single colony of the union
+        # ant set" contract (advisor r3) — same raise-on-bad-split
+        # rule as the other shmap drivers.
+        raise ValueError(
+            f"n_ants ({n_ants}) must divide evenly over the "
+            f"{n_dev}-device '{axis}' mesh axis"
+        )
+    ants_local = n_ants // n_dev
     f32 = jnp.float32
 
     @partial(
@@ -2466,6 +2477,15 @@ def fused_aco_run_shmap(
                 best_len, best_tour_f, dev, axis,
             )
             tau = (1.0 - rho) * tau + d + d.T
+            if elite > 0.0:
+                # Same elitist reinforcement as fused_aco_step: the
+                # exchanged global-best tour (replicated) deposits
+                # elite/best_len on every device identically, so tau
+                # stays replicated with no extra collective.
+                tau = _deposit(
+                    tau, best_tour_f.astype(jnp.int32)[None, :],
+                    best_len[None] / elite, rho=0.0,
+                )
             return (tau, best_tour_f, best_len, key), None
 
         (tau, best_tour_f, best_len, key), _ = lax.scan(

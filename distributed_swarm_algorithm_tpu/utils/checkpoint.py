@@ -65,10 +65,14 @@ def npz_layout(path: str):
     p = path if path.endswith(".npz") else path + ".npz"
     if not os.path.exists(p):
         return None
-    data = np.load(p)
-    if "__schema_version__" in data.files:
-        return ("v2", len([k for k in data.files if k.startswith("f:")]))
-    return ("v1", len([k for k in data.files if k.startswith("leaf_")]))
+    with np.load(p) as data:
+        if "__schema_version__" in data.files:
+            return (
+                "v2", len([k for k in data.files if k.startswith("f:")])
+            )
+        return (
+            "v1", len([k for k in data.files if k.startswith("leaf_")])
+        )
 
 
 def restore(path: str, target: T, strict: bool = True) -> T:

@@ -313,3 +313,33 @@ def test_gridmean_tiny_align_grid_guard():
         )
         with pytest.raises(ValueError, match="align grid"):
             boids_forces_gridmean(state, params)
+
+
+def test_portable_gridmean_chunking_preserves_semantics(monkeypatch):
+    """The TPU crash containment (host-side chunking at 500 steps per
+    XLA program) must not change results: same trajectory as one
+    program, record=True frames concatenated across chunks."""
+    from distributed_swarm_algorithm_tpu.models import boids as mb
+
+    flock = Boids(
+        n=64, seed=0, half_width=20.0, neighbor_mode="gridmean",
+        grid_sep_backend="portable",
+    )
+    # Force the containment path (off-TPU it is normally inactive)
+    # and a tiny chunk so 7 steps split as 3+3+1.
+    monkeypatch.setattr(
+        Boids, "_portable_gridmean_on_tpu", lambda self: True
+    )
+    monkeypatch.setattr(Boids, "_PORTABLE_GRIDMEAN_CHUNK", 3)
+    traj = flock.run(7, record=True)
+    assert traj.shape == (7, 64, 2)
+
+    ref = Boids(
+        n=64, seed=0, half_width=20.0, neighbor_mode="gridmean",
+        grid_sep_backend="portable",
+    )
+    ref_traj = ref.run(7, record=True)
+    np.testing.assert_allclose(
+        np.asarray(traj), np.asarray(ref_traj), rtol=1e-5, atol=1e-5
+    )
+    del mb

@@ -219,3 +219,48 @@ def test_fused_aco_shmap(mesh):
     np.testing.assert_array_equal(np.asarray(out.best_tour),
                                   np.asarray(out2.best_tour))
     np.testing.assert_allclose(np.asarray(out.tau), np.asarray(out2.tau))
+
+
+def test_fused_aco_shmap_rejects_indivisible_ants(mesh):
+    from distributed_swarm_algorithm_tpu.ops.aco import (
+        aco_init,
+        coords_to_dist,
+    )
+
+    rng = np.random.default_rng(7)
+    coords = jnp.asarray(rng.uniform(0, 10, (16, 2)).astype(np.float32))
+    st = aco_init(coords_to_dist(coords), seed=0)
+    with pytest.raises(ValueError, match="divide evenly"):
+        sh.fused_aco_run_shmap(
+            st, mesh, 2, n_ants=100, tile_a=128, rng="host",
+            interpret=True,
+        )
+
+
+def test_fused_aco_shmap_elite(mesh):
+    """elite > 0 reinforces the exchanged global-best tour's edges on
+    the replicated pheromone (advisor r3: the knob existed on
+    fused_aco_step but was silently absent here)."""
+    from distributed_swarm_algorithm_tpu.ops.aco import (
+        aco_init,
+        coords_to_dist,
+    )
+
+    rng = np.random.default_rng(7)
+    coords = jnp.asarray(rng.uniform(0, 10, (16, 2)).astype(np.float32))
+    st = aco_init(coords_to_dist(coords), seed=0)
+    out = sh.fused_aco_run_shmap(
+        st, mesh, 10, n_ants=256, tile_a=128, elite=4.0, rng="host",
+        interpret=True,
+    )
+    base = sh.fused_aco_run_shmap(
+        st, mesh, 10, n_ants=256, tile_a=128, rng="host", interpret=True,
+    )
+    bt = np.asarray(out.best_tour)
+    tau = np.asarray(out.tau)
+    edges = list(zip(bt, np.roll(bt, -1)))
+    on_edges = np.mean([tau[u, v] for u, v in edges])
+    off = tau.sum() - sum(tau[u, v] + tau[v, u] for u, v in edges)
+    n_off = tau.size - 2 * len(edges)
+    assert on_edges > off / n_off          # best edges reinforced
+    assert float(out.best_len) <= float(base.best_len) * 1.2

@@ -1,4 +1,12 @@
-"""BASELINE config 2: 10k-particle PSO, Rastrigin-30D, one chip."""
+"""BASELINE config 2: 10k-particle PSO, Rastrigin-30D, one chip.
+
+STEPS is 20,000 (r4, VERDICT r3 item 5): at the old 2,000 the whole
+run was ~13 ms of device work buried under 60-190 ms of per-call
+tunnel dispatch — the recorded 102-111M agent-steps/s was measuring
+the HARNESS, not the chip (same workload at 20k steps: 1.58B).  The
+long workload amortizes the fixed per-call cost below 10% like the 1M
+bench's does naturally.
+"""
 
 from __future__ import annotations
 
@@ -8,7 +16,7 @@ from distributed_swarm_algorithm_tpu.models.pso import PSO
 
 N = 10_240          # lane-friendly 10k
 DIM = 30
-STEPS = 2000
+STEPS = 20_000
 
 
 def main() -> None:

@@ -419,6 +419,10 @@ def _run_report(opt, args, count_key: str, count=None, extra=None) -> int:
     start = time.perf_counter()
     if not _write_history(opt, args):
         opt.run(args.steps)
+    # Models dispatch asynchronously (PSO.run no longer blocks, r4):
+    # force the result before reading the clock, or steps_per_sec
+    # would measure dispatch latency, not the run.
+    float(opt.best)
     elapsed = time.perf_counter() - start
     out = {
         "objective": args.objective,

@@ -83,16 +83,11 @@ class Boids(CheckpointMixin):
 
         if self.neighbor_mode != "gridmean" or not on_tpu():
             return False
-        p = self.params
-        if p.grid_sep_backend == "portable":
-            return True
-        if p.grid_sep_backend == "pallas":
-            return False
-        from ..ops.pallas.grid_separation import hashgrid_supported
-
-        return not hashgrid_supported(
-            self.state.pos.shape[-1], self.state.pos.dtype,
-            p.half_width, p.r_sep, p.grid_max_per_cell,
+        # Single source of truth for which backend actually runs
+        # (ops/boids.py:gridmean_uses_hashgrid) — the containment
+        # must track the executed path exactly.
+        return not _k.gridmean_uses_hashgrid(
+            self.params, self.state.pos.shape[-1], self.state.pos.dtype
         )
 
     def run(self, n_steps: int, record: bool = False):

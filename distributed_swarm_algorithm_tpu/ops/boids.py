@@ -344,6 +344,37 @@ def boids_forces_window(
     return _clamp_force(acc, p)
 
 
+def gridmean_uses_hashgrid(p: BoidsParams, dim: int, dtype) -> bool:
+    """THE separation-backend dispatch predicate for gridmean mode —
+    single source of truth, also consumed by ``models/boids.py``'s
+    crash-containment guard (which must track the path actually
+    executed).  Raises on an unknown backend string, and on
+    ``"pallas"`` outside the kernel envelope."""
+    if p.grid_sep_backend not in ("auto", "pallas", "portable"):
+        raise ValueError(
+            f"unknown grid_sep_backend {p.grid_sep_backend!r}; "
+            "expected 'auto', 'pallas', or 'portable'"
+        )
+    if p.grid_sep_backend == "portable":
+        return False
+    from .pallas.grid_separation import hashgrid_supported
+
+    supported = hashgrid_supported(
+        dim, dtype, p.half_width, p.r_sep, p.grid_max_per_cell
+    )
+    if p.grid_sep_backend == "pallas" and not supported:
+        raise ValueError(
+            "grid_sep_backend='pallas' but this configuration is "
+            "outside the kernel's envelope (needs 2-D f32, "
+            "2*half_width/r_sep >= 16 grid cells, grid_max_per_cell "
+            "a multiple of 8 in [8, 64], and the grid row within "
+            "the VMEM budget)"
+        )
+    from ..utils.platform import on_tpu
+
+    return supported and (p.grid_sep_backend == "pallas" or on_tpu())
+
+
 def boids_forces_gridmean(
     state: BoidsState,
     params: BoidsParams,
@@ -413,32 +444,7 @@ def boids_forces_gridmean(
     # as one VMEM pass (ops/pallas/grid_separation.py) — the r4 fix
     # for gridmean's gather-bound cost (measured ~60x window at 65k)
     # and its 1M long-scan worker crash, both in separation_grid.
-    if p.grid_sep_backend not in ("auto", "pallas", "portable"):
-        raise ValueError(
-            f"unknown grid_sep_backend {p.grid_sep_backend!r}; "
-            "expected 'auto', 'pallas', or 'portable'"
-        )
-    use_kernel = False
-    if p.grid_sep_backend != "portable":
-        from .pallas.grid_separation import hashgrid_supported
-
-        supported = hashgrid_supported(
-            d, pos.dtype, p.half_width, p.r_sep, p.grid_max_per_cell
-        )
-        if p.grid_sep_backend == "pallas" and not supported:
-            raise ValueError(
-                "grid_sep_backend='pallas' but this configuration is "
-                "outside the kernel's envelope (needs 2-D f32, "
-                "2*half_width/r_sep >= 16 grid cells, grid_max_per_cell "
-                "a multiple of 8 in [8, 64], and the grid row within "
-                "the VMEM budget)"
-            )
-        from ..utils.platform import on_tpu
-
-        use_kernel = supported and (
-            p.grid_sep_backend == "pallas" or on_tpu()
-        )
-    if use_kernel:
+    if gridmean_uses_hashgrid(p, d, pos.dtype):
         from ..utils.platform import on_tpu
         from .pallas.grid_separation import separation_hashgrid_pallas
 

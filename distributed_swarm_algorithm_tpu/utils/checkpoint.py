@@ -91,50 +91,61 @@ def restore(path: str, target: T, strict: bool = True) -> T:
         ckptr = ocp.PyTreeCheckpointer()
         restored = ckptr.restore(os.path.abspath(path), item=target)
         return restored
-    data = np.load(path if path.endswith(".npz") else path + ".npz")
     leaves, treedef = jax.tree_util.tree_flatten(target)
-    if "__schema_version__" in data.files:
-        ver = int(data["__schema_version__"])
-        if ver > _VERSION:
-            raise ValueError(
-                f"checkpoint {path!r} uses schema v{ver} but this "
-                f"code understands up to v{_VERSION}; upgrade the "
-                "framework to restore it"
+    with np.load(
+        path if path.endswith(".npz") else path + ".npz"
+    ) as data:
+        if "__schema_version__" in data.files:
+            ver = int(data["__schema_version__"])
+            if ver > _VERSION:
+                raise ValueError(
+                    f"checkpoint {path!r} uses schema v{ver} but this "
+                    f"code understands up to v{_VERSION}; upgrade the "
+                    "framework to restore it"
+                )
+            named = _path_leaves(target)
+            missing = [
+                n for n, _ in named if f"f:{n}" not in data.files
+            ]
+            extra = [
+                k[2:] for k in data.files
+                if k.startswith("f:")
+                and k[2:] not in {n for n, _ in named}
+            ]
+            if extra:
+                raise ValueError(
+                    f"checkpoint {path!r} holds leaves the target "
+                    f"lacks: {extra} — restoring into an older/"
+                    "different struct; rebuild the target at the "
+                    "checkpoint's version"
+                )
+            if missing and strict:
+                raise ValueError(
+                    f"checkpoint {path!r} predates target fields "
+                    f"{missing}; pass strict=False to keep the "
+                    "target's values for them, then recompute any "
+                    "event-maintained caches (e.g. "
+                    "SwarmState.recount_alive_below)"
+                )
+            new_leaves = [
+                jax.numpy.asarray(data[f"f:{n}"])
+                if f"f:{n}" in data.files else leaf
+                for n, leaf in named
+            ]
+        else:
+            n_saved = len(
+                [k for k in data.files if k.startswith("leaf_")]
             )
-        named = _path_leaves(target)
-        missing = [n for n, _ in named if f"f:{n}" not in data.files]
-        extra = [
-            k[2:] for k in data.files
-            if k.startswith("f:") and k[2:] not in {n for n, _ in named}
-        ]
-        if extra:
-            raise ValueError(
-                f"checkpoint {path!r} holds leaves the target lacks: "
-                f"{extra} — restoring into an older/different struct; "
-                "rebuild the target at the checkpoint's version"
-            )
-        if missing and strict:
-            raise ValueError(
-                f"checkpoint {path!r} predates target fields {missing}; "
-                "pass strict=False to keep the target's values for "
-                "them, then recompute any event-maintained caches "
-                "(e.g. SwarmState.recount_alive_below)"
-            )
-        new_leaves = [
-            jax.numpy.asarray(data[f"f:{n}"])
-            if f"f:{n}" in data.files else leaf
-            for n, leaf in named
-        ]
-    else:
-        n_saved = len([k for k in data.files if k.startswith("leaf_")])
-        if n_saved != len(leaves):
-            raise ValueError(
-                f"positional (schema-v1) checkpoint {path!r} has "
-                f"{n_saved} leaves but the target has {len(leaves)} — "
-                "the struct changed since the save and positional keys "
-                "cannot be realigned; re-save with the current version"
-            )
-        new_leaves = [
-            jax.numpy.asarray(data[f"leaf_{i}"]) for i in range(len(leaves))
-        ]
+            if n_saved != len(leaves):
+                raise ValueError(
+                    f"positional (schema-v1) checkpoint {path!r} has "
+                    f"{n_saved} leaves but the target has "
+                    f"{len(leaves)} — the struct changed since the "
+                    "save and positional keys cannot be realigned; "
+                    "re-save with the current version"
+                )
+            new_leaves = [
+                jax.numpy.asarray(data[f"leaf_{i}"])
+                for i in range(len(leaves))
+            ]
     return jax.tree_util.tree_unflatten(treedef, new_leaves)

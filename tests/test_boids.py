@@ -321,6 +321,14 @@ def test_portable_gridmean_chunking_preserves_semantics(monkeypatch):
     program, record=True frames concatenated across chunks."""
     from distributed_swarm_algorithm_tpu.models import boids as mb
 
+    # Reference trajectory FIRST, before any patching: one single
+    # 7-step program (comparing chunked-vs-chunked would be vacuous).
+    ref = Boids(
+        n=64, seed=0, half_width=20.0, neighbor_mode="gridmean",
+        grid_sep_backend="portable",
+    )
+    ref_traj = ref.run(7, record=True)
+
     flock = Boids(
         n=64, seed=0, half_width=20.0, neighbor_mode="gridmean",
         grid_sep_backend="portable",
@@ -333,12 +341,6 @@ def test_portable_gridmean_chunking_preserves_semantics(monkeypatch):
     monkeypatch.setattr(Boids, "_PORTABLE_GRIDMEAN_CHUNK", 3)
     traj = flock.run(7, record=True)
     assert traj.shape == (7, 64, 2)
-
-    ref = Boids(
-        n=64, seed=0, half_width=20.0, neighbor_mode="gridmean",
-        grid_sep_backend="portable",
-    )
-    ref_traj = ref.run(7, record=True)
     np.testing.assert_allclose(
         np.asarray(traj), np.asarray(ref_traj), rtol=1e-5, atol=1e-5
     )

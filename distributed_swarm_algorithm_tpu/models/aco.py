@@ -100,7 +100,10 @@ class ACO(CheckpointMixin):
                 self.state, n_steps, self.n_ants, self.alpha, self.beta,
                 self.rho, self.q0, self.elite,
             )
-        jax.block_until_ready(self.state.best_len)
+        # Dispatch is ASYNC (r4, same rationale as PSO.run): the
+        # block_until_ready that used to sit here costs ~80 ms per
+        # call through the axon TPU tunnel while being documented-
+        # unreliable on it; reading any state field synchronizes.
         return self.state
 
     @property

@@ -107,7 +107,10 @@ class Bat(CheckpointMixin):
                 self.f_min, self.f_max, self.alpha, self.gamma, self.r0,
                 self.sigma_local,
             )
-        jax.block_until_ready(self.state.best_fit)
+        # Dispatch is ASYNC (r4, same rationale as PSO.run): the
+        # block_until_ready that used to sit here costs ~80 ms per
+        # call through the axon TPU tunnel while being documented-
+        # unreliable on it; reading any state field synchronizes.
         return self.state
 
     @property

@@ -106,7 +106,10 @@ class Boids(CheckpointMixin):
                 self.state, self.params, n_steps, self.obstacles,
                 record, neighbor_mode=self.neighbor_mode,
             )
-            jax.block_until_ready(self.state.pos)
+            # Dispatch is ASYNC (r4, same rationale as PSO.run): the
+            # block_until_ready that used to sit here costs ~80 ms per
+            # call through the axon TPU tunnel while being documented-
+            # unreliable on it; reading any state field synchronizes.
             return traj if record else self.state
         frames = []
         done = 0
@@ -119,7 +122,10 @@ class Boids(CheckpointMixin):
             if record:
                 frames.append(traj)
             done += step
-        jax.block_until_ready(self.state.pos)
+        # Dispatch is ASYNC (r4, same rationale as PSO.run): the
+        # block_until_ready that used to sit here costs ~80 ms per
+        # call through the axon TPU tunnel while being documented-
+        # unreliable on it; reading any state field synchronizes.
         if record:
             return (
                 frames[0] if len(frames) == 1

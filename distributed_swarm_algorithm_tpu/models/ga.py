@@ -114,7 +114,10 @@ class GA(CheckpointMixin):
                 self.eta_c, self.eta_m, self.p_cross, self.p_mut,
                 self.n_elite,
             )
-        jax.block_until_ready(self.state.best_fit)
+        # Dispatch is ASYNC (r4, same rationale as PSO.run): the
+        # block_until_ready that used to sit here costs ~80 ms per
+        # call through the axon TPU tunnel while being documented-
+        # unreliable on it; reading any state field synchronizes.
         return self.state
 
     @property

@@ -95,7 +95,10 @@ class HarrisHawks(CheckpointMixin):
                 self.state, self.objective, n_steps, self.half_width,
                 self.t_max, self.levy_beta,
             )
-        jax.block_until_ready(self.state.best_fit)
+        # Dispatch is ASYNC (r4, same rationale as PSO.run): the
+        # block_until_ready that used to sit here costs ~80 ms per
+        # call through the axon TPU tunnel while being documented-
+        # unreliable on it; reading any state field synchronizes.
         return self.state
 
     @property

@@ -89,7 +89,10 @@ class MAPElites(CheckpointMixin):
             self.bins, self.half_width, self.lo, self.hi, self.batch,
             self.sigma_mut,
         )
-        jax.block_until_ready(self.state.archive_fit)
+        # Dispatch is ASYNC (r4, same rationale as PSO.run): the
+        # block_until_ready that used to sit here costs ~80 ms per
+        # call through the axon TPU tunnel while being documented-
+        # unreliable on it; reading any state field synchronizes.
         return self.state
 
     @property

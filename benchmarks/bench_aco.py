@@ -25,7 +25,7 @@ from distributed_swarm_algorithm_tpu.ops.pallas.aco_fused import (
     fused_aco_run,
 )
 
-C, A, STEPS = 256, 1024, 100
+C, A, STEPS = 256, 1024, 400   # STEPS sized for the sustained regime (r4)
 
 
 def main() -> None:

@@ -26,7 +26,7 @@ import distributed_swarm_algorithm_tpu as dsa
 # r3 1M row read 320 ticks/s at 100-step calls vs 404 at 800).
 CONFIGS = [
     (4_096, "dense", 1000, 1),
-    (65_536, "pallas", 50, 1),
+    (65_536, "pallas", 100, 1),
     (65_536, "window", 2000, 8),
     # The r3 flagship: the full 1M-agent protocol tick (window
     # separation, Morton sort amortized) — the 337-ticks/s config of

@@ -107,10 +107,8 @@ class Bat(CheckpointMixin):
                 self.f_min, self.f_max, self.alpha, self.gamma, self.r0,
                 self.sigma_local,
             )
-        # Dispatch is ASYNC (r4, same rationale as PSO.run): the
-        # block_until_ready that used to sit here costs ~80 ms per
-        # call through the axon TPU tunnel while being documented-
-        # unreliable on it; reading any state field synchronizes.
+        # Async dispatch (r4): see PSO.run's rationale.  Reading any
+        # state field synchronizes.
         return self.state
 
     @property

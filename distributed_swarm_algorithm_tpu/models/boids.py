@@ -106,10 +106,7 @@ class Boids(CheckpointMixin):
                 self.state, self.params, n_steps, self.obstacles,
                 record, neighbor_mode=self.neighbor_mode,
             )
-            # Dispatch is ASYNC (r4, same rationale as PSO.run): the
-            # block_until_ready that used to sit here costs ~80 ms per
-            # call through the axon TPU tunnel while being documented-
-            # unreliable on it; reading any state field synchronizes.
+            # Async dispatch (r4): see PSO.run's rationale.
             return traj if record else self.state
         frames = []
         done = 0
@@ -122,10 +119,8 @@ class Boids(CheckpointMixin):
             if record:
                 frames.append(traj)
             done += step
-        # Dispatch is ASYNC (r4, same rationale as PSO.run): the
-        # block_until_ready that used to sit here costs ~80 ms per
-        # call through the axon TPU tunnel while being documented-
-        # unreliable on it; reading any state field synchronizes.
+        # Async dispatch (r4): see PSO.run's rationale.  Reading any
+        # state field synchronizes.
         if record:
             return (
                 frames[0] if len(frames) == 1

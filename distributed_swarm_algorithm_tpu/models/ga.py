@@ -114,10 +114,8 @@ class GA(CheckpointMixin):
                 self.eta_c, self.eta_m, self.p_cross, self.p_mut,
                 self.n_elite,
             )
-        # Dispatch is ASYNC (r4, same rationale as PSO.run): the
-        # block_until_ready that used to sit here costs ~80 ms per
-        # call through the axon TPU tunnel while being documented-
-        # unreliable on it; reading any state field synchronizes.
+        # Async dispatch (r4): see PSO.run's rationale.  Reading any
+        # state field synchronizes.
         return self.state
 
     @property

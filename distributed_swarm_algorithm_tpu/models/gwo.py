@@ -96,10 +96,8 @@ class GWO(CheckpointMixin):
                 self.state, self.objective, n_steps, self.half_width,
                 self.t_max,
             )
-        # Dispatch is ASYNC (r4, same rationale as PSO.run): the
-        # block_until_ready that used to sit here costs ~80 ms per
-        # call through the axon TPU tunnel while being documented-
-        # unreliable on it; reading any state field synchronizes.
+        # Async dispatch (r4): see PSO.run's rationale.  Reading any
+        # state field synchronizes.
         return self.state
 
     @property

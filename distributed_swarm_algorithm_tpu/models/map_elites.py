@@ -89,10 +89,8 @@ class MAPElites(CheckpointMixin):
             self.bins, self.half_width, self.lo, self.hi, self.batch,
             self.sigma_mut,
         )
-        # Dispatch is ASYNC (r4, same rationale as PSO.run): the
-        # block_until_ready that used to sit here costs ~80 ms per
-        # call through the axon TPU tunnel while being documented-
-        # unreliable on it; reading any state field synchronizes.
+        # Async dispatch (r4): see PSO.run's rationale.  Reading any
+        # state field synchronizes.
         return self.state
 
     @property

@@ -118,10 +118,8 @@ class NSGA2(CheckpointMixin):
             self.eta_c, self.eta_m, self.p_cross, self.p_mut,
             self.violation_fn,
         )
-        # Dispatch is ASYNC (r4, same rationale as PSO.run): the
-        # block_until_ready that used to sit here costs ~80 ms per
-        # call through the axon TPU tunnel while being documented-
-        # unreliable on it; reading any state field synchronizes.
+        # Async dispatch (r4): see PSO.run's rationale.  Reading any
+        # state field synchronizes.
         return self.state
 
     def igd(self, reference=None, k: int = 256) -> float:

@@ -36,10 +36,10 @@ CONFIGS = [
     # K=24: zero overflow at flock equilibrium (measured 65k/14k
     # steps), kernel cost between K=16 and the conservative K=32.
     (65_536, 226.0, "gridmean", 200, {"grid_max_per_cell": 24}),
-    # 1M gridmean: the r3 portable path crashed the TPU worker here;
-    # the VMEM budget caps the cell cap at K=16 at this world size
-    # (short-horizon exact; long-horizon compaction needs the
-    # documented lane-tiled extension).
+    # 1M gridmean: the r3 portable path crashed the TPU worker here.
+    # K=16 (the 1-D kernel) is the recorded row; the r4b lane-tiled
+    # kernel additionally admits K=32 at this world size (see
+    # docs/PERFORMANCE.md for its measurement).
     (1_048_576, 905.0, "gridmean", 20, {}),
 ]
 

@@ -190,6 +190,127 @@ def _make_kernel(k_sep, personal_space, eps, hw, K, L):
     return kernel
 
 
+def _make_tiled_kernel(k_sep, personal_space, eps, hw, K, Lc):
+    """Lane-tiled variant (r4b): grid rows are processed in chunks of
+    ``Lc`` lanes, so VMEM residency is bounded by ``Lc`` instead of
+    the whole ``g*K`` row — this is what lifts the cell-cap ceiling at
+    1M-agent world sizes (K=32 needs L=28,672-lane rows; the 1-D
+    kernel's ~24 resident blocks of that length blow the 16 MiB
+    scoped budget).
+
+    Each of the three row-bases (up/own/down) is built for the
+    CENTER lane chunk and its LEFT and RIGHT neighbors; a lane roll
+    by ``s`` then patches the ``|s|`` edge lanes from the neighbor
+    chunk — the same wrap-and-patch trick as the row direction, one
+    axis over.  rem-wrapped lane-chunk index maps close the cy torus
+    seam exactly like the row maps close cx."""
+    two_hw = 2.0 * hw
+
+    def wrap(v):
+        return jnp.where(
+            v >= hw, v - two_hw, jnp.where(v < -hw, v + two_hw, v)
+        )
+
+    def kernel(xpl_ref, xpc_ref, xpr_ref,
+               xol_ref, xoc_ref, xor_ref,
+               xnl_ref, xnc_ref, xnr_ref,
+               ypl_ref, ypc_ref, ypr_ref,
+               yol_ref, yoc_ref, yor_ref,
+               ynl_ref, ync_ref, ynr_ref,
+               fx_ref, fy_ref):
+        row = jax.lax.broadcasted_iota(jnp.int32, (_ROWS, Lc), 0)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (_ROWS, Lc), 1)
+
+        def up(own, prev):
+            return jnp.where(
+                row == 0, pltpu.roll(prev, 1, 0), pltpu.roll(own, 1, 0)
+            )
+
+        def down(own, nxt):
+            return jnp.where(
+                row == _ROWS - 1,
+                pltpu.roll(nxt, _ROWS - 1, 0),
+                pltpu.roll(own, _ROWS - 1, 0),
+            )
+
+        xoc, yoc = xoc_ref[:], yoc_ref[:]
+        # (left, center, right) triple per row-base and attribute.
+        bases = (
+            (
+                (up(xol_ref[:], xpl_ref[:]), up(xoc, xpc_ref[:]),
+                 up(xor_ref[:], xpr_ref[:])),
+                (up(yol_ref[:], ypl_ref[:]), up(yoc, ypc_ref[:]),
+                 up(yor_ref[:], ypr_ref[:])),
+                False,
+            ),
+            (
+                (xol_ref[:], xoc, xor_ref[:]),
+                (yol_ref[:], yoc, yor_ref[:]),
+                True,
+            ),
+            (
+                (down(xol_ref[:], xnl_ref[:]), down(xoc, xnc_ref[:]),
+                 down(xor_ref[:], xnr_ref[:])),
+                (down(yol_ref[:], ynl_ref[:]), down(yoc, ync_ref[:]),
+                 down(yor_ref[:], ynr_ref[:])),
+                False,
+            ),
+        )
+
+        def shifted(left, center, right, s):
+            # center[r, i - s] with edge lanes patched from the
+            # neighbor chunk: for s > 0 the first s lanes come from
+            # LEFT's tail; for s < 0 the last |s| lanes from RIGHT's
+            # head.  The cyclic chunk index maps make the patch wrap
+            # the torus seam at the row ends.
+            if s > 0:
+                return jnp.where(
+                    lane < s,
+                    pltpu.roll(left, s, 1),
+                    pltpu.roll(center, s, 1),
+                )
+            r = (s % Lc)
+            return jnp.where(
+                lane >= Lc + s,
+                pltpu.roll(right, r, 1),
+                pltpu.roll(center, r, 1),
+            )
+
+        fx = jnp.zeros((_ROWS, Lc), jnp.float32)
+        fy = jnp.zeros((_ROWS, Lc), jnp.float32)
+        for (bx3, by3, is_own) in bases:
+            for s in range(-(2 * K - 1), 2 * K):
+                if is_own and s == 0:
+                    continue
+                dx = wrap(xoc - shifted(*bx3, s))
+                dy = wrap(yoc - shifted(*by3, s))
+                dist = jnp.sqrt(dx * dx + dy * dy)
+                dist_c = jnp.maximum(dist, eps)
+                near = dist < personal_space
+                scale = k_sep / (dist_c * dist_c * dist_c)
+                fx = fx + jnp.where(near, scale * dx, 0.0)
+                fy = fy + jnp.where(near, scale * dy, 0.0)
+        fx_ref[:] = fx
+        fy_ref[:] = fy
+
+    return kernel
+
+
+def _lane_chunk(L: int, target: int = 4096) -> int:
+    """Largest 128-multiple divisor of ``L`` not exceeding ``target``
+    (L is a multiple of 128 by the geometry constraints)."""
+    q = L // 128
+    best = 1
+    d = 1
+    while d * d <= q:
+        if q % d == 0:
+            for c in (d, q // d):
+                if 128 * c <= target and c > best:
+                    best = c
+        d += 1
+    return 128 * best
+
+
 def _cell_tables(pos, torus_hw, g):
     """(key, order, starts, counts): per-agent cell key, the stable
     cell-sort order, and the CSR start/count tables — the cell
@@ -281,7 +402,7 @@ def _overflow_rescue(
     jax.jit,
     static_argnames=(
         "k_sep", "personal_space", "eps", "cell", "max_per_cell",
-        "torus_hw", "overflow_budget", "interpret",
+        "torus_hw", "overflow_budget", "lane_chunk", "interpret",
     ),
 )
 def separation_hashgrid_pallas(
@@ -294,12 +415,19 @@ def separation_hashgrid_pallas(
     max_per_cell: int,
     torus_hw: float,
     overflow_budget: int = 512,
+    lane_chunk: int | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Drop-in fused fast path for the torus-mode
     ``separation_grid`` — same grid semantics (up to the documented
     occupancy-cap delta above), one VMEM pass.  2-D float32 only;
-    torus worlds only (the cyclic rolls ARE the seam wrap)."""
+    torus worlds only (the cyclic rolls ARE the seam wrap).
+
+    ``lane_chunk``: None picks automatically — the 1-D kernel while a
+    whole ``g*K`` row fits the VMEM budget, else the lane-tiled
+    kernel (r4b) at an auto-sized chunk.  An explicit value forces
+    the tiled kernel at that chunk width (testing hook; must divide
+    ``g*K``, be a multiple of 128, and exceed ``2*max_per_cell``)."""
     n, d = pos.shape
     if d != 2:
         raise ValueError("hash-grid separation kernel is 2-D only")
@@ -314,12 +442,23 @@ def separation_hashgrid_pallas(
     K = max_per_cell
     g, cell_eff = _geometry(torus_hw, cell, K)
     L = g * K
-    if _VMEM_ROWS * L * 4 > _VMEM_BUDGET:
-        raise ValueError(
-            f"grid row of {L} lanes needs ~{(_VMEM_ROWS * L * 4) >> 20} "
-            f"MiB resident VMEM (budget {_VMEM_BUDGET >> 20} MiB); "
-            "lower max_per_cell or use a coarser cell"
-        )
+    if lane_chunk is None:
+        tiled = _VMEM_ROWS * L * 4 > _VMEM_BUDGET
+        Lc = _lane_chunk(L) if tiled else L
+        if tiled and Lc <= 2 * K:
+            raise ValueError(
+                f"no lane chunk of the {L}-lane row fits VMEM while "
+                f"exceeding the 2K={2 * K} shift reach; lower "
+                "max_per_cell"
+            )
+    else:
+        tiled = True
+        Lc = lane_chunk
+        if Lc % 128 != 0 or L % Lc != 0 or Lc <= 2 * K:
+            raise ValueError(
+                f"lane_chunk ({Lc}) must be a 128-multiple divisor "
+                f"of the {L}-lane row exceeding 2*max_per_cell"
+            )
 
     key, order, starts, counts = _cell_tables(pos, torus_hw, g)
     slot, ok = _agent_slots(key, order, starts, K)
@@ -341,31 +480,73 @@ def separation_hashgrid_pallas(
     xr = plane(pos[:, 0])
     yr = plane(pos[:, 1])
 
-    kernel = _make_kernel(
-        float(k_sep), float(personal_space), float(eps),
-        float(torus_hw), K, L,
-    )
     n_tiles = g // _ROWS
-    col = lambda i: (i, 0)                                   # noqa: E731
-    prev_map = lambda i: (jax.lax.rem(i + n_tiles - 1, n_tiles), 0)  # noqa: E731
-    next_map = lambda i: (jax.lax.rem(i + 1, n_tiles), 0)    # noqa: E731
-    blk = lambda m: pl.BlockSpec(                            # noqa: E731
-        (_ROWS, L), m, memory_space=pltpu.VMEM
-    )
-    fx, fy = pl.pallas_call(
-        kernel,
-        grid=(n_tiles,),
-        in_specs=[
-            blk(prev_map), blk(col), blk(next_map),
-            blk(prev_map), blk(col), blk(next_map),
-        ],
-        out_specs=[blk(col), blk(col)],
-        out_shape=[
-            jax.ShapeDtypeStruct((g, L), jnp.float32),
-            jax.ShapeDtypeStruct((g, L), jnp.float32),
-        ],
-        interpret=interpret,
-    )(xr, xr, xr, yr, yr, yr)
+    out_shape = [
+        jax.ShapeDtypeStruct((g, L), jnp.float32),
+        jax.ShapeDtypeStruct((g, L), jnp.float32),
+    ]
+    if not tiled:
+        kernel = _make_kernel(
+            float(k_sep), float(personal_space), float(eps),
+            float(torus_hw), K, L,
+        )
+        col = lambda i: (i, 0)                               # noqa: E731
+        prev_map = lambda i: (jax.lax.rem(i + n_tiles - 1, n_tiles), 0)  # noqa: E731
+        next_map = lambda i: (jax.lax.rem(i + 1, n_tiles), 0)  # noqa: E731
+        blk = lambda m: pl.BlockSpec(                        # noqa: E731
+            (_ROWS, L), m, memory_space=pltpu.VMEM
+        )
+        fx, fy = pl.pallas_call(
+            kernel,
+            grid=(n_tiles,),
+            in_specs=[
+                blk(prev_map), blk(col), blk(next_map),
+                blk(prev_map), blk(col), blk(next_map),
+            ],
+            out_specs=[blk(col), blk(col)],
+            out_shape=out_shape,
+            interpret=interpret,
+        )(xr, xr, xr, yr, yr, yr)
+    else:
+        kernel = _make_tiled_kernel(
+            float(k_sep), float(personal_space), float(eps),
+            float(torus_hw), K, Lc,
+        )
+        nL = L // Lc
+        rm = {
+            "p": lambda i: jax.lax.rem(i + n_tiles - 1, n_tiles),
+            "o": lambda i: i,
+            "n": lambda i: jax.lax.rem(i + 1, n_tiles),
+        }
+        lm = {
+            "l": lambda j: jax.lax.rem(j + nL - 1, nL),
+            "c": lambda j: j,
+            "r": lambda j: jax.lax.rem(j + 1, nL),
+        }
+
+        def blk2(r, c):
+            return pl.BlockSpec(
+                (_ROWS, Lc),
+                lambda i, j, r=r, c=c: (rm[r](i), lm[c](j)),
+                memory_space=pltpu.VMEM,
+            )
+
+        maps = [
+            blk2(r, c)
+            for r in ("p", "o", "n")
+            for c in ("l", "c", "r")
+        ]
+        out_blk = pl.BlockSpec(
+            (_ROWS, Lc), lambda i, j: (i, j), memory_space=pltpu.VMEM
+        )
+        fx, fy = pl.pallas_call(
+            kernel,
+            grid=(n_tiles, nL),
+            in_specs=maps + maps,     # x then y, same 9 maps each
+            out_specs=[out_blk, out_blk],
+            out_shape=out_shape,
+            interpret=interpret,
+        )(*([xr] * 9 + [yr] * 9))
 
     # Dead agents' slots hold the sentinel, so their computed force
     # is exactly zero — no receive-side masking needed.
@@ -406,7 +587,12 @@ def hashgrid_supported(
     g = (int(2.0 * torus_hw / cell) // 16) * 16
     if g < 16:
         return False
-    return _VMEM_ROWS * g * max_per_cell * 4 <= _VMEM_BUDGET
+    L = g * max_per_cell
+    if _VMEM_ROWS * L * 4 <= _VMEM_BUDGET:
+        return True                      # 1-D kernel fits
+    # Lane-tiled kernel (r4b): needs a chunk wider than the 2K shift
+    # reach and sane HBM planes.
+    return _lane_chunk(L) > 2 * max_per_cell and g * L * 4 <= 1 << 30
 
 
 def hashgrid_overflow(

@@ -194,6 +194,62 @@ def test_gridmean_pallas_scan_runs():
     assert bool(jnp.all(jnp.isfinite(state.vel)))
 
 
+@pytest.mark.parametrize("lane_chunk", [128, 256])
+def test_lane_tiled_matches_1d_kernel(lane_chunk):
+    """The r4b lane-tiled kernel (forced via lane_chunk) must agree
+    with the 1-D kernel exactly — same math, different blocking.
+    Chunks at 128 put many cy-seam and chunk-edge crossings in
+    play (g=32, K=16 -> L=512 = 4 chunks of 128)."""
+    pos, alive = _swarm(2048, seed=21)
+    base = separation_hashgrid_pallas(
+        pos, alive, 20.0, PS, 1e-3, cell=CELL, max_per_cell=16,
+        torus_hw=HW, interpret=True,
+    )
+    tiled = separation_hashgrid_pallas(
+        pos, alive, 20.0, PS, 1e-3, cell=CELL, max_per_cell=16,
+        torus_hw=HW, lane_chunk=lane_chunk, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(base), np.asarray(tiled), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_lane_tiled_seam_and_grid_parity():
+    """Tiled kernel vs the portable separation_grid oracle, including
+    seam pairs (chunk maps wrap the cy seam via rem)."""
+    pos = jnp.concatenate([
+        jnp.asarray(
+            [[-HW + 0.3, 0.0], [HW - 0.3, 0.0], [0.0, -HW + 0.3],
+             [0.0, HW - 0.3]], jnp.float32,
+        ),
+        _swarm(1020, seed=9)[0],
+    ])
+    alive = jnp.ones((1024,), bool)
+    f_grid = separation_grid(
+        pos, alive, 20.0, PS, 1e-3, cell=CELL, max_per_cell=16,
+        torus_hw=HW,
+    )
+    f_tiled = separation_hashgrid_pallas(
+        pos, alive, 20.0, PS, 1e-3, cell=CELL, max_per_cell=16,
+        torus_hw=HW, lane_chunk=128, interpret=True,
+    )
+    _assert_match(f_grid, f_tiled)
+
+
+def test_lane_chunk_validation():
+    pos, alive = _swarm(256)
+    with pytest.raises(ValueError, match="lane_chunk"):
+        separation_hashgrid_pallas(
+            pos, alive, 1.0, 2.0, 1e-3, cell=CELL, max_per_cell=16,
+            torus_hw=HW, lane_chunk=192, interpret=True,  # not /128
+        )
+    with pytest.raises(ValueError, match="lane_chunk"):
+        separation_hashgrid_pallas(
+            pos, alive, 1.0, 2.0, 1e-3, cell=CELL, max_per_cell=64,
+            torus_hw=HW, lane_chunk=128, interpret=True,  # <= 2K
+        )
+
+
 def test_validation_and_support_gate():
     pos, alive = _swarm(256)
     with pytest.raises(ValueError, match="2-D"):

@@ -277,3 +277,18 @@ def test_validation_and_support_gate():
     assert not hashgrid_supported(3, jnp.float32, HW, CELL, 16)
     assert not hashgrid_supported(2, jnp.float32, 6.0, CELL, 16)
     assert not hashgrid_supported(2, jnp.float32, HW, CELL, 12)
+
+
+def test_support_gate_admits_1m_flagship_k32():
+    """The r4b tiled kernel's reason to exist: the 1M-agent world
+    (hw=905, r_sep=2) at K=32 — rejected by the 1-D VMEM budget —
+    must pass the gate and chunk at a 128-multiple divisor."""
+    from distributed_swarm_algorithm_tpu.ops.pallas.grid_separation import (
+        _geometry,
+        _lane_chunk,
+    )
+
+    assert hashgrid_supported(2, jnp.float32, 905.0, 2.0, 32)
+    g, _ = _geometry(905.0, 2.0, 32)
+    lc = _lane_chunk(g * 32)
+    assert lc % 128 == 0 and (g * 32) % lc == 0 and lc > 64

@@ -15,11 +15,13 @@ r3 documented "at 1M long scans crash the TPU worker".  r4 bisection
     program-cache interaction), not scan length alone.
 
 Containment shipped anyway (defense in depth): ``models/boids.py``
-chunks the host loop at ``_PORTABLE_GRIDMEAN_CHUNK`` (500) steps per
-XLA program when the portable gridmean path runs on TPU — bounding
-any single program far below every observed failure — and the r4
-default backend is the fused Pallas kernel, which has never exhibited
-the crash (measured: 65k x 14,000 steps, 1M x 300 steps clean).
+chunks the host loop at ``_GRIDMEAN_CHUNK`` (500) steps per XLA
+program for EVERY gridmean run on TPU — r4b widened it from
+portable-only after one crash was also observed on the fused path
+(1M, K=32 lane-tiled, during a ~157 s 200-step scan in a heavy
+process; 65k x 14,000 steps and 1M x 300 in 100-step chunks measure
+clean).  Chunking bounds any single program far below every observed
+failure.
 
 Run on a throwaway process — a reproduced crash kills this process's
 TPU runtime:
@@ -64,7 +66,7 @@ def main() -> None:
         flock.run(steps)
         print(
             f"containment path ok: {steps} steps in "
-            f"{-(-steps // Boids._PORTABLE_GRIDMEAN_CHUNK)} chunked "
+            f"{-(-steps // Boids._GRIDMEAN_CHUNK)} chunked "
             f"programs, pol={flock.polarization:.3f}"
         )
 

@@ -65,38 +65,31 @@ class Boids(CheckpointMixin):
         self.state = step_fn(self.state, self.params, self.obstacles)
         return self.state
 
-    # Longest single scan allowed on the PORTABLE gridmean path on
-    # TPU.  Long scans over separation_grid's 9-stencil gather chain
-    # have INTERMITTENTLY crashed the TPU worker process (observed r3
-    # at 1M and r4 at 4096x2000 — both in processes that had already
-    # run other large programs; not reproducible in a fresh process:
-    # benchmarks/repro_gridmean_crash.py has the full
-    # characterization).  Chunking the host-side loop bounds any
-    # single XLA program far below every observed failure, at ~one
-    # extra dispatch per chunk (~100 us) — semantics identical
-    # (pinned by test).  The fused Pallas backend (the TPU default)
-    # has never exhibited the crash.
-    _PORTABLE_GRIDMEAN_CHUNK = 500
+    # Longest single gridmean scan per XLA program on TPU.  Long
+    # scans have INTERMITTENTLY crashed the TPU worker process —
+    # observed r3 at 1M and r4 at 4096x2000 on the portable path,
+    # and once on the FUSED path (r4b: 1M, K=32 lane-tiled, during a
+    # ~157 s 200-step scan in a heavy process); never reproducible in
+    # a fresh process (benchmarks/repro_gridmean_crash.py has the
+    # characterization — the trigger is scan length x accumulated
+    # worker state).  Chunking the host-side loop bounds any single
+    # program far below every observed failure, at ~one extra
+    # dispatch per chunk (~100 us) — semantics identical (pinned by
+    # test).
+    _GRIDMEAN_CHUNK = 500
 
-    def _portable_gridmean_on_tpu(self) -> bool:
+    def _gridmean_chunking_on_tpu(self) -> bool:
         from ..utils.platform import on_tpu
 
-        if self.neighbor_mode != "gridmean" or not on_tpu():
-            return False
-        # Single source of truth for which backend actually runs
-        # (ops/boids.py:gridmean_uses_hashgrid) — the containment
-        # must track the executed path exactly.
-        return not _k.gridmean_uses_hashgrid(
-            self.params, self.state.pos.shape[-1], self.state.pos.dtype
-        )
+        return self.neighbor_mode == "gridmean" and on_tpu()
 
     def run(self, n_steps: int, record: bool = False):
         """Advance ``n_steps`` ticks; with ``record=True`` returns the
         ``[n_steps, N, D]`` position trajectory."""
         chunk = (
-            self._PORTABLE_GRIDMEAN_CHUNK
-            if n_steps > self._PORTABLE_GRIDMEAN_CHUNK
-            and self._portable_gridmean_on_tpu()
+            self._GRIDMEAN_CHUNK
+            if n_steps > self._GRIDMEAN_CHUNK
+            and self._gridmean_chunking_on_tpu()
             else n_steps
         )
         if n_steps <= 0:

@@ -336,9 +336,9 @@ def test_portable_gridmean_chunking_preserves_semantics(monkeypatch):
     # Force the containment path (off-TPU it is normally inactive)
     # and a tiny chunk so 7 steps split as 3+3+1.
     monkeypatch.setattr(
-        Boids, "_portable_gridmean_on_tpu", lambda self: True
+        Boids, "_gridmean_chunking_on_tpu", lambda self: True
     )
-    monkeypatch.setattr(Boids, "_PORTABLE_GRIDMEAN_CHUNK", 3)
+    monkeypatch.setattr(Boids, "_GRIDMEAN_CHUNK", 3)
     traj = flock.run(7, record=True)
     assert traj.shape == (7, 64, 2)
     np.testing.assert_allclose(

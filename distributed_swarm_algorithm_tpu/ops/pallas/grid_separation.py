@@ -51,7 +51,10 @@ Two measured kernel-shape decisions (r4, 65k boids on v5e):
     (``plane[cell, k] = sorted_agent[starts[cell] + k]``) measured
     4x SLOWER (16.9 vs 4.2 ms at 65k/K=16): the gather touches all
     g*g*K slots where the scatter writes only N values over a fast
-    fill.
+    fill.  (Also negative: fusing the two plane scatters into one
+    [slots, 2]-row scatter — 5.7 vs 4.1 ms at 65k/K=24; the doubled
+    fill and strided column slices cost more than the saved scatter
+    launch.)
 
 Minimum-image wrapping uses the select form
 ``where(v >= hw, v - 2hw, where(v < -hw, v + 2hw, v))`` — exact for

@@ -28,6 +28,20 @@ CONFIGS = [
     (4_096, "dense", 1000, 1),
     (65_536, "pallas", 100, 1),
     (65_536, "window", 2000, 8),
+    # r5 (VERDICT r4 item 3): hashgrid — exact-up-to-cap separation,
+    # the rows that collapse the 170x exact-tick-vs-window gap (2.39M
+    # agent-steps/s on the all-pairs pallas row above).  The cell-slot
+    # kernel's sweep is O(arena_cells * K) — it is the DENSITY-MATCHED
+    # mode — so these rows run the bounded-arena scenario (hw=256
+    # torus, spread-250 spawn, shared target, formation="none"; the
+    # rank-indexed V spans ~130 km at 65k agents, which no bounded
+    # world can hold).  A window row on the SAME scenario gives the
+    # in-scenario exact-vs-approximate ratio; first measurement of the
+    # naive unbounded config (world_hw=1024 around the spread-1000
+    # spawn) read 1.66M agent-steps/s — the arena-sized grid, not the
+    # agents, was the cost, hence this scenario.
+    (65_536, "hashgrid", 1000, 1),
+    (65_536, "window-arena", 1000, 8),
     # The r3 flagship: the full 1M-agent protocol tick (window
     # separation, Morton sort amortized) — the 337-ticks/s config of
     # docs/PERFORMANCE.md's decomposition table, recorded per-round
@@ -42,10 +56,19 @@ CONFIGS = [
 
 
 def bench(n: int, mode: str, steps: int, sort_every: int) -> None:
+    arena = mode in ("hashgrid", "window-arena")
+    sep = "window" if mode == "window-arena" else mode
     cfg = dsa.SwarmConfig().replace(
-        separation_mode=mode, sort_every=sort_every
+        separation_mode=sep, sort_every=sort_every
     )
-    s = dsa.make_swarm(n, seed=0, spread=1000.0)
+    if arena:
+        cfg = cfg.replace(formation_shape="none")
+    if mode == "hashgrid":
+        cfg = cfg.replace(
+            world_hw=256.0, grid_max_per_cell=16,
+            hashgrid_overflow_budget=1024,
+        )
+    s = dsa.make_swarm(n, seed=0, spread=250.0 if arena else 1000.0)
     s = dsa.with_tasks(
         s, jnp.asarray([[1.0, 1.0], [-2.0, 3.0], [5.0, -8.0], [0.0, 9.0]])
     )

@@ -1,0 +1,142 @@
+"""Stage decomposition of the gridmean boids step, sustained regime.
+
+The r5 lever-verification tool (VERDICT r4 items 1-2): times, per
+configuration, scans of
+
+  - ``full``  — the complete gridmean step (sep + CIC field + integrate),
+  - ``sep``   — the fused hash-grid separation alone,
+  - ``build`` — just the cell sort + slot planes (no kernel sweep),
+
+each under one jitted ``lax.scan`` long enough that per-call tunnel
+dispatch is noise (house methodology, benchmarks/common.py).  Stage
+costs are reported per step; ``sep - build`` isolates the kernel sweep
+and ``full - sep`` the CIC field + integration tail.
+
+Usage: python decompose_gridmean.py [65k|1m|both]
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from common import timeit_best
+
+from distributed_swarm_algorithm_tpu.ops import boids as bk
+from distributed_swarm_algorithm_tpu.ops.pallas.grid_separation import (
+    _geometry,
+    _slots_sorted,
+    hashgrid_overflow,
+    separation_hashgrid_pallas,
+)
+
+# (tag, n, half_width, steps/call, param overrides)
+CONFIGS = {
+    "65k-K24": (65_536, 226.0, 100,
+                dict(grid_max_per_cell=24)),
+    "65k-half-K8": (65_536, 226.0, 100,
+                    dict(grid_max_per_cell=8, grid_sep_cell=1.0)),
+    "65k-K16": (65_536, 226.0, 100,
+                dict(grid_max_per_cell=16,
+                     grid_overflow_budget=2048)),
+    "65k-K16-nr": (65_536, 226.0, 100,
+                   dict(grid_max_per_cell=16,
+                        grid_overflow_budget=0)),
+    "65k-K16-b512": (65_536, 226.0, 100,
+                     dict(grid_max_per_cell=16,
+                          grid_overflow_budget=512)),
+    "1m-K32": (1_048_576, 905.0, 20,
+               dict(grid_max_per_cell=32)),
+    "1m-half-K8": (1_048_576, 905.0, 20,
+                   dict(grid_max_per_cell=8, grid_sep_cell=1.0)),
+}
+
+
+def _scan(fn, state, steps):
+    def body(s, _):
+        return fn(s), None
+
+    run = jax.jit(
+        lambda s: jax.lax.scan(body, s, None, length=steps)[0]
+    )
+    out = {"s": run(state)}
+    jax.block_until_ready(out["s"].pos)
+
+    def once():
+        out["s"] = run(state)
+
+    best = timeit_best(once, lambda: float(out["s"].pos[0, 0]))
+    return best / steps
+
+
+def decompose(tag: str) -> None:
+    n, hw, steps, kw = CONFIGS[tag]
+    p = bk.BoidsParams(half_width=hw, **kw)
+    cell = p.grid_sep_cell if p.grid_sep_cell > 0 else p.r_sep
+    K = p.grid_max_per_cell
+    state = bk.boids_init(n, 2, params=p, seed=0)
+
+    # Settle 200 steps so timings see flocking-era occupancy, not the
+    # uniform spawn.
+    state, _ = bk.boids_run(state, p, 200, neighbor_mode="gridmean")
+    jax.block_until_ready(state.pos)
+    ovf = int(hashgrid_overflow(state.pos, cell, K, hw))
+
+    full = _scan(
+        lambda s: bk.boids_step_gridmean(s, p), state, steps
+    )
+
+    def sep_only(s):
+        f = separation_hashgrid_pallas(
+            s.pos, jnp.ones((n,), bool), 1.0, float(p.r_sep),
+            float(p.eps), cell=float(cell), max_per_cell=K,
+            torus_hw=float(hw),
+            overflow_budget=p.grid_overflow_budget,
+        )
+        # Tiny coupling keeps the scan body non-DCE-able while
+        # perturbing the trajectory below fp-visibility.
+        return s.replace(pos=s.pos + 1e-30 * f)
+
+    sep = _scan(sep_only, state, steps)
+
+    g, _ = _geometry(hw, cell, K)
+
+    def build_only(s):
+        order, skey, rank, ok, sx, sy = _slots_sorted(
+            s.pos, jnp.ones((n,), bool), hw, g, K
+        )
+        slot_s = jnp.where(ok, skey * K + rank, g * g * K)
+        plane = (
+            jnp.full((g * g * K + 1,), 1.0e18, jnp.float32)
+            .at[slot_s].set(sx)[: g * g * K]
+        )
+        probe = plane[0] + sy[0] + order[0]
+        return s.replace(pos=s.pos + 1e-30 * probe)
+
+    build = _scan(build_only, state, steps)
+
+    print(
+        f"{tag}: full {full * 1e3:.2f} ms/step | sep {sep * 1e3:.2f}"
+        f" | build(1 plane) {build * 1e3:.2f} | kernel+2nd-plane "
+        f"{(sep - build) * 1e3:.2f} | field+integrate "
+        f"{(full - sep) * 1e3:.2f} | overflow@t200 {ovf}"
+    )
+
+
+def main() -> None:
+    which = sys.argv[1] if len(sys.argv) > 1 else "65k"
+    tags = {
+        "65k": ["65k-K24", "65k-half-K8"],
+        "65k16": ["65k-K16"],
+        "65k16x": ["65k-K16-nr", "65k-K16-b512"],
+        "1m": ["1m-K32", "1m-half-K8"],
+        "both": list(CONFIGS),
+    }[which]
+    for t in tags:
+        decompose(t)
+
+
+if __name__ == "__main__":
+    main()

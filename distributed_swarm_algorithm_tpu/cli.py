@@ -213,6 +213,13 @@ def _cmd_swarm(args) -> int:
         from .utils.config import DEFAULT_CONFIG
 
         cfg = DEFAULT_CONFIG.replace(separation_mode=args.separation)
+        if args.separation == "hashgrid":
+            # Default arena: 4x the spawn spread, so targets well
+            # outside the spawn box stay inside the torus.
+            cfg = cfg.replace(
+                world_hw=args.world_hw
+                if args.world_hw > 0 else 4.0 * max(args.spread, 1.0)
+            )
         sw = VectorSwarm(args.n, dim=args.dim, seed=args.seed,
                          spread=args.spread, config=cfg)
     else:
@@ -705,11 +712,17 @@ def build_parser() -> argparse.ArgumentParser:
              "(open with TensorBoard/XProf)")
     p_swarm.add_argument(
         "--separation", default="dense",
-        choices=["dense", "pallas", "grid", "window", "off"],
+        choices=["dense", "pallas", "grid", "window", "hashgrid", "off"],
         help="neighbor-separation kernel (jax backend): dense all-pairs, "
              "tiled Pallas (exact, large N on TPU), spatial-hash grid "
              "(CPU), Morton-window (approximate, very large N on TPU), "
-             "or off",
+             "hashgrid (torus-world hash — exact up to the cell cap, "
+             "fused Pallas kernel on TPU; needs --world-hw), or off",
+    )
+    p_swarm.add_argument(
+        "--world-hw", type=float, default=0.0, metavar="HW",
+        help="torus half-width for --separation hashgrid: the world "
+             "becomes [-HW, HW)^2 (default: 4x --spread)",
     )
     p_swarm.add_argument(
         "--save-state", default=None, metavar="PATH",

@@ -115,10 +115,20 @@ class BoidsParams(NamedTuple):
     align_deposit: str = "bilinear"
     # Rescue budget for the fused separation kernel: max capped-out
     # agents per step that still get exact (symmetric) separation via
-    # the dense rescue pass.  Size to the transient worst case —
+    # the kernel's rescue pass (r5: a LOCAL cell-neighborhood pass,
+    # no longer dense-vs-all).  Size to the transient worst case —
     # overflow beyond it silently gets zero separation (the kernel
     # module doc has the measured runaway this prevents); 0 disables.
     grid_overflow_budget: int = 512
+    # Separation-grid cell for gridmean mode; 0 = r_sep (the classic
+    # 3x3 stencil).  r5: values in [r_sep/2, r_sep) engage the fused
+    # kernel's HALF-CELL 5x5 sweep — occupancy per cell drops ~4x, so
+    # pair e.g. grid_sep_cell = r_sep/2 with grid_max_per_cell//
+    # (i.e. 24 -> 8) for a ~2-3x cheaper sweep at equal capacity.
+    # Kernel-path only: the portable separation_grid stays on the
+    # full r_sep cell (its 3x3 gather needs cell >= r_sep) — both are
+    # exact up to their caps, so the backends still agree.
+    grid_sep_cell: float = 0.0
     # Separation backend for gridmean mode.  "auto" = the fused
     # Pallas hash-grid kernel (ops/pallas/grid_separation.py) on TPU
     # when the configuration qualifies (2-D f32, >=16 grid rows after
@@ -350,29 +360,13 @@ def gridmean_uses_hashgrid(p: BoidsParams, dim: int, dtype) -> bool:
     crash-containment guard (which must track the path actually
     executed).  Raises on an unknown backend string, and on
     ``"pallas"`` outside the kernel envelope."""
-    if p.grid_sep_backend not in ("auto", "pallas", "portable"):
-        raise ValueError(
-            f"unknown grid_sep_backend {p.grid_sep_backend!r}; "
-            "expected 'auto', 'pallas', or 'portable'"
-        )
-    if p.grid_sep_backend == "portable":
-        return False
-    from .pallas.grid_separation import hashgrid_supported
+    from .pallas.grid_separation import hashgrid_backend_choice
 
-    supported = hashgrid_supported(
-        dim, dtype, p.half_width, p.r_sep, p.grid_max_per_cell
+    return hashgrid_backend_choice(
+        p.grid_sep_backend, dim, dtype, p.half_width,
+        p.grid_sep_cell if p.grid_sep_cell > 0 else p.r_sep,
+        p.grid_max_per_cell, p.r_sep, knob="grid_sep_backend",
     )
-    if p.grid_sep_backend == "pallas" and not supported:
-        raise ValueError(
-            "grid_sep_backend='pallas' but this configuration is "
-            "outside the kernel's envelope (needs 2-D f32, "
-            "2*half_width/r_sep >= 16 grid cells, grid_max_per_cell "
-            "a multiple of 8 in [8, 64], and the grid row within "
-            "the VMEM budget)"
-        )
-    from ..utils.platform import on_tpu
-
-    return supported and (p.grid_sep_backend == "pallas" or on_tpu())
 
 
 def boids_forces_gridmean(
@@ -450,7 +444,10 @@ def boids_forces_gridmean(
 
         sep = separation_hashgrid_pallas(
             pos, jnp.ones((n,), bool), 1.0, float(p.r_sep),
-            float(p.eps), cell=float(p.r_sep),
+            float(p.eps),
+            cell=float(
+                p.grid_sep_cell if p.grid_sep_cell > 0 else p.r_sep
+            ),
             max_per_cell=p.grid_max_per_cell,
             torus_hw=float(p.half_width),
             overflow_budget=p.grid_overflow_budget,

@@ -15,36 +15,56 @@ rows: the torus ``[-hw, hw)^2`` is tiled by a ``g x g`` cell grid
 multiple of 8) and every cell owns ``K`` agent slots.  Agent
 attributes live in ``[g, g*K]`` planes: sublane = grid row ``cx``,
 lane = ``cy*K + rank`` (rank = the agent's arrival order within its
-cell, from one stable sort).  Two facts make the 3x3 stencil free in
-this layout:
+cell, from one stable sort).  Two facts make the stencil free in this
+layout:
 
   - cy-adjacency is LANE-adjacency: a neighbor in cell ``cy' in
-    {cy-1, cy, cy+1}`` sits within ``+-(2K-1)`` lanes, so the whole
+    [cy-R, cy+R]`` sits within ``+-((R+1)K - 1)`` lanes, so the whole
     in-row stencil is a sweep of static cyclic lane rolls
     (``pltpu.roll``) — and because the roll is cyclic over the
     ``g*K``-lane row, the cy seam of the torus wraps for free.
-  - cx-adjacency is SUBLANE-adjacency: rows ``cx+-1`` come from a
-    one-sublane roll patched from the adjacent 8-row tile block
-    (same prev/own/next rotated-BlockSpec trick as
-    window_separation.py), and the rem-wrapped index maps wrap the
-    cx seam for free.
+  - cx-adjacency is SUBLANE-adjacency: rows ``cx+r`` come from an
+    r-sublane roll patched from the adjacent 8-row tile block
+    (the same rotated-BlockSpec trick as window_separation.py), and
+    the rem-wrapped index maps wrap the cx seam for free.
 
-Rolls reaching past ``+-1`` cell in cy (possible for ``|s| > K``) are
-rejected by the distance test alone: cells two apart are separated by
-``cell_eff >= personal_space``, so no extra validity mask is needed.
+``R`` is the stencil radius in cells: 1 (the classic 3x3, for
+``cell_eff >= personal_space``) or 2 (a 5x5 over HALF-cells, for
+``personal_space/2 <= cell_eff < personal_space`` — r5).  The
+half-cell geometry quarters the per-cell occupancy, so ``K`` drops
+~4x and the total shift count falls ~2x at equal capacity; rolls
+reaching past ``+-R`` cells are rejected by the distance test alone
+(cells R+1 apart are separated by ``R*cell_eff >= personal_space``).
+
+ANTISYMMETRIC sweeps (r5): every pair is COMPUTED exactly once.
+Own-row pairs sweep positive lane shifts only; the mirror force is
+applied in-kernel as a reaction (``-contrib`` lane-rolled by ``-s`` —
+cyclic over the full row, so the cy seam stays exact).  Row pairs
+sweep only the DOWN bases (rows ``cx+1..cx+R``) — the up bases are
+gone entirely — and their reactions accumulate into per-``r``
+UNROLLED planes that the host-side wrapper row-rolls by ``+r``
+(cyclic over all ``g`` rows, closing tile boundaries and the cx
+torus seam in one jnp.roll) and subtracts.  Net: ~((R+1)K shifts own
++ R * 2(R+1)K down) vs the symmetric form's (2R+1) * 4(R+1)K/...
+— at R=1 the shift count halves; at R=2/half-cell vs R=1/full-cell
+it falls ~3x with the ~4x smaller K.
+
+Distance math runs in SQUARED space (r5): ``near = d2 < ps^2`` and
+``scale = k * rsqrt(max(d2, eps^2))^3`` — no sqrt, no divide in the
+hot loop; bit-for-bit this equals ``k / max(d, eps)^3`` up to rsqrt
+rounding (parity bands in tests are unchanged).
 
 Two measured kernel-shape decisions (r4, 65k boids on v5e):
 
   - No alive plane: empty and dead slots hold a 1e18 position
     SENTINEL — any pair involving a sentinel fails
-    ``dist < personal_space`` by construction (sentinel-sentinel
-    pairs alias to dist 0, but their contribution is
-    ``scale * diff = scale * 0``), so the alive plane, its rolls,
-    and its compares all vanish: 2 rolls per shift instead of 3.
-    (Stacking all six remaining planes into one [48, L] array rolled
-    once per shift was also tried and measured NEGATIVE: 2x slower
-    and a scoped-VMEM OOM at K=32 — Mosaic kept ~4x more rows
-    resident.  Per-plane [8, L] rolls it is.)
+    ``d2 < ps^2`` by construction (sentinel-sentinel pairs alias to
+    d2 = 0, but their contribution is ``scale * diff = scale * 0``),
+    so the alive plane, its rolls, and its compares all vanish.
+    (Stacking all planes into one tall array rolled once per shift
+    was also tried and measured NEGATIVE: 2x slower and a
+    scoped-VMEM OOM at K=32 — Mosaic kept ~4x more rows resident.
+    Per-plane [8, L] rolls it is.)
   - Build by scatter, not gather: each agent writes its (x, y) into
     its slot of a sentinel-FILLED [g*g*K] buffer.  The seemingly
     TPU-friendlier CSR inverse-map gather
@@ -55,6 +75,17 @@ Two measured kernel-shape decisions (r4, 65k boids on v5e):
     [slots, 2]-row scatter — 5.7 vs 4.1 ms at 65k/K=24; the doubled
     fill and strided column slices cost more than the saved scatter
     launch.)
+
+The BUILD (r5): one variadic ``lax.sort`` over ``(key, iota, x, y)``
+(iota as tie-break key = stability without is_stable) replaces
+argsort + three post-sort gathers, and within-cell ranks come from a
+run-position ``cummax`` over the sorted keys instead of a CSR starts
+table — the [g*g] counts scatter, its cumsum, and the starts gather
+(the dominant build terms at 1M, where g*g > N) all vanish.  Cell
+ASSIGNMENT still comes from the shared
+ops/neighbors.py:torus_cell_tables so the binning parity contract
+with separation_grid cannot drift (its unused CSR outputs are
+DCE'd under jit).
 
 Minimum-image wrapping uses the select form
 ``where(v >= hw, v - 2hw, where(v < -hw, v + 2hw, v))`` — exact for
@@ -69,7 +100,9 @@ each neighbor GATHER (a truncated agent there still receives force
 from its own stencil pass).  With ``K`` at or above the max cell
 occupancy both are exact and byte-identical to a dense torus pass;
 size ``K`` to your density with :func:`hashgrid_overflow` (returns
-the dropped-agent count).
+the dropped-agent count).  Dead agents claim no slots (r5): they are
+keyed past the grid by the sort, so a cell crowded with dead agents
+cannot push live agents into overflow.
 
 The overflow RESCUE pass (``overflow_budget``): capped-out agents
 must still RECEIVE separation force, or the cap becomes a runaway —
@@ -79,13 +112,26 @@ dropped agents force-free, they free-fall into the clump (NN
 flock ends up dropped, even though the TRUE dynamics (dense oracle)
 never exceed the cap at all (overflow 0 at equilibrium).  So up to
 ``overflow_budget`` overflow agents get their force from an exact
-masked dense pass against all agents (O(budget * N), fused by XLA,
-~0 cost when overflow is empty).  They still do not push in-grid
-agents until they re-enter the grid — a transient asymmetry that
-vanishes at equilibrium, where overflow is empty and the kernel is
-exact.  Overflow beyond the budget gets zero force (size the budget
-to your transient worst case; the count is observable via
-:func:`hashgrid_overflow`).
+masked pass.  SYMMETRIC (r4 fix, the load-bearing part): each rescued
+pair (v, j) contributes both the force ON v and the reaction ON j —
+receive-only rescue measured catastrophic (18 invisible agents
+poisoned 248 neighbors' forces and the flock collapsed to pol ~0.03
+where the exact control reaches 0.993).
+
+r5 replaces the rescue's [budget, N] DENSE pass with a LOCAL one
+(VERDICT r4 item 1 — the dense pass was ~500 ms of the 785 ms 1M
+step): each rescued agent gathers only the ``(2R+1)^2 * K`` plane
+slots of its cell neighborhood — every in-range in-grid partner is
+in there by the stencil-covers-radius construction — plus a
+[budget, budget] pass over the other RESCUED agents (overflow agents
+cluster in the same cells by construction, so they see each other).
+Semantics vs the dense rescue: identical whenever live overflow
+<= budget (same pair set); past the budget, unrescued agents are
+invisible to rescued ones (the dense form let them exert force) —
+both forms already give unrescued agents zero force, so size the
+budget to the transient worst case exactly as before.  The reaction
+term excludes partners that are themselves capped-out (their own
+rescue row counts the pair).
 
 Capability lineage: the separation rule is /root/reference/
 agent.py:148-160; the grid machinery is this repo's own scale answer
@@ -103,17 +149,32 @@ from jax.experimental.pallas import tpu as pltpu
 
 _ROWS = 8               # sublane tile height (grid rows per block)
 _SENTINEL = 1.0e18      # empty/dead slot position (see module doc)
-# Peak resident VMEM ~ (6 double-buffered input blocks + 2 outputs +
-# 4 row-base planes + roll/diff temporaries), each [8, L] f32 ~ 24
-# blocks; budgeted against the 16 MB/core scoped-vmem limit.
-_VMEM_ROWS = 24 * _ROWS
+# Peak resident VMEM for the 1-D kernel ~ (4 double-buffered input
+# blocks + (2 + 2R) double-buffered outputs + down bases + roll/diff
+# temporaries), each [8, L] f32; budgeted against the 16 MB/core
+# scoped-vmem limit with headroom.
+_VMEM_ROWS = {1: 24 * _ROWS, 2: 30 * _ROWS}
 _VMEM_BUDGET = 13 * 1024 * 1024
+
+
+def _stencil_radius(cell_eff: float, personal_space: float) -> int:
+    """R in cells the sweep must reach so the stencil covers the
+    separation radius: 1 for full cells, 2 for half cells."""
+    if cell_eff >= personal_space:
+        return 1
+    if 2.0 * cell_eff >= personal_space:
+        return 2
+    raise ValueError(
+        f"grid cell ({cell_eff}) must be >= personal_space/2 "
+        f"({personal_space / 2}) so the 5x5 stencil covers the "
+        "separation radius (>= personal_space gives the cheaper 3x3)"
+    )
 
 
 def _geometry(torus_hw: float, cell: float, max_per_cell: int):
     """(g, cell_eff) for the cell grid.  ``g`` is ``floor(2hw/cell)``
     rounded DOWN to a multiple of 16 (so ``cell_eff >= cell`` and the
-    stencil radius can only grow past ``personal_space``; 16 keeps
+    stencil radius can only grow past its coverage bound; 16 keeps
     ``g*K`` lane-aligned for every ``K`` multiple of 8)."""
     if max_per_cell % 8 != 0 or not 8 <= max_per_cell <= 64:
         raise ValueError(
@@ -130,8 +191,29 @@ def _geometry(torus_hw: float, cell: float, max_per_cell: int):
     return g, 2.0 * torus_hw / g
 
 
-def _make_kernel(k_sep, personal_space, eps, hw, K, L):
+def _pair_terms(k_sep, ps2, eps2, wrap, xo, yo, bx, by, s, L):
+    """(cx, cy) force contribution of the shift-``s`` pair sweep:
+    squared-space distance test, rsqrt scale (see module doc)."""
+    dxv = wrap(xo - pltpu.roll(bx, s % L, 1))
+    dyv = wrap(yo - pltpu.roll(by, s % L, 1))
+    d2 = dxv * dxv + dyv * dyv
+    near = d2 < ps2
+    inv = jax.lax.rsqrt(jnp.maximum(d2, eps2))
+    scale = k_sep * inv * inv * inv
+    return (
+        jnp.where(near, scale * dxv, 0.0),
+        jnp.where(near, scale * dyv, 0.0),
+    )
+
+
+def _make_kernel(k_sep, personal_space, eps, hw, K, L, R):
+    """1-D (full-row) antisymmetric kernel: outputs (fx, fy) plus one
+    unrolled reaction plane pair per down distance r = 1..R (the
+    host wrapper row-rolls them by +r and subtracts)."""
     two_hw = 2.0 * hw
+    ps2 = personal_space * personal_space
+    eps2 = eps * eps
+    reach = (R + 1) * K          # lane shifts sweep |s| < reach
 
     def wrap(v):
         # Select-form minimum image: exact for |v| < 2hw, inert on
@@ -140,132 +222,114 @@ def _make_kernel(k_sep, personal_space, eps, hw, K, L):
             v >= hw, v - two_hw, jnp.where(v < -hw, v + two_hw, v)
         )
 
-    def kernel(xp_ref, xo_ref, xn_ref, yp_ref, yo_ref, yn_ref,
-               fx_ref, fy_ref):
+    def kernel(xo_ref, xn_ref, yo_ref, yn_ref, fx_ref, fy_ref,
+               *react_refs):
         xo, yo = xo_ref[:], yo_ref[:]
         row = jax.lax.broadcasted_iota(jnp.int32, (_ROWS, L), 0)
 
-        # Row-shifted bases: up[r] = grid row r-1 (row 0 patched from
-        # the previous tile's last row); down[r] = row r+1 (row 7
-        # from the next tile's first).  rem-wrapped index maps make
-        # the prev of tile 0 the LAST tile, closing the cx seam.
-        def up(own, prev):
+        def downr(own, nxt, r):
+            # base[q] = grid row q+r; rows >= 8-r patched from the
+            # next tile (rem-wrapped index maps close the cx seam).
             return jnp.where(
-                row == 0, pltpu.roll(prev, 1, 0), pltpu.roll(own, 1, 0)
+                row >= _ROWS - r,
+                pltpu.roll(nxt, _ROWS - r, 0),
+                pltpu.roll(own, _ROWS - r, 0),
             )
 
-        def down(own, nxt):
-            return jnp.where(
-                row == _ROWS - 1,
-                pltpu.roll(nxt, _ROWS - 1, 0),
-                pltpu.roll(own, _ROWS - 1, 0),
+        # Accumulate INTO the output refs: ref stores are
+        # memory-sequenced, so each shift's temporaries die before
+        # the next shift.  Accumulating in SSA values instead lets
+        # Mosaic's scheduler defer the reaction rolls and keep every
+        # shift's contribution live at once — measured 27.6 MB
+        # scoped-VMEM stack at 65k/K=24 (limit 16) where this form
+        # fits.  (optimization_barrier is not lowerable in Mosaic.)
+        fx_ref[:] = jnp.zeros((_ROWS, L), jnp.float32)
+        fy_ref[:] = jnp.zeros((_ROWS, L), jnp.float32)
+
+        # Own row: positive shifts only; the mirror is the in-kernel
+        # reaction (-contrib rolled by -s, cyclic = cy-seam exact).
+        for s in range(1, reach):
+            cx_, cy_ = _pair_terms(
+                k_sep, ps2, eps2, wrap, xo, yo, xo, yo, s, L
             )
+            fx_ref[:] += cx_ - pltpu.roll(cx_, (L - s) % L, 1)
+            fy_ref[:] += cy_ - pltpu.roll(cy_, (L - s) % L, 1)
 
-        # Measured negative (r4, 65k/K=32): stacking all six planes
-        # into one [48, L] array rolled once per shift was 2x SLOWER
-        # than these per-plane [8, L] rolls and OOM'd scoped VMEM
-        # (Mosaic kept ~4x more rows resident) — per-plane it is.
-        bases = (
-            (up(xo, xp_ref[:]), up(yo, yp_ref[:]), False),
-            (xo, yo, True),
-            (down(xo, xn_ref[:]), down(yo, yn_ref[:]), False),
-        )
-
-        fx = jnp.zeros((_ROWS, L), jnp.float32)
-        fy = jnp.zeros((_ROWS, L), jnp.float32)
-        for bx, by, is_own in bases:
-            for s in range(-(2 * K - 1), 2 * K):
-                if is_own and s == 0:
-                    continue          # a slot is its own only self-pair
-                dx = wrap(xo - pltpu.roll(bx, s % L, 1))
-                dy = wrap(yo - pltpu.roll(by, s % L, 1))
-                dist = jnp.sqrt(dx * dx + dy * dy)
-                dist_c = jnp.maximum(dist, eps)
-                # Sentinel slots (empty/dead) fail this by construction.
-                near = dist < personal_space
-                # k_sep / d_c^2 * diff / d_c  (agent.py:155 form)
-                scale = k_sep / (dist_c * dist_c * dist_c)
-                fx = fx + jnp.where(near, scale * dx, 0.0)
-                fy = fy + jnp.where(near, scale * dy, 0.0)
-        fx_ref[:] = fx
-        fy_ref[:] = fy
+        # Down rows r = 1..R: full lane sweep; reactions accumulate
+        # lane-rolled into the per-r output planes (row roll happens
+        # outside the kernel on the full [g, L] plane).
+        xn, yn = xn_ref[:], yn_ref[:]
+        for r in range(1, R + 1):
+            bx = downr(xo, xn, r)
+            by = downr(yo, yn, r)
+            rx_ref = react_refs[2 * (r - 1)]
+            ry_ref = react_refs[2 * (r - 1) + 1]
+            rx_ref[:] = jnp.zeros((_ROWS, L), jnp.float32)
+            ry_ref[:] = jnp.zeros((_ROWS, L), jnp.float32)
+            for s in range(-reach + 1, reach):
+                cx_, cy_ = _pair_terms(
+                    k_sep, ps2, eps2, wrap, xo, yo, bx, by, s, L
+                )
+                fx_ref[:] += cx_
+                fy_ref[:] += cy_
+                rx_ref[:] += pltpu.roll(cx_, (L - s) % L, 1)
+                ry_ref[:] += pltpu.roll(cy_, (L - s) % L, 1)
 
     return kernel
 
 
-def _make_tiled_kernel(k_sep, personal_space, eps, hw, K, Lc):
-    """Lane-tiled variant (r4b): grid rows are processed in chunks of
-    ``Lc`` lanes, so VMEM residency is bounded by ``Lc`` instead of
-    the whole ``g*K`` row — this is what lifts the cell-cap ceiling at
-    1M-agent world sizes (K=32 needs L=28,672-lane rows; the 1-D
-    kernel's ~24 resident blocks of that length blow the 16 MiB
-    scoped budget).
+def _make_tiled_kernel(k_sep, personal_space, eps, hw, K, Lc, R):
+    """Lane-tiled antisymmetric variant (r4b blocking, r5 sweep):
+    grid rows are processed in chunks of ``Lc`` lanes, so VMEM
+    residency is bounded by ``Lc`` instead of the whole ``g*K`` row —
+    this is what lifts the cell-cap ceiling at 1M-agent world sizes.
 
-    Each of the three row-bases (up/own/down) is built for the
-    CENTER lane chunk and its LEFT and RIGHT neighbors; a lane roll
-    by ``s`` then patches the ``|s|`` edge lanes from the neighbor
-    chunk — the same wrap-and-patch trick as the row direction, one
-    axis over.  rem-wrapped lane-chunk index maps close the cy torus
-    seam exactly like the row maps close cx."""
+    Row bases (own + down-r) are built for the CENTER lane chunk and
+    its LEFT and RIGHT neighbors; a lane roll by ``s`` patches the
+    ``|s|`` edge lanes from the neighbor chunk — the same
+    wrap-and-patch trick as the row direction, one axis over;
+    rem-wrapped lane-chunk index maps close the cy torus seam exactly
+    like the row maps close cx.
+
+    Reaction lane-rolls CROSS chunk edges: the wrapped lanes of
+    ``roll(contrib, -s)`` belong to the left (s > 0) or right (s < 0)
+    neighbor chunk at the SAME lane index, so they accumulate into
+    LEFT/RIGHT spill planes that the host wrapper lane-rolls by
+    ``-+Lc`` (global, cyclic) and subtracts.  Output planes per
+    component: main, own-left spill, and per r: in-chunk, left,
+    right — all unrolled in the row direction (host row-rolls by +r).
+    """
     two_hw = 2.0 * hw
+    ps2 = personal_space * personal_space
+    eps2 = eps * eps
+    reach = (R + 1) * K
 
     def wrap(v):
         return jnp.where(
             v >= hw, v - two_hw, jnp.where(v < -hw, v + two_hw, v)
         )
 
-    def kernel(xpl_ref, xpc_ref, xpr_ref,
-               xol_ref, xoc_ref, xor_ref,
-               xnl_ref, xnc_ref, xnr_ref,
-               ypl_ref, ypc_ref, ypr_ref,
-               yol_ref, yoc_ref, yor_ref,
-               ynl_ref, ync_ref, ynr_ref,
-               fx_ref, fy_ref):
+    def kernel(*refs):
+        # inputs: x(own l,c,r  next l,c,r)  y(same 6) = 12 refs
+        (xol_ref, xoc_ref, xor_ref, xnl_ref, xnc_ref, xnr_ref,
+         yol_ref, yoc_ref, yor_ref, ynl_ref, ync_ref, ynr_ref) = refs[:12]
+        outs = refs[12:]
+        # outputs: fx, fy, L0x, L0y, then per r: (INx, INy, Lx, Ly,
+        # Rx, Ry)
         row = jax.lax.broadcasted_iota(jnp.int32, (_ROWS, Lc), 0)
         lane = jax.lax.broadcasted_iota(jnp.int32, (_ROWS, Lc), 1)
 
-        def up(own, prev):
+        def downr(own, nxt, r):
             return jnp.where(
-                row == 0, pltpu.roll(prev, 1, 0), pltpu.roll(own, 1, 0)
+                row >= _ROWS - r,
+                pltpu.roll(nxt, _ROWS - r, 0),
+                pltpu.roll(own, _ROWS - r, 0),
             )
-
-        def down(own, nxt):
-            return jnp.where(
-                row == _ROWS - 1,
-                pltpu.roll(nxt, _ROWS - 1, 0),
-                pltpu.roll(own, _ROWS - 1, 0),
-            )
-
-        xoc, yoc = xoc_ref[:], yoc_ref[:]
-        # (left, center, right) triple per row-base and attribute.
-        bases = (
-            (
-                (up(xol_ref[:], xpl_ref[:]), up(xoc, xpc_ref[:]),
-                 up(xor_ref[:], xpr_ref[:])),
-                (up(yol_ref[:], ypl_ref[:]), up(yoc, ypc_ref[:]),
-                 up(yor_ref[:], ypr_ref[:])),
-                False,
-            ),
-            (
-                (xol_ref[:], xoc, xor_ref[:]),
-                (yol_ref[:], yoc, yor_ref[:]),
-                True,
-            ),
-            (
-                (down(xol_ref[:], xnl_ref[:]), down(xoc, xnc_ref[:]),
-                 down(xor_ref[:], xnr_ref[:])),
-                (down(yol_ref[:], ynl_ref[:]), down(yoc, ync_ref[:]),
-                 down(yor_ref[:], ynr_ref[:])),
-                False,
-            ),
-        )
 
         def shifted(left, center, right, s):
-            # center[r, i - s] with edge lanes patched from the
-            # neighbor chunk: for s > 0 the first s lanes come from
-            # LEFT's tail; for s < 0 the last |s| lanes from RIGHT's
-            # head.  The cyclic chunk index maps make the patch wrap
-            # the torus seam at the row ends.
+            # center[q, i - s] with edge lanes patched from the
+            # neighbor chunk (cyclic chunk index maps wrap the torus
+            # seam at the row ends).
             if s > 0:
                 return jnp.where(
                     lane < s,
@@ -279,22 +343,83 @@ def _make_tiled_kernel(k_sep, personal_space, eps, hw, K, Lc):
                 pltpu.roll(center, r, 1),
             )
 
-        fx = jnp.zeros((_ROWS, Lc), jnp.float32)
-        fy = jnp.zeros((_ROWS, Lc), jnp.float32)
-        for (bx3, by3, is_own) in bases:
-            for s in range(-(2 * K - 1), 2 * K):
-                if is_own and s == 0:
-                    continue
-                dx = wrap(xoc - shifted(*bx3, s))
-                dy = wrap(yoc - shifted(*by3, s))
-                dist = jnp.sqrt(dx * dx + dy * dy)
-                dist_c = jnp.maximum(dist, eps)
-                near = dist < personal_space
-                scale = k_sep / (dist_c * dist_c * dist_c)
-                fx = fx + jnp.where(near, scale * dx, 0.0)
-                fy = fy + jnp.where(near, scale * dy, 0.0)
-        fx_ref[:] = fx
-        fy_ref[:] = fy
+        def pair(xc, yc, bx3, by3, s):
+            dxv = wrap(xc - shifted(*bx3, s))
+            dyv = wrap(yc - shifted(*by3, s))
+            d2 = dxv * dxv + dyv * dyv
+            near = d2 < ps2
+            inv = jax.lax.rsqrt(jnp.maximum(d2, eps2))
+            scale = k_sep * inv * inv * inv
+            return (
+                jnp.where(near, scale * dxv, 0.0),
+                jnp.where(near, scale * dyv, 0.0),
+            )
+
+        def react_split(c, s):
+            """(in_chunk, left, right) parts of roll(c, -s): wrapped
+            lanes belong to the neighboring chunk at the same index."""
+            rolled = pltpu.roll(c, (Lc - s) % Lc, 1)
+            if s > 0:
+                spill = lane >= Lc - s
+                return (
+                    jnp.where(spill, 0.0, rolled),
+                    jnp.where(spill, rolled, 0.0),
+                    None,
+                )
+            spill = lane < -s
+            return (
+                jnp.where(spill, 0.0, rolled),
+                None,
+                jnp.where(spill, rolled, 0.0),
+            )
+
+        xoc, yoc = xoc_ref[:], yoc_ref[:]
+        xo3 = (xol_ref[:], xoc, xor_ref[:])
+        yo3 = (yol_ref[:], yoc, yor_ref[:])
+
+        # Accumulate INTO the output refs (memory-sequenced) — see
+        # _make_kernel for the scoped-VMEM blowup SSA accumulation
+        # causes.
+        zero = jnp.zeros((_ROWS, Lc), jnp.float32)
+        for ref in outs:
+            ref[:] = zero
+        fx_ref, fy_ref, l0x_ref, l0y_ref = outs[:4]
+
+        # Own row: positive shifts; in-chunk reaction subtracts
+        # directly, left-spilled lanes accumulate for the host.
+        for s in range(1, reach):
+            cx_, cy_ = pair(xoc, yoc, xo3, yo3, s)
+            inx, lx, _ = react_split(cx_, s)
+            iny, ly, _ = react_split(cy_, s)
+            fx_ref[:] += cx_ - inx
+            fy_ref[:] += cy_ - iny
+            l0x_ref[:] += lx
+            l0y_ref[:] += ly
+
+        # Down rows r = 1..R.
+        xn3 = (xnl_ref[:], xnc_ref[:], xnr_ref[:])
+        yn3 = (ynl_ref[:], ync_ref[:], ynr_ref[:])
+        o = 4
+        for r in range(1, R + 1):
+            bx3 = tuple(downr(a, b, r) for a, b in zip(xo3, xn3))
+            by3 = tuple(downr(a, b, r) for a, b in zip(yo3, yn3))
+            (rinx_ref, riny_ref, rlx_ref, rly_ref, rrx_ref,
+             rry_ref) = outs[o:o + 6]
+            for s in range(-reach + 1, reach):
+                cx_, cy_ = pair(xoc, yoc, bx3, by3, s)
+                fx_ref[:] += cx_
+                fy_ref[:] += cy_
+                ix, lx, rx_ = react_split(cx_, s)
+                iy, ly, ry_ = react_split(cy_, s)
+                rinx_ref[:] += ix
+                riny_ref[:] += iy
+                if s > 0:
+                    rlx_ref[:] += lx
+                    rly_ref[:] += ly
+                elif s < 0:
+                    rrx_ref[:] += rx_
+                    rry_ref[:] += ry_
+            o += 6
 
     return kernel
 
@@ -314,53 +439,57 @@ def _lane_chunk(L: int, target: int = 4096) -> int:
     return 128 * best
 
 
-def _cell_tables(pos, torus_hw, g):
-    """(key, order, starts, counts): per-agent cell key, the stable
-    cell-sort order, and the CSR start/count tables — the cell
-    assignment itself comes from the SHARED
-    ops/neighbors.py:torus_cell_tables (the parity contract with
-    separation_grid depends on both backends binning identically)."""
+def _slots_sorted(pos, alive, torus_hw, g, K):
+    """(order, skey, rank, ok, sx, sy): the cell-sorted view of the
+    swarm — one variadic sort (iota tie-break = stable), run-position
+    ranks via cummax, no CSR tables (r5; see module doc).  Cell
+    assignment comes from the shared torus_cell_tables (binning
+    parity contract with separation_grid); dead agents are keyed past
+    the grid so they claim no slots (advisor r4)."""
     from ..neighbors import torus_cell_tables
 
-    _, _, key, counts, starts = torus_cell_tables(pos, torus_hw, g)
-    order = jnp.argsort(key)          # stable: rank = arrival order
-    return key, order, starts, counts
+    n = pos.shape[0]
+    _, _, key, _, _ = torus_cell_tables(pos, torus_hw, g)
+    key = jnp.where(alive, key, g * g)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    skey, order, sx, sy = jax.lax.sort(
+        (key, iota, pos[:, 0], pos[:, 1]), num_keys=2
+    )
+    run_start = jnp.where(
+        skey != jnp.concatenate([skey[:1] - 1, skey[:-1]]), iota, 0
+    )
+    rank = iota - jax.lax.cummax(run_start)
+    ok = (rank < K) & (skey < g * g)
+    return order, skey, rank, ok, sx, sy
 
 
-def _agent_slots(key, order, starts, K):
-    """(slot, ok) per SORTED agent: flat slot ``key*K + rank`` and the
-    under-cap mask."""
-    n = key.shape[0]
-    skey = key[order]
-    rank = jnp.arange(n, dtype=jnp.int32) - starts[skey]
-    return skey * K + rank, rank < K
-
-
-def _overflow_rescue(
-    pos, alive, order, ok, k_sep, personal_space, eps, hw, budget
+def _overflow_rescue_local(
+    pos, alive, order, ok, skey, xr, yr, slot_s,
+    k_sep, personal_space, eps, hw, budget, g, K, R,
 ):
-    """[N, 2] force correction for up to ``budget`` capped-out agents:
-    an exact masked dense pass (difference form — XLA fuses the
-    [V, N, 2] broadcast into the reductions, nothing is materialized).
+    """[N, 2] force correction for up to ``budget`` capped-out LIVE
+    agents — the r5 LOCAL formulation (module doc): each rescued
+    agent v gathers its (2R+1)^2 * K cell-neighborhood plane slots
+    (every in-range in-grid partner is in there by construction) and
+    pairs with the other rescued agents; reactions scatter back to
+    the in-grid partners' original indices.
 
     SYMMETRIC (r4 fix, the load-bearing part): each rescued pair
-    (v, j) contributes both the force ON v and the reaction ON j.
-    Receive-only rescue measured catastrophic at 4096 boids: each
-    capped-out agent is INVISIBLE to its ~14 in-grid neighbors, so 18
-    overflow agents poisoned 248 agents' forces (rel err 1-8,
-    flickering as cells crossed the cap) — exactly the detection-
-    flicker heading noise of docs/PERFORMANCE.md r3b — and the flock
-    decayed to pol ~0.03 where the exact-separation control reaches
-    0.993.  The reaction term excludes j's that are themselves
-    capped-out (their own rescue row already counts the pair)."""
+    (v, j) contributes both the force ON v and the reaction ON j —
+    receive-only rescue measured catastrophic (see module doc)."""
     n = pos.shape[0]
+    L = g * K
     two_hw = 2.0 * hw
-    # First `budget` LIVE overflow agents by sorted order -> their
-    # ORIGINAL indices, padded with n (invalid).  Dead capped-out
-    # agents are skipped so they cannot burn budget slots on rows
-    # that would contribute zero force anyway.
-    sorted_alive = alive[order]
-    live_ovf = ~ok & sorted_alive
+
+    def wrap(v):
+        return jnp.where(
+            v >= hw, v - two_hw, jnp.where(v < -hw, v + two_hw, v)
+        )
+
+    # First `budget` live overflow agents by sorted order -> original
+    # indices, padded with n (invalid).  (Dead agents have ok False
+    # but sort past the grid, so ~ok & alive[order] is live overflow.)
+    live_ovf = ~ok & alive[order]
     ovf_rank = jnp.cumsum(live_ovf) - 1
     v_slot = jnp.where(live_ovf & (ovf_rank < budget), ovf_rank, budget)
     vidx = (
@@ -369,36 +498,79 @@ def _overflow_rescue(
     )
     vvalid = vidx < n
     vi = jnp.minimum(vidx, n - 1)
-    in_grid = jnp.zeros((n,), bool).at[order].set(ok)      # [N]
     vpos = pos[vi]                                         # [V, 2]
-    diff = vpos[:, None, :] - pos[None, :, :]              # fused away
-    diff = jnp.where(
-        diff >= hw, diff - two_hw,
-        jnp.where(diff < -hw, diff + two_hw, diff),
-    )                                                      # min image
-    dist = jnp.sqrt(jnp.sum(diff * diff, axis=-1))         # [V, N]
-    dist_c = jnp.maximum(dist, eps)
-    near = (
+    # Rescued agents' cells — from the SHARED binning (r5 review:
+    # a private floor/clip copy here could drift from the table the
+    # planes were built with; unused CSR outputs are DCE'd).
+    from ..neighbors import torus_cell_tables
+
+    vcx, vcy, _, _, _ = torus_cell_tables(vpos, hw, g)
+
+    # Original-index plane (built only inside the rescue cond).
+    iplane = (
+        jnp.full((g * g * K + 1,), n, jnp.int32)
+        .at[slot_s].set(order.astype(jnp.int32))[:g * g * K]
+    )
+
+    # [V, (2R+1)^2 K] neighborhood slot indices.
+    w = 2 * R + 1
+    dr = jnp.arange(-R, R + 1)
+    kk = jnp.arange(K)
+    rows = jnp.mod(vcx[:, None] + dr[None, :], g)          # [V, w]
+    cols = jnp.mod(vcy[:, None] + dr[None, :], g)          # [V, w]
+    flat = (
+        rows[:, :, None, None] * L
+        + cols[:, None, :, None] * K
+        + kk[None, None, None, :]
+    ).reshape(budget, w * w * K)                           # [V, S]
+    xg = xr.reshape(-1)[flat]
+    yg = yr.reshape(-1)[flat]
+    ig = iplane[flat]
+    dx = wrap(vpos[:, 0:1] - xg)
+    dy = wrap(vpos[:, 1:2] - yg)
+    d2 = dx * dx + dy * dy
+    near = vvalid[:, None] & (d2 < personal_space * personal_space)
+    inv = jax.lax.rsqrt(jnp.maximum(d2, eps * eps))
+    scale = k_sep * inv * inv * inv
+    cx_ = jnp.where(near, scale * dx, 0.0)                 # [V, S]
+    cy_ = jnp.where(near, scale * dy, 0.0)
+    f_v = jnp.stack([jnp.sum(cx_, axis=1), jnp.sum(cy_, axis=1)], 1)
+
+    # Reaction on the in-grid partners.  Sentinel slots carry
+    # ig == n: clamping them onto agent n-1 is safe because their
+    # contrib is exactly zero (sentinel pairs fail `near`).
+    ig_c = jnp.minimum(ig, n - 1)
+    react = (
+        jnp.zeros((n, 2), pos.dtype)
+        .at[ig_c.reshape(-1), 0].add(-cx_.reshape(-1))
+        .at[ig_c.reshape(-1), 1].add(-cy_.reshape(-1))
+    )
+
+    # Rescued-vs-rescued pairs ([V, V]): overflow agents are not in
+    # the planes, so they see each other only here.
+    dvx = wrap(vpos[:, 0][:, None] - vpos[:, 0][None, :])
+    dvy = wrap(vpos[:, 1][:, None] - vpos[:, 1][None, :])
+    dv2 = dvx * dvx + dvy * dvy
+    nearv = (
         vvalid[:, None]
-        & (alive[vi])[:, None]
-        & alive[None, :]
-        & (dist < personal_space)
-        & (vi[:, None] != jnp.arange(n)[None, :])          # not self
+        & vvalid[None, :]
+        & (dv2 < personal_space * personal_space)
+        & ~jnp.eye(budget, dtype=bool)
     )
-    mag = k_sep / (dist_c * dist_c)
-    contrib = jnp.where(
-        near[..., None], mag[..., None] * diff / dist_c[..., None],
-        0.0,
-    )                                                      # [V, N, 2]
-    f_v = jnp.sum(contrib, axis=1)                         # [V, 2]
-    # Reaction on in-grid partners: -force(v<-j) = force(j<-v).
-    f_react = -jnp.sum(
-        jnp.where(in_grid[None, :, None], contrib, 0.0), axis=0
-    )                                                      # [N, 2]
-    return f_react + (
-        jnp.zeros((n, 2), f_v.dtype)
-        .at[vi].add(jnp.where(vvalid[:, None], f_v, 0.0))
+    invv = jax.lax.rsqrt(jnp.maximum(dv2, eps * eps))
+    sv = k_sep * invv * invv * invv
+    f_vv = jnp.stack(
+        [
+            jnp.sum(jnp.where(nearv, sv * dvx, 0.0), axis=1),
+            jnp.sum(jnp.where(nearv, sv * dvy, 0.0), axis=1),
+        ],
+        1,
     )
+
+    out = jnp.zeros((n, 2), pos.dtype).at[vi].add(
+        jnp.where(vvalid[:, None], f_v + f_vv, 0.0)
+    )
+    return out + react
 
 
 @partial(
@@ -426,98 +598,93 @@ def separation_hashgrid_pallas(
     occupancy-cap delta above), one VMEM pass.  2-D float32 only;
     torus worlds only (the cyclic rolls ARE the seam wrap).
 
+    ``cell`` may be as small as ``personal_space / 2`` (r5): half
+    cells quarter the occupancy cap and run the cheaper 5x5 sweep —
+    see ``_stencil_radius``.
+
     ``lane_chunk``: None picks automatically — the 1-D kernel while a
     whole ``g*K`` row fits the VMEM budget, else the lane-tiled
     kernel (r4b) at an auto-sized chunk.  An explicit value forces
     the tiled kernel at that chunk width (testing hook; must divide
-    ``g*K``, be a multiple of 128, and exceed ``2*max_per_cell``)."""
+    ``g*K``, be a multiple of 128, and exceed ``(R+1)*max_per_cell``)."""
     n, d = pos.shape
     if d != 2:
         raise ValueError("hash-grid separation kernel is 2-D only")
-    if cell < personal_space:
-        # Mirrors separation_grid: the 3x3 stencil only reaches one
-        # cell out, so a smaller cell would silently drop neighbors.
-        raise ValueError(
-            f"grid cell ({cell}) must be >= personal_space "
-            f"({personal_space}) for the 3x3 stencil to cover the "
-            "separation radius"
-        )
     K = max_per_cell
     g, cell_eff = _geometry(torus_hw, cell, K)
+    R = _stencil_radius(cell_eff, personal_space)
     L = g * K
+    reach = (R + 1) * K
     if lane_chunk is None:
-        tiled = _VMEM_ROWS * L * 4 > _VMEM_BUDGET
+        tiled = _VMEM_ROWS[R] * L * 4 > _VMEM_BUDGET
         Lc = _lane_chunk(L) if tiled else L
-        if tiled and Lc <= 2 * K:
+        if tiled and Lc <= reach:
             raise ValueError(
                 f"no lane chunk of the {L}-lane row fits VMEM while "
-                f"exceeding the 2K={2 * K} shift reach; lower "
+                f"exceeding the (R+1)K={reach} shift reach; lower "
                 "max_per_cell"
             )
     else:
         tiled = True
         Lc = lane_chunk
-        if Lc % 128 != 0 or L % Lc != 0 or Lc <= 2 * K:
+        if Lc % 128 != 0 or L % Lc != 0 or Lc <= reach:
             raise ValueError(
                 f"lane_chunk ({Lc}) must be a 128-multiple divisor "
-                f"of the {L}-lane row exceeding 2*max_per_cell"
+                f"of the {L}-lane row exceeding (R+1)*max_per_cell"
             )
 
-    key, order, starts, counts = _cell_tables(pos, torus_hw, g)
-    slot, ok = _agent_slots(key, order, starts, K)
-
+    order, skey, rank, ok, sx, sy = _slots_sorted(
+        pos, alive, torus_hw, g, K
+    )
+    slot = skey * K + rank
     # Scatter-build over a sentinel fill (see module doc for the
-    # measured gather-build negative).  Dead agents write the
-    # sentinel so they exert and receive nothing.
-    slot_s = jnp.where(ok, slot, g * g * K)   # overflow -> scratch
-    sorted_alive = alive[order]
+    # measured gather-build negative).  Dead agents sort past the
+    # grid and land in the scratch slot with the overflow.
+    slot_s = jnp.where(ok, slot, g * g * K)   # overflow/dead -> scratch
 
-    def plane(v):
-        sv = jnp.where(sorted_alive, v[order], _SENTINEL)
+    def plane(sv):
         return (
             jnp.full((g * g * K + 1,), _SENTINEL, jnp.float32)
             .at[slot_s].set(sv.astype(jnp.float32))[:g * g * K]
             .reshape(g, L)
         )
 
-    xr = plane(pos[:, 0])
-    yr = plane(pos[:, 1])
+    xr = plane(sx)
+    yr = plane(sy)
 
     n_tiles = g // _ROWS
-    out_shape = [
-        jax.ShapeDtypeStruct((g, L), jnp.float32),
-        jax.ShapeDtypeStruct((g, L), jnp.float32),
-    ]
+    gl_shape = jax.ShapeDtypeStruct((g, L), jnp.float32)
     if not tiled:
         kernel = _make_kernel(
             float(k_sep), float(personal_space), float(eps),
-            float(torus_hw), K, L,
+            float(torus_hw), K, L, R,
         )
         col = lambda i: (i, 0)                               # noqa: E731
-        prev_map = lambda i: (jax.lax.rem(i + n_tiles - 1, n_tiles), 0)  # noqa: E731
         next_map = lambda i: (jax.lax.rem(i + 1, n_tiles), 0)  # noqa: E731
         blk = lambda m: pl.BlockSpec(                        # noqa: E731
             (_ROWS, L), m, memory_space=pltpu.VMEM
         )
-        fx, fy = pl.pallas_call(
+        outs = pl.pallas_call(
             kernel,
             grid=(n_tiles,),
-            in_specs=[
-                blk(prev_map), blk(col), blk(next_map),
-                blk(prev_map), blk(col), blk(next_map),
-            ],
-            out_specs=[blk(col), blk(col)],
-            out_shape=out_shape,
+            in_specs=[blk(col), blk(next_map), blk(col), blk(next_map)],
+            out_specs=[blk(col)] * (2 + 2 * R),
+            out_shape=[gl_shape] * (2 + 2 * R),
             interpret=interpret,
-        )(xr, xr, xr, yr, yr, yr)
+        )(xr, xr, yr, yr)
+        fx, fy = outs[0], outs[1]
+        # Down-r reactions: -contrib row-rolled by +r (cyclic over
+        # all g rows = tile boundaries + cx torus seam in one roll).
+        for r in range(1, R + 1):
+            fx = fx - jnp.roll(outs[2 * r], r, axis=0)
+            fy = fy - jnp.roll(outs[2 * r + 1], r, axis=0)
     else:
         kernel = _make_tiled_kernel(
             float(k_sep), float(personal_space), float(eps),
-            float(torus_hw), K, Lc,
+            float(torus_hw), K, Lc, R,
         )
         nL = L // Lc
         rm = {
-            "p": lambda i: jax.lax.rem(i + n_tiles - 1, n_tiles),
             "o": lambda i: i,
             "n": lambda i: jax.lax.rem(i + 1, n_tiles),
         }
@@ -536,38 +703,54 @@ def separation_hashgrid_pallas(
 
         maps = [
             blk2(r, c)
-            for r in ("p", "o", "n")
+            for r in ("o", "n")
             for c in ("l", "c", "r")
         ]
         out_blk = pl.BlockSpec(
             (_ROWS, Lc), lambda i, j: (i, j), memory_space=pltpu.VMEM
         )
-        fx, fy = pl.pallas_call(
+        n_out = 4 + 6 * R
+        outs = pl.pallas_call(
             kernel,
             grid=(n_tiles, nL),
-            in_specs=maps + maps,     # x then y, same 9 maps each
-            out_specs=[out_blk, out_blk],
-            out_shape=out_shape,
+            in_specs=maps + maps,     # x then y, same 6 maps each
+            out_specs=[out_blk] * n_out,
+            out_shape=[gl_shape] * n_out,
             interpret=interpret,
-        )(*([xr] * 9 + [yr] * 9))
+        )(*([xr] * 6 + [yr] * 6))
+        fx, fy = outs[0], outs[1]
+        # Own-row left spill: reaction lanes that crossed the chunk
+        # edge — one global cyclic lane roll by -Lc.
+        fx = fx - jnp.roll(outs[2], -Lc, axis=1)
+        fy = fy - jnp.roll(outs[3], -Lc, axis=1)
+        o = 4
+        for r in range(1, R + 1):
+            fx = fx - jnp.roll(outs[o], r, axis=0)
+            fy = fy - jnp.roll(outs[o + 1], r, axis=0)
+            fx = fx - jnp.roll(outs[o + 2], (r, -Lc), axis=(0, 1))
+            fy = fy - jnp.roll(outs[o + 3], (r, -Lc), axis=(0, 1))
+            fx = fx - jnp.roll(outs[o + 4], (r, Lc), axis=(0, 1))
+            fy = fy - jnp.roll(outs[o + 5], (r, Lc), axis=(0, 1))
+            o += 6
 
-    # Dead agents' slots hold the sentinel, so their computed force
-    # is exactly zero — no receive-side masking needed.
+    # Dead agents never enter the planes (keyed past the grid), and
+    # their `ok` is False — the where below zeroes their force.
     slot_c = jnp.minimum(slot, g * g * K - 1)
     fsx = jnp.where(ok, fx.reshape(-1)[slot_c], 0.0)
     fsy = jnp.where(ok, fy.reshape(-1)[slot_c], 0.0)
     force_s = jnp.stack([fsx, fsy], axis=1).astype(pos.dtype)
     force = jnp.zeros_like(pos).at[order].set(force_s)
     if overflow_budget > 0:
-        # lax.cond so the O(budget * N) pass costs ~nothing in the
-        # common no-overflow case (uniform swarms, equilibrium
-        # flocks) and only runs during crowding transients.
+        # lax.cond so the local pass (and its index-plane build)
+        # costs ~nothing in the common no-overflow case (uniform
+        # swarms, equilibrium flocks) and only runs during crowding
+        # transients.
         force = force + jax.lax.cond(
-            jnp.any(~ok),
-            lambda: _overflow_rescue(
-                pos, alive, order, ok, float(k_sep),
-                float(personal_space), float(eps), float(torus_hw),
-                int(overflow_budget),
+            jnp.any(~ok & alive[order]),
+            lambda: _overflow_rescue_local(
+                pos, alive, order, ok, skey, xr, yr, slot_s,
+                float(k_sep), float(personal_space), float(eps),
+                float(torus_hw), int(overflow_budget), g, K, R,
             ).astype(pos.dtype),
             lambda: jnp.zeros_like(pos),
         )
@@ -575,14 +758,18 @@ def separation_hashgrid_pallas(
 
 
 def hashgrid_supported(
-    dim: int, dtype, torus_hw: float, cell: float, max_per_cell: int
+    dim: int,
+    dtype,
+    torus_hw: float,
+    cell: float,
+    max_per_cell: int,
+    personal_space: float | None = None,
 ) -> bool:
     """True when this configuration is inside the kernel's
     geometry/dtype/VMEM envelope (the auto-dispatch gate in
-    ops/boids.py).  The caller still owes the kernel's semantic
-    precondition ``cell >= personal_space`` — not checked here
-    because this gate does not see the force parameters (boids
-    always passes ``cell == r_sep == personal_space``)."""
+    ops/boids.py and ops/physics.py).  ``personal_space`` defaults to
+    ``cell`` (the classic 3x3 regime); pass it explicitly to validate
+    a half-cell (5x5) configuration."""
     if dim != 2 or dtype != jnp.float32:
         return False
     if max_per_cell % 8 != 0 or not 8 <= max_per_cell <= 64:
@@ -590,22 +777,80 @@ def hashgrid_supported(
     g = (int(2.0 * torus_hw / cell) // 16) * 16
     if g < 16:
         return False
+    cell_eff = 2.0 * torus_hw / g
+    ps = cell if personal_space is None else personal_space
+    if 2.0 * cell_eff < ps:
+        return False
+    R = 1 if cell_eff >= ps else 2
     L = g * max_per_cell
-    if _VMEM_ROWS * L * 4 <= _VMEM_BUDGET:
+    if _VMEM_ROWS[R] * L * 4 <= _VMEM_BUDGET:
         return True                      # 1-D kernel fits
-    # Lane-tiled kernel (r4b): needs a chunk wider than the 2K shift
+    # Lane-tiled kernel (r4b): needs a chunk wider than the shift
     # reach and sane HBM planes.
-    return _lane_chunk(L) > 2 * max_per_cell and g * L * 4 <= 1 << 30
+    return (
+        _lane_chunk(L) > (R + 1) * max_per_cell
+        and g * L * 4 <= 1 << 30
+    )
+
+
+def hashgrid_backend_choice(
+    backend: str,
+    dim: int,
+    dtype,
+    torus_hw: float,
+    cell: float,
+    max_per_cell: int,
+    personal_space: float,
+    knob: str,
+) -> bool:
+    """THE dispatch predicate shared by both hashgrid consumers —
+    ops/boids.py:gridmean_uses_hashgrid and
+    ops/physics.py:tick_uses_hashgrid_kernel delegate here (r5 review:
+    two independent copies had already drifted), so the
+    backend-string validation, envelope check, forced-'pallas' error,
+    and on-TPU gate cannot diverge.  ``knob`` names the config field
+    in error messages."""
+    if backend not in ("auto", "pallas", "portable"):
+        raise ValueError(
+            f"unknown {knob} {backend!r}; "
+            "expected 'auto', 'pallas', or 'portable'"
+        )
+    if backend == "portable":
+        return False
+    supported = hashgrid_supported(
+        dim, dtype, torus_hw, cell, max_per_cell,
+        personal_space=personal_space,
+    )
+    if backend == "pallas" and not supported:
+        raise ValueError(
+            f"{knob}='pallas' but this configuration is outside the "
+            "kernel's envelope (needs 2-D f32, >= 16 aligned grid "
+            "cells across the world after rounding down to a "
+            "multiple of 16, cell >= personal_space/2, max_per_cell "
+            "a multiple of 8 in [8, 64], and the grid row within "
+            "the VMEM budget)"
+        )
+    from ...utils.platform import on_tpu
+
+    return supported and (backend == "pallas" or on_tpu())
 
 
 def hashgrid_overflow(
-    pos: jax.Array, cell: float, max_per_cell: int, torus_hw: float
+    pos: jax.Array,
+    cell: float,
+    max_per_cell: int,
+    torus_hw: float,
+    alive: jax.Array | None = None,
 ) -> jax.Array:
-    """Number of agents past the per-cell slot cap — the agents the
-    kernel drops from the grid (they receive force only via the
+    """Number of LIVE agents past the per-cell slot cap — the agents
+    the kernel drops from the grid (they receive force only via the
     rescue pass, and exert none until they re-enter).  Diagnostic for
-    sizing ``max_per_cell``; 0 means the kernel is exact."""
+    sizing ``max_per_cell``; 0 means the kernel is exact.  Dead agents
+    claim no slots (and are not counted)."""
+    if alive is None:
+        alive = jnp.ones((pos.shape[0],), bool)
     g, cell_eff = _geometry(torus_hw, cell, max_per_cell)
-    key, order, starts, _ = _cell_tables(pos, torus_hw, g)
-    _, ok = _agent_slots(key, order, starts, max_per_cell)
-    return jnp.sum(~ok)
+    order, _, _, ok, _, _ = _slots_sorted(
+        pos, alive, torus_hw, g, max_per_cell
+    )
+    return jnp.sum(~ok & alive[order])

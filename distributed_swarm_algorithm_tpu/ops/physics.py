@@ -48,8 +48,13 @@ def formation_targets(state: SwarmState, cfg: SwarmConfig) -> SwarmState:
 
     V-shape (agent.py:105-111): x_off = -spacing*rank; y_off = ±spacing*rank
     with even ranks going one side, odd the other.  "line" keeps y_off = 0
-    (the commented-out variant at agent.py:101-103).
+    (the commented-out variant at agent.py:101-103).  "none" disables the
+    retarget entirely — followers keep their user-set nav targets (the
+    reference hardcodes the V; at 10^4+ agents a rank-indexed V spans
+    kilometres, so bounded-arena deployments need the opt-out).
     """
+    if cfg.formation_shape == "none":
+        return state
     if cfg.formation_rank_mode == "id":
         rank = state.agent_id.astype(jnp.float32)
     else:
@@ -90,6 +95,24 @@ def formation_targets(state: SwarmState, cfg: SwarmConfig) -> SwarmState:
     target = jnp.where(is_follower[:, None], new_target, state.target)
     has_target = state.has_target | is_follower
     return state.replace(target=target, has_target=has_target)
+
+
+def tick_uses_hashgrid_kernel(cfg: SwarmConfig, dim: int, dtype) -> bool:
+    """THE separation backend predicate for ``separation_mode=
+    'hashgrid'`` (single source of truth for which path
+    ``apf_forces`` executes; tests and benches consult it rather than
+    re-deriving the envelope).  Raises on an unknown backend string
+    and on ``"pallas"`` outside the kernel envelope — the shared
+    rules live in ops/pallas/grid_separation.py:
+    hashgrid_backend_choice (one predicate for this and the boids
+    gridmean twin)."""
+    from .pallas.grid_separation import hashgrid_backend_choice
+
+    return hashgrid_backend_choice(
+        cfg.hashgrid_backend, dim, dtype, cfg.world_hw,
+        cfg.grid_cell, cfg.grid_max_per_cell, cfg.personal_space,
+        knob="hashgrid_backend",
+    )
 
 
 def apf_forces(
@@ -181,12 +204,59 @@ def apf_forces(
                 cell=cfg.grid_cell, window=cfg.window_size,
                 presorted=cfg.sort_every > 1,
             )
+    elif cfg.separation_mode == "hashgrid":
+        # Torus-world spatial hash (r5, VERDICT r4 item 3): exact up
+        # to the per-cell cap and STABLE in detection — the mode that
+        # collapses the exact-tick-vs-window throughput gap.  Same
+        # semantics as separation_grid(torus_hw=world_hw) up to the
+        # kernel's documented occupancy-cap delta.
+        if cfg.world_hw <= 0:
+            raise ValueError(
+                "separation_mode='hashgrid' needs world_hw > 0 (the "
+                "torus half-width the grid tiles); set it in "
+                "SwarmConfig"
+            )
+        if pos.shape[1] != 2:
+            # Without this guard the portable branch would silently
+            # degrade to the NON-torus dense pass (separation_grid's
+            # d != 2 fallback ignores torus_hw) — no seam wrapping,
+            # no error (r5 review finding).
+            raise ValueError(
+                "separation_mode='hashgrid' is 2-D only (the cell "
+                f"grid tiles a 2-D torus); got dim={pos.shape[1]}"
+            )
+        if tick_uses_hashgrid_kernel(cfg, pos.shape[1], pos.dtype):
+            from ..utils.platform import on_tpu
+            from .pallas.grid_separation import (
+                separation_hashgrid_pallas,
+            )
+
+            f_sep = separation_hashgrid_pallas(
+                pos, state.alive, float(cfg.k_sep),
+                float(cfg.personal_space), float(cfg.dist_eps),
+                cell=float(cfg.grid_cell),
+                max_per_cell=cfg.grid_max_per_cell,
+                torus_hw=float(cfg.world_hw),
+                overflow_budget=cfg.hashgrid_overflow_budget,
+                interpret=not on_tpu(),
+            )
+        else:
+            # The portable 3x3 gather needs cell >= personal_space:
+            # a half-cell config (kernel-only geometry) falls back to
+            # the full-cell grid — exact up to the cap either way.
+            f_sep = _neighbors.separation_grid(
+                pos, state.alive, cfg.k_sep, cfg.personal_space, eps,
+                cell=max(cfg.grid_cell, cfg.personal_space),
+                max_per_cell=cfg.grid_max_per_cell,
+                torus_hw=cfg.world_hw,
+            )
     elif cfg.separation_mode == "off":
         f_sep = jnp.zeros_like(pos)
     else:
         raise ValueError(
             f"unknown separation_mode {cfg.separation_mode!r}; "
-            "expected 'dense', 'pallas', 'grid', 'window', or 'off'"
+            "expected 'dense', 'pallas', 'grid', 'window', "
+            "'hashgrid', or 'off'"
         )
 
     return f_att + f_rep + f_sep

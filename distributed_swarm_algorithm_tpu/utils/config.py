@@ -60,7 +60,10 @@ class SwarmConfig:
     # --- formation --------------------------------------------------------
     formation_spacing: float = 2.0      # V spacing (agent.py:106-107)
     formation_shape: str = "vee"        # "vee" (agent.py:105-107) | "line"
-    #   (line-formation variant left commented in the reference, agent.py:101-103)
+    #   (line-formation variant left commented in the reference,
+    #   agent.py:101-103) | "none" (no follower retarget — followers keep
+    #   their user nav targets; a rank-indexed V spans kilometres at
+    #   10^4+ agents, so bounded-arena swarms need the opt-out)
     formation_rank_mode: str = "ordinal"
     #   "ordinal": rank = position among alive non-leader agents (fixes the
     #     gaps-in-the-V quirk, SURVEY.md §5a bug 7).
@@ -95,9 +98,33 @@ class SwarmConfig:
     #   "grid": spatial-hash approximation (gather-heavy; CPU-oriented).
     #   "window": Morton-sorted sliding window — the TPU-native
     #     approximate mode for very large N (roll-based, no gathers).
+    #   "hashgrid": torus-world spatial hash — exact up to the per-cell
+    #     cap and STABLE in detection (no window-rank flicker), at
+    #     window-like cost: the fused Pallas cell-slot kernel
+    #     (ops/pallas/grid_separation.py) on TPU, the portable
+    #     torus-mode separation_grid elsewhere.  Requires world_hw > 0
+    #     (the world becomes the torus [-world_hw, world_hw)^2; keep
+    #     agents inside it) and dim == 2.
     #   "off": no separation force.
     grid_cell: float = 2.0              # cell for "grid"/"window" modes
     grid_max_per_cell: int = 8          # bucket capacity for "grid" mode
+    world_hw: float = 0.0               # torus half-width for "hashgrid"
+    #   (0 = unset).  Binning clips to the box; displacements use
+    #   minimum-image wrapping, so agents far outside [-hw, hw) would
+    #   see wrong neighbors — same caller contract as the torus-mode
+    #   separation_grid.
+    hashgrid_backend: str = "auto"
+    #   "auto": fused Pallas kernel on TPU when the geometry qualifies
+    #     (2-D f32, >= 16 aligned grid rows, cap a multiple of 8 in
+    #     [8, 64]), else portable torus-grid.  "pallas" forces the
+    #     kernel (interpret off-TPU — test hook); "portable" forces
+    #     separation_grid — also the documented choice for GSPMD
+    #     multi-device meshes (the kernel is a single-device program;
+    #     a shard_map tick driver is future work).
+    hashgrid_overflow_budget: int = 256
+    #   Max capped-out agents per tick that still receive exact
+    #   (symmetric) separation via the kernel's rescue pass; see
+    #   ops/pallas/grid_separation.py.
     window_size: int = 16               # ± sorted-order span for "window"
     sort_every: int = 1                 # "window" re-sort cadence in ticks.
     #   1 (default): sort+gather+scatter inside the separation pass every

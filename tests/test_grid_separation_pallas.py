@@ -258,8 +258,10 @@ def test_validation_and_support_gate():
             cell=2.0, max_per_cell=16, torus_hw=HW, interpret=True,
         )
     with pytest.raises(ValueError, match="personal_space"):
+        # Below HALF the separation radius even the 5x5 misses pairs
+        # (r5: cell in [ps/2, ps) is now legal and runs R=2).
         separation_hashgrid_pallas(
-            pos, alive, 1.0, 4.0, 1e-3, cell=2.0, max_per_cell=16,
+            pos, alive, 1.0, 4.2, 1e-3, cell=2.0, max_per_cell=16,
             torus_hw=HW, interpret=True,
         )
     with pytest.raises(ValueError, match="max_per_cell"):
@@ -292,3 +294,117 @@ def test_support_gate_admits_1m_flagship_k32():
     g, _ = _geometry(905.0, 2.0, 32)
     lc = _lane_chunk(g * 32)
     assert lc % 128 == 0 and (g * 32) % lc == 0 and lc > 64
+
+
+def test_dead_agents_claim_no_slots():
+    """r5 (advisor finding): a cell crowded with DEAD agents must not
+    burn cap slots — the live agents in it stay in-grid and their
+    force matches the dense oracle restricted to live pairs."""
+    # 12 co-located agents in one cell: first 8 dead, last 4 live.
+    crowd = jnp.tile(jnp.asarray([[1.05, 1.05]], jnp.float32), (12, 1))
+    crowd = crowd + 0.01 * jnp.arange(12, dtype=jnp.float32)[:, None]
+    pos = jnp.concatenate([crowd, _swarm(500, seed=3)[0]])
+    alive = jnp.ones((512,), bool).at[jnp.arange(8)].set(False)
+    # cap 8: with dead agents claiming slots the 4 live crowd members
+    # would overflow; keyed-past-grid they must not.
+    assert int(hashgrid_overflow(pos, CELL, 8, HW, alive=alive)) == 0
+    f = separation_hashgrid_pallas(
+        pos, alive, 20.0, PS, 1e-3, cell=CELL, max_per_cell=8,
+        torus_hw=HW, overflow_budget=0, interpret=True,
+    )
+    f_dense = separation_dense(pos, alive, 20.0, PS, 1e-3)
+    _assert_match(f[8:12], f_dense[8:12])
+    # dead agents feel nothing
+    assert float(jnp.abs(f[:8]).max()) == 0.0
+
+
+# --- r5: half-cell (R=2, 5x5-stencil) geometry --------------------------
+
+
+def test_half_cell_matches_portable_grid():
+    """cell = personal_space/2 engages the 5x5 sweep (R=2); with zero
+    overflow on the half-cell grid it must equal the portable 3x3
+    oracle on the FULL-cell grid — parity through exactness (the two
+    paths share no grid geometry)."""
+    pos, alive = _swarm(2048, seed=31)
+    assert int(hashgrid_overflow(pos, 1.0, 8, HW, alive=alive)) == 0
+    f_grid = separation_grid(
+        pos, alive, 20.0, PS, 1e-3, cell=CELL, max_per_cell=16,
+        torus_hw=HW,
+    )
+    f_half = separation_hashgrid_pallas(
+        pos, alive, 20.0, PS, 1e-3, cell=1.0, max_per_cell=8,
+        torus_hw=HW, interpret=True,
+    )
+    _assert_match(f_grid, f_half)
+
+
+def test_half_cell_seam_pairs():
+    pos = jnp.concatenate([
+        jnp.asarray(
+            [[-HW + 0.3, 0.0], [HW - 0.3, 0.0], [0.0, -HW + 0.3],
+             [0.0, HW - 0.3]], jnp.float32,
+        ),
+        _swarm(1020, seed=9)[0],
+    ])
+    alive = jnp.ones((1024,), bool)
+    f_grid = separation_grid(
+        pos, alive, 20.0, PS, 1e-3, cell=CELL, max_per_cell=16,
+        torus_hw=HW,
+    )
+    f_half = separation_hashgrid_pallas(
+        pos, alive, 20.0, PS, 1e-3, cell=1.0, max_per_cell=8,
+        torus_hw=HW, interpret=True,
+    )
+    _assert_match(f_grid, f_half)
+    assert float(jnp.abs(f_half[0]).max()) > 1.0
+
+
+def test_half_cell_tiled_matches_1d():
+    """Lane-tiled blocking under R=2 (reaction chunk spills in play:
+    g=64, K=8 -> L=512 = 4 chunks of 128, reach 3K=24 < 128)."""
+    pos, alive = _swarm(2048, seed=33)
+    base = separation_hashgrid_pallas(
+        pos, alive, 20.0, PS, 1e-3, cell=1.0, max_per_cell=8,
+        torus_hw=HW, interpret=True,
+    )
+    tiled = separation_hashgrid_pallas(
+        pos, alive, 20.0, PS, 1e-3, cell=1.0, max_per_cell=8,
+        torus_hw=HW, lane_chunk=128, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(base), np.asarray(tiled), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_half_cell_overflow_rescue_matches_dense():
+    """R=2 + crowding past the half-cell cap: rescued agents' force
+    must still match the dense oracle (the LOCAL rescue gathers the
+    5x5 neighborhood and the other rescued agents)."""
+    crowd = jnp.tile(jnp.asarray([[5.0, 5.0]], jnp.float32), (20, 1))
+    crowd = crowd + 0.02 * jnp.arange(20, dtype=jnp.float32)[:, None]
+    pos = jnp.concatenate([crowd, _swarm(236, seed=13)[0]])
+    alive = jnp.ones((256,), bool)
+    f_dense = separation_dense(pos, alive, 20.0, PS, 1e-3)
+    f = separation_hashgrid_pallas(
+        pos, alive, 20.0, PS, 1e-3, cell=1.0, max_per_cell=8,
+        torus_hw=HW, interpret=True,
+    )
+    atol = 1e-5 * float(jnp.abs(f_dense).max())
+    np.testing.assert_allclose(
+        np.asarray(f[8:20]), np.asarray(f_dense[8:20]),
+        rtol=2e-3, atol=atol,
+    )
+
+
+def test_cell_below_half_personal_space_rejected():
+    pos, alive = _swarm(256)
+    with pytest.raises(ValueError, match="personal_space"):
+        separation_hashgrid_pallas(
+            pos, alive, 1.0, 4.2, 1e-3, cell=2.0, max_per_cell=16,
+            torus_hw=HW, interpret=True,
+        )
+    assert hashgrid_supported(2, jnp.float32, HW, 1.0, 8,
+                              personal_space=PS)
+    assert not hashgrid_supported(2, jnp.float32, HW, 0.9, 8,
+                                  personal_space=4.0)

@@ -172,12 +172,22 @@ def test_sharded_window_rollout_matches_single_device():
 
 def test_sharded_window_rollout_collective_census():
     """The sharded window tick must actually run SPMD — this is the
-    census docs/PERFORMANCE.md's r4 multi-chip paragraph cites (same
-    config: 8 ticks, window 16, sort_every 8, 1024 agents).  The
-    roll halo exchanges must lower to collective-permutes and the
-    coordination/allocation reductions to all-reduces; a partitioning
-    regression to gather-everything-per-tick would zero the CP count
-    and explode the all-gather count."""
+    census docs/PERFORMANCE.md's multi-chip paragraph cites (same
+    config: window 16, sort_every 8, 1024 agents).
+
+    r5 (VERDICT r4 item 6): the assertions are STRUCTURAL — computed
+    from HLO collective categories across two rollout lengths — not
+    pinned counts, so an XLA upgrade that merges or splits
+    collectives differently cannot fail them spuriously.  Invariants:
+
+      1. roll halo exchanges lower to collective-permutes and
+         coordination/allocation reductions to all-reduces (SPMD at
+         all: a replicate-everything regression zeroes the CP count);
+      2. all-gather traffic scales with SORT CHUNKS, not ticks — the
+         one-full-state-gather-per-chunk contract.  Doubling the tick
+         count at fixed sort_every doubles chunks; a
+         gather-per-TICK regression would scale AGs ~8x here.
+    """
     import re
 
     cfg = dsa.SwarmConfig().replace(
@@ -185,17 +195,28 @@ def test_sharded_window_rollout_collective_census():
     )
     mesh = make_mesh()
     s = shard_swarm(dsa.make_swarm(1024, seed=0, spread=50.0), mesh)
-    hlo = jax.jit(
-        lambda st: dsa.swarm_rollout(st, None, cfg, 8)
-    ).lower(s).compile().as_text()
-    census = {
-        k: len(re.findall(k + r"\(", hlo))
-        for k in ("collective-permute", "all-gather", "all-reduce")
-    }
-    # Halo exchanges exist and reductions exist.
-    assert census["collective-permute"] >= 1, census
-    assert census["all-reduce"] >= 1, census
-    # The per-chunk variadic sort costs about one gather per state
-    # column (~20); gather-per-TICK degradation would multiply that
-    # by the chunk length.  Generous bound: < 2 columns' worth.
-    assert census["all-gather"] <= 50, census
+
+    def census(ticks):
+        hlo = jax.jit(
+            lambda st: dsa.swarm_rollout(st, None, cfg, ticks)
+        ).lower(s).compile().as_text()
+        return {
+            k: len(re.findall(k + r"\(", hlo))
+            for k in ("collective-permute", "all-gather", "all-reduce")
+        }
+
+    c8, c16 = census(8), census(16)
+    # Halo exchanges exist and reductions exist (both lengths).
+    for c in (c8, c16):
+        assert c["collective-permute"] >= 1, (c8, c16)
+        assert c["all-reduce"] >= 1, (c8, c16)
+    # Gathers scale with chunks (16 ticks = 2 chunks vs 1), NOT with
+    # ticks: allow the chunk-proportional doubling plus a fixed
+    # epilogue term, which is far below the ~8x a per-tick gather
+    # would cost.  (Under scan-based lowering the count can even stay
+    # flat — the loop body is compiled once.)
+    assert c16["all-gather"] <= 2 * c8["all-gather"] + 8, (c8, c16)
+    # CP-per-tick structure: more ticks cannot REDUCE halo exchanges.
+    assert c16["collective-permute"] >= c8["collective-permute"], (
+        c8, c16,
+    )

@@ -1,0 +1,165 @@
+"""Bisect the intermittent TPU worker crash's accumulation variable.
+
+r4 characterized the gridmean worker crash as "scan length x
+accumulated worker state, not reproducible fresh" (three observed
+hits: portable at 1M r3, portable at 4096x2000 r4, fused lane-tiled
+at 1M r4b — every one in a process that had already compiled and run
+several other large programs).  This harness (r5, VERDICT r4 item 4)
+CONSTRUCTS heavy processes deterministically and sweeps the candidate
+accumulation variables:
+
+  - P:  number of DISTINCT prior XLA programs loaded onto the worker
+        before the trigger (distinct static shapes force distinct
+        programs; each is compiled, run, and its outputs dropped);
+  - F:  prior-program flavor — "gridmean" (the observed history:
+        portable stencil-gather scans at varied n) or "alloc"
+        (large HBM live-buffer churn without gather chains);
+  - T:  trigger repeats of the observed crash config (4096 x
+        2000-step portable gridmean scan in ONE program).
+
+Each cell runs in a SUBPROCESS: a reproduced crash kills only the
+child; the parent records the exit code and moves on.  Results land
+in CRASH_BISECT.json next to this script and print as a matrix.
+
+Honest accounting: the three historical crashes were through the axon
+TPU tunnel after minutes-to-hours of mixed load; a bounded sweep that
+stays green is a DOCUMENTED NEGATIVE (the trigger needs more state
+than P<=24 programs x ~2 GB churn builds), not proof of absence — the
+500-step chunk containment in models/boids.py stays regardless.
+
+Usage: python bisect_worker_crash.py [--budget-min 25]
+"""
+
+from __future__ import annotations
+
+import os as _os
+import sys as _sys
+
+_ROOT = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+if _ROOT not in _sys.path:
+    _sys.path.insert(0, _ROOT)
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+_CHILD = "--child"
+
+
+def child_main(p_programs: int, flavor: str, trigger_reps: int) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_swarm_algorithm_tpu.ops import boids as bk
+
+    # Phase 1 — heavying: P distinct programs (distinct n => distinct
+    # XLA programs), each compiled + run + synced, outputs dropped.
+    for i in range(p_programs):
+        if flavor == "gridmean":
+            n = 4096 + 256 * i
+            params = bk.BoidsParams(
+                half_width=56.5, grid_sep_backend="portable"
+            )
+            s = bk.boids_init(n, 2, seed=i, params=params)
+            s, _ = bk.boids_run(
+                s, params, 100, neighbor_mode="gridmean"
+            )
+            jax.block_until_ready(s.pos)
+        else:  # alloc: big live-buffer churn, no gather chains
+            n = 1_048_576 + 4096 * i
+            x = jnp.arange(n, dtype=jnp.float32)
+            y = jax.jit(lambda v: jnp.sort(v * 1.0001) + v[::-1])(x)
+            jax.block_until_ready(y)
+        print(f"  heavy[{i}] {flavor} n={n} ok", flush=True)
+
+    # Phase 2 — the observed trigger: 4096 x 2000 portable gridmean
+    # in ONE scan program.
+    params = bk.BoidsParams(half_width=56.5, grid_sep_backend="portable")
+    for t in range(trigger_reps):
+        s = bk.boids_init(4096, 2, seed=100 + t, params=params)
+        s, _ = bk.boids_run(s, params, 2000, neighbor_mode="gridmean")
+        jax.block_until_ready(s.pos)
+        print(f"  trigger[{t}] 4096x2000 ok", flush=True)
+    print("CHILD_OK", flush=True)
+
+
+def main() -> None:
+    if _CHILD in sys.argv:
+        i = sys.argv.index(_CHILD)
+        child_main(
+            int(sys.argv[i + 1]), sys.argv[i + 2], int(sys.argv[i + 3])
+        )
+        return
+
+    budget_min = 25.0
+    if "--budget-min" in sys.argv:
+        budget_min = float(sys.argv[sys.argv.index("--budget-min") + 1])
+
+    # The sweep matrix: escalating prior-program counts per flavor,
+    # then a combined worst case.  (The persistent XLA disk cache
+    # makes repeat compiles cheap; programs still LOAD onto the
+    # worker, which is the accumulation under test.)
+    cells = [
+        dict(p=0, flavor="gridmean", reps=2),
+        dict(p=6, flavor="gridmean", reps=2),
+        dict(p=12, flavor="gridmean", reps=2),
+        dict(p=24, flavor="gridmean", reps=2),
+        dict(p=12, flavor="alloc", reps=2),
+        dict(p=24, flavor="alloc", reps=3),
+    ]
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dsa-bisect-cache")
+
+    results = []
+    t0 = time.time()
+    for cell in cells:
+        if (time.time() - t0) / 60.0 > budget_min:
+            results.append({**cell, "outcome": "skipped-budget"})
+            continue
+        cmd = [
+            sys.executable, os.path.abspath(__file__), _CHILD,
+            str(cell["p"]), cell["flavor"], str(cell["reps"]),
+        ]
+        start = time.time()
+        try:
+            proc = subprocess.run(
+                cmd, env=env, capture_output=True, text=True,
+                timeout=600, cwd=os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+            )
+            ok = proc.returncode == 0 and "CHILD_OK" in proc.stdout
+            outcome = "ok" if ok else f"exit={proc.returncode}"
+            tail = (proc.stdout + proc.stderr)[-400:]
+        except subprocess.TimeoutExpired:
+            outcome, tail = "timeout", ""
+        results.append({
+            **cell, "outcome": outcome,
+            "seconds": round(time.time() - start, 1),
+            "tail": tail if outcome not in ("ok",) else "",
+        })
+        print(f"cell {cell}: {results[-1]['outcome']} "
+              f"({results[-1].get('seconds', '?')}s)", flush=True)
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "CRASH_BISECT.json")
+    with open(out, "w") as f:
+        json.dump({"budget_min": budget_min, "cells": results}, f,
+                  indent=1)
+    crashed = [r for r in results if r["outcome"].startswith("exit")
+               or r["outcome"] == "timeout"]
+    print(json.dumps({
+        "cells_run": len([r for r in results
+                          if r["outcome"] != "skipped-budget"]),
+        "crashes": len(crashed),
+        "verdict": (
+            "REPRODUCED — see CRASH_BISECT.json" if crashed else
+            "documented negative: trigger survives every heavy-process "
+            "recipe in the matrix"
+        ),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -738,6 +738,71 @@ def gate_hashgrid_separation_exact() -> dict:
             "ok": ovf == 0 and fc >= 0.9999 and err < 1e-2 * scale}
 
 
+def gate_hashgrid_halfcell_exact() -> dict:
+    """r5: the HALF-CELL (R=2, 5x5-stencil) geometry on-chip Mosaic
+    vs the portable FULL-cell separation_grid on CPU — the two share
+    no grid geometry, so agreement is parity through exactness (both
+    are exact at zero overflow on their own grids)."""
+    from distributed_swarm_algorithm_tpu.ops.neighbors import (
+        separation_grid,
+    )
+    from distributed_swarm_algorithm_tpu.ops.pallas.grid_separation import (
+        hashgrid_overflow,
+        separation_hashgrid_pallas,
+    )
+
+    n, hw = 50_000, 160.0
+    key = jax.random.PRNGKey(17)
+    pos = jax.random.uniform(key, (n, 2), minval=-hw, maxval=hw)
+    alive = jnp.ones((n,), bool).at[::37].set(False)
+    ovf = int(hashgrid_overflow(pos, 1.0, 8, hw, alive=alive))
+    dev = separation_hashgrid_pallas(
+        pos, alive, 20.0, 2.0, 1e-3, cell=1.0, max_per_cell=8,
+        torus_hw=hw,
+    )
+    jax.block_until_ready(dev)
+    with jax.default_device(_cpu_device()):
+        ref = separation_grid(
+            jax.device_put(pos, _cpu_device()),
+            jax.device_put(alive, _cpu_device()),
+            20.0, 2.0, 1e-3, cell=2.0, max_per_cell=16, torus_hw=hw,
+        )
+    scale = float(np.max(np.abs(np.asarray(ref))))
+    fc = _frac_close(dev, ref, atol=1e-4 * scale, rtol=1e-3)
+    err = float(np.max(np.abs(np.asarray(dev) - np.asarray(ref))))
+    return {"overflow": ovf, "frac_close": fc,
+            "max_abs_err": round(err, 6), "force_scale": round(scale, 3),
+            "ok": ovf == 0 and fc >= 0.9999 and err < 1e-2 * scale}
+
+
+def gate_hashgrid_tick() -> dict:
+    """r5 (VERDICT r4 item 3): one full protocol tick with
+    separation_mode='hashgrid' — the fused kernel path on-chip vs the
+    portable torus-grid path on CPU, same swarm, same config."""
+    import distributed_swarm_algorithm_tpu as dsa
+
+    cfg = dsa.SwarmConfig().replace(
+        separation_mode="hashgrid", world_hw=160.0,
+        grid_max_per_cell=16, formation_shape="none",
+    )
+    s = dsa.make_swarm(20_000, seed=3, spread=150.0)
+    s = s.replace(
+        target=jnp.broadcast_to(
+            jnp.asarray([5.0, 5.0]), s.pos.shape
+        ).astype(s.pos.dtype),
+        has_target=jnp.ones_like(s.has_target),
+    )
+    dev = dsa.swarm_rollout(s, None, cfg, 3)
+    jax.block_until_ready(dev.pos)
+    with jax.default_device(_cpu_device()):
+        cpu_cfg = cfg.replace(hashgrid_backend="portable")
+        ref = dsa.swarm_rollout(_to_cpu(s), None, cpu_cfg, 3)
+    fc = _frac_close(dev.pos, ref.pos, atol=1e-4, rtol=1e-3)
+    err = float(np.max(np.abs(np.asarray(dev.pos) - np.asarray(ref.pos))))
+    return {"frac_close": fc, "max_abs_err": round(err, 6),
+            "ok": fc >= 0.9999 and err < 1e-2}
+
+
 def gate_aco_host_exact() -> dict:
     """r4 (VERDICT r3 item 2): the whole-tour ACO kernel with host
     uniforms — on-chip Mosaic vs interpret on CPU, identical inputs.
@@ -929,6 +994,8 @@ ALL_GATES = {
     "separation_exact": gate_separation_exact,
     "window_separation_exact": gate_window_separation_exact,
     "hashgrid_separation_exact": gate_hashgrid_separation_exact,
+    "hashgrid_halfcell_exact": gate_hashgrid_halfcell_exact,
+    "hashgrid_tick": gate_hashgrid_tick,
     "aco_host_exact": gate_aco_host_exact,
     "pso_tpu_prng": gate_pso_tpu_prng,
     "bat_tpu_prng": gate_bat_tpu_prng,

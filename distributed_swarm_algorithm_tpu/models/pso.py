@@ -101,6 +101,14 @@ class PSO(CheckpointMixin):
         return self.state
 
     def run(self, n_steps: int) -> _k.PSOState:
+        """Advance ``n_steps`` iterations and return the new state.
+
+        Dispatch contract (r4): ``run`` returns with device work
+        possibly still IN FLIGHT — it does not block.  Reading any
+        state field (``opt.best``, ``state.gbest_fit``, ...)
+        synchronizes, which is where device-side failures surface;
+        callers timing ``run()`` alone measure dispatch latency only.
+        """
         if self.use_pallas:
             on_tpu = _on_tpu()
             self.state = _pf.fused_pso_run(

@@ -40,6 +40,7 @@ is the swarm-canonical generalization (see ops/aco.py).
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Tuple
 
@@ -52,6 +53,17 @@ from ..aco import ACOState, _EPS, deposit
 from .common import ceil_to as _ceil_to
 from .cuckoo_fused import _log2_fast
 from .pso_fused import _uniform_bits, seed_base
+
+# VMEM budget for the ant-tile fit model (_fits).  14 MiB = the
+# measured-usable scoped-VMEM envelope on TPU v5e (16 MiB compiler
+# limit minus Mosaic double-buffering overheads).  Other TPU
+# generations carry different scoped-VMEM envelopes (advisor r4: the
+# hardcoded constant can OOM in Mosaic or needlessly reject C near
+# the 1024 ceiling elsewhere) — override via DSA_ACO_VMEM_BUDGET_MB
+# or by assigning this module global before the first fused call.
+VMEM_BUDGET_BYTES = int(
+    float(os.environ.get("DSA_ACO_VMEM_BUDGET_MB", "14")) * 1024 * 1024
+)
 
 _LN2 = 0.6931471805599453
 _NEG = -1e30
@@ -218,7 +230,7 @@ def fused_construct_tours(
             # program: [(C-1)*Cp, t] f32 (advisor r3 — previously an
             # opaque Mosaic OOM).
             est += grid_mult * (c - 1) * cp * t * 4
-        return est <= 14 * 1024 * 1024
+        return est <= VMEM_BUDGET_BYTES
 
     # Largest 128-multiple divisor of a_pad not exceeding the request
     # THAT FITS IN VMEM: small colonies must not be silently padded to

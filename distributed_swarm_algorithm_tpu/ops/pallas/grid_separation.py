@@ -152,8 +152,13 @@ _SENTINEL = 1.0e18      # empty/dead slot position (see module doc)
 # Peak resident VMEM for the 1-D kernel ~ (4 double-buffered input
 # blocks + (2 + 2R) double-buffered outputs + down bases + roll/diff
 # temporaries), each [8, L] f32; budgeted against the 16 MB/core
-# scoped-vmem limit with headroom.
-_VMEM_ROWS = {1: 24 * _ROWS, 2: 30 * _ROWS}
+# scoped-vmem limit with headroom.  R=2 rows: 8 in-dbuf + 12 out-dbuf
+# + 4 bases + ~4 temps = 28 blocks (ref-accumulation keeps per-shift
+# temporaries from piling up — see the kernel comment); this admits
+# the 1M half-cell row (L=14336) on the 1-D kernel, where the tiled
+# R=2 path hits a scale-dependent device fault (r5, under
+# investigation — small tiled-R=2 runs are clean on-chip).
+_VMEM_ROWS = {1: 24 * _ROWS, 2: 28 * _ROWS}
 _VMEM_BUDGET = 13 * 1024 * 1024
 
 
@@ -222,9 +227,8 @@ def _make_kernel(k_sep, personal_space, eps, hw, K, L, R):
             v >= hw, v - two_hw, jnp.where(v < -hw, v + two_hw, v)
         )
 
-    def kernel(xo_ref, xn_ref, yo_ref, yn_ref, fx_ref, fy_ref,
-               *react_refs):
-        xo, yo = xo_ref[:], yo_ref[:]
+    def kernel(occ_ref, xo_ref, xn_ref, yo_ref, yn_ref, fx_ref,
+               fy_ref, *react_refs):
         row = jax.lax.broadcasted_iota(jnp.int32, (_ROWS, L), 0)
 
         def downr(own, nxt, r):
@@ -245,35 +249,47 @@ def _make_kernel(k_sep, personal_space, eps, hw, K, L, R):
         # fits.  (optimization_barrier is not lowerable in Mosaic.)
         fx_ref[:] = jnp.zeros((_ROWS, L), jnp.float32)
         fy_ref[:] = jnp.zeros((_ROWS, L), jnp.float32)
+        for rr in react_refs:
+            rr[:] = jnp.zeros((_ROWS, L), jnp.float32)
 
-        # Own row: positive shifts only; the mirror is the in-kernel
-        # reaction (-contrib rolled by -s, cyclic = cy-seam exact).
-        for s in range(1, reach):
-            cx_, cy_ = _pair_terms(
-                k_sep, ps2, eps2, wrap, xo, yo, xo, yo, s, L
-            )
-            fx_ref[:] += cx_ - pltpu.roll(cx_, (L - s) % L, 1)
-            fy_ref[:] += cy_ - pltpu.roll(cy_, (L - s) % L, 1)
-
-        # Down rows r = 1..R: full lane sweep; reactions accumulate
-        # lane-rolled into the per-r output planes (row roll happens
-        # outside the kernel on the full [g, L] plane).
-        xn, yn = xn_ref[:], yn_ref[:]
-        for r in range(1, R + 1):
-            bx = downr(xo, xn, r)
-            by = downr(yo, yn, r)
-            rx_ref = react_refs[2 * (r - 1)]
-            ry_ref = react_refs[2 * (r - 1) + 1]
-            rx_ref[:] = jnp.zeros((_ROWS, L), jnp.float32)
-            ry_ref[:] = jnp.zeros((_ROWS, L), jnp.float32)
-            for s in range(-reach + 1, reach):
+        # Occupancy skip (r5): every pair this tile owns has its
+        # receiving agent q IN this tile's rows, so an all-empty tile
+        # contributes nothing and the whole sweep is skipped (incoming
+        # reactions ride the NEIGHBOR tiles' reaction planes, which
+        # the host-side roll delivers regardless).  At a compacted
+        # flock equilibrium most of the world is empty — the sweep
+        # cost follows the occupied fraction, not the arena.
+        @pl.when(occ_ref[pl.program_id(0)] != 0)
+        def _sweep():
+            xo, yo = xo_ref[:], yo_ref[:]
+            # Own row: positive shifts only; the mirror is the
+            # in-kernel reaction (-contrib rolled by -s, cyclic =
+            # cy-seam exact).
+            for s in range(1, reach):
                 cx_, cy_ = _pair_terms(
-                    k_sep, ps2, eps2, wrap, xo, yo, bx, by, s, L
+                    k_sep, ps2, eps2, wrap, xo, yo, xo, yo, s, L
                 )
-                fx_ref[:] += cx_
-                fy_ref[:] += cy_
-                rx_ref[:] += pltpu.roll(cx_, (L - s) % L, 1)
-                ry_ref[:] += pltpu.roll(cy_, (L - s) % L, 1)
+                fx_ref[:] += cx_ - pltpu.roll(cx_, (L - s) % L, 1)
+                fy_ref[:] += cy_ - pltpu.roll(cy_, (L - s) % L, 1)
+
+            # Down rows r = 1..R: full lane sweep; reactions
+            # accumulate lane-rolled into the per-r output planes
+            # (row roll happens outside the kernel on the full
+            # [g, L] plane).
+            xn, yn = xn_ref[:], yn_ref[:]
+            for r in range(1, R + 1):
+                bx = downr(xo, xn, r)
+                by = downr(yo, yn, r)
+                rx_ref = react_refs[2 * (r - 1)]
+                ry_ref = react_refs[2 * (r - 1) + 1]
+                for s in range(-reach + 1, reach):
+                    cx_, cy_ = _pair_terms(
+                        k_sep, ps2, eps2, wrap, xo, yo, bx, by, s, L
+                    )
+                    fx_ref[:] += cx_
+                    fy_ref[:] += cy_
+                    rx_ref[:] += pltpu.roll(cx_, (L - s) % L, 1)
+                    ry_ref[:] += pltpu.roll(cy_, (L - s) % L, 1)
 
     return kernel
 
@@ -309,7 +325,7 @@ def _make_tiled_kernel(k_sep, personal_space, eps, hw, K, Lc, R):
             v >= hw, v - two_hw, jnp.where(v < -hw, v + two_hw, v)
         )
 
-    def kernel(*refs):
+    def kernel(occ_ref, *refs):
         # inputs: x(own l,c,r  next l,c,r)  y(same 6) = 12 refs
         (xol_ref, xoc_ref, xor_ref, xnl_ref, xnc_ref, xnr_ref,
          yol_ref, yoc_ref, yor_ref, ynl_ref, ync_ref, ynr_ref) = refs[:12]
@@ -373,10 +389,6 @@ def _make_tiled_kernel(k_sep, personal_space, eps, hw, K, Lc, R):
                 jnp.where(spill, rolled, 0.0),
             )
 
-        xoc, yoc = xoc_ref[:], yoc_ref[:]
-        xo3 = (xol_ref[:], xoc, xor_ref[:])
-        yo3 = (yol_ref[:], yoc, yor_ref[:])
-
         # Accumulate INTO the output refs (memory-sequenced) — see
         # _make_kernel for the scoped-VMEM blowup SSA accumulation
         # causes.
@@ -385,41 +397,53 @@ def _make_tiled_kernel(k_sep, personal_space, eps, hw, K, Lc, R):
             ref[:] = zero
         fx_ref, fy_ref, l0x_ref, l0y_ref = outs[:4]
 
-        # Own row: positive shifts; in-chunk reaction subtracts
-        # directly, left-spilled lanes accumulate for the host.
-        for s in range(1, reach):
-            cx_, cy_ = pair(xoc, yoc, xo3, yo3, s)
-            inx, lx, _ = react_split(cx_, s)
-            iny, ly, _ = react_split(cy_, s)
-            fx_ref[:] += cx_ - inx
-            fy_ref[:] += cy_ - iny
-            l0x_ref[:] += lx
-            l0y_ref[:] += ly
+        # 2-D occupancy skip (r5): pairs owned by this [8, Lc] block
+        # have their receiving agent q INSIDE it, so an empty block
+        # sweeps nothing (incoming reactions ride the neighbor
+        # blocks' spill planes).  Chunk-granular skip is what makes a
+        # compacted 1M flock cheap: the blob occupies ~10-20% of the
+        # (row, chunk) blocks, and cost follows occupancy.
+        @pl.when(occ_ref[pl.program_id(0), pl.program_id(1)] != 0)
+        def _sweep():
+            xoc, yoc = xoc_ref[:], yoc_ref[:]
+            xo3 = (xol_ref[:], xoc, xor_ref[:])
+            yo3 = (yol_ref[:], yoc, yor_ref[:])
 
-        # Down rows r = 1..R.
-        xn3 = (xnl_ref[:], xnc_ref[:], xnr_ref[:])
-        yn3 = (ynl_ref[:], ync_ref[:], ynr_ref[:])
-        o = 4
-        for r in range(1, R + 1):
-            bx3 = tuple(downr(a, b, r) for a, b in zip(xo3, xn3))
-            by3 = tuple(downr(a, b, r) for a, b in zip(yo3, yn3))
-            (rinx_ref, riny_ref, rlx_ref, rly_ref, rrx_ref,
-             rry_ref) = outs[o:o + 6]
-            for s in range(-reach + 1, reach):
-                cx_, cy_ = pair(xoc, yoc, bx3, by3, s)
-                fx_ref[:] += cx_
-                fy_ref[:] += cy_
-                ix, lx, rx_ = react_split(cx_, s)
-                iy, ly, ry_ = react_split(cy_, s)
-                rinx_ref[:] += ix
-                riny_ref[:] += iy
-                if s > 0:
-                    rlx_ref[:] += lx
-                    rly_ref[:] += ly
-                elif s < 0:
-                    rrx_ref[:] += rx_
-                    rry_ref[:] += ry_
-            o += 6
+            # Own row: positive shifts; in-chunk reaction subtracts
+            # directly, left-spilled lanes accumulate for the host.
+            for s in range(1, reach):
+                cx_, cy_ = pair(xoc, yoc, xo3, yo3, s)
+                inx, lx, _ = react_split(cx_, s)
+                iny, ly, _ = react_split(cy_, s)
+                fx_ref[:] += cx_ - inx
+                fy_ref[:] += cy_ - iny
+                l0x_ref[:] += lx
+                l0y_ref[:] += ly
+
+            # Down rows r = 1..R.
+            xn3 = (xnl_ref[:], xnc_ref[:], xnr_ref[:])
+            yn3 = (ynl_ref[:], ync_ref[:], ynr_ref[:])
+            o = 4
+            for r in range(1, R + 1):
+                bx3 = tuple(downr(a, b, r) for a, b in zip(xo3, xn3))
+                by3 = tuple(downr(a, b, r) for a, b in zip(yo3, yn3))
+                (rinx_ref, riny_ref, rlx_ref, rly_ref, rrx_ref,
+                 rry_ref) = outs[o:o + 6]
+                for s in range(-reach + 1, reach):
+                    cx_, cy_ = pair(xoc, yoc, bx3, by3, s)
+                    fx_ref[:] += cx_
+                    fy_ref[:] += cy_
+                    ix, lx, rx_ = react_split(cx_, s)
+                    iy, ly, ry_ = react_split(cy_, s)
+                    rinx_ref[:] += ix
+                    riny_ref[:] += iy
+                    if s > 0:
+                        rlx_ref[:] += lx
+                        rly_ref[:] += ly
+                    elif s < 0:
+                        rrx_ref[:] += rx_
+                        rry_ref[:] += ry_
+                o += 6
 
     return kernel
 
@@ -464,21 +488,26 @@ def _slots_sorted(pos, alive, torus_hw, g, K):
 
 
 def _overflow_rescue_local(
-    pos, alive, order, ok, skey, xr, yr, slot_s,
+    pos, alive, order, ok, xr, yr, fx, fy,
     k_sep, personal_space, eps, hw, budget, g, K, R,
 ):
-    """[N, 2] force correction for up to ``budget`` capped-out LIVE
-    agents — the r5 LOCAL formulation (module doc): each rescued
-    agent v gathers its (2R+1)^2 * K cell-neighborhood plane slots
-    (every in-range in-grid partner is in there by construction) and
-    pairs with the other rescued agents; reactions scatter back to
-    the in-grid partners' original indices.
+    """(fx', fy', f_v) — the r5 LOCAL rescue (module doc): each of up
+    to ``budget`` capped-out LIVE agents v gathers its
+    (2R+1)^2 * K cell-neighborhood plane slots (every in-range
+    in-grid partner is in there by construction) and pairs with the
+    other rescued agents; ``f_v`` is the [N, 2] force on the rescued
+    agents themselves, and the reactions on in-grid partners are
+    accumulated into the force PLANES (fx, fy) — the caller's
+    existing slot gather then delivers them, so no index plane and no
+    per-agent reaction scatter are needed (r5b: the index-plane +
+    flat-gather form measured 2.5 ms of the 4.6 ms engaged-rescue
+    cost at 65k/V=512; this form gathers 2-D from the native-tiled
+    planes and scatters reactions at slot granularity).
 
     SYMMETRIC (r4 fix, the load-bearing part): each rescued pair
     (v, j) contributes both the force ON v and the reaction ON j —
     receive-only rescue measured catastrophic (see module doc)."""
     n = pos.shape[0]
-    L = g * K
     two_hw = 2.0 * hw
 
     def wrap(v):
@@ -506,26 +535,24 @@ def _overflow_rescue_local(
 
     vcx, vcy, _, _, _ = torus_cell_tables(vpos, hw, g)
 
-    # Original-index plane (built only inside the rescue cond).
-    iplane = (
-        jnp.full((g * g * K + 1,), n, jnp.int32)
-        .at[slot_s].set(order.astype(jnp.int32))[:g * g * K]
-    )
-
-    # [V, (2R+1)^2 K] neighborhood slot indices.
+    # [V, w, w, K] neighborhood (row, lane) indices — gathered 2-D
+    # from the planes' native tiling (a flat gather forces a
+    # relayout copy of the whole plane).
     w = 2 * R + 1
     dr = jnp.arange(-R, R + 1)
     kk = jnp.arange(K)
     rows = jnp.mod(vcx[:, None] + dr[None, :], g)          # [V, w]
     cols = jnp.mod(vcy[:, None] + dr[None, :], g)          # [V, w]
-    flat = (
-        rows[:, :, None, None] * L
-        + cols[:, None, :, None] * K
-        + kk[None, None, None, :]
-    ).reshape(budget, w * w * K)                           # [V, S]
-    xg = xr.reshape(-1)[flat]
-    yg = yr.reshape(-1)[flat]
-    ig = iplane[flat]
+    rows_b = jnp.broadcast_to(
+        rows[:, :, None, None], (budget, w, w, K)
+    ).reshape(budget, w * w * K)
+    lanes_b = jnp.broadcast_to(
+        cols[:, None, :, None] * K + kk[None, None, None, :],
+        (budget, w, w, K),
+    ).reshape(budget, w * w * K)
+    xg = xr[rows_b, lanes_b]                               # [V, S]
+    yg = yr[rows_b, lanes_b]
+
     dx = wrap(vpos[:, 0:1] - xg)
     dy = wrap(vpos[:, 1:2] - yg)
     d2 = dx * dx + dy * dy
@@ -534,17 +561,13 @@ def _overflow_rescue_local(
     scale = k_sep * inv * inv * inv
     cx_ = jnp.where(near, scale * dx, 0.0)                 # [V, S]
     cy_ = jnp.where(near, scale * dy, 0.0)
-    f_v = jnp.stack([jnp.sum(cx_, axis=1), jnp.sum(cy_, axis=1)], 1)
 
-    # Reaction on the in-grid partners.  Sentinel slots carry
-    # ig == n: clamping them onto agent n-1 is safe because their
-    # contrib is exactly zero (sentinel pairs fail `near`).
-    ig_c = jnp.minimum(ig, n - 1)
-    react = (
-        jnp.zeros((n, 2), pos.dtype)
-        .at[ig_c.reshape(-1), 0].add(-cx_.reshape(-1))
-        .at[ig_c.reshape(-1), 1].add(-cy_.reshape(-1))
-    )
+    # Reaction on in-grid partners: scatter-add into the force
+    # PLANES at the gathered slots (sentinel slots get exactly zero
+    # — their pairs fail `near` — so garbage never propagates; the
+    # caller's slot gather reads only real slots).
+    fx = fx.at[rows_b, lanes_b].add(-cx_)
+    fy = fy.at[rows_b, lanes_b].add(-cy_)
 
     # Rescued-vs-rescued pairs ([V, V]): overflow agents are not in
     # the planes, so they see each other only here.
@@ -559,18 +582,18 @@ def _overflow_rescue_local(
     )
     invv = jax.lax.rsqrt(jnp.maximum(dv2, eps * eps))
     sv = k_sep * invv * invv * invv
-    f_vv = jnp.stack(
-        [
-            jnp.sum(jnp.where(nearv, sv * dvx, 0.0), axis=1),
-            jnp.sum(jnp.where(nearv, sv * dvy, 0.0), axis=1),
-        ],
-        1,
+    f_vx = jnp.sum(cx_, axis=1) + jnp.sum(
+        jnp.where(nearv, sv * dvx, 0.0), axis=1
     )
-
-    out = jnp.zeros((n, 2), pos.dtype).at[vi].add(
-        jnp.where(vvalid[:, None], f_v + f_vv, 0.0)
+    f_vy = jnp.sum(cy_, axis=1) + jnp.sum(
+        jnp.where(nearv, sv * dvy, 0.0), axis=1
     )
-    return out + react
+    f_v = jnp.zeros((n, 2), pos.dtype).at[vi].add(
+        jnp.where(
+            vvalid[:, None], jnp.stack([f_vx, f_vy], 1), 0.0
+        )
+    )
+    return fx, fy, f_v
 
 
 @partial(
@@ -643,9 +666,12 @@ def separation_hashgrid_pallas(
     slot_s = jnp.where(ok, slot, g * g * K)   # overflow/dead -> scratch
 
     def plane(sv):
+        # mode="drop": overflow/dead agents carry slot g*g*K — out of
+        # range, dropped — so no +1 pad slot and no post-scatter
+        # slice copy (r5b: the slice materialized a full extra plane).
         return (
-            jnp.full((g * g * K + 1,), _SENTINEL, jnp.float32)
-            .at[slot_s].set(sv.astype(jnp.float32))[:g * g * K]
+            jnp.full((g * g * K,), _SENTINEL, jnp.float32)
+            .at[slot_s].set(sv.astype(jnp.float32), mode="drop")
             .reshape(g, L)
         )
 
@@ -659,19 +685,36 @@ def separation_hashgrid_pallas(
             float(k_sep), float(personal_space), float(eps),
             float(torus_hw), K, L, R,
         )
-        col = lambda i: (i, 0)                               # noqa: E731
-        next_map = lambda i: (jax.lax.rem(i + 1, n_tiles), 0)  # noqa: E731
+        # Row-tile occupancy for the skip (the keys are sorted, so a
+        # searchsorted over tile boundaries is O(tiles log N) — no
+        # scatter needed).
+        bounds = jnp.arange(n_tiles + 1, dtype=jnp.int32) * (_ROWS * g)
+        cuts = jnp.searchsorted(skey, bounds)
+        # Dead agents (keyed g*g == the last bound) fall past the
+        # final cut and never mark a tile; overflow agents carry
+        # their real key — conservative (an overflow-only tile stays
+        # "occupied").
+        occ1 = (jnp.diff(cuts) > 0).astype(jnp.int32)
+        col = lambda i, occ: (i, 0)                          # noqa: E731
+        next_map = lambda i, occ: (                          # noqa: E731
+            jax.lax.rem(i + 1, n_tiles), 0
+        )
         blk = lambda m: pl.BlockSpec(                        # noqa: E731
             (_ROWS, L), m, memory_space=pltpu.VMEM
         )
         outs = pl.pallas_call(
             kernel,
-            grid=(n_tiles,),
-            in_specs=[blk(col), blk(next_map), blk(col), blk(next_map)],
-            out_specs=[blk(col)] * (2 + 2 * R),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(n_tiles,),
+                in_specs=[
+                    blk(col), blk(next_map), blk(col), blk(next_map),
+                ],
+                out_specs=[blk(col)] * (2 + 2 * R),
+            ),
             out_shape=[gl_shape] * (2 + 2 * R),
             interpret=interpret,
-        )(xr, xr, yr, yr)
+        )(occ1, xr, xr, yr, yr)
         fx, fy = outs[0], outs[1]
         # Down-r reactions: -contrib row-rolled by +r (cyclic over
         # all g rows = tile boundaries + cx torus seam in one roll).
@@ -684,6 +727,17 @@ def separation_hashgrid_pallas(
             float(torus_hw), K, Lc, R,
         )
         nL = L // Lc
+        # (row-tile, lane-chunk) occupancy for the 2-D skip.  A cell
+        # whose K-lane run straddles a chunk edge (K ∤ Lc) marks both
+        # chunks; only in-grid agents mark blocks.
+        srow_t = jnp.where(ok, skey // g // _ROWS, 0)
+        lane0 = (jnp.where(ok, skey % g, 0)) * K
+        ok_i = ok.astype(jnp.int32)
+        occ2 = (
+            jnp.zeros((n_tiles, nL), jnp.int32)
+            .at[srow_t, lane0 // Lc].add(ok_i)
+            .at[srow_t, (lane0 + K - 1) // Lc].add(ok_i)
+        )
         rm = {
             "o": lambda i: i,
             "n": lambda i: jax.lax.rem(i + 1, n_tiles),
@@ -697,7 +751,7 @@ def separation_hashgrid_pallas(
         def blk2(r, c):
             return pl.BlockSpec(
                 (_ROWS, Lc),
-                lambda i, j, r=r, c=c: (rm[r](i), lm[c](j)),
+                lambda i, j, occ, r=r, c=c: (rm[r](i), lm[c](j)),
                 memory_space=pltpu.VMEM,
             )
 
@@ -707,17 +761,21 @@ def separation_hashgrid_pallas(
             for c in ("l", "c", "r")
         ]
         out_blk = pl.BlockSpec(
-            (_ROWS, Lc), lambda i, j: (i, j), memory_space=pltpu.VMEM
+            (_ROWS, Lc), lambda i, j, occ: (i, j),
+            memory_space=pltpu.VMEM,
         )
         n_out = 4 + 6 * R
         outs = pl.pallas_call(
             kernel,
-            grid=(n_tiles, nL),
-            in_specs=maps + maps,     # x then y, same 6 maps each
-            out_specs=[out_blk] * n_out,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(n_tiles, nL),
+                in_specs=maps + maps,     # x then y, same 6 maps each
+                out_specs=[out_blk] * n_out,
+            ),
             out_shape=[gl_shape] * n_out,
             interpret=interpret,
-        )(*([xr] * 6 + [yr] * 6))
+        )(occ2, *([xr] * 6 + [yr] * 6))
         fx, fy = outs[0], outs[1]
         # Own-row left spill: reaction lanes that crossed the chunk
         # edge — one global cyclic lane roll by -Lc.
@@ -733,28 +791,34 @@ def separation_hashgrid_pallas(
             fy = fy - jnp.roll(outs[o + 5], (r, Lc), axis=(0, 1))
             o += 6
 
-    # Dead agents never enter the planes (keyed past the grid), and
-    # their `ok` is False — the where below zeroes their force.
-    slot_c = jnp.minimum(slot, g * g * K - 1)
-    fsx = jnp.where(ok, fx.reshape(-1)[slot_c], 0.0)
-    fsy = jnp.where(ok, fy.reshape(-1)[slot_c], 0.0)
-    force_s = jnp.stack([fsx, fsy], axis=1).astype(pos.dtype)
-    force = jnp.zeros_like(pos).at[order].set(force_s)
+    f_v = jnp.zeros_like(pos)
     if overflow_budget > 0:
-        # lax.cond so the local pass (and its index-plane build)
-        # costs ~nothing in the common no-overflow case (uniform
-        # swarms, equilibrium flocks) and only runs during crowding
-        # transients.
-        force = force + jax.lax.cond(
+        # lax.cond so the local pass costs ~nothing in the common
+        # no-overflow case (uniform swarms, equilibrium flocks) and
+        # only runs during crowding transients; the false branch
+        # passes the planes through untouched.
+        fx, fy, f_v = jax.lax.cond(
             jnp.any(~ok & alive[order]),
             lambda: _overflow_rescue_local(
-                pos, alive, order, ok, skey, xr, yr, slot_s,
+                pos, alive, order, ok, xr, yr, fx, fy,
                 float(k_sep), float(personal_space), float(eps),
                 float(torus_hw), int(overflow_budget), g, K, R,
-            ).astype(pos.dtype),
-            lambda: jnp.zeros_like(pos),
+            ),
+            lambda: (fx, fy, jnp.zeros_like(pos)),
         )
-    return force
+
+    # Per-agent force: 2-D slot gather (row = skey // g, lane =
+    # (skey % g) * K + rank — flat-indexing the tiled plane would
+    # force a whole-plane relayout copy).  Dead agents never enter
+    # the planes (keyed past the grid) and their `ok` is False — the
+    # where zeroes their force.
+    skey_c = jnp.minimum(skey, g * g - 1)
+    srow = skey_c // g
+    slane = (skey_c % g) * K + jnp.minimum(rank, K - 1)
+    fsx = jnp.where(ok, fx[srow, slane], 0.0)
+    fsy = jnp.where(ok, fy[srow, slane], 0.0)
+    force_s = jnp.stack([fsx, fsy], axis=1).astype(pos.dtype)
+    return jnp.zeros_like(pos).at[order].set(force_s) + f_v
 
 
 def hashgrid_supported(

@@ -86,6 +86,15 @@ def restore(path: str, target: T, strict: bool = True) -> T:
     ``state.recount_alive_below`` (and a conservative leader check)
     after restoring, because ``alive_below``/``leader_live`` are
     event-maintained.
+
+    Growth detection is only meaningful for NAMED-field pytrees
+    (dataclasses/dicts): tuple/list nodes key their children by
+    position (``[0]``, ``[1]`` — keystr has nothing better), so an
+    element inserted mid-tuple shifts keys exactly like schema v1 and
+    the missing/extra analysis would misalign silently.
+    ``strict=False`` therefore REJECTS targets whose leaf paths
+    contain positional components (r5, advisor finding); strict
+    restores of unchanged tuple structures remain fine.
     """
     if _HAVE_ORBAX and not path.endswith(".npz"):
         ckptr = ocp.PyTreeCheckpointer()
@@ -107,6 +116,28 @@ def restore(path: str, target: T, strict: bool = True) -> T:
             missing = [
                 n for n, _ in named if f"f:{n}" not in data.files
             ]
+            if not strict and missing:
+                # Growth detection is about to fire — it is only
+                # sound for named-field paths (see docstring).  An
+                # exact-match restore (missing empty) never exercises
+                # it, so tuple-containing targets stay restorable.
+                import re
+
+                positional = sorted(
+                    {n for n, _ in named if re.search(r"\[\d+\]", n)}
+                )
+                if positional:
+                    raise ValueError(
+                        "strict=False growth-tolerant restore needs "
+                        "named-field pytree paths, but the target has "
+                        f"positionally-keyed leaves {positional[:4]}"
+                        f"{'...' if len(positional) > 4 else ''} "
+                        "(tuple/list nodes) — an element inserted "
+                        "mid-container shifts these keys like schema "
+                        "v1, so growth detection cannot be trusted; "
+                        "restore with strict=True or restructure the "
+                        "state as named fields"
+                    )
             extra = [
                 k[2:] for k in data.files
                 if k.startswith("f:")

@@ -408,3 +408,37 @@ def test_cell_below_half_personal_space_rejected():
                               personal_space=PS)
     assert not hashgrid_supported(2, jnp.float32, HW, 0.9, 8,
                                   personal_space=4.0)
+
+
+def test_occupancy_skip_sparse_boundaries():
+    """r5 occupancy skip: an almost-empty world (most row-tiles and
+    lane-chunks empty) with interacting pairs placed ACROSS tile and
+    chunk boundaries must still match the dense oracle — the skip may
+    only drop blocks with no receiving agents."""
+    pos = jnp.asarray(
+        [
+            [15.9, 0.0], [16.1, 0.0],      # row-tile boundary pair
+            [0.0, -16.1], [0.0, -15.9],    # lane/chunk boundary pair
+            [-31.9, 5.0], [31.9, 5.0],     # torus seam pair
+            [20.0, 20.0],                  # isolated singleton
+        ],
+        jnp.float32,
+    )
+    alive = jnp.ones((7,), bool)
+    # Torus-aware oracle (the seam pair interacts THROUGH the wrap,
+    # which the plane dense pass cannot see).
+    f_ref = separation_grid(
+        pos, alive, 20.0, PS, 1e-3, cell=CELL, max_per_cell=16,
+        torus_hw=HW,
+    )
+    for kw in (dict(), dict(lane_chunk=128)):
+        f = separation_hashgrid_pallas(
+            pos, alive, 20.0, PS, 1e-3, cell=CELL, max_per_cell=16,
+            torus_hw=HW, interpret=True, **kw,
+        )
+        np.testing.assert_allclose(
+            np.asarray(f), np.asarray(f_ref), rtol=1e-4, atol=1e-4
+        )
+    # all three pairs actually interact (the skip dropped nothing)
+    for i in (0, 2, 4):
+        assert float(jnp.abs(f_ref[i]).max()) > 0.1

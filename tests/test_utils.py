@@ -227,3 +227,29 @@ def test_checkpoint_legacy_positional_mismatch_is_named(tmp_path):
     _np.testing.assert_array_equal(_np.asarray(got[0]), leaves[0])
     with pytest.raises(ValueError, match="schema-v1"):
         ckpt.restore(p, (jnp.zeros(3), jnp.zeros(2), jnp.zeros(1)))
+
+
+def test_checkpoint_strict_false_rejects_positional_paths(tmp_path):
+    """r5 (advisor finding): strict=False growth detection is only
+    sound for named-field pytrees — tuple/list nodes key children by
+    position, so it must be rejected, not silently misaligned."""
+    import jax.numpy as jnp
+    import pytest
+
+    from distributed_swarm_algorithm_tpu.utils import checkpoint as ck
+
+    tree = (jnp.zeros((3,)), {"a": jnp.ones((2,))})
+    p = str(tmp_path / "tup.npz")
+    ck.save(p, tree)
+    # Round-trips fine while the structure matches exactly (growth
+    # detection never fires, so positional keys are harmless)...
+    back = ck.restore(p, tree, strict=True)
+    assert float(back[1]["a"][0]) == 1.0
+    back = ck.restore(p, tree, strict=False)
+    assert float(back[1]["a"][0]) == 1.0
+    # ...but a GROWN target (missing leaves) with positional keys in
+    # play must be rejected rather than silently misaligned.
+    grown = (jnp.zeros((3,)), {"a": jnp.ones((2,)),
+                               "b": jnp.zeros((1,))})
+    with pytest.raises(ValueError, match="positional"):
+        ck.restore(p, grown, strict=False)

@@ -84,15 +84,35 @@ def main() -> None:
     cell = p.grid_sep_cell if p.grid_sep_cell > 0 else p.r_sep
     state = bk.boids_init(n, 2, params=p, seed=0)
 
-    cadence = 2_000
+    # Crash resilience: the intermittent 1M worker crash (documented
+    # in PERFORMANCE.md) can kill any long run, so progress is
+    # checkpointed each cadence and a killed run resumes — drive with
+    #   until python quality_gridmean.py TAG STEPS; do sleep 150; done
+    ckpt = f"/tmp/quality_{tag}.npz"
     done = 0
+    if _os.path.exists(ckpt):
+        data = np.load(ckpt)
+        state = state.replace(
+            pos=jnp.asarray(data["pos"]), vel=jnp.asarray(data["vel"]),
+        )
+        done = int(data["done"])
+        print(f"resumed {tag} at t={done}", flush=True)
+
+    cadence = 2_000
     t0 = time.time()
     while done < total:
-        chunk = min(cadence, total - done)
-        state, _ = bk.boids_run(
-            state, p, chunk, neighbor_mode="gridmean"
-        )
-        done += chunk
+        target = min(done + cadence, total)
+        while done < target:
+            # Crash-containment chunking (a raw 2000-step 1M scan
+            # reproduced the long-scan worker crash from THIS tool,
+            # r5).  1M runs use 100-step programs: the crash lottery
+            # hit 500-step first-chunks twice in r5, and 100 is the
+            # probe-validated size.
+            chunk = min(100 if n > 500_000 else 500, target - done)
+            state, _ = bk.boids_run(
+                state, p, chunk, neighbor_mode="gridmean"
+            )
+            done += chunk
         pol = float(bk.polarization(state))
         ovf = int(hashgrid_overflow(
             state.pos, cell, p.grid_max_per_cell, hw
@@ -103,7 +123,12 @@ def main() -> None:
             f"NN {nn:.3f} | {time.time() - t0:.0f}s",
             flush=True,
         )
+        np.savez(
+            ckpt, pos=np.asarray(state.pos),
+            vel=np.asarray(state.vel), done=done,
+        )
     assert bool(jnp.isfinite(state.pos).all())
+    _os.remove(ckpt)
 
 
 if __name__ == "__main__":

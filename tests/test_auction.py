@@ -304,3 +304,42 @@ def test_single_pair_instance():
     scaled = auction_assign_scaled(jnp.asarray([[7.0]]), eps=0.25)
     assert int(scaled.agent_task[0]) == 0
     assert np.isfinite(float(scaled.prices[0]))
+
+
+def test_greedy_one_to_one_baseline_sane():
+    """The bench's greedy+hysteresis baseline (bench_auction.py): on a
+    specialist instance the greedy outcome is strictly beaten by the
+    auction, and on any instance greedy never exceeds the auction's
+    eps bound above it (sanity for the r5 optimality gate)."""
+    import sys
+    import os
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks",
+    ))
+    import numpy as np
+
+    from bench_auction import greedy_one_to_one
+    from distributed_swarm_algorithm_tpu.ops.auction import (
+        assignment_utility,
+        auction_assign,
+    )
+
+    # Specialist trap: agent 0 is best at task 0 (90) but agent 1 can
+    # ONLY do task 0 (89).  Greedy seats 0 on task 0 (utility 90+0);
+    # the auction seats 1 on 0 and 0 on 1 (89 + 80 = 169).
+    util = np.asarray([[90.0, 80.0], [89.0, 0.0]], np.float32)
+    g = greedy_one_to_one(util)
+    assert g == 90.0
+    res = auction_assign(jnp.asarray(util), eps=0.05)
+    total = float(assignment_utility(jnp.asarray(util), res))
+    assert total >= 169.0 - 1e-3
+    # Random instances: auction >= greedy (eps-optimal vs myopic).
+    rng = np.random.default_rng(3)
+    for _ in range(3):
+        u = rng.uniform(1.0, 100.0, size=(24, 24)).astype(np.float32)
+        g = greedy_one_to_one(u)
+        r = auction_assign(jnp.asarray(u), eps=0.1)
+        a = float(assignment_utility(jnp.asarray(u), r))
+        assert a >= g - 1e-3, (a, g)

@@ -71,16 +71,42 @@ def _scan(fn, state, steps):
     return best / steps
 
 
+def blob_state(n, hw, p, nn=0.55, seed=0):
+    """Synthetic equilibrium-REGIME state: an ordered compact blob at
+    flock-equilibrium density (NN ~ 0.55 measured at 65k), aligned
+    velocities.  The cost probe for the occupancy skip — real
+    equilibria take O(L^2) coarsening steps to reach dynamically, but
+    their OCCUPANCY GEOMETRY (and hence the step cost) is this."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    radius = float(np.sqrt(n * (nn * nn) / np.pi)) * 1.35
+    r = radius * np.sqrt(rng.uniform(size=n))
+    th = rng.uniform(0, 2 * np.pi, size=n)
+    pos = jnp.asarray(
+        np.stack([r * np.cos(th), r * np.sin(th)], 1), jnp.float32
+    )
+    vel = jnp.tile(jnp.asarray([[3.0, 0.4]], jnp.float32), (n, 1))
+    s = bk.boids_init(n, 2, params=p, seed=seed)
+    return s.replace(pos=pos, vel=vel)
+
+
 def decompose(tag: str) -> None:
-    n, hw, steps, kw = CONFIGS[tag]
+    blob = tag.endswith("-blob")
+    n, hw, steps, kw = CONFIGS[tag.removesuffix("-blob")]
     p = bk.BoidsParams(half_width=hw, **kw)
     cell = p.grid_sep_cell if p.grid_sep_cell > 0 else p.r_sep
     K = p.grid_max_per_cell
-    state = bk.boids_init(n, 2, params=p, seed=0)
-
-    # Settle 200 steps so timings see flocking-era occupancy, not the
-    # uniform spawn.
-    state, _ = bk.boids_run(state, p, 200, neighbor_mode="gridmean")
+    if blob:
+        state = blob_state(n, hw, p)
+        # Short settle so the blob relaxes its spacing under the real
+        # dynamics (stays compact; occupancy geometry is the point).
+        state, _ = bk.boids_run(state, p, 100, neighbor_mode="gridmean")
+    else:
+        state = bk.boids_init(n, 2, params=p, seed=0)
+        # Settle 200 steps so timings see flocking-era occupancy, not
+        # the uniform spawn.
+        state, _ = bk.boids_run(state, p, 200, neighbor_mode="gridmean")
     jax.block_until_ready(state.pos)
     ovf = int(hashgrid_overflow(state.pos, cell, K, hw))
 
@@ -128,6 +154,7 @@ def decompose(tag: str) -> None:
 def main() -> None:
     which = sys.argv[1] if len(sys.argv) > 1 else "65k"
     tags = {
+        "blob": ["1m-K32-blob", "65k-K24-blob"],
         "65k": ["65k-K24", "65k-half-K8"],
         "65k16": ["65k-K16"],
         "65k16x": ["65k-K16-nr", "65k-K16-b512"],

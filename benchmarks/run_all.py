@@ -51,6 +51,7 @@ BENCHES = [
     "bench_aco.py",
     "bench_swarm_tpu.py",
     "bench_boids.py",
+    "bench_recovery.py",
     "bench_dim_sharded.py",
     "measure_window_recall.py",
 ]
@@ -75,6 +76,7 @@ QUICK_SKIP = {
     "bench_aco.py",
     "bench_swarm_tpu.py",
     "bench_boids.py",
+    "bench_recovery.py",
     "bench_dim_sharded.py",
     "measure_window_recall.py",
 }

@@ -41,6 +41,12 @@ CONFIGS = [
     # spawn) read 1.66M agent-steps/s — the arena-sized grid, not the
     # agents, was the cost, hence this scenario.
     (65_536, "hashgrid", 1000, 1),
+    # Station-keeping: every agent holds its spawn position (the
+    # bounded-density protocol workload exact separation exists for —
+    # patrol/surveillance grids; the converge-to-a-point scenario
+    # above drives central density past ANY cap, so its hashgrid row
+    # is rescue-dominated and measures the cap-overflow regime).
+    (65_536, "hashgrid-station", 1000, 1),
     (65_536, "window-arena", 1000, 8),
     # The r3 flagship: the full 1M-agent protocol tick (window
     # separation, Morton sort amortized) — the 337-ticks/s config of
@@ -56,14 +62,16 @@ CONFIGS = [
 
 
 def bench(n: int, mode: str, steps: int, sort_every: int) -> None:
-    arena = mode in ("hashgrid", "window-arena")
-    sep = "window" if mode == "window-arena" else mode
+    arena = mode in ("hashgrid", "hashgrid-station", "window-arena")
+    sep = {"window-arena": "window", "hashgrid-station": "hashgrid"}.get(
+        mode, mode
+    )
     cfg = dsa.SwarmConfig().replace(
         separation_mode=sep, sort_every=sort_every
     )
     if arena:
         cfg = cfg.replace(formation_shape="none")
-    if mode == "hashgrid":
+    if sep == "hashgrid":
         cfg = cfg.replace(
             world_hw=256.0, grid_max_per_cell=16,
             hashgrid_overflow_budget=1024,
@@ -72,8 +80,12 @@ def bench(n: int, mode: str, steps: int, sort_every: int) -> None:
     s = dsa.with_tasks(
         s, jnp.asarray([[1.0, 1.0], [-2.0, 3.0], [5.0, -8.0], [0.0, 9.0]])
     )
+    target = (
+        s.pos if mode == "hashgrid-station"
+        else jnp.broadcast_to(jnp.asarray([50.0, 0.0]), s.pos.shape)
+    )
     s = s.replace(
-        target=jnp.broadcast_to(jnp.asarray([50.0, 0.0]), s.pos.shape),
+        target=jnp.asarray(target),
         has_target=jnp.ones_like(s.has_target),
     )
     run = jax.jit(lambda st: dsa.swarm_rollout(st, None, cfg, steps))

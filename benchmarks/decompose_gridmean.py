@@ -71,16 +71,21 @@ def _scan(fn, state, steps):
     return best / steps
 
 
-def blob_state(n, hw, p, nn=0.55, seed=0):
+def blob_state(n, hw, p, nn=0.51, seed=0):
     """Synthetic equilibrium-REGIME state: an ordered compact blob at
-    flock-equilibrium density (NN ~ 0.55 measured at 65k), aligned
-    velocities.  The cost probe for the occupancy skip — real
-    equilibria take O(L^2) coarsening steps to reach dynamically, but
-    their OCCUPANCY GEOMETRY (and hence the step cost) is this."""
+    flock-equilibrium density (NN ~ 0.51 measured at the 65k
+    equilibrium), aligned velocities.  The cost probe for the
+    occupancy skip — real equilibria take O(L^2) coarsening steps to
+    reach dynamically, but their OCCUPANCY GEOMETRY (and hence the
+    step cost) is this.  (First version used a 1.35x radius margin +
+    100 settle steps: without a relaxed flock velocity field the
+    settle EXPLODES the blob edge at up to max_speed and occupancy
+    spreads — the probe then measures dispersal, not equilibrium;
+    hence the equilibrium density and the minimal settle.)"""
     import numpy as np
 
     rng = np.random.default_rng(seed)
-    radius = float(np.sqrt(n * (nn * nn) / np.pi)) * 1.35
+    radius = float(np.sqrt(n * (nn * nn) / np.pi))
     r = radius * np.sqrt(rng.uniform(size=n))
     th = rng.uniform(0, 2 * np.pi, size=n)
     pos = jnp.asarray(
@@ -99,9 +104,10 @@ def decompose(tag: str) -> None:
     K = p.grid_max_per_cell
     if blob:
         state = blob_state(n, hw, p)
-        # Short settle so the blob relaxes its spacing under the real
-        # dynamics (stays compact; occupancy geometry is the point).
-        state, _ = bk.boids_run(state, p, 100, neighbor_mode="gridmean")
+        # Minimal settle (10 steps): just enough to decluster exact
+        # overlaps; occupancy geometry — the point of the probe —
+        # must stay at the equilibrium footprint.
+        state, _ = bk.boids_run(state, p, 10, neighbor_mode="gridmean")
     else:
         state = bk.boids_init(n, 2, params=p, seed=0)
         # Settle 200 steps so timings see flocking-era occupancy, not

@@ -7,7 +7,7 @@ cadence — the data that sizes ``grid_max_per_cell`` (overflow at
 equilibrium must be 0, or at worst stay well under the rescue budget)
 and certifies the polarization bar (>= 0.99 at equilibrium).
 
-Usage: python quality_gridmean.py [65k-K16|65k-K24|1m-half-K8|...] [steps]
+Usage: python quality_gridmean.py [65k-K16|65k-K24|...] [steps] [seed]
 """
 
 from __future__ import annotations
@@ -79,16 +79,17 @@ def sampled_nn(pos: jax.Array, hw: float, sample: int = 2048) -> float:
 def main() -> None:
     tag = sys.argv[1] if len(sys.argv) > 1 else "65k-K16"
     total = int(sys.argv[2]) if len(sys.argv) > 2 else 14_000
+    seed = int(sys.argv[3]) if len(sys.argv) > 3 else 0
     n, hw, kw = CONFIGS[tag]
     p = bk.BoidsParams(half_width=hw, **kw)
     cell = p.grid_sep_cell if p.grid_sep_cell > 0 else p.r_sep
-    state = bk.boids_init(n, 2, params=p, seed=0)
+    state = bk.boids_init(n, 2, params=p, seed=seed)
 
     # Crash resilience: the intermittent 1M worker crash (documented
     # in PERFORMANCE.md) can kill any long run, so progress is
     # checkpointed each cadence and a killed run resumes — drive with
     #   until python quality_gridmean.py TAG STEPS; do sleep 150; done
-    ckpt = f"/tmp/quality_{tag}.npz"
+    ckpt = f"/tmp/quality_{tag}_s{seed}.npz"
     done = 0
     if _os.path.exists(ckpt):
         data = np.load(ckpt)
@@ -119,7 +120,7 @@ def main() -> None:
         ))
         nn = sampled_nn(state.pos, hw) if n <= 262_144 else float("nan")
         print(
-            f"{tag} t={done}: pol {pol:.4f} | overflow {ovf} | "
+            f"{tag} s{seed} t={done}: pol {pol:.4f} | overflow {ovf} | "
             f"NN {nn:.3f} | {time.time() - t0:.0f}s",
             flush=True,
         )

@@ -142,10 +142,16 @@ def bench_size(n: int, solves: int) -> None:
     r0 = holder["res"][0]
     seated = int((np.asarray(r0.agent_task) >= 0).sum())
     total = float(assignment_utility(utils[0], r0))
+    # Instance details go in a comment line, NOT the metric name —
+    # embedding rounds/utility in the name breaks the union-based
+    # regression gate whenever they drift (r5: the r4 rows showed as
+    # "dropped" because the round count moved into a new name).
+    print(
+        f"# {n}x{n}: seated {seated}/{n}, utility {total:.0f}, "
+        f"{int(r0.rounds)} rounds (flat eps)"
+    )
     report(
-        f"assignments/sec, eps-optimal auction, {n} x {n} "
-        f"(seated {seated}/{n}, utility {total:.0f}, "
-        f"{int(r0.rounds)} rounds)",
+        f"assignments/sec, eps-optimal auction, {n} x {n}",
         n * solves / best,
         "assignments/sec",
         0.0,

@@ -87,11 +87,14 @@ def _run_one(cmd, cwd, recorded, record: bool) -> bool:
     False on failure/timeout."""
     name = os.path.basename(cmd[-1])
     try:
+        # 3600 s: bench_swarm_tpu's r5 arena rows compile several
+        # multi-minute Mosaic programs and overran the old 1800 s cap
+        # (its rows were dropped from the r05 record's first pass).
         proc = subprocess.run(
-            cmd, capture_output=True, text=True, timeout=1800, cwd=cwd,
+            cmd, capture_output=True, text=True, timeout=3600, cwd=cwd,
         )
     except subprocess.TimeoutExpired:
-        print(f"# {name} timed out after 1800s", file=sys.stderr)
+        print(f"# {name} timed out after 3600s", file=sys.stderr)
         return False
     for line in proc.stdout.splitlines():
         if line.startswith("{"):

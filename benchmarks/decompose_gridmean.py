@@ -12,7 +12,16 @@ dispatch is noise (house methodology, benchmarks/common.py).  Stage
 costs are reported per step; ``sep - build`` isolates the kernel sweep
 and ``full - sep`` the CIC field + integration tail.
 
-Usage: python decompose_gridmean.py [65k|1m|both]
+r6: the ``*-mom`` configs run ``align_deposit="moments"`` (the
+commensurate moments-deposit CIC, ops/grid_moments.py — the r5
+ledger's sized lever for the ~100 ms/step 1M field cost) and
+additionally time the field's deposit and deposit+sample stages.
+Fixed-name metrics (``cic-deposit, <tag>`` / ``cic-field, <tag>`` /
+``gridmean-field+integrate, <tag>`` / ``gridmean-step, <tag>``) go
+out as JSON lines so the union regression gate in run_all.py carries
+them across rounds.
+
+Usage: python decompose_gridmean.py [65k|65k16|65k16x|1m|mom|gate|blob|both]
 """
 
 from __future__ import annotations
@@ -22,15 +31,21 @@ import sys
 import jax
 import jax.numpy as jnp
 
-from common import timeit_best
+from common import report, timeit_best
 
 from distributed_swarm_algorithm_tpu.ops import boids as bk
+from distributed_swarm_algorithm_tpu.ops.grid_moments import (
+    align_cell_arg,
+    cic_field_commensurate,
+    moments_deposit,
+)
 from distributed_swarm_algorithm_tpu.ops.pallas.grid_separation import (
     _geometry,
     _slots_sorted,
     hashgrid_overflow,
     separation_hashgrid_pallas,
 )
+from distributed_swarm_algorithm_tpu.utils.platform import on_tpu
 
 # (tag, n, half_width, steps/call, param overrides)
 CONFIGS = {
@@ -51,6 +66,15 @@ CONFIGS = {
                dict(grid_max_per_cell=32)),
     "1m-half-K8": (1_048_576, 905.0, 20,
                    dict(grid_max_per_cell=8, grid_sep_cell=1.0)),
+    # Commensurate moments-deposit CIC (align_cell=0 derives
+    # cell_a = 4*cell_sep exactly; the bilinear rows above keep the
+    # corner-scatter baseline measurable side by side).
+    "65k-K24-mom": (65_536, 226.0, 100,
+                    dict(grid_max_per_cell=24,
+                         align_deposit="moments", align_cell=0.0)),
+    "1m-K32-mom": (1_048_576, 905.0, 20,
+                   dict(grid_max_per_cell=32,
+                        align_deposit="moments", align_cell=0.0)),
 }
 
 
@@ -126,6 +150,7 @@ def decompose(tag: str) -> None:
             float(p.eps), cell=float(cell), max_per_cell=K,
             torus_hw=float(hw),
             overflow_budget=p.grid_overflow_budget,
+            interpret=not on_tpu(),
         )
         # Tiny coupling keeps the scan body non-DCE-able while
         # perturbing the trajectory below fp-visibility.
@@ -155,19 +180,64 @@ def decompose(tag: str) -> None:
         f"{(sep - build) * 1e3:.2f} | field+integrate "
         f"{(full - sep) * 1e3:.2f} | overflow@t200 {ovf}"
     )
+    report(f"gridmean-step, {tag}", full * 1e3, "ms/step", 0.0)
+    report(
+        f"gridmean-field+integrate, {tag}", (full - sep) * 1e3,
+        "ms/step", 0.0,
+    )
+
+    if p.align_deposit == "moments":
+        # Field-stage scans on the new path: deposit alone, then the
+        # whole field (deposit + sample) — the two fixed-name metrics
+        # the acceptance gate tracks.
+        sep_cell = float(cell)
+        ac = align_cell_arg(p.align_cell)
+
+        def dep_only(s):
+            grid = moments_deposit(
+                s.pos, s.vel, None, torus_hw=float(hw),
+                sep_cell=sep_cell, align_cell=ac,
+            )
+            return s.replace(pos=s.pos + 1e-30 * grid[0, 0, 4])
+
+        def field_only(s):
+            align, coh = cic_field_commensurate(
+                s.pos, s.vel, None, torus_hw=float(hw),
+                sep_cell=sep_cell, align_cell=ac,
+            )
+            return s.replace(pos=s.pos + 1e-30 * (align + coh))
+
+        dep = _scan(dep_only, state, steps)
+        field = _scan(field_only, state, steps)
+        print(
+            f"{tag}: cic-deposit {dep * 1e3:.2f} ms/step | "
+            f"cic-field(dep+sample) {field * 1e3:.2f} | sample "
+            f"{(field - dep) * 1e3:.2f}"
+        )
+        report(f"cic-deposit, {tag}", dep * 1e3, "ms/step", 0.0)
+        report(f"cic-field, {tag}", field * 1e3, "ms/step", 0.0)
 
 
 def main() -> None:
     which = sys.argv[1] if len(sys.argv) > 1 else "65k"
     tags = {
         "blob": ["1m-K32-blob", "65k-K24-blob"],
-        "65k": ["65k-K24", "65k-half-K8"],
+        "65k": ["65k-K24", "65k-half-K8", "65k-K24-mom"],
         "65k16": ["65k-K16"],
         "65k16x": ["65k-K16-nr", "65k-K16-b512"],
-        "1m": ["1m-K32", "1m-half-K8"],
+        "1m": ["1m-K32", "1m-half-K8", "1m-K32-mom"],
+        "mom": ["65k-K24-mom", "1m-K32-mom"],
+        # The run_all union-gate set: both flagship scales, corner
+        # baseline + moments side by side (run_all.py passes "gate").
+        "gate": ["65k-K24", "65k-K24-mom", "1m-K32", "1m-K32-mom"],
         "both": list(CONFIGS),
-    }[which]
-    for t in tags:
+    }
+    if which not in tags:
+        raise SystemExit(
+            f"unknown selector {which!r}; one of "
+            f"{'|'.join(sorted(tags))}"
+        )
+    for t in tags[which]:
         decompose(t)
 
 

@@ -54,7 +54,19 @@ BENCHES = [
     "bench_recovery.py",
     "bench_dim_sharded.py",
     "measure_window_recall.py",
+    # r6: gridmean stage decomposition joins the gated suite — its
+    # fixed-name cic-deposit / cic-field / gridmean-step metrics are
+    # how the commensurate moments-deposit lever is tracked.
+    "decompose_gridmean.py",
 ]
+
+# Extra argv for benches whose no-arg default is not the gate set —
+# decompose_gridmean's "gate" tag runs both flagship scales with the
+# corner baseline and moments rows side by side, so the union gate
+# actually carries the 1M cic-deposit/cic-field metrics it tracks.
+BENCH_ARGS = {
+    "decompose_gridmean.py": ["gate"],
+}
 
 QUICK_SKIP = {
     "bench_pso_1m_ackley.py",
@@ -79,6 +91,7 @@ QUICK_SKIP = {
     "bench_recovery.py",
     "bench_dim_sharded.py",
     "measure_window_recall.py",
+    "decompose_gridmean.py",
 }
 
 
@@ -148,8 +161,9 @@ def main() -> int:
         if args.quick and name in QUICK_SKIP:
             continue
         ok = _run_one(
-            [sys.executable, os.path.join(HERE, name)], root,
-            recorded, bool(args.record),
+            [sys.executable, os.path.join(HERE, name)]
+            + BENCH_ARGS.get(name, []),
+            root, recorded, bool(args.record),
         )
         failures += 0 if ok else 1
     if not args.quick:

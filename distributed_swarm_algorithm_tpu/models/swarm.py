@@ -39,8 +39,46 @@ from ._checkpoint import CheckpointMixin
 _NO_OBSTACLES = None
 
 
+def _hashgrid_multidevice_cfg(
+    state: SwarmState, cfg: SwarmConfig
+) -> SwarmConfig:
+    """Eager-boundary guard (r6, ADVICE r5): the fused hash-grid
+    kernel is a single-device program, and inside jit the position
+    array is a tracer with no sharding — so the driver entry points
+    (the only places the state is still concrete) must make the
+    multi-device call.  Under ``hashgrid_backend='auto'`` a swarm
+    committed across devices is re-dispatched onto the portable path
+    (cfg is static, so the portable graph is what gets traced);
+    a forced ``'pallas'`` raises the clear error from
+    ``tick_uses_hashgrid_kernel``.  Tracer or non-hashgrid states
+    pass through untouched."""
+    if cfg.separation_mode != "hashgrid":
+        return cfg
+    if state.pos.ndim != 2 or state.pos.shape[1] != 2:
+        return cfg
+    from ..ops.physics import (
+        _committed_multidevice,
+        tick_uses_hashgrid_kernel,
+    )
+
+    # Cheap sharding probe first: single-device (and tracer) states
+    # skip the geometry/VMEM predicate entirely — this wrapper runs
+    # on the eager 10 Hz driver hot loop.
+    if not _committed_multidevice(state.pos):
+        return cfg
+    # Raises for forced 'pallas' on a committed multi-device swarm.
+    with_state = tick_uses_hashgrid_kernel(
+        cfg, 2, state.pos.dtype, arr=state.pos
+    )
+    if not with_state and tick_uses_hashgrid_kernel(
+        cfg, 2, state.pos.dtype
+    ):
+        return cfg.replace(hashgrid_backend="portable")
+    return cfg
+
+
 @partial(jax.jit, static_argnames=("cfg", "sort_in_tick"))
-def swarm_tick(
+def _swarm_tick_impl(
     state: SwarmState,
     obstacles: Optional[jax.Array],
     cfg: SwarmConfig,
@@ -89,8 +127,24 @@ def swarm_tick(
     return state
 
 
+def swarm_tick(
+    state: SwarmState,
+    obstacles: Optional[jax.Array],
+    cfg: SwarmConfig,
+    sort_in_tick: bool = True,
+) -> SwarmState:
+    """One synchronous swarm tick — ``_swarm_tick_impl`` behind the
+    eager multi-device hash-grid guard (see
+    ``_hashgrid_multidevice_cfg``; a no-op under trace and for
+    single-device swarms)."""
+    return _swarm_tick_impl(
+        state, obstacles, _hashgrid_multidevice_cfg(state, cfg),
+        sort_in_tick,
+    )
+
+
 @partial(jax.jit, static_argnames=("cfg", "n_steps", "record"))
-def swarm_rollout(
+def _swarm_rollout_impl(
     state: SwarmState,
     obstacles: Optional[jax.Array],
     cfg: SwarmConfig,
@@ -111,7 +165,7 @@ def swarm_rollout(
     def body(s, _):
         # The chunked path below owns the re-sort cadence, so the tick
         # runs cond-free (the conditional alone measured ~26 ms/tick
-        # at 1M — see swarm_tick's docstring).
+        # at 1M — see _swarm_tick_impl's docstring).
         s = swarm_tick(s, obstacles, cfg, sort_in_tick=not permuting)
         frame = None
         if record:
@@ -165,6 +219,23 @@ def swarm_rollout(
                                     state.pos.dtype)
         return state, jnp.concatenate(frames, axis=0)
     return state
+
+
+def swarm_rollout(
+    state: SwarmState,
+    obstacles: Optional[jax.Array],
+    cfg: SwarmConfig,
+    n_steps: int,
+    record: bool = False,
+) -> Union[SwarmState, Tuple[SwarmState, jax.Array]]:
+    """``n_steps`` ticks under one ``lax.scan`` — ``_swarm_rollout_impl``
+    behind the eager multi-device hash-grid guard (see
+    ``_hashgrid_multidevice_cfg``; a no-op under trace and for
+    single-device swarms)."""
+    return _swarm_rollout_impl(
+        state, obstacles, _hashgrid_multidevice_cfg(state, cfg),
+        n_steps, record,
+    )
 
 
 class VectorSwarm(CheckpointMixin):

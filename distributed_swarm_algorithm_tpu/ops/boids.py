@@ -112,6 +112,17 @@ class BoidsParams(NamedTuple):
     # 0.995-0.996 bilinear vs 0.44-0.99 nearest (basin-dependent),
     # with healthier spacing (NN 0.55 vs 0.36); at 512 both match
     # dense (the r3 result that did not generalize).
+    # "moments" (r6): the SAME bilinear field computed by the
+    # commensurate moments deposit (ops/grid_moments.py) — the
+    # alignment grid is locked commensurate with the separation grid
+    # (cell_a an even integer multiple of the effective sep cell,
+    # canonically 4x) and the four per-agent corner scatters/gathers
+    # collapse into one 16-channel cell reduction + dense block
+    # algebra (deposit) and one 20-channel gather (sample).  Equal to
+    # "bilinear" on the same grid up to fp reassociation — the r5
+    # ledger's sized lever for the 1M CIC cost (~100 -> ~35 ms/step
+    # predicted).  align_cell must be commensurate (<= 0 derives
+    # cell_a = 4*cell_sep exactly); incommensurate values raise.
     align_deposit: str = "bilinear"
     # Rescue budget for the fused separation kernel: max capped-out
     # agents per step that still get exact (symmetric) separation via
@@ -462,126 +473,142 @@ def boids_forces_gridmean(
 
     # --- alignment + cohesion: grid velocity/centroid field -------------
     hw = p.half_width
-    g = max(1, int(round(2.0 * hw / p.align_cell)))
-    cell = 2.0 * hw / g                       # tiles the torus exactly
-    # Tiny-grid guards (advisor r3): with g < 3 the nearest branch's
-    # 3x3 tent pool would roll(+-1) onto the same cell twice,
-    # double-counting deposits with inconsistent center offsets; with
-    # g < 2 the bilinear corners collapse onto one cell.  Mirror
-    # separation_grid's torus guard instead of corrupting silently.
-    g_min = 2 if p.align_deposit == "bilinear" else 3
-    if g < g_min:
-        raise ValueError(
-            f"align grid of {g} cells (align_cell={p.align_cell}, "
-            f"world [-{hw}, {hw})) is below the {g_min}-cell minimum "
-            f"for align_deposit={p.align_deposit!r}; use "
-            "neighbor_mode='dense' for such tiny worlds or shrink "
-            "align_cell"
+    if p.align_deposit == "moments":
+        # Commensurate moments-deposit CIC (r6): same bilinear field
+        # on the alignment grid derived from the SEPARATION grid
+        # (cell_a = even multiple of cell_sep; align_cell <= 0 takes
+        # the canonical 4x), computed with zero per-agent corner
+        # scatters — see ops/grid_moments.py for the algebra and the
+        # r5 ledger sizing this lever.
+        from .grid_moments import align_cell_arg, cic_field_commensurate
+
+        sep_cell = p.grid_sep_cell if p.grid_sep_cell > 0 else p.r_sep
+        align, coh = cic_field_commensurate(
+            pos, vel, None, torus_hw=float(hw),
+            sep_cell=float(sep_cell),
+            align_cell=align_cell_arg(p.align_cell),
         )
-    if p.align_deposit == "bilinear":
-        # CIC: deposit into the 2x2 nearest cell corners with
-        # bilinear weights, sample bilinearly — the field a boid sees
-        # varies continuously with position (see BoidsParams for the
-        # measured nearest-vs-bilinear ordering result).  Position
-        # sums are stored relative to each receiving cell's CENTER so
-        # the toroidal seam never tears the centroid.
-        u = (pos + hw) / cell - 0.5
-        i0 = jnp.floor(u).astype(jnp.int32)
-        frac = u - i0.astype(pos.dtype)
-
-        # Four separate corner scatters/gathers.  Measured negative
-        # (r4): batching them as [4n] concatenated index arrays (one
-        # scatter, one gather) was 25% SLOWER at 65k — the tiles and
-        # concats materialize [4n, 5] intermediates that cost more
-        # than the three saved scatter launches.
-        def corners():
-            for dx in (0, 1):
-                for dy in (0, 1):
-                    w = (
-                        jnp.where(dx == 0, 1 - frac[:, 0], frac[:, 0])
-                        * jnp.where(dy == 0, 1 - frac[:, 1], frac[:, 1])
-                    )
-                    ci = jnp.mod(i0[:, 0] + dx, g)
-                    cj = jnp.mod(i0[:, 1] + dy, g)
-                    center = jnp.stack(
-                        [
-                            (ci.astype(pos.dtype) + 0.5) * cell - hw,
-                            (cj.astype(pos.dtype) + 0.5) * cell - hw,
-                        ],
-                        axis=1,
-                    )
-                    yield w, ci, cj, center
-
-        grid = jnp.zeros((g, g, 2 * d + 1), pos.dtype)
-        for w, ci, cj, center in corners():
-            rel = _wrap(pos - center, hw)
-            depc = jnp.concatenate(
-                [vel, rel, jnp.ones((n, 1), pos.dtype)], axis=1
-            )
-            grid = grid.at[ci, cj].add(w[:, None] * depc)
-
-        samp = jnp.zeros((n, 2 * d + 1), pos.dtype)
-        for w, ci, cj, center in corners():
-            gv = grid[ci, cj]
-            # Corner cells' position sums are relative to THEIR
-            # centers; re-express relative to this boid.
-            adj = gv.at[:, d:2 * d].add(
-                gv[:, 2 * d:] * _wrap(center - pos, hw)
-            )
-            samp = samp + w[:, None] * adj
-        # No presence gate needed: self-sampling is exactly
-        # force-free (per corner, the self deposit w*(pos - center)
-        # plus the sample-side re-centering w*(center - pos) cancel
-        # identically, and the self mean-velocity is the boid's own),
-        # and the count can never hit 0 — a lone boid always
-        # self-samples sum(w^2) >= 0.25, so a lone boid feels zero
-        # force, matching dense's no-neighbor case.
-        cnt = jnp.maximum(samp[:, 2 * d:], 1e-6)
-        align = samp[:, :d] / cnt - vel
-        coh = samp[:, d:2 * d] / cnt
-    elif p.align_deposit == "nearest":
-        ci = jnp.clip(
-            jnp.floor((pos + hw) / cell).astype(jnp.int32), 0, g - 1
-        )                                                   # [N, 2]
-        center = (ci.astype(pos.dtype) + 0.5) * cell - hw
-        rel = _wrap(pos - center, hw)         # cell-local, seam-safe
-        dep = jnp.concatenate(
-            [vel, rel, jnp.ones((n, 1), pos.dtype)], axis=1
-        )                                                   # [N, 5]
-        grid = (
-            jnp.zeros((g, g, 5), pos.dtype)
-            .at[ci[:, 0], ci[:, 1]].add(dep)
-        )
-
-        pooled = jnp.zeros_like(grid)
-        for dx in (-1, 0, 1):
-            for dy in (-1, 0, 1):
-                w = (2 - abs(dx)) * (2 - abs(dy)) / 16.0
-                gshift = jnp.roll(grid, (dx, dy), axis=(0, 1))  # periodic
-                # Neighbor cells' position sums are relative to THEIR
-                # centers; re-express relative to the receiving cell.
-                off = jnp.asarray([dx * cell, dy * cell], pos.dtype)
-                gshift = gshift.at[..., 2:4].add(
-                    -gshift[..., 4:5] * off
-                )
-                pooled = pooled + w * gshift
-
-        samp = pooled[ci[:, 0], ci[:, 1]]                   # [N, 5]
-        cnt = jnp.maximum(samp[:, 4:5], 1e-6)
-        # Self deposits exactly 0.25 into the pooled count (tent
-        # center weight 4/16); anything above that means some OTHER
-        # boid is in the pooled patch — matching dense's no-neighbor
-        # gate for a lone boid.
-        has = samp[:, 4:5] > 0.26
-        mean_vel = samp[:, :d] / cnt
-        centroid_rel = samp[:, d:2 * d] / cnt + _wrap(center - pos, hw)
-        align = jnp.where(has, mean_vel - vel, 0.0)
-        coh = jnp.where(has, centroid_rel, 0.0)
     else:
-        raise ValueError(
-            f"unknown align_deposit {p.align_deposit!r}; "
-            "expected 'bilinear' or 'nearest'"
-        )
+        g = max(1, int(round(2.0 * hw / p.align_cell)))
+        cell = 2.0 * hw / g                       # tiles the torus exactly
+        # Tiny-grid guards (advisor r3): with g < 3 the nearest branch's
+        # 3x3 tent pool would roll(+-1) onto the same cell twice,
+        # double-counting deposits with inconsistent center offsets; with
+        # g < 2 the bilinear corners collapse onto one cell.  Mirror
+        # separation_grid's torus guard instead of corrupting silently.
+        g_min = 2 if p.align_deposit == "bilinear" else 3
+        if g < g_min:
+            raise ValueError(
+                f"align grid of {g} cells (align_cell={p.align_cell}, "
+                f"world [-{hw}, {hw})) is below the {g_min}-cell minimum "
+                f"for align_deposit={p.align_deposit!r}; use "
+                "neighbor_mode='dense' for such tiny worlds or shrink "
+                "align_cell"
+            )
+        if p.align_deposit == "bilinear":
+            # CIC: deposit into the 2x2 nearest cell corners with
+            # bilinear weights, sample bilinearly — the field a boid sees
+            # varies continuously with position (see BoidsParams for the
+            # measured nearest-vs-bilinear ordering result).  Position
+            # sums are stored relative to each receiving cell's CENTER so
+            # the toroidal seam never tears the centroid.
+            u = (pos + hw) / cell - 0.5
+            i0 = jnp.floor(u).astype(jnp.int32)
+            frac = u - i0.astype(pos.dtype)
+
+            # Four separate corner scatters/gathers.  Measured negative
+            # (r4): batching them as [4n] concatenated index arrays (one
+            # scatter, one gather) was 25% SLOWER at 65k — the tiles and
+            # concats materialize [4n, 5] intermediates that cost more
+            # than the three saved scatter launches.
+            def corners():
+                for dx in (0, 1):
+                    for dy in (0, 1):
+                        w = (
+                            jnp.where(dx == 0, 1 - frac[:, 0], frac[:, 0])
+                            * jnp.where(dy == 0, 1 - frac[:, 1], frac[:, 1])
+                        )
+                        ci = jnp.mod(i0[:, 0] + dx, g)
+                        cj = jnp.mod(i0[:, 1] + dy, g)
+                        center = jnp.stack(
+                            [
+                                (ci.astype(pos.dtype) + 0.5) * cell - hw,
+                                (cj.astype(pos.dtype) + 0.5) * cell - hw,
+                            ],
+                            axis=1,
+                        )
+                        yield w, ci, cj, center
+
+            grid = jnp.zeros((g, g, 2 * d + 1), pos.dtype)
+            for w, ci, cj, center in corners():
+                rel = _wrap(pos - center, hw)
+                depc = jnp.concatenate(
+                    [vel, rel, jnp.ones((n, 1), pos.dtype)], axis=1
+                )
+                grid = grid.at[ci, cj].add(w[:, None] * depc)
+
+            samp = jnp.zeros((n, 2 * d + 1), pos.dtype)
+            for w, ci, cj, center in corners():
+                gv = grid[ci, cj]
+                # Corner cells' position sums are relative to THEIR
+                # centers; re-express relative to this boid.
+                adj = gv.at[:, d:2 * d].add(
+                    gv[:, 2 * d:] * _wrap(center - pos, hw)
+                )
+                samp = samp + w[:, None] * adj
+            # No presence gate needed: self-sampling is exactly
+            # force-free (per corner, the self deposit w*(pos - center)
+            # plus the sample-side re-centering w*(center - pos) cancel
+            # identically, and the self mean-velocity is the boid's own),
+            # and the count can never hit 0 — a lone boid always
+            # self-samples sum(w^2) >= 0.25, so a lone boid feels zero
+            # force, matching dense's no-neighbor case.
+            cnt = jnp.maximum(samp[:, 2 * d:], 1e-6)
+            align = samp[:, :d] / cnt - vel
+            coh = samp[:, d:2 * d] / cnt
+        elif p.align_deposit == "nearest":
+            ci = jnp.clip(
+                jnp.floor((pos + hw) / cell).astype(jnp.int32), 0, g - 1
+            )                                                   # [N, 2]
+            center = (ci.astype(pos.dtype) + 0.5) * cell - hw
+            rel = _wrap(pos - center, hw)         # cell-local, seam-safe
+            dep = jnp.concatenate(
+                [vel, rel, jnp.ones((n, 1), pos.dtype)], axis=1
+            )                                                   # [N, 5]
+            grid = (
+                jnp.zeros((g, g, 5), pos.dtype)
+                .at[ci[:, 0], ci[:, 1]].add(dep)
+            )
+
+            pooled = jnp.zeros_like(grid)
+            for dx in (-1, 0, 1):
+                for dy in (-1, 0, 1):
+                    w = (2 - abs(dx)) * (2 - abs(dy)) / 16.0
+                    gshift = jnp.roll(grid, (dx, dy), axis=(0, 1))  # periodic
+                    # Neighbor cells' position sums are relative to THEIR
+                    # centers; re-express relative to the receiving cell.
+                    off = jnp.asarray([dx * cell, dy * cell], pos.dtype)
+                    gshift = gshift.at[..., 2:4].add(
+                        -gshift[..., 4:5] * off
+                    )
+                    pooled = pooled + w * gshift
+
+            samp = pooled[ci[:, 0], ci[:, 1]]                   # [N, 5]
+            cnt = jnp.maximum(samp[:, 4:5], 1e-6)
+            # Self deposits exactly 0.25 into the pooled count (tent
+            # center weight 4/16); anything above that means some OTHER
+            # boid is in the pooled patch — matching dense's no-neighbor
+            # gate for a lone boid.
+            has = samp[:, 4:5] > 0.26
+            mean_vel = samp[:, :d] / cnt
+            centroid_rel = samp[:, d:2 * d] / cnt + _wrap(center - pos, hw)
+            align = jnp.where(has, mean_vel - vel, 0.0)
+            coh = jnp.where(has, centroid_rel, 0.0)
+        else:
+            raise ValueError(
+                f"unknown align_deposit {p.align_deposit!r}; "
+                "expected 'bilinear', 'moments', or 'nearest'"
+            )
 
     acc = p.w_sep * sep + p.w_align * align + p.w_coh * coh
     acc = acc + _obstacle_acc(pos, obstacles, p)

@@ -1,0 +1,444 @@
+"""Commensurate-grid moments deposit for the CIC alignment field.
+
+The r5 ledger (docs/PERFORMANCE.md, gridmean decomposition) measured
+the bilinear CIC field at ~100 ms/step at 1M boids — four per-agent
+corner scatters on deposit plus four corner gathers on sample, each
+paying the chip's ~9 ms [1M, 5]-scatter/gather primitive floor — and
+sized the fix: make the alignment grid COMMENSURATE with the
+separation grid and replace per-agent corner traffic with per-cell
+moment sums.  This module is that path, in portable ``jnp`` so the
+identical algebra runs on CPU (parity tests) and TPU (the win).
+
+Geometry.  The fine grid is the hash-separation grid: ``g_fine``
+cells across the torus, ``g_fine = (2hw/cell_sep // 16) * 16`` — the
+SAME rounding rule as ``ops/pallas/grid_separation._geometry``, so
+the fine binning here and the kernel's sort keys can never disagree.
+The alignment (CIC) grid has ``g_align = g_fine / Q`` cells for an
+EVEN integer ratio ``Q`` (canonically 4: ``cell_a = 4 * cell_sep``).
+Evenness is load-bearing: a boid's CIC corner index is
+``i0 = floor((pos + hw)/cell_a - 0.5)`` and the ``-0.5`` shifts the
+floor breakpoints to half-CIC-cell lines, which coincide with fine
+cell boundaries exactly when ``Q`` is even — then EVERY fine cell
+lies wholly inside one corner cell and ``i0`` is a pure function of
+the fine cell index: ``i0 = (s - Q/2) // Q``.
+
+The moments form.  Write the bilinear corner weight of corner
+``dx in {0, 1}`` as an affine function of the fine-cell-local
+coordinate ``x~ = px - x_ref`` (``x_ref`` the fine cell's center):
+``wx = alpha + beta * x~`` with per-(fine-cell, corner) constants,
+and the corner-relative deposit position as ``x~ + Cx`` with another
+such constant.  Every per-corner channel — ``w*vx``, ``w*vy``,
+``w*(pos - corner_center)``, ``w`` — then expands over products of
+the 16 monomials
+
+    {1, x, y, xy, x2, y2, x2y, xy2} x {1}  +  {1, x, y, xy} x {vx, vy}
+
+with coefficients that depend only on ``(t, dx)`` where
+``t = (s - Q/2) mod Q`` is the fine cell's phase inside its corner
+block.  So the whole deposit is: ONE 16-channel per-fine-cell
+reduction (replacing four 5-channel per-agent corner scatters),
+followed by dense QxQ block algebra — an einsum against a tiny
+constant tensor plus four cyclic rolls — that assembles the corner
+fields.  Exact by construction: the same per-agent terms, summed in
+a different association order (parity is fp-tolerance, not bitwise).
+
+The sample side inverts the same structure: the four corner field
+values seen by every boid in a fine cell are the SAME four cells, so
+a dense einsum turns the CIC grid into a per-fine-cell table of
+polynomial coefficients (5 channels x {1, x~, y~, x~y~}); each boid
+then needs ONE 20-channel gather (replacing four 5-channel corner
+gathers) and a cheap polynomial evaluation.  The sample-side
+re-centering term ``-cnt * x~`` reuses the boid's own count sample,
+so no extra coefficients are needed for it.
+
+Consumers: ``ops/boids.py:boids_forces_gridmean``
+(``align_deposit="moments"``) and ``ops/physics.py:apf_forces``
+(``k_align``/``k_coh`` velocity-alignment + cohesion forces).  The
+deposit accepts precomputed fine-cell keys so a caller that already
+binned the swarm (the hash-separation sort) can share them.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N_MOMENTS = 16
+N_CHANNELS = 5          # vx, vy, relx, rely, cnt — the CIC layout
+N_COEFFS = 4            # polynomial monomials {1, x~, y~, x~y~}
+
+
+def align_cell_arg(align_cell: float) -> Optional[float]:
+    """Normalize a config-level ``align_cell`` knob to the
+    ``align_cell`` argument of this module: any value <= 0 means
+    "derive the canonical commensurate cell" (``None`` here, i.e.
+    ``cell_a = 4 * cell_sep`` in ``commensurate_geometry``).  The one
+    place the <=0-derives-canonical rule lives — every caller
+    (``apf_forces``, ``boids_forces_gridmean``, the decompose bench)
+    funnels through it."""
+    return float(align_cell) if align_cell > 0 else None
+
+
+def commensurate_geometry(
+    torus_hw: float,
+    sep_cell: float,
+    align_cell: Optional[float] = None,
+) -> Tuple[int, float, int, float, int]:
+    """(g_fine, cell_fine, g_align, cell_align, ratio) for the
+    commensurate pair of grids tiling the torus ``[-hw, hw)^2``.
+
+    ``g_fine`` follows the hash-grid kernel's rounding rule (multiple
+    of 16), so the fine binning matches the separation sort exactly.
+    ``align_cell=None`` derives the canonical ``cell_a = 4*cell_sep``
+    grid; an explicit value must resolve (by the same round-to-grid
+    rule the corner CIC path uses) to a commensurate grid — an EVEN
+    integer number of fine cells per alignment cell — or this raises.
+    """
+    g = (int(2.0 * torus_hw / sep_cell) // 16) * 16
+    if g < 16:
+        raise ValueError(
+            f"torus [-{torus_hw}, {torus_hw}) tiled by sep cell "
+            f"{sep_cell} gives fewer than 16 aligned fine cells; the "
+            "commensurate moments field needs the hash-grid geometry"
+        )
+    cell_fine = 2.0 * torus_hw / g
+    if align_cell is None:
+        ga = g // 4
+    else:
+        ga = int(round(2.0 * torus_hw / align_cell))
+        if ga < 2 or g % ga != 0 or (g // ga) % 2 != 0:
+            raise ValueError(
+                f"align_cell={align_cell} is not commensurate with "
+                f"the separation grid: the alignment cell must be an "
+                f"EVEN integer multiple of the effective sep cell "
+                f"(canonically cell_a = 4*cell_sep = "
+                f"{4.0 * cell_fine}); got {ga} alignment cells "
+                f"against {g} fine cells (ratio "
+                f"{g / ga if ga else float('inf'):.3g})"
+            )
+    q = g // ga
+    if q % 2 != 0 or q < 2 or ga < 2:
+        raise ValueError(
+            f"commensurate ratio must be an even integer >= 2 with "
+            f">= 2 alignment cells (cell_a = 4*cell_sep is the "
+            f"canonical choice); got g_fine={g}, g_align={ga}"
+        )
+    return g, cell_fine, ga, 2.0 * torus_hw / ga, q
+
+
+@lru_cache(maxsize=None)
+def _block_tensors(q: int, cell_fine: float, cell_align: float):
+    """(W, U) constant tensors of the QxQ block algebra (float64
+    numpy; cast to the working dtype at use).
+
+    ``W[t_x, t_y, dx, dy, moment, channel]`` maps the 16 per-fine-
+    cell moment sums to that cell's deposit into corner ``(dx, dy)``.
+    ``U[t_x, t_y, dx, dy, grid_ch, out_ch, coeff]`` maps the four
+    corner field values to the fine cell's sample polynomial
+    coefficients over {1, x~, y~, x~y~} (the re-centering ``-cnt*x~``
+    term is applied per-agent from the count sample, not here).
+    """
+    t = np.arange(q, dtype=np.float64)
+    frac0 = (t + 0.5) / q                       # weight at x~ = 0
+    alpha = np.stack([1.0 - frac0, frac0], 1)   # [q, corner]
+    beta = np.asarray([-1.0, 1.0]) / cell_align
+    # corner-center offset: x_ref - corner_center = cf*(t + .5 - q*dx)
+    cc = cell_fine * (t[:, None] + 0.5 - q * np.arange(2)[None, :])
+    W = np.zeros((q, q, 2, 2, N_MOMENTS, N_CHANNELS))
+    U = np.zeros((q, q, 2, 2, N_CHANNELS, N_CHANNELS, N_COEFFS))
+    for tx in range(q):
+        for ty in range(q):
+            for dx in range(2):
+                for dy in range(2):
+                    a, b = alpha[tx, dx], beta[dx]
+                    c, d = alpha[ty, dy], beta[dy]
+                    cx_, cy_ = cc[tx, dx], cc[ty, dy]
+                    # (ax + bx*x)(cy + dy*y) over {1, x, y, xy}
+                    w4 = np.asarray([a * c, b * c, a * d, b * d])
+                    sw = W[tx, ty, dx, dy]
+                    sw[[0, 1, 2, 3], 4] = w4          # cnt: sum w
+                    sw[[8, 9, 10, 11], 0] = w4        # vx:  sum w*vx
+                    sw[[12, 13, 14, 15], 1] = w4      # vy:  sum w*vy
+                    # relx = sum w*(x + Cx):  w*x over {x,x2,xy,x2y}
+                    sw[[1, 4, 3, 6], 2] += w4
+                    sw[[0, 1, 2, 3], 2] += cx_ * w4
+                    sw[[2, 3, 5, 7], 3] += w4         # w*y terms
+                    sw[[0, 1, 2, 3], 3] += cy_ * w4
+                    su = U[tx, ty, dx, dy]
+                    for ch in (0, 1, 4):              # vx, vy, cnt
+                        su[ch, ch, :] += w4
+                    # rel channels: corner value + cnt*(corner_center
+                    # - pos) = (gv_rel - C*gv_cnt) - gv_cnt*x~; the
+                    # -gv_cnt*x~ piece is -x~*(count sample), applied
+                    # per-agent downstream.
+                    su[2, 2, :] += w4
+                    su[4, 2, :] += -cx_ * w4
+                    su[3, 3, :] += w4
+                    su[4, 3, :] += -cy_ * w4
+    return W, U
+
+
+def fine_cell_keys(
+    pos: jax.Array,
+    alive: Optional[jax.Array],
+    torus_hw: float,
+    g_fine: int,
+):
+    """(key, x~, y~): per-agent fine-cell key (dead agents keyed to
+    ``g_fine**2`` so the deposit drops them) and fine-cell-local
+    coordinates.  Binning delegates to the shared
+    ``ops/neighbors.torus_cell_tables`` — the same tables the
+    hash-separation kernel sorts by, so the two grids cannot drift
+    (the tables' unused CSR outputs are DCE'd under jit)."""
+    from .neighbors import torus_cell_tables
+
+    # Wrap onto the torus first: torus_cell_tables CLIPS out-of-range
+    # coordinates (the separation kernel's convention), which would
+    # leave x~ unbounded for an escaped agent and poison the edge
+    # cells' higher moments (x~², x~²y~) for every sampler.  The
+    # corner CIC form is exactly periodic in pos (frac and mod-ga
+    # indices), so parity requires periodic binning here too.
+    pos = jnp.mod(pos + torus_hw, 2.0 * torus_hw) - torus_hw
+    cx, cy, key, _, _ = torus_cell_tables(pos, torus_hw, g_fine)
+    cell_fine = 2.0 * torus_hw / g_fine
+    xt = pos[:, 0] - ((cx.astype(pos.dtype) + 0.5) * cell_fine - torus_hw)
+    yt = pos[:, 1] - ((cy.astype(pos.dtype) + 0.5) * cell_fine - torus_hw)
+    if alive is not None:
+        key = jnp.where(alive, key, g_fine * g_fine)
+    return key, xt, yt
+
+
+def _moment_rows(xt, yt, vel):
+    """[N, 16] per-agent monomials (fine-cell-local coordinates keep
+    every moment O(cell)-sized — no catastrophic x^2 cancellation at
+    large world half-widths)."""
+    one = jnp.ones_like(xt)
+    xy = xt * yt
+    vx, vy = vel[:, 0], vel[:, 1]
+    return jnp.stack(
+        [
+            one, xt, yt, xy, xt * xt, yt * yt, xt * xt * yt,
+            xt * yt * yt,
+            vx, xt * vx, yt * vx, xy * vx,
+            vy, xt * vy, yt * vy, xy * vy,
+        ],
+        axis=1,
+    )
+
+
+def moments_deposit(
+    pos: jax.Array,
+    vel: jax.Array,
+    alive: Optional[jax.Array],
+    torus_hw: float,
+    sep_cell: float,
+    align_cell: Optional[float] = None,
+    keys: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
+) -> jax.Array:
+    """The commensurate CIC deposit: ``[g_align, g_align, 5]`` field
+    of (velocity-sum x2, center-relative position-sum x2, count),
+    equal (up to fp reassociation) to the four-corner bilinear
+    scatter on the same alignment grid.
+
+    One 16-channel cell reduction + dense block einsum + four rolls —
+    zero per-agent corner scatters.  ``keys`` lets a caller that
+    already binned the swarm (the hash-separation sort) pass
+    ``(key, x~, y~)`` and skip the rebinning.
+    """
+    g, cf, ga, ca, q = commensurate_geometry(
+        torus_hw, sep_cell, align_cell
+    )
+    key, xt, yt = (
+        keys if keys is not None
+        else fine_cell_keys(pos, alive, torus_hw, g)
+    )
+    rows = _moment_rows(xt, yt, vel)
+    # One scatter-add (segment-sum-equivalent on sorted runs — the r5
+    # ledger measured sorted/unsorted/segment_sum within noise of each
+    # other on-chip); dead agents carry key g*g -> out of range ->
+    # dropped, same convention as the separation planes.
+    m = (
+        jnp.zeros((g * g, N_MOMENTS), pos.dtype)
+        .at[key].add(rows, mode="drop")
+        .reshape(g, g, N_MOMENTS)
+    )
+    # Phase-align: fine cell s belongs to corner block (s - q/2)//q,
+    # so a cyclic roll by -q/2 makes blocks contiguous (the roll also
+    # closes the torus seam — block -1 is block ga-1).
+    m = jnp.roll(m, (-(q // 2), -(q // 2)), axis=(0, 1))
+    blocks = m.reshape(ga, q, ga, q, N_MOMENTS)
+    w = jnp.asarray(_block_tensors(q, cf, ca)[0], pos.dtype)
+    # corner[a, b, dx, dy, ch]: what block (a, b) deposits into
+    # alignment cell ((a+dx) mod ga, (b+dy) mod ga).
+    corner = jnp.einsum("aibjm,ijdemc->abdec", blocks, w)
+    grid = jnp.zeros((ga, ga, N_CHANNELS), pos.dtype)
+    for dx in (0, 1):
+        for dy in (0, 1):
+            grid = grid + jnp.roll(
+                corner[:, :, dx, dy, :], (dx, dy), axis=(0, 1)
+            )
+    return grid
+
+
+def moments_sample(
+    grid: jax.Array,
+    pos: jax.Array,
+    vel: jax.Array,
+    alive: Optional[jax.Array],
+    torus_hw: float,
+    sep_cell: float,
+    align_cell: Optional[float] = None,
+    keys: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """(align, coh) [N, 2] forces sampled from a commensurate CIC
+    ``grid`` — bilinear corner sampling with seam-safe re-centering,
+    restructured as one dense coefficient-table einsum + ONE
+    per-agent 20-channel gather + a polynomial evaluation (vs four
+    5-channel corner gathers).  Matches ``boids_forces_gridmean``'s
+    bilinear branch: no presence gate (a lone boid's self-sample is
+    force-free by the same corner cancellation), count floored at
+    1e-6."""
+    g, cf, ga, ca, q = commensurate_geometry(
+        torus_hw, sep_cell, align_cell
+    )
+    key, xt, yt = (
+        keys if keys is not None
+        else fine_cell_keys(pos, alive, torus_hw, g)
+    )
+    u = jnp.asarray(_block_tensors(q, cf, ca)[1], pos.dtype)
+    rolled = jnp.stack(
+        [
+            jnp.stack(
+                [jnp.roll(grid, (-dx, -dy), (0, 1)) for dy in (0, 1)],
+                0,
+            )
+            for dx in (0, 1)
+        ],
+        0,
+    )                                           # [2, 2, ga, ga, ch]
+    coeff = jnp.einsum("deabn,ijdenck->aibjck", rolled, u)
+    # Undo the phase roll so the table is indexed by the raw fine
+    # cell, then flatten for the single per-agent gather.
+    coeff = coeff.reshape(g, g, N_CHANNELS, N_COEFFS)
+    coeff = jnp.roll(coeff, (q // 2, q // 2), axis=(0, 1))
+    coeff = coeff.reshape(g * g, N_CHANNELS, N_COEFFS)
+    cfa = coeff[jnp.minimum(key, g * g - 1)]    # [N, ch, 4]
+    mono = jnp.stack(
+        [jnp.ones_like(xt), xt, yt, xt * yt], axis=1
+    )                                           # [N, 4]
+    samp = jnp.einsum("nck,nk->nc", cfa, mono)  # [N, ch]
+    cnt_raw = samp[:, 4]
+    cnt = jnp.maximum(cnt_raw, 1e-6)[:, None]
+    align = samp[:, 0:2] / cnt - vel
+    coh = (
+        jnp.stack(
+            [samp[:, 2] - xt * cnt_raw, samp[:, 3] - yt * cnt_raw],
+            axis=1,
+        )
+        / cnt
+    )
+    if alive is not None:
+        live = alive[:, None]
+        align = jnp.where(live, align, 0.0)
+        coh = jnp.where(live, coh, 0.0)
+    return align, coh
+
+
+@partial(
+    jax.jit,
+    static_argnames=("torus_hw", "sep_cell", "align_cell"),
+)
+def cic_field_commensurate(
+    pos: jax.Array,
+    vel: jax.Array,
+    alive: Optional[jax.Array],
+    torus_hw: float,
+    sep_cell: float,
+    align_cell: Optional[float] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """(align, coh) [N, 2]: the full commensurate moments CIC field —
+    deposit + sample sharing one binning pass.  Drop-in replacement
+    for the four-corner bilinear field on the commensurate alignment
+    grid (fp-reassociation tolerance)."""
+    g, *_ = commensurate_geometry(torus_hw, sep_cell, align_cell)
+    keys = fine_cell_keys(pos, alive, torus_hw, g)
+    grid = moments_deposit(
+        pos, vel, alive, torus_hw, sep_cell, align_cell, keys=keys
+    )
+    return moments_sample(
+        grid, pos, vel, alive, torus_hw, sep_cell, align_cell,
+        keys=keys,
+    )
+
+
+def cic_field_corner_reference(
+    pos: jax.Array,
+    vel: jax.Array,
+    alive: Optional[jax.Array],
+    torus_hw: float,
+    sep_cell: float,
+    align_cell: Optional[float] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """The four-corner bilinear CIC field on the SAME commensurate
+    alignment grid — the parity oracle for the moments path (the
+    per-agent scatter/gather form this module exists to replace;
+    kept for tests and for auditing, not for hot paths).  Mirrors
+    ``boids_forces_gridmean``'s bilinear branch with an alive mask.
+    """
+    _, _, ga, ca, _ = commensurate_geometry(
+        torus_hw, sep_cell, align_cell
+    )
+    hw = torus_hw
+    n, d = pos.shape
+    live = (
+        jnp.ones((n,), bool) if alive is None else alive
+    )
+
+    def wrap(x):
+        return jnp.mod(x + hw, 2.0 * hw) - hw
+
+    u = (pos + hw) / ca - 0.5
+    i0 = jnp.floor(u).astype(jnp.int32)
+    frac = u - i0.astype(pos.dtype)
+
+    def corners():
+        for dx in (0, 1):
+            for dy in (0, 1):
+                w = (
+                    jnp.where(dx == 0, 1 - frac[:, 0], frac[:, 0])
+                    * jnp.where(dy == 0, 1 - frac[:, 1], frac[:, 1])
+                )
+                ci = jnp.mod(i0[:, 0] + dx, ga)
+                cj = jnp.mod(i0[:, 1] + dy, ga)
+                center = jnp.stack(
+                    [
+                        (ci.astype(pos.dtype) + 0.5) * ca - hw,
+                        (cj.astype(pos.dtype) + 0.5) * ca - hw,
+                    ],
+                    axis=1,
+                )
+                yield jnp.where(live, w, 0.0), ci, cj, center
+
+    grid = jnp.zeros((ga, ga, 2 * d + 1), pos.dtype)
+    for w, ci, cj, center in corners():
+        rel = wrap(pos - center)
+        depc = jnp.concatenate(
+            [vel, rel, jnp.ones((n, 1), pos.dtype)], axis=1
+        )
+        grid = grid.at[ci, cj].add(w[:, None] * depc)
+
+    samp = jnp.zeros((n, 2 * d + 1), pos.dtype)
+    for w, ci, cj, center in corners():
+        gv = grid[ci, cj]
+        adj = gv.at[:, d:2 * d].add(gv[:, 2 * d:] * wrap(center - pos))
+        samp = samp + w[:, None] * adj
+    cnt = jnp.maximum(samp[:, 2 * d:], 1e-6)
+    align = samp[:, :d] / cnt - vel
+    coh = samp[:, d:2 * d] / cnt
+    align = jnp.where(live[:, None], align, 0.0)
+    coh = jnp.where(live[:, None], coh, 0.0)
+    return align, coh

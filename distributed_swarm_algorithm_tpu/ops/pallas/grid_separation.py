@@ -640,6 +640,20 @@ def separation_hashgrid_pallas(
     reach = (R + 1) * K
     if lane_chunk is None:
         tiled = _VMEM_ROWS[R] * L * 4 > _VMEM_BUDGET
+        if tiled and R == 2:
+            # hashgrid_supported routes these configs to the portable
+            # path (known lane-tiled R=2 device fault, ADVICE r5);
+            # refuse here too so a direct call cannot silently land
+            # on the faulting kernel.  lane_chunk stays available as
+            # the explicit on-chip repro hook.
+            raise ValueError(
+                f"half-cell (R=2) row of {L} lanes exceeds the 1-D "
+                "VMEM budget and the lane-tiled R=2 kernel has a "
+                "known unresolved device fault at scale; use the "
+                "portable separation_grid (hashgrid_supported now "
+                "gates this off), or pass lane_chunk explicitly to "
+                "reproduce the fault on-chip"
+            )
         Lc = _lane_chunk(L) if tiled else L
         if tiled and Lc <= reach:
             raise ValueError(
@@ -849,6 +863,15 @@ def hashgrid_supported(
     L = g * max_per_cell
     if _VMEM_ROWS[R] * L * 4 <= _VMEM_BUDGET:
         return True                      # 1-D kernel fits
+    if R == 2:
+        # The lane-tiled R=2 kernel hits a known, unresolved
+        # scale-dependent device fault (module header; ADVICE r5) —
+        # a half-cell config whose row exceeds the 1-D VMEM budget
+        # must NOT auto-dispatch onto it.  Callers get the portable
+        # fallback; the explicit ``lane_chunk`` argument to
+        # ``separation_hashgrid_pallas`` remains the on-chip repro
+        # hook until the fault is root-caused.
+        return False
     # Lane-tiled kernel (r4b): needs a chunk wider than the shift
     # reach and sane HBM planes.
     return (

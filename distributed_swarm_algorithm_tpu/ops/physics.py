@@ -97,7 +97,23 @@ def formation_targets(state: SwarmState, cfg: SwarmConfig) -> SwarmState:
     return state.replace(target=target, has_target=has_target)
 
 
-def tick_uses_hashgrid_kernel(cfg: SwarmConfig, dim: int, dtype) -> bool:
+def _committed_multidevice(x) -> bool:
+    """Best-effort: True when ``x`` is a concrete array committed
+    across more than one device (a GSPMD-sharded or multi-device-
+    replicated swarm).  Tracers inside jit expose no usable sharding
+    — they return False, so the guard protects the eager dispatch
+    boundary (where the rollout drivers make the path choice) and
+    cannot mis-fire under trace."""
+    try:
+        sharding = x.sharding
+        return len(sharding.device_set) > 1
+    except Exception:
+        return False
+
+
+def tick_uses_hashgrid_kernel(
+    cfg: SwarmConfig, dim: int, dtype, arr=None
+) -> bool:
     """THE separation backend predicate for ``separation_mode=
     'hashgrid'`` (single source of truth for which path
     ``apf_forces`` executes; tests and benches consult it rather than
@@ -105,14 +121,58 @@ def tick_uses_hashgrid_kernel(cfg: SwarmConfig, dim: int, dtype) -> bool:
     and on ``"pallas"`` outside the kernel envelope — the shared
     rules live in ops/pallas/grid_separation.py:
     hashgrid_backend_choice (one predicate for this and the boids
-    gridmean twin)."""
+    gridmean twin).
+
+    ``arr`` (r6, ADVICE r5): pass the position array so sharded /
+    committed multi-device swarms are detected — the fused kernel is
+    a single-device program, so under ``hashgrid_backend='auto'``
+    such inputs fall back to the portable path instead of silently
+    selecting the kernel, and a forced ``'pallas'`` raises a clear
+    error rather than relying on the config-comment contract.
+    Detection is best-effort: inside jit the array is a tracer with
+    no sharding and the static config choice stands (document your
+    mesh with 'portable' there, as before)."""
     from .pallas.grid_separation import hashgrid_backend_choice
 
-    return hashgrid_backend_choice(
+    use = hashgrid_backend_choice(
         cfg.hashgrid_backend, dim, dtype, cfg.world_hw,
         cfg.grid_cell, cfg.grid_max_per_cell, cfg.personal_space,
         knob="hashgrid_backend",
     )
+    if use and arr is not None and _committed_multidevice(arr):
+        if cfg.hashgrid_backend == "pallas":
+            raise ValueError(
+                "hashgrid_backend='pallas' but the swarm state is "
+                "committed across multiple devices — the fused "
+                "hash-grid kernel is a single-device program; use "
+                "hashgrid_backend='portable' for GSPMD/multi-device "
+                "meshes (a shard_map tick driver is future work)"
+            )
+        return False
+    return use
+
+
+def tick_field_enabled(cfg: SwarmConfig) -> bool:
+    """True when the tick adds the commensurate CIC alignment/
+    cohesion field forces (``k_align``/``k_coh``) — the path-
+    selection predicate twin of ``tick_uses_hashgrid_kernel``.
+    Validates the field's geometry requirements eagerly so
+    misconfiguration fails at dispatch, not mid-trace."""
+    if cfg.k_align == 0.0 and cfg.k_coh == 0.0:
+        return False
+    if cfg.world_hw <= 0:
+        raise ValueError(
+            "k_align/k_coh need world_hw > 0 (the torus the "
+            "alignment field tiles); set it in SwarmConfig"
+        )
+    from .grid_moments import align_cell_arg, commensurate_geometry
+
+    # Raises with the commensurability story when align_cell does not
+    # resolve to an even multiple of the effective grid_cell.
+    commensurate_geometry(
+        cfg.world_hw, cfg.grid_cell, align_cell_arg(cfg.align_cell)
+    )
+    return True
 
 
 def apf_forces(
@@ -225,7 +285,9 @@ def apf_forces(
                 "separation_mode='hashgrid' is 2-D only (the cell "
                 f"grid tiles a 2-D torus); got dim={pos.shape[1]}"
             )
-        if tick_uses_hashgrid_kernel(cfg, pos.shape[1], pos.dtype):
+        if tick_uses_hashgrid_kernel(
+            cfg, pos.shape[1], pos.dtype, arr=pos
+        ):
             from ..utils.platform import on_tpu
             from .pallas.grid_separation import (
                 separation_hashgrid_pallas,
@@ -259,7 +321,31 @@ def apf_forces(
             "'hashgrid', or 'off'"
         )
 
-    return f_att + f_rep + f_sep
+    # 4. Velocity-alignment / cohesion field (r6, beyond-parity):
+    #    neighborhood mean-velocity matching and centroid attraction
+    #    from the commensurate moments-deposit CIC field — one
+    #    16-channel cell reduction + dense block algebra instead of
+    #    per-agent corner scatters (ops/grid_moments.py).  Dead
+    #    agents neither deposit nor feel the field.
+    if tick_field_enabled(cfg):
+        if pos.shape[1] != 2:
+            raise ValueError(
+                "k_align/k_coh field forces are 2-D only (the field "
+                f"tiles a 2-D torus); got dim={pos.shape[1]}"
+            )
+        from .grid_moments import align_cell_arg, cic_field_commensurate
+
+        align, coh = cic_field_commensurate(
+            pos, state.vel, state.alive,
+            torus_hw=float(cfg.world_hw),
+            sep_cell=float(cfg.grid_cell),
+            align_cell=align_cell_arg(cfg.align_cell),
+        )
+        f_field = cfg.k_align * align + cfg.k_coh * coh
+    else:
+        f_field = jnp.zeros_like(pos)
+
+    return f_att + f_rep + f_sep + f_field
 
 
 def integrate(

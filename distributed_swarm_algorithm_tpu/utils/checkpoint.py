@@ -92,9 +92,17 @@ def restore(path: str, target: T, strict: bool = True) -> T:
     position (``[0]``, ``[1]`` — keystr has nothing better), so an
     element inserted mid-tuple shifts keys exactly like schema v1 and
     the missing/extra analysis would misalign silently.
-    ``strict=False`` therefore REJECTS targets whose leaf paths
-    contain positional components (r5, advisor finding); strict
-    restores of unchanged tuple structures remain fine.
+    ``strict=False`` therefore rejects growth-tolerant restores when
+    the MISMATCH ITSELF touches a positionally-keyed subtree the
+    checkpoint knows about — a missing leaf whose path contains a
+    positional component AND whose container holds saved keys (r5
+    advisor finding, narrowed in r6 per ADVICE: growth purely in
+    named fields restores fine even when an UNAFFECTED tuple subtree
+    exists elsewhere in the target, since that subtree's keys are all
+    present and unshifted; a wholly-NEW tuple-valued field is plain
+    growth — the checkpoint holds nothing under it to misalign).
+    Strict restores of unchanged tuple structures remain fine either
+    way.
     """
     if _HAVE_ORBAX and not path.endswith(".npz"):
         ckptr = ocp.PyTreeCheckpointer()
@@ -120,23 +128,44 @@ def restore(path: str, target: T, strict: bool = True) -> T:
                 # Growth detection is about to fire — it is only
                 # sound for named-field paths (see docstring).  An
                 # exact-match restore (missing empty) never exercises
-                # it, so tuple-containing targets stay restorable.
+                # it, and (r6, ADVICE r5) an UNAFFECTED tuple subtree
+                # is harmless: all its keys are present and unshifted,
+                # so only a mismatch that itself touches a
+                # positionally-keyed path can misalign.
                 import re
 
+                def _shifted(n):
+                    # Dangerous only when the SAVED checkpoint also
+                    # holds keys under the same container as the
+                    # first POSITIONAL component (keystr writes dict
+                    # keys as ['name'] — anchor on [<digits>], not on
+                    # any bracket) — then an insertion may have
+                    # shifted them.  A wholly-new container (no saved
+                    # key shares its '[' prefix) is plain growth:
+                    # nothing existed to misalign.
+                    m = re.search(r"\[\d+\]", n)
+                    if m is None:
+                        return False
+                    pre = "f:" + n[: m.start() + 1]
+                    return any(
+                        k.startswith(pre) for k in data.files
+                    )
+
                 positional = sorted(
-                    {n for n, _ in named if re.search(r"\[\d+\]", n)}
+                    n for n in missing if _shifted(n)
                 )
                 if positional:
                     raise ValueError(
                         "strict=False growth-tolerant restore needs "
-                        "named-field pytree paths, but the target has "
-                        f"positionally-keyed leaves {positional[:4]}"
+                        "named-field pytree paths, but the missing "
+                        f"leaves include positionally-keyed paths "
+                        f"{positional[:4]}"
                         f"{'...' if len(positional) > 4 else ''} "
                         "(tuple/list nodes) — an element inserted "
                         "mid-container shifts these keys like schema "
                         "v1, so growth detection cannot be trusted; "
                         "restore with strict=True or restructure the "
-                        "state as named fields"
+                        "grown state as named fields"
                     )
             extra = [
                 k[2:] for k in data.files

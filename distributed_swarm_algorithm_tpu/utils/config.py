@@ -53,6 +53,24 @@ class SwarmConfig:
     rho0: float = 5.0                   # obstacle influence radius (agent.py:129)
     k_sep: float = 20.0                 # neighbor separation gain (agent.py:149)
     personal_space: float = 2.0         # separation radius (agent.py:153)
+    # Velocity-alignment / cohesion field forces (r6, beyond-parity —
+    # the reference has neither): when either gain is nonzero the
+    # tick adds k_align * (neighborhood mean velocity - vel) and
+    # k_coh * (neighborhood centroid - pos) from the COMMENSURATE
+    # moments-deposit CIC field (ops/grid_moments.py): the alignment
+    # grid is locked to the hashgrid separation geometry (cell_a an
+    # even integer multiple of the effective grid_cell, canonically
+    # 4x), the deposit is one 16-channel cell reduction instead of
+    # four per-agent corner scatters, and the identical portable
+    # algebra runs on CPU and TPU.  Requires world_hw > 0 and
+    # dim == 2 (the field tiles the torus).  Dead agents neither
+    # deposit nor feel the field.
+    k_align: float = 0.0                # 0 = alignment force off
+    k_coh: float = 0.0                  # 0 = cohesion force off
+    align_cell: float = 0.0             # field cell; <= 0 derives the
+    #   canonical commensurate cell_a = 4 * cell_sep_eff; explicit
+    #   values must resolve to a commensurate grid (even integer
+    #   number of sep cells per field cell) or the tick raises.
     dist_eps: float = 1e-3              # distance clamp (agent.py:135,154);
     #   unlike the reference, the clamp is applied to *every* norm, fixing the
     #   ZeroDivisionError for co-located agents (SURVEY.md §5a bug 1).

@@ -1,0 +1,335 @@
+"""Commensurate moments-deposit CIC field (ops/grid_moments.py).
+
+The r6 tentpole: the moments form must equal the four-corner bilinear
+CIC scatter/gather on the SAME commensurate alignment grid — the same
+per-agent terms summed in a different association order, so parity is
+fp-tolerance, not bitwise.  Oracles: the in-module
+``cic_field_corner_reference`` (the scatter form the moments path
+replaces) and the gridmean boids bilinear branch at matched geometry.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu.ops import boids as bk
+from distributed_swarm_algorithm_tpu.ops.grid_moments import (
+    cic_field_commensurate,
+    cic_field_corner_reference,
+    commensurate_geometry,
+    moments_deposit,
+)
+
+HW = 32.0
+
+
+def _flock(n, seed=0, hw=HW, vscale=3.0):
+    kp, kv = jax.random.split(jax.random.PRNGKey(seed))
+    pos = jax.random.uniform(kp, (n, 2), jnp.float32, -hw, hw)
+    vel = vscale * jax.random.normal(kv, (n, 2), jnp.float32)
+    return pos, vel
+
+
+def _assert_field_match(got, want):
+    scale = max(float(jnp.abs(want).max()), 1.0)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4,
+        atol=2e-5 * scale,
+    )
+
+
+def test_geometry_canonical():
+    """align_cell=None derives cell_a = 4*cell_sep on the kernel's
+    16-aligned fine grid."""
+    g, cf, ga, ca, q = commensurate_geometry(HW, 2.0)
+    assert (g, ga, q) == (32, 8, 4)
+    assert cf == pytest.approx(2.0) and ca == pytest.approx(8.0)
+
+
+@pytest.mark.parametrize(
+    "sep_cell,align_cell",
+    [
+        (2.0, None),     # canonical Q=4
+        (2.0, 8.0),      # explicit, same grid
+        (2.0, 4.0),      # Q=2
+        (1.0, 8.0),      # half-cell sep, Q=8
+    ],
+)
+def test_moments_matches_corner_reference(sep_cell, align_cell):
+    """Moments deposit+sample == corner scatter/gather CIC on the same
+    commensurate grid, random swarm, alive mask in play."""
+    pos, vel = _flock(4096, seed=3)
+    alive = jnp.arange(4096) % 97 != 0
+    a_m, c_m = cic_field_commensurate(
+        pos, vel, alive, torus_hw=HW, sep_cell=sep_cell,
+        align_cell=align_cell,
+    )
+    a_r, c_r = cic_field_corner_reference(
+        pos, vel, alive, HW, sep_cell, align_cell
+    )
+    _assert_field_match(a_m, a_r)
+    _assert_field_match(c_m, c_r)
+    # Dead agents feel nothing on either path.
+    assert float(jnp.abs(a_m[~alive]).max()) == 0.0
+    assert float(jnp.abs(c_m[~alive]).max()) == 0.0
+
+
+def test_moments_matches_corner_on_cell_boundaries():
+    """Adversarial configuration: agents exactly ON fine-cell lines,
+    CIC corner lines, the torus seam, and cell centers — the floor
+    breakpoints where the i0 derivation must agree with the corner
+    path's own floor (bilinear weights are continuous across the
+    lines, so fp disagreement there stays O(ulp))."""
+    grid_pts = []
+    for x in (-HW, -HW + 2.0, -4.0, 0.0, 1.0, 2.0, 7.0, HW - 2.0,
+              HW - 1.0):
+        for y in (-HW, -2.0, 0.0, 2.0, 3.0, HW - 2.0):
+            grid_pts.append([x, y])
+    pos = jnp.asarray(grid_pts, jnp.float32)
+    vel = jax.random.normal(
+        jax.random.PRNGKey(7), pos.shape, jnp.float32
+    )
+    a_m, c_m = cic_field_commensurate(
+        pos, vel, None, torus_hw=HW, sep_cell=2.0
+    )
+    a_r, c_r = cic_field_corner_reference(pos, vel, None, HW, 2.0)
+    _assert_field_match(a_m, a_r)
+    _assert_field_match(c_m, c_r)
+
+
+def test_moments_matches_corner_for_escaped_agents():
+    """Agents OUTSIDE [-hw, hw) (the physics integrator never wraps
+    pos onto the torus): the corner CIC form is exactly periodic in
+    pos, so the moments path must wrap before binning — the clipping
+    fine-cell tables would otherwise leave x~ unbounded and poison
+    the edge cells' higher moments for every sampler."""
+    pos, vel = _flock(1024, seed=13)
+    # Push a band of agents well outside the box on both axes, plus
+    # exact-boundary stragglers at +-hw.
+    pos = pos.at[:64, 0].add(2.0 * HW + 17.0)
+    pos = pos.at[64:128, 1].add(-(4.0 * HW + 3.0))
+    pos = pos.at[128, :].set(jnp.asarray([HW, -HW], jnp.float32))
+    a_m, c_m = cic_field_commensurate(
+        pos, vel, None, torus_hw=HW, sep_cell=2.0
+    )
+    a_r, c_r = cic_field_corner_reference(pos, vel, None, HW, 2.0)
+    _assert_field_match(a_m, a_r)
+    _assert_field_match(c_m, c_r)
+
+
+def test_lone_boid_is_force_free():
+    """A boid alone in its pooled patch must feel ~zero align AND
+    ~zero cohesion (the corner self-cancellation survives the moments
+    reassociation to fp tolerance) — matching dense's no-neighbor
+    case."""
+    pos = jnp.asarray([[5.3, -11.7]], jnp.float32)
+    vel = jnp.asarray([[2.0, 1.0]], jnp.float32)
+    align, coh = cic_field_commensurate(
+        pos, vel, None, torus_hw=HW, sep_cell=2.0
+    )
+    assert float(jnp.abs(align).max()) < 1e-4
+    assert float(jnp.abs(coh).max()) < 1e-4
+
+
+def test_deposit_conserves_mass_and_momentum():
+    """The alignment grid's total count equals the live-agent count
+    and its velocity sums equal the flock's (bilinear weights sum to
+    1 per agent; the block algebra must not lose or double-count a
+    corner, including across the torus seam)."""
+    pos, vel = _flock(2048, seed=11)
+    alive = jnp.arange(2048) % 5 != 0
+    grid = moments_deposit(
+        pos, vel, alive, torus_hw=HW, sep_cell=2.0
+    )
+    n_live = float(jnp.sum(alive))
+    assert float(jnp.sum(grid[:, :, 4])) == pytest.approx(
+        n_live, rel=1e-5
+    )
+    vsum = jnp.sum(jnp.where(alive[:, None], vel, 0.0), axis=0)
+    np.testing.assert_allclose(
+        np.asarray(jnp.sum(grid[:, :, 0:2], axis=(0, 1))),
+        np.asarray(vsum), rtol=1e-4, atol=1e-3,
+    )
+
+
+def test_commensurability_validation():
+    """cell_a not an even integer multiple of the effective sep cell
+    -> clear error, naming the canonical 4x choice."""
+    with pytest.raises(ValueError, match="commensurate"):
+        commensurate_geometry(HW, 2.0, align_cell=7.0)
+    # odd ratio (g=48 fine cells, 16 align cells -> Q=3)
+    with pytest.raises(ValueError, match="(?i)even"):
+        commensurate_geometry(24.0, 1.0, align_cell=3.0)
+    # world too small for the 16-aligned fine grid
+    with pytest.raises(ValueError, match="16"):
+        commensurate_geometry(6.0, 2.0)
+
+
+# --- gridmean boids integration ----------------------------------------
+
+
+def test_boids_gridmean_moments_matches_bilinear():
+    """boids_forces_gridmean under align_deposit='moments' equals the
+    'bilinear' branch when the bilinear grid is already commensurate
+    (hw=32, r_sep=2, align_cell=8: both paths tile 8x8 alignment
+    cells over a 32-cell fine grid)."""
+    n = 2048
+    kp, kv = jax.random.split(jax.random.PRNGKey(5))
+    p_bil = bk.BoidsParams(half_width=HW, align_cell=8.0)
+    state = bk.boids_init(n, 2, params=p_bil, seed=2)
+    state = state.replace(
+        vel=3.0 * jax.random.normal(kv, (n, 2), jnp.float32)
+    )
+    p_mom = bk.BoidsParams(
+        half_width=HW, align_cell=8.0, align_deposit="moments"
+    )
+    f_bil = bk.boids_forces_gridmean(state, p_bil)
+    f_mom = bk.boids_forces_gridmean(state, p_mom)
+    scale = float(jnp.abs(f_bil).max())
+    np.testing.assert_allclose(
+        np.asarray(f_mom), np.asarray(f_bil), rtol=2e-4,
+        atol=2e-5 * scale,
+    )
+
+
+def test_boids_gridmean_moments_step_runs_and_orders():
+    """A short gridmean run in moments mode stays finite and does not
+    disorder an aligned flock (smoke for the scan path)."""
+    p = bk.BoidsParams(
+        half_width=HW, align_cell=0.0, align_deposit="moments"
+    )
+    state = bk.boids_init(512, 2, params=p, seed=0)
+    state = state.replace(
+        vel=jnp.tile(jnp.asarray([[2.0, 0.5]], jnp.float32), (512, 1))
+    )
+    out, _ = bk.boids_run(state, p, 20, neighbor_mode="gridmean")
+    assert bool(jnp.isfinite(out.pos).all())
+    # Smoke bar, not a quality bar: a uniformly-seeded 512 flock holds
+    # most of its initial alignment over 20 steps (separation kicks
+    # cost a few points; the bilinear path lands at the same value).
+    assert float(bk.polarization(out)) > 0.8
+
+
+def test_boids_gridmean_moments_incommensurate_raises():
+    p = bk.BoidsParams(
+        half_width=HW, align_cell=7.0, align_deposit="moments"
+    )
+    state = bk.boids_init(64, 2, params=p, seed=0)
+    with pytest.raises(ValueError, match="commensurate"):
+        bk.boids_forces_gridmean(state, p)
+
+
+# --- physics (APF) integration -----------------------------------------
+
+
+def _field_swarm(n=512, seed=4, spread=28.0):
+    s = dsa.make_swarm(n, seed=seed, spread=spread)
+    kv = jax.random.PRNGKey(seed + 100)
+    return s.replace(
+        vel=2.0 * jax.random.normal(kv, s.vel.shape, s.vel.dtype)
+    )
+
+
+def test_physics_alignment_field_matches_reference():
+    """apf_forces with k_align/k_coh and everything else off equals
+    the corner-reference field scaled by the gains — dead agents
+    excluded on both sides."""
+    from distributed_swarm_algorithm_tpu.ops.coordination import kill
+
+    cfg = dsa.SwarmConfig().replace(
+        separation_mode="off", world_hw=HW,
+        k_align=0.7, k_coh=0.3,
+    )
+    s = kill(_field_swarm(), [3, 77, 200])
+    f = dsa.apf_forces(s, None, cfg)
+    a_r, c_r = cic_field_corner_reference(
+        s.pos, s.vel, s.alive, HW, cfg.grid_cell
+    )
+    want = 0.7 * a_r + 0.3 * c_r
+    scale = max(float(jnp.abs(want).max()), 1.0)
+    np.testing.assert_allclose(
+        np.asarray(f), np.asarray(want), rtol=2e-4,
+        atol=2e-5 * scale,
+    )
+    assert float(jnp.abs(f[jnp.asarray([3, 77, 200])]).max()) == 0.0
+
+
+def test_physics_alignment_steers_toward_neighbor_velocity():
+    """Velocity-matching semantics: two nearby agents with opposed
+    velocities each get a command component toward the local mean
+    (i.e. toward the OTHER agent's heading), and an isolated agent
+    gets ~none — force == velocity command in this model, so the
+    behavioral contract is the command's direction."""
+    cfg = dsa.SwarmConfig().replace(
+        separation_mode="off", world_hw=HW, k_align=1.0,
+    )
+    s = dsa.make_swarm(3, seed=0)
+    s = s.replace(
+        pos=jnp.asarray(
+            [[0.3, 0.3], [0.9, 0.3], [20.0, -20.0]], jnp.float32
+        ),
+        vel=jnp.asarray(
+            [[3.0, 0.0], [-3.0, 0.0], [2.0, 2.0]], jnp.float32
+        ),
+    )
+    f = dsa.apf_forces(s, None, cfg)
+    assert float(f[0, 0]) < -0.5     # pulled toward the -x neighbor
+    assert float(f[1, 0]) > 0.5      # and vice versa
+    assert float(jnp.abs(f[2]).max()) < 1e-3   # lone agent: no field
+
+
+def test_physics_field_validation():
+    from distributed_swarm_algorithm_tpu.ops.physics import (
+        tick_field_enabled,
+    )
+
+    cfg = dsa.SwarmConfig()
+    assert not tick_field_enabled(cfg)
+    with pytest.raises(ValueError, match="world_hw"):
+        tick_field_enabled(cfg.replace(k_align=1.0))
+    with pytest.raises(ValueError, match="commensurate"):
+        tick_field_enabled(
+            cfg.replace(k_align=1.0, world_hw=HW, align_cell=7.0)
+        )
+    assert tick_field_enabled(
+        cfg.replace(k_align=1.0, world_hw=HW)
+    )
+
+
+def test_physics_hashgrid_multidevice_fallback():
+    """r6 (ADVICE r5): a swarm committed across multiple devices must
+    not auto-select the single-device fused kernel — 'auto' falls
+    back to portable, forced 'pallas' raises a clear error.  Uses the
+    8 forced CPU host devices (tests/conftest.py)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from distributed_swarm_algorithm_tpu.ops.physics import (
+        tick_uses_hashgrid_kernel,
+    )
+
+    mesh = jax.make_mesh((jax.device_count(),), ("i",))
+    pos = jax.device_put(
+        jnp.zeros((8 * jax.device_count(), 2), jnp.float32),
+        NamedSharding(mesh, PartitionSpec("i", None)),
+    )
+    cfg = dsa.SwarmConfig().replace(
+        separation_mode="hashgrid", world_hw=HW,
+        grid_max_per_cell=16,
+    )
+    # Forced kernel + multi-device commitment: clear error.
+    with pytest.raises(ValueError, match="single-device"):
+        tick_uses_hashgrid_kernel(
+            cfg.replace(hashgrid_backend="pallas"),
+            2, jnp.float32, arr=pos,
+        )
+    # 'auto' with the same input: portable fallback, no error.
+    assert not tick_uses_hashgrid_kernel(
+        cfg, 2, jnp.float32, arr=pos
+    )
+    # Single-device arrays keep the forced-kernel choice.
+    assert tick_uses_hashgrid_kernel(
+        cfg.replace(hashgrid_backend="pallas"), 2, jnp.float32,
+        arr=jnp.zeros((64, 2), jnp.float32),
+    )

@@ -253,3 +253,50 @@ def test_checkpoint_strict_false_rejects_positional_paths(tmp_path):
                                "b": jnp.zeros((1,))})
     with pytest.raises(ValueError, match="positional"):
         ck.restore(p, grown, strict=False)
+
+
+def test_checkpoint_strict_false_allows_unaffected_tuple(tmp_path):
+    """r6 (ADVICE r5, narrowing): growth purely in NAMED fields must
+    restore with strict=False even when the target also holds a
+    tuple subtree — that subtree's keys are all present and
+    unshifted, so no misalignment is possible.  Only a mismatch that
+    itself touches a positionally-keyed path is rejected."""
+    import numpy as _np
+
+    from distributed_swarm_algorithm_tpu.utils import checkpoint as ck
+
+    tree = {"t": (jnp.zeros((3,)), jnp.ones((2,))),
+            "a": jnp.full((2,), 2.0)}
+    p = str(tmp_path / "mix.npz")
+    ck.save(p, tree)
+    # Named growth, tuple untouched: allowed; target value kept for
+    # the new field, tuple leaves restored.
+    grown = {"t": (jnp.ones((3,)), jnp.zeros((2,))),
+             "a": jnp.zeros((2,)), "b": jnp.full((4,), 9.0)}
+    got = ck.restore(p, grown, strict=False)
+    _np.testing.assert_array_equal(_np.asarray(got["t"][0]),
+                                   _np.zeros((3,)))
+    _np.testing.assert_array_equal(_np.asarray(got["a"]),
+                                   _np.full((2,), 2.0))
+    _np.testing.assert_array_equal(_np.asarray(got["b"]),
+                                   _np.full((4,), 9.0))
+    # A wholly-NEW tuple-valued named field is plain growth: the
+    # checkpoint holds nothing under it to misalign, so it restores
+    # (keeping the target's values for the new subtree).
+    grown_new_tup = {"t": (jnp.ones((3,)), jnp.zeros((2,))),
+                     "a": jnp.zeros((2,)),
+                     "extras": (jnp.full((2,), 7.0),)}
+    got2 = ck.restore(p, grown_new_tup, strict=False)
+    _np.testing.assert_array_equal(_np.asarray(got2["extras"][0]),
+                                   _np.full((2,), 7.0))
+    _np.testing.assert_array_equal(_np.asarray(got2["a"]),
+                                   _np.full((2,), 2.0))
+    # Growth INSIDE the tuple (a new trailing element): still
+    # rejected — the mismatch touches positional keys the checkpoint
+    # knows about (trailing append is indistinguishable from a
+    # mid-tuple insertion by key set alone).
+    grown_tup = {"t": (jnp.zeros((3,)), jnp.ones((2,)),
+                       jnp.zeros((1,))),
+                 "a": jnp.zeros((2,))}
+    with pytest.raises(ValueError, match="positional"):
+        ck.restore(p, grown_tup, strict=False)

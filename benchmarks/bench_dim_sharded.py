@@ -39,7 +39,9 @@ def main() -> None:
         lambda: 0.0,
     )
     report(
-        f"agent-steps/sec, PSO Rastrigin-{DIM}D, {N} particles, "
+        # Literal config pin (swarmlint metric-fstring): matches the
+        # N=2048 / DIM=4096 constants above.
+        "agent-steps/sec, PSO Rastrigin-4096D, 2048 particles, "
         "portable jit",
         N * STEPS / best,
         "agent-steps/sec",
@@ -57,7 +59,7 @@ def main() -> None:
         lambda: 0.0,
     )
     report(
-        f"agent-steps/sec, PSO Rastrigin-{DIM}D, {N} particles, "
+        "agent-steps/sec, PSO Rastrigin-4096D, 2048 particles, "
         "dim-sharded shard_map (1-device mesh)",
         N * STEPS / best,
         "agent-steps/sec",

@@ -70,6 +70,7 @@ def child_main(p_programs: int, flavor: str, trigger_reps: int) -> None:
         else:  # alloc: big live-buffer churn, no gather chains
             n = 1_048_576 + 4096 * i
             x = jnp.arange(n, dtype=jnp.float32)
+            # swarmlint: disable=retrace -- deliberate: the bisect reproduces the XLA executable-accumulation crash by compiling a fresh program per iteration
             y = jax.jit(lambda v: jnp.sort(v * 1.0001) + v[::-1])(x)
             jax.block_until_ready(y)
         print(f"  heavy[{i}] {flavor} n={n} ok", flush=True)

@@ -15,8 +15,9 @@ bench script), so they are compared verbatim after normalizing
 embedded measurement floats (NSGA-II's "HV 0.875" etc.) to '#'.
 Metrics present in only one round are listed informationally and do
 not gate.  Exit code 1 iff at least one regression exceeds the
-threshold (higher-is-better metrics only; every recorded metric is a
-throughput).
+threshold.  Recorded metrics are throughputs (higher is better) with
+one exception: unit "findings" (the swarmlint hazard count from
+run_all's static gate) is lower-is-better and gates on growth.
 """
 
 from __future__ import annotations
@@ -123,6 +124,19 @@ def compare(prev_label: str, cur_label: str, threshold: float = 0.2,
     for key in sorted(set(prev) & set(cur)):
         pv = float(prev[key][1]["value"])
         cv = float(cur[key][1]["value"])
+        if str(cur[key][1].get("unit", "")) == "findings":
+            # Lower-is-better count metric (swarmlint hygiene debt):
+            # gate on growth, never on paydown.  A clean baseline
+            # (0) regressing to any positive count always gates.
+            status = "ok"
+            if cv > pv * (1.0 + threshold) or (pv == 0 and cv > 0):
+                status = "REGRESSION"
+                regressions.append((key, pv, cv, cv / max(pv, 1.0)))
+            elif cv < pv:
+                status = "improved"
+            print(f"{status:>10}  {cv:6.0f}   {cur[key][0]}"
+                  f"  (count {pv:.0f} -> {cv:.0f})")
+            continue
         if pv <= 0:
             continue
         ratio = cv / pv

@@ -126,6 +126,50 @@ def _run_one(cmd, cwd, recorded, record: bool) -> bool:
     return True
 
 
+def _run_swarmlint(root, recorded, record: bool) -> bool:
+    """Static-hazard gate as a metric: one fixed-name
+    ``swarmlint-findings`` line (new + baselined count) so the union
+    gate tracks hygiene-debt regressions across rounds the same way it
+    tracks throughput.  compare.py treats unit "findings" as
+    lower-is-better.  Returns False when the analyzer reports new
+    (non-baselined) findings or fails to run."""
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable, "-m",
+                "distributed_swarm_algorithm_tpu.analysis", "--json",
+            ],
+            capture_output=True, text=True, timeout=300, cwd=root,
+        )
+    except subprocess.TimeoutExpired:
+        print("# swarmlint timed out", file=sys.stderr)
+        return False
+    try:
+        counts = json.loads(proc.stdout)["counts"]
+    except (json.JSONDecodeError, KeyError, TypeError):
+        tail = (proc.stderr.strip().splitlines()[-1]
+                if proc.stderr.strip() else "no stderr")
+        print(f"# swarmlint produced no JSON summary: {tail}",
+              file=sys.stderr)
+        return False
+    line = {
+        "metric": "swarmlint-findings",
+        "value": float(counts["total"]),
+        "unit": "findings",
+        "vs_baseline": None,
+    }
+    print(json.dumps(line), flush=True)
+    if record:
+        recorded.append(line)
+    if proc.returncode != 0:
+        print(
+            f"# swarmlint: {counts['new']} new finding(s) — run "
+            "`python -m distributed_swarm_algorithm_tpu.analysis`",
+            file=sys.stderr,
+        )
+    return proc.returncode == 0
+
+
 def main() -> int:
     import argparse
 
@@ -139,6 +183,10 @@ def main() -> int:
     root = os.path.dirname(HERE)
     failures = 0
     recorded: list = []
+    # Cheapest gate first (pure AST, no jax): hazard count + contract
+    # check before any bench spends device time.
+    failures += 0 if _run_swarmlint(root, recorded,
+                                    bool(args.record)) else 1
     if args.tests:
         # Full gate = TWO pytest processes (default set, then the slow
         # set).  XLA's CPU backend_compile_and_load segfaults after

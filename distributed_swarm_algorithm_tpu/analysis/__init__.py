@@ -1,0 +1,66 @@
+"""swarmlint — AST-based hazard analyzer for this repo's JAX/Pallas code.
+
+Run it::
+
+    python -m distributed_swarm_algorithm_tpu.analysis            # text
+    python -m distributed_swarm_algorithm_tpu.analysis --json     # machine
+
+It parses (never imports) every .py file under the default scan set
+(the package, benchmarks/, examples/, bench.py) and checks the hazard
+classes that have actually bitten this repo on TPU: PRNG key reuse,
+host syncs and Python branches inside traced code, per-call re-jit,
+dtype drift in ops/ hot paths, the fused-kernel dispatch contract,
+and bench metric-name hygiene.  See docs/STATIC_ANALYSIS.md for the
+rule catalog, the suppression policy, and how to add a rule.
+
+Importing this package registers the built-in rules (import order is
+display order).
+"""
+
+from __future__ import annotations
+
+from . import baseline  # noqa: F401
+from .core import (  # noqa: F401
+    BAD_SUPPRESS,
+    Finding,
+    ModuleInfo,
+    REGISTRY,
+    Rule,
+    Suppression,
+    analyze_module,
+    analyze_paths,
+    iter_py_files,
+    parse_suppressions,
+    register,
+)
+
+# Importing the rule modules populates REGISTRY.
+from . import rules_prng    # noqa: E402,F401
+from . import rules_trace   # noqa: E402,F401
+from . import rules_dtype   # noqa: E402,F401
+from . import rules_contract  # noqa: E402,F401
+
+#: What `python -m distributed_swarm_algorithm_tpu.analysis` scans
+#: when given no paths (repo-relative).
+DEFAULT_PATHS = (
+    "distributed_swarm_algorithm_tpu",
+    "benchmarks",
+    "examples",
+    "bench.py",
+)
+
+__all__ = [
+    "BAD_SUPPRESS",
+    "DEFAULT_PATHS",
+    "Finding",
+    "ModuleInfo",
+    "REGISTRY",
+    "Rule",
+    "Suppression",
+    "analyze_module",
+    "analyze_paths",
+    "baseline",
+    "iter_py_files",
+    "parse_suppressions",
+    "register",
+]

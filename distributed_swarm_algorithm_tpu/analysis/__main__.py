@@ -1,0 +1,160 @@
+"""swarmlint CLI.
+
+Exit codes: 0 clean (every finding fixed, suppressed with a
+justification, or baselined — and no stale baseline entries), 1 new
+findings / stale entries / malformed baseline, 2 usage error (e.g. a
+nonexistent scan path).  ``--json`` prints one machine-readable
+summary object — the shape ``benchmarks/run_all.py`` turns into the
+fixed-name ``swarmlint-findings`` metric.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import (
+    DEFAULT_PATHS,
+    REGISTRY,
+    analyze_paths,
+    baseline,
+    iter_py_files,
+)
+
+#: Repo root = three levels up from this file (package/analysis/__main__).
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m distributed_swarm_algorithm_tpu.analysis",
+        description=__doc__,
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        help=f"files/dirs to scan (default: {', '.join(DEFAULT_PATHS)})",
+    )
+    ap.add_argument("--root", default=REPO_ROOT,
+                    help="repo root paths are relative to")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable summary on stdout")
+    ap.add_argument(
+        "--baseline",
+        default=None,
+        help="baseline file (default <root>/"
+             f"{baseline.DEFAULT_BASENAME})",
+    )
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report every finding")
+    ap.add_argument(
+        "--write-baseline", action="store_true",
+        help="write all current new findings to the baseline file "
+             "with TODO justifications (then edit them in)",
+    )
+    ap.add_argument("--list-rules", action="store_true")
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule in REGISTRY.values():
+            print(f"{rule.id:16} {rule.summary}")
+        return 0
+
+    root = os.path.abspath(args.root)
+    paths = list(args.paths) or [
+        p for p in DEFAULT_PATHS
+        if os.path.exists(os.path.join(root, p))
+    ]
+    baseline_path = args.baseline or os.path.join(
+        root, baseline.DEFAULT_BASENAME
+    )
+
+    try:
+        findings, suppressed, errors = analyze_paths(root, paths)
+        scanned = set(iter_py_files(root, paths))
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    entries = []
+    if not args.no_baseline:
+        try:
+            entries = baseline.load(baseline_path)
+        except baseline.BaselineError as e:
+            print(f"swarmlint: {e}", file=sys.stderr)
+            return 1
+    new, baselined, stale = baseline.partition(findings, entries)
+    # On a scoped run (explicit paths), an entry for an unscanned file
+    # is unknown, not stale — only the full default scan can prove
+    # staleness.
+    stale = [e for e in stale if e.path in scanned]
+
+    if args.write_baseline:
+        merged = [e for e in entries if e not in stale] + [
+            baseline.from_finding(
+                f, "TODO(swarmlint): justify or fix"
+            )
+            for f in new
+        ]
+        baseline.save(baseline_path, merged)
+        print(
+            f"swarmlint: wrote {len(merged)} entries to "
+            f"{baseline_path} ({len(new)} new — edit the TODO "
+            "justifications)"
+        )
+        return 0
+
+    summary = {
+        "tool": "swarmlint",
+        "counts": {
+            "new": len(new),
+            "baselined": len(baselined),
+            "suppressed": len(suppressed),
+            "stale_baseline": len(stale),
+            "total": len(new) + len(baselined),
+            "parse_errors": len(errors),
+        },
+        "findings": [
+            dict(f.to_dict(), status="new") for f in new
+        ] + [
+            dict(f.to_dict(), status="baselined") for f in baselined
+        ],
+        "stale_baseline": [e.to_dict() for e in stale],
+        "parse_errors": [
+            {"path": p, "error": m} for p, m in errors
+        ],
+    }
+
+    if args.as_json:
+        print(json.dumps(summary, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        for p, m in errors:
+            print(f"{p}:0: [parse-error] {m}")
+        for e in stale:
+            print(
+                f"# stale baseline entry: [{e.rule}] {e.path} "
+                f"({e.context}) — fixed? remove it from the baseline"
+            )
+        c = summary["counts"]
+        print(
+            f"# swarmlint: {c['new']} new, {c['baselined']} "
+            f"baselined, {c['suppressed']} suppressed, "
+            f"{c['stale_baseline']} stale baseline entr"
+            f"{'y' if c['stale_baseline'] == 1 else 'ies'} "
+            f"({len(REGISTRY)} rules)"
+        )
+    # Stale entries fail too (matching tier-1's baseline-is-tight
+    # test): the ledger must shrink the moment its debt is paid.
+    return 1 if (new or errors or stale) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

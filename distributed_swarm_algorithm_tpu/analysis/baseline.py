@@ -1,0 +1,137 @@
+"""Baseline file: grandfathered findings, each with a justification.
+
+The baseline is the escape hatch that lets the analyzer gate in
+tier-1 from day one without demanding every legacy finding be fixed
+in the same commit — but it is a *ledger*, not a dumping ground:
+every entry carries a one-line justification, and entries that no
+longer match anything are reported as stale so the file shrinks as
+debt is paid.
+
+Format (``swarmlint-baseline.json`` at the repo root)::
+
+    {
+      "entries": [
+        {"rule": "metric-fstring",
+         "path": "benchmarks/decompose_gridmean.py",
+         "context": "main",
+         "snippet": "report(f\"cic-deposit, {tag}\", ...)",
+         "justification": "tag is a fixed config label, ..."}
+      ]
+    }
+
+Matching is by ``Finding.fingerprint()`` — (rule, path, context,
+stripped source line) — so baselines survive unrelated edits that
+shift line numbers, and die (go stale) when the flagged line itself
+changes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+
+DEFAULT_BASENAME = "swarmlint-baseline.json"
+
+
+@dataclass(frozen=True)
+class Entry:
+    rule: str
+    path: str
+    context: str
+    snippet: str
+    justification: str
+
+    def fingerprint(self) -> tuple:
+        return (self.rule, self.path, self.context, self.snippet)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "context": self.context,
+            "snippet": self.snippet,
+            "justification": self.justification,
+        }
+
+
+class BaselineError(ValueError):
+    """Malformed baseline file (bad JSON, missing keys, or an entry
+    with no justification)."""
+
+
+def load(path: str) -> list:
+    """Parse and validate a baseline file; [] if it does not exist."""
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        try:
+            data = json.load(f)
+        except json.JSONDecodeError as e:
+            raise BaselineError(f"{path}: not valid JSON: {e}") from e
+    entries = []
+    for i, raw in enumerate(data.get("entries", [])):
+        missing = [
+            k
+            for k in ("rule", "path", "context", "snippet",
+                      "justification")
+            if k not in raw
+        ]
+        if missing:
+            raise BaselineError(
+                f"{path}: entry {i} missing {missing}"
+            )
+        if not str(raw["justification"]).strip():
+            raise BaselineError(
+                f"{path}: entry {i} ({raw['rule']} at {raw['path']}) "
+                "has an empty justification — baselined findings must "
+                "say why they are exempt"
+            )
+        entries.append(
+            Entry(
+                rule=raw["rule"],
+                path=raw["path"],
+                context=raw["context"],
+                snippet=raw["snippet"],
+                justification=str(raw["justification"]),
+            )
+        )
+    return entries
+
+
+def save(path: str, entries) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(
+            {"entries": [e.to_dict() for e in entries]},
+            f, indent=1, sort_keys=True,
+        )
+        f.write("\n")
+
+
+def from_finding(finding, justification: str) -> Entry:
+    return Entry(
+        rule=finding.rule,
+        path=finding.path,
+        context=finding.context,
+        snippet=finding.snippet,
+        justification=justification,
+    )
+
+
+def partition(findings, entries):
+    """Split ``findings`` into (new, baselined) and return the stale
+    entries.  One entry silences every finding sharing its
+    fingerprint (two identical lines in one function are one hazard
+    class, one justification)."""
+    known = {e.fingerprint(): e for e in entries}
+    new, baselined = [], []
+    hit: set = set()
+    for f in findings:
+        fp = f.fingerprint()
+        if fp in known:
+            baselined.append(f)
+            hit.add(fp)
+        else:
+            new.append(f)
+    stale = [e for e in entries if e.fingerprint() not in hit]
+    return new, baselined, stale

@@ -1,0 +1,446 @@
+"""swarmlint core: finding model, rule registry, module context, runner.
+
+The analyzer is pure-AST — no file under analysis is ever imported or
+executed, so it is safe to run over broken or TPU-only modules and it
+costs milliseconds at pytest time instead of minutes at TPU time.
+
+Three layers:
+
+- ``ModuleInfo``: one parsed file + the derived tables every rule
+  shares (import-alias resolution, parent links, enclosing-scope
+  qualnames, traced-function detection, suppression comments).
+- ``Rule`` subclasses (rules_*.py) register themselves in ``REGISTRY``
+  and yield ``Finding``s from ``check(mod)``.
+- ``analyze_paths``: walk the tree, run every rule, apply inline
+  suppressions, and report invalid (justification-free) suppressions
+  as findings of the built-in ``bad-suppress`` meta-rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import Iterable, Iterator
+
+# ---------------------------------------------------------------------------
+# Findings
+
+#: Inline suppression syntax (the justification after ``--`` is
+#: mandatory — see ``Suppression``):
+#:   # swarmlint: disable=rule-a,rule-b -- why this is safe here
+SUPPRESS_RE = re.compile(
+    r"#\s*swarmlint:\s*disable=([A-Za-z0-9_,-]+)\s*(?:--\s*(\S.*))?"
+)
+
+#: Meta-rule id for a disable comment with no justification.
+BAD_SUPPRESS = "bad-suppress"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One hazard at one site.
+
+    ``fingerprint`` deliberately excludes the line number: baselines
+    must survive unrelated edits above the finding, so identity is
+    (rule, file, enclosing scope, stripped source line).
+    """
+
+    rule: str
+    path: str          # repo-relative, posix separators
+    line: int          # 1-based
+    context: str       # enclosing def/class qualname, or "<module>"
+    message: str
+    snippet: str       # the stripped source line
+
+    def fingerprint(self) -> tuple:
+        return (self.rule, self.path, self.context, self.snippet)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "context": self.context,
+            "message": self.message,
+            "snippet": self.snippet,
+        }
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass(frozen=True)
+class Suppression:
+    """One parsed ``# swarmlint: disable=...`` comment.
+
+    A suppression is only honored when ``justification`` is non-empty
+    — the policy the analyzer exists to enforce is "every silenced
+    hazard carries its reason next to it".  ``applies_to`` is the code
+    line being excused: the comment's own line for a trailing comment,
+    the next line for a standalone comment.
+    """
+
+    line: int
+    rules: tuple
+    justification: str
+    applies_to: int
+
+    @property
+    def valid(self) -> bool:
+        return bool(self.justification.strip())
+
+
+def parse_suppressions(source: str) -> list:
+    """Extract every swarmlint disable comment from ``source``.
+
+    Tokenize-based: only real COMMENT tokens count, so suppression
+    syntax quoted inside docstrings/string literals (e.g. this
+    repo's own docs and tests) is neither honored nor flagged."""
+    out = []
+    lines = source.splitlines()
+    try:
+        tokens = list(
+            tokenize.generate_tokens(io.StringIO(source).readline)
+        )
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return out  # unparseable files are reported elsewhere
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        i, col = tok.start
+        text = lines[i - 1] if 1 <= i <= len(lines) else ""
+        standalone = not text[:col].strip()
+        out.append(
+            Suppression(
+                line=i,
+                rules=tuple(
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                ),
+                justification=(m.group(2) or "").strip(),
+                applies_to=i + 1 if standalone else i,
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Module context
+
+#: Transforms whose function-valued arguments run under trace.  Keys
+#: are fully-resolved dotted names (after import-alias resolution).
+TRACING_CALLS = frozenset(
+    {
+        "jax.jit",
+        "jax.pmap",
+        "jax.vmap",
+        "jax.grad",
+        "jax.value_and_grad",
+        "jax.checkpoint",
+        "jax.remat",
+        "jax.lax.scan",
+        "jax.lax.fori_loop",
+        "jax.lax.while_loop",
+        "jax.lax.cond",
+        "jax.lax.switch",
+        "jax.lax.map",
+        "jax.lax.associative_scan",
+        "jax.experimental.pallas.pallas_call",
+        "jax.experimental.shard_map.shard_map",
+        "jax.shard_map",
+    }
+)
+
+#: Decorators that make the decorated function's body traced.
+TRACING_DECORATORS = frozenset(
+    {"jax.jit", "jax.pmap", "jax.vmap", "jax.checkpoint", "jax.remat"}
+)
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+class ModuleInfo:
+    """One parsed source file plus the shared per-module tables."""
+
+    def __init__(self, root: str, relpath: str):
+        self.root = root
+        self.relpath = relpath.replace(os.sep, "/")
+        with open(os.path.join(root, relpath), encoding="utf-8") as f:
+            self.source = f.read()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=self.relpath)
+        self.suppressions = parse_suppressions(self.source)
+        self._parents: dict = {}
+        self._qualnames: dict = {}
+        self._aliases: dict = {}
+        self._build_tables()
+        self._traced: set | None = None
+
+    # -- construction -----------------------------------------------------
+
+    def _build_tables(self) -> None:
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self._aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self._aliases[a.asname or a.name] = (
+                        f"{node.module}.{a.name}"
+                    )
+
+    # -- shared helpers ---------------------------------------------------
+
+    def parent(self, node):
+        return self._parents.get(node)
+
+    def ancestors(self, node) -> Iterator[ast.AST]:
+        node = self._parents.get(node)
+        while node is not None:
+            yield node
+            node = self._parents.get(node)
+
+    def qualname(self, node) -> str:
+        """Dotted name of the scope enclosing ``node`` ("<module>" at
+        top level) — the ``context`` component of fingerprints."""
+        parts = []
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(anc.name)
+            elif isinstance(anc, ast.Lambda):
+                parts.append("<lambda>")
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            parts.insert(0, node.name)
+        return ".".join(reversed(parts)) or "<module>"
+
+    def resolve(self, node) -> str:
+        """Dotted name of a Name/Attribute chain with import aliases
+        expanded: ``jr.normal`` -> ``jax.random.normal``.  Returns ""
+        for anything that is not a plain dotted chain."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return ""
+        parts.append(self._aliases.get(node.id, node.id))
+        return ".".join(reversed(parts))
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(
+            rule=rule,
+            path=self.relpath,
+            line=line,
+            context=self.qualname(node),
+            message=message,
+            snippet=self.snippet(line),
+        )
+
+    # -- traced-function detection ---------------------------------------
+
+    def decorator_resolves(self, fn, targets: frozenset) -> bool:
+        """True if any decorator of ``fn`` is one of ``targets``,
+        directly, called (``@jax.jit(...)``), or via
+        ``functools.partial(jax.jit, ...)``."""
+        if isinstance(fn, ast.Lambda):
+            return False
+        for dec in fn.decorator_list:
+            if self.resolve(dec) in targets:
+                return True
+            if isinstance(dec, ast.Call):
+                name = self.resolve(dec.func)
+                if name in targets:
+                    return True
+                if name == "functools.partial" and dec.args:
+                    if self.resolve(dec.args[0]) in targets:
+                        return True
+        return False
+
+    def traced_functions(self) -> set:
+        """Function/lambda nodes whose bodies execute under a jax
+        trace: jit/pmap/vmap-decorated, passed to a TRACING_CALLS
+        transform (by name within this module, or as an inline
+        lambda), or nested inside either."""
+        if self._traced is not None:
+            return self._traced
+        by_name: dict = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                by_name.setdefault(node.name, []).append(node)
+        traced: set = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, _FUNC_NODES) and self.decorator_resolves(
+                node, TRACING_DECORATORS
+            ):
+                traced.add(node)
+            if not isinstance(node, ast.Call):
+                continue
+            if self.resolve(node.func) not in TRACING_CALLS:
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Lambda):
+                    traced.add(arg)
+                elif isinstance(arg, ast.Name):
+                    traced.update(by_name.get(arg.id, []))
+        # Nested defs inside a traced function trace too.
+        for node in ast.walk(self.tree):
+            if isinstance(node, _FUNC_NODES) and any(
+                a in traced for a in self.ancestors(node)
+            ):
+                traced.add(node)
+        self._traced = traced
+        return traced
+
+
+# ---------------------------------------------------------------------------
+# Rule registry
+
+REGISTRY: dict = {}
+
+
+class Rule:
+    """Base class; subclasses set ``id``/``summary``/``details`` and
+    implement ``check``."""
+
+    id: str = ""
+    summary: str = ""
+    details: str = ""
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        raise NotImplementedError
+        yield  # pragma: no cover
+
+
+def register(cls):
+    """Class decorator: instantiate and add to REGISTRY (import order
+    is presentation order in --list-rules and docs)."""
+    inst = cls()
+    if not inst.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if inst.id in REGISTRY:
+        raise ValueError(f"duplicate rule id {inst.id!r}")
+    REGISTRY[inst.id] = inst
+    return cls
+
+
+# ---------------------------------------------------------------------------
+# Runner
+
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", ".hypothesis"}
+
+
+def iter_py_files(root: str, paths: Iterable[str]) -> Iterator[str]:
+    """Yield repo-relative .py paths under each of ``paths`` (which may
+    themselves be files), sorted, skipping cache/VCS directories.
+
+    A nonexistent path raises — a typo'd scan path must not report a
+    vacuously clean run (callers that want existence-filtering, like
+    the DEFAULT_PATHS fallback, filter before calling)."""
+    seen = set()
+    for p in paths:
+        full = os.path.join(root, p)
+        if not os.path.exists(full):
+            raise FileNotFoundError(
+                f"swarmlint: no such scan path: {p!r} (under {root})"
+            )
+        if os.path.isfile(full) and p.endswith(".py"):
+            if p not in seen:
+                seen.add(p)
+                yield p.replace(os.sep, "/")
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = sorted(
+                d for d in dirnames if d not in _SKIP_DIRS
+            )
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                rel = rel.replace(os.sep, "/")
+                if rel not in seen:
+                    seen.add(rel)
+                    yield rel
+
+
+def analyze_module(mod: ModuleInfo, rules=None):
+    """Run rules over one module; apply inline suppressions.
+
+    Returns ``(kept, suppressed)`` — invalid suppressions become
+    ``bad-suppress`` findings in ``kept`` and do NOT silence anything.
+    """
+    rules = list((rules or REGISTRY).values())
+    raw: list = []
+    for rule in rules:
+        raw.extend(rule.check(mod))
+    valid = [s for s in mod.suppressions if s.valid]
+    kept: list = []
+    suppressed: list = []
+    for f in raw:
+        if any(
+            s.applies_to == f.line and f.rule in s.rules for s in valid
+        ):
+            suppressed.append(f)
+        else:
+            kept.append(f)
+    for s in mod.suppressions:
+        if not s.valid:
+            kept.append(
+                Finding(
+                    rule=BAD_SUPPRESS,
+                    path=mod.relpath,
+                    line=s.line,
+                    context="<module>",
+                    message=(
+                        "swarmlint disable comment without a "
+                        "justification (use `# swarmlint: "
+                        "disable=RULE -- why`)"
+                    ),
+                    snippet=mod.snippet(s.line),
+                )
+            )
+    return kept, suppressed
+
+
+def analyze_paths(root: str, paths: Iterable[str], rules=None):
+    """Run the registry over every .py file under ``paths``.
+
+    Returns ``(findings, suppressed, errors)``; ``errors`` are
+    (path, message) pairs for unparseable files (reported, not fatal —
+    a syntax error is pytest's job to flag, not the linter's to crash
+    on)."""
+    findings: list = []
+    suppressed: list = []
+    errors: list = []
+    for rel in iter_py_files(root, paths):
+        try:
+            mod = ModuleInfo(root, rel)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            errors.append((rel, f"{type(e).__name__}: {e}"))
+            continue
+        kept, supp = analyze_module(mod, rules)
+        findings.extend(kept)
+        suppressed.extend(supp)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    suppressed.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, suppressed, errors

@@ -1,0 +1,139 @@
+"""Repo-contract rules: Pallas dispatch gates and bench metric hygiene.
+
+- ``pallas-gate``: every ``ops/pallas/*_fused.py`` kernel family must
+  expose a ``*_supported()`` capability gate at module scope and pass
+  an explicit ``interpret=`` through each ``pl.pallas_call`` — the
+  hashgrid_supported pattern (r6) made mandatory, so dispatch sites
+  can ask *before* tracing and CPU tests can drive the same body.
+- ``metric-fstring``: metric names handed to the benchmark
+  ``report()`` contract must be string literals.  A run-varying name
+  (the r5 bench_recovery f-string) silently drops the metric from the
+  cross-round union gate — the regression tracker matches on the
+  exact string.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import ModuleInfo, Rule, register
+
+_PALLAS_CALL = frozenset(
+    {"jax.experimental.pallas.pallas_call", "pallas.pallas_call"}
+)
+
+
+def _module_level_names(tree: ast.Module):
+    """Names bound at module scope: defs, assignments, imports."""
+    for st in tree.body:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            yield st.name
+        elif isinstance(st, ast.Assign):
+            for t in st.targets:
+                for node in ast.walk(t):
+                    if isinstance(node, ast.Name):
+                        yield node.id
+        elif isinstance(st, ast.AnnAssign) and isinstance(
+            st.target, ast.Name
+        ):
+            yield st.target.id
+        elif isinstance(st, (ast.Import, ast.ImportFrom)):
+            for a in st.names:
+                yield a.asname or a.name.split(".")[0]
+
+
+@register
+class PallasGateRule(Rule):
+    id = "pallas-gate"
+    summary = "fused Pallas module missing *_supported() gate or interpret="
+    details = (
+        "ops/pallas/*_fused.py must bind a module-level *_supported "
+        "capability gate (dispatchers ask before tracing; the "
+        "hashgrid R=2 VMEM overrun was exactly an ungated dispatch) "
+        "and every pallas_call must plumb an explicit interpret= so "
+        "the identical kernel body runs under CPU tests."
+    )
+
+    def applies(self, mod: ModuleInfo) -> bool:
+        return (
+            "ops/pallas/" in mod.relpath
+            and mod.relpath.endswith("_fused.py")
+        )
+
+    def check(self, mod: ModuleInfo):
+        if not self.applies(mod):
+            return
+        if not any(
+            n.endswith("_supported") for n in _module_level_names(mod.tree)
+        ):
+            yield mod.finding(
+                self.id, mod.tree.body[0] if mod.tree.body else mod.tree,
+                "fused kernel module exposes no *_supported() "
+                "capability gate — dispatchers cannot check the "
+                "envelope before tracing",
+            )
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if mod.resolve(node.func) not in _PALLAS_CALL:
+                continue
+            if not any(kw.arg == "interpret" for kw in node.keywords):
+                yield mod.finding(
+                    self.id, node,
+                    "pallas_call without an explicit interpret= — the "
+                    "kernel body cannot run under CPU tests",
+                )
+
+
+@register
+class MetricStringRule(Rule):
+    id = "metric-fstring"
+    summary = "non-literal metric name passed to benchmark report()"
+    details = (
+        "The union perf gate matches metrics by exact string across "
+        "rounds; an f-string or computed name that varies per run "
+        "lands every round in the non-gating 'new'/'dropped' buckets "
+        "(the r5 bench_recovery bug).  Pass a string literal."
+    )
+
+    def applies(self, mod: ModuleInfo) -> bool:
+        return (
+            mod.relpath.startswith("benchmarks/")
+            or mod.relpath == "bench.py"
+        )
+
+    def check(self, mod: ModuleInfo):
+        if not self.applies(mod):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            is_report = (
+                isinstance(func, ast.Name) and func.id == "report"
+            ) or (
+                isinstance(func, ast.Attribute) and func.attr == "report"
+            )
+            if not is_report:
+                continue
+            metric = node.args[0] if node.args else None
+            if metric is None:
+                for kw in node.keywords:
+                    if kw.arg == "metric":
+                        metric = kw.value
+            if metric is None:
+                continue
+            if isinstance(metric, ast.Constant) and isinstance(
+                metric.value, str
+            ):
+                continue
+            kind = (
+                "f-string" if isinstance(metric, ast.JoinedStr)
+                else "computed expression"
+            )
+            yield mod.finding(
+                self.id, metric,
+                f"metric name is a {kind} — the union gate matches "
+                "exact strings; use a literal",
+            )

@@ -1,0 +1,96 @@
+"""Rule: dtype drift in ops/ hot paths (`dtype-drift`).
+
+Every Pallas kernel and every fused driver in this repo is written
+against an explicit float32 (or int32) contract — dtype-less array
+constructors in ``ops/`` inherit whatever flows in, and an upstream
+float64 (x64 mode) or weak-typed literal silently changes the traced
+program: at best a recompile per distinct dtype, at worst a kernel
+that rejects the operand on-chip only.  Scope is deliberately the hot
+paths (``**/ops/**``): model/benchmark code may stage host-side in
+float64 on purpose (e.g. grid_moments' QxQ block-algebra constants).
+
+Flagged:
+- ``jnp.zeros/ones/empty/full/array/asarray`` with no dtype (neither
+  the positional dtype slot nor ``dtype=``);
+- any explicit float64 dtype in a ``jnp.*`` call (``jnp.float64``,
+  ``np.float64``, ``"float64"``).
+
+``jnp.arange`` is exempt: dtype-less ``arange(n)`` is the universal
+index-vector idiom and lands on int32 under the repo's x64-off config.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import ModuleInfo, Rule, register
+
+#: function -> index of its positional dtype slot
+_CREATORS = {
+    "jax.numpy.zeros": 1,
+    "jax.numpy.ones": 1,
+    "jax.numpy.empty": 1,
+    "jax.numpy.full": 2,
+    "jax.numpy.array": 1,
+    "jax.numpy.asarray": 1,
+}
+
+_F64 = frozenset({"jax.numpy.float64", "numpy.float64"})
+
+
+def _has_dtype(call: ast.Call, pos_index: int) -> bool:
+    if len(call.args) > pos_index:
+        return True
+    return any(kw.arg == "dtype" for kw in call.keywords)
+
+
+def _is_f64(mod: ModuleInfo, node: ast.expr) -> bool:
+    if isinstance(node, ast.Constant) and node.value == "float64":
+        return True
+    return mod.resolve(node) in _F64
+
+
+@register
+class DtypeDriftRule(Rule):
+    id = "dtype-drift"
+    summary = "dtype-less or float64 array constructor in ops/"
+    details = (
+        "Hot-path (ops/, ops/pallas/) jnp constructors must pin their "
+        "dtype: dtype-less jnp.zeros/ones/full/array/asarray inherit "
+        "upstream drift and retrace per dtype; explicit float64 "
+        "either downcasts silently (x64 off) or breaks the f32 kernel "
+        "contract (x64 on)."
+    )
+
+    def applies(self, mod: ModuleInfo) -> bool:
+        return "/ops/" in f"/{mod.relpath}"
+
+    def check(self, mod: ModuleInfo):
+        if not self.applies(mod):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = mod.resolve(node.func)
+            if name in _CREATORS:
+                if not _has_dtype(node, _CREATORS[name]):
+                    short = name.replace("jax.numpy", "jnp")
+                    yield mod.finding(
+                        self.id, node,
+                        f"`{short}` without an explicit dtype in an "
+                        "ops/ hot path — pin it (f32/i32 kernel "
+                        "contract)",
+                    )
+            if name.startswith("jax.numpy."):
+                f64_args = [
+                    a
+                    for a in list(node.args)
+                    + [k.value for k in node.keywords]
+                    if _is_f64(mod, a)
+                ]
+                for a in f64_args:
+                    yield mod.finding(
+                        self.id, a,
+                        "float64 dtype in a jnp call in an ops/ hot "
+                        "path — the kernel contract is float32",
+                    )

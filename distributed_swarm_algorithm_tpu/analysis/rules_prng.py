@@ -1,0 +1,212 @@
+"""Rule: PRNG key reuse (`key-reuse`).
+
+The single most common silent-correctness bug in jax code: the same
+key consumed by two ``jax.random.*`` calls yields *identical or
+correlated* draws — e.g. initializing positions and velocities from
+one key makes them bitwise-coupled.  The safe idiom threads keys
+explicitly::
+
+    key, sub = jax.random.split(key)
+    x = jax.random.normal(sub, shape)
+
+Detection is a branch-aware sequential scan of each function scope
+(and the module scope): a bare name consumed by two key-consuming
+``jax.random.*`` calls with no intervening re-assignment is a
+finding on the second call.  ``fold_in(key, i)`` is treated as
+*non*-consuming — deriving independent streams from one key with
+distinct fold constants is this repo's documented domain-separation
+idiom (pso_fused's ``0x6E0`` host key, etc.).  Only bare-``Name``
+key arguments are tracked; ``state.key`` attribute flows are the
+checkpoint/pytree discipline's job.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, ModuleInfo, Rule, register
+
+#: jax.random members whose FIRST argument consumes key entropy.
+#: ``fold_in`` derives (domain separation), ``PRNGKey``/``key``/
+#: ``wrap_key_data`` construct — none of those consume.
+_NON_CONSUMERS = frozenset(
+    {"PRNGKey", "key", "wrap_key_data", "key_data", "clone"}
+)
+
+
+def _is_consumer(mod: ModuleInfo, call: ast.Call) -> bool:
+    name = mod.resolve(call.func)
+    if not name.startswith("jax.random."):
+        return False
+    member = name.rsplit(".", 1)[1]
+    return member not in _NON_CONSUMERS and member != "fold_in"
+
+
+def _key_arg(call: ast.Call):
+    """The bare-Name key operand of a consumer call, if any."""
+    if call.args and isinstance(call.args[0], ast.Name):
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "key" and isinstance(kw.value, ast.Name):
+            return kw.value
+    return None
+
+
+def _bound_names(target) -> list:
+    """Names (re)bound by an assignment target / loop target."""
+    out = []
+    for node in ast.walk(target):
+        if isinstance(node, ast.Name):
+            out.append(node.id)
+    return out
+
+
+@register
+class KeyReuseRule(Rule):
+    id = "key-reuse"
+    summary = "PRNG key consumed by two jax.random calls"
+    details = (
+        "A key passed to two key-consuming jax.random.* calls without "
+        "an intervening re-assignment (split/fold_in producing a new "
+        "binding) yields correlated draws.  Thread keys: "
+        "`key, sub = jax.random.split(key)`."
+    )
+
+    def check(self, mod: ModuleInfo):
+        findings: dict = {}
+        scopes = [self._module_body(mod.tree)]
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(node.body)
+        for body in scopes:
+            self._scan_stmts(mod, body, {}, findings)
+        for f in sorted(findings.values(), key=lambda f: f.line):
+            yield f
+
+    @staticmethod
+    def _module_body(tree: ast.Module) -> list:
+        # Module scope minus function bodies (scanned separately).
+        return [
+            st
+            for st in tree.body
+            if not isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef))
+        ]
+
+    # -- statement walk ---------------------------------------------------
+
+    def _scan_stmts(self, mod, stmts, counts, findings) -> None:
+        for st in stmts:
+            self._scan_stmt(mod, st, counts, findings)
+
+    def _scan_stmt(self, mod, st, counts, findings) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return  # separate scope
+        if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            if st.value is not None:
+                self._scan_expr(mod, st.value, counts, findings)
+            targets = (
+                st.targets if isinstance(st, ast.Assign) else [st.target]
+            )
+            for t in targets:
+                for name in _bound_names(t):
+                    counts[name] = 0
+            return
+        if isinstance(st, ast.If):
+            self._scan_expr(mod, st.test, counts, findings)
+            self._scan_branches(mod, [st.body, st.orelse], counts,
+                                findings)
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self._scan_expr(mod, st.iter, counts, findings)
+            for name in _bound_names(st.target):
+                counts[name] = 0
+            # Two passes expose loop-carried reuse (a key consumed
+            # once per iteration without re-binding IS reuse);
+            # findings dedupe on site so the second pass adds nothing
+            # for straight-line single uses.
+            for _ in range(2):
+                body_counts = dict(counts)
+                self._scan_stmts(mod, st.body, body_counts, findings)
+                counts.update(body_counts)
+            self._scan_stmts(mod, st.orelse, counts, findings)
+            return
+        if isinstance(st, ast.While):
+            for _ in range(2):
+                self._scan_expr(mod, st.test, counts, findings)
+                body_counts = dict(counts)
+                self._scan_stmts(mod, st.body, body_counts, findings)
+                counts.update(body_counts)
+            self._scan_stmts(mod, st.orelse, counts, findings)
+            return
+        if isinstance(st, ast.Try):
+            branches = [st.body]
+            for h in st.handlers:
+                branches.append(h.body)
+            branches.append(st.orelse)
+            self._scan_branches(mod, branches, counts, findings)
+            self._scan_stmts(mod, st.finalbody, counts, findings)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self._scan_expr(mod, item.context_expr, counts, findings)
+                if item.optional_vars is not None:
+                    for name in _bound_names(item.optional_vars):
+                        counts[name] = 0
+            self._scan_stmts(mod, st.body, counts, findings)
+            return
+        # Return / Expr / Assert / Raise / Delete / ...
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self._scan_expr(mod, child, counts, findings)
+
+    @staticmethod
+    def _terminates(body) -> bool:
+        """True if the branch body never falls through to the code
+        after it (ends the scope or the loop iteration)."""
+        return any(
+            isinstance(st, (ast.Return, ast.Raise, ast.Break,
+                            ast.Continue))
+            for st in body
+        )
+
+    def _scan_branches(self, mod, branch_bodies, counts, findings):
+        """Mutually exclusive branches: each starts from the incoming
+        state; the merged state is the per-name max over the branches
+        that can fall through (a branch ending in return/raise never
+        reaches the code after the if, so its consumptions must not
+        count against later uses — the early-return key pattern)."""
+        merged = dict(counts)
+        for body in branch_bodies:
+            c = dict(counts)
+            self._scan_stmts(mod, body, c, findings)
+            if self._terminates(body):
+                continue
+            for name, n in c.items():
+                merged[name] = max(merged.get(name, 0), n)
+        counts.clear()
+        counts.update(merged)
+
+    # -- expression walk --------------------------------------------------
+
+    def _scan_expr(self, mod, expr, counts, findings) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            if not _is_consumer(mod, node):
+                continue
+            key = _key_arg(node)
+            if key is None:
+                continue
+            counts[key.id] = counts.get(key.id, 0) + 1
+            if counts[key.id] >= 2:
+                site = (mod.relpath, node.lineno, node.col_offset)
+                if site not in findings:
+                    findings[site] = mod.finding(
+                        self.id,
+                        node,
+                        f"PRNG key `{key.id}` consumed again without "
+                        "an intervening split/re-assignment — "
+                        "correlated draws",
+                    )

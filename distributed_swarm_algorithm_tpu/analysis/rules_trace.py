@@ -1,0 +1,307 @@
+"""Rules over traced (jit/scan/vmap/pallas) function bodies.
+
+- ``host-sync``: host round-trips inside traced code (``.item()``,
+  ``np.asarray``, builtin casts of computed values) force a device
+  sync per call — the silent throughput killer on TPU.
+- ``tracer-branch``: Python ``if``/``while`` on a traced argument
+  raises ``TracerBoolConversionError`` at trace time on-chip but can
+  pass CPU tests that never hit the jitted path; use ``lax.cond`` /
+  ``jnp.where``.
+- ``retrace``: ``jax.jit`` constructed where it re-runs per call
+  (inside loops, or constructed-and-immediately-called) recompiles
+  every time; unhashable static-arg defaults fail at first call.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import ModuleInfo, Rule, register
+
+# ---------------------------------------------------------------------------
+# host-sync
+
+_NP_SYNC = frozenset(
+    {
+        "numpy.asarray",
+        "numpy.array",
+        "numpy.ascontiguousarray",
+        "numpy.asfortranarray",
+    }
+)
+_CASTS = frozenset({"float", "int", "bool"})
+
+
+@register
+class HostSyncRule(Rule):
+    id = "host-sync"
+    summary = "host round-trip inside traced code"
+    details = (
+        "`.item()`, `np.asarray`/`np.array`, and `float()`/`int()`/"
+        "`bool()` of a computed value inside a jit/scan/vmap body "
+        "block on the device (or fail to trace).  Keep values on "
+        "device; cast outside the traced region."
+    )
+
+    def check(self, mod: ModuleInfo):
+        traced = mod.traced_functions()
+        seen: set = set()
+        for fn in traced:
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for st in body:
+                for node in ast.walk(st):
+                    # Nested traced defs walk their own bodies; dedupe
+                    # the overlap by site.
+                    site = (
+                        getattr(node, "lineno", 0),
+                        getattr(node, "col_offset", 0),
+                    )
+                    if site in seen:
+                        continue
+                    f = self._check_call(mod, node)
+                    if f is not None:
+                        seen.add(site)
+                        yield f
+
+    def _check_call(self, mod, node):
+        if not isinstance(node, ast.Call):
+            return None
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "item"
+            and not node.args
+        ):
+            return mod.finding(
+                self.id, node,
+                "`.item()` inside traced code forces a device sync",
+            )
+        name = mod.resolve(node.func)
+        if name in _NP_SYNC:
+            return mod.finding(
+                self.id, node,
+                f"`{name.replace('numpy', 'np')}` inside traced code "
+                "pulls the value to host",
+            )
+        if name in _CASTS and node.args:
+            # Only computed values: a Call argument is (almost) always
+            # a traced intermediate; bare names / attributes are
+            # usually static config and stay un-flagged.
+            if isinstance(node.args[0], ast.Call):
+                return mod.finding(
+                    self.id, node,
+                    f"`{name}()` of a computed value inside traced "
+                    "code concretizes a tracer",
+                )
+        return None
+
+
+# ---------------------------------------------------------------------------
+# tracer-branch
+
+
+def _static_param_names(mod: ModuleInfo, fn) -> set:
+    """Parameter names marked static via static_argnames/static_argnums
+    in a jit decorator (direct or functools.partial)."""
+    static: set = set()
+    if isinstance(fn, ast.Lambda):
+        return static
+    params = [a.arg for a in (
+        fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+    )]
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        for kw in dec.keywords:
+            if kw.arg == "static_argnames":
+                for node in ast.walk(kw.value):
+                    if isinstance(node, ast.Constant) and isinstance(
+                        node.value, str
+                    ):
+                        static.add(node.value)
+            elif kw.arg == "static_argnums":
+                for node in ast.walk(kw.value):
+                    if isinstance(node, ast.Constant) and isinstance(
+                        node.value, int
+                    ):
+                        if 0 <= node.value < len(params):
+                            static.add(params[node.value])
+    return static
+
+
+def _hazard_names(test: ast.expr) -> set:
+    """Bare Names in a test expression that would concretize a tracer:
+    excludes `x is (not) None` operands, attribute bases (`x.shape`),
+    and call callees (`f(...)`)."""
+    exempt: set = set()
+    for node in ast.walk(test):
+        if isinstance(node, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+        ):
+            for operand in [node.left] + node.comparators:
+                for n in ast.walk(operand):
+                    if isinstance(n, ast.Name):
+                        exempt.add(n.id)
+        # `any(x is None for x in (a, b))` — a presence check over
+        # operands, not a value branch: exempt the whole comprehension
+        # when its element is purely an is/is-not comparison.
+        if isinstance(node, (ast.GeneratorExp, ast.ListComp,
+                             ast.SetComp)) and isinstance(
+            node.elt, ast.Compare
+        ) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.elt.ops
+        ):
+            for n in ast.walk(node):
+                if isinstance(n, ast.Name):
+                    exempt.add(n.id)
+        if isinstance(node, ast.Attribute) and isinstance(
+            node.value, ast.Name
+        ):
+            exempt.add(node.value.id)
+        if isinstance(node, ast.Call):
+            for n in ast.walk(node.func):
+                if isinstance(n, ast.Name):
+                    exempt.add(n.id)
+            if isinstance(node.func, ast.Name) and node.func.id in (
+                "isinstance", "len", "hasattr", "callable",
+            ):
+                for arg in node.args:
+                    for n in ast.walk(arg):
+                        if isinstance(n, ast.Name):
+                            exempt.add(n.id)
+    return {
+        node.id
+        for node in ast.walk(test)
+        if isinstance(node, ast.Name) and node.id not in exempt
+    }
+
+
+@register
+class TracerBranchRule(Rule):
+    id = "tracer-branch"
+    summary = "Python if/while on a traced argument"
+    details = (
+        "Branching on a non-static parameter inside a traced function "
+        "raises TracerBoolConversionError at trace time; use "
+        "jax.lax.cond / jnp.where, or mark the argument static."
+    )
+
+    def check(self, mod: ModuleInfo):
+        for fn in mod.traced_functions():
+            if isinstance(fn, ast.Lambda):
+                continue  # lambdas cannot contain statements
+            params = {
+                a.arg
+                for a in (
+                    fn.args.posonlyargs + fn.args.args
+                    + fn.args.kwonlyargs
+                )
+            }
+            params -= _static_param_names(mod, fn)
+            params.discard("self")
+            for st in fn.body:
+                for node in ast.walk(st):
+                    if not isinstance(node, (ast.If, ast.While)):
+                        continue
+                    # Don't cross into nested defs — they are traced
+                    # functions in their own right and get their own
+                    # parameter set.
+                    if any(
+                        isinstance(a, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef, ast.Lambda))
+                        and a is not fn
+                        for a in mod.ancestors(node)
+                    ):
+                        continue
+                    hot = _hazard_names(node.test) & params
+                    if hot:
+                        kind = (
+                            "if" if isinstance(node, ast.If) else "while"
+                        )
+                        yield mod.finding(
+                            self.id, node,
+                            f"Python `{kind}` on traced argument(s) "
+                            f"{sorted(hot)} — use lax.cond/jnp.where "
+                            "or mark static",
+                        )
+
+
+# ---------------------------------------------------------------------------
+# retrace
+
+
+@register
+class RetraceRule(Rule):
+    id = "retrace"
+    summary = "jax.jit constructed where it recompiles per call"
+    details = (
+        "`jax.jit(f)` inside a loop, or `jax.jit(f)(x)` constructed "
+        "and called in one expression, builds a fresh cache entry "
+        "every execution — hoist the jitted callable to module scope "
+        "or cache it.  Mutable defaults on static args fail hashing "
+        "at the first call."
+    )
+
+    def check(self, mod: ModuleInfo):
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                yield from self._check_call(mod, node)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                yield from self._check_static_defaults(mod, node)
+
+    def _check_call(self, mod, node):
+        name = mod.resolve(node.func)
+        if name == "jax.jit":
+            in_loop = False
+            for anc in mod.ancestors(node):
+                if isinstance(anc, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef, ast.Lambda)):
+                    # A def inside a loop still re-jits per iteration
+                    # when the loop re-executes it, so keep climbing
+                    # only if the def itself is not decorator scope.
+                    break
+                if isinstance(anc, (ast.For, ast.AsyncFor, ast.While)):
+                    in_loop = True
+                    break
+            if in_loop:
+                yield mod.finding(
+                    self.id, node,
+                    "`jax.jit` constructed inside a loop — each "
+                    "iteration builds (and retraces) a new callable",
+                )
+        # jax.jit(f, ...)(x): the jitted wrapper is rebuilt per call.
+        if isinstance(node.func, ast.Call):
+            if mod.resolve(node.func.func) == "jax.jit":
+                yield mod.finding(
+                    self.id, node,
+                    "`jax.jit(f)(...)` constructed and called in one "
+                    "expression retraces on every execution — bind "
+                    "the jitted callable once",
+                )
+
+    def _check_static_defaults(self, mod, fn):
+        static = _static_param_names(mod, fn)
+        if not static:
+            return
+        args = fn.args
+        pos = args.posonlyargs + args.args
+        defaults = args.defaults
+        for param, default in zip(pos[len(pos) - len(defaults):],
+                                  defaults):
+            if param.arg in static and isinstance(
+                default, (ast.List, ast.Dict, ast.Set)
+            ):
+                yield mod.finding(
+                    self.id, default,
+                    f"static arg `{param.arg}` has an unhashable "
+                    "mutable default — jit static args must hash",
+                )
+        for param, default in zip(args.kwonlyargs, args.kw_defaults):
+            if default is not None and param.arg in static and isinstance(
+                default, (ast.List, ast.Dict, ast.Set)
+            ):
+                yield mod.finding(
+                    self.id, default,
+                    f"static arg `{param.arg}` has an unhashable "
+                    "mutable default — jit static args must hash",
+                )

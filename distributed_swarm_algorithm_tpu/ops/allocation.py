@@ -204,7 +204,7 @@ def auction_allocation_step(
     resolve = leader_exists & (
         (state.tick % cfg.auction_every == 0)
         | jnp.any(evict)
-        | jnp.asarray(leader_emerged)
+        | jnp.asarray(leader_emerged, dtype=bool)
     )
 
     def solve(st):

@@ -136,10 +136,10 @@ def crowding_distance(objs: jax.Array, rank: jax.Array) -> jax.Array:
         r_sorted = rank[order]
         v_sorted = norm[order, mm]
         prev_same = jnp.concatenate(
-            [jnp.asarray([False]), r_sorted[1:] == r_sorted[:-1]]
+            [jnp.asarray([False], dtype=bool), r_sorted[1:] == r_sorted[:-1]]
         )
         next_same = jnp.concatenate(
-            [r_sorted[:-1] == r_sorted[1:], jnp.asarray([False])]
+            [r_sorted[:-1] == r_sorted[1:], jnp.asarray([False], dtype=bool)]
         )
         prev_v = jnp.concatenate([v_sorted[:1], v_sorted[:-1]])
         next_v = jnp.concatenate([v_sorted[1:], v_sorted[-1:]])

@@ -69,6 +69,54 @@ _LN2 = 0.6931471805599453
 _NEG = -1e30
 
 
+def _tile_fits(c: int, cp: int, a_pad: int, t: int, rng: str) -> bool:
+    """VMEM fit model for ant tile ``t`` (see the envelope note in
+    ``fused_construct_tours``): both [Cp, Cp] operands stay
+    single-buffered, per-program ant blocks double-buffer once the
+    grid has >1 program, host-RNG uniforms ride as whole-rows blocks."""
+    grid_mult = 1 if a_pad == t else 2
+    est = (
+        2 * cp * cp * 4            # logits + dist, single-buffered
+        + grid_mult * 3 * cp * t * 4   # start/tours/len blocks
+        + cp * t * 4                   # in-kernel scratch
+    )
+    if rng == "host":
+        # The uniforms ride in as one whole-rows block per
+        # program: [(C-1)*Cp, t] f32 (advisor r3 — previously an
+        # opaque Mosaic OOM).
+        est += grid_mult * (c - 1) * cp * t * 4
+    return est <= VMEM_BUDGET_BYTES
+
+
+def _tile_candidates(c: int, cp: int, a_pad: int, tile_a: int,
+                     rng: str, interpret: bool = False) -> list:
+    """128-multiple divisors of ``a_pad`` not exceeding the requested
+    tile THAT FIT IN VMEM: small colonies must not be silently padded
+    to the default tile, and large instances shrink the ant tile
+    instead of dying in Mosaic allocation."""
+    return [
+        t
+        for t in range(128, max(128, min(tile_a, a_pad)) + 1, 128)
+        if a_pad % t == 0 and (interpret or _tile_fits(c, cp, a_pad, t, rng))
+    ]
+
+
+def aco_pallas_supported(n_cities: int, n_ants: int = 1024,
+                         tile_a: int = 1024, rng: str = "tpu") -> bool:
+    """Dispatch gate (repo contract: every fused family exposes one).
+
+    True when the fused whole-tour kernel can hold this instance in
+    VMEM at SOME ant tile — the same fit model the entry point uses to
+    pick its tile, so a True here never dies in Mosaic allocation.
+    Past the envelope (C ceiling ~1024 on v5e), use the portable
+    ``ops/aco.py`` path."""
+    if rng not in ("tpu", "host"):
+        return False
+    cp = _ceil_to(n_cities, 128)
+    a_pad = _ceil_to(max(int(n_ants), 1), 128)
+    return bool(_tile_candidates(n_cities, cp, a_pad, tile_a, rng))
+
+
 def _ln_fast(x):
     return _LN2 * _log2_fast(x)
 
@@ -218,29 +266,7 @@ def fused_construct_tours(
 
     a_pad = _ceil_to(n_ants, 128)
 
-    def _fits(t):
-        grid_mult = 1 if a_pad == t else 2
-        est = (
-            2 * cp * cp * 4            # logits + dist, single-buffered
-            + grid_mult * 3 * cp * t * 4   # start/tours/len blocks
-            + cp * t * 4                   # in-kernel scratch
-        )
-        if rng == "host":
-            # The uniforms ride in as one whole-rows block per
-            # program: [(C-1)*Cp, t] f32 (advisor r3 — previously an
-            # opaque Mosaic OOM).
-            est += grid_mult * (c - 1) * cp * t * 4
-        return est <= VMEM_BUDGET_BYTES
-
-    # Largest 128-multiple divisor of a_pad not exceeding the request
-    # THAT FITS IN VMEM: small colonies must not be silently padded to
-    # the default tile, and large instances shrink the ant tile
-    # instead of dying in Mosaic allocation (see envelope note above).
-    candidates = [
-        t
-        for t in range(128, max(128, min(tile_a, a_pad)) + 1, 128)
-        if a_pad % t == 0 and (interpret or _fits(t))
-    ]
+    candidates = _tile_candidates(c, cp, a_pad, tile_a, rng, interpret)
     if not candidates and rng == "host":
         raise ValueError(
             f"rng='host' at C={c} needs a [(C-1)*Cp, tile_a] uniform "

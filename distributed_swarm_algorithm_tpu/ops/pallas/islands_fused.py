@@ -33,9 +33,16 @@ from .pso_fused import (
     _auto_tile,
     _make_kernel,
     host_uniforms,
+    pallas_supported,
     run_blocks,
     seed_base,
 )
+
+# Dispatch gate (repo contract: every fused family exposes one).  The
+# island kernel body is byte-identical to the single-swarm PSO kernel,
+# so the envelope is exactly PSO's: objective coverage, f32, and the
+# michalewicz dim bound.
+islands_pallas_supported = pallas_supported
 
 
 def _islands_step_t(

@@ -120,6 +120,7 @@ def shade_step(
     )
 
     # --- current-to-pbest/1 with external archive ---------------------
+    # swarmlint: disable=host-sync -- p_best is static_argnames and n is a shape: trace-time Python scalars, no tracer concretized
     n_top = max(2, int(round(p_best * n)))
     _, top_idx = jax.lax.top_k(-state.fit, n_top)       # best rows
     pb = top_idx[jax.random.randint(k_pb, (n,), 0, n_top)]

@@ -1,0 +1,496 @@
+"""swarmlint: the analyzer gates tier-1, and each rule detects its class.
+
+Three layers:
+- the repo-wide gate: running the analyzer over the default scan set
+  must produce zero non-baselined findings (and a tight baseline —
+  stale entries fail too, so the ledger shrinks as debt is paid);
+- a seeded fixture tree with exactly one violation per rule, proving
+  each rule fires exactly once (and precision cases proving the
+  branch-aware/static-arg exemptions hold);
+- round-trips of the suppression-comment and baseline machinery.
+
+Pure AST analysis — no jax import, no tracing; this whole module runs
+in well under a second after the repo parse.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from distributed_swarm_algorithm_tpu import analysis
+from distributed_swarm_algorithm_tpu.analysis import baseline
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# The tier-1 gate
+
+
+@functools.lru_cache(maxsize=1)
+def _repo_partition():
+    # Cached: the repo-wide AST walk is the dominant cost of this
+    # module and two gate tests share it.
+    paths = [
+        p for p in analysis.DEFAULT_PATHS
+        if os.path.exists(os.path.join(ROOT, p))
+    ]
+    findings, suppressed, errors = analysis.analyze_paths(ROOT, paths)
+    entries = baseline.load(
+        os.path.join(ROOT, baseline.DEFAULT_BASENAME)
+    )
+    new, baselined, stale = baseline.partition(findings, entries)
+    return new, baselined, stale, tuple(errors)
+
+
+def test_repo_has_no_new_findings():
+    new, _, _, errors = _repo_partition()
+    assert not errors, f"unparseable files: {errors}"
+    assert not new, "non-baselined swarmlint findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+
+
+def test_repo_baseline_is_tight():
+    # A stale entry means the finding it excused was fixed (or its
+    # line edited): remove it so the ledger tracks real debt only.
+    _, _, stale, _ = _repo_partition()
+    assert not stale, "stale baseline entries (remove them):\n" + (
+        "\n".join(f"[{e.rule}] {e.path} ({e.context})" for e in stale)
+    )
+
+
+def test_cli_json_exits_zero_on_repo():
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "distributed_swarm_algorithm_tpu.analysis", "--json"],
+        capture_output=True, text=True, cwd=ROOT, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    summary = json.loads(proc.stdout)
+    assert summary["counts"]["new"] == 0
+    assert summary["counts"]["parse_errors"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Seeded fixture tree: one violation per rule, each fires exactly once
+
+#: rule id -> (repo-relative fixture path, source).  Paths matter:
+#: dtype-drift only looks under ops/, pallas-gate under
+#: ops/pallas/*_fused.py, metric-fstring under benchmarks/.
+SEEDED = {
+    "key-reuse": (
+        "pkg/sampling.py",
+        """
+        import jax
+
+        def sample(key):
+            a = jax.random.normal(key, (4,))
+            b = jax.random.uniform(key, (4,))
+            return a + b
+        """,
+    ),
+    "host-sync": (
+        "pkg/sync.py",
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.mean().item()
+        """,
+    ),
+    "tracer-branch": (
+        "pkg/branch.py",
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+        """,
+    ),
+    "retrace": (
+        "pkg/loopjit.py",
+        """
+        import jax
+
+        def run_all(fns, x):
+            outs = []
+            for fn in fns:
+                jf = jax.jit(fn)
+                outs.append(jf(x))
+            return outs
+        """,
+    ),
+    "dtype-drift": (
+        "ops/hot.py",
+        """
+        import jax.numpy as jnp
+
+        def z(n):
+            return jnp.zeros((n, 3))
+        """,
+    ),
+    "pallas-gate": (
+        "ops/pallas/fake_fused.py",
+        """
+        from jax.experimental import pallas as pl
+
+        def run(kernel, x):
+            return pl.pallas_call(kernel, out_shape=x,
+                                  interpret=False)(x)
+        """,
+    ),
+    "metric-fstring": (
+        "benchmarks/bench_fake.py",
+        """
+        from common import report
+
+        def main(n):
+            report(f"steps/sec, {n} agents", 1.0, "steps/sec", 0.0)
+        """,
+    ),
+}
+
+
+def _write_tree(root, files) -> None:
+    for rel, src in files:
+        path = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(textwrap.dedent(src))
+
+
+def test_each_rule_fires_exactly_once_on_seeded_tree(tmp_path):
+    _write_tree(str(tmp_path), SEEDED.values())
+    findings, suppressed, errors = analysis.analyze_paths(
+        str(tmp_path), ["pkg", "ops", "benchmarks"]
+    )
+    assert not errors
+    assert not suppressed
+    by_rule: dict = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    for rule, (rel, _) in SEEDED.items():
+        hits = by_rule.get(rule, [])
+        assert len(hits) == 1, (
+            f"rule {rule}: expected exactly 1 finding, got "
+            f"{[h.render() for h in hits]}"
+        )
+        assert hits[0].path == rel
+    assert len(findings) == len(SEEDED), (
+        "cross-contamination:\n" + "\n".join(
+            f.render() for f in findings
+        )
+    )
+
+
+@pytest.mark.parametrize(
+    "name,src",
+    [
+        # Threaded keys: re-assignment resets the consumption count.
+        (
+            "split_rebind",
+            """
+            import jax
+
+            def sample(key):
+                key, sub = jax.random.split(key)
+                a = jax.random.normal(sub, (4,))
+                key, sub = jax.random.split(key)
+                return a + jax.random.uniform(sub, (4,))
+            """,
+        ),
+        # Mutually exclusive branches each consume once: no reuse.
+        (
+            "branch_exclusive",
+            """
+            import jax
+
+            def sample(key, flag):
+                if flag:
+                    return jax.random.normal(key, (4,))
+                else:
+                    return jax.random.uniform(key, (4,))
+            """,
+        ),
+        # fold_in is domain separation, not consumption.
+        (
+            "fold_in_derivation",
+            """
+            import jax
+
+            def sample(key):
+                a = jax.random.normal(jax.random.fold_in(key, 1), (4,))
+                b = jax.random.normal(jax.random.fold_in(key, 2), (4,))
+                return a + b
+            """,
+        ),
+        # Static (static_argnames) params may drive Python branches.
+        (
+            "static_branch",
+            """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, static_argnames=("mode",))
+            def f(x, mode):
+                if mode == "fast":
+                    return x * 2
+                return x
+            """,
+        ),
+        # Early-return branches never reach the code after the if:
+        # one consumption per execution path is not reuse.
+        (
+            "early_return",
+            """
+            import jax
+
+            def sample(key, fast):
+                if fast:
+                    return jax.random.normal(key, (4,))
+                return jax.random.uniform(key, (4,))
+            """,
+        ),
+        # Suppression syntax quoted in a docstring is inert: neither
+        # honored nor flagged as bad-suppress.
+        (
+            "docstring_mention",
+            '''
+            """Docs: silence with `# swarmlint: disable=key-reuse` and
+            justify, or bare `# swarmlint: disable=host-sync` is bad.
+            """
+
+            X = 1
+            ''',
+        ),
+        # `x is None` presence checks never concretize a tracer.
+        (
+            "none_checks",
+            """
+            import jax
+
+            @jax.jit
+            def f(x, r_a=None, r_b=None):
+                if r_a is None:
+                    return x
+                if any(r is None for r in (r_a, r_b)):
+                    return x + 1
+                return x + r_a + r_b
+            """,
+        ),
+    ],
+)
+def test_precision_no_false_positive(tmp_path, name, src):
+    _write_tree(str(tmp_path), [(f"{name}.py", src)])
+    findings, _, errors = analysis.analyze_paths(
+        str(tmp_path), [f"{name}.py"]
+    )
+    assert not errors
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_loop_carried_key_reuse_detected(tmp_path):
+    src = """
+    import jax
+
+    def sample(key, n):
+        out = 0.0
+        for _ in range(n):
+            out = out + jax.random.normal(key, (4,))
+        return out
+    """
+    _write_tree(str(tmp_path), [("loop.py", src)])
+    findings, _, _ = analysis.analyze_paths(str(tmp_path), ["loop.py"])
+    assert [f.rule for f in findings] == ["key-reuse"]
+
+
+def test_quoted_suppression_in_string_cannot_silence(tmp_path):
+    # A string literal above flagged code that merely QUOTES the
+    # disable syntax must not act as a suppression.
+    src = '''
+    import jax
+
+    @jax.jit
+    def f(x):
+        s = "# swarmlint: disable=host-sync -- not a real comment"
+        return x.mean().item()
+    '''
+    _write_tree(str(tmp_path), [("mod.py", src)])
+    findings, suppressed, _ = analysis.analyze_paths(
+        str(tmp_path), ["mod.py"]
+    )
+    assert not suppressed
+    assert [f.rule for f in findings] == ["host-sync"]
+
+
+def test_nonexistent_scan_path_raises(tmp_path):
+    with pytest.raises(FileNotFoundError, match="no such scan path"):
+        list(analysis.iter_py_files(str(tmp_path), ["no_such_dir"]))
+
+
+def test_cli_fails_on_stale_baseline(tmp_path):
+    from distributed_swarm_algorithm_tpu.analysis.__main__ import main
+
+    _write_tree(str(tmp_path), [("clean.py", "X = 1\n")])
+    bl = tmp_path / "bl.json"
+    baseline.save(
+        str(bl),
+        [baseline.Entry(rule="host-sync", path="clean.py", context="f",
+                        snippet="x.item()", justification="was real")],
+    )
+    rc = main(["--root", str(tmp_path), "--baseline", str(bl),
+               "clean.py"])
+    assert rc == 1  # stale entry for a scanned file fails the gate
+
+
+def test_cli_usage_error_on_bad_path(tmp_path):
+    from distributed_swarm_algorithm_tpu.analysis.__main__ import main
+
+    rc = main(["--root", str(tmp_path), "definitely_missing"])
+    assert rc == 2
+
+
+# ---------------------------------------------------------------------------
+# Suppression machinery
+
+
+def test_suppression_comment_roundtrip():
+    src = textwrap.dedent(
+        """
+        x = 1  # swarmlint: disable=host-sync,retrace -- staged on host by design
+        # swarmlint: disable=key-reuse -- antithetic pair wants the correlation
+        y = 2
+        # swarmlint: disable=dtype-drift
+        z = 3
+        """
+    )
+    supp = analysis.parse_suppressions(src)
+    assert len(supp) == 3
+    trailing, standalone, bare = supp
+    assert trailing.rules == ("host-sync", "retrace")
+    assert trailing.applies_to == trailing.line
+    assert trailing.valid
+    assert standalone.rules == ("key-reuse",)
+    assert standalone.applies_to == standalone.line + 1
+    assert standalone.valid
+    assert not bare.valid  # no justification -> not honored
+
+
+def test_valid_suppression_silences_and_bare_one_is_flagged(tmp_path):
+    src = """
+    import jax
+
+    @jax.jit
+    def f(x, y):
+        # swarmlint: disable=host-sync -- x is a static shim in every caller
+        a = x.mean().item()
+        b = y.mean().item()  # swarmlint: disable=host-sync
+        return a + b
+    """
+    _write_tree(str(tmp_path), [("mod.py", src)])
+    findings, suppressed, _ = analysis.analyze_paths(
+        str(tmp_path), ["mod.py"]
+    )
+    # The justified suppression silences line a; the bare comment on
+    # line b silences nothing AND is itself a finding.
+    assert [f.rule for f in suppressed] == ["host-sync"]
+    assert sorted(f.rule for f in findings) == [
+        analysis.BAD_SUPPRESS, "host-sync",
+    ]
+
+
+def test_suppression_rule_must_match(tmp_path):
+    src = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        return x.mean().item()  # swarmlint: disable=retrace -- wrong rule id
+    """
+    _write_tree(str(tmp_path), [("mod.py", src)])
+    findings, suppressed, _ = analysis.analyze_paths(
+        str(tmp_path), ["mod.py"]
+    )
+    assert not suppressed
+    assert [f.rule for f in findings] == ["host-sync"]
+
+
+# ---------------------------------------------------------------------------
+# Baseline machinery
+
+
+def _one_finding(tmp_path):
+    _write_tree(str(tmp_path), [SEEDED["key-reuse"]])
+    findings, _, _ = analysis.analyze_paths(str(tmp_path), ["pkg"])
+    assert len(findings) == 1
+    return findings[0]
+
+
+def test_baseline_roundtrip_and_partition(tmp_path):
+    f = _one_finding(tmp_path)
+    entry = baseline.from_finding(f, "seeded: grandfathered on purpose")
+    path = str(tmp_path / "bl.json")
+    baseline.save(path, [entry])
+    loaded = baseline.load(path)
+    assert loaded == [entry]
+    new, baselined, stale = baseline.partition([f], loaded)
+    assert (new, baselined, stale) == ([], [f], [])
+
+
+def test_baseline_is_line_number_insensitive(tmp_path):
+    f = _one_finding(tmp_path)
+    entry = baseline.from_finding(f, "still the same source line")
+    shifted = f.__class__(**dict(f.to_dict(), line=f.line + 40))
+    new, baselined, stale = baseline.partition([shifted], [entry])
+    assert (new, baselined, stale) == ([], [shifted], [])
+
+
+def test_baseline_stale_and_unmatched(tmp_path):
+    f = _one_finding(tmp_path)
+    other = baseline.Entry(
+        rule="host-sync", path="gone.py", context="f",
+        snippet="x.item()", justification="module was deleted",
+    )
+    new, baselined, stale = baseline.partition([f], [other])
+    assert new == [f]
+    assert baselined == []
+    assert stale == [other]
+
+
+def test_baseline_rejects_empty_justification(tmp_path):
+    path = str(tmp_path / "bl.json")
+    with open(path, "w") as fh:
+        json.dump(
+            {
+                "entries": [
+                    {
+                        "rule": "key-reuse", "path": "a.py",
+                        "context": "f", "snippet": "x",
+                        "justification": "   ",
+                    }
+                ]
+            },
+            fh,
+        )
+    with pytest.raises(baseline.BaselineError, match="justification"):
+        baseline.load(path)
+
+
+def test_baseline_rejects_missing_keys(tmp_path):
+    path = str(tmp_path / "bl.json")
+    with open(path, "w") as fh:
+        json.dump({"entries": [{"rule": "key-reuse"}]}, fh)
+    with pytest.raises(baseline.BaselineError, match="missing"):
+        baseline.load(path)

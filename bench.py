@@ -21,15 +21,57 @@ attached, else the portable jit path.  Methodology notes:
 """
 
 import json
+import os
 import time
-
-from distributed_swarm_algorithm_tpu.models.pso import PSO
 
 N = 1_048_576           # 1M particles (BASELINE.json north star)
 DIM = 30                # Rastrigin-30D
 BENCH_STEPS = 2560
 REPS = 3
 REFERENCE_AGENT_STEPS_PER_SEC = 40_000.0  # SURVEY.md §6, measured
+
+# Backend-init retry (r8, VERDICT r5 #1): the r5 capture lost its
+# whole round to ONE transient tunnel hiccup — bench.py died on a
+# traceback before printing any JSON, and the round recorded null.
+# Backend/device acquisition is the only phase that can fail
+# transiently (the math after it is deterministic), so it gets a
+# bounded retry with backoff, and the FINAL failure prints one
+# structured JSON line (value null) instead of an unparseable stack.
+INIT_ATTEMPTS = int(os.environ.get("DSA_BENCH_INIT_ATTEMPTS", "3"))
+INIT_BACKOFF_S = float(os.environ.get("DSA_BENCH_INIT_BACKOFF", "5"))
+
+HEADLINE_METRIC = (
+    "agent-steps/sec, PSO Rastrigin-30D, 1,048,576 particles, 1 chip"
+)
+
+
+def _retry_backend_init(fn, attempts=INIT_ATTEMPTS,
+                        backoff_s=INIT_BACKOFF_S, sleep=time.sleep,
+                        label="backend-init"):
+    """Run ``fn`` with bounded retry + linear backoff.  Raises
+    ``SystemExit(3)`` after printing ONE structured failure line when
+    every attempt fails — a tunnel hiccup degrades the round's capture
+    to an explicit null record instead of nulling it silently."""
+    last = None
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — any init failure retries
+            last = e
+            if attempt < attempts:
+                sleep(backoff_s * attempt)
+    print(
+        json.dumps({
+            "metric": HEADLINE_METRIC + " (FAILED)",
+            "value": None,
+            "unit": "agent-steps/sec",
+            "vs_baseline": None,
+            "error": label,
+            "attempts": attempts,
+            "detail": f"{type(last).__name__}: {last}",
+        })
+    )
+    raise SystemExit(3)
 
 
 def _parity_gate():
@@ -73,9 +115,32 @@ def _parity_gate():
 
 
 def main():
-    parity_ok = _parity_gate()
-    opt = PSO("rastrigin", n=N, dim=DIM, seed=0, steps_per_kernel=64)
-    float(opt.state.gbest_fit)
+    # Touch the backend FIRST, inside the retry envelope: jax.devices()
+    # is where a broken tunnel/driver surfaces, and it is also what the
+    # infra-failure drill (tests/test_infra_failure_drill.py)
+    # monkeypatches to exercise this path without a real outage.
+    def _probe():
+        import jax
+
+        return jax.devices()
+
+    # Distinct labels per phase: only the devices probe is a pure
+    # "backend-init" signal; a gate or construction failure after N
+    # retries is recorded under its own phase name, so a
+    # deterministic bug cannot masquerade as a tunnel hiccup in the
+    # round artifact (the retry still helps when the hiccup surfaces
+    # late, e.g. the first real compile).
+    _retry_backend_init(_probe)
+    parity_ok = _retry_backend_init(_parity_gate, label="parity-gate")
+
+    from distributed_swarm_algorithm_tpu.models.pso import PSO
+
+    def _construct():
+        opt = PSO("rastrigin", n=N, dim=DIM, seed=0, steps_per_kernel=64)
+        float(opt.state.gbest_fit)
+        return opt
+
+    opt = _retry_backend_init(_construct, label="pso-construct")
 
     # Warmup: compile + first execution of the exact timed program.
     opt.run(BENCH_STEPS)

@@ -107,7 +107,78 @@ def bench(n: int, mode: str, steps: int, sort_every: int) -> None:
     )
 
 
+def bench_cpu_regimes(steps: int = 20) -> None:
+    """CPU-backend capture of BOTH hashgrid regimes at 65k (r8).
+
+    The r5 round recorded the converge row on-chip but LOST the
+    station-keeping row (the dangling "see BENCH r05" citation this
+    PR retires), and rounds without a chip attached previously
+    recorded NOTHING for either regime.  These rows are the
+    backend-tagged fixed-name twins: separate metric families from
+    the TPU rows (names end in ", cpu)"), so cross-backend values are
+    never gate-compared, and every round — tunnel or no tunnel —
+    carries a measured number for both regimes."""
+    if jax.default_backend() != "cpu":
+        # The cpu rows exist to be comparable ACROSS rounds; letting
+        # them silently record tunnel/TPU values would corrupt the
+        # family.  (run_all always runs the default TPU set; this
+        # mode is invoked explicitly.)
+        raise SystemExit("bench_swarm_tpu.py cpu: backend is not cpu")
+    metrics = {
+        "hashgrid": (
+            "agent-steps/sec, full protocol tick, 65536 agents "
+            "(separation=hashgrid, cpu)"
+        ),
+        "hashgrid-station": (
+            "agent-steps/sec, full protocol tick, 65536 agents "
+            "(separation=hashgrid-station, cpu)"
+        ),
+    }
+    n = 65_536
+    # NOTE: mirrors bench()'s hashgrid arena scenario (hw=256 torus,
+    # spread-250 spawn, cap 16, budget 1024) — keep the two in sync.
+    for mode, metric in metrics.items():
+        cfg = dsa.SwarmConfig().replace(
+            separation_mode="hashgrid", sort_every=1,
+            formation_shape="none",
+            world_hw=256.0, grid_max_per_cell=16,
+            hashgrid_overflow_budget=1024,
+        )
+        s = dsa.make_swarm(n, seed=0, spread=250.0)
+        s = dsa.with_tasks(
+            s,
+            jnp.asarray(
+                [[1.0, 1.0], [-2.0, 3.0], [5.0, -8.0], [0.0, 9.0]]
+            ),
+        )
+        target = (
+            s.pos if mode == "hashgrid-station"
+            else jnp.broadcast_to(jnp.asarray([50.0, 0.0]), s.pos.shape)
+        )
+        s = s.replace(
+            target=jnp.asarray(target),
+            has_target=jnp.ones_like(s.has_target),
+        )
+        # swarmlint: disable=retrace -- two-element regime loop; each regime is a distinct target setup compiled once and timed, exactly like bench() above
+        run = jax.jit(lambda st: dsa.swarm_rollout(st, None, cfg, steps))
+        holder = {"out": run(s)}
+        jax.block_until_ready(holder["out"].pos)
+
+        def once():
+            holder["out"] = run(s)
+
+        best = timeit_best(once, lambda: float(holder["out"].pos[0, 0]))
+        # swarmlint: disable=metric-fstring -- the two names are the literal strings in `metrics` above; fixed-name cpu-tagged families (compare.py pins exact strings)
+        report(metric, n * steps / best, "agent-steps/sec",
+               REFERENCE_AGENT_STEPS_PER_SEC)
+
+
 def main() -> None:
+    import sys
+
+    if len(sys.argv) > 1 and sys.argv[1] == "cpu":
+        bench_cpu_regimes()
+        return
     for n, mode, steps, sort_every in CONFIGS:
         bench(n, mode, steps, sort_every)
 

@@ -16,8 +16,10 @@ embedded measurement floats (NSGA-II's "HV 0.875" etc.) to '#'.
 Metrics present in only one round are listed informationally and do
 not gate.  Exit code 1 iff at least one regression exceeds the
 threshold.  Recorded metrics are throughputs (higher is better) with
-one exception: unit "findings" (the swarmlint hazard count from
-run_all's static gate) is lower-is-better and gates on growth.
+two exceptions: units "findings" (the swarmlint hazard count from
+run_all's static gate) and "rounds" (auction convergence rounds, r8)
+are lower-is-better and gate on growth.  Records with value null
+(structured failure lines) are never merged into the history.
 """
 
 from __future__ import annotations
@@ -59,6 +61,13 @@ def record(label: str, parsed_lines: list[dict],
     rnd = hist["rounds"].setdefault(label, {})
     for obj in parsed_lines:
         if "metric" not in obj or "value" not in obj:
+            continue
+        if obj["value"] is None:
+            # Structured failure records (bench.py backend-init
+            # failures, run_all's per-bench failure lines) carry
+            # value null by contract — they are stream diagnostics,
+            # not measurements, and must never enter the history as
+            # fake zeros the gate would then compare against.
             continue
         rnd[obj["metric"]] = {
             "value": obj["value"],
@@ -124,10 +133,11 @@ def compare(prev_label: str, cur_label: str, threshold: float = 0.2,
     for key in sorted(set(prev) & set(cur)):
         pv = float(prev[key][1]["value"])
         cv = float(cur[key][1]["value"])
-        if str(cur[key][1].get("unit", "")) == "findings":
-            # Lower-is-better count metric (swarmlint hygiene debt):
-            # gate on growth, never on paydown.  A clean baseline
-            # (0) regressing to any positive count always gates.
+        if str(cur[key][1].get("unit", "")) in ("findings", "rounds"):
+            # Lower-is-better count metrics (swarmlint hygiene debt;
+            # auction convergence rounds, r8): gate on growth, never
+            # on paydown.  A clean baseline (0) regressing to any
+            # positive count always gates.
             status = "ok"
             if cv > pv * (1.0 + threshold) or (pv == 0 and cv > 0):
                 status = "REGRESSION"

@@ -161,7 +161,7 @@ def decompose(tag: str) -> None:
     g, _ = _geometry(hw, cell, K)
 
     def build_only(s):
-        order, skey, rank, ok, sx, sy = _slots_sorted(
+        _, _, order, skey, rank, ok, sx, sy = _slots_sorted(
             s.pos, jnp.ones((n,), bool), hw, g, K
         )
         slot_s = jnp.where(ok, skey * K + rank, g * g * K)

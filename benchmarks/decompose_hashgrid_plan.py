@@ -1,0 +1,205 @@
+"""Decompose the hashgrid tick's spatial-structure cost: per-term
+duplicate builds (the pre-r8 tick) vs the single shared build
+(ops/hashgrid_plan.py) — the measured evidence for the r8 tentpole.
+
+Each term is timed as its own jitted program (warmed, scalar-synced,
+best-of-3 — the common.py methodology) on the bench_swarm_tpu 65k
+bounded-arena geometry (hw=256 torus, cell 2, K=16, spread-250 spawn):
+
+  build terms
+    bin            torus_cell_tables binning (cx, cy, key)
+    sort-build     the full cell sort + rank/ok/sorted-positions
+                   (the fused kernel's private r7 build)
+    csr            live-only counts/starts tables (portable stencil)
+    field-keys     the moments field's fine-grid re-binning
+                   (fine_cell_keys — what the shared plan deletes)
+    plan           ONE build_hashgrid_plan carrying all of the above
+
+  consumer terms
+    deposit-scatter   16-moment cell reduction via .at[key].add on
+                      shared keys (the production deposit)
+    deposit-sorted    the same sums off the plan's sorted order +
+                      segment boundaries (plan_cell_sums — the
+                      measured alternative; r5 TPU ledger had the
+                      forms within noise, this records the answer
+                      per backend)
+    portable-force    legacy separation_grid (re-bins, re-sorts, and
+                      gathers sorted keys 9x) vs build+
+                      separation_grid_plan (occupancy windowing)
+
+Metric names carry the backend (cpu/tpu) — build costs are not
+comparable across backends, so each backend is its own fixed-name
+regression family in the union gate from r8 on.
+
+Usage: python benchmarks/decompose_hashgrid_plan.py [N]
+"""
+
+from __future__ import annotations
+
+import sys
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from common import report, timeit_best
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu.ops.grid_moments import (
+    _moment_rows,
+    fine_cell_keys,
+    moments_deposit,
+)
+from distributed_swarm_algorithm_tpu.ops.hashgrid_plan import (
+    build_hashgrid_plan,
+    plan_cell_sums,
+    plan_geometry,
+)
+from distributed_swarm_algorithm_tpu.ops.neighbors import (
+    separation_grid,
+    separation_grid_plan,
+    torus_cell_tables,
+)
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 65_536
+HW = 256.0
+CELL = 2.0
+K = 16
+PS = 2.0
+K_SEP = 20.0
+EPS = 1e-3
+
+
+def _time(fn, *args) -> float:
+    """Best-of-3 seconds for one jitted call (warmed)."""
+    jfn = jax.jit(fn)
+    out = {"v": jfn(*args)}
+    jax.block_until_ready(out["v"])
+
+    def once():
+        out["v"] = jfn(*args)
+
+    return timeit_best(once, lambda: float(jnp.ravel(out["v"])[0]))
+
+
+def main() -> None:
+    backend = jax.default_backend()
+    g, _ = plan_geometry(HW, CELL)
+    s = dsa.make_swarm(N, seed=0, spread=250.0)
+    pos, alive = s.pos, s.alive
+
+    def bin_only(p):
+        cx, cy, key, _, _ = torus_cell_tables(p, HW, g)
+        return cx[0] + cy[0] + key[0] + jnp.sum(key)
+
+    def sort_build(p):
+        pl = build_hashgrid_plan(p, alive, HW, CELL, K, g=g)
+        return (
+            jnp.sum(pl.skey) + pl.order[0] + pl.rank[0]
+            + jnp.sum(pl.ok) + pl.sx[0] + pl.sy[0]
+        )
+
+    def csr_only(p):
+        _, _, key, _, _ = torus_cell_tables(p, HW, g)
+        key = jnp.where(alive, key, g * g)
+        counts = jnp.zeros((g * g,), jnp.int32).at[key].add(
+            1, mode="drop"
+        )
+        starts = jnp.cumsum(counts) - counts
+        return jnp.sum(counts) + starts[0]
+
+    def field_keys_only(p):
+        fkey, xt, yt = fine_cell_keys(p, alive, HW, g)
+        return jnp.sum(fkey) + xt[0] + yt[0]
+
+    def plan_full(p):
+        pl = build_hashgrid_plan(
+            p, alive, HW, CELL, K, need_csr=True,
+            field_sep_cell=CELL, g=g,
+        )
+        return (
+            jnp.sum(pl.skey) + jnp.sum(pl.ok) + jnp.sum(pl.counts)
+            + jnp.sum(pl.fkey) + pl.sx[0] + pl.xt[0]
+        )
+
+    t_bin = _time(bin_only, pos)
+    t_sort = _time(sort_build, pos)
+    t_csr = _time(csr_only, pos)
+    t_fkeys = _time(field_keys_only, pos)
+    t_plan = _time(plan_full, pos)
+
+    jplan = jax.jit(
+        partial(
+            build_hashgrid_plan, torus_hw=HW, cell=CELL,
+            max_per_cell=K, need_csr=True, field_sep_cell=CELL, g=g,
+        )
+    )
+    plan = jplan(pos, alive)
+    jax.block_until_ready(plan.skey)
+
+    def deposit_scatter(p, keys3):
+        return jnp.sum(
+            moments_deposit(p, s.vel, alive, HW, CELL, keys=keys3)
+        )
+
+    def deposit_sorted(pl, p):
+        rows = _moment_rows(pl.xt, pl.yt, s.vel)
+        return jnp.sum(plan_cell_sums(pl, rows))
+
+    keys3 = (plan.fkey, plan.xt, plan.yt)
+    t_dep_scatter = _time(deposit_scatter, pos, keys3)
+    t_dep_sorted = _time(deposit_sorted, plan, pos)
+
+    def force_legacy(p):
+        return jnp.sum(separation_grid(
+            p, alive, K_SEP, PS, jnp.asarray(EPS), cell=CELL,
+            max_per_cell=K, torus_hw=HW,
+        ))
+
+    def force_plan(p):
+        pl = build_hashgrid_plan(
+            p, alive, HW, CELL, K, need_csr=True, g=g
+        )
+        return jnp.sum(separation_grid_plan(
+            p, alive, K_SEP, PS, jnp.asarray(EPS), pl
+        ))
+
+    t_force_legacy = _time(force_legacy, pos)
+    t_force_plan = _time(force_plan, pos)
+
+    per_term = t_sort + t_fkeys + t_csr
+    print(
+        f"# decompose (N={N}, g={g}, K={K}, {backend}) ms: "
+        f"bin {t_bin * 1e3:.2f} | sort-build {t_sort * 1e3:.2f} | "
+        f"csr {t_csr * 1e3:.2f} | field-keys {t_fkeys * 1e3:.2f} | "
+        f"plan(all) {t_plan * 1e3:.2f} vs per-term "
+        f"{per_term * 1e3:.2f} | deposit scatter "
+        f"{t_dep_scatter * 1e3:.2f} vs sorted "
+        f"{t_dep_sorted * 1e3:.2f} | portable force legacy "
+        f"{t_force_legacy * 1e3:.2f} vs plan {t_force_plan * 1e3:.2f}"
+    )
+    # Fixed-name rows, one family per (N, backend) — N rides in the
+    # name so an argv-overridden run can never masquerade as the 65k
+    # family; builds/sec is higher-is-better, so a faster backend
+    # round can never false-gate.
+    rows = [
+        (f"hashgrid-plan-single-build/sec, {N} agents ({backend})",
+         1.0 / t_plan),
+        (f"hashgrid-perterm-builds/sec, {N} agents ({backend})",
+         1.0 / per_term),
+        (f"cic-deposit-scatter/sec, {N} agents ({backend})",
+         1.0 / t_dep_scatter),
+        (f"cic-deposit-sorted-segments/sec, {N} agents ({backend})",
+         1.0 / t_dep_sorted),
+        (f"hashgrid-portable-force-legacy/sec, {N} agents ({backend})",
+         1.0 / t_force_legacy),
+        (f"hashgrid-portable-force-plan/sec, {N} agents ({backend})",
+         1.0 / t_force_plan),
+    ]
+    for metric, value in rows:
+        # swarmlint: disable=metric-fstring -- names are the literal prefixes enumerated in `rows` above plus the backend tag, a two-element enumeration (cpu/tpu) forming stable per-backend families (compare.py pins exact strings)
+        report(metric, value, "builds/sec", 0.0)
+
+
+if __name__ == "__main__":
+    main()

@@ -58,6 +58,10 @@ BENCHES = [
     # fixed-name cic-deposit / cic-field / gridmean-step metrics are
     # how the commensurate moments-deposit lever is tracked.
     "decompose_gridmean.py",
+    # r8: shared-plan build decomposition — fixed-name (per-backend)
+    # single-build vs per-term-build rows; how the one-build-per-tick
+    # tentpole is regression-tracked.
+    "decompose_hashgrid_plan.py",
 ]
 
 # Extra argv for benches whose no-arg default is not the gate set —
@@ -69,6 +73,9 @@ BENCH_ARGS = {
 }
 
 QUICK_SKIP = {
+    # r8: the price-war rounds sweep (~10k Jacobi rounds at 1024^2)
+    # makes the auction bench minutes-heavy off-chip — full gate only.
+    "bench_auction.py",
     "bench_pso_1m_ackley.py",
     "bench_islands.py",
     "bench_bat_1m.py",
@@ -92,13 +99,39 @@ QUICK_SKIP = {
     "bench_dim_sharded.py",
     "measure_window_recall.py",
     "decompose_gridmean.py",
+    "decompose_hashgrid_plan.py",
 }
+
+
+def _fail_record(name: str, error: str, detail: str) -> dict:
+    """One structured failure line per failed bench (r8, VERDICT r5
+    #8): machine-parseable on stdout, so a harness reading the stream
+    sees WHICH bench died and why instead of inferring it from a
+    missing row.  ``value`` is null — ``compare.record`` skips null
+    values, so failures never enter BENCH_HISTORY as fake zeros."""
+    rec = {
+        "metric": f"bench-failure, {name}",
+        "value": None,
+        "unit": "failure",
+        "vs_baseline": None,
+        "error": error,
+        "detail": detail[-500:],
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
 
 
 def _run_one(cmd, cwd, recorded, record: bool) -> bool:
     """Run one bench subprocess; print/record its JSON lines.  Returns
-    False on failure/timeout."""
-    name = os.path.basename(cmd[-1])
+    False on failure/timeout (after printing a structured failure
+    record)."""
+    # The bench NAME is the .py element, not cmd[-1] — arg-bearing
+    # invocations ("bench_swarm_tpu.py cpu", "decompose_gridmean.py
+    # gate") must not report as 'bench-failure, cpu'.
+    name = next(
+        (os.path.basename(c) for c in cmd if c.endswith(".py")),
+        os.path.basename(cmd[-1]),
+    )
     try:
         # 3600 s: bench_swarm_tpu's r5 arena rows compile several
         # multi-minute Mosaic programs and overran the old 1800 s cap
@@ -108,6 +141,7 @@ def _run_one(cmd, cwd, recorded, record: bool) -> bool:
         )
     except subprocess.TimeoutExpired:
         print(f"# {name} timed out after 3600s", file=sys.stderr)
+        _fail_record(name, "timeout", "3600s cap")
         return False
     for line in proc.stdout.splitlines():
         if line.startswith("{"):
@@ -122,6 +156,7 @@ def _run_one(cmd, cwd, recorded, record: bool) -> bool:
                 if proc.stderr.strip() else "no stderr")
         print(f"# {name} failed (rc={proc.returncode}): {tail}",
               file=sys.stderr)
+        _fail_record(name, f"rc={proc.returncode}", tail)
         return False
     return True
 
@@ -170,6 +205,27 @@ def _run_swarmlint(root, recorded, record: bool) -> bool:
     return proc.returncode == 0
 
 
+def _default_backend() -> str:
+    """The backend jax will actually pick, probed in a SUBPROCESS —
+    env-var sniffing misses the no-JAX_PLATFORMS default case, and
+    importing jax in THIS process on a tunnel image could hold a chip
+    lease for the whole suite.  Returns "" when the probe fails (the
+    cpu-capture hook then simply doesn't fire)."""
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable, "-c",
+                "import jax; print(jax.default_backend())",
+            ],
+            capture_output=True, text=True, timeout=300,
+        )
+        return proc.stdout.strip().splitlines()[-1] if (
+            proc.returncode == 0 and proc.stdout.strip()
+        ) else ""
+    except Exception:
+        return ""
+
+
 def main() -> int:
     import argparse
 
@@ -211,6 +267,21 @@ def main() -> int:
         ok = _run_one(
             [sys.executable, os.path.join(HERE, name)]
             + BENCH_ARGS.get(name, []),
+            root, recorded, bool(args.record),
+        )
+        failures += 0 if ok else 1
+    if not args.quick and _default_backend() == "cpu":
+        # CPU-backend round (no chip attached): capture the hashgrid
+        # regime pair under their cpu-tagged fixed names (r8) so both
+        # regimes stay regression-gated even on tunnel-less rounds —
+        # the r5 round lost its station-keeping row to exactly this
+        # gap.  The script's own backend guard refuses to run this
+        # mode against a non-cpu backend.
+        ok = _run_one(
+            [
+                sys.executable,
+                os.path.join(HERE, "bench_swarm_tpu.py"), "cpu",
+            ],
             root, recorded, bool(args.record),
         )
         failures += 0 if ok else 1

@@ -70,6 +70,7 @@ from .ops.aco import (
     tour_lengths,
 )
 from .ops.gwo import GWOState, gwo_init, gwo_run, gwo_step
+from .ops.hashgrid_plan import HashgridPlan, build_hashgrid_plan
 from .ops.memetic import gd_refine, memetic_run, refine_pbest
 from .ops.pallas import fused_pso_run
 from .ops.physics import apf_forces, formation_targets, physics_step
@@ -98,6 +99,7 @@ __all__ = [
     "revive",
     "allocation_step", "arbitrate", "utility_matrix", "task_status_view",
     "physics_step", "apf_forces", "formation_targets",
+    "HashgridPlan", "build_hashgrid_plan",
     "FOLLOWER", "ELECTION_WAIT", "LEADER",
     "TASK_OPEN", "TASK_TENTATIVE", "TASK_ASSIGNED", "TASK_LOCKED",
     "NO_LEADER", "NO_CAP", "NO_WINNER",

@@ -343,12 +343,19 @@ class CpuSwarm:
             u > np.float32(cfg.utility_threshold)
         )
 
+        # phases=1 = the FLAT schedule, matching the JAX tick's r8
+        # switch (ops/allocation.py) — the oracle parity contract is
+        # bit-identical outcomes, so the schedules must agree.
         if self.backend == "native":
-            res = _native.auction_assign(u, feasible, eps=cfg.auction_eps)
+            res = _native.auction_assign(
+                u, feasible, eps=cfg.auction_eps, phases=1
+            )
         else:
             from ..ops.auction import auction_assign_np
 
-            res = auction_assign_np(u, feasible, eps=cfg.auction_eps)
+            res = auction_assign_np(
+                u, feasible, eps=cfg.auction_eps, phases=1
+            )
         got = res.task_agent >= 0
         row = np.maximum(res.task_agent, 0)
         self.task_winner = np.where(

@@ -174,7 +174,7 @@ def auction_allocation_step(
     must clear ``utility_threshold`` (agent.py:297), and nothing happens
     while the swarm is leaderless (same stance as the greedy path).
     """
-    from .auction import auction_assign_scaled
+    from .auction import auction_assign
 
     if state.n_tasks == 0:
         return state
@@ -213,7 +213,16 @@ def auction_allocation_step(
         # other auction_every - 1 ticks.
         u = utility_matrix(st, cfg)
         feasible = st.alive[:, None] & (u > cfg.utility_threshold)
-        res = auction_assign_scaled(u, feasible, eps=cfg.auction_eps)
+        # FLAT auction (r8, VERDICT r5 #7): protocol utilities are
+        # bounded by utility_scale (= 100 by default), and the
+        # measured rounds tables (docs/PERFORMANCE.md r8) show flat
+        # eps=0.25 beating every eps-scaled schedule in that regime on
+        # BOTH instance classes — uniform draws (r5: 141 vs 1206
+        # rounds at 1024^2) and shallow price wars (r8: 398 vs 4677).
+        # eps-scaling only wins deep price wars (max-util/eps ~ 4000),
+        # which the utility model cannot produce; auction_assign_scaled
+        # stays available for workloads that can (see its docstring).
+        res = auction_assign(u, feasible, eps=cfg.auction_eps)
         got = res.task_agent >= 0                                  # [T]
         row = jnp.maximum(res.task_agent, 0)
         winner = jnp.where(got, st.agent_id[row], NO_WINNER)

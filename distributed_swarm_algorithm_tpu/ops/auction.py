@@ -217,8 +217,18 @@ def auction_assign_scaled(
     """eps-scaled auction: coarse-to-fine eps phases, each warm-starting
     from the previous phase's prices.  Same ``max(N,T) * eps`` guarantee
     as the flat auction (the symmetric forward auction is eps-optimal
-    from ANY starting prices) but far fewer total rounds on hard
-    instances — Bertsekas' standard acceleration."""
+    from ANY starting prices).
+
+    Measured regime split (r5 + r8 rounds tables, docs/PERFORMANCE.md;
+    1024^2, eps=0.25): scaling wins ONLY on DEEP price wars —
+    max-utility/eps ~ 4000 (hot=1000: 1,031 rounds vs 3,937 flat).  On
+    uniform draws (141 vs 1,206) and SHALLOW price wars at the
+    protocol's utility_scale=100 (398 vs 4,677) the flat auction wins,
+    because every phase re-seats all S agents from scratch and the
+    coarse phases' price overshoot erases the fine phases' bidding
+    margins.  The protocol tick therefore runs FLAT
+    (ops/allocation.py); reach for this form when your utility scale
+    genuinely dwarfs the eps you need."""
     if feasible is None:
         feasible = util > 0.0
     values = _square_values(util, feasible)

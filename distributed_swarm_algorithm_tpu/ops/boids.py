@@ -449,20 +449,50 @@ def boids_forces_gridmean(
     # as one VMEM pass (ops/pallas/grid_separation.py) — the r4 fix
     # for gridmean's gather-bound cost (measured ~60x window at 65k)
     # and its 1M long-scan worker crash, both in separation_grid.
+    plan = None
     if gridmean_uses_hashgrid(p, d, pos.dtype):
         from ..utils.platform import on_tpu
+        from .hashgrid_plan import build_hashgrid_plan
+        from .pallas.grid_separation import _geometry
         from .pallas.grid_separation import separation_hashgrid_pallas
 
+        sep_cell = p.grid_sep_cell if p.grid_sep_cell > 0 else p.r_sep
+        g_plan, _ = _geometry(
+            p.half_width, sep_cell, p.grid_max_per_cell
+        )
+        # One shared spatial build per step (r8, ops/hashgrid_plan):
+        # the kernel and — when the commensurate geometry coincides —
+        # the moments field below both consume it instead of each
+        # running its own bin+sort.
+        share_field = False
+        if p.align_deposit == "moments":
+            from .grid_moments import (
+                align_cell_arg,
+                commensurate_geometry,
+            )
+
+            share_field = commensurate_geometry(
+                float(p.half_width), float(sep_cell),
+                align_cell_arg(p.align_cell),
+            )[0] == g_plan
+        plan = build_hashgrid_plan(
+            pos, jnp.ones((n,), bool), float(p.half_width),
+            float(sep_cell), p.grid_max_per_cell,
+            field_sep_cell=float(sep_cell) if share_field else None,
+            field_align_cell=(
+                align_cell_arg(p.align_cell) if share_field else None
+            ),
+            g=g_plan,
+        )
         sep = separation_hashgrid_pallas(
             pos, jnp.ones((n,), bool), 1.0, float(p.r_sep),
             float(p.eps),
-            cell=float(
-                p.grid_sep_cell if p.grid_sep_cell > 0 else p.r_sep
-            ),
+            cell=float(sep_cell),
             max_per_cell=p.grid_max_per_cell,
             torus_hw=float(p.half_width),
             overflow_budget=p.grid_overflow_budget,
             interpret=not on_tpu(),
+            plan=plan,
         )
     else:
         sep = _neighbors.separation_grid(
@@ -481,12 +511,14 @@ def boids_forces_gridmean(
         # scatters — see ops/grid_moments.py for the algebra and the
         # r5 ledger sizing this lever.
         from .grid_moments import align_cell_arg, cic_field_commensurate
+        from .hashgrid_plan import plan_field_keys
 
         sep_cell = p.grid_sep_cell if p.grid_sep_cell > 0 else p.r_sep
         align, coh = cic_field_commensurate(
             pos, vel, None, torus_hw=float(hw),
             sep_cell=float(sep_cell),
             align_cell=align_cell_arg(p.align_cell),
+            keys=plan_field_keys(plan) if plan is not None else None,
         )
     else:
         g = max(1, int(round(2.0 * hw / p.align_cell)))

@@ -359,13 +359,22 @@ def cic_field_commensurate(
     torus_hw: float,
     sep_cell: float,
     align_cell: Optional[float] = None,
+    keys: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """(align, coh) [N, 2]: the full commensurate moments CIC field —
     deposit + sample sharing one binning pass.  Drop-in replacement
     for the four-corner bilinear field on the commensurate alignment
-    grid (fp-reassociation tolerance)."""
-    g, *_ = commensurate_geometry(torus_hw, sep_cell, align_cell)
-    keys = fine_cell_keys(pos, alive, torus_hw, g)
+    grid (fp-reassociation tolerance).
+
+    ``keys`` (r8): a precomputed ``(key, x~, y~)`` fine-grid binning —
+    the shared hashgrid plan's field triple
+    (``ops/hashgrid_plan.plan_field_keys``), produced by the SAME
+    ``fine_cell_keys`` math — so a tick that already built its
+    spatial index deposits and samples off it instead of re-binning
+    the swarm here."""
+    if keys is None:
+        g, *_ = commensurate_geometry(torus_hw, sep_cell, align_cell)
+        keys = fine_cell_keys(pos, alive, torus_hw, g)
     grid = moments_deposit(
         pos, vel, alive, torus_hw, sep_cell, align_cell, keys=keys
     )

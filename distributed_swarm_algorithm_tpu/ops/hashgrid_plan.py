@@ -1,0 +1,300 @@
+"""Single-build shared spatial index for the hashgrid protocol tick.
+
+The r7 tick with ``separation_mode='hashgrid'`` paid for its spatial
+structure several times per step: the fused separation kernel ran its
+own cell-sort/slot build (``ops/pallas/grid_separation._slots_sorted``),
+the portable torus grid rebuilt CSR tables AND gathered sorted cell
+keys 9x per force pass, the r6 moments-deposit field re-binned the
+whole swarm onto its commensurate fine grid, and the overflow-rescue
+pass re-derived its agents' cell coordinates from scratch.  ABMax and
+JaxMARL (PAPERS.md) both converge on the same discipline — build the
+spatial index ONCE per step and let every consumer read it — and the
+r5 ledger already measured exact stable binning as a ~2.3 ms/tick
+scatter-class floor at 65k: duplicating it is the one cost the tick
+can simply stop paying.
+
+This module is that single build: :class:`HashgridPlan` is a pytree
+(jit/scan/checkpoint-safe) holding everything the hashgrid force terms
+need —
+
+  - the per-agent cell assignment (``cx``, ``cy``, ``key``) from the
+    SHARED ``ops/neighbors.torus_cell_tables`` binning (clip
+    convention, dead agents keyed past the grid — the kernel's r5
+    contract), so no consumer can drift;
+  - the stable cell sort (``order``, ``skey``, ``rank``, ``ok``,
+    ``sx``, ``sy``) — one variadic ``lax.sort``, the same build the
+    fused kernel ran privately before r8;
+  - live-only CSR occupancy (``counts``, ``starts``) for the portable
+    3x3 gather — which now tests ``slot < counts[cell]`` instead of
+    gathering sorted keys per stencil cell (9 [N, K] int gathers
+    become 9 [N] table gathers, and EMPTY cells are skipped by the
+    occupancy test alone: the portable twin of the kernels' r5
+    ``pl.when`` occupancy skip);
+  - the commensurate fine-grid field binning (``fkey``, ``xt``,
+    ``yt``) for the moments-deposit CIC field, built only when the
+    field's geometry is commensurate with the separation grid
+    (``ops/grid_moments.commensurate_geometry`` — the canonical
+    ``cell_a = 4*cell_sep`` case), so the deposit and sample reuse
+    the plan instead of re-binning.
+
+Consumers: ``ops/physics.apf_forces`` (the protocol tick),
+``ops/boids.boids_forces_gridmean`` (the flocking twin),
+``ops/pallas/grid_separation.separation_hashgrid_pallas`` (``plan=``),
+``ops/neighbors.separation_grid_plan`` (portable path), and the
+kernel's LOCAL rescue pass (reads ``cx``/``cy`` by gather instead of
+re-binning).
+
+Field-key semantics: ``fkey``/``xt``/``yt`` follow
+``grid_moments.fine_cell_keys`` exactly (positions wrapped onto the
+torus before binning — the r6 choice that keeps edge-cell moments
+bounded), while the separation keys follow ``torus_cell_tables``
+(clip).  The two coincide for every agent inside ``[-hw, hw)`` — the
+documented hashgrid caller contract — and the plan carries both so
+neither consumer's semantics moved in r8.
+
+:func:`plan_cell_sums` is the sorted-order segment reduction the plan
+enables (per-cell sums off the existing sort, scatter only at segment
+boundaries).  The r5 ledger measured sorted/unsorted/segment-sum
+deposits within noise of each other on-chip, so the production deposit
+stays a plain scatter on the shared keys; the sorted form is kept,
+tested, and measured by ``benchmarks/decompose_hashgrid_plan.py`` as
+the honest record (see docs/PERFORMANCE.md r8).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def plan_geometry(torus_hw: float, cell: float) -> Tuple[int, float]:
+    """(g, cell_eff) for the shared plan's cell grid tiling the torus
+    ``[-hw, hw)^2``.
+
+    Uses the fused kernel's rounding rule — ``floor(2hw/cell)`` rounded
+    DOWN to a multiple of 16 — whenever that leaves a usable grid, so
+    the plan, the kernel (``ops/pallas/grid_separation._geometry``) and
+    the commensurate field grid (``grid_moments.commensurate_geometry``)
+    all agree on one binning.  Tiny worlds (fewer than 16 aligned
+    cells) fall back to the plain portable tiling ``g = floor(2hw /
+    cell)`` — the field cannot share there (it requires the aligned
+    geometry) but the separation terms still share one build.
+    Rounding g DOWN only grows ``cell_eff``, so a stencil sized for
+    ``cell`` keeps covering the separation radius.
+    """
+    g16 = (int(2.0 * torus_hw / cell) // 16) * 16
+    if g16 >= 16:
+        return g16, 2.0 * torus_hw / g16
+    g = max(1, int(2.0 * torus_hw / cell))
+    return g, 2.0 * torus_hw / g
+
+
+@jax.tree_util.register_pytree_node_class
+class HashgridPlan:
+    """The one-build-per-tick spatial index (module doc).  A pytree:
+    array fields are children (jit/scan/vmap/checkpoint-safe), the
+    geometry is static aux data (hashable, participates in jit cache
+    keys).  Optional fields (``counts``/``starts`` — CSR, portable
+    path only; ``fkey``/``xt``/``yt`` — field binning) are ``None``
+    when not built; ``None`` is a pytree-transparent child."""
+
+    ARRAY_FIELDS = (
+        "cx", "cy", "key", "order", "skey", "rank", "ok", "sx", "sy",
+        "counts", "starts", "fkey", "xt", "yt",
+    )
+
+    def __init__(self, *, g, cell_eff, torus_hw, max_per_cell,
+                 cx, cy, key, order, skey, rank, ok, sx, sy,
+                 counts=None, starts=None, fkey=None, xt=None, yt=None):
+        self.g = g
+        self.cell_eff = cell_eff
+        self.torus_hw = torus_hw
+        self.max_per_cell = max_per_cell
+        self.cx = cx
+        self.cy = cy
+        self.key = key
+        self.order = order
+        self.skey = skey
+        self.rank = rank
+        self.ok = ok
+        self.sx = sx
+        self.sy = sy
+        self.counts = counts
+        self.starts = starts
+        self.fkey = fkey
+        self.xt = xt
+        self.yt = yt
+
+    @property
+    def has_csr(self) -> bool:
+        return self.counts is not None
+
+    @property
+    def has_field(self) -> bool:
+        return self.fkey is not None
+
+    def tree_flatten(self):
+        children = tuple(getattr(self, f) for f in self.ARRAY_FIELDS)
+        aux = (self.g, self.cell_eff, self.torus_hw, self.max_per_cell)
+        return children, aux
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        g, cell_eff, torus_hw, max_per_cell = aux
+        kw = dict(zip(cls.ARRAY_FIELDS, children))
+        return cls(
+            g=g, cell_eff=cell_eff, torus_hw=torus_hw,
+            max_per_cell=max_per_cell, **kw,
+        )
+
+    def __repr__(self) -> str:  # debugging aid, not a contract
+        opt = [f for f in ("counts", "fkey") if getattr(self, f) is not None]
+        return (
+            f"HashgridPlan(g={self.g}, cell_eff={self.cell_eff:.4g}, "
+            f"torus_hw={self.torus_hw}, K={self.max_per_cell}, "
+            f"extras={opt})"
+        )
+
+
+def build_hashgrid_plan(
+    pos: jax.Array,
+    alive: jax.Array,
+    torus_hw: float,
+    cell: float,
+    max_per_cell: int,
+    need_csr: bool = False,
+    field_sep_cell: Optional[float] = None,
+    field_align_cell: Optional[float] = None,
+    g: Optional[int] = None,
+) -> HashgridPlan:
+    """Build the shared plan: one binning + one stable cell sort.
+
+    ``need_csr``: also materialize the live-only CSR occupancy tables
+    (the portable 3x3 gather's stencil index; the fused kernel derives
+    its occupancy-skip tables from ``skey``/``ok`` directly and does
+    not want the [g*g] scatter+cumsum back — dropping it was the r5
+    build win at 1M where g*g > N).
+
+    ``field_sep_cell``: when set, additionally bin the swarm onto the
+    commensurate moments-field fine grid (``grid_moments.
+    fine_cell_keys`` semantics).  The fine grid is only attached when
+    it coincides with the plan's own grid (``commensurate_geometry``'s
+    g_fine == plan g — always true on the fused-kernel geometry with
+    ``field_sep_cell == cell``); a mismatched geometry raises, because
+    silently carrying a second, different binning would defeat the
+    plan's no-drift contract — the caller should bin separately and
+    knowingly.
+
+    ``g``: explicit cell count per axis, bypassing
+    :func:`plan_geometry` — for callers (the fused kernel's direct
+    entry point) whose geometry is already resolved; avoids the
+    float round-trip of re-deriving ``g`` from ``cell_eff``.
+    """
+    from .grid_moments import commensurate_geometry, fine_cell_keys
+    from .neighbors import torus_cell_tables
+
+    n = pos.shape[0]
+    if g is None:
+        g, cell_eff = plan_geometry(torus_hw, cell)
+    else:
+        cell_eff = 2.0 * torus_hw / g
+    cx, cy, key_raw, _, _ = torus_cell_tables(pos, torus_hw, g)
+    # Dead agents are keyed PAST the grid (the kernel's r5 convention:
+    # they claim no slots, crowd no cells, and the CSR occupancy below
+    # counts live agents only).
+    key = jnp.where(alive, key_raw, g * g)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    # One variadic sort, iota tie-break = stability without is_stable
+    # (the exact r5 kernel build, now shared by every consumer).
+    skey, order, sx, sy = jax.lax.sort(
+        (key, iota, pos[:, 0], pos[:, 1]), num_keys=2
+    )
+    run_start = jnp.where(
+        skey != jnp.concatenate([skey[:1] - 1, skey[:-1]]), iota, 0
+    )
+    rank = iota - jax.lax.cummax(run_start)
+    ok = (rank < max_per_cell) & (skey < g * g)
+
+    counts = starts = None
+    if need_csr:
+        # Live-only occupancy over the bounded g*g key space (dead
+        # agents carry key g*g -> dropped).  One scatter + exclusive
+        # cumsum replaces the 9 searchsorted binary searches AND the 9
+        # per-stencil [N, K] sorted-key gathers of the pre-plan
+        # portable path (separation_grid_plan consumes these).
+        counts = (
+            jnp.zeros((g * g,), jnp.int32)
+            .at[key].add(1, mode="drop")
+        )
+        starts = jnp.cumsum(counts) - counts
+
+    fkey = xt = yt = None
+    if field_sep_cell is not None:
+        g_fine, _, _, _, _ = commensurate_geometry(
+            torus_hw, field_sep_cell, field_align_cell
+        )
+        if g_fine != g:
+            raise ValueError(
+                f"moments-field fine grid (g_fine={g_fine}, from "
+                f"sep_cell={field_sep_cell}) does not coincide with "
+                f"the plan grid (g={g}, from cell={cell}); the shared "
+                "plan only carries ONE binning — bin the field "
+                "separately (pass field_sep_cell=None) for split "
+                "geometries"
+            )
+        fkey, xt, yt = fine_cell_keys(pos, alive, torus_hw, g_fine)
+
+    return HashgridPlan(
+        g=g, cell_eff=cell_eff, torus_hw=torus_hw,
+        max_per_cell=max_per_cell,
+        cx=cx, cy=cy, key=key, order=order, skey=skey, rank=rank,
+        ok=ok, sx=sx, sy=sy, counts=counts, starts=starts,
+        fkey=fkey, xt=xt, yt=yt,
+    )
+
+
+def plan_field_keys(plan: HashgridPlan):
+    """The ``keys=(key, x~, y~)`` triple ``grid_moments`` consumers
+    accept, or ``None`` when the plan was built without the field
+    binning."""
+    if plan.fkey is None:
+        return None
+    return plan.fkey, plan.xt, plan.yt
+
+
+def plan_cell_sums(plan: HashgridPlan, vals: jax.Array) -> jax.Array:
+    """[g*g, C] per-cell sums of per-agent ``vals`` [N, C], computed
+    off the plan's EXISTING sorted order: a gather into sorted order,
+    the gather-free segmented reduction of ``neighbors.
+    seg_sums_sorted``, and one scatter touching only segment-BOUNDARY
+    rows — no full [N, C] scatter.
+
+    Exactness contract: cells are the plan's separation cells (clip
+    binning, dead agents dropped).  For the moments-field deposit this
+    coincides with ``fine_cell_keys`` binning exactly when every agent
+    lies inside the torus (the hashgrid caller contract); the
+    production deposit therefore stays on the plain shared-key scatter
+    (measured within noise of the segment form on-chip, r5 ledger) and
+    this form is the measured alternative, kept honest by
+    tests/test_shared_plan.py and benchmarks/decompose_hashgrid_plan.py.
+    """
+    from .neighbors import seg_sums_sorted
+
+    g2 = plan.g * plan.g
+    svals = vals[plan.order]
+    skey = plan.skey
+    boundary = jnp.concatenate(
+        [jnp.ones((1,), bool), skey[1:] != skey[:-1]]
+    )
+    totals = seg_sums_sorted(boundary, svals)
+    # Scatter ONE row per occupied cell: non-boundary rows are sent to
+    # the dropped index, as are dead/overflow segments (key g*g).
+    idx = jnp.where(boundary & (skey < g2), skey, g2)
+    return (
+        jnp.zeros((g2, vals.shape[1]), vals.dtype)
+        .at[idx].add(
+            jnp.where(boundary[:, None], totals, 0.0), mode="drop"
+        )
+    )

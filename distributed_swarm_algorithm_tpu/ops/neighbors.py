@@ -428,6 +428,99 @@ def torus_cell_tables(pos: jax.Array, torus_hw: float, g: int):
     return cx, cy, key, counts, starts
 
 
+def separation_grid_plan(
+    pos: jax.Array,
+    alive: jax.Array,
+    k_sep: float,
+    personal_space: float,
+    eps: float,
+    plan,
+) -> jax.Array:
+    """Torus spatial-hash separation force off a prebuilt shared
+    :class:`~..ops.hashgrid_plan.HashgridPlan` (must carry CSR), [N, 2].
+
+    Force semantics match ``separation_grid(torus_hw=...)`` — same
+    mod-form minimum-image wrap, same norm/divide distance math, same
+    per-gather ``max_per_cell`` truncation — with two deliberate,
+    documented deltas riding the shared build (both are the fused
+    kernel's r5 conventions, so the two hashgrid backends now agree):
+
+      - dead agents claim no slots (they are keyed past the grid by
+        the plan build), so a cell crowded with dead agents cannot
+        push live neighbors past the occupancy cap;
+      - the stencil membership test is OCCUPANCY-based:
+        ``slot < counts[cell]`` replaces the pre-plan ``skeys[idx] ==
+        nkey`` comparison, which deletes the 9 per-stencil [N, K]
+        sorted-key gathers (the portable twin of the kernels'
+        occupancy skip — an empty stencil cell now costs one [N]
+        table read and an always-false compare, no gather of sorted
+        keys at all).
+
+    Identical forces whenever no cell's LIVE occupancy exceeds the
+    cap (exactness there is pinned by tests/test_shared_plan.py); past
+    the cap both paths truncate to the first ``max_per_cell`` agents
+    in sort order, the portable cap contract since r5.
+    """
+    n = pos.shape[0]
+    if plan.counts is None:
+        raise ValueError(
+            "separation_grid_plan needs a plan built with "
+            "need_csr=True (the portable path's stencil tables)"
+        )
+    g = plan.g
+    if g < 3:
+        raise ValueError(
+            f"torus tiled into a {g}-cell grid; the wrapping 3x3 "
+            "stencil needs g >= 3 (use dense separation for such "
+            "tiny worlds)"
+        )
+    if plan.cell_eff < personal_space:
+        raise ValueError(
+            f"plan cell ({plan.cell_eff}) must be >= personal_space "
+            f"({personal_space}) for the 3x3 stencil to cover the "
+            "separation radius"
+        )
+    torus_hw = plan.torus_hw
+    cx, cy = plan.cx, plan.cy
+    spos = jnp.stack([plan.sx, plan.sy], axis=1)
+    sorig = plan.order
+    counts, starts = plan.counts, plan.starts
+
+    def wrap(diff):
+        return jnp.mod(diff + torus_hw, 2.0 * torus_hw) - torus_hw
+
+    window = jnp.arange(plan.max_per_cell)
+    me = jnp.arange(n)
+    force = jnp.zeros_like(pos)
+    for dx in (-1, 0, 1):
+        for dy in (-1, 0, 1):
+            nkey = jnp.mod(cx + dx, g) * g + jnp.mod(cy + dy, g)
+            occ = counts[nkey]                              # [N]
+            idx = starts[nkey][:, None] + window[None, :]   # [N, K]
+            idx_c = jnp.minimum(idx, n - 1)
+            # Occupancy windowing: in-window slots of a LIVE-keyed
+            # cell are live by construction (dead agents sort past
+            # the grid), so no sorted-key and no alive gathers.
+            in_cell = window[None, :] < occ[:, None]
+            npos = spos[idx_c]                              # [N, K, 2]
+            diff = wrap(pos[:, None, :] - npos)
+            dist = jnp.linalg.norm(diff, axis=-1)
+            dist_c = jnp.maximum(dist, eps)
+            near = (
+                in_cell
+                & alive[:, None]
+                & (dist < personal_space)
+                & (sorig[idx_c] != me[:, None])
+            )
+            mag = k_sep / (dist_c * dist_c)
+            unit = diff / dist_c[..., None]
+            force = force + jnp.sum(
+                jnp.where(near[..., None], mag[..., None] * unit, 0.0),
+                axis=1,
+            )
+    return force
+
+
 def separation_grid(
     pos: jax.Array,
     alive: jax.Array,

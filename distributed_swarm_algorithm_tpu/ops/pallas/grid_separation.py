@@ -464,31 +464,27 @@ def _lane_chunk(L: int, target: int = 4096) -> int:
 
 
 def _slots_sorted(pos, alive, torus_hw, g, K):
-    """(order, skey, rank, ok, sx, sy): the cell-sorted view of the
-    swarm — one variadic sort (iota tie-break = stable), run-position
-    ranks via cummax, no CSR tables (r5; see module doc).  Cell
-    assignment comes from the shared torus_cell_tables (binning
-    parity contract with separation_grid); dead agents are keyed past
-    the grid so they claim no slots (advisor r4)."""
-    from ..neighbors import torus_cell_tables
+    """(cx, cy, order, skey, rank, ok, sx, sy): the cell-sorted view
+    of the swarm — one variadic sort (iota tie-break = stable),
+    run-position ranks via cummax, no CSR tables (r5; see module
+    doc).  Since r8 this is a thin delegate to the SHARED tick-wide
+    build (``ops/hashgrid_plan.build_hashgrid_plan``) so a direct
+    kernel call and a plan-carrying tick cannot drift; cell
+    assignment still comes from torus_cell_tables (binning parity
+    contract with separation_grid), and dead agents are keyed past
+    the grid so they claim no slots (advisor r4).  ``cx``/``cy`` ride
+    along for the rescue pass, which gathers them instead of
+    re-binning its agents (the r8 re-derive fix)."""
+    from ..hashgrid_plan import build_hashgrid_plan
 
-    n = pos.shape[0]
-    _, _, key, _, _ = torus_cell_tables(pos, torus_hw, g)
-    key = jnp.where(alive, key, g * g)
-    iota = jnp.arange(n, dtype=jnp.int32)
-    skey, order, sx, sy = jax.lax.sort(
-        (key, iota, pos[:, 0], pos[:, 1]), num_keys=2
+    p = build_hashgrid_plan(
+        pos, alive, torus_hw, 2.0 * torus_hw / g, K, g=g
     )
-    run_start = jnp.where(
-        skey != jnp.concatenate([skey[:1] - 1, skey[:-1]]), iota, 0
-    )
-    rank = iota - jax.lax.cummax(run_start)
-    ok = (rank < K) & (skey < g * g)
-    return order, skey, rank, ok, sx, sy
+    return p.cx, p.cy, p.order, p.skey, p.rank, p.ok, p.sx, p.sy
 
 
 def _overflow_rescue_local(
-    pos, alive, order, ok, xr, yr, fx, fy,
+    pos, alive, cx, cy, order, ok, xr, yr, fx, fy,
     k_sep, personal_space, eps, hw, budget, g, K, R,
 ):
     """(fx', fy', f_v) — the r5 LOCAL rescue (module doc): each of up
@@ -528,12 +524,12 @@ def _overflow_rescue_local(
     vvalid = vidx < n
     vi = jnp.minimum(vidx, n - 1)
     vpos = pos[vi]                                         # [V, 2]
-    # Rescued agents' cells — from the SHARED binning (r5 review:
-    # a private floor/clip copy here could drift from the table the
-    # planes were built with; unused CSR outputs are DCE'd).
-    from ..neighbors import torus_cell_tables
-
-    vcx, vcy, _, _, _ = torus_cell_tables(vpos, hw, g)
+    # Rescued agents' cells — GATHERED from the tick's shared build
+    # (r8: the rescue used to re-derive them with a fresh
+    # torus_cell_tables pass over vpos; same values by construction,
+    # one less binning of the neighborhood structure).
+    vcx = cx[vi]
+    vcy = cy[vi]
 
     # [V, w, w, K] neighborhood (row, lane) indices — gathered 2-D
     # from the planes' native tiling (a flat gather forces a
@@ -615,6 +611,7 @@ def separation_hashgrid_pallas(
     overflow_budget: int = 512,
     lane_chunk: int | None = None,
     interpret: bool = False,
+    plan=None,
 ) -> jax.Array:
     """Drop-in fused fast path for the torus-mode
     ``separation_grid`` — same grid semantics (up to the documented
@@ -629,7 +626,15 @@ def separation_hashgrid_pallas(
     whole ``g*K`` row fits the VMEM budget, else the lane-tiled
     kernel (r4b) at an auto-sized chunk.  An explicit value forces
     the tiled kernel at that chunk width (testing hook; must divide
-    ``g*K``, be a multiple of 128, and exceed ``(R+1)*max_per_cell``)."""
+    ``g*K``, be a multiple of 128, and exceed ``(R+1)*max_per_cell``).
+
+    ``plan`` (r8): a prebuilt shared
+    :class:`~..hashgrid_plan.HashgridPlan` for this exact geometry —
+    the tick builds it once and every force term (this kernel, the
+    moments field, the rescue) consumes it, instead of each running
+    its own bin+sort.  Must match ``(g, max_per_cell, torus_hw)`` or
+    this raises; ``None`` keeps the self-building r5 behavior for
+    direct callers."""
     n, d = pos.shape
     if d != 2:
         raise ValueError("hash-grid separation kernel is 2-D only")
@@ -670,9 +675,26 @@ def separation_hashgrid_pallas(
                 f"of the {L}-lane row exceeding (R+1)*max_per_cell"
             )
 
-    order, skey, rank, ok, sx, sy = _slots_sorted(
-        pos, alive, torus_hw, g, K
-    )
+    if plan is None:
+        cx, cy, order, skey, rank, ok, sx, sy = _slots_sorted(
+            pos, alive, torus_hw, g, K
+        )
+    else:
+        if (
+            plan.g != g
+            or plan.max_per_cell != K
+            or float(plan.torus_hw) != float(torus_hw)
+        ):
+            raise ValueError(
+                f"shared plan geometry (g={plan.g}, "
+                f"K={plan.max_per_cell}, hw={plan.torus_hw}) does not "
+                f"match this kernel call (g={g}, K={K}, "
+                f"hw={torus_hw}) — the plan must be built from the "
+                "same cell/cap/world the kernel dispatches on"
+            )
+        cx, cy = plan.cx, plan.cy
+        order, skey, rank = plan.order, plan.skey, plan.rank
+        ok, sx, sy = plan.ok, plan.sx, plan.sy
     slot = skey * K + rank
     # Scatter-build over a sentinel fill (see module doc for the
     # measured gather-build negative).  Dead agents sort past the
@@ -814,7 +836,7 @@ def separation_hashgrid_pallas(
         fx, fy, f_v = jax.lax.cond(
             jnp.any(~ok & alive[order]),
             lambda: _overflow_rescue_local(
-                pos, alive, order, ok, xr, yr, fx, fy,
+                pos, alive, cx, cy, order, ok, xr, yr, fx, fy,
                 float(k_sep), float(personal_space), float(eps),
                 float(torus_hw), int(overflow_budget), g, K, R,
             ),
@@ -937,7 +959,7 @@ def hashgrid_overflow(
     if alive is None:
         alive = jnp.ones((pos.shape[0],), bool)
     g, cell_eff = _geometry(torus_hw, cell, max_per_cell)
-    order, _, _, ok, _, _ = _slots_sorted(
+    _, _, order, _, _, ok, _, _ = _slots_sorted(
         pos, alive, torus_hw, g, max_per_cell
     )
     return jnp.sum(~ok & alive[order])

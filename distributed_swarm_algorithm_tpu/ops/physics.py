@@ -208,6 +208,13 @@ def apf_forces(
 
     # 3. Neighbor separation (agent.py:148-160): every *other alive agent*
     #    inside the personal-space radius repels with k_sep / d^2.
+    #    The hashgrid branch builds ONE shared spatial index
+    #    (ops/hashgrid_plan.py, r8) consumed by the separation kernel
+    #    OR portable gather, the overflow rescue, and — when the
+    #    geometry is commensurate — the moments field in section 4;
+    #    field_keys carries the shared fine-grid binning out of the
+    #    branch.
+    field_keys = None
     if cfg.separation_mode == "dense":
         f_sep = _neighbors.separation_dense(
             pos, state.alive, cfg.k_sep, cfg.personal_space, eps
@@ -285,9 +292,78 @@ def apf_forces(
                 "separation_mode='hashgrid' is 2-D only (the cell "
                 f"grid tiles a 2-D torus); got dim={pos.shape[1]}"
             )
-        if tick_uses_hashgrid_kernel(
+        from .hashgrid_plan import build_hashgrid_plan, plan_field_keys
+
+        use_kernel = tick_uses_hashgrid_kernel(
             cfg, pos.shape[1], pos.dtype, arr=pos
-        ):
+        )
+        if use_kernel:
+            from .pallas.grid_separation import _geometry
+
+            # The kernel's resolved geometry IS the plan geometry —
+            # _geometry validates the cap/grid envelope exactly as the
+            # pre-plan kernel build did.
+            g_plan, _ = _geometry(
+                cfg.world_hw, cfg.grid_cell, cfg.grid_max_per_cell
+            )
+            cell_plan = cfg.grid_cell
+        else:
+            # The portable 3x3 gather needs cell >= personal_space:
+            # a half-cell config (kernel-only geometry) falls back to
+            # the full-cell grid — exact up to the cap either way.
+            # Geometry keeps the LEGACY floor tiling (g = 2hw/cell,
+            # not 16-aligned) so the per-cell occupancy — and hence
+            # the cap-truncation set — is unchanged from the pre-plan
+            # portable path; the 16-aligned grid is adopted below
+            # ONLY when the moments field shares the plan (its
+            # commensurate geometry requires it, and
+            # commensurate_geometry raises for worlds too small to
+            # align).
+            cell_plan = max(cfg.grid_cell, cfg.personal_space)
+            g_plan = max(1, int(2.0 * cfg.world_hw / cell_plan))
+            if g_plan < 3:
+                raise ValueError(
+                    f"torus [-{cfg.world_hw}, {cfg.world_hw}) tiled "
+                    f"by cell {cell_plan} gives a {g_plan}-cell grid; "
+                    "the wrapping 3x3 stencil needs g >= 3 (use "
+                    "dense separation for such tiny worlds)"
+                )
+        # Share the fine-grid field binning when the moments field is
+        # on and its commensurate grid COINCIDES with the plan's —
+        # always true on the kernel geometry (same rounding rule,
+        # same cell), and on portable geometries whose floor tiling
+        # already lands on the 16-aligned grid (the common
+        # power-of-two arenas).  Ragged worlds and half-cell
+        # fallbacks keep their legacy separation grid — identical
+        # occupancy/truncation behavior to the pre-plan tick — and
+        # the field bins itself as before (one extra elementwise
+        # pass, the documented cost of not coarsening the grid).
+        share_field = False
+        if tick_field_enabled(cfg):
+            from .grid_moments import (
+                align_cell_arg,
+                commensurate_geometry,
+            )
+
+            g_fine = commensurate_geometry(
+                cfg.world_hw, cfg.grid_cell,
+                align_cell_arg(cfg.align_cell),
+            )[0]
+            share_field = g_fine == g_plan
+        plan = build_hashgrid_plan(
+            pos, state.alive, float(cfg.world_hw), float(cell_plan),
+            cfg.grid_max_per_cell,
+            need_csr=not use_kernel,
+            field_sep_cell=(
+                float(cfg.grid_cell) if share_field else None
+            ),
+            field_align_cell=(
+                align_cell_arg(cfg.align_cell) if share_field else None
+            ),
+            g=g_plan,
+        )
+        field_keys = plan_field_keys(plan)
+        if use_kernel:
             from ..utils.platform import on_tpu
             from .pallas.grid_separation import (
                 separation_hashgrid_pallas,
@@ -301,16 +377,12 @@ def apf_forces(
                 torus_hw=float(cfg.world_hw),
                 overflow_budget=cfg.hashgrid_overflow_budget,
                 interpret=not on_tpu(),
+                plan=plan,
             )
         else:
-            # The portable 3x3 gather needs cell >= personal_space:
-            # a half-cell config (kernel-only geometry) falls back to
-            # the full-cell grid — exact up to the cap either way.
-            f_sep = _neighbors.separation_grid(
+            f_sep = _neighbors.separation_grid_plan(
                 pos, state.alive, cfg.k_sep, cfg.personal_space, eps,
-                cell=max(cfg.grid_cell, cfg.personal_space),
-                max_per_cell=cfg.grid_max_per_cell,
-                torus_hw=cfg.world_hw,
+                plan,
             )
     elif cfg.separation_mode == "off":
         f_sep = jnp.zeros_like(pos)
@@ -340,6 +412,7 @@ def apf_forces(
             torus_hw=float(cfg.world_hw),
             sep_cell=float(cfg.grid_cell),
             align_cell=align_cell_arg(cfg.align_cell),
+            keys=field_keys,
         )
         f_field = cfg.k_align * align + cfg.k_coh * coh
     else:

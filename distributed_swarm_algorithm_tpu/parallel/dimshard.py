@@ -44,9 +44,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from ..ops.es import ESState, LR, MOMENTUM, SIGMA, centered_ranks
+from ..utils.compat import shard_map
 from ..ops.pso import C1, C2, PSOState, W
 
 DIM_AXIS = "dim"

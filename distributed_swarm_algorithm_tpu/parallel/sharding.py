@@ -26,10 +26,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from ..ops import pso as _pso
 from ..state import NO_LEADER, SwarmState
+from ..utils.compat import shard_map
 from .mesh import AGENT_AXIS
 
 _BIG_I32 = jnp.iinfo(jnp.int32).max
@@ -72,6 +72,18 @@ def shard_swarm(state: SwarmState, mesh: Mesh, axis: str = AGENT_AXIS):
     election/heartbeat/allocation reductions.  Requires n_agents % devices
     == 0 (pad the swarm with dead agents otherwise — alive-masking makes
     padding free).
+
+    ``separation_mode='hashgrid'`` on a mesh runs the PORTABLE path
+    (the fused kernel is a single-device program — the driver guard in
+    models/swarm.py re-dispatches 'auto' and rejects forced 'pallas').
+    Since r8 that path consumes the ONE shared spatial build
+    (ops/hashgrid_plan.py) per tick: the same collective classes as
+    the pre-plan tick — the cell sort is XLA's gather-sort-reslice
+    exactly like the cadenced window re-sort, and the CSR occupancy
+    scatter targets the bounded, replicated ``[g*g]`` key space — but
+    built once instead of once per force term, so the per-tick
+    all-gather count does not grow with the number of plan consumers
+    (separation + moments field + rescue).
     """
     return _tree_shard_dim0(state, mesh, axis, state.n_agents)
 

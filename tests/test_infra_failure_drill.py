@@ -1,0 +1,191 @@
+"""Infra-failure drill (r8, VERDICT r5 #1/#8).
+
+The r5 capture lost its round to ONE transient backend-init failure:
+bench.py died on a traceback before printing any JSON, run_all dropped
+the rows silently, and the round's artifact recorded null.  This drill
+SIMULATES that outage — a monkeypatched ``jax.devices`` raising
+UNAVAILABLE, and a bench subprocess that dies — and pins the r8
+contract: bounded retry, then ONE structured JSON failure line
+(value null) on stdout, nonzero-but-parseable exit, and the failure
+record never entering BENCH_HISTORY.  Runs in the default suite (not
+slow-marked): the whole drill exercises only the failure paths, no
+device work.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name, relpath):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, relpath)
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture()
+def bench_mod():
+    return _load("bench_drill", "bench.py")
+
+
+def test_retry_backend_init_retries_then_structured_failure(
+    bench_mod, capsys
+):
+    """Bounded retry with backoff; final failure prints ONE JSON line
+    with value null and error tag, then exits nonzero (3)."""
+    calls = {"n": 0}
+    sleeps = []
+
+    def flaky():
+        calls["n"] += 1
+        raise RuntimeError("UNAVAILABLE: failed to connect to backend")
+
+    with pytest.raises(SystemExit) as ei:
+        bench_mod._retry_backend_init(
+            flaky, attempts=3, backoff_s=0.01, sleep=sleeps.append
+        )
+    assert ei.value.code == 3
+    assert calls["n"] == 3
+    # linear backoff, attempts-1 sleeps
+    assert sleeps == [0.01, 0.02]
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    rec = json.loads(out[0])
+    assert rec["value"] is None
+    assert rec["error"] == "backend-init"
+    assert rec["attempts"] == 3
+    assert "UNAVAILABLE" in rec["detail"]
+
+
+def test_retry_backend_init_recovers_after_transient(bench_mod, capsys):
+    """A hiccup that clears mid-retry must NOT null the round — the
+    exact r5 failure this satellite exists to prevent."""
+    calls = {"n": 0}
+
+    def transient():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("UNAVAILABLE: tunnel hiccup")
+        return "ok"
+
+    got = bench_mod._retry_backend_init(
+        transient, attempts=3, backoff_s=0.0, sleep=lambda s: None
+    )
+    assert got == "ok"
+    assert capsys.readouterr().out == ""   # no failure line on success
+
+
+def test_bench_main_survives_monkeypatched_devices(
+    bench_mod, monkeypatch, capsys
+):
+    """bench.main() under a dead backend: jax.devices raises
+    UNAVAILABLE every time -> main exits 3 with one parseable line and
+    never reaches the heavy parity/PSO phases."""
+    import jax
+
+    def dead():
+        raise RuntimeError(
+            "UNAVAILABLE: backend deadline exceeded (drill)"
+        )
+
+    monkeypatch.setattr(jax, "devices", dead)
+    monkeypatch.setattr(bench_mod, "INIT_BACKOFF_S", 0.0)
+
+    def no_sleep(s):
+        return None
+
+    monkeypatch.setattr(bench_mod.time, "sleep", no_sleep)
+    with pytest.raises(SystemExit) as ei:
+        bench_mod.main()
+    assert ei.value.code == 3
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert rec["value"] is None and rec["error"] == "backend-init"
+
+
+@pytest.mark.slow
+def test_bench_subprocess_nonzero_but_parseable(tmp_path):
+    """End-to-end: bench.py as a subprocess against a backend that
+    cannot exist (JAX_PLATFORMS=bogus — fails fast with a named
+    RuntimeError; =tpu would crawl GCP-metadata retries for minutes
+    on a CPU host) exits nonzero with every stdout line
+    JSON-parseable.  Slow-marked: pays a full jax import in a fresh
+    interpreter."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "bogus",
+        "DSA_BENCH_INIT_BACKOFF": "0",
+        "DSA_BENCH_INIT_ATTEMPTS": "2",
+    })
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py")],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert proc.returncode != 0
+    lines = [
+        ln for ln in proc.stdout.strip().splitlines() if ln.strip()
+    ]
+    assert lines, "no stdout at all — the structured line is missing"
+    recs = [json.loads(ln) for ln in lines]   # every line parses
+    assert any(
+        r.get("value") is None and r.get("error") == "backend-init"
+        for r in recs
+    )
+
+
+def test_run_all_emits_structured_failure_record(tmp_path, capsys):
+    """run_all._run_one on a dying bench prints a machine-parseable
+    failure record (value null) alongside the human stderr comment."""
+    run_all = _load("run_all_drill", "benchmarks/run_all.py")
+    bad = tmp_path / "bench_dead.py"
+    bad.write_text(
+        "import sys\n"
+        "print('booting', file=sys.stderr)\n"
+        "raise RuntimeError('UNAVAILABLE: no backend (drill)')\n"
+    )
+    recorded = []
+    ok = run_all._run_one(
+        [sys.executable, str(bad)], str(tmp_path), recorded, True
+    )
+    assert ok is False
+    out_lines = [
+        ln for ln in capsys.readouterr().out.strip().splitlines()
+        if ln.startswith("{")
+    ]
+    assert len(out_lines) == 1
+    rec = json.loads(out_lines[0])
+    assert rec["metric"] == "bench-failure, bench_dead.py"
+    assert rec["value"] is None
+    assert rec["error"].startswith("rc=")
+    assert "UNAVAILABLE" in rec["detail"]
+
+
+def test_compare_record_skips_null_values(tmp_path):
+    """Structured failure lines (value null) never enter the history —
+    a failed bench must not become a fake-zero baseline the gate then
+    'regresses' against."""
+    compare = _load("compare_drill", "benchmarks/compare.py")
+    hist = str(tmp_path / "hist.json")
+    compare.record(
+        "r99",
+        [
+            {"metric": "real-metric", "value": 42.0, "unit": "x/sec"},
+            {"metric": "bench-failure, dead.py", "value": None,
+             "unit": "failure", "error": "rc=1"},
+        ],
+        path=hist,
+    )
+    saved = json.load(open(hist))["rounds"]["r99"]
+    assert "real-metric" in saved
+    assert "bench-failure, dead.py" not in saved

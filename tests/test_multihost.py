@@ -33,7 +33,7 @@ def test_hybrid_mesh_collectives_ride_axes():
     x = jnp.arange(8.0).reshape(4, 2)
     xs = jax.device_put(x, NamedSharding(mesh, P("islands", "agents")))
 
-    from jax import shard_map
+    from distributed_swarm_algorithm_tpu.utils.compat import shard_map
 
     @jax.jit
     def global_min(v):

@@ -1,0 +1,443 @@
+"""Single-build shared spatial index (ops/hashgrid_plan.py, r8).
+
+The tentpole contract: ONE Morton/cell-sort/occupancy build per
+hashgrid tick, consumed by the fused/portable separation paths, the
+moments field, and the overflow rescue — with exactness pinned against
+the pre-r8 per-term-build tick at small and 65k-shaped geometry, cap
+(occupancy-skip) edge cases covered, and the plan pytree surviving
+jit/scan/checkpoint round-trips.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu.ops import neighbors as nb
+from distributed_swarm_algorithm_tpu.ops.grid_moments import (
+    _moment_rows,
+    cic_field_commensurate,
+    moments_deposit,
+)
+from distributed_swarm_algorithm_tpu.ops.hashgrid_plan import (
+    HashgridPlan,
+    build_hashgrid_plan,
+    plan_cell_sums,
+    plan_field_keys,
+    plan_geometry,
+)
+from distributed_swarm_algorithm_tpu.ops.physics import apf_forces
+from distributed_swarm_algorithm_tpu.state import make_swarm
+
+HW = 32.0
+CELL = 2.0
+K = 16
+
+
+def _swarm(n=512, seed=5, spread=25.0, dead=(3, 77, 200)):
+    s = make_swarm(n, seed=seed, spread=spread)
+    s = s.replace(
+        target=jnp.broadcast_to(jnp.asarray([5.0, 5.0]), s.pos.shape),
+        has_target=jnp.ones_like(s.has_target),
+    )
+    if dead:
+        from distributed_swarm_algorithm_tpu.ops.coordination import kill
+
+        s = kill(s, list(dead))
+    return s
+
+
+def _uniform(n, seed=0, hw=HW):
+    kp, kv = jax.random.split(jax.random.PRNGKey(seed))
+    pos = jax.random.uniform(kp, (n, 2), jnp.float32, -hw, hw)
+    vel = 3.0 * jax.random.normal(kv, (n, 2), jnp.float32)
+    return pos, vel
+
+
+# --- geometry + build invariants ----------------------------------------
+
+
+def test_plan_geometry_matches_kernel_and_fine_grid():
+    """One rounding rule everywhere: plan == fused-kernel geometry ==
+    commensurate fine grid (the no-drift contract)."""
+    from distributed_swarm_algorithm_tpu.ops.grid_moments import (
+        commensurate_geometry,
+    )
+    from distributed_swarm_algorithm_tpu.ops.pallas.grid_separation import (
+        _geometry,
+    )
+
+    g, cell_eff = plan_geometry(HW, CELL)
+    gk, cek = _geometry(HW, CELL, K)
+    gf = commensurate_geometry(HW, CELL)[0]
+    assert g == gk == gf == 32
+    assert cell_eff == pytest.approx(cek)
+    # tiny world: falls back to the plain portable tiling
+    g_small, _ = plan_geometry(4.0, 1.0)
+    assert g_small == 8
+
+
+def test_plan_build_matches_kernel_private_build():
+    """The plan's sort/rank/ok equals the fused kernel's pre-r8
+    private build (_slots_sorted now delegates to the plan)."""
+    s = _swarm()
+    plan = build_hashgrid_plan(s.pos, s.alive, HW, CELL, K)
+    from distributed_swarm_algorithm_tpu.ops.pallas.grid_separation import (
+        _slots_sorted,
+    )
+
+    cx, cy, order, skey, rank, ok, sx, sy = _slots_sorted(
+        s.pos, s.alive, HW, plan.g, K
+    )
+    for a, b in [
+        (plan.cx, cx), (plan.cy, cy), (plan.order, order),
+        (plan.skey, skey), (plan.rank, rank), (plan.ok, ok),
+        (plan.sx, sx), (plan.sy, sy),
+    ]:
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # dead agents keyed past the grid and counted by no CSR cell
+    plan_csr = build_hashgrid_plan(
+        s.pos, s.alive, HW, CELL, K, need_csr=True
+    )
+    assert int(plan_csr.counts.sum()) == int(s.alive.sum())
+    dead_keys = np.asarray(plan.key)[~np.asarray(s.alive)]
+    assert (dead_keys == plan.g * plan.g).all()
+
+
+def test_plan_field_keys_match_fine_cell_keys():
+    from distributed_swarm_algorithm_tpu.ops.grid_moments import (
+        fine_cell_keys,
+    )
+
+    s = _swarm()
+    plan = build_hashgrid_plan(
+        s.pos, s.alive, HW, CELL, K, field_sep_cell=CELL
+    )
+    key, xt, yt = fine_cell_keys(s.pos, s.alive, HW, plan.g)
+    fkey, fxt, fyt = plan_field_keys(plan)
+    np.testing.assert_array_equal(np.asarray(fkey), np.asarray(key))
+    np.testing.assert_allclose(np.asarray(fxt), np.asarray(xt))
+    np.testing.assert_allclose(np.asarray(fyt), np.asarray(yt))
+
+
+def test_plan_rejects_mismatched_field_geometry():
+    s = _swarm(n=64, dead=())
+    with pytest.raises(ValueError, match="does not coincide"):
+        build_hashgrid_plan(
+            s.pos, s.alive, HW, 4.0, K, field_sep_cell=CELL
+        )
+
+
+# --- single-build tick == per-term-build tick ---------------------------
+
+
+def _legacy_per_term_forces(s, cfg):
+    """The pre-r8 per-term-build tick's separation + field forces:
+    legacy separation_grid (its own bin+sort+CSR) plus the field's own
+    re-binned deposit — the parity oracle the acceptance criteria
+    pin against."""
+    eps = jnp.asarray(cfg.dist_eps, s.pos.dtype)
+    f_sep = nb.separation_grid(
+        s.pos, s.alive, cfg.k_sep, cfg.personal_space, eps,
+        cell=max(cfg.grid_cell, cfg.personal_space),
+        max_per_cell=cfg.grid_max_per_cell,
+        torus_hw=cfg.world_hw,
+    )
+    f = f_sep
+    if cfg.k_align != 0.0 or cfg.k_coh != 0.0:
+        align, coh = cic_field_commensurate(
+            s.pos, s.vel, s.alive, torus_hw=float(cfg.world_hw),
+            sep_cell=float(cfg.grid_cell), align_cell=None,
+        )
+        f = f + cfg.k_align * align + cfg.k_coh * coh
+    return f
+
+
+@pytest.mark.parametrize("n,spread", [(256, 20.0), (2048, 30.0)])
+def test_single_build_tick_matches_per_term_tick_portable(n, spread):
+    """apf_forces (shared plan, portable backend) == legacy per-term
+    separation_grid + self-binned field, to fp tolerance — with dead
+    agents and the field on."""
+    cfg = dsa.SwarmConfig().replace(
+        separation_mode="hashgrid", world_hw=HW,
+        grid_max_per_cell=K, hashgrid_backend="portable",
+        k_align=0.4, k_coh=0.15, formation_shape="none",
+    )
+    s = _swarm(n=n, spread=spread)
+    got = apf_forces(s, None, cfg)
+    # subtract the attraction term (identical on both sides) so the
+    # comparison isolates separation + field
+    delta = s.target - s.pos
+    pulling = s.has_target & (
+        jnp.linalg.norm(delta, axis=-1) > cfg.arrival_tolerance
+    )
+    f_att = jnp.where(pulling[:, None], cfg.k_att * delta, 0.0)
+    want = _legacy_per_term_forces(s, cfg) + f_att
+    scale = max(float(jnp.abs(want).max()), 1.0)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5,
+        atol=2e-6 * scale,
+    )
+
+
+def test_single_build_tick_matches_per_term_tick_kernel():
+    """Kernel backend (interpret on CPU) with the shared plan ==
+    the same kernel called WITHOUT a plan (its private r7 build) —
+    bitwise, since the build is the same computation."""
+    from distributed_swarm_algorithm_tpu.ops.pallas.grid_separation import (
+        separation_hashgrid_pallas,
+    )
+
+    s = _swarm()
+    plan = build_hashgrid_plan(s.pos, s.alive, HW, CELL, K)
+    kw = dict(
+        k_sep=20.0, personal_space=2.0, eps=1e-3, cell=CELL,
+        max_per_cell=K, torus_hw=HW, overflow_budget=64,
+        interpret=True,
+    )
+    with_plan = separation_hashgrid_pallas(
+        s.pos, s.alive, plan=plan, **kw
+    )
+    without = separation_hashgrid_pallas(s.pos, s.alive, **kw)
+    np.testing.assert_array_equal(
+        np.asarray(with_plan), np.asarray(without)
+    )
+
+
+def test_kernel_rejects_mismatched_plan():
+    from distributed_swarm_algorithm_tpu.ops.pallas.grid_separation import (
+        separation_hashgrid_pallas,
+    )
+
+    s = _swarm(n=64, dead=())
+    plan = build_hashgrid_plan(s.pos, s.alive, HW, CELL, 32)
+    with pytest.raises(ValueError, match="plan geometry"):
+        separation_hashgrid_pallas(
+            s.pos, s.alive, 20.0, 2.0, 1e-3, cell=CELL,
+            max_per_cell=K, torus_hw=HW, interpret=True, plan=plan,
+        )
+
+
+@pytest.mark.slow
+def test_single_build_tick_matches_per_term_tick_65k_shaped():
+    """65k-shaped geometry (the bench arena: hw=256 torus, g=256,
+    spread-250 spawn) on CPU — the scale-shaped parity pin the
+    acceptance criteria name.  8192 agents keep CPU wall-clock sane;
+    the GEOMETRY (g, cell, cap) is the 65k bench one."""
+    cfg = dsa.SwarmConfig().replace(
+        separation_mode="hashgrid", world_hw=256.0,
+        grid_max_per_cell=16, hashgrid_backend="portable",
+        k_align=0.3, k_coh=0.1, formation_shape="none",
+    )
+    s = _swarm(n=8192, spread=250.0, dead=(1, 1000, 5000))
+    got = apf_forces(s, None, cfg)
+    delta = s.target - s.pos
+    pulling = s.has_target & (
+        jnp.linalg.norm(delta, axis=-1) > cfg.arrival_tolerance
+    )
+    f_att = jnp.where(pulling[:, None], cfg.k_att * delta, 0.0)
+    want = _legacy_per_term_forces(s, cfg) + f_att
+    scale = max(float(jnp.abs(want).max()), 1.0)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5,
+        atol=2e-6 * scale,
+    )
+
+
+# --- occupancy-skip / cap edge cases ------------------------------------
+
+
+def test_occupancy_windowing_empty_full_overflowing_cells():
+    """separation_grid_plan's occupancy test vs the legacy sorted-key
+    compare across the cap spectrum: empty cells (most of the grid),
+    exactly-full cells, and overflowing cells (both truncate to the
+    first K in sort order — same contract)."""
+    # 3 clusters: one empty region, one cell holding exactly K agents,
+    # one cell holding 3K (overflow), plus a uniform background.
+    rng = np.random.default_rng(0)
+    bg = rng.uniform(-HW, HW, size=(128, 2)).astype(np.float32)
+    full = (
+        np.asarray([-15.0, -15.0]) + 0.3 * rng.random((K, 2))
+    ).astype(np.float32)
+    over = (
+        np.asarray([21.0, 21.0]) + 0.3 * rng.random((3 * K, 2))
+    ).astype(np.float32)
+    pos = jnp.asarray(np.concatenate([bg, full, over]))
+    n = pos.shape[0]
+    alive = jnp.ones((n,), bool)
+    eps = jnp.asarray(1e-3)
+    plan = build_hashgrid_plan(pos, alive, HW, CELL, K, need_csr=True)
+    got = nb.separation_grid_plan(pos, alive, 20.0, 2.0, eps, plan)
+    want = nb.separation_grid(
+        pos, alive, 20.0, 2.0, eps, cell=CELL, max_per_cell=K,
+        torus_hw=HW,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-5
+    )
+    # the overflow cluster really overflows (the case is not vacuous)
+    counts = np.asarray(plan.counts)
+    assert counts.max() > K
+    assert (counts == 0).sum() > counts.size // 2   # mostly empty
+    assert int(jnp.sum(~plan.ok & alive[plan.order])) > 0
+
+
+def test_occupancy_windowing_dead_agents_claim_no_slots():
+    """A cell crowded past the cap with DEAD agents must not truncate
+    its live members' forces (the kernel's r5 convention, now shared
+    by the portable plan path)."""
+    rng = np.random.default_rng(1)
+    clump = (
+        np.asarray([0.5, 0.5]) + 0.4 * rng.random((2 * K, 2))
+    ).astype(np.float32)
+    lone = np.asarray([[0.9, 0.9], [10.0, 10.0]], np.float32)
+    pos = jnp.asarray(np.concatenate([clump, lone]))
+    n = pos.shape[0]
+    alive = jnp.asarray([False] * (2 * K) + [True, True])
+    eps = jnp.asarray(1e-3)
+    plan = build_hashgrid_plan(pos, alive, HW, CELL, K, need_csr=True)
+    got = nb.separation_grid_plan(pos, alive, 20.0, 2.0, eps, plan)
+    # dense oracle: only the two live agents interact (they are far
+    # apart -> zero force); the dead clump exerts nothing.
+    want = nb.separation_dense(pos, alive, 20.0, 2.0, eps)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-6
+    )
+
+
+def test_rescue_uses_shared_cells():
+    """Overflow rescue on the plan path == the self-building kernel's
+    rescue (the rescue's cell lookup is now a gather from the shared
+    build; values must be identical)."""
+    from distributed_swarm_algorithm_tpu.ops.pallas.grid_separation import (
+        separation_hashgrid_pallas,
+    )
+
+    rng = np.random.default_rng(2)
+    # force overflow: 4K agents in one cell
+    clump = (
+        np.asarray([3.0, 3.0]) + 0.5 * rng.random((4 * K, 2))
+    ).astype(np.float32)
+    bg = rng.uniform(-HW, HW, size=(256, 2)).astype(np.float32)
+    pos = jnp.asarray(np.concatenate([clump, bg]))
+    alive = jnp.ones((pos.shape[0],), bool)
+    plan = build_hashgrid_plan(pos, alive, HW, CELL, K)
+    kw = dict(
+        k_sep=20.0, personal_space=2.0, eps=1e-3, cell=CELL,
+        max_per_cell=K, torus_hw=HW, overflow_budget=256,
+        interpret=True,
+    )
+    a = separation_hashgrid_pallas(pos, alive, plan=plan, **kw)
+    b = separation_hashgrid_pallas(pos, alive, **kw)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# --- moments deposit off the shared plan --------------------------------
+
+
+def test_field_shared_keys_match_self_binned(n=1024):
+    pos, vel = _uniform(n)
+    alive = jnp.ones((n,), bool)
+    plan = build_hashgrid_plan(
+        pos, alive, HW, CELL, K, field_sep_cell=CELL
+    )
+    a1, c1 = cic_field_commensurate(
+        pos, vel, alive, torus_hw=HW, sep_cell=CELL,
+        keys=plan_field_keys(plan),
+    )
+    a0, c0 = cic_field_commensurate(
+        pos, vel, alive, torus_hw=HW, sep_cell=CELL
+    )
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a0))
+    np.testing.assert_array_equal(np.asarray(c1), np.asarray(c0))
+
+
+def test_plan_cell_sums_matches_scatter_deposit(n=2048):
+    """The sorted-segment cell reduction (off the plan's existing
+    sort) == the production scatter deposit, to fp reassociation
+    tolerance, for in-torus swarms (its documented contract) — dead
+    agents dropped on both sides."""
+    pos, vel = _uniform(n, seed=3)
+    alive = jnp.asarray(np.random.default_rng(4).random(n) > 0.1)
+    plan = build_hashgrid_plan(
+        pos, alive, HW, CELL, K, field_sep_cell=CELL
+    )
+    fkey, xt, yt = plan_field_keys(plan)
+    rows = _moment_rows(xt, yt, vel)
+    got = plan_cell_sums(plan, rows)
+    g2 = plan.g * plan.g
+    want = (
+        jnp.zeros((g2, rows.shape[1]), rows.dtype)
+        .at[fkey].add(rows, mode="drop")
+    )
+    scale = max(float(jnp.abs(want).max()), 1.0)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5,
+        atol=1e-6 * scale,
+    )
+
+
+# --- pytree plumbing: jit / scan / checkpoint ---------------------------
+
+
+def test_plan_pytree_jit_scan_roundtrip():
+    s = _swarm(n=128, dead=())
+    plan = build_hashgrid_plan(
+        s.pos, s.alive, HW, CELL, K, need_csr=True,
+        field_sep_cell=CELL,
+    )
+
+    @jax.jit
+    def through_jit(p):
+        return p
+
+    p2 = through_jit(plan)
+    assert isinstance(p2, HashgridPlan)
+    assert (p2.g, p2.max_per_cell) == (plan.g, plan.max_per_cell)
+    np.testing.assert_array_equal(
+        np.asarray(p2.skey), np.asarray(plan.skey)
+    )
+    assert p2.has_csr and p2.has_field
+
+    # scan-carried: the plan is a legal loop carry (static aux data
+    # participates in the treedef, not the leaves)
+    def body(p, _):
+        return jax.tree_util.tree_map(lambda x: x, p), jnp.float32(0)
+
+    p3, _ = jax.lax.scan(body, plan, None, length=3)
+    np.testing.assert_array_equal(
+        np.asarray(p3.counts), np.asarray(plan.counts)
+    )
+
+    # a plan WITHOUT optional fields has a distinct treedef (retrace,
+    # not silent reuse)
+    lean = build_hashgrid_plan(s.pos, s.alive, HW, CELL, K)
+    t_full = jax.tree_util.tree_structure(plan)
+    t_lean = jax.tree_util.tree_structure(lean)
+    assert t_full != t_lean
+    assert not lean.has_csr and not lean.has_field
+
+
+def test_plan_checkpoint_roundtrip(tmp_path):
+    from distributed_swarm_algorithm_tpu.utils import checkpoint as ckpt
+
+    s = _swarm(n=64, dead=(2,))
+    plan = build_hashgrid_plan(
+        s.pos, s.alive, HW, CELL, K, need_csr=True,
+        field_sep_cell=CELL,
+    )
+    path = os.path.join(str(tmp_path), "plan.npz")
+    ckpt.save(path, plan)
+    target = jax.tree_util.tree_map(jnp.zeros_like, plan)
+    back = ckpt.restore(path, target)
+    assert isinstance(back, HashgridPlan)
+    assert back.g == plan.g
+    for f in HashgridPlan.ARRAY_FIELDS:
+        a, b = getattr(plan, f), getattr(back, f)
+        if a is None:
+            assert b is None
+            continue
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -62,6 +62,12 @@ BENCHES = [
     # single-build vs per-term-build rows; how the one-build-per-tick
     # tentpole is regression-tracked.
     "decompose_hashgrid_plan.py",
+    # r9: Verlet-skin amortization — fixed-name cpu rows for the
+    # amortized vs per-tick 65k station tick, observed rebuild rates
+    # (lower-is-better "rounds" rows), and the field_deposit
+    # scatter/sorted flag pair.  Cpu-family rows: the script refuses
+    # to run on a non-cpu backend, so it never eats tunnel time.
+    "decompose_rebuild.py",
 ]
 
 # Extra argv for benches whose no-arg default is not the gate set —
@@ -100,6 +106,7 @@ QUICK_SKIP = {
     "measure_window_recall.py",
     "decompose_gridmean.py",
     "decompose_hashgrid_plan.py",
+    "decompose_rebuild.py",
 }
 
 

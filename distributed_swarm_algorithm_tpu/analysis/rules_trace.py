@@ -10,6 +10,11 @@
 - ``retrace``: ``jax.jit`` constructed where it re-runs per call
   (inside loops, or constructed-and-immediately-called) recompiles
   every time; unhashable static-arg defaults fail at first call.
+- ``plan-staleness``: ``build_hashgrid_plan`` called inside a
+  ``lax.scan``/``fori_loop``/``while_loop`` body that never routes
+  through ``refresh_plan`` pays the full bin+sort every iteration —
+  the r8 structural floor the r9 Verlet carry exists to amortize;
+  rollout bodies must carry a plan and ``refresh_plan`` it.
 """
 
 from __future__ import annotations
@@ -223,6 +228,84 @@ class TracerBranchRule(Rule):
                             f"{sorted(hot)} — use lax.cond/jnp.where "
                             "or mark static",
                         )
+
+
+# ---------------------------------------------------------------------------
+# plan-staleness
+
+#: Loop-carrying transforms whose bodies re-execute per iteration —
+#: the scopes where an un-refreshed spatial-index build is a per-tick
+#: cost.  lax.cond is deliberately absent: refresh_plan's own rebuild
+#: branch lives under cond, and a conditional build is the amortized
+#: pattern, not the hazard.
+_LOOP_CALLS = frozenset(
+    {
+        "jax.lax.scan",
+        "jax.lax.fori_loop",
+        "jax.lax.while_loop",
+        "jax.lax.map",
+    }
+)
+
+
+@register
+class PlanStalenessRule(Rule):
+    id = "plan-staleness"
+    summary = "HashgridPlan built per-iteration inside a scan body"
+    details = (
+        "`build_hashgrid_plan` inside a lax.scan/fori_loop/while_loop "
+        "body pays the full bin+sort every iteration — the r8 "
+        "structural floor.  Rollout bodies should carry the plan and "
+        "route it through `refresh_plan` (ops/hashgrid_plan.py), "
+        "which rebuilds under lax.cond only when the Verlet skin "
+        "guarantee has expired."
+    )
+
+    def check(self, mod: ModuleInfo):
+        by_name: dict = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                by_name.setdefault(node.name, []).append(node)
+        bodies: set = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if mod.resolve(node.func) not in _LOOP_CALLS:
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Lambda):
+                    bodies.add(arg)
+                elif isinstance(arg, ast.Name):
+                    bodies.update(by_name.get(arg.id, []))
+        seen: set = set()
+        for fn in bodies:
+            stmts = fn.body if isinstance(fn.body, list) else [fn.body]
+            builds: list = []
+            has_refresh = False
+            for st in stmts:
+                for node in ast.walk(st):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = mod.resolve(node.func)
+                    leaf = name.rsplit(".", 1)[-1] if name else ""
+                    if leaf == "build_hashgrid_plan":
+                        builds.append(node)
+                    elif leaf == "refresh_plan":
+                        has_refresh = True
+            if has_refresh:
+                continue
+            for b in builds:
+                site = (b.lineno, b.col_offset)
+                if site in seen:
+                    continue
+                seen.add(site)
+                yield mod.finding(
+                    self.id, b,
+                    "`build_hashgrid_plan` inside a loop-transform "
+                    "body rebuilds the spatial index every iteration "
+                    "— carry the plan and use `refresh_plan` (Verlet "
+                    "skin reuse)",
+                )
 
 
 # ---------------------------------------------------------------------------

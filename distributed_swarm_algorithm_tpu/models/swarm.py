@@ -24,7 +24,7 @@ from ..ops.allocation import (
 )
 from ..ops.coordination import coordination_step, current_leader, kill, revive
 from ..ops.neighbors import morton_keys as _morton_keys
-from ..ops.physics import physics_step
+from ..ops.physics import build_tick_plan, physics_step, physics_step_plan
 from ..state import (
     LEADER,
     SwarmState,
@@ -77,22 +77,14 @@ def _hashgrid_multidevice_cfg(
     return cfg
 
 
-@partial(jax.jit, static_argnames=("cfg", "sort_in_tick"))
-def _swarm_tick_impl(
+def _protocol_steps(
     state: SwarmState,
-    obstacles: Optional[jax.Array],
     cfg: SwarmConfig,
-    sort_in_tick: bool = True,
+    sort_in_tick: bool,
 ) -> SwarmState:
-    """One synchronous swarm tick (= one 10 Hz loop body for every agent).
-
-    ``sort_in_tick=False`` drops the cadenced Morton re-sort ``lax.cond``
-    from the graph — callers that handle the cadence themselves
-    (``swarm_rollout``'s chunked scan) MUST use it: a conditional
-    carrying the full swarm state costs ~26 ms/tick at 1M on v5e even
-    when the branch never fires (measured r3 — XLA TPU conditionals
-    materialize their whole carried tuple).
-    """
+    """The pre-physics tick prefix shared by the plain and
+    plan-carrying ticks: tick stamp, cadenced Morton re-sort (window
+    mode), coordination, allocation."""
     state = state.replace(tick=state.tick + 1)
     if (
         sort_in_tick
@@ -123,8 +115,42 @@ def _swarm_tick_impl(
     else:
         state = coordination_step(state, cfg)      # agent.py:83-89
         state = allocation_step(state, cfg)        # agent.py:91-92
+    return state
+
+
+@partial(jax.jit, static_argnames=("cfg", "sort_in_tick"))
+def _swarm_tick_impl(
+    state: SwarmState,
+    obstacles: Optional[jax.Array],
+    cfg: SwarmConfig,
+    sort_in_tick: bool = True,
+) -> SwarmState:
+    """One synchronous swarm tick (= one 10 Hz loop body for every agent).
+
+    ``sort_in_tick=False`` drops the cadenced Morton re-sort ``lax.cond``
+    from the graph — callers that handle the cadence themselves
+    (``swarm_rollout``'s chunked scan) MUST use it: a conditional
+    carrying the full swarm state costs ~26 ms/tick at 1M on v5e even
+    when the branch never fires (measured r3 — XLA TPU conditionals
+    materialize their whole carried tuple).
+    """
+    state = _protocol_steps(state, cfg, sort_in_tick)
     state = physics_step(state, obstacles, cfg)    # agent.py:94-181
     return state
+
+
+def _swarm_tick_plan(
+    state: SwarmState,
+    obstacles: Optional[jax.Array],
+    cfg: SwarmConfig,
+    plan,
+):
+    """The plan-carrying tick (r9): same protocol prefix, physics off
+    the refreshed Verlet plan, plan handed back for the scan carry.
+    Plain (un-jitted) — it only runs inside the rollout scan."""
+    state = _protocol_steps(state, cfg, sort_in_tick=False)
+    state, plan = physics_step_plan(state, obstacles, cfg, plan)
+    return state, plan
 
 
 def swarm_tick(
@@ -143,13 +169,16 @@ def swarm_tick(
     )
 
 
-@partial(jax.jit, static_argnames=("cfg", "n_steps", "record"))
+@partial(
+    jax.jit, static_argnames=("cfg", "n_steps", "record", "return_plan")
+)
 def _swarm_rollout_impl(
     state: SwarmState,
     obstacles: Optional[jax.Array],
     cfg: SwarmConfig,
     n_steps: int,
     record: bool = False,
+    return_plan: bool = False,
 ) -> Union[SwarmState, Tuple[SwarmState, jax.Array]]:
     """``n_steps`` ticks under one ``lax.scan`` — the as-fast-as-possible
     mode; XLA fuses each tick into a handful of kernels.
@@ -159,7 +188,35 @@ def _swarm_rollout_impl(
     reference's per-tick pose log, agent.py:180-181).  Recording under
     the Morton re-sort is safe: each frame is unscrambled by scattering
     rows to their ``agent_id`` slots before stacking.
+
+    Verlet amortization (r9): with ``separation_mode='hashgrid'`` and
+    ``hashgrid_skin > 0`` the scan carry is ``(state, plan)`` — ONE
+    skin-inflated ``HashgridPlan`` seeded by ``build_tick_plan`` and
+    reused across ticks, rebuilt inside the tick only when
+    ``refresh_plan``'s displacement/alive/ceiling triggers fire.  The
+    per-tick bin+sort (the r8 structural floor) becomes a per-rebuild
+    cost; detection stays exact (ops/hashgrid_plan.py module doc).
+    ``return_plan=True`` appends the final plan to the result — its
+    ``rebuilds``/``age`` counters are the observed rebuild rate the
+    benches report (``None`` outside the plan-carry regime).
     """
+    plan_carried = (
+        cfg.separation_mode == "hashgrid" and cfg.hashgrid_skin > 0
+    )
+    if plan_carried:
+        plan = build_tick_plan(state, cfg)
+
+        def pbody(carry, _):
+            s, p = carry
+            s, p = _swarm_tick_plan(s, obstacles, cfg, p)
+            return (s, p), (s.pos if record else None)
+
+        (state, plan), traj = jax.lax.scan(
+            pbody, (state, plan), None, length=n_steps
+        )
+        out = (state, traj) if record else state
+        return (out, plan) if return_plan else out
+
     permuting = cfg.separation_mode == "window" and cfg.sort_every > 1
 
     def body(s, _):
@@ -180,7 +237,8 @@ def _swarm_rollout_impl(
 
     if not permuting:
         state, traj = jax.lax.scan(body, state, None, length=n_steps)
-        return (state, traj) if record else state
+        out = (state, traj) if record else state
+        return (out, None) if return_plan else out
 
     # Window mode with a sort cadence: scan CHUNKS of sort_every ticks,
     # each chunk opening with one UNCONDITIONAL full-state variadic
@@ -215,10 +273,13 @@ def _swarm_rollout_impl(
             frames.append(fr)
     if record:
         if not frames:                       # n_steps == 0
-            return state, jnp.zeros((0,) + state.pos.shape,
-                                    state.pos.dtype)
-        return state, jnp.concatenate(frames, axis=0)
-    return state
+            out = state, jnp.zeros((0,) + state.pos.shape,
+                                   state.pos.dtype)
+        else:
+            out = state, jnp.concatenate(frames, axis=0)
+    else:
+        out = state
+    return (out, None) if return_plan else out
 
 
 def swarm_rollout(
@@ -227,14 +288,17 @@ def swarm_rollout(
     cfg: SwarmConfig,
     n_steps: int,
     record: bool = False,
+    return_plan: bool = False,
 ) -> Union[SwarmState, Tuple[SwarmState, jax.Array]]:
     """``n_steps`` ticks under one ``lax.scan`` — ``_swarm_rollout_impl``
     behind the eager multi-device hash-grid guard (see
     ``_hashgrid_multidevice_cfg``; a no-op under trace and for
-    single-device swarms)."""
+    single-device swarms).  ``return_plan``: also return the final
+    carried Verlet plan (rebuild-rate observability; ``None`` unless
+    ``separation_mode='hashgrid'`` with ``hashgrid_skin > 0``)."""
     return _swarm_rollout_impl(
         state, obstacles, _hashgrid_multidevice_cfg(state, cfg),
-        n_steps, record,
+        n_steps, record, return_plan,
     )
 
 

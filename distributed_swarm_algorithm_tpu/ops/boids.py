@@ -151,6 +151,27 @@ class BoidsParams(NamedTuple):
     # past the per-cell cap drop from the interaction entirely rather
     # than only from neighbor gathers.
     grid_sep_backend: str = "auto"
+    # --- Verlet skin reuse (r9, ops/hashgrid_plan.py) -------------------
+    # skin > 0: boids_run's gridmean rollout carries ONE hashgrid
+    # plan built on cells inflated by `skin` and reuses it until any
+    # boid has moved skin/2 from the build snapshot — detection stays
+    # exact (consumers distance-filter at r_sep), the bin+sort is
+    # paid per REBUILD.  The portable backend additionally sweeps a
+    # prebuilt per-cell stencil-union candidate table ([g*g,
+    # neighbor_cap] — one [N, W] row gather replaces the 9-cell
+    # stencil windows).  Budget grid_max_per_cell for the inflated
+    # cells ((1 + skin/cell)^2 more boids per cell); rebuild_every>0
+    # adds a hard age ceiling on reuse.  The moments field never
+    # shares a skinned plan's keys (a stale binning would misplace
+    # deposits) — it re-bins per tick, as documented in physics.py.
+    skin: float = 0.0
+    rebuild_every: int = 0
+    neighbor_cap: int = 64
+    # Moments-field deposit backend ("scatter" | "sorted") — the r9
+    # flag promoting plan_cell_sums; "sorted" needs the shared plan
+    # (align_deposit="moments", kernel path, commensurate geometry,
+    # skin == 0).  See SwarmConfig.field_deposit.
+    field_deposit: str = "scatter"
 
 
 def boids_init(
@@ -370,13 +391,61 @@ def gridmean_uses_hashgrid(p: BoidsParams, dim: int, dtype) -> bool:
     single source of truth, also consumed by ``models/boids.py``'s
     crash-containment guard (which must track the path actually
     executed).  Raises on an unknown backend string, and on
-    ``"pallas"`` outside the kernel envelope."""
+    ``"pallas"`` outside the kernel envelope.  With ``skin > 0`` the
+    envelope is evaluated at the inflated Verlet geometry (cell and
+    coverage radius both grown by the skin) — the grid actually
+    binned on."""
     from .pallas.grid_separation import hashgrid_backend_choice
 
     return hashgrid_backend_choice(
         p.grid_sep_backend, dim, dtype, p.half_width,
-        p.grid_sep_cell if p.grid_sep_cell > 0 else p.r_sep,
-        p.grid_max_per_cell, p.r_sep, knob="grid_sep_backend",
+        (p.grid_sep_cell if p.grid_sep_cell > 0 else p.r_sep) + p.skin,
+        p.grid_max_per_cell, p.r_sep + p.skin,
+        knob="grid_sep_backend",
+    )
+
+
+def build_gridmean_plan(state: BoidsState, params: BoidsParams):
+    """Build the gridmean tick's shared hashgrid plan — the one place
+    its geometry is resolved (``boids_forces_gridmean`` builds
+    through it when no plan is passed; ``boids_run``'s skin rollout
+    calls it to seed the scan carry).  Mirrors
+    ``ops/physics.build_tick_plan`` for the no-protocol boids tick
+    (every boid alive)."""
+    from .hashgrid_plan import build_hashgrid_plan
+
+    p = params
+    pos = state.pos
+    n, d = pos.shape
+    if d != 2:
+        raise ValueError(
+            f"gridmean neighbor mode is 2-D only (got dim={d})"
+        )
+    from .grid_moments import align_cell_arg
+    from .physics import resolve_plan_geometry
+
+    skin = float(p.skin)
+    sep_cell = p.grid_sep_cell if p.grid_sep_cell > 0 else p.r_sep
+    use_kernel = gridmean_uses_hashgrid(p, d, pos.dtype)
+    g_plan, cell_plan, share_field = resolve_plan_geometry(
+        use_kernel, float(p.half_width), float(sep_cell),
+        float(p.r_sep), p.grid_max_per_cell, skin,
+        field_on=use_kernel and p.align_deposit == "moments",
+        field_sep_cell=float(sep_cell), align_cell=p.align_cell,
+    )
+    neighbor_cap = (
+        p.neighbor_cap if (skin > 0.0 and not use_kernel) else 0
+    )
+    return build_hashgrid_plan(
+        pos, jnp.ones((n,), bool), float(p.half_width),
+        float(cell_plan), p.grid_max_per_cell,
+        need_csr=not use_kernel,
+        field_sep_cell=float(sep_cell) if share_field else None,
+        field_align_cell=(
+            align_cell_arg(p.align_cell) if share_field else None
+        ),
+        g=g_plan, skin=skin,
+        neighbor_cap=neighbor_cap,
     )
 
 
@@ -384,6 +453,7 @@ def boids_forces_gridmean(
     state: BoidsState,
     params: BoidsParams,
     obstacles: Optional[jax.Array] = None,
+    plan=None,
 ) -> jax.Array:
     """Reynolds forces with particle-in-cell alignment/cohesion.
 
@@ -449,50 +519,35 @@ def boids_forces_gridmean(
     # as one VMEM pass (ops/pallas/grid_separation.py) — the r4 fix
     # for gridmean's gather-bound cost (measured ~60x window at 65k)
     # and its 1M long-scan worker crash, both in separation_grid.
-    plan = None
-    if gridmean_uses_hashgrid(p, d, pos.dtype):
+    # One shared spatial build per step (r8, ops/hashgrid_plan) —
+    # or, with `plan` passed (the r9 skin rollout carry), a REUSED
+    # one: consumers read current positions through it and filter at
+    # the true r_sep, so detection stays exact across the reuse
+    # window (build_gridmean_plan / refresh_plan own the contract).
+    use_kernel = gridmean_uses_hashgrid(p, d, pos.dtype)
+    if use_kernel:
         from ..utils.platform import on_tpu
-        from .hashgrid_plan import build_hashgrid_plan
-        from .pallas.grid_separation import _geometry
         from .pallas.grid_separation import separation_hashgrid_pallas
 
         sep_cell = p.grid_sep_cell if p.grid_sep_cell > 0 else p.r_sep
-        g_plan, _ = _geometry(
-            p.half_width, sep_cell, p.grid_max_per_cell
-        )
-        # One shared spatial build per step (r8, ops/hashgrid_plan):
-        # the kernel and — when the commensurate geometry coincides —
-        # the moments field below both consume it instead of each
-        # running its own bin+sort.
-        share_field = False
-        if p.align_deposit == "moments":
-            from .grid_moments import (
-                align_cell_arg,
-                commensurate_geometry,
-            )
-
-            share_field = commensurate_geometry(
-                float(p.half_width), float(sep_cell),
-                align_cell_arg(p.align_cell),
-            )[0] == g_plan
-        plan = build_hashgrid_plan(
-            pos, jnp.ones((n,), bool), float(p.half_width),
-            float(sep_cell), p.grid_max_per_cell,
-            field_sep_cell=float(sep_cell) if share_field else None,
-            field_align_cell=(
-                align_cell_arg(p.align_cell) if share_field else None
-            ),
-            g=g_plan,
-        )
+        if plan is None:
+            plan = build_gridmean_plan(state, p)
         sep = separation_hashgrid_pallas(
             pos, jnp.ones((n,), bool), 1.0, float(p.r_sep),
             float(p.eps),
-            cell=float(sep_cell),
+            cell=float(sep_cell) + plan.skin,
             max_per_cell=p.grid_max_per_cell,
             torus_hw=float(p.half_width),
             overflow_budget=p.grid_overflow_budget,
             interpret=not on_tpu(),
             plan=plan,
+        )
+    elif plan is not None:
+        # Portable backend off the carried plan: the Verlet list
+        # sweep (or occupancy-windowed stencil) of
+        # neighbors.separation_grid_plan — same cap contract.
+        sep = _neighbors.separation_grid_plan(
+            pos, jnp.ones((n,), bool), 1.0, p.r_sep, p.eps, plan
         )
     else:
         sep = _neighbors.separation_grid(
@@ -514,11 +569,24 @@ def boids_forces_gridmean(
         from .hashgrid_plan import plan_field_keys
 
         sep_cell = p.grid_sep_cell if p.grid_sep_cell > 0 else p.r_sep
+        field_keys = (
+            plan_field_keys(plan) if plan is not None else None
+        )
+        if p.field_deposit == "sorted" and field_keys is None:
+            raise ValueError(
+                "field_deposit='sorted' runs the deposit off the "
+                "shared plan's existing cell sort, so it needs the "
+                "plan to carry the field keys: the hashgrid kernel "
+                "path with commensurate geometry and skin == 0.  Use "
+                "field_deposit='scatter' here."
+            )
         align, coh = cic_field_commensurate(
             pos, vel, None, torus_hw=float(hw),
             sep_cell=float(sep_cell),
             align_cell=align_cell_arg(p.align_cell),
-            keys=plan_field_keys(plan) if plan is not None else None,
+            keys=field_keys,
+            plan=plan if p.field_deposit == "sorted" else None,
+            deposit=p.field_deposit,
         )
     else:
         g = max(1, int(round(2.0 * hw / p.align_cell)))
@@ -753,6 +821,32 @@ def boids_run(
             "in-scan Morton re-sorts permute boid array slots, so "
             "traj[t, i] would not track one boid over time"
         )
+    if neighbor_mode == "gridmean" and params.skin > 0:
+        # Verlet amortization (r9): carry ONE skin-inflated hashgrid
+        # plan through the scan and refresh it per tick — a rebuild
+        # only when some boid has outrun skin/2 (or the rebuild_every
+        # ceiling hits).  Detection stays exact; the bin+sort becomes
+        # a per-rebuild cost (ops/hashgrid_plan.py module doc).
+        from .hashgrid_plan import refresh_plan
+
+        n = state.pos.shape[0]
+        live = jnp.ones((n,), bool)
+        plan = build_gridmean_plan(state, params)
+
+        def pbody(carry, _):
+            s, p = carry
+            p = refresh_plan(
+                s.pos, live, p, rebuild_every=params.rebuild_every
+            )
+            acc = boids_forces_gridmean(s, params, obstacles, plan=p)
+            s = _integrate_tick(s, acc, params)
+            return (s, p), (s.pos if record else None)
+
+        (state, _), traj = jax.lax.scan(
+            pbody, (state, plan), None, length=n_steps
+        )
+        return state, (traj if record else None)
+
     step = {
         "dense": boids_step,
         "window": boids_step_window,

@@ -238,6 +238,8 @@ def moments_deposit(
     sep_cell: float,
     align_cell: Optional[float] = None,
     keys: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
+    plan=None,
+    deposit: str = "scatter",
 ) -> jax.Array:
     """The commensurate CIC deposit: ``[g_align, g_align, 5]`` field
     of (velocity-sum x2, center-relative position-sum x2, count),
@@ -248,6 +250,16 @@ def moments_deposit(
     zero per-agent corner scatters.  ``keys`` lets a caller that
     already binned the swarm (the hash-separation sort) pass
     ``(key, x~, y~)`` and skip the rebinning.
+
+    ``deposit`` (r9, the per-backend flag promoting r8's
+    ``plan_cell_sums``): ``"scatter"`` is the production
+    ``.at[key].add`` cell reduction; ``"sorted"`` computes the same
+    sums off the shared ``plan``'s EXISTING cell sort (segment
+    boundaries + one boundary-row scatter — measured -24% deposit
+    time on CPU, r8) and therefore requires ``plan`` to be the
+    shared :class:`~.hashgrid_plan.HashgridPlan` whose field keys
+    were passed as ``keys`` (same grid, fresh sort — the exactness
+    contract ``plan_cell_sums`` documents).
     """
     g, cf, ga, ca, q = commensurate_geometry(
         torus_hw, sep_cell, align_cell
@@ -257,15 +269,38 @@ def moments_deposit(
         else fine_cell_keys(pos, alive, torus_hw, g)
     )
     rows = _moment_rows(xt, yt, vel)
-    # One scatter-add (segment-sum-equivalent on sorted runs — the r5
-    # ledger measured sorted/unsorted/segment_sum within noise of each
-    # other on-chip); dead agents carry key g*g -> out of range ->
-    # dropped, same convention as the separation planes.
-    m = (
-        jnp.zeros((g * g, N_MOMENTS), pos.dtype)
-        .at[key].add(rows, mode="drop")
-        .reshape(g, g, N_MOMENTS)
-    )
+    if deposit == "sorted":
+        from .hashgrid_plan import plan_cell_sums
+
+        if plan is None or keys is None:
+            raise ValueError(
+                "deposit='sorted' needs the shared hashgrid plan "
+                "(plan=) and its field keys (keys=) — the sorted-"
+                "segment form reduces over the plan's existing cell "
+                "sort"
+            )
+        if plan.g != g:
+            raise ValueError(
+                f"deposit='sorted': plan grid (g={plan.g}) does not "
+                f"match the field fine grid (g={g}) — the sorted "
+                "deposit reduces over the plan's separation sort"
+            )
+        m = plan_cell_sums(plan, rows).reshape(g, g, N_MOMENTS)
+    elif deposit == "scatter":
+        # One scatter-add (segment-sum-equivalent on sorted runs — the
+        # r5 ledger measured sorted/unsorted/segment_sum within noise
+        # of each other on-chip); dead agents carry key g*g -> out of
+        # range -> dropped, same convention as the separation planes.
+        m = (
+            jnp.zeros((g * g, N_MOMENTS), pos.dtype)
+            .at[key].add(rows, mode="drop")
+            .reshape(g, g, N_MOMENTS)
+        )
+    else:
+        raise ValueError(
+            f"unknown deposit {deposit!r}; expected 'scatter' or "
+            "'sorted'"
+        )
     # Phase-align: fine cell s belongs to corner block (s - q/2)//q,
     # so a cyclic roll by -q/2 makes blocks contiguous (the roll also
     # closes the torus seam — block -1 is block ga-1).
@@ -350,7 +385,7 @@ def moments_sample(
 
 @partial(
     jax.jit,
-    static_argnames=("torus_hw", "sep_cell", "align_cell"),
+    static_argnames=("torus_hw", "sep_cell", "align_cell", "deposit"),
 )
 def cic_field_commensurate(
     pos: jax.Array,
@@ -360,6 +395,8 @@ def cic_field_commensurate(
     sep_cell: float,
     align_cell: Optional[float] = None,
     keys: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
+    plan=None,
+    deposit: str = "scatter",
 ) -> Tuple[jax.Array, jax.Array]:
     """(align, coh) [N, 2]: the full commensurate moments CIC field —
     deposit + sample sharing one binning pass.  Drop-in replacement
@@ -371,12 +408,17 @@ def cic_field_commensurate(
     (``ops/hashgrid_plan.plan_field_keys``), produced by the SAME
     ``fine_cell_keys`` math — so a tick that already built its
     spatial index deposits and samples off it instead of re-binning
-    the swarm here."""
+    the swarm here.
+
+    ``plan``/``deposit`` (r9): deposit backend selection — see
+    :func:`moments_deposit` (``deposit="sorted"`` reduces over the
+    shared plan's existing cell sort instead of scattering)."""
     if keys is None:
         g, *_ = commensurate_geometry(torus_hw, sep_cell, align_cell)
         keys = fine_cell_keys(pos, alive, torus_hw, g)
     grid = moments_deposit(
-        pos, vel, alive, torus_hw, sep_cell, align_cell, keys=keys
+        pos, vel, alive, torus_hw, sep_cell, align_cell, keys=keys,
+        plan=plan, deposit=deposit,
     )
     return moments_sample(
         grid, pos, vel, alive, torus_hw, sep_cell, align_cell,

@@ -57,8 +57,43 @@ enables (per-cell sums off the existing sort, scatter only at segment
 boundaries).  The r5 ledger measured sorted/unsorted/segment-sum
 deposits within noise of each other on-chip, so the production deposit
 stays a plain scatter on the shared keys; the sorted form is kept,
-tested, and measured by ``benchmarks/decompose_hashgrid_plan.py`` as
-the honest record (see docs/PERFORMANCE.md r8).
+tested, measured by ``benchmarks/decompose_hashgrid_plan.py``, and —
+since r9 — promotable per backend via ``SwarmConfig.field_deposit``
+(see docs/PERFORMANCE.md r8/r9).
+
+Skin-radius Verlet reuse (r9).  PERFORMANCE.md r8 proved the per-tick
+rebuild is a structural floor: every exact tick pays the bin+sort
+cost even when almost nothing moved.  The molecular-dynamics answer
+is a *skin*: build the index with every length inflated by ``skin``
+(cells sized to cover ``r + skin``; optionally a per-cell candidate
+table of each cell's whole stencil neighborhood), snapshot the
+positions it
+was built from (``ref_pos``/``ref_alive``), and keep reusing it — the
+index provably remains a SUPERSET of the true ``r``-neighbors until
+some agent has moved more than ``skin/2`` from its snapshot (each
+endpoint of a pair moves <= skin/2, so a pair within ``r`` now was
+within ``r + skin`` at build time).  Consumers distance-filter
+candidates against the TRUE radius every tick, so detection stays
+exact; only the amortization is new.  :func:`refresh_plan` is the
+trigger: a fused max-displacement check plus a rebuild under
+``lax.cond`` — fixed shapes on both branches, so it composes with
+``jit``/``scan``/``shard_map`` and lives in a rollout carry (see
+``ops/physics.physics_step_plan`` and ``ops/boids.boids_run``).
+
+``neighbor_cap`` builds the Verlet candidate table ``cand
+[g*g, W]``: per CELL, the concatenated occupancy runs of its 3x3
+stencil neighborhood — every live agent that could interact with
+anything in the cell, in stencil scan order, padded with ``n``.
+Built with nine elementwise selects over the CSR tables plus one
+gather (a per-AGENT compacted list was measured ~2 s at 65k on CPU:
+the [N, 9K] -> [N, M] compaction is scatter- or sort-bound either
+way, where this per-cell form shares one row across a cell's whole
+population and needs neither).  Between rebuilds the portable sweep
+then costs ONE ``[N, W]`` gather instead of nine ``[N, K]`` stencil
+gathers — at 65k/CPU the stencil sweep is ~170 ms of the ~210 ms
+tick and the union sweep is ~3x tighter; this, not the build
+amortization alone, is what makes the r9 amortized regime >1.5x
+(benchmarks/decompose_rebuild.py).
 """
 
 from __future__ import annotations
@@ -97,21 +132,45 @@ class HashgridPlan:
     array fields are children (jit/scan/vmap/checkpoint-safe), the
     geometry is static aux data (hashable, participates in jit cache
     keys).  Optional fields (``counts``/``starts`` — CSR, portable
-    path only; ``fkey``/``xt``/``yt`` — field binning) are ``None``
-    when not built; ``None`` is a pytree-transparent child."""
+    path only; ``fkey``/``xt``/``yt`` — field binning; ``cand``/
+    ``cand_overflow`` — the Verlet candidate list) are ``None`` when
+    not built; ``None`` is a pytree-transparent child.
+
+    Verlet-reuse fields (r9): ``ref_pos``/``ref_alive`` snapshot the
+    build inputs (what :func:`refresh_plan`'s staleness check compares
+    against), ``age`` counts ticks since the last rebuild, and
+    ``rebuilds`` counts rebuilds over the plan's lifetime (the
+    observed-rebuild-rate counter the benches report).  ``skin``
+    rides as static aux — the validity contract every consumer
+    budgets its coverage check against.  ``cand [g*g, W]`` is the
+    per-cell stencil-union candidate table (module doc) with
+    ``cand_overflow`` counting entries truncated past ``W``."""
 
     ARRAY_FIELDS = (
         "cx", "cy", "key", "order", "skey", "rank", "ok", "sx", "sy",
         "counts", "starts", "fkey", "xt", "yt",
+        "ref_pos", "ref_alive", "age", "rebuilds",
+        "cand", "cand_overflow",
+    )
+    AUX_FIELDS = (
+        "g", "cell_eff", "torus_hw", "max_per_cell",
+        "skin", "field_sep_cell", "field_align_cell",
     )
 
     def __init__(self, *, g, cell_eff, torus_hw, max_per_cell,
                  cx, cy, key, order, skey, rank, ok, sx, sy,
-                 counts=None, starts=None, fkey=None, xt=None, yt=None):
+                 counts=None, starts=None, fkey=None, xt=None, yt=None,
+                 ref_pos=None, ref_alive=None, age=None, rebuilds=None,
+                 cand=None, cand_overflow=None,
+                 skin=0.0,
+                 field_sep_cell=None, field_align_cell=None):
         self.g = g
         self.cell_eff = cell_eff
         self.torus_hw = torus_hw
         self.max_per_cell = max_per_cell
+        self.skin = skin
+        self.field_sep_cell = field_sep_cell
+        self.field_align_cell = field_align_cell
         self.cx = cx
         self.cy = cy
         self.key = key
@@ -126,6 +185,12 @@ class HashgridPlan:
         self.fkey = fkey
         self.xt = xt
         self.yt = yt
+        self.ref_pos = ref_pos
+        self.ref_alive = ref_alive
+        self.age = age
+        self.rebuilds = rebuilds
+        self.cand = cand
+        self.cand_overflow = cand_overflow
 
     @property
     def has_csr(self) -> bool:
@@ -135,26 +200,39 @@ class HashgridPlan:
     def has_field(self) -> bool:
         return self.fkey is not None
 
+    @property
+    def has_list(self) -> bool:
+        return self.cand is not None
+
+    def replace(self, **kw) -> "HashgridPlan":
+        """A copy with the named ARRAY fields replaced (aux is
+        geometry — a different geometry is a different plan, build a
+        new one)."""
+        fields = {f: getattr(self, f) for f in self.ARRAY_FIELDS}
+        fields.update(kw)
+        aux = {f: getattr(self, f) for f in self.AUX_FIELDS}
+        return HashgridPlan(**aux, **fields)
+
     def tree_flatten(self):
         children = tuple(getattr(self, f) for f in self.ARRAY_FIELDS)
-        aux = (self.g, self.cell_eff, self.torus_hw, self.max_per_cell)
+        aux = tuple(getattr(self, f) for f in self.AUX_FIELDS)
         return children, aux
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        g, cell_eff, torus_hw, max_per_cell = aux
         kw = dict(zip(cls.ARRAY_FIELDS, children))
-        return cls(
-            g=g, cell_eff=cell_eff, torus_hw=torus_hw,
-            max_per_cell=max_per_cell, **kw,
-        )
+        kw.update(zip(cls.AUX_FIELDS, aux))
+        return cls(**kw)
 
     def __repr__(self) -> str:  # debugging aid, not a contract
-        opt = [f for f in ("counts", "fkey") if getattr(self, f) is not None]
+        opt = [
+            f for f in ("counts", "fkey", "cand")
+            if getattr(self, f) is not None
+        ]
         return (
             f"HashgridPlan(g={self.g}, cell_eff={self.cell_eff:.4g}, "
             f"torus_hw={self.torus_hw}, K={self.max_per_cell}, "
-            f"extras={opt})"
+            f"skin={self.skin}, extras={opt})"
         )
 
 
@@ -168,6 +246,8 @@ def build_hashgrid_plan(
     field_sep_cell: Optional[float] = None,
     field_align_cell: Optional[float] = None,
     g: Optional[int] = None,
+    skin: float = 0.0,
+    neighbor_cap: int = 0,
 ) -> HashgridPlan:
     """Build the shared plan: one binning + one stable cell sort.
 
@@ -191,13 +271,38 @@ def build_hashgrid_plan(
     :func:`plan_geometry` — for callers (the fused kernel's direct
     entry point) whose geometry is already resolved; avoids the
     float round-trip of re-deriving ``g`` from ``cell_eff``.
+
+    ``skin`` (r9, module doc): inflate the binning cell to
+    ``cell + skin`` so the 3x3 stencil keeps covering the true query
+    radius after every agent has moved up to ``skin/2`` from the
+    ``ref_pos`` snapshot — the Verlet reuse window
+    (:func:`refresh_plan` is the trigger).  ``skin=0`` is exactly the
+    r8 per-tick plan.  With an explicit ``g`` the caller has already
+    resolved the inflated geometry; ``skin`` then only rides along as
+    the consumers' validity contract.
+
+    ``neighbor_cap`` (``W``): with ``W > 0``, also materialize the
+    per-cell stencil-union candidate table ``cand [g*g, W]`` — for
+    each cell, the original indices of every LIVE agent in its 3x3
+    stencil neighborhood, in stencil scan order, padded with ``n``
+    (the CSR tables are built regardless of ``need_csr``; per-cell
+    membership is still truncated to the first ``max_per_cell``
+    agents in sort order — the r5 cap contract — and neighborhoods
+    holding more than ``W`` agents truncate the scan-order tail,
+    counted in ``cand_overflow``; size ``W`` like
+    ``grid_max_per_cell``, roughly 9x the expected cell occupancy).
+    Coverage is the stencil's: one cell out, so the table serves any
+    query radius up to ``cell_eff`` — consumers check
+    ``cell_eff >= r + skin`` exactly as the stencil path does.
+    Requires ``g >= 3`` (a smaller torus would duplicate wrapped
+    stencil cells and double-count pairs).
     """
     from .grid_moments import commensurate_geometry, fine_cell_keys
     from .neighbors import torus_cell_tables
 
     n = pos.shape[0]
     if g is None:
-        g, cell_eff = plan_geometry(torus_hw, cell)
+        g, cell_eff = plan_geometry(torus_hw, cell + skin)
     else:
         cell_eff = 2.0 * torus_hw / g
     cx, cy, key_raw, _, _ = torus_cell_tables(pos, torus_hw, g)
@@ -218,7 +323,7 @@ def build_hashgrid_plan(
     ok = (rank < max_per_cell) & (skey < g * g)
 
     counts = starts = None
-    if need_csr:
+    if need_csr or neighbor_cap > 0:
         # Live-only occupancy over the bounded g*g key space (dead
         # agents carry key g*g -> dropped).  One scatter + exclusive
         # cumsum replaces the 9 searchsorted binary searches AND the 9
@@ -229,6 +334,21 @@ def build_hashgrid_plan(
             .at[key].add(1, mode="drop")
         )
         starts = jnp.cumsum(counts) - counts
+
+    cand = cand_overflow = None
+    if neighbor_cap > 0:
+        if g < 3:
+            raise ValueError(
+                f"the stencil-union candidate table needs g >= 3 "
+                f"(got {g}): a smaller wrapped stencil visits the "
+                "same cell twice and would double-count pairs"
+            )
+        # CSR stays in the plan even when only the table asked for
+        # it: a refresh-rebuilt plan must reproduce one structure,
+        # and the [g*g] tables are small next to the [g*g, W] table.
+        cand, cand_overflow = _cell_union_table(
+            order, counts, starts, g, max_per_cell, neighbor_cap, n,
+        )
 
     fkey = xt = yt = None
     if field_sep_cell is not None:
@@ -249,10 +369,119 @@ def build_hashgrid_plan(
     return HashgridPlan(
         g=g, cell_eff=cell_eff, torus_hw=torus_hw,
         max_per_cell=max_per_cell,
+        skin=float(skin),
+        field_sep_cell=field_sep_cell, field_align_cell=field_align_cell,
         cx=cx, cy=cy, key=key, order=order, skey=skey, rank=rank,
         ok=ok, sx=sx, sy=sy, counts=counts, starts=starts,
         fkey=fkey, xt=xt, yt=yt,
+        ref_pos=pos, ref_alive=alive,
+        age=jnp.zeros((), jnp.int32),
+        rebuilds=jnp.zeros((), jnp.int32),
+        cand=cand, cand_overflow=cand_overflow,
     )
+
+
+def _cell_union_table(order, counts, starts, g, max_per_cell, w, n):
+    """(cand [g*g, W] i32, overflow scalar i32): the per-cell
+    stencil-union candidate table (build_hashgrid_plan doc) — row c
+    holds the original indices of the live agents in cell c's 3x3
+    neighborhood, in stencil scan order (each cell's run truncated to
+    the first ``max_per_cell`` in sort order, the r5 cap contract),
+    padded with ``n``.
+
+    Built WITHOUT per-agent compaction: the runs are contiguous in
+    the plan's sorted order, so each row is nine interval copies —
+    computed as nine elementwise selects of source-slot indices over
+    a [g*g, W] iota plus ONE gather through ``order``.  (The
+    per-agent [N, M] compacted form was measured ~2 s at 65k on CPU
+    — scatter- or sort-bound — where this is ~10 ms.)"""
+    cells = jnp.arange(g * g, dtype=jnp.int32)
+    ccx = cells // g
+    ccy = cells % g
+    wiota = jnp.arange(w, dtype=jnp.int32)[None, :]      # [1, W]
+    src = jnp.full((g * g, w), n, jnp.int32)
+    lo = jnp.zeros((g * g,), jnp.int32)
+    for dx in (-1, 0, 1):
+        for dy in (-1, 0, 1):
+            nkey = jnp.mod(ccx + dx, g) * g + jnp.mod(ccy + dy, g)
+            occ = jnp.minimum(counts[nkey], max_per_cell)
+            st = starts[nkey]
+            hi = lo + occ
+            m = (wiota >= lo[:, None]) & (wiota < hi[:, None])
+            src = jnp.where(
+                m, st[:, None] + (wiota - lo[:, None]), src
+            )
+            lo = hi
+    cand = jnp.where(
+        src < n, order[jnp.minimum(src, n - 1)].astype(jnp.int32), n
+    )
+    overflow = jnp.sum(jnp.maximum(lo - w, 0))
+    return cand, overflow
+
+
+def plan_staleness(pos: jax.Array, alive: jax.Array, plan: HashgridPlan):
+    """(d2max, alive_changed): the fused staleness probe — the max
+    squared minimum-image displacement of any agent from the plan's
+    ``ref_pos`` snapshot, and whether the alive set changed at all
+    (a kill/revive invalidates the live-only keying, CSR occupancy,
+    and candidate list outright — positions alone cannot see it)."""
+    hw = plan.torus_hw
+    d = pos - plan.ref_pos
+    d = jnp.mod(d + hw, 2.0 * hw) - hw
+    d2max = jnp.max(jnp.sum(d * d, axis=-1))
+    return d2max, jnp.any(alive != plan.ref_alive)
+
+
+def refresh_plan(
+    pos: jax.Array,
+    alive: jax.Array,
+    plan: HashgridPlan,
+    rebuild_every: int = 0,
+) -> HashgridPlan:
+    """The Verlet reuse trigger (module doc): rebuild ``plan`` from
+    the current ``(pos, alive)`` under ``lax.cond`` when — and only
+    when — its exactness guarantee has expired:
+
+      - some agent moved more than ``skin/2`` from ``ref_pos``
+        (``2 * max||pos - ref_pos|| > skin``, minimum-image), or
+      - the alive set changed (live-only keying went stale), or
+      - ``rebuild_every > 0`` and the plan is ``rebuild_every - 1``
+        ticks old (a hard staleness ceiling, the config override for
+        drift sources the displacement probe cannot see).
+
+    Otherwise the plan is reused with ``age + 1``.  Both branches
+    produce the same pytree structure (the rebuild reuses the plan's
+    own static geometry), so the result is a legal ``scan`` carry;
+    with ``skin == 0`` any motion at all triggers, degenerating to
+    the r8 per-tick rebuild.
+
+    Consumers of a possibly-stale plan must read CURRENT positions
+    through ``plan.order``/``plan.cand`` (they do — see
+    ``neighbors.separation_grid_plan`` and the kernel's plan path)
+    and distance-filter against the true radius; ``sx``/``sy`` are
+    the build-time snapshot, not the present."""
+    skin = plan.skin
+    d2max, alive_changed = plan_staleness(pos, alive, plan)
+    stale = alive_changed | (4.0 * d2max > skin * skin)
+    if rebuild_every > 0:
+        stale = stale | (plan.age + 1 >= rebuild_every)
+
+    def rebuild():
+        p = build_hashgrid_plan(
+            pos, alive, plan.torus_hw, plan.cell_eff,
+            plan.max_per_cell,
+            need_csr=plan.has_csr,
+            field_sep_cell=plan.field_sep_cell,
+            field_align_cell=plan.field_align_cell,
+            g=plan.g, skin=skin,
+            neighbor_cap=plan.cand.shape[1] if plan.has_list else 0,
+        )
+        return p.replace(rebuilds=plan.rebuilds + 1)
+
+    def keep():
+        return plan.replace(age=plan.age + 1)
+
+    return jax.lax.cond(stale, rebuild, keep)
 
 
 def plan_field_keys(plan: HashgridPlan):

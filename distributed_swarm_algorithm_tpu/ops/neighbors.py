@@ -460,12 +460,39 @@ def separation_grid_plan(
     cap (exactness there is pinned by tests/test_shared_plan.py); past
     the cap both paths truncate to the first ``max_per_cell`` agents
     in sort order, the portable cap contract since r5.
+
+    Verlet reuse (r9): the plan may be STALE — built from a
+    ``ref_pos`` snapshot up to ``plan.skin/2`` of motion ago
+    (``hashgrid_plan.refresh_plan`` enforces the bound, and rebuilds
+    on any alive-set change, so in-plan candidates are live by
+    contract).  Neighbor positions are therefore gathered from the
+    CURRENT ``pos`` through ``plan.order`` (bitwise-identical to the
+    ``sx``/``sy`` snapshot when the plan is fresh), the coverage
+    check budgets for the skin, and the distance test — always
+    against the true ``personal_space`` — keeps detection exact.
+    When the plan carries the per-cell stencil-union candidate table
+    (``plan.has_list``) the sweep reads it instead of walking the
+    stencil: one ``[N, W]`` gather in the same stencil scan order —
+    the same pair set up to the caps, summed in one reduction
+    instead of nine (equal to fp reassociation tolerance).
     """
     n = pos.shape[0]
+    if plan.cell_eff < personal_space + plan.skin:
+        raise ValueError(
+            f"plan cell ({plan.cell_eff}) must be >= personal_space "
+            f"+ skin ({personal_space} + {plan.skin}) for the 3x3 "
+            "stencil (and its union candidate table) to cover the "
+            "separation radius across the Verlet reuse window"
+        )
+    if plan.has_list:
+        return _separation_list_plan(
+            pos, alive, k_sep, personal_space, eps, plan
+        )
     if plan.counts is None:
         raise ValueError(
             "separation_grid_plan needs a plan built with "
-            "need_csr=True (the portable path's stencil tables)"
+            "need_csr=True (the portable path's stencil tables) or "
+            "neighbor_cap > 0 (the stencil-union candidate table)"
         )
     g = plan.g
     if g < 3:
@@ -474,15 +501,9 @@ def separation_grid_plan(
             "stencil needs g >= 3 (use dense separation for such "
             "tiny worlds)"
         )
-    if plan.cell_eff < personal_space:
-        raise ValueError(
-            f"plan cell ({plan.cell_eff}) must be >= personal_space "
-            f"({personal_space}) for the 3x3 stencil to cover the "
-            "separation radius"
-        )
     torus_hw = plan.torus_hw
     cx, cy = plan.cx, plan.cy
-    spos = jnp.stack([plan.sx, plan.sy], axis=1)
+    spos = pos[plan.order]
     sorig = plan.order
     counts, starts = plan.counts, plan.starts
 
@@ -519,6 +540,64 @@ def separation_grid_plan(
                 axis=1,
             )
     return force
+
+
+def _separation_list_plan(
+    pos: jax.Array,
+    alive: jax.Array,
+    k_sep: float,
+    personal_space: float,
+    eps: float,
+    plan,
+) -> jax.Array:
+    """Separation force off the plan's per-cell stencil-union
+    candidate table (``separation_grid_plan`` dispatches here when
+    ``plan.has_list``): each agent reads its OWN cell's precomputed
+    row — every live agent in the 3x3 neighborhood, so coverage is
+    exactly the stencil's — and ONE ``[N, W]`` gather of current
+    positions replaces the nine ``[N, K]`` stencil gathers (the
+    amortized-regime sweep; hashgrid_plan module doc).  Detection
+    stays exact while the plan's reuse guarantee holds: the per-tick
+    distance test at the true radius rejects everything the inflated
+    neighborhood over-collects.  Candidates are live by the refresh
+    contract (any alive change rebuilds); the receiver-side ``alive``
+    mask still applies, and dead receivers (keyed past the grid) are
+    clipped onto row 0 and masked."""
+    n = pos.shape[0]
+    g2 = plan.g * plan.g
+    hw = plan.torus_hw
+    key_c = jnp.minimum(plan.key, g2 - 1)
+    crow = plan.cand[key_c]                             # [N, W]
+    valid = crow < n                                    # padded w/ n
+    me = jnp.arange(n)
+    npos = pos[jnp.minimum(crow, n - 1)]                # [N, W, 2]
+    diff = pos[:, None, :] - npos
+    # Select-form minimum image (the kernel's r5 wrap): exact for
+    # true displacements and ~1.5 ulp-equal to the mod form, with
+    # two compares instead of an fmod per lane.
+    diff = jnp.where(
+        diff >= hw, diff - 2.0 * hw,
+        jnp.where(diff < -hw, diff + 2.0 * hw, diff),
+    )
+    dist = jnp.linalg.norm(diff, axis=-1)
+    dist_c = jnp.maximum(dist, eps)
+    near = (
+        valid
+        & alive[:, None]
+        & (dist < personal_space)
+        & (crow != me[:, None])
+    )
+    # One divide per slot (k/d^3 * diff) instead of the stencil
+    # path's three (mag * diff/d): ulp-equal, measured ~25% of the
+    # sweep at 65k on CPU.  (lax.rsqrt would drop the sqrt too, but
+    # XLA CPU lowers it to the ~12-bit approximate instruction —
+    # ~3e-4 relative on near-contact pairs, outside the portable
+    # exactness contract.)
+    scale = k_sep / (dist_c * dist_c * dist_c)
+    return jnp.sum(
+        jnp.where(near[..., None], scale[..., None] * diff, 0.0),
+        axis=1,
+    )
 
 
 def separation_grid(

@@ -634,13 +634,27 @@ def separation_hashgrid_pallas(
     moments field, the rescue) consumes it, instead of each running
     its own bin+sort.  Must match ``(g, max_per_cell, torus_hw)`` or
     this raises; ``None`` keeps the self-building r5 behavior for
-    direct callers."""
+    direct callers.
+
+    Verlet reuse (r9): a passed plan may be STALE — built from a
+    snapshot up to ``plan.skin/2`` of motion ago (the
+    ``refresh_plan`` contract; alive changes always rebuild).  The
+    position planes are therefore scattered from the CURRENT ``pos``
+    gathered through ``plan.order`` (identical to the snapshot when
+    fresh), and the stencil radius is sized to cover
+    ``personal_space + plan.skin`` so ref-cell adjacency still
+    reaches every true pair; the in-kernel distance test stays at
+    the true ``personal_space``, so detection is exact across the
+    reuse window.  ``cell`` must be the INFLATED cell the plan was
+    built with (``base_cell + skin``) — geometry is validated
+    against ``plan.g`` exactly as before."""
     n, d = pos.shape
     if d != 2:
         raise ValueError("hash-grid separation kernel is 2-D only")
     K = max_per_cell
     g, cell_eff = _geometry(torus_hw, cell, K)
-    R = _stencil_radius(cell_eff, personal_space)
+    ps_cover = personal_space + (plan.skin if plan is not None else 0.0)
+    R = _stencil_radius(cell_eff, ps_cover)
     L = g * K
     reach = (R + 1) * K
     if lane_chunk is None:
@@ -694,7 +708,11 @@ def separation_hashgrid_pallas(
             )
         cx, cy = plan.cx, plan.cy
         order, skey, rank = plan.order, plan.skey, plan.rank
-        ok, sx, sy = plan.ok, plan.sx, plan.sy
+        ok = plan.ok
+        # Current positions in slot order — NOT the plan's sx/sy
+        # snapshot (bitwise-equal when the plan is fresh; the live
+        # values when it is reused across a Verlet window).
+        sx, sy = pos[order, 0], pos[order, 1]
     slot = skey * K + rank
     # Scatter-build over a sentinel fill (see module doc for the
     # measured gather-build negative).  Dead agents sort past the

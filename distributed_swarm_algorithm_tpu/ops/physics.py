@@ -131,12 +131,18 @@ def tick_uses_hashgrid_kernel(
     error rather than relying on the config-comment contract.
     Detection is best-effort: inside jit the array is a tracer with
     no sharding and the static config choice stands (document your
-    mesh with 'portable' there, as before)."""
+    mesh with 'portable' there, as before).
+
+    With ``hashgrid_skin > 0`` (r9) the envelope is evaluated at the
+    INFLATED geometry — cell ``grid_cell + skin``, coverage radius
+    ``personal_space + skin`` — because that is the grid the Verlet
+    plan actually bins on."""
     from .pallas.grid_separation import hashgrid_backend_choice
 
     use = hashgrid_backend_choice(
         cfg.hashgrid_backend, dim, dtype, cfg.world_hw,
-        cfg.grid_cell, cfg.grid_max_per_cell, cfg.personal_space,
+        cfg.grid_cell + cfg.hashgrid_skin, cfg.grid_max_per_cell,
+        cfg.personal_space + cfg.hashgrid_skin,
         knob="hashgrid_backend",
     )
     if use and arr is not None and _committed_multidevice(arr):
@@ -175,12 +181,150 @@ def tick_field_enabled(cfg: SwarmConfig) -> bool:
     return True
 
 
+def resolve_plan_geometry(
+    use_kernel: bool,
+    world_hw: float,
+    sep_cell: float,
+    personal_space: float,
+    max_per_cell: int,
+    skin: float,
+    field_on: bool,
+    field_sep_cell: float,
+    align_cell: float,
+):
+    """(g_plan, cell_plan, share_field): THE resolution of a hashgrid
+    plan's grid geometry, shared by ``build_tick_plan`` (protocol
+    tick) and ``ops/boids.build_gridmean_plan`` (flocking twin) so
+    the two cannot drift (the r5 ``hashgrid_backend_choice`` lesson,
+    applied to geometry).
+
+    Kernel path: the fused kernel's 16-aligned grid on the
+    skin-inflated cell (``_geometry`` validates the envelope).
+    Portable path: the legacy floor tiling on ``max(sep_cell,
+    personal_space) + skin`` (per-cell occupancy — and hence the
+    cap-truncation set — unchanged from the pre-plan portable path
+    at skin 0).  ``share_field``: the commensurate moments-field
+    keys ride the plan only when the field is on, its fine grid
+    coincides with the plan grid, and ``skin == 0`` (a stale
+    binning would misplace deposits — skinned ticks let the field
+    re-bin per tick)."""
+    if use_kernel:
+        from .pallas.grid_separation import _geometry
+
+        g_plan, _ = _geometry(
+            world_hw, sep_cell + skin, max_per_cell
+        )
+        cell_plan = sep_cell
+    else:
+        cell_plan = max(sep_cell, personal_space)
+        g_plan = max(1, int(2.0 * world_hw / (cell_plan + skin)))
+        if g_plan < 3:
+            raise ValueError(
+                f"torus [-{world_hw}, {world_hw}) tiled by cell "
+                f"{cell_plan + skin} gives a {g_plan}-cell grid; "
+                "the wrapping 3x3 stencil needs g >= 3 (use the "
+                "dense separation/neighbor mode for such tiny "
+                "worlds)"
+            )
+    share_field = False
+    if skin == 0.0 and field_on:
+        from .grid_moments import align_cell_arg, commensurate_geometry
+
+        share_field = commensurate_geometry(
+            world_hw, field_sep_cell, align_cell_arg(align_cell)
+        )[0] == g_plan
+    return g_plan, cell_plan, share_field
+
+
+def build_tick_plan(
+    state: SwarmState,
+    cfg: SwarmConfig,
+    amortized: bool = True,
+):
+    """Build the hashgrid tick's shared spatial plan for this config —
+    THE one place the tick's plan geometry is resolved (``apf_forces``
+    builds through it when no plan is passed, and the rollout drivers
+    call it to seed the scan carry).
+
+    Geometry: the fused kernel's 16-aligned grid on the kernel path,
+    the legacy floor tiling on the portable path — both inflated by
+    ``cfg.hashgrid_skin`` (the Verlet reuse window; 0 = the exact r8
+    per-tick geometry).  The commensurate moments-field keys ride
+    along only when the field is on, its fine grid coincides with the
+    plan grid, AND ``skin == 0`` — a stale plan's fine-grid binning
+    would misplace deposits, so skinned ticks let the field re-bin
+    per tick (the documented fallback).
+
+    ``amortized``: build the per-cell stencil-union candidate table
+    (width ``cfg.hashgrid_neighbor_cap``) — the portable
+    rollout-carry sweep reads one ``[N, W]`` row instead of walking
+    the 3x3 stencil.  Per-tick builders (``apf_forces`` with
+    ``plan=None``) skip it: the stencil sweep is already exact and
+    the table only pays for itself when the plan is reused.
+    """
+    pos = state.pos
+    if cfg.world_hw <= 0:
+        raise ValueError(
+            "separation_mode='hashgrid' needs world_hw > 0 (the "
+            "torus half-width the grid tiles); set it in "
+            "SwarmConfig"
+        )
+    if pos.shape[1] != 2:
+        # Without this guard the portable branch would silently
+        # degrade to the NON-torus dense pass (separation_grid's
+        # d != 2 fallback ignores torus_hw) — no seam wrapping,
+        # no error (r5 review finding).
+        raise ValueError(
+            "separation_mode='hashgrid' is 2-D only (the cell "
+            f"grid tiles a 2-D torus); got dim={pos.shape[1]}"
+        )
+    from .grid_moments import align_cell_arg
+    from .hashgrid_plan import build_hashgrid_plan
+
+    skin = float(cfg.hashgrid_skin)
+    use_kernel = tick_uses_hashgrid_kernel(
+        cfg, pos.shape[1], pos.dtype, arr=pos
+    )
+    g_plan, cell_plan, share_field = resolve_plan_geometry(
+        use_kernel, cfg.world_hw, cfg.grid_cell, cfg.personal_space,
+        cfg.grid_max_per_cell, skin,
+        field_on=tick_field_enabled(cfg),
+        field_sep_cell=cfg.grid_cell, align_cell=cfg.align_cell,
+    )
+    neighbor_cap = (
+        cfg.hashgrid_neighbor_cap
+        if (amortized and skin > 0.0 and not use_kernel)
+        else 0
+    )
+    return build_hashgrid_plan(
+        pos, state.alive, float(cfg.world_hw), float(cell_plan),
+        cfg.grid_max_per_cell,
+        need_csr=not use_kernel,
+        field_sep_cell=(
+            float(cfg.grid_cell) if share_field else None
+        ),
+        field_align_cell=(
+            align_cell_arg(cfg.align_cell) if share_field else None
+        ),
+        g=g_plan, skin=skin,
+        neighbor_cap=neighbor_cap,
+    )
+
+
 def apf_forces(
     state: SwarmState,
     obstacles: Optional[jax.Array],
     cfg: SwarmConfig,
+    plan=None,
 ) -> jax.Array:
-    """Total APF force per agent, [N, D]."""
+    """Total APF force per agent, [N, D].
+
+    ``plan`` (r9): a prebuilt — possibly Verlet-reused —
+    :class:`~.hashgrid_plan.HashgridPlan` from the rollout carry
+    (``physics_step_plan`` refreshes it before calling here).  With
+    ``None`` and ``separation_mode='hashgrid'``, the tick builds its
+    own plan via :func:`build_tick_plan` — exact per-tick behavior
+    regardless of ``hashgrid_skin``."""
     pos = state.pos
     eps = jnp.asarray(cfg.dist_eps, pos.dtype)
 
@@ -276,92 +420,16 @@ def apf_forces(
         # to the per-cell cap and STABLE in detection — the mode that
         # collapses the exact-tick-vs-window throughput gap.  Same
         # semantics as separation_grid(torus_hw=world_hw) up to the
-        # kernel's documented occupancy-cap delta.
-        if cfg.world_hw <= 0:
-            raise ValueError(
-                "separation_mode='hashgrid' needs world_hw > 0 (the "
-                "torus half-width the grid tiles); set it in "
-                "SwarmConfig"
-            )
-        if pos.shape[1] != 2:
-            # Without this guard the portable branch would silently
-            # degrade to the NON-torus dense pass (separation_grid's
-            # d != 2 fallback ignores torus_hw) — no seam wrapping,
-            # no error (r5 review finding).
-            raise ValueError(
-                "separation_mode='hashgrid' is 2-D only (the cell "
-                f"grid tiles a 2-D torus); got dim={pos.shape[1]}"
-            )
-        from .hashgrid_plan import build_hashgrid_plan, plan_field_keys
+        # kernel's documented occupancy-cap delta.  Geometry and the
+        # shared build live in build_tick_plan; a rollout-carried
+        # (skin-reused) plan arrives via the ``plan`` argument.
+        from .hashgrid_plan import plan_field_keys
 
         use_kernel = tick_uses_hashgrid_kernel(
             cfg, pos.shape[1], pos.dtype, arr=pos
         )
-        if use_kernel:
-            from .pallas.grid_separation import _geometry
-
-            # The kernel's resolved geometry IS the plan geometry —
-            # _geometry validates the cap/grid envelope exactly as the
-            # pre-plan kernel build did.
-            g_plan, _ = _geometry(
-                cfg.world_hw, cfg.grid_cell, cfg.grid_max_per_cell
-            )
-            cell_plan = cfg.grid_cell
-        else:
-            # The portable 3x3 gather needs cell >= personal_space:
-            # a half-cell config (kernel-only geometry) falls back to
-            # the full-cell grid — exact up to the cap either way.
-            # Geometry keeps the LEGACY floor tiling (g = 2hw/cell,
-            # not 16-aligned) so the per-cell occupancy — and hence
-            # the cap-truncation set — is unchanged from the pre-plan
-            # portable path; the 16-aligned grid is adopted below
-            # ONLY when the moments field shares the plan (its
-            # commensurate geometry requires it, and
-            # commensurate_geometry raises for worlds too small to
-            # align).
-            cell_plan = max(cfg.grid_cell, cfg.personal_space)
-            g_plan = max(1, int(2.0 * cfg.world_hw / cell_plan))
-            if g_plan < 3:
-                raise ValueError(
-                    f"torus [-{cfg.world_hw}, {cfg.world_hw}) tiled "
-                    f"by cell {cell_plan} gives a {g_plan}-cell grid; "
-                    "the wrapping 3x3 stencil needs g >= 3 (use "
-                    "dense separation for such tiny worlds)"
-                )
-        # Share the fine-grid field binning when the moments field is
-        # on and its commensurate grid COINCIDES with the plan's —
-        # always true on the kernel geometry (same rounding rule,
-        # same cell), and on portable geometries whose floor tiling
-        # already lands on the 16-aligned grid (the common
-        # power-of-two arenas).  Ragged worlds and half-cell
-        # fallbacks keep their legacy separation grid — identical
-        # occupancy/truncation behavior to the pre-plan tick — and
-        # the field bins itself as before (one extra elementwise
-        # pass, the documented cost of not coarsening the grid).
-        share_field = False
-        if tick_field_enabled(cfg):
-            from .grid_moments import (
-                align_cell_arg,
-                commensurate_geometry,
-            )
-
-            g_fine = commensurate_geometry(
-                cfg.world_hw, cfg.grid_cell,
-                align_cell_arg(cfg.align_cell),
-            )[0]
-            share_field = g_fine == g_plan
-        plan = build_hashgrid_plan(
-            pos, state.alive, float(cfg.world_hw), float(cell_plan),
-            cfg.grid_max_per_cell,
-            need_csr=not use_kernel,
-            field_sep_cell=(
-                float(cfg.grid_cell) if share_field else None
-            ),
-            field_align_cell=(
-                align_cell_arg(cfg.align_cell) if share_field else None
-            ),
-            g=g_plan,
-        )
+        if plan is None:
+            plan = build_tick_plan(state, cfg, amortized=False)
         field_keys = plan_field_keys(plan)
         if use_kernel:
             from ..utils.platform import on_tpu
@@ -372,7 +440,7 @@ def apf_forces(
             f_sep = separation_hashgrid_pallas(
                 pos, state.alive, float(cfg.k_sep),
                 float(cfg.personal_space), float(cfg.dist_eps),
-                cell=float(cfg.grid_cell),
+                cell=float(cfg.grid_cell) + plan.skin,
                 max_per_cell=cfg.grid_max_per_cell,
                 torus_hw=float(cfg.world_hw),
                 overflow_budget=cfg.hashgrid_overflow_budget,
@@ -407,12 +475,23 @@ def apf_forces(
             )
         from .grid_moments import align_cell_arg, cic_field_commensurate
 
+        if cfg.field_deposit == "sorted" and field_keys is None:
+            raise ValueError(
+                "field_deposit='sorted' runs the deposit off the "
+                "shared plan's existing cell sort (plan_cell_sums), "
+                "so it needs the plan to carry the field keys: "
+                "separation_mode='hashgrid' with a commensurate "
+                "geometry and hashgrid_skin == 0 (a stale sort "
+                "cannot deposit).  Use field_deposit='scatter' here."
+            )
         align, coh = cic_field_commensurate(
             pos, state.vel, state.alive,
             torus_hw=float(cfg.world_hw),
             sep_cell=float(cfg.grid_cell),
             align_cell=align_cell_arg(cfg.align_cell),
             keys=field_keys,
+            plan=plan if cfg.field_deposit == "sorted" else None,
+            deposit=cfg.field_deposit,
         )
         f_field = cfg.k_align * align + cfg.k_coh * coh
     else:
@@ -452,12 +531,54 @@ def physics_step(
     the mission instead of parking on its stale formation slot (which is
     what persisting the derived target caused).
     """
+    return _physics_step_core(state, obstacles, cfg, None, dt)[0]
+
+
+def physics_step_plan(
+    state: SwarmState,
+    obstacles: Optional[jax.Array],
+    cfg: SwarmConfig,
+    plan,
+    dt: Optional[float] = None,
+) -> Tuple[SwarmState, object]:
+    """One motion tick with a CARRIED hashgrid plan (r9): refresh the
+    Verlet plan against the tick's current positions/alive set
+    (``hashgrid_plan.refresh_plan`` — a rebuild only when some agent
+    has outrun the skin, the alive set changed, or the
+    ``hashgrid_rebuild_every`` ceiling hit), run the same tick as
+    :func:`physics_step` off it, and hand the plan back for the next
+    iteration.  This is the protocol tick the ``lax.scan`` rollout
+    drivers carry (``models/swarm.py``); seed the carry with
+    :func:`build_tick_plan`."""
+    return _physics_step_core(state, obstacles, cfg, plan, dt)
+
+
+def _physics_step_core(
+    state: SwarmState,
+    obstacles: Optional[jax.Array],
+    cfg: SwarmConfig,
+    plan,
+    dt: Optional[float],
+) -> Tuple[SwarmState, object]:
+    """The one tick body behind both :func:`physics_step` and
+    :func:`physics_step_plan` — shared so the plan-carried and eager
+    ticks cannot drift."""
     dt = cfg.dt if dt is None else dt
+    if plan is not None:
+        from .hashgrid_plan import refresh_plan
+
+        # Refresh BEFORE the forces so the exactness bound is
+        # checked against the exact positions this tick's forces
+        # read.
+        plan = refresh_plan(
+            state.pos, state.alive, plan,
+            rebuild_every=cfg.hashgrid_rebuild_every,
+        )
     derived = formation_targets(state, cfg)
-    force = apf_forces(derived, obstacles, cfg)
+    force = apf_forces(derived, obstacles, cfg, plan=plan)
     # Reference semantics: no target => early return, nothing moves
     # (agent.py:113-114).  Dead agents are frozen too (masked update).
     moving = derived.has_target & state.alive
     pos, vel = integrate(state.pos, force, moving, cfg, dt)
     pos = jnp.where(moving[:, None], pos, state.pos)
-    return state.replace(pos=pos, vel=vel)
+    return state.replace(pos=pos, vel=vel), plan

@@ -143,6 +143,48 @@ class SwarmConfig:
     #   Max capped-out agents per tick that still receive exact
     #   (symmetric) separation via the kernel's rescue pass; see
     #   ops/pallas/grid_separation.py.
+    hashgrid_skin: float = 0.0
+    #   Verlet skin radius (r9, ops/hashgrid_plan.py).  0 = rebuild
+    #   the spatial index every tick (the exact r8 behavior).  > 0:
+    #   the index is built with cells inflated by `skin` and REUSED
+    #   across `lax.scan` rollout ticks until any agent has moved
+    #   more than skin/2 from the build snapshot (or the alive set
+    #   changes) — a provably exact superset until then, so
+    #   detection stays exact while the bin+sort cost is paid per
+    #   REBUILD instead of per tick.  Portable rollouts additionally
+    #   materialize a per-cell stencil-union candidate table
+    #   (hashgrid_neighbor_cap) whose one-row [N, W] sweep replaces
+    #   the 9-cell stencil gathers.
+    #   Pick skin ~ personal_space/2..personal_space; budget cap
+    #   headroom (grid_max_per_cell) for the inflated cells, which
+    #   hold (1 + skin/cell)^2 more agents.  Amortization engages in
+    #   swarm_rollout / VectorSwarm.step(n>1); single eager ticks
+    #   still rebuild per tick (exact either way).
+    hashgrid_rebuild_every: int = 0
+    #   Hard staleness ceiling for the Verlet plan: > 0 forces a
+    #   rebuild whenever the carried plan is this many ticks old,
+    #   regardless of measured displacement — an override for drift
+    #   the displacement probe cannot see.  0 = displacement/alive
+    #   triggers only.
+    hashgrid_neighbor_cap: int = 64
+    #   Width W of the per-cell stencil-union candidate table
+    #   ([g*g, W]: every live agent in a cell's 3x3 neighborhood, in
+    #   stencil scan order) — the amortized portable sweep reads one
+    #   [N, W] row instead of nine [N, K] stencil windows.  Size to
+    #   ~9x the expected cell occupancy; neighborhoods past W
+    #   truncate their scan-order tail (counted in
+    #   plan.cand_overflow), like grid_max_per_cell overflow.  Only
+    #   materialized for amortized portable rollouts
+    #   (hashgrid_skin > 0).
+    field_deposit: str = "scatter"
+    #   Moments-field deposit backend (r9, promoting r8's
+    #   plan_cell_sums).  "scatter": the production .at[key].add cell
+    #   reduction.  "sorted": the sorted-segment deposit off the
+    #   shared plan's existing cell sort (plan_cell_sums — measured
+    #   -24% deposit time on CPU in r8, kept non-default pending the
+    #   TPU re-measure this flag exists to run without code changes).
+    #   "sorted" requires the shared plan: separation_mode='hashgrid',
+    #   commensurate field geometry, hashgrid_skin == 0.
     window_size: int = 16               # ± sorted-order span for "window"
     sort_every: int = 1                 # "window" re-sort cadence in ticks.
     #   1 (default): sort+gather+scatter inside the separation pass every

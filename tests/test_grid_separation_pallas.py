@@ -197,9 +197,13 @@ def test_gridmean_pallas_scan_runs():
 @pytest.mark.parametrize("lane_chunk", [128, 256])
 def test_lane_tiled_matches_1d_kernel(lane_chunk):
     """The r4b lane-tiled kernel (forced via lane_chunk) must agree
-    with the 1-D kernel exactly — same math, different blocking.
-    Chunks at 128 put many cy-seam and chunk-edge crossings in
-    play (g=32, K=16 -> L=512 = 4 chunks of 128)."""
+    with the 1-D kernel — same math, different blocking.  Chunks at
+    128 put many cy-seam and chunk-edge crossings in play (g=32,
+    K=16 -> L=512 = 4 chunks of 128).  Band, not bitwise (r9 triage,
+    SURVEY.md): the tiled form accumulates edge-crossing reactions in
+    separate spill planes summed after the sweep, so pairs straddling
+    a chunk edge associate differently — observed ~1e-5 relative on a
+    couple of elements per 4096."""
     pos, alive = _swarm(2048, seed=21)
     base = separation_hashgrid_pallas(
         pos, alive, 20.0, PS, 1e-3, cell=CELL, max_per_cell=16,
@@ -210,7 +214,7 @@ def test_lane_tiled_matches_1d_kernel(lane_chunk):
         torus_hw=HW, lane_chunk=lane_chunk, interpret=True,
     )
     np.testing.assert_allclose(
-        np.asarray(base), np.asarray(tiled), rtol=1e-6, atol=1e-6
+        np.asarray(base), np.asarray(tiled), rtol=5e-5, atol=1e-5
     )
 
 

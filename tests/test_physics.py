@@ -571,7 +571,12 @@ def test_hashgrid_tick_protocol_semantics_run():
         separation_mode="hashgrid", world_hw=32.0, grid_max_per_cell=16,
     )
     s = _hashgrid_swarm(n=128, spread=20.0)
-    out = dsa.swarm_rollout(s, None, cfg, 100)
+    # 200 ticks, not 100 (r9 triage, SURVEY.md): election takes ~30+
+    # ticks and the leader then covers ~30 m at 0.5 m/tick — at 100
+    # ticks this seed's leader (and the DENSE oracle's, which is even
+    # further out at ~11 m) is still en route; both arrive and hold
+    # station by 200.
+    out = dsa.swarm_rollout(s, None, cfg, 200)
     assert bool(jnp.isfinite(out.pos).all())
     # Not a swarm-contraction bar: once a leader is elected the
     # followers steer to FORMATION slots (a 128-agent V spans ~250 m,

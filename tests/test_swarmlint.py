@@ -132,6 +132,23 @@ SEEDED = {
             return outs
         """,
     ),
+    "plan-staleness": (
+        "pkg/scanplan.py",
+        """
+        import jax
+        from distributed_swarm_algorithm_tpu.ops.hashgrid_plan import (
+            build_hashgrid_plan,
+        )
+
+        def rollout(pos, alive, n_steps):
+            def body(s, _):
+                plan = build_hashgrid_plan(s, alive, 32.0, 2.0, 16)
+                return s + plan.cell_eff, None
+
+            out, _ = jax.lax.scan(body, pos, None, length=n_steps)
+            return out
+        """,
+    ),
     "dtype-drift": (
         "ops/hot.py",
         """
@@ -274,6 +291,35 @@ def test_each_rule_fires_exactly_once_on_seeded_tree(tmp_path):
 
             X = 1
             ''',
+        ),
+        # A scan body that routes its build through refresh_plan is
+        # the AMORTIZED pattern — the rebuild lives under lax.cond
+        # inside refresh_plan, so no plan-staleness finding.
+        (
+            "scan_refresh_plan",
+            """
+            import jax
+            from distributed_swarm_algorithm_tpu.ops.hashgrid_plan import (
+                build_hashgrid_plan,
+                refresh_plan,
+            )
+
+            def rollout(pos, alive, plan0, n_steps):
+                def body(carry, _):
+                    s, plan = carry
+                    plan = refresh_plan(s, alive, plan)
+                    return (s, plan), None
+
+                out, _ = jax.lax.scan(
+                    body, (pos, plan0), None, length=n_steps
+                )
+                return out
+
+            def seed(pos, alive):
+                # A build OUTSIDE any loop body is the carry seed —
+                # never flagged.
+                return build_hashgrid_plan(pos, alive, 32.0, 2.0, 16)
+            """,
         ),
         # `x is None` presence checks never concretize a tracer.
         (

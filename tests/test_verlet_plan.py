@@ -1,0 +1,471 @@
+"""Skin-radius Verlet plan reuse (ops/hashgrid_plan.py, r9).
+
+The tentpole contract, pinned:
+
+- while max displacement stays under ``skin/2`` a REUSED plan's tick
+  is bitwise equal to a fresh-plan tick, on both the portable (stencil
+  AND Verlet-list) and kernel (interpret) paths.  Bitwise needs one
+  extra hypothesis the property test constructs explicitly: no agent
+  crosses a cell boundary — a crossed boundary re-slots the agent in
+  the fresh build and reassociates the fp sums (pair-SET exactness
+  without that hypothesis is pinned separately, at tolerance, by the
+  rollout tests below);
+- the forced-rebuild path (refresh_plan past the trigger) is bitwise
+  equal to build-from-scratch, and the keep path is bitwise identity;
+- a skin=0 plan degenerates to the r8 per-tick behavior;
+- cap overflow under the inflated stencil keeps the documented
+  truncation contract (list and stencil consumers of one plan agree);
+- amortized rollouts (plan in the scan carry) match per-tick-rebuild
+  rollouts at fp-drift tolerance, and actually amortize (observed
+  rebuild count < tick count on a near-stationary swarm).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu.ops import neighbors as nb
+from distributed_swarm_algorithm_tpu.ops.hashgrid_plan import (
+    HashgridPlan,
+    build_hashgrid_plan,
+    plan_staleness,
+    refresh_plan,
+)
+from distributed_swarm_algorithm_tpu.ops.pallas.grid_separation import (
+    _geometry,
+    separation_hashgrid_pallas,
+)
+from distributed_swarm_algorithm_tpu.state import make_swarm
+
+HW = 32.0
+CELL = 2.0
+PS = 2.0
+SKIN = 1.0
+K = 16
+EPS = 1e-3
+
+
+def _cell_interior_swarm(n, g, seed=0, margin=0.3):
+    """[n, 2] positions strictly inside cells of the g-grid tiling
+    [-HW, HW): random cell + offset <= ``margin`` * cell from its
+    center, so sub-(0.5-margin)*cell motion can never cross a cell
+    boundary — the extra hypothesis the bitwise property needs."""
+    rng = np.random.default_rng(seed)
+    cell_eff = 2.0 * HW / g
+    cells = rng.integers(0, g, size=(n, 2))
+    off = rng.uniform(-margin, margin, size=(n, 2)) * cell_eff
+    pos = (cells + 0.5) * cell_eff - HW + off
+    return jnp.asarray(pos, jnp.float32)
+
+
+def _small_motion(n, seed=1, amp=0.2):
+    """Per-agent displacement with |dx|,|dy| <= amp (norm <= amp*√2):
+    keep amp*√2 < SKIN/2 and < (0.5 - margin)*cell so the plan stays
+    valid AND nobody changes cell."""
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(
+        rng.uniform(-amp, amp, size=(n, 2)), jnp.float32
+    )
+
+
+# --- bitwise: reused plan == fresh plan while inside the skin ----------
+
+
+@pytest.mark.parametrize("neighbor_cap", [0, 64])
+def test_reused_portable_tick_bitwise_fresh(neighbor_cap):
+    """Portable path (stencil walk at cap 0, stencil-union candidate
+    table at 64): forces from a stale-but-valid plan at the CURRENT
+    positions are bitwise the forces from a plan freshly built at
+    those positions.  Membership in both forms is binning-only
+    (runs of the occupancy tables), so the within-cell-motion
+    hypothesis alone makes the two plans structurally identical."""
+    n = 1024
+    g = max(1, int(2.0 * HW / (CELL + SKIN)))       # portable tiling
+    pos0 = _cell_interior_swarm(n, g, seed=3)
+    alive = jnp.ones((n,), bool)
+    kw = dict(need_csr=True, g=g, skin=SKIN, neighbor_cap=neighbor_cap)
+    plan0 = build_hashgrid_plan(pos0, alive, HW, CELL, K, **kw)
+    pos1 = pos0 + _small_motion(n, seed=4)
+    # inside the trigger: refresh keeps the stale plan
+    d2max, changed = plan_staleness(pos1, alive, plan0)
+    assert not bool(changed)
+    assert 4.0 * float(d2max) <= SKIN * SKIN
+    stale = refresh_plan(pos1, alive, plan0)
+    assert int(stale.rebuilds) == 0 and int(stale.age) == 1
+    fresh = build_hashgrid_plan(pos1, alive, HW, CELL, K, **kw)
+    if neighbor_cap:
+        # nobody changed cell -> identical candidate tables
+        np.testing.assert_array_equal(
+            np.asarray(stale.cand), np.asarray(fresh.cand)
+        )
+    eps = jnp.asarray(EPS)
+    f_stale = nb.separation_grid_plan(pos1, alive, 20.0, PS, eps, stale)
+    f_fresh = nb.separation_grid_plan(pos1, alive, 20.0, PS, eps, fresh)
+    assert float(jnp.max(jnp.abs(f_stale))) > 0.0   # not vacuous
+    np.testing.assert_array_equal(
+        np.asarray(f_stale), np.asarray(f_fresh)
+    )
+
+
+def test_reused_kernel_tick_bitwise_fresh():
+    """Kernel path (interpret): stale-plan planes are scattered from
+    current positions through the frozen slot map, so while nobody
+    changes cell the kernel sees bit-identical inputs either way."""
+    n = 1024
+    g, _ = _geometry(HW, CELL + SKIN, K)            # 16-aligned
+    pos0 = _cell_interior_swarm(n, g, seed=5)
+    alive = jnp.ones((n,), bool)
+    plan0 = build_hashgrid_plan(
+        pos0, alive, HW, CELL, K, g=g, skin=SKIN
+    )
+    pos1 = pos0 + _small_motion(n, seed=6)
+    stale = refresh_plan(pos1, alive, plan0)
+    assert int(stale.rebuilds) == 0
+    fresh = build_hashgrid_plan(
+        pos1, alive, HW, CELL, K, g=g, skin=SKIN
+    )
+    kw = dict(
+        k_sep=20.0, personal_space=PS, eps=EPS, cell=CELL + SKIN,
+        max_per_cell=K, torus_hw=HW, overflow_budget=64,
+        interpret=True,
+    )
+    a = separation_hashgrid_pallas(pos1, alive, plan=stale, **kw)
+    b = separation_hashgrid_pallas(pos1, alive, plan=fresh, **kw)
+    assert float(jnp.max(jnp.abs(a))) > 0.0
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_stale_plan_exact_pair_set_generic_motion():
+    """Generic sub-skin/2 motion (cell crossings allowed): the stale
+    plan's candidate superset still yields the EXACT torus pair
+    forces — equality against the legacy per-tick-rebuilt
+    ``separation_grid`` oracle at the near-contact-amplified band:
+    the union sweep's select-form wrap and fused k/d^3 divide are
+    ~ulp-different from the oracle's mod-wrap and mag*unit forms,
+    and 1/d^2 pairs near the eps floor amplify ulps to ~1e-4
+    relative (the same band class as the kernel-vs-portable parity
+    pins in test_physics)."""
+    n = 768
+    s = make_swarm(n, seed=9, spread=28.0)
+    alive = jnp.ones((n,), bool)
+    g = max(1, int(2.0 * HW / (CELL + SKIN)))
+    plan0 = build_hashgrid_plan(
+        s.pos, alive, HW, CELL, 32, need_csr=True, g=g, skin=SKIN,
+        neighbor_cap=96,
+    )
+    pos1 = s.pos + _small_motion(n, seed=10, amp=0.33)  # norm<=.467<skin/2
+    stale = refresh_plan(pos1, alive, plan0)
+    assert int(stale.rebuilds) == 0
+    assert int(stale.cand_overflow) == 0            # caps not in play
+    assert int(jnp.sum(~stale.ok)) == 0
+    eps = jnp.asarray(EPS)
+    got = nb.separation_grid_plan(pos1, alive, 20.0, PS, eps, stale)
+    want = nb.separation_grid(
+        pos1, alive, 20.0, PS, eps, cell=CELL + SKIN,
+        max_per_cell=32, torus_hw=HW,
+    )
+    scale = max(float(jnp.abs(want).max()), 1.0)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4,
+        atol=2e-4 * scale,
+    )
+
+
+# --- the trigger: forced rebuild == build from scratch ------------------
+
+
+def test_rebuild_past_trigger_equals_scratch():
+    n = 512
+    s = make_swarm(n, seed=11, spread=25.0)
+    alive = jnp.ones((n,), bool)
+    plan0 = build_hashgrid_plan(
+        s.pos, alive, HW, CELL, K, need_csr=True, skin=SKIN,
+        neighbor_cap=64,
+    )
+    pos1 = s.pos + jnp.asarray([0.6, 0.0], jnp.float32)  # 2*0.6 > skin
+    got = refresh_plan(pos1, alive, plan0)
+    assert int(got.rebuilds) == 1 and int(got.age) == 0
+    want = build_hashgrid_plan(
+        pos1, alive, HW, CELL, K, need_csr=True, g=plan0.g, skin=SKIN,
+        neighbor_cap=64,
+    )
+    for f in HashgridPlan.ARRAY_FIELDS:
+        a, b = getattr(got, f), getattr(want, f)
+        if f == "rebuilds":
+            continue
+        if a is None:
+            assert b is None
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=f
+        )
+
+
+def test_alive_change_triggers_rebuild():
+    n = 256
+    s = make_swarm(n, seed=12, spread=25.0)
+    alive = jnp.ones((n,), bool)
+    plan0 = build_hashgrid_plan(
+        s.pos, alive, HW, CELL, K, need_csr=True, skin=SKIN
+    )
+    got = refresh_plan(s.pos, alive.at[7].set(False), plan0)
+    assert int(got.rebuilds) == 1
+    # the rebuilt plan keyed the dead agent past the grid
+    assert int(np.asarray(got.key)[7]) == got.g * got.g
+
+
+def test_rebuild_every_ceiling():
+    n = 128
+    s = make_swarm(n, seed=13, spread=25.0)
+    alive = jnp.ones((n,), bool)
+    plan = build_hashgrid_plan(
+        s.pos, alive, HW, CELL, K, need_csr=True, skin=SKIN
+    )
+    for i in range(3):
+        plan = refresh_plan(s.pos, alive, plan, rebuild_every=3)
+    # two keeps then the age ceiling fires
+    assert int(plan.rebuilds) == 1 and int(plan.age) == 0
+
+
+# --- skin = 0 degenerates to r8 -----------------------------------------
+
+
+def test_skin_zero_degenerates_to_r8():
+    n = 512
+    s = make_swarm(n, seed=14, spread=25.0)
+    alive = jnp.ones((n,), bool)
+    r8 = build_hashgrid_plan(s.pos, alive, HW, CELL, K, need_csr=True)
+    z = build_hashgrid_plan(
+        s.pos, alive, HW, CELL, K, need_csr=True, skin=0.0
+    )
+    assert (z.g, z.cell_eff) == (r8.g, r8.cell_eff)
+    for f in ("key", "order", "skey", "rank", "counts"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(z, f)), np.asarray(getattr(r8, f))
+        )
+    # any motion at all expires a skin-0 plan
+    moved = refresh_plan(s.pos + 1e-3, alive, z)
+    assert int(moved.rebuilds) == 1
+    # ...and a motionless tick legally keeps it
+    kept = refresh_plan(s.pos, alive, z)
+    assert int(kept.rebuilds) == 0
+    # the rollout driver does not carry a plan at skin=0
+    cfg = dsa.SwarmConfig().replace(
+        separation_mode="hashgrid", world_hw=HW,
+        grid_max_per_cell=K, hashgrid_backend="portable",
+    )
+    st = make_swarm(n, seed=14, spread=25.0)
+    st = st.replace(
+        target=jnp.broadcast_to(jnp.asarray([5.0, 5.0]), st.pos.shape),
+        has_target=jnp.ones_like(st.has_target),
+    )
+    out, plan = dsa.swarm_rollout(st, None, cfg, 3, return_plan=True)
+    assert plan is None
+
+
+# --- cap overflow under the inflated stencil ----------------------------
+
+
+def test_cap_overflow_inflated_stencil_truncation_contract():
+    """A cell crowded past K under the INFLATED grid: the union-table
+    consumer and the stencil consumer of the same geometry see the
+    same K-truncated candidate set (the table concatenates the same
+    occupancy runs in the same scan order), so their forces agree up
+    to the union sweep's documented fp-form band (select wrap +
+    fused divide, near-contact amplified); the overflow is real and
+    counted."""
+    rng = np.random.default_rng(15)
+    g = max(1, int(2.0 * HW / (CELL + SKIN)))
+    cell_eff = 2.0 * HW / g
+    clump = (
+        np.asarray([0.35 * cell_eff, 0.35 * cell_eff])
+        + 0.1 * cell_eff * rng.random((3 * K, 2))
+    ).astype(np.float32)
+    bg = rng.uniform(-HW, HW, size=(512, 2)).astype(np.float32)
+    pos = jnp.asarray(np.concatenate([clump, bg]))
+    n = pos.shape[0]
+    alive = jnp.ones((n,), bool)
+    plan_l = build_hashgrid_plan(
+        pos, alive, HW, CELL, K, need_csr=True, g=g, skin=SKIN,
+        neighbor_cap=9 * K,
+    )
+    assert int(jnp.sum(~plan_l.ok & alive[plan_l.order])) > 0
+    plan_s = build_hashgrid_plan(
+        pos, alive, HW, CELL, K, need_csr=True, g=g, skin=SKIN
+    )
+    eps = jnp.asarray(EPS)
+    f_list = nb.separation_grid_plan(pos, alive, 20.0, PS, eps, plan_l)
+    f_sten = nb.separation_grid_plan(pos, alive, 20.0, PS, eps, plan_s)
+    # a 48-agent clump inside one cell has pairs at ~1e-2 separation
+    # (forces ~1e5): the sweep-form ulp band amplifies to ~1e-3
+    # relative there, wider than the uniform-swarm band above
+    scale = max(float(jnp.abs(f_sten).max()), 1.0)
+    np.testing.assert_allclose(
+        np.asarray(f_list), np.asarray(f_sten), rtol=2e-3,
+        atol=1e-3 * scale,
+    )
+    # union-cap overflow is counted, not silent: rebuild with a
+    # width too small for the clump's neighborhood
+    plan_t = build_hashgrid_plan(
+        pos, alive, HW, CELL, K, need_csr=True, g=g, skin=SKIN,
+        neighbor_cap=4,
+    )
+    assert int(plan_t.cand_overflow) > 0
+    assert bool(jnp.isfinite(
+        nb.separation_grid_plan(pos, alive, 20.0, PS, eps, plan_t)
+    ).all())
+
+
+def test_coverage_validated_across_reuse_window():
+    n = 64
+    s = make_swarm(n, seed=16, spread=20.0)
+    alive = jnp.ones((n,), bool)
+    # cell_eff 2.0 < personal_space + skin: valid r8 geometry, but
+    # NOT valid for reuse across a skin window — the consumer must
+    # refuse rather than silently miss drifted-in neighbors.
+    g_tight = max(1, int(2.0 * HW / CELL))
+    plan = build_hashgrid_plan(
+        s.pos, alive, HW, CELL, K, need_csr=True, g=g_tight,
+        skin=SKIN,
+    )
+    with pytest.raises(ValueError, match="personal_space"):
+        nb.separation_grid_plan(
+            s.pos, alive, 20.0, PS, jnp.asarray(EPS), plan
+        )
+    # the union table refuses tiny wrapped grids outright (duplicate
+    # stencil cells would double-count pairs)
+    with pytest.raises(ValueError, match="g >= 3"):
+        build_hashgrid_plan(
+            s.pos, alive, 2.0, CELL, K, skin=0.0, neighbor_cap=16,
+        )
+
+
+# --- rollout-level: amortized == per-tick rebuild -----------------------
+
+
+def _protocol_swarm(n=512, seed=5, spread=25.0):
+    s = make_swarm(n, seed=seed, spread=spread)
+    return s.replace(
+        target=jnp.broadcast_to(jnp.asarray([5.0, 5.0]), s.pos.shape),
+        has_target=jnp.ones_like(s.has_target),
+    )
+
+
+@pytest.mark.parametrize("backend", ["portable", "pallas"])
+def test_rollout_amortized_matches_per_tick_rebuild(backend):
+    """The full protocol rollout with the plan in the scan carry
+    (skin reuse) vs the same rollout forced to rebuild every tick
+    (rebuild_every=1): same dynamics to fp-drift tolerance, on both
+    separation backends."""
+    cfg = dsa.SwarmConfig().replace(
+        separation_mode="hashgrid", world_hw=HW,
+        grid_max_per_cell=24, hashgrid_backend=backend,
+        hashgrid_skin=SKIN, formation_shape="none",
+    )
+    s = _protocol_swarm()
+    a, plan_a = dsa.swarm_rollout(s, None, cfg, 10, return_plan=True)
+    b, plan_b = dsa.swarm_rollout(
+        s, None, cfg.replace(hashgrid_rebuild_every=1), 10,
+        return_plan=True,
+    )
+    assert int(plan_b.rebuilds) == 10
+    assert int(plan_a.rebuilds) <= int(plan_b.rebuilds)
+    np.testing.assert_allclose(
+        np.asarray(a.pos), np.asarray(b.pos), rtol=2e-4, atol=2e-4
+    )
+    # and against the r8 per-tick geometry (skin=0, no carry)
+    c = dsa.swarm_rollout(s, None, cfg.replace(hashgrid_skin=0.0), 10)
+    np.testing.assert_allclose(
+        np.asarray(a.pos), np.asarray(c.pos), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_rollout_station_keeping_amortizes():
+    """A station-keeping swarm (targets = own positions, nobody
+    inside anyone's personal space) must reuse ONE plan across the
+    whole rollout: observed rebuilds == 0 — the regime the skin
+    exists for (PERFORMANCE.md r9).  One agent per cell with a small
+    center offset keeps every pair >= 0.8 * cell_eff ~ 2.4 > PS
+    apart, so separation exerts nothing and nobody drifts."""
+    g = max(1, int(2.0 * HW / (CELL + SKIN)))
+    n = 384                                     # < g*g distinct cells
+    rng = np.random.default_rng(17)
+    cell_eff = 2.0 * HW / g
+    cells = rng.choice(g * g, size=n, replace=False)
+    off = rng.uniform(-0.1, 0.1, size=(n, 2)) * cell_eff
+    pos = jnp.asarray(
+        np.stack([cells // g, cells % g], axis=1) * cell_eff
+        + 0.5 * cell_eff - HW + off,
+        jnp.float32,
+    )
+    s = make_swarm(n, seed=17, spread=25.0)
+    s = s.replace(
+        pos=pos, target=pos, has_target=jnp.ones_like(s.has_target),
+    )
+    cfg = dsa.SwarmConfig().replace(
+        separation_mode="hashgrid", world_hw=HW,
+        grid_max_per_cell=24, hashgrid_backend="portable",
+        hashgrid_skin=SKIN, formation_shape="none",
+    )
+    out, plan = dsa.swarm_rollout(s, None, cfg, 20, return_plan=True)
+    assert plan is not None
+    assert int(plan.rebuilds) == 0
+    assert int(plan.age) == 20
+
+
+def test_boids_gridmean_skin_rollout_matches_per_tick():
+    from distributed_swarm_algorithm_tpu.ops.boids import (
+        BoidsParams, boids_init, boids_run,
+    )
+
+    p = BoidsParams(
+        half_width=HW, grid_max_per_cell=24,
+        grid_sep_backend="portable", skin=SKIN,
+    )
+    s = boids_init(512, params=p, seed=2)
+    a, _ = boids_run(s, p, 15, neighbor_mode="gridmean")
+    b, _ = boids_run(
+        s, p._replace(rebuild_every=1), 15, neighbor_mode="gridmean"
+    )
+    np.testing.assert_allclose(
+        np.asarray(a.pos), np.asarray(b.pos), rtol=2e-4, atol=2e-4
+    )
+    # r8 twin (skin=0) at drift tolerance
+    c, _ = boids_run(
+        s, p._replace(skin=0.0, grid_max_per_cell=16), 15,
+        neighbor_mode="gridmean",
+    )
+    np.testing.assert_allclose(
+        np.asarray(a.pos), np.asarray(c.pos), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_plan_carry_checkpoint_roundtrip(tmp_path):
+    """The carried plan (ref snapshot, counters, candidate list) must
+    survive the checkpoint round-trip like any other carry state."""
+    import os
+
+    from distributed_swarm_algorithm_tpu.utils import checkpoint as ckpt
+
+    n = 128
+    s = make_swarm(n, seed=18, spread=25.0)
+    alive = jnp.ones((n,), bool)
+    plan = build_hashgrid_plan(
+        s.pos, alive, HW, CELL, K, need_csr=True, skin=SKIN,
+        neighbor_cap=16,
+    )
+    plan = refresh_plan(s.pos + 0.6, alive, plan)   # rebuilds=1
+    path = os.path.join(str(tmp_path), "verlet_plan.npz")
+    ckpt.save(path, plan)
+    target = jax.tree_util.tree_map(jnp.zeros_like, plan)
+    back = ckpt.restore(path, target)
+    assert back.skin == plan.skin
+    assert int(back.rebuilds) == 1
+    for f in HashgridPlan.ARRAY_FIELDS:
+        a, b = getattr(plan, f), getattr(back, f)
+        if a is None:
+            assert b is None
+            continue
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -20,6 +20,13 @@ believers' caches flip, DETECTION still waits for the heartbeat
 timeout exactly like the reference), then advance in CHUNK-tick jitted
 scans, reading the swarm-wide ground truth (current_leader) after
 each chunk; the tick count is chunk-resolution (chunk=2 ticks).
+
+r10: the flight recorder (utils/telemetry.py) replays the same
+recovery window ONCE with in-scan telemetry and reads the
+leader-change event at TICK resolution — no per-chunk host polling —
+plus the leader-churn count over the window (unit "events",
+lower-is-better: re-election must settle in one change, and flapping
+gates).
 """
 
 from __future__ import annotations
@@ -61,6 +68,7 @@ def main() -> None:
     roll(s)                       # compile + warm the chunk program
 
     s = dsa.kill(s, [lid0])
+    s_kill = s                    # replay anchor for the recorder pass
     ticks = 0
     t0 = time.perf_counter()
     while True:
@@ -88,6 +96,44 @@ def main() -> None:
         "ticks-to-new-leader, 1M agents, chunk=2",
         float(ticks),
         "ticks",
+        0.0,
+    )
+
+    # --- r10: exact recovery tick + churn from the flight recorder ---
+    # One telemetry rollout over the (known-sufficient) window from
+    # the kill state: the leader-change event carries the exact swarm
+    # tick, and the summary's change count is the churn gauge.
+    from distributed_swarm_algorithm_tpu.utils.telemetry import (
+        summarize_telemetry,
+        telemetry_events,
+    )
+
+    _, telem = dsa.swarm_rollout(
+        s_kill, None, cfg, ticks + CHUNK, telemetry=True
+    )
+    kill_tick = int(s_kill.tick)
+    change = next(
+        e for e in telemetry_events(telem)
+        if e["event"] == "leader-change"
+        and e["to"] >= 0 and e["to"] != lid0
+    )
+    exact = change["tick"] - kill_tick
+    churn = summarize_telemetry(telem)["leader_changes"]
+    print(
+        f"# recorder replay: leader-change at tick {change['tick']} "
+        f"(kill at {kill_tick}) -> {exact} ticks exact vs {ticks} "
+        f"chunk-resolution; {churn} change(s) in the window"
+    )
+    report(
+        "ticks-to-new-leader, 1M agents, telemetry-exact",
+        float(exact),
+        "ticks",
+        0.0,
+    )
+    report(
+        "leader-changes, 1M agents, recovery window",
+        float(churn),
+        "events",
         0.0,
     )
 

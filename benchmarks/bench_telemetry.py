@@ -1,0 +1,131 @@
+"""Flight-recorder overhead at the 65k station-keeping arena (r10).
+
+The r10 acceptance bar: a telemetry-enabled rollout (the in-scan
+``TickTelemetry`` ys — utils/telemetry.py) must cost <= 5% wall-clock
+over the identical telemetry-off rollout at 65k agents / 100 ticks.
+This bench measures exactly that, on the same settled station-keeping
+scenario as decompose_rebuild.py (hashgrid portable, skin-half-r
+Verlet carry — the amortized production regime, where a fixed per-tick
+collection cost is proportionally LARGEST, so the number reported here
+is the conservative bound).
+
+Fixed-name rows (cpu families; the script skips on other backends so
+tunnel rounds cannot corrupt them):
+
+  telemetry-overhead-pct ...   unit "pct"    — compare.py gates this
+      lower-is-better against the documented 5% absolute ceiling;
+  truncation-events ...        unit "events" — a clean scenario must
+      STAY clean (0 -> any positive count gates);
+  plan-rebuilds-per-100-ticks  unit "rounds" — the recorder-measured
+      rebuild rate (same series decompose_rebuild.py tracks per
+      regime, here from the summary reducer).
+
+Usage: python benchmarks/bench_telemetry.py
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from common import report, telemetry_rows, timeit_best
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu.utils.telemetry import (
+    summarize_telemetry,
+)
+
+N = 65_536
+HW = 256.0
+SETTLE = 48
+STEPS = 100
+TAG = "65536 agents 100 ticks station-keeping (cpu)"
+
+
+def _station_swarm():
+    s = dsa.make_swarm(N, seed=0, spread=250.0)
+    s = dsa.with_tasks(
+        s,
+        jnp.asarray([[1.0, 1.0], [-2.0, 3.0], [5.0, -8.0], [0.0, 9.0]]),
+    )
+    return s.replace(
+        target=jnp.asarray(s.pos),
+        has_target=jnp.ones_like(s.has_target),
+    )
+
+
+def _cfg() -> dsa.SwarmConfig:
+    # decompose_rebuild's skin-half-r regime: the amortized carry the
+    # production tick runs, per PERFORMANCE.md r9.
+    return dsa.SwarmConfig().replace(
+        separation_mode="hashgrid", sort_every=1,
+        formation_shape="none", world_hw=HW,
+        grid_max_per_cell=24, hashgrid_overflow_budget=1024,
+        hashgrid_backend="portable", max_speed=1.0,
+        hashgrid_skin=1.0, hashgrid_neighbor_cap=48,
+    )
+
+
+def _time(s, cfg, telemetry: bool):
+    """(best seconds, last rollout output) — the telemetry pass's
+    final output is reused for the summary rows, so the recorder
+    read costs no extra rollout."""
+    def run(st):
+        return dsa.swarm_rollout(
+            st, None, cfg, STEPS, telemetry=telemetry
+        )
+
+    holder = {"out": run(s)}
+    final = holder["out"][0] if telemetry else holder["out"]
+    jax.block_until_ready(final.pos)
+
+    def once():
+        holder["out"] = run(s)
+
+    def sync():
+        out = holder["out"]
+        st = out[0] if telemetry else out
+        return float(st.pos[0, 0])
+
+    return timeit_best(once, sync), holder["out"]
+
+
+def main() -> None:
+    backend = jax.default_backend()
+    if backend != "cpu":
+        # cpu-family fixed names (cross-round comparability); clean
+        # no-op on tunnel rounds, same contract as decompose_rebuild.
+        print(
+            f"# bench_telemetry: cpu-family rows; backend is "
+            f"{backend!r} — skipping"
+        )
+        return
+    cfg = _cfg()
+    s0 = _station_swarm()
+    s1 = dsa.swarm_rollout(s0, None, cfg.replace(hashgrid_skin=0.0),
+                           SETTLE)
+    jax.block_until_ready(s1.pos)
+
+    t_off, _ = _time(s1, cfg, telemetry=False)
+    t_on, (_, telem) = _time(s1, cfg, telemetry=True)
+    overhead = max(0.0, 100.0 * (t_on - t_off) / t_off)
+    summ = summarize_telemetry(telem)
+    print(
+        f"# telemetry overhead (N={N}, {STEPS} ticks, {backend}): "
+        f"off {t_off / STEPS * 1e3:.1f} ms/tick, on "
+        f"{t_on / STEPS * 1e3:.1f} ms/tick -> {overhead:.2f}% "
+        f"(bar <= 5%); recorder: rebuilds/100t "
+        f"{summ['rebuilds_per_100_ticks']:.0f}, truncation events "
+        f"{summ['truncation_events']}, first nonfinite "
+        f"{summ['first_nonfinite_step']}"
+    )
+    report(
+        "telemetry-overhead-pct, 65536 agents 100 ticks "
+        "station-keeping (cpu)",
+        overhead, "pct", 0.0,
+    )
+    telemetry_rows(summ, TAG)
+
+
+if __name__ == "__main__":
+    main()

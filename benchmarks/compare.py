@@ -16,10 +16,18 @@ embedded measurement floats (NSGA-II's "HV 0.875" etc.) to '#'.
 Metrics present in only one round are listed informationally and do
 not gate.  Exit code 1 iff at least one regression exceeds the
 threshold.  Recorded metrics are throughputs (higher is better) with
-two exceptions: units "findings" (the swarmlint hazard count from
-run_all's static gate) and "rounds" (auction convergence rounds, r8)
-are lower-is-better and gate on growth.  Records with value null
-(structured failure lines) are never merged into the history.
+these exceptions: units "findings" (the swarmlint hazard count from
+run_all's static gate), "rounds" (auction convergence / plan-rebuild
+rates, r8/r10), "events" (flight-recorder truncation / leader-churn
+counts, r10), and "ticks" (recovery latency, bench_recovery — a
+LATENCY, which the pre-r10 throughput branch silently gated
+backwards) are lower-is-better and gate on growth (a clean 0
+baseline regressing to any positive count always gates); unit "pct"
+(telemetry overhead, r10) is lower-is-better against an ABSOLUTE
+ceiling — any value above PCT_CEILING (5%) gates, regardless of the
+baseline (relative gating is meaningless near 0%).  Records with
+value null (structured failure lines) are never merged into the
+history.
 """
 
 from __future__ import annotations
@@ -32,6 +40,11 @@ import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 HISTORY_PATH = os.path.join(ROOT, "BENCH_HISTORY.json")
+
+#: Absolute ceiling for unit-"pct" metrics (telemetry overhead, r10):
+#: the documented acceptance bar — overhead above this gates even
+#: against a near-zero baseline.
+PCT_CEILING = 5.0
 
 
 def norm_key(metric: str) -> str:
@@ -133,11 +146,14 @@ def compare(prev_label: str, cur_label: str, threshold: float = 0.2,
     for key in sorted(set(prev) & set(cur)):
         pv = float(prev[key][1]["value"])
         cv = float(cur[key][1]["value"])
-        if str(cur[key][1].get("unit", "")) in ("findings", "rounds"):
+        unit = str(cur[key][1].get("unit", ""))
+        if unit in ("findings", "rounds", "events", "ticks"):
             # Lower-is-better count metrics (swarmlint hygiene debt;
-            # auction convergence rounds, r8): gate on growth, never
-            # on paydown.  A clean baseline (0) regressing to any
-            # positive count always gates.
+            # auction convergence rounds, r8; flight-recorder
+            # truncation/churn counts and recovery-latency ticks,
+            # r10): gate on growth, never on paydown.  A clean
+            # baseline (0) regressing to any positive count always
+            # gates.
             status = "ok"
             if cv > pv * (1.0 + threshold) or (pv == 0 and cv > 0):
                 status = "REGRESSION"
@@ -146,6 +162,21 @@ def compare(prev_label: str, cur_label: str, threshold: float = 0.2,
                 status = "improved"
             print(f"{status:>10}  {cv:6.0f}   {cur[key][0]}"
                   f"  (count {pv:.0f} -> {cv:.0f})")
+            continue
+        if unit == "pct":
+            # Lower-is-better against the ABSOLUTE ceiling (module
+            # doc): telemetry overhead lives near 0%, where relative
+            # growth gating is noise — the documented 5% bar is the
+            # contract.
+            status = "ok"
+            if cv > PCT_CEILING:
+                status = "REGRESSION"
+                regressions.append((key, pv, cv, cv / max(pv, 1.0)))
+            elif cv < pv:
+                status = "improved"
+            print(f"{status:>10}  {cv:6.1f}%  {cur[key][0]}"
+                  f"  ({pv:.2f}% -> {cv:.2f}%, ceiling "
+                  f"{PCT_CEILING:.0f}%)")
             continue
         if pv <= 0:
             continue

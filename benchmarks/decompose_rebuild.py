@@ -28,7 +28,10 @@ the same settled state:
 Each policy reports agent-steps/sec (fixed-name, cpu-tagged) and the
 skin rows also report the OBSERVED rebuild count per 100 ticks
 (unit "rounds" — lower-is-better in compare.py, so a semantics
-change that silently burns the amortization gates).  Skin tags ride
+change that silently burns the amortization gates).  Since r10 the
+rebuild rate comes from the flight recorder's per-tick series
+(utils/telemetry.py summary) instead of hand-dividing the final
+plan's counter — one reducer for benches, tests, and production.  Skin tags ride
 in the names as words (skin-half-r), never floats — norm_key folds
 float literals to '#' and the three families must not collide.
 
@@ -83,23 +86,35 @@ def _cfg(skin: float, cap: int, ncap: int, **kw) -> dsa.SwarmConfig:
 
 
 def _time_rollout(s, cfg, steps: int):
-    """(best seconds, final plan) for a jitted `steps`-tick rollout
-    from the settled state (warmed, scalar-synced, best-of-3)."""
+    """Best seconds for a jitted `steps`-tick rollout from the
+    settled state (warmed, scalar-synced, best-of-3)."""
     def run(st):
-        return dsa.swarm_rollout(
-            st, None, cfg, steps, return_plan=True
-        )
+        return dsa.swarm_rollout(st, None, cfg, steps)
 
     holder = {"out": run(s)}
-    jax.block_until_ready(holder["out"][0].pos)
+    jax.block_until_ready(holder["out"].pos)
 
     def once():
         holder["out"] = run(s)
 
-    best = timeit_best(
-        once, lambda: float(holder["out"][0].pos[0, 0])
+    return timeit_best(
+        once, lambda: float(holder["out"].pos[0, 0])
     )
-    return best, holder["out"][1]
+
+
+def _rebuild_rate(s, cfg, steps: int) -> float:
+    """Observed rebuilds per 100 ticks from the flight recorder (r10
+    — replaces the hand-rolled `100 * plan.rebuilds / steps` off the
+    returned carry: the recorder's stacked series is the same counter
+    read per tick, reduced by the one shared summary path every
+    consumer uses).  Untimed: runs outside the measured window, so
+    the throughput rows stay telemetry-free."""
+    from distributed_swarm_algorithm_tpu.utils.telemetry import (
+        summarize_telemetry,
+    )
+
+    _, telem = dsa.swarm_rollout(s, None, cfg, steps, telemetry=True)
+    return summarize_telemetry(telem)["rebuilds_per_100_ticks"]
 
 
 def main() -> None:
@@ -124,11 +139,11 @@ def main() -> None:
     s1 = dsa.swarm_rollout(s0, None, settle_cfg, SETTLE)
     jax.block_until_ready(s1.pos)
 
-    t0, _ = _time_rollout(s1, _cfg(0.0, 16, 0), STEPS)
-    t_half, p_half = _time_rollout(s1, _cfg(1.0, 24, 48), STEPS)
-    t_full, p_full = _time_rollout(s1, _cfg(2.0, 32, 64), STEPS)
-    r_half = 100.0 * int(p_half.rebuilds) / STEPS
-    r_full = 100.0 * int(p_full.rebuilds) / STEPS
+    t0 = _time_rollout(s1, _cfg(0.0, 16, 0), STEPS)
+    t_half = _time_rollout(s1, _cfg(1.0, 24, 48), STEPS)
+    t_full = _time_rollout(s1, _cfg(2.0, 32, 64), STEPS)
+    r_half = _rebuild_rate(s1, _cfg(1.0, 24, 48), STEPS)
+    r_full = _rebuild_rate(s1, _cfg(2.0, 32, 64), STEPS)
     print(
         f"# rebuild decomposition (N={N}, {STEPS} ticks, settled "
         f"{SETTLE}, {backend}) ms/tick: skin-0 "
@@ -169,11 +184,11 @@ def main() -> None:
 
     # --- field_deposit flag: scatter vs sorted on the shared plan ----
     field_kw = dict(k_align=0.3, k_coh=0.1)
-    t_scatter, _ = _time_rollout(
+    t_scatter = _time_rollout(
         s1, _cfg(0.0, 16, 0, field_deposit="scatter", **field_kw),
         FIELD_STEPS,
     )
-    t_sorted, _ = _time_rollout(
+    t_sorted = _time_rollout(
         s1, _cfg(0.0, 16, 0, field_deposit="sorted", **field_kw),
         FIELD_STEPS,
     )

@@ -68,6 +68,11 @@ BENCHES = [
     # scatter/sorted flag pair.  Cpu-family rows: the script refuses
     # to run on a non-cpu backend, so it never eats tunnel time.
     "decompose_rebuild.py",
+    # r10: flight-recorder overhead + recorder-derived truncation/
+    # rebuild rows at the 65k station arena — the telemetry-overhead
+    # ceiling (<= 5%, unit "pct") and the stay-clean truncation gate
+    # (unit "events") both ride the union gate from here.
+    "bench_telemetry.py",
 ]
 
 # Extra argv for benches whose no-arg default is not the gate set —
@@ -107,6 +112,7 @@ QUICK_SKIP = {
     "decompose_gridmean.py",
     "decompose_hashgrid_plan.py",
     "decompose_rebuild.py",
+    "bench_telemetry.py",
 }
 
 

@@ -15,7 +15,8 @@ Layers (mirrors SURVEY.md §1, rebuilt TPU-first):
   ops/       pure kernels: physics, coordination, allocation, PSO/DE/
              CMA-ES/boids, objectives, neighbor search
   parallel/  mesh/sharding/island-model multi-chip layer
-  utils/     config, checkpoint, metrics, profiling
+  utils/     config, checkpoint, metrics, profiling, telemetry
+             (the in-scan flight recorder, docs/OBSERVABILITY.md)
 """
 
 from .state import (
@@ -33,7 +34,20 @@ from .state import (
     make_swarm,
     with_tasks,
 )
-from .utils.config import DEFAULT_CONFIG, SwarmConfig
+from .utils.config import (
+    DEFAULT_CONFIG,
+    TELEMETRY_OFF,
+    TELEMETRY_ON,
+    SwarmConfig,
+    TelemetryConfig,
+)
+from .utils.telemetry import (
+    TelemetrySummary,
+    TickTelemetry,
+    summarize_telemetry,
+    telemetry_events,
+    write_events_jsonl,
+)
 from .models.swarm import VectorSwarm, swarm_rollout, swarm_tick
 from .models.pso import PSO
 from .models.memetic import MemeticPSO
@@ -81,6 +95,9 @@ __version__ = "0.1.0"
 
 __all__ = [
     "SwarmConfig", "DEFAULT_CONFIG", "SwarmState", "make_swarm", "with_tasks",
+    "TelemetryConfig", "TELEMETRY_ON", "TELEMETRY_OFF",
+    "TickTelemetry", "TelemetrySummary", "summarize_telemetry",
+    "telemetry_events", "write_events_jsonl",
     "VectorSwarm", "swarm_tick", "swarm_rollout", "PSO",
     "PSOState", "pso_init", "pso_step", "pso_run", "fused_pso_run",
     "MemeticPSO", "memetic_run", "refine_pbest", "gd_refine",

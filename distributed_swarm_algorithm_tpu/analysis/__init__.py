@@ -9,9 +9,11 @@ It parses (never imports) every .py file under the default scan set
 (the package, benchmarks/, examples/, bench.py) and checks the hazard
 classes that have actually bitten this repo on TPU: PRNG key reuse,
 host syncs and Python branches inside traced code, per-call re-jit,
-dtype drift in ops/ hot paths, the fused-kernel dispatch contract,
-and bench metric-name hygiene.  See docs/STATIC_ANALYSIS.md for the
-rule catalog, the suppression policy, and how to add a rule.
+per-iteration spatial-index rebuilds, ungated flight-recorder
+collection in scan bodies, dtype drift in ops/ hot paths, the
+fused-kernel dispatch contract, and bench metric-name hygiene.  See
+docs/STATIC_ANALYSIS.md for the rule catalog, the suppression
+policy, and how to add a rule.
 
 Importing this package registers the built-in rules (import order is
 display order).

@@ -15,6 +15,13 @@
   through ``refresh_plan`` pays the full bin+sort every iteration —
   the r8 structural floor the r9 Verlet carry exists to amortize;
   rollout bodies must carry a plan and ``refresh_plan`` it.
+- ``telemetry-gate``: flight-recorder collection
+  (``*tick_telemetry``) inside a scan body without the static
+  ``TelemetryConfig`` gate bloats EVERY rollout's graph with
+  collection ops and stacked ys, whether or not anyone reads them —
+  the r10 contract is that the disabled trace compiles to the
+  identical telemetry-free HLO, which only a trace-time Python ``if``
+  on the static gate can guarantee.
 """
 
 from __future__ import annotations
@@ -306,6 +313,99 @@ class PlanStalenessRule(Rule):
                     "— carry the plan and use `refresh_plan` (Verlet "
                     "skin reuse)",
                 )
+
+
+# ---------------------------------------------------------------------------
+# telemetry-gate
+
+#: Flight-recorder collector leaf names (utils/telemetry.py): the
+#: generic entry point plus its per-model conveniences.
+_TELEMETRY_COLLECTORS = frozenset(
+    {"tick_telemetry", "swarm_tick_telemetry", "boids_tick_telemetry"}
+)
+
+
+def _gated_by_telemetry_flag(mod: ModuleInfo, node, fn) -> bool:
+    """True when ``node`` sits under a Python ``if`` (within ``fn``)
+    whose test mentions the telemetry gate — a Name or Attribute
+    component literally named ``telemetry`` (``if telemetry:``,
+    ``if cfg.telemetry.enabled:``, ...).  A trace-time static branch
+    is the ONLY gate shape that keeps the disabled HLO identical,
+    which is why the rule looks for exactly this."""
+    for anc in mod.ancestors(node):
+        if anc is fn or isinstance(
+            anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            # Stop at the body function boundary: a gate OUTSIDE the
+            # scan body runs once at trace setup and cannot gate the
+            # per-iteration collection.
+            return False
+        if not isinstance(anc, ast.If):
+            continue
+        for sub in ast.walk(anc.test):
+            if isinstance(sub, ast.Name) and sub.id == "telemetry":
+                return True
+            if isinstance(sub, ast.Attribute) and sub.attr == "telemetry":
+                return True
+    return False
+
+
+@register
+class TelemetryGateRule(Rule):
+    id = "telemetry-gate"
+    summary = "ungated telemetry collection inside a scan body"
+    details = (
+        "`tick_telemetry` (or a `*_tick_telemetry` convenience) "
+        "called inside a lax.scan/fori_loop/while_loop body without a "
+        "static TelemetryConfig gate adds collection ops and stacked "
+        "ys to EVERY rollout, enabled or not.  Guard the call with a "
+        "trace-time Python `if` on the static gate (`if telemetry:` "
+        "/ `if cfg.telemetry.enabled:`) so the disabled trace "
+        "compiles to the identical telemetry-free HLO "
+        "(utils/telemetry.py, docs/OBSERVABILITY.md)."
+    )
+
+    def check(self, mod: ModuleInfo):
+        by_name: dict = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                by_name.setdefault(node.name, []).append(node)
+        bodies: set = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if mod.resolve(node.func) not in _LOOP_CALLS:
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Lambda):
+                    bodies.add(arg)
+                elif isinstance(arg, ast.Name):
+                    bodies.update(by_name.get(arg.id, []))
+        seen: set = set()
+        for fn in bodies:
+            stmts = fn.body if isinstance(fn.body, list) else [fn.body]
+            for st in stmts:
+                for node in ast.walk(st):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = mod.resolve(node.func)
+                    leaf = name.rsplit(".", 1)[-1] if name else ""
+                    if leaf not in _TELEMETRY_COLLECTORS:
+                        continue
+                    if _gated_by_telemetry_flag(mod, node, fn):
+                        continue
+                    site = (node.lineno, node.col_offset)
+                    if site in seen:
+                        continue
+                    seen.add(site)
+                    yield mod.finding(
+                        self.id, node,
+                        f"`{leaf}` inside a loop-transform body "
+                        "without the static TelemetryConfig gate — "
+                        "wrap it in `if telemetry:` / `if "
+                        "cfg.telemetry.enabled:` so the disabled "
+                        "rollout keeps its telemetry-free HLO",
+                    )
 
 
 # ---------------------------------------------------------------------------

@@ -101,6 +101,11 @@ class CpuSwarm:
         self.task_claimed = np.zeros((n_agents, 0), bool)
 
         self.obstacles: Optional[np.ndarray] = None
+        # Flight-recorder twin (r10): one TickTelemetry per tick when
+        # config.telemetry.enabled — the oracle's record uses the SAME
+        # pytree type as the JAX scan's stacked ys, so one summary
+        # reducer serves both (utils/telemetry.stack_telemetry).
+        self.telemetry: list = []
 
     # --- world injection --------------------------------------------------
     def set_target(self, target, agents=None) -> None:
@@ -165,6 +170,37 @@ class CpuSwarm:
         if not mask.any():
             return NO_LEADER, False
         return int(self.agent_id[mask].max()), True
+
+    # --- flight recorder (NumPy twin of utils/telemetry.py) ---------------
+    def _collect_telemetry(self, force: Optional[np.ndarray]) -> None:
+        """Append this tick's TickTelemetry (config.telemetry gate is
+        checked by the caller).  ``force`` is the pre-clamp APF force
+        — None on the native backend, whose C++ kernel integrates
+        in-place (force gauges then read 0, documented delta)."""
+        from ..utils.telemetry import tick_telemetry
+
+        mask = self.alive & (self.fsm == LEADER)
+        lid = int(self.agent_id[mask].max()) if mask.any() else NO_LEADER
+        electing = int((self.alive & (self.fsm == ELECTION_WAIT)).sum())
+        self.telemetry.append(
+            tick_telemetry(
+                self.pos.astype(np.float32),
+                self.vel.astype(np.float32),
+                self.alive, self.tick,
+                force=(
+                    None if force is None else force.astype(np.float32)
+                ),
+                leader_id=lid, electing=electing,
+            )
+        )
+
+    def stacked_telemetry(self):
+        """The rollout-shaped record: per-tick entries stacked into
+        one ``[T]``-leaved TickTelemetry (raises on an empty log,
+        mirroring utils/telemetry.stack_telemetry)."""
+        from ..utils.telemetry import stack_telemetry
+
+        return stack_telemetry(self.telemetry)
 
     # --- coordination (NumPy port of ops/coordination.py) ----------------
     def _coordination_step(self) -> None:
@@ -415,6 +451,8 @@ class CpuSwarm:
                 self.alive, self.obstacles,
                 cfg.replace(k_sep=0.0) if sep_off else cfg,
             )
+            if cfg.telemetry.enabled:
+                self._collect_telemetry(None)
             return
 
         eps = cfg.dist_eps
@@ -466,3 +504,5 @@ class CpuSwarm:
             moving[:, None], pos + vel * cfg.dt, pos
         )
         self.vel = vel
+        if cfg.telemetry.enabled:
+            self._collect_telemetry(force)

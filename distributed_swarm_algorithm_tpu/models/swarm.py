@@ -24,7 +24,12 @@ from ..ops.allocation import (
 )
 from ..ops.coordination import coordination_step, current_leader, kill, revive
 from ..ops.neighbors import morton_keys as _morton_keys
-from ..ops.physics import build_tick_plan, physics_step, physics_step_plan
+from ..ops.physics import (
+    build_tick_plan,
+    physics_step,
+    physics_step_plan,
+    physics_step_telem,
+)
 from ..state import (
     LEADER,
     SwarmState,
@@ -33,7 +38,7 @@ from ..state import (
     sort_agents_by_key,
     with_tasks,
 )
-from ..utils.config import DEFAULT_CONFIG, SwarmConfig
+from ..utils.config import DEFAULT_CONFIG, TELEMETRY_ON, SwarmConfig
 from ._checkpoint import CheckpointMixin
 
 _NO_OBSTACLES = None
@@ -118,13 +123,16 @@ def _protocol_steps(
     return state
 
 
-@partial(jax.jit, static_argnames=("cfg", "sort_in_tick"))
+@partial(
+    jax.jit, static_argnames=("cfg", "sort_in_tick", "telemetry")
+)
 def _swarm_tick_impl(
     state: SwarmState,
     obstacles: Optional[jax.Array],
     cfg: SwarmConfig,
     sort_in_tick: bool = True,
-) -> SwarmState:
+    telemetry: bool = False,
+):
     """One synchronous swarm tick (= one 10 Hz loop body for every agent).
 
     ``sort_in_tick=False`` drops the cadenced Morton re-sort ``lax.cond``
@@ -133,10 +141,16 @@ def _swarm_tick_impl(
     carrying the full swarm state costs ~26 ms/tick at 1M on v5e even
     when the branch never fires (measured r3 — XLA TPU conditionals
     materialize their whole carried tuple).
+
+    ``telemetry=True`` (r10, static) returns ``(state, telem)`` where
+    ``telem`` is the tick's flight-recorder record (None unless
+    ``cfg.telemetry.enabled`` — the rollout driver enables both
+    together).
     """
     state = _protocol_steps(state, cfg, sort_in_tick)
-    state = physics_step(state, obstacles, cfg)    # agent.py:94-181
-    return state
+    if telemetry:
+        return physics_step_telem(state, obstacles, cfg)
+    return physics_step(state, obstacles, cfg)     # agent.py:94-181
 
 
 def _swarm_tick_plan(
@@ -146,11 +160,13 @@ def _swarm_tick_plan(
     plan,
 ):
     """The plan-carrying tick (r9): same protocol prefix, physics off
-    the refreshed Verlet plan, plan handed back for the scan carry.
-    Plain (un-jitted) — it only runs inside the rollout scan."""
+    the refreshed Verlet plan, plan (and, gated on
+    ``cfg.telemetry.enabled``, the tick's telemetry record) handed
+    back for the scan.  Plain (un-jitted) — it only runs inside the
+    rollout scan."""
     state = _protocol_steps(state, cfg, sort_in_tick=False)
-    state, plan = physics_step_plan(state, obstacles, cfg, plan)
-    return state, plan
+    state, plan, telem = physics_step_plan(state, obstacles, cfg, plan)
+    return state, plan, telem
 
 
 def swarm_tick(
@@ -158,19 +174,24 @@ def swarm_tick(
     obstacles: Optional[jax.Array],
     cfg: SwarmConfig,
     sort_in_tick: bool = True,
-) -> SwarmState:
+    telemetry: bool = False,
+):
     """One synchronous swarm tick — ``_swarm_tick_impl`` behind the
     eager multi-device hash-grid guard (see
     ``_hashgrid_multidevice_cfg``; a no-op under trace and for
-    single-device swarms)."""
+    single-device swarms).  ``telemetry=True`` returns
+    ``(state, telem)`` — see ``_swarm_tick_impl``."""
     return _swarm_tick_impl(
         state, obstacles, _hashgrid_multidevice_cfg(state, cfg),
-        sort_in_tick,
+        sort_in_tick, telemetry,
     )
 
 
 @partial(
-    jax.jit, static_argnames=("cfg", "n_steps", "record", "return_plan")
+    jax.jit,
+    static_argnames=(
+        "cfg", "n_steps", "record", "return_plan", "telemetry",
+    ),
 )
 def _swarm_rollout_impl(
     state: SwarmState,
@@ -179,6 +200,7 @@ def _swarm_rollout_impl(
     n_steps: int,
     record: bool = False,
     return_plan: bool = False,
+    telemetry: bool = False,
 ) -> Union[SwarmState, Tuple[SwarmState, jax.Array]]:
     """``n_steps`` ticks under one ``lax.scan`` — the as-fast-as-possible
     mode; XLA fuses each tick into a handful of kernels.
@@ -199,7 +221,33 @@ def _swarm_rollout_impl(
     ``return_plan=True`` appends the final plan to the result — its
     ``rebuilds``/``age`` counters are the observed rebuild rate the
     benches report (``None`` outside the plan-carry regime).
+
+    Flight recorder (r10): with ``telemetry=True`` (or
+    ``cfg.telemetry.enabled``) each tick's fixed-shape
+    ``TickTelemetry`` rides the scan as stacked ``ys`` — on-device,
+    zero host syncs, and provably non-perturbing (the carried state
+    computation is untouched; tests/test_telemetry.py pins bitwise
+    trajectory equality).  The stacked record is appended to the
+    result AFTER the trajectory and BEFORE the plan:
+    ``state`` -> ``(state, telem)``; with ``record``,
+    ``(state, traj, telem)``; ``return_plan`` still appends last.
+    ``n_steps == 0`` yields ``telem = None``.
     """
+    telem_on = telemetry or cfg.telemetry.enabled
+    if telem_on and not cfg.telemetry.enabled:
+        cfg = cfg.replace(telemetry=TELEMETRY_ON)
+
+    def compose(state, traj, telem, plan):
+        out = (state, traj) if record else state
+        if telem_on:
+            if not n_steps:
+                # n_steps == 0 yields None on EVERY path: the scan
+                # paths would otherwise hand back a [0]-leaved record
+                # while the chunked path has nothing to concatenate.
+                telem = None
+            out = out + (telem,) if record else (out, telem)
+        return (out, plan) if return_plan else out
+
     plan_carried = (
         cfg.separation_mode == "hashgrid" and cfg.hashgrid_skin > 0
     )
@@ -208,14 +256,13 @@ def _swarm_rollout_impl(
 
         def pbody(carry, _):
             s, p = carry
-            s, p = _swarm_tick_plan(s, obstacles, cfg, p)
-            return (s, p), (s.pos if record else None)
+            s, p, telem = _swarm_tick_plan(s, obstacles, cfg, p)
+            return (s, p), ((s.pos if record else None), telem)
 
-        (state, plan), traj = jax.lax.scan(
+        (state, plan), (traj, telem) = jax.lax.scan(
             pbody, (state, plan), None, length=n_steps
         )
-        out = (state, traj) if record else state
-        return (out, plan) if return_plan else out
+        return compose(state, traj, telem, plan)
 
     permuting = cfg.separation_mode == "window" and cfg.sort_every > 1
 
@@ -223,7 +270,14 @@ def _swarm_rollout_impl(
         # The chunked path below owns the re-sort cadence, so the tick
         # runs cond-free (the conditional alone measured ~26 ms/tick
         # at 1M — see _swarm_tick_impl's docstring).
-        s = swarm_tick(s, obstacles, cfg, sort_in_tick=not permuting)
+        telem = None
+        if telem_on:
+            s, telem = swarm_tick(
+                s, obstacles, cfg, sort_in_tick=not permuting,
+                telemetry=True,
+            )
+        else:
+            s = swarm_tick(s, obstacles, cfg, sort_in_tick=not permuting)
         frame = None
         if record:
             # Unscramble to id order only when slots can actually move;
@@ -233,12 +287,13 @@ def _swarm_rollout_impl(
                 if permuting
                 else s.pos
             )
-        return s, frame
+        return s, (frame, telem)
 
     if not permuting:
-        state, traj = jax.lax.scan(body, state, None, length=n_steps)
-        out = (state, traj) if record else state
-        return (out, None) if return_plan else out
+        state, (traj, telem) = jax.lax.scan(
+            body, state, None, length=n_steps
+        )
+        return compose(state, traj, telem, None)
 
     # Window mode with a sort cadence: scan CHUNKS of sort_every ticks,
     # each chunk opening with one UNCONDITIONAL full-state variadic
@@ -257,29 +312,43 @@ def _swarm_rollout_impl(
 
     n_chunks, rem = divmod(n_steps, chunk)
     frames = []
+    telems = []
     if n_chunks:
         def chunk_body(s, _):
-            s, fr = sorted_chunk(s, chunk)
-            return s, fr
+            s, ys = sorted_chunk(s, chunk)
+            return s, ys
 
-        state, fr = jax.lax.scan(
+        state, (fr, tl) = jax.lax.scan(
             chunk_body, state, None, length=n_chunks
         )
         if record:
             frames.append(fr.reshape((n_chunks * chunk,) + fr.shape[2:]))
+        if telem_on:
+            # [n_chunks, chunk] leaves -> [n_chunks * chunk]
+            telems.append(jax.tree_util.tree_map(
+                lambda x: x.reshape((n_chunks * chunk,) + x.shape[2:]),
+                tl,
+            ))
     if rem:
-        state, fr = sorted_chunk(state, rem)
+        state, (fr, tl) = sorted_chunk(state, rem)
         if record:
             frames.append(fr)
+        if telem_on:
+            telems.append(tl)
     if record:
-        if not frames:                       # n_steps == 0
-            out = state, jnp.zeros((0,) + state.pos.shape,
-                                   state.pos.dtype)
-        else:
-            out = state, jnp.concatenate(frames, axis=0)
+        traj = (
+            jnp.concatenate(frames, axis=0)
+            if frames
+            else jnp.zeros((0,) + state.pos.shape, state.pos.dtype)
+        )
     else:
-        out = state
-    return (out, None) if return_plan else out
+        traj = None
+    telem = None
+    if telem_on and telems:
+        from ..utils.telemetry import concat_telemetry
+
+        telem = concat_telemetry(telems)
+    return compose(state, traj, telem, None)
 
 
 def swarm_rollout(
@@ -289,16 +358,21 @@ def swarm_rollout(
     n_steps: int,
     record: bool = False,
     return_plan: bool = False,
+    telemetry: bool = False,
 ) -> Union[SwarmState, Tuple[SwarmState, jax.Array]]:
     """``n_steps`` ticks under one ``lax.scan`` — ``_swarm_rollout_impl``
     behind the eager multi-device hash-grid guard (see
     ``_hashgrid_multidevice_cfg``; a no-op under trace and for
     single-device swarms).  ``return_plan``: also return the final
     carried Verlet plan (rebuild-rate observability; ``None`` unless
-    ``separation_mode='hashgrid'`` with ``hashgrid_skin > 0``)."""
+    ``separation_mode='hashgrid'`` with ``hashgrid_skin > 0``).
+    ``telemetry``: enable the in-scan flight recorder for this rollout
+    — the stacked per-tick ``TickTelemetry`` joins the result (see
+    ``_swarm_rollout_impl``; ``utils/telemetry.summarize_telemetry``
+    reduces it to a JSON-safe dict)."""
     return _swarm_rollout_impl(
         state, obstacles, _hashgrid_multidevice_cfg(state, cfg),
-        n_steps, record, return_plan,
+        n_steps, record, return_plan, telemetry,
     )
 
 

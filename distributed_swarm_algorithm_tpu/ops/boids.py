@@ -732,11 +732,17 @@ def boids_step(
     state: BoidsState,
     params: BoidsParams,
     obstacles: Optional[jax.Array] = None,
-) -> BoidsState:
-    """One flocking tick: Reynolds forces -> speed-clamped Euler -> wrap."""
-    return _integrate_tick(
-        state, boids_forces(state, params, obstacles), params
-    )
+    return_acc: bool = False,
+):
+    """One flocking tick: Reynolds forces -> speed-clamped Euler -> wrap.
+
+    ``return_acc=True`` (r10, all three step modes) also returns the
+    pre-integration steering acceleration — the flight recorder's
+    force-spike gauge (utils/telemetry.py) without recomputing the
+    rules."""
+    acc = boids_forces(state, params, obstacles)
+    state = _integrate_tick(state, acc, params)
+    return (state, acc) if return_acc else state
 
 
 def _morton_sort_boids(state: BoidsState, p: BoidsParams) -> BoidsState:
@@ -755,7 +761,8 @@ def boids_step_window(
     state: BoidsState,
     params: BoidsParams,
     obstacles: Optional[jax.Array] = None,
-) -> BoidsState:
+    return_acc: bool = False,
+):
     """One flocking tick in window mode: re-sort on cadence, roll-only
     Reynolds forces, speed-clamped Euler, toroidal wrap."""
     p = params
@@ -765,16 +772,17 @@ def boids_step_window(
         lambda s: s,
         state,
     )
-    return _integrate_tick(
-        state, boids_forces_window(state, params, obstacles), params
-    )
+    acc = boids_forces_window(state, params, obstacles)
+    state = _integrate_tick(state, acc, params)
+    return (state, acc) if return_acc else state
 
 
 def boids_step_gridmean(
     state: BoidsState,
     params: BoidsParams,
     obstacles: Optional[jax.Array] = None,
-) -> BoidsState:
+    return_acc: bool = False,
+):
     """One flocking tick with particle-in-cell alignment/cohesion.
 
     No Morton re-sort of the array: every gridmean rule is computed in
@@ -783,13 +791,16 @@ def boids_step_gridmean(
     This also means ``record=True`` trajectories are slot-stable here,
     unlike window mode.
     """
-    return _integrate_tick(
-        state, boids_forces_gridmean(state, params, obstacles), params
-    )
+    acc = boids_forces_gridmean(state, params, obstacles)
+    state = _integrate_tick(state, acc, params)
+    return (state, acc) if return_acc else state
 
 
 @partial(
-    jax.jit, static_argnames=("params", "n_steps", "record", "neighbor_mode")
+    jax.jit,
+    static_argnames=(
+        "params", "n_steps", "record", "neighbor_mode", "telemetry",
+    ),
 )
 def boids_run(
     state: BoidsState,
@@ -798,7 +809,8 @@ def boids_run(
     obstacles: Optional[jax.Array] = None,
     record: bool = False,
     neighbor_mode: str = "dense",
-) -> Tuple[BoidsState, Optional[jax.Array]]:
+    telemetry: bool = False,
+):
     """``n_steps`` ticks under one ``lax.scan``.
 
     ``neighbor_mode="dense"`` is the exact all-pairs pass;
@@ -807,6 +819,15 @@ def boids_run(
     ``[n_steps, N, D]`` (stacked by the scan — the framework's
     trajectory-capture hook; the reference could only log poses to
     stdout, agent.py:180-181).
+
+    ``telemetry=True`` (r10, static): the flight recorder rides the
+    scan — the return gains a trailing stacked
+    ``utils/telemetry.TickTelemetry`` element, ``(state, traj,
+    telem)``, carrying per-tick speed/steering gauges, the nonfinite
+    flag, and (on the gridmean skin path) the carried plan's
+    rebuild/truncation counters.  Off (the default), the trace is the
+    identical telemetry-free program and the return stays
+    ``(state, traj)``.
     """
     if neighbor_mode not in ("dense", "window", "gridmean"):
         raise ValueError(
@@ -840,12 +861,18 @@ def boids_run(
             )
             acc = boids_forces_gridmean(s, params, obstacles, plan=p)
             s = _integrate_tick(s, acc, params)
-            return (s, p), (s.pos if record else None)
+            telem = None
+            if telemetry:  # static TelemetryConfig-style gate
+                from ..utils.telemetry import boids_tick_telemetry
 
-        (state, _), traj = jax.lax.scan(
+                telem = boids_tick_telemetry(s, force=acc, plan=p)
+            return (s, p), ((s.pos if record else None), telem)
+
+        (state, _), (traj, telem) = jax.lax.scan(
             pbody, (state, plan), None, length=n_steps
         )
-        return state, (traj if record else None)
+        out = (state, traj if record else None)
+        return out + (telem,) if telemetry else out
 
     step = {
         "dense": boids_step,
@@ -854,11 +881,21 @@ def boids_run(
     }[neighbor_mode]
 
     def body(s, _):
-        s = step(s, params, obstacles)
-        return s, (s.pos if record else None)
+        telem = None
+        if telemetry:  # static TelemetryConfig-style gate
+            from ..utils.telemetry import boids_tick_telemetry
 
-    state, traj = jax.lax.scan(body, state, None, length=n_steps)
-    return state, (traj if record else None)
+            s, acc = step(s, params, obstacles, return_acc=True)
+            telem = boids_tick_telemetry(s, force=acc)
+        else:
+            s = step(s, params, obstacles)
+        return s, ((s.pos if record else None), telem)
+
+    state, (traj, telem) = jax.lax.scan(
+        body, state, None, length=n_steps
+    )
+    out = (state, traj if record else None)
+    return out + (telem,) if telemetry else out
 
 
 # ---------------------------------------------------------------------------

@@ -416,14 +416,19 @@ def cic_field_commensurate(
     if keys is None:
         g, *_ = commensurate_geometry(torus_hw, sep_cell, align_cell)
         keys = fine_cell_keys(pos, alive, torus_hw, g)
-    grid = moments_deposit(
-        pos, vel, alive, torus_hw, sep_cell, align_cell, keys=keys,
-        plan=plan, deposit=deposit,
-    )
-    return moments_sample(
-        grid, pos, vel, alive, torus_hw, sep_cell, align_cell,
-        keys=keys,
-    )
+    # XProf scope labels (r10, docs/OBSERVABILITY.md): the deposit is
+    # the field's scatter-class cost center, the sample its gather —
+    # named so an on-chip trace decomposes like decompose_gridmean.py.
+    with jax.named_scope("moments_deposit"):
+        grid = moments_deposit(
+            pos, vel, alive, torus_hw, sep_cell, align_cell, keys=keys,
+            plan=plan, deposit=deposit,
+        )
+    with jax.named_scope("moments_sample"):
+        return moments_sample(
+            grid, pos, vel, alive, torus_hw, sep_cell, align_cell,
+            keys=keys,
+        )
 
 
 def cic_field_corner_reference(

@@ -144,13 +144,23 @@ class HashgridPlan:
     rides as static aux — the validity contract every consumer
     budgets its coverage check against.  ``cand [g*g, W]`` is the
     per-cell stencil-union candidate table (module doc) with
-    ``cand_overflow`` counting entries truncated past ``W``."""
+    ``cand_overflow`` counting entries truncated past ``W``.
+
+    ``cap_overflow`` (r10): the number of LIVE agents whose in-cell
+    rank is past ``max_per_cell`` — the agents every consumer (slot
+    kernel, occupancy-windowed stencil, candidate table) silently
+    truncates under the r5/r9 cap contract.  Before r10 this count
+    existed nowhere: overcrowding degraded separation with no signal.
+    It is a build-time scalar on the plan so the flight recorder
+    (``utils/telemetry.py``) reads it for free; the kernel's rescue
+    pass budget (``hashgrid_overflow_budget``) is sized against
+    exactly this number."""
 
     ARRAY_FIELDS = (
         "cx", "cy", "key", "order", "skey", "rank", "ok", "sx", "sy",
         "counts", "starts", "fkey", "xt", "yt",
         "ref_pos", "ref_alive", "age", "rebuilds",
-        "cand", "cand_overflow",
+        "cand", "cand_overflow", "cap_overflow",
     )
     AUX_FIELDS = (
         "g", "cell_eff", "torus_hw", "max_per_cell",
@@ -161,7 +171,7 @@ class HashgridPlan:
                  cx, cy, key, order, skey, rank, ok, sx, sy,
                  counts=None, starts=None, fkey=None, xt=None, yt=None,
                  ref_pos=None, ref_alive=None, age=None, rebuilds=None,
-                 cand=None, cand_overflow=None,
+                 cand=None, cand_overflow=None, cap_overflow=None,
                  skin=0.0,
                  field_sep_cell=None, field_align_cell=None):
         self.g = g
@@ -191,6 +201,7 @@ class HashgridPlan:
         self.rebuilds = rebuilds
         self.cand = cand
         self.cand_overflow = cand_overflow
+        self.cap_overflow = cap_overflow
 
     @property
     def has_csr(self) -> bool:
@@ -237,6 +248,32 @@ class HashgridPlan:
 
 
 def build_hashgrid_plan(
+    pos: jax.Array,
+    alive: jax.Array,
+    torus_hw: float,
+    cell: float,
+    max_per_cell: int,
+    need_csr: bool = False,
+    field_sep_cell: Optional[float] = None,
+    field_align_cell: Optional[float] = None,
+    g: Optional[int] = None,
+    skin: float = 0.0,
+    neighbor_cap: int = 0,
+) -> HashgridPlan:
+    """:func:`_build_hashgrid_plan_impl` under the ``hashgrid_plan_
+    build`` named scope — the plan build is the tick's scatter-class
+    floor, so it gets its own label in XProf traces (the r10 scope
+    map, docs/OBSERVABILITY.md)."""
+    with jax.named_scope("hashgrid_plan_build"):
+        return _build_hashgrid_plan_impl(
+            pos, alive, torus_hw, cell, max_per_cell,
+            need_csr=need_csr, field_sep_cell=field_sep_cell,
+            field_align_cell=field_align_cell, g=g, skin=skin,
+            neighbor_cap=neighbor_cap,
+        )
+
+
+def _build_hashgrid_plan_impl(
     pos: jax.Array,
     alive: jax.Array,
     torus_hw: float,
@@ -321,6 +358,12 @@ def build_hashgrid_plan(
     )
     rank = iota - jax.lax.cummax(run_start)
     ok = (rank < max_per_cell) & (skey < g * g)
+    # Live agents past the per-cell cap: truncated from every
+    # consumer's pair set (the r5 cap contract) — surfaced as the
+    # plan-level counter the flight recorder reads (class doc).
+    cap_overflow = jnp.sum(
+        (skey < g * g) & (rank >= max_per_cell)
+    ).astype(jnp.int32)
 
     counts = starts = None
     if need_csr or neighbor_cap > 0:
@@ -378,6 +421,7 @@ def build_hashgrid_plan(
         age=jnp.zeros((), jnp.int32),
         rebuilds=jnp.zeros((), jnp.int32),
         cand=cand, cand_overflow=cand_overflow,
+        cap_overflow=cap_overflow,
     )
 
 

@@ -484,10 +484,15 @@ def separation_grid_plan(
             "stencil (and its union candidate table) to cover the "
             "separation radius across the Verlet reuse window"
         )
+    # Agents in cells past the per-cell cap are truncated from every
+    # gather below (the r5 cap contract) — the count is surfaced as
+    # ``plan.cap_overflow`` so the flight recorder (utils/telemetry.py)
+    # sees what this sweep silently drops.
     if plan.has_list:
-        return _separation_list_plan(
-            pos, alive, k_sep, personal_space, eps, plan
-        )
+        with jax.named_scope("separation_union_sweep"):
+            return _separation_list_plan(
+                pos, alive, k_sep, personal_space, eps, plan
+            )
     if plan.counts is None:
         raise ValueError(
             "separation_grid_plan needs a plan built with "

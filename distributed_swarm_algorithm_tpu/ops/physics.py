@@ -325,6 +325,20 @@ def apf_forces(
     ``None`` and ``separation_mode='hashgrid'``, the tick builds its
     own plan via :func:`build_tick_plan` — exact per-tick behavior
     regardless of ``hashgrid_skin``."""
+    return apf_forces_plan(state, obstacles, cfg, plan)[0]
+
+
+def apf_forces_plan(
+    state: SwarmState,
+    obstacles: Optional[jax.Array],
+    cfg: SwarmConfig,
+    plan=None,
+):
+    """(force [N, D], plan-or-None): :func:`apf_forces` that also
+    hands back the hashgrid plan the tick dispatched on (the one it
+    was passed, or the one it built) — the flight recorder
+    (utils/telemetry.py) reads the plan's truncation/rebuild counters
+    off it, so a per-tick-built plan is observable too."""
     pos = state.pos
     eps = jnp.asarray(cfg.dist_eps, pos.dtype)
 
@@ -358,6 +372,61 @@ def apf_forces(
     #    geometry is commensurate — the moments field in section 4;
     #    field_keys carries the shared fine-grid binning out of the
     #    branch.
+    f_sep, field_keys, plan = _separation_dispatch(state, cfg, plan)
+
+    # 4. Velocity-alignment / cohesion field (r6, beyond-parity):
+    #    neighborhood mean-velocity matching and centroid attraction
+    #    from the commensurate moments-deposit CIC field — one
+    #    16-channel cell reduction + dense block algebra instead of
+    #    per-agent corner scatters (ops/grid_moments.py).  Dead
+    #    agents neither deposit nor feel the field.
+    if tick_field_enabled(cfg):
+        if pos.shape[1] != 2:
+            raise ValueError(
+                "k_align/k_coh field forces are 2-D only (the field "
+                f"tiles a 2-D torus); got dim={pos.shape[1]}"
+            )
+        from .grid_moments import align_cell_arg, cic_field_commensurate
+
+        if cfg.field_deposit == "sorted" and field_keys is None:
+            raise ValueError(
+                "field_deposit='sorted' runs the deposit off the "
+                "shared plan's existing cell sort (plan_cell_sums), "
+                "so it needs the plan to carry the field keys: "
+                "separation_mode='hashgrid' with a commensurate "
+                "geometry and hashgrid_skin == 0 (a stale sort "
+                "cannot deposit).  Use field_deposit='scatter' here."
+            )
+        with jax.named_scope("moments_field"):
+            align, coh = cic_field_commensurate(
+                pos, state.vel, state.alive,
+                torus_hw=float(cfg.world_hw),
+                sep_cell=float(cfg.grid_cell),
+                align_cell=align_cell_arg(cfg.align_cell),
+                keys=field_keys,
+                plan=plan if cfg.field_deposit == "sorted" else None,
+                deposit=cfg.field_deposit,
+            )
+        f_field = cfg.k_align * align + cfg.k_coh * coh
+    else:
+        f_field = jnp.zeros_like(pos)
+
+    return f_att + f_rep + f_sep + f_field, plan
+
+
+def _separation_dispatch(state: SwarmState, cfg: SwarmConfig, plan):
+    """(f_sep, field_keys, plan): the separation-mode dispatch of
+    :func:`apf_forces` — section 3 of the tick, extracted so the
+    whole backend chain runs under ONE ``separation_dispatch`` named
+    scope (the r10 XProf scope map, docs/OBSERVABILITY.md) and the
+    possibly-built plan flows back to the caller for telemetry."""
+    with jax.named_scope("separation_dispatch"):
+        return _separation_dispatch_impl(state, cfg, plan)
+
+
+def _separation_dispatch_impl(state, cfg, plan):
+    pos = state.pos
+    eps = jnp.asarray(cfg.dist_eps, pos.dtype)
     field_keys = None
     if cfg.separation_mode == "dense":
         f_sep = _neighbors.separation_dense(
@@ -460,44 +529,7 @@ def apf_forces(
             "expected 'dense', 'pallas', 'grid', 'window', "
             "'hashgrid', or 'off'"
         )
-
-    # 4. Velocity-alignment / cohesion field (r6, beyond-parity):
-    #    neighborhood mean-velocity matching and centroid attraction
-    #    from the commensurate moments-deposit CIC field — one
-    #    16-channel cell reduction + dense block algebra instead of
-    #    per-agent corner scatters (ops/grid_moments.py).  Dead
-    #    agents neither deposit nor feel the field.
-    if tick_field_enabled(cfg):
-        if pos.shape[1] != 2:
-            raise ValueError(
-                "k_align/k_coh field forces are 2-D only (the field "
-                f"tiles a 2-D torus); got dim={pos.shape[1]}"
-            )
-        from .grid_moments import align_cell_arg, cic_field_commensurate
-
-        if cfg.field_deposit == "sorted" and field_keys is None:
-            raise ValueError(
-                "field_deposit='sorted' runs the deposit off the "
-                "shared plan's existing cell sort (plan_cell_sums), "
-                "so it needs the plan to carry the field keys: "
-                "separation_mode='hashgrid' with a commensurate "
-                "geometry and hashgrid_skin == 0 (a stale sort "
-                "cannot deposit).  Use field_deposit='scatter' here."
-            )
-        align, coh = cic_field_commensurate(
-            pos, state.vel, state.alive,
-            torus_hw=float(cfg.world_hw),
-            sep_cell=float(cfg.grid_cell),
-            align_cell=align_cell_arg(cfg.align_cell),
-            keys=field_keys,
-            plan=plan if cfg.field_deposit == "sorted" else None,
-            deposit=cfg.field_deposit,
-        )
-        f_field = cfg.k_align * align + cfg.k_coh * coh
-    else:
-        f_field = jnp.zeros_like(pos)
-
-    return f_att + f_rep + f_sep + f_field
+    return f_sep, field_keys, plan
 
 
 def integrate(
@@ -534,13 +566,27 @@ def physics_step(
     return _physics_step_core(state, obstacles, cfg, None, dt)[0]
 
 
+def physics_step_telem(
+    state: SwarmState,
+    obstacles: Optional[jax.Array],
+    cfg: SwarmConfig,
+    dt: Optional[float] = None,
+):
+    """(state, telemetry): :func:`physics_step` that also returns the
+    tick's :class:`~..utils.telemetry.TickTelemetry` record — or
+    ``None`` unless ``cfg.telemetry.enabled`` (the static gate; the
+    disabled trace is identical to :func:`physics_step`)."""
+    out, _, telem = _physics_step_core(state, obstacles, cfg, None, dt)
+    return out, telem
+
+
 def physics_step_plan(
     state: SwarmState,
     obstacles: Optional[jax.Array],
     cfg: SwarmConfig,
     plan,
     dt: Optional[float] = None,
-) -> Tuple[SwarmState, object]:
+):
     """One motion tick with a CARRIED hashgrid plan (r9): refresh the
     Verlet plan against the tick's current positions/alive set
     (``hashgrid_plan.refresh_plan`` — a rebuild only when some agent
@@ -549,7 +595,12 @@ def physics_step_plan(
     :func:`physics_step` off it, and hand the plan back for the next
     iteration.  This is the protocol tick the ``lax.scan`` rollout
     drivers carry (``models/swarm.py``); seed the carry with
-    :func:`build_tick_plan`."""
+    :func:`build_tick_plan`.
+
+    Returns ``(state, plan, telemetry)`` (r10): ``telemetry`` is the
+    tick's :class:`~..utils.telemetry.TickTelemetry` when
+    ``cfg.telemetry.enabled``, else ``None`` — the same static gate
+    as :func:`physics_step_telem`."""
     return _physics_step_core(state, obstacles, cfg, plan, dt)
 
 
@@ -559,10 +610,17 @@ def _physics_step_core(
     cfg: SwarmConfig,
     plan,
     dt: Optional[float],
-) -> Tuple[SwarmState, object]:
-    """The one tick body behind both :func:`physics_step` and
-    :func:`physics_step_plan` — shared so the plan-carried and eager
-    ticks cannot drift."""
+):
+    """The one tick body behind :func:`physics_step`,
+    :func:`physics_step_telem`, and :func:`physics_step_plan` —
+    shared so the plan-carried and eager ticks cannot drift.  Returns
+    ``(state, plan, telemetry)``.
+
+    Telemetry (r10) is collected AFTER the state update, off values
+    the tick computed anyway (post-step pos/vel, the pre-clamp force,
+    the dispatched plan) — read-only, so the trajectory is bitwise
+    independent of the gate (tests/test_telemetry.py pins this with
+    ``utils/replay.fingerprint``)."""
     dt = cfg.dt if dt is None else dt
     if plan is not None:
         from .hashgrid_plan import refresh_plan
@@ -575,10 +633,17 @@ def _physics_step_core(
             rebuild_every=cfg.hashgrid_rebuild_every,
         )
     derived = formation_targets(state, cfg)
-    force = apf_forces(derived, obstacles, cfg, plan=plan)
+    force, tick_plan = apf_forces_plan(derived, obstacles, cfg, plan=plan)
     # Reference semantics: no target => early return, nothing moves
     # (agent.py:113-114).  Dead agents are frozen too (masked update).
     moving = derived.has_target & state.alive
-    pos, vel = integrate(state.pos, force, moving, cfg, dt)
-    pos = jnp.where(moving[:, None], pos, state.pos)
-    return state.replace(pos=pos, vel=vel), plan
+    with jax.named_scope("integrate"):
+        pos, vel = integrate(state.pos, force, moving, cfg, dt)
+        pos = jnp.where(moving[:, None], pos, state.pos)
+    out = state.replace(pos=pos, vel=vel)
+    telem = None
+    if cfg.telemetry.enabled:
+        from ..utils.telemetry import swarm_tick_telemetry
+
+        telem = swarm_tick_telemetry(out, force, plan=tick_plan)
+    return out, plan, telem

@@ -29,6 +29,35 @@ from dataclasses import dataclass
 
 
 @dataclass(frozen=True)
+class TelemetryConfig:
+    """Static gate for the in-scan flight recorder (r10,
+    utils/telemetry.py).
+
+    Frozen + hashable, so it rides inside ``SwarmConfig`` as part of
+    the jit-static config: the gate is resolved at TRACE time, which
+    is what makes the disabled path compile to the identical HLO the
+    telemetry-free tick always had (no masked-out collection ops, no
+    dead ``ys`` — the Python ``if`` never emits them).  Enabled, the
+    tick computes one fixed-shape :class:`~..utils.telemetry.
+    TickTelemetry` of scalar counters/gauges per step, which the
+    rollout drivers stack as ``lax.scan`` ys — telemetry stays on
+    device for the whole rollout (no host syncs), and the carried
+    state computation is untouched, so the trajectory is bitwise
+    identical either way (pinned by tests/test_telemetry.py via
+    ``utils/replay.fingerprint``).
+    """
+
+    enabled: bool = False
+
+    def replace(self, **kw) -> "TelemetryConfig":
+        return dataclasses.replace(self, **kw)
+
+
+TELEMETRY_OFF = TelemetryConfig()
+TELEMETRY_ON = TelemetryConfig(enabled=True)
+
+
+@dataclass(frozen=True)
 class SwarmConfig:
     """All swarm tunables.  Frozen → hashable → usable as a jit-static arg.
 
@@ -185,6 +214,15 @@ class SwarmConfig:
     #   TPU re-measure this flag exists to run without code changes).
     #   "sorted" requires the shared plan: separation_mode='hashgrid',
     #   commensurate field geometry, hashgrid_skin == 0.
+    telemetry: TelemetryConfig = TELEMETRY_OFF
+    #   In-scan flight recorder (r10, utils/telemetry.py +
+    #   docs/OBSERVABILITY.md).  Static: flipping it retraces; the
+    #   disabled trace is the identical telemetry-free HLO.  Enabled,
+    #   physics_step/physics_step_plan emit a per-tick TickTelemetry
+    #   the rollout drivers stack as scan ys (swarm_rollout(...,
+    #   telemetry=True) enables it for one rollout without touching
+    #   the config).  Collection is provably non-perturbing: the
+    #   telemetry-on trajectory is bitwise-equal to telemetry-off.
     window_size: int = 16               # ± sorted-order span for "window"
     sort_every: int = 1                 # "window" re-sort cadence in ticks.
     #   1 (default): sort+gather+scatter inside the separation pass every

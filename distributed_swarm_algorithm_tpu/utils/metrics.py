@@ -32,7 +32,14 @@ class StepTimer:
         self._t0 = time.perf_counter()
 
     def stop(self) -> float:
-        assert self._t0 is not None, "start() not called"
+        # A real exception, not a bare assert: the misuse must
+        # surface under `python -O` too (r10 satellite).
+        if self._t0 is None:
+            raise RuntimeError(
+                "StepTimer.stop() called without a matching start() "
+                "— use start()/stop() pairs or the measure(...) "
+                "context manager"
+            )
         elapsed = time.perf_counter() - self._t0
         steps, agents = self._pending
         self.total_steps += steps

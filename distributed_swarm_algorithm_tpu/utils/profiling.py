@@ -7,6 +7,13 @@ Absent in the reference (SURVEY.md §5).  Usage:
 
 then load the trace directory in TensorBoard/XProf; or use
 ``annotate("phase")`` inside host loops to label regions.
+
+Since r10 the tick's hot-op boundaries carry ``jax.named_scope``
+labels (plan build, separation dispatch, moments deposit/sample,
+integration — the scope map is in docs/OBSERVABILITY.md), so traces
+captured here decompose into the same stages the benchmarks time;
+pair with the in-scan flight recorder (utils/telemetry.py) for
+per-tick counters alongside the profile.
 """
 
 from __future__ import annotations

@@ -1,0 +1,416 @@
+"""On-device flight recorder: in-scan telemetry for the swarm tick.
+
+The reference agent's only observability is a pose line printed every
+10th tick (SURVEY.md §5: tracing/profiling absent), and the vectorized
+rollouts run as opaque jitted ``lax.scan`` loops — the quantities worth
+watching (Verlet-plan rebuild rate, hash-cell cap truncation, leader
+churn, force spikes, NaN onset) are computed on device every tick and
+thrown away.  JaxMARL and ABMax (PAPERS.md) both settle on the same
+JAX-native pattern this module implements: carry a FIXED-SHAPE pytree
+of per-step scalar diagnostics through the scan as stacked ``ys``, so
+telemetry
+
+  - costs zero host syncs (everything stays on device until the
+    rollout returns),
+  - composes with jit/pjit/scan (fixed shapes, no data-dependent
+    control flow), and
+  - is statically gated (``utils/config.TelemetryConfig``): the
+    disabled trace compiles to the identical HLO, and the enabled
+    trace only READS values the tick already computed — the carried
+    trajectory is bitwise-equal either way (the non-perturbation
+    contract, pinned by tests/test_telemetry.py via
+    ``utils/replay.fingerprint``).
+
+Three layers:
+
+- :class:`TickTelemetry` — the on-device record: one scalar per
+  counter/gauge, collected by ``ops/physics._physics_step_core`` (the
+  protocol tick), ``ops/boids.boids_run`` (the flocking twin), and the
+  NumPy oracle (``models/cpu_swarm.CpuSwarm``).  Stacked by the
+  rollout scan into ``[n_steps]``-shaped leaves.
+- :class:`TelemetrySummary` — the host-side reducer: stacked ticks ->
+  a JSON-safe dict of rates, maxima, and the first-nonfinite step
+  (``benchmarks/common.telemetry_rows`` turns it into fixed-name
+  gated metrics).
+- :func:`telemetry_events` / :func:`write_events_jsonl` — the
+  threshold-crossing event log: leader changes, plan rebuilds,
+  truncation onsets, NaN onset, one JSON object per line.
+
+The ``jax.named_scope`` annotations on the tick's hot-op boundaries
+(plan build, separation dispatch, moments deposit, integration — the
+scope map lives in docs/OBSERVABILITY.md) are the profiling half of
+the story: they label XProf traces from ``utils/profiling.trace`` so
+an on-chip trace decomposes into the same stages the benchmarks time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass
+from typing import IO, Iterable, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+#: Sentinel for "no leader known" — mirrors state.NO_LEADER without
+#: importing the state module (utils must stay import-light).
+NO_LEADER = -1
+
+
+@struct.dataclass
+class TickTelemetry:
+    """One tick's counters and gauges — every leaf a scalar, so a
+    rollout's stacked record is ``[n_steps]`` per field.
+
+    Fields without a source in a given mode hold their neutral value
+    (boids: ``leader_id = -1``, ``electing = 0``; plan-less ticks:
+    zero plan counters) so one record type serves the protocol tick,
+    the flocking twin, and the CPU oracle.
+    """
+
+    tick: jax.Array          # i32 — the tick this record describes
+    alive: jax.Array         # i32 — live-agent count
+    leader_id: jax.Array     # i32 — swarm-wide leader, NO_LEADER if none
+    electing: jax.Array      # i32 — alive agents in ELECTION_WAIT
+    speed_max: jax.Array     # f32 — max ||vel|| over alive agents
+    speed_mean: jax.Array    # f32 — mean ||vel|| over alive agents
+    force_max: jax.Array     # f32 — max pre-clamp ||force|| over alive
+    force_mean: jax.Array    # f32 — mean pre-clamp ||force|| over alive
+    nonfinite: jax.Array     # bool — any non-finite in pos/vel/force
+    plan_age: jax.Array      # i32 — carried Verlet plan age (0 = fresh)
+    plan_rebuilds: jax.Array  # i32 — cumulative rebuilds this rollout
+    cap_overflow: jax.Array  # i32 — live agents past the per-cell cap
+    cand_overflow: jax.Array  # i32 — candidate-table entries past W
+
+
+def _masked_norm_stats(vec: jax.Array, mask: jax.Array, count):
+    """(max, mean) of row norms of ``vec`` over ``mask`` rows —
+    fixed-shape (masked, not compacted) so it scans."""
+    norm = jnp.linalg.norm(vec, axis=-1)
+    norm = jnp.where(mask, norm, 0.0)
+    mx = jnp.max(norm)
+    mean = jnp.sum(norm) / jnp.maximum(count, 1).astype(norm.dtype)
+    return mx.astype(jnp.float32), mean.astype(jnp.float32)
+
+
+def tick_telemetry(
+    pos: jax.Array,
+    vel: jax.Array,
+    alive: jax.Array,
+    tick,
+    force: Optional[jax.Array] = None,
+    leader_id=None,
+    electing=None,
+    plan=None,
+) -> TickTelemetry:
+    """Collect one :class:`TickTelemetry` from a tick's arrays.
+
+    Pure read-only: every input is a value the tick computed anyway,
+    so collection cannot perturb the trajectory.  ``force`` is the
+    PRE-CLAMP force/steering vector (the spike detector — the clamped
+    velocity hides exactly the spikes worth recording); ``plan`` an
+    optional carried :class:`~..ops.hashgrid_plan.HashgridPlan`.
+
+    MUST be called behind the static ``TelemetryConfig`` gate when
+    used inside a scan body (the ``telemetry-gate`` swarmlint rule
+    enforces this) — an ungated call would bloat every rollout's HLO
+    whether or not anyone reads the record.
+    """
+    alive = alive.astype(bool)
+    n_alive = jnp.sum(alive).astype(jnp.int32)
+    speed_max, speed_mean = _masked_norm_stats(vel, alive, n_alive)
+    finite = jnp.all(jnp.isfinite(pos)) & jnp.all(jnp.isfinite(vel))
+    if force is not None:
+        force_max, force_mean = _masked_norm_stats(
+            force, alive, n_alive
+        )
+        finite = finite & jnp.all(jnp.isfinite(force))
+    else:
+        force_max = force_mean = jnp.asarray(0.0, jnp.float32)
+    zero = jnp.asarray(0, jnp.int32)
+    if plan is not None:
+        plan_age = plan.age.astype(jnp.int32)
+        plan_rebuilds = plan.rebuilds.astype(jnp.int32)
+        cap_overflow = (
+            plan.cap_overflow.astype(jnp.int32)
+            if plan.cap_overflow is not None
+            else zero
+        )
+        cand_overflow = (
+            plan.cand_overflow.astype(jnp.int32)
+            if plan.cand_overflow is not None
+            else zero
+        )
+    else:
+        plan_age = plan_rebuilds = cap_overflow = cand_overflow = zero
+    return TickTelemetry(
+        tick=jnp.asarray(tick, jnp.int32),
+        alive=n_alive,
+        leader_id=(
+            jnp.asarray(NO_LEADER, jnp.int32)
+            if leader_id is None
+            else jnp.asarray(leader_id, jnp.int32)
+        ),
+        electing=(
+            zero if electing is None else jnp.asarray(electing, jnp.int32)
+        ),
+        speed_max=speed_max,
+        speed_mean=speed_mean,
+        force_max=force_max,
+        force_mean=force_mean,
+        nonfinite=~finite,
+        plan_age=plan_age,
+        plan_rebuilds=plan_rebuilds,
+        cap_overflow=cap_overflow,
+        cand_overflow=cand_overflow,
+    )
+
+
+def swarm_tick_telemetry(state, force, plan=None) -> TickTelemetry:
+    """Protocol-tick collector: :func:`tick_telemetry` off a
+    ``SwarmState`` plus the tick's pre-clamp APF force.  Leader id is
+    the swarm-wide ground truth (the ``ops/coordination.
+    current_leader`` reduction); ``electing`` counts alive agents
+    sitting in ELECTION_WAIT — together the leader-churn /
+    election-round signal the recovery bench reads."""
+    # Local constants, not an ops import (utils stays a leaf layer);
+    # pinned to state.py's FSM codes by tests/test_telemetry.py.
+    LEADER = 3
+    ELECTION_WAIT = 2
+    mask = state.alive & (state.fsm == LEADER)
+    lid = jnp.max(jnp.where(mask, state.agent_id, NO_LEADER))
+    electing = jnp.sum(state.alive & (state.fsm == ELECTION_WAIT))
+    return tick_telemetry(
+        state.pos, state.vel, state.alive, state.tick,
+        force=force, leader_id=lid, electing=electing, plan=plan,
+    )
+
+
+def boids_tick_telemetry(state, force=None, plan=None) -> TickTelemetry:
+    """Flocking-twin collector: every boid alive, no protocol."""
+    n = state.pos.shape[0]
+    return tick_telemetry(
+        state.pos, state.vel, jnp.ones((n,), bool), state.iteration,
+        force=force, plan=plan,
+    )
+
+
+def stack_telemetry(ticks: Iterable[TickTelemetry]) -> TickTelemetry:
+    """Stack per-tick records into one ``[T]``-leaved record — the
+    host-side twin of the scan's ys stacking (the CPU oracle and the
+    chunked rollout paths use it)."""
+    ticks = list(ticks)
+    if not ticks:
+        raise ValueError("stack_telemetry needs at least one tick")
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *ticks
+    )
+
+
+def concat_telemetry(parts: Iterable[TickTelemetry]) -> TickTelemetry:
+    """Concatenate already-stacked ``[T_i]`` records along the tick
+    axis (the chunked window-mode rollout produces one part per
+    chunk)."""
+    parts = list(parts)
+    if len(parts) == 1:
+        return parts[0]
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.concatenate(xs, axis=0), *parts
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host-side reduction
+
+
+def _np(x):
+    import numpy as np
+
+    return np.asarray(x)
+
+
+@dataclass(frozen=True)
+class TelemetrySummary:
+    """JSON-safe reduction of a stacked :class:`TickTelemetry`.
+
+    Every field is a plain Python scalar (``to_dict`` round-trips
+    through ``json`` unchanged).  ``first_nonfinite_step`` is an index
+    into the stacked record (-1 = the whole rollout stayed finite);
+    ``leader_changes`` counts transitions in the leader series
+    INCLUDING the initial acquisition from NO_LEADER;
+    ``truncation_events`` counts ticks where either hash-grid
+    truncation counter was nonzero (the silent-clipping signal the r9
+    inflated-cap contract made invisible)."""
+
+    ticks: int
+    alive_final: int
+    alive_min: int
+    leader_final: int
+    leader_changes: int
+    leaderless_ticks: int
+    election_ticks: int
+    speed_max: float
+    speed_mean: float
+    force_max: float
+    force_mean: float
+    first_nonfinite_step: int
+    plan_rebuilds: int
+    rebuilds_per_100_ticks: float
+    plan_age_max: int
+    truncation_events: int
+    cap_overflow_max: int
+    cand_overflow_max: int
+
+    @classmethod
+    def from_ticks(cls, t: TickTelemetry) -> "TelemetrySummary":
+        import numpy as np
+
+        tick = _np(t.tick)
+        if tick.ndim == 0:
+            t = jax.tree_util.tree_map(lambda x: _np(x)[None], t)
+            tick = _np(t.tick)
+        n = int(tick.shape[0])
+        if n == 0:                      # zero-length rollout record
+            return cls(
+                ticks=0, alive_final=0, alive_min=0,
+                leader_final=NO_LEADER, leader_changes=0,
+                leaderless_ticks=0, election_ticks=0,
+                speed_max=0.0, speed_mean=0.0,
+                force_max=0.0, force_mean=0.0,
+                first_nonfinite_step=-1, plan_rebuilds=0,
+                rebuilds_per_100_ticks=0.0, plan_age_max=0,
+                truncation_events=0, cap_overflow_max=0,
+                cand_overflow_max=0,
+            )
+        alive = _np(t.alive)
+        leader = _np(t.leader_id)
+        electing = _np(t.electing)
+        nonfinite = _np(t.nonfinite)
+        rebuilds = _np(t.plan_rebuilds)
+        cap = _np(t.cap_overflow)
+        cand = _np(t.cand_overflow)
+        prev = np.concatenate([[NO_LEADER], leader[:-1]])
+        bad = np.flatnonzero(nonfinite)
+        total_rebuilds = int(rebuilds[-1]) if n else 0
+        return cls(
+            ticks=n,
+            alive_final=int(alive[-1]),
+            alive_min=int(alive.min()),
+            leader_final=int(leader[-1]),
+            leader_changes=int(np.sum(leader != prev)),
+            leaderless_ticks=int(np.sum(leader == NO_LEADER)),
+            election_ticks=int(np.sum(electing > 0)),
+            speed_max=float(_np(t.speed_max).max()),
+            speed_mean=float(_np(t.speed_mean).mean()),
+            force_max=float(_np(t.force_max).max()),
+            force_mean=float(_np(t.force_mean).mean()),
+            first_nonfinite_step=int(bad[0]) if bad.size else -1,
+            plan_rebuilds=total_rebuilds,
+            rebuilds_per_100_ticks=(
+                100.0 * total_rebuilds / n if n else 0.0
+            ),
+            plan_age_max=int(_np(t.plan_age).max()),
+            truncation_events=int(np.sum((cap > 0) | (cand > 0))),
+            cap_overflow_max=int(cap.max()),
+            cand_overflow_max=int(cand.max()),
+        )
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def summarize_telemetry(t: TickTelemetry) -> dict:
+    """One-call form: stacked ticks -> the JSON-safe summary dict."""
+    return TelemetrySummary.from_ticks(t).to_dict()
+
+
+# ---------------------------------------------------------------------------
+# Threshold-crossing event log (JSONL)
+
+
+def telemetry_events(t: TickTelemetry) -> List[dict]:
+    """Flatten a stacked record into threshold-crossing events, in
+    tick order: ``leader-change`` (every transition, including the
+    first acquisition), ``plan-rebuild`` (each increment of the
+    cumulative rebuild counter), ``truncation`` (each onset — a
+    counter going 0 -> positive), and ``nan-onset`` (the first
+    non-finite tick).  Each event is a JSON-safe dict with the swarm
+    ``tick`` stamp it occurred at."""
+    import numpy as np
+
+    tick = _np(t.tick)
+    if tick.ndim == 0:
+        t = jax.tree_util.tree_map(lambda x: _np(x)[None], t)
+        tick = _np(t.tick)
+    leader = _np(t.leader_id)
+    rebuilds = _np(t.plan_rebuilds)
+    cap = _np(t.cap_overflow)
+    cand = _np(t.cand_overflow)
+    nonfinite = _np(t.nonfinite)
+    events: List[dict] = []
+    prev_leader = NO_LEADER
+    prev_rebuilds = 0
+    prev_trunc = False
+    nan_seen = False
+    for i in range(int(tick.shape[0])):
+        tk = int(tick[i])
+        lid = int(leader[i])
+        if lid != prev_leader:
+            events.append(
+                {
+                    "event": "leader-change",
+                    "tick": tk,
+                    "from": prev_leader,
+                    "to": lid,
+                }
+            )
+            prev_leader = lid
+        rb = int(rebuilds[i])
+        if rb > prev_rebuilds:
+            events.append(
+                {"event": "plan-rebuild", "tick": tk, "rebuilds": rb}
+            )
+            prev_rebuilds = rb
+        trunc = bool(cap[i] > 0 or cand[i] > 0)
+        if trunc and not prev_trunc:
+            events.append(
+                {
+                    "event": "truncation",
+                    "tick": tk,
+                    "cap_overflow": int(cap[i]),
+                    "cand_overflow": int(cand[i]),
+                }
+            )
+        prev_trunc = trunc
+        if bool(nonfinite[i]) and not nan_seen:
+            events.append({"event": "nan-onset", "tick": tk, "step": i})
+            nan_seen = True
+    return events
+
+
+def write_events_jsonl(
+    events: Iterable[dict], out: Union[str, IO[str]]
+) -> int:
+    """Write events one JSON object per line; returns the count.
+    ``out`` is a path or an open text handle."""
+    events = list(events)
+    if isinstance(out, str):
+        with open(out, "w") as fh:
+            return write_events_jsonl(events, fh)
+    for ev in events:
+        out.write(json.dumps(ev, sort_keys=True))
+        out.write("\n")
+    return len(events)
+
+
+def read_events_jsonl(path: str) -> List[dict]:
+    """Inverse of :func:`write_events_jsonl` (round-trip tested)."""
+    out: List[dict] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

@@ -52,6 +52,64 @@ def test_new_and_dropped_metrics_do_not_gate(hist):
     assert compare.compare("r01", "r02", path=hist) == 0
 
 
+def test_events_unit_is_lower_is_better(hist):
+    # r10 flight-recorder counts: a clean 0 baseline regressing to ANY
+    # positive count gates (same contract as findings/rounds).
+    compare.record("r01", [
+        {"metric": "truncation-events, arena", "value": 0.0,
+         "unit": "events"},
+    ], path=hist)
+    compare.record("r02", [
+        {"metric": "truncation-events, arena", "value": 1.0,
+         "unit": "events"},
+    ], path=hist)
+    assert compare.compare("r01", "r02", path=hist) == 1
+    compare.record("r03", [
+        {"metric": "truncation-events, arena", "value": 0.0,
+         "unit": "events"},
+    ], path=hist)
+    assert compare.compare("r02", "r03", path=hist) == 0  # paydown ok
+
+
+def test_ticks_unit_is_lower_is_better(hist):
+    # Recovery latency (bench_recovery): growth gates, paydown never
+    # does — the pre-r10 throughput branch had this backwards.
+    compare.record("r01", [
+        {"metric": "ticks-to-new-leader, fam", "value": 32.0,
+         "unit": "ticks"},
+    ], path=hist)
+    compare.record("r02", [
+        {"metric": "ticks-to-new-leader, fam", "value": 24.0,
+         "unit": "ticks"},  # faster recovery = improvement
+    ], path=hist)
+    assert compare.compare("r01", "r02", path=hist) == 0
+    compare.record("r03", [
+        {"metric": "ticks-to-new-leader, fam", "value": 60.0,
+         "unit": "ticks"},  # slower recovery gates
+    ], path=hist)
+    assert compare.compare("r02", "r03", path=hist) == 1
+
+
+def test_pct_unit_gates_on_absolute_ceiling(hist):
+    # Telemetry overhead (unit "pct"): gated against the ABSOLUTE 5%
+    # ceiling, not relative growth — 0.1% -> 3% is fine (30x growth),
+    # anything past PCT_CEILING fails.
+    compare.record("r01", [
+        {"metric": "telemetry-overhead-pct, arena", "value": 0.1,
+         "unit": "pct"},
+    ], path=hist)
+    compare.record("r02", [
+        {"metric": "telemetry-overhead-pct, arena", "value": 3.0,
+         "unit": "pct"},
+    ], path=hist)
+    assert compare.compare("r01", "r02", path=hist) == 0
+    compare.record("r03", [
+        {"metric": "telemetry-overhead-pct, arena",
+         "value": compare.PCT_CEILING + 0.5, "unit": "pct"},
+    ], path=hist)
+    assert compare.compare("r02", "r03", path=hist) == 1
+
+
 def test_float_stats_normalized_ints_pinned():
     # Quality floats riding in the metric string must not break matching
     a = "generations/sec, NSGA-II ZDT1-30D, pop 512 (HV 0.875, IGD 0.0009)"

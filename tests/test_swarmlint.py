@@ -149,6 +149,23 @@ SEEDED = {
             return out
         """,
     ),
+    "telemetry-gate": (
+        "pkg/scantelem.py",
+        """
+        import jax
+        from distributed_swarm_algorithm_tpu.utils.telemetry import (
+            tick_telemetry,
+        )
+
+        def rollout(pos, vel, alive, n_steps):
+            def body(s, _):
+                t = tick_telemetry(s, vel, alive, 0)
+                return s, t
+
+            out, ys = jax.lax.scan(body, pos, None, length=n_steps)
+            return out, ys
+        """,
+    ),
     "dtype-drift": (
         "ops/hot.py",
         """
@@ -319,6 +336,38 @@ def test_each_rule_fires_exactly_once_on_seeded_tree(tmp_path):
                 # A build OUTSIDE any loop body is the carry seed —
                 # never flagged.
                 return build_hashgrid_plan(pos, alive, 32.0, 2.0, 16)
+            """,
+        ),
+        # A scan-body collector behind the static gate (`if
+        # telemetry:` — the trace-time Python branch) is the
+        # SANCTIONED flight-recorder pattern: no telemetry-gate
+        # finding.  The attribute form (`cfg.telemetry.enabled`)
+        # gates too.
+        (
+            "gated_scan_telemetry",
+            """
+            import jax
+            from distributed_swarm_algorithm_tpu.utils.telemetry import (
+                boids_tick_telemetry,
+                tick_telemetry,
+            )
+
+            def rollout(pos, vel, alive, n_steps, telemetry, cfg):
+                def body(s, _):
+                    t = None
+                    if telemetry:
+                        t = tick_telemetry(s, vel, alive, 0)
+                    return s, t
+
+                def body2(s, _):
+                    t = None
+                    if cfg.telemetry.enabled:
+                        t = boids_tick_telemetry(s)
+                    return s, t
+
+                out, ys = jax.lax.scan(body, pos, None, length=n_steps)
+                out, _ = jax.lax.scan(body2, out, None, length=n_steps)
+                return out, ys
             """,
         ),
         # `x is None` presence checks never concretize a tracer.

@@ -1,0 +1,343 @@
+"""Flight recorder (r10): non-perturbation, summary/JSONL round-trip,
+NaN-onset and truncation detection, leader-churn visibility.
+
+The load-bearing contract is NON-PERTURBATION: a telemetry-enabled
+rollout must produce the bitwise-identical trajectory to the disabled
+one (utils/replay.fingerprint over the full final state) on every
+rollout path — dense, hashgrid per-tick, hashgrid plan-carried
+(Verlet skin), the chunked window scan, the boids twin, and the CPU
+oracle.  Everything else the recorder reports is only trustworthy if
+watching cannot change what is watched.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu.models.cpu_swarm import CpuSwarm
+from distributed_swarm_algorithm_tpu.ops.boids import (
+    BoidsParams,
+    boids_init,
+    boids_run,
+)
+from distributed_swarm_algorithm_tpu.utils import telemetry as tl
+from distributed_swarm_algorithm_tpu.utils.config import (
+    TELEMETRY_ON,
+    TelemetryConfig,
+)
+from distributed_swarm_algorithm_tpu.utils.replay import fingerprint
+
+
+def _targeted_swarm(n=64, seed=0, spread=10.0):
+    s = dsa.make_swarm(n, seed=seed, spread=spread)
+    return s.replace(
+        target=jnp.broadcast_to(jnp.asarray([5.0, 0.0]), s.pos.shape),
+        has_target=jnp.ones_like(s.has_target),
+    )
+
+
+def _station_swarm(n=512, seed=1, spread=60.0):
+    s = dsa.make_swarm(n, seed=seed, spread=spread)
+    return s.replace(
+        target=jnp.asarray(s.pos),
+        has_target=jnp.ones_like(s.has_target),
+    )
+
+
+HASHGRID = dict(
+    separation_mode="hashgrid", world_hw=64.0,
+    formation_shape="none", hashgrid_backend="portable",
+    grid_max_per_cell=24,
+)
+
+
+# ---------------------------------------------------------------- contract
+
+def test_fsm_codes_match_state_module():
+    # utils/telemetry.py pins LEADER/ELECTION_WAIT locally (utils is a
+    # leaf layer); this is the cross-module pin that keeps them honest.
+    from distributed_swarm_algorithm_tpu import state as st
+
+    assert st.LEADER == 3 and st.ELECTION_WAIT == 2
+    assert st.NO_LEADER == tl.NO_LEADER
+
+
+@pytest.mark.parametrize(
+    "cfg",
+    [
+        dsa.SwarmConfig(),                                  # dense
+        dsa.SwarmConfig().replace(**HASHGRID),              # per-tick plan
+        dsa.SwarmConfig().replace(                          # Verlet carry
+            **HASHGRID, hashgrid_skin=1.0,
+        ),
+        dsa.SwarmConfig().replace(                          # chunked scan
+            separation_mode="window", sort_every=4,
+        ),
+    ],
+    ids=["dense", "hashgrid", "hashgrid-skin", "window-chunked"],
+)
+def test_telemetry_is_bitwise_nonperturbing(cfg):
+    s = (
+        _station_swarm()
+        if cfg.separation_mode == "hashgrid"
+        else _targeted_swarm()
+    )
+    off = dsa.swarm_rollout(s, None, cfg, 22)
+    on, telem = dsa.swarm_rollout(s, None, cfg, 22, telemetry=True)
+    assert fingerprint(off) == fingerprint(on)
+    assert tl.summarize_telemetry(telem)["ticks"] == 22
+
+
+def test_cfg_gate_equals_rollout_flag():
+    # Enabling via the config (the TelemetryConfig gate) and via the
+    # rollout flag are the same program: identical records, and the
+    # flag path never mutates the caller's config.
+    cfg = dsa.SwarmConfig()
+    s = _targeted_swarm()
+    out_a, ta = dsa.swarm_rollout(s, None, cfg, 10, telemetry=True)
+    out_b, tb = dsa.swarm_rollout(
+        s, None, cfg.replace(telemetry=TELEMETRY_ON), 10
+    )
+    assert fingerprint(out_a) == fingerprint(out_b)
+    assert fingerprint(ta) == fingerprint(tb)
+    assert cfg.telemetry == TelemetryConfig(enabled=False)
+
+
+def test_record_and_return_plan_compose_with_telemetry():
+    cfg = dsa.SwarmConfig().replace(**HASHGRID, hashgrid_skin=1.0)
+    s = _station_swarm()
+    (state, traj, telem), plan = dsa.swarm_rollout(
+        s, None, cfg, 8, record=True, telemetry=True, return_plan=True
+    )
+    assert traj.shape == (8,) + s.pos.shape
+    assert int(telem.tick.shape[0]) == 8
+    # The stacked record's final rebuild count matches the carried
+    # plan's own counter — one source of truth, two views.
+    assert int(telem.plan_rebuilds[-1]) == int(plan.rebuilds)
+
+
+# ------------------------------------------------------------- the gauges
+
+def test_leader_and_election_series():
+    cfg = dsa.SwarmConfig()
+    s = _targeted_swarm(n=32)
+    _, telem = dsa.swarm_rollout(s, None, cfg, 45, telemetry=True)
+    summ = tl.summarize_telemetry(telem)
+    # Election timeout is 30 ticks: the run starts leaderless, elects
+    # agent 31, and the change is both counted and event-logged.
+    assert summ["leader_final"] == 31
+    assert summ["leader_changes"] == 1
+    assert summ["leaderless_ticks"] >= 30
+    assert summ["election_ticks"] >= 1
+    events = tl.telemetry_events(telem)
+    changes = [e for e in events if e["event"] == "leader-change"]
+    assert changes == [
+        {
+            "event": "leader-change",
+            "tick": changes[0]["tick"],
+            "from": -1,
+            "to": 31,
+        }
+    ]
+
+
+def test_leader_churn_after_kill():
+    # The bench_recovery use case at test scale: kill the leader
+    # mid-run; the telemetry series shows the leaderless window and
+    # the re-election, at tick resolution.
+    cfg = dsa.SwarmConfig()
+    s = _targeted_swarm(n=24)
+    s = dsa.swarm_rollout(s, None, cfg, 40)
+    lid0, exists = dsa.current_leader(s)
+    assert bool(exists)
+    s = dsa.kill(s, [int(lid0)])
+    _, telem = dsa.swarm_rollout(s, None, cfg, 60, telemetry=True)
+    summ = tl.summarize_telemetry(telem)
+    assert summ["leader_final"] != int(lid0)
+    assert summ["leader_final"] >= 0
+    assert summ["leader_changes"] >= 1
+    assert summ["alive_min"] == 23
+
+
+def test_speed_and_force_gauges_are_bounded_and_positive():
+    cfg = dsa.SwarmConfig()
+    s = _targeted_swarm()
+    _, telem = dsa.swarm_rollout(s, None, cfg, 12, telemetry=True)
+    summ = tl.summarize_telemetry(telem)
+    assert 0.0 < summ["speed_max"] <= cfg.max_speed + 1e-6
+    # Pre-clamp force is what the speed clamp hides: far-from-target
+    # agents pull harder than max_speed.
+    assert summ["force_max"] >= summ["speed_max"]
+    assert summ["force_mean"] > 0.0
+
+
+def test_truncation_counter_surfaces_cap_overflow():
+    # 65 co-located agents in one cell with an 8-slot cap: the r5 cap
+    # contract silently truncates — the r10 counter makes it visible.
+    cfg = dsa.SwarmConfig().replace(
+        separation_mode="hashgrid", world_hw=16.0,
+        formation_shape="none", hashgrid_backend="portable",
+        grid_max_per_cell=8,
+    )
+    s = dsa.make_swarm(65, seed=3, spread=0.5)
+    s = s.replace(
+        target=jnp.asarray(s.pos), has_target=jnp.ones_like(s.has_target)
+    )
+    _, telem = dsa.swarm_rollout(s, None, cfg, 5, telemetry=True)
+    summ = tl.summarize_telemetry(telem)
+    assert summ["truncation_events"] == 5
+    assert summ["cap_overflow_max"] >= 1
+    events = tl.telemetry_events(telem)
+    assert any(e["event"] == "truncation" for e in events)
+    # Plan-level counter (satellite): the same number is on the plan.
+    from distributed_swarm_algorithm_tpu.ops.physics import (
+        build_tick_plan,
+    )
+
+    plan = build_tick_plan(s, cfg)
+    assert int(plan.cap_overflow) == summ["cap_overflow_max"]
+
+
+# -------------------------------------------------------------- NaN onset
+
+def test_nan_onset_detected_on_divergent_config():
+    # k_att at the f32 overflow edge: force overflows to inf on the
+    # first tick, the clamp's inf * 0 produces NaN — the recorder
+    # flags the onset step; a sane config stays clean.
+    bad = dsa.SwarmConfig().replace(k_att=1e38, formation_shape="none")
+    s = _targeted_swarm(n=16, spread=5.0)
+    _, telem = dsa.swarm_rollout(s, None, bad, 6, telemetry=True)
+    summ = tl.summarize_telemetry(telem)
+    assert summ["first_nonfinite_step"] == 0
+    events = tl.telemetry_events(telem)
+    onsets = [e for e in events if e["event"] == "nan-onset"]
+    assert len(onsets) == 1 and onsets[0]["step"] == 0
+
+    good = dsa.SwarmConfig()
+    _, telem2 = dsa.swarm_rollout(s, None, good, 6, telemetry=True)
+    assert tl.summarize_telemetry(telem2)["first_nonfinite_step"] == -1
+
+
+def test_nan_onset_mid_series_reducer():
+    # The reducer itself, on a synthetic series with onset at step 3
+    # (a rollout that diverges mid-run): first_nonfinite_step is the
+    # FIRST bad step, and the event log carries the swarm tick stamp.
+    n = 6
+    z32 = np.zeros(n, np.int32)
+    telem = tl.TickTelemetry(
+        tick=np.arange(10, 10 + n, dtype=np.int32),
+        alive=np.full(n, 4, np.int32),
+        leader_id=np.full(n, 2, np.int32),
+        electing=z32,
+        speed_max=np.ones(n, np.float32),
+        speed_mean=np.ones(n, np.float32),
+        force_max=np.ones(n, np.float32),
+        force_mean=np.ones(n, np.float32),
+        nonfinite=np.array([0, 0, 0, 1, 1, 1], bool),
+        plan_age=z32,
+        plan_rebuilds=z32,
+        cap_overflow=z32,
+        cand_overflow=z32,
+    )
+    summ = tl.summarize_telemetry(telem)
+    assert summ["first_nonfinite_step"] == 3
+    onsets = [
+        e for e in tl.telemetry_events(telem) if e["event"] == "nan-onset"
+    ]
+    assert onsets == [{"event": "nan-onset", "tick": 13, "step": 3}]
+
+
+# ------------------------------------------------- summary / JSONL plumbing
+
+def test_summary_is_json_safe_and_events_roundtrip(tmp_path):
+    cfg = dsa.SwarmConfig().replace(**HASHGRID, hashgrid_skin=1.0)
+    s = _station_swarm(n=256)
+    _, telem = dsa.swarm_rollout(s, None, cfg, 15, telemetry=True)
+    summ = tl.summarize_telemetry(telem)
+    # Round-trips through json with no numpy scalars leaking.
+    assert json.loads(json.dumps(summ)) == summ
+    events = tl.telemetry_events(telem)
+    path = str(tmp_path / "events.jsonl")
+    n = tl.write_events_jsonl(events, path)
+    assert n == len(events)
+    assert tl.read_events_jsonl(path) == events
+    # Rebuild events reconstruct the cumulative counter.
+    rebuilds = [e for e in events if e["event"] == "plan-rebuild"]
+    assert len(rebuilds) == summ["plan_rebuilds"]
+    assert [e["rebuilds"] for e in rebuilds] == list(
+        range(1, len(rebuilds) + 1)
+    )
+
+
+def test_zero_step_rollout_yields_none_on_every_path():
+    # The documented n_steps == 0 contract must not depend on which
+    # rollout path the config selects (scan vs chunked window).
+    s = _targeted_swarm(n=8)
+    for cfg in (
+        dsa.SwarmConfig(),
+        dsa.SwarmConfig().replace(separation_mode="window", sort_every=4),
+    ):
+        state, telem = dsa.swarm_rollout(s, None, cfg, 0, telemetry=True)
+        assert telem is None
+
+
+def test_stack_and_concat_telemetry():
+    s = _targeted_swarm(n=8)
+    cfg = dsa.SwarmConfig()
+    _, t1 = dsa.swarm_rollout(s, None, cfg, 3, telemetry=True)
+    _, t2 = dsa.swarm_rollout(s, None, cfg, 4, telemetry=True)
+    both = tl.concat_telemetry([t1, t2])
+    assert int(both.tick.shape[0]) == 7
+    with pytest.raises(ValueError, match="at least one"):
+        tl.stack_telemetry([])
+
+
+# ----------------------------------------------------------- boids + oracle
+
+def test_boids_telemetry_nonperturbing_dense_and_gridmean():
+    p = BoidsParams(half_width=40.0)
+    st = boids_init(128, params=p, seed=0)
+    a, _ = boids_run(st, p, 12, neighbor_mode="dense")
+    b, _, telem = boids_run(
+        st, p, 12, neighbor_mode="dense", telemetry=True
+    )
+    assert fingerprint(a) == fingerprint(b)
+    summ = tl.summarize_telemetry(telem)
+    assert summ["ticks"] == 12
+    assert summ["leader_final"] == tl.NO_LEADER      # no protocol
+    assert 0.0 < summ["speed_max"] <= p.max_speed + 1e-6
+
+    pg = BoidsParams(
+        half_width=40.0, skin=1.0, grid_sep_backend="portable",
+        grid_max_per_cell=24,
+    )
+    c, _ = boids_run(st, pg, 10, neighbor_mode="gridmean")
+    d, _, tg = boids_run(
+        st, pg, 10, neighbor_mode="gridmean", telemetry=True
+    )
+    assert fingerprint(c) == fingerprint(d)
+    sg = tl.summarize_telemetry(tg)
+    assert sg["plan_rebuilds"] >= 0
+    assert sg["first_nonfinite_step"] == -1
+
+
+def test_cpu_oracle_telemetry_matches_protocol():
+    cfg = dsa.SwarmConfig().replace(telemetry=TELEMETRY_ON)
+    sw = CpuSwarm(16, config=cfg, seed=0, spread=3.0, backend="numpy")
+    sw.set_target([5.0, 5.0])
+    sw.step(45)
+    assert len(sw.telemetry) == 45
+    summ = tl.summarize_telemetry(sw.stacked_telemetry())
+    assert summ["ticks"] == 45
+    assert summ["leader_final"] == 15
+    assert summ["leader_changes"] == 1
+    assert summ["first_nonfinite_step"] == -1
+    # Gate honored: a default-config oracle records nothing.
+    quiet = CpuSwarm(8, seed=0, backend="numpy")
+    quiet.step(5)
+    assert quiet.telemetry == []

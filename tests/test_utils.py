@@ -56,6 +56,33 @@ def test_step_timer():
     assert t.steps_per_sec > 0
 
 
+def test_step_timer_measure_accumulates_and_yields_timer():
+    # The context manager the class docstring advertises (r10
+    # satellite): yields the timer, accumulates across blocks, and
+    # stop() clears the pending start.
+    t = StepTimer()
+    with t.measure(steps=3, agents=2) as inner:
+        assert inner is t
+    with t.measure(steps=7, agents=2):
+        pass
+    assert t.total_steps == 10
+    assert t.total_agent_steps == 20
+    assert t.total_seconds > 0.0
+    assert t.agent_steps_per_sec > 0.0
+
+
+def test_step_timer_stop_without_start_raises():
+    # A real exception, not a bare assert (stripped under python -O).
+    t = StepTimer()
+    with pytest.raises(RuntimeError, match="without a matching start"):
+        t.stop()
+    # After a completed measure, a second bare stop still raises.
+    with t.measure(steps=1):
+        pass
+    with pytest.raises(RuntimeError, match="without a matching start"):
+        t.stop()
+
+
 def test_config_replace_and_hash():
     cfg = dsa.SwarmConfig()
     cfg2 = cfg.replace(max_speed=2.0)

@@ -45,6 +45,25 @@ HEADLINE_METRIC = (
 )
 
 
+def _append_to_run_dir(record: dict) -> None:
+    """With DSA_RUN_DIR set, deposit the headline line there too —
+    stdout remains the contract; the run dir is the durable copy the
+    inspector reads.  Under run_all (DSA_RUN_ALL sentinel) this is a
+    no-op: the suite collector already captures every stdout JSON
+    line into metrics.jsonl, and a second direct write would double
+    the row (harmless for metrics, but a value-null failure line
+    would show as two failures in `swarmscope summary`)."""
+    run_dir = os.environ.get("DSA_RUN_DIR")
+    if not run_dir or os.environ.get("DSA_RUN_ALL"):
+        return
+    try:
+        from distributed_swarm_algorithm_tpu.utils import rundir
+
+        rundir.append_metrics(run_dir, [record])
+    except Exception:
+        pass  # the run dir is best-effort; the stdout line already shipped
+
+
 def _retry_backend_init(fn, attempts=INIT_ATTEMPTS,
                         backoff_s=INIT_BACKOFF_S, sleep=time.sleep,
                         label="backend-init"):
@@ -60,17 +79,17 @@ def _retry_backend_init(fn, attempts=INIT_ATTEMPTS,
             last = e
             if attempt < attempts:
                 sleep(backoff_s * attempt)
-    print(
-        json.dumps({
-            "metric": HEADLINE_METRIC + " (FAILED)",
-            "value": None,
-            "unit": "agent-steps/sec",
-            "vs_baseline": None,
-            "error": label,
-            "attempts": attempts,
-            "detail": f"{type(last).__name__}: {last}",
-        })
-    )
+    failure = {
+        "metric": HEADLINE_METRIC + " (FAILED)",
+        "value": None,
+        "unit": "agent-steps/sec",
+        "vs_baseline": None,
+        "error": label,
+        "attempts": attempts,
+        "detail": f"{type(last).__name__}: {last}",
+    }
+    print(json.dumps(failure))
+    _append_to_run_dir(failure)
     raise SystemExit(3)
 
 
@@ -156,24 +175,22 @@ def main():
 
     agent_steps_per_sec = best * N
     path = "pallas-fused" if opt.use_pallas else "xla-jit"
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    "agent-steps/sec, PSO Rastrigin-30D, 1,048,576 "
-                    f"particles, 1 chip ({path})"
-                ),
-                "value": round(agent_steps_per_sec, 1),
-                "unit": "agent-steps/sec",
-                "vs_baseline": round(
-                    agent_steps_per_sec / REFERENCE_AGENT_STEPS_PER_SEC, 2
-                ),
-                # True = fused kernel numerically certified on this chip
-                # this run; None = no TPU attached (portable path).
-                "parity_ok": parity_ok,
-            }
-        )
-    )
+    record = {
+        "metric": (
+            "agent-steps/sec, PSO Rastrigin-30D, 1,048,576 "
+            f"particles, 1 chip ({path})"
+        ),
+        "value": round(agent_steps_per_sec, 1),
+        "unit": "agent-steps/sec",
+        "vs_baseline": round(
+            agent_steps_per_sec / REFERENCE_AGENT_STEPS_PER_SEC, 2
+        ),
+        # True = fused kernel numerically certified on this chip
+        # this run; None = no TPU attached (portable path).
+        "parity_ok": parity_ok,
+    }
+    print(json.dumps(record))
+    _append_to_run_dir(record)
 
 
 if __name__ == "__main__":

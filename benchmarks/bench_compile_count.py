@@ -1,0 +1,127 @@
+"""Compile-observatory cache-entry counts (r11).
+
+The compile plane's union-gate rows: run one workload TWICE against
+the rollout entry and one parallel driver, and report how many
+distinct signatures the compile observatory (utils/compile_watch.py)
+saw each entry compile under.  The healthy value is exactly 1 — jit
+hits its cache on the second call — so the fixed-name rows gate
+lower-is-better (unit "compiles" in compare.py): any change that
+sneaks a run-varying value into a traced position (a fresh lambda, an
+unhashable static, a shape that drifts per call) shows up as a count
+regression in the very next recorded round, instead of as a silent
+2x compile bill.
+
+Fixed-name rows (cpu families; skipped on other backends):
+
+  compile-count, swarm-rollout ...   unit "compiles"
+  compile-count, island-run ...      unit "compiles"
+
+Usage: python benchmarks/bench_compile_count.py
+"""
+
+from __future__ import annotations
+
+import os
+
+# This bench is its own subprocess (run_all contract), so the
+# 8-virtual-device CPU rig can be pinned before jax initializes —
+# the island driver row measures the real multi-device program.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+import jax.numpy as jnp
+
+from common import report
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu.ops.objectives import rastrigin
+from distributed_swarm_algorithm_tpu.parallel.islands import (
+    island_init,
+    island_run,
+)
+from distributed_swarm_algorithm_tpu.parallel.mesh import make_mesh
+from distributed_swarm_algorithm_tpu.utils import compile_watch as cw
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+N_AGENTS = 4096
+N_TICKS = 16
+N_ISLANDS = 8
+N_PER_ISLAND = 128
+ISLAND_STEPS = 16
+
+
+def main() -> None:
+    backend = jax.default_backend()
+    if backend != "cpu":
+        print(
+            f"# bench_compile_count: cpu-family rows; backend is "
+            f"{backend!r} — skipping"
+        )
+        return
+    cw.WATCH.reset()
+    cw.enable()
+
+    # --- rollout entry: same workload twice -> one cache entry -------
+    s = dsa.make_swarm(N_AGENTS, seed=0, spread=20.0)
+    s = s.replace(
+        target=jnp.broadcast_to(jnp.asarray([5.0, 0.0]), s.pos.shape),
+        has_target=jnp.ones_like(s.has_target),
+    )
+    cfg = dsa.SwarmConfig()
+    for _ in range(2):
+        out = dsa.swarm_rollout(s, None, cfg, N_TICKS)
+        jax.block_until_ready(out.pos)
+    rollout_compiles = cw.WATCH.compile_count("swarm-rollout")
+    report(
+        "compile-count, swarm-rollout 4096 agents 16 ticks (cpu)",
+        float(rollout_compiles), "compiles", 0.0,
+    )
+
+    # --- one parallel driver: the island model on the 8-device rig --
+    devices = jax.devices()[:8]
+    mesh = make_mesh(("islands",), devices=devices)
+    st = island_init(
+        rastrigin, n_islands=N_ISLANDS, n_per_island=N_PER_ISLAND,
+        dim=8, half_width=5.12, seed=0,
+    )
+    st = jax.tree_util.tree_map(
+        lambda x: jax.device_put(
+            x,
+            NamedSharding(
+                mesh,
+                P("islands")
+                if getattr(x, "ndim", 0) >= 1 and x.shape[0] == N_ISLANDS
+                else P(),
+            ),
+        ),
+        st,
+    )
+    for _ in range(2):
+        out = island_run(
+            st, rastrigin, ISLAND_STEPS, migrate_every=4, migrate_k=2
+        )
+        jax.block_until_ready(out.pso.gbest_fit)
+    island_compiles = cw.WATCH.compile_count("island-run")
+    report(
+        "compile-count, island-run 8x128 particles 16 steps "
+        "8 devices (cpu)",
+        float(island_compiles), "compiles", 0.0,
+    )
+
+    storms = [
+        e for e in cw.WATCH.events if e["event"] == "retrace-storm"
+    ]
+    print(
+        f"# compile observatory: rollout {rollout_compiles} entr"
+        f"{'y' if rollout_compiles == 1 else 'ies'}, island-run "
+        f"{island_compiles}, retrace storms {len(storms)}"
+    )
+
+
+if __name__ == "__main__":
+    main()

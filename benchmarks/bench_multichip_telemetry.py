@@ -1,0 +1,155 @@
+"""Sharded flight-recorder overhead on the 8-virtual-device rig (r11).
+
+The multichip twin of bench_telemetry.py: the protocol tick with the
+agent axis sharded over an 8-device CPU mesh (the dryrun_multichip
+rig, GSPMD portable hashgrid — the documented multi-device backend),
+timed with the in-scan recorder off and on.  Under GSPMD the
+collection's reductions are partitioned into ICI collectives, so this
+is the number that says what the recorder costs where it matters:
+per-tick collectives on a mesh, not just single-device arithmetic.
+The row gates under the same absolute 5% ceiling (unit "pct",
+compare.PCT_CEILING) as the single-device row, and lands in the
+MULTICHIP round artifact via the dryrun's own telemetry axis.
+
+The run doubles as the rig-level non-perturbation check: the
+telemetry-on trajectory must fingerprint bitwise-equal to off, or the
+bench exits nonzero before reporting anything.
+
+Fixed-name rows (cpu families; the script pins the CPU backend itself
+— it IS the virtual-device rig):
+
+  multichip-telemetry-overhead-pct ...  unit "pct"   (ceiling 5%)
+  truncation-events, 8 devices ...      unit "events"
+  plan-rebuilds-per-100-ticks, 8 d...   unit "rounds"
+
+Usage: python benchmarks/bench_multichip_telemetry.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# Own-subprocess contract (run_all): pin the 8-virtual-device CPU rig
+# before jax initializes — this bench never wants the tunnel chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+import jax.numpy as jnp
+
+from common import report, telemetry_rows, timeit_best
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu.parallel.mesh import make_mesh
+from distributed_swarm_algorithm_tpu.parallel.sharding import (
+    shard_swarm,
+    swarm_telemetry_shmap,
+)
+from distributed_swarm_algorithm_tpu.utils.replay import fingerprint
+from distributed_swarm_algorithm_tpu.utils.telemetry import (
+    summarize_telemetry,
+    telemetry_events,
+)
+
+N_DEV = 8
+N = 2048
+HW = 64.0
+SETTLE = 16
+STEPS = 30
+TAG = "8 devices 2048 agents 30 ticks station-keeping (cpu)"
+
+
+def _cfg() -> dsa.SwarmConfig:
+    # The documented multi-device hashgrid backend (portable path);
+    # per-tick plan (skin=0) — the Verlet carry is a single-device
+    # regime today (ROADMAP item 1 owns the sharded neighbor tick).
+    return dsa.SwarmConfig().replace(
+        separation_mode="hashgrid", world_hw=HW,
+        formation_shape="none", hashgrid_backend="portable",
+        grid_max_per_cell=24, max_speed=1.0,
+    )
+
+
+def _station_swarm():
+    s = dsa.make_swarm(N, seed=0, spread=HW * 0.9)
+    s = dsa.with_tasks(s, jnp.asarray([[1.0, 1.0], [-2.0, 3.0]]))
+    return s.replace(
+        target=jnp.asarray(s.pos),
+        has_target=jnp.ones_like(s.has_target),
+    )
+
+
+def _time(s, cfg, telemetry: bool):
+    def run(st):
+        return dsa.swarm_rollout(st, None, cfg, STEPS,
+                                 telemetry=telemetry)
+
+    holder = {"out": run(s)}
+    final = holder["out"][0] if telemetry else holder["out"]
+    jax.block_until_ready(final.pos)
+
+    def once():
+        holder["out"] = run(s)
+
+    def sync():
+        out = holder["out"]
+        st = out[0] if telemetry else out
+        return float(st.pos[0, 0])
+
+    return timeit_best(once, sync), holder["out"]
+
+
+def main() -> int:
+    devices = jax.devices()[:N_DEV]
+    if len(devices) < N_DEV:
+        print(f"# bench_multichip_telemetry: need {N_DEV} devices, "
+              f"have {len(devices)} — skipping")
+        return 0
+    mesh = make_mesh(("agents",), devices=devices)
+    cfg = _cfg()
+    s = _station_swarm()
+    s = shard_swarm(s, mesh)
+    s = dsa.swarm_rollout(s, None, cfg, SETTLE)
+    jax.block_until_ready(s.pos)
+
+    t_off, out_off = _time(s, cfg, telemetry=False)
+    t_on, (out_on, telem) = _time(s, cfg, telemetry=True)
+    # Rig-level non-perturbation gate: watching the sharded tick must
+    # not change it, bitwise, or no number below can be trusted.
+    if fingerprint(out_off) != fingerprint(out_on):
+        print("# PARITY FAILURE: telemetry-on trajectory diverged "
+              "from telemetry-off on the sharded rollout",
+              file=sys.stderr)
+        return 2
+    overhead = max(0.0, 100.0 * (t_on - t_off) / t_off)
+    summ = summarize_telemetry(telem)
+    rec = swarm_telemetry_shmap(out_on, mesh)
+    print(
+        f"# sharded recorder (N={N}, {N_DEV} devices, {STEPS} ticks): "
+        f"off {t_off / STEPS * 1e3:.1f} ms/tick, on "
+        f"{t_on / STEPS * 1e3:.1f} -> {overhead:.2f}% (bar <= 5%); "
+        f"residency max {int(rec.shard_max_alive)} agents/shard, "
+        f"imbalance {int(rec.shard_imbalance)}"
+    )
+    report(
+        "multichip-telemetry-overhead-pct, 8 devices 2048 agents "
+        "30 ticks station-keeping (cpu)",
+        overhead, "pct", 0.0,
+    )
+    telemetry_rows(summ, TAG)
+    run_dir = os.environ.get("DSA_RUN_DIR")
+    if run_dir:
+        from distributed_swarm_algorithm_tpu.utils import rundir
+
+        rundir.merge_telemetry_summary(run_dir, TAG, summ)
+        rundir.append_events(run_dir, telemetry_events(telem))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -25,6 +25,8 @@ Usage: python benchmarks/bench_telemetry.py
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -33,6 +35,7 @@ from common import report, telemetry_rows, timeit_best
 import distributed_swarm_algorithm_tpu as dsa
 from distributed_swarm_algorithm_tpu.utils.telemetry import (
     summarize_telemetry,
+    telemetry_events,
 )
 
 N = 65_536
@@ -125,6 +128,14 @@ def main() -> None:
         overhead, "pct", 0.0,
     )
     telemetry_rows(summ, TAG)
+    run_dir = os.environ.get("DSA_RUN_DIR")
+    if run_dir:
+        # The swarmscope run directory (r11): the recorder summary and
+        # the threshold-event log become durable run artifacts.
+        from distributed_swarm_algorithm_tpu.utils import rundir
+
+        rundir.merge_telemetry_summary(run_dir, TAG, summ)
+        rundir.append_events(run_dir, telemetry_events(telem))
 
 
 if __name__ == "__main__":

@@ -19,15 +19,20 @@ threshold.  Recorded metrics are throughputs (higher is better) with
 these exceptions: units "findings" (the swarmlint hazard count from
 run_all's static gate), "rounds" (auction convergence / plan-rebuild
 rates, r8/r10), "events" (flight-recorder truncation / leader-churn
-counts, r10), and "ticks" (recovery latency, bench_recovery — a
+counts, r10), "ticks" (recovery latency, bench_recovery — a
 LATENCY, which the pre-r10 throughput branch silently gated
-backwards) are lower-is-better and gate on growth (a clean 0
-baseline regressing to any positive count always gates); unit "pct"
-(telemetry overhead, r10) is lower-is-better against an ABSOLUTE
+backwards), and "compiles" (compile-observatory cache-entry counts,
+r11 — a retrace storm is a count regression) are lower-is-better and
+gate on growth (a clean 0 baseline regressing to any positive count
+always gates); unit "pct" (telemetry overhead, r10; multichip
+telemetry overhead, r11) is lower-is-better against an ABSOLUTE
 ceiling — any value above PCT_CEILING (5%) gates, regardless of the
 baseline (relative gating is meaningless near 0%).  Records with
 value null (structured failure lines) are never merged into the
-history.
+history.  The gating rules are mirrored in
+``distributed_swarm_algorithm_tpu/utils/rundir.py`` (the swarmscope
+run-directory diff) — change them in BOTH places;
+tests/test_swarmscope.py cross-checks the verdicts.
 """
 
 from __future__ import annotations
@@ -147,13 +152,13 @@ def compare(prev_label: str, cur_label: str, threshold: float = 0.2,
         pv = float(prev[key][1]["value"])
         cv = float(cur[key][1]["value"])
         unit = str(cur[key][1].get("unit", ""))
-        if unit in ("findings", "rounds", "events", "ticks"):
+        if unit in ("findings", "rounds", "events", "ticks", "compiles"):
             # Lower-is-better count metrics (swarmlint hygiene debt;
             # auction convergence rounds, r8; flight-recorder
             # truncation/churn counts and recovery-latency ticks,
-            # r10): gate on growth, never on paydown.  A clean
-            # baseline (0) regressing to any positive count always
-            # gates.
+            # r10; compile-observatory cache entries, r11): gate on
+            # growth, never on paydown.  A clean baseline (0)
+            # regressing to any positive count always gates.
             status = "ok"
             if cv > pv * (1.0 + threshold) or (pv == 0 and cv > 0):
                 status = "REGRESSION"

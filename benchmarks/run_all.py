@@ -4,6 +4,7 @@ Each bench is a subprocess so a failure (e.g. no TPU attached for the
 1M-particle configs) skips that line instead of killing the suite.
 Usage:  python benchmarks/run_all.py  [--quick] [--tests]
                                       [--record rNN] [--no-gate]
+                                      [--run-dir DIR]
 
 ``--tests`` first runs the FULL pytest suite (including the tests the
 default `pytest` run deselects via the `slow` marker: heavyweight
@@ -14,7 +15,16 @@ the CI-style everything gate.
 under round label rNN, then runs ``compare.py`` against the latest
 earlier round: any family-level throughput drop >20% fails the run
 (the perf-regression gate, VERDICT r2 §6).  ``--no-gate`` records and
-prints the comparison without failing.
+prints the comparison without failing.  Recording also restores the
+per-round ``BENCH_rNN.json`` snapshot at the repo root (r11 — the
+r06-r10 rounds lived only inside BENCH_HISTORY.json, so the per-round
+trajectory stopped being diffable as standalone artifacts).
+
+``--run-dir DIR`` (r11; defaults to ``runs/<rNN>`` when ``--record``
+is given) emits a structured run directory — manifest + metrics.jsonl
++ flight-recorder summaries/events + compile-observatory records (the
+subprocesses see it via ``DSA_RUN_DIR``) — which ``python -m
+distributed_swarm_algorithm_tpu swarmscope`` summarizes and diffs.
 """
 
 from __future__ import annotations
@@ -73,6 +83,15 @@ BENCHES = [
     # ceiling (<= 5%, unit "pct") and the stay-clean truncation gate
     # (unit "events") both ride the union gate from here.
     "bench_telemetry.py",
+    # r11: compile-observatory cache-entry counts for the rollout and
+    # one parallel driver (unit "compiles", lower-is-better) — a
+    # retrace regression in either entry gates the round.
+    "bench_compile_count.py",
+    # r11: sharded-recorder overhead on the 8-virtual-device rig
+    # (unit "pct" under the absolute 5% ceiling) plus the mesh
+    # residency/imbalance rows — the multichip twin of
+    # bench_telemetry.
+    "bench_multichip_telemetry.py",
 ]
 
 # Extra argv for benches whose no-arg default is not the gate set —
@@ -113,6 +132,8 @@ QUICK_SKIP = {
     "decompose_hashgrid_plan.py",
     "decompose_rebuild.py",
     "bench_telemetry.py",
+    "bench_compile_count.py",
+    "bench_multichip_telemetry.py",
 }
 
 
@@ -247,15 +268,39 @@ def main() -> int:
     ap.add_argument("--tests", action="store_true")
     ap.add_argument("--record", metavar="rNN", default=None)
     ap.add_argument("--no-gate", action="store_true")
+    ap.add_argument("--run-dir", metavar="DIR", default=None,
+                    help="emit a swarmscope run directory (default: "
+                         "runs/<rNN> when --record is given)")
     args = ap.parse_args()
 
     root = os.path.dirname(HERE)
+    run_dir = args.run_dir or (
+        os.path.join(root, "runs", args.record) if args.record else None
+    )
+    if run_dir:
+        # The package's rundir helpers need the repo root importable
+        # (same contract as common.py; the suite runs in-tree).
+        if root not in sys.path:
+            sys.path.insert(0, root)
+        from distributed_swarm_algorithm_tpu.utils import rundir
+
+        run_dir = os.path.abspath(run_dir)
+        rundir.create_run_dir(
+            run_dir, label=args.record, backend=_default_backend(),
+        )
+        # Subprocesses deposit their halves here: bench_telemetry's
+        # recorder summary/events, and every compile-watch dump.
+        # DSA_RUN_ALL tells bench.py NOT to also write its stdout line
+        # directly — this collector captures it into metrics.jsonl.
+        os.environ["DSA_RUN_DIR"] = run_dir
+        os.environ["DSA_RUN_ALL"] = "1"
+        print(f"# run directory: {run_dir}")
+    collect = bool(args.record or run_dir)
     failures = 0
     recorded: list = []
     # Cheapest gate first (pure AST, no jax): hazard count + contract
     # check before any bench spends device time.
-    failures += 0 if _run_swarmlint(root, recorded,
-                                    bool(args.record)) else 1
+    failures += 0 if _run_swarmlint(root, recorded, collect) else 1
     if args.tests:
         # Full gate = TWO pytest processes (default set, then the slow
         # set).  XLA's CPU backend_compile_and_load segfaults after
@@ -280,7 +325,7 @@ def main() -> int:
         ok = _run_one(
             [sys.executable, os.path.join(HERE, name)]
             + BENCH_ARGS.get(name, []),
-            root, recorded, bool(args.record),
+            root, recorded, collect,
         )
         failures += 0 if ok else 1
     if not args.quick and _default_backend() == "cpu":
@@ -295,7 +340,7 @@ def main() -> int:
                 sys.executable,
                 os.path.join(HERE, "bench_swarm_tpu.py"), "cpu",
             ],
-            root, recorded, bool(args.record),
+            root, recorded, collect,
         )
         failures += 0 if ok else 1
     if not args.quick:
@@ -304,13 +349,20 @@ def main() -> int:
         # would land in the non-gating 'dropped' bucket.
         ok = _run_one(
             [sys.executable, os.path.join(root, "bench.py")], root,
-            recorded, bool(args.record),
+            recorded, collect,
         )
         failures += 0 if ok else 1
+    if run_dir:
+        from distributed_swarm_algorithm_tpu.utils import rundir
+
+        n = rundir.append_metrics(run_dir, recorded)
+        print(f"# run directory: {n} metric line(s) -> "
+              f"{os.path.join(run_dir, rundir.METRICS)}")
     if args.record:
         import compare
 
         compare.record(args.record, recorded)
+        _write_round_snapshot(root, args.record)
         print(f"# perf-regression gate: union -> {args.record}")
         n_bad = compare.compare(
             "union", args.record, min_coverage=0.5,
@@ -318,6 +370,26 @@ def main() -> int:
         if n_bad and not args.no_gate:
             return 1
     return 1 if failures else 0
+
+
+def _write_round_snapshot(root: str, label: str) -> str:
+    """Restore the per-round ``BENCH_rNN.json`` artifact (r11): the
+    recorded round's metric map, pulled back OUT of BENCH_HISTORY.json
+    so each round is diffable as a standalone file again (r01-r05 had
+    these; r06-r10 existed only inside the history)."""
+    import compare
+
+    hist = compare.load_history()
+    snap = {
+        "round": label,
+        "metrics": hist.get("rounds", {}).get(label, {}),
+    }
+    path = os.path.join(root, f"BENCH_{label}.json")
+    with open(path, "w") as fh:
+        json.dump(snap, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    print(f"# round snapshot: {path}")
+    return path
 
 
 if __name__ == "__main__":

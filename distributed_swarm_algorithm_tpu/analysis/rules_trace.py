@@ -22,6 +22,12 @@
   the r10 contract is that the disabled trace compiles to the
   identical telemetry-free HLO, which only a trace-time Python ``if``
   on the static gate can guarantee.
+- ``scope-fstring``: a dynamic (f-string / ``.format`` /
+  concatenated) name passed to ``jax.named_scope`` — each distinct
+  name string is a fresh trace annotation, so a run-varying scope
+  name is a retrace hazard (and shreds XProf trace aggregation, which
+  groups by exact scope string) exactly like a run-varying metric
+  name shreds the bench union gate.
 """
 
 from __future__ import annotations
@@ -319,10 +325,19 @@ class PlanStalenessRule(Rule):
 # telemetry-gate
 
 #: Flight-recorder collector leaf names (utils/telemetry.py): the
-#: generic entry point plus its per-model conveniences.
+#: generic entry point plus its per-model conveniences.  Any
+#: ``*_tick_telemetry`` leaf matches too (r11 added island/optimizer/
+#: driver-private collectors; new ones must not dodge the gate rule
+#: by name).
 _TELEMETRY_COLLECTORS = frozenset(
     {"tick_telemetry", "swarm_tick_telemetry", "boids_tick_telemetry"}
 )
+
+
+def _is_telemetry_collector(leaf: str) -> bool:
+    return leaf in _TELEMETRY_COLLECTORS or leaf.endswith(
+        "_tick_telemetry"
+    )
 
 
 def _gated_by_telemetry_flag(mod: ModuleInfo, node, fn) -> bool:
@@ -390,7 +405,7 @@ class TelemetryGateRule(Rule):
                         continue
                     name = mod.resolve(node.func)
                     leaf = name.rsplit(".", 1)[-1] if name else ""
-                    if leaf not in _TELEMETRY_COLLECTORS:
+                    if not _is_telemetry_collector(leaf):
                         continue
                     if _gated_by_telemetry_flag(mod, node, fn):
                         continue
@@ -406,6 +421,57 @@ class TelemetryGateRule(Rule):
                         "cfg.telemetry.enabled:` so the disabled "
                         "rollout keeps its telemetry-free HLO",
                     )
+
+
+# ---------------------------------------------------------------------------
+# scope-fstring
+
+
+@register
+class ScopeStringRule(Rule):
+    id = "scope-fstring"
+    summary = "dynamic name passed to jax.named_scope"
+    details = (
+        "`jax.named_scope` names become trace annotations keyed by "
+        "exact string: an f-string / `.format` / concatenated name "
+        "mints a fresh annotation per distinct value — a retrace "
+        "hazard inside jitted code (the traced program embeds the "
+        "name) and an aggregation-shredder in XProf (the scope map in "
+        "docs/OBSERVABILITY.md relies on stable names).  Use a "
+        "string literal (or a module-level constant)."
+    )
+
+    def check(self, mod: ModuleInfo):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = mod.resolve(node.func)
+            leaf = name.rsplit(".", 1)[-1] if name else ""
+            if leaf != "named_scope":
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.JoinedStr):
+                kind = "f-string"
+            elif isinstance(arg, ast.Call) and isinstance(
+                arg.func, ast.Attribute
+            ) and arg.func.attr == "format":
+                kind = "str.format"
+            elif isinstance(arg, ast.BinOp) and isinstance(
+                arg.op, (ast.Add, ast.Mod)
+            ):
+                kind = "concatenated/interpolated string"
+            else:
+                # Literals and bare names (module constants) are
+                # stable; only syntactically-dynamic names flag.
+                continue
+            yield mod.finding(
+                self.id, node,
+                f"`named_scope` name is a {kind} — each distinct "
+                "value is a fresh trace annotation (retrace hazard); "
+                "use a literal",
+            )
 
 
 # ---------------------------------------------------------------------------

@@ -663,6 +663,124 @@ def _cmd_nsga2(args) -> int:
     return 0
 
 
+def _cmd_scope_summary(args) -> int:
+    """``swarmscope summary RUN``: one human-readable roll-up of a
+    run directory (manifest, metric counts, failures, telemetry
+    highlights, compile observatory state)."""
+    from .utils import rundir
+
+    run = rundir.load_run(args.run)
+    man = run.manifest
+    print(f"run {run.label}  ({run.path})")
+    if man:
+        print(
+            f"  created {man.get('created', '?')}  backend "
+            f"{man.get('backend', '?')}"
+        )
+        if man.get("mesh"):
+            print(f"  mesh {man['mesh']}")
+    print(f"  metrics: {len(run.metrics)}"
+          + (f"  FAILURES: {len(run.failures)}" if run.failures else ""))
+    for obj in run.failures:
+        print(f"    failed: {obj.get('metric')}  "
+              f"({obj.get('error', '?')})")
+    for tag, summ in sorted(run.telemetry.items()):
+        print(
+            f"  telemetry [{tag}]: ticks {summ.get('ticks')}, "
+            f"rebuilds/100t {summ.get('rebuilds_per_100_ticks')}, "
+            f"truncation {summ.get('truncation_events')}, "
+            f"first nonfinite {summ.get('first_nonfinite_step')}, "
+            f"shard imbalance {summ.get('shard_imbalance_max')}"
+        )
+    if run.events:
+        kinds: dict = {}
+        for ev in run.events:
+            kinds[ev.get("event", "?")] = kinds.get(
+                ev.get("event", "?"), 0
+            ) + 1
+        print("  events: " + ", ".join(
+            f"{k} x{v}" for k, v in sorted(kinds.items())
+        ))
+    for entry, agg in sorted(run.compile_entries.items()):
+        print(
+            f"  compiles [{entry}]: {agg['compiles']} "
+            f"({agg['wall_s']:.1f}s wall)"
+        )
+    storms = [
+        e for e in run.compile_events
+        if e.get("event") == "retrace-storm"
+    ]
+    for ev in storms:
+        print(
+            f"  RETRACE STORM: {ev.get('entry')} compiled "
+            f"{ev.get('compiles')} signatures"
+        )
+    return 0
+
+
+def _cmd_scope_diff(args) -> int:
+    """``swarmscope diff A B``: metric-by-metric comparison with the
+    union gate's semantics — exit 1 naming the regressed fixed-name
+    rows when any gated metric regresses, 0 otherwise."""
+    from .utils import rundir
+
+    a = rundir.load_run(args.a)
+    b = rundir.load_run(args.b)
+    out = rundir.diff_runs(a, b, threshold=args.threshold)
+    for row in out["rows"]:
+        if row["unit"] == "pct":
+            detail = (f"{row['prev']:.2f}% -> {row['cur']:.2f}% "
+                      f"(ceiling {rundir.PCT_CEILING:.0f}%)")
+        elif row["prev"] > 0:
+            detail = (f"{row['prev']:.3g} -> {row['cur']:.3g} "
+                      f"({row['cur'] / row['prev']:.2f}x)")
+        else:
+            detail = f"{row['prev']:.3g} -> {row['cur']:.3g}"
+        print(f"{row['status']:>10}  {row['metric']}  {detail}")
+    for name in out["only_a"]:
+        print(f"{'dropped':>10}  {name}")
+    for name in out["only_b"]:
+        print(f"{'new':>10}  {name}")
+    if out["regressions"]:
+        print(
+            f"\n{len(out['regressions'])} gated regression(s) "
+            f"({a.label} -> {b.label}):",
+            file=sys.stderr,
+        )
+        for name in out["regressions"]:
+            print(f"  REGRESSION  {name}", file=sys.stderr)
+        return 1
+    print(f"\nno gated regressions ({a.label} -> {b.label})")
+    return 0
+
+
+def _cmd_scope_history(args) -> int:
+    """``swarmscope history METRIC``: the fixed-name row's trajectory
+    across every recorded round of BENCH_HISTORY.json."""
+    from pathlib import Path
+
+    from .utils import rundir
+
+    path = args.file
+    if path is None:
+        path = str(
+            Path(__file__).resolve().parent.parent / "BENCH_HISTORY.json"
+        )
+    rows = rundir.history_rows(args.metric, path)
+    if not rows:
+        print(f"no rounds record a metric matching {args.metric!r}",
+              file=sys.stderr)
+        return 1
+    prev = None
+    for label, value, unit in rows:
+        delta = ""
+        if prev not in (None, 0.0):
+            delta = f"  ({(value - prev) / prev:+.1%})"
+        print(f"{label:>6}  {value:>14.4g} {unit}{delta}")
+        prev = value
+    return 0
+
+
 def _cmd_bench(args) -> int:
     # bench.py lives at the repo root (a driver contract), outside the
     # package — resolve it relative to this file so the subcommand works
@@ -943,6 +1061,35 @@ def build_parser() -> argparse.ArgumentParser:
     p_bench = sub.add_parser("bench", help="headline benchmark")
     p_bench.set_defaults(fn=_cmd_bench)
 
+    p_scope = sub.add_parser(
+        "swarmscope",
+        help="inspect benchmark run directories (r11; see "
+             "docs/OBSERVABILITY.md)",
+    )
+    scope_sub = p_scope.add_subparsers(dest="scope_cmd")
+    p_ss = scope_sub.add_parser(
+        "summary", help="summarize one run directory"
+    )
+    p_ss.add_argument("run", help="run directory (runs/<label>)")
+    p_ss.set_defaults(fn=_cmd_scope_summary)
+    p_sd = scope_sub.add_parser(
+        "diff",
+        help="diff two run directories metric-by-metric; exit 1 "
+             "naming the regressed rows when a gated metric regresses",
+    )
+    p_sd.add_argument("a", help="baseline run directory")
+    p_sd.add_argument("b", help="candidate run directory")
+    p_sd.add_argument("--threshold", type=float, default=0.2)
+    p_sd.set_defaults(fn=_cmd_scope_diff)
+    p_sh = scope_sub.add_parser(
+        "history",
+        help="print a fixed-name row's BENCH_HISTORY trajectory",
+    )
+    p_sh.add_argument("metric", help="metric name (exact or substring)")
+    p_sh.add_argument("--file", default=None,
+                      help="history JSON (default: repo BENCH_HISTORY)")
+    p_sh.set_defaults(fn=_cmd_scope_history)
+
     # Convergence-history flags for every single-objective optimizer
     # subcommand (utils/history.py; see _run_report).
     for name in (
@@ -971,7 +1118,7 @@ def main(argv=None) -> int:
         return 2
     try:
         return args.fn(args)
-    except (KeyError, ValueError, RuntimeError) as e:
+    except (KeyError, ValueError, RuntimeError, FileNotFoundError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
 

@@ -38,6 +38,7 @@ from ..state import (
     sort_agents_by_key,
     with_tasks,
 )
+from ..utils.compile_watch import watched
 from ..utils.config import DEFAULT_CONFIG, TELEMETRY_ON, SwarmConfig
 from ._checkpoint import CheckpointMixin
 
@@ -123,6 +124,7 @@ def _protocol_steps(
     return state
 
 
+@watched("swarm-tick")
 @partial(
     jax.jit, static_argnames=("cfg", "sort_in_tick", "telemetry")
 )
@@ -187,6 +189,7 @@ def swarm_tick(
     )
 
 
+@watched("swarm-rollout")
 @partial(
     jax.jit,
     static_argnames=(

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from flax import struct
 
 from . import neighbors as _neighbors
+from ..utils.compile_watch import watched
 
 
 @struct.dataclass
@@ -796,6 +797,7 @@ def boids_step_gridmean(
     return (state, acc) if return_acc else state
 
 
+@watched("boids-run")
 @partial(
     jax.jit,
     static_argnames=(

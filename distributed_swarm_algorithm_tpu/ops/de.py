@@ -25,6 +25,8 @@ from typing import Callable, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from ..utils.compile_watch import watched
 from flax import struct
 
 # Classic defaults (Storn & Price).
@@ -149,6 +151,7 @@ def de_step(
     )
 
 
+@watched("de-run")
 @partial(
     jax.jit,
     static_argnames=("objective", "n_steps", "f", "cr", "half_width",

@@ -31,6 +31,8 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+
+from ..utils.compile_watch import watched
 from flax import struct
 
 SIGMA = 0.1          # perturbation scale, in half_width units
@@ -136,6 +138,7 @@ def es_step(
     )
 
 
+@watched("es-run")
 @partial(
     jax.jit,
     static_argnames=(

@@ -20,6 +20,8 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+
+from ..utils.compile_watch import watched
 from flax import struct
 
 
@@ -101,6 +103,7 @@ def gwo_step(
     )
 
 
+@watched("gwo-run")
 @partial(
     jax.jit,
     static_argnames=("objective", "n_steps", "half_width", "t_max"),

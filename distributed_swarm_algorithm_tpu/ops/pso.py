@@ -20,6 +20,8 @@ from typing import Callable, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from ..utils.compile_watch import watched
 from flax import struct
 
 from . import topology as _topo
@@ -143,6 +145,7 @@ def pso_step(
     )
 
 
+@watched("pso-run")
 @partial(
     jax.jit,
     static_argnames=("objective", "n_steps", "w", "c1", "c2", "half_width",

@@ -47,6 +47,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.es import ESState, LR, MOMENTUM, SIGMA, centered_ranks
 from ..utils.compat import shard_map
+from ..utils.compile_watch import watched
 from ..ops.pso import C1, C2, PSOState, W
 
 DIM_AXIS = "dim"
@@ -168,11 +169,12 @@ def shard_es_dim(
 
 # ---------------------------------------------------------------- drivers
 
+@watched("pso-dimshard")
 @partial(
     jax.jit,
     static_argnames=(
         "objective_name", "mesh", "n_steps", "axis", "w", "c1", "c2",
-        "half_width", "vmax_frac",
+        "half_width", "vmax_frac", "telemetry",
     ),
 )
 def pso_run_dimshard(
@@ -186,13 +188,24 @@ def pso_run_dimshard(
     c2: float = C2,
     half_width: float = 5.12,
     vmax_frac: float = 0.5,
-) -> PSOState:
+    telemetry: bool = False,
+):
     """``n_steps`` of gbest PSO with the DIMENSION axis sharded.
 
     Same update rule as ``ops.pso.pso_step`` (trajectories differ only
     in RNG stream: each device draws its own [N, D_loc] uniforms from a
     device-folded key).  Communication per step: one ``psum`` of
     ``[P, N]`` objective partials — O(N) bytes regardless of D.
+
+    ``telemetry=True`` (r11, static gate): per-step flight-recorder
+    records ride the scan and the return becomes ``(state, telem)``.
+    Speed gauges need the cross-shard norm, so the recorder adds one
+    ``psum`` of per-particle squared partials per step — collection
+    only READS the carried values, so the trajectory stays
+    bitwise-equal (tests/test_mesh_telemetry.py); disabled, the trace
+    is the identical telemetry-free HLO (trace-time Python gate).
+    ``shard_max_alive``/``shard_imbalance`` report the per-device
+    D-shard residency via ``lax.pmax``/``lax.pmin``.
     """
     local, combine = PARTIAL_OBJECTIVES[objective_name]
     n, d = state.pos.shape
@@ -205,23 +218,25 @@ def pso_run_dimshard(
     d_loc = d // n_dev
     vmax = half_width * vmax_frac
 
+    carry_spec = (
+        P(None, axis), P(None, axis), P(None, axis), P(),
+        P(axis), P(), P(),
+    )
+    out_spec = (carry_spec, P()) if telemetry else carry_spec
+
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(
-            P(None, axis), P(None, axis), P(None, axis), P(),
-            P(axis), P(), P(),
-        ),
-        out_specs=(
-            P(None, axis), P(None, axis), P(None, axis), P(),
-            P(axis), P(), P(),
-        ),
+        in_specs=carry_spec,
+        out_specs=out_spec,
         check_vma=False,
     )
     def run(pos, vel, bpos, bfit, gpos, gfit, key):
         dev = lax.axis_index(axis)
 
-        def step(carry, _):
+        def step(carry, it):
+            # ``it`` is the step index (scan xs), threaded ONLY when
+            # the recorder is on — the disabled carry/HLO is untouched.
             pos, vel, bpos, bfit, gpos, gfit, key = carry
             key, k1, k2 = jax.random.split(key, 3)
             r1 = jax.random.uniform(
@@ -250,30 +265,91 @@ def pso_run_dimshard(
             better = bfit[b] < gfit
             gfit = jnp.where(better, bfit[b], gfit)
             gpos = jnp.where(better, bpos[b], gpos)
-            return (pos, vel, bpos, bfit, gpos, gfit, key), None
+            telem = None
+            if telemetry:  # static TelemetryConfig-style gate
+                telem = _dimshard_tick_telemetry(
+                    it, pos, vel, fit, bfit, d_loc, axis
+                )
+            return (pos, vel, bpos, bfit, gpos, gfit, key), telem
 
-        carry, _ = lax.scan(
-            step, (pos, vel, bpos, bfit, gpos, gfit, key), None,
+        xs = (
+            jnp.arange(1, n_steps + 1, dtype=jnp.int32)
+            if telemetry else None
+        )
+        carry, telem = lax.scan(
+            step, (pos, vel, bpos, bfit, gpos, gfit, key), xs,
             length=n_steps,
         )
+        if telemetry:
+            return carry, telem
         return carry
 
-    pos, vel, bpos, bfit, gpos, gfit, key = run(
+    out = run(
         state.pos, state.vel, state.pbest_pos, state.pbest_fit,
         state.gbest_pos, state.gbest_fit, state.key,
     )
-    return PSOState(
+    (pos, vel, bpos, bfit, gpos, gfit, key), telem = (
+        out if telemetry else (out, None)
+    )
+    new = PSOState(
         pos=pos, vel=vel, pbest_pos=bpos, pbest_fit=bfit,
         gbest_pos=gpos, gbest_fit=gfit, key=key,
         iteration=state.iteration + n_steps,
     )
+    if telemetry:
+        return new, telem
+    return new
 
 
+def _dimshard_tick_telemetry(
+    it, pos, vel, fit, bfit, d_loc, axis, population=None
+):
+    """Per-step record inside a dim-sharded body: the speed gauges
+    reduce per-particle squared partials over the named axis (one
+    extra ``psum`` per step); the residency pair reports the local
+    D-shard width via ``pmax``/``pmin``.  ``leader_id`` carries the
+    incumbent-best particle index (replicated arithmetic — identical
+    on every shard)."""
+    from ..utils.telemetry import optimizer_tick_telemetry
+
+    n = pos.shape[0] if population is None else population
+    speed = jnp.sqrt(
+        lax.psum(jnp.sum(vel * vel, axis=1), axis)
+    )                                                    # [n] global
+    finite_local = jnp.all(jnp.isfinite(pos)) & jnp.all(
+        jnp.isfinite(vel)
+    )
+    # Packed-reduction rule (utils/telemetry.py): the speed psum
+    # above plus ONE pmax pack — nonfinite flag, shard width, and the
+    # negated width (pmin via pmax) ride together.
+    width = jnp.asarray(d_loc, jnp.float32)
+    flags = lax.pmax(
+        jnp.stack(
+            [(~finite_local).astype(jnp.float32), width, -width]
+        ),
+        axis,
+    )
+    nonfinite = (flags[0] > 0.0) | ~jnp.all(jnp.isfinite(fit))
+    hi = flags[1].astype(jnp.int32)
+    lo = (-flags[2]).astype(jnp.int32)
+    return optimizer_tick_telemetry(
+        it,
+        n,
+        speed_max=jnp.max(speed),
+        speed_mean=jnp.mean(speed),
+        nonfinite=nonfinite,
+        best_shard=jnp.argmin(bfit),
+        shard_max=hi,
+        shard_imbalance=hi - lo,
+    )
+
+
+@watched("es-dimshard")
 @partial(
     jax.jit,
     static_argnames=(
         "objective_name", "mesh", "n_steps", "n", "axis", "half_width",
-        "sigma", "lr", "momentum",
+        "sigma", "lr", "momentum", "telemetry",
     ),
 )
 def es_run_dimshard(
@@ -287,7 +363,8 @@ def es_run_dimshard(
     sigma: float = SIGMA,
     lr: float = LR,
     momentum: float = MOMENTUM,
-) -> ESState:
+    telemetry: bool = False,
+):
     """OpenAI-ES with the PARAMETER axis sharded — proper tensor
     parallelism for neuroevolution-scale D.
 
@@ -299,6 +376,12 @@ def es_run_dimshard(
     ranks are then replicated arithmetic).  Complements
     ``parallel.sharding.es_run_shmap``, which shards the *population*
     axis instead — compose them on a 2-D mesh for both scales at once.
+
+    ``telemetry=True`` (r11, static gate): returns ``(state, telem)``
+    with per-generation records — ``speed_*`` gauges the momentum
+    norm (one extra ``psum`` of the local squared partial), the
+    residency pair the per-device D-shard width.  Same contract as
+    ``pso_run_dimshard``.
     """
     local, combine = PARTIAL_OBJECTIVES[objective_name]
     d = state.mean.shape[0]
@@ -313,17 +396,20 @@ def es_run_dimshard(
     d_loc = d // n_dev
     s = sigma * half_width
 
+    carry_spec = (P(axis), P(axis), P(axis), P(), P())
+    out_spec = (carry_spec, P()) if telemetry else carry_spec
+
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(), P()),
-        out_specs=(P(axis), P(axis), P(axis), P(), P()),
+        in_specs=carry_spec,
+        out_specs=out_spec,
         check_vma=False,
     )
     def run(mean, mom, best_pos, best_fit, key):
         dev = lax.axis_index(axis)
 
-        def step(carry, _):
+        def step(carry, it):
             mean, mom, best_pos, best_fit, key = carry
             key, kd = jax.random.split(key)
             eps_half = jax.random.normal(
@@ -350,18 +436,36 @@ def es_run_dimshard(
             better_mean = mean_fit < best_fit
             best_fit = jnp.where(better_mean, mean_fit, best_fit)
             best_pos = jnp.where(better_mean, mean, best_pos)
-            return (mean, mom, best_pos, best_fit, key), None
+            telem = None
+            if telemetry:  # static TelemetryConfig-style gate
+                telem = _dimshard_tick_telemetry(
+                    it, mean[None, :], mom[None, :], fit, fit,
+                    d_loc, axis, population=n,
+                )
+            return (mean, mom, best_pos, best_fit, key), telem
 
-        carry, _ = lax.scan(
-            step, (mean, mom, best_pos, best_fit, key), None,
+        xs = (
+            jnp.arange(1, n_steps + 1, dtype=jnp.int32)
+            if telemetry else None
+        )
+        carry, telem = lax.scan(
+            step, (mean, mom, best_pos, best_fit, key), xs,
             length=n_steps,
         )
+        if telemetry:
+            return carry, telem
         return carry
 
-    mean, mom, best_pos, best_fit, key = run(
+    out = run(
         state.mean, state.mom, state.best_pos, state.best_fit, state.key
     )
-    return ESState(
+    (mean, mom, best_pos, best_fit, key), telem = (
+        out if telemetry else (out, None)
+    )
+    new = ESState(
         mean=mean, mom=mom, best_pos=best_pos, best_fit=best_fit,
         key=key, iteration=state.iteration + n_steps,
     )
+    if telemetry:
+        return new, telem
+    return new

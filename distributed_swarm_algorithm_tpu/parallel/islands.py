@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from flax import struct
 
 from ..ops import pso as _pso
+from ..utils.compile_watch import watched
 from .mesh import ISLAND_AXIS  # noqa: F401  (canonical axis name)
 
 
@@ -122,11 +123,12 @@ def migrate(state: IslandPSOState, k: int) -> IslandPSOState:
     )
 
 
+@watched("island-run")
 @partial(
     jax.jit,
     static_argnames=(
         "objective", "n_steps", "migrate_every", "migrate_k", "w", "c1",
-        "c2", "half_width", "vmax_frac",
+        "c2", "half_width", "vmax_frac", "telemetry",
     ),
 )
 def island_run(
@@ -140,8 +142,21 @@ def island_run(
     c2: float = _pso.C2,
     half_width: float = 5.12,
     vmax_frac: float = 0.5,
-) -> IslandPSOState:
-    """Run all islands in lockstep under one scan, migrating periodically."""
+    telemetry: bool = False,
+):
+    """Run all islands in lockstep under one scan, migrating periodically.
+
+    ``telemetry=True`` (r11, static — the same trace-time gate shape as
+    the r10 rollout recorder, so the disabled trace is the identical
+    telemetry-free HLO) stacks one ``utils/telemetry.TickTelemetry``
+    per iteration as scan ys and returns ``(state, telem)``:
+    ``leader_id`` is the island holding the global best, ``speed_*``
+    the particle-velocity gauges, ``shard_max_alive`` the per-island
+    population.  Under GSPMD with the island axis sharded the
+    cross-island reductions lower to ICI collectives; collection only
+    READS the carried state, so the trajectory is bitwise-equal either
+    way (tests/test_mesh_telemetry.py).
+    """
 
     step_one = partial(
         _pso.pso_step, objective=objective, w=w, c1=c1, c2=c2,
@@ -157,9 +172,16 @@ def island_run(
             lambda s: s,
             st,
         )
-        return st, None
+        telem = None
+        if telemetry:  # static TelemetryConfig-style gate
+            from ..utils.telemetry import island_tick_telemetry
 
-    state, _ = jax.lax.scan(body, state, None, length=n_steps)
+            telem = island_tick_telemetry(st.pso, st.iteration)
+        return st, telem
+
+    state, telem = jax.lax.scan(body, state, None, length=n_steps)
+    if telemetry:
+        return state, telem
     return state
 
 

@@ -68,6 +68,42 @@ def coord_print(*args, **kwargs) -> None:
         builtins.print(*args, **kwargs)
 
 
+def describe_mesh(mesh: Mesh) -> dict:
+    """JSON-safe mesh topology for run manifests (r11, the
+    ``swarmscope`` run directory): axis names/sizes, device platform,
+    and the process (host) count — the context a telemetry summary or
+    compile record is meaningless without on a pod.  Pure metadata:
+    no collective, no device sync."""
+    devices = list(mesh.devices.flat)
+    return {
+        "axes": {
+            name: int(size)
+            for name, size in zip(mesh.axis_names, mesh.devices.shape)
+        },
+        "n_devices": len(devices),
+        "platform": devices[0].platform if devices else "none",
+        "n_processes": len({d.process_index for d in devices}),
+    }
+
+
+def coord_write_json(path: str, obj) -> bool:
+    """Write ``obj`` as JSON at ``path`` on the COORDINATOR process
+    only — the multi-host guard for every run-directory artifact
+    (manifest, telemetry summary, compile records): without it each
+    host of a pod would race the same file.  Returns True iff this
+    process wrote.  Creates parent directories."""
+    if not is_coordinator():
+        return False
+    import json
+    import os
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as fh:
+        json.dump(obj, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return True
+
+
 def hybrid_mesh(
     islands_per_host: int = 1,
     devices: Optional[Sequence] = None,

@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops import pso as _pso
 from ..state import NO_LEADER, SwarmState
 from ..utils.compat import shard_map
+from ..utils.compile_watch import watched
 from .mesh import AGENT_AXIS
 
 _BIG_I32 = jnp.iinfo(jnp.int32).max
@@ -113,11 +114,20 @@ def pso_step_shmap(
     c2: float = _pso.C2,
     half_width: float = 5.12,
     vmax_frac: float = 0.5,
-) -> _pso.PSOState:
+    telemetry: bool = False,
+):
     """One PSO step with the cross-device gbest reduction written as
     explicit collectives: local argmin → ``lax.pmin`` for the value →
     min-device-index tie-break → ``lax.psum`` to broadcast the winning
-    position.  Semantically identical to the GSPMD path."""
+    position.  Semantically identical to the GSPMD path.
+
+    ``telemetry=True`` (r11, static gate): returns ``(state, telem)``
+    — one ``utils/telemetry.TickTelemetry`` reduced over the mesh
+    axis with the same collective classes as the step itself
+    (``psum`` counts, ``pmax`` gauges); ``leader_id`` is the device
+    index holding the incumbent global best, the residency pair the
+    per-shard particle counts.  Collection only READS step outputs,
+    so the carried state is bitwise-identical either way."""
 
     shard = P(axis)
     spec = _pso.PSOState(
@@ -126,10 +136,11 @@ def pso_step_shmap(
     )
 
     @partial(
-        shard_map, mesh=mesh, in_specs=(spec,), out_specs=spec,
+        shard_map, mesh=mesh, in_specs=(spec,),
+        out_specs=(spec, P()) if telemetry else spec,
         check_vma=False,
     )
-    def step(s: _pso.PSOState) -> _pso.PSOState:
+    def step(s: _pso.PSOState):
         # Per-device keys: fold in the device index so shards draw
         # independent randomness from one replicated key.
         dev = lax.axis_index(axis)
@@ -165,20 +176,52 @@ def pso_step_shmap(
         # Keep the carried key replicated (every shard advances the same
         # base key; shards re-diversify via fold_in above).
         base_key, _ = jax.random.split(s.key)
-        return _pso.PSOState(
+        out = _pso.PSOState(
             pos=pos, vel=vel, pbest_pos=pbest_pos, pbest_fit=pbest_fit,
             gbest_pos=gbest_pos, gbest_fit=gbest_fit, key=base_key,
             iteration=s.iteration + 1,
         )
+        if telemetry:  # static TelemetryConfig-style gate
+            from ..utils.telemetry import (
+                mesh_reduce_telemetry,
+                optimizer_tick_telemetry,
+            )
+
+            n_loc = jnp.asarray(pos.shape[0], jnp.int32)
+            speed = jnp.linalg.norm(vel, axis=-1)
+            finite = (
+                jnp.all(jnp.isfinite(pos))
+                & jnp.all(jnp.isfinite(vel))
+                & jnp.all(jnp.isfinite(fit))
+            )
+            holder = lax.pmin(
+                jnp.where(loc_fit == gbest_fit, dev, _BIG_I32), axis
+            )
+            local = optimizer_tick_telemetry(
+                out.iteration,
+                n_loc,
+                speed_max=jnp.max(speed),
+                speed_mean=jnp.mean(speed),
+                nonfinite=~finite,
+                best_shard=jnp.where(
+                    holder == _BIG_I32, NO_LEADER, holder
+                ),
+                shard_max=n_loc,
+            )
+            # The reducer's pmin/pmax over per-shard counts fills the
+            # residency pair; best_shard/nonfinite are replicated.
+            return out, mesh_reduce_telemetry(local, axis)
+        return out
 
     return step(state)
 
 
+@watched("pso-shmap")
 @partial(
     jax.jit,
     static_argnames=(
         "objective", "mesh", "n_steps", "axis", "w", "c1", "c2",
-        "half_width", "vmax_frac",
+        "half_width", "vmax_frac", "telemetry",
     ),
 )
 def pso_run_shmap(
@@ -192,21 +235,29 @@ def pso_run_shmap(
     c2: float = _pso.C2,
     half_width: float = 5.12,
     vmax_frac: float = 0.5,
-) -> _pso.PSOState:
+    telemetry: bool = False,
+):
     """``n_steps`` explicit-collective PSO steps under one ``lax.scan`` —
     one dispatch for the whole rollout (important on oversubscribed hosts:
     CPU-backend collective rendezvous is time-limited, so per-step Python
-    dispatch of 8-way collectives is avoidable flake surface)."""
+    dispatch of 8-way collectives is avoidable flake surface).
+
+    ``telemetry=True`` (r11, static gate): the per-step mesh-reduced
+    records ride the scan as stacked ys and the return becomes
+    ``(state, telem)`` — see ``pso_step_shmap``."""
 
     def body(s, _):
-        return (
-            pso_step_shmap(
-                s, objective, mesh, axis, w, c1, c2, half_width, vmax_frac
-            ),
-            None,
+        out = pso_step_shmap(
+            s, objective, mesh, axis, w, c1, c2, half_width, vmax_frac,
+            telemetry=telemetry,
         )
+        if telemetry:
+            return out
+        return out, None
 
-    state, _ = jax.lax.scan(body, state, None, length=n_steps)
+    state, telem = jax.lax.scan(body, state, None, length=n_steps)
+    if telemetry:
+        return state, telem
     return state
 
 
@@ -944,20 +995,99 @@ def elect_shmap(
     agent_id: jax.Array,
     mesh: Mesh,
     axis: str = AGENT_AXIS,
-) -> jax.Array:
+    telemetry: bool = False,
+):
     """Bully-election fixed point as an explicit cross-device reduction:
     leader = max alive id (agent.py:244-251 collapsed to one ``lax.pmax``).
-    Returns the replicated winning id (NO_LEADER if none alive)."""
+    Returns the replicated winning id (NO_LEADER if none alive).
+
+    ``telemetry=True`` (r11, static gate): returns ``(leader_id,
+    telem)`` where ``telem`` is one mesh-reduced
+    ``utils/telemetry.TickTelemetry`` — global alive count (``psum``),
+    the elected leader, and the per-device residency pair
+    (``pmax``/``pmin`` of per-shard alive counts): the live-agent
+    imbalance counter for an agent-sharded swarm."""
 
     @partial(
-        shard_map, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=P(),
+        shard_map, mesh=mesh, in_specs=(P(axis), P(axis)),
+        out_specs=(P(), P()) if telemetry else P(),
         check_vma=False,
     )
     def elect(alive_l, id_l):
         local = jnp.max(jnp.where(alive_l, id_l, NO_LEADER))
-        return lax.pmax(local, axis)[None]
+        leader = lax.pmax(local, axis)[None]
+        if not telemetry:  # static TelemetryConfig-style gate
+            return leader
+        from ..utils.telemetry import (
+            mesh_reduce_telemetry,
+            tick_telemetry,
+        )
 
-    return elect(alive, agent_id)[0]
+        # Position/velocity are not the election's business: a zero
+        # [n_loc, 1] placeholder keeps the gauges neutral while the
+        # alive mask drives the counts the reducer turns into the
+        # global total and the per-shard residency pair.
+        zeros = jnp.zeros((alive_l.shape[0], 1), jnp.float32)
+        local_rec = tick_telemetry(
+            zeros, zeros, alive_l, 0, leader_id=leader[0]
+        )
+        return leader, mesh_reduce_telemetry(local_rec, axis)
+
+    out = elect(alive, agent_id)
+    if telemetry:
+        leader, rec = out
+        return leader[0], rec
+    return out[0]
+
+
+def swarm_telemetry_shmap(
+    state: SwarmState,
+    mesh: Mesh,
+    axis: str = AGENT_AXIS,
+):
+    """One mesh-reduced ``utils/telemetry.TickTelemetry`` from an
+    agent-sharded ``SwarmState`` — the sharded flight recorder's
+    one-shot form (r11).
+
+    The in-rollout recorder already runs under GSPMD (the partitioned
+    ``jnp`` reductions in ``tick_telemetry`` lower to collectives when
+    the state is sharded), but GSPMD cannot express PER-DEVICE
+    quantities — a partitioned ``sum`` is the global sum by
+    construction.  This collector drops to ``shard_map``, collects the
+    same record per shard, and reduces with named-axis collectives
+    (``mesh_reduce_telemetry``), which is exactly what fills
+    ``shard_max_alive``/``shard_imbalance``: the live-agent residency
+    spread an imbalanced kill pattern creates across devices.  Pure
+    read-only — safe to call on any sharded state at any cadence."""
+    shard = P(axis)
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(shard, shard, shard, shard, shard, P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def collect(pos, vel, alive, fsm, agent_id, tick):
+        from ..state import LEADER as _LEADER
+        from ..state import ELECTION_WAIT as _EW
+        from ..utils.telemetry import (
+            mesh_reduce_telemetry,
+            tick_telemetry,
+        )
+
+        mask = alive & (fsm == _LEADER)
+        lid = jnp.max(jnp.where(mask, agent_id, NO_LEADER))
+        electing = jnp.sum(alive & (fsm == _EW))
+        local = tick_telemetry(
+            pos, vel, alive, tick,
+            leader_id=lax.pmax(lid, axis), electing=electing,
+        )
+        return mesh_reduce_telemetry(local, axis)
+
+    return collect(
+        state.pos, state.vel, state.alive, state.fsm, state.agent_id,
+        state.tick,
+    )
 
 
 # --------------------------------------------------------------------------

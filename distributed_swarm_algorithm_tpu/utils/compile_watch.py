@@ -1,0 +1,379 @@
+"""Compile/retrace observatory — runtime telemetry for the compile plane.
+
+The flight recorder (r10, ``utils/telemetry.py``) watches the *data*
+plane; nothing watched the *compile* plane, and that is the plane
+population-batched JAX stepping lives or dies by: Fast Population-Based
+RL (arxiv 2206.08888) identifies compilation cost and retrace storms as
+the dominant failure mode, and swarmlint's ``retrace`` rule can only
+catch the static shapes of the hazard (jit-in-a-loop), not the runtime
+one (one jitted entry fed a stream of distinct arg signatures — the
+exact thing scenario shape-bucketing exists to prevent).
+
+This module wraps the repo's jitted entry points (rollout, boids twin,
+parallel drivers, optimizer zoo) in a registry that, when enabled,
+records per cache entry:
+
+- the **arg signature** (shape/dtype of every array leaf + repr of
+  every static),
+- the **compile count** per entry (distinct signatures seen),
+- **first-call wall time** for each signature (trace + compile + first
+  execution — the user-visible latency of a cache miss),
+- ``jit(...).lower(...).cost_analysis()`` **flops / bytes accessed**
+  (measured ~1.6 s at the 65k rollout on CPU — no backend compile
+  needed, so the analysis itself cannot trigger the storm it reports),
+
+and fires a structured **retrace-storm event** (plus one
+``RetraceStormWarning``) when one entry compiles under
+``storm_threshold`` distinct signatures.
+
+Contract mirrors the r10 recorder: **disabled (the default) is free**
+— the wrapper forwards after one attribute check, no signature is
+computed, and the wrapped callable is the same jitted function with the
+same cache.  Enable with :func:`enable` or ``DSA_COMPILE_WATCH=1``.
+With ``DSA_RUN_DIR`` set, the records are dumped to
+``$DSA_RUN_DIR/compile/<proc>.json`` at exit — the compile half of the
+``swarmscope`` run directory (docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import sys
+import time
+import warnings
+from dataclasses import asdict, dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+#: Distinct-signature count at which one entry's compiles are declared
+#: a retrace storm.  Override per-watch or via the environment.
+DEFAULT_STORM_THRESHOLD = int(
+    os.environ.get("DSA_RETRACE_STORM_THRESHOLD", "5")
+)
+
+
+class RetraceStormWarning(UserWarning):
+    """One jitted entry is recompiling under many distinct signatures."""
+
+
+@dataclass
+class CompileRecord:
+    """One (entry, signature) cache entry's observed compile."""
+
+    entry: str               # registry name of the jitted entry point
+    signature: str           # arg shapes/dtypes + statics
+    seq: int                 # 1-based distinct-signature index;
+    #                          0 = analyze()-only record (no compile)
+    wall_s: Optional[float] = None   # first-call latency (None: analyze())
+    flops: Optional[float] = None          # cost_analysis "flops"
+    bytes_accessed: Optional[float] = None  # cost_analysis "bytes accessed"
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+def _leaf_sig(leaf: Any) -> str:
+    """One leaf's contribution to the cache-key approximation: arrays
+    by shape/dtype (jit's abstraction), everything else by repr (jit
+    keys statics by equality; repr is the observable proxy)."""
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{dtype}[{','.join(map(str, shape))}]"
+    r = repr(leaf)
+    return r if len(r) <= 120 else r[:117] + "..."
+
+
+def arg_signature(args: tuple, kwargs: dict) -> str:
+    """Approximate jit cache key for a call: stable across calls with
+    the same tree structure, leaf shapes/dtypes, and statics."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    return f"{treedef}|" + ";".join(_leaf_sig(x) for x in leaves)
+
+
+def _has_tracer(args: tuple, kwargs: dict) -> bool:
+    """True when the call is itself inside a jax transform (the
+    wrapped entry is being inlined, not dispatched) — nothing compiles
+    at this boundary, so nothing should be recorded."""
+    import jax
+
+    return any(
+        isinstance(x, jax.core.Tracer)
+        for x in jax.tree_util.tree_leaves((args, kwargs))
+    )
+
+
+def _cost_analysis(lowered) -> tuple:
+    """(flops, bytes) from a ``Lowered``; (None, None) when the
+    backend offers no analysis."""
+    try:
+        ca = lowered.cost_analysis()
+    except Exception:
+        return None, None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return None, None
+    flops = ca.get("flops")
+    nbytes = ca.get("bytes accessed")
+    return (
+        float(flops) if flops is not None else None,
+        float(nbytes) if nbytes is not None else None,
+    )
+
+
+class WatchedFunction:
+    """A jitted entry point under observation.
+
+    Callable exactly like the wrapped function; unknown attributes
+    (``.lower``, ``.__name__``, ...) delegate to it, so AOT callers
+    and introspection keep working.  All bookkeeping happens only when
+    the owning :class:`CompileWatch` is enabled AND the call is an
+    actual dispatch (not an inlining under an outer trace).
+    """
+
+    def __init__(self, watch: "CompileWatch", entry: str, fn: Callable):
+        self._watch = watch
+        self.entry = entry
+        self.__wrapped__ = fn
+        try:
+            self.__name__ = fn.__name__
+            self.__doc__ = fn.__doc__
+        except AttributeError:
+            pass
+
+    def __call__(self, *args, **kwargs):
+        watch = self._watch
+        if not watch.enabled or _has_tracer(args, kwargs):
+            return self.__wrapped__(*args, **kwargs)
+        sig = arg_signature(args, kwargs)
+        if watch.seen(self.entry, sig):
+            return self.__wrapped__(*args, **kwargs)
+        start = time.perf_counter()
+        out = self.__wrapped__(*args, **kwargs)
+        wall = time.perf_counter() - start
+        flops = nbytes = None
+        if watch.cost_analysis:
+            try:
+                flops, nbytes = _cost_analysis(
+                    self.__wrapped__.lower(*args, **kwargs)
+                )
+            except Exception:
+                pass
+        watch.record(self.entry, sig, wall_s=wall, flops=flops,
+                     bytes_accessed=nbytes)
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self.__wrapped__, name)
+
+    def __repr__(self):
+        return f"WatchedFunction({self.entry!r}, {self.__wrapped__!r})"
+
+
+class CompileWatch:
+    """The registry: entry name -> signatures seen -> records.
+
+    One process-global instance (:data:`WATCH`) serves the repo;
+    independent instances exist for tests.
+    """
+
+    def __init__(
+        self,
+        storm_threshold: int = DEFAULT_STORM_THRESHOLD,
+        cost_analysis: bool = True,
+    ):
+        self.storm_threshold = storm_threshold
+        self.cost_analysis = cost_analysis
+        self.enabled = bool(os.environ.get("DSA_COMPILE_WATCH"))
+        self.records: List[CompileRecord] = []
+        self.events: List[dict] = []
+        self._sigs: Dict[str, List[str]] = {}
+        self._warned: set = set()
+
+    # -- lifecycle ---------------------------------------------------------
+    def enable(self) -> "CompileWatch":
+        self.enabled = True
+        return self
+
+    def disable(self) -> "CompileWatch":
+        self.enabled = False
+        return self
+
+    def reset(self) -> None:
+        self.records.clear()
+        self.events.clear()
+        self._sigs.clear()
+        self._warned.clear()
+
+    # -- recording ---------------------------------------------------------
+    def seen(self, entry: str, sig: str) -> bool:
+        return sig in self._sigs.get(entry, ())
+
+    def compile_count(self, entry: str) -> int:
+        """Distinct signatures observed compiling for ``entry``."""
+        return len(self._sigs.get(entry, ()))
+
+    def record(
+        self,
+        entry: str,
+        sig: str,
+        wall_s: Optional[float] = None,
+        flops: Optional[float] = None,
+        bytes_accessed: Optional[float] = None,
+    ) -> CompileRecord:
+        sigs = self._sigs.setdefault(entry, [])
+        if sig not in sigs:
+            sigs.append(sig)
+        rec = CompileRecord(
+            entry=entry, signature=sig, seq=len(sigs), wall_s=wall_s,
+            flops=flops, bytes_accessed=bytes_accessed,
+        )
+        self.records.append(rec)
+        if len(sigs) >= self.storm_threshold:
+            self._storm(entry, sigs)
+        return rec
+
+    def _storm(self, entry: str, sigs: List[str]) -> None:
+        # ONE event per storming entry, its count rising in place — a
+        # 50-shape storm must not bloat the run artifact (and the
+        # swarmscope summary) with 46 near-identical events.
+        for ev in self.events:
+            if (
+                ev.get("event") == "retrace-storm"
+                and ev.get("entry") == entry
+            ):
+                ev["compiles"] = len(sigs)
+                ev["signatures"] = sigs[-3:]
+                break
+        else:
+            self.events.append(
+                {
+                    "event": "retrace-storm",
+                    "entry": entry,
+                    "compiles": len(sigs),
+                    "threshold": self.storm_threshold,
+                    "signatures": sigs[-3:],
+                }
+            )
+        if entry not in self._warned:
+            self._warned.add(entry)
+            warnings.warn(
+                f"retrace storm: jitted entry {entry!r} compiled under "
+                f"{len(sigs)} distinct arg signatures (threshold "
+                f"{self.storm_threshold}) — bucket the shapes "
+                "(ROADMAP item 2) or hoist the varying arg to static",
+                RetraceStormWarning,
+                stacklevel=3,
+            )
+
+    # -- wrapping ----------------------------------------------------------
+    def wrap(self, entry: str, fn: Callable) -> WatchedFunction:
+        return WatchedFunction(self, entry, fn)
+
+    def watched(self, entry: str) -> Callable:
+        """Decorator form: ``@WATCH.watched("swarm-rollout")`` above a
+        jitted def."""
+        return lambda fn: self.wrap(entry, fn)
+
+    def analyze(self, fn: Callable, *args, **kwargs) -> CompileRecord:
+        """Cost-analyze one entry WITHOUT executing or compiling it:
+        ``lower(...).cost_analysis()`` only (measured ~1.6 s at the
+        65k rollout on CPU).  Records under the entry's registry name
+        (``WatchedFunction``) or ``__name__``.
+
+        Analysis records carry ``seq=0`` and deliberately do NOT
+        enter the dispatch ledger: nothing compiled, so the entry's
+        gated compile count must not grow, the storm detector must
+        not fire, and a later REAL call with the same args must still
+        record its first-call wall time."""
+        entry = getattr(fn, "entry", None) or getattr(
+            fn, "__name__", repr(fn)
+        )
+        inner = getattr(fn, "__wrapped__", fn)
+        flops, nbytes = _cost_analysis(inner.lower(*args, **kwargs))
+        rec = CompileRecord(
+            entry=entry, signature=arg_signature(args, kwargs),
+            seq=0, wall_s=None, flops=flops, bytes_accessed=nbytes,
+        )
+        self.records.append(rec)
+        return rec
+
+    # -- reporting ---------------------------------------------------------
+    def summary(self) -> dict:
+        """JSON-safe roll-up: per-entry compile counts, total compile
+        wall, storm events, and every record."""
+        entries = {
+            entry: {
+                "compiles": len(sigs),
+                "wall_s": round(
+                    sum(
+                        r.wall_s or 0.0
+                        for r in self.records
+                        if r.entry == entry
+                    ),
+                    3,
+                ),
+            }
+            for entry, sigs in sorted(self._sigs.items())
+        }
+        return {
+            "storm_threshold": self.storm_threshold,
+            "entries": entries,
+            "events": list(self.events),
+            "records": [r.to_dict() for r in self.records],
+        }
+
+    def dump(self, path: str) -> str:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as fh:
+            json.dump(self.summary(), fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        return path
+
+
+#: Process-global registry every ``watched`` entry point reports to.
+WATCH = CompileWatch()
+
+
+def watched(entry: str) -> Callable:
+    """Module-level decorator onto the global :data:`WATCH` registry:
+
+        @watched("swarm-rollout")
+        @partial(jax.jit, static_argnames=(...))
+        def _swarm_rollout_impl(...): ...
+    """
+    return WATCH.watched(entry)
+
+
+def enable() -> CompileWatch:
+    return WATCH.enable()
+
+
+def disable() -> CompileWatch:
+    return WATCH.disable()
+
+
+def _dump_to_run_dir() -> None:
+    """atexit hook: with DSA_RUN_DIR set and anything recorded, leave
+    the compile records in the run directory (one file per process, so
+    run_all's bench subprocesses never clobber each other)."""
+    run_dir = os.environ.get("DSA_RUN_DIR")
+    if not run_dir or not (WATCH.records or WATCH.events):
+        return
+    try:
+        name = os.path.basename(sys.argv[0]) if sys.argv else "proc"
+        name = name or "proc"
+        WATCH.dump(
+            os.path.join(
+                run_dir, "compile", f"{name}-{os.getpid()}.json"
+            )
+        )
+    except OSError:
+        pass
+
+
+atexit.register(_dump_to_run_dir)

@@ -13,19 +13,28 @@ labels (plan build, separation dispatch, moments deposit/sample,
 integration — the scope map is in docs/OBSERVABILITY.md), so traces
 captured here decompose into the same stages the benchmarks time;
 pair with the in-scan flight recorder (utils/telemetry.py) for
-per-tick counters alongside the profile.
+per-tick counters alongside the profile.  ``annotate`` spans BOTH
+planes (r11): the host-side ``TraceAnnotation`` for eager regions and
+``jax.named_scope`` for any ops traced while the block is open, so
+one label shows up whichever way the wrapped code executes.
 """
 
 from __future__ import annotations
 
 import contextlib
+import os
 
 import jax
 
 
 @contextlib.contextmanager
 def trace(log_dir: str):
-    """Capture a device trace (TensorBoard-compatible) for the block."""
+    """Capture a device trace (TensorBoard-compatible) for the block.
+
+    Creates ``log_dir`` (and parents) when missing — first use must
+    not fail on a fresh checkout just because ``runs/trace/`` does
+    not exist yet (r11 satellite)."""
+    os.makedirs(log_dir, exist_ok=True)
     jax.profiler.start_trace(log_dir)
     try:
         yield
@@ -33,6 +42,13 @@ def trace(log_dir: str):
         jax.profiler.stop_trace()
 
 
+@contextlib.contextmanager
 def annotate(name: str):
-    """Named region inside a host-side loop (shows up in trace viewers)."""
-    return jax.profiler.TraceAnnotation(name)
+    """Named region labeling BOTH planes (r11): the host-side
+    profiler annotation (shows up in trace viewers around eager
+    work) and ``jax.named_scope`` (labels any ops traced inside the
+    block, so the region survives into jitted HLO metadata).  Keep
+    ``name`` a literal — the ``scope-fstring`` swarmlint rule flags
+    dynamic scope names as retrace hazards."""
+    with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
+        yield

@@ -1,0 +1,336 @@
+"""Structured run directories + the ``swarmscope`` inspector core (r11).
+
+A *run directory* is the durable artifact of one benchmark/suite
+execution — the pieces the r10/r11 observability planes produce,
+gathered where a later session (or the ``swarmscope`` CLI) can read
+them without re-running anything:
+
+    <run>/
+      manifest.json           who/when/where: label, argv, backend, mesh
+      metrics.jsonl           one JSON object per bench metric line
+      telemetry_summary.json  {scenario tag -> TelemetrySummary dict}
+      events.jsonl            flight-recorder threshold events
+      compile/*.json          CompileWatch dumps, one per process
+
+``benchmarks/run_all.py`` emits one per recorded round (and exports
+``DSA_RUN_DIR`` so bench subprocesses and the compile observatory
+deposit their halves); ``bench.py`` appends its headline line when the
+env var is set.  ``swarmscope`` (cli.py) summarizes a run, diffs two
+runs metric-by-metric with the same gating semantics as the
+cross-round union gate, and prints a fixed-name row's BENCH_HISTORY
+trajectory.
+
+The gating rules here MUST stay in lockstep with
+``benchmarks/compare.py`` (the union gate): units ``findings`` /
+``rounds`` / ``events`` / ``ticks`` / ``compiles`` are lower-is-better
+counts (a clean 0 baseline regressing to any positive count always
+gates), unit ``pct`` gates against the absolute :data:`PCT_CEILING`,
+everything else is a higher-is-better throughput.  compare.py cannot
+be imported from the package (benchmarks/ is not a package), so the
+~30 shared lines live here and compare.py's tests cross-check the
+verdicts agree (tests/test_swarmscope.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+MANIFEST = "manifest.json"
+METRICS = "metrics.jsonl"
+TELEMETRY = "telemetry_summary.json"
+EVENTS = "events.jsonl"
+COMPILE_DIR = "compile"
+
+#: Lower-is-better count units (mirror of compare.py's tuple).
+COUNT_UNITS = ("findings", "rounds", "events", "ticks", "compiles")
+
+#: Absolute ceiling for unit-"pct" metrics (compare.PCT_CEILING).
+PCT_CEILING = 5.0
+
+
+# ---------------------------------------------------------------------------
+# Writing
+
+
+def create_run_dir(
+    path: str,
+    label: Optional[str] = None,
+    argv: Optional[List[str]] = None,
+    backend: Optional[str] = None,
+    extra: Optional[dict] = None,
+) -> str:
+    """Create (or refresh the manifest of) a run directory."""
+    os.makedirs(path, exist_ok=True)
+    manifest = {
+        "label": label,
+        "created": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "argv": list(argv if argv is not None else sys.argv),
+        "backend": backend,
+    }
+    if extra:
+        manifest.update(extra)
+    with open(os.path.join(path, MANIFEST), "w") as fh:
+        json.dump(manifest, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+def append_metrics(run_dir: str, lines: List[dict]) -> int:
+    """Append bench metric dicts to ``metrics.jsonl``; returns count."""
+    os.makedirs(run_dir, exist_ok=True)
+    n = 0
+    with open(os.path.join(run_dir, METRICS), "a") as fh:
+        for obj in lines:
+            if "metric" not in obj:
+                continue
+            fh.write(json.dumps(obj, sort_keys=True))
+            fh.write("\n")
+            n += 1
+    return n
+
+
+def merge_telemetry_summary(run_dir: str, tag: str, summary: dict) -> str:
+    """Merge one scenario's flight-recorder summary under its tag."""
+    os.makedirs(run_dir, exist_ok=True)
+    path = os.path.join(run_dir, TELEMETRY)
+    data: dict = {}
+    if os.path.exists(path):
+        try:
+            with open(path) as fh:
+                data = json.load(fh)
+        except (json.JSONDecodeError, OSError):
+            data = {}
+    data[tag] = summary
+    with open(path, "w") as fh:
+        json.dump(data, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+def append_events(run_dir: str, events: List[dict]) -> int:
+    """Append flight-recorder events to ``events.jsonl``."""
+    os.makedirs(run_dir, exist_ok=True)
+    with open(os.path.join(run_dir, EVENTS), "a") as fh:
+        for ev in events:
+            fh.write(json.dumps(ev, sort_keys=True))
+            fh.write("\n")
+    return len(events)
+
+
+# ---------------------------------------------------------------------------
+# Reading
+
+
+@dataclass
+class RunData:
+    """Everything ``swarmscope`` knows about one run directory."""
+
+    path: str
+    manifest: dict = field(default_factory=dict)
+    metrics: Dict[str, dict] = field(default_factory=dict)  # name -> row
+    failures: List[dict] = field(default_factory=list)
+    telemetry: dict = field(default_factory=dict)
+    events: List[dict] = field(default_factory=list)
+    compile_entries: dict = field(default_factory=dict)
+    compile_events: List[dict] = field(default_factory=list)
+
+    @property
+    def label(self) -> str:
+        return self.manifest.get("label") or os.path.basename(
+            self.path.rstrip("/")
+        )
+
+
+def load_run(run_dir: str) -> RunData:
+    """Parse a run directory (every piece optional — a partial run is
+    still inspectable)."""
+    if not os.path.isdir(run_dir):
+        raise FileNotFoundError(f"no such run directory: {run_dir}")
+    run = RunData(path=run_dir)
+    mpath = os.path.join(run_dir, MANIFEST)
+    if os.path.exists(mpath):
+        with open(mpath) as fh:
+            run.manifest = json.load(fh)
+    metpath = os.path.join(run_dir, METRICS)
+    if os.path.exists(metpath):
+        with open(metpath) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if obj.get("value") is None:
+                    # Structured failure records (value null by the
+                    # bench contract) are diagnostics, not metrics.
+                    run.failures.append(obj)
+                    continue
+                run.metrics[obj["metric"]] = obj
+    tpath = os.path.join(run_dir, TELEMETRY)
+    if os.path.exists(tpath):
+        with open(tpath) as fh:
+            run.telemetry = json.load(fh)
+    epath = os.path.join(run_dir, EVENTS)
+    if os.path.exists(epath):
+        with open(epath) as fh:
+            for ln in fh:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    run.events.append(json.loads(ln))
+                except json.JSONDecodeError:
+                    # Append-mode writers killed mid-line (run_all's
+                    # timeout) must not make the run uninspectable.
+                    continue
+    cdir = os.path.join(run_dir, COMPILE_DIR)
+    if os.path.isdir(cdir):
+        for name in sorted(os.listdir(cdir)):
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(cdir, name)) as fh:
+                    dump = json.load(fh)
+            except (json.JSONDecodeError, OSError):
+                continue
+            for entry, stats in dump.get("entries", {}).items():
+                agg = run.compile_entries.setdefault(
+                    entry, {"compiles": 0, "wall_s": 0.0}
+                )
+                agg["compiles"] += stats.get("compiles", 0)
+                agg["wall_s"] += stats.get("wall_s", 0.0)
+            run.compile_events.extend(dump.get("events", []))
+    return run
+
+
+# ---------------------------------------------------------------------------
+# Gating (lockstep with benchmarks/compare.py — see module doc)
+
+
+def norm_key(metric: str) -> str:
+    """compare.norm_key: measurement floats become '#'; config ints
+    stay (they are the pin)."""
+    return re.sub(r"\d+\.\d+", "#", metric)
+
+
+def gate(unit: str, prev: float, cur: float,
+         threshold: float = 0.2) -> str:
+    """'ok' | 'improved' | 'REGRESSION' for one metric pair."""
+    if unit in COUNT_UNITS:
+        if cur > prev * (1.0 + threshold) or (prev == 0 and cur > 0):
+            return "REGRESSION"
+        return "improved" if cur < prev else "ok"
+    if unit == "pct":
+        if cur > PCT_CEILING:
+            return "REGRESSION"
+        return "improved" if cur < prev else "ok"
+    if prev <= 0:
+        return "ok"
+    ratio = cur / prev
+    if ratio < 1.0 - threshold:
+        return "REGRESSION"
+    return "improved" if ratio > 1.0 + threshold else "ok"
+
+
+def diff_runs(a: RunData, b: RunData, threshold: float = 0.2) -> dict:
+    """Metric-by-metric diff of two runs, ``a`` the baseline.
+
+    Returns ``{"rows": [...], "regressions": [names], "only_a": [...],
+    "only_b": [...]}`` — ``regressions`` holds the exact fixed-name
+    rows whose gated value regressed (the ``swarmscope diff`` exit
+    contract: nonzero iff non-empty)."""
+    akeys = {norm_key(k): k for k in a.metrics}
+    bkeys = {norm_key(k): k for k in b.metrics}
+    rows = []
+    regressions = []
+    for key in sorted(set(akeys) & set(bkeys)):
+        pa = a.metrics[akeys[key]]
+        pb = b.metrics[bkeys[key]]
+        unit = str(pb.get("unit", ""))
+        pv, cv = float(pa["value"]), float(pb["value"])
+        status = gate(unit, pv, cv, threshold)
+        rows.append(
+            {
+                "metric": bkeys[key],
+                "unit": unit,
+                "prev": pv,
+                "cur": cv,
+                "status": status,
+            }
+        )
+        if status == "REGRESSION":
+            regressions.append(bkeys[key])
+    return {
+        "rows": rows,
+        "regressions": regressions,
+        # Real metric names, not normalized keys — a '#'-wildcarded
+        # name matches no actual row and cannot be grepped back.
+        "only_a": sorted(akeys[k] for k in set(akeys) - set(bkeys)),
+        "only_b": sorted(bkeys[k] for k in set(bkeys) - set(akeys)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# BENCH_HISTORY trajectory
+
+
+def history_rows(
+    metric: str, history_path: str
+) -> List[Tuple[str, float, str]]:
+    """The cross-round trajectory of one fixed-name row:
+    ``[(round, value, unit), ...]`` in round order.
+
+    The query resolves to exactly ONE metric family (normalized key)
+    across ALL rounds before any values are read — a per-round lookup
+    would silently stitch different families into one trajectory when
+    a later round adds a second name containing the query (e.g.
+    ``telemetry-overhead-pct`` matching both the single-device and
+    the multichip rows).  Resolution order: exact name, then
+    normalized-key equality, then substring containment; among
+    substring candidates the family recorded in the MOST rounds wins
+    (tie: alphabetical)."""
+    with open(history_path) as fh:
+        rounds = json.load(fh).get("rounds", {})
+
+    def sort_key(label: str) -> int:
+        digits = re.sub(r"\D", "", label)
+        return int(digits) if digits else 0
+
+    ordered = sorted(rounds, key=sort_key)
+    # family (norm key) -> {round label -> real name}
+    families: Dict[str, Dict[str, str]] = {}
+    for label in ordered:
+        for name in rounds[label]:
+            families.setdefault(norm_key(name), {})[label] = name
+
+    want = norm_key(metric)
+    if any(
+        metric in rounds[label] for label in ordered
+    ) or want in families:
+        chosen = want
+    else:
+        candidates = [
+            fam for fam, by_round in families.items()
+            if any(metric in name for name in by_round.values())
+        ]
+        if not candidates:
+            return []
+        chosen = min(
+            candidates, key=lambda fam: (-len(families[fam]), fam)
+        )
+    out: List[Tuple[str, float, str]] = []
+    for label in ordered:
+        name = families.get(chosen, {}).get(label)
+        if name is None:
+            continue
+        row = rounds[label][name]
+        out.append((label, float(row["value"]), row.get("unit", "")))
+    return out

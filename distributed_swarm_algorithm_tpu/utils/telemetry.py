@@ -83,16 +83,13 @@ class TickTelemetry:
     plan_rebuilds: jax.Array  # i32 — cumulative rebuilds this rollout
     cap_overflow: jax.Array  # i32 — live agents past the per-cell cap
     cand_overflow: jax.Array  # i32 — candidate-table entries past W
-
-
-def _masked_norm_stats(vec: jax.Array, mask: jax.Array, count):
-    """(max, mean) of row norms of ``vec`` over ``mask`` rows —
-    fixed-shape (masked, not compacted) so it scans."""
-    norm = jnp.linalg.norm(vec, axis=-1)
-    norm = jnp.where(mask, norm, 0.0)
-    mx = jnp.max(norm)
-    mean = jnp.sum(norm) / jnp.maximum(count, 1).astype(norm.dtype)
-    return mx.astype(jnp.float32), mean.astype(jnp.float32)
+    # Mesh residency (r11, the sharded recorder): per-device share of
+    # the sharded axis.  Single-device collection leaves the neutral
+    # values (max = alive count, imbalance = 0); the mesh reducers
+    # (mesh_reduce_telemetry + the parallel/ drivers) fill them with
+    # pmax/pmin over the named axis.
+    shard_max_alive: jax.Array   # i32 — max per-shard element count
+    shard_imbalance: jax.Array   # i32 — max - min per-shard count
 
 
 def tick_telemetry(
@@ -104,6 +101,9 @@ def tick_telemetry(
     leader_id=None,
     electing=None,
     plan=None,
+    leader_mask: Optional[jax.Array] = None,
+    agent_id: Optional[jax.Array] = None,
+    electing_mask: Optional[jax.Array] = None,
 ) -> TickTelemetry:
     """Collect one :class:`TickTelemetry` from a tick's arrays.
 
@@ -113,22 +113,70 @@ def tick_telemetry(
     velocity hides exactly the spikes worth recording); ``plan`` an
     optional carried :class:`~..ops.hashgrid_plan.HashgridPlan`.
 
+    The leader/election signals come in two forms: pre-reduced
+    scalars (``leader_id``/``electing`` — the CPU oracle and one-shot
+    collectors), or per-agent masks (``leader_mask`` + ``agent_id`` /
+    ``electing_mask`` — the in-scan swarm collector), which fold into
+    the packed reduction below.
+
+    All per-agent reductions are PACKED into one max-tree and one
+    sum-tree over an ``[N, 4]`` stack (r11): under GSPMD with the
+    agent axis sharded, every separate ``jnp.max``/``jnp.sum`` lowers
+    to its own per-tick all-reduce — collection measured ~30%
+    overhead on the 8-virtual-device rig as a dozen scalar
+    collectives, and within the 5% ceiling as two packed ones
+    (benchmarks/bench_multichip_telemetry.py).  f32 packing is exact
+    for the integer columns (counts and ids < 2^24).
+
     MUST be called behind the static ``TelemetryConfig`` gate when
     used inside a scan body (the ``telemetry-gate`` swarmlint rule
     enforces this) — an ungated call would bloat every rollout's HLO
     whether or not anyone reads the record.
     """
     alive = alive.astype(bool)
-    n_alive = jnp.sum(alive).astype(jnp.int32)
-    speed_max, speed_mean = _masked_norm_stats(vel, alive, n_alive)
-    finite = jnp.all(jnp.isfinite(pos)) & jnp.all(jnp.isfinite(vel))
+    falive = alive.astype(jnp.float32)
+    speed = jnp.where(alive, jnp.linalg.norm(vel, axis=-1), 0.0)
+    bad = ~(
+        jnp.all(jnp.isfinite(pos), axis=-1)
+        & jnp.all(jnp.isfinite(vel), axis=-1)
+    )
     if force is not None:
-        force_max, force_mean = _masked_norm_stats(
-            force, alive, n_alive
-        )
-        finite = finite & jnp.all(jnp.isfinite(force))
+        fnorm = jnp.where(alive, jnp.linalg.norm(force, axis=-1), 0.0)
+        bad = bad | ~jnp.all(jnp.isfinite(force), axis=-1)
     else:
-        force_max = force_mean = jnp.asarray(0.0, jnp.float32)
+        fnorm = jnp.zeros_like(speed)
+    lead_col = (
+        jnp.where(leader_mask, agent_id, NO_LEADER).astype(jnp.float32)
+        if leader_mask is not None
+        else jnp.full_like(speed, NO_LEADER)
+    )
+    elect_col = (
+        electing_mask.astype(jnp.float32)
+        if electing_mask is not None
+        else jnp.zeros_like(speed)
+    )
+    # One max-tree, one sum-tree — the only two [N]-reductions the
+    # whole record needs.
+    maxpack = jnp.max(
+        jnp.stack(
+            [speed, fnorm, bad.astype(jnp.float32), lead_col], axis=-1
+        ),
+        axis=0,
+    )
+    sumpack = jnp.sum(
+        jnp.stack([falive, elect_col, speed, fnorm], axis=-1), axis=0
+    )
+    n_alive = sumpack[0].astype(jnp.int32)
+    denom = jnp.maximum(sumpack[0], 1.0)
+    speed_max = maxpack[0].astype(jnp.float32)
+    force_max = maxpack[1].astype(jnp.float32)
+    speed_mean = (sumpack[2] / denom).astype(jnp.float32)
+    force_mean = (sumpack[3] / denom).astype(jnp.float32)
+    finite = maxpack[2] == 0.0
+    if leader_mask is not None:
+        leader_id = maxpack[3].astype(jnp.int32)
+    if electing_mask is not None:
+        electing = sumpack[1].astype(jnp.int32)
     zero = jnp.asarray(0, jnp.int32)
     if plan is not None:
         plan_age = plan.age.astype(jnp.int32)
@@ -165,6 +213,8 @@ def tick_telemetry(
         plan_rebuilds=plan_rebuilds,
         cap_overflow=cap_overflow,
         cand_overflow=cand_overflow,
+        shard_max_alive=n_alive,
+        shard_imbalance=zero,
     )
 
 
@@ -179,12 +229,12 @@ def swarm_tick_telemetry(state, force, plan=None) -> TickTelemetry:
     # pinned to state.py's FSM codes by tests/test_telemetry.py.
     LEADER = 3
     ELECTION_WAIT = 2
-    mask = state.alive & (state.fsm == LEADER)
-    lid = jnp.max(jnp.where(mask, state.agent_id, NO_LEADER))
-    electing = jnp.sum(state.alive & (state.fsm == ELECTION_WAIT))
     return tick_telemetry(
         state.pos, state.vel, state.alive, state.tick,
-        force=force, leader_id=lid, electing=electing, plan=plan,
+        force=force, plan=plan,
+        leader_mask=state.alive & (state.fsm == LEADER),
+        agent_id=state.agent_id,
+        electing_mask=state.alive & (state.fsm == ELECTION_WAIT),
     )
 
 
@@ -194,6 +244,178 @@ def boids_tick_telemetry(state, force=None, plan=None) -> TickTelemetry:
     return tick_telemetry(
         state.pos, state.vel, jnp.ones((n,), bool), state.iteration,
         force=force, plan=plan,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Mesh collection (r11): the sharded flight recorder.
+#
+# Inside a ``shard_map`` body every shard holds a LOCAL TickTelemetry;
+# the reducers below merge them into the same scalar pytree with
+# named-axis collectives, one collective class per field semantics:
+# counts psum, maxima/ids pmax, means alive-weighted psum-ratio, and
+# the residency pair (shard_max_alive / shard_imbalance) from
+# pmax/pmin of the per-shard counts.  Collection stays read-only —
+# the reduced record feeds scan ys only, so the carried trajectory is
+# bitwise-equal with the recorder on or off (the r10 contract, now
+# pinned on the 8-virtual-device rig by tests/test_mesh_telemetry.py).
+
+
+def mesh_reduce_telemetry(local: TickTelemetry, axis) -> TickTelemetry:
+    """Reduce per-shard records into the global record over the named
+    mesh axis ``axis``.  Only legal inside ``shard_map``/``pmap``
+    bodies where ``axis`` is bound; GSPMD callers never need it
+    (partitioned ``jnp`` reductions already produce the global
+    record).
+
+    Exactly TWO collectives, whatever the record holds (the same
+    packing discipline as ``tick_telemetry`` — an in-scan caller pays
+    per step): one ``lax.pmax`` of an f32 max-pack (maxima, ids,
+    flags, and the negated alive count, which turns the ``pmin`` for
+    the residency floor into the same pmax), one ``lax.psum`` of an
+    f32 sum-pack (counts and alive-weighted means).  f32 is exact for
+    every integer column (counts and ids < 2^24)."""
+    from jax import lax
+
+    f32 = jnp.float32
+    count = jnp.maximum(local.alive, 0).astype(f32)
+    maxpack = lax.pmax(
+        jnp.stack(
+            [
+                local.tick.astype(f32),
+                local.leader_id.astype(f32),
+                local.speed_max.astype(f32),
+                local.force_max.astype(f32),
+                local.nonfinite.astype(f32),
+                local.plan_age.astype(f32),
+                local.plan_rebuilds.astype(f32),
+                local.alive.astype(f32),
+                -local.alive.astype(f32),      # pmin via negated pmax
+            ]
+        ),
+        axis,
+    )
+    sumpack = lax.psum(
+        jnp.stack(
+            [
+                count,
+                local.electing.astype(f32),
+                local.cap_overflow.astype(f32),
+                local.cand_overflow.astype(f32),
+                # Alive-weighted per-shard means sum to the global
+                # mean numerator (each shard's mean is over its own
+                # alive count).
+                local.speed_mean.astype(f32) * count,
+                local.force_mean.astype(f32) * count,
+            ]
+        ),
+        axis,
+    )
+    total = jnp.maximum(sumpack[0], 1.0)
+    hi = maxpack[7].astype(jnp.int32)
+    lo = (-maxpack[8]).astype(jnp.int32)
+    return TickTelemetry(
+        tick=maxpack[0].astype(jnp.int32),
+        alive=sumpack[0].astype(jnp.int32),
+        leader_id=maxpack[1].astype(jnp.int32),
+        electing=sumpack[1].astype(jnp.int32),
+        speed_max=maxpack[2].astype(f32),
+        speed_mean=(sumpack[4] / total).astype(f32),
+        force_max=maxpack[3].astype(f32),
+        force_mean=(sumpack[5] / total).astype(f32),
+        nonfinite=maxpack[4] > 0.0,
+        plan_age=maxpack[5].astype(jnp.int32),
+        plan_rebuilds=maxpack[6].astype(jnp.int32),
+        cap_overflow=sumpack[2].astype(jnp.int32),
+        cand_overflow=sumpack[3].astype(jnp.int32),
+        shard_max_alive=hi,
+        shard_imbalance=hi - lo,
+    )
+
+
+def optimizer_tick_telemetry(
+    iteration,
+    population,
+    speed_max=None,
+    speed_mean=None,
+    nonfinite=None,
+    best_shard=None,
+    shard_max=None,
+    shard_imbalance=None,
+) -> TickTelemetry:
+    """Per-step record for the optimizer-zoo drivers — same fixed
+    pytree, zoo field mapping: ``alive`` = population size,
+    ``leader_id`` = the shard/island currently holding the global best
+    (NO_LEADER when untracked), ``speed_*`` = velocity-norm gauges
+    where the family has velocities, protocol/plan fields neutral.
+    ``shard_max``/``shard_imbalance`` carry the per-device residency
+    counters (defaults: the whole population on one shard)."""
+    zero = jnp.asarray(0, jnp.int32)
+    fzero = jnp.asarray(0.0, jnp.float32)
+    population = jnp.asarray(population, jnp.int32)
+    return TickTelemetry(
+        tick=jnp.asarray(iteration, jnp.int32),
+        alive=population,
+        leader_id=(
+            jnp.asarray(NO_LEADER, jnp.int32)
+            if best_shard is None
+            else jnp.asarray(best_shard, jnp.int32)
+        ),
+        electing=zero,
+        speed_max=(
+            fzero if speed_max is None
+            else jnp.asarray(speed_max, jnp.float32)
+        ),
+        speed_mean=(
+            fzero if speed_mean is None
+            else jnp.asarray(speed_mean, jnp.float32)
+        ),
+        force_max=fzero,
+        force_mean=fzero,
+        nonfinite=(
+            jnp.asarray(False)
+            if nonfinite is None
+            else jnp.asarray(nonfinite, bool)
+        ),
+        plan_age=zero,
+        plan_rebuilds=zero,
+        cap_overflow=zero,
+        cand_overflow=zero,
+        shard_max_alive=(
+            population if shard_max is None
+            else jnp.asarray(shard_max, jnp.int32)
+        ),
+        shard_imbalance=(
+            zero if shard_imbalance is None
+            else jnp.asarray(shard_imbalance, jnp.int32)
+        ),
+    )
+
+
+def island_tick_telemetry(pso, iteration) -> TickTelemetry:
+    """Island-model collector (parallel/islands.py): one global record
+    per lockstep iteration from the stacked ``[I, n, ...]`` PSO state.
+    The cross-island reductions here are plain ``jnp`` ops — under
+    GSPMD with the island axis sharded, XLA lowers them to the same
+    ICI collectives the migration roll rides.  ``leader_id`` is the
+    island holding the global best (the zoo analog of the swarm's
+    leader: which shard owns the optimum)."""
+    n_islands, n_per = pso.pbest_fit.shape
+    speed = jnp.linalg.norm(pso.vel, axis=-1)            # [I, n]
+    finite = (
+        jnp.all(jnp.isfinite(pso.pos))
+        & jnp.all(jnp.isfinite(pso.vel))
+        & jnp.all(jnp.isfinite(pso.gbest_fit))
+    )
+    return optimizer_tick_telemetry(
+        iteration,
+        n_islands * n_per,
+        speed_max=jnp.max(speed),
+        speed_mean=jnp.mean(speed),
+        nonfinite=~finite,
+        best_shard=jnp.argmin(pso.gbest_fit),
+        shard_max=n_per,
+        shard_imbalance=0,
     )
 
 
@@ -262,6 +484,8 @@ class TelemetrySummary:
     truncation_events: int
     cap_overflow_max: int
     cand_overflow_max: int
+    shard_max_alive: int
+    shard_imbalance_max: int
 
     @classmethod
     def from_ticks(cls, t: TickTelemetry) -> "TelemetrySummary":
@@ -282,7 +506,8 @@ class TelemetrySummary:
                 first_nonfinite_step=-1, plan_rebuilds=0,
                 rebuilds_per_100_ticks=0.0, plan_age_max=0,
                 truncation_events=0, cap_overflow_max=0,
-                cand_overflow_max=0,
+                cand_overflow_max=0, shard_max_alive=0,
+                shard_imbalance_max=0,
             )
         alive = _np(t.alive)
         leader = _np(t.leader_id)
@@ -315,6 +540,8 @@ class TelemetrySummary:
             truncation_events=int(np.sum((cap > 0) | (cand > 0))),
             cap_overflow_max=int(cap.max()),
             cand_overflow_max=int(cand.max()),
+            shard_max_alive=int(_np(t.shard_max_alive).max()),
+            shard_imbalance_max=int(_np(t.shard_imbalance).max()),
         )
 
     def to_dict(self) -> dict:

@@ -48,6 +48,32 @@ def main():
     assert float(fit) < 150.0      # random init is ~400 on rastrigin-16D
     print("OK: islands ran sharded with ring elite migration.")
 
+    # --- the sharded flight recorder (r11): watch an island run -----
+    from distributed_swarm_algorithm_tpu.parallel.islands import (
+        island_init,
+        island_run,
+    )
+    from distributed_swarm_algorithm_tpu.utils.telemetry import (
+        summarize_telemetry,
+    )
+
+    st = island_init(fn, n_islands=n_dev, n_per_island=256, dim=16,
+                     half_width=hw, seed=0)
+    st, telem = island_run(
+        st, fn, 60, migrate_every=20, migrate_k=8, half_width=hw,
+        telemetry=True,
+    )
+    summ = summarize_telemetry(telem)
+    print(
+        f"recorder: {summ['ticks']} gens, best owned by island "
+        f"{summ['leader_final']}, {summ['shard_max_alive']} "
+        f"particles/island, nonfinite step "
+        f"{summ['first_nonfinite_step']}"
+    )
+    assert summ["first_nonfinite_step"] == -1
+    print("OK: flight recorder rode the island scan "
+          "(docs/OBSERVABILITY.md).")
+
 
 if __name__ == "__main__":
     main()

@@ -90,6 +90,26 @@ def test_ticks_unit_is_lower_is_better(hist):
     assert compare.compare("r02", "r03", path=hist) == 1
 
 
+def test_compiles_unit_is_lower_is_better(hist):
+    # r11 compile observatory: a cache-entry count doubling (a retrace
+    # crept into the entry) gates; holding at 1 or paying down never
+    # does.
+    compare.record("r01", [
+        {"metric": "compile-count, swarm-rollout 4096", "value": 1.0,
+         "unit": "compiles"},
+    ], path=hist)
+    compare.record("r02", [
+        {"metric": "compile-count, swarm-rollout 4096", "value": 2.0,
+         "unit": "compiles"},
+    ], path=hist)
+    assert compare.compare("r01", "r02", path=hist) == 1
+    compare.record("r03", [
+        {"metric": "compile-count, swarm-rollout 4096", "value": 1.0,
+         "unit": "compiles"},
+    ], path=hist)
+    assert compare.compare("r02", "r03", path=hist) == 0  # paydown ok
+
+
 def test_pct_unit_gates_on_absolute_ceiling(hist):
     # Telemetry overhead (unit "pct"): gated against the ABSOLUTE 5%
     # ceiling, not relative growth — 0.1% -> 3% is fine (30x growth),

@@ -1,0 +1,158 @@
+"""Compile/retrace observatory (r11, utils/compile_watch.py).
+
+Pins: the disabled wrapper is a pure passthrough; enabled, one record
+per distinct arg signature with first-call wall time and
+cost_analysis flops/bytes; a stream of distinct signatures into one
+entry fires the structured retrace-storm event (and the warning,
+once); the analyze() path reports cost_analysis for the 65k rollout
+entry WITHOUT compiling it (the acceptance row); summaries dump as
+JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import warnings
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu.utils import compile_watch as cw
+
+
+@pytest.fixture()
+def watch():
+    w = cw.CompileWatch(storm_threshold=4)
+    w.enable()
+    return w
+
+
+def _toy(watch):
+    @watch.watched("toy-entry")
+    @partial(jax.jit, static_argnames=("k",))
+    def toy(x, k: int = 1):
+        return x * k
+
+    return toy
+
+
+def test_disabled_wrapper_is_passthrough():
+    w = cw.CompileWatch()
+    assert not w.enabled        # env-gated; off by default
+    toy = _toy(w)
+    out = toy(jnp.ones((4,)))
+    assert float(out.sum()) == 4.0
+    assert w.records == [] and w.events == []
+    # Attribute delegation: AOT callers still reach the jitted fn.
+    assert hasattr(toy, "lower")
+    assert toy.entry == "toy-entry"
+
+
+def test_one_record_per_signature(watch):
+    toy = _toy(watch)
+    toy(jnp.ones((4,)))
+    toy(jnp.ones((4,)))                      # cache hit: no new record
+    assert watch.compile_count("toy-entry") == 1
+    toy(jnp.ones((8,)))                      # new shape
+    toy(jnp.ones((4,)), k=2)                 # new static
+    assert watch.compile_count("toy-entry") == 3
+    recs = [r for r in watch.records if r.entry == "toy-entry"]
+    assert [r.seq for r in recs] == [1, 2, 3]
+    for r in recs:
+        assert r.wall_s is not None and r.wall_s > 0.0
+        assert (
+            "float32[4]" in r.signature or "float32[8]" in r.signature
+        )
+    # Statics are part of the signature (jit keys on them too).
+    assert any("2" in r.signature.rsplit("|", 1)[-1] for r in recs)
+
+
+def test_retrace_storm_fires_structured_event_and_warns(watch):
+    toy = _toy(watch)
+    with warnings.catch_warnings(record=True) as wlist:
+        warnings.simplefilter("always")
+        for n in range(3, 11):               # 8 distinct shapes
+            toy(jnp.ones((n,)))
+    storms = [e for e in watch.events if e["event"] == "retrace-storm"]
+    assert len(storms) == 1                  # ONE event per entry...
+    first = storms[0]
+    assert first["entry"] == "toy-entry"
+    assert first["compiles"] == 8            # ...its count rising
+    assert first["threshold"] == 4
+    assert len(first["signatures"]) <= 3
+    storm_warnings = [
+        w for w in wlist
+        if issubclass(w.category, cw.RetraceStormWarning)
+    ]
+    assert len(storm_warnings) == 1          # warned once, not per call
+
+
+def test_no_recording_under_an_outer_trace(watch):
+    # A watched entry inlined inside vmap/jit sees tracers — nothing
+    # dispatches there, so nothing must be recorded (and lower() on
+    # tracers must never be attempted).
+    toy = _toy(watch)
+    jax.vmap(lambda x: toy(x))(jnp.ones((3, 4)))
+    inlined = [
+        r for r in watch.records
+        if r.entry == "toy-entry" and "Tracer" in r.signature
+    ]
+    assert inlined == []
+
+
+def test_analyze_reports_cost_for_65k_rollout_entry(watch):
+    # The acceptance row: cost_analysis flops/bytes for the 65k
+    # rollout entry — via lower().cost_analysis(), no backend compile
+    # (~2 s on CPU, vs a multi-minute 65k compile).
+    from distributed_swarm_algorithm_tpu.models.swarm import (
+        _swarm_rollout_impl,
+    )
+
+    cfg = dsa.SwarmConfig().replace(
+        separation_mode="hashgrid", world_hw=256.0,
+        formation_shape="none", hashgrid_backend="portable",
+        grid_max_per_cell=24, hashgrid_skin=1.0,
+        hashgrid_neighbor_cap=48, max_speed=1.0,
+    )
+    s = dsa.make_swarm(65_536, seed=0, spread=250.0)
+    s = s.replace(
+        target=jnp.asarray(s.pos),
+        has_target=jnp.ones_like(s.has_target),
+    )
+    rec = watch.analyze(_swarm_rollout_impl, s, None, cfg, 2)
+    assert rec.entry == "swarm-rollout"      # registry name, not repr
+    assert rec.flops is not None and rec.flops > 1e8
+    assert rec.bytes_accessed is not None and rec.bytes_accessed > 1e8
+    assert rec.wall_s is None                # analyzed, not executed
+    assert rec.seq == 0                      # not a counted compile
+    assert "float32[65536,2]" in rec.signature
+    # The dispatch ledger is untouched: nothing compiled, so the
+    # gated compile count must not grow and a later real call with
+    # the same args would still record its first-call wall time.
+    assert watch.compile_count("swarm-rollout") == 0
+
+
+def test_summary_and_dump_roundtrip(watch, tmp_path):
+    toy = _toy(watch)
+    toy(jnp.ones((4,)))
+    toy(jnp.ones((5,)))
+    summ = watch.summary()
+    assert summ["entries"]["toy-entry"]["compiles"] == 2
+    assert summ["entries"]["toy-entry"]["wall_s"] > 0.0
+    path = watch.dump(str(tmp_path / "sub" / "compile.json"))
+    with open(path) as fh:
+        loaded = json.load(fh)
+    assert loaded["entries"] == json.loads(
+        json.dumps(summ["entries"])
+    )
+    assert len(loaded["records"]) == 2
+
+
+def test_global_watch_default_disabled_for_suite():
+    # The repo's wrapped entry points ride the global WATCH: the test
+    # suite must not be paying signature bookkeeping unless a test
+    # explicitly enables it (none leave it on).
+    assert not cw.WATCH.enabled

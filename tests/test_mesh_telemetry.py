@@ -1,0 +1,285 @@
+"""Sharded flight recorder (r11): the r10 contract on the
+8-virtual-device rig.
+
+The load-bearing pins, per driver (islands, dimshard, the explicit
+shmap PSO, the election reduction, and the GSPMD swarm rollout):
+
+- **bitwise non-perturbation**: the telemetry-enabled run's final
+  state fingerprints identical to the disabled run — watching the
+  mesh cannot change it;
+- **telemetry-free disabled HLO**: lowering with ``telemetry=False``
+  produces byte-identical text to lowering with the kwarg omitted
+  (the gate is a trace-time Python ``if``, so the disabled program IS
+  the pre-recorder program), and the enabled text differs;
+- **mesh reduction semantics**: counts psum, maxima/ids pmax, and the
+  per-device residency pair (``shard_max_alive``/``shard_imbalance``)
+  reports real live-agent imbalance after an uneven kill.
+
+Runs on the same 8-virtual-CPU-device mesh as the rest of the
+parallel suite (conftest pins the XLA flag).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu.ops.es import es_init
+from distributed_swarm_algorithm_tpu.ops.objectives import rastrigin
+from distributed_swarm_algorithm_tpu.ops.pso import pso_init
+from distributed_swarm_algorithm_tpu.parallel.dimshard import (
+    DIM_AXIS,
+    es_run_dimshard,
+    pso_run_dimshard,
+    shard_es_dim,
+    shard_pso_dim,
+)
+from distributed_swarm_algorithm_tpu.parallel.islands import (
+    island_init,
+    island_run,
+)
+from distributed_swarm_algorithm_tpu.parallel.mesh import make_mesh
+from distributed_swarm_algorithm_tpu.parallel.multihost import (
+    describe_mesh,
+)
+from distributed_swarm_algorithm_tpu.parallel.sharding import (
+    elect_shmap,
+    pso_run_shmap,
+    shard_pso,
+    shard_swarm,
+    swarm_telemetry_shmap,
+)
+from distributed_swarm_algorithm_tpu.utils.replay import fingerprint
+from distributed_swarm_algorithm_tpu.utils.telemetry import (
+    NO_LEADER,
+    summarize_telemetry,
+)
+
+N_DEV = 8
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < N_DEV,
+    reason=f"needs {N_DEV} virtual devices (conftest XLA flag)",
+)
+
+
+def _devices():
+    return jax.devices()[:N_DEV]
+
+
+# ------------------------------------------------------------------ islands
+
+
+def test_island_recorder_bitwise_and_hlo():
+    st = island_init(rastrigin, N_DEV, 32, 8, 5.12, seed=0)
+    args = (st, rastrigin, 6)
+    kw = dict(migrate_every=2, migrate_k=2)
+    off = island_run(*args, **kw)
+    on, telem = island_run(*args, **kw, telemetry=True)
+    assert fingerprint(off) == fingerprint(on)
+    summ = summarize_telemetry(telem)
+    assert summ["ticks"] == 6
+    assert summ["alive_final"] == N_DEV * 32
+    assert 0 <= summ["leader_final"] < N_DEV      # best-owning island
+    assert summ["shard_max_alive"] == 32          # per-island pop
+    assert summ["shard_imbalance_max"] == 0
+    assert summ["first_nonfinite_step"] == -1
+    # Disabled lowering == kwarg-omitted lowering (the trace-time gate
+    # adds nothing); enabled lowering is a different program.
+    low = island_run.lower(*args, **kw, telemetry=False).as_text()
+    low_default = island_run.lower(*args, **kw).as_text()
+    low_on = island_run.lower(*args, **kw, telemetry=True).as_text()
+    assert low == low_default
+    assert low_on != low
+
+
+@pytest.mark.slow
+def test_island_recorder_sharded_over_mesh():
+    # The GSPMD twin of the tier-1 bitwise gate above — same program,
+    # island axis committed across the mesh (slow set; the tier-1
+    # budget keeps the uncommitted variant, which traces identically).
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_mesh(("islands",), devices=_devices())
+    st = island_init(rastrigin, N_DEV, 32, 8, 5.12, seed=0)
+    st = jax.tree_util.tree_map(
+        lambda x: jax.device_put(
+            x,
+            NamedSharding(
+                mesh,
+                P("islands")
+                if getattr(x, "ndim", 0) >= 1 and x.shape[0] == N_DEV
+                else P(),
+            ),
+        ),
+        st,
+    )
+    off = island_run(st, rastrigin, 4, migrate_every=2, migrate_k=2)
+    on, telem = island_run(
+        st, rastrigin, 4, migrate_every=2, migrate_k=2, telemetry=True
+    )
+    assert fingerprint(off) == fingerprint(on)
+    assert summarize_telemetry(telem)["ticks"] == 4
+
+
+# ----------------------------------------------------------------- dimshard
+
+
+def test_dimshard_pso_recorder_bitwise_and_hlo():
+    mesh = make_mesh((DIM_AXIS,), devices=_devices())
+    st = shard_pso_dim(
+        pso_init(rastrigin, n=64, dim=8 * N_DEV, half_width=5.12,
+                 seed=0),
+        mesh,
+    )
+    off = pso_run_dimshard(st, "rastrigin", mesh, 5)
+    on, telem = pso_run_dimshard(
+        st, "rastrigin", mesh, 5, telemetry=True
+    )
+    assert fingerprint(off) == fingerprint(on)
+    summ = summarize_telemetry(telem)
+    assert summ["ticks"] == 5
+    assert summ["alive_final"] == 64
+    assert summ["shard_max_alive"] == 8            # D-shard width
+    assert summ["shard_imbalance_max"] == 0
+    assert summ["speed_max"] > 0.0
+    low = pso_run_dimshard.lower(
+        st, "rastrigin", mesh, 5, telemetry=False
+    ).as_text()
+    low_default = pso_run_dimshard.lower(
+        st, "rastrigin", mesh, 5
+    ).as_text()
+    low_on = pso_run_dimshard.lower(
+        st, "rastrigin", mesh, 5, telemetry=True
+    ).as_text()
+    assert low == low_default
+    assert low_on != low
+
+
+def test_dimshard_es_recorder_bitwise():
+    mesh = make_mesh((DIM_AXIS,), devices=_devices())
+    st = shard_es_dim(
+        es_init(rastrigin, dim=8 * N_DEV, half_width=5.12, seed=0),
+        mesh,
+    )
+    off = es_run_dimshard(st, "rastrigin", mesh, 4, n=32)
+    on, telem = es_run_dimshard(
+        st, "rastrigin", mesh, 4, n=32, telemetry=True
+    )
+    assert fingerprint(off) == fingerprint(on)
+    summ = summarize_telemetry(telem)
+    assert summ["ticks"] == 4
+    assert summ["alive_final"] == 32               # ES population
+    assert summ["shard_max_alive"] == 8
+
+
+# --------------------------------------------------------------- shmap PSO
+
+
+def test_pso_shmap_recorder_bitwise_and_leader_shard():
+    mesh = make_mesh(("agents",), devices=_devices())
+    st = shard_pso(
+        pso_init(rastrigin, n=16 * N_DEV, dim=6, half_width=5.12,
+                 seed=0),
+        mesh,
+    )
+    off = pso_run_shmap(st, rastrigin, mesh, 5)
+    on, telem = pso_run_shmap(st, rastrigin, mesh, 5, telemetry=True)
+    assert fingerprint(off) == fingerprint(on)
+    summ = summarize_telemetry(telem)
+    assert summ["ticks"] == 5
+    assert summ["alive_final"] == 16 * N_DEV
+    # The incumbent best lives on SOME device every step (its pbest
+    # still equals the incumbent), so the holder index is a real shard.
+    assert 0 <= summ["leader_final"] < N_DEV
+    assert summ["shard_max_alive"] == 16
+    assert summ["shard_imbalance_max"] == 0
+
+
+# ------------------------------------------------- election + swarm residency
+
+
+def test_elect_shmap_telemetry_counts_residency_imbalance():
+    mesh = make_mesh(("agents",), devices=_devices())
+    n = 4 * N_DEV
+    s = dsa.make_swarm(n, seed=0, spread=4.0)
+    # Kill 3 agents that share shard 0 (ids 0..3 land there under
+    # P('agents') row sharding): residency [1, 4, 4, ...] -> spread 3.
+    s = dsa.kill(s, [0, 1, 2])
+    s = shard_swarm(s, mesh)
+    lid_plain = elect_shmap(s.alive, s.agent_id, mesh)
+    lid, rec = elect_shmap(s.alive, s.agent_id, mesh, telemetry=True)
+    assert int(lid) == int(lid_plain) == n - 1
+    assert int(rec.alive) == n - 3
+    assert int(rec.leader_id) == n - 1
+    assert int(rec.shard_max_alive) == 4
+    assert int(rec.shard_imbalance) == 3
+    # All-dead degenerate: leader NO_LEADER, counts zero.
+    dead = dsa.kill(dsa.make_swarm(n, seed=0), list(range(n)))
+    dead = shard_swarm(dead, mesh)
+    lid2, rec2 = elect_shmap(
+        dead.alive, dead.agent_id, mesh, telemetry=True
+    )
+    assert int(lid2) == NO_LEADER
+    assert int(rec2.alive) == 0
+    assert int(rec2.shard_imbalance) == 0
+
+
+def test_swarm_telemetry_shmap_matches_rollout_recorder():
+    mesh = make_mesh(("agents",), devices=_devices())
+    n = 4 * N_DEV
+    cfg = dsa.SwarmConfig()
+    s = dsa.make_swarm(n, seed=0, spread=6.0)
+    s = s.replace(
+        target=jnp.broadcast_to(jnp.asarray([3.0, 0.0]), s.pos.shape),
+        has_target=jnp.ones_like(s.has_target),
+    )
+    s = shard_swarm(s, mesh)
+    out, telem = dsa.swarm_rollout(s, None, cfg, 40, telemetry=True)
+    rec = swarm_telemetry_shmap(out, mesh)
+    summ = summarize_telemetry(telem)
+    # One-shot mesh collector agrees with the in-rollout recorder's
+    # final tick on the globally-reduced fields...
+    assert int(rec.alive) == summ["alive_final"]
+    assert int(rec.leader_id) == summ["leader_final"] == n - 1
+    assert int(rec.tick) == 40
+    # ...and adds what GSPMD cannot express: per-device residency.
+    assert int(rec.shard_max_alive) == 4
+    assert int(rec.shard_imbalance) == 0
+
+
+@pytest.mark.slow
+def test_sharded_rollout_recorder_bitwise():
+    # The GSPMD swarm path itself (dryrun axis 26's config): recorder
+    # on/off trajectories bitwise-equal with the agent axis sharded.
+    # Slow set (two full sharded hashgrid compiles); the same contract
+    # hard-gates in benchmarks/bench_multichip_telemetry.py (exit 2 on
+    # divergence) and dryrun_multichip axis 27 every round.
+    mesh = make_mesh(("agents",), devices=_devices())
+    cfg = dsa.SwarmConfig().replace(
+        separation_mode="hashgrid", world_hw=32.0,
+        grid_max_per_cell=16, hashgrid_backend="portable",
+        formation_shape="none",
+    )
+    s = dsa.make_swarm(16 * N_DEV, seed=1, spread=8.0)
+    s = dsa.with_tasks(s, jnp.asarray([[1.0, 1.0], [-2.0, 3.0]]))
+    s = shard_swarm(s, mesh)
+    off = dsa.swarm_rollout(s, None, cfg, 9)
+    on, telem = dsa.swarm_rollout(s, None, cfg, 9, telemetry=True)
+    assert fingerprint(off) == fingerprint(on)
+    summ = summarize_telemetry(telem)
+    assert summ["ticks"] == 9
+    assert summ["first_nonfinite_step"] == -1
+
+
+def test_describe_mesh_is_json_safe():
+    import json
+
+    mesh = make_mesh(("agents",), devices=_devices())
+    d = describe_mesh(mesh)
+    assert json.loads(json.dumps(d)) == d
+    assert d["axes"] == {"agents": N_DEV}
+    assert d["n_devices"] == N_DEV
+    assert d["n_processes"] == 1

@@ -194,6 +194,16 @@ SEEDED = {
             report(f"steps/sec, {n} agents", 1.0, "steps/sec", 0.0)
         """,
     ),
+    "scope-fstring": (
+        "pkg/scopename.py",
+        """
+        import jax
+
+        def tick(x, i):
+            with jax.named_scope(f"tick_{i}"):
+                return x + 1
+        """,
+    ),
 }
 
 
@@ -368,6 +378,23 @@ def test_each_rule_fires_exactly_once_on_seeded_tree(tmp_path):
                 out, ys = jax.lax.scan(body, pos, None, length=n_steps)
                 out, _ = jax.lax.scan(body2, out, None, length=n_steps)
                 return out, ys
+            """,
+        ),
+        # named_scope with a literal, a module constant, or a bare
+        # variable is the stable-name pattern: no scope-fstring
+        # finding (only syntactically-dynamic names flag).
+        (
+            "scope_literal",
+            """
+            import jax
+
+            PHASE = "integrate"
+
+            def tick(x, label):
+                with jax.named_scope("separation_dispatch"):
+                    with jax.named_scope(PHASE):
+                        with jax.named_scope(label):
+                            return x + 1
             """,
         ),
         # `x is None` presence checks never concretize a tracer.

@@ -1,0 +1,236 @@
+"""swarmscope run inspector (r11): run directories, the diff gate,
+and the BENCH_HISTORY trajectory view.
+
+The diff's acceptance contract: ``swarmscope diff A B`` exits nonzero
+and NAMES the regressed fixed-name rows when a gated metric
+regresses, and exits zero otherwise.  The gating rules must agree
+with benchmarks/compare.py (the union gate) — the cross-check test
+drives both over the same pairs.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+
+from distributed_swarm_algorithm_tpu.cli import main as cli_main
+from distributed_swarm_algorithm_tpu.utils import rundir
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+spec = importlib.util.spec_from_file_location(
+    "bench_compare", os.path.join(ROOT, "benchmarks", "compare.py")
+)
+compare = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(compare)
+
+
+def _mk_run(path, label, metrics):
+    rundir.create_run_dir(str(path), label=label, backend="cpu")
+    rundir.append_metrics(
+        str(path),
+        [
+            {"metric": m, "value": v, "unit": u, "vs_baseline": None}
+            for m, v, u in metrics
+        ],
+    )
+    return str(path)
+
+
+BASE = [
+    ("agent-steps/sec, station 65536", 1000.0, "agent-steps/sec"),
+    ("truncation-events, station 65536", 0.0, "events"),
+    ("telemetry-overhead-pct, station 65536", 2.0, "pct"),
+    ("compile-count, swarm-rollout 4096", 1.0, "compiles"),
+]
+
+
+def test_run_dir_roundtrip(tmp_path):
+    run = _mk_run(tmp_path / "ra", "ra", BASE)
+    rundir.merge_telemetry_summary(run, "station", {"ticks": 100})
+    rundir.merge_telemetry_summary(run, "station", {"ticks": 101})
+    rundir.append_events(run, [{"event": "leader-change", "tick": 3}])
+    data = rundir.load_run(run)
+    assert data.label == "ra"
+    assert len(data.metrics) == len(BASE)
+    assert data.telemetry == {"station": {"ticks": 101}}
+    assert data.events == [{"event": "leader-change", "tick": 3}]
+    # Failure records (value null) are diagnostics, not metrics.
+    rundir.append_metrics(
+        run, [{"metric": "bench-failure, x", "value": None,
+               "unit": "failure", "error": "rc=1"}]
+    )
+    data = rundir.load_run(run)
+    assert len(data.metrics) == len(BASE)
+    assert [f["metric"] for f in data.failures] == ["bench-failure, x"]
+
+
+def test_diff_clean_exits_zero(tmp_path, capsys):
+    a = _mk_run(tmp_path / "ra", "ra", BASE)
+    b = _mk_run(tmp_path / "rb", "rb", BASE)
+    assert cli_main(["swarmscope", "diff", a, b]) == 0
+    out = capsys.readouterr().out
+    assert "no gated regressions" in out
+
+
+def test_diff_names_regressed_rows_and_exits_nonzero(tmp_path, capsys):
+    a = _mk_run(tmp_path / "ra", "ra", BASE)
+    bad = [
+        # throughput -40% -> gates
+        ("agent-steps/sec, station 65536", 600.0, "agent-steps/sec"),
+        # clean 0 -> positive count: gates
+        ("truncation-events, station 65536", 3.0, "events"),
+        # above the absolute 5% ceiling: gates
+        ("telemetry-overhead-pct, station 65536", 7.5, "pct"),
+        # compile count doubled: gates
+        ("compile-count, swarm-rollout 4096", 2.0, "compiles"),
+    ]
+    b = _mk_run(tmp_path / "rb", "rb", bad)
+    rc = cli_main(["swarmscope", "diff", a, b])
+    captured = capsys.readouterr()
+    assert rc == 1
+    for name, _, _ in bad:
+        assert name in captured.err      # every regressed row is named
+    assert "4 gated regression(s)" in captured.err
+
+
+def test_diff_improvements_do_not_gate(tmp_path):
+    a = _mk_run(tmp_path / "ra", "ra", BASE)
+    better = [
+        ("agent-steps/sec, station 65536", 2000.0, "agent-steps/sec"),
+        ("truncation-events, station 65536", 0.0, "events"),
+        ("telemetry-overhead-pct, station 65536", 0.5, "pct"),
+        ("compile-count, swarm-rollout 4096", 1.0, "compiles"),
+    ]
+    b = _mk_run(tmp_path / "rb", "rb", better)
+    assert cli_main(["swarmscope", "diff", a, b]) == 0
+
+
+def test_gate_semantics_agree_with_compare(tmp_path):
+    # The two implementations of the gating rules (compare.py's union
+    # gate, rundir.gate for run-dir diffs) must return the same
+    # verdicts — drive compare.compare over recorded rounds and
+    # rundir.gate over the same pairs.
+    cases = [
+        # (unit, prev, cur, expect_regression)
+        ("agent-steps/sec", 100.0, 75.0, True),
+        ("agent-steps/sec", 100.0, 85.0, False),
+        ("events", 0.0, 1.0, True),
+        ("events", 5.0, 4.0, False),
+        ("ticks", 10.0, 13.0, True),
+        ("compiles", 1.0, 2.0, True),
+        ("compiles", 2.0, 2.0, False),
+        ("pct", 1.0, 4.9, False),
+        ("pct", 1.0, 5.1, True),
+        ("rounds", 4.0, 4.5, False),
+    ]
+    for i, (unit, prev, cur, expect) in enumerate(cases):
+        assert (
+            rundir.gate(unit, prev, cur) == "REGRESSION"
+        ) is expect, (unit, prev, cur)
+        hist = str(tmp_path / f"h{i}.json")
+        compare.record(
+            "r01", [{"metric": "m", "value": prev, "unit": unit}],
+            path=hist,
+        )
+        compare.record(
+            "r02", [{"metric": "m", "value": cur, "unit": unit}],
+            path=hist,
+        )
+        n_bad = compare.compare("r01", "r02", path=hist)
+        assert (n_bad > 0) is expect, (unit, prev, cur)
+
+
+def test_summary_renders_run(tmp_path, capsys):
+    run = _mk_run(tmp_path / "ra", "ra", BASE)
+    rundir.merge_telemetry_summary(
+        run, "station",
+        {"ticks": 100, "rebuilds_per_100_ticks": 6.0,
+         "truncation_events": 0, "first_nonfinite_step": -1,
+         "shard_imbalance_max": 0},
+    )
+    os.makedirs(os.path.join(run, rundir.COMPILE_DIR), exist_ok=True)
+    with open(os.path.join(run, rundir.COMPILE_DIR, "p.json"),
+              "w") as fh:
+        json.dump(
+            {
+                "entries": {"swarm-rollout": {"compiles": 1,
+                                              "wall_s": 2.5}},
+                "events": [
+                    {"event": "retrace-storm", "entry": "toy",
+                     "compiles": 7}
+                ],
+                "records": [],
+            },
+            fh,
+        )
+    assert cli_main(["swarmscope", "summary", run]) == 0
+    out = capsys.readouterr().out
+    assert "run ra" in out
+    assert "metrics: 4" in out
+    assert "telemetry [station]" in out
+    assert "compiles [swarm-rollout]: 1" in out
+    assert "RETRACE STORM" in out
+
+
+def test_summary_missing_dir_is_a_cli_error(tmp_path, capsys):
+    rc = cli_main(
+        ["swarmscope", "summary", str(tmp_path / "nope")]
+    )
+    assert rc == 2
+    assert "no such run directory" in capsys.readouterr().err
+
+
+def test_history_trajectory(tmp_path, capsys):
+    hist = str(tmp_path / "BENCH_HISTORY.json")
+    for label, val in (("r02", 100.0), ("r09", 140.0), ("r10", 150.0)):
+        compare.record(
+            label,
+            [{"metric": "agent-steps/sec, station", "value": val,
+              "unit": "agent-steps/sec"}],
+            path=hist,
+        )
+    rc = cli_main(
+        ["swarmscope", "history", "agent-steps/sec, station",
+         "--file", hist]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out.splitlines()
+    assert [ln.split()[0] for ln in out] == ["r02", "r09", "r10"]
+    assert "+7.1%" in out[2]                 # 140 -> 150
+    # Substring match finds the row family too.
+    assert cli_main(
+        ["swarmscope", "history", "station", "--file", hist]
+    ) == 0
+    capsys.readouterr()
+    rc = cli_main(
+        ["swarmscope", "history", "no-such-metric", "--file", hist]
+    )
+    assert rc == 1
+
+
+def test_history_resolves_one_family_not_a_mix(tmp_path):
+    # A later round adds a SECOND row containing the query substring
+    # ("multichip-telemetry-overhead-pct" contains
+    # "telemetry-overhead-pct", and sorts FIRST alphabetically): the
+    # trajectory must stay within one metric family — the one
+    # recorded in the most rounds — not stitch the two together.
+    hist = str(tmp_path / "h.json")
+    compare.record("r10", [
+        {"metric": "telemetry-overhead-pct, 65536 agents (cpu)",
+         "value": 3.4, "unit": "pct"},
+    ], path=hist)
+    compare.record("r11", [
+        {"metric": "multichip-telemetry-overhead-pct, 8 devices (cpu)",
+         "value": 0.5, "unit": "pct"},
+        {"metric": "telemetry-overhead-pct, 65536 agents (cpu)",
+         "value": 3.1, "unit": "pct"},
+    ], path=hist)
+    rows = rundir.history_rows("telemetry-overhead-pct", hist)
+    assert [(r, v) for r, v, _ in rows] == [("r10", 3.4), ("r11", 3.1)]
+    # An exact name still wins outright.
+    rows = rundir.history_rows(
+        "multichip-telemetry-overhead-pct, 8 devices (cpu)", hist
+    )
+    assert [(r, v) for r, v, _ in rows] == [("r11", 0.5)]

@@ -243,6 +243,8 @@ def test_nan_onset_mid_series_reducer():
         plan_rebuilds=z32,
         cap_overflow=z32,
         cand_overflow=z32,
+        shard_max_alive=np.full(n, 4, np.int32),
+        shard_imbalance=z32,
     )
     summ = tl.summarize_telemetry(telem)
     assert summ["first_nonfinite_step"] == 3

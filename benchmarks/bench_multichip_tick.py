@@ -1,0 +1,197 @@
+"""Spatially-sharded protocol tick on the 8-virtual-device rig (r12).
+
+Revives the MULTICHIP bench lineage (last real row: MULTICHIP_r05)
+for the thing ROADMAP item 1 actually wanted measured: ONE swarm
+domain-decomposed across the mesh — per-tile hashgrid plans, ring
+``ppermute`` halo exchange at strip boundaries, election/allocation
+as the existing cross-shard collectives (``parallel/spatial.py``).
+
+Three fixed-name row families (cpu; the script pins the virtual rig
+itself — indicative on an oversubscribed host, the scaling claim
+needs real chips):
+
+  multichip-sharded-tick, ...         agent-steps/s at 1M agents
+  halo-exchange-bytes-per-tick, ...   unit "bytes" (lower-is-better,
+                                      r12 — the halo-volume model of
+                                      docs/PERFORMANCE.md r12 at the
+                                      MEASURED rebuild rate)
+  shard-imbalance-agents, ...         unit "events" (lower-is-better
+                                      count: max - min per-tile live
+                                      agents — real spatial load
+                                      imbalance, the number the r11
+                                      residency counters existed for)
+
+plus the standard recorder rows (truncation / rebuild rate) via
+``common.telemetry_rows``.
+
+The run gates itself twice before reporting: a small-N sharded-vs-
+single-device parity check (positions bitwise by agent id — the
+tests/test_spatial_shard.py contract, exit 2 on divergence), and the
+1M residency bound (per-device live agents <= tile capacity, i.e. no
+per-device full-swarm copy — the ROADMAP "pod scale" invariant).
+
+Usage: python benchmarks/bench_multichip_tick.py [--small]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# Own-subprocess contract (run_all): pin the 8-virtual-device CPU rig
+# before jax initializes — this bench never wants the tunnel chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from common import report, telemetry_rows, timeit_best
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu.parallel.mesh import make_mesh
+from distributed_swarm_algorithm_tpu.parallel.spatial import (
+    SPATIAL_AXIS,
+    gather_by_id,
+    halo_bytes_per_tick,
+    spatial_shard_swarm,
+)
+from distributed_swarm_algorithm_tpu.utils.telemetry import (
+    summarize_telemetry,
+    telemetry_events,
+)
+
+N_DEV = 8
+N = 1_000_000
+# ~0.24 agents/unit^2: the cap-clean density regime (grid cells hold
+# a handful of agents, grid_max_per_cell=24 and the W=64 candidate
+# table never truncate — the r9 sizing guidance; the truncation rows
+# below gate that this stays true).
+HW = 1024.0
+STEPS = 4
+PARITY_N = 4096
+PARITY_HW = 64.0
+PARITY_STEPS = 8
+TAG = "8 devices 1m agents 4 ticks station-keeping (cpu)"
+
+
+def _cfg(hw: float) -> dsa.SwarmConfig:
+    return dsa.SwarmConfig().replace(
+        separation_mode="hashgrid", world_hw=hw,
+        formation_shape="none", hashgrid_backend="portable",
+        grid_max_per_cell=24, max_speed=1.0, hashgrid_skin=1.0,
+    )
+
+
+def _station_swarm(n: int, hw: float) -> dsa.SwarmState:
+    s = dsa.make_swarm(n, seed=0, spread=hw * 0.9)
+    return s.replace(
+        target=jnp.asarray(s.pos),
+        has_target=jnp.ones_like(s.has_target),
+    )
+
+
+def _parity_gate(mesh) -> bool:
+    """Small-N sharded == single-device, positions bitwise by id."""
+    cfg = _cfg(PARITY_HW)
+    s = _station_swarm(PARITY_N, PARITY_HW)
+    ts, spec = spatial_shard_swarm(s, mesh, cfg)
+    ref = dsa.swarm_rollout(s, None, cfg, PARITY_STEPS)
+    out = dsa.swarm_rollout(
+        ts, None, cfg, PARITY_STEPS, mesh=mesh, spatial=spec
+    )
+    got = np.asarray(gather_by_id(out.pos, out.agent_id, PARITY_N))
+    return np.array_equal(np.asarray(ref.pos), got)
+
+
+def main() -> int:
+    small = "--small" in sys.argv[1:]
+    n, hw, tag = (65536, 256.0, TAG.replace("1m", "65k")) if small \
+        else (N, HW, TAG)
+    devices = jax.devices()[:N_DEV]
+    if len(devices) < N_DEV:
+        print(f"# bench_multichip_tick: need {N_DEV} devices, have "
+              f"{len(devices)} — skipping")
+        return 0
+    mesh = make_mesh((SPATIAL_AXIS,), devices=devices)
+
+    if not _parity_gate(mesh):
+        print("# PARITY FAILURE: sharded tick diverged from the "
+              "single-device hashgrid tick at the small-N gate",
+              file=sys.stderr)
+        return 2
+
+    cfg = _cfg(hw)
+    s = _station_swarm(n, hw)
+    ts, spec = spatial_shard_swarm(s, mesh, cfg)
+
+    holder = {}
+
+    def run():
+        holder["out"] = dsa.swarm_rollout(
+            ts, None, cfg, STEPS, mesh=mesh, spatial=spec,
+            telemetry=True, return_plan=True,
+        )
+
+    run()
+    (out, telem), carry = holder["out"]
+    jax.block_until_ready(out.pos)
+
+    def sync():
+        (o, _), _ = holder["out"]
+        return float(o.pos[0, 0])
+
+    sec = timeit_best(run, sync, reps=2)
+    (out, telem), carry = holder["out"]
+    summ = summarize_telemetry(telem)
+
+    # Residency bound: the per-device live array is the tile block +
+    # halo, never a full-swarm copy.
+    assert summ["shard_max_alive"] <= spec.capacity, (
+        summ["shard_max_alive"], spec.capacity)
+    rebuild_rate = summ["rebuilds_per_100_ticks"] / 100.0
+    bytes_tick = halo_bytes_per_tick(spec, rebuild_rate)
+    escapes = int(np.asarray(carry.escapes).sum())
+    halo_ovf = int(np.asarray(carry.halo_overflow).sum())
+    print(
+        f"# sharded tick (N={n}, {N_DEV} tiles, {STEPS} ticks): "
+        f"{sec / STEPS * 1e3:.0f} ms/tick; residency max "
+        f"{summ['shard_max_alive']}/{spec.capacity} agents/tile, "
+        f"imbalance {summ['shard_imbalance_max']}; "
+        f"rebuilds/100t {summ['rebuilds_per_100_ticks']:.1f}; "
+        f"escapes {escapes}, halo_overflow {halo_ovf}; halo "
+        f"{bytes_tick / 1024:.0f} KiB/tick"
+    )
+    report(
+        # swarmlint: disable=metric-fstring -- tag is a module constant; names are stable cross-round pins
+        f"multichip-sharded-tick, {tag}",
+        n * STEPS / sec, "agent-steps/sec", 40_000.0,
+    )
+    report(
+        # swarmlint: disable=metric-fstring -- tag is a module constant; names are stable cross-round pins
+        f"halo-exchange-bytes-per-tick, {tag}",
+        bytes_tick, "bytes", 0.0,
+    )
+    report(
+        # swarmlint: disable=metric-fstring -- tag is a module constant; names are stable cross-round pins
+        f"shard-imbalance-agents, {tag}",
+        float(summ["shard_imbalance_max"]), "events", 0.0,
+    )
+    telemetry_rows(summ, tag)
+    run_dir = os.environ.get("DSA_RUN_DIR")
+    if run_dir:
+        from distributed_swarm_algorithm_tpu.utils import rundir
+
+        rundir.merge_telemetry_summary(run_dir, tag, summ)
+        rundir.append_events(run_dir, telemetry_events(telem))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

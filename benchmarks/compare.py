@@ -21,8 +21,11 @@ run_all's static gate), "rounds" (auction convergence / plan-rebuild
 rates, r8/r10), "events" (flight-recorder truncation / leader-churn
 counts, r10), "ticks" (recovery latency, bench_recovery — a
 LATENCY, which the pre-r10 throughput branch silently gated
-backwards), and "compiles" (compile-observatory cache-entry counts,
-r11 — a retrace storm is a count regression) are lower-is-better and
+backwards), "compiles" (compile-observatory cache-entry counts,
+r11 — a retrace storm is a count regression), and "bytes"
+(cross-shard traffic volume — the sharded tick's halo-exchange
+bytes/tick, r12: growth means the boundary exchange stopped being
+thin) are lower-is-better and
 gate on growth (a clean 0 baseline regressing to any positive count
 always gates); unit "pct" (telemetry overhead, r10; multichip
 telemetry overhead, r11) is lower-is-better against an ABSOLUTE
@@ -152,13 +155,15 @@ def compare(prev_label: str, cur_label: str, threshold: float = 0.2,
         pv = float(prev[key][1]["value"])
         cv = float(cur[key][1]["value"])
         unit = str(cur[key][1].get("unit", ""))
-        if unit in ("findings", "rounds", "events", "ticks", "compiles"):
+        if unit in ("findings", "rounds", "events", "ticks",
+                    "compiles", "bytes"):
             # Lower-is-better count metrics (swarmlint hygiene debt;
             # auction convergence rounds, r8; flight-recorder
             # truncation/churn counts and recovery-latency ticks,
-            # r10; compile-observatory cache entries, r11): gate on
-            # growth, never on paydown.  A clean baseline (0)
-            # regressing to any positive count always gates.
+            # r10; compile-observatory cache entries, r11;
+            # halo-exchange traffic bytes, r12): gate on growth,
+            # never on paydown.  A clean baseline (0) regressing to
+            # any positive count always gates.
             status = "ok"
             if cv > pv * (1.0 + threshold) or (pv == 0 and cv > 0):
                 status = "REGRESSION"

@@ -92,6 +92,12 @@ BENCHES = [
     # residency/imbalance rows — the multichip twin of
     # bench_telemetry.
     "bench_multichip_telemetry.py",
+    # r12: the spatially-sharded protocol tick — 1M-agent sharded
+    # throughput, halo-exchange bytes/tick (unit "bytes",
+    # lower-is-better), and the per-tile live-agent imbalance row;
+    # self-gates on small-N sharded-vs-single bitwise parity before
+    # reporting (the revived MULTICHIP lineage).
+    "bench_multichip_tick.py",
 ]
 
 # Extra argv for benches whose no-arg default is not the gate set —
@@ -134,6 +140,7 @@ QUICK_SKIP = {
     "bench_telemetry.py",
     "bench_compile_count.py",
     "bench_multichip_telemetry.py",
+    "bench_multichip_tick.py",
 }
 
 

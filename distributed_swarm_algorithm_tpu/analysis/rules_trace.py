@@ -28,6 +28,14 @@
   name is a retrace hazard (and shreds XProf trace aggregation, which
   groups by exact scope string) exactly like a run-varying metric
   name shreds the bench union gate.
+- ``halo-width``: a ``shard_map`` body that builds or consumes a
+  per-shard ``HashgridPlan`` with NO halo exchange reachable in its
+  scope silently drops every pair that straddles a shard boundary —
+  the plan's 3x3 stencil only covers agents the shard actually
+  holds, so without boundary agents shipped in (``lax.ppermute``
+  payloads sized for ``personal_space + skin``,
+  parallel/spatial.py) the "exact" sharded tick is quietly wrong at
+  every tile seam.
 """
 
 from __future__ import annotations
@@ -472,6 +480,123 @@ class ScopeStringRule(Rule):
                 "value is a fresh trace annotation (retrace hazard); "
                 "use a literal",
             )
+
+
+# ---------------------------------------------------------------------------
+# halo-width
+
+#: Plan producers/consumers whose presence in a shard_map body means
+#: the body runs a PER-SHARD spatial index.
+_PLAN_CALLS = frozenset(
+    {"build_hashgrid_plan", "refresh_plan", "separation_grid_plan"}
+)
+
+#: Call leaves that count as a halo exchange being in scope: the ring
+#: collectives themselves, or a helper named for the job.
+_EXCHANGE_LEAVES = frozenset({"ppermute", "pshuffle"})
+
+
+def _shard_map_bodies(mod: ModuleInfo):
+    """FunctionDef/Lambda nodes that run as shard_map bodies: direct
+    ``shard_map(f, ...)`` calls, and defs decorated with
+    ``@partial(shard_map, ...)`` (the repo idiom)."""
+    by_name: dict = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, []).append(node)
+    bodies: set = set()
+
+    def is_shard_map(expr) -> bool:
+        name = mod.resolve(expr)
+        return bool(name) and name.rsplit(".", 1)[-1] == "shard_map"
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if (
+                    isinstance(dec, ast.Call)
+                    and mod.resolve(dec.func) in (
+                        "functools.partial", "partial"
+                    )
+                    and dec.args
+                    and is_shard_map(dec.args[0])
+                ):
+                    bodies.add(node)
+        if isinstance(node, ast.Call) and is_shard_map(node.func):
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Lambda):
+                    bodies.add(arg)
+                elif isinstance(arg, ast.Name):
+                    bodies.update(by_name.get(arg.id, []))
+    return bodies, by_name
+
+
+@register
+class HaloWidthRule(Rule):
+    id = "halo-width"
+    summary = "per-shard HashgridPlan without a halo exchange in scope"
+    details = (
+        "A shard_map body building or sweeping a HashgridPlan sees "
+        "only its own shard's agents: without a halo exchange "
+        "(lax.ppermute of boundary agents, band depth "
+        "personal_space + skin — see parallel/spatial.py) every "
+        "pair straddling a shard boundary is silently dropped, so "
+        "the sharded tick is quietly wrong at every tile seam.  Ship "
+        "boundary agents before consuming the plan, or run the plan "
+        "on the full (unsharded) swarm."
+    )
+
+    def check(self, mod: ModuleInfo):
+        bodies, by_name = _shard_map_bodies(mod)
+        for fn in bodies:
+            # Reachable local-function closure: the exchange (and the
+            # plan call) routinely live in helpers the body calls.
+            seen_fns: set = set()
+            frontier = [fn]
+            plan_calls: list = []
+            has_exchange = False
+            while frontier:
+                cur = frontier.pop()
+                if id(cur) in seen_fns:
+                    continue
+                seen_fns.add(id(cur))
+                stmts = (
+                    cur.body if isinstance(cur.body, list)
+                    else [cur.body]
+                )
+                for st in stmts:
+                    for node in ast.walk(st):
+                        if not isinstance(node, ast.Call):
+                            continue
+                        name = mod.resolve(node.func) or ""
+                        leaf = name.rsplit(".", 1)[-1]
+                        if leaf in _PLAN_CALLS:
+                            plan_calls.append(node)
+                        if leaf in _EXCHANGE_LEAVES or (
+                            "collective_permute" in name
+                        ):
+                            has_exchange = True
+                        if isinstance(node.func, ast.Name):
+                            for cand in by_name.get(node.func.id, []):
+                                frontier.append(cand)
+            if has_exchange:
+                continue
+            seen_sites: set = set()
+            for call in plan_calls:
+                site = (call.lineno, call.col_offset)
+                if site in seen_sites:
+                    continue
+                seen_sites.add(site)
+                name = mod.resolve(call.func) or ""
+                leaf = name.rsplit(".", 1)[-1]
+                yield mod.finding(
+                    self.id, call,
+                    f"`{leaf}` in a shard_map body with no halo "
+                    "exchange in scope — cross-shard neighbor pairs "
+                    "are silently dropped; ppermute boundary agents "
+                    "(band depth personal_space + skin) before "
+                    "consuming a per-shard plan",
+                )
 
 
 # ---------------------------------------------------------------------------

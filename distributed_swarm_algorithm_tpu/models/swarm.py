@@ -26,8 +26,10 @@ from ..ops.coordination import coordination_step, current_leader, kill, revive
 from ..ops.neighbors import morton_keys as _morton_keys
 from ..ops.physics import (
     build_tick_plan,
+    build_tick_plan_spatial,
     physics_step,
     physics_step_plan,
+    physics_step_spatial,
     physics_step_telem,
 )
 from ..state import (
@@ -354,6 +356,86 @@ def _swarm_rollout_impl(
     return compose(state, traj, telem, None)
 
 
+def _swarm_tick_spatial(
+    state: SwarmState,
+    obstacles: Optional[jax.Array],
+    cfg: SwarmConfig,
+    carry,
+    spec,
+    mesh,
+):
+    """The spatially-sharded tick (r12): same protocol prefix — the
+    coordination/allocation reductions stay the existing cross-shard
+    collectives GSPMD lowers them to — then physics off the per-tile
+    halo'd Verlet plans (``ops/physics.physics_step_spatial``).
+    Plain (un-jitted): it only runs inside the spatial rollout scan."""
+    state = _protocol_steps(state, cfg, sort_in_tick=False)
+    return physics_step_spatial(state, obstacles, cfg, carry, spec,
+                                mesh)
+
+
+@watched("swarm-rollout-spatial")
+@partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "n_steps", "mesh", "spatial", "record", "return_plan",
+        "telemetry",
+    ),
+)
+def _swarm_rollout_spatial_impl(
+    state: SwarmState,
+    obstacles: Optional[jax.Array],
+    cfg: SwarmConfig,
+    n_steps: int,
+    mesh,
+    spatial,
+    record: bool = False,
+    return_plan: bool = False,
+    telemetry: bool = False,
+):
+    """``n_steps`` spatially-sharded ticks under one ``lax.scan`` —
+    the mesh-native rollout (r12, ROADMAP item 1).  ``state`` must be
+    the tiled layout from ``parallel/spatial.spatial_shard_swarm``
+    and ``spatial`` its :class:`~..parallel.spatial.SpatialSpec`; the
+    scan carry is ``(state, SpatialCarry)`` — per-tile halo membership
+    + per-tile Verlet plans, seeded by ``build_tick_plan_spatial`` and
+    rebuilt inside the tick under the mesh-OR'd r9 triggers.
+
+    Result composition mirrors ``_swarm_rollout_impl``: ``record``
+    returns id-ordered ``[n_steps, n_slots, D]`` frames (padding slots
+    ride as zero rows past the real swarm), ``telemetry`` appends the
+    stacked recorder ys (residency counters filled from real per-tile
+    live counts), ``return_plan`` appends the final
+    ``SpatialCarry`` — its per-tile ``plan.rebuilds``/``escapes``/
+    ``halo_overflow`` are the sharded-tick observability surface."""
+    telem_on = telemetry or cfg.telemetry.enabled
+    if telem_on and not cfg.telemetry.enabled:
+        cfg = cfg.replace(telemetry=TELEMETRY_ON)
+    carry0 = build_tick_plan_spatial(state, cfg, spatial, mesh)
+
+    def body(carry, _):
+        s, c = carry
+        s, c, telem = _swarm_tick_spatial(
+            s, obstacles, cfg, c, spatial, mesh
+        )
+        frame = None
+        if record:
+            # Tiled slots are not id-ordered: unscramble like the
+            # window mode does (ids are unique over the padded slots).
+            frame = jnp.zeros_like(s.pos).at[s.agent_id].set(s.pos)
+        return (s, c), (frame, telem)
+
+    (state, carry), (traj, telem) = jax.lax.scan(
+        body, (state, carry0), None, length=n_steps
+    )
+    out = (state, traj) if record else state
+    if telem_on:
+        if not n_steps:
+            telem = None
+        out = out + (telem,) if record else (out, telem)
+    return (out, carry) if return_plan else out
+
+
 def swarm_rollout(
     state: SwarmState,
     obstacles: Optional[jax.Array],
@@ -362,6 +444,8 @@ def swarm_rollout(
     record: bool = False,
     return_plan: bool = False,
     telemetry: bool = False,
+    mesh=None,
+    spatial=None,
 ) -> Union[SwarmState, Tuple[SwarmState, jax.Array]]:
     """``n_steps`` ticks under one ``lax.scan`` — ``_swarm_rollout_impl``
     behind the eager multi-device hash-grid guard (see
@@ -372,7 +456,35 @@ def swarm_rollout(
     ``telemetry``: enable the in-scan flight recorder for this rollout
     — the stacked per-tick ``TickTelemetry`` joins the result (see
     ``_swarm_rollout_impl``; ``utils/telemetry.summarize_telemetry``
-    reduces it to a JSON-safe dict)."""
+    reduces it to a JSON-safe dict).
+
+    ``mesh`` + ``spatial`` (r12): run the SPATIALLY-SHARDED tick —
+    one swarm domain-decomposed across the mesh's tile axis with halo
+    exchange at strip boundaries (``parallel/spatial.py``; ``state``
+    must come from ``spatial_shard_swarm``, which also returns the
+    ``spatial`` spec).  ``return_plan`` then appends the final
+    ``SpatialCarry`` instead of a single plan."""
+    if mesh is not None:
+        if spatial is None:
+            raise ValueError(
+                "swarm_rollout(mesh=...) runs the spatially-sharded "
+                "tick and needs its SpatialSpec: pass spatial= (both "
+                "come from parallel.spatial.spatial_shard_swarm)"
+            )
+        return _swarm_rollout_spatial_impl(
+            state, obstacles, cfg, n_steps, mesh, spatial,
+            record, return_plan, telemetry,
+        )
+    if spatial is not None:
+        # The inverse half-call must not silently run the
+        # single-device path on a tiled state (return_plan would
+        # then hand back a HashgridPlan where the caller expects a
+        # SpatialCarry — an AttributeError far from the cause).
+        raise ValueError(
+            "swarm_rollout(spatial=...) needs the mesh too: pass "
+            "mesh= (the one spatial_shard_swarm committed the state "
+            "over)"
+        )
     return _swarm_rollout_impl(
         state, obstacles, _hashgrid_multidevice_cfg(state, cfg),
         n_steps, record, return_plan, telemetry,

@@ -259,6 +259,7 @@ def build_hashgrid_plan(
     g: Optional[int] = None,
     skin: float = 0.0,
     neighbor_cap: int = 0,
+    tiebreak: Optional[jax.Array] = None,
 ) -> HashgridPlan:
     """:func:`_build_hashgrid_plan_impl` under the ``hashgrid_plan_
     build`` named scope — the plan build is the tick's scatter-class
@@ -269,7 +270,7 @@ def build_hashgrid_plan(
             pos, alive, torus_hw, cell, max_per_cell,
             need_csr=need_csr, field_sep_cell=field_sep_cell,
             field_align_cell=field_align_cell, g=g, skin=skin,
-            neighbor_cap=neighbor_cap,
+            neighbor_cap=neighbor_cap, tiebreak=tiebreak,
         )
 
 
@@ -285,6 +286,7 @@ def _build_hashgrid_plan_impl(
     g: Optional[int] = None,
     skin: float = 0.0,
     neighbor_cap: int = 0,
+    tiebreak: Optional[jax.Array] = None,
 ) -> HashgridPlan:
     """Build the shared plan: one binning + one stable cell sort.
 
@@ -333,6 +335,16 @@ def _build_hashgrid_plan_impl(
     ``cell_eff >= r + skin`` exactly as the stencil path does.
     Requires ``g >= 3`` (a smaller torus would duplicate wrapped
     stencil cells and double-count pairs).
+
+    ``tiebreak`` (r12, the spatially-sharded tick): an optional [N]
+    i32 of UNIQUE per-agent keys used as the within-cell sort order
+    in place of the array position.  A per-shard plan built over a
+    local + halo slice orders each cell's members by GLOBAL agent id
+    this way, so the within-cell candidate order (and hence the fp
+    summation order and the cap-truncation set) matches the
+    single-device plan's — the parity lever
+    ``parallel/spatial.py`` leans on.  ``None`` (every existing
+    caller) is bitwise-identical to the pre-r12 build.
     """
     from .grid_moments import commensurate_geometry, fine_cell_keys
     from .neighbors import torus_cell_tables
@@ -348,11 +360,22 @@ def _build_hashgrid_plan_impl(
     # counts live agents only).
     key = jnp.where(alive, key_raw, g * g)
     iota = jnp.arange(n, dtype=jnp.int32)
-    # One variadic sort, iota tie-break = stability without is_stable
-    # (the exact r5 kernel build, now shared by every consumer).
-    skey, order, sx, sy = jax.lax.sort(
-        (key, iota, pos[:, 0], pos[:, 1]), num_keys=2
-    )
+    if tiebreak is None:
+        # One variadic sort, iota tie-break = stability without
+        # is_stable (the exact r5 kernel build, now shared by every
+        # consumer).
+        skey, order, sx, sy = jax.lax.sort(
+            (key, iota, pos[:, 0], pos[:, 1]), num_keys=2
+        )
+    else:
+        # Caller-supplied unique within-cell order (global agent ids
+        # for the per-shard spatial plans): same one-sort build, the
+        # tiebreak column keyed instead of the array position.
+        skey, _, order, sx, sy = jax.lax.sort(
+            (key, tiebreak.astype(jnp.int32), iota,
+             pos[:, 0], pos[:, 1]),
+            num_keys=2,
+        )
     run_start = jnp.where(
         skey != jnp.concatenate([skey[:1] - 1, skey[:-1]]), iota, 0
     )

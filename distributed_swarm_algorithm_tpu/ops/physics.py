@@ -328,17 +328,17 @@ def apf_forces(
     return apf_forces_plan(state, obstacles, cfg, plan)[0]
 
 
-def apf_forces_plan(
+def _apf_point_forces(
     state: SwarmState,
     obstacles: Optional[jax.Array],
     cfg: SwarmConfig,
-    plan=None,
-):
-    """(force [N, D], plan-or-None): :func:`apf_forces` that also
-    hands back the hashgrid plan the tick dispatched on (the one it
-    was passed, or the one it built) — the flight recorder
-    (utils/telemetry.py) reads the plan's truncation/rebuild counters
-    off it, so a per-tick-built plan is observable too."""
+) -> jax.Array:
+    """``f_att + f_rep`` — the per-agent point forces of the tick
+    (sections 1-2 of :func:`apf_forces_plan`), extracted so the
+    spatially-sharded tick (:func:`physics_step_spatial`) reuses them
+    verbatim: both are elementwise in the agent axis (the obstacle
+    table is replicated), so they partition under GSPMD with no
+    collectives and no cross-path drift."""
     pos = state.pos
     eps = jnp.asarray(cfg.dist_eps, pos.dtype)
 
@@ -363,6 +363,22 @@ def apf_forces_plan(
         f_rep = jnp.sum(mag[..., None] * unit, axis=1)
     else:
         f_rep = jnp.zeros_like(pos)
+    return f_att + f_rep
+
+
+def apf_forces_plan(
+    state: SwarmState,
+    obstacles: Optional[jax.Array],
+    cfg: SwarmConfig,
+    plan=None,
+):
+    """(force [N, D], plan-or-None): :func:`apf_forces` that also
+    hands back the hashgrid plan the tick dispatched on (the one it
+    was passed, or the one it built) — the flight recorder
+    (utils/telemetry.py) reads the plan's truncation/rebuild counters
+    off it, so a per-tick-built plan is observable too."""
+    pos = state.pos
+    f_point = _apf_point_forces(state, obstacles, cfg)
 
     # 3. Neighbor separation (agent.py:148-160): every *other alive agent*
     #    inside the personal-space radius repels with k_sep / d^2.
@@ -411,7 +427,9 @@ def apf_forces_plan(
     else:
         f_field = jnp.zeros_like(pos)
 
-    return f_att + f_rep + f_sep + f_field, plan
+    # Same association as the pre-r12 (f_att + f_rep) + f_sep +
+    # f_field sum, so the refactor is bitwise-neutral.
+    return f_point + f_sep + f_field, plan
 
 
 def _separation_dispatch(state: SwarmState, cfg: SwarmConfig, plan):
@@ -647,3 +665,85 @@ def _physics_step_core(
 
         telem = swarm_tick_telemetry(out, force, plan=tick_plan)
     return out, plan, telem
+
+
+def build_tick_plan_spatial(state, cfg: SwarmConfig, spec, mesh,
+                            axis=None):
+    """The sharded twin of :func:`build_tick_plan` (r12): seed the
+    spatially-sharded rollout carry — per-shard halo membership +
+    per-shard Verlet plans over local + halo agents
+    (``parallel/spatial.spatial_plan_init``).  ``state`` must be the
+    tiled layout from ``parallel/spatial.spatial_shard_swarm``."""
+    from ..parallel.spatial import SPATIAL_AXIS, spatial_plan_init
+
+    return spatial_plan_init(
+        state, cfg, spec, mesh, axis or SPATIAL_AXIS
+    )
+
+
+def physics_step_spatial(
+    state: SwarmState,
+    obstacles: Optional[jax.Array],
+    cfg: SwarmConfig,
+    carry,
+    spec,
+    mesh,
+    axis=None,
+    dt: Optional[float] = None,
+):
+    """The sharded twin of :func:`physics_step_plan` (r12): one motion
+    tick with the separation force computed by the spatially-sharded
+    halo tick (``parallel/spatial.spatial_separation_step`` — per-tile
+    ``HashgridPlan`` over local + halo agents, ring ``ppermute``
+    boundary exchange, mesh-OR'd Verlet rebuild trigger) while the
+    point forces, clamp, and Euler step stay the elementwise GSPMD
+    code every path shares (:func:`_apf_point_forces` /
+    :func:`integrate`).
+
+    Returns ``(state, carry, telemetry)`` like
+    :func:`physics_step_plan`; with ``cfg.telemetry.enabled`` the
+    record's plan counters are reduced over tiles (age/rebuilds max,
+    overflows summed) and the r11 residency pair
+    (``shard_max_alive``/``shard_imbalance``) is filled from REAL
+    per-tile live counts — the spatial load imbalance those counters
+    existed for."""
+    from ..parallel.spatial import (
+        SPATIAL_AXIS,
+        spatial_separation_step,
+        tile_live_counts,
+    )
+
+    axis = axis or SPATIAL_AXIS
+    dt = cfg.dt if dt is None else dt
+    derived = formation_targets(state, cfg)
+    with jax.named_scope("spatial_separation"):
+        f_sep, carry = spatial_separation_step(
+            state.pos, state.alive, state.agent_id, carry, cfg, spec,
+            mesh, axis,
+        )
+    force = _apf_point_forces(derived, obstacles, cfg) + f_sep
+    moving = derived.has_target & state.alive
+    with jax.named_scope("integrate"):
+        pos, vel = integrate(state.pos, force, moving, cfg, dt)
+        pos = jnp.where(moving[:, None], pos, state.pos)
+    out = state.replace(pos=pos, vel=vel)
+    telem = None
+    if cfg.telemetry.enabled:
+        from ..utils.telemetry import swarm_tick_telemetry
+
+        plan = carry.plan
+        counts = tile_live_counts(out.alive, spec)
+        telem = swarm_tick_telemetry(out, force, plan=None)
+        telem = telem.replace(
+            plan_age=jnp.max(plan.age).astype(jnp.int32),
+            plan_rebuilds=jnp.max(plan.rebuilds).astype(jnp.int32),
+            cap_overflow=jnp.sum(plan.cap_overflow).astype(jnp.int32),
+            cand_overflow=(
+                jnp.sum(plan.cand_overflow).astype(jnp.int32)
+                if plan.cand_overflow is not None
+                else jnp.asarray(0, jnp.int32)
+            ),
+            shard_max_alive=jnp.max(counts),
+            shard_imbalance=jnp.max(counts) - jnp.min(counts),
+        )
+    return out, carry, telem

@@ -1,0 +1,660 @@
+"""Spatially-sharded protocol tick: domain decomposition over a mesh axis.
+
+Everything r8-r11 built for the neighbor-physics tick — the shared
+``HashgridPlan``, the skin-radius Verlet carry, the flight recorder —
+runs on ONE device; ``parallel/`` shards *populations* (islands,
+dimshard, the fused zoo) but never the *spatial* tick, so "one swarm,
+pod scale" was capped by one chip's memory and FLOPs (ROADMAP item 1).
+This module is the domain decomposition that removes the cap: agents
+are sharded by SPATIAL TILE along a mesh axis, each shard runs the r9
+portable hashgrid tick over its own agents plus a thin HALO of
+boundary agents exchanged with its ring neighbors, and detection stays
+exact under the same Verlet-skin contract the single-device carry
+already pins.
+
+Decomposition
+-------------
+
+The torus ``[-hw, hw)^2`` is cut into ``n_tiles`` column strips of
+width ``tile_width = 2*hw / n_tiles`` along x.  ``spatial_shard_swarm``
+assigns every agent a HOME strip from its position (the same clip
+convention as ``torus_cell_tables`` binning), lays the swarm out as
+``[n_tiles * capacity]`` slots — tile ``d`` owns slots
+``[d*capacity, (d+1)*capacity)``, unused slots padded with dead agents
+(alive-masking makes padding free, the ``shard_swarm`` contract) — and
+commits the state with ``PartitionSpec(axis)`` so slot blocks land one
+per device.  The protocol prefix (election, heartbeat, allocation)
+keeps running as the EXISTING cross-shard collectives: the state is
+GSPMD-sharded, and every coordination reduction is already a masked
+max/sum (ops/coordination.py deliberately has no ``pos[argmax]``
+gathers), so XLA lowers them to scalar all-reduces — no positional
+all-gather anywhere in the tick.
+
+Halo exchange
+-------------
+
+Only the separation force needs cross-shard *per-agent* data, and only
+near a strip boundary.  The band depth is ``halo_width = 2 *
+cell_eff`` (two plan cells): physically, ``ps + skin`` — the r9
+coverage bound — would already make detection exact, but two full
+cells guarantee that EVERY cell an in-strip receiver's 3x3 stencil
+touches is COMPLETE in the local view (one cell of reach, plus up to
+one cell of strip/cell misalignment).  Complete cells mean the
+per-shard plan's occupancy runs and candidate rows are *identical* to
+the single-device plan's for every in-strip receiver — not merely
+equivalent-up-to-masked-zeros — which is what upgrades sharded parity
+from "equal within reduction-order noise" to BITWISE (a compacted
+candidate row with different zero placement regroups a tree-shaped
+fp reduction by ~1 ulp; tests/test_spatial_shard.py pins the bitwise
+form).  Each shard keeps two MEMBERSHIP
+lists (``send_lo``/``send_hi``: up to ``halo_cap`` local slots inside
+the boundary bands, selected at plan-build time), and each tick ships
+their CURRENT ``(x, y, alive, id)`` — one packed ``[halo_cap, 4]`` f32
+``lax.ppermute`` per direction, the r11 packed-collective discipline
+(f32 exact for ids < 2^24) — one step around the tile ring.  The
+boundary exchange therefore lowers to ``collective-permute`` only;
+bytes/tick is fixed by the spec (:func:`halo_bytes_per_tick`), not by
+N.
+
+Per-shard Verlet plan
+---------------------
+
+Each shard builds its own :class:`~..ops.hashgrid_plan.HashgridPlan`
+over ``local + halo`` agents, on the SAME full-torus grid geometry the
+single-device portable tick resolves (``ops/physics.
+resolve_plan_geometry``), with the within-cell sort tie-broken by
+GLOBAL agent id (``build_hashgrid_plan(tiebreak=...)``) — so a cell's
+candidate order (and the cap-truncation set) is identical to the
+single-device plan's, which is what makes sharded-vs-single parity
+exact (tests/test_spatial_shard.py).  The plan is carried through the
+rollout scan and rebuilt under ``lax.cond`` by the r9 staleness
+triggers (displacement > skin/2, alive-set change, age ceiling),
+evaluated over local + halo and then OR-reduced across the mesh
+(``lax.pmax``).  The global OR is load-bearing twice over:
+
+- **exactness**: shard ``d``'s halo membership was selected from
+  BUILD-TIME positions, so a fast mover on shard ``e`` can invalidate
+  ``d``'s membership without any local signal — the displacement
+  probe must be global exactly like the r9 single-device trigger is
+  global over all agents;
+- **deadlock-freedom**: the rebuild branch re-selects membership and
+  re-exchanges it (``ppermute`` inside the cond), and collectives
+  under non-uniform predicates hang — the pmax makes the predicate
+  uniform by construction, so every shard enters the same branch.
+
+(The per-tile trigger that lets a fast mover rebuild only its
+neighborhood is ROADMAP item 3b, unchanged by this module.)
+
+Exactness contract
+------------------
+
+Between rebuilds the per-shard plan is a provable superset of the true
+``personal_space`` pairs under the r9 skin bound, PROVIDED every live
+agent sits inside its home strip (plus the band's slack over
+``ps + skin``) at build time and every boundary band fits its
+``halo_cap``.  The build counts both hazards — ``escapes`` (live
+agents outside their home strip at build; CONSERVATIVE: drift smaller
+than the band slack is still covered, so a small positive count is a
+warning, not yet an error) and ``halo_overflow`` (band members
+truncated past ``halo_cap`` — immediately lossy) ride the
+:class:`SpatialCarry`.  Out-of-contract runs may diverge from the
+single-device tick, but never silently: the counters go positive the
+build it happens (tests/test_spatial_shard.py pins both regimes;
+benchmarks/bench_multichip_tick.py reports them, and the r11
+residency counters ``shard_max_alive``/``shard_imbalance`` now
+measure real spatial load imbalance).  Re-homing drifted agents onto
+their current strip (a ring migration at rebuild) is the known next
+step and is deliberately out of scope here.
+
+Scope: 2-D, ``separation_mode='hashgrid'``, portable path only (the
+fused kernel is a single-device program), no moments field
+(``k_align = k_coh = 0`` — a sharded commensurate deposit needs its
+own halo, future work).  Entry points: ``spatial_shard_swarm`` →
+``models/swarm.swarm_rollout(mesh=..., spatial=...)``, which threads
+``ops/physics.physics_step_spatial`` through the scan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.hashgrid_plan import (
+    HashgridPlan,
+    build_hashgrid_plan,
+    plan_staleness,
+)
+from ..ops.neighbors import separation_grid_plan
+from ..state import SwarmState, recount_alive_below
+from ..utils.compat import shard_map
+from ..utils.config import SwarmConfig
+
+SPATIAL_AXIS = "tiles"
+
+#: f32-packed id ceiling (the r11 packed-collective rule): the halo
+#: payload ships agent ids through an f32 lane, exact below 2^24.
+_ID_CEILING = 1 << 24
+
+
+@dataclass(frozen=True)
+class SpatialSpec:
+    """Static geometry of a spatial decomposition (hashable — rides as
+    a jit-static argument next to ``SwarmConfig``).
+
+    ``capacity``: local agent slots per tile (padding slots are dead).
+    ``halo_cap``: boundary-band slots per SIDE — the fixed ppermute
+    payload width; band members past it are truncated and counted
+    (``SpatialCarry.halo_overflow``).  ``halo_width`` is the band
+    depth, ``2 * cell_eff`` of the plan grid — full-cell coverage of
+    the boundary stencil, the bitwise-parity bound (module doc);
+    physical exactness alone needs only ``ps + skin``, which
+    ``cell_eff`` already dominates."""
+
+    n_tiles: int
+    capacity: int
+    halo_cap: int
+    world_hw: float
+    halo_width: float
+
+    @property
+    def tile_width(self) -> float:
+        return 2.0 * self.world_hw / self.n_tiles
+
+    @property
+    def n_slots(self) -> int:
+        return self.n_tiles * self.capacity
+
+    @property
+    def ext_size(self) -> int:
+        """Per-shard extended array length: local + both halos."""
+        return self.capacity + 2 * self.halo_cap
+
+    def replace(self, **kw) -> "SpatialSpec":
+        return dataclasses.replace(self, **kw)
+
+
+@struct.dataclass
+class SpatialCarry:
+    """The sharded tick's scan carry, one entry per tile stacked flat
+    along dim 0 (every leaf is committed ``P(axis)`` so ``shard_map``
+    splits it back per shard):
+
+    - ``send_lo``/``send_hi`` ``[n_tiles * halo_cap]`` i32 — the halo
+      MEMBERSHIP: local slot indices (``capacity`` = empty) whose
+      current ``(x, y, alive, id)`` is shipped to the lower/upper ring
+      neighbor each tick; re-selected at every plan rebuild.
+    - ``plan`` — the per-shard :class:`HashgridPlan` over local + halo
+      agents (leaves ``[n_tiles * ext_size]``-class, per-shard scalars
+      widened to ``[n_tiles]``).
+    - ``escapes`` ``[n_tiles]`` i32 — live agents outside their home
+      strip at the last build (nonzero = the exactness contract is
+      void for cross-boundary pairs; module doc).
+    - ``halo_overflow`` ``[n_tiles]`` i32 — band members truncated
+      past ``halo_cap`` at the last build.
+    """
+
+    send_lo: jax.Array
+    send_hi: jax.Array
+    plan: HashgridPlan
+    escapes: jax.Array
+    halo_overflow: jax.Array
+
+
+def spatial_plan_geometry(cfg: SwarmConfig) -> Tuple[int, float]:
+    """(g, cell) of the per-shard plan grid — THE single-device
+    portable geometry (``ops/physics.resolve_plan_geometry`` with
+    ``use_kernel=False``), so the sharded and single-device binnings
+    cannot drift.  Raises for configs the sharded tick does not
+    support (moments field on, non-hashgrid separation)."""
+    from ..ops.physics import resolve_plan_geometry, tick_field_enabled
+
+    if cfg.separation_mode != "hashgrid":
+        raise ValueError(
+            "the spatially-sharded tick is the hashgrid tick "
+            f"(separation_mode='hashgrid'); got "
+            f"{cfg.separation_mode!r}"
+        )
+    if tick_field_enabled(cfg):
+        raise ValueError(
+            "k_align/k_coh moments-field forces are not supported "
+            "under the spatially-sharded tick yet (the commensurate "
+            "deposit needs its own halo); set both gains to 0"
+        )
+    g_plan, cell_plan, _ = resolve_plan_geometry(
+        False, cfg.world_hw, cfg.grid_cell, cfg.personal_space,
+        cfg.grid_max_per_cell, float(cfg.hashgrid_skin),
+        field_on=False, field_sep_cell=cfg.grid_cell,
+        align_cell=cfg.align_cell,
+    )
+    return g_plan, cell_plan
+
+
+def _round8(n: int) -> int:
+    return -(-int(n) // 8) * 8
+
+
+def spatial_shard_swarm(
+    state: SwarmState,
+    mesh: Mesh,
+    cfg: SwarmConfig,
+    axis: str = SPATIAL_AXIS,
+    capacity: Optional[int] = None,
+    halo_cap: Optional[int] = None,
+    slack: float = 1.5,
+) -> Tuple[SwarmState, SpatialSpec]:
+    """Lay a swarm out by home strip and commit it over ``mesh``.
+
+    Returns ``(tiled_state, spec)``: a ``[n_tiles * capacity]``-slot
+    state (tile ``d`` owns slots ``[d*capacity, (d+1)*capacity)``;
+    unused slots are dead padding agents with fresh ids past the real
+    swarm) placed with ``P(axis)`` on every agent-axis leaf, and the
+    static :class:`SpatialSpec` the rollout needs.  Eager, host-side
+    — the layout permutation is numpy (same boundary as
+    ``shard_swarm``), done once per deployment, not per tick.
+
+    ``capacity`` defaults to the larger of the measured max tile
+    occupancy and ``slack * N / n_tiles``, rounded up to a multiple
+    of 8; a tile whose occupancy exceeds an explicit ``capacity``
+    raises.  ``halo_cap`` defaults to 2x the band's share of a full
+    tile (``capacity * halo_width / tile_width``), floor 64.
+    """
+    import numpy as np
+
+    from .sharding import _tree_shard_dim0
+
+    if state.dim != 2:
+        raise ValueError(
+            f"spatial sharding tiles a 2-D torus; got dim={state.dim}"
+        )
+    if cfg.world_hw <= 0:
+        raise ValueError(
+            "spatial sharding needs world_hw > 0 (the torus the "
+            "strips tile); set it in SwarmConfig"
+        )
+    n_tiles = int(mesh.shape[axis])
+    hw = float(cfg.world_hw)
+    # Band depth = two plan cells (module doc): one cell of stencil
+    # reach + one of strip/cell misalignment, so every stencil cell
+    # of an in-strip receiver is COMPLETE locally — the bitwise-
+    # parity bound.  cell_eff >= ps + skin, so the r9 physical bound
+    # is dominated.
+    g_plan, _ = spatial_plan_geometry(cfg)
+    halo_width = 2.0 * (2.0 * hw / g_plan)
+    tile_w = 2.0 * hw / n_tiles
+    if n_tiles > 1 and 2.0 * halo_width > tile_w:
+        raise ValueError(
+            f"halo bands overlap: 2 * halo_width = {2 * halo_width} "
+            f"(4 plan cells) exceeds the tile width {tile_w} "
+            f"({n_tiles} tiles over [-{hw}, {hw})); use fewer tiles, "
+            "a larger arena, or a smaller cell/skin"
+        )
+
+    n = state.n_agents
+    x = np.asarray(state.pos[:, 0])
+    tile = np.clip(
+        np.floor((x + hw) / tile_w).astype(np.int64), 0, n_tiles - 1
+    )
+    occ = np.bincount(tile, minlength=n_tiles)
+    if capacity is None:
+        capacity = _round8(max(int(occ.max()),
+                               int(np.ceil(slack * n / n_tiles)), 2))
+    elif int(occ.max()) > capacity:
+        raise ValueError(
+            f"tile occupancy {int(occ.max())} exceeds capacity "
+            f"{capacity}; raise capacity (or rebalance the swarm)"
+        )
+    if halo_cap is None:
+        halo_cap = _round8(
+            max(64, int(np.ceil(2.0 * capacity * halo_width / tile_w)))
+        )
+    spec = SpatialSpec(
+        n_tiles=n_tiles, capacity=int(capacity),
+        halo_cap=int(halo_cap), world_hw=hw, halo_width=halo_width,
+    )
+    if spec.n_slots >= _ID_CEILING:
+        raise ValueError(
+            f"{spec.n_slots} slots overflows the f32-packed halo id "
+            f"lane (< {_ID_CEILING}); shard a smaller swarm per tile"
+        )
+
+    # Slot assignment: within a tile, agents keep ascending original
+    # order (stable), so a quiet layout is reproducible.
+    order = np.lexsort((np.arange(n), tile))
+    ranks = np.zeros(n, np.int64)
+    ranks[order] = np.arange(n) - np.concatenate(
+        ([0], np.cumsum(occ)[:-1])
+    )[tile[order]]
+    slots = tile * capacity + ranks
+
+    from ..state import AGENT_AXIS_FIELDS, make_swarm
+
+    base = make_swarm(
+        spec.n_slots, dim=2, n_tasks=state.n_tasks,
+        n_caps=state.caps.shape[1], seed=0,
+        dtype=state.pos.dtype,
+    )
+    slots_j = jnp.asarray(slots, jnp.int32)
+    pad_count = spec.n_slots - n
+    pad_ids = jnp.arange(n, n + pad_count, dtype=jnp.int32)
+    pad_slots = jnp.asarray(
+        np.setdiff1d(np.arange(spec.n_slots), slots), jnp.int32
+    )
+    updates = {}
+    for f in AGENT_AXIS_FIELDS:
+        src = getattr(state, f)
+        dst = getattr(base, f)
+        updates[f] = dst.at[slots_j].set(src)
+    # Padding slots: dead, uniquely-id'd past the real swarm (kill /
+    # revive match by value), no targets, everything else neutral.
+    updates["alive"] = (
+        jnp.zeros((spec.n_slots,), bool).at[slots_j].set(state.alive)
+    )
+    updates["agent_id"] = updates["agent_id"].at[pad_slots].set(pad_ids)
+    updates["has_target"] = (
+        jnp.zeros((spec.n_slots,), bool)
+        .at[slots_j].set(state.has_target)
+    )
+    tiled = base.replace(
+        tick=state.tick, key=state.key,
+        task_pos=state.task_pos, task_cap=state.task_cap,
+        task_winner=state.task_winner, task_util=state.task_util,
+        **updates,
+    )
+    tiled = recount_alive_below(tiled)
+    return _tree_shard_dim0(tiled, mesh, axis, spec.n_slots), spec
+
+
+def gather_by_id(arr: jax.Array, agent_id: jax.Array, n: int):
+    """Unscramble a tiled per-agent column back to agent-id order and
+    drop the padding tail: ``out[id] = arr[slot_of(id)]`` for ids
+    ``< n`` — the comparison lens the parity tests (and record
+    frames) use."""
+    out_shape = (agent_id.shape[0],) + arr.shape[1:]
+    return jnp.zeros(out_shape, arr.dtype).at[agent_id].set(arr)[:n]
+
+
+# ---------------------------------------------------------------------------
+# shard_map body helpers.  Everything below runs PER SHARD: pos/alive/
+# aid are the local [capacity] block, plan leaves the local slice.
+
+
+def _pack_band(pos, alive, aid, idx, c):
+    """[halo_cap, 4] f32 payload ``(x, y, alive, id)`` gathered at the
+    membership list ``idx`` (``c`` = empty slot; id -1)."""
+    valid = idx < c
+    j = jnp.minimum(idx, c - 1)
+    return jnp.stack(
+        [
+            pos[j, 0],
+            pos[j, 1],
+            (alive[j] & valid).astype(jnp.float32),
+            jnp.where(valid, aid[j], -1).astype(jnp.float32),
+        ],
+        axis=1,
+    )
+
+
+def _unpack_halo(pay):
+    """Inverse of :func:`_pack_band` over a concatenated [2H, 4]."""
+    return (
+        pay[:, :2],
+        pay[:, 2] > 0.0,
+        pay[:, 3].astype(jnp.int32),
+    )
+
+
+def _ring_exchange(pay_lo, pay_hi, axis, n_tiles):
+    """One ring step of the band payloads: ship ``pay_hi`` up and
+    ``pay_lo`` down, receive the mirror — ``(from_below, from_above)``.
+    The ONLY cross-shard data motion in the sharded tick; lowers to
+    two ``collective-permute`` ops (asserted on the lowered text by
+    tests/test_spatial_shard.py).  ``n_tiles == 1`` has no neighbors:
+    the halo is dead (a single tile IS the single-device tick)."""
+    if n_tiles == 1:
+        dead = jnp.zeros_like(pay_lo).at[:, 3].set(-1.0)
+        return dead, dead
+    fwd = [(i, (i + 1) % n_tiles) for i in range(n_tiles)]
+    bwd = [(i, (i - 1) % n_tiles) for i in range(n_tiles)]
+    from_below = lax.ppermute(pay_hi, axis, perm=fwd)
+    from_above = lax.ppermute(pay_lo, axis, perm=bwd)
+    return from_below, from_above
+
+
+def _strip_offset(pos, spec, axis):
+    """Per-agent minimum-image x-offset from this shard's strip
+    center (the band/escape coordinate)."""
+    d = lax.axis_index(axis)
+    hw = spec.world_hw
+    center = -hw + (d.astype(pos.dtype) + 0.5) * spec.tile_width
+    return jnp.mod(pos[:, 0] - center + hw, 2.0 * hw) - hw
+
+
+def _rebuild_local(pos, alive, aid, rebuilds_prev, spec, cfg,
+                   g_plan, cell_plan, axis):
+    """Membership re-selection + halo exchange + per-shard plan build
+    (the ``lax.cond`` rebuild branch, and the initial build).  MUST
+    run under a mesh-uniform predicate: it ppermutes."""
+    c, h = spec.capacity, spec.halo_cap
+    half_w = 0.5 * spec.tile_width
+    u = _strip_offset(pos, spec, axis)
+    lo_mask = alive & (u <= -(half_w - spec.halo_width))
+    hi_mask = alive & (u >= (half_w - spec.halo_width))
+    send_lo = jnp.nonzero(lo_mask, size=h, fill_value=c)[0].astype(
+        jnp.int32
+    )
+    send_hi = jnp.nonzero(hi_mask, size=h, fill_value=c)[0].astype(
+        jnp.int32
+    )
+    n_lo = jnp.sum(lo_mask)
+    n_hi = jnp.sum(hi_mask)
+    halo_overflow = (
+        jnp.maximum(n_lo - h, 0) + jnp.maximum(n_hi - h, 0)
+    ).astype(jnp.int32)
+    escapes = jnp.sum(alive & (jnp.abs(u) > half_w)).astype(jnp.int32)
+
+    pay_lo = _pack_band(pos, alive, aid, send_lo, c)
+    pay_hi = _pack_band(pos, alive, aid, send_hi, c)
+    from_below, from_above = _ring_exchange(
+        pay_lo, pay_hi, axis, spec.n_tiles
+    )
+    hpos, halive, hid = _unpack_halo(
+        jnp.concatenate([from_below, from_above])
+    )
+    epos = jnp.concatenate([pos, hpos])
+    ealive = jnp.concatenate([alive, halive])
+    eids = jnp.concatenate([aid, hid])
+    plan = build_hashgrid_plan(
+        epos, ealive, spec.world_hw, cell_plan,
+        cfg.grid_max_per_cell, need_csr=True,
+        g=g_plan, skin=float(cfg.hashgrid_skin),
+        neighbor_cap=(
+            cfg.hashgrid_neighbor_cap
+            if cfg.hashgrid_skin > 0 else 0
+        ),
+        tiebreak=eids,
+    )
+    plan = plan.replace(rebuilds=rebuilds_prev + 1)
+    return plan, send_lo, send_hi, epos, ealive, escapes, halo_overflow
+
+
+def _tick_local(pos, alive, aid, carry_lo, carry_hi, plan,
+                escapes, halo_overflow, spec, cfg, g_plan, cell_plan,
+                axis):
+    """One shard's separation tick: refresh the halo at the carried
+    membership, OR-reduce the r9 staleness triggers across the mesh,
+    rebuild under the uniform cond, sweep the per-shard plan."""
+    c = spec.capacity
+    # 1. Per-tick halo refresh at FIXED membership: current positions
+    #    and alive bits of the build-time band members, so consumers
+    #    read CURRENT neighbor positions through plan.order (the r9
+    #    stale-plan contract) and a neighbor-side kill is visible the
+    #    tick it happens.
+    pay_lo = _pack_band(pos, alive, aid, carry_lo, c)
+    pay_hi = _pack_band(pos, alive, aid, carry_hi, c)
+    from_below, from_above = _ring_exchange(
+        pay_lo, pay_hi, axis, spec.n_tiles
+    )
+    hpos, halive, hid = _unpack_halo(
+        jnp.concatenate([from_below, from_above])
+    )
+    epos = jnp.concatenate([pos, hpos])
+    ealive = jnp.concatenate([alive, halive])
+
+    # 2. Staleness over local + halo, then the mesh-wide OR (module
+    #    doc: required for exactness AND for deadlock-free collectives
+    #    inside the cond).
+    d2max, alive_changed = plan_staleness(epos, ealive, plan)
+    skin = plan.skin
+    stale = alive_changed | (4.0 * d2max > skin * skin)
+    if cfg.hashgrid_rebuild_every > 0:
+        stale = stale | (plan.age + 1 >= cfg.hashgrid_rebuild_every)
+    stale_any = lax.pmax(stale.astype(jnp.int32), axis) > 0
+
+    def rebuild(_):
+        return _rebuild_local(
+            pos, alive, aid, plan.rebuilds, spec, cfg, g_plan,
+            cell_plan, axis,
+        )
+
+    def keep(_):
+        return (
+            plan.replace(age=plan.age + 1),
+            carry_lo, carry_hi, epos, ealive, escapes, halo_overflow,
+        )
+
+    plan, send_lo, send_hi, epos, ealive, escapes, halo_overflow = (
+        lax.cond(stale_any, rebuild, keep, None)
+    )
+
+    # 3. The r9 portable sweep over local + halo; receivers are the
+    #    local block only.
+    eps = jnp.asarray(cfg.dist_eps, pos.dtype)
+    f = separation_grid_plan(
+        epos, ealive, cfg.k_sep, cfg.personal_space, eps, plan
+    )[:c]
+    return f, send_lo, send_hi, plan, escapes, halo_overflow
+
+
+def _squeeze_scalar(x):
+    """Per-shard block -> local value: carry scalars are widened to
+    [n_tiles] outside, so their block is [1].  No genuine [1]-length
+    vector exists in the carry (ext_size >= 4, g*g >= 9 — enforced by
+    the spec/geometry guards), so shape alone is unambiguous."""
+    if x is None:
+        return None
+    return x.reshape(()) if x.ndim == 1 and x.shape[0] == 1 else x
+
+
+def _widen_scalar(x):
+    if x is None:
+        return None
+    return x[None] if x.ndim == 0 else x
+
+
+def spatial_plan_init(
+    state: SwarmState,
+    cfg: SwarmConfig,
+    spec: SpatialSpec,
+    mesh: Mesh,
+    axis: str = SPATIAL_AXIS,
+) -> SpatialCarry:
+    """Seed the rollout carry: select each shard's boundary bands,
+    exchange them, build every per-shard plan (the sharded twin of
+    ``ops/physics.build_tick_plan``)."""
+    g_plan, cell_plan = spatial_plan_geometry(cfg)
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis)),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    def init(pos, alive, aid):
+        plan, send_lo, send_hi, _, _, escapes, overflow = (
+            _rebuild_local(
+                pos, alive, aid, jnp.asarray(-1, jnp.int32), spec,
+                cfg, g_plan, cell_plan, axis,
+            )
+        )
+        return jax.tree_util.tree_map(
+            _widen_scalar,
+            SpatialCarry(
+                send_lo=send_lo, send_hi=send_hi, plan=plan,
+                escapes=escapes, halo_overflow=overflow,
+            ),
+        )
+
+    return init(state.pos, state.alive, state.agent_id)
+
+
+def spatial_separation_step(
+    pos: jax.Array,
+    alive: jax.Array,
+    agent_id: jax.Array,
+    carry: SpatialCarry,
+    cfg: SwarmConfig,
+    spec: SpatialSpec,
+    mesh: Mesh,
+    axis: str = SPATIAL_AXIS,
+):
+    """(f_sep [n_slots, 2], carry'): one sharded separation tick —
+    the ``shard_map`` wrapper around :func:`_tick_local`."""
+    g_plan, cell_plan = spatial_plan_geometry(cfg)
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis)),
+        out_specs=(P(axis), P(axis)),
+        check_vma=False,
+    )
+    def step(pos_l, alive_l, aid_l, carry_l):
+        carry_l = jax.tree_util.tree_map(_squeeze_scalar, carry_l)
+        f, send_lo, send_hi, plan, escapes, overflow = _tick_local(
+            pos_l, alive_l, aid_l,
+            carry_l.send_lo, carry_l.send_hi, carry_l.plan,
+            carry_l.escapes, carry_l.halo_overflow,
+            spec, cfg, g_plan, cell_plan, axis,
+        )
+        out_carry = jax.tree_util.tree_map(
+            _widen_scalar,
+            SpatialCarry(
+                send_lo=send_lo, send_hi=send_hi, plan=plan,
+                escapes=escapes, halo_overflow=overflow,
+            ),
+        )
+        return f, out_carry
+
+    return step(pos, alive, agent_id, carry)
+
+
+def tile_live_counts(alive: jax.Array, spec: SpatialSpec) -> jax.Array:
+    """[n_tiles] live-agent counts from the tiled alive mask — the
+    spatial residency the r11 telemetry counters report (each tile's
+    slot block is contiguous, so this is a local reduction per
+    device under GSPMD)."""
+    return jnp.sum(
+        alive.reshape(spec.n_tiles, spec.capacity), axis=1
+    ).astype(jnp.int32)
+
+
+def halo_bytes_per_tick(spec: SpatialSpec,
+                        rebuilds_per_tick: float = 0.0) -> float:
+    """Modelled cross-shard traffic of the sharded tick, bytes/tick
+    over the whole mesh: every tick each tile ships two
+    ``[halo_cap, 4]`` f32 payloads (the per-tick position/alive
+    refresh), and a rebuild tick ships the same pair again (the
+    membership re-exchange).  Independent of N — the number the
+    MULTICHIP bytes row gates (docs/PERFORMANCE.md r12 halo-volume
+    model)."""
+    if spec.n_tiles == 1:
+        return 0.0
+    per_exchange = spec.n_tiles * 2 * spec.halo_cap * 4 * 4
+    return per_exchange * (1.0 + float(rebuilds_per_tick))

@@ -22,7 +22,8 @@ trajectory.
 
 The gating rules here MUST stay in lockstep with
 ``benchmarks/compare.py`` (the union gate): units ``findings`` /
-``rounds`` / ``events`` / ``ticks`` / ``compiles`` are lower-is-better
+``rounds`` / ``events`` / ``ticks`` / ``compiles`` / ``bytes`` (r12 —
+halo-exchange traffic) are lower-is-better
 counts (a clean 0 baseline regressing to any positive count always
 gates), unit ``pct`` gates against the absolute :data:`PCT_CEILING`,
 everything else is a higher-is-better throughput.  compare.py cannot
@@ -48,7 +49,8 @@ EVENTS = "events.jsonl"
 COMPILE_DIR = "compile"
 
 #: Lower-is-better count units (mirror of compare.py's tuple).
-COUNT_UNITS = ("findings", "rounds", "events", "ticks", "compiles")
+COUNT_UNITS = ("findings", "rounds", "events", "ticks", "compiles",
+               "bytes")
 
 #: Absolute ceiling for unit-"pct" metrics (compare.PCT_CEILING).
 PCT_CEILING = 5.0

@@ -110,6 +110,26 @@ def test_compiles_unit_is_lower_is_better(hist):
     assert compare.compare("r02", "r03", path=hist) == 0  # paydown ok
 
 
+def test_bytes_unit_is_lower_is_better(hist):
+    # r12 halo-exchange volume: the sharded tick's cross-shard
+    # traffic row gates on growth (the boundary exchange must stay
+    # thin); paying traffic down never gates.
+    compare.record("r01", [
+        {"metric": "halo-exchange-bytes-per-tick, 1m", "value": 2e6,
+         "unit": "bytes"},
+    ], path=hist)
+    compare.record("r02", [
+        {"metric": "halo-exchange-bytes-per-tick, 1m", "value": 3e6,
+         "unit": "bytes"},
+    ], path=hist)
+    assert compare.compare("r01", "r02", path=hist) == 1
+    compare.record("r03", [
+        {"metric": "halo-exchange-bytes-per-tick, 1m", "value": 1e6,
+         "unit": "bytes"},
+    ], path=hist)
+    assert compare.compare("r02", "r03", path=hist) == 0  # paydown ok
+
+
 def test_pct_unit_gates_on_absolute_ceiling(hist):
     # Telemetry overhead (unit "pct"): gated against the ABSOLUTE 5%
     # ceiling, not relative growth — 0.1% -> 3% is fine (30x growth),

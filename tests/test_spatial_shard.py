@@ -1,0 +1,347 @@
+"""Spatially-sharded protocol tick (r12, parallel/spatial.py): the
+sharded hashgrid rollout must BE the single-device hashgrid rollout.
+
+Exactness ledger (the r9-style notes these pins encode):
+
+- **bitwise parity**: with the cell-aligned halo (band depth
+  ``2 * cell_eff`` — every stencil cell of an in-strip receiver is
+  COMPLETE in the local view) the per-shard candidate rows are
+  identical to the single-device plan's, so positions and velocities
+  match bitwise by agent id, per-tick (skin=0) and Verlet-carried
+  (skin>0), through kills, uneven occupancy, and per-shard-differing
+  trigger inputs.  A band of only ``ps + skin`` is physically exact
+  but only reduction-order-equal (~1 ulp): partial stencil cells
+  compact the candidate rows differently and tree-shaped fp
+  reductions regroup — the reason the wider band is the contract.
+- **documented degradation**: the decomposition leaves exactness in
+  two ways — a live agent drifting outside its home strip past the
+  band's slack (``SpatialCarry.escapes``, a CONSERVATIVE counter:
+  any out-of-strip agent counts, small drift is still covered), and
+  a boundary band denser than ``halo_cap``
+  (``SpatialCarry.halo_overflow``: the shipped membership
+  truncates).  Out-of-contract runs may diverge, but they are
+  DETECTED — the counters go positive the build it happens — which
+  is the r9-notes-style documented contract for this regime.
+- **collective shape**: the sharded scan body exchanges boundary
+  agents via ``collective-permute`` ONLY — the lowered text contains
+  no all-gather (a full-swarm position gather is exactly what the
+  decomposition exists to avoid), asserted on the HLO.
+- **recorder contract**: telemetry-disabled lowering is byte-identical
+  to the kwarg-omitted lowering (the r10/r11 static-gate contract),
+  the enabled trajectory fingerprints bitwise-equal to disabled, and
+  the r11 residency counters report REAL per-tile live counts
+  (``shard_max_alive <= capacity`` — the no-full-swarm-copy bound).
+
+Runs on the 8-virtual-CPU-device rig (conftest pins the XLA flag).
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu.models.swarm import (
+    _swarm_rollout_spatial_impl,
+)
+from distributed_swarm_algorithm_tpu.parallel.mesh import make_mesh
+from distributed_swarm_algorithm_tpu.parallel.spatial import (
+    SPATIAL_AXIS,
+    gather_by_id,
+    halo_bytes_per_tick,
+    spatial_shard_swarm,
+)
+from distributed_swarm_algorithm_tpu.utils.replay import fingerprint
+from distributed_swarm_algorithm_tpu.utils.telemetry import (
+    summarize_telemetry,
+)
+
+N_DEV = 8
+HW = 64.0
+N = 512
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < N_DEV,
+    reason=f"needs {N_DEV} virtual devices (conftest XLA flag)",
+)
+
+
+def _mesh():
+    return make_mesh((SPATIAL_AXIS,), devices=jax.devices()[:N_DEV])
+
+
+def _cfg(**kw) -> dsa.SwarmConfig:
+    base = dict(
+        separation_mode="hashgrid", world_hw=HW,
+        formation_shape="none", hashgrid_backend="portable",
+        grid_max_per_cell=24, max_speed=1.0, hashgrid_skin=1.0,
+    )
+    base.update(kw)
+    return dsa.SwarmConfig().replace(**base)
+
+
+def _station(n=N, seed=0, spread=HW * 0.9) -> dsa.SwarmState:
+    s = dsa.make_swarm(n, seed=seed, spread=spread)
+    return s.replace(
+        target=jnp.asarray(s.pos),
+        has_target=jnp.ones_like(s.has_target),
+    )
+
+
+def _parity(cfg, s, steps, mesh=None, **shard_kw):
+    """(ref, out, spec): run both paths on the same swarm."""
+    mesh = mesh or _mesh()
+    tiled, spec = spatial_shard_swarm(s, mesh, cfg, **shard_kw)
+    ref = dsa.swarm_rollout(s, None, cfg, steps)
+    out = dsa.swarm_rollout(
+        tiled, None, cfg, steps, mesh=mesh, spatial=spec
+    )
+    return ref, out, spec
+
+
+def _assert_bitwise(ref, out, n):
+    got_p = np.asarray(gather_by_id(out.pos, out.agent_id, n))
+    got_v = np.asarray(gather_by_id(out.vel, out.agent_id, n))
+    assert np.array_equal(np.asarray(ref.pos), got_p)
+    assert np.array_equal(np.asarray(ref.vel), got_v)
+
+
+# ------------------------------------------------------------------- parity
+
+
+def test_sharded_matches_single_device_verlet_carry():
+    # The flagship pin: skin-carried (amortized) sharded rollout,
+    # bitwise by agent id.
+    cfg = _cfg()
+    ref, out, spec = _parity(cfg, _station(), 12)
+    _assert_bitwise(ref, out, N)
+    assert spec.n_slots < N * 2 + 8 * N_DEV  # padded, not exploded
+
+
+@pytest.mark.slow
+def test_sharded_matches_single_device_per_tick_rebuild():
+    # skin=0: the exact r8 per-tick regime, every tick rebuilds
+    # (the mesh-OR trigger fires on any motion, like refresh_plan).
+    # Slow set (a distinct compile pair; the skin-carried flagship
+    # pin above owns the tier-1 budget — the r11 precedent).
+    cfg = _cfg(hashgrid_skin=0.0)
+    ref, out, _ = _parity(cfg, _station(seed=3), 6)
+    _assert_bitwise(ref, out, N)
+
+
+def test_dead_agent_halo_parity():
+    # Kill agents that sit inside boundary bands (x near a tile
+    # seam): the halo ships their alive=False, the staleness check
+    # sees the flip, and dead agents are keyed past every per-shard
+    # grid — parity must hold through the kill.
+    cfg = _cfg()
+    s = _station(seed=1)
+    mesh = _mesh()
+    tiled, spec = spatial_shard_swarm(s, mesh, cfg)
+    # Agents closest to the tile seams (multiples of tile_width).
+    x = np.asarray(s.pos[:, 0])
+    seam = np.abs(
+        np.mod(x + HW, spec.tile_width) - spec.tile_width / 2
+    )
+    kill_ids = np.argsort(-seam)[:8].tolist()
+    s = dsa.kill(s, kill_ids)
+    tiled = dsa.kill(tiled, kill_ids)
+    ref = dsa.swarm_rollout(s, None, cfg, 12)
+    out = dsa.swarm_rollout(
+        tiled, None, cfg, 12, mesh=mesh, spatial=spec
+    )
+    _assert_bitwise(ref, out, N)
+    # The killed agents really are frozen on both paths.
+    got = np.asarray(gather_by_id(out.pos, out.agent_id, N))
+    assert np.array_equal(got[kill_ids], np.asarray(s.pos)[kill_ids])
+
+
+def test_uneven_occupancy_one_tile():
+    # Everything in ONE strip: 7 of 8 shards run empty — fixed
+    # shapes keep them trivially correct — and the residency
+    # counters report the real imbalance.  Cluster centered
+    # mid-strip so nobody escapes during the run (bitwise regime).
+    cfg = _cfg(max_speed=0.5, grid_max_per_cell=64,
+               hashgrid_neighbor_cap=256)
+    center = -HW + 1.5 * (2 * HW / N_DEV)   # middle of tile 1
+    s = dsa.make_swarm(256, seed=2, spread=3.0)
+    s = s.replace(pos=s.pos + jnp.asarray([center, 0.0]))
+    s = s.replace(target=jnp.asarray(s.pos),
+                  has_target=jnp.ones_like(s.has_target))
+    mesh = _mesh()
+    tiled, spec = spatial_shard_swarm(s, mesh, cfg)
+    ref = dsa.swarm_rollout(s, None, cfg, 8)
+    (out, telem), carry = dsa.swarm_rollout(
+        tiled, None, cfg, 8, mesh=mesh, spatial=spec,
+        telemetry=True, return_plan=True,
+    )
+    _assert_bitwise(ref, out, 256)
+    summ = summarize_telemetry(telem)
+    assert summ["shard_max_alive"] == 256          # one hot tile...
+    assert summ["shard_imbalance_max"] == 256      # ...rest empty
+    assert int(np.asarray(carry.escapes).sum()) == 0
+    assert int(np.asarray(carry.halo_overflow).sum()) == 0
+
+
+def test_per_shard_trigger_inputs_collapse_to_global_rebuild():
+    # Only tile 0's agents move (everyone else is parked), so the
+    # r9 displacement trigger's INPUTS differ per shard.  The mesh
+    # OR-reduces them — required for exactness (a mover on shard e
+    # invalidates its neighbors' build-time halo membership) and for
+    # deadlock-freedom (the rebuild branch holds collectives, so the
+    # predicate must be uniform) — hence every tile's rebuild
+    # counter advances in lockstep, and parity holds bitwise.
+    cfg = _cfg()
+    s = _station(seed=4)
+    x = np.asarray(s.pos[:, 0])
+    tile0 = x < (-HW + 2 * HW / N_DEV)
+    # Park everyone; send tile-0 agents marching +x.
+    tgt = np.asarray(s.pos).copy()
+    tgt[tile0, 0] += 6.0
+    s = s.replace(target=jnp.asarray(tgt))
+    mesh = _mesh()
+    tiled, spec = spatial_shard_swarm(s, mesh, cfg)
+    ref = dsa.swarm_rollout(s, None, cfg, 12)
+    out, carry = dsa.swarm_rollout(
+        tiled, None, cfg, 12, mesh=mesh, spatial=spec,
+        return_plan=True,
+    )
+    _assert_bitwise(ref, out, N)
+    rebuilds = np.asarray(carry.plan.rebuilds)
+    assert rebuilds.min() == rebuilds.max()        # lockstep (OR'd)
+    assert rebuilds.max() >= 1                     # and it fired
+    assert int(np.asarray(carry.plan.age).min()) >= 0
+
+
+@pytest.mark.slow
+def test_out_of_contract_regimes_are_detected_not_silent():
+    # Slow set: three distinct 20-tick rollout compiles (ref + two
+    # halo_cap variants) — the heaviest case in the file, and the
+    # contract it pins (counters flag divergence) is carry-level,
+    # not per-round regression surface.
+    # The exactness ledger's degradation case (module doc): a dense
+    # cluster parked ON a tile seam.  Two regimes off one scenario:
+    #
+    # (a) band slots sized to the cluster -> bitwise through 20
+    #     ticks, even with a few `escapes` (the 2-cell band's slack
+    #     over ps + skin absorbs small drift — the counter is
+    #     deliberately conservative);
+    # (b) default band slots -> the band TRUNCATES (the cluster is
+    #     entirely inside halo_width of the seam), forces diverge —
+    #     and `halo_overflow` flags it the moment it happens.  The
+    #     carry counters are the contract: out-of-contract runs are
+    #     detected, never silently wrong.
+    cfg = _cfg(grid_max_per_cell=96, hashgrid_neighbor_cap=1024)
+    seam = -HW + 2 * (2 * HW / N_DEV)              # tile 1/2 seam
+    s = dsa.make_swarm(256, seed=5, spread=3.0)
+    s = s.replace(pos=s.pos + jnp.asarray([seam, 0.0]))
+    s = s.replace(target=jnp.asarray(s.pos),
+                  has_target=jnp.ones_like(s.has_target))
+    mesh = _mesh()
+    ref = dsa.swarm_rollout(s, None, cfg, 20)
+
+    tiled, spec = spatial_shard_swarm(s, mesh, cfg, halo_cap=256)
+    out, carry = dsa.swarm_rollout(
+        tiled, None, cfg, 20, mesh=mesh, spatial=spec,
+        return_plan=True,
+    )
+    _assert_bitwise(ref, out, 256)
+    assert int(np.asarray(carry.halo_overflow).sum()) == 0
+
+    tiled2, spec2 = spatial_shard_swarm(s, mesh, cfg)  # default cap
+    assert spec2.halo_cap < 256                        # will truncate
+    out2, carry2 = dsa.swarm_rollout(
+        tiled2, None, cfg, 20, mesh=mesh, spatial=spec2,
+        return_plan=True,
+    )
+    got2 = np.asarray(gather_by_id(out2.pos, out2.agent_id, 256))
+    err2 = np.abs(np.asarray(ref.pos) - got2).max()
+    assert err2 > 0.0                                  # diverged...
+    assert int(np.asarray(carry2.halo_overflow).sum()) > 0  # ...loudly
+    assert np.all(np.isfinite(got2))
+
+
+# ------------------------------------------------- lowering / collectives
+
+
+def test_scan_body_exchanges_by_collective_permute_only():
+    cfg = _cfg()
+    mesh = _mesh()
+    tiled, spec = spatial_shard_swarm(_station(), mesh, cfg)
+    low = _swarm_rollout_spatial_impl.lower(
+        tiled, None, cfg, 6, mesh, spec
+    ).as_text()
+    # The boundary exchange is pairwise: collective-permute present,
+    # and NO all-gather anywhere — a full-swarm position gather is
+    # what the decomposition exists to avoid.
+    assert re.search(r"collective.permute", low)
+    assert not re.search(r"all.gather", low)
+
+
+def test_telemetry_gate_contract_on_sharded_rollout():
+    # Disabled lowering == kwarg-omitted lowering (byte-identical:
+    # the r10/r11 trace-time gate), enabled lowering differs, and
+    # the enabled trajectory is bitwise the disabled one.
+    cfg = _cfg()
+    mesh = _mesh()
+    tiled, spec = spatial_shard_swarm(_station(), mesh, cfg)
+    args = (tiled, None, cfg, 6, mesh, spec)
+    low_off = _swarm_rollout_spatial_impl.lower(
+        *args, telemetry=False
+    ).as_text()
+    low_default = _swarm_rollout_spatial_impl.lower(*args).as_text()
+    low_on = _swarm_rollout_spatial_impl.lower(
+        *args, telemetry=True
+    ).as_text()
+    assert low_off == low_default
+    assert low_on != low_off
+    off = dsa.swarm_rollout(*args[:4], mesh=mesh, spatial=spec)
+    on, telem = dsa.swarm_rollout(
+        *args[:4], mesh=mesh, spatial=spec, telemetry=True
+    )
+    assert fingerprint(off) == fingerprint(on)
+    summ = summarize_telemetry(telem)
+    assert summ["ticks"] == 6
+    # Residency is REAL per-tile live counts, and bounds the
+    # per-device live array: never a full-swarm copy.
+    assert 0 < summ["shard_max_alive"] <= spec.capacity
+    assert summ["shard_max_alive"] < N
+
+
+# ------------------------------------------------------ spec validation
+
+
+def test_layout_and_spec_guards():
+    cfg = _cfg()
+    mesh = _mesh()
+    s = _station(seed=6)
+    tiled, spec = spatial_shard_swarm(s, mesh, cfg)
+    # Layout: every real agent landed in its home strip's slot block.
+    tile_of_slot = np.arange(spec.n_slots) // spec.capacity
+    aid = np.asarray(tiled.agent_id)
+    alive = np.asarray(tiled.alive)
+    x = np.asarray(tiled.pos[:, 0])
+    home = np.clip(
+        np.floor((x + HW) / spec.tile_width), 0, spec.n_tiles - 1
+    )
+    assert np.all(home[alive] == tile_of_slot[alive])
+    assert np.sum(alive) == N
+    assert set(aid.tolist()) == set(range(spec.n_slots))
+    # Band depth: two plan cells, dominating ps + skin.
+    assert spec.halo_width >= cfg.personal_space + cfg.hashgrid_skin
+    assert halo_bytes_per_tick(spec) > 0
+    # Guards: capacity too small; halo bands overlapping the strip.
+    with pytest.raises(ValueError, match="capacity"):
+        spatial_shard_swarm(s, mesh, cfg, capacity=8)
+    with pytest.raises(ValueError, match="halo bands overlap"):
+        spatial_shard_swarm(
+            s, mesh, cfg.replace(world_hw=16.0)
+        )
+    with pytest.raises(ValueError, match="spatial"):
+        # swarm_rollout(mesh=...) without the spec is an error.
+        dsa.swarm_rollout(tiled, None, cfg, 2, mesh=mesh)

@@ -204,6 +204,28 @@ SEEDED = {
                 return x + 1
         """,
     ),
+    "halo-width": (
+        "pkg/shardsweep.py",
+        """
+        from functools import partial
+
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+        from distributed_swarm_algorithm_tpu.ops.neighbors import (
+            separation_grid_plan,
+        )
+
+        def forces(pos, alive, plan, mesh):
+            @partial(shard_map, mesh=mesh, in_specs=(P("x"),),
+                     out_specs=P("x"))
+            def body(p):
+                return separation_grid_plan(
+                    p, alive, 1.0, 2.0, 1e-3, plan
+                )
+
+            return body(pos)
+        """,
+    ),
 }
 
 
@@ -410,6 +432,39 @@ def test_each_rule_fires_exactly_once_on_seeded_tree(tmp_path):
                 if any(r is None for r in (r_a, r_b)):
                     return x + 1
                 return x + r_a + r_b
+            """,
+        ),
+        # A shard_map body that ppermutes boundary agents (here via a
+        # local helper — the reachable-scope closure must follow the
+        # call) before building/sweeping its per-shard plan is the
+        # SANCTIONED sharded-tick pattern (parallel/spatial.py): no
+        # halo-width finding.
+        (
+            "shard_halo_exchange",
+            """
+            from functools import partial
+
+            from jax import lax
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+            from distributed_swarm_algorithm_tpu.ops.hashgrid_plan import (
+                build_hashgrid_plan,
+            )
+
+            def exchange(p, perm):
+                return lax.ppermute(p, "x", perm=perm)
+
+            def tick(pos, alive, mesh, perm):
+                @partial(shard_map, mesh=mesh, in_specs=(P("x"),),
+                         out_specs=P("x"))
+                def body(p):
+                    halo = exchange(p, perm)
+                    plan = build_hashgrid_plan(
+                        p, alive, 32.0, 2.0, 16
+                    )
+                    return p + plan.cell_eff + halo
+
+                return body(pos)
             """,
         ),
     ],

@@ -124,6 +124,13 @@ def test_gate_semantics_agree_with_compare(tmp_path):
         ("pct", 1.0, 4.9, False),
         ("pct", 1.0, 5.1, True),
         ("rounds", 4.0, 4.5, False),
+        # r12 halo-exchange volume: bytes growth past threshold
+        # gates, a fatter-but-within-threshold exchange does not,
+        # and a clean-0 baseline (single-tile mesh) regressing to
+        # any traffic gates.
+        ("bytes", 1_000_000.0, 1_300_000.0, True),
+        ("bytes", 1_000_000.0, 1_100_000.0, False),
+        ("bytes", 0.0, 512.0, True),
     ]
     for i, (unit, prev, cur, expect) in enumerate(cases):
         assert (

@@ -1,0 +1,221 @@
+"""Multi-tenant rollout service throughput (r13) — 1k scenarios x 256
+agents, batched vs the serial loop.
+
+The workload is a HETEROGENEOUS request stream: every scenario draws
+its own APF gains / max-speed / seed / arena (the serving reality the
+north star's "millions of users" implies).  Two ways to serve it:
+
+- **serial loop** (the pre-r13 API): one ``swarm_rollout`` call per
+  scenario.  Per-scenario gains live in the jit-STATIC ``SwarmConfig``
+  there, so every distinct param set RETRACES — the serial baseline
+  pays one trace+compile per request, which is the retrace storm the
+  compile observatory (r11) detects and ROADMAP item 2 exists to
+  kill.  Measured on a subsample (rate per scenario is constant; a
+  full 1k-retrace run would burn ~30 min proving the same number).
+- **batched service** (serve/): one compiled program per bucket
+  shape; params are traced data, tenants ride a vmapped scenario
+  axis, dispatches double-buffer.
+
+For transparency the HOMOGENEOUS serial loop (identical params, so
+the serial path reuses ONE compiled rollout — its absolute best
+case) is also reported: that row isolates the dispatch/vectorization
+win alone, without the retrace term.
+
+Fixed-name rows (cpu families; the script no-ops off-cpu):
+
+  multitenant-scenarios-per-sec, 1k x 256 ...      (the headline;
+      gated >= 5x the serial row by an exit-2 self-gate)
+  multitenant-serial-scenarios-per-sec, ...        (heterogeneous
+      serial baseline, retrace-bound)
+  multitenant-homog-serial-scenarios-per-sec, ...  (homogeneous
+      serial loop — the no-retrace best case)
+  serve-compile-entries, 1k x 256 ...              unit "compiles":
+      observatory cache entries for the batched entry; exit 2 when
+      past the bucket budget (lower-is-better in compare.py)
+
+Usage: python benchmarks/bench_multitenant.py [--small]
+  --small: 64 scenarios (the CI-speed smoke of the same shape).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault("DSA_COMPILE_WATCH", "1")
+
+import jax
+
+from common import report, timeit_best
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu import serve
+from distributed_swarm_algorithm_tpu.utils import compile_watch as cw
+
+N_SCENARIOS = 1000
+N_AGENTS = 256
+N_STEPS = 20
+SERIAL_SAMPLE = 6        # heterogeneous serial: each pays a retrace
+HOMOG_SAMPLE = 24        # homogeneous serial: one compile, then rate
+SPEEDUP_BAR = 5.0
+
+#: One compiled shape pair: capacity 256, batches 8/64 — the whole
+#: 1k stream fits in 2 shapes, so the compiles row has a tight bar.
+SPEC = serve.BucketSpec(capacities=(N_AGENTS,), batches=(8, 64))
+
+BASE = dsa.SwarmConfig().replace(
+    formation_shape="none", utility_threshold=2.0
+)
+
+
+def _requests(n):
+    """The heterogeneous stream: params drawn from a small grid (seeded
+    by index — deterministic cross-round)."""
+    reqs = []
+    for i in range(n):
+        reqs.append(serve.ScenarioRequest(
+            n_agents=N_AGENTS,
+            seed=i,
+            arena_hw=6.0 + (i % 5),
+            params={
+                "k_att": 0.5 + 0.25 * (i % 7),
+                "k_sep": 10.0 + 5.0 * (i % 4),
+                "max_speed": 2.0 + (i % 3),
+            },
+        ))
+    return reqs
+
+
+def _serial_rate(reqs, tag) -> float:
+    """scenarios/sec of the serial swarm_rollout loop over ``reqs`` —
+    params baked into the (static) config exactly as a pre-r13 caller
+    would."""
+    start = time.perf_counter()
+    out = None
+    for req in reqs:
+        s, p = serve.materialize_scenario(req, N_AGENTS, BASE)
+        cfg = serve.bake_params(BASE, p)
+        out = dsa.swarm_rollout(s, None, cfg, N_STEPS)
+    jax.block_until_ready(out.pos)
+    sec = time.perf_counter() - start
+    print(f"# serial[{tag}]: {len(reqs)} scenarios in {sec:.1f}s")
+    return len(reqs) / sec
+
+
+def main() -> int:
+    backend = jax.default_backend()
+    if backend != "cpu":
+        # cpu-family fixed names — a tunnel/TPU value would corrupt
+        # the cross-round comparison; clean no-op (run_all contract).
+        print(
+            f"# bench_multitenant: cpu-family rows; backend is "
+            f"{backend!r} — skipping"
+        )
+        return 0
+    small = "--small" in sys.argv[1:]
+    n_scenarios = 64 if small else N_SCENARIOS
+    tag = f"{'64' if small else '1k'} x {N_AGENTS} cpu"
+    reqs = _requests(n_scenarios)
+
+    # --- heterogeneous serial baseline (retrace-bound, subsampled) ---
+    serial_sps = _serial_rate(reqs[:SERIAL_SAMPLE], "heterogeneous")
+
+    # --- homogeneous serial loop (one compile — serial's best case) --
+    homog = serve.ScenarioRequest(
+        n_agents=N_AGENTS, seed=0, arena_hw=8.0,
+        params={"k_att": 1.0, "k_sep": 20.0, "max_speed": 5.0},
+    )
+    s, p = serve.materialize_scenario(homog, N_AGENTS, BASE)
+    hcfg = serve.bake_params(BASE, p)
+    warm = dsa.swarm_rollout(s, None, hcfg, N_STEPS)
+    jax.block_until_ready(warm.pos)
+
+    def run_homog():
+        # A serving loop builds each request's state too — the
+        # per-request materialization is part of both paths' work.
+        out = None
+        for i in range(HOMOG_SAMPLE):
+            si, _ = serve.materialize_scenario(
+                serve.ScenarioRequest(
+                    n_agents=N_AGENTS, seed=i, arena_hw=8.0,
+                    params=homog.params,
+                ),
+                N_AGENTS, BASE,
+            )
+            out = dsa.swarm_rollout(si, None, hcfg, N_STEPS)
+        jax.block_until_ready(out.pos)
+
+    homog_sec = timeit_best(run_homog, lambda: 0.0, reps=2)
+    homog_sps = HOMOG_SAMPLE / homog_sec
+
+    # --- the batched service over the full stream --------------------
+    def run_service() -> int:
+        svc = serve.RolloutService(
+            BASE, spec=SPEC, n_steps=N_STEPS, telemetry=False,
+        )
+        for req in reqs:
+            svc.submit(req)
+        svc.flush()
+        results = svc.collect_all()
+        return len(results)
+
+    n_done = run_service()                       # warm (compiles)
+    assert n_done == n_scenarios, (n_done, n_scenarios)
+    start = time.perf_counter()
+    run_service()
+    batched_sps = n_scenarios / (time.perf_counter() - start)
+
+    # Suppressions: tag is one of two mode literals ("1k x 256 cpu" /
+    # "64 x 256 cpu"), fixed at the top of main() — each composed
+    # name is a stable cross-round pin, same contract as
+    # common.telemetry_rows.
+    report(
+        # swarmlint: disable=metric-fstring -- tag is a mode literal; names are stable cross-round pins
+        f"multitenant-scenarios-per-sec, {tag}",
+        batched_sps, "scenarios/sec", serial_sps,
+    )
+    report(
+        # swarmlint: disable=metric-fstring -- tag is a mode literal; names are stable cross-round pins
+        f"multitenant-serial-scenarios-per-sec, {tag}",
+        serial_sps, "scenarios/sec", 0.0,
+    )
+    report(
+        # swarmlint: disable=metric-fstring -- tag is a mode literal; names are stable cross-round pins
+        f"multitenant-homog-serial-scenarios-per-sec, {tag}",
+        homog_sps, "scenarios/sec", 0.0,
+    )
+
+    # --- compile budget: observatory entries vs the bucket lattice ---
+    entries = cw.WATCH.compile_count(serve.SERVE_ENTRY)
+    report(
+        # swarmlint: disable=metric-fstring -- tag is a mode literal; names are stable cross-round pins
+        f"serve-compile-entries, {tag}",
+        float(entries), "compiles", 0.0,
+    )
+
+    failures = 0
+    if entries > SPEC.max_shapes:
+        print(
+            f"# SELF-GATE: {entries} compiled entries for "
+            f"{serve.SERVE_ENTRY} exceed the bucket budget "
+            f"{SPEC.max_shapes}",
+            file=sys.stderr,
+        )
+        failures += 1
+    speedup = batched_sps / max(serial_sps, 1e-9)
+    print(f"# batched vs heterogeneous-serial: {speedup:.1f}x "
+          f"(bar {SPEEDUP_BAR}x); vs homogeneous-serial: "
+          f"{batched_sps / max(homog_sps, 1e-9):.2f}x")
+    if speedup < SPEEDUP_BAR:
+        print(
+            f"# SELF-GATE: batched {batched_sps:.1f} scenarios/sec < "
+            f"{SPEEDUP_BAR}x serial {serial_sps:.1f}",
+            file=sys.stderr,
+        )
+        failures += 1
+    return 2 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

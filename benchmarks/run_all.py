@@ -98,6 +98,13 @@ BENCHES = [
     # self-gates on small-N sharded-vs-single bitwise parity before
     # reporting (the revived MULTICHIP lineage).
     "bench_multichip_tick.py",
+    # r13: the multi-tenant rollout service — 1k heterogeneous
+    # scenarios x 256 agents through the scenario-batched serve layer
+    # vs the serial swarm_rollout loop (which retraces per distinct
+    # param set), plus the compile-observatory cache-entry row (unit
+    # "compiles") gated against the bucket lattice; self-gates the
+    # >= 5x speedup bar and the bucket budget (exit 2).
+    "bench_multitenant.py",
 ]
 
 # Extra argv for benches whose no-arg default is not the gate set —
@@ -141,6 +148,7 @@ QUICK_SKIP = {
     "bench_compile_count.py",
     "bench_multichip_telemetry.py",
     "bench_multichip_tick.py",
+    "bench_multitenant.py",
 }
 
 

@@ -15,6 +15,8 @@ Layers (mirrors SURVEY.md §1, rebuilt TPU-first):
   ops/       pure kernels: physics, coordination, allocation, PSO/DE/
              CMA-ES/boids, objectives, neighbor search
   parallel/  mesh/sharding/island-model multi-chip layer
+  serve/     multi-tenant rollout service (r13): scenario-batched
+             rollouts, bucketed compiled shapes, submit/collect
   utils/     config, checkpoint, metrics, profiling, telemetry
              (the in-scan flight recorder, docs/OBSERVABILITY.md)
 """

@@ -1,4 +1,5 @@
-"""Rule: PRNG key reuse (`key-reuse`).
+"""Rules: PRNG key reuse (`key-reuse`) and vmapped-axis key
+broadcast (`key-broadcast`).
 
 The single most common silent-correctness bug in jax code: the same
 key consumed by two ``jax.random.*`` calls yields *identical or
@@ -18,6 +19,17 @@ distinct fold constants is this repo's documented domain-separation
 idiom (pso_fused's ``0x6E0`` host key, etc.).  Only bare-``Name``
 key arguments are tracked; ``state.key`` attribute flows are the
 checkpoint/pytree discipline's job.
+
+``key-broadcast`` (r13, the scenario-batching twin): a PRNG key
+passed through ``jax.vmap``'s ``in_axes=None`` slot is the SAME key
+in every batch member — every vmapped scenario draws identical
+"random" numbers (correlated election jitter across tenants is
+silent and wrong; each tenant must get its own split key, e.g. the
+key inside its stacked state pytree, mapped with axis 0).  Detection
+is the immediate-call shape ``jax.vmap(f, in_axes=...)(args...)``:
+a bare-``Name`` call argument whose name mentions ``key`` aligned
+with a ``None`` axis (or a whole-tree ``in_axes=None``) is a
+finding.
 """
 
 from __future__ import annotations
@@ -210,3 +222,75 @@ class KeyReuseRule(Rule):
                         "an intervening split/re-assignment — "
                         "correlated draws",
                     )
+
+
+def _in_axes_value(call: ast.Call):
+    """The ``in_axes`` operand of a ``jax.vmap`` call: second
+    positional argument or keyword.  Returns (node, True) when
+    present, (None, False) when defaulted (axis 0 everywhere — the
+    safe default)."""
+    if len(call.args) >= 2:
+        return call.args[1], True
+    for kw in call.keywords:
+        if kw.arg == "in_axes":
+            return kw.value, True
+    return None, False
+
+
+def _is_none_axis(node) -> bool:
+    return isinstance(node, ast.Constant) and node.value is None
+
+
+def _looks_like_key(node) -> bool:
+    return isinstance(node, ast.Name) and "key" in node.id.lower()
+
+
+@register
+class KeyBroadcastRule(Rule):
+    id = "key-broadcast"
+    summary = "PRNG key broadcast across a vmapped axis (in_axes=None)"
+    details = (
+        "jax.vmap(f, in_axes=..., ...)(..., key, ...) with the key's "
+        "axis None hands EVERY batch member the same key — identical "
+        "draws per member (correlated election jitter, identical "
+        "init noise).  Split per member instead: map a [S]-leaved "
+        "key array with axis 0 (jax.random.split(key, S))."
+    )
+
+    def check(self, mod: ModuleInfo):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            # The immediate-call shape: jax.vmap(fn, ...)(args...).
+            vmap = node.func
+            if not isinstance(vmap, ast.Call):
+                continue
+            if mod.resolve(vmap.func) != "jax.vmap":
+                continue
+            axes, explicit = _in_axes_value(vmap)
+            if not explicit:
+                continue  # default in_axes=0: every arg mapped
+            if _is_none_axis(axes):
+                # Whole-tree broadcast: every key-looking arg is the
+                # same key in every member.
+                for arg in node.args:
+                    if _looks_like_key(arg):
+                        yield mod.finding(
+                            self.id, arg,
+                            f"PRNG key `{arg.id}` broadcast across "
+                            "the vmapped axis (in_axes=None) — every "
+                            "batch member draws the same stream; "
+                            "split one key per member and map it "
+                            "with axis 0",
+                        )
+                continue
+            if isinstance(axes, (ast.Tuple, ast.List)):
+                for axis, arg in zip(axes.elts, node.args):
+                    if _is_none_axis(axis) and _looks_like_key(arg):
+                        yield mod.finding(
+                            self.id, arg,
+                            f"PRNG key `{arg.id}` rides a None slot "
+                            "of in_axes — the same key reaches every "
+                            "member of the vmapped axis; split one "
+                            "key per member (axis 0) instead",
+                        )

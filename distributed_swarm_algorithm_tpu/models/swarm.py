@@ -89,10 +89,16 @@ def _protocol_steps(
     state: SwarmState,
     cfg: SwarmConfig,
     sort_in_tick: bool,
+    params=None,
 ) -> SwarmState:
     """The pre-physics tick prefix shared by the plain and
     plan-carrying ticks: tick stamp, cadenced Morton re-sort (window
-    mode), coordination, allocation."""
+    mode), coordination, allocation.
+
+    ``params`` (r13, serve/batched.py): optional per-scenario override
+    pytree — the allocation steps read ``utility_threshold`` /
+    ``auction_eps`` from it as TRACED scalars (coordination timing
+    stays static config).  ``None`` = the pre-r13 graph."""
     state = state.replace(tick=state.tick + 1)
     if (
         sort_in_tick
@@ -118,11 +124,12 @@ def _protocol_steps(
         state = coordination_step(state, cfg)      # agent.py:83-89
         has_leader = jnp.any(state.alive & (state.fsm == LEADER))
         state = auction_allocation_step(
-            state, cfg, leader_emerged=~had_leader & has_leader
+            state, cfg, leader_emerged=~had_leader & has_leader,
+            params=params,
         )
     else:
         state = coordination_step(state, cfg)      # agent.py:83-89
-        state = allocation_step(state, cfg)        # agent.py:91-92
+        state = allocation_step(state, cfg, params=params)  # agent.py:91-92
     return state
 
 
@@ -189,6 +196,43 @@ def swarm_tick(
         state, obstacles, _hashgrid_multidevice_cfg(state, cfg),
         sort_in_tick, telemetry,
     )
+
+
+def swarm_tick_dyn(
+    state: SwarmState,
+    obstacles: Optional[jax.Array],
+    cfg: SwarmConfig,
+    params=None,
+):
+    """One protocol tick with DYNAMIC per-scenario parameters (r13) —
+    the scenario-batching substrate.
+
+    Identical tick order to the rollout scan body (protocol prefix
+    with the re-sort cond dropped, then physics), but the gain /
+    threshold scalars named by ``params`` (``serve/batched.
+    ScenarioParams``: APF gains, max-speed clamp, auction eps/theta)
+    are read from a TRACED pytree instead of the jit-static config —
+    so ``jax.vmap`` over a leading scenario axis of ``(state,
+    params)`` runs thousands of heterogeneous swarms in ONE compiled
+    program with zero retraces (``serve/batched.batched_rollout``).
+    With ``params=None`` every scalar comes from ``cfg`` and the
+    graph is the pre-r13 tick — which is why a batched scenario is
+    bitwise-equal to the same scenario run solo through
+    :func:`swarm_rollout` with the params baked into the config
+    (pinned by tests/test_serve.py).
+
+    Plain (un-jitted): callers own the jit/vmap/scan composition.
+    Returns ``(state, telemetry-or-None)`` — telemetry gated on
+    ``cfg.telemetry.enabled`` (the r10 static gate).
+    """
+    state = _protocol_steps(state, cfg, sort_in_tick=False,
+                            params=params)
+    from ..ops.physics import _physics_step_core
+
+    out, _, telem = _physics_step_core(
+        state, obstacles, cfg, None, None, params=params
+    )
+    return out, telem
 
 
 @watched("swarm-rollout")

@@ -102,11 +102,23 @@ def dead_winner_tasks(state: SwarmState) -> jax.Array:
     return awarded & ~winner_alive
 
 
-def allocation_step(state: SwarmState, cfg: SwarmConfig) -> SwarmState:
+def allocation_step(
+    state: SwarmState, cfg: SwarmConfig, params=None
+) -> SwarmState:
     """One allocation tick: dead-winner eviction, greedy claims, leader
-    arbitration, award."""
+    arbitration, award.
+
+    ``params`` (r13, serve/batched.py): optional per-scenario override
+    pytree — ``utility_threshold`` becomes a TRACED scalar so a
+    vmapped scenario axis runs heterogeneous claim thresholds in one
+    compiled program.  ``None`` keeps the static config value (every
+    pre-r13 caller; identical graph)."""
     if state.n_tasks == 0:
         return state
+    threshold = (
+        cfg.utility_threshold if params is None
+        else params.utility_threshold
+    )
 
     evict = dead_winner_tasks(state)
     state = state.replace(
@@ -131,7 +143,7 @@ def allocation_step(state: SwarmState, cfg: SwarmConfig) -> SwarmState:
     claims = (
         state.alive[:, None]
         & open_for_me
-        & (u > cfg.utility_threshold)
+        & (u > threshold)
         & leader_exists
     )
     claims_util = jnp.where(claims, u, 0.0)
@@ -158,6 +170,7 @@ def auction_allocation_step(
     state: SwarmState,
     cfg: SwarmConfig,
     leader_emerged: jax.Array | bool = False,
+    params=None,
 ) -> SwarmState:
     """Allocation tick in ``allocation_mode="auction"``: the leader solves
     an eps-optimal one-task-per-agent assignment (Bertsekas auction,
@@ -178,6 +191,18 @@ def auction_allocation_step(
 
     if state.n_tasks == 0:
         return state
+
+    # r13 per-scenario overrides: the auction's eps and the claim
+    # threshold (the ISSUE's "auction eps/theta") become traced
+    # scalars under the serve layer's scenario batching; None keeps
+    # the static config (identical graph).
+    threshold = (
+        cfg.utility_threshold if params is None
+        else params.utility_threshold
+    )
+    auction_eps = (
+        cfg.auction_eps if params is None else params.auction_eps
+    )
 
     t = state.n_tasks
     # Dead winners are evicted immediately (leader or not), exactly like
@@ -212,7 +237,7 @@ def auction_allocation_step(
         # inside the cond branch so the O(N*T*D) work is skipped on the
         # other auction_every - 1 ticks.
         u = utility_matrix(st, cfg)
-        feasible = st.alive[:, None] & (u > cfg.utility_threshold)
+        feasible = st.alive[:, None] & (u > threshold)
         # FLAT auction (r8, VERDICT r5 #7): protocol utilities are
         # bounded by utility_scale (= 100 by default), and the
         # measured rounds tables (docs/PERFORMANCE.md r8) show flat
@@ -222,7 +247,7 @@ def auction_allocation_step(
         # eps-scaling only wins deep price wars (max-util/eps ~ 4000),
         # which the utility model cannot produce; auction_assign_scaled
         # stays available for workloads that can (see its docstring).
-        res = auction_assign(u, feasible, eps=cfg.auction_eps)
+        res = auction_assign(u, feasible, eps=auction_eps)
         got = res.task_agent >= 0                                  # [T]
         row = jnp.maximum(res.task_agent, 0)
         winner = jnp.where(got, st.agent_id[row], NO_WINNER)

@@ -168,7 +168,7 @@ def _unpad(util, feasible, agent_task, task_agent, prices, rounds):
     return AuctionResult(at, ta, prices[:t], rounds)
 
 
-@partial(jax.jit, static_argnames=("eps", "max_rounds"))
+@partial(jax.jit, static_argnames=("max_rounds",))
 def auction_assign(
     util: jax.Array,
     feasible: jax.Array | None = None,
@@ -181,6 +181,11 @@ def auction_assign(
     feasible: [N, T] bool — assignable pairs; defaults to ``util > 0``.
     eps:      bid increment; total utility is within ``max(N, T) * eps``
               of the optimum over feasible partial assignments.
+              DYNAMIC since r13 (a traced scalar is accepted): eps
+              only enters the bid arithmetic, and the serve layer's
+              scenario batching threads a per-scenario eps through
+              one compiled program — a float still produces the
+              identical f32 math.
 
     The returned assignment is one-to-one on the assigned pairs; agents
     and tasks may stay unassigned (id -1) when infeasible, non-positive,

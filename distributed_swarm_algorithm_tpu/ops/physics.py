@@ -332,22 +332,31 @@ def _apf_point_forces(
     state: SwarmState,
     obstacles: Optional[jax.Array],
     cfg: SwarmConfig,
+    params=None,
 ) -> jax.Array:
     """``f_att + f_rep`` — the per-agent point forces of the tick
     (sections 1-2 of :func:`apf_forces_plan`), extracted so the
     spatially-sharded tick (:func:`physics_step_spatial`) reuses them
     verbatim: both are elementwise in the agent axis (the obstacle
     table is replicated), so they partition under GSPMD with no
-    collectives and no cross-path drift."""
+    collectives and no cross-path drift.
+
+    ``params`` (r13, serve/batched.py): an optional per-scenario
+    override pytree carrying DYNAMIC ``k_att``/``k_rep`` scalars —
+    traced data, not jit-static config, so one compiled program
+    serves every gain combination (the scenario-batching substrate).
+    ``None`` keeps the static config values and the pre-r13 graph."""
     pos = state.pos
     eps = jnp.asarray(cfg.dist_eps, pos.dtype)
+    k_att = cfg.k_att if params is None else params.k_att
+    k_rep = cfg.k_rep if params is None else params.k_rep
 
     # 1. Attraction to target (agent.py:116-125): full displacement vector,
     #    gated outside the arrival tolerance.
     delta = state.target - pos
     dist = jnp.linalg.norm(delta, axis=-1)
     pulling = state.has_target & (dist > cfg.arrival_tolerance)
-    f_att = jnp.where(pulling[:, None], cfg.k_att * delta, 0.0)
+    f_att = jnp.where(pulling[:, None], k_att * delta, 0.0)
 
     # 2. Obstacle repulsion (agent.py:127-146).  obstacles: [O, D+1] rows of
     #    (center..., radius), matching update_sensors' (x, y, r) tuples.
@@ -357,7 +366,7 @@ def _apf_point_forces(
         away = pos[:, None, :] - centers[None, :, :]  # [N, O, D]
         center_dist = jnp.linalg.norm(away, axis=-1)  # [N, O]
         surf = jnp.maximum(center_dist - radii[None, :], eps)
-        mag = cfg.k_rep * (1.0 / surf - 1.0 / cfg.rho0) / (surf * surf)
+        mag = k_rep * (1.0 / surf - 1.0 / cfg.rho0) / (surf * surf)
         mag = jnp.where(surf < cfg.rho0, mag, 0.0)
         unit = away / jnp.maximum(center_dist, eps)[..., None]
         f_rep = jnp.sum(mag[..., None] * unit, axis=1)
@@ -371,14 +380,19 @@ def apf_forces_plan(
     obstacles: Optional[jax.Array],
     cfg: SwarmConfig,
     plan=None,
+    params=None,
 ):
     """(force [N, D], plan-or-None): :func:`apf_forces` that also
     hands back the hashgrid plan the tick dispatched on (the one it
     was passed, or the one it built) — the flight recorder
     (utils/telemetry.py) reads the plan's truncation/rebuild counters
-    off it, so a per-tick-built plan is observable too."""
+    off it, so a per-tick-built plan is observable too.
+
+    ``params`` (r13): dynamic per-scenario gain overrides — see
+    :func:`_apf_point_forces`; portable separation paths only (the
+    Pallas kernels bake their gains as Mosaic statics)."""
     pos = state.pos
-    f_point = _apf_point_forces(state, obstacles, cfg)
+    f_point = _apf_point_forces(state, obstacles, cfg, params)
 
     # 3. Neighbor separation (agent.py:148-160): every *other alive agent*
     #    inside the personal-space radius repels with k_sep / d^2.
@@ -388,7 +402,8 @@ def apf_forces_plan(
     #    geometry is commensurate — the moments field in section 4;
     #    field_keys carries the shared fine-grid binning out of the
     #    branch.
-    f_sep, field_keys, plan = _separation_dispatch(state, cfg, plan)
+    f_sep, field_keys, plan = _separation_dispatch(state, cfg, plan,
+                                                   params)
 
     # 4. Velocity-alignment / cohesion field (r6, beyond-parity):
     #    neighborhood mean-velocity matching and centroid attraction
@@ -432,27 +447,41 @@ def apf_forces_plan(
     return f_point + f_sep + f_field, plan
 
 
-def _separation_dispatch(state: SwarmState, cfg: SwarmConfig, plan):
+def _separation_dispatch(state: SwarmState, cfg: SwarmConfig, plan,
+                         params=None):
     """(f_sep, field_keys, plan): the separation-mode dispatch of
     :func:`apf_forces` — section 3 of the tick, extracted so the
     whole backend chain runs under ONE ``separation_dispatch`` named
     scope (the r10 XProf scope map, docs/OBSERVABILITY.md) and the
     possibly-built plan flows back to the caller for telemetry."""
     with jax.named_scope("separation_dispatch"):
-        return _separation_dispatch_impl(state, cfg, plan)
+        return _separation_dispatch_impl(state, cfg, plan, params)
 
 
-def _separation_dispatch_impl(state, cfg, plan):
+def _separation_dispatch_impl(state, cfg, plan, params=None):
     pos = state.pos
     eps = jnp.asarray(cfg.dist_eps, pos.dtype)
     field_keys = None
+    # r13: a dynamic per-scenario k_sep rides the portable paths only
+    # — the Pallas kernels bake their gains into the Mosaic program
+    # (static floats), so a traced gain cannot reach them.  The serve
+    # layer's mode validation keeps kernel configs out; this guard is
+    # the backstop for direct callers.
+    k_sep = cfg.k_sep if params is None else params.k_sep
+    if params is not None and cfg.separation_mode == "pallas":
+        raise ValueError(
+            "per-scenario params (dynamic k_sep) cannot reach "
+            "separation_mode='pallas' — the fused kernel bakes its "
+            "gains as Mosaic statics; use 'dense' (or a portable "
+            "grid mode) for scenario-batched ticks"
+        )
     if cfg.separation_mode == "dense":
         f_sep = _neighbors.separation_dense(
-            pos, state.alive, cfg.k_sep, cfg.personal_space, eps
+            pos, state.alive, k_sep, cfg.personal_space, eps
         )
     elif cfg.separation_mode == "grid":
         f_sep = _neighbors.separation_grid(
-            pos, state.alive, cfg.k_sep, cfg.personal_space, eps,
+            pos, state.alive, k_sep, cfg.personal_space, eps,
             cell=cfg.grid_cell, max_per_cell=cfg.grid_max_per_cell,
         )
     elif cfg.separation_mode == "pallas":
@@ -485,6 +514,7 @@ def _separation_dispatch_impl(state, cfg, plan):
             and pos.dtype == jnp.float32
             and cfg.window_size < tile_bound
             and on_tpu()
+            and params is None  # dynamic k_sep: portable path only
         ):
             from .pallas.window_separation import (
                 separation_window_pallas,
@@ -498,7 +528,7 @@ def _separation_dispatch_impl(state, cfg, plan):
             )
         else:
             f_sep = _neighbors.separation_window(
-                pos, state.alive, cfg.k_sep, cfg.personal_space, eps,
+                pos, state.alive, k_sep, cfg.personal_space, eps,
                 cell=cfg.grid_cell, window=cfg.window_size,
                 presorted=cfg.sort_every > 1,
             )
@@ -515,6 +545,13 @@ def _separation_dispatch_impl(state, cfg, plan):
         use_kernel = tick_uses_hashgrid_kernel(
             cfg, pos.shape[1], pos.dtype, arr=pos
         )
+        if use_kernel and params is not None:
+            raise ValueError(
+                "per-scenario params (dynamic k_sep) cannot reach "
+                "the fused hash-grid kernel (gains are Mosaic "
+                "statics); force hashgrid_backend='portable' for "
+                "scenario-batched ticks"
+            )
         if plan is None:
             plan = build_tick_plan(state, cfg, amortized=False)
         field_keys = plan_field_keys(plan)
@@ -536,7 +573,7 @@ def _separation_dispatch_impl(state, cfg, plan):
             )
         else:
             f_sep = _neighbors.separation_grid_plan(
-                pos, state.alive, cfg.k_sep, cfg.personal_space, eps,
+                pos, state.alive, k_sep, cfg.personal_space, eps,
                 plan,
             )
     elif cfg.separation_mode == "off":
@@ -556,11 +593,17 @@ def integrate(
     moving: jax.Array,
     cfg: SwarmConfig,
     dt: float,
+    max_speed=None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Force -> clamped velocity command -> Euler step (agent.py:165-178)."""
+    """Force -> clamped velocity command -> Euler step (agent.py:165-178).
+
+    ``max_speed`` (r13): an optional DYNAMIC clamp override (traced
+    scalar) — the per-scenario params path; ``None`` keeps the static
+    config value."""
+    ms = cfg.max_speed if max_speed is None else max_speed
     speed = jnp.linalg.norm(force, axis=-1, keepdims=True)
     scale = jnp.where(
-        speed > cfg.max_speed, cfg.max_speed / jnp.maximum(speed, cfg.dist_eps), 1.0
+        speed > ms, ms / jnp.maximum(speed, cfg.dist_eps), 1.0
     )
     vel = force * scale
     vel = jnp.where(moving[:, None], vel, 0.0)
@@ -628,6 +671,7 @@ def _physics_step_core(
     cfg: SwarmConfig,
     plan,
     dt: Optional[float],
+    params=None,
 ):
     """The one tick body behind :func:`physics_step`,
     :func:`physics_step_telem`, and :func:`physics_step_plan` —
@@ -638,7 +682,14 @@ def _physics_step_core(
     the tick computed anyway (post-step pos/vel, the pre-clamp force,
     the dispatched plan) — read-only, so the trajectory is bitwise
     independent of the gate (tests/test_telemetry.py pins this with
-    ``utils/replay.fingerprint``)."""
+    ``utils/replay.fingerprint``).
+
+    ``params`` (r13, serve/batched.py): per-scenario dynamic gain
+    overrides (``k_att``/``k_rep``/``k_sep``/``max_speed``) threaded
+    as TRACED scalars so a vmapped scenario axis runs heterogeneous
+    physics in one compiled program.  ``None`` (every pre-r13 caller)
+    reads the static config — identical graph, pinned bitwise by
+    tests/test_serve.py."""
     dt = cfg.dt if dt is None else dt
     if plan is not None:
         from .hashgrid_plan import refresh_plan
@@ -651,12 +702,16 @@ def _physics_step_core(
             rebuild_every=cfg.hashgrid_rebuild_every,
         )
     derived = formation_targets(state, cfg)
-    force, tick_plan = apf_forces_plan(derived, obstacles, cfg, plan=plan)
+    force, tick_plan = apf_forces_plan(derived, obstacles, cfg, plan=plan,
+                                       params=params)
     # Reference semantics: no target => early return, nothing moves
     # (agent.py:113-114).  Dead agents are frozen too (masked update).
     moving = derived.has_target & state.alive
     with jax.named_scope("integrate"):
-        pos, vel = integrate(state.pos, force, moving, cfg, dt)
+        pos, vel = integrate(
+            state.pos, force, moving, cfg, dt,
+            max_speed=None if params is None else params.max_speed,
+        )
         pos = jnp.where(moving[:, None], pos, state.pos)
     out = state.replace(pos=pos, vel=vel)
     telem = None

@@ -1,0 +1,46 @@
+"""Multi-tenant rollout serving (r13): scenario-batched swarm
+rollouts with bucketed compiled shapes and an async double-buffered
+submit/collect loop.  See serve/batched.py (the vmapped tick +
+per-scenario params), serve/buckets.py (the shape lattice), and
+serve/service.py (the host loop)."""
+
+from .batched import (
+    MATERIALIZE_ENTRY,
+    PARAM_FIELDS,
+    SERVE_ENTRY,
+    ScenarioParams,
+    ScenarioRequest,
+    bake_params,
+    batched_rollout,
+    materialize_batch,
+    materialize_scenario,
+    scenario_params,
+    stack_params,
+    stack_scenarios,
+    tenant_state,
+    validate_request,
+    validate_serve_config,
+)
+from .buckets import BucketSpec
+from .service import RolloutService, TenantResult
+
+__all__ = [
+    "MATERIALIZE_ENTRY",
+    "PARAM_FIELDS",
+    "SERVE_ENTRY",
+    "BucketSpec",
+    "RolloutService",
+    "ScenarioParams",
+    "ScenarioRequest",
+    "TenantResult",
+    "bake_params",
+    "batched_rollout",
+    "materialize_batch",
+    "materialize_scenario",
+    "scenario_params",
+    "stack_params",
+    "stack_scenarios",
+    "tenant_state",
+    "validate_request",
+    "validate_serve_config",
+]

@@ -1,0 +1,461 @@
+"""Scenario-batched swarm rollouts: many small swarms, one program.
+
+The north star's "millions of users" is not one 1M-agent swarm (r12
+sharded that) but THOUSANDS of small, heterogeneous swarms per chip —
+the population-batched pattern of Fast Population-Based RL (arxiv
+2206.08888) and ABMax (arxiv 2508.16508): stack the per-scenario
+state along a leading axis, move every per-scenario tunable from
+jit-static config into a TRACED params pytree, and ``vmap`` the tick
+so one compiled ``lax.scan`` steps the whole tenant population.
+
+Three pieces:
+
+- :class:`ScenarioParams` — the dynamic per-scenario scalars (APF
+  gains, max-speed clamp, auction eps/theta).  Everything else stays
+  in the static :class:`~..utils.config.SwarmConfig`, shared by the
+  batch (structure: separation mode, shapes, cadences).
+- :class:`ScenarioRequest` + :func:`materialize_batch` — the host
+  description of one tenant's swarm, and THE one constructor of its
+  padded :class:`~..state.SwarmState`: one jitted, vmapped build per
+  dispatch (per-request ``make_swarm`` + ``kill`` calls measured
+  ~3 ms/scenario of pure host/dispatch overhead — at service rates
+  that was 40% of the whole rollout).  The per-scenario agent count
+  rides the ``alive`` mask (pad slots are dead agents; every
+  protocol reduction already masks on liveness), and every scenario
+  derives its own PRNG key from its seed — never broadcast one key
+  across the batch (swarmlint's ``key-broadcast`` rule exists
+  because correlated election jitter across tenants is silent and
+  wrong).  ``materialize_scenario`` is the batch-of-1 view, so the
+  solo parity reference runs the IDENTICAL state by construction.
+- :func:`batched_rollout` — the compiled entry: ``vmap`` of
+  ``models/swarm.swarm_tick_dyn`` under one ``lax.scan``, the
+  scenario-stacked state DONATED (the service's double-buffered loop
+  hands dispatch buffers straight back to XLA).  Registered with the
+  compile observatory as ``"serve-batched-rollout"`` (the
+  materializer as ``"serve-materialize"``) so the bucket lattice
+  (serve/buckets.py) is an enforced budget, not a hope.
+
+Bitwise contract (pinned in tests/test_serve.py): scenario ``i`` of a
+batched rollout equals the same materialized state run solo through
+``swarm_rollout`` with the params baked into the config — per-scenario
+scalars enter the identical arithmetic whether constant-folded or
+traced, and vmapped agent-axis reductions keep their row-wise order.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..models.swarm import swarm_tick_dyn
+from ..state import (
+    FOLLOWER,
+    NO_CAP,
+    NO_LEADER,
+    NO_WINNER,
+    SwarmState,
+)
+from ..utils.compile_watch import watched
+from ..utils.config import TELEMETRY_ON, SwarmConfig
+
+#: Compile-observatory registry names of the serve plane's two jitted
+#: entries — the names the service declares its bucket budgets under.
+SERVE_ENTRY = "serve-batched-rollout"
+MATERIALIZE_ENTRY = "serve-materialize"
+
+#: Separation modes the batched tick supports.  Dense is exact at the
+#: service's small-swarm scale and vmaps to one fused pair sweep;
+#: "off" serves pure-protocol tenants.  The spatial-hash modes bake
+#: grid geometry from static config (and the Pallas kernels bake
+#: their gains), so they stay solo/sharded-path features.
+SUPPORTED_SEPARATION = ("dense", "off")
+
+
+@struct.dataclass
+class ScenarioParams:
+    """Per-scenario DYNAMIC overrides — every leaf an f32 scalar
+    (stacked: ``[S]`` per leaf).  These are traced data: one compiled
+    program serves every value combination.  Fields mirror their
+    ``SwarmConfig`` namesakes; ``utility_threshold`` / ``auction_eps``
+    are the allocation layer's theta/eps pair."""
+
+    k_att: jax.Array
+    k_rep: jax.Array
+    k_sep: jax.Array
+    max_speed: jax.Array
+    utility_threshold: jax.Array
+    auction_eps: jax.Array
+
+
+#: The SwarmConfig fields ScenarioParams can override — one tuple so
+#: the builder, the baker, and the docs cannot drift.
+PARAM_FIELDS = (
+    "k_att", "k_rep", "k_sep", "max_speed", "utility_threshold",
+    "auction_eps",
+)
+
+
+def scenario_params(cfg: SwarmConfig, **overrides) -> ScenarioParams:
+    """Build one scenario's params: config defaults, selectively
+    overridden.  Values are stored as f32 scalars (the dtype the tick
+    computes in), so baking them back into a config is lossless."""
+    bad = set(overrides) - set(PARAM_FIELDS)
+    if bad:
+        raise ValueError(
+            f"unknown scenario param(s) {sorted(bad)}; "
+            f"overridable fields: {PARAM_FIELDS}"
+        )
+    return ScenarioParams(**{
+        f: jnp.asarray(
+            overrides.get(f, getattr(cfg, f)), jnp.float32
+        )
+        for f in PARAM_FIELDS
+    })
+
+
+def bake_params(cfg: SwarmConfig, params: ScenarioParams) -> SwarmConfig:
+    """The inverse direction: one scenario's params as a STATIC config
+    — the solo reference path of the bitwise parity contract
+    (``swarm_rollout`` with this config == the batched row).  The
+    f32 -> Python float -> f32 round trip is exact, so both paths
+    compute with the identical scalar."""
+    return cfg.replace(**{
+        f: float(np.float32(np.asarray(getattr(params, f))))
+        for f in PARAM_FIELDS
+    })
+
+
+def stack_params(params) -> ScenarioParams:
+    """Stack per-scenario params into the ``[S]``-leaved batch pytree."""
+    params = list(params)
+    if not params:
+        raise ValueError("stack_params needs at least one scenario")
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *params
+    )
+
+
+@dataclass(frozen=True)
+class ScenarioRequest:
+    """One tenant's scenario, host-side.
+
+    ``n_agents`` is the REAL agent count; the service pads it up to a
+    capacity bucket (the pad slots are dead).  ``arena_hw`` scales the
+    spawn spread (the per-scenario "arena size"; must be > 0 — every
+    tenant draws its spawn from its own seed's stream); ``target`` is
+    an optional shared nav goal (``None`` = station-keeping: every
+    agent holds its spawn pose — the r12 arena).  ``task_pos`` rows
+    install a task table (all requests of one service must agree on
+    the row COUNT — it is a shape); ``kill_ids`` injects initial
+    faults (the recovery-scenario hook: killing the would-be leader
+    forces an election the per-tenant flight recorder then shows).
+    ``params`` maps ScenarioParams field names to per-tenant values.
+    """
+
+    n_agents: int
+    seed: int = 0
+    arena_hw: float = 8.0
+    target: Optional[Tuple[float, float]] = None
+    task_pos: Tuple[Tuple[float, float], ...] = ()
+    kill_ids: Tuple[int, ...] = ()
+    params: Dict[str, float] = field(default_factory=dict)
+
+
+def validate_request(req: ScenarioRequest, capacity=None) -> None:
+    """Every per-request invariant, in one place — the service checks
+    them at SUBMIT time (a bad request must fail at its own submit,
+    not poison its co-batched requests' flush) and the materializer
+    re-checks them (direct callers).  ``capacity`` adds the bucket
+    bound when known."""
+    if req.n_agents <= 0:
+        raise ValueError(
+            f"scenario needs n_agents >= 1, got {req.n_agents}"
+        )
+    if capacity is not None and req.n_agents > capacity:
+        raise ValueError(
+            f"n_agents {req.n_agents} outside (0, capacity="
+            f"{capacity}]"
+        )
+    if not req.arena_hw > 0:
+        raise ValueError(
+            f"arena_hw must be > 0, got {req.arena_hw} (the spawn "
+            "spread — every scenario draws its arena from its own "
+            "seed)"
+        )
+    bad = set(req.params) - set(PARAM_FIELDS)
+    if bad:
+        raise ValueError(
+            f"unknown scenario param(s) {sorted(bad)}; overridable "
+            f"fields: {PARAM_FIELDS}"
+        )
+    out = [k for k in req.kill_ids if not 0 <= k < req.n_agents]
+    if out:
+        # Silently dropping these would turn an off-by-one on "kill
+        # the would-be leader" into a quiet no-fault tenant (and a
+        # negative id would wrap to a different slot).
+        raise ValueError(
+            f"kill_ids {out} outside [0, n_agents={req.n_agents}) — "
+            "fault injection must name real agents"
+        )
+
+
+@watched(MATERIALIZE_ENTRY)
+@partial(jax.jit, static_argnames=("capacity", "n_tasks"))
+def _materialize_batch_impl(
+    seeds: jax.Array,        # [S] i32
+    spreads: jax.Array,      # [S] f32 arena half-widths
+    alive: jax.Array,        # [S, capacity] bool (pads/faults dead)
+    use_point: jax.Array,    # [S] bool — point target vs station
+    points: jax.Array,       # [S, 2] f32 shared nav goal (if use_point)
+    task_pos: jax.Array,     # [S, n_tasks, 2] f32
+    capacity: int,
+    n_tasks: int,
+) -> SwarmState:
+    """One compiled, vmapped constructor for a whole dispatch batch —
+    the shapes-and-seeds half of scenario materialization.  Mirrors
+    ``make_swarm(capacity, seed, spread) -> with_tasks -> kill ->
+    station/point targets`` semantically: spawn drawn from the
+    scenario's own seed (split exactly like ``make_swarm``), dead
+    slots via the alive mask with the ``alive_below`` cache recounted,
+    targets = spawn pose (station-keeping) or the shared point."""
+
+    def one(seed, spread, alive_row, use_pt, point, tpos):
+        key = jax.random.PRNGKey(seed)
+        key, sub = jax.random.split(key)
+        pos = jax.random.uniform(
+            sub, (capacity, 2), jnp.float32,
+            minval=-spread, maxval=spread,
+        )
+        aint = alive_row.astype(jnp.int32)
+        alive_below = jnp.cumsum(aint) - aint
+        target = jnp.where(
+            use_pt,
+            jnp.broadcast_to(point, pos.shape),
+            pos,
+        )
+        return SwarmState(
+            tick=jnp.asarray(0, jnp.int32),
+            key=key,
+            agent_id=jnp.arange(capacity, dtype=jnp.int32),
+            alive=alive_row,
+            pos=pos,
+            vel=jnp.zeros((capacity, 2), jnp.float32),
+            caps=jnp.zeros((capacity, 1), bool),
+            target=target,
+            has_target=jnp.ones((capacity,), bool),
+            fsm=jnp.full((capacity,), FOLLOWER, jnp.int32),
+            leader_id=jnp.full((capacity,), NO_LEADER, jnp.int32),
+            leader_pos=jnp.zeros((capacity, 2), jnp.float32),
+            has_leader_pos=jnp.zeros((capacity,), bool),
+            last_hb_tick=jnp.zeros((capacity,), jnp.int32),
+            wait_until=jnp.zeros((capacity,), jnp.int32),
+            alive_below=alive_below,
+            leader_live=jnp.ones((capacity,), bool),
+            task_pos=tpos,
+            task_cap=jnp.full((n_tasks,), NO_CAP, jnp.int32),
+            task_winner=jnp.full((n_tasks,), NO_WINNER, jnp.int32),
+            task_util=jnp.zeros((n_tasks,), jnp.float32),
+            task_claimed=jnp.zeros((capacity, n_tasks), bool),
+        )
+
+    return jax.vmap(one)(
+        seeds, spreads, alive, use_point, points, task_pos
+    )
+
+
+def materialize_batch(
+    reqs: Sequence[ScenarioRequest],
+    capacity: int,
+    cfg: SwarmConfig,
+    pad_to: Optional[int] = None,
+) -> Tuple[SwarmState, ScenarioParams]:
+    """Materialize a dispatch batch: ``[S, ...]``-stacked states +
+    ``[S]``-leaved params, S = ``pad_to`` or ``len(reqs)``.  Rows past
+    ``len(reqs)`` are dead FILLER scenarios (every slot dead — they
+    tick along at full shape and their rows are discarded): the
+    padding half of the bucket contract.  All host work is cheap
+    numpy assembly; the build itself is one jitted call per
+    ``(S, capacity, n_tasks)`` shape."""
+    if not reqs:
+        raise ValueError("materialize_batch needs at least one request")
+    n_real = len(reqs)
+    size = pad_to if pad_to is not None else n_real
+    if size < n_real:
+        raise ValueError(f"pad_to {size} < {n_real} requests")
+    if cfg.dtype != "float32":
+        raise ValueError(
+            "scenario batching materializes float32 swarms; got "
+            f"cfg.dtype={cfg.dtype!r}"
+        )
+    n_tasks = len(reqs[0].task_pos)
+    seeds = np.zeros((size,), np.int32)
+    spreads = np.full((size,), 1.0, np.float32)
+    alive = np.zeros((size, capacity), bool)
+    use_point = np.zeros((size,), bool)
+    points = np.zeros((size, 2), np.float32)
+    task_pos = np.zeros((size, n_tasks, 2), np.float32)
+    pvals = {
+        f: np.full((size,), getattr(cfg, f), np.float32)
+        for f in PARAM_FIELDS
+    }
+    for i, req in enumerate(reqs):
+        validate_request(req, capacity=capacity)
+        if len(req.task_pos) != n_tasks:
+            raise ValueError(
+                "all scenarios in one batch must install the same "
+                f"task count (a shape): got {n_tasks} and "
+                f"{len(req.task_pos)}"
+            )
+        seeds[i] = req.seed
+        spreads[i] = req.arena_hw
+        alive[i, : req.n_agents] = True
+        alive[i, list(req.kill_ids)] = False
+        if req.target is not None:
+            use_point[i] = True
+            points[i] = req.target
+        if n_tasks:
+            task_pos[i] = np.asarray(req.task_pos, np.float32)
+        for f, v in req.params.items():
+            pvals[f][i] = v
+    states = _materialize_batch_impl(
+        jnp.asarray(seeds), jnp.asarray(spreads), jnp.asarray(alive),
+        jnp.asarray(use_point), jnp.asarray(points),
+        jnp.asarray(task_pos), capacity=capacity, n_tasks=n_tasks,
+    )
+    params = ScenarioParams(
+        **{f: jnp.asarray(v) for f, v in pvals.items()}
+    )
+    return states, params
+
+
+def materialize_scenario(
+    req: ScenarioRequest, capacity: int, cfg: SwarmConfig
+) -> Tuple[SwarmState, ScenarioParams]:
+    """One scenario's padded state + params — the batch-of-1 view of
+    :func:`materialize_batch`, so the solo parity reference and the
+    batched service run the IDENTICAL constructor."""
+    states, params = materialize_batch([req], capacity, cfg)
+    return (
+        tenant_state(states, 0),
+        jax.tree_util.tree_map(lambda x: x[0], params),
+    )
+
+
+def stack_scenarios(states) -> SwarmState:
+    """Stack per-scenario states into the ``[S, ...]``-leaved batch
+    (scalar leaves — tick, key — become ``[S]`` / ``[S, 2]``)."""
+    states = list(states)
+    if not states:
+        raise ValueError("stack_scenarios needs at least one scenario")
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *states
+    )
+
+
+def tenant_state(states: SwarmState, i: int) -> SwarmState:
+    """Scenario ``i``'s state out of the batch (still capacity-padded
+    — trim with ``[:n_agents]`` views if needed)."""
+    return jax.tree_util.tree_map(lambda x: x[i], states)
+
+
+def validate_serve_config(cfg: SwarmConfig) -> SwarmConfig:
+    """The batched tick's static-config envelope, checked eagerly at
+    service construction so misconfiguration fails at the API
+    boundary, not mid-trace."""
+    if cfg.separation_mode not in SUPPORTED_SEPARATION:
+        raise ValueError(
+            f"scenario batching supports separation_mode in "
+            f"{SUPPORTED_SEPARATION}, got {cfg.separation_mode!r} — "
+            "the spatial-hash/window modes derive grid geometry from "
+            "static config (and the Pallas kernels bake their "
+            "gains), so they cannot take per-scenario dynamic params"
+        )
+    return cfg
+
+
+@watched(SERVE_ENTRY)
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "n_steps", "record", "telemetry"),
+    donate_argnums=(0,),
+)
+def _batched_rollout_impl(
+    states: SwarmState,
+    params: Optional[ScenarioParams],
+    cfg: SwarmConfig,
+    n_steps: int,
+    record: bool = False,
+    telemetry: bool = False,
+):
+    """``n_steps`` vmapped ticks under one ``lax.scan`` — the compiled
+    multi-tenant program.  ``states``/``params`` carry a leading
+    scenario axis; ``states`` is DONATED (the service's submit/collect
+    loop hands each dispatch's buffers straight back to XLA — with
+    async dispatch the host materializes bucket k+1 while bucket k
+    executes, the double-buffering half of the r13 design).
+
+    Result composition mirrors ``_swarm_rollout_impl``: ``record``
+    prepends the ``[n_steps, S, capacity, D]`` position trajectory,
+    ``telemetry`` appends the stacked per-tenant recorder ys
+    (``[n_steps, S]`` per leaf — ``utils/telemetry.tenant_summaries``
+    reduces them per scenario).  The telemetry gate is the r10 static
+    contract: disabled, the lowering is byte-identical to the
+    flag-free entry (pinned in tests/test_serve.py)."""
+    telem_on = telemetry or cfg.telemetry.enabled
+    if telem_on and not cfg.telemetry.enabled:
+        cfg = cfg.replace(telemetry=TELEMETRY_ON)
+
+    if params is None:
+        vtick = jax.vmap(
+            lambda s: swarm_tick_dyn(s, None, cfg, None)
+        )
+
+        def step(ss):
+            return vtick(ss)
+    else:
+        vtick = jax.vmap(
+            lambda s, p: swarm_tick_dyn(s, None, cfg, p)
+        )
+
+        def step(ss):
+            return vtick(ss, params)
+
+    def body(ss, _):
+        ss, telem = step(ss)
+        frame = ss.pos if record else None
+        return ss, (frame, telem)
+
+    states, (traj, telem) = jax.lax.scan(
+        body, states, None, length=n_steps
+    )
+    out = (states, traj) if record else states
+    if telem_on:
+        if not n_steps:
+            telem = None
+        out = out + (telem,) if record else (out, telem)
+    return out
+
+
+def batched_rollout(
+    states: SwarmState,
+    params: Optional[ScenarioParams],
+    cfg: SwarmConfig,
+    n_steps: int,
+    record: bool = False,
+    telemetry: bool = False,
+):
+    """Public entry for the scenario-batched rollout (see
+    :func:`_batched_rollout_impl`).  ``states`` must carry a leading
+    scenario axis (:func:`materialize_batch` or
+    :func:`stack_scenarios`) and is DONATED — do not reuse its
+    buffers after the call."""
+    validate_serve_config(cfg)
+    return _batched_rollout_impl(
+        states, params, cfg, n_steps, record, telemetry
+    )

@@ -1,0 +1,122 @@
+"""Shape bucketing for the multi-tenant rollout service.
+
+One compiled program exists per distinct ``(scenario_batch,
+agent_capacity)`` shape of the batched tick — and a serving workload
+left unquantized produces a fresh shape per request mix, which is a
+retrace storm by construction (the runtime failure mode the compile
+observatory's storm detector and swarmlint's ``retrace`` rule both
+exist to catch; Fast Population-Based RL, arxiv 2206.08888, names
+compilation cost as THE pitfall of population-batched stepping).
+
+:class:`BucketSpec` quantizes both axes into a small fixed lattice:
+
+- **agent capacity**: each request is padded up to the smallest
+  capacity rung that fits it (the pad agents ride as dead slots in
+  the existing ``alive`` mask — the protocol already masks every
+  reduction on liveness, so padding is semantically free);
+- **scenario batch**: each flush of same-capacity requests is split
+  into dispatch batches drawn only from the ``batches`` rungs
+  (largest-first; a final partial dispatch pads with dead filler
+  scenarios up to the smallest rung that covers it).
+
+The service therefore holds at most ``len(capacities) *
+len(batches)`` compiled entries — a budget it declares to the
+compile observatory (``utils/compile_watch.declare_buckets``), which
+turns any excess compile into a structured ``bucket-overflow`` event
+instead of a silent 2x latency bill.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Tuple
+
+#: Default lattice: three capacity rungs x three batch rungs = nine
+#: compiled shapes at most — "a handful of cache entries".
+DEFAULT_CAPACITIES = (64, 256, 1024)
+DEFAULT_BATCHES = (1, 8, 64)
+
+
+@dataclass(frozen=True)
+class BucketSpec:
+    """The service's compiled-shape lattice (immutable; the compile
+    budget is ``max_shapes``)."""
+
+    capacities: Tuple[int, ...] = DEFAULT_CAPACITIES
+    batches: Tuple[int, ...] = DEFAULT_BATCHES
+
+    def __post_init__(self):
+        for name, rungs in (
+            ("capacities", self.capacities), ("batches", self.batches)
+        ):
+            if not rungs:
+                raise ValueError(f"BucketSpec.{name} must be non-empty")
+            if any(r <= 0 for r in rungs):
+                raise ValueError(
+                    f"BucketSpec.{name} must be positive, got {rungs}"
+                )
+            if tuple(sorted(set(rungs))) != tuple(rungs):
+                raise ValueError(
+                    f"BucketSpec.{name} must be strictly ascending "
+                    f"(the quantizers binary-search them), got {rungs}"
+                )
+
+    @property
+    def max_shapes(self) -> int:
+        """The compile-cache budget: distinct (batch, capacity) shapes
+        the service can ever dispatch."""
+        return len(self.capacities) * len(self.batches)
+
+    def capacity_for(self, n_agents: int) -> int:
+        """Smallest capacity rung holding ``n_agents`` — the agent-axis
+        quantizer.  Raises for requests past the largest rung (the
+        REJECTION half of the padding/eviction contract: an unservable
+        shape must fail loudly at submit time, not compile a bespoke
+        program)."""
+        if n_agents <= 0:
+            raise ValueError(
+                f"scenario needs n_agents >= 1, got {n_agents}"
+            )
+        for cap in self.capacities:
+            if n_agents <= cap:
+                return cap
+        raise ValueError(
+            f"scenario with {n_agents} agents exceeds the largest "
+            f"capacity bucket {self.capacities[-1]}; widen "
+            "BucketSpec.capacities (each rung is one compiled shape)"
+        )
+
+    def split_batch(self, k: int) -> List[int]:
+        """Dispatch batch sizes covering ``k`` pending scenarios, every
+        size a ``batches`` rung (sum >= k; the excess of the final
+        dispatch is padded with dead filler scenarios).
+
+        Deterministic greedy with a BOUNDED-PAD tail: take the
+        largest rung while it fits whole; for each remainder ``r``,
+        round UP to the smallest rung ``>= r`` when that wastes at
+        most half the dispatch (``rung <= 2*r`` — pad rows still
+        compute, so unbounded rounding would trade cheap dispatch
+        overhead for expensive dead compute), else take the largest
+        rung ``<= r`` and continue; when no rung fits below ``r`` the
+        smallest rung above is forced.  Rounding the near-full tail
+        up is what keeps a 71-request flush at ``[64, 8]`` instead of
+        seven single-scenario dispatches — per-dispatch host overhead
+        is the cost the serve layer exists to amortize.
+        """
+        if k <= 0:
+            return []
+        out: List[int] = []
+        largest = self.batches[-1]
+        while k >= largest:
+            out.append(largest)
+            k -= largest
+        while k > 0:
+            up = [b for b in self.batches if k <= b <= 2 * k]
+            if up:
+                out.append(up[0])
+                break
+            fit = [b for b in self.batches if b <= k]
+            rung = fit[-1] if fit else self.batches[0]
+            out.append(rung)
+            k -= rung
+        return out

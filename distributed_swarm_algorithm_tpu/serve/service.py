@@ -1,0 +1,335 @@
+"""The multi-tenant rollout service: submit scenarios, collect results.
+
+The async host loop over serve/batched.py + serve/buckets.py:
+
+    svc = RolloutService(cfg, n_steps=50)
+    rid = svc.submit(ScenarioRequest(n_agents=100, seed=7))
+    ...
+    svc.flush()                      # dispatch everything pending
+    result = svc.collect(rid)        # block on THAT dispatch only
+
+``flush`` groups pending requests by capacity bucket, splits each
+group into batch-rung dispatches (serve/buckets.py), materializes the
+padded states, and launches the compiled batched rollout WITHOUT
+blocking: jax's async dispatch queues the device work, so the host is
+already materializing dispatch k+1 while dispatch k executes, and the
+donated state buffers go straight back to XLA — the double-buffered
+submit/collect loop of the r13 design.  ``collect`` is keyed by
+request id and blocks only on the dispatch that holds it, so results
+may be consumed in ANY order relative to submission (out-of-order
+completion is the normal case for a mixed-bucket stream).
+
+Collected results are evicted from the service (the result store is
+bounded by what is in flight, not by service lifetime); collecting an
+unknown or already-collected id raises ``KeyError``.
+
+Compile budget: the service declares ``spec.max_shapes`` to the
+compile observatory under the ``"serve-batched-rollout"`` entry —
+with the observatory enabled (``DSA_COMPILE_WATCH=1``), any compile
+past the bucket lattice fires a structured ``bucket-overflow`` event
+(utils/compile_watch.py), and benchmarks/bench_multitenant.py gates
+the count as a fixed-name "compiles" row.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..state import SwarmState
+from ..utils import compile_watch
+from ..utils.config import DEFAULT_CONFIG, SwarmConfig
+from ..utils.telemetry import TelemetrySummary, tenant_telemetry
+from .batched import (
+    MATERIALIZE_ENTRY,
+    SERVE_ENTRY,
+    ScenarioRequest,
+    batched_rollout,
+    materialize_batch,
+    tenant_state,
+    validate_request,
+    validate_serve_config,
+)
+from .buckets import BucketSpec
+
+
+@dataclass
+class TenantResult:
+    """One collected scenario.
+
+    ``state`` is the final capacity-padded :class:`SwarmState` with
+    HOST numpy leaves (the bitwise-parity surface — identical to the
+    solo rollout of the same materialized scenario; one device->host
+    transfer per dispatch, free views per tenant); ``summary`` the
+    tenant's flight-recorder reduction (None with telemetry off);
+    ``traj`` the ``[n_steps, n_agents, D]`` recorded trajectory
+    trimmed to the REAL agent count (None with record off)."""
+
+    request_id: int
+    n_agents: int
+    capacity: int
+    state: SwarmState
+    summary: Optional[dict] = None
+    traj: Optional[np.ndarray] = None
+
+
+class _Dispatch:
+    """One launched bucket batch: the async handles plus the rid ->
+    batch-row map.  Buffers are dropped once every tenant is
+    collected (result-store eviction)."""
+
+    def __init__(self, rids, states, traj, telem):
+        self.rids: List[int] = rids          # row i <-> rids[i]
+        self.states = states                 # [S, ...] final states
+        self.traj = traj                     # [T, S, C, D] or None
+        self.telem = telem                   # [T, S]-leaved or None
+        self._host = None
+
+    def block(self):
+        jax.block_until_ready(self.states.pos)
+
+    def host_states(self) -> SwarmState:
+        """The final states as host numpy — one device->host transfer
+        per dispatch, then per-tenant extraction is a free view (a
+        per-tenant device slice measured ~3 ms/tenant of dispatch
+        overhead at collect time)."""
+        if self._host is None:
+            self.block()
+            self._host = jax.tree_util.tree_map(
+                np.asarray, self.states
+            )
+        return self._host
+
+    def host_telem(self):
+        """The stacked recorder ys as host numpy (same one-transfer
+        discipline as :meth:`host_states`)."""
+        if self.telem is not None and not isinstance(
+            self.telem.tick, np.ndarray
+        ):
+            self.telem = jax.tree_util.tree_map(
+                np.asarray, self.telem
+            )
+        return self.telem
+
+    def host_traj(self):
+        """The recorded trajectory as host numpy — the largest buffer
+        in the dispatch, so per-tenant device slices would be the
+        worst offenders of the one-transfer rule."""
+        if self.traj is not None and not isinstance(
+            self.traj, np.ndarray
+        ):
+            self.traj = np.asarray(self.traj)
+        return self.traj
+
+
+class RolloutService:
+    """Scenario-batched swarm serving — thousands of concurrent small
+    swarms per chip through a handful of compiled shapes.
+
+    Static per-service: the shared :class:`SwarmConfig` (structure),
+    the rollout length, and the telemetry/record composition — each
+    is a jit-static of the batched entry, so keeping them per-service
+    keeps the compile budget at ``spec.max_shapes``.  Per-REQUEST:
+    agent count (alive-mask padding), arena, seed, faults, tasks, and
+    every :class:`~.batched.ScenarioParams` scalar.
+    """
+
+    def __init__(
+        self,
+        cfg: Optional[SwarmConfig] = None,
+        spec: Optional[BucketSpec] = None,
+        n_steps: int = 50,
+        telemetry: bool = True,
+        record: bool = False,
+    ):
+        self.cfg = validate_serve_config(cfg or DEFAULT_CONFIG)
+        self.spec = spec or BucketSpec()
+        if n_steps <= 0:
+            raise ValueError(f"n_steps must be >= 1, got {n_steps}")
+        self.n_steps = int(n_steps)
+        # The EFFECTIVE flag: the batched entry returns the telemetry
+        # ys whenever the flag OR the config gate is on, so the
+        # unpacking below must agree with that disjunction — a config
+        # with telemetry pre-enabled plus telemetry=False would
+        # otherwise make the service mistake (states, telem) for
+        # states.
+        self.telemetry = bool(telemetry) or self.cfg.telemetry.enabled
+        self.record = bool(record)
+        self._next_rid = 0
+        #: (capacity, n_tasks) -> [(rid, request)] awaiting flush,
+        #: FIFO.  The task count is part of the bucket key because it
+        #: is a SHAPE (the task table rides the batch) — mixing task
+        #: counts in one dispatch would be a retrace, not a batch.
+        self._pending: Dict[tuple, List] = {}
+        #: rid -> _Dispatch holding its row.
+        self._dispatches: Dict[int, _Dispatch] = {}
+        #: rid -> (request, capacity) for pending bookkeeping.
+        self._requests: Dict[int, tuple] = {}
+        #: distinct task counts seen — each one multiplies the
+        #: compiled-shape lattice (shape axis #3).
+        self._task_counts: set = set()
+        self.stats = {
+            "submitted": 0, "dispatches": 0, "padded_scenarios": 0,
+            "collected": 0,
+        }
+        self._declare_budgets(n_task_families=1)
+
+    def _declare_budgets(self, n_task_families: int) -> None:
+        # Declare the compile budgets whether or not the observatory
+        # is enabled — declaration is free and makes a later enable()
+        # retroactively meaningful for new compiles.  The budget is
+        # the bucket lattice times the observed task-count families;
+        # the materializer adds the batch-of-1 scalar view.  The
+        # registry (and the jit caches it mirrors) is PROCESS-GLOBAL:
+        # with several services alive, the declared budget is the MAX
+        # over services (a smaller second service must not turn the
+        # first's legitimate compiles into overflow events), and
+        # compile_entries() counts every service's compiles — the
+        # per-service gate in bench_multitenant runs one service per
+        # process, the honest granularity the jit cache offers.
+        watch = compile_watch.WATCH
+        budget = self.spec.max_shapes * max(n_task_families, 1)
+        for entry, b in (
+            (SERVE_ENTRY, budget), (MATERIALIZE_ENTRY, budget + 1)
+        ):
+            prev = watch.bucket_budget(entry)
+            watch.declare_buckets(entry, max(b, prev or 0))
+
+    # -- submit ------------------------------------------------------------
+    def submit(self, req: ScenarioRequest) -> int:
+        """Queue one scenario; returns its request id.  EVERY request
+        invariant is checked here — oversized shapes (no capacity
+        rung fits; the eviction half of the bucket contract) and the
+        materializer's field contracts — so a bad request fails at
+        its own submit instead of poisoning the co-batched requests'
+        flush."""
+        capacity = self.spec.capacity_for(req.n_agents)
+        validate_request(req)
+        rid = self._next_rid
+        self._next_rid += 1
+        n_tasks = len(req.task_pos)
+        if n_tasks not in self._task_counts:
+            self._task_counts.add(n_tasks)
+            self._declare_budgets(len(self._task_counts))
+        self._pending.setdefault((capacity, n_tasks), []).append(
+            (rid, req)
+        )
+        self._requests[rid] = (req, capacity)
+        self.stats["submitted"] += 1
+        return rid
+
+    # -- dispatch ----------------------------------------------------------
+    def flush(self) -> int:
+        """Dispatch every pending request as bucketed batches; returns
+        the number of dispatches launched.  Non-blocking: the device
+        works while the host materializes the next batch."""
+        launched = 0
+        for key in sorted(self._pending):
+            capacity, _ = key
+            group = self._pending[key]
+            for size in self.spec.split_batch(len(group)):
+                entries = group[:size]
+                # Launch BEFORE dequeuing: a failed launch must not
+                # silently drop its co-batched requests.
+                self._launch(capacity, size, entries)
+                del group[:size]
+                launched += 1
+        self._pending = {k: g for k, g in self._pending.items() if g}
+        self.stats["dispatches"] += launched
+        return launched
+
+    def _launch(self, capacity: int, size: int, entries) -> None:
+        rids = [rid for rid, _ in entries]
+        reqs = [req for _, req in entries]
+        n_pad = size - len(reqs)
+        self.stats["padded_scenarios"] += n_pad
+        # One jitted build for the whole dispatch (rows past the real
+        # requests are dead filler scenarios), one compiled rollout;
+        # neither call blocks, so the host is already materializing
+        # the NEXT dispatch while this one executes (async dispatch =
+        # the double buffer), and the donated state buffers go
+        # straight back to XLA.
+        states, params = materialize_batch(
+            reqs, capacity, self.cfg, pad_to=size
+        )
+        out = batched_rollout(
+            states, params, self.cfg, self.n_steps,
+            record=self.record, telemetry=self.telemetry,
+        )
+        traj = telem = None
+        if self.record and self.telemetry:
+            states, traj, telem = out
+        elif self.record:
+            states, traj = out
+        elif self.telemetry:
+            states, telem = out
+        else:
+            states = out
+        d = _Dispatch(rids, states, traj, telem)
+        for rid in rids:
+            self._dispatches[rid] = d
+
+    # -- collect -----------------------------------------------------------
+    def collect(self, rid: int) -> TenantResult:
+        """Block on (only) the dispatch holding ``rid`` and return its
+        tenant's result, evicting it from the service.  Pending but
+        unflushed requests are flushed first.  Raises ``KeyError``
+        for unknown or already-collected ids."""
+        if rid not in self._dispatches:
+            if rid in self._requests and any(
+                rid == r for g in self._pending.values() for r, _ in g
+            ):
+                self.flush()
+        if rid not in self._dispatches:
+            raise KeyError(
+                f"request id {rid} is not in flight (never submitted, "
+                "or already collected — results are evicted on "
+                "collect)"
+            )
+        d = self._dispatches.pop(rid)
+        i = d.rids.index(rid)
+        req, capacity = self._requests.pop(rid)
+        summary = None
+        if d.telem is not None:
+            summary = TelemetrySummary.from_ticks(
+                tenant_telemetry(d.host_telem(), i)
+            ).to_dict()
+        traj = None
+        if d.traj is not None:
+            traj = d.host_traj()[:, i, : req.n_agents]
+        result = TenantResult(
+            request_id=rid,
+            n_agents=req.n_agents,
+            capacity=capacity,
+            state=tenant_state(d.host_states(), i),
+            summary=summary,
+            traj=traj,
+        )
+        self.stats["collected"] += 1
+        return result
+
+    def collect_all(self) -> Dict[int, TenantResult]:
+        """Flush, then collect every outstanding request (in-flight
+        and pending), keyed by request id."""
+        self.flush()
+        rids = sorted(self._dispatches)
+        return {rid: self.collect(rid) for rid in rids}
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def n_pending(self) -> int:
+        return sum(len(g) for g in self._pending.values())
+
+    @property
+    def n_in_flight(self) -> int:
+        return len(self._dispatches)
+
+    def compile_entries(self) -> int:
+        """Distinct compiled signatures the observatory has seen for
+        the batched entry (0 unless the observatory is enabled) —
+        the number bench_multitenant gates against
+        ``spec.max_shapes``."""
+        return compile_watch.WATCH.compile_count(SERVE_ENTRY)

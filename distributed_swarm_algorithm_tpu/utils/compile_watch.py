@@ -193,6 +193,10 @@ class CompileWatch:
         self.events: List[dict] = []
         self._sigs: Dict[str, List[str]] = {}
         self._warned: set = set()
+        #: entry -> declared max distinct signatures (r13: the serve
+        #: layer's bucket lattice).  Declarations survive reset() —
+        #: the budget is a property of the entry, not of one run.
+        self._bucket_budgets: Dict[str, int] = {}
 
     # -- lifecycle ---------------------------------------------------------
     def enable(self) -> "CompileWatch":
@@ -208,6 +212,26 @@ class CompileWatch:
         self.events.clear()
         self._sigs.clear()
         self._warned.clear()
+
+    # -- bucket budgets (r13) ----------------------------------------------
+    def declare_buckets(self, entry: str, max_entries: int) -> None:
+        """Declare ``entry``'s compiled-shape budget (the serve
+        layer's bucket lattice, serve/buckets.py): compiles past
+        ``max_entries`` distinct signatures fire a structured
+        ``bucket-overflow`` event + one warning — a shape escaped
+        quantization.  Tighter than the generic storm threshold, and
+        per-entry."""
+        self._bucket_budgets[entry] = int(max_entries)
+
+    def bucket_budget(self, entry: str):
+        """The declared budget for ``entry`` (None = undeclared)."""
+        return self._bucket_budgets.get(entry)
+
+    def within_bucket_budget(self, entry: str) -> bool:
+        """True while ``entry``'s observed compile count is inside
+        its declared budget (vacuously True when undeclared)."""
+        budget = self._bucket_budgets.get(entry)
+        return budget is None or self.compile_count(entry) <= budget
 
     # -- recording ---------------------------------------------------------
     def seen(self, entry: str, sig: str) -> bool:
@@ -233,9 +257,53 @@ class CompileWatch:
             flops=flops, bytes_accessed=bytes_accessed,
         )
         self.records.append(rec)
-        if len(sigs) >= self.storm_threshold:
+        budget = self._bucket_budgets.get(entry)
+        if budget is not None and len(sigs) > budget:
+            self._bucket_overflow(entry, sigs, budget)
+        # A declared bucket budget SUPERSEDES the generic storm
+        # threshold for its entry: compiles inside the lattice are
+        # the design, not a storm (warning a serve workload to adopt
+        # the bucketing it is already using would be noise); past
+        # the budget, bucket-overflow above is the report.
+        if budget is None and len(sigs) >= self.storm_threshold:
             self._storm(entry, sigs)
         return rec
+
+    def _bucket_overflow(
+        self, entry: str, sigs: List[str], budget: int
+    ) -> None:
+        # Same one-event-per-entry discipline as _storm: the count
+        # rises in place.
+        for ev in self.events:
+            if (
+                ev.get("event") == "bucket-overflow"
+                and ev.get("entry") == entry
+            ):
+                ev["compiles"] = len(sigs)
+                ev["signatures"] = sigs[-3:]
+                break
+        else:
+            self.events.append(
+                {
+                    "event": "bucket-overflow",
+                    "entry": entry,
+                    "compiles": len(sigs),
+                    "budget": budget,
+                    "signatures": sigs[-3:],
+                }
+            )
+        mark = ("bucket:" + entry)
+        if mark not in self._warned:
+            self._warned.add(mark)
+            warnings.warn(
+                f"bucket overflow: entry {entry!r} compiled under "
+                f"{len(sigs)} distinct signatures, past its declared "
+                f"bucket budget {budget} — a shape escaped "
+                "quantization (serve/buckets.py); check the request "
+                "stream's shapes against the BucketSpec lattice",
+                RetraceStormWarning,
+                stacklevel=4,
+            )
 
     def _storm(self, entry: str, sigs: List[str]) -> None:
         # ONE event per storming entry, its count rising in place — a
@@ -322,6 +390,7 @@ class CompileWatch:
         }
         return {
             "storm_threshold": self.storm_threshold,
+            "bucket_budgets": dict(self._bucket_budgets),
             "entries": entries,
             "events": list(self.events),
             "records": [r.to_dict() for r in self.records],

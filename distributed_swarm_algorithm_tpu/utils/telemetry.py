@@ -431,6 +431,33 @@ def stack_telemetry(ticks: Iterable[TickTelemetry]) -> TickTelemetry:
     )
 
 
+def tenant_telemetry(t: TickTelemetry, i: int) -> TickTelemetry:
+    """Scenario ``i``'s ``[T]``-leaved record out of a scenario-
+    batched rollout's stacked ys (r13, serve/batched.py: leaves are
+    ``[n_steps, S]`` — tick axis leading, scenario axis trailing).
+    The slice composes with every host-side reducer unchanged:
+    ``TelemetrySummary.from_ticks(tenant_telemetry(t, i))`` is tenant
+    ``i``'s flight-recorder summary, ``telemetry_events`` its event
+    log — the r10 observability surface, per tenant, for free."""
+    return jax.tree_util.tree_map(lambda x: x[:, i], t)
+
+
+def tenant_summaries(t: TickTelemetry) -> List["TelemetrySummary"]:
+    """Every tenant's summary from one batched record (``[T, S]``
+    leaves): index ``j`` is scenario ``j``'s
+    :class:`TelemetrySummary`."""
+    import numpy as np
+
+    host = jax.tree_util.tree_map(_np, t)
+    n_tenants = int(np.asarray(host.tick).shape[1])
+    return [
+        TelemetrySummary.from_ticks(
+            jax.tree_util.tree_map(lambda x: x[:, j], host)
+        )
+        for j in range(n_tenants)
+    ]
+
+
 def concat_telemetry(parts: Iterable[TickTelemetry]) -> TickTelemetry:
     """Concatenate already-stacked ``[T_i]`` records along the tick
     axis (the chunked window-mode rollout produces one part per

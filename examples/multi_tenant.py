@@ -1,0 +1,102 @@
+"""Multi-tenant swarm serving: 100 heterogeneous scenarios, one program.
+
+Each "tenant" asks for its own swarm — its own agent count, arena,
+APF gains, speed limit, and (for some) an injected fault that forces
+a leader election mid-mission.  The rollout service (r13,
+distributed_swarm_algorithm_tpu/serve/) buckets the requests into a
+handful of compiled shapes, runs them as vmapped scenario batches,
+and hands back per-tenant results with per-tenant flight-recorder
+summaries — the r10 observability surface, per tenant, for free.
+
+Run:  python examples/multi_tenant.py
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu import serve
+
+N_TENANTS = 100
+N_STEPS = 80
+
+
+def build_requests():
+    """100 heterogeneous tenants.  Every third one is a RECOVERY
+    scenario: its highest-id agent — the bully protocol's would-be
+    leader — is dead on arrival, so the swarm must elect around the
+    fault (visible as leader churn in the tenant's summary)."""
+    reqs = []
+    for i in range(N_TENANTS):
+        n = 12 + (i * 7) % 53                  # 12..64 agents
+        fault = (i % 3 == 0)
+        reqs.append(serve.ScenarioRequest(
+            n_agents=n,
+            seed=1000 + i,
+            arena_hw=5.0 + (i % 6) * 2.0,      # 5..15 m arenas
+            kill_ids=(n - 1,) if fault else (),
+            params={
+                "k_att": 0.5 + 0.25 * (i % 5),
+                "k_sep": 10.0 + 5.0 * (i % 3),
+                "max_speed": 1.0 + (i % 4),
+            },
+        ))
+    return reqs
+
+
+def main():
+    # Faster elections than the 10 Hz default so an 80-tick rollout
+    # shows the whole detect -> elect -> recover arc per tenant.
+    cfg = dsa.SwarmConfig().replace(
+        formation_shape="none",
+        election_timeout_ticks=10,
+        heartbeat_period_ticks=5,
+    )
+    svc = serve.RolloutService(
+        cfg,
+        spec=serve.BucketSpec(capacities=(32, 64), batches=(8, 32)),
+        n_steps=N_STEPS,
+        telemetry=True,
+    )
+    reqs = build_requests()
+    rids = [svc.submit(r) for r in reqs]
+    svc.flush()
+    print(f"{N_TENANTS} tenants -> {svc.stats['dispatches']} "
+          f"dispatches ({svc.stats['padded_scenarios']} padded "
+          f"filler scenarios), {svc.n_in_flight} in flight")
+
+    results = {rid: svc.collect(rid) for rid in rids}
+
+    print("\nper-tenant recovery summaries (first 10):")
+    print(f"{'tenant':>6} {'agents':>6} {'alive':>5} {'leader':>6} "
+          f"{'churn':>5} {'elect-ticks':>11} {'leaderless':>10}")
+    for rid in rids[:10]:
+        r = results[rid]
+        s = r.summary
+        print(f"{rid:>6} {r.n_agents:>6} {s['alive_final']:>5} "
+              f"{s['leader_final']:>6} {s['leader_changes']:>5} "
+              f"{s['election_ticks']:>11} {s['leaderless_ticks']:>10}")
+
+    # Aggregate serving health: every tenant elected a leader and
+    # every fault-injected tenant recovered around its dead slot.
+    led = sum(
+        1 for r in results.values() if r.summary["leader_final"] >= 0
+    )
+    faulted = [r for i, r in enumerate(results.values()) if i % 3 == 0]
+    recovered = sum(
+        1 for r in faulted
+        if r.summary["leader_final"] >= 0
+        and r.summary["leader_final"] != r.n_agents - 1
+    )
+    print(f"\n{led}/{N_TENANTS} tenants led by rollout end; "
+          f"{recovered}/{len(faulted)} fault-injected tenants "
+          "elected around their dead would-be leader")
+    assert led == N_TENANTS, "some tenant never elected a leader"
+    assert recovered == len(faulted), "a faulted tenant failed recovery"
+    print("multi-tenant serving demo OK")
+
+
+if __name__ == "__main__":
+    main()

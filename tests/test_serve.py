@@ -1,0 +1,456 @@
+"""Multi-tenant rollout service (r13, serve/): batched-vs-solo
+bitwise parity, the bucket padding/eviction contract, double-buffer
+ordering under out-of-order collection, and the per-tenant telemetry
+gate.
+
+The load-bearing contract is BITWISE PARITY: scenario ``i`` of a
+batched dispatch must equal the same materialized scenario run solo
+through ``swarm_rollout`` with its params baked into the (static)
+config — per-scenario scalars enter identical arithmetic whether
+constant-folded or traced, and the vmapped tick preserves row-wise
+reduction order.  Everything the service adds (bucketing, padding,
+fillers, donation, double-buffering) is only trustworthy if it is
+invisible in the numbers.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+import pytest
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu import serve
+from distributed_swarm_algorithm_tpu.serve.batched import (
+    _batched_rollout_impl,
+)
+from distributed_swarm_algorithm_tpu.utils import compile_watch as cw
+from distributed_swarm_algorithm_tpu.utils import telemetry as tl
+
+CFG = dsa.SwarmConfig().replace(
+    formation_shape="none", utility_threshold=2.0
+)
+
+#: Fields that prove the full protocol state matched (positions,
+#: dynamics, FSM, leadership, allocation, liveness, clocks).
+PARITY_FIELDS = (
+    "pos", "vel", "fsm", "leader_id", "task_winner", "task_util",
+    "alive", "tick", "last_hb_tick", "alive_below",
+)
+
+
+def _assert_state_parity(solo, got, label=""):
+    for f in PARITY_FIELDS:
+        a = np.asarray(getattr(solo, f))
+        b = np.asarray(getattr(got, f))
+        assert np.array_equal(a, b), f"{label}: field {f} diverged"
+
+
+def _solo(req, capacity, cfg, n_steps):
+    s, p = serve.materialize_scenario(req, capacity, cfg)
+    return dsa.swarm_rollout(s, None, serve.bake_params(cfg, p),
+                             n_steps)
+
+
+# ------------------------------------------------------------- parity
+
+
+def test_batched_vs_solo_bitwise_parity_two_bucket_shapes():
+    # Two bucket shapes (capacities 32 and 64) and uneven agent
+    # counts (7..64, some padded past half the capacity) in one
+    # service — the acceptance pin.
+    spec = serve.BucketSpec(capacities=(32, 64), batches=(1, 4))
+    svc = serve.RolloutService(CFG, spec=spec, n_steps=25,
+                               telemetry=True)
+    reqs = [
+        serve.ScenarioRequest(n_agents=32, seed=0,
+                              params={"k_att": 1.5}),
+        serve.ScenarioRequest(n_agents=20, seed=1, arena_hw=12.0,
+                              params={"k_sep": 10.0,
+                                      "max_speed": 2.0}),
+        serve.ScenarioRequest(n_agents=64, seed=2,
+                              task_pos=((1.0, 1.0), (-2.0, 3.0))),
+        serve.ScenarioRequest(n_agents=7, seed=3, kill_ids=(6,)),
+        serve.ScenarioRequest(n_agents=40, seed=4,
+                              params={"utility_threshold": 5.0}),
+    ]
+    rids = [svc.submit(r) for r in reqs]
+    results = svc.collect_all()
+    assert sorted(results) == sorted(rids)
+    for rid, req in zip(rids, reqs):
+        capacity = spec.capacity_for(req.n_agents)
+        solo = _solo(req, capacity, CFG, 25)
+        _assert_state_parity(solo, results[rid].state,
+                             f"tenant {rid}")
+        assert results[rid].summary["ticks"] == 25
+
+
+@pytest.mark.slow
+def test_auction_mode_parity_with_dynamic_eps_theta():
+    # Slow-marked: the vmapped auction compiles the full solve into
+    # the scan body (cond lowers to select under vmap), the heaviest
+    # compile in this file; greedy-mode parity with a dynamic
+    # utility_threshold is already pinned in the default set above.
+    # The auction path: per-scenario auction_eps / utility_threshold
+    # ride as traced scalars (r13 made auction_assign's eps dynamic).
+    cfg = CFG.replace(allocation_mode="auction")
+    spec = serve.BucketSpec(capacities=(32,), batches=(4,))
+    svc = serve.RolloutService(cfg, spec=spec, n_steps=40,
+                               telemetry=True)
+    reqs = [
+        serve.ScenarioRequest(
+            n_agents=32, seed=0, task_pos=((1.0, 1.0), (-2.0, 3.0)),
+            params={"auction_eps": 0.5},
+        ),
+        serve.ScenarioRequest(
+            n_agents=24, seed=1, task_pos=((0.0, 4.0), (2.0, -1.0)),
+            params={"auction_eps": 0.1, "utility_threshold": 4.0},
+        ),
+        serve.ScenarioRequest(
+            n_agents=32, seed=2, task_pos=((5.0, 5.0), (-5.0, -5.0)),
+        ),
+    ]
+    rids = [svc.submit(r) for r in reqs]
+    results = svc.collect_all()
+    for rid, req in zip(rids, reqs):
+        solo = _solo(req, 32, cfg, 40)
+        _assert_state_parity(solo, results[rid].state,
+                             f"auction tenant {rid}")
+        # The allocation actually resolved — the parity is not
+        # vacuous.
+        assert (np.asarray(results[rid].state.task_winner) >= 0).all()
+
+
+def test_materialize_scenario_is_batch_row():
+    # The solo reference state IS row i of the batched build — one
+    # constructor, two views.
+    reqs = [
+        serve.ScenarioRequest(n_agents=10, seed=5, arena_hw=3.0),
+        serve.ScenarioRequest(n_agents=16, seed=6,
+                              target=(1.0, -1.0)),
+    ]
+    states, params = serve.materialize_batch(reqs, 16, CFG)
+    for i, req in enumerate(reqs):
+        solo_s, solo_p = serve.materialize_scenario(req, 16, CFG)
+        _assert_state_parity(solo_s, serve.tenant_state(states, i),
+                             f"materialize row {i}")
+        for f in serve.PARAM_FIELDS:
+            assert np.asarray(getattr(solo_p, f)) == np.asarray(
+                getattr(params, f)[i]
+            )
+
+
+# ------------------------------------------- bucket padding / eviction
+
+
+def test_bucket_spec_quantizers():
+    spec = serve.BucketSpec(capacities=(64, 256), batches=(8, 64))
+    assert spec.max_shapes == 4
+    assert spec.capacity_for(1) == 64
+    assert spec.capacity_for(64) == 64
+    assert spec.capacity_for(65) == 256
+    with pytest.raises(ValueError, match="exceeds the largest"):
+        spec.capacity_for(257)
+    with pytest.raises(ValueError, match="n_agents >= 1"):
+        spec.capacity_for(0)
+    assert spec.split_batch(0) == []
+    assert spec.split_batch(5) == [8]            # padded to a rung
+    assert spec.split_batch(8) == [8]
+    assert spec.split_batch(75) == [64, 8, 8]    # 64 + 8 + pad(5)
+    assert spec.split_batch(136) == [64, 64, 8]
+    # Bounded-pad tail: a near-full remainder rounds UP to one padded
+    # dispatch instead of degenerating into per-scenario dispatches
+    # (per-dispatch overhead is the cost this layer amortizes), but
+    # never wastes more than half a dispatch on pad rows (pad rows
+    # still compute).
+    dflt = serve.BucketSpec()                    # (1, 8, 64) batches
+    assert dflt.split_batch(71) == [64, 8]       # not 64 + 1*7
+    assert dflt.split_batch(11) == [8, 1, 1, 1]  # 64 would be 83% pad
+    with pytest.raises(ValueError, match="ascending"):
+        serve.BucketSpec(capacities=(64, 64))
+    with pytest.raises(ValueError, match="positive"):
+        serve.BucketSpec(batches=(0, 8))
+
+
+def test_partial_batches_pad_with_dead_fillers():
+    spec = serve.BucketSpec(capacities=(32,), batches=(8,))
+    svc = serve.RolloutService(CFG, spec=spec, n_steps=5,
+                               telemetry=True)
+    rids = [
+        svc.submit(serve.ScenarioRequest(n_agents=20, seed=i))
+        for i in range(3)
+    ]
+    assert svc.flush() == 1                      # one padded dispatch
+    assert svc.stats["padded_scenarios"] == 5
+    results = {rid: svc.collect(rid) for rid in rids}
+    # Only the real tenants come back, and the fillers did not
+    # perturb them (parity against solo).
+    assert sorted(results) == sorted(rids)
+    for rid in rids:
+        solo = _solo(serve.ScenarioRequest(n_agents=20, seed=rid),
+                     32, CFG, 5)
+        _assert_state_parity(solo, results[rid].state,
+                             f"padded tenant {rid}")
+
+
+def test_collect_evicts_results_and_rejects_unknown_ids():
+    svc = serve.RolloutService(
+        CFG, spec=serve.BucketSpec(capacities=(16,), batches=(1,)),
+        n_steps=3,
+    )
+    rid = svc.submit(serve.ScenarioRequest(n_agents=16, seed=0))
+    svc.flush()
+    svc.collect(rid)
+    with pytest.raises(KeyError):                # evicted on collect
+        svc.collect(rid)
+    with pytest.raises(KeyError):                # never submitted
+        svc.collect(10_000)
+
+
+def test_oversize_request_rejected_at_submit():
+    svc = serve.RolloutService(
+        CFG, spec=serve.BucketSpec(capacities=(16,), batches=(1,)),
+        n_steps=3,
+    )
+    with pytest.raises(ValueError, match="exceeds the largest"):
+        svc.submit(serve.ScenarioRequest(n_agents=17))
+
+
+def test_task_count_is_a_bucket_axis():
+    # Mixed task counts in one capacity must land in separate
+    # dispatches (the task table is a shape), and both still collect.
+    spec = serve.BucketSpec(capacities=(16,), batches=(2,))
+    svc = serve.RolloutService(CFG, spec=spec, n_steps=5)
+    r0 = svc.submit(serve.ScenarioRequest(n_agents=16, seed=0))
+    r1 = svc.submit(serve.ScenarioRequest(
+        n_agents=16, seed=1, task_pos=((1.0, 1.0),),
+    ))
+    assert svc.flush() == 2
+    out = {r: svc.collect(r) for r in (r0, r1)}
+    assert out[r0].state.task_pos.shape == (0, 2)
+    assert out[r1].state.task_pos.shape == (1, 2)
+
+
+# --------------------------------------------- double-buffer ordering
+
+
+def test_out_of_order_collection_across_buckets():
+    # Results key on request id, not completion order: collect the
+    # LAST submitted tenant first, interleave a second flush, then
+    # drain the rest backwards.
+    spec = serve.BucketSpec(capacities=(16, 32), batches=(1, 2))
+    svc = serve.RolloutService(CFG, spec=spec, n_steps=8,
+                               telemetry=True)
+    reqs = [
+        serve.ScenarioRequest(n_agents=16, seed=0),
+        serve.ScenarioRequest(n_agents=32, seed=1),
+        serve.ScenarioRequest(n_agents=9, seed=2),
+    ]
+    rids = [svc.submit(r) for r in reqs]
+    svc.flush()
+    late = serve.ScenarioRequest(n_agents=30, seed=3)
+    late_rid = svc.submit(late)                  # second wave
+    order = [late_rid, rids[2], rids[0], rids[1]]
+    results = {rid: svc.collect(rid) for rid in order}
+    for rid, req in list(zip(rids, reqs)) + [(late_rid, late)]:
+        capacity = spec.capacity_for(req.n_agents)
+        solo = _solo(req, capacity, CFG, 8)
+        _assert_state_parity(solo, results[rid].state,
+                             f"ooo tenant {rid}")
+    assert svc.n_in_flight == 0 and svc.n_pending == 0
+
+
+# ------------------------------------------------- per-tenant telemetry
+
+
+def test_per_tenant_summaries_and_recovery_signal():
+    cfg = CFG.replace(election_timeout_ticks=10,
+                      heartbeat_period_ticks=5)
+    spec = serve.BucketSpec(capacities=(32,), batches=(2,))
+    svc = serve.RolloutService(cfg, spec=spec, n_steps=60,
+                               telemetry=True)
+    quiet = svc.submit(serve.ScenarioRequest(n_agents=32, seed=0))
+    faulted = svc.submit(serve.ScenarioRequest(
+        n_agents=32, seed=1, kill_ids=(31,),
+    ))
+    res = svc.collect_all()
+    q, f = res[quiet].summary, res[faulted].summary
+    assert q["ticks"] == f["ticks"] == 60
+    assert q["alive_final"] == 32 and f["alive_final"] == 31
+    # Both elected; the faulted tenant elected AROUND its dead
+    # would-be leader (the bully protocol's highest id).
+    assert q["leader_final"] == 31
+    assert f["leader_final"] == 30
+    assert f["leader_changes"] >= 1
+
+
+def test_tenant_telemetry_helpers_roundtrip():
+    spec = serve.BucketSpec(capacities=(16,), batches=(4,))
+    reqs = [
+        serve.ScenarioRequest(n_agents=16 - 2 * i, seed=i)
+        for i in range(4)
+    ]
+    states, params = serve.materialize_batch(reqs, 16, CFG)
+    _, telem = serve.batched_rollout(states, params, CFG, 12,
+                                     telemetry=True)
+    summaries = tl.tenant_summaries(telem)
+    assert len(summaries) == 4
+    for i, s in enumerate(summaries):
+        assert s.ticks == 12
+        assert s.alive_final == 16 - 2 * i
+        # The slice view agrees with the list view.
+        assert tl.TelemetrySummary.from_ticks(
+            tl.tenant_telemetry(telem, i)
+        ) == s
+
+
+def test_disabled_telemetry_lowering_is_byte_identical():
+    # The r10 static-gate contract on the batched entry: the
+    # telemetry=False lowering is the flag-free program, byte for
+    # byte; enabling changes it.
+    req = serve.ScenarioRequest(n_agents=8, seed=0)
+    states, params = serve.materialize_batch([req], 8, CFG)
+    low_off = _batched_rollout_impl.lower(
+        states, params, CFG, 6, telemetry=False
+    ).as_text()
+    low_default = _batched_rollout_impl.lower(
+        states, params, CFG, 6
+    ).as_text()
+    low_on = _batched_rollout_impl.lower(
+        states, params, CFG, 6, telemetry=True
+    ).as_text()
+    assert low_off == low_default
+    assert low_off != low_on
+
+
+# -------------------------------------------------- records / validation
+
+
+def test_recorded_trajectory_trims_to_real_agents():
+    spec = serve.BucketSpec(capacities=(16,), batches=(1,))
+    svc = serve.RolloutService(CFG, spec=spec, n_steps=7,
+                               record=True, telemetry=False)
+    rid = svc.submit(serve.ScenarioRequest(n_agents=11, seed=0))
+    res = svc.collect_all()[rid]
+    assert res.traj.shape == (7, 11, 2)
+    # The final frame matches the final state's live rows.
+    assert np.array_equal(
+        res.traj[-1], np.asarray(res.state.pos)[:11]
+    )
+
+
+def test_serve_config_envelope_rejected_eagerly():
+    with pytest.raises(ValueError, match="separation_mode"):
+        serve.RolloutService(
+            CFG.replace(separation_mode="hashgrid", world_hw=32.0)
+        )
+    with pytest.raises(ValueError, match="arena_hw"):
+        serve.materialize_batch(
+            [serve.ScenarioRequest(n_agents=4, arena_hw=0.0)], 8, CFG
+        )
+    with pytest.raises(ValueError, match="unknown scenario param"):
+        serve.materialize_batch(
+            [serve.ScenarioRequest(n_agents=4,
+                                   params={"dt": 0.5})], 8, CFG
+        )
+    # Fault injection must name real agents: out-of-range ids would
+    # silently inject nothing, negatives would wrap to other slots.
+    with pytest.raises(ValueError, match="kill_ids"):
+        serve.materialize_batch(
+            [serve.ScenarioRequest(n_agents=4, kill_ids=(4,))], 8,
+            CFG,
+        )
+    with pytest.raises(ValueError, match="kill_ids"):
+        serve.materialize_batch(
+            [serve.ScenarioRequest(n_agents=4, kill_ids=(-1,))], 8,
+            CFG,
+        )
+
+
+def test_bad_request_rejected_at_submit_not_flush():
+    # A malformed request must fail at ITS OWN submit — a flush-time
+    # failure would drop the co-batched good requests.
+    svc = serve.RolloutService(
+        CFG, spec=serve.BucketSpec(capacities=(16,), batches=(2,)),
+        n_steps=3,
+    )
+    good = svc.submit(serve.ScenarioRequest(n_agents=16, seed=0))
+    for bad in (
+        serve.ScenarioRequest(n_agents=8, params={"typo": 1.0}),
+        serve.ScenarioRequest(n_agents=8, arena_hw=0.0),
+        serve.ScenarioRequest(n_agents=8, kill_ids=(8,)),
+    ):
+        with pytest.raises(ValueError):
+            svc.submit(bad)
+    res = svc.collect(good)                   # the good tenant lives
+    assert res.n_agents == 16
+
+
+def test_telemetry_config_gate_and_flag_agree():
+    # A config with the telemetry gate pre-enabled plus
+    # telemetry=False at the service must still unpack the (states,
+    # telem) return correctly — the effective flag is the
+    # disjunction.
+    from distributed_swarm_algorithm_tpu.utils.config import (
+        TELEMETRY_ON,
+    )
+
+    svc = serve.RolloutService(
+        CFG.replace(telemetry=TELEMETRY_ON),
+        spec=serve.BucketSpec(capacities=(8,), batches=(1,)),
+        n_steps=4, telemetry=False,
+    )
+    rid = svc.submit(serve.ScenarioRequest(n_agents=8, seed=0))
+    res = svc.collect(rid)
+    assert res.summary is not None and res.summary["ticks"] == 4
+
+
+# ------------------------------------------------------ compile budget
+
+
+def test_compile_budget_within_lattice_and_overflow_event():
+    watch = cw.WATCH
+    was_enabled = watch.enabled
+    watch.reset()
+    watch.enable()
+    try:
+        spec = serve.BucketSpec(capacities=(8, 16), batches=(1, 2))
+        svc = serve.RolloutService(CFG, spec=spec, n_steps=4,
+                                   telemetry=False)
+        for n, seed in ((8, 0), (16, 1), (12, 2), (5, 3), (9, 4)):
+            svc.submit(serve.ScenarioRequest(n_agents=n, seed=seed))
+        svc.collect_all()
+        entries = svc.compile_entries()
+        assert 0 < entries <= spec.max_shapes
+        assert watch.within_bucket_budget(serve.SERVE_ENTRY)
+        # Declarations are the MAX over live services (the registry
+        # is process-global; earlier tests' services declared too).
+        assert watch.bucket_budget(serve.SERVE_ENTRY) >= spec.max_shapes
+        assert not [
+            e for e in watch.events
+            if e["event"] == "bucket-overflow"
+        ]
+        # Now blow the budget deliberately: a shape OUTSIDE the
+        # lattice (a distinct static n_steps) must fire exactly one
+        # bucket-overflow event and a warning.
+        watch.declare_buckets(serve.SERVE_ENTRY, entries)
+        req = serve.ScenarioRequest(n_agents=8, seed=9)
+        states, params = serve.materialize_batch([req], 8, CFG)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            serve.batched_rollout(states, params, CFG, 5)
+        overflow = [
+            e for e in watch.events
+            if e["event"] == "bucket-overflow"
+            and e["entry"] == serve.SERVE_ENTRY
+        ]
+        assert len(overflow) == 1
+        assert overflow[0]["compiles"] > overflow[0]["budget"]
+        assert any(
+            isinstance(w.message, cw.RetraceStormWarning)
+            for w in caught
+        )
+    finally:
+        watch.reset()
+        watch.enabled = was_enabled

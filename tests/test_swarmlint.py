@@ -226,6 +226,18 @@ SEEDED = {
             return body(pos)
         """,
     ),
+    "key-broadcast": (
+        "pkg/broadcast.py",
+        """
+        import jax
+
+        def rollout(states, key):
+            def tick(s, k):
+                return s + jax.random.normal(k, (4,))
+
+            return jax.vmap(tick, in_axes=(0, None))(states, key)
+        """,
+    ),
 }
 
 
@@ -465,6 +477,38 @@ def test_each_rule_fires_exactly_once_on_seeded_tree(tmp_path):
                     return p + plan.cell_eff + halo
 
                 return body(pos)
+            """,
+        ),
+        # Per-member keys mapped with axis 0: the sanctioned
+        # scenario-batching idiom (serve/batched.py) — no broadcast.
+        (
+            "vmap_split_keys",
+            """
+            import jax
+
+            def rollout(states, key):
+                keys = jax.random.split(key, states.shape[0])
+
+                def tick(s, k):
+                    return s + jax.random.normal(k, (4,))
+
+                return jax.vmap(tick, in_axes=(0, 0))(states, keys)
+            """,
+        ),
+        # A broadcast NON-key operand (static config) is fine; so is
+        # the default in_axes (everything mapped).
+        (
+            "vmap_broadcast_cfg",
+            """
+            import jax
+
+            def rollout(states, cfg, keys):
+                def tick(s, c, k):
+                    return s * c + jax.random.normal(k, (4,))
+
+                return jax.vmap(tick, in_axes=(0, None, 0))(
+                    states, cfg, keys
+                )
             """,
         ),
     ],

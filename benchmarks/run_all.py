@@ -105,6 +105,13 @@ BENCHES = [
     # "compiles") gated against the bucket lattice; self-gates the
     # >= 5x speedup bar and the bucket budget (exit 2).
     "bench_multitenant.py",
+    # r14: the MARL env facade — 4 heterogeneous zoo scenarios x 256
+    # agents stepped as ONE compiled env-rollout program (random
+    # policy), plus the auto-reset select's structural overhead vs
+    # the auto_reset=False twin (unit "overhead-pct", lower-is-better
+    # growth gate); self-gates the env-rollout compile budget and a
+    # 200% overhead sanity ceiling (exit 2).
+    "bench_env.py",
 ]
 
 # Extra argv for benches whose no-arg default is not the gate set —
@@ -149,6 +156,11 @@ QUICK_SKIP = {
     "bench_multichip_telemetry.py",
     "bench_multichip_tick.py",
     "bench_multitenant.py",
+    # r14: two compiles of the 4-scenario x 256 vmapped env-rollout
+    # program + best-of-5 timing of both auto-reset twins — minutes
+    # on the 2-core rig, full gate only (the bench_multitenant
+    # precedent).
+    "bench_env.py",
 }
 
 

@@ -10,8 +10,9 @@ It parses (never imports) every .py file under the default scan set
 classes that have actually bitten this repo on TPU: PRNG key reuse,
 host syncs and Python branches inside traced code, per-call re-jit,
 per-iteration spatial-index rebuilds, ungated flight-recorder
-collection in scan bodies, dtype drift in ops/ hot paths, the
-fused-kernel dispatch contract, and bench metric-name hygiene.  See
+collection in scan bodies, host branches on traced done flags in env
+rollouts, dtype drift in ops/ hot paths, the fused-kernel dispatch
+contract, and bench metric-name hygiene.  See
 docs/STATIC_ANALYSIS.md for the rule catalog, the suppression
 policy, and how to add a rule.
 

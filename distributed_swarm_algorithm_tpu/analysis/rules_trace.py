@@ -36,6 +36,11 @@
   payloads sized for ``personal_space + skin``,
   parallel/spatial.py) the "exact" sharded tick is quietly wrong at
   every tile seam.
+- ``done-branch``: a host ``if``/``while`` on a traced done/
+  terminated flag inside an env-rollout scan body — the classic
+  auto-reset hazard (ConcretizationError on-chip, or a per-boolean
+  retrace); the sanctioned pattern is the ``jnp.where``-select
+  auto-reset (envs/core.py).
 """
 
 from __future__ import annotations
@@ -428,6 +433,102 @@ class TelemetryGateRule(Rule):
                         "wrap it in `if telemetry:` / `if "
                         "cfg.telemetry.enabled:` so the disabled "
                         "rollout keeps its telemetry-free HLO",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# done-branch
+
+#: Names that read as episode-termination flags.  Exact matches plus
+#: the common suffix forms (``ep_done``, ``all_dones``); chosen
+#: narrow — a generic "flag word" list would flag host drivers.
+_DONE_EXACT = frozenset(
+    {"done", "dones", "terminated", "terminateds", "truncated",
+     "truncateds", "terminal", "terminals"}
+)
+_DONE_SUFFIXES = (
+    "_done", "_dones", "_terminated", "_truncated", "_terminal",
+)
+
+
+def _is_done_name(name: str) -> bool:
+    low = name.lower()
+    return low in _DONE_EXACT or low.endswith(_DONE_SUFFIXES)
+
+
+@register
+class DoneBranchRule(Rule):
+    id = "done-branch"
+    summary = "host if/while on a traced done flag inside a rollout body"
+    details = (
+        "A Python `if`/`while` on a done/terminated flag inside a "
+        "lax.scan/fori_loop/while_loop body is the classic auto-reset "
+        "hazard: the flag is a tracer there, so the branch either "
+        "raises ConcretizationError at trace time or — when the body "
+        "is traced per call — silently retraces per boolean value.  "
+        "Auto-reset must be the `jnp.where`-select pattern "
+        "(envs/core.py: compute the reset state unconditionally and "
+        "select it in), which keeps the whole rollout ONE compiled "
+        "program (docs/ENVIRONMENTS.md)."
+    )
+
+    def check(self, mod: ModuleInfo):
+        by_name: dict = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                by_name.setdefault(node.name, []).append(node)
+        bodies: set = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if mod.resolve(node.func) not in _LOOP_CALLS:
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Lambda):
+                    bodies.add(arg)
+                elif isinstance(arg, ast.Name):
+                    bodies.update(by_name.get(arg.id, []))
+        seen: set = set()
+        for fn in bodies:
+            stmts = fn.body if isinstance(fn.body, list) else [fn.body]
+            for st in stmts:
+                for node in ast.walk(st):
+                    if not isinstance(node, (ast.If, ast.While)):
+                        continue
+                    # The branch must belong to the loop body ITSELF:
+                    # the nearest enclosing function of the If/While
+                    # (ancestors yield nearest-first) must be `fn` —
+                    # nested defs are their own scope.
+                    nested = False
+                    for a in mod.ancestors(node):
+                        if a is fn:
+                            break
+                        if isinstance(
+                            a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)
+                        ):
+                            nested = True
+                            break
+                    if nested:
+                        continue
+                    hot = {
+                        n for n in _hazard_names(node.test)
+                        if _is_done_name(n)
+                    }
+                    if not hot:
+                        continue
+                    site = (node.lineno, node.col_offset)
+                    if site in seen:
+                        continue
+                    seen.add(site)
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    yield mod.finding(
+                        self.id, node,
+                        f"Python `{kind}` on traced done flag(s) "
+                        f"{sorted(hot)} inside a loop-transform body "
+                        "— auto-reset must be a `jnp.where` select "
+                        "(compute the reset branch unconditionally, "
+                        "select on the traced flag)",
                     )
 
 

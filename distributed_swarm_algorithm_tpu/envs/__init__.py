@@ -1,0 +1,56 @@
+"""Swarm-as-environment (r14): a JaxMARL-compatible RL facade over the
+protocol tick.  See envs/core.py (``SwarmMARLEnv`` — pure
+``reset``/``step``, fixed-shape per-agent obs, bounded steering
+actions, ``where``-select auto-reset, the watched ``env-rollout``
+compiled entry) and envs/scenarios.py (the scenario zoo: each scenario
+is a params pytree + a reward id, never a fork of the tick)."""
+
+from .core import (
+    ENV_ROLLOUT_ENTRY,
+    EnvParams,
+    EnvState,
+    SwarmMARLEnv,
+    env_params_row,
+    env_rollout,
+    make_env_params,
+    stack_env_params,
+)
+from .scenarios import (
+    COVERAGE,
+    OBSTACLE,
+    PURSUIT,
+    REWARD_NAMES,
+    STATION,
+    ZOO,
+    coverage_foraging,
+    filler_params,
+    obstacle_field,
+    pursuit_evasion,
+    reward_switch,
+    station_keeping,
+    zoo_batch,
+)
+
+__all__ = [
+    "COVERAGE",
+    "ENV_ROLLOUT_ENTRY",
+    "EnvParams",
+    "EnvState",
+    "OBSTACLE",
+    "PURSUIT",
+    "REWARD_NAMES",
+    "STATION",
+    "SwarmMARLEnv",
+    "ZOO",
+    "coverage_foraging",
+    "env_params_row",
+    "env_rollout",
+    "filler_params",
+    "make_env_params",
+    "obstacle_field",
+    "pursuit_evasion",
+    "reward_switch",
+    "stack_env_params",
+    "station_keeping",
+    "zoo_batch",
+]

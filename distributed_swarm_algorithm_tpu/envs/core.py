@@ -1,0 +1,661 @@
+"""SwarmMARLEnv — the protocol swarm as a JaxMARL-style MARL environment.
+
+The tick (election + allocation + formation + APF physics) is pure
+fixed-shape dataflow, which is exactly the contract a JAX-native
+multi-agent RL environment needs (JaxMARL, arxiv 2311.10090): a pure
+``reset(key, params) -> (obs, state)`` / ``step(key, state, actions)
+-> (obs, state, rewards, dones, info)`` pair that composes with
+``jit``/``vmap``/``lax.scan`` end to end.  This module wraps
+``models/swarm.swarm_tick_dyn`` (the r13 scenario-batching substrate)
+in that API:
+
+- **Actions** are a bounded per-agent steering force ``[N, 2]``
+  injected between the APF term and ``integrate``
+  (``_physics_step_core(extra_force=...)``).  The injection is a
+  sign-of-zero-safe select, so an all-zero action reproduces the pure
+  protocol trajectory BITWISE — the env's ground truth is the swarm
+  everyone else ships, pinned in tests/test_envs.py against
+  ``swarm_rollout``.
+- **Observations** are fixed-shape per-agent rows: own pose/velocity/
+  liveness, the leader-relative block (leader offset + formation slot
+  error via ``formation_targets``), a K-nearest-neighbor block read
+  off the existing :class:`~..ops.hashgrid_plan.HashgridPlan`
+  (candidate rows from the stencil-union table, true-distance
+  ``top_k``), and a task-board slice (per-task offset + open/mine
+  flags).  Collection is read-only — it cannot perturb the
+  trajectory.
+- **Auto-reset** is the standard ``jnp.where`` select (never a host
+  branch on the traced ``done`` — swarmlint's ``done-branch`` rule
+  exists because that is the classic retrace/ConcretizationError
+  hazard): when an episode hits ``params.max_steps`` the freshly
+  materialized state is selected in, so a full rollout is ONE
+  compiled ``lax.scan``.
+- **Scenarios are data** (envs/scenarios.py): a scenario is an
+  :class:`EnvParams` pytree — :class:`~..serve.batched.ScenarioParams`
+  gains + a reward id + spawn/team/task/obstacle tables — never a
+  fork of the tick, so heterogeneous scenarios vmap into one compiled
+  program and ride the serve layer's bucket lattice
+  (``serve/batched.env_rollouts``).
+
+The compiled entry is registered with the compile observatory as
+``"env-rollout"``; per-tick :class:`~..utils.telemetry.TickTelemetry`
+threads through ``step`` behind the same static gate as every other
+rollout (disabled lowering is byte-identical — pinned).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..models.swarm import swarm_tick_dyn
+from ..ops.hashgrid_plan import build_hashgrid_plan
+from ..ops.physics import formation_targets
+from ..serve.batched import (
+    ScenarioParams,
+    scenario_params,
+    validate_serve_config,
+)
+from ..state import (
+    FOLLOWER,
+    NO_CAP,
+    NO_LEADER,
+    NO_WINNER,
+    SwarmState,
+    recount_alive_below,
+)
+from ..utils.compile_watch import watched
+from ..utils.config import TELEMETRY_ON, SwarmConfig
+
+#: Compile-observatory registry name of the env rollout entry.
+ENV_ROLLOUT_ENTRY = "env-rollout"
+
+#: Where inactive obstacle rows are parked: far enough that the
+#: repulsion term is exactly zero for any in-arena agent (surface
+#: distance >> rho0), so a scenario with fewer obstacles than the
+#: env's static table costs nothing but padding.
+_REMOTE = 1.0e6
+
+
+@struct.dataclass
+class EnvParams:
+    """One scenario as TRACED data — every leaf stacks along a leading
+    scenario axis, so heterogeneous scenarios run in one compiled
+    program (the r13 discipline, extended to the RL surface).
+
+    ``scenario`` carries the protocol gains
+    (:class:`~..serve.batched.ScenarioParams`); ``reward_id`` selects
+    the reward function from envs/scenarios.py via ``lax.switch``;
+    ``alive0``/``team`` are the population register (pad slots dead;
+    team 1 = evaders in the pursuit scenario, killed via the alive
+    mask when tagged); ``max_steps`` is the auto-reset episode
+    boundary; ``tag_radius <= 0`` disables tagging entirely (the
+    non-pursuit scenarios select the untouched state bitwise)."""
+
+    scenario: ScenarioParams   # protocol gains, each an f32 scalar
+    reward_id: jax.Array       # i32 — envs/scenarios.py registry index
+    spread: jax.Array          # f32 — spawn arena half-width
+    use_point: jax.Array       # bool — shared nav goal vs station-keep
+    point: jax.Array           # [2] f32 — the shared goal (if use_point)
+    alive0: jax.Array          # [capacity] bool — initial population
+    team: jax.Array            # [capacity] i32 — 0 pursuer/default, 1 evader
+    task_pos: jax.Array        # [n_tasks, 2] f32 — task board
+    obstacles: jax.Array       # [n_obstacles, 3] f32 (cx, cy, radius)
+    max_steps: jax.Array       # i32 — episode length (auto-reset)
+    tag_radius: jax.Array      # f32 — pursuit tag distance (<= 0: off)
+
+
+@struct.dataclass
+class EnvState:
+    """The env's scan carry: the live protocol state, the episode
+    clock, and the scenario's own params (carried so ``step`` needs no
+    params argument and ``vmap`` over states covers the scenario axis
+    in one in_axes)."""
+
+    swarm: SwarmState
+    t: jax.Array               # i32 — steps into the current episode
+    params: EnvParams
+
+
+def stack_env_params(params: Sequence[EnvParams]) -> EnvParams:
+    """Stack single scenarios into the ``[S]``-leaved batch pytree."""
+    params = list(params)
+    if not params:
+        raise ValueError("stack_env_params needs at least one scenario")
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params)
+
+
+def env_params_row(params: EnvParams, i: int) -> EnvParams:
+    """Scenario ``i`` out of a stacked batch."""
+    return jax.tree_util.tree_map(lambda x: x[i], params)
+
+
+@dataclasses.dataclass(frozen=True)
+class SwarmMARLEnv:
+    """The swarm as a multi-agent RL environment — STATIC structure
+    only (frozen + hashable, so the env rides as a jit-static
+    argument; everything per-scenario lives in :class:`EnvParams`).
+
+    ``cfg`` must sit inside the scenario-batching envelope
+    (``separation_mode`` in ``{dense, off}`` — the serve contract;
+    the obs spatial index is the env's own and does not constrain the
+    tick).  ``capacity``/``n_tasks``/``n_obstacles`` are the shape
+    axes every scenario of this env shares (a scenario with fewer
+    agents rides the alive mask, fewer obstacles the remote-row
+    padding).  The obs KNN block reads a per-step
+    :class:`~..ops.hashgrid_plan.HashgridPlan` over the
+    ``[-obs_hw, obs_hw)^2`` box: neighbors are exact within one obs
+    cell (``2 * obs_hw / g``); agents outside the box clip into edge
+    cells and degrade gracefully (candidates distance-ranked, never
+    wrong, possibly missing).  ``act_limit`` bounds the steering
+    force per agent (L2)."""
+
+    cfg: SwarmConfig
+    capacity: int
+    n_tasks: int = 0
+    n_obstacles: int = 0
+    k_neighbors: int = 4
+    obs_hw: float = 16.0
+    obs_cell: float = 4.0
+    obs_max_per_cell: int = 8
+    obs_neighbor_cap: int = 32
+    act_limit: float = 1.0
+    enable_tagging: bool = True
+
+    def __post_init__(self):
+        validate_serve_config(self.cfg)
+        if self.cfg.dtype != "float32":
+            raise ValueError(
+                f"SwarmMARLEnv materializes float32 swarms; got "
+                f"cfg.dtype={self.cfg.dtype!r}"
+            )
+        if self.capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {self.capacity}")
+        if not self.obs_hw > 0 or not self.obs_cell > 0:
+            raise ValueError(
+                "obs_hw and obs_cell must be > 0 (the obs KNN grid "
+                f"tiles [-obs_hw, obs_hw)^2); got {self.obs_hw}, "
+                f"{self.obs_cell}"
+            )
+        if not 1 <= self.k_neighbors <= self.obs_neighbor_cap:
+            raise ValueError(
+                f"k_neighbors {self.k_neighbors} outside [1, "
+                f"obs_neighbor_cap={self.obs_neighbor_cap}] — the KNN "
+                "block ranks candidates from the plan's stencil-union "
+                "rows, so K cannot exceed the row width"
+            )
+        if not self.act_limit > 0:
+            raise ValueError(
+                f"act_limit must be > 0, got {self.act_limit} (the "
+                "steering bound; actions are norm-clamped to it)"
+            )
+
+    # -- observation layout -------------------------------------------------
+    def obs_layout(self):
+        """[(block, width), ...] — the documented per-agent row
+        layout, in order (docs/ENVIRONMENTS.md)."""
+        return [
+            ("own: pos, vel, alive", 5),
+            ("leader: offset, has_leader, slot_err", 5),
+            ("neighbors: K x (rel_pos, rel_vel, valid)",
+             5 * self.k_neighbors),
+            ("tasks: T x (rel_pos, open, mine)", 4 * self.n_tasks),
+        ]
+
+    @property
+    def obs_dim(self) -> int:
+        return sum(w for _, w in self.obs_layout())
+
+    @property
+    def action_dim(self) -> int:
+        return 2
+
+    # -- constructors -------------------------------------------------------
+    def materialize(self, key: jax.Array, p: EnvParams) -> SwarmState:
+        """The scenario's initial :class:`SwarmState` from traced data
+        — the same construction as the serve layer's vmapped
+        materializer (``serve/batched._materialize_batch_impl``), so
+        ``reset(jax.random.PRNGKey(seed), params)`` reproduces
+        ``serve.materialize_scenario`` of the matching request
+        bitwise, and the auto-reset branch can re-materialize inside
+        the compiled rollout."""
+        capacity = self.capacity
+        key, sub = jax.random.split(key)
+        pos = jax.random.uniform(
+            sub, (capacity, 2), jnp.float32,
+            minval=-p.spread, maxval=p.spread,
+        )
+        aint = p.alive0.astype(jnp.int32)
+        alive_below = jnp.cumsum(aint) - aint
+        target = jnp.where(
+            p.use_point, jnp.broadcast_to(p.point, pos.shape), pos
+        )
+        return SwarmState(
+            tick=jnp.asarray(0, jnp.int32),
+            key=key,
+            agent_id=jnp.arange(capacity, dtype=jnp.int32),
+            alive=p.alive0,
+            pos=pos,
+            vel=jnp.zeros((capacity, 2), jnp.float32),
+            caps=jnp.zeros((capacity, 1), bool),
+            target=target,
+            has_target=jnp.ones((capacity,), bool),
+            fsm=jnp.full((capacity,), FOLLOWER, jnp.int32),
+            leader_id=jnp.full((capacity,), NO_LEADER, jnp.int32),
+            leader_pos=jnp.zeros((capacity, 2), jnp.float32),
+            has_leader_pos=jnp.zeros((capacity,), bool),
+            last_hb_tick=jnp.zeros((capacity,), jnp.int32),
+            wait_until=jnp.zeros((capacity,), jnp.int32),
+            alive_below=alive_below,
+            leader_live=jnp.ones((capacity,), bool),
+            task_pos=p.task_pos,
+            task_cap=jnp.full((self.n_tasks,), NO_CAP, jnp.int32),
+            task_winner=jnp.full((self.n_tasks,), NO_WINNER, jnp.int32),
+            task_util=jnp.zeros((self.n_tasks,), jnp.float32),
+            task_claimed=jnp.zeros((capacity, self.n_tasks), bool),
+        )
+
+    # -- observation --------------------------------------------------------
+    def obs(self, state: SwarmState) -> jax.Array:
+        """[capacity, obs_dim] per-agent observation rows (dead agents
+        read all-zero).  Read-only off the current state — collection
+        cannot perturb the trajectory."""
+        with jax.named_scope("env_obs"):
+            return self._obs_impl(state)
+
+    def _obs_impl(self, state: SwarmState) -> jax.Array:
+        n = self.capacity
+        pos, vel, alive = state.pos, state.vel, state.alive
+        falive = alive.astype(jnp.float32)
+
+        own = jnp.concatenate([pos, vel, falive[:, None]], axis=-1)
+
+        # Leader block: offset to the last-heard leader pose and the
+        # formation slot error (the derived target the APF attraction
+        # actually steers toward this tick).
+        derived = formation_targets(state, self.cfg)
+        has_lead = state.has_leader_pos & alive
+        lead_rel = jnp.where(
+            has_lead[:, None], state.leader_pos - pos, 0.0
+        )
+        slot_err = jnp.where(
+            (derived.has_target & alive)[:, None],
+            derived.target - pos, 0.0,
+        )
+        leader = jnp.concatenate(
+            [lead_rel, has_lead.astype(jnp.float32)[:, None], slot_err],
+            axis=-1,
+        )
+
+        # KNN block off the shared spatial index: one plan build, one
+        # [N, W] candidate gather (the r9 stencil-union table), exact
+        # top-K by true distance within one obs cell of coverage.
+        plan = build_hashgrid_plan(
+            pos, alive, float(self.obs_hw), float(self.obs_cell),
+            self.obs_max_per_cell, need_csr=True,
+            neighbor_cap=self.obs_neighbor_cap,
+        )
+        g2 = plan.g * plan.g
+        cell = jnp.minimum(plan.key, g2 - 1)   # dead agents clip; masked out
+        cand = plan.cand[cell]                                # [N, W]
+        idx = jnp.minimum(cand, n - 1)
+        iota = jnp.arange(n, dtype=jnp.int32)
+        valid = (
+            (cand < n)
+            & (idx != iota[:, None])
+            & alive[idx]
+            & alive[:, None]
+        )
+        rel = pos[idx] - pos[:, None, :]                      # [N, W, 2]
+        d2 = jnp.sum(rel * rel, axis=-1)
+        score = jnp.where(valid, -d2, -jnp.inf)
+        _, top = jax.lax.top_k(score, self.k_neighbors)       # [N, K]
+        sel = jnp.take_along_axis(idx, top, axis=1)
+        sel_ok = jnp.take_along_axis(valid, top, axis=1)
+        nrel = jnp.where(
+            sel_ok[..., None],
+            jnp.take_along_axis(rel, top[..., None], axis=1), 0.0,
+        )
+        nrelv = jnp.where(
+            sel_ok[..., None], vel[sel] - vel[:, None, :], 0.0
+        )
+        nbr = jnp.concatenate(
+            [nrel, nrelv, sel_ok.astype(jnp.float32)[..., None]],
+            axis=-1,
+        ).reshape(n, 5 * self.k_neighbors)
+
+        blocks = [own, leader, nbr]
+
+        if self.n_tasks:
+            trel = state.task_pos[None, :, :] - pos[:, None, :]
+            open_ = (state.task_winner == NO_WINNER).astype(jnp.float32)
+            mine = (
+                (state.task_winner[None, :] == state.agent_id[:, None])
+                & (state.task_winner != NO_WINNER)[None, :]
+            ).astype(jnp.float32)
+            tb = jnp.concatenate(
+                [
+                    trel,
+                    jnp.broadcast_to(
+                        open_[None, :], mine.shape
+                    )[..., None],
+                    mine[..., None],
+                ],
+                axis=-1,
+            ).reshape(n, 4 * self.n_tasks)
+            blocks.append(tb)
+
+        out = jnp.concatenate(blocks, axis=-1)
+        return jnp.where(alive[:, None], out, 0.0)
+
+    # -- the env API --------------------------------------------------------
+    def reset(
+        self, key: jax.Array, params: EnvParams
+    ) -> Tuple[jax.Array, EnvState]:
+        """(obs, state): materialize the scenario and observe it."""
+        swarm = self.materialize(key, params)
+        state = EnvState(
+            swarm=swarm, t=jnp.asarray(0, jnp.int32), params=params
+        )
+        return self.obs(swarm), state
+
+    def step(
+        self,
+        key: jax.Array,
+        state: EnvState,
+        actions: jax.Array,
+        auto_reset: bool = True,
+    ):
+        """(obs, state, rewards, dones, info): one protocol tick under
+        the per-agent steering ``actions`` ([capacity, 2], L2-clamped
+        to ``act_limit``), then reward, termination, and the
+        ``where``-select auto-reset.
+
+        ``rewards``/``dones`` are per-agent ``[capacity]`` (dead and
+        pad slots reward 0 and read done); ``info["done"]`` is the
+        episode-boundary scalar, and ``info["telemetry"]`` the tick's
+        flight-recorder record when the static gate is on.  With
+        ``auto_reset=False`` (static) the episode boundary only
+        reports — the state keeps stepping (the bench's overhead
+        twin)."""
+        p = state.params
+        prev = state.swarm
+
+        a = jnp.asarray(actions, jnp.float32)
+        norm = jnp.linalg.norm(a, axis=-1, keepdims=True)
+        lim = jnp.asarray(self.act_limit, jnp.float32)
+        a = a * jnp.minimum(1.0, lim / jnp.maximum(norm, 1e-9))
+
+        obstacles = p.obstacles if self.n_obstacles else None
+        swarm, telem = swarm_tick_dyn(
+            prev, obstacles, self.cfg, params=p.scenario,
+            extra_force=a,
+        )
+        if self.enable_tagging:
+            swarm = _pursuit_tag(swarm, p)
+
+        from .scenarios import reward_switch
+
+        rewards = reward_switch(prev, swarm, p, self.cfg)
+
+        t_next = state.t + 1
+        done = t_next >= p.max_steps
+        dones = done | ~swarm.alive
+        if auto_reset:
+            key, rkey = jax.random.split(key)
+            fresh = self.materialize(rkey, p)
+            swarm = jax.tree_util.tree_map(
+                lambda r, s: jnp.where(done, r, s), fresh, swarm
+            )
+            t_next = jnp.where(done, 0, t_next)
+        new_state = EnvState(swarm=swarm, t=t_next, params=p)
+        info = {"done": done}
+        if self.cfg.telemetry.enabled:
+            info["telemetry"] = telem
+        return self.obs(swarm), new_state, rewards, dones, info
+
+    def replace(self, **kw) -> "SwarmMARLEnv":
+        return dataclasses.replace(self, **kw)
+
+
+def _pursuit_tag(swarm: SwarmState, p: EnvParams) -> SwarmState:
+    """Post-tick tagging for the two-population scenarios: an alive
+    evader (team 1) within ``tag_radius`` of any alive pursuer
+    (team 0) is killed — the team id rides the alive mask, so the
+    protocol's recovery machinery (dead-winner eviction, re-election
+    around a tagged leader) reacts with no tick fork.  Mirrors
+    ``ops/coordination.kill`` semantics (believers see the liveness
+    flip; the ``alive_below`` cache is recounted).
+
+    Data-gated on ``tag_radius > 0``: non-pursuit scenarios select
+    the untouched masks bitwise, so the zero-action parity contract
+    survives the shared heterogeneous program."""
+    tag_on = p.tag_radius > 0.0
+    pos, alive = swarm.pos, swarm.alive
+    n = pos.shape[0]
+    pursuer = alive & (p.team == 0)
+    evader = alive & (p.team == 1)
+    delta = pos[:, None, :] - pos[None, :, :]
+    d2 = jnp.sum(delta * delta, axis=-1)
+    close = d2 <= p.tag_radius * p.tag_radius
+    tagged = evader & jnp.any(close & pursuer[None, :], axis=1)
+    kill_mask = jnp.where(tag_on, tagged, False)
+
+    # Believers in a tagged leader see the liveness flip immediately
+    # (the kill() cache contract, by id value).
+    dead_by_id = (
+        jnp.zeros((n,), bool).at[swarm.agent_id].set(kill_mask)
+    )
+    lid_valid = (swarm.leader_id >= 0) & (swarm.leader_id < n)
+    believed = lid_valid & dead_by_id[jnp.clip(swarm.leader_id, 0, n - 1)]
+    return recount_alive_below(
+        swarm.replace(
+            alive=alive & ~kill_mask,
+            leader_live=swarm.leader_live & ~believed,
+        )
+    )
+
+
+def make_env_params(
+    env: SwarmMARLEnv,
+    reward_id: int,
+    n_agents: Optional[int] = None,
+    spread: float = 6.0,
+    target: Optional[Tuple[float, float]] = None,
+    task_pos: Sequence[Tuple[float, float]] = (),
+    obstacles: Sequence[Tuple[float, float, float]] = (),
+    team: Optional[Sequence[int]] = None,
+    kill_ids: Sequence[int] = (),
+    max_steps: int = 10_000,
+    tag_radius: float = 0.0,
+    **overrides,
+) -> EnvParams:
+    """One scenario's :class:`EnvParams` against ``env``'s static
+    shapes — the host-side constructor every zoo entry goes through.
+
+    ``n_agents`` (default: full capacity) rides the ``alive0`` mask;
+    ``kill_ids`` injects initial faults (the recovery hook);
+    ``task_pos`` must match ``env.n_tasks`` exactly (a shape);
+    ``obstacles`` rows ``(cx, cy, r)`` up to ``env.n_obstacles``
+    (missing rows park at the remote pad where their force is exactly
+    zero); ``**overrides`` are
+    :class:`~..serve.batched.ScenarioParams` fields (``k_att``,
+    ``auction_eps``, ...).  ``n_agents=0`` is the dead FILLER
+    scenario the bucket padding uses."""
+    cap = env.capacity
+    n = cap if n_agents is None else int(n_agents)
+    if not 0 <= n <= cap:
+        raise ValueError(
+            f"n_agents {n} outside [0, capacity={cap}]"
+        )
+    if not spread > 0:
+        raise ValueError(f"spread must be > 0, got {spread}")
+    if len(task_pos) != env.n_tasks:
+        raise ValueError(
+            f"task_pos has {len(task_pos)} rows; this env's task "
+            f"board is n_tasks={env.n_tasks} (a shape — pad or "
+            "rebuild the env)"
+        )
+    if len(obstacles) > env.n_obstacles:
+        raise ValueError(
+            f"{len(obstacles)} obstacles exceed the env's static "
+            f"table n_obstacles={env.n_obstacles}"
+        )
+    bad = [k for k in kill_ids if not 0 <= k < max(n, 1)]
+    if bad:
+        raise ValueError(
+            f"kill_ids {bad} outside [0, n_agents={n}) — fault "
+            "injection must name real agents"
+        )
+    if max_steps < 1:
+        raise ValueError(f"max_steps must be >= 1, got {max_steps}")
+    if tag_radius > 0 and not env.enable_tagging:
+        raise ValueError(
+            f"tag_radius {tag_radius} > 0 but the env was built with "
+            "enable_tagging=False — the tag sweep was statically "
+            "compiled out, so the scenario would silently never tag; "
+            "build the env with enable_tagging=True for pursuit "
+            "scenarios"
+        )
+
+    alive0 = np.zeros((cap,), bool)
+    alive0[:n] = True
+    if kill_ids:
+        alive0[list(kill_ids)] = False
+    team_arr = np.zeros((cap,), np.int32)
+    if team is not None:
+        team = np.asarray(team, np.int32)
+        if team.shape != (cap,):
+            raise ValueError(
+                f"team must be [capacity]={cap} ints, got "
+                f"{team.shape}"
+            )
+        team_arr = team
+    obs_arr = np.full((env.n_obstacles, 3), 0.0, np.float32)
+    obs_arr[:, 0] = _REMOTE
+    obs_arr[:, 1] = _REMOTE
+    for i, row in enumerate(obstacles):
+        obs_arr[i] = np.asarray(row, np.float32)
+    tpos = (
+        np.asarray(task_pos, np.float32).reshape(env.n_tasks, 2)
+        if env.n_tasks
+        else np.zeros((0, 2), np.float32)
+    )
+    return EnvParams(
+        scenario=scenario_params(env.cfg, **overrides),
+        reward_id=jnp.asarray(reward_id, jnp.int32),
+        spread=jnp.asarray(spread, jnp.float32),
+        use_point=jnp.asarray(target is not None),
+        point=jnp.asarray(
+            target if target is not None else (0.0, 0.0), jnp.float32
+        ),
+        alive0=jnp.asarray(alive0),
+        team=jnp.asarray(team_arr),
+        task_pos=jnp.asarray(tpos),
+        obstacles=jnp.asarray(obs_arr),
+        max_steps=jnp.asarray(max_steps, jnp.int32),
+        tag_radius=jnp.asarray(tag_radius, jnp.float32),
+    )
+
+
+@watched(ENV_ROLLOUT_ENTRY)
+@partial(
+    jax.jit,
+    static_argnames=(
+        "env", "n_steps", "random_policy", "telemetry", "auto_reset",
+    ),
+)
+def _env_rollout_impl(
+    keys: jax.Array,
+    params: EnvParams,
+    env: SwarmMARLEnv,
+    n_steps: int,
+    random_policy: bool = False,
+    telemetry: bool = False,
+    auto_reset: bool = True,
+):
+    """``n_steps`` vmapped env steps under one ``lax.scan`` — the
+    compiled MARL rollout.  ``keys`` is ``[S, 2]`` (one PRNG stream
+    per scenario — never broadcast, the key-broadcast rule) and
+    ``params`` ``[S]``-leaved; S heterogeneous scenarios step in one
+    program (``reward_id`` dispatches via ``lax.switch``).
+
+    ``random_policy=True`` draws uniform actions in
+    ``[-act_limit, act_limit]^2`` per agent per step (the bench /
+    smoke policy); False steps the zero action — BITWISE the pure
+    protocol rollout.  Returns ``(states, rewards [T, S, capacity],
+    dones [T, S, capacity])`` with the stacked ``[T, S]`` telemetry
+    record appended when the static gate is on (disabled lowering
+    byte-identical — the r10 contract, pinned in
+    tests/test_envs.py)."""
+    telem_on = telemetry or env.cfg.telemetry.enabled
+    if telem_on and not env.cfg.telemetry.enabled:
+        env = env.replace(cfg=env.cfg.replace(telemetry=TELEMETRY_ON))
+
+    split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+    obs, states = jax.vmap(env.reset)(split[:, 0], params)
+
+    def body(carry, _):
+        lkeys, _obs, states = carry
+        parts = jax.vmap(lambda k: jax.random.split(k, 3))(lkeys)
+        lkeys, akeys, skeys = parts[:, 0], parts[:, 1], parts[:, 2]
+        if random_policy:
+            acts = jax.vmap(
+                lambda ak: jax.random.uniform(
+                    ak, (env.capacity, 2), jnp.float32,
+                    minval=-env.act_limit, maxval=env.act_limit,
+                )
+            )(akeys)
+        else:
+            acts = jnp.zeros(
+                _obs.shape[:2] + (2,), jnp.float32
+            )
+        obs, states, rew, dones, info = jax.vmap(
+            lambda k, s, a: env.step(k, s, a, auto_reset=auto_reset)
+        )(skeys, states, acts)
+        telem = None
+        if telem_on:
+            telem = info["telemetry"]
+        return (lkeys, obs, states), (rew, dones, telem)
+
+    (_, obs, states), (rewards, dones, telem) = jax.lax.scan(
+        body, (split[:, 1], obs, states), None, length=n_steps
+    )
+    out = (states, rewards, dones)
+    if telem_on:
+        if not n_steps:
+            telem = None
+        out = out + (telem,)
+    return out
+
+
+def env_rollout(
+    keys: jax.Array,
+    env: SwarmMARLEnv,
+    params: EnvParams,
+    n_steps: int,
+    random_policy: bool = False,
+    telemetry: bool = False,
+    auto_reset: bool = True,
+):
+    """Public entry for the compiled env rollout (see
+    :func:`_env_rollout_impl`).  ``keys`` must carry a leading
+    scenario axis matching ``params`` (``[S, 2]``; build a batch of
+    one with ``stack_env_params([p])`` and ``key[None]``)."""
+    keys = jnp.asarray(keys)
+    if keys.ndim != 2:
+        raise ValueError(
+            "env_rollout wants batched keys [S, 2] — one PRNG stream "
+            f"per scenario; got shape {keys.shape} (wrap a single "
+            "key with key[None] and stack_env_params([params]))"
+        )
+    return _env_rollout_impl(
+        keys, params, env, n_steps, random_policy, telemetry,
+        auto_reset,
+    )

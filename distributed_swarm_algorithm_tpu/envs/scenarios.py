@@ -1,0 +1,261 @@
+"""The scenario zoo: each scenario is a params pytree + a reward id.
+
+No scenario forks the tick.  A zoo entry is an
+:class:`~.core.EnvParams` builder (spawn/team/task/obstacle tables +
+:class:`~..serve.batched.ScenarioParams` gain overrides) plus one of
+the reward functions below, selected at trace time by ``reward_id``
+through ``lax.switch`` — so FOUR different scenarios vmap into ONE
+compiled rollout, and a scenario is exactly the kind of data the
+serve layer's bucket lattice already batches
+(``serve/batched.env_rollouts``).
+
+Reward functions share one signature ``(prev, cur, params, cfg) ->
+[capacity] f32`` (per-agent, 0 on dead/pad slots; ``prev`` is the
+pre-tick swarm so transition events — an evader tagged this step —
+are observable).  They are read-only: reward computation can never
+perturb the trajectory, which is what keeps the zero-action rollout
+bitwise equal to the pure protocol.
+
+The zoo (see docs/ENVIRONMENTS.md for the matrix):
+
+- **station-keeping** (``STATION``): hold the spawn formation; reward
+  is the negative distance to the (formation-derived) nav target —
+  the protocol's own objective, so the pure protocol is already a
+  strong baseline policy.
+- **obstacle-field** (``OBSTACLE``): reach a shared goal through an
+  obstacle line; the APF repulsion already exists, the reward adds a
+  proximity penalty inside ``rho0`` on top of the goal distance.
+- **pursuit-evasion** (``PURSUIT``): two populations via the per-agent
+  team id riding the alive mask — pursuers close on the nearest
+  evader, evaders open distance; a tagged evader is KILLED (alive bit
+  cleared), so the election/allocation recovery machinery is
+  stress-tested by adversarial motion, not a quiet arena.
+- **coverage-foraging** (``COVERAGE``): reward rides the task
+  -allocation auction — an agent scores for holding a task award
+  (``ops/allocation.agent_task_view``) and for actually standing near
+  the task it won, so the learned policy must cooperate with (not
+  fight) the protocol's assignment mechanism.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.allocation import agent_task_view
+from ..state import SwarmState
+from ..utils.config import SwarmConfig
+from .core import EnvParams, SwarmMARLEnv, make_env_params
+
+#: Reward registry indices — ``EnvParams.reward_id`` values.
+STATION = 0
+OBSTACLE = 1
+PURSUIT = 2
+COVERAGE = 3
+
+REWARD_NAMES = (
+    "station-keeping",
+    "obstacle-field",
+    "pursuit-evasion",
+    "coverage-foraging",
+)
+
+#: Distance-shaping cap for the pursuit rewards: beyond this range the
+#: gradient is noise, and an unbounded evader reward would reward
+#: leaving the arena.
+_PURSUIT_RANGE = 20.0
+_FAR = 1.0e9
+
+
+def _station_reward(prev: SwarmState, cur: SwarmState,
+                    p: EnvParams, cfg: SwarmConfig) -> jax.Array:
+    err = jnp.linalg.norm(cur.target - cur.pos, axis=-1)
+    return jnp.where(cur.alive, -err, 0.0)
+
+
+def _obstacle_reward(prev: SwarmState, cur: SwarmState,
+                     p: EnvParams, cfg: SwarmConfig) -> jax.Array:
+    base = _station_reward(prev, cur, p, cfg)
+    if p.obstacles.shape[0] == 0:
+        return base
+    centers = p.obstacles[:, :2]
+    radii = p.obstacles[:, 2]
+    d = (
+        jnp.linalg.norm(cur.pos[:, None, :] - centers[None, :, :],
+                        axis=-1)
+        - radii[None, :]
+    )
+    # Penalty ramps linearly inside the APF influence radius rho0 —
+    # the same length scale the repulsion term acts on, so the reward
+    # and the physics agree about what "too close" means.
+    pen = jnp.sum(jnp.clip(1.0 - d / cfg.rho0, 0.0, 1.0), axis=1)
+    return jnp.where(cur.alive, base - 2.0 * pen, 0.0)
+
+
+def _pursuit_reward(prev: SwarmState, cur: SwarmState,
+                    p: EnvParams, cfg: SwarmConfig) -> jax.Array:
+    pos = cur.pos
+    d = jnp.linalg.norm(pos[:, None, :] - pos[None, :, :], axis=-1)
+    evader = cur.alive & (p.team == 1)
+    pursuer = cur.alive & (p.team == 0)
+    d_to_evader = jnp.min(
+        jnp.where(evader[None, :], d, _FAR), axis=1
+    )
+    d_to_pursuer = jnp.min(
+        jnp.where(pursuer[None, :], d, _FAR), axis=1
+    )
+    r_pursue = -jnp.minimum(d_to_evader, _PURSUIT_RANGE)
+    r_evade = jnp.minimum(d_to_pursuer, _PURSUIT_RANGE)
+    r = jnp.where(p.team == 0, r_pursue, r_evade)
+    # A tagged evader's terminal penalty lands on the transition tick
+    # (prev alive, now dead); afterwards the slot rewards 0.
+    tagged_now = prev.alive & ~cur.alive & (p.team == 1)
+    return jnp.where(
+        cur.alive, r, jnp.where(tagged_now, -_PURSUIT_RANGE, 0.0)
+    )
+
+
+def _coverage_reward(prev: SwarmState, cur: SwarmState,
+                     p: EnvParams, cfg: SwarmConfig) -> jax.Array:
+    zero = jnp.zeros((cur.n_agents,), jnp.float32)
+    if cur.n_tasks == 0:
+        return zero
+    my_task = agent_task_view(cur)                        # [N] i32
+    won = my_task >= 0
+    tpos = cur.task_pos[jnp.maximum(my_task, 0)]
+    d = jnp.linalg.norm(tpos - cur.pos, axis=-1)
+    # Holding an award is worth 1; standing on the task doubles it —
+    # the auction decides WHO serves, the policy must actually GO.
+    r = jnp.where(won, 1.0 + 1.0 / (1.0 + d), 0.0)
+    return jnp.where(cur.alive, r, 0.0)
+
+
+#: reward_id -> function, in registry order (REWARD_NAMES aligns).
+REWARD_FNS = (
+    _station_reward, _obstacle_reward, _pursuit_reward,
+    _coverage_reward,
+)
+
+
+def reward_switch(prev: SwarmState, cur: SwarmState, p: EnvParams,
+                  cfg: SwarmConfig) -> jax.Array:
+    """Per-agent reward dispatched on the TRACED ``reward_id`` — under
+    ``vmap`` the switch lowers to a select, so heterogeneous scenarios
+    cost every branch but stay one compiled program (the same
+    cond->select economics as the r13 vmapped auction)."""
+    idx = jnp.clip(p.reward_id, 0, len(REWARD_FNS) - 1)
+    return jax.lax.switch(
+        idx,
+        [lambda a, b, c, f=f: f(a, b, c, cfg) for f in REWARD_FNS],
+        prev, cur, p,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Zoo builders — every entry goes through make_env_params, so the
+# shapes are the env's statics and the gains are ScenarioParams data.
+
+
+def station_keeping(env: SwarmMARLEnv, n_agents: Optional[int] = None,
+                    spread: float = 6.0, max_steps: int = 10_000,
+                    kill_ids=(), **overrides) -> EnvParams:
+    """Hold the spawn formation (the r12 quiet arena, as an env)."""
+    return make_env_params(
+        env, STATION, n_agents=n_agents, spread=spread,
+        task_pos=[(0.0, 0.0)] * env.n_tasks,
+        max_steps=max_steps, kill_ids=kill_ids, **overrides,
+    )
+
+
+def obstacle_field(env: SwarmMARLEnv, n_agents: Optional[int] = None,
+                   spread: float = 4.0, max_steps: int = 10_000,
+                   **overrides) -> EnvParams:
+    """Cross an obstacle line to a shared goal — APF repulsion is
+    already in the tick; the reward adds the proximity penalty."""
+    rows = [
+        (6.0, -3.0, 1.0), (6.5, 0.0, 1.2), (6.0, 3.0, 1.0),
+    ][: env.n_obstacles]
+    return make_env_params(
+        env, OBSTACLE, n_agents=n_agents, spread=spread,
+        target=(12.0, 0.0), obstacles=rows,
+        task_pos=[(0.0, 0.0)] * env.n_tasks,
+        max_steps=max_steps, **overrides,
+    )
+
+
+def pursuit_evasion(env: SwarmMARLEnv, n_agents: Optional[int] = None,
+                    spread: float = 8.0, tag_radius: float = 1.0,
+                    max_steps: int = 10_000, **overrides) -> EnvParams:
+    """Two populations: the lower half of the id range pursues, the
+    upper half evades; a tagged evader dies through the alive mask
+    (the recovery machinery's adversarial workout)."""
+    cap = env.capacity
+    n = cap if n_agents is None else int(n_agents)
+    team = [0] * cap
+    for i in range(n // 2, n):
+        team[i] = 1
+    return make_env_params(
+        env, PURSUIT, n_agents=n_agents, spread=spread, team=team,
+        tag_radius=tag_radius,
+        task_pos=[(0.0, 0.0)] * env.n_tasks,
+        max_steps=max_steps, **overrides,
+    )
+
+
+def coverage_foraging(env: SwarmMARLEnv,
+                      n_agents: Optional[int] = None,
+                      spread: float = 6.0, max_steps: int = 10_000,
+                      **overrides) -> EnvParams:
+    """Serve the task board: the auction (or greedy arbiter) awards,
+    the reward pays for holding an award and standing on it."""
+    if env.n_tasks == 0:
+        raise ValueError(
+            "coverage-foraging needs a task board: build the env "
+            "with n_tasks >= 1 (the reward rides the allocation "
+            "award)"
+        )
+    import math
+
+    ring = []
+    for i in range(env.n_tasks):
+        ang = 2.0 * math.pi * i / env.n_tasks
+        ring.append((8.0 * math.cos(ang), 8.0 * math.sin(ang)))
+    overrides.setdefault("utility_threshold", 2.0)
+    return make_env_params(
+        env, COVERAGE, n_agents=n_agents, spread=spread,
+        task_pos=ring, max_steps=max_steps, **overrides,
+    )
+
+
+def filler_params(env: SwarmMARLEnv) -> EnvParams:
+    """The dead FILLER scenario bucket padding dispatches: every slot
+    dead, station reward — it ticks along at full shape and its rows
+    are discarded (the serve/buckets.py padding contract)."""
+    return make_env_params(
+        env, STATION, n_agents=0,
+        task_pos=[(0.0, 0.0)] * env.n_tasks,
+    )
+
+
+#: name -> builder, the zoo surface examples/benches iterate.
+ZOO = {
+    "station-keeping": station_keeping,
+    "obstacle-field": obstacle_field,
+    "pursuit-evasion": pursuit_evasion,
+    "coverage-foraging": coverage_foraging,
+}
+
+
+def zoo_batch(env: SwarmMARLEnv, **common) -> EnvParams:
+    """The whole zoo as one stacked ``[4]``-leaved batch — the
+    heterogeneous ONE-compiled-program workload (requires
+    ``env.n_tasks >= 1`` for the coverage entry and
+    ``env.n_obstacles >= 1`` for the obstacle entry to be
+    distinguishable)."""
+    from .core import stack_env_params
+
+    return stack_env_params(
+        [ZOO[name](env, **common) for name in REWARD_NAMES]
+    )

@@ -203,6 +203,7 @@ def swarm_tick_dyn(
     obstacles: Optional[jax.Array],
     cfg: SwarmConfig,
     params=None,
+    extra_force=None,
 ):
     """One protocol tick with DYNAMIC per-scenario parameters (r13) —
     the scenario-batching substrate.
@@ -221,6 +222,13 @@ def swarm_tick_dyn(
     :func:`swarm_rollout` with the params baked into the config
     (pinned by tests/test_serve.py).
 
+    ``extra_force`` (r14, envs/): an optional ``[N, D]`` per-agent
+    steering force injected between the APF sum and ``integrate`` —
+    the RL action channel of the MARL env facade
+    (``envs/core.SwarmMARLEnv``).  ``None`` keeps the pre-r14 graph;
+    an all-zero array reproduces the pure-protocol trajectory BITWISE
+    (the sign-of-zero-safe select lives in ``_physics_step_core``).
+
     Plain (un-jitted): callers own the jit/vmap/scan composition.
     Returns ``(state, telemetry-or-None)`` — telemetry gated on
     ``cfg.telemetry.enabled`` (the r10 static gate).
@@ -230,7 +238,8 @@ def swarm_tick_dyn(
     from ..ops.physics import _physics_step_core
 
     out, _, telem = _physics_step_core(
-        state, obstacles, cfg, None, None, params=params
+        state, obstacles, cfg, None, None, params=params,
+        extra_force=extra_force,
     )
     return out, telem
 

@@ -263,6 +263,28 @@ def auction_allocation_step(
     return jax.lax.cond(resolve, solve, lambda st: st, state)
 
 
+def agent_task_view(state: SwarmState) -> jax.Array:
+    """[N] i32 — the task index awarded to each agent, ``NO_WINNER``
+    (-1) when unassigned; the LOWEST task index when one agent holds
+    several (possible on the greedy path — the auction is one-task-
+    per-agent by construction).
+
+    The per-agent inverse of ``task_winner`` — the view RL reward
+    shaping reads (envs/scenarios.py: the coverage/foraging reward is
+    "how well am I serving the task the auction gave me") without
+    re-deriving the ``[N, T]`` ownership match per consumer."""
+    if state.n_tasks == 0:
+        return jnp.full((state.n_agents,), NO_WINNER, jnp.int32)
+    awarded = state.task_winner != NO_WINNER                     # [T]
+    mine = (
+        state.task_winner[None, :] == state.agent_id[:, None]
+    ) & awarded[None, :]                                         # [N, T]
+    t_idx = jnp.arange(state.n_tasks, dtype=jnp.int32)
+    big = jnp.asarray(state.n_tasks, jnp.int32)
+    first = jnp.min(jnp.where(mine, t_idx[None, :], big), axis=1)
+    return jnp.where(first < big, first, NO_WINNER).astype(jnp.int32)
+
+
 def task_status_view(state: SwarmState) -> jax.Array:
     """[N, T] per-agent task status, the reference's string statuses as ints:
     OPEN=0, TENTATIVE=1 (I claimed, unresolved), ASSIGNED=2 (awarded to me),

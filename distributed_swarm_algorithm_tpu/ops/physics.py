@@ -672,6 +672,7 @@ def _physics_step_core(
     plan,
     dt: Optional[float],
     params=None,
+    extra_force=None,
 ):
     """The one tick body behind :func:`physics_step`,
     :func:`physics_step_telem`, and :func:`physics_step_plan` —
@@ -689,7 +690,13 @@ def _physics_step_core(
     as TRACED scalars so a vmapped scenario axis runs heterogeneous
     physics in one compiled program.  ``None`` (every pre-r13 caller)
     reads the static config — identical graph, pinned bitwise by
-    tests/test_serve.py."""
+    tests/test_serve.py.
+
+    ``extra_force`` (r14, envs/): an optional ``[N, D]`` steering
+    force injected between the APF sum and :func:`integrate` — the
+    per-agent RL action of the MARL env facade.  ``None`` keeps the
+    pre-r14 graph; a zero array reproduces the pure-protocol
+    trajectory BITWISE (see the select below)."""
     dt = cfg.dt if dt is None else dt
     if plan is not None:
         from .hashgrid_plan import refresh_plan
@@ -704,6 +711,17 @@ def _physics_step_core(
     derived = formation_targets(state, cfg)
     force, tick_plan = apf_forces_plan(derived, obstacles, cfg, plan=plan,
                                        params=params)
+    if extra_force is not None:
+        # Elementwise select, not a plain add: `force + 0.0` flips the
+        # sign bit of any -0.0 APF component (and -0.0 force rows DO
+        # occur — `k * (target - pos)` produces them), which would
+        # leak into the stored velocity and break the zero-action ==
+        # pure-protocol BITWISE contract (tests/test_envs.py).  A zero
+        # action component therefore passes the APF force through
+        # untouched; nonzero components add (numerically identical to
+        # the unconditional sum everywhere else).
+        force = jnp.where(extra_force != 0.0, force + extra_force,
+                          force)
     # Reference semantics: no target => early return, nothing moves
     # (agent.py:113-114).  Dead agents are frozen too (masked update).
     moving = derived.has_target & state.alive

@@ -8,10 +8,12 @@ from .batched import (
     MATERIALIZE_ENTRY,
     PARAM_FIELDS,
     SERVE_ENTRY,
+    EnvRolloutResult,
     ScenarioParams,
     ScenarioRequest,
     bake_params,
     batched_rollout,
+    env_rollouts,
     materialize_batch,
     materialize_scenario,
     scenario_params,
@@ -29,12 +31,14 @@ __all__ = [
     "PARAM_FIELDS",
     "SERVE_ENTRY",
     "BucketSpec",
+    "EnvRolloutResult",
     "RolloutService",
     "ScenarioParams",
     "ScenarioRequest",
     "TenantResult",
     "bake_params",
     "batched_rollout",
+    "env_rollouts",
     "materialize_batch",
     "materialize_scenario",
     "scenario_params",

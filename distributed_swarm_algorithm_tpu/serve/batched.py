@@ -459,3 +459,124 @@ def batched_rollout(
     return _batched_rollout_impl(
         states, params, cfg, n_steps, record, telemetry
     )
+
+
+# ---------------------------------------------------------------------------
+# Env serving (r14): the MARL rollout through the bucket lattice.
+
+#: Static families the env-rollout entry has served in this process
+#: (env, n_steps, random_policy, effective telemetry) — the jit cache
+#: they key is process-global, so the declared budget must be too.
+_ENV_ROLLOUT_FAMILIES: set = set()
+
+
+@dataclass
+class EnvRolloutResult:
+    """One scenario of a bucketed env dispatch: the final
+    :class:`~..envs.core.EnvState` row, the ``[n_steps, capacity]``
+    per-agent reward/done stacks, and the tenant's flight-recorder
+    summary (``None`` with telemetry off)."""
+
+    index: int
+    state: object
+    rewards: object
+    dones: object
+    summary: Optional[dict] = None
+
+
+def env_rollouts(
+    env,
+    scenarios,
+    seeds,
+    n_steps: int,
+    spec=None,
+    random_policy: bool = False,
+    telemetry: bool = False,
+):
+    """Bucketed MARL serving: run a heterogeneous list of env
+    scenarios through the batch-rung lattice — each dispatch is ONE
+    compiled call of the ``"env-rollout"`` entry, padded with dead
+    filler scenarios exactly like the tenant service
+    (serve/buckets.py); a scenario is just params + a reward id, so
+    the serve plane needs nothing new to carry RL workloads.
+
+    ``env`` is a :class:`~..envs.core.SwarmMARLEnv` (its capacity is
+    the agent-axis shape — already quantized by construction, so only
+    the batch axis buckets here); ``scenarios`` a sequence of
+    single-scenario :class:`~..envs.core.EnvParams`; ``seeds`` one
+    PRNG seed per scenario (each scenario gets its own stream — the
+    key-broadcast rule).  The batch-rung budget is declared to the
+    compile observatory under the env entry.  Returns one
+    :class:`EnvRolloutResult` per scenario, input order."""
+    from ..envs.core import (
+        ENV_ROLLOUT_ENTRY,
+        _env_rollout_impl,
+        env_params_row,
+        stack_env_params,
+    )
+    from ..envs.scenarios import filler_params
+    from ..utils import compile_watch
+    from ..utils.telemetry import TelemetrySummary, tenant_telemetry
+    from .buckets import BucketSpec
+
+    scenarios = list(scenarios)
+    seeds = list(seeds)
+    if len(seeds) != len(scenarios):
+        raise ValueError(
+            f"{len(scenarios)} scenarios but {len(seeds)} seeds — "
+            "every scenario needs its own PRNG stream"
+        )
+    spec = spec or BucketSpec()
+    watch = compile_watch.WATCH
+    # The budget is batch rungs x OBSERVED static families (env,
+    # n_steps, flags) — the r13 service's task-family discipline:
+    # each distinct static tuple legitimately mints its own compile
+    # per rung, and declaring rungs alone would turn the second
+    # family's compile into a spurious bucket-overflow event.
+    _ENV_ROLLOUT_FAMILIES.add(
+        (env, int(n_steps), bool(random_policy),
+         bool(telemetry or env.cfg.telemetry.enabled))
+    )
+    budget = max(
+        len(spec.batches) * len(_ENV_ROLLOUT_FAMILIES),
+        watch.bucket_budget(ENV_ROLLOUT_ENTRY) or 0,
+    )
+    watch.declare_buckets(ENV_ROLLOUT_ENTRY, budget)
+
+    filler = filler_params(env) if scenarios else None
+    results: list = [None] * len(scenarios)
+    queue = list(range(len(scenarios)))
+    for size in spec.split_batch(len(queue)):
+        take = queue[:size]
+        queue = queue[size:]
+        rows = [scenarios[i] for i in take]
+        row_seeds = [seeds[i] for i in take]
+        n_pad = size - len(rows)
+        rows += [filler] * n_pad
+        row_seeds += [0] * n_pad
+        params = stack_env_params(rows)
+        keys = jnp.stack(
+            [jax.random.PRNGKey(s) for s in row_seeds]
+        )
+        out = _env_rollout_impl(
+            keys, params, env, n_steps, random_policy, telemetry,
+        )
+        telem = None
+        if telemetry or env.cfg.telemetry.enabled:
+            states, rewards, dones, telem = out
+        else:
+            states, rewards, dones = out
+        for j, i in enumerate(take):
+            summary = None
+            if telem is not None:
+                summary = TelemetrySummary.from_ticks(
+                    tenant_telemetry(telem, j)
+                ).to_dict()
+            results[i] = EnvRolloutResult(
+                index=i,
+                state=jax.tree_util.tree_map(lambda x: x[j], states),
+                rewards=rewards[:, j],
+                dones=dones[:, j],
+                summary=summary,
+            )
+    return results

@@ -73,15 +73,29 @@ class CompileRecord:
         return asdict(self)
 
 
+import re as _re
+
+#: Object addresses inside reprs (``<function f at 0x7f...>`` — e.g.
+#: an env rollout's policy callable): stable within a process but not
+#: across runs, which would make every run's signatures diff as
+#: "changed" in swarmscope run-dir comparisons.  Strip them — jit
+#: keys statics by equality, and two objects at different addresses
+#: with the same stripped repr are the same signature for the
+#: observatory's purposes (a collision only under-counts compiles of
+#: identically-named distinct callables).
+_ADDR = _re.compile(r" at 0x[0-9a-fA-F]+")
+
+
 def _leaf_sig(leaf: Any) -> str:
     """One leaf's contribution to the cache-key approximation: arrays
     by shape/dtype (jit's abstraction), everything else by repr (jit
-    keys statics by equality; repr is the observable proxy)."""
+    keys statics by equality; repr is the observable proxy, with
+    memory addresses stripped for cross-run stability)."""
     shape = getattr(leaf, "shape", None)
     dtype = getattr(leaf, "dtype", None)
     if shape is not None and dtype is not None:
         return f"{dtype}[{','.join(map(str, shape))}]"
-    r = repr(leaf)
+    r = _ADDR.sub("", repr(leaf))
     return r if len(r) <= 120 else r[:117] + "..."
 
 

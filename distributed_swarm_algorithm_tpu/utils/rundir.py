@@ -25,7 +25,10 @@ The gating rules here MUST stay in lockstep with
 ``rounds`` / ``events`` / ``ticks`` / ``compiles`` / ``bytes`` (r12 —
 halo-exchange traffic) are lower-is-better
 counts (a clean 0 baseline regressing to any positive count always
-gates), unit ``pct`` gates against the absolute :data:`PCT_CEILING`,
+gates), unit ``pct`` gates against the absolute :data:`PCT_CEILING`
+and unit ``overhead-pct`` against :data:`OVERHEAD_PCT_CEILING`
+(r14 — structural overheads near 100%, where relative gating is
+load noise),
 everything else is a higher-is-better throughput.  compare.py cannot
 be imported from the package (benchmarks/ is not a package), so the
 ~30 shared lines live here and compare.py's tests cross-check the
@@ -54,6 +57,11 @@ COUNT_UNITS = ("findings", "rounds", "events", "ticks", "compiles",
 
 #: Absolute ceiling for unit-"pct" metrics (compare.PCT_CEILING).
 PCT_CEILING = 5.0
+
+#: Absolute ceiling for unit-"overhead-pct" metrics (r14, mirror of
+#: compare.OVERHEAD_PCT_CEILING — structural overheads near 100%
+#: where both relative and 5% gating would flap on load noise).
+OVERHEAD_PCT_CEILING = 200.0
 
 
 # ---------------------------------------------------------------------------
@@ -230,8 +238,9 @@ def gate(unit: str, prev: float, cur: float,
         if cur > prev * (1.0 + threshold) or (prev == 0 and cur > 0):
             return "REGRESSION"
         return "improved" if cur < prev else "ok"
-    if unit == "pct":
-        if cur > PCT_CEILING:
+    if unit in ("pct", "overhead-pct"):
+        ceiling = PCT_CEILING if unit == "pct" else OVERHEAD_PCT_CEILING
+        if cur > ceiling:
             return "REGRESSION"
         return "improved" if cur < prev else "ok"
     if prev <= 0:

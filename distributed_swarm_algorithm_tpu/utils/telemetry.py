@@ -458,6 +458,39 @@ def tenant_summaries(t: TickTelemetry) -> List["TelemetrySummary"]:
     ]
 
 
+def summarize_env_rollout(telem, rewards) -> dict:
+    """One env scenario's roll-up (r14, envs/): the flight-recorder
+    summary merged with per-agent reward statistics — the table row
+    the MARL example and ``benchmarks/bench_env.py`` print.
+
+    ``telem`` is the scenario's ``[T]``-leaved record (a
+    :func:`tenant_telemetry` slice, or ``None`` with the gate off);
+    ``rewards`` its ``[T, capacity]`` per-agent reward stack.  Reward
+    means are taken over ALL slots (dead/pad slots reward exactly 0
+    by the envs/scenarios.py contract, so the mean is comparable
+    across scenarios of one env)."""
+    import numpy as np
+
+    out = (
+        TelemetrySummary.from_ticks(telem).to_dict()
+        if telem is not None
+        else {}
+    )
+    r = np.asarray(rewards)
+    if r.ndim != 2:
+        raise ValueError(
+            f"rewards must be [T, capacity] for ONE scenario, got "
+            f"shape {r.shape}"
+        )
+    out["reward_mean"] = float(r.mean()) if r.size else 0.0
+    out["reward_first"] = float(r[0].mean()) if r.size else 0.0
+    out["reward_final"] = float(r[-1].mean()) if r.size else 0.0
+    out["reward_min_step"] = (
+        int(np.argmin(r.mean(axis=1))) if r.size else -1
+    )
+    return out
+
+
 def concat_telemetry(parts: Iterable[TickTelemetry]) -> TickTelemetry:
     """Concatenate already-stacked ``[T_i]`` records along the tick
     axis (the chunked window-mode rollout produces one part per

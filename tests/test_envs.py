@@ -1,0 +1,494 @@
+"""Swarm-as-environment (r14, envs/): the MARL facade's contracts.
+
+The load-bearing pin is ZERO-ACTION BITWISE PARITY: a zero-action env
+rollout must equal ``swarm_rollout`` of the same materialized state
+with the scenario params baked static — pos AND vel, by agent id.
+The env's action channel, reward computation, tagging, auto-reset
+select, and observation collection must all be invisible when the
+policy does nothing, or the env trains against a different swarm
+than the one everyone else ships.
+
+Compile budget: the rollout entry's ``(S, n_steps, flags)`` static
+signature is shared deliberately — TWO compiles (S=1 plain, S=4
+telemetry-on, one n_steps) cover parity, auto-reset (max_steps is
+traced data), the pursuit twin, the telemetry contract (zoo rows vs
+plain batch-of-1 crosses the gate, so vmap parity doubles as the
+non-perturbation pin), and the serve-bucketed path.  The
+vmapped-auction twin is slow-marked (the cond->select auction solve
+is the heaviest compile in this file's family).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu import envs, serve
+from distributed_swarm_algorithm_tpu.envs.core import _env_rollout_impl
+from distributed_swarm_algorithm_tpu.models.swarm import swarm_tick_dyn
+from distributed_swarm_algorithm_tpu.state import recount_alive_below
+
+#: Short election timings so allocation (leader-gated) resolves
+#: inside the 20-step window the whole module shares.
+CFG = dsa.SwarmConfig().replace(
+    formation_shape="none", utility_threshold=2.0,
+    election_timeout_ticks=10, heartbeat_period_ticks=5,
+)
+#: obs_max_per_cell covers the full capacity so the KNN block is
+#: EXACT at this scale (the default per-cell cap trades exactness
+#: for bounded rows — the documented degrade, not wanted in a pin).
+ENV = envs.SwarmMARLEnv(
+    cfg=CFG, capacity=24, n_tasks=2, n_obstacles=2, k_neighbors=4,
+    obs_max_per_cell=24,
+)
+T = 20
+
+PARITY_FIELDS = (
+    "pos", "vel", "fsm", "leader_id", "alive", "tick", "alive_below",
+    "task_winner", "task_util", "last_hb_tick",
+)
+
+
+def _swarm_row(states, i=0):
+    return jax.tree_util.tree_map(lambda x: x[i], states.swarm)
+
+
+def _assert_swarm_parity(solo, got, label=""):
+    for f in PARITY_FIELDS:
+        a = np.asarray(getattr(solo, f))
+        b = np.asarray(getattr(got, f))
+        assert np.array_equal(a, b), f"{label}: field {f} diverged"
+
+
+@functools.lru_cache(maxsize=None)
+def _station_rollout():
+    """S=1 zero-action station rollout — the shared compiled entry."""
+    p = envs.stack_env_params([envs.station_keeping(ENV, n_agents=20)])
+    keys = jax.random.PRNGKey(7)[None]
+    states, rewards, dones = envs.env_rollout(keys, ENV, p, T)
+    return p, states, rewards, dones
+
+
+@functools.lru_cache(maxsize=None)
+def _zoo_rollout():
+    """S=4 zero-action zoo rollout — one compiled heterogeneous
+    program (the acceptance shape), telemetry ON so the recorder
+    contract rides the same compile."""
+    p = envs.zoo_batch(ENV, n_agents=20)
+    keys = jnp.stack([jax.random.PRNGKey(i) for i in range(4)])
+    states, rewards, dones, telem = envs.env_rollout(
+        keys, ENV, p, T, telemetry=True
+    )
+    return p, states, rewards, dones, telem
+
+
+def _solo_reference(params_row, key, n_steps=T):
+    """The pure-protocol reference: the env's own materialization run
+    through swarm_rollout with the scenario params baked static."""
+    reset_key = jax.random.split(key, 2)[0]
+    swarm0 = ENV.materialize(reset_key, params_row)
+    baked = serve.bake_params(CFG, params_row.scenario)
+    return dsa.swarm_rollout(swarm0, None, baked, n_steps)
+
+
+# ----------------------------------------------------------- parity
+
+
+def test_zero_action_rollout_bitwise_parity_station():
+    # THE acceptance pin: pos AND vel bitwise, by agent id (slots ==
+    # ids here — the env never permutes the agent axis).
+    p, states, rewards, dones = _station_rollout()
+    solo = _solo_reference(
+        envs.env_params_row(p, 0), jax.random.PRNGKey(7)
+    )
+    _assert_swarm_parity(solo, _swarm_row(states), "station")
+    # Rewards are read-only: station reward is -dist-to-target for
+    # alive agents, 0 for the 4 pad slots.
+    r = np.asarray(rewards)[:, 0]
+    assert r.shape == (T, 24)
+    assert (r[:, :20] <= 0).all() and (r[:, 20:] == 0).all()
+    d = np.asarray(dones)[:, 0]
+    assert not d[:, :20].any()      # nobody dies, no episode boundary
+    assert d[:, 20:].all()          # pad slots always read done
+
+
+def test_vmap_over_scenarios_parity():
+    # Each row of the ONE heterogeneously-batched zoo program equals
+    # the same scenario run as a batch of one — vmap cannot perturb a
+    # scenario, whatever its neighbors compute.  The zoo runs with
+    # telemetry ON and the batch-of-1 twins with it OFF, so this
+    # comparison is ALSO the r10 non-perturbation pin (the recorder
+    # cannot move the trajectory).
+    p4, states4, rewards4, _, _ = _zoo_rollout()
+    builders = [
+        envs.station_keeping, envs.obstacle_field,
+        envs.pursuit_evasion, envs.coverage_foraging,
+    ]
+    for i, build in enumerate(builders):
+        p1 = envs.stack_env_params([build(ENV, n_agents=20)])
+        st1, rew1, _ = envs.env_rollout(
+            jax.random.PRNGKey(i)[None], ENV, p1, T
+        )
+        a, b = _swarm_row(states4, i), _swarm_row(st1)
+        for f in ("pos", "vel", "alive", "task_winner"):
+            assert np.array_equal(
+                np.asarray(getattr(a, f)), np.asarray(getattr(b, f))
+            ), f"zoo row {i} field {f} diverged from batch-of-1"
+        assert np.array_equal(
+            np.asarray(rewards4)[:, i], np.asarray(rew1)[:, 0]
+        ), f"zoo row {i} rewards diverged"
+
+
+def test_zoo_reward_structure():
+    _, _, rewards, _, _ = _zoo_rollout()
+    r = np.asarray(rewards)                       # [T, 4, 24]
+    assert (r[:, 0] <= 0).all()                   # station: -err
+    assert (r[:, 1] <= 0).all()                   # obstacle: -err - pen
+    # Coverage: the greedy arbiter awards once a leader exists (the
+    # short election timings above), and award reward is positive.
+    assert r[-1, 3].max() > 0
+
+
+def test_reset_matches_serve_materializer():
+    # The env constructor IS the serve constructor: reset(PRNGKey(s))
+    # reproduces materialize_scenario of the matching request.
+    p = envs.station_keeping(ENV, n_agents=20)
+    _, st = ENV.reset(jax.random.PRNGKey(5), p)
+    req = serve.ScenarioRequest(
+        n_agents=20, seed=5, arena_hw=6.0,
+        task_pos=((0.0, 0.0), (0.0, 0.0)),
+    )
+    ref, ref_params = serve.materialize_scenario(req, 24, CFG)
+    _assert_swarm_parity(ref, st.swarm, "reset-vs-materializer")
+    for f in serve.PARAM_FIELDS:
+        assert np.asarray(getattr(ref_params, f)) == np.asarray(
+            getattr(p.scenario, f)
+        )
+
+
+# ------------------------------------------------------- auto-reset
+
+
+def test_auto_reset_boundary():
+    # max_steps is TRACED data: the same compiled program as
+    # _station_rollout serves episodic semantics.
+    p = envs.stack_env_params(
+        [envs.station_keeping(ENV, n_agents=20, max_steps=6)]
+    )
+    keys = jax.random.PRNGKey(7)[None]
+    states, rewards, dones = envs.env_rollout(keys, ENV, p, T)
+    d = np.asarray(dones)[:, 0]                   # [T, 24]
+    boundary = d.all(axis=-1)
+    # Episodes end at step indices 5, 11, 17 (t+1 == 6 there).
+    assert list(np.flatnonzero(boundary)) == [5, 11, 17]
+    assert not d[:5, :20].any()     # only the 4 pad slots read done
+    # After the last boundary the clock restarted: 20 - 18 = 2 steps
+    # into episode 4, and the fresh swarm's tick agrees.
+    assert int(states.t[0]) == 2
+    assert int(states.swarm.tick[0]) == 2
+    # The reset re-materializes from a FRESH key: the final state is
+    # not the no-reset rollout's final state.
+    _, cont, _, _ = _station_rollout()
+    assert not np.array_equal(
+        np.asarray(states.swarm.pos[0]), np.asarray(cont.swarm.pos[0])
+    )
+
+
+# ------------------------------------------- two-population masking
+
+
+def test_two_population_masking_and_rewards():
+    # Crowded arena + generous tag radius: evaders die through the
+    # alive mask; pursuers never do; the alive_below cache stays
+    # consistent (the kill()-semantics contract of _pursuit_tag).
+    p = envs.stack_env_params([
+        envs.pursuit_evasion(
+            ENV, n_agents=20, tag_radius=3.0, spread=4.0
+        )
+    ])
+    keys = jax.random.PRNGKey(5)[None]
+    states, rewards, dones = envs.env_rollout(keys, ENV, p, T)
+    row = envs.env_params_row(p, 0)
+    team = np.asarray(row.team)
+    alive0 = np.asarray(row.alive0)
+    d = np.asarray(dones)[:, 0]                   # [T, 24] == ~alive
+    evader_alive = (~d & (team == 1)[None, :]).sum(axis=-1)
+    pursuer_alive = (~d & (team == 0)[None, :] & alive0[None, :]).sum(
+        axis=-1
+    )
+    assert (np.diff(evader_alive) <= 0).all()     # tags only kill
+    assert (pursuer_alive == 10).all()            # pursuers immune
+    assert evader_alive[-1] < 10                  # something happened
+    got = _swarm_row(states)
+    rec = recount_alive_below(got)
+    assert np.array_equal(
+        np.asarray(got.alive_below), np.asarray(rec.alive_below)
+    )
+    # Reward structure: alive pursuers are penalized by distance
+    # (<= 0), alive evaders rewarded by it (>= 0), and each tag lands
+    # exactly one -20 terminal on the transition step.
+    r = np.asarray(rewards)[:, 0]                 # [T, 24]
+    alive_t = ~d
+    assert (r[alive_t & (team == 0)[None, :]] <= 0).all()
+    assert (r[alive_t & (team == 1)[None, :]] >= 0).all()
+    # Each tag lands exactly one -20 terminal on an EVADER column
+    # (pursuer columns can also read -20 when no evader is in range
+    # — the shaping cap — so the count restricts to evader slots).
+    n_tags = int(10 - evader_alive[-1])
+    assert int((r[:, team == 1] == -20.0).sum()) == n_tags
+
+
+# ------------------------------------------------------ observations
+
+
+def test_knn_obs_matches_brute_force():
+    # Tight spawn (spread 2 << obs cell 4): every agent's true K
+    # nearest sit inside the plan's stencil coverage, so the plan-KNN
+    # block must equal the brute-force K nearest exactly.
+    p = envs.station_keeping(ENV, n_agents=20, spread=2.0)
+    obs, st = ENV.reset(jax.random.PRNGKey(3), p)
+    obs = np.asarray(obs)
+    pos = np.asarray(st.swarm.pos)
+    alive = np.asarray(st.swarm.alive)
+    K = ENV.k_neighbors
+    nbr = obs[:, 10:10 + 5 * K].reshape(24, K, 5)
+    for i in range(24):
+        if not alive[i]:
+            assert (obs[i] == 0).all()
+            continue
+        d = np.linalg.norm(pos - pos[i], axis=-1)
+        d[i] = np.inf
+        d[~alive] = np.inf
+        want = np.sort(d[np.isfinite(d)])[:K]
+        got = np.linalg.norm(nbr[i, :, :2], axis=-1)
+        valid = nbr[i, :, 4] > 0
+        assert valid.all()                        # 19 alive neighbors > K
+        np.testing.assert_allclose(np.sort(got), want, rtol=1e-6)
+
+
+def test_obs_layout_and_task_block():
+    assert ENV.obs_dim == 10 + 5 * ENV.k_neighbors + 4 * ENV.n_tasks
+    p, states, _, _ = _station_rollout()
+    obs = np.asarray(ENV.obs(_swarm_row(states)))
+    assert obs.shape == (24, ENV.obs_dim)
+    # Task block: open/mine flags agree with the final task_winner
+    # column (dead/pad rows are all-zero, so only alive rows assert).
+    tb = obs[:, 10 + 5 * ENV.k_neighbors:].reshape(24, ENV.n_tasks, 4)
+    final = _swarm_row(states)
+    winner = np.asarray(final.task_winner)
+    alive = np.asarray(final.alive)
+    for t in range(ENV.n_tasks):
+        assert (tb[alive, t, 2] == float(winner[t] < 0)).all()
+        mine = tb[:, t, 3].astype(bool)
+        if winner[t] >= 0:
+            assert mine.sum() == 1 and mine[winner[t]]
+        else:
+            assert not mine.any()
+
+
+# ----------------------------------------------------------- actions
+
+
+@functools.lru_cache(maxsize=1)
+def _jitted_step():
+    return jax.jit(lambda k, s, a: ENV.step(k, s, a))
+
+
+def test_action_effect_and_clamp():
+    p = envs.station_keeping(ENV, n_agents=20)
+    _, st = ENV.reset(jax.random.PRNGKey(9), p)
+    step = _jitted_step()
+    k = jax.random.PRNGKey(1)
+    zero = jnp.zeros((24, 2), jnp.float32)
+    big = jnp.full((24, 2), 100.0, jnp.float32)
+    _, st_zero, _, _, _ = step(k, st, zero)
+    _, st_big, _, _, _ = step(k, st, big)
+    # A power-of-two rescale keeps the clamped vector bit-identical
+    # (norm and quotient scale exactly); a generic scale would differ
+    # by 1 ulp in the normalization quotient.
+    _, st_bigger, _, _, _ = step(k, st, big * 4.0)
+    # Nonzero steering changes the trajectory...
+    assert not np.array_equal(
+        np.asarray(st_zero.swarm.pos), np.asarray(st_big.swarm.pos)
+    )
+    # ...but the L2 clamp makes every over-limit action identical.
+    assert np.array_equal(
+        np.asarray(st_big.swarm.pos), np.asarray(st_bigger.swarm.pos)
+    )
+    assert np.array_equal(
+        np.asarray(st_big.swarm.vel), np.asarray(st_bigger.swarm.vel)
+    )
+    # And the zero action reproduces the raw protocol tick bitwise
+    # (the where-select injection contract at the single-tick level).
+    # Jitted like the step — eager dispatch contracts FMAs differently
+    # from the compiled graph, which would make this a fusion test,
+    # not a semantics test.
+    tick = jax.jit(
+        lambda s, o, sp: swarm_tick_dyn(s, o, CFG, params=sp)[0]
+    )
+    ref = tick(
+        st.swarm,
+        envs.env_params_row(envs.stack_env_params([p]), 0).obstacles,
+        p.scenario,
+    )
+    assert np.array_equal(
+        np.asarray(ref.pos), np.asarray(st_zero.swarm.pos)
+    )
+    assert np.array_equal(
+        np.asarray(ref.vel), np.asarray(st_zero.swarm.vel)
+    )
+
+
+# --------------------------------------------------------- telemetry
+
+
+def test_telemetry_summaries():
+    # Trajectory non-perturbation is pinned by
+    # test_vmap_over_scenarios_parity (zoo telem-on rows == plain
+    # batch-of-1); here the per-scenario reductions are checked.
+    _, _, rewards, _, telem = _zoo_rollout()
+    from distributed_swarm_algorithm_tpu.utils.telemetry import (
+        summarize_env_rollout, tenant_telemetry,
+    )
+
+    s = summarize_env_rollout(
+        tenant_telemetry(telem, 0), np.asarray(rewards)[:, 0]
+    )
+    assert s["ticks"] == T and s["alive_final"] == 20
+    assert s["leader_changes"] >= 1               # the election happened
+    assert s["reward_mean"] <= 0                  # station reward
+    # The pursuit row shows the tag kills in the alive series.
+    sp = summarize_env_rollout(
+        tenant_telemetry(telem, 2), np.asarray(rewards)[:, 2]
+    )
+    assert sp["alive_final"] <= 20
+
+
+def test_disabled_telemetry_lowering_is_byte_identical():
+    p, _, _, _ = _station_rollout()
+    keys = jax.random.PRNGKey(7)[None]
+    low_off = _env_rollout_impl.lower(
+        keys, p, ENV, T, telemetry=False
+    ).as_text()
+    low_default = _env_rollout_impl.lower(keys, p, ENV, T).as_text()
+    low_on = _env_rollout_impl.lower(
+        keys, p, ENV, T, telemetry=True
+    ).as_text()
+    assert low_off == low_default
+    assert low_off != low_on
+
+
+# ----------------------------------------------------- serve buckets
+
+
+def test_env_serving_through_buckets():
+    # 5 scenarios through the single batch rung (4): two dispatches
+    # of 4, the second carrying 3 dead fillers — every result must
+    # equal its direct batch-of-1 rollout bitwise (crossing the
+    # telemetry gate too: dispatches run telem-on for the summaries,
+    # the direct twins run plain).  Signatures reuse the module's two
+    # compiled entries.
+    scen = [
+        envs.station_keeping(ENV, n_agents=12 + i) for i in range(5)
+    ]
+    res = serve.env_rollouts(
+        ENV, scen, seeds=range(5), n_steps=T,
+        spec=serve.BucketSpec(batches=(4,)), telemetry=True,
+    )
+    assert [r.index for r in res] == list(range(5))
+    for i in (0, 4):
+        st1, rew1, _ = envs.env_rollout(
+            jax.random.PRNGKey(i)[None], ENV,
+            envs.stack_env_params([scen[i]]), T,
+        )
+        assert np.array_equal(
+            np.asarray(res[i].state.swarm.pos),
+            np.asarray(st1.swarm.pos[0]),
+        ), f"bucketed scenario {i} diverged"
+        assert np.array_equal(
+            np.asarray(res[i].rewards), np.asarray(rew1)[:, 0]
+        )
+        assert res[i].summary["ticks"] == T
+        assert res[i].summary["alive_final"] == 12 + i
+    with pytest.raises(ValueError, match="seeds"):
+        serve.env_rollouts(ENV, scen, seeds=[0], n_steps=T)
+
+
+# ------------------------------------------------------- validation
+
+
+def test_env_validation_errors():
+    with pytest.raises(ValueError, match="separation_mode"):
+        envs.SwarmMARLEnv(
+            cfg=CFG.replace(separation_mode="hashgrid", world_hw=32.0),
+            capacity=8,
+        )
+    with pytest.raises(ValueError, match="k_neighbors"):
+        envs.SwarmMARLEnv(cfg=CFG, capacity=8, k_neighbors=64)
+    with pytest.raises(ValueError, match="act_limit"):
+        envs.SwarmMARLEnv(cfg=CFG, capacity=8, act_limit=0.0)
+    with pytest.raises(ValueError, match="task_pos"):
+        envs.make_env_params(ENV, envs.STATION, task_pos=())
+    with pytest.raises(ValueError, match="obstacles"):
+        envs.make_env_params(
+            ENV, envs.OBSTACLE,
+            task_pos=((0.0, 0.0), (0.0, 0.0)),
+            obstacles=((0, 0, 1),) * 3,
+        )
+    with pytest.raises(ValueError, match="kill_ids"):
+        envs.make_env_params(
+            ENV, envs.STATION, n_agents=4, kill_ids=(4,),
+            task_pos=((0.0, 0.0), (0.0, 0.0)),
+        )
+    with pytest.raises(ValueError, match="task board"):
+        envs.coverage_foraging(
+            envs.SwarmMARLEnv(cfg=CFG, capacity=8, n_tasks=0)
+        )
+    # A tagging-disabled env (the static N^2-sweep opt-out) must
+    # reject pursuit scenarios instead of silently never tagging.
+    with pytest.raises(ValueError, match="enable_tagging"):
+        envs.pursuit_evasion(
+            envs.SwarmMARLEnv(cfg=CFG, capacity=8,
+                              enable_tagging=False)
+        )
+    with pytest.raises(ValueError, match="batched keys"):
+        envs.env_rollout(
+            jax.random.PRNGKey(0), ENV,
+            envs.stack_env_params([envs.station_keeping(ENV)]), 2,
+        )
+
+
+# ------------------------------------------------ vmapped-auction twin
+
+
+@pytest.mark.slow
+def test_auction_coverage_env_parity():
+    # Slow-marked (ISSUE 9 triage): the vmapped auction compiles the
+    # full eps-optimal solve into the scan body (cond lowers to
+    # select under vmap) — the heaviest compile of this family.  The
+    # pin: auction-mode coverage through the env equals the solo
+    # auction rollout bitwise, and the auction actually awards.
+    cfg = CFG.replace(allocation_mode="auction")
+    env = envs.SwarmMARLEnv(
+        cfg=cfg, capacity=24, n_tasks=2, n_obstacles=2, k_neighbors=4
+    )
+    p = envs.stack_env_params([
+        envs.coverage_foraging(env, n_agents=20, auction_eps=0.5)
+    ])
+    keys = jax.random.PRNGKey(13)[None]
+    states, rewards, dones = envs.env_rollout(keys, env, p, 30)
+    row = envs.env_params_row(p, 0)
+    reset_key = jax.random.split(jax.random.PRNGKey(13), 2)[0]
+    swarm0 = env.materialize(reset_key, row)
+    solo = dsa.swarm_rollout(
+        swarm0, None, serve.bake_params(cfg, row.scenario), 30
+    )
+    _assert_swarm_parity(solo, _swarm_row(states), "auction-coverage")
+    winner = np.asarray(_swarm_row(states).task_winner)
+    assert (winner >= 0).all()                    # the solve resolved
+    assert np.asarray(rewards)[-1, 0].max() > 0

@@ -238,6 +238,25 @@ SEEDED = {
             return jax.vmap(tick, in_axes=(0, None))(states, key)
         """,
     ),
+    "done-branch": (
+        "pkg/envreset.py",
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def rollout(state, s0, n_steps):
+            def body(carry, _):
+                s, done = carry
+                if done:
+                    s = s0
+                return (s, jnp.any(s > 10.0)), None
+
+            out, _ = jax.lax.scan(
+                body, (state, False), None, length=n_steps
+            )
+            return out
+        """,
+    ),
 }
 
 
@@ -477,6 +496,38 @@ def test_each_rule_fires_exactly_once_on_seeded_tree(tmp_path):
                     return p + plan.cell_eff + halo
 
                 return body(pos)
+            """,
+        ),
+        # The `jnp.where`-select auto-reset (envs/core.py) is the
+        # SANCTIONED episode-boundary pattern — the traced done flag
+        # drives selects, never a Python branch; `is None` presence
+        # checks stay exempt; and a host driver's `while not done:`
+        # OUTSIDE any loop-transform body is ordinary host code.
+        (
+            "env_where_reset",
+            """
+            import jax
+            import jax.numpy as jnp
+
+            def rollout(state, s0, n_steps):
+                def body(carry, _):
+                    s, t = carry
+                    done = t >= 10
+                    if s0 is None:
+                        t = t * 0
+                    s = jnp.where(done, s0, s)
+                    return (s, jnp.where(done, 0, t + 1)), None
+
+                out, _ = jax.lax.scan(
+                    body, (state, 0), None, length=n_steps
+                )
+                return out
+
+            def drive(env_step, state):
+                done = False
+                while not done:
+                    state, done = env_step(state)
+                return state
             """,
         ),
         # Per-member keys mapped with axis 0: the sanctioned

@@ -266,6 +266,93 @@ def _run_swarmlint(root, recorded, record: bool) -> bool:
     return proc.returncode == 0
 
 
+def _run_jaxlint(root, recorded, record: bool) -> bool:
+    """Trace-level gate as metrics (r15): one fixed-name
+    ``jaxlint-findings`` line (unit "findings") plus one
+    ``jaxlint-collectives-per-tick, <entry>`` line (unit
+    "collectives", lower-is-better) per audited registry entry — so a
+    refactor that slips an extra per-tick collective into a lowered
+    rollout regresses a gated count even before the census-budget
+    test fails.  The subprocess pins its own CPU rig (the cli
+    handler), so this never dials a chip.  Returns False when the
+    auditor reports findings or fails to run."""
+    # Force the 8-virtual-device CPU rig in the subprocess: the cli
+    # handler appends the flag only when ABSENT, so a host env that
+    # already pins a smaller device count would silently skip the
+    # mesh entries — the very contracts this gate exists for.  XLA
+    # honors the last duplicate flag, so appending ours wins.
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable, "-m",
+                "distributed_swarm_algorithm_tpu.cli", "jaxlint",
+                "--json",
+            ],
+            capture_output=True, text=True, timeout=600, cwd=root,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        print("# jaxlint timed out", file=sys.stderr)
+        return False
+    try:
+        summary = json.loads(proc.stdout)
+        counts = summary["counts"]
+    except (json.JSONDecodeError, KeyError, TypeError):
+        tail = (proc.stderr.strip().splitlines()[-1]
+                if proc.stderr.strip() else "no stderr")
+        print(f"# jaxlint produced no JSON summary: {tail}",
+              file=sys.stderr)
+        return False
+    if counts.get("skipped"):
+        # A skipped entry is an UNAUDITED contract, not a pass.
+        print(
+            f"# jaxlint: {counts['skipped']} registry entr"
+            f"{'y' if counts['skipped'] == 1 else 'ies'} skipped — "
+            "the census gate did not cover the full registry",
+            file=sys.stderr,
+        )
+        return False
+    lines = [
+        {
+            "metric": "jaxlint-findings",
+            "value": float(counts["findings"]),
+            "unit": "findings",
+            "vs_baseline": None,
+        }
+    ]
+    for entry in summary.get("entries", []):
+        if entry.get("collectives_per_tick") is None:
+            continue
+        lines.append(
+            {
+                "metric": (
+                    "jaxlint-collectives-per-tick, "
+                    f"{entry['entry']}"
+                ),
+                "value": float(entry["collectives_per_tick"]),
+                "unit": "collectives",
+                "vs_baseline": None,
+            }
+        )
+    for line in lines:
+        print(json.dumps(line), flush=True)
+        if record:
+            recorded.append(line)
+    if proc.returncode != 0:
+        print(
+            f"# jaxlint: {counts['findings']} finding(s) — run "
+            "`python -m distributed_swarm_algorithm_tpu.cli jaxlint`",
+            file=sys.stderr,
+        )
+    return proc.returncode == 0
+
+
 def _default_backend() -> str:
     """The backend jax will actually pick, probed in a SUBPROCESS —
     env-var sniffing misses the no-JAX_PLATFORMS default case, and
@@ -328,6 +415,9 @@ def main() -> int:
     # Cheapest gate first (pure AST, no jax): hazard count + contract
     # check before any bench spends device time.
     failures += 0 if _run_swarmlint(root, recorded, collect) else 1
+    # Then the trace-level gate (r15: lowering only, CPU rig, no
+    # backend execution) — still far cheaper than any bench.
+    failures += 0 if _run_jaxlint(root, recorded, collect) else 1
     if args.tests:
         # Full gate = TWO pytest processes (default set, then the slow
         # set).  XLA's CPU backend_compile_and_load segfaults after

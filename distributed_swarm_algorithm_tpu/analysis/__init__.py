@@ -11,10 +11,19 @@ classes that have actually bitten this repo on TPU: PRNG key reuse,
 host syncs and Python branches inside traced code, per-call re-jit,
 per-iteration spatial-index rebuilds, ungated flight-recorder
 collection in scan bodies, host branches on traced done flags in env
-rollouts, dtype drift in ops/ hot paths, the fused-kernel dispatch
+rollouts, collectives under non-uniform cond predicates in shard_map
+bodies, dtype drift in ops/ hot paths, the fused-kernel dispatch
 contract, and bench metric-name hygiene.  See
 docs/STATIC_ANALYSIS.md for the rule catalog, the suppression
 policy, and how to add a rule.
+
+The package's second analyzer, **jaxlint** (``jaxlint.py``, r15 — run
+as ``python -m distributed_swarm_algorithm_tpu.cli jaxlint``), audits
+the LOWERED program instead of the source text: per-entry collective
+census with declared budgets (jaxlint-budgets.json), donation
+aliasing, and dtype-widening contracts over every compile-observatory
+registry entry.  It is deliberately not imported here: this package
+import stays jax-free so the AST gate runs anywhere.
 
 Importing this package registers the built-in rules (import order is
 display order).

@@ -1,0 +1,1025 @@
+"""jaxlint — trace/HLO-level program auditor (r15).
+
+swarmlint (rules_*.py) catches hazards visible in source text, but the
+contracts the sharded/serving layers live or die by are properties of
+the LOWERED program: "collective-permute present, all-gather absent"
+(the r12 spatial tick), "one pmax + one psum per tick, not 37
+all-reduces" (the r11 packed-telemetry finding, 34% overhead), donated
+buffers actually aliased (the r13 double-buffer loop).  Until r15 each
+of those was asserted ad hoc as an HLO-text grep inside one test;
+jaxlint promotes them into a first-class analysis pass with per-entry
+budgets, a ledger, and tier-1 gating — the HLO twin of swarmlint.
+
+How it works — no backend execution, no backend compile:
+
+1. Every ``compile_watch.watched()`` registry entry has a **lint
+   spec** here: a builder producing the entry's canonical small
+   example invocation ``(fn, args, kwargs)``.
+2. The entry is lowered once through the observatory's memoized
+   ``CompileWatch.lower_cached()`` path (``jit(...).lower(...)`` —
+   trace + StableHLO emission only), and the module text is parsed
+   into a per-function op table with call edges and
+   ``stablehlo.while`` loop regions.
+3. Four audits run over that table (the **census**, one flat
+   ``{key: count}`` dict per entry):
+
+   - **collective census** — all-gather / all-reduce /
+     collective-permute / reduce-scatter / all-to-all counts over the
+     whole module.  Note this sees what ``lower()`` sees: explicit
+     collectives (``shard_map`` bodies, ``lax.p*``) — GSPMD-inserted
+     collectives materialize later, inside XLA's SPMD partitioner,
+     and would need a backend compile to observe.
+   - **scan-body census** (``scan-*`` keys) — the same collectives
+     plus ``dynamic_slice`` counted INSIDE ``while`` loop regions
+     (scan/fori/while all lower to ``stablehlo.while``), following
+     ``func.call`` edges out of the region: a per-tick collective
+     costs T× a one-shot one, so the loop-body count is the one that
+     gates ("collectives-per-tick").
+   - **donation audit** — ``donated-not-aliased`` counts the buffers
+     jit WARNED it could not alias ("Some donated buffers were not
+     usable"), the exact signal of the r13 donated double-buffer loop
+     regressing to copies; ``aliased-outputs`` (informational, plus
+     the ``min-aliased-outputs`` floor budget) counts the
+     ``tf.aliasing_output`` parameter attributes that prove aliasing.
+   - **dtype/widening audit** — ``f64`` type occurrences,
+     ``f32-to-f64`` converts (an x64-creep guard: every kernel
+     contract here is f32/i32), and ``i64-to-f32`` converts (ids
+     widened past i32 then packed into f32 break the 2^24-exact
+     packing contract the r11/r12 packed collectives rely on).
+
+4. Counts are checked against the entry's **declared budgets** in
+   ``jaxlint-budgets.json`` (repo root — the same fingerprint-ledger
+   pattern as ``swarmlint-baseline.json``): every gated key is a
+   CEILING defaulting to 0, so a refactor that silently reintroduces
+   an all-gather into the spatial tick, or unpacks the r11 packed
+   telemetry reduction back into per-gauge all-reduces, fails tier-1.
+   Each ledger entry pins the example invocation's signature hash:
+   when the entry's example program changes shape, the entry goes
+   **signature-stale** and must be re-measured (``--write-budgets``)
+   — budgets must never silently gate a different program.  Ledger
+   entries for entries no longer registered are **stale** and fail,
+   so the file shrinks when entries die (the swarmlint baseline
+   discipline).
+
+Run it::
+
+    python -m distributed_swarm_algorithm_tpu.cli jaxlint            # text
+    python -m distributed_swarm_algorithm_tpu.cli jaxlint --json     # machine
+    python -m distributed_swarm_algorithm_tpu.cli jaxlint --census   # table
+    python -m distributed_swarm_algorithm_tpu.cli jaxlint --write-budgets
+
+Gated in tier-1 by ``tests/test_jaxlint.py`` (full registry lints
+clean) and in ``run_all`` as the fixed-name ``jaxlint-findings``
+metric plus per-entry ``jaxlint-collectives-per-tick`` rows (unit
+"collectives", lower-is-better in compare.py/rundir.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+# ---------------------------------------------------------------------------
+# Census keys
+
+#: Whole-module collective counts (census key -> StableHLO mnemonic).
+COLLECTIVE_OPS = {
+    "all-gather": "all_gather",
+    "all-reduce": "all_reduce",
+    "collective-permute": "collective_permute",
+    "reduce-scatter": "reduce_scatter",
+    "all-to-all": "all_to_all",
+}
+
+#: Ops additionally censused inside while-loop regions (per-tick cost).
+SCAN_EXTRA_OPS = {"scan-dynamic-slice": "dynamic_slice"}
+
+#: Keys that are reported but never ceiling-gated: they are floors or
+#: structure facts, not hazards ("aliased-outputs" regressing DOWN is
+#: the hazard — the ``min-aliased-outputs`` budget covers that).
+INFO_KEYS = ("aliased-outputs", "while-loops")
+
+#: Budget key declaring a FLOOR on "aliased-outputs" (the donation
+#: audit's positive half: the r13 serve entry must keep actually
+#: aliasing its donated carry, not merely avoid the warning).
+MIN_ALIASED = "min-aliased-outputs"
+
+DEFAULT_BUDGETS_BASENAME = "jaxlint-budgets.json"
+
+#: jit's lowering-time donation complaint (utils/compile_watch caches
+#: the warning strings alongside the memoized Lowered).
+_DONATION_WARNING = "donated buffers were not usable"
+
+
+def census_keys() -> List[str]:
+    """Every census key, in table order."""
+    keys = list(COLLECTIVE_OPS)
+    keys += [f"scan-{k}" for k in COLLECTIVE_OPS]
+    keys += list(SCAN_EXTRA_OPS)
+    keys += ["f64", "f32-to-f64", "i64-to-f32", "donated-not-aliased"]
+    keys += list(INFO_KEYS)
+    return keys
+
+
+# ---------------------------------------------------------------------------
+# StableHLO module text parsing
+#
+# jax pretty-prints one op per line, so the parser is line-based:
+# function bodies and while-op regions are tracked by per-line brace
+# deltas (with quoted strings stripped first — sharding attributes
+# like ``mhlo.sharding = "{replicated}"`` carry braces inside quotes).
+
+_FUNC_RE = re.compile(
+    r"func\.func\s+(?:public\s+|private\s+)?@([\w$.\-]+)"
+)
+_OP_RE = re.compile(r"\"?(?:stablehlo|mhlo)\.([a-z_0-9]+)")
+_CALL_RE = re.compile(r"(?:func\.call|=\s*call)\s+@([\w$.\-]+)")
+_QUOTED = re.compile(r'"[^"]*"')
+_WHILE_RE = re.compile(r"\"?(?:stablehlo|mhlo)\.while\b")
+#: No leading word boundary: the common spelling is ``tensor<4xf64>``
+#: and ``xf64`` has no \b between the ``x`` and the ``f``.
+_F64 = re.compile(r"(?<!b)f64\b")
+_CONVERT_F32_F64 = re.compile(r"convert.*f32.*->.*f64")
+_CONVERT_I64_F32 = re.compile(r"convert.*i64.*->.*f32")
+_ALIASED = re.compile(r"tf\.aliasing_output")
+
+
+def _brace_delta(line: str) -> int:
+    bare = _QUOTED.sub('""', line)
+    return bare.count("{") - bare.count("}")
+
+
+@dataclass
+class HloFunction:
+    """One ``func.func`` of the lowered module."""
+
+    name: str
+    lines: List[str]
+    ops: Counter = field(default_factory=Counter)
+    calls: List[str] = field(default_factory=list)
+    #: One entry per top-level ``while`` op: the region's lines
+    #: (cond + body — both run per iteration).
+    while_regions: List[List[str]] = field(default_factory=list)
+
+
+def split_functions(text: str) -> Dict[str, HloFunction]:
+    """Carve the module into functions (brace-balanced, line-based)."""
+    funcs: Dict[str, HloFunction] = {}
+    cur: Optional[HloFunction] = None
+    depth = 0
+    for line in text.splitlines():
+        if cur is None:
+            m = _FUNC_RE.search(line)
+            if not m:
+                continue
+            cur = HloFunction(name=m.group(1), lines=[line])
+            depth = _brace_delta(line)
+            if depth <= 0:      # declaration-only (no body)
+                funcs[cur.name] = cur
+                cur = None
+            continue
+        cur.lines.append(line)
+        depth += _brace_delta(line)
+        if depth <= 0:
+            funcs[cur.name] = cur
+            cur = None
+    for fn in funcs.values():
+        _index_function(fn)
+    return funcs
+
+
+def _index_function(fn: HloFunction) -> None:
+    body = fn.lines
+    for line in body:
+        fn.ops.update(_OP_RE.findall(line))
+        fn.calls.extend(_CALL_RE.findall(line))
+    # While regions: from each top-level while op line, consume until
+    # the brace depth returns to its pre-op level (the op's two
+    # regions, ``cond { ... } do { ... }``, balance out).  Nested
+    # whiles are consumed inside the outer region — they stay part of
+    # the outer loop's per-iteration cost and are not double-scanned.
+    i = 0
+    while i < len(body):
+        if not _WHILE_RE.search(body[i]):
+            i += 1
+            continue
+        depth = _brace_delta(body[i])
+        region: List[str] = []
+        opened = depth > 0
+        j = i + 1
+        while j < len(body):
+            d = _brace_delta(body[j])
+            depth += d
+            region.append(body[j])
+            if depth > 0:
+                opened = True
+            if opened and depth <= 0:
+                break
+            j += 1
+        fn.while_regions.append(region)
+        i = j + 1
+
+
+def _closure_ops(
+    funcs: Dict[str, HloFunction], name: str, memo: Dict[str, Counter],
+    active: set,
+) -> Counter:
+    """Op counts of function ``name`` plus everything it transitively
+    calls (cycle-safe).  Callees count once per CALL SITE: a body
+    calling a collective-bearing helper twice pays its collectives
+    twice, and the census must say so."""
+    if name in memo:
+        return memo[name]
+    if name in active or name not in funcs:
+        return Counter()
+    active.add(name)
+    total = Counter(funcs[name].ops)
+    for callee, n_sites in Counter(funcs[name].calls).items():
+        sub = _closure_ops(funcs, callee, memo, active)
+        for op, c in sub.items():
+            total[op] += c * n_sites
+    active.discard(name)
+    memo[name] = total
+    return total
+
+
+def census_of_text(
+    text: str, lowering_warnings: Optional[List[str]] = None
+) -> Dict[str, int]:
+    """The full census of one lowered module's text."""
+    funcs = split_functions(text)
+    counts: Dict[str, int] = {k: 0 for k in census_keys()}
+
+    module_ops: Counter = Counter()
+    for fn in funcs.values():
+        module_ops.update(fn.ops)
+    for key, op in COLLECTIVE_OPS.items():
+        counts[key] = module_ops.get(op, 0)
+    counts["while-loops"] = module_ops.get("while", 0)
+
+    # Scan-body census: direct ops inside every while region, plus the
+    # transitive closure of functions called from inside a region
+    # (scan bodies routinely lower to ``func.call @...``).
+    memo: Dict[str, Counter] = {}
+    loop_ops: Counter = Counter()
+    for fn in funcs.values():
+        for region in fn.while_regions:
+            callees: List[str] = []
+            for line in region:
+                loop_ops.update(_OP_RE.findall(line))
+                callees.extend(_CALL_RE.findall(line))
+            # Once per call SITE: two calls of one helper per
+            # iteration cost its collectives twice per tick.
+            for callee, n_sites in Counter(callees).items():
+                sub = _closure_ops(funcs, callee, memo, set())
+                for op, c in sub.items():
+                    loop_ops[op] += c * n_sites
+    for key, op in COLLECTIVE_OPS.items():
+        counts[f"scan-{key}"] = loop_ops.get(op, 0)
+    for key, op in SCAN_EXTRA_OPS.items():
+        counts[key] = loop_ops.get(op, 0)
+
+    counts["f64"] = len(_F64.findall(text))
+    counts["f32-to-f64"] = sum(
+        1 for ln in text.splitlines() if _CONVERT_F32_F64.search(ln)
+    )
+    counts["i64-to-f32"] = sum(
+        1 for ln in text.splitlines() if _CONVERT_I64_F32.search(ln)
+    )
+    counts["aliased-outputs"] = len(_ALIASED.findall(text))
+    counts["donated-not-aliased"] = sum(
+        w.count("ShapedArray")
+        for w in (lowering_warnings or [])
+        if _DONATION_WARNING in w
+    )
+    return counts
+
+
+def collectives_per_tick(counts: Dict[str, int]) -> int:
+    """The headline per-entry number: collectives inside loop bodies
+    (each fires once per tick of the scanned rollout)."""
+    return sum(counts[f"scan-{k}"] for k in COLLECTIVE_OPS)
+
+
+# ---------------------------------------------------------------------------
+# Lint-entry registry: entry name -> canonical small example invocation
+
+@dataclass(frozen=True)
+class LintSpec:
+    """One watched entry's lint registration."""
+
+    entry: str
+    build: Callable[[], tuple]   # -> (fn, args, kwargs)
+    min_devices: int = 1
+    note: str = ""
+
+
+LINT_REGISTRY: Dict[str, LintSpec] = {}
+
+
+def lint_entry(
+    entry: str, min_devices: int = 1, note: str = ""
+) -> Callable:
+    """Decorator registering a builder of ``entry``'s canonical
+    example invocation.  Builders import lazily and must be cheap on
+    host (eager constructors only — ``jax.eval_shape`` /
+    ``ShapeDtypeStruct`` where a concrete arg would need device
+    execution to produce)."""
+
+    def register(build: Callable[[], tuple]) -> Callable[[], tuple]:
+        if entry in LINT_REGISTRY:
+            raise ValueError(f"duplicate lint entry {entry!r}")
+        LINT_REGISTRY[entry] = LintSpec(
+            entry=entry, build=build, min_devices=min_devices,
+            note=note,
+        )
+        return build
+
+    return register
+
+
+def _rastrigin():
+    from ..ops.objectives import get_objective
+
+    return get_objective("rastrigin")[0]
+
+
+def _swarm_cfg():
+    """The r12 flagship hashgrid config — shared by the rollout, tick
+    and spatial specs so their censuses are comparable."""
+    import distributed_swarm_algorithm_tpu as dsa
+
+    return dsa.SwarmConfig().replace(
+        separation_mode="hashgrid", world_hw=64.0,
+        formation_shape="none", hashgrid_backend="portable",
+        grid_max_per_cell=24, max_speed=1.0, hashgrid_skin=1.0,
+    )
+
+
+def _station(n: int, seed: int = 0):
+    import jax.numpy as jnp
+
+    import distributed_swarm_algorithm_tpu as dsa
+
+    s = dsa.make_swarm(n, seed=seed, spread=64.0 * 0.9)
+    return s.replace(
+        target=jnp.asarray(s.pos),
+        has_target=jnp.ones_like(s.has_target),
+    )
+
+
+@lint_entry("swarm-tick")
+def _spec_swarm_tick():
+    from ..models.swarm import _swarm_tick_impl
+
+    return _swarm_tick_impl, (_station(64), None, _swarm_cfg()), {}
+
+
+@lint_entry("swarm-rollout")
+def _spec_swarm_rollout():
+    from ..models.swarm import _swarm_rollout_impl
+
+    return (
+        _swarm_rollout_impl, (_station(64), None, _swarm_cfg(), 4), {},
+    )
+
+
+@lint_entry(
+    "swarm-rollout-spatial", min_devices=8,
+    note="needs the 8-virtual-device rig (conftest XLA flag)",
+)
+def _spec_swarm_rollout_spatial():
+    import jax
+
+    from ..models.swarm import _swarm_rollout_spatial_impl
+    from ..parallel.mesh import make_mesh
+    from ..parallel.spatial import SPATIAL_AXIS, spatial_shard_swarm
+
+    cfg = _swarm_cfg()
+    mesh = make_mesh((SPATIAL_AXIS,), devices=jax.devices()[:8])
+    tiled, spec = spatial_shard_swarm(_station(512), mesh, cfg)
+    return (
+        _swarm_rollout_spatial_impl,
+        (tiled, None, cfg, 6, mesh, spec), {},
+    )
+
+
+@lint_entry("boids-run")
+def _spec_boids_run():
+    from ..ops.boids import BoidsParams, boids_init, boids_run
+
+    params = BoidsParams()
+    return boids_run, (boids_init(64, params=params), params, 4), {}
+
+
+@lint_entry("island-run")
+def _spec_island_run():
+    from ..parallel.islands import island_init, island_run
+
+    fn = _rastrigin()
+    st = island_init(fn, 4, 16, 4, 5.12, seed=0)
+    return (
+        island_run, (st, fn, 4),
+        {"migrate_every": 2, "migrate_k": 2},
+    )
+
+
+@lint_entry("pso-dimshard", min_devices=8)
+def _spec_pso_dimshard():
+    import jax
+
+    from ..ops.pso import pso_init
+    from ..parallel.dimshard import pso_run_dimshard, shard_pso_dim
+    from ..parallel.mesh import make_mesh
+
+    mesh = make_mesh(("dim",), devices=jax.devices()[:8])
+    st = shard_pso_dim(
+        pso_init(_rastrigin(), n=32, dim=16, half_width=5.12, seed=0),
+        mesh,
+    )
+    return pso_run_dimshard, (st, "rastrigin", mesh, 3), {}
+
+
+@lint_entry("es-dimshard", min_devices=8)
+def _spec_es_dimshard():
+    import jax
+
+    from ..ops.es import es_init
+    from ..parallel.dimshard import es_run_dimshard, shard_es_dim
+    from ..parallel.mesh import make_mesh
+
+    mesh = make_mesh(("dim",), devices=jax.devices()[:8])
+    st = shard_es_dim(
+        es_init(_rastrigin(), dim=16, half_width=5.12, seed=0), mesh
+    )
+    return es_run_dimshard, (st, "rastrigin", mesh, 3), {"n": 16}
+
+
+@lint_entry("pso-shmap", min_devices=8)
+def _spec_pso_shmap():
+    import jax
+
+    from ..ops.pso import pso_init
+    from ..parallel.mesh import make_mesh
+    from ..parallel.sharding import pso_run_shmap, shard_pso
+
+    fn = _rastrigin()
+    mesh = make_mesh(("agents",), devices=jax.devices()[:8])
+    st = shard_pso(pso_init(fn, 64, 4, 5.12, seed=0), mesh)
+    return pso_run_shmap, (st, fn, mesh, 3), {"axis": "agents"}
+
+
+@lint_entry("pso-run")
+def _spec_pso_run():
+    from ..ops.pso import pso_init, pso_run
+
+    fn = _rastrigin()
+    return pso_run, (pso_init(fn, 32, 4, 5.12, seed=0), fn, 3), {}
+
+
+@lint_entry("de-run")
+def _spec_de_run():
+    from ..ops.de import de_init, de_run
+
+    fn = _rastrigin()
+    return de_run, (de_init(fn, 16, 4, 5.12, seed=0), fn, 3), {}
+
+
+@lint_entry("es-run")
+def _spec_es_run():
+    from ..ops.es import es_init, es_run
+
+    fn = _rastrigin()
+    return (
+        es_run, (es_init(fn, dim=4, half_width=5.12, seed=0), fn, 3),
+        {"n": 16},
+    )
+
+
+@lint_entry("gwo-run")
+def _spec_gwo_run():
+    from ..ops.gwo import gwo_init, gwo_run
+
+    fn = _rastrigin()
+    return gwo_run, (gwo_init(fn, 32, 4, 5.12, seed=0), fn, 3), {}
+
+
+def _serve_cfg():
+    import distributed_swarm_algorithm_tpu as dsa
+
+    return dsa.SwarmConfig().replace(
+        formation_shape="none", utility_threshold=2.0,
+        election_timeout_ticks=10, heartbeat_period_ticks=5,
+    )
+
+
+@lint_entry("serve-materialize")
+def _spec_serve_materialize():
+    import jax.numpy as jnp
+
+    from ..serve.batched import _materialize_batch_impl
+
+    S, cap = 2, 8
+    return (
+        _materialize_batch_impl,
+        (
+            jnp.zeros((S,), jnp.int32),
+            jnp.full((S,), 8.0, jnp.float32),
+            jnp.ones((S, cap), bool),
+            jnp.zeros((S,), bool),
+            jnp.zeros((S, 2), jnp.float32),
+            jnp.zeros((S, 0, 2), jnp.float32),
+            cap,
+            0,
+        ),
+        {},
+    )
+
+
+@lint_entry("serve-batched-rollout")
+def _spec_serve_batched_rollout():
+    import jax
+    import jax.numpy as jnp
+
+    from ..serve.batched import (
+        _materialize_batch_impl,
+        scenario_params,
+        stack_params,
+    )
+
+    cfg = _serve_cfg()
+    S, cap = 2, 8
+    # The donated states arg rides as ShapeDtypeStructs (lower()
+    # accepts avals) — materializing for real would EXECUTE the
+    # materializer, and jaxlint never executes.  Statics are bound
+    # via partial: eval_shape abstracts every positional arg.
+    import functools
+
+    states = jax.eval_shape(
+        functools.partial(
+            _materialize_batch_impl, capacity=cap, n_tasks=0
+        ),
+        jnp.zeros((S,), jnp.int32),
+        jnp.full((S,), 8.0, jnp.float32),
+        jnp.ones((S, cap), bool),
+        jnp.zeros((S,), bool),
+        jnp.zeros((S, 2), jnp.float32),
+        jnp.zeros((S, 0, 2), jnp.float32),
+    )
+    params = stack_params([scenario_params(cfg), scenario_params(cfg)])
+    from ..serve.batched import _batched_rollout_impl
+
+    return _batched_rollout_impl, (states, params, cfg, 6), {}
+
+
+@lint_entry("env-rollout")
+def _spec_env_rollout():
+    import jax
+
+    from .. import envs
+
+    cfg = _serve_cfg()
+    env = envs.SwarmMARLEnv(
+        cfg=cfg, capacity=24, n_tasks=2, n_obstacles=2, k_neighbors=4,
+        obs_max_per_cell=24,
+    )
+    from ..envs.core import _env_rollout_impl
+
+    p = envs.stack_env_params(
+        [envs.station_keeping(env, n_agents=20)]
+    )
+    keys = jax.random.PRNGKey(7)[None]
+    return _env_rollout_impl, (keys, p, env, 8), {}
+
+
+# ---------------------------------------------------------------------------
+# Auditing
+
+@dataclass
+class EntryAudit:
+    """One registry entry's measured census (or skip reason)."""
+
+    entry: str
+    signature: str = ""          # short fingerprint of the example args
+    counts: Dict[str, int] = field(default_factory=dict)
+    skipped: str = ""            # non-empty: why the entry did not lower
+
+    def to_dict(self) -> dict:
+        return {
+            "entry": self.entry,
+            "signature": self.signature,
+            "counts": dict(self.counts),
+            "skipped": self.skipped,
+            "collectives_per_tick": (
+                collectives_per_tick(self.counts) if self.counts else None
+            ),
+        }
+
+
+def _sig_hash(args: tuple, kwargs: dict) -> str:
+    from ..utils.compile_watch import arg_signature
+
+    return hashlib.sha256(
+        arg_signature(args, kwargs).encode()
+    ).hexdigest()[:12]
+
+
+def census_of(fn: Callable, *args, **kwargs) -> Dict[str, int]:
+    """Census an arbitrary jitted callable + example args (the API the
+    migrated HLO-grep tests and seeded fixtures use).  Lowering is
+    memoized through the global compile observatory."""
+    from ..utils.compile_watch import WATCH
+
+    lowered, warns = WATCH.lower_cached(fn, *args, **kwargs)
+    return census_of_text(lowered.as_text(), warns)
+
+
+def audit_entry(name: str) -> EntryAudit:
+    """Lower + census one registered entry (memoized per process via
+    the observatory's lowering cache)."""
+    import jax
+
+    spec = LINT_REGISTRY[name]
+    if len(jax.devices()) < spec.min_devices:
+        return EntryAudit(
+            entry=name,
+            skipped=(
+                f"needs {spec.min_devices} devices, have "
+                f"{len(jax.devices())}"
+                + (f" ({spec.note})" if spec.note else "")
+            ),
+        )
+    fn, args, kwargs = spec.build()
+    counts = census_of(fn, *args, **kwargs)
+    return EntryAudit(
+        entry=name, signature=_sig_hash(args, kwargs), counts=counts,
+    )
+
+
+def entry_census(name: str) -> Dict[str, int]:
+    """The census dict of one registered entry (raises on skip — a
+    caller asserting a collective contract must not pass vacuously)."""
+    audit = audit_entry(name)
+    if audit.skipped:
+        raise RuntimeError(
+            f"jaxlint entry {name!r} not lintable here: {audit.skipped}"
+        )
+    return audit.counts
+
+
+# ---------------------------------------------------------------------------
+# Budget ledger (jaxlint-budgets.json)
+
+#: Repo root = three levels up (package/analysis/jaxlint.py).
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+@dataclass(frozen=True)
+class BudgetEntry:
+    entry: str
+    signature: str
+    budgets: Dict[str, int]
+    justification: str
+
+    def to_dict(self) -> dict:
+        return {
+            "entry": self.entry,
+            "signature": self.signature,
+            "budgets": dict(self.budgets),
+            "justification": self.justification,
+        }
+
+
+class BudgetError(ValueError):
+    """Malformed budgets file."""
+
+
+def load_budgets(path: str) -> Dict[str, BudgetEntry]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        try:
+            data = json.load(f)
+        except json.JSONDecodeError as e:
+            raise BudgetError(f"{path}: not valid JSON: {e}") from e
+    out: Dict[str, BudgetEntry] = {}
+    for i, raw in enumerate(data.get("entries", [])):
+        missing = [
+            k for k in ("entry", "signature", "budgets", "justification")
+            if k not in raw
+        ]
+        if missing:
+            raise BudgetError(f"{path}: entry {i} missing {missing}")
+        if not str(raw["justification"]).strip():
+            raise BudgetError(
+                f"{path}: entry {i} ({raw['entry']}) has an empty "
+                "justification — declared budgets must say why the "
+                "counts are the contract"
+            )
+        bad = [
+            k for k in raw["budgets"]
+            if k != MIN_ALIASED and k not in census_keys()
+        ]
+        if bad:
+            raise BudgetError(
+                f"{path}: entry {i} ({raw['entry']}) budgets unknown "
+                f"census key(s) {bad}"
+            )
+        out[raw["entry"]] = BudgetEntry(
+            entry=raw["entry"],
+            signature=str(raw["signature"]),
+            budgets={k: int(v) for k, v in raw["budgets"].items()},
+            justification=str(raw["justification"]),
+        )
+    return out
+
+
+def save_budgets(path: str, entries: Dict[str, BudgetEntry]) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(
+            {
+                "entries": [
+                    entries[k].to_dict() for k in sorted(entries)
+                ]
+            },
+            f, indent=1, sort_keys=True,
+        )
+        f.write("\n")
+
+
+def budget_from_audit(
+    audit: EntryAudit, justification: str
+) -> BudgetEntry:
+    """A ledger entry pinning the audit's measured counts (nonzero
+    gated keys only — zero is the default ceiling)."""
+    budgets = {
+        k: v for k, v in audit.counts.items()
+        if v and k not in INFO_KEYS
+    }
+    if audit.counts.get("aliased-outputs"):
+        budgets[MIN_ALIASED] = audit.counts["aliased-outputs"]
+    return BudgetEntry(
+        entry=audit.entry, signature=audit.signature,
+        budgets=budgets, justification=justification,
+    )
+
+
+@dataclass(frozen=True)
+class LintFinding:
+    """One budget/contract violation at one entry."""
+
+    entry: str
+    check: str                   # census key, or a lifecycle check id
+    message: str
+    measured: Optional[int] = None
+    budget: Optional[int] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "entry": self.entry,
+            "check": self.check,
+            "message": self.message,
+            "measured": self.measured,
+            "budget": self.budget,
+        }
+
+    def render(self) -> str:
+        return f"{self.entry}: [{self.check}] {self.message}"
+
+
+def check_against_budget(
+    audit: EntryAudit, entry: Optional[BudgetEntry]
+) -> List[LintFinding]:
+    """Findings for one audited entry vs its declared budgets."""
+    findings: List[LintFinding] = []
+    if entry is None:
+        findings.append(
+            LintFinding(
+                entry=audit.entry, check="undeclared",
+                message=(
+                    "no declared budget — every registered entry "
+                    "must declare its census contract (run "
+                    "`cli jaxlint --write-budgets`, then edit the "
+                    "justification)"
+                ),
+            )
+        )
+        return findings
+    if entry.signature != audit.signature:
+        findings.append(
+            LintFinding(
+                entry=audit.entry, check="signature-stale",
+                message=(
+                    f"example-program signature {audit.signature} != "
+                    f"declared {entry.signature} — the entry's "
+                    "canonical invocation changed shape; re-measure "
+                    "and re-declare (`--write-budgets`), budgets must "
+                    "never gate a different program"
+                ),
+            )
+        )
+        # Signature drift does NOT short-circuit the count checks:
+        # a refactor that both reshapes the example AND regresses a
+        # collective must surface both facts.
+    for key, measured in audit.counts.items():
+        if key in INFO_KEYS:
+            continue
+        budget = entry.budgets.get(key, 0)
+        if measured > budget:
+            findings.append(
+                LintFinding(
+                    entry=audit.entry, check=key,
+                    measured=measured, budget=budget,
+                    message=(
+                        f"{key} count {measured} exceeds the declared "
+                        f"budget {budget}"
+                        + (
+                            " — a collective crept into the lowered "
+                            "program"
+                            if key in COLLECTIVE_OPS
+                            or key.startswith("scan-")
+                            else ""
+                        )
+                    ),
+                )
+            )
+    floor = entry.budgets.get(MIN_ALIASED)
+    if floor is not None:
+        got = audit.counts.get("aliased-outputs", 0)
+        if got < floor:
+            findings.append(
+                LintFinding(
+                    entry=audit.entry, check=MIN_ALIASED,
+                    measured=got, budget=floor,
+                    message=(
+                        f"only {got} aliased output buffers, floor "
+                        f"{floor} — donation regressed to copies "
+                        "(the r13 double-buffer contract)"
+                    ),
+                )
+            )
+    return findings
+
+
+@dataclass
+class AuditResult:
+    audits: List[EntryAudit]
+    findings: List[LintFinding]
+    stale: List[str]             # ledger entries with no registry entry
+    skipped: List[EntryAudit]
+
+    def to_dict(self) -> dict:
+        return {
+            "tool": "jaxlint",
+            "counts": {
+                "entries": len(self.audits),
+                "findings": len(self.findings),
+                "stale_budget": len(self.stale),
+                "skipped": len(self.skipped),
+            },
+            "findings": [f.to_dict() for f in self.findings],
+            "stale_budget": list(self.stale),
+            "entries": [a.to_dict() for a in self.audits],
+            "skipped": [a.to_dict() for a in self.skipped],
+        }
+
+
+def run_audit(
+    entries: Optional[List[str]] = None,
+    budgets_path: Optional[str] = None,
+) -> AuditResult:
+    """Audit ``entries`` (default: the whole registry) against the
+    declared budgets.  Stale ledger entries only prove stale on a
+    full-registry run (the swarmlint scoped-scan rule)."""
+    names = list(entries) if entries else sorted(LINT_REGISTRY)
+    unknown = [n for n in names if n not in LINT_REGISTRY]
+    if unknown:
+        raise KeyError(
+            f"unknown lint entr{'y' if len(unknown) == 1 else 'ies'} "
+            f"{unknown}; registered: {sorted(LINT_REGISTRY)}"
+        )
+    path = budgets_path or os.path.join(
+        REPO_ROOT, DEFAULT_BUDGETS_BASENAME
+    )
+    declared = load_budgets(path)
+    audits: List[EntryAudit] = []
+    skipped: List[EntryAudit] = []
+    findings: List[LintFinding] = []
+    for name in names:
+        audit = audit_entry(name)
+        if audit.skipped:
+            skipped.append(audit)
+            continue
+        audits.append(audit)
+        findings.extend(
+            check_against_budget(audit, declared.get(name))
+        )
+    stale: List[str] = []
+    if not entries:   # full run: absence from the REGISTRY proves
+        # staleness (skipped entries are still registered — a budget
+        # for an entry this host cannot lower is not stale debt)
+        stale = sorted(e for e in declared if e not in LINT_REGISTRY)
+        for e in stale:
+            findings.append(
+                LintFinding(
+                    entry=e, check="stale-budget",
+                    message=(
+                        "budget declared for an entry that is no "
+                        "longer registered — remove it from "
+                        f"{DEFAULT_BUDGETS_BASENAME}"
+                    ),
+                )
+            )
+    return AuditResult(
+        audits=audits, findings=findings, stale=stale, skipped=skipped,
+    )
+
+
+# ---------------------------------------------------------------------------
+# CLI (dispatched from cli.py's ``jaxlint`` subcommand)
+
+
+def main_cli(args) -> int:
+    """Exit 0 clean, 1 findings/stale budgets, 2 usage error."""
+    budgets_path = args.budgets or os.path.join(
+        REPO_ROOT, DEFAULT_BUDGETS_BASENAME
+    )
+    if args.list_entries:
+        for name in sorted(LINT_REGISTRY):
+            spec = LINT_REGISTRY[name]
+            extra = (
+                f"  (min {spec.min_devices} devices)"
+                if spec.min_devices > 1 else ""
+            )
+            print(f"{name:24}{extra}")
+        return 0
+    import sys
+
+    try:
+        result = run_audit(
+            entries=args.entries or None, budgets_path=budgets_path
+        )
+    except (KeyError, BudgetError) as e:
+        # KeyError str() is the quoted repr of its arg — unwrap it.
+        msg = e.args[0] if isinstance(e, KeyError) and e.args else e
+        print(f"jaxlint: {msg}", file=sys.stderr)
+        return 2 if isinstance(e, KeyError) else 1
+
+    if args.write_budgets:
+        declared = load_budgets(budgets_path)
+        for audit in result.audits:
+            prev = declared.get(audit.entry)
+            just = (
+                prev.justification
+                if prev is not None
+                and not prev.justification.startswith("TODO(")
+                else "TODO(jaxlint): justify the measured counts"
+            )
+            declared[audit.entry] = budget_from_audit(audit, just)
+        for name in result.stale:
+            declared.pop(name, None)
+        save_budgets(budgets_path, declared)
+        print(
+            f"jaxlint: wrote {len(declared)} entries to "
+            f"{budgets_path} (edit the TODO justifications)"
+        )
+        return 0
+
+    if args.as_json:
+        print(json.dumps(result.to_dict(), indent=1))
+    else:
+        if args.census:
+            keys = [
+                k for k in census_keys() if any(
+                    a.counts.get(k) for a in result.audits
+                )
+            ]
+            for audit in result.audits:
+                row = ", ".join(
+                    f"{k}={audit.counts[k]}" for k in keys
+                    if audit.counts.get(k)
+                ) or "no collectives / clean"
+                print(
+                    f"{audit.entry:24} per-tick="
+                    f"{collectives_per_tick(audit.counts):<3} {row}"
+                )
+        for f in result.findings:
+            print(f.render())
+        for a in result.skipped:
+            print(f"# skipped: {a.entry} ({a.skipped})")
+        print(
+            f"# jaxlint: {len(result.findings)} finding(s), "
+            f"{len(result.audits)} entr"
+            f"{'y' if len(result.audits) == 1 else 'ies'} audited, "
+            f"{len(result.skipped)} skipped, "
+            f"{len(result.stale)} stale budget entr"
+            f"{'y' if len(result.stale) == 1 else 'ies'}"
+        )
+    return 1 if result.findings else 0

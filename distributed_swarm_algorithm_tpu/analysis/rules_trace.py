@@ -41,6 +41,12 @@
   auto-reset hazard (ConcretizationError on-chip, or a per-boolean
   retrace); the sanctioned pattern is the ``jnp.where``-select
   auto-reset (envs/core.py).
+- ``cond-collective``: a collective (``ppermute``/``psum``/``pmax``)
+  reachable inside a ``lax.cond`` branch under shard_map without a
+  mesh-uniform predicate nearby — collectives rendezvous across the
+  mesh, so devices disagreeing on the branch DEADLOCK (the r12
+  rebuild hazard); the sanctioned pattern OR-reduces the trigger
+  first (``lax.pmax(flag, axis) > 0``, parallel/spatial.py).
 """
 
 from __future__ import annotations
@@ -698,6 +704,190 @@ class HaloWidthRule(Rule):
                     "(band depth personal_space + skin) before "
                     "consuming a per-shard plan",
                 )
+
+
+# ---------------------------------------------------------------------------
+# cond-collective
+
+#: Collective leaves whose presence inside a cond branch means the
+#: branch RENDEZVOUSES: every device must take the same branch or the
+#: program deadlocks.
+_COND_COLLECTIVES = frozenset(
+    {"ppermute", "pshuffle", "psum", "pmax", "pmin", "pmean",
+     "all_gather", "psum_scatter", "all_to_all"}
+)
+
+#: Reduction leaves that make a predicate mesh-uniform: every device
+#: computes the same value because the value IS a mesh reduction.
+_MESH_REDUCE = frozenset(
+    {"psum", "pmax", "pmin", "pmean", "all_gather", "psum_scatter"}
+)
+
+
+def _collect_collectives(mod, fn, by_name):
+    """Collective call leaves reachable from ``fn`` through its
+    local-call closure (the halo-width walk)."""
+    found: list = []
+    seen: set = set()
+    frontier = [fn]
+    while frontier:
+        cur = frontier.pop()
+        if id(cur) in seen:
+            continue
+        seen.add(id(cur))
+        stmts = cur.body if isinstance(cur.body, list) else [cur.body]
+        for st in stmts:
+            for node in ast.walk(st):
+                if not isinstance(node, ast.Call):
+                    continue
+                leaf = (mod.resolve(node.func) or "").rsplit(".", 1)[-1]
+                if leaf in _COND_COLLECTIVES:
+                    found.append(leaf)
+                if isinstance(node.func, ast.Name):
+                    frontier.extend(by_name.get(node.func.id, []))
+    return found
+
+
+def _expr_has_mesh_reduce(mod, expr) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            leaf = (mod.resolve(node.func) or "").rsplit(".", 1)[-1]
+            if leaf in _MESH_REDUCE:
+                return True
+    return False
+
+
+def _predicate_is_uniform(mod, cond_call) -> bool:
+    """True when the cond's predicate is visibly mesh-uniform: the
+    predicate expression contains a mesh reduction, or a Name in it
+    was LAST assigned (lexically, before the cond) from one in the
+    cond's enclosing function — the ``stale_any = lax.pmax(...) > 0``
+    idiom (parallel/spatial.py).  Only the latest assignment counts:
+    an earlier pmax re-assigned to a per-shard value before the cond
+    is exactly the deadlock this rule exists to flag."""
+    pred = cond_call.args[0] if cond_call.args else None
+    if pred is None:
+        return False
+    if _expr_has_mesh_reduce(mod, pred):
+        return True
+    names = {
+        n.id for n in ast.walk(pred) if isinstance(n, ast.Name)
+    }
+    if not names:
+        return False
+    enclosing = None
+    for anc in mod.ancestors(cond_call):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            enclosing = anc
+            break
+    if enclosing is None:
+        return False
+    # name -> (lineno of latest assignment before the cond, uniform?)
+    latest: dict = {}
+    for node in ast.walk(enclosing):
+        targets: list = []
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets, value = [node.target], node.value
+        else:
+            continue
+        if value is None or node.lineno >= cond_call.lineno:
+            continue
+        uniform = _expr_has_mesh_reduce(mod, value)
+        for tgt in targets:
+            for n in ast.walk(tgt):
+                if isinstance(n, ast.Name) and n.id in names:
+                    prev = latest.get(n.id)
+                    if prev is None or node.lineno >= prev[0]:
+                        latest[n.id] = (node.lineno, uniform)
+    return any(uniform for _, uniform in latest.values())
+
+
+@register
+class CondCollectiveRule(Rule):
+    id = "cond-collective"
+    summary = "collective inside a lax.cond branch without a uniform predicate"
+    details = (
+        "Inside shard_map, every device must agree on which lax.cond "
+        "branch runs when the branch holds a collective (ppermute/"
+        "psum/pmax rendezvous across the mesh): a per-shard predicate "
+        "sends devices down different branches and the collective "
+        "DEADLOCKS — the r12 rebuild hazard.  OR/AND-reduce the "
+        "trigger across the mesh first (`lax.pmax(flag, axis) > 0`, "
+        "parallel/spatial.py) so the predicate is mesh-uniform by "
+        "construction."
+    )
+
+    def check(self, mod: ModuleInfo):
+        bodies, by_name = _shard_map_bodies(mod)
+        seen_sites: set = set()
+        for body in bodies:
+            # Every function reachable from the shard_map body runs
+            # per shard — a cond anywhere in that closure is a
+            # per-shard branch decision.
+            reach: list = []
+            seen_fns: set = set()
+            frontier = [body]
+            while frontier:
+                cur = frontier.pop()
+                if id(cur) in seen_fns:
+                    continue
+                seen_fns.add(id(cur))
+                reach.append(cur)
+                stmts = (
+                    cur.body if isinstance(cur.body, list)
+                    else [cur.body]
+                )
+                for st in stmts:
+                    for node in ast.walk(st):
+                        if isinstance(node, ast.Call) and isinstance(
+                            node.func, ast.Name
+                        ):
+                            frontier.extend(
+                                by_name.get(node.func.id, [])
+                            )
+            for fn in reach:
+                stmts = (
+                    fn.body if isinstance(fn.body, list) else [fn.body]
+                )
+                for st in stmts:
+                    for node in ast.walk(st):
+                        if not isinstance(node, ast.Call):
+                            continue
+                        name = mod.resolve(node.func) or ""
+                        if name.rsplit(".", 1)[-1] != "cond":
+                            continue
+                        branch_fns: list = []
+                        for arg in node.args[1:3]:
+                            if isinstance(arg, ast.Lambda):
+                                branch_fns.append(arg)
+                            elif isinstance(arg, ast.Name):
+                                branch_fns.extend(
+                                    by_name.get(arg.id, [])
+                                )
+                        hot: list = []
+                        for bf in branch_fns:
+                            hot.extend(
+                                _collect_collectives(mod, bf, by_name)
+                            )
+                        if not hot:
+                            continue
+                        if _predicate_is_uniform(mod, node):
+                            continue
+                        site = (node.lineno, node.col_offset)
+                        if site in seen_sites:
+                            continue
+                        seen_sites.add(site)
+                        yield mod.finding(
+                            self.id, node,
+                            f"lax.cond branch holds collective(s) "
+                            f"{sorted(set(hot))} under shard_map but "
+                            "the predicate is not visibly "
+                            "mesh-uniform — reduce the trigger "
+                            "across the mesh first (`lax.pmax(flag, "
+                            "axis) > 0`) or the rendezvous deadlocks",
+                        )
 
 
 # ---------------------------------------------------------------------------

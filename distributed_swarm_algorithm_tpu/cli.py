@@ -781,6 +781,39 @@ def _cmd_scope_history(args) -> int:
     return 0
 
 
+def _cmd_jaxlint(args) -> int:
+    """``jaxlint``: the trace/HLO-level program auditor (r15) —
+    lower every ``compile_watch.watched()`` registry entry (no
+    backend execution) and check its collective/donation/dtype census
+    against the declared budgets in ``jaxlint-budgets.json``.  See
+    docs/STATIC_ANALYSIS.md."""
+    import os
+
+    # The mesh entries (spatial tick, shmap/dimshard drivers) need
+    # the 8-virtual-device rig, and the audit must never dial a real
+    # chip just to *lower*.  jax is already imported (the package
+    # import pulls it in) but its BACKEND is not initialized until
+    # the first devices() call, and XLA_FLAGS is read at client
+    # creation — so pinning env + live config here still lands, the
+    # conftest pattern.  If a backend is somehow already live with
+    # fewer devices, the mesh entries skip and say so.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    from .analysis import jaxlint
+
+    return jaxlint.main_cli(args)
+
+
 def _cmd_bench(args) -> int:
     # bench.py lives at the repo root (a driver contract), outside the
     # package — resolve it relative to this file so the subcommand works
@@ -1060,6 +1093,35 @@ def build_parser() -> argparse.ArgumentParser:
 
     p_bench = sub.add_parser("bench", help="headline benchmark")
     p_bench.set_defaults(fn=_cmd_bench)
+
+    p_jl = sub.add_parser(
+        "jaxlint",
+        help="trace/HLO-level program auditor: lower every watched "
+             "registry entry (no backend execution) and gate its "
+             "collective/donation/dtype census against "
+             "jaxlint-budgets.json (r15; see docs/STATIC_ANALYSIS.md)",
+    )
+    p_jl.add_argument(
+        "entries", nargs="*",
+        help="registry entries to audit (default: all; stale-budget "
+             "detection only runs on the full audit)",
+    )
+    p_jl.add_argument("--json", action="store_true", dest="as_json",
+                      help="machine-readable summary on stdout")
+    p_jl.add_argument("--census", action="store_true",
+                      help="print the per-entry census table")
+    p_jl.add_argument(
+        "--budgets", default=None,
+        help="budgets file (default <repo>/jaxlint-budgets.json)",
+    )
+    p_jl.add_argument(
+        "--write-budgets", action="store_true",
+        help="pin the measured censuses as declared budgets (keeps "
+             "existing justifications; new entries get TODOs to edit)",
+    )
+    p_jl.add_argument("--list-entries", action="store_true",
+                      help="list registered lint entries")
+    p_jl.set_defaults(fn=_cmd_jaxlint)
 
     p_scope = sub.add_parser(
         "swarmscope",

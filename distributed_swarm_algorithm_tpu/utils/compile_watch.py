@@ -172,6 +172,14 @@ class WatchedFunction:
         flops = nbytes = None
         if watch.cost_analysis:
             try:
+                # Deliberately NOT lower_cached: the dispatch path
+                # sees a new signature per compile, and pinning one
+                # full Lowered module per (entry, signature) forever
+                # would be a slow leak in long-lived enabled
+                # processes.  The transient lowering here is the
+                # pre-r15 behavior; the memoized path serves the
+                # analyze()/jaxlint side, whose key set is bounded
+                # by the lint registry.
                 flops, nbytes = _cost_analysis(
                     self.__wrapped__.lower(*args, **kwargs)
                 )
@@ -211,6 +219,19 @@ class CompileWatch:
         #: layer's bucket lattice).  Declarations survive reset() —
         #: the budget is a property of the entry, not of one run.
         self._bucket_budgets: Dict[str, int] = {}
+        #: (entry, signature) -> (fn, Lowered, [warning strings]) —
+        #: the memoized lowering cache (r15).  ``analyze()`` used to
+        #: re-trace + re-lower on EVERY call, which made linting the
+        #: full registry (analysis/jaxlint.py) pay the trace cost per
+        #: check instead of per entry; a lowering is a pure function
+        #: of the (function, signature) pair, so it is cached like
+        #: one.  The function rides in the value as an identity
+        #: guard: entry names for UNregistered callables are bare
+        #: ``__name__``s, and two distinct same-named functions with
+        #: identical arg shapes must not share a lowering.  Survives
+        #: reset(): resetting the *observation* ledger must not throw
+        #: away lowerings that are still valid.
+        self._lowered: Dict[tuple, tuple] = {}
 
     # -- lifecycle ---------------------------------------------------------
     def enable(self) -> "CompileWatch":
@@ -226,6 +247,13 @@ class CompileWatch:
         self.events.clear()
         self._sigs.clear()
         self._warned.clear()
+
+    def clear_lowered(self) -> None:
+        """Drop the memoized lowering cache (kept out of ``reset()``:
+        lowerings are pure in the (entry, signature) key, so clearing
+        the observation ledger does not invalidate them — but tests
+        exercising the cache lifecycle need an explicit drop)."""
+        self._lowered.clear()
 
     # -- bucket budgets (r13) ----------------------------------------------
     def declare_buckets(self, entry: str, max_entries: int) -> None:
@@ -361,11 +389,46 @@ class CompileWatch:
         jitted def."""
         return lambda fn: self.wrap(entry, fn)
 
+    def lower_cached(self, fn: Callable, *args, **kwargs) -> tuple:
+        """``(Lowered, [warning strings])`` for one entry + example
+        args, memoized per (entry, signature) — the r15 fix for
+        ``analyze()`` re-tracing on every call (linting the full
+        registry in tier-1 pays each trace once per entry, not once
+        per check).  Lowering warnings (e.g. jit's "Some donated
+        buffers were not usable", the donation-audit signal in
+        analysis/jaxlint.py) only fire on the first, uncached lower,
+        so they are captured and cached alongside the ``Lowered``."""
+        entry = getattr(fn, "entry", None) or getattr(
+            fn, "__name__", repr(fn)
+        )
+        key = (entry, arg_signature(args, kwargs))
+        # A WatchedFunction delegates .lower to its wrapped jit; a
+        # bare jit has it directly.  Only unwrap as a fallback: jit
+        # itself sets functools-style ``__wrapped__`` to the UNJITTED
+        # function, which has no .lower.
+        inner = (
+            fn if hasattr(fn, "lower")
+            else getattr(fn, "__wrapped__", fn)
+        )
+        hit = self._lowered.get(key)
+        if hit is None or hit[0] is not inner:
+            # Identity mismatch = a DIFFERENT same-named function
+            # with the same shapes (bare-__name__ entries): its
+            # lowering must not be shared — recompute and replace.
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                lowered = inner.lower(*args, **kwargs)
+            hit = (inner, lowered, [str(w.message) for w in caught])
+            self._lowered[key] = hit
+        return hit[1], hit[2]
+
     def analyze(self, fn: Callable, *args, **kwargs) -> CompileRecord:
         """Cost-analyze one entry WITHOUT executing or compiling it:
         ``lower(...).cost_analysis()`` only (measured ~1.6 s at the
-        65k rollout on CPU).  Records under the entry's registry name
-        (``WatchedFunction``) or ``__name__``.
+        65k rollout on CPU; the lowering itself is memoized per
+        (entry, signature) — see :meth:`lower_cached`).  Records
+        under the entry's registry name (``WatchedFunction``) or
+        ``__name__``.
 
         Analysis records carry ``seq=0`` and deliberately do NOT
         enter the dispatch ledger: nothing compiled, so the entry's
@@ -375,8 +438,8 @@ class CompileWatch:
         entry = getattr(fn, "entry", None) or getattr(
             fn, "__name__", repr(fn)
         )
-        inner = getattr(fn, "__wrapped__", fn)
-        flops, nbytes = _cost_analysis(inner.lower(*args, **kwargs))
+        lowered, _ = self.lower_cached(fn, *args, **kwargs)
+        flops, nbytes = _cost_analysis(lowered)
         rec = CompileRecord(
             entry=entry, signature=arg_signature(args, kwargs),
             seq=0, wall_s=None, flops=flops, bytes_accessed=nbytes,

@@ -23,7 +23,8 @@ trajectory.
 The gating rules here MUST stay in lockstep with
 ``benchmarks/compare.py`` (the union gate): units ``findings`` /
 ``rounds`` / ``events`` / ``ticks`` / ``compiles`` / ``bytes`` (r12 —
-halo-exchange traffic) are lower-is-better
+halo-exchange traffic) / ``collectives`` (r15 — jaxlint's per-entry
+scan-body collective census) are lower-is-better
 counts (a clean 0 baseline regressing to any positive count always
 gates), unit ``pct`` gates against the absolute :data:`PCT_CEILING`
 and unit ``overhead-pct`` against :data:`OVERHEAD_PCT_CEILING`
@@ -53,7 +54,7 @@ COMPILE_DIR = "compile"
 
 #: Lower-is-better count units (mirror of compare.py's tuple).
 COUNT_UNITS = ("findings", "rounds", "events", "ticks", "compiles",
-               "bytes")
+               "bytes", "collectives")
 
 #: Absolute ceiling for unit-"pct" metrics (compare.PCT_CEILING).
 PCT_CEILING = 5.0

@@ -151,6 +151,63 @@ def test_summary_and_dump_roundtrip(watch, tmp_path):
     assert len(loaded["records"]) == 2
 
 
+def test_lower_cached_memoizes_per_entry_signature(watch):
+    # r15: analyze() used to re-trace + re-lower on EVERY call; the
+    # lowering is a pure function of (entry, signature), so the
+    # second analyze of the same example args must hit the cache —
+    # the thing that keeps jaxlint's full-registry tier-1 sweep
+    # inside the budget.
+    calls = {"n": 0}
+
+    @watch.watched("memo-entry")
+    @jax.jit
+    def toy(x):
+        calls["n"] += 1          # trace-time counter: fires per trace
+        return x * 2.0
+
+    low1, warns1 = watch.lower_cached(toy, jnp.ones((4,)))
+    low2, warns2 = watch.lower_cached(toy, jnp.ones((4,)))
+    assert low1 is low2 and warns1 is warns2
+    assert calls["n"] == 1                   # traced exactly once
+    low3, _ = watch.lower_cached(toy, jnp.ones((8,)))
+    assert low3 is not low1                  # distinct signature
+    assert calls["n"] == 2
+    rec_a = watch.analyze(toy, jnp.ones((4,)))
+    rec_b = watch.analyze(toy, jnp.ones((4,)))
+    assert calls["n"] == 2                   # analyze rode the cache
+    assert rec_a.flops == rec_b.flops
+    # reset() clears observations but NOT the lowering cache (still
+    # valid); clear_lowered() is the explicit drop (after which the
+    # cache repopulates — jax's own jit trace cache may still serve
+    # the retrace, so only the map size is asserted).
+    watch.reset()
+    watch.lower_cached(toy, jnp.ones((4,)))
+    assert calls["n"] == 2
+    assert len(watch._lowered) == 2
+    watch.clear_lowered()
+    assert len(watch._lowered) == 0
+    watch.lower_cached(toy, jnp.ones((4,)))
+    assert len(watch._lowered) == 1
+
+
+def test_lower_cached_captures_donation_warnings(watch):
+    # The donation-audit signal (analysis/jaxlint.py): jit's "Some
+    # donated buffers were not usable" fires at the first lowering
+    # only — the cache must hand it back on every hit.
+    @watch.watched("donate-entry")
+    @partial(jax.jit, donate_argnums=(0,))
+    def bad_donate(x):
+        return (x[:2] * 2.0,)    # shape mismatch: cannot alias
+
+    for _ in range(2):
+        _, warns = watch.lower_cached(
+            bad_donate, jnp.zeros((4,), jnp.float32)
+        )
+        assert any(
+            "donated buffers were not usable" in w for w in warns
+        )
+
+
 def test_global_watch_default_disabled_for_suite():
     # The repo's wrapped entry points ride the global WATCH: the test
     # suite must not be paying signature bookkeeping unless a test

@@ -1,0 +1,469 @@
+"""jaxlint (r15, analysis/jaxlint.py): the trace/HLO-level auditor.
+
+Four layers:
+
+- the tier-1 gate: every ``compile_watch.watched()`` registry entry
+  lowers (no backend execution) and its collective/donation/dtype
+  census fits the budgets declared in ``jaxlint-budgets.json`` — so a
+  refactor that slips an all-gather into the spatial tick, unpacks
+  the r11 packed telemetry reduction, or un-aliases the r13 donated
+  carry fails here, not on-chip;
+- seeded regressions: a tampered spatial tick WITH an all-gather (and
+  a toy per-tick all-reduce) must be caught by the census gate;
+- the budget-ledger lifecycle: undeclared entries, stale entries,
+  signature drift, malformed files;
+- the StableHLO text parser: while-region extraction, ``func.call``
+  closure following, quoted-brace robustness, donation/dtype signals.
+
+The lowerings are memoized process-wide (CompileWatch.lower_cached),
+so the full-registry tests after the first cost parse time only.
+Runs on the 8-virtual-CPU-device rig (conftest pins the XLA flag).
+"""
+
+from __future__ import annotations
+
+import functools
+import importlib.util
+import json
+import os
+import textwrap
+from functools import partial
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_swarm_algorithm_tpu.analysis import jaxlint
+from distributed_swarm_algorithm_tpu.utils import rundir
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUDGETS = os.path.join(ROOT, jaxlint.DEFAULT_BUDGETS_BASENAME)
+
+N_DEV = 8
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < N_DEV,
+    reason=f"needs {N_DEV} virtual devices (conftest XLA flag)",
+)
+
+
+@functools.lru_cache(maxsize=1)
+def _full_audit():
+    # Cached: several tests read the full-registry result, and the
+    # underlying lowerings are themselves memoized in the observatory.
+    return jaxlint.run_audit(budgets_path=BUDGETS)
+
+
+# ---------------------------------------------------------------------------
+# The tier-1 gate
+
+
+def test_full_registry_lints_clean():
+    result = _full_audit()
+    assert not result.skipped, (
+        "entries skipped on the 8-device rig: "
+        + ", ".join(a.entry for a in result.skipped)
+    )
+    assert set(a.entry for a in result.audits) == set(
+        jaxlint.LINT_REGISTRY
+    )
+    assert not result.findings, (
+        "jaxlint findings:\n"
+        + "\n".join(f.render() for f in result.findings)
+    )
+    assert not result.stale
+
+
+def test_spatial_contract_in_census():
+    # The r12 exchange shape, read off the census instead of a raw
+    # HLO grep: collective-permute present (2 halo directions + the
+    # rebuild re-select inside the cond), all-gather absent, and the
+    # mesh-uniform trigger is exactly one in-scan all-reduce.
+    counts = jaxlint.entry_census("swarm-rollout-spatial")
+    assert counts["scan-collective-permute"] >= 2
+    assert counts["all-gather"] == 0
+    assert counts["scan-all-reduce"] == 1
+
+
+def test_packed_telemetry_contract_in_census():
+    # The r11 packed-reduction rule on the shmap driver: the per-step
+    # collective count stays a handful (one objective reduction pair
+    # + one packed max/sum tree), nowhere near one-per-gauge (the
+    # pre-r11 regression measured +37 all-reduces in the scan body).
+    counts = jaxlint.entry_census("pso-shmap")
+    assert 0 < counts["scan-all-reduce"] <= 4
+
+
+def test_serve_donation_is_aliased():
+    counts = jaxlint.entry_census("serve-batched-rollout")
+    assert counts["donated-not-aliased"] == 0
+    # Every leaf of the donated [S] state carry actually aliases.
+    assert counts["aliased-outputs"] >= 20
+
+
+# ---------------------------------------------------------------------------
+# Seeded regressions: the census gate catches them
+
+
+def _declared():
+    return jaxlint.load_budgets(BUDGETS)
+
+
+def test_seeded_all_gather_into_spatial_tick_is_caught():
+    # Tamper the spatial tick: same entry, same example args, but the
+    # program now all-gathers every shard's positions — exactly the
+    # full-swarm copy the decomposition exists to avoid.  The census
+    # gate must name it.
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_swarm_algorithm_tpu.parallel.spatial import (
+        SPATIAL_AXIS,
+    )
+    from distributed_swarm_algorithm_tpu.utils.compat import shard_map
+
+    spec = jaxlint.LINT_REGISTRY["swarm-rollout-spatial"]
+    fn, args, kwargs = spec.build()
+    tiled, _obs, cfg, n_steps, mesh, spatial = args
+
+    @jax.jit
+    def tampered(state):
+        out = fn(state, None, cfg, n_steps, mesh, spatial)
+        gathered = shard_map(
+            lambda p: jax.lax.all_gather(
+                p, SPATIAL_AXIS, tiled=True
+            ),
+            mesh=mesh,
+            in_specs=(P(SPATIAL_AXIS),),
+            out_specs=P(SPATIAL_AXIS),
+        )(out.pos)
+        return out, gathered
+
+    counts = jaxlint.census_of(tampered, tiled)
+    assert counts["all-gather"] >= 1
+    declared = _declared()["swarm-rollout-spatial"]
+    audit = jaxlint.EntryAudit(
+        entry="swarm-rollout-spatial",
+        signature=declared.signature,   # isolate the census check
+        counts=counts,
+    )
+    findings = jaxlint.check_against_budget(audit, declared)
+    assert any(f.check == "all-gather" for f in findings), [
+        f.render() for f in findings
+    ]
+
+
+def test_seeded_per_tick_all_reduce_is_caught():
+    # A toy telemetry-unpacking regression: one EXTRA psum per tick
+    # on top of a budget that allows exactly one.
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_swarm_algorithm_tpu.utils.compat import shard_map
+
+    mesh = jax.sharding.Mesh(jax.devices()[:N_DEV], ("x",))
+
+    @jax.jit
+    def rollout(x):
+        def local(x):
+            def body(c, _):
+                s = jax.lax.psum(c, "x")
+                m = jax.lax.psum(c * c, "x")   # the unpacked gauge
+                return c + s * 0 + m * 0, None
+
+            out, _ = jax.lax.scan(body, x, None, length=4)
+            return out
+
+        return shard_map(
+            local, mesh=mesh, in_specs=(P("x"),), out_specs=P("x")
+        )(x)
+
+    counts = jaxlint.census_of(rollout, jnp.zeros((N_DEV, 4)))
+    assert counts["scan-all-reduce"] == 2
+    entry = jaxlint.BudgetEntry(
+        entry="toy-rollout", signature="sig",
+        budgets={"all-reduce": 2, "scan-all-reduce": 1},
+        justification="one packed reduction per tick is the contract",
+    )
+    audit = jaxlint.EntryAudit(
+        entry="toy-rollout", signature="sig", counts=counts
+    )
+    findings = jaxlint.check_against_budget(audit, entry)
+    assert [f.check for f in findings] == ["scan-all-reduce"]
+    assert findings[0].measured == 2 and findings[0].budget == 1
+
+
+# ---------------------------------------------------------------------------
+# Budget-ledger lifecycle
+
+
+def test_undeclared_entry_is_a_finding(tmp_path):
+    declared = _declared()
+    declared.pop("swarm-rollout")
+    path = str(tmp_path / "budgets.json")
+    jaxlint.save_budgets(path, declared)
+    result = jaxlint.run_audit(
+        entries=["swarm-rollout"], budgets_path=path
+    )
+    assert [f.check for f in result.findings] == ["undeclared"]
+
+
+def test_stale_budget_entry_fails_full_audit(tmp_path):
+    declared = _declared()
+    declared["ghost-entry"] = jaxlint.BudgetEntry(
+        entry="ghost-entry", signature="dead", budgets={},
+        justification="entry retired two rounds ago",
+    )
+    path = str(tmp_path / "budgets.json")
+    jaxlint.save_budgets(path, declared)
+    result = jaxlint.run_audit(budgets_path=path)
+    assert result.stale == ["ghost-entry"]
+    assert any(f.check == "stale-budget" for f in result.findings)
+    # A SCOPED audit cannot prove staleness (the swarmlint rule).
+    scoped = jaxlint.run_audit(
+        entries=["swarm-rollout"], budgets_path=path
+    )
+    assert not scoped.stale and not scoped.findings
+
+
+def test_signature_drift_is_a_finding(tmp_path):
+    declared = _declared()
+    real = declared["swarm-rollout"]
+    declared["swarm-rollout"] = jaxlint.BudgetEntry(
+        entry=real.entry, signature="000000000000",
+        budgets=real.budgets, justification=real.justification,
+    )
+    path = str(tmp_path / "budgets.json")
+    jaxlint.save_budgets(path, declared)
+    result = jaxlint.run_audit(
+        entries=["swarm-rollout"], budgets_path=path
+    )
+    assert [f.check for f in result.findings] == ["signature-stale"]
+
+
+def test_budget_roundtrip_and_validation(tmp_path):
+    audit = jaxlint.EntryAudit(
+        entry="e", signature="abc",
+        counts={
+            "all-reduce": 2, "aliased-outputs": 5, "f64": 0,
+            "while-loops": 3,
+        },
+    )
+    entry = jaxlint.budget_from_audit(audit, "why")
+    # Nonzero gated keys become ceilings; info keys become the
+    # aliased floor, never a ceiling.
+    assert entry.budgets == {
+        "all-reduce": 2, jaxlint.MIN_ALIASED: 5
+    }
+    path = str(tmp_path / "b.json")
+    jaxlint.save_budgets(path, {"e": entry})
+    assert jaxlint.load_budgets(path)["e"] == entry
+
+    for bad in (
+        {"entries": [{"entry": "x"}]},                   # missing keys
+        {"entries": [{"entry": "x", "signature": "s",
+                      "budgets": {}, "justification": "  "}]},
+        {"entries": [{"entry": "x", "signature": "s",
+                      "budgets": {"bogus-key": 1},
+                      "justification": "j"}]},
+    ):
+        with open(path, "w") as fh:
+            json.dump(bad, fh)
+        with pytest.raises(jaxlint.BudgetError):
+            jaxlint.load_budgets(path)
+
+
+def test_min_aliased_floor_gates():
+    entry = jaxlint.BudgetEntry(
+        entry="e", signature="s",
+        budgets={jaxlint.MIN_ALIASED: 10},
+        justification="donated carry must stay aliased",
+    )
+    audit = jaxlint.EntryAudit(
+        entry="e", signature="s",
+        counts={"aliased-outputs": 3, "donated-not-aliased": 0},
+    )
+    findings = jaxlint.check_against_budget(audit, entry)
+    assert [f.check for f in findings] == [jaxlint.MIN_ALIASED]
+
+
+# ---------------------------------------------------------------------------
+# Donation + dtype audits on fixture programs
+
+
+def test_donation_audit_flags_unaliased_donation():
+    @partial(jax.jit, donate_argnums=(0,))
+    def f(x):
+        return (x[:2] * 2.0,)    # shape mismatch: cannot alias
+
+    counts = jaxlint.census_of(f, jnp.zeros((4,), jnp.float32))
+    assert counts["donated-not-aliased"] >= 1
+    assert counts["aliased-outputs"] == 0
+
+
+def test_donation_audit_sees_aliasing():
+    @partial(jax.jit, donate_argnums=(0,))
+    def f(x, y):
+        return x + y
+
+    counts = jaxlint.census_of(
+        f, jnp.zeros((4,)), jnp.ones((4,))
+    )
+    assert counts["aliased-outputs"] == 1
+    assert counts["donated-not-aliased"] == 0
+
+
+def test_dtype_audit_flags_f64_and_promotion():
+    from jax.experimental import enable_x64
+
+    @jax.jit
+    def widen(x):
+        return x.astype(jnp.float64) * 2.0
+
+    with enable_x64():
+        counts = jaxlint.census_of(widen, jnp.zeros((4,), jnp.float32))
+    assert counts["f64"] > 0
+    assert counts["f32-to-f64"] >= 1
+    # The x64-off repo programs never carry f64 (the gate's default-0
+    # ceiling is what keeps it that way).
+    assert jaxlint.entry_census("swarm-rollout")["f64"] == 0
+
+
+# ---------------------------------------------------------------------------
+# StableHLO text parser
+
+
+_SYNTH = textwrap.dedent(
+    """\
+    module @jit_f attributes {mhlo.num_partitions = 8 : i32} {
+      func.func public @main(%arg0: tensor<8x4xf32> {tf.aliasing_output = 0 : i32, mhlo.sharding = "{devices=[8,1]<=[8]}"}) -> (tensor<8x4xf32>) {
+        %0 = stablehlo.custom_call @Sharding(%arg0) : (tensor<8x4xf32>) -> tensor<8x4xf32>
+        %1 = call @wrapped(%0) : (tensor<8x4xf32>) -> tensor<8x4xf32>
+        return %1 : tensor<8x4xf32>
+      }
+      func.func private @wrapped(%arg0: tensor<8x4xf32>) -> tensor<8x4xf32> {
+        %c = stablehlo.constant dense<0> : tensor<i32>
+        %0:2 = stablehlo.while(%iterArg = %c, %iterArg_0 = %arg0) : tensor<i32>, tensor<8x4xf32>
+         cond {
+          %c_1 = stablehlo.constant dense<4> : tensor<i32>
+          %1 = stablehlo.compare  LT, %iterArg, %c_1,  SIGNED : (tensor<i32>, tensor<i32>) -> tensor<i1>
+          stablehlo.return %1 : tensor<i1>
+        } do {
+          %1 = func.call @body(%iterArg_0) : (tensor<8x4xf32>) -> tensor<8x4xf32>
+          %c_1 = stablehlo.constant dense<1> : tensor<i32>
+          %2 = stablehlo.add %iterArg, %c_1 : tensor<i32>
+          stablehlo.return %2, %1 : tensor<i32>, tensor<8x4xf32>
+        }
+        %3 = "stablehlo.all_gather"(%0#1) <{all_gather_dim = 0 : i64}> : (tensor<8x4xf32>) -> tensor<8x4xf32>
+        return %3 : tensor<8x4xf32>
+      }
+      func.func private @body(%arg0: tensor<8x4xf32>) -> tensor<8x4xf32> {
+        %0 = "stablehlo.collective_permute"(%arg0) <{source_target_pairs = dense<0> : tensor<1x2xi64>}> : (tensor<8x4xf32>) -> tensor<8x4xf32>
+        %1 = "stablehlo.all_reduce"(%0) ({
+          ^bb0(%a: tensor<f32>, %b: tensor<f32>):
+            %s = stablehlo.add %a, %b : tensor<f32>
+            stablehlo.return %s : tensor<f32>
+        }) {replica_groups = dense<0> : tensor<1x8xi64>} : (tensor<8x4xf32>) -> tensor<8x4xf32>
+        %2 = stablehlo.dynamic_slice %1, %1, %1, sizes = [1, 4] : (tensor<8x4xf32>) -> tensor<1x4xf32>
+        %3 = stablehlo.convert %2 : (tensor<1x4xf32>) -> tensor<1x4xf64>
+        return %1 : tensor<8x4xf32>
+      }
+    }
+    """
+)
+
+
+def test_parser_census_on_synthetic_module():
+    counts = jaxlint.census_of_text(_SYNTH)
+    # Whole-module: the gather sits OUTSIDE the loop, the permute +
+    # reduce inside (via the func.call edge out of the do-region).
+    assert counts["all-gather"] == 1
+    assert counts["collective-permute"] == 1
+    assert counts["all-reduce"] == 1
+    assert counts["scan-all-gather"] == 0
+    assert counts["scan-collective-permute"] == 1
+    assert counts["scan-all-reduce"] == 1
+    assert counts["scan-dynamic-slice"] == 1
+    assert counts["while-loops"] == 1
+    assert counts["f64"] == 1
+    assert counts["f32-to-f64"] == 1
+    assert counts["aliased-outputs"] == 1
+    # The quoted sharding attribute's braces did not derail function
+    # splitting: all three functions parsed.
+    assert set(jaxlint.split_functions(_SYNTH)) == {
+        "main", "wrapped", "body"
+    }
+
+
+def test_parser_counts_callees_per_call_site():
+    # A loop body calling a collective-bearing helper TWICE pays its
+    # collectives twice per tick — the census must say so (a
+    # once-per-callee dedup would let a doubled halo exchange ship
+    # under the old budget).
+    doubled = _SYNTH.replace(
+        "%1 = func.call @body(%iterArg_0) : "
+        "(tensor<8x4xf32>) -> tensor<8x4xf32>\n",
+        "%0_b = func.call @body(%iterArg_0) : "
+        "(tensor<8x4xf32>) -> tensor<8x4xf32>\n      "
+        "%1 = func.call @body(%0_b) : "
+        "(tensor<8x4xf32>) -> tensor<8x4xf32>\n",
+    )
+    assert doubled != _SYNTH
+    counts = jaxlint.census_of_text(doubled)
+    assert counts["scan-collective-permute"] == 2
+    assert counts["scan-all-reduce"] == 2
+    assert counts["scan-dynamic-slice"] == 2
+
+
+def test_parser_donation_warning_count():
+    counts = jaxlint.census_of_text(
+        "func.func public @main() { }",
+        lowering_warnings=[
+            "Some donated buffers were not usable: "
+            "ShapedArray(float32[4]), ShapedArray(int32[4])."
+        ],
+    )
+    assert counts["donated-not-aliased"] == 2
+
+
+def test_collectives_per_tick_sums_scan_keys():
+    counts = {k: 0 for k in jaxlint.census_keys()}
+    counts["scan-all-reduce"] = 2
+    counts["scan-collective-permute"] = 3
+    counts["all-reduce"] = 7          # outside-loop ops don't count
+    assert jaxlint.collectives_per_tick(counts) == 5
+
+
+# ---------------------------------------------------------------------------
+# Gate parity: unit "collectives" in compare.py and rundir.py
+
+
+def _load_compare():
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare_jaxlint",
+        os.path.join(ROOT, "benchmarks", "compare.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_collectives_unit_gates_lower_is_better(tmp_path):
+    assert "collectives" in rundir.COUNT_UNITS
+    assert rundir.gate("collectives", 0.0, 1.0) == "REGRESSION"
+    assert rundir.gate("collectives", 4.0, 4.0) == "ok"
+    assert rundir.gate("collectives", 4.0, 3.0) == "improved"
+
+    compare = _load_compare()
+    hist = str(tmp_path / "BENCH_HISTORY.json")
+    row = "jaxlint-collectives-per-tick, swarm-rollout-spatial"
+    compare.record("r01", [
+        {"metric": row, "value": 5.0, "unit": "collectives"},
+    ], path=hist)
+    compare.record("r02", [
+        {"metric": row, "value": 7.0, "unit": "collectives"},
+    ], path=hist)
+    assert compare.compare("r01", "r02", path=hist) == 1   # growth gates
+    compare.record("r03", [
+        {"metric": row, "value": 5.0, "unit": "collectives"},
+    ], path=hist)
+    assert compare.compare("r02", "r03", path=hist) == 0   # paydown ok

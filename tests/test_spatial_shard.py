@@ -23,9 +23,11 @@ Exactness ledger (the r9-style notes these pins encode):
   DETECTED — the counters go positive the build it happens — which
   is the r9-notes-style documented contract for this regime.
 - **collective shape**: the sharded scan body exchanges boundary
-  agents via ``collective-permute`` ONLY — the lowered text contains
-  no all-gather (a full-swarm position gather is exactly what the
-  decomposition exists to avoid), asserted on the HLO.
+  agents via ``collective-permute`` ONLY — the lowered program
+  contains no all-gather (a full-swarm position gather is exactly
+  what the decomposition exists to avoid), asserted through the
+  jaxlint census (r15, analysis/jaxlint.py — the same counts the
+  tier-1 budget gate pins in jaxlint-budgets.json).
 - **recorder contract**: telemetry-disabled lowering is byte-identical
   to the kwarg-omitted lowering (the r10/r11 static-gate contract),
   the enabled trajectory fingerprints bitwise-equal to disabled, and
@@ -36,8 +38,6 @@ Runs on the 8-virtual-CPU-device rig (conftest pins the XLA flag).
 """
 
 from __future__ import annotations
-
-import re
 
 import numpy as np
 import pytest
@@ -270,17 +270,23 @@ def test_out_of_contract_regimes_are_detected_not_silent():
 
 
 def test_scan_body_exchanges_by_collective_permute_only():
-    cfg = _cfg()
-    mesh = _mesh()
-    tiled, spec = spatial_shard_swarm(_station(), mesh, cfg)
-    low = _swarm_rollout_spatial_impl.lower(
-        tiled, None, cfg, 6, mesh, spec
-    ).as_text()
-    # The boundary exchange is pairwise: collective-permute present,
-    # and NO all-gather anywhere — a full-swarm position gather is
+    # r15: the collective contract lives in ONE place now — the
+    # jaxlint census over the registered swarm-rollout-spatial entry
+    # (analysis/jaxlint.py; the same canonical example invocation the
+    # tier-1 budget gate lowers, so this costs one memoized lowering,
+    # not a fresh HLO-text grep).  The boundary exchange is pairwise:
+    # collective-permute present — per tick, inside the scan body —
+    # and NO all-gather anywhere: a full-swarm position gather is
     # what the decomposition exists to avoid.
-    assert re.search(r"collective.permute", low)
-    assert not re.search(r"all.gather", low)
+    from distributed_swarm_algorithm_tpu.analysis import jaxlint
+
+    counts = jaxlint.entry_census("swarm-rollout-spatial")
+    assert counts["scan-collective-permute"] >= 2   # 2 halo directions
+    assert counts["collective-permute"] > counts[
+        "scan-collective-permute"
+    ]                                               # + the initial build
+    assert counts["all-gather"] == 0
+    assert counts["scan-all-gather"] == 0
 
 
 def test_telemetry_gate_contract_on_sharded_rollout():

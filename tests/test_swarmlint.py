@@ -238,6 +238,32 @@ SEEDED = {
             return jax.vmap(tick, in_axes=(0, None))(states, key)
         """,
     ),
+    "cond-collective": (
+        "pkg/condrebuild.py",
+        """
+        from functools import partial
+
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def tick(pos, mesh, perm):
+            @partial(shard_map, mesh=mesh, in_specs=(P("x"),),
+                     out_specs=P("x"))
+            def body(p):
+                def rebuild(_):
+                    return lax.ppermute(p, "x", perm=perm)
+
+                def keep(_):
+                    return p
+
+                stale = jnp.max(jnp.abs(p)) > 1.0
+                return lax.cond(stale, rebuild, keep, None)
+
+            return body(pos)
+        """,
+    ),
     "done-branch": (
         "pkg/envreset.py",
         """
@@ -562,6 +588,44 @@ def test_each_rule_fires_exactly_once_on_seeded_tree(tmp_path):
                 )
             """,
         ),
+        # A cond whose collective-bearing branch runs under a
+        # mesh-REDUCED predicate (`lax.pmax(flag, axis) > 0` — the
+        # parallel/spatial.py rebuild idiom) is the SANCTIONED
+        # uniform-trigger pattern: no cond-collective finding.  A
+        # collective-free cond under shard_map never flags either.
+        (
+            "cond_uniform_trigger",
+            """
+            from functools import partial
+
+            import jax.numpy as jnp
+            from jax import lax
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            def tick(pos, mesh, perm):
+                @partial(shard_map, mesh=mesh, in_specs=(P("x"),),
+                         out_specs=P("x"))
+                def body(p):
+                    def rebuild(_):
+                        return lax.ppermute(p, "x", perm=perm)
+
+                    def keep(_):
+                        return p
+
+                    stale = jnp.max(jnp.abs(p)) > 1.0
+                    stale_any = lax.pmax(
+                        stale.astype(jnp.int32), "x"
+                    ) > 0
+                    out = lax.cond(stale_any, rebuild, keep, None)
+                    # Collective-free branches take any predicate.
+                    return lax.cond(
+                        stale, lambda _: out, lambda _: p, None
+                    )
+
+                return body(pos)
+            """,
+        ),
     ],
 )
 def test_precision_no_false_positive(tmp_path, name, src):
@@ -571,6 +635,41 @@ def test_precision_no_false_positive(tmp_path, name, src):
     )
     assert not errors
     assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_cond_collective_reassigned_predicate_detected(tmp_path):
+    # The uniformity check honors only the LAST assignment before the
+    # cond: a pmax-reduced trigger RE-assigned to a per-shard value
+    # is exactly the r12 deadlock, and must flag.
+    src = """
+    from functools import partial
+
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def tick(pos, mesh, perm):
+        @partial(shard_map, mesh=mesh, in_specs=(P("x"),),
+                 out_specs=P("x"))
+        def body(p):
+            def rebuild(_):
+                return lax.ppermute(p, "x", perm=perm)
+
+            def keep(_):
+                return p
+
+            stale = lax.pmax(jnp.max(jnp.abs(p)), "x") > 1.0
+            stale = jnp.max(jnp.abs(p)) > 1.0   # per-shard again!
+            return lax.cond(stale, rebuild, keep, None)
+
+        return body(pos)
+    """
+    _write_tree(str(tmp_path), [("reassigned.py", src)])
+    findings, _, _ = analysis.analyze_paths(
+        str(tmp_path), ["reassigned.py"]
+    )
+    assert [f.rule for f in findings] == ["cond-collective"]
 
 
 def test_loop_carried_key_reuse_detected(tmp_path):

@@ -131,6 +131,12 @@ def test_gate_semantics_agree_with_compare(tmp_path):
         ("bytes", 1_000_000.0, 1_300_000.0, True),
         ("bytes", 1_000_000.0, 1_100_000.0, False),
         ("bytes", 0.0, 512.0, True),
+        # r15 jaxlint per-entry scan-body collective census: growth
+        # gates, paydown never, and a collective-free entry (0)
+        # regressing to ANY per-tick collective gates.
+        ("collectives", 4.0, 5.0, True),
+        ("collectives", 5.0, 4.0, False),
+        ("collectives", 0.0, 1.0, True),
     ]
     for i, (unit, prev, cur, expect) in enumerate(cases):
         assert (

@@ -1,0 +1,321 @@
+"""Sustained-traffic soak of the streaming serve loop (r16) — the
+standing "heavy traffic" gate.
+
+The multitenant bench (r13) measures a BURST: submit everything,
+flush once, collect.  Production traffic is a STREAM — Poisson
+arrivals, heterogeneous scenarios churning through two capacity
+rungs, tenants leaving mid-rollout — and the failure modes of a
+stream (admission latency creep, deadline misses, a host sync
+serializing the pipeline) are invisible to a burst bench.  This soak
+drives the StreamingService with minutes of sustained mixed traffic
+and gates what a tenant experiences:
+
+- **zero deadline-miss events** at the declared admission deadline
+  (the host loop kept up for the whole soak);
+- **p99 time-to-first-result** under a declared absolute ceiling,
+  recorded as fixed-name rows under the new lower-is-better latency
+  units (``ms-p50``/``ms-p99``, mirrored in compare.py + rundir.py);
+- **bitwise per-tenant parity** vs solo rollouts, asserted under
+  out-of-order collection and mid-soak eviction (evicted tenants:
+  bitwise-PREFIX-equal at their elapsed tick count) — the r13
+  contract surviving the streaming rewrite, sampled because each
+  solo reference bakes its params static and retraces;
+- **scenarios/sec** sustained throughput (higher-is-better).
+
+Methodology notes: the compiled-shape lattice is warmed BEFORE the
+soak window (a cold compile is a one-time cost the lattice bounds,
+not a property of sustained traffic), and the SLO tracker is then
+reset so the gated percentiles cover exactly the soak's requests.
+
+Fixed-name rows (cpu families; the script no-ops off-cpu):
+
+  soak-scenarios-per-sec, <tag>        scenarios/sec (throughput)
+  soak-ttfr-ms-p50, <tag>              unit "ms-p50"
+  soak-ttfr-ms-p99, <tag>              unit "ms-p99" (+ self-gate
+                                       against P99_TTFR_CEILING_MS)
+  soak-queue-ms-p99, <tag>             unit "ms-p99"
+  soak-deadline-miss-events, <tag>     unit "events" (self-gate: 0)
+
+With ``DSA_RUN_DIR`` set, the SLO summary (incl. the queue-depth
+trajectory) lands in ``slo.json`` and the alert events in
+``events.jsonl`` — the surface ``swarmscope slo`` renders.
+
+Usage: python benchmarks/bench_soak.py [--small]
+  --small: ~60 s of traffic (the CI-speed soak wired into run_all);
+  default: ~180 s.
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import random
+import sys
+import time
+
+os.environ.setdefault("DSA_COMPILE_WATCH", "1")
+
+import jax
+import numpy as np
+
+from common import report
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu import serve
+from distributed_swarm_algorithm_tpu.utils import compile_watch as cw
+
+N_STEPS = 30
+SEGMENT_STEPS = 10
+DEADLINE_S = 0.25
+#: Declared miss grace: the deadline-miss bar sits at deadline +
+#: grace = 750 ms.  The regression class this gate exists for — a
+#: host sync serializing the pipeline (serve-host-sync) — puts queue
+#: times at SECONDS (every dispatch pays a full rollout); the grace
+#: above the default (one deadline) absorbs the ~150 ms gen-2 GC /
+#: scheduler hiccups a shared 2-core CI rig shows without weakening
+#: the gate against the failure it targets.
+MISS_GRACE_S = 0.5
+#: Mean request inter-arrival (Poisson).  Calibrated to ~40-60%
+#: utilization of the 2-core rig so the gate measures a HEALTHY
+#: stream (an overloaded soak measures the backlog, not the service).
+MEAN_ARRIVAL_S = 1 / 12.0
+#: Absolute p99 TTFR ceiling (ms) — declared, not fitted: coalescing
+#: is bounded by the 250 ms deadline, a first segment is ~1/3 of a
+#: rollout, and several dispatches pipeline concurrently; a healthy
+#: soak sits well under 2 s, and past it the pipeline stalled.
+P99_TTFR_CEILING_MS = 2000.0
+#: Evict roughly one in EVICT_EVERY pump cycles (mid-rollout churn).
+EVICT_EVERY = 40
+#: Solo-parity sample bounds (each solo reference retraces).
+PARITY_SAMPLE = 6
+PARITY_EVICTED = 3
+#: Warm-pass submissions (rungs 8+4+1 per capacity) — also the rid
+#: offset of the first soak request (rids are submission-ordered).
+N_WARM = 2 * (8 + 4 + 1)
+
+SPEC = serve.BucketSpec(capacities=(32, 64), batches=(1, 4, 8))
+BASE = dsa.SwarmConfig().replace(
+    formation_shape="none", utility_threshold=2.0
+)
+
+
+def _request(i: int) -> serve.ScenarioRequest:
+    """Deterministic heterogeneous stream: two capacity rungs, a
+    param grid, per-index seeds — cross-round reproducible, and
+    recoverable from the rid (soak index = rid - N_WARM)."""
+    return serve.ScenarioRequest(
+        n_agents=(24 + (i * 11) % 9) if i % 3 else (48 + (i * 7) % 17),
+        seed=i,
+        arena_hw=6.0 + (i % 5),
+        params={
+            "k_att": 0.5 + 0.25 * (i % 7),
+            "k_sep": 10.0 + 5.0 * (i % 4),
+            "max_speed": 2.0 + (i % 3),
+        },
+    )
+
+
+def _solo(req: serve.ScenarioRequest, n_steps: int):
+    cap = SPEC.capacity_for(req.n_agents)
+    s, p = serve.materialize_scenario(req, cap, BASE)
+    return dsa.swarm_rollout(
+        s, None, serve.bake_params(BASE, p), n_steps
+    )
+
+
+def _assert_parity(solo, got, label: str) -> None:
+    for f in ("pos", "vel", "fsm", "leader_id", "alive", "tick"):
+        a = np.asarray(getattr(solo, f))
+        b = np.asarray(getattr(got, f))
+        assert np.array_equal(a, b), f"{label}: field {f} diverged"
+
+
+def _warm(svc) -> None:
+    """Compile every (capacity, rung, segment) shape the soak can
+    dispatch: one 8-, one 4-, and one 1-rung wave per capacity."""
+    for cap in SPEC.capacities:
+        for rung in (8, 4, 1):
+            for k in range(rung):
+                svc.submit(
+                    serve.ScenarioRequest(n_agents=cap, seed=900 + k)
+                )
+            while svc.n_pending or svc.n_in_flight:
+                svc.pump(force=True)
+    for rid in list(svc.ready_rids()):
+        svc.collect(rid)
+
+
+def main() -> int:
+    backend = jax.default_backend()
+    if backend != "cpu":
+        print(
+            f"# bench_soak: cpu-family rows; backend is {backend!r} "
+            "— skipping"
+        )
+        return 0
+    small = "--small" in sys.argv[1:]
+    duration_s = 60.0 if small else 180.0
+    tag = f"{'60s' if small else '180s'} mixed cpu"
+
+    svc = serve.StreamingService(
+        BASE, spec=SPEC, n_steps=N_STEPS,
+        segment_steps=SEGMENT_STEPS, deadline_s=DEADLINE_S,
+        telemetry=False,
+    )
+    _warm(svc)
+    print(f"# warmed {svc.compile_entries()} compiled shapes "
+          f"(budget {cw.WATCH.bucket_budget(serve.SERVE_ENTRY)})")
+    # Quiesce the allocator before the window: the warm pass leaves
+    # a large survivor set, and a gen-2 sweep mid-soak is a ~150 ms
+    # host pause (measured on this rig) charged to whoever is queued
+    # at that instant.  Freezing moves the survivors out of the
+    # collector's scan set — the standard serving-process trick —
+    # while leaving collection ON, so a real leak still surfaces.
+    gc.collect()
+    gc.freeze()
+    # Fresh tracker: the gated percentiles cover the soak only (warm
+    # compiles are a one-time cost, not sustained-traffic latency).
+    svc.slo = serve.SloTracker(
+        deadline_s=DEADLINE_S, miss_grace_s=MISS_GRACE_S
+    )
+    svc.queue.clock = svc.slo.clock
+
+    rng = random.Random(0)
+    t0 = time.monotonic()
+    t_end = t0 + duration_s
+    next_arrival = t0
+    i = 0
+    full_kept: dict = {}      # rid -> TenantResult (parity sample)
+    evicted_kept: dict = {}
+    evict_countdown = EVICT_EVERY
+    n_ooo = 0
+    while time.monotonic() < t_end:
+        now = time.monotonic()
+        while next_arrival <= now and next_arrival < t_end:
+            svc.submit(_request(i))
+            i += 1
+            next_arrival += rng.expovariate(1.0 / MEAN_ARRIVAL_S)
+        svc.pump()
+        evict_countdown -= 1
+        if evict_countdown <= 0:
+            active = svc.active_rids()
+            if active and svc.evict(rng.choice(active)):
+                evict_countdown = EVICT_EVERY
+        # OUT-OF-ORDER collection: drain ready results NEWEST-first,
+        # so the parity sample is exercised under a queueing-order
+        # permutation, not submission order.  Gated on result_ready —
+        # collecting a merely-LAUNCHED stream blocks the loop on its
+        # in-flight segments, and a stalled pump is exactly how
+        # admission deadlines get missed.
+        ready = sorted(
+            (r for r in svc.ready_rids() if svc.result_ready(r)),
+            reverse=True,
+        )
+        n_ooo += len(ready) > 1
+        for rid in ready:
+            res = svc.collect(rid)
+            if res.ticks < N_STEPS:
+                if len(evicted_kept) < PARITY_EVICTED:
+                    evicted_kept[rid] = res
+            elif len(full_kept) < PARITY_SAMPLE and rid % 7 == 0:
+                full_kept[rid] = res
+        time.sleep(0.002)
+    rest = svc.drain()
+    for rid, res in rest.items():
+        if res.ticks < N_STEPS and len(evicted_kept) < PARITY_EVICTED:
+            evicted_kept[rid] = res
+    wall = time.monotonic() - t0
+    # Warm collects happened before the tracker reset, so the soak's
+    # served count is the collected total minus the warm pass.
+    n_served = svc.stats["collected"] - N_WARM
+    slo = svc.slo.summary()
+    sps = n_served / wall
+
+    print(f"# soak: {n_served} scenarios in {wall:.1f}s "
+          f"({slo['dispatches']} dispatches, "
+          f"{slo['evictions']} evicted, filler "
+          f"{100 * slo['filler_fraction']:.1f}%, "
+          f"{n_ooo} multi-ready collect rounds)")
+
+    # --- parity under queueing: sampled full + evicted-prefix -------
+    for rid, res in full_kept.items():
+        solo = _solo(_request(rid - N_WARM), N_STEPS)
+        _assert_parity(solo, res.state, f"soak tenant {rid}")
+    for rid, res in evicted_kept.items():
+        solo = _solo(_request(rid - N_WARM), res.ticks)
+        _assert_parity(solo, res.state,
+                       f"evicted tenant {rid} @ {res.ticks} ticks")
+    print(f"# parity: {len(full_kept)} full + {len(evicted_kept)} "
+          "evicted-prefix tenants bitwise-equal to solo rollouts")
+
+    # --- fixed-name rows --------------------------------------------
+    # Suppressions: tag is one of two mode literals, fixed at the top
+    # of main() — the bench_multitenant precedent.
+    report(
+        # swarmlint: disable=metric-fstring -- tag is a mode literal; names are stable cross-round pins
+        f"soak-scenarios-per-sec, {tag}", sps, "scenarios/sec", 0.0,
+    )
+    report(
+        # swarmlint: disable=metric-fstring -- tag is a mode literal; names are stable cross-round pins
+        f"soak-ttfr-ms-p50, {tag}",
+        slo["ttfr_ms"]["p50"], "ms-p50", 0.0,
+    )
+    report(
+        # swarmlint: disable=metric-fstring -- tag is a mode literal; names are stable cross-round pins
+        f"soak-ttfr-ms-p99, {tag}",
+        slo["ttfr_ms"]["p99"], "ms-p99", 0.0,
+    )
+    report(
+        # swarmlint: disable=metric-fstring -- tag is a mode literal; names are stable cross-round pins
+        f"soak-queue-ms-p99, {tag}",
+        slo["queue_ms"]["p99"], "ms-p99", 0.0,
+    )
+    report(
+        # swarmlint: disable=metric-fstring -- tag is a mode literal; names are stable cross-round pins
+        f"soak-deadline-miss-events, {tag}",
+        float(slo["deadline_misses"]), "events", 0.0,
+    )
+
+    # --- run-dir deposit (swarmscope slo) ---------------------------
+    run_dir = os.environ.get("DSA_RUN_DIR")
+    if run_dir:
+        from distributed_swarm_algorithm_tpu.utils import rundir
+
+        rundir.merge_slo_summary(run_dir, f"soak {tag}", slo)
+        rundir.append_events(run_dir, svc.slo.events)
+
+    # --- self-gates -------------------------------------------------
+    failures = 0
+    if slo["deadline_misses"] > 0:
+        print(
+            f"# SELF-GATE: {slo['deadline_misses']} deadline-miss "
+            f"event(s) at the declared bar "
+            f"{(DEADLINE_S + MISS_GRACE_S) * 1e3:.0f} ms (deadline "
+            f"{DEADLINE_S * 1e3:.0f} + grace "
+            f"{MISS_GRACE_S * 1e3:.0f}) — the host loop fell behind "
+            "the admission bound",
+            file=sys.stderr,
+        )
+        failures += 1
+    if slo["ttfr_ms"]["p99"] > P99_TTFR_CEILING_MS:
+        print(
+            f"# SELF-GATE: p99 TTFR {slo['ttfr_ms']['p99']:.0f} ms "
+            f"> declared ceiling {P99_TTFR_CEILING_MS:.0f} ms",
+            file=sys.stderr,
+        )
+        failures += 1
+    entries = cw.WATCH.compile_count(serve.SERVE_ENTRY)
+    budget = cw.WATCH.bucket_budget(serve.SERVE_ENTRY)
+    if budget is not None and entries > budget:
+        print(
+            f"# SELF-GATE: {entries} compiled entries for "
+            f"{serve.SERVE_ENTRY} exceed the declared budget "
+            f"{budget} — a shape escaped the lattice mid-soak",
+            file=sys.stderr,
+        )
+        failures += 1
+    return 2 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -27,7 +27,11 @@ r11 — a retrace storm is a count regression), "bytes"
 bytes/tick, r12: growth means the boundary exchange stopped being
 thin), "collectives" (jaxlint's per-entry scan-body collective
 census, r15 — an extra per-tick collective in a lowered rollout is
-a count regression) are lower-is-better and
+a count regression), "ms-p50"/"ms-p99" (the streaming serve loop's
+SLO latency percentiles, r16 — a tail-latency regression gates
+exactly like a byte-volume regression; the soak bench additionally
+self-gates p99 against its own declared absolute ceiling) are
+lower-is-better and
 gate on growth (a clean 0 baseline regressing to any positive count
 always gates); unit "pct" (telemetry overhead, r10; multichip
 telemetry overhead, r11) is lower-is-better against an ABSOLUTE
@@ -172,14 +176,16 @@ def compare(prev_label: str, cur_label: str, threshold: float = 0.2,
         cv = float(cur[key][1]["value"])
         unit = str(cur[key][1].get("unit", ""))
         if unit in ("findings", "rounds", "events", "ticks",
-                    "compiles", "bytes", "collectives"):
+                    "compiles", "bytes", "collectives",
+                    "ms-p50", "ms-p99"):
             # Lower-is-better count metrics (swarmlint hygiene debt;
             # auction convergence rounds, r8; flight-recorder
             # truncation/churn counts and recovery-latency ticks,
             # r10; compile-observatory cache entries, r11;
             # halo-exchange traffic bytes, r12; jaxlint's per-entry
             # scan-body collective census, r15 — one extra per-tick
-            # collective costs T× a one-shot one): gate on growth,
+            # collective costs T× a one-shot one; serve-SLO latency
+            # percentiles, r16): gate on growth,
             # never on paydown.  A clean baseline (0) regressing to
             # any positive count always gates.
             status = "ok"

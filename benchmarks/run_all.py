@@ -112,6 +112,16 @@ BENCHES = [
     # growth gate); self-gates the env-rollout compile budget and a
     # 200% overhead sanity ceiling (exit 2).
     "bench_env.py",
+    # r16: the streaming-serve soak — ~60 s of sustained Poisson
+    # mixed traffic (--small) through the StreamingService, gating
+    # p99 time-to-first-result (unit "ms-p99", lower-is-better),
+    # zero deadline-miss events, sampled bitwise solo parity under
+    # out-of-order collection and mid-soak eviction, and sustained
+    # scenarios/sec; self-gates the miss count, the declared p99
+    # ceiling, and the compile budget (exit 2).  With --record the
+    # SLO summary + alert events land in the run dir for
+    # `swarmscope slo`.
+    "bench_soak.py",
 ]
 
 # Extra argv for benches whose no-arg default is not the gate set —
@@ -120,6 +130,9 @@ BENCHES = [
 # actually carries the 1M cic-deposit/cic-field metrics it tracks.
 BENCH_ARGS = {
     "decompose_gridmean.py": ["gate"],
+    # The gate set runs the CI-speed soak; the 180 s default is the
+    # by-hand deep-soak mode.
+    "bench_soak.py": ["--small"],
 }
 
 QUICK_SKIP = {
@@ -161,6 +174,9 @@ QUICK_SKIP = {
     # on the 2-core rig, full gate only (the bench_multitenant
     # precedent).
     "bench_env.py",
+    # r16: even --small is a fixed 60 s traffic window plus lattice
+    # warm-up — full gate only.
+    "bench_soak.py",
 }
 
 

@@ -1,4 +1,5 @@
-"""Repo-contract rules: Pallas dispatch gates and bench metric hygiene.
+"""Repo-contract rules: Pallas dispatch gates, bench metric hygiene,
+and the serve hot-loop host-sync contract.
 
 - ``pallas-gate``: every ``ops/pallas/*_fused.py`` kernel family must
   expose a ``*_supported()`` capability gate at module scope and pass
@@ -10,6 +11,17 @@
   (the r5 bench_recovery f-string) silently drops the metric from the
   cross-round union gate — the regression tracker matches on the
   exact string.
+- ``serve-host-sync``: a host sync (``jax.block_until_ready`` /
+  ``jax.device_get`` / ``.item()`` / ``np.asarray``-family) reachable
+  from a ``serve/`` HOT-LOOP method — any function whose name carries
+  an admit/launch/rotate/pump/advance stem, followed transitively
+  through same-module calls.  The streaming loop's whole design is
+  that admission and segment rotation never wait on the device (the
+  r16 double-buffer rotation); one stray sync silently serializes
+  the pipeline — every dispatch then costs a full rollout of
+  latency, which no test fails and no bench catches until the soak's
+  p99 row moves.  Collection paths (collect/harvest-after-enqueue)
+  that must block carry a justified inline suppression.
 """
 
 from __future__ import annotations
@@ -137,3 +149,131 @@ class MetricStringRule(Rule):
                 f"metric name is a {kind} — the union gate matches "
                 "exact strings; use a literal",
             )
+
+
+# ---------------------------------------------------------------------------
+# serve-host-sync (r16)
+
+#: Function-name stems that mark a serve/ hot-loop method.  The
+#: streaming loop's admission (admit), dispatch (launch), segment
+#: rotation (rotate/advance), and the pump that drives them must stay
+#: sync-free; collection paths use other names and MAY block.
+_HOT_STEMS = ("admit", "launch", "rotate", "pump", "advance")
+
+#: Resolved dotted names that force a host<->device sync.
+_SYNC_CALLS = frozenset(
+    {
+        "jax.block_until_ready",
+        "jax.device_get",
+        "numpy.asarray",
+        "numpy.array",
+        "numpy.ascontiguousarray",
+        "numpy.asfortranarray",
+    }
+)
+
+
+def _is_hot_name(name: str) -> bool:
+    low = name.lower()
+    return any(stem in low for stem in _HOT_STEMS)
+
+
+@register
+class ServeHostSyncRule(Rule):
+    id = "serve-host-sync"
+    summary = "host sync reachable from a serve/ hot-loop method"
+    details = (
+        "serve/ hot-loop methods (names carrying an admit/launch/"
+        "rotate/pump/advance stem) and everything they call in their "
+        "module must not force a device sync (jax.block_until_ready, "
+        "jax.device_get, .item(), np.asarray/np.array of a device "
+        "array): one stray sync serializes the streaming pipeline — "
+        "every dispatch then pays a full rollout of latency on the "
+        "host loop's critical path.  Blocking collection sites carry "
+        "a justified suppression (they read only work whose "
+        "successor dispatch is already enqueued)."
+    )
+
+    def applies(self, mod: ModuleInfo) -> bool:
+        return "/serve/" in f"/{mod.relpath}"
+
+    def check(self, mod: ModuleInfo):
+        if not self.applies(mod):
+            return
+        funcs: dict = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs.setdefault(node.name, []).append(node)
+        roots = [
+            fn for name, fns in funcs.items()
+            if _is_hot_name(name) for fn in fns
+        ]
+        # Transitive closure over same-module calls: bare names and
+        # attribute calls (self.f(), obj.f()) resolve by their
+        # terminal name — a sync hidden two helpers deep still
+        # serializes the pump.
+        reach = set(roots)
+        frontier = list(roots)
+        while frontier:
+            fn = frontier.pop()
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = None
+                if isinstance(node.func, ast.Name):
+                    callee = node.func.id
+                elif isinstance(node.func, ast.Attribute):
+                    callee = node.func.attr
+                for target in funcs.get(callee, ()):
+                    if target not in reach:
+                        reach.add(target)
+                        frontier.append(target)
+        seen: set = set()
+        for fn in sorted(reach, key=lambda f: f.lineno):
+            for node in ast.walk(fn):
+                f = self._sync_site(mod, node, fn.name)
+                if f is None:
+                    continue
+                site = (f.line, f.snippet)
+                if site not in seen:
+                    seen.add(site)
+                    yield f
+
+    def _sync_site(self, mod, node, root: str):
+        if not isinstance(node, ast.Call):
+            return None
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "item"
+            and not node.args
+        ):
+            return mod.finding(
+                self.id, node,
+                "`.item()` reachable from serve hot-loop method "
+                f"`{root}` forces a device sync on the serving path",
+            )
+        name = mod.resolve(node.func)
+        if name in _SYNC_CALLS:
+            short = name.replace("numpy", "np")
+            return mod.finding(
+                self.id, node,
+                f"`{short}` reachable from serve hot-loop method "
+                f"`{root}` blocks the host loop on device work — the "
+                "pipeline serializes",
+            )
+        # A sync passed AS AN ARGUMENT — tree_map(np.asarray, carry),
+        # this codebase's dominant whole-pytree transfer idiom — is
+        # the same serialization with the call site one level up.
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if not isinstance(arg, (ast.Name, ast.Attribute)):
+                continue
+            aname = mod.resolve(arg)
+            if aname in _SYNC_CALLS:
+                short = aname.replace("numpy", "np")
+                return mod.finding(
+                    self.id, node,
+                    f"`{short}` passed as a mapped function from "
+                    f"serve hot-loop method `{root}` blocks the host "
+                    "loop on device work — the pipeline serializes",
+                )
+        return None

@@ -754,6 +754,106 @@ def _cmd_scope_diff(args) -> int:
     return 0
 
 
+#: Alert-event names of the serve SLO observatory (serve/slo.py) —
+#: the subset of events.jsonl the ``slo`` subcommand counts.
+_SLO_EVENTS = ("deadline-miss", "queue-overflow", "eviction")
+
+
+def _spark(values, width: int = 48) -> str:
+    """Resample ``values`` to ``width`` buckets and render a block-
+    character sparkline (max per bucket — spikes must stay visible)."""
+    if not values:
+        return ""
+    blocks = " ▁▂▃▄▅▆▇█"
+    lo, hi = min(values), max(values)
+    n = min(width, len(values))
+    if hi == lo:
+        # A constant series has no shape to normalize; a steady
+        # nonzero level must still read as load, not as no data.
+        return ("▄" if lo else " ") * n
+    span = hi - lo
+    out = []
+    for b in range(n):
+        chunk = values[
+            b * len(values) // n: (b + 1) * len(values) // n
+        ] or [values[-1]]
+        frac = (max(chunk) - lo) / span
+        out.append(blocks[min(len(blocks) - 1, int(frac * (len(blocks) - 1) + 0.5))])
+    return "".join(out)
+
+
+def _cmd_scope_slo(args) -> int:
+    """``swarmscope slo RUN``: the serving-latency view of a run
+    directory (r16) — the SLO summaries from ``slo.json`` (latency
+    percentiles, occupancy, the queue-depth trajectory), the
+    fixed-name ``ms-*`` metric rows, and the deadline-miss /
+    queue-overflow / eviction alert events from ``events.jsonl``."""
+    from .utils import rundir
+
+    run = rundir.load_run(args.run)
+    printed = False
+    for tag, s in sorted(run.slo.items()):
+        printed = True
+        print(f"slo [{tag}]  (deadline {s.get('deadline_ms', '?')} ms"
+              f" + grace {s.get('miss_grace_ms', '?')} ms)")
+        for series, label in (("ttfr_ms", "ttfr"),
+                              ("queue_ms", "queue")):
+            p = s.get(series) or {}
+            print(
+                f"  {label:>6}: p50 {p.get('p50', 0.0):8.1f} ms   "
+                f"p95 {p.get('p95', 0.0):8.1f} ms   "
+                f"p99 {p.get('p99', 0.0):8.1f} ms   "
+                f"(n={p.get('n', 0)})"
+            )
+        print(
+            f"  dispatches {s.get('dispatches', 0)}  "
+            f"filler {100.0 * s.get('filler_fraction', 0.0):.1f}%  "
+            f"misses {s.get('deadline_misses', 0)}  "
+            f"overflows {s.get('queue_overflows', 0)}  "
+            f"evictions {s.get('evictions', 0)}"
+        )
+        traj = s.get("queue_depth") or []
+        if traj:
+            depths = [row[1] for row in traj]
+            flight = [row[2] for row in traj]
+            print(f"  queue depth  [{min(depths)}..{max(depths)}]  "
+                  f"{_spark(depths)}")
+            print(f"  in flight    [{min(flight)}..{max(flight)}]  "
+                  f"{_spark(flight)}")
+    ms_rows = [
+        row for row in run.metrics.values()
+        if str(row.get("unit", "")).startswith("ms-")
+    ]
+    if ms_rows:
+        printed = True
+        print("gated latency rows:")
+        for row in sorted(ms_rows, key=lambda r: r["metric"]):
+            print(f"  {row['value']:10.1f} {row['unit']:>7}  "
+                  f"{row['metric']}")
+    counts = {k: 0 for k in _SLO_EVENTS}
+    for ev in run.events:
+        if ev.get("event") in counts:
+            counts[ev["event"]] += 1
+    if any(counts.values()):
+        printed = True
+        print("alert events: " + ", ".join(
+            f"{k} x{v}" for k, v in sorted(counts.items()) if v
+        ))
+        for ev in run.events:
+            if ev.get("event") == "deadline-miss":
+                print(
+                    f"  MISS rid {ev.get('rid')} queued "
+                    f"{ev.get('queue_ms', 0.0):.1f} ms "
+                    f"(deadline {ev.get('deadline_ms', 0.0):.0f} ms"
+                    f" + grace {ev.get('grace_ms', 0.0):.0f} ms)"
+                )
+    if not printed:
+        print(f"run {run.label}: no SLO data (no slo.json, no ms-* "
+              "rows, no serve alert events) — was this run recorded "
+              "by a streaming bench (bench_soak.py)?")
+    return 0
+
+
 def _cmd_scope_history(args) -> int:
     """``swarmscope history METRIC``: the fixed-name row's trajectory
     across every recorded round of BENCH_HISTORY.json."""
@@ -1143,6 +1243,15 @@ def build_parser() -> argparse.ArgumentParser:
     p_sd.add_argument("b", help="candidate run directory")
     p_sd.add_argument("--threshold", type=float, default=0.2)
     p_sd.set_defaults(fn=_cmd_scope_diff)
+    p_slo = scope_sub.add_parser(
+        "slo",
+        help="render a run's serving-latency view (r16): SLO "
+             "percentile summaries + queue-depth trajectory from "
+             "slo.json, gated ms-* rows, and deadline-miss/"
+             "queue-overflow/eviction alert events",
+    )
+    p_slo.add_argument("run", help="run directory (runs/<label>)")
+    p_slo.set_defaults(fn=_cmd_scope_slo)
     p_sh = scope_sub.add_parser(
         "history",
         help="print a fixed-name row's BENCH_HISTORY trajectory",

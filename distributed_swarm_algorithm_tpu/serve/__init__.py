@@ -1,8 +1,11 @@
-"""Multi-tenant rollout serving (r13): scenario-batched swarm
-rollouts with bucketed compiled shapes and an async double-buffered
-submit/collect loop.  See serve/batched.py (the vmapped tick +
-per-scenario params), serve/buckets.py (the shape lattice), and
-serve/service.py (the host loop)."""
+"""Multi-tenant rollout serving (r13) + the streaming serve loop
+(r16): scenario-batched swarm rollouts with bucketed compiled shapes,
+an async double-buffered submit/collect loop, and a continuous-
+batching streaming service with an SLO observatory.  See
+serve/batched.py (the vmapped tick + per-scenario params),
+serve/buckets.py (the shape lattice), serve/service.py (the host
+loops), serve/queue.py (deadline-coalescing admission), and
+serve/slo.py (latency percentiles, gauges, alert events)."""
 
 from .batched import (
     MATERIALIZE_ENTRY,
@@ -24,17 +27,24 @@ from .batched import (
     validate_serve_config,
 )
 from .buckets import BucketSpec
-from .service import RolloutService, TenantResult
+from .queue import AdmissionQueue, QueueOverflowError
+from .service import RolloutService, StreamingService, TenantResult
+from .slo import DEFAULT_DEADLINE_S, SloTracker
 
 __all__ = [
+    "DEFAULT_DEADLINE_S",
     "MATERIALIZE_ENTRY",
     "PARAM_FIELDS",
     "SERVE_ENTRY",
+    "AdmissionQueue",
     "BucketSpec",
     "EnvRolloutResult",
+    "QueueOverflowError",
     "RolloutService",
     "ScenarioParams",
     "ScenarioRequest",
+    "SloTracker",
+    "StreamingService",
     "TenantResult",
     "bake_params",
     "batched_rollout",

@@ -1,0 +1,149 @@
+"""Admission queue with deadline coalescing (r16).
+
+The r13 service dispatched on an explicit ``flush()``: whoever called
+it decided the batching, and a caller submitting one request at a
+time degraded to batch-of-1 dispatches.  The streaming loop inverts
+that: requests ACCUMULATE here, grouped by their compiled-shape key
+``(capacity, n_tasks)``, and a group is released for dispatch when
+
+- it can fill the LARGEST batch rung (a full dispatch wastes no pad
+  rows — release immediately; waiting longer only adds latency), or
+- its oldest request's admission deadline expires (release the whole
+  group, split over the rungs by ``BucketSpec.split_batch`` — the
+  bounded-pad tail applies, so a deadline flush pays at most half a
+  dispatch of filler).
+
+This is the continuous-batching admission policy of LLM serving
+mapped onto the bucket lattice: the deadline bounds time-in-queue,
+the rung-full fast path bounds wasted flops, and both bounds are
+DECLARED (the SLO observatory gates the deadline; the occupancy
+gauge shows the filler).  The queue holds host-side request records
+only — nothing here touches a device array, so admission can never
+serialize the dispatch pipeline (the ``serve-host-sync`` contract).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+from .batched import ScenarioRequest
+from .buckets import BucketSpec
+
+
+class QueueOverflowError(RuntimeError):
+    """Submit rejected at the declared queue bound — the service's
+    loud backpressure signal (silently buffering unbounded requests
+    would trade an honest rejection for a latency cliff)."""
+
+
+@dataclass
+class QueuedRequest:
+    """One request awaiting admission."""
+
+    rid: int
+    req: ScenarioRequest
+    capacity: int
+    n_tasks: int
+    submit_t: float
+    deadline_t: float
+
+
+class AdmissionQueue:
+    """FIFO groups keyed by compiled shape, released by rung-full or
+    deadline — see the module doc for the policy."""
+
+    def __init__(
+        self,
+        spec: BucketSpec,
+        deadline_s: float,
+        clock=time.monotonic,
+    ):
+        if deadline_s <= 0:
+            raise ValueError(
+                f"deadline_s must be > 0, got {deadline_s}"
+            )
+        self.spec = spec
+        self.deadline_s = float(deadline_s)
+        self.clock = clock
+        #: (capacity, n_tasks) -> FIFO of QueuedRequest.
+        self._groups: Dict[tuple, List[QueuedRequest]] = {}
+
+    @property
+    def depth(self) -> int:
+        return sum(len(g) for g in self._groups.values())
+
+    def push(self, rid: int, req: ScenarioRequest, capacity: int,
+             n_tasks: int) -> QueuedRequest:
+        now = self.clock()
+        entry = QueuedRequest(
+            rid=rid, req=req, capacity=capacity, n_tasks=n_tasks,
+            submit_t=now, deadline_t=now + self.deadline_s,
+        )
+        self._groups.setdefault((capacity, n_tasks), []).append(entry)
+        return entry
+
+    def remove(self, rid: int) -> bool:
+        """Cancel a queued request (queued-tenant eviction); False if
+        ``rid`` is not queued."""
+        for key, group in self._groups.items():
+            for i, entry in enumerate(group):
+                if entry.rid == rid:
+                    del group[i]
+                    if not group:
+                        del self._groups[key]
+                    return True
+        return False
+
+    def __contains__(self, rid: int) -> bool:
+        return any(
+            e.rid == rid for g in self._groups.values() for e in g
+        )
+
+    # -- release policy ----------------------------------------------------
+    def pop_ready(
+        self, now=None, force: bool = False
+    ) -> List[Tuple[tuple, List[QueuedRequest], int]]:
+        """Dispatch groups due at ``now``: ``[(key, entries, size)]``
+        with ``size`` the batch rung each dispatch pads to.
+
+        Rung-full groups release a largest-rung dispatch per fill;
+        deadline-expired (or ``force``-flushed) groups release
+        entirely via ``split_batch`` (bounded-pad tail).  FIFO within
+        a group is preserved — admission order is dispatch order, so
+        latency accounting is honest per tenant."""
+        now = self.clock() if now is None else now
+        largest = self.spec.batches[-1]
+        out: List[Tuple[tuple, List[QueuedRequest], int]] = []
+        for key in sorted(self._groups):
+            group = self._groups[key]
+            while len(group) >= largest:
+                out.append((key, group[:largest], largest))
+                del group[:largest]
+            if group and (force or now >= group[0].deadline_t):
+                for size in self.spec.split_batch(len(group)):
+                    take = group[: min(size, len(group))]
+                    del group[: len(take)]
+                    out.append((key, take, size))
+        self._groups = {k: g for k, g in self._groups.items() if g}
+        return out
+
+    def pop_group(self, key) -> List[Tuple[tuple, List[QueuedRequest], int]]:
+        """Release ONE shape group now, split over the rungs — the
+        targeted drain a blocking collect on a queued rid uses, so
+        unrelated groups keep coalescing toward their own rung or
+        deadline instead of being force-flushed at partial fill."""
+        group = self._groups.pop(key, None)
+        if not group:
+            return []
+        out: List[Tuple[tuple, List[QueuedRequest], int]] = []
+        for size in self.spec.split_batch(len(group)):
+            take = group[: min(size, len(group))]
+            del group[: len(take)]
+            out.append((key, take, size))
+        return out
+
+    def flush_all(self):
+        """Release everything now (the drain path)."""
+        return self.pop_ready(force=True)

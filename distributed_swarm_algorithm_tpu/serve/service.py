@@ -1,12 +1,33 @@
 """The multi-tenant rollout service: submit scenarios, collect results.
 
-The async host loop over serve/batched.py + serve/buckets.py:
+Two host loops over serve/batched.py + serve/buckets.py:
+
+**One-shot** (r13) — the caller decides the batching:
 
     svc = RolloutService(cfg, n_steps=50)
     rid = svc.submit(ScenarioRequest(n_agents=100, seed=7))
     ...
     svc.flush()                      # dispatch everything pending
     result = svc.collect(rid)        # block on THAT dispatch only
+
+**Streaming** (r16) — continuous batching with an SLO observatory:
+
+    svc = StreamingService(cfg, n_steps=50, segment_steps=10,
+                           deadline_s=0.05)
+    rid = svc.submit(req)            # enters the admission queue
+    while serving:
+        svc.pump()                   # admit due rungs, rotate
+                                     # segments, harvest results
+    result = svc.collect(rid)        # full — or partial after evict()
+    print(svc.slo.summary())         # p50/p95/p99 TTFR, queue depth
+
+``StreamingService`` replaces the explicit flush with an admission
+queue (serve/queue.py): requests coalesce into bucket rungs and
+dispatch when a rung fills or their deadline expires, rollouts run in
+fixed SEGMENTS so results stream and tenants can leave (``evict``)
+or arrive mid-stream, and every request's latency is stamped into the
+SLO tracker (serve/slo.py) — the heavy-traffic surface
+benchmarks/bench_soak.py gates.
 
 ``flush`` groups pending requests by capacity bucket, splits each
 group into batch-rung dispatches (serve/buckets.py), materializes the
@@ -34,15 +55,20 @@ the count as a fixed-name "compiles" row.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..state import SwarmState
 from ..utils import compile_watch
 from ..utils.config import DEFAULT_CONFIG, SwarmConfig
-from ..utils.telemetry import TelemetrySummary, tenant_telemetry
+from ..utils.telemetry import (
+    TelemetrySummary,
+    concat_telemetry,
+    tenant_telemetry,
+)
 from .batched import (
     MATERIALIZE_ENTRY,
     SERVE_ENTRY,
@@ -54,6 +80,8 @@ from .batched import (
     validate_serve_config,
 )
 from .buckets import BucketSpec
+from .queue import AdmissionQueue, QueueOverflowError
+from .slo import DEFAULT_DEADLINE_S, SloTracker
 
 
 @dataclass
@@ -66,7 +94,11 @@ class TenantResult:
     transfer per dispatch, free views per tenant); ``summary`` the
     tenant's flight-recorder reduction (None with telemetry off);
     ``traj`` the ``[n_steps, n_agents, D]`` recorded trajectory
-    trimmed to the REAL agent count (None with record off)."""
+    trimmed to the REAL agent count (None with record off);
+    ``ticks`` the rollout length this result covers — the full
+    ``n_steps`` normally, or the elapsed prefix for a tenant evicted
+    mid-stream (r16; None on the one-shot r13 path, whose length is
+    always the service's)."""
 
     request_id: int
     n_agents: int
@@ -74,6 +106,7 @@ class TenantResult:
     state: SwarmState
     summary: Optional[dict] = None
     traj: Optional[np.ndarray] = None
+    ticks: Optional[int] = None
 
 
 class _Dispatch:
@@ -332,4 +365,524 @@ class RolloutService:
         the batched entry (0 unless the observatory is enabled) —
         the number bench_multitenant gates against
         ``spec.max_shapes``."""
+        return compile_watch.WATCH.compile_count(SERVE_ENTRY)
+
+
+# ---------------------------------------------------------------------------
+# Streaming service (r16): continuous batching + the SLO observatory.
+
+
+class _Stream:
+    """One in-flight streaming dispatch: the donated rollout carry
+    advanced segment by segment, plus everything harvested from it.
+
+    The carry rotation IS the double buffer: each segment's output
+    becomes the next segment's DONATED input, so XLA reuses the state
+    buffers across the whole rollout; anything the host needs later
+    (eviction views, the first-result probe, telemetry/trajectory ys)
+    is materialized as an independent buffer BEFORE the donating
+    launch, and read only after a successor launch is enqueued — the
+    device pipeline never waits on the host."""
+
+    def __init__(self, rids, reqs, capacity, size, params, states,
+                 seg_plan):
+        self.rids: List[int] = rids              # row i <-> rids[i]
+        self.reqs = reqs                         # aligned with rids
+        self.capacity = capacity
+        self.size = size
+        self.params = params
+        self.carry = states                      # device; donated next
+        self.seg_plan: Tuple[int, ...] = seg_plan
+        self.seg_done = 0
+        self.telem_segs: List = []               # [seg_len, S] leaves
+        self.traj_segs: List = []                # [seg_len, S, C, D]
+        self.probe = None                        # independent tick copy
+        self.first_stamped = False
+        self.evict_flags: Set[int] = set()
+        #: rid -> (ticks_elapsed, device state view, n_telem_segs)
+        self.evicted: Dict[int, tuple] = {}
+        self.collected: Set[int] = set()
+        self._host = None
+
+    @property
+    def done(self) -> bool:
+        return self.seg_done >= len(self.seg_plan)
+
+    def ticks_elapsed(self) -> int:
+        return sum(self.seg_plan[: self.seg_done])
+
+    def host_states(self) -> SwarmState:
+        """Final states as host numpy — the one-transfer-per-dispatch
+        discipline of the r13 `_Dispatch`; only legal once the stream
+        is done (the carry is never donated again)."""
+        if self._host is None:
+            jax.block_until_ready(self.carry.pos)
+            self._host = jax.tree_util.tree_map(np.asarray, self.carry)
+        return self._host
+
+    def _host_telem_seg(self, k: int):
+        """Segment ``k``'s recorder ys as host numpy, converted ONCE
+        per dispatch and cached in place — per-tenant slices are
+        then free views (the r13 ``_Dispatch.host_telem``
+        one-transfer-per-dispatch discipline; re-transferring the
+        full [T, S] batch per tenant multiplies collect-path
+        transfer time by the batch size)."""
+        t = self.telem_segs[k]
+        if not isinstance(t.tick, np.ndarray):
+            t = jax.tree_util.tree_map(np.asarray, t)
+            self.telem_segs[k] = t
+        return t
+
+    def _host_traj_seg(self, k: int):
+        """Segment ``k``'s trajectory as host numpy (same caching —
+        the trajectory is the largest buffer in the dispatch, the
+        worst offender of the one-transfer rule)."""
+        t = self.traj_segs[k]
+        if not isinstance(t, np.ndarray):
+            t = np.asarray(t)
+            self.traj_segs[k] = t
+        return t
+
+    def tenant_telem(self, i: int, n_segs=None):
+        """Tenant ``i``'s [T]-leaved recorder slice across the
+        harvested segments (``n_segs`` bounds the prefix for evicted
+        tenants)."""
+        n = len(self.telem_segs) if n_segs is None else n_segs
+        parts = [
+            jax.tree_util.tree_map(
+                lambda x, i=i: x[:, i], self._host_telem_seg(k)
+            )
+            for k in range(n)
+        ]
+        return concat_telemetry(parts) if parts else None
+
+    def tenant_traj(self, i: int, n_agents: int, n_segs=None):
+        n = len(self.traj_segs) if n_segs is None else n_segs
+        if not n:
+            return None
+        return np.concatenate(
+            [self._host_traj_seg(k)[:, i, :n_agents] for k in range(n)],
+            axis=0,
+        )
+
+
+class StreamingService:
+    """Continuous-batching streaming rollout service with a
+    first-class SLO observatory (r16) — the serve loop as an actual
+    service instead of a submit/flush/collect API.
+
+    Three mechanisms on top of :class:`RolloutService`'s bucket
+    lattice (shapes, params, parity semantics all unchanged):
+
+    - **Admission queue + deadline coalescing** (serve/queue.py):
+      ``submit`` enqueues; ``pump`` dispatches a shape group when it
+      fills the largest batch rung or when its oldest request's
+      ``deadline_s`` expires (padded via the bounded-pad tail).  An
+      optional ``max_queue`` bound makes backpressure loud
+      (:class:`~.queue.QueueOverflowError` + a queue-overflow event)
+      instead of a silent latency cliff.
+    - **Segmented rollouts + donated double-buffer rotation**: the
+      rollout runs as ``segment_steps``-tick segments; each segment's
+      output carry is DONATED into the next launch, and everything
+      the host reads (eviction views, the first-result probe) is
+      sliced into independent buffers before the donating call — so
+      collection never forces a ``block_until_ready`` on the next
+      dispatch's critical path (the ``serve-host-sync`` lint
+      contract).  Segment composition is bitwise: k segments of the
+      vmapped tick are the same arithmetic as one k·seg-tick scan, so
+      the r13 solo-parity contract survives the rewrite (pinned in
+      tests/test_serve_stream.py).
+    - **Mid-stream eviction/join**: ``evict(rid)`` returns a tenant's
+      PARTIAL results at the next segment boundary (bitwise-prefix-
+      equal to its solo rollout) via the existing batch-of-1
+      materializer views; a tenant submitted mid-stream joins the
+      next coalesced dispatch of its shape — no retrace, the shape is
+      already in the lattice.
+
+    Every request is stamped into the :class:`~.slo.SloTracker`
+    (``svc.slo``): time-in-queue and time-to-first-result
+    percentiles, queue-depth/in-flight gauges, per-dispatch
+    occupancy, and the deadline-miss / queue-overflow / eviction
+    alert events — the surface ``benchmarks/bench_soak.py`` gates and
+    ``swarmscope slo`` renders.
+
+    The compile budget grows only by the distinct segment lengths
+    (``n_steps = k·seg + rem`` → at most 2 scan lengths per bucket
+    shape), declared to the observatory like every serve budget.
+    """
+
+    def __init__(
+        self,
+        cfg: Optional[SwarmConfig] = None,
+        spec: Optional[BucketSpec] = None,
+        n_steps: int = 50,
+        segment_steps: Optional[int] = None,
+        deadline_s: float = DEFAULT_DEADLINE_S,
+        max_queue: Optional[int] = None,
+        telemetry: bool = True,
+        record: bool = False,
+        slo: Optional[SloTracker] = None,
+    ):
+        self.cfg = validate_serve_config(cfg or DEFAULT_CONFIG)
+        self.spec = spec or BucketSpec()
+        if n_steps <= 0:
+            raise ValueError(f"n_steps must be >= 1, got {n_steps}")
+        seg = n_steps if segment_steps is None else int(segment_steps)
+        if not 0 < seg <= n_steps:
+            raise ValueError(
+                f"segment_steps must be in [1, n_steps={n_steps}], "
+                f"got {seg}"
+            )
+        full, rem = divmod(n_steps, seg)
+        self.n_steps = int(n_steps)
+        self.segment_steps = seg
+        #: The segment schedule, e.g. n_steps=25, seg=10 -> (10, 10,
+        #: 5).  At most TWO distinct scan lengths — the compile-budget
+        #: multiplier.
+        self._seg_plan: Tuple[int, ...] = (seg,) * full + (
+            (rem,) if rem else ()
+        )
+        # Same effective-flag disjunction as RolloutService.
+        self.telemetry = bool(telemetry) or self.cfg.telemetry.enabled
+        self.record = bool(record)
+        self.max_queue = max_queue
+        self.slo = slo or SloTracker(deadline_s=deadline_s)
+        self.queue = AdmissionQueue(
+            self.spec, deadline_s, clock=self.slo.clock
+        )
+        self._next_rid = 0
+        self._streams: Dict[int, _Stream] = {}   # uncollected rids
+        self._live: List[_Stream] = []
+        self._requests: Dict[int, tuple] = {}
+        self._task_counts: set = set()
+        self.stats = {
+            "submitted": 0, "dispatches": 0, "padded_scenarios": 0,
+            "collected": 0, "evicted": 0,
+        }
+        self._declare_budgets(n_task_families=1)
+
+    def _declare_budgets(self, n_task_families: int) -> None:
+        # The r13 declaration times the distinct segment lengths:
+        # each (bucket shape, scan length) pair is one legitimate
+        # compile.  The materializer sees only the bucket shapes.
+        watch = compile_watch.WATCH
+        fams = max(n_task_families, 1)
+        shapes = self.spec.max_shapes * fams
+        budget = shapes * len(set(self._seg_plan))
+        for entry, b in (
+            (SERVE_ENTRY, budget), (MATERIALIZE_ENTRY, shapes + 1)
+        ):
+            prev = watch.bucket_budget(entry)
+            watch.declare_buckets(entry, max(b, prev or 0))
+
+    # -- submit ------------------------------------------------------------
+    def submit(self, req: ScenarioRequest) -> int:
+        """Enqueue one scenario; returns its request id.  Validation
+        is the r13 contract (fail at YOUR OWN submit); additionally
+        the declared queue bound rejects loudly — a queue-overflow
+        event plus :class:`~.queue.QueueOverflowError` — instead of
+        buffering unbounded latency."""
+        capacity = self.spec.capacity_for(req.n_agents)
+        validate_request(req)
+        if (
+            self.max_queue is not None
+            and self.queue.depth >= self.max_queue
+        ):
+            self.slo.on_queue_overflow(self.queue.depth, self.max_queue)
+            raise QueueOverflowError(
+                f"admission queue at its declared bound "
+                f"({self.queue.depth}/{self.max_queue}); pump() or "
+                "widen max_queue"
+            )
+        rid = self._next_rid
+        self._next_rid += 1
+        n_tasks = len(req.task_pos)
+        if n_tasks not in self._task_counts:
+            self._task_counts.add(n_tasks)
+            self._declare_budgets(len(self._task_counts))
+        self.slo.on_submit(rid)
+        self.queue.push(rid, req, capacity, n_tasks)
+        self._requests[rid] = (req, capacity)
+        self.stats["submitted"] += 1
+        return rid
+
+    # -- the host loop -----------------------------------------------------
+    def pump(self, force: bool = False) -> dict:
+        """One step of the serving loop: admit due rungs (rung-full
+        or deadline-expired; ``force`` admits everything — the drain
+        path), rotate every in-flight dispatch one segment, harvest
+        ready first-result probes, and sample the gauges.  Returns
+        ``{"launched": ..., "advanced": ...}``.  Never blocks on
+        device work except the probe stamp, which only reads a
+        segment whose successor is already enqueued."""
+        launched = self._admit(force=force)
+        advanced = self._advance()
+        self._harvest()
+        self.slo.sample(self.queue.depth, self.n_in_flight)
+        return {"launched": launched, "advanced": advanced}
+
+    def _admit(self, force: bool = False) -> int:
+        n = 0
+        for (capacity, _), entries, size in self.queue.pop_ready(
+            force=force
+        ):
+            self._launch_group(capacity, size, entries)
+            n += 1
+        return n
+
+    def _launch_group(self, capacity, size, entries) -> None:
+        rids = [e.rid for e in entries]
+        reqs = [e.req for e in entries]
+        for rid in rids:
+            self.slo.on_admit(rid)
+        self.stats["padded_scenarios"] += size - len(reqs)
+        states, params = materialize_batch(
+            reqs, capacity, self.cfg, pad_to=size
+        )
+        s = _Stream(rids, reqs, capacity, size, params, states,
+                    self._seg_plan)
+        for rid in rids:
+            self._streams[rid] = s
+        self._live.append(s)
+        self.slo.on_dispatch(size, len(reqs))
+        self.stats["dispatches"] += 1
+
+    def _advance(self) -> int:
+        """Rotate: one segment launch per in-flight dispatch.  At
+        each boundary, flagged evictions are sliced out of the carry
+        as independent batch-of-1 views BEFORE the donating launch
+        (async device slices — no host sync on this path)."""
+        n = 0
+        for s in self._live:
+            if s.done:
+                continue
+            for rid in sorted(s.evict_flags):
+                if rid in s.evicted:
+                    continue
+                i = s.rids.index(rid)
+                view = jax.tree_util.tree_map(
+                    lambda x, i=i: x[i], s.carry
+                )
+                s.evicted[rid] = (s.ticks_elapsed(), view, s.seg_done)
+                self.slo.on_eviction(rid, s.ticks_elapsed())
+                self.stats["evicted"] += 1
+            s.evict_flags.clear()
+            first = s.seg_done == 0
+            if first:
+                # Launch stamps BEFORE the jit dispatch: time-in-queue
+                # measures the admission policy; a cold shape's
+                # trace+compile belongs to TTFR (the tenant pays it),
+                # not to the queue.
+                self.slo.on_launch(s.rids)
+            seg_len = s.seg_plan[s.seg_done]
+            out = batched_rollout(
+                s.carry, s.params, self.cfg, seg_len,
+                record=self.record, telemetry=self.telemetry,
+            )
+            traj = telem = None
+            if self.record and self.telemetry:
+                states, traj, telem = out
+            elif self.record:
+                states, traj = out
+            elif self.telemetry:
+                states, telem = out
+            else:
+                states = out
+            s.carry = states
+            if traj is not None:
+                s.traj_segs.append(traj)
+            if telem is not None:
+                s.telem_segs.append(telem)
+            s.seg_done += 1
+            if first:
+                # The first-result probe: an INDEPENDENT copy of one
+                # tiny leaf of segment 1's output (the carry itself
+                # is donated into segment 2), harvested once it is
+                # observable — TTFR is a real observation, not a
+                # dispatch-time guess.
+                s.probe = jnp.copy(states.tick)
+            n += 1
+        return n
+
+    def _harvest(self) -> None:
+        """Stamp first-result probes that are observable.  Device
+        probes are polled via ``is_ready`` and only read once the
+        computation has finished — the stamp never blocks the pump,
+        even on a single-segment plan whose probe IS the final
+        output (a tenant collected before any poll observed it is
+        backfilled by ``SloTracker.on_collect``).  Probe leaves
+        without ``is_ready`` (host arrays) are observable as soon as
+        every segment is launched."""
+        for s in self._live:
+            if s.probe is None or s.first_stamped:
+                continue
+            is_ready = getattr(s.probe, "is_ready", None)
+            observable = s.done if is_ready is None else is_ready()
+            if observable:
+                # swarmlint: disable=serve-host-sync -- the probe is already finished (is_ready above) or a host array; the read cannot stall the pump
+                np.asarray(s.probe)
+                self.slo.on_first_result(s.rids)
+                s.first_stamped = True
+
+    # -- eviction / join ---------------------------------------------------
+    def evict(self, rid: int) -> bool:
+        """Remove a tenant mid-stream.  Queued: the request is
+        cancelled outright (collect then raises ``KeyError``).
+        In-flight: partial results are cut at the NEXT segment
+        boundary and ``collect`` returns them (``ticks`` = the
+        elapsed prefix, bitwise-prefix-equal to the solo rollout).
+        Returns False for unknown/done/already-evicted tenants (the
+        rollout finished first — collect returns the full result)."""
+        if rid in self.queue:
+            self.queue.remove(rid)
+            self._requests.pop(rid, None)
+            # The clock can never reach on_collect (collect raises
+            # for cancelled rids), so compact it here — the tracker
+            # holds one clock per OUTSTANDING request.
+            self.slo.clocks.pop(rid, None)
+            self.slo.on_eviction(rid, 0)
+            self.stats["evicted"] += 1
+            return True
+        s = self._streams.get(rid)
+        if (
+            s is None or s.done or rid in s.evicted
+            or rid in s.evict_flags
+        ):
+            return False
+        s.evict_flags.add(rid)
+        return True
+
+    # -- collect -----------------------------------------------------------
+    def ready_rids(self) -> List[int]:
+        """Request ids whose result can be collected without further
+        pumping (rollout complete, or eviction cut harvested)."""
+        return sorted(
+            rid for rid, s in self._streams.items()
+            if s.done or rid in s.evicted
+        )
+
+    def result_ready(self, rid: int) -> bool:
+        """True when ``collect(rid)`` returns without waiting on
+        device work: the rollout (or eviction cut) is fully launched
+        AND its result buffers are observable.  ``ready_rids`` means
+        "nothing left to pump" — collecting such a tenant still
+        blocks on the device for whatever segments are in flight; a
+        serving loop that must keep admitting (bench_soak's) gates
+        its collects on this instead, so the one legal blocking
+        transfer happens only when it no longer waits."""
+        s = self._streams.get(rid)
+        if s is None:
+            return False
+        if rid in s.evicted:
+            leaf = s.evicted[rid][1].pos
+        elif s.done:
+            if s._host is not None:
+                return True
+            leaf = s.carry.pos
+        else:
+            return False
+        is_ready = getattr(leaf, "is_ready", None)
+        return True if is_ready is None else bool(is_ready())
+
+    def active_rids(self) -> List[int]:
+        """Request ids admitted and still rolling (not done, not
+        evicted) — the evictable set a churn driver samples from."""
+        return sorted(
+            rid for rid, s in self._streams.items()
+            if not s.done and rid not in s.evicted
+            and rid not in s.evict_flags
+        )
+
+    def collect(self, rid: int) -> TenantResult:
+        """Drive the loop until ``rid``'s result is ready and return
+        it, evicting it from the service (the r13 result-store
+        contract: second collect raises ``KeyError``)."""
+        if rid not in self._requests:
+            raise KeyError(
+                f"request id {rid} is not in the service (never "
+                "submitted, cancelled while queued, or already "
+                "collected — results are evicted on collect)"
+            )
+        if rid in self.queue:
+            # Targeted release: dispatch only THIS rid's shape group
+            # — a blocking collect must not force-flush unrelated
+            # groups still coalescing toward their rung or deadline.
+            req, capacity = self._requests[rid]
+            for key, entries, size in self.queue.pop_group(
+                (capacity, len(req.task_pos))
+            ):
+                self._launch_group(key[0], size, entries)
+        s = self._streams.get(rid)
+        while s is not None and not (s.done or rid in s.evicted):
+            self.pump()
+        if s is None:                        # pragma: no cover
+            raise KeyError(f"request id {rid} lost its dispatch")
+        return self._result_for(s, rid)
+
+    def drain(self) -> Dict[int, TenantResult]:
+        """Admit everything immediately, run the loop to completion,
+        and collect every outstanding tenant (keyed by rid)."""
+        self.pump(force=True)
+        while any(not s.done for s in self._live):
+            self.pump()
+        return {rid: self.collect(rid) for rid in self.ready_rids()}
+
+    def _result_for(self, s: _Stream, rid: int) -> TenantResult:
+        req, capacity = self._requests.pop(rid)
+        i = s.rids.index(rid)
+        if rid in s.evicted:
+            ticks, view, n_segs = s.evicted.pop(rid)
+            state = jax.tree_util.tree_map(np.asarray, view)
+            summary = None
+            if self.telemetry and n_segs:
+                summary = TelemetrySummary.from_ticks(
+                    s.tenant_telem(i, n_segs)
+                ).to_dict()
+            traj = (
+                s.tenant_traj(i, req.n_agents, n_segs)
+                if self.record else None
+            )
+        else:
+            ticks = self.n_steps
+            state = tenant_state(s.host_states(), i)
+            summary = None
+            if self.telemetry and s.telem_segs:
+                summary = TelemetrySummary.from_ticks(
+                    s.tenant_telem(i)
+                ).to_dict()
+            traj = (
+                s.tenant_traj(i, req.n_agents) if self.record else None
+            )
+        s.collected.add(rid)
+        del self._streams[rid]
+        if not any(r in self._streams for r in s.rids):
+            # Every tenant of this stream is out: drop the buffers
+            # (result-store eviction, the r13 discipline).
+            try:
+                self._live.remove(s)
+            except ValueError:
+                pass
+        self.slo.on_collect(rid)
+        self.stats["collected"] += 1
+        return TenantResult(
+            request_id=rid,
+            n_agents=req.n_agents,
+            capacity=capacity,
+            state=state,
+            summary=summary,
+            traj=traj,
+            ticks=ticks,
+        )
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def n_pending(self) -> int:
+        return self.queue.depth
+
+    @property
+    def n_in_flight(self) -> int:
+        """Dispatches with segments still to launch."""
+        return sum(1 for s in self._live if not s.done)
+
+    def compile_entries(self) -> int:
         return compile_watch.WATCH.compile_count(SERVE_ENTRY)

@@ -1,0 +1,296 @@
+"""The SLO observatory for the streaming serve loop (r16).
+
+The r13 service could tell you WHAT it computed (per-tenant flight
+recorder, bitwise parity) but nothing about what a tenant
+*experienced*: how long a request sat in the queue, when its first
+results became observable, whether the admission deadline held.  This
+module is the host-side half of the observability story the on-device
+recorder (utils/telemetry.py) cannot carry — request latency lives in
+wall-clock time between host events, not in scan ys.
+
+**Timestamp taxonomy** (all ``time.monotonic`` seconds, host-side,
+one :class:`TenantClock` per request):
+
+    submit        request entered the admission queue
+    admit         request was assembled into a dispatch group
+                  (coalescing decided — rung full or deadline hit)
+    launch        the group's first rollout segment was dispatched
+    first_result  the host first OBSERVED device output for the
+                  tenant's dispatch (the segment-1 probe landed —
+                  a real observation, not a dispatch-time guess)
+    collect       the result was returned to the caller
+
+Derived latencies (milliseconds):
+
+    time-in-queue = launch - submit       (admission latency)
+    ttfr          = first_result - submit (time-to-first-result,
+                                           the headline SLO)
+
+Reduction is nearest-rank p50/p95/p99
+(``utils/telemetry.latency_percentiles`` — a gated p99 is a latency
+some request actually paid).  Gauges (queue depth, in-flight
+dispatches) are sampled per pump into a bounded trajectory, and
+per-dispatch batch occupancy records the filler fraction (pad rows
+still compute — wasted flops the bucket contract trades for bounded
+compiles).
+
+**Alert events** ride the same JSONL surface as the flight recorder's
+threshold crossings (``utils/telemetry.write_events_jsonl`` →
+``events.jsonl``, the file swarmscope reads):
+
+    deadline-miss   a tenant launched later than deadline + grace —
+                    the host loop stopped keeping up
+    queue-overflow  a submit was rejected at the declared queue bound
+    eviction        a tenant left mid-stream (partial results)
+
+The tracker is pure host bookkeeping: no jax import, no device
+arrays, so the serve hot loop's ``serve-host-sync`` lint contract is
+trivially honest here.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ..utils.telemetry import latency_percentiles
+
+#: Default admission deadline: how long a partially-filled rung may
+#: coalesce before it is dispatched padded (seconds).
+DEFAULT_DEADLINE_S = 0.05
+
+#: Gauge-trajectory bound: past this many samples the stored
+#: trajectory is decimated 2x and the sampling stride doubles, so a
+#: long soak keeps a full-span (coarser) trajectory in O(1) memory.
+MAX_GAUGE_SAMPLES = 4096
+
+
+@dataclass
+class TenantClock:
+    """One request's monotonic stamps (None = not reached)."""
+
+    rid: int
+    submit: float
+    admit: Optional[float] = None
+    launch: Optional[float] = None
+    first_result: Optional[float] = None
+    collect: Optional[float] = None
+
+    def queue_ms(self) -> Optional[float]:
+        if self.launch is None:
+            return None
+        return 1e3 * (self.launch - self.submit)
+
+    def ttfr_ms(self) -> Optional[float]:
+        if self.first_result is None:
+            return None
+        return 1e3 * (self.first_result - self.submit)
+
+
+class SloTracker:
+    """Per-tenant latency stamps + gauges + alert events.
+
+    ``clock`` is injectable (tests drive deterministic timelines);
+    everything else is plain lists/dicts — ``summary()`` is the
+    JSON-safe roll-up the run directory stores (``slo.json``) and
+    ``swarmscope slo`` renders."""
+
+    def __init__(
+        self,
+        deadline_s: float = DEFAULT_DEADLINE_S,
+        miss_grace_s: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+        max_gauge_samples: int = MAX_GAUGE_SAMPLES,
+    ):
+        if deadline_s <= 0:
+            raise ValueError(
+                f"deadline_s must be > 0, got {deadline_s} (the "
+                "coalescing wait bound)"
+            )
+        self.deadline_s = float(deadline_s)
+        #: A launch later than deadline + grace is a MISS: the
+        #: deadline itself is the design point (a coalescing group
+        #: legitimately launches AT its deadline), so the miss bar
+        #: sits one grace above it.  Default grace = the deadline.
+        self.miss_grace_s = float(
+            miss_grace_s if miss_grace_s is not None else deadline_s
+        )
+        self.clock = clock
+        self.t0 = clock()
+        #: IN-FLIGHT (and cancelled-while-queued) requests only:
+        #: ``on_collect`` compacts a finished clock into the float
+        #: sample lists below and drops the object, so a long-running
+        #: service holds one clock per outstanding request, not per
+        #: request ever served.  (The latency SAMPLE lists still grow
+        #: one float per request — a tracker covers one observation
+        #: window; a service that runs for weeks rotates trackers the
+        #: way bench_soak.py does after its warm pass.)
+        self.clocks: Dict[int, TenantClock] = {}
+        self._ttfr_ms: List[float] = []
+        self._queue_ms: List[float] = []
+        #: Alert events, JSONL-ready (monotonic ms offsets from t0).
+        self.events: List[dict] = []
+        #: [(t_ms, queue_depth, in_flight_dispatches), ...] — the
+        #: queue-depth trajectory, stride-decimated past the bound.
+        self.gauges: List[tuple] = []
+        self._gauge_stride = 1
+        self._gauge_skip = 0
+        self._max_gauge_samples = int(max_gauge_samples)
+        #: Dispatch-occupancy running totals (O(1), not per-dispatch
+        #: rows): filler fraction only ever needs the sums.
+        self.n_dispatches = 0
+        self._dispatch_rows = 0
+        self._dispatch_real = 0
+        self.deadline_misses = 0
+        self.queue_overflows = 0
+        self.evictions = 0
+
+    # -- stamps ------------------------------------------------------------
+    def _ms(self, t: float) -> float:
+        return 1e3 * (t - self.t0)
+
+    def on_submit(self, rid: int) -> None:
+        self.clocks[rid] = TenantClock(rid=rid, submit=self.clock())
+
+    def on_admit(self, rid: int) -> None:
+        c = self.clocks.get(rid)
+        if c is not None and c.admit is None:
+            c.admit = self.clock()
+
+    def on_launch(self, rids) -> None:
+        """Stamp a dispatch group's launch; fires one deadline-miss
+        event per tenant whose queue time overran deadline + grace."""
+        now = self.clock()
+        bar_ms = 1e3 * (self.deadline_s + self.miss_grace_s)
+        for rid in rids:
+            c = self.clocks.get(rid)
+            if c is None or c.launch is not None:
+                continue
+            c.launch = now
+            q_ms = c.queue_ms()
+            if q_ms is not None and q_ms > bar_ms:
+                self.deadline_misses += 1
+                self.events.append(
+                    {
+                        "event": "deadline-miss",
+                        "t_ms": round(self._ms(now), 3),
+                        "rid": rid,
+                        "queue_ms": round(q_ms, 3),
+                        "deadline_ms": round(1e3 * self.deadline_s, 3),
+                        "grace_ms": round(1e3 * self.miss_grace_s, 3),
+                    }
+                )
+
+    def on_first_result(self, rids) -> None:
+        """Idempotent: only the FIRST observation stamps."""
+        now = self.clock()
+        for rid in rids:
+            c = self.clocks.get(rid)
+            if c is not None and c.first_result is None:
+                c.first_result = now
+
+    def on_collect(self, rid: int) -> None:
+        c = self.clocks.get(rid)
+        if c is not None and c.collect is None:
+            c.collect = now = self.clock()
+            # A result collected before any probe observation (e.g.
+            # a single-segment dispatch drained straight through)
+            # still has a first observable moment: collection itself.
+            if c.first_result is None:
+                c.first_result = now
+            # Compact: the derived latencies are all the reduction
+            # ever reads — keep two floats, drop the clock.
+            t = c.ttfr_ms()
+            if t is not None:
+                self._ttfr_ms.append(t)
+            q = c.queue_ms()
+            if q is not None:
+                self._queue_ms.append(q)
+            del self.clocks[rid]
+
+    # -- alert events ------------------------------------------------------
+    def on_queue_overflow(self, depth: int, bound: int) -> None:
+        self.queue_overflows += 1
+        self.events.append(
+            {
+                "event": "queue-overflow",
+                "t_ms": round(self._ms(self.clock()), 3),
+                "depth": int(depth),
+                "bound": int(bound),
+            }
+        )
+
+    def on_eviction(self, rid: int, ticks: int) -> None:
+        self.evictions += 1
+        self.events.append(
+            {
+                "event": "eviction",
+                "t_ms": round(self._ms(self.clock()), 3),
+                "rid": rid,
+                "ticks": int(ticks),
+            }
+        )
+
+    # -- gauges ------------------------------------------------------------
+    def sample(self, queue_depth: int, in_flight: int) -> None:
+        """One pump's gauge sample; decimates 2x (and doubles the
+        stride) at the bound so a long soak keeps a full-span
+        trajectory instead of a truncated prefix."""
+        self._gauge_skip += 1
+        if self._gauge_skip < self._gauge_stride:
+            return
+        self._gauge_skip = 0
+        self.gauges.append(
+            (
+                round(self._ms(self.clock()), 3),
+                int(queue_depth),
+                int(in_flight),
+            )
+        )
+        if len(self.gauges) > self._max_gauge_samples:
+            self.gauges = self.gauges[::2]
+            self._gauge_stride *= 2
+
+    def on_dispatch(self, size: int, n_real: int) -> None:
+        self.n_dispatches += 1
+        self._dispatch_rows += int(size)
+        self._dispatch_real += int(n_real)
+
+    # -- reduction ---------------------------------------------------------
+    def ttfr_ms(self) -> List[float]:
+        """Collected samples plus any in-flight request that already
+        has an observed first result."""
+        return self._ttfr_ms + [
+            c.ttfr_ms() for c in self.clocks.values()
+            if c.ttfr_ms() is not None
+        ]
+
+    def queue_ms(self) -> List[float]:
+        return self._queue_ms + [
+            c.queue_ms() for c in self.clocks.values()
+            if c.queue_ms() is not None
+        ]
+
+    def filler_fraction(self) -> float:
+        """Wasted-flops fraction over all dispatches: pad rows /
+        total rows (0.0 with no dispatches)."""
+        total = self._dispatch_rows
+        return (total - self._dispatch_real) / total if total else 0.0
+
+    def summary(self) -> dict:
+        """JSON-safe roll-up — the ``slo.json`` run-dir artifact and
+        the ``swarmscope slo`` rendering surface."""
+        return {
+            "deadline_ms": round(1e3 * self.deadline_s, 3),
+            "miss_grace_ms": round(1e3 * self.miss_grace_s, 3),
+            "ttfr_ms": latency_percentiles(self.ttfr_ms()),
+            "queue_ms": latency_percentiles(self.queue_ms()),
+            "deadline_misses": self.deadline_misses,
+            "queue_overflows": self.queue_overflows,
+            "evictions": self.evictions,
+            "dispatches": self.n_dispatches,
+            "filler_fraction": round(self.filler_fraction(), 4),
+            "gauge_stride": self._gauge_stride,
+            "queue_depth": [list(g) for g in self.gauges],
+        }

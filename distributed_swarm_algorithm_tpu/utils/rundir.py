@@ -24,7 +24,9 @@ The gating rules here MUST stay in lockstep with
 ``benchmarks/compare.py`` (the union gate): units ``findings`` /
 ``rounds`` / ``events`` / ``ticks`` / ``compiles`` / ``bytes`` (r12 —
 halo-exchange traffic) / ``collectives`` (r15 — jaxlint's per-entry
-scan-body collective census) are lower-is-better
+scan-body collective census) / ``ms-p50`` / ``ms-p99`` (r16 — the
+serve SLO latency percentiles: a tail-latency regression gates like
+a byte-volume regression) are lower-is-better
 counts (a clean 0 baseline regressing to any positive count always
 gates), unit ``pct`` gates against the absolute :data:`PCT_CEILING`
 and unit ``overhead-pct`` against :data:`OVERHEAD_PCT_CEILING`
@@ -50,11 +52,14 @@ MANIFEST = "manifest.json"
 METRICS = "metrics.jsonl"
 TELEMETRY = "telemetry_summary.json"
 EVENTS = "events.jsonl"
+SLO = "slo.json"
 COMPILE_DIR = "compile"
 
 #: Lower-is-better count units (mirror of compare.py's tuple).
+#: "ms-p50"/"ms-p99" (r16): serve-SLO latency percentiles — growth
+#: past threshold gates, paydown never does.
 COUNT_UNITS = ("findings", "rounds", "events", "ticks", "compiles",
-               "bytes", "collectives")
+               "bytes", "collectives", "ms-p50", "ms-p99")
 
 #: Absolute ceiling for unit-"pct" metrics (compare.PCT_CEILING).
 PCT_CEILING = 5.0
@@ -134,6 +139,27 @@ def append_events(run_dir: str, events: List[dict]) -> int:
     return len(events)
 
 
+def merge_slo_summary(run_dir: str, tag: str, summary: dict) -> str:
+    """Merge one scenario's SLO-tracker summary (serve/slo.py
+    ``SloTracker.summary()`` — latency percentiles, gauges, alert
+    counts, the queue-depth trajectory) into ``slo.json`` under its
+    tag — the artifact ``swarmscope slo`` renders (r16)."""
+    os.makedirs(run_dir, exist_ok=True)
+    path = os.path.join(run_dir, SLO)
+    data: dict = {}
+    if os.path.exists(path):
+        try:
+            with open(path) as fh:
+                data = json.load(fh)
+        except (json.JSONDecodeError, OSError):
+            data = {}
+    data[tag] = summary
+    with open(path, "w") as fh:
+        json.dump(data, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
 # ---------------------------------------------------------------------------
 # Reading
 
@@ -148,6 +174,7 @@ class RunData:
     failures: List[dict] = field(default_factory=list)
     telemetry: dict = field(default_factory=dict)
     events: List[dict] = field(default_factory=list)
+    slo: dict = field(default_factory=dict)      # tag -> SLO summary
     compile_entries: dict = field(default_factory=dict)
     compile_events: List[dict] = field(default_factory=list)
 
@@ -189,6 +216,13 @@ def load_run(run_dir: str) -> RunData:
     if os.path.exists(tpath):
         with open(tpath) as fh:
             run.telemetry = json.load(fh)
+    spath = os.path.join(run_dir, SLO)
+    if os.path.exists(spath):
+        try:
+            with open(spath) as fh:
+                run.slo = json.load(fh)
+        except (json.JSONDecodeError, OSError):
+            run.slo = {}
     epath = os.path.join(run_dir, EVENTS)
     if os.path.exists(epath):
         with open(epath) as fh:

@@ -614,6 +614,51 @@ def summarize_telemetry(t: TickTelemetry) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Latency reduction (r16, the serve plane's SLO observatory)
+#
+# Host-side, pure-python percentile helpers for the streaming service
+# (serve/slo.py): per-tenant monotonic timestamps reduce to the
+# p50/p95/p99 rows the soak bench gates.  They live here — not in
+# serve/ — because they are generic latency reducers with the same
+# role TelemetrySummary plays for the on-device record, and utils
+# stays the one leaf layer every reporting surface can import.
+
+
+def percentile(samples: List[float], q: float) -> float:
+    """Nearest-rank percentile of ``samples`` (q in [0, 100]).
+
+    Nearest-rank (not interpolated) deliberately: a gated p99 must be
+    a latency some request actually PAID — an interpolated value
+    between two observations can pass a ceiling neither sample
+    satisfies.  Empty input returns 0.0 (a zero-traffic soak has no
+    latency to gate)."""
+    if not 0.0 <= q <= 100.0:
+        raise ValueError(f"percentile q must be in [0, 100], got {q}")
+    xs = sorted(samples)
+    if not xs:
+        return 0.0
+    import math
+
+    rank = max(1, math.ceil(q / 100.0 * len(xs)))
+    return float(xs[rank - 1])
+
+
+def latency_percentiles(samples: List[float]) -> dict:
+    """The SLO reduction of one latency series: ``{p50, p95, p99,
+    max, mean, n}`` — JSON-safe, the shape serve/slo.py summaries and
+    the ``swarmscope slo`` renderer share."""
+    xs = [float(x) for x in samples]
+    return {
+        "p50": percentile(xs, 50.0),
+        "p95": percentile(xs, 95.0),
+        "p99": percentile(xs, 99.0),
+        "max": max(xs) if xs else 0.0,
+        "mean": (sum(xs) / len(xs)) if xs else 0.0,
+        "n": len(xs),
+    }
+
+
+# ---------------------------------------------------------------------------
 # Threshold-crossing event log (JSONL)
 
 

@@ -130,6 +130,32 @@ def test_bytes_unit_is_lower_is_better(hist):
     assert compare.compare("r02", "r03", path=hist) == 0  # paydown ok
 
 
+def test_latency_units_are_lower_is_better(hist):
+    # r16 serve-SLO latency percentiles (ms-p50 / ms-p99): a
+    # tail-latency regression gates exactly like a byte-volume
+    # regression; a latency paydown never gates.
+    compare.record("r01", [
+        {"metric": "soak-ttfr-ms-p99, 60s mixed cpu", "value": 800.0,
+         "unit": "ms-p99"},
+        {"metric": "soak-ttfr-ms-p50, 60s mixed cpu", "value": 300.0,
+         "unit": "ms-p50"},
+    ], path=hist)
+    compare.record("r02", [
+        {"metric": "soak-ttfr-ms-p99, 60s mixed cpu", "value": 1100.0,
+         "unit": "ms-p99"},   # +37% tail regression gates
+        {"metric": "soak-ttfr-ms-p50, 60s mixed cpu", "value": 300.0,
+         "unit": "ms-p50"},
+    ], path=hist)
+    assert compare.compare("r01", "r02", path=hist) == 1
+    compare.record("r03", [
+        {"metric": "soak-ttfr-ms-p99, 60s mixed cpu", "value": 500.0,
+         "unit": "ms-p99"},
+        {"metric": "soak-ttfr-ms-p50, 60s mixed cpu", "value": 250.0,
+         "unit": "ms-p50"},
+    ], path=hist)
+    assert compare.compare("r02", "r03", path=hist) == 0  # paydown ok
+
+
 def test_pct_unit_gates_on_absolute_ceiling(hist):
     # Telemetry overhead (unit "pct"): gated against the ABSOLUTE 5%
     # ceiling, not relative growth — 0.1% -> 3% is fine (30x growth),

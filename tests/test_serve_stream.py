@@ -1,0 +1,553 @@
+"""The streaming serve loop + SLO observatory (r16, serve/queue.py,
+serve/slo.py, serve/service.StreamingService).
+
+Three layers:
+
+- **host policy, deterministically clocked**: the admission queue's
+  release rules (rung-full fast path, deadline flush, FIFO order) and
+  the SLO tracker's stamp taxonomy / alert events run against an
+  injected fake clock, so every latency and every deadline-miss in
+  these tests is exact, not timing-dependent;
+- **the parity contract under streaming**: segmented rollouts with
+  donated carry rotation must stay BITWISE equal to the one-shot r13
+  dispatch and to solo ``swarm_rollout`` — under out-of-order
+  collection, mid-stream eviction (prefix equality at the cut tick),
+  and a tenant joining between dispatches;
+- **the compile-budget contract**: a joiner whose shape is already in
+  the lattice rides the next coalesced dispatch without a retrace
+  (compile-observatory count pinned), and the streaming service's
+  declared budget covers its segment schedule.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu import serve
+from distributed_swarm_algorithm_tpu.utils import compile_watch as cw
+from distributed_swarm_algorithm_tpu.utils.telemetry import (
+    latency_percentiles,
+    percentile,
+)
+
+CFG = dsa.SwarmConfig().replace(
+    formation_shape="none", utility_threshold=2.0
+)
+
+PARITY_FIELDS = (
+    "pos", "vel", "fsm", "leader_id", "task_winner", "task_util",
+    "alive", "tick", "last_hb_tick", "alive_below",
+)
+
+
+def _assert_state_parity(solo, got, label=""):
+    for f in PARITY_FIELDS:
+        a = np.asarray(getattr(solo, f))
+        b = np.asarray(getattr(got, f))
+        assert np.array_equal(a, b), f"{label}: field {f} diverged"
+
+
+def _solo(req, capacity, cfg, n_steps):
+    s, p = serve.materialize_scenario(req, capacity, cfg)
+    return dsa.swarm_rollout(s, None, serve.bake_params(cfg, p),
+                             n_steps)
+
+
+def _drain(svc):
+    """Run the service loop to completion and collect everything."""
+    return svc.drain()
+
+
+class FakeClock:
+    """Injectable monotonic clock: tests advance it explicitly, so
+    queue deadlines and SLO latencies are exact."""
+
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+# ------------------------------------------------------ admission queue
+
+
+def _req(n_agents=8, seed=0):
+    return serve.ScenarioRequest(n_agents=n_agents, seed=seed)
+
+
+def test_queue_releases_full_rung_immediately():
+    clock = FakeClock()
+    spec = serve.BucketSpec(capacities=(8, 16), batches=(1, 2, 4))
+    q = serve.AdmissionQueue(spec, deadline_s=10.0, clock=clock)
+    for i in range(4):
+        q.push(i, _req(seed=i), 8, 0)
+    # Largest rung filled: released NOW, deadline nowhere near.
+    out = q.pop_ready()
+    assert len(out) == 1
+    (key, entries, size) = out[0]
+    assert key == (8, 0) and size == 4
+    assert [e.rid for e in entries] == [0, 1, 2, 3]  # FIFO
+    assert q.depth == 0
+
+
+def test_queue_holds_partial_group_until_deadline():
+    clock = FakeClock()
+    spec = serve.BucketSpec(capacities=(8,), batches=(1, 2, 4))
+    q = serve.AdmissionQueue(spec, deadline_s=0.5, clock=clock)
+    q.push(0, _req(seed=0), 8, 0)
+    q.push(1, _req(seed=1), 8, 0)
+    assert q.pop_ready() == []           # under rung, under deadline
+    assert q.depth == 2
+    clock.advance(0.6)                   # oldest request expires
+    out = q.pop_ready()
+    assert len(out) == 1
+    _, entries, size = out[0]
+    assert [e.rid for e in entries] == [0, 1]
+    assert size == 2                     # exact rung, no padding
+    assert q.depth == 0
+
+
+def test_queue_deadline_flush_pads_to_rung():
+    # 3 expired requests with rungs (1, 4): split_batch pads to 4.
+    clock = FakeClock()
+    spec = serve.BucketSpec(capacities=(8,), batches=(1, 4))
+    q = serve.AdmissionQueue(spec, deadline_s=0.1, clock=clock)
+    for i in range(3):
+        q.push(i, _req(seed=i), 8, 0)
+    clock.advance(0.2)
+    out = q.pop_ready()
+    assert [(size, len(entries)) for _, entries, size in out] == [
+        (4, 3)
+    ]
+
+
+def test_queue_groups_by_shape_key():
+    # Distinct (capacity, n_tasks) keys never co-batch: a full rung
+    # in one group does not release the other.
+    clock = FakeClock()
+    spec = serve.BucketSpec(capacities=(8, 16), batches=(1, 2))
+    q = serve.AdmissionQueue(spec, deadline_s=5.0, clock=clock)
+    q.push(0, _req(seed=0), 8, 0)
+    q.push(1, _req(n_agents=12, seed=1), 16, 0)
+    q.push(2, _req(seed=2), 8, 0)        # fills the (8, 0) rung
+    out = q.pop_ready()
+    assert len(out) == 1
+    assert out[0][0] == (8, 0)
+    assert q.depth == 1                  # the 16-cap request waits
+    # force releases the rest.
+    out = q.pop_ready(force=True)
+    assert len(out) == 1 and out[0][0] == (16, 0)
+
+
+def test_queue_remove_and_contains():
+    clock = FakeClock()
+    spec = serve.BucketSpec(capacities=(8,), batches=(1, 2))
+    q = serve.AdmissionQueue(spec, deadline_s=1.0, clock=clock)
+    q.push(0, _req(seed=0), 8, 0)
+    assert 0 in q and 1 not in q
+    assert q.remove(0) is True
+    assert q.remove(0) is False
+    assert q.depth == 0
+
+
+def test_queue_rejects_nonpositive_deadline():
+    spec = serve.BucketSpec(capacities=(8,), batches=(1,))
+    with pytest.raises(ValueError, match="deadline_s"):
+        serve.AdmissionQueue(spec, deadline_s=0.0)
+
+
+# ------------------------------------------------------ SLO tracker
+
+
+def test_slo_stamps_and_percentiles_deterministic():
+    clock = FakeClock()
+    slo = serve.SloTracker(deadline_s=1.0, clock=clock)
+    for rid, (q_wait, run_wait) in enumerate(
+        [(0.1, 0.2), (0.3, 0.4), (0.5, 0.6)]
+    ):
+        t0 = clock.t
+        slo.on_submit(rid)
+        clock.advance(q_wait)
+        slo.on_admit(rid)
+        slo.on_launch([rid])
+        clock.advance(run_wait)
+        slo.on_first_result([rid])
+        slo.on_collect(rid)
+        assert clock.t == pytest.approx(t0 + q_wait + run_wait)
+    s = slo.summary()
+    assert s["queue_ms"]["p50"] == pytest.approx(300.0)
+    assert s["queue_ms"]["p99"] == pytest.approx(500.0)
+    assert s["ttfr_ms"]["p50"] == pytest.approx(700.0)
+    assert s["ttfr_ms"]["p99"] == pytest.approx(1100.0)
+    assert s["ttfr_ms"]["n"] == 3
+    assert s["deadline_misses"] == 0
+
+
+def test_slo_deadline_miss_event_fires_past_grace():
+    # Miss bar = deadline + grace (a coalescing group legitimately
+    # launches AT its deadline; one grace above is the alert).
+    clock = FakeClock()
+    slo = serve.SloTracker(deadline_s=0.1, miss_grace_s=0.1,
+                           clock=clock)
+    slo.on_submit(0)
+    clock.advance(0.15)                  # within deadline + grace
+    slo.on_launch([0])
+    slo.on_submit(1)
+    clock.advance(0.25)                  # past the bar: a MISS
+    slo.on_launch([1])
+    assert slo.deadline_misses == 1
+    ev = [e for e in slo.events if e["event"] == "deadline-miss"]
+    assert len(ev) == 1
+    assert ev[0]["rid"] == 1
+    assert ev[0]["queue_ms"] == pytest.approx(250.0)
+    # Re-stamping is idempotent: no double miss.
+    slo.on_launch([1])
+    assert slo.deadline_misses == 1
+
+
+def test_slo_eviction_and_overflow_events():
+    clock = FakeClock()
+    slo = serve.SloTracker(deadline_s=1.0, clock=clock)
+    slo.on_queue_overflow(depth=16, bound=16)
+    slo.on_eviction(rid=3, ticks=20)
+    assert slo.queue_overflows == 1 and slo.evictions == 1
+    kinds = sorted(e["event"] for e in slo.events)
+    assert kinds == ["eviction", "queue-overflow"]
+    ev = {e["event"]: e for e in slo.events}
+    assert ev["queue-overflow"]["depth"] == 16
+    assert ev["eviction"]["ticks"] == 20
+
+
+def test_slo_collect_backfills_first_result():
+    # A result collected before any probe observation still has a
+    # first observable moment: collection itself.
+    clock = FakeClock()
+    slo = serve.SloTracker(deadline_s=1.0, clock=clock)
+    slo.on_submit(0)
+    clock.advance(0.2)
+    slo.on_launch([0])
+    clock.advance(0.3)
+    slo.on_collect(0)
+    s = slo.summary()
+    assert s["ttfr_ms"]["max"] == pytest.approx(500.0)
+    assert s["ttfr_ms"]["n"] == 1
+    # Compaction: the finished clock is gone (a long-lived service
+    # holds one clock per OUTSTANDING request), the sample stays.
+    assert 0 not in slo.clocks
+
+
+def test_slo_gauge_trajectory_decimates_not_truncates():
+    clock = FakeClock()
+    slo = serve.SloTracker(deadline_s=1.0, clock=clock,
+                           max_gauge_samples=8)
+    for i in range(40):
+        clock.advance(1.0)
+        slo.sample(queue_depth=i, in_flight=1)
+    s = slo.summary()
+    traj = s["queue_depth"]
+    assert len(traj) <= 8
+    # Full span survives (decimation, not a truncated prefix): the
+    # last stored sample is from the tail of the run.
+    assert traj[-1][1] >= 32
+    assert s["gauge_stride"] > 1
+
+
+def test_slo_filler_fraction():
+    slo = serve.SloTracker(deadline_s=1.0, clock=FakeClock())
+    slo.on_dispatch(size=4, n_real=3)
+    slo.on_dispatch(size=4, n_real=4)
+    assert slo.filler_fraction() == pytest.approx(1.0 / 8.0)
+
+
+# ------------------------------------------------ percentile reduction
+
+
+def test_percentile_is_nearest_rank():
+    xs = [10.0, 20.0, 30.0, 40.0]
+    # Nearest-rank: every return value is an OBSERVED sample.
+    assert percentile(xs, 50.0) == 20.0
+    assert percentile(xs, 75.0) == 30.0
+    assert percentile(xs, 99.0) == 40.0
+    assert percentile(xs, 0.0) == 10.0
+    assert percentile([], 99.0) == 0.0
+    with pytest.raises(ValueError, match="q must be"):
+        percentile(xs, 101.0)
+
+
+def test_latency_percentiles_shape():
+    d = latency_percentiles([5.0, 1.0, 3.0])
+    assert d == {
+        "p50": 3.0, "p95": 5.0, "p99": 5.0, "max": 5.0,
+        "mean": 3.0, "n": 3,
+    }
+
+
+# ------------------------------------------- streaming service parity
+
+
+def _spec():
+    return serve.BucketSpec(capacities=(16, 32), batches=(1, 2))
+
+
+def test_streaming_segmented_equals_solo_bitwise():
+    # The load-bearing contract: k segments of the vmapped tick with
+    # donated carry rotation are the SAME arithmetic as one k*seg
+    # scan — streaming results bitwise-equal solo rollouts.
+    svc = serve.StreamingService(
+        CFG, spec=_spec(), n_steps=10, segment_steps=4,
+        deadline_s=0.001, telemetry=False,
+    )
+    reqs = [
+        serve.ScenarioRequest(n_agents=12, seed=3,
+                              params={"k_att": 1.5}),
+        serve.ScenarioRequest(n_agents=30, seed=4, arena_hw=12.0,
+                              params={"k_sep": 10.0}),
+        serve.ScenarioRequest(n_agents=16, seed=5, kill_ids=(2,)),
+    ]
+    rids = [svc.submit(r) for r in reqs]
+    res = _drain(svc)
+    assert sorted(res) == sorted(rids)
+    for rid, req in zip(rids, reqs):
+        cap = _spec().capacity_for(req.n_agents)
+        solo = _solo(req, cap, CFG, 10)
+        _assert_state_parity(solo, res[rid].state, f"tenant {rid}")
+        assert res[rid].ticks == 10
+
+
+def test_streaming_out_of_order_collect():
+    # Collect NEWEST-first across two bucket shapes: eviction-on-
+    # collect bookkeeping must not care about submission order.
+    svc = serve.StreamingService(
+        CFG, spec=_spec(), n_steps=6, segment_steps=3,
+        deadline_s=0.001, telemetry=False,
+    )
+    reqs = [
+        serve.ScenarioRequest(n_agents=10, seed=i)
+        if i % 2 else serve.ScenarioRequest(n_agents=20, seed=i)
+        for i in range(4)
+    ]
+    rids = [svc.submit(r) for r in reqs]
+    svc.pump(force=True)
+    while any(not s.done for s in svc._live):
+        svc.pump()
+    for rid in sorted(rids, reverse=True):
+        res = svc.collect(rid)
+        req = reqs[rids.index(rid)]
+        cap = _spec().capacity_for(req.n_agents)
+        _assert_state_parity(
+            _solo(req, cap, CFG, 6), res.state, f"ooo tenant {rid}"
+        )
+    with pytest.raises(KeyError, match="not in the service"):
+        svc.collect(rids[0])             # evicted on collect
+
+
+def test_streaming_eviction_returns_bitwise_prefix():
+    svc = serve.StreamingService(
+        CFG, spec=_spec(), n_steps=12, segment_steps=4,
+        deadline_s=0.001, telemetry=False,
+    )
+    keep = svc.submit(serve.ScenarioRequest(n_agents=14, seed=7))
+    leave = svc.submit(serve.ScenarioRequest(n_agents=15, seed=8))
+    svc.pump(force=True)                 # both admitted, segment 1
+    assert svc.evict(leave) is True
+    assert svc.evict(leave) is False     # already flagged
+    res = _drain(svc)
+    # The evicted tenant's partial result is cut at a segment
+    # boundary after the evict call, strictly before the full run...
+    cut = res[leave].ticks
+    assert 4 <= cut < 12 and cut % 4 == 0
+    # ...and is bitwise-prefix-equal to its solo rollout at that tick.
+    req_leave = serve.ScenarioRequest(n_agents=15, seed=8)
+    _assert_state_parity(
+        _solo(req_leave, 16, CFG, cut), res[leave].state,
+        "evicted prefix",
+    )
+    assert svc.stats["evicted"] == 1
+    assert any(
+        e["event"] == "eviction" for e in svc.slo.events
+    )
+    # The co-batched tenant is untouched: full-length, full parity.
+    assert res[keep].ticks == 12
+    _assert_state_parity(
+        _solo(serve.ScenarioRequest(n_agents=14, seed=7), 16, CFG, 12),
+        res[keep].state, "co-batched survivor",
+    )
+
+
+def test_streaming_queued_eviction_cancels():
+    svc = serve.StreamingService(
+        CFG, spec=_spec(), n_steps=4, segment_steps=2,
+        deadline_s=60.0, telemetry=False,
+    )
+    rid = svc.submit(serve.ScenarioRequest(n_agents=10, seed=1))
+    assert svc.evict(rid) is True        # still queued: cancelled
+    assert svc.n_pending == 0
+    # The cancelled clock compacts immediately (collect can never
+    # fire for it) — the tracker holds outstanding requests only.
+    assert rid not in svc.slo.clocks
+    with pytest.raises(KeyError):
+        svc.collect(rid)
+    assert svc.evict(999) is False       # unknown rid
+
+
+def test_streaming_collect_on_queued_rid_releases_only_its_group():
+    # A blocking collect on a queued rid dispatches THAT shape group
+    # only; an unrelated group keeps coalescing toward its own
+    # deadline instead of being force-flushed at partial fill.
+    svc = serve.StreamingService(
+        CFG, spec=_spec(), n_steps=4, segment_steps=2,
+        deadline_s=60.0, telemetry=False,
+    )
+    small = svc.submit(serve.ScenarioRequest(n_agents=10, seed=0))
+    large = svc.submit(serve.ScenarioRequest(n_agents=30, seed=1))
+    res = svc.collect(small)             # queued -> targeted release
+    assert res.ticks == 4
+    assert svc.n_pending == 1            # the 32-cap tenant still
+    assert large in svc.queue            # coalescing, undispatched
+    assert svc.collect(large).ticks == 4
+
+
+def test_streaming_join_without_retrace():
+    # A tenant submitted mid-stream whose shape is already in the
+    # lattice joins the next coalesced dispatch with ZERO new
+    # compiles — the compile-observatory pin.
+    watch = cw.WATCH
+    was_enabled = watch.enabled
+    watch.reset()
+    watch.enable()
+    try:
+        svc = serve.StreamingService(
+            CFG, spec=serve.BucketSpec(capacities=(16,), batches=(1,)),
+            n_steps=6, segment_steps=3, deadline_s=0.001,
+            telemetry=False,
+        )
+        first = svc.submit(serve.ScenarioRequest(n_agents=10, seed=0))
+        svc.pump(force=True)             # dispatch 1 in flight
+        entries_before = watch.compile_count(serve.SERVE_ENTRY)
+        assert entries_before >= 1
+        # The joiner arrives MID-STREAM of dispatch 1.
+        joiner = svc.submit(serve.ScenarioRequest(n_agents=12, seed=1))
+        res = _drain(svc)
+        assert sorted(res) == sorted([first, joiner])
+        assert watch.compile_count(serve.SERVE_ENTRY) == entries_before
+        assert watch.within_bucket_budget(serve.SERVE_ENTRY)
+        _assert_state_parity(
+            _solo(serve.ScenarioRequest(n_agents=12, seed=1), 16,
+                  CFG, 6),
+            res[joiner].state, "joiner",
+        )
+    finally:
+        watch.reset()
+        watch.enabled = was_enabled
+
+
+def test_streaming_declared_budget_covers_segment_schedule():
+    # n_steps=10, seg=4 -> plan (4, 4, 2): two distinct scan lengths,
+    # so the declared budget is max_shapes * 2.
+    watch = cw.WATCH
+    was_enabled = watch.enabled
+    watch.reset()
+    watch.enable()
+    try:
+        spec = serve.BucketSpec(capacities=(16,), batches=(1, 2))
+        svc = serve.StreamingService(
+            CFG, spec=spec, n_steps=10, segment_steps=4,
+            deadline_s=0.001, telemetry=False,
+        )
+        assert svc._seg_plan == (4, 4, 2)
+        assert watch.bucket_budget(serve.SERVE_ENTRY) >= (
+            spec.max_shapes * 2
+        )
+        rid = svc.submit(serve.ScenarioRequest(n_agents=8, seed=0))
+        res = _drain(svc)
+        assert res[rid].ticks == 10
+        assert watch.within_bucket_budget(serve.SERVE_ENTRY)
+    finally:
+        watch.reset()
+        watch.enabled = was_enabled
+
+
+def test_result_ready_gates_the_blocking_collect():
+    # ready_rids means "nothing left to pump"; result_ready
+    # additionally means "the blocking transfer no longer waits" —
+    # the probe a serving loop uses to keep collection off the
+    # pump's critical path.
+    svc = serve.StreamingService(
+        CFG, spec=_spec(), n_steps=6, segment_steps=3,
+        deadline_s=0.001, telemetry=False,
+    )
+    rid = svc.submit(serve.ScenarioRequest(n_agents=10, seed=0))
+    assert svc.result_ready(rid) is False      # still queued
+    svc.pump(force=True)                       # segment 1 launched
+    assert svc.result_ready(rid) is False      # segments left to pump
+    while not svc.result_ready(rid):
+        svc.pump()
+    assert rid in svc.ready_rids()
+    res = svc.collect(rid)
+    assert res.ticks == 6
+    assert svc.result_ready(rid) is False      # evicted on collect
+    assert svc.result_ready(999) is False      # unknown rid
+
+
+def test_streaming_queue_overflow_is_loud():
+    svc = serve.StreamingService(
+        CFG, spec=_spec(), n_steps=4, segment_steps=4,
+        deadline_s=60.0, max_queue=2, telemetry=False,
+    )
+    svc.submit(serve.ScenarioRequest(n_agents=8, seed=0))
+    svc.submit(serve.ScenarioRequest(n_agents=8, seed=1))
+    with pytest.raises(serve.QueueOverflowError, match="declared"):
+        svc.submit(serve.ScenarioRequest(n_agents=8, seed=2))
+    assert svc.slo.queue_overflows == 1
+    assert any(
+        e["event"] == "queue-overflow" for e in svc.slo.events
+    )
+    # The rejected request never entered: draining serves exactly 2.
+    assert len(_drain(svc)) == 2
+
+
+def test_streaming_telemetry_summary_per_tenant():
+    # Segmented recorder ys concatenate to the full rollout: the
+    # tenant summary covers every tick.
+    svc = serve.StreamingService(
+        CFG, spec=_spec(), n_steps=8, segment_steps=3,
+        deadline_s=0.001, telemetry=True,
+    )
+    rid = svc.submit(serve.ScenarioRequest(n_agents=12, seed=2))
+    res = _drain(svc)
+    assert res[rid].summary is not None
+    assert res[rid].summary["ticks"] == 8
+
+
+def test_streaming_validates_constructor_args():
+    with pytest.raises(ValueError, match="segment_steps"):
+        serve.StreamingService(CFG, n_steps=4, segment_steps=5)
+    with pytest.raises(ValueError, match="segment_steps"):
+        serve.StreamingService(CFG, n_steps=4, segment_steps=0)
+    with pytest.raises(ValueError, match="n_steps"):
+        serve.StreamingService(CFG, n_steps=0)
+
+
+def test_streaming_slo_summary_covers_all_collected():
+    svc = serve.StreamingService(
+        CFG, spec=_spec(), n_steps=4, segment_steps=2,
+        deadline_s=0.001, telemetry=False,
+    )
+    rids = [
+        svc.submit(serve.ScenarioRequest(n_agents=8 + i, seed=i))
+        for i in range(3)
+    ]
+    _drain(svc)
+    s = svc.slo.summary()
+    assert s["ttfr_ms"]["n"] == len(rids)
+    assert s["queue_ms"]["n"] == len(rids)
+    assert s["dispatches"] == svc.stats["dispatches"]
+    # Every latency is a real nonnegative wall-clock measurement.
+    assert s["ttfr_ms"]["p99"] >= s["ttfr_ms"]["p50"] >= 0.0

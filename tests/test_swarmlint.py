@@ -264,6 +264,16 @@ SEEDED = {
             return body(pos)
         """,
     ),
+    "serve-host-sync": (
+        "pkg/serve/loop.py",
+        """
+        import jax
+
+        def pump(carry):
+            jax.block_until_ready(carry)
+            return carry
+        """,
+    ),
     "done-branch": (
         "pkg/envreset.py",
         """
@@ -634,6 +644,111 @@ def test_precision_no_false_positive(tmp_path, name, src):
         str(tmp_path), [f"{name}.py"]
     )
     assert not errors
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_serve_host_sync_collect_path_not_flagged(tmp_path):
+    # Collection paths (collect/harvest names without a hot stem,
+    # unreachable from any hot-loop method) MAY block: that is where
+    # the one legal device->host transfer per dispatch lives.  And
+    # the same file OUTSIDE serve/ is exempt entirely.
+    src = """
+    import jax
+    import numpy as np
+
+    def collect(dispatch):
+        jax.block_until_ready(dispatch.states)
+        return np.asarray(dispatch.states)
+    """
+    _write_tree(
+        str(tmp_path),
+        [("pkg/serve/svc.py", src), ("pkg/other/hot.py", """
+        import jax
+
+        def pump(carry):
+            jax.block_until_ready(carry)
+            return carry
+        """)],
+    )
+    findings, _, errors = analysis.analyze_paths(str(tmp_path), ["pkg"])
+    assert not errors
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_serve_host_sync_transitive_and_suppression(tmp_path):
+    # A sync two same-module helpers deep below a hot-loop method
+    # still serializes the pump — the reachability closure must
+    # follow it; a justified suppression on the sync site silences.
+    src = """
+    import numpy as np
+
+    def _stamp(probe):
+        return np.asarray(probe)
+
+    def _harvest(streams):
+        return [_stamp(s.probe) for s in streams]
+
+    def pump(streams):
+        return _harvest(streams)
+    """
+    _write_tree(str(tmp_path), [("pkg/serve/deep.py", src)])
+    findings, _, _ = analysis.analyze_paths(str(tmp_path), ["pkg"])
+    assert [f.rule for f in findings] == ["serve-host-sync"]
+    assert "_stamp" in findings[0].render() or "np.asarray" in (
+        findings[0].render()
+    )
+    suppressed_src = """
+    import numpy as np
+
+    def _stamp(probe):
+        # swarmlint: disable=serve-host-sync -- successor launch already enqueued
+        return np.asarray(probe)
+
+    def _harvest(streams):
+        return [_stamp(s.probe) for s in streams]
+
+    def pump(streams):
+        return _harvest(streams)
+    """
+    _write_tree(
+        str(tmp_path), [("pkg/serve/deep2.py", suppressed_src)]
+    )
+    findings, suppressed, _ = analysis.analyze_paths(
+        str(tmp_path), ["pkg/serve/deep2.py"]
+    )
+    assert not findings
+    assert [f.rule for f in suppressed] == ["serve-host-sync"]
+
+
+def test_serve_host_sync_mapped_argument_detected(tmp_path):
+    # The dominant whole-pytree transfer idiom passes the sync AS AN
+    # ARGUMENT — tree_map(np.asarray, carry).  Same serialization,
+    # call site one level up: must flag from a hot-loop method.
+    src = """
+    import jax
+    import numpy as np
+
+    def advance(streams):
+        return [
+            jax.tree_util.tree_map(np.asarray, s.carry)
+            for s in streams
+        ]
+    """
+    _write_tree(str(tmp_path), [("pkg/serve/mapped.py", src)])
+    findings, _, _ = analysis.analyze_paths(str(tmp_path), ["pkg"])
+    assert [f.rule for f in findings] == ["serve-host-sync"]
+    # The SAME idiom with a non-sync mapped function stays clean.
+    clean = """
+    import jax
+
+    def advance(streams):
+        return [
+            jax.tree_util.tree_map(lambda x: x[0], s.carry)
+            for s in streams
+        ]
+    """
+    _write_tree(str(tmp_path), [("pkg2/serve/clean.py", clean)])
+    findings, _, _ = analysis.analyze_paths(str(tmp_path), ["pkg2"])
     assert not findings, "\n".join(f.render() for f in findings)
 
 

@@ -137,6 +137,14 @@ def test_gate_semantics_agree_with_compare(tmp_path):
         ("collectives", 4.0, 5.0, True),
         ("collectives", 5.0, 4.0, False),
         ("collectives", 0.0, 1.0, True),
+        # r16 serve-SLO latency percentiles: tail growth past
+        # threshold gates, within-threshold jitter and paydown do
+        # not, and a zero-latency baseline regressing to any
+        # measured latency gates.
+        ("ms-p99", 800.0, 1100.0, True),
+        ("ms-p99", 800.0, 850.0, False),
+        ("ms-p99", 1100.0, 500.0, False),
+        ("ms-p50", 0.0, 100.0, True),
     ]
     for i, (unit, prev, cur, expect) in enumerate(cases):
         assert (
@@ -247,3 +255,85 @@ def test_history_resolves_one_family_not_a_mix(tmp_path):
         "multichip-telemetry-overhead-pct, 8 devices (cpu)", hist
     )
     assert [(r, v) for r, v, _ in rows] == [("r11", 0.5)]
+
+
+# ---------------------------------------------------------------------------
+# swarmscope slo (r16): the serving-latency view
+
+
+def _slo_summary(p99=900.0):
+    return {
+        "deadline_ms": 250.0,
+        "miss_grace_ms": 250.0,
+        "ttfr_ms": {"p50": 400.0, "p95": 800.0, "p99": p99,
+                    "max": p99, "mean": 450.0, "n": 120},
+        "queue_ms": {"p50": 60.0, "p95": 200.0, "p99": 240.0,
+                     "max": 240.0, "mean": 80.0, "n": 120},
+        "deadline_misses": 1,
+        "queue_overflows": 0,
+        "evictions": 2,
+        "dispatches": 30,
+        "filler_fraction": 0.125,
+        "gauge_stride": 1,
+        "queue_depth": [[10.0, 0, 1], [20.0, 3, 2], [30.0, 1, 1]],
+    }
+
+
+def test_slo_artifact_roundtrip_and_merge(tmp_path):
+    run = _mk_run(tmp_path / "ra", "ra", BASE)
+    rundir.merge_slo_summary(run, "soak 60s", _slo_summary())
+    rundir.merge_slo_summary(run, "soak 60s", _slo_summary(p99=950.0))
+    rundir.merge_slo_summary(run, "burst", _slo_summary(p99=100.0))
+    data = rundir.load_run(run)
+    assert sorted(data.slo) == ["burst", "soak 60s"]
+    # Re-merge under the same tag replaces (last write wins).
+    assert data.slo["soak 60s"]["ttfr_ms"]["p99"] == 950.0
+
+
+def test_scope_slo_renders_percentiles_events_and_rows(
+    tmp_path, capsys
+):
+    metrics = BASE + [
+        ("soak-ttfr-ms-p99, 60s mixed cpu", 900.0, "ms-p99"),
+        ("soak-ttfr-ms-p50, 60s mixed cpu", 400.0, "ms-p50"),
+    ]
+    run = _mk_run(tmp_path / "ra", "ra", metrics)
+    rundir.merge_slo_summary(run, "soak 60s", _slo_summary())
+    rundir.append_events(run, [
+        {"event": "deadline-miss", "t_ms": 1000.0, "rid": 7,
+         "queue_ms": 612.5, "deadline_ms": 250.0, "grace_ms": 250.0},
+        {"event": "eviction", "t_ms": 1500.0, "rid": 3, "ticks": 20},
+        {"event": "eviction", "t_ms": 1800.0, "rid": 9, "ticks": 10},
+        {"event": "leader-change", "tick": 3},   # not an SLO event
+    ])
+    assert cli_main(["swarmscope", "slo", run]) == 0
+    out = capsys.readouterr().out
+    assert "slo [soak 60s]" in out
+    assert "p99    900.0 ms" in out.replace("  ", " ").replace(
+        "  ", " "
+    ) or "900.0" in out
+    assert "queue depth" in out
+    assert "soak-ttfr-ms-p99" in out
+    assert "deadline-miss x1" in out
+    assert "eviction x2" in out
+    assert "MISS rid 7" in out
+    assert "leader-change" not in out
+
+
+def test_scope_slo_empty_run_says_so(tmp_path, capsys):
+    run = _mk_run(tmp_path / "ra", "ra", BASE)
+    assert cli_main(["swarmscope", "slo", run]) == 0
+    assert "no SLO data" in capsys.readouterr().out
+
+
+def test_diff_gates_on_slo_latency_rows(tmp_path, capsys):
+    # The diff picks the new latency units up via the shared gate:
+    # a p99 tail regression names the row and exits nonzero.
+    lat = [("soak-ttfr-ms-p99, 60s mixed cpu", 800.0, "ms-p99")]
+    a = _mk_run(tmp_path / "ra", "ra", BASE + lat)
+    worse = [("soak-ttfr-ms-p99, 60s mixed cpu", 1100.0, "ms-p99")]
+    b = _mk_run(tmp_path / "rb", "rb", BASE + worse)
+    rc = cli_main(["swarmscope", "diff", a, b])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "soak-ttfr-ms-p99" in captured.err

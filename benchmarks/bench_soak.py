@@ -179,6 +179,13 @@ def main() -> int:
         deadline_s=DEADLINE_S, miss_grace_s=MISS_GRACE_S
     )
     svc.queue.clock = svc.slo.clock
+    # Keep the r17 device-memory watermark on the fresh tracker (the
+    # service wires it at construction; the reset must not lose it).
+    from distributed_swarm_algorithm_tpu.utils.trace import (
+        device_memory_watermark,
+    )
+
+    svc.slo.memory_probe = device_memory_watermark
 
     rng = random.Random(0)
     t0 = time.monotonic()

@@ -1,0 +1,162 @@
+"""Span-tracer overhead on the streaming serve loop (r17).
+
+The swarmtrace contract (utils/trace.py) is the r10 telemetry
+discipline applied to host spans: DISABLED is one attribute check per
+emission site, and ENABLED must stay cheap enough that tracing a
+production stream is a default, not a debugging splurge.  This bench
+states the enabled half as a number: the same deterministic
+60-request streamed mix (two capacity rungs, mixed gains, 3-segment
+rollouts) runs through a ``StreamingService`` once with a disabled
+tracer and once with an enabled one, and the wall-clock delta is the
+fixed-name ``trace-overhead-pct`` row — unit "pct", gated
+lower-is-better against the absolute 5% ``PCT_CEILING`` in
+compare.py/rundir.py (the telemetry-overhead bar).
+
+The enabled pass doubles as the span-taxonomy acceptance check: every
+fully-served request must show >= 5 span kinds (queue.wait,
+serve.coalesce, serve.launch, serve.segment, serve.collect) in the
+per-request table, and with ``DSA_RUN_DIR`` set the Chrome trace is
+deposited under ``<run>/trace/`` for ``swarmscope trace``.
+
+Usage: python benchmarks/bench_trace_overhead.py
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import jax
+
+from common import report
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu import serve
+from distributed_swarm_algorithm_tpu.utils import trace as tracelib
+
+N_REQUESTS = 60
+N_STEPS = 30
+SEGMENT_STEPS = 10
+DEADLINE_S = 0.01
+#: Best-of reps per tracer mode, interleaved off/on: the streamed
+#: pass is sub-second, so noise on a loaded host is one-sided and
+#: best-of absorbs it (the timeit_best discipline).
+REPS = 3
+TAG = "60 requests streamed mix (cpu)"
+
+SPEC = serve.BucketSpec(capacities=(32, 64), batches=(1, 2, 4))
+BASE = dsa.SwarmConfig().replace(
+    formation_shape="none", utility_threshold=2.0
+)
+
+
+def _request(i: int) -> serve.ScenarioRequest:
+    """The bench_soak deterministic heterogeneous mix, shrunk: two
+    capacity rungs, a param grid, per-index seeds."""
+    return serve.ScenarioRequest(
+        n_agents=(24 + (i * 11) % 9) if i % 3 else (48 + (i * 7) % 17),
+        seed=i,
+        arena_hw=6.0 + (i % 5),
+        params={
+            "k_att": 0.5 + 0.25 * (i % 7),
+            "k_sep": 10.0 + 5.0 * (i % 4),
+        },
+    )
+
+
+def _serve_mix(tracer: tracelib.SpanTracer) -> float:
+    """One full streamed pass: submit in waves of 4, pump, collect
+    ready results newest-first, drain; returns wall seconds.  The
+    request sequence and pump cadence are identical across passes —
+    only the tracer differs."""
+    svc = serve.StreamingService(
+        BASE, spec=SPEC, n_steps=N_STEPS,
+        segment_steps=SEGMENT_STEPS, deadline_s=DEADLINE_S,
+        telemetry=False, tracer=tracer,
+    )
+    start = time.perf_counter()
+    submitted = 0
+    collected = 0
+    while collected < N_REQUESTS:
+        for _ in range(4):
+            if submitted < N_REQUESTS:
+                svc.submit(_request(submitted))
+                submitted += 1
+        svc.pump(force=submitted >= N_REQUESTS)
+        for rid in sorted(
+            (r for r in svc.ready_rids() if svc.result_ready(r)),
+            reverse=True,
+        ):
+            svc.collect(rid)
+            collected += 1
+    return time.perf_counter() - start
+
+
+def main() -> int:
+    backend = jax.default_backend()
+    if backend != "cpu":
+        print(
+            f"# bench_trace_overhead: cpu-family rows; backend is "
+            f"{backend!r} — skipping"
+        )
+        return 0
+
+    off = tracelib.SpanTracer()
+    on = tracelib.SpanTracer().enable()
+
+    # Warm the full bucket lattice (every capacity x rung x segment
+    # shape the mix can dispatch) before timing — compiles are a
+    # one-time cost the lattice bounds, not tracer overhead.
+    _serve_mix(off)
+
+    t_off = t_on = float("inf")
+    for _ in range(REPS):
+        t_off = min(t_off, _serve_mix(off))
+        on.reset()
+        t_on = min(t_on, _serve_mix(on))
+    overhead = max(0.0, 100.0 * (t_on - t_off) / t_off)
+
+    # The span-taxonomy acceptance surface: every fully-served
+    # request of the traced pass shows the full critical path.
+    table = tracelib.request_table(on.spans)
+    assert len(table) == N_REQUESTS, (
+        f"per-request table covers {len(table)}/{N_REQUESTS} rids"
+    )
+    want = {
+        tracelib.QUEUE_SPAN, tracelib.COALESCE_SPAN,
+        tracelib.LAUNCH_SPAN, tracelib.SEGMENT_SPAN,
+        tracelib.COLLECT_SPAN,
+    }
+    for rid, row in table.items():
+        missing = want - set(row["kinds"])
+        assert not missing, (
+            f"rid {rid}: span kinds missing {sorted(missing)} "
+            f"(have {row['kinds']})"
+        )
+    assert off.spans == [] and off.dropped == 0, (
+        "disabled tracer recorded spans"
+    )
+
+    print(
+        f"# trace overhead ({N_REQUESTS} requests, {backend}): off "
+        f"{t_off:.2f}s, on {t_on:.2f}s -> {overhead:.2f}% (bar <= "
+        f"5%); {len(on.spans)} spans, >= {len(want)} kinds/request"
+    )
+    report(
+        "trace-overhead-pct, 60 requests streamed mix (cpu)",
+        overhead, "pct", 0.0,
+    )
+
+    run_dir = os.environ.get("DSA_RUN_DIR")
+    if run_dir:
+        # The Chrome trace becomes a run artifact: `swarmscope trace
+        # runs/<rNN>` renders the critical-path table from it.
+        path = on.dump(
+            os.path.join(run_dir, "trace", "bench_trace_overhead.json")
+        )
+        print(f"# swarmtrace deposit: {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -122,6 +122,12 @@ BENCHES = [
     # SLO summary + alert events land in the run dir for
     # `swarmscope slo`.
     "bench_soak.py",
+    # r17: span-tracer overhead on the streamed mix — the fixed-name
+    # trace-overhead-pct row (unit "pct", absolute 5% ceiling): a
+    # tracing-on streamed pass must stay within the telemetry bar of
+    # the identical tracing-off pass, and the traced pass asserts the
+    # full >= 5-kind span taxonomy per request.
+    "bench_trace_overhead.py",
 ]
 
 # Extra argv for benches whose no-arg default is not the gate set —
@@ -177,6 +183,9 @@ QUICK_SKIP = {
     # r16: even --small is a fixed 60 s traffic window plus lattice
     # warm-up — full gate only.
     "bench_soak.py",
+    # r17: three full streamed 60-request passes (warm + off + on)
+    # compile the whole serve lattice — full gate only.
+    "bench_trace_overhead.py",
 }
 
 

@@ -46,6 +46,13 @@ How it works — no backend execution, no backend compile:
      contract here is f32/i32), and ``i64-to-f32`` converts (ids
      widened past i32 then packed into f32 break the 2^24-exact
      packing contract the r11/r12 packed collectives rely on).
+   - **bytes census** (r17, the memory observatory) — per-entry
+     ``compiled.memory_analysis()`` buckets (:data:`MEMORY_KEYS`),
+     memoized via ``CompileWatch.memory_cached``.  The one audit
+     that backend-COMPILES (still no execution): peak temp bytes
+     are a property of the buffer assignment, not the StableHLO.
+     Backends without memory analysis produce a structured
+     ``memory_skipped`` reason; ``--no-memory`` skips the pass.
 
 4. Counts are checked against the entry's **declared budgets** in
    ``jaxlint-budgets.json`` (repo root — the same fingerprint-ledger
@@ -108,6 +115,24 @@ INFO_KEYS = ("aliased-outputs", "while-loops")
 #: audit's positive half: the r13 serve entry must keep actually
 #: aliasing its donated carry, not merely avoid the warning).
 MIN_ALIASED = "min-aliased-outputs"
+
+#: The bytes census (r17, the memory observatory): per-entry
+#: ``compiled.memory_analysis()`` buckets, each a CEILING budget in
+#: bytes (unit "bytes" is already lower-is-better in
+#: compare.py/rundir.py).  Unlike the op census these need a backend
+#: COMPILE (no execution) — peak temp bytes are a property of the
+#: buffer assignment, not the StableHLO — so they ride
+#: ``CompileWatch.memory_cached`` (memoized per entry+signature, like
+#: the r15 lowering cache).  ``alias-bytes`` is how the r13 donated
+#: double-buffer shows up positively: donated carries alias instead
+#: of growing temp.
+MEMORY_KEYS = (
+    "temp-bytes",
+    "argument-bytes",
+    "output-bytes",
+    "alias-bytes",
+    "generated-code-bytes",
+)
 
 DEFAULT_BUDGETS_BASENAME = "jaxlint-budgets.json"
 
@@ -608,6 +633,12 @@ class EntryAudit:
     signature: str = ""          # short fingerprint of the example args
     counts: Dict[str, int] = field(default_factory=dict)
     skipped: str = ""            # non-empty: why the entry did not lower
+    #: Bytes census (r17): MEMORY_KEYS -> measured bytes; empty when
+    #: the memory audit was off or structurally skipped.
+    memory: Dict[str, int] = field(default_factory=dict)
+    #: Non-empty: why the bytes census could not be measured here
+    #: (backend keeps no memory analysis) — structured, never silent.
+    memory_skipped: str = ""
 
     def to_dict(self) -> dict:
         return {
@@ -615,6 +646,8 @@ class EntryAudit:
             "signature": self.signature,
             "counts": dict(self.counts),
             "skipped": self.skipped,
+            "memory": dict(self.memory),
+            "memory_skipped": self.memory_skipped,
             "collectives_per_tick": (
                 collectives_per_tick(self.counts) if self.counts else None
             ),
@@ -639,9 +672,12 @@ def census_of(fn: Callable, *args, **kwargs) -> Dict[str, int]:
     return census_of_text(lowered.as_text(), warns)
 
 
-def audit_entry(name: str) -> EntryAudit:
+def audit_entry(name: str, memory: bool = True) -> EntryAudit:
     """Lower + census one registered entry (memoized per process via
-    the observatory's lowering cache)."""
+    the observatory's lowering cache).  ``memory=True`` additionally
+    backend-compiles the example (still no execution) for the bytes
+    census — memoized the same way, so the full registry pays each
+    compile once per process."""
     import jax
 
     spec = LINT_REGISTRY[name]
@@ -656,8 +692,23 @@ def audit_entry(name: str) -> EntryAudit:
         )
     fn, args, kwargs = spec.build()
     counts = census_of(fn, *args, **kwargs)
+    mem: Dict[str, int] = {}
+    mem_skip = ""
+    if memory:
+        from ..utils.compile_watch import WATCH
+
+        got = WATCH.memory_cached(
+            fn, *args,
+            has_aliasing=counts.get("aliased-outputs", 0) > 0,
+            **kwargs,
+        )
+        if "skipped" in got:
+            mem_skip = got["skipped"]
+        else:
+            mem = dict(got)
     return EntryAudit(
         entry=name, signature=_sig_hash(args, kwargs), counts=counts,
+        memory=mem, memory_skipped=mem_skip,
     )
 
 
@@ -726,6 +777,7 @@ def load_budgets(path: str) -> Dict[str, BudgetEntry]:
         bad = [
             k for k in raw["budgets"]
             if k != MIN_ALIASED and k not in census_keys()
+            and k not in MEMORY_KEYS
         ]
         if bad:
             raise BudgetError(
@@ -755,7 +807,8 @@ def save_budgets(path: str, entries: Dict[str, BudgetEntry]) -> None:
 
 
 def budget_from_audit(
-    audit: EntryAudit, justification: str
+    audit: EntryAudit, justification: str,
+    previous: Optional[BudgetEntry] = None,
 ) -> BudgetEntry:
     """A ledger entry pinning the audit's measured counts (nonzero
     gated keys only — zero is the default ceiling)."""
@@ -765,6 +818,21 @@ def budget_from_audit(
     }
     if audit.counts.get("aliased-outputs"):
         budgets[MIN_ALIASED] = audit.counts["aliased-outputs"]
+    # Bytes census (r17): nonzero measured bytes become ceilings too
+    # (zero stays the default, so a footprint APPEARING where none
+    # was declared fails until re-measured).  An audit that carried
+    # NO memory census (--no-memory, or a structural backend skip)
+    # preserves the previously declared byte ceilings instead of
+    # silently erasing them from the ledger.
+    if audit.memory:
+        budgets.update(
+            {k: v for k, v in audit.memory.items() if v}
+        )
+    elif previous is not None:
+        budgets.update({
+            k: v for k, v in previous.budgets.items()
+            if k in MEMORY_KEYS
+        })
     return BudgetEntry(
         entry=audit.entry, signature=audit.signature,
         budgets=budgets, justification=justification,
@@ -850,6 +918,26 @@ def check_against_budget(
                     ),
                 )
             )
+    # Bytes-census ceilings (r17): same default-0 discipline as the
+    # op census — any measured footprint past its declared budget
+    # (or appearing undeclared) gates; a structural memory skip
+    # (audit.memory empty) checks nothing here, and the skip reason
+    # rides the audit's to_dict so it is never silent.
+    for key, measured in audit.memory.items():
+        budget = entry.budgets.get(key, 0)
+        if measured > budget:
+            findings.append(
+                LintFinding(
+                    entry=audit.entry, check=key,
+                    measured=measured, budget=budget,
+                    message=(
+                        f"{key} {measured} exceeds the declared "
+                        f"budget {budget} — the compiled footprint "
+                        "grew; re-measure (`--write-budgets`) only "
+                        "if the growth is justified"
+                    ),
+                )
+            )
     floor = entry.budgets.get(MIN_ALIASED)
     if floor is not None:
         got = audit.counts.get("aliased-outputs", 0)
@@ -894,10 +982,13 @@ class AuditResult:
 def run_audit(
     entries: Optional[List[str]] = None,
     budgets_path: Optional[str] = None,
+    memory: bool = True,
 ) -> AuditResult:
     """Audit ``entries`` (default: the whole registry) against the
     declared budgets.  Stale ledger entries only prove stale on a
-    full-registry run (the swarmlint scoped-scan rule)."""
+    full-registry run (the swarmlint scoped-scan rule).
+    ``memory=False`` skips the bytes census (lower-only audit — no
+    backend compiles)."""
     names = list(entries) if entries else sorted(LINT_REGISTRY)
     unknown = [n for n in names if n not in LINT_REGISTRY]
     if unknown:
@@ -913,7 +1004,7 @@ def run_audit(
     skipped: List[EntryAudit] = []
     findings: List[LintFinding] = []
     for name in names:
-        audit = audit_entry(name)
+        audit = audit_entry(name, memory=memory)
         if audit.skipped:
             skipped.append(audit)
             continue
@@ -964,7 +1055,8 @@ def main_cli(args) -> int:
 
     try:
         result = run_audit(
-            entries=args.entries or None, budgets_path=budgets_path
+            entries=args.entries or None, budgets_path=budgets_path,
+            memory=not getattr(args, "no_memory", False),
         )
     except (KeyError, BudgetError) as e:
         # KeyError str() is the quoted repr of its arg — unwrap it.
@@ -982,7 +1074,9 @@ def main_cli(args) -> int:
                 and not prev.justification.startswith("TODO(")
                 else "TODO(jaxlint): justify the measured counts"
             )
-            declared[audit.entry] = budget_from_audit(audit, just)
+            declared[audit.entry] = budget_from_audit(
+                audit, just, previous=prev
+            )
         for name in result.stale:
             declared.pop(name, None)
         save_budgets(budgets_path, declared)
@@ -1006,6 +1100,13 @@ def main_cli(args) -> int:
                     f"{k}={audit.counts[k]}" for k in keys
                     if audit.counts.get(k)
                 ) or "no collectives / clean"
+                if audit.memory:
+                    row += (
+                        f"  bytes[temp={audit.memory['temp-bytes']}"
+                        f", alias={audit.memory['alias-bytes']}]"
+                    )
+                elif audit.memory_skipped:
+                    row += "  bytes[skipped]"
                 print(
                     f"{audit.entry:24} per-tick="
                     f"{collectives_per_tick(audit.counts):<3} {row}"

@@ -47,6 +47,14 @@
   mesh, so devices disagreeing on the branch DEADLOCK (the r12
   rebuild hazard); the sanctioned pattern OR-reduces the trigger
   first (``lax.pmax(flag, axis) > 0``, parallel/spatial.py).
+- ``span-leak``: a tracer span begun with the explicit
+  ``begin_span``/``end_span`` pair inside ``serve/`` or a
+  loop-transform body, or ``jax.profiler.start_trace`` with no
+  reachable ``stop_trace`` — any exception or early return between
+  begin and end leaks an open span across pump cycles (and an
+  unclosed profiler capture corrupts the trace file); use the
+  ``with tracer.span(...)`` form or :meth:`SpanTracer.emit`
+  (utils/trace.py).
 """
 
 from __future__ import annotations
@@ -970,3 +978,147 @@ class RetraceRule(Rule):
                     f"static arg `{param.arg}` has an unhashable "
                     "mutable default — jit static args must hash",
                 )
+
+
+# ---------------------------------------------------------------------------
+# span-leak (r17)
+
+
+def _call_leaf(mod: ModuleInfo, node: ast.Call) -> str:
+    """Terminal name of a call target: resolved dotted leaf when the
+    chain resolves, the bare Attribute attr otherwise (method calls
+    on locals — ``tracer.begin_span`` — resolve to "")."""
+    name = mod.resolve(node.func)
+    if name:
+        return name.rsplit(".", 1)[-1]
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return ""
+
+
+@register
+class SpanLeakRule(Rule):
+    id = "span-leak"
+    summary = "tracer span begun without context-manager form"
+    details = (
+        "`SpanTracer.begin_span` inside serve/ or a loop-transform "
+        "body leaks an open span across pump cycles the moment an "
+        "exception or early return skips the matching `end_span` — "
+        "use the `with tracer.span(...)` form, or `emit(...)` for "
+        "endpoints other bookkeeping already stamped "
+        "(utils/trace.py; the explicit pair is for host drivers "
+        "OUTSIDE the serve hot loop).  `jax.profiler.start_trace` "
+        "with no reachable `stop_trace` is the same leak one level "
+        "down: the capture never finalizes and the trace file is "
+        "corrupt (utils/profiling.trace is the sanctioned wrapper)."
+    )
+
+    def check(self, mod: ModuleInfo):
+        yield from self._check_begin_span(mod)
+        yield from self._check_profiler_trace(mod)
+
+    def _check_begin_span(self, mod: ModuleInfo):
+        in_serve = "/serve/" in f"/{mod.relpath}"
+        by_name: dict = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                by_name.setdefault(node.name, []).append(node)
+        bodies: set = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if mod.resolve(node.func) not in _LOOP_CALLS:
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Lambda):
+                    bodies.add(arg)
+                elif isinstance(arg, ast.Name):
+                    bodies.update(by_name.get(arg.id, []))
+        seen: set = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _call_leaf(mod, node) != "begin_span":
+                continue
+            where = None
+            if in_serve:
+                where = "serve/ (the streaming hot loop)"
+            else:
+                for anc in mod.ancestors(node):
+                    if anc in bodies:
+                        where = "a loop-transform body"
+                        break
+            if where is None:
+                continue
+            site = (node.lineno, node.col_offset)
+            if site in seen:
+                continue
+            seen.add(site)
+            yield mod.finding(
+                self.id, node,
+                f"`begin_span` inside {where} — an exception or "
+                "early return before `end_span` leaks the open span; "
+                "use `with tracer.span(...)` or "
+                "`emit(name, t0, t1, ...)`",
+            )
+
+    def _check_profiler_trace(self, mod: ModuleInfo):
+        by_name: dict = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                by_name.setdefault(node.name, []).append(node)
+        seen: set = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = mod.resolve(node.func) or ""
+            if not name.endswith("profiler.start_trace"):
+                continue
+            # stop_trace must be reachable from the start's enclosing
+            # scope through same-module calls (the halo-width walk) —
+            # a try/finally wrapper in the same function counts, the
+            # utils/profiling.trace pattern.
+            scope = None
+            for anc in mod.ancestors(node):
+                if isinstance(
+                    anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)
+                ):
+                    scope = anc
+                    break
+            frontier = [scope if scope is not None else mod.tree]
+            seen_fns: set = set()
+            has_stop = False
+            while frontier and not has_stop:
+                cur = frontier.pop()
+                if id(cur) in seen_fns:
+                    continue
+                seen_fns.add(id(cur))
+                stmts = (
+                    cur.body if isinstance(cur.body, list)
+                    else [cur.body]
+                )
+                for st in stmts:
+                    for n in ast.walk(st):
+                        if not isinstance(n, ast.Call):
+                            continue
+                        nm = mod.resolve(n.func) or ""
+                        if nm.rsplit(".", 1)[-1] == "stop_trace":
+                            has_stop = True
+                        if isinstance(n.func, ast.Name):
+                            frontier.extend(
+                                by_name.get(n.func.id, [])
+                            )
+            if has_stop:
+                continue
+            site = (node.lineno, node.col_offset)
+            if site in seen:
+                continue
+            seen.add(site)
+            yield mod.finding(
+                self.id, node,
+                "`jax.profiler.start_trace` with no reachable "
+                "`stop_trace` — the capture never finalizes and the "
+                "trace file is corrupt; use utils/profiling.trace "
+                "(start/stop under try/finally)",
+            )

@@ -812,6 +812,18 @@ def _cmd_scope_slo(args) -> int:
             f"overflows {s.get('queue_overflows', 0)}  "
             f"evictions {s.get('evictions', 0)}"
         )
+        if "device_peak_bytes" in s:
+            peak = s["device_peak_bytes"]
+            if peak is None:
+                print(
+                    "  device memory: skipped "
+                    f"({s.get('device_memory_skip', '?')})"
+                )
+            else:
+                print(
+                    f"  device memory: peak {peak / 1e6:.1f} MB "
+                    "(allocator watermark)"
+                )
         traj = s.get("queue_depth") or []
         if traj:
             depths = [row[1] for row in traj]
@@ -856,7 +868,12 @@ def _cmd_scope_slo(args) -> int:
 
 def _cmd_scope_history(args) -> int:
     """``swarmscope history METRIC``: the fixed-name row's trajectory
-    across every recorded round of BENCH_HISTORY.json."""
+    across every recorded round of BENCH_HISTORY.json.
+
+    ``--export-round rNN`` instead restores that round's standalone
+    ``BENCH_rNN.json`` snapshot from the history (the run_all
+    ``--record`` snapshot format) — the backfill path for rounds
+    whose on-disk snapshot went missing."""
     from pathlib import Path
 
     from .utils import rundir
@@ -866,6 +883,31 @@ def _cmd_scope_history(args) -> int:
         path = str(
             Path(__file__).resolve().parent.parent / "BENCH_HISTORY.json"
         )
+    if args.export_round:
+        label = args.export_round
+        with open(path) as fh:
+            rounds = json.load(fh).get("rounds", {})
+        if label not in rounds:
+            print(
+                f"round {label!r} is not recorded in {path} "
+                f"(have: {sorted(rounds)}) — a round never merged "
+                "into the history cannot be restored from it",
+                file=sys.stderr,
+            )
+            return 1
+        out = Path(path).parent / f"BENCH_{label}.json"
+        with open(out, "w") as fh:
+            json.dump(
+                {"round": label, "metrics": rounds[label]},
+                fh, indent=1, sort_keys=True,
+            )
+            fh.write("\n")
+        print(f"restored {out} ({len(rounds[label])} metrics)")
+        return 0
+    if not args.metric:
+        print("error: METRIC required (or --export-round rNN)",
+              file=sys.stderr)
+        return 2
     rows = rundir.history_rows(args.metric, path)
     if not rows:
         print(f"no rounds record a metric matching {args.metric!r}",
@@ -878,6 +920,129 @@ def _cmd_scope_history(args) -> int:
             delta = f"  ({(value - prev) / prev:+.1%})"
         print(f"{label:>6}  {value:>14.4g} {unit}{delta}")
         prev = value
+    return 0
+
+
+def _cmd_scope_trace(args) -> int:
+    """``swarmscope trace RUN``: the per-request critical-path view
+    of a run's swarmtrace spans (r17, utils/trace.py) — where each
+    request's time went (queue / coalesce / launch / compute /
+    collect fractions), the slowest-span ranking, and ``--export``
+    merging the host spans (plus an optional profiler capture dir)
+    into one Perfetto-loadable Chrome trace."""
+    import glob
+    import gzip
+    import os
+
+    from .utils import trace as tracelib
+
+    if os.path.isfile(args.run):
+        files = [args.run]
+    else:
+        files = sorted(
+            glob.glob(os.path.join(args.run, "trace", "*.json"))
+        )
+    if not files:
+        print(
+            f"no swarmtrace files under {args.run!r} (expected "
+            "<run>/trace/*.json — record with DSA_TRACE=1 and "
+            "DSA_RUN_DIR set, or pass a trace JSON directly)",
+            file=sys.stderr,
+        )
+        return 1
+    spans = []
+    per_file = []
+    sources = []
+    for path in files:
+        # One parse per file: the table, the ranking, and the
+        # --export merge all read the same loaded dict.
+        with open(path) as fh:
+            data = json.load(fh)
+        file_spans = tracelib.chrome_trace_spans(data)
+        spans.extend(file_spans)
+        per_file.append((os.path.basename(path), file_spans))
+        if args.export:
+            sources.append((os.path.basename(path), data))
+    n_files = len(files)
+    print(f"swarmtrace: {len(spans)} spans from {n_files} file(s)")
+    # Rids number from 0 IN EACH PROCESS, so the critical-path table
+    # is per source file — merging rid 0 of two processes would sum
+    # unrelated requests into one bogus row.
+    any_table = False
+    for fname, file_spans in per_file:
+        table = tracelib.request_table(file_spans)
+        if not table:
+            continue
+        any_table = True
+        if n_files > 1:
+            print(f"-- {fname}")
+        buckets = [b for b, _ in tracelib.CRITICAL_BUCKETS]
+        header = "  ".join(f"{b:>9}" for b in buckets)
+        print(f"{'rid':>5}  {'total_ms':>9}  {header}  kinds")
+        for rid in sorted(table):
+            row = table[rid]
+            total = row["total_ms"]
+            cells = "  ".join(
+                f"{(100.0 * row[b] / total if total else 0.0):8.1f}%"
+                for b in buckets
+            )
+            print(
+                f"{rid:>5}  {total:9.3f}  {cells}  "
+                f"{len(row['kinds'])}"
+            )
+    if not any_table:
+        print("  (no rid/rids-attributed spans — nothing to bucket)")
+    top = tracelib.slowest_spans(spans, args.top)
+    if top:
+        print("slowest spans:")
+        for s in top:
+            rids = tracelib.span_rids(s)
+            who = f" rids={rids}" if rids else ""
+            print(f"  {1e3 * s.dur_s():10.3f} ms  {s.name}{who}")
+    if args.profile and not args.export:
+        print(
+            "# --profile only affects the --export merge; pass "
+            "--export PATH to merge the capture",
+            file=sys.stderr,
+        )
+    if args.export:
+        # Profiler captures export Chrome traces as *.trace.json(.gz)
+        # (TensorBoard's plugins/profile layout); merge whatever the
+        # capture dir holds alongside the host spans.
+        if args.profile:
+            found = sorted(
+                glob.glob(
+                    os.path.join(args.profile, "**", "*.trace.json*"),
+                    recursive=True,
+                )
+            )
+            if not found:
+                print(
+                    f"# no *.trace.json(.gz) under {args.profile!r} "
+                    "— exporting host spans only",
+                    file=sys.stderr,
+                )
+            for path in found:
+                opener = gzip.open if path.endswith(".gz") else open
+                try:
+                    with opener(path, "rt") as fh:
+                        sources.append(
+                            (os.path.basename(path), json.load(fh))
+                        )
+                except (OSError, json.JSONDecodeError) as e:
+                    print(f"# skipping {path}: {e}", file=sys.stderr)
+        merged = tracelib.merge_chrome_traces(sources)
+        os.makedirs(
+            os.path.dirname(os.path.abspath(args.export)),
+            exist_ok=True,
+        )
+        with open(args.export, "w") as fh:
+            json.dump(merged, fh)
+            fh.write("\n")
+        print(
+            f"exported {len(merged['traceEvents'])} events from "
+            f"{len(sources)} source(s) -> {args.export}"
+        )
     return 0
 
 
@@ -1221,6 +1386,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p_jl.add_argument("--list-entries", action="store_true",
                       help="list registered lint entries")
+    p_jl.add_argument(
+        "--no-memory", action="store_true", dest="no_memory",
+        help="skip the bytes census (r17: compiled.memory_analysis "
+             "per entry — needs a backend compile; lowering-only "
+             "audit with this flag)",
+    )
     p_jl.set_defaults(fn=_cmd_jaxlint)
 
     p_scope = sub.add_parser(
@@ -1254,12 +1425,46 @@ def build_parser() -> argparse.ArgumentParser:
     p_slo.set_defaults(fn=_cmd_scope_slo)
     p_sh = scope_sub.add_parser(
         "history",
-        help="print a fixed-name row's BENCH_HISTORY trajectory",
+        help="print a fixed-name row's BENCH_HISTORY trajectory, or "
+             "restore a round's BENCH_rNN.json snapshot "
+             "(--export-round)",
     )
-    p_sh.add_argument("metric", help="metric name (exact or substring)")
+    p_sh.add_argument("metric", nargs="?", default=None,
+                      help="metric name (exact or substring)")
     p_sh.add_argument("--file", default=None,
                       help="history JSON (default: repo BENCH_HISTORY)")
+    p_sh.add_argument(
+        "--export-round", metavar="rNN", default=None,
+        help="write BENCH_rNN.json next to the history file from "
+             "round rNN's recorded metrics (the run_all --record "
+             "snapshot format) instead of printing a trajectory",
+    )
     p_sh.set_defaults(fn=_cmd_scope_history)
+    p_st = scope_sub.add_parser(
+        "trace",
+        help="render a run's swarmtrace spans (r17): per-request "
+             "critical-path table (queue/coalesce/launch/compute/"
+             "collect fractions), slowest-span ranking, and --export "
+             "to one merged Perfetto-loadable Chrome trace",
+    )
+    p_st.add_argument(
+        "run",
+        help="run directory (reads <run>/trace/*.json) or one "
+             "Chrome-trace JSON file",
+    )
+    p_st.add_argument("--top", type=int, default=10,
+                      help="slowest-span ranking depth")
+    p_st.add_argument(
+        "--export", metavar="OUT.json", default=None,
+        help="write one merged Chrome trace (host spans + --profile "
+             "capture) loadable in Perfetto / chrome://tracing",
+    )
+    p_st.add_argument(
+        "--profile", metavar="DIR", default=None,
+        help="profiler capture dir (utils/profiling.trace output) "
+             "whose *.trace.json(.gz) exports merge into --export",
+    )
+    p_st.set_defaults(fn=_cmd_scope_trace)
 
     # Convergence-history flags for every single-objective optimizer
     # subcommand (utils/history.py; see _run_report).

@@ -26,8 +26,9 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
+from ..utils.trace import QUEUE_SPAN, TRACER, SpanTracer
 from .batched import ScenarioRequest
 from .buckets import BucketSpec
 
@@ -59,6 +60,7 @@ class AdmissionQueue:
         spec: BucketSpec,
         deadline_s: float,
         clock=time.monotonic,
+        tracer: Optional[SpanTracer] = None,
     ):
         if deadline_s <= 0:
             raise ValueError(
@@ -67,6 +69,12 @@ class AdmissionQueue:
         self.spec = spec
         self.deadline_s = float(deadline_s)
         self.clock = clock
+        #: Span registry (r17): each released request emits one
+        #: RETROSPECTIVE ``queue.wait`` span from its already-stamped
+        #: submit time — nothing to leak across pump cycles, and the
+        #: emission shares the queue's clock (= the SLO tracker's) so
+        #: span edges and latency stamps agree.
+        self.tracer = TRACER if tracer is None else tracer
         #: (capacity, n_tasks) -> FIFO of QueuedRequest.
         self._groups: Dict[tuple, List[QueuedRequest]] = {}
 
@@ -101,6 +109,18 @@ class AdmissionQueue:
             e.rid == rid for g in self._groups.values() for e in g
         )
 
+    def _emit_release(self, key, entries, now) -> None:
+        """One retrospective queue-wait span per released request.
+        Guarded on ``enabled`` so the disabled path pays exactly one
+        attribute check per release, not a per-entry loop."""
+        if not self.tracer.enabled:
+            return
+        for e in entries:
+            self.tracer.emit(
+                QUEUE_SPAN, e.submit_t, now,
+                rid=e.rid, capacity=key[0], n_tasks=key[1],
+            )
+
     # -- release policy ----------------------------------------------------
     def pop_ready(
         self, now=None, force: bool = False
@@ -127,6 +147,8 @@ class AdmissionQueue:
                     del group[: len(take)]
                     out.append((key, take, size))
         self._groups = {k: g for k, g in self._groups.items() if g}
+        for key, entries, _ in out:
+            self._emit_release(key, entries, now)
         return out
 
     def pop_group(self, key) -> List[Tuple[tuple, List[QueuedRequest], int]]:
@@ -142,6 +164,9 @@ class AdmissionQueue:
             take = group[: min(size, len(group))]
             del group[: len(take)]
             out.append((key, take, size))
+        now = self.clock()
+        for k, entries, _ in out:
+            self._emit_release(k, entries, now)
         return out
 
     def flush_all(self):

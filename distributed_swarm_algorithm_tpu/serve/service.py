@@ -64,6 +64,19 @@ import numpy as np
 from ..state import SwarmState
 from ..utils import compile_watch
 from ..utils.config import DEFAULT_CONFIG, SwarmConfig
+from ..utils.trace import (
+    COALESCE_SPAN,
+    COLLECT_SPAN,
+    EVICT_SPAN,
+    FLUSH_SPAN,
+    HARVEST_EVENT,
+    LAUNCH_SPAN,
+    OVERFLOW_EVENT,
+    SEGMENT_SPAN,
+    TRACER,
+    SpanTracer,
+    device_memory_watermark,
+)
 from ..utils.telemetry import (
     TelemetrySummary,
     concat_telemetry,
@@ -177,12 +190,19 @@ class RolloutService:
         n_steps: int = 50,
         telemetry: bool = True,
         record: bool = False,
+        tracer: Optional[SpanTracer] = None,
     ):
         self.cfg = validate_serve_config(cfg or DEFAULT_CONFIG)
         self.spec = spec or BucketSpec()
         if n_steps <= 0:
             raise ValueError(f"n_steps must be >= 1, got {n_steps}")
         self.n_steps = int(n_steps)
+        #: Span registry (r17): every dispatch phase emits into it
+        #: when tracing is enabled; disabled, each emission site is
+        #: one attribute check (the pinned no-op contract,
+        #: utils/trace.py).  Injectable for tests and benches; the
+        #: default is the process-global tracer DSA_TRACE enables.
+        self.tracer = TRACER if tracer is None else tracer
         # The EFFECTIVE flag: the batched entry returns the telemetry
         # ys whenever the flag OR the config gate is on, so the
         # unpacking below must agree with that disjunction — a config
@@ -260,16 +280,17 @@ class RolloutService:
         the number of dispatches launched.  Non-blocking: the device
         works while the host materializes the next batch."""
         launched = 0
-        for key in sorted(self._pending):
-            capacity, _ = key
-            group = self._pending[key]
-            for size in self.spec.split_batch(len(group)):
-                entries = group[:size]
-                # Launch BEFORE dequeuing: a failed launch must not
-                # silently drop its co-batched requests.
-                self._launch(capacity, size, entries)
-                del group[:size]
-                launched += 1
+        with self.tracer.span(FLUSH_SPAN):
+            for key in sorted(self._pending):
+                capacity, _ = key
+                group = self._pending[key]
+                for size in self.spec.split_batch(len(group)):
+                    entries = group[:size]
+                    # Launch BEFORE dequeuing: a failed launch must
+                    # not silently drop its co-batched requests.
+                    self._launch(capacity, size, entries)
+                    del group[:size]
+                    launched += 1
         self._pending = {k: g for k, g in self._pending.items() if g}
         self.stats["dispatches"] += launched
         return launched
@@ -285,13 +306,19 @@ class RolloutService:
         # the NEXT dispatch while this one executes (async dispatch =
         # the double buffer), and the donated state buffers go
         # straight back to XLA.
-        states, params = materialize_batch(
-            reqs, capacity, self.cfg, pad_to=size
-        )
-        out = batched_rollout(
-            states, params, self.cfg, self.n_steps,
-            record=self.record, telemetry=self.telemetry,
-        )
+        with self.tracer.span(
+            COALESCE_SPAN, rids=rids, capacity=capacity, size=size
+        ):
+            states, params = materialize_batch(
+                reqs, capacity, self.cfg, pad_to=size
+            )
+        with self.tracer.span(
+            LAUNCH_SPAN, rids=rids, capacity=capacity, size=size
+        ):
+            out = batched_rollout(
+                states, params, self.cfg, self.n_steps,
+                record=self.record, telemetry=self.telemetry,
+            )
         traj = telem = None
         if self.record and self.telemetry:
             states, traj, telem = out
@@ -325,22 +352,23 @@ class RolloutService:
         d = self._dispatches.pop(rid)
         i = d.rids.index(rid)
         req, capacity = self._requests.pop(rid)
-        summary = None
-        if d.telem is not None:
-            summary = TelemetrySummary.from_ticks(
-                tenant_telemetry(d.host_telem(), i)
-            ).to_dict()
-        traj = None
-        if d.traj is not None:
-            traj = d.host_traj()[:, i, : req.n_agents]
-        result = TenantResult(
-            request_id=rid,
-            n_agents=req.n_agents,
-            capacity=capacity,
-            state=tenant_state(d.host_states(), i),
-            summary=summary,
-            traj=traj,
-        )
+        with self.tracer.span(COLLECT_SPAN, rid=rid):
+            summary = None
+            if d.telem is not None:
+                summary = TelemetrySummary.from_ticks(
+                    tenant_telemetry(d.host_telem(), i)
+                ).to_dict()
+            traj = None
+            if d.traj is not None:
+                traj = d.host_traj()[:, i, : req.n_agents]
+            result = TenantResult(
+                request_id=rid,
+                n_agents=req.n_agents,
+                capacity=capacity,
+                state=tenant_state(d.host_states(), i),
+                summary=summary,
+                traj=traj,
+            )
         self.stats["collected"] += 1
         return result
 
@@ -522,6 +550,7 @@ class StreamingService:
         telemetry: bool = True,
         record: bool = False,
         slo: Optional[SloTracker] = None,
+        tracer: Optional[SpanTracer] = None,
     ):
         self.cfg = validate_serve_config(cfg or DEFAULT_CONFIG)
         self.spec = spec or BucketSpec()
@@ -547,8 +576,20 @@ class StreamingService:
         self.record = bool(record)
         self.max_queue = max_queue
         self.slo = slo or SloTracker(deadline_s=deadline_s)
+        #: Same injectable registry as RolloutService; the admission
+        #: queue shares it (and the SLO clock), so its retrospective
+        #: queue-wait spans land on the same timeline as the dispatch
+        #: spans below.
+        self.tracer = TRACER if tracer is None else tracer
+        # The runtime half of the memory observatory (r17): the SLO
+        # summary samples the device allocator's peak-bytes watermark
+        # where the backend keeps one (structured skip on CPU).  The
+        # tracker itself stays jax-free, so the probe is injected.
+        if self.slo.memory_probe is None:
+            self.slo.memory_probe = device_memory_watermark
         self.queue = AdmissionQueue(
-            self.spec, deadline_s, clock=self.slo.clock
+            self.spec, deadline_s, clock=self.slo.clock,
+            tracer=self.tracer,
         )
         self._next_rid = 0
         self._streams: Dict[int, _Stream] = {}   # uncollected rids
@@ -589,6 +630,10 @@ class StreamingService:
             and self.queue.depth >= self.max_queue
         ):
             self.slo.on_queue_overflow(self.queue.depth, self.max_queue)
+            self.tracer.instant(
+                OVERFLOW_EVENT, depth=self.queue.depth,
+                bound=self.max_queue,
+            )
             raise QueueOverflowError(
                 f"admission queue at its declared bound "
                 f"({self.queue.depth}/{self.max_queue}); pump() or "
@@ -636,9 +681,12 @@ class StreamingService:
         for rid in rids:
             self.slo.on_admit(rid)
         self.stats["padded_scenarios"] += size - len(reqs)
-        states, params = materialize_batch(
-            reqs, capacity, self.cfg, pad_to=size
-        )
+        with self.tracer.span(
+            COALESCE_SPAN, rids=rids, capacity=capacity, size=size
+        ):
+            states, params = materialize_batch(
+                reqs, capacity, self.cfg, pad_to=size
+            )
         s = _Stream(rids, reqs, capacity, size, params, states,
                     self._seg_plan)
         for rid in rids:
@@ -659,11 +707,16 @@ class StreamingService:
             for rid in sorted(s.evict_flags):
                 if rid in s.evicted:
                     continue
-                i = s.rids.index(rid)
-                view = jax.tree_util.tree_map(
-                    lambda x, i=i: x[i], s.carry
-                )
-                s.evicted[rid] = (s.ticks_elapsed(), view, s.seg_done)
+                with self.tracer.span(
+                    EVICT_SPAN, rid=rid, ticks=s.ticks_elapsed()
+                ):
+                    i = s.rids.index(rid)
+                    view = jax.tree_util.tree_map(
+                        lambda x, i=i: x[i], s.carry
+                    )
+                    s.evicted[rid] = (
+                        s.ticks_elapsed(), view, s.seg_done
+                    )
                 self.slo.on_eviction(rid, s.ticks_elapsed())
                 self.stats["evicted"] += 1
             s.evict_flags.clear()
@@ -675,10 +728,18 @@ class StreamingService:
                 # not to the queue.
                 self.slo.on_launch(s.rids)
             seg_len = s.seg_plan[s.seg_done]
-            out = batched_rollout(
-                s.carry, s.params, self.cfg, seg_len,
-                record=self.record, telemetry=self.telemetry,
-            )
+            # Segment 1's dispatch is the LAUNCH span (TTFR's compute
+            # edge); later rotations are SEGMENT spans — together the
+            # critical-path table's compute proxy (the host-side
+            # launches bracket the async device work they enqueue).
+            with self.tracer.span(
+                LAUNCH_SPAN if first else SEGMENT_SPAN,
+                rids=s.rids, seg=s.seg_done, seg_len=seg_len,
+            ):
+                out = batched_rollout(
+                    s.carry, s.params, self.cfg, seg_len,
+                    record=self.record, telemetry=self.telemetry,
+                )
             traj = telem = None
             if self.record and self.telemetry:
                 states, traj, telem = out
@@ -722,6 +783,7 @@ class StreamingService:
                 # swarmlint: disable=serve-host-sync -- the probe is already finished (is_ready above) or a host array; the read cannot stall the pump
                 np.asarray(s.probe)
                 self.slo.on_first_result(s.rids)
+                self.tracer.instant(HARVEST_EVENT, rids=s.rids)
                 s.first_stamped = True
 
     # -- eviction / join ---------------------------------------------------
@@ -830,29 +892,31 @@ class StreamingService:
     def _result_for(self, s: _Stream, rid: int) -> TenantResult:
         req, capacity = self._requests.pop(rid)
         i = s.rids.index(rid)
-        if rid in s.evicted:
-            ticks, view, n_segs = s.evicted.pop(rid)
-            state = jax.tree_util.tree_map(np.asarray, view)
-            summary = None
-            if self.telemetry and n_segs:
-                summary = TelemetrySummary.from_ticks(
-                    s.tenant_telem(i, n_segs)
-                ).to_dict()
-            traj = (
-                s.tenant_traj(i, req.n_agents, n_segs)
-                if self.record else None
-            )
-        else:
-            ticks = self.n_steps
-            state = tenant_state(s.host_states(), i)
-            summary = None
-            if self.telemetry and s.telem_segs:
-                summary = TelemetrySummary.from_ticks(
-                    s.tenant_telem(i)
-                ).to_dict()
-            traj = (
-                s.tenant_traj(i, req.n_agents) if self.record else None
-            )
+        with self.tracer.span(COLLECT_SPAN, rid=rid):
+            if rid in s.evicted:
+                ticks, view, n_segs = s.evicted.pop(rid)
+                state = jax.tree_util.tree_map(np.asarray, view)
+                summary = None
+                if self.telemetry and n_segs:
+                    summary = TelemetrySummary.from_ticks(
+                        s.tenant_telem(i, n_segs)
+                    ).to_dict()
+                traj = (
+                    s.tenant_traj(i, req.n_agents, n_segs)
+                    if self.record else None
+                )
+            else:
+                ticks = self.n_steps
+                state = tenant_state(s.host_states(), i)
+                summary = None
+                if self.telemetry and s.telem_segs:
+                    summary = TelemetrySummary.from_ticks(
+                        s.tenant_telem(i)
+                    ).to_dict()
+                traj = (
+                    s.tenant_traj(i, req.n_agents)
+                    if self.record else None
+                )
         s.collected.add(rid)
         del self._streams[rid]
         if not any(r in self._streams for r in s.rids):

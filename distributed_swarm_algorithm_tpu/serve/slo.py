@@ -102,6 +102,7 @@ class SloTracker:
         miss_grace_s: Optional[float] = None,
         clock: Callable[[], float] = time.monotonic,
         max_gauge_samples: int = MAX_GAUGE_SAMPLES,
+        memory_probe: Optional[Callable[[], tuple]] = None,
     ):
         if deadline_s <= 0:
             raise ValueError(
@@ -118,6 +119,14 @@ class SloTracker:
         )
         self.clock = clock
         self.t0 = clock()
+        #: Device-memory watermark probe (r17, the runtime half of
+        #: the memory observatory): a callable returning
+        #: ``(peak_bytes | None, skip_reason)``.  Injected by the
+        #: serve layer (``utils.trace.device_memory_watermark``) —
+        #: the tracker itself stays jax-free, so backends without
+        #: allocator stats surface a STRUCTURED skip in the summary,
+        #: never a silent zero a gate would then trust.
+        self.memory_probe = memory_probe
         #: IN-FLIGHT (and cancelled-while-queued) requests only:
         #: ``on_collect`` compacts a finished clock into the float
         #: sample lists below and drops the object, so a long-running
@@ -281,7 +290,7 @@ class SloTracker:
     def summary(self) -> dict:
         """JSON-safe roll-up — the ``slo.json`` run-dir artifact and
         the ``swarmscope slo`` rendering surface."""
-        return {
+        out = {
             "deadline_ms": round(1e3 * self.deadline_s, 3),
             "miss_grace_ms": round(1e3 * self.miss_grace_s, 3),
             "ttfr_ms": latency_percentiles(self.ttfr_ms()),
@@ -294,3 +303,11 @@ class SloTracker:
             "gauge_stride": self._gauge_stride,
             "queue_depth": [list(g) for g in self.gauges],
         }
+        if self.memory_probe is not None:
+            peak, reason = self.memory_probe()
+            out["device_peak_bytes"] = (
+                int(peak) if peak is not None else None
+            )
+            if peak is None:
+                out["device_memory_skip"] = reason
+        return out

@@ -232,6 +232,12 @@ class CompileWatch:
         #: reset(): resetting the *observation* ledger must not throw
         #: away lowerings that are still valid.
         self._lowered: Dict[tuple, tuple] = {}
+        #: (entry, signature) -> (fn, memory-bytes dict) — the r17
+        #: memory observatory's memoized ``compiled.memory_analysis()``
+        #: results, riding the lowering cache (a compile is a pure
+        #: function of the same key; same identity guard).  Survives
+        #: reset() like the lowerings; cleared by clear_lowered().
+        self._memory: Dict[tuple, tuple] = {}
 
     # -- lifecycle ---------------------------------------------------------
     def enable(self) -> "CompileWatch":
@@ -254,6 +260,7 @@ class CompileWatch:
         the observation ledger does not invalidate them — but tests
         exercising the cache lifecycle need an explicit drop)."""
         self._lowered.clear()
+        self._memory.clear()
 
     # -- bucket budgets (r13) ----------------------------------------------
     def declare_buckets(self, entry: str, max_entries: int) -> None:
@@ -421,6 +428,124 @@ class CompileWatch:
             hit = (inner, lowered, [str(w.message) for w in caught])
             self._lowered[key] = hit
         return hit[1], hit[2]
+
+    @staticmethod
+    def _compile_uncached(lowered):
+        """``lowered.compile()`` with the persistent compile cache
+        bypassed.  Two memoizations stand between ``compile()`` and a
+        real buffer assignment: ``is_cache_used`` pins its verdict at
+        the process's first compile (so flipping
+        ``jax_enable_compilation_cache`` alone does nothing — reset
+        that check around the flip; ``reset_cache`` touches only
+        in-process state, never the on-disk cache), and the lowering
+        caches its first executable (a no-op default like ``{}``
+        returns it verbatim — pass an explicitly-defaulted XLA option
+        to force the recompile without changing codegen)."""
+        import jax
+
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:
+            _cc = None
+        prev = jax.config.jax_enable_compilation_cache
+        jax.config.update("jax_enable_compilation_cache", False)
+        try:
+            return lowered.compile(
+                compiler_options={"xla_embed_ir_in_executable": False}
+            ).memory_analysis()
+        finally:
+            jax.config.update("jax_enable_compilation_cache", prev)
+            if _cc is not None:
+                try:
+                    _cc.reset_cache()
+                except Exception:
+                    pass
+
+    def memory_cached(
+        self,
+        fn: Callable,
+        *args,
+        has_aliasing: Optional[bool] = None,
+        **kwargs,
+    ) -> dict:
+        """The compiled program's memory footprint for one entry +
+        example args, memoized per (entry, signature) — the static
+        half of the r17 memory observatory.  Unlike :meth:`analyze`
+        this DOES backend-compile (``lower(...).compile()`` — still no
+        execution): ``memory_analysis()`` only exists on the compiled
+        executable, because peak temp bytes are a property of the
+        buffer assignment, not of the StableHLO.
+
+        ``has_aliasing``: whether the lowering carries
+        ``tf.aliasing_output`` attrs, when the caller already knows
+        (jaxlint's census does) — saves this method re-rendering the
+        module text for its deserialized-alias-stats guard below.
+
+        Returns ``{"temp-bytes", "argument-bytes", "output-bytes",
+        "alias-bytes", "generated-code-bytes"}`` (ints), or
+        ``{"skipped": reason}`` where the backend keeps no memory
+        analysis — a structured skip, never a silent zero a budget
+        gate would then trust."""
+        entry = getattr(fn, "entry", None) or getattr(
+            fn, "__name__", repr(fn)
+        )
+        key = (entry, arg_signature(args, kwargs))
+        inner = (
+            fn if hasattr(fn, "lower")
+            else getattr(fn, "__wrapped__", fn)
+        )
+        hit = self._memory.get(key)
+        if hit is not None and hit[0] is inner:
+            return hit[1]
+        lowered, _ = self.lower_cached(fn, *args, **kwargs)
+        try:
+            stats = lowered.compile().memory_analysis()
+            # An executable deserialized from the persistent compile
+            # cache drops alias_size_in_bytes (measured: 1000 -> 0 on
+            # a warm /tmp cache) — so when alias reads zero but the
+            # lowering PROVES aliasing (tf.aliasing_output attrs),
+            # re-compile with the cache bypassed for a real buffer
+            # assignment.  Only donated entries ever pay this second
+            # compile; a cold-cache first compile of one reports its
+            # alias bytes directly and skips it too.
+            if (
+                stats is not None
+                and int(stats.alias_size_in_bytes) == 0
+                and (
+                    has_aliasing
+                    if has_aliasing is not None
+                    else "tf.aliasing_output" in lowered.as_text()
+                )
+            ):
+                stats = self._compile_uncached(lowered)
+        except Exception as e:
+            out = {
+                "skipped": (
+                    f"compile/memory_analysis failed: "
+                    f"{type(e).__name__}: {e}"
+                )
+            }
+        else:
+            if stats is None:
+                out = {
+                    "skipped": "backend reports no memory analysis"
+                }
+            else:
+                out = {
+                    "temp-bytes": int(stats.temp_size_in_bytes),
+                    "argument-bytes": int(
+                        stats.argument_size_in_bytes
+                    ),
+                    "output-bytes": int(stats.output_size_in_bytes),
+                    "alias-bytes": int(stats.alias_size_in_bytes),
+                    "generated-code-bytes": int(
+                        stats.generated_code_size_in_bytes
+                    ),
+                }
+        self._memory[key] = (inner, out)
+        return out
 
     def analyze(self, fn: Callable, *args, **kwargs) -> CompileRecord:
         """Cost-analyze one entry WITHOUT executing or compiling it:

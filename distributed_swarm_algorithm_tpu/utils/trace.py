@@ -1,0 +1,487 @@
+"""swarmtrace — request-scoped host-side span tracing (r17).
+
+The serve stack's observability so far answers *how slow* (the r16
+SLO percentiles) and *what compiled* (the r11 observatory) but not
+*where the time went*: nothing ties one request's queue wait →
+coalesce → launch → segment execution → collect into a single
+viewable timeline.  This module is that timeline — lightweight
+host-side spans with an injectable clock (the ``SloTracker``
+discipline), exported as **Chrome-trace-format JSON** that loads
+directly in Perfetto (https://ui.perfetto.dev) or
+``chrome://tracing``.
+
+Contract (mirrors the r10 telemetry gate and the r11 observatory):
+
+- **Disabled (the default) is free**: every recording call is one
+  attribute check, and :meth:`SpanTracer.span` returns a PINNED
+  module-level no-op context manager — no object is allocated per
+  call, which tests/test_trace.py pins the same way the disabled
+  flight recorder's identical-HLO contract is pinned.
+- **Injectable clock**: tests drive deterministic timelines; the
+  serve layer shares one ``time.monotonic`` with the SLO tracker so
+  span edges and latency stamps agree.
+- **Retrospective emission**: a span whose endpoints were already
+  stamped by other bookkeeping (the admission queue's submit time)
+  is emitted complete via :meth:`SpanTracer.emit` — no begin/end
+  pair to leak across pump cycles.  The explicit
+  :meth:`begin_span`/:meth:`end_span` pair exists for host drivers
+  OUTSIDE the serve hot loop; inside ``serve/`` (or any
+  loop-transform body) swarmlint rule ``span-leak`` flags it — use
+  the ``with`` form or ``emit``.
+- **Device-scope bridging**: an enabled ``span()`` also enters
+  ``jax.profiler.TraceAnnotation``, so when a profiler capture is
+  open the host spans land in the same timeline as the device
+  scopes of the r10 ``named_scope`` map (docs/OBSERVABILITY.md) —
+  one request's host coalesce sits directly above the device ops it
+  dispatched.
+
+Enable with :func:`enable` or ``DSA_TRACE=1``.  With ``DSA_RUN_DIR``
+set, the trace dumps to ``$DSA_RUN_DIR/trace/<proc>-<pid>.json`` at
+exit (one file per process, the compile-observatory discipline);
+``swarmscope trace RUN`` renders the per-request critical-path table
+and slowest-span ranking from it.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# The serve span taxonomy (docs/OBSERVABILITY.md "Tracing & memory").
+# Fixed names — the swarmscope critical-path table buckets by exact
+# string, the metric-fstring discipline applied to spans.
+
+QUEUE_SPAN = "queue.wait"          # submit -> release (admission)
+OVERFLOW_EVENT = "queue.overflow"  # instant: submit rejected at bound
+COALESCE_SPAN = "serve.coalesce"   # group assembly + batch materialize
+LAUNCH_SPAN = "serve.launch"       # first-segment dispatch of a group
+SEGMENT_SPAN = "serve.segment"     # one segment rotation launch
+EVICT_SPAN = "serve.evict"         # mid-stream eviction cut
+HARVEST_EVENT = "serve.harvest"    # instant: first-result probe landed
+COLLECT_SPAN = "serve.collect"     # result transfer + extraction
+FLUSH_SPAN = "serve.flush"         # one-shot service dispatch loop
+
+#: Critical-path buckets for the per-request table, in path order.
+#: A request's end-to-end time decomposes into these span kinds
+#: (`serve.segment` is the device-compute proxy: the host-side
+#: rotation launches bracket the async device work they enqueue).
+CRITICAL_BUCKETS: Tuple[Tuple[str, str], ...] = (
+    ("queue", QUEUE_SPAN),
+    ("coalesce", COALESCE_SPAN),
+    ("launch", LAUNCH_SPAN),
+    ("compute", SEGMENT_SPAN),
+    ("collect", COLLECT_SPAN),
+)
+
+#: Span-count bound: past this the tracer keeps counting but stops
+#: storing, loudly (``dropped`` rides the export metadata) — a
+#: week-long soak must not grow an unbounded host list.
+MAX_SPANS = 100_000
+
+
+@dataclass
+class Span:
+    """One recorded span (``t1`` None = instant event)."""
+
+    name: str
+    t0: float
+    t1: Optional[float]
+    attrs: Dict[str, Any] = field(default_factory=dict)
+
+    def dur_s(self) -> float:
+        return 0.0 if self.t1 is None else self.t1 - self.t0
+
+
+class _NoopSpan:
+    """The pinned disabled-path context manager: one module-level
+    instance, returned from every disabled ``span()`` call — the
+    zero-allocation contract tests pin (`span() is span()`)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+#: Pinned disabled-path handle for begin_span/end_span.
+_NOOP_HANDLE: Tuple = ()
+
+
+def _annotation(name: str):
+    """``jax.profiler.TraceAnnotation`` when jax is importable (the
+    device-scope bridge), else a no-op — the tracer itself must work
+    in jax-free host tooling."""
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return _NoopSpan()
+
+
+class _LiveSpan:
+    """An enabled ``with tracer.span(...)`` region."""
+
+    __slots__ = ("_tracer", "_name", "_attrs", "_t0", "_ann")
+
+    def __init__(self, tracer: "SpanTracer", name: str, attrs: dict):
+        self._tracer = tracer
+        self._name = name
+        self._attrs = attrs
+
+    def __enter__(self):
+        self._ann = _annotation(self._name)
+        self._ann.__enter__()
+        self._t0 = self._tracer.clock()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = self._tracer.clock()
+        self._ann.__exit__(*exc)
+        self._tracer._record(
+            Span(self._name, self._t0, t1, self._attrs)
+        )
+        return False
+
+
+class SpanTracer:
+    """The span registry: record, bound, export.
+
+    One process-global instance (:data:`TRACER`) serves the repo;
+    independent instances exist for tests and benches (the compile-
+    observatory pattern)."""
+
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.monotonic,
+        max_spans: int = MAX_SPANS,
+        enabled: bool = False,
+    ):
+        self.clock = clock
+        # Fresh instances start DISABLED: the env gate applies to the
+        # process-global TRACER only (module bottom) — a bench's
+        # deliberately-off control tracer must not silently enable
+        # under DSA_TRACE=1.
+        self.enabled = bool(enabled)
+        self.max_spans = int(max_spans)
+        self.t0 = clock()
+        self.spans: List[Span] = []
+        self.dropped = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def enable(self) -> "SpanTracer":
+        self.enabled = True
+        return self
+
+    def disable(self) -> "SpanTracer":
+        self.enabled = False
+        return self
+
+    def reset(self) -> None:
+        self.spans.clear()
+        self.dropped = 0
+        self.t0 = self.clock()
+
+    # -- recording ---------------------------------------------------------
+    def _record(self, span: Span) -> None:
+        if len(self.spans) >= self.max_spans:
+            self.dropped += 1
+            return
+        self.spans.append(span)
+
+    def span(self, name: str, **attrs):
+        """Context-manager span — the only sanctioned form inside
+        ``serve/`` and loop-transform bodies (swarmlint rule
+        ``span-leak``).  Disabled returns the pinned no-op."""
+        if not self.enabled:
+            return _NOOP
+        return _LiveSpan(self, name, attrs)
+
+    def emit(self, name: str, t0: float, t1: float, **attrs) -> None:
+        """Retrospective complete span from endpoints stamped by
+        other bookkeeping (the queue's ``submit_t``) — nothing to
+        leak, so it is hot-loop-legal by construction."""
+        if not self.enabled:
+            return
+        self._record(Span(name, t0, t1, attrs))
+
+    def instant(self, name: str, **attrs) -> None:
+        """Instant event (overflow rejections, probe landings)."""
+        if not self.enabled:
+            return
+        self._record(Span(name, self.clock(), None, attrs))
+
+    def begin_span(self, name: str, **attrs):
+        """Explicit begin of a cross-call span; pair with
+        :meth:`end_span`.  For host DRIVERS only — inside ``serve/``
+        or a loop-transform body the ``span-leak`` lint flags it
+        (use ``with span(...)`` or :meth:`emit`)."""
+        if not self.enabled:
+            return _NOOP_HANDLE
+        return (name, self.clock(), attrs)
+
+    def end_span(self, handle) -> None:
+        if not self.enabled or handle is _NOOP_HANDLE or not handle:
+            return
+        name, t0, attrs = handle
+        self._record(Span(name, t0, self.clock(), attrs))
+
+    # -- export ------------------------------------------------------------
+    def chrome_trace(self) -> dict:
+        """The Chrome-trace-format dict (Perfetto /
+        ``chrome://tracing`` loadable).  Each distinct span NAME gets
+        its own ``tid`` row (named via ``M``etadata events), so the
+        taxonomy reads as parallel tracks; timestamps are
+        microseconds relative to the tracer's birth."""
+        names = sorted({s.name for s in self.spans})
+        tids = {n: i for i, n in enumerate(names)}
+        pid = os.getpid()
+        events: List[dict] = [
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": tids[n],
+                "args": {"name": n},
+            }
+            for n in names
+        ]
+        for s in self.spans:
+            ev = {
+                "name": s.name,
+                "cat": "swarmtrace",
+                "pid": pid,
+                "tid": tids[s.name],
+                "ts": round(1e6 * (s.t0 - self.t0), 3),
+                "args": dict(s.attrs),
+            }
+            if s.t1 is None:
+                ev["ph"] = "i"
+                ev["s"] = "p"
+            else:
+                ev["ph"] = "X"
+                ev["dur"] = round(1e6 * (s.t1 - s.t0), 3)
+            events.append(ev)
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "tool": "swarmtrace",
+                "spans": len(self.spans),
+                "dropped": self.dropped,
+            },
+        }
+
+    def dump(self, path: str) -> str:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as fh:
+            json.dump(self.chrome_trace(), fh)
+            fh.write("\n")
+        return path
+
+
+def load_chrome_trace(path: str) -> List[Span]:
+    """Inverse of :meth:`SpanTracer.dump` for the duration/instant
+    events (metadata rows are presentation, not spans) — the
+    round-trip tests and the ``swarmscope trace`` reader share it."""
+    with open(path) as fh:
+        data = json.load(fh)
+    return chrome_trace_spans(data)
+
+
+def chrome_trace_spans(data: dict) -> List[Span]:
+    """The span list of an already-parsed Chrome-trace dict (callers
+    holding the dict for other reasons — the CLI's ``--export`` merge
+    — must not pay a second file parse)."""
+    out: List[Span] = []
+    for ev in data.get("traceEvents", []):
+        ph = ev.get("ph")
+        if ph not in ("X", "i"):
+            continue
+        t0 = float(ev.get("ts", 0.0)) / 1e6
+        t1 = (
+            t0 + float(ev.get("dur", 0.0)) / 1e6 if ph == "X" else None
+        )
+        out.append(
+            Span(
+                name=str(ev.get("name", "?")),
+                t0=t0,
+                t1=t1,
+                attrs=dict(ev.get("args", {})),
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Per-request critical-path reduction (the ``swarmscope trace`` core)
+
+
+def span_rids(span: Span) -> List[int]:
+    """The request ids a span attributes to: a per-request span
+    carries ``rid``, a dispatch-group span carries ``rids`` (group
+    time is charged to every member — the group IS each member's
+    critical path, not a shared cost to amortize)."""
+    if "rid" in span.attrs:
+        return [int(span.attrs["rid"])]
+    return [int(r) for r in span.attrs.get("rids", ())]
+
+
+def request_table(spans: List[Span]) -> Dict[int, dict]:
+    """Per-rid critical-path decomposition: ``{rid: {"total_ms",
+    "kinds", bucket: ms, ...}}`` over :data:`CRITICAL_BUCKETS`, plus
+    the distinct span-kind count (the acceptance surface: a fully
+    served request sees >= 5 kinds)."""
+    out: Dict[int, dict] = {}
+    by_bucket = {name: bucket for bucket, name in CRITICAL_BUCKETS}
+    for s in spans:
+        for rid in span_rids(s):
+            row = out.setdefault(
+                rid,
+                {bucket: 0.0 for bucket, _ in CRITICAL_BUCKETS}
+                | {"total_ms": 0.0, "kinds": set()},
+            )
+            row["kinds"].add(s.name)
+            bucket = by_bucket.get(s.name)
+            if bucket is not None:
+                ms = 1e3 * s.dur_s()
+                row[bucket] += ms
+                row["total_ms"] += ms
+    for row in out.values():
+        row["kinds"] = sorted(row["kinds"])
+    return out
+
+
+def slowest_spans(spans: List[Span], n: int = 10) -> List[Span]:
+    """Top-``n`` spans by duration, longest first (instant events
+    carry no duration and are excluded) — the ``swarmscope trace``
+    ranking."""
+    timed = [s for s in spans if s.t1 is not None]
+    timed.sort(key=lambda s: -s.dur_s())
+    return timed[:n]
+
+
+def merge_chrome_traces(sources: List[Tuple[str, dict]]) -> dict:
+    """One Chrome-trace dict from several ``(label, trace_dict)``
+    sources — the ``swarmscope trace --export`` merge.  Each source
+    keeps its own event stream but is remapped onto a distinct ``pid``
+    (with a ``process_name`` metadata row), so host spans and a
+    profiler capture load side by side in Perfetto instead of
+    colliding on the capturing processes' real (possibly equal)
+    pids."""
+    events: List[dict] = []
+    for i, (label, data) in enumerate(sources):
+        events.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": i,
+                "tid": 0,
+                "args": {"name": label},
+            }
+        )
+        for ev in data.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = i
+            events.append(ev)
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"tool": "swarmtrace-merge",
+                      "sources": [label for label, _ in sources]},
+    }
+
+
+# ---------------------------------------------------------------------------
+# Device-memory watermark (the runtime half of the memory observatory)
+
+
+def device_memory_watermark() -> Tuple[Optional[int], str]:
+    """``(peak_bytes, reason)`` from ``device.memory_stats()`` —
+    ``peak_bytes`` is the max over addressable devices of the
+    backend's peak-bytes-in-use gauge (``bytes_in_use`` where no peak
+    is kept).  Backends without allocator stats (CPU) return
+    ``(None, reason)`` — a STRUCTURED skip the SLO summary records,
+    never a silent zero a gate would then trust."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception as e:  # pragma: no cover - import-degraded hosts
+        return None, f"jax unavailable ({type(e).__name__})"
+    peak = None
+    for d in devices:
+        stats = None
+        if hasattr(d, "memory_stats"):
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+        if not stats:
+            continue
+        got = stats.get("peak_bytes_in_use", stats.get("bytes_in_use"))
+        if got is None:
+            continue
+        peak = max(int(got), peak or 0)
+    if peak is None:
+        return None, (
+            f"backend {devices[0].platform if devices else '?'!s} "
+            "reports no memory_stats (CPU keeps no allocator "
+            "watermark)"
+        )
+    return peak, ""
+
+
+# ---------------------------------------------------------------------------
+# Process-global tracer + run-dir deposit
+
+def _env_enabled() -> bool:
+    """The DSA_TRACE gate for the process-global tracer — explicit
+    falsy spellings stay off (``DSA_TRACE=0`` must not trace)."""
+    v = os.environ.get("DSA_TRACE", "").strip().lower()
+    return v not in ("", "0", "false", "off")
+
+
+#: The registry serve/ reports to by default (services accept an
+#: injected tracer for tests and benches).
+TRACER = SpanTracer(enabled=_env_enabled())
+
+
+def enable() -> SpanTracer:
+    return TRACER.enable()
+
+
+def disable() -> SpanTracer:
+    return TRACER.disable()
+
+
+def _dump_to_run_dir() -> None:
+    """atexit hook: with DSA_RUN_DIR set and anything recorded, leave
+    the Chrome trace in the run directory (one file per process, the
+    compile-observatory discipline)."""
+    run_dir = os.environ.get("DSA_RUN_DIR")
+    if not run_dir or not TRACER.spans:
+        return
+    try:
+        name = os.path.basename(sys.argv[0]) if sys.argv else "proc"
+        name = name or "proc"
+        TRACER.dump(
+            os.path.join(
+                run_dir, "trace", f"{name}-{os.getpid()}.json"
+            )
+        )
+    except OSError:
+        pass
+
+
+atexit.register(_dump_to_run_dir)

@@ -274,6 +274,18 @@ SEEDED = {
             return carry
         """,
     ),
+    "span-leak": (
+        "pkg/serve/spanleak.py",
+        """
+        from distributed_swarm_algorithm_tpu.utils.trace import TRACER
+
+        def rotate_segments(streams):
+            handle = TRACER.begin_span("serve.segment")
+            for s in streams:
+                s.step()
+            TRACER.end_span(handle)
+        """,
+    ),
     "done-branch": (
         "pkg/envreset.py",
         """
@@ -645,6 +657,106 @@ def test_precision_no_false_positive(tmp_path, name, src):
     )
     assert not errors
     assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_span_leak_with_form_and_emit_not_flagged(tmp_path):
+    # The sanctioned serve/ forms: the with-span context manager and
+    # retrospective emit (utils/trace.py) — nothing to leak, clean.
+    # The explicit begin/end pair OUTSIDE serve/ and outside any
+    # loop-transform body is a host driver's prerogative.
+    serve_src = """
+    from distributed_swarm_algorithm_tpu.utils.trace import TRACER
+
+    def pump(streams, now):
+        with TRACER.span("serve.segment", rids=[1]):
+            advance(streams)
+        for s in streams:
+            TRACER.emit("queue.wait", s.submit_t, now, rid=s.rid)
+
+    def advance(streams):
+        return streams
+    """
+    driver_src = """
+    from distributed_swarm_algorithm_tpu.utils.trace import TRACER
+
+    def drive(bench):
+        handle = TRACER.begin_span("bench.phase")
+        bench.run()
+        TRACER.end_span(handle)
+    """
+    _write_tree(
+        str(tmp_path),
+        [("pkg/serve/clean.py", serve_src),
+         ("pkg/driver.py", driver_src)],
+    )
+    findings, _, errors = analysis.analyze_paths(str(tmp_path), ["pkg"])
+    assert not errors
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_span_leak_in_loop_transform_body_detected(tmp_path):
+    # begin_span inside a lax.scan body leaks per ITERATION — flagged
+    # anywhere, not just under serve/.
+    src = """
+    import jax
+    from distributed_swarm_algorithm_tpu.utils.trace import TRACER
+
+    def rollout(pos, n_steps):
+        def body(s, _):
+            h = TRACER.begin_span("tick")
+            s = s + 1
+            TRACER.end_span(h)
+            return s, None
+
+        out, _ = jax.lax.scan(body, pos, None, length=n_steps)
+        return out
+    """
+    _write_tree(str(tmp_path), [("scanspan.py", src)])
+    findings, _, _ = analysis.analyze_paths(
+        str(tmp_path), ["scanspan.py"]
+    )
+    assert [f.rule for f in findings] == ["span-leak"]
+    assert "loop-transform body" in findings[0].message
+
+
+def test_span_leak_profiler_trace_pairing(tmp_path):
+    # start_trace with stop_trace reachable in the same scope (the
+    # utils/profiling.trace try/finally pattern, here via a helper —
+    # the closure walk must follow the call) is clean; a start with
+    # no stop anywhere in scope flags.
+    paired = """
+    import jax
+
+    def capture(log_dir, fn):
+        jax.profiler.start_trace(log_dir)
+        try:
+            return fn()
+        finally:
+            _finish()
+
+    def _finish():
+        jax.profiler.stop_trace()
+    """
+    leaky = """
+    import jax
+
+    def capture(log_dir, fn):
+        jax.profiler.start_trace(log_dir)
+        return fn()
+    """
+    _write_tree(
+        str(tmp_path),
+        [("paired.py", paired), ("leaky.py", leaky)],
+    )
+    findings, _, _ = analysis.analyze_paths(
+        str(tmp_path), ["paired.py"]
+    )
+    assert not findings, "\n".join(f.render() for f in findings)
+    findings, _, _ = analysis.analyze_paths(
+        str(tmp_path), ["leaky.py"]
+    )
+    assert [f.rule for f in findings] == ["span-leak"]
+    assert "stop_trace" in findings[0].message
 
 
 def test_serve_host_sync_collect_path_not_flagged(tmp_path):

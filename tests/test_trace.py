@@ -1,0 +1,547 @@
+"""swarmtrace (r17, utils/trace.py) + the memory observatory.
+
+Five layers:
+
+- span mechanics: fake-clock exactness of the with-form, emit, and
+  instant paths; the DISABLED tracer's pinned zero-allocation no-op
+  (the r10 telemetry-gate discipline applied to host spans);
+- Chrome-trace export: schema shape (Perfetto-loadable), round-trip
+  through ``load_chrome_trace``, the bounded-span drop counter, and
+  the multi-source ``merge_chrome_traces`` pid remap;
+- serve integration: a streamed StreamingService run emits the full
+  >= 5-kind span taxonomy per request (queue.wait through
+  serve.collect), queue-overflow instants, and eviction spans; the
+  SLO summary carries the device-memory watermark (structured skip
+  on CPU);
+- the memory observatory: ``CompileWatch.memory_cached`` memoization
+  + identity guard, and the jaxlint bytes-census budget lifecycle
+  (undeclared/over-ceiling/roundtrip/validation) mirroring the r15
+  census tests;
+- the ``swarmscope trace`` CLI: golden output over a fake-clock run
+  directory, and the --export merge.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu import serve
+from distributed_swarm_algorithm_tpu.analysis import jaxlint
+from distributed_swarm_algorithm_tpu.cli import main as cli_main
+from distributed_swarm_algorithm_tpu.utils import trace as tracelib
+from distributed_swarm_algorithm_tpu.utils.compile_watch import (
+    CompileWatch,
+)
+
+
+class FakeClock:
+    """Deterministic injectable clock (the SloTracker test idiom)."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# Span mechanics
+
+
+def test_fake_clock_span_exactness():
+    clk = FakeClock(100.0)
+    tr = tracelib.SpanTracer(clock=clk).enable()
+    with tr.span("serve.launch", rid=3):
+        clk.advance(0.25)
+    tr.emit("queue.wait", 99.0, 100.5, rid=3)
+    clk.advance(0.5)
+    tr.instant("serve.harvest", rids=[3])
+    assert [s.name for s in tr.spans] == [
+        "serve.launch", "queue.wait", "serve.harvest",
+    ]
+    launch, queue, harvest = tr.spans
+    assert (launch.t0, launch.t1) == (100.0, 100.25)
+    assert launch.dur_s() == pytest.approx(0.25)
+    assert launch.attrs == {"rid": 3}
+    assert (queue.t0, queue.t1) == (99.0, 100.5)
+    assert harvest.t1 is None and harvest.t0 == 100.75
+    assert harvest.dur_s() == 0.0
+
+
+def test_disabled_tracer_is_a_pinned_noop():
+    tr = tracelib.SpanTracer()
+    assert not tr.enabled
+    # The zero-allocation pin: every disabled span() returns the SAME
+    # module-level context manager, and nothing records.
+    assert tr.span("a") is tr.span("b")
+    with tr.span("a", rid=1):
+        pass
+    tr.emit("q", 0.0, 1.0, rid=1)
+    tr.instant("i")
+    handle = tr.begin_span("x")
+    assert handle is tracelib._NOOP_HANDLE
+    tr.end_span(handle)
+    assert tr.spans == [] and tr.dropped == 0
+
+
+def test_fresh_instance_ignores_env_gate(monkeypatch):
+    # DSA_TRACE gates the process-global TRACER only: a bench's
+    # deliberately-off control tracer must stay off under DSA_TRACE=1
+    # (or the overhead gate compares traced-vs-traced and can never
+    # fail), and explicit falsy spellings must not enable.
+    monkeypatch.setenv("DSA_TRACE", "1")
+    assert not tracelib.SpanTracer().enabled
+    assert tracelib._env_enabled()
+    for off in ("", "0", "false", "OFF"):
+        monkeypatch.setenv("DSA_TRACE", off)
+        assert not tracelib._env_enabled()
+
+
+def test_begin_end_span_and_reset():
+    clk = FakeClock()
+    tr = tracelib.SpanTracer(clock=clk).enable()
+    h = tr.begin_span("driver.phase", run=7)
+    clk.advance(2.0)
+    tr.end_span(h)
+    assert tr.spans[0].dur_s() == pytest.approx(2.0)
+    assert tr.spans[0].attrs == {"run": 7}
+    tr.reset()
+    assert tr.spans == [] and tr.t0 == clk.t
+
+
+def test_span_bound_drops_loudly():
+    clk = FakeClock()
+    tr = tracelib.SpanTracer(clock=clk, max_spans=3).enable()
+    for i in range(5):
+        tr.emit("s", 0.0, 1.0, rid=i)
+    assert len(tr.spans) == 3
+    assert tr.dropped == 2
+    assert tr.chrome_trace()["otherData"]["dropped"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace export + round-trip
+
+
+def _demo_tracer() -> tracelib.SpanTracer:
+    clk = FakeClock(10.0)
+    tr = tracelib.SpanTracer(clock=clk).enable()
+    tr.emit(tracelib.QUEUE_SPAN, 10.0, 10.010, rid=0, capacity=32)
+    tr.emit(tracelib.COALESCE_SPAN, 10.010, 10.012, rids=[0])
+    tr.emit(tracelib.LAUNCH_SPAN, 10.012, 10.020, rids=[0])
+    tr.emit(tracelib.SEGMENT_SPAN, 10.020, 10.025, rids=[0])
+    tr.emit(tracelib.COLLECT_SPAN, 10.030, 10.032, rid=0)
+    clk.advance(0.022)
+    tr.instant(tracelib.HARVEST_EVENT, rids=[0])
+    return tr
+
+
+def test_chrome_trace_schema_and_roundtrip(tmp_path):
+    tr = _demo_tracer()
+    data = tr.chrome_trace()
+    events = data["traceEvents"]
+    # Metadata rows name one tid per span kind; duration events are
+    # complete ("X") with microsecond ts/dur; instants are "i".
+    metas = [e for e in events if e["ph"] == "M"]
+    assert {m["args"]["name"] for m in metas} == {
+        s.name for s in tr.spans
+    }
+    xs = [e for e in events if e["ph"] == "X"]
+    assert all("dur" in e and "ts" in e for e in xs)
+    assert len(xs) == 5
+    queue = next(e for e in xs if e["name"] == tracelib.QUEUE_SPAN)
+    assert queue["ts"] == 0.0 and queue["dur"] == pytest.approx(1e4)
+    instants = [e for e in events if e["ph"] == "i"]
+    assert len(instants) == 1 and instants[0]["s"] == "p"
+
+    path = tr.dump(str(tmp_path / "trace" / "t.json"))
+    spans = tracelib.load_chrome_trace(path)
+    assert [s.name for s in spans] == [s.name for s in tr.spans]
+    for got, want in zip(spans, tr.spans):
+        assert got.dur_s() == pytest.approx(want.dur_s(), abs=1e-8)
+        assert tracelib.span_rids(got) == tracelib.span_rids(want)
+
+
+def test_request_table_and_slowest_spans():
+    tr = _demo_tracer()
+    table = tracelib.request_table(tr.spans)
+    assert set(table) == {0}
+    row = table[0]
+    assert len(row["kinds"]) == 6
+    assert row["queue"] == pytest.approx(10.0)
+    assert row["compute"] == pytest.approx(5.0)
+    assert row["total_ms"] == pytest.approx(10 + 2 + 8 + 5 + 2)
+    top = tracelib.slowest_spans(tr.spans, 2)
+    assert [s.name for s in top] == [
+        tracelib.QUEUE_SPAN, tracelib.LAUNCH_SPAN,
+    ]
+
+
+def test_merge_chrome_traces_remaps_pids():
+    a = _demo_tracer().chrome_trace()
+    b = _demo_tracer().chrome_trace()
+    merged = tracelib.merge_chrome_traces([("host", a), ("prof", b)])
+    pids = {e["pid"] for e in merged["traceEvents"]}
+    assert pids == {0, 1}
+    names = [
+        e["args"]["name"] for e in merged["traceEvents"]
+        if e.get("name") == "process_name"
+    ]
+    assert names == ["host", "prof"]
+
+
+# ---------------------------------------------------------------------------
+# Serve integration
+
+_CFG = dsa.SwarmConfig().replace(
+    formation_shape="none", utility_threshold=2.0
+)
+_SPEC = serve.BucketSpec(capacities=(16,), batches=(1, 2))
+
+
+def test_streaming_service_emits_full_span_taxonomy():
+    tr = tracelib.SpanTracer().enable()
+    svc = serve.StreamingService(
+        _CFG, spec=_SPEC, n_steps=8, segment_steps=4,
+        deadline_s=0.01, telemetry=False, tracer=tr,
+    )
+    rids = [
+        svc.submit(serve.ScenarioRequest(n_agents=8 + i, seed=i))
+        for i in range(3)
+    ]
+    svc.drain()
+    table = tracelib.request_table(tr.spans)
+    want = {
+        tracelib.QUEUE_SPAN, tracelib.COALESCE_SPAN,
+        tracelib.LAUNCH_SPAN, tracelib.SEGMENT_SPAN,
+        tracelib.COLLECT_SPAN,
+    }
+    for rid in rids:
+        assert want <= set(table[rid]["kinds"]), (
+            rid, table[rid]["kinds"]
+        )
+    # Queue spans share the SLO clock: admission wait is the span the
+    # tracker also measured.
+    q = [s for s in tr.spans if s.name == tracelib.QUEUE_SPAN]
+    assert len(q) == len(rids)
+    assert all(s.dur_s() >= 0.0 for s in q)
+
+
+def test_streaming_eviction_and_overflow_spans():
+    tr = tracelib.SpanTracer().enable()
+    svc = serve.StreamingService(
+        _CFG, spec=_SPEC, n_steps=8, segment_steps=4,
+        deadline_s=0.01, max_queue=2, telemetry=False, tracer=tr,
+    )
+    rids = [
+        svc.submit(serve.ScenarioRequest(n_agents=8, seed=i))
+        for i in range(2)
+    ]
+    with pytest.raises(serve.QueueOverflowError):
+        svc.submit(serve.ScenarioRequest(n_agents=8, seed=9))
+    overflow = [
+        s for s in tr.spans if s.name == tracelib.OVERFLOW_EVENT
+    ]
+    assert len(overflow) == 1 and overflow[0].t1 is None
+    assert overflow[0].attrs == {"depth": 2, "bound": 2}
+    svc.pump(force=True)
+    assert svc.evict(rids[0])
+    svc.drain()
+    evicts = [s for s in tr.spans if s.name == tracelib.EVICT_SPAN]
+    assert [s.attrs["rid"] for s in evicts] == [rids[0]]
+
+
+def test_disabled_tracer_service_records_nothing():
+    tr = tracelib.SpanTracer()
+    svc = serve.StreamingService(
+        _CFG, spec=_SPEC, n_steps=4, segment_steps=4,
+        deadline_s=0.01, telemetry=False, tracer=tr,
+    )
+    svc.submit(serve.ScenarioRequest(n_agents=8, seed=0))
+    svc.drain()
+    assert tr.spans == [] and tr.dropped == 0
+
+
+def test_slo_summary_device_memory_watermark():
+    # CPU keeps no allocator watermark: the summary must carry a
+    # STRUCTURED skip, never a silent zero (the gate discipline).
+    svc = serve.StreamingService(
+        _CFG, spec=_SPEC, n_steps=4, segment_steps=4,
+        telemetry=False,
+    )
+    summ = svc.slo.summary()
+    assert "device_peak_bytes" in summ
+    assert summ["device_peak_bytes"] is None
+    assert "memory_stats" in summ["device_memory_skip"]
+    # A backend WITH allocator stats reports the peak and no skip.
+    svc.slo.memory_probe = lambda: (123456, "")
+    summ = svc.slo.summary()
+    assert summ["device_peak_bytes"] == 123456
+    assert "device_memory_skip" not in summ
+
+
+def test_device_memory_watermark_structured_skip():
+    peak, reason = tracelib.device_memory_watermark()
+    assert peak is None            # CPU rig
+    assert reason
+
+
+# ---------------------------------------------------------------------------
+# Memory observatory: memory_cached + the bytes-census budget ledger
+
+
+def test_memory_cached_measures_and_memoizes():
+    import jax
+    import jax.numpy as jnp
+
+    watch = CompileWatch()
+
+    @jax.jit
+    def f(x):
+        return (x * 2.0).sum()
+
+    x = jnp.zeros((16, 16), jnp.float32)
+    got = watch.memory_cached(f, x)
+    assert got["argument-bytes"] == 1024
+    assert got["output-bytes"] == 4
+    assert "skipped" not in got
+    # Memoized: the same (entry, signature) returns the cached dict.
+    assert watch.memory_cached(f, x) is got
+    # clear_lowered drops the memory cache with the lowerings.
+    watch.clear_lowered()
+    assert watch.memory_cached(f, x) is not got
+
+
+def test_memory_cached_identity_guard():
+    # Two distinct same-named functions with identical shapes must
+    # not share a footprint (the lower_cached identity discipline).
+    import jax
+    import jax.numpy as jnp
+
+    watch = CompileWatch()
+
+    def make(k):
+        @jax.jit
+        def g(x):
+            return x[:k].sum()
+
+        return g
+
+    x = jnp.zeros((8,), jnp.float32)
+    a = watch.memory_cached(make(2), x)
+    b = watch.memory_cached(make(8), x)
+    assert a is not b
+
+
+def test_donated_aliasing_reduces_temp_bytes():
+    # The acceptance surface: donation shows up in the bytes census
+    # as alias-bytes > 0, and the serve entry's ledger records it.
+    audit = jaxlint.audit_entry("serve-batched-rollout", memory=True)
+    assert audit.memory["alias-bytes"] > 0
+    declared = jaxlint.load_budgets(os.path.join(
+        jaxlint.REPO_ROOT, jaxlint.DEFAULT_BUDGETS_BASENAME
+    ))["serve-batched-rollout"]
+    assert declared.budgets.get("alias-bytes", 0) > 0
+    findings = [
+        f for f in jaxlint.check_against_budget(audit, declared)
+        if f.check in jaxlint.MEMORY_KEYS
+    ]
+    assert not findings, [f.render() for f in findings]
+
+
+def test_memory_budget_lifecycle(tmp_path):
+    # The r15 budget-ledger discipline extended to bytes: undeclared
+    # footprints gate, over-ceiling gates, within-ceiling is clean.
+    audit = jaxlint.EntryAudit(
+        entry="e", signature="s",
+        counts={k: 0 for k in jaxlint.census_keys()},
+        memory={
+            "temp-bytes": 4096, "argument-bytes": 256,
+            "output-bytes": 128, "alias-bytes": 0,
+            "generated-code-bytes": 0,
+        },
+    )
+    undeclared = jaxlint.BudgetEntry(
+        entry="e", signature="s", budgets={}, justification="j",
+    )
+    findings = jaxlint.check_against_budget(audit, undeclared)
+    assert sorted(f.check for f in findings) == [
+        "argument-bytes", "output-bytes", "temp-bytes",
+    ]
+    declared = jaxlint.budget_from_audit(audit, "measured r17")
+    assert declared.budgets["temp-bytes"] == 4096
+    assert "alias-bytes" not in declared.budgets   # zero = default
+    assert not jaxlint.check_against_budget(audit, declared)
+    # Growth past the ceiling gates with measured/budget attached.
+    grown = jaxlint.EntryAudit(
+        entry="e", signature="s", counts=audit.counts,
+        memory=dict(audit.memory, **{"temp-bytes": 9000}),
+    )
+    findings = jaxlint.check_against_budget(grown, declared)
+    assert [f.check for f in findings] == ["temp-bytes"]
+    assert findings[0].measured == 9000
+    assert findings[0].budget == 4096
+    # Ledger roundtrip accepts memory keys; unknown keys still fail.
+    path = str(tmp_path / "b.json")
+    jaxlint.save_budgets(path, {"e": declared})
+    assert jaxlint.load_budgets(path)["e"] == declared
+    with open(path, "w") as fh:
+        json.dump(
+            {"entries": [{
+                "entry": "e", "signature": "s",
+                "budgets": {"bogus-bytes": 1}, "justification": "j",
+            }]},
+            fh,
+        )
+    with pytest.raises(jaxlint.BudgetError):
+        jaxlint.load_budgets(path)
+
+
+def test_memoryless_rewrite_preserves_byte_ceilings():
+    # --write-budgets under --no-memory (or a structural backend
+    # skip) must NOT erase the declared byte ceilings: an audit with
+    # no memory census carries the previous entry's MEMORY_KEYS
+    # budgets forward instead of silently dropping them.
+    previous = jaxlint.BudgetEntry(
+        entry="e", signature="s",
+        budgets={"temp-bytes": 4096, "alias-bytes": 1000,
+                 "all-gather": 2},
+        justification="j",
+    )
+    memoryless = jaxlint.EntryAudit(
+        entry="e", signature="s",
+        counts={k: 0 for k in jaxlint.census_keys()},
+        memory_skipped="--no-memory",
+    )
+    rewritten = jaxlint.budget_from_audit(
+        memoryless, "j", previous=previous
+    )
+    assert rewritten.budgets["temp-bytes"] == 4096
+    assert rewritten.budgets["alias-bytes"] == 1000
+    # Op-census keys still re-pin from the audit (0 measured -> gone).
+    assert "all-gather" not in rewritten.budgets
+    # With a real memory census, measured bytes win over previous.
+    measured = jaxlint.EntryAudit(
+        entry="e", signature="s", counts=memoryless.counts,
+        memory={"temp-bytes": 8192, "argument-bytes": 0,
+                "output-bytes": 0, "alias-bytes": 0,
+                "generated-code-bytes": 0},
+    )
+    assert jaxlint.budget_from_audit(
+        measured, "j", previous=previous
+    ).budgets["temp-bytes"] == 8192
+
+
+def test_memory_skip_is_structured_not_silent():
+    audit = jaxlint.EntryAudit(
+        entry="e", signature="s", counts={},
+        memory_skipped="backend reports no memory analysis",
+    )
+    d = audit.to_dict()
+    assert d["memory"] == {}
+    assert d["memory_skipped"]
+    # A skipped bytes census checks nothing (no vacuous findings).
+    entry = jaxlint.BudgetEntry(
+        entry="e", signature="s", budgets={"temp-bytes": 1},
+        justification="j",
+    )
+    assert not [
+        f for f in jaxlint.check_against_budget(audit, entry)
+        if f.check in jaxlint.MEMORY_KEYS
+    ]
+
+
+# ---------------------------------------------------------------------------
+# swarmscope trace CLI
+
+
+def _golden_run_dir(tmp_path) -> str:
+    run = tmp_path / "run"
+    tr = _demo_tracer()
+    tr.dump(str(run / "trace" / "proc-1.json"))
+    return str(run)
+
+
+def test_swarmscope_trace_golden_output(tmp_path, capsys):
+    run = _golden_run_dir(tmp_path)
+    rc = cli_main(["swarmscope", "trace", run, "--top", "2"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    lines = out.splitlines()
+    assert lines[0] == "swarmtrace: 6 spans from 1 file(s)"
+    # The per-request critical-path row: fake-clock exact fractions
+    # of total_ms = 27 ms (queue 10, coalesce 2, launch 8, compute 5,
+    # collect 2), 6 distinct kinds.
+    row = next(ln for ln in lines if ln.strip().startswith("0 "))
+    for frac in ("37.0%", "7.4%", "29.6%", "18.5%"):
+        assert frac in row, (frac, row)
+    assert row.rstrip().endswith("6")
+    assert "slowest spans:" in out
+    assert "10.000 ms  queue.wait" in out
+    assert "8.000 ms  serve.launch" in out
+
+
+def test_swarmscope_trace_export_merges(tmp_path, capsys):
+    run = _golden_run_dir(tmp_path)
+    out_path = str(tmp_path / "merged.json")
+    rc = cli_main(
+        ["swarmscope", "trace", run, "--export", out_path]
+    )
+    assert rc == 0
+    with open(out_path) as fh:
+        merged = json.load(fh)
+    assert merged["otherData"]["tool"] == "swarmtrace-merge"
+    assert {e["pid"] for e in merged["traceEvents"]} == {0}
+    capsys.readouterr()
+
+
+def test_swarmscope_trace_empty_run_errors(tmp_path, capsys):
+    run = tmp_path / "empty"
+    run.mkdir()
+    rc = cli_main(["swarmscope", "trace", str(run)])
+    assert rc == 1
+    assert "no swarmtrace files" in capsys.readouterr().err
+
+
+def test_swarmscope_history_export_round(tmp_path, capsys):
+    hist = tmp_path / "BENCH_HISTORY.json"
+    hist.write_text(json.dumps({
+        "rounds": {
+            "r03": {"m": {"value": 1.0, "unit": "x/sec"}},
+        }
+    }))
+    rc = cli_main([
+        "swarmscope", "history", "--file", str(hist),
+        "--export-round", "r03",
+    ])
+    assert rc == 0
+    snap = json.loads((tmp_path / "BENCH_r03.json").read_text())
+    assert snap == {
+        "round": "r03", "metrics": {"m": {"value": 1.0, "unit": "x/sec"}},
+    }
+    capsys.readouterr()
+    # An unrecorded round cannot be restored — loud, exit 1.
+    rc = cli_main([
+        "swarmscope", "history", "--file", str(hist),
+        "--export-round", "r07",
+    ])
+    assert rc == 1
+    assert "not recorded" in capsys.readouterr().err
+
+
+def test_run_dir_deposit_roundtrip(tmp_path, monkeypatch):
+    # The atexit deposit path, driven directly: dump into
+    # $DSA_RUN_DIR/trace and read back through the CLI loader.
+    tr = _demo_tracer()
+    run = str(tmp_path / "rundir")
+    path = tr.dump(os.path.join(run, "trace", "bench-42.json"))
+    spans = tracelib.load_chrome_trace(path)
+    table = tracelib.request_table(spans)
+    assert len(table[0]["kinds"]) == 6

@@ -35,6 +35,16 @@ Fixed-name rows (cpu families; the script no-ops off-cpu):
                                        against P99_TTFR_CEILING_MS)
   soak-queue-ms-p99, <tag>             unit "ms-p99"
   soak-deadline-miss-events, <tag>     unit "events" (self-gate: 0)
+  soak-filler-fraction-pct, <tag>      unit "filler-pct" (r18,
+                                       lower-is-better): the dispatch
+                                       occupancy cost of deadline
+                                       flushes at the fixed rung
+                                       ladder — previously only
+                                       narrated (~31%); now the
+                                       tracked baseline the
+                                       auto-tuned-ladder work
+                                       (ROADMAP item 2a) measures
+                                       against
 
 With ``DSA_RUN_DIR`` set, the SLO summary (incl. the queue-depth
 trajectory) lands in ``slo.json`` and the alert events in
@@ -281,6 +291,11 @@ def main() -> int:
         # swarmlint: disable=metric-fstring -- tag is a mode literal; names are stable cross-round pins
         f"soak-deadline-miss-events, {tag}",
         float(slo["deadline_misses"]), "events", 0.0,
+    )
+    report(
+        # swarmlint: disable=metric-fstring -- tag is a mode literal; names are stable cross-round pins
+        f"soak-filler-fraction-pct, {tag}",
+        round(100.0 * slo["filler_fraction"], 2), "filler-pct", 0.0,
     )
 
     # --- run-dir deposit (swarmscope slo) ---------------------------

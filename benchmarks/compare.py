@@ -30,7 +30,11 @@ census, r15 — an extra per-tick collective in a lowered rollout is
 a count regression), "ms-p50"/"ms-p99" (the streaming serve loop's
 SLO latency percentiles, r16 — a tail-latency regression gates
 exactly like a byte-volume regression; the soak bench additionally
-self-gates p99 against its own declared absolute ceiling) are
+self-gates p99 against its own declared absolute ceiling),
+"filler-pct" (the soak's dispatch-occupancy filler fraction, r18 —
+the declared cost of deadline flushes at a fixed rung ladder; growth
+means the admission policy started padding more, the baseline the
+ROADMAP auto-tuned-ladder work is measured against) are
 lower-is-better and
 gate on growth (a clean 0 baseline regressing to any positive count
 always gates); unit "pct" (telemetry overhead, r10; multichip
@@ -177,7 +181,7 @@ def compare(prev_label: str, cur_label: str, threshold: float = 0.2,
         unit = str(cur[key][1].get("unit", ""))
         if unit in ("findings", "rounds", "events", "ticks",
                     "compiles", "bytes", "collectives",
-                    "ms-p50", "ms-p99"):
+                    "ms-p50", "ms-p99", "filler-pct"):
             # Lower-is-better count metrics (swarmlint hygiene debt;
             # auction convergence rounds, r8; flight-recorder
             # truncation/churn counts and recovery-latency ticks,
@@ -185,7 +189,8 @@ def compare(prev_label: str, cur_label: str, threshold: float = 0.2,
             # halo-exchange traffic bytes, r12; jaxlint's per-entry
             # scan-body collective census, r15 — one extra per-tick
             # collective costs T× a one-shot one; serve-SLO latency
-            # percentiles, r16): gate on growth,
+            # percentiles, r16; dispatch filler fraction, r18 — the
+            # soak's declared padding cost): gate on growth,
             # never on paydown.  A clean baseline (0) regressing to
             # any positive count always gates.
             status = "ok"

@@ -128,6 +128,12 @@ BENCHES = [
     # the identical tracing-off pass, and the traced pass asserts the
     # full >= 5-kind span taxonomy per request.
     "bench_trace_overhead.py",
+    # r18: 2D-mesh serving on the 8-vdev rig — scenario-axis sharded
+    # service throughput vs the same-run single-device row (self-
+    # gated >= 1.5x with bitwise per-tenant parity, exit 2), the
+    # sharded entry's compile budget, and the jumbo mix (one tenant
+    # through the spatial tick on the tiles axis, bitwise vs solo).
+    "bench_mesh2d.py",
 ]
 
 # Extra argv for benches whose no-arg default is not the gate set —
@@ -186,6 +192,10 @@ QUICK_SKIP = {
     # r17: three full streamed 60-request passes (warm + off + on)
     # compile the whole serve lattice — full gate only.
     "bench_trace_overhead.py",
+    # r18: six full 256-scenario service passes (warm + 2x timed per
+    # plane) plus the jumbo mix — minutes on the 2-core rig, full
+    # gate only.
+    "bench_mesh2d.py",
 }
 
 

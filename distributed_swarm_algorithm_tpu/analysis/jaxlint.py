@@ -109,11 +109,18 @@ SCAN_EXTRA_OPS = {"scan-dynamic-slice": "dynamic_slice"}
 #: Keys that are reported but never ceiling-gated: they are floors or
 #: structure facts, not hazards ("aliased-outputs" regressing DOWN is
 #: the hazard — the ``min-aliased-outputs`` budget covers that).
-INFO_KEYS = ("aliased-outputs", "while-loops")
+#: "donor-args" (r18): ``jax.buffer_donor`` parameter attrs — how a
+#: donated SHARDED arg shows up in the lowering (shard_map defers the
+#: input/output pairing to the compiler, so no ``tf.aliasing_output``
+#: appears; the bytes census' alias-bytes then proves the aliasing
+#: actually landed).
+INFO_KEYS = ("aliased-outputs", "donor-args", "while-loops")
 
-#: Budget key declaring a FLOOR on "aliased-outputs" (the donation
-#: audit's positive half: the r13 serve entry must keep actually
-#: aliasing its donated carry, not merely avoid the warning).
+#: Budget key declaring a FLOOR on the donation evidence —
+#: ``aliased-outputs + donor-args`` (the donation audit's positive
+#: half: the r13 serve entry must keep actually aliasing its donated
+#: carry, not merely avoid the warning; the r18 sharded entry's
+#: donation is donor-attr-shaped, see INFO_KEYS).
 MIN_ALIASED = "min-aliased-outputs"
 
 #: The bytes census (r17, the memory observatory): per-entry
@@ -172,6 +179,7 @@ _F64 = re.compile(r"(?<!b)f64\b")
 _CONVERT_F32_F64 = re.compile(r"convert.*f32.*->.*f64")
 _CONVERT_I64_F32 = re.compile(r"convert.*i64.*->.*f32")
 _ALIASED = re.compile(r"tf\.aliasing_output")
+_DONOR = re.compile(r"jax\.buffer_donor")
 
 
 def _brace_delta(line: str) -> int:
@@ -317,6 +325,7 @@ def census_of_text(
         1 for ln in text.splitlines() if _CONVERT_I64_F32.search(ln)
     )
     counts["aliased-outputs"] = len(_ALIASED.findall(text))
+    counts["donor-args"] = len(_DONOR.findall(text))
     counts["donated-not-aliased"] = sum(
         w.count("ShapedArray")
         for w in (lowering_warnings or [])
@@ -602,6 +611,52 @@ def _spec_serve_batched_rollout():
     return _batched_rollout_impl, (states, params, cfg, 6), {}
 
 
+@lint_entry(
+    "serve-batched-rollout-sharded", min_devices=8,
+    note="needs the 8-virtual-device rig (conftest XLA flag)",
+)
+def _spec_serve_batched_rollout_sharded():
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.mesh import SCENARIO_AXIS, make_serve_mesh
+    from ..serve.batched import (
+        _batched_rollout_sharded_impl,
+        _materialize_batch_impl,
+        scenario_params,
+        stack_params,
+    )
+
+    cfg = _serve_cfg()
+    S, cap = 8, 8
+    # Same ShapeDtypeStruct discipline as the unsharded serve spec:
+    # the donated states ride as avals, nothing executes.  The mesh
+    # is the genuine 2D (scenarios, tiles) serve mesh — the census
+    # must prove zero collectives on the REAL axis layout, tiles
+    # replication included.
+    states = jax.eval_shape(
+        functools.partial(
+            _materialize_batch_impl, capacity=cap, n_tasks=0
+        ),
+        jnp.zeros((S,), jnp.int32),
+        jnp.full((S,), 8.0, jnp.float32),
+        jnp.ones((S, cap), bool),
+        jnp.zeros((S,), bool),
+        jnp.zeros((S, 2), jnp.float32),
+        jnp.zeros((S, 0, 2), jnp.float32),
+    )
+    params = stack_params([scenario_params(cfg)] * S)
+    mesh = make_serve_mesh(
+        scenarios=4, tiles=2, devices=jax.devices()[:8]
+    )
+    return (
+        _batched_rollout_sharded_impl,
+        (states, params, cfg, 6, mesh, SCENARIO_AXIS), {},
+    )
+
+
 @lint_entry("env-rollout")
 def _spec_env_rollout():
     import jax
@@ -699,7 +754,10 @@ def audit_entry(name: str, memory: bool = True) -> EntryAudit:
 
         got = WATCH.memory_cached(
             fn, *args,
-            has_aliasing=counts.get("aliased-outputs", 0) > 0,
+            has_aliasing=(
+                counts.get("aliased-outputs", 0) > 0
+                or counts.get("donor-args", 0) > 0
+            ),
             **kwargs,
         )
         if "skipped" in got:
@@ -816,8 +874,12 @@ def budget_from_audit(
         k: v for k, v in audit.counts.items()
         if v and k not in INFO_KEYS
     }
-    if audit.counts.get("aliased-outputs"):
-        budgets[MIN_ALIASED] = audit.counts["aliased-outputs"]
+    evidence = (
+        audit.counts.get("aliased-outputs", 0)
+        + audit.counts.get("donor-args", 0)
+    )
+    if evidence:
+        budgets[MIN_ALIASED] = evidence
     # Bytes census (r17): nonzero measured bytes become ceilings too
     # (zero stays the default, so a footprint APPEARING where none
     # was declared fails until re-measured).  An audit that carried
@@ -940,16 +1002,19 @@ def check_against_budget(
             )
     floor = entry.budgets.get(MIN_ALIASED)
     if floor is not None:
-        got = audit.counts.get("aliased-outputs", 0)
+        got = (
+            audit.counts.get("aliased-outputs", 0)
+            + audit.counts.get("donor-args", 0)
+        )
         if got < floor:
             findings.append(
                 LintFinding(
                     entry=audit.entry, check=MIN_ALIASED,
                     measured=got, budget=floor,
                     message=(
-                        f"only {got} aliased output buffers, floor "
-                        f"{floor} — donation regressed to copies "
-                        "(the r13 double-buffer contract)"
+                        f"only {got} aliased/donor-marked buffers, "
+                        f"floor {floor} — donation regressed to "
+                        "copies (the r13 double-buffer contract)"
                     ),
                 )
             )

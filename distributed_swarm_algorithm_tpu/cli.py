@@ -812,6 +812,17 @@ def _cmd_scope_slo(args) -> int:
             f"overflows {s.get('queue_overflows', 0)}  "
             f"evictions {s.get('evictions', 0)}"
         )
+        # Per-rung occupancy (r18): one line per bucket rung with the
+        # mesh axis it rides — "scenarios x8" / "tiles x2" / "device"
+        # — so an operator can see WHICH axis a rung's filler cost
+        # lives on (the aggregate above averages jumbo's structural
+        # zero filler with the scenario rungs' padding).
+        for label, r in sorted((s.get("rungs") or {}).items()):
+            print(
+                f"    rung {label:<14} [{r.get('mesh', 'device')}]"
+                f"  dispatches {r.get('dispatches', 0):>4}  "
+                f"filler {100.0 * r.get('filler_fraction', 0.0):.1f}%"
+            )
         if "device_peak_bytes" in s:
             peak = s["device_peak_bytes"]
             if peak is None:

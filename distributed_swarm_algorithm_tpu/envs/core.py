@@ -262,14 +262,21 @@ class SwarmMARLEnv:
         )
 
     # -- observation --------------------------------------------------------
-    def obs(self, state: SwarmState) -> jax.Array:
+    def obs(self, state: SwarmState, derived=None) -> jax.Array:
         """[capacity, obs_dim] per-agent observation rows (dead agents
         read all-zero).  Read-only off the current state — collection
-        cannot perturb the trajectory."""
-        with jax.named_scope("env_obs"):
-            return self._obs_impl(state)
+        cannot perturb the trajectory.
 
-    def _obs_impl(self, state: SwarmState) -> jax.Array:
+        ``derived`` (r18): the tick's already-computed formation
+        ``(target, has_target)`` columns — ``step`` passes them when
+        it can prove they match what a re-derivation here would
+        produce (``formation_targets`` is position-independent, so
+        only the tag sweep's liveness flips can invalidate them);
+        ``None`` derives from ``state`` as before."""
+        with jax.named_scope("env_obs"):
+            return self._obs_impl(state, derived)
+
+    def _obs_impl(self, state: SwarmState, derived=None) -> jax.Array:
         n = self.capacity
         pos, vel, alive = state.pos, state.vel, state.alive
         falive = alive.astype(jnp.float32)
@@ -279,14 +286,17 @@ class SwarmMARLEnv:
         # Leader block: offset to the last-heard leader pose and the
         # formation slot error (the derived target the APF attraction
         # actually steers toward this tick).
-        derived = formation_targets(state, self.cfg)
+        if derived is None:
+            d = formation_targets(state, self.cfg)
+            derived = (d.target, d.has_target)
+        d_target, d_has = derived
         has_lead = state.has_leader_pos & alive
         lead_rel = jnp.where(
             has_lead[:, None], state.leader_pos - pos, 0.0
         )
         slot_err = jnp.where(
-            (derived.has_target & alive)[:, None],
-            derived.target - pos, 0.0,
+            (d_has & alive)[:, None],
+            d_target - pos, 0.0,
         )
         leader = jnp.concatenate(
             [lead_rel, has_lead.astype(jnp.float32)[:, None], slot_err],
@@ -393,11 +403,28 @@ class SwarmMARLEnv:
         a = a * jnp.minimum(1.0, lim / jnp.maximum(norm, 1e-9))
 
         obstacles = p.obstacles if self.n_obstacles else None
-        swarm, telem = swarm_tick_dyn(
-            prev, obstacles, self.cfg, params=p.scenario,
-            extra_force=a,
-        )
-        if self.enable_tagging:
+        # r18 (ROADMAP item 4 speed note): without the tag sweep the
+        # tick's formation derivation is provably the one the obs
+        # pass would redo — formation_targets reads only leader/rank/
+        # liveness fields, which physics never writes — so the tick
+        # hands its ephemeral derived columns over and obs skips the
+        # second derivation.  The tag sweep (static enable_tagging)
+        # CAN flip liveness (killed evaders shift every higher-id
+        # agent's formation rank), so tagging envs keep the post-tag
+        # re-derivation — bitwise the pre-r18 path either way
+        # (pinned in tests/test_envs.py).
+        reuse_derived = not self.enable_tagging
+        if reuse_derived:
+            swarm, telem, derived = swarm_tick_dyn(
+                prev, obstacles, self.cfg, params=p.scenario,
+                extra_force=a, return_derived=True,
+            )
+        else:
+            swarm, telem = swarm_tick_dyn(
+                prev, obstacles, self.cfg, params=p.scenario,
+                extra_force=a,
+            )
+            derived = None
             swarm = _pursuit_tag(swarm, p)
 
         from .scenarios import reward_switch
@@ -414,11 +441,19 @@ class SwarmMARLEnv:
                 lambda r, s: jnp.where(done, r, s), fresh, swarm
             )
             t_next = jnp.where(done, 0, t_next)
+            if derived is not None:
+                # A fresh state has no leader, so its derivation is
+                # the identity on (target, has_target) — the reset
+                # branch's derived columns come for free.
+                derived = (
+                    jnp.where(done, fresh.target, derived[0]),
+                    jnp.where(done, fresh.has_target, derived[1]),
+                )
         new_state = EnvState(swarm=swarm, t=t_next, params=p)
         info = {"done": done}
         if self.cfg.telemetry.enabled:
             info["telemetry"] = telem
-        return self.obs(swarm), new_state, rewards, dones, info
+        return self.obs(swarm, derived), new_state, rewards, dones, info
 
     def replace(self, **kw) -> "SwarmMARLEnv":
         return dataclasses.replace(self, **kw)

@@ -204,6 +204,7 @@ def swarm_tick_dyn(
     cfg: SwarmConfig,
     params=None,
     extra_force=None,
+    return_derived: bool = False,
 ):
     """One protocol tick with DYNAMIC per-scenario parameters (r13) —
     the scenario-batching substrate.
@@ -232,11 +233,24 @@ def swarm_tick_dyn(
     Plain (un-jitted): callers own the jit/vmap/scan composition.
     Returns ``(state, telemetry-or-None)`` — telemetry gated on
     ``cfg.telemetry.enabled`` (the r10 static gate).
+
+    ``return_derived`` (r18): additionally hand back the tick's
+    ephemeral formation-derived ``(target, has_target)`` columns —
+    the env facade reuses them for its observation pass instead of
+    re-deriving per step (``ops/physics._physics_step_core``;
+    the values are position-independent, so post-physics they are
+    the columns a re-derivation would compute, bitwise).
     """
     state = _protocol_steps(state, cfg, sort_in_tick=False,
                             params=params)
     from ..ops.physics import _physics_step_core
 
+    if return_derived:
+        out, _, telem, derived = _physics_step_core(
+            state, obstacles, cfg, None, None, params=params,
+            extra_force=extra_force, return_derived=True,
+        )
+        return out, telem, derived
     out, _, telem = _physics_step_core(
         state, obstacles, cfg, None, None, params=params,
         extra_force=extra_force,
@@ -445,6 +459,7 @@ def _swarm_rollout_spatial_impl(
     record: bool = False,
     return_plan: bool = False,
     telemetry: bool = False,
+    carry=None,
 ):
     """``n_steps`` spatially-sharded ticks under one ``lax.scan`` —
     the mesh-native rollout (r12, ROADMAP item 1).  ``state`` must be
@@ -460,11 +475,23 @@ def _swarm_rollout_spatial_impl(
     stacked recorder ys (residency counters filled from real per-tile
     live counts), ``return_plan`` appends the final
     ``SpatialCarry`` — its per-tile ``plan.rebuilds``/``escapes``/
-    ``halo_overflow`` are the sharded-tick observability surface."""
+    ``halo_overflow`` are the sharded-tick observability surface.
+
+    ``carry`` (r18, the jumbo serve rung): an existing
+    :class:`~..parallel.spatial.SpatialCarry` to resume from instead
+    of seeding a fresh one — k carry-threaded segments are then the
+    SAME tick sequence as one k*seg-tick rollout (no re-seed, no
+    trigger reset), which is what makes the streaming service's
+    segmented jumbo rollouts bitwise-equal to the one-shot spatial
+    rollout (pinned in tests/test_serve_2d.py).  Pair it with
+    ``return_plan=True`` to get the advanced carry back out."""
     telem_on = telemetry or cfg.telemetry.enabled
     if telem_on and not cfg.telemetry.enabled:
         cfg = cfg.replace(telemetry=TELEMETRY_ON)
-    carry0 = build_tick_plan_spatial(state, cfg, spatial, mesh)
+    carry0 = (
+        build_tick_plan_spatial(state, cfg, spatial, mesh)
+        if carry is None else carry
+    )
 
     def body(carry, _):
         s, c = carry
@@ -499,6 +526,7 @@ def swarm_rollout(
     telemetry: bool = False,
     mesh=None,
     spatial=None,
+    carry=None,
 ) -> Union[SwarmState, Tuple[SwarmState, jax.Array]]:
     """``n_steps`` ticks under one ``lax.scan`` — ``_swarm_rollout_impl``
     behind the eager multi-device hash-grid guard (see
@@ -516,7 +544,9 @@ def swarm_rollout(
     exchange at strip boundaries (``parallel/spatial.py``; ``state``
     must come from ``spatial_shard_swarm``, which also returns the
     ``spatial`` spec).  ``return_plan`` then appends the final
-    ``SpatialCarry`` instead of a single plan."""
+    ``SpatialCarry`` instead of a single plan; ``carry`` (r18) resumes
+    from an existing ``SpatialCarry`` — the segmented-serving hook
+    (see ``_swarm_rollout_spatial_impl``)."""
     if mesh is not None:
         if spatial is None:
             raise ValueError(
@@ -526,7 +556,13 @@ def swarm_rollout(
             )
         return _swarm_rollout_spatial_impl(
             state, obstacles, cfg, n_steps, mesh, spatial,
-            record, return_plan, telemetry,
+            record, return_plan, telemetry, carry,
+        )
+    if carry is not None:
+        raise ValueError(
+            "swarm_rollout(carry=...) resumes a SpatialCarry and only "
+            "makes sense with mesh=/spatial= (the spatially-sharded "
+            "rollout); the single-device plan carry is internal"
         )
     if spatial is not None:
         # The inverse half-call must not silently run the

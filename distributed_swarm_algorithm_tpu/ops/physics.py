@@ -673,6 +673,7 @@ def _physics_step_core(
     dt: Optional[float],
     params=None,
     extra_force=None,
+    return_derived: bool = False,
 ):
     """The one tick body behind :func:`physics_step`,
     :func:`physics_step_telem`, and :func:`physics_step_plan` —
@@ -696,7 +697,16 @@ def _physics_step_core(
     force injected between the APF sum and :func:`integrate` — the
     per-agent RL action of the MARL env facade.  ``None`` keeps the
     pre-r14 graph; a zero array reproduces the pure-protocol
-    trajectory BITWISE (see the select below)."""
+    trajectory BITWISE (see the select below).
+
+    ``return_derived`` (r18, ROADMAP item 4's speed note): appends
+    the ephemeral formation-derived ``(target, has_target)`` columns
+    to the return so the env's observation pass can reuse them
+    instead of re-deriving — :func:`formation_targets` reads only
+    leader/rank/liveness fields the physics half never writes, so the
+    post-physics re-derivation it replaces was computing the
+    identical values.  Default False keeps every existing caller's
+    return arity."""
     dt = cfg.dt if dt is None else dt
     if plan is not None:
         from .hashgrid_plan import refresh_plan
@@ -737,6 +747,8 @@ def _physics_step_core(
         from ..utils.telemetry import swarm_tick_telemetry
 
         telem = swarm_tick_telemetry(out, force, plan=tick_plan)
+    if return_derived:
+        return out, plan, telem, (derived.target, derived.has_target)
     return out, plan, telem
 
 

@@ -20,6 +20,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 AGENT_AXIS = "agents"
 ISLAND_AXIS = "islands"
 
+#: The serve plane's 2D mesh axes (r18): small tenants shard their
+#: scenario batch over ``scenarios``; jumbo tenants domain-decompose
+#: over ``tiles`` (the r12 spatial tick's axis).  One mesh, both
+#: workload shapes — see serve/buckets.BucketSpec.mesh_axes_for.
+SCENARIO_AXIS = "scenarios"
+TILE_AXIS = "tiles"
+
 
 def make_mesh(
     axis_names: Sequence[str] = (AGENT_AXIS,),
@@ -35,6 +42,48 @@ def make_mesh(
     if shape is None:
         shape = (devices.size,) + (1,) * (len(axis_names) - 1)
     return Mesh(devices.reshape(shape), axis_names)
+
+
+def make_serve_mesh(
+    scenarios: Optional[int] = None,
+    tiles: int = 1,
+    devices=None,
+) -> Mesh:
+    """The serving slice as a ``(scenarios, tiles)`` 2D mesh (r18,
+    ROADMAP item 1): scenario-axis rungs shard their batch over
+    ``scenarios`` (each scenario wholly on one device — embarrassingly
+    parallel, zero per-tick collectives), and jumbo rungs run the r12
+    spatial tick over ``tiles`` (collective-permute halo ring).  With
+    both axes > 1, a dispatch on one axis is REPLICATED over the
+    other — the whole slice serves either workload shape at any
+    moment, which is the point; re-homing a rung onto a sub-rectangle
+    is ROADMAP follow-up work.
+
+    Default: every device on the scenario axis (``tiles=1`` — the
+    pure scenario-serving layout; a 1-tile spatial axis is the
+    single-device tick).  ``scenarios * tiles`` must cover the device
+    list exactly.
+    """
+    devices = np.asarray(
+        devices if devices is not None else jax.devices()
+    )
+    if tiles <= 0:
+        raise ValueError(f"tiles must be >= 1, got {tiles}")
+    if scenarios is None:
+        if devices.size % tiles:
+            raise ValueError(
+                f"{devices.size} devices do not split into "
+                f"tiles={tiles} columns; pass scenarios= explicitly"
+            )
+        scenarios = devices.size // tiles
+    if scenarios * tiles != devices.size:
+        raise ValueError(
+            f"mesh shape ({scenarios}, {tiles}) needs "
+            f"{scenarios * tiles} devices, have {devices.size}"
+        )
+    return Mesh(
+        devices.reshape(scenarios, tiles), (SCENARIO_AXIS, TILE_AXIS)
+    )
 
 
 def agent_sharding(mesh: Mesh, axis: str = AGENT_AXIS) -> NamedSharding:

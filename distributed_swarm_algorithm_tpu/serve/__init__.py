@@ -1,41 +1,60 @@
 """Multi-tenant rollout serving (r13) + the streaming serve loop
-(r16): scenario-batched swarm rollouts with bucketed compiled shapes,
-an async double-buffered submit/collect loop, and a continuous-
-batching streaming service with an SLO observatory.  See
-serve/batched.py (the vmapped tick + per-scenario params),
-serve/buckets.py (the shape lattice), serve/service.py (the host
-loops), serve/queue.py (deadline-coalescing admission), and
-serve/slo.py (latency percentiles, gauges, alert events)."""
+(r16) + 2D-mesh dispatch (r18): scenario-batched swarm rollouts with
+bucketed compiled shapes, an async double-buffered submit/collect
+loop, a continuous-batching streaming service with an SLO
+observatory, and a ``(scenarios, tiles)`` mesh plane — scenario
+rungs shard_map-committed ``P('scenarios')`` (zero per-tick
+collectives), jumbo rungs through the r12 spatial tick on the tiles
+axis, one ``StreamingService`` front door.  See serve/batched.py
+(the vmapped tick + per-scenario params + the sharded twin),
+serve/buckets.py (the shape lattice + per-rung mesh axes),
+serve/service.py (the host loops), serve/queue.py
+(deadline-coalescing admission), and serve/slo.py (latency
+percentiles, gauges, per-rung occupancy, alert events)."""
 
+from ..parallel.mesh import make_serve_mesh
 from .batched import (
     MATERIALIZE_ENTRY,
     PARAM_FIELDS,
     SERVE_ENTRY,
+    SERVE_SHARDED_ENTRY,
     EnvRolloutResult,
     ScenarioParams,
     ScenarioRequest,
     bake_params,
     batched_rollout,
+    batched_rollout_sharded,
     env_rollouts,
     materialize_batch,
     materialize_scenario,
     scenario_params,
+    shard_scenarios,
     stack_params,
     stack_scenarios,
     tenant_state,
     validate_request,
     validate_serve_config,
 )
-from .buckets import BucketSpec
+from .buckets import SCENARIO_AXES, TILE_AXES, BucketSpec
 from .queue import AdmissionQueue, QueueOverflowError
-from .service import RolloutService, StreamingService, TenantResult
+from .service import (
+    JUMBO_ENTRY,
+    RolloutService,
+    StreamingService,
+    TenantResult,
+    unshard_spatial_state,
+)
 from .slo import DEFAULT_DEADLINE_S, SloTracker
 
 __all__ = [
     "DEFAULT_DEADLINE_S",
+    "JUMBO_ENTRY",
     "MATERIALIZE_ENTRY",
     "PARAM_FIELDS",
+    "SCENARIO_AXES",
     "SERVE_ENTRY",
+    "SERVE_SHARDED_ENTRY",
+    "TILE_AXES",
     "AdmissionQueue",
     "BucketSpec",
     "EnvRolloutResult",
@@ -48,13 +67,17 @@ __all__ = [
     "TenantResult",
     "bake_params",
     "batched_rollout",
+    "batched_rollout_sharded",
     "env_rollouts",
+    "make_serve_mesh",
     "materialize_batch",
     "materialize_scenario",
     "scenario_params",
+    "shard_scenarios",
     "stack_params",
     "stack_scenarios",
     "tenant_state",
+    "unshard_spatial_state",
     "validate_request",
     "validate_serve_config",
 ]

@@ -64,10 +64,16 @@ from ..state import (
 from ..utils.compile_watch import watched
 from ..utils.config import TELEMETRY_ON, SwarmConfig
 
-#: Compile-observatory registry names of the serve plane's two jitted
+#: Compile-observatory registry names of the serve plane's jitted
 #: entries — the names the service declares its bucket budgets under.
 SERVE_ENTRY = "serve-batched-rollout"
 MATERIALIZE_ENTRY = "serve-materialize"
+#: The scenario-axis sharded twin (r18): the same vmapped scan, its
+#: scenario batch shard_map-committed P('scenarios') so S tenants run
+#: S/n_devices per device.  A separate registry entry because it is a
+#: separate contract: jaxlint budgets pin ZERO per-tick collectives
+#: here (per-scenario state never crosses the axis).
+SERVE_SHARDED_ENTRY = "serve-batched-rollout-sharded"
 
 #: Separation modes the batched tick supports.  Dense is exact at the
 #: service's small-swarm scale and vmaps to one fused pair sweep;
@@ -458,6 +464,153 @@ def batched_rollout(
     validate_serve_config(cfg)
     return _batched_rollout_impl(
         states, params, cfg, n_steps, record, telemetry
+    )
+
+
+# ---------------------------------------------------------------------------
+# Scenario-axis sharded rollout (r18): the 2D-mesh serve plane's
+# small-tenant half.  The batched tick is embarrassingly parallel over
+# scenarios — vmap rows never read each other — so sharding the
+# leading axis over a mesh costs ZERO per-tick collectives.  The body
+# is shard_map (not bare GSPMD) deliberately: jaxlint's census reads
+# the LOWERED program, and only explicit shard_map partitioning makes
+# "zero all-gathers on the scenario axis" a checkable contract instead
+# of a hope about the SPMD partitioner (analysis/jaxlint.py module
+# doc).  Bitwise contract: a vmap row's arithmetic is independent of
+# its batch neighbors, so the S/n-per-device blocks compute exactly
+# the rows the single-device batch computes — scenario i of the
+# sharded rollout equals scenario i of the unsharded one BITWISE
+# (pinned in tests/test_serve_2d.py).
+
+
+def scenario_sharding(mesh, axis: str = None):
+    """The serve plane's scenario-batch placement: dim 0 of every
+    ``[S, ...]`` leaf split over ``axis`` of ``mesh`` (the one
+    dim-0-over-an-axis helper, serve-axis default)."""
+    from ..parallel.mesh import SCENARIO_AXIS, agent_sharding
+
+    return agent_sharding(mesh, axis or SCENARIO_AXIS)
+
+
+def shard_scenarios(tree, mesh, axis: str = None):
+    """Commit a materialized ``[S, ...]``-leaved batch (states AND/OR
+    params) over the mesh's scenario axis — done BEFORE the first
+    launch so the donated carry keeps the sharding across every
+    segment rotation (donation preserves placement)."""
+    sh = scenario_sharding(mesh, axis)
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sh), tree
+    )
+
+
+@watched(SERVE_SHARDED_ENTRY)
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "n_steps", "mesh", "axis", "record",
+                     "telemetry"),
+    donate_argnums=(0,),
+)
+def _batched_rollout_sharded_impl(
+    states: SwarmState,
+    params: ScenarioParams,
+    cfg: SwarmConfig,
+    n_steps: int,
+    mesh,
+    axis: str,
+    record: bool = False,
+    telemetry: bool = False,
+):
+    """``n_steps`` vmapped ticks under one ``lax.scan``, the scenario
+    axis shard_map-split over ``mesh[axis]`` — each device scans its
+    own ``S/n`` block, no cross-device data motion anywhere (the
+    whole point; budget-pinned by jaxlint).  Same donation and result
+    composition as :func:`_batched_rollout_impl`."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..utils.compat import shard_map
+
+    telem_on = telemetry or cfg.telemetry.enabled
+    if telem_on and not cfg.telemetry.enabled:
+        cfg = cfg.replace(telemetry=TELEMETRY_ON)
+
+    sp = P(axis)
+    ys = P(None, axis)        # stacked [T, S]-class leaves
+    out_specs: tuple = (sp, ys) if record else sp
+    if telem_on:
+        out_specs = (
+            out_specs + (ys,) if record else (out_specs, ys)
+        )
+
+    @partial(
+        shard_map, mesh=mesh, in_specs=(sp, sp),
+        out_specs=out_specs, check_vma=False,
+    )
+    def block(ss, pp):
+        vtick = jax.vmap(
+            lambda s, p: swarm_tick_dyn(s, None, cfg, p)
+        )
+
+        def body(ss, _):
+            ss, telem = vtick(ss, pp)
+            frame = ss.pos if record else None
+            return ss, (frame, telem)
+
+        ss, (traj, telem) = jax.lax.scan(
+            body, ss, None, length=n_steps
+        )
+        out = (ss, traj) if record else ss
+        if telem_on:
+            out = out + (telem,) if record else (out, telem)
+        return out
+
+    out = block(states, params)
+    if telem_on and not n_steps:
+        # Mirror the unsharded entry: a zero-length rollout yields
+        # telem = None, never a [0]-leaved record.
+        out = out[:-1] + (None,) if record else (out[0], None)
+    return out
+
+
+def batched_rollout_sharded(
+    states: SwarmState,
+    params: ScenarioParams,
+    cfg: SwarmConfig,
+    n_steps: int,
+    mesh,
+    axis: str = None,
+    record: bool = False,
+    telemetry: bool = False,
+):
+    """Public entry for the scenario-axis sharded rollout (see
+    :func:`_batched_rollout_sharded_impl`).  ``states``/``params``
+    must carry a leading scenario axis divisible by the mesh's
+    scenario-axis size (shard_map splits it into equal blocks; the
+    bucket lattice guarantees this by sizing sharded rungs as
+    multiples of the axis), committed via :func:`shard_scenarios`;
+    ``states`` is DONATED.  ``params`` is required — the sharded path
+    exists for the heterogeneous serving workload, and a None-params
+    twin would double the compiled-shape lattice for no caller."""
+    from ..parallel.mesh import SCENARIO_AXIS
+
+    axis = axis or SCENARIO_AXIS
+    validate_serve_config(cfg)
+    if params is None:
+        raise ValueError(
+            "batched_rollout_sharded needs params (the serve "
+            "materializer always builds them); the params=None graph "
+            "is the single-device batched_rollout's"
+        )
+    n_shards = int(mesh.shape[axis])
+    s = states.pos.shape[0]
+    if s % n_shards:
+        raise ValueError(
+            f"scenario batch {s} does not split over the "
+            f"{n_shards}-way {axis!r} mesh axis; pad the dispatch to "
+            "a rung sized a multiple of the axis (the service's "
+            "sharded rungs are validated to be)"
+        )
+    return _batched_rollout_sharded_impl(
+        states, params, cfg, n_steps, mesh, axis, record, telemetry
     )
 
 

@@ -24,6 +24,27 @@ len(batches)`` compiled entries — a budget it declares to the
 compile observatory (``utils/compile_watch.declare_buckets``), which
 turns any excess compile into a structured ``bucket-overflow`` event
 instead of a silent 2x latency bill.
+
+**Mesh axes per rung (r18, the 2D-mesh serve plane).**  Every rung
+additionally declares WHICH mesh axis its dispatches ride
+(:meth:`BucketSpec.mesh_axes_for`):
+
+- the ``capacities`` rungs are **scenario-axis** rungs
+  (``('scenarios',)``): the vmapped batched tick, its scenario batch
+  shard_map-committed ``P('scenarios')`` — embarrassingly parallel,
+  per-scenario state never crosses the axis (jaxlint budget: zero
+  per-tick collectives);
+- the ``jumbo_capacities`` rungs are **tiles-axis** rungs
+  (``('tiles',)``): ONE tenant per dispatch (the batch axis is
+  meaningless for a swarm that spans the mesh), routed through the
+  r12 spatially-sharded tick (``parallel/spatial.py`` — ring
+  collective-permute halo exchange, all-gather-zero contract).
+
+Jumbo rungs sit strictly ABOVE the largest scenario capacity — they
+are where the scenario lattice's rejection bound used to be, so a
+tenant too big to vmap is now served instead of refused.  The
+admission queue keys on the axes tuple, so a jumbo group can never
+co-batch (or head-of-line-block) a scenario group.
 """
 
 from __future__ import annotations
@@ -31,19 +52,28 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import List, Tuple
 
+from ..parallel.mesh import SCENARIO_AXIS, TILE_AXIS
+
 #: Default lattice: three capacity rungs x three batch rungs = nine
 #: compiled shapes at most — "a handful of cache entries".
 DEFAULT_CAPACITIES = (64, 256, 1024)
 DEFAULT_BATCHES = (1, 8, 64)
 
+#: The per-rung mesh-axes declarations (module doc).
+SCENARIO_AXES: Tuple[str, ...] = (SCENARIO_AXIS,)
+TILE_AXES: Tuple[str, ...] = (TILE_AXIS,)
+
 
 @dataclass(frozen=True)
 class BucketSpec:
     """The service's compiled-shape lattice (immutable; the compile
-    budget is ``max_shapes``)."""
+    budget is ``max_shapes``).  ``jumbo_capacities`` (r18) are the
+    tiles-axis rungs — strictly above the largest scenario capacity,
+    one tenant per dispatch, served by the r12 spatial tick."""
 
     capacities: Tuple[int, ...] = DEFAULT_CAPACITIES
     batches: Tuple[int, ...] = DEFAULT_BATCHES
+    jumbo_capacities: Tuple[int, ...] = ()
 
     def __post_init__(self):
         for name, rungs in (
@@ -60,36 +90,74 @@ class BucketSpec:
                     f"BucketSpec.{name} must be strictly ascending "
                     f"(the quantizers binary-search them), got {rungs}"
                 )
+        j = self.jumbo_capacities
+        if j:
+            if tuple(sorted(set(j))) != tuple(j):
+                raise ValueError(
+                    "BucketSpec.jumbo_capacities must be strictly "
+                    f"ascending, got {j}"
+                )
+            if j[0] <= self.capacities[-1]:
+                raise ValueError(
+                    f"jumbo rungs must sit ABOVE the largest scenario "
+                    f"capacity {self.capacities[-1]} (they replace its "
+                    f"rejection bound), got {j} — a tenant that fits a "
+                    "scenario rung must ride the scenario axis"
+                )
 
     @property
     def max_shapes(self) -> int:
         """The compile-cache budget: distinct (batch, capacity) shapes
-        the service can ever dispatch."""
-        return len(self.capacities) * len(self.batches)
+        the service can ever dispatch.  Jumbo rungs are batch-of-1 by
+        construction, so each adds exactly one shape."""
+        return (
+            len(self.capacities) * len(self.batches)
+            + len(self.jumbo_capacities)
+        )
+
+    def is_jumbo(self, capacity: int) -> bool:
+        return capacity in self.jumbo_capacities
+
+    def mesh_axes_for(self, capacity: int) -> Tuple[str, ...]:
+        """The declared mesh axes of ``capacity``'s rung — the thing
+        the admission queue keys on and ``swarmscope slo`` renders
+        next to each rung's occupancy (module doc)."""
+        return TILE_AXES if self.is_jumbo(capacity) else SCENARIO_AXES
+
+    def batches_for(self, capacity: int) -> Tuple[int, ...]:
+        """The batch rungs available at ``capacity``: the declared
+        lattice for scenario rungs, exactly ``(1,)`` for jumbo rungs
+        (one mesh-spanning tenant per dispatch)."""
+        return (1,) if self.is_jumbo(capacity) else self.batches
 
     def capacity_for(self, n_agents: int) -> int:
         """Smallest capacity rung holding ``n_agents`` — the agent-axis
-        quantizer.  Raises for requests past the largest rung (the
-        REJECTION half of the padding/eviction contract: an unservable
-        shape must fail loudly at submit time, not compile a bespoke
-        program)."""
+        quantizer (scenario rungs first, then jumbo).  Raises for
+        requests past the largest rung (the REJECTION half of the
+        padding/eviction contract: an unservable shape must fail
+        loudly at submit time, not compile a bespoke program)."""
         if n_agents <= 0:
             raise ValueError(
                 f"scenario needs n_agents >= 1, got {n_agents}"
             )
-        for cap in self.capacities:
+        for cap in self.capacities + self.jumbo_capacities:
             if n_agents <= cap:
                 return cap
+        largest = (self.jumbo_capacities or self.capacities)[-1]
         raise ValueError(
             f"scenario with {n_agents} agents exceeds the largest "
-            f"capacity bucket {self.capacities[-1]}; widen "
-            "BucketSpec.capacities (each rung is one compiled shape)"
+            f"capacity bucket {largest}; widen BucketSpec."
+            "capacities/jumbo_capacities (each rung is one compiled "
+            "shape)"
         )
 
-    def split_batch(self, k: int) -> List[int]:
+    def split_batch(self, k: int, capacity: int = None) -> List[int]:
         """Dispatch batch sizes covering ``k`` pending scenarios, every
         size a ``batches`` rung (sum >= k; the excess of the final
-        dispatch is padded with dead filler scenarios).
+        dispatch is padded with dead filler scenarios).  ``capacity``
+        (r18) selects the rung family: a jumbo capacity's only rung is
+        1, so ``k`` jumbo tenants split into ``k`` one-tenant
+        dispatches — zero filler, ever.
 
         Deterministic greedy with a BOUNDED-PAD tail: take the
         largest rung while it fits whole; for each remainder ``r``,
@@ -105,18 +173,22 @@ class BucketSpec:
         """
         if k <= 0:
             return []
+        rungs = (
+            self.batches_for(capacity)
+            if capacity is not None else self.batches
+        )
         out: List[int] = []
-        largest = self.batches[-1]
+        largest = rungs[-1]
         while k >= largest:
             out.append(largest)
             k -= largest
         while k > 0:
-            up = [b for b in self.batches if k <= b <= 2 * k]
+            up = [b for b in rungs if k <= b <= 2 * k]
             if up:
                 out.append(up[0])
                 break
-            fit = [b for b in self.batches if b <= k]
-            rung = fit[-1] if fit else self.batches[0]
+            fit = [b for b in rungs if b <= k]
+            rung = fit[-1] if fit else rungs[0]
             out.append(rung)
             k -= rung
         return out

@@ -50,6 +50,16 @@ class QueuedRequest:
     submit_t: float
     deadline_t: float
 
+    @property
+    def key(self) -> tuple:
+        """The compiled-shape group key.  The rung's declared mesh
+        axes (r18) are derived from the capacity by the spec, so the
+        (capacity, n_tasks) pair remains the full key — a jumbo
+        capacity IS a different capacity, hence a different group, and
+        jumbo groups can never co-batch or head-of-line-block a
+        scenario group."""
+        return (self.capacity, self.n_tasks)
+
 
 class AdmissionQueue:
     """FIFO groups keyed by compiled shape, released by rung-full or
@@ -89,7 +99,7 @@ class AdmissionQueue:
             rid=rid, req=req, capacity=capacity, n_tasks=n_tasks,
             submit_t=now, deadline_t=now + self.deadline_s,
         )
-        self._groups.setdefault((capacity, n_tasks), []).append(entry)
+        self._groups.setdefault(entry.key, []).append(entry)
         return entry
 
     def remove(self, rid: int) -> bool:
@@ -132,17 +142,25 @@ class AdmissionQueue:
         deadline-expired (or ``force``-flushed) groups release
         entirely via ``split_batch`` (bounded-pad tail).  FIFO within
         a group is preserved — admission order is dispatch order, so
-        latency accounting is honest per tenant."""
+        latency accounting is honest per tenant.  Rung families are
+        PER CAPACITY (r18): a jumbo group's only rung is 1, so a
+        jumbo tenant releases the pump cycle it arrives — its
+        mesh-spanning dispatch never waits on coalescing, and the
+        scenario groups keep coalescing independently (no cross-rung
+        head-of-line blocking, pinned in tests/test_serve_2d.py)."""
         now = self.clock() if now is None else now
-        largest = self.spec.batches[-1]
         out: List[Tuple[tuple, List[QueuedRequest], int]] = []
         for key in sorted(self._groups):
             group = self._groups[key]
+            capacity = key[0]
+            largest = self.spec.batches_for(capacity)[-1]
             while len(group) >= largest:
                 out.append((key, group[:largest], largest))
                 del group[:largest]
             if group and (force or now >= group[0].deadline_t):
-                for size in self.spec.split_batch(len(group)):
+                for size in self.spec.split_batch(
+                    len(group), capacity
+                ):
                     take = group[: min(size, len(group))]
                     del group[: len(take)]
                     out.append((key, take, size))
@@ -160,7 +178,7 @@ class AdmissionQueue:
         if not group:
             return []
         out: List[Tuple[tuple, List[QueuedRequest], int]] = []
-        for size in self.spec.split_batch(len(group)):
+        for size in self.spec.split_batch(len(group), key[0]):
             take = group[: min(size, len(group))]
             del group[: len(take)]
             out.append((key, take, size))

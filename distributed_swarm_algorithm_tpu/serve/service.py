@@ -85,9 +85,13 @@ from ..utils.telemetry import (
 from .batched import (
     MATERIALIZE_ENTRY,
     SERVE_ENTRY,
+    SERVE_SHARDED_ENTRY,
     ScenarioRequest,
     batched_rollout,
+    batched_rollout_sharded,
     materialize_batch,
+    materialize_scenario,
+    shard_scenarios,
     tenant_state,
     validate_request,
     validate_serve_config,
@@ -95,6 +99,38 @@ from .batched import (
 from .buckets import BucketSpec
 from .queue import AdmissionQueue, QueueOverflowError
 from .slo import DEFAULT_DEADLINE_S, SloTracker
+
+#: Compile-observatory entry the jumbo rung's dispatches land under —
+#: the r12 spatial rollout IS the jumbo program (its collective
+#: contract is already budgeted; the service only declares the bucket
+#: count for its segment schedule).
+JUMBO_ENTRY = "swarm-rollout-spatial"
+
+
+def unshard_spatial_state(state: SwarmState, n: int) -> SwarmState:
+    """A host-numpy tiled state (``spatial_shard_swarm`` slot layout)
+    back in AGENT-ID order, trimmed to the first ``n`` ids — the lens
+    a jumbo tenant's result is returned through, so its state compares
+    field-for-field against the solo single-device rollout of the same
+    materialized scenario (the r12 parity discipline).  Per-agent
+    columns travel with their row; the ``alive_below`` ordinal cache
+    is layout-local and is recounted for the restored order."""
+    from ..state import AGENT_AXIS_FIELDS
+
+    aid = np.asarray(state.agent_id)
+    slot_of = np.empty(aid.shape[0], np.int64)
+    slot_of[aid] = np.arange(aid.shape[0])
+    take = slot_of[:n]
+    updates = {
+        f: np.asarray(getattr(state, f))[take]
+        for f in AGENT_AXIS_FIELDS
+    }
+    aint = updates["alive"].astype(np.int32)
+    # dtype pinned: numpy's cumsum silently widens sub-platform ints
+    # to int64, and an i64 leaf in a returned SwarmState is a bespoke
+    # retrace for any jitted consumer (the dtype contract is [N] i32).
+    updates["alive_below"] = np.cumsum(aint, dtype=np.int32) - aint
+    return state.replace(**updates)
 
 
 @dataclass
@@ -194,6 +230,18 @@ class RolloutService:
     ):
         self.cfg = validate_serve_config(cfg or DEFAULT_CONFIG)
         self.spec = spec or BucketSpec()
+        if self.spec.jumbo_capacities:
+            # Without this, capacity_for would hand a jumbo rung to
+            # the one-shot flush path, which co-batches by the
+            # SCENARIO rungs and dispatches a mesh-scale tenant
+            # through the single-device vmapped program — a bespoke
+            # minutes-long compile (or OOM) where the r13 contract
+            # promises a loud submit-time rejection.
+            raise ValueError(
+                "RolloutService has no tiles-axis dispatch plane; "
+                f"jumbo rungs {self.spec.jumbo_capacities} need the "
+                "StreamingService (mesh= + jumbo_cfg=)"
+            )
         if n_steps <= 0:
             raise ValueError(f"n_steps must be >= 1, got {n_steps}")
         self.n_steps = int(n_steps)
@@ -400,6 +448,21 @@ class RolloutService:
 # Streaming service (r16): continuous batching + the SLO observatory.
 
 
+def _swarm_rollout_spatial(tiled, cfg, n_steps, mesh, spatial,
+                           telemetry, carry):
+    """One jumbo segment: the r12 spatial rollout with the
+    :class:`~..parallel.spatial.SpatialCarry` threaded through
+    (``carry=None`` seeds segment 1 exactly like the one-shot rollout;
+    ``return_plan=True`` hands the advanced carry back) — so k
+    segments are the identical tick sequence as one k*seg scan."""
+    from ..models.swarm import swarm_rollout
+
+    return swarm_rollout(
+        tiled, None, cfg, n_steps, telemetry=telemetry,
+        return_plan=True, mesh=mesh, spatial=spatial, carry=carry,
+    )
+
+
 class _Stream:
     """One in-flight streaming dispatch: the donated rollout carry
     advanced segment by segment, plus everything harvested from it.
@@ -413,7 +476,8 @@ class _Stream:
     device pipeline never waits on the host."""
 
     def __init__(self, rids, reqs, capacity, size, params, states,
-                 seg_plan):
+                 seg_plan, sharded=False, jumbo=False, spatial=None,
+                 sp_carry=None):
         self.rids: List[int] = rids              # row i <-> rids[i]
         self.reqs = reqs                         # aligned with rids
         self.capacity = capacity
@@ -422,6 +486,17 @@ class _Stream:
         self.carry = states                      # device; donated next
         self.seg_plan: Tuple[int, ...] = seg_plan
         self.seg_done = 0
+        #: r18 (the 2D-mesh serve plane): ``sharded`` marks a
+        #: scenario-axis dispatch (carry committed P('scenarios'),
+        #: advanced by the sharded entry); ``jumbo`` marks a
+        #: tiles-axis dispatch — ONE tenant in the r12 slot layout,
+        #: ``spatial`` its SpatialSpec and ``sp_carry`` the
+        #: SpatialCarry threaded segment to segment (what makes the
+        #: segmented rollout bitwise-equal to the one-shot).
+        self.sharded = sharded
+        self.jumbo = jumbo
+        self.spatial = spatial
+        self.sp_carry = sp_carry
         self.telem_segs: List = []               # [seg_len, S] leaves
         self.traj_segs: List = []                # [seg_len, S, C, D]
         self.probe = None                        # independent tick copy
@@ -431,10 +506,16 @@ class _Stream:
         self.evicted: Dict[int, tuple] = {}
         self.collected: Set[int] = set()
         self._host = None
+        #: True once EVERY tenant of this stream has been evicted —
+        #: the remaining segments would compute results no one can
+        #: observe, so the rotation stops (load-bearing for the jumbo
+        #: rung, where "every tenant" is one tenant and the dead work
+        #: would be mesh-wide spatial segments).
+        self.abandoned = False
 
     @property
     def done(self) -> bool:
-        return self.seg_done >= len(self.seg_plan)
+        return self.abandoned or self.seg_done >= len(self.seg_plan)
 
     def ticks_elapsed(self) -> int:
         return sum(self.seg_plan[: self.seg_done])
@@ -482,6 +563,14 @@ class _Stream:
             )
             for k in range(n)
         ]
+        return concat_telemetry(parts) if parts else None
+
+    def jumbo_telem(self, n_segs=None):
+        """The jumbo stream's [T]-leaved recorder record across the
+        harvested segments — no tenant axis to slice (the spatial
+        rollout records one mesh-wide stream per tick)."""
+        n = len(self.telem_segs) if n_segs is None else n_segs
+        parts = [self._host_telem_seg(k) for k in range(n)]
         return concat_telemetry(parts) if parts else None
 
     def tenant_traj(self, i: int, n_agents: int, n_segs=None):
@@ -534,6 +623,28 @@ class StreamingService:
     alert events — the surface ``benchmarks/bench_soak.py`` gates and
     ``swarmscope slo`` renders.
 
+    **2D-mesh serving (r18, ROADMAP item 1).**  With ``mesh=`` (a
+    ``(scenarios, tiles)`` mesh from ``parallel.mesh.make_serve_mesh``)
+    the one service runs both workload shapes on the whole slice:
+
+    - scenario rungs whose batch size divides the scenario axis
+      dispatch through ``serve-batched-rollout-sharded`` — the same
+      vmapped scan shard_map-committed ``P('scenarios')``, donated
+      sharded carries, ZERO per-tick collectives (jaxlint-budgeted);
+      per-tenant results stay BITWISE equal to the single-device
+      batched path (tests/test_serve_2d.py);
+    - ``spec.jumbo_capacities`` rungs (with ``jumbo_cfg=``, a
+      hashgrid config) route one large tenant per dispatch through
+      the r12 spatial tick on the tiles axis — segmented via a
+      threaded ``SpatialCarry`` so streaming composes bitwise with
+      the one-shot spatial rollout, collective-permute-only contract
+      unchanged.
+
+    Both rung kinds ride the same admission queue (keyed per
+    capacity, so a jumbo tenant never head-of-line-blocks a scenario
+    rung), the same segment rotation, eviction, SLO stamps, and
+    collect surface.
+
     The compile budget grows only by the distinct segment lengths
     (``n_steps = k·seg + rem`` → at most 2 scan lengths per bucket
     shape), declared to the observatory like every serve budget.
@@ -551,9 +662,60 @@ class StreamingService:
         record: bool = False,
         slo: Optional[SloTracker] = None,
         tracer: Optional[SpanTracer] = None,
+        mesh=None,
+        jumbo_cfg: Optional[SwarmConfig] = None,
     ):
         self.cfg = validate_serve_config(cfg or DEFAULT_CONFIG)
         self.spec = spec or BucketSpec()
+        # --- the 2D-mesh serve plane (r18, ROADMAP item 1) ----------
+        # ``mesh``: a (scenarios, tiles) Mesh (parallel/mesh.
+        # make_serve_mesh).  Scenario rungs whose batch size divides
+        # the scenario axis dispatch through the shard_map'd sharded
+        # entry (donated sharded carries); smaller rungs stay
+        # single-device (sharding a sub-axis batch wastes devices and
+        # loses to the vmapped program — measured, bench_mesh2d.py).
+        # Jumbo rungs (spec.jumbo_capacities) route ONE tenant per
+        # dispatch through the r12 spatial tick on the tiles axis and
+        # need ``jumbo_cfg`` (a hashgrid config — the spatial tick's
+        # envelope; per-request ScenarioParams cannot ride it, so
+        # jumbo requests carry no param overrides).
+        self.mesh = mesh
+        self.jumbo_cfg = jumbo_cfg
+        self.n_scenario_shards = 1
+        self.n_tiles = 1
+        if mesh is not None:
+            from ..parallel.mesh import SCENARIO_AXIS, TILE_AXIS
+
+            shape = dict(mesh.shape)
+            if SCENARIO_AXIS not in shape:
+                raise ValueError(
+                    f"serve mesh must carry a {SCENARIO_AXIS!r} axis "
+                    "(parallel.mesh.make_serve_mesh); got axes "
+                    f"{tuple(shape)}"
+                )
+            self.n_scenario_shards = int(shape[SCENARIO_AXIS])
+            self.n_tiles = int(shape.get(TILE_AXIS, 1))
+        if self.spec.jumbo_capacities:
+            if mesh is None or jumbo_cfg is None:
+                raise ValueError(
+                    "BucketSpec declares jumbo rungs "
+                    f"{self.spec.jumbo_capacities} — the tiles-axis "
+                    "path needs mesh= (make_serve_mesh with tiles >= "
+                    "1) and jumbo_cfg= (the spatial tick's hashgrid "
+                    "config)"
+                )
+            if record:
+                raise ValueError(
+                    "record=True is not supported with jumbo rungs — "
+                    "the spatial rollout's frames are slot-ordered "
+                    "mesh-wide buffers, not per-tenant trajectories"
+                )
+            # Fail at the API boundary, not mid-trace: the spatial
+            # tick's envelope (hashgrid mode, no moments field) and
+            # geometry guards all live here.
+            from ..parallel.spatial import spatial_plan_geometry
+
+            spatial_plan_geometry(jumbo_cfg)
         if n_steps <= 0:
             raise ValueError(f"n_steps must be >= 1, got {n_steps}")
         seg = n_steps if segment_steps is None else int(segment_steps)
@@ -606,13 +768,31 @@ class StreamingService:
         # The r13 declaration times the distinct segment lengths:
         # each (bucket shape, scan length) pair is one legitimate
         # compile.  The materializer sees only the bucket shapes.
+        # r18: scenario shapes are declared under BOTH batched entries
+        # (a rung dispatches sharded when its size divides the
+        # scenario axis, single-device otherwise — the max over both
+        # is the honest ceiling); jumbo rungs land under the spatial
+        # entry, times 2 for the seed-vs-resume carry structures of
+        # the segment rotation.
         watch = compile_watch.WATCH
         fams = max(n_task_families, 1)
-        shapes = self.spec.max_shapes * fams
-        budget = shapes * len(set(self._seg_plan))
-        for entry, b in (
-            (SERVE_ENTRY, budget), (MATERIALIZE_ENTRY, shapes + 1)
-        ):
+        seg_lens = len(set(self._seg_plan))
+        scen_shapes = (
+            len(self.spec.capacities) * len(self.spec.batches) * fams
+        )
+        budget = scen_shapes * seg_lens
+        declarations = [
+            (SERVE_ENTRY, budget),
+            (MATERIALIZE_ENTRY, self.spec.max_shapes * fams + 1),
+        ]
+        if self.mesh is not None:
+            declarations.append((SERVE_SHARDED_ENTRY, budget))
+        if self.spec.jumbo_capacities:
+            declarations.append((
+                JUMBO_ENTRY,
+                len(self.spec.jumbo_capacities) * fams * seg_lens * 2,
+            ))
+        for entry, b in declarations:
             prev = watch.bucket_budget(entry)
             watch.declare_buckets(entry, max(b, prev or 0))
 
@@ -639,6 +819,25 @@ class StreamingService:
                 f"({self.queue.depth}/{self.max_queue}); pump() or "
                 "widen max_queue"
             )
+        if self.spec.is_jumbo(capacity):
+            # Jumbo invariants fail at THEIR OWN submit (the r13
+            # discipline): the spatial tick bakes its gains static,
+            # and the tiled layout lives on the jumbo config's torus.
+            if req.params:
+                raise ValueError(
+                    f"jumbo request (capacity {capacity}, tiles "
+                    "axis) cannot carry per-request params "
+                    f"{sorted(req.params)} — the r12 spatial tick "
+                    "compiles its gains from the static jumbo_cfg; "
+                    "bake them there (one config per jumbo service)"
+                )
+            if req.arena_hw > float(self.jumbo_cfg.world_hw):
+                raise ValueError(
+                    f"jumbo arena_hw {req.arena_hw} exceeds the "
+                    f"jumbo_cfg torus world_hw "
+                    f"{self.jumbo_cfg.world_hw} — spawns must land "
+                    "inside the tiled domain"
+                )
         rid = self._next_rid
         self._next_rid += 1
         n_tasks = len(req.task_pos)
@@ -681,19 +880,64 @@ class StreamingService:
         for rid in rids:
             self.slo.on_admit(rid)
         self.stats["padded_scenarios"] += size - len(reqs)
-        with self.tracer.span(
-            COALESCE_SPAN, rids=rids, capacity=capacity, size=size
-        ):
-            states, params = materialize_batch(
-                reqs, capacity, self.cfg, pad_to=size
+        if self.spec.is_jumbo(capacity):
+            s = self._coalesce_jumbo(capacity, rids, reqs)
+            mesh_label = f"tiles x{self.n_tiles}"
+        else:
+            sharded = (
+                self.mesh is not None
+                and size % self.n_scenario_shards == 0
             )
-        s = _Stream(rids, reqs, capacity, size, params, states,
-                    self._seg_plan)
+            with self.tracer.span(
+                COALESCE_SPAN, rids=rids, capacity=capacity, size=size
+            ):
+                states, params = materialize_batch(
+                    reqs, capacity, self.cfg, pad_to=size
+                )
+                if sharded:
+                    # Committed BEFORE the first launch: donation
+                    # preserves placement, so every later segment's
+                    # carry stays P('scenarios') for free.
+                    states = shard_scenarios(states, self.mesh)
+                    params = shard_scenarios(params, self.mesh)
+            s = _Stream(rids, reqs, capacity, size, params, states,
+                        self._seg_plan, sharded=sharded)
+            mesh_label = (
+                f"scenarios x{self.n_scenario_shards}" if sharded
+                else "device"
+            )
         for rid in rids:
             self._streams[rid] = s
         self._live.append(s)
-        self.slo.on_dispatch(size, len(reqs))
+        self.slo.on_dispatch(
+            size, len(reqs),
+            rung=f"cap={capacity} b={size}", mesh=mesh_label,
+        )
         self.stats["dispatches"] += 1
+
+    def _coalesce_jumbo(self, capacity, rids, reqs) -> _Stream:
+        """One jumbo tenant -> the r12 tiled layout: the IDENTICAL
+        batch-of-1 materializer every parity reference runs (r13
+        discipline), laid out by home strip over the tiles axis.  The
+        host-side layout permutation runs once per dispatch — the
+        deployment boundary ``spatial_shard_swarm`` documents."""
+        from ..parallel.mesh import TILE_AXIS
+        from ..parallel.spatial import spatial_shard_swarm
+
+        assert len(reqs) == 1, "jumbo rungs are batch-of-1"
+        with self.tracer.span(
+            COALESCE_SPAN, rids=rids, capacity=capacity, size=1
+        ):
+            state, _ = materialize_scenario(
+                reqs[0], capacity, self.jumbo_cfg
+            )
+            tiled, spec = spatial_shard_swarm(
+                state, self.mesh, self.jumbo_cfg, axis=TILE_AXIS
+            )
+        return _Stream(
+            rids, reqs, capacity, 1, None, tiled, self._seg_plan,
+            jumbo=True, spatial=spec,
+        )
 
     def _advance(self) -> int:
         """Rotate: one segment launch per in-flight dispatch.  At
@@ -710,16 +954,33 @@ class StreamingService:
                 with self.tracer.span(
                     EVICT_SPAN, rid=rid, ticks=s.ticks_elapsed()
                 ):
-                    i = s.rids.index(rid)
-                    view = jax.tree_util.tree_map(
-                        lambda x, i=i: x[i], s.carry
-                    )
+                    if s.jumbo:
+                        # The whole tiled state IS the tenant; the
+                        # spatial rollout never donates its input, so
+                        # the reference stays valid across later
+                        # segment launches.
+                        view = s.carry
+                    else:
+                        i = s.rids.index(rid)
+                        view = jax.tree_util.tree_map(
+                            lambda x, i=i: x[i], s.carry
+                        )
                     s.evicted[rid] = (
                         s.ticks_elapsed(), view, s.seg_done
                     )
                 self.slo.on_eviction(rid, s.ticks_elapsed())
                 self.stats["evicted"] += 1
             s.evict_flags.clear()
+            if all(
+                rid in s.evicted or rid in s.collected
+                for rid in s.rids
+            ):
+                # Every tenant left: the remaining segments would
+                # compute a result no one can observe.  Stop the
+                # rotation (a jumbo stream would otherwise keep
+                # burning the whole tiles axis on discarded work).
+                s.abandoned = True
+                continue
             first = s.seg_done == 0
             if first:
                 # Launch stamps BEFORE the jit dispatch: time-in-queue
@@ -736,12 +997,30 @@ class StreamingService:
                 LAUNCH_SPAN if first else SEGMENT_SPAN,
                 rids=s.rids, seg=s.seg_done, seg_len=seg_len,
             ):
-                out = batched_rollout(
-                    s.carry, s.params, self.cfg, seg_len,
-                    record=self.record, telemetry=self.telemetry,
-                )
+                if s.jumbo:
+                    out = _swarm_rollout_spatial(
+                        s.carry, self.jumbo_cfg, seg_len, self.mesh,
+                        s.spatial, self.telemetry, s.sp_carry,
+                    )
+                elif s.sharded:
+                    out = batched_rollout_sharded(
+                        s.carry, s.params, self.cfg, seg_len,
+                        self.mesh, record=self.record,
+                        telemetry=self.telemetry,
+                    )
+                else:
+                    out = batched_rollout(
+                        s.carry, s.params, self.cfg, seg_len,
+                        record=self.record, telemetry=self.telemetry,
+                    )
             traj = telem = None
-            if self.record and self.telemetry:
+            if s.jumbo:
+                out, s.sp_carry = out
+                if self.telemetry:
+                    states, telem = out
+                else:
+                    states = out
+            elif self.record and self.telemetry:
                 states, traj, telem = out
             elif self.record:
                 states, traj = out
@@ -893,7 +1172,25 @@ class StreamingService:
         req, capacity = self._requests.pop(rid)
         i = s.rids.index(rid)
         with self.tracer.span(COLLECT_SPAN, rid=rid):
-            if rid in s.evicted:
+            if s.jumbo:
+                if rid in s.evicted:
+                    ticks, view, n_segs = s.evicted.pop(rid)
+                    state = jax.tree_util.tree_map(np.asarray, view)
+                else:
+                    ticks, n_segs = self.n_steps, None
+                    state = s.host_states()
+                # Back to agent-id order at the bucket capacity: the
+                # r12 parity lens — the result compares directly
+                # against the solo single-device rollout of the same
+                # materialized scenario.
+                state = unshard_spatial_state(state, capacity)
+                summary = None
+                if self.telemetry and s.telem_segs and n_segs != 0:
+                    summary = TelemetrySummary.from_ticks(
+                        s.jumbo_telem(n_segs)
+                    ).to_dict()
+                traj = None
+            elif rid in s.evicted:
                 ticks, view, n_segs = s.evicted.pop(rid)
                 state = jax.tree_util.tree_map(np.asarray, view)
                 summary = None
@@ -950,3 +1247,9 @@ class StreamingService:
 
     def compile_entries(self) -> int:
         return compile_watch.WATCH.compile_count(SERVE_ENTRY)
+
+    def compile_entries_sharded(self) -> int:
+        """Observatory cache entries of the scenario-axis sharded
+        entry (r18) — gated against the same bucket lattice by
+        bench_mesh2d.py."""
+        return compile_watch.WATCH.compile_count(SERVE_SHARDED_ENTRY)

@@ -151,6 +151,14 @@ class SloTracker:
         self.n_dispatches = 0
         self._dispatch_rows = 0
         self._dispatch_real = 0
+        #: Per-RUNG occupancy (r18): rung label -> [dispatches, rows,
+        #: real rows, mesh label].  O(#rungs) — the bucket lattice is
+        #: small by design — so a long-lived service pays nothing per
+        #: dispatch beyond three adds.  The mesh label ("scenarios x8",
+        #: "tiles x2", "device") is what ``swarmscope slo`` renders
+        #: next to each rung's occupancy line, so an operator can see
+        #: which axis a rung rides.
+        self._rungs: Dict[str, list] = {}
         self.deadline_misses = 0
         self.queue_overflows = 0
         self.evictions = 0
@@ -261,10 +269,27 @@ class SloTracker:
             self.gauges = self.gauges[::2]
             self._gauge_stride *= 2
 
-    def on_dispatch(self, size: int, n_real: int) -> None:
+    def on_dispatch(
+        self, size: int, n_real: int,
+        rung: Optional[str] = None, mesh: Optional[str] = None,
+    ) -> None:
+        """One launched dispatch: ``size`` padded rows, ``n_real``
+        real tenants.  ``rung`` (r18) attributes the occupancy to a
+        bucket rung (e.g. ``"cap=64 b=8"``) and ``mesh`` names the
+        axis it rides (``"scenarios x8"`` / ``"tiles x2"`` /
+        ``"device"``) — the per-rung view the aggregate filler
+        fraction hides (a zero-filler jumbo rung and a padded
+        scenario rung average into a number describing neither)."""
         self.n_dispatches += 1
         self._dispatch_rows += int(size)
         self._dispatch_real += int(n_real)
+        if rung is not None:
+            row = self._rungs.setdefault(
+                rung, [0, 0, 0, mesh or "device"]
+            )
+            row[0] += 1
+            row[1] += int(size)
+            row[2] += int(n_real)
 
     # -- reduction ---------------------------------------------------------
     def ttfr_ms(self) -> List[float]:
@@ -300,6 +325,17 @@ class SloTracker:
             "evictions": self.evictions,
             "dispatches": self.n_dispatches,
             "filler_fraction": round(self.filler_fraction(), 4),
+            "rungs": {
+                label: {
+                    "dispatches": row[0],
+                    "filler_fraction": round(
+                        (row[1] - row[2]) / row[1] if row[1] else 0.0,
+                        4,
+                    ),
+                    "mesh": row[3],
+                }
+                for label, row in sorted(self._rungs.items())
+            },
             "gauge_stride": self._gauge_stride,
             "queue_depth": [list(g) for g in self.gauges],
         }

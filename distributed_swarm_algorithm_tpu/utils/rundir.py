@@ -26,7 +26,8 @@ The gating rules here MUST stay in lockstep with
 halo-exchange traffic) / ``collectives`` (r15 — jaxlint's per-entry
 scan-body collective census) / ``ms-p50`` / ``ms-p99`` (r16 — the
 serve SLO latency percentiles: a tail-latency regression gates like
-a byte-volume regression) are lower-is-better
+a byte-volume regression) / ``filler-pct`` (r18 — the soak's
+dispatch-occupancy padding cost) are lower-is-better
 counts (a clean 0 baseline regressing to any positive count always
 gates), unit ``pct`` gates against the absolute :data:`PCT_CEILING`
 and unit ``overhead-pct`` against :data:`OVERHEAD_PCT_CEILING`
@@ -57,9 +58,11 @@ COMPILE_DIR = "compile"
 
 #: Lower-is-better count units (mirror of compare.py's tuple).
 #: "ms-p50"/"ms-p99" (r16): serve-SLO latency percentiles — growth
-#: past threshold gates, paydown never does.
+#: past threshold gates, paydown never does.  "filler-pct" (r18):
+#: the soak's dispatch-occupancy padding cost.
 COUNT_UNITS = ("findings", "rounds", "events", "ticks", "compiles",
-               "bytes", "collectives", "ms-p50", "ms-p99")
+               "bytes", "collectives", "ms-p50", "ms-p99",
+               "filler-pct")
 
 #: Absolute ceiling for unit-"pct" metrics (compare.PCT_CEILING).
 PCT_CEILING = 5.0

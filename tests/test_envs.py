@@ -492,3 +492,73 @@ def test_auction_coverage_env_parity():
     winner = np.asarray(_swarm_row(states).task_winner)
     assert (winner >= 0).all()                    # the solve resolved
     assert np.asarray(rewards)[-1, 0].max() > 0
+
+
+# --------------------------------------------- derived-target reuse (r18)
+
+
+def test_obs_reuses_tick_derived_targets_bitwise():
+    # r18 (ROADMAP item 4 speed note): with the tag sweep compiled
+    # out (enable_tagging=False), `step` hands the tick's ephemeral
+    # formation derivation to `obs` instead of re-deriving — and the
+    # observations must stay BITWISE what the recompute path
+    # (enable_tagging=True, tag_radius=0: the tag sweep is a bitwise
+    # no-op, pinned in test_two_population_masking) produces, across
+    # ordinary steps AND an auto-reset boundary.  A V-formation
+    # config makes the derived slot-error block nontrivial (the
+    # module CFG's formation "none" would pin an identity).
+    vcfg = CFG.replace(formation_shape="v")
+    env_re = envs.SwarmMARLEnv(
+        cfg=vcfg, capacity=16, k_neighbors=2, obs_max_per_cell=16,
+        enable_tagging=True,
+    )
+    env_reuse = env_re.replace(enable_tagging=False)
+
+    def roll(env, n_steps):
+        p = envs.stack_env_params(
+            [envs.station_keeping(env, n_agents=12, max_steps=5)]
+        )
+        step = jax.jit(
+            lambda k, s, a: jax.vmap(env.step)(k[None], s, a[None])
+        )
+        obs, st = jax.vmap(env.reset)(
+            jax.random.PRNGKey(3)[None], p
+        )
+        key = jax.random.PRNGKey(9)
+        frames = []
+        for _ in range(n_steps):
+            key, sk = jax.random.split(key)
+            obs, st, _, _, _ = step(sk, st, jnp.zeros((12 + 4, 2))[:16])
+            frames.append(np.asarray(obs))
+        return frames, st
+
+    f_re, st_re = roll(env_re, 8)
+    f_ru, st_ru = roll(env_reuse, 8)
+    for i, (a, b) in enumerate(zip(f_re, f_ru)):
+        assert np.array_equal(a, b), f"obs diverged at step {i}"
+    _assert_swarm_parity(
+        _swarm_row(st_re), _swarm_row(st_ru), "derived-reuse"
+    )
+
+
+def test_swarm_tick_dyn_return_derived_matches_formation_targets():
+    # The handed-back columns ARE formation_targets of the post-tick
+    # state (position-independent, so deriving before or after
+    # integrate is the same arithmetic).
+    from distributed_swarm_algorithm_tpu.ops.physics import (
+        formation_targets,
+    )
+
+    vcfg = CFG.replace(formation_shape="v")
+    s = dsa.make_swarm(16, seed=2, spread=4.0)
+    out, _, derived = swarm_tick_dyn(
+        s, None, vcfg, return_derived=True
+    )
+    ref = formation_targets(out, vcfg)
+    assert np.array_equal(np.asarray(derived[0]), np.asarray(ref.target))
+    assert np.array_equal(
+        np.asarray(derived[1]), np.asarray(ref.has_target)
+    )
+    # Default arity unchanged (every pre-r18 caller).
+    out2, telem = swarm_tick_dyn(s, None, vcfg)
+    assert np.array_equal(np.asarray(out2.pos), np.asarray(out.pos))

@@ -1,0 +1,468 @@
+"""2D-mesh serving (r18): scenarios x tiles behind one StreamingService.
+
+Four layers:
+
+- **the lattice declarations**: jumbo rungs sit above the scenario
+  capacities, declare the ``('tiles',)`` axes, quantize batch-of-1,
+  and the admission queue releases them without coalescing — all
+  host-side, fake-clocked, exact;
+- **the sharded parity contract**: the scenario-axis sharded entry
+  (``serve-batched-rollout-sharded``) is BITWISE equal, per tenant,
+  to the single-device batched rollout — a vmap row's arithmetic is
+  independent of its batch neighbors, so shard_map's S/n blocks
+  compute exactly the same rows;
+- **the census contract**: the sharded entry lowers with ZERO
+  collectives (module-wide and per tick) and carries the donated
+  carry as ``jax.buffer_donor`` args — stated on the lowered program
+  via the jaxlint census, not hoped;
+- **mixed-rung streaming**: a jumbo tenant (tiles axis, segmented
+  spatial tick with a threaded ``SpatialCarry``) and a scenario rung
+  in flight simultaneously — per-rung FIFO, no cross-rung
+  head-of-line blocking, retrace-free joins (compile-count pinned),
+  and everyone bitwise-equal to their solo reference.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu import serve
+from distributed_swarm_algorithm_tpu.analysis import jaxlint
+from distributed_swarm_algorithm_tpu.parallel.mesh import (
+    SCENARIO_AXIS,
+    make_serve_mesh,
+)
+from distributed_swarm_algorithm_tpu.utils import compile_watch as cw
+
+CFG = dsa.SwarmConfig().replace(
+    formation_shape="none", utility_threshold=2.0
+)
+
+#: The jumbo rung's static config — the r12 flagship hashgrid shape
+#: (the spatial tick's envelope).
+JUMBO_CFG = dsa.SwarmConfig().replace(
+    separation_mode="hashgrid", world_hw=64.0,
+    formation_shape="none", hashgrid_backend="portable",
+    grid_max_per_cell=24, max_speed=1.0, hashgrid_skin=1.0,
+)
+
+PARITY_FIELDS = ("pos", "vel", "fsm", "leader_id", "alive", "tick")
+
+
+def _assert_parity(solo, got, label=""):
+    for f in PARITY_FIELDS:
+        a = np.asarray(getattr(solo, f))
+        b = np.asarray(getattr(got, f))
+        assert np.array_equal(a, b), f"{label}: field {f} diverged"
+
+
+def _solo(req, capacity, cfg, n_steps):
+    s, p = serve.materialize_scenario(req, capacity, cfg)
+    return dsa.swarm_rollout(
+        s, None, serve.bake_params(cfg, p), n_steps
+    )
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+# ------------------------------------------------------- bucket lattice
+
+
+def test_bucketspec_jumbo_declarations():
+    spec = serve.BucketSpec(
+        capacities=(16, 32), batches=(1, 4), jumbo_capacities=(256,)
+    )
+    assert spec.mesh_axes_for(16) == serve.SCENARIO_AXES
+    assert spec.mesh_axes_for(256) == serve.TILE_AXES
+    assert spec.batches_for(32) == (1, 4)
+    assert spec.batches_for(256) == (1,)
+    assert spec.capacity_for(30) == 32
+    assert spec.capacity_for(33) == 256     # past the scenario rungs
+    assert spec.is_jumbo(256) and not spec.is_jumbo(32)
+    # Jumbo rungs add one shape each (batch-of-1 by construction).
+    assert spec.max_shapes == 2 * 2 + 1
+    # Jumbo split: k tenants -> k one-tenant dispatches, zero filler.
+    assert spec.split_batch(3, 256) == [1, 1, 1]
+    assert spec.split_batch(3, 16) == [4]   # scenario rungs unchanged
+
+
+def test_bucketspec_jumbo_must_sit_above_scenario_rungs():
+    with pytest.raises(ValueError, match="ABOVE the largest"):
+        serve.BucketSpec(
+            capacities=(16, 32), batches=(1,), jumbo_capacities=(32,)
+        )
+
+
+def test_bucketspec_rejects_past_largest_jumbo():
+    spec = serve.BucketSpec(
+        capacities=(16,), batches=(1,), jumbo_capacities=(64,)
+    )
+    with pytest.raises(ValueError, match="exceeds the largest"):
+        spec.capacity_for(65)
+
+
+def test_make_serve_mesh_shapes():
+    mesh = make_serve_mesh()                      # all devices, 1 tile
+    assert mesh.shape[SCENARIO_AXIS] == 8
+    assert mesh.shape["tiles"] == 1
+    mesh2 = make_serve_mesh(scenarios=4, tiles=2)
+    assert dict(mesh2.shape) == {"scenarios": 4, "tiles": 2}
+    with pytest.raises(ValueError, match="needs"):
+        make_serve_mesh(scenarios=3, tiles=2)
+
+
+# ---------------------------------------------- mixed-rung queue policy
+
+
+def test_queue_mixed_rungs_release_independently():
+    # The satellite's queue half: a jumbo tenant releases the cycle
+    # it arrives (its only rung is 1 — a mesh-spanning dispatch never
+    # waits on coalescing) WITHOUT flushing the scenario group, which
+    # keeps coalescing toward its own rung or deadline; per-rung FIFO
+    # is preserved on both sides.
+    clock = FakeClock()
+    spec = serve.BucketSpec(
+        capacities=(16,), batches=(1, 4), jumbo_capacities=(256,)
+    )
+    q = serve.AdmissionQueue(spec, deadline_s=10.0, clock=clock)
+    q.push(0, serve.ScenarioRequest(n_agents=10, seed=0), 16, 0)
+    q.push(1, serve.ScenarioRequest(n_agents=200, seed=1), 256, 0)
+    q.push(2, serve.ScenarioRequest(n_agents=201, seed=2), 256, 0)
+    q.push(3, serve.ScenarioRequest(n_agents=11, seed=3), 16, 0)
+    out = q.pop_ready()
+    # Only the jumbo group released (one dispatch per tenant, FIFO);
+    # the scenario pair is still coalescing (rung 4 unfilled,
+    # deadline far) — no cross-rung head-of-line blocking either way.
+    assert [(key[0], size) for key, _, size in out] == [
+        (256, 1), (256, 1)
+    ]
+    assert [e.rid for _, es, _ in out for e in es] == [1, 2]
+    assert q.depth == 2
+    # Scenario rung fills -> releases FIFO, jumbo long gone.
+    q.push(4, serve.ScenarioRequest(n_agents=12, seed=4), 16, 0)
+    q.push(5, serve.ScenarioRequest(n_agents=13, seed=5), 16, 0)
+    out = q.pop_ready()
+    assert [(key[0], size) for key, _, size in out] == [(16, 4)]
+    assert [e.rid for e in out[0][1]] == [0, 3, 4, 5]
+
+
+# ------------------------------------------------- sharded entry parity
+
+
+def test_sharded_rollout_bitwise_equals_single_device():
+    mesh = make_serve_mesh(scenarios=4, tiles=2)
+    reqs = [
+        serve.ScenarioRequest(
+            n_agents=4 + (i % 5), seed=i, arena_hw=6.0 + (i % 3),
+            params={"k_att": 1.0 + 0.1 * i, "k_sep": 10.0 + i},
+        )
+        for i in range(8)
+    ]
+    st, pr = serve.materialize_batch(reqs, 8, CFG)
+    ref = serve.batched_rollout(st, pr, CFG, 7, telemetry=False)
+    st2, pr2 = serve.materialize_batch(reqs, 8, CFG)
+    got = serve.batched_rollout_sharded(
+        serve.shard_scenarios(st2, mesh),
+        serve.shard_scenarios(pr2, mesh),
+        CFG, 7, mesh,
+    )
+    for i in range(len(reqs)):
+        _assert_parity(
+            serve.tenant_state(ref, i), serve.tenant_state(got, i),
+            f"tenant {i}",
+        )
+
+
+def test_sharded_rollout_validations():
+    mesh = make_serve_mesh(scenarios=4, tiles=2)
+    reqs = [serve.ScenarioRequest(n_agents=6, seed=i) for i in range(6)]
+    st, pr = serve.materialize_batch(reqs, 8, CFG)
+    with pytest.raises(ValueError, match="does not split"):
+        serve.batched_rollout_sharded(st, pr, CFG, 3, mesh)  # 6 % 4
+    st, _ = serve.materialize_batch(reqs[:4], 8, CFG)
+    with pytest.raises(ValueError, match="needs params"):
+        serve.batched_rollout_sharded(st, None, CFG, 3, mesh)
+
+
+def test_sharded_entry_census_zero_collectives():
+    # The jaxlint registry's canonical example IS the contract: zero
+    # collectives module-wide and per tick, donation visible as
+    # jax.buffer_donor args (shard_map defers the aliasing pairing to
+    # the compiler — alias-bytes in the budgets ledger proves it
+    # landed).  One memoized lowering, no execution.
+    census = jaxlint.entry_census("serve-batched-rollout-sharded")
+    assert jaxlint.collectives_per_tick(census) == 0
+    for key in jaxlint.COLLECTIVE_OPS:
+        assert census[key] == 0, key
+    assert census["donor-args"] > 0
+    assert census["donated-not-aliased"] == 0
+
+
+# --------------------------------------------- the mesh-ed service
+
+
+def test_streaming_mesh_constructor_validations():
+    mesh = make_serve_mesh(scenarios=4, tiles=2)
+    jspec = serve.BucketSpec(
+        capacities=(16,), batches=(1,), jumbo_capacities=(64,)
+    )
+    with pytest.raises(ValueError, match="needs mesh"):
+        serve.StreamingService(CFG, spec=jspec, n_steps=4)
+    with pytest.raises(ValueError, match="record=True"):
+        serve.StreamingService(
+            CFG, spec=jspec, n_steps=4, mesh=mesh,
+            jumbo_cfg=JUMBO_CFG, record=True,
+        )
+    # The jumbo config must sit in the spatial tick's envelope.
+    with pytest.raises(ValueError, match="hashgrid"):
+        serve.StreamingService(
+            CFG, spec=jspec, n_steps=4, mesh=mesh, jumbo_cfg=CFG,
+        )
+    svc = serve.StreamingService(
+        CFG, spec=jspec, n_steps=4, mesh=mesh, jumbo_cfg=JUMBO_CFG,
+    )
+    # Jumbo requests cannot carry per-request params (static config).
+    with pytest.raises(ValueError, match="cannot carry"):
+        svc.submit(serve.ScenarioRequest(
+            n_agents=50, seed=0, params={"k_att": 2.0},
+        ))
+    with pytest.raises(ValueError, match="world_hw"):
+        svc.submit(serve.ScenarioRequest(
+            n_agents=50, seed=0, arena_hw=100.0,
+        ))
+
+
+def test_streaming_mixed_rungs_parity_fifo_and_join():
+    # The satellite's service half: a jumbo tenant (tiles axis,
+    # multi-segment spatial tick) and a sharded scenario rung in
+    # flight SIMULTANEOUSLY; a joiner of the already-compiled shape
+    # rides a later dispatch retrace-free (compile pin); every tenant
+    # bitwise-equals its solo reference — the jumbo one via the
+    # single-device rollout of the same materialized scenario (the
+    # r12 parity lens), which also pins that the segmented
+    # carry-threaded rollout composes bitwise.
+    watch = cw.WATCH
+    was_enabled = watch.enabled
+    watch.reset()
+    watch.enable()
+    try:
+        mesh = make_serve_mesh(scenarios=4, tiles=2)
+        spec = serve.BucketSpec(
+            capacities=(16,), batches=(4,), jumbo_capacities=(64,)
+        )
+        svc = serve.StreamingService(
+            CFG, spec=spec, n_steps=9, segment_steps=3,
+            deadline_s=0.001, telemetry=False, mesh=mesh,
+            jumbo_cfg=JUMBO_CFG,
+        )
+        jreq = serve.ScenarioRequest(n_agents=50, seed=9,
+                                     arena_hw=57.0)
+        sreqs = [
+            serve.ScenarioRequest(
+                n_agents=10 + i, seed=20 + i,
+                params={"k_sep": 12.0 + i},
+            )
+            for i in range(4)
+        ]
+        jrid = svc.submit(jreq)
+        srids = [svc.submit(r) for r in sreqs]
+        svc.pump()
+        # Both rungs launched in ONE pump: the jumbo released
+        # immediately AND the rung-full scenario group dispatched —
+        # neither waited on the other (no cross-rung HOL blocking).
+        assert svc.n_in_flight == 2
+        streams = {svc._streams[jrid], svc._streams[srids[0]]}
+        assert {s.jumbo for s in streams} == {True, False}
+        assert all(
+            s.sharded for s in streams if not s.jumbo
+        ), "the rung-4 scenario dispatch should ride the sharded entry"
+        # Let dispatch 1 compile its FULL segment schedule (seg 1 is
+        # the seed-carry structure, seg 2 the resumed-carry one)
+        # before snapshotting the counts the joiners are pinned to.
+        svc.pump()
+        sharded_compiles = watch.compile_count(
+            serve.SERVE_SHARDED_ENTRY
+        )
+        jumbo_compiles = watch.compile_count(serve.JUMBO_ENTRY)
+        assert sharded_compiles >= 1 and jumbo_compiles == 2
+        # Joiners of both shapes arrive MID-STREAM of dispatch 1.
+        j2 = [
+            svc.submit(serve.ScenarioRequest(
+                n_agents=12 + i, seed=30 + i,
+            ))
+            for i in range(4)
+        ]
+        jrid2 = svc.submit(serve.ScenarioRequest(
+            n_agents=40, seed=31, arena_hw=50.0,
+        ))
+        res = svc.drain()
+        assert sorted(res) == sorted([jrid, jrid2] + srids + j2)
+        # Retrace-free: the joiner dispatches reused both compiled
+        # shapes (segment schedule included — resumed-carry segments
+        # compile once, on dispatch 1).
+        assert watch.compile_count(
+            serve.SERVE_SHARDED_ENTRY
+        ) == sharded_compiles
+        assert watch.compile_count(serve.JUMBO_ENTRY) == jumbo_compiles
+        assert watch.within_bucket_budget(serve.SERVE_SHARDED_ENTRY)
+        assert watch.within_bucket_budget(serve.JUMBO_ENTRY)
+        # Parity: scenario tenants (sharded rung) vs solo.
+        for rid, req in list(zip(srids, sreqs)) + [
+            (j2[i], serve.ScenarioRequest(n_agents=12 + i,
+                                          seed=30 + i))
+            for i in range(4)
+        ]:
+            _assert_parity(
+                _solo(req, 16, CFG, 9), res[rid].state,
+                f"scenario tenant {rid}",
+            )
+            assert res[rid].ticks == 9
+        # Parity: jumbo tenants vs the solo single-device rollout —
+        # through materialize -> tile -> 3 carry-threaded segments ->
+        # unshard, bitwise.
+        for rid, req in ((jrid, jreq),
+                        (jrid2, serve.ScenarioRequest(
+                            n_agents=40, seed=31, arena_hw=50.0))):
+            _assert_parity(
+                _solo(req, 64, JUMBO_CFG, 9), res[rid].state,
+                f"jumbo tenant {rid}",
+            )
+        # The rung ledger names the axis each rung rode.
+        rungs = svc.slo.summary()["rungs"]
+        assert rungs["cap=16 b=4"]["mesh"] == "scenarios x4"
+        assert rungs["cap=64 b=1"]["mesh"] == "tiles x2"
+        assert rungs["cap=64 b=1"]["filler_fraction"] == 0.0
+    finally:
+        watch.reset()
+        watch.enabled = was_enabled
+
+
+def test_streaming_jumbo_eviction_prefix_and_abandonment():
+    # A jumbo tenant evicted mid-stream returns the elapsed prefix,
+    # bitwise-equal to the solo rollout cut at the same tick — and
+    # the stream STOPS rotating once its only tenant is gone (the
+    # remaining mesh-wide spatial segments would compute a result no
+    # one can observe).
+    mesh = make_serve_mesh(scenarios=4, tiles=2)
+    spec = serve.BucketSpec(
+        capacities=(16,), batches=(1,), jumbo_capacities=(64,)
+    )
+    svc = serve.StreamingService(
+        CFG, spec=spec, n_steps=9, segment_steps=3,
+        deadline_s=0.001, telemetry=False, mesh=mesh,
+        jumbo_cfg=JUMBO_CFG,
+    )
+    jreq = serve.ScenarioRequest(n_agents=48, seed=5, arena_hw=57.0)
+    rid = svc.submit(jreq)
+    svc.pump(force=True)          # segment 1 launched
+    assert svc.evict(rid)
+    while not (rid in svc.ready_rids()):
+        svc.pump()
+    stream = svc._streams[rid]
+    assert stream.abandoned and stream.done
+    assert stream.seg_done == 1   # the cut segment — nothing after
+    assert svc.n_in_flight == 0
+    svc.pump()                    # further pumps launch nothing
+    assert stream.seg_done == 1
+    res = svc.collect(rid)
+    assert 0 < res.ticks < 9 and res.ticks % 3 == 0
+    _assert_parity(
+        _solo(jreq, 64, JUMBO_CFG, res.ticks), res.state,
+        "evicted jumbo prefix",
+    )
+
+
+def test_rollout_service_rejects_jumbo_rungs():
+    # The one-shot r13 service has no tiles-axis dispatch plane: a
+    # jumbo-capacity spec must fail at construction, not silently
+    # route a mesh-scale tenant through the single-device vmapped
+    # path (a bespoke compile/OOM instead of a loud rejection).
+    with pytest.raises(ValueError, match="StreamingService"):
+        serve.RolloutService(
+            CFG,
+            spec=serve.BucketSpec(
+                capacities=(16,), batches=(1,),
+                jumbo_capacities=(64,),
+            ),
+            n_steps=4,
+        )
+
+
+def test_unsharded_small_rung_still_serves_under_mesh():
+    # A rung smaller than the scenario axis stays single-device (the
+    # sharding rule: only multiples of the axis shard) — and still
+    # serves bitwise.
+    mesh = make_serve_mesh(scenarios=8, tiles=1)
+    spec = serve.BucketSpec(capacities=(16,), batches=(1,))
+    svc = serve.StreamingService(
+        CFG, spec=spec, n_steps=5, deadline_s=0.001,
+        telemetry=False, mesh=mesh,
+    )
+    req = serve.ScenarioRequest(n_agents=9, seed=3)
+    rid = svc.submit(req)
+    res = svc.drain()
+    _assert_parity(_solo(req, 16, CFG, 5), res[rid].state, "b1")
+    assert svc.slo.summary()["rungs"]["cap=16 b=1"]["mesh"] == "device"
+
+
+# ------------------------------------------------------- unshard lens
+
+
+def test_unshard_spatial_state_restores_id_order():
+    import jax
+
+    from distributed_swarm_algorithm_tpu.ops.coordination import kill
+    from distributed_swarm_algorithm_tpu.parallel.spatial import (
+        spatial_shard_swarm,
+    )
+
+    mesh = make_serve_mesh(scenarios=4, tiles=2)
+    s = kill(dsa.make_swarm(48, seed=0, spread=57.0), [3, 17])
+    tiled, _ = spatial_shard_swarm(s, mesh, JUMBO_CFG, axis="tiles")
+    host = jax.tree_util.tree_map(np.asarray, tiled)
+    back = serve.unshard_spatial_state(host, 48)
+    for f in ("pos", "vel", "alive", "agent_id", "fsm", "target",
+              "has_target"):
+        assert np.array_equal(
+            np.asarray(getattr(s, f)), np.asarray(getattr(back, f))
+        ), f
+    aint = np.asarray(s.alive).astype(np.int32)
+    assert np.array_equal(
+        back.alive_below, np.cumsum(aint) - aint
+    )
+    # The restored state keeps the SwarmState dtype contract ([N]
+    # i32) — an i64 leaf would be a bespoke retrace for any jitted
+    # consumer of the returned result.
+    assert back.alive_below.dtype == np.int32
+
+
+# --------------------------------------------------- slo rung ledger
+
+
+def test_slo_per_rung_occupancy():
+    clock = FakeClock()
+    t = serve.SloTracker(deadline_s=1.0, clock=clock)
+    t.on_dispatch(4, 3, rung="cap=16 b=4", mesh="scenarios x4")
+    t.on_dispatch(4, 4, rung="cap=16 b=4", mesh="scenarios x4")
+    t.on_dispatch(1, 1, rung="cap=64 b=1", mesh="tiles x2")
+    s = t.summary()
+    assert s["dispatches"] == 3
+    assert s["rungs"]["cap=16 b=4"] == {
+        "dispatches": 2, "filler_fraction": 0.125,
+        "mesh": "scenarios x4",
+    }
+    assert s["rungs"]["cap=64 b=1"]["filler_fraction"] == 0.0
+    # Aggregate unchanged by the rung attribution.
+    assert s["filler_fraction"] == round(1 / 9, 4)

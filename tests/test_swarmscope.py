@@ -145,6 +145,13 @@ def test_gate_semantics_agree_with_compare(tmp_path):
         ("ms-p99", 800.0, 850.0, False),
         ("ms-p99", 1100.0, 500.0, False),
         ("ms-p50", 0.0, 100.0, True),
+        # r18 dispatch filler fraction: padding growth past threshold
+        # gates, within-threshold jitter and paydown do not, and a
+        # zero-filler baseline regressing to any padding gates.
+        ("filler-pct", 31.0, 40.0, True),
+        ("filler-pct", 31.0, 33.0, False),
+        ("filler-pct", 31.0, 20.0, False),
+        ("filler-pct", 0.0, 5.0, True),
     ]
     for i, (unit, prev, cur, expect) in enumerate(cases):
         assert (

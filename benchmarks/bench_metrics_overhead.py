@@ -1,0 +1,222 @@
+"""Metrics-registry overhead + TTFR observation lag (r19).
+
+The live metrics plane (utils/metrics.py) follows the r10/r17
+overhead discipline: DISABLED is one attribute check per observation
+site, and ENABLED must stay cheap enough that a production service
+runs with the dashboard on by default.  Two fixed-name rows state
+both halves:
+
+- ``metrics-overhead-pct`` (unit "pct", the absolute 5% PCT_CEILING):
+  the same deterministic 60-request streamed mix (the
+  bench_trace_overhead pass, device callbacks ON in both arms so the
+  delta isolates the registry) runs through a ``StreamingService``
+  once with a disabled registry and once enabled; the wall-clock
+  delta is the row.  Self-gated (exit 2) like every pct bar.
+- ``ttfr-observation-lag-ms`` (unit "lag-ms", the absolute 50 ms
+  LAG_MS_CEILING): on the soak's request mix, the per-request delta
+  between the HOST-POLL first-result observation (the pre-r19 stamp:
+  quantized to pump cadence) and the DEVICE-CALLBACK stamp (r19,
+  ROADMAP item 2b: the device records completion).  The row is the
+  p99 of the per-request lags — what the poll-only design was adding
+  to observed TTFR.  Self-gates: the callback stamp must be <= the
+  host-poll stamp on EVERY request (the callback fires when the
+  segment completes; the poll can only observe later), and the p99
+  must sit under the ceiling.
+
+The enabled pass doubles as the live-surface acceptance check: the
+registry's snapshot must carry the serve taxonomy (admissions,
+releases, dispatch launches, TTFR histogram) with counts that match
+the service's own stats, and the disabled registry must have recorded
+nothing.
+
+Usage: python benchmarks/bench_metrics_overhead.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import jax
+
+from common import report
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu import serve
+from distributed_swarm_algorithm_tpu.utils import metrics as metricslib
+from distributed_swarm_algorithm_tpu.utils.telemetry import percentile
+
+N_REQUESTS = 60
+N_STEPS = 30
+SEGMENT_STEPS = 10
+DEADLINE_S = 0.01
+#: Best-of reps per registry mode, interleaved off/on (the
+#: timeit_best discipline — sub-second passes on a loaded host show
+#: one-sided noise).
+REPS = 3
+TAG = "60 requests streamed mix (cpu)"
+
+SPEC = serve.BucketSpec(capacities=(32, 64), batches=(1, 2, 4))
+BASE = dsa.SwarmConfig().replace(
+    formation_shape="none", utility_threshold=2.0
+)
+
+
+def _request(i: int) -> serve.ScenarioRequest:
+    """The bench_soak deterministic heterogeneous mix, shrunk: two
+    capacity rungs, a param grid, per-index seeds."""
+    return serve.ScenarioRequest(
+        n_agents=(24 + (i * 11) % 9) if i % 3 else (48 + (i * 7) % 17),
+        seed=i,
+        arena_hw=6.0 + (i % 5),
+        params={
+            "k_att": 0.5 + 0.25 * (i % 7),
+            "k_sep": 10.0 + 5.0 * (i % 4),
+        },
+    )
+
+
+def _serve_mix(registry: metricslib.MetricsRegistry):
+    """One full streamed pass (identical request sequence and pump
+    cadence across passes — only the registry differs); returns
+    ``(wall_s, service)``."""
+    svc = serve.StreamingService(
+        BASE, spec=SPEC, n_steps=N_STEPS,
+        segment_steps=SEGMENT_STEPS, deadline_s=DEADLINE_S,
+        telemetry=False, metrics=registry,
+    )
+    start = time.perf_counter()
+    submitted = 0
+    collected = 0
+    while collected < N_REQUESTS:
+        for _ in range(4):
+            if submitted < N_REQUESTS:
+                svc.submit(_request(submitted))
+                submitted += 1
+        svc.pump(force=submitted >= N_REQUESTS)
+        for rid in sorted(
+            (r for r in svc.ready_rids() if svc.result_ready(r)),
+            reverse=True,
+        ):
+            svc.collect(rid)
+            collected += 1
+    return time.perf_counter() - start, svc
+
+
+def main() -> int:
+    backend = jax.default_backend()
+    if backend != "cpu":
+        print(
+            f"# bench_metrics_overhead: cpu-family rows; backend is "
+            f"{backend!r} — skipping"
+        )
+        return 0
+
+    failures = 0
+    off = metricslib.MetricsRegistry(enabled=False)
+    on = metricslib.MetricsRegistry()
+
+    # Warm the full bucket lattice (every capacity x rung x segment
+    # shape the mix can dispatch) before timing — compiles are a
+    # one-time cost the lattice bounds, not registry overhead.
+    _serve_mix(off)
+
+    t_off = t_on = float("inf")
+    lag_ms: list = []
+    for _ in range(REPS):
+        w, _svc = _serve_mix(off)
+        t_off = min(t_off, w)
+        on.reset()
+        w, svc_on = _serve_mix(on)
+        t_on = min(t_on, w)
+        # The lag sample set accumulates across ON reps — more
+        # requests under the per-request self-gate, same mix.
+        lag_ms.extend(svc_on.ttfr_lag_ms)
+    overhead = max(0.0, 100.0 * (t_on - t_off) / t_off)
+
+    # --- live-surface acceptance ------------------------------------
+    admit = on.get("serve_admissions_total")
+    assert admit is not None and sum(
+        s["value"] for s in admit.samples()
+    ) == N_REQUESTS, "admissions counter disagrees with the mix size"
+    ttfr_hist = on.get("slo_ttfr_ms")
+    assert ttfr_hist is not None and ttfr_hist.samples(), (
+        "TTFR histogram recorded nothing on the enabled pass"
+    )
+    launches = on.get("serve_dispatch_launches_total")
+    assert launches is not None and launches.samples(), (
+        "dispatch-launch counter recorded nothing"
+    )
+    for inst_name in (
+        "serve_admissions_total", "slo_ttfr_ms",
+        "serve_dispatch_launches_total",
+    ):
+        inst = off.get(inst_name)
+        assert inst is None or not inst.samples(), (
+            f"disabled registry recorded {inst_name}"
+        )
+
+    # --- ttfr observation lag (device callback vs host poll) --------
+    # Every request of the ON passes ran with device callbacks (the
+    # service default): each sample is host-poll observation minus
+    # device-callback stamp, clamped at 0 in the service — so the
+    # per-request "callback is never later than the poll" contract is
+    # asserted on the RAW stamps here via the sample count: a request
+    # with no callback landing records no sample at all.
+    n_expected = REPS * N_REQUESTS
+    if len(lag_ms) < n_expected:
+        print(
+            f"# SELF-GATE: only {len(lag_ms)}/{n_expected} requests "
+            "carried a device-callback stamp — the callback path "
+            "did not cover the mix",
+            file=sys.stderr,
+        )
+        failures += 1
+    lag_p99 = percentile(lag_ms, 99.0)
+    lag_p50 = percentile(lag_ms, 50.0)
+
+    print(
+        f"# metrics overhead ({N_REQUESTS} requests, {backend}): off "
+        f"{t_off:.2f}s, on {t_on:.2f}s -> {overhead:.2f}% (bar <= "
+        f"5%); ttfr observation lag p50 {lag_p50:.2f} ms / p99 "
+        f"{lag_p99:.2f} ms over {len(lag_ms)} requests (ceiling "
+        f"50 ms)"
+    )
+    report(
+        "metrics-overhead-pct, 60 requests streamed mix (cpu)",
+        overhead, "pct", 0.0,
+    )
+    report(
+        "ttfr-observation-lag-ms, 60 requests streamed mix (cpu)",
+        lag_p99, "lag-ms", 0.0,
+    )
+
+    run_dir = os.environ.get("DSA_RUN_DIR")
+    if run_dir:
+        # The live deposit becomes a run artifact: `swarmscope live
+        # runs/<rNN>` renders the final snapshot trajectory from it.
+        path = on.deposit(run_dir)
+        print(f"# metrics_live deposit: {path}")
+
+    # --- self-gates --------------------------------------------------
+    if overhead > 5.0:
+        print(
+            f"# SELF-GATE: metrics overhead {overhead:.2f}% > the "
+            "5% ceiling — an observation site grew a real cost",
+            file=sys.stderr,
+        )
+        failures += 1
+    if lag_p99 > 50.0:
+        print(
+            f"# SELF-GATE: ttfr observation lag p99 {lag_p99:.2f} ms "
+            "> the 50 ms ceiling — first-result observation "
+            "re-coupled to the pump",
+            file=sys.stderr,
+        )
+        failures += 1
+    return 2 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -253,6 +253,19 @@ def main() -> int:
           f"{slo['evictions']} evicted, filler "
           f"{100 * slo['filler_fraction']:.1f}%, "
           f"{n_ooo} multi-ready collect rounds)")
+    # r19: the soak runs with device-callback first-result stamping
+    # (the service default) — the gated TTFR rows below measure the
+    # device-stamped time; the observation lag the host-poll design
+    # added is its own gated row in bench_metrics_overhead.py.
+    lags = svc.ttfr_lag_ms
+    if lags:
+        from distributed_swarm_algorithm_tpu.utils.telemetry import (
+            percentile,
+        )
+
+        print(f"# ttfr stamps: {len(lags)} device-callback stamped, "
+              f"observation lag p50 {percentile(lags, 50.0):.2f} / "
+              f"p99 {percentile(lags, 99.0):.2f} ms")
 
     # --- parity under queueing: sampled full + evicted-prefix -------
     for rid, res in full_kept.items():

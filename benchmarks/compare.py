@@ -46,7 +46,13 @@ own ABSOLUTE ceiling OVERHEAD_PCT_CEILING (200%) — the value is a
 ratio of two small wall times on a loaded rig, so BOTH relative
 growth gating and the 5% bar would flap on load noise, while the
 structural claim ("auto-reset costs less than two baseline
-rollouts") is deterministic.  Records with
+rollouts") is deterministic; unit "lag-ms" (the TTFR observation
+lag, r19 — host-poll stamp minus device-callback stamp) gates
+against its own ABSOLUTE ceiling LAG_MS_CEILING (50 ms): the healthy
+value is a few ms of pump cadence where relative gating is pure
+noise, and the regression class it exists for — first-result
+observation re-coupling to a stalled/serialized pump — lands at
+segment-duration scale (hundreds of ms).  Records with
 value null (structured failure lines) are never merged into the
 history.  The gating rules are mirrored in
 ``distributed_swarm_algorithm_tpu/utils/rundir.py`` (the swarmscope
@@ -77,6 +83,12 @@ PCT_CEILING = 5.0
 #: everything), so only crossing this ceiling is a regression
 #: (mirrors bench_env.py's self-gate).
 OVERHEAD_PCT_CEILING = 200.0
+
+#: Absolute ceiling for unit-"lag-ms" metrics (r19, the TTFR
+#: observation lag): healthy values are a few ms of pump cadence;
+#: the failure class (observation re-coupled to a stalled pump)
+#: sits at segment scale, hundreds of ms.
+LAG_MS_CEILING = 50.0
 
 
 def norm_key(metric: str) -> str:
@@ -202,24 +214,29 @@ def compare(prev_label: str, cur_label: str, threshold: float = 0.2,
             print(f"{status:>10}  {cv:6.0f}   {cur[key][0]}"
                   f"  (count {pv:.0f} -> {cv:.0f})")
             continue
-        if unit in ("pct", "overhead-pct"):
+        if unit in ("pct", "overhead-pct", "lag-ms"):
             # Lower-is-better against an ABSOLUTE ceiling (module
             # doc): "pct" lives near 0% (telemetry overhead — the
             # documented 5% bar), "overhead-pct" near 100% (the env
-            # auto-reset select — the 200% structural bar); in both
-            # regimes relative growth gating is load noise.
-            ceiling = (
-                PCT_CEILING if unit == "pct" else OVERHEAD_PCT_CEILING
-            )
+            # auto-reset select — the 200% structural bar),
+            # "lag-ms" near pump cadence (the 50 ms observation-lag
+            # bar); in all three regimes relative growth gating is
+            # load noise.
+            ceiling = {
+                "pct": PCT_CEILING,
+                "overhead-pct": OVERHEAD_PCT_CEILING,
+                "lag-ms": LAG_MS_CEILING,
+            }[unit]
+            suffix = "ms" if unit == "lag-ms" else "%"
             status = "ok"
             if cv > ceiling:
                 status = "REGRESSION"
                 regressions.append((key, pv, cv, cv / max(pv, 1.0)))
             elif cv < pv:
                 status = "improved"
-            print(f"{status:>10}  {cv:6.1f}%  {cur[key][0]}"
-                  f"  ({pv:.2f}% -> {cv:.2f}%, ceiling "
-                  f"{ceiling:.0f}%)")
+            print(f"{status:>10}  {cv:6.1f}{suffix}  {cur[key][0]}"
+                  f"  ({pv:.2f}{suffix} -> {cv:.2f}{suffix}, ceiling "
+                  f"{ceiling:.0f}{suffix})")
             continue
         if pv <= 0:
             continue

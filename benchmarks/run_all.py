@@ -128,6 +128,13 @@ BENCHES = [
     # the identical tracing-off pass, and the traced pass asserts the
     # full >= 5-kind span taxonomy per request.
     "bench_trace_overhead.py",
+    # r19: metrics-registry overhead on the streamed mix — the
+    # fixed-name metrics-overhead-pct row (unit "pct", absolute 5%
+    # ceiling) plus the ttfr-observation-lag-ms row (unit "lag-ms",
+    # absolute 50 ms ceiling): device-callback first-result stamp vs
+    # the host-poll observation, per request; self-gates both
+    # ceilings and full callback coverage of the mix (exit 2).
+    "bench_metrics_overhead.py",
     # r18: 2D-mesh serving on the 8-vdev rig — scenario-axis sharded
     # service throughput vs the same-run single-device row (self-
     # gated >= 1.5x with bitwise per-tenant parity, exit 2), the
@@ -192,6 +199,9 @@ QUICK_SKIP = {
     # r17: three full streamed 60-request passes (warm + off + on)
     # compile the whole serve lattice — full gate only.
     "bench_trace_overhead.py",
+    # r19: same shape as bench_trace_overhead (warm + interleaved
+    # off/on reps over the full lattice) — full gate only.
+    "bench_metrics_overhead.py",
     # r18: six full 256-scenario service passes (warm + 2x timed per
     # plane) plus the jumbo mix — minutes on the 2-core rig, full
     # gate only.

@@ -11,6 +11,15 @@ and the serve hot-loop host-sync contract.
   (the r5 bench_recovery f-string) silently drops the metric from the
   cross-round union gate — the regression tracker matches on the
   exact string.
+- ``metric-label``: metric NAMES or label-schema elements handed to
+  the r19 metrics-registry registration calls
+  (``counter()``/``gauge()``/``histogram()``) must be string
+  literals.  A formatted name (f-string, ``.format``, ``+``
+  concatenation, ``%``) registers one metric FAMILY per distinct
+  runtime value — unbounded cardinality on a process-lifetime
+  registry, and every family lands outside the declared taxonomy
+  (docs/OBSERVABILITY.md).  The registry's MAX_SERIES bound catches
+  the runtime half; this rule catches it at the source.
 - ``serve-host-sync``: a host sync (``jax.block_until_ready`` /
   ``jax.device_get`` / ``.item()`` / ``np.asarray``-family) reachable
   from a ``serve/`` HOT-LOOP method — any function whose name carries
@@ -27,6 +36,7 @@ and the serve hot-loop host-sync contract.
 from __future__ import annotations
 
 import ast
+from typing import Optional
 
 from .core import ModuleInfo, Rule, register
 
@@ -149,6 +159,106 @@ class MetricStringRule(Rule):
                 f"metric name is a {kind} — the union gate matches "
                 "exact strings; use a literal",
             )
+
+
+# ---------------------------------------------------------------------------
+# metric-label (r19)
+
+#: The registry's registration methods (utils/metrics.py).  Only
+#: ATTRIBUTE calls count (``reg.counter(...)``): a bare-name
+#: ``histogram(...)`` is some other library's function, and
+#: ``jnp.histogram``/``np.histogram`` pass data positionally — their
+#: first arg is a Name, which this rule deliberately never flags (a
+#: Name cannot be PROVEN a formatted string; only explicit
+#: string-formatting expressions are).
+_REGISTRY_METHODS = frozenset({"counter", "gauge", "histogram"})
+
+
+def _is_formatted_string(node: ast.expr) -> Optional[str]:
+    """The kind of runtime string formatting ``node`` performs, or
+    None when it is not provably a formatted string.  Literal-safe by
+    construction: plain Names, attribute reads, and literal constants
+    all return None."""
+    if isinstance(node, ast.JoinedStr):
+        return "f-string"
+    if isinstance(node, ast.Call) and isinstance(
+        node.func, ast.Attribute
+    ) and node.func.attr == "format":
+        return ".format() call"
+    if isinstance(node, ast.BinOp):
+        if isinstance(node.op, ast.Mod) and isinstance(
+            node.left, ast.Constant
+        ) and isinstance(node.left.value, str):
+            return "%-formatting"
+        if isinstance(node.op, ast.Add):
+            for side in (node.left, node.right):
+                if (
+                    isinstance(side, ast.Constant)
+                    and isinstance(side.value, str)
+                ) or isinstance(side, ast.JoinedStr):
+                    return "string concatenation"
+    return None
+
+
+@register
+class MetricLabelRule(Rule):
+    id = "metric-label"
+    summary = "formatted metric name/label in a registry registration"
+    details = (
+        "utils/metrics.py registration calls (.counter/.gauge/"
+        ".histogram) fix a metric's name and label SCHEMA for the "
+        "process lifetime; an f-string/format/concatenated/%-"
+        "formatted name (or label-tuple element) mints one metric "
+        "family per runtime value — unbounded registry cardinality, "
+        "and every minted family falls outside the declared taxonomy "
+        "the live dashboard and the exposition render.  Pass string "
+        "literals; runtime variation belongs in label VALUES at the "
+        "observation site, drawn from a design-bounded set."
+    )
+
+    def check(self, mod: ModuleInfo):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            if node.func.attr not in _REGISTRY_METHODS:
+                continue
+            name = node.args[0] if node.args else None
+            for kw in node.keywords:
+                if kw.arg == "name":
+                    name = kw.value
+            if name is not None:
+                kind = _is_formatted_string(name)
+                if kind is not None:
+                    yield mod.finding(
+                        self.id, name,
+                        f"metric name is built by {kind} — one "
+                        "registered family per runtime value; the "
+                        "registry taxonomy is fixed strings",
+                    )
+            # The label schema may arrive as labels= OR positionally
+            # (3rd arg to counter/gauge, 4th to histogram after
+            # buckets) — check every candidate tuple/list the same
+            # way; float bucket literals can never read as formatted
+            # strings, so histogram's buckets arg is inert here.
+            label_args = [
+                kw.value for kw in node.keywords
+                if kw.arg == "labels"
+            ] + list(node.args[2:])
+            for labels in label_args:
+                if not isinstance(labels, (ast.Tuple, ast.List)):
+                    continue
+                for el in labels.elts:
+                    kind = _is_formatted_string(el)
+                    if kind is not None:
+                        yield mod.finding(
+                            self.id, el,
+                            f"label name is built by {kind} — the "
+                            "label SCHEMA is fixed at registration; "
+                            "runtime variation belongs in label "
+                            "values",
+                        )
 
 
 # ---------------------------------------------------------------------------

@@ -1057,6 +1057,181 @@ def _cmd_scope_trace(args) -> int:
     return 0
 
 
+def _metric_total(metric) -> float:
+    """Sum of one snapshot metric's samples (counters/gauges)."""
+    if metric is None:
+        return 0.0
+    return float(sum(s["value"] for s in metric.get("samples", ())))
+
+
+def _cmd_scope_live(args) -> int:
+    """``swarmscope live RUN``: the live operational view of a
+    serving process (r19) — renders the ``metrics_live/`` snapshot
+    deposits a running ``StreamingService`` appends each pump
+    interval: alert counters, admissions/releases, rung occupancy,
+    queue-depth/in-flight sparklines over the deposit trajectory, and
+    TTFR percentile sparklines from the binned latency histograms.
+    ``--follow`` re-reads and re-renders until interrupted (the
+    `tail -f` of the metrics plane); one-shot by default."""
+    import glob
+    import os
+    import time as _time
+
+    from .utils import metrics as metricslib
+
+    def _files():
+        if os.path.isfile(args.run):
+            return [args.run]
+        return sorted(
+            glob.glob(
+                os.path.join(
+                    args.run, metricslib.METRICS_LIVE_DIR, "*.jsonl"
+                )
+            )
+        )
+
+    def _render() -> bool:
+        files = _files()
+        printed = False
+        for path in files:
+            snapshots = metricslib.read_snapshots(path)
+            if not snapshots:
+                continue
+            printed = True
+            latest = {
+                m["name"]: m
+                for m in snapshots[-1].get("metrics", ())
+            }
+            span_s = (
+                snapshots[-1].get("t_ms", 0.0)
+                - snapshots[0].get("t_ms", 0.0)
+            ) / 1e3
+            print(
+                f"live [{os.path.basename(path)}]  "
+                f"{len(snapshots)} snapshot(s) over {span_s:.1f}s"
+            )
+            admit = _metric_total(latest.get("serve_admissions_total"))
+            rel = latest.get("serve_releases_total")
+            reasons = ", ".join(
+                f"{s['labels'].get('reason', '?')} "
+                f"{s['value']:.0f}"
+                for s in (rel or {}).get("samples", ())
+            )
+            print(
+                f"  admitted {admit:.0f}  released by "
+                f"{{{reasons or 'none'}}}"
+            )
+            alerts = {
+                "deadline-miss": "serve_deadline_miss_total",
+                "queue-overflow": "serve_queue_overflow_total",
+                "eviction": "serve_evictions_total",
+            }
+            counts = {
+                label: _metric_total(latest.get(name))
+                for label, name in alerts.items()
+            }
+            print("  alerts: " + ", ".join(
+                f"{k} x{v:.0f}" for k, v in sorted(counts.items())
+            ))
+            # Per-rung occupancy from the row counters: the live twin
+            # of the slo summary's rung table.
+            rows = latest.get("serve_dispatch_rows_total")
+            real = latest.get("serve_dispatch_real_rows_total")
+            launches = latest.get("serve_dispatch_launches_total")
+            if rows is not None:
+                real_by = {
+                    s["labels"].get("rung", "-"): s["value"]
+                    for s in (real or {}).get("samples", ())
+                }
+                n_by = {
+                    s["labels"].get("rung", "-"): s["value"]
+                    for s in (launches or {}).get("samples", ())
+                }
+                for s in rows.get("samples", ()):
+                    rung = s["labels"].get("rung", "-")
+                    total = s["value"]
+                    filler = (
+                        100.0 * (total - real_by.get(rung, 0.0)) / total
+                        if total else 0.0
+                    )
+                    print(
+                        f"    rung {rung:<14} dispatches "
+                        f"{n_by.get(rung, 0.0):>5.0f}  filler "
+                        f"{filler:.1f}%"
+                    )
+            # Trajectories over the deposit sequence: gauges read
+            # directly, percentiles re-derived per snapshot from the
+            # cumulative histogram (a running-percentile view).
+            for name, label in (
+                ("serve_queue_depth", "queue depth"),
+                ("serve_in_flight", "in flight"),
+            ):
+                series = [
+                    _metric_total(m) for m in
+                    metricslib.snapshot_series(snapshots, name)
+                ]
+                if series:
+                    print(
+                        f"  {label:<12} [{min(series):.0f}.."
+                        f"{max(series):.0f}]  {_spark(series)}"
+                    )
+            hist_series = metricslib.snapshot_series(
+                snapshots, "slo_ttfr_ms"
+            )
+            if hist_series:
+                # inf = the percentile blew past the histogram's last
+                # declared edge: render pinned AT that edge with a
+                # loud marker — never filtered (a dashboard must not
+                # read green during the worst regime; the metrics
+                # module's own "outside the envelope must gate, not
+                # flatter" contract).
+                top = max(
+                    (m.get("buckets") or [0.0])[-1]
+                    for m in hist_series
+                )
+                for q, qlabel in ((50.0, "ttfr p50"), (99.0, "ttfr p99")):
+                    vals = [
+                        metricslib.histogram_percentile(m, q)
+                        for m in hist_series
+                    ]
+                    blown = vals[-1] == float("inf")
+                    vals = [
+                        top if v == float("inf") else v for v in vals
+                    ]
+                    now = (
+                        f">{top:.0f} ms PAST-ENVELOPE" if blown
+                        else f"{vals[-1]:8.1f} ms"
+                    )
+                    print(
+                        f"  {qlabel:<12} now {now}  {_spark(vals)}"
+                    )
+        return printed
+
+    if not args.follow:
+        if not _render():
+            print(
+                f"no live metrics under {args.run!r} (expected "
+                f"<run>/{metricslib.METRICS_LIVE_DIR}/*.jsonl — a "
+                "StreamingService deposits them each pump interval "
+                "when DSA_RUN_DIR is set and its metrics registry is "
+                "enabled, e.g. DSA_METRICS=1)",
+                file=sys.stderr,
+            )
+            return 1
+        return 0
+    try:
+        while True:
+            if not _render():
+                print(
+                    f"# waiting for {metricslib.METRICS_LIVE_DIR}/ "
+                    f"deposits under {args.run!r} ..."
+                )
+            print(f"--- ({args.interval:.0f}s; ctrl-c to stop)")
+            _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def _cmd_jaxlint(args) -> int:
     """``jaxlint``: the trace/HLO-level program auditor (r15) —
     lower every ``compile_watch.watched()`` registry entry (no
@@ -1476,6 +1651,25 @@ def build_parser() -> argparse.ArgumentParser:
              "whose *.trace.json(.gz) exports merge into --export",
     )
     p_st.set_defaults(fn=_cmd_scope_trace)
+    p_lv = scope_sub.add_parser(
+        "live",
+        help="render (or --follow) a running service's live metrics "
+             "deposits (r19): alert counters, rung occupancy, "
+             "queue-depth and TTFR-percentile sparklines from "
+             "<run>/metrics_live/*.jsonl",
+    )
+    p_lv.add_argument(
+        "run",
+        help="run directory (reads <run>/metrics_live/*.jsonl) or "
+             "one deposit file",
+    )
+    p_lv.add_argument(
+        "--follow", action="store_true",
+        help="re-render every --interval seconds until interrupted",
+    )
+    p_lv.add_argument("--interval", type=float, default=2.0,
+                      help="--follow refresh period (seconds)")
+    p_lv.set_defaults(fn=_cmd_scope_live)
 
     # Convergence-history flags for every single-objective optimizer
     # subcommand (utils/history.py; see _run_report).

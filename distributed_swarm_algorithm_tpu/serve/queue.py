@@ -28,6 +28,7 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from ..utils import metrics as metricslib
 from ..utils.trace import QUEUE_SPAN, TRACER, SpanTracer
 from .batched import ScenarioRequest
 from .buckets import BucketSpec
@@ -71,6 +72,7 @@ class AdmissionQueue:
         deadline_s: float,
         clock=time.monotonic,
         tracer: Optional[SpanTracer] = None,
+        metrics: Optional[metricslib.MetricsRegistry] = None,
     ):
         if deadline_s <= 0:
             raise ValueError(
@@ -85,6 +87,24 @@ class AdmissionQueue:
         #: emission shares the queue's clock (= the SLO tracker's) so
         #: span edges and latency stamps agree.
         self.tracer = TRACER if tracer is None else tracer
+        #: Live metrics (r19): admissions by capacity rung (the label
+        #: set is bounded by the spec's lattice) and releases by the
+        #: POLICY that freed them — "rung-full" (the zero-pad fast
+        #: path), "deadline" (oldest entry expired), "force" (drain),
+        #: "targeted" (a blocking collect released one group).  The
+        #: reason split is what the aggregate release count hides: a
+        #: deadline-dominated stream is paying filler for its ladder
+        #: (ROADMAP item 2a), a rung-full-dominated one is healthy.
+        self.metrics = metricslib.METRICS if metrics is None else metrics
+        self._m_admit = self.metrics.counter(
+            "serve_admissions_total",
+            "Requests admitted to the queue", labels=("cap",),
+        )
+        self._m_release = self.metrics.counter(
+            "serve_releases_total",
+            "Requests released to dispatch, by release policy",
+            labels=("reason",),
+        )
         #: (capacity, n_tasks) -> FIFO of QueuedRequest.
         self._groups: Dict[tuple, List[QueuedRequest]] = {}
 
@@ -100,6 +120,7 @@ class AdmissionQueue:
             submit_t=now, deadline_t=now + self.deadline_s,
         )
         self._groups.setdefault(entry.key, []).append(entry)
+        self._m_admit.inc(cap=capacity)
         return entry
 
     def remove(self, rid: int) -> bool:
@@ -157,13 +178,16 @@ class AdmissionQueue:
             while len(group) >= largest:
                 out.append((key, group[:largest], largest))
                 del group[:largest]
+                self._m_release.inc(largest, reason="rung-full")
             if group and (force or now >= group[0].deadline_t):
+                reason = "force" if force else "deadline"
                 for size in self.spec.split_batch(
                     len(group), capacity
                 ):
                     take = group[: min(size, len(group))]
                     del group[: len(take)]
                     out.append((key, take, size))
+                    self._m_release.inc(len(take), reason=reason)
         self._groups = {k: g for k, g in self._groups.items() if g}
         for key, entries, _ in out:
             self._emit_release(key, entries, now)
@@ -182,6 +206,7 @@ class AdmissionQueue:
             take = group[: min(size, len(group))]
             del group[: len(take)]
             out.append((key, take, size))
+            self._m_release.inc(len(take), reason="targeted")
         now = self.clock()
         for k, entries, _ in out:
             self._emit_release(k, entries, now)

@@ -54,8 +54,10 @@ the count as a fixed-name "compiles" row.
 
 from __future__ import annotations
 
+import itertools
+import threading
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -63,6 +65,7 @@ import numpy as np
 
 from ..state import SwarmState
 from ..utils import compile_watch
+from ..utils import metrics as metricslib
 from ..utils.config import DEFAULT_CONFIG, SwarmConfig
 from ..utils.trace import (
     COALESCE_SPAN,
@@ -105,6 +108,75 @@ from .slo import DEFAULT_DEADLINE_S, SloTracker
 #: contract is already budgeted; the service only declares the bucket
 #: count for its segment schedule).
 JUMBO_ENTRY = "swarm-rollout-spatial"
+
+
+# ---------------------------------------------------------------------------
+# Device-callback first-result stamping (r19, ROADMAP item 2b).
+#
+# The r16 probe is HOST-POLLED: `pump` asks `is_ready()` once per
+# cycle, so the observed TTFR is quantized to the pump cadence — a
+# result that lands between pumps waits for the next one to be seen.
+# Here the device itself stamps: segment 1's tick leaf routes through
+# a tiny jitted copy whose `jax.debug.callback` fires ON COMPLETION
+# (the callback's operands depend on the segment-1 output, so the
+# runtime cannot run it earlier), recording the request's clock time
+# into a token registry the next `_harvest` drains.  The donated-carry
+# path is untouched — the probe copy was ALWAYS an independent buffer
+# outside the rotation — and the rollout arithmetic is untouched (the
+# callback only observes), so results stay bitwise-identical with
+# callbacks on (pinned in tests/test_metrics.py).
+#
+# Callback-OFF is the r10 gate discipline: the probe reverts to the
+# LITERAL pre-r19 `jnp.copy(states.tick)` expression — no extra
+# program exists to lower, so the disabled service's compiled set is
+# byte-identical to the r16 service (also pinned).
+#
+# The token registry is module-level and lock-guarded because the
+# callback runs on the runtime's thread, not the pump's: the callback
+# touches ONLY these two dicts (never the tracker), and the pump
+# applies the stamp single-threadedly at the next harvest.
+
+_PROBE_TOKENS = itertools.count()
+_PROBE_LOCK = threading.Lock()
+#: token -> request-clock time the device finished segment 1.
+_PROBE_LANDED: Dict[int, float] = {}
+#: token -> the stream's SLO clock (registered at launch, consumed by
+#: the callback; popped on harvest/cleanup so neither dict outlives
+#: its stream).
+_PROBE_CLOCKS: Dict[int, Callable[[], float]] = {}
+
+
+def _probe_landed_cb(token, _tick) -> None:
+    """The device-side completion callback: one dict write under the
+    lock.  ``_tick`` is the segment-1 output leaf — unused, but its
+    presence as an operand is the data dependency that pins the
+    callback AFTER the segment's computation."""
+    tok = int(token)
+    with _PROBE_LOCK:
+        clock = _PROBE_CLOCKS.pop(tok, None)
+        if clock is not None:
+            _PROBE_LANDED[tok] = float(clock())
+
+
+@jax.jit
+def _probe_stamp(tick, token):
+    """Segment-1 probe WITH the completion callback: the same
+    independent copy as the host-poll path, plus the observation
+    effect.  ``token`` is a traced i32 scalar (a fresh Python int per
+    dispatch would be a fresh constant — a retrace per dispatch)."""
+    jax.debug.callback(_probe_landed_cb, token, tick)
+    return jnp.copy(tick)
+
+
+def _probe_cleanup(token: Optional[int]) -> None:
+    """Drop a stream's token from both registries (collected or
+    abandoned before its harvest): the dicts are bounded by what is
+    in flight, the r13 result-store discipline."""
+    if token is None:
+        return
+    with _PROBE_LOCK:
+        _PROBE_CLOCKS.pop(token, None)
+        _PROBE_LANDED.pop(token, None)
 
 
 def unshard_spatial_state(state: SwarmState, n: int) -> SwarmState:
@@ -500,7 +572,11 @@ class _Stream:
         self.telem_segs: List = []               # [seg_len, S] leaves
         self.traj_segs: List = []                # [seg_len, S, C, D]
         self.probe = None                        # independent tick copy
+        self.probe_token: Optional[int] = None   # r19 callback token
         self.first_stamped = False
+        #: Clock time of this stream's latest segment launch — the
+        #: rotation-interval histogram's left edge (r19).
+        self.last_launch_t: Optional[float] = None
         self.evict_flags: Set[int] = set()
         #: rid -> (ticks_elapsed, device state view, n_telem_segs)
         self.evicted: Dict[int, tuple] = {}
@@ -664,6 +740,8 @@ class StreamingService:
         tracer: Optional[SpanTracer] = None,
         mesh=None,
         jumbo_cfg: Optional[SwarmConfig] = None,
+        metrics: Optional[metricslib.MetricsRegistry] = None,
+        first_result_callback: bool = True,
     ):
         self.cfg = validate_serve_config(cfg or DEFAULT_CONFIG)
         self.spec = spec or BucketSpec()
@@ -737,7 +815,60 @@ class StreamingService:
         self.telemetry = bool(telemetry) or self.cfg.telemetry.enabled
         self.record = bool(record)
         self.max_queue = max_queue
-        self.slo = slo or SloTracker(deadline_s=deadline_s)
+        # Live metrics plane (r19): ONE registry feeds the tracker's
+        # latency histograms / alert counters, the queue's admission
+        # counters, and the service's own rotation instruments below
+        # — split registries would scrape as traffic with no latency
+        # and no alerts, so a conflicting injection fails loudly.
+        if (
+            metrics is not None and slo is not None
+            and slo.metrics is not metrics
+        ):
+            raise ValueError(
+                "StreamingService(slo=, metrics=) received a tracker "
+                "bound to a DIFFERENT registry — the alert-parity "
+                "contract needs one instrument plane; construct the "
+                "tracker with SloTracker(metrics=...) or drop the "
+                "metrics= argument"
+            )
+        if metrics is not None:
+            self.metrics = metrics
+        elif slo is not None:
+            self.metrics = slo.metrics
+        else:
+            self.metrics = metricslib.METRICS
+        self.slo = slo or SloTracker(
+            deadline_s=deadline_s, metrics=self.metrics
+        )
+        self._m_rotations = self.metrics.counter(
+            "serve_segment_rotations_total",
+            "Segment launches past each stream's first",
+        )
+        self._m_segment_wall = self.metrics.histogram(
+            "serve_segment_wall_ms",
+            "Wall-clock between successive segment launches of one "
+            "stream (the pipelined segment's wall time under a busy "
+            "pump; pump cadence bounds it from below on an idle one)",
+        )
+        #: Device-callback first-result stamping (r19, ROADMAP 2b) —
+        #: see the module-level probe machinery.  Applies to
+        #: single-device scenario streams; mesh-committed carries
+        #: (sharded/jumbo) keep the host-poll probe — a cross-device
+        #: callback gather on the serving path is exactly the class
+        #: of hidden transfer the serve-host-sync lint exists for.
+        self.first_result_callback = bool(first_result_callback)
+        #: Observation-lag samples (ms), one per request whose first
+        #: result carried BOTH stamps: host-poll observation minus
+        #: device-callback stamp — what the poll-only design was
+        #: adding to observed TTFR (the bench_metrics_overhead row).
+        #: Bounded like the SLO gauge trajectory: past the bound the
+        #: stored samples decimate 2x and the keep-stride doubles, so
+        #: a weeks-long service holds a full-span (coarser) sample in
+        #: O(1) memory instead of one float per request ever served.
+        self.ttfr_lag_ms: List[float] = []
+        self._lag_stride = 1
+        self._lag_skip = 0
+        self._max_lag_samples = 4096
         #: Same injectable registry as RolloutService; the admission
         #: queue shares it (and the SLO clock), so its retrospective
         #: queue-wait spans land on the same timeline as the dispatch
@@ -751,7 +882,7 @@ class StreamingService:
             self.slo.memory_probe = device_memory_watermark
         self.queue = AdmissionQueue(
             self.spec, deadline_s, clock=self.slo.clock,
-            tracer=self.tracer,
+            tracer=self.tracer, metrics=self.metrics,
         )
         self._next_rid = 0
         self._streams: Dict[int, _Stream] = {}   # uncollected rids
@@ -863,6 +994,10 @@ class StreamingService:
         advanced = self._advance()
         self._harvest()
         self.slo.sample(self.queue.depth, self.n_in_flight)
+        # The live surface: one snapshot line per deposit interval
+        # when a run dir is configured (swarmscope live follows it);
+        # a clock read + compare otherwise.
+        self.metrics.maybe_deposit()
         return {"launched": launched, "advanced": advanced}
 
     def _admit(self, force: bool = False) -> int:
@@ -980,6 +1115,8 @@ class StreamingService:
                 # rotation (a jumbo stream would otherwise keep
                 # burning the whole tiles axis on discarded work).
                 s.abandoned = True
+                _probe_cleanup(s.probe_token)
+                s.probe_token = None
                 continue
             first = s.seg_done == 0
             if first:
@@ -988,6 +1125,14 @@ class StreamingService:
                 # trace+compile belongs to TTFR (the tenant pays it),
                 # not to the queue.
                 self.slo.on_launch(s.rids)
+            else:
+                self._m_rotations.inc()
+            now = self.slo.clock()
+            if s.last_launch_t is not None:
+                self._m_segment_wall.observe(
+                    1e3 * (now - s.last_launch_t)
+                )
+            s.last_launch_t = now
             seg_len = s.seg_plan[s.seg_done]
             # Segment 1's dispatch is the LAUNCH span (TTFR's compute
             # edge); later rotations are SEGMENT spans — together the
@@ -1039,8 +1184,29 @@ class StreamingService:
                 # tiny leaf of segment 1's output (the carry itself
                 # is donated into segment 2), harvested once it is
                 # observable — TTFR is a real observation, not a
-                # dispatch-time guess.
-                s.probe = jnp.copy(states.tick)
+                # dispatch-time guess.  With callbacks on (r19) the
+                # copy routes through _probe_stamp so the DEVICE
+                # stamps completion; off (or on a mesh-committed
+                # carry) it is the literal pre-r19 expression.
+                if (
+                    self.first_result_callback
+                    and not s.sharded and not s.jumbo
+                ):
+                    # Wrapped to the i32 domain the traced scalar
+                    # rides in: only in-flight tokens must be unique,
+                    # and 2^31 concurrent streams is not a regime —
+                    # the unbounded count would otherwise overflow
+                    # jnp.asarray(..., int32) on a weeks-long
+                    # service.
+                    token = next(_PROBE_TOKENS) % (2 ** 31)
+                    with _PROBE_LOCK:
+                        _PROBE_CLOCKS[token] = self.slo.clock
+                    s.probe_token = token
+                    s.probe = _probe_stamp(
+                        states.tick, jnp.asarray(token, jnp.int32)
+                    )
+                else:
+                    s.probe = jnp.copy(states.tick)
             n += 1
         return n
 
@@ -1061,9 +1227,40 @@ class StreamingService:
             if observable:
                 # swarmlint: disable=serve-host-sync -- the probe is already finished (is_ready above) or a host array; the read cannot stall the pump
                 np.asarray(s.probe)
-                self.slo.on_first_result(s.rids)
+                now = self.slo.clock()
+                cb_t = None
+                if s.probe_token is not None:
+                    with _PROBE_LOCK:
+                        cb_t = _PROBE_LANDED.pop(s.probe_token, None)
+                        _PROBE_CLOCKS.pop(s.probe_token, None)
+                    s.probe_token = None
+                if cb_t is not None:
+                    # The device stamped completion (r19): TTFR
+                    # measures the device, and the poll-vs-callback
+                    # delta is the observation lag the host-poll
+                    # design was charging every request.
+                    self.slo.on_first_result(s.rids, t=cb_t)
+                    lag = max(0.0, 1e3 * (now - cb_t))
+                    self._record_lag(lag, len(s.rids))
+                else:
+                    self.slo.on_first_result(s.rids, t=now)
                 self.tracer.instant(HARVEST_EVENT, rids=s.rids)
                 s.first_stamped = True
+
+    def _record_lag(self, lag_ms: float, n: int) -> None:
+        """Keep the observation-lag sample list bounded (the
+        SloTracker gauge-decimation discipline): drop samples by the
+        current stride, halve the store and double the stride at the
+        bound."""
+        for _ in range(n):
+            self._lag_skip += 1
+            if self._lag_skip < self._lag_stride:
+                continue
+            self._lag_skip = 0
+            self.ttfr_lag_ms.append(lag_ms)
+        if len(self.ttfr_lag_ms) > self._max_lag_samples:
+            self.ttfr_lag_ms = self.ttfr_lag_ms[::2]
+            self._lag_stride *= 2
 
     # -- eviction / join ---------------------------------------------------
     def evict(self, rid: int) -> bool:
@@ -1166,11 +1363,29 @@ class StreamingService:
         self.pump(force=True)
         while any(not s.done for s in self._live):
             self.pump()
-        return {rid: self.collect(rid) for rid in self.ready_rids()}
+        out = {rid: self.collect(rid) for rid in self.ready_rids()}
+        if self.metrics.enabled:
+            # One closing snapshot so the final collects' latency
+            # observations reach the live surface (the cadence gate
+            # only runs inside pump).
+            self.metrics.deposit()
+        return out
 
     def _result_for(self, s: _Stream, rid: int) -> TenantResult:
         req, capacity = self._requests.pop(rid)
         i = s.rids.index(rid)
+        if s.probe_token is not None and not s.first_stamped:
+            # Collected before any harvest observed the probe (a
+            # single-segment plan drained straight through): the
+            # device callback may still have landed — prefer its
+            # stamp over the on_collect backfill.
+            with _PROBE_LOCK:
+                cb_t = _PROBE_LANDED.pop(s.probe_token, None)
+                _PROBE_CLOCKS.pop(s.probe_token, None)
+            s.probe_token = None
+            if cb_t is not None:
+                self.slo.on_first_result(s.rids, t=cb_t)
+                s.first_stamped = True
         with self.tracer.span(COLLECT_SPAN, rid=rid):
             if s.jumbo:
                 if rid in s.evicted:
@@ -1218,7 +1433,11 @@ class StreamingService:
         del self._streams[rid]
         if not any(r in self._streams for r in s.rids):
             # Every tenant of this stream is out: drop the buffers
-            # (result-store eviction, the r13 discipline).
+            # (result-store eviction, the r13 discipline) and any
+            # unharvested probe token (collect backfilled TTFR; the
+            # registry must not outlive the stream).
+            _probe_cleanup(s.probe_token)
+            s.probe_token = None
             try:
                 self._live.remove(s)
             except ValueError:

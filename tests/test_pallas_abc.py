@@ -32,10 +32,17 @@ def test_fused_run_converges_sphere():
     assert float(out.best_fit) <= float(out.fit.min()) + 1e-6
 
 
+@pytest.mark.slow
 def test_fused_matches_portable_regime_on_rastrigin():
     """Bernoulli recruitment + rotational partners must stay in the
     portable path's optimization regime (not bit-equal — different
-    recruitment law)."""
+    recruitment law).
+
+    Slow-marked (r19): 2048x8x200 iterations through BOTH backends is
+    the single heaviest tier-1 test (~44 s on the 2-core rig) against
+    the 870 s budget — the r11 GSPMD-twin precedent.  Tier-1 keeps the
+    fused path's convergence, determinism, padding, and
+    backend-switch pins; the regime twin runs in the full suite."""
     st = abc_init(rastrigin, 2048, 8, HW, seed=1)
     fused = fused_abc_run(st, "rastrigin", 200, half_width=HW,
                           rng="host", interpret=True)

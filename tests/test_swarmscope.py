@@ -152,6 +152,14 @@ def test_gate_semantics_agree_with_compare(tmp_path):
         ("filler-pct", 31.0, 33.0, False),
         ("filler-pct", 31.0, 20.0, False),
         ("filler-pct", 0.0, 5.0, True),
+        # r19 TTFR observation lag: ABSOLUTE 50 ms ceiling (the
+        # healthy value is a few ms of pump cadence — relative
+        # gating there is load noise; the failure class sits at
+        # segment scale), so a big relative jump UNDER the ceiling
+        # does not gate, crossing it always does.
+        ("lag-ms", 2.0, 40.0, False),
+        ("lag-ms", 2.0, 51.0, True),
+        ("lag-ms", 60.0, 3.0, False),
     ]
     for i, (unit, prev, cur, expect) in enumerate(cases):
         assert (

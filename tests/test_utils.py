@@ -161,9 +161,13 @@ def test_cli_reference_compat_flags(capsys):
 
 # ------------------------------------------------------------------ profiling
 
+@pytest.mark.slow
 def test_trace_creates_missing_log_dir(tmp_path):
     # r11 satellite: first use must not fail on a fresh checkout —
     # trace() creates the log dir (including parents) itself.
+    # Slow-marked (r19, the tier-1 870 s budget): the real profiler
+    # capture start/stop costs ~17 s on the 2-core rig; the
+    # annotate/named_scope composition stays tier-1.
     from distributed_swarm_algorithm_tpu.utils.profiling import trace
 
     log_dir = str(tmp_path / "runs" / "nested" / "trace")

@@ -1,0 +1,192 @@
+"""Training-plane throughput + learned-vs-protocol gate (r20, train/).
+
+The workload is the acceptance shape of ISSUE 15: the FOUR-scenario
+zoo (station-keeping / obstacle-field / pursuit-evasion /
+coverage-foraging) x 32 agents trained by shared-parameter IPPO as
+ONE compiled ``train-step`` program — env rollout, GAE, and the
+clipped-surrogate epochs fused, the TrainState carry donated across
+every update.  Pursuit runs the asymmetric capability table
+(train/caps.py: evaders faster but coarser-steering, reward-weighted
+so the shared-policy gradient favors learning to flee) and the env
+carries the r20 Verlet obs plan (``obs_skin``).
+
+Fixed-name rows (cpu family; the script no-ops off-cpu):
+
+  train-env-steps-per-sec, zoo4 x 32 cpu     S * T * updates / wall —
+      the headline fused-training throughput (one env step = one
+      vmapped protocol tick + obs + reward + auto-reset select for
+      all 4 scenarios, INSIDE the train-step program).
+  learned-vs-protocol, <scenario> x 32 cpu   unit "reward-delta"
+      (MILLI-reward, x1000 — the shared report() contract rounds to
+      one decimal and per-step reward deltas live at 1e-2 scale):
+      deterministic learned-policy eval reward minus the zero-action
+      protocol baseline, per zoo scenario, over the SAME episode
+      stream (policy_rollout's key discipline mirrors env_rollout, so
+      a zero net reproduces the baseline exactly).  Positive = the
+      policy beats the protocol it was dropped into.
+
+Self-gates (exit 2): learned >= baseline (within a 2% noise band) on
+>= 2 of the 4 zoo scenarios; the train-step entry stays ONE compiled
+signature; every training metric finite.
+
+Usage: python benchmarks/bench_train.py [--small]
+  --small: fewer updates (the CI-speed smoke of the same shape).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault("DSA_COMPILE_WATCH", "1")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from common import report
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu import envs, train
+from distributed_swarm_algorithm_tpu.utils import compile_watch as cw
+
+N_AGENTS = 32
+N_UPDATES = 300
+EVAL_STEPS = 40
+#: Noise band for the >= gate: deterministic eval on a fixed episode
+#: stream is reproducible, but "learned ties the protocol" must not
+#: flap on float drift.
+TOL_FRAC = 0.02
+
+CFG = dsa.SwarmConfig().replace(
+    formation_shape="none", utility_threshold=2.0,
+    election_timeout_ticks=10, heartbeat_period_ticks=5,
+)
+
+#: Short-horizon credit (gamma 0.95 / lambda 0.9): steering effects
+#: on the dense shaped rewards are immediate, and the shorter horizon
+#: keeps the critic's target scale tractable at CPU-bench budgets.
+TCFG = train.TrainConfig(
+    rollout_steps=16, n_epochs=4, hidden=(32, 32), lr=1e-3,
+    gamma=0.95, gae_lambda=0.9, ent_coef=0.001,
+)
+
+
+def _zoo(env):
+    """The 4 zoo scenarios with the asymmetric pursuit table (evaders
+    reward-weighted 2x — the class-conditional reward knob)."""
+    caps = train.pursuit_caps(
+        env,
+        evader=train.CapabilityClass(
+            "evader", act_scale=0.8, speed_scale=1.2,
+            reward_scale=2.0,
+        ),
+    )
+    return [
+        envs.station_keeping(env, max_steps=400),
+        envs.obstacle_field(env, max_steps=400),
+        envs.pursuit_evasion(env, max_steps=400, caps=caps),
+        envs.coverage_foraging(env, max_steps=400),
+    ]
+
+
+def main() -> int:
+    backend = jax.default_backend()
+    if backend != "cpu":
+        print(
+            f"# bench_train: cpu-family rows; backend is {backend!r} "
+            "— skipping"
+        )
+        return 0
+    small = "--small" in sys.argv[1:]
+    n_updates = 30 if small else N_UPDATES
+
+    env = envs.SwarmMARLEnv(
+        cfg=CFG, capacity=N_AGENTS, n_tasks=2, n_obstacles=2,
+        k_neighbors=4, obs_max_per_cell=N_AGENTS, n_cap_classes=2,
+        obs_skin=2.0,
+    )
+    scen = _zoo(env)
+    params = envs.stack_env_params(scen)
+
+    ts = train.init_train_state(
+        jax.random.PRNGKey(0), params, env, TCFG
+    )
+    ts, _ = train.train_run(ts, env, TCFG, 1)   # warm (compiles)
+    t0 = time.perf_counter()
+    ts, hist = train.train_run(ts, env, TCFG, n_updates)
+    wall = time.perf_counter() - t0
+
+    steps_per_sec = (
+        len(scen) * TCFG.rollout_steps * n_updates / max(wall, 1e-9)
+    )
+    # Suppression: the tag is a mode literal fixed above — a stable
+    # cross-round pin, the common.telemetry_rows contract.
+    report(
+        # swarmlint: disable=metric-fstring -- tag is a mode literal; names are stable cross-round pins
+        f"train-env-steps-per-sec, zoo4 x {N_AGENTS} cpu",
+        steps_per_sec, "env-steps/sec", 0.0,
+    )
+
+    failures = 0
+    if not all(np.isfinite(v).all() for v in hist.values()):
+        bad = [k for k, v in hist.items() if not np.isfinite(v).all()]
+        print(f"# SELF-GATE: non-finite training metrics: {bad}",
+              file=sys.stderr)
+        failures += 1
+
+    # Learned-vs-protocol, per scenario, SAME episode stream: the
+    # zero net is the protocol baseline by the policy_rollout key
+    # contract (pinned in tests/test_train.py).
+    net0 = jax.tree_util.tree_map(jnp.zeros_like, ts.params)
+    wins = 0
+    for i, name in enumerate(envs.REWARD_NAMES):
+        p1 = envs.stack_env_params([scen[i]])
+        keys = jax.random.PRNGKey(100 + i)[None]
+        _, rew_l, _ = train.policy_rollout(
+            keys, env, p1, ts.params, TCFG, EVAL_STEPS,
+        )
+        _, rew_b, _ = train.policy_rollout(
+            keys, env, p1, net0, TCFG, EVAL_STEPS,
+        )
+        learned = float(np.asarray(rew_l).mean())
+        base = float(np.asarray(rew_b).mean())
+        delta = learned - base
+        tol = TOL_FRAC * max(1.0, abs(base))
+        ok = delta >= -tol
+        wins += ok
+        print(
+            f"# {name}: learned {learned:+.4f} vs protocol "
+            f"{base:+.4f} (delta {delta:+.4f}, "
+            f"{'>=' if ok else '<'} baseline)"
+        )
+        report(
+            # swarmlint: disable=metric-fstring -- scenario names are the fixed REWARD_NAMES registry; stable cross-round pins
+            f"learned-vs-protocol, {name} x {N_AGENTS} cpu",
+            delta * 1000.0, "reward-delta", 0.0,
+        )
+    if wins < 2:
+        print(
+            f"# SELF-GATE: learned policy >= the zero-action "
+            f"baseline on only {wins}/4 zoo scenarios (need >= 2)",
+            file=sys.stderr,
+        )
+        failures += 1
+
+    entries = cw.WATCH.compile_count(train.TRAIN_STEP_ENTRY)
+    budget = 1                       # one fused program, one family
+    print(f"# train-step compile entries: {entries} (budget {budget})")
+    if entries > budget:
+        print(
+            f"# SELF-GATE: {entries} compiled entries for "
+            f"{train.TRAIN_STEP_ENTRY} exceed {budget} — the update "
+            "stopped being one fused program",
+            file=sys.stderr,
+        )
+        failures += 1
+    return 2 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

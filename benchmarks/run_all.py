@@ -141,6 +141,14 @@ BENCHES = [
     # sharded entry's compile budget, and the jumbo mix (one tenant
     # through the spatial tick on the tiles axis, bitwise vs solo).
     "bench_mesh2d.py",
+    # r20: the training plane — shared-parameter IPPO over the
+    # 4-scenario zoo (asymmetric pursuit caps) as ONE fused
+    # train-step program; fixed-name train-env-steps-per-sec plus
+    # per-zoo-scenario learned-vs-protocol reward-delta rows
+    # (self-gated: learned >= the zero-action baseline on >= 2
+    # scenarios, one compiled train-step signature, finite metrics —
+    # exit 2).
+    "bench_train.py",
 ]
 
 # Extra argv for benches whose no-arg default is not the gate set —
@@ -206,6 +214,10 @@ QUICK_SKIP = {
     # plane) plus the jumbo mix — minutes on the 2-core rig, full
     # gate only.
     "bench_mesh2d.py",
+    # r20: hundreds of fused PPO updates + 8 deterministic eval
+    # rollouts over the zoo lattice — minutes on the 2-core rig,
+    # full gate only (the bench_env precedent).
+    "bench_train.py",
 }
 
 

@@ -677,6 +677,76 @@ def _spec_env_rollout():
     return _env_rollout_impl, (keys, p, env, 8), {}
 
 
+def _train_env():
+    """The training specs' shared small env + scenario batch — the
+    heterogeneous pursuit shape (2 capability classes, the obs plan
+    on the r20 Verlet carry) so the lint census covers the full
+    machinery, at lint-friendly scale."""
+    from .. import envs
+    from ..train.caps import pursuit_caps
+
+    env = envs.SwarmMARLEnv(
+        cfg=_serve_cfg(), capacity=12, k_neighbors=2,
+        obs_max_per_cell=12, n_cap_classes=2, obs_skin=2.0,
+    )
+    p = envs.stack_env_params([
+        envs.pursuit_evasion(
+            env, n_agents=8, caps=pursuit_caps(env, n_agents=8),
+            max_steps=100,
+        )
+    ])
+    return env, p
+
+
+@lint_entry("train-step")
+def _spec_train_step():
+    import functools
+
+    import jax
+
+    from ..train.ppo import (
+        TrainConfig,
+        _train_step_impl,
+        init_train_state,
+    )
+
+    env, p = _train_env()
+    tcfg = TrainConfig(rollout_steps=4, n_epochs=2, hidden=(16,))
+    # The donated TrainState rides as ShapeDtypeStructs (lower()
+    # accepts avals) — materializing it would EXECUTE the vmapped env
+    # reset + network init, and jaxlint never executes.
+    ts = jax.eval_shape(
+        functools.partial(init_train_state, env=env, tcfg=tcfg),
+        jax.random.PRNGKey(0), p,
+    )
+    return _train_step_impl, (ts, env, tcfg), {}
+
+
+@lint_entry("policy-rollout")
+def _spec_policy_rollout():
+    import functools
+
+    import jax
+
+    from ..train.ppo import (
+        TrainConfig,
+        _policy_rollout_impl,
+        init_policy_params,
+    )
+
+    env, p = _train_env()
+    tcfg = TrainConfig(rollout_steps=4, n_epochs=2, hidden=(16,))
+    net = jax.eval_shape(
+        functools.partial(
+            init_policy_params, obs_dim=env.obs_dim, act_dim=2,
+            tcfg=tcfg,
+        ),
+        jax.random.PRNGKey(0),
+    )
+    keys = jax.random.PRNGKey(3)[None]
+    return _policy_rollout_impl, (keys, p, net, env, tcfg, 6), {}
+
+
 # ---------------------------------------------------------------------------
 # Auditing
 

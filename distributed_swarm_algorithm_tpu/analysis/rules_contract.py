@@ -387,3 +387,184 @@ class ServeHostSyncRule(Rule):
                     "loop on device work — the pipeline serializes",
                 )
         return None
+
+
+# ---------------------------------------------------------------------------
+# nondonated-carry (r20)
+
+_LOOP_CALLS = frozenset(
+    {"jax.lax.scan", "jax.lax.fori_loop", "jax.lax.while_loop"}
+)
+
+#: Identifier components (underscore-split) that mark a loop carry as
+#: an optimizer-or-params pytree — the buffers a training loop cycles
+#: every update, where a missing donation doubles live memory (the
+#: whole state exists twice per step: the consumed input and the
+#: fresh output).  Deliberately narrow: generic rollout carries
+#: ("state", "carry", "plan") update in place too, but their
+#: lifetime is one call — the hazard this rule exists for is the
+#: long-LIVED learner state (train/ppo.py's TrainState discipline).
+_OPT_COMPONENTS = frozenset(
+    {"opt", "optimizer", "param", "params", "theta", "weights",
+     "train"}
+)
+
+_JIT_NAMES = frozenset({"jax.jit", "jax.pmap"})
+_DONATE_KWARGS = frozenset({"donate_argnums", "donate_argnames"})
+
+#: The loop call's carry-init operand: positional index / keyword.
+_CARRY_SLOT = {
+    "jax.lax.scan": (1, "init"),
+    "jax.lax.fori_loop": (3, "init_val"),
+    "jax.lax.while_loop": (2, "init_val"),
+}
+
+
+def _optish(name: str) -> bool:
+    return bool(
+        _OPT_COMPONENTS.intersection(name.lower().split("_"))
+    )
+
+
+@register
+class NondonatedCarryRule(Rule):
+    id = "nondonated-carry"
+    summary = (
+        "watched jitted entry scans an optimizer/params carry "
+        "without donation"
+    )
+    details = (
+        "A `@watched(...)` jitted entry whose lax.scan/fori_loop/"
+        "while_loop threads an optimizer-or-params pytree (carry "
+        "names carrying an opt/params/theta/weights/train component) "
+        "without `donate_argnums`/`donate_argnames` on its jit keeps "
+        "BOTH copies of the learner state live across every update — "
+        "the classic training-loop memory doubling (train/ppo.py "
+        "donates its whole TrainState; the jaxlint min-aliased floor "
+        "proves the aliasing landed).  Donate the carry argument, or "
+        "mark sharded donors with jax.buffer_donor."
+    )
+
+    def check(self, mod: ModuleInfo):
+        for fn in ast.walk(mod.tree):
+            if not isinstance(
+                fn, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            if not self._is_watched(mod, fn):
+                continue
+            if self._is_donated(mod, fn):
+                continue
+            assigns = self._assignments(fn)
+            seen: set = set()
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                loop = mod.resolve(node.func)
+                if loop not in _LOOP_CALLS:
+                    continue
+                init = self._carry_init(node, loop)
+                if init is None:
+                    continue
+                hits = sorted(self._optish_names(init, assigns))
+                if not hits:
+                    continue
+                site = (node.lineno, node.col_offset)
+                if site in seen:
+                    continue
+                seen.add(site)
+                yield mod.finding(
+                    self.id, node,
+                    f"loop carry threads {hits} through watched "
+                    f"jitted entry `{fn.name}` with no donation — "
+                    "both copies of the learner state stay live "
+                    "every update; add donate_argnums (or "
+                    "jax.buffer_donor for sharded carries)",
+                )
+
+    @staticmethod
+    def _is_watched(mod: ModuleInfo, fn) -> bool:
+        """A decorator of the form ``@watched("entry")`` /
+        ``@WATCH.watched("entry")`` — the compile-observatory
+        registration that marks a function as a long-lived entry
+        point (the scope this rule gates)."""
+        for dec in fn.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            name = mod.resolve(dec.func)
+            if name.rsplit(".", 1)[-1] == "watched":
+                return True
+        return False
+
+    @staticmethod
+    def _is_donated(mod: ModuleInfo, fn) -> bool:
+        """True when any jit/pmap decorator (direct, called, or via
+        functools.partial) carries a donate kwarg — or the body
+        mentions ``jax.buffer_donor`` (the shard_map donation
+        spelling, r18)."""
+        for dec in fn.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            name = mod.resolve(dec.func)
+            kws = {k.arg for k in dec.keywords if k.arg}
+            if name in _JIT_NAMES and kws & _DONATE_KWARGS:
+                return True
+            if (
+                name == "functools.partial"
+                and dec.args
+                and mod.resolve(dec.args[0]) in _JIT_NAMES
+                and kws & _DONATE_KWARGS
+            ):
+                return True
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and "buffer_donor" in node.value
+            ):
+                return True
+        return False
+
+    @staticmethod
+    def _carry_init(node: ast.Call, loop: str):
+        pos, kw = _CARRY_SLOT[loop]
+        for k in node.keywords:
+            if k.arg == kw:
+                return k.value
+        if len(node.args) > pos:
+            return node.args[pos]
+        return None
+
+    @staticmethod
+    def _assignments(fn):
+        """name -> last assigned value node, CONTAINER expressions
+        only (one-level indirection: ``carry0 = (params, m, v)`` then
+        ``scan(body, carry0)``).  Call RHSes deliberately don't
+        expand — ``plan = build_plan(pos, params)`` names params as a
+        builder INPUT, not as a carried pytree."""
+        out: dict = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, (ast.Tuple, ast.List, ast.Name)
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = node.value
+        return out
+
+    @classmethod
+    def _optish_names(cls, init, assigns, _depth: int = 0):
+        hits: set = set()
+        for node in ast.walk(init):
+            if isinstance(node, ast.Name):
+                if _optish(node.id):
+                    hits.add(node.id)
+                elif _depth < 1 and node.id in assigns:
+                    hits |= cls._optish_names(
+                        assigns[node.id], assigns, _depth + 1
+                    )
+            elif isinstance(node, ast.Attribute) and _optish(
+                node.attr
+            ):
+                hits.add(node.attr)
+        return hits

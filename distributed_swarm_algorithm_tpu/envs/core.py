@@ -55,7 +55,7 @@ import numpy as np
 from flax import struct
 
 from ..models.swarm import swarm_tick_dyn
-from ..ops.hashgrid_plan import build_hashgrid_plan
+from ..ops.hashgrid_plan import build_hashgrid_plan, refresh_plan
 from ..ops.physics import formation_targets
 from ..serve.batched import (
     ScenarioParams,
@@ -96,7 +96,18 @@ class EnvParams:
     team 1 = evaders in the pursuit scenario, killed via the alive
     mask when tagged); ``max_steps`` is the auto-reset episode
     boundary; ``tag_radius <= 0`` disables tagging entirely (the
-    non-pursuit scenarios select the untouched state bitwise)."""
+    non-pursuit scenarios select the untouched state bitwise).
+
+    Capability classes (r20, train/caps.py — the ABMax-style
+    heterogeneous-agents axis, arxiv 2508.16508): ``cap_class``
+    assigns each agent one of the env's ``n_cap_classes`` classes,
+    and the three per-class tables scale that agent's action bound
+    (``cap_act``), speed clamp (``cap_speed``) and reward weight
+    (``cap_reward``) — all TRACED data, so one compiled program
+    serves every class layout.  The default table (every agent class
+    0, every scale 1.0) is arithmetically a multiply-by-one, so the
+    r14 zero-action == protocol BITWISE pin extends to it unchanged
+    (pinned in tests/test_train.py)."""
 
     scenario: ScenarioParams   # protocol gains, each an f32 scalar
     reward_id: jax.Array       # i32 — envs/scenarios.py registry index
@@ -109,6 +120,10 @@ class EnvParams:
     obstacles: jax.Array       # [n_obstacles, 3] f32 (cx, cy, radius)
     max_steps: jax.Array       # i32 — episode length (auto-reset)
     tag_radius: jax.Array      # f32 — pursuit tag distance (<= 0: off)
+    cap_class: jax.Array       # [capacity] i32 — capability class id
+    cap_act: jax.Array         # [n_cap_classes] f32 — act_limit scale
+    cap_speed: jax.Array       # [n_cap_classes] f32 — max_speed scale
+    cap_reward: jax.Array      # [n_cap_classes] f32 — reward weight
 
 
 @struct.dataclass
@@ -116,11 +131,23 @@ class EnvState:
     """The env's scan carry: the live protocol state, the episode
     clock, and the scenario's own params (carried so ``step`` needs no
     params argument and ``vmap`` over states covers the scenario axis
-    in one in_axes)."""
+    in one in_axes).
+
+    ``obs_plan`` (r20, ROADMAP item 4's named scatter floor): with
+    ``env.obs_skin > 0`` the carry additionally holds the KNN
+    observation's skin-inflated
+    :class:`~..ops.hashgrid_plan.HashgridPlan`, refreshed under the
+    r9 Verlet triggers (``refresh_plan``) instead of rebuilt per step
+    — the per-step bin+sort becomes a per-rebuild cost while the
+    KNN block stays exact within its coverage radius (candidates are
+    distance-ranked against CURRENT positions every step).  ``None``
+    (the default, ``obs_skin == 0``) keeps the pre-r20 per-step
+    build bitwise."""
 
     swarm: SwarmState
     t: jax.Array               # i32 — steps into the current episode
     params: EnvParams
+    obs_plan: Optional[object] = None
 
 
 def stack_env_params(params: Sequence[EnvParams]) -> EnvParams:
@@ -154,7 +181,16 @@ class SwarmMARLEnv:
     cell (``2 * obs_hw / g``); agents outside the box clip into edge
     cells and degrade gracefully (candidates distance-ranked, never
     wrong, possibly missing).  ``act_limit`` bounds the steering
-    force per agent (L2)."""
+    force per agent (L2).
+
+    ``n_cap_classes`` (r20) is the capability-class table's shape
+    axis (train/caps.py): per-class act/speed/reward scales ride
+    :class:`EnvParams` as traced data; ``> 1`` additionally appends a
+    class one-hot block to the observation so a shared policy can
+    condition on its own class.  ``obs_skin``/``obs_rebuild_every``
+    (r20) opt the observation KNN plan into the r9 Verlet carry: the
+    plan lives in :class:`EnvState` and rebuilds only under the
+    displacement/alive/ceiling triggers (0 = the per-step build)."""
 
     cfg: SwarmConfig
     capacity: int
@@ -167,6 +203,9 @@ class SwarmMARLEnv:
     obs_neighbor_cap: int = 32
     act_limit: float = 1.0
     enable_tagging: bool = True
+    n_cap_classes: int = 1
+    obs_skin: float = 0.0
+    obs_rebuild_every: int = 0
 
     def __post_init__(self):
         validate_serve_config(self.cfg)
@@ -195,18 +234,48 @@ class SwarmMARLEnv:
                 f"act_limit must be > 0, got {self.act_limit} (the "
                 "steering bound; actions are norm-clamped to it)"
             )
+        if self.n_cap_classes < 1:
+            raise ValueError(
+                f"n_cap_classes must be >= 1, got "
+                f"{self.n_cap_classes} (the capability table's shape "
+                "axis; 1 = the homogeneous default)"
+            )
+        if self.obs_skin < 0:
+            raise ValueError(
+                f"obs_skin must be >= 0, got {self.obs_skin} (the "
+                "obs plan's Verlet reuse window; 0 = per-step build)"
+            )
+        if self.obs_rebuild_every < 0:
+            raise ValueError(
+                f"obs_rebuild_every must be >= 0, got "
+                f"{self.obs_rebuild_every}"
+            )
+        if self.obs_rebuild_every and not self.obs_skin > 0:
+            raise ValueError(
+                "obs_rebuild_every only applies to the carried obs "
+                "plan — set obs_skin > 0 (with skin 0 the plan is "
+                "rebuilt every step anyway)"
+            )
 
     # -- observation layout -------------------------------------------------
     def obs_layout(self):
         """[(block, width), ...] — the documented per-agent row
-        layout, in order (docs/ENVIRONMENTS.md)."""
-        return [
+        layout, in order (docs/ENVIRONMENTS.md).  The capability
+        block only exists for heterogeneous envs (``n_cap_classes >
+        1``) — the homogeneous default keeps the r14 layout
+        byte-for-byte."""
+        layout = [
             ("own: pos, vel, alive", 5),
             ("leader: offset, has_leader, slot_err", 5),
             ("neighbors: K x (rel_pos, rel_vel, valid)",
              5 * self.k_neighbors),
             ("tasks: T x (rel_pos, open, mine)", 4 * self.n_tasks),
         ]
+        if self.n_cap_classes > 1:
+            layout.append(
+                ("caps: class one-hot", self.n_cap_classes)
+            )
+        return layout
 
     @property
     def obs_dim(self) -> int:
@@ -262,7 +331,22 @@ class SwarmMARLEnv:
         )
 
     # -- observation --------------------------------------------------------
-    def obs(self, state: SwarmState, derived=None) -> jax.Array:
+    def build_obs_plan(self, state: SwarmState):
+        """The observation KNN's spatial index for ``state`` — THE one
+        builder both the per-step path and the r20 Verlet carry go
+        through, so their geometry cannot drift.  With ``obs_skin >
+        0`` the binning cell is inflated by the skin (the r9 reuse
+        window); coverage after drift stays >= one obs cell either
+        way (ops/hashgrid_plan.py module doc)."""
+        return build_hashgrid_plan(
+            state.pos, state.alive, float(self.obs_hw),
+            float(self.obs_cell), self.obs_max_per_cell,
+            need_csr=True, neighbor_cap=self.obs_neighbor_cap,
+            skin=float(self.obs_skin),
+        )
+
+    def obs(self, state: SwarmState, derived=None, plan=None,
+            cap_class=None) -> jax.Array:
         """[capacity, obs_dim] per-agent observation rows (dead agents
         read all-zero).  Read-only off the current state — collection
         cannot perturb the trajectory.
@@ -272,11 +356,24 @@ class SwarmMARLEnv:
         it can prove they match what a re-derivation here would
         produce (``formation_targets`` is position-independent, so
         only the tag sweep's liveness flips can invalidate them);
-        ``None`` derives from ``state`` as before."""
-        with jax.named_scope("env_obs"):
-            return self._obs_impl(state, derived)
+        ``None`` derives from ``state`` as before.
 
-    def _obs_impl(self, state: SwarmState, derived=None) -> jax.Array:
+        ``plan`` (r20): a carried — possibly Verlet-stale —
+        observation :class:`~..ops.hashgrid_plan.HashgridPlan`
+        (:class:`EnvState` holds it when ``obs_skin > 0``); ``None``
+        builds per call.  Candidate rows are read through the plan
+        but distances/velocities come from the CURRENT state, so a
+        within-skin-stale plan yields the same top-K block a fresh
+        same-geometry build would (pinned in tests/test_train.py).
+
+        ``cap_class`` (r20): the scenario's per-agent class ids —
+        required (and appended as a one-hot block) only when the env
+        is heterogeneous (``n_cap_classes > 1``)."""
+        with jax.named_scope("env_obs"):
+            return self._obs_impl(state, derived, plan, cap_class)
+
+    def _obs_impl(self, state: SwarmState, derived=None, plan=None,
+                  cap_class=None) -> jax.Array:
         n = self.capacity
         pos, vel, alive = state.pos, state.vel, state.alive
         falive = alive.astype(jnp.float32)
@@ -303,14 +400,14 @@ class SwarmMARLEnv:
             axis=-1,
         )
 
-        # KNN block off the shared spatial index: one plan build, one
-        # [N, W] candidate gather (the r9 stencil-union table), exact
-        # top-K by true distance within one obs cell of coverage.
-        plan = build_hashgrid_plan(
-            pos, alive, float(self.obs_hw), float(self.obs_cell),
-            self.obs_max_per_cell, need_csr=True,
-            neighbor_cap=self.obs_neighbor_cap,
-        )
+        # KNN block off the shared spatial index: one plan build (or
+        # the r20 carried plan), one [N, W] candidate gather (the r9
+        # stencil-union table), exact top-K by true distance within
+        # one obs cell of coverage.  A carried plan's key/cand tables
+        # are build-time snapshots, but the scores below are CURRENT
+        # distances — the Verlet contract every plan consumer keeps.
+        if plan is None:
+            plan = self.build_obs_plan(state)
         g2 = plan.g * plan.g
         cell = jnp.minimum(plan.key, g2 - 1)   # dead agents clip; masked out
         cand = plan.cand[cell]                                # [N, W]
@@ -361,6 +458,24 @@ class SwarmMARLEnv:
             ).reshape(n, 4 * self.n_tasks)
             blocks.append(tb)
 
+        if self.n_cap_classes > 1:
+            # Heterogeneous env: a shared policy must be able to
+            # condition on its own capability class (the ABMax
+            # asymmetric-game point) — one-hot, dead rows zeroed by
+            # the trailing select like every other block.
+            if cap_class is None:
+                raise ValueError(
+                    "obs() on a heterogeneous env (n_cap_classes > 1) "
+                    "needs the scenario's cap_class column — pass "
+                    "params.cap_class (reset/step thread it "
+                    "automatically)"
+                )
+            cls = jnp.clip(cap_class, 0, self.n_cap_classes - 1)
+            blocks.append(
+                jax.nn.one_hot(cls, self.n_cap_classes,
+                               dtype=jnp.float32)
+            )
+
         out = jnp.concatenate(blocks, axis=-1)
         return jnp.where(alive[:, None], out, 0.0)
 
@@ -370,10 +485,17 @@ class SwarmMARLEnv:
     ) -> Tuple[jax.Array, EnvState]:
         """(obs, state): materialize the scenario and observe it."""
         swarm = self.materialize(key, params)
-        state = EnvState(
-            swarm=swarm, t=jnp.asarray(0, jnp.int32), params=params
+        plan = (
+            self.build_obs_plan(swarm) if self.obs_skin > 0 else None
         )
-        return self.obs(swarm), state
+        state = EnvState(
+            swarm=swarm, t=jnp.asarray(0, jnp.int32), params=params,
+            obs_plan=plan,
+        )
+        return (
+            self.obs(swarm, plan=plan, cap_class=params.cap_class),
+            state,
+        )
 
     def step(
         self,
@@ -397,10 +519,29 @@ class SwarmMARLEnv:
         p = state.params
         prev = state.swarm
 
+        # Capability classes (r20): per-agent act/speed scales gathered
+        # from the traced class tables.  The default table is all-ones,
+        # and x * 1.0 is bitwise x in f32 — which is how the r14
+        # zero-action == protocol pin survives the heterogeneous
+        # machinery being always-on (tests/test_train.py).
+        cap_cls = jnp.clip(p.cap_class, 0, self.n_cap_classes - 1)
+
         a = jnp.asarray(actions, jnp.float32)
         norm = jnp.linalg.norm(a, axis=-1, keepdims=True)
-        lim = jnp.asarray(self.act_limit, jnp.float32)
+        lim = (
+            jnp.asarray(self.act_limit, jnp.float32)
+            * p.cap_act[cap_cls][:, None]
+        )
         a = a * jnp.minimum(1.0, lim / jnp.maximum(norm, 1e-9))
+
+        # Per-agent speed clamp: the scenario's scalar max_speed times
+        # the class scale, shaped [capacity, 1] so ops/physics.
+        # integrate's keepdims-speed comparison broadcasts row-wise.
+        sp = p.scenario.replace(
+            max_speed=(
+                p.scenario.max_speed * p.cap_speed[cap_cls]
+            )[:, None]
+        )
 
         obstacles = p.obstacles if self.n_obstacles else None
         # r18 (ROADMAP item 4 speed note): without the tag sweep the
@@ -416,12 +557,12 @@ class SwarmMARLEnv:
         reuse_derived = not self.enable_tagging
         if reuse_derived:
             swarm, telem, derived = swarm_tick_dyn(
-                prev, obstacles, self.cfg, params=p.scenario,
+                prev, obstacles, self.cfg, params=sp,
                 extra_force=a, return_derived=True,
             )
         else:
             swarm, telem = swarm_tick_dyn(
-                prev, obstacles, self.cfg, params=p.scenario,
+                prev, obstacles, self.cfg, params=sp,
                 extra_force=a,
             )
             derived = None
@@ -429,7 +570,12 @@ class SwarmMARLEnv:
 
         from .scenarios import reward_switch
 
-        rewards = reward_switch(prev, swarm, p, self.cfg)
+        # Class-conditional reward weight: r * 1.0 is bitwise r, so
+        # the default table leaves every reward pin untouched.
+        rewards = (
+            reward_switch(prev, swarm, p, self.cfg)
+            * p.cap_reward[cap_cls]
+        )
 
         t_next = state.t + 1
         done = t_next >= p.max_steps
@@ -449,11 +595,32 @@ class SwarmMARLEnv:
                     jnp.where(done, fresh.target, derived[0]),
                     jnp.where(done, fresh.has_target, derived[1]),
                 )
-        new_state = EnvState(swarm=swarm, t=t_next, params=p)
+
+        # r20: refresh the carried obs plan against the state obs will
+        # read — AFTER the auto-reset select, so one refresh serves
+        # both cases: an episode boundary's respawn jump / liveness
+        # change fires the displacement/alive triggers like any other
+        # motion (a second, unconditional fresh build per step for the
+        # reset branch would cost exactly the bin+sort the carry
+        # exists to amortize), and the Verlet exactness argument is
+        # purely geometric — any state within skin/2 of the snapshot
+        # reuses the plan legally, however it got there.
+        plan = state.obs_plan
+        if plan is not None:
+            plan = refresh_plan(
+                swarm.pos, swarm.alive, plan,
+                rebuild_every=self.obs_rebuild_every,
+            )
+        new_state = EnvState(
+            swarm=swarm, t=t_next, params=p, obs_plan=plan
+        )
         info = {"done": done}
         if self.cfg.telemetry.enabled:
             info["telemetry"] = telem
-        return self.obs(swarm, derived), new_state, rewards, dones, info
+        return (
+            self.obs(swarm, derived, plan, p.cap_class),
+            new_state, rewards, dones, info,
+        )
 
     def replace(self, **kw) -> "SwarmMARLEnv":
         return dataclasses.replace(self, **kw)
@@ -509,6 +676,10 @@ def make_env_params(
     kill_ids: Sequence[int] = (),
     max_steps: int = 10_000,
     tag_radius: float = 0.0,
+    cap_class: Optional[Sequence[int]] = None,
+    cap_act: Optional[Sequence[float]] = None,
+    cap_speed: Optional[Sequence[float]] = None,
+    cap_reward: Optional[Sequence[float]] = None,
     **overrides,
 ) -> EnvParams:
     """One scenario's :class:`EnvParams` against ``env``'s static
@@ -522,7 +693,14 @@ def make_env_params(
     zero); ``**overrides`` are
     :class:`~..serve.batched.ScenarioParams` fields (``k_att``,
     ``auction_eps``, ...).  ``n_agents=0`` is the dead FILLER
-    scenario the bucket padding uses."""
+    scenario the bucket padding uses.
+
+    ``cap_class``/``cap_act``/``cap_speed``/``cap_reward`` (r20) are
+    the heterogeneous capability tables — per-agent class ids
+    (``[capacity]``) and per-class act/speed/reward scales
+    (``[env.n_cap_classes]`` each); ``None`` defaults to the
+    homogeneous table (class 0 everywhere, every scale 1.0 — the
+    bitwise-neutral default).  ``train/caps.py`` holds the builders."""
     cap = env.capacity
     n = cap if n_agents is None else int(n_agents)
     if not 0 <= n <= cap:
@@ -558,6 +736,42 @@ def make_env_params(
             "build the env with enable_tagging=True for pursuit "
             "scenarios"
         )
+
+    n_cls = env.n_cap_classes
+    cls_arr = np.zeros((cap,), np.int32)
+    if cap_class is not None:
+        cls_arr = np.asarray(cap_class, np.int32)
+        if cls_arr.shape != (cap,):
+            raise ValueError(
+                f"cap_class must be [capacity]={cap} ints, got "
+                f"{cls_arr.shape}"
+            )
+        if cls_arr.min(initial=0) < 0 or cls_arr.max(initial=0) >= n_cls:
+            raise ValueError(
+                f"cap_class ids outside [0, n_cap_classes={n_cls}) — "
+                "class tables are shapes; build the env with enough "
+                "classes"
+            )
+
+    def _cap_table(vals, name, positive):
+        if vals is None:
+            return np.ones((n_cls,), np.float32)
+        arr = np.asarray(vals, np.float32)
+        if arr.shape != (n_cls,):
+            raise ValueError(
+                f"{name} must be [n_cap_classes]={n_cls} floats, got "
+                f"{arr.shape}"
+            )
+        if positive and not (arr > 0).all():
+            raise ValueError(
+                f"{name} scales must be > 0 (a zero scale would park "
+                "a class with no way to express it in the reward)"
+            )
+        return arr
+
+    act_tab = _cap_table(cap_act, "cap_act", positive=True)
+    speed_tab = _cap_table(cap_speed, "cap_speed", positive=True)
+    reward_tab = _cap_table(cap_reward, "cap_reward", positive=False)
 
     alive0 = np.zeros((cap,), bool)
     alive0[:n] = True
@@ -596,6 +810,10 @@ def make_env_params(
         obstacles=jnp.asarray(obs_arr),
         max_steps=jnp.asarray(max_steps, jnp.int32),
         tag_radius=jnp.asarray(tag_radius, jnp.float32),
+        cap_class=jnp.asarray(cls_arr),
+        cap_act=jnp.asarray(act_tab),
+        cap_speed=jnp.asarray(speed_tab),
+        cap_reward=jnp.asarray(reward_tab),
     )
 
 

@@ -160,18 +160,19 @@ def reward_switch(prev: SwarmState, cur: SwarmState, p: EnvParams,
 
 def station_keeping(env: SwarmMARLEnv, n_agents: Optional[int] = None,
                     spread: float = 6.0, max_steps: int = 10_000,
-                    kill_ids=(), **overrides) -> EnvParams:
+                    kill_ids=(), caps=None, **overrides) -> EnvParams:
     """Hold the spawn formation (the r12 quiet arena, as an env)."""
     return make_env_params(
         env, STATION, n_agents=n_agents, spread=spread,
         task_pos=[(0.0, 0.0)] * env.n_tasks,
-        max_steps=max_steps, kill_ids=kill_ids, **overrides,
+        max_steps=max_steps, kill_ids=kill_ids, **(caps or {}),
+        **overrides,
     )
 
 
 def obstacle_field(env: SwarmMARLEnv, n_agents: Optional[int] = None,
                    spread: float = 4.0, max_steps: int = 10_000,
-                   **overrides) -> EnvParams:
+                   caps=None, **overrides) -> EnvParams:
     """Cross an obstacle line to a shared goal — APF repulsion is
     already in the tick; the reward adds the proximity penalty."""
     rows = [
@@ -181,16 +182,23 @@ def obstacle_field(env: SwarmMARLEnv, n_agents: Optional[int] = None,
         env, OBSTACLE, n_agents=n_agents, spread=spread,
         target=(12.0, 0.0), obstacles=rows,
         task_pos=[(0.0, 0.0)] * env.n_tasks,
-        max_steps=max_steps, **overrides,
+        max_steps=max_steps, **(caps or {}), **overrides,
     )
 
 
 def pursuit_evasion(env: SwarmMARLEnv, n_agents: Optional[int] = None,
                     spread: float = 8.0, tag_radius: float = 1.0,
-                    max_steps: int = 10_000, **overrides) -> EnvParams:
+                    max_steps: int = 10_000, caps=None,
+                    **overrides) -> EnvParams:
     """Two populations: the lower half of the id range pursues, the
     upper half evades; a tagged evader dies through the alive mask
-    (the recovery machinery's adversarial workout)."""
+    (the recovery machinery's adversarial workout).
+
+    ``caps`` (r20): a capability-table kwargs dict
+    (``train/caps.py:pursuit_caps`` builds the canonical asymmetric
+    one — per-class act/speed/reward scales aligned with the team
+    split) merged into :func:`~.core.make_env_params`; ``None`` keeps
+    the homogeneous bitwise-neutral default."""
     cap = env.capacity
     n = cap if n_agents is None else int(n_agents)
     team = [0] * cap
@@ -200,14 +208,14 @@ def pursuit_evasion(env: SwarmMARLEnv, n_agents: Optional[int] = None,
         env, PURSUIT, n_agents=n_agents, spread=spread, team=team,
         tag_radius=tag_radius,
         task_pos=[(0.0, 0.0)] * env.n_tasks,
-        max_steps=max_steps, **overrides,
+        max_steps=max_steps, **(caps or {}), **overrides,
     )
 
 
 def coverage_foraging(env: SwarmMARLEnv,
                       n_agents: Optional[int] = None,
                       spread: float = 6.0, max_steps: int = 10_000,
-                      **overrides) -> EnvParams:
+                      caps=None, **overrides) -> EnvParams:
     """Serve the task board: the auction (or greedy arbiter) awards,
     the reward pays for holding an award and standing on it."""
     if env.n_tasks == 0:
@@ -225,7 +233,8 @@ def coverage_foraging(env: SwarmMARLEnv,
     overrides.setdefault("utility_threshold", 2.0)
     return make_env_params(
         env, COVERAGE, n_agents=n_agents, spread=spread,
-        task_pos=ring, max_steps=max_steps, **overrides,
+        task_pos=ring, max_steps=max_steps, **(caps or {}),
+        **overrides,
     )
 
 

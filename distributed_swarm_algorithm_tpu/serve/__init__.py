@@ -32,6 +32,7 @@ from .batched import (
     stack_params,
     stack_scenarios,
     tenant_state,
+    train_rollouts,
     validate_request,
     validate_serve_config,
 )
@@ -77,6 +78,7 @@ __all__ = [
     "stack_params",
     "stack_scenarios",
     "tenant_state",
+    "train_rollouts",
     "unshard_spatial_state",
     "validate_request",
     "validate_serve_config",

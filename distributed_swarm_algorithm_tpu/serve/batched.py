@@ -637,6 +637,88 @@ class EnvRolloutResult:
     summary: Optional[dict] = None
 
 
+def _bucketed_rollouts(
+    env,
+    scenarios,
+    seeds,
+    n_steps: int,
+    spec,
+    telemetry: bool,
+    entry: str,
+    families: set,
+    family: tuple,
+    dispatch,
+):
+    """THE bucket-serving loop both env and learned-policy serving
+    share: seed validation, the rungs x observed-static-families
+    compile budget (the r13 service's task-family discipline: each
+    distinct static tuple legitimately mints its own compile per
+    rung, and declaring rungs alone would turn the second family's
+    compile into a spurious bucket-overflow event), dead-filler
+    padding, per-scenario PRNG keying, telemetry unpack, and result
+    assembly.  ``dispatch(keys, params)`` is the one compiled call
+    per bucket; ``family`` the caller's static tuple for ``entry``'s
+    process-global ``families`` ledger."""
+    from ..envs.core import stack_env_params
+    from ..envs.scenarios import filler_params
+    from ..utils import compile_watch
+    from ..utils.telemetry import TelemetrySummary, tenant_telemetry
+    from .buckets import BucketSpec
+
+    scenarios = list(scenarios)
+    seeds = list(seeds)
+    if len(seeds) != len(scenarios):
+        raise ValueError(
+            f"{len(scenarios)} scenarios but {len(seeds)} seeds — "
+            "every scenario needs its own PRNG stream"
+        )
+    spec = spec or BucketSpec()
+    watch = compile_watch.WATCH
+    families.add(family)
+    budget = max(
+        len(spec.batches) * len(families),
+        watch.bucket_budget(entry) or 0,
+    )
+    watch.declare_buckets(entry, budget)
+
+    telem_on = telemetry or env.cfg.telemetry.enabled
+    filler = filler_params(env) if scenarios else None
+    results: list = [None] * len(scenarios)
+    queue = list(range(len(scenarios)))
+    for size in spec.split_batch(len(queue)):
+        take = queue[:size]
+        queue = queue[size:]
+        rows = [scenarios[i] for i in take]
+        row_seeds = [seeds[i] for i in take]
+        n_pad = size - len(rows)
+        rows += [filler] * n_pad
+        row_seeds += [0] * n_pad
+        params = stack_env_params(rows)
+        keys = jnp.stack(
+            [jax.random.PRNGKey(s) for s in row_seeds]
+        )
+        out = dispatch(keys, params)
+        telem = None
+        if telem_on:
+            states, rewards, dones, telem = out
+        else:
+            states, rewards, dones = out
+        for j, i in enumerate(take):
+            summary = None
+            if telem is not None:
+                summary = TelemetrySummary.from_ticks(
+                    tenant_telemetry(telem, j)
+                ).to_dict()
+            results[i] = EnvRolloutResult(
+                index=i,
+                state=jax.tree_util.tree_map(lambda x: x[j], states),
+                rewards=rewards[:, j],
+                dones=dones[:, j],
+                summary=summary,
+            )
+    return results
+
+
 def env_rollouts(
     env,
     scenarios,
@@ -661,75 +743,62 @@ def env_rollouts(
     key-broadcast rule).  The batch-rung budget is declared to the
     compile observatory under the env entry.  Returns one
     :class:`EnvRolloutResult` per scenario, input order."""
-    from ..envs.core import (
-        ENV_ROLLOUT_ENTRY,
-        _env_rollout_impl,
-        env_params_row,
-        stack_env_params,
-    )
-    from ..envs.scenarios import filler_params
-    from ..utils import compile_watch
-    from ..utils.telemetry import TelemetrySummary, tenant_telemetry
-    from .buckets import BucketSpec
+    from ..envs.core import ENV_ROLLOUT_ENTRY, _env_rollout_impl
 
-    scenarios = list(scenarios)
-    seeds = list(seeds)
-    if len(seeds) != len(scenarios):
-        raise ValueError(
-            f"{len(scenarios)} scenarios but {len(seeds)} seeds — "
-            "every scenario needs its own PRNG stream"
-        )
-    spec = spec or BucketSpec()
-    watch = compile_watch.WATCH
-    # The budget is batch rungs x OBSERVED static families (env,
-    # n_steps, flags) — the r13 service's task-family discipline:
-    # each distinct static tuple legitimately mints its own compile
-    # per rung, and declaring rungs alone would turn the second
-    # family's compile into a spurious bucket-overflow event.
-    _ENV_ROLLOUT_FAMILIES.add(
-        (env, int(n_steps), bool(random_policy),
-         bool(telemetry or env.cfg.telemetry.enabled))
-    )
-    budget = max(
-        len(spec.batches) * len(_ENV_ROLLOUT_FAMILIES),
-        watch.bucket_budget(ENV_ROLLOUT_ENTRY) or 0,
-    )
-    watch.declare_buckets(ENV_ROLLOUT_ENTRY, budget)
-
-    filler = filler_params(env) if scenarios else None
-    results: list = [None] * len(scenarios)
-    queue = list(range(len(scenarios)))
-    for size in spec.split_batch(len(queue)):
-        take = queue[:size]
-        queue = queue[size:]
-        rows = [scenarios[i] for i in take]
-        row_seeds = [seeds[i] for i in take]
-        n_pad = size - len(rows)
-        rows += [filler] * n_pad
-        row_seeds += [0] * n_pad
-        params = stack_env_params(rows)
-        keys = jnp.stack(
-            [jax.random.PRNGKey(s) for s in row_seeds]
-        )
-        out = _env_rollout_impl(
+    return _bucketed_rollouts(
+        env, scenarios, seeds, n_steps, spec, telemetry,
+        entry=ENV_ROLLOUT_ENTRY,
+        families=_ENV_ROLLOUT_FAMILIES,
+        family=(env, int(n_steps), bool(random_policy),
+                bool(telemetry or env.cfg.telemetry.enabled)),
+        dispatch=lambda keys, params: _env_rollout_impl(
             keys, params, env, n_steps, random_policy, telemetry,
-        )
-        telem = None
-        if telemetry or env.cfg.telemetry.enabled:
-            states, rewards, dones, telem = out
-        else:
-            states, rewards, dones = out
-        for j, i in enumerate(take):
-            summary = None
-            if telem is not None:
-                summary = TelemetrySummary.from_ticks(
-                    tenant_telemetry(telem, j)
-                ).to_dict()
-            results[i] = EnvRolloutResult(
-                index=i,
-                state=jax.tree_util.tree_map(lambda x: x[j], states),
-                rewards=rewards[:, j],
-                dones=dones[:, j],
-                summary=summary,
-            )
-    return results
+        ),
+    )
+
+
+#: Static families the policy-rollout entry has served in this process
+#: (env, tcfg, n_steps, deterministic, effective telemetry) — the same
+#: process-global budget discipline as the env entry above.
+_POLICY_ROLLOUT_FAMILIES: set = set()
+
+
+def train_rollouts(
+    env,
+    scenarios,
+    seeds,
+    n_steps: int,
+    net,
+    tcfg,
+    spec=None,
+    deterministic: bool = True,
+    telemetry: bool = False,
+):
+    """Bucketed LEARNED-POLICY serving (r20): the twin of
+    :func:`env_rollouts` for trained policies — a heterogeneous list
+    of env scenarios runs through the batch-rung lattice with the
+    network riding each dispatch as TRACED data, so every checkpoint
+    of one architecture serves through the same compiled
+    ``"policy-rollout"`` entry (train/ppo.py).  Padding, seeding, and
+    result unpacking are the shared :func:`_bucketed_rollouts` loop;
+    the learned policy is just one more tenant workload on the serve
+    plane.
+
+    ``net`` is the policy pytree (``train.ppo.init_policy_params``
+    shape — its architecture must match ``env.obs_dim``); ``tcfg``
+    the :class:`~..train.ppo.TrainConfig` it was trained under
+    (static — it shapes the graph).  Returns one
+    :class:`EnvRolloutResult` per scenario, input order."""
+    from ..train.ppo import POLICY_ROLLOUT_ENTRY, _policy_rollout_impl
+
+    return _bucketed_rollouts(
+        env, scenarios, seeds, n_steps, spec, telemetry,
+        entry=POLICY_ROLLOUT_ENTRY,
+        families=_POLICY_ROLLOUT_FAMILIES,
+        family=(env, tcfg, int(n_steps), bool(deterministic),
+                bool(telemetry or env.cfg.telemetry.enabled)),
+        dispatch=lambda keys, params: _policy_rollout_impl(
+            keys, params, net, env, tcfg, n_steps, deterministic,
+            telemetry,
+        ),
+    )

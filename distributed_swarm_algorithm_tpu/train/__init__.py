@@ -1,0 +1,56 @@
+"""The training plane (r20): pure-JAX IPPO/MAPPO over
+:class:`~..envs.core.SwarmMARLEnv` with heterogeneous capability
+classes.  See train/ppo.py (the fused ``train-step`` program:
+rollout + GAE + clipped-surrogate epochs under one jit, donated
+carry; the ``policy-rollout`` eval/serve entry; vmap-over-seeds
+ensembles) and train/caps.py (ABMax-style per-class act/speed/reward
+scale tables threaded as traced :class:`~..envs.core.EnvParams`
+data).  docs/TRAINING.md holds the API contract."""
+
+from .caps import (
+    DEFAULT_CLASS,
+    EVADER_CLASS,
+    PURSUER_CLASS,
+    CapabilityClass,
+    caps_kwargs,
+    default_caps,
+    pursuit_caps,
+)
+from .ppo import (
+    ALGOS,
+    POLICY_ROLLOUT_ENTRY,
+    TRAIN_STEP_ENTRY,
+    TrainConfig,
+    TrainState,
+    actor_mean,
+    init_policy_params,
+    init_train_ensemble,
+    init_train_state,
+    policy_rollout,
+    train_run,
+    train_step,
+    train_step_ensemble,
+)
+
+__all__ = [
+    "ALGOS",
+    "DEFAULT_CLASS",
+    "EVADER_CLASS",
+    "POLICY_ROLLOUT_ENTRY",
+    "PURSUER_CLASS",
+    "TRAIN_STEP_ENTRY",
+    "CapabilityClass",
+    "TrainConfig",
+    "TrainState",
+    "actor_mean",
+    "caps_kwargs",
+    "default_caps",
+    "init_policy_params",
+    "init_train_ensemble",
+    "init_train_state",
+    "policy_rollout",
+    "pursuit_caps",
+    "train_run",
+    "train_step",
+    "train_step_ensemble",
+]

@@ -1,0 +1,128 @@
+"""Heterogeneous capability classes for the MARL env (r20).
+
+ABMax (arxiv 2508.16508) makes heterogeneity a first-class batched
+axis: agent *types* are data riding the vectorized state, never a
+fork of the step function.  This module is that discipline on the
+swarm env: a capability CLASS is a row of three per-class scale
+tables —
+
+  - ``act_scale``   — multiplies the env's ``act_limit`` (how hard
+    this class can steer),
+  - ``speed_scale`` — multiplies the scenario's ``max_speed`` clamp
+    (how fast this class can move),
+  - ``reward_scale`` — weights this class's per-agent reward (whose
+    objective dominates the shared-policy gradient),
+
+and the per-agent ``cap_class`` column assigns one class per slot.
+All four arrays enter :class:`~..envs.core.EnvParams` as TRACED data
+(``envs/core.make_env_params``), so one compiled program serves every
+class layout — the r13 params-as-data discipline extended to agent
+types.
+
+The load-bearing default: a table of all-default classes (class 0
+everywhere, every scale 1.0) is arithmetically a multiply-by-one, so
+the r14 "zero action == protocol rollout BITWISE" pin survives the
+caps machinery being always-on (tests/test_train.py pins this).
+
+The flagship asymmetric game (:func:`pursuit_caps`): evaders out-run
+pursuers (``speed_scale`` > 1) but steer more coarsely (``act_scale``
+< 1) — pursuit-evasion stops being a symmetric race and becomes a
+genuine pursuit-curve problem the learned policy must solve per
+class (the class one-hot block in the observation is what lets one
+shared policy condition on its own class).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from ..envs.core import SwarmMARLEnv
+
+
+@dataclasses.dataclass(frozen=True)
+class CapabilityClass:
+    """One capability class: a named row of the three scale tables."""
+
+    name: str
+    act_scale: float = 1.0
+    speed_scale: float = 1.0
+    reward_scale: float = 1.0
+
+
+#: The homogeneous class every default-built scenario uses.
+DEFAULT_CLASS = CapabilityClass("default")
+
+#: The canonical asymmetric pursuit pair (module doc): pursuers are
+#: the protocol baseline; evaders trade steering precision for top
+#: speed — faster in a straight line, wider turns.
+PURSUER_CLASS = CapabilityClass("pursuer")
+EVADER_CLASS = CapabilityClass(
+    "evader", act_scale=0.8, speed_scale=1.2
+)
+
+
+def caps_kwargs(
+    env: SwarmMARLEnv,
+    classes: Sequence[CapabilityClass],
+    assignment: Sequence[int],
+) -> Dict[str, object]:
+    """The ``make_env_params`` kwargs for one class layout: validated
+    per-class tables + the per-agent assignment column.  ``classes``
+    must match the env's static ``n_cap_classes`` (a shape);
+    ``assignment`` is one class id per capacity slot."""
+    classes = list(classes)
+    if len(classes) != env.n_cap_classes:
+        raise ValueError(
+            f"{len(classes)} classes for an env with n_cap_classes="
+            f"{env.n_cap_classes} — the class table is a shape; "
+            "build the env with matching n_cap_classes"
+        )
+    assign = np.asarray(list(assignment), np.int32)
+    if assign.shape != (env.capacity,):
+        raise ValueError(
+            f"assignment must name a class per capacity slot "
+            f"([{env.capacity}]), got shape {assign.shape}"
+        )
+    return {
+        "cap_class": assign,
+        "cap_act": [c.act_scale for c in classes],
+        "cap_speed": [c.speed_scale for c in classes],
+        "cap_reward": [c.reward_scale for c in classes],
+    }
+
+
+def default_caps(env: SwarmMARLEnv) -> Dict[str, object]:
+    """The all-default table — the bitwise-neutral layout the r14
+    parity pin extends over (every agent class 0, every scale 1.0)."""
+    return caps_kwargs(
+        env,
+        [DEFAULT_CLASS] * env.n_cap_classes,
+        [0] * env.capacity,
+    )
+
+
+def pursuit_caps(
+    env: SwarmMARLEnv,
+    n_agents: Optional[int] = None,
+    pursuer: CapabilityClass = PURSUER_CLASS,
+    evader: CapabilityClass = EVADER_CLASS,
+) -> Dict[str, object]:
+    """The asymmetric pursuit layout, aligned with
+    ``envs/scenarios.pursuit_evasion``'s team split (lower half of
+    the id range pursues = class 0, upper half evades = class 1) so
+    the class table and the tag-sweep team column describe the same
+    populations.  Needs ``n_cap_classes == 2``."""
+    if env.n_cap_classes != 2:
+        raise ValueError(
+            "pursuit_caps is the two-class layout — build the env "
+            f"with n_cap_classes=2 (got {env.n_cap_classes})"
+        )
+    cap = env.capacity
+    n = cap if n_agents is None else int(n_agents)
+    assign = [0] * cap
+    for i in range(n // 2, n):
+        assign[i] = 1
+    return caps_kwargs(env, [pursuer, evader], assign)

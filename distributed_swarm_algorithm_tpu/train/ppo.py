@@ -1,0 +1,647 @@
+"""Pure-JAX IPPO/MAPPO over :class:`~..envs.core.SwarmMARLEnv` (r20).
+
+The env (r14) is JaxMARL-compatible (arxiv 2311.10090), and JaxMARL's
+baselines prove the payoff of keeping the WHOLE learning loop inside
+one jitted program: env rollout, GAE, and the clipped-surrogate update
+fuse into a single ``lax.scan``-composed graph, so the per-update cost
+is one dispatch, not ``T`` of them.  This module is that loop for the
+swarm, with zero new dependencies — the network is a plain
+``jax.numpy`` params-as-pytree MLP and the optimizer a hand-rolled
+Adam, so the training plane rides the exact toolchain the serving
+plane already ships.
+
+Shape of the system:
+
+- **Shared-parameter actor-critic.**  One tanh MLP maps each agent's
+  observation row to a Gaussian steering mean (state-independent
+  learned ``log_std``); a second MLP is the critic.  ``algo="ippo"``
+  gives each agent an independent critic of its OWN observation;
+  ``algo="mappo"`` is the centralized-critic variant — the critic
+  additionally sees the alive-masked MEAN observation of the whole
+  swarm (a fixed-shape global summary, so the centralized input
+  vmaps like everything else).  Heterogeneous behavior under shared
+  parameters comes from the observation, not from per-class
+  networks: the env's class one-hot block (``n_cap_classes > 1``,
+  envs/core.py) is how one policy plays both sides of the
+  asymmetric pursuit game (train/caps.py).
+- **One compiled train step.**  :func:`train_step` — the
+  ``watched("train-step")`` entry — runs ``rollout_steps`` vmapped
+  env steps (the S-scenario axis of the r13/r14 lattice), computes
+  GAE, then scans ``n_epochs`` full-batch clipped-PPO epochs, all in
+  ONE jitted program whose :class:`TrainState` carry (params, Adam
+  moments, env states, observations, PRNG key) is DONATED — the
+  update loop hands each step's buffers straight back to XLA, the
+  r13 double-buffer discipline applied to the optimizer (swarmlint
+  rule 18 ``nondonated-carry`` exists because forgetting this
+  doubles live memory).  Registered with the compile observatory and
+  budgeted in jaxlint (zero collectives, f64-free, donation floor).
+- **Scale hooks.**  The scenario axis is already inside the program
+  (train on the whole zoo at once — reward dispatch is the traced
+  ``lax.switch``); :func:`init_train_ensemble` /
+  :func:`train_step_ensemble` vmap the SAME step over a leading
+  seeds axis (independent policies per member) — the meta-loop shape
+  ROADMAP item 5 will reuse.
+- **Serving the learned policy.**  :func:`policy_rollout` — the
+  ``watched("policy-rollout")`` entry — rolls a (deterministic or
+  sampled) policy through the env with the SAME key discipline as
+  ``envs/core.env_rollout``, so a zero network's deterministic
+  rollout reproduces the zero-action protocol rollout exactly; the
+  serve layer buckets it (``serve/batched.train_rollouts``) like any
+  other tenant workload.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..envs.core import EnvParams, EnvState, SwarmMARLEnv
+from ..utils.compile_watch import watched
+from ..utils.config import TELEMETRY_ON
+
+#: Compile-observatory registry names of the training plane's jitted
+#: entries (declared in jaxlint-budgets.json like every other entry).
+TRAIN_STEP_ENTRY = "train-step"
+POLICY_ROLLOUT_ENTRY = "policy-rollout"
+
+#: Supported algorithm variants (static — they trace different
+#: critic-input graphs).
+ALGOS = ("ippo", "mappo")
+
+_LOG2PI = math.log(2.0 * math.pi)
+#: log_std clamp: exp(-5) ~ 7e-3 (effectively deterministic) to
+#: exp(2) ~ 7.4 (wildly exploratory) — outside this band the
+#: Gaussian logp is numerically useless.
+_LOG_STD_LO, _LOG_STD_HI = -5.0, 2.0
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Static training hyperparameters — frozen + hashable, so the
+    config rides as a jit-static argument exactly like ``SwarmConfig``
+    (every per-run tunable that must stay dynamic lives in the traced
+    :class:`TrainState` instead)."""
+
+    rollout_steps: int = 32     # T env steps collected per update
+    n_epochs: int = 4           # full-batch PPO epochs per update
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    clip_eps: float = 0.2
+    lr: float = 3e-4
+    vf_coef: float = 0.5
+    ent_coef: float = 0.01
+    max_grad_norm: float = 0.5
+    hidden: Tuple[int, ...] = (64, 64)
+    algo: str = "ippo"
+    log_std_init: float = -0.7  # exp(-0.7) ~ 0.5 — half the act bound
+    adam_b1: float = 0.9
+    adam_b2: float = 0.999
+    adam_eps: float = 1e-8
+
+    def __post_init__(self):
+        if self.algo not in ALGOS:
+            raise ValueError(
+                f"algo must be one of {ALGOS}, got {self.algo!r}"
+            )
+        if self.rollout_steps < 1:
+            raise ValueError(
+                f"rollout_steps must be >= 1, got {self.rollout_steps}"
+            )
+        if self.n_epochs < 1:
+            raise ValueError(
+                f"n_epochs must be >= 1, got {self.n_epochs}"
+            )
+        if not self.hidden:
+            raise ValueError("hidden must name at least one layer")
+
+    def critic_in(self, obs_dim: int) -> int:
+        """The critic MLP's input width: own obs (IPPO) or own obs +
+        the pooled global summary (MAPPO's centralized critic)."""
+        return obs_dim if self.algo == "ippo" else 2 * obs_dim
+
+
+@struct.dataclass
+class TrainState:
+    """The donated carry of one learner: network params, Adam moments
+    + step count, and the live env frontier (states, observations,
+    PRNG key).  Everything is traced data — ensembles vmap a leading
+    seeds axis over the whole pytree."""
+
+    params: Any                # {"actor": [...], "critic": [...], "log_std"}
+    opt_m: Any                 # Adam first moments (params-shaped)
+    opt_v: Any                 # Adam second moments (params-shaped)
+    opt_t: jax.Array           # i32 — Adam step count
+    env: EnvState              # [S]-leaved env frontier
+    obs: jax.Array             # [S, capacity, obs_dim]
+    key: jax.Array             # PRNG key
+
+
+# ---------------------------------------------------------------------------
+# Network: params-as-pytree MLP (no deps beyond jax.numpy)
+
+
+def _linear_init(key, n_in: int, n_out: int, scale: float):
+    w = jax.random.normal(key, (n_in, n_out), jnp.float32) * (
+        scale / jnp.sqrt(jnp.asarray(n_in, jnp.float32))
+    )
+    return w, jnp.zeros((n_out,), jnp.float32)
+
+
+def _mlp_init(key, sizes, out_scale: float):
+    keys = jax.random.split(key, len(sizes) - 1)
+    layers = []
+    for i in range(len(sizes) - 1):
+        scale = math.sqrt(2.0) if i < len(sizes) - 2 else out_scale
+        layers.append(
+            _linear_init(keys[i], sizes[i], sizes[i + 1], scale)
+        )
+    return layers
+
+
+def _mlp(layers, x: jax.Array) -> jax.Array:
+    for w, b in layers[:-1]:
+        x = jnp.tanh(x @ w + b)
+    w, b = layers[-1]
+    return x @ w + b
+
+
+def init_policy_params(
+    key: jax.Array, obs_dim: int, act_dim: int, tcfg: TrainConfig
+):
+    """The network pytree: actor (small-scaled output head so the
+    initial policy is near-zero steering — the protocol-respecting
+    start), critic, and the state-independent ``log_std``."""
+    akey, ckey = jax.random.split(key)
+    hidden = tuple(tcfg.hidden)
+    return {
+        "actor": _mlp_init(
+            akey, (obs_dim,) + hidden + (act_dim,), out_scale=0.01
+        ),
+        "critic": _mlp_init(
+            ckey, (tcfg.critic_in(obs_dim),) + hidden + (1,),
+            out_scale=1.0,
+        ),
+        "log_std": jnp.full(
+            (act_dim,), tcfg.log_std_init, jnp.float32
+        ),
+    }
+
+
+def actor_mean(net, obs: jax.Array) -> jax.Array:
+    """The policy's deterministic action (the eval/serve head)."""
+    return _mlp(net["actor"], obs)
+
+
+def _log_std(net) -> jax.Array:
+    return jnp.clip(net["log_std"], _LOG_STD_LO, _LOG_STD_HI)
+
+
+def _gauss_logp(mean, log_std, act) -> jax.Array:
+    z = (act - mean) * jnp.exp(-log_std)
+    return -0.5 * jnp.sum(
+        z * z + 2.0 * log_std + _LOG2PI, axis=-1
+    )
+
+
+def _gauss_entropy(log_std) -> jax.Array:
+    return jnp.sum(log_std + 0.5 * (_LOG2PI + 1.0))
+
+
+def _critic_obs(obs: jax.Array, alive: jax.Array, algo: str):
+    """The critic's input: own obs (IPPO), or own obs concatenated
+    with the alive-masked mean observation of the whole swarm (MAPPO
+    — a fixed-shape centralized summary; dead/pad rows are all-zero
+    by the env contract so the mask only fixes the denominator)."""
+    if algo == "ippo":
+        return obs
+    w = alive.astype(jnp.float32)[..., None]           # [..., N, 1]
+    pooled = (obs * w).sum(axis=-2, keepdims=True) / jnp.maximum(
+        w.sum(axis=-2, keepdims=True), 1.0
+    )
+    return jnp.concatenate(
+        [obs, jnp.broadcast_to(pooled, obs.shape)], axis=-1
+    )
+
+
+# ---------------------------------------------------------------------------
+# Optimizer: hand-rolled Adam (pure jnp, donation-friendly pytrees)
+
+
+def _clip_by_global_norm(grads, max_norm: float):
+    gn = jnp.sqrt(
+        sum(jnp.sum(g * g) for g in jax.tree_util.tree_leaves(grads))
+    )
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(gn, 1e-9))
+    return jax.tree_util.tree_map(lambda g: g * scale, grads), gn
+
+
+def _adam(net, grads, m, v, t, tcfg: TrainConfig):
+    t = t + 1
+    b1, b2 = tcfg.adam_b1, tcfg.adam_b2
+    m = jax.tree_util.tree_map(
+        lambda mm, g: b1 * mm + (1.0 - b1) * g, m, grads
+    )
+    v = jax.tree_util.tree_map(
+        lambda vv, g: b2 * vv + (1.0 - b2) * g * g, v, grads
+    )
+    tf = t.astype(jnp.float32)
+    c1 = 1.0 - b1 ** tf
+    c2 = 1.0 - b2 ** tf
+    net = jax.tree_util.tree_map(
+        lambda p, mm, vv: p - tcfg.lr * (mm / c1) / (
+            jnp.sqrt(vv / c2) + tcfg.adam_eps
+        ),
+        net, m, v,
+    )
+    return net, m, v, t
+
+
+# ---------------------------------------------------------------------------
+# GAE
+
+
+def _gae(rewards, values, dones, last_value, gamma, lam):
+    """(advantages, returns) by reverse scan; ``dones`` terminates the
+    bootstrap (per-agent — a tagged evader's stream ends where the
+    episode-boundary select restarts everyone's)."""
+
+    def back(carry, inp):
+        adv_next, v_next = carry
+        r, v, nonterm = inp
+        delta = r + gamma * v_next * nonterm - v
+        adv = delta + gamma * lam * nonterm * adv_next
+        return (adv, v), adv
+
+    nonterm = 1.0 - dones
+    (_, _), advs = jax.lax.scan(
+        back,
+        (jnp.zeros_like(last_value), last_value),
+        (rewards, values, nonterm),
+        reverse=True,
+    )
+    return advs, advs + values
+
+
+# ---------------------------------------------------------------------------
+# The train step: rollout + GAE + epochs, ONE compiled program
+
+
+def init_train_state(
+    key: jax.Array,
+    params: EnvParams,
+    env: SwarmMARLEnv,
+    tcfg: TrainConfig,
+) -> TrainState:
+    """Fresh learner state over the ``[S]``-stacked scenarios: vmapped
+    env reset (one PRNG stream per scenario — the key-broadcast rule)
+    plus network/optimizer init."""
+    # The scenario params ride INSIDE the donated carry (EnvState
+    # holds them), so without this copy the first train_step would
+    # hand the CALLER's EnvParams buffers to XLA — and every later
+    # use of them (a second learner, an eval rollout) would hit
+    # "buffer has been deleted or donated".  They are a few hundred
+    # bytes; copy once here.
+    params = jax.tree_util.tree_map(jnp.copy, params)
+    n_scen = params.reward_id.shape[0]
+    key, nkey, rkey = jax.random.split(key, 3)
+    obs, states = jax.vmap(env.reset)(
+        jax.random.split(rkey, n_scen), params
+    )
+    net = init_policy_params(nkey, env.obs_dim, env.action_dim, tcfg)
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, net)
+    return TrainState(
+        params=net,
+        opt_m=zeros,
+        opt_v=jax.tree_util.tree_map(jnp.zeros_like, net),
+        opt_t=jnp.zeros((), jnp.int32),
+        env=states,
+        obs=obs,
+        key=key,
+    )
+
+
+def _train_step_core(
+    ts: TrainState, env: SwarmMARLEnv, tcfg: TrainConfig
+):
+    """(TrainState, metrics): one full PPO update — see module doc.
+    Plain (un-jitted): the jitted/vmapped entries below own the
+    transform composition."""
+    net = ts.params
+
+    def rollout_body(carry, _):
+        st, obs, key = carry
+        key, akey, skey = jax.random.split(key, 3)
+        mean = actor_mean(net, obs)
+        log_std = _log_std(net)
+        noise = jax.random.normal(akey, mean.shape, jnp.float32)
+        act = mean + jnp.exp(log_std) * noise
+        logp = _gauss_logp(mean, log_std, act)
+        alive = st.swarm.alive                         # [S, N]
+        val = _mlp(
+            net["critic"], _critic_obs(obs, alive, tcfg.algo)
+        )[..., 0]
+        skeys = jax.random.split(skey, obs.shape[0])
+        obs2, st2, rew, dones, _ = jax.vmap(
+            lambda k, s, a: env.step(k, s, a)
+        )(skeys, st, act)
+        ys = (
+            obs, act, logp, val, rew,
+            dones.astype(jnp.float32),
+            alive.astype(jnp.float32),
+        )
+        return (st2, obs2, key), ys
+
+    (st_f, obs_f, key_f), traj = jax.lax.scan(
+        rollout_body, (ts.env, ts.obs, ts.key), None,
+        length=tcfg.rollout_steps,
+    )
+    obs_t, act_t, logp_t, val_t, rew_t, done_t, mask = traj
+    last_val = _mlp(
+        net["critic"],
+        _critic_obs(obs_f, st_f.swarm.alive, tcfg.algo),
+    )[..., 0]
+    adv_t, ret_t = _gae(
+        rew_t, val_t, done_t, last_val, tcfg.gamma, tcfg.gae_lambda
+    )
+
+    # Masked, PER-SCENARIO advantage normalization: dead/pad slots
+    # carry obs of zeros and rewards of zero — they must not dilute
+    # the statistics — and the zoo's reward scales span orders of
+    # magnitude (obstacle-field ~ -9/step vs coverage ~ +0.06/step),
+    # so a GLOBAL normalization would let the large-scale scenario's
+    # variance crush every other scenario's gradient signal.  Axes
+    # (T, N) per scenario; with S = 1 this is the classic global
+    # normalization.
+    msum = jnp.maximum(mask.sum(), 1.0)
+    s_sum = jnp.maximum(mask.sum(axis=(0, 2), keepdims=True), 1.0)
+    amean = (adv_t * mask).sum(axis=(0, 2), keepdims=True) / s_sum
+    avar = (
+        ((adv_t - amean) ** 2) * mask
+    ).sum(axis=(0, 2), keepdims=True) / s_sum
+    adv_n = (adv_t - amean) / jnp.sqrt(avar + 1e-8)
+
+    def loss_fn(p):
+        mean = actor_mean(p, obs_t)
+        log_std = _log_std(p)
+        logp = _gauss_logp(mean, log_std, act_t)
+        ratio = jnp.exp(logp - logp_t)
+        clipped = jnp.clip(
+            ratio, 1.0 - tcfg.clip_eps, 1.0 + tcfg.clip_eps
+        )
+        pg = -(
+            jnp.minimum(ratio * adv_n, clipped * adv_n) * mask
+        ).sum() / msum
+        v = _mlp(
+            p["critic"],
+            _critic_obs(obs_t, mask > 0.0, tcfg.algo),
+        )[..., 0]
+        v_loss = 0.5 * (((v - ret_t) ** 2) * mask).sum() / msum
+        ent = _gauss_entropy(_log_std(p))
+        kl = ((logp_t - logp) * mask).sum() / msum
+        total = pg + tcfg.vf_coef * v_loss - tcfg.ent_coef * ent
+        return total, (pg, v_loss, ent, kl)
+
+    def epoch_body(carry, _):
+        p, m, v, t = carry
+        (total, aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(p)
+        grads, gn = _clip_by_global_norm(grads, tcfg.max_grad_norm)
+        p, m, v, t = _adam(p, grads, m, v, t, tcfg)
+        return (p, m, v, t), (total,) + aux + (gn,)
+
+    (net2, m2, v2, t2), stats = jax.lax.scan(
+        epoch_body, (net, ts.opt_m, ts.opt_v, ts.opt_t), None,
+        length=tcfg.n_epochs,
+    )
+    total, pg, v_loss, ent, kl, gn = stats
+    metrics = {
+        "reward_mean": (rew_t * mask).sum() / msum,
+        "loss": total[-1],
+        "pg_loss": pg[-1],
+        "v_loss": v_loss[-1],
+        "entropy": ent[-1],
+        "approx_kl": kl[-1],
+        "grad_norm": gn[-1],
+    }
+    ts2 = TrainState(
+        params=net2, opt_m=m2, opt_v=v2, opt_t=t2,
+        env=st_f, obs=obs_f, key=key_f,
+    )
+    return ts2, metrics
+
+
+@watched(TRAIN_STEP_ENTRY)
+@partial(
+    jax.jit, static_argnames=("env", "tcfg"), donate_argnums=(0,)
+)
+def _train_step_impl(
+    ts: TrainState, env: SwarmMARLEnv, tcfg: TrainConfig
+):
+    return _train_step_core(ts, env, tcfg)
+
+
+def _ens_core(ts, env, tcfg):
+    return jax.vmap(
+        lambda t: _train_step_core(t, env, tcfg)
+    )(ts)
+
+
+#: The seeds-axis twin: the SAME core vmapped over a leading ensemble
+#: axis of the whole TrainState, registered under the same observatory
+#: entry (one more signature, declared in the entry's bucket budget).
+_train_step_ens_impl = watched(TRAIN_STEP_ENTRY)(
+    partial(
+        jax.jit, static_argnums=(1, 2), donate_argnums=(0,)
+    )(_ens_core)
+)
+
+
+def _dealias_donated(ts: TrainState) -> TrainState:
+    """Copy any leaf that shares a device buffer with an earlier leaf
+    — XLA refuses to donate one buffer twice, and duplicate buffers
+    are REAL here: the eager constant cache hands every same-shaped
+    ``jnp.zeros`` the same buffer (Adam moments and bias init), and
+    the compiled step's own output aliasing can merge identical
+    values.  Duplicates are a handful of small leaves, so the copies
+    cost microseconds; tracers (the vmapped ensemble core) expose no
+    buffer and pass through untouched."""
+    seen: set = set()
+
+    def fix(x):
+        try:
+            p = x.unsafe_buffer_pointer()
+        except Exception:
+            return x
+        if p in seen:
+            return jnp.copy(x)
+        seen.add(p)
+        return x
+
+    return jax.tree_util.tree_map(fix, ts)
+
+
+def train_step(
+    ts: TrainState, env: SwarmMARLEnv, tcfg: TrainConfig
+):
+    """(TrainState, metrics): ONE compiled PPO update — env rollout,
+    GAE, and ``n_epochs`` clipped-surrogate epochs fused into the
+    single ``"train-step"`` program.  ``ts`` is DONATED — rebind it
+    (``ts, m = train_step(ts, ...)``); its buffers belong to XLA
+    after the call."""
+    return _train_step_impl(_dealias_donated(ts), env, tcfg)
+
+
+def init_train_ensemble(
+    keys: jax.Array,
+    params: EnvParams,
+    env: SwarmMARLEnv,
+    tcfg: TrainConfig,
+) -> TrainState:
+    """[E]-leaved learner ensemble: one independent policy + env
+    frontier per seed (``keys [E, 2]``), all stepping in one program
+    via :func:`train_step_ensemble` — the vmap-over-seeds scale hook
+    the meta-loop (ROADMAP item 5) reuses."""
+    keys = jnp.asarray(keys)
+    if keys.ndim != 2:
+        raise ValueError(
+            "init_train_ensemble wants batched keys [E, 2] — one "
+            f"PRNG stream per ensemble member; got shape {keys.shape}"
+        )
+    return jax.vmap(
+        lambda k: init_train_state(k, params, env, tcfg)
+    )(keys)
+
+
+def train_step_ensemble(
+    ts: TrainState, env: SwarmMARLEnv, tcfg: TrainConfig
+):
+    """The ensemble twin of :func:`train_step`: E independent
+    learners advance one update in one compiled program (metrics gain
+    a leading ``[E]`` axis).  ``ts`` is DONATED."""
+    return _train_step_ens_impl(_dealias_donated(ts), env, tcfg)
+
+
+def train_run(
+    ts: TrainState,
+    env: SwarmMARLEnv,
+    tcfg: TrainConfig,
+    n_updates: int,
+    ensemble: bool = False,
+):
+    """(TrainState, metrics): ``n_updates`` donated train steps with
+    the per-update metrics stacked host-side (``{name: [n_updates]}``
+    numpy arrays; ``[n_updates, E]`` for ensembles) — the loop every
+    example/bench drives.  One compiled program total: the carry
+    donation means update k+1 reuses update k's buffers."""
+    step = train_step_ensemble if ensemble else train_step
+    rows = []
+    for _ in range(n_updates):
+        ts, m = step(ts, env, tcfg)
+        rows.append(m)
+    metrics = {
+        k: np.stack([np.asarray(r[k]) for r in rows])
+        for k in (rows[0] if rows else {})
+    }
+    return ts, metrics
+
+
+# ---------------------------------------------------------------------------
+# Serving the learned policy
+
+
+@watched(POLICY_ROLLOUT_ENTRY)
+@partial(
+    jax.jit,
+    static_argnames=(
+        "env", "tcfg", "n_steps", "deterministic", "telemetry",
+    ),
+)
+def _policy_rollout_impl(
+    keys: jax.Array,
+    params: EnvParams,
+    net,
+    env: SwarmMARLEnv,
+    tcfg: TrainConfig,
+    n_steps: int,
+    deterministic: bool = True,
+    telemetry: bool = False,
+):
+    """``n_steps`` vmapped env steps under the LEARNED policy — the
+    compiled eval/serve rollout.  The network rides as traced data,
+    so one compiled program serves every checkpoint of one
+    architecture.  Key discipline mirrors
+    ``envs/core._env_rollout_impl`` exactly (reset from ``split[:,
+    0]``, per-step 3-way splits), so a zero network's deterministic
+    rollout steps the IDENTICAL episode stream the zero-action
+    protocol rollout does — the learned-vs-protocol comparison is
+    apples to apples by construction."""
+    telem_on = telemetry or env.cfg.telemetry.enabled
+    if telem_on and not env.cfg.telemetry.enabled:
+        env = env.replace(cfg=env.cfg.replace(telemetry=TELEMETRY_ON))
+
+    split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+    obs, states = jax.vmap(env.reset)(split[:, 0], params)
+
+    def body(carry, _):
+        lkeys, obs, states = carry
+        parts = jax.vmap(lambda k: jax.random.split(k, 3))(lkeys)
+        lkeys, akeys, skeys = parts[:, 0], parts[:, 1], parts[:, 2]
+        mean = actor_mean(net, obs)
+        if deterministic:
+            acts = mean
+        else:
+            noise = jax.vmap(
+                lambda ak, m: jax.random.normal(
+                    ak, m.shape, jnp.float32
+                )
+            )(akeys, mean)
+            acts = mean + jnp.exp(_log_std(net)) * noise
+        obs, states, rew, dones, info = jax.vmap(
+            lambda k, s, a: env.step(k, s, a)
+        )(skeys, states, acts)
+        telem = info["telemetry"] if telem_on else None
+        return (lkeys, obs, states), (rew, dones, telem)
+
+    (_, obs, states), (rewards, dones, telem) = jax.lax.scan(
+        body, (split[:, 1], obs, states), None, length=n_steps
+    )
+    out = (states, rewards, dones)
+    if telem_on:
+        if not n_steps:
+            telem = None
+        out = out + (telem,)
+    return out
+
+
+def policy_rollout(
+    keys: jax.Array,
+    env: SwarmMARLEnv,
+    params: EnvParams,
+    net,
+    tcfg: TrainConfig,
+    n_steps: int,
+    deterministic: bool = True,
+    telemetry: bool = False,
+):
+    """Public entry for the compiled learned-policy rollout (see
+    :func:`_policy_rollout_impl`).  ``keys`` must carry a leading
+    scenario axis matching ``params`` (``[S, 2]``)."""
+    keys = jnp.asarray(keys)
+    if keys.ndim != 2:
+        raise ValueError(
+            "policy_rollout wants batched keys [S, 2] — one PRNG "
+            f"stream per scenario; got shape {keys.shape} (wrap a "
+            "single key with key[None] and stack_env_params([params]))"
+        )
+    return _policy_rollout_impl(
+        keys, params, net, env, tcfg, n_steps, deterministic,
+        telemetry,
+    )

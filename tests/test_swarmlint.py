@@ -308,6 +308,29 @@ SEEDED = {
             )
         """,
     ),
+    "nondonated-carry": (
+        "pkg/trainloop.py",
+        """
+        from functools import partial
+
+        import jax
+        from distributed_swarm_algorithm_tpu.utils.compile_watch import (
+            watched,
+        )
+
+        @watched("toy-train-step")
+        @partial(jax.jit, static_argnames=("n_steps",))
+        def train(params, opt_state, n_steps):
+            def body(carry, _):
+                p, o = carry
+                return (p - 0.1 * o, o), None
+
+            (params, opt_state), _ = jax.lax.scan(
+                body, (params, opt_state), None, length=n_steps
+            )
+            return params, opt_state
+        """,
+    ),
     "done-branch": (
         "pkg/envreset.py",
         """
@@ -974,6 +997,120 @@ def test_cond_collective_reassigned_predicate_detected(tmp_path):
         str(tmp_path), ["reassigned.py"]
     )
     assert [f.rule for f in findings] == ["cond-collective"]
+
+
+def test_nondonated_carry_precision(tmp_path):
+    # The donated twin of the seeded fixture must be silent (the
+    # whole point is donation), as must an UN-watched helper with the
+    # same carry (short-lived internal loops update in place for one
+    # call — the rule gates long-lived entry points only) and a
+    # watched entry whose opt-ish names are builder INPUTS, not
+    # carried pytrees (the boids_run shape: params feeds the plan
+    # build; the carry is (state, plan)).
+    donated = """
+    from functools import partial
+
+    import jax
+    from distributed_swarm_algorithm_tpu.utils.compile_watch import (
+        watched,
+    )
+
+    @watched("toy-train-step-donated")
+    @partial(jax.jit, static_argnames=("n_steps",),
+             donate_argnums=(0, 1))
+    def train(params, opt_state, n_steps):
+        def body(carry, _):
+            p, o = carry
+            return (p - 0.1 * o, o), None
+
+        (params, opt_state), _ = jax.lax.scan(
+            body, (params, opt_state), None, length=n_steps
+        )
+        return params, opt_state
+    """
+    unwatched = """
+    import jax
+
+    @jax.jit
+    def helper(params, opt_state, n_steps):
+        def body(carry, _):
+            p, o = carry
+            return (p - 0.1 * o, o), None
+
+        return jax.lax.scan(
+            body, (params, opt_state), None, length=n_steps
+        )[0]
+    """
+    builder_input = """
+    from functools import partial
+
+    import jax
+    from distributed_swarm_algorithm_tpu.utils.compile_watch import (
+        watched,
+    )
+
+    def build_plan(state, params):
+        return state * params
+
+    @watched("toy-rollout")
+    @partial(jax.jit, static_argnames=("n_steps",))
+    def rollout(state, params, n_steps):
+        plan = build_plan(state, params)
+
+        def body(carry, _):
+            s, p = carry
+            return (s + p, p), None
+
+        (state, plan), _ = jax.lax.scan(
+            body, (state, plan), None, length=n_steps
+        )
+        return state
+    """
+    _write_tree(
+        str(tmp_path),
+        [
+            ("donated.py", donated),
+            ("unwatched.py", unwatched),
+            ("builder.py", builder_input),
+        ],
+    )
+    findings, _, _ = analysis.analyze_paths(
+        str(tmp_path),
+        ["donated.py", "unwatched.py", "builder.py"],
+    )
+    assert not [
+        f for f in findings if f.rule == "nondonated-carry"
+    ], [f.render() for f in findings]
+
+
+def test_nondonated_carry_indirect_carry_detected(tmp_path):
+    # One level of container indirection: the carry tuple bound to a
+    # name first (the common `carry0 = (params, m, v)` shape) still
+    # names the optimizer pytree.
+    src = """
+    from functools import partial
+
+    import jax
+    from distributed_swarm_algorithm_tpu.utils.compile_watch import (
+        watched,
+    )
+
+    @watched("toy-train-indirect")
+    @partial(jax.jit, static_argnames=("n_steps",))
+    def train(params, opt_m, n_steps):
+        def body(carry, _):
+            p, m = carry
+            return (p - m, m), None
+
+        carry0 = (params, opt_m)
+        out, _ = jax.lax.scan(body, carry0, None, length=n_steps)
+        return out
+    """
+    _write_tree(str(tmp_path), [("indirect.py", src)])
+    findings, _, _ = analysis.analyze_paths(
+        str(tmp_path), ["indirect.py"]
+    )
+    assert [f.rule for f in findings] == ["nondonated-carry"]
 
 
 def test_loop_carried_key_reuse_detected(tmp_path):

@@ -1,0 +1,424 @@
+"""The training plane (r20, train/): IPPO/MAPPO + capability classes.
+
+Load-bearing pins:
+
+- **Caps neutrality**: the r14 "zero action == protocol rollout
+  BITWISE" contract extends over the always-on capability machinery —
+  a heterogeneous env with the all-default class table (class 0
+  everywhere, every scale 1.0) steps the identical trajectory,
+  because every class gather is arithmetically a multiply-by-one.
+- **Zero-net policy parity**: a zero-weight network's deterministic
+  ``policy_rollout`` reproduces the zero-action ``env_rollout``
+  exactly (same key discipline by construction) — the learned-vs-
+  protocol bench comparison is apples to apples.
+- **One compiled train step**: repeated ``train_step`` calls mint ONE
+  compile-observatory signature (the acceptance pin: env rollout +
+  GAE + epochs are one fused program).
+- **Obs-plan Verlet carry**: with ``obs_skin > 0`` the carried KNN
+  plan's observations stay BITWISE equal to a per-step fresh build of
+  the same geometry — stale within the skin is exact by the Verlet
+  argument, and a rebuild reproduces the fresh build outright.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu import envs, serve, train
+from distributed_swarm_algorithm_tpu.utils import compile_watch as cw
+
+CFG = dsa.SwarmConfig().replace(
+    formation_shape="none", utility_threshold=2.0,
+    election_timeout_ticks=10, heartbeat_period_ticks=5,
+)
+T = 12
+
+#: The heterogeneous test env: 2 capability classes (obs gains the
+#: one-hot block), full-capacity per-cell cap so the KNN block is
+#: exact at this scale.
+HENV = envs.SwarmMARLEnv(
+    cfg=CFG, capacity=16, k_neighbors=2, obs_max_per_cell=16,
+    n_cap_classes=2,
+)
+TCFG = train.TrainConfig(rollout_steps=4, n_epochs=2, hidden=(16,))
+
+
+def _pursuit_params(env=HENV, **kw):
+    return envs.stack_env_params([
+        envs.pursuit_evasion(
+            env, n_agents=12, caps=train.pursuit_caps(env, n_agents=12),
+            max_steps=200, **kw,
+        )
+    ])
+
+
+@functools.lru_cache(maxsize=1)
+def _trained():
+    """A few updates on asymmetric pursuit — shared by the metric and
+    serving tests (one compile, one short run)."""
+    p = _pursuit_params()
+    ts = train.init_train_state(jax.random.PRNGKey(0), p, HENV, TCFG)
+    ts, hist = train.train_run(ts, HENV, TCFG, 4)
+    return ts, hist
+
+
+# ------------------------------------------------------------- caps
+
+
+def test_zero_action_parity_with_default_caps_table():
+    # THE extension of the r14 pin: heterogeneous env, DEFAULT table
+    # (every scale 1.0) — zero-action rollout bitwise equals the
+    # protocol rollout with the params baked static.
+    p = envs.stack_env_params([
+        envs.station_keeping(
+            HENV, n_agents=12, caps=train.default_caps(HENV)
+        )
+    ])
+    keys = jax.random.PRNGKey(7)[None]
+    states, rewards, dones = envs.env_rollout(keys, HENV, p, T)
+    row = envs.env_params_row(p, 0)
+    reset_key = jax.random.split(jax.random.PRNGKey(7), 2)[0]
+    solo = dsa.swarm_rollout(
+        HENV.materialize(reset_key, row), None,
+        serve.bake_params(CFG, row.scenario), T,
+    )
+    got = jax.tree_util.tree_map(lambda x: x[0], states.swarm)
+    for f in ("pos", "vel", "alive", "fsm", "leader_id"):
+        assert np.array_equal(
+            np.asarray(getattr(solo, f)), np.asarray(getattr(got, f))
+        ), f"default caps table perturbed the protocol on {f}"
+
+
+def test_asymmetric_caps_change_dynamics():
+    # The speed table actually bites: under a huge uniform action the
+    # velocity clamp is per-class, so evaders (speed_scale 1.2)
+    # outrun pursuers by exactly the table ratio.
+    p = _pursuit_params()
+    _, st = jax.vmap(HENV.reset)(
+        jax.random.PRNGKey(5)[None], p
+    )
+    big = jnp.full((1, HENV.capacity, 2), 100.0, jnp.float32)
+    step = jax.jit(
+        lambda k, s, a: jax.vmap(HENV.step)(k[None], s, a)
+    )
+    _, st2, _, _, _ = step(jax.random.PRNGKey(1), st, big)
+    vel = np.linalg.norm(np.asarray(st2.swarm.vel[0]), axis=-1)
+    row = envs.env_params_row(p, 0)
+    cls = np.asarray(row.cap_class)
+    alive = np.asarray(st2.swarm.alive[0])
+    v0 = vel[alive & (cls == 0)]
+    v1 = vel[alive & (cls == 1)]
+    ms = float(np.asarray(row.scenario.max_speed))
+    lim = HENV.act_limit
+    # Every agent rides one of two regimes: APF-pulled (speed clamp
+    # bites: ms x speed_scale) or arrived (the clamped action is the
+    # whole force: act_limit x act_scale).  Both tables must show.
+    def _near(x, targets):
+        return np.isclose(x[:, None], np.asarray(targets)[None, :],
+                          rtol=1e-4).any(axis=1)
+
+    assert _near(v0, [lim, ms]).all(), v0
+    assert _near(v1, [0.8 * lim, 1.2 * ms]).all(), v1
+    assert np.isclose(v1, 1.2 * ms, rtol=1e-4).any()   # speed bites
+    assert np.isclose(v1, 0.8 * lim, rtol=1e-4).any()  # act bites
+
+
+def test_caps_obs_one_hot_block():
+    assert HENV.obs_dim == (
+        10 + 5 * HENV.k_neighbors + 4 * HENV.n_tasks
+        + HENV.n_cap_classes
+    )
+    p = _pursuit_params()
+    obs, st = jax.vmap(HENV.reset)(jax.random.PRNGKey(2)[None], p)
+    obs = np.asarray(obs[0])
+    cls = np.asarray(envs.env_params_row(p, 0).cap_class)
+    alive = np.asarray(st.swarm.alive[0])
+    block = obs[:, -HENV.n_cap_classes:]
+    want = np.eye(HENV.n_cap_classes, dtype=np.float32)[cls]
+    assert np.array_equal(block[alive], want[alive])
+    assert (obs[~alive] == 0).all()
+
+
+def test_caps_validation_errors():
+    with pytest.raises(ValueError, match="n_cap_classes"):
+        train.pursuit_caps(
+            envs.SwarmMARLEnv(cfg=CFG, capacity=8)
+        )
+    with pytest.raises(ValueError, match="classes"):
+        train.caps_kwargs(HENV, [train.DEFAULT_CLASS], [0] * 16)
+    with pytest.raises(ValueError, match="assignment"):
+        train.caps_kwargs(
+            HENV, [train.DEFAULT_CLASS] * 2, [0] * 4
+        )
+    with pytest.raises(ValueError, match="cap_class"):
+        envs.make_env_params(
+            HENV, envs.STATION, cap_class=[5] * 16
+        )
+    with pytest.raises(ValueError, match="cap_act"):
+        envs.make_env_params(
+            HENV, envs.STATION, cap_act=[1.0, 0.0]
+        )
+    with pytest.raises(ValueError, match="n_cap_classes"):
+        envs.SwarmMARLEnv(cfg=CFG, capacity=8, n_cap_classes=0)
+
+
+# ------------------------------------------------------- train step
+
+
+def test_train_step_one_compiled_program_and_finite_metrics():
+    cached, hist = _trained()
+    # The cached state is shared by other tests and train_step
+    # DONATES its argument — step a deep copy, never the original.
+    ts = jax.tree_util.tree_map(jnp.copy, cached)
+    # One fused program: repeated updates reuse one cache entry (the
+    # lru-cached run above did 4; mint a 5th to be sure the watch
+    # sees a steady state, under an enabled observatory).
+    watch = cw.WATCH
+    was_enabled = watch.enabled
+    watch.enable()
+    try:
+        ts, m = train.train_step(ts, HENV, TCFG)
+        ts, m = train.train_step(ts, HENV, TCFG)
+        assert watch.compile_count(train.TRAIN_STEP_ENTRY) <= 1
+    finally:
+        if not was_enabled:
+            watch.disable()
+    for k, v in m.items():
+        assert np.isfinite(np.asarray(v)).all(), f"metric {k} not finite"
+    for k in ("reward_mean", "loss", "pg_loss", "v_loss", "entropy",
+              "approx_kl", "grad_norm"):
+        assert hist[k].shape == (4,)
+        assert np.isfinite(hist[k]).all(), f"history {k} not finite"
+    # The optimizer actually stepped: 4 (cached) + 2 updates x
+    # n_epochs Adam steps.
+    assert int(ts.opt_t) == 6 * TCFG.n_epochs
+
+
+@pytest.mark.slow
+def test_mappo_variant_runs_and_differs():
+    # Slow-marked (tier-1 870 s budget): a second full train-step
+    # compile (the centralized-critic graph); the IPPO twin pins the
+    # shared machinery in tier-1.
+    tcfg = train.TrainConfig(
+        rollout_steps=4, n_epochs=2, hidden=(16,), algo="mappo"
+    )
+    assert tcfg.critic_in(HENV.obs_dim) == 2 * HENV.obs_dim
+    p = _pursuit_params()
+    ts = train.init_train_state(jax.random.PRNGKey(0), p, HENV, tcfg)
+    w0 = ts.params["critic"][0][0]
+    assert w0.shape[0] == 2 * HENV.obs_dim
+    ts, m = train.train_step(ts, HENV, tcfg)
+    assert np.isfinite(float(m["loss"]))
+
+
+@pytest.mark.slow
+def test_ensemble_vmap_over_seeds():
+    # Slow-marked (tier-1 870 s budget): a third train-step compile
+    # (the vmapped ensemble core).
+    p = _pursuit_params()
+    keys = jnp.stack([jax.random.PRNGKey(i) for i in range(3)])
+    tse = train.init_train_ensemble(keys, p, HENV, TCFG)
+    tse, m = train.train_step_ensemble(tse, HENV, TCFG)
+    assert m["reward_mean"].shape == (3,)
+    # Independent members: different seeds -> different params.
+    w = np.asarray(tse.params["actor"][0][0])
+    assert not np.array_equal(w[0], w[1])
+    with pytest.raises(ValueError, match="batched keys"):
+        train.init_train_ensemble(
+            jax.random.PRNGKey(0), p, HENV, TCFG
+        )
+
+
+def test_train_config_validation():
+    with pytest.raises(ValueError, match="algo"):
+        train.TrainConfig(algo="ppo2")
+    with pytest.raises(ValueError, match="rollout_steps"):
+        train.TrainConfig(rollout_steps=0)
+    with pytest.raises(ValueError, match="n_epochs"):
+        train.TrainConfig(n_epochs=0)
+
+
+def test_env_params_survive_donation():
+    # The donated carry must not eat the CALLER's EnvParams (they are
+    # copied at init): training then evaluating with the same params
+    # object must work.
+    p = _pursuit_params()
+    ts = train.init_train_state(jax.random.PRNGKey(4), p, HENV, TCFG)
+    ts, _ = train.train_step(ts, HENV, TCFG)
+    # p still usable — a fresh learner and an eval rollout both read it.
+    ts2 = train.init_train_state(jax.random.PRNGKey(5), p, HENV, TCFG)
+    st, rew, dn = train.policy_rollout(
+        jax.random.PRNGKey(6)[None], HENV, p, ts2.params, TCFG, 4
+    )
+    assert np.isfinite(np.asarray(rew)).all()
+
+
+# -------------------------------------------------- policy rollout
+
+
+def test_policy_rollout_zero_net_parity():
+    # A zero network's deterministic rollout == the zero-action env
+    # rollout, rewards included — the learned-vs-protocol comparison
+    # is same-episode by construction.
+    p = _pursuit_params()
+    keys = jax.random.PRNGKey(11)[None]
+    net0 = jax.tree_util.tree_map(
+        jnp.zeros_like,
+        train.init_policy_params(
+            jax.random.PRNGKey(0), HENV.obs_dim, 2, TCFG
+        ),
+    )
+    st_a, rew_a, dn_a = train.policy_rollout(
+        keys, HENV, p, net0, TCFG, T
+    )
+    st_b, rew_b, dn_b = envs.env_rollout(keys, HENV, p, T)
+    for f in ("pos", "vel", "alive"):
+        assert np.array_equal(
+            np.asarray(getattr(st_a.swarm, f)),
+            np.asarray(getattr(st_b.swarm, f)),
+        ), f"zero-net policy rollout diverged on {f}"
+    assert np.array_equal(np.asarray(rew_a), np.asarray(rew_b))
+    with pytest.raises(ValueError, match="batched keys"):
+        train.policy_rollout(
+            jax.random.PRNGKey(0), HENV, p, net0, TCFG, 2
+        )
+
+
+def test_train_rollouts_through_buckets():
+    # 5 learned-policy scenarios through the batch-rung lattice (rung
+    # 4: one full dispatch + one padded with fillers) — each result
+    # bitwise-equals its direct batch-of-1 policy rollout.
+    ts, _ = _trained()
+    scen = [
+        envs.pursuit_evasion(
+            HENV, n_agents=10 + i,
+            caps=train.pursuit_caps(HENV, n_agents=10 + i),
+            max_steps=200,
+        )
+        for i in range(5)
+    ]
+    res = serve.train_rollouts(
+        HENV, scen, seeds=range(5), n_steps=T, net=ts.params,
+        tcfg=TCFG, spec=serve.BucketSpec(batches=(4,)),
+    )
+    assert [r.index for r in res] == list(range(5))
+    for i in (0, 4):
+        st1, rew1, _ = train.policy_rollout(
+            jax.random.PRNGKey(i)[None], HENV,
+            envs.stack_env_params([scen[i]]), ts.params, TCFG, T,
+        )
+        assert np.array_equal(
+            np.asarray(res[i].state.swarm.pos),
+            np.asarray(st1.swarm.pos[0]),
+        ), f"bucketed learned rollout {i} diverged"
+        assert np.array_equal(
+            np.asarray(res[i].rewards), np.asarray(rew1)[:, 0]
+        )
+    with pytest.raises(ValueError, match="seeds"):
+        serve.train_rollouts(
+            HENV, scen, seeds=[0], n_steps=T, net=ts.params,
+            tcfg=TCFG,
+        )
+
+
+# ------------------------------------------------ obs plan carry
+
+
+def _roll_with_plans(env, p, n_steps, kill_at=None):
+    """Host-stepped rollout collecting (obs, swarm, carried plan) per
+    step — the step-by-step lens the bitwise pin needs."""
+    step = jax.jit(
+        lambda k, s, a: jax.vmap(env.step)(
+            k[None], s, jnp.zeros((1, env.capacity, 2), jnp.float32)
+        )
+    )
+    obs, st = jax.vmap(env.reset)(jax.random.PRNGKey(3)[None], p)
+    key = jax.random.PRNGKey(9)
+    frames = []
+    for t in range(n_steps):
+        if kill_at is not None and t == kill_at:
+            from distributed_swarm_algorithm_tpu.ops.coordination import (
+                kill,
+            )
+
+            swarm = jax.tree_util.tree_map(
+                lambda x: x[0], st.swarm
+            )
+            swarm = kill(swarm, [1])
+            st = envs.EnvState(
+                swarm=jax.tree_util.tree_map(
+                    lambda x: x[None], swarm
+                ),
+                t=st.t, params=st.params, obs_plan=st.obs_plan,
+            )
+        key, sk = jax.random.split(key)
+        obs, st, _, _, _ = step(sk, st, None)
+        frames.append((np.asarray(obs[0]), st))
+    return frames
+
+
+def test_obs_plan_carry_bitwise_vs_fresh_build():
+    # Carried-plan observations == a fresh same-geometry build's
+    # observations at EVERY step — stale-but-within-skin is exact
+    # (Verlet coverage + true-distance ranking), a rebuilt plan is
+    # the fresh build outright.  Station-keeping (agents hold spawn):
+    # no trigger ever fires, so the carry actually amortizes.
+    env = envs.SwarmMARLEnv(
+        cfg=CFG, capacity=16, k_neighbors=2, obs_max_per_cell=16,
+        obs_skin=4.0,
+    )
+    p = envs.stack_env_params(
+        [envs.station_keeping(env, n_agents=12, max_steps=500)]
+    )
+    frames = _roll_with_plans(env, p, 8)
+    for t, (obs, st) in enumerate(frames):
+        swarm = jax.tree_util.tree_map(lambda x: x[0], st.swarm)
+        fresh = env.build_obs_plan(swarm)
+        want = np.asarray(env.obs(swarm, plan=fresh))
+        assert np.array_equal(obs, want), (
+            f"carried-plan obs diverged from fresh build at step {t}"
+        )
+    final = frames[-1][1]
+    assert int(final.obs_plan.rebuilds[0]) == 0, (
+        "station-keeping fired a rebuild — the carry isn't amortizing"
+    )
+    assert int(final.obs_plan.age[0]) == 8
+
+
+@pytest.mark.slow
+def test_obs_plan_alive_trigger_rebuilds():
+    # Slow-marked (tier-1 870 s budget): the no-rebuild bitwise pin
+    # above is the satellite's load-bearing contract; this is the
+    # trigger-coverage twin.
+    # A kill invalidates the live-only keying — the alive trigger
+    # must rebuild, and the observations stay equal to fresh builds
+    # through the transition.
+    env = envs.SwarmMARLEnv(
+        cfg=CFG, capacity=16, k_neighbors=2, obs_max_per_cell=16,
+        obs_skin=4.0,
+    )
+    p = envs.stack_env_params(
+        [envs.station_keeping(env, n_agents=12, max_steps=500)]
+    )
+    frames = _roll_with_plans(env, p, 6, kill_at=3)
+    for t, (obs, st) in enumerate(frames):
+        swarm = jax.tree_util.tree_map(lambda x: x[0], st.swarm)
+        fresh = env.build_obs_plan(swarm)
+        want = np.asarray(env.obs(swarm, plan=fresh))
+        assert np.array_equal(obs, want), f"step {t} diverged"
+    assert int(frames[-1][1].obs_plan.rebuilds[0]) >= 1
+
+
+def test_obs_plan_validation():
+    with pytest.raises(ValueError, match="obs_skin"):
+        envs.SwarmMARLEnv(cfg=CFG, capacity=8, obs_skin=-1.0)
+    with pytest.raises(ValueError, match="obs_rebuild_every"):
+        envs.SwarmMARLEnv(
+            cfg=CFG, capacity=8, obs_rebuild_every=4
+        )

@@ -283,8 +283,9 @@ def _run_swarmlint(root, recorded, record: bool) -> bool:
     """Static-hazard gate as a metric: one fixed-name
     ``swarmlint-findings`` line (new + baselined count) so the union
     gate tracks hygiene-debt regressions across rounds the same way it
-    tracks throughput.  compare.py treats unit "findings" as
-    lower-is-better.  Returns False when the analyzer reports new
+    tracks throughput, plus the r21 ``racelint-findings`` line (the
+    race-* slice of the same run).  compare.py treats unit "findings"
+    as lower-is-better.  Returns False when the analyzer reports new
     (non-baselined) findings or fails to run."""
     try:
         proc = subprocess.run(
@@ -314,6 +315,20 @@ def _run_swarmlint(root, recorded, record: bool) -> bool:
     print(json.dumps(line), flush=True)
     if record:
         recorded.append(line)
+    # The racelint slice (r21) rides the same subprocess run as its
+    # own fixed-name row: host-concurrency debt (race-* findings, new
+    # + baselined) gated separately from general hazard debt, still
+    # under the lower-is-better "findings" unit compare.py already
+    # handles.
+    race_line = {
+        "metric": "racelint-findings",
+        "value": float(counts.get("racelint", 0)),
+        "unit": "findings",
+        "vs_baseline": None,
+    }
+    print(json.dumps(race_line), flush=True)
+    if record:
+        recorded.append(race_line)
     if proc.returncode != 0:
         print(
             f"# swarmlint: {counts['new']} new finding(s) — run "

@@ -13,7 +13,11 @@ per-iteration spatial-index rebuilds, ungated flight-recorder
 collection in scan bodies, host branches on traced done flags in env
 rollouts, collectives under non-uniform cond predicates in shard_map
 bodies, dtype drift in ops/ hot paths, the fused-kernel dispatch
-contract, and bench metric-name hygiene.  See
+contract, and bench metric-name hygiene.  As of r21 the four
+cross-module rules ride a project-wide call-graph engine
+(``callgraph.py``) and a fifth hazard family — **racelint**
+(``rules_concurrency.py``) — audits host-thread lock discipline over
+the serve plane's shared mutable state.  See
 docs/STATIC_ANALYSIS.md for the rule catalog, the suppression
 policy, and how to add a rule.
 
@@ -51,6 +55,10 @@ from . import rules_prng    # noqa: E402,F401
 from . import rules_trace   # noqa: E402,F401
 from . import rules_dtype   # noqa: E402,F401
 from . import rules_contract  # noqa: E402,F401
+from . import rules_concurrency  # noqa: E402,F401  (racelint, r21)
+
+from . import callgraph  # noqa: E402,F401  (cross-module engine, r21)
+from .rules_concurrency import racelint_rules  # noqa: E402,F401
 
 #: What `python -m distributed_swarm_algorithm_tpu.analysis` scans
 #: when given no paths (repo-relative).
@@ -72,7 +80,9 @@ __all__ = [
     "analyze_module",
     "analyze_paths",
     "baseline",
+    "callgraph",
     "iter_py_files",
     "parse_suppressions",
+    "racelint_rules",
     "register",
 ]

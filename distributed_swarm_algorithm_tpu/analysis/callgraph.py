@@ -1,0 +1,516 @@
+"""Project-wide call-graph + symbol-resolution engine (r21).
+
+Before this module, every rule that needed to follow calls carried its
+own private closure walker over a bare-name ``dict`` — four copies of
+the same BFS (serve-host-sync, halo-width, cond-collective, span-leak),
+each blind past its module boundary.  ``Project`` centralizes that walk
+and extends it across modules:
+
+* **module globals** — ``TRACER.dump()`` resolves through a top-level
+  ``TRACER = SpanTracer(...)`` binding to ``SpanTracer.dump``, in the
+  same module or through an import alias
+  (``metricslib.METRICS.counter``);
+* **class/method tables** — ``self.f()`` resolves to the enclosing
+  class's method (walking base classes declared in the project);
+* **instance-attribute methods** — ``self.tracer.span()`` resolves via
+  ``self.tracer = TRACER if tracer is None else tracer`` (constructor
+  calls, if/or alternatives, and parameter annotations all contribute
+  candidate classes);
+* **functools.partial / decorator unwrapping** —
+  ``partial(f, x)(...)`` and ``@partial(shard_map, ...)`` both reach
+  ``f``.
+
+Resolution is deliberately *under*-approximate: an expression that
+cannot be resolved contributes no edge.  Rules built on top therefore
+keep swarmlint's precision bias — silence is never proof of absence,
+but a reported path is a real lexical path.
+
+Like everything in ``analysis/``, this works on source text alone and
+never imports the code it reads.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+#: Constructors whose results are mutable containers (used by
+#: rules_concurrency's shared-state footprint; kept here because the
+#: tables that recognize them are built here).
+MUTABLE_CONSTRUCTORS = frozenset(
+    {"dict", "list", "set", "collections.deque", "deque",
+     "collections.defaultdict", "defaultdict",
+     "collections.OrderedDict", "OrderedDict",
+     "collections.Counter", "Counter"}
+)
+
+#: Context-manager protocol methods pulled into the closure when a
+#: class constructor is a call target: ``with Foo(...):`` executes all
+#: three, and a body that stashes the instance reaches them later.
+_CTOR_PROTOCOL = ("__init__", "__enter__", "__exit__")
+
+
+def module_dotted(relpath: str) -> str:
+    """Dotted module name of a repo-relative path:
+    ``pkg/serve/loop.py`` -> ``pkg.serve.loop`` (``__init__`` maps to
+    its package)."""
+    dotted = relpath[:-3] if relpath.endswith(".py") else relpath
+    dotted = dotted.replace("/", ".")
+    if dotted.endswith(".__init__"):
+        dotted = dotted[: -len(".__init__")]
+    return dotted
+
+
+class FuncRef:
+    """A function definition located in the project: AST node + the
+    module it lives in + (for directly-defined methods) its class."""
+
+    __slots__ = ("mod", "node", "cls")
+
+    def __init__(self, mod, node, cls=None):
+        self.mod = mod
+        self.node = node
+        self.cls = cls
+
+    @property
+    def name(self) -> str:
+        return getattr(self.node, "name", "<lambda>")
+
+    def key(self) -> int:
+        return id(self.node)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"FuncRef({self.mod.relpath}:{self.name})"
+
+
+class ClassInfo:
+    """A class definition: direct method table + lazily-inferred
+    instance-attribute class candidates."""
+
+    __slots__ = ("mod", "node", "name", "methods")
+
+    def __init__(self, mod, node):
+        self.mod = mod
+        self.node = node
+        self.name = node.name
+        self.methods: Dict[str, ast.AST] = {}
+        for st in node.body:
+            if isinstance(st, _FUNC_DEFS):
+                self.methods.setdefault(st.name, st)
+
+    def key(self) -> Tuple[str, str]:
+        return (self.mod.relpath, self.name)
+
+
+def _param_annotation(fn, name: str):
+    """Annotation expr of parameter ``name`` of ``fn`` (or None)."""
+    if isinstance(fn, ast.Lambda) or fn is None:
+        return None
+    args = fn.args
+    for a in (
+        list(args.posonlyargs) + list(args.args)
+        + list(args.kwonlyargs)
+        + ([args.vararg] if args.vararg else [])
+        + ([args.kwarg] if args.kwarg else [])
+    ):
+        if a is not None and a.arg == name:
+            return a.annotation
+    return None
+
+
+class Project:
+    """Symbol tables + call resolution over a set of ``ModuleInfo``s.
+
+    One instance is built per analysis run (``analyze_paths`` spans
+    every scanned file; ``analyze_module`` wraps the single module) and
+    attached to each module as ``mod.project``.  ``cache`` is scratch
+    space for rules that compute a project-global model once
+    (racelint's thread-root reach, serve-host-sync's hot closure).
+    """
+
+    def __init__(self, modules: Iterable):
+        self.modules = list(modules)
+        self.by_relpath = {m.relpath: m for m in self.modules}
+        self._by_dotted = {
+            module_dotted(m.relpath): m for m in self.modules
+        }
+        self._tables: Dict[int, dict] = {}
+        self._attr_cache: Dict[Tuple[int, str], list] = {}
+        self.cache: Dict[str, object] = {}
+        for m in self.modules:
+            m.project = self
+
+    # -- per-module tables -------------------------------------------------
+
+    def tables(self, mod) -> dict:
+        t = self._tables.get(id(mod))
+        if t is None:
+            t = self._build_tables(mod)
+            self._tables[id(mod)] = t
+        return t
+
+    def _build_tables(self, mod) -> dict:
+        by_name: Dict[str, list] = {}
+        classes: Dict[str, ClassInfo] = {}
+        owner: Dict[int, ClassInfo] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, _FUNC_DEFS):
+                by_name.setdefault(node.name, []).append(node)
+            elif isinstance(node, ast.ClassDef):
+                ci = ClassInfo(mod, node)
+                classes.setdefault(node.name, ci)
+                for meth in ci.methods.values():
+                    owner.setdefault(id(meth), ci)
+        top: Dict[str, ast.AST] = {}
+        instances: Dict[str, ast.AST] = {}
+        for st in mod.tree.body:
+            if isinstance(st, _FUNC_DEFS):
+                top.setdefault(st.name, st)
+            elif isinstance(st, ast.Assign) and len(st.targets) == 1:
+                tgt = st.targets[0]
+                if isinstance(tgt, ast.Name) and isinstance(
+                    st.value, ast.Call
+                ):
+                    instances.setdefault(tgt.id, st.value.func)
+            elif isinstance(st, ast.AnnAssign) and isinstance(
+                st.target, ast.Name
+            ) and isinstance(st.value, ast.Call):
+                instances.setdefault(st.target.id, st.value.func)
+        return {
+            "by_name": by_name,
+            "classes": classes,
+            "owner": owner,
+            "top": top,
+            "instances": instances,
+        }
+
+    def funcs_by_name(self, mod) -> Dict[str, list]:
+        """All function/method defs in ``mod`` keyed by bare name —
+        the table the four legacy closure walkers each rebuilt."""
+        return self.tables(mod)["by_name"]
+
+    def owner_class(self, mod, fn) -> Optional[ClassInfo]:
+        """ClassInfo whose body directly defines ``fn`` (or None)."""
+        return self.tables(mod)["owner"].get(id(fn))
+
+    def func_ref(self, mod, fn) -> FuncRef:
+        return FuncRef(mod, fn, self.owner_class(mod, fn))
+
+    # -- dotted-name navigation -------------------------------------------
+
+    def _find_module(self, dotted: str):
+        """Module whose dotted name is ``dotted`` or uniquely ends with
+        it — relative imports surface as suffix paths
+        (``from ..utils import trace`` resolves through
+        ``utils.trace``)."""
+        m = self._by_dotted.get(dotted)
+        if m is not None:
+            return m
+        tail = "." + dotted
+        hits = [
+            mm for k, mm in self._by_dotted.items() if k.endswith(tail)
+        ]
+        return hits[0] if len(hits) == 1 else None
+
+    def lookup_dotted(self, mod, dotted: str):
+        """Resolve an alias-expanded dotted chain to a project symbol.
+
+        Returns ``("func", FuncRef)``, ``("class", ClassInfo)``,
+        ``("instance", ClassInfo)`` (a module-global built by a
+        constructor call — the ClassInfo is the instance's class), or
+        ``None``.
+        """
+        parts = dotted.split(".")
+        hit = self._navigate(mod, parts)
+        if hit is not None:
+            return hit
+        for i in range(len(parts) - 1, 0, -1):
+            m2 = self._find_module(".".join(parts[:i]))
+            if m2 is not None and m2 is not mod:
+                return self._navigate(m2, parts[i:])
+        return None
+
+    def _navigate(self, mod, parts: list):
+        if not parts:
+            return None
+        t = self.tables(mod)
+        head, rest = parts[0], parts[1:]
+        if not rest:
+            fn = t["top"].get(head)
+            if fn is not None:
+                return ("func", FuncRef(mod, fn, None))
+            ci = t["classes"].get(head)
+            if ci is not None:
+                return ("class", ci)
+            inst = self.instance_class(mod, head)
+            if inst is not None:
+                return ("instance", inst)
+            return None
+        if len(rest) == 1:
+            ci = t["classes"].get(head) or self.instance_class(
+                mod, head
+            )
+            if ci is not None:
+                m = self.method_of(ci, rest[0])
+                if m is not None:
+                    return ("func", m)
+        return None
+
+    def instance_class(self, mod, name: str) -> Optional[ClassInfo]:
+        """Class of a module-global ``NAME = ClassName(...)``."""
+        ctor = self.tables(mod)["instances"].get(name)
+        if ctor is None:
+            return None
+        return self.resolve_class(mod, ctor)
+
+    def resolve_class(self, mod, expr) -> Optional[ClassInfo]:
+        """ClassInfo named by a Name/Attribute expr (same module or
+        through an import alias)."""
+        if isinstance(expr, ast.Name):
+            ci = self.tables(mod)["classes"].get(expr.id)
+            if ci is not None:
+                return ci
+        dotted = mod.resolve(expr)
+        if dotted:
+            hit = self.lookup_dotted(mod, dotted)
+            if hit is not None and hit[0] == "class":
+                return hit[1]
+        return None
+
+    def method_of(
+        self, ci: ClassInfo, name: str, _depth: int = 0
+    ) -> Optional[FuncRef]:
+        """Method ``name`` of ``ci`` or a project-resolved base."""
+        meth = ci.methods.get(name)
+        if meth is not None:
+            return FuncRef(ci.mod, meth, ci)
+        if _depth >= 4:
+            return None
+        for base in ci.node.bases:
+            bi = self.resolve_class(ci.mod, base)
+            if bi is not None and bi is not ci:
+                hit = self.method_of(bi, name, _depth + 1)
+                if hit is not None:
+                    return hit
+        return None
+
+    # -- instance-attribute class inference --------------------------------
+
+    def attr_classes(self, ci: ClassInfo, attr: str) -> list:
+        """Candidate classes of ``self.<attr>`` on ``ci``, inferred
+        from every ``self.<attr> = ...`` in the class body (constructor
+        calls, ``a if c else b`` / ``a or b`` alternatives, annotated
+        parameters, module-global instances)."""
+        key = (id(ci.node), attr)
+        out = self._attr_cache.get(key)
+        if out is not None:
+            return out
+        out = []
+        seen = set()
+        for meth in ci.methods.values():
+            for node in ast.walk(meth):
+                value = None
+                if isinstance(node, ast.Assign):
+                    tgts, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    tgts, value = [node.target], node.value
+                else:
+                    continue
+                for tgt in tgts:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and tgt.attr == attr
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        for cand in self._classes_of_value(
+                            ci.mod, value, meth
+                        ):
+                            if cand.key() not in seen:
+                                seen.add(cand.key())
+                                out.append(cand)
+        self._attr_cache[key] = out
+        return out
+
+    def _classes_of_value(self, mod, expr, fn) -> list:
+        if isinstance(expr, ast.Call):
+            ci = self.resolve_class(mod, expr.func)
+            return [ci] if ci is not None else []
+        if isinstance(expr, ast.IfExp):
+            return self._classes_of_value(
+                mod, expr.body, fn
+            ) + self._classes_of_value(mod, expr.orelse, fn)
+        if isinstance(expr, ast.BoolOp):
+            out = []
+            for v in expr.values:
+                out.extend(self._classes_of_value(mod, v, fn))
+            return out
+        if isinstance(expr, ast.Name):
+            inst = self.instance_class(mod, expr.id)
+            if inst is None:
+                dotted = mod.resolve(expr)
+                if dotted and dotted != expr.id:
+                    hit = self.lookup_dotted(mod, dotted)
+                    if hit is not None and hit[0] == "instance":
+                        inst = hit[1]
+            if inst is not None:
+                return [inst]
+            ann = _param_annotation(fn, expr.id)
+            ci = self.class_from_annotation(mod, ann)
+            return [ci] if ci is not None else []
+        if isinstance(expr, ast.Attribute):
+            dotted = mod.resolve(expr)
+            if dotted:
+                hit = self.lookup_dotted(mod, dotted)
+                if hit is not None and hit[0] == "instance":
+                    return [hit[1]]
+        return []
+
+    def class_from_annotation(self, mod, ann) -> Optional[ClassInfo]:
+        """Class named by an annotation, unwrapping ``Optional[...]``/
+        ``Union[...]`` subscripts and string forward references."""
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            name = ann.value.strip().rsplit(".", 1)[-1]
+            return self.tables(mod)["classes"].get(name)
+        if isinstance(ann, ast.Subscript):
+            sl = ann.slice
+            elts = sl.elts if isinstance(sl, ast.Tuple) else [sl]
+            for e in elts:
+                ci = self.class_from_annotation(mod, e)
+                if ci is not None:
+                    return ci
+            return None
+        if isinstance(ann, (ast.Name, ast.Attribute)):
+            return self.resolve_class(mod, ann)
+        return None
+
+    # -- call resolution ----------------------------------------------------
+
+    def _ctor_refs(self, ci: ClassInfo) -> list:
+        return [
+            FuncRef(ci.mod, ci.methods[m], ci)
+            for m in _CTOR_PROTOCOL
+            if m in ci.methods
+        ]
+
+    def _hit_to_funcs(self, hit) -> list:
+        if hit is None:
+            return []
+        kind, obj = hit
+        if kind == "func":
+            return [obj]
+        if kind == "class":
+            return self._ctor_refs(obj)
+        return []
+
+    def resolve_callable(
+        self, mod, expr, cls=None, follow_attr=False
+    ) -> list:
+        """FuncRefs an expression in call position can reach.
+
+        ``cls`` is the enclosing ClassInfo (enables ``self.*``
+        resolution); ``follow_attr`` enables the legacy terminal-name
+        fallback for unresolvable attribute calls (``obj.f()`` matches
+        any same-module def named ``f`` — serve-host-sync semantics).
+        """
+        if isinstance(expr, ast.Call):
+            # functools.partial(f, ...) used in call/target position.
+            if mod.resolve(expr.func) in (
+                "functools.partial", "partial"
+            ) and expr.args:
+                return self.resolve_callable(
+                    mod, expr.args[0], cls=cls, follow_attr=follow_attr
+                )
+            return []
+        if isinstance(expr, ast.Name):
+            t = self.tables(mod)
+            hits = t["by_name"].get(expr.id)
+            if hits:
+                return [
+                    FuncRef(mod, h, t["owner"].get(id(h)))
+                    for h in hits
+                ]
+            ci = t["classes"].get(expr.id)
+            if ci is not None:
+                return self._ctor_refs(ci)
+            dotted = mod.resolve(expr)
+            if dotted and dotted != expr.id:
+                return self._hit_to_funcs(
+                    self.lookup_dotted(mod, dotted)
+                )
+            return []
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if (
+                cls is not None
+                and isinstance(base, ast.Name)
+                and base.id == "self"
+            ):
+                m = self.method_of(cls, expr.attr)
+                if m is not None:
+                    return [m]
+            if (
+                cls is not None
+                and isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"
+            ):
+                out = []
+                for ci in self.attr_classes(cls, base.attr):
+                    m = self.method_of(ci, expr.attr)
+                    if m is not None:
+                        out.append(m)
+                if out:
+                    return out
+            dotted = mod.resolve(expr)
+            if dotted:
+                fs = self._hit_to_funcs(self.lookup_dotted(mod, dotted))
+                if fs:
+                    return fs
+            if follow_attr:
+                t = self.tables(mod)
+                return [
+                    FuncRef(mod, h, t["owner"].get(id(h)))
+                    for h in t["by_name"].get(expr.attr, [])
+                ]
+            return []
+        return []
+
+    def callees(self, mod, call, cls=None, follow_attr=False) -> list:
+        """FuncRefs a Call node can invoke."""
+        return self.resolve_callable(
+            mod, call.func, cls=cls, follow_attr=follow_attr
+        )
+
+    def closure(
+        self, roots: Iterable[FuncRef], follow_attr=False, skip=None
+    ):
+        """Transitive call closure: ``{id(node): FuncRef}`` for every
+        function reachable from ``roots`` (roots included).
+
+        ``skip`` is an optional predicate over callee FuncRefs: a
+        callee it accepts is neither entered nor expanded (roots are
+        always entered) — rules use it to stop at semantic boundaries
+        such as traced functions.
+        """
+        seen: Dict[int, FuncRef] = {}
+        frontier = list(roots)
+        while frontier:
+            fr = frontier.pop()
+            if fr.key() in seen:
+                continue
+            seen[fr.key()] = fr
+            for node in ast.walk(fr.node):
+                if isinstance(node, ast.Call):
+                    for cal in self.callees(
+                        fr.mod, node,
+                        cls=fr.cls, follow_attr=follow_attr,
+                    ):
+                        if skip is not None and skip(cal):
+                            continue
+                        frontier.append(cal)
+        return seen

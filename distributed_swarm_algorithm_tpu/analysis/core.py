@@ -181,6 +181,10 @@ class ModuleInfo:
         self._aliases: dict = {}
         self._build_tables()
         self._traced: set | None = None
+        #: Cross-module view, attached by callgraph.Project when this
+        #: module is analyzed as part of a project (analyze_paths spans
+        #: every scanned file; analyze_module wraps the single module).
+        self.project = None
 
     # -- construction -----------------------------------------------------
 
@@ -383,29 +387,34 @@ def iter_py_files(root: str, paths: Iterable[str]) -> Iterator[str]:
                     yield rel
 
 
-def analyze_module(mod: ModuleInfo, rules=None):
-    """Run rules over one module; apply inline suppressions.
-
-    Returns ``(kept, suppressed)`` — invalid suppressions become
-    ``bad-suppress`` findings in ``kept`` and do NOT silence anything.
-    """
-    rules = list((rules or REGISTRY).values())
-    raw: list = []
-    for rule in rules:
-        raw.extend(rule.check(mod))
-    valid = [s for s in mod.suppressions if s.valid]
+def _apply_suppressions(raw, mods_by_path):
+    """Split ``raw`` into ``(kept, suppressed)`` using each finding's
+    OWN module's inline suppressions — with cross-module rules a
+    finding may live in a different file than the module whose
+    ``check()`` produced it, and only a comment in the finding's file
+    may silence it."""
     kept: list = []
     suppressed: list = []
     for f in raw:
+        owner = mods_by_path.get(f.path)
+        valid = (
+            [s for s in owner.suppressions if s.valid]
+            if owner is not None else []
+        )
         if any(
             s.applies_to == f.line and f.rule in s.rules for s in valid
         ):
             suppressed.append(f)
         else:
             kept.append(f)
+    return kept, suppressed
+
+
+def _bad_suppress_findings(mod: ModuleInfo) -> list:
+    out: list = []
     for s in mod.suppressions:
         if not s.valid:
-            kept.append(
+            out.append(
                 Finding(
                     rule=BAD_SUPPRESS,
                     path=mod.relpath,
@@ -419,28 +428,73 @@ def analyze_module(mod: ModuleInfo, rules=None):
                     snippet=mod.snippet(s.line),
                 )
             )
+    return out
+
+
+def _check_modules(mods, rules):
+    """Run ``rules`` over ``mods``, deduping identical findings — a
+    cross-module rule rooted in two different modules can report the
+    same site twice."""
+    raw: list = []
+    seen: set = set()
+    for mod in mods:
+        for rule in rules:
+            for f in rule.check(mod):
+                key = (f.rule, f.path, f.line, f.context, f.snippet)
+                if key in seen:
+                    continue
+                seen.add(key)
+                raw.append(f)
+    return raw
+
+
+def analyze_module(mod: ModuleInfo, rules=None):
+    """Run rules over one module; apply inline suppressions.
+
+    Returns ``(kept, suppressed)`` — invalid suppressions become
+    ``bad-suppress`` findings in ``kept`` and do NOT silence anything.
+    The module gets a single-module ``callgraph.Project`` if it is not
+    already part of one, so cross-module rules degrade to their
+    same-module reach.
+    """
+    from . import callgraph
+
+    rules = list((rules or REGISTRY).values())
+    if mod.project is None:
+        callgraph.Project([mod])
+    raw = _check_modules([mod], rules)
+    kept, suppressed = _apply_suppressions(raw, {mod.relpath: mod})
+    kept.extend(_bad_suppress_findings(mod))
     return kept, suppressed
 
 
 def analyze_paths(root: str, paths: Iterable[str], rules=None):
     """Run the registry over every .py file under ``paths``.
 
-    Returns ``(findings, suppressed, errors)``; ``errors`` are
-    (path, message) pairs for unparseable files (reported, not fatal —
-    a syntax error is pytest's job to flag, not the linter's to crash
-    on)."""
-    findings: list = []
-    suppressed: list = []
+    All parseable files are loaded first and share one
+    ``callgraph.Project``, so rules see cross-module call paths across
+    the whole scan set.  Returns ``(findings, suppressed, errors)``;
+    ``errors`` are (path, message) pairs for unparseable files
+    (reported, not fatal — a syntax error is pytest's job to flag, not
+    the linter's to crash on)."""
+    from . import callgraph
+
+    rules = list((rules or REGISTRY).values())
+    mods: list = []
     errors: list = []
     for rel in iter_py_files(root, paths):
         try:
-            mod = ModuleInfo(root, rel)
+            mods.append(ModuleInfo(root, rel))
         except (SyntaxError, UnicodeDecodeError, OSError) as e:
             errors.append((rel, f"{type(e).__name__}: {e}"))
             continue
-        kept, supp = analyze_module(mod, rules)
-        findings.extend(kept)
-        suppressed.extend(supp)
+    callgraph.Project(mods)
+    raw = _check_modules(mods, rules)
+    findings, suppressed = _apply_suppressions(
+        raw, {m.relpath: m for m in mods}
+    )
+    for mod in mods:
+        findings.extend(_bad_suppress_findings(mod))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     suppressed.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings, suppressed, errors

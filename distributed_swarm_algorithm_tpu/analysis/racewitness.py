@@ -1,0 +1,211 @@
+"""Runtime lock-witness — the dynamic half of racelint (r21).
+
+The static model (``rules_concurrency``) claims: every statically
+guarded site in a with-lock region actually holds that lock when it
+executes.  This module checks the claim on a LIVE program — the race
+drill runs a short ``StreamingService`` segment while rival threads
+hammer ``/metrics``, ``snapshot()`` and trace export, and the witness
+observes every executed line inside a statically-derived lock region,
+asserting the mapped lock is held by the executing thread.
+
+Two pieces:
+
+- :class:`WitnessLock` — a delegating wrapper installed over a real
+  ``threading.Lock``/``RLock`` **by attribute replacement** (e.g.
+  ``registry._lock = WitnessLock(registry._lock)``), which tracks
+  per-thread hold depth so ``held()`` answers "does the CURRENT
+  thread hold this lock?" — the question a runtime race check needs
+  and the stdlib locks cannot answer.
+
+- :class:`RuntimeLockWitness` — line-granular execution monitor over
+  the static model's ``lock_regions`` output.  On 3.12+ it rides
+  ``sys.monitoring`` (PEP 669: near-zero cost outside watched code
+  via ``DISABLE`` returns); on older interpreters it falls back to
+  ``sys.settrace`` + ``threading.settrace``, returning a local trace
+  function only for watched code objects so unwatched frames run
+  untraced.  Install the witness BEFORE spawning rival threads:
+  already-running threads keep their current (un)traced state.
+
+Pure stdlib, jax-free — importable anywhere the analysis package is.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+class WitnessLock:
+    """Delegating lock wrapper with per-thread hold-depth tracking.
+
+    Re-entrant bookkeeping works for both Lock and RLock underneath
+    (a plain Lock simply never reaches depth 2)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._holders: Dict[int, int] = {}
+
+    def acquire(self, *a, **k) -> bool:
+        got = self._inner.acquire(*a, **k)
+        if got:
+            tid = threading.get_ident()
+            self._holders[tid] = self._holders.get(tid, 0) + 1
+        return got
+
+    def release(self) -> None:
+        tid = threading.get_ident()
+        # Decrement BEFORE the real release: after releasing, another
+        # thread may acquire and read _holders concurrently.
+        depth = self._holders.get(tid, 0)
+        if depth <= 1:
+            self._holders.pop(tid, None)
+        else:
+            self._holders[tid] = depth - 1
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def held(self) -> bool:
+        """Does the CURRENT thread hold this lock?"""
+        return self._holders.get(threading.get_ident(), 0) > 0
+
+
+class RuntimeLockWitness:
+    """Checks statically-guarded lines against live lock holds.
+
+    Parameters
+    ----------
+    regions:
+        ``lock_regions()`` output — ``(relpath, func, lo, hi,
+        lock_name)`` tuples.  ``relpath`` is matched as a suffix of
+        ``co_filename`` so repo-relative static paths find absolute
+        runtime paths.
+    locks:
+        ``lock_name -> WitnessLock`` for every lock the drill wrapped.
+        Regions whose lock is not in the map still count hits (the
+        static and dynamic models agree the line is watched) but
+        cannot witness a violation.
+    """
+
+    def __init__(
+        self,
+        regions: Iterable[Tuple[str, str, int, int, str]],
+        locks: Dict[str, WitnessLock],
+    ):
+        self.locks = dict(locks)
+        #: func name -> [(relpath, lo, hi, lock_name)] — first-level
+        #: filter by co_name, then the relpath suffix check.
+        self._by_func: Dict[str, List[tuple]] = {}
+        for relpath, fname, lo, hi, lock in regions:
+            self._by_func.setdefault(fname, []).append(
+                (relpath, int(lo), int(hi), lock)
+            )
+        self.hits = 0
+        self.violations: List[tuple] = []
+        self._lock = threading.Lock()
+        self._installed: Optional[str] = None
+        self._prev_trace = None
+
+    # -- shared region check ----------------------------------------------
+    def _regions_of(self, code) -> Optional[List[tuple]]:
+        cands = self._by_func.get(code.co_name)
+        if not cands:
+            return None
+        fname = code.co_filename
+        out = [r for r in cands if fname.endswith(r[0])]
+        return out or None
+
+    def _check_line(self, regions, line) -> None:
+        for relpath, lo, hi, lock_name in regions:
+            if lo <= line <= hi:
+                wl = self.locks.get(lock_name)
+                ok = wl is None or wl.held()
+                with self._lock:
+                    self.hits += 1
+                    if not ok:
+                        self.violations.append(
+                            (relpath, line, lock_name,
+                             threading.current_thread().name)
+                        )
+
+    # -- sys.settrace backend (<=3.11) ------------------------------------
+    def _global_trace(self, frame, event, arg):
+        if event != "call":
+            return None
+        if self._regions_of(frame.f_code) is None:
+            return None
+        return self._local_trace
+
+    def _local_trace(self, frame, event, arg):
+        if event == "line":
+            regions = self._regions_of(frame.f_code)
+            if regions:
+                self._check_line(regions, frame.f_lineno)
+        return self._local_trace
+
+    # -- sys.monitoring backend (3.12+) -----------------------------------
+    def _install_monitoring(self) -> bool:
+        mon = getattr(sys, "monitoring", None)
+        if mon is None:
+            return False
+        try:
+            tool = mon.PROFILER_ID
+            mon.use_tool_id(tool, "racelint-witness")
+
+            def on_line(code, line):
+                regions = self._regions_of(code)
+                if regions is None:
+                    return mon.DISABLE
+                self._check_line(regions, line)
+                return None
+
+            mon.register_callback(
+                tool, mon.events.LINE, on_line
+            )
+            mon.set_events(tool, mon.events.LINE)
+        except Exception:
+            return False
+        self._mon_tool = tool
+        return True
+
+    # -- lifecycle ---------------------------------------------------------
+    def install(self) -> "RuntimeLockWitness":
+        """Start observing.  Prefer ``sys.monitoring``; fall back to
+        settrace.  Call before spawning the rival threads."""
+        if self._installed is not None:
+            return self
+        if self._install_monitoring():
+            self._installed = "monitoring"
+            return self
+        self._prev_trace = sys.gettrace()
+        threading.settrace(self._global_trace)
+        sys.settrace(self._global_trace)
+        self._installed = "settrace"
+        return self
+
+    def uninstall(self) -> None:
+        if self._installed == "monitoring":
+            mon = sys.monitoring
+            mon.set_events(self._mon_tool, 0)
+            mon.register_callback(
+                self._mon_tool, mon.events.LINE, None
+            )
+            mon.free_tool_id(self._mon_tool)
+        elif self._installed == "settrace":
+            threading.settrace(None)  # type: ignore[arg-type]
+            sys.settrace(self._prev_trace)
+        self._installed = None
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False

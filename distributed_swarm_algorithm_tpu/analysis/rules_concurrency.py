@@ -1,0 +1,768 @@
+"""racelint — host-concurrency lock-discipline audit (r21).
+
+The serve plane is a genuinely multi-threaded host program: the pump
+loop, the `serve_metrics_endpoint` daemon thread, the r19
+`jax.debug.callback` probe thread, and the atexit trace exporter all
+touch shared registries.  Both concurrency bugs found so far (the r19
+MetricsRegistry scrape-vs-pump race, the unlocked probe-token dicts)
+were caught by review, not by a gate.  This module is that gate — the
+thread-safety twin of swarmlint's source hazards and jaxlint's
+lowered-program contracts, built on the callgraph engine:
+
+1. **Thread-root inference** — functions that run concurrently with
+   the main program: ``threading.Thread``/``Timer`` targets,
+   ``ThreadingHTTPServer`` handler ``do_*`` methods,
+   ``jax.debug.callback`` callees (the jax runtime thread),
+   ``atexit`` hooks (run while daemon threads are still live), the
+   serve pump-loop entry methods, and each spawn site's enclosing
+   function (the main-thread side of the pair).
+
+2. **Shared-mutable-state footprint** — module-level containers and
+   ``self.``-attributes accessed from two distinct roots with at
+   least one write, where at least one root is truly asynchronous
+   (thread/handler/callback/atexit — two main-thread functions are
+   sequential, not a race).  Two happens-before refinements keep the
+   footprint honest: accesses inside ``__init__`` precede publication,
+   and accesses in a spawner's own body BEFORE its first spawn site
+   precede the thread's existence.
+
+3. **Lock-witness checking** — every shared site must be reached
+   under ``with <lock>`` of the SAME lock on every path (lexical
+   ``with`` blocks plus interprocedural must-hold propagation along
+   the call graph).  Distinct findings:
+
+   * ``race-unguarded-write``  — no access takes any lock;
+   * ``race-guard-split``      — some sites locked, this one is not;
+   * ``race-lock-mismatch``    — all sites locked, no common lock;
+   * ``race-lock-order``       — two locks nested in opposite orders
+     on different paths (deadlock under contention).
+
+Like all of swarmlint this is pure AST — precision-biased
+(unresolvable expressions contribute no edge) and suppressible with
+justified inline comments or the baseline ledger.  The with-lock
+regions the model collects are exported (``lock_regions``) to the
+dynamic race drill, whose runtime witness checks that every
+statically-guarded line actually holds its lock mid-flight.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import ModuleInfo, Rule, register
+
+_LOCK_CTORS = frozenset({"threading.Lock", "threading.RLock"})
+
+#: Root kinds that run asynchronously with the main thread.  "pump"
+#: and "spawner" are the main-thread side of a pair — two of those are
+#: sequential, not concurrent.
+ASYNC_KINDS = frozenset({"thread", "handler", "callback", "atexit"})
+
+#: Method calls that mutate their receiver container.
+_MUT_METHODS = frozenset(
+    {"append", "extend", "insert", "add", "update", "setdefault",
+     "pop", "popitem", "remove", "discard", "clear", "appendleft",
+     "popleft", "rotate", "__setitem__"}
+)
+
+_MUTABLE_LITERALS = (
+    ast.Dict, ast.List, ast.Set, ast.ListComp, ast.DictComp,
+    ast.SetComp,
+)
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+# ---------------------------------------------------------------------------
+# lock / state tables
+
+
+def _module_locks(project, mod) -> Dict[str, tuple]:
+    """Module-global ``NAME = threading.Lock()/RLock()`` bindings."""
+    key = ("racelint-mlocks", id(mod))
+    out = project.cache.get(key)
+    if out is None:
+        out = {}
+        for st in mod.tree.body:
+            if (
+                isinstance(st, ast.Assign)
+                and len(st.targets) == 1
+                and isinstance(st.targets[0], ast.Name)
+                and isinstance(st.value, ast.Call)
+                and mod.resolve(st.value.func) in _LOCK_CTORS
+            ):
+                name = st.targets[0].id
+                out[name] = ("G", mod.relpath, name)
+        project.cache[key] = out
+    return out
+
+
+def _class_locks(project, ci) -> Dict[str, tuple]:
+    """``self.X = threading.Lock()/RLock()`` attributes of a class."""
+    key = ("racelint-clocks", id(ci.node))
+    out = project.cache.get(key)
+    if out is None:
+        out = {}
+        for meth in ci.methods.values():
+            for node in ast.walk(meth):
+                if not (
+                    isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and ci.mod.resolve(node.value.func) in _LOCK_CTORS
+                ):
+                    continue
+                for tgt in node.targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        out[tgt.attr] = ("A", ci.key(), tgt.attr)
+        project.cache[key] = out
+    return out
+
+
+def _module_state(project, mod) -> Set[str]:
+    """Module-global mutable containers (the shared-state footprint's
+    module-level half)."""
+    key = ("racelint-mstate", id(mod))
+    out = project.cache.get(key)
+    if out is None:
+        out = set()
+        for st in mod.tree.body:
+            tgt = None
+            if isinstance(st, ast.Assign) and len(st.targets) == 1:
+                tgt, value = st.targets[0], st.value
+            elif isinstance(st, ast.AnnAssign) and st.value is not None:
+                tgt, value = st.target, st.value
+            else:
+                continue
+            if not isinstance(tgt, ast.Name):
+                continue
+            if isinstance(value, _MUTABLE_LITERALS):
+                out.add(tgt.id)
+            elif isinstance(value, ast.Call):
+                from .callgraph import MUTABLE_CONSTRUCTORS
+
+                if mod.resolve(value.func) in MUTABLE_CONSTRUCTORS:
+                    out.add(tgt.id)
+        project.cache[key] = out
+    return out
+
+
+def lock_name(lock_key: tuple) -> str:
+    """Human/witness rendering of a lock key."""
+    if lock_key[0] == "G":
+        return f"{lock_key[1]}::{lock_key[2]}"
+    (relpath, cls), attr = lock_key[1], lock_key[2]
+    return f"{relpath}::{cls}.{attr}"
+
+
+def _lock_of(project, fr, expr) -> Optional[tuple]:
+    """Lock key of a ``with`` context expression, or None when the
+    expression is not a recognizable lock object."""
+    if isinstance(expr, ast.Name):
+        lk = _module_locks(project, fr.mod).get(expr.id)
+        if lk is not None:
+            return lk
+        dotted = fr.mod.resolve(expr)
+        if dotted and "." in dotted:
+            head, name = dotted.rsplit(".", 1)
+            m2 = project._find_module(head)
+            if m2 is not None:
+                return _module_locks(project, m2).get(name)
+        return None
+    if isinstance(expr, ast.Attribute):
+        base = expr.value
+        if (
+            fr.cls is not None
+            and isinstance(base, ast.Name)
+            and base.id == "self"
+        ):
+            return _class_locks(project, fr.cls).get(expr.attr)
+        if (
+            fr.cls is not None
+            and isinstance(base, ast.Attribute)
+            and isinstance(base.value, ast.Name)
+            and base.value.id == "self"
+        ):
+            for ci in project.attr_classes(fr.cls, base.attr):
+                lk = _class_locks(project, ci).get(expr.attr)
+                if lk is not None:
+                    return lk
+        dotted = fr.mod.resolve(expr)
+        if dotted and "." in dotted:
+            head, name = dotted.rsplit(".", 1)
+            m2 = project._find_module(head)
+            if m2 is not None:
+                return _module_locks(project, m2).get(name)
+    return None
+
+
+def _binding_names(tgt) -> Iterable[str]:
+    """Names a target BINDS: bare names and destructuring elements.
+    ``x[k] = v`` / ``x.a = v`` mutate ``x``'s referent — they bind
+    nothing, so they must not shadow a module global."""
+    if isinstance(tgt, ast.Name):
+        yield tgt.id
+    elif isinstance(tgt, (ast.Tuple, ast.List)):
+        for el in tgt.elts:
+            yield from _binding_names(el)
+    elif isinstance(tgt, ast.Starred):
+        yield from _binding_names(tgt.value)
+
+
+def _local_names(fn) -> Set[str]:
+    """Names bound locally in ``fn`` (these shadow module globals);
+    ``global``-declared names are excluded."""
+    out: Set[str] = set()
+    hard_globals: Set[str] = set()
+    args = fn.args
+    for a in (
+        list(getattr(args, "posonlyargs", [])) + list(args.args)
+        + list(args.kwonlyargs)
+        + ([args.vararg] if args.vararg else [])
+        + ([args.kwarg] if args.kwarg else [])
+    ):
+        out.add(a.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global):
+            hard_globals.update(node.names)
+        elif isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            tgts = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for tgt in tgts:
+                out.update(_binding_names(tgt))
+        elif isinstance(node, ast.For):
+            out.update(_binding_names(node.target))
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    out.update(_binding_names(item.optional_vars))
+        elif isinstance(node, ast.comprehension):
+            out.update(_binding_names(node.target))
+    return out - hard_globals
+
+
+# ---------------------------------------------------------------------------
+# thread-root inference
+
+
+class _Root:
+    __slots__ = ("fr", "kinds", "spawn_line")
+
+    def __init__(self, fr):
+        self.fr = fr
+        self.kinds: Set[str] = set()
+        #: For spawner roots: line of the first spawn call in the
+        #: function's own body — accesses before it happen before the
+        #: spawned thread exists.
+        self.spawn_line: Optional[int] = None
+
+    @property
+    def is_async(self) -> bool:
+        return bool(self.kinds & ASYNC_KINDS)
+
+    def desc(self) -> str:
+        return (
+            f"`{self.fr.mod.relpath}:{self.fr.name}` "
+            f"[{'/'.join(sorted(self.kinds))}]"
+        )
+
+
+def _enclosing(mod, project, node):
+    """(FuncRef, first enclosing function) of a call site; the FuncRef
+    is None at module top level."""
+    for anc in mod.ancestors(node):
+        if isinstance(anc, _FUNC_DEFS):
+            return project.func_ref(mod, anc)
+    return None
+
+
+def _add_root(roots, fr, kind):
+    if fr is None:
+        return None
+    r = roots.get(fr.key())
+    if r is None:
+        r = roots[fr.key()] = _Root(fr)
+    r.kinds.add(kind)
+    return r
+
+
+def _thread_roots(project) -> Dict[int, "_Root"]:
+    roots: Dict[int, _Root] = {}
+    for mod in project.modules:
+        if "/serve/" in f"/{mod.relpath}":
+            for name, fns in project.funcs_by_name(mod).items():
+                if "pump" in name.lower():
+                    for fn in fns:
+                        _add_root(
+                            roots, project.func_ref(mod, fn), "pump"
+                        )
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = mod.resolve(node.func) or ""
+            leaf = resolved.rsplit(".", 1)[-1]
+            spawner = _enclosing(mod, project, node)
+            cls = spawner.cls if spawner is not None else None
+            targets: list = []
+            kind = None
+            if resolved == "threading.Thread":
+                kind = "thread"
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        targets.append(kw.value)
+            elif resolved == "threading.Timer":
+                kind = "thread"
+                for kw in node.keywords:
+                    if kw.arg == "function":
+                        targets.append(kw.value)
+                if not targets and len(node.args) > 1:
+                    targets.append(node.args[1])
+            elif resolved == "atexit.register" and node.args:
+                kind = "atexit"
+                targets.append(node.args[0])
+            elif resolved.endswith("debug.callback") and node.args:
+                kind = "callback"
+                targets.append(node.args[0])
+            elif leaf in (
+                "ThreadingHTTPServer", "HTTPServer", "TCPServer",
+                "ThreadingTCPServer",
+            ) and len(node.args) > 1:
+                kind = "handler"
+                handler_ci = project.resolve_class(mod, node.args[1])
+                if handler_ci is not None:
+                    for mname, meth in handler_ci.methods.items():
+                        if mname.startswith("do_"):
+                            _add_root(
+                                roots,
+                                project.func_ref(
+                                    handler_ci.mod, meth
+                                ),
+                                "handler",
+                            )
+            if kind is None:
+                continue
+            for tgt in targets:
+                for fr in project.resolve_callable(
+                    mod, tgt, cls=cls
+                ):
+                    _add_root(roots, fr, kind)
+            sp = _add_root(roots, spawner, "spawner")
+            if sp is not None:
+                line = node.lineno
+                if sp.spawn_line is None or line < sp.spawn_line:
+                    sp.spawn_line = line
+    return roots
+
+
+# ---------------------------------------------------------------------------
+# per-root reach with held-lock propagation
+
+
+class _Access:
+    __slots__ = ("rw", "root", "fr", "node", "locks")
+
+    def __init__(self, rw, root, fr, node, locks):
+        self.rw = rw            # "r" | "w"
+        self.root = root        # _Root
+        self.fr = fr
+        self.node = node
+        self.locks = locks      # frozenset of lock keys held
+
+
+class RaceModel:
+    """Project-global result of the racelint analysis."""
+
+    def __init__(self):
+        #: state key -> [_Access]; keys are ("G", relpath, name) for
+        #: module globals and ("A", (relpath, Class), attr) for
+        #: instance attributes.
+        self.accesses: Dict[tuple, List[_Access]] = {}
+        #: (outer lock, inner lock) -> (fr, node) first nesting site.
+        self.order: Dict[tuple, tuple] = {}
+        #: (relpath, func name, lo, hi, lock key) with-block regions
+        #: reached from a root — the dynamic witness's watch list.
+        self.regions: List[tuple] = []
+        self._region_seen: Set[tuple] = set()
+        self.findings: List = []
+
+    def add_region(self, relpath, fname, lo, hi, lock_key):
+        item = (relpath, fname, lo, hi, lock_key)
+        if item not in self._region_seen:
+            self._region_seen.add(item)
+            self.regions.append(item)
+
+
+def state_name(key: tuple) -> str:
+    if key[0] == "G":
+        return f"module global `{key[2]}`"
+    return f"`{key[1][1]}.{key[2]}`"
+
+
+def _scan_root(project, root, model: RaceModel) -> None:
+    held_map: Dict[int, frozenset] = {root.fr.key(): frozenset()}
+    fr_map = {root.fr.key(): root.fr}
+    queue = [root.fr.key()]
+    while queue:
+        fkey = queue.pop()
+        fr = fr_map[fkey]
+        held = held_map[fkey]
+        cutoff = (
+            root.spawn_line
+            if fkey == root.fr.key() and root.spawn_line is not None
+            and not (root.kinds - {"spawner"})
+            else None
+        )
+        _scan_fn(
+            project, root, fr, held, cutoff, model,
+            held_map, fr_map, queue,
+        )
+
+
+def _scan_fn(
+    project, root, fr, held, cutoff, model, held_map, fr_map, queue
+):
+    mod = fr.mod
+    local = (
+        _local_names(fr.node)
+        if isinstance(fr.node, _FUNC_DEFS + (ast.Lambda,)) else set()
+    )
+    mstate = _module_state(project, mod)
+    clocks = (
+        _class_locks(project, fr.cls) if fr.cls is not None else {}
+    )
+    in_init = fr.name == "__init__"
+
+    def record(key, rw, node, locks):
+        if in_init:
+            return
+        if cutoff is not None and node.lineno <= cutoff:
+            return
+        model.accesses.setdefault(key, []).append(
+            _Access(rw, root, fr, node, frozenset(held | set(locks)))
+        )
+
+    def global_key(name) -> Optional[tuple]:
+        if name in mstate and name not in local:
+            return ("G", mod.relpath, name)
+        return None
+
+    def attr_key(node) -> Optional[tuple]:
+        # self.X on a method, excluding the lock attributes themselves
+        if (
+            fr.cls is not None
+            and isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and node.attr not in clocks
+        ):
+            return ("A", fr.cls.key(), node.attr)
+        return None
+
+    def state_of(expr) -> Optional[tuple]:
+        if isinstance(expr, ast.Name):
+            return global_key(expr.id)
+        if isinstance(expr, ast.Attribute):
+            return attr_key(expr)
+        return None
+
+    def visit(node, locks):
+        if isinstance(
+            node, _FUNC_DEFS + (ast.Lambda, ast.ClassDef)
+        ):
+            return  # runs only when called — reached via call edges
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = []
+            for item in node.items:
+                visit(item.context_expr, locks)
+                lk = _lock_of(project, fr, item.context_expr)
+                if lk is None:
+                    continue
+                prior = held | set(locks) | {a for a, _ in acquired}
+                for outer in prior:
+                    if outer != lk:
+                        pair = (outer, lk)
+                        if pair not in model.order:
+                            model.order[pair] = (
+                                fr, item.context_expr
+                            )
+                acquired.append((lk, item.context_expr))
+            if acquired and node.body:
+                lo = node.body[0].lineno
+                hi = max(
+                    getattr(st, "end_lineno", st.lineno)
+                    for st in node.body
+                )
+                for lk, _ in acquired:
+                    model.add_region(
+                        mod.relpath, fr.name, lo, hi, lk
+                    )
+            inner = locks + tuple(lk for lk, _ in acquired)
+            for st in node.body:
+                visit(st, inner)
+            return
+        # -- accesses ---------------------------------------------------
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                _target_access(tgt, locks)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            _target_access(node.target, locks)
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                _target_access(tgt, locks)
+        elif isinstance(node, ast.Call):
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUT_METHODS
+            ):
+                key = state_of(node.func.value)
+                if key is not None:
+                    record(key, "w", node, locks)
+            # propagate held locks along call edges
+            new_held = frozenset(held | set(locks))
+            for cal in project.callees(mod, node, cls=fr.cls):
+                ck = cal.key()
+                prev = held_map.get(ck)
+                if prev is None:
+                    held_map[ck] = new_held
+                    fr_map[ck] = cal
+                    queue.append(ck)
+                elif not prev.issubset(new_held):
+                    # must-hold = intersection over all call paths
+                    held_map[ck] = prev & new_held
+                    fr_map[ck] = cal
+                    queue.append(ck)
+        elif isinstance(node, ast.Name) and isinstance(
+            node.ctx, ast.Load
+        ):
+            key = global_key(node.id)
+            if key is not None:
+                record(key, "r", node, locks)
+        elif isinstance(node, ast.Attribute) and isinstance(
+            node.ctx, ast.Load
+        ):
+            key = attr_key(node)
+            if key is not None:
+                record(key, "r", node, locks)
+        for child in ast.iter_child_nodes(node):
+            visit(child, locks)
+
+    def _target_access(tgt, locks):
+        if isinstance(tgt, ast.Subscript):
+            key = state_of(tgt.value)
+            if key is not None:
+                record(key, "w", tgt, locks)
+        else:
+            key = state_of(tgt)
+            if key is not None:
+                record(key, "w", tgt, locks)
+
+    body = (
+        fr.node.body if isinstance(fr.node.body, list)
+        else [fr.node.body]
+    )
+    for st in body:
+        visit(st, ())
+
+
+# ---------------------------------------------------------------------------
+# model -> findings
+
+
+def race_model(project) -> RaceModel:
+    model = project.cache.get("racelint")
+    if model is not None:
+        return model
+    model = RaceModel()
+    roots = _thread_roots(project)
+    for root in roots.values():
+        _scan_root(project, root, model)
+    _derive_findings(model)
+    project.cache["racelint"] = model
+    return model
+
+
+def _site(acc: _Access) -> tuple:
+    return (acc.fr.mod.relpath, acc.node.lineno)
+
+
+def _derive_findings(model: RaceModel) -> None:
+    for key in sorted(
+        model.accesses, key=lambda k: (k[0], str(k[1]), str(k[2]))
+    ):
+        accs = sorted(model.accesses[key], key=_site)
+        root_keys = {a.root.fr.key() for a in accs}
+        if len(root_keys) < 2:
+            continue
+        if not any(a.root.is_async for a in accs):
+            continue
+        writes = [a for a in accs if a.rw == "w"]
+        if not writes:
+            continue
+        roots_desc = " and ".join(
+            sorted({a.root.desc() for a in accs})[:3]
+        )
+        locked = [a for a in accs if a.locks]
+        unlocked = [a for a in accs if not a.locks]
+        if not locked:
+            a = writes[0]
+            model.findings.append(a.fr.mod.finding(
+                "race-unguarded-write", a.node,
+                f"{state_name(key)} is written here and accessed "
+                f"from {roots_desc} with NO lock discipline on any "
+                "path — wrap every access in `with <lock>` of one "
+                "shared threading.RLock (the MetricsRegistry._lock "
+                "pattern)",
+            ))
+            continue
+        common = frozenset.intersection(*(a.locks for a in accs))
+        if common:
+            continue  # every path holds the same lock — clean
+        if unlocked:
+            a = unlocked[0]
+            other = locked[0]
+            model.findings.append(a.fr.mod.finding(
+                "race-guard-split", a.node,
+                f"{state_name(key)} is accessed here with no lock "
+                f"held, but is guarded under "
+                f"`{lock_name(sorted(other.locks)[0])}` at "
+                f"{_site(other)[0]}:{_site(other)[1]} — a guarded "
+                "write does not protect an unguarded read; every "
+                "path (from " + roots_desc + ") must hold the lock",
+            ))
+            continue
+        a, b = accs[0], next(
+            x for x in accs if not (x.locks & accs[0].locks)
+        )
+        model.findings.append(b.fr.mod.finding(
+            "race-lock-mismatch", b.node,
+            f"{state_name(key)} is guarded by "
+            f"`{lock_name(sorted(b.locks)[0])}` here but by "
+            f"`{lock_name(sorted(a.locks)[0])}` at "
+            f"{_site(a)[0]}:{_site(a)[1]} — two locks serialize "
+            "nothing; pick ONE lock for every access",
+        ))
+    seen_pairs: Set[frozenset] = set()
+    for (outer, inner), (fr, node) in sorted(
+        model.order.items(),
+        key=lambda kv: (kv[1][0].mod.relpath, kv[1][1].lineno),
+    ):
+        rev = model.order.get((inner, outer))
+        if rev is None:
+            continue
+        pk = frozenset((outer, inner))
+        if pk in seen_pairs:
+            continue
+        seen_pairs.add(pk)
+        rfr, rnode = rev
+        model.findings.append(fr.mod.finding(
+            "race-lock-order", node,
+            f"`{lock_name(inner)}` is acquired while holding "
+            f"`{lock_name(outer)}` here, but the OPPOSITE order is "
+            f"taken at {rfr.mod.relpath}:{rnode.lineno} — "
+            "inconsistent nesting deadlocks under contention; fix "
+            "one canonical order",
+        ))
+
+
+def lock_regions(root: str, paths: Iterable[str]):
+    """Statically-guarded with-lock regions over ``paths`` — the
+    dynamic race drill's witness list.  Returns
+    ``[(relpath, func, lo, hi, lock_name_str), ...]``."""
+    from . import callgraph
+    from .core import ModuleInfo, iter_py_files
+
+    mods = []
+    for rel in iter_py_files(root, list(paths)):
+        try:
+            mods.append(ModuleInfo(root, rel))
+        except (SyntaxError, UnicodeDecodeError, OSError):
+            continue
+    project = callgraph.Project(mods)
+    model = race_model(project)
+    return [
+        (relpath, fname, lo, hi, lock_name(lk))
+        for relpath, fname, lo, hi, lk in model.regions
+    ]
+
+
+def racelint_rules() -> dict:
+    """The racelint slice of the registry (for scoped runs: the
+    `racelint-findings` bench row and graft dryrun axis 35)."""
+    from .core import REGISTRY
+
+    return {
+        rid: rule for rid, rule in REGISTRY.items()
+        if rid.startswith("race-")
+    }
+
+
+# ---------------------------------------------------------------------------
+# rules
+
+
+class _RaceRule(Rule):
+    def check(self, mod: ModuleInfo):
+        project = mod.project
+        if project is None:
+            from . import callgraph
+
+            project = callgraph.Project([mod])
+        for f in race_model(project).findings:
+            if f.rule == self.id and f.path == mod.relpath:
+                yield f
+
+
+@register
+class UnguardedWriteRule(_RaceRule):
+    id = "race-unguarded-write"
+    summary = "shared mutable state written with no lock on any path"
+    details = (
+        "A module-level container or instance attribute is written "
+        "from one thread root and read or written from another, and "
+        "NO access takes a lock: concurrent scrape/pump/callback "
+        "interleavings tear the structure (the r19 MetricsRegistry "
+        "race).  Guard every access with one shared threading.RLock "
+        "— the MetricsRegistry._lock pattern."
+    )
+
+
+@register
+class GuardSplitRule(_RaceRule):
+    id = "race-guard-split"
+    summary = "shared state guarded on some paths, bare on others"
+    details = (
+        "Some accesses to a shared structure hold a lock and at "
+        "least one does not: a guarded write does not protect an "
+        "unguarded read — the reader can still observe a torn "
+        "update.  Every path from every thread root must hold the "
+        "same lock."
+    )
+
+
+@register
+class LockMismatchRule(_RaceRule):
+    id = "race-lock-mismatch"
+    summary = "shared state guarded by different locks on different paths"
+    details = (
+        "Every access is locked but there is no lock COMMON to all "
+        "of them: two locks serialize nothing between each other's "
+        "holders.  Pick one canonical lock for the structure."
+    )
+
+
+@register
+class LockOrderRule(_RaceRule):
+    id = "race-lock-order"
+    summary = "two locks nested in opposite orders on different paths"
+    details = (
+        "Path A acquires lock L1 then L2; path B acquires L2 then "
+        "L1.  Under contention each holds what the other wants — "
+        "classic deadlock.  Fix one canonical acquisition order "
+        "(document it next to the lock definitions)."
+    )

@@ -307,41 +307,54 @@ class ServeHostSyncRule(Rule):
     def applies(self, mod: ModuleInfo) -> bool:
         return "/serve/" in f"/{mod.relpath}"
 
-    def check(self, mod: ModuleInfo):
-        if not self.applies(mod):
-            return
-        funcs: dict = {}
-        for node in ast.walk(mod.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                funcs.setdefault(node.name, []).append(node)
-        roots = [
-            fn for name, fns in funcs.items()
-            if _is_hot_name(name) for fn in fns
-        ]
-        # Transitive closure over same-module calls: bare names and
-        # attribute calls (self.f(), obj.f()) resolve by their
-        # terminal name — a sync hidden two helpers deep still
-        # serializes the pump.
-        reach = set(roots)
-        frontier = list(roots)
-        while frontier:
-            fn = frontier.pop()
-            for node in ast.walk(fn):
-                if not isinstance(node, ast.Call):
+    def _hot_reach(self, project):
+        """Project-wide closure from every serve/ hot-stem method,
+        computed once per project (callgraph re-hosting, r21): bare
+        names and attribute calls resolve by terminal name within a
+        module exactly as the legacy walker did, and additionally
+        follow import aliases, module-global instances, and
+        ``self.attr`` methods into other modules — a sync hidden in a
+        utils helper the pump calls still serializes the stream.
+
+        The walk stops at TRACED callees (jit/shard_map bodies): code
+        under a trace runs on device — a host sync there is a
+        trace-time error, not a per-tick serialization, and numpy on
+        trace-time constants is free."""
+        reach = project.cache.get(self.id)
+        if reach is None:
+            roots = []
+            for m in project.modules:
+                if not self.applies(m):
                     continue
-                callee = None
-                if isinstance(node.func, ast.Name):
-                    callee = node.func.id
-                elif isinstance(node.func, ast.Attribute):
-                    callee = node.func.attr
-                for target in funcs.get(callee, ()):
-                    if target not in reach:
-                        reach.add(target)
-                        frontier.append(target)
+                for name, fns in project.funcs_by_name(m).items():
+                    if _is_hot_name(name):
+                        roots.extend(
+                            project.func_ref(m, fn) for fn in fns
+                        )
+            reach = project.closure(
+                roots, follow_attr=True,
+                skip=lambda fr: fr.node in fr.mod.traced_functions(),
+            )
+            project.cache[self.id] = reach
+        return reach
+
+    def check(self, mod: ModuleInfo):
+        project = mod.project
+        if project is None:
+            from . import callgraph
+
+            project = callgraph.Project([mod])
+        # Roots live in serve/ modules; sites are reported while
+        # checking the module THEY live in, so suppressions and
+        # fingerprints stay local to the file they annotate.
+        local = [
+            fr for fr in self._hot_reach(project).values()
+            if fr.mod is mod
+        ]
         seen: set = set()
-        for fn in sorted(reach, key=lambda f: f.lineno):
-            for node in ast.walk(fn):
-                f = self._sync_site(mod, node, fn.name)
+        for fr in sorted(local, key=lambda fr: fr.node.lineno):
+            for node in ast.walk(fr.node):
+                f = self._sync_site(mod, node, fr.name)
                 if f is None:
                     continue
                 site = (f.line, f.snippet)

@@ -611,6 +611,20 @@ _PLAN_CALLS = frozenset(
 _EXCHANGE_LEAVES = frozenset({"ppermute", "pshuffle"})
 
 
+def _project_of(mod: ModuleInfo):
+    """The module's cross-module view; a single-module project when the
+    module is analyzed standalone (callgraph re-hosting, r21)."""
+    if mod.project is None:
+        from . import callgraph
+
+        callgraph.Project([mod])
+    return mod.project
+
+
+def _body_stmts(node):
+    return node.body if isinstance(node.body, list) else [node.body]
+
+
 def _shard_map_bodies(mod: ModuleInfo):
     """FunctionDef/Lambda nodes that run as shard_map bodies: direct
     ``shard_map(f, ...)`` calls, and defs decorated with
@@ -662,48 +676,49 @@ class HaloWidthRule(Rule):
     )
 
     def check(self, mod: ModuleInfo):
-        bodies, by_name = _shard_map_bodies(mod)
+        project = _project_of(mod)
+        bodies, _ = _shard_map_bodies(mod)
         for fn in bodies:
-            # Reachable local-function closure: the exchange (and the
-            # plan call) routinely live in helpers the body calls.
-            seen_fns: set = set()
-            frontier = [fn]
+            # Reachable call closure (project-wide since r21): the
+            # exchange (and the plan call) routinely live in helpers
+            # the body calls — including helpers in other modules.
+            reach = project.closure([project.func_ref(mod, fn)])
             plan_calls: list = []
             has_exchange = False
-            while frontier:
-                cur = frontier.pop()
-                if id(cur) in seen_fns:
-                    continue
-                seen_fns.add(id(cur))
-                stmts = (
-                    cur.body if isinstance(cur.body, list)
-                    else [cur.body]
-                )
-                for st in stmts:
+            for fr in reach.values():
+                for st in _body_stmts(fr.node):
                     for node in ast.walk(st):
                         if not isinstance(node, ast.Call):
                             continue
-                        name = mod.resolve(node.func) or ""
+                        name = fr.mod.resolve(node.func) or ""
                         leaf = name.rsplit(".", 1)[-1]
                         if leaf in _PLAN_CALLS:
-                            plan_calls.append(node)
+                            plan_calls.append((fr, node))
                         if leaf in _EXCHANGE_LEAVES or (
                             "collective_permute" in name
                         ):
                             has_exchange = True
-                        if isinstance(node.func, ast.Name):
-                            for cand in by_name.get(node.func.id, []):
-                                frontier.append(cand)
             if has_exchange:
                 continue
             seen_sites: set = set()
-            for call in plan_calls:
-                site = (call.lineno, call.col_offset)
+            remote: list = []
+            for fr, call in plan_calls:
+                site = (fr.mod.relpath, call.lineno, call.col_offset)
                 if site in seen_sites:
                     continue
                 seen_sites.add(site)
-                name = mod.resolve(call.func) or ""
+                name = fr.mod.resolve(call.func) or ""
                 leaf = name.rsplit(".", 1)[-1]
+                if fr.mod is not mod:
+                    # Cross-module reach (r21): anchor at the
+                    # shard_map BODY, where the sharding decision (and
+                    # the fix — exchange or axis choice) lives; the
+                    # shared ops/ helper is correct for its other,
+                    # exchanged or unsharded, callers.
+                    remote.append(
+                        f"{leaf} ({fr.mod.relpath}:{call.lineno})"
+                    )
+                    continue
                 yield mod.finding(
                     self.id, call,
                     f"`{leaf}` in a shard_map body with no halo "
@@ -711,6 +726,16 @@ class HaloWidthRule(Rule):
                     "are silently dropped; ppermute boundary agents "
                     "(band depth personal_space + skin) before "
                     "consuming a per-shard plan",
+                )
+            if remote:
+                yield mod.finding(
+                    self.id, fn,
+                    "shard_map body reaches per-shard plan "
+                    f"build(s) [{', '.join(sorted(remote))}] with no "
+                    "halo exchange in scope — cross-shard neighbor "
+                    "pairs are silently dropped; ppermute boundary "
+                    "agents before consuming the plan, or shard a "
+                    "batch axis the plan never straddles",
                 )
 
 
@@ -732,27 +757,20 @@ _MESH_REDUCE = frozenset(
 )
 
 
-def _collect_collectives(mod, fn, by_name):
-    """Collective call leaves reachable from ``fn`` through its
-    local-call closure (the halo-width walk)."""
+def _collect_collectives(project, root_ref):
+    """Collective call leaves reachable from ``root_ref`` through the
+    project call closure (cross-module since r21)."""
     found: list = []
-    seen: set = set()
-    frontier = [fn]
-    while frontier:
-        cur = frontier.pop()
-        if id(cur) in seen:
-            continue
-        seen.add(id(cur))
-        stmts = cur.body if isinstance(cur.body, list) else [cur.body]
-        for st in stmts:
+    for fr in project.closure([root_ref]).values():
+        for st in _body_stmts(fr.node):
             for node in ast.walk(st):
                 if not isinstance(node, ast.Call):
                     continue
-                leaf = (mod.resolve(node.func) or "").rsplit(".", 1)[-1]
+                leaf = (
+                    fr.mod.resolve(node.func) or ""
+                ).rsplit(".", 1)[-1]
                 if leaf in _COND_COLLECTIVES:
                     found.append(leaf)
-                if isinstance(node.func, ast.Name):
-                    frontier.extend(by_name.get(node.func.id, []))
     return found
 
 
@@ -828,66 +846,53 @@ class CondCollectiveRule(Rule):
     )
 
     def check(self, mod: ModuleInfo):
-        bodies, by_name = _shard_map_bodies(mod)
+        project = _project_of(mod)
+        bodies, _ = _shard_map_bodies(mod)
         seen_sites: set = set()
         for body in bodies:
             # Every function reachable from the shard_map body runs
-            # per shard — a cond anywhere in that closure is a
-            # per-shard branch decision.
-            reach: list = []
-            seen_fns: set = set()
-            frontier = [body]
-            while frontier:
-                cur = frontier.pop()
-                if id(cur) in seen_fns:
-                    continue
-                seen_fns.add(id(cur))
-                reach.append(cur)
-                stmts = (
-                    cur.body if isinstance(cur.body, list)
-                    else [cur.body]
-                )
-                for st in stmts:
-                    for node in ast.walk(st):
-                        if isinstance(node, ast.Call) and isinstance(
-                            node.func, ast.Name
-                        ):
-                            frontier.extend(
-                                by_name.get(node.func.id, [])
-                            )
-            for fn in reach:
-                stmts = (
-                    fn.body if isinstance(fn.body, list) else [fn.body]
-                )
-                for st in stmts:
+            # per shard — a cond anywhere in that closure (cross-module
+            # since r21) is a per-shard branch decision.
+            reach = project.closure([project.func_ref(mod, body)])
+            for fr in reach.values():
+                for st in _body_stmts(fr.node):
                     for node in ast.walk(st):
                         if not isinstance(node, ast.Call):
                             continue
-                        name = mod.resolve(node.func) or ""
+                        name = fr.mod.resolve(node.func) or ""
                         if name.rsplit(".", 1)[-1] != "cond":
                             continue
                         branch_fns: list = []
                         for arg in node.args[1:3]:
                             if isinstance(arg, ast.Lambda):
-                                branch_fns.append(arg)
-                            elif isinstance(arg, ast.Name):
+                                branch_fns.append(
+                                    project.func_ref(fr.mod, arg)
+                                )
+                            elif isinstance(
+                                arg, (ast.Name, ast.Attribute)
+                            ):
                                 branch_fns.extend(
-                                    by_name.get(arg.id, [])
+                                    project.resolve_callable(
+                                        fr.mod, arg, cls=fr.cls
+                                    )
                                 )
                         hot: list = []
                         for bf in branch_fns:
                             hot.extend(
-                                _collect_collectives(mod, bf, by_name)
+                                _collect_collectives(project, bf)
                             )
                         if not hot:
                             continue
-                        if _predicate_is_uniform(mod, node):
+                        if _predicate_is_uniform(fr.mod, node):
                             continue
-                        site = (node.lineno, node.col_offset)
+                        site = (
+                            fr.mod.relpath, node.lineno,
+                            node.col_offset,
+                        )
                         if site in seen_sites:
                             continue
                         seen_sites.add(site)
-                        yield mod.finding(
+                        yield fr.mod.finding(
                             self.id, node,
                             f"lax.cond branch holds collective(s) "
                             f"{sorted(set(hot))} under shard_map but "
@@ -1063,10 +1068,7 @@ class SpanLeakRule(Rule):
             )
 
     def _check_profiler_trace(self, mod: ModuleInfo):
-        by_name: dict = {}
-        for node in ast.walk(mod.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                by_name.setdefault(node.name, []).append(node)
+        project = _project_of(mod)
         seen: set = set()
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Call):
@@ -1075,9 +1077,9 @@ class SpanLeakRule(Rule):
             if not name.endswith("profiler.start_trace"):
                 continue
             # stop_trace must be reachable from the start's enclosing
-            # scope through same-module calls (the halo-width walk) —
-            # a try/finally wrapper in the same function counts, the
-            # utils/profiling.trace pattern.
+            # scope through the project call closure (cross-module
+            # since r21) — a try/finally wrapper in the same function
+            # counts, the utils/profiling.trace pattern.
             scope = None
             for anc in mod.ancestors(node):
                 if isinstance(
@@ -1086,29 +1088,26 @@ class SpanLeakRule(Rule):
                 ):
                     scope = anc
                     break
-            frontier = [scope if scope is not None else mod.tree]
-            seen_fns: set = set()
+            from .callgraph import FuncRef
+
+            root = (
+                project.func_ref(mod, scope)
+                if scope is not None else FuncRef(mod, mod.tree)
+            )
             has_stop = False
-            while frontier and not has_stop:
-                cur = frontier.pop()
-                if id(cur) in seen_fns:
-                    continue
-                seen_fns.add(id(cur))
-                stmts = (
-                    cur.body if isinstance(cur.body, list)
-                    else [cur.body]
-                )
-                for st in stmts:
+            for fr in project.closure([root]).values():
+                for st in _body_stmts(fr.node):
                     for n in ast.walk(st):
                         if not isinstance(n, ast.Call):
                             continue
-                        nm = mod.resolve(n.func) or ""
+                        nm = fr.mod.resolve(n.func) or ""
                         if nm.rsplit(".", 1)[-1] == "stop_trace":
                             has_stop = True
-                        if isinstance(n.func, ast.Name):
-                            frontier.extend(
-                                by_name.get(n.func.id, [])
-                            )
+                            break
+                    if has_stop:
+                        break
+                if has_stop:
+                    break
             if has_stop:
                 continue
             site = (node.lineno, node.col_offset)

@@ -298,6 +298,7 @@ def spatial_shard_swarm(
         )
 
     n = state.n_agents
+    # swarmlint: disable=serve-host-sync -- the shard layout is host-computed by design at launch/rotation boundaries, before the rollout is in flight: nothing downstream is enqueued yet, so the transfer cannot serialize the pump
     x = np.asarray(state.pos[:, 0])
     tile = np.clip(
         np.floor((x + hw) / tile_w).astype(np.int64), 0, n_tiles - 1

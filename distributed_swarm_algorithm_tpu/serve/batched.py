@@ -326,6 +326,7 @@ def materialize_batch(
             use_point[i] = True
             points[i] = req.target
         if n_tasks:
+            # swarmlint: disable=serve-host-sync -- req.task_pos is a host-side Python list from the request payload; asarray here is host-to-host, no device array is touched
             task_pos[i] = np.asarray(req.task_pos, np.float32)
         for f, v in req.params.items():
             pvals[f][i] = v
@@ -545,6 +546,7 @@ def _batched_rollout_sharded_impl(
         shard_map, mesh=mesh, in_specs=(sp, sp),
         out_specs=out_specs, check_vma=False,
     )
+    # swarmlint: disable=halo-width -- the sharded axis is the SCENARIO batch axis: every device holds whole scenarios, so each per-scenario plan (built under vmap) sees its complete swarm — there is no spatial shard boundary to halo across (zero-collective budget pinned by jaxlint)
     def block(ss, pp):
         vtick = jax.vmap(
             lambda s, p: swarm_tick_dyn(s, None, cfg, p)

@@ -48,6 +48,7 @@ import atexit
 import json
 import os
 import sys
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -177,6 +178,13 @@ class SpanTracer:
         self.t0 = clock()
         self.spans: List[Span] = []
         self.dropped = 0
+        # The span store is written by the pump thread (via emit /
+        # _LiveSpan.__exit__) and read by the atexit exporter and any
+        # rival snapshot caller while the pump is still live — the
+        # same shape as the r19 MetricsRegistry scrape-vs-pump race,
+        # guarded the same way.  RLock, not Lock: an export path that
+        # re-enters (dump -> chrome_trace) must not self-deadlock.
+        self._lock = threading.RLock()
 
     # -- lifecycle ---------------------------------------------------------
     def enable(self) -> "SpanTracer":
@@ -188,16 +196,21 @@ class SpanTracer:
         return self
 
     def reset(self) -> None:
-        self.spans.clear()
-        self.dropped = 0
-        self.t0 = self.clock()
+        with self._lock:
+            self.spans.clear()
+            self.dropped = 0
+            self.t0 = self.clock()
 
     # -- recording ---------------------------------------------------------
     def _record(self, span: Span) -> None:
-        if len(self.spans) >= self.max_spans:
-            self.dropped += 1
-            return
-        self.spans.append(span)
+        # Bound check and append/count under one lock hold: two
+        # concurrent emits at the boundary must yield exactly one
+        # stored span + one drop, never two of either.
+        with self._lock:
+            if len(self.spans) >= self.max_spans:
+                self.dropped += 1
+                return
+            self.spans.append(span)
 
     def span(self, name: str, **attrs):
         """Context-manager span — the only sanctioned form inside
@@ -243,7 +256,14 @@ class SpanTracer:
         its own ``tid`` row (named via ``M``etadata events), so the
         taxonomy reads as parallel tracks; timestamps are
         microseconds relative to the tracer's birth."""
-        names = sorted({s.name for s in self.spans})
+        # Locked snapshot of store + counters, then format outside
+        # the lock — concurrent emits during export land in the
+        # live store, never in the copy the loop below iterates.
+        with self._lock:
+            spans = list(self.spans)
+            dropped = self.dropped
+            t_origin = self.t0
+        names = sorted({s.name for s in spans})
         tids = {n: i for i, n in enumerate(names)}
         pid = os.getpid()
         events: List[dict] = [
@@ -256,13 +276,13 @@ class SpanTracer:
             }
             for n in names
         ]
-        for s in self.spans:
+        for s in spans:
             ev = {
                 "name": s.name,
                 "cat": "swarmtrace",
                 "pid": pid,
                 "tid": tids[s.name],
-                "ts": round(1e6 * (s.t0 - self.t0), 3),
+                "ts": round(1e6 * (s.t0 - t_origin), 3),
                 "args": dict(s.attrs),
             }
             if s.t1 is None:
@@ -277,8 +297,8 @@ class SpanTracer:
             "displayTimeUnit": "ms",
             "otherData": {
                 "tool": "swarmtrace",
-                "spans": len(self.spans),
-                "dropped": self.dropped,
+                "spans": len(spans),
+                "dropped": dropped,
             },
         }
 

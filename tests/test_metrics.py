@@ -471,6 +471,98 @@ def test_streamed_service_populates_live_taxonomy():
     assert sum(s["count"] for s in wall.samples()) >= 1
 
 
+def test_concurrent_scrape_during_pump_smoke():
+    """r21 racelint satellite: rival threads poll ``/metrics`` +
+    ``/healthz`` and ``snapshot()`` MID-SEGMENT while the service
+    pumps.  Every read must be schema-complete and torn-read-free —
+    the dynamic twin of the static race-* rules (the full witness
+    drill lives in tests/test_racelint.py)."""
+    import threading
+
+    reg = MetricsRegistry()
+    stop = threading.Event()
+    errors: list = []
+    captured: list = []
+
+    with serve_metrics_endpoint(reg) as ep:
+
+        def scraper():
+            last_admissions = 0.0
+            while not stop.is_set():
+                try:
+                    body = urllib.request.urlopen(
+                        ep.url(), timeout=5
+                    ).read().decode()
+                    health = json.loads(
+                        urllib.request.urlopen(
+                            ep.url("/healthz"), timeout=5
+                        ).read()
+                    )
+                    snap = reg.snapshot()
+                except Exception as e:  # pragma: no cover - assert
+                    errors.append(e)
+                    return
+                if health.get("status") != "ok":
+                    errors.append(AssertionError(health))
+                    return
+                # Exposition is line-complete: a torn render would
+                # leave a non-comment line without a float value.
+                for line in body.splitlines():
+                    if not line or line.startswith("#"):
+                        continue
+                    try:
+                        float(line.rsplit(None, 1)[1])
+                    except (IndexError, ValueError):
+                        errors.append(
+                            AssertionError(f"torn line: {line!r}")
+                        )
+                        return
+                # Counters never run backwards within one scraper.
+                for m in snap["metrics"]:
+                    if m["name"] == "serve_admissions_total":
+                        total = sum(
+                            s["value"] for s in m["samples"]
+                        )
+                        if total < last_admissions:
+                            errors.append(AssertionError(
+                                f"admissions went backwards: "
+                                f"{total} < {last_admissions}"
+                            ))
+                            return
+                        last_admissions = total
+                captured.append(snap)
+
+        scrapers = [
+            threading.Thread(
+                target=scraper, daemon=True, name=f"scraper-{i}"
+            )
+            for i in range(4)
+        ]
+        for t in scrapers:
+            t.start()
+        try:
+            svc, results = _run_service(metrics=reg)
+        finally:
+            stop.set()
+            for t in scrapers:
+                t.join(timeout=10)
+    assert not errors, errors[0]
+    assert len(results) == 3
+    assert captured, "scrapers never completed a full poll"
+    # Every mid-flight snapshot is schema-complete: name/type/help/
+    # samples on each metric, histogram counts summing to count.
+    for snap in captured:
+        for m in snap["metrics"]:
+            assert {"name", "type", "help", "samples"} <= set(m)
+            for s in m["samples"]:
+                if m["type"] == "histogram":
+                    assert sum(s["counts"]) == s["count"]
+    # And the final state carries the full serve taxonomy.
+    final = {m["name"] for m in reg.snapshot()["metrics"]}
+    assert {"serve_admissions_total", "slo_ttfr_ms",
+            "serve_dispatch_launches_total"} <= final
+
+
 def test_metrics_disabled_service_records_nothing_and_matches():
     off = MetricsRegistry(enabled=False)
     on = MetricsRegistry()

@@ -350,6 +350,93 @@ SEEDED = {
             return out
         """,
     ),
+    # -- racelint (r21): each hazard class on its own module so the
+    # shared-state keys (which include the module path) cannot merge.
+    "race-unguarded-write": (
+        "pkg/raceland/unguarded.py",
+        """
+        import threading
+
+        _EVENTS = []
+
+        def worker():
+            _EVENTS.append("tick")
+
+        def run():
+            t = threading.Thread(target=worker)
+            t.start()
+            _EVENTS.append("started")
+            t.join()
+        """,
+    ),
+    "race-guard-split": (
+        "pkg/raceland/split.py",
+        """
+        import threading
+
+        _LOCK = threading.Lock()
+        _STATS = {}
+
+        def worker():
+            with _LOCK:
+                _STATS["ticks"] = _STATS.get("ticks", 0) + 1
+
+        def snapshot():
+            return dict(_STATS)
+
+        def run():
+            t = threading.Thread(target=worker)
+            t.start()
+            out = snapshot()
+            t.join()
+            return out
+        """,
+    ),
+    "race-lock-mismatch": (
+        "pkg/raceland/mismatch.py",
+        """
+        import threading
+
+        _A = threading.Lock()
+        _B = threading.Lock()
+        _STATE = {}
+
+        def worker():
+            with _A:
+                _STATE["n"] = 1
+
+        def run():
+            t = threading.Thread(target=worker)
+            t.start()
+            with _B:
+                n = _STATE.get("n")
+            t.join()
+            return n
+        """,
+    ),
+    "race-lock-order": (
+        "pkg/raceland/order.py",
+        """
+        import threading
+
+        _A = threading.Lock()
+        _B = threading.Lock()
+        _N = {}
+
+        def worker():
+            with _A:
+                with _B:
+                    _N["w"] = 1
+
+        def run():
+            t = threading.Thread(target=worker)
+            t.start()
+            with _B:
+                with _A:
+                    _N["r"] = _N.get("w")
+            t.join()
+        """,
+    ),
 }
 
 
@@ -722,6 +809,87 @@ def test_each_rule_fires_exactly_once_on_seeded_tree(tmp_path):
                 for cap in (32, 64):
                     c.inc(cap=f"cap={cap}", rung="b=4")
                 return jnp.histogram(samples, bins)
+            """,
+        ),
+        # racelint: every access path holds the SAME lock — clean,
+        # including the interprocedural hold (run's write is guarded
+        # by the with-lock in its CALLER-side block).
+        (
+            "race_common_lock",
+            """
+            import threading
+
+            _LOCK = threading.RLock()
+            _STATS = {}
+
+            def _bump(k):
+                _STATS[k] = _STATS.get(k, 0) + 1
+
+            def worker():
+                with _LOCK:
+                    _bump("ticks")
+
+            def run():
+                t = threading.Thread(target=worker)
+                t.start()
+                with _LOCK:
+                    _bump("polls")
+                t.join()
+                with _LOCK:
+                    return dict(_STATS)
+            """,
+        ),
+        # racelint happens-before refinements: writes in __init__
+        # precede publication, and a spawner's writes BEFORE its
+        # first spawn site precede the thread — neither is contested,
+        # so the single remaining accessor (the worker) is race-free.
+        (
+            "race_prespawn_and_init",
+            """
+            import threading
+
+            _CFG = {}
+
+            class Pump:
+                def __init__(self):
+                    self.buf = []
+                    self.buf.append("seed")
+
+                def loop(self):
+                    self.buf.append("tick")
+                    return _CFG.get("rate")
+
+                def start(self):
+                    _CFG["rate"] = 10
+                    t = threading.Thread(target=self.loop)
+                    t.start()
+                    return t
+            """,
+        ),
+        # racelint lock-order: both paths nest _A then _B — one
+        # canonical order, and _N is under the common pair — clean.
+        (
+            "race_lock_order_consistent",
+            """
+            import threading
+
+            _A = threading.Lock()
+            _B = threading.Lock()
+            _N = {}
+
+            def worker():
+                with _A:
+                    with _B:
+                        _N["w"] = 1
+
+            def run():
+                t = threading.Thread(target=worker)
+                t.start()
+                with _A:
+                    with _B:
+                        n = _N.get("w")
+                t.join()
+                return n
             """,
         ),
     ],
@@ -1172,6 +1340,66 @@ def test_cli_usage_error_on_bad_path(tmp_path):
 
     rc = main(["--root", str(tmp_path), "definitely_missing"])
     assert rc == 2
+
+
+def test_write_baseline_preserves_justifications(tmp_path):
+    # Editing a flagged line re-fingerprints its finding (the old
+    # entry goes stale); --write-baseline must carry the hand-written
+    # justification over instead of resetting it to TODO (r21, the
+    # r17 `budget_from_audit(previous=)` discipline).
+    from distributed_swarm_algorithm_tpu.analysis.__main__ import main
+
+    src_v1 = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        return x.mean().item()
+    """
+    _write_tree(str(tmp_path), [("mod.py", src_v1)])
+    bl = tmp_path / "bl.json"
+    rc = main(["--root", str(tmp_path), "--baseline", str(bl),
+               "--write-baseline", "mod.py"])
+    assert rc == 0
+    entries = baseline.load(str(bl))
+    assert len(entries) == 1
+    assert entries[0].justification.startswith("TODO")
+    # The human edits the justification in...
+    baseline.save(str(bl), [
+        baseline.Entry(
+            rule=entries[0].rule, path=entries[0].path,
+            context=entries[0].context, snippet=entries[0].snippet,
+            justification="host sync is the whole point here",
+        )
+    ])
+    # ...then the flagged LINE is edited (same hazard, new snippet):
+    src_v2 = src_v1.replace("x.mean().item()", "x.sum().item()")
+    _write_tree(str(tmp_path), [("mod.py", src_v2)])
+    rc = main(["--root", str(tmp_path), "--baseline", str(bl),
+               "--write-baseline", "mod.py"])
+    assert rc == 0
+    rewritten = baseline.load(str(bl))
+    assert len(rewritten) == 1
+    assert rewritten[0].snippet == "return x.sum().item()"
+    assert rewritten[0].justification == (
+        "host sync is the whole point here"
+    )
+    # A genuinely NEW finding (different context) still gets TODO.
+    src_v3 = textwrap.dedent(src_v2) + textwrap.dedent("""
+    @jax.jit
+    def g(y):
+        return float(y.max())
+    """)
+    with open(tmp_path / "mod.py", "w") as fh:
+        fh.write(src_v3)
+    rc = main(["--root", str(tmp_path), "--baseline", str(bl),
+               "--write-baseline", "mod.py"])
+    assert rc == 0
+    by_ctx = {e.context: e for e in baseline.load(str(bl))}
+    assert by_ctx["f"].justification == (
+        "host sync is the whole point here"
+    )
+    assert by_ctx["g"].justification.startswith("TODO")
 
 
 # ---------------------------------------------------------------------------

@@ -20,9 +20,21 @@ needs real chips):
                                       agents — real spatial load
                                       imbalance, the number the r11
                                       residency counters existed for)
+  spatial-escapes, ...                unit "events" (r22: live agents
+                                      outside their home strip at the
+                                      end of the run — 0 is the
+                                      re-homed contract)
+  spatial-migrations-per-rebuild, ... unit "migrations" (r22:
+                                      re-homing churn normalized by
+                                      rebuild count — growth means
+                                      tiles are thrashing agents)
 
 plus the standard recorder rows (truncation / rebuild rate) via
-``common.telemetry_rows``.
+``common.telemetry_rows``.  Since r22 the timed run is the
+locality-aware configuration (``spatial_per_tile_rebuild`` +
+``spatial_rehome``); the small-N parity gate keeps exercising the
+default global-OR mode, whose bitwise contract is the pinned
+baseline.
 
 The run gates itself twice before reporting: a small-N sharded-vs-
 single-device parity check (positions bitwise by agent id — the
@@ -127,7 +139,10 @@ def main() -> int:
               file=sys.stderr)
         return 2
 
-    cfg = _cfg(hw)
+    # r22 flagship sharded config: per-tile triggers + re-homing.
+    cfg = _cfg(hw).replace(
+        spatial_per_tile_rebuild=True, spatial_rehome=True,
+    )
     s = _station_swarm(n, hw)
     ts, spec = spatial_shard_swarm(s, mesh, cfg)
 
@@ -159,14 +174,16 @@ def main() -> int:
     bytes_tick = halo_bytes_per_tick(spec, rebuild_rate)
     escapes = int(np.asarray(carry.escapes).sum())
     halo_ovf = int(np.asarray(carry.halo_overflow).sum())
+    migrations = int(np.asarray(carry.migrations).sum())
+    mig_per_rebuild = migrations / max(summ["plan_rebuilds"], 1)
     print(
         f"# sharded tick (N={n}, {N_DEV} tiles, {STEPS} ticks): "
         f"{sec / STEPS * 1e3:.0f} ms/tick; residency max "
         f"{summ['shard_max_alive']}/{spec.capacity} agents/tile, "
         f"imbalance {summ['shard_imbalance_max']}; "
         f"rebuilds/100t {summ['rebuilds_per_100_ticks']:.1f}; "
-        f"escapes {escapes}, halo_overflow {halo_ovf}; halo "
-        f"{bytes_tick / 1024:.0f} KiB/tick"
+        f"escapes {escapes}, halo_overflow {halo_ovf}, migrations "
+        f"{migrations}; halo {bytes_tick / 1024:.0f} KiB/tick"
     )
     report(
         # swarmlint: disable=metric-fstring -- tag is a module constant; names are stable cross-round pins
@@ -182,6 +199,16 @@ def main() -> int:
         # swarmlint: disable=metric-fstring -- tag is a module constant; names are stable cross-round pins
         f"shard-imbalance-agents, {tag}",
         float(summ["shard_imbalance_max"]), "events", 0.0,
+    )
+    report(
+        # swarmlint: disable=metric-fstring -- tag is a module constant; names are stable cross-round pins
+        f"spatial-escapes, {tag}",
+        float(escapes), "events", 0.0,
+    )
+    report(
+        # swarmlint: disable=metric-fstring -- tag is a module constant; names are stable cross-round pins
+        f"spatial-migrations-per-rebuild, {tag}",
+        mig_per_rebuild, "migrations", 0.0,
     )
     telemetry_rows(summ, tag)
     run_dir = os.environ.get("DSA_RUN_DIR")

@@ -193,7 +193,7 @@ def compare(prev_label: str, cur_label: str, threshold: float = 0.2,
         unit = str(cur[key][1].get("unit", ""))
         if unit in ("findings", "rounds", "events", "ticks",
                     "compiles", "bytes", "collectives",
-                    "ms-p50", "ms-p99", "filler-pct"):
+                    "ms-p50", "ms-p99", "filler-pct", "migrations"):
             # Lower-is-better count metrics (swarmlint hygiene debt;
             # auction convergence rounds, r8; flight-recorder
             # truncation/churn counts and recovery-latency ticks,
@@ -202,7 +202,9 @@ def compare(prev_label: str, cur_label: str, threshold: float = 0.2,
             # scan-body collective census, r15 — one extra per-tick
             # collective costs T× a one-shot one; serve-SLO latency
             # percentiles, r16; dispatch filler fraction, r18 — the
-            # soak's declared padding cost): gate on growth,
+            # soak's declared padding cost; re-homing migration
+            # volume per rebuild, r22 — growth means tiles are
+            # churning agents): gate on growth,
             # never on paydown.  A clean baseline (0) regressing to
             # any positive count always gates.
             status = "ok"

@@ -12,11 +12,16 @@ floor exactly here).  The speed cap is load-bearing for the
 AMORTIZED rows: the refresh trigger fires when ANY agent outruns
 skin/2, so the reuse window is ~skin / (2 * per-tick max step) —
 at the protocol's full 5 m/s cap the densest pairs oscillate at the
-cap and the window collapses to ~1-2 ticks (the trigger-bound
-regime; its measured rate is recorded in docs/PERFORMANCE.md r9),
-while a 1 m/s correction cap is the regime a patrol/surveillance
-deployment actually holds station in.  Three rebuild policies over
-the same settled state:
+cap and the window collapses to ~1-2 ticks.  That trigger-bound
+regime is no longer a ceiling: the FAST-MOVER section below measures
+it head-on, where the r22 per-cell partial refresh
+(``hashgrid_partial_refresh`` — ops/hashgrid_plan.
+refresh_plan_partial) repairs only the violated stencil
+neighborhoods and demotes the ~97/100 full-rebuild rate
+(docs/PERFORMANCE.md r9) to a small residual.  A 1 m/s correction
+cap remains the regime a patrol/surveillance deployment actually
+holds station in, so the three classic policies keep their rows.
+Three rebuild policies over the same settled state:
 
     skin-0       per-tick rebuild (the r8 tick; no plan carry)
     skin-half-r  skin = personal_space/2: plan carried through the
@@ -28,7 +33,10 @@ the same settled state:
 Each policy reports agent-steps/sec (fixed-name, cpu-tagged) and the
 skin rows also report the OBSERVED rebuild count per 100 ticks
 (unit "rounds" — lower-is-better in compare.py, so a semantics
-change that silently burns the amortization gates).  Since r10 the
+change that silently burns the amortization gates).  The r22
+fast-mover rows add the full-rebuild rate under partial refresh
+(same "rounds" discipline), the mean refreshed-cell fraction on
+refresh ticks, and the partial-vs-full amortized speedup.  Since r10 the
 rebuild rate comes from the flight recorder's per-tick series
 (utils/telemetry.py summary) instead of hand-dividing the final
 plan's counter — one reducer for benches, tests, and production.  Skin tags ride
@@ -76,13 +84,15 @@ def _station_swarm():
 
 
 def _cfg(skin: float, cap: int, ncap: int, **kw) -> dsa.SwarmConfig:
-    return dsa.SwarmConfig().replace(
+    base = dict(
         separation_mode="hashgrid", sort_every=1,
         formation_shape="none", world_hw=HW,
         grid_max_per_cell=cap, hashgrid_overflow_budget=1024,
         hashgrid_backend="portable", max_speed=1.0,
-        hashgrid_skin=skin, hashgrid_neighbor_cap=ncap, **kw,
+        hashgrid_skin=skin, hashgrid_neighbor_cap=ncap,
     )
+    base.update(kw)        # fast-mover rows override max_speed
+    return dsa.SwarmConfig().replace(**base)
 
 
 def _time_rollout(s, cfg, steps: int):
@@ -100,6 +110,37 @@ def _time_rollout(s, cfg, steps: int):
     return timeit_best(
         once, lambda: float(holder["out"].pos[0, 0])
     )
+
+
+def _refresh_stats(s, cfg, steps: int):
+    """(full_rebuilds_per_100_ticks, mean refreshed-cell fraction on
+    refresh ticks) from the flight recorder's cumulative series.  A
+    full rebuild adds g^2 to ``cells_rebuilt``; a partial repair adds
+    only the refreshed rows — diffing both series separates them.
+    Untimed, like :func:`_rebuild_rate`."""
+    import numpy as np
+
+    from distributed_swarm_algorithm_tpu.ops.physics import (
+        resolve_plan_geometry,
+    )
+
+    g, _, _ = resolve_plan_geometry(
+        False, cfg.world_hw, cfg.grid_cell, cfg.personal_space,
+        cfg.grid_max_per_cell, float(cfg.hashgrid_skin),
+        field_on=False, field_sep_cell=cfg.grid_cell,
+        align_cell=cfg.align_cell,
+    )
+    _, telem = dsa.swarm_rollout(s, None, cfg, steps, telemetry=True)
+    cells = np.asarray(telem.cells_rebuilt)
+    rebuilds = np.asarray(telem.plan_rebuilds)
+    dcells = np.diff(cells, prepend=0)
+    rate = 100.0 * float(rebuilds[-1]) / steps
+    refresh = dcells > 0
+    frac = (
+        float(np.mean(dcells[refresh] / float(g * g)))
+        if refresh.any() else 0.0
+    )
+    return rate, frac
 
 
 def _rebuild_rate(s, cfg, steps: int) -> float:
@@ -180,6 +221,67 @@ def main() -> None:
         "hashgrid-verlet-rebuilds-per-100-ticks, 65536 agents "
         "skin-full-r (cpu)",
         r_full, "rounds", 0.0,
+    )
+
+    # --- r22 fast movers: per-cell partial refresh -------------------
+    # The trigger-bound regime the module doc names: at the full
+    # 5 m/s protocol cap the global displacement trigger fires nearly
+    # every tick (~97/100, PERFORMANCE.md r9).  Partial refresh
+    # repairs only violated stencil neighborhoods, so the FULL
+    # rebuild becomes the rare escalation and the common tick pays
+    # ~the refreshed-cell fraction of a build.
+    fast_settle = _cfg(0.0, 16, 0, max_speed=5.0)
+    s_fast = dsa.swarm_rollout(s0, None, fast_settle, SETTLE)
+    jax.block_until_ready(s_fast.pos)
+    cfg_fast_full = _cfg(1.5, 24, 48, max_speed=5.0)
+    cfg_fast_part = _cfg(
+        1.5, 24, 48, max_speed=5.0, hashgrid_partial_refresh=True,
+    )
+    tf_full = _time_rollout(s_fast, cfg_fast_full, STEPS)
+    tf_part = _time_rollout(s_fast, cfg_fast_part, STEPS)
+    rf_full = _rebuild_rate(s_fast, cfg_fast_full, STEPS)
+    rf_part, frac_part = _refresh_stats(s_fast, cfg_fast_part, STEPS)
+    speedup = tf_full / tf_part
+    print(
+        f"# fast movers (max_speed=5) ms/tick: full "
+        f"{tf_full / STEPS * 1e3:.1f} (rebuilds/100t {rf_full:.0f}) "
+        f"| partial {tf_part / STEPS * 1e3:.1f} (full-rebuilds/100t "
+        f"{rf_part:.0f}, refreshed-cell fraction {frac_part:.3f}) | "
+        f"speedup {speedup:.2f}x"
+    )
+    report(
+        "hashgrid-verlet-fastmover-agent-steps/sec, 65536 agents "
+        "full-refresh (cpu)",
+        N * STEPS / tf_full, "agent-steps/sec",
+        REFERENCE_AGENT_STEPS_PER_SEC,
+    )
+    report(
+        "hashgrid-verlet-fastmover-agent-steps/sec, 65536 agents "
+        "partial-refresh (cpu)",
+        N * STEPS / tf_part, "agent-steps/sec",
+        REFERENCE_AGENT_STEPS_PER_SEC,
+    )
+    report(
+        "hashgrid-verlet-fastmover-rebuilds-per-100-ticks, 65536 "
+        "agents full-refresh (cpu)",
+        rf_full, "rounds", 0.0,
+    )
+    report(
+        "hashgrid-verlet-fastmover-full-rebuilds-per-100-ticks, "
+        "65536 agents partial-refresh (cpu)",
+        rf_part, "rounds", 0.0,
+    )
+    # Percent, not raw fraction: report() rounds to one decimal and
+    # a 0.1-grain fraction would make the relative gate flap.
+    report(
+        "hashgrid-verlet-fastmover-cell-rebuild-pct, 65536 "
+        "agents partial-refresh (cpu)",
+        100.0 * frac_part, "rounds", 0.0,
+    )
+    report(
+        "hashgrid-verlet-fastmover-amortized-speedup, 65536 agents "
+        "partial-vs-full (cpu)",
+        speedup, "x", 0.0,
     )
 
     # --- field_deposit flag: scatter vs sorted on the shared plan ----

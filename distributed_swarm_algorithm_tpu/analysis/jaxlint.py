@@ -418,8 +418,11 @@ def _spec_swarm_tick():
 def _spec_swarm_rollout():
     from ..models.swarm import _swarm_rollout_impl
 
+    # r22: census the locality-aware refresh path (per-cell partial
+    # repair) — the flagship amortized rollout configuration.
+    cfg = _swarm_cfg().replace(hashgrid_partial_refresh=True)
     return (
-        _swarm_rollout_impl, (_station(64), None, _swarm_cfg(), 4), {},
+        _swarm_rollout_impl, (_station(64), None, cfg, 4), {},
     )
 
 
@@ -434,7 +437,13 @@ def _spec_swarm_rollout_spatial():
     from ..parallel.mesh import make_mesh
     from ..parallel.spatial import SPATIAL_AXIS, spatial_shard_swarm
 
-    cfg = _swarm_cfg()
+    # r22: census the per-tile trigger + re-homing tick — the fully
+    # locality-aware sharded configuration (the global-OR baseline
+    # stays covered by the bitwise parity pins in
+    # tests/test_spatial_shard.py).
+    cfg = _swarm_cfg().replace(
+        spatial_per_tile_rebuild=True, spatial_rehome=True,
+    )
     mesh = make_mesh((SPATIAL_AXIS,), devices=jax.devices()[:8])
     tiled, spec = spatial_shard_swarm(_station(512), mesh, cfg)
     return (

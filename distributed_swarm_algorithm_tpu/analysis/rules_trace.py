@@ -296,9 +296,9 @@ class PlanStalenessRule(Rule):
         "`build_hashgrid_plan` inside a lax.scan/fori_loop/while_loop "
         "body pays the full bin+sort every iteration — the r8 "
         "structural floor.  Rollout bodies should carry the plan and "
-        "route it through `refresh_plan` (ops/hashgrid_plan.py), "
-        "which rebuilds under lax.cond only when the Verlet skin "
-        "guarantee has expired."
+        "route it through `refresh_plan` or `refresh_plan_partial` "
+        "(ops/hashgrid_plan.py), which rebuild under lax.cond/switch "
+        "only when the Verlet skin guarantee has expired."
     )
 
     def check(self, mod: ModuleInfo):
@@ -330,7 +330,9 @@ class PlanStalenessRule(Rule):
                     leaf = name.rsplit(".", 1)[-1] if name else ""
                     if leaf == "build_hashgrid_plan":
                         builds.append(node)
-                    elif leaf == "refresh_plan":
+                    elif leaf in (
+                        "refresh_plan", "refresh_plan_partial"
+                    ):
                         has_refresh = True
             if has_refresh:
                 continue
@@ -343,8 +345,8 @@ class PlanStalenessRule(Rule):
                     self.id, b,
                     "`build_hashgrid_plan` inside a loop-transform "
                     "body rebuilds the spatial index every iteration "
-                    "— carry the plan and use `refresh_plan` (Verlet "
-                    "skin reuse)",
+                    "— carry the plan and use `refresh_plan` / "
+                    "`refresh_plan_partial` (Verlet skin reuse)",
                 )
 
 
@@ -603,7 +605,12 @@ class ScopeStringRule(Rule):
 #: Plan producers/consumers whose presence in a shard_map body means
 #: the body runs a PER-SHARD spatial index.
 _PLAN_CALLS = frozenset(
-    {"build_hashgrid_plan", "refresh_plan", "separation_grid_plan"}
+    {
+        "build_hashgrid_plan",
+        "refresh_plan",
+        "refresh_plan_partial",
+        "separation_grid_plan",
+    }
 )
 
 #: Call leaves that count as a halo exchange being in scope: the ring

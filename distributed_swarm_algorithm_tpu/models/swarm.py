@@ -287,12 +287,15 @@ def _swarm_rollout_impl(
     ``hashgrid_skin > 0`` the scan carry is ``(state, plan)`` — ONE
     skin-inflated ``HashgridPlan`` seeded by ``build_tick_plan`` and
     reused across ticks, rebuilt inside the tick only when
-    ``refresh_plan``'s displacement/alive/ceiling triggers fire.  The
-    per-tick bin+sort (the r8 structural floor) becomes a per-rebuild
-    cost; detection stays exact (ops/hashgrid_plan.py module doc).
+    ``refresh_plan``'s displacement/alive/ceiling triggers fire (or,
+    with ``cfg.hashgrid_partial_refresh``, partially repaired by the
+    r22 locality-aware ``refresh_plan_partial``).  The per-tick
+    bin+sort (the r8 structural floor) becomes a per-rebuild cost;
+    detection stays exact (ops/hashgrid_plan.py module doc).
     ``return_plan=True`` appends the final plan to the result — its
-    ``rebuilds``/``age`` counters are the observed rebuild rate the
-    benches report (``None`` outside the plan-carry regime).
+    ``rebuilds``/``cells_rebuilt``/``age`` counters are the observed
+    full-rebuild rate and refreshed-row total the benches report
+    (``None`` outside the plan-carry regime).
 
     Flight recorder (r10): with ``telemetry=True`` (or
     ``cfg.telemetry.enabled``) each tick's fixed-shape

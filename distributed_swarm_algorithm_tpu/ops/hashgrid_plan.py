@@ -94,6 +94,19 @@ gathers — at 65k/CPU the stencil sweep is ~170 ms of the ~210 ms
 tick and the union sweep is ~3x tighter; this, not the build
 amortization alone, is what makes the r9 amortized regime >1.5x
 (benchmarks/decompose_rebuild.py).
+
+Locality-aware partial refresh (r22).  The r9 trigger is GLOBAL: one
+agent past ``skin/2`` rebuilds the whole structure, which collapses in
+the fast-mover regime (max_speed=5: ~97/100 ticks rebuilt, the ceiling
+PERFORMANCE.md r9 documented).  :func:`refresh_plan_partial` replaces
+it with per-agent anchors and a per-cell repair: violators re-anchor
+individually, only CROSSING violators change structure, and only the
+candidate rows whose 3x3 stencil touches a crosser's old/new cell are
+rebuilt — bitwise-identical to a scratch build over the mixed
+per-agent reference, at a measured ~5x less than a full build at 65k
+(docs/PERFORMANCE.md r22).  Enabled by
+``SwarmConfig.hashgrid_partial_refresh``; the default stays the r9
+global trigger.
 """
 
 from __future__ import annotations
@@ -138,9 +151,14 @@ class HashgridPlan:
 
     Verlet-reuse fields (r9): ``ref_pos``/``ref_alive`` snapshot the
     build inputs (what :func:`refresh_plan`'s staleness check compares
-    against), ``age`` counts ticks since the last rebuild, and
-    ``rebuilds`` counts rebuilds over the plan's lifetime (the
-    observed-rebuild-rate counter the benches report).  ``skin``
+    against), ``age`` counts ticks since the last FULL rebuild,
+    ``rebuilds`` counts full rebuilds over the plan's lifetime (the
+    observed-rebuild-rate counter the benches report), and
+    ``cells_rebuilt`` (r22) counts candidate ROWS refreshed — a full
+    rebuild adds ``g*g``, a :func:`refresh_plan_partial` repair adds
+    only the dilated trigger neighborhood, so the ratio
+    ``cells_rebuilt / (rebuilds * g * g)`` is the locality win the
+    r22 benches report.  ``skin``
     rides as static aux — the validity contract every consumer
     budgets its coverage check against.  ``cand [g*g, W]`` is the
     per-cell stencil-union candidate table (module doc) with
@@ -159,7 +177,7 @@ class HashgridPlan:
     ARRAY_FIELDS = (
         "cx", "cy", "key", "order", "skey", "rank", "ok", "sx", "sy",
         "counts", "starts", "fkey", "xt", "yt",
-        "ref_pos", "ref_alive", "age", "rebuilds",
+        "ref_pos", "ref_alive", "age", "rebuilds", "cells_rebuilt",
         "cand", "cand_overflow", "cap_overflow",
     )
     AUX_FIELDS = (
@@ -171,6 +189,7 @@ class HashgridPlan:
                  cx, cy, key, order, skey, rank, ok, sx, sy,
                  counts=None, starts=None, fkey=None, xt=None, yt=None,
                  ref_pos=None, ref_alive=None, age=None, rebuilds=None,
+                 cells_rebuilt=None,
                  cand=None, cand_overflow=None, cap_overflow=None,
                  skin=0.0,
                  field_sep_cell=None, field_align_cell=None):
@@ -199,6 +218,7 @@ class HashgridPlan:
         self.ref_alive = ref_alive
         self.age = age
         self.rebuilds = rebuilds
+        self.cells_rebuilt = cells_rebuilt
         self.cand = cand
         self.cand_overflow = cand_overflow
         self.cap_overflow = cap_overflow
@@ -443,6 +463,7 @@ def _build_hashgrid_plan_impl(
         ref_pos=pos, ref_alive=alive,
         age=jnp.zeros((), jnp.int32),
         rebuilds=jnp.zeros((), jnp.int32),
+        cells_rebuilt=jnp.zeros((), jnp.int32),
         cand=cand, cand_overflow=cand_overflow,
         cap_overflow=cap_overflow,
     )
@@ -543,12 +564,282 @@ def refresh_plan(
             g=plan.g, skin=skin,
             neighbor_cap=plan.cand.shape[1] if plan.has_list else 0,
         )
-        return p.replace(rebuilds=plan.rebuilds + 1)
+        return p.replace(
+            rebuilds=plan.rebuilds + 1,
+            cells_rebuilt=plan.cells_rebuilt + plan.g * plan.g,
+        )
 
     def keep():
         return plan.replace(age=plan.age + 1)
 
     return jax.lax.cond(stale, rebuild, keep)
+
+
+def refresh_plan_partial(
+    pos: jax.Array,
+    alive: jax.Array,
+    plan: HashgridPlan,
+    rebuild_every: int = 0,
+    crosser_cap: int = 512,
+) -> HashgridPlan:
+    """The r22 locality-aware Verlet trigger: like :func:`refresh_plan`
+    but with PER-AGENT anchors and a per-cell partial repair, so a
+    handful of fast movers no longer forces the whole ``[g*g, W]``
+    structure to rebuild.
+
+    Each agent is anchored at its own snapshot position in ``ref_pos``
+    (mixed snapshot times).  Soundness is per-pair by the triangle
+    inequality: a pair within ``r`` now was within ``r + skin`` at its
+    endpoints' anchors as long as each endpoint sits within ``skin/2``
+    of its OWN anchor — the anchors need not be simultaneous.  The
+    plan invariant is ``key[i] == cell(ref_pos[i])``: every agent is
+    listed under its anchor's cell.  Per tick, three tiers:
+
+      - **keep**: no agent violated its ``skin/2`` budget -> age + 1,
+        nothing else (identical to :func:`refresh_plan`'s keep).
+      - **partial**: some agents violated.  Violators re-anchor at
+        their current position.  In-cell violators change no
+        structure (their key is unchanged); CROSSING violators
+        (current cell != anchored cell) are repaired incrementally —
+        their slots move in the sorted order (a gather-form merge:
+        composite ``key*n + i`` keys are unique, so removal/insert
+        positions come from a few small ``searchsorted`` passes, no
+        [N] scatter and no full sort), per-cell ``counts``/``starts``
+        update by +-1, and only the candidate rows whose 3x3 stencil
+        neighborhood touches a crosser's old or new cell are rebuilt
+        (the nine-interval select of :func:`_cell_union_table` run
+        over just those rows, selected back into ``cand`` by mask).
+        Non-violating agents keep their anchors — even ones that
+        drifted across a cell line (sound: they are within ``skin/2``
+        of the anchor they are listed under).  The result is
+        BITWISE-IDENTICAL to ``build_hashgrid_plan`` run on the mixed
+        reference ``where(violated, pos, ref_pos)`` (the sort order
+        depends only on ``(key, i)``; membership changes are confined
+        to trigger cells; the dilation covers every affected row) —
+        the equality tests/test_verlet_plan.py pins.
+      - **full**: the alive set changed (live-only keying is stale
+        everywhere), the ``rebuild_every`` ceiling hit, more than
+        ``crosser_cap`` agents crossed, or the dilated rows exceed
+        the fixed row budget (``g*g // 4`` — the partial form only
+        wins while it touches a minority of rows).  Counted in
+        ``rebuilds`` and resetting ``age``, exactly like
+        :func:`refresh_plan`'s rebuild.  The partial tier counts in
+        ``cells_rebuilt`` only and does NOT reset ``age``, so the
+        ``rebuild_every`` ceiling keeps bounding the oldest anchor.
+
+    Plans that cannot be partially repaired fall back to
+    :func:`refresh_plan` statically: no candidate table or no skin
+    (nothing to scope), a riding field binning (``fkey`` would need
+    its own repair; geometry resolution never skins field plans —
+    see ``physics.resolve_plan_geometry``), or ``n * (g*g + 1)``
+    overflowing i32 (the merge's composite keys).  Plans built with
+    a ``tiebreak`` are NOT supported (the merge orders within cells
+    by array position); the spatially-sharded path keeps its own
+    per-shard full rebuilds (``parallel/spatial.py``)."""
+    from .neighbors import torus_cell_xy
+
+    skin = plan.skin
+    n = pos.shape[0]
+    g = plan.g
+    g2 = g * g
+    if (
+        (not plan.has_list) or skin <= 0.0 or plan.has_field
+        or n * (g2 + 1) >= 2**31
+    ):
+        return refresh_plan(pos, alive, plan, rebuild_every)
+
+    row_cap = max(1, g2 // 4)
+    ccap = min(int(crosser_cap), n)
+    w = plan.cand.shape[1]
+    K = plan.max_per_cell
+    hw = plan.torus_hw
+    iota = jnp.arange(n, dtype=jnp.int32)
+    BIG = jnp.int32(2**31 - 1)
+
+    with jax.named_scope("hashgrid_plan_partial_trigger"):
+        # Per-agent staleness (same float forms as plan_staleness so
+        # the trigger boundary matches the global probe exactly).
+        d = pos - plan.ref_pos
+        d = jnp.mod(d + hw, 2.0 * hw) - hw
+        viol = 4.0 * jnp.sum(d * d, axis=-1) > skin * skin
+        ccx, ccy = torus_cell_xy(pos, hw, g)
+        key_cur = jnp.where(alive, ccx * g + ccy, g2)
+        crossed = viol & (key_cur != plan.key)
+        alive_changed = jnp.any(alive != plan.ref_alive)
+        trigger = jnp.any(viol)
+        new_ref = jnp.where(viol[:, None], pos, plan.ref_pos)
+
+        # Crosser compaction WITHOUT jnp.nonzero: ranks are monotone,
+        # so searchsorted inverts the cumsum (nonzero lowers to an
+        # [N] scatter — ~3 ms at 65k on CPU, most of the budget).
+        cranks = jnp.cumsum(crossed.astype(jnp.int32))
+        n_cross = cranks[-1]
+        cidx = jnp.searchsorted(
+            cranks, jnp.arange(1, ccap + 1, dtype=jnp.int32)
+        ).astype(jnp.int32)
+        cvalid = cidx < n
+        cj = jnp.minimum(cidx, n - 1)
+        ckey_old = jnp.where(cvalid, plan.key[cj], g2)
+        ckey_new = jnp.where(cvalid, key_cur[cj], g2)
+
+        # Trigger cells (old + new homes of crossers), 3x3-dilated to
+        # the rows whose stencil union they can appear in.  Computed
+        # eagerly: the tier predicate needs the exact row count (a
+        # truncated row set would leave invalid rows stale).
+        trig = (
+            jnp.zeros((g2 + 1,), bool)
+            .at[ckey_old].set(True, mode="drop")
+            .at[ckey_new].set(True, mode="drop")
+        )[:g2]
+        tg = trig.reshape(g, g)
+        dil = tg
+        for dx in (-1, 0, 1):
+            for dy in (-1, 0, 1):
+                if dx or dy:
+                    dil = dil | jnp.roll(jnp.roll(tg, dx, 0), dy, 1)
+        refresh = dil.reshape(-1)
+        n_rows = jnp.sum(refresh).astype(jnp.int32)
+
+        age_hit = jnp.zeros((), bool)
+        if rebuild_every > 0:
+            age_hit = plan.age + 1 >= rebuild_every
+        full_needed = (
+            alive_changed | age_hit
+            | (trigger & ((n_cross > ccap) | (n_rows > row_cap)))
+        )
+        branch = jnp.where(full_needed, 2, jnp.where(trigger, 1, 0))
+
+    def _keep(_):
+        return plan.replace(age=plan.age + 1)
+
+    def _partial(_):
+        with jax.named_scope("hashgrid_plan_partial_refresh"):
+            # -- gather-form merge of the sorted order ------------
+            rm = jnp.where(cvalid, plan.key[cj] * n + cj, BIG)
+            ins = jnp.where(cvalid, key_cur[cj] * n + cj, BIG)
+            insa = jnp.where(cvalid, cj, n)
+            rm_s = jnp.sort(rm)
+            ins_s, insa_s = jax.lax.sort((ins, insa), num_keys=1)
+            A = plan.skey * n + plan.order
+            carr = jnp.arange(ccap, dtype=jnp.int32)
+            # removed slots (exact matches in A; padding -> BIG)
+            u = jnp.searchsorted(A, rm_s).astype(jnp.int32)
+            uai = jnp.where(rm_s == BIG, BIG, u - carr)
+            # insert target positions (strictly increasing when valid)
+            ob = jnp.searchsorted(A, ins_s).astype(jnp.int32)
+            rl = jnp.searchsorted(rm_s, ins_s).astype(jnp.int32)
+            npi = jnp.where(ins_s == BIG, BIG, carr + ob - rl)
+            is_ins = jnp.zeros((n,), bool).at[
+                jnp.where(ins_s == BIG, n, npi)
+            ].set(True, mode="drop")
+            ic = jnp.searchsorted(
+                npi, iota, side="right"
+            ).astype(jnp.int32)
+            # kept slot for target t: the (t - ic)-th unremoved slot,
+            # recovered from the sorted removed-slot table
+            r = jnp.searchsorted(
+                uai, iota - ic, side="right"
+            ).astype(jnp.int32)
+            s = jnp.minimum(iota - ic + r, n - 1)
+            order = jnp.where(
+                is_ins,
+                insa_s[jnp.clip(ic - 1, 0, ccap - 1)].astype(jnp.int32),
+                plan.order[s],
+            )
+            key_new = jnp.where(crossed, key_cur, plan.key)
+            cx_new = jnp.where(crossed, ccx, plan.cx)
+            cy_new = jnp.where(crossed, ccy, plan.cy)
+            skey = key_new[order]
+            run_start = jnp.where(
+                skey != jnp.concatenate([skey[:1] - 1, skey[:-1]]),
+                iota, 0,
+            )
+            rank = iota - jax.lax.cummax(run_start)
+            ok = (rank < K) & (skey < g2)
+            sx = new_ref[order, 0]
+            sy = new_ref[order, 1]
+            counts = (
+                plan.counts.at[ckey_old].add(-1, mode="drop")
+                .at[ckey_new].add(1, mode="drop")
+            )
+            starts = jnp.cumsum(counts) - counts
+            cap_overflow = jnp.sum(
+                jnp.maximum(counts - K, 0)
+            ).astype(jnp.int32)
+
+            # -- sparse stencil-union rows (nine-interval select of
+            # _cell_union_table over only the refreshed rows) ------
+            rranks = jnp.cumsum(refresh.astype(jnp.int32))
+            rows = jnp.searchsorted(
+                rranks, jnp.arange(1, row_cap + 1, dtype=jnp.int32)
+            ).astype(jnp.int32)
+            rvalid = rows < g2
+            rc = jnp.minimum(rows, g2 - 1)
+            rcx = rc // g
+            rcy = rc % g
+            wiota = jnp.arange(w, dtype=jnp.int32)[None, :]
+            src = jnp.full((row_cap, w), n, jnp.int32)
+            lo = jnp.zeros((row_cap,), jnp.int32)
+            tot_old = jnp.zeros((row_cap,), jnp.int32)
+            for dx in (-1, 0, 1):
+                for dy in (-1, 0, 1):
+                    nkey = (
+                        jnp.mod(rcx + dx, g) * g + jnp.mod(rcy + dy, g)
+                    )
+                    occ = jnp.minimum(counts[nkey], K)
+                    st = starts[nkey]
+                    hi = lo + occ
+                    m = (wiota >= lo[:, None]) & (wiota < hi[:, None])
+                    src = jnp.where(
+                        m, st[:, None] + (wiota - lo[:, None]), src
+                    )
+                    lo = hi
+                    tot_old = tot_old + jnp.minimum(
+                        plan.counts[nkey], K
+                    )
+            rows_cand = jnp.where(
+                src < n,
+                order[jnp.minimum(src, n - 1)].astype(jnp.int32),
+                n,
+            )
+            # gather-form row select: which refreshed row covers c
+            pos_in = jnp.clip(
+                jnp.searchsorted(
+                    rows, jnp.arange(g2, dtype=jnp.int32)
+                ).astype(jnp.int32),
+                0, row_cap - 1,
+            )
+            cand = jnp.where(
+                refresh[:, None], rows_cand[pos_in], plan.cand
+            )
+            # incremental cand_overflow: stencil totals change only
+            # inside the refreshed rows, so swap their old excess
+            # for their new
+            ex_old = jnp.where(rvalid, jnp.maximum(tot_old - w, 0), 0)
+            ex_new = jnp.where(rvalid, jnp.maximum(lo - w, 0), 0)
+            cand_overflow = (
+                plan.cand_overflow + jnp.sum(ex_new) - jnp.sum(ex_old)
+            )
+            return plan.replace(
+                cx=cx_new, cy=cy_new, key=key_new, order=order,
+                skey=skey, rank=rank, ok=ok, sx=sx, sy=sy,
+                counts=counts, starts=starts, cand=cand,
+                cand_overflow=cand_overflow, cap_overflow=cap_overflow,
+                ref_pos=new_ref, age=plan.age + 1,
+                cells_rebuilt=plan.cells_rebuilt + n_rows,
+            )
+
+    def _full(_):
+        p = build_hashgrid_plan(
+            pos, alive, hw, plan.cell_eff, K,
+            need_csr=plan.has_csr, g=g, skin=skin, neighbor_cap=w,
+        )
+        return p.replace(
+            rebuilds=plan.rebuilds + 1,
+            cells_rebuilt=plan.cells_rebuilt + g2,
+        )
+
+    return jax.lax.switch(branch, (_keep, _partial, _full), None)
 
 
 def plan_field_keys(plan: HashgridPlan):

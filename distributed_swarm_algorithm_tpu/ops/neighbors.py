@@ -405,14 +405,14 @@ def suggest_window(
     return int(np.clip(int(np.ceil(safety * max(p95, 1.0))), lo, hi))
 
 
-def torus_cell_tables(pos: jax.Array, torus_hw: float, g: int):
-    """(cx, cy, key, counts, starts) for the ``g x g`` cell grid
-    tiling the torus ``[-hw, hw)^2``: per-agent cell coordinates and
-    row-major key, plus the CSR occupancy tables over the ``g*g`` key
-    space.  Shared by :func:`separation_grid`'s torus mode and the
-    Pallas hash-grid kernel (ops/pallas/grid_separation.py) so the
-    cell assignment the kernel's parity contract depends on cannot
-    drift between backends."""
+def torus_cell_xy(pos: jax.Array, torus_hw: float, g: int):
+    """(cx, cy): per-agent cell coordinates on the ``g x g`` grid
+    tiling the torus ``[-hw, hw)^2`` — the ONE binning formula (clip
+    convention) every backend shares.  Split out of
+    :func:`torus_cell_tables` for callers that need the assignment
+    without the [g*g] CSR scatter+cumsum (the r22 partial-refresh
+    trigger probes cell crossings every tick; the scatter would cost
+    more than the whole probe)."""
     cell_eff = 2.0 * torus_hw / g
     cx = jnp.clip(
         jnp.floor((pos[:, 0] + torus_hw) / cell_eff).astype(jnp.int32),
@@ -422,6 +422,18 @@ def torus_cell_tables(pos: jax.Array, torus_hw: float, g: int):
         jnp.floor((pos[:, 1] + torus_hw) / cell_eff).astype(jnp.int32),
         0, g - 1,
     )
+    return cx, cy
+
+
+def torus_cell_tables(pos: jax.Array, torus_hw: float, g: int):
+    """(cx, cy, key, counts, starts) for the ``g x g`` cell grid
+    tiling the torus ``[-hw, hw)^2``: per-agent cell coordinates and
+    row-major key, plus the CSR occupancy tables over the ``g*g`` key
+    space.  Shared by :func:`separation_grid`'s torus mode and the
+    Pallas hash-grid kernel (ops/pallas/grid_separation.py) so the
+    cell assignment the kernel's parity contract depends on cannot
+    drift between backends."""
+    cx, cy = torus_cell_xy(pos, torus_hw, g)
     key = cx * g + cy
     counts = jnp.zeros((g * g,), jnp.int32).at[key].add(1)
     starts = jnp.cumsum(counts) - counts

@@ -709,15 +709,26 @@ def _physics_step_core(
     return arity."""
     dt = cfg.dt if dt is None else dt
     if plan is not None:
-        from .hashgrid_plan import refresh_plan
+        from .hashgrid_plan import refresh_plan, refresh_plan_partial
 
         # Refresh BEFORE the forces so the exactness bound is
         # checked against the exact positions this tick's forces
         # read.
-        plan = refresh_plan(
-            state.pos, state.alive, plan,
-            rebuild_every=cfg.hashgrid_rebuild_every,
-        )
+        if cfg.hashgrid_partial_refresh:
+            # r22 locality-aware trigger: per-agent anchors, partial
+            # per-cell repair, full rebuild only on alive changes /
+            # ceiling / trigger storms (ineligible plans fall back to
+            # the global trigger inside).
+            plan = refresh_plan_partial(
+                state.pos, state.alive, plan,
+                rebuild_every=cfg.hashgrid_rebuild_every,
+                crosser_cap=cfg.hashgrid_partial_crosser_cap,
+            )
+        else:
+            plan = refresh_plan(
+                state.pos, state.alive, plan,
+                rebuild_every=cfg.hashgrid_rebuild_every,
+            )
     derived = formation_targets(state, cfg)
     force, tick_plan = apf_forces_plan(derived, obstacles, cfg, plan=plan,
                                        params=params)
@@ -794,12 +805,21 @@ def physics_step_spatial(
     existed for."""
     from ..parallel.spatial import (
         SPATIAL_AXIS,
+        spatial_rehome_step,
         spatial_separation_step,
         tile_live_counts,
     )
 
     axis = axis or SPATIAL_AXIS
     dt = cfg.dt if dt is None else dt
+    if cfg.spatial_rehome and spec.n_tiles > 1:
+        # r22 drifter re-homing: migrate escapees BEFORE any consumer
+        # of tile residency, so this tick's escapes counter measures
+        # the post-migration state.
+        with jax.named_scope("spatial_rehome"):
+            state, carry = spatial_rehome_step(
+                state, carry, cfg, spec, mesh, axis
+            )
     derived = formation_targets(state, cfg)
     with jax.named_scope("spatial_separation"):
         f_sep, carry = spatial_separation_step(
@@ -828,6 +848,10 @@ def physics_step_spatial(
                 if plan.cand_overflow is not None
                 else jnp.asarray(0, jnp.int32)
             ),
+            cells_rebuilt=jnp.sum(plan.cells_rebuilt).astype(
+                jnp.int32
+            ),
+            migrations=jnp.sum(carry.migrations).astype(jnp.int32),
             shard_max_alive=jnp.max(counts),
             shard_imbalance=jnp.max(counts) - jnp.min(counts),
         )

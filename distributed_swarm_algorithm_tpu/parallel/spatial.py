@@ -69,8 +69,9 @@ single-device plan's, which is what makes sharded-vs-single parity
 exact (tests/test_spatial_shard.py).  The plan is carried through the
 rollout scan and rebuilt under ``lax.cond`` by the r9 staleness
 triggers (displacement > skin/2, alive-set change, age ceiling),
-evaluated over local + halo and then OR-reduced across the mesh
-(``lax.pmax``).  The global OR is load-bearing twice over:
+evaluated over local + halo and then — in the default mode —
+OR-reduced across the mesh (``lax.pmax``).  The global OR is
+load-bearing twice over:
 
 - **exactness**: shard ``d``'s halo membership was selected from
   BUILD-TIME positions, so a fast mover on shard ``e`` can invalidate
@@ -82,8 +83,56 @@ evaluated over local + halo and then OR-reduced across the mesh
   under non-uniform predicates hang — the pmax makes the predicate
   uniform by construction, so every shard enters the same branch.
 
-(The per-tile trigger that lets a fast mover rebuild only its
-neighborhood is ROADMAP item 3b, unchanged by this module.)
+Per-tile triggers (r22)
+-----------------------
+
+``cfg.spatial_per_tile_rebuild`` replaces the mesh-wide OR with a
+TWO-LEVEL predicate so one fast mover rebuilds its own neighborhood
+instead of every tile.  Both global-OR obligations are re-discharged
+locally:
+
+- **exactness**: halo membership is re-selected EVERY tick from
+  current positions (bitwise-equal to the carried lists on quiet
+  ticks), so the shipped band is never stale; each tile compares the
+  fresh lists against last tick's and ships a one-shot BAND-EDGE
+  TRIGGER on the payload's meta row (``[halo_cap + 1, 4]`` — the
+  extra row carries the trigger scalar plus the free-slot advert the
+  re-homing pass reads).  A tile's rebuild predicate is its own
+  local+halo staleness OR'd with the two received neighbor triggers:
+  halo-slot *displacement* and *death* are visible in the tile's own
+  ext staleness probe (the refresh ships current positions/alive
+  bits), and halo-slot *identity* changes are exactly the neighbor
+  membership changes the meta row announces — same tick, because
+  selection is per-tick.
+- **deadlock-freedom**: the single per-tick exchange happens BEFORE
+  the cond and serves both branches (the rebuild branch bins the
+  already-exchanged ``local + halo`` view), so the rebuild branch
+  holds NO collectives and the non-uniform predicate is safe by
+  construction.
+
+Drifter re-homing (r22)
+-----------------------
+
+``cfg.spatial_rehome`` runs a bounded ring migration over every
+agent-axis state leaf at the top of each sharded tick
+(:func:`spatial_rehome_step`, before the separation pass): live
+agents whose position left their home strip ship one ring hop toward
+it per tick — below-strip escapees down, above-strip up — as fixed
+``[spatial_migration_cap, F]`` f32 payloads (ids exact below 2^24,
+the r11 packed-collective rule).  Receivers place arrivals into dead
+slots; capacity is guaranteed one tick ahead by the free-slot advert
+on the halo meta row (each sender caps a direction at
+``min(cap, advertised_free // 2)``, so both directions together
+never exceed the advert).  Escapees past the cap stay put and retry,
+counted in ``SpatialCarry.migration_overflow``; shipped agents count
+in ``SpatialCarry.migrations``.  Vacated slots become dead padding
+with fresh synthetic ids past ``n_slots`` (never colliding with a
+real id); arrivals and departures flip the local alive sets, so the
+staleness triggers fire the same tick on both sides.  Migration is
+deliberately NOT gated on the rebuild predicates — it runs every
+tick in both trigger modes, which is what keeps a per-tile-trigger
+run and a global-OR run bitwise-comparable under identical rebuild
+schedules.
 
 Exactness contract
 ------------------
@@ -102,9 +151,10 @@ single-device tick, but never silently: the counters go positive the
 build it happens (tests/test_spatial_shard.py pins both regimes;
 benchmarks/bench_multichip_tick.py reports them, and the r11
 residency counters ``shard_max_alive``/``shard_imbalance`` now
-measure real spatial load imbalance).  Re-homing drifted agents onto
-their current strip (a ring migration at rebuild) is the known next
-step and is deliberately out of scope here.
+measure real spatial load imbalance).  ``cfg.spatial_rehome`` (r22,
+above) closes the escapes hazard operationally: drifted agents
+migrate back onto the tile that owns their position, one ring hop
+per tick, and the counter drains to zero.
 
 Scope: 2-D, ``separation_mode='hashgrid'``, portable path only (the
 fused kernel is a single-device program), no moments field
@@ -117,6 +167,7 @@ own halo, future work).  Entry points: ``spatial_shard_swarm`` →
 from __future__ import annotations
 
 import dataclasses
+import math
 from dataclasses import dataclass
 from functools import partial
 from typing import Optional, Tuple
@@ -133,7 +184,7 @@ from ..ops.hashgrid_plan import (
     plan_staleness,
 )
 from ..ops.neighbors import separation_grid_plan
-from ..state import SwarmState, recount_alive_below
+from ..state import AGENT_AXIS_FIELDS, SwarmState, recount_alive_below
 from ..utils.compat import shard_map
 from ..utils.config import SwarmConfig
 
@@ -196,9 +247,24 @@ class SpatialCarry:
       widened to ``[n_tiles]``).
     - ``escapes`` ``[n_tiles]`` i32 — live agents outside their home
       strip at the last build (nonzero = the exactness contract is
-      void for cross-boundary pairs; module doc).
+      void for cross-boundary pairs; module doc).  Updated every tick
+      under ``cfg.spatial_per_tile_rebuild`` (membership selection is
+      per-tick there); with ``cfg.spatial_rehome`` the re-homing
+      migration drains it to zero.
     - ``halo_overflow`` ``[n_tiles]`` i32 — band members truncated
-      past ``halo_cap`` at the last build.
+      past ``halo_cap`` at the last build (per-tick under the r22
+      per-tile trigger, like ``escapes``).
+    - ``migrations`` ``[n_tiles]`` i32 — cumulative agents this tile
+      SHIPPED to a neighbor by the r22 re-homing pass (0 with
+      ``spatial_rehome`` off).
+    - ``migration_overflow`` ``[n_tiles]`` i32 — cumulative escapees
+      the pass could not ship (past ``spatial_migration_cap`` or the
+      receiver's advertised free slots); they stay put and retry, so
+      a transiently positive count is back-pressure, a growing one
+      is a sizing error (the ``halo_overflow`` discipline).
+    - ``free_lo``/``free_hi`` ``[n_tiles]`` i32 — dead-slot counts
+      the lower/upper ring neighbor advertised on the last halo meta
+      row: next tick's migration budget toward that neighbor.
     """
 
     send_lo: jax.Array
@@ -206,6 +272,10 @@ class SpatialCarry:
     plan: HashgridPlan
     escapes: jax.Array
     halo_overflow: jax.Array
+    migrations: jax.Array
+    migration_overflow: jax.Array
+    free_lo: jax.Array
+    free_hi: jax.Array
 
 
 def spatial_plan_geometry(cfg: SwarmConfig) -> Tuple[int, float]:
@@ -377,9 +447,13 @@ def gather_by_id(arr: jax.Array, agent_id: jax.Array, n: int):
     """Unscramble a tiled per-agent column back to agent-id order and
     drop the padding tail: ``out[id] = arr[slot_of(id)]`` for ids
     ``< n`` — the comparison lens the parity tests (and record
-    frames) use."""
+    frames) use.  ``mode='drop'``: slots the r22 re-homing pass
+    vacated carry synthetic dead ids past ``n_slots`` (out of range
+    here BY DESIGN — clipping would corrupt the last row)."""
     out_shape = (agent_id.shape[0],) + arr.shape[1:]
-    return jnp.zeros(out_shape, arr.dtype).at[agent_id].set(arr)[:n]
+    return jnp.zeros(out_shape, arr.dtype).at[agent_id].set(
+        arr, mode="drop"
+    )[:n]
 
 
 # ---------------------------------------------------------------------------
@@ -401,6 +475,19 @@ def _pack_band(pos, alive, aid, idx, c):
         ],
         axis=1,
     )
+
+
+def _meta_row(trig, free):
+    """[4] f32 meta row appended to each band payload (r22): lane 0 =
+    the band-edge trigger the per-tile predicate ORs in, lane 1 = the
+    free-(dead-)slot advert the re-homing pass budgets against next
+    tick, lanes 2-3 spare.  Rides every payload in both trigger modes
+    so the exchange shape is mode-invariant."""
+    z = jnp.zeros((), jnp.float32)
+    return jnp.stack([
+        jnp.asarray(trig, jnp.float32), jnp.asarray(free, jnp.float32),
+        z, z,
+    ])
 
 
 def _unpack_halo(pay):
@@ -438,11 +525,11 @@ def _strip_offset(pos, spec, axis):
     return jnp.mod(pos[:, 0] - center + hw, 2.0 * hw) - hw
 
 
-def _rebuild_local(pos, alive, aid, rebuilds_prev, spec, cfg,
-                   g_plan, cell_plan, axis):
-    """Membership re-selection + halo exchange + per-shard plan build
-    (the ``lax.cond`` rebuild branch, and the initial build).  MUST
-    run under a mesh-uniform predicate: it ppermutes."""
+def _select_bands(pos, alive, spec, axis):
+    """Boundary-band membership from CURRENT positions: the two send
+    lists plus the escape/overflow counters their selection measures.
+    Purely local — called per rebuild in the global-OR mode and every
+    tick under the r22 per-tile trigger."""
     c, h = spec.capacity, spec.halo_cap
     half_w = 0.5 * spec.tile_width
     u = _strip_offset(pos, spec, axis)
@@ -460,18 +547,39 @@ def _rebuild_local(pos, alive, aid, rebuilds_prev, spec, cfg,
         jnp.maximum(n_lo - h, 0) + jnp.maximum(n_hi - h, 0)
     ).astype(jnp.int32)
     escapes = jnp.sum(alive & (jnp.abs(u) > half_w)).astype(jnp.int32)
+    return send_lo, send_hi, escapes, halo_overflow
 
-    pay_lo = _pack_band(pos, alive, aid, send_lo, c)
-    pay_hi = _pack_band(pos, alive, aid, send_hi, c)
+
+def _exchange_bands(pos, alive, aid, send_lo, send_hi, meta_lo,
+                    meta_hi, spec, axis):
+    """Pack both band payloads with their meta rows, one ring
+    exchange, unpack: ``(epos, ealive, eids, meta_below,
+    meta_above)`` — the extended local + halo view plus the two
+    received neighbor meta rows (:func:`_meta_row`)."""
+    c, h = spec.capacity, spec.halo_cap
+    pay_lo = jnp.concatenate(
+        [_pack_band(pos, alive, aid, send_lo, c), meta_lo[None, :]]
+    )
+    pay_hi = jnp.concatenate(
+        [_pack_band(pos, alive, aid, send_hi, c), meta_hi[None, :]]
+    )
     from_below, from_above = _ring_exchange(
         pay_lo, pay_hi, axis, spec.n_tiles
     )
     hpos, halive, hid = _unpack_halo(
-        jnp.concatenate([from_below, from_above])
+        jnp.concatenate([from_below[:h], from_above[:h]])
     )
     epos = jnp.concatenate([pos, hpos])
     ealive = jnp.concatenate([alive, halive])
     eids = jnp.concatenate([aid, hid])
+    return epos, ealive, eids, from_below[h], from_above[h]
+
+
+def _build_ext_plan(epos, ealive, eids, spec, cfg, g_plan, cell_plan,
+                    rebuilds_prev, cells_prev):
+    """Per-shard plan build over an already-exchanged local + halo
+    view — NO collectives, so it is safe under the r22 per-tile
+    (non-uniform) rebuild predicate."""
     plan = build_hashgrid_plan(
         epos, ealive, spec.world_hw, cell_plan,
         cfg.grid_max_per_cell, need_csr=True,
@@ -482,66 +590,165 @@ def _rebuild_local(pos, alive, aid, rebuilds_prev, spec, cfg,
         ),
         tiebreak=eids,
     )
-    plan = plan.replace(rebuilds=rebuilds_prev + 1)
+    return plan.replace(
+        rebuilds=rebuilds_prev + 1,
+        cells_rebuilt=(
+            cells_prev + jnp.asarray(g_plan * g_plan, jnp.int32)
+        ),
+    )
+
+
+def _rebuild_local(pos, alive, aid, rebuilds_prev, cells_prev, spec,
+                   cfg, g_plan, cell_plan, axis):
+    """Membership re-selection + halo exchange + per-shard plan build
+    (the global-OR mode's ``lax.cond`` rebuild branch, and the initial
+    build).  MUST run under a mesh-uniform predicate: it ppermutes."""
+    send_lo, send_hi, escapes, halo_overflow = _select_bands(
+        pos, alive, spec, axis
+    )
+    meta = _meta_row(
+        jnp.zeros((), jnp.float32), jnp.sum(~alive).astype(jnp.int32)
+    )
+    epos, ealive, eids, _, _ = _exchange_bands(
+        pos, alive, aid, send_lo, send_hi, meta, meta, spec, axis
+    )
+    plan = _build_ext_plan(
+        epos, ealive, eids, spec, cfg, g_plan, cell_plan,
+        rebuilds_prev, cells_prev,
+    )
     return plan, send_lo, send_hi, epos, ealive, escapes, halo_overflow
 
 
-def _tick_local(pos, alive, aid, carry_lo, carry_hi, plan,
-                escapes, halo_overflow, spec, cfg, g_plan, cell_plan,
+def _tick_local(pos, alive, aid, carry, spec, cfg, g_plan, cell_plan,
                 axis):
-    """One shard's separation tick: refresh the halo at the carried
-    membership, OR-reduce the r9 staleness triggers across the mesh,
-    rebuild under the uniform cond, sweep the per-shard plan."""
+    """One shard's separation tick: halo exchange, staleness triggers,
+    rebuild under cond, the r9 portable sweep.  ``carry`` is the
+    squeezed per-shard :class:`SpatialCarry`; returns ``(f_sep,
+    carry')``.
+
+    Two STATIC trigger modes (module doc):
+
+    - global-OR (default): per-tick halo refresh at the CARRIED
+      membership, r9 staleness pmax-OR'd across the mesh, rebuild
+      branch re-selects membership and re-exchanges under the
+      uniform predicate;
+    - ``cfg.spatial_per_tile_rebuild`` (r22): membership re-selected
+      every tick, ONE exchange (band payloads + meta rows) serves
+      both cond branches, and the predicate is local staleness OR'd
+      with the two received neighbor band-edge triggers — no
+      collectives inside the cond, so the non-uniform predicate is
+      deadlock-free.
+    """
     c = spec.capacity
-    # 1. Per-tick halo refresh at FIXED membership: current positions
-    #    and alive bits of the build-time band members, so consumers
-    #    read CURRENT neighbor positions through plan.order (the r9
-    #    stale-plan contract) and a neighbor-side kill is visible the
-    #    tick it happens.
-    pay_lo = _pack_band(pos, alive, aid, carry_lo, c)
-    pay_hi = _pack_band(pos, alive, aid, carry_hi, c)
-    from_below, from_above = _ring_exchange(
-        pay_lo, pay_hi, axis, spec.n_tiles
-    )
-    hpos, halive, hid = _unpack_halo(
-        jnp.concatenate([from_below, from_above])
-    )
-    epos = jnp.concatenate([pos, hpos])
-    ealive = jnp.concatenate([alive, halive])
+    plan = carry.plan
+    free = jnp.sum(~alive).astype(jnp.int32)
 
-    # 2. Staleness over local + halo, then the mesh-wide OR (module
-    #    doc: required for exactness AND for deadlock-free collectives
-    #    inside the cond).
-    d2max, alive_changed = plan_staleness(epos, ealive, plan)
-    skin = plan.skin
-    stale = alive_changed | (4.0 * d2max > skin * skin)
-    if cfg.hashgrid_rebuild_every > 0:
-        stale = stale | (plan.age + 1 >= cfg.hashgrid_rebuild_every)
-    stale_any = lax.pmax(stale.astype(jnp.int32), axis) > 0
-
-    def rebuild(_):
-        return _rebuild_local(
-            pos, alive, aid, plan.rebuilds, spec, cfg, g_plan,
-            cell_plan, axis,
+    if cfg.spatial_per_tile_rebuild:
+        # --- r22 two-level trigger -------------------------------
+        # Fresh membership from current positions; identical to the
+        # carried lists on quiet ticks, and the per-side inequality
+        # IS the band-edge trigger: the neighbor's halo slots change
+        # identity exactly when my band membership changes.
+        send_lo, send_hi, escapes, halo_overflow = _select_bands(
+            pos, alive, spec, axis
+        )
+        trig_lo = jnp.any(send_lo != carry.send_lo)
+        trig_hi = jnp.any(send_hi != carry.send_hi)
+        epos, ealive, eids, meta_below, meta_above = _exchange_bands(
+            pos, alive, aid, send_lo, send_hi,
+            _meta_row(trig_lo, free), _meta_row(trig_hi, free),
+            spec, axis,
+        )
+        # Own staleness over local + halo covers halo DISPLACEMENT
+        # and DEATH (current positions/alive bits vs the plan refs);
+        # halo IDENTITY changes arrive as the neighbor triggers.
+        d2max, alive_changed = plan_staleness(epos, ealive, plan)
+        skin = plan.skin
+        stale = alive_changed | (4.0 * d2max > skin * skin)
+        if cfg.hashgrid_rebuild_every > 0:
+            stale = stale | (
+                plan.age + 1 >= cfg.hashgrid_rebuild_every
+            )
+        pred = (
+            stale | (meta_below[0] > 0.5) | (meta_above[0] > 0.5)
         )
 
-    def keep(_):
-        return (
-            plan.replace(age=plan.age + 1),
-            carry_lo, carry_hi, epos, ealive, escapes, halo_overflow,
+        # Distinct names from the global-OR branch pair below: this
+        # rebuild is collective-FREE (the exchange already happened
+        # unconditionally), which is what makes the non-uniform
+        # predicate legal — and what lets swarmlint's cond-collective
+        # name resolution see it that way.
+        def rebuild_prebuilt(_):
+            return _build_ext_plan(
+                epos, ealive, eids, spec, cfg, g_plan, cell_plan,
+                plan.rebuilds, plan.cells_rebuilt,
+            )
+
+        def keep_prebuilt(_):
+            return plan.replace(age=plan.age + 1)
+
+        new_plan = lax.cond(pred, rebuild_prebuilt, keep_prebuilt,
+                            None)
+        out = carry.replace(
+            send_lo=send_lo, send_hi=send_hi, plan=new_plan,
+            escapes=escapes, halo_overflow=halo_overflow,
+            free_lo=meta_below[1].astype(jnp.int32),
+            free_hi=meta_above[1].astype(jnp.int32),
+        )
+    else:
+        # --- r12 global-OR (the bitwise-pinned baseline) ---------
+        # 1. Per-tick halo refresh at FIXED membership: current
+        #    positions and alive bits of the build-time band members,
+        #    so consumers read CURRENT neighbor positions through
+        #    plan.order (the r9 stale-plan contract) and a neighbor-
+        #    side kill is visible the tick it happens.
+        meta = _meta_row(jnp.zeros((), jnp.float32), free)
+        epos0, ealive0, _, meta_below, meta_above = _exchange_bands(
+            pos, alive, aid, carry.send_lo, carry.send_hi,
+            meta, meta, spec, axis,
         )
 
-    plan, send_lo, send_hi, epos, ealive, escapes, halo_overflow = (
-        lax.cond(stale_any, rebuild, keep, None)
-    )
+        # 2. Staleness over local + halo, then the mesh-wide OR
+        #    (module doc: required for exactness AND for deadlock-
+        #    free collectives inside the cond).
+        d2max, alive_changed = plan_staleness(epos0, ealive0, plan)
+        skin = plan.skin
+        stale = alive_changed | (4.0 * d2max > skin * skin)
+        if cfg.hashgrid_rebuild_every > 0:
+            stale = stale | (
+                plan.age + 1 >= cfg.hashgrid_rebuild_every
+            )
+        stale_any = lax.pmax(stale.astype(jnp.int32), axis) > 0
+
+        def rebuild(_):
+            return _rebuild_local(
+                pos, alive, aid, plan.rebuilds, plan.cells_rebuilt,
+                spec, cfg, g_plan, cell_plan, axis,
+            )
+
+        def keep(_):
+            return (
+                plan.replace(age=plan.age + 1),
+                carry.send_lo, carry.send_hi, epos0, ealive0,
+                carry.escapes, carry.halo_overflow,
+            )
+
+        (new_plan, send_lo, send_hi, epos, ealive, escapes,
+         halo_overflow) = lax.cond(stale_any, rebuild, keep, None)
+        out = carry.replace(
+            send_lo=send_lo, send_hi=send_hi, plan=new_plan,
+            escapes=escapes, halo_overflow=halo_overflow,
+            free_lo=meta_below[1].astype(jnp.int32),
+            free_hi=meta_above[1].astype(jnp.int32),
+        )
 
     # 3. The r9 portable sweep over local + halo; receivers are the
     #    local block only.
     eps = jnp.asarray(cfg.dist_eps, pos.dtype)
     f = separation_grid_plan(
-        epos, ealive, cfg.k_sep, cfg.personal_space, eps, plan
+        epos, ealive, cfg.k_sep, cfg.personal_space, eps, new_plan
     )[:c]
-    return f, send_lo, send_hi, plan, escapes, halo_overflow
+    return f, out
 
 
 def _squeeze_scalar(x):
@@ -579,17 +786,26 @@ def spatial_plan_init(
         check_vma=False,
     )
     def init(pos, alive, aid):
+        # Counters seeded one rebuild BELOW zero so the seed build
+        # lands at rebuilds == 0 / cells_rebuilt == 0, matching the
+        # single-device build_tick_plan convention.
         plan, send_lo, send_hi, _, _, escapes, overflow = (
             _rebuild_local(
-                pos, alive, aid, jnp.asarray(-1, jnp.int32), spec,
+                pos, alive, aid, jnp.asarray(-1, jnp.int32),
+                jnp.asarray(-g_plan * g_plan, jnp.int32), spec,
                 cfg, g_plan, cell_plan, axis,
             )
         )
+        zero = jnp.zeros((), jnp.int32)
+        # free_lo/free_hi seed at 0: the first re-homing tick ships
+        # nothing; the advert warms up on tick 1's halo exchange.
         return jax.tree_util.tree_map(
             _widen_scalar,
             SpatialCarry(
                 send_lo=send_lo, send_hi=send_hi, plan=plan,
                 escapes=escapes, halo_overflow=overflow,
+                migrations=zero, migration_overflow=zero,
+                free_lo=zero, free_hi=zero,
             ),
         )
 
@@ -618,22 +834,176 @@ def spatial_separation_step(
     )
     def step(pos_l, alive_l, aid_l, carry_l):
         carry_l = jax.tree_util.tree_map(_squeeze_scalar, carry_l)
-        f, send_lo, send_hi, plan, escapes, overflow = _tick_local(
-            pos_l, alive_l, aid_l,
-            carry_l.send_lo, carry_l.send_hi, carry_l.plan,
-            carry_l.escapes, carry_l.halo_overflow,
-            spec, cfg, g_plan, cell_plan, axis,
+        f, out_carry = _tick_local(
+            pos_l, alive_l, aid_l, carry_l, spec, cfg, g_plan,
+            cell_plan, axis,
         )
-        out_carry = jax.tree_util.tree_map(
-            _widen_scalar,
-            SpatialCarry(
-                send_lo=send_lo, send_hi=send_hi, plan=plan,
-                escapes=escapes, halo_overflow=overflow,
-            ),
-        )
-        return f, out_carry
+        return f, jax.tree_util.tree_map(_widen_scalar, out_carry)
 
     return step(pos, alive, agent_id, carry)
+
+
+def _flatten_leaf(arr):
+    """Per-agent leaf ``[c, ...]`` -> ``[c, lanes]`` f32 migration
+    lanes.  Bools ride as 0/1; ints are f32-exact below 2^24 (the
+    :data:`_ID_CEILING` discipline — ``spatial_rehome_step`` guards
+    the id fields, tick counters stay well under it for any run the
+    repo models)."""
+    return arr.reshape(arr.shape[0], -1).astype(jnp.float32)
+
+
+def _unflatten_leaf(flat, like):
+    """Inverse of :func:`_flatten_leaf` against a template leaf."""
+    vals = flat.reshape((flat.shape[0],) + like.shape[1:])
+    if like.dtype == jnp.bool_:
+        return vals > 0.5
+    return vals.astype(like.dtype)
+
+
+def _rehome_local(leaves, carry, spec, cfg, axis):
+    """One shard's drifter re-homing pass (module doc): select the
+    live agents whose position left this strip, ship up to the
+    per-direction budget one ring hop toward home, vacate their
+    slots, and place the mirror arrivals into dead slots.  ``leaves``
+    is the dict of per-agent state columns (``AGENT_AXIS_FIELDS``
+    order defines the flat lane layout); returns ``(leaves',
+    carry')``.
+
+    Budget per direction = ``min(spatial_migration_cap, advert //
+    2)`` where ``advert`` is the dead-slot count the receiver put on
+    LAST tick's halo meta row.  Both neighbors draw on the same pool,
+    so each gets half — total arrivals can never exceed the true free
+    count (deaths since the advert only grow it), hence ``lost`` is 0
+    by protocol and counted loudly anyway.  Escapees past the budget
+    stay put and retry next tick (``migration_overflow``)."""
+    c = spec.capacity
+    m = int(cfg.spatial_migration_cap)
+    half_w = 0.5 * spec.tile_width
+    alive = leaves["alive"]
+    u = _strip_offset(leaves["pos"], spec, axis)
+    esc_lo = alive & (u < -half_w)
+    esc_hi = alive & (u > half_w)
+
+    cap_dn = jnp.minimum(m, carry.free_lo // 2)
+    cap_up = jnp.minimum(m, carry.free_hi // 2)
+    idx_dn = jnp.nonzero(esc_lo, size=m, fill_value=c)[0].astype(
+        jnp.int32
+    )
+    idx_up = jnp.nonzero(esc_hi, size=m, fill_value=c)[0].astype(
+        jnp.int32
+    )
+    lane = jnp.arange(m, dtype=jnp.int32)
+    ship_dn = (idx_dn < c) & (lane < cap_dn)
+    ship_up = (idx_up < c) & (lane < cap_up)
+
+    flat = jnp.concatenate(
+        [_flatten_leaf(leaves[f]) for f in AGENT_AXIS_FIELDS], axis=1
+    )
+
+    def payload(idx, ship):
+        rows = flat[jnp.where(ship, idx, 0)] * ship[:, None]
+        return jnp.concatenate(
+            [rows, ship[:, None].astype(jnp.float32)], axis=1
+        )
+
+    from_below, from_above = _ring_exchange(
+        payload(idx_dn, ship_dn), payload(idx_up, ship_up),
+        axis, spec.n_tiles,
+    )
+
+    # Vacate shipped slots: dead, UNIQUE synthetic id past n_slots
+    # (never a real agent; gather_by_id drops it), target cleared;
+    # the other lanes go stale behind the dead bit, the documented
+    # corpse contract.
+    d = lax.axis_index(axis)
+    vac = jnp.concatenate(
+        [jnp.where(ship_dn, idx_dn, c), jnp.where(ship_up, idx_up, c)]
+    )
+    out = dict(leaves)
+    out["alive"] = alive.at[vac].set(False, mode="drop")
+    out["agent_id"] = leaves["agent_id"].at[vac].set(
+        (spec.n_slots + d * c + vac).astype(jnp.int32), mode="drop"
+    )
+    out["has_target"] = leaves["has_target"].at[vac].set(
+        False, mode="drop"
+    )
+
+    # Place arrivals: k-th valid arrival row -> k-th dead slot
+    # (vacated slots included — they ARE free now).
+    pay = jnp.concatenate([from_below, from_above])
+    valid = pay[:, -1] > 0.5
+    rank = jnp.cumsum(valid.astype(jnp.int32)) - 1
+    free_idx = jnp.nonzero(
+        ~out["alive"], size=2 * m, fill_value=c
+    )[0].astype(jnp.int32)
+    slot = jnp.where(valid, free_idx[jnp.clip(rank, 0, 2 * m - 1)], c)
+    ok = valid & (slot < c)
+    lost = jnp.sum(valid & ~ok).astype(jnp.int32)
+    slot = jnp.where(ok, slot, c)
+    off = 0
+    for f in AGENT_AXIS_FIELDS:
+        lanes = math.prod(leaves[f].shape[1:])
+        out[f] = out[f].at[slot].set(
+            _unflatten_leaf(pay[:, off:off + lanes], leaves[f]),
+            mode="drop",
+        )
+        off += lanes
+
+    shipped = (jnp.sum(ship_dn) + jnp.sum(ship_up)).astype(jnp.int32)
+    n_esc = (jnp.sum(esc_lo) + jnp.sum(esc_hi)).astype(jnp.int32)
+    return out, carry.replace(
+        migrations=carry.migrations + shipped,
+        migration_overflow=(
+            carry.migration_overflow + (n_esc - shipped) + lost
+        ),
+    )
+
+
+def spatial_rehome_step(
+    state: SwarmState,
+    carry: SpatialCarry,
+    cfg: SwarmConfig,
+    spec: SpatialSpec,
+    mesh: Mesh,
+    axis: str = SPATIAL_AXIS,
+) -> Tuple[SwarmState, SpatialCarry]:
+    """One sharded re-homing tick (``cfg.spatial_rehome``): migrate
+    escaped agents one ring hop toward their position-owning tile.
+    Runs at the TOP of the sharded physics tick, before any consumer
+    of tile residency, so the separation step's ``escapes`` counter
+    measures the post-migration state (0 under sustained drift once
+    the advert warms up).  Statically a no-op on a 1-tile mesh (a
+    single strip owns every position).  NOT gated on the rebuild
+    predicates — migration must not depend on the trigger mode, or
+    the per-tile/global-OR parity contract would break."""
+    if spec.n_tiles == 1:
+        return state, carry
+    if 2 * spec.n_slots >= _ID_CEILING:
+        raise ValueError(
+            "spatial_rehome needs synthetic vacated-slot ids "
+            f"(< 2 * n_slots = {2 * spec.n_slots}) to stay f32-exact "
+            f"on the migration payload (< {_ID_CEILING}); shard a "
+            "smaller swarm per tile"
+        )
+    leaves = {f: getattr(state, f) for f in AGENT_AXIS_FIELDS}
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(axis), P(axis)),
+        out_specs=(P(axis), P(axis)),
+        check_vma=False,
+    )
+    def step(leaves_l, carry_l):
+        carry_l = jax.tree_util.tree_map(_squeeze_scalar, carry_l)
+        leaves_out, carry_out = _rehome_local(
+            leaves_l, carry_l, spec, cfg, axis
+        )
+        return leaves_out, jax.tree_util.tree_map(
+            _widen_scalar, carry_out
+        )
+
+    leaves2, carry2 = step(leaves, carry)
+    return state.replace(**leaves2), carry2
 
 
 def tile_live_counts(alive: jax.Array, spec: SpatialSpec) -> jax.Array:
@@ -650,12 +1020,13 @@ def halo_bytes_per_tick(spec: SpatialSpec,
                         rebuilds_per_tick: float = 0.0) -> float:
     """Modelled cross-shard traffic of the sharded tick, bytes/tick
     over the whole mesh: every tick each tile ships two
-    ``[halo_cap, 4]`` f32 payloads (the per-tick position/alive
-    refresh), and a rebuild tick ships the same pair again (the
-    membership re-exchange).  Independent of N — the number the
+    ``[halo_cap + 1, 4]`` f32 payloads (the per-tick position/alive
+    refresh plus the r22 meta row carrying the band-edge trigger and
+    free-slot advert), and a rebuild tick ships the same pair again
+    (the membership re-exchange).  Independent of N — the number the
     MULTICHIP bytes row gates (docs/PERFORMANCE.md r12 halo-volume
     model)."""
     if spec.n_tiles == 1:
         return 0.0
-    per_exchange = spec.n_tiles * 2 * spec.halo_cap * 4 * 4
+    per_exchange = spec.n_tiles * 2 * (spec.halo_cap + 1) * 4 * 4
     return per_exchange * (1.0 + float(rebuilds_per_tick))

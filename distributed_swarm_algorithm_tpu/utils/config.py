@@ -195,6 +195,24 @@ class SwarmConfig:
     #   regardless of measured displacement — an override for drift
     #   the displacement probe cannot see.  0 = displacement/alive
     #   triggers only.
+    hashgrid_partial_refresh: bool = False
+    #   r22 locality-aware trigger (hashgrid_plan.refresh_plan_
+    #   partial): per-agent anchors + per-cell partial repair in
+    #   place of the r9 global-max displacement trigger, so a few
+    #   fast movers refresh their 3x3 neighborhoods instead of
+    #   rebuilding the whole structure (full rebuilds remain for
+    #   alive-set changes, the rebuild_every ceiling, and trigger
+    #   storms past the caps).  Only engages on amortized portable
+    #   rollouts carrying a candidate table (hashgrid_skin > 0,
+    #   hashgrid_neighbor_cap > 0) without a riding field binning;
+    #   anywhere else it falls back to the global trigger.  Default
+    #   off: the r9 trigger stays the bitwise-pinned baseline.
+    hashgrid_partial_crosser_cap: int = 512
+    #   Fixed per-tick budget of CELL-CROSSING violators the partial
+    #   repair can absorb (its merge tables are [cap]-shaped); more
+    #   crossers than this in one tick falls back to a full rebuild.
+    #   Size to the regime's observed crossings per tick (~200/tick
+    #   at 65k agents, max_speed=5 — docs/PERFORMANCE.md r22).
     hashgrid_neighbor_cap: int = 64
     #   Width W of the per-cell stencil-union candidate table
     #   ([g*g, W]: every live agent in a cell's 3x3 neighborhood, in
@@ -205,6 +223,34 @@ class SwarmConfig:
     #   plan.cand_overflow), like grid_max_per_cell overflow.  Only
     #   materialized for amortized portable rollouts
     #   (hashgrid_skin > 0).
+    spatial_per_tile_rebuild: bool = False
+    #   r22 two-level trigger for the spatially-sharded tick
+    #   (parallel/spatial.py): each tile's Verlet rebuild predicate
+    #   becomes its OWN local+halo staleness OR'd with its two ring
+    #   neighbors' band-edge triggers (shipped on the halo payload's
+    #   meta row) instead of the r12 mesh-wide OR — a fast mover
+    #   rebuilds its own neighborhood while quiet tiles keep their
+    #   plans.  Halo membership is re-selected every tick (bitwise-
+    #   equal to the carried lists on quiet ticks), which is what
+    #   empties the rebuild branch of collectives and makes the
+    #   non-uniform predicate deadlock-free.  Default off keeps the
+    #   r12 global-OR lockstep baseline the parity tests pin.
+    spatial_rehome: bool = False
+    #   r22 drifter re-homing: a bounded ring migration at the top of
+    #   every sharded tick ships agents whose position left their
+    #   home strip to the owning neighbor tile (one ring hop per
+    #   tick), draining ``SpatialCarry.escapes`` to zero under
+    #   sustained drift.  Arrivals land in dead slots (receiver free
+    #   capacity is advertised on the halo meta row one tick ahead),
+    #   so kill/revive flows must not rely on vacated corpse slots
+    #   persisting under re-homing.  No-op on a 1-tile mesh.
+    spatial_migration_cap: int = 64
+    #   Per-direction migrant slots per tick (the fixed f32
+    #   ``[cap, F]`` ppermute payload of the re-homing pass).
+    #   Escapees past the cap — or past the receiver's advertised
+    #   free slots — stay put and retry next tick, counted loudly in
+    #   ``SpatialCarry.migration_overflow`` (the halo_overflow
+    #   discipline: out-of-budget regimes are detected, not silent).
     field_deposit: str = "scatter"
     #   Moments-field deposit backend (r9, promoting r8's
     #   plan_cell_sums).  "scatter": the production .at[key].add cell

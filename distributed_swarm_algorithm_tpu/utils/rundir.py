@@ -61,10 +61,11 @@ COMPILE_DIR = "compile"
 #: Lower-is-better count units (mirror of compare.py's tuple).
 #: "ms-p50"/"ms-p99" (r16): serve-SLO latency percentiles — growth
 #: past threshold gates, paydown never does.  "filler-pct" (r18):
-#: the soak's dispatch-occupancy padding cost.
+#: the soak's dispatch-occupancy padding cost.  "migrations" (r22):
+#: re-homing volume per rebuild — growth means tile churn.
 COUNT_UNITS = ("findings", "rounds", "events", "ticks", "compiles",
                "bytes", "collectives", "ms-p50", "ms-p99",
-               "filler-pct")
+               "filler-pct", "migrations")
 
 #: Absolute ceiling for unit-"pct" metrics (compare.PCT_CEILING).
 PCT_CEILING = 5.0

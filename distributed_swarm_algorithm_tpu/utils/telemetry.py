@@ -80,7 +80,12 @@ class TickTelemetry:
     force_mean: jax.Array    # f32 — mean pre-clamp ||force|| over alive
     nonfinite: jax.Array     # bool — any non-finite in pos/vel/force
     plan_age: jax.Array      # i32 — carried Verlet plan age (0 = fresh)
-    plan_rebuilds: jax.Array  # i32 — cumulative rebuilds this rollout
+    plan_rebuilds: jax.Array  # i32 — cumulative FULL rebuilds this rollout
+    cells_rebuilt: jax.Array  # i32 — cumulative candidate rows rebuilt
+    #   (r22: a full rebuild adds g*g, a partial refresh adds only its
+    #   dilated trigger rows — see hashgrid_plan.refresh_plan_partial)
+    migrations: jax.Array    # i32 — cumulative re-homed drifters (r22,
+    #   spatial mesh only; single-device ticks hold the neutral 0)
     cap_overflow: jax.Array  # i32 — live agents past the per-cell cap
     cand_overflow: jax.Array  # i32 — candidate-table entries past W
     # Mesh residency (r11, the sharded recorder): per-device share of
@@ -181,6 +186,11 @@ def tick_telemetry(
     if plan is not None:
         plan_age = plan.age.astype(jnp.int32)
         plan_rebuilds = plan.rebuilds.astype(jnp.int32)
+        cells_rebuilt = (
+            plan.cells_rebuilt.astype(jnp.int32)
+            if plan.cells_rebuilt is not None
+            else zero
+        )
         cap_overflow = (
             plan.cap_overflow.astype(jnp.int32)
             if plan.cap_overflow is not None
@@ -192,7 +202,8 @@ def tick_telemetry(
             else zero
         )
     else:
-        plan_age = plan_rebuilds = cap_overflow = cand_overflow = zero
+        plan_age = plan_rebuilds = cells_rebuilt = zero
+        cap_overflow = cand_overflow = zero
     return TickTelemetry(
         tick=jnp.asarray(tick, jnp.int32),
         alive=n_alive,
@@ -211,6 +222,8 @@ def tick_telemetry(
         nonfinite=~finite,
         plan_age=plan_age,
         plan_rebuilds=plan_rebuilds,
+        cells_rebuilt=cells_rebuilt,
+        migrations=zero,
         cap_overflow=cap_overflow,
         cand_overflow=cand_overflow,
         shard_max_alive=n_alive,
@@ -307,6 +320,12 @@ def mesh_reduce_telemetry(local: TickTelemetry, axis) -> TickTelemetry:
                 # alive count).
                 local.speed_mean.astype(f32) * count,
                 local.force_mean.astype(f32) * count,
+                # r22 locality counters: TOTALS across tiles (with the
+                # r12 global-OR every tile rebuilt in lockstep; the
+                # per-tile triggers make these sums the signal —
+                # rebuilt rows and shipped drifters, tile by tile).
+                local.cells_rebuilt.astype(f32),
+                local.migrations.astype(f32),
             ]
         ),
         axis,
@@ -326,6 +345,8 @@ def mesh_reduce_telemetry(local: TickTelemetry, axis) -> TickTelemetry:
         nonfinite=maxpack[4] > 0.0,
         plan_age=maxpack[5].astype(jnp.int32),
         plan_rebuilds=maxpack[6].astype(jnp.int32),
+        cells_rebuilt=sumpack[6].astype(jnp.int32),
+        migrations=sumpack[7].astype(jnp.int32),
         cap_overflow=sumpack[2].astype(jnp.int32),
         cand_overflow=sumpack[3].astype(jnp.int32),
         shard_max_alive=hi,
@@ -379,6 +400,8 @@ def optimizer_tick_telemetry(
         ),
         plan_age=zero,
         plan_rebuilds=zero,
+        cells_rebuilt=zero,
+        migrations=zero,
         cap_overflow=zero,
         cand_overflow=zero,
         shard_max_alive=(
@@ -541,6 +564,9 @@ class TelemetrySummary:
     plan_rebuilds: int
     rebuilds_per_100_ticks: float
     plan_age_max: int
+    cells_rebuilt: int
+    partial_refresh_ticks: int
+    migrations: int
     truncation_events: int
     cap_overflow_max: int
     cand_overflow_max: int
@@ -565,6 +591,8 @@ class TelemetrySummary:
                 force_max=0.0, force_mean=0.0,
                 first_nonfinite_step=-1, plan_rebuilds=0,
                 rebuilds_per_100_ticks=0.0, plan_age_max=0,
+                cells_rebuilt=0, partial_refresh_ticks=0,
+                migrations=0,
                 truncation_events=0, cap_overflow_max=0,
                 cand_overflow_max=0, shard_max_alive=0,
                 shard_imbalance_max=0,
@@ -579,6 +607,12 @@ class TelemetrySummary:
         prev = np.concatenate([[NO_LEADER], leader[:-1]])
         bad = np.flatnonzero(nonfinite)
         total_rebuilds = int(rebuilds[-1]) if n else 0
+        cells = _np(t.cells_rebuilt)
+        # Ticks where rows were refreshed WITHOUT a full rebuild — the
+        # r22 partial-refresh rate (diff both cumulative series).
+        dcells = np.diff(cells, prepend=0)
+        drebuilds = np.diff(rebuilds, prepend=0)
+        partial_ticks = int(np.sum((dcells > 0) & (drebuilds == 0)))
         return cls(
             ticks=n,
             alive_final=int(alive[-1]),
@@ -597,6 +631,9 @@ class TelemetrySummary:
                 100.0 * total_rebuilds / n if n else 0.0
             ),
             plan_age_max=int(_np(t.plan_age).max()),
+            cells_rebuilt=int(cells[-1]) if n else 0,
+            partial_refresh_ticks=partial_ticks,
+            migrations=int(_np(t.migrations)[-1]) if n else 0,
             truncation_events=int(np.sum((cap > 0) | (cand > 0))),
             cap_overflow_max=int(cap.max()),
             cand_overflow_max=int(cand.max()),

@@ -76,14 +76,15 @@ def test_full_registry_lints_clean():
 
 
 def test_spatial_contract_in_census():
-    # The r12 exchange shape, read off the census instead of a raw
-    # HLO grep: collective-permute present (2 halo directions + the
-    # rebuild re-select inside the cond), all-gather absent, and the
-    # mesh-uniform trigger is exactly one in-scan all-reduce.
+    # The r22 exchange shape, read off the census instead of a raw
+    # HLO grep: collective-permute present (2 halo directions + 2
+    # re-homing migration ships), all-gather absent, and ZERO in-scan
+    # all-reduces — the r12 mesh-uniform trigger pmax is deleted (the
+    # per-tile trigger is local; that locality is the r22 point).
     counts = jaxlint.entry_census("swarm-rollout-spatial")
     assert counts["scan-collective-permute"] >= 2
     assert counts["all-gather"] == 0
-    assert counts["scan-all-reduce"] == 1
+    assert counts["scan-all-reduce"] == 0
 
 
 def test_packed_telemetry_contract_in_census():

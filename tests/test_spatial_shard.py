@@ -266,6 +266,162 @@ def test_out_of_contract_regimes_are_detected_not_silent():
     assert np.all(np.isfinite(got2))
 
 
+# ------------------------------------------------- r22 per-tile + re-home
+
+
+def _drifters(n=N, seed=0, dx=500.0):
+    """Everybody marches +x at the speed cap — sustained directed
+    drift across tile seams (the re-homing soak regime)."""
+    s = dsa.make_swarm(n, seed=seed, spread=HW * 0.9)
+    return s.replace(
+        target=jnp.asarray(s.pos) + jnp.asarray([dx, 0.0]),
+        has_target=jnp.ones_like(s.has_target),
+    )
+
+
+def test_per_tile_trigger_parity_through_kills():
+    # cfg.spatial_per_tile_rebuild: the rebuild schedule changes (per
+    # tile, local) but the physics must not — in-contract runs stay
+    # bitwise the single-device rollout, including through seam-side
+    # kills (a dead band member changes the fresh membership list,
+    # which IS the band-edge trigger the neighbor receives).
+    cfg = _cfg(spatial_per_tile_rebuild=True)
+    s = _station(seed=1)
+    mesh = _mesh()
+    tiled, spec = spatial_shard_swarm(s, mesh, cfg)
+    x = np.asarray(s.pos[:, 0])
+    seam = np.abs(
+        np.mod(x + HW, spec.tile_width) - spec.tile_width / 2
+    )
+    kill_ids = np.argsort(-seam)[:8].tolist()
+    s = dsa.kill(s, kill_ids)
+    tiled = dsa.kill(tiled, kill_ids)
+    ref = dsa.swarm_rollout(s, None, cfg, 12)
+    out = dsa.swarm_rollout(
+        tiled, None, cfg, 12, mesh=mesh, spatial=spec
+    )
+    _assert_bitwise(ref, out, N)
+
+
+def test_per_tile_rebuilds_are_local_not_lockstep():
+    # The locality claim itself: only tile 0's agents move, so under
+    # the per-tile predicate the far tiles must NOT rebuild in
+    # lockstep with the hot tile (contrast with the global-OR test
+    # above, which asserts min == max on the same shape of scenario).
+    cfg = _cfg(spatial_per_tile_rebuild=True)
+    s = _station(seed=4)
+    x = np.asarray(s.pos[:, 0])
+    tile0 = x < (-HW + 2 * HW / N_DEV)
+    tgt = np.asarray(s.pos).copy()
+    tgt[tile0, 0] += 6.0
+    s = s.replace(target=jnp.asarray(tgt))
+    mesh = _mesh()
+    tiled, spec = spatial_shard_swarm(s, mesh, cfg)
+    ref = dsa.swarm_rollout(s, None, cfg, 12)
+    out, carry = dsa.swarm_rollout(
+        tiled, None, cfg, 12, mesh=mesh, spatial=spec,
+        return_plan=True,
+    )
+    _assert_bitwise(ref, out, N)
+    rebuilds = np.asarray(carry.plan.rebuilds)
+    assert rebuilds.max() >= 1                     # the hot tile fired
+    assert rebuilds.min() < rebuilds.max()         # far tiles did NOT
+
+
+def test_per_tile_matches_global_or_under_forced_schedule():
+    # Bitwise cross-mode parity needs identical rebuild schedules;
+    # hashgrid_rebuild_every=1 forces every-tile-every-tick in both
+    # modes, so any divergence would be a real protocol bug (payload
+    # layout, membership selection, plan build), not fp schedule
+    # noise.  Drifting swarm: seams are crossed during the run.
+    s = _drifters(seed=1)
+    mesh = _mesh()
+    outs = []
+    for per_tile in (False, True):
+        cfg = _cfg(
+            hashgrid_rebuild_every=1,
+            spatial_per_tile_rebuild=per_tile,
+        )
+        tiled, spec = spatial_shard_swarm(s, mesh, cfg)
+        out = dsa.swarm_rollout(
+            tiled, None, cfg, 10, mesh=mesh, spatial=spec
+        )
+        outs.append(
+            np.asarray(gather_by_id(out.pos, out.agent_id, N))
+        )
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+@pytest.mark.parametrize("per_tile", [False, True])
+def test_rehome_drains_escapes_under_sustained_drift(per_tile):
+    # The r22 self-healing contract, both trigger modes: >= 100
+    # ticks of directed drift across seams, and the escapes counter
+    # ends at ZERO (each tick's crossers are re-homed at the top of
+    # the next tick, before escapes is measured), with the live id
+    # set intact (nobody lost, nobody duplicated — the id-order lens
+    # gather_by_id drops the synthetic vacated-slot ids by).
+    cfg = _cfg(
+        spatial_per_tile_rebuild=per_tile, spatial_rehome=True,
+        max_speed=2.0,
+    )
+    s = _drifters(seed=3)
+    mesh = _mesh()
+    tiled, spec = spatial_shard_swarm(s, mesh, cfg, slack=2.5)
+    out, carry = dsa.swarm_rollout(
+        tiled, None, cfg, 100, mesh=mesh, spatial=spec,
+        return_plan=True,
+    )
+    assert int(np.asarray(carry.escapes).sum()) == 0
+    assert int(np.asarray(carry.migrations).sum()) > 0
+    assert int(np.asarray(carry.migration_overflow).sum()) == 0
+    alive = np.asarray(out.alive)
+    ids = np.sort(np.asarray(out.agent_id)[alive])
+    np.testing.assert_array_equal(ids, np.arange(N))
+    # Re-homing keeps up with the drift: everyone sits within ONE
+    # tick's step of their owning strip (re-homing runs at the top
+    # of the NEXT tick, so the final integration step may leave
+    # fresh crossers pending — but never a backlog).
+    x = np.asarray(out.pos[:, 0])
+    tile_of_slot = np.arange(spec.n_slots) // spec.capacity
+    ctr = (tile_of_slot + 0.5) * spec.tile_width - HW
+    u = np.mod(x - ctr + HW, 2 * HW) - HW
+    bound = spec.tile_width / 2 + cfg.max_speed + 1e-5
+    assert np.all(np.abs(u[alive]) <= bound)
+    # The id-order positions are finite and real (not corpse data).
+    got = np.asarray(gather_by_id(out.pos, out.agent_id, N))
+    assert np.all(np.isfinite(got))
+
+
+def test_migration_overflow_counted_never_lost():
+    # Throttle the migration budget to a trickle against two-way
+    # drift (half the swarm marches +x, half -x): the per-direction
+    # cap leaves crossers behind — counted in migration_overflow,
+    # never dropped — and they retry on later ticks, so migrations
+    # still advances.
+    cfg = _cfg(
+        spatial_rehome=True, spatial_migration_cap=2, max_speed=2.0,
+    )
+    s = dsa.make_swarm(N, seed=5, spread=HW * 0.9)
+    dirs = np.where(np.arange(N) % 2 == 0, 500.0, -500.0)
+    tgt = np.asarray(s.pos).copy()
+    tgt[:, 0] += dirs
+    s = s.replace(
+        target=jnp.asarray(tgt),
+        has_target=jnp.ones_like(s.has_target),
+    )
+    mesh = _mesh()
+    tiled, spec = spatial_shard_swarm(s, mesh, cfg, slack=2.5)
+    out, carry = dsa.swarm_rollout(
+        tiled, None, cfg, 40, mesh=mesh, spatial=spec,
+        return_plan=True,
+    )
+    assert int(np.asarray(carry.migrations).sum()) > 0
+    assert int(np.asarray(carry.migration_overflow).sum()) > 0
+    alive = np.asarray(out.alive)
+    ids = np.sort(np.asarray(out.agent_id)[alive])
+    np.testing.assert_array_equal(ids, np.arange(N))
+
+
 # ------------------------------------------------- lowering / collectives
 
 

@@ -581,6 +581,29 @@ def test_each_rule_fires_exactly_once_on_seeded_tree(tmp_path):
                 return build_hashgrid_plan(pos, alive, 32.0, 2.0, 16)
             """,
         ),
+        # The r22 locality-aware variant is just as amortized: a scan
+        # body routing through refresh_plan_partial (per-cell repair
+        # under lax.switch) must not flag plan-staleness either.
+        (
+            "scan_refresh_plan_partial",
+            """
+            import jax
+            from distributed_swarm_algorithm_tpu.ops.hashgrid_plan import (
+                refresh_plan_partial,
+            )
+
+            def rollout(pos, alive, plan0, n_steps):
+                def body(carry, _):
+                    s, plan = carry
+                    plan = refresh_plan_partial(s, alive, plan)
+                    return (s, plan), None
+
+                out, _ = jax.lax.scan(
+                    body, (pos, plan0), None, length=n_steps
+                )
+                return out
+            """,
+        ),
         # A scan-body collector behind the static gate (`if
         # telemetry:` — the trace-time Python branch) is the
         # SANCTIONED flight-recorder pattern: no telemetry-gate

@@ -152,6 +152,12 @@ def test_gate_semantics_agree_with_compare(tmp_path):
         ("filler-pct", 31.0, 33.0, False),
         ("filler-pct", 31.0, 20.0, False),
         ("filler-pct", 0.0, 5.0, True),
+        # r22 re-homing migration volume: churn growth past threshold
+        # gates, paydown never, and an escape-free baseline (0)
+        # regressing to any migration traffic gates.
+        ("migrations", 6.0, 8.0, True),
+        ("migrations", 8.0, 6.0, False),
+        ("migrations", 0.0, 1.0, True),
         # r19 TTFR observation lag: ABSOLUTE 50 ms ceiling (the
         # healthy value is a few ms of pump cadence — relative
         # gating there is load noise; the failure class sits at

@@ -241,6 +241,8 @@ def test_nan_onset_mid_series_reducer():
         nonfinite=np.array([0, 0, 0, 1, 1, 1], bool),
         plan_age=z32,
         plan_rebuilds=z32,
+        cells_rebuilt=z32,
+        migrations=z32,
         cap_overflow=z32,
         cand_overflow=z32,
         shard_max_alive=np.full(n, 4, np.int32),

@@ -32,6 +32,7 @@ from distributed_swarm_algorithm_tpu.ops.hashgrid_plan import (
     build_hashgrid_plan,
     plan_staleness,
     refresh_plan,
+    refresh_plan_partial,
 )
 from distributed_swarm_algorithm_tpu.ops.pallas.grid_separation import (
     _geometry,
@@ -193,7 +194,9 @@ def test_rebuild_past_trigger_equals_scratch():
     )
     for f in HashgridPlan.ARRAY_FIELDS:
         a, b = getattr(got, f), getattr(want, f)
-        if f == "rebuilds":
+        if f in ("rebuilds", "cells_rebuilt"):
+            # Cumulative counters: the refresh carries history a
+            # scratch build starts at zero.
             continue
         if a is None:
             assert b is None
@@ -227,6 +230,201 @@ def test_rebuild_every_ceiling():
         plan = refresh_plan(s.pos, alive, plan, rebuild_every=3)
     # two keeps then the age ceiling fires
     assert int(plan.rebuilds) == 1 and int(plan.age) == 0
+
+
+# --- r22 per-cell partial refresh ---------------------------------------
+
+P_HW, P_CELL, P_SKIN, P_CAP, P_NCAP = 32.0, 2.0, 1.0, 8, 40
+P_G = int(2 * P_HW / (P_CELL + P_SKIN))
+P_N = 512
+
+
+def _partial_fixture(seed=3, dead=40):
+    rng = np.random.default_rng(seed)
+    pos = rng.uniform(-P_HW, P_HW, (P_N, 2)).astype(np.float32)
+    alive = np.ones(P_N, bool)
+    alive[rng.choice(P_N, dead, replace=False)] = False
+    plan = build_hashgrid_plan(
+        jnp.asarray(pos), jnp.asarray(alive), P_HW, P_CELL, P_CAP,
+        need_csr=True, g=P_G, skin=P_SKIN, neighbor_cap=P_NCAP,
+    )
+    return rng, pos, alive, plan
+
+
+def _assert_matches_scratch(p, ref_np, alive_np):
+    """Every structural plan field of ``p`` equals a scratch build at
+    the reference it claims to snapshot (the refresh_plan_partial
+    contract: partially-repaired == built-from-scratch at the MIXED
+    reference, violators current / non-violators anchored)."""
+    scratch = build_hashgrid_plan(
+        jnp.asarray(ref_np), jnp.asarray(alive_np), P_HW, P_CELL,
+        P_CAP, need_csr=True, g=P_G, skin=P_SKIN,
+        neighbor_cap=P_NCAP,
+    )
+    for f in HashgridPlan.ARRAY_FIELDS:
+        if f in ("age", "rebuilds", "cells_rebuilt"):
+            continue        # cumulative counters, not structure
+        a, b = getattr(p, f), getattr(scratch, f)
+        if a is None:
+            assert b is None, f
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=f
+        )
+
+
+def _mixed_reference(pos_now, ref_pos):
+    """Violators at current positions, everyone else at their plan
+    anchor — the reference refresh_plan_partial repairs toward."""
+    d = pos_now - ref_pos
+    d = np.mod(d + P_HW, 2 * P_HW) - P_HW
+    viol = 4.0 * (d * d).sum(1) > P_SKIN * P_SKIN
+    return np.where(viol[:, None], pos_now, ref_pos)
+
+
+def test_partial_refresh_matches_scratch_at_mixed_reference():
+    """The r22 three-tier contract end to end: a motionless tick
+    KEEPS (identity + age), sub-cap violations repair PARTIALLY
+    (bitwise a scratch build at the mixed reference, full-rebuild
+    counter untouched), a second partial CHAINS off the repaired
+    anchors, and an alive flip escalates to FULL."""
+    rng, pos, alive, plan = _partial_fixture()
+
+    p = jax.jit(
+        lambda pl: refresh_plan_partial(
+            jnp.asarray(pos), jnp.asarray(alive), pl
+        )
+    )(plan)
+    _assert_matches_scratch(p, pos, alive)
+    assert int(p.cells_rebuilt) == 0 and int(p.age) == 1
+    assert int(p.rebuilds) == 0
+
+    pos2 = pos.copy()
+    mv = rng.choice(np.where(alive)[0], 6, replace=False)
+    pos2[mv] += rng.uniform(-2, 2, (6, 2)).astype(np.float32)
+    pos2 = ((pos2 + P_HW) % (2 * P_HW)) - P_HW
+    p = jax.jit(
+        lambda pl: refresh_plan_partial(
+            jnp.asarray(pos2), jnp.asarray(alive), pl
+        )
+    )(plan)
+    _assert_matches_scratch(p, _mixed_reference(pos2, pos), alive)
+    assert int(p.rebuilds) == 0 and int(p.age) == 1
+    assert 0 < int(p.cells_rebuilt) < P_G * P_G
+
+    # Chain: a second partial repairs against the FIRST repair's
+    # mixed reference, not the original build.
+    pos3 = pos2.copy()
+    mv2 = rng.choice(np.where(alive)[0], 4, replace=False)
+    pos3[mv2] += rng.uniform(-2, 2, (4, 2)).astype(np.float32)
+    pos3 = ((pos3 + P_HW) % (2 * P_HW)) - P_HW
+    p2 = jax.jit(
+        lambda pl: refresh_plan_partial(
+            jnp.asarray(pos3), jnp.asarray(alive), pl
+        )
+    )(p)
+    _assert_matches_scratch(
+        p2, _mixed_reference(pos3, np.asarray(p.ref_pos)), alive
+    )
+
+    # Alive change: no partial story for membership flips — full.
+    alive2 = alive.copy()
+    alive2[np.where(alive)[0][:5]] = False
+    p = jax.jit(
+        lambda pl: refresh_plan_partial(
+            jnp.asarray(pos2), jnp.asarray(alive2), pl
+        )
+    )(plan)
+    _assert_matches_scratch(p, pos2, alive2)
+    assert int(p.rebuilds) == 1
+    assert int(p.cells_rebuilt) == P_G * P_G and int(p.age) == 0
+
+
+def test_partial_refresh_stale_row_validity_sweep():
+    """Sweep one agent's displacement across the skin/2 trigger
+    boundary: below it the plan is untouched (the stale row is
+    PROVABLY valid — nobody moved past skin/2), past it the violator
+    re-anchors (structural repair only when it also crosses a cell
+    line), and in every regime the plan is a scratch build at the
+    mixed reference."""
+    _, pos, alive, plan = _partial_fixture(seed=7)
+    mover = int(np.where(alive)[0][0])
+    saw_structural = False
+    for amp in (0.2 * P_SKIN, 0.49 * P_SKIN, 0.51 * P_SKIN,
+                1.5 * P_SKIN, 4.0):
+        pos1 = pos.copy()
+        pos1[mover, 0] += amp
+        pos1 = ((pos1 + P_HW) % (2 * P_HW)) - P_HW
+        p = refresh_plan_partial(
+            jnp.asarray(pos1), jnp.asarray(alive), plan
+        )
+        # Fire/no-fire from the implementation's own float forms
+        # (the skin/2 budget), observed through the per-agent
+        # anchor: a violator re-anchors at its current position, a
+        # within-budget mover keeps the stale-but-valid anchor.
+        d = np.mod(pos1 - pos + P_HW, 2 * P_HW) - P_HW
+        fired = bool(
+            4.0 * (d[mover] ** 2).sum() > P_SKIN * P_SKIN
+        )
+        want = pos1[mover] if fired else pos[mover]
+        np.testing.assert_array_equal(
+            np.asarray(p.ref_pos)[mover], want, err_msg=str(amp)
+        )
+        # cells_rebuilt is the STRUCTURAL repair counter: it stays 0
+        # for in-cell violators (their key is unchanged) and only
+        # counts when the violator crosses a cell line.
+        if not fired:
+            assert int(p.cells_rebuilt) == 0, amp
+        if int(p.cells_rebuilt) > 0:
+            saw_structural = True
+        _assert_matches_scratch(
+            p, _mixed_reference(pos1, pos), alive
+        )
+        assert int(p.rebuilds) == 0
+    assert saw_structural  # the 4.0 amp crosses a 3.048-wide cell
+
+
+def test_partial_refresh_crosser_cap_escalates_to_full():
+    """Overflowing the fixed crosser budget must never silently drop
+    a violator: the refresh escalates to a FULL rebuild (the
+    cap-overflow discipline — loud, counted, correct)."""
+    rng, pos, alive, plan = _partial_fixture(seed=9)
+    pos2 = pos.copy()
+    mv = rng.choice(np.where(alive)[0], 6, replace=False)
+    pos2[mv] += rng.uniform(-2, 2, (6, 2)).astype(np.float32)
+    pos2 = ((pos2 + P_HW) % (2 * P_HW)) - P_HW
+    p = jax.jit(
+        lambda pl: refresh_plan_partial(
+            jnp.asarray(pos2), jnp.asarray(alive), pl, crosser_cap=1
+        )
+    )(plan)
+    _assert_matches_scratch(p, pos2, alive)
+    assert int(p.rebuilds) == 1 and int(p.cells_rebuilt) == P_G * P_G
+
+
+def test_partial_refresh_fallbacks():
+    """Static fallbacks to the r9 refresh: no candidate table, and
+    the age ceiling — both take the full-rebuild path."""
+    _, pos, alive, _ = _partial_fixture(seed=11)
+    no_list = build_hashgrid_plan(
+        jnp.asarray(pos), jnp.asarray(alive), P_HW, P_CELL, P_CAP,
+        need_csr=True, g=P_G, skin=P_SKIN, neighbor_cap=0,
+    )
+    moved = pos + np.asarray([0.6, 0.0], np.float32)
+    moved = ((moved + P_HW) % (2 * P_HW)) - P_HW
+    p = refresh_plan_partial(
+        jnp.asarray(moved), jnp.asarray(alive), no_list
+    )
+    assert int(p.rebuilds) == 1
+    with_list = build_hashgrid_plan(
+        jnp.asarray(pos), jnp.asarray(alive), P_HW, P_CELL, P_CAP,
+        need_csr=True, g=P_G, skin=P_SKIN, neighbor_cap=P_NCAP,
+    )
+    p = refresh_plan_partial(
+        jnp.asarray(pos), jnp.asarray(alive), with_list,
+        rebuild_every=1,
+    )
+    assert int(p.rebuilds) == 1
 
 
 # --- skin = 0 degenerates to r8 -----------------------------------------

@@ -1,0 +1,320 @@
+"""Plan-native Pallas candidate sweep (r23): parity self-gate + the
+operand-prep cost model rows.
+
+Two jobs, mirroring the kernel's two claims:
+
+1. **Parity self-gate** (exit 2 on failure): the candidate-sweep
+   kernel (interpret mode — the identical Mosaic body, pallas-gate
+   contract) must be BITWISE equal to ``separation_grid_plan``'s
+   portable union sweep on the pinned cases — skin=0, skinned-stale,
+   a 3-step partial-refresh chain, and the cap-overflow truncation
+   regime (identical truncation sets; the pinned scenario keeps
+   ``recv_overflow == 0``, the kernel's receiver-envelope exactness
+   window — ``cap_overflow > 0`` is required, so the case really is
+   a truncation regime).  The same cases are asserted in tier-1
+   (tests/test_candidate_kernel.py); the bench re-checks them so an
+   on-chip round that only runs benches still refuses to record
+   kernel rows from a diverged build.  Reported as a clean-0
+   "events" row — any failure count gates the round.
+
+2. **Operand-prep cost rows** (cpu-family, indicative): the kernel's
+   per-tick operand prep is the plan refresh — a FULL rebuild
+   recomputes all ``g*g`` cand+recv rows, while the r22 partial
+   refresh recomputes only the 3x3-dilated trigger rows, so prep
+   cost scales with ``cells_rebuilt``, not ``g*g``.  Measured at the
+   r22 fast-mover reference (65k agents, hw=256 station arena,
+   max_speed=5, skin=1.5, cap 24/W 48 — decompose_rebuild.py's
+   fast-mover rows) on the same displaced state: best-of-3 jitted
+   ``refresh_plan`` (full-rebuild branch) vs ``refresh_plan_partial``
+   (row-scatter repair) over a candidates-flavor plan.  Self-gated
+   (exit 2): partial prep must be <= 0.5x full prep — the acceptance
+   bar for "prep scales with cells_rebuilt".
+
+The interpret-mode kernel is NOT timed at 65k — the Pallas
+interpreter walks the grid in Python and a 65k timing would measure
+the interpreter, not the program (docs/PERFORMANCE.md r23).  On-chip
+rounds record the real kernel throughput under the reserved
+``hashgrid-candidates-kernel-*`` names declared there.
+
+Usage: python benchmarks/bench_kernel_sweep.py
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from common import report, timeit_best
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu.ops import neighbors
+from distributed_swarm_algorithm_tpu.ops.hashgrid_plan import (
+    plan_staleness,
+    refresh_plan,
+    refresh_plan_partial,
+)
+from distributed_swarm_algorithm_tpu.ops.pallas.candidate_sweep import (
+    candidate_sweep_forces,
+    candidate_sweep_supported,
+)
+from distributed_swarm_algorithm_tpu.ops.physics import (
+    build_tick_plan,
+)
+
+N_PREP = 65_536
+HW_PREP = 256.0
+SETTLE = 48
+PAR_N = 192
+PAR_HW = 24.0
+
+
+def _cfg(skin: float, cap: int, ncap: int, **kw) -> dsa.SwarmConfig:
+    base = dict(
+        separation_mode="hashgrid", sort_every=1,
+        formation_shape="none", world_hw=HW_PREP,
+        grid_max_per_cell=cap, hashgrid_overflow_budget=1024,
+        hashgrid_backend="portable", max_speed=1.0,
+        hashgrid_skin=skin, hashgrid_neighbor_cap=ncap,
+    )
+    base.update(kw)
+    return dsa.SwarmConfig().replace(**base)
+
+
+def _parity_cfg(**kw) -> dsa.SwarmConfig:
+    base = dict(
+        separation_mode="hashgrid", formation_shape="none",
+        world_hw=PAR_HW, grid_max_per_cell=24, max_speed=5.0,
+        hashgrid_backend="portable", hashgrid_neighbor_cap=48,
+        hashgrid_kernel="candidates",
+    )
+    base.update(kw)
+    return dsa.SwarmConfig().replace(**base)
+
+
+def _forces_pair(pos, alive, plan, cfg):
+    """(kernel, portable) separation forces off the SAME plan.  The
+    kernel call is gated the pallas-gate way: the fit model is
+    consulted on the plan's actual operand shapes before dispatch."""
+    assert candidate_sweep_supported(
+        pos.shape[1], pos.dtype, plan.cand.shape[1],
+        plan.recv.shape[1], n=pos.shape[0],
+    ), "pinned parity case left the candidate sweep's envelope"
+    f_k = candidate_sweep_forces(
+        pos, plan,
+        k_sep=float(cfg.k_sep),
+        personal_space=float(cfg.personal_space),
+        eps=float(cfg.dist_eps), interpret=True,
+    )
+    f_p = neighbors.separation_grid_plan(
+        pos, alive, jnp.asarray(cfg.k_sep, pos.dtype),
+        cfg.personal_space,
+        jnp.asarray(cfg.dist_eps, pos.dtype), plan,
+    )
+    return f_k, f_p
+
+
+def _parity_cases():
+    """Yield (name, ok) over the pinned bitwise cases."""
+    key = jax.random.PRNGKey(7)
+    s = dsa.make_swarm(PAR_N, seed=3, spread=PAR_HW * 0.9)
+
+    # 1. skin=0: per-tick plan, no staleness.
+    cfg0 = _parity_cfg(hashgrid_skin=0.0)
+    plan0 = build_tick_plan(s, cfg0)
+    f_k, f_p = _forces_pair(s.pos, s.alive, plan0, cfg0)
+    yield "skin-0", bool(jnp.array_equal(f_k, f_p))
+
+    # 2. skinned-stale: drift positions under the skin/2 budget, keep
+    # the plan — both backends must read CURRENT positions through it.
+    cfgs = _parity_cfg(hashgrid_skin=0.5)
+    plans = build_tick_plan(s, cfgs)
+    key, sub = jax.random.split(key)
+    drift = 0.2 * jax.random.normal(sub, s.pos.shape)
+    pos_d = s.pos + drift
+    f_k, f_p = _forces_pair(pos_d, s.alive, plans, cfgs)
+    yield "skinned-stale", bool(jnp.array_equal(f_k, f_p))
+
+    # 3. partial-refresh chain: three repair steps, parity after each
+    # (the repaired rows and the untouched rows both stay exact).
+    cfgp = _parity_cfg(
+        hashgrid_skin=0.5, hashgrid_partial_refresh=True,
+    )
+    planp = build_tick_plan(s, cfgp)
+    pos_c = s.pos
+    ok = True
+    for i in range(3):
+        key, sub = jax.random.split(key)
+        pos_c = pos_c + 0.45 * jax.random.normal(sub, pos_c.shape)
+        planp = refresh_plan_partial(
+            pos_c, s.alive, planp,
+            crosser_cap=cfgp.hashgrid_partial_crosser_cap,
+        )
+        f_k, f_p = _forces_pair(pos_c, s.alive, planp, cfgp)
+        ok = ok and bool(jnp.array_equal(f_k, f_p))
+    yield "partial-refresh-chain", ok
+
+    # 4. cap-overflow truncation: a crowded cluster overflows the
+    # per-cell cap, so the candidate table truncates — both backends
+    # must truncate IDENTICALLY.  The receiver table must not (the
+    # kernel's exactness window): recv_overflow == 0 is asserted.
+    cfgo = _parity_cfg(hashgrid_skin=0.0, grid_max_per_cell=8)
+    crowd = jnp.concatenate([
+        s.pos[: PAR_N - 16],
+        jnp.asarray([[1.0, 1.0]]) + 0.05 * jax.random.normal(
+            jax.random.PRNGKey(11), (16, 2)
+        ),
+    ])
+    s_o = s.replace(pos=crowd.astype(s.pos.dtype))
+    plano = build_tick_plan(s_o, cfgo)
+    trunc = int(plano.cap_overflow) > 0
+    envelope = int(plano.recv_overflow) == 0
+    f_k, f_p = _forces_pair(s_o.pos, s_o.alive, plano, cfgo)
+    yield (
+        "cap-overflow",
+        trunc and envelope and bool(jnp.array_equal(f_k, f_p)),
+    )
+
+
+def _prep_state():
+    """The r22 fast-mover reference state: 65k station arena settled
+    under the skin-0 baseline, then advanced until the carried plan's
+    Verlet trigger has fired (so both refresh paths take their repair
+    branch, not the keep branch)."""
+    s0 = dsa.make_swarm(N_PREP, seed=0, spread=250.0)
+    s0 = s0.replace(
+        target=jnp.asarray(s0.pos),
+        has_target=jnp.ones_like(s0.has_target),
+    )
+    settle = _cfg(0.0, 16, 0, max_speed=5.0)
+    s1 = dsa.swarm_rollout(s0, None, settle, SETTLE)
+    jax.block_until_ready(s1.pos)
+
+    cfg_c = _cfg(
+        1.5, 24, 48, max_speed=5.0, hashgrid_kernel="candidates",
+        hashgrid_partial_refresh=True,
+    )
+    plan = build_tick_plan(s1, cfg_c)
+    s2 = s1
+    for _ in range(8):
+        s2 = dsa.swarm_rollout(s2, None, settle, 1)
+        d2max, _ = plan_staleness(s2.pos, s2.alive, plan)
+        if float(4.0 * d2max) > plan.skin * plan.skin:
+            return s2, plan, cfg_c
+    raise SystemExit(
+        "# bench_kernel_sweep: fast-mover state never tripped the "
+        "Verlet trigger — reference regime changed; re-pin SETTLE"
+    )
+
+
+def main() -> None:
+    backend = jax.default_backend()
+    if backend != "cpu":
+        # cpu-family rows (cross-round comparability) and an
+        # interpret-mode parity gate that would time the Python
+        # interpreter on-chip: clean no-op, like decompose_rebuild.
+        print(
+            f"# bench_kernel_sweep: cpu-family rows; backend is "
+            f"{backend!r} — skipping"
+        )
+        return
+
+    failures = []
+    for name, ok in _parity_cases():
+        tag = "ok" if ok else "MISMATCH"
+        print(f"# parity {name}: {tag}")
+        if not ok:
+            failures.append(name)
+    report(
+        "hashgrid-candidates-kernel-parity-failures, pinned cases "
+        "(cpu)",
+        float(len(failures)), "events", 0.0,
+    )
+    if failures:
+        print(
+            "# bench_kernel_sweep: kernel/portable bitwise parity "
+            f"FAILED on {failures} — refusing to record kernel rows"
+        )
+        sys.exit(2)
+
+    s2, plan, cfg_c = _prep_state()
+    full_fn = jax.jit(refresh_plan)
+    part_fn = jax.jit(
+        lambda p, a, pl: refresh_plan_partial(
+            p, a, pl, crosser_cap=cfg_c.hashgrid_partial_crosser_cap,
+        )
+    )
+    holder = {
+        "full": full_fn(s2.pos, s2.alive, plan),
+        "part": part_fn(s2.pos, s2.alive, plan),
+    }
+    jax.block_until_ready((holder["full"].cand, holder["part"].cand))
+    # The partial path must have taken its row-scatter branch, not
+    # the full-rebuild escalation — else the two timings below are
+    # the same program and the ratio row is meaningless.
+    g2 = holder["part"].cand.shape[0]
+    d_part = int(holder["part"].cells_rebuilt) - int(
+        plan.cells_rebuilt
+    )
+    d_full = int(holder["full"].cells_rebuilt) - int(
+        plan.cells_rebuilt
+    )
+    assert d_full == g2, "full refresh did not rebuild all rows"
+    assert 0 < d_part < g2, (
+        f"partial refresh repaired {d_part}/{g2} rows — escalated "
+        "or kept; the reference regime drifted"
+    )
+
+    def run_full():
+        holder["full"] = full_fn(s2.pos, s2.alive, plan)
+
+    def run_part():
+        holder["part"] = part_fn(s2.pos, s2.alive, plan)
+
+    t_full = timeit_best(
+        run_full, lambda: float(holder["full"].cand[0, 0])
+    )
+    t_part = timeit_best(
+        run_part, lambda: float(holder["part"].cand[0, 0])
+    )
+    ratio = t_full / t_part
+    pct = 100.0 * d_part / g2
+    print(
+        f"# operand prep (N={N_PREP}, fast-mover reference, "
+        f"{backend}) ms: full {t_full * 1e3:.1f} ({d_full} rows) | "
+        f"partial {t_part * 1e3:.1f} ({d_part} rows, {pct:.1f}%) | "
+        f"full/partial {ratio:.2f}x"
+    )
+    report(
+        "hashgrid-candidates-kernel-operand-prep-full-refreshes/sec, "
+        "65536 agents fastmover (cpu)",
+        1.0 / t_full, "refreshes/sec", 0.0,
+    )
+    report(
+        "hashgrid-candidates-kernel-operand-prep-partial-"
+        "refreshes/sec, 65536 agents fastmover (cpu)",
+        1.0 / t_part, "refreshes/sec", 0.0,
+    )
+    report(
+        "hashgrid-candidates-kernel-operand-prep-partial-vs-full, "
+        "65536 agents fastmover (cpu)",
+        ratio, "x", 0.0,
+    )
+    report(
+        "hashgrid-candidates-kernel-prep-cell-rebuild-pct, 65536 "
+        "agents fastmover (cpu)",
+        pct, "rounds", 0.0,
+    )
+    if t_part > 0.5 * t_full:
+        print(
+            "# bench_kernel_sweep: partial prep "
+            f"{t_part * 1e3:.1f} ms > 0.5x full "
+            f"{t_full * 1e3:.1f} ms — operand prep no longer scales "
+            "with cells_rebuilt (acceptance bar, ISSUE r23)"
+        )
+        sys.exit(2)
+
+
+if __name__ == "__main__":
+    main()

@@ -149,6 +149,12 @@ BENCHES = [
     # scenarios, one compiled train-step signature, finite metrics —
     # exit 2).
     "bench_train.py",
+    # r23: the plan-native candidate-sweep kernel — interpret-mode
+    # bitwise parity self-gate over the pinned cases (exit 2) plus
+    # the operand-prep cost-model rows at the r22 fast-mover
+    # reference (full-rebuild vs partial-refresh prep; self-gated
+    # partial <= 0.5x full — prep must scale with cells_rebuilt).
+    "bench_kernel_sweep.py",
 ]
 
 # Extra argv for benches whose no-arg default is not the gate set —
@@ -191,6 +197,9 @@ QUICK_SKIP = {
     "decompose_gridmean.py",
     "decompose_hashgrid_plan.py",
     "decompose_rebuild.py",
+    # r23: 65k settle + best-of-3 refresh timings — full gate only
+    # (the parity half re-runs in tier-1 every round anyway).
+    "bench_kernel_sweep.py",
     "bench_telemetry.py",
     "bench_compile_count.py",
     "bench_multichip_telemetry.py",

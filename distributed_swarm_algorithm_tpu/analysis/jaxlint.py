@@ -426,6 +426,30 @@ def _spec_swarm_rollout():
     )
 
 
+@lint_entry("candidate-sweep")
+def _spec_candidate_sweep():
+    from ..ops.pallas.candidate_sweep import candidate_sweep_forces
+    from ..ops.physics import build_tick_plan
+
+    # r23: the plan-native Pallas candidate sweep's standalone
+    # watched entry — censused in interpret mode (the Mosaic lowering
+    # is TPU-only) on the flagship station with the candidates-flavor
+    # plan (lane-tiled cand + recv operands).
+    cfg = _swarm_cfg().replace(hashgrid_kernel="candidates")
+    state = _station(64)
+    plan = build_tick_plan(state, cfg)
+    return (
+        candidate_sweep_forces,
+        (state.pos, plan),
+        {
+            "k_sep": float(cfg.k_sep),
+            "personal_space": float(cfg.personal_space),
+            "eps": float(cfg.dist_eps),
+            "interpret": True,
+        },
+    )
+
+
 @lint_entry(
     "swarm-rollout-spatial", min_devices=8,
     note="needs the 8-virtual-device rig (conftest XLA flag)",

@@ -6,6 +6,13 @@ and the serve hot-loop host-sync contract.
   an explicit ``interpret=`` through each ``pl.pallas_call`` — the
   hashgrid_supported pattern (r6) made mandatory, so dispatch sites
   can ask *before* tracing and CPU tests can drive the same body.
+  r23 extends the rule to ``ops/pallas/candidate_sweep.py`` (the
+  plan-native candidate sweep) and adds a call-site half: any call to
+  ``candidate_sweep_pallas`` / ``candidate_sweep_forces`` outside the
+  defining module whose enclosing function never consults the fit
+  model (``candidate_sweep_supported`` / ``candidate_backend_choice``
+  / ``tick_uses_hashgrid_kernel``) is flagged — an ungated dispatch
+  is exactly the hashgrid R=2 VMEM-overrun shape.
 - ``metric-fstring``: metric names handed to the benchmark
   ``report()`` contract must be string literals.  A run-varying name
   (the r5 bench_recovery f-string) silently drops the metric from the
@@ -44,6 +51,22 @@ _PALLAS_CALL = frozenset(
     {"jax.experimental.pallas.pallas_call", "pallas.pallas_call"}
 )
 
+#: The candidate-sweep kernel entries (r23) and the fit-model names a
+#: dispatch site must consult before calling one.  Matched on the
+#: final segment of the resolved dotted chain — the entries are
+#: repo-unique names, and suffix matching survives every import style
+#: (relative, absolute, aliased module attribute).
+_CANDIDATE_ENTRIES = frozenset(
+    {"candidate_sweep_pallas", "candidate_sweep_forces"}
+)
+_CANDIDATE_GUARDS = frozenset(
+    {
+        "candidate_sweep_supported",
+        "candidate_backend_choice",
+        "tick_uses_hashgrid_kernel",
+    }
+)
+
 
 def _module_level_names(tree: ast.Module):
     """Names bound at module scope: defs, assignments, imports."""
@@ -70,42 +93,97 @@ class PallasGateRule(Rule):
     id = "pallas-gate"
     summary = "fused Pallas module missing *_supported() gate or interpret="
     details = (
-        "ops/pallas/*_fused.py must bind a module-level *_supported "
+        "ops/pallas/*_fused.py (and the r23 plan-native "
+        "candidate_sweep.py) must bind a module-level *_supported "
         "capability gate (dispatchers ask before tracing; the "
         "hashgrid R=2 VMEM overrun was exactly an ungated dispatch) "
         "and every pallas_call must plumb an explicit interpret= so "
-        "the identical kernel body runs under CPU tests."
+        "the identical kernel body runs under CPU tests.  Call-site "
+        "half: candidate_sweep_pallas/candidate_sweep_forces callers "
+        "outside the defining module must consult the fit model "
+        "(candidate_sweep_supported / candidate_backend_choice / "
+        "tick_uses_hashgrid_kernel) in the enclosing function."
     )
 
     def applies(self, mod: ModuleInfo) -> bool:
-        return (
-            "ops/pallas/" in mod.relpath
-            and mod.relpath.endswith("_fused.py")
+        return "ops/pallas/" in mod.relpath and (
+            mod.relpath.endswith("_fused.py")
+            or mod.relpath.endswith("candidate_sweep.py")
         )
 
     def check(self, mod: ModuleInfo):
-        if not self.applies(mod):
+        if self.applies(mod):
+            if not any(
+                n.endswith("_supported")
+                for n in _module_level_names(mod.tree)
+            ):
+                yield mod.finding(
+                    self.id,
+                    mod.tree.body[0] if mod.tree.body else mod.tree,
+                    "fused kernel module exposes no *_supported() "
+                    "capability gate — dispatchers cannot check the "
+                    "envelope before tracing",
+                )
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if mod.resolve(node.func) not in _PALLAS_CALL:
+                    continue
+                if not any(
+                    kw.arg == "interpret" for kw in node.keywords
+                ):
+                    yield mod.finding(
+                        self.id, node,
+                        "pallas_call without an explicit interpret= — "
+                        "the kernel body cannot run under CPU tests",
+                    )
+        yield from self._unguarded_candidate_calls(mod)
+
+    def _unguarded_candidate_calls(self, mod: ModuleInfo):
+        """Flag candidate-sweep kernel calls whose enclosing function
+        never consults the fit model.  The defining module is exempt
+        (its internal forwarding IS the guarded implementation);
+        references are matched as real Name/Attribute nodes, so a
+        docstring mention cannot satisfy the gate."""
+        if mod.relpath.endswith("ops/pallas/candidate_sweep.py"):
             return
-        if not any(
-            n.endswith("_supported") for n in _module_level_names(mod.tree)
-        ):
-            yield mod.finding(
-                self.id, mod.tree.body[0] if mod.tree.body else mod.tree,
-                "fused kernel module exposes no *_supported() "
-                "capability gate — dispatchers cannot check the "
-                "envelope before tracing",
-            )
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Call):
                 continue
-            if mod.resolve(node.func) not in _PALLAS_CALL:
+            resolved = mod.resolve(node.func)
+            if resolved.rpartition(".")[2] not in _CANDIDATE_ENTRIES:
                 continue
-            if not any(kw.arg == "interpret" for kw in node.keywords):
+            scope = self._enclosing_function(mod, node)
+            if not self._references_guard(scope or mod.tree):
                 yield mod.finding(
                     self.id, node,
-                    "pallas_call without an explicit interpret= — the "
-                    "kernel body cannot run under CPU tests",
+                    "candidate_sweep kernel called without consulting "
+                    "its fit model (candidate_sweep_supported / "
+                    "candidate_backend_choice / "
+                    "tick_uses_hashgrid_kernel) — an ungated dispatch "
+                    "can overrun the VMEM envelope",
                 )
+
+    @staticmethod
+    def _enclosing_function(mod: ModuleInfo, node):
+        cur = mod.parent(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = mod.parent(cur)
+        return None
+
+    @staticmethod
+    def _references_guard(tree) -> bool:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Name) and node.id in _CANDIDATE_GUARDS:
+                return True
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr in _CANDIDATE_GUARDS
+            ):
+                return True
+        return False
 
 
 @register

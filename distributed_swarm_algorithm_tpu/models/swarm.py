@@ -59,7 +59,9 @@ def _hashgrid_multidevice_cfg(
     (cfg is static, so the portable graph is what gets traced);
     a forced ``'pallas'`` raises the clear error from
     ``tick_uses_hashgrid_kernel``.  Tracer or non-hashgrid states
-    pass through untouched."""
+    pass through untouched.  Flavor-agnostic (r23): the predicate
+    gates whichever program ``cfg.hashgrid_kernel`` selects — the
+    slot-plane kernel or the plan-native candidate sweep."""
     if cfg.separation_mode != "hashgrid":
         return cfg
     if state.pos.ndim != 2 or state.pos.shape[1] != 2:

@@ -107,6 +107,18 @@ per-agent reference, at a measured ~5x less than a full build at 65k
 (docs/PERFORMANCE.md r22).  Enabled by
 ``SwarmConfig.hashgrid_partial_refresh``; the default stays the r9
 global trigger.
+
+Plan-native kernel operands (r23).  ``recv_cap`` builds the per-cell
+receiver table ``recv [g*g, RK]`` (each cell's own occupancy run in
+sort order) — with ``cand`` it makes the plan the COMPLETE operand
+set of the candidate-sweep Pallas kernel
+(``ops/pallas/candidate_sweep.py``): one program instance per
+candidate row, receivers from ``recv``, sources from ``cand``,
+CURRENT positions gathered in-lane.  Both tables are structural —
+they change only when the plan rebuilds or partially refreshes, so
+the kernel's per-tick operand prep is the O(N) position split plus
+repairs proportional to ``cells_rebuilt`` (the
+benchmarks/bench_kernel_sweep.py rows).
 """
 
 from __future__ import annotations
@@ -146,8 +158,23 @@ class HashgridPlan:
     geometry is static aux data (hashable, participates in jit cache
     keys).  Optional fields (``counts``/``starts`` — CSR, portable
     path only; ``fkey``/``xt``/``yt`` — field binning; ``cand``/
-    ``cand_overflow`` — the Verlet candidate list) are ``None`` when
-    not built; ``None`` is a pytree-transparent child.
+    ``cand_overflow`` — the Verlet candidate list; ``recv``/
+    ``recv_overflow`` — the r23 per-cell receiver table, the
+    candidate-sweep kernel's writeback index) are ``None`` when not
+    built; ``None`` is a pytree-transparent child.
+
+    ``recv [g*g, RK]`` (r23): row c holds the original indices of the
+    live agents anchored IN cell c (its own occupancy run, not the
+    stencil union), in sort order, padded with ``n`` — the receiver
+    set of the plan-native candidate-sweep kernel
+    (``ops/pallas/candidate_sweep.py``), which computes one force row
+    per ``(cell, resident)`` and scatters back through this table.
+    Cells holding more than ``RK`` live agents truncate their
+    receiver tail, counted in ``recv_overflow`` (live agents that
+    would receive NO separation force from the kernel).  Since
+    ``RK >= max_per_cell`` everywhere the dispatch builds it, any
+    receiver truncation implies ``cap_overflow > 0`` — the existing
+    overcrowding signal covers this regime too.
 
     Verlet-reuse fields (r9): ``ref_pos``/``ref_alive`` snapshot the
     build inputs (what :func:`refresh_plan`'s staleness check compares
@@ -179,6 +206,7 @@ class HashgridPlan:
         "counts", "starts", "fkey", "xt", "yt",
         "ref_pos", "ref_alive", "age", "rebuilds", "cells_rebuilt",
         "cand", "cand_overflow", "cap_overflow",
+        "recv", "recv_overflow",
     )
     AUX_FIELDS = (
         "g", "cell_eff", "torus_hw", "max_per_cell",
@@ -191,6 +219,7 @@ class HashgridPlan:
                  ref_pos=None, ref_alive=None, age=None, rebuilds=None,
                  cells_rebuilt=None,
                  cand=None, cand_overflow=None, cap_overflow=None,
+                 recv=None, recv_overflow=None,
                  skin=0.0,
                  field_sep_cell=None, field_align_cell=None):
         self.g = g
@@ -222,6 +251,8 @@ class HashgridPlan:
         self.cand = cand
         self.cand_overflow = cand_overflow
         self.cap_overflow = cap_overflow
+        self.recv = recv
+        self.recv_overflow = recv_overflow
 
     @property
     def has_csr(self) -> bool:
@@ -234,6 +265,10 @@ class HashgridPlan:
     @property
     def has_list(self) -> bool:
         return self.cand is not None
+
+    @property
+    def has_recv(self) -> bool:
+        return self.recv is not None
 
     def replace(self, **kw) -> "HashgridPlan":
         """A copy with the named ARRAY fields replaced (aux is
@@ -257,7 +292,7 @@ class HashgridPlan:
 
     def __repr__(self) -> str:  # debugging aid, not a contract
         opt = [
-            f for f in ("counts", "fkey", "cand")
+            f for f in ("counts", "fkey", "cand", "recv")
             if getattr(self, f) is not None
         ]
         return (
@@ -279,6 +314,7 @@ def build_hashgrid_plan(
     g: Optional[int] = None,
     skin: float = 0.0,
     neighbor_cap: int = 0,
+    recv_cap: int = 0,
     tiebreak: Optional[jax.Array] = None,
 ) -> HashgridPlan:
     """:func:`_build_hashgrid_plan_impl` under the ``hashgrid_plan_
@@ -290,7 +326,8 @@ def build_hashgrid_plan(
             pos, alive, torus_hw, cell, max_per_cell,
             need_csr=need_csr, field_sep_cell=field_sep_cell,
             field_align_cell=field_align_cell, g=g, skin=skin,
-            neighbor_cap=neighbor_cap, tiebreak=tiebreak,
+            neighbor_cap=neighbor_cap, recv_cap=recv_cap,
+            tiebreak=tiebreak,
         )
 
 
@@ -306,6 +343,7 @@ def _build_hashgrid_plan_impl(
     g: Optional[int] = None,
     skin: float = 0.0,
     neighbor_cap: int = 0,
+    recv_cap: int = 0,
     tiebreak: Optional[jax.Array] = None,
 ) -> HashgridPlan:
     """Build the shared plan: one binning + one stable cell sort.
@@ -355,6 +393,17 @@ def _build_hashgrid_plan_impl(
     ``cell_eff >= r + skin`` exactly as the stencil path does.
     Requires ``g >= 3`` (a smaller torus would duplicate wrapped
     stencil cells and double-count pairs).
+
+    ``recv_cap`` (``RK``, r23): with ``RK > 0``, also materialize the
+    per-cell receiver table ``recv [g*g, RK]`` (class doc) — each
+    cell's OWN occupancy run (all live residents in sort order, NOT
+    truncated at ``max_per_cell``: portable receivers past the source
+    cap still receive forces, so the kernel's receiver set must
+    include them), padded with ``n``; residents past ``RK`` are
+    counted in ``recv_overflow``.  Size ``RK >= max_per_cell`` —
+    ``physics.build_tick_plan`` defaults to ``2*max_per_cell`` so the
+    (occupancy <= RK) exactness window extends through the whole
+    source-truncation regime.
 
     ``tiebreak`` (r12, the spatially-sharded tick): an optional [N]
     i32 of UNIQUE per-agent keys used as the within-cell sort order
@@ -409,7 +458,7 @@ def _build_hashgrid_plan_impl(
     ).astype(jnp.int32)
 
     counts = starts = None
-    if need_csr or neighbor_cap > 0:
+    if need_csr or neighbor_cap > 0 or recv_cap > 0:
         # Live-only occupancy over the bounded g*g key space (dead
         # agents carry key g*g -> dropped).  One scatter + exclusive
         # cumsum replaces the 9 searchsorted binary searches AND the 9
@@ -434,6 +483,12 @@ def _build_hashgrid_plan_impl(
         # and the [g*g] tables are small next to the [g*g, W] table.
         cand, cand_overflow = _cell_union_table(
             order, counts, starts, g, max_per_cell, neighbor_cap, n,
+        )
+
+    recv = recv_overflow = None
+    if recv_cap > 0:
+        recv, recv_overflow = _cell_receiver_table(
+            order, counts, starts, recv_cap, n,
         )
 
     fkey = xt = yt = None
@@ -466,7 +521,28 @@ def _build_hashgrid_plan_impl(
         cells_rebuilt=jnp.zeros((), jnp.int32),
         cand=cand, cand_overflow=cand_overflow,
         cap_overflow=cap_overflow,
+        recv=recv, recv_overflow=recv_overflow,
     )
+
+
+def _cell_receiver_table(order, counts, starts, rk, n):
+    """(recv [g*g, RK] i32, overflow scalar i32): each cell's own
+    occupancy run — ``recv[c, k] = order[starts[c] + k]`` for
+    ``k < min(counts[c], RK)``, padded with ``n``.  One interval
+    select over a [g*g, RK] iota plus one gather through ``order``
+    (the single-cell degenerate of :func:`_cell_union_table`'s nine).
+    Residents are NOT truncated at ``max_per_cell`` — receivers past
+    the source cap still receive forces on the portable sweep, and
+    the kernel must match it (build_hashgrid_plan doc)."""
+    riota = jnp.arange(rk, dtype=jnp.int32)[None, :]     # [1, RK]
+    occ = jnp.minimum(counts, rk)
+    m = riota < occ[:, None]
+    src = starts[:, None] + riota
+    recv = jnp.where(
+        m, order[jnp.minimum(src, n - 1)].astype(jnp.int32), n
+    )
+    overflow = jnp.sum(jnp.maximum(counts - rk, 0)).astype(jnp.int32)
+    return recv, overflow
 
 
 def _cell_union_table(order, counts, starts, g, max_per_cell, w, n):
@@ -563,6 +639,7 @@ def refresh_plan(
             field_align_cell=plan.field_align_cell,
             g=plan.g, skin=skin,
             neighbor_cap=plan.cand.shape[1] if plan.has_list else 0,
+            recv_cap=plan.recv.shape[1] if plan.has_recv else 0,
         )
         return p.replace(
             rebuilds=plan.rebuilds + 1,
@@ -802,16 +879,17 @@ def refresh_plan_partial(
                 order[jnp.minimum(src, n - 1)].astype(jnp.int32),
                 n,
             )
-            # gather-form row select: which refreshed row covers c
-            pos_in = jnp.clip(
-                jnp.searchsorted(
-                    rows, jnp.arange(g2, dtype=jnp.int32)
-                ).astype(jnp.int32),
-                0, row_cap - 1,
-            )
-            cand = jnp.where(
-                refresh[:, None], rows_cand[pos_in], plan.cand
-            )
+            # Row-scatter composition (r23): write the repaired rows
+            # back by index — O(row_cap * W), not O(g*g * W) like the
+            # r22 gather-form select (which re-materialized the WHOLE
+            # table through a [g*g] row gather).  ``rows`` is strictly
+            # increasing over its valid prefix (searchsorted of
+            # distinct ranks) and padding lands at g*g -> dropped, so
+            # the scatter is unique-index deterministic and bitwise
+            # the gather form.  This is what keeps kernel operand
+            # prep ~ cells_rebuilt (the candidate-sweep acceptance
+            # bar, benchmarks/bench_kernel_sweep.py).
+            cand = plan.cand.at[rows].set(rows_cand, mode="drop")
             # incremental cand_overflow: stencil totals change only
             # inside the refreshed rows, so swap their old excess
             # for their new
@@ -820,6 +898,33 @@ def refresh_plan_partial(
             cand_overflow = (
                 plan.cand_overflow + jnp.sum(ex_new) - jnp.sum(ex_old)
             )
+            extra = {}
+            if plan.has_recv:
+                # r23 receiver-table repair, riding the SAME refreshed
+                # row set: membership changes only at trigger cells
+                # (a strict subset of the dilated rows), and a cell
+                # whose membership is unchanged keeps its exact old
+                # receiver row (values are agent ids in within-cell
+                # sort order — slot SHIFTS in ``order`` don't change
+                # them), so recomputing just the refreshed rows from
+                # the updated counts/starts/order is bitwise a scratch
+                # build — operand prep stays ~ cells_rebuilt, not g*g.
+                rk = plan.recv.shape[1]
+                rkio = jnp.arange(rk, dtype=jnp.int32)[None, :]
+                rocc = jnp.minimum(counts[rc], rk)
+                rmask = rkio < rocc[:, None]
+                rsrc = starts[rc][:, None] + rkio
+                rows_recv = jnp.where(
+                    rmask,
+                    order[jnp.minimum(rsrc, n - 1)].astype(jnp.int32),
+                    n,
+                )
+                extra["recv"] = plan.recv.at[rows].set(
+                    rows_recv, mode="drop"
+                )
+                extra["recv_overflow"] = jnp.sum(
+                    jnp.maximum(counts - rk, 0)
+                ).astype(jnp.int32)
             return plan.replace(
                 cx=cx_new, cy=cy_new, key=key_new, order=order,
                 skey=skey, rank=rank, ok=ok, sx=sx, sy=sy,
@@ -827,12 +932,14 @@ def refresh_plan_partial(
                 cand_overflow=cand_overflow, cap_overflow=cap_overflow,
                 ref_pos=new_ref, age=plan.age + 1,
                 cells_rebuilt=plan.cells_rebuilt + n_rows,
+                **extra,
             )
 
     def _full(_):
         p = build_hashgrid_plan(
             pos, alive, hw, plan.cell_eff, K,
             need_csr=plan.has_csr, g=g, skin=skin, neighbor_cap=w,
+            recv_cap=plan.recv.shape[1] if plan.has_recv else 0,
         )
         return p.replace(
             rebuilds=plan.rebuilds + 1,

@@ -1,0 +1,358 @@
+"""Plan-native Pallas candidate sweep — the on-chip amortized round.
+
+The r5 fused cell-slot kernel (``grid_separation.py``) re-derives its
+own per-cell planes from the plan's sort EVERY tick ([g*g*K] sentinel
+scatters + the 2R+1-row shift sweep), so it never benefits from the
+r9/r22 Verlet amortization: a skinned plan that is 95% reused still
+pays full per-tick operand assembly, and the amortized regime ran
+only on the portable union sweep (ROADMAP item 2).  This module is
+the kernel that CONSUMES the plan instead of rebuilding it:
+
+  - **operands ARE the plan**: ``plan.cand [g*g, W]`` (per-cell
+    stencil-union source rows, r9) and ``plan.recv [g*g, RK]`` (each
+    cell's own residents, r23 — ``hashgrid_plan._cell_receiver_table``)
+    are structural index tables that change only when the plan
+    rebuilds or partially refreshes.  Per tick the kernel needs just
+    the O(N) position split/pad and a [g*g/8] occupancy reduce; after
+    ``refresh_plan_partial`` only the 3x3-dilated trigger rows of
+    both tables changed (a row-scatter), so operand-prep cost scales
+    with ``cells_rebuilt``, not ``g*g``
+    (benchmarks/bench_kernel_sweep.py measures exactly this).
+  - **one program instance per candidate row block** (``_ROWS`` rows):
+    receivers come from ``recv``, sources from ``cand``, and CURRENT
+    positions are gathered in-lane through the resident ``posx``/
+    ``posy`` planes — NOT the plan's build-time ``sx``/``sy`` snapshot
+    — so a stale (skinned) plan stays exact: the in-lane true-radius
+    test rejects everything the inflated neighborhood over-collects,
+    the same contract as ``neighbors._separation_list_plan``.
+  - **fused k/d^3 accumulate** with the select-form minimum image and
+    NO rsqrt — expression-for-expression the portable union sweep
+    (including the [.., W, 2]-shaped reductions, so the fp summation
+    order matches), which is what makes the parity contract BITWISE:
+    ``candidate_sweep_pallas == separation_grid_plan`` on the same
+    plan, pinned across skin=0 / skinned-stale / partial-refresh
+    chains / cap-overflow truncation sets by
+    tests/test_candidate_kernel.py and self-gated (exit 2) by the
+    bench.
+
+Receiver envelope: a cell holding more than ``RK`` live agents
+truncates its receiver tail (those agents get ZERO separation force
+from this kernel; counted in ``plan.recv_overflow`` at build).  The
+dispatch sizes ``RK >= 2*max_per_cell`` (``SwarmConfig.
+hashgrid_recv_cap``, 0 = auto), so the bitwise window covers the
+whole source-truncation regime (occupancy in (K, RK]) and any
+receiver truncation implies ``cap_overflow > 0`` — the existing
+overcrowding signal.  Dead agents appear in neither table (live-only
+keying) and receive exactly the portable path's +0.0.
+
+Mosaic caveat (the r23 interpret-mode note, docs/PERFORMANCE.md):
+the in-lane index gathers (``posx[cand]``) have no dedicated op in
+the Mosaic op tables — off-chip this kernel is validated in
+interpret mode (bitwise vs the portable sweep, which IS the
+semantics), and the on-chip lowering/throughput is gated by the
+declared ``hashgrid-candidates-kernel-*`` BENCH_HISTORY names
+against the r9 amortized-model floor (the next real-chip session's
+acceptance bar).
+
+Gate discipline (r6/r8): :func:`candidate_sweep_supported` is the
+VMEM fit model — W lane-tiled (multiple of 128), RK sublane-tiled
+(multiple of 8), resident position planes + double-buffered blocks +
+the sweep's live set under the 13 MB budget —
+:func:`candidate_backend_choice` the shared dispatch predicate
+(forced-'pallas' raises outside the envelope), and
+``physics.tick_uses_hashgrid_kernel`` adds the committed-multi-device
+fallback.  Enabled by ``SwarmConfig.hashgrid_kernel='candidates'``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ...utils.compile_watch import watched
+from .common import ceil_to
+
+#: Candidate rows (cells) per program instance.
+_ROWS = 8
+#: Lane tile: ``W`` (the cand width) must be a multiple of this.
+_LANES = 128
+#: Same per-core working budget the fused kernels size against.
+_VMEM_BUDGET = 13 * 1024 * 1024
+
+
+def _make_kernel(k_sep, personal_space, eps, hw, n, rk):
+    """The per-block sweep body.  Mirrors ``neighbors.
+    _separation_list_plan`` expression-for-expression (module doc):
+    clamped index gathers + masks instead of sentinels, stacked
+    [B, RK, W, 2] diff so the W reduction has the portable's exact
+    shape, one divide per lane pair (k/d^3), no rsqrt."""
+    two_hw = 2.0 * hw
+
+    def kernel(occ_ref, cand_ref, recv_ref, posx_ref, posy_ref,
+               fx_ref, fy_ref):
+        fx_ref[:] = jnp.zeros((_ROWS, rk), jnp.float32)
+        fy_ref[:] = jnp.zeros((_ROWS, rk), jnp.float32)
+
+        # Occupancy skip (r5 discipline): a block whose 8 cells hold
+        # no receivers contributes nothing — at a settled flock most
+        # of the arena is empty and the sweep cost follows the
+        # occupied fraction.  Outputs are pre-zeroed above, so the
+        # skipped block's rows scatter nothing real.
+        @pl.when(occ_ref[pl.program_id(0)] != 0)
+        def _sweep():
+            cand = cand_ref[:]                          # [B, W] i32
+            recv = recv_ref[:]                          # [B, RK] i32
+            posx = posx_ref[:]                          # [NP] f32
+            posy = posy_ref[:]
+            valid = cand < n                            # padded w/ n
+            cj = jnp.minimum(cand, n - 1)
+            sxp = posx[cj]                              # [B, W]
+            syp = posy[cj]
+            rvalid = recv < n
+            rj = jnp.minimum(recv, n - 1)
+            rx = posx[rj]                               # [B, RK]
+            ry = posy[rj]
+            # [B, RK, W, 2]: receiver minus source, both components
+            # stacked minor-most — the union sweep's [N, W, 2] with a
+            # receiver-slot batch axis, so the axis=-2 distance sum
+            # and the axis=2 force sum reduce identically.
+            diff = jnp.stack(
+                [
+                    rx[:, :, None] - sxp[:, None, :],
+                    ry[:, :, None] - syp[:, None, :],
+                ],
+                axis=-1,
+            )
+            # Select-form minimum image (the r5 wrap): exact for true
+            # displacements, two compares per lane.
+            diff = jnp.where(
+                diff >= hw, diff - two_hw,
+                jnp.where(diff < -hw, diff + two_hw, diff),
+            )
+            # jnp.linalg.norm's expansion, spelled out (Mosaic has no
+            # norm op): sqrt of the minor-axis pair sum.
+            dist = jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+            dist_c = jnp.maximum(dist, eps)
+            near = (
+                valid[:, None, :]
+                & rvalid[:, :, None]
+                & (dist < personal_space)
+                & (cand[:, None, :] != recv[:, :, None])
+            )
+            scale = k_sep / (dist_c * dist_c * dist_c)
+            f = jnp.sum(
+                jnp.where(near[..., None], scale[..., None] * diff, 0.0),
+                axis=2,
+            )
+            fx_ref[:] = f[..., 0]
+            fy_ref[:] = f[..., 1]
+
+    return kernel
+
+
+def candidate_sweep_pallas(
+    pos: jax.Array,
+    k_sep: float,
+    personal_space: float,
+    eps: float,
+    plan,
+    interpret: bool = False,
+) -> jax.Array:
+    """[N, 2] separation force off the plan's candidate + receiver
+    tables (module doc).  ``plan`` must carry ``cand``, ``recv`` and
+    the CSR occupancy (``physics.build_tick_plan`` with
+    ``hashgrid_kernel='candidates'`` builds all three); positions are
+    the CURRENT ones — the plan may be stale within its Verlet
+    window.  Dead agents appear in no receiver row and keep zero
+    force; callers need not re-mask."""
+    n = pos.shape[0]
+    if pos.ndim != 2 or pos.shape[1] != 2:
+        raise ValueError(
+            f"candidate sweep is 2-D only (pos shape {pos.shape})"
+        )
+    if not (plan.has_list and plan.has_recv and plan.has_csr):
+        raise ValueError(
+            "candidate_sweep_pallas needs a plan carrying cand, recv "
+            "and the CSR occupancy — build it via "
+            "physics.build_tick_plan with hashgrid_kernel="
+            "'candidates' (or build_hashgrid_plan with neighbor_cap "
+            "and recv_cap set)"
+        )
+    if plan.cell_eff < personal_space + plan.skin:
+        raise ValueError(
+            f"plan cell_eff={plan.cell_eff:.4g} cannot cover "
+            f"personal_space={personal_space} + skin={plan.skin} — "
+            "the candidate table's one-cell-out stencil coverage "
+            "contract (same check as separation_grid_plan)"
+        )
+    g2 = plan.g * plan.g
+    w = int(plan.cand.shape[1])
+    rk = int(plan.recv.shape[1])
+    g2p = ceil_to(g2, _ROWS)
+    n_pad = ceil_to(n, _LANES)
+    pad_rows = g2p - g2
+
+    cand_p, recv_p = plan.cand, plan.recv
+    occ_rows = jnp.minimum(plan.counts, rk) > 0
+    if pad_rows:
+        cand_p = jnp.concatenate(
+            [cand_p, jnp.full((pad_rows, w), n, jnp.int32)]
+        )
+        recv_p = jnp.concatenate(
+            [recv_p, jnp.full((pad_rows, rk), n, jnp.int32)]
+        )
+        occ_rows = jnp.concatenate(
+            [occ_rows, jnp.zeros((pad_rows,), bool)]
+        )
+    occ1 = jnp.any(
+        occ_rows.reshape(-1, _ROWS), axis=1
+    ).astype(jnp.int32)
+    # Zero-padded position planes: every in-kernel gather is clamped
+    # to n-1 and masked, so the pad lanes are never read — no
+    # sentinel needed (unlike the slot planes, where empty slots DO
+    # enter the shift sweep).
+    posx = jnp.pad(pos[:, 0].astype(jnp.float32), (0, n_pad - n))
+    posy = jnp.pad(pos[:, 1].astype(jnp.float32), (0, n_pad - n))
+
+    kernel = _make_kernel(
+        float(k_sep), float(personal_space), float(eps),
+        float(plan.torus_hw), n, rk,
+    )
+    n_blocks = g2p // _ROWS
+    col = lambda i, occ: (i, 0)                          # noqa: E731
+    whole = lambda i, occ: (0,)                          # noqa: E731
+    fx, fy = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n_blocks,),
+            in_specs=[
+                pl.BlockSpec((_ROWS, w), col, memory_space=pltpu.VMEM),
+                pl.BlockSpec((_ROWS, rk), col, memory_space=pltpu.VMEM),
+                pl.BlockSpec((n_pad,), whole, memory_space=pltpu.VMEM),
+                pl.BlockSpec((n_pad,), whole, memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((_ROWS, rk), col, memory_space=pltpu.VMEM),
+                pl.BlockSpec((_ROWS, rk), col, memory_space=pltpu.VMEM),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((g2p, rk), jnp.float32),
+            jax.ShapeDtypeStruct((g2p, rk), jnp.float32),
+        ],
+        interpret=interpret,
+    )(occ1, cand_p, recv_p, posx, posy)
+    # Writeback through the receiver table: each live agent owns at
+    # most one (cell, slot); pad/empty slots carry id n -> dropped,
+    # so untouched rows (dead agents, truncated receivers) keep +0.0
+    # — the portable sweep's masked value.
+    force = jnp.stack(
+        [fx.reshape(-1), fy.reshape(-1)], axis=1
+    ).astype(pos.dtype)
+    return (
+        jnp.zeros_like(pos)
+        .at[recv_p.reshape(-1)].set(force, mode="drop")
+    )
+
+
+@watched("candidate-sweep")
+@partial(
+    jax.jit,
+    static_argnames=("k_sep", "personal_space", "eps", "interpret"),
+)
+def candidate_sweep_forces(
+    pos: jax.Array,
+    plan,
+    k_sep: float,
+    personal_space: float,
+    eps: float = 1e-9,
+    interpret: bool = False,
+) -> jax.Array:
+    """The watched/jitted standalone entry (compile observatory +
+    jaxlint census ride this; the in-tick dispatch calls
+    :func:`candidate_sweep_pallas` directly inside its own traced
+    program).  Guarded: callers dispatch via
+    ``physics.tick_uses_hashgrid_kernel`` /
+    :func:`candidate_sweep_supported`."""
+    return candidate_sweep_pallas(
+        pos, k_sep, personal_space, eps, plan, interpret=interpret
+    )
+
+
+def candidate_sweep_supported(
+    dim: int,
+    dtype,
+    width: int,
+    recv_cap: int,
+    n=None,
+    g=None,
+) -> bool:
+    """The candidate-sweep VMEM fit model — pure Python on static
+    geometry, so dispatchers (and swarmlint's pallas-gate rule) can
+    branch before tracing.  Envelope: 2-D f32; ``W`` a multiple of
+    128 (lane tiling — ``build_tick_plan`` raises the configured
+    ``hashgrid_neighbor_cap`` to the next multiple); ``RK`` a
+    multiple of 8 (sublane tiling); ``g >= 3`` when known (the
+    candidate table's own floor); and the working set under the
+    13 MB budget: resident position planes (2 * 4 * ceil(n, 128) —
+    skipped when ``n`` is unknown at gate time), double-buffered
+    cand/recv/fx/fy blocks, and ~5 [8, RK, W] f32 live planes for
+    the sweep's temporaries."""
+    if dim != 2:
+        return False
+    if jnp.dtype(dtype) != jnp.float32:
+        return False
+    if width <= 0 or width % _LANES:
+        return False
+    if recv_cap <= 0 or recv_cap % _ROWS:
+        return False
+    if g is not None and g < 3:
+        return False
+    resident = 0 if n is None else 2 * 4 * ceil_to(int(n), _LANES)
+    blocks = 2 * (4 * _ROWS * width + 3 * 4 * _ROWS * recv_cap)
+    live = 5 * 4 * _ROWS * recv_cap * width
+    return resident + blocks + live <= _VMEM_BUDGET
+
+
+def candidate_backend_choice(
+    backend: str,
+    dim: int,
+    dtype,
+    width: int,
+    recv_cap: int,
+    n=None,
+    g=None,
+    knob: str = "hashgrid_backend",
+) -> bool:
+    """The candidate-flavor twin of ``grid_separation.
+    hashgrid_backend_choice`` (one shared predicate so validation,
+    envelope check, forced-'pallas' error and on-TPU gate cannot
+    drift between dispatchers).  ``knob`` names the config field in
+    error messages."""
+    if backend not in ("auto", "pallas", "portable"):
+        raise ValueError(
+            f"unknown {knob} {backend!r}; "
+            "expected 'auto', 'pallas', or 'portable'"
+        )
+    if backend == "portable":
+        return False
+    supported = candidate_sweep_supported(
+        dim, dtype, width, recv_cap, n=n, g=g
+    )
+    if backend == "pallas" and not supported:
+        raise ValueError(
+            f"{knob}='pallas' with hashgrid_kernel='candidates' but "
+            "this configuration is outside the candidate sweep's "
+            "envelope (needs 2-D f32, candidate width a multiple of "
+            "128, receiver cap a multiple of 8, g >= 3, and the "
+            "resident position planes + row blocks within the VMEM "
+            "budget)"
+        )
+    from ...utils.platform import on_tpu
+
+    return supported and (backend == "pallas" or on_tpu())

@@ -111,6 +111,38 @@ def _committed_multidevice(x) -> bool:
         return False
 
 
+def _candidate_table_shape(cfg: SwarmConfig):
+    """(W, RK) of the candidate-flavor plan operands (r23) — THE one
+    resolution of the kernel's table shape, shared by the dispatch
+    predicate, ``build_tick_plan`` and the benches so the gate is
+    evaluated on exactly the operands the plan will carry.  ``W``:
+    ``hashgrid_neighbor_cap`` raised to the next multiple of 128 (the
+    kernel's lane tiling).  ``RK``: ``hashgrid_recv_cap``, or (auto,
+    0) twice ``grid_max_per_cell`` — never below the slot cap, so any
+    receiver truncation implies ``cap_overflow > 0`` — rounded up to
+    a multiple of 8 (sublane tiling)."""
+    from .pallas.common import ceil_to
+
+    w = ceil_to(max(int(cfg.hashgrid_neighbor_cap), 1), 128)
+    rk = int(cfg.hashgrid_recv_cap)
+    if rk <= 0:
+        rk = 2 * int(cfg.grid_max_per_cell)
+    rk = ceil_to(max(rk, int(cfg.grid_max_per_cell)), 8)
+    return w, rk
+
+
+def _candidate_plan_g(cfg: SwarmConfig) -> int:
+    """The candidate flavor's plan grid resolution — the PORTABLE
+    tiling of ``resolve_plan_geometry`` (the candidate kernel
+    consumes the same plan the portable union sweep reads, so both
+    backends bin on the same grid and stay bitwise-comparable)."""
+    cell_plan = max(float(cfg.grid_cell), float(cfg.personal_space))
+    denom = cell_plan + float(cfg.hashgrid_skin)
+    if cfg.world_hw <= 0 or denom <= 0:
+        return 1
+    return max(1, int(2.0 * float(cfg.world_hw) / denom))
+
+
 def tick_uses_hashgrid_kernel(
     cfg: SwarmConfig, dim: int, dtype, arr=None
 ) -> bool:
@@ -136,15 +168,38 @@ def tick_uses_hashgrid_kernel(
     With ``hashgrid_skin > 0`` (r9) the envelope is evaluated at the
     INFLATED geometry — cell ``grid_cell + skin``, coverage radius
     ``personal_space + skin`` — because that is the grid the Verlet
-    plan actually bins on."""
-    from .pallas.grid_separation import hashgrid_backend_choice
+    plan actually bins on.
 
-    use = hashgrid_backend_choice(
-        cfg.hashgrid_backend, dim, dtype, cfg.world_hw,
-        cfg.grid_cell + cfg.hashgrid_skin, cfg.grid_max_per_cell,
-        cfg.personal_space + cfg.hashgrid_skin,
-        knob="hashgrid_backend",
-    )
+    ``cfg.hashgrid_kernel`` (r23) selects WHICH fused program the
+    kernel path means: ``"slots"`` gates on the r5 slot-plane
+    kernel's envelope; ``"candidates"`` gates on the plan-native
+    candidate sweep's fit model (``candidate_backend_choice`` over
+    the ``_candidate_table_shape`` operands at the portable plan
+    grid).  The multi-device fallback below is shared by both."""
+    if cfg.hashgrid_kernel not in ("slots", "candidates"):
+        raise ValueError(
+            f"unknown hashgrid_kernel {cfg.hashgrid_kernel!r}; "
+            "expected 'slots' or 'candidates'"
+        )
+    if cfg.hashgrid_kernel == "candidates":
+        from .pallas.candidate_sweep import candidate_backend_choice
+
+        w, rk = _candidate_table_shape(cfg)
+        use = candidate_backend_choice(
+            cfg.hashgrid_backend, dim, dtype, w, rk,
+            n=(None if arr is None else int(arr.shape[0])),
+            g=_candidate_plan_g(cfg),
+            knob="hashgrid_backend",
+        )
+    else:
+        from .pallas.grid_separation import hashgrid_backend_choice
+
+        use = hashgrid_backend_choice(
+            cfg.hashgrid_backend, dim, dtype, cfg.world_hw,
+            cfg.grid_cell + cfg.hashgrid_skin, cfg.grid_max_per_cell,
+            cfg.personal_space + cfg.hashgrid_skin,
+            knob="hashgrid_backend",
+        )
     if use and arr is not None and _committed_multidevice(arr):
         if cfg.hashgrid_backend == "pallas":
             raise ValueError(
@@ -285,21 +340,35 @@ def build_tick_plan(
     use_kernel = tick_uses_hashgrid_kernel(
         cfg, pos.shape[1], pos.dtype, arr=pos
     )
+    candidates = cfg.hashgrid_kernel == "candidates"
+    # The candidate flavor consumes the PORTABLE plan (same grid,
+    # same union table) — only the slots kernel needs the fused
+    # kernel's 16-aligned geometry.
     g_plan, cell_plan, share_field = resolve_plan_geometry(
-        use_kernel, cfg.world_hw, cfg.grid_cell, cfg.personal_space,
+        use_kernel and not candidates,
+        cfg.world_hw, cfg.grid_cell, cfg.personal_space,
         cfg.grid_max_per_cell, skin,
         field_on=tick_field_enabled(cfg),
         field_sep_cell=cfg.grid_cell, align_cell=cfg.align_cell,
     )
-    neighbor_cap = (
-        cfg.hashgrid_neighbor_cap
-        if (amortized and skin > 0.0 and not use_kernel)
-        else 0
-    )
+    if candidates:
+        # Flavor-keyed operands (r23): the candidates flavor ALWAYS
+        # carries the lane-tiled cand + recv tables — kernel and
+        # portable-fallback backends share identical plans, so a
+        # VMEM-gate or multi-device fallback stays bitwise equal to
+        # the kernel in every regime (including truncation sets).
+        neighbor_cap, recv_cap = _candidate_table_shape(cfg)
+    else:
+        neighbor_cap = (
+            cfg.hashgrid_neighbor_cap
+            if (amortized and skin > 0.0 and not use_kernel)
+            else 0
+        )
+        recv_cap = 0
     return build_hashgrid_plan(
         pos, state.alive, float(cfg.world_hw), float(cell_plan),
         cfg.grid_max_per_cell,
-        need_csr=not use_kernel,
+        need_csr=not use_kernel or candidates,
         field_sep_cell=(
             float(cfg.grid_cell) if share_field else None
         ),
@@ -308,6 +377,7 @@ def build_tick_plan(
         ),
         g=g_plan, skin=skin,
         neighbor_cap=neighbor_cap,
+        recv_cap=recv_cap,
     )
 
 
@@ -555,7 +625,21 @@ def _separation_dispatch_impl(state, cfg, plan, params=None):
         if plan is None:
             plan = build_tick_plan(state, cfg, amortized=False)
         field_keys = plan_field_keys(plan)
-        if use_kernel:
+        if use_kernel and cfg.hashgrid_kernel == "candidates":
+            # r23 plan-native candidate sweep: gathers CURRENT
+            # positions through plan.cand, so the carried (stale)
+            # plan stays exact across the Verlet reuse window —
+            # portable fallback is the identical-plan union sweep
+            # below, bitwise equal by construction.
+            from ..utils.platform import on_tpu
+            from .pallas.candidate_sweep import candidate_sweep_pallas
+
+            f_sep = candidate_sweep_pallas(
+                pos, float(cfg.k_sep), float(cfg.personal_space),
+                float(cfg.dist_eps), plan,
+                interpret=not on_tpu(),
+            )
+        elif use_kernel:
             from ..utils.platform import on_tpu
             from .pallas.grid_separation import (
                 separation_hashgrid_pallas,

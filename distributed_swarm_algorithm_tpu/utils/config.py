@@ -223,6 +223,32 @@ class SwarmConfig:
     #   plan.cand_overflow), like grid_max_per_cell overflow.  Only
     #   materialized for amortized portable rollouts
     #   (hashgrid_skin > 0).
+    hashgrid_kernel: str = "slots"
+    #   Which fused Pallas program the hashgrid kernel path runs
+    #   (r23).  "slots": the r5 per-cell slot-plane kernel
+    #   (separation_hashgrid_pallas) — re-derives its planes every
+    #   tick, cannot ride a skinned plan.  "candidates": the
+    #   plan-native candidate sweep (ops/pallas/candidate_sweep.py)
+    #   — consumes HashgridPlan.cand/recv directly, gathers CURRENT
+    #   positions through the table so a stale (skinned) plan stays
+    #   exact, and so runs the amortized Verlet regime on-chip.
+    #   With "candidates" the plan always carries the cand+recv
+    #   operands (even on the portable fallback) so kernel and
+    #   portable backends share identical plans and stay bitwise
+    #   equal; gating (VMEM fit, multi-device fallback) follows the
+    #   r6/r8 hashgrid_backend discipline via
+    #   candidate_backend_choice.
+    hashgrid_recv_cap: int = 0
+    #   Receiver rows RK of the candidate kernel's per-cell writeback
+    #   table (plan.recv [g*g, RK]: each cell's own live occupants).
+    #   0 (auto) sizes to 2x grid_max_per_cell rounded up to a
+    #   multiple of 8 (the kernel's sublane tile).  Cells holding
+    #   more than RK live agents truncate their receiver tail
+    #   (counted in plan.recv_overflow) and those agents silently
+    #   get zero separation force from the kernel — size RK so the
+    #   regime keeps recv_overflow == 0; with RK >= grid_max_per_cell
+    #   (enforced) any receiver truncation implies cap_overflow > 0,
+    #   so the existing overflow telemetry already flags it.
     spatial_per_tile_rebuild: bool = False
     #   r22 two-level trigger for the spatially-sharded tick
     #   (parallel/spatial.py): each tile's Verlet rebuild predicate

@@ -1,0 +1,344 @@
+"""Plan-native Pallas candidate sweep (ops/pallas/candidate_sweep.py,
+r23).
+
+The tentpole contract, pinned:
+
+- BITWISE: the candidate-sweep kernel (interpret mode — the identical
+  Mosaic body, pallas-gate contract) equals ``separation_grid_plan``'s
+  portable union sweep off the SAME plan, in every pinned regime:
+  skin=0 per-tick plans, skinned-stale plans read at CURRENT
+  positions, a chained partial-refresh carry, an alive-flip (full
+  rebuild via the staleness trigger), and the cap-overflow truncation
+  regime (identical truncation sets) — and end-to-end through the
+  Verlet-carried ``swarm_rollout`` scan.
+- The RECEIVER envelope: ``recv_overflow == 0`` is the kernel's
+  exactness window.  Receivers truncated past ``RK`` silently get
+  zero separation force (pinned explicitly below) — which is why the
+  pinned parity regimes assert ``recv_overflow == 0`` and the auto
+  ``RK >= grid_max_per_cell`` floor makes any receiver truncation
+  imply ``cap_overflow > 0`` (already-loud telemetry).
+- Gate discipline (the r6/r8 dispatch contract): outside the VMEM
+  envelope ``'auto'`` falls back to the portable sweep on the SAME
+  flavor-keyed plan, forced ``'pallas'`` raises, and the fit model
+  rejects non-2-D/f64/misaligned shapes statically.
+- Disabled telemetry lowers byte-identically on the kernel path, and
+  a kernel-path Verlet carry survives the checkpoint round-trip.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu.ops import neighbors as nb
+from distributed_swarm_algorithm_tpu.ops.hashgrid_plan import (
+    HashgridPlan,
+    refresh_plan,
+    refresh_plan_partial,
+)
+from distributed_swarm_algorithm_tpu.ops.pallas import candidate_sweep as cs
+from distributed_swarm_algorithm_tpu.ops.physics import (
+    _candidate_table_shape,
+    build_tick_plan,
+    tick_uses_hashgrid_kernel,
+)
+from distributed_swarm_algorithm_tpu.state import make_swarm
+
+HW = 24.0
+N = 192
+K_SEP = 1.2
+PS = 1.5
+EPS = 1e-3
+
+
+def _cfg(**kw) -> dsa.SwarmConfig:
+    base = dict(
+        separation_mode="hashgrid", formation_shape="none",
+        world_hw=HW, grid_max_per_cell=24, max_speed=5.0,
+        k_sep=K_SEP, personal_space=PS, dist_eps=EPS,
+        hashgrid_backend="portable", hashgrid_neighbor_cap=48,
+        hashgrid_kernel="candidates",
+    )
+    base.update(kw)
+    return dsa.SwarmConfig().replace(**base)
+
+
+def _swarm(seed=3, n=N):
+    s = make_swarm(n, seed=seed, spread=HW * 0.9)
+    return s.replace(
+        target=jnp.asarray(s.pos),
+        has_target=jnp.ones_like(s.has_target),
+    )
+
+
+def _pair(pos, alive, plan, cfg):
+    """(kernel, portable) forces off the SAME plan — the bitwise
+    comparison every parity test below reduces to."""
+    assert cs.candidate_sweep_supported(
+        pos.shape[1], pos.dtype, plan.cand.shape[1],
+        plan.recv.shape[1], n=pos.shape[0],
+    )
+    f_k = cs.candidate_sweep_forces(
+        pos, plan, k_sep=float(cfg.k_sep),
+        personal_space=float(cfg.personal_space),
+        eps=float(cfg.dist_eps), interpret=True,
+    )
+    f_p = nb.separation_grid_plan(
+        pos, alive, jnp.asarray(cfg.k_sep, pos.dtype),
+        cfg.personal_space, jnp.asarray(cfg.dist_eps, pos.dtype),
+        plan,
+    )
+    return np.asarray(f_k), np.asarray(f_p)
+
+
+# --- bitwise parity: the pinned regimes --------------------------------
+
+
+def test_kernel_bitwise_skin0():
+    s = _swarm()
+    cfg = _cfg(hashgrid_skin=0.0)
+    plan = build_tick_plan(s, cfg)
+    assert plan.has_recv and int(plan.recv_overflow) == 0
+    f_k, f_p = _pair(s.pos, s.alive, plan, cfg)
+    np.testing.assert_array_equal(f_k, f_p)
+
+
+def test_kernel_bitwise_skinned_stale():
+    """A drifted state read through the UNCHANGED (stale) plan: the
+    kernel gathers current positions through the table, so staleness
+    must not cost a single bit."""
+    s = _swarm()
+    cfg = _cfg(hashgrid_skin=0.5)
+    plan = build_tick_plan(s, cfg)
+    drift = 0.2 * jax.random.normal(jax.random.PRNGKey(0), s.pos.shape)
+    pos_d = s.pos + drift
+    f_k, f_p = _pair(pos_d, s.alive, plan, cfg)
+    np.testing.assert_array_equal(f_k, f_p)
+
+
+def test_kernel_bitwise_partial_refresh_chain():
+    """Three partial repairs in sequence — each repairs cand AND recv
+    in place (row scatter) and the kernel must stay bitwise after
+    every link of the chain."""
+    s = _swarm()
+    cfg = _cfg(hashgrid_skin=0.5, hashgrid_partial_refresh=True)
+    plan = build_tick_plan(s, cfg)
+    key = jax.random.PRNGKey(1)
+    pos = s.pos
+    rebuilt0 = int(plan.cells_rebuilt)
+    for _ in range(3):
+        key, sub = jax.random.split(key)
+        # Fast-mover subset: a dozen drifters keeps the touched-row
+        # count under the partial tier's row_cap = g*g // 4.
+        kick = jnp.zeros_like(pos).at[:12].set(
+            0.45 * jax.random.normal(sub, (12, 2), pos.dtype)
+        )
+        pos = pos + kick
+        plan = refresh_plan_partial(
+            pos, s.alive, plan,
+            crosser_cap=cfg.hashgrid_partial_crosser_cap,
+        )
+        f_k, f_p = _pair(pos, s.alive, plan, cfg)
+        np.testing.assert_array_equal(f_k, f_p)
+    # The chain exercised the partial tier, not the keep branch.
+    assert int(plan.cells_rebuilt) > rebuilt0
+    assert int(plan.rebuilds) == 0
+
+
+def test_kernel_bitwise_alive_flip():
+    """Killing agents flips the alive set: refresh_plan must take its
+    full-rebuild branch (live-only keying went stale) and the rebuilt
+    plan's kernel output must stay bitwise — with dead agents at
+    exactly +0.0 (absent from recv by construction)."""
+    s = _swarm()
+    cfg = _cfg(hashgrid_skin=0.5)
+    plan = build_tick_plan(s, cfg)
+    alive2 = s.alive.at[: N // 4].set(False)
+    plan2 = refresh_plan(s.pos, alive2, plan)
+    assert int(plan2.rebuilds) == int(plan.rebuilds) + 1
+    f_k, f_p = _pair(s.pos, alive2, plan2, cfg)
+    np.testing.assert_array_equal(f_k, f_p)
+    dead = ~np.asarray(alive2)
+    np.testing.assert_array_equal(f_k[dead], 0.0)
+
+
+def test_kernel_bitwise_cap_overflow_truncation():
+    """A crowded cluster past the per-cell cap: both backends truncate
+    the candidate tail IDENTICALLY.  recv_overflow == 0 keeps the
+    scenario inside the kernel's receiver envelope (the auto RK =
+    2*cap floor) — the regime the docs pin as still-exact."""
+    s = _swarm()
+    crowd = jnp.concatenate([
+        s.pos[: N - 16],
+        jnp.asarray([[1.0, 1.0]])
+        + 0.05 * jax.random.normal(jax.random.PRNGKey(2), (16, 2)),
+    ]).astype(s.pos.dtype)
+    s = s.replace(pos=crowd)
+    cfg = _cfg(hashgrid_skin=0.0, grid_max_per_cell=8)
+    plan = build_tick_plan(s, cfg)
+    assert int(plan.cap_overflow) > 0
+    assert int(plan.recv_overflow) == 0
+    f_k, f_p = _pair(s.pos, s.alive, plan, cfg)
+    np.testing.assert_array_equal(f_k, f_p)
+
+
+def test_receiver_truncation_envelope_documented():
+    """PAST the receiver envelope the kernel is NOT the portable
+    sweep: receivers beyond RK get zero force.  Pinned so the
+    documented divergence stays the documented divergence (and
+    recv_overflow stays the counter that flags it)."""
+    s = _swarm()
+    crowd = jnp.concatenate([
+        s.pos[: N - 40],
+        jnp.asarray([[1.0, 1.0]])
+        + 0.05 * jax.random.normal(jax.random.PRNGKey(4), (40, 2)),
+    ]).astype(s.pos.dtype)
+    s = s.replace(pos=crowd)
+    cfg = _cfg(hashgrid_skin=0.0, grid_max_per_cell=8,
+               hashgrid_recv_cap=8)
+    plan = build_tick_plan(s, cfg)
+    assert int(plan.recv_overflow) > 0
+    assert int(plan.cap_overflow) > 0     # RK >= cap ties them
+    f_k, f_p = _pair(s.pos, s.alive, plan, cfg)
+    listed = np.zeros(N, bool)
+    recv = np.asarray(plan.recv).reshape(-1)
+    listed[recv[recv < N]] = True
+    live = np.asarray(s.alive)
+    # Listed receivers: exact.  Truncated live receivers: zero.
+    np.testing.assert_array_equal(f_k[listed], f_p[listed])
+    np.testing.assert_array_equal(f_k[~listed], 0.0)
+    assert np.any(~listed & live)
+
+
+def test_kernel_rollout_end_to_end_bitwise():
+    """The Verlet-carried scan end-to-end: hashgrid_kernel=
+    'candidates' forced 'pallas' (interpret) vs the portable fallback
+    on the IDENTICAL flavor-keyed plan — bitwise trajectories, with
+    and without partial refresh."""
+    s = _swarm(seed=7)
+    for extra in ({}, {"hashgrid_partial_refresh": True}):
+        cfg = _cfg(hashgrid_skin=0.5, **extra)
+        out_k = dsa.swarm_rollout(
+            s, None, cfg.replace(hashgrid_backend="pallas"), 10
+        )
+        out_p = dsa.swarm_rollout(s, None, cfg, 10)
+        np.testing.assert_array_equal(
+            np.asarray(out_k.pos), np.asarray(out_p.pos)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out_k.vel), np.asarray(out_p.vel)
+        )
+
+
+# --- gate discipline ---------------------------------------------------
+
+
+def test_supported_envelope_rejections():
+    ok = dict(dim=2, dtype=jnp.float32, width=128, recv_cap=48)
+    assert cs.candidate_sweep_supported(**ok)
+    assert not cs.candidate_sweep_supported(
+        3, jnp.float32, 128, 48
+    )
+    assert not cs.candidate_sweep_supported(
+        2, jnp.float64, 128, 48
+    )
+    assert not cs.candidate_sweep_supported(
+        2, jnp.float32, 120, 48     # width not lane-tiled
+    )
+    assert not cs.candidate_sweep_supported(
+        2, jnp.float32, 128, 42     # recv_cap not sublane-tiled
+    )
+    assert not cs.candidate_sweep_supported(
+        2, jnp.float32, 128, 48, g=2
+    )
+
+
+def test_vmem_gate_forces_portable_fallback(monkeypatch):
+    """Shrinking the VMEM budget must flip the dispatch predicate off
+    under 'auto' (portable fallback on the same plan) and turn a
+    forced 'pallas' into a loud error — the r6/r8 gate discipline."""
+    s = _swarm()
+    cfg = _cfg(hashgrid_skin=0.5, hashgrid_backend="pallas")
+    assert tick_uses_hashgrid_kernel(cfg, 2, s.pos.dtype, arr=s.pos)
+    monkeypatch.setattr(cs, "_VMEM_BUDGET", 1024)
+    assert not tick_uses_hashgrid_kernel(
+        cfg.replace(hashgrid_backend="auto"), 2, s.pos.dtype,
+        arr=s.pos,
+    )
+    with pytest.raises(ValueError, match="envelope"):
+        tick_uses_hashgrid_kernel(cfg, 2, s.pos.dtype, arr=s.pos)
+    # The gated-off rollout still runs — portable sweep, same plan.
+    out = dsa.swarm_rollout(
+        s, None, cfg.replace(hashgrid_backend="auto"), 4
+    )
+    assert np.isfinite(np.asarray(out.pos)).all()
+
+
+def test_unknown_kernel_flavor_raises():
+    with pytest.raises(ValueError, match="hashgrid_kernel"):
+        tick_uses_hashgrid_kernel(
+            _cfg(hashgrid_kernel="fused"), 2, jnp.float32
+        )
+
+
+def test_candidate_table_shape_auto_recv_cap():
+    w, rk = _candidate_table_shape(_cfg())
+    assert w == 128 and rk == 48          # ceil(48,128) / 2*24
+    w, rk = _candidate_table_shape(_cfg(hashgrid_recv_cap=10))
+    assert rk == 24                        # floor to cap, ceil to 8
+    _, rk = _candidate_table_shape(_cfg(hashgrid_recv_cap=40))
+    assert rk == 40
+
+
+# --- telemetry + checkpoint --------------------------------------------
+
+
+def test_disabled_telemetry_lowering_byte_identical():
+    """telemetry=False on the kernel-path rollout must lower to
+    byte-identical text as the default — the flight recorder's
+    non-perturbation contract extends to the r23 dispatch."""
+    from distributed_swarm_algorithm_tpu.models.swarm import (
+        _swarm_rollout_impl,
+    )
+
+    s = _swarm()
+    cfg = _cfg(hashgrid_skin=0.5, hashgrid_backend="pallas")
+    low_off = _swarm_rollout_impl.lower(
+        s, None, cfg, 4, telemetry=False
+    ).as_text()
+    low_default = _swarm_rollout_impl.lower(s, None, cfg, 4).as_text()
+    assert low_off == low_default
+
+
+def test_kernel_plan_carry_checkpoint_roundtrip(tmp_path):
+    """A kernel-path Verlet carry (cand + recv operands, counters)
+    must survive the checkpoint round-trip field-for-field."""
+    from distributed_swarm_algorithm_tpu.utils import checkpoint as ckpt
+
+    s = _swarm()
+    cfg = _cfg(hashgrid_skin=0.5, hashgrid_partial_refresh=True)
+    plan = build_tick_plan(s, cfg)
+    pos2 = s.pos + 0.45 * jax.random.normal(
+        jax.random.PRNGKey(5), s.pos.shape
+    )
+    plan = refresh_plan_partial(
+        pos2, s.alive, plan,
+        crosser_cap=cfg.hashgrid_partial_crosser_cap,
+    )
+    assert plan.has_recv
+    path = os.path.join(str(tmp_path), "kernel_plan.npz")
+    ckpt.save(path, plan)
+    target = jax.tree_util.tree_map(jnp.zeros_like, plan)
+    back = ckpt.restore(path, target)
+    for f in HashgridPlan.ARRAY_FIELDS:
+        a, b = getattr(plan, f), getattr(back, f)
+        if a is None:
+            assert b is None
+            continue
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # The restored carry still drives the kernel bitwise.
+    f_k, f_p = _pair(pos2, s.alive, back, cfg)
+    np.testing.assert_array_equal(f_k, f_p)

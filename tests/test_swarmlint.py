@@ -926,6 +926,87 @@ def test_precision_no_false_positive(tmp_path, name, src):
     assert not findings, "\n".join(f.render() for f in findings)
 
 
+def test_pallas_gate_unguarded_candidate_call_detected(tmp_path):
+    # r23 call-site half: dispatching the candidate-sweep kernel
+    # without consulting its fit model anywhere in the enclosing
+    # function is the ungated-dispatch shape the rule exists for.
+    _write_tree(str(tmp_path), [(
+        "ops/dispatch_bad.py",
+        """
+        from distributed_swarm_algorithm_tpu.ops.pallas.candidate_sweep import (
+            candidate_sweep_pallas,
+        )
+
+        def forces(pos, plan):
+            return candidate_sweep_pallas(
+                pos, 1.0, 1.5, 1e-9, plan, interpret=True,
+            )
+        """,
+    )])
+    findings, _, errors = analysis.analyze_paths(
+        str(tmp_path), ["ops/dispatch_bad.py"]
+    )
+    assert not errors
+    assert [f.rule for f in findings] == ["pallas-gate"]
+    assert "fit model" in findings[0].message
+
+
+def test_pallas_gate_guarded_candidate_call_precision(tmp_path):
+    # Precision: the same call with the fit model consulted in the
+    # enclosing function (the physics.py dispatch shape) is clean —
+    # and the guard must be a real Name reference, which this is.
+    _write_tree(str(tmp_path), [(
+        "ops/dispatch_ok.py",
+        """
+        from distributed_swarm_algorithm_tpu.ops.pallas.candidate_sweep import (
+            candidate_backend_choice,
+            candidate_sweep_pallas,
+        )
+
+        def forces(pos, plan, backend):
+            if not candidate_backend_choice(
+                backend, 2, pos.dtype, 128, 48,
+            ):
+                return None
+            return candidate_sweep_pallas(
+                pos, 1.0, 1.5, 1e-9, plan, interpret=True,
+            )
+        """,
+    )])
+    findings, _, errors = analysis.analyze_paths(
+        str(tmp_path), ["ops/dispatch_ok.py"]
+    )
+    assert not errors
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_pallas_gate_covers_candidate_sweep_module(tmp_path):
+    # The r23 applies() extension: a candidate_sweep.py module under
+    # ops/pallas/ owes the same module contract as *_fused.py — the
+    # *_supported gate (missing here -> one finding) and interpret=
+    # on each pallas_call (absent here -> a second finding).  Its own
+    # internal kernel call is exempt from the call-site half (the
+    # defining module IS the guarded implementation).
+    _write_tree(str(tmp_path), [(
+        "ops/pallas/candidate_sweep.py",
+        """
+        from jax.experimental import pallas as pl
+
+        def candidate_sweep_pallas(kernel, x):
+            return pl.pallas_call(kernel, out_shape=x)(x)
+        """,
+    )])
+    findings, _, errors = analysis.analyze_paths(
+        str(tmp_path), ["ops/pallas/candidate_sweep.py"]
+    )
+    assert not errors
+    assert sorted(f.rule for f in findings) == [
+        "pallas-gate", "pallas-gate",
+    ]
+    msgs = " | ".join(f.message for f in findings)
+    assert "_supported" in msgs and "interpret" in msgs
+
+
 def test_metric_label_positional_labels_detected(tmp_path):
     # The label schema passed POSITIONALLY (3rd arg to counter) is
     # the same unbounded-cardinality pattern as labels= — one

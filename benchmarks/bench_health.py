@@ -1,0 +1,321 @@
+"""swarmpulse cost + latency rows (r24): heartbeats, harvest, watchdog.
+
+The r24 observability plane (per-segment device heartbeats, the
+callback-driven harvest, and the stream-health watchdog) follows the
+r10/r17/r19 overhead discipline: callbacks OFF is the literal pre-r19
+program, and ON must stay cheap enough to run by default.  Three
+fixed-name rows state the contract:
+
+- ``heartbeat-overhead-pct`` (unit "pct", the absolute 5%
+  PCT_CEILING): the deterministic streamed mix runs once with
+  callbacks OFF (host-poll harvest, the pre-r19 lowering) and once ON
+  (every segment stamped, callback harvest, watchdog in the pump) —
+  interleaved best-of reps, metrics disabled in BOTH arms so the
+  delta isolates swarmpulse itself.  Self-gated (exit 2).
+- ``harvest-lag-ms`` (unit "lag-ms", the absolute 50 ms
+  LAG_MS_CEILING): the p99 of per-tenant host-poll-observation minus
+  device-completion-stamp deltas for each stream's FINAL segment —
+  what ``is_ready`` polling was adding to result latency.  The sample
+  pool covers all three stream classes: the single-device mix plus a
+  (4, 2)-mesh pass with a scenario-sharded rung and a jumbo tenant
+  (the cross-device stamps r19 deferred).  Coverage is self-gated:
+  every tenant of every class must carry a device stamp.
+- ``stall-detection-ms`` (unit "lag-ms"): the wedged drill — a
+  ``launch_hook`` veto freezes a live stream under a fake clock, the
+  clock advances in 2 ms steps, and the row is the delta between the
+  threshold crossing and the watchdog's ``stream-stall`` event.
+  Self-gated <= one watchdog interval: detection is cadence-bound,
+  not luck.
+
+Usage: python benchmarks/bench_health.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+# Own-subprocess contract (run_all): pin the 8-virtual-device CPU rig
+# before jax initializes — the mesh pass needs a (4, 2) lattice.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+from common import report
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu import serve
+from distributed_swarm_algorithm_tpu.serve.health import HealthMonitor
+from distributed_swarm_algorithm_tpu.serve.slo import SloTracker
+from distributed_swarm_algorithm_tpu.utils import metrics as metricslib
+from distributed_swarm_algorithm_tpu.utils.telemetry import percentile
+
+#: The mix is sized so segments carry REAL compute (the design point
+#: for a serving segment): the per-launch stamp dispatch is a fixed
+#: host cost (effectful programs ride jit's Python dispatch path),
+#: so the honest 5% bar needs segment walls in the tens of
+#: milliseconds — pairwise-separation rungs at capacity 64/128, 20
+#: steps per segment — not sub-millisecond toy segments.
+N_REQUESTS = 24
+N_STEPS = 720
+SEGMENT_STEPS = 240
+DEADLINE_S = 0.01
+#: Best-of reps per callback mode, interleaved off/on (the
+#: timeit_best discipline).
+REPS = 3
+JUMBO_N = 256
+
+SPEC = serve.BucketSpec(capacities=(64, 128), batches=(2, 4))
+BASE = dsa.SwarmConfig().replace(
+    formation_shape="none", utility_threshold=2.0
+)
+JUMBO_CFG = dsa.SwarmConfig().replace(
+    separation_mode="hashgrid", world_hw=64.0,
+    formation_shape="none", hashgrid_backend="portable",
+    grid_max_per_cell=24, max_speed=1.0, hashgrid_skin=1.0,
+)
+
+
+def _request(i: int) -> serve.ScenarioRequest:
+    """Deterministic heterogeneous mix over both capacity rungs."""
+    return serve.ScenarioRequest(
+        n_agents=(40 + (i * 11) % 25) if i % 3 else (96 + (i * 7) % 33),
+        seed=i,
+        arena_hw=6.0 + (i % 5),
+        params={
+            "k_att": 0.5 + 0.25 * (i % 7),
+            "k_sep": 10.0 + 5.0 * (i % 4),
+        },
+    )
+
+
+def _serve_mix(first_result_callback: bool):
+    """One full streamed pass (identical request sequence and pump
+    cadence across passes — only the callback flag differs); returns
+    ``(wall_s, service)``."""
+    svc = serve.StreamingService(
+        BASE, spec=SPEC, n_steps=N_STEPS,
+        segment_steps=SEGMENT_STEPS, deadline_s=DEADLINE_S,
+        telemetry=False,
+        metrics=metricslib.MetricsRegistry(enabled=False),
+        first_result_callback=first_result_callback,
+    )
+    start = time.perf_counter()
+    submitted = 0
+    collected = 0
+    while collected < N_REQUESTS:
+        for _ in range(4):
+            if submitted < N_REQUESTS:
+                svc.submit(_request(submitted))
+                submitted += 1
+        svc.pump(force=submitted >= N_REQUESTS)
+        for rid in sorted(
+            (r for r in svc.ready_rids() if svc.result_ready(r)),
+            reverse=True,
+        ):
+            svc.collect(rid)
+            collected += 1
+    return time.perf_counter() - start, svc
+
+
+def _mesh_pass():
+    """The cross-device half: a scenario-sharded rung (batch-of-4 on
+    the scenarios axis) plus one jumbo tenant (tiles axis), callbacks
+    on — returns the service after a full drain."""
+    mesh = serve.make_serve_mesh(scenarios=4, tiles=2)
+    spec = serve.BucketSpec(
+        capacities=(32,), batches=(4,), jumbo_capacities=(JUMBO_N,),
+    )
+    svc = serve.StreamingService(
+        BASE, spec=spec, n_steps=N_STEPS,
+        segment_steps=SEGMENT_STEPS, deadline_s=DEADLINE_S,
+        telemetry=False, mesh=mesh, jumbo_cfg=JUMBO_CFG,
+        metrics=metricslib.MetricsRegistry(enabled=False),
+    )
+    svc.submit(serve.ScenarioRequest(
+        n_agents=200, seed=99, arena_hw=57.0
+    ))
+    for i in range(4):
+        svc.submit(serve.ScenarioRequest(n_agents=20 + i, seed=i))
+    svc.drain()
+    return svc
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _stall_drill() -> tuple:
+    """The wedged-segment drill under a fake clock: returns
+    ``(detection_ms, interval_ms)``."""
+    clock = _FakeClock()
+    slo = SloTracker(
+        deadline_s=0.001, clock=clock,
+        metrics=metricslib.MetricsRegistry(enabled=False),
+    )
+    wedged = {"on": False}
+    monitor = HealthMonitor(
+        interval_s=0.01, floor_ms=1.0, default_wall_ms=5.0
+    )
+    svc = serve.StreamingService(
+        BASE, spec=serve.BucketSpec(capacities=(32,), batches=(1,)),
+        n_steps=N_STEPS, segment_steps=SEGMENT_STEPS,
+        deadline_s=0.001, telemetry=False, slo=slo, health=monitor,
+        launch_hook=lambda rids, seg: not wedged["on"],
+    )
+    svc.submit(serve.ScenarioRequest(n_agents=24, seed=0))
+    svc.pump(force=True)          # segment 1 launched, heartbeat live
+    wedged["on"] = True
+    s = next(iter(svc._streams.values()))
+    base_t = (
+        s.last_progress_t
+        if s.last_progress_t is not None else s.last_launch_t
+    )
+    wall_ms = monitor.expected_wall_ms()
+    # The stream crosses the stall bar when its heartbeat age exceeds
+    # stall_mult * expected wall.
+    t_cross_ms = 1e3 * base_t + monitor.stall_mult * wall_ms
+    detected_ms = None
+    # 3 ms quanta, deliberately unaligned with the 20 ms stall bar —
+    # the crossing lands INSIDE a quantum, never on its edge.
+    for _ in range(200):
+        clock.t += 0.003
+        svc.pump()
+        stalls = [
+            e for e in slo.events if e["event"] == "stream-stall"
+        ]
+        if stalls:
+            detected_ms = 1e3 * clock.t
+            break
+    # Unwedge so teardown drains cleanly.
+    wedged["on"] = False
+    svc.drain()
+    if detected_ms is None:
+        return None, 1e3 * monitor.interval_s
+    return detected_ms - t_cross_ms, 1e3 * monitor.interval_s
+
+
+def main() -> int:
+    backend = jax.default_backend()
+    if backend != "cpu":
+        print(
+            f"# bench_health: cpu-family rows; backend is "
+            f"{backend!r} — skipping"
+        )
+        return 0
+
+    failures = 0
+
+    # Warm the full bucket lattice (both callback modes share every
+    # rollout shape; the stamp program is the only extra compile)
+    # before timing.
+    _serve_mix(False)
+    _serve_mix(True)
+
+    t_off = t_on = float("inf")
+    harvest_lag: list = []
+    for _ in range(REPS):
+        w, _svc = _serve_mix(False)
+        t_off = min(t_off, w)
+        w, svc_on = _serve_mix(True)
+        t_on = min(t_on, w)
+        harvest_lag.extend(svc_on.harvest_lag_ms)
+    overhead = max(0.0, 100.0 * (t_on - t_off) / t_off)
+
+    n_expected = REPS * N_REQUESTS
+    if len(harvest_lag) < n_expected:
+        print(
+            f"# SELF-GATE: only {len(harvest_lag)}/{n_expected} "
+            "single-device tenants carried a device completion stamp",
+            file=sys.stderr,
+        )
+        failures += 1
+
+    # Cross-device coverage: the sharded rung and the jumbo tenant
+    # must stamp every segment too (the design r19 deferred).
+    svc_mesh = _mesh_pass()
+    mesh_lags = list(svc_mesh.harvest_lag_ms)
+    if len(mesh_lags) != 5:
+        print(
+            f"# SELF-GATE: mesh pass recorded {len(mesh_lags)}/5 "
+            "harvest-lag samples (4 sharded tenants + 1 jumbo) — a "
+            "stream class lost its device stamps",
+            file=sys.stderr,
+        )
+        failures += 1
+    harvest_lag.extend(mesh_lags)
+    lag_p99 = percentile(harvest_lag, 99.0)
+    lag_p50 = percentile(harvest_lag, 50.0)
+
+    detection_ms, interval_ms = _stall_drill()
+
+    print(
+        f"# heartbeat overhead ({N_REQUESTS} requests, {backend}): "
+        f"off {t_off:.2f}s, on {t_on:.2f}s -> {overhead:.2f}% (bar "
+        f"<= 5%); harvest lag p50 {lag_p50:.2f} ms / p99 "
+        f"{lag_p99:.2f} ms over {len(harvest_lag)} tenants (ceiling "
+        f"50 ms); stall detection "
+        f"{'-' if detection_ms is None else f'{detection_ms:.1f} ms'}"
+        f" (watchdog interval {interval_ms:.0f} ms)"
+    )
+    report(
+        "heartbeat-overhead-pct, streamed mix off-vs-on (cpu)",
+        overhead, "pct", 0.0,
+    )
+    report(
+        "harvest-lag-ms, 3 stream classes p99 (cpu)",
+        lag_p99, "lag-ms", 0.0,
+    )
+    report(
+        "stall-detection-ms, wedged drill (cpu)",
+        0.0 if detection_ms is None else detection_ms, "lag-ms", 0.0,
+    )
+
+    # --- self-gates --------------------------------------------------
+    if overhead > 5.0:
+        print(
+            f"# SELF-GATE: heartbeat overhead {overhead:.2f}% > the "
+            "5% ceiling — the per-segment stamp grew a real cost",
+            file=sys.stderr,
+        )
+        failures += 1
+    if lag_p99 > 50.0:
+        print(
+            f"# SELF-GATE: harvest lag p99 {lag_p99:.2f} ms > the "
+            "50 ms ceiling — result observation re-coupled to the "
+            "pump",
+            file=sys.stderr,
+        )
+        failures += 1
+    if detection_ms is None:
+        print(
+            "# SELF-GATE: the wedged drill never emitted a "
+            "stream-stall event — the watchdog is blind",
+            file=sys.stderr,
+        )
+        failures += 1
+    elif detection_ms > interval_ms + 3.0:
+        # +3 ms: the drill's clock quantum — detection is cadence-
+        # bound (one watchdog interval), not luck.
+        print(
+            f"# SELF-GATE: stall detection {detection_ms:.1f} ms > "
+            f"one watchdog interval ({interval_ms:.0f} ms) — the "
+            "cadence bound broke",
+            file=sys.stderr,
+        )
+        failures += 1
+    return 2 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

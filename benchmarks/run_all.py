@@ -135,6 +135,14 @@ BENCHES = [
     # the host-poll observation, per request; self-gates both
     # ceilings and full callback coverage of the mix (exit 2).
     "bench_metrics_overhead.py",
+    # r24: swarmpulse — the fixed-name heartbeat-overhead-pct row
+    # (unit "pct", absolute 5% ceiling; callbacks-off vs the
+    # per-segment device-heartbeat path), harvest-lag-ms p99 (unit
+    # "lag-ms", absolute 50 ms ceiling; device completion stamp vs
+    # host-poll observation across single-device, sharded, and jumbo
+    # streams), and stall-detection-ms from the wedged-segment drill
+    # (self-gated <= one watchdog interval; exit 2).
+    "bench_health.py",
     # r18: 2D-mesh serving on the 8-vdev rig — scenario-axis sharded
     # service throughput vs the same-run single-device row (self-
     # gated >= 1.5x with bitwise per-tenant parity, exit 2), the
@@ -219,6 +227,10 @@ QUICK_SKIP = {
     # r19: same shape as bench_trace_overhead (warm + interleaved
     # off/on reps over the full lattice) — full gate only.
     "bench_metrics_overhead.py",
+    # r24: same interleaved warm + off/on shape over the full lattice
+    # plus a (4, 2)-mesh pass — full gate only (the drill half
+    # re-runs fake-clocked in tier-1 every round anyway).
+    "bench_health.py",
     # r18: six full 256-scenario service passes (warm + 2x timed per
     # plane) plus the jumbo mix — minutes on the 2-core rig, full
     # gate only.

@@ -326,7 +326,12 @@ def _thread_roots(project) -> Dict[int, "_Root"]:
             elif resolved == "atexit.register" and node.args:
                 kind = "atexit"
                 targets.append(node.args[0])
-            elif resolved.endswith("debug.callback") and node.args:
+            elif node.args and (
+                resolved.endswith("debug.callback")
+                or leaf in ("pure_callback", "io_callback")
+            ):
+                # All three jax host-callback spellings take the
+                # host function as their first positional argument.
                 kind = "callback"
                 targets.append(node.args[0])
             elif leaf in (
@@ -348,6 +353,16 @@ def _thread_roots(project) -> Dict[int, "_Root"]:
             if kind is None:
                 continue
             for tgt in targets:
+                # A target wrapped as functools.partial(f, ...)
+                # still roots at f — the heartbeat registry binds
+                # its callback this way.
+                if isinstance(tgt, ast.Call):
+                    inner = mod.resolve(tgt.func) or ""
+                    if (
+                        inner.rsplit(".", 1)[-1] == "partial"
+                        and tgt.args
+                    ):
+                        tgt = tgt.args[0]
                 for fr in project.resolve_callable(
                     mod, tgt, cls=cls
                 ):

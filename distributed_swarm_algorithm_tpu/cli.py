@@ -877,6 +877,82 @@ def _cmd_scope_slo(args) -> int:
     return 0
 
 
+#: Alert-event names of the stream-health watchdog (serve/health.py)
+#: — the subset of events.jsonl the ``health`` subcommand renders.
+_HEALTH_EVENTS = ("stream-stall", "stream-recovered")
+
+
+def _cmd_scope_health(args) -> int:
+    """``swarmscope health RUN``: the stream-health view of a run
+    directory (r24 swarmpulse) — the watchdog's last per-stream
+    table from ``slo.json`` (state, heartbeat age, device-stamped
+    segment cursor), the stall/recovery alert totals, and the
+    ``stream-stall`` / ``stream-recovered`` incident log from
+    ``events.jsonl``."""
+    from .utils import rundir
+
+    run = rundir.load_run(args.run)
+    printed = False
+    for tag, s in sorted(run.slo.items()):
+        stalls = s.get("stream_stalls", 0)
+        recoveries = s.get("stream_recoveries", 0)
+        health = s.get("stream_health")
+        if not (stalls or recoveries or health):
+            continue
+        printed = True
+        print(f"stream health [{tag}]  stalls {stalls}  "
+              f"recoveries {recoveries}")
+        if not health:
+            continue
+        counts = health.get("counts") or {}
+        print(
+            f"  expected segment wall "
+            f"{health.get('expected_wall_ms', 0.0):.1f} ms   "
+            + "  ".join(
+                f"{st} {counts.get(st, 0)}"
+                for st in ("healthy", "slow", "stalled", "wedged")
+            )
+        )
+        for row in health.get("rows") or []:
+            rids = ",".join(str(r) for r in row.get("rids", []))
+            print(
+                f"    {row.get('state', '?'):>8}  rids [{rids}]  "
+                f"age {row.get('age_ms', 0.0):8.1f} ms  "
+                f"segs launched {row.get('seg_done', 0)} / "
+                f"landed {row.get('segs_landed', 0)}"
+            )
+    events = [
+        ev for ev in run.events
+        if ev.get("event") in _HEALTH_EVENTS
+    ]
+    if events:
+        printed = True
+        print(f"incident log ({len(events)} events):")
+        for ev in events:
+            rids = ",".join(str(r) for r in ev.get("rids", []))
+            if ev.get("event") == "stream-stall":
+                print(
+                    f"  STALL     t {ev.get('t_ms', 0.0):10.1f} ms  "
+                    f"rids [{rids}]  {ev.get('state', '?')}  "
+                    f"age {ev.get('age_ms', 0.0):.1f} ms "
+                    f"(expected wall "
+                    f"{ev.get('expected_wall_ms', 0.0):.1f} ms, "
+                    f"seg {ev.get('seg')})"
+                )
+            else:
+                print(
+                    f"  RECOVERED t {ev.get('t_ms', 0.0):10.1f} ms  "
+                    f"rids [{rids}]  "
+                    f"age {ev.get('age_ms', 0.0):.1f} ms"
+                )
+    if not printed:
+        print(f"run {run.label}: no stream-health data (no watchdog "
+              "snapshot in slo.json, no stream-stall/stream-recovered "
+              "events) — streams stayed healthy, or the run predates "
+              "the r24 watchdog")
+    return 0
+
+
 def _cmd_scope_history(args) -> int:
     """``swarmscope history METRIC``: the fixed-name row's trajectory
     across every recorded round of BENCH_HISTORY.json.
@@ -1609,6 +1685,15 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p_slo.add_argument("run", help="run directory (runs/<label>)")
     p_slo.set_defaults(fn=_cmd_scope_slo)
+    p_hl = scope_sub.add_parser(
+        "health",
+        help="render a run's stream-health view (r24): the "
+             "watchdog's per-stream table (state, heartbeat age, "
+             "device-stamped segment cursor) from slo.json plus the "
+             "stream-stall/stream-recovered incident log",
+    )
+    p_hl.add_argument("run", help="run directory (runs/<label>)")
+    p_hl.set_defaults(fn=_cmd_scope_health)
     p_sh = scope_sub.add_parser(
         "history",
         help="print a fixed-name row's BENCH_HISTORY trajectory, or "

@@ -45,7 +45,7 @@ traced, and vmapped agent-axis reductions keep their row-wise order.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from functools import partial
+from functools import lru_cache, partial
 from typing import Dict, Optional, Sequence, Tuple
 
 import jax
@@ -614,6 +614,51 @@ def batched_rollout_sharded(
     return _batched_rollout_sharded_impl(
         states, params, cfg, n_steps, mesh, axis, record, telemetry
     )
+
+
+def pulse_stamp_sharded(mesh, spec):
+    """The swarmpulse heartbeat stamp for mesh-committed carries
+    (r24): :func:`~.pulse.pulse_stamp`'s copy shard_map'd over the
+    serve mesh, so the completion callback fires ONCE PER DEVICE with
+    a linearized shard index — per-shard stamps are reduced host-side
+    by ``pulse.pulse_drain`` (no collective, no cross-device gather on
+    the serving path; the r19 review's deferred cross-device design).
+
+    ``spec`` places the stamped leaf: ``P(SCENARIO_AXIS)`` for a
+    sharded stream's ``[S]`` tick, ``P()`` for a jumbo stream's
+    replicated scalar tick (``spatial_shard_swarm`` replicates
+    non-slot leaves).  One compiled stamp per ``(mesh, spec)`` pair
+    ever — the builder is cached, so the per-segment stamp costs a
+    dispatch, never a retrace."""
+    return _pulse_stamp_sharded_cached(mesh, spec)
+
+
+@lru_cache(maxsize=None)
+def _pulse_stamp_sharded_cached(mesh, spec):
+    from jax.sharding import PartitionSpec as P
+
+    from ..utils.compat import shard_map
+    from .pulse import _pulse_landed_cb
+
+    axes = tuple(mesh.axis_names)
+    sizes = tuple(int(mesh.shape[a]) for a in axes)
+
+    def _block(leaf, token, seg):
+        # Linearized shard id over every mesh axis, row-major — the
+        # host-side reduction only needs distinctness + a stable
+        # count (mesh.size stamps per segment).
+        idx = jax.lax.axis_index(axes[0])
+        for name, size in zip(axes[1:], sizes[1:]):
+            idx = idx * size + jax.lax.axis_index(name)
+        jax.debug.callback(_pulse_landed_cb, token, seg, idx, leaf)
+        return jnp.copy(leaf)
+
+    fn = partial(
+        shard_map, mesh=mesh,
+        in_specs=(spec, P(), P()), out_specs=spec,
+        check_vma=False,
+    )(_block)
+    return jax.jit(fn)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,220 @@
+"""The stream-health watchdog (r24 swarmpulse, layer 3).
+
+The pulse registry (serve/pulse.py) gives every in-flight stream a
+monotonically advancing device-progress timestamp; this module turns
+it into a LIVENESS signal: each pump, the monitor ages every stream's
+heartbeat against the segment wall the service has actually been
+paying (learned live from the r16 ``serve_segment_wall_ms``
+histogram) and classifies it on a four-state ladder:
+
+    healthy   age <= slow_mult  * expected wall   (keeping pace)
+    slow      age <= stall_mult * expected wall   (straggling)
+    stalled   age <= wedge_mult * expected wall   (not progressing)
+    wedged    age >  wedge_mult * expected wall   (presumed dead)
+
+Entering the alarm zone (``stalled``/``wedged``) emits ONE
+``stream-stall`` event; leaving it (progress resumed, or the stream
+finished) emits ``stream-recovered`` — both through
+:class:`~.slo.SloTracker` so events.jsonl and the metric counters
+update in the same method (the r19 count-for-count parity
+discipline).  The ``stalled -> wedged`` escalation is visible in the
+health table but is NOT a second alarm: one incident, one event pair.
+
+Design constraints, in order:
+
+- **No thread, no device work.**  ``check`` runs inside the pump,
+  cadence-gated by ``interval_s``; it reads host floats the pulse
+  drain already wrote.  A wedged DEVICE cannot block detection,
+  because detection never touches the device.
+- **Fake-clock testable.**  The monitor sees streams as plain
+  objects with ``rids / done / seg_done / segs_landed /
+  last_launch_t / last_progress_t / health_state`` attributes; tests
+  drive it with ``SimpleNamespace`` rows and a hand-cranked clock
+  (tests/test_health.py), no service required.
+- **Learned walls, bounded floors.**  The expected wall is a
+  percentile of the live segment-wall histogram so thresholds track
+  the workload; before any history (or past the histogram envelope)
+  it falls back to ``default_wall_ms``, and never drops below
+  ``floor_ms`` — sub-millisecond CPU segments must not make an idle
+  pump look wedged.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Callable, List, Optional
+
+HEALTHY = "healthy"
+SLOW = "slow"
+STALLED = "stalled"
+WEDGED = "wedged"
+
+#: The ladder, mild to dead — the fixed label set of the
+#: ``serve_stream_health`` gauge (bounded cardinality by design).
+HEALTH_STATES = (HEALTHY, SLOW, STALLED, WEDGED)
+
+#: States that raise the stall alarm.
+ALARM_STATES = (STALLED, WEDGED)
+
+#: Watchdog defaults: one detection interval of 250 ms keeps the
+#: drill's "classified within one interval" bound meaningful at
+#: serving cadence while costing one float compare per pump.
+DEFAULT_INTERVAL_S = 0.25
+DEFAULT_WALL_MS = 1000.0
+
+
+class HealthMonitor:
+    """Classify in-flight streams from heartbeat age (see module
+    doc).  ``wall_hist`` (the service's ``serve_segment_wall_ms``
+    histogram) and ``slo`` (the tracker the events/counters ride) are
+    wired by :class:`~.service.StreamingService`; a bare monitor with
+    neither still classifies — it just has nowhere to report."""
+
+    def __init__(
+        self,
+        clock: Optional[Callable[[], float]] = None,
+        interval_s: float = DEFAULT_INTERVAL_S,
+        slow_mult: float = 1.5,
+        stall_mult: float = 4.0,
+        wedge_mult: float = 16.0,
+        floor_ms: float = 50.0,
+        default_wall_ms: float = DEFAULT_WALL_MS,
+        wall_quantile: float = 95.0,
+        wall_hist=None,
+        slo=None,
+    ):
+        if not 0 < slow_mult < stall_mult < wedge_mult:
+            raise ValueError(
+                "health thresholds must be ordered 0 < slow_mult < "
+                f"stall_mult < wedge_mult, got ({slow_mult}, "
+                f"{stall_mult}, {wedge_mult})"
+            )
+        self.clock = clock
+        self.interval_s = float(interval_s)
+        self.slow_mult = float(slow_mult)
+        self.stall_mult = float(stall_mult)
+        self.wedge_mult = float(wedge_mult)
+        self.floor_ms = float(floor_ms)
+        self.default_wall_ms = float(default_wall_ms)
+        self.wall_quantile = float(wall_quantile)
+        self.wall_hist = wall_hist
+        self.slo = slo
+        self._last_check: Optional[float] = None
+        #: Last completed check's snapshot (None before the first) —
+        #: what ``SloTracker.summary()`` re-renders between checks.
+        self.last_snapshot: Optional[dict] = None
+
+    def _now(self) -> float:
+        return (self.clock or time.monotonic)()
+
+    # -- thresholds --------------------------------------------------------
+    def expected_wall_ms(self) -> float:
+        """The segment wall the workload has been paying: a high
+        percentile of the live histogram, floored, with a structured
+        fallback before history exists or past the bucket envelope
+        (``inf`` must not disable the watchdog)."""
+        wall = None
+        if self.wall_hist is not None:
+            got = self.wall_hist.percentile(self.wall_quantile)
+            if got and math.isfinite(got):
+                wall = float(got)
+        if wall is None:
+            wall = self.default_wall_ms
+        return max(self.floor_ms, wall)
+
+    def classify(self, age_ms: float, wall_ms: float) -> str:
+        if age_ms <= self.slow_mult * wall_ms:
+            return HEALTHY
+        if age_ms <= self.stall_mult * wall_ms:
+            return SLOW
+        if age_ms <= self.wedge_mult * wall_ms:
+            return STALLED
+        return WEDGED
+
+    # -- the watchdog tick -------------------------------------------------
+    def check(self, streams, force: bool = False) -> Optional[dict]:
+        """One watchdog pass over ``streams`` (cadence-gated; returns
+        None when skipped).  Emits stall/recovered transitions through
+        the tracker, pushes the per-stream table + state counts to it,
+        and returns the snapshot ``{"expected_wall_ms", "rows",
+        "counts"}``."""
+        now = self._now()
+        if (
+            not force
+            and self._last_check is not None
+            and now - self._last_check < self.interval_s
+        ):
+            return None
+        self._last_check = now
+        wall = self.expected_wall_ms()
+        rows: List[dict] = []
+        counts = {st: 0 for st in HEALTH_STATES}
+        for s in streams:
+            if s.done:
+                # A finished (or abandoned) stream leaves the table;
+                # completion IS recovery for an alarmed one — the
+                # incident closes with an event, not silence.
+                self.discharge(s)
+                continue
+            base = (
+                s.last_progress_t
+                if s.last_progress_t is not None
+                else s.last_launch_t
+            )
+            if base is None:
+                # Admitted but never launched this pump cycle — no
+                # heartbeat to age yet.
+                continue
+            age_ms = max(0.0, 1e3 * (now - base))
+            state = self.classify(age_ms, wall)
+            prev = s.health_state
+            if state != prev:
+                in_alarm = state in ALARM_STATES
+                was_alarm = prev in ALARM_STATES
+                if in_alarm and not was_alarm:
+                    self._emit_stall(s, state, age_ms, wall, now)
+                elif was_alarm and not in_alarm:
+                    self._emit_recovered(s, age_ms, now)
+                s.health_state = state
+            counts[state] += 1
+            rows.append(
+                {
+                    "rids": list(s.rids),
+                    "state": state,
+                    "age_ms": round(age_ms, 3),
+                    "seg_done": int(s.seg_done),
+                    "segs_landed": int(s.segs_landed),
+                }
+            )
+        snapshot = {
+            "expected_wall_ms": round(wall, 3),
+            "rows": rows,
+            "counts": counts,
+        }
+        self.last_snapshot = snapshot
+        if self.slo is not None:
+            self.slo.set_stream_health(snapshot)
+        return snapshot
+
+    def discharge(self, s) -> None:
+        """A stream is leaving observation (done, or its last tenant
+        collected): close any open incident NOW, without waiting for
+        the next cadence tick — a collect can race the cadence gate,
+        and an alarm must never dangle past the stream it names."""
+        if s.health_state in ALARM_STATES:
+            self._emit_recovered(s, 0.0, self._now())
+        s.health_state = HEALTHY
+
+    def _emit_stall(self, s, state, age_ms, wall_ms, now) -> None:
+        if self.slo is not None:
+            self.slo.on_stream_stall(
+                s.rids, state=state, age_ms=age_ms,
+                expected_wall_ms=wall_ms, seg=s.seg_done, t=now,
+            )
+
+    def _emit_recovered(self, s, age_ms, now) -> None:
+        if self.slo is not None:
+            self.slo.on_stream_recovered(
+                s.rids, age_ms=age_ms, t=now
+            )

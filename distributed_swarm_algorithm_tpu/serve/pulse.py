@@ -1,0 +1,153 @@
+"""swarmpulse — per-segment device-progress heartbeats (r24).
+
+The r19 probe stamped ONE moment per stream: segment 1's completion,
+single-device streams only, feeding TTFR.  This module generalizes it
+into the serve plane's liveness sensor: EVERY segment rotation of
+EVERY stream class routes one tiny data-dependent leaf through a
+``jax.debug.callback`` stamp, so each in-flight stream carries a
+monotonically advancing ``last_device_progress`` timestamp and the
+pump can harvest completed segments from the registry instead of
+host-polling ``is_ready`` (ROADMAP item 5's "remaining r19 edge").
+
+**The stamp programs.**  Two, both tiny copies whose callback operand
+is the segment's output leaf — the data dependency is what pins the
+callback AFTER the segment's computation; the runtime cannot run it
+earlier:
+
+- :func:`pulse_stamp` — single-device streams: one jitted copy, one
+  callback, one stamp per segment (the r19 ``_probe_stamp`` shape
+  plus a segment index).
+- ``serve.batched.pulse_stamp_sharded`` — mesh-committed carries
+  (scenario-sharded and jumbo/spatial): the same copy shard_map'd
+  over the serve mesh, so the callback fires ONCE PER DEVICE with a
+  linearized shard index.  Per-shard stamps are reduced host-side in
+  :func:`pulse_drain` — a segment is complete when all ``n_shards``
+  stamps landed, its completion time the max over shards (the
+  straggler defines the segment, exactly like the device itself).
+  This is the cross-device design the r19 review deferred: no
+  collective, no cross-device gather on the serving path — each
+  device reports only its own block, and the reduction is host
+  arithmetic over a dict.
+
+**The token registry.**  Module-level and lock-guarded because the
+callbacks run on the runtime's threads, not the pump's.  One token
+per stream, allocated at first launch (:func:`pulse_open`), wrapped
+to the i32 domain the traced scalar rides in; the dicts are bounded
+by what is in flight (:func:`pulse_close` on collect/abandon — the
+r13 result-store discipline).  The callback touches ONLY these dicts
+and only under ``_PROBE_LOCK``; the pump consumes stamps
+single-threadedly via :func:`pulse_drain`.
+
+Callbacks OFF is the r10 gate discipline: the service never imports a
+stamp into its launch path — the probe reverts to the LITERAL
+pre-r19 ``jnp.copy(states.tick)`` expression and harvest reverts to
+``is_ready`` polling, so the disabled service's compiled set is
+byte-identical to the r16 service (pinned in tests/test_metrics.py).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_PROBE_TOKENS = itertools.count()
+_PROBE_LOCK = threading.Lock()
+#: token -> {segment index -> {shard index -> request-clock stamp}}.
+#: Consumed segments are deleted by ``pulse_drain`` as soon as every
+#: shard stamped, so an entry holds at most the in-flight segment
+#: per shard, not the stream's history.
+_PROBE_LANDED: Dict[int, Dict[int, Dict[int, float]]] = {}
+#: token -> the stream's SLO clock (registered at open, read by the
+#: callback; popped on close so neither dict outlives its stream).
+_PROBE_CLOCKS: Dict[int, Callable[[], float]] = {}
+#: token -> stamps expected per segment: 1 for single-device streams,
+#: ``mesh.size`` for shard_map'd stamps (one per device).
+_PROBE_SHARDS: Dict[int, int] = {}
+
+
+def _pulse_landed_cb(token, seg, shard, _leaf) -> None:
+    """The device-side heartbeat: one dict write under the lock.
+    ``_leaf`` is the segment's output leaf — unused, but its presence
+    as an operand is the data dependency that pins the callback AFTER
+    the segment's computation."""
+    tok, sg, sh = int(token), int(seg), int(shard)
+    with _PROBE_LOCK:
+        clock = _PROBE_CLOCKS.get(tok)
+        if clock is not None:
+            _PROBE_LANDED.setdefault(tok, {}).setdefault(
+                sg, {}
+            )[sh] = float(clock())
+
+
+@jax.jit
+def pulse_stamp(leaf, token, seg):
+    """Single-device segment stamp: the same independent copy the
+    host-poll probe makes, plus the observation effect.  ``token``
+    and ``seg`` are traced i32 scalars (fresh Python ints would be
+    fresh constants — a retrace per dispatch)."""
+    jax.debug.callback(
+        _pulse_landed_cb, token, seg, jnp.int32(0), leaf
+    )
+    return jnp.copy(leaf)
+
+
+def pulse_open(clock: Callable[[], float], n_shards: int = 1) -> int:
+    """Allocate a stream's heartbeat token and register its clock and
+    expected per-segment stamp count.  Wrapped to the i32 domain the
+    traced scalar rides in: only IN-FLIGHT tokens must be unique, and
+    2^31 concurrent streams is not a regime."""
+    token = next(_PROBE_TOKENS) % (2 ** 31)
+    with _PROBE_LOCK:
+        _PROBE_CLOCKS[token] = clock
+        _PROBE_SHARDS[token] = max(1, int(n_shards))
+    return token
+
+
+def pulse_drain(
+    token: Optional[int], next_seg: int
+) -> Tuple[Optional[float], List[Tuple[int, float]]]:
+    """Consume landed stamps: ``(latest stamp time or None,
+    [(seg, completion time), ...])`` for the consecutive run of fully
+    stamped segments starting at ``next_seg``.  ``latest`` advances on
+    PARTIAL segments too (a straggling shard's peers still prove
+    progress — the heartbeat the watchdog ages).  Completed segments
+    are deleted from the registry; per-device program order makes
+    completion consecutive, so a consecutive cursor loses nothing."""
+    if token is None:
+        return None, []
+    out: List[Tuple[int, float]] = []
+    latest: Optional[float] = None
+    with _PROBE_LOCK:
+        expected = _PROBE_SHARDS.get(token, 1)
+        segs = _PROBE_LANDED.get(token)
+        if segs:
+            latest = max(
+                t for sh in segs.values() for t in sh.values()
+            )
+            k = next_seg
+            while True:
+                shards = segs.get(k)
+                if shards is None or len(shards) < expected:
+                    break
+                out.append((k, max(shards.values())))
+                del segs[k]
+                k += 1
+            if not segs:
+                del _PROBE_LANDED[token]
+    return latest, out
+
+
+def pulse_close(token: Optional[int]) -> None:
+    """Drop a stream's token from every registry (collected or
+    abandoned before its drain): the dicts are bounded by what is in
+    flight, the r13 result-store discipline."""
+    if token is None:
+        return
+    with _PROBE_LOCK:
+        _PROBE_CLOCKS.pop(token, None)
+        _PROBE_LANDED.pop(token, None)
+        _PROBE_SHARDS.pop(token, None)

@@ -54,8 +54,6 @@ the count as a fixed-name "compiles" row.
 
 from __future__ import annotations
 
-import itertools
-import threading
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
@@ -94,12 +92,15 @@ from .batched import (
     batched_rollout_sharded,
     materialize_batch,
     materialize_scenario,
+    pulse_stamp_sharded,
     shard_scenarios,
     tenant_state,
     validate_request,
     validate_serve_config,
 )
 from .buckets import BucketSpec
+from .health import HealthMonitor
+from .pulse import pulse_close, pulse_drain, pulse_open, pulse_stamp
 from .queue import AdmissionQueue, QueueOverflowError
 from .slo import DEFAULT_DEADLINE_S, SloTracker
 
@@ -111,72 +112,18 @@ JUMBO_ENTRY = "swarm-rollout-spatial"
 
 
 # ---------------------------------------------------------------------------
-# Device-callback first-result stamping (r19, ROADMAP item 2b).
-#
-# The r16 probe is HOST-POLLED: `pump` asks `is_ready()` once per
-# cycle, so the observed TTFR is quantized to the pump cadence — a
-# result that lands between pumps waits for the next one to be seen.
-# Here the device itself stamps: segment 1's tick leaf routes through
-# a tiny jitted copy whose `jax.debug.callback` fires ON COMPLETION
-# (the callback's operands depend on the segment-1 output, so the
-# runtime cannot run it earlier), recording the request's clock time
-# into a token registry the next `_harvest` drains.  The donated-carry
-# path is untouched — the probe copy was ALWAYS an independent buffer
-# outside the rotation — and the rollout arithmetic is untouched (the
-# callback only observes), so results stay bitwise-identical with
-# callbacks on (pinned in tests/test_metrics.py).
-#
-# Callback-OFF is the r10 gate discipline: the probe reverts to the
-# LITERAL pre-r19 `jnp.copy(states.tick)` expression — no extra
-# program exists to lower, so the disabled service's compiled set is
-# byte-identical to the r16 service (also pinned).
-#
-# The token registry is module-level and lock-guarded because the
-# callback runs on the runtime's thread, not the pump's: the callback
-# touches ONLY these two dicts (never the tracker), and the pump
-# applies the stamp single-threadedly at the next harvest.
-
-_PROBE_TOKENS = itertools.count()
-_PROBE_LOCK = threading.Lock()
-#: token -> request-clock time the device finished segment 1.
-_PROBE_LANDED: Dict[int, float] = {}
-#: token -> the stream's SLO clock (registered at launch, consumed by
-#: the callback; popped on harvest/cleanup so neither dict outlives
-#: its stream).
-_PROBE_CLOCKS: Dict[int, Callable[[], float]] = {}
-
-
-def _probe_landed_cb(token, _tick) -> None:
-    """The device-side completion callback: one dict write under the
-    lock.  ``_tick`` is the segment-1 output leaf — unused, but its
-    presence as an operand is the data dependency that pins the
-    callback AFTER the segment's computation."""
-    tok = int(token)
-    with _PROBE_LOCK:
-        clock = _PROBE_CLOCKS.pop(tok, None)
-        if clock is not None:
-            _PROBE_LANDED[tok] = float(clock())
-
-
-@jax.jit
-def _probe_stamp(tick, token):
-    """Segment-1 probe WITH the completion callback: the same
-    independent copy as the host-poll path, plus the observation
-    effect.  ``token`` is a traced i32 scalar (a fresh Python int per
-    dispatch would be a fresh constant — a retrace per dispatch)."""
-    jax.debug.callback(_probe_landed_cb, token, tick)
-    return jnp.copy(tick)
-
-
-def _probe_cleanup(token: Optional[int]) -> None:
-    """Drop a stream's token from both registries (collected or
-    abandoned before its harvest): the dicts are bounded by what is
-    in flight, the r13 result-store discipline."""
-    if token is None:
-        return
-    with _PROBE_LOCK:
-        _PROBE_CLOCKS.pop(token, None)
-        _PROBE_LANDED.pop(token, None)
+# swarmpulse (r24): per-segment device heartbeats for EVERY stream
+# class, generalizing the r19 segment-1 probe.  The machinery —
+# token registry, the completion callback, the single-device and
+# shard_map'd stamp programs — lives in serve/pulse.py (and
+# batched.pulse_stamp_sharded for the mesh classes); the service only
+# orchestrates: open a token at first launch, route every segment's
+# tick leaf through a stamp, drain completed segments at harvest
+# (callback-driven — no `is_ready` host polls while callbacks are
+# on), and close the token when the stream leaves.  Callbacks OFF
+# reverts the probe to the LITERAL pre-r19 `jnp.copy(states.tick)`
+# and harvest to `is_ready` polling — the disabled service's compiled
+# set stays byte-identical (pinned in tests/test_metrics.py).
 
 
 def unshard_spatial_state(state: SwarmState, n: int) -> SwarmState:
@@ -572,11 +519,37 @@ class _Stream:
         self.telem_segs: List = []               # [seg_len, S] leaves
         self.traj_segs: List = []                # [seg_len, S, C, D]
         self.probe = None                        # independent tick copy
-        self.probe_token: Optional[int] = None   # r19 callback token
+        self.probe_token: Optional[int] = None   # swarmpulse token
+        #: True iff this stream ever opened a pulse token — keeps it
+        #: off the host-poll path even after the token closes
+        #: (abandon), so callbacks-on never mixes observation modes.
+        self.pulsed = False
         self.first_stamped = False
         #: Clock time of this stream's latest segment launch — the
         #: rotation-interval histogram's left edge (r19).
         self.last_launch_t: Optional[float] = None
+        # -- swarmpulse (r24): what the pulse drain writes ----------
+        #: Segments fully device-stamped, consecutive from 0 — the
+        #: callback-harvest cursor (``segs_landed == len(seg_plan)``
+        #: means the result buffers are observable without a poll).
+        self.segs_landed = 0
+        #: Latest device stamp (monotone; partial shard stamps count
+        #: — a straggler's peers still prove progress).  None until
+        #: the first stamp; the watchdog falls back to
+        #: ``last_launch_t`` as the heartbeat base.
+        self.last_progress_t: Optional[float] = None
+        #: Final segment's device completion stamp (harvest-lag's
+        #: left edge); None until it lands.
+        self.result_t: Optional[float] = None
+        #: The final segment's stamped output leaf.  Collect blocks
+        #: on it before the terminal pulse drain: the stamp program
+        #: is enqueued with the launch, but its host callback runs
+        #: asynchronously — without the barrier a fast collect could
+        #: close the token before the last heartbeat lands.
+        self.final_stamp = None
+        #: The watchdog's current classification (serve/health.py
+        #: owns transitions; the stream just stores the label).
+        self.health_state = "healthy"
         self.evict_flags: Set[int] = set()
         #: rid -> (ticks_elapsed, device state view, n_telem_segs)
         self.evicted: Dict[int, tuple] = {}
@@ -742,6 +715,8 @@ class StreamingService:
         jumbo_cfg: Optional[SwarmConfig] = None,
         metrics: Optional[metricslib.MetricsRegistry] = None,
         first_result_callback: bool = True,
+        health: Optional[HealthMonitor] = None,
+        launch_hook: Optional[Callable[[List[int], int], bool]] = None,
     ):
         self.cfg = validate_serve_config(cfg or DEFAULT_CONFIG)
         self.spec = spec or BucketSpec()
@@ -850,13 +825,23 @@ class StreamingService:
             "stream (the pipelined segment's wall time under a busy "
             "pump; pump cadence bounds it from below on an idle one)",
         )
-        #: Device-callback first-result stamping (r19, ROADMAP 2b) —
-        #: see the module-level probe machinery.  Applies to
-        #: single-device scenario streams; mesh-committed carries
-        #: (sharded/jumbo) keep the host-poll probe — a cross-device
-        #: callback gather on the serving path is exactly the class
-        #: of hidden transfer the serve-host-sync lint exists for.
+        #: swarmpulse master switch (r24; name kept from the r19
+        #: first-result callback it grew out of).  ON: every segment
+        #: of every stream class — single-device, scenario-sharded,
+        #: jumbo — routes a tick leaf through a device heartbeat
+        #: stamp (serve/pulse.py), TTFR and harvest are
+        #: callback-driven, and the watchdog ages real device
+        #: progress.  OFF: the literal pre-r19 probe expression and
+        #: `is_ready` host polling — the compiled set is pinned
+        #: byte-identical to the r16 service.
         self.first_result_callback = bool(first_result_callback)
+        #: Fault-injection hook (the wedge drill's injection point):
+        #: called as ``launch_hook(rids, seg_index)`` before each
+        #: segment launch; returning False skips THIS stream's launch
+        #: this pump — the stream stays in-flight with an aging
+        #: heartbeat, which is exactly what a wedged device looks
+        #: like from the host.  None (the default) costs nothing.
+        self.launch_hook = launch_hook
         #: Observation-lag samples (ms), one per request whose first
         #: result carried BOTH stamps: host-poll observation minus
         #: device-callback stamp — what the poll-only design was
@@ -869,6 +854,13 @@ class StreamingService:
         self._lag_stride = 1
         self._lag_skip = 0
         self._max_lag_samples = 4096
+        #: harvest-lag twin (r24): host observation of a stream's
+        #: FINAL segment minus its device completion stamp — what
+        #: `is_ready` polling was adding to result latency; one
+        #: sample per tenant, same decimation bound.
+        self.harvest_lag_ms: List[float] = []
+        self._hlag_stride = 1
+        self._hlag_skip = 0
         #: Same injectable registry as RolloutService; the admission
         #: queue shares it (and the SLO clock), so its retrospective
         #: queue-wait spans land on the same timeline as the dispatch
@@ -880,6 +872,18 @@ class StreamingService:
         # tracker itself stays jax-free, so the probe is injected.
         if self.slo.memory_probe is None:
             self.slo.memory_probe = device_memory_watermark
+        # The stall watchdog (r24, swarmpulse layer 3): runs INSIDE
+        # the pump, cadence-gated — no new thread on the hot path.
+        # An injected monitor keeps its thresholds; the service only
+        # fills the wiring it left open (clock, the live segment-wall
+        # histogram, the tracker the events ride).
+        self.health = health or HealthMonitor()
+        if self.health.clock is None:
+            self.health.clock = self.slo.clock
+        if self.health.wall_hist is None:
+            self.health.wall_hist = self._m_segment_wall
+        if self.health.slo is None:
+            self.health.slo = self.slo
         self.queue = AdmissionQueue(
             self.spec, deadline_s, clock=self.slo.clock,
             tracer=self.tracer, metrics=self.metrics,
@@ -993,6 +997,10 @@ class StreamingService:
         launched = self._admit(force=force)
         advanced = self._advance()
         self._harvest()
+        # The stall watchdog (r24): ages each in-flight stream's
+        # heartbeat against the learned segment wall — cadence-gated
+        # host floats only, no device work, no thread.
+        self.health.check(self._live)
         self.slo.sample(self.queue.depth, self.n_in_flight)
         # The live surface: one snapshot line per deposit interval
         # when a run dir is configured (swarmscope live follows it);
@@ -1115,8 +1123,22 @@ class StreamingService:
                 # rotation (a jumbo stream would otherwise keep
                 # burning the whole tiles axis on discarded work).
                 s.abandoned = True
-                _probe_cleanup(s.probe_token)
+                # One last drain (stamps that already landed still
+                # advance the cursor eviction cuts read), then the
+                # registry entry goes — it must not outlive its
+                # stream.
+                self._drain_pulse(s)
+                pulse_close(s.probe_token)
                 s.probe_token = None
+                continue
+            if (
+                self.launch_hook is not None
+                and not self.launch_hook(list(s.rids), s.seg_done)
+            ):
+                # Fault injection (the wedge drill): the hook vetoed
+                # this stream's launch this pump.  The stream stays
+                # in-flight, its heartbeat ages, the watchdog sees a
+                # wedge — without any device actually wedging.
                 continue
             first = s.seg_done == 0
             if first:
@@ -1178,48 +1200,123 @@ class StreamingService:
                 s.traj_segs.append(traj)
             if telem is not None:
                 s.telem_segs.append(telem)
+            seg_idx = s.seg_done
             s.seg_done += 1
-            if first:
-                # The first-result probe: an INDEPENDENT copy of one
-                # tiny leaf of segment 1's output (the carry itself
-                # is donated into segment 2), harvested once it is
-                # observable — TTFR is a real observation, not a
-                # dispatch-time guess.  With callbacks on (r19) the
-                # copy routes through _probe_stamp so the DEVICE
-                # stamps completion; off (or on a mesh-committed
-                # carry) it is the literal pre-r19 expression.
-                if (
-                    self.first_result_callback
-                    and not s.sharded and not s.jumbo
-                ):
-                    # Wrapped to the i32 domain the traced scalar
-                    # rides in: only in-flight tokens must be unique,
-                    # and 2^31 concurrent streams is not a regime —
-                    # the unbounded count would otherwise overflow
-                    # jnp.asarray(..., int32) on a weeks-long
-                    # service.
-                    token = next(_PROBE_TOKENS) % (2 ** 31)
-                    with _PROBE_LOCK:
-                        _PROBE_CLOCKS[token] = self.slo.clock
-                    s.probe_token = token
-                    s.probe = _probe_stamp(
-                        states.tick, jnp.asarray(token, jnp.int32)
+            if self.first_result_callback:
+                # swarmpulse (r24): EVERY launched segment of EVERY
+                # stream class routes its tick leaf through a device
+                # heartbeat stamp — an INDEPENDENT copy outside the
+                # donated rotation whose callback fires on segment
+                # completion (the leaf operand is the data
+                # dependency).  Segment 0's stamped copy doubles as
+                # the first-result probe; later stamps are observe-
+                # only (the enqueued effect outlives the dropped
+                # reference).
+                if first:
+                    s.probe_token = pulse_open(
+                        self.slo.clock,
+                        n_shards=(
+                            self.mesh.size
+                            if (s.sharded or s.jumbo) else 1
+                        ),
                     )
-                else:
-                    s.probe = jnp.copy(states.tick)
+                    s.pulsed = True
+                stamped = self._pulse_stamp_launch(s, states, seg_idx)
+                if first:
+                    s.probe = stamped
+                if seg_idx == len(s.seg_plan) - 1:
+                    # Collect blocks on the final stamp before the
+                    # terminal drain — the heartbeat must land
+                    # before the token closes.
+                    s.final_stamp = stamped
+                # Drain EVERY live pulse at the launch boundary, not
+                # just at pass end: a heartbeat that lands while the
+                # pump is busy launching some other stream's segment
+                # is observed at the next boundary, so harvest lag is
+                # bounded by one launch — not by the whole pass over
+                # ``_live``.
+                for t in self._live:
+                    if t.probe_token is not None:
+                        self._drain_pulse(t)
+            elif first:
+                # Callbacks off: the LITERAL pre-r19 probe — an
+                # independent copy of one tiny leaf of segment 1's
+                # output, host-polled at harvest.  Byte-identical
+                # lowering to the r16 service (pinned).
+                s.probe = jnp.copy(states.tick)
             n += 1
         return n
 
+    def _pulse_stamp_launch(self, s: _Stream, states, seg: int):
+        """Enqueue the heartbeat stamp for the segment just launched:
+        the single-device jitted stamp, or the shard_map'd per-device
+        stamp for mesh-committed carries (``P(SCENARIO_AXIS)`` for a
+        sharded stream's [S] tick, replicated ``P()`` for the jumbo
+        tiled scalar — ``spatial_shard_swarm`` replicates non-slot
+        leaves, so the designated leaf is fully addressable)."""
+        tok = jnp.asarray(s.probe_token, jnp.int32)
+        sg = jnp.asarray(seg, jnp.int32)
+        if s.sharded or s.jumbo:
+            from jax.sharding import PartitionSpec as P
+
+            from ..parallel.mesh import SCENARIO_AXIS
+
+            spec = P(SCENARIO_AXIS) if s.sharded else P()
+            return pulse_stamp_sharded(self.mesh, spec)(
+                states.tick, tok, sg
+            )
+        return pulse_stamp(states.tick, tok, sg)
+
+    def _drain_pulse(self, s: _Stream) -> None:
+        """Consume the stream's landed heartbeats: advance the
+        progress timestamp (partial shard stamps count), stamp TTFR
+        from segment 0's device completion, and mark the final
+        segment's landing (the callback-driven harvest — no
+        ``is_ready`` poll anywhere on this path)."""
+        if s.probe_token is None:
+            return
+        latest, completed = pulse_drain(s.probe_token, s.segs_landed)
+        if latest is not None and (
+            s.last_progress_t is None or latest > s.last_progress_t
+        ):
+            s.last_progress_t = latest
+        if not completed:
+            return
+        now = self.slo.clock()
+        for seg, t in completed:
+            s.segs_landed = seg + 1
+            if seg == 0 and not s.first_stamped:
+                # The device stamped segment-1 completion: TTFR
+                # measures the device, and poll-vs-callback lag is
+                # what the host-poll design was charging (r19).
+                self.slo.on_first_result(s.rids, t=t)
+                self._record_lag(
+                    max(0.0, 1e3 * (now - t)), len(s.rids)
+                )
+                self.tracer.instant(HARVEST_EVENT, rids=s.rids)
+                s.first_stamped = True
+            if seg == len(s.seg_plan) - 1 and s.result_t is None:
+                s.result_t = t
+                self._record_harvest_lag(
+                    max(0.0, 1e3 * (now - t)), len(s.rids)
+                )
+
     def _harvest(self) -> None:
-        """Stamp first-result probes that are observable.  Device
-        probes are polled via ``is_ready`` and only read once the
-        computation has finished — the stamp never blocks the pump,
-        even on a single-segment plan whose probe IS the final
-        output (a tenant collected before any poll observed it is
-        backfilled by ``SloTracker.on_collect``).  Probe leaves
-        without ``is_ready`` (host arrays) are observable as soon as
-        every segment is launched."""
+        """Drain completed segments.  With callbacks on (swarmpulse,
+        r24) the registry IS the harvest: the device already stamped
+        every landed segment, so the pump reads host floats — no
+        ``is_ready`` poll on the hot path.  With callbacks off the
+        r16 poll survives verbatim: the segment-1 probe is polled via
+        ``is_ready`` and only read once finished (a tenant collected
+        before any poll observed it is backfilled by
+        ``SloTracker.on_collect``).  Probe leaves without
+        ``is_ready`` (host arrays) are observable as soon as every
+        segment is launched."""
         for s in self._live:
+            if s.probe_token is not None:
+                self._drain_pulse(s)
+            if s.pulsed:
+                continue
             if s.probe is None or s.first_stamped:
                 continue
             is_ready = getattr(s.probe, "is_ready", None)
@@ -1227,23 +1324,7 @@ class StreamingService:
             if observable:
                 # swarmlint: disable=serve-host-sync -- the probe is already finished (is_ready above) or a host array; the read cannot stall the pump
                 np.asarray(s.probe)
-                now = self.slo.clock()
-                cb_t = None
-                if s.probe_token is not None:
-                    with _PROBE_LOCK:
-                        cb_t = _PROBE_LANDED.pop(s.probe_token, None)
-                        _PROBE_CLOCKS.pop(s.probe_token, None)
-                    s.probe_token = None
-                if cb_t is not None:
-                    # The device stamped completion (r19): TTFR
-                    # measures the device, and the poll-vs-callback
-                    # delta is the observation lag the host-poll
-                    # design was charging every request.
-                    self.slo.on_first_result(s.rids, t=cb_t)
-                    lag = max(0.0, 1e3 * (now - cb_t))
-                    self._record_lag(lag, len(s.rids))
-                else:
-                    self.slo.on_first_result(s.rids, t=now)
+                self.slo.on_first_result(s.rids, t=self.slo.clock())
                 self.tracer.instant(HARVEST_EVENT, rids=s.rids)
                 s.first_stamped = True
 
@@ -1261,6 +1342,20 @@ class StreamingService:
         if len(self.ttfr_lag_ms) > self._max_lag_samples:
             self.ttfr_lag_ms = self.ttfr_lag_ms[::2]
             self._lag_stride *= 2
+
+    def _record_harvest_lag(self, lag_ms: float, n: int) -> None:
+        """The r24 twin for final-segment (harvest) observation lag —
+        same stride-decimated bound, separate store (TTFR lag and
+        harvest lag gate as separate bench rows)."""
+        for _ in range(n):
+            self._hlag_skip += 1
+            if self._hlag_skip < self._hlag_stride:
+                continue
+            self._hlag_skip = 0
+            self.harvest_lag_ms.append(lag_ms)
+        if len(self.harvest_lag_ms) > self._max_lag_samples:
+            self.harvest_lag_ms = self.harvest_lag_ms[::2]
+            self._hlag_stride *= 2
 
     # -- eviction / join ---------------------------------------------------
     def evict(self, rid: int) -> bool:
@@ -1311,11 +1406,22 @@ class StreamingService:
         s = self._streams.get(rid)
         if s is None:
             return False
+        if s.probe_token is not None:
+            # Callback-driven readiness (r24): the registry already
+            # knows which segments the device finished — consult it
+            # instead of touching a device handle.  (After abandon
+            # the token is closed and the `is_ready` fallback below
+            # answers for the eviction cuts.)
+            self._drain_pulse(s)
         if rid in s.evicted:
+            if s.probe_token is not None:
+                return s.segs_landed >= s.evicted[rid][2]
             leaf = s.evicted[rid][1].pos
         elif s.done:
             if s._host is not None:
                 return True
+            if s.probe_token is not None:
+                return s.segs_landed >= len(s.seg_plan)
             leaf = s.carry.pos
         else:
             return False
@@ -1374,18 +1480,20 @@ class StreamingService:
     def _result_for(self, s: _Stream, rid: int) -> TenantResult:
         req, capacity = self._requests.pop(rid)
         i = s.rids.index(rid)
-        if s.probe_token is not None and not s.first_stamped:
-            # Collected before any harvest observed the probe (a
-            # single-segment plan drained straight through): the
-            # device callback may still have landed — prefer its
-            # stamp over the on_collect backfill.
-            with _PROBE_LOCK:
-                cb_t = _PROBE_LANDED.pop(s.probe_token, None)
-                _PROBE_CLOCKS.pop(s.probe_token, None)
-            s.probe_token = None
-            if cb_t is not None:
-                self.slo.on_first_result(s.rids, t=cb_t)
-                s.first_stamped = True
+        if s.probe_token is not None:
+            # Collected before the last pump drained (a
+            # single-segment plan collected straight through): any
+            # stamp that already landed — TTFR, harvest lag — is
+            # preferred over the on_collect backfill.  The final
+            # segment's stamp program may still be executing (its
+            # callback runs on the runtime thread); barrier on its
+            # output once so the harvest-lag sample lands before the
+            # token closes — the segment itself is already done, so
+            # the wait is callback dispatch, not compute.
+            if s.final_stamp is not None and s.done:
+                jax.block_until_ready(s.final_stamp)
+                s.final_stamp = None
+            self._drain_pulse(s)
         with self.tracer.span(COLLECT_SPAN, rid=rid):
             if s.jumbo:
                 if rid in s.evicted:
@@ -1434,10 +1542,14 @@ class StreamingService:
         if not any(r in self._streams for r in s.rids):
             # Every tenant of this stream is out: drop the buffers
             # (result-store eviction, the r13 discipline) and any
-            # unharvested probe token (collect backfilled TTFR; the
+            # unharvested pulse token (collect backfilled TTFR; the
             # registry must not outlive the stream).
-            _probe_cleanup(s.probe_token)
+            pulse_close(s.probe_token)
             s.probe_token = None
+            # Leaving observation closes any open stall incident —
+            # the watchdog's cadence gate must not let an alarm
+            # dangle past the stream it names.
+            self.health.discharge(s)
             try:
                 self._live.remove(s)
             except ValueError:
@@ -1453,6 +1565,20 @@ class StreamingService:
             traj=traj,
             ticks=ticks,
         )
+
+    # -- observation windows -----------------------------------------------
+    def rotate_slo(self, window: Optional[str] = None) -> SloTracker:
+        """Rotate the SLO observation window in place (r24 satellite;
+        see :meth:`~.slo.SloTracker.rotate`): the service, watchdog,
+        and queue continue on the successor tracker (the queue shares
+        only the clock, which the successor keeps), and the CLOSED
+        tracker is returned for archival — ``summary()`` on it is the
+        window's frozen slo.json artifact."""
+        closed = self.slo
+        self.slo = closed.rotate(window)
+        if self.health.slo is closed:
+            self.health.slo = self.slo
+        return closed
 
     # -- introspection -----------------------------------------------------
     @property

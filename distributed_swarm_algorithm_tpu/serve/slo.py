@@ -38,10 +38,15 @@ compiles).
 threshold crossings (``utils/telemetry.write_events_jsonl`` →
 ``events.jsonl``, the file swarmscope reads):
 
-    deadline-miss   a tenant launched later than deadline + grace —
-                    the host loop stopped keeping up
-    queue-overflow  a submit was rejected at the declared queue bound
-    eviction        a tenant left mid-stream (partial results)
+    deadline-miss     a tenant launched later than deadline + grace —
+                      the host loop stopped keeping up
+    queue-overflow    a submit was rejected at the declared queue bound
+    eviction          a tenant left mid-stream (partial results)
+    stream-stall      a stream's device heartbeat aged into the
+                      watchdog's alarm zone (r24 swarmpulse,
+                      serve/health.py)
+    stream-recovered  a stalled/wedged stream progressed again (or
+                      finished) — the incident closed
 
 The tracker is pure host bookkeeping: no jax import, no device
 arrays, so the serve hot loop's ``serve-host-sync`` lint contract is
@@ -177,6 +182,16 @@ class SloTracker:
         self.deadline_misses = 0
         self.queue_overflows = 0
         self.evictions = 0
+        self.stream_stalls = 0
+        self.stream_recoveries = 0
+        #: Latest watchdog snapshot (r24 swarmpulse): the per-stream
+        #: health table + state counts serve/health.py pushes here
+        #: each check — what ``summary()``, the Prometheus gauge, and
+        #: ``swarmscope health`` all render.
+        self.stream_health: Optional[dict] = None
+        #: Observation-window label ``rotate()`` stamps on successor
+        #: trackers (None for the first window).
+        self.window: Optional[str] = None
         #: Live metrics plane (r19): the alert counters increment in
         #: the SAME methods that append to ``events`` (alert parity —
         #: the two surfaces can never drift), the latency histograms
@@ -230,6 +245,20 @@ class SloTracker:
             "device_peak_bytes",
             "Device allocator peak-bytes watermark (max over "
             "addressable devices)",
+        )
+        self._m_stall = reg.counter(
+            "serve_stream_stalls_total",
+            "Streams whose device heartbeat aged into the watchdog's "
+            "alarm zone (stalled/wedged)",
+        )
+        self._m_recover = reg.counter(
+            "serve_stream_recovered_total",
+            "Alarmed streams that progressed again or finished",
+        )
+        self._m_health = reg.gauge(
+            "serve_stream_health",
+            "In-flight streams per watchdog health state",
+            labels=("state",),
         )
 
     # -- stamps ------------------------------------------------------------
@@ -327,6 +356,53 @@ class SloTracker:
             }
         )
 
+    def on_stream_stall(
+        self, rids, state: str, age_ms: float,
+        expected_wall_ms: float, seg: Optional[int] = None,
+        t: Optional[float] = None,
+    ) -> None:
+        """One stream entered the watchdog's alarm zone (r24): the
+        counter and the event update HERE, in the same method — the
+        count-for-count parity contract every alert keeps."""
+        self.stream_stalls += 1
+        self._m_stall.inc()
+        now = self.clock() if t is None else float(t)
+        self.events.append(
+            {
+                "event": "stream-stall",
+                "t_ms": round(self._ms(now), 3),
+                "rids": list(rids),
+                "state": state,
+                "age_ms": round(float(age_ms), 3),
+                "expected_wall_ms": round(float(expected_wall_ms), 3),
+                "seg": None if seg is None else int(seg),
+            }
+        )
+
+    def on_stream_recovered(
+        self, rids, age_ms: float, t: Optional[float] = None
+    ) -> None:
+        self.stream_recoveries += 1
+        self._m_recover.inc()
+        now = self.clock() if t is None else float(t)
+        self.events.append(
+            {
+                "event": "stream-recovered",
+                "t_ms": round(self._ms(now), 3),
+                "rids": list(rids),
+                "age_ms": round(float(age_ms), 3),
+            }
+        )
+
+    def set_stream_health(self, snapshot: dict) -> None:
+        """Install the watchdog's latest per-stream table and mirror
+        the state counts onto the ``serve_stream_health`` gauge (the
+        label set is the fixed four-state ladder — bounded
+        cardinality by construction)."""
+        self.stream_health = snapshot
+        for state, n in snapshot.get("counts", {}).items():
+            self._m_health.set(int(n), state=state)
+
     # -- gauges ------------------------------------------------------------
     def sample(self, queue_depth: int, in_flight: int) -> None:
         """One pump's gauge sample; decimates 2x (and doubles the
@@ -378,6 +454,49 @@ class SloTracker:
             row[1] += int(size)
             row[2] += int(n_real)
 
+    # -- window rotation ---------------------------------------------------
+    def rotate(self, window: Optional[str] = None) -> "SloTracker":
+        """Close this observation window and return its successor —
+        the helper the r16 notes promised ("a weeks-long service
+        rotates trackers per observation window").  The successor:
+
+        - shares the clock, deadline/grace, gauge bound, memory
+          probe, and the METRICS REGISTRY (registration is
+          idempotent, and the Prometheus counters stay monotone
+          across windows — a scrape never sees totals reset);
+        - CARRIES the alert-counter totals (misses, overflows,
+          evictions, stalls, recoveries) so the tracker attributes
+          match their metric twins count-for-count across the
+          rotation;
+        - takes OWNERSHIP of the in-flight clocks — an open request's
+          latency lands in the window that observes its collect — and
+          of the latest health snapshot (the streams are still live);
+        - starts EMPTY everywhere else: latency samples, events,
+          gauge trajectory, dispatch/rung occupancy.  This tracker
+          keeps its closed-window record for archival (``summary()``
+          still works) but receives no new observations.
+
+        The per-window state is therefore bounded by the window, not
+        by service lifetime (tested in tests/test_health.py)."""
+        nxt = SloTracker(
+            deadline_s=self.deadline_s,
+            miss_grace_s=self.miss_grace_s,
+            clock=self.clock,
+            max_gauge_samples=self._max_gauge_samples,
+            memory_probe=self.memory_probe,
+            metrics=self.metrics,
+        )
+        nxt.window = window
+        nxt.deadline_misses = self.deadline_misses
+        nxt.queue_overflows = self.queue_overflows
+        nxt.evictions = self.evictions
+        nxt.stream_stalls = self.stream_stalls
+        nxt.stream_recoveries = self.stream_recoveries
+        nxt.clocks = self.clocks
+        self.clocks = {}
+        nxt.stream_health = self.stream_health
+        return nxt
+
     # -- reduction ---------------------------------------------------------
     def ttfr_ms(self) -> List[float]:
         """Collected samples plus any in-flight request that already
@@ -410,6 +529,8 @@ class SloTracker:
             "deadline_misses": self.deadline_misses,
             "queue_overflows": self.queue_overflows,
             "evictions": self.evictions,
+            "stream_stalls": self.stream_stalls,
+            "stream_recoveries": self.stream_recoveries,
             "dispatches": self.n_dispatches,
             "filler_fraction": round(self.filler_fraction(), 4),
             "rungs": {
@@ -426,6 +547,10 @@ class SloTracker:
             "gauge_stride": self._gauge_stride,
             "queue_depth": [list(g) for g in self.gauges],
         }
+        if self.window is not None:
+            out["window"] = self.window
+        if self.stream_health is not None:
+            out["stream_health"] = self.stream_health
         if self.memory_probe is not None:
             peak, reason = self.memory_probe()
             out["device_peak_bytes"] = (

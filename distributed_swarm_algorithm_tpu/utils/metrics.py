@@ -723,11 +723,7 @@ class MetricsEndpoint:
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                 elif self.path.split("?")[0] == "/healthz":
                     body = (
-                        json.dumps(
-                            {"status": "ok", "t_ms": round(
-                                1e3 * (endpoint.registry.clock()
-                                       - endpoint.registry.t0), 3)}
-                        ) + "\n"
+                        json.dumps(endpoint.health_body()) + "\n"
                     ).encode()
                     ctype = "application/json"
                 else:
@@ -756,6 +752,34 @@ class MetricsEndpoint:
 
     def url(self, path: str = "/metrics") -> str:
         return f"http://{self.host}:{self.port}{path}"
+
+    def health_body(self) -> dict:
+        """The /healthz payload.  r24: the probe reads the stream
+        watchdog's ``serve_stream_health`` gauge — any stream in the
+        alarm zone (stalled/wedged) degrades the endpoint's status,
+        so an orchestrator's liveness check sees a wedged device
+        without parsing the metrics exposition.  A registry with no
+        serving gauge (or metrics disabled) stays ``ok``: absence of
+        evidence is not an alarm."""
+        status = "ok"
+        alarmed = {}
+        gauge = self.registry.get("serve_stream_health")
+        if gauge is not None:
+            for state in ("stalled", "wedged"):
+                n = gauge.value(state=state)
+                if n > 0:
+                    alarmed[state] = int(n)
+        if alarmed:
+            status = "degraded"
+        body = {
+            "status": status,
+            "t_ms": round(
+                1e3 * (self.registry.clock() - self.registry.t0), 3
+            ),
+        }
+        if alarmed:
+            body["stream_health"] = alarmed
+        return body
 
     def close(self) -> None:
         self._server.shutdown()

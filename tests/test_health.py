@@ -1,0 +1,445 @@
+"""swarmpulse (r24): device heartbeats, callback harvest, and the
+stream-health watchdog.
+
+Four layers:
+
+- **the watchdog, pure**: ``HealthMonitor`` classification is plain
+  host arithmetic over duck-typed stream rows — fake-clocked unit
+  tests pin the ladder boundaries, the learned-wall fallbacks, the
+  cadence gate, and the one-event-per-incident transition discipline
+  with no service (and no jax) in sight;
+- **the wedge drill**: a ``launch_hook`` veto freezes a live stream's
+  rotation mid-flight — the host-visible signature of a wedged
+  device — and the watchdog classifies it ``stalled`` within ONE
+  watchdog interval of the threshold crossing, with the
+  ``stream-stall`` event and its metric counter moving
+  count-for-count; un-wedging completes the stream and closes the
+  incident with ``stream-recovered``;
+- **the harvest parity contract**: callbacks-on (per-segment device
+  heartbeats + callback-driven harvest) is BITWISE equal, per
+  tenant, to callbacks-off (the pre-r19 ``is_ready`` poll), across
+  all three stream classes — single-device, scenario-sharded, and
+  jumbo — including through an eviction cut; and the pulse token
+  registries are pinned empty once streams are collected or
+  abandoned (no token leaks);
+- **window rotation**: ``SloTracker.rotate`` bounds per-window state
+  by the window while carrying the alert counters and the shared
+  metrics registry, so scrapes stay monotone across rotations.
+"""
+
+from __future__ import annotations
+
+import math
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import distributed_swarm_algorithm_tpu as dsa
+from distributed_swarm_algorithm_tpu import serve
+from distributed_swarm_algorithm_tpu.parallel.mesh import make_serve_mesh
+from distributed_swarm_algorithm_tpu.serve import pulse as pulse_mod
+from distributed_swarm_algorithm_tpu.serve.health import (
+    ALARM_STATES,
+    HEALTH_STATES,
+    HealthMonitor,
+)
+from distributed_swarm_algorithm_tpu.serve.slo import SloTracker
+from distributed_swarm_algorithm_tpu.utils.metrics import MetricsRegistry
+
+# Same shapes as tests/test_serve_2d.py so the in-process jit cache
+# is shared across files (tier-1 budget discipline).
+CFG = dsa.SwarmConfig().replace(
+    formation_shape="none", utility_threshold=2.0
+)
+JUMBO_CFG = dsa.SwarmConfig().replace(
+    separation_mode="hashgrid", world_hw=64.0,
+    formation_shape="none", hashgrid_backend="portable",
+    grid_max_per_cell=24, max_speed=1.0, hashgrid_skin=1.0,
+)
+PARITY_FIELDS = ("pos", "vel", "fsm", "leader_id", "alive", "tick")
+
+
+def _assert_parity(a_state, b_state, label=""):
+    for f in PARITY_FIELDS:
+        a = np.asarray(getattr(a_state, f))
+        b = np.asarray(getattr(b_state, f))
+        assert np.array_equal(a, b), f"{label}: field {f} diverged"
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def _assert_pulse_registries_empty():
+    assert pulse_mod._PROBE_LANDED == {}
+    assert pulse_mod._PROBE_CLOCKS == {}
+    assert pulse_mod._PROBE_SHARDS == {}
+
+
+# ------------------------------------------------- the watchdog, pure
+
+
+def _row(**kw):
+    base = dict(
+        rids=[0], done=False, seg_done=1, segs_landed=1,
+        last_launch_t=0.0, last_progress_t=0.0,
+        health_state="healthy",
+    )
+    base.update(kw)
+    return SimpleNamespace(**base)
+
+
+class _SloRecorder:
+    """The tracker surface the monitor emits through, as lists."""
+
+    def __init__(self):
+        self.stalls = []
+        self.recoveries = []
+        self.snapshots = []
+
+    def on_stream_stall(self, rids, **kw):
+        self.stalls.append((list(rids), kw))
+
+    def on_stream_recovered(self, rids, **kw):
+        self.recoveries.append((list(rids), kw))
+
+    def set_stream_health(self, snapshot):
+        self.snapshots.append(snapshot)
+
+
+def test_monitor_rejects_unordered_thresholds():
+    with pytest.raises(ValueError, match="ordered"):
+        HealthMonitor(slow_mult=4.0, stall_mult=1.5)
+    with pytest.raises(ValueError, match="ordered"):
+        HealthMonitor(stall_mult=20.0, wedge_mult=16.0)
+
+
+def test_classify_ladder_boundaries():
+    m = HealthMonitor()  # 1.5 / 4 / 16
+    wall = 100.0
+    assert m.classify(0.0, wall) == "healthy"
+    assert m.classify(150.0, wall) == "healthy"   # boundary inclusive
+    assert m.classify(150.1, wall) == "slow"
+    assert m.classify(400.0, wall) == "slow"
+    assert m.classify(400.1, wall) == "stalled"
+    assert m.classify(1600.0, wall) == "stalled"
+    assert m.classify(1600.1, wall) == "wedged"
+    assert set(HEALTH_STATES) >= set(ALARM_STATES)
+
+
+def test_expected_wall_learned_floored_and_fallback():
+    hist = SimpleNamespace(percentile=lambda q: 200.0)
+    m = HealthMonitor(wall_hist=hist, floor_ms=50.0,
+                      default_wall_ms=1000.0)
+    assert m.expected_wall_ms() == 200.0        # learned from history
+    # Empty histogram (0.0) and past-envelope (inf) both fall back to
+    # the structured default — inf must not disable the watchdog.
+    m.wall_hist = SimpleNamespace(percentile=lambda q: 0.0)
+    assert m.expected_wall_ms() == 1000.0
+    m.wall_hist = SimpleNamespace(percentile=lambda q: math.inf)
+    assert m.expected_wall_ms() == 1000.0
+    m.wall_hist = None
+    assert m.expected_wall_ms() == 1000.0
+    # Sub-millisecond learned walls clamp to the floor: an idle pump
+    # on fast CPU segments must not look wedged.
+    m.wall_hist = SimpleNamespace(percentile=lambda q: 0.5)
+    assert m.expected_wall_ms() == 50.0
+
+
+def test_check_cadence_transitions_and_one_event_per_incident():
+    clock = FakeClock()
+    rec = _SloRecorder()
+    m = HealthMonitor(
+        clock=clock, interval_s=1.0, floor_ms=1.0,
+        default_wall_ms=100.0, slo=rec,
+    )
+    s = _row(last_launch_t=0.0, last_progress_t=None)
+    # First check runs (no prior), classifies from last_launch_t.
+    snap = m.check([s])
+    assert snap is not None
+    assert s.health_state == "healthy"
+    assert snap["counts"]["healthy"] == 1
+    assert snap["expected_wall_ms"] == 100.0
+    # Cadence gate: a second check inside the interval is skipped,
+    # force=True overrides.
+    clock.advance(0.3)  # age 300 ms: slow band, NOT an alarm
+    assert m.check([s]) is None
+    assert m.check([s], force=True) is not None
+    assert s.health_state == "slow"
+    assert not rec.stalls
+    # Crossing into the alarm zone emits ONE stream-stall.
+    clock.advance(0.7)  # age 1000 ms > 4 * 100
+    m.check([s], force=True)
+    assert s.health_state == "stalled"
+    assert len(rec.stalls) == 1
+    rids, kw = rec.stalls[0]
+    assert rids == [0] and kw["state"] == "stalled"
+    assert kw["expected_wall_ms"] == 100.0 and kw["age_ms"] >= 400.0
+    # Escalation stalled -> wedged is visible but NOT a second alarm.
+    clock.advance(1.0)  # age 2000 ms > 16 * 100
+    m.check([s], force=True)
+    assert s.health_state == "wedged"
+    assert len(rec.stalls) == 1 and not rec.recoveries
+    # Progress resumes: one stream-recovered closes the incident.
+    s.last_progress_t = clock.t - 0.01  # age 10 ms: healthy
+    m.check([s], force=True)
+    assert s.health_state == "healthy"
+    assert len(rec.recoveries) == 1
+    # A stream finishing WHILE alarmed also recovers (the incident
+    # closes with an event, not silence) and leaves the table.
+    clock.advance(0.5)  # age 510 ms: stalled band again
+    m.check([s], force=True)
+    assert s.health_state == "stalled"
+    assert len(rec.stalls) == 2
+    s.done = True
+    snap = m.check([s], force=True)
+    assert len(rec.recoveries) == 2
+    assert snap["rows"] == []
+    # Admitted-but-never-launched rows have no heartbeat to age.
+    fresh = _row(last_launch_t=None, last_progress_t=None)
+    snap = m.check([fresh], force=True)
+    assert snap["rows"] == [] and fresh.health_state == "healthy"
+    # Every snapshot also landed on the tracker surface.
+    assert len(rec.snapshots) >= 5
+
+
+# --------------------------------------------------- the wedge drill
+
+
+def test_wedge_drill_detects_within_one_interval():
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    slo = SloTracker(deadline_s=0.001, clock=clock, metrics=reg)
+    wedged = {"on": False}
+
+    def hook(rids, seg):
+        return not wedged["on"]
+
+    # interval 10 ms, expected wall 5 ms (the fake clock never moves
+    # during compute, so the wall histogram stays empty and the
+    # default rules): stalled band is (20 ms, 80 ms].
+    monitor = HealthMonitor(
+        interval_s=0.01, floor_ms=1.0, default_wall_ms=5.0
+    )
+    svc = serve.StreamingService(
+        CFG, spec=serve.BucketSpec(capacities=(32,), batches=(1,)),
+        n_steps=9, segment_steps=3, deadline_s=0.001,
+        telemetry=False, slo=slo, health=monitor, launch_hook=hook,
+    )
+    assert monitor.clock is clock and monitor.slo is slo
+    rid = svc.submit(serve.ScenarioRequest(n_agents=20, seed=0))
+    svc.pump(force=True)          # segment 1 launched, heartbeat live
+    wedged["on"] = True
+    # Below threshold: age 15 ms <= 4 * 5 ms — no alarm.
+    clock.advance(0.015)
+    svc.pump()
+    assert svc._streams[rid].health_state in ("healthy", "slow")
+    assert slo.stream_stalls == 0
+    # Cross into the stalled band; the FIRST pump past the crossing
+    # (one watchdog interval) must classify and alarm.
+    clock.advance(0.015)          # age 30 ms: stalled band
+    svc.pump()
+    assert svc._streams[rid].health_state == "stalled"
+    assert slo.stream_stalls == 1
+    # Count-for-count parity: attribute == counter == event count.
+    assert reg.get("serve_stream_stalls_total").value() == 1.0
+    stalls = [e for e in slo.events if e["event"] == "stream-stall"]
+    assert len(stalls) == 1
+    assert stalls[0]["rids"] == [rid]
+    assert stalls[0]["state"] == "stalled"
+    assert stalls[0]["expected_wall_ms"] == 5.0
+    assert stalls[0]["age_ms"] >= 20.0
+    assert reg.get("serve_stream_health").value(state="stalled") == 1.0
+    # The health surface reaches the summary.
+    summ = slo.summary()
+    assert summ["stream_stalls"] == 1
+    assert summ["stream_health"]["counts"]["stalled"] == 1
+    # Un-wedge: the stream completes and the incident closes — the
+    # frozen fake clock gates every in-drain cadence tick, so the
+    # recovery rides the collect-time discharge (an alarm must not
+    # dangle past the stream it names).
+    wedged["on"] = False
+    results = svc.drain()
+    assert list(results) == [rid] and results[rid].ticks == 9
+    assert slo.stream_recoveries == 1
+    assert reg.get("serve_stream_recovered_total").value() == 1.0
+    recs = [e for e in slo.events if e["event"] == "stream-recovered"]
+    assert len(recs) == 1 and recs[0]["rids"] == [rid]
+    # The next cadence tick republishes the (now empty) table.
+    clock.advance(1.0)
+    svc.pump()
+    assert reg.get("serve_stream_health").value(state="stalled") == 0.0
+    _assert_pulse_registries_empty()
+
+
+# ------------------------------------- harvest parity, all 3 classes
+
+
+def _mixed_rung_service(first_result_callback):
+    mesh = make_serve_mesh(scenarios=4, tiles=2)
+    spec = serve.BucketSpec(
+        capacities=(16,), batches=(4,), jumbo_capacities=(64,)
+    )
+    svc = serve.StreamingService(
+        CFG, spec=spec, n_steps=9, segment_steps=3,
+        deadline_s=0.001, telemetry=False, mesh=mesh,
+        jumbo_cfg=JUMBO_CFG,
+        metrics=MetricsRegistry(enabled=False),
+        first_result_callback=first_result_callback,
+    )
+    jrid = svc.submit(
+        serve.ScenarioRequest(n_agents=50, seed=9, arena_hw=57.0)
+    )
+    srids = [
+        svc.submit(serve.ScenarioRequest(
+            n_agents=10 + i, seed=20 + i,
+            params={"k_sep": 12.0 + i},
+        ))
+        for i in range(4)
+    ]
+    return svc, jrid, srids
+
+
+def test_callback_harvest_bitwise_equals_poll_all_stream_classes():
+    # Callbacks ON: run to completion by hand so the per-stream
+    # heartbeat ledgers are still inspectable before collect.
+    svc_on, jrid_on, srids_on = _mixed_rung_service(True)
+    while not all(
+        svc_on.result_ready(r) for r in [jrid_on] + srids_on
+    ):
+        svc_on.pump()
+    # Every segment of every stream class device-stamped: the
+    # heartbeat cursor reached the full segment plan for the jumbo
+    # (tiles axis), the sharded rung (scenarios axis), and with no
+    # is_ready poll having been needed to know it.
+    for rid in [jrid_on] + srids_on:
+        s = svc_on._streams[rid]
+        assert s.pulsed
+        assert s.segs_landed == len(s.seg_plan) == 3
+        assert s.last_progress_t is not None
+    res_on = {r: svc_on.collect(r) for r in [jrid_on] + srids_on}
+    # One harvest-lag sample per tenant (4 sharded + 1 jumbo), like
+    # the TTFR twin.
+    assert len(svc_on.harvest_lag_ms) == 5
+    assert all(lag >= 0.0 for lag in svc_on.harvest_lag_ms)
+    assert len(svc_on.ttfr_lag_ms) == 5
+    _assert_pulse_registries_empty()
+    # Callbacks OFF: the pre-r19 poll path, same tenants.
+    svc_off, jrid_off, srids_off = _mixed_rung_service(False)
+    res_off = svc_off.drain()
+    assert svc_off.harvest_lag_ms == []
+    _assert_pulse_registries_empty()
+    # Bitwise parity, per tenant, per field, across stream classes.
+    _assert_parity(
+        res_on[jrid_on].state, res_off[jrid_off].state, "jumbo"
+    )
+    for a, b in zip(srids_on, srids_off):
+        _assert_parity(
+            res_on[a].state, res_off[b].state, f"sharded {a}"
+        )
+        assert res_on[a].ticks == res_off[b].ticks == 9
+
+
+def test_eviction_prefix_parity_through_callback_harvest():
+    # A jumbo tenant evicted mid-stream under the CALLBACK harvest
+    # returns the same bitwise prefix as under the poll harvest, and
+    # abandoning the stream closes its pulse token (no leak).
+    def _evicted(first_result_callback):
+        mesh = make_serve_mesh(scenarios=4, tiles=2)
+        spec = serve.BucketSpec(
+            capacities=(16,), batches=(1,), jumbo_capacities=(64,)
+        )
+        svc = serve.StreamingService(
+            CFG, spec=spec, n_steps=9, segment_steps=3,
+            deadline_s=0.001, telemetry=False, mesh=mesh,
+            jumbo_cfg=JUMBO_CFG,
+            metrics=MetricsRegistry(enabled=False),
+            first_result_callback=first_result_callback,
+        )
+        rid = svc.submit(serve.ScenarioRequest(
+            n_agents=48, seed=5, arena_hw=57.0
+        ))
+        svc.pump(force=True)      # segment 1 launched
+        assert svc.evict(rid)
+        while rid not in svc.ready_rids():
+            svc.pump()
+        s = svc._streams[rid]
+        assert s.abandoned and s.done and s.seg_done == 1
+        if first_result_callback:
+            # Abandon closed the token immediately...
+            assert s.probe_token is None and s.pulsed
+        res = svc.collect(rid)
+        # ...and nothing leaked.
+        _assert_pulse_registries_empty()
+        return res
+
+    on = _evicted(True)
+    off = _evicted(False)
+    assert on.ticks == off.ticks == 3
+    _assert_parity(on.state, off.state, "evicted jumbo prefix")
+
+
+# -------------------------------------------------- window rotation
+
+
+def test_slo_rotate_carries_alerts_and_bounds_window_state():
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    t1 = SloTracker(deadline_s=0.5, clock=clock, metrics=reg)
+    # Window 1 traffic: alerts, samples, an in-flight request.
+    t1.on_stream_stall([3], state="stalled", age_ms=50.0,
+                       expected_wall_ms=5.0)
+    t1.on_stream_recovered([3], age_ms=1.0)
+    t1.on_eviction(7, ticks=3)
+    t1.on_submit(11)              # still open at rotation
+    t1.set_stream_health(
+        {"expected_wall_ms": 5.0, "rows": [],
+         "counts": {s: 0 for s in HEALTH_STATES}}
+    )
+    assert len(t1.events) == 3
+    t2 = t1.rotate("w2")
+    # The successor: same plane, carried alert totals, empty window.
+    assert t2.window == "w2"
+    assert t2.metrics is reg and t2.clock is clock
+    assert t2.stream_stalls == 1
+    assert t2.stream_recoveries == 1
+    assert t2.evictions == 1
+    assert t2.events == []        # bounded by the window
+    assert t2.stream_health is not None
+    # In-flight clocks MOVED to the observing window.
+    assert 11 in t2.clocks and t1.clocks == {}
+    # The closed window keeps its archival record.
+    assert len(t1.events) == 3
+    assert t1.summary()["stream_stalls"] == 1
+    # Counters stay monotone across the rotation: window 2's first
+    # stall lands on the SAME registry series, total 2.
+    t2.on_stream_stall([4], state="wedged", age_ms=90.0,
+                       expected_wall_ms=5.0)
+    assert reg.get("serve_stream_stalls_total").value() == 2.0
+    assert t2.stream_stalls == 2
+    assert t2.summary()["window"] == "w2"
+
+
+def test_service_rotate_slo_rewires_the_watchdog():
+    clock = FakeClock()
+    svc = serve.StreamingService(
+        CFG, spec=serve.BucketSpec(capacities=(32,), batches=(1,)),
+        n_steps=3, deadline_s=0.001, telemetry=False,
+        slo=SloTracker(deadline_s=0.001, clock=clock,
+                       metrics=MetricsRegistry(enabled=False)),
+    )
+    old = svc.slo
+    closed = svc.rotate_slo("w2")
+    assert closed is old
+    assert svc.slo is not old and svc.slo.window == "w2"
+    # The watchdog emits into the NEW window.
+    assert svc.health.slo is svc.slo

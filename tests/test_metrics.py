@@ -40,6 +40,7 @@ import pytest
 import distributed_swarm_algorithm_tpu as dsa
 from distributed_swarm_algorithm_tpu import serve
 from distributed_swarm_algorithm_tpu.cli import main as cli_main
+from distributed_swarm_algorithm_tpu.serve import pulse as pulse_mod
 from distributed_swarm_algorithm_tpu.serve import service as service_mod
 from distributed_swarm_algorithm_tpu.utils import compile_watch as cw
 from distributed_swarm_algorithm_tpu.utils import metrics as metricslib
@@ -314,6 +315,38 @@ def test_metrics_endpoint_round_trip():
         assert b"a_total 3" in body
     with pytest.raises((urllib.error.URLError, OSError)):
         urllib.request.urlopen(ep.url(), timeout=1)
+
+
+def test_healthz_degrades_on_stalled_streams():
+    # r24: the liveness probe reads the stream watchdog's gauge — an
+    # alarmed stream flips the status so orchestrators see a wedged
+    # device without parsing the exposition; recovery flips it back.
+    reg = MetricsRegistry()
+    g = reg.gauge(
+        "serve_stream_health", "per-state stream counts",
+        labels=("state",),
+    )
+    with serve_metrics_endpoint(reg) as ep:
+        def _health():
+            return json.loads(
+                urllib.request.urlopen(
+                    ep.url("/healthz"), timeout=5
+                ).read()
+            )
+
+        g.set(2, state="healthy")
+        assert _health()["status"] == "ok"
+        g.set(1, state="stalled")
+        got = _health()
+        assert got["status"] == "degraded"
+        assert got["stream_health"] == {"stalled": 1}
+        g.set(0, state="stalled")
+        g.set(1, state="wedged")
+        got = _health()
+        assert got["status"] == "degraded"
+        assert got["stream_health"] == {"wedged": 1}
+        g.set(0, state="wedged")
+        assert _health()["status"] == "ok"
 
 
 # ------------------------------------------------------------ deposits
@@ -602,22 +635,31 @@ def test_callback_on_bitwise_equal_and_lag_stamped():
     assert len(svc_on.ttfr_lag_ms) == 3
     assert all(lag >= 0.0 for lag in svc_on.ttfr_lag_ms)
     assert svc_off.ttfr_lag_ms == []
-    # Neither path leaks probe tokens.
-    assert service_mod._PROBE_LANDED == {}
-    assert service_mod._PROBE_CLOCKS == {}
+    # Neither path leaks pulse tokens (r24: three registries).
+    assert pulse_mod._PROBE_LANDED == {}
+    assert pulse_mod._PROBE_CLOCKS == {}
+    assert pulse_mod._PROBE_SHARDS == {}
+    # r24: the callback path also stamped every FINAL segment — the
+    # harvest-lag twin has one sample per tenant, the poll path none.
+    assert len(svc_on.harvest_lag_ms) == 3
+    assert all(lag >= 0.0 for lag in svc_on.harvest_lag_ms)
+    assert svc_off.harvest_lag_ms == []
 
 
 def test_callback_off_path_is_the_pre_r19_program(monkeypatch):
     """The r10 gate discipline, stated executably: with callbacks off
     the probe is the LITERAL pre-r19 ``jnp.copy`` expression — no
-    callback program exists to lower or run (byte-identical off
-    path), which the sentinel proves by never firing."""
+    stamp program exists to lower or run, no token is ever opened
+    (byte-identical off path), which the sentinels prove by never
+    firing."""
     def _boom(*a, **k):  # pragma: no cover - failing is the assert
         raise AssertionError(
-            "callbacks-off service entered the callback probe"
+            "callbacks-off service entered the pulse machinery"
         )
 
-    monkeypatch.setattr(service_mod, "_probe_stamp", _boom)
+    monkeypatch.setattr(service_mod, "pulse_stamp", _boom)
+    monkeypatch.setattr(service_mod, "pulse_stamp_sharded", _boom)
+    monkeypatch.setattr(service_mod, "pulse_open", _boom)
     svc, results = _run_service(
         metrics=MetricsRegistry(enabled=False),
         first_result_callback=False,
@@ -660,9 +702,10 @@ def test_probe_stamp_lowering_carries_the_callback():
 
     tick = jnp.zeros((2,), jnp.int32)
     token = jnp.asarray(7, jnp.int32)
-    text = service_mod._probe_stamp.lower(tick, token).as_text()
+    seg = jnp.asarray(0, jnp.int32)
+    text = pulse_mod.pulse_stamp.lower(tick, token, seg).as_text()
     assert "callback" in text or "custom_call" in text, (
-        "the probe program lost its completion callback"
+        "the pulse program lost its completion callback"
     )
 
 

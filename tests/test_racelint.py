@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import inspect
 import os
+import textwrap
 import threading
 import urllib.request
 
@@ -38,7 +39,7 @@ from distributed_swarm_algorithm_tpu.analysis.racewitness import (
     RuntimeLockWitness,
     WitnessLock,
 )
-from distributed_swarm_algorithm_tpu.serve import service as service_mod
+from distributed_swarm_algorithm_tpu.serve import pulse as pulse_mod
 from distributed_swarm_algorithm_tpu.utils.metrics import (
     MetricsRegistry,
     serve_metrics_endpoint,
@@ -57,7 +58,7 @@ SPEC = serve.BucketSpec(capacities=(32,), batches=(1, 2))
 
 METRICS_LOCK = f"{PKG}/utils/metrics.py::MetricsRegistry._lock"
 TRACER_LOCK = f"{PKG}/utils/trace.py::SpanTracer._lock"
-PROBE_LOCK = f"{PKG}/serve/service.py::_PROBE_LOCK"
+PROBE_LOCK = f"{PKG}/serve/pulse.py::_PROBE_LOCK"
 
 
 @pytest.fixture(scope="module")
@@ -74,6 +75,76 @@ def test_serve_plane_is_race_clean():
     )
     assert not errors
     assert not findings, "\n".join(f.render() for f in findings)
+
+
+def _write_fixture(root, rel, src) -> None:
+    path = os.path.join(root, rel)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(textwrap.dedent(src))
+
+
+def test_callback_roots_cover_partial_wrapped_host_callbacks(tmp_path):
+    """r24: the thread-root inference follows heartbeat-registry
+    idiom — a ``jax.pure_callback``/``io_callback`` whose host
+    function is bound with ``functools.partial`` is still an async
+    root, so an unguarded dict write inside it races with the
+    spawner's write (seeded positive), while the lock-guarded twin
+    stays clean (precision)."""
+    _write_fixture(
+        str(tmp_path), "pkg/pulsefix/landed.py",
+        """
+        import functools
+
+        import jax
+
+        _LANDED = {}
+
+        def on_land(token, leaf):
+            _LANDED[token] = float(leaf)
+
+        def stamp(leaf, token):
+            jax.pure_callback(
+                functools.partial(on_land, token), None, leaf
+            )
+            _LANDED.setdefault(token, 0.0)
+            return leaf
+        """,
+    )
+    _write_fixture(
+        str(tmp_path), "pkg/pulsefix/guarded.py",
+        """
+        import functools
+        import threading
+
+        import jax
+
+        _LOCK = threading.Lock()
+        _LANDED = {}
+
+        def on_land(token, leaf):
+            with _LOCK:
+                _LANDED[token] = float(leaf)
+
+        def stamp(leaf, token):
+            jax.pure_callback(
+                functools.partial(on_land, token), None, leaf
+            )
+            with _LOCK:
+                _LANDED.setdefault(token, 0.0)
+            return leaf
+        """,
+    )
+    findings, _, errors = analysis.analyze_paths(
+        str(tmp_path), ["pkg"], rules=analysis.racelint_rules()
+    )
+    assert not errors
+    hits = [f for f in findings if f.rule == "race-unguarded-write"]
+    assert len(hits) == 1, "\n".join(f.render() for f in findings)
+    assert hits[0].path == "pkg/pulsefix/landed.py"
+    assert not any(
+        f.path == "pkg/pulsefix/guarded.py" for f in findings
+    ), "\n".join(f.render() for f in findings)
 
 
 def test_static_model_covers_the_known_locks(regions):
@@ -157,7 +228,7 @@ def test_race_drill_static_guards_hold_live(regions):
     reg._lock = wl_reg
     wl_tracer = WitnessLock(tracer._lock)
     tracer._lock = wl_tracer
-    orig_probe = service_mod._PROBE_LOCK
+    orig_probe = pulse_mod._PROBE_LOCK
     wl_probe = WitnessLock(orig_probe)
     witness = RuntimeLockWitness(regions, {
         METRICS_LOCK: wl_reg,
@@ -177,7 +248,7 @@ def test_race_drill_static_guards_hold_live(regions):
                 rival_errors.append(e)
                 return
 
-    service_mod._PROBE_LOCK = wl_probe
+    pulse_mod._PROBE_LOCK = wl_probe
     rivals = []
     try:
         # Witness first, THEN rivals: settrace only reaches threads
@@ -207,7 +278,7 @@ def test_race_drill_static_guards_hold_live(regions):
                 t.join(timeout=10)
     finally:
         stop.set()
-        service_mod._PROBE_LOCK = orig_probe
+        pulse_mod._PROBE_LOCK = orig_probe
     assert not rival_errors, rival_errors
     assert len(results) == 3
     # The witness saw real guarded-region traffic...

@@ -347,6 +347,55 @@ def test_scope_slo_empty_run_says_so(tmp_path, capsys):
     assert "no SLO data" in capsys.readouterr().out
 
 
+# ---------------------------------------------------------------------------
+# swarmscope health (r24): the stream-health view
+
+
+def test_scope_health_renders_table_and_incident_log(
+    tmp_path, capsys
+):
+    run = _mk_run(tmp_path / "ra", "ra", BASE)
+    summary = dict(_slo_summary())
+    summary["stream_stalls"] = 1
+    summary["stream_recoveries"] = 1
+    summary["stream_health"] = {
+        "expected_wall_ms": 5.0,
+        "rows": [
+            {"rids": [3], "state": "stalled", "age_ms": 42.5,
+             "seg_done": 2, "segs_landed": 1},
+            {"rids": [4, 5], "state": "healthy", "age_ms": 1.0,
+             "seg_done": 2, "segs_landed": 2},
+        ],
+        "counts": {"healthy": 1, "slow": 0, "stalled": 1,
+                   "wedged": 0},
+    }
+    rundir.merge_slo_summary(run, "soak 60s", summary)
+    rundir.append_events(run, [
+        {"event": "stream-stall", "t_ms": 100.0, "rids": [3],
+         "state": "stalled", "age_ms": 42.5,
+         "expected_wall_ms": 5.0, "seg": 2},
+        {"event": "stream-recovered", "t_ms": 180.0, "rids": [3],
+         "age_ms": 1.2},
+        {"event": "eviction", "t_ms": 1500.0, "rid": 9, "ticks": 10},
+    ])
+    assert cli_main(["swarmscope", "health", run]) == 0
+    out = capsys.readouterr().out
+    assert "stream health [soak 60s]  stalls 1  recoveries 1" in out
+    assert "expected segment wall 5.0 ms" in out
+    assert "stalled 1" in out
+    assert "rids [3]" in out and "rids [4,5]" in out
+    assert "segs launched 2 / landed 1" in out
+    assert "STALL" in out and "RECOVERED" in out
+    assert "eviction" not in out   # not a health event
+
+
+def test_scope_health_empty_run_says_so(tmp_path, capsys):
+    run = _mk_run(tmp_path / "ra", "ra", BASE)
+    rundir.merge_slo_summary(run, "soak 60s", _slo_summary())
+    assert cli_main(["swarmscope", "health", run]) == 0
+    assert "no stream-health data" in capsys.readouterr().out
+
+
 def test_diff_gates_on_slo_latency_rows(tmp_path, capsys):
     # The diff picks the new latency units up via the shared gate:
     # a p99 tail regression names the row and exits nonzero.
